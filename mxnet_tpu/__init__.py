@@ -1,0 +1,19 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with classic-MXNet
+capabilities (NDArray, Symbol/Executor, Module, KVStore, data iterators)
+rebuilt idiomatically on JAX/XLA/Pallas.  See SURVEY.md for the mapping
+to the reference architecture."""
+
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, cpu_pinned, current_context, gpu, tpu, num_devices
+from . import engine
+from . import random
+from . import ndarray
+from . import ndarray as nd
+from . import symbol
+from . import symbol as sym
+from .symbol import AttrScope, Variable, Group
+from . import executor
+from .executor import Executor
+
+__version__ = "0.1.0"

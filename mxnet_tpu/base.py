@@ -1,0 +1,78 @@
+"""Base utilities: dtype mapping, errors, misc helpers.
+
+TPU-native rebuild of the role played by the reference's
+``python/mxnet/base.py`` (ctypes loader / handle types) and
+``include/mxnet/base.h``.  There is no C library handle layer here: the
+"backend" is JAX/XLA, so this module only carries the shared dtype table,
+exception types and small helpers used across the package.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "MXNetError",
+    "string_types",
+    "numeric_types",
+    "np_dtype",
+    "dtype_name",
+    "DTYPE_NAMES",
+]
+
+
+class MXNetError(Exception):
+    """Error raised by the framework (parity with reference base.py:39)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+
+# Canonical dtype table.  The reference supports fp16/32/64, uint8, int32
+# (mshadow type switch); we add bfloat16 as the TPU-native half type and
+# int64/bool for completeness.
+_DTYPE_ALIASES = {
+    "float32": np.float32,
+    "float64": np.float64,
+    "float16": np.float16,
+    "uint8": np.uint8,
+    "int8": np.int8,
+    "int32": np.int32,
+    "int64": np.int64,
+    "bool": np.bool_,
+}
+
+
+def _bfloat16():
+    import jax.numpy as jnp
+
+    return jnp.bfloat16
+
+
+def np_dtype(dtype):
+    """Normalize a dtype-like (string, np.dtype, python type) to a numpy dtype.
+
+    ``bfloat16`` is resolved through jax (ml_dtypes) since numpy has no
+    native bfloat16.
+    """
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            return np.dtype(_bfloat16())
+        if dtype in _DTYPE_ALIASES:
+            return np.dtype(_DTYPE_ALIASES[dtype])
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """Canonical string name for a dtype-like."""
+    return np_dtype(dtype).name
+
+
+DTYPE_NAMES = tuple(_DTYPE_ALIASES) + ("bfloat16",)
+
+
+def check_call(ret):
+    """No-op kept for API familiarity with the reference's ctypes layer."""
+    return ret

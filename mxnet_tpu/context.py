@@ -1,0 +1,145 @@
+"""Device context.
+
+Rebuild of the reference's ``python/mxnet/context.py`` (Context class,
+``mx.cpu()/mx.gpu()``, with-statement device stack) for a JAX/TPU backend.
+
+A ``Context`` names a logical device ``(device_type, device_id)`` and
+resolves lazily to a concrete ``jax.Device``.  Mapping rules:
+
+- ``tpu`` -> jax TPU devices (falls back to the default platform when no
+  TPU is present, so code written for TPU runs under the CPU backend used
+  in tests with ``--xla_force_host_platform_device_count=N``).
+- ``gpu``  -> alias for ``tpu`` (migration aid: reference examples use
+  ``mx.gpu(i)``; here they land on TPU chips).
+- ``cpu`` / ``cpu_pinned`` -> jax CPU devices.
+
+The reference's model-parallel tests rely on ``mx.cpu(0)`` and
+``mx.cpu(1)`` being distinct schedulable devices
+(tests/python/unittest/test_model_parallel.py) — that property holds here
+whenever multiple XLA host devices are configured.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context", "num_devices"]
+
+
+class Context:
+    """A logical device (device_type, device_id), usable as a with-block."""
+
+    _default_ctx = threading.local()
+
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 4: "tpu"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 4}
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            if device_type not in self.devstr2type:
+                raise ValueError(f"unknown device type {device_type!r}")
+            self.device_typeid = self.devstr2type[device_type]
+            self.device_id = device_id
+        self._old_ctx = None
+
+    @property
+    def device_type(self) -> str:
+        return self.devtype2str[self.device_typeid]
+
+    # -- jax resolution ----------------------------------------------------
+    def jax_device(self):
+        """Resolve to a concrete jax.Device (cached per process)."""
+        import jax
+
+        devs = _platform_devices(self.device_type)
+        if self.device_id >= len(devs):
+            raise ValueError(
+                f"{self} out of range: only {len(devs)} {self.device_type} device(s) available"
+            )
+        return devs[self.device_id]
+
+    # -- identity ----------------------------------------------------------
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_typeid == other.device_typeid
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    def __str__(self):
+        return self.__repr__()
+
+    def __enter__(self):
+        self._old_ctx = getattr(Context._default_ctx, "value", None)
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        Context._default_ctx.value = self._old_ctx
+        return False
+
+
+def _platform_devices(device_type: str):
+    """Devices for a device_type, with graceful fallback (memoized)."""
+    import jax
+
+    key = device_type
+    cache = _platform_devices._cache
+    if key in cache:
+        return cache[key]
+    order = {
+        "cpu": ["cpu"],
+        "cpu_pinned": ["cpu"],
+        "tpu": ["tpu", None],
+        "gpu": ["tpu", "gpu", None],
+    }[device_type]
+    devs = None
+    for plat in order:
+        try:
+            devs = jax.devices(plat) if plat else jax.devices()
+            break
+        except RuntimeError:
+            continue
+    if devs is None:
+        devs = jax.devices()
+    cache[key] = devs
+    return devs
+
+
+_platform_devices._cache = {}
+
+
+def cpu(device_id=0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id=0) -> Context:
+    """Accelerator context (alias family: on this framework, a TPU chip)."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0) -> Context:
+    return Context("tpu", device_id)
+
+
+def num_devices(device_type="tpu") -> int:
+    return len(_platform_devices(device_type))
+
+
+def current_context() -> Context:
+    """The ambient default context (reference context.py:108)."""
+    cur = getattr(Context._default_ctx, "value", None)
+    return cur if cur is not None else Context("cpu", 0)

@@ -1,0 +1,277 @@
+"""Asynchronous dependency engine.
+
+Rebuild of the reference's dataflow scheduler (include/mxnet/engine.h,
+src/engine/threaded_engine.{h,cc}, threaded_engine_perdevice.cc) for the
+TPU runtime.  Division of labor in this framework:
+
+- **Device compute** is scheduled by XLA/PJRT: every jitted program is
+  dispatched asynchronously by JAX onto the device stream, which already
+  provides the per-device in-order async pipeline the reference built
+  ThreadedEnginePerDevice for.  A compiled graph segment == one engine op
+  (the reference's "bulk segment", graph_executor.cc:842-892, made the
+  default unit).
+- **Host-side work** (data pipeline stages, checkpoint writes, custom
+  Python ops, cross-device staging) still needs genuine dependency
+  scheduling — that is what this engine does.
+
+Semantics mirror threaded_engine.h:87-189: each ``Var`` holds a queue of
+pending reader/writer blocks; an op runs when all its const (read) vars
+have granted read access and all mutable (write) vars have reached it at
+the queue head.  ``NaiveEngine`` runs everything inline (the documented
+debugging path, threaded_engine.cc:306-314); ``ThreadedEngine`` dispatches
+ready ops to a worker pool.  Selection via ``MXNET_ENGINE_TYPE`` env var,
+exactly like src/engine/engine.cc:13-39.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+__all__ = ["Engine", "Var", "get_engine", "set_engine_type", "FnProperty"]
+
+
+class FnProperty:
+    """Operator property hints (engine.h:58-69)."""
+
+    NORMAL = 0
+    COPY_FROM_DEVICE = 1
+    COPY_TO_DEVICE = 2
+    CPU_PRIORITIZED = 3
+    ASYNC = 4
+
+
+class Var:
+    """A schedulable variable (engine.h Var / threaded_engine.h ThreadedVar).
+
+    Holds a FIFO of pending accessors.  Readers at the head of the queue
+    may proceed concurrently; a writer must be alone at the head.
+    """
+
+    __slots__ = ("_lock", "_queue", "_active_readers", "_active_writer", "name")
+
+    def __init__(self, name=None):
+        self._lock = threading.Lock()
+        self._queue = deque()  # (op_block, is_write)
+        self._active_readers = 0
+        self._active_writer = False
+        self.name = name
+
+    def __repr__(self):
+        return f"Var({self.name or hex(id(self))})"
+
+
+class _OpBlock:
+    __slots__ = ("fn", "const_vars", "mutable_vars", "wait", "lock", "prop", "done", "exc")
+
+    def __init__(self, fn, const_vars, mutable_vars, prop):
+        self.fn = fn
+        self.const_vars = const_vars
+        self.mutable_vars = mutable_vars
+        self.prop = prop
+        self.wait = len(const_vars) + len(mutable_vars)
+        self.lock = threading.Lock()
+        self.done = threading.Event()
+        self.exc = None
+
+    def dec_wait(self):
+        with self.lock:
+            self.wait -= 1
+            return self.wait == 0
+
+
+class Engine:
+    """Dependency engine base: push ops with read/write sets."""
+
+    def __init__(self):
+        self._pending = 0
+        self._pending_lock = threading.Lock()
+        self._all_done = threading.Condition(self._pending_lock)
+        self._exceptions = []
+
+    # -- public API (engine.h:74-223) --------------------------------------
+    def new_variable(self, name=None) -> Var:
+        return Var(name)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), prop=FnProperty.NORMAL,
+             priority=0):
+        """Schedule ``fn()`` to run once its dependencies are satisfied.
+
+        ``const_vars`` are read, ``mutable_vars`` are written; no var may
+        appear twice across the two sets (CheckDuplicate,
+        threaded_engine.cc:205-237).
+        """
+        const_vars = tuple(const_vars)
+        mutable_vars = tuple(mutable_vars)
+        seen = set()
+        for v in const_vars + mutable_vars:
+            if id(v) in seen:
+                raise ValueError(f"duplicate variable {v} in dependency sets")
+            seen.add(id(v))
+        block = _OpBlock(fn, const_vars, mutable_vars, prop)
+        with self._pending_lock:
+            self._pending += 1
+        if not const_vars and not mutable_vars:
+            self._dispatch(block)
+            return block
+        # Enqueue on every var; a var grants access immediately if possible.
+        ready = 0
+        for v in const_vars:
+            if self._append_read(v, block):
+                ready += 1
+        for v in mutable_vars:
+            if self._append_write(v, block):
+                ready += 1
+        # Decrement wait for the grants that happened synchronously.
+        fire = False
+        for _ in range(ready):
+            if block.dec_wait():
+                fire = True
+        if fire:
+            self._dispatch(block)
+        return block
+
+    def wait_for_var(self, var: Var):
+        """Block until all ops touching ``var`` pushed so far completed."""
+        done = threading.Event()
+        self.push(done.set, const_vars=(var,))
+        done.wait()
+
+    def wait_for_all(self):
+        with self._all_done:
+            while self._pending:
+                self._all_done.wait()
+        if self._exceptions:
+            exc = self._exceptions[:]
+            self._exceptions.clear()
+            raise exc[0]
+
+    def delete_variable(self, var: Var, on_delete=None):
+        """Schedule deletion after all pending ops on var complete."""
+        if on_delete is not None:
+            self.push(on_delete, mutable_vars=(var,))
+
+    # -- var queue mechanics (threaded_engine.h:87-189) ---------------------
+    def _append_read(self, var: Var, block) -> bool:
+        """Returns True if read access is granted immediately."""
+        with var._lock:
+            if not var._active_writer and not var._queue:
+                var._active_readers += 1
+                return True
+            var._queue.append((block, False))
+            return False
+
+    def _append_write(self, var: Var, block) -> bool:
+        with var._lock:
+            if not var._active_writer and var._active_readers == 0 and not var._queue:
+                var._active_writer = True
+                return True
+            var._queue.append((block, True))
+            return False
+
+    def _complete(self, block):
+        for v in block.const_vars:
+            self._release(v, is_write=False)
+        for v in block.mutable_vars:
+            self._release(v, is_write=True)
+        block.done.set()
+        with self._pending_lock:
+            self._pending -= 1
+            if block.exc is not None:
+                self._exceptions.append(block.exc)
+            if self._pending == 0:
+                self._all_done.notify_all()
+
+    def _release(self, var: Var, is_write: bool):
+        to_fire = []
+        with var._lock:
+            if is_write:
+                var._active_writer = False
+            else:
+                var._active_readers -= 1
+            # Grant queued accessors now runnable.
+            while var._queue and not var._active_writer:
+                nxt, nxt_write = var._queue[0]
+                if nxt_write:
+                    if var._active_readers == 0:
+                        var._queue.popleft()
+                        var._active_writer = True
+                        to_fire.append(nxt)
+                    break
+                var._queue.popleft()
+                var._active_readers += 1
+                to_fire.append(nxt)
+        for blk in to_fire:
+            if blk.dec_wait():
+                self._dispatch(blk)
+
+    # -- execution ----------------------------------------------------------
+    def _dispatch(self, block):
+        raise NotImplementedError
+
+    def _run(self, block):
+        try:
+            block.fn()
+        except BaseException as e:  # propagated at wait_for_all
+            block.exc = e
+        finally:
+            self._complete(block)
+
+
+class NaiveEngine(Engine):
+    """Synchronous inline execution (src/engine/naive_engine.cc)."""
+
+    def _dispatch(self, block):
+        self._run(block)
+
+
+class ThreadedEngine(Engine):
+    """Worker-pool execution (src/engine/threaded_engine_perdevice.cc).
+
+    One shared pool for normal work plus a dedicated pool for prioritized /
+    IO work, standing in for the reference's per-device + copy pools (device
+    streams are owned by PJRT here).
+    """
+
+    def __init__(self, num_workers=None):
+        super().__init__()
+        if num_workers is None:
+            num_workers = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS", "4"))
+        self._pool = ThreadPoolExecutor(max_workers=num_workers,
+                                        thread_name_prefix="mxtpu-engine")
+        self._io_pool = ThreadPoolExecutor(max_workers=2,
+                                           thread_name_prefix="mxtpu-engine-io")
+
+    def _dispatch(self, block):
+        pool = (
+            self._io_pool
+            if block.prop in (FnProperty.COPY_FROM_DEVICE, FnProperty.COPY_TO_DEVICE,
+                              FnProperty.CPU_PRIORITIZED)
+            else self._pool
+        )
+        pool.submit(self._run, block)
+
+
+_engine = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> Engine:
+    """Singleton engine, selected by MXNET_ENGINE_TYPE (engine.cc:13-39)."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            kind = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEngine")
+            _engine = NaiveEngine() if kind == "NaiveEngine" else ThreadedEngine()
+        return _engine
+
+
+def set_engine_type(kind: str):
+    """Switch engine implementation ('NaiveEngine' | 'ThreadedEngine')."""
+    global _engine
+    with _engine_lock:
+        if _engine is not None:
+            _engine.wait_for_all()
+        _engine = NaiveEngine() if kind == "NaiveEngine" else ThreadedEngine()

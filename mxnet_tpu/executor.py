@@ -1,0 +1,380 @@
+"""Graph executor.
+
+Rebuild of the reference GraphExecutor (src/symbol/graph_executor.cc,
+include/mxnet/symbolic.h:320-420, python frontend python/mxnet/executor.py)
+for the XLA compilation model.
+
+Design mapping (SURVEY.md §7):
+
+- The reference plans memory, instantiates per-node operators, and pushes
+  cached engine ops per node, fusing runs of ops into "bulk segments"
+  (graph_executor.cc:842-892).  Here the *entire per-context subgraph* is
+  one bulk segment: a single jitted XLA program.  XLA buffer assignment
+  replaces GraphStorageAllocator; XLA fusion replaces the engine's
+  op-level pipelining; JAX async dispatch preserves the asynchronous
+  ``forward()``-returns-immediately semantics.
+- ``MakeBackwardPass`` (static_graph.cc:396-550) — the explicit backward
+  graph transform — becomes ``jax.vjp`` over the traced forward, with
+  loss-layer custom backward rules applied through ``jax.custom_vjp`` and
+  gradient checkpointing ("mirroring", MXNET_BACKWARD_DO_MIRROR) mapped
+  to ``jax.checkpoint`` on nodes carrying the ``force_mirroring`` attr.
+- ``grad_req`` add/write/null (OpReqType, operator.h:23-36) is applied
+  when gradients are committed to the bound grad arrays; XLA input/output
+  aliasing (buffer donation) replaces the reference's inplace planning.
+
+Training-mode ``forward`` eagerly launches the fused forward+backward
+program with default head gradients (ones): for loss-headed graphs this
+is exactly one compiled train step — the TPU-idiomatic execution unit —
+and ``backward()`` just commits the already-computed gradients.  Custom
+head gradients fall back to re-running the fused program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import ndarray as nd
+from . import random as _random
+from .base import MXNetError, np_dtype
+from .context import Context
+from .ndarray import NDArray
+
+__all__ = ["Executor"]
+
+
+def _wrap_custom_vjp(op, params):
+    """Wrap an op with explicit backward into jax.custom_vjp."""
+
+    @jax.custom_vjp
+    def f(*inputs):
+        outs, _ = op.forward(params, list(inputs), [], True, None)
+        return tuple(outs)
+
+    def f_fwd(*inputs):
+        outs = f(*inputs)
+        return outs, (inputs, outs)
+
+    def f_bwd(res, gouts):
+        inputs, outs = res
+        gins = op.backward(params, list(gouts), list(inputs), list(outs))
+        return tuple(gins)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+class _CompiledGraph:
+    """Traceable evaluator for a Symbol's node graph on one context."""
+
+    def __init__(self, symbol):
+        self.symbol = symbol
+        self.topo = symbol._topo()
+        self.heads = symbol._heads
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.rng_nodes = [n for n in self.topo
+                          if not n.is_variable and n.op.need_rng]
+        self._custom = {}
+        self._aux_of_node = {}
+        for node in self.topo:
+            if node.is_variable:
+                continue
+            n_args = len(node.op.list_arguments(node.params))
+            aux_vars = [src.name for src, _ in node.inputs[n_args:]]
+            self._aux_of_node[id(node)] = (n_args, aux_vars)
+            if node.op.has_backward:
+                self._custom[id(node)] = _wrap_custom_vjp(node.op, node.params)
+
+    def __call__(self, arg_vals: dict, aux_vals: dict, key, train: bool,
+                 collect=None):
+        """Evaluate the graph.  JAX-traceable for fixed ``train``.
+
+        Returns (outputs tuple, new_aux dict)."""
+        env = {}
+        subkeys = (jax.random.split(key, len(self.rng_nodes))
+                   if self.rng_nodes else None)
+        rng_idx = {id(n): i for i, n in enumerate(self.rng_nodes)}
+        new_aux = dict(aux_vals)
+        for node in self.topo:
+            if node.is_variable:
+                if node.name in arg_vals:
+                    env[id(node), 0] = arg_vals[node.name]
+                elif node.name in aux_vals:
+                    env[id(node), 0] = aux_vals[node.name]
+                continue
+            n_args, aux_names = self._aux_of_node[id(node)]
+            ins = [env[id(src), idx] for src, idx in node.inputs[:n_args]]
+            auxs = [new_aux[a] for a in aux_names]
+            mirror = node.attrs.get("force_mirroring", "") in ("1", "true", "True")
+            if id(node) in self._custom:
+                outs = list(self._custom[id(node)](*ins))
+                node_new_aux = auxs
+            else:
+                fwd = node.op.forward
+                nkey = subkeys[rng_idx[id(node)]] if id(node) in rng_idx else None
+                if mirror and train:
+                    # gradient checkpointing: recompute in backward
+                    pure = jax.checkpoint(
+                        lambda *i, _n=node, _k=nkey, _a=auxs: _n.op.forward(
+                            _n.params, list(i), list(_a), train, _k)[0])
+                    outs = list(pure(*ins))
+                    node_new_aux = node.op.forward(node.params, ins, auxs,
+                                                   train, nkey)[1]
+                else:
+                    outs, node_new_aux = fwd(node.params, ins, auxs, train, nkey)
+            for a, v in zip(aux_names, node_new_aux):
+                new_aux[a] = v
+            for i, o in enumerate(outs):
+                env[id(node), i] = o
+                if collect is not None:
+                    out_name = f"{node.name}_{node.op.list_outputs(node.params)[i]}"
+                    collect.append((out_name, o))
+        outputs = tuple(env[id(n), i] for n, i in self.heads)
+        return outputs, new_aux
+
+
+class Executor:
+    """Bound, compiled computation (reference python/mxnet/executor.py)."""
+
+    def __init__(self, symbol, ctx, grad_req, arg_arrays, grad_arrays, aux_arrays,
+                 group2ctx=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+        self.arg_arrays = arg_arrays
+        self.grad_arrays = grad_arrays
+        self.aux_arrays = aux_arrays
+        self.arg_dict = dict(zip(self.arg_names, arg_arrays))
+        self.grad_dict = {k: g for k, g in zip(self.arg_names, grad_arrays)
+                          if g is not None}
+        self.aux_dict = dict(zip(self.aux_names, aux_arrays))
+        if isinstance(grad_req, str):
+            grad_req = {k: grad_req for k in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(self.arg_names, grad_req))
+        self._grad_req = {k: (grad_req.get(k, "null") if grad_arrays else "null")
+                          for k in self.arg_names}
+        for k, g in zip(self.arg_names, grad_arrays or [None] * len(self.arg_names)):
+            if g is None:
+                self._grad_req[k] = "null"
+        self._grad_names = [k for k in self.arg_names if self._grad_req[k] != "null"]
+
+        self._graph = _CompiledGraph(symbol)
+        self._key = _random.next_key()
+        self._outputs = None
+        self._pending_grads = None
+        self._monitor_callback = None
+
+        # --- compiled entry points ---
+        graph = self._graph
+
+        def fwd(train, args, aux, key):
+            outs, new_aux = graph(args, aux, key, train)
+            return outs, new_aux
+
+        self._fwd_eval = jax.jit(lambda a, x, k: fwd(False, a, x, k))
+        self._fwd_train = jax.jit(lambda a, x, k: fwd(True, a, x, k))
+
+        def fwd_bwd(grad_args, other_args, aux, key, head_grads):
+            def f(ga):
+                outs, new_aux = graph({**ga, **other_args}, aux, key, True)
+                return outs, new_aux
+
+            outs, vjp_fn, new_aux = jax.vjp(f, grad_args, has_aux=True)
+            grads = vjp_fn(head_grads)[0]
+            return outs, grads, new_aux
+
+        self._fwd_bwd = jax.jit(fwd_bwd)
+
+    # -- factory helpers (Symbol.bind / simple_bind) -------------------------
+    @staticmethod
+    def _bind(symbol, ctx, args, args_grad, grad_req, aux_states, group2ctx=None,
+              shared_exec=None):
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_arrays = Executor._to_list(args, arg_names, "args")
+        if args_grad is None:
+            grad_arrays = [None] * len(arg_names)
+        else:
+            grad_arrays = Executor._to_list(args_grad, arg_names, "args_grad",
+                                            allow_missing=True)
+        aux_arrays = Executor._to_list(aux_states or [], aux_names, "aux_states")
+        return Executor(symbol, ctx, grad_req, arg_arrays, grad_arrays, aux_arrays,
+                        group2ctx=group2ctx)
+
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None,
+                     shared_exec=None, **kwargs):
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**kwargs)
+        type_dict = type_dict or {}
+        arg_types, _, aux_types = symbol.infer_type(**{
+            k: v for k, v in type_dict.items()})
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_arrays = [nd.zeros(s, ctx=ctx, dtype=t or np.float32)
+                      for s, t in zip(arg_shapes, arg_types)]
+        aux_arrays = [nd.zeros(s, ctx=ctx, dtype=t or np.float32)
+                      for s, t in zip(aux_shapes, aux_types)]
+        req = grad_req if isinstance(grad_req, dict) else {
+            k: grad_req for k in arg_names}
+        grad_arrays = [
+            nd.zeros(s, ctx=ctx, dtype=t or np.float32)
+            if req.get(k, "null") != "null" else None
+            for k, s, t in zip(arg_names, arg_shapes, arg_types)
+        ]
+        return Executor(symbol, ctx, req, arg_arrays, grad_arrays, aux_arrays,
+                        group2ctx=group2ctx)
+
+    @staticmethod
+    def _to_list(values, names, what, allow_missing=False):
+        if isinstance(values, dict):
+            out = []
+            for k in names:
+                if k in values:
+                    out.append(values[k])
+                elif allow_missing:
+                    out.append(None)
+                else:
+                    raise MXNetError(f"{what}: missing entry for {k!r}")
+            return out
+        values = list(values)
+        if len(values) != len(names):
+            raise MXNetError(f"{what}: expected {len(names)} entries, got {len(values)}")
+        return values
+
+    # -- execution ----------------------------------------------------------
+    def _gather(self):
+        args = {k: a._data for k, a in zip(self.arg_names, self.arg_arrays)}
+        aux = {k: a._data for k, a in zip(self.aux_names, self.aux_arrays)}
+        return args, aux
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def forward(self, is_train=False, **kwargs):
+        """Run forward (reference executor.py:84).  kwargs assign input
+        arrays by name before running (e.g. ``exe.forward(data=batch)``)."""
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"unknown input {k!r}")
+            if isinstance(v, NDArray):
+                self.arg_dict[k][:] = v
+            else:
+                self.arg_dict[k][:] = nd.array(v, ctx=self._ctx)
+        args, aux = self._gather()
+        key = self._next_key()
+
+        if self._monitor_callback is not None:
+            collect = []
+            outs, new_aux = self._graph(args, aux, key, is_train, collect=collect)
+            for name, val in collect:
+                self._monitor_callback(name, NDArray(val, self._ctx))
+        elif is_train and self._grad_names:
+            grad_args = {k: args[k] for k in self._grad_names}
+            other = {k: v for k, v in args.items() if k not in grad_args}
+            outs_probe = jax.eval_shape(
+                lambda a, x, k: self._fwd_train(a, x, k)[0], args, aux, key)
+            head = tuple(jnp.ones(o.shape, o.dtype) for o in outs_probe)
+            outs, grads, new_aux = self._fwd_bwd(grad_args, other, aux, key, head)
+            self._pending_grads = grads
+        else:
+            fn = self._fwd_train if is_train else self._fwd_eval
+            outs, new_aux = fn(args, aux, key)
+            self._pending_grads = None
+
+        if is_train:
+            for k, arr in zip(self.aux_names, self.aux_arrays):
+                arr._set(new_aux[k])
+        self._outputs = [NDArray(o, self._ctx) for o in outs]
+        return self._outputs
+
+    def backward(self, out_grads=None):
+        """Commit gradients (reference executor.py:123).
+
+        With no ``out_grads``: gradients from the fused train step (head
+        gradients = ones, the loss-layer contract) are committed.  With
+        explicit head gradients the fused program re-runs with them.
+        """
+        if not self._grad_names:
+            return
+        if out_grads is not None:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            head = tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                         for g in out_grads)
+            args, aux = self._gather()
+            grad_args = {k: args[k] for k in self._grad_names}
+            other = {k: v for k, v in args.items() if k not in grad_args}
+            _, grads, _ = self._fwd_bwd(grad_args, other, aux, self._key, head)
+        else:
+            if self._pending_grads is None:
+                raise MXNetError("backward called before forward(is_train=True)")
+            grads = self._pending_grads
+        for k, garr in zip(self.arg_names, self.grad_arrays):
+            if garr is None or self._grad_req[k] == "null":
+                continue
+            g = grads[k]
+            if self._grad_req[k] == "add":
+                garr._set(garr._data + g)
+            else:
+                garr._set(g)
+        self._pending_grads = None
+
+    @property
+    def outputs(self):
+        if self._outputs is None:
+            raise MXNetError("run forward() first")
+        return self._outputs
+
+    # -- misc API -----------------------------------------------------------
+    def set_monitor_callback(self, callback):
+        """Install per-output stat callback; switches to eager (un-fused)
+        execution like the reference disabling bulk exec under monitor
+        (graph_executor.cc:904)."""
+        self._monitor_callback = callback
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k][:] = v
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown arg {k!r}")
+        if aux_params:
+            for k, v in aux_params.items():
+                if k in self.aux_dict:
+                    self.aux_dict[k][:] = v
+                elif not allow_extra_params:
+                    raise MXNetError(f"unknown aux {k!r}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor with new input shapes (executor.py reshape)."""
+        new_shapes = {}
+        arg_shapes, _, _ = self._symbol.infer_shape(**kwargs)
+        for name, shp, arr in zip(self.arg_names, arg_shapes, self.arg_arrays):
+            new_shapes[name] = shp
+        ctx = self._ctx
+        new_args = []
+        for name, shp, arr in zip(self.arg_names, arg_shapes, self.arg_arrays):
+            if tuple(arr.shape) == tuple(shp):
+                new_args.append(arr)
+            else:
+                new_args.append(nd.zeros(shp, ctx=ctx, dtype=arr.dtype))
+        grad_arrays = []
+        for name, shp, garr in zip(self.arg_names, arg_shapes, self.grad_arrays):
+            if garr is None:
+                grad_arrays.append(None)
+            elif tuple(garr.shape) == tuple(shp):
+                grad_arrays.append(garr)
+            else:
+                grad_arrays.append(nd.zeros(shp, ctx=ctx, dtype=garr.dtype))
+        return Executor(self._symbol, ctx, self._grad_req, new_args, grad_arrays,
+                        self.aux_arrays)
+
+    def debug_str(self):
+        return self._symbol.debug_str()

@@ -1,0 +1,470 @@
+"""Imperative NDArray.
+
+Rebuild of the reference NDArray stack (include/mxnet/ndarray.h,
+src/ndarray/ndarray.cc, python/mxnet/ndarray.py) on a JAX/XLA backend.
+
+Execution model: every NDArray wraps a **committed** ``jax.Array`` on the
+device of its ``Context``.  Ops dispatch through per-(op, params) jitted
+callables — JAX's async dispatch plays the role of the reference's
+dependency engine for device work (ops return immediately; device-side
+ordering is per-device program order, a superset of the reference's
+read/write-dependency order), and ``wait_to_read`` maps to
+``block_until_ready`` (reference ndarray.h:123-139).
+
+The module-level op functions (``dot``, ``FullyConnected``, …) are
+generated at import time by enumerating the op registry — the same
+runtime-discovery pattern as the reference's
+``_init_ndarray_module``/``_make_ndarray_function``
+(python/mxnet/ndarray.py:1128-1305).
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import random as _random
+from .base import MXNetError, np_dtype, numeric_types
+from .context import Context, cpu, current_context
+from .ops import OP_REGISTRY
+
+__all__ = [
+    "NDArray", "array", "empty", "zeros", "ones", "full", "arange",
+    "concatenate", "save", "load", "imperative_invoke", "onehot_encode",
+    "waitall",
+]
+
+# Generated op functions (sum, max, slice, abs, ...) shadow builtins in this
+# module's namespace; keep safe references for internal use.
+_pyslice = slice
+_pysum = sum
+
+
+class NDArray:
+    """Multi-dimensional array on a device context."""
+
+    __slots__ = ("_data", "_ctx", "writable")
+
+    def __init__(self, data, ctx=None, writable=True):
+        if ctx is None:
+            ctx = current_context()
+        self._ctx = ctx
+        self._data = data
+        self.writable = writable
+
+    # -- core properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def context(self) -> Context:
+        return self._ctx
+
+    @property
+    def T(self):
+        return transpose(self)
+
+    # -- sync / host transfer (reference ndarray.h:123-139, ndarray.py:465)
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self._data.block_until_ready()
+
+    def asnumpy(self) -> np.ndarray:
+        return np.asarray(jax.device_get(self._data))
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("the array is not scalar-sized")
+        return self.asnumpy().reshape(())[()]
+
+    def astype(self, dtype):
+        return NDArray(self._data.astype(np_dtype(dtype)), self._ctx)
+
+    # -- copies ------------------------------------------------------------
+    def copyto(self, other):
+        """Copy to another NDArray (in place) or a Context (new array).
+
+        Reference ndarray.py:511 / CopyFromTo ndarray.cc:226-290.
+        """
+        if isinstance(other, NDArray):
+            if other.shape != self.shape:
+                raise ValueError(f"copyto shape mismatch {self.shape} vs {other.shape}")
+            other._data = jax.device_put(
+                self._data.astype(other.dtype), other._ctx.jax_device())
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device()), other)
+        raise TypeError(f"copyto does not support {type(other)}")
+
+    def as_in_context(self, ctx: Context):
+        if ctx == self._ctx:
+            return self
+        return self.copyto(ctx)
+
+    def copy(self):
+        return NDArray(self._data + 0, self._ctx)
+
+    def reshape(self, shape):
+        if isinstance(shape, (int, np.integer)):
+            shape = (shape,)
+        return NDArray(jnp.reshape(self._data, shape), self._ctx)
+
+    # -- mutation ----------------------------------------------------------
+    def _check_writable(self):
+        if not self.writable:
+            raise MXNetError("trying to write to a read-only NDArray")
+
+    def _set(self, data):
+        self._check_writable()
+        self._data = data
+        return self
+
+    def __setitem__(self, key, value):
+        self._check_writable()
+        if isinstance(value, NDArray):
+            value = value._data
+        elif isinstance(value, numeric_types):
+            pass
+        else:
+            value = jnp.asarray(np.asarray(value), dtype=self.dtype)
+        if isinstance(key, _pyslice) and key == _pyslice(None):
+            if isinstance(value, numeric_types):
+                self._data = jnp.full(self.shape, value, self.dtype)
+            else:
+                self._data = jnp.broadcast_to(value, self.shape).astype(self.dtype)
+            self._data = jax.device_put(self._data, self._ctx.jax_device())
+        else:
+            self._data = self._data.at[key].set(value)
+
+    def __getitem__(self, key):
+        return NDArray(self._data[key], self._ctx)
+
+    # -- python protocol ---------------------------------------------------
+    def __len__(self):
+        return self.shape[0]
+
+    def __repr__(self):
+        return f"<NDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("ambiguous truth value of multi-element NDArray")
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    # -- arithmetic (ndarray.py:105+) --------------------------------------
+    def __add__(self, other):
+        return _ufunc(self, other, "_plus", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return _ufunc(self, other, "_minus", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return _ufunc(self, other, None, "_rminus_scalar")
+
+    def __mul__(self, other):
+        return _ufunc(self, other, "_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return _ufunc(self, other, "_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return _ufunc(self, other, None, "_rdiv_scalar")
+
+    def __pow__(self, other):
+        return _ufunc(self, other, "_power", "_power_scalar")
+
+    def __rpow__(self, other):
+        return _ufunc(self, other, None, "_rpower_scalar")
+
+    def __neg__(self):
+        return imperative_invoke("negative", [self], {})[0]
+
+    def __iadd__(self, other):
+        return self._set((self + other)._data)
+
+    def __isub__(self, other):
+        return self._set((self - other)._data)
+
+    def __imul__(self, other):
+        return self._set((self * other)._data)
+
+    def __itruediv__(self, other):
+        return self._set((self / other)._data)
+
+    def __eq__(self, other):
+        return _ufunc(self, other, "_equal", "_equal_scalar")
+
+    def __ne__(self, other):
+        return _ufunc(self, other, "_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, other):
+        return _ufunc(self, other, "_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return _ufunc(self, other, "_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return _ufunc(self, other, "_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return _ufunc(self, other, "_lesser_equal", "_lesser_equal_scalar")
+
+    __hash__ = object.__hash__
+
+
+def _ufunc(lhs, rhs, op_name, scalar_op_name):
+    if isinstance(rhs, NDArray):
+        if op_name is None:
+            raise TypeError("operation not supported between two NDArrays")
+        return imperative_invoke(op_name, [lhs, rhs], {})[0]
+    if isinstance(rhs, numeric_types):
+        return imperative_invoke(scalar_op_name, [lhs], {"scalar": float(rhs)})[0]
+    raise TypeError(f"unsupported operand type {type(rhs)}")
+
+
+# -- imperative dispatch -----------------------------------------------------
+@functools.lru_cache(maxsize=None)
+def _cached_jit(op_name, params, train):
+    """One jitted callable per (op, params, train); JAX retraces per
+    shape/dtype — the rebuild of the reference's cached engine ops keyed
+    by executable (SURVEY.md §7 hard part (b))."""
+    op = OP_REGISTRY.get(op_name)
+
+    def fn(*args):
+        if op.need_rng:
+            inputs, key = list(args[:-1]), args[-1]
+        else:
+            inputs, key = list(args), None
+        outs, _ = op.forward(params, inputs, [], train, key)
+        return tuple(outs)
+
+    return jax.jit(fn)
+
+
+def imperative_invoke(op_name, inputs, kwargs, out=None, ctx=None, train=True):
+    """Invoke a registered op on NDArrays (reference MXFuncInvoke path,
+    src/c_api/c_api.cc:410-436 → registered function → Engine::PushSync)."""
+    op = OP_REGISTRY.get(op_name)
+    params = op.make_params(kwargs)
+    if inputs:
+        ctx = inputs[0].context
+        for arr in inputs[1:]:
+            if arr.context != ctx:
+                raise MXNetError(
+                    f"{op_name}: inputs on different contexts "
+                    f"({arr.context} vs {ctx}); use copyto/as_in_context")
+    elif ctx is None:
+        ctx = current_context()
+    fn = _cached_jit(op_name, params, train)
+    args = [arr._data for arr in inputs]
+    if op.need_rng:
+        args.append(_random.next_key())
+    if not inputs:
+        with jax.default_device(ctx.jax_device()):
+            raw = fn(*args)
+    else:
+        raw = fn(*args)
+    results = [NDArray(r, ctx) for r in raw]
+    if out is not None:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for dst, src in zip(outs, results):
+            dst._set(jax.device_put(src._data.astype(dst.dtype), dst._ctx.jax_device()))
+        return list(outs)
+    return results
+
+
+# -- creation ----------------------------------------------------------------
+def _resolve_ctx(ctx):
+    return ctx if ctx is not None else current_context()
+
+
+def array(source, ctx=None, dtype=None) -> NDArray:
+    """Create an NDArray from any array-like (reference ndarray.py array)."""
+    ctx = _resolve_ctx(ctx)
+    if isinstance(source, NDArray):
+        source = source.asnumpy()
+    if dtype is None:
+        # reference default: float32 unless the source already carries a
+        # non-float64 numpy dtype (python/mxnet/ndarray.py array)
+        if isinstance(source, np.ndarray) and source.dtype != np.float64:
+            dtype = source.dtype
+        else:
+            dtype = np.float32
+    arr = np.asarray(source, dtype=np_dtype(dtype))
+    return NDArray(jax.device_put(arr, ctx.jax_device()), ctx)
+
+
+def empty(shape, ctx=None, dtype=None) -> NDArray:
+    return zeros(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype=None) -> NDArray:
+    ctx = _resolve_ctx(ctx)
+    if isinstance(shape, int):
+        shape = (shape,)
+    data = jax.device_put(jnp.zeros(shape, np_dtype(dtype)), ctx.jax_device())
+    return NDArray(data, ctx)
+
+
+def ones(shape, ctx=None, dtype=None) -> NDArray:
+    ctx = _resolve_ctx(ctx)
+    if isinstance(shape, int):
+        shape = (shape,)
+    data = jax.device_put(jnp.ones(shape, np_dtype(dtype)), ctx.jax_device())
+    return NDArray(data, ctx)
+
+
+def full(shape, val, ctx=None, dtype=None) -> NDArray:
+    ctx = _resolve_ctx(ctx)
+    if isinstance(shape, int):
+        shape = (shape,)
+    data = jax.device_put(jnp.full(shape, val, np_dtype(dtype)), ctx.jax_device())
+    return NDArray(data, ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None) -> NDArray:
+    ctx = _resolve_ctx(ctx)
+    vals = np.arange(start, stop, step, dtype=np_dtype(dtype))
+    if repeat > 1:
+        vals = np.repeat(vals, repeat)
+    return NDArray(jax.device_put(vals, ctx.jax_device()), ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True) -> NDArray:
+    if not arrays:
+        raise ValueError("need at least one array")
+    if len(arrays) == 1 and not always_copy:
+        return arrays[0]
+    ctx = arrays[0].context
+    return NDArray(jnp.concatenate([a._data for a in arrays], axis=axis), ctx)
+
+
+def onehot_encode(indices: NDArray, out: NDArray) -> NDArray:
+    """Fill out with one-hot rows from indices (reference _onehot_encode)."""
+    depth = out.shape[1]
+    hot = jax.nn.one_hot(indices._data.astype(jnp.int32), depth, dtype=out.dtype)
+    out._set(jax.device_put(hot, out._ctx.jax_device()))
+    return out
+
+
+def waitall():
+    """Block until all dispatched work completes (Engine::WaitForAll)."""
+    from .engine import get_engine
+
+    get_engine().wait_for_all()
+    jax.effects_barrier()
+
+
+# -- serialization (reference mx.nd.save/load, ndarray.py:1001-1086) ---------
+def save(fname: str, data):
+    """Save a list or str->NDArray dict (two-artifact checkpoint contract)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        payload = {f"__list__:{i}": a.asnumpy() for i, a in enumerate(data)}
+    elif isinstance(data, dict):
+        payload = {k: v.asnumpy() for k, v in data.items()}
+    else:
+        raise TypeError("save expects NDArray, list or dict")
+    np.savez(fname if fname.endswith(".npz") else fname, **_encode_bf16(payload))
+
+
+def load(fname: str):
+    with np.load(fname, allow_pickle=False) as zf:
+        payload = _decode_bf16({k: zf[k] for k in zf.files})
+    if payload and all(k.startswith("__list__:") for k in payload):
+        items = sorted(payload.items(), key=lambda kv: int(kv[0].split(":")[1]))
+        return [array(v) for _, v in items]
+    return {k: array(v) for k, v in payload.items()}
+
+
+def _encode_bf16(payload):
+    """npz can't store bfloat16: stash as uint16 with a name tag."""
+    out = {}
+    for k, v in payload.items():
+        if v.dtype == np_dtype("bfloat16"):
+            out["__bf16__:" + k] = v.view(np.uint16)
+        else:
+            out[k] = v
+    return out
+
+
+def _decode_bf16(payload):
+    out = {}
+    for k, v in payload.items():
+        if k.startswith("__bf16__:"):
+            out[k[len("__bf16__:"):]] = v.view(np_dtype("bfloat16"))
+        else:
+            out[k] = v
+    return out
+
+
+# -- runtime-generated op functions ------------------------------------------
+def _make_ndarray_function(op_name):
+    op = OP_REGISTRY.get(op_name)
+
+    def generic_fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        ctx = kwargs.pop("ctx", None)
+        if isinstance(ctx, str):
+            ctx = Context(*ctx.split("(")) if False else ctx  # pragma: no cover
+        inputs = []
+        for a in args:
+            if isinstance(a, NDArray):
+                inputs.append(a)
+            elif isinstance(a, (np.ndarray, list, tuple)) and not kwargs.get("_no_coerce"):
+                inputs.append(array(a, ctx=ctx))
+            else:
+                raise TypeError(f"{op_name}: positional args must be NDArray, got {type(a)}")
+        results = imperative_invoke(op_name, inputs, kwargs, out=out, ctx=ctx)
+        return results[0] if len(results) == 1 else results
+
+    generic_fn.__name__ = op_name
+    generic_fn.__qualname__ = op_name
+    generic_fn.__doc__ = (
+        f"Imperative op ``{op_name}``"
+        + (f"\n{op.param_cls.__doc__}" if op.param_cls else "")
+    )
+    return generic_fn
+
+
+def _init_ndarray_module():
+    mod = sys.modules[__name__]
+    for name in OP_REGISTRY.list():
+        fn = _make_ndarray_function(name)
+        setattr(mod, name, fn)
+        canonical = OP_REGISTRY.get(name)
+        if canonical.name.lower() == name:
+            setattr(mod, canonical.name, fn)  # preserve CamelCase spelling
+
+
+_init_ndarray_module()

@@ -1,0 +1,18 @@
+"""Operator layer: registry + op families.
+
+Importing this package registers every operator (the reference's
+equivalent of linking src/operator/*.cc registrations into libmxnet).
+"""
+
+from .op import OP_REGISTRY, OpDef, SimpleOpDef, register_op, register_simple_op
+
+# Register op families (import order irrelevant; each module self-registers).
+from . import elementwise  # noqa: F401
+from . import reduce  # noqa: F401
+from . import matrix  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import sequence  # noqa: F401
+from . import sample  # noqa: F401
+
+__all__ = ["OP_REGISTRY", "OpDef", "SimpleOpDef", "register_op", "register_simple_op"]

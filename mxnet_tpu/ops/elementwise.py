@@ -1,0 +1,126 @@
+"""Elementwise unary / binary / scalar / broadcast operators.
+
+Rebuild of the reference's simple-op zoo:
+src/operator/{elemwise_unary_op,elementwise_binary_op,
+elementwise_binary_scalar_op,elementwise_binary_broadcast_op}.cc plus the
+scalar functor zoo in src/operator/mshadow_op.h.  Each registration yields
+both an imperative NDArray function and a Symbol op, as in the reference's
+MXNET_REGISTER_SIMPLE_OP pattern.  Kernels are jnp expressions — XLA fuses
+them into surrounding computations (the mshadow expression-template role).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..param import Params, field
+from .op import register_simple_op
+
+
+class ScalarParam(Params):
+    """Scalar operand for *_scalar ops (operator_util.h scalar ops)."""
+
+    scalar = field(float, required=True, doc="scalar operand")
+
+
+def _unary(name, fn, aliases=()):
+    register_simple_op(name, fn, nin=1, aliases=aliases)
+
+
+def _binary(name, fn, aliases=()):
+    register_simple_op(name, fn, nin=2, shape_rule="broadcast", aliases=aliases)
+
+
+def _scalar(name, fn):
+    register_simple_op(name, fn, nin=1, param_cls=ScalarParam)
+
+
+# -- unary (mshadow_op.h functors) ------------------------------------------
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("round", jnp.round)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: 1.0 / jnp.sqrt(x))
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("cos", jnp.cos)
+_unary("sin", jnp.sin)
+_unary("tanh", jnp.tanh)
+_unary("sigmoid", lambda x: 1.0 / (1.0 + jnp.exp(-x)))
+_unary("relu", lambda x: jnp.maximum(x, 0))
+_unary("negative", lambda x: -x, aliases=("_mul_scalar_neg",))
+_unary("_copy", lambda x: x)
+_unary("gamma", lambda x: jnp.exp(__import__("jax").scipy.special.gammaln(x)))
+_unary("gammaln", lambda x: __import__("jax").scipy.special.gammaln(x))
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+
+# -- binary (same-shape in the reference; we additionally broadcast) ---------
+_binary("_plus", jnp.add, aliases=("elemwise_add", "_add"))
+_binary("_minus", jnp.subtract, aliases=("elemwise_sub", "_sub"))
+_binary("_mul", jnp.multiply, aliases=("elemwise_mul",))
+_binary("_div", jnp.divide, aliases=("elemwise_div",))
+_binary("_power", jnp.power, aliases=("pow",))
+_binary("_maximum", jnp.maximum)
+_binary("_minimum", jnp.minimum)
+_binary("_hypot", jnp.hypot)
+
+# comparison family (returns same dtype as inputs, like the reference)
+_binary("_equal", lambda a, b: (a == b).astype(a.dtype))
+_binary("_not_equal", lambda a, b: (a != b).astype(a.dtype))
+_binary("_greater", lambda a, b: (a > b).astype(a.dtype))
+_binary("_greater_equal", lambda a, b: (a >= b).astype(a.dtype))
+_binary("_lesser", lambda a, b: (a < b).astype(a.dtype))
+_binary("_lesser_equal", lambda a, b: (a <= b).astype(a.dtype))
+
+# -- broadcast_* explicit family (elementwise_binary_broadcast_op.cc) --------
+_binary("broadcast_plus", jnp.add, aliases=("broadcast_add",))
+_binary("broadcast_minus", jnp.subtract, aliases=("broadcast_sub",))
+_binary("broadcast_mul", jnp.multiply)
+_binary("broadcast_div", jnp.divide)
+_binary("broadcast_power", jnp.power)
+_binary("broadcast_maximum", jnp.maximum)
+_binary("broadcast_minimum", jnp.minimum)
+
+# -- scalar variants ---------------------------------------------------------
+_scalar("_plus_scalar", lambda p, x: x + p.scalar)
+_scalar("_minus_scalar", lambda p, x: x - p.scalar)
+_scalar("_rminus_scalar", lambda p, x: p.scalar - x)
+_scalar("_mul_scalar", lambda p, x: x * p.scalar)
+_scalar("_div_scalar", lambda p, x: x / p.scalar)
+_scalar("_rdiv_scalar", lambda p, x: p.scalar / x)
+_scalar("_power_scalar", lambda p, x: x**p.scalar)
+_scalar("_rpower_scalar", lambda p, x: p.scalar**x)
+_scalar("_maximum_scalar", lambda p, x: jnp.maximum(x, p.scalar))
+_scalar("_minimum_scalar", lambda p, x: jnp.minimum(x, p.scalar))
+_scalar("_equal_scalar", lambda p, x: (x == p.scalar).astype(x.dtype))
+_scalar("_not_equal_scalar", lambda p, x: (x != p.scalar).astype(x.dtype))
+_scalar("_greater_scalar", lambda p, x: (x > p.scalar).astype(x.dtype))
+_scalar("_greater_equal_scalar", lambda p, x: (x >= p.scalar).astype(x.dtype))
+_scalar("_lesser_scalar", lambda p, x: (x < p.scalar).astype(x.dtype))
+_scalar("_lesser_equal_scalar", lambda p, x: (x <= p.scalar).astype(x.dtype))
+
+
+class SmoothL1Param(Params):
+    sigma = field(float, default=1.0, doc="transition point scale")
+
+
+def _smooth_l1(p, x):
+    s2 = p.sigma * p.sigma
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)
+
+
+register_simple_op("smooth_l1", _smooth_l1, nin=1, param_cls=SmoothL1Param)
+
+
+class ClipParam(Params):
+    a_min = field(float, required=True)
+    a_max = field(float, required=True)
+
+
+register_simple_op("clip", lambda p, x: jnp.clip(x, p.a_min, p.a_max), nin=1,
+                   param_cls=ClipParam)

@@ -1,0 +1,251 @@
+"""Output / loss-layer operators with explicit backward semantics.
+
+Rebuild of src/operator/{softmax_output,regression_output,make_loss,
+block_grad,svm_output}-inl.h.  These ops define ``backward`` explicitly:
+their gradient is the gradient of an *implicit* loss and ignores the head
+gradient — e.g. SoftmaxOutput's backward is ``(softmax(x) - onehot(label))
+* grad_scale`` regardless of out_grad.  The graph compiler wraps them in
+``jax.custom_vjp`` so whole-graph reverse-mode flows through correctly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..param import Params, field
+from .op import OpDef, register_op
+
+
+class SoftmaxOutputParam(Params):
+    grad_scale = field(float, default=1.0)
+    ignore_label = field(float, default=-1.0)
+    multi_output = field(bool, default=False)
+    use_ignore = field(bool, default=False)
+    preserve_shape = field(bool, default=False)
+    normalization = field(str, default="null", enum=("null", "batch", "valid"))
+
+
+@register_op("SoftmaxOutput", aliases=("Softmax",))
+class SoftmaxOutputOp(OpDef):
+    """Softmax forward + cross-entropy gradient backward
+    (softmax_output-inl.h:386: grad_scale, ignore_label, multi_output)."""
+
+    param_cls = SoftmaxOutputParam
+    is_loss = True
+
+    def list_arguments(self, params):
+        return ["data", "label"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            raise ValueError("SoftmaxOutput: data shape unknown")
+        if params.multi_output:
+            # data (n, c, d1...), label (n, d1...)
+            label = (d[0],) + tuple(d[2:])
+        else:
+            label = (d[0],)
+        return [tuple(d), label], [tuple(d)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        x = inputs[0]
+        axis = 1 if params.multi_output else -1
+        if not params.multi_output and x.ndim > 2 and not params.preserve_shape:
+            out = jax.nn.softmax(x.reshape(x.shape[0], -1)).reshape(x.shape)
+        else:
+            out = jax.nn.softmax(x, axis=axis)
+        return [out], []
+
+    def backward(self, params, out_grads, inputs, outputs):
+        prob = outputs[0]
+        label = inputs[1]
+        axis = 1 if params.multi_output else -1
+        nclass = prob.shape[axis]
+        lab = label.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab, nclass, dtype=prob.dtype, axis=axis)
+        grad = prob - onehot
+        if params.use_ignore:
+            mask = (label != params.ignore_label)
+            grad = grad * jnp.expand_dims(mask, axis).astype(grad.dtype)
+            if params.normalization == "valid":
+                valid = jnp.maximum(jnp.sum(mask), 1).astype(grad.dtype)
+                grad = grad / valid
+        if params.normalization == "batch":
+            grad = grad / prob.shape[0]
+        grad = grad * params.grad_scale
+        return [grad, jnp.zeros_like(label)]
+
+
+class RegressionParam(Params):
+    grad_scale = field(float, default=1.0)
+
+
+def _reg_label_shape(params, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        raise ValueError("regression output: data shape unknown")
+    return [tuple(d), tuple(d)], [tuple(d)], []
+
+
+@register_op("LinearRegressionOutput")
+class LinearRegressionOutputOp(OpDef):
+    """Identity forward, (pred - label) backward (regression_output-inl.h)."""
+
+    param_cls = RegressionParam
+    is_loss = True
+
+    def list_arguments(self, params):
+        return ["data", "label"]
+
+    infer_shape = _reg_label_shape
+
+    def forward(self, params, inputs, aux, train, key):
+        return [inputs[0]], []
+
+    def backward(self, params, out_grads, inputs, outputs):
+        scale = params.grad_scale / outputs[0].shape[0]
+        g = (outputs[0] - inputs[1].reshape(outputs[0].shape)) * scale
+        return [g, jnp.zeros_like(inputs[1])]
+
+
+@register_op("MAERegressionOutput")
+class MAERegressionOutputOp(LinearRegressionOutputOp):
+    def backward(self, params, out_grads, inputs, outputs):
+        scale = params.grad_scale / outputs[0].shape[0]
+        g = jnp.sign(outputs[0] - inputs[1].reshape(outputs[0].shape)) * scale
+        return [g, jnp.zeros_like(inputs[1])]
+
+
+@register_op("LogisticRegressionOutput")
+class LogisticRegressionOutputOp(OpDef):
+    """Sigmoid forward, (sigmoid(x) - label) backward."""
+
+    param_cls = RegressionParam
+    is_loss = True
+
+    def list_arguments(self, params):
+        return ["data", "label"]
+
+    infer_shape = _reg_label_shape
+
+    def forward(self, params, inputs, aux, train, key):
+        return [jax.nn.sigmoid(inputs[0])], []
+
+    def backward(self, params, out_grads, inputs, outputs):
+        scale = params.grad_scale / outputs[0].shape[0]
+        g = (outputs[0] - inputs[1].reshape(outputs[0].shape)) * scale
+        return [g, jnp.zeros_like(inputs[1])]
+
+
+class MakeLossParam(Params):
+    grad_scale = field(float, default=1.0)
+    valid_thresh = field(float, default=0.0)
+    normalization = field(str, default="null", enum=("null", "batch", "valid"))
+
+
+@register_op("MakeLoss")
+class MakeLossOp(OpDef):
+    """Turn any symbol into a loss: forward = identity, backward = grad_scale
+    (make_loss-inl.h)."""
+
+    param_cls = MakeLossParam
+    is_loss = True
+
+    def forward(self, params, inputs, aux, train, key):
+        return [inputs[0]], []
+
+    def backward(self, params, out_grads, inputs, outputs):
+        x = inputs[0]
+        scale = params.grad_scale
+        if params.normalization == "batch":
+            scale = scale / x.shape[0]
+        g = jnp.full_like(x, scale)
+        if params.normalization == "valid":
+            mask = (x > params.valid_thresh).astype(x.dtype)
+            valid = jnp.maximum(jnp.sum(mask), 1.0)
+            g = g * mask / valid
+        return [g]
+
+
+@register_op("BlockGrad", aliases=("stop_gradient",))
+class BlockGradOp(OpDef):
+    """Identity forward, zero backward (block_grad-inl.h) — stop_gradient."""
+
+    is_loss = True
+
+    def forward(self, params, inputs, aux, train, key):
+        return [jax.lax.stop_gradient(inputs[0])], []
+
+    def backward(self, params, out_grads, inputs, outputs):
+        return [jnp.zeros_like(inputs[0])]
+
+
+class SVMOutputParam(Params):
+    margin = field(float, default=1.0)
+    regularization_coefficient = field(float, default=1.0)
+    use_linear = field(bool, default=False)
+
+
+@register_op("SVMOutput")
+class SVMOutputOp(OpDef):
+    """Hinge-loss output layer (svm_output-inl.h)."""
+
+    param_cls = SVMOutputParam
+    is_loss = True
+
+    def list_arguments(self, params):
+        return ["data", "label"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        return [tuple(d), (d[0],)], [tuple(d)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        return [inputs[0]], []
+
+    def backward(self, params, out_grads, inputs, outputs):
+        x, label = inputs[0], inputs[1]
+        lab = label.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab, x.shape[1], dtype=x.dtype)
+        score_correct = jnp.sum(x * onehot, axis=1, keepdims=True)
+        margin_viol = (x - score_correct + params.margin) > 0
+        if params.use_linear:
+            g = jnp.where(margin_viol, 1.0, 0.0) * (1 - onehot)
+        else:
+            g = 2 * jnp.maximum(x - score_correct + params.margin, 0) * (1 - onehot)
+        g = g - onehot * jnp.sum(g, axis=1, keepdims=True)
+        g = g * params.regularization_coefficient
+        return [g.astype(x.dtype), jnp.zeros_like(label)]
+
+
+class IdentityAttachKLSparseRegParam(Params):
+    sparseness_target = field(float, default=0.1)
+    penalty = field(float, default=0.001)
+    momentum = field(float, default=0.9)
+
+
+@register_op("IdentityAttachKLSparseReg")
+class IdentityAttachKLSparseRegOp(OpDef):
+    """Identity with KL sparsity penalty gradient
+    (identity_attach_KL_sparse_reg-inl.h); moving average of mean
+    activation kept in an aux state."""
+
+    param_cls = IdentityAttachKLSparseRegParam
+    is_loss = False
+
+    def list_auxiliary_states(self, params):
+        return ["moving_avg"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        return list(in_shapes), [tuple(d)], [(1,)]
+
+    def forward(self, params, inputs, aux, train, key):
+        x = inputs[0]
+        avg = aux[0]
+        if train:
+            m = params.momentum
+            new_avg = m * avg + (1 - m) * jnp.mean(x).reshape(1)
+            return [x], [jax.lax.stop_gradient(new_avg)]
+        return [x], [avg]

@@ -1,0 +1,365 @@
+"""Matrix / shape-manipulation operators.
+
+Rebuild of src/operator/matrix_op{.cc,-inl.h} (dot, batch_dot, transpose,
+expand_dims, crop/slice, slice_axis, flip) plus the full-property shape
+ops Reshape/Flatten/Concat/SliceChannel/SwapAxis/Cast/Pad
+(src/operator/{reshape,concat,slice_channel,swapaxis,cast,pad}-inl.h).
+``dot`` hits the MXU directly through jnp.dot / lax.dot_general.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import np_dtype
+from ..param import Params, field, tuple_of
+from .op import OpDef, register_op, register_simple_op
+
+
+# -- dot / batch_dot ---------------------------------------------------------
+class DotParam(Params):
+    transpose_a = field(bool, default=False)
+    transpose_b = field(bool, default=False)
+
+
+def _dot_shape(params, in_shapes):
+    a, b = in_shapes
+    if a is None or b is None:
+        raise ValueError("dot: input shapes unknown")
+    am = a[::-1] if params.transpose_a else a
+    bm = b[::-1] if params.transpose_b else b
+    if len(a) == 1 and len(b) == 1:
+        return in_shapes, (1,)
+    if am[-1] != bm[0]:
+        raise ValueError(f"dot: shape mismatch {a} x {b}")
+    return in_shapes, tuple(am[:-1]) + tuple(bm[1:])
+
+
+def _dot(p, a, b):
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b).reshape(1)
+    am = a.T if p.transpose_a else a
+    bm = b.T if p.transpose_b else b
+    # Accumulate in f32 on the MXU regardless of input dtype.
+    return jnp.dot(am, bm, preferred_element_type=jnp.float32).astype(a.dtype)
+
+
+register_simple_op("dot", _dot, nin=2, param_cls=DotParam, shape_rule=_dot_shape)
+
+
+def _batch_dot_shape(params, in_shapes):
+    a, b = in_shapes
+    am = (a[0], a[2], a[1]) if params.transpose_a else a
+    bm = (b[0], b[2], b[1]) if params.transpose_b else b
+    if am[0] != bm[0] or am[2] != bm[1]:
+        raise ValueError(f"batch_dot: shape mismatch {a} x {b}")
+    return in_shapes, (am[0], am[1], bm[2])
+
+
+def _batch_dot(p, a, b):
+    am = jnp.swapaxes(a, 1, 2) if p.transpose_a else a
+    bm = jnp.swapaxes(b, 1, 2) if p.transpose_b else b
+    return jnp.einsum("bij,bjk->bik", am, bm,
+                      preferred_element_type=jnp.float32).astype(a.dtype)
+
+
+register_simple_op("batch_dot", _batch_dot, nin=2, param_cls=DotParam,
+                   shape_rule=_batch_dot_shape)
+
+
+# -- transpose / swapaxes / expand_dims / flip -------------------------------
+class TransposeParam(Params):
+    axes = field(tuple_of(int), default=None, doc="permutation; None reverses")
+
+
+def _transpose_shape(p, in_shapes):
+    s = in_shapes[0]
+    axes = p.axes if p.axes else tuple(reversed(range(len(s))))
+    return in_shapes, tuple(s[a] for a in axes)
+
+
+register_simple_op("transpose", lambda p, x: jnp.transpose(x, p.axes or None),
+                   nin=1, param_cls=TransposeParam, shape_rule=_transpose_shape)
+
+
+class SwapAxisParam(Params):
+    dim1 = field(int, default=0)
+    dim2 = field(int, default=0)
+
+
+def _swap_shape(p, in_shapes):
+    s = list(in_shapes[0])
+    s[p.dim1], s[p.dim2] = s[p.dim2], s[p.dim1]
+    return in_shapes, tuple(s)
+
+
+register_simple_op("SwapAxis", lambda p, x: jnp.swapaxes(x, p.dim1, p.dim2),
+                   nin=1, param_cls=SwapAxisParam, shape_rule=_swap_shape,
+                   aliases=("swapaxes",))
+
+
+class ExpandDimsParam(Params):
+    axis = field(int, required=True)
+
+
+register_simple_op(
+    "expand_dims", lambda p, x: jnp.expand_dims(x, p.axis), nin=1,
+    param_cls=ExpandDimsParam,
+    shape_rule=lambda p, s: (s, tuple(np.expand_dims(np.empty(s[0]), p.axis).shape)))
+
+
+class FlipParam(Params):
+    axis = field(int, required=True)
+
+
+register_simple_op("flip", lambda p, x: jnp.flip(x, p.axis), nin=1,
+                   param_cls=FlipParam, shape_rule="same")
+
+
+# -- slice_axis / crop -------------------------------------------------------
+class SliceAxisParam(Params):
+    axis = field(int, required=True)
+    begin = field(int, required=True)
+    end = field(int, default=None, doc="None means to the end")
+
+
+def _slice_axis_shape(p, in_shapes):
+    s = list(in_shapes[0])
+    ax = p.axis % len(s)
+    begin = p.begin % s[ax] if p.begin < 0 else p.begin
+    end = s[ax] if p.end is None else (p.end % s[ax] if p.end < 0 else p.end)
+    s[ax] = end - begin
+    return in_shapes, tuple(s)
+
+
+def _slice_axis(p, x):
+    ax = p.axis % x.ndim
+    begin = p.begin % x.shape[ax] if p.begin < 0 else p.begin
+    end = x.shape[ax] if p.end is None else (p.end % x.shape[ax] if p.end < 0 else p.end)
+    idx = [slice(None)] * x.ndim
+    idx[ax] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+register_simple_op("slice_axis", _slice_axis, nin=1, param_cls=SliceAxisParam,
+                   shape_rule=_slice_axis_shape)
+
+
+class SliceParam(Params):
+    begin = field(tuple_of(int), required=True)
+    end = field(tuple_of(int), required=True)
+
+
+def _slice_shape(p, in_shapes):
+    out = tuple(e - b for b, e in zip(p.begin, p.end))
+    return in_shapes, out
+
+
+register_simple_op(
+    "slice", lambda p, x: x[tuple(slice(b, e) for b, e in zip(p.begin, p.end))],
+    nin=1, param_cls=SliceParam, shape_rule=_slice_shape, aliases=("crop_like",))
+
+
+# -- Reshape / Flatten -------------------------------------------------------
+class ReshapeParam(Params):
+    shape = field(tuple_of(int), default=None,
+                  doc="target shape; 0 copies input dim, -1 infers")
+    target_shape = field(tuple_of(int), default=None, doc="legacy alias")
+
+
+def _resolve_reshape(p, in_shape):
+    tgt = list(p.shape if p.shape is not None else p.target_shape)
+    if tgt is None:
+        raise ValueError("Reshape: no target shape")
+    out = []
+    for i, d in enumerate(tgt):
+        if d == 0:
+            out.append(in_shape[i])
+        else:
+            out.append(d)
+    if -1 in out:
+        known = int(np.prod([d for d in out if d != -1])) or 1
+        total = int(np.prod(in_shape))
+        out[out.index(-1)] = total // known
+    if int(np.prod(out)) != int(np.prod(in_shape)):
+        raise ValueError(f"Reshape: cannot reshape {in_shape} to {tgt}")
+    return tuple(out)
+
+
+register_simple_op(
+    "Reshape", lambda p, x: jnp.reshape(x, _resolve_reshape(p, x.shape)), nin=1,
+    param_cls=ReshapeParam,
+    shape_rule=lambda p, s: (s, _resolve_reshape(p, s[0])), aliases=("reshape",))
+
+register_simple_op(
+    "Flatten", lambda x: jnp.reshape(x, (x.shape[0], -1)), nin=1,
+    shape_rule=lambda p, s: (s, (s[0][0], int(np.prod(s[0][1:])) if len(s[0]) > 1 else 1)),
+    aliases=("flatten",))
+
+
+# -- Cast --------------------------------------------------------------------
+class CastParam(Params):
+    dtype = field(str, required=True, doc="target dtype name")
+
+
+def _cast_dtype(p, in_dtypes):
+    return list(in_dtypes), [np_dtype(p.dtype)], []
+
+
+register_simple_op("Cast", lambda p, x: x.astype(np_dtype(p.dtype)), nin=1,
+                   param_cls=CastParam, dtype_rule=_cast_dtype, aliases=("cast",))
+
+
+# -- Concat / SliceChannel (multi-arity full ops) ----------------------------
+class ConcatParam(Params):
+    num_args = field(int, required=True, lower=1)
+    dim = field(int, default=1, doc="axis to concatenate on")
+
+
+@register_op("Concat", aliases=("concat",))
+class ConcatOp(OpDef):
+    param_cls = ConcatParam
+
+    def list_arguments(self, params):
+        return [f"arg{i}" for i in range(params.num_args)]
+
+    def infer_shape(self, params, in_shapes):
+        known = [s for s in in_shapes if s is not None]
+        if not known:
+            raise ValueError("Concat: no input shape known")
+        ref = list(known[0])
+        dim = params.dim % len(ref)
+        total = 0
+        for s in in_shapes:
+            if s is None:
+                raise ValueError("Concat: all input shapes required")
+            total += s[dim]
+        ref[dim] = total
+        return list(in_shapes), [tuple(ref)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        return [jnp.concatenate(inputs, axis=params.dim)], []
+
+
+class SliceChannelParam(Params):
+    num_outputs = field(int, required=True, lower=1)
+    axis = field(int, default=1)
+    squeeze_axis = field(bool, default=False)
+
+
+@register_op("SliceChannel", aliases=("slice_channel", "split"))
+class SliceChannelOp(OpDef):
+    param_cls = SliceChannelParam
+
+    def list_outputs(self, params):
+        return [f"output{i}" for i in range(params.num_outputs)]
+
+    def infer_shape(self, params, in_shapes):
+        s = list(in_shapes[0])
+        ax = params.axis % len(s)
+        if s[ax] % params.num_outputs:
+            raise ValueError(f"SliceChannel: dim {s[ax]} not divisible by "
+                             f"{params.num_outputs}")
+        s[ax] //= params.num_outputs
+        if params.squeeze_axis and s[ax] == 1:
+            out = tuple(d for i, d in enumerate(s) if i != ax)
+        else:
+            out = tuple(s)
+        return list(in_shapes), [out] * params.num_outputs, []
+
+    def forward(self, params, inputs, aux, train, key):
+        parts = jnp.split(inputs[0], params.num_outputs, axis=params.axis)
+        if params.squeeze_axis:
+            parts = [jnp.squeeze(p, axis=params.axis) for p in parts]
+        return list(parts), []
+
+
+# -- Pad ---------------------------------------------------------------------
+class PadParam(Params):
+    mode = field(str, default="constant", enum=("constant", "edge", "reflect"))
+    pad_width = field(tuple_of(int), required=True,
+                      doc="(before, after) per axis, flattened; NCHW 4D uses 8 ints")
+    constant_value = field(float, default=0.0)
+
+
+def _pad_shape(p, in_shapes):
+    s = in_shapes[0]
+    pw = p.pad_width
+    out = tuple(d + pw[2 * i] + pw[2 * i + 1] for i, d in enumerate(s))
+    return in_shapes, out
+
+
+def _pad(p, x):
+    pw = [(p.pad_width[2 * i], p.pad_width[2 * i + 1]) for i in range(x.ndim)]
+    if p.mode == "constant":
+        return jnp.pad(x, pw, constant_values=p.constant_value)
+    return jnp.pad(x, pw, mode=p.mode)
+
+
+register_simple_op("Pad", _pad, nin=1, param_cls=PadParam, shape_rule=_pad_shape,
+                   aliases=("pad",))
+
+
+# -- Crop (spatial center/offset crop, src/operator/crop-inl.h) --------------
+class CropParam(Params):
+    num_args = field(int, default=1)
+    offset = field(tuple_of(int), default=(0, 0))
+    h_w = field(tuple_of(int), default=(0, 0))
+    center_crop = field(bool, default=False)
+
+
+@register_op("Crop")
+class CropOp(OpDef):
+    param_cls = CropParam
+
+    def list_arguments(self, params):
+        return ["data"] if params.num_args == 1 else ["data", "crop_like"]
+
+    def _target_hw(self, params, in_shapes):
+        if params.num_args == 2:
+            return in_shapes[1][2], in_shapes[1][3]
+        return params.h_w
+
+    def infer_shape(self, params, in_shapes):
+        n, c = in_shapes[0][0], in_shapes[0][1]
+        h, w = self._target_hw(params, in_shapes)
+        return list(in_shapes), [(n, c, h, w)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        x = inputs[0]
+        if params.num_args == 2:
+            th, tw = inputs[1].shape[2], inputs[1].shape[3]
+        else:
+            th, tw = params.h_w
+        if params.center_crop:
+            oh = (x.shape[2] - th) // 2
+            ow = (x.shape[3] - tw) // 2
+        else:
+            oh, ow = params.offset
+        return [x[:, :, oh:oh + th, ow:ow + tw]], []
+
+
+# -- tile / repeat (convenience parity) --------------------------------------
+class TileParam(Params):
+    reps = field(tuple_of(int), required=True)
+
+
+register_simple_op(
+    "tile", lambda p, x: jnp.tile(x, p.reps), nin=1, param_cls=TileParam,
+    shape_rule=lambda p, s: (s, tuple(np.tile(np.empty(s[0], dtype=np.int8), p.reps).shape)))
+
+
+class OneHotParam(Params):
+    depth = field(int, required=True)
+    on_value = field(float, default=1.0)
+    off_value = field(float, default=0.0)
+
+
+register_simple_op(
+    "one_hot",
+    lambda p, x: jnp.where(
+        (jnp.arange(p.depth) == x.astype(jnp.int32)[..., None]), p.on_value, p.off_value
+    ).astype(jnp.float32),
+    nin=1, param_cls=OneHotParam,
+    shape_rule=lambda p, s: (s, tuple(s[0]) + (p.depth,)))

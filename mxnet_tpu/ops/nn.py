@@ -1,0 +1,559 @@
+"""Neural-network layer operators.
+
+Rebuild of the reference full-property operators (SURVEY.md §2.3):
+FullyConnected (fully_connected-inl.h), Convolution/Deconvolution
+(convolution-inl.h + cudnn_convolution-inl.h), Activation, LeakyReLU,
+BatchNorm (batch_norm-inl.h), Pooling, Dropout, LRN, Embedding,
+UpSampling, InstanceNorm, L2Normalization, SoftmaxActivation.
+
+TPU-native lowering notes:
+- Conv/Deconv/Pooling lower to ``lax.conv_general_dilated`` /
+  ``lax.reduce_window`` — XLA tiles these onto the MXU directly; there is
+  no im2col+gemm path nor cuDNN twin to maintain.
+- BatchNorm keeps the reference's aux-state contract (moving_mean /
+  moving_var updated during training, used in inference) via the op-level
+  ``new_aux`` return; the executor commits aux updates after the step.
+- Dropout consumes a PRNG key threaded through the executor
+  (``need_rng``), replacing the reference's per-device Random resource
+  (src/resource.cc:144-176).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..param import Params, field, tuple_of
+from .op import OpDef, register_op
+
+
+def _pair(t, n=2):
+    if t is None:
+        return (1,) * n
+    if len(t) == 1:
+        return t * n
+    return tuple(t)
+
+
+# -- FullyConnected ----------------------------------------------------------
+class FullyConnectedParam(Params):
+    num_hidden = field(int, required=True, lower=1)
+    no_bias = field(bool, default=False)
+    flatten = field(bool, default=True)
+
+
+@register_op("FullyConnected")
+class FullyConnectedOp(OpDef):
+    """y = x @ W.T + b (reference fully_connected-inl.h; weight stored
+    (num_hidden, input_dim) exactly like mshadow's dot(data, W.T))."""
+
+    param_cls = FullyConnectedParam
+
+    def list_arguments(self, params):
+        return ["data", "weight"] if params.no_bias else ["data", "weight", "bias"]
+
+    def infer_shape(self, params, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise ValueError("FullyConnected: data shape unknown")
+        in_dim = int(np.prod(data[1:]))
+        out = [data[0], params.num_hidden]
+        completed = [tuple(data), (params.num_hidden, in_dim)]
+        if not params.no_bias:
+            completed.append((params.num_hidden,))
+        return completed, [tuple(out)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        x = inputs[0]
+        w = inputs[1]
+        x2 = x.reshape(x.shape[0], -1)
+        y = jnp.dot(x2, w.T, preferred_element_type=jnp.float32).astype(x.dtype)
+        if not params.no_bias:
+            y = y + inputs[2]
+        return [y], []
+
+
+# -- Convolution -------------------------------------------------------------
+class ConvolutionParam(Params):
+    kernel = field(tuple_of(int), required=True)
+    num_filter = field(int, required=True, lower=1)
+    stride = field(tuple_of(int), default=None)
+    dilate = field(tuple_of(int), default=None)
+    pad = field(tuple_of(int), default=None)
+    num_group = field(int, default=1, lower=1)
+    no_bias = field(bool, default=False)
+    workspace = field(int, default=512, doc="ignored (XLA owns scratch)")
+    cudnn_tune = field(str, default=None, doc="ignored on TPU")
+    layout = field(str, default="NCHW", enum=("NCHW", "NHWC"))
+
+
+def _conv_out_dim(d, k, s, p, dil):
+    return (d + 2 * p - (dil * (k - 1) + 1)) // s + 1
+
+
+@register_op("Convolution")
+class ConvolutionOp(OpDef):
+    """2D convolution (reference convolution-inl.h:489).
+
+    Weight layout matches the reference: (num_filter, C/group, kH, kW).
+    Lowered to lax.conv_general_dilated with feature_group_count; XLA maps
+    it onto the MXU (no im2col materialization, no layout copies).
+    """
+
+    param_cls = ConvolutionParam
+
+    def list_arguments(self, params):
+        return ["data", "weight"] if params.no_bias else ["data", "weight", "bias"]
+
+    def infer_shape(self, params, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise ValueError("Convolution: data shape unknown")
+        n, c = data[0], data[1]
+        kh, kw = _pair(params.kernel)
+        sh, sw = _pair(params.stride)
+        ph, pw = _pair(params.pad, 2) if params.pad else (0, 0)
+        dh, dw = _pair(params.dilate)
+        oh = _conv_out_dim(data[2], kh, sh, ph, dh)
+        ow = _conv_out_dim(data[3], kw, sw, pw, dw)
+        wshape = (params.num_filter, c // params.num_group, kh, kw)
+        completed = [tuple(data), wshape]
+        if not params.no_bias:
+            completed.append((params.num_filter,))
+        return completed, [(n, params.num_filter, oh, ow)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        x, w = inputs[0], inputs[1]
+        sh, sw = _pair(params.stride)
+        ph, pw = _pair(params.pad, 2) if params.pad else (0, 0)
+        dh, dw = _pair(params.dilate)
+        y = lax.conv_general_dilated(
+            x, w,
+            window_strides=(sh, sw),
+            padding=((ph, ph), (pw, pw)),
+            rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=params.num_group,
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
+        if not params.no_bias:
+            y = y + inputs[2][None, :, None, None]
+        return [y], []
+
+
+class DeconvolutionParam(Params):
+    kernel = field(tuple_of(int), required=True)
+    num_filter = field(int, required=True, lower=1)
+    stride = field(tuple_of(int), default=None)
+    pad = field(tuple_of(int), default=None)
+    adj = field(tuple_of(int), default=(0, 0))
+    num_group = field(int, default=1)
+    no_bias = field(bool, default=True)
+    workspace = field(int, default=512)
+
+
+@register_op("Deconvolution")
+class DeconvolutionOp(OpDef):
+    """Transposed convolution (reference deconvolution-inl.h); lowered as
+    the gradient-of-conv via lhs dilation."""
+
+    param_cls = DeconvolutionParam
+
+    def list_arguments(self, params):
+        return ["data", "weight"] if params.no_bias else ["data", "weight", "bias"]
+
+    def infer_shape(self, params, in_shapes):
+        data = in_shapes[0]
+        n, c = data[0], data[1]
+        kh, kw = _pair(params.kernel)
+        sh, sw = _pair(params.stride)
+        ph, pw = _pair(params.pad, 2) if params.pad else (0, 0)
+        ah, aw = _pair(params.adj, 2)
+        oh = sh * (data[2] - 1) + kh - 2 * ph + ah
+        ow = sw * (data[3] - 1) + kw - 2 * pw + aw
+        wshape = (c, params.num_filter // params.num_group, kh, kw)
+        completed = [tuple(data), wshape]
+        if not params.no_bias:
+            completed.append((params.num_filter,))
+        return completed, [(n, params.num_filter, oh, ow)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        x, w = inputs[0], inputs[1]
+        kh, kw = _pair(params.kernel)
+        sh, sw = _pair(params.stride)
+        ph, pw = _pair(params.pad, 2) if params.pad else (0, 0)
+        y = lax.conv_general_dilated(
+            x, jnp.flip(w, (-1, -2)).swapaxes(0, 1) if params.num_group == 1 else w,
+            window_strides=(1, 1),
+            padding=((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)),
+            lhs_dilation=(sh, sw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=params.num_group,
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
+        if not params.no_bias:
+            y = y + inputs[2][None, :, None, None]
+        return [y], []
+
+
+# -- Activation --------------------------------------------------------------
+class ActivationParam(Params):
+    act_type = field(str, required=True, enum=("relu", "sigmoid", "tanh", "softrelu"))
+
+
+_ACTS = {
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softrelu": jax.nn.softplus,
+}
+
+
+@register_op("Activation")
+class ActivationOp(OpDef):
+    param_cls = ActivationParam
+
+    def forward(self, params, inputs, aux, train, key):
+        return [_ACTS[params.act_type](inputs[0])], []
+
+
+class LeakyReLUParam(Params):
+    act_type = field(str, default="leaky", enum=("leaky", "prelu", "elu", "rrelu"))
+    slope = field(float, default=0.25)
+    lower_bound = field(float, default=0.125)
+    upper_bound = field(float, default=0.334)
+
+
+@register_op("LeakyReLU")
+class LeakyReLUOp(OpDef):
+    param_cls = LeakyReLUParam
+    need_rng = True
+
+    def list_arguments(self, params):
+        return ["data", "gamma"] if params.act_type == "prelu" else ["data"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if params.act_type == "prelu":
+            return [tuple(d), (d[1],)], [tuple(d)], []
+        return list(in_shapes), [tuple(d)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        x = inputs[0]
+        t = params.act_type
+        if t == "leaky":
+            return [jnp.where(x > 0, x, params.slope * x)], []
+        if t == "elu":
+            return [jnp.where(x > 0, x, params.slope * (jnp.exp(x) - 1))], []
+        if t == "prelu":
+            g = inputs[1].reshape((1, -1) + (1,) * (x.ndim - 2))
+            return [jnp.where(x > 0, x, g * x)], []
+        # rrelu: random slope in train, mean slope in eval
+        if train and key is not None:
+            slope = jax.random.uniform(key, x.shape, x.dtype,
+                                       params.lower_bound, params.upper_bound)
+        else:
+            slope = (params.lower_bound + params.upper_bound) / 2.0
+        return [jnp.where(x > 0, x, slope * x)], []
+
+
+# -- BatchNorm ---------------------------------------------------------------
+class BatchNormParam(Params):
+    eps = field(float, default=1e-3)
+    momentum = field(float, default=0.9)
+    fix_gamma = field(bool, default=True)
+    use_global_stats = field(bool, default=False)
+
+
+@register_op("BatchNorm", aliases=("CuDNNBatchNorm",))
+class BatchNormOp(OpDef):
+    """Batch normalization over axis 1 (reference batch_norm-inl.h:314).
+
+    aux states: moving_mean, moving_var — updated with the reference's
+    momentum rule during training; used directly when ``use_global_stats``
+    or in inference mode.
+    """
+
+    param_cls = BatchNormParam
+
+    def list_arguments(self, params):
+        return ["data", "gamma", "beta"]
+
+    def list_auxiliary_states(self, params):
+        return ["moving_mean", "moving_var"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            raise ValueError("BatchNorm: data shape unknown")
+        c = (d[1],)
+        return [tuple(d), c, c], [tuple(d)], [c, c]
+
+    def forward(self, params, inputs, aux, train, key):
+        x, gamma, beta = inputs
+        moving_mean, moving_var = aux
+        if params.fix_gamma:
+            gamma = jnp.ones_like(gamma)
+        axes = (0,) + tuple(range(2, x.ndim))
+        shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+        if train and not params.use_global_stats:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            m = params.momentum
+            new_mean = (m * moving_mean + (1 - m) * mean).astype(moving_mean.dtype)
+            new_var = (m * moving_var + (1 - m) * var).astype(moving_var.dtype)
+            use_mean, use_var = mean, var
+            new_aux = [lax.stop_gradient(new_mean), lax.stop_gradient(new_var)]
+        else:
+            use_mean, use_var = moving_mean, moving_var
+            new_aux = [moving_mean, moving_var]
+        inv = lax.rsqrt(use_var.astype(jnp.float32) + params.eps)
+        y = (x.astype(jnp.float32) - use_mean.reshape(shape)) * inv.reshape(shape)
+        y = y.astype(x.dtype) * gamma.reshape(shape) + beta.reshape(shape)
+        return [y], new_aux
+
+
+class InstanceNormParam(Params):
+    eps = field(float, default=1e-3)
+
+
+@register_op("InstanceNorm")
+class InstanceNormOp(OpDef):
+    param_cls = InstanceNormParam
+
+    def list_arguments(self, params):
+        return ["data", "gamma", "beta"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        c = (d[1],)
+        return [tuple(d), c, c], [tuple(d)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        x, gamma, beta = inputs
+        axes = tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+        y = (x - mean) * lax.rsqrt(var + params.eps)
+        return [y * gamma.reshape(shape) + beta.reshape(shape)], []
+
+
+class L2NormalizationParam(Params):
+    eps = field(float, default=1e-10)
+    mode = field(str, default="instance", enum=("instance", "channel", "spatial"))
+
+
+@register_op("L2Normalization")
+class L2NormalizationOp(OpDef):
+    param_cls = L2NormalizationParam
+
+    def forward(self, params, inputs, aux, train, key):
+        x = inputs[0]
+        if params.mode == "instance":
+            axes = tuple(range(1, x.ndim))
+        elif params.mode == "channel":
+            axes = (1,)
+        else:  # spatial
+            axes = tuple(range(2, x.ndim))
+        norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + params.eps)
+        return [x / norm], []
+
+
+# -- Pooling -----------------------------------------------------------------
+class PoolingParam(Params):
+    kernel = field(tuple_of(int), required=True)
+    pool_type = field(str, default="max", enum=("max", "avg", "sum"))
+    global_pool = field(bool, default=False)
+    stride = field(tuple_of(int), default=None)
+    pad = field(tuple_of(int), default=None)
+    pooling_convention = field(str, default="valid", enum=("valid", "full"))
+
+
+@register_op("Pooling")
+class PoolingOp(OpDef):
+    """Max/avg/sum pooling via lax.reduce_window (reference pooling-inl.h).
+
+    Supports the reference's two output-size conventions: 'valid' (floor)
+    and 'full' (ceil, the legacy mshadow convention used by LeNet-era
+    models).
+    """
+
+    param_cls = PoolingParam
+
+    def _geometry(self, params, h, w):
+        kh, kw = _pair(params.kernel)
+        sh, sw = _pair(params.stride)
+        ph, pw = _pair(params.pad, 2) if params.pad else (0, 0)
+        if params.global_pool:
+            return (h, w), (1, 1), (0, 0), (1, 1)
+        rnd = np.ceil if params.pooling_convention == "full" else np.floor
+        oh = int(rnd((h + 2 * ph - kh) / sh)) + 1
+        ow = int(rnd((w + 2 * pw - kw) / sw)) + 1
+        return (kh, kw), (sh, sw), (ph, pw), (oh, ow)
+
+    def infer_shape(self, params, in_shapes):
+        n, c, h, w = in_shapes[0]
+        if params.global_pool:
+            return list(in_shapes), [(n, c, 1, 1)], []
+        _, _, _, (oh, ow) = self._geometry(params, h, w)
+        return list(in_shapes), [(n, c, oh, ow)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        x = inputs[0]
+        h, w = x.shape[2], x.shape[3]
+        (kh, kw), (sh, sw), (ph, pw), (oh, ow) = self._geometry(params, h, w)
+        # 'full' convention can need extra one-sided padding to reach (oh, ow).
+        eh = max(0, (oh - 1) * sh + kh - h - 2 * ph)
+        ew = max(0, (ow - 1) * sw + kw - w - 2 * pw)
+        pads = ((0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew))
+        if params.pool_type == "max":
+            init = -jnp.inf
+            y = lax.reduce_window(x, init, lax.max, (1, 1, kh, kw), (1, 1, sh, sw), pads)
+        else:
+            y = lax.reduce_window(x, 0.0, lax.add, (1, 1, kh, kw), (1, 1, sh, sw), pads)
+            if params.pool_type == "avg":
+                y = y / (kh * kw)
+        return [y.astype(x.dtype)], []
+
+
+# -- Dropout -----------------------------------------------------------------
+class DropoutParam(Params):
+    p = field(float, default=0.5, lower=0.0, upper=1.0)
+
+
+@register_op("Dropout")
+class DropoutOp(OpDef):
+    """Inverted dropout (reference dropout-inl.h); identity in inference."""
+
+    param_cls = DropoutParam
+    need_rng = True
+
+    def forward(self, params, inputs, aux, train, key):
+        x = inputs[0]
+        if not train or params.p <= 0.0:
+            return [x], []
+        keep = 1.0 - params.p
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)], []
+
+
+# -- LRN ---------------------------------------------------------------------
+class LRNParam(Params):
+    nsize = field(int, required=True)
+    alpha = field(float, default=1e-4)
+    beta = field(float, default=0.75)
+    knorm = field(float, default=2.0)
+
+
+@register_op("LRN")
+class LRNOp(OpDef):
+    """Local response normalization across channels (lrn-inl.h)."""
+
+    param_cls = LRNParam
+
+    def forward(self, params, inputs, aux, train, key):
+        x = inputs[0]
+        sq = jnp.square(x)
+        half = params.nsize // 2
+        pad = [(0, 0), (half, params.nsize - half - 1), (0, 0), (0, 0)]
+        acc = lax.reduce_window(jnp.pad(sq, pad), 0.0, lax.add,
+                                (1, params.nsize, 1, 1), (1, 1, 1, 1),
+                                [(0, 0)] * 4)
+        scale = (params.knorm + params.alpha * acc / params.nsize) ** (-params.beta)
+        return [x * scale], []
+
+
+# -- Embedding ---------------------------------------------------------------
+class EmbeddingParam(Params):
+    input_dim = field(int, required=True, lower=1)
+    output_dim = field(int, required=True, lower=1)
+
+
+@register_op("Embedding")
+class EmbeddingOp(OpDef):
+    """Gather forward / scatter-add backward (embedding-inl.h).
+
+    The backward comes for free from jax's gather vjp (a scatter-add),
+    which XLA lowers natively.
+    """
+
+    param_cls = EmbeddingParam
+
+    def list_arguments(self, params):
+        return ["data", "weight"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            raise ValueError("Embedding: data shape unknown")
+        w = (params.input_dim, params.output_dim)
+        return [tuple(d), w], [tuple(d) + (params.output_dim,)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        idx = inputs[0].astype(jnp.int32)
+        return [jnp.take(inputs[1], idx, axis=0)], []
+
+
+# -- UpSampling --------------------------------------------------------------
+class UpSamplingParam(Params):
+    scale = field(int, required=True, lower=1)
+    sample_type = field(str, default="nearest", enum=("nearest", "bilinear"))
+    num_args = field(int, default=1)
+    num_filter = field(int, default=0)
+
+
+@register_op("UpSampling")
+class UpSamplingOp(OpDef):
+    param_cls = UpSamplingParam
+
+    def list_arguments(self, params):
+        if params.sample_type == "bilinear":
+            return ["data", "weight"]
+        return [f"arg{i}" for i in range(params.num_args)] if params.num_args > 1 else ["data"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        oh, ow = d[2] * params.scale, d[3] * params.scale
+        c = sum(s[1] for s in in_shapes if s is not None) if params.num_args > 1 else d[1]
+        completed = list(in_shapes)
+        if params.sample_type == "bilinear":
+            k = 2 * params.scale - params.scale % 2
+            completed = [tuple(d), (d[1], 1, k, k)]
+        return completed, [(d[0], c, oh, ow)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        s = params.scale
+        outs = []
+        for x in (inputs if params.sample_type == "nearest" and params.num_args > 1
+                  else inputs[:1]):
+            if params.sample_type == "nearest":
+                y = jnp.repeat(jnp.repeat(x, s, axis=2), s, axis=3)
+            else:
+                n, c, h, w = x.shape
+                y = jax.image.resize(x, (n, c, h * s, w * s), method="bilinear")
+            outs.append(y)
+        if len(outs) > 1:
+            # multi-input nearest mode upsamples each to the first's size and concats
+            return [jnp.concatenate(outs, axis=1)], []
+        return [outs[0]], []
+
+
+# -- SoftmaxActivation -------------------------------------------------------
+class SoftmaxActivationParam(Params):
+    mode = field(str, default="instance", enum=("instance", "channel"))
+
+
+@register_op("SoftmaxActivation")
+class SoftmaxActivationOp(OpDef):
+    param_cls = SoftmaxActivationParam
+
+    def forward(self, params, inputs, aux, train, key):
+        axis = 1 if params.mode == "channel" else -1
+        x = inputs[0]
+        if params.mode == "instance" and x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        return [jax.nn.softmax(x, axis=axis)], []

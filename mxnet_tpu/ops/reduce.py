@@ -1,0 +1,96 @@
+"""Reduction operators (src/operator/broadcast_reduce_op.cc rebuild)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..param import Params, field, tuple_of
+from .op import register_simple_op
+
+
+class ReduceAxisParam(Params):
+    axis = field(tuple_of(int), default=None, doc="axes to reduce; None = all")
+    keepdims = field(bool, default=False)
+
+
+def _reduce_shape(params, in_shapes):
+    shp = in_shapes[0]
+    if shp is None:
+        raise ValueError("reduce: input shape unknown")
+    axis = params.axis
+    if axis is None:
+        out = (1,) if params.keepdims else ()
+        return in_shapes, out if out else (1,)
+    axis = tuple(a % len(shp) for a in axis)
+    if params.keepdims:
+        out = tuple(1 if i in axis else d for i, d in enumerate(shp))
+    else:
+        out = tuple(d for i, d in enumerate(shp) if i not in axis)
+        out = out if out else (1,)
+    return in_shapes, out
+
+
+def _make_reduce(name, jfn, aliases=()):
+    def fn(p, x):
+        out = jfn(x, axis=p.axis, keepdims=p.keepdims)
+        if out.ndim == 0:
+            out = out.reshape(1)
+        return out
+
+    register_simple_op(name, fn, nin=1, param_cls=ReduceAxisParam,
+                       shape_rule=_reduce_shape, aliases=aliases)
+
+
+_make_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_make_reduce("max", jnp.max, aliases=("max_axis",))
+_make_reduce("min", jnp.min, aliases=("min_axis",))
+_make_reduce("mean", jnp.mean)
+_make_reduce("prod", jnp.prod)
+
+
+def _norm_fn(x):
+    return jnp.sqrt(jnp.sum(jnp.square(x))).reshape(1)
+
+
+register_simple_op("norm", _norm_fn, nin=1,
+                   shape_rule=lambda p, s: (s, (1,)))
+
+
+class ArgmaxParam(Params):
+    axis = field(int, default=None, doc="axis; None reduces all")
+    keepdims = field(bool, default=False)
+
+
+def _arg_shape(params, in_shapes):
+    shp = in_shapes[0]
+    if params.axis is None:
+        return in_shapes, (1,)
+    ax = params.axis % len(shp)
+    if params.keepdims:
+        return in_shapes, tuple(1 if i == ax else d for i, d in enumerate(shp))
+    out = tuple(d for i, d in enumerate(shp) if i != ax)
+    return in_shapes, out if out else (1,)
+
+
+def _make_arg(name, jfn):
+    def fn(p, x):
+        out = jfn(x, axis=p.axis, keepdims=p.keepdims).astype(x.dtype)
+        if out.ndim == 0:
+            out = out.reshape(1)
+        return out
+
+    register_simple_op(name, fn, nin=1, param_cls=ArgmaxParam, shape_rule=_arg_shape)
+
+
+_make_arg("argmax", jnp.argmax)
+_make_arg("argmin", jnp.argmin)
+
+
+def _argmax_channel(x):
+    """argmax over axis 1 (reference argmax_channel, broadcast_reduce_op)."""
+    return jnp.argmax(x, axis=1).astype(x.dtype)
+
+
+register_simple_op("argmax_channel", _argmax_channel, nin=1,
+                   shape_rule=lambda p, s: (s, (s[0][0],) + tuple(s[0][2:])))

@@ -1,0 +1,47 @@
+"""Random sampling operators (src/operator/sample_op.cc rebuild).
+
+Samplers consume PRNG keys threaded through the executor / the global
+imperative key (mxnet_tpu.random), replacing the reference's per-device
+mshadow::Random resource (src/resource.cc:144-176).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..param import Params, field, tuple_of
+from .op import register_simple_op
+
+
+class UniformParam(Params):
+    low = field(float, default=0.0)
+    high = field(float, default=1.0)
+    shape = field(tuple_of(int), default=None)
+
+
+class NormalParam(Params):
+    loc = field(float, default=0.0)
+    scale = field(float, default=1.0)
+    shape = field(tuple_of(int), default=None)
+
+
+def _sample_shape(p, in_shapes):
+    if p.shape is None:
+        raise ValueError("sample op: shape required")
+    return in_shapes, tuple(p.shape)
+
+
+def _uniform(p, key=None):
+    return jax.random.uniform(key, p.shape, minval=p.low, maxval=p.high)
+
+
+def _normal(p, key=None):
+    return p.loc + p.scale * jax.random.normal(key, p.shape)
+
+
+register_simple_op("_sample_uniform", _uniform, nin=0, param_cls=UniformParam,
+                   shape_rule=_sample_shape, need_rng=True,
+                   aliases=("uniform", "_random_uniform"))
+register_simple_op("_sample_normal", _normal, nin=0, param_cls=NormalParam,
+                   shape_rule=_sample_shape, need_rng=True,
+                   aliases=("normal", "_random_normal"))

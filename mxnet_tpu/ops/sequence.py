@@ -1,0 +1,99 @@
+"""Sequence operators with per-example lengths.
+
+Rebuild of src/operator/sequence_{last,mask,reverse}-inl.h (+
+sequence_op_common.h).  Layout convention matches the reference:
+time-major (T, N, ...) with an optional (N,) length vector.
+Implemented with vectorized masks/gathers — no scalar loops, so XLA
+keeps everything on-device with static shapes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..param import Params, field
+from .op import OpDef, register_op
+
+
+class SequenceParam(Params):
+    use_sequence_length = field(bool, default=False)
+
+
+class SequenceMaskParam(SequenceParam):
+    value = field(float, default=0.0)
+
+
+def _seq_args(params):
+    return ["data", "sequence_length"] if params.use_sequence_length else ["data"]
+
+
+@register_op("SequenceLast")
+class SequenceLastOp(OpDef):
+    param_cls = SequenceParam
+
+    def list_arguments(self, params):
+        return _seq_args(params)
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        completed = [tuple(d)] + ([(d[1],)] if params.use_sequence_length else [])
+        return completed, [tuple(d[1:])], []
+
+    def forward(self, params, inputs, aux, train, key):
+        x = inputs[0]
+        if params.use_sequence_length:
+            idx = (inputs[1].astype(jnp.int32) - 1).clip(0, x.shape[0] - 1)
+            out = jnp.take_along_axis(
+                x, idx.reshape((1, -1) + (1,) * (x.ndim - 2)), axis=0
+            )[0]
+        else:
+            out = x[-1]
+        return [out], []
+
+
+@register_op("SequenceMask")
+class SequenceMaskOp(OpDef):
+    param_cls = SequenceMaskParam
+
+    def list_arguments(self, params):
+        return _seq_args(params)
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        completed = [tuple(d)] + ([(d[1],)] if params.use_sequence_length else [])
+        return completed, [tuple(d)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        x = inputs[0]
+        if not params.use_sequence_length:
+            return [x], []
+        steps = jnp.arange(x.shape[0]).reshape((-1, 1))
+        mask = steps < inputs[1].astype(jnp.int32).reshape((1, -1))
+        mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+        return [jnp.where(mask, x, params.value).astype(x.dtype)], []
+
+
+@register_op("SequenceReverse")
+class SequenceReverseOp(OpDef):
+    param_cls = SequenceParam
+
+    def list_arguments(self, params):
+        return _seq_args(params)
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        completed = [tuple(d)] + ([(d[1],)] if params.use_sequence_length else [])
+        return completed, [tuple(d)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        x = inputs[0]
+        if not params.use_sequence_length:
+            return [jnp.flip(x, axis=0)], []
+        T = x.shape[0]
+        lengths = inputs[1].astype(jnp.int32).reshape((1, -1))
+        steps = jnp.arange(T).reshape((-1, 1))
+        # index of source row: reverse within [0, len), identity beyond
+        src = jnp.where(steps < lengths, lengths - 1 - steps, steps)
+        out = jnp.take_along_axis(x, src.reshape(src.shape + (1,) * (x.ndim - 2)),
+                                  axis=0)
+        return [out], []

@@ -1,0 +1,47 @@
+"""Global PRNG state for imperative sampling.
+
+Rebuild of python/mxnet/random.py (seed + samplers).  The reference keeps
+per-device mshadow::Random resources seeded via ``MXRandomSeed``; here a
+single functional JAX key chain is split per imperative call, and
+executors fork their own keys at bind time (deterministic given the seed).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "uniform", "normal"]
+
+_state = threading.local()
+
+
+def _get_key():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+    return _state.key
+
+
+def seed(seed_state: int):
+    """Seed the global random number chain (parity: mx.random.seed)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split and return a fresh key from the global chain."""
+    key, sub = jax.random.split(_get_key())
+    _state.key = key
+    return sub
+
+
+def uniform(low=0, high=1, shape=None, ctx=None, out=None):
+    from . import ndarray as nd
+
+    return nd._sample_uniform(low=low, high=high, shape=shape or (1,), ctx=ctx, out=out)
+
+
+def normal(loc=0, scale=1, shape=None, ctx=None, out=None):
+    from . import ndarray as nd
+
+    return nd._sample_normal(loc=loc, scale=scale, shape=shape or (1,), ctx=ctx, out=out)

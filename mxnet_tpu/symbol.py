@@ -1,0 +1,553 @@
+"""Symbolic graph construction.
+
+Rebuild of the reference Symbol layer (include/mxnet/symbolic.h:40-317,
+src/symbol/symbol.cc, static_graph.cc; Python frontend
+python/mxnet/symbol.py).  A Symbol is a list of heads over shared
+``Node``s; composition auto-creates variable nodes for unbound op
+arguments and auxiliary states (reference Compose semantics).  Graph JSON
+save/load keeps the reference's two-artifact checkpoint contract
+(symbol JSON + named param blob, SURVEY.md §5).
+
+Op-creating functions (``mx.sym.Convolution`` etc.) are generated from the
+op registry at import time, mirroring python/mxnet/symbol.py:999-1120.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+
+import numpy as np
+
+from .base import MXNetError, dtype_name, np_dtype
+from .ops import OP_REGISTRY
+
+__all__ = ["Symbol", "Variable", "Group", "load", "load_json", "AttrScope", "NameManager"]
+
+
+class AttrScope:
+    """Attribute scope propagated onto created symbols
+    (python/mxnet/attribute.py; carries ctx_group / force_mirroring /
+    lr_mult-style attrs)."""
+
+    _current = threading.local()
+
+    def __init__(self, **attrs):
+        self._attrs = {k: str(v) for k, v in attrs.items()}
+        self._old = None
+
+    @classmethod
+    def current_attrs(cls) -> dict:
+        cur = getattr(cls._current, "value", None)
+        return dict(cur._attrs) if cur is not None else {}
+
+    def __enter__(self):
+        self._old = getattr(AttrScope._current, "value", None)
+        merged = dict(self._old._attrs) if self._old else {}
+        merged.update(self._attrs)
+        self._merged_attrs = self._attrs
+        self._attrs = merged
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        self._attrs = self._merged_attrs
+        AttrScope._current.value = self._old
+        return False
+
+
+class NameManager:
+    """Automatic unique naming (python/mxnet/name.py)."""
+
+    _current = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+
+    @classmethod
+    def get(cls):
+        if getattr(cls._current, "value", None) is None:
+            cls._current.value = NameManager()
+        return cls._current.value
+
+    def next_name(self, hint: str) -> str:
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return f"{hint}{idx}"
+
+
+class Node:
+    """One graph node: an op application or a variable (symbolic.h Node)."""
+
+    __slots__ = ("op", "name", "attrs", "params", "inputs", "_id")
+
+    def __init__(self, op, name, attrs=None, params=None, inputs=None):
+        self.op = op  # OpDef or None for variables
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.params = params
+        self.inputs = list(inputs or [])  # [(Node, out_index)]
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    def num_outputs(self):
+        return 1 if self.is_variable else self.op.num_outputs(self.params)
+
+    def __repr__(self):
+        kind = "var" if self.is_variable else self.op.name
+        return f"<Node {kind}:{self.name}>"
+
+
+def _topo_order(head_nodes):
+    """Post-order DFS over unique nodes (static_graph.cc topo sort)."""
+    seen = set()
+    order = []
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for (src, _) in node.inputs:
+            visit(src)
+        order.append(node)
+
+    for n in head_nodes:
+        visit(n)
+    return order
+
+
+class Symbol:
+    """A list of output heads over a shared node graph."""
+
+    __slots__ = ("_heads",)
+
+    def __init__(self, heads):
+        self._heads = list(heads)  # [(Node, out_index)]
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def name(self):
+        if len(self._heads) == 1:
+            return self._heads[0][0].name
+        return None
+
+    def _topo(self):
+        return _topo_order([n for n, _ in self._heads])
+
+    def list_arguments(self):
+        """Names of argument variables in topo order (symbolic.h:132).
+
+        Auxiliary-state variables are excluded (they have the node attr
+        ``__aux__``)."""
+        return [n.name for n in self._topo()
+                if n.is_variable and "__aux__" not in n.attrs]
+
+    def list_outputs(self):
+        out = []
+        for node, idx in self._heads:
+            if node.is_variable:
+                out.append(node.name)
+            else:
+                out.append(f"{node.name}_{node.op.list_outputs(node.params)[idx]}")
+        return out
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._topo()
+                if n.is_variable and "__aux__" in n.attrs]
+
+    def get_internals(self) -> "Symbol":
+        """Symbol exposing every internal output (symbolic.h GetInternals)."""
+        heads = []
+        for node in self._topo():
+            for i in range(node.num_outputs()):
+                heads.append((node, i))
+        return Symbol(heads)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise ValueError(f"no output named {index!r}; outputs: {names}")
+            index = names.index(index)
+        return Symbol([self._heads[index]])
+
+    def __len__(self):
+        return len(self._heads)
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self._heads)))
+
+    # -- attributes --------------------------------------------------------
+    def attr(self, key):
+        if len(self._heads) == 1:
+            return self._heads[0][0].attrs.get(key)
+        return None
+
+    def _set_attr(self, **kwargs):
+        for node, _ in self._heads:
+            node.attrs.update({k: str(v) for k, v in kwargs.items()})
+
+    def attr_dict(self):
+        out = {}
+        for node in self._topo():
+            if node.attrs:
+                out[node.name] = dict(node.attrs)
+        return out
+
+    # -- composition -------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        """Compose: bind this symbol's free variables to new inputs
+        (symbolic.h Compose).  Returns a new Symbol; the graph is copied so
+        the original stays reusable."""
+        name = kwargs.pop("name", None)
+        mapping = {}
+        arg_names = self.list_arguments()
+        if args:
+            if kwargs:
+                raise MXNetError("compose accepts positional or keyword args, not both")
+            if len(args) > len(arg_names):
+                raise MXNetError("too many positional arguments")
+            for argname, sym in zip(arg_names, args):
+                mapping[argname] = sym
+        for k, v in kwargs.items():
+            if k not in arg_names:
+                raise MXNetError(f"unknown argument {k!r}; args: {arg_names}")
+            mapping[k] = v
+        copies = {}
+
+        def copy_node(node):
+            if id(node) in copies:
+                return copies[id(node)]
+            if node.is_variable and node.name in mapping:
+                head_node, head_idx = mapping[node.name]._heads[0]
+                if head_idx != 0:
+                    # splice a pass-through of that output via _copy
+                    new = Node(OP_REGISTRY.get("_copy"), node.name, {},
+                               None, [(head_node, head_idx)])
+                else:
+                    new = head_node
+            else:
+                new = Node(node.op, node.name, node.attrs, node.params,
+                           [(copy_node(s), i) for s, i in node.inputs])
+            copies[id(node)] = new
+            return new
+
+        return Symbol([(copy_node(n), i) for n, i in self._heads])
+
+    # -- shape / dtype inference -------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        return self._infer_shape_impl(False, *args, **kwargs)
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for name, shp in zip(arg_names, args):
+                if shp is not None:
+                    known[name] = tuple(shp)
+        for k, v in kwargs.items():
+            if k not in arg_names and k not in self.list_auxiliary_states():
+                raise MXNetError(f"infer_shape: unknown argument {k!r}")
+            known[k] = tuple(v)
+        shapes = _infer_graph(self._topo(), known, "shape", partial)
+        if shapes is None:
+            return None, None, None
+        arg_shapes = [shapes["var", n] for n in arg_names]
+        aux_shapes = [shapes["var", n] for n in self.list_auxiliary_states()]
+        out_shapes = [shapes["out", id(n), i] for n, i in self._heads]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for name, dt in zip(arg_names, args):
+                if dt is not None:
+                    known[name] = np_dtype(dt)
+        for k, v in kwargs.items():
+            known[k] = np_dtype(v)
+        types = _infer_graph(self._topo(), known, "dtype", False)
+        if types is None:
+            return None, None, None
+        arg_types = [types["var", n] for n in arg_names]
+        aux_types = [types["var", n] for n in self.list_auxiliary_states()]
+        out_types = [types["out", id(n), i] for n, i in self._heads]
+        return arg_types, out_types, aux_types
+
+    # -- serialization (static_graph.cc:601-616 JSON contract) --------------
+    def tojson(self) -> str:
+        nodes = self._topo()
+        node_ids = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        for n in nodes:
+            entry = {
+                "op": "null" if n.is_variable else n.op.name,
+                "name": n.name,
+                "inputs": [[node_ids[id(s)], i] for s, i in n.inputs],
+            }
+            if n.attrs:
+                entry["attr"] = dict(n.attrs)
+            if n.params is not None:
+                entry["param"] = n.params.to_dict()
+            out_nodes.append(entry)
+        graph = {
+            "nodes": out_nodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.is_variable],
+            "heads": [[node_ids[id(n)], idx] for n, idx in self._heads],
+            "attrs": {"mxnet_tpu_version": 1},
+        }
+        return json.dumps(graph, indent=2)
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- binding (executor factory; implemented in executor.py) -------------
+    def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None):
+        from .executor import Executor
+
+        return Executor._bind(self, ctx, args, args_grad, grad_req, aux_states,
+                              group2ctx=group2ctx, shared_exec=shared_exec)
+
+    def simple_bind(self, ctx, grad_req="write", type_dict=None, group2ctx=None,
+                    shared_exec=None, **kwargs):
+        from .executor import Executor
+
+        return Executor._simple_bind(self, ctx, grad_req, type_dict, group2ctx,
+                                     shared_exec, **kwargs)
+
+    # -- arithmetic --------------------------------------------------------
+    def __add__(self, other):
+        return _sym_ufunc(self, other, "_plus", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return _sym_ufunc(self, other, "_minus", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return _sym_ufunc(self, other, None, "_rminus_scalar")
+
+    def __mul__(self, other):
+        return _sym_ufunc(self, other, "_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return _sym_ufunc(self, other, "_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return _sym_ufunc(self, other, None, "_rdiv_scalar")
+
+    def __pow__(self, other):
+        return _sym_ufunc(self, other, "_power", "_power_scalar")
+
+    def __neg__(self):
+        return _create("negative", [self], {})
+
+    def __repr__(self):
+        name = self.name
+        return f"<Symbol {name}>" if name else f"<Symbol group of {len(self)}>"
+
+    def __copy__(self):
+        return Symbol(list(self._heads))
+
+    def debug_str(self):
+        lines = []
+        for n in self._topo():
+            kind = "Variable" if n.is_variable else n.op.name
+            ins = ", ".join(f"{s.name}[{i}]" for s, i in n.inputs)
+            lines.append(f"{kind} {n.name}({ins})")
+        return "\n".join(lines)
+
+
+def _sym_ufunc(lhs, rhs, op_name, scalar_op_name):
+    if isinstance(rhs, Symbol):
+        if op_name is None:
+            raise TypeError("operation not supported")
+        return _create(op_name, [lhs, rhs], {})
+    if isinstance(rhs, (int, float, np.generic)):
+        return _create(scalar_op_name, [lhs], {"scalar": float(rhs)})
+    raise TypeError(f"unsupported operand type {type(rhs)}")
+
+
+def _infer_graph(topo, known, what, partial):
+    """Forward inference over the graph; two passes so late-discovered
+    variable values (e.g. FC weight shapes) propagate."""
+    values = {}  # ("var", name) | ("out", node_id, idx) -> value
+    for n in topo:
+        if n.is_variable:
+            values["var", n.name] = known.get(n.name)
+    for _ in range(2):
+        progress = False
+        for node in topo:
+            if node.is_variable:
+                values["out", id(node), 0] = values["var", node.name]
+                continue
+            n_args = len(node.op.list_arguments(node.params))
+            in_vals = []
+            for src, idx in node.inputs[:n_args]:
+                v = (values.get(("var", src.name)) if src.is_variable
+                     else values.get(("out", id(src), idx)))
+                in_vals.append(v)
+            try:
+                if what == "shape":
+                    comp_in, outs, auxs = node.op.infer_shape(node.params, in_vals)
+                else:
+                    comp_in, outs, auxs = node.op.infer_dtype(node.params, in_vals)
+            except (ValueError, MXNetError):
+                if partial:
+                    for i in range(node.num_outputs()):
+                        values.setdefault(("out", id(node), i), None)
+                    continue
+                raise
+            # aux-state variables trail the argument inputs on the node
+            for (src, idx), v in zip(node.inputs[n_args:], auxs):
+                if src.is_variable and v is not None and values.get(("var", src.name)) is None:
+                    values["var", src.name] = tuple(v) if what == "shape" else v
+            # write back completed input values to variable sources
+            for (src, idx), v in zip(node.inputs[:n_args], comp_in):
+                if src.is_variable and v is not None:
+                    prev = values.get(("var", src.name))
+                    if prev is None:
+                        values["var", src.name] = tuple(v) if what == "shape" else v
+                        progress = True
+                    elif what == "shape" and tuple(prev) != tuple(v):
+                        raise MXNetError(
+                            f"inferred shape conflict for {src.name}: {prev} vs {v}")
+            for i, v in enumerate(outs):
+                values["out", id(node), i] = v
+        if not progress:
+            break
+    missing = [k for k, v in values.items() if v is None]
+    if missing and not partial:
+        names = [k[1] for k in missing if k[0] == "var"]
+        raise MXNetError(f"infer_{what}: insufficient information for {names}")
+    return values
+
+
+# -- constructors ------------------------------------------------------------
+def Variable(name, attr=None, shape=None, **kwargs) -> Symbol:
+    """Create a variable symbol (python/mxnet/symbol.py Variable)."""
+    attrs = AttrScope.current_attrs()
+    if attr:
+        attrs.update({k: str(v) for k, v in attr.items()})
+    for k, v in kwargs.items():
+        attrs["__" + k + "__"] = str(v)
+    if shape is not None:
+        attrs["__shape__"] = str(tuple(shape))
+    return Symbol([(Node(None, name, attrs), 0)])
+
+
+def Group(symbols) -> Symbol:
+    heads = []
+    for s in symbols:
+        heads.extend(s._heads)
+    return Symbol(heads)
+
+
+def load_json(json_str: str) -> Symbol:
+    graph = json.loads(json_str)
+    nodes = []
+    for entry in graph["nodes"]:
+        if entry["op"] == "null":
+            node = Node(None, entry["name"], entry.get("attr"))
+        else:
+            op = OP_REGISTRY.get(entry["op"])
+            params = op.make_params(entry.get("param", {}))
+            node = Node(op, entry["name"], entry.get("attr"), params,
+                        [(nodes[i], idx) for i, idx, *_ in entry["inputs"]])
+        nodes.append(node)
+    return Symbol([(nodes[i], idx) for i, idx in graph["heads"]])
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# -- op symbol creation ------------------------------------------------------
+def _create(op_name, sym_inputs, kwargs):
+    """Create an op node; auto-create variables for unbound args and aux
+    states (reference symbol.cc CreateFromAtomicSymbol + Compose)."""
+    op = OP_REGISTRY.get(op_name)
+    name = kwargs.pop("name", None)
+    attr = kwargs.pop("attr", None)
+    # split kwargs into symbol inputs vs op params
+    sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+    param_kwargs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
+    params = op.make_params(param_kwargs)
+    arg_names = op.list_arguments(params)
+    if name is None:
+        name = NameManager.get().next_name(op.name.lower())
+    attrs = AttrScope.current_attrs()
+    if attr:
+        attrs.update({k: str(v) for k, v in attr.items()})
+
+    bound = {}
+    if sym_inputs:
+        if len(sym_inputs) > len(arg_names):
+            raise MXNetError(f"{op_name}: too many inputs ({len(sym_inputs)} > "
+                             f"{len(arg_names)})")
+        for argname, sym in zip(arg_names, sym_inputs):
+            bound[argname] = sym
+    for k, v in sym_kwargs.items():
+        if k not in arg_names:
+            raise MXNetError(f"{op_name}: unknown input {k!r}; inputs: {arg_names}")
+        if k in bound:
+            raise MXNetError(f"{op_name}: input {k!r} bound twice")
+        bound[k] = v
+
+    inputs = []
+    for argname in arg_names:
+        if argname in bound:
+            inputs.append(bound[argname]._heads[0])
+        else:
+            var = Node(None, f"{name}_{argname}", AttrScope.current_attrs())
+            inputs.append((var, 0))
+    node = Node(op, name, attrs, params, inputs)
+    # auxiliary-state variables hang off the node for discovery
+    for aux_name in op.list_auxiliary_states(params):
+        var = Node(None, f"{name}_{aux_name}", {"__aux__": "1"})
+        node.inputs.append((var, 0))
+    return Symbol([(node, i) for i in range(op.num_outputs(params))])
+
+
+def _make_symbol_function(op_name):
+    op = OP_REGISTRY.get(op_name)
+
+    def creator(*args, **kwargs):
+        sym_inputs = []
+        for a in args:
+            if not isinstance(a, Symbol):
+                raise TypeError(f"{op_name}: positional args must be Symbols")
+            sym_inputs.append(a)
+        return _create(op_name, sym_inputs, kwargs)
+
+    creator.__name__ = op_name
+    creator.__qualname__ = op_name
+    creator.__doc__ = (
+        f"Symbolic op ``{op_name}``"
+        + (f"\n{op.param_cls.__doc__}" if op.param_cls else "")
+    )
+    return creator
+
+
+def _init_symbol_module():
+    mod = sys.modules[__name__]
+    for name in OP_REGISTRY.list():
+        fn = _make_symbol_function(name)
+        setattr(mod, name, fn)
+        canonical = OP_REGISTRY.get(name)
+        if canonical.name.lower() == name:
+            setattr(mod, canonical.name, fn)
+
+
+_init_symbol_module()

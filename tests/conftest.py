@@ -1,0 +1,12 @@
+"""Test configuration: run everything on the CPU backend with 8 virtual
+XLA host devices, so multi-device paths (multi-context executors, model
+parallelism, KVStore reduction, mesh sharding) are exercised without TPU
+hardware — the rebuild of the reference's N-CPU-contexts testing trick
+(tests/python/unittest/test_model_parallel.py, SURVEY.md §4.3)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
