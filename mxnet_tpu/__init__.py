@@ -13,7 +13,31 @@ from . import ndarray as nd
 from . import symbol
 from . import symbol as sym
 from .symbol import AttrScope, Variable, Group
+from . import attribute
 from . import executor
 from .executor import Executor
+from . import initializer
+from .initializer import init
+from . import optimizer
+from . import optimizer as opt
+from . import metric
+from . import lr_scheduler
+from . import callback
+from . import monitor
+from . import io
+from . import recordio
+from . import kvstore
+from . import kvstore as kv
+from . import model
+from .model import FeedForward
+from . import module
+from . import module as mod
+from . import visualization
+from . import visualization as viz
+from . import test_utils
+from . import operator
+from . import rtc
+from . import parallel
+from . import models
 
 __version__ = "0.1.0"

@@ -1,0 +1,9 @@
+"""Attribute scoping (parity module for python/mxnet/attribute.py).
+
+The implementation lives in mxnet_tpu.symbol; re-exported here so code
+written against the reference layout (``mx.attribute.AttrScope``) works.
+"""
+
+from .symbol import AttrScope
+
+__all__ = ["AttrScope"]

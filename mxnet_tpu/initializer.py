@@ -1,0 +1,205 @@
+"""Weight initializers (rebuild of python/mxnet/initializer.py).
+
+Name-pattern driven: an ``Initializer`` is called with (name, NDArray) and
+dispatches on the arg-name suffix (weight/bias/gamma/beta/moving_*),
+exactly like the reference's ``__call__`` (initializer.py:22-68).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .registry import Registry
+
+__all__ = ["Initializer", "Uniform", "Normal", "Orthogonal", "Xavier",
+           "MSRAPrelu", "Load", "Mixed", "One", "Zero", "Constant", "init"]
+
+_INIT_REGISTRY = Registry("initializer")
+
+
+class Initializer:
+    def __call__(self, name, arr):
+        if name.startswith("upsampling"):
+            self._init_bilinear(name, arr)
+        elif name.endswith("bias"):
+            self._init_bias(name, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(name, arr)
+        elif name.endswith("beta"):
+            self._init_beta(name, arr)
+        elif name.endswith("weight"):
+            self._init_weight(name, arr)
+        elif name.endswith("moving_mean") or name.endswith("moving_avg"):
+            self._init_zero(name, arr)
+        elif name.endswith("moving_var"):
+            self._init_one(name, arr)
+        else:
+            self._init_default(name, arr)
+
+    def _init_bilinear(self, name, arr):
+        shape = arr.shape
+        weight = np.zeros(int(np.prod(shape)), dtype=np.float32)
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight.reshape(shape)
+
+    def _init_bias(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_zero(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_default(self, name, arr):
+        raise MXNetError(
+            f"Unknown initialization pattern for {name!r}; name an initializer "
+            "pattern (weight/bias/gamma/beta) or use Mixed")
+
+
+@_INIT_REGISTRY.register("uniform")
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr[:] = np.random.uniform(-self.scale, self.scale, arr.shape)
+
+
+@_INIT_REGISTRY.register("normal")
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr[:] = np.random.normal(0, self.sigma, arr.shape)
+
+
+@_INIT_REGISTRY.register("orthogonal")
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = (self.scale * q).reshape(arr.shape)
+
+
+@_INIT_REGISTRY.register("xavier")
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = float(np.prod(shape[2:])) if len(shape) > 2 else 1.0
+        fan_in = shape[1] * hw_scale if len(shape) > 1 else shape[0]
+        fan_out = shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+                  "out": fan_out}[self.factor_type]
+        scale = np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = np.random.uniform(-scale, scale, shape)
+        else:
+            arr[:] = np.random.normal(0, scale, shape)
+
+
+@_INIT_REGISTRY.register("msraprelu")
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        super().__init__("gaussian", factor_type, 2.0 / (1 + slope**2))
+
+
+@_INIT_REGISTRY.register("zero")
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+
+    _init_default = _init_weight
+
+
+@_INIT_REGISTRY.register("one")
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 1.0
+
+    _init_default = _init_weight
+
+
+class Constant(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr[:] = self.value
+
+    _init_default = _init_weight
+
+
+class Load:
+    """Initialize from saved dict; fall back to ``default_init``
+    (initializer.py Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {k[4:] if k.startswith(("arg:", "aux:")) else k: v
+                      for k, v in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            if tuple(self.param[name].shape) != tuple(arr.shape):
+                raise MXNetError(f"shape mismatch loading {name}")
+            arr[:] = self.param[name]
+        else:
+            if self.default_init is None:
+                raise MXNetError(f"cannot init {name}: not found and no default")
+            self.default_init(name, arr)
+
+
+class Mixed:
+    """Regex-pattern routed initializers (initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns and initializers must pair up")
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for pat, ini in self.map:
+            if pat.match(name):
+                ini(name, arr)
+                return
+        raise MXNetError(f"no initializer pattern matches {name!r}; add '.*'")
+
+
+def init(name, **kwargs):
+    """Create a registered initializer by name."""
+    return _INIT_REGISTRY.get(name)(**kwargs)

@@ -1,0 +1,390 @@
+"""Data iterators.
+
+Rebuild of the reference IO stack (include/mxnet/io.h, src/io/*, python
+frontend python/mxnet/io.py): the ``DataIter`` protocol
+(BeforeFirst/Next ≙ reset/next), ``DataBatch`` with pad/index,
+``NDArrayIter`` (numpy feeding), ``ResizeIter``, ``PrefetchingIter``
+(background-thread double-buffering, the PrefetcherIter equivalent —
+iter_prefetcher.h:47-152), ``CSVIter`` and ``MNISTIter`` (idx format,
+with distributed ``part_index``/``num_parts`` sharding like
+iter_mnist.cc).  The ImageRecordIter pipeline lives in image_io.py.
+"""
+
+from __future__ import annotations
+
+import queue
+import struct
+import threading
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["DataIter", "DataBatch", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "DataDesc"]
+
+
+class DataDesc:
+    """Name+shape(+dtype+layout) of one data stream (io.py DataDesc)."""
+
+    def __init__(self, name, shape, dtype=np.float32, layout="NCHW"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.layout = layout
+
+    def __iter__(self):  # unpack like a (name, shape) tuple
+        yield self.name
+        yield self.shape
+
+    def __getitem__(self, i):
+        return (self.name, self.shape)[i]
+
+    def __repr__(self):
+        return f"DataDesc[{self.name},{self.shape},{self.dtype},{self.layout}]"
+
+
+class DataBatch:
+    """One mini-batch (reference io.py:86)."""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label if label is not None else []
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Iterator protocol (reference io.py:100): reset / next / iter, with
+    provide_data/provide_label shape advertisement."""
+
+    def __init__(self):
+        self.batch_size = 0
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(), self.getpad(),
+                             self.getindex())
+        raise StopIteration
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+    @property
+    def provide_data(self):
+        raise NotImplementedError
+
+    @property
+    def provide_label(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input data to list of (name, np.ndarray) (io.py:330-365)."""
+    if data is None:
+        if not allow_empty:
+            raise ValueError("data cannot be None")
+        return []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty and len(data) == 0:
+            raise ValueError("empty data")
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {f"_{i}_{default_name}": d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("data must be array, list or dict")
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, np.asarray(v)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory numpy/NDArray data (reference io.py:402).
+
+    Supports shuffle, discard/pad/roll_over last-batch handling.
+    """
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__()
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        if shuffle:
+            perm = np.random.permutation(self.num_data)
+            self.data = [(k, v[perm]) for k, v in self.data]
+            self.label = [(k, v[perm]) for k, v in self.label]
+        if last_batch_handle == "discard":
+            self.num_data = (self.num_data // batch_size) * batch_size
+        if self.num_data < batch_size:
+            raise MXNetError("batch_size larger than dataset size")
+        self.batch_size = batch_size
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.last_batch_handle == "roll_over" and self.cursor > self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _getdata(self, data_source):
+        if self.cursor + self.batch_size <= self.num_data:
+            return [nd.array(v[self.cursor:self.cursor + self.batch_size])
+                    for _, v in data_source]
+        # pad: wrap around
+        pad = self.batch_size - (self.num_data - self.cursor)
+        return [nd.array(np.concatenate([v[self.cursor:], v[:pad]], axis=0))
+                for _, v in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if (self.last_batch_handle == "pad"
+                and self.cursor + self.batch_size > self.num_data):
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to ``size`` batches per epoch (io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.batch_size = data_iter.batch_size
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch over one or more iterators
+    (reference io.py:236 + dmlc ThreadedIter double-buffering)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, capacity=2):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0][1][0]
+        self._queue = queue.Queue(maxsize=capacity)
+        self._epoch = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[n], s) if isinstance(s, tuple) else (r[n], s)
+                     for n, s in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[n], s) if isinstance(s, tuple) else (r[n], s)
+                     for n, s in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def _producer(self):
+        while not self._stop.is_set():
+            try:
+                batches = [i.next() for i in self.iters]
+                self._queue.put(("batch", batches))
+            except StopIteration:
+                self._queue.put(("end", None))
+                for i in self.iters:
+                    i.reset()
+
+    def reset(self):
+        # drain until epoch-end marker so next epoch starts fresh
+        while True:
+            kind, _ = self._queue.get()
+            if kind == "end":
+                break
+
+    def iter_next(self):
+        kind, batches = self._queue.get()
+        if kind == "end":
+            return False
+        data = sum([b.data for b in batches], [])
+        label = sum([b.label for b in batches], [])
+        self.current_batch = DataBatch(data, label, batches[0].pad,
+                                       batches[0].index)
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+    def __del__(self):
+        self._stop.set()
+
+
+class CSVIter(NDArrayIter):
+    """CSV-backed iterator (src/io/iter_csv.cc equivalent)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label_shape == (1,):
+                label = label.reshape(-1)
+        super().__init__(data, label, batch_size,
+                         last_batch_handle="pad" if round_batch else "discard",
+                         label_name="label", **kwargs)
+
+
+def _read_idx_file(path):
+    """Read an MNIST idx file (iter_mnist.cc format)."""
+    import gzip
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">i", f.read(4))[0]
+        ndim = magic % 256
+        dims = [struct.unpack(">i", f.read(4))[0] for _ in range(ndim)]
+        dtype = {8: np.uint8, 9: np.int8, 11: np.int16, 12: np.int32,
+                 13: np.float32, 14: np.float64}[(magic >> 8) % 256]
+        data = np.frombuffer(f.read(), dtype=dtype.newbyteorder(">"))
+        return data.reshape(dims).astype(dtype)
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST idx-format iterator (src/io/iter_mnist.cc:250) with
+    flat/shuffle/partition options including distributed sharding via
+    part_index / num_parts."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 silent=False, seed=0, part_index=0, num_parts=1,
+                 input_shape=None, **kwargs):
+        images = _read_idx_file(image).astype(np.float32) / 255.0
+        labels = _read_idx_file(label).astype(np.float32)
+        if flat:
+            images = images.reshape(images.shape[0], -1)
+        else:
+            images = images.reshape(images.shape[0], 1,
+                                    images.shape[1], images.shape[2])
+        if input_shape is not None:
+            images = images.reshape((images.shape[0],) + tuple(input_shape))
+        if num_parts > 1:
+            images = images[part_index::num_parts]
+            labels = labels[part_index::num_parts]
+        if shuffle:
+            rng = np.random.RandomState(seed)
+            perm = rng.permutation(images.shape[0])
+            images, labels = images[perm], labels[perm]
+        super().__init__(images, labels, batch_size, shuffle=False,
+                         last_batch_handle="discard", **kwargs)

@@ -1,0 +1,64 @@
+"""Learning-rate schedulers (rebuild of python/mxnet/lr_scheduler.py)."""
+
+from __future__ import annotations
+
+import logging
+
+__all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler"]
+
+
+class LRScheduler:
+    def __init__(self, base_lr=0.01):
+        self.base_lr = base_lr
+
+    def __call__(self, num_update: int) -> float:
+        raise NotImplementedError
+
+
+class FactorScheduler(LRScheduler):
+    """lr *= factor every ``step`` updates (lr_scheduler.py:36)."""
+
+    def __init__(self, step, factor=1.0, stop_factor_lr=1e-8):
+        super().__init__()
+        if step < 1:
+            raise ValueError("step must be at least 1")
+        if factor > 1.0:
+            raise ValueError("factor must be no more than 1")
+        self.step = step
+        self.factor = factor
+        self.stop_factor_lr = stop_factor_lr
+        self.count = 0
+
+    def __call__(self, num_update):
+        while num_update > self.count + self.step:
+            self.count += self.step
+            self.base_lr *= self.factor
+            if self.base_lr < self.stop_factor_lr:
+                self.base_lr = self.stop_factor_lr
+                logging.info("Update[%d]: lr reached stop factor %.5e",
+                             num_update, self.base_lr)
+            else:
+                logging.info("Update[%d]: change lr to %.5e", num_update, self.base_lr)
+        return self.base_lr
+
+
+class MultiFactorScheduler(LRScheduler):
+    """lr *= factor at given update milestones (lr_scheduler.py:85)."""
+
+    def __init__(self, step, factor=1.0):
+        super().__init__()
+        if not all(step[i] < step[i + 1] for i in range(len(step) - 1)):
+            raise ValueError("steps must be increasing")
+        if step[0] < 1:
+            raise ValueError("steps must be at least 1")
+        self.step = list(step)
+        self.cur_step_ind = 0
+        self.factor = factor
+
+    def __call__(self, num_update):
+        while (self.cur_step_ind <= len(self.step) - 1
+               and num_update > self.step[self.cur_step_ind]):
+            self.base_lr *= self.factor
+            self.cur_step_ind += 1
+            logging.info("Update[%d]: change lr to %.5e", num_update, self.base_lr)
+        return self.base_lr

@@ -1,0 +1,248 @@
+"""FeedForward estimator + shared training-loop plumbing.
+
+Rebuild of python/mxnet/model.py: ``_create_kvstore`` (model.py:39-76),
+the kvstore update paths with per-key priority (−index) for
+comm/compute overlap (model.py:87-115), two-artifact checkpointing
+(save/load_checkpoint, model.py:318-384) and the sklearn-style
+``FeedForward`` estimator (model.py:386+) built on the Module API.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from . import context as ctx_mod
+from . import io as io_mod
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .base import MXNetError
+from .initializer import Uniform
+from .kvstore import KVStore
+from .kvstore import create as _kv_create
+
+__all__ = ["FeedForward", "save_checkpoint", "load_checkpoint"]
+
+BASE_ESTIMATOR = object
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Select kvstore + update placement (reference model.py:39-76)."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = _kv_create(kvstore)
+            if kvstore == "local":
+                max_size = max(int(np.prod(p.shape)) for p in arg_params.values())
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return kv, update_on_kvstore
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
+                        update_on_kvstore):
+    for idx, param_on_devs in enumerate(param_arrays):
+        kvstore.init(idx, arg_params[param_names[idx]])
+        if update_on_kvstore:
+            kvstore.pull(idx, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
+    """push grad / pull weight per key, priority −index so layer-k comm
+    overlaps layer-(k−1) compute (reference model.py:87-97)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        kvstore.push(index, grad_list, priority=-index)
+        kvstore.pull(index, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None):
+    """Aggregate grads (optionally via kvstore) then run the local updater
+    per device copy (reference model.py:98-115)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        if kvstore:
+            kvstore.push(index, grad_list, priority=-index)
+            kvstore.pull(index, grad_list, priority=-index)
+        else:
+            # sum gradients in place of kvstore local-reduce
+            if len(grad_list) > 1:
+                total = grad_list[0].copyto(grad_list[0].context)
+                for g in grad_list[1:]:
+                    total += g.as_in_context(total.context)
+                for g in grad_list:
+                    g[:] = total
+        for k, (w, g) in enumerate(zip(arg_list, grad_list)):
+            updater(index * num_device + k, g, w)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Two-artifact checkpoint: ``prefix-symbol.json`` +
+    ``prefix-####.params`` (reference model.py:318-347)."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    param_name = f"{prefix}-{epoch:04d}.params"
+    nd.save(param_name, save_dict)
+    logging.info('Saved checkpoint to "%s"', param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    """Load (symbol, arg_params, aux_params) (reference model.py:350-384)."""
+    symbol = sym_mod.load(f"{prefix}-symbol.json")
+    save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params
+
+
+class FeedForward(BASE_ESTIMATOR):
+    """sklearn-style estimator (reference model.py:386 FeedForward).
+
+    Implemented over the Module API (the reference's own successor path);
+    keeps fit/predict/score/save/load and ctor surface.
+    """
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=Uniform(0.01), numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        self.symbol = symbol
+        if ctx is None:
+            ctx = [ctx_mod.current_context()]
+        elif isinstance(ctx, ctx_mod.Context):
+            ctx = [ctx]
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    # -- helpers -----------------------------------------------------------
+    def _init_iter(self, X, y, is_train):
+        if isinstance(X, io_mod.DataIter):
+            return X
+        X = np.asarray(X)
+        if y is not None:
+            y = np.asarray(y)
+        batch_size = min(self.numpy_batch_size, X.shape[0])
+        if is_train:
+            if y is None:
+                raise ValueError("y is required for training")
+            return io_mod.NDArrayIter(X, y, batch_size, shuffle=True,
+                                      last_batch_handle="roll_over")
+        return io_mod.NDArrayIter(X, y, batch_size, shuffle=False)
+
+    def _get_module(self, data):
+        from .module import Module
+
+        data_names = [d[0] for d in data.provide_data]
+        label_names = [l[0] for l in data.provide_label]
+        return Module(self.symbol, data_names=data_names,
+                      label_names=label_names, context=self.ctx)
+
+    # -- public API --------------------------------------------------------
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        data = self._init_iter(X, y, is_train=True)
+        if eval_data is not None and not isinstance(eval_data, io_mod.DataIter):
+            ex, ey = eval_data
+            eval_data = self._init_iter(np.asarray(ex), np.asarray(ey), False)
+        self._module = self._get_module(data)
+        opt_params = dict(self.kwargs)
+        self._module.fit(data, eval_data=eval_data, eval_metric=eval_metric,
+                         epoch_end_callback=epoch_end_callback,
+                         batch_end_callback=batch_end_callback,
+                         kvstore=kvstore, optimizer=self.optimizer,
+                         optimizer_params=opt_params,
+                         eval_end_callback=eval_end_callback,
+                         eval_batch_end_callback=eval_batch_end_callback,
+                         initializer=self.initializer,
+                         arg_params=self.arg_params, aux_params=self.aux_params,
+                         allow_missing=True, begin_epoch=self.begin_epoch,
+                         num_epoch=self.num_epoch, monitor=monitor)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        data = self._init_iter(X, None, is_train=False)
+        if self._module is None or not self._module.binded:
+            self._module = self._get_module(data)
+            self._module.bind(data.provide_data, data.provide_label,
+                              for_training=False)
+            self._module.set_params(self.arg_params, self.aux_params or {})
+        out = self._module.predict(data, num_batch=num_batch, reset=reset)
+        if isinstance(out, list):
+            return [o.asnumpy() for o in out]
+        return out.asnumpy()
+
+    def score(self, X, y=None, eval_metric="acc", num_batch=None, reset=True):
+        data = self._init_iter(X, y, is_train=False)
+        if self._module is None or not self._module.binded:
+            self._module = self._get_module(data)
+            self._module.bind(data.provide_data, data.provide_label,
+                              for_training=False)
+            self._module.set_params(self.arg_params, self.aux_params or {})
+        res = self._module.score(data, eval_metric, num_batch=num_batch,
+                                 reset=reset)
+        return res[0][1]
+
+    def save(self, prefix, epoch=None):
+        if epoch is None:
+            epoch = self.num_epoch
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=Uniform(0.01), eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model

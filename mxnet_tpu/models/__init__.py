@@ -1,0 +1,10 @@
+"""Model zoo: symbols for the BASELINE.json workloads
+(reference example/image-classification/symbol_*.py, example/rnn)."""
+
+from .lenet import get_symbol as lenet
+from .mlp import get_symbol as mlp
+from .resnet import get_symbol as resnet
+from .lstm import lstm_unroll, lstm_cell, LSTMState, LSTMParam
+
+__all__ = ["lenet", "mlp", "resnet", "lstm_unroll", "lstm_cell",
+           "LSTMState", "LSTMParam"]

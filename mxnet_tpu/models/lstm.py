@@ -1,0 +1,89 @@
+"""Explicitly-unrolled LSTM language model
+(reference example/rnn/lstm.py:17-40 lstm cell, lstm_unroll).
+
+This is the bucketing-LM symbol (BASELINE config 3's explicit-unroll
+variant); the fused scan-based RNN op covers the cuDNN-RNN path.
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+
+from .. import symbol as mx_sym
+
+LSTMState = namedtuple("LSTMState", ["c", "h"])
+LSTMParam = namedtuple("LSTMParam", ["i2h_weight", "i2h_bias",
+                                     "h2h_weight", "h2h_bias"])
+
+
+def lstm_cell(num_hidden, indata, prev_state, param, seqidx, layeridx,
+              dropout=0.0):
+    """One LSTM step (reference example/rnn/lstm.py:17-40)."""
+    if dropout > 0.0:
+        indata = mx_sym.Dropout(indata, p=dropout)
+    i2h = mx_sym.FullyConnected(indata, weight=param.i2h_weight,
+                                bias=param.i2h_bias, num_hidden=num_hidden * 4,
+                                name=f"t{seqidx}_l{layeridx}_i2h")
+    h2h = mx_sym.FullyConnected(prev_state.h, weight=param.h2h_weight,
+                                bias=param.h2h_bias, num_hidden=num_hidden * 4,
+                                name=f"t{seqidx}_l{layeridx}_h2h")
+    gates = i2h + h2h
+    slice_gates = mx_sym.SliceChannel(gates, num_outputs=4,
+                                      name=f"t{seqidx}_l{layeridx}_slice")
+    in_gate = mx_sym.Activation(slice_gates[0], act_type="sigmoid")
+    in_transform = mx_sym.Activation(slice_gates[1], act_type="tanh")
+    forget_gate = mx_sym.Activation(slice_gates[2], act_type="sigmoid")
+    out_gate = mx_sym.Activation(slice_gates[3], act_type="sigmoid")
+    next_c = (forget_gate * prev_state.c) + (in_gate * in_transform)
+    next_h = out_gate * mx_sym.Activation(next_c, act_type="tanh")
+    return LSTMState(c=next_c, h=next_h)
+
+
+def lstm_unroll(num_lstm_layer, seq_len, input_size, num_hidden, num_embed,
+                num_label, dropout=0.0):
+    """Unrolled LSTM LM over a padded sequence
+    (reference example/rnn/lstm.py lstm_unroll)."""
+    embed_weight = mx_sym.Variable("embed_weight")
+    cls_weight = mx_sym.Variable("cls_weight")
+    cls_bias = mx_sym.Variable("cls_bias")
+    param_cells = []
+    last_states = []
+    for i in range(num_lstm_layer):
+        param_cells.append(LSTMParam(
+            i2h_weight=mx_sym.Variable(f"l{i}_i2h_weight"),
+            i2h_bias=mx_sym.Variable(f"l{i}_i2h_bias"),
+            h2h_weight=mx_sym.Variable(f"l{i}_h2h_weight"),
+            h2h_bias=mx_sym.Variable(f"l{i}_h2h_bias")))
+        last_states.append(LSTMState(
+            c=mx_sym.Variable(f"l{i}_init_c"),
+            h=mx_sym.Variable(f"l{i}_init_h")))
+
+    data = mx_sym.Variable("data")
+    label = mx_sym.Variable("softmax_label")
+    embed = mx_sym.Embedding(data, weight=embed_weight, input_dim=input_size,
+                             output_dim=num_embed, name="embed")
+    wordvec = mx_sym.SliceChannel(embed, num_outputs=seq_len, axis=1,
+                                  squeeze_axis=True)
+
+    hidden_all = []
+    for seqidx in range(seq_len):
+        hidden = wordvec[seqidx]
+        for i in range(num_lstm_layer):
+            next_state = lstm_cell(num_hidden, indata=hidden,
+                                   prev_state=last_states[i],
+                                   param=param_cells[i], seqidx=seqidx,
+                                   layeridx=i,
+                                   dropout=dropout if i > 0 else 0.0)
+            hidden = next_state.h
+            last_states[i] = next_state
+        if dropout > 0.0:
+            hidden = mx_sym.Dropout(hidden, p=dropout)
+        hidden_all.append(hidden)
+
+    hidden_concat = mx_sym.Concat(*hidden_all, num_args=len(hidden_all), dim=0)
+    pred = mx_sym.FullyConnected(hidden_concat, weight=cls_weight,
+                                 bias=cls_bias, num_hidden=num_label,
+                                 name="pred")
+    label_t = mx_sym.transpose(label)
+    label_flat = mx_sym.Reshape(label_t, shape=(-1,))
+    return mx_sym.SoftmaxOutput(pred, label_flat, name="softmax")

@@ -1,0 +1,199 @@
+"""BucketingModule: variable-length training via per-bucket executors.
+
+Rebuild of python/mxnet/module/bucketing_module.py.  ``sym_gen(bucket_key)``
+returns (symbol, data_names, label_names); one Module per bucket key is
+bound lazily and parameters are shared across buckets
+(``switch_bucket``, reference bucketing_module.py:195-220).  Where the
+reference shares a GraphStoragePool across bucket executors
+(graph_executor.h:50-56), here XLA compiles one program per bucket shape
+and JAX's compilation cache plays the shared-pool role; padded-shape
+buckets bound the number of recompiles (SURVEY.md §5 long-context).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from ..initializer import Uniform
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None):
+        super().__init__(logger=logger)
+        if default_bucket_key is None:
+            raise ValueError("default_bucket_key must be set")
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._work_load_list = work_load_list
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    @property
+    def data_names(self):
+        if self.binded:
+            return self._curr_module.data_names
+        sym, data_names, _ = self._call_sym_gen(self._default_bucket_key)
+        return data_names
+
+    @property
+    def output_names(self):
+        if self.binded:
+            return self._curr_module.output_names
+        sym, _, _ = self._call_sym_gen(self._default_bucket_key)
+        return sym.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._curr_module.data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._curr_module.label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._curr_module.output_shapes
+
+    def _call_sym_gen(self, bucket_key):
+        return self._sym_gen(bucket_key)
+
+    # -- bind / switch -----------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if force_rebind:
+            self._buckets = {}
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already binded, ignoring bind()")
+            return
+        if shared_module is not None:
+            raise MXNetError("shared_module not supported for BucketingModule")
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+        sym, data_names, label_names = self._call_sym_gen(self._default_bucket_key)
+        module = Module(sym, data_names, label_names, logger=self.logger,
+                        context=self._context,
+                        work_load_list=self._work_load_list)
+        module.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
+                    force_rebind=False, shared_module=None, grad_req=grad_req)
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self._buckets[self._default_bucket_key] = module
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Switch to (lazily binding) the bucket's module, sharing params
+        with the default bucket (reference bucketing_module.py:195)."""
+        if not self.binded:
+            raise MXNetError("call bind before switch_bucket")
+        if bucket_key not in self._buckets:
+            sym, data_names, label_names = self._call_sym_gen(bucket_key)
+            module = Module(sym, data_names, label_names, logger=self.logger,
+                            context=self._context,
+                            work_load_list=self._work_load_list)
+            module.bind(data_shapes, label_shapes, self._curr_module.for_training,
+                        self._curr_module.inputs_need_grad, force_rebind=False,
+                        shared_module=self._buckets[self._default_bucket_key])
+            self._buckets[bucket_key] = module
+            if self.params_initialized:
+                arg_params, aux_params = self.get_params()
+                module.init_params(arg_params=arg_params, aux_params=aux_params,
+                                   allow_missing=False, force_init=True)
+                module.optimizer_initialized = False
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    # -- params ------------------------------------------------------------
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False):
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("call bind before init_params")
+        self._curr_module.init_params(initializer=initializer,
+                                      arg_params=arg_params,
+                                      aux_params=aux_params,
+                                      allow_missing=allow_missing,
+                                      force_init=force_init)
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    # -- optimizer ---------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        self._curr_module.init_optimizer(kvstore, optimizer, optimizer_params,
+                                         force_init=force_init)
+        self._shared_optimizer_source = self._curr_module
+        self.optimizer_initialized = True
+
+    def _propagate_optimizer(self, module):
+        """Reuse the one optimizer/updater/kvstore across bucket modules so
+        update counts and state are shared."""
+        src = self._shared_optimizer_source
+        module._optimizer = src._optimizer
+        module._kvstore = src._kvstore
+        module._update_on_kvstore = src._update_on_kvstore
+        module._updater = src._updater
+        module.optimizer_initialized = True
+
+    # -- compute -----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        """Switches bucket based on data_batch.bucket_key."""
+        if data_batch.bucket_key is not None:
+            self.switch_bucket(data_batch.bucket_key,
+                               data_batch.provide_data,
+                               data_batch.provide_label)
+            if self.optimizer_initialized and not self._curr_module.optimizer_initialized:
+                self._propagate_optimizer(self._curr_module)
+            # keep current params flowing into the switched bucket
+            if self.params_initialized:
+                src = self._buckets[self._default_bucket_key]
+                if self._curr_module is not src and src._params_dirty:
+                    pass
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+        # params now live in curr module's executors; propagate master copy
+        arg_params, aux_params = self._curr_module.get_params()
+        for key, module in self._buckets.items():
+            if module is not self._curr_module and module.params_initialized:
+                module.set_params(arg_params, aux_params)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._curr_module.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        for module in self._buckets.values():
+            module.install_monitor(mon)

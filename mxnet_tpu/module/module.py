@@ -1,0 +1,265 @@
+"""Module: single-symbol data-parallel training module.
+
+Rebuild of python/mxnet/module/module.py: owns a DataParallelExecutorGroup
+over a list of contexts, CPU-resident master params, and the
+kvstore-mediated update paths (``_update_params_on_kvstore`` /
+``_update_params``, reference model.py:87-115) with per-key priority
+hints for comm/compute overlap.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .. import context as ctx_mod
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..initializer import Uniform
+from ..kvstore import KVStore
+from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
+                     _update_params_on_kvstore, load_checkpoint, save_checkpoint)
+from .base_module import BaseModule
+from .executor_group import DataParallelExecutorGroup
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = [ctx_mod.current_context()]
+        if isinstance(context, ctx_mod.Context):
+            context = [context]
+        self._context = context
+        self._work_load_list = work_load_list or [1] * len(context)
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+        self._exec_group = None
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        return self._exec_group.data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._exec_group.label_shapes
+
+    @property
+    def output_shapes(self):
+        _, out_shapes, _ = self._symbol.infer_shape(
+            **{d.name: d.shape for d in self.data_shapes})
+        return list(zip(self._output_names, out_shapes))
+
+    # -- bind --------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if force_rebind:
+            self._exec_group = None
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already binded, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+        shared_group = shared_module._exec_group if shared_module else None
+        self._exec_group = DataParallelExecutorGroup(
+            self._symbol, self._context, self._work_load_list, data_shapes,
+            label_shapes, self._param_names, for_training, inputs_need_grad,
+            shared_group=shared_group, logger=self.logger,
+            fixed_param_names=self._fixed_param_names, grad_req=grad_req)
+        if self._arg_params is not None:
+            # params from a previous bind/init: push into new executors
+            self._exec_group.set_params(self._arg_params, self._aux_params)
+
+    # -- params ------------------------------------------------------------
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False):
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("call bind before init_params")
+        if self._arg_params is None:
+            self._arg_params = {
+                name: nd.zeros(exe_arr.shape, dtype=exe_arr.dtype)
+                for name, exe_arr in zip(
+                    self._param_names,
+                    [self._exec_group.execs[0].arg_dict[n]
+                     for n in self._param_names])}
+            self._aux_params = {
+                name: nd.zeros(exe_arr.shape, dtype=exe_arr.dtype)
+                for name, exe_arr in zip(
+                    self._aux_names,
+                    [self._exec_group.execs[0].aux_dict[n]
+                     for n in self._aux_names])}
+
+        def _impl(name, arr, cache):
+            if cache is not None and name in cache:
+                cache_arr = cache[name]
+                if cache_arr is not arr:
+                    arr[:] = cache_arr
+            elif not allow_missing and initializer is None:
+                raise MXNetError(f"{name} is not presented")
+            elif initializer is not None:
+                initializer(name, arr)
+
+        for name, arr in sorted(self._arg_params.items()):
+            _impl(name, arr, arg_params)
+        for name, arr in sorted(self._aux_params.items()):
+            _impl(name, arr, aux_params)
+
+        self.params_initialized = True
+        self._params_dirty = False
+        self._exec_group.set_params(self._arg_params, self._aux_params)
+
+    def get_params(self):
+        if not (self.binded and self.params_initialized):
+            raise MXNetError("module must be binded and initialized")
+        if self._params_dirty:
+            self._sync_params_from_devices()
+        return self._arg_params, self._aux_params
+
+    def _sync_params_from_devices(self):
+        """Device -> CPU master copy (reference module.py:472)."""
+        self._exec_group.get_params(self._arg_params, self._aux_params)
+        self._params_dirty = False
+
+    # -- optimizer ---------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if not (self.binded and self.params_initialized):
+            raise MXNetError("module must be binded and initialized")
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring")
+            return
+        (kvstore, update_on_kvstore) = _create_kvstore(
+            kvstore, len(self._context), self._arg_params)
+        if isinstance(optimizer, str):
+            batch_size = self._exec_group.batch_size
+            if kvstore and kvstore.type == "dist_sync":
+                batch_size *= kvstore.num_workers
+            idx2name = dict(enumerate(self._param_names))
+            optimizer = opt.create(
+                optimizer, rescale_grad=(1.0 / batch_size),
+                param_idx2name=idx2name, sym=self._symbol,
+                **dict(optimizer_params))
+        self._optimizer = optimizer
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+        if kvstore:
+            _initialize_kvstore(kvstore=kvstore,
+                                param_arrays=self._exec_group.param_arrays,
+                                arg_params=self._arg_params,
+                                param_names=self._param_names,
+                                update_on_kvstore=update_on_kvstore)
+        if update_on_kvstore and kvstore:
+            kvstore.set_optimizer(self._optimizer)
+        else:
+            self._updater = opt.get_updater(optimizer)
+        self.optimizer_initialized = True
+
+    # -- compute -----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if not (self.binded and self.params_initialized):
+            raise MXNetError("module must be binded and initialized")
+        self._exec_group.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        if not (self.binded and self.params_initialized):
+            raise MXNetError("module must be binded and initialized")
+        self._exec_group.backward(out_grads)
+
+    def update(self):
+        """Apply optimizer using kvstore-aggregated grads
+        (reference module.py:403 / model.py:87-115)."""
+        if not (self.binded and self.params_initialized
+                and self.optimizer_initialized):
+            raise MXNetError("module not fully initialized")
+        self._params_dirty = True
+        if self._update_on_kvstore:
+            _update_params_on_kvstore(self._exec_group.param_arrays,
+                                      self._exec_group.grad_arrays,
+                                      self._kvstore)
+        else:
+            _update_params(self._exec_group.param_arrays,
+                           self._exec_group.grad_arrays,
+                           updater=self._updater,
+                           num_device=len(self._context),
+                           kvstore=self._kvstore)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec_group.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._exec_group.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        self._exec_group.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        if not self.binded:
+            raise MXNetError("call bind first")
+        self._exec_group.install_monitor(mon)
+
+    # -- checkpoint --------------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._symbol.save(f"{prefix}-symbol.json")
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg_params, aux_params)
+        if save_optimizer_states:
+            if self._update_on_kvstore:
+                self._kvstore.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+            else:
+                import pickle
+
+                with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                    f.write(pickle.dumps(self._updater.states))
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
+        return mod

@@ -1,0 +1,68 @@
+"""Tensor-stat monitor (rebuild of python/mxnet/monitor.py).
+
+Installs a per-output callback on executors (the reference wires this via
+MXExecutorSetMonitorCallback; here the executor switches to un-fused
+eager evaluation while a monitor is installed, the analog of bulk-exec
+being disabled under monitoring, graph_executor.cc:904)."""
+
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                return abs(x).asnumpy().mean()
+
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+        def stat_helper(name, arr):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(arr)))
+
+        self.stat_helper = stat_helper
+
+    def install(self, exe):
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        self.activated = False
+        for exe in self.exes:
+            for name, array in zip(exe.arg_names, exe.arg_arrays):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name, self.stat_func(array)))
+            for name, array in zip(exe.arg_names, exe.grad_arrays):
+                if array is not None and self.re_prog.match(name):
+                    self.queue.append((self.step, name + "_grad",
+                                       self.stat_func(array)))
+        res = sorted(self.queue, key=lambda x: x[1]) if self.sort else self.queue
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        for n, k, v_list in self.toc():
+            logging.info("Batch: %7d %30s %s", n, k, str(v_list))

@@ -1,0 +1,250 @@
+"""Custom operators defined in Python.
+
+Rebuild of python/mxnet/operator.py (CustomOp/CustomOpProp + register,
+plus the legacy NumpyOp/NDArrayOp callback classes) and their C++ bridges
+(src/operator/custom-inl.h, ndarray_op-inl.h, native_op-inl.h).
+
+TPU-native mechanics: a custom op's ``forward``/``backward`` run as
+host callbacks via ``jax.pure_callback`` inside the compiled graph — the
+analog of the reference's async-safe frontend-callback operator.  The op
+declares shapes/dtypes through a ``CustomOpProp`` exactly as in the
+reference, so graph inference composes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .ops.op import OpDef, OP_REGISTRY
+from .registry import Registry
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "NumpyOp", "NDArrayOp",
+           "get_all_registered_operators"]
+
+_CUSTOM_REGISTRY = Registry("custom-op")
+
+
+class CustomOp:
+    """Base class for custom op execution (operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src if isinstance(dst, np.ndarray) else dst + src
+        elif req == "null":
+            pass
+        else:
+            raise MXNetError(f"invalid req {req!r}")
+
+
+class CustomOpProp:
+    """Metadata for a custom op (operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def create_operator(self, ctx, shapes, dtypes):
+        raise NotImplementedError
+
+
+class _CustomOpDef(OpDef):
+    """Adapter lowering a CustomOpProp into the framework op registry via
+    host callbacks."""
+
+    def __init__(self, name, prop_cls):
+        self.name = name
+        self.prop_cls = prop_cls
+        self.param_cls = None
+        self.has_backward = True
+        self.is_loss = False
+
+    def make_params(self, kwargs):
+        return self.prop_cls(**kwargs)
+
+    def list_arguments(self, prop):
+        return list(prop.list_arguments())
+
+    def list_outputs(self, prop):
+        return list(prop.list_outputs())
+
+    def list_auxiliary_states(self, prop):
+        return list(prop.list_auxiliary_states())
+
+    def infer_shape(self, prop, in_shapes):
+        ins, outs, auxs = prop.infer_shape(list(in_shapes))
+        return list(ins), [tuple(o) for o in outs], [tuple(a) for a in auxs]
+
+    def infer_dtype(self, prop, in_dtypes):
+        ins, outs, auxs = prop.infer_type(list(in_dtypes))
+        return list(ins), list(outs), list(auxs)
+
+    def _get_op(self, prop, shapes, dtypes):
+        return prop.create_operator(None, shapes, dtypes)
+
+    def forward(self, prop, inputs, aux, train, key):
+        shapes = [tuple(x.shape) for x in inputs]
+        dtypes = [np.dtype(x.dtype) for x in inputs]
+        _, out_shapes, _ = self.infer_shape(prop, shapes)
+        _, out_dtypes, _ = self.infer_dtype(prop, dtypes)
+        op = self._get_op(prop, shapes, dtypes)
+        n_out = len(out_shapes)
+
+        def host_fwd(*arrs):
+            in_data = [np.asarray(a) for a in arrs]
+            out_data = [np.zeros(s, d) for s, d in zip(out_shapes, out_dtypes)]
+            op.forward(is_train=train, req=["write"] * n_out,
+                       in_data=in_data, out_data=out_data, aux=[])
+            return tuple(out_data)
+
+        result_shapes = tuple(jax.ShapeDtypeStruct(s, d)
+                              for s, d in zip(out_shapes, out_dtypes))
+        outs = jax.pure_callback(host_fwd, result_shapes, *inputs)
+        return list(outs), list(aux)
+
+    def backward(self, prop, out_grads, inputs, outputs):
+        shapes = [tuple(x.shape) for x in inputs]
+        dtypes = [np.dtype(x.dtype) for x in inputs]
+        op = self._get_op(prop, shapes, dtypes)
+
+        def host_bwd(*arrs):
+            n_in = len(inputs)
+            n_out = len(outputs)
+            in_data = [np.asarray(a) for a in arrs[:n_in]]
+            out_data = [np.asarray(a) for a in arrs[n_in:n_in + n_out]]
+            ograds = [np.asarray(a) for a in arrs[n_in + n_out:]]
+            in_grad = [np.zeros_like(d) for d in in_data]
+            op.backward(req=["write"] * n_in, out_grad=ograds, in_data=in_data,
+                        out_data=out_data, in_grad=in_grad, aux=[])
+            return tuple(in_grad)
+
+        result_shapes = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
+                              for x in inputs)
+        grads = jax.pure_callback(host_bwd, result_shapes,
+                                  *inputs, *outputs, *out_grads)
+        return list(grads)
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under a name usable from
+    nd./sym. (reference operator.py register)."""
+
+    def do_register(prop_cls):
+        opdef = _CustomOpDef(reg_name, prop_cls)
+        OP_REGISTRY.register(reg_name, opdef)
+        _CUSTOM_REGISTRY.register(reg_name, prop_cls)
+        # refresh generated frontends
+        from . import ndarray as nd_mod
+        from . import symbol as sym_mod
+
+        setattr(nd_mod, reg_name, nd_mod._make_ndarray_function(reg_name))
+        setattr(sym_mod, reg_name, sym_mod._make_symbol_function(reg_name))
+        return prop_cls
+
+    return do_register
+
+
+def get_all_registered_operators():
+    return OP_REGISTRY.list()
+
+
+class NumpyOp:
+    """Legacy callback op over numpy buffers (reference operator.py
+    NumpyOp / _Native).  Subclass and call ``get_symbol``."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+        self._registered = None
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def forward(self, in_data, out_data):
+        raise NotImplementedError
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError
+
+    def _ensure_registered(self):
+        if self._registered:
+            return self._registered
+        legacy = self
+        name = f"_numpy_op_{type(self).__name__}_{id(self):x}"
+
+        class _Prop(CustomOpProp):
+            def __init__(self):
+                super().__init__(need_top_grad=legacy.need_top_grad_)
+
+            def list_arguments(self):
+                return legacy.list_arguments()
+
+            def list_outputs(self):
+                return legacy.list_outputs()
+
+            def infer_shape(self, in_shape):
+                ins, outs = legacy.infer_shape(in_shape)
+                return ins, outs, []
+
+            def create_operator(self, ctx, shapes, dtypes):
+                class _Op(CustomOp):
+                    def forward(self, is_train, req, in_data, out_data, aux):
+                        legacy.forward(in_data=in_data, out_data=out_data)
+
+                    def backward(self, req, out_grad, in_data, out_data,
+                                 in_grad, aux):
+                        legacy.backward(out_grad=out_grad, in_data=in_data,
+                                        out_data=out_data, in_grad=in_grad)
+
+                return _Op()
+
+        register(name)(_Prop)
+        self._registered = name
+        return name
+
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as sym_mod
+
+        name = self._ensure_registered()
+        return getattr(sym_mod, name)(*args, **kwargs)
+
+
+class NDArrayOp(NumpyOp):
+    """Legacy callback op over NDArrays (reference operator.py NDArrayOp).
+    Same bridge as NumpyOp here: callbacks receive numpy views."""

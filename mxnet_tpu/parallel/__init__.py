@@ -1,0 +1,18 @@
+"""Parallelism subsystem: mesh, collectives, sharded training.
+
+First-class TPU capabilities (SURVEY.md §2.4 parallelism inventory):
+data parallel (dp), tensor parallel (tp), sequence/context parallel (sp,
+ring attention), pipeline parallel (pp) and the all-reduce bandwidth
+benchmark harness.
+"""
+
+from .mesh import Mesh, NamedSharding, PartitionSpec, make_mesh, local_mesh, \
+    replicated, shard_along
+from .collectives import allreduce, allreduce_bench, psum, all_gather, \
+    reduce_scatter, ppermute
+from .trainer import ShardedTrainer, sgd_opt, adam_opt
+
+__all__ = ["Mesh", "NamedSharding", "PartitionSpec", "make_mesh", "local_mesh",
+           "replicated", "shard_along", "allreduce", "allreduce_bench", "psum",
+           "all_gather", "reduce_scatter", "ppermute", "ShardedTrainer",
+           "sgd_opt", "adam_opt"]

@@ -1,0 +1,93 @@
+"""Collective primitives + the ICI all-reduce bandwidth benchmark.
+
+Replacement for the reference's §2.4 communication column (CommCPU tree
+reduce, CommDevice P2P all-reduce, ps-lite ZPush/ZPull): on TPU these are
+XLA collectives (psum / all_gather / reduce_scatter / ppermute) issued
+inside compiled programs over the mesh.  ``allreduce_bench`` is the port
+of tools/bandwidth/measure.py — the harness behind BASELINE.md's
+"KVStore all-reduce GB/s per device" metric.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.experimental.shard_map import shard_map
+
+__all__ = ["psum", "all_gather", "reduce_scatter", "ppermute", "allreduce",
+           "allreduce_bench"]
+
+# re-exported lax collectives (usable inside shard_map'd functions)
+psum = jax.lax.psum
+all_gather = jax.lax.all_gather
+ppermute = jax.lax.ppermute
+
+
+def reduce_scatter(x, axis_name):
+    return jax.lax.psum_scatter(x, axis_name, tiled=True)
+
+
+def allreduce(arrays, mesh: Mesh, axis_name="dp"):
+    """All-reduce a pytree of per-device-sharded arrays over one mesh axis.
+
+    Equivalent of KVStore push+pull fused: each leaf is stacked on a
+    leading device axis; result is the sum, replicated.
+    """
+    spec = PartitionSpec(axis_name)
+
+    @jax.jit
+    def _ar(xs):
+        def inner(*leaves):
+            return tuple(jax.lax.psum(l, axis_name) for l in leaves)
+
+        flat, treedef = jax.tree_util.tree_flatten(xs)
+        out = shard_map(inner, mesh=mesh, in_specs=(spec,) * len(flat),
+                        out_specs=(spec,) * len(flat))(*flat)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return _ar(arrays)
+
+
+def allreduce_bench(mesh=None, sizes_mb=(1, 4, 16, 64, 256), n_iter=10,
+                    dtype=jnp.float32, verbose=True):
+    """Measure all-reduce algorithmic bandwidth per device over the mesh.
+
+    Port of tools/bandwidth/measure.py: reports GB/s/device using the
+    2(n-1)/n ring all-reduce traffic model on the gradient-sized buffers.
+    """
+    if mesh is None:
+        from .mesh import local_mesh
+
+        mesh = local_mesh("dp")
+    axis = mesh.axis_names[0]
+    n = mesh.devices.size
+    results = []
+    for mb in sizes_mb:
+        elems = int(mb * 1024 * 1024 / np.dtype(dtype).itemsize)
+        sharding = NamedSharding(mesh, PartitionSpec(axis))
+        x = jax.device_put(
+            jnp.ones((n, elems), dtype), sharding)
+
+        @jax.jit
+        def ar(v):
+            return shard_map(lambda t: jax.lax.psum(t, axis), mesh=mesh,
+                             in_specs=PartitionSpec(axis),
+                             out_specs=PartitionSpec(axis))(v)
+
+        ar(x).block_until_ready()  # compile
+        tic = time.perf_counter()
+        for _ in range(n_iter):
+            x = ar(x)
+        x.block_until_ready()
+        dt = (time.perf_counter() - tic) / n_iter
+        bytes_moved = 2 * (n - 1) / max(n, 1) * elems * np.dtype(dtype).itemsize
+        gbps = bytes_moved / dt / 1e9
+        results.append({"size_mb": mb, "time_s": dt, "gbps_per_device": gbps})
+        if verbose:
+            print(f"allreduce {mb:4d} MB over {n} devices: {dt*1e3:8.2f} ms, "
+                  f"{gbps:7.2f} GB/s/device")
+    return results

@@ -1,0 +1,58 @@
+"""Device mesh management.
+
+The TPU-native replacement for the reference's device-list plumbing
+(ctx lists in Module, kvstore device groups): a named ``jax.sharding.Mesh``
+over the chip grid, with axes for data (dp), tensor (tp), pipeline (pp),
+sequence (sp) and expert (ep) parallelism.  Collectives ride ICI within a
+slice and DCN across slices — XLA chooses based on mesh topology.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "Mesh", "NamedSharding", "PartitionSpec", "replicated",
+           "shard_along", "local_mesh"]
+
+
+def make_mesh(axes, devices=None) -> Mesh:
+    """Create a Mesh from an ordered {axis_name: size} dict.
+
+    A size of -1 absorbs the remaining devices (like a reshape wildcard)::
+
+        mesh = make_mesh({"dp": -1, "tp": 2})
+    """
+    if devices is None:
+        devices = jax.devices()
+    names = list(axes)
+    sizes = [axes[n] for n in names]
+    n_dev = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n_dev % known:
+            raise ValueError(f"cannot infer axis: {n_dev} devices, known {known}")
+        sizes[sizes.index(-1)] = n_dev // known
+    total = int(np.prod(sizes))
+    if total > n_dev:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+                         f"have {n_dev}")
+    grid = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(grid, names)
+
+
+def local_mesh(axis_name="dp") -> Mesh:
+    """1-D mesh over all local devices."""
+    return make_mesh({axis_name: -1})
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_along(mesh: Mesh, axis_name, dim=0) -> NamedSharding:
+    """Sharding that splits array dimension ``dim`` along mesh axis."""
+    spec = [None] * dim + [axis_name]
+    return NamedSharding(mesh, PartitionSpec(*spec))

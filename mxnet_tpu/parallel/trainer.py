@@ -1,0 +1,253 @@
+"""ShardedTrainer: a Symbol's full training step compiled over a mesh.
+
+This is the TPU-native path the reference cannot express: instead of
+per-device executors + KVStore push/pull (§3.3/§3.4), the *entire* train
+step — forward, backward, gradient all-reduce, optimizer update — is one
+jitted XLA program whose inputs carry ``NamedSharding``s.  The GSPMD
+partitioner inserts the collectives: batch sharded over ``dp`` yields a
+gradient psum over ICI (the dist_sync path collapsed into the step,
+SURVEY.md §3.4 "TPU translation"); parameters sharded over ``tp`` yield
+tensor-parallel matmul collectives; sequence-sharded activations over
+``sp`` yield context parallelism.
+
+The Module/KVStore stack remains the MXNet-compatible surface; this
+trainer is the performance path for pod-scale runs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .. import random as _random
+from ..base import MXNetError, np_dtype
+from ..executor import _CompiledGraph
+from ..initializer import Uniform
+from .. import ndarray as nd
+
+__all__ = ["ShardedTrainer", "sgd_opt", "adam_opt"]
+
+
+def sgd_opt(learning_rate=0.01, momentum=0.9, weight_decay=0.0):
+    """Functional SGD(+momentum) over a param pytree."""
+
+    def init(params):
+        if momentum == 0.0:
+            return {}
+        return {k: jnp.zeros_like(v) for k, v in params.items()}
+
+    def update(grads, state, params):
+        new_params, new_state = {}, {}
+        for k, p in params.items():
+            g = grads[k].astype(jnp.float32) + weight_decay * p.astype(jnp.float32)
+            if momentum != 0.0:
+                m = momentum * state[k].astype(jnp.float32) - learning_rate * g
+                new_state[k] = m.astype(p.dtype)
+                new_params[k] = (p.astype(jnp.float32) + m).astype(p.dtype)
+            else:
+                new_params[k] = (p.astype(jnp.float32) - learning_rate * g).astype(p.dtype)
+        return new_params, new_state
+
+    return init, update
+
+
+def adam_opt(learning_rate=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+             weight_decay=0.0):
+    """Functional Adam over a param pytree."""
+
+    def init(params):
+        z = {k: jnp.zeros_like(v, dtype=jnp.float32) for k, v in params.items()}
+        return {"m": z, "v": {k: jnp.zeros_like(val, dtype=jnp.float32)
+                              for k, val in params.items()},
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update(grads, state, params):
+        t = state["t"] + 1
+        lr_t = learning_rate * jnp.sqrt(1 - beta2**t.astype(jnp.float32)) / (
+            1 - beta1**t.astype(jnp.float32))
+        new_params, new_m, new_v = {}, {}, {}
+        for k, p in params.items():
+            g = grads[k].astype(jnp.float32) + weight_decay * p.astype(jnp.float32)
+            m = beta1 * state["m"][k] + (1 - beta1) * g
+            v = beta2 * state["v"][k] + (1 - beta2) * jnp.square(g)
+            new_m[k], new_v[k] = m, v
+            new_params[k] = (p.astype(jnp.float32)
+                             - lr_t * m / (jnp.sqrt(v) + eps)).astype(p.dtype)
+        return new_params, {"m": new_m, "v": new_v, "t": t}
+
+    return init, update
+
+
+_OPTS = {"sgd": sgd_opt, "adam": adam_opt}
+
+
+class ShardedTrainer:
+    """Compile and run a full sharded train step for a Symbol.
+
+    Parameters
+    ----------
+    symbol : Symbol with a loss head (SoftmaxOutput / MakeLoss / ...)
+    input_shapes : dict name -> global shape (batch dim = global batch)
+    mesh : jax.sharding.Mesh; axes referenced by batch_axis/param_specs
+    batch_axis : mesh axis name data is sharded over (data parallelism)
+    param_specs : {param_name_or_regex: PartitionSpec} for tensor/expert
+        parallel parameter sharding; unlisted params are replicated
+    sequence_specs : {input_name: PartitionSpec} extra input shardings
+        (e.g. sequence axis over 'sp' for context parallelism)
+    optimizer : 'sgd' | 'adam' | (init_fn, update_fn)
+    dtype : compute dtype for params (bfloat16 recommended on TPU)
+    """
+
+    def __init__(self, symbol, input_shapes, mesh=None, batch_axis="dp",
+                 param_specs=None, sequence_specs=None, optimizer="sgd",
+                 optimizer_params=None, initializer=None, dtype="float32",
+                 input_dtypes=None, rescale_grad=None):
+        if mesh is None:
+            from .mesh import local_mesh
+
+            mesh = local_mesh(batch_axis)
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        self.symbol = symbol
+        self._graph = _CompiledGraph(symbol)
+        self.input_names = list(input_shapes)
+        self.param_names = [n for n in symbol.list_arguments()
+                            if n not in input_shapes]
+        self.aux_names = symbol.list_auxiliary_states()
+        self._dtype = np_dtype(dtype)
+
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**input_shapes)
+        arg_types, _, _ = symbol.infer_type(
+            **{k: v for k, v in (input_dtypes or {}).items()})
+        name2shape = dict(zip(symbol.list_arguments(), arg_shapes))
+        name2type = dict(zip(symbol.list_arguments(), arg_types))
+        self.out_shapes = out_shapes
+        self._input_shapes = dict(input_shapes)
+        self._input_dtypes = {k: name2type.get(k) or np.float32
+                              for k in self.input_names}
+        if input_dtypes:
+            self._input_dtypes.update(input_dtypes)
+
+        # -- initialize params on host, then place with shardings ----------
+        initializer = initializer or Uniform(0.07)
+        import re
+
+        def spec_for(name):
+            for pat, spec in (param_specs or {}).items():
+                if pat == name or re.fullmatch(pat, name):
+                    return spec
+            return PartitionSpec()
+
+        self.param_shardings = {n: NamedSharding(mesh, spec_for(n))
+                                for n in self.param_names}
+        self._replicated = NamedSharding(mesh, PartitionSpec())
+
+        params = {}
+        for name in self.param_names:
+            host = nd.zeros(name2shape[name], dtype=np.float32)
+            initializer(name, host)
+            params[name] = jax.device_put(
+                host.asnumpy().astype(self._dtype), self.param_shardings[name])
+        self.params = params
+        aux = {}
+        for name, shp in zip(self.aux_names, aux_shapes):
+            host = nd.zeros(shp, dtype=np.float32)
+            initializer(name, host)
+            aux[name] = jax.device_put(host.asnumpy(), self._replicated)
+        self.aux = aux
+
+        # -- optimizer ------------------------------------------------------
+        if isinstance(optimizer, str):
+            opt_factory = _OPTS[optimizer]
+            init_fn, update_fn = opt_factory(**(optimizer_params or {}))
+        else:
+            init_fn, update_fn = optimizer
+        self.opt_state = jax.device_put(init_fn(params))  # inherits shardings
+        self._update_fn = update_fn
+
+        # Loss-layer backward is un-normalized (reference SoftmaxOutput
+        # contract); like Module.init_optimizer, default rescale to
+        # 1/global_batch.
+        if rescale_grad is None:
+            rescale_grad = 1.0 / next(iter(input_shapes.values()))[0]
+        self._rescale_grad = rescale_grad
+
+        self.batch_shardings = {
+            n: NamedSharding(mesh, (sequence_specs or {}).get(
+                n, PartitionSpec(batch_axis)))
+            for n in self.input_names}
+        self._key = _random.next_key()
+        self._build_steps()
+
+    # ------------------------------------------------------------------ #
+    def _build_steps(self):
+        graph = self._graph
+
+        def train_step(params, opt_state, aux, batch, key):
+            def f(p):
+                outs, new_aux = graph({**p, **batch}, aux, key, True)
+                return outs, new_aux
+
+            outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
+            head = tuple(jnp.ones_like(o) for o in outs)
+            grads = vjp_fn(head)[0]
+            scale = self._rescale_grad
+            grads = {k: g * scale for k, g in grads.items()}
+            new_params, new_opt = self._update_fn(grads, opt_state, params)
+            return new_params, new_opt, new_aux, outs
+
+        def eval_step(params, aux, batch, key):
+            outs, _ = graph({**params, **batch}, aux, key, False)
+            return outs
+
+        p_shard = self.param_shardings
+        rep = self._replicated
+        opt_shardings = jax.tree_util.tree_map(
+            lambda x: x.sharding, self.opt_state)
+        aux_shardings = {k: rep for k in self.aux_names}
+        self._train_step = jax.jit(
+            train_step,
+            in_shardings=(p_shard, opt_shardings, aux_shardings,
+                          self.batch_shardings, rep),
+            out_shardings=(p_shard, opt_shardings, aux_shardings, None),
+            donate_argnums=(0, 1, 2),
+        )
+        self._eval_step = jax.jit(
+            eval_step,
+            in_shardings=(p_shard, aux_shardings, self.batch_shardings, rep),
+        )
+
+    def _place_batch(self, batch):
+        placed = {}
+        for name in self.input_names:
+            v = batch[name]
+            if isinstance(v, nd.NDArray):
+                v = v.asnumpy()
+            v = np.asarray(v, dtype=self._input_dtypes[name])
+            placed[name] = jax.device_put(v, self.batch_shardings[name])
+        return placed
+
+    def step(self, batch: dict):
+        """One optimizer step on a global batch; returns outputs."""
+        self._key, sub = jax.random.split(self._key)
+        placed = self._place_batch(batch)
+        self.params, self.opt_state, self.aux, outs = self._train_step(
+            self.params, self.opt_state, self.aux, placed, sub)
+        return outs
+
+    def eval(self, batch: dict):
+        self._key, sub = jax.random.split(self._key)
+        return self._eval_step(self.params, self.aux, self._place_batch(batch), sub)
+
+    def get_params(self):
+        """Gather params to host as name->np.ndarray (checkpoint surface)."""
+        return {k: np.asarray(jax.device_get(v)) for k, v in self.params.items()}
+
+    def set_params(self, arg_params):
+        for k, v in arg_params.items():
+            if k in self.params:
+                self.params[k] = jax.device_put(
+                    np.asarray(v).astype(self._dtype), self.param_shardings[k])

@@ -1,0 +1,206 @@
+"""RecordIO: packed record format + readers/writers.
+
+Rebuild of python/mxnet/recordio.py and dmlc-core's recordio framing as
+used by the reference data pipeline (src/io/iter_image_recordio.cc).
+Binary-compatible with the reference format: records framed by the magic
+``0xced7230a`` + a length-encoded header word, payload padded to 4-byte
+boundaries, plus the IRHeader (flag, label, id, id2) image-record header
+used by im2rec — so .rec datasets packed for the reference load here
+unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xCED7230A
+_ENC_MASK = 0x1FFFFFFF
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _dec_flag(header):
+    return header >> 29
+
+
+def _dec_length(header):
+    return header & _ENC_MASK
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("invalid flag " + self.flag)
+
+    def close(self):
+        if self.handle is not None:
+            self.handle.close()
+            self.handle = None
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf: bytes):
+        assert self.writable
+        self.handle.write(struct.pack("<II", _MAGIC, _encode_lrec(0, len(buf))))
+        self.handle.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        head = self.handle.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _MAGIC:
+            raise IOError("invalid record magic")
+        length = _dec_length(lrec)
+        buf = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        return buf
+
+    def tell(self):
+        return self.handle.tell()
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["handle"] = None
+        d["_pos"] = self.handle.tell() if self.handle else 0
+        return d
+
+    def __setstate__(self, d):
+        pos = d.pop("_pos", 0)
+        self.__dict__.update(d)
+        self.open()
+        if not self.writable:
+            self.handle.seek(pos)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access record IO via an .idx sidecar (recordio.py:86)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if not self.writable and os.path.isfile(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    key, pos = line.strip().split("\t")
+                    key = key_type(key)
+                    self.idx[key] = int(pos)
+                    self.keys.append(key)
+
+    def close(self):
+        if self.handle is not None and self.writable:
+            with open(self.idx_path, "w") as f:
+                for key in self.keys:
+                    f.write(f"{key}\t{self.idx[key]}\n")
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        self.idx[key] = self.tell()
+        self.keys.append(key)
+        self.write(buf)
+
+
+class IRHeader:
+    """Image-record header (recordio.py IRHeader): flag, label, id, id2."""
+
+    _FMT = "<IfQQ"
+
+    def __init__(self, flag, label, id, id2):
+        self.flag = flag
+        self.label = label
+        self.id = id
+        self.id2 = id2
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Pack a (header, payload) image record (recordio.py pack)."""
+    label = header.label
+    if isinstance(label, (list, tuple, np.ndarray)):
+        label = np.asarray(label, dtype=np.float32)
+        header = IRHeader(len(label), 0.0, header.id, header.id2)
+        return struct.pack(IRHeader._FMT, header.flag, header.label,
+                           header.id, header.id2) + label.tobytes() + s
+    return struct.pack(IRHeader._FMT, int(header.flag), float(label),
+                       int(header.id), int(header.id2)) + s
+
+
+def unpack(s: bytes):
+    """Unpack a record into (IRHeader, payload) (recordio.py unpack)."""
+    flag, label, id_, id2 = struct.unpack(IRHeader._FMT,
+                                          s[:struct.calcsize(IRHeader._FMT)])
+    s = s[struct.calcsize(IRHeader._FMT):]
+    header = IRHeader(flag, label, id_, id2)
+    if flag > 0:
+        label = np.frombuffer(s[:flag * 4], dtype=np.float32)
+        header = IRHeader(flag, label, id_, id2)
+        s = s[flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array as a compressed record (recordio.py pack_img)."""
+    import cv2
+
+    encode_params = None
+    if img_fmt in (".jpg", ".jpeg"):
+        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    elif img_fmt == ".png":
+        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+    ret, buf = cv2.imencode(img_fmt, img, encode_params)
+    if not ret:
+        raise RuntimeError("failed to encode image")
+    return pack(header, buf.tobytes())
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack a record into (IRHeader, decoded image) (recordio.py)."""
+    import cv2
+
+    header, img_bytes = unpack(s)
+    img = cv2.imdecode(np.frombuffer(img_bytes, dtype=np.uint8), iscolor)
+    return header, img

@@ -1,0 +1,72 @@
+"""Runtime user kernels.
+
+Rebuild of the reference MXRtc (src/common/mxrtc.cc, python/mxnet/rtc.py):
+there the user hands CUDA source to NVRTC at runtime; here the user hands
+a **Pallas kernel** (or any JAX-traceable function), which is compiled
+for TPU by Mosaic and pushed like any other op.  Same capability —
+user-supplied custom kernels without rebuilding the framework.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .ndarray import NDArray
+
+__all__ = ["Rtc", "PallasKernel"]
+
+
+class PallasKernel:
+    """Wrap a pallas_call-building function into an NDArray-callable op.
+
+    Example::
+
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def scale_kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:] * 2.0
+
+        def build(x):
+            return pl.pallas_call(
+                scale_kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+
+        k = PallasKernel(build)
+        y = k(x_nd)
+    """
+
+    def __init__(self, build_fn, name="pallas_kernel"):
+        self.name = name
+        self._fn = jax.jit(build_fn)
+
+    def __call__(self, *inputs):
+        ctx = inputs[0].context
+        raw = self._fn(*[x._data for x in inputs])
+        if isinstance(raw, (tuple, list)):
+            return [NDArray(r, ctx) for r in raw]
+        return NDArray(raw, ctx)
+
+
+class Rtc:
+    """API-compatible shim for mx.rtc.Rtc(name, inputs, outputs, kernel).
+
+    The reference takes CUDA C source; on TPU pass a python function
+    ``kernel(inputs) -> outputs`` built from jnp/pallas instead.  Passing
+    CUDA source raises with a pointer to PallasKernel.
+    """
+
+    def __init__(self, name, inputs, outputs, kernel):
+        if isinstance(kernel, str):
+            raise TypeError(
+                "CUDA source kernels are not supported on TPU; pass a "
+                "JAX/Pallas callable (see mxnet_tpu.rtc.PallasKernel)")
+        self.name = name
+        self._kernel = PallasKernel(kernel, name)
+
+    def push(self, inputs, outputs, grid_dims=None, block_dims=None):
+        results = self._kernel(*inputs)
+        if not isinstance(results, list):
+            results = [results]
+        for dst, src in zip(outputs, results):
+            dst[:] = src

@@ -1,0 +1,274 @@
+"""Testing utilities.
+
+Rebuild of python/mxnet/test_utils.py: ``check_numeric_gradient`` (random
+projections + central finite differences, reference test_utils.py:270),
+``check_symbolic_forward/backward``, ``check_consistency`` (same symbol
+across contexts/dtypes, test_utils.py:616), ``check_speed``, and data
+helpers.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from . import context as ctx_mod
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .base import MXNetError
+
+__all__ = ["default_context", "rand_ndarray", "check_numeric_gradient",
+           "check_symbolic_forward", "check_symbolic_backward",
+           "check_consistency", "check_speed", "reldiff", "same",
+           "assert_almost_equal", "simple_forward"]
+
+
+def default_context():
+    return ctx_mod.current_context()
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def reldiff(a, b):
+    diff = np.abs(a - b).sum()
+    norm = (np.abs(a) + np.abs(b)).sum()
+    return diff / norm if norm != 0 else diff
+
+
+def assert_almost_equal(a, b, threshold=1e-5, rtol=None, atol=None):
+    if rtol is not None or atol is not None:
+        np.testing.assert_allclose(a, b, rtol=rtol or 1e-5, atol=atol or 1e-20)
+        return
+    rd = reldiff(np.asarray(a), np.asarray(b))
+    if rd > threshold:
+        raise AssertionError(f"reldiff {rd} > {threshold}")
+
+
+def rand_ndarray(shape, ctx=None, scale=1.0):
+    return nd.array(np.random.uniform(-scale, scale, shape), ctx=ctx)
+
+
+def _parse_location(sym, location, ctx):
+    if isinstance(location, dict):
+        return {k: (v if isinstance(v, nd.NDArray) else nd.array(v, ctx=ctx))
+                for k, v in location.items()}
+    return {name: (v if isinstance(v, nd.NDArray) else nd.array(v, ctx=ctx))
+            for name, v in zip(sym.list_arguments(), location)}
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Forward a symbol on given numpy inputs, returning numpy outputs."""
+    ctx = ctx or default_context()
+    shapes = {k: v.shape for k, v in inputs.items()}
+    exe = sym.simple_bind(ctx, grad_req="null", **shapes)
+    for k, v in inputs.items():
+        exe.arg_dict[k][:] = v
+    outs = [o.asnumpy() for o in exe.forward(is_train=is_train)]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Central finite differences over every arg (test_utils.py:270)."""
+    approx_grads = {}
+    for name, arr in location.items():
+        base = arr.asnumpy().copy()
+        grad = np.zeros_like(base, dtype=np.float64)
+        flat = base.ravel()
+        gflat = grad.ravel()
+        for i in range(flat.size):
+            old = flat[i]
+            flat[i] = old + eps
+            executor.arg_dict[name][:] = base.reshape(arr.shape)
+            fp = _total_out(executor, use_forward_train)
+            flat[i] = old - eps
+            executor.arg_dict[name][:] = base.reshape(arr.shape)
+            fm = _total_out(executor, use_forward_train)
+            flat[i] = old
+            executor.arg_dict[name][:] = base.reshape(arr.shape)
+            gflat[i] = (fp - fm) / (2 * eps)
+        approx_grads[name] = grad
+    return approx_grads
+
+
+def _total_out(executor, is_train):
+    outs = executor.forward(is_train=is_train)
+    return sum(float(o.asnumpy().sum()) for o in outs)
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           check_eps=1e-2, grad_nodes=None, use_forward_train=True,
+                           ctx=None, proj=None):
+    """Compare symbolic gradients of sum(outputs·proj) against finite
+    differences (reference test_utils.py check_numeric_gradient)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    if grad_nodes is None:
+        grad_nodes = list(location.keys())
+
+    # random projection makes the scalar objective sensitive everywhere
+    input_shapes = {k: v.shape for k, v in location.items()}
+    _, out_shapes, _ = sym.infer_shape(**input_shapes)
+    proj_syms = []
+    out_grouped = sym if len(sym.list_outputs()) > 1 else sym_mod.Group([sym])
+    heads = []
+    for i, oshape in enumerate(out_shapes):
+        p = sym_mod.Variable(f"__random_proj_{i}")
+        heads.append(sym_mod.MakeLoss(sym_mod.sum(out_grouped[i] * p)))
+    combined = sym_mod.Group(heads)
+
+    proj_arrays = {f"__random_proj_{i}": nd.array(
+        np.random.normal(0, 1.0, s), ctx=ctx)
+        for i, s in enumerate(out_shapes)}
+    grad_req = {k: ("write" if k in grad_nodes else "null")
+                for k in list(location) + list(proj_arrays)}
+    all_args = {**location, **proj_arrays}
+    shapes = {k: v.shape for k, v in all_args.items()}
+    exe = combined.simple_bind(ctx, grad_req=grad_req, **shapes)
+    for k, v in all_args.items():
+        exe.arg_dict[k][:] = v
+    if aux_states:
+        for k, v in aux_states.items():
+            exe.aux_dict[k][:] = v
+    exe.forward(is_train=True)
+    exe.backward()
+    symbolic_grads = {k: exe.grad_dict[k].asnumpy() for k in grad_nodes}
+
+    numeric_gradients = numeric_grad(
+        exe, {k: v for k, v in location.items() if k in grad_nodes},
+        eps=numeric_eps, use_forward_train=use_forward_train)
+
+    for name in grad_nodes:
+        fd_grad = numeric_gradients[name]
+        sym_grad = symbolic_grads[name]
+        rd = reldiff(fd_grad, sym_grad)
+        if rd > check_eps:
+            raise AssertionError(
+                f"numeric gradient check failed for {name}: reldiff {rd:.3g} "
+                f"> {check_eps}\nnumeric:\n{fd_grad}\nsymbolic:\n{sym_grad}")
+
+
+def check_symbolic_forward(sym, location, expected, check_eps=1e-5, ctx=None,
+                           aux_states=None):
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    shapes = {k: v.shape for k, v in location.items()}
+    exe = sym.simple_bind(ctx, grad_req="null", **shapes)
+    for k, v in location.items():
+        exe.arg_dict[k][:] = v
+    if aux_states:
+        for k, v in aux_states.items():
+            exe.aux_dict[k][:] = v
+    outputs = [o.asnumpy() for o in exe.forward(is_train=False)]
+    for out, exp in zip(outputs, expected):
+        if reldiff(out, np.asarray(exp)) > check_eps:
+            raise AssertionError(
+                f"forward check failed: reldiff > {check_eps}\n{out}\nvs\n{exp}")
+    return outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, check_eps=1e-5,
+                            grad_req="write", ctx=None, aux_states=None):
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    shapes = {k: v.shape for k, v in location.items()}
+    exe = sym.simple_bind(ctx, grad_req=grad_req, **shapes)
+    for k, v in location.items():
+        exe.arg_dict[k][:] = v
+    if aux_states:
+        for k, v in aux_states.items():
+            exe.aux_dict[k][:] = v
+    exe.forward(is_train=True)
+    exe.backward([g if isinstance(g, nd.NDArray) else nd.array(g, ctx=ctx)
+                  for g in out_grads])
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    grads = {k: exe.grad_dict[k].asnumpy() for k in expected}
+    for name, exp in expected.items():
+        if reldiff(grads[name], np.asarray(exp)) > check_eps:
+            raise AssertionError(
+                f"backward check failed for {name}\n{grads[name]}\nvs\n{exp}")
+    return grads
+
+
+def check_consistency(sym, ctx_list, scale=1.0, type_dict=None,
+                      arg_params=None, tol=None):
+    """Run the same symbol across context/dtype configs and compare
+    forward/backward (reference test_utils.py:616)."""
+    tol = tol or {np.dtype(np.float16): 1e-1, np.dtype(np.float32): 1e-3,
+                  np.dtype(np.float64): 1e-5}
+    exe_list = []
+    for spec in ctx_list:
+        spec = dict(spec)
+        ctx = spec.pop("ctx", default_context())
+        dtypes = spec.pop("type_dict", type_dict or {})
+        shapes = spec
+        exe = sym.simple_bind(ctx, grad_req="write", type_dict=dtypes, **shapes)
+        exe_list.append(exe)
+    # identical inputs everywhere (cast per executor dtype)
+    ref = exe_list[0]
+    inits = {}
+    for name, arr in ref.arg_dict.items():
+        inits[name] = np.random.normal(0, scale, arr.shape)
+        if arg_params and name in arg_params:
+            inits[name] = arg_params[name]
+    outputs = []
+    grads = []
+    for exe in exe_list:
+        for name, v in inits.items():
+            exe.arg_dict[name][:] = v.astype(exe.arg_dict[name].dtype)
+        exe.forward(is_train=True)
+        exe.backward()
+        outputs.append([o.asnumpy().astype(np.float64) for o in exe.outputs])
+        grads.append({k: g.asnumpy().astype(np.float64)
+                      for k, g in exe.grad_dict.items()})
+    for i, exe in enumerate(exe_list[1:], 1):
+        t = tol.get(np.dtype(exe.arg_arrays[0].dtype), 1e-3)
+        for o_ref, o in zip(outputs[0], outputs[i]):
+            if reldiff(o_ref, o) > t:
+                raise AssertionError(f"forward inconsistency in config {i}")
+        for name in grads[0]:
+            if reldiff(grads[0][name], grads[i][name]) > t:
+                raise AssertionError(
+                    f"backward inconsistency for {name} in config {i}")
+    return outputs
+
+
+def check_speed(sym, location=None, ctx=None, N=20, grad_req="write",
+                typ="whole", **kwargs):
+    """Micro-benchmark a symbol (reference test_utils.py:538)."""
+    ctx = ctx or default_context()
+    if location is None:
+        location = {k: np.random.normal(size=s)
+                    for k, s in kwargs.items()}
+        shapes = kwargs
+    else:
+        shapes = {k: v.shape for k, v in location.items()}
+    exe = sym.simple_bind(ctx, grad_req=grad_req, **shapes)
+    for k, v in location.items():
+        exe.arg_dict[k][:] = v
+    if typ == "whole":
+        exe.forward(is_train=True)
+        exe.backward()
+        [o.wait_to_read() for o in exe.outputs]
+        tic = time.time()
+        for _ in range(N):
+            exe.forward(is_train=True)
+            exe.backward()
+        for o in exe.outputs:
+            o.wait_to_read()
+        nd.waitall()
+        return (time.time() - tic) / N
+    elif typ == "forward":
+        exe.forward(is_train=False)
+        [o.wait_to_read() for o in exe.outputs]
+        tic = time.time()
+        for _ in range(N):
+            exe.forward(is_train=False)
+        for o in exe.outputs:
+            o.wait_to_read()
+        return (time.time() - tic) / N
+    raise ValueError("typ must be 'whole' or 'forward'")

@@ -1,0 +1,142 @@
+"""Network visualization (rebuild of python/mxnet/visualization.py):
+``print_summary`` (layer table with params/flops-ish info) and
+``plot_network`` (graphviz dot; returns the Digraph if graphviz is
+installed, else the dot source string)."""
+
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print a per-layer summary table (reference visualization.py:25)."""
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise MXNetError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        pre_filter = 0
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in set(conf["arg_nodes"]):
+                    if not input_name.startswith(node["name"]):
+                        pre_node.append(input_name)
+        cur_param = 0
+        if show_shape and op != "null":
+            key = node["name"] + "_output"
+            if key in shape_dict:
+                out_shape = shape_dict[key]
+        for input_entry in node.get("inputs", []):
+            input_node = nodes[input_entry[0]]
+            if input_node["op"] == "null" and input_node["name"].startswith(
+                    node["name"] + "_"):
+                key = input_node["name"] + "_output"
+                if key in shape_dict:
+                    p = 1
+                    for d in shape_dict[key]:
+                        p *= d
+                    cur_param += p
+        first_connection = pre_node[0] if pre_node else ""
+        fields = [f"{node['name']}({op})", str(out_shape) if out_shape else "",
+                  cur_param, first_connection]
+        print_row(fields, positions)
+        for connection in pre_node[1:]:
+            print_row(["", "", "", connection], positions)
+        total_params[0] += cur_param
+
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            continue
+        out_shape = None
+        print_layer_summary(node, out_shape)
+        print(("=" if i == len(nodes) - 1 else "_") * line_length)
+    print(f"Total params: {total_params[0]}")
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", shape=None, node_attrs=None):
+    """Build a graphviz Digraph of the network (visualization.py:97).
+
+    Falls back to returning the dot source string if graphviz is absent.
+    """
+    node_attrs = node_attrs or {}
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = {h[0] for h in conf["heads"]}
+
+    fill_map = {"FullyConnected": "#fb8072", "Convolution": "#fb8072",
+                "Activation": "#ffffb3", "LeakyReLU": "#ffffb3",
+                "BatchNorm": "#bebada", "Pooling": "#80b1d3",
+                "Concat": "#fdb462", "Flatten": "#fdb462",
+                "Reshape": "#fdb462", "SoftmaxOutput": "#b3de69"}
+
+    lines = [f"digraph {json.dumps(title)} {{"]
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null" and i not in heads and not any(
+                i == item[0] for n in nodes for item in n.get("inputs", [])):
+            continue
+        if op == "null":
+            label = name
+            color = "#8dd3c7"
+        else:
+            param = node.get("param", {})
+            label = f"{op}\\n{name}"
+            if op == "Convolution":
+                label = f"Convolution\\n{param.get('kernel', '?')}/{param.get('stride', '1')},{param.get('num_filter', '?')}"
+            elif op == "FullyConnected":
+                label = f"FullyConnected\\n{param.get('num_hidden', '?')}"
+            color = fill_map.get(op, "#fccde5")
+        lines.append(
+            f'  n{i} [label="{label}", style=filled, fillcolor="{color}", shape=box];')
+    for i, node in enumerate(nodes):
+        for item in node.get("inputs", []):
+            src = nodes[item[0]]
+            if src["op"] == "null" and not src["name"].endswith(
+                    ("weight", "bias", "gamma", "beta", "moving_mean",
+                     "moving_var", "label")):
+                lines.append(f"  n{item[0]} -> n{i};")
+            elif src["op"] != "null":
+                lines.append(f"  n{item[0]} -> n{i};")
+    lines.append("}")
+    dot_source = "\n".join(lines)
+    try:
+        from graphviz import Source
+
+        return Source(dot_source)
+    except ImportError:
+        return dot_source

@@ -6,7 +6,13 @@ hardware — the rebuild of the reference's N-CPU-contexts testing trick
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+# The env var alone can be overridden by accelerator plugins (axon);
+# the config update is authoritative.
+jax.config.update("jax_platforms", "cpu")
