@@ -3,6 +3,13 @@ capabilities (NDArray, Symbol/Executor, Module, KVStore, data iterators)
 rebuilt idiomatically on JAX/XLA/Pallas.  See SURVEY.md for the mapping
 to the reference architecture."""
 
+import jax as _jax
+
+# The reference framework supports float64 end to end (mshadow type switch);
+# enable x64 so dtype parity holds.  Weak-typed python scalars still keep
+# float32 results in f32 graphs, so TPU perf paths are unaffected.
+_jax.config.update("jax_enable_x64", True)
+
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, cpu_pinned, current_context, gpu, tpu, num_devices

@@ -356,8 +356,8 @@ def _read_idx_file(path):
         magic = struct.unpack(">i", f.read(4))[0]
         ndim = magic % 256
         dims = [struct.unpack(">i", f.read(4))[0] for _ in range(ndim)]
-        dtype = {8: np.uint8, 9: np.int8, 11: np.int16, 12: np.int32,
-                 13: np.float32, 14: np.float64}[(magic >> 8) % 256]
+        dtype = np.dtype({8: np.uint8, 9: np.int8, 11: np.int16, 12: np.int32,
+                          13: np.float32, 14: np.float64}[(magic >> 8) % 256])
         data = np.frombuffer(f.read(), dtype=dtype.newbyteorder(">"))
         return data.reshape(dims).astype(dtype)
 

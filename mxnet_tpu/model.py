@@ -200,7 +200,8 @@ class FeedForward(BASE_ESTIMATOR):
             self._module = self._get_module(data)
             self._module.bind(data.provide_data, data.provide_label,
                               for_training=False)
-            self._module.set_params(self.arg_params, self.aux_params or {})
+            self._module.set_params(self.arg_params, self.aux_params or {},
+                                    allow_missing=True)
         out = self._module.predict(data, num_batch=num_batch, reset=reset)
         if isinstance(out, list):
             return [o.asnumpy() for o in out]
@@ -212,7 +213,8 @@ class FeedForward(BASE_ESTIMATOR):
             self._module = self._get_module(data)
             self._module.bind(data.provide_data, data.provide_label,
                               for_training=False)
-            self._module.set_params(self.arg_params, self.aux_params or {})
+            self._module.set_params(self.arg_params, self.aux_params or {},
+                                    allow_missing=True)
         res = self._module.score(data, eval_metric, num_batch=num_batch,
                                  reset=reset)
         return res[0][1]

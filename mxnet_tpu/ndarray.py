@@ -395,7 +395,8 @@ def save(fname: str, data):
         payload = {k: v.asnumpy() for k, v in data.items()}
     else:
         raise TypeError("save expects NDArray, list or dict")
-    np.savez(fname if fname.endswith(".npz") else fname, **_encode_bf16(payload))
+    with open(fname, "wb") as f:
+        np.savez(f, **_encode_bf16(payload))
 
 
 def load(fname: str):

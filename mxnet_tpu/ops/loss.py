@@ -81,7 +81,7 @@ class RegressionParam(Params):
     grad_scale = field(float, default=1.0)
 
 
-def _reg_label_shape(params, in_shapes):
+def _reg_label_shape(self, params, in_shapes):
     d = in_shapes[0]
     if d is None:
         raise ValueError("regression output: data shape unknown")

@@ -204,7 +204,8 @@ class CastParam(Params):
 
 
 def _cast_dtype(p, in_dtypes):
-    return list(in_dtypes), [np_dtype(p.dtype)], []
+    ins = [d if d is not None else np.dtype(np.float32) for d in in_dtypes]
+    return ins, [np_dtype(p.dtype)], []
 
 
 register_simple_op("Cast", lambda p, x: x.astype(np_dtype(p.dtype)), nin=1,

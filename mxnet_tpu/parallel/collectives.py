@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 __all__ = ["psum", "all_gather", "reduce_scatter", "ppermute", "allreduce",
            "allreduce_bench"]

@@ -402,12 +402,14 @@ def _infer_graph(topo, known, what, partial):
                     comp_in, outs, auxs = node.op.infer_shape(node.params, in_vals)
                 else:
                     comp_in, outs, auxs = node.op.infer_dtype(node.params, in_vals)
-            except (ValueError, MXNetError):
+            except (ValueError, MXNetError) as e:
                 if partial:
                     for i in range(node.num_outputs()):
                         values.setdefault(("out", id(node), i), None)
                     continue
-                raise
+                if isinstance(e, MXNetError):
+                    raise
+                raise MXNetError(f"infer_{what} at node {node.name}: {e}") from e
             # aux-state variables trail the argument inputs on the node
             for (src, idx), v in zip(node.inputs[n_args:], auxs):
                 if src.is_variable and v is not None and values.get(("var", src.name)) is None:

@@ -1,0 +1,123 @@
+"""Dependency engine: threaded-vs-serial equivalence under random
+read/write workloads (rebuild of tests/cpp/threaded_engine_test.cc)."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from mxnet_tpu.engine import FnProperty, NaiveEngine, ThreadedEngine
+
+
+def _random_workload(engine, n_vars=8, n_ops=60, seed=0):
+    """Push ops appending to per-var logs; writes must serialize with
+    reads/writes on the same var (GenerateWorkload analog)."""
+    rng = random.Random(seed)
+    history = []
+    hist_lock = threading.Lock()
+    variables = [engine.new_variable(f"v{i}") for i in range(n_vars)]
+    for op_id in range(n_ops):
+        n_read = rng.randint(0, 3)
+        n_write = rng.randint(1, 2)
+        picks = rng.sample(range(n_vars), n_read + n_write)
+        reads = [variables[i] for i in picks[:n_read]]
+        writes = [variables[i] for i in picks[n_read:]]
+
+        def fn(op_id=op_id, reads=tuple(picks[:n_read]),
+               writes=tuple(picks[n_read:])):
+            with hist_lock:
+                history.append((op_id, reads, writes))
+
+        engine.push(fn, const_vars=reads, mutable_vars=writes)
+    engine.wait_for_all()
+    return history
+
+
+def _check_serialization(history, n_ops):
+    """All ops ran exactly once, and per-var write ordering respects push
+    order: for each var, the op-ids that wrote it appear in increasing
+    order (engine guarantees FIFO per var)."""
+    assert sorted(h[0] for h in history) == list(range(n_ops))
+    last_write = {}
+    for op_id, reads, writes in history:
+        for v in writes:
+            if v in last_write:
+                assert last_write[v] < op_id, f"write order violated on var {v}"
+            last_write[v] = op_id
+
+
+@pytest.mark.parametrize("engine_cls", [NaiveEngine, ThreadedEngine])
+def test_workload_equivalence(engine_cls):
+    engine = engine_cls()
+    n_ops = 60
+    history = _random_workload(engine, n_ops=n_ops)
+    _check_serialization(history, n_ops)
+
+
+def test_readers_run_concurrently():
+    engine = ThreadedEngine(num_workers=4)
+    v = engine.new_variable()
+    barrier = threading.Barrier(3, timeout=5)
+
+    def reader():
+        barrier.wait()  # all three readers must be in flight at once
+
+    for _ in range(3):
+        engine.push(reader, const_vars=(v,))
+    engine.wait_for_all()
+
+
+def test_writer_excludes_readers():
+    engine = ThreadedEngine(num_workers=4)
+    v = engine.new_variable()
+    state = {"writer_active": False, "violation": False}
+    lock = threading.Lock()
+
+    def writer():
+        with lock:
+            state["writer_active"] = True
+        time.sleep(0.01)
+        with lock:
+            state["writer_active"] = False
+
+    def reader():
+        with lock:
+            if state["writer_active"]:
+                state["violation"] = True
+
+    for i in range(20):
+        if i % 3 == 0:
+            engine.push(writer, mutable_vars=(v,))
+        else:
+            engine.push(reader, const_vars=(v,))
+    engine.wait_for_all()
+    assert not state["violation"]
+
+
+def test_wait_for_var():
+    engine = ThreadedEngine(num_workers=2)
+    v = engine.new_variable()
+    done = []
+    engine.push(lambda: (time.sleep(0.05), done.append(1)), mutable_vars=(v,))
+    engine.wait_for_var(v)
+    assert done == [1]
+    engine.wait_for_all()
+
+
+def test_exception_propagates():
+    engine = ThreadedEngine(num_workers=2)
+
+    def bad():
+        raise RuntimeError("boom")
+
+    engine.push(bad)
+    with pytest.raises(RuntimeError, match="boom"):
+        engine.wait_for_all()
+
+
+def test_duplicate_var_rejected():
+    engine = NaiveEngine()
+    v = engine.new_variable()
+    with pytest.raises(ValueError):
+        engine.push(lambda: None, const_vars=(v,), mutable_vars=(v,))

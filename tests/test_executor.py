@@ -1,0 +1,126 @@
+"""Executor bind / grad_req semantics (rebuild of test_executor.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _setup():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = a * b + a
+    return out
+
+
+def test_bind_forward():
+    out = _setup()
+    ashape = (3, 4)
+    a_arr = mx.nd.array(np.random.rand(*ashape))
+    b_arr = mx.nd.array(np.random.rand(*ashape))
+    exe = out.bind(mx.cpu(), args={"a": a_arr, "b": b_arr})
+    res = exe.forward()[0].asnumpy()
+    np.testing.assert_allclose(res, a_arr.asnumpy() * b_arr.asnumpy()
+                               + a_arr.asnumpy(), rtol=1e-6)
+
+
+def test_backward_write_req():
+    out = _setup()
+    a_arr = mx.nd.array(np.random.rand(2, 2))
+    b_arr = mx.nd.array(np.random.rand(2, 2))
+    ga = mx.nd.zeros((2, 2))
+    gb = mx.nd.zeros((2, 2))
+    exe = out.bind(mx.cpu(), args=[a_arr, b_arr], args_grad=[ga, gb],
+                   grad_req="write")
+    exe.forward(is_train=True)
+    head = mx.nd.ones((2, 2))
+    exe.backward([head])
+    np.testing.assert_allclose(ga.asnumpy(), b_arr.asnumpy() + 1, rtol=1e-6)
+    np.testing.assert_allclose(gb.asnumpy(), a_arr.asnumpy(), rtol=1e-6)
+
+
+def test_backward_add_req():
+    out = _setup()
+    a_arr = mx.nd.array(np.random.rand(2, 2))
+    b_arr = mx.nd.array(np.random.rand(2, 2))
+    ga = mx.nd.ones((2, 2))
+    gb = mx.nd.ones((2, 2))
+    exe = out.bind(mx.cpu(), args=[a_arr, b_arr], args_grad=[ga, gb],
+                   grad_req="add")
+    exe.forward(is_train=True)
+    exe.backward([mx.nd.ones((2, 2))])
+    np.testing.assert_allclose(ga.asnumpy(), 1 + b_arr.asnumpy() + 1, rtol=1e-6)
+    exe.forward(is_train=True)
+    exe.backward([mx.nd.ones((2, 2))])
+    np.testing.assert_allclose(ga.asnumpy(), 1 + 2 * (b_arr.asnumpy() + 1),
+                               rtol=1e-6)
+
+
+def test_null_grad_req():
+    out = _setup()
+    exe = out.simple_bind(mx.cpu(), grad_req="null", a=(2, 2), b=(2, 2))
+    exe.forward(is_train=True)
+    exe.backward()  # no-op
+    assert exe.grad_dict == {}
+
+
+def test_grad_req_dict():
+    out = _setup()
+    exe = out.simple_bind(mx.cpu(), grad_req={"a": "write", "b": "null"},
+                          a=(2, 2), b=(2, 2))
+    assert "a" in exe.grad_dict and "b" not in exe.grad_dict
+
+
+def test_forward_kwargs_assign():
+    out = _setup()
+    exe = out.simple_bind(mx.cpu(), a=(2, 2), b=(2, 2))
+    res = exe.forward(a=np.ones((2, 2)), b=np.full((2, 2), 3.0))[0]
+    np.testing.assert_allclose(res.asnumpy(), np.full((2, 2), 4.0))
+
+
+def test_reshape():
+    out = _setup()
+    exe = out.simple_bind(mx.cpu(), a=(2, 2), b=(2, 2))
+    exe2 = exe.reshape(a=(4, 2), b=(4, 2))
+    res = exe2.forward(a=np.ones((4, 2)), b=np.ones((4, 2)))[0]
+    assert res.shape == (4, 2)
+
+
+def test_executor_loss_default_head_grad():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, label, name="sm")
+    exe = out.simple_bind(mx.cpu(), data=(4, 5), label=(4,))
+    exe.arg_dict["data"][:] = np.random.randn(4, 5)
+    exe.arg_dict["fc_weight"][:] = np.random.randn(3, 5) * 0.1
+    exe.arg_dict["label"][:] = [0, 1, 2, 0]
+    exe.forward(is_train=True)
+    exe.backward()  # loss head: no explicit out_grads needed
+    g = exe.grad_dict["fc_weight"].asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_monitor_callback():
+    out = _setup()
+    exe = out.simple_bind(mx.cpu(), a=(2, 2), b=(2, 2))
+    seen = []
+    exe.set_monitor_callback(lambda name, arr: seen.append(name))
+    exe.forward()
+    assert any("_output" in s for s in seen)
+
+
+def test_mirror_attr_runs():
+    # force_mirroring (gradient checkpointing) produces identical grads
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(force_mirroring="1"):
+        act = mx.sym.Activation(data, act_type="tanh")
+    out = mx.sym.MakeLoss(mx.sym.sum(act * act))
+    exe = out.simple_bind(mx.cpu(), data=(3, 3))
+    x = np.random.RandomState(0).randn(3, 3) * 0.5
+    exe.arg_dict["data"][:] = x
+    exe.forward(is_train=True)
+    exe.backward()
+    g = exe.grad_dict["data"].asnumpy()
+    expected = 2 * np.tanh(x) * (1 - np.tanh(x) ** 2)
+    np.testing.assert_allclose(g, expected, rtol=1e-5, atol=1e-6)

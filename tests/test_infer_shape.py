@@ -1,0 +1,82 @@
+"""Shape inference (rebuild of tests/python/unittest/test_infer_shape.py)."""
+
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_mlp_infer_shape():
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, name="fc1", num_hidden=1000)
+    out = mx.sym.Activation(out, act_type="relu")
+    out = mx.sym.FullyConnected(out, name="fc2", num_hidden=10)
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(100, 100))
+    names = out.list_arguments()
+    d = dict(zip(names, arg_shapes))
+    assert d["fc1_weight"] == (1000, 100)
+    assert d["fc1_bias"] == (1000,)
+    assert d["fc2_weight"] == (10, 1000)
+    assert out_shapes == [(100, 10)]
+
+
+def test_conv_infer_shape():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16,
+                              stride=(2, 2), pad=(1, 1), name="conv")
+    arg_shapes, out_shapes, _ = conv.infer_shape(data=(4, 3, 32, 32))
+    d = dict(zip(conv.list_arguments(), arg_shapes))
+    assert d["conv_weight"] == (16, 3, 3, 3)
+    assert out_shapes == [(4, 16, 16, 16)]
+
+
+def test_pool_full_convention():
+    data = mx.sym.Variable("data")
+    p = mx.sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       pooling_convention="full")
+    _, out_shapes, _ = p.infer_shape(data=(1, 1, 5, 5))
+    assert out_shapes == [(1, 1, 3, 3)]
+    p2 = mx.sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    _, out_shapes, _ = p2.infer_shape(data=(1, 1, 5, 5))
+    assert out_shapes == [(1, 1, 2, 2)]
+
+
+def test_backward_infer():
+    # weight shape determines data shape is NOT required; but partial works
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    assert out_shapes[0] is None
+
+
+def test_incomplete_infer_raises():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3)
+    with pytest.raises(mx.MXNetError):
+        fc.infer_shape()
+
+
+def test_reshape_infer():
+    data = mx.sym.Variable("data")
+    r = mx.sym.Reshape(data, shape=(0, -1))
+    _, out_shapes, _ = r.infer_shape(data=(2, 3, 4))
+    assert out_shapes == [(2, 12)]
+
+
+def test_concat_infer():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = mx.sym.Concat(a, b, num_args=2, dim=1)
+    _, out_shapes, _ = c.infer_shape(a=(2, 3), b=(2, 5))
+    assert out_shapes == [(2, 8)]
+
+
+def test_infer_type():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3)
+    arg_types, out_types, _ = fc.infer_type(data="float64")
+    import numpy as np
+
+    assert out_types[0] == np.dtype(np.float64)
+    c = mx.sym.Cast(data, dtype="float16")
+    _, out_types, _ = c.infer_type(data="float32")
+    assert out_types[0] == np.dtype(np.float16)

@@ -1,0 +1,159 @@
+"""IO iterators + RecordIO (rebuild of test_io.py / test_recordio.py)."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.io import (CSVIter, DataBatch, MNISTIter, NDArrayIter,
+                          PrefetchingIter, ResizeIter)
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(100).reshape(25, 4).astype(np.float32)
+    labels = np.arange(25).astype(np.float32)
+    it = NDArrayIter(data, labels, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 5
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5])
+    np.testing.assert_allclose(batches[2].label[0].asnumpy(), labels[10:15])
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_ndarray_iter_pad():
+    data = np.arange(23 * 2).reshape(23, 2).astype(np.float32)
+    it = NDArrayIter(data, np.zeros(23), batch_size=5, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[-1].pad == 2
+    # padded rows wrap to the beginning
+    np.testing.assert_allclose(batches[-1].data[0].asnumpy()[-2:], data[:2])
+
+
+def test_ndarray_iter_discard():
+    data = np.zeros((23, 2), np.float32)
+    it = NDArrayIter(data, np.zeros(23), batch_size=5,
+                     last_batch_handle="discard")
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_dict_data():
+    it = NDArrayIter({"a": np.zeros((10, 2)), "b": np.zeros((10, 3))},
+                     np.zeros(10), batch_size=5)
+    assert sorted(d[0] for d in it.provide_data) == ["a", "b"]
+
+
+def test_resize_iter():
+    data = np.zeros((20, 2), np.float32)
+    it = ResizeIter(NDArrayIter(data, np.zeros(20), batch_size=5), size=7)
+    assert len(list(it)) == 7
+    it.reset()
+    assert len(list(it)) == 7
+
+
+def test_prefetching_iter():
+    data = np.arange(40).reshape(20, 2).astype(np.float32)
+    base = NDArrayIter(data, np.zeros(20), batch_size=5)
+    it = PrefetchingIter(base)
+    total = 0
+    for epoch in range(3):
+        got = []
+        for batch in it:
+            got.append(batch.data[0].asnumpy())
+            total += 1
+        it.reset()
+        np.testing.assert_allclose(got[0], data[:5])
+    assert total == 12
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(10, 4).astype(np.float32)
+    labels = np.arange(10).astype(np.float32)
+    dcsv = str(tmp_path / "d.csv")
+    lcsv = str(tmp_path / "l.csv")
+    np.savetxt(dcsv, data, delimiter=",")
+    np.savetxt(lcsv, labels, delimiter=",")
+    it = CSVIter(data_csv=dcsv, data_shape=(4,), label_csv=lcsv, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5],
+                               rtol=1e-5)
+
+
+def _write_idx(path, arr):
+    """Write MNIST idx format."""
+    with open(path, "wb") as f:
+        dtype_code = {np.uint8: 8}[arr.dtype.type]
+        f.write(struct.pack(">i", (dtype_code << 8) + arr.ndim))
+        for d in arr.shape:
+            f.write(struct.pack(">i", d))
+        f.write(arr.tobytes())
+
+
+def test_mnist_iter(tmp_path):
+    images = (np.random.rand(50, 28, 28) * 255).astype(np.uint8)
+    labels = np.random.randint(0, 10, 50).astype(np.uint8)
+    img_path = str(tmp_path / "img.idx")
+    lab_path = str(tmp_path / "lab.idx")
+    _write_idx(img_path, images)
+    _write_idx(lab_path, labels)
+    it = MNISTIter(image=img_path, label=lab_path, batch_size=10,
+                   shuffle=False)
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[0].data[0].shape == (10, 1, 28, 28)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy()[0, 0],
+                               images[0] / 255.0, rtol=1e-5)
+    # flat + sharded
+    it2 = MNISTIter(image=img_path, label=lab_path, batch_size=5, flat=True,
+                    shuffle=False, part_index=1, num_parts=2)
+    b = next(iter(it2))
+    assert b.data[0].shape == (5, 784)
+    np.testing.assert_allclose(b.data[0].asnumpy()[0],
+                               images[1].ravel() / 255.0, rtol=1e-5)
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    writer = recordio.MXRecordIO(path, "w")
+    payloads = [b"x" * n for n in (1, 5, 125, 1000)]
+    for p in payloads:
+        writer.write(p)
+    writer.close()
+    reader = recordio.MXRecordIO(path, "r")
+    for expected in payloads:
+        assert reader.read() == expected
+    assert reader.read() is None
+    reader.close()
+
+
+def test_indexed_recordio(tmp_path):
+    rec = str(tmp_path / "test.rec")
+    idx = str(tmp_path / "test.idx")
+    writer = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(10):
+        writer.write_idx(i, f"record{i}".encode())
+    writer.close()
+    reader = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert reader.read_idx(7) == b"record7"
+    assert reader.read_idx(2) == b"record2"
+    assert reader.keys == list(range(10))
+
+
+def test_irheader_pack_unpack():
+    hdr = recordio.IRHeader(0, 3.0, 42, 0)
+    packed = recordio.pack(hdr, b"payload")
+    hdr2, payload = recordio.unpack(packed)
+    assert hdr2.label == 3.0
+    assert hdr2.id == 42
+    assert payload == b"payload"
+    # multi-label
+    hdr = recordio.IRHeader(0, [1.0, 2.0, 3.0], 7, 0)
+    packed = recordio.pack(hdr, b"data")
+    hdr2, payload = recordio.unpack(packed)
+    np.testing.assert_allclose(hdr2.label, [1, 2, 3])
+    assert payload == b"data"

@@ -1,0 +1,121 @@
+"""KVStore aggregation correctness (rebuild of
+tests/python/unittest/test_kvstore.py + the nightly exact-sum test)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def _init_kv(kind="local"):
+    kv = mx.kv.create(kind)
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.init(KEYS, [mx.nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def _check_diff_to_scalar(arr, num):
+    np.testing.assert_allclose(arr.asnumpy(), num * np.ones(SHAPE), rtol=1e-5)
+
+
+@pytest.mark.parametrize("kind", ["local", "local_allreduce_cpu", "device"])
+def test_single_kv_pair(kind):
+    kv = _init_kv(kind)
+    kv.push(3, mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    _check_diff_to_scalar(val, 1)
+
+
+@pytest.mark.parametrize("kind", ["local", "device"])
+def test_aggregator(kind):
+    """Aggregation over 4 'devices' (reference test_kvstore.py
+    test_aggregator, using repeated values in place of GPUs)."""
+    kv = _init_kv(kind)
+    num_devs = 4
+    devs = [mx.cpu(i % 2) for i in range(num_devs)]
+    vals = [mx.nd.ones(SHAPE, ctx=d) for d in devs]
+    kv.push(3, vals)
+    outs = [mx.nd.empty(SHAPE, ctx=d) for d in devs]
+    kv.pull(3, out=outs)
+    for out in outs:
+        _check_diff_to_scalar(out, num_devs)
+    # list key push
+    list_vals = [[mx.nd.ones(SHAPE, ctx=d) * 2 for d in devs]] * len(KEYS)
+    kv.push(KEYS, list_vals)
+    list_outs = [[mx.nd.empty(SHAPE, ctx=d) for d in devs]] * len(KEYS)
+    kv.pull(KEYS, out=list_outs)
+    for outs in list_outs:
+        for out in outs:
+            _check_diff_to_scalar(out, 2 * num_devs)
+
+
+def test_updater():
+    kv = _init_kv()
+
+    def updater(key, recv, stored):
+        stored += recv * 2
+
+    kv._set_updater(updater)
+    kv.push(3, mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    _check_diff_to_scalar(val, 2)
+    num_devs = 3
+    vals = [mx.nd.ones(SHAPE, ctx=mx.cpu(i % 2)) for i in range(num_devs)]
+    kv.push(3, vals)
+    kv.pull(3, out=val)
+    _check_diff_to_scalar(val, 2 + 2 * num_devs)
+
+
+def test_set_optimizer_sgd():
+    kv = _init_kv()
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    grad = mx.nd.ones(SHAPE)
+    kv.push(3, grad)
+    w = mx.nd.empty(SHAPE)
+    kv.pull(3, out=w)
+    _check_diff_to_scalar(w, -0.1)
+
+
+def test_deterministic_sum():
+    """Exact deterministic reduction (rebuild of
+    tests/nightly/dist_sync_kvstore.py exactness assertion)."""
+    kv = _init_kv()
+    rng = np.random.RandomState(0)
+    data = [rng.randn(*SHAPE).astype(np.float32) for _ in range(4)]
+    expected = np.zeros(SHAPE, np.float64)
+    stored = np.zeros(SHAPE, np.float32)
+    for it in range(10):
+        vals = [mx.nd.array(d) for d in data]
+        kv.push(3, vals)
+        out = mx.nd.empty(SHAPE)
+        kv.pull(3, out=out)
+        stored = sum(data, start=np.zeros(SHAPE, np.float32))
+        expected = expected * 0 + stored  # assign semantics (no updater)
+        np.testing.assert_allclose(out.asnumpy(), expected.astype(np.float32),
+                                   rtol=1e-6)
+
+
+def test_dist_kvstore_single_process():
+    kv = mx.kv.create("dist_sync")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    kv.init(0, mx.nd.zeros(SHAPE))
+    kv.push(0, mx.nd.ones(SHAPE))
+    out = mx.nd.empty(SHAPE)
+    kv.pull(0, out=out)
+    _check_diff_to_scalar(out, 1)
+    kv.barrier()
+
+
+def test_optimizer_state_roundtrip(tmp_path):
+    kv = _init_kv()
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv.push(3, mx.nd.ones(SHAPE))
+    fname = str(tmp_path / "states.bin")
+    kv.save_optimizer_states(fname)
+    kv.load_optimizer_states(fname)

@@ -1,0 +1,195 @@
+"""Module API tests (rebuild of tests/python/unittest/test_module.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import DataBatch, NDArrayIter
+
+
+def _toy_data(n=256, d=10, c=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    W = rng.randn(d, c).astype(np.float32)
+    y = X.dot(W).argmax(axis=1).astype(np.float32)
+    return X, y
+
+
+def _mlp(c=3):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=c)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_module_fit_and_score():
+    X, y = _toy_data()
+    it = NDArrayIter(X, y, batch_size=32, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=15, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(), kvstore=None)
+    acc = mod.score(it, "acc")[0][1]
+    assert acc > 0.9
+
+
+def test_module_predict():
+    X, y = _toy_data(64)
+    it = NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label, for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    out = mod.predict(it)
+    assert out.shape == (64, 3)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    X, y = _toy_data(64)
+    it = NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1)
+    mod2 = mx.mod.Module.load(prefix, 1)
+    mod2.bind(it.provide_data, it.provide_label, for_training=False)
+    arg1, _ = mod.get_params()
+    arg2, _ = mod2.get_params()
+    for k in arg1:
+        np.testing.assert_allclose(arg1[k].asnumpy(), arg2[k].asnumpy())
+    # predictions identical
+    p1 = mod.predict(it).asnumpy()
+    p2 = mod2.predict(it).asnumpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_module_multi_device_matches_single():
+    X, y = _toy_data()
+    init = {}
+    shapes, _, _ = _mlp().infer_shape(data=(32, 10))
+    rng = np.random.RandomState(3)
+    for name, s in zip(_mlp().list_arguments(), shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        init[name] = mx.nd.array(rng.randn(*s) * 0.1)
+
+    results = {}
+    for ndev in (1, 2):
+        mod = mx.mod.Module(_mlp(), context=[mx.cpu(i) for i in range(ndev)])
+        mod.bind([("data", (32, 10))], [("softmax_label", (32,))])
+        mod.init_params(arg_params={k: v.copy() for k, v in init.items()},
+                        aux_params={}, initializer=None, force_init=True)
+        mod.init_optimizer(kvstore="local", optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        for i in range(5):
+            b = i * 32
+            batch = DataBatch([mx.nd.array(X[b:b + 32])],
+                              [mx.nd.array(y[b:b + 32])])
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+        arg, _ = mod.get_params()
+        results[ndev] = {k: v.asnumpy() for k, v in arg.items()}
+    for k in results[1]:
+        np.testing.assert_allclose(results[1][k], results[2][k], atol=1e-5)
+
+
+def test_module_input_grads():
+    X, y = _toy_data(32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind([("data", (32, 10))], [("softmax_label", (32,))],
+             inputs_need_grad=True)
+    mod.init_params(mx.initializer.Xavier())
+    batch = DataBatch([mx.nd.array(X[:32])], [mx.nd.array(y[:32])])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    g = mod.get_input_grads()[0].asnumpy()
+    assert g.shape == (32, 10)
+    assert np.abs(g).sum() > 0
+
+
+def test_bucketing_module():
+    # variable-length sequences padded to bucket sizes 8 and 16; weights
+    # (embedding + classifier) are shared across buckets like the
+    # reference's bucketing LM
+    rng = np.random.RandomState(0)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        emb = mx.sym.Embedding(data, input_dim=20, output_dim=6, name="emb")
+        pooled = mx.sym.mean(emb, axis=(1,))
+        fc = mx.sym.FullyConnected(pooled, name="fc", num_hidden=4)
+        sm = mx.sym.SoftmaxOutput(fc, name="softmax")
+        return sm, ["data"], ["softmax_label"]
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=16,
+                                 context=mx.cpu())
+    from mxnet_tpu.io import DataDesc
+
+    mod.bind([DataDesc("data", (8, 16))], [DataDesc("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=None,
+                       optimizer_params={"learning_rate": 0.1})
+    for key in (16, 8, 16, 8):
+        batch = DataBatch([mx.nd.array(rng.randint(0, 20, (8, key)))],
+                          [mx.nd.array(rng.randint(0, 4, 8))],
+                          bucket_key=key,
+                          provide_data=[DataDesc("data", (8, key))],
+                          provide_label=[DataDesc("softmax_label", (8,))])
+        mod.forward(batch, is_train=True)
+        assert mod.get_outputs()[0].shape == (8, 4)
+        mod.backward()
+        mod.update()
+    # params shared: emb weight identical across bucket modules
+    w16 = mod._buckets[16]._exec_group.execs[0].arg_dict["emb_weight"].asnumpy()
+    w8 = mod._buckets[8]._exec_group.execs[0].arg_dict["emb_weight"].asnumpy()
+    np.testing.assert_allclose(w16, w8, atol=1e-6)
+
+
+def test_sequential_module():
+    X, y = _toy_data(64)
+    net1 = mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc1",
+                                 num_hidden=8)
+    net1 = mx.sym.Activation(net1, act_type="relu", name="a1")
+    net2_data = mx.sym.Variable("fc1_act")
+    net2 = mx.sym.FullyConnected(net2_data, name="fc2", num_hidden=3)
+    net2 = mx.sym.SoftmaxOutput(net2, name="softmax")
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net1, label_names=[], context=mx.cpu()))
+    seq.add(mx.mod.Module(net2, data_names=["fc1_act"], context=mx.cpu()),
+            take_labels=True, auto_wiring=True)
+    it = NDArrayIter(X, y, batch_size=16)
+    seq.bind(it.provide_data, it.provide_label)
+    seq.init_params(mx.initializer.Xavier())
+    seq.init_optimizer(kvstore=None,
+                       optimizer_params={"learning_rate": 0.5})
+    metric = mx.metric.create("acc")
+    for epoch in range(4):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+            seq.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.6
+
+
+def test_feedforward_save_load(tmp_path):
+    np.random.seed(5)
+    X, y = _toy_data(128)
+    model = mx.FeedForward(_mlp(), ctx=mx.cpu(), num_epoch=10,
+                           learning_rate=0.3, momentum=0.9,
+                           initializer=mx.initializer.Xavier())
+    model.fit(X, y)
+    acc = model.score(X, y)
+    assert acc > 0.8
+    prefix = str(tmp_path / "ff")
+    model.save(prefix)
+    model2 = mx.FeedForward.load(prefix, 10, ctx=mx.cpu())
+    p1 = model.predict(X)
+    p2 = model2.predict(X)
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)

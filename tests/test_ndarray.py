@@ -1,0 +1,174 @@
+"""NDArray vs numpy semantics (rebuild of tests/python/unittest/test_ndarray.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_ndarray_creation():
+    a = mx.nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert (a.asnumpy() == 0).all()
+    b = mx.nd.ones((2, 2), dtype="float64")
+    assert b.dtype == np.float64
+    c = mx.nd.full((2,), 7.0)
+    assert (c.asnumpy() == 7).all()
+    d = mx.nd.array([[1, 2], [3, 4]])
+    assert d.dtype == np.float32
+    assert d.asnumpy().tolist() == [[1, 2], [3, 4]]
+
+
+def test_ndarray_elementwise():
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        x = rng.randn(4, 5).astype(np.float32)
+        y = rng.rand(4, 5).astype(np.float32) + 0.5
+        a, b = mx.nd.array(x), mx.nd.array(y)
+        np.testing.assert_allclose((a + b).asnumpy(), x + y, rtol=1e-6)
+        np.testing.assert_allclose((a - b).asnumpy(), x - y, rtol=1e-6)
+        np.testing.assert_allclose((a * b).asnumpy(), x * y, rtol=1e-6)
+        np.testing.assert_allclose((a / b).asnumpy(), x / y, rtol=1e-5)
+        np.testing.assert_allclose((a + 2).asnumpy(), x + 2, rtol=1e-6)
+        np.testing.assert_allclose((2 - a).asnumpy(), 2 - x, rtol=1e-6)
+        np.testing.assert_allclose((a / 2).asnumpy(), x / 2, rtol=1e-6)
+        np.testing.assert_allclose((2 / b).asnumpy(), 2 / y, rtol=1e-5)
+        np.testing.assert_allclose((-a).asnumpy(), -x, rtol=1e-6)
+        np.testing.assert_allclose(mx.nd.sqrt(b).asnumpy(), np.sqrt(y), rtol=1e-6)
+        np.testing.assert_allclose(mx.nd.square(a).asnumpy(), x * x, rtol=1e-6)
+        np.testing.assert_allclose(mx.nd.exp(a).asnumpy(), np.exp(x), rtol=1e-5)
+
+
+def test_ndarray_inplace():
+    a = mx.nd.ones((2, 3))
+    a += 2
+    np.testing.assert_allclose(a.asnumpy(), np.full((2, 3), 3.0))
+    a *= 2
+    np.testing.assert_allclose(a.asnumpy(), np.full((2, 3), 6.0))
+    a -= 1
+    a /= 5
+    np.testing.assert_allclose(a.asnumpy(), np.ones((2, 3)))
+
+
+def test_ndarray_setitem():
+    a = mx.nd.zeros((3, 4))
+    a[:] = 2.5
+    assert (a.asnumpy() == 2.5).all()
+    a[1] = 1.0
+    expected = np.full((3, 4), 2.5)
+    expected[1] = 1.0
+    np.testing.assert_allclose(a.asnumpy(), expected)
+    a[0:2] = 0.0
+    expected[0:2] = 0.0
+    np.testing.assert_allclose(a.asnumpy(), expected)
+    a[:] = np.arange(12).reshape(3, 4)
+    np.testing.assert_allclose(a.asnumpy(), np.arange(12).reshape(3, 4))
+
+
+def test_ndarray_slicing():
+    x = np.arange(24).reshape(4, 6).astype(np.float32)
+    a = mx.nd.array(x)
+    np.testing.assert_allclose(a[1].asnumpy(), x[1])
+    np.testing.assert_allclose(a[1:3].asnumpy(), x[1:3])
+    np.testing.assert_allclose(a[:, 2].asnumpy(), x[:, 2])
+    assert a[2, 3].asscalar() == x[2, 3]
+
+
+def test_ndarray_reshape_transpose():
+    x = np.arange(12).reshape(3, 4).astype(np.float32)
+    a = mx.nd.array(x)
+    np.testing.assert_allclose(a.reshape((4, 3)).asnumpy(), x.reshape(4, 3))
+    np.testing.assert_allclose(a.T.asnumpy(), x.T)
+    np.testing.assert_allclose(
+        mx.nd.transpose(a, axes=(1, 0)).asnumpy(), x.T)
+
+
+def test_ndarray_dot():
+    x = np.random.rand(3, 4).astype(np.float32)
+    y = np.random.rand(4, 5).astype(np.float32)
+    out = mx.nd.dot(mx.nd.array(x), mx.nd.array(y))
+    np.testing.assert_allclose(out.asnumpy(), x.dot(y), rtol=1e-5)
+
+
+def test_ndarray_reduce():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    a = mx.nd.array(x)
+    np.testing.assert_allclose(mx.nd.sum(a).asnumpy(),
+                               [x.sum()], rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.sum(a, axis=(1,)).asnumpy(),
+                               x.sum(axis=1), rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.max(a, axis=(0, 2)).asnumpy(),
+                               x.max(axis=(0, 2)), rtol=1e-5)
+
+
+def test_ndarray_copy():
+    a = mx.nd.array(np.random.rand(3, 3))
+    b = a.copy()
+    b[:] = 0
+    assert not (a.asnumpy() == 0).all()
+    c = mx.nd.zeros((3, 3))
+    a.copyto(c)
+    np.testing.assert_allclose(a.asnumpy(), c.asnumpy())
+
+
+def test_ndarray_context():
+    a = mx.nd.ones((2, 2), ctx=mx.cpu(1))
+    assert a.context == mx.cpu(1)
+    b = a.as_in_context(mx.cpu(0))
+    assert b.context == mx.cpu(0)
+    np.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+    with pytest.raises(mx.MXNetError):
+        _ = a + mx.nd.ones((2, 2), ctx=mx.cpu(0))
+
+
+def test_ndarray_saveload(tmp_path):
+    fname = str(tmp_path / "nd.npz")
+    data = [mx.nd.array(np.random.rand(3, 3)) for _ in range(3)]
+    mx.nd.save(fname, data)
+    loaded = mx.nd.load(fname)
+    assert len(loaded) == 3
+    for a, b in zip(data, loaded):
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+    dmap = {"w": data[0], "b": data[1]}
+    mx.nd.save(fname, dmap)
+    loaded = mx.nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    np.testing.assert_allclose(loaded["w"].asnumpy(), data[0].asnumpy())
+
+
+def test_ndarray_bf16_saveload(tmp_path):
+    fname = str(tmp_path / "bf.npz")
+    a = mx.nd.array(np.random.rand(4, 4), dtype="bfloat16")
+    mx.nd.save(fname, {"a": a})
+    out = mx.nd.load(fname)["a"]
+    assert out.dtype == mx.base.np_dtype("bfloat16")
+    np.testing.assert_allclose(out.astype("float32").asnumpy(),
+                               a.astype("float32").asnumpy())
+
+
+def test_onehot_encode():
+    idx = mx.nd.array([1, 0, 2])
+    out = mx.nd.zeros((3, 3))
+    mx.nd.onehot_encode(idx, out)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.eye(3)[[1, 0, 2]])
+
+
+def test_ndarray_comparison():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([2.0, 2.0, 2.0])
+    np.testing.assert_allclose((a > b).asnumpy(), [0, 0, 1])
+    np.testing.assert_allclose((a == b).asnumpy(), [0, 1, 0])
+    np.testing.assert_allclose((a <= 2).asnumpy(), [1, 1, 0])
+
+
+def test_clip_and_sample():
+    a = mx.nd.array(np.linspace(-5, 5, 11))
+    np.testing.assert_allclose(mx.nd.clip(a, a_min=-2, a_max=2).asnumpy(),
+                               np.clip(np.linspace(-5, 5, 11), -2, 2))
+    mx.random.seed(42)
+    u = mx.random.uniform(0, 1, shape=(1000,))
+    assert 0.4 < float(u.asnumpy().mean()) < 0.6
+    n = mx.random.normal(0, 1, shape=(1000,))
+    assert abs(float(n.asnumpy().mean())) < 0.15

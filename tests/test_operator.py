@@ -1,0 +1,333 @@
+"""Operator correctness: forward values + numeric gradient checks
+(rebuild of tests/python/unittest/test_operator.py using the ported
+check_numeric_gradient / check_symbolic_forward from test_utils)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (check_numeric_gradient,
+                                  check_symbolic_forward, reldiff)
+
+rng = np.random.RandomState(7)
+
+
+def test_elemwise_forward_backward():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    for sym, fn in [(a + b, np.add), (a * b, np.multiply),
+                    (a - b, np.subtract)]:
+        x = rng.randn(3, 4)
+        y = rng.randn(3, 4)
+        check_symbolic_forward(sym, {"a": x, "b": y}, [fn(x, y)])
+        check_numeric_gradient(sym, {"a": x, "b": y})
+
+
+def test_unary_ops_grad():
+    x = rng.rand(3, 4) + 0.5
+    data = mx.sym.Variable("data")
+    for sym in [mx.sym.sqrt(data), mx.sym.exp(data), mx.sym.log(data),
+                mx.sym.tanh(data), mx.sym.sigmoid(data), mx.sym.square(data)]:
+        check_numeric_gradient(sym, {"data": x}, numeric_eps=1e-4)
+
+
+def test_fully_connected():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    x = rng.randn(5, 3)
+    check_numeric_gradient(fc, {"data": x,
+                                "fc_weight": rng.randn(4, 3),
+                                "fc_bias": rng.randn(4)})
+
+
+def test_convolution_grad():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=2, pad=(1, 1),
+                              name="conv")
+    x = rng.randn(2, 3, 5, 5)
+    check_numeric_gradient(conv, {"data": x,
+                                  "conv_weight": rng.randn(2, 3, 3, 3) * 0.3,
+                                  "conv_bias": rng.randn(2) * 0.3},
+                           numeric_eps=1e-3, check_eps=0.05)
+
+
+def test_conv_matches_reference_impl():
+    # conv forward vs explicit im2col computation
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=3, no_bias=True,
+                              name="c")
+    out = mx.test_utils.simple_forward(conv, data=x, c_weight=w)
+    ref = np.zeros((1, 3, 2, 2), np.float32)
+    for o in range(3):
+        for i in range(2):
+            for p in range(2):
+                for q in range(2):
+                    ref[0, o, p, q] += (x[0, i, p:p + 3, q:q + 3]
+                                        * w[o, i]).sum()
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_pooling():
+    data = mx.sym.Variable("data")
+    x = rng.randn(1, 1, 4, 4)
+    maxp = mx.sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    out = mx.test_utils.simple_forward(maxp, data=x)
+    ref = x.reshape(1, 1, 2, 2, 2, 2).transpose(0, 1, 2, 4, 3, 5).reshape(
+        1, 1, 2, 2, 4).max(axis=-1)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    avgp = mx.sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    out = mx.test_utils.simple_forward(avgp, data=x)
+    np.testing.assert_allclose(
+        out, x.reshape(1, 1, 2, 2, 2, 2).transpose(0, 1, 2, 4, 3, 5).reshape(
+            1, 1, 2, 2, 4).mean(axis=-1), rtol=1e-5)
+    check_numeric_gradient(maxp, {"data": rng.randn(1, 1, 6, 6)})
+
+
+def test_batchnorm_train_forward():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, fix_gamma=False, name="bn")
+    x = rng.randn(8, 3, 2, 2).astype(np.float32)
+    exe = bn.simple_bind(mx.cpu(), data=x.shape)
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["bn_gamma"][:] = 1.0
+    exe.arg_dict["bn_beta"][:] = 0.0
+    exe.aux_dict["bn_moving_var"][:] = 1.0
+    out = exe.forward(is_train=True)[0].asnumpy()
+    mean = x.mean(axis=(0, 2, 3), keepdims=True)
+    var = x.var(axis=(0, 2, 3), keepdims=True)
+    ref = (x - mean) / np.sqrt(var + 1e-3)
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+    # aux updated
+    assert np.abs(exe.aux_dict["bn_moving_mean"].asnumpy()).sum() > 0
+
+
+def test_activation_leakyrelu():
+    data = mx.sym.Variable("data")
+    x = rng.randn(4, 4)
+    lr = mx.sym.LeakyReLU(data, act_type="leaky", slope=0.1)
+    out = mx.test_utils.simple_forward(lr, data=x)
+    np.testing.assert_allclose(out, np.where(x > 0, x, 0.1 * x), rtol=1e-5)
+    elu = mx.sym.LeakyReLU(data, act_type="elu", slope=0.5)
+    out = mx.test_utils.simple_forward(elu, data=x)
+    np.testing.assert_allclose(out, np.where(x > 0, x, 0.5 * (np.exp(x) - 1)),
+                               rtol=1e-5)
+
+
+def test_softmax_output_grad():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    sm = mx.sym.SoftmaxOutput(data, label, name="sm")
+    x = rng.randn(4, 5)
+    lab = np.array([0, 2, 1, 4], np.float32)
+    exe = sm.simple_bind(mx.cpu(), grad_req={"data": "write", "label": "null"},
+                         data=(4, 5), label=(4,))
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["label"][:] = lab
+    out = exe.forward(is_train=True)[0].asnumpy()
+    exe.backward()
+    g = exe.grad_dict["data"].asnumpy()
+    ex = np.exp(x - x.max(axis=1, keepdims=True))
+    p = ex / ex.sum(axis=1, keepdims=True)
+    onehot = np.eye(5)[lab.astype(int)]
+    np.testing.assert_allclose(out, p, rtol=1e-5)
+    np.testing.assert_allclose(g, p - onehot, rtol=1e-4, atol=1e-6)
+
+
+def test_regression_outputs():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    x = rng.randn(4, 3)
+    y = rng.randn(4, 3)
+    lin = mx.sym.LinearRegressionOutput(data, label)
+    exe = lin.simple_bind(mx.cpu(), grad_req={"data": "write", "label": "null"},
+                          data=(4, 3), label=(4, 3))
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["label"][:] = y
+    out = exe.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-6)
+    exe.backward()
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), (x - y) / 4,
+                               rtol=1e-5)
+    logi = mx.sym.LogisticRegressionOutput(data, label)
+    out = mx.test_utils.simple_forward(logi, data=x, label=y)
+    np.testing.assert_allclose(out, 1 / (1 + np.exp(-x)), rtol=1e-5)
+
+
+def test_block_grad():
+    data = mx.sym.Variable("data")
+    blocked = mx.sym.BlockGrad(data * 2)
+    out = blocked + data
+    exe = out.simple_bind(mx.cpu(), data=(3,))
+    exe.arg_dict["data"][:] = [1, 2, 3]
+    exe.forward(is_train=True)
+    exe.backward([mx.nd.ones((3,))])
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), [1, 1, 1])
+
+
+def test_embedding():
+    data = mx.sym.Variable("data")
+    emb = mx.sym.Embedding(data, input_dim=6, output_dim=3, name="emb")
+    w = rng.randn(6, 3)
+    idx = np.array([1, 3, 5, 0], np.float32)
+    out = mx.test_utils.simple_forward(emb, data=idx, emb_weight=w)
+    np.testing.assert_allclose(out, w[idx.astype(int)], rtol=1e-5)
+    # scatter-add backward
+    exe = emb.simple_bind(mx.cpu(), grad_req={"data": "null",
+                                              "emb_weight": "write"},
+                          data=(4,), emb_weight=(6, 3))
+    exe.arg_dict["data"][:] = np.array([1, 1, 2, 0], np.float32)
+    exe.arg_dict["emb_weight"][:] = w
+    exe.forward(is_train=True)
+    exe.backward([mx.nd.ones((4, 3))])
+    g = exe.grad_dict["emb_weight"].asnumpy()
+    expected = np.zeros((6, 3))
+    for i in [1, 1, 2, 0]:
+        expected[i] += 1
+    np.testing.assert_allclose(g, expected, rtol=1e-5)
+
+
+def test_concat_slicechannel_roundtrip():
+    data = mx.sym.Variable("data")
+    parts = mx.sym.SliceChannel(data, num_outputs=2, axis=1, name="sl")
+    cat = mx.sym.Concat(parts[0], parts[1], num_args=2, dim=1)
+    x = rng.randn(2, 4, 3)
+    out = mx.test_utils.simple_forward(cat, data=x)
+    np.testing.assert_allclose(out, x, rtol=1e-6)
+
+
+def test_transpose_swapaxis_flip():
+    data = mx.sym.Variable("data")
+    x = rng.randn(2, 3, 4)
+    out = mx.test_utils.simple_forward(mx.sym.transpose(data, axes=(2, 0, 1)),
+                                       data=x)
+    np.testing.assert_allclose(out, x.transpose(2, 0, 1))
+    out = mx.test_utils.simple_forward(mx.sym.SwapAxis(data, dim1=0, dim2=2),
+                                       data=x)
+    np.testing.assert_allclose(out, x.swapaxes(0, 2))
+    out = mx.test_utils.simple_forward(mx.sym.flip(data, axis=1), data=x)
+    np.testing.assert_allclose(out, x[:, ::-1])
+
+
+def test_sequence_ops():
+    x = rng.randn(4, 3, 2).astype(np.float32)  # (T, N, D)
+    lengths = np.array([2, 4, 1], np.float32)
+    data = mx.sym.Variable("data")
+    sl = mx.sym.Variable("sl")
+    last = mx.sym.SequenceLast(data, sl, use_sequence_length=True)
+    out = mx.test_utils.simple_forward(last, data=x, sl=lengths)
+    expected = np.stack([x[1, 0], x[3, 1], x[0, 2]])
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+    mask = mx.sym.SequenceMask(data, sl, use_sequence_length=True, value=-1.0)
+    out = mx.test_utils.simple_forward(mask, data=x, sl=lengths)
+    assert (out[2, 0] == -1).all() and (out[1, 2] == -1).all()
+    np.testing.assert_allclose(out[0], x[0], rtol=1e-5)
+
+    rev = mx.sym.SequenceReverse(data, sl, use_sequence_length=True)
+    out = mx.test_utils.simple_forward(rev, data=x, sl=lengths)
+    np.testing.assert_allclose(out[0, 0], x[1, 0], rtol=1e-5)
+    np.testing.assert_allclose(out[0, 1], x[3, 1], rtol=1e-5)
+    np.testing.assert_allclose(out[0, 2], x[0, 2], rtol=1e-5)
+
+
+def test_dropout():
+    data = mx.sym.Variable("data")
+    do = mx.sym.Dropout(data, p=0.5)
+    x = np.ones((200, 200), np.float32)
+    exe = do.simple_bind(mx.cpu(), data=x.shape, grad_req="null")
+    exe.arg_dict["data"][:] = x
+    out_train = exe.forward(is_train=True)[0].asnumpy()
+    frac = (out_train == 0).mean()
+    assert 0.4 < frac < 0.6
+    kept = out_train[out_train != 0]
+    np.testing.assert_allclose(kept, 2.0, rtol=1e-5)
+    out_eval = exe.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out_eval, x)
+
+
+def test_reduce_grads():
+    data = mx.sym.Variable("data")
+    x = rng.randn(3, 4)
+    check_numeric_gradient(mx.sym.sum(data, axis=(1,)), {"data": x})
+    check_numeric_gradient(mx.sym.mean(data), {"data": x})
+
+
+def test_broadcast_ops():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    x = rng.randn(3, 4)
+    y = rng.randn(1, 4)
+    out = mx.test_utils.simple_forward(mx.sym.broadcast_plus(a, b), a=x, b=y)
+    np.testing.assert_allclose(out, x + y, rtol=1e-6)
+    check_numeric_gradient(mx.sym.broadcast_mul(a, b), {"a": x, "b": y})
+
+
+def test_dot_batchdot():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    x = rng.randn(3, 4)
+    y = rng.randn(4, 5)
+    out = mx.test_utils.simple_forward(mx.sym.dot(a, b), a=x, b=y)
+    np.testing.assert_allclose(out, x.dot(y), rtol=1e-5)
+    xb = rng.randn(2, 3, 4)
+    yb = rng.randn(2, 4, 5)
+    out = mx.test_utils.simple_forward(mx.sym.batch_dot(a, b), a=xb, b=yb)
+    np.testing.assert_allclose(out, np.einsum("bij,bjk->bik", xb, yb),
+                               rtol=1e-5)
+    check_numeric_gradient(mx.sym.dot(a, b), {"a": x, "b": y})
+
+
+def test_upsampling_nearest():
+    data = mx.sym.Variable("data")
+    up = mx.sym.UpSampling(data, scale=2, sample_type="nearest")
+    x = rng.randn(1, 2, 2, 2)
+    out = mx.test_utils.simple_forward(up, data=x)
+    assert out.shape == (1, 2, 4, 4)
+    np.testing.assert_allclose(out[0, 0, :2, :2],
+                               np.full((2, 2), x[0, 0, 0, 0]), rtol=1e-6)
+
+
+def test_lrn_instance_norm_l2norm():
+    data = mx.sym.Variable("data")
+    x = rng.randn(2, 4, 3, 3).astype(np.float32)
+    lrn = mx.sym.LRN(data, nsize=3)
+    out = mx.test_utils.simple_forward(lrn, data=x)
+    assert out.shape == x.shape
+    inorm = mx.sym.InstanceNorm(data, name="in")
+    out = mx.test_utils.simple_forward(
+        inorm, data=x, in_gamma=np.ones(4, np.float32),
+        in_beta=np.zeros(4, np.float32))
+    np.testing.assert_allclose(out.mean(axis=(2, 3)), 0, atol=1e-4)
+    l2 = mx.sym.L2Normalization(data)
+    out = mx.test_utils.simple_forward(l2, data=x)
+    norms = np.sqrt((out.reshape(2, -1) ** 2).sum(axis=1))
+    np.testing.assert_allclose(norms, 1.0, rtol=1e-4)
+
+
+def test_smooth_l1_and_maeregression():
+    data = mx.sym.Variable("data")
+    x = np.array([-2.0, -0.5, 0.0, 0.5, 2.0], np.float32)
+    out = mx.test_utils.simple_forward(mx.sym.smooth_l1(data, sigma=1.0),
+                                       data=x)
+    expected = np.where(np.abs(x) < 1, 0.5 * x * x, np.abs(x) - 0.5)
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_cast():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Cast(data, dtype="float16")
+    out = mx.test_utils.simple_forward(c, data=np.ones((2, 2), np.float32))
+    assert out.dtype == np.float16
+
+
+def test_makeloss_grad_scale():
+    data = mx.sym.Variable("data")
+    loss = mx.sym.MakeLoss(mx.sym.square(data), grad_scale=2.0)
+    exe = loss.simple_bind(mx.cpu(), data=(3,))
+    exe.arg_dict["data"][:] = [1.0, 2.0, 3.0]
+    exe.forward(is_train=True)
+    exe.backward()
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                               2 * 2 * np.array([1, 2, 3.0]), rtol=1e-5)

@@ -1,0 +1,113 @@
+"""Optimizer update rules vs numpy references
+(rebuild of optimizer coverage in tests/python/unittest)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _run_steps(opt, w0, grads, index=0):
+    w = mx.nd.array(w0.copy())
+    state = opt.create_state(index, w)
+    for g in grads:
+        opt.update(index, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_no_momentum():
+    w0 = np.array([1.0, 2.0], np.float32)
+    grads = [np.array([0.5, -0.5], np.float32)] * 3
+    opt = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0)
+    out = _run_steps(opt, w0, grads)
+    ref = w0.copy()
+    for g in grads:
+        ref -= 0.1 * g
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_sgd_momentum_wd():
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(4).astype(np.float32)
+    grads = [rng.randn(4).astype(np.float32) for _ in range(5)]
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                           rescale_grad=1.0, param_idx2name={0: "w_weight"})
+    out = _run_steps(opt, w0, grads)
+    ref, mom = w0.copy(), np.zeros(4, np.float32)
+    for g in grads:
+        geff = g + 0.01 * ref
+        mom = 0.9 * mom - 0.1 * geff
+        ref = ref + mom
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_sgd_clip_and_rescale():
+    w0 = np.zeros(3, np.float32)
+    grads = [np.array([10.0, -10.0, 0.1], np.float32)]
+    opt = mx.optimizer.SGD(learning_rate=1.0, rescale_grad=0.5,
+                           clip_gradient=1.0)
+    out = _run_steps(opt, w0, grads)
+    np.testing.assert_allclose(out, [-1.0, 1.0, -0.05], rtol=1e-5)
+
+
+def test_adam():
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(4).astype(np.float32)
+    grads = [rng.randn(4).astype(np.float32) for _ in range(4)]
+    opt = mx.optimizer.Adam(learning_rate=0.01, rescale_grad=1.0)
+    out = _run_steps(opt, w0, grads)
+    ref = w0.copy().astype(np.float64)
+    m = np.zeros(4)
+    v = np.zeros(4)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t, g in enumerate(grads, 1):
+        lr_t = 0.01 * np.sqrt(1 - b2**t) / (1 - b1**t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        ref -= lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_rmsprop_adagrad_adadelta_run():
+    rng = np.random.RandomState(2)
+    w0 = rng.randn(6).astype(np.float32)
+    grads = [rng.randn(6).astype(np.float32) for _ in range(5)]
+    for name in ("rmsprop", "adagrad", "adadelta", "nag", "sgld"):
+        opt = mx.optimizer.create(name, rescale_grad=1.0)
+        out = _run_steps(opt, w0, grads)
+        assert np.isfinite(out).all()
+        assert not np.allclose(out, w0)
+
+
+def test_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(5) == 1.0
+    assert sched(11) == 0.5
+    assert sched(25) == 0.25
+    msched = mx.lr_scheduler.MultiFactorScheduler(step=[4, 8], factor=0.1)
+    msched.base_lr = 1.0
+    assert msched(2) == 1.0
+    assert abs(msched(5) - 0.1) < 1e-12
+    assert abs(msched(9) - 0.01) < 1e-12
+
+
+def test_lr_wd_mult_via_attrs():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("fc_weight", lr_mult=2.0)
+    fc = mx.sym.FullyConnected(data, weight=w, num_hidden=2, name="fc")
+    out = mx.sym.SoftmaxOutput(fc)
+    opt = mx.optimizer.SGD(learning_rate=0.1, sym=out,
+                           param_idx2name={0: "fc_weight"}, rescale_grad=1.0)
+    assert opt._get_lr(0) == pytest.approx(0.2)
+    # bias defaults to wd 0
+    opt2 = mx.optimizer.SGD(wd=0.1, param_idx2name={0: "fc_bias"})
+    assert opt2._get_wd(0) == 0.0
+
+
+def test_get_updater():
+    opt = mx.optimizer.SGD(learning_rate=0.5, rescale_grad=1.0)
+    updater = mx.optimizer.get_updater(opt)
+    w = mx.nd.ones((2,))
+    updater(0, mx.nd.ones((2,)), w)
+    np.testing.assert_allclose(w.asnumpy(), [0.5, 0.5], rtol=1e-6)

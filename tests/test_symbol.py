@@ -1,0 +1,117 @@
+"""Symbol composition / serialization (rebuild of test_symbol.py)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=10)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=5)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_symbol_basic():
+    net = _mlp()
+    assert net.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias", "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_symbol_compose():
+    data = mx.sym.Variable("data")
+    net1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=10)
+    net1 = mx.sym.FullyConnected(net1, name="fc2", num_hidden=100)
+    assert net1.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                     "fc2_weight", "fc2_bias"]
+    net2 = mx.sym.FullyConnected(mx.sym.Variable("data2"), name="fc3",
+                                 num_hidden=10)
+    net2 = mx.sym.Activation(net2, act_type="relu")
+    net2 = mx.sym.FullyConnected(net2, name="fc4", num_hidden=20)
+    composed = net2(data2=net1, name="composed")
+    args = composed.list_arguments()
+    assert "fc1_weight" in args and "fc3_weight" in args
+    assert "data2" not in args
+
+
+def test_symbol_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    outs = internals.list_outputs()
+    assert "fc1_output" in outs
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_symbol_json_roundtrip(tmp_path):
+    net = _mlp()
+    js = net.tojson()
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    assert json.loads(net2.tojson()) == json.loads(js)
+    fname = str(tmp_path / "sym.json")
+    net.save(fname)
+    net3 = mx.sym.load(fname)
+    assert net3.list_arguments() == net.list_arguments()
+
+
+def test_symbol_group():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, name="fc", num_hidden=4)
+    act = mx.sym.Activation(fc, act_type="relu", name="act")
+    g = mx.sym.Group([fc, act])
+    assert g.list_outputs() == ["fc_output", "act_output"]
+    assert len(g) == 2
+
+
+def test_symbol_arith():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = 2 * a + b / a - 1
+    exe = c.simple_bind(mx.cpu(), a=(3,), b=(3,))
+    exe.arg_dict["a"][:] = [1, 2, 4]
+    exe.arg_dict["b"][:] = [2, 2, 2]
+    out = exe.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, 2 * np.array([1, 2, 4.0])
+                               + np.array([2, 1, 0.5]) - 1)
+
+
+def test_symbol_multi_output_index():
+    data = mx.sym.Variable("data")
+    parts = mx.sym.SliceChannel(data, num_outputs=3, axis=1, name="sl")
+    assert len(parts) == 3
+    assert parts[1].list_outputs() == ["sl_output1"]
+
+
+def test_aux_states_listed():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn")
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    assert "bn_moving_mean" not in bn.list_arguments()
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = mx.sym.Variable("a")
+        fc = mx.sym.FullyConnected(a, num_hidden=3, name="fc")
+    assert a.attr("ctx_group") == "dev1"
+    assert fc.attr("ctx_group") == "dev1"
+    b = mx.sym.Variable("b")
+    assert b.attr("ctx_group") is None
+
+
+def test_attr_dict_json():
+    with mx.AttrScope(lr_mult="2"):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    d = fc.attr_dict()
+    assert d["fc"]["lr_mult"] == "2"
+    js = fc.tojson()
+    fc2 = mx.sym.load_json(js)
+    assert fc2.attr_dict()["fc"]["lr_mult"] == "2"

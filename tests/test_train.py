@@ -1,0 +1,86 @@
+"""Training convergence gates (rebuild of tests/python/train/test_mlp.py /
+test_conv.py, on synthetic data — no dataset downloads in CI)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import NDArrayIter
+
+
+def _synthetic_images(n=512, c=10, seed=0):
+    """Separable image-like task: class-dependent bar pattern + noise."""
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, 1, 28, 28), np.float32)
+    y = rng.randint(0, c, n)
+    for i in range(n):
+        X[i, 0, y[i] * 2:y[i] * 2 + 3, 5:20] = 1.0
+    X += rng.randn(*X.shape).astype(np.float32) * 0.1
+    return X, y.astype(np.float32)
+
+
+def test_mlp_convergence():
+    X, y = _synthetic_images(512)
+    Xf = X.reshape(512, -1)
+    train = NDArrayIter(Xf[:384], y[:384], batch_size=64, shuffle=True)
+    val = NDArrayIter(Xf[384:], y[384:], batch_size=64)
+    model = mx.FeedForward(mx.models.mlp(), ctx=mx.cpu(), num_epoch=6,
+                           learning_rate=0.2, momentum=0.9,
+                           initializer=mx.initializer.Xavier())
+    model.fit(train, eval_data=val)
+    acc = model.score(val)
+    assert acc > 0.95, f"mlp accuracy {acc} below gate"
+
+
+def test_lenet_convergence():
+    X, y = _synthetic_images(512)
+    train = NDArrayIter(X[:384], y[:384], batch_size=64, shuffle=True)
+    val = NDArrayIter(X[384:], y[384:], batch_size=64)
+    model = mx.FeedForward(mx.models.lenet(), ctx=mx.cpu(), num_epoch=3,
+                           learning_rate=0.1, momentum=0.9,
+                           initializer=mx.initializer.Xavier())
+    model.fit(train, eval_data=val)
+    acc = model.score(val)
+    assert acc > 0.95, f"lenet accuracy {acc} below gate"
+
+
+def test_bf16_training():
+    """bfloat16 data path (the TPU-native half type; rebuild of
+    tests/python/train/test_dtype.py's fp16 intent)."""
+    X, y = _synthetic_images(256)
+    Xf = X.reshape(256, -1)
+    train = NDArrayIter(Xf, y, batch_size=64, shuffle=True)
+    data = mx.sym.Variable("data")
+    net = mx.sym.Cast(data, dtype="bfloat16")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Cast(net, dtype="float32")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=6,
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(), kvstore=None)
+    acc = mod.score(train, "acc")[0][1]
+    assert acc > 0.9, f"bf16 accuracy {acc} below gate"
+
+
+def test_checkpoint_resume(tmp_path):
+    """Train, checkpoint, resume, continue — loss keeps improving
+    (checkpoint/resume contract, SURVEY.md §5)."""
+    X, y = _synthetic_images(256)
+    Xf = X.reshape(256, -1)
+    train = NDArrayIter(Xf, y, batch_size=64, shuffle=True)
+    prefix = str(tmp_path / "ckpt")
+    model = mx.FeedForward(mx.models.mlp(), ctx=mx.cpu(), num_epoch=2,
+                           learning_rate=0.2, momentum=0.9,
+                           initializer=mx.initializer.Xavier())
+    model.fit(train, epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    acc1 = model.score(train)
+    model2 = mx.FeedForward.load(prefix, 2, ctx=mx.cpu(), num_epoch=4,
+                                 learning_rate=0.2, momentum=0.9)
+    acc_loaded = model2.score(train)
+    assert abs(acc_loaded - acc1) < 0.05
+    model2.fit(train)
+    acc2 = model2.score(train)
+    assert acc2 >= acc1 - 0.05
