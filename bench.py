@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Benchmark harness: ResNet-50 training throughput on the local chip(s).
+
+Prints ONE JSON line:
+  {"metric": "resnet50_train_throughput", "value": N,
+   "unit": "images/sec/chip", "vs_baseline": N}
+
+Baseline: BASELINE.md's north star is ">= A100-class img/sec/chip" for
+ResNet-50 ImageNet training; A100 mixed-precision ResNet-50 training
+is ~2500 img/s/chip (MLPerf-era public number), so vs_baseline =
+value / 2500.  Data is synthetic device-resident (the harness measures
+the compute path, like the reference's benchmark.py synthetic mode —
+example/image-classification/benchmark.py).
+"""
+
+import json
+import os
+import sys
+import time
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 2500.0
+
+
+def main():
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    n_chips = len(jax.devices())
+
+    if on_tpu:
+        batch_per_chip = int(os.environ.get("BENCH_BATCH", "256"))
+        image_hw = 224
+        dtype = "bfloat16"
+        n_warmup, n_iter = 5, 20
+    else:  # CPU smoke mode: tiny shapes so the harness itself is testable
+        batch_per_chip = 8
+        image_hw = 32
+        dtype = "float32"
+        n_warmup, n_iter = 2, 5
+
+    batch = batch_per_chip * n_chips
+    net = mx.models.resnet(num_classes=1000, num_layers=50,
+                           image_shape=(3, image_hw, image_hw))
+
+    mesh = mx.parallel.local_mesh("dp")
+    trainer = mx.parallel.ShardedTrainer(
+        net,
+        {"data": (batch, 3, image_hw, image_hw), "softmax_label": (batch,)},
+        mesh=mesh,
+        optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                          factor_type="in", magnitude=2),
+        dtype=dtype,
+    )
+
+    rng = np.random.RandomState(0)
+    data = rng.uniform(-1, 1, (batch, 3, image_hw, image_hw)).astype(np.float32)
+    label = rng.randint(0, 1000, batch).astype(np.float32)
+    # place once; reuse device-resident batch (synthetic-data mode)
+    placed = trainer._place_batch({"data": data, "softmax_label": label})
+
+    def step():
+        trainer._key, sub = jax.random.split(trainer._key)
+        trainer.params, trainer.opt_state, trainer.aux, outs = \
+            trainer._train_step(trainer.params, trainer.opt_state, trainer.aux,
+                                placed, sub)
+        return outs
+
+    for _ in range(n_warmup):
+        outs = step()
+    jax.block_until_ready(outs)
+
+    tic = time.perf_counter()
+    for _ in range(n_iter):
+        outs = step()
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - tic
+
+    img_per_sec = batch * n_iter / dt
+    img_per_sec_per_chip = img_per_sec / n_chips
+    result = {
+        "metric": "resnet50_train_throughput",
+        "value": round(img_per_sec_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
+        "batch_per_chip": batch_per_chip,
+        "image_hw": image_hw,
+        "n_chips": n_chips,
+        "dtype": dtype,
+        "platform": "tpu" if on_tpu else jax.devices()[0].platform,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

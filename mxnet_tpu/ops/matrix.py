@@ -42,7 +42,7 @@ def _dot(p, a, b):
     am = a.T if p.transpose_a else a
     bm = b.T if p.transpose_b else b
     # Accumulate in f32 on the MXU regardless of input dtype.
-    return jnp.dot(am, bm, preferred_element_type=jnp.float32).astype(a.dtype)
+    return jnp.dot(am, bm)
 
 
 register_simple_op("dot", _dot, nin=2, param_cls=DotParam, shape_rule=_dot_shape)
@@ -60,8 +60,7 @@ def _batch_dot_shape(params, in_shapes):
 def _batch_dot(p, a, b):
     am = jnp.swapaxes(a, 1, 2) if p.transpose_a else a
     bm = jnp.swapaxes(b, 1, 2) if p.transpose_b else b
-    return jnp.einsum("bij,bjk->bik", am, bm,
-                      preferred_element_type=jnp.float32).astype(a.dtype)
+    return jnp.einsum("bij,bjk->bik", am, bm)
 
 
 register_simple_op("batch_dot", _batch_dot, nin=2, param_cls=DotParam,

@@ -67,11 +67,11 @@ class FullyConnectedOp(OpDef):
 
     def forward(self, params, inputs, aux, train, key):
         x = inputs[0]
-        w = inputs[1]
+        w = inputs[1].astype(x.dtype)  # mixed-precision: follow activations
         x2 = x.reshape(x.shape[0], -1)
-        y = jnp.dot(x2, w.T, preferred_element_type=jnp.float32).astype(x.dtype)
+        y = jnp.dot(x2, w.T)
         if not params.no_bias:
-            y = y + inputs[2]
+            y = y + inputs[2].astype(x.dtype)
         return [y], []
 
 
@@ -125,7 +125,7 @@ class ConvolutionOp(OpDef):
         return completed, [(n, params.num_filter, oh, ow)], []
 
     def forward(self, params, inputs, aux, train, key):
-        x, w = inputs[0], inputs[1]
+        x, w = inputs[0], inputs[1].astype(inputs[0].dtype)
         sh, sw = _pair(params.stride)
         ph, pw = _pair(params.pad, 2) if params.pad else (0, 0)
         dh, dw = _pair(params.dilate)
@@ -136,10 +136,9 @@ class ConvolutionOp(OpDef):
             rhs_dilation=(dh, dw),
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             feature_group_count=params.num_group,
-            preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
+        )
         if not params.no_bias:
-            y = y + inputs[2][None, :, None, None]
+            y = y + inputs[2].astype(x.dtype)[None, :, None, None]
         return [y], []
 
 
@@ -180,7 +179,7 @@ class DeconvolutionOp(OpDef):
         return completed, [(n, params.num_filter, oh, ow)], []
 
     def forward(self, params, inputs, aux, train, key):
-        x, w = inputs[0], inputs[1]
+        x, w = inputs[0], inputs[1].astype(inputs[0].dtype)
         kh, kw = _pair(params.kernel)
         sh, sw = _pair(params.stride)
         ph, pw = _pair(params.pad, 2) if params.pad else (0, 0)
@@ -191,8 +190,7 @@ class DeconvolutionOp(OpDef):
             lhs_dilation=(sh, sw),
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             feature_group_count=params.num_group,
-            preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
+        )
         if not params.no_bias:
             y = y + inputs[2][None, :, None, None]
         return [y], []
@@ -311,9 +309,11 @@ class BatchNormOp(OpDef):
             use_mean, use_var = moving_mean, moving_var
             new_aux = [moving_mean, moving_var]
         inv = lax.rsqrt(use_var.astype(jnp.float32) + params.eps)
-        y = (x.astype(jnp.float32) - use_mean.reshape(shape)) * inv.reshape(shape)
-        y = y.astype(x.dtype) * gamma.reshape(shape) + beta.reshape(shape)
-        return [y], new_aux
+        y = (x.astype(jnp.float32)
+             - use_mean.astype(jnp.float32).reshape(shape)) * inv.reshape(shape)
+        y = (y * gamma.astype(jnp.float32).reshape(shape)
+             + beta.astype(jnp.float32).reshape(shape))
+        return [y.astype(x.dtype)], new_aux
 
 
 class InstanceNormParam(Params):

@@ -130,6 +130,13 @@ class ShardedTrainer:
                               for k in self.input_names}
         if input_dtypes:
             self._input_dtypes.update(input_dtypes)
+        # mixed precision: float data inputs follow the compute dtype;
+        # labels stay f32 (bf16 cannot represent class ids > 256 exactly)
+        for k in self.input_names:
+            if (self._dtype != np.float32
+                    and np.issubdtype(self._input_dtypes[k], np.floating)
+                    and not k.endswith("label")):
+                self._input_dtypes[k] = self._dtype
 
         # -- initialize params on host, then place with shardings ----------
         initializer = initializer or Uniform(0.07)
