@@ -136,7 +136,13 @@ class _CompiledGraph:
 
 
 class Executor:
-    """Bound, compiled computation (reference python/mxnet/executor.py)."""
+    """Bound, compiled computation (reference python/mxnet/executor.py).
+
+    Single-context graphs compile to one fused XLA program; graphs whose
+    nodes span contexts (``ctx_group`` attrs via ``group2ctx``, or bound
+    arrays on different devices) execute as per-context compiled segments
+    with automatic cross-device transfers (see mxnet_tpu.graph).
+    """
 
     def __init__(self, symbol, ctx, grad_req, arg_arrays, grad_arrays, aux_arrays,
                  group2ctx=None):
@@ -169,7 +175,30 @@ class Executor:
         self._pending_grads = None
         self._monitor_callback = None
 
-        # --- compiled entry points ---
+        # -- context assignment (model parallelism) -------------------------
+        from .graph import SegmentedGraph, assign_contexts
+
+        self._arg_ctx = {name: arr.context
+                         for name, arr in zip(self.arg_names, arg_arrays)}
+        var_ctx = dict(self._arg_ctx)
+        for name, arr in zip(self.aux_names, aux_arrays):
+            var_ctx[name] = arr.context
+        ctx_of = assign_contexts(symbol, ctx, group2ctx, var_ctx)
+        distinct = {c for c in ctx_of.values()}
+        self._multi_ctx = len(distinct) > 1
+        if self._multi_ctx:
+            self._seg_graph = SegmentedGraph(symbol, ctx_of,
+                                             self._graph._custom)
+            self._pending_chain = None
+            self._head_ctx = []
+            for node, idx in symbol._heads:
+                if node.is_variable:
+                    self._head_ctx.append(self._arg_ctx[node.name])
+                else:
+                    self._head_ctx.append(ctx_of[id(node)])
+            return
+
+        # --- compiled entry points (single-context fused path) ---
         graph = self._graph
 
         def fwd(train, args, aux, key):
@@ -215,16 +244,30 @@ class Executor:
             k: v for k, v in type_dict.items()})
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
-        arg_arrays = [nd.zeros(s, ctx=ctx, dtype=t or np.float32)
-                      for s, t in zip(arg_shapes, arg_types)]
-        aux_arrays = [nd.zeros(s, ctx=ctx, dtype=t or np.float32)
-                      for s, t in zip(aux_shapes, aux_types)]
+        # with ctx groups, allocate each variable on its assigned context
+        # (reference simple_bind honors AssignContext placements)
+        if group2ctx:
+            from .graph import assign_contexts
+
+            ctx_of = assign_contexts(symbol, ctx, group2ctx)
+            name_ctx = {}
+            for node in symbol._topo():
+                if node.is_variable:
+                    name_ctx[node.name] = ctx_of[id(node)]
+        else:
+            name_ctx = {}
+        arg_ctxs = [name_ctx.get(k, ctx) for k in arg_names]
+        aux_ctxs = [name_ctx.get(k, ctx) for k in aux_names]
+        arg_arrays = [nd.zeros(s, ctx=c, dtype=t or np.float32)
+                      for s, t, c in zip(arg_shapes, arg_types, arg_ctxs)]
+        aux_arrays = [nd.zeros(s, ctx=c, dtype=t or np.float32)
+                      for s, t, c in zip(aux_shapes, aux_types, aux_ctxs)]
         req = grad_req if isinstance(grad_req, dict) else {
             k: grad_req for k in arg_names}
         grad_arrays = [
-            nd.zeros(s, ctx=ctx, dtype=t or np.float32)
+            nd.zeros(s, ctx=c, dtype=t or np.float32)
             if req.get(k, "null") != "null" else None
-            for k, s, t in zip(arg_names, arg_shapes, arg_types)
+            for k, s, t, c in zip(arg_names, arg_shapes, arg_types, arg_ctxs)
         ]
         return Executor(symbol, ctx, req, arg_arrays, grad_arrays, aux_arrays,
                         group2ctx=group2ctx)
@@ -269,6 +312,19 @@ class Executor:
         args, aux = self._gather()
         key = self._next_key()
 
+        if self._multi_ctx:
+            build_vjp = bool(is_train and self._grad_names)
+            head_outs, new_aux, chain = self._seg_graph.forward(
+                args, self._arg_ctx, aux, key, is_train, build_vjp)
+            self._pending_chain = chain
+            if is_train:
+                for k, arr in zip(self.aux_names, self.aux_arrays):
+                    arr._set(jax.device_put(new_aux[k],
+                                            arr._ctx.jax_device()))
+            self._outputs = [NDArray(o, c)
+                             for o, c in zip(head_outs, self._head_ctx)]
+            return self._outputs
+
         if self._monitor_callback is not None:
             collect = []
             outs, new_aux = self._graph(args, aux, key, is_train, collect=collect)
@@ -301,6 +357,28 @@ class Executor:
         explicit head gradients the fused program re-runs with them.
         """
         if not self._grad_names:
+            return
+        if self._multi_ctx:
+            if self._pending_chain is None:
+                raise MXNetError("backward called before forward(is_train=True)")
+            if out_grads is None:
+                head_grads = [jnp.ones(o.shape, o.dtype) for o in self._outputs]
+            else:
+                if isinstance(out_grads, NDArray):
+                    out_grads = [out_grads]
+                head_grads = [g._data if isinstance(g, NDArray)
+                              else jnp.asarray(g) for g in out_grads]
+            grads = self._seg_graph.backward(self._pending_chain, head_grads,
+                                             self._arg_ctx, self._grad_names)
+            for k, garr in zip(self.arg_names, self.grad_arrays):
+                if garr is None or self._grad_req[k] == "null":
+                    continue
+                g = grads[k]
+                if g is None:
+                    continue
+                g = jax.device_put(g, garr._ctx.jax_device())
+                garr._set(garr._data + g if self._grad_req[k] == "add" else g)
+            self._pending_chain = None
             return
         if out_grads is not None:
             if isinstance(out_grads, NDArray):

@@ -1,0 +1,112 @@
+"""Model parallelism via ctx_group/group2ctx (rebuild of
+tests/python/unittest/test_model_parallel.py): a graph split across two
+CPU contexts must produce outputs and gradients identical to a
+single-context bind."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _net():
+    with mx.AttrScope(ctx_group="dev1"):
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+        act1 = mx.sym.Activation(fc1, act_type="relu", name="act1")
+    with mx.AttrScope(ctx_group="dev2"):
+        fc2 = mx.sym.FullyConnected(act1, num_hidden=8, name="fc2")
+        act2 = mx.sym.Activation(fc2, act_type="relu", name="act2")
+        fc3 = mx.sym.FullyConnected(act2, num_hidden=4, name="fc3")
+        out = mx.sym.SoftmaxOutput(fc3, name="softmax")
+    return out
+
+
+def test_chain_multi_context_matches_single():
+    net = _net()
+    shape = (8, 10)
+    rng = np.random.RandomState(0)
+    arg_shapes, _, _ = net.infer_shape(data=shape)
+    values = {name: rng.randn(*s).astype(np.float32) * 0.5
+              for name, s in zip(net.list_arguments(), arg_shapes)}
+    values["softmax_label"] = rng.randint(0, 4, 8).astype(np.float32)
+
+    def run(group2ctx):
+        exe = net.simple_bind(mx.cpu(0), group2ctx=group2ctx,
+                              grad_req="write", data=shape)
+        for k, v in values.items():
+            exe.arg_dict[k][:] = v
+        outs = [o.asnumpy() for o in exe.forward(is_train=True)]
+        exe.backward()
+        grads = {k: g.asnumpy() for k, g in exe.grad_dict.items()}
+        return outs, grads
+
+    outs1, grads1 = run(None)
+    outs2, grads2 = run({"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    for o1, o2 in zip(outs1, outs2):
+        np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-6)
+    assert set(grads1) == set(grads2)
+    for k in grads1:
+        np.testing.assert_allclose(grads1[k], grads2[k], rtol=1e-4,
+                                   atol=1e-6, err_msg=k)
+
+
+def test_array_placement_follows_groups():
+    net = _net()
+    exe = net.simple_bind(mx.cpu(0),
+                          group2ctx={"dev1": mx.cpu(2), "dev2": mx.cpu(3)},
+                          data=(8, 10))
+    assert exe.arg_dict["fc1_weight"].context == mx.cpu(2)
+    assert exe.arg_dict["fc3_weight"].context == mx.cpu(3)
+    assert exe.arg_dict["data"].context == mx.cpu(2)
+
+
+def test_multi_ctx_training_converges():
+    np.random.seed(11)
+    net = _net()
+    rng = np.random.RandomState(1)
+    X = rng.randn(128, 10).astype(np.float32)
+    W = rng.randn(10, 4).astype(np.float32)
+    y = X.dot(W).argmax(axis=1).astype(np.float32)
+    exe = net.simple_bind(mx.cpu(0),
+                          group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)},
+                          grad_req="write", data=(32, 10))
+    ini = mx.initializer.Xavier()
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            ini(name, arr)
+    opt = mx.optimizer.SGD(learning_rate=0.3, momentum=0.9,
+                           rescale_grad=1.0 / 32)
+    updater = mx.optimizer.get_updater(opt)
+    for step in range(40):
+        b = (step * 32) % 96
+        exe.arg_dict["data"][:] = X[b:b + 32]
+        exe.arg_dict["softmax_label"][:] = y[b:b + 32]
+        exe.forward(is_train=True)
+        exe.backward()
+        for i, name in enumerate(exe.arg_names):
+            if name in ("data", "softmax_label"):
+                continue
+            updater(i, exe.grad_dict[name], exe.arg_dict[name])
+    exe.arg_dict["data"][:] = X[:32]
+    exe.arg_dict["softmax_label"][:] = y[:32]
+    pred = exe.forward(is_train=False)[0].asnumpy().argmax(axis=1)
+    assert (pred == y[:32]).mean() > 0.9
+
+
+def test_mixed_device_bind_arrays():
+    """bind() with arrays pre-placed on different contexts partitions the
+    graph accordingly (reference model-parallel-lstm custom bind)."""
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = mx.sym.MakeLoss(mx.sym.sum((a * 2) * b))
+    a_arr = mx.nd.array(np.ones((3, 3)), ctx=mx.cpu(0))
+    b_arr = mx.nd.array(np.full((3, 3), 2.0), ctx=mx.cpu(1))
+    ga = mx.nd.zeros((3, 3), ctx=mx.cpu(0))
+    gb = mx.nd.zeros((3, 3), ctx=mx.cpu(1))
+    exe = out.bind(mx.cpu(0), args={"a": a_arr, "b": b_arr},
+                   args_grad={"a": ga, "b": gb})
+    exe.forward(is_train=True)
+    exe.backward()
+    np.testing.assert_allclose(ga.asnumpy(), np.full((3, 3), 4.0), rtol=1e-6)
+    np.testing.assert_allclose(gb.asnumpy(), np.full((3, 3), 2.0), rtol=1e-6)
+    assert ga.context == mx.cpu(0) and gb.context == mx.cpu(1)
