@@ -42,13 +42,16 @@ def main():
         n_warmup, n_iter = 2, 5
 
     batch = batch_per_chip * n_chips
+    layout = os.environ.get("BENCH_LAYOUT", "NHWC" if on_tpu else "NCHW")
     net = mx.models.resnet(num_classes=1000, num_layers=50,
-                           image_shape=(3, image_hw, image_hw))
+                           image_shape=(3, image_hw, image_hw), layout=layout)
+    data_shape = ((batch, image_hw, image_hw, 3) if layout == "NHWC"
+                  else (batch, 3, image_hw, image_hw))
 
     mesh = mx.parallel.local_mesh("dp")
     trainer = mx.parallel.ShardedTrainer(
         net,
-        {"data": (batch, 3, image_hw, image_hw), "softmax_label": (batch,)},
+        {"data": data_shape, "softmax_label": (batch,)},
         mesh=mesh,
         optimizer="sgd",
         optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
@@ -58,7 +61,7 @@ def main():
     )
 
     rng = np.random.RandomState(0)
-    data = rng.uniform(-1, 1, (batch, 3, image_hw, image_hw)).astype(np.float32)
+    data = rng.uniform(-1, 1, data_shape).astype(np.float32)
     label = rng.randint(0, 1000, batch).astype(np.float32)
     # place once; reuse device-resident batch (synthetic-data mode)
     placed = trainer._place_batch({"data": data, "softmax_label": label})
@@ -91,6 +94,7 @@ def main():
         "image_hw": image_hw,
         "n_chips": n_chips,
         "dtype": dtype,
+        "layout": layout,
         "platform": "tpu" if on_tpu else jax.devices()[0].platform,
     }
     print(json.dumps(result))

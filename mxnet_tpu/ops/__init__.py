@@ -13,6 +13,7 @@ from . import matrix  # noqa: F401
 from . import nn  # noqa: F401
 from . import loss  # noqa: F401
 from . import sequence  # noqa: F401
+from . import rnn  # noqa: F401
 from . import sample  # noqa: F401
 
 __all__ = ["OP_REGISTRY", "OpDef", "SimpleOpDef", "register_op", "register_simple_op"]

@@ -111,34 +111,43 @@ class ConvolutionOp(OpDef):
         data = in_shapes[0]
         if data is None:
             raise ValueError("Convolution: data shape unknown")
-        n, c = data[0], data[1]
+        nhwc = params.layout == "NHWC"
+        n = data[0]
+        c = data[3] if nhwc else data[1]
+        ih, iw = (data[1], data[2]) if nhwc else (data[2], data[3])
         kh, kw = _pair(params.kernel)
         sh, sw = _pair(params.stride)
         ph, pw = _pair(params.pad, 2) if params.pad else (0, 0)
         dh, dw = _pair(params.dilate)
-        oh = _conv_out_dim(data[2], kh, sh, ph, dh)
-        ow = _conv_out_dim(data[3], kw, sw, pw, dw)
+        oh = _conv_out_dim(ih, kh, sh, ph, dh)
+        ow = _conv_out_dim(iw, kw, sw, pw, dw)
+        # weight layout is OIHW in both cases (reference checkpoint parity)
         wshape = (params.num_filter, c // params.num_group, kh, kw)
+        out = ((n, oh, ow, params.num_filter) if nhwc
+               else (n, params.num_filter, oh, ow))
         completed = [tuple(data), wshape]
         if not params.no_bias:
             completed.append((params.num_filter,))
-        return completed, [(n, params.num_filter, oh, ow)], []
+        return completed, [out], []
 
     def forward(self, params, inputs, aux, train, key):
         x, w = inputs[0], inputs[1].astype(inputs[0].dtype)
         sh, sw = _pair(params.stride)
         ph, pw = _pair(params.pad, 2) if params.pad else (0, 0)
         dh, dw = _pair(params.dilate)
+        nhwc = params.layout == "NHWC"
         y = lax.conv_general_dilated(
             x, w,
             window_strides=(sh, sw),
             padding=((ph, ph), (pw, pw)),
             rhs_dilation=(dh, dw),
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=(("NHWC", "OIHW", "NHWC") if nhwc
+                               else ("NCHW", "OIHW", "NCHW")),
             feature_group_count=params.num_group,
         )
         if not params.no_bias:
-            y = y + inputs[2].astype(x.dtype)[None, :, None, None]
+            b = inputs[2].astype(x.dtype)
+            y = y + (b[None, None, None, :] if nhwc else b[None, :, None, None])
         return [y], []
 
 
@@ -263,6 +272,7 @@ class BatchNormParam(Params):
     momentum = field(float, default=0.9)
     fix_gamma = field(bool, default=True)
     use_global_stats = field(bool, default=False)
+    axis = field(int, default=1, doc="channel axis (use -1 for NHWC)")
 
 
 @register_op("BatchNorm", aliases=("CuDNNBatchNorm",))
@@ -286,7 +296,7 @@ class BatchNormOp(OpDef):
         d = in_shapes[0]
         if d is None:
             raise ValueError("BatchNorm: data shape unknown")
-        c = (d[1],)
+        c = (d[params.axis % len(d)],)
         return [tuple(d), c, c], [tuple(d)], [c, c]
 
     def forward(self, params, inputs, aux, train, key):
@@ -294,8 +304,9 @@ class BatchNormOp(OpDef):
         moving_mean, moving_var = aux
         if params.fix_gamma:
             gamma = jnp.ones_like(gamma)
-        axes = (0,) + tuple(range(2, x.ndim))
-        shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+        ax = params.axis % x.ndim
+        axes = tuple(i for i in range(x.ndim) if i != ax)
+        shape = tuple(x.shape[i] if i == ax else 1 for i in range(x.ndim))
         if train and not params.use_global_stats:
             xf = x.astype(jnp.float32)
             mean = jnp.mean(xf, axis=axes)
@@ -371,6 +382,7 @@ class PoolingParam(Params):
     stride = field(tuple_of(int), default=None)
     pad = field(tuple_of(int), default=None)
     pooling_convention = field(str, default="valid", enum=("valid", "full"))
+    layout = field(str, default="NCHW", enum=("NCHW", "NHWC"))
 
 
 @register_op("Pooling")
@@ -396,25 +408,36 @@ class PoolingOp(OpDef):
         return (kh, kw), (sh, sw), (ph, pw), (oh, ow)
 
     def infer_shape(self, params, in_shapes):
-        n, c, h, w = in_shapes[0]
+        nhwc = params.layout == "NHWC"
+        if nhwc:
+            n, h, w, c = in_shapes[0]
+        else:
+            n, c, h, w = in_shapes[0]
         if params.global_pool:
-            return list(in_shapes), [(n, c, 1, 1)], []
+            out = (n, 1, 1, c) if nhwc else (n, c, 1, 1)
+            return list(in_shapes), [out], []
         _, _, _, (oh, ow) = self._geometry(params, h, w)
-        return list(in_shapes), [(n, c, oh, ow)], []
+        out = (n, oh, ow, c) if nhwc else (n, c, oh, ow)
+        return list(in_shapes), [out], []
 
     def forward(self, params, inputs, aux, train, key):
         x = inputs[0]
-        h, w = x.shape[2], x.shape[3]
+        nhwc = params.layout == "NHWC"
+        h, w = (x.shape[1], x.shape[2]) if nhwc else (x.shape[2], x.shape[3])
         (kh, kw), (sh, sw), (ph, pw), (oh, ow) = self._geometry(params, h, w)
         # 'full' convention can need extra one-sided padding to reach (oh, ow).
         eh = max(0, (oh - 1) * sh + kh - h - 2 * ph)
         ew = max(0, (ow - 1) * sw + kw - w - 2 * pw)
-        pads = ((0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew))
-        if params.pool_type == "max":
-            init = -jnp.inf
-            y = lax.reduce_window(x, init, lax.max, (1, 1, kh, kw), (1, 1, sh, sw), pads)
+        if nhwc:
+            dims, strides = (1, kh, kw, 1), (1, sh, sw, 1)
+            pads = ((0, 0), (ph, ph + eh), (pw, pw + ew), (0, 0))
         else:
-            y = lax.reduce_window(x, 0.0, lax.add, (1, 1, kh, kw), (1, 1, sh, sw), pads)
+            dims, strides = (1, 1, kh, kw), (1, 1, sh, sw)
+            pads = ((0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew))
+        if params.pool_type == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads)
+        else:
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
             if params.pool_type == "avg":
                 y = y / (kh * kw)
         return [y.astype(x.dtype)], []

@@ -1,0 +1,208 @@
+"""Fused multi-layer RNN operator.
+
+Rebuild of the reference ``RNN`` op (src/operator/rnn-inl.h:315 — CPU path
+was LOG(FATAL), the real implementation was cuDNN v5 fused kernels,
+src/operator/cudnn_rnn-inl.h:513).  TPU-native design:
+
+- the whole sequence runs inside one ``lax.scan`` per layer/direction, so
+  XLA compiles a single fused loop (the cuDNN-fused-kernel equivalent);
+- the input projection ``x @ W_i2h^T`` for ALL timesteps is hoisted out
+  of the scan into one big MXU matmul (time-batched), so the sequential
+  part touches only the (N, H) @ (H, GH) recurrent matmul;
+- parameters use the reference's concatenated flat-weight layout
+  (cudnn_rnn-inl.h weight concat: all layer/direction W_i2h then W_h2h
+  blocks, followed by all b_i2h then b_h2h blocks), so checkpoints keyed
+  on a single ``parameters`` vector stay compatible in shape.
+
+Gate orders follow cuDNN: LSTM (i, f, g, o), GRU (r, z, n).
+Layout: data (T, N, input_size) time-major, states (L*D, N, H).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..param import Params, field
+from .op import OpDef, register_op
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+class RNNParam(Params):
+    state_size = field(int, required=True, lower=1)
+    num_layers = field(int, required=True, lower=1)
+    mode = field(str, required=True, enum=("rnn_relu", "rnn_tanh", "lstm", "gru"))
+    bidirectional = field(bool, default=False)
+    p = field(float, default=0.0, doc="dropout between layers")
+    state_outputs = field(bool, default=False)
+
+
+def _dirs(params):
+    return 2 if params.bidirectional else 1
+
+
+def _layer_input_size(params, input_size, layer):
+    return input_size if layer == 0 else params.state_size * _dirs(params)
+
+
+def _weight_size(params, input_size):
+    """Total flat parameter count (mirrors cudnn_rnn-inl.h size calc)."""
+    G, H, D = _GATES[params.mode], params.state_size, _dirs(params)
+    total = 0
+    for layer in range(params.num_layers):
+        isz = _layer_input_size(params, input_size, layer)
+        total += D * (G * H * isz + G * H * H)  # W_i2h + W_h2h
+    total += params.num_layers * D * 2 * G * H  # b_i2h + b_h2h
+    return total
+
+
+def _slice_params(params, input_size, flat):
+    """Split the flat vector into per-(layer, direction) weight blocks."""
+    G, H, D = _GATES[params.mode], params.state_size, _dirs(params)
+    out = []
+    pos = 0
+    for layer in range(params.num_layers):
+        isz = _layer_input_size(params, input_size, layer)
+        per_layer = []
+        for d in range(D):
+            wi = flat[pos:pos + G * H * isz].reshape(G * H, isz)
+            pos += G * H * isz
+            wh = flat[pos:pos + G * H * H].reshape(G * H, H)
+            pos += G * H * H
+            per_layer.append([wi, wh, None, None])
+        out.append(per_layer)
+    for layer in range(params.num_layers):
+        for d in range(D):
+            out[layer][d][2] = flat[pos:pos + G * H]
+            pos += G * H
+            out[layer][d][3] = flat[pos:pos + G * H]
+            pos += G * H
+    return out
+
+
+def _cell_step(mode, H):
+    if mode == "lstm":
+        def step(carry, inp):
+            h, c = carry
+            gx, wh, bh = inp  # gx: precomputed x-projection + b_i2h
+            gates = gx + jnp.dot(h, wh.T) + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+            return (h2, c2), h2
+    elif mode == "gru":
+        def step(carry, inp):
+            h = carry
+            gx, wh, bh = inp
+            hp = jnp.dot(h, wh.T) + bh
+            rx, zx, nx = jnp.split(gx, 3, axis=-1)
+            rh, zh, nh = jnp.split(hp, 3, axis=-1)
+            r = jax.nn.sigmoid(rx + rh)
+            z = jax.nn.sigmoid(zx + zh)
+            n = jnp.tanh(nx + r * nh)
+            h2 = (1 - z) * n + z * h
+            return h2, h2
+    else:
+        act = jnp.maximum if mode == "rnn_relu" else None
+
+        def step(carry, inp):
+            h = carry
+            gx, wh, bh = inp
+            pre = gx + jnp.dot(h, wh.T) + bh
+            h2 = jnp.maximum(pre, 0) if mode == "rnn_relu" else jnp.tanh(pre)
+            return h2, h2
+    return step
+
+
+def _run_direction(mode, x, h0, c0, wi, wh, bi, bh, reverse):
+    """One layer, one direction over the full sequence."""
+    # time-batched input projection: (T, N, I) x (GH, I) -> (T, N, GH)
+    gx = jnp.einsum("tni,gi->tng", x, wi) + bi
+    if reverse:
+        gx = jnp.flip(gx, axis=0)
+    step = _cell_step(mode, h0.shape[-1])
+    if mode == "lstm":
+        (hT, cT), ys = lax.scan(lambda c, g: step(c, (g, wh, bh)), (h0, c0), gx)
+    else:
+        hT, ys = lax.scan(lambda c, g: step(c, (g, wh, bh)), h0, gx)
+        cT = None
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, hT, cT
+
+
+@register_op("RNN")
+class RNNOp(OpDef):
+    param_cls = RNNParam
+    need_rng = True
+
+    def list_arguments(self, params):
+        args = ["data", "parameters", "state"]
+        if params.mode == "lstm":
+            args.append("state_cell")
+        return args
+
+    def list_outputs(self, params):
+        outs = ["output"]
+        if params.state_outputs:
+            outs.append("state")
+            if params.mode == "lstm":
+                outs.append("state_cell")
+        return outs
+
+    def infer_shape(self, params, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise ValueError("RNN: data shape unknown")
+        T, N, input_size = data
+        H, D, L = params.state_size, _dirs(params), params.num_layers
+        wsize = _weight_size(params, input_size)
+        state_shape = (L * D, N, H)
+        completed = [tuple(data), (wsize,), state_shape]
+        if params.mode == "lstm":
+            completed.append(state_shape)
+        outs = [(T, N, H * D)]
+        if params.state_outputs:
+            outs.append(state_shape)
+            if params.mode == "lstm":
+                outs.append(state_shape)
+        return completed, outs, []
+
+    def forward(self, params, inputs, aux, train, key):
+        data, flat = inputs[0], inputs[1]
+        h0_all = inputs[2]
+        c0_all = inputs[3] if params.mode == "lstm" else None
+        T, N, input_size = data.shape
+        H, D, L = params.state_size, _dirs(params), params.num_layers
+        blocks = _slice_params(params, input_size, flat)
+
+        x = data
+        hTs, cTs = [], []
+        drop_keys = (jax.random.split(key, L) if key is not None else [None] * L)
+        for layer in range(L):
+            outs_dir = []
+            for d in range(D):
+                wi, wh, bi, bh = blocks[layer][d]
+                h0 = h0_all[layer * D + d]
+                c0 = c0_all[layer * D + d] if c0_all is not None else None
+                ys, hT, cT = _run_direction(params.mode, x, h0, c0, wi, wh,
+                                            bi, bh, reverse=(d == 1))
+                outs_dir.append(ys)
+                hTs.append(hT)
+                if cT is not None:
+                    cTs.append(cT)
+            x = jnp.concatenate(outs_dir, axis=-1) if D == 2 else outs_dir[0]
+            if params.p > 0 and train and layer < L - 1 and drop_keys[layer] is not None:
+                keep = 1.0 - params.p
+                mask = jax.random.bernoulli(drop_keys[layer], keep, x.shape)
+                x = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+        outputs = [x]
+        if params.state_outputs:
+            outputs.append(jnp.stack(hTs, axis=0))
+            if params.mode == "lstm":
+                outputs.append(jnp.stack(cTs, axis=0))
+        return outputs, []

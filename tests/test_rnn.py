@@ -1,0 +1,176 @@
+"""Fused RNN op: shapes, numpy-reference LSTM/GRU forward, gradients,
+bidirectional/multilayer (rebuild of the cudnn_rnn coverage in
+tests/python/gpu/test_operator_gpu.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.rnn import _weight_size, _slice_params, RNNParam
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+rng = np.random.RandomState(3)
+
+
+def _np_lstm(x, h0, c0, wi, wh, bi, bh):
+    T, N, _ = x.shape
+    H = h0.shape[-1]
+    h, c = h0.copy(), c0.copy()
+    ys = []
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for t in range(T):
+        gates = x[t].dot(wi.T) + bi + h.dot(wh.T) + bh
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        ys.append(h)
+    return np.stack(ys), h, c
+
+
+def test_rnn_shapes():
+    sym = mx.sym.RNN(mx.sym.Variable("data"), state_size=8, num_layers=2,
+                     mode="lstm", state_outputs=True, name="rnn")
+    arg_shapes, out_shapes, _ = sym.infer_shape(data=(5, 4, 10))
+    d = dict(zip(sym.list_arguments(), arg_shapes))
+    assert d["rnn_state"] == (2, 4, 8)
+    assert d["rnn_state_cell"] == (2, 4, 8)
+    assert out_shapes == [(5, 4, 8), (2, 4, 8), (2, 4, 8)]
+    p = RNNParam(state_size=8, num_layers=2, mode="lstm")
+    assert d["rnn_parameters"] == (_weight_size(p, 10),)
+
+
+def test_lstm_forward_matches_numpy():
+    T, N, I, H = 4, 3, 5, 6
+    p = RNNParam(state_size=H, num_layers=1, mode="lstm", state_outputs=True)
+    wsize = _weight_size(p, I)
+    flat = rng.randn(wsize).astype(np.float32) * 0.3
+    x = rng.randn(T, N, I).astype(np.float32)
+    h0 = rng.randn(1, N, H).astype(np.float32) * 0.1
+    c0 = rng.randn(1, N, H).astype(np.float32) * 0.1
+
+    sym = mx.sym.RNN(mx.sym.Variable("data"), state_size=H, num_layers=1,
+                     mode="lstm", state_outputs=True, name="rnn")
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=(T, N, I))
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["rnn_parameters"][:] = flat
+    exe.arg_dict["rnn_state"][:] = h0
+    exe.arg_dict["rnn_state_cell"][:] = c0
+    out, hT, cT = [o.asnumpy() for o in exe.forward(is_train=False)]
+
+    import jax.numpy as jnp
+
+    blocks = _slice_params(p, I, jnp.asarray(flat))
+    wi, wh, bi, bh = [np.asarray(b) for b in blocks[0][0]]
+    ref_y, ref_h, ref_c = _np_lstm(x, h0[0], c0[0], wi, wh, bi, bh)
+    np.testing.assert_allclose(out, ref_y, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(hT[0], ref_h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(cT[0], ref_c, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["rnn_relu", "rnn_tanh", "gru", "lstm"])
+def test_rnn_modes_run_and_grad(mode):
+    T, N, I, H = 3, 2, 4, 5
+    p = RNNParam(state_size=H, num_layers=1, mode=mode)
+    wsize = _weight_size(p, I)
+    sym = mx.sym.RNN(mx.sym.Variable("data"), state_size=H, num_layers=1,
+                     mode=mode, name="rnn")
+    loc = {"data": rng.randn(T, N, I) * 0.5,
+           "rnn_parameters": rng.randn(wsize) * 0.2,
+           "rnn_state": np.zeros((1, N, H))}
+    if mode == "lstm":
+        loc["rnn_state_cell"] = np.zeros((1, N, H))
+    check_numeric_gradient(sym, loc, numeric_eps=1e-3, check_eps=0.05,
+                           grad_nodes=["data", "rnn_parameters"])
+
+
+def test_bidirectional_multilayer():
+    T, N, I, H, L = 6, 2, 4, 3, 2
+    sym = mx.sym.RNN(mx.sym.Variable("data"), state_size=H, num_layers=L,
+                     mode="gru", bidirectional=True, state_outputs=True,
+                     name="rnn")
+    arg_shapes, out_shapes, _ = sym.infer_shape(data=(T, N, I))
+    assert out_shapes[0] == (T, N, 2 * H)
+    assert out_shapes[1] == (2 * L, N, H)
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=(T, N, I))
+    exe.arg_dict["data"][:] = rng.randn(T, N, I)
+    exe.arg_dict["rnn_parameters"][:] = rng.randn(
+        exe.arg_dict["rnn_parameters"].shape[0]) * 0.1
+    outs = exe.forward(is_train=False)
+    assert np.isfinite(outs[0].asnumpy()).all()
+    # single-layer flip symmetry: reversing the input sequence swaps the
+    # roles of the two directions
+    sym1 = mx.sym.RNN(mx.sym.Variable("data"), state_size=H, num_layers=1,
+                      mode="gru", bidirectional=True, name="r1")
+    exe1 = sym1.simple_bind(mx.cpu(), grad_req="null", data=(T, N, I))
+    # identical weights for both directions so flip symmetry is exact:
+    # flat layout is [wi_d0, wh_d0, wi_d1, wh_d1, b_d0(2GH), b_d1(2GH)]
+    G = 3
+    wblk = G * H * I + G * H * H
+    bblk = 2 * G * H
+    w = rng.randn(wblk) * 0.2
+    b = rng.randn(bblk) * 0.2
+    exe1.arg_dict["r1_parameters"][:] = np.concatenate([w, w, b, b])
+    x = rng.randn(T, N, I).astype(np.float32)
+    exe1.arg_dict["data"][:] = x
+    o1 = exe1.forward(is_train=False)[0].asnumpy()
+    exe1.arg_dict["data"][:] = x[::-1]
+    o2 = exe1.forward(is_train=False)[0].asnumpy()
+    # fwd half on reversed input == flipped reverse half on original input
+    np.testing.assert_allclose(o2[:, :, :H], o1[::-1][:, :, H:], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_rnn_lm_trains():
+    """Tiny LSTM LM via the fused op learns a deterministic pattern."""
+    V, T, N, H = 12, 8, 16, 32
+    seqs = np.zeros((64, T + 1), np.int64)
+    for i in range(64):
+        start = i % V
+        seqs[i] = (start + np.arange(T + 1)) % V  # predictable successor
+    data_in = seqs[:, :-1].astype(np.float32)
+    labels = seqs[:, 1:].astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    emb = mx.sym.Embedding(data, input_dim=V, output_dim=H, name="emb")
+    emb_t = mx.sym.SwapAxis(emb, dim1=0, dim2=1)  # (T, N, H)
+    rnn = mx.sym.RNN(emb_t, state_size=H, num_layers=1, mode="lstm",
+                     name="rnn")
+    out_t = mx.sym.SwapAxis(rnn, dim1=0, dim2=1)  # (N, T, H)
+    flat = mx.sym.Reshape(out_t, shape=(-1, H))
+    fc = mx.sym.FullyConnected(flat, num_hidden=V, name="cls")
+    label = mx.sym.Variable("softmax_label")
+    label_flat = mx.sym.Reshape(label, shape=(-1,))
+    net = mx.sym.SoftmaxOutput(fc, label_flat, name="softmax")
+
+    exe = net.simple_bind(mx.cpu(), grad_req="write", data=(N, T),
+                          softmax_label=(N, T))
+    ini = mx.initializer.Xavier()
+    rs = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        if name.endswith("parameters") or name.endswith("state") or \
+                name.endswith("state_cell"):
+            arr[:] = rs.randn(*arr.shape) * 0.1 if name.endswith("parameters") \
+                else 0
+        else:
+            ini(name, arr)
+    opt = mx.optimizer.Adam(learning_rate=0.01, rescale_grad=1.0 / (N * T))
+    upd = mx.optimizer.get_updater(opt)
+    for step in range(60):
+        b = (step * N) % (64 - N)
+        exe.arg_dict["data"][:] = data_in[b:b + N]
+        exe.arg_dict["softmax_label"][:] = labels[b:b + N]
+        exe.forward(is_train=True)
+        exe.backward()
+        for i, name in enumerate(exe.arg_names):
+            if name in ("data", "softmax_label") or name.endswith("state") \
+                    or name.endswith("state_cell"):
+                continue
+            upd(i, exe.grad_dict[name], exe.arg_dict[name])
+    exe.arg_dict["data"][:] = data_in[:N]
+    exe.arg_dict["softmax_label"][:] = labels[:N]
+    probs = exe.forward(is_train=False)[0].asnumpy()
+    pred = probs.argmax(axis=1).reshape(N, T)
+    acc = (pred == labels[:N].astype(int)).mean()
+    assert acc > 0.9, f"LSTM LM accuracy {acc}"
