@@ -11,8 +11,11 @@ from .mesh import Mesh, NamedSharding, PartitionSpec, make_mesh, local_mesh, \
 from .collectives import allreduce, allreduce_bench, psum, all_gather, \
     reduce_scatter, ppermute
 from .trainer import ShardedTrainer, sgd_opt, adam_opt
+from .ring_attention import ring_attention, attention_reference
+from .pipeline import pipeline_apply, PipelineModule
 
 __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "make_mesh", "local_mesh",
            "replicated", "shard_along", "allreduce", "allreduce_bench", "psum",
            "all_gather", "reduce_scatter", "ppermute", "ShardedTrainer",
-           "sgd_opt", "adam_opt"]
+           "sgd_opt", "adam_opt", "ring_attention", "attention_reference",
+           "pipeline_apply", "PipelineModule"]

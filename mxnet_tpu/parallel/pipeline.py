@@ -1,0 +1,118 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+The reference had no explicit pipeline schedule — overlap emerged from
+the dependency engine running different layers' ops on different devices
+(SURVEY.md §2.4 "Pipeline parallelism: implicit only").  This module is
+the explicit TPU-native upgrade: each device on the ``pp`` mesh axis owns
+one stage's parameters; microbatches stream through the ring via
+``ppermute`` (ICI neighbor transfers) with a ``lax.scan`` over schedule
+ticks, so the whole pipeline — including the bubble — is one compiled
+XLA program, differentiable end to end (reverse-mode replays the
+schedule backwards).
+
+Requirements: homogeneous stages (same activation shape in/out), stage
+parameters stacked on a leading axis sharded over ``pp``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["pipeline_apply", "PipelineModule"]
+
+
+def pipeline_apply(stage_fn, stacked_params, x, n_microbatches, mesh: Mesh,
+                   axis: str = "pp"):
+    """Run ``x`` through ``n_stages`` copies of ``stage_fn`` as a pipeline.
+
+    Parameters
+    ----------
+    stage_fn : (params_i, activation) -> activation, same shape in/out
+    stacked_params : pytree whose leaves have leading dim n_stages
+        (sharded over ``axis``; each device sees its own stage's slice)
+    x : (batch, ...) global input; split into n_microbatches along batch
+    n_microbatches : must divide batch
+    """
+    n_stages = mesh.shape[axis]
+    B = x.shape[0]
+    if B % n_microbatches:
+        raise ValueError("batch not divisible by n_microbatches")
+    mb = B // n_microbatches
+    xs = x.reshape((n_microbatches, mb) + x.shape[1:])
+    total_ticks = n_microbatches + n_stages - 1
+    pad = jnp.zeros((n_stages - 1,) + xs.shape[1:], xs.dtype)
+    feed = jnp.concatenate([xs, pad], axis=0)  # one injection per tick
+
+    p_spec = jax.tree_util.tree_map(lambda _: PartitionSpec(axis), stacked_params)
+    rep = PartitionSpec()
+
+    def shard_fn(params, feed_local):
+        # params: this device's stage slice, leading dim 1
+        params_i = jax.tree_util.tree_map(lambda p: p[0], params)
+        idx = lax.axis_index(axis)
+        is_first = idx == 0
+        is_last = idx == n_stages - 1
+
+        def tick(carry, feed_t):
+            state, ys = carry
+            inp = jnp.where(is_first, feed_t, state)
+            out = stage_fn(params_i, inp)
+            # shift to the next stage; last stage's send wraps but is unused
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            state_next = lax.ppermute(out, axis, perm)
+            return (state_next, out), out
+
+        state0 = jnp.zeros_like(feed_local[0])
+        ys0 = jnp.zeros_like(feed_local[0])
+        (_, _), outs = lax.scan(tick, (state0, ys0), feed_local)
+        # last stage's outputs for ticks [n_stages-1, total) are the results
+        result = outs[n_stages - 1:]
+        # replicate the last stage's result to every device
+        result = lax.psum(jnp.where(is_last, result, jnp.zeros_like(result)),
+                          axis)
+        return result
+
+    run = shard_map(shard_fn, mesh=mesh, in_specs=(p_spec, rep),
+                    out_specs=rep, check_vma=False)
+    outs = jax.jit(run)(stacked_params, feed)
+    return outs.reshape((B,) + x.shape[1:])
+
+
+class PipelineModule:
+    """Convenience wrapper: N identical stages + heads, trainable.
+
+    ``stage_fn(params_i, x) -> x`` applied pipeline-parallel, with a
+    user ``loss_fn(final_activation, labels) -> scalar`` for training.
+    """
+
+    def __init__(self, stage_fn, stacked_params, mesh, axis="pp",
+                 n_microbatches=4):
+        self.stage_fn = stage_fn
+        self.mesh = mesh
+        self.axis = axis
+        self.n_microbatches = n_microbatches
+        spec = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, PartitionSpec(axis)), stacked_params)
+        self.params = jax.device_put(stacked_params, spec)
+
+    def forward(self, x):
+        return pipeline_apply(self.stage_fn, self.params, x,
+                              self.n_microbatches, self.mesh, self.axis)
+
+    def grad_step(self, x, loss_fn, lr=0.01):
+        """One SGD step through the pipelined computation."""
+
+        def objective(params):
+            out = pipeline_apply(self.stage_fn, params, x,
+                                 self.n_microbatches, self.mesh, self.axis)
+            return loss_fn(out)
+
+        loss, grads = jax.value_and_grad(objective)(self.params)
+        self.params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, self.params, grads)
+        return loss

@@ -1,0 +1,122 @@
+"""Ring attention: sequence/context parallelism for long sequences.
+
+New first-class TPU capability (absent in the reference — SURVEY.md §2.4
+marks sequence parallelism "No"; its long-sequence story was bucketing +
+fused RNN).  Implements blockwise ring attention (Liu et al.: each chip
+holds one sequence shard of Q/K/V; K/V shards rotate around the ring via
+``ppermute`` over ICI while each chip accumulates its Q-block's attention
+with streaming log-sum-exp renormalization).  Peak memory per chip is
+O(S/n * S/n) instead of O(S^2); communication fully overlaps compute on
+the ring.
+
+Exposed as:
+- ``ring_attention(q, k, v, mesh, axis)`` — jitted sharded call;
+- the ``_RingAttention`` symbol op so Symbol graphs can use it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["ring_attention", "attention_reference"]
+
+
+def _block_attn(q, k, v, scale, causal_mask=None):
+    """Scores for one (Q-block, K-block) pair with running-max stats.
+
+    Returns (unnormalized out, row max, row sumexp)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal_mask is not None:
+        s = jnp.where(causal_mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)
+    # fully-masked rows have m = -inf; subtract a finite stand-in so
+    # exp(-inf - m_safe) = 0 instead of NaN
+    m_safe = jnp.maximum(m, -1e30)
+    p = jnp.exp(s - m_safe[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o, m, l
+
+
+def _ring_body(q, k, v, axis_name, n_shards, scale, causal, q_index):
+    """Per-shard ring loop: rotate K/V, accumulate with LSE renorm."""
+    B, H, S_blk, D = q.shape
+
+    def step(carry, i):
+        k_cur, v_cur, o_acc, m_acc, l_acc = carry
+        if causal:
+            # global block index of the current K/V shard
+            kv_index = (q_index - i) % n_shards
+            q_pos = q_index * S_blk + jnp.arange(S_blk)[:, None]
+            k_pos = kv_index * S_blk + jnp.arange(S_blk)[None, :]
+            mask = q_pos >= k_pos
+            mask = jnp.broadcast_to(mask, (B, H, S_blk, S_blk))
+        else:
+            mask = None
+        o_blk, m_blk, l_blk = _block_attn(q, k_cur, v_cur, scale, mask)
+        # streaming renormalization
+        m_new = jnp.maximum(m_acc, m_blk)
+        # guard -inf blocks (fully masked): exp(-inf - -inf) -> use where
+        c_acc = jnp.where(jnp.isfinite(m_acc), jnp.exp(m_acc - m_new), 0.0)
+        c_blk = jnp.where(jnp.isfinite(m_blk), jnp.exp(m_blk - m_new), 0.0)
+        o_new = o_acc * c_acc[..., None] + o_blk * c_blk[..., None]
+        l_new = l_acc * c_acc + l_blk * c_blk
+        # rotate K/V around the ring (ICI neighbor exchange)
+        perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, o_new, m_new, l_new), None
+
+    o0 = jnp.zeros_like(q)
+    m0 = jnp.full((B, H, S_blk), -jnp.inf, q.dtype)
+    l0 = jnp.zeros((B, H, S_blk), q.dtype)
+    (k, v, o, m, l), _ = lax.scan(step, (k, v, o0, m0, l0),
+                                  jnp.arange(n_shards))
+    return o / jnp.maximum(l, 1e-20)[..., None]
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False):
+    """Sharded multi-head attention over a sequence-parallel mesh axis.
+
+    q/k/v: (batch, heads, seq, head_dim), sharded over ``axis`` on the
+    seq dimension (replicated arrays are accepted and sharded here).
+    Returns the attention output with the same sharding.
+    """
+    n_shards = mesh.shape[axis]
+    scale = float(1.0 / np.sqrt(q.shape[-1]))
+    spec = PartitionSpec(None, None, axis, None)
+
+    @jax.jit
+    def run(q, k, v):
+        def shard_fn(q_s, k_s, v_s):
+            idx = lax.axis_index(axis)
+            return _ring_body(q_s, k_s, v_s, axis, n_shards, scale, causal, idx)
+
+        return shard_map(
+            shard_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)(q, k, v)
+
+    sharding = NamedSharding(mesh, spec)
+    q = jax.device_put(q, sharding)
+    k = jax.device_put(k, sharding)
+    v = jax.device_put(v, sharding)
+    return run(q, k, v)
+
+
+def attention_reference(q, k, v, causal=False):
+    """Dense single-device attention for testing."""
+    scale = float(1.0 / np.sqrt(q.shape[-1]))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        S = q.shape[2]
+        mask = np.tril(np.ones((S, S), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
