@@ -33,6 +33,10 @@ from . import callback
 from . import monitor
 from . import io
 from . import recordio
+from . import image_io
+from .image_io import ImageRecordIter
+
+io.ImageRecordIter = ImageRecordIter  # reference exposes it under mx.io
 from . import kvstore
 from . import kvstore as kv
 from . import model

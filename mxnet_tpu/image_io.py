@@ -1,0 +1,248 @@
+"""ImageRecordIter: packed-image dataset pipeline.
+
+Rebuild of the reference image pipeline (src/io/iter_image_recordio.cc:472
++ image_aug_default.cc + iter_normalize.h + iter_batchloader.h +
+iter_prefetcher.h): RecordIO shards -> multi-threaded JPEG decode +
+augmentation -> mean/scale normalize -> batch collation -> background
+prefetch.  Distributed sharding via ``part_index``/``num_parts`` (the
+dmlc InputSplit role).  Decode threads use OpenCV like the reference's
+parser fan-out (iter_image_recordio.cc:150-355).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from . import ndarray as nd
+from . import recordio
+from .base import MXNetError
+from .io import DataBatch, DataDesc, DataIter
+
+__all__ = ["ImageRecordIter", "ImageAugmenter"]
+
+
+class ImageAugmenter:
+    """Default augmentation chain (reference DefaultImageAugParam,
+    src/io/image_aug_default.cc:314): resize, random/center crop, mirror,
+    HSL jitter, rotation."""
+
+    def __init__(self, data_shape, resize=0, rand_crop=False, rand_mirror=False,
+                 mirror=False, rotate=-1, max_rotate_angle=0,
+                 random_h=0, random_s=0, random_l=0, fill_value=255,
+                 inter_method=1, seed=0):
+        self.data_shape = data_shape
+        self.resize = resize
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.mirror = mirror
+        self.rotate = rotate
+        self.max_rotate_angle = max_rotate_angle
+        self.random_h = random_h
+        self.random_s = random_s
+        self.random_l = random_l
+        self.fill_value = fill_value
+
+    def __call__(self, img, rng):
+        import cv2
+
+        if self.resize > 0:
+            h, w = img.shape[:2]
+            if h < w:
+                new_h, new_w = self.resize, int(w * self.resize / h)
+            else:
+                new_h, new_w = int(h * self.resize / w), self.resize
+            img = cv2.resize(img, (new_w, new_h))
+        angle = None
+        if self.rotate >= 0:
+            angle = self.rotate
+        elif self.max_rotate_angle > 0:
+            angle = rng.uniform(-self.max_rotate_angle, self.max_rotate_angle)
+        if angle is not None:
+            h, w = img.shape[:2]
+            mat = cv2.getRotationMatrix2D((w / 2, h / 2), angle, 1.0)
+            img = cv2.warpAffine(img, mat, (w, h),
+                                 borderValue=(self.fill_value,) * 3)
+        # crop to target
+        th, tw = self.data_shape[1], self.data_shape[2]
+        h, w = img.shape[:2]
+        if h < th or w < tw:
+            img = cv2.resize(img, (max(tw, w), max(th, h)))
+            h, w = img.shape[:2]
+        if self.rand_crop:
+            y0 = rng.randint(0, h - th + 1)
+            x0 = rng.randint(0, w - tw + 1)
+        else:
+            y0, x0 = (h - th) // 2, (w - tw) // 2
+        img = img[y0:y0 + th, x0:x0 + tw]
+        if self.mirror or (self.rand_mirror and rng.rand() < 0.5):
+            img = img[:, ::-1]
+        if self.random_h or self.random_s or self.random_l:
+            hsl = cv2.cvtColor(img, cv2.COLOR_BGR2HLS).astype(np.float32)
+            hsl[..., 0] += rng.uniform(-self.random_h, self.random_h)
+            hsl[..., 1] += rng.uniform(-self.random_l, self.random_l)
+            hsl[..., 2] += rng.uniform(-self.random_s, self.random_s)
+            img = cv2.cvtColor(np.clip(hsl, 0, 255).astype(np.uint8),
+                               cv2.COLOR_HLS2BGR)
+        return img
+
+
+class ImageRecordIter(DataIter):
+    """Batched iterator over a packed .rec image dataset.
+
+    Composition mirrors the reference registration
+    (iter_image_recordio.cc:444-476):
+    RecordIO -> [decode+augment thread pool] -> normalize -> batch ->
+    prefetch thread.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, part_index=0, num_parts=1,
+                 preprocess_threads=4, prefetch_buffer=4,
+                 mean_img=None, mean_r=0, mean_g=0, mean_b=0, scale=1.0,
+                 rand_crop=False, rand_mirror=False, mirror=False, resize=0,
+                 max_rotate_angle=0, random_h=0, random_s=0, random_l=0,
+                 data_name="data", label_name="softmax_label", seed=0,
+                 round_batch=True, **aug_kwargs):
+        super().__init__()
+        if not os.path.exists(path_imgrec):
+            raise MXNetError(f"record file not found: {path_imgrec}")
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.data_name = data_name
+        self.label_name = label_name
+        self._rng = np.random.RandomState(seed + part_index)
+        self._aug = ImageAugmenter(self.data_shape, resize=resize,
+                                   rand_crop=rand_crop,
+                                   rand_mirror=rand_mirror, mirror=mirror,
+                                   max_rotate_angle=max_rotate_angle,
+                                   random_h=random_h, random_s=random_s,
+                                   random_l=random_l, **aug_kwargs)
+        self._mean = None
+        if mean_img is not None and os.path.exists(mean_img):
+            self._mean = nd.load(mean_img)["mean_img"].asnumpy()
+        elif mean_r or mean_g or mean_b:
+            self._mean = np.array([mean_b, mean_g, mean_r],
+                                  np.float32).reshape(3, 1, 1)
+        self._scale = scale
+
+        # index all record offsets once, then shard (InputSplit role)
+        offsets = []
+        reader = recordio.MXRecordIO(path_imgrec, "r")
+        while True:
+            pos = reader.tell()
+            if reader.read() is None:
+                break
+            offsets.append(pos)
+        reader.close()
+        self._path = path_imgrec
+        self._offsets = offsets[part_index::num_parts]
+        if not self._offsets:
+            raise MXNetError("no records in partition")
+        self._threads = preprocess_threads
+        self._prefetch = prefetch_buffer
+        self._order = None
+        self._reset_order()
+        self._queue = None
+        self._producer = None
+        self._stop = threading.Event()
+        self._start_producer()
+
+    def _reset_order(self):
+        self._order = np.arange(len(self._offsets))
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+
+    # -- pipeline ----------------------------------------------------------
+    def _decode_one(self, raw, rng_seed):
+        header, img = recordio.unpack_img(raw, iscolor=1)
+        rng = np.random.RandomState(rng_seed)
+        img = self._aug(img, rng)
+        # HWC BGR uint8 -> CHW float32 (reference keeps BGR order of cv2)
+        chw = img.astype(np.float32).transpose(2, 0, 1)
+        if self._mean is not None:
+            chw = chw - self._mean
+        if self._scale != 1.0:
+            chw = chw * self._scale
+        label = header.label
+        if np.isscalar(label):
+            label = np.array([label], np.float32)
+        return chw, np.asarray(label, np.float32)[:self.label_width]
+
+    def _produce_epoch(self, pool, reader):
+        bs = self.batch_size
+        n = len(self._order)
+        for start in range(0, n - bs + 1, bs):
+            idxs = self._order[start:start + bs]
+            raws = []
+            for i in idxs:
+                reader.handle.seek(self._offsets[i])
+                raws.append(reader.read())
+            seeds = self._rng.randint(0, 2**31, size=bs)
+            results = list(pool.map(self._decode_one, raws, seeds))
+            data = np.stack([r[0] for r in results])
+            label = np.stack([r[1] for r in results])
+            if self.label_width == 1:
+                label = label.reshape(bs)
+            yield DataBatch([nd.array(data)], [nd.array(label)], pad=0)
+
+    def _producer_loop(self):
+        pool = ThreadPoolExecutor(max_workers=self._threads,
+                                  thread_name_prefix="imgdec")
+        reader = recordio.MXRecordIO(self._path, "r")
+        try:
+            while not self._stop.is_set():
+                for batch in self._produce_epoch(pool, reader):
+                    if self._stop.is_set():
+                        return
+                    self._queue.put(("batch", batch))
+                self._queue.put(("end", None))
+                self._reset_order()
+        finally:
+            pool.shutdown(wait=False)
+            reader.close()
+
+    def _start_producer(self):
+        self._queue = queue.Queue(maxsize=self._prefetch)
+        self._producer = threading.Thread(target=self._producer_loop,
+                                          daemon=True)
+        self._producer.start()
+
+    # -- DataIter protocol ---------------------------------------------------
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = ((self.batch_size,) if self.label_width == 1
+                 else (self.batch_size, self.label_width))
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        while True:
+            kind, _ = self._queue.get()
+            if kind == "end":
+                return
+
+    def next(self):
+        kind, batch = self._queue.get()
+        if kind == "end":
+            raise StopIteration
+        return batch
+
+    def iter_next(self):
+        try:
+            self._current = self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def __del__(self):
+        self._stop.set()

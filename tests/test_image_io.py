@@ -1,0 +1,90 @@
+"""ImageRecordIter pipeline over a synthetic packed .rec dataset
+(rebuild of tests/python/unittest/test_io.py's ImageRecordIter case)."""
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.image_io import ImageRecordIter
+
+
+@pytest.fixture(scope="module")
+def rec_file(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("data") / "test.rec")
+    writer = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(48):
+        label = i % 4
+        img = np.full((40, 40, 3), label * 60, np.uint8)
+        img += rng.randint(0, 10, img.shape).astype(np.uint8)
+        header = recordio.IRHeader(0, float(label), i, 0)
+        writer.write(recordio.pack_img(header, img, quality=90))
+    writer.close()
+    return path
+
+
+def test_image_record_iter_basic(rec_file):
+    it = ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
+                         batch_size=8, preprocess_threads=2)
+    batches = list(iter_epoch(it))
+    assert len(batches) == 6
+    b = batches[0]
+    assert b.data[0].shape == (8, 3, 32, 32)
+    assert b.label[0].shape == (8,)
+    # labels preserved through pack/decode
+    np.testing.assert_allclose(b.label[0].asnumpy(), np.arange(8) % 4)
+    # pixel content approximately label*60 (jpeg lossy)
+    img0 = b.data[0].asnumpy()[1]
+    assert abs(img0.mean() - 60) < 15
+
+
+def iter_epoch(it):
+    while True:
+        try:
+            yield it.next()
+        except StopIteration:
+            return
+
+
+def test_image_record_iter_reset_and_shuffle(rec_file):
+    it = ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
+                         batch_size=16, shuffle=True, preprocess_threads=2)
+    e1 = [b.label[0].asnumpy().copy() for b in iter_epoch(it)]
+    e2 = [b.label[0].asnumpy().copy() for b in iter_epoch(it)]
+    assert len(e1) == len(e2) == 3
+    assert not all((a == b).all() for a, b in zip(e1, e2))
+
+
+def test_image_record_iter_sharding(rec_file):
+    it0 = ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
+                          batch_size=8, part_index=0, num_parts=2,
+                          preprocess_threads=1)
+    it1 = ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
+                          batch_size=8, part_index=1, num_parts=2,
+                          preprocess_threads=1)
+    l0 = np.concatenate([b.label[0].asnumpy() for b in iter_epoch(it0)])
+    l1 = np.concatenate([b.label[0].asnumpy() for b in iter_epoch(it1)])
+    assert len(l0) == len(l1) == 24
+    np.testing.assert_allclose(l0, np.arange(0, 48, 2) % 4)
+    np.testing.assert_allclose(l1, np.arange(1, 48, 2) % 4)
+
+
+def test_image_record_iter_augment(rec_file):
+    it = ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 24, 24),
+                         batch_size=8, rand_crop=True, rand_mirror=True,
+                         scale=1.0 / 255, preprocess_threads=2)
+    b = next(iter_epoch(it))
+    assert b.data[0].shape == (8, 3, 24, 24)
+    assert float(b.data[0].asnumpy().max()) <= 1.0
+
+
+def test_mean_subtract(rec_file):
+    it = ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
+                         batch_size=8, mean_r=30, mean_g=30, mean_b=30,
+                         preprocess_threads=1)
+    b = next(iter_epoch(it))
+    img0 = b.data[0].asnumpy()[0]  # label 0: pixels ~0..10 minus mean 30
+    assert img0.mean() < 0
