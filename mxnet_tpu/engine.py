@@ -50,7 +50,8 @@ class Var:
     may proceed concurrently; a writer must be alone at the head.
     """
 
-    __slots__ = ("_lock", "_queue", "_active_readers", "_active_writer", "name")
+    __slots__ = ("_lock", "_queue", "_active_readers", "_active_writer",
+                 "name", "native")
 
     def __init__(self, name=None):
         self._lock = threading.Lock()
@@ -58,6 +59,7 @@ class Var:
         self._active_readers = 0
         self._active_writer = False
         self.name = name
+        self.native = None  # C++ var handle when used by NativeEngine
 
     def __repr__(self):
         return f"Var({self.name or hex(id(self))})"
@@ -254,24 +256,115 @@ class ThreadedEngine(Engine):
         pool.submit(self._run, block)
 
 
+class NativeEngine(Engine):
+    """ctypes binding to the C++ engine (src/engine.cc) — the native
+    rebuild of ThreadedEnginePerDevice.  Dependency tracking, queues and
+    worker threads live in C++; Python callables run as callbacks on the
+    C++ workers (ctypes re-acquires the GIL per call)."""
+
+    def __init__(self, num_workers=None, num_io_workers=2):
+        import ctypes
+
+        from .libinfo import find_lib
+
+        super().__init__()
+        self._lib = find_lib()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable; build src/ first")
+        if num_workers is None:
+            num_workers = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS", "4"))
+        self._handle = self._lib.MXTPUEngineCreate(num_workers, num_io_workers)
+        self._CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+        self._live = {}  # keep callbacks alive until executed
+        self._live_lock = threading.Lock()
+        self._ct = ctypes
+
+    def new_variable(self, name=None) -> Var:
+        v = Var(name)
+        v.native = self._lib.MXTPUEngineNewVar(self._handle)
+        return v
+
+    def push(self, fn, const_vars=(), mutable_vars=(), prop=FnProperty.NORMAL,
+             priority=0):
+        ct = self._ct
+        const_vars = tuple(const_vars)
+        mutable_vars = tuple(mutable_vars)
+        seen = set()
+        for v in const_vars + mutable_vars:
+            if id(v) in seen:
+                raise ValueError(f"duplicate variable {v} in dependency sets")
+            seen.add(id(v))
+        token = object()
+
+        def trampoline(_payload, _fn=fn, _token=token):
+            try:
+                _fn()
+            except BaseException as e:
+                with self._pending_lock:
+                    self._exceptions.append(e)
+            finally:
+                with self._live_lock:
+                    self._live.pop(id(_token), None)
+
+        cb = self._CB(trampoline)
+        with self._live_lock:
+            self._live[id(token)] = (cb, token)
+        cvars = (ct.c_void_p * max(1, len(const_vars)))(
+            *[v.native for v in const_vars])
+        mvars = (ct.c_void_p * max(1, len(mutable_vars)))(
+            *[v.native for v in mutable_vars])
+        native_prop = 1 if prop in (FnProperty.COPY_FROM_DEVICE,
+                                    FnProperty.COPY_TO_DEVICE,
+                                    FnProperty.CPU_PRIORITIZED) else 0
+        self._lib.MXTPUEnginePush(self._handle, ct.cast(cb, ct.c_void_p),
+                                  None, cvars, len(const_vars), mvars,
+                                  len(mutable_vars), native_prop)
+
+    def wait_for_var(self, var: Var):
+        self._lib.MXTPUEngineWaitForVar(self._handle, var.native)
+
+    def wait_for_all(self):
+        self._lib.MXTPUEngineWaitForAll(self._handle)
+        with self._pending_lock:
+            if self._exceptions:
+                exc = self._exceptions[:]
+                self._exceptions.clear()
+                raise exc[0]
+
+
 _engine = None
 _engine_lock = threading.Lock()
 
+_ENGINE_KINDS = {}
+
+
+def _make_engine(kind: str) -> Engine:
+    if kind == "NaiveEngine":
+        return NaiveEngine()
+    if kind == "NativeEngine":
+        try:
+            return NativeEngine()
+        except RuntimeError:
+            return ThreadedEngine()
+    return ThreadedEngine()
+
 
 def get_engine() -> Engine:
-    """Singleton engine, selected by MXNET_ENGINE_TYPE (engine.cc:13-39)."""
+    """Singleton engine, selected by MXNET_ENGINE_TYPE (engine.cc:13-39):
+    NaiveEngine | ThreadedEngine | NativeEngine (C++)."""
     global _engine
     with _engine_lock:
         if _engine is None:
-            kind = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEngine")
-            _engine = NaiveEngine() if kind == "NaiveEngine" else ThreadedEngine()
+            _engine = _make_engine(os.environ.get("MXNET_ENGINE_TYPE",
+                                                  "ThreadedEngine"))
         return _engine
 
 
 def set_engine_type(kind: str):
-    """Switch engine implementation ('NaiveEngine' | 'ThreadedEngine')."""
+    """Switch engine implementation ('NaiveEngine' | 'ThreadedEngine' |
+    'NativeEngine')."""
     global _engine
     with _engine_lock:
         if _engine is not None:
             _engine.wait_for_all()
-        _engine = NaiveEngine() if kind == "NaiveEngine" else ThreadedEngine()
+        _engine = _make_engine(kind)

@@ -1,0 +1,87 @@
+"""Native library loading (rebuild of python/mxnet/libinfo.py + base.py's
+ctypes loader).
+
+Finds ``libmxtpu.so`` (the C++ runtime: dependency engine, recordio
+scanner, storage pool — src/*.cc), building it with make on first use if
+a toolchain is available.  All callers degrade gracefully to pure-Python
+implementations when the library is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB = None
+_TRIED = False
+_LOCK = threading.Lock()
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_PKG_DIR, "lib", "libmxtpu.so")
+_SRC_DIR = os.path.join(os.path.dirname(_PKG_DIR), "src")
+
+
+def _build():
+    if not os.path.isdir(_SRC_DIR):
+        return False
+    try:
+        subprocess.run(["make", "-s", "-j4"], cwd=_SRC_DIR, check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _declare(lib):
+    c = ctypes
+    lib.MXTPUEngineCreate.restype = c.c_void_p
+    lib.MXTPUEngineCreate.argtypes = [c.c_int, c.c_int]
+    lib.MXTPUEngineFree.argtypes = [c.c_void_p]
+    lib.MXTPUEngineNewVar.restype = c.c_void_p
+    lib.MXTPUEngineNewVar.argtypes = [c.c_void_p]
+    lib.MXTPUEnginePush.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_void_p, c.POINTER(c.c_void_p), c.c_int,
+        c.POINTER(c.c_void_p), c.c_int, c.c_int]
+    lib.MXTPUEngineWaitForAll.argtypes = [c.c_void_p]
+    lib.MXTPUEngineWaitForVar.argtypes = [c.c_void_p, c.c_void_p]
+    lib.MXTPUEnginePending.restype = c.c_int64
+    lib.MXTPUEnginePending.argtypes = [c.c_void_p]
+
+    lib.MXTPURecordIOIndex.restype = c.c_void_p
+    lib.MXTPURecordIOIndex.argtypes = [c.c_char_p, c.POINTER(c.c_int64)]
+    lib.MXTPURecordIOIndexGet.argtypes = [c.c_void_p, c.c_int64,
+                                          c.POINTER(c.c_uint64),
+                                          c.POINTER(c.c_uint32)]
+    lib.MXTPURecordIOIndexFree.argtypes = [c.c_void_p]
+    lib.MXTPURecordIOReadBatch.restype = c.c_int64
+    lib.MXTPURecordIOReadBatch.argtypes = [
+        c.c_char_p, c.c_void_p, c.POINTER(c.c_int64), c.c_int64,
+        c.POINTER(c.c_uint8), c.c_int64, c.POINTER(c.c_uint32)]
+
+    lib.MXTPUStorageAlloc.restype = c.c_void_p
+    lib.MXTPUStorageAlloc.argtypes = [c.c_uint64]
+    lib.MXTPUStorageFree.argtypes = [c.c_void_p, c.c_uint64]
+    lib.MXTPUStorageReleaseAll.argtypes = []
+    lib.MXTPUStorageStats.argtypes = [c.POINTER(c.c_uint64)] * 4
+    return lib
+
+
+def find_lib():
+    """Load (building if needed) the native library, or None."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        if not os.path.exists(_LIB_PATH):
+            if os.environ.get("MXNET_TPU_NO_NATIVE"):
+                return None
+            if not _build():
+                return None
+        try:
+            _LIB = _declare(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _LIB = None
+        return _LIB

@@ -1,0 +1,93 @@
+"""Host storage pool bindings.
+
+Python surface over the native pooled allocator (src/storage.cc — the
+rebuild of the reference Storage layer, src/storage/pooled_storage_manager.h).
+Device memory is owned by PJRT; this pool serves aligned host staging
+buffers (data-pipeline batches, checkpoint IO) where the reference used
+pinned cudaMallocHost memory.  Falls back to plain numpy allocation when
+the native library is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .libinfo import find_lib
+
+__all__ = ["alloc", "free", "release_all", "stats", "StagingBuffer"]
+
+
+def _lib():
+    return find_lib()
+
+
+def alloc(size: int):
+    """Allocate ``size`` bytes from the pool; returns an int address or
+    None without the native library."""
+    lib = _lib()
+    if lib is None:
+        return None
+    return lib.MXTPUStorageAlloc(ctypes.c_uint64(size))
+
+
+def free(ptr, size: int):
+    lib = _lib()
+    if lib is not None and ptr:
+        lib.MXTPUStorageFree(ctypes.c_void_p(ptr), ctypes.c_uint64(size))
+
+
+def release_all():
+    """Drop all pooled buffers (release-on-pressure hook)."""
+    lib = _lib()
+    if lib is not None:
+        lib.MXTPUStorageReleaseAll()
+
+
+def stats() -> dict:
+    lib = _lib()
+    if lib is None:
+        return {"native": False}
+    vals = [ctypes.c_uint64() for _ in range(4)]
+    lib.MXTPUStorageStats(*[ctypes.byref(v) for v in vals])
+    return {"native": True,
+            "allocated_bytes": vals[0].value,
+            "pooled_bytes": vals[1].value,
+            "alloc_count": vals[2].value,
+            "pool_hits": vals[3].value}
+
+
+class StagingBuffer:
+    """A pooled host buffer viewable as a numpy array.
+
+    Usage::
+
+        with StagingBuffer((256, 3, 224, 224), np.float32) as arr:
+            arr[...] = batch
+            dev = jax.device_put(arr)
+    """
+
+    def __init__(self, shape, dtype=np.float32):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.nbytes = int(np.prod(self.shape)) * self.dtype.itemsize
+        self._ptr = alloc(self.nbytes)
+        if self._ptr:
+            buf = (ctypes.c_char * self.nbytes).from_address(self._ptr)
+            self.array = np.frombuffer(buf, dtype=self.dtype).reshape(self.shape)
+        else:  # fallback: plain numpy
+            self.array = np.empty(self.shape, self.dtype)
+
+    def __enter__(self):
+        return self.array
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def close(self):
+        if self._ptr:
+            free(self._ptr, self.nbytes)
+            self._ptr = None
+            self.array = None

@@ -1,0 +1,273 @@
+// Native dependency engine.
+//
+// C++ rebuild of the reference's threaded dataflow scheduler
+// (src/engine/threaded_engine.{h,cc} + threaded_engine_perdevice.cc):
+// versioned variables hold FIFO queues of pending reader/writer blocks;
+// an operation becomes runnable when every const var has granted read
+// access and every mutable var has reached the queue head; completions
+// release successors.  Worker pool with a separate prioritized lane
+// (the reference's kCPUPrioritized / IO pools).
+//
+// Ops are opaque callbacks (host work: IO stages, checkpoint writes,
+// staging copies); device compute is scheduled by XLA/PJRT.  Exposed
+// through the flat C API in c_api.cc and driven from Python via ctypes.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace mxtpu {
+
+typedef void (*OpCallback)(void* payload);
+
+struct OprBlock;
+
+struct Var {
+  std::mutex mu;
+  // pending accessors: (block, is_write)
+  std::deque<std::pair<OprBlock*, bool>> queue;
+  int active_readers = 0;
+  bool active_writer = false;
+};
+
+struct OprBlock {
+  OpCallback fn;
+  void* payload;
+  std::vector<Var*> const_vars;
+  std::vector<Var*> mutable_vars;
+  std::atomic<int> wait{0};
+  int prop = 0;  // 0 normal, 1 prioritized/IO
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers, int num_io_workers) : shutdown_(false) {
+    if (num_workers < 1) num_workers = 1;
+    if (num_io_workers < 1) num_io_workers = 1;
+    for (int i = 0; i < num_workers; ++i)
+      workers_.emplace_back([this] { WorkerLoop(/*io=*/false); });
+    for (int i = 0; i < num_io_workers; ++i)
+      workers_.emplace_back([this] { WorkerLoop(/*io=*/true); });
+  }
+
+  ~Engine() {
+    WaitForAll();
+    {
+      std::lock_guard<std::mutex> lk(task_mu_);
+      shutdown_ = true;
+    }
+    task_cv_.notify_all();
+    for (auto& t : workers_) t.join();
+    for (Var* v : all_vars_) delete v;
+  }
+
+  Var* NewVar() {
+    Var* v = new Var();
+    std::lock_guard<std::mutex> lk(vars_mu_);
+    all_vars_.push_back(v);
+    return v;
+  }
+
+  // Push an op with read/write sets (threaded_engine.cc:255-300).
+  void Push(OpCallback fn, void* payload, Var** const_vars, int n_const,
+            Var** mutable_vars, int n_mutable, int prop) {
+    OprBlock* blk = new OprBlock();
+    blk->fn = fn;
+    blk->payload = payload;
+    blk->prop = prop;
+    blk->const_vars.assign(const_vars, const_vars + n_const);
+    blk->mutable_vars.assign(mutable_vars, mutable_vars + n_mutable);
+    blk->wait.store(n_const + n_mutable + 1, std::memory_order_relaxed);
+    pending_.fetch_add(1, std::memory_order_relaxed);
+
+    int granted = 1;  // the +1 sentinel: all appended before dispatch
+    for (Var* v : blk->const_vars)
+      if (AppendRead(v, blk)) ++granted;
+    for (Var* v : blk->mutable_vars)
+      if (AppendWrite(v, blk)) ++granted;
+    if (blk->wait.fetch_sub(granted) == granted) Dispatch(blk);
+  }
+
+  void WaitForAll() {
+    std::unique_lock<std::mutex> lk(done_mu_);
+    done_cv_.wait(lk, [this] { return pending_.load() == 0; });
+  }
+
+  // Wait until all currently-pushed ops touching var complete: push a
+  // read op that signals (the reference's WaitForVar).
+  void WaitForVar(Var* var) {
+    std::mutex mu;
+    std::condition_variable cv;
+    bool done = false;
+    struct Ctx { std::mutex* mu; std::condition_variable* cv; bool* done; };
+    Ctx ctx{&mu, &cv, &done};
+    Var* cvars[1] = {var};
+    Push(
+        [](void* p) {
+          Ctx* c = static_cast<Ctx*>(p);
+          std::lock_guard<std::mutex> lk(*c->mu);
+          *c->done = true;
+          c->cv->notify_all();
+        },
+        &ctx, cvars, 1, nullptr, 0, /*prop=*/1);
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] { return done; });
+  }
+
+  int64_t Pending() const { return pending_.load(); }
+
+ private:
+  bool AppendRead(Var* v, OprBlock* blk) {
+    std::lock_guard<std::mutex> lk(v->mu);
+    if (!v->active_writer && v->queue.empty()) {
+      ++v->active_readers;
+      return true;
+    }
+    v->queue.emplace_back(blk, false);
+    return false;
+  }
+
+  bool AppendWrite(Var* v, OprBlock* blk) {
+    std::lock_guard<std::mutex> lk(v->mu);
+    if (!v->active_writer && v->active_readers == 0 && v->queue.empty()) {
+      v->active_writer = true;
+      return true;
+    }
+    v->queue.emplace_back(blk, true);
+    return false;
+  }
+
+  void Release(Var* v, bool was_write) {
+    std::vector<OprBlock*> to_check;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (was_write)
+        v->active_writer = false;
+      else
+        --v->active_readers;
+      while (!v->queue.empty() && !v->active_writer) {
+        auto [blk, is_write] = v->queue.front();
+        if (is_write) {
+          if (v->active_readers == 0) {
+            v->queue.pop_front();
+            v->active_writer = true;
+            to_check.push_back(blk);
+          }
+          break;
+        }
+        v->queue.pop_front();
+        ++v->active_readers;
+        to_check.push_back(blk);
+      }
+    }
+    for (OprBlock* blk : to_check)
+      if (blk->wait.fetch_sub(1) == 1) Dispatch(blk);
+  }
+
+  void Dispatch(OprBlock* blk) {
+    {
+      std::lock_guard<std::mutex> lk(task_mu_);
+      if (blk->prop == 1)
+        io_tasks_.push(blk);
+      else
+        tasks_.push(blk);
+    }
+    task_cv_.notify_one();
+  }
+
+  void Complete(OprBlock* blk) {
+    for (Var* v : blk->const_vars) Release(v, false);
+    for (Var* v : blk->mutable_vars) Release(v, true);
+    delete blk;
+    if (pending_.fetch_sub(1) == 1) {
+      std::lock_guard<std::mutex> lk(done_mu_);
+      done_cv_.notify_all();
+    }
+  }
+
+  void WorkerLoop(bool io) {
+    for (;;) {
+      OprBlock* blk = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(task_mu_);
+        task_cv_.wait(lk, [&] {
+          return shutdown_ || !tasks_.empty() || !io_tasks_.empty();
+        });
+        if (shutdown_ && tasks_.empty() && io_tasks_.empty()) return;
+        std::queue<OprBlock*>& primary = io ? io_tasks_ : tasks_;
+        std::queue<OprBlock*>& secondary = io ? tasks_ : io_tasks_;
+        if (!primary.empty()) {
+          blk = primary.front();
+          primary.pop();
+        } else if (!secondary.empty()) {
+          blk = secondary.front();
+          secondary.pop();
+        }
+      }
+      if (blk != nullptr) {
+        blk->fn(blk->payload);
+        Complete(blk);
+      }
+    }
+  }
+
+  std::vector<std::thread> workers_;
+  std::queue<OprBlock*> tasks_;
+  std::queue<OprBlock*> io_tasks_;
+  std::mutex task_mu_;
+  std::condition_variable task_cv_;
+  bool shutdown_;
+  std::atomic<int64_t> pending_{0};
+  std::mutex done_mu_;
+  std::condition_variable done_cv_;
+  std::mutex vars_mu_;
+  std::vector<Var*> all_vars_;
+};
+
+}  // namespace mxtpu
+
+// ---------------------------------------------------------------------------
+// flat C API (the src/c_api role for the engine)
+// ---------------------------------------------------------------------------
+extern "C" {
+
+void* MXTPUEngineCreate(int num_workers, int num_io_workers) {
+  return new mxtpu::Engine(num_workers, num_io_workers);
+}
+
+void MXTPUEngineFree(void* engine) {
+  delete static_cast<mxtpu::Engine*>(engine);
+}
+
+void* MXTPUEngineNewVar(void* engine) {
+  return static_cast<mxtpu::Engine*>(engine)->NewVar();
+}
+
+void MXTPUEnginePush(void* engine, mxtpu::OpCallback fn, void* payload,
+                     void** const_vars, int n_const, void** mutable_vars,
+                     int n_mutable, int prop) {
+  static_cast<mxtpu::Engine*>(engine)->Push(
+      fn, payload, reinterpret_cast<mxtpu::Var**>(const_vars), n_const,
+      reinterpret_cast<mxtpu::Var**>(mutable_vars), n_mutable, prop);
+}
+
+void MXTPUEngineWaitForAll(void* engine) {
+  static_cast<mxtpu::Engine*>(engine)->WaitForAll();
+}
+
+void MXTPUEngineWaitForVar(void* engine, void* var) {
+  static_cast<mxtpu::Engine*>(engine)->WaitForVar(
+      static_cast<mxtpu::Var*>(var));
+}
+
+int64_t MXTPUEnginePending(void* engine) {
+  return static_cast<mxtpu::Engine*>(engine)->Pending();
+}
+
+}  // extern "C"

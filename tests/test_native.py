@@ -1,0 +1,130 @@
+"""Native C++ runtime components (src/*.cc via ctypes): engine
+dependency semantics, recordio scanner, storage pool."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.libinfo import find_lib
+
+pytestmark = pytest.mark.skipif(find_lib() is None,
+                                reason="native library not built")
+
+
+def test_native_engine_workload():
+    from mxnet_tpu.engine import NativeEngine
+
+    engine = NativeEngine(num_workers=4)
+    import random
+
+    rng = random.Random(0)
+    history = []
+    lock = threading.Lock()
+    variables = [engine.new_variable(f"v{i}") for i in range(6)]
+    n_ops = 80
+    for op_id in range(n_ops):
+        n_read = rng.randint(0, 2)
+        n_write = rng.randint(1, 2)
+        picks = rng.sample(range(6), n_read + n_write)
+        reads = [variables[i] for i in picks[:n_read]]
+        writes = [variables[i] for i in picks[n_read:]]
+
+        def fn(op_id=op_id, w=tuple(picks[n_read:])):
+            with lock:
+                history.append((op_id, w))
+
+        engine.push(fn, const_vars=reads, mutable_vars=writes)
+    engine.wait_for_all()
+    assert sorted(h[0] for h in history) == list(range(n_ops))
+    last_write = {}
+    for op_id, writes in history:
+        for v in writes:
+            if v in last_write:
+                assert last_write[v] < op_id
+            last_write[v] = op_id
+
+
+def test_native_engine_wait_for_var():
+    from mxnet_tpu.engine import NativeEngine
+
+    engine = NativeEngine(num_workers=2)
+    v = engine.new_variable()
+    done = []
+    engine.push(lambda: (time.sleep(0.05), done.append(1)), mutable_vars=(v,))
+    engine.wait_for_var(v)
+    assert done == [1]
+    engine.wait_for_all()
+
+
+def test_native_engine_exception():
+    from mxnet_tpu.engine import NativeEngine
+
+    engine = NativeEngine(num_workers=2)
+
+    def bad():
+        raise RuntimeError("native boom")
+
+    engine.push(bad)
+    with pytest.raises(RuntimeError, match="native boom"):
+        engine.wait_for_all()
+
+
+def test_native_recordio_index(tmp_path):
+    import ctypes
+
+    from mxnet_tpu import recordio
+
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [bytes([i]) * (10 + i * 7) for i in range(20)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+    lib = find_lib()
+    n = ctypes.c_int64()
+    idx = lib.MXTPURecordIOIndex(path.encode(), ctypes.byref(n))
+    assert idx and n.value == 20
+    off = ctypes.c_uint64()
+    length = ctypes.c_uint32()
+    lib.MXTPURecordIOIndexGet(idx, 3, ctypes.byref(off), ctypes.byref(length))
+    assert length.value == len(payloads[3])
+
+    indices = (ctypes.c_int64 * 3)(5, 0, 19)
+    total = sum(len(payloads[i]) for i in (5, 0, 19))
+    buf = (ctypes.c_uint8 * (total + 16))()
+    sizes = (ctypes.c_uint32 * 3)()
+    got = lib.MXTPURecordIOReadBatch(path.encode(), idx, indices, 3, buf,
+                                     len(buf), sizes)
+    assert got == total
+    pos = 0
+    for j, i in enumerate((5, 0, 19)):
+        assert bytes(buf[pos:pos + sizes[j]]) == payloads[i]
+        pos += sizes[j]
+    lib.MXTPURecordIOIndexFree(idx)
+
+
+def test_storage_pool():
+    from mxnet_tpu import storage
+
+    s0 = storage.stats()
+    assert s0["native"]
+    p1 = storage.alloc(1 << 20)
+    assert p1
+    storage.free(p1, 1 << 20)
+    p2 = storage.alloc(1 << 20)  # should come from the pool
+    s1 = storage.stats()
+    assert s1["pool_hits"] > s0.get("pool_hits", 0)
+    storage.free(p2, 1 << 20)
+    storage.release_all()
+    assert storage.stats()["pooled_bytes"] == 0
+
+
+def test_staging_buffer_numpy_view():
+    from mxnet_tpu.storage import StagingBuffer
+
+    with StagingBuffer((4, 8), np.float32) as arr:
+        arr[:] = np.arange(32).reshape(4, 8)
+        assert arr.sum() == np.arange(32).sum()
