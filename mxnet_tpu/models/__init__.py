@@ -5,6 +5,7 @@ from .lenet import get_symbol as lenet
 from .mlp import get_symbol as mlp
 from .resnet import get_symbol as resnet
 from .lstm import lstm_unroll, lstm_cell, LSTMState, LSTMParam
+from .ssd import get_symbol as ssd
 
 __all__ = ["lenet", "mlp", "resnet", "lstm_unroll", "lstm_cell",
-           "LSTMState", "LSTMParam"]
+           "LSTMState", "LSTMParam", "ssd"]

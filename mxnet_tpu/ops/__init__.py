@@ -14,6 +14,8 @@ from . import nn  # noqa: F401
 from . import loss  # noqa: F401
 from . import sequence  # noqa: F401
 from . import rnn  # noqa: F401
+from . import vision  # noqa: F401
+from . import multibox  # noqa: F401
 from . import sample  # noqa: F401
 
 __all__ = ["OP_REGISTRY", "OpDef", "SimpleOpDef", "register_op", "register_simple_op"]

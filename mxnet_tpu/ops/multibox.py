@@ -1,0 +1,284 @@
+"""SSD multibox operators.
+
+Rebuild of the reference SSD example's native CUDA/C++ operators
+(example/ssd/operator/multibox_{prior,target,detection}-inl.h + .cu):
+anchor generation, training-target matching and detection decoding/NMS —
+all as static-shape vectorized JAX so they fuse into the SSD graph.
+
+Box format: corner (xmin, ymin, xmax, ymax), normalized to [0, 1].
+Ground-truth label rows: [class_id, xmin, ymin, xmax, ymax]; class −1
+pads invalid rows (reference convention).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..param import Params, field, tuple_of
+from .op import OpDef, register_op
+
+
+def _iou(a, b):
+    """IOU matrix between (A, 4) and (B, 4) corner boxes."""
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1, 0)
+    ih = jnp.maximum(iy2 - iy1, 0)
+    inter = iw * ih
+    area_a = jnp.maximum((a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1]), 0)
+    area_b = jnp.maximum((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]), 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+# -- MultiBoxPrior -----------------------------------------------------------
+class MultiBoxPriorParam(Params):
+    sizes = field(tuple_of(float), default=(1.0,))
+    ratios = field(tuple_of(float), default=(1.0,))
+    clip = field(bool, default=False)
+    steps = field(tuple_of(float), default=None)
+    offsets = field(tuple_of(float), default=(0.5, 0.5))
+
+
+@register_op("MultiBoxPrior", aliases=("_contrib_MultiBoxPrior",))
+class MultiBoxPriorOp(OpDef):
+    """Anchor boxes per feature-map cell (multibox_prior-inl.h):
+    num_anchors = len(sizes) + len(ratios) - 1."""
+
+    param_cls = MultiBoxPriorParam
+
+    def _num_anchors(self, params):
+        return len(params.sizes) + len(params.ratios) - 1
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        n_anchor = self._num_anchors(params)
+        return list(in_shapes), [(1, d[2] * d[3] * n_anchor, 4)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        H, W = inputs[0].shape[2], inputs[0].shape[3]
+        step_y = params.steps[1] if params.steps else 1.0 / H
+        step_x = params.steps[0] if params.steps else 1.0 / W
+        oy, ox = params.offsets
+        cy = (jnp.arange(H) + oy) * step_y
+        cx = (jnp.arange(W) + ox) * step_x
+        # anchor (w, h) list: all sizes with ratio[0], then ratios[1:] with
+        # sizes[0] (reference enumeration)
+        whs = []
+        r0 = np.sqrt(params.ratios[0])
+        for s in params.sizes:
+            whs.append((s * r0, s / r0))
+        for r in params.ratios[1:]:
+            sr = np.sqrt(r)
+            whs.append((params.sizes[0] * sr, params.sizes[0] / sr))
+        whs = jnp.asarray(whs)  # (A, 2)
+        gy, gx = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+        centers = jnp.stack([gx, gy], axis=-1).reshape(-1, 1, 2)  # (HW,1,2)
+        half = whs.reshape(1, -1, 2) / 2.0
+        boxes = jnp.concatenate([centers - half, centers + half], axis=-1)
+        boxes = boxes.reshape(1, -1, 4)
+        if params.clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        return [boxes.astype(inputs[0].dtype)], []
+
+
+# -- MultiBoxTarget ----------------------------------------------------------
+class MultiBoxTargetParam(Params):
+    overlap_threshold = field(float, default=0.5)
+    ignore_label = field(float, default=-1.0)
+    negative_mining_ratio = field(float, default=-1.0)
+    negative_mining_thresh = field(float, default=0.5)
+    minimum_negative_samples = field(int, default=0)
+    variances = field(tuple_of(float), default=(0.1, 0.1, 0.2, 0.2))
+
+
+@register_op("MultiBoxTarget", aliases=("_contrib_MultiBoxTarget",))
+class MultiBoxTargetOp(OpDef):
+    """Match anchors to ground truth, emit regression targets + masks +
+    classification targets (multibox_target-inl.h).
+
+    inputs: anchors (1, A, 4), labels (N, M, 5), cls_preds (N, cls+1, A)
+    outputs: loc_target (N, A*4), loc_mask (N, A*4), cls_target (N, A)
+    """
+
+    param_cls = MultiBoxTargetParam
+    is_loss = True  # matching is not differentiated
+
+    def list_arguments(self, params):
+        return ["anchor", "label", "cls_pred"]
+
+    def list_outputs(self, params):
+        return ["loc_target", "loc_mask", "cls_target"]
+
+    def infer_shape(self, params, in_shapes):
+        anchor, label, cls_pred = in_shapes
+        A = anchor[1]
+        N = label[0]
+        return list(in_shapes), [(N, A * 4), (N, A * 4), (N, A)], []
+
+    def infer_dtype(self, params, in_dtypes):
+        dt = in_dtypes[0] or np.dtype(np.float32)
+        return [dt] * 3, [dt] * 3, []
+
+    def forward(self, params, inputs, aux, train, key):
+        anchors = inputs[0][0]  # (A, 4)
+        labels = inputs[1]  # (N, M, 5)
+        cls_preds = inputs[2]  # (N, cls+1, A)
+        variances = jnp.asarray(params.variances)
+        A = anchors.shape[0]
+
+        def encode(anchor, gt):
+            aw = anchor[:, 2] - anchor[:, 0]
+            ah = anchor[:, 3] - anchor[:, 1]
+            acx = (anchor[:, 0] + anchor[:, 2]) / 2
+            acy = (anchor[:, 1] + anchor[:, 3]) / 2
+            gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-8)
+            gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-8)
+            gcx = (gt[:, 0] + gt[:, 2]) / 2
+            gcy = (gt[:, 1] + gt[:, 3]) / 2
+            tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / variances[0]
+            ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / variances[1]
+            tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / variances[2]
+            th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / variances[3]
+            return jnp.stack([tx, ty, tw, th], axis=-1)
+
+        def one_sample(label, cls_pred):
+            valid = label[:, 0] >= 0  # (M,)
+            gt_boxes = label[:, 1:5]
+            iou = _iou(anchors, gt_boxes)  # (A, M)
+            iou = jnp.where(valid[None, :], iou, -1.0)
+            best_gt = jnp.argmax(iou, axis=1)  # (A,)
+            best_iou = jnp.max(iou, axis=1)
+            assigned = best_iou >= params.overlap_threshold
+            # bipartite: each valid gt claims its best anchor
+            best_anchor = jnp.argmax(iou, axis=0)  # (M,)
+            claim = jnp.zeros((A,), bool).at[best_anchor].set(valid)
+            claimed_gt = jnp.zeros((A,), jnp.int32).at[best_anchor].set(
+                jnp.arange(label.shape[0], dtype=jnp.int32))
+            gt_idx = jnp.where(claim, claimed_gt, best_gt)
+            pos = assigned | claim
+            matched = gt_boxes[gt_idx]  # (A, 4)
+            loc_t = encode(anchors, matched)
+            loc_t = jnp.where(pos[:, None], loc_t, 0.0).reshape(-1)
+            loc_m = jnp.where(pos[:, None], 1.0,
+                              0.0).repeat(1).reshape(A, 1).repeat(4, 1).reshape(-1)
+            cls_t = jnp.where(pos, label[gt_idx, 0] + 1, 0.0)  # 0 = background
+            if params.negative_mining_ratio > 0:
+                # hard negatives: highest background loss (= max non-bg
+                # score) first, keep ratio * num_pos
+                neg_score = jnp.max(cls_pred[1:], axis=0) - cls_pred[0]
+                neg_score = jnp.where(pos, -jnp.inf, neg_score)
+                num_pos = jnp.sum(pos)
+                num_neg = jnp.maximum(
+                    (params.negative_mining_ratio * num_pos).astype(jnp.int32),
+                    params.minimum_negative_samples)
+                order = jnp.argsort(-neg_score)
+                rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A))
+                keep_neg = (~pos) & (rank < num_neg)
+                cls_t = jnp.where(pos | keep_neg, cls_t, params.ignore_label)
+            return loc_t, loc_m, cls_t
+
+        loc_t, loc_m, cls_t = jax.vmap(one_sample)(labels, cls_preds)
+        return [lax.stop_gradient(loc_t), lax.stop_gradient(loc_m),
+                lax.stop_gradient(cls_t)], []
+
+    def backward(self, params, out_grads, inputs, outputs):
+        return [jnp.zeros_like(x) for x in inputs]
+
+
+# -- MultiBoxDetection -------------------------------------------------------
+class MultiBoxDetectionParam(Params):
+    clip = field(bool, default=True)
+    threshold = field(float, default=0.01)
+    background_id = field(int, default=0)
+    nms_threshold = field(float, default=0.5)
+    force_suppress = field(bool, default=False)
+    variances = field(tuple_of(float), default=(0.1, 0.1, 0.2, 0.2))
+    nms_topk = field(int, default=-1)
+
+
+@register_op("MultiBoxDetection", aliases=("_contrib_MultiBoxDetection",))
+class MultiBoxDetectionOp(OpDef):
+    """Decode predictions + per-class NMS (multibox_detection-inl.h).
+
+    inputs: cls_prob (N, cls+1, A), loc_pred (N, A*4), anchors (1, A, 4)
+    output: (N, A, 6) rows [class_id, score, x1, y1, x2, y2]; class −1
+    marks suppressed/invalid entries.
+    """
+
+    param_cls = MultiBoxDetectionParam
+    is_loss = True
+
+    def list_arguments(self, params):
+        return ["cls_prob", "loc_pred", "anchor"]
+
+    def infer_shape(self, params, in_shapes):
+        cls_prob = in_shapes[0]
+        A = in_shapes[2][1]
+        return list(in_shapes), [(cls_prob[0], A, 6)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        cls_prob, loc_pred, anchors = inputs
+        anchors = anchors[0]
+        variances = jnp.asarray(params.variances)
+        N = cls_prob.shape[0]
+        A = anchors.shape[0]
+
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+        def one(probs, locs):
+            t = locs.reshape(A, 4)
+            cx = t[:, 0] * variances[0] * aw + acx
+            cy = t[:, 1] * variances[1] * ah + acy
+            w = jnp.exp(t[:, 2] * variances[2]) * aw
+            h = jnp.exp(t[:, 3] * variances[3]) * ah
+            boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                              axis=-1)
+            if params.clip:
+                boxes = jnp.clip(boxes, 0.0, 1.0)
+            # best non-background class per anchor
+            fg = jnp.concatenate(
+                [probs[:params.background_id], probs[params.background_id + 1:]],
+                axis=0)
+            # class ids are foreground-relative (reference convention:
+            # original class minus the background slot)
+            best = jnp.argmax(fg, axis=0)
+            cls_id = best.astype(jnp.float32)
+            score = jnp.max(fg, axis=0)
+            keep = score > params.threshold
+            cls_id = jnp.where(keep, cls_id, -1.0)
+            score = jnp.where(keep, score, 0.0)
+            # NMS: greedy over score order
+            order = jnp.argsort(-score)
+            boxes_o = boxes[order]
+            cls_o = cls_id[order]
+            score_o = score[order]
+            iou = _iou(boxes_o, boxes_o)
+            same = (cls_o[:, None] == cls_o[None, :]) | params.force_suppress
+            sup_matrix = (iou > params.nms_threshold) & same
+            topk = params.nms_topk if params.nms_topk > 0 else A
+
+            def body(i, alive):
+                is_alive = alive[i] & (cls_o[i] >= 0) & (i < topk)
+                kill = sup_matrix[i] & (jnp.arange(A) > i) & is_alive
+                return alive & ~kill
+
+            alive = lax.fori_loop(0, A, body, jnp.ones((A,), bool))
+            alive = alive & (cls_o >= 0) & (jnp.arange(A) < topk)
+            cls_f = jnp.where(alive, cls_o, -1.0)
+            out = jnp.concatenate([cls_f[:, None], score_o[:, None], boxes_o],
+                                  axis=-1)
+            return out
+
+        return [lax.stop_gradient(jax.vmap(one)(cls_prob, loc_pred))], []
+
+    def backward(self, params, out_grads, inputs, outputs):
+        return [jnp.zeros_like(x) for x in inputs]

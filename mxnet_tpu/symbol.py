@@ -381,10 +381,15 @@ def _sym_ufunc(lhs, rhs, op_name, scalar_op_name):
 def _infer_graph(topo, known, what, partial):
     """Forward inference over the graph; two passes so late-discovered
     variable values (e.g. FC weight shapes) propagate."""
+    import ast as _ast
+
     values = {}  # ("var", name) | ("out", node_id, idx) -> value
     for n in topo:
         if n.is_variable:
-            values["var", n.name] = known.get(n.name)
+            v = known.get(n.name)
+            if v is None and what == "shape" and "__shape__" in n.attrs:
+                v = tuple(_ast.literal_eval(n.attrs["__shape__"]))
+            values["var", n.name] = v
     for _ in range(2):
         progress = False
         for node in topo:

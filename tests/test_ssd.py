@@ -1,0 +1,65 @@
+"""SSD model graph: shapes, forward/backward, detection path
+(BASELINE config 5; reference example/ssd)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+@pytest.fixture(scope="module")
+def small_input():
+    # SSD300 geometry with narrow channels keeps CI fast
+    return (2, 3, 300, 300)
+
+
+def test_ssd_train_graph(small_input):
+    np.random.seed(0)
+    net = mx.models.ssd(num_classes=3, mode="train", filter_scale=16)
+    args = net.list_arguments()
+    assert "label" in args and "data" in args
+    exe = net.simple_bind(mx.cpu(), grad_req="write", data=small_input,
+                          label=(small_input[0], 4, 5))
+    ini = mx.initializer.Xavier()
+    for name, arr in exe.arg_dict.items():
+        if name in ("data", "label"):
+            continue
+        if name == "relu4_3_scale":
+            arr[:] = 20.0
+        else:
+            ini(name, arr)
+    exe.arg_dict["data"][:] = np.random.randn(*small_input) * 0.3
+    labels = np.full((small_input[0], 4, 5), -1, np.float32)
+    labels[0, 0] = [1, 0.1, 0.1, 0.4, 0.4]
+    labels[1, 0] = [0, 0.5, 0.5, 0.9, 0.9]
+    exe.arg_dict["label"][:] = labels
+    outs = exe.forward(is_train=True)
+    cls_prob, loc_loss, cls_label = [o.asnumpy() for o in outs]
+    assert np.isfinite(cls_prob).all()
+    assert np.isfinite(loc_loss).all()
+    assert (cls_label >= -1).all()
+    exe.backward()
+    g = exe.grad_dict["conv1_1_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_ssd_detection_graph(small_input):
+    np.random.seed(1)
+    net = mx.models.ssd(num_classes=3, mode="det", filter_scale=16)
+    exe = net.simple_bind(mx.cpu(), grad_req="null", data=small_input)
+    ini = mx.initializer.Xavier()
+    for name, arr in exe.arg_dict.items():
+        if name == "data":
+            continue
+        if name == "relu4_3_scale":
+            arr[:] = 20.0
+        else:
+            ini(name, arr)
+    exe.arg_dict["data"][:] = np.random.randn(*small_input) * 0.3
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.ndim == 3 and out.shape[0] == small_input[0]
+    assert out.shape[2] == 6
+    # every row is either invalid (-1) or [cls, score, box] with score in (0,1]
+    valid = out[out[:, :, 0] >= 0]
+    if len(valid):
+        assert ((valid[:, 1] > 0) & (valid[:, 1] <= 1)).all()

@@ -1,0 +1,139 @@
+"""Vision + multibox operators (rebuild of the reference coverage for
+roi_pooling/spatial_transformer/correlation and the SSD example ops)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import simple_forward
+
+rng = np.random.RandomState(0)
+
+
+def test_roi_pooling():
+    data = np.arange(1 * 1 * 8 * 8, dtype=np.float32).reshape(1, 1, 8, 8)
+    rois = np.array([[0, 0, 0, 7, 7],
+                     [0, 2, 2, 5, 5]], np.float32)
+    sym = mx.sym.ROIPooling(mx.sym.Variable("data"), mx.sym.Variable("rois"),
+                            pooled_size=(2, 2), spatial_scale=1.0)
+    out = simple_forward(sym, data=data, rois=rois)
+    assert out.shape == (2, 1, 2, 2)
+    # full-image roi: max of each quadrant
+    np.testing.assert_allclose(out[0, 0], [[27, 31], [59, 63]])
+    # sub roi 2..5: quadrants within
+    sub = data[0, 0, 2:6, 2:6]
+    np.testing.assert_allclose(out[1, 0], [[sub[:2, :2].max(), sub[:2, 2:].max()],
+                                           [sub[2:, :2].max(), sub[2:, 2:].max()]])
+
+
+def test_spatial_transformer_identity():
+    data = rng.randn(2, 3, 6, 6).astype(np.float32)
+    loc = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    sym = mx.sym.SpatialTransformer(mx.sym.Variable("data"),
+                                    mx.sym.Variable("loc"),
+                                    target_shape=(6, 6))
+    out = simple_forward(sym, data=data, loc=loc)
+    np.testing.assert_allclose(out, data, rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_transformer_shift_and_scale():
+    data = np.zeros((1, 1, 5, 5), np.float32)
+    data[0, 0, 2, 2] = 1.0
+    # zoom out x2: output samples from [-2,2] range of input coords
+    loc = np.array([[2, 0, 0, 0, 2, 0]], np.float32)
+    sym = mx.sym.SpatialTransformer(mx.sym.Variable("data"),
+                                    mx.sym.Variable("loc"),
+                                    target_shape=(5, 5))
+    out = simple_forward(sym, data=data, loc=loc)
+    assert out[0, 0, 2, 2] == pytest.approx(1.0, abs=1e-5)
+    assert out.sum() == pytest.approx(1.0, abs=1e-4)
+
+
+def test_correlation_self_identity():
+    x = rng.randn(1, 4, 6, 6).astype(np.float32)
+    sym = mx.sym.Correlation(mx.sym.Variable("data1"), mx.sym.Variable("data2"),
+                             kernel_size=1, max_displacement=1, stride1=1,
+                             stride2=1, pad_size=1)
+    out = simple_forward(sym, data1=x, data2=x)
+    assert out.shape == (1, 9, 6, 6)
+    # zero displacement channel (center of 3x3 grid = idx 4) is mean of squares
+    center = out[0, 4]
+    np.testing.assert_allclose(center, (x[0] ** 2).mean(axis=0), rtol=1e-4)
+
+
+def test_multibox_prior():
+    data = mx.sym.Variable("data")
+    prior = mx.sym.MultiBoxPrior(data, sizes=(0.5, 0.25), ratios=(1.0, 2.0))
+    x = np.zeros((1, 3, 4, 4), np.float32)
+    out = simple_forward(prior, data=x)
+    assert out.shape == (1, 4 * 4 * 3, 4)
+    boxes = out[0].reshape(4, 4, 3, 4)
+    # first cell center at (0.125, 0.125), first anchor size 0.5 ratio 1
+    np.testing.assert_allclose(boxes[0, 0, 0],
+                               [0.125 - 0.25, 0.125 - 0.25,
+                                0.125 + 0.25, 0.125 + 0.25], rtol=1e-5)
+    widths = boxes[..., 2] - boxes[..., 0]
+    heights = boxes[..., 3] - boxes[..., 1]
+    np.testing.assert_allclose(widths[0, 0], [0.5, 0.25, 0.5 * np.sqrt(2)],
+                               rtol=1e-5)
+    np.testing.assert_allclose(heights[0, 0], [0.5, 0.25, 0.5 / np.sqrt(2)],
+                               rtol=1e-5)
+
+
+def test_multibox_target_and_detection_roundtrip():
+    # anchors on a 2x2 grid, one gt box matching the top-left anchor
+    anchors = np.array([[0.0, 0.0, 0.5, 0.5],
+                        [0.5, 0.0, 1.0, 0.5],
+                        [0.0, 0.5, 0.5, 1.0],
+                        [0.5, 0.5, 1.0, 1.0]], np.float32)[None]
+    labels = np.array([[[1, 0.05, 0.05, 0.45, 0.45],
+                        [-1, 0, 0, 0, 0]]], np.float32)
+    cls_preds = np.zeros((1, 3, 4), np.float32)
+
+    tgt = mx.sym.MultiBoxTarget(mx.sym.Variable("anchor"),
+                                mx.sym.Variable("label"),
+                                mx.sym.Variable("cls_pred"))
+    loc_t, loc_m, cls_t = simple_forward(
+        tgt, anchor=anchors, label=labels, cls_pred=cls_preds)
+    assert cls_t.shape == (1, 4)
+    assert cls_t[0, 0] == 2.0  # class 1 -> target 2 (0 is background)
+    assert (cls_t[0, 1:] == 0).all()
+    assert loc_m[0, :4].sum() == 4  # mask on for matched anchor only
+    assert loc_m[0, 4:].sum() == 0
+
+    # decoding the emitted target must recover the gt box
+    det = mx.sym.MultiBoxDetection(mx.sym.Variable("cls_prob"),
+                                   mx.sym.Variable("loc_pred"),
+                                   mx.sym.Variable("anchor"),
+                                   nms_threshold=0.5)
+    cls_prob = np.zeros((1, 3, 4), np.float32)
+    cls_prob[0, 2, 0] = 0.9  # class-1 confident on anchor 0
+    cls_prob[0, 0, 1:] = 1.0  # others background
+    out = simple_forward(det, cls_prob=cls_prob, loc_pred=loc_t,
+                         anchor=anchors)
+    assert out.shape == (1, 4, 6)
+    top = out[0, 0]
+    assert top[0] == 1.0  # class id (0-based foreground)
+    assert top[1] == pytest.approx(0.9, abs=1e-5)
+    np.testing.assert_allclose(top[2:], [0.05, 0.05, 0.45, 0.45], atol=1e-3)
+    assert (out[0, 1:, 0] == -1).all()
+
+
+def test_multibox_detection_nms():
+    anchors = np.array([[0.1, 0.1, 0.5, 0.5],
+                        [0.12, 0.12, 0.52, 0.52],
+                        [0.6, 0.6, 0.9, 0.9]], np.float32)[None]
+    cls_prob = np.zeros((1, 2, 3), np.float32)
+    cls_prob[0, 1] = [0.9, 0.8, 0.7]  # all same class
+    loc_pred = np.zeros((1, 12), np.float32)
+    det = mx.sym.MultiBoxDetection(mx.sym.Variable("cls_prob"),
+                                   mx.sym.Variable("loc_pred"),
+                                   mx.sym.Variable("anchor"),
+                                   nms_threshold=0.5)
+    out = simple_forward(det, cls_prob=cls_prob, loc_pred=loc_pred,
+                         anchor=anchors)
+    kept = out[0][out[0, :, 0] >= 0]
+    # overlapping second box suppressed; two detections remain
+    assert kept.shape[0] == 2
+    assert kept[0, 1] == pytest.approx(0.9, abs=1e-5)
+    assert kept[1, 1] == pytest.approx(0.7, abs=1e-5)
