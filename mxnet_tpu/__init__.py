@@ -50,5 +50,6 @@ from . import operator
 from . import rtc
 from . import parallel
 from . import models
+from . import predict
 
 __version__ = "0.1.0"

@@ -1,0 +1,112 @@
+"""Predict-only API + standalone export (reference c_predict_api.cc /
+amalgamation; tests modeled on tests/python/predict/mxnet_predict_example
+usage)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _make_checkpoint(tmp_path, prefix="m"):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    arg_shapes, _, _ = net.infer_shape(data=(2, 8))
+    rng = np.random.RandomState(0)
+    arg_params = {
+        name: mx.nd.array(rng.standard_normal(shape).astype(np.float32))
+        for name, shape in zip(net.list_arguments(), arg_shapes)
+        if name not in ("data", "softmax_label")
+    }
+    path = str(tmp_path / prefix)
+    mx.model.save_checkpoint(path, 7, net, arg_params, {})
+    return net, arg_params, path
+
+
+def test_predictor_from_checkpoint(tmp_path):
+    net, arg_params, prefix = _make_checkpoint(tmp_path)
+    pred = mx.predict.Predictor(f"{prefix}-symbol.json",
+                                f"{prefix}-0007.params",
+                                {"data": (2, 8)})
+    assert pred.data_names == ["data"]
+    x = np.random.RandomState(1).standard_normal((2, 8)).astype(np.float32)
+    pred.forward(data=x)
+    out = pred.get_output(0)
+    assert out.shape == (2, 4)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(2), rtol=1e-5)
+
+    # must agree with a normal bound executor
+    exe = net.simple_bind(mx.cpu(), grad_req="null", data=(2, 8))
+    exe.copy_params_from(arg_params, {}, allow_extra_params=True)
+    exe.forward(is_train=False, data=x)
+    np.testing.assert_allclose(out, exe.outputs[0].asnumpy(), rtol=1e-5)
+
+
+def test_predictor_output_shape_and_reshape(tmp_path):
+    _, _, prefix = _make_checkpoint(tmp_path)
+    pred = mx.predict.create(f"{prefix}-symbol.json",
+                             f"{prefix}-0007.params", {"data": (2, 8)})
+    assert pred.get_output_shape(0) == (2, 4)
+    pred.reshape({"data": (5, 8)})  # MXPredReshape
+    assert pred.get_output_shape(0) == (5, 4)
+    pred.set_input("data", np.zeros((5, 8), np.float32))
+    pred.forward()
+    assert pred.get_output(0).shape == (5, 4)
+
+
+def test_predictor_partial_forward(tmp_path):
+    _, _, prefix = _make_checkpoint(tmp_path)
+    pred = mx.predict.create(f"{prefix}-symbol.json",
+                             f"{prefix}-0007.params", {"data": (2, 8)})
+    x = np.random.RandomState(2).standard_normal((2, 8)).astype(np.float32)
+    pred.forward(data=x)
+    internals = pred.symbol.get_internals().list_outputs()
+    step = internals.index("relu1_output")
+    remaining = pred.partial_forward(step)
+    assert remaining == len(internals) - step - 1
+    inter = pred.get_internal().asnumpy()
+    assert inter.shape == (2, 16)
+    assert (inter >= 0).all()  # post-relu
+
+
+def test_predictor_rejects_bad_input(tmp_path):
+    _, _, prefix = _make_checkpoint(tmp_path)
+    pred = mx.predict.create(f"{prefix}-symbol.json",
+                             f"{prefix}-0007.params", {"data": (2, 8)})
+    with pytest.raises(mx.MXNetError):
+        pred.set_input("fc1_weight", np.zeros((16, 8), np.float32))
+    with pytest.raises(mx.MXNetError):
+        mx.predict.create(f"{prefix}-symbol.json",
+                          f"{prefix}-0007.params", {})
+
+
+def test_export_roundtrip(tmp_path):
+    net, arg_params, prefix = _make_checkpoint(tmp_path)
+    pred = mx.predict.create(f"{prefix}-symbol.json",
+                             f"{prefix}-0007.params", {"data": (3, 8)})
+    x = np.random.RandomState(3).standard_normal((3, 8)).astype(np.float32)
+    pred.forward(data=x)
+    want = pred.get_output(0)
+
+    artifact = str(tmp_path / "model.mxtpu")
+    pred.export(artifact)
+
+    loaded = mx.predict.load_exported(artifact)
+    assert loaded.data_names == ["data"]
+    assert loaded.output_names == ["softmax_output"]
+    loaded.forward(data=x)
+    np.testing.assert_allclose(loaded.get_output(0), want, rtol=1e-5)
+
+
+def test_export_model_direct(tmp_path):
+    net, arg_params, _ = _make_checkpoint(tmp_path)
+    artifact = str(tmp_path / "direct.mxtpu")
+    mx.predict.export_model(artifact, net, arg_params, {}, {"data": (2, 8)})
+    loaded = mx.predict.load_exported(artifact)
+    x = np.zeros((2, 8), np.float32)
+    loaded.forward(data=x)
+    out = loaded.get_output(0)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(2), rtol=1e-5)
