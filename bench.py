@@ -42,7 +42,7 @@ def main():
         n_warmup, n_iter = 2, 5
 
     batch = batch_per_chip * n_chips
-    layout = os.environ.get("BENCH_LAYOUT", "NCHW")
+    layout = os.environ.get("BENCH_LAYOUT", "NHWC" if on_tpu else "NCHW")
     net = mx.models.resnet(num_classes=1000, num_layers=50,
                            image_shape=(3, image_hw, image_hw), layout=layout)
     data_shape = ((batch, image_hw, image_hw, 3) if layout == "NHWC"

@@ -62,8 +62,9 @@ class MultiBoxPriorOp(OpDef):
 
     def forward(self, params, inputs, aux, train, key):
         H, W = inputs[0].shape[2], inputs[0].shape[3]
-        step_y = params.steps[1] if params.steps else 1.0 / H
-        step_x = params.steps[0] if params.steps else 1.0 / W
+        # steps / offsets are (y, x), reference multibox_prior-inl.h order
+        step_y = params.steps[0] if params.steps else 1.0 / H
+        step_x = params.steps[1] if params.steps else 1.0 / W
         oy, ox = params.offsets
         cy = (jnp.arange(H) + oy) * step_y
         cx = (jnp.arange(W) + ox) * step_x
@@ -155,18 +156,21 @@ class MultiBoxTargetOp(OpDef):
             best_gt = jnp.argmax(iou, axis=1)  # (A,)
             best_iou = jnp.max(iou, axis=1)
             assigned = best_iou >= params.overlap_threshold
-            # bipartite: each valid gt claims its best anchor
+            # bipartite: each valid gt claims its best anchor; padding rows
+            # (class -1) are routed to a sentinel index and dropped so they
+            # can't clobber a real gt's claim
             best_anchor = jnp.argmax(iou, axis=0)  # (M,)
-            claim = jnp.zeros((A,), bool).at[best_anchor].set(valid)
+            best_anchor = jnp.where(valid, best_anchor, A)
+            claim = jnp.zeros((A,), bool).at[best_anchor].set(
+                True, mode="drop")
             claimed_gt = jnp.zeros((A,), jnp.int32).at[best_anchor].set(
-                jnp.arange(label.shape[0], dtype=jnp.int32))
+                jnp.arange(label.shape[0], dtype=jnp.int32), mode="drop")
             gt_idx = jnp.where(claim, claimed_gt, best_gt)
             pos = assigned | claim
             matched = gt_boxes[gt_idx]  # (A, 4)
             loc_t = encode(anchors, matched)
             loc_t = jnp.where(pos[:, None], loc_t, 0.0).reshape(-1)
-            loc_m = jnp.where(pos[:, None], 1.0,
-                              0.0).repeat(1).reshape(A, 1).repeat(4, 1).reshape(-1)
+            loc_m = jnp.repeat(pos, 4).astype(loc_t.dtype)
             cls_t = jnp.where(pos, label[gt_idx, 0] + 1, 0.0)  # 0 = background
             if params.negative_mining_ratio > 0:
                 # hard negatives: highest background loss (= max non-bg
@@ -256,23 +260,28 @@ class MultiBoxDetectionOp(OpDef):
             keep = score > params.threshold
             cls_id = jnp.where(keep, cls_id, -1.0)
             score = jnp.where(keep, score, 0.0)
-            # NMS: greedy over score order
+            # NMS: greedy over score order.  Only the top nms_topk survive,
+            # so the IoU matrix is topk x topk, not A x A (at SSD300 scale
+            # A=8732 the full matrix would be ~300 MB per image).
             order = jnp.argsort(-score)
             boxes_o = boxes[order]
             cls_o = cls_id[order]
             score_o = score[order]
-            iou = _iou(boxes_o, boxes_o)
-            same = (cls_o[:, None] == cls_o[None, :]) | params.force_suppress
+            topk = min(params.nms_topk, A) if params.nms_topk > 0 else A
+            boxes_k = boxes_o[:topk]
+            cls_k = cls_o[:topk]
+            iou = _iou(boxes_k, boxes_k)
+            same = (cls_k[:, None] == cls_k[None, :]) | params.force_suppress
             sup_matrix = (iou > params.nms_threshold) & same
-            topk = params.nms_topk if params.nms_topk > 0 else A
 
-            def body(i, alive):
-                is_alive = alive[i] & (cls_o[i] >= 0) & (i < topk)
-                kill = sup_matrix[i] & (jnp.arange(A) > i) & is_alive
-                return alive & ~kill
+            def body(i, alive_k):
+                is_alive = alive_k[i] & (cls_k[i] >= 0)
+                kill = sup_matrix[i] & (jnp.arange(topk) > i) & is_alive
+                return alive_k & ~kill
 
-            alive = lax.fori_loop(0, A, body, jnp.ones((A,), bool))
-            alive = alive & (cls_o >= 0) & (jnp.arange(A) < topk)
+            alive_k = lax.fori_loop(0, topk, body, jnp.ones((topk,), bool))
+            alive = jnp.zeros((A,), bool).at[:topk].set(alive_k)
+            alive = alive & (cls_o >= 0)
             cls_f = jnp.where(alive, cls_o, -1.0)
             out = jnp.concatenate([cls_f[:, None], score_o[:, None], boxes_o],
                                   axis=-1)

@@ -137,3 +137,53 @@ def test_multibox_detection_nms():
     assert kept.shape[0] == 2
     assert kept[0, 1] == pytest.approx(0.9, abs=1e-5)
     assert kept[1, 1] == pytest.approx(0.7, abs=1e-5)
+
+
+def test_multibox_prior_steps_are_y_x():
+    # steps are (step_y, step_x) like offsets (multibox_prior-inl.h)
+    data = mx.sym.Variable("data")
+    prior = mx.sym.MultiBoxPrior(data, sizes=(0.1,), ratios=(1.0,),
+                                 steps=(0.25, 0.125))
+    x = np.zeros((1, 3, 4, 8), np.float32)  # H=4 (step .25), W=8 (step .125)
+    out = simple_forward(prior, data=x)
+    boxes = out[0].reshape(4, 8, 1, 4)
+    cx = (boxes[0, 0, 0, 0] + boxes[0, 0, 0, 2]) / 2
+    cy = (boxes[0, 0, 0, 1] + boxes[0, 0, 0, 3]) / 2
+    assert cx == pytest.approx(0.5 * 0.125, abs=1e-6)
+    assert cy == pytest.approx(0.5 * 0.25, abs=1e-6)
+
+
+def test_multibox_target_padding_rows_cannot_clobber():
+    # gt whose best-anchor IoU is below threshold must still claim its best
+    # anchor (bipartite stage) even when -1 padding rows are present; the
+    # padding rows' argmax lands on anchor 0 and must be dropped.
+    anchors = np.array([[0.0, 0.0, 0.5, 0.5],
+                        [0.5, 0.5, 1.0, 1.0]], np.float32)[None]
+    gt = [1, 0.0, 0.0, 0.2, 0.2]  # IoU with anchor0 = .04/.25 = .16 < .5
+    labels = np.array([[gt, [-1, 0, 0, 0, 0], [-1, 0, 0, 0, 0]]], np.float32)
+    cls_preds = np.zeros((1, 3, 2), np.float32)
+    tgt = mx.sym.MultiBoxTarget(mx.sym.Variable("anchor"),
+                                mx.sym.Variable("label"),
+                                mx.sym.Variable("cls_pred"))
+    loc_t, loc_m, cls_t = simple_forward(
+        tgt, anchor=anchors, label=labels, cls_pred=cls_preds)
+    assert cls_t[0, 0] == 2.0  # gt class 1 claims anchor 0
+    assert loc_m[0, :4].sum() == 4
+
+
+def test_multibox_detection_nms_topk_limits_survivors():
+    anchors = np.array([[0.1, 0.1, 0.5, 0.5],
+                        [0.55, 0.55, 0.9, 0.9],
+                        [0.05, 0.55, 0.45, 0.95]], np.float32)[None]
+    cls_prob = np.zeros((1, 2, 3), np.float32)
+    cls_prob[0, 1] = [0.9, 0.8, 0.7]  # disjoint boxes, same class
+    loc_pred = np.zeros((1, 12), np.float32)
+    det = mx.sym.MultiBoxDetection(mx.sym.Variable("cls_prob"),
+                                   mx.sym.Variable("loc_pred"),
+                                   mx.sym.Variable("anchor"),
+                                   nms_threshold=0.5, nms_topk=2)
+    out = simple_forward(det, cls_prob=cls_prob, loc_pred=loc_pred,
+                         anchor=anchors)
+    kept = out[0][out[0, :, 0] >= 0]
+    assert kept.shape[0] == 2  # third detection cut by nms_topk
+    np.testing.assert_allclose(kept[:, 1], [0.9, 0.8], atol=1e-5)
