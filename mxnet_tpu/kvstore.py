@@ -190,6 +190,27 @@ class KVStore:
             self._updater.states.update(pickle.loads(f.read()))
 
 
+_DIST_INITIALIZED = False
+
+
+def _maybe_init_distributed():
+    """Join the multi-process rendezvous from tools/launch.py env vars
+    (MXTPU_COORDINATOR / MXTPU_NUM_PROCS / MXTPU_PROC_ID) — the analog of
+    the reference's DMLC_* tracker contract (tools/launch.py:33-50,
+    kvstore_dist.h scheduler rendezvous).  No-op when the env is absent
+    (single-process; jax.process_count() == 1) or already initialized."""
+    global _DIST_INITIALIZED
+    import os
+
+    if _DIST_INITIALIZED or "MXTPU_COORDINATOR" not in os.environ:
+        return
+    jax.distributed.initialize(
+        coordinator_address=os.environ["MXTPU_COORDINATOR"],
+        num_processes=int(os.environ["MXTPU_NUM_PROCS"]),
+        process_id=int(os.environ["MXTPU_PROC_ID"]))
+    _DIST_INITIALIZED = True
+
+
 class DistKVStore(KVStore):
     """Multi-host store over JAX collectives (replaces kvstore_dist.h).
 
@@ -202,6 +223,7 @@ class DistKVStore(KVStore):
     """
 
     def __init__(self, kind):
+        _maybe_init_distributed()
         super().__init__(kind)
         self._nproc = jax.process_count()
 

@@ -88,6 +88,6 @@ def allreduce_bench(mesh=None, sizes_mb=(1, 4, 16, 64, 256), n_iter=10,
         gbps = bytes_moved / dt / 1e9
         results.append({"size_mb": mb, "time_s": dt, "gbps_per_device": gbps})
         if verbose:
-            print(f"allreduce {mb:4d} MB over {n} devices: {dt*1e3:8.2f} ms, "
+            print(f"allreduce {mb:7.2f} MB over {n} devices: {dt*1e3:8.2f} ms, "
                   f"{gbps:7.2f} GB/s/device")
     return results

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""All-reduce bandwidth benchmark over the device mesh.
+
+Port of the reference tools/bandwidth/measure.py (kvstore all-reduce
+GB/s per GPU, tools/bandwidth/README.md) to ICI collectives: measures
+psum bandwidth per device over a jax mesh at gradient-like sizes —
+optionally the actual gradient shapes of a model from the zoo.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def model_grad_sizes(network, image_shape, num_classes):
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    builder = getattr(mx.models, network)
+    net = builder(num_classes=num_classes) if network != "resnet" else \
+        mx.models.resnet(num_classes=num_classes, num_layers=50,
+                         image_shape=image_shape)
+    shape_kw = {"data": (2,) + tuple(image_shape)}
+    try:
+        arg_shapes, _, _ = net.infer_shape(**shape_kw)
+    except Exception:
+        shape_kw["softmax_label"] = (2,)
+        arg_shapes, _, _ = net.infer_shape(**shape_kw)
+    sizes = [int(np.prod(s)) for n, s in zip(net.list_arguments(), arg_shapes)
+             if n not in ("data", "softmax_label")]
+    total_mb = sum(sizes) * 4 / 1e6
+    print(f"{network}: {len(sizes)} gradient tensors, {total_mb:.1f} MB total")
+    return sizes
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--network", default=None,
+                   help="measure this model's actual gradient sizes "
+                        "(e.g. resnet, lenet)")
+    p.add_argument("--image-shape", default="3,224,224")
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--sizes-mb", default="1,4,16,64,256",
+                   help="buffer sizes when no --network is given")
+    p.add_argument("--n-iter", type=int, default=10)
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--platform", default=None,
+                   help="force a jax backend (e.g. cpu; combine with "
+                        "XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                        "for an N-device virtual mesh)")
+    args = p.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mxnet_tpu.parallel.collectives import allreduce_bench
+
+    dtype = jnp.float32 if args.dtype == "float32" else jnp.bfloat16
+    if args.network:
+        image_shape = tuple(int(x) for x in args.image_shape.split(","))
+        sizes = model_grad_sizes(args.network, image_shape, args.num_classes)
+        itemsize = np.dtype(args.dtype).itemsize
+        total_mb = sum(sizes) * itemsize / (1024 * 1024)
+        sizes_mb = (max(total_mb, 0.01),)
+    else:
+        sizes_mb = tuple(float(x) for x in args.sizes_mb.split(","))
+    allreduce_bench(sizes_mb=sizes_mb, n_iter=args.n_iter, dtype=dtype)
+
+
+if __name__ == "__main__":
+    main()

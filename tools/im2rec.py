@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""im2rec: pack an image directory into RecordIO shards.
+
+Rebuild of the reference dataset packer (tools/im2rec.py and the C++
+tools/im2rec.cc): generate a .lst listing (``--list``), then encode/resize
+images into packed .rec shards with a worker pool.  Shards pair with
+ImageRecordIter's ``part_index``/``num_parts`` distributed sharding.
+
+Usage:
+  python tools/im2rec.py --list prefix image_root   # make prefix.lst
+  python tools/im2rec.py prefix image_root          # pack prefix.rec
+"""
+
+import argparse
+import multiprocessing
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def list_images(root, recursive):
+    """Yield (relpath, label) with labels from sorted subdirectory names
+    (reference im2rec.py list_image)."""
+    if recursive:
+        cat = {}
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            for fname in sorted(files):
+                if os.path.splitext(fname)[1].lower() not in _EXTS:
+                    continue
+                label_dir = os.path.relpath(path, root).split(os.sep)[0]
+                if label_dir not in cat:
+                    cat[label_dir] = len(cat)
+                yield os.path.relpath(os.path.join(path, fname), root), cat[label_dir]
+    else:
+        i = 0
+        for fname in sorted(os.listdir(root)):
+            if os.path.splitext(fname)[1].lower() in _EXTS:
+                yield fname, i
+                i += 1
+
+
+def write_list(prefix, root, args):
+    entries = list(list_images(root, args.recursive))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(entries)
+    chunks = {"": entries}
+    if args.train_ratio < 1.0 or args.test_ratio > 0.0:
+        n = len(entries)
+        n_test = int(n * args.test_ratio)
+        n_train = int(n * args.train_ratio)
+        chunks = {"_test": entries[:n_test],
+                  "_train": entries[n_test:n_test + n_train],
+                  "_val": entries[n_test + n_train:]}
+        chunks = {k: v for k, v in chunks.items() if v}
+    for suffix, chunk in chunks.items():
+        with open(f"{prefix}{suffix}.lst", "w") as f:
+            for i, (path, label) in enumerate(chunk):
+                f.write(f"{i}\t{label}\t{path}\n")
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            # idx \t label(s)... \t path   (path is last, labels between)
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def _encode_one(task):
+    idx, labels, fname, root, args = task
+    import cv2
+    import numpy as np
+
+    path = os.path.join(root, fname)
+    if args.pass_through:
+        with open(path, "rb") as f:
+            data = f.read()
+        header = recordio.IRHeader(0, labels[0] if len(labels) == 1 else
+                                   np.asarray(labels, np.float32), idx, 0)
+        return idx, recordio.pack(header, data)
+    img = cv2.imread(path, args.color)
+    if img is None:
+        return idx, None
+    if args.center_crop and img.shape[0] != img.shape[1]:
+        m = min(img.shape[:2])
+        y = (img.shape[0] - m) // 2
+        x = (img.shape[1] - m) // 2
+        img = img[y:y + m, x:x + m]
+    if args.resize > 0:
+        h, w = img.shape[:2]
+        if min(h, w) != args.resize:
+            if h < w:
+                img = cv2.resize(img, (int(w * args.resize / h), args.resize))
+            else:
+                img = cv2.resize(img, (args.resize, int(h * args.resize / w)))
+    header = recordio.IRHeader(0, labels[0] if len(labels) == 1 else
+                               __import__("numpy").asarray(labels, "float32"),
+                               idx, 0)
+    return idx, recordio.pack_img(header, img, quality=args.quality,
+                                  img_fmt=args.encoding)
+
+
+def pack(prefix, root, args):
+    lst = prefix + ".lst"
+    if not os.path.exists(lst):
+        raise SystemExit(f"{lst} not found; run with --list first")
+    items = list(read_list(lst))
+    for part in range(args.num_parts):
+        shard = items[part::args.num_parts]
+        suffix = f"_{part}" if args.num_parts > 1 else ""
+        rec_path = f"{prefix}{suffix}.rec"
+        idx_path = f"{prefix}{suffix}.idx"
+        writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+        tasks = [(i, lab, fn, root, args) for i, lab, fn in shard]
+        tic = time.time()
+        n_done = 0
+        if args.num_thread > 1:
+            with multiprocessing.Pool(args.num_thread) as pool:
+                for idx, payload in pool.imap(_encode_one, tasks, chunksize=16):
+                    if payload is not None:
+                        writer.write_idx(idx, payload)
+                        n_done += 1
+        else:
+            for task in tasks:
+                idx, payload = _encode_one(task)
+                if payload is not None:
+                    writer.write_idx(idx, payload)
+                    n_done += 1
+        writer.close()
+        dt = time.time() - tic
+        print(f"wrote {rec_path}: {n_done} records in {dt:.1f}s "
+              f"({n_done / max(dt, 1e-9):.0f} img/s)")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix")
+    p.add_argument("root")
+    p.add_argument("--list", action="store_true",
+                   help="make a .lst listing instead of packing")
+    p.add_argument("--recursive", action="store_true",
+                   help="label by subdirectory")
+    p.add_argument("--shuffle", type=int, default=1)
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--test-ratio", type=float, default=0.0)
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter edge to this")
+    p.add_argument("--center-crop", action="store_true")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    p.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
+    p.add_argument("--pass-through", action="store_true",
+                   help="store raw file bytes, no re-encode")
+    p.add_argument("--num-thread", type=int, default=1)
+    p.add_argument("--num-parts", type=int, default=1,
+                   help="number of output shards")
+    args = p.parse_args(argv)
+    if args.list:
+        write_list(args.prefix, args.root, args)
+    else:
+        pack(args.prefix, args.root, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Kill stray training processes on a host list (rebuild of
+tools/kill-mxnet.py: blunt cluster cleanup after a bad distributed run).
+
+Usage: python tools/kill_mxnet_tpu.py hostfile [pattern] [username]
+"""
+
+import subprocess
+import sys
+
+
+def main(argv):
+    if len(argv) < 2:
+        print(__doc__)
+        return 1
+    hostfile, pattern = argv[1], (argv[2] if len(argv) > 2 else "mxnet_tpu")
+    username = argv[3] if len(argv) > 3 else None
+    with open(hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip() and not h.startswith("#")]
+    kill_cmd = f"pkill -f {pattern} || true"
+    for host in hosts:
+        target = f"{username}@{host}" if username else host
+        print(f"{target}: {kill_cmd}")
+        subprocess.call(["ssh", "-o", "BatchMode=yes", target, kill_cmd])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

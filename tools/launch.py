@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Multi-host job launcher (rebuild of tools/launch.py + the dmlc-core
+ssh tracker).
+
+The reference starts a scheduler plus N servers/workers and wires them
+through ``DMLC_*`` env rendezvous.  The TPU-native control plane is
+``jax.distributed``: one coordinator address, ``num_processes`` and a
+``process_id`` per host — the launcher's job is only to spawn the
+program everywhere with those env vars set (`MXTPU_COORDINATOR`,
+`MXTPU_NUM_PROCS`, `MXTPU_PROC_ID`, consumed by
+mxnet_tpu.kvstore.create("dist_sync")).
+
+Modes:
+  local: spawn -n processes on this machine (CPU mesh testing)
+  ssh:   spawn one process per host in -H hostfile via ssh, rsyncing
+         the working dir first (reference ssh tracker behavior)
+"""
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _child_env(coordinator, n, rank, extra=None):
+    env = dict(os.environ)
+    env.update({
+        "MXTPU_COORDINATOR": coordinator,
+        "MXTPU_NUM_PROCS": str(n),
+        "MXTPU_PROC_ID": str(rank),
+    })
+    if extra:
+        env.update(extra)
+    return env
+
+
+def launch_local(n, command, extra_env=None):
+    """Spawn n local processes with distinct ranks; returns exit code."""
+    coordinator = f"127.0.0.1:{_free_port()}"
+    procs = []
+    try:
+        for rank in range(n):
+            procs.append(subprocess.Popen(
+                command, env=_child_env(coordinator, n, rank, extra_env)))
+        code = 0
+        for p in procs:
+            code = p.wait() or code
+        return code
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+
+
+def launch_ssh(hostfile, command, sync_dir=None, username=None):
+    with open(hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip() and not h.startswith("#")]
+    n = len(hosts)
+    coordinator = f"{hosts[0]}:{_free_port()}"
+    cwd = sync_dir or os.getcwd()
+    procs = []
+    for rank, host in enumerate(hosts):
+        target = f"{username}@{host}" if username else host
+        if sync_dir:
+            subprocess.check_call(
+                ["rsync", "-az", "--delete", cwd + "/", f"{target}:{cwd}/"])
+        env_prefix = (f"MXTPU_COORDINATOR={coordinator} "
+                      f"MXTPU_NUM_PROCS={n} MXTPU_PROC_ID={rank}")
+        remote = f"cd {cwd} && {env_prefix} {' '.join(command)}"
+        procs.append(subprocess.Popen(["ssh", "-o", "BatchMode=yes",
+                                       target, remote]))
+    code = 0
+    for p in procs:
+        code = p.wait() or code
+    return code
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-n", "--num-workers", type=int, default=1)
+    p.add_argument("-H", "--hostfile", default=None,
+                   help="one host per line; enables ssh mode")
+    p.add_argument("--launcher", choices=["local", "ssh"], default=None)
+    p.add_argument("--sync-dir", default=None,
+                   help="rsync this dir to all hosts before launch")
+    p.add_argument("--username", default=None)
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    command = args.command[1:] if args.command[0] == "--" else args.command
+    mode = args.launcher or ("ssh" if args.hostfile else "local")
+    if mode == "ssh":
+        if not args.hostfile:
+            p.error("ssh mode needs -H hostfile")
+        return launch_ssh(args.hostfile, command, args.sync_dir, args.username)
+    return launch_local(args.num_workers, command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Parse training logs into tables (rebuild of tools/parse_log.py).
+
+Reads the logging output of FeedForward/Module.fit (epoch metrics,
+validation metrics, time cost) and emits markdown or csv — the nightly
+accuracy gates (tests/nightly/test_all.sh check_val) grep this.
+
+Usage: python tools/parse_log.py train.log [--format markdown|csv|none]
+"""
+
+import argparse
+import re
+import sys
+
+_PATTERNS = {
+    "train": re.compile(
+        r"Epoch\[(\d+)\].*?Train-([\w-]+)=([\d.eE+-]+)"),
+    "val": re.compile(
+        r"Epoch\[(\d+)\].*?Validation-([\w-]+)=([\d.eE+-]+)"),
+    "time": re.compile(
+        r"Epoch\[(\d+)\].*?Time cost=([\d.eE+-]+)"),
+    "speed": re.compile(
+        r"Epoch\[(\d+)\].*?Speed: ([\d.eE+-]+) samples/sec"),
+}
+
+
+def parse(lines):
+    """Return {epoch: {col: value}} from log lines."""
+    rows = {}
+    for line in lines:
+        for kind, pat in _PATTERNS.items():
+            m = pat.search(line)
+            if not m:
+                continue
+            epoch = int(m.group(1))
+            row = rows.setdefault(epoch, {})
+            if kind == "train":
+                row[f"train-{m.group(2)}"] = float(m.group(3))
+            elif kind == "val":
+                row[f"val-{m.group(2)}"] = float(m.group(3))
+            elif kind == "time":
+                row["time"] = float(m.group(2))
+            elif kind == "speed":
+                row["speed"] = max(row.get("speed", 0.0), float(m.group(2)))
+    return rows
+
+
+def render(rows, fmt):
+    if not rows:
+        return ""
+    cols = sorted({c for r in rows.values() for c in r})
+    out = []
+    if fmt == "markdown":
+        out.append("| epoch | " + " | ".join(cols) + " |")
+        out.append("| --- " * (len(cols) + 1) + "|")
+        for e in sorted(rows):
+            vals = [f"{rows[e].get(c, ''):.6g}" if c in rows[e] else ""
+                    for c in cols]
+            out.append(f"| {e} | " + " | ".join(vals) + " |")
+    elif fmt == "csv":
+        out.append("epoch," + ",".join(cols))
+        for e in sorted(rows):
+            out.append(f"{e}," + ",".join(
+                f"{rows[e][c]:.6g}" if c in rows[e] else "" for c in cols))
+    else:  # none: plain aligned
+        for e in sorted(rows):
+            kv = " ".join(f"{c}={rows[e][c]:.6g}" for c in cols if c in rows[e])
+            out.append(f"epoch {e}: {kv}")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("logfile")
+    p.add_argument("--format", default="markdown",
+                   choices=["markdown", "csv", "none"])
+    args = p.parse_args(argv)
+    with open(args.logfile) as f:
+        rows = parse(f)
+    print(render(rows, args.format))
+
+
+if __name__ == "__main__":
+    main()
