@@ -51,5 +51,6 @@ from . import rtc
 from . import parallel
 from . import models
 from . import predict
+from . import torch_bridge
 
 __version__ = "0.1.0"

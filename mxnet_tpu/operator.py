@@ -107,7 +107,11 @@ class _CustomOpDef(OpDef):
         return list(ins), [tuple(o) for o in outs], [tuple(a) for a in auxs]
 
     def infer_dtype(self, prop, in_dtypes):
-        ins, outs, auxs = prop.infer_type(list(in_dtypes))
+        # custom ops default to float32 when nothing is known (reference
+        # custom-op behavior: frontends assume float32 absent hints)
+        filled = [d if d is not None else np.dtype(np.float32)
+                  for d in in_dtypes]
+        ins, outs, auxs = prop.infer_type(filled)
         return list(ins), list(outs), list(auxs)
 
     def _get_op(self, prop, shapes, dtypes):

@@ -249,3 +249,121 @@ class IdentityAttachKLSparseRegOp(OpDef):
             new_avg = m * avg + (1 - m) * jnp.mean(x).reshape(1)
             return [x], [jax.lax.stop_gradient(new_avg)]
         return [x], [avg]
+
+
+# -- CTC loss (warpctc plugin parity) ----------------------------------------
+class CTCLossParam(Params):
+    use_data_lengths = field(bool, default=False)
+    use_label_lengths = field(bool, default=False)
+    blank_label = field(str, default="first", enum=("first", "last"))
+    padding_mask = field(float, default=-1.0)
+
+
+def _ctc_single(log_probs, labels, data_len, label_len, blank):
+    """Negative log likelihood of one (T, C) log-prob sequence under CTC.
+
+    Log-space alpha recursion (forward algorithm) as a lax.scan over
+    time — the scan keeps the whole loss one fused XLA loop (TPU-native
+    stand-in for the warp-ctc CUDA kernels, plugin/warpctc/)."""
+    T, C = log_probs.shape
+    L = labels.shape[0]
+    S = 2 * L + 1
+    neg_inf = jnp.asarray(-1e30, log_probs.dtype)
+    labels = labels.astype(jnp.int32)
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    z = jnp.full((S,), blank, jnp.int32).at[1::2].set(labels)
+    s_idx = jnp.arange(S)
+    valid_s = s_idx < 2 * label_len + 1
+    # skip transition allowed where z_s != blank and z_s != z_{s-2}
+    z_shift2 = jnp.concatenate([jnp.full((2,), -1, jnp.int32), z[:-2]])
+    can_skip = (z != blank) & (z != z_shift2)
+
+    alpha0 = jnp.full((S,), neg_inf)
+    alpha0 = alpha0.at[0].set(log_probs[0, blank])
+    alpha0 = alpha0.at[1].set(
+        jnp.where(label_len > 0, log_probs[0, z[1]], neg_inf))
+    alpha0 = jnp.where(valid_s, alpha0, neg_inf)
+
+    def step(alpha, t):
+        lp = log_probs[t]
+        a1 = alpha
+        a2 = jnp.concatenate([jnp.full((1,), neg_inf), alpha[:-1]])
+        a3 = jnp.where(can_skip,
+                       jnp.concatenate([jnp.full((2,), neg_inf), alpha[:-2]]),
+                       neg_inf)
+        new = jnp.logaddexp(jnp.logaddexp(a1, a2), a3) + lp[z]
+        new = jnp.where(valid_s, new, neg_inf)
+        new = jnp.where(t < data_len, new, alpha)  # frozen past seq end
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    end = 2 * label_len  # index of final blank in the effective sequence
+    a_last = alpha[end]
+    a_prev = jnp.where(label_len > 0, alpha[jnp.maximum(end - 1, 0)], neg_inf)
+    return -jnp.logaddexp(a_last, a_prev)
+
+
+@register_op("CTCLoss", aliases=("ctc_loss", "WarpCTC", "_contrib_CTCLoss"))
+class CTCLossOp(OpDef):
+    """Connectionist Temporal Classification loss (plugin/warpctc/
+    warpctc-inl.h capability, API shape of contrib CTCLoss).
+
+    inputs: data (T, N, C) unnormalized activations, label (N, L)
+    padded with ``padding_mask`` (or exact with use_label_lengths),
+    plus optional data_lengths (N,) / label_lengths (N,).
+    output: loss (N,).  Backward is the exact CTC gradient wrt data,
+    obtained by differentiating the fused scan.
+    """
+
+    param_cls = CTCLossParam
+    is_loss = True
+
+    def list_arguments(self, params):
+        args = ["data", "label"]
+        if params.use_data_lengths:
+            args.append("data_lengths")
+        if params.use_label_lengths:
+            args.append("label_lengths")
+        return args
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            raise ValueError("CTCLoss: data shape unknown")
+        T, N, C = d
+        lab = in_shapes[1] or (N, max(T // 2, 1))
+        shapes = [tuple(d), tuple(lab)]
+        if params.use_data_lengths:
+            shapes.append((N,))
+        if params.use_label_lengths:
+            shapes.append((N,))
+        return shapes, [(N,)], []
+
+    def _compute(self, params, inputs):
+        data, label = inputs[0], inputs[1]
+        T, N, C = data.shape
+        blank = 0 if params.blank_label == "first" else C - 1
+        log_probs = jax.nn.log_softmax(data, axis=-1)  # (T, N, C)
+        idx = 2
+        if params.use_data_lengths:
+            data_lens = inputs[idx].astype(jnp.int32)
+            idx += 1
+        else:
+            data_lens = jnp.full((N,), T, jnp.int32)
+        if params.use_label_lengths:
+            label_lens = inputs[idx].astype(jnp.int32)
+        else:
+            label_lens = jnp.sum(label != params.padding_mask,
+                                 axis=1).astype(jnp.int32)
+        per_sample = jax.vmap(_ctc_single, in_axes=(1, 0, 0, 0, None))
+        return per_sample(log_probs, jnp.maximum(label, 0), data_lens,
+                          label_lens, blank)
+
+    def forward(self, params, inputs, aux, train, key):
+        return [self._compute(params, inputs)], []
+
+    def backward(self, params, out_grads, inputs, outputs):
+        grad = jax.grad(
+            lambda d: jnp.sum(self._compute(params, [d] + list(inputs[1:]))))(
+                inputs[0])
+        return [grad] + [jnp.zeros_like(x) for x in inputs[1:]]

@@ -1,0 +1,238 @@
+"""PyTorch interop bridge.
+
+Rebuild of the reference torch plugin (plugin/torch/torch_module-inl.h,
+torch_criterion-inl.h, python/mxnet/torch.py): run torch modules,
+criterions and functions inside the graph or eagerly over NDArrays.
+The reference embedded Lua Torch via TH/THC; the living equivalent is
+PyTorch (CPU), executed as host callbacks (``jax.pure_callback``)
+around the compiled XLA program — the same mechanics as CustomOp.
+
+A wrapped module's learnable parameters surface as op *arguments*
+(named ``<name>_param_i``), so framework optimizers/initializers manage
+them exactly like native layer weights — mirroring how TorchModule
+exposed Lua module weights to the MXNet optimizer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+from .operator import CustomOp, CustomOpProp, register
+
+__all__ = ["TorchModule", "TorchCriterion", "torch_function"]
+
+
+def _import_torch():
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError("torch_bridge requires pytorch") from e
+    return torch
+
+
+class TorchModule:
+    """Wrap a ``torch.nn.Module`` as a symbolic operator.
+
+    >>> net = TorchModule(torch.nn.Linear(8, 4), name="tlin")(data_sym)
+
+    The module runs on host CPU; its parameters are op arguments
+    (initialized from the module's current values via the
+    ``init_params`` helper or any framework initializer).
+    """
+
+    def __init__(self, module, name=None):
+        self.module = module
+        self.name = name or f"torch_{type(module).__name__.lower()}"
+        self._param_tensors = list(module.parameters())
+        self._registered = None
+
+    def param_names(self):
+        return [f"{self.name}_param_{i}"
+                for i in range(len(self._param_tensors))]
+
+    def init_values(self):
+        """Current torch parameter values, keyed by op argument name —
+        feed to Module.init_params(arg_params=...) or set_params."""
+        return {n: p.detach().cpu().numpy()
+                for n, p in zip(self.param_names(), self._param_tensors)}
+
+    def _infer_out_shapes(self, in_shape):
+        torch = _import_torch()
+        with torch.no_grad():
+            out = self.module(torch.zeros(*in_shape))
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return [tuple(o.shape) for o in outs]
+
+    def _ensure_registered(self):
+        if self._registered:
+            return self._registered
+        bridge = self
+        reg_name = f"_torch_module_{self.name}_{id(self):x}"
+
+        class _Prop(CustomOpProp):
+            def __init__(self):
+                super().__init__(need_top_grad=True)
+
+            def list_arguments(self):
+                # bare names; symbol naming prefixes them with the op
+                # instance name, yielding bridge.param_names()
+                return ["data"] + [f"param_{i}"
+                                   for i in range(len(bridge._param_tensors))]
+
+            def list_outputs(self):
+                return ["output"]
+
+            def infer_shape(self, in_shape):
+                data_shape = in_shape[0]
+                param_shapes = [tuple(p.shape)
+                                for p in bridge._param_tensors]
+                out_shapes = bridge._infer_out_shapes(data_shape)
+                return [tuple(data_shape)] + param_shapes, out_shapes, []
+
+            def create_operator(self, ctx, shapes, dtypes):
+                return _TorchModuleOp(bridge)
+
+        register(reg_name)(_Prop)
+        self._registered = reg_name
+        return reg_name
+
+    def __call__(self, data, name=None):
+        from . import symbol as sym_mod
+
+        reg_name = self._ensure_registered()
+        fn = getattr(sym_mod, reg_name)
+        return fn(data=data, name=name or self.name)
+
+
+class _TorchModuleOp(CustomOp):
+    def __init__(self, bridge):
+        self.bridge = bridge
+
+    def _load_params(self, torch, in_data):
+        with torch.no_grad():
+            for p, v in zip(self.bridge._param_tensors, in_data[1:]):
+                p.copy_(torch.from_numpy(np.ascontiguousarray(v)))
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        torch = _import_torch()
+        self._load_params(torch, in_data)
+        with torch.no_grad():
+            out = self.bridge.module(torch.from_numpy(
+                np.ascontiguousarray(in_data[0])))
+        out = out if isinstance(out, (tuple, list)) else (out,)
+        for dst, o in zip(out_data, out):
+            self.assign(dst, req[0], o.detach().cpu().numpy())
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        torch = _import_torch()
+        self._load_params(torch, in_data)
+        x = torch.from_numpy(np.ascontiguousarray(in_data[0]))
+        x.requires_grad_(True)
+        params = self.bridge._param_tensors
+        for p in params:
+            p.requires_grad_(True)
+            p.grad = None
+        out = self.bridge.module(x)
+        out.backward(torch.from_numpy(np.ascontiguousarray(out_grad[0])))
+        grads = [x.grad] + [p.grad for p in params]
+        for dst, g, r in zip(in_grad, grads, req):
+            self.assign(dst, r, np.zeros_like(dst) if g is None
+                        else g.detach().cpu().numpy())
+
+
+class TorchCriterion:
+    """Wrap a torch loss (criterion) as an output layer
+    (torch_criterion-inl.h): forward emits the scalar loss broadcast per
+    batch row; backward injects d(loss)/d(data), ignoring head grads."""
+
+    def __init__(self, criterion, name=None):
+        self.criterion = criterion
+        self.name = name or f"torch_{type(criterion).__name__.lower()}"
+        self._registered = None
+
+    def _ensure_registered(self):
+        if self._registered:
+            return self._registered
+        bridge = self
+        reg_name = f"_torch_criterion_{self.name}_{id(self):x}"
+
+        class _Prop(CustomOpProp):
+            def __init__(self):
+                super().__init__(need_top_grad=False)
+
+            def list_arguments(self):
+                return ["data", "label"]
+
+            def list_outputs(self):
+                return ["loss"]
+
+            def infer_shape(self, in_shape):
+                return [tuple(s) for s in in_shape], [(in_shape[0][0],)], []
+
+            def create_operator(self, ctx, shapes, dtypes):
+                return _TorchCriterionOp(bridge)
+
+        register(reg_name)(_Prop)
+        self._registered = reg_name
+        return reg_name
+
+    def __call__(self, data, label, name=None):
+        from . import symbol as sym_mod
+
+        fn = getattr(sym_mod, self._ensure_registered())
+        return fn(data=data, label=label, name=name or self.name)
+
+
+class _TorchCriterionOp(CustomOp):
+    def __init__(self, bridge):
+        self.bridge = bridge
+
+    def _loss(self, torch, in_data, need_grad):
+        x = torch.from_numpy(np.ascontiguousarray(in_data[0]))
+        y = torch.from_numpy(np.ascontiguousarray(in_data[1]))
+        if need_grad:
+            x.requires_grad_(True)
+        crit = self.bridge.criterion
+        target = y.long() if _is_class_criterion(crit) else y
+        loss = crit(x, target)
+        return x, loss
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        torch = _import_torch()
+        with torch.no_grad():
+            _, loss = self._loss(torch, in_data, need_grad=False)
+        val = float(loss.detach().cpu().numpy())
+        self.assign(out_data[0], req[0],
+                    np.full(out_data[0].shape, val, out_data[0].dtype))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        torch = _import_torch()
+        x, loss = self._loss(torch, in_data, need_grad=True)
+        loss.backward()
+        self.assign(in_grad[0], req[0], x.grad.detach().cpu().numpy())
+        self.assign(in_grad[1], req[1], np.zeros_like(in_grad[1]))
+
+
+def _is_class_criterion(crit):
+    name = type(crit).__name__
+    return name in ("CrossEntropyLoss", "NLLLoss")
+
+
+def torch_function(fn, *args, **kwargs):
+    """Eagerly apply a torch function to NDArrays (python/mxnet/torch.py
+    function dispatch): NDArray → torch CPU tensor → fn → NDArray."""
+    torch = _import_torch()
+
+    def conv(v):
+        if isinstance(v, NDArray):
+            return torch.from_numpy(v.asnumpy())
+        return v
+
+    out = fn(*[conv(a) for a in args],
+             **{k: conv(v) for k, v in kwargs.items()})
+    if isinstance(out, (tuple, list)):
+        return [nd.array(o.detach().cpu().numpy()) for o in out]
+    return nd.array(out.detach().cpu().numpy())

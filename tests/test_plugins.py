@@ -1,0 +1,172 @@
+"""Plugin-parity features: CTC loss (warpctc) and the torch bridge
+(plugin/torch)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+# -- CTC loss ---------------------------------------------------------------
+def _np_ctc_ref(log_probs, labels, blank=0):
+    """Brute-force CTC via dynamic programming in prob space (small T)."""
+    T, C = log_probs.shape
+    probs = np.exp(log_probs)
+    z = [blank]
+    for l in labels:
+        z += [l, blank]
+    S = len(z)
+    alpha = np.zeros((T, S))
+    alpha[0, 0] = probs[0, blank]
+    if S > 1:
+        alpha[0, 1] = probs[0, z[1]]
+    for t in range(1, T):
+        for s in range(S):
+            a = alpha[t - 1, s]
+            if s >= 1:
+                a += alpha[t - 1, s - 1]
+            if s >= 2 and z[s] != blank and z[s] != z[s - 2]:
+                a += alpha[t - 1, s - 2]
+            alpha[t, s] = a * probs[t, z[s]]
+    p = alpha[T - 1, S - 1] + (alpha[T - 1, S - 2] if S > 1 else 0)
+    return -np.log(max(p, 1e-300))
+
+
+def _run_ctc(data, label, **kw):
+    d = mx.sym.Variable("data")
+    l = mx.sym.Variable("label")
+    loss = mx.sym.CTCLoss(d, l, **kw)
+    exe = loss.bind(mx.cpu(), {"data": mx.nd.array(data),
+                               "label": mx.nd.array(label)})
+    exe.forward(is_train=False)
+    return exe.outputs[0].asnumpy()
+
+
+def test_ctc_loss_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    T, N, C = 6, 2, 5
+    data = rng.standard_normal((T, N, C)).astype(np.float32)
+    label = np.array([[1, 2, -1], [3, 3, 4]], np.float32)
+    out = _run_ctc(data, label)
+    log_probs = data - np.log(np.exp(data).sum(-1, keepdims=True))
+    for n in range(N):
+        labs = [int(x) for x in label[n] if x >= 0]
+        want = _np_ctc_ref(log_probs[:, n], labs)
+        assert out[n] == pytest.approx(want, rel=1e-4), (n, out[n], want)
+
+
+def test_ctc_loss_variable_lengths():
+    rng = np.random.RandomState(1)
+    T, N, C = 8, 2, 4
+    data = rng.standard_normal((T, N, C)).astype(np.float32)
+    label = np.array([[1, 2], [2, 0]], np.float32)
+    dlen = np.array([5, 8], np.float32)
+    llen = np.array([2, 1], np.float32)
+    d = mx.sym.Variable("data")
+    l = mx.sym.Variable("label")
+    loss = mx.sym.CTCLoss(d, l, mx.sym.Variable("dl"), mx.sym.Variable("ll"),
+                          use_data_lengths=True, use_label_lengths=True)
+    exe = loss.bind(mx.cpu(), {"data": mx.nd.array(data),
+                               "label": mx.nd.array(label),
+                               "dl": mx.nd.array(dlen),
+                               "ll": mx.nd.array(llen)})
+    exe.forward(is_train=False)
+    out = exe.outputs[0].asnumpy()
+    log_probs = data - np.log(np.exp(data).sum(-1, keepdims=True))
+    want0 = _np_ctc_ref(log_probs[:5, 0], [1, 2])
+    want1 = _np_ctc_ref(log_probs[:, 1], [2])
+    assert out[0] == pytest.approx(want0, rel=1e-4)
+    assert out[1] == pytest.approx(want1, rel=1e-4)
+
+
+def test_ctc_loss_gradient_descends():
+    # training with the CTC gradient must reduce the loss
+    rng = np.random.RandomState(2)
+    T, N, C = 6, 3, 5
+    data = rng.standard_normal((T, N, C)).astype(np.float32) * 0.1
+    label = np.array([[1, 2, -1], [3, -1, -1], [4, 1, 2]], np.float32)
+    d = mx.sym.Variable("data")
+    loss = mx.sym.CTCLoss(d, mx.sym.Variable("label"))
+    exe = loss.simple_bind(mx.cpu(), grad_req="write",
+                           data=(T, N, C), label=(N, 3))
+    exe.arg_dict["data"][:] = data
+    exe.arg_dict["label"][:] = label
+    losses = []
+    for _ in range(12):
+        exe.forward(is_train=True)
+        losses.append(float(exe.outputs[0].asnumpy().sum()))
+        exe.backward()
+        g = exe.grad_dict["data"].asnumpy()
+        exe.arg_dict["data"][:] = exe.arg_dict["data"].asnumpy() - 0.5 * g
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+# -- torch bridge -----------------------------------------------------------
+torch = pytest.importorskip("torch")
+
+
+def test_torch_module_forward_matches_torch():
+    tmod = torch.nn.Linear(8, 4)
+    bridge = mx.torch_bridge.TorchModule(tmod, name="tlin")
+    data = mx.sym.Variable("data")
+    out_sym = bridge(data)
+    x = np.random.RandomState(0).standard_normal((3, 8)).astype(np.float32)
+
+    args = {"data": mx.nd.array(x)}
+    for k, v in bridge.init_values().items():
+        args[k] = mx.nd.array(v)
+    exe = out_sym.bind(mx.cpu(), args)
+    exe.forward(is_train=False)
+    got = exe.outputs[0].asnumpy()
+    want = tmod(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_torch_module_gradients():
+    tmod = torch.nn.Linear(6, 2)
+    bridge = mx.torch_bridge.TorchModule(tmod, name="tg")
+    out_sym = mx.sym.MakeLoss(bridge(mx.sym.Variable("data")) ** 2)
+    x = np.random.RandomState(1).standard_normal((4, 6)).astype(np.float32)
+    exe = out_sym.simple_bind(mx.cpu(), grad_req="write", data=(4, 6))
+    exe.arg_dict["data"][:] = x
+    for k, v in bridge.init_values().items():
+        exe.arg_dict[k][:] = v
+    exe.forward(is_train=True)
+    exe.backward()
+    # torch reference gradient
+    xt = torch.from_numpy(x)
+    xt.requires_grad_(True)
+    for p in tmod.parameters():
+        p.grad = None
+    (tmod(xt) ** 2).sum().backward()
+    got = exe.grad_dict["data"].asnumpy()
+    np.testing.assert_allclose(got, xt.grad.numpy(), rtol=1e-4, atol=1e-5)
+    w_grad = exe.grad_dict["tg_param_0"].asnumpy()
+    np.testing.assert_allclose(
+        w_grad, list(tmod.parameters())[0].grad.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_torch_criterion():
+    crit = mx.torch_bridge.TorchCriterion(torch.nn.MSELoss(), name="tmse")
+    pred = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    loss_sym = crit(pred, label)
+    x = np.random.RandomState(2).standard_normal((5, 3)).astype(np.float32)
+    y = np.random.RandomState(3).standard_normal((5, 3)).astype(np.float32)
+    exe = loss_sym.bind(mx.cpu(), {"data": mx.nd.array(x),
+                                   "label": mx.nd.array(y)},
+                        args_grad={"data": mx.nd.zeros((5, 3)),
+                                   "label": mx.nd.zeros((5, 3))})
+    exe.forward(is_train=True)
+    want = float(((x - y) ** 2).mean())
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(),
+                               np.full(5, want), rtol=1e-5)
+    exe.backward()
+    g = exe.grad_dict["data"].asnumpy()
+    np.testing.assert_allclose(g, 2 * (x - y) / x.size, rtol=1e-4, atol=1e-6)
+
+
+def test_torch_function_eager():
+    x = mx.nd.array(np.array([[1.0, 4.0], [9.0, 16.0]], np.float32))
+    out = mx.torch_bridge.torch_function(torch.sqrt, x)
+    np.testing.assert_allclose(out.asnumpy(), [[1, 2], [3, 4]], rtol=1e-6)
