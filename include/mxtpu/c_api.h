@@ -1,0 +1,97 @@
+/*
+ * mxtpu flat C API — the native runtime ABI.
+ *
+ * Rebuild of the reference's include/mxnet/c_api.h role for the
+ * TPU-native stack: opaque handles, int return codes (0 = success,
+ * nonzero = failure with MXTPUGetLastError()), per-thread error string.
+ *
+ * Scope note (deliberate design split, SURVEY.md §7): the *compute*
+ * path — arrays, operators, autograd, collectives — compiles through
+ * XLA and is driven from the Python layer; this C ABI covers what is
+ * native in this framework, mirroring what was native in the
+ * reference's runtime:
+ *   - the dependency engine (threaded_engine.{h,cc} analog)
+ *   - the pooled host storage manager (storage/ analog)
+ *   - the RecordIO scanner (io/ analog)
+ *   - the runtime-discoverable op registry (MXSymbolListAtomicSymbol-
+ *     Creators / MXSymbolGetAtomicSymbolInfo analog), populated by the
+ *     host frontend at import so thin in-process language bindings can
+ *     generate op wrappers at runtime.
+ */
+
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- error handling (src/c_api/c_api_error.cc analog) ---- */
+/* Message of the last failure on this thread; empty string if none. */
+const char* MXTPUGetLastError(void);
+void MXTPUSetLastError(const char* msg);
+
+/* ---- dependency engine (include/mxnet/engine.h analog) ---- */
+typedef void* EngineHandle;
+typedef void* VarHandle;
+typedef void (*MXTPUOpCallback)(void* payload);
+
+EngineHandle MXTPUEngineCreate(int num_workers, int num_io_workers);
+void MXTPUEngineFree(EngineHandle engine);
+VarHandle MXTPUEngineNewVar(EngineHandle engine);
+/* Push fn(payload) with read deps const_vars and write deps
+ * mutable_vars; prop: 0 = normal worker pool, 1 = prioritized/IO pool
+ * (FnProperty analog). Returns immediately; execution is async. */
+void MXTPUEnginePush(EngineHandle engine, MXTPUOpCallback fn, void* payload,
+                     VarHandle* const_vars, int n_const,
+                     VarHandle* mutable_vars, int n_mutable, int prop);
+void MXTPUEngineWaitForAll(EngineHandle engine);
+void MXTPUEngineWaitForVar(EngineHandle engine, VarHandle var);
+int64_t MXTPUEnginePending(EngineHandle engine);
+
+/* ---- pooled host storage (include/mxnet/storage.h analog) ---- */
+/* Size-bucketed free-list pool; Alloc may return a recycled buffer. */
+void* MXTPUStorageAlloc(uint64_t size);
+void MXTPUStorageFree(void* ptr, uint64_t size);
+/* Return all pooled buffers to the OS (release-on-pressure). */
+void MXTPUStorageReleaseAll(void);
+void MXTPUStorageStats(uint64_t* allocated, uint64_t* pooled,
+                       uint64_t* allocs, uint64_t* hits);
+
+/* ---- RecordIO scanner (src/io recordio analog) ---- */
+/* Build an offset index of a .rec file: returns a handle and writes the
+ * record count to *out_count; NULL on failure. */
+void* MXTPURecordIOIndex(const char* path, int64_t* out_count);
+void MXTPURecordIOIndexGet(void* index, int64_t i, uint64_t* out_offset,
+                           uint32_t* out_length);
+void MXTPURecordIOIndexFree(void* index);
+/* Read records [begin, begin+n) payloads into buf (capacity buf_len);
+ * writes each record's length into out_lengths; returns bytes written
+ * or -1 on failure. */
+int64_t MXTPURecordIOReadBatch(const char* path, void* index, int64_t* order,
+                               int64_t n, uint8_t* buf, int64_t buf_len,
+                               uint32_t* out_lengths);
+
+/* ---- runtime op registry (c_api.cc op-discovery analog) ---- */
+/* Register/replace op metadata. Arrays are parallel; param_types follow
+ * the reference's "type, optional, default=..." style strings. */
+int MXTPURegisterOp(const char* name, const char* doc,
+                    const char** arg_names, int n_args,
+                    const char** param_names, const char** param_types,
+                    const char** param_docs, int n_params);
+/* Enumerate op names; pointers valid until the next MXTPUListOps call. */
+int MXTPUListOps(int* out_size, const char*** out_names);
+/* Fetch one op's metadata; pointers valid until re-registration. */
+int MXTPUGetOpInfo(const char* name, const char** out_doc, int* out_n_args,
+                   const char*** out_arg_names, int* out_n_params,
+                   const char*** out_param_names,
+                   const char*** out_param_types,
+                   const char*** out_param_docs);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXTPU_C_API_H_ */

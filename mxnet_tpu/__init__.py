@@ -52,5 +52,14 @@ from . import parallel
 from . import models
 from . import predict
 from . import torch_bridge
+from . import c_api
+
+# publish the op registry through the native C ABI so in-process
+# non-Python frontends can discover ops (reference: frontends enumerate
+# ops via MXSymbolListAtomicSymbolCreators at import)
+try:
+    c_api.publish_registry()
+except Exception:  # native lib optional; frontends fall back to Python
+    pass
 
 __version__ = "0.1.0"

@@ -1,0 +1,132 @@
+"""Python side of the flat C API (include/mxtpu/c_api.h).
+
+Publishes the op registry into the native library at import so thin
+in-process frontends can discover ops through the C ABI — the rebuild of
+the reference's runtime op discovery (MXSymbolListAtomicSymbolCreators /
+MXSymbolGetAtomicSymbolInfo, src/c_api/c_api.cc; consumed by
+python/mxnet/symbol.py:999-1120 to generate functions).  Here the roles
+are inverted — Python is the publisher, since op implementations are XLA
+emitters — but the discovery surface and its "typed param signature per
+op" contract are the same.
+
+Also exposes the per-thread error ring and list/get introspection
+helpers (used by tests and any non-Python binding).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from . import libinfo
+from .ops.op import OP_REGISTRY
+from .param import _REQUIRED
+
+__all__ = ["publish_registry", "list_ops", "get_op_info", "last_error"]
+
+_PUBLISHED = False
+
+
+def _sig_of(field):
+    """Render a field as a reference-style type string
+    ('float, optional, default=0.5' — the strings the C API hands to
+    frontends for docstring/kwargs generation)."""
+    tname = getattr(field.type, "__name__", str(field.type))
+    if tname == "_coerce_bool":
+        tname = "boolean"
+    parts = [tname]
+    if field.enum:
+        parts.append("{" + ", ".join(repr(e) for e in field.enum) + "}")
+    if field.required or field.default is _REQUIRED:
+        parts.append("required")
+    else:
+        parts.append(f"optional, default={field.default!r}")
+    return ", ".join(parts)
+
+
+def _c_arr(strings):
+    arr = (ctypes.c_char_p * max(len(strings), 1))()
+    for i, s in enumerate(strings):
+        arr[i] = s.encode()
+    return arr
+
+
+def publish_registry(lib=None):
+    """Push every registered op's metadata into the native registry.
+    No-op when the native library is unavailable."""
+    global _PUBLISHED
+    lib = lib or libinfo.find_lib()
+    if lib is None:
+        return False
+    for name in sorted(OP_REGISTRY._entries):
+        op = OP_REGISTRY.get(name)
+        try:
+            params = op.make_params({}) if op.param_cls else None
+        except Exception:
+            params = None
+        try:
+            args = list(op.list_arguments(params))
+        except Exception:
+            args = ["data"]
+        doc = (getattr(op, "__doc__", "") or
+               getattr(type(op), "__doc__", "") or "").strip()
+        fields = list(op.param_cls._fields.values()) if op.param_cls else []
+        pnames = [f.name for f in fields]
+        ptypes = [_sig_of(f) for f in fields]
+        pdocs = [f.doc or "" for f in fields]
+        rc = lib.MXTPURegisterOp(
+            name.encode(), doc.encode(), _c_arr(args), len(args),
+            _c_arr(pnames), _c_arr(ptypes), _c_arr(pdocs), len(pnames))
+        if rc != 0:
+            raise RuntimeError(last_error(lib))
+    _PUBLISHED = True
+    return True
+
+
+def _ensure_published(lib):
+    if not _PUBLISHED:
+        publish_registry(lib)
+
+
+def list_ops():
+    """Op names via the C ABI (MXSymbolListAtomicSymbolCreators shape)."""
+    lib = libinfo.find_lib()
+    if lib is None:
+        return sorted(OP_REGISTRY._entries)
+    _ensure_published(lib)
+    n = ctypes.c_int()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    if lib.MXTPUListOps(ctypes.byref(n), ctypes.byref(names)) != 0:
+        raise RuntimeError(last_error(lib))
+    return [names[i].decode() for i in range(n.value)]
+
+
+def get_op_info(name):
+    """(doc, arg_names, {param: (type_str, doc)}) via the C ABI."""
+    lib = libinfo.find_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    _ensure_published(lib)
+    doc = ctypes.c_char_p()
+    n_args = ctypes.c_int()
+    n_params = ctypes.c_int()
+    arg_names = ctypes.POINTER(ctypes.c_char_p)()
+    p_names = ctypes.POINTER(ctypes.c_char_p)()
+    p_types = ctypes.POINTER(ctypes.c_char_p)()
+    p_docs = ctypes.POINTER(ctypes.c_char_p)()
+    rc = lib.MXTPUGetOpInfo(
+        name.encode(), ctypes.byref(doc), ctypes.byref(n_args),
+        ctypes.byref(arg_names), ctypes.byref(n_params), ctypes.byref(p_names),
+        ctypes.byref(p_types), ctypes.byref(p_docs))
+    if rc != 0:
+        raise KeyError(last_error(lib))
+    args = [arg_names[i].decode() for i in range(n_args.value)]
+    params = {p_names[i].decode(): (p_types[i].decode(), p_docs[i].decode())
+              for i in range(n_params.value)}
+    return (doc.value or b"").decode(), args, params
+
+
+def last_error(lib=None):
+    lib = lib or libinfo.find_lib()
+    if lib is None:
+        return ""
+    return (lib.MXTPUGetLastError() or b"").decode()

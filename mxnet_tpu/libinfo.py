@@ -65,6 +65,23 @@ def _declare(lib):
     lib.MXTPUStorageFree.argtypes = [c.c_void_p, c.c_uint64]
     lib.MXTPUStorageReleaseAll.argtypes = []
     lib.MXTPUStorageStats.argtypes = [c.POINTER(c.c_uint64)] * 4
+
+    lib.MXTPUGetLastError.restype = c.c_char_p
+    lib.MXTPUSetLastError.argtypes = [c.c_char_p]
+    lib.MXTPURegisterOp.restype = c.c_int
+    lib.MXTPURegisterOp.argtypes = [
+        c.c_char_p, c.c_char_p, c.POINTER(c.c_char_p), c.c_int,
+        c.POINTER(c.c_char_p), c.POINTER(c.c_char_p), c.POINTER(c.c_char_p),
+        c.c_int]
+    lib.MXTPUListOps.restype = c.c_int
+    lib.MXTPUListOps.argtypes = [c.POINTER(c.c_int),
+                                 c.POINTER(c.POINTER(c.c_char_p))]
+    lib.MXTPUGetOpInfo.restype = c.c_int
+    lib.MXTPUGetOpInfo.argtypes = [
+        c.c_char_p, c.POINTER(c.c_char_p), c.POINTER(c.c_int),
+        c.POINTER(c.POINTER(c.c_char_p)), c.POINTER(c.c_int),
+        c.POINTER(c.POINTER(c.c_char_p)), c.POINTER(c.POINTER(c.c_char_p)),
+        c.POINTER(c.POINTER(c.c_char_p))]
     return lib
 
 

@@ -1,0 +1,136 @@
+// Flat C API surface: error handling + runtime op registry.
+//
+// C++ rebuild of the reference's src/c_api/c_api_error.{h,cc} (per-thread
+// last-error string behind int return codes) and the runtime-discoverable
+// operator registry that MXSymbolListAtomicSymbolCreators /
+// MXSymbolGetAtomicSymbolInfo expose (src/c_api/c_api.cc) — the
+// load-bearing piece that lets thin language frontends generate their op
+// bindings at runtime instead of compile time.
+//
+// In this framework the op *implementations* live in the XLA compute
+// layer; the Python package publishes each op's metadata (name, argument
+// list, typed parameter signature, docstring) into this registry at
+// import, after which any in-process frontend can enumerate ops through
+// the C ABI exactly like the reference's frontends do.
+
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+struct OpInfo {
+  std::string name;
+  std::string doc;
+  std::vector<std::string> arg_names;
+  std::vector<std::string> param_names;
+  std::vector<std::string> param_types;   // type[,default=...][,enum=...]
+  std::vector<std::string> param_docs;
+  // c_str views of the vectors above; rebuilt after insertion so they
+  // point at the map-owned strings (map nodes are address-stable)
+  std::vector<const char*> arg_ptrs;
+  std::vector<const char*> param_name_ptrs;
+  std::vector<const char*> param_type_ptrs;
+  std::vector<const char*> param_doc_ptrs;
+
+  void RebuildPtrs() {
+    auto fill = [](const std::vector<std::string>& src,
+                   std::vector<const char*>* dst) {
+      dst->clear();
+      for (const auto& s : src) dst->push_back(s.c_str());
+    };
+    fill(arg_names, &arg_ptrs);
+    fill(param_names, &param_name_ptrs);
+    fill(param_types, &param_type_ptrs);
+    fill(param_docs, &param_doc_ptrs);
+  }
+};
+
+static std::mutex reg_mu;
+static std::map<std::string, OpInfo>& Registry() {
+  static std::map<std::string, OpInfo> reg;
+  return reg;
+}
+// stable snapshot of names handed out by ListOps
+static std::vector<const char*> list_snapshot;
+
+thread_local std::string last_error;
+
+}  // namespace mxtpu
+
+extern "C" {
+
+// -- error ring (c_api_error analog) ----------------------------------------
+const char* MXTPUGetLastError() { return mxtpu::last_error.c_str(); }
+
+void MXTPUSetLastError(const char* msg) {
+  mxtpu::last_error = msg ? msg : "";
+}
+
+// -- op registry -------------------------------------------------------------
+// Register/replace an op. Arrays are parallel, length n_params.
+int MXTPURegisterOp(const char* name, const char* doc,
+                    const char** arg_names, int n_args,
+                    const char** param_names, const char** param_types,
+                    const char** param_docs, int n_params) {
+  if (name == nullptr || *name == '\0') {
+    MXTPUSetLastError("MXTPURegisterOp: empty op name");
+    return -1;
+  }
+  mxtpu::OpInfo info;
+  info.name = name;
+  info.doc = doc ? doc : "";
+  for (int i = 0; i < n_args; ++i)
+    info.arg_names.emplace_back(arg_names[i] ? arg_names[i] : "");
+  for (int i = 0; i < n_params; ++i) {
+    info.param_names.emplace_back(param_names[i] ? param_names[i] : "");
+    info.param_types.emplace_back(param_types[i] ? param_types[i] : "");
+    info.param_docs.emplace_back(param_docs && param_docs[i] ? param_docs[i]
+                                                             : "");
+  }
+  std::lock_guard<std::mutex> lk(mxtpu::reg_mu);
+  mxtpu::OpInfo& slot = mxtpu::Registry()[info.name];
+  slot = std::move(info);
+  slot.RebuildPtrs();
+  return 0;
+}
+
+// List registered op names (MXSymbolListAtomicSymbolCreators shape):
+// *out_size names, pointers owned by the library, valid until the next
+// ListOps call.
+int MXTPUListOps(int* out_size, const char*** out_names) {
+  std::lock_guard<std::mutex> lk(mxtpu::reg_mu);
+  mxtpu::list_snapshot.clear();
+  for (auto& kv : mxtpu::Registry())
+    mxtpu::list_snapshot.push_back(kv.first.c_str());
+  *out_size = static_cast<int>(mxtpu::list_snapshot.size());
+  *out_names = mxtpu::list_snapshot.data();
+  return 0;
+}
+
+// Op metadata (MXSymbolGetAtomicSymbolInfo shape). Returned pointers are
+// owned by the registry entry and stay valid until the op is re-registered.
+int MXTPUGetOpInfo(const char* name, const char** out_doc, int* out_n_args,
+                   const char*** out_arg_names, int* out_n_params,
+                   const char*** out_param_names,
+                   const char*** out_param_types,
+                   const char*** out_param_docs) {
+  std::lock_guard<std::mutex> lk(mxtpu::reg_mu);
+  auto it = mxtpu::Registry().find(name ? name : "");
+  if (it == mxtpu::Registry().end()) {
+    mxtpu::last_error = std::string("unknown op: ") + (name ? name : "");
+    return -1;
+  }
+  mxtpu::OpInfo& info = it->second;
+  *out_doc = info.doc.c_str();
+  *out_n_args = static_cast<int>(info.arg_ptrs.size());
+  *out_arg_names = info.arg_ptrs.data();
+  *out_n_params = static_cast<int>(info.param_name_ptrs.size());
+  *out_param_names = info.param_name_ptrs.data();
+  *out_param_types = info.param_type_ptrs.data();
+  *out_param_docs = info.param_doc_ptrs.data();
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,47 @@
+#include <stdio.h>
+#include <string.h>
+#include "mxtpu/c_api.h"
+
+static int ran = 0;
+static void op(void* p) { ran = 1; *(int*)p += 41; }
+
+int main(void) {
+  /* engine */
+  EngineHandle e = MXTPUEngineCreate(2, 1);
+  VarHandle v = MXTPUEngineNewVar(e);
+  int x = 1;
+  MXTPUEnginePush(e, op, &x, NULL, 0, &v, 1, 0);
+  MXTPUEngineWaitForAll(e);
+  if (!ran || x != 42) { printf("FAIL engine\n"); return 1; }
+  MXTPUEngineFree(e);
+
+  /* registry */
+  const char* args[] = {"data"};
+  const char* pn[] = {"alpha"};
+  const char* pt[] = {"float, optional, default=1.0"};
+  const char* pd[] = {"scale"};
+  if (MXTPURegisterOp("c_test_op", "doc here", args, 1, pn, pt, pd, 1) != 0)
+    { printf("FAIL register: %s\n", MXTPUGetLastError()); return 1; }
+  int n; const char** names;
+  MXTPUListOps(&n, &names);
+  int found = 0;
+  for (int i = 0; i < n; ++i) if (!strcmp(names[i], "c_test_op")) found = 1;
+  if (!found) { printf("FAIL list\n"); return 1; }
+  const char* doc; int na, np2;
+  const char **an, **pnn, **ptt, **pdd;
+  if (MXTPUGetOpInfo("c_test_op", &doc, &na, &an, &np2, &pnn, &ptt, &pdd) != 0)
+    { printf("FAIL info\n"); return 1; }
+  if (strcmp(doc, "doc here") || na != 1 || strcmp(an[0], "data") ||
+      np2 != 1 || strcmp(ptt[0], pt[0])) { printf("FAIL meta\n"); return 1; }
+
+  /* storage */
+  void* p = MXTPUStorageAlloc(1024);
+  MXTPUStorageFree(p, 1024);
+  void* p2 = MXTPUStorageAlloc(1000);  /* bucket reuse */
+  uint64_t a, b, c, h;
+  MXTPUStorageStats(&a, &b, &c, &h);
+  if (h < 1) { printf("FAIL pool reuse\n"); return 1; }
+  MXTPUStorageFree(p2, 1000);
+  printf("C_API_OK\n");
+  return 0;
+}

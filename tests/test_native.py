@@ -1,6 +1,7 @@
 """Native C++ runtime components (src/*.cc via ctypes): engine
 dependency semantics, recordio scanner, storage pool."""
 
+import os
 import threading
 import time
 
@@ -128,3 +129,68 @@ def test_staging_buffer_numpy_view():
     with StagingBuffer((4, 8), np.float32) as arr:
         arr[:] = np.arange(32).reshape(4, 8)
         assert arr.sum() == np.arange(32).sum()
+
+
+# -- flat C API: error ring + op discovery (include/mxtpu/c_api.h) ----------
+def test_c_api_error_ring():
+    from mxnet_tpu import c_api, libinfo
+
+    lib = libinfo.find_lib()
+    if lib is None:
+        pytest.skip("native lib unavailable")
+    lib.MXTPUSetLastError(b"boom")
+    assert c_api.last_error() == "boom"
+    lib.MXTPUSetLastError(b"")
+    assert c_api.last_error() == ""
+
+
+def test_c_api_op_discovery_roundtrip():
+    from mxnet_tpu import c_api, libinfo
+
+    if libinfo.find_lib() is None:
+        pytest.skip("native lib unavailable")
+    names = c_api.list_ops()
+    assert len(names) > 100
+    assert "convolution" in names and "softmaxoutput" in names
+
+    doc, args, params = c_api.get_op_info("convolution")
+    assert args[0] == "data"
+    assert "kernel" in params
+    type_str, _ = params["kernel"]
+    assert "required" in type_str
+    assert "num_filter" in params
+
+    doc, args, params = c_api.get_op_info("softmaxoutput")
+    assert args == ["data", "label"]
+    assert "grad_scale" in params
+    assert "optional" in params["grad_scale"][0]
+
+
+def test_c_api_unknown_op_sets_error():
+    from mxnet_tpu import c_api, libinfo
+
+    if libinfo.find_lib() is None:
+        pytest.skip("native lib unavailable")
+    with pytest.raises(KeyError):
+        c_api.get_op_info("no_such_op_xyz")
+    assert "no_such_op_xyz" in c_api.last_error()
+
+
+def test_c_api_usable_from_c(tmp_path):
+    """Compile and run a real C consumer of include/mxtpu/c_api.h —
+    the reference's thin-frontend contract (tests/cpp analog)."""
+    import shutil
+    import subprocess
+
+    if shutil.which("gcc") is None:
+        pytest.skip("no C compiler")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(repo, "tests", "cpp", "c_api_consumer.c")
+    exe = str(tmp_path / "capi_test")
+    lib_dir = os.path.join(repo, "mxnet_tpu", "lib")
+    subprocess.run(
+        ["gcc", "-I" + os.path.join(repo, "include"), src,
+         "-L" + lib_dir, "-lmxtpu", "-Wl,-rpath," + lib_dir, "-o", exe],
+        check=True, capture_output=True)
+    out = subprocess.run([exe], capture_output=True, text=True, check=True)
+    assert "C_API_OK" in out.stdout
