@@ -174,6 +174,14 @@ def register(reg_name):
 
         setattr(nd_mod, reg_name, nd_mod._make_ndarray_function(reg_name))
         setattr(sym_mod, reg_name, sym_mod._make_symbol_function(reg_name))
+        # keep the native C-ABI registry in sync for in-process frontends
+        try:
+            from . import c_api as _c_api
+
+            if _c_api._PUBLISHED:
+                _c_api.publish_registry()
+        except Exception:
+            pass
         return prop_cls
 
     return do_register

@@ -194,3 +194,15 @@ def test_c_api_usable_from_c(tmp_path):
         check=True, capture_output=True)
     out = subprocess.run([exe], capture_output=True, text=True, check=True)
     assert "C_API_OK" in out.stdout
+
+
+def test_c_api_sees_late_registered_custom_ops():
+    import mxnet_tpu as mx
+    from mxnet_tpu import c_api
+    from mxnet_tpu.operator import CustomOpProp, register
+
+    @register("late_custom_op_test")
+    class _P(CustomOpProp):
+        pass
+
+    assert "late_custom_op_test" in c_api.list_ops()
