@@ -1,0 +1,75 @@
+"""Shared training driver for the examples (rebuild of
+example/image-classification/train_model.py: kvstore selection,
+checkpointing, resume via --load-epoch, Speedometer logging)."""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def add_fit_args(parser):
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--lr-factor", type=float, default=1.0,
+                        help="epoch-wise lr decay factor")
+    parser.add_argument("--lr-factor-epoch", type=float, default=1.0)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--kv-store", default="local",
+                        help="local / device / dist_sync / dist_async")
+    parser.add_argument("--model-prefix", default=None,
+                        help="checkpoint prefix")
+    parser.add_argument("--load-epoch", type=int, default=None,
+                        help="resume from this checkpoint epoch")
+    parser.add_argument("--log-interval", type=int, default=50)
+    parser.add_argument("--gpus", default=None,
+                        help="device indices, e.g. 0,1 (default: all)")
+    return parser
+
+
+def contexts(args):
+    if args.gpus:
+        return [mx.tpu(int(i)) for i in args.gpus.split(",")]
+    n = mx.num_devices()
+    return [mx.tpu(i) for i in range(n)] if n > 1 else [mx.tpu(0)]
+
+
+def fit(args, net, train_iter, val_iter=None, eval_metric="acc"):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(message)s")
+    kv = mx.kv.create(args.kv_store)
+
+    model_args = {}
+    if args.load_epoch is not None:
+        assert args.model_prefix is not None
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        model_args = {"arg_params": arg_params, "aux_params": aux_params,
+                      "begin_epoch": args.load_epoch}
+
+    lr_scheduler = None
+    if args.lr_factor < 1.0:
+        epoch_size = max(getattr(train_iter, "num_data", 50000)
+                         // args.batch_size, 1)
+        lr_scheduler = mx.lr_scheduler.FactorScheduler(
+            step=max(int(epoch_size * args.lr_factor_epoch), 1),
+            factor=args.lr_factor)
+
+    checkpoint = (mx.callback.do_checkpoint(args.model_prefix)
+                  if args.model_prefix else None)
+
+    model = mx.FeedForward(
+        net, ctx=contexts(args), num_epoch=args.num_epochs,
+        learning_rate=args.lr, momentum=0.9, wd=1e-4,
+        initializer=mx.initializer.Xavier(factor_type="in", magnitude=2.34),
+        lr_scheduler=lr_scheduler, **model_args)
+    model.fit(X=train_iter, eval_data=val_iter, eval_metric=eval_metric,
+              kvstore=kv,
+              batch_end_callback=mx.callback.Speedometer(
+                  args.batch_size, args.log_interval),
+              epoch_end_callback=checkpoint)
+    return model

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Bucketed LSTM language model (rebuild of example/rnn/lstm_bucketing.py):
+variable-length sentences bucketed into a few padded lengths, one
+compiled program per bucket, weights shared across buckets via
+BucketingModule.
+
+--data: a tokenized text file (one sentence per line, e.g. PTB
+ptb.train.txt).  Without it, trains on synthetic Markov text.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.rnn_io import BucketSentenceIter, build_vocab, \
+    encode_sentences  # noqa: E402
+
+
+def load_sentences(args):
+    if args.data and os.path.exists(args.data):
+        with open(args.data) as f:
+            raw = [line.split() + ["<eos>"] for line in f if line.strip()]
+    else:
+        # synthetic Markov chains so the example runs without a corpus
+        rng = np.random.RandomState(0)
+        vocab_size = 200
+        trans = rng.dirichlet(np.ones(vocab_size) * 0.05, size=vocab_size)
+        raw = []
+        for _ in range(2000):
+            length = int(rng.randint(5, 60))
+            sent, tok = [], int(rng.randint(vocab_size))
+            for _ in range(length):
+                sent.append(str(tok))
+                tok = int(rng.choice(vocab_size, p=trans[tok]))
+            raw.append(sent)
+    vocab = build_vocab(raw)
+    return encode_sentences(raw, vocab), len(vocab) + 1
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data", default=None)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-hidden", type=int, default=200)
+    p.add_argument("--num-embed", type=int, default=200)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--num-epochs", type=int, default=5)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--buckets", default="10,20,30,40,60")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    sentences, vocab_size = load_sentences(args)
+    buckets = [int(x) for x in args.buckets.split(",")]
+    init_states = [(f"l{i}_init_{k}", (args.batch_size, args.num_hidden))
+                   for i in range(args.num_layers) for k in ("c", "h")]
+    data = BucketSentenceIter(sentences, args.batch_size, buckets=buckets,
+                              init_states=init_states)
+
+    def sym_gen(seq_len):
+        sym = mx.models.lstm_unroll(
+            args.num_layers, seq_len, vocab_size,
+            num_hidden=args.num_hidden, num_embed=args.num_embed,
+            num_label=vocab_size)
+        data_names = ["data"] + [n for n, _ in init_states]
+        return sym, data_names, ["softmax_label"]
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=data.default_bucket_key,
+                                 context=mx.tpu(0))
+    mod.fit(data, num_epoch=args.num_epochs,
+            eval_metric=mx.metric.CrossEntropy(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20),
+            initializer=mx.initializer.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-5})
+
+
+if __name__ == "__main__":
+    main()

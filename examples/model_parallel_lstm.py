@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Model-parallel LSTM: layers pinned to different devices via ctx_group
+(rebuild of example/model-parallel-lstm/lstm.py:48-99 + lstm_ptb.py).
+
+Each LSTM layer is built inside an AttrScope(ctx_group=...) and
+group2ctx maps groups to devices at bind time; the graph partitioner
+inserts cross-device transfers on group boundaries — on TPU these are
+ICI transfers between compiled per-device segments.
+
+Runs on N real devices, or (the canonical test trick) N CPU contexts.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def pipelined_lstm_unroll(num_layers, seq_len, input_size, num_hidden,
+                          num_embed, num_label):
+    """lstm_unroll with each layer in its own ctx_group (the reference
+    pins embed+layer0 to group 'layer0', etc.)."""
+    from mxnet_tpu.models.lstm import LSTMParam, LSTMState, lstm_cell
+
+    with mx.AttrScope(ctx_group="embed"):
+        data = mx.sym.Variable("data")
+        embed = mx.sym.Embedding(
+            data, weight=mx.sym.Variable("embed_weight"),
+            input_dim=input_size, output_dim=num_embed, name="embed")
+        wordvec = mx.sym.SliceChannel(embed, num_outputs=seq_len, axis=1,
+                                      squeeze_axis=True)
+
+    params, states = [], []
+    for i in range(num_layers):
+        with mx.AttrScope(ctx_group=f"layer{i}"):
+            params.append(LSTMParam(
+                i2h_weight=mx.sym.Variable(f"l{i}_i2h_weight"),
+                i2h_bias=mx.sym.Variable(f"l{i}_i2h_bias"),
+                h2h_weight=mx.sym.Variable(f"l{i}_h2h_weight"),
+                h2h_bias=mx.sym.Variable(f"l{i}_h2h_bias")))
+            states.append(LSTMState(c=mx.sym.Variable(f"l{i}_init_c"),
+                                    h=mx.sym.Variable(f"l{i}_init_h")))
+
+    hidden_all = []
+    for t in range(seq_len):
+        hidden = wordvec[t]
+        for i in range(num_layers):
+            with mx.AttrScope(ctx_group=f"layer{i}"):
+                states[i] = lstm_cell(num_hidden, indata=hidden,
+                                      prev_state=states[i], param=params[i],
+                                      seqidx=t, layeridx=i)
+                hidden = states[i].h
+        hidden_all.append(hidden)
+
+    with mx.AttrScope(ctx_group="out"):
+        concat = mx.sym.Concat(*hidden_all, dim=0,
+                               num_args=len(hidden_all))
+        fc = mx.sym.FullyConnected(concat, weight=mx.sym.Variable("cls_weight"),
+                                   bias=mx.sym.Variable("cls_bias"),
+                                   num_hidden=num_label, name="cls")
+        label = mx.sym.transpose(mx.sym.Variable("softmax_label"))
+        label_flat = mx.sym.Reshape(label, shape=(-1,))
+        return mx.sym.SoftmaxOutput(fc, label_flat, name="softmax")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--seq-len", type=int, default=16)
+    p.add_argument("--num-hidden", type=int, default=64)
+    p.add_argument("--num-embed", type=int, default=64)
+    p.add_argument("--vocab", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--cpu-contexts", action="store_true",
+                   help="use N CPU contexts instead of devices "
+                        "(the test_model_parallel.py trick)")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = pipelined_lstm_unroll(args.num_layers, args.seq_len, args.vocab,
+                                args.num_hidden, args.num_embed, args.vocab)
+
+    n_dev = mx.num_devices()
+    dev = (lambda i: mx.cpu(i)) if args.cpu_contexts else \
+        (lambda i: mx.tpu(i % n_dev))
+    group2ctx = {"embed": dev(0), "out": dev(0)}
+    for i in range(args.num_layers):
+        group2ctx[f"layer{i}"] = dev(i % max(args.num_layers, 1))
+
+    shapes = {"data": (args.batch_size, args.seq_len),
+              "softmax_label": (args.batch_size, args.seq_len)}
+    for i in range(args.num_layers):
+        shapes[f"l{i}_init_c"] = (args.batch_size, args.num_hidden)
+        shapes[f"l{i}_init_h"] = (args.batch_size, args.num_hidden)
+    exe = net.simple_bind(dev(0), grad_req="write", group2ctx=group2ctx,
+                          **shapes)
+    init = mx.initializer.Xavier()
+    for name, arr in exe.arg_dict.items():
+        if name not in shapes:
+            init(name, arr)
+
+    rng = np.random.RandomState(0)
+    opt = mx.opt.SGD(learning_rate=0.05, momentum=0.9)
+    updater = mx.opt.get_updater(opt)
+    for step in range(args.steps):
+        X = rng.randint(0, args.vocab, (args.batch_size, args.seq_len))
+        exe.arg_dict["data"][:] = X
+        y = np.roll(X, -1, axis=1)
+        exe.arg_dict["softmax_label"][:] = y
+        exe.forward(is_train=True)
+        exe.backward()
+        for k, (w, g) in enumerate(zip(exe.arg_arrays, exe.grad_arrays)):
+            if g is not None and exe.arg_names[k] not in shapes:
+                updater(k, g, w)
+        if step % 10 == 0:
+            prob = exe.outputs[0].asnumpy()
+            ll = -np.log(np.maximum(
+                prob[np.arange(prob.shape[0]),
+                     y.T.reshape(-1).astype(int)], 1e-9)).mean()
+            logging.info("step %d nll %.4f", step, ll)
+
+
+if __name__ == "__main__":
+    main()

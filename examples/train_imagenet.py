@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Train ResNet-50 on ImageNet RecordIO shards, or benchmark on
+synthetic data (rebuild of example/image-classification/train_imagenet.py
++ benchmark.py).
+
+Real data: --data-dir with train.rec/val.rec packed by tools/im2rec.py.
+No data: synthetic device-resident batches (the benchmark.py mode).
+"""
+
+import os
+
+import numpy as np
+
+import common
+import mxnet_tpu as mx
+
+
+def get_iters(args):
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    d = args.data_dir
+    if d and os.path.exists(os.path.join(d, "train.rec")):
+        train = mx.ImageRecordIter(
+            path_imgrec=os.path.join(d, "train.rec"), data_shape=shape,
+            batch_size=args.batch_size, shuffle=True, rand_crop=True,
+            rand_mirror=True, preprocess_threads=args.data_nthreads,
+            part_index=args.part_index, num_parts=args.num_parts)
+        val_path = os.path.join(d, "val.rec")
+        val = mx.ImageRecordIter(
+            path_imgrec=val_path, data_shape=shape,
+            batch_size=args.batch_size,
+            preprocess_threads=args.data_nthreads) \
+            if os.path.exists(val_path) else None
+        return train, val
+    # synthetic benchmark mode
+    rng = np.random.RandomState(0)
+    n = args.batch_size * 8
+    X = rng.standard_normal((n,) + shape).astype(np.float32)
+    y = rng.randint(0, args.num_classes, n).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, args.batch_size), None
+
+
+def main():
+    parser = common.add_fit_args(__import__("argparse").ArgumentParser(
+        description=__doc__))
+    parser.add_argument("--data-dir", default=None)
+    parser.add_argument("--num-layers", type=int, default=50)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--image-shape", default="3,224,224")
+    parser.add_argument("--layout", default="NHWC", choices=["NCHW", "NHWC"],
+                        help="NHWC feeds the TPU MXU best")
+    parser.add_argument("--data-nthreads", type=int, default=4)
+    parser.add_argument("--part-index", type=int, default=0)
+    parser.add_argument("--num-parts", type=int, default=1)
+    args = parser.parse_args()
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    net = mx.models.resnet(num_classes=args.num_classes,
+                           num_layers=args.num_layers, image_shape=shape,
+                           layout=args.layout)
+    train, val = get_iters(args)
+    common.fit(args, net, train, val)
+
+
+if __name__ == "__main__":
+    main()

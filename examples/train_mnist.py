@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Train an MLP or LeNet on MNIST
+(rebuild of example/image-classification/train_mnist.py).
+
+With --data-dir pointing at the idx files, uses MNISTIter; without,
+trains on a synthetic stand-in so the example runs anywhere.
+"""
+
+import os
+
+import numpy as np
+
+import common
+import mxnet_tpu as mx
+
+
+def get_iters(args):
+    flat = args.network == "mlp"
+    d = args.data_dir
+    if d and os.path.exists(os.path.join(d, "train-images-idx3-ubyte")):
+        train = mx.io.MNISTIter(
+            image=os.path.join(d, "train-images-idx3-ubyte"),
+            label=os.path.join(d, "train-labels-idx1-ubyte"),
+            batch_size=args.batch_size, shuffle=True, flat=flat)
+        val = mx.io.MNISTIter(
+            image=os.path.join(d, "t10k-images-idx3-ubyte"),
+            label=os.path.join(d, "t10k-labels-idx1-ubyte"),
+            batch_size=args.batch_size, shuffle=False, flat=flat)
+        return train, val
+    # synthetic fallback: 10 gaussian blobs
+    rng = np.random.RandomState(0)
+    n = 6400
+    y = rng.randint(0, 10, n)
+    X = rng.standard_normal((n, 784)).astype(np.float32) * 0.3
+    X[np.arange(n), y * 78] += 2.0
+    if not flat:
+        X = X.reshape(n, 1, 28, 28)
+    split = n - 1280
+    train = mx.io.NDArrayIter(X[:split], y[:split].astype(np.float32),
+                              args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(X[split:], y[split:].astype(np.float32),
+                            args.batch_size)
+    return train, val
+
+
+def main():
+    parser = common.add_fit_args(__import__("argparse").ArgumentParser(
+        description=__doc__))
+    parser.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    parser.add_argument("--data-dir", default=None)
+    args = parser.parse_args()
+    net = (mx.models.mlp() if args.network == "mlp"
+           else mx.models.lenet())
+    train, val = get_iters(args)
+    common.fit(args, net, train, val)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Train SSD-VGG16 detection (rebuild of example/ssd/train.py →
+train/train_net.py with the native multibox ops).
+
+Real data: --data-dir with train.rec packed by tools/im2rec.py using
+detection labels.  Without it, trains briefly on synthetic boxes to
+demonstrate the full multibox target/loss path.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def synthetic_det_iter(batch_size, data_shape, num_classes, n=64):
+    rng = np.random.RandomState(0)
+    X = rng.standard_normal((n,) + data_shape).astype(np.float32)
+    labels = np.full((n, 4, 5), -1.0, np.float32)
+    for i in range(n):
+        for b in range(rng.randint(1, 4)):
+            cls = rng.randint(0, num_classes)
+            x1, y1 = rng.uniform(0, 0.6, 2)
+            w, h = rng.uniform(0.2, 0.4, 2)
+            labels[i, b] = [cls, x1, y1, min(x1 + w, 1.0), min(y1 + h, 1.0)]
+    return mx.io.NDArrayIter({"data": X}, {"label": labels}, batch_size,
+                             shuffle=True)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--data-shape", type=int, default=300)
+    p.add_argument("--filter-scale", type=int, default=1,
+                   help="channel divisor for quick runs (e.g. 16)")
+    p.add_argument("--num-classes", type=int, default=20)
+    p.add_argument("--num-epochs", type=int, default=2)
+    p.add_argument("--lr", type=float, default=0.004)
+    p.add_argument("--model-prefix", default=None)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    shape = (3, args.data_shape, args.data_shape)
+    net = mx.models.ssd(num_classes=args.num_classes, mode="train",
+                        filter_scale=args.filter_scale)
+    data = synthetic_det_iter(args.batch_size, shape, args.num_classes)
+
+    mod = mx.mod.Module(net, data_names=["data"], label_names=["label"],
+                        context=mx.tpu(0))
+    # relu4_3's learned L2-norm scale initializes to 20 (reference
+    # train_net.py), everything else Xavier
+    initializer = mx.initializer.Mixed(
+        ["relu4_3_scale", ".*"],
+        [mx.initializer.Constant(20.0), mx.initializer.Xavier()])
+    mod.fit(data, num_epoch=args.num_epochs,
+            initializer=initializer,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 5e-4},
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 5),
+            eval_metric=mx.metric.Loss() if hasattr(mx.metric, "Loss")
+            else "mse",
+            epoch_end_callback=(mx.callback.do_checkpoint(args.model_prefix)
+                                if args.model_prefix else None))
+
+
+if __name__ == "__main__":
+    main()

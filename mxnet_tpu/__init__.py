@@ -33,6 +33,7 @@ from . import callback
 from . import monitor
 from . import io
 from . import recordio
+from . import rnn_io
 from . import image_io
 from .image_io import ImageRecordIter
 

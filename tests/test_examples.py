@@ -1,0 +1,111 @@
+"""Examples + bucketed sequence iterator (reference example/ drivers and
+example/rnn/bucket_io.py)."""
+
+import os
+import runpy
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.rnn_io import BucketSentenceIter, build_vocab, encode_sentences
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+# -- BucketSentenceIter -----------------------------------------------------
+def _sentences(n=100, seed=0):
+    rng = np.random.RandomState(seed)
+    return [[int(x) for x in rng.randint(1, 50, rng.randint(3, 25))]
+            for _ in range(n)]
+
+
+def test_bucket_sentence_iter_shapes_and_labels():
+    it = BucketSentenceIter(_sentences(), batch_size=8, buckets=[8, 16, 32],
+                            shuffle=False)
+    assert it.default_bucket_key == 32
+    n_batches = 0
+    for _ in range(200):
+        try:
+            b = it.next()
+        except StopIteration:
+            break
+        n_batches += 1
+        assert b.bucket_key in (8, 16, 32)
+        data = b.data[0].asnumpy()
+        label = b.label[0].asnumpy()
+        assert data.shape == (8, b.bucket_key)
+        np.testing.assert_array_equal(label[:, :-1], data[:, 1:])
+        assert (label[:, -1] == 0).all()
+    assert n_batches >= 5
+    it.reset()
+    assert it.next() is not None
+
+
+def test_bucket_sentence_iter_auto_buckets_and_drop():
+    sents = _sentences(60) + [[1] * 100]  # one longer than any bucket
+    it = BucketSentenceIter(sents, batch_size=4, buckets=[10, 24])
+    for _ in range(100):
+        try:
+            b = it.next()
+        except StopIteration:
+            break
+        assert b.data[0].shape[1] <= 24
+
+
+def test_bucket_sentence_iter_init_states():
+    it = BucketSentenceIter(_sentences(), batch_size=4, buckets=[16],
+                            init_states=[("l0_init_c", (4, 8)),
+                                         ("l0_init_h", (4, 8))])
+    b = it.next()
+    assert len(b.data) == 3
+    assert b.data[1].shape == (4, 8)
+    assert [d.name for d in b.provide_data] == ["data", "l0_init_c",
+                                                "l0_init_h"]
+
+
+def test_vocab_helpers():
+    raw = [["the", "cat"], ["the", "dog"]]
+    vocab = build_vocab(raw)
+    assert vocab["the"] == 1
+    enc = encode_sentences(raw, vocab)
+    assert enc[0][0] == enc[1][0] == 1
+
+
+# -- example scripts (synthetic fallback paths) -----------------------------
+def _run_example(script, argv):
+    old_argv, old_path = sys.argv, list(sys.path)
+    sys.argv = [script] + argv
+    sys.path.insert(0, EXAMPLES)
+    try:
+        runpy.run_path(os.path.join(EXAMPLES, script), run_name="__main__")
+    finally:
+        sys.argv, sys.path = old_argv, old_path
+
+
+def test_example_train_mnist_runs():
+    _run_example("train_mnist.py",
+                 ["--num-epochs", "1", "--batch-size", "256", "--lr", "0.2"])
+
+
+def test_example_lstm_bucketing_runs():
+    _run_example("lstm_bucketing.py",
+                 ["--num-epochs", "1", "--batch-size", "16",
+                  "--num-hidden", "16", "--num-embed", "16",
+                  "--num-layers", "1", "--buckets", "12,24"])
+
+
+def test_example_model_parallel_lstm_runs():
+    _run_example("model_parallel_lstm.py",
+                 ["--steps", "3", "--seq-len", "6", "--num-hidden", "16",
+                  "--num-embed", "8", "--vocab", "30", "--batch-size", "4",
+                  "--cpu-contexts"])
+
+
+@pytest.mark.filterwarnings("ignore")
+def test_example_train_ssd_runs():
+    _run_example("train_ssd.py",
+                 ["--num-epochs", "1", "--batch-size", "2",
+                  "--filter-scale", "16", "--num-classes", "3"])
