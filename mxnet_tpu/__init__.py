@@ -31,6 +31,7 @@ from . import metric
 from . import lr_scheduler
 from . import callback
 from . import monitor
+from . import profiler
 from . import io
 from . import recordio
 from . import rnn_io

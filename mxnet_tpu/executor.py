@@ -69,8 +69,15 @@ class _CompiledGraph:
     """Traceable evaluator for a Symbol's node graph on one context."""
 
     def __init__(self, symbol):
+        import os
+
         self.symbol = symbol
         self.topo = symbol._topo()
+        # global gradient-checkpointing switch (reference
+        # MXNET_BACKWARD_DO_MIRROR, static_graph.cc:396-440); per-node
+        # force_mirroring attrs still apply when unset
+        self._mirror_all = os.environ.get(
+            "MXNET_BACKWARD_DO_MIRROR", "0") in ("1", "true", "True")
         self.heads = symbol._heads
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -107,7 +114,8 @@ class _CompiledGraph:
             n_args, aux_names = self._aux_of_node[id(node)]
             ins = [env[id(src), idx] for src, idx in node.inputs[:n_args]]
             auxs = [new_aux[a] for a in aux_names]
-            mirror = node.attrs.get("force_mirroring", "") in ("1", "true", "True")
+            mirror = self._mirror_all or node.attrs.get(
+                "force_mirroring", "") in ("1", "true", "True")
             if id(node) in self._custom:
                 outs = list(self._custom[id(node)](*ins))
                 node_new_aux = auxs

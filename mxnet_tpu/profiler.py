@@ -1,0 +1,86 @@
+"""Profiling / tracing facilities.
+
+The reference snapshot has no dedicated profiler (SURVEY.md §5); its
+observability surface is Monitor tensor-stat hooks, the Speedometer
+callback, `MXNET_ENGINE_INFO` engine traces and check_speed — all of
+which exist here (monitor.py, callback.py, test_utils.check_speed).
+This module adds the TPU-native tracing layer on top: a thin wrapper
+over the JAX/XLA profiler whose traces open in TensorBoard/Perfetto and
+show per-op device time on the real chip.
+
+API shape follows the familiar profiler contract:
+  profiler.start("/tmp/prof"); ...; profiler.stop()
+  with profiler.scope("step"): ...
+  profiler.annotate("h2d-copy") decorator
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+
+__all__ = ["start", "stop", "trace", "scope", "annotate", "device_memory"]
+
+_active_logdir = None
+
+
+def start(logdir):
+    """Begin capturing an XLA trace into ``logdir`` (TensorBoard
+    `profile` plugin / xprof format)."""
+    global _active_logdir
+    jax.profiler.start_trace(logdir)
+    _active_logdir = logdir
+
+
+def stop():
+    """Finish the capture started by ``start``."""
+    global _active_logdir
+    jax.profiler.stop_trace()
+    _active_logdir = None
+
+
+@contextlib.contextmanager
+def trace(logdir):
+    """Capture a trace around a block."""
+    start(logdir)
+    try:
+        yield
+    finally:
+        stop()
+
+
+def scope(name, **kwargs):
+    """Named region inside an active trace (shows as a span)."""
+    return jax.profiler.TraceAnnotation(name, **kwargs)
+
+
+def annotate(name=None):
+    """Decorator: wrap a function in a named trace span."""
+
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kw):
+            with jax.profiler.TraceAnnotation(label):
+                return fn(*args, **kw)
+
+        return wrapper
+
+    return deco
+
+
+def device_memory(device=None):
+    """Live per-buffer device memory stats (storage observability; the
+    pooled-allocator stats analog for HBM)."""
+    devs = [device] if device is not None else jax.local_devices()
+    out = {}
+    for d in devs:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        out[str(d)] = stats
+    return out

@@ -124,3 +124,48 @@ def test_mirror_attr_runs():
     g = exe.grad_dict["data"].asnumpy()
     expected = 2 * np.tanh(x) * (1 - np.tanh(x) ** 2)
     np.testing.assert_allclose(g, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_backward_do_mirror_env(monkeypatch):
+    # MXNET_BACKWARD_DO_MIRROR=1 rematerializes activations; gradients
+    # must be identical to the unmirrored run
+    import numpy as np
+
+    def build():
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+        net = mx.sym.Activation(net, act_type="tanh")
+        net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((4, 8)).astype(np.float32)
+    y = rng.randint(0, 4, 4).astype(np.float32)
+
+    grads = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", flag)
+        exe = build().simple_bind(mx.cpu(), grad_req="write",
+                                  data=(4, 8), softmax_label=(4,))
+        rng2 = np.random.RandomState(7)
+        for name, arr in exe.arg_dict.items():
+            if name not in ("data", "softmax_label"):
+                arr[:] = rng2.uniform(-0.1, 0.1, arr.shape).astype(np.float32)
+        exe.forward(is_train=True, data=x, softmax_label=y)
+        exe.backward()
+        grads[flag] = exe.grad_dict["fc1_weight"].asnumpy()
+    np.testing.assert_allclose(grads["0"], grads["1"], rtol=1e-5, atol=1e-6)
+
+
+def test_profiler_api_smoke(tmp_path):
+    from mxnet_tpu import profiler
+
+    @profiler.annotate("square")
+    def f(v):
+        return v * v
+
+    with profiler.trace(str(tmp_path / "prof")):
+        with profiler.scope("region"):
+            f(np.ones(4))
+    mem = profiler.device_memory()
+    assert isinstance(mem, dict) and len(mem) >= 1
