@@ -102,15 +102,21 @@ def _platform_devices(device_type: str):
         "tpu": ["tpu", None],
         "gpu": ["tpu", "gpu", None],
     }[device_type]
+    # local (process-addressable) devices only: context ids are
+    # per-process, like the reference's per-worker device ordinals —
+    # matters under jax.distributed where jax.devices() is global
     devs = None
     for plat in order:
         try:
-            devs = jax.devices(plat) if plat else jax.devices()
+            candidates = jax.devices(plat) if plat else jax.devices()
+            local = [d for d in candidates
+                     if d.process_index == jax.process_index()]
+            devs = local or candidates
             break
         except RuntimeError:
             continue
     if devs is None:
-        devs = jax.devices()
+        devs = jax.local_devices()
     cache[key] = devs
     return devs
 

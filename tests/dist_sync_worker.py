@@ -1,0 +1,52 @@
+"""Worker script for the distributed kvstore exactness test (rebuild of
+tests/nightly/dist_sync_kvstore.py): each rank pushes deterministic
+values; every rank must observe the exact global sum each round.
+
+Launched by test_dist.py via tools/launch.py -n N.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+    assert nworker == int(os.environ["MXTPU_NUM_PROCS"])
+
+    shape = (5, 7)
+    big_shape = (1200, 1100)  # the big-key striping path analog
+    kv.init(3, mx.nd.ones(shape))
+    kv.init(99, mx.nd.ones(big_shape))
+    kv.barrier()
+
+    for round_i in range(4):
+        scale = rank + round_i + 1
+        kv.push(3, mx.nd.ones(shape) * scale)
+        kv.push(99, mx.nd.ones(big_shape) * scale)
+        # expected exact sum over ranks: sum_{r}(r + round_i + 1)
+        expect = sum(r + round_i + 1 for r in range(nworker))
+        out = mx.nd.zeros(shape)
+        kv.pull(3, out)
+        np.testing.assert_array_equal(out.asnumpy(), expect)
+        big = mx.nd.zeros(big_shape)
+        kv.pull(99, big)
+        np.testing.assert_array_equal(big.asnumpy(), expect)
+        kv.barrier()
+
+    print(f"RANK_{rank}_OK")
+
+
+if __name__ == "__main__":
+    main()
