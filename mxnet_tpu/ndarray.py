@@ -458,6 +458,16 @@ def _make_ndarray_function(op_name):
     return generic_fn
 
 
+def Custom(*args, op_type=None, **kwargs):
+    """Generic custom-op invoker (``mx.nd.Custom(..., op_type=name)``,
+    src/operator/custom.cc): dispatches to the registered CustomOpProp."""
+    if op_type is None:
+        raise TypeError("Custom requires op_type=<registered custom op name>")
+    if op_type not in OP_REGISTRY:
+        raise MXNetError(f"Custom op {op_type!r} is not registered")
+    return _make_ndarray_function(op_type)(*args, **kwargs)
+
+
 def _init_ndarray_module():
     mod = sys.modules[__name__]
     for name in OP_REGISTRY.list():

@@ -14,7 +14,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..param import Params, field
-from .op import register_simple_op
+from .op import OpDef, register_op, register_simple_op
 
 
 class ScalarParam(Params):
@@ -102,6 +102,63 @@ _scalar("_greater_scalar", lambda p, x: (x > p.scalar).astype(x.dtype))
 _scalar("_greater_equal_scalar", lambda p, x: (x >= p.scalar).astype(x.dtype))
 _scalar("_lesser_scalar", lambda p, x: (x < p.scalar).astype(x.dtype))
 _scalar("_lesser_equal_scalar", lambda p, x: (x <= p.scalar).astype(x.dtype))
+
+
+class ElementWiseSumParam(Params):
+    num_args = field(int, required=True, lower=1, doc="number of summands")
+
+
+@register_op("ElementWiseSum", aliases=("add_n", "element_wise_sum"))
+class ElementWiseSumOp(OpDef):
+    """Variadic sum (src/operator/elementwise_sum-inl.h; also the NDArray
+    function ElementwiseSum, src/ndarray/ndarray.cc:292+)."""
+
+    param_cls = ElementWiseSumParam
+
+    def list_arguments(self, params):
+        return [f"arg{i}" for i in range(params.num_args)]
+
+    def infer_shape(self, params, in_shapes):
+        known = next((s for s in in_shapes if s is not None), None)
+        if known is None:
+            raise ValueError("ElementWiseSum: no input shape known")
+        for s in in_shapes:
+            if s is not None and tuple(s) != tuple(known):
+                raise ValueError(
+                    f"ElementWiseSum: all inputs must share one shape, "
+                    f"got {tuple(s)} vs {tuple(known)}")
+        return [known if s is None else s for s in in_shapes], [tuple(known)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        out = inputs[0]
+        for x in inputs[1:]:
+            out = out + x
+        return [out], []
+
+
+def _element_mask(lhs, rhs):
+    return lhs * rhs.reshape((rhs.shape[0],) + (1,) * (lhs.ndim - 1)).astype(lhs.dtype)
+
+
+def _element_mask_shape(params, in_shapes):
+    lhs, rhs = in_shapes
+    if lhs is None:
+        raise ValueError("element_mask: lhs shape unknown")
+    if len(lhs) < 2 or (rhs is not None and (len(rhs) != 1 or rhs[0] != lhs[0])):
+        raise ValueError("element_mask: lhs must be >=2D, rhs 1D with matching dim0")
+    return [lhs, (lhs[0],)], tuple(lhs)
+
+
+def _element_mask_backward(params, out_grads, inputs, outputs):
+    # Mask is non-differentiable w.r.t. rhs (broadcast_mask_op-inl.h:59-82
+    # writes only lhs_grad).
+    og = out_grads[0]
+    return [_element_mask(og, inputs[1]), jnp.zeros_like(inputs[1])]
+
+
+register_simple_op("element_mask", _element_mask, nin=2,
+                   shape_rule=_element_mask_shape,
+                   backward_fn=_element_mask_backward)
 
 
 class SmoothL1Param(Params):

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..param import Params, field
-from .op import OpDef, register_op
+from .op import OpDef, register_op, register_simple_op
 
 
 class SoftmaxOutputParam(Params):
@@ -367,3 +367,36 @@ class CTCLossOp(OpDef):
             lambda d: jnp.sum(self._compute(params, [d] + list(inputs[1:]))))(
                 inputs[0])
         return [grad] + [jnp.zeros_like(x) for x in inputs[1:]]
+
+
+def _softmax_cross_entropy(data, label):
+    # loss_binary_op-inl.h:35-70: scalar output sum_i -log(max(p_i[y_i], 1e-8))
+    prob = jax.nn.softmax(data, axis=-1)
+    picked = jnp.take_along_axis(
+        prob, label.astype(jnp.int32)[:, None], axis=-1)[:, 0]
+    return jnp.sum(-jnp.log(jnp.maximum(picked, 1e-8))).reshape(1)
+
+
+def _softmax_cross_entropy_shape(params, in_shapes):
+    d, l = in_shapes
+    if d is None:
+        raise ValueError("softmax_cross_entropy: data shape unknown")
+    if len(d) != 2 or (l is not None and (len(l) != 1 or l[0] != d[0])):
+        raise ValueError("softmax_cross_entropy: data must be 2D, label 1D "
+                         "with matching dim0")
+    return [d, (d[0],)], (1,)
+
+
+def _softmax_cross_entropy_backward(params, out_grads, inputs, outputs):
+    # loss_binary_op-inl.h:73-99: data_grad = scale * (softmax - onehot);
+    # label is non-differentiable (kNullOp enforced in the reference).
+    data, label = inputs
+    prob = jax.nn.softmax(data, axis=-1)
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1],
+                            dtype=prob.dtype)
+    scale = out_grads[0].reshape(()).astype(prob.dtype)
+    return [scale * (prob - onehot), jnp.zeros_like(label)]
+
+register_simple_op("softmax_cross_entropy", _softmax_cross_entropy, nin=2,
+                   shape_rule=_softmax_cross_entropy_shape,
+                   backward_fn=_softmax_cross_entropy_backward)

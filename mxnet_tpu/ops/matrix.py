@@ -9,6 +9,7 @@ ops Reshape/Flatten/Concat/SliceChannel/SwapAxis/Cast/Pad
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -158,6 +159,64 @@ def _slice_shape(p, in_shapes):
 register_simple_op(
     "slice", lambda p, x: x[tuple(slice(b, e) for b, e in zip(p.begin, p.end))],
     nin=1, param_cls=SliceParam, shape_rule=_slice_shape, aliases=("crop_like",))
+
+
+def _check_crop_region(begin, end, shape, opname):
+    if not (len(begin) == len(end) == len(shape)):
+        raise ValueError(f"{opname}: begin/end ndim must match data ndim")
+    for b, e, d in zip(begin, end, shape):
+        if not (0 <= b <= e <= d):
+            raise ValueError(
+                f"{opname}: region [{begin}, {end}) out of bounds for {shape}")
+
+
+def _crop_assign_shape(p, in_shapes):
+    lhs, rhs = in_shapes
+    if lhs is None:
+        raise ValueError("_crop_assign: lhs shape unknown")
+    _check_crop_region(p.begin, p.end, lhs, "_crop_assign")
+    want = tuple(e - b for b, e in zip(p.begin, p.end))
+    if rhs is not None and tuple(rhs) != want:
+        raise ValueError(f"_crop_assign: rhs shape {rhs} != region {want}")
+    return [lhs, want], tuple(lhs)
+
+
+def _crop_assign(p, lhs, rhs):
+    # Functional form of the reference's inplace region write
+    # (matrix_op-inl.h:453 CropAssign, kWriteInplace): returns lhs with
+    # [begin, end) overwritten by rhs.
+    return jax.lax.dynamic_update_slice(lhs, rhs.astype(lhs.dtype), p.begin)
+
+
+register_simple_op("_crop_assign", _crop_assign, nin=2,
+                   param_cls=SliceParam, shape_rule=_crop_assign_shape,
+                   aliases=("_slice_assign",))
+
+
+class CropAssignScalarParam(Params):
+    begin = field(tuple_of(int), required=True)
+    end = field(tuple_of(int), required=True)
+    scalar = field(float, default=0.0, doc="value written into the region")
+
+
+def _crop_assign_scalar(p, x):
+    # matrix_op-inl.h:535 CropAssignScalar.
+    region = tuple(e - b for b, e in zip(p.begin, p.end))
+    fill = jnp.full(region, p.scalar, dtype=x.dtype)
+    return jax.lax.dynamic_update_slice(x, fill, p.begin)
+
+
+def _crop_assign_scalar_shape(p, in_shapes):
+    if in_shapes[0] is None:
+        raise ValueError("_crop_assign_scalar: input shape unknown")
+    _check_crop_region(p.begin, p.end, in_shapes[0], "_crop_assign_scalar")
+    return in_shapes, tuple(in_shapes[0])
+
+
+register_simple_op("_crop_assign_scalar", _crop_assign_scalar, nin=1,
+                   param_cls=CropAssignScalarParam,
+                   shape_rule=_crop_assign_scalar_shape,
+                   aliases=("_slice_assign_scalar",))
 
 
 # -- Reshape / Flatten -------------------------------------------------------

@@ -94,3 +94,62 @@ def _argmax_channel(x):
 
 register_simple_op("argmax_channel", _argmax_channel, nin=1,
                    shape_rule=lambda p, s: (s, (s[0][0],) + tuple(s[0][2:])))
+
+
+class BroadcastAxisParam(Params):
+    axis = field(tuple_of(int), default=(), doc="axes to broadcast (must be size 1)")
+    size = field(tuple_of(int), default=(), doc="target sizes per axis")
+
+
+def _broadcast_axis_shape(params, in_shapes):
+    shp = in_shapes[0]
+    if shp is None:
+        raise ValueError("broadcast_axis: input shape unknown")
+    if len(params.axis) != len(params.size):
+        raise ValueError("broadcast_axis: axis and size must have equal length")
+    out = list(shp)
+    for ax, sz in zip(params.axis, params.size):
+        ax = ax % len(out)
+        if out[ax] != 1:
+            raise ValueError(f"broadcast_axis: axis {ax} has size {out[ax]}, "
+                             "can only broadcast size-1 axes")
+        out[ax] = sz
+    return in_shapes, tuple(out)
+
+
+def _broadcast_axis(p, x):
+    out = list(x.shape)
+    for ax, sz in zip(p.axis, p.size):
+        out[ax % x.ndim] = sz
+    return jnp.broadcast_to(x, tuple(out))
+
+
+register_simple_op("broadcast_axis", _broadcast_axis, nin=1,
+                   param_cls=BroadcastAxisParam, shape_rule=_broadcast_axis_shape)
+
+
+class BroadcastToParam(Params):
+    shape = field(tuple_of(int), required=True,
+                  doc="target shape; 0 keeps the input size on that axis")
+
+
+def _broadcast_to_shape(params, in_shapes):
+    shp = in_shapes[0]
+    if shp is None:
+        raise ValueError("broadcast_to: input shape unknown")
+    if len(params.shape) != len(shp):
+        raise ValueError("broadcast_to: shape ndim mismatch")
+    out = tuple(d if t == 0 else t for d, t in zip(shp, params.shape))
+    for d, t in zip(shp, out):
+        if d != t and d != 1:
+            raise ValueError(f"broadcast_to: cannot broadcast {shp} to {out}")
+    return in_shapes, out
+
+
+def _broadcast_to(p, x):
+    out = tuple(d if t == 0 else t for d, t in zip(x.shape, p.shape))
+    return jnp.broadcast_to(x, out)
+
+
+register_simple_op("broadcast_to", _broadcast_to, nin=1,
+                   param_cls=BroadcastToParam, shape_rule=_broadcast_to_shape)

@@ -547,6 +547,17 @@ def _make_symbol_function(op_name):
     return creator
 
 
+def Custom(*args, op_type=None, **kwargs):
+    """Generic custom-op invoker (src/operator/custom.cc `Custom` registration;
+    python/mxnet/operator.py usage ``mx.sym.Custom(..., op_type=name)``):
+    dispatches to the CustomOpProp registered under ``op_type``."""
+    if op_type is None:
+        raise TypeError("Custom requires op_type=<registered custom op name>")
+    if op_type not in OP_REGISTRY:
+        raise MXNetError(f"Custom op {op_type!r} is not registered")
+    return _make_symbol_function(op_type)(*args, **kwargs)
+
+
 def _init_symbol_module():
     mod = sys.modules[__name__]
     for name in OP_REGISTRY.list():

@@ -331,3 +331,162 @@ def test_makeloss_grad_scale():
     exe.backward()
     np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
                                2 * 2 * np.array([1, 2, 3.0]), rtol=1e-5)
+
+
+def test_element_wise_sum():
+    syms = [mx.sym.Variable(f"x{i}") for i in range(4)]
+    out = mx.sym.ElementWiseSum(*syms, num_args=4)
+    loc = {f"x{i}": rng.randn(3, 4) for i in range(4)}
+    check_symbolic_forward(out, loc, [sum(loc[f"x{i}"] for i in range(4))])
+    check_numeric_gradient(out, loc)
+    # imperative path
+    arrs = [mx.nd.array(loc[f"x{i}"]) for i in range(4)]
+    got = mx.nd.ElementWiseSum(*arrs, num_args=4).asnumpy()
+    assert reldiff(got, sum(a.asnumpy() for a in arrs)) < 1e-6
+
+
+def test_broadcast_axis_and_to():
+    data = mx.sym.Variable("data")
+    x = rng.randn(2, 1, 3)
+    out = mx.sym.broadcast_axis(data, axis=(1,), size=(4,))
+    check_symbolic_forward(out, {"data": x},
+                           [np.broadcast_to(x, (2, 4, 3))])
+    check_numeric_gradient(out, {"data": x})
+    out2 = mx.sym.broadcast_to(data, shape=(0, 5, 0))
+    check_symbolic_forward(out2, {"data": x},
+                           [np.broadcast_to(x, (2, 5, 3))])
+    check_numeric_gradient(out2, {"data": x})
+    # backward of broadcast is sum-reduce over the broadcast axis
+    with pytest.raises(Exception):
+        mx.sym.broadcast_axis(data, axis=(0,), size=(4,)).infer_shape(
+            data=(2, 1, 3))
+
+
+def test_element_mask():
+    lhs = mx.sym.Variable("lhs")
+    rhs = mx.sym.Variable("rhs")
+    out = mx.sym.element_mask(lhs, rhs)
+    x = rng.randn(4, 3, 2)
+    m = np.array([1.0, 0.0, 1.0, 0.0])
+    want = x * m.reshape(4, 1, 1)
+    check_symbolic_forward(out, {"lhs": x, "rhs": m}, [want])
+    # gradient flows only to lhs, masked by rhs
+    e = out.simple_bind(mx.cpu(), lhs=x.shape, rhs=m.shape)
+    e.arg_dict["lhs"][:] = x
+    e.arg_dict["rhs"][:] = m
+    e.forward(is_train=True)
+    og = rng.randn(4, 3, 2)
+    e.backward([mx.nd.array(og)])
+    assert reldiff(e.grad_dict["lhs"].asnumpy(), og * m.reshape(4, 1, 1)) < 1e-6
+    assert np.abs(e.grad_dict["rhs"].asnumpy()).max() == 0.0
+
+
+def test_softmax_cross_entropy():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    out = mx.sym.softmax_cross_entropy(data, label)
+    x = rng.randn(5, 7)
+    y = rng.randint(0, 7, 5).astype(np.float64)
+    ex = np.exp(x - x.max(axis=1, keepdims=True))
+    prob = ex / ex.sum(axis=1, keepdims=True)
+    want = -np.log(np.maximum(prob[np.arange(5), y.astype(int)], 1e-8)).sum()
+    check_symbolic_forward(out, {"data": x, "label": y},
+                           [np.array([want])], check_eps=1e-4)
+    # explicit backward: scale * (softmax - onehot)
+    e = out.simple_bind(mx.cpu(), grad_req={"data": "write", "label": "null"},
+                        data=x.shape, label=y.shape)
+    e.arg_dict["data"][:] = x
+    e.arg_dict["label"][:] = y
+    e.forward(is_train=True)
+    e.backward([mx.nd.array(np.array([2.0]))])
+    onehot = np.eye(7)[y.astype(int)]
+    assert reldiff(e.grad_dict["data"].asnumpy(), 2.0 * (prob - onehot)) < 1e-5
+
+
+def test_crop_assign():
+    lhs = mx.sym.Variable("lhs")
+    rhs = mx.sym.Variable("rhs")
+    out = mx.sym._crop_assign(lhs, rhs, begin=(1, 0), end=(3, 2))
+    x = rng.randn(4, 3)
+    r = rng.randn(2, 2)
+    want = x.copy()
+    want[1:3, 0:2] = r
+    check_symbolic_forward(out, {"lhs": x, "rhs": r}, [want])
+    sc = mx.sym._crop_assign_scalar(lhs, begin=(0, 1), end=(2, 3), scalar=7.5)
+    want2 = x.copy()
+    want2[0:2, 1:3] = 7.5
+    check_symbolic_forward(sc, {"lhs": x}, [want2])
+
+
+def test_custom_dispatcher():
+    import mxnet_tpu.operator as op
+
+    @op.register("_test_scale2x")
+    class ScaleProp(op.CustomOpProp):
+        def list_arguments(self):
+            return ["data"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class Scale(op.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * 2.0)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0] * 2.0)
+
+            return Scale()
+
+    x = rng.randn(3, 4).astype(np.float32)
+    got = mx.nd.Custom(mx.nd.array(x), op_type="_test_scale2x").asnumpy()
+    assert reldiff(got, x * 2.0) < 1e-6
+    data = mx.sym.Variable("data")
+    s = mx.sym.Custom(data, op_type="_test_scale2x")
+    check_symbolic_forward(s, {"data": x}, [x * 2.0])
+    with pytest.raises(Exception):
+        mx.sym.Custom(data, op_type="_no_such_custom_op")
+
+
+def test_parity_op_validation():
+    data = mx.sym.Variable("data")
+    # mismatched ElementWiseSum shapes must fail at infer time
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    with pytest.raises(Exception):
+        mx.sym.ElementWiseSum(a, b, num_args=2).infer_shape(a=(3, 4), b=(1, 4))
+    # out-of-bounds crop regions must fail, not clamp
+    with pytest.raises(Exception):
+        mx.sym._crop_assign(a, b, begin=(3, 0), end=(5, 2)).infer_shape(
+            a=(4, 3), b=(2, 2))
+    with pytest.raises(Exception):
+        mx.sym._crop_assign_scalar(data, begin=(2, 0), end=(1, 2),
+                                   scalar=1.0).infer_shape(data=(4, 3))
+    # malformed broadcast_axis params
+    with pytest.raises(Exception):
+        mx.sym.broadcast_axis(data, axis=(1, 2), size=(4,)).infer_shape(
+            data=(2, 1, 1))
+    # Custom with CamelCase registered name must dispatch (case-insensitive
+    # registry membership)
+    import mxnet_tpu.operator as op
+
+    @op.register("CamelCaseScale")
+    class CamelProp(op.CustomOpProp):
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class S(op.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * 3.0)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0] * 3.0)
+
+            return S()
+
+    x = rng.randn(2, 3).astype(np.float32)
+    got = mx.nd.Custom(mx.nd.array(x), op_type="CamelCaseScale").asnumpy()
+    assert reldiff(got, x * 3.0) < 1e-6
