@@ -184,7 +184,13 @@ def _crop_assign_shape(p, in_shapes):
 def _crop_assign(p, lhs, rhs):
     # Functional form of the reference's inplace region write
     # (matrix_op-inl.h:453 CropAssign, kWriteInplace): returns lhs with
-    # [begin, end) overwritten by rhs.
+    # [begin, end) overwritten by rhs.  Shapes are static under jit, so
+    # bounds-check eagerly — dynamic_update_slice would silently clamp.
+    _check_crop_region(p.begin, p.end, lhs.shape, "_crop_assign")
+    want = tuple(e - b for b, e in zip(p.begin, p.end))
+    if tuple(rhs.shape) != want:
+        raise ValueError(
+            f"_crop_assign: rhs shape {tuple(rhs.shape)} != region {want}")
     return jax.lax.dynamic_update_slice(lhs, rhs.astype(lhs.dtype), p.begin)
 
 
@@ -200,7 +206,9 @@ class CropAssignScalarParam(Params):
 
 
 def _crop_assign_scalar(p, x):
-    # matrix_op-inl.h:535 CropAssignScalar.
+    # matrix_op-inl.h:535 CropAssignScalar.  Eager bounds check as in
+    # _crop_assign: dynamic_update_slice silently clamps out-of-bounds.
+    _check_crop_region(p.begin, p.end, x.shape, "_crop_assign_scalar")
     region = tuple(e - b for b, e in zip(p.begin, p.end))
     fill = jnp.full(region, p.scalar, dtype=x.dtype)
     return jax.lax.dynamic_update_slice(x, fill, p.begin)

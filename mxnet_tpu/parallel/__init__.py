@@ -2,8 +2,8 @@
 
 First-class TPU capabilities (SURVEY.md §2.4 parallelism inventory):
 data parallel (dp), tensor parallel (tp), sequence/context parallel (sp,
-ring attention), pipeline parallel (pp) and the all-reduce bandwidth
-benchmark harness.
+ring attention), pipeline parallel (pp), expert parallel (ep, MoE) and
+the all-reduce bandwidth benchmark harness.
 """
 
 from .mesh import Mesh, NamedSharding, PartitionSpec, make_mesh, local_mesh, \
@@ -13,9 +13,11 @@ from .collectives import allreduce, allreduce_bench, psum, all_gather, \
 from .trainer import ShardedTrainer, sgd_opt, adam_opt
 from .ring_attention import ring_attention, attention_reference
 from .pipeline import pipeline_apply, PipelineModule
+from .moe import moe_apply, moe_reference, MoELayer, init_moe_params
 
 __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "make_mesh", "local_mesh",
            "replicated", "shard_along", "allreduce", "allreduce_bench", "psum",
            "all_gather", "reduce_scatter", "ppermute", "ShardedTrainer",
            "sgd_opt", "adam_opt", "ring_attention", "attention_reference",
-           "pipeline_apply", "PipelineModule"]
+           "pipeline_apply", "PipelineModule",
+           "moe_apply", "moe_reference", "MoELayer", "init_moe_params"]

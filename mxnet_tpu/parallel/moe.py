@@ -1,0 +1,243 @@
+"""Mixture-of-Experts with expert parallelism (``ep`` mesh axis).
+
+New first-class TPU capability (absent in the reference — SURVEY.md §2.4
+marks expert parallelism "No").  Implements Switch/top-k token routing
+with capacity-based dispatch: each device on the ``ep`` axis owns
+``E / n_shards`` experts; tokens are routed with an in-program
+``lax.all_to_all`` over ICI (dispatch), run through the local experts,
+and routed back (combine), all inside one ``shard_map``-compiled XLA
+program so the router, both all-to-alls, the expert FFNs, and the
+load-balancing auxiliary loss fuse into a single differentiable step.
+
+Dispatch math follows the standard capacity formulation (Switch
+Transformer / GShard): per-expert capacity ``C = ceil(k * tokens_per
+_shard / E * capacity_factor)``; tokens beyond capacity are dropped from
+that expert (their combine weight is zero, so the layer degrades to the
+residual path if the caller adds one).
+
+Exposed as:
+- ``moe_apply(...)`` — functional sharded call (differentiable);
+- ``moe_reference(...)`` — identical math, single device, for tests;
+- ``MoELayer`` — stateful convenience wrapper (init + trainable step).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["moe_apply", "moe_reference", "MoELayer", "init_moe_params"]
+
+
+def _router(x, gate_w, num_experts, k, capacity):
+    """Token routing: returns (dispatch, combine, aux_loss).
+
+    x: (T, D) tokens.  dispatch: (T, E, C) one-hot routing tensor;
+    combine: same shape scaled by gate probabilities.
+    """
+    T = x.shape[0]
+    logits = x @ gate_w                                   # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    dispatch = jnp.zeros((T, num_experts, capacity), x.dtype)
+    combine = jnp.zeros((T, num_experts, capacity), x.dtype)
+    masked = probs
+    # occupancy per expert carried across the k routing rounds
+    occupancy = jnp.zeros((num_experts,), jnp.int32)
+    frac_routed = jnp.zeros((num_experts,), x.dtype)
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)                 # (T,)
+        gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(idx, num_experts, dtype=x.dtype)  # (T, E)
+        # position of each token within its expert's buffer this round
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) + occupancy[None, :].astype(
+            x.dtype)
+        pos_int = pos.astype(jnp.int32)
+        keep = (pos_int < capacity).astype(x.dtype) * onehot
+        slot = jax.nn.one_hot(pos_int, capacity, dtype=x.dtype)   # (T, E, C)
+        d = keep[..., None] * slot
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        frac_routed = frac_routed + jnp.sum(onehot, axis=0) / T
+        occupancy = occupancy + jnp.sum(keep, axis=0).astype(jnp.int32)
+        masked = masked * (1.0 - onehot)                  # exclude chosen
+
+    # Switch-style load-balancing loss: E * <frac tokens> . <mean prob>
+    mean_prob = jnp.mean(probs, axis=0)
+    aux_loss = num_experts * jnp.sum((frac_routed / k) * mean_prob)
+    return dispatch, combine, aux_loss
+
+
+def _expert_ffn(params_i, h):
+    """One expert: two-layer FFN with ReLU (params: w1, b1, w2, b2)."""
+    h = jnp.maximum(h @ params_i["w1"] + params_i["b1"], 0.0)
+    return h @ params_i["w2"] + params_i["b2"]
+
+
+def capacity_for(tokens_per_shard, num_experts, k=1, capacity_factor=1.25):
+    return max(1, int(math.ceil(k * tokens_per_shard / num_experts
+                                * capacity_factor)))
+
+
+@functools.lru_cache(maxsize=64)
+def _build_moe_run(mesh: Mesh, axis: str, k: int, E: int, C: int, expert_fn):
+    """Cached compiled MoE step for one (mesh, routing config) combo.
+
+    jax.jit caches on function identity + input shapes, so the shard_map
+    program must be built once per config, not per call — otherwise every
+    training step recompiles.
+    """
+    n_shards = mesh.shape[axis]
+    epl = E // n_shards            # experts per shard
+    tok_spec = PartitionSpec(axis, None)
+    gate_spec = PartitionSpec(None, None)
+
+    def shard_fn(gate_w, experts_local, x_local):
+        dispatch, combine, aux = _router(x_local, gate_w, E, k, C)
+        # gather each expert's token buffer: (E, C, D)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, x_local)
+        D = expert_in.shape[-1]
+        # dispatch all-to-all: device g receives, from every shard s, the
+        # buffers for its expert group -> (n_shards, epl, C, D)
+        expert_in = expert_in.reshape(n_shards, epl, C, D)
+        expert_in = lax.all_to_all(expert_in, axis, split_axis=0,
+                                   concat_axis=0, tiled=False)
+        # run local experts over all shards' tokens at once
+        flat_in = expert_in.transpose(1, 0, 2, 3).reshape(epl, n_shards * C, D)
+        flat_out = jax.vmap(expert_fn)(experts_local, flat_in)
+        Do = flat_out.shape[-1]
+        # combine all-to-all: route results back to their source shards
+        out = flat_out.reshape(epl, n_shards, C, Do).transpose(1, 0, 2, 3)
+        out = lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
+                             tiled=False)
+        out = out.reshape(E, C, Do)
+        y_local = jnp.einsum("tec,ecd->td", combine, out)
+        # aux loss: average over shards so the global loss is one scalar
+        aux = lax.pmean(aux, axis)
+        return y_local, aux
+
+    @jax.jit
+    def run(gate_w, experts, x):
+        exp_spec = jax.tree_util.tree_map(lambda _: PartitionSpec(axis),
+                                          experts)
+        return shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(gate_spec, exp_spec, tok_spec),
+            out_specs=(tok_spec, PartitionSpec()),
+            check_vma=False)(gate_w, experts, x)
+
+    return run
+
+
+def moe_apply(params, x, mesh: Mesh, axis: str = "ep", k: int = 1,
+              capacity_factor: float = 1.25, expert_fn=_expert_ffn):
+    """Expert-parallel MoE layer over mesh axis ``axis``.
+
+    Parameters
+    ----------
+    params : dict with "gate_w" (D, E) replicated and "experts", a pytree
+        whose leaves have leading dim E (sharded over ``axis``).
+    x : (tokens, D) global batch of tokens, sharded over ``axis`` on dim 0
+        (replicated input is placed here).
+    expert_fn : must be a stable function object — compiled programs are
+        cached per (mesh, routing config, expert_fn); a fresh lambda per
+        call recompiles and churns the cache.
+    Returns (y, aux_loss) with y sharded like x.
+    """
+    n_shards = mesh.shape[axis]
+    E = params["gate_w"].shape[1]
+    if E % n_shards:
+        raise ValueError(f"num_experts {E} not divisible by ep={n_shards}")
+    T = x.shape[0]
+    if T % n_shards:
+        raise ValueError(f"tokens {T} not divisible by ep={n_shards}")
+    C = capacity_for(T // n_shards, E, k, capacity_factor)
+    run = _build_moe_run(mesh, axis, k, E, C, expert_fn)
+
+    if not isinstance(x, jax.core.Tracer):
+        x = jax.device_put(x, NamedSharding(mesh, PartitionSpec(axis, None)))
+    return run(params["gate_w"], params["experts"], x)
+
+
+def moe_reference(params, x, n_shards: int, k: int = 1,
+                  capacity_factor: float = 1.25, expert_fn=_expert_ffn):
+    """Single-device math-identical reference: same per-shard routing and
+    capacities as ``moe_apply`` on an ``n_shards``-way mesh."""
+    E = params["gate_w"].shape[1]
+    T = x.shape[0]
+    if T % n_shards:
+        raise ValueError(f"tokens {T} not divisible by n_shards={n_shards}")
+    C = capacity_for(T // n_shards, E, k, capacity_factor)
+    outs, auxes = [], []
+    for s in range(n_shards):
+        x_local = x[s * (T // n_shards):(s + 1) * (T // n_shards)]
+        dispatch, combine, aux = _router(x_local, params["gate_w"], E, k, C)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, x_local)
+        expert_out = jax.vmap(expert_fn)(params["experts"], expert_in)
+        outs.append(jnp.einsum("tec,ecd->td", combine, expert_out))
+        auxes.append(aux)
+    return jnp.concatenate(outs, axis=0), jnp.mean(jnp.stack(auxes))
+
+
+def init_moe_params(rng, d_model, d_hidden, num_experts, d_out=None,
+                    dtype=np.float32):
+    """Initializer for the default FFN experts + router."""
+    d_out = d_model if d_out is None else d_out
+    s1 = 1.0 / np.sqrt(d_model)
+    s2 = 1.0 / np.sqrt(d_hidden)
+    return {
+        "gate_w": (rng.standard_normal((d_model, num_experts)) * s1
+                   ).astype(dtype),
+        "experts": {
+            "w1": (rng.standard_normal((num_experts, d_model, d_hidden)) * s1
+                   ).astype(dtype),
+            "b1": np.zeros((num_experts, d_hidden), dtype),
+            "w2": (rng.standard_normal((num_experts, d_hidden, d_out)) * s2
+                   ).astype(dtype),
+            "b2": np.zeros((num_experts, d_out), dtype),
+        },
+    }
+
+
+class MoELayer:
+    """Stateful convenience wrapper around ``moe_apply`` (trainable)."""
+
+    def __init__(self, d_model, d_hidden, num_experts, mesh, axis="ep",
+                 k=1, capacity_factor=1.25, seed=0):
+        self.mesh, self.axis, self.k = mesh, axis, k
+        self.capacity_factor = capacity_factor
+        self.params = init_moe_params(np.random.RandomState(seed), d_model,
+                                      d_hidden, num_experts)
+        self._steps = {}               # (loss_fn id) -> jitted update
+
+    def __call__(self, x):
+        y, aux = moe_apply(self.params, x, self.mesh, self.axis, self.k,
+                           self.capacity_factor)
+        self.last_aux_loss = aux
+        return y
+
+    def grad_step(self, x, loss_fn, lr=0.01, aux_weight=0.01):
+        step = self._steps.get(id(loss_fn))
+        if step is None:
+            def step_fn(params, x, lr, aux_weight):
+                def objective(params):
+                    y, aux = moe_apply(params, x, self.mesh, self.axis,
+                                       self.k, self.capacity_factor)
+                    return loss_fn(y) + aux_weight * aux
+
+                loss, grads = jax.value_and_grad(objective)(params)
+                new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                                    params, grads)
+                return loss, new_params
+
+            step = jax.jit(step_fn)
+            self._steps[id(loss_fn)] = step
+        loss, self.params = step(self.params, x, lr, aux_weight)
+        return loss

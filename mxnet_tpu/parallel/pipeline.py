@@ -16,6 +16,8 @@ parameters stacked on a leading axis sharded over ``pp``.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -26,29 +28,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 __all__ = ["pipeline_apply", "PipelineModule"]
 
 
-def pipeline_apply(stage_fn, stacked_params, x, n_microbatches, mesh: Mesh,
-                   axis: str = "pp"):
-    """Run ``x`` through ``n_stages`` copies of ``stage_fn`` as a pipeline.
-
-    Parameters
-    ----------
-    stage_fn : (params_i, activation) -> activation, same shape in/out
-    stacked_params : pytree whose leaves have leading dim n_stages
-        (sharded over ``axis``; each device sees its own stage's slice)
-    x : (batch, ...) global input; split into n_microbatches along batch
-    n_microbatches : must divide batch
-    """
+@functools.lru_cache(maxsize=64)
+def _build_pipeline_run(stage_fn, mesh: Mesh, axis: str):
+    """Cached compiled pipeline program per (stage_fn, mesh, axis) —
+    jax.jit caches on function identity, so the shard_map must be built
+    once per config or every call recompiles."""
     n_stages = mesh.shape[axis]
-    B = x.shape[0]
-    if B % n_microbatches:
-        raise ValueError("batch not divisible by n_microbatches")
-    mb = B // n_microbatches
-    xs = x.reshape((n_microbatches, mb) + x.shape[1:])
-    total_ticks = n_microbatches + n_stages - 1
-    pad = jnp.zeros((n_stages - 1,) + xs.shape[1:], xs.dtype)
-    feed = jnp.concatenate([xs, pad], axis=0)  # one injection per tick
-
-    p_spec = jax.tree_util.tree_map(lambda _: PartitionSpec(axis), stacked_params)
     rep = PartitionSpec()
 
     def shard_fn(params, feed_local):
@@ -77,9 +62,40 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatches, mesh: Mesh,
                           axis)
         return result
 
-    run = shard_map(shard_fn, mesh=mesh, in_specs=(p_spec, rep),
-                    out_specs=rep, check_vma=False)
-    outs = jax.jit(run)(stacked_params, feed)
+    @jax.jit
+    def run(stacked_params, feed):
+        p_spec = jax.tree_util.tree_map(lambda _: PartitionSpec(axis),
+                                        stacked_params)
+        return shard_map(shard_fn, mesh=mesh, in_specs=(p_spec, rep),
+                         out_specs=rep, check_vma=False)(stacked_params, feed)
+
+    return run
+
+
+def pipeline_apply(stage_fn, stacked_params, x, n_microbatches, mesh: Mesh,
+                   axis: str = "pp"):
+    """Run ``x`` through ``n_stages`` copies of ``stage_fn`` as a pipeline.
+
+    Parameters
+    ----------
+    stage_fn : (params_i, activation) -> activation, same shape in/out;
+        must be a stable function object for compile caching
+    stacked_params : pytree whose leaves have leading dim n_stages
+        (sharded over ``axis``; each device sees its own stage's slice)
+    x : (batch, ...) global input; split into n_microbatches along batch
+    n_microbatches : must divide batch
+    """
+    n_stages = mesh.shape[axis]
+    B = x.shape[0]
+    if B % n_microbatches:
+        raise ValueError("batch not divisible by n_microbatches")
+    mb = B // n_microbatches
+    xs = x.reshape((n_microbatches, mb) + x.shape[1:])
+    pad = jnp.zeros((n_stages - 1,) + xs.shape[1:], xs.dtype)
+    feed = jnp.concatenate([xs, pad], axis=0)  # one injection per tick
+
+    run = _build_pipeline_run(stage_fn, mesh, axis)
+    outs = run(stacked_params, feed)
     return outs.reshape((B,) + x.shape[1:])
 
 
@@ -96,6 +112,7 @@ class PipelineModule:
         self.mesh = mesh
         self.axis = axis
         self.n_microbatches = n_microbatches
+        self._steps = {}               # (loss_fn id) -> jitted update
         spec = jax.tree_util.tree_map(
             lambda _: NamedSharding(mesh, PartitionSpec(axis)), stacked_params)
         self.params = jax.device_put(stacked_params, spec)
@@ -105,14 +122,25 @@ class PipelineModule:
                               self.n_microbatches, self.mesh, self.axis)
 
     def grad_step(self, x, loss_fn, lr=0.01):
-        """One SGD step through the pipelined computation."""
+        """One SGD step through the pipelined computation.
 
-        def objective(params):
-            out = pipeline_apply(self.stage_fn, params, x,
-                                 self.n_microbatches, self.mesh, self.axis)
-            return loss_fn(out)
+        ``loss_fn`` must be a stable function object — the jitted update
+        is cached per loss_fn, so a fresh lambda per call recompiles."""
+        step = self._steps.get(id(loss_fn))
+        if step is None:
+            def step_fn(params, x, lr):
+                def objective(params):
+                    out = pipeline_apply(self.stage_fn, params, x,
+                                         self.n_microbatches, self.mesh,
+                                         self.axis)
+                    return loss_fn(out)
 
-        loss, grads = jax.value_and_grad(objective)(self.params)
-        self.params = jax.tree_util.tree_map(
-            lambda p, g: p - lr * g, self.params, grads)
+                loss, grads = jax.value_and_grad(objective)(params)
+                new_params = jax.tree_util.tree_map(
+                    lambda p, g: p - lr * g, params, grads)
+                return loss, new_params
+
+            step = jax.jit(step_fn)
+            self._steps[id(loss_fn)] = step
+        loss, self.params = step(self.params, x, lr)
         return loss

@@ -82,15 +82,12 @@ def _ring_body(q, k, v, axis_name, n_shards, scale, causal, q_index):
     return o / jnp.maximum(l, 1e-20)[..., None]
 
 
-def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False):
-    """Sharded multi-head attention over a sequence-parallel mesh axis.
-
-    q/k/v: (batch, heads, seq, head_dim), sharded over ``axis`` on the
-    seq dimension (replicated arrays are accepted and sharded here).
-    Returns the attention output with the same sharding.
-    """
+@functools.lru_cache(maxsize=64)
+def _build_ring_run(mesh: Mesh, axis: str, scale: float, causal: bool):
+    """Cached compiled ring-attention program per (mesh, axis, config) —
+    jax.jit caches on function identity, so the shard_map must be built
+    once per config or every call recompiles."""
     n_shards = mesh.shape[axis]
-    scale = float(1.0 / np.sqrt(q.shape[-1]))
     spec = PartitionSpec(None, None, axis, None)
 
     @jax.jit
@@ -103,10 +100,24 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False):
             shard_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)(q, k, v)
 
-    sharding = NamedSharding(mesh, spec)
-    q = jax.device_put(q, sharding)
-    k = jax.device_put(k, sharding)
-    v = jax.device_put(v, sharding)
+    return run
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False):
+    """Sharded multi-head attention over a sequence-parallel mesh axis.
+
+    q/k/v: (batch, heads, seq, head_dim), sharded over ``axis`` on the
+    seq dimension (replicated arrays are accepted and sharded here).
+    Returns the attention output with the same sharding.
+    """
+    scale = float(1.0 / np.sqrt(q.shape[-1]))
+    run = _build_ring_run(mesh, axis, scale, bool(causal))
+
+    if not isinstance(q, jax.core.Tracer):
+        sharding = NamedSharding(mesh, PartitionSpec(None, None, axis, None))
+        q = jax.device_put(q, sharding)
+        k = jax.device_put(k, sharding)
+        v = jax.device_put(v, sharding)
     return run(q, k, v)
 
 

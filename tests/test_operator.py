@@ -416,6 +416,17 @@ def test_crop_assign():
     want2 = x.copy()
     want2[0:2, 1:3] = 7.5
     check_symbolic_forward(sc, {"lhs": x}, [want2])
+    # imperative path must also reject out-of-bounds regions, not clamp
+    # (jax dynamic_update_slice would silently shift the write)
+    with pytest.raises(Exception):
+        mx.nd._crop_assign_scalar(mx.nd.array(x), begin=(3, 0), end=(5, 2),
+                                  scalar=99.0)
+    with pytest.raises(Exception):
+        mx.nd._crop_assign(mx.nd.array(x), mx.nd.array(r), begin=(3, 0),
+                           end=(5, 2))
+    with pytest.raises(Exception):  # rhs shape != region
+        mx.nd._crop_assign(mx.nd.array(x), mx.nd.array(np.zeros((3, 2))),
+                           begin=(1, 0), end=(3, 2))
 
 
 def test_custom_dispatcher():
