@@ -18,4 +18,7 @@ from . import vision  # noqa: F401
 from . import multibox  # noqa: F401
 from . import sample  # noqa: F401
 
-__all__ = ["OP_REGISTRY", "OpDef", "SimpleOpDef", "register_op", "register_simple_op"]
+from .flash_attention import flash_attention
+
+__all__ = ["OP_REGISTRY", "OpDef", "SimpleOpDef", "register_op",
+           "register_simple_op", "flash_attention"]

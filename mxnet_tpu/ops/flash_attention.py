@@ -1,0 +1,342 @@
+"""Fused flash attention as a Pallas TPU kernel.
+
+The hot op of the attention family (the reference framework predates
+attention; its fused-kernel analog is the cuDNN RNN wrapper,
+cudnn_rnn-inl.h — this is the TPU-era equivalent: hand-fused kernels
+where stock XLA lowering leaves performance on the table).  Standard
+streaming-softmax tiling: the (Sq x Sk) score matrix is never
+materialized in HBM; each grid step loads one (block_q x d) Q tile and
+one (block_k x d) K/V tile into VMEM, updates running max / sum-exp /
+accumulator scratch, and writes the normalized output once on the last
+K step.  MXU does the two matmuls per tile; accumulation is always
+float32 regardless of input dtype.
+
+Backward is a custom VJP with two more Pallas kernels (dQ, and dK/dV)
+recomputing probabilities from the saved log-sum-exp — O(S) memory.
+The log-sum-exp is also exposed as a differentiable output so ring
+attention (parallel/ring_attention.py) can stream-combine per-shard
+flash results with correct gradients.
+
+``q_offset``/``k_offset`` shift the positions used by the causal mask,
+which is what lets one kernel serve both local attention and one ring
+step (global positions = shard offset + local positions).
+
+``interpret=True`` (automatic off-TPU) runs the same kernels through the
+Pallas interpreter so tests exercise identical code paths on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _block_sizes(Sq, Sk, block_q, block_k):
+    bq = min(block_q, Sq)
+    bk = min(block_k, Sk)
+    if Sq % bq or Sk % bk:
+        raise ValueError(
+            f"flash_attention: seq lens ({Sq}, {Sk}) must be divisible by "
+            f"block sizes ({bq}, {bk}); pad the sequence")
+    return bq, bk
+
+
+def _mask_for(i, j, bq, bk, causal, qo, ko):
+    """Score mask for Q tile i vs K tile j (True = keep); qo/ko are
+    global position offsets (ring-step shards), possibly traced."""
+    if not causal:
+        return None
+    q_pos = qo + i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = ko + j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return q_pos >= k_pos
+
+
+# -- forward ------------------------------------------------------------------
+
+def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc, m_sc, l_sc, *, scale, causal, bq, bk, nk):
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    i = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+
+    m_prev = m_sc[:, 0]
+    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_cur[:, None])
+    if mask is not None:
+        # without this, a fully-masked row (m_cur == _NEG_INF) would get
+        # p == exp(0) == 1 for every masked entry
+        p = jnp.where(mask, p, 0.0)
+    alpha = jnp.exp(m_prev - m_cur)
+    l_cur = l_sc[:, 0] * alpha + jnp.sum(p, axis=-1)
+    v = v_ref[0].astype(jnp.float32)
+    acc[:] = acc[:] * alpha[:, None] + jnp.dot(
+        p, v, preferred_element_type=jnp.float32)
+    m_sc[:, 0] = m_cur
+    l_sc[:, 0] = l_cur
+
+    @pl.when(j == nk - 1)
+    def _():
+        l_row = l_sc[:, 0]
+        valid = l_row > 0.0           # False only for fully-masked rows
+        l_fin = jnp.maximum(l_row, 1e-30)
+        o_ref[0] = jnp.where(valid[:, None], acc[:] / l_fin[:, None],
+                             0.0).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(valid, m_sc[:, 0] + jnp.log(l_fin), _NEG_INF)
+
+
+def _scalar_spec():
+    return pl.BlockSpec((1, 1), lambda b, x, y: (0, 0),
+                        memory_space=pltpu.SMEM)
+
+
+def _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret):
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    nq, nk = Sq // bq, Sk // bk
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, nk=nk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(BH, nq, nk),
+        in_specs=[
+            _scalar_spec(),
+            _scalar_spec(),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qo, ko, q, k, v)
+    return o, lse
+
+
+# -- backward -----------------------------------------------------------------
+
+def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dlse_ref, dq_ref, dq_acc, *, scale, causal,
+                   bq, bk, nk):
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    i = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    dlse = dlse_ref[0]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jnp.exp(s - lse[:, None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)   # fully-masked rows have lse=_NEG_INF
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    # d s from the o path (p*(dp - delta)) and the lse output (p * dlse)
+    ds = p * (dp - delta[:, None] + dlse[:, None]) * scale
+    dq_acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale, causal, bq, bk, nq):
+    i = pl.program_id(2)  # q-block index (inner loop)
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    j = pl.program_id(1)  # k-block index (outer)
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    dlse = dlse_ref[0]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jnp.exp(s - lse[:, None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)   # fully-masked rows have lse=_NEG_INF
+    dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None] + dlse[:, None]) * scale
+    dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, bq, bk, interpret, res, g):
+    q, k, v, qo, ko, o, lse = res
+    do, dlse_in = g
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    nq, nk = Sq // bq, Sk // bk
+
+    do = do.astype(jnp.float32)
+    dlse = (jnp.zeros_like(lse) if dlse_in is None
+            else dlse_in.astype(jnp.float32))
+    delta = jnp.sum(do * o.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk),
+        grid=(BH, nq, nk),
+        in_specs=[
+            _scalar_spec(),
+            _scalar_spec(),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(qo, ko, q, k, v, do, lse, delta, dlse)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq),
+        grid=(BH, nk, nq),
+        in_specs=[
+            _scalar_spec(),
+            _scalar_spec(),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        interpret=interpret,
+    )(qo, ko, q, k, v, do, lse, delta, dlse)
+    return dq, dk, dv, None, None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, qo, ko, scale, causal, bq, bk, interpret):
+    return _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret)
+
+
+def _flash_fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret):
+    o, lse = _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret)
+    return (o, lse), (q, k, v, qo, ko, o, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128, q_offset=0, k_offset=0, return_lse=False,
+                    interpret=None):
+    """Fused multi-head attention: softmax(QK^T * scale) V.
+
+    q: (B, H, Sq, D); k/v: (B, H, Sk, D).  Differentiable (custom VJP).
+    Sequence lengths must be divisible by the (clamped) block sizes.
+    ``q_offset``/``k_offset`` shift the causal-mask positions (may be
+    traced values — used for ring-attention shards).  With
+    ``return_lse`` the per-row log-sum-exp (B, H, Sq) float32 is also
+    returned (differentiable).  Off-TPU the kernels run in the Pallas
+    interpreter unless ``interpret`` is explicitly set.
+    """
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    if scale is None:
+        scale = float(1.0 / np.sqrt(D))
+    if interpret is None:
+        interpret = not _on_tpu()
+    bq, bk = _block_sizes(Sq, Sk, block_q, block_k)
+
+    qf = q.reshape(B * H, Sq, D)
+    kf = k.reshape(B * H, Sk, D)
+    vf = v.reshape(B * H, Sk, D)
+    qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
+    ko = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
+    o, lse = _flash(qf, kf, vf, qo, ko, scale, bool(causal), bq, bk,
+                    bool(interpret))
+    o = o.reshape(B, H, Sq, D)
+    if return_lse:
+        return o, lse.reshape(B, H, Sq)
+    return o

@@ -43,25 +43,27 @@ def _router(x, gate_w, num_experts, k, capacity):
     combine: same shape scaled by gate probabilities.
     """
     T = x.shape[0]
-    logits = x @ gate_w                                   # (T, E)
+    # all routing math in float32: a bf16 cumsum is inexact past 256 and
+    # would silently assign duplicate capacity slots
+    logits = (x.astype(jnp.float32) @ gate_w.astype(jnp.float32))  # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)
 
-    dispatch = jnp.zeros((T, num_experts, capacity), x.dtype)
-    combine = jnp.zeros((T, num_experts, capacity), x.dtype)
+    dispatch = jnp.zeros((T, num_experts, capacity), jnp.float32)
+    combine = jnp.zeros((T, num_experts, capacity), jnp.float32)
     masked = probs
     # occupancy per expert carried across the k routing rounds
     occupancy = jnp.zeros((num_experts,), jnp.int32)
-    frac_routed = jnp.zeros((num_experts,), x.dtype)
+    frac_routed = jnp.zeros((num_experts,), jnp.float32)
     for _ in range(k):
         idx = jnp.argmax(masked, axis=-1)                 # (T,)
         gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
-        onehot = jax.nn.one_hot(idx, num_experts, dtype=x.dtype)  # (T, E)
+        onehot = jax.nn.one_hot(idx, num_experts, dtype=jnp.float32)  # (T, E)
         # position of each token within its expert's buffer this round
         pos = (jnp.cumsum(onehot, axis=0) - 1.0) + occupancy[None, :].astype(
-            x.dtype)
+            jnp.float32)
         pos_int = pos.astype(jnp.int32)
-        keep = (pos_int < capacity).astype(x.dtype) * onehot
-        slot = jax.nn.one_hot(pos_int, capacity, dtype=x.dtype)   # (T, E, C)
+        keep = (pos_int < capacity).astype(jnp.float32) * onehot
+        slot = jax.nn.one_hot(pos_int, capacity, dtype=jnp.float32)  # (T,E,C)
         d = keep[..., None] * slot
         dispatch = dispatch + d
         combine = combine + d * gate[:, None, None]
@@ -72,7 +74,7 @@ def _router(x, gate_w, num_experts, k, capacity):
     # Switch-style load-balancing loss: E * <frac tokens> . <mean prob>
     mean_prob = jnp.mean(probs, axis=0)
     aux_loss = num_experts * jnp.sum((frac_routed / k) * mean_prob)
-    return dispatch, combine, aux_loss
+    return dispatch.astype(x.dtype), combine.astype(x.dtype), aux_loss
 
 
 def _expert_ffn(params_i, h):
@@ -223,21 +225,20 @@ class MoELayer:
         self.last_aux_loss = aux
         return y
 
+    def _make_objective(self, loss_fn, x, aux_weight):
+        def objective(params):
+            y, aux = moe_apply(params, x, self.mesh, self.axis, self.k,
+                               self.capacity_factor)
+            return loss_fn(y) + aux_weight * aux
+
+        return objective
+
     def grad_step(self, x, loss_fn, lr=0.01, aux_weight=0.01):
-        step = self._steps.get(id(loss_fn))
-        if step is None:
-            def step_fn(params, x, lr, aux_weight):
-                def objective(params):
-                    y, aux = moe_apply(params, x, self.mesh, self.axis,
-                                       self.k, self.capacity_factor)
-                    return loss_fn(y) + aux_weight * aux
+        """One SGD step.  ``loss_fn`` must be a stable function object —
+        the jitted update is cached per loss_fn (see
+        trainer.cached_sgd_step)."""
+        from .trainer import cached_sgd_step
 
-                loss, grads = jax.value_and_grad(objective)(params)
-                new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
-                                                    params, grads)
-                return loss, new_params
-
-            step = jax.jit(step_fn)
-            self._steps[id(loss_fn)] = step
+        step = cached_sgd_step(self._steps, loss_fn, self._make_objective)
         loss, self.params = step(self.params, x, lr, aux_weight)
         return loss

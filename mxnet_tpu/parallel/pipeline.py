@@ -121,26 +121,21 @@ class PipelineModule:
         return pipeline_apply(self.stage_fn, self.params, x,
                               self.n_microbatches, self.mesh, self.axis)
 
+    def _make_objective(self, loss_fn, x):
+        def objective(params):
+            out = pipeline_apply(self.stage_fn, params, x,
+                                 self.n_microbatches, self.mesh, self.axis)
+            return loss_fn(out)
+
+        return objective
+
     def grad_step(self, x, loss_fn, lr=0.01):
         """One SGD step through the pipelined computation.
 
         ``loss_fn`` must be a stable function object — the jitted update
         is cached per loss_fn, so a fresh lambda per call recompiles."""
-        step = self._steps.get(id(loss_fn))
-        if step is None:
-            def step_fn(params, x, lr):
-                def objective(params):
-                    out = pipeline_apply(self.stage_fn, params, x,
-                                         self.n_microbatches, self.mesh,
-                                         self.axis)
-                    return loss_fn(out)
+        from .trainer import cached_sgd_step
 
-                loss, grads = jax.value_and_grad(objective)(params)
-                new_params = jax.tree_util.tree_map(
-                    lambda p, g: p - lr * g, params, grads)
-                return loss, new_params
-
-            step = jax.jit(step_fn)
-            self._steps[id(loss_fn)] = step
+        step = cached_sgd_step(self._steps, loss_fn, self._make_objective)
         loss, self.params = step(self.params, x, lr)
         return loss

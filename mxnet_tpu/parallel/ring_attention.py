@@ -82,8 +82,50 @@ def _ring_body(q, k, v, axis_name, n_shards, scale, causal, q_index):
     return o / jnp.maximum(l, 1e-20)[..., None]
 
 
+def _ring_body_flash(q, k, v, axis_name, n_shards, scale, causal, q_index,
+                     block_q, block_k, interpret):
+    """Ring loop where each shard-pair attention block is the fused
+    Pallas flash kernel (ops/flash_attention.py); per-step normalized
+    outputs are stream-combined via their log-sum-exps.  The kernel's
+    causal mask uses global positions = shard_index * S_blk + local, so
+    diagonal / past / future K-V shards all fall out of one kernel."""
+    from ..ops.flash_attention import flash_attention
+
+    B, H, S_blk, D = q.shape
+
+    def step(carry, i):
+        k_cur, v_cur, o_acc, m_acc, l_acc = carry
+        kv_index = (q_index - i) % n_shards
+        o_b, lse_b = flash_attention(
+            q, k_cur, v_cur, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k,
+            q_offset=q_index * S_blk, k_offset=kv_index * S_blk,
+            return_lse=True, interpret=interpret)
+        # streaming logsumexp-weighted combine of normalized outputs;
+        # accumulate in float32 regardless of input dtype (bf16 inputs
+        # would otherwise promote the scan carry and break its type)
+        m_new = jnp.maximum(m_acc, lse_b)
+        c_acc = jnp.where(jnp.isfinite(m_acc), jnp.exp(m_acc - m_new), 0.0)
+        c_b = jnp.exp(lse_b - m_new)
+        o_new = o_acc * c_acc[..., None] + \
+            o_b.astype(jnp.float32) * c_b[..., None]
+        l_new = l_acc * c_acc + c_b
+        perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, o_new, m_new, l_new), None
+
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full((B, H, S_blk), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, S_blk), jnp.float32)
+    (k, v, o, m, l), _ = lax.scan(step, (k, v, o0, m0, l0),
+                                  jnp.arange(n_shards))
+    return (o / jnp.maximum(l, 1e-20)[..., None]).astype(q.dtype)
+
+
 @functools.lru_cache(maxsize=64)
-def _build_ring_run(mesh: Mesh, axis: str, scale: float, causal: bool):
+def _build_ring_run(mesh: Mesh, axis: str, scale: float, causal: bool,
+                    impl: str, block_q: int, block_k: int, interpret: bool):
     """Cached compiled ring-attention program per (mesh, axis, config) —
     jax.jit caches on function identity, so the shard_map must be built
     once per config or every call recompiles."""
@@ -94,7 +136,12 @@ def _build_ring_run(mesh: Mesh, axis: str, scale: float, causal: bool):
     def run(q, k, v):
         def shard_fn(q_s, k_s, v_s):
             idx = lax.axis_index(axis)
-            return _ring_body(q_s, k_s, v_s, axis, n_shards, scale, causal, idx)
+            if impl == "flash":
+                return _ring_body_flash(q_s, k_s, v_s, axis, n_shards, scale,
+                                        causal, idx, block_q, block_k,
+                                        interpret)
+            return _ring_body(q_s, k_s, v_s, axis, n_shards, scale, causal,
+                              idx)
 
         return shard_map(
             shard_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -103,15 +150,31 @@ def _build_ring_run(mesh: Mesh, axis: str, scale: float, causal: bool):
     return run
 
 
-def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False):
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
+                   impl="auto", block_q=128, block_k=128):
     """Sharded multi-head attention over a sequence-parallel mesh axis.
 
     q/k/v: (batch, heads, seq, head_dim), sharded over ``axis`` on the
     seq dimension (replicated arrays are accepted and sharded here).
     Returns the attention output with the same sharding.
+
+    impl: "flash" runs each shard-pair block through the fused Pallas
+    kernel; "xla" uses the jnp blockwise body; "auto" picks flash on
+    TPU (when the shard length divides the kernel block sizes) and xla
+    elsewhere.
     """
+    from ..ops.flash_attention import _on_tpu
+
     scale = float(1.0 / np.sqrt(q.shape[-1]))
-    run = _build_ring_run(mesh, axis, scale, bool(causal))
+    n_shards = mesh.shape[axis]
+    S_blk = q.shape[2] // n_shards
+    interpret = not _on_tpu()
+    if impl == "auto":
+        fits = (S_blk % min(block_q, S_blk) == 0
+                and S_blk % min(block_k, S_blk) == 0)
+        impl = "flash" if (not interpret and fits) else "xla"
+    run = _build_ring_run(mesh, axis, scale, bool(causal), impl,
+                          block_q, block_k, interpret)
 
     if not isinstance(q, jax.core.Tracer):
         sharding = NamedSharding(mesh, PartitionSpec(None, None, axis, None))

@@ -153,3 +153,145 @@ def test_moe_layer_trains():
     for _ in range(30):
         l = float(layer.grad_step(x, loss_fn, lr=0.05))
     assert l < l0
+
+
+# -- Pallas flash attention -------------------------------------------------
+def test_flash_attention_matches_dense():
+    from mxnet_tpu.ops.flash_attention import flash_attention
+
+    rng2 = np.random.RandomState(7)
+    B, H, S, D = 2, 3, 32, 16
+    q, k, v = (jnp.asarray(rng2.standard_normal((B, H, S, D))
+                           .astype(np.float32)) for _ in range(3))
+    for causal in (False, True):
+        o = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+        o_ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_flash_attention_grads():
+    from mxnet_tpu.ops.flash_attention import flash_attention
+
+    rng2 = np.random.RandomState(8)
+    B, H, S, D = 1, 2, 32, 8
+    q, k, v = (jnp.asarray(rng2.standard_normal((B, H, S, D))
+                           .astype(np.float32)) for _ in range(3))
+
+    for causal in (False, True):
+        def f(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                           block_q=16, block_k=16) ** 2)
+
+        def fr(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+        g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(fr, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_lse_and_offsets():
+    from mxnet_tpu.ops.flash_attention import flash_attention
+
+    rng2 = np.random.RandomState(9)
+    B, H, S, D = 1, 2, 32, 8
+    q, k, v = (jnp.asarray(rng2.standard_normal((B, H, S, D))
+                           .astype(np.float32)) for _ in range(3))
+    scale = 1.0 / np.sqrt(D)
+    _, lse = flash_attention(q, k, v, block_q=16, block_k=16,
+                             return_lse=True)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    lse_ref = jax.scipy.special.logsumexp(s, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_ref),
+                               rtol=1e-5, atol=1e-5)
+    # causal-mask offsets: lower-half rows of the full attention
+    o_full = attention_reference(q, k, v, causal=True)
+    o_hi = flash_attention(q[:, :, 16:], k, v, causal=True, q_offset=16,
+                           block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(o_hi),
+                               np.asarray(o_full[:, :, 16:]),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_flash_impl(causal):
+    rng2 = np.random.RandomState(10)
+    mesh = mx.parallel.make_mesh({"sp": 4})
+    B, H, S, D = 2, 2, 64, 8
+    q, k, v = (jnp.asarray(rng2.standard_normal((B, H, S, D))
+                           .astype(np.float32)) for _ in range(3))
+    o = ring_attention(q, k, v, mesh, "sp", causal=causal, impl="flash",
+                       block_q=16, block_k=16)
+    o_ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                               rtol=1e-5, atol=2e-5)
+
+
+def test_ring_attention_flash_grads():
+    rng2 = np.random.RandomState(11)
+    mesh = mx.parallel.make_mesh({"sp": 2})
+    B, H, S, D = 1, 2, 32, 8
+    q, k, v = (jnp.asarray(rng2.standard_normal((B, H, S, D))
+                           .astype(np.float32)) for _ in range(3))
+
+    def f(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, "sp", causal=True,
+                                      impl="flash", block_q=16,
+                                      block_k=16) ** 2)
+
+    def fr(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(fr, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_attention_fully_masked_rows():
+    """A query block entirely before the key block must return zeros and
+    lse == -inf-like, not the uniform mean of V."""
+    from mxnet_tpu.ops.flash_attention import flash_attention
+
+    rng2 = np.random.RandomState(12)
+    B, H, S, D = 1, 1, 16, 8
+    q, k, v = (jnp.asarray(rng2.standard_normal((B, H, S, D))
+                           .astype(np.float32)) for _ in range(3))
+    o, lse = flash_attention(q, k, v, causal=True, k_offset=S,
+                             block_q=16, block_k=16, return_lse=True)
+    assert float(jnp.max(jnp.abs(o))) == 0.0
+    assert float(jnp.max(lse)) < -1e29
+
+
+def test_ring_attention_flash_bf16():
+    rng2 = np.random.RandomState(13)
+    mesh = mx.parallel.make_mesh({"sp": 4})
+    B, H, S, D = 1, 2, 64, 8
+    qf, kf, vf = (rng2.standard_normal((B, H, S, D)).astype(np.float32)
+                  for _ in range(3))
+    q, k, v = (jnp.asarray(a, jnp.bfloat16) for a in (qf, kf, vf))
+    o = ring_attention(q, k, v, mesh, "sp", causal=True, impl="flash",
+                       block_q=16, block_k=16)
+    assert o.dtype == jnp.bfloat16
+    o_ref = attention_reference(jnp.asarray(qf), jnp.asarray(kf),
+                                jnp.asarray(vf), causal=True)
+    np.testing.assert_allclose(np.asarray(o, np.float32), np.asarray(o_ref),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_moe_router_bf16_slot_uniqueness():
+    """bf16 tokens must not produce duplicate capacity slots (cumsum in
+    bf16 is inexact past 256)."""
+    from mxnet_tpu.parallel.moe import _router
+
+    rng2 = np.random.RandomState(14)
+    T, D, E = 320, 8, 2
+    x = jnp.asarray(rng2.standard_normal((T, D)), jnp.bfloat16)
+    gate_w = jnp.asarray(rng2.standard_normal((D, E)), jnp.bfloat16)
+    dispatch, combine, _ = _router(x, gate_w, E, 1, T)
+    occupancy = np.asarray(jnp.sum(dispatch.astype(jnp.float32), axis=0))
+    assert occupancy.max() <= 1.0 + 1e-6, "duplicate capacity slot"
