@@ -11,11 +11,12 @@ backend"):
   XLA device-to-device copies, standing in for CommDevice's CUDA P2P
   (comm.h:186-346).
 - ``dist_*`` types replace the ps-lite parameter server with JAX
-  multihost collectives over ICI/DCN; rank/size/barrier map to
-  process_index/process_count/sync_global_devices.  The reference's
-  server-side-optimizer mode has no ICI analog: ``set_optimizer`` keeps
-  the API but always runs the updater worker-side (documented deviation,
-  SURVEY.md §7 hard part (e)).
+  multihost collectives over ICI/DCN (DistKVStore: rank/size/barrier map
+  to process_index/process_count/sync_global_devices), or — when the
+  launcher spawns server shards (``tools/launch.py -s N``) — with the
+  host-side parameter server in mxnet_tpu/ps.py (DistPSKVStore), which
+  restores true ``dist_async`` race semantics and the server-side
+  optimizer (pickled to servers, reference kvstore.py:231-256).
 
 API shape (init/push/pull with int or str keys, pluggable updater,
 priority hints) matches python/mxnet/kvstore.py so Module/FeedForward
@@ -216,10 +217,10 @@ class DistKVStore(KVStore):
 
     Each host pushes its locally-reduced gradient; cross-host aggregation
     is an all-reduce over DCN/ICI via multihost allgather+sum.  Sync mode
-    is inherent (collectives are synchronous across processes); the
-    reference's ``dist_async`` server-race semantics cannot be reproduced
-    without a parameter-server tier, so async falls back to sync
-    (documented deviation).
+    is inherent (collectives are synchronous across processes); true
+    ``dist_async`` server-race semantics need the parameter-server tier
+    (DistPSKVStore, selected when the launcher spawns servers with
+    ``-s N``) — without servers async degrades to sync here.
     """
 
     def __init__(self, kind):
@@ -260,13 +261,96 @@ class DistKVStore(KVStore):
             multihost_utils.sync_global_devices("kvstore_barrier")
 
 
+class DistPSKVStore(KVStore):
+    """Parameter-server-backed distributed store (true ``dist_async``).
+
+    Used when the launcher started server shards (``tools/launch.py -s N``
+    sets ``MXTPU_PS_ADDRS``).  Reproduces the reference kvstore_dist
+    contract over the host-side PS in mxnet_tpu/ps.py: pushes of
+    locally-reduced gradients run the server-side updater — immediately
+    in async mode (worker updates race), or merged across exactly
+    ``num_workers`` requests in sync mode; ``set_optimizer`` pickles the
+    optimizer to every server shard (reference kvstore.py:231-256); big
+    arrays stripe across shards (EncodeKey analog)."""
+
+    def __init__(self, kind, addrs):
+        import os
+
+        from .ps import ShardedPSClient
+
+        super().__init__(kind)
+        self._client = ShardedPSClient(addrs.split(","))
+        self._rank = int(os.environ.get("MXTPU_PROC_ID", "0"))
+        self._nproc = int(os.environ.get("MXTPU_NUM_PROCS", "1"))
+        # per-push sync flag (reference sends a server-global kSyncMode
+        # command, kvstore.cc:29-38; per-push is strictly safer when two
+        # stores share the same servers)
+        self._sync = "async" not in kind
+        self._meta = {}          # key -> (shape, dtype)
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._nproc
+
+    def init(self, key, value):
+        for k, vs in self._normalize(key, value):
+            if k in self._meta:
+                raise MXNetError(f"key {k!r} already initialized")
+            arr = vs[0].asnumpy()
+            self._meta[k] = (arr.shape, arr.dtype)
+            if self._rank == 0:
+                self._client.init(k, arr)
+        self.barrier()
+
+    def push(self, key, value, priority=0):
+        for k, vs in self._normalize(key, value):
+            if k not in self._meta:
+                raise MXNetError(f"key {k!r} not initialized")
+            reduced = self._comm.reduce(vs)
+            self._client.push(k, reduced.asnumpy(), sync=self._sync)
+
+    def pull(self, key, out=None, priority=0):
+        for k, outs in self._normalize(key, out):
+            if k not in self._meta:
+                raise MXNetError(f"key {k!r} not initialized")
+            shape, dtype = self._meta[k]
+            arr = self._client.pull(k, shape, dtype)
+            src = NDArray(jnp.asarray(arr), outs[0].context)
+            self._comm.broadcast(src, outs)
+
+    def set_optimizer(self, optimizer):
+        """Pickle the optimizer to every server shard — the reference's
+        server-side-optimizer capability, restored."""
+        self._optimizer = optimizer
+        if self._rank == 0:
+            self._client.command("set_optimizer", pickle.dumps(optimizer))
+        self.barrier()
+
+    def barrier(self):
+        self._client.barrier()
+
+    def send_command_to_servers(self, head, body):
+        self._client.command(head, body)
+
+
 def create(name="local") -> KVStore:
     """Factory (reference src/kvstore/kvstore.cc:17-45): local /
     local_allreduce_cpu / *device* / dist_sync / dist_async /
-    dist_sync_device / dist_async_device."""
+    dist_sync_device / dist_async_device.  ``dist_*`` uses the
+    parameter-server transport when the launcher provided server shards
+    (MXTPU_PS_ADDRS); otherwise collectives-backed sync."""
+    import os
+
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     if name.startswith("dist"):
+        addrs = os.environ.get("MXTPU_PS_ADDRS")
+        if addrs:
+            return DistPSKVStore(name, addrs)
         return DistKVStore(name)
     if name in ("local", "local_allreduce_cpu", "local_update_cpu") or "device" in name:
         return KVStore(name)

@@ -61,6 +61,21 @@ class Optimizer:
                 if "__wd_mult__" in a:
                     self.wd_mult[name] = float(a["__wd_mult__"])
 
+    # -- pickling ----------------------------------------------------------
+    # Optimizers are pickled to dist-kvstore servers (reference
+    # kvstore.py:231-256) and into checkpoint states; jitted step
+    # kernels are not picklable, so they are dropped and rebuilt.
+    def _build_steps(self):
+        """Recreate jitted update kernels; overridden by subclasses."""
+
+    def __getstate__(self):
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_step")}
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._build_steps()
+
     # -- state -------------------------------------------------------------
     def create_state(self, index, weight):
         return None
@@ -119,7 +134,9 @@ class SGD(Optimizer):
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
+        self._build_steps()
 
+    def _build_steps(self):
         def step(w, g, m, lr, wd):
             g = self._preprocess(g) + wd * w
             m_new = self.momentum * m - lr * g
@@ -164,7 +181,9 @@ class NAG(Optimizer):
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
+        self._build_steps()
 
+    def _build_steps(self):
         def step(w, g, m, lr, wd):
             g = self._preprocess(g) + wd * w
             m_new = self.momentum * m + g
@@ -191,7 +210,9 @@ class SGLD(Optimizer):
 
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
+        self._build_steps()
 
+    def _build_steps(self):
         def step(w, g, lr, wd, key):
             g = self._preprocess(g) + wd * w
             noise = jax.random.normal(key, w.shape, jnp.float32) * jnp.sqrt(lr)
@@ -216,7 +237,9 @@ class Adam(Optimizer):
                  epsilon=1e-8, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self._build_steps()
 
+    def _build_steps(self):
         def step(w, g, mv, lr_t, wd):
             m, v = mv
             g = self._preprocess(g) + wd * w
@@ -256,7 +279,9 @@ class AdaGrad(Optimizer):
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
+        self._build_steps()
 
+    def _build_steps(self):
         def step(w, g, h, lr, wd):
             g = self._preprocess(g)
             h_new = h + jnp.square(g)
@@ -286,7 +311,9 @@ class RMSProp(Optimizer):
                  epsilon=1e-4, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.gamma1, self.gamma2, self.epsilon = gamma1, gamma2, epsilon
+        self._build_steps()
 
+    def _build_steps(self):
         def step(w, g, state, lr, wd):
             n, gavg, delta = state
             g = self._preprocess(g) + wd * w
@@ -324,7 +351,9 @@ class AdaDelta(Optimizer):
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
         self.rho, self.epsilon = rho, epsilon
+        self._build_steps()
 
+    def _build_steps(self):
         def step(w, g, state, wd):
             acc_g, acc_delta = state
             g = self._preprocess(g)

@@ -1,0 +1,345 @@
+"""Host-side parameter server: the TPU-native stand-in for ps-lite.
+
+The collectives-backed ``dist_sync`` path (kvstore.py DistKVStore) is
+the fast lane for synchronous data parallelism, but it cannot express
+the reference's ``dist_async`` semantics — workers racing updates into
+shared state through a server-side optimizer (kvstore_dist_server.h:
+136-190: async pushes run the updater immediately; sync mode merges
+exactly NumWorkers requests before replying — and kvstore.py:231-256:
+the optimizer is pickled to the servers).  This module restores that
+capability with a small threaded TCP server (pickle-framed messages
+standing in for ps-lite's ZMQ transport):
+
+- ``PSServer``: key -> ndarray store; per-key sync merge with
+  request-counting barrier, or immediate async updates (sync/async is
+  carried per push, so different stores can share servers); runs a
+  frontend-supplied updater (unpickled optimizer via ``set_optimizer``
+  command, reference kSetOptimizer); worker barrier; clean stop
+  (reference kStopServer).
+- ``PSClient``: blocking request/response connection per worker.
+- Key sharding: with multiple servers, keys hash to a server and big
+  arrays are striped evenly across all servers (reference EncodeKey
+  big-array striping, kvstore_dist.h:260-298).
+
+Server processes are spawned by ``tools/launch.py -s N`` (reference
+tracker starting scheduler+servers) or ``python -m mxnet_tpu.ps``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import zlib
+
+import numpy as np
+
+__all__ = ["PSServer", "PSClient", "ShardedPSClient", "BIGARRAY_BOUND"]
+
+# reference MXNET_KVSTORE_BIGARRAY_BOUND default (kvstore_dist.h)
+BIGARRAY_BOUND = int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 10 ** 6))
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("!I", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack("!I", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class PSServer:
+    """Single parameter-server shard (one reference server node).
+
+    Thread-per-connection; state guarded by a lock with per-key
+    condition variables for sync-mode merge barriers.
+    """
+
+    def __init__(self, num_workers, port=0, host="127.0.0.1"):
+        self.num_workers = num_workers
+        self.store = {}
+        self.updater = None
+        self._merge = {}        # key -> (accumulated array, count)
+        self._gen = {}          # key -> completed sync-round counter
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._stop = threading.Event()
+        self._sock = socket.create_server((host, port))
+        self._sock.settimeout(0.2)
+        self.addr = f"{host}:{self._sock.getsockname()[1]}"
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._threads = []
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._sock.close()
+
+    # -- request handlers ---------------------------------------------------
+    def _handle_push(self, key, value, sync):
+        """Sync/async is carried per push (per-kvstore, not server-global:
+        a server-global flag would let one store's creation silently flip
+        the semantics of another live store on the same servers)."""
+        with self._cond:
+            if sync:
+                acc, count = self._merge.get(key, (None, 0))
+                acc = value.copy() if acc is None else acc + value
+                count += 1
+                if count < self.num_workers:
+                    self._merge[key] = (acc, count)
+                    gen = self._gen.get(key, 0)
+                    # block this worker's push until the round completes
+                    # (reference: server replies after NumWorkers merged)
+                    while (self._gen.get(key, 0) == gen
+                           and not self._stop.is_set()):
+                        self._cond.wait(timeout=0.2)
+                    return
+                # last pusher applies the merged update and releases peers
+                self._apply(key, acc)
+                self._merge[key] = (None, 0)
+                self._gen[key] = self._gen.get(key, 0) + 1
+                self._cond.notify_all()
+            else:
+                # async: apply immediately — worker updates race, exactly
+                # the reference dist_async contract
+                self._apply(key, value)
+
+    def _apply(self, key, recved):
+        if key not in self.store:
+            self.store[key] = recved.copy()
+        elif self.updater is not None:
+            # the unpickled optimizer updater works on NDArrays
+            from . import ndarray as nd
+
+            w = nd.array(self.store[key])
+            self.updater(key, nd.array(recved), w)
+            self.store[key] = w.asnumpy()
+        else:
+            self.store[key][...] = recved
+
+    def _handle(self, msg):
+        op = msg[0]
+        if op == "init":
+            _, key, value = msg
+            with self._lock:
+                self.store[key] = np.array(value)
+            return ("ok",)
+        if op == "push":
+            _, key, value, sync = msg
+            self._handle_push(key, np.asarray(value), sync)
+            return ("ok",)
+        if op == "pull":
+            with self._lock:
+                val = self.store.get(msg[1])
+            if val is None:
+                return ("err", f"key {msg[1]!r} not initialized")
+            return ("ok", val)
+        if op == "barrier":
+            with self._cond:
+                self._barrier_count += 1
+                gen = self._barrier_gen
+                if self._barrier_count == self.num_workers:
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._cond.notify_all()
+                else:
+                    while (self._barrier_gen == gen
+                           and not self._stop.is_set()):
+                        self._cond.wait(timeout=0.2)
+            return ("ok",)
+        if op == "command":
+            _, head, body = msg
+            if head == "set_optimizer":
+                from .optimizer import get_updater
+
+                optimizer = pickle.loads(body)
+                with self._lock:
+                    self.updater = get_updater(optimizer)
+            elif head == "stop":
+                self._stop.set()
+                with self._cond:
+                    self._cond.notify_all()
+            return ("ok",)
+        return ("err", f"unknown op {op!r}")
+
+    def _serve(self, conn):
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    msg = _recv_msg(conn)
+                except OSError:
+                    break
+                if msg is None:
+                    break
+                try:
+                    reply = self._handle(msg)
+                except Exception as e:  # surface server errors to the worker
+                    reply = ("err", repr(e))
+                try:
+                    _send_msg(conn, reply)
+                except OSError:
+                    break
+
+    def stop(self):
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+
+    def join(self, timeout=None):
+        self._thread.join(timeout)
+
+
+class PSClient:
+    """One worker's connection to one server shard."""
+
+    def __init__(self, addr):
+        host, port = addr.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)))
+        self._lock = threading.Lock()
+
+    def request(self, *msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            reply = _recv_msg(self._sock)
+        if reply is None:
+            raise ConnectionError("parameter server closed connection")
+        if reply[0] == "err":
+            raise RuntimeError(f"parameter server error: {reply[1]}")
+        return reply[1] if len(reply) > 1 else None
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ShardedPSClient:
+    """Key-sharded view over all server shards (reference EncodeKey,
+    kvstore_dist.h:260-298): small arrays live on hash(key) % n_servers;
+    arrays over BIGARRAY_BOUND elements are striped evenly across all
+    servers so no shard holds the whole tensor."""
+
+    def __init__(self, addrs):
+        self.clients = [PSClient(a) for a in addrs]
+
+    def _shard(self, key):
+        # stable across processes — builtin hash() is randomized per
+        # process for str keys, which would send each worker's requests
+        # for the same key to different shards
+        h = zlib.crc32(str(key).encode())
+        return self.clients[h % len(self.clients)]
+
+    def _stripes(self, key, size):
+        n = len(self.clients)
+        if n == 1 or size < BIGARRAY_BOUND:
+            return None
+        bounds = [size * i // n for i in range(n + 1)]
+        return [(f"{key}#stripe{i}", bounds[i], bounds[i + 1])
+                for i in range(n)]
+
+    def init(self, key, value):
+        value = np.asarray(value)
+        stripes = self._stripes(key, value.size)
+        if stripes is None:
+            self._shard(key).request("init", key, value)
+            return
+        flat = value.reshape(-1)
+        for c, (skey, lo, hi) in zip(self.clients, stripes):
+            c.request("init", skey, flat[lo:hi])
+
+    def push(self, key, value, sync=False):
+        value = np.asarray(value)
+        stripes = self._stripes(key, value.size)
+        if stripes is None:
+            self._shard(key).request("push", key, value, sync)
+            return
+        flat = value.reshape(-1)
+        for c, (skey, lo, hi) in zip(self.clients, stripes):
+            c.request("push", skey, flat[lo:hi], sync)
+
+    def pull(self, key, shape, dtype):
+        size = int(np.prod(shape)) if shape else 1
+        stripes = self._stripes(key, size)
+        if stripes is None:
+            return np.asarray(self._shard(key).request("pull", key)
+                              ).reshape(shape).astype(dtype, copy=False)
+        parts = [np.asarray(c.request("pull", skey))
+                 for c, (skey, _, _) in zip(self.clients, stripes)]
+        return np.concatenate(parts).reshape(shape).astype(dtype, copy=False)
+
+    def barrier(self):
+        for c in self.clients:
+            c.request("barrier")
+
+    def command(self, head, body):
+        for c in self.clients:
+            c.request("command", head, body)
+
+    def close(self):
+        for c in self.clients:
+            c.close()
+
+
+def main(argv=None):
+    """Server-process entry: ``python -m mxnet_tpu.ps --workers N``.
+
+    Prints ``PS_ADDR <host:port>`` on stdout for the launcher, serves
+    until a stop command arrives."""
+    import argparse
+
+    # the server's updater math is host-side: pin jax to CPU before any
+    # backend initialization (env vars alone do not override accelerator
+    # plugins; the config update is authoritative)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--workers", type=int, required=True)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--host", default="127.0.0.1")
+    args = parser.parse_args(argv)
+
+    server = PSServer(args.workers, port=args.port, host=args.host).start()
+    print(f"PS_ADDR {server.addr}", flush=True)
+    try:
+        while not server._stop.wait(timeout=0.5):
+            pass
+    except KeyboardInterrupt:
+        server.stop()
+    server.join(timeout=5)
+
+
+if __name__ == "__main__":
+    main()

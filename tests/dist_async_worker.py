@@ -1,0 +1,60 @@
+"""Worker script for the PS-backed dist_async kvstore test: two workers
+push gradients into a server-side SGD optimizer (the reference's
+pickled-updater-at-server capability, kvstore_dist_server.h) and verify
+the additive result is exact regardless of push order.
+
+Launched by test_ps.py via tools/launch.py -n 2 -s 1.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    assert "MXTPU_PS_ADDRS" in os.environ, "launcher did not start servers"
+    kv = mx.kv.create("dist_async")
+    rank, nworker = kv.rank, kv.num_workers
+    assert kv.type == "dist_async"
+
+    shape = (4, 3)
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0,
+                                      rescale_grad=1.0))
+    kv.init("w", mx.nd.zeros(shape))
+
+    # each rank pushes (rank + 1); server applies w -= lr * grad per push
+    kv.push("w", mx.nd.ones(shape) * (rank + 1))
+    kv.barrier()   # both pushes applied before anyone pulls
+
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    expect = -float(sum(r + 1 for r in range(nworker)))
+    got = out.asnumpy()
+    assert np.allclose(got, expect), (rank, got[0, 0], expect)
+
+    # sync-mode sibling through the same PS: merged exactly once
+    kv2 = mx.kv.create("dist_sync")
+    kv2.init("s", mx.nd.zeros(shape))
+    kv2.push("s", mx.nd.ones(shape) * (rank + 1))
+    out2 = mx.nd.zeros(shape)
+    kv2.pull("s", out=out2)
+    # the server-side updater is server-global (one updater per server,
+    # reference kvstore_dist_server.h): SGD applies to the merged sum once
+    expect2 = -float(sum(r + 1 for r in range(nworker)))
+    assert np.allclose(out2.asnumpy(), expect2), (rank, out2.asnumpy()[0, 0])
+
+    print(f"RANK_{rank}_PS_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

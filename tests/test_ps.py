@@ -1,0 +1,153 @@
+"""Parameter-server transport (mxnet_tpu/ps.py): async race semantics,
+sync merge counting, server-side optimizer, big-array striping — the
+rebuild of the reference's ps-lite kvstore_dist_server behavior
+(kvstore_dist_server.h:136-190, kvstore_dist.h:260-298)."""
+
+import pickle
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ps import BIGARRAY_BOUND, PSClient, PSServer, ShardedPSClient
+
+
+def _start(num_workers, n_servers=1):
+    servers = [PSServer(num_workers).start() for _ in range(n_servers)]
+    client_of = lambda: ShardedPSClient([s.addr for s in servers])
+    return servers, client_of
+
+
+def _stop(servers, clients):
+    for c in clients:
+        c.close()
+    for s in servers:
+        s.stop()
+
+
+def test_ps_async_push_pull():
+    servers, mk = _start(num_workers=2)
+    c1, c2 = mk(), mk()
+    try:
+        c1.init("w", np.zeros(4, np.float32))
+        # async (default): each push applies immediately; no updater means
+        # assignment, so last writer wins
+        c1.push("w", np.full(4, 1.0, np.float32))
+        c2.push("w", np.full(4, 2.0, np.float32))
+        got = c1.pull("w", (4,), np.float32)
+        assert got.tolist() == [2.0] * 4
+    finally:
+        _stop(servers, [c1, c2])
+
+
+def test_ps_server_side_optimizer_async_race():
+    """With a server-side SGD updater, racing pushes both apply — the
+    additive update makes the result order-independent and exact."""
+    servers, mk = _start(num_workers=2)
+    c1, c2 = mk(), mk()
+    try:
+        opt = mx.optimizer.SGD(learning_rate=0.5)
+        c1.command("set_optimizer", pickle.dumps(opt))
+        c1.init("w", np.zeros(3, np.float32))
+        c1.push("w", np.full(3, 1.0, np.float32))   # w -= 0.5 * 1
+        c2.push("w", np.full(3, 3.0, np.float32))   # w -= 0.5 * 3
+        got = c1.pull("w", (3,), np.float32)
+        np.testing.assert_allclose(got, np.full(3, -2.0))
+    finally:
+        _stop(servers, [c1, c2])
+
+
+def test_ps_sync_merges_num_workers_pushes():
+    """Sync mode: a push only returns once num_workers pushes merged;
+    the merged sum is applied once (reference request counting)."""
+    servers, mk = _start(num_workers=2)
+    c1, c2 = mk(), mk()
+    try:
+        c1.init("w", np.zeros(2, np.float32))
+        results = {}
+
+        def worker(name, client, val):
+            client.push("w", np.full(2, val, np.float32), sync=True)
+            results[name] = True
+
+        t1 = threading.Thread(target=worker, args=("a", c1, 1.0))
+        t1.start()
+        # c1's push must block until c2 contributes
+        t1.join(timeout=0.5)
+        assert "a" not in results, "sync push returned before merge"
+        worker("b", c2, 5.0)
+        t1.join(timeout=10)
+        assert results == {"a": True, "b": True}
+        got = c1.pull("w", (2,), np.float32)
+        assert got.tolist() == [6.0, 6.0]   # assigned merged sum, once
+    finally:
+        _stop(servers, [c1, c2])
+
+
+def test_ps_big_array_striping():
+    """Arrays over BIGARRAY_BOUND stripe across all server shards."""
+    servers, mk = _start(num_workers=1, n_servers=2)
+    c = mk()
+    try:
+        n = BIGARRAY_BOUND + 17
+        big = np.arange(n, dtype=np.float32)
+        c.init("big", big)
+        got = c.pull("big", (n,), np.float32)
+        np.testing.assert_array_equal(got, big)
+        # each shard holds only its stripe, not the whole tensor
+        sizes = [sum(v.size for k, v in s.store.items()) for s in servers]
+        assert all(0 < sz < n for sz in sizes) and sum(sizes) == n
+        c.push("big", big)
+        got = c.pull("big", (n,), np.float32)
+        np.testing.assert_array_equal(got, big)
+    finally:
+        _stop(servers, [c])
+
+
+def test_ps_barrier_and_errors():
+    servers, mk = _start(num_workers=2)
+    c1, c2 = mk(), mk()
+    try:
+        done = []
+
+        def b(client):
+            client.barrier()
+            done.append(1)
+
+        t = threading.Thread(target=b, args=(c1,))
+        t.start()
+        t.join(timeout=0.4)
+        assert not done, "barrier released early"
+        b(c2)
+        t.join(timeout=10)
+        assert len(done) == 2
+        with pytest.raises(RuntimeError):
+            c1.pull("nope", (1,), np.float32)
+    finally:
+        _stop(servers, [c1, c2])
+
+
+@pytest.mark.parametrize("n_servers", [1, 2])
+def test_dist_async_kvstore_via_launcher(n_servers):
+    """End-to-end: tools/launch.py -s N -n 2 with kv.create('dist_async');
+    server-side optimizer applies both workers' pushes.  The 2-server
+    case exercises cross-process key->shard stability (crc32, not the
+    per-process-randomized builtin hash)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("MXTPU_COORDINATOR", None)
+    env.pop("MXTPU_PS_ADDRS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"),
+         "-n", "2", "-s", str(n_servers), "--",
+         sys.executable, os.path.join(repo, "tests", "dist_async_worker.py")],
+        capture_output=True, text=True, timeout=280, env=env)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    assert "RANK_0_PS_OK" in out
+    assert "RANK_1_PS_OK" in out

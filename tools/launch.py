@@ -44,20 +44,62 @@ def _child_env(coordinator, n, rank, extra=None):
     return env
 
 
-def launch_local(n, command, extra_env=None):
+def _drain(stream):
+    """Discard a child's stdout after the handshake so later prints (e.g.
+    logging from an unpickled server-side optimizer) cannot fill the pipe
+    and block the server mid-request."""
+    import threading
+
+    def run():
+        for _ in stream:
+            pass
+
+    threading.Thread(target=run, daemon=True).start()
+
+
+def _spawn_servers(num_servers, num_workers):
+    """Start parameter-server shard processes (reference tracker starting
+    server nodes); returns (procs, comma-joined addr list)."""
+    procs, addrs = [], []
+    try:
+        for _ in range(num_servers):
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "mxnet_tpu.ps",
+                 "--workers", str(num_workers)],
+                stdout=subprocess.PIPE, text=True)
+            procs.append(proc)
+            line = proc.stdout.readline().strip()
+            if not line.startswith("PS_ADDR "):
+                raise RuntimeError(
+                    f"parameter server failed to start: {line!r}")
+            addrs.append(line.split(" ", 1)[1])
+            _drain(proc.stdout)
+        return procs, ",".join(addrs)
+    except Exception:
+        for p in procs:
+            p.kill()
+        raise
+
+
+def launch_local(n, command, extra_env=None, num_servers=0):
     """Spawn n local processes with distinct ranks; returns exit code."""
     coordinator = f"127.0.0.1:{_free_port()}"
     procs = []
+    server_procs = []
+    extra = dict(extra_env or {})
     try:
+        if num_servers:
+            server_procs, addrs = _spawn_servers(num_servers, n)
+            extra["MXTPU_PS_ADDRS"] = addrs
         for rank in range(n):
             procs.append(subprocess.Popen(
-                command, env=_child_env(coordinator, n, rank, extra_env)))
+                command, env=_child_env(coordinator, n, rank, extra)))
         code = 0
         for p in procs:
             code = p.wait() or code
         return code
     finally:
-        for p in procs:
+        for p in procs + server_procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
 
@@ -88,6 +130,9 @@ def launch_ssh(hostfile, command, sync_dir=None, username=None):
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("-n", "--num-workers", type=int, default=1)
+    p.add_argument("-s", "--num-servers", type=int, default=0,
+                   help="parameter-server shards for dist_async/dist_sync "
+                        "PS mode (reference dmlc tracker -s)")
     p.add_argument("-H", "--hostfile", default=None,
                    help="one host per line; enables ssh mode")
     p.add_argument("--launcher", choices=["local", "ssh"], default=None)
@@ -104,7 +149,8 @@ def main(argv=None):
         if not args.hostfile:
             p.error("ssh mode needs -H hostfile")
         return launch_ssh(args.hostfile, command, args.sync_dir, args.username)
-    return launch_local(args.num_workers, command)
+    return launch_local(args.num_workers, command,
+                        num_servers=args.num_servers)
 
 
 if __name__ == "__main__":
