@@ -330,6 +330,22 @@ class DistPSKVStore(KVStore):
             self._client.command("set_optimizer", pickle.dumps(optimizer))
         self.barrier()
 
+    def save_optimizer_states(self, fname):
+        """Optimizer states live on the servers in PS mode — fetch and
+        merge them across shards for checkpointing."""
+        if self._optimizer is None:
+            raise MXNetError("optimizer not initialized")
+        with open(fname, "wb") as f:
+            f.write(pickle.dumps(self._client.get_states()))
+
+    def load_optimizer_states(self, fname):
+        if self._optimizer is None:
+            raise MXNetError("optimizer not initialized")
+        if self._rank == 0:
+            with open(fname, "rb") as f:
+                self._client.set_states(pickle.loads(f.read()))
+        self.barrier()
+
     def barrier(self):
         self._client.barrier()
 

@@ -183,6 +183,29 @@ class Loss(EvalMetric):
             self.num_inst += pred.size
 
 
+@METRIC_REGISTRY.register("torch")
+class Torch(Loss):
+    """Mean of external-framework criterion outputs (reference metric.py
+    Torch/Caffe: both average the plugin loss op's raw outputs — e.g.
+    losses produced through the torch bridge)."""
+
+    def __init__(self, name="torch"):
+        super().__init__()
+        self.name = name
+
+    def update(self, labels, preds):
+        for pred in preds:
+            pred = _as_np(pred)
+            self.sum_metric += float(pred.mean())
+            self.num_inst += 1
+
+
+@METRIC_REGISTRY.register("caffe")
+class Caffe(Torch):
+    def __init__(self):
+        super().__init__(name="caffe")
+
+
 class CompositeEvalMetric(EvalMetric):
     def __init__(self, metrics=None, **kwargs):
         super().__init__("composite")

@@ -6,6 +6,9 @@ from .mlp import get_symbol as mlp
 from .resnet import get_symbol as resnet
 from .lstm import lstm_unroll, lstm_cell, LSTMState, LSTMParam
 from .ssd import get_symbol as ssd
+from .inception import inception_bn, inception_bn_small
+from .vgg import vgg, alexnet
 
 __all__ = ["lenet", "mlp", "resnet", "lstm_unroll", "lstm_cell",
-           "LSTMState", "LSTMParam", "ssd"]
+           "LSTMState", "LSTMParam", "ssd",
+           "inception_bn", "inception_bn_small", "vgg", "alexnet"]

@@ -41,6 +41,10 @@ __all__ = ["PSServer", "PSClient", "ShardedPSClient", "BIGARRAY_BOUND"]
 # reference MXNET_KVSTORE_BIGARRAY_BOUND default (kvstore_dist.h)
 BIGARRAY_BOUND = int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 10 ** 6))
 
+# a sync merge or barrier that outlives this is treated as a dead-worker
+# failure and surfaced as an error instead of hanging the job
+SYNC_TIMEOUT_S = float(os.environ.get("MXTPU_PS_SYNC_TIMEOUT", 300))
+
 
 def _send_msg(sock, obj):
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
@@ -119,9 +123,19 @@ class PSServer:
                     self._merge[key] = (acc, count)
                     gen = self._gen.get(key, 0)
                     # block this worker's push until the round completes
-                    # (reference: server replies after NumWorkers merged)
+                    # (reference: server replies after NumWorkers merged);
+                    # bounded so one dead worker fails the job instead of
+                    # hanging every peer forever
+                    import time
+
+                    deadline = time.monotonic() + SYNC_TIMEOUT_S
                     while (self._gen.get(key, 0) == gen
                            and not self._stop.is_set()):
+                        if time.monotonic() > deadline:
+                            raise RuntimeError(
+                                f"sync push timed out on key {key!r}: only "
+                                f"{count}/{self.num_workers} workers pushed "
+                                f"within {SYNC_TIMEOUT_S}s (dead worker?)")
                         self._cond.wait(timeout=0.2)
                     return
                 # last pusher applies the merged update and releases peers
@@ -161,6 +175,10 @@ class PSServer:
         if op == "pull":
             with self._lock:
                 val = self.store.get(msg[1])
+                # copy under the lock: the assign path mutates stored
+                # arrays in place, and pickling outside the lock could
+                # serialize a torn half-old/half-new value
+                val = None if val is None else val.copy()
             if val is None:
                 return ("err", f"key {msg[1]!r} not initialized")
             return ("ok", val)
@@ -173,8 +191,15 @@ class PSServer:
                     self._barrier_gen += 1
                     self._cond.notify_all()
                 else:
+                    import time
+
+                    deadline = time.monotonic() + SYNC_TIMEOUT_S
                     while (self._barrier_gen == gen
                            and not self._stop.is_set()):
+                        if time.monotonic() > deadline:
+                            raise RuntimeError(
+                                f"barrier timed out after {SYNC_TIMEOUT_S}s "
+                                "(dead worker?)")
                         self._cond.wait(timeout=0.2)
             return ("ok",)
         if op == "command":
@@ -185,6 +210,17 @@ class PSServer:
                 optimizer = pickle.loads(body)
                 with self._lock:
                     self.updater = get_updater(optimizer)
+            elif head == "get_states":
+                # optimizer states live server-side; expose them so
+                # workers can checkpoint (save_optimizer_states)
+                with self._lock:
+                    states = dict(self.updater.states) if self.updater else {}
+                return ("ok", pickle.dumps(states))
+            elif head == "set_states":
+                with self._lock:
+                    if self.updater is None:
+                        return ("err", "optimizer not initialized on server")
+                    self.updater.states.update(pickle.loads(body))
             elif head == "stop":
                 self._stop.set()
                 with self._cond:
@@ -305,6 +341,19 @@ class ShardedPSClient:
     def command(self, head, body):
         for c in self.clients:
             c.request("command", head, body)
+
+    def get_states(self):
+        """Merged server-side optimizer states across all shards."""
+        merged = {}
+        for c in self.clients:
+            merged.update(pickle.loads(c.request("command", "get_states",
+                                                 None)))
+        return merged
+
+    def set_states(self, states):
+        body = pickle.dumps(states)
+        for c in self.clients:
+            c.request("command", "set_states", body)
 
     def close(self):
         for c in self.clients:

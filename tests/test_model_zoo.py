@@ -1,0 +1,81 @@
+"""Baseline model-zoo symbols (inception-bn / vgg / alexnet — the
+reference's published-benchmark models, SURVEY.md §6) build, infer,
+run forward, and train."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+rng = np.random.RandomState(0)
+
+
+def _forward(net, data_shape, n_labels):
+    ex = net.simple_bind(mx.cpu(), data=data_shape,
+                         softmax_label=(data_shape[0],))
+    ex.arg_dict["data"][:] = rng.uniform(-1, 1, data_shape)
+    ex.arg_dict["softmax_label"][:] = rng.randint(0, n_labels, data_shape[0])
+    ex.forward(is_train=False)
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (data_shape[0], n_labels)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+    return out
+
+
+def test_inception_bn_small_forward_nchw_nhwc():
+    out = _forward(mx.models.inception_bn_small(num_classes=10),
+                   (2, 3, 28, 28), 10)
+    out2 = _forward(mx.models.inception_bn_small(num_classes=10,
+                                                 layout="NHWC"),
+                    (2, 28, 28, 3), 10)
+    assert out.shape == out2.shape
+
+
+def test_inception_bn_imagenet_shapes():
+    net = mx.models.inception_bn(num_classes=1000)
+    arg_shapes, out_shapes, _ = net.infer_shape(
+        data=(2, 3, 224, 224), softmax_label=(2,))
+    assert out_shapes == [(2, 1000)]
+    # reference block 5b concat width: 352 + 320 + 224 + 128 = 1024
+    names = dict(zip(net.list_arguments(), arg_shapes))
+    assert names["fc1_weight"][1] == 1024
+
+
+@pytest.mark.parametrize("depth", [11, 16])
+def test_vgg_forward(depth):
+    _forward(mx.models.vgg(num_classes=13, num_layers=depth),
+             (1, 3, 224, 224), 13)
+
+
+def test_vgg_bad_depth():
+    with pytest.raises(ValueError):
+        mx.models.vgg(num_layers=12)
+
+
+def test_alexnet_forward():
+    _forward(mx.models.alexnet(num_classes=7), (1, 3, 227, 227), 7)
+
+
+def test_inception_small_trains():
+    """A few SGD steps reduce loss on random-but-fixed CIFAR-shaped data."""
+    net = mx.models.inception_bn_small(num_classes=4)
+    X = rng.uniform(-1, 1, (16, 3, 28, 28)).astype(np.float32)
+    y = rng.randint(0, 4, 16).astype(np.float32)
+    it = mx.io.NDArrayIter({"data": X}, {"softmax_label": y}, batch_size=8)
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    metric = mx.metric.create("ce")
+    losses = []
+    for epoch in range(4):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        losses.append(metric.get()[1])
+    assert losses[-1] < losses[0]

@@ -85,6 +85,40 @@ def test_ps_sync_merges_num_workers_pushes():
         _stop(servers, [c1, c2])
 
 
+def test_ps_optimizer_states_roundtrip(tmp_path):
+    """Server-side optimizer states (momentum) can be fetched, saved,
+    and restored — the checkpoint path for PS-mode training."""
+    servers, mk = _start(num_workers=1, n_servers=2)
+    c = mk()
+    try:
+        opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+        c.command("set_optimizer", pickle.dumps(opt))
+        c.init("w", np.zeros(3, np.float32))
+        c.init("v", np.zeros(2, np.float32))
+        c.push("w", np.ones(3, np.float32))
+        c.push("v", np.ones(2, np.float32))
+        states = c.get_states()
+        assert set(states) == {"w", "v"}   # one momentum state per key
+        w_after_one = c.pull("w", (3,), np.float32).copy()
+
+        # restore states elsewhere: continuing must match exactly
+        servers2, mk2 = _start(num_workers=1, n_servers=2)
+        c2 = mk2()
+        try:
+            c2.command("set_optimizer", pickle.dumps(opt))
+            c2.init("w", w_after_one)
+            c2.init("v", c.pull("v", (2,), np.float32))
+            c2.set_states(states)
+            c.push("w", np.ones(3, np.float32))
+            c2.push("w", np.ones(3, np.float32))
+            np.testing.assert_allclose(c.pull("w", (3,), np.float32),
+                                       c2.pull("w", (3,), np.float32))
+        finally:
+            _stop(servers2, [c2])
+    finally:
+        _stop(servers, [c])
+
+
 def test_ps_big_array_striping():
     """Arrays over BIGARRAY_BOUND stripe across all server shards."""
     servers, mk = _start(num_workers=1, n_servers=2)
