@@ -84,6 +84,28 @@ int MXTPURegisterOp(const char* name, const char* doc,
 /* Enumerate op names; pointers valid until the next MXTPUListOps call. */
 int MXTPUListOps(int* out_size, const char*** out_names);
 /* Fetch one op's metadata; pointers valid until re-registration. */
+/* ---- predict-only mini API (reference include/mxnet/c_predict_api.h:
+ * create from symbol JSON + param blob, set named inputs, forward, copy
+ * outputs; the binding surface for non-Python frontends).  Implemented
+ * over an embedded CPython interpreter driving the JAX predictor. */
+typedef void* PredictorHandle;
+
+int MXTPUPredCreate(const char* symbol_json, const void* param_bytes,
+                    uint64_t param_size, int dev_type, int dev_id,
+                    uint32_t num_input_nodes, const char** input_keys,
+                    const uint32_t* input_shape_indptr,
+                    const uint32_t* input_shape_data,
+                    PredictorHandle* out);
+int MXTPUPredSetInput(PredictorHandle handle, const char* key,
+                      const float* data, uint32_t size);
+int MXTPUPredForward(PredictorHandle handle);
+/* Pass shape_data == NULL to query ndim first. */
+int MXTPUPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                            uint32_t* shape_data, uint32_t* shape_ndim);
+int MXTPUPredGetOutput(PredictorHandle handle, uint32_t index, float* data,
+                       uint32_t size);
+int MXTPUPredFree(PredictorHandle handle);
+
 int MXTPUGetOpInfo(const char* name, const char** out_doc, int* out_n_args,
                    const char*** out_arg_names, int* out_n_params,
                    const char*** out_param_names,

@@ -332,11 +332,14 @@ class DistPSKVStore(KVStore):
 
     def save_optimizer_states(self, fname):
         """Optimizer states live on the servers in PS mode — fetch and
-        merge them across shards for checkpointing."""
+        merge them across shards for checkpointing.  Safe to call from
+        every rank; only rank 0 writes the file."""
         if self._optimizer is None:
             raise MXNetError("optimizer not initialized")
-        with open(fname, "wb") as f:
-            f.write(pickle.dumps(self._client.get_states()))
+        if self._rank == 0:
+            with open(fname, "wb") as f:
+                f.write(pickle.dumps(self._client.get_states()))
+        self.barrier()
 
     def load_optimizer_states(self, fname):
         if self._optimizer is None:

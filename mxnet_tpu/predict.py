@@ -195,6 +195,22 @@ class Predictor:
         """``MXPredGetOutput``: copy output ``index`` to host numpy."""
         return self._exec.outputs[index].asnumpy()
 
+    # -- flat-buffer accessors for the C predict API (src/predict_capi.cc)
+    def set_input_flat(self, name, values):
+        """Set input ``name`` from raw float32 bytes (or any flat float
+        sequence) — the zero-boxing C ABI path."""
+        shape = self._input_shapes[name]
+        if isinstance(values, (bytes, bytearray, memoryview)):
+            arr = np.frombuffer(values, dtype=np.float32).reshape(shape)
+        else:
+            arr = np.asarray(values, dtype=np.float32).reshape(shape)
+        self.set_input(name, arr)
+
+    def get_output_flat(self, index=0):
+        """Output ``index`` as raw float32 bytes (C ABI path)."""
+        return np.ascontiguousarray(
+            self.get_output(index), dtype=np.float32).tobytes()
+
     def reshape(self, input_shapes):
         """``MXPredReshape``: rebind with new input shapes (weights kept)."""
         self._bind(dict(input_shapes))

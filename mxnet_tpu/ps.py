@@ -32,6 +32,7 @@ import pickle
 import socket
 import struct
 import threading
+import time
 import zlib
 
 import numpy as np
@@ -123,20 +124,11 @@ class PSServer:
                     self._merge[key] = (acc, count)
                     gen = self._gen.get(key, 0)
                     # block this worker's push until the round completes
-                    # (reference: server replies after NumWorkers merged);
-                    # bounded so one dead worker fails the job instead of
-                    # hanging every peer forever
-                    import time
-
-                    deadline = time.monotonic() + SYNC_TIMEOUT_S
-                    while (self._gen.get(key, 0) == gen
-                           and not self._stop.is_set()):
-                        if time.monotonic() > deadline:
-                            raise RuntimeError(
-                                f"sync push timed out on key {key!r}: only "
-                                f"{count}/{self.num_workers} workers pushed "
-                                f"within {SYNC_TIMEOUT_S}s (dead worker?)")
-                        self._cond.wait(timeout=0.2)
+                    # (reference: server replies after NumWorkers merged)
+                    self._wait_released(
+                        lambda: self._gen.get(key, 0) != gen,
+                        f"sync push on key {key!r} "
+                        f"({count}/{self.num_workers} pushed)")
                     return
                 # last pusher applies the merged update and releases peers
                 self._apply(key, acc)
@@ -147,6 +139,17 @@ class PSServer:
                 # async: apply immediately — worker updates race, exactly
                 # the reference dist_async contract
                 self._apply(key, value)
+
+    def _wait_released(self, released, what):
+        """Wait (holding self._cond) until ``released()`` or stop; bounded
+        so one dead worker fails the job instead of hanging every peer."""
+        deadline = time.monotonic() + SYNC_TIMEOUT_S
+        while not released() and not self._stop.is_set():
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"{what} timed out after {SYNC_TIMEOUT_S}s "
+                    "(dead worker?)")
+            self._cond.wait(timeout=0.2)
 
     def _apply(self, key, recved):
         if key not in self.store:
@@ -191,16 +194,8 @@ class PSServer:
                     self._barrier_gen += 1
                     self._cond.notify_all()
                 else:
-                    import time
-
-                    deadline = time.monotonic() + SYNC_TIMEOUT_S
-                    while (self._barrier_gen == gen
-                           and not self._stop.is_set()):
-                        if time.monotonic() > deadline:
-                            raise RuntimeError(
-                                f"barrier timed out after {SYNC_TIMEOUT_S}s "
-                                "(dead worker?)")
-                        self._cond.wait(timeout=0.2)
+                    self._wait_released(
+                        lambda: self._barrier_gen != gen, "barrier")
             return ("ok",)
         if op == "command":
             _, head, body = msg
@@ -351,9 +346,20 @@ class ShardedPSClient:
         return merged
 
     def set_states(self, states):
-        body = pickle.dumps(states)
-        for c in self.clients:
-            c.request("command", "set_states", body)
+        """Route each state entry to the shard that owns its key (same
+        mapping push/pull use), so shards don't hold dead copies of
+        every other shard's momentum buffers."""
+        per_shard = [{} for _ in self.clients]
+        n = len(self.clients)
+        for k, v in states.items():
+            if isinstance(k, str) and "#stripe" in k:
+                idx = int(k.rsplit("#stripe", 1)[1]) % n
+            else:
+                idx = zlib.crc32(str(k).encode()) % n
+            per_shard[idx][k] = v
+        for c, d in zip(self.clients, per_shard):
+            if d:
+                c.request("command", "set_states", pickle.dumps(d))
 
     def close(self):
         for c in self.clients:

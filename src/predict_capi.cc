@@ -1,0 +1,231 @@
+// C-ABI predict API (rebuild of the reference's predict-only mini API,
+// src/c_api/c_predict_api.cc / include/mxnet/c_predict_api.h): the
+// surface that non-Python frontends (R / Scala / Matlab / amalgamation
+// deployments) bind against.  Create a predictor from symbol JSON + a
+// param blob, set named inputs, forward, copy outputs out.
+//
+// The compute path is the JAX/XLA predictor (mxnet_tpu/predict.py);
+// this file bridges to it through an embedded CPython interpreter: when
+// the host process is already Python (ctypes users) the existing
+// interpreter is used, otherwise one is initialized lazily and pinned
+// to the CPU backend.  All entry points hold the GIL only for the span
+// of the call, so C hosts may drive predictors from any thread.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "mxtpu/c_api.h"
+
+namespace {
+
+struct Predictor {
+  PyObject* obj;  // mxnet_tpu.predict.Predictor instance
+};
+
+bool g_we_initialized = false;
+
+// Set the thread-local error ring from the pending Python exception.
+void SetErrorFromPython() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  MXTPUSetLastError(msg.c_str());
+}
+
+// Ensure an interpreter exists; returns false on failure.  When this
+// library initializes Python itself (pure-C host), the JAX backend is
+// pinned to CPU first — predict-only deployments are host-side
+// (reference MXNET_PREDICT_ONLY forces the naive engine the same way).
+std::once_flag g_init_once;
+
+bool EnsurePython() {
+  // serialize first-call initialization: two C host threads racing
+  // Py_InitializeEx is undefined behavior
+  std::call_once(g_init_once, []() {
+    if (Py_IsInitialized()) return;
+    Py_InitializeEx(0);
+    if (!Py_IsInitialized()) return;
+    g_we_initialized = true;
+    PyRun_SimpleString(
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n");
+    // release the GIL so later PyGILState_Ensure works from any thread
+    (void)PyEval_SaveThread();
+  });
+  if (!Py_IsInitialized()) {
+    MXTPUSetLastError("failed to initialize embedded Python");
+    return false;
+  }
+  return true;
+}
+
+class GILGuard {
+ public:
+  GILGuard() : state_(PyGILState_Ensure()) {}
+  ~GILGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+}  // namespace
+
+extern "C" {
+
+int MXTPUPredCreate(const char* symbol_json, const void* param_bytes,
+                    uint64_t param_size, int dev_type, int dev_id,
+                    uint32_t num_input_nodes, const char** input_keys,
+                    const uint32_t* input_shape_indptr,
+                    const uint32_t* input_shape_data,
+                    PredictorHandle* out) {
+  (void)dev_type;
+  (void)dev_id;  // context selection is the frontend's concern on TPU
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+
+  PyObject* shapes = PyDict_New();
+  for (uint32_t i = 0; i < num_input_nodes; ++i) {
+    uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject* tup = PyTuple_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(tup, j - lo, PyLong_FromUnsignedLong(
+                                        input_shape_data[j]));
+    PyDict_SetItemString(shapes, input_keys[i], tup);
+    Py_DECREF(tup);
+  }
+
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.predict");
+  if (mod == nullptr) {
+    SetErrorFromPython();
+    Py_DECREF(shapes);
+    return -1;
+  }
+  PyObject* cls = PyObject_GetAttrString(mod, "Predictor");
+  Py_DECREF(mod);
+  PyObject* blob = PyBytes_FromStringAndSize(
+      static_cast<const char*>(param_bytes),
+      static_cast<Py_ssize_t>(param_size));
+  PyObject* obj =
+      cls ? PyObject_CallFunction(cls, "sOO", symbol_json, blob, shapes)
+          : nullptr;
+  Py_XDECREF(cls);
+  Py_XDECREF(blob);
+  Py_DECREF(shapes);
+  if (obj == nullptr) {
+    SetErrorFromPython();
+    return -1;
+  }
+  auto* h = new Predictor{obj};
+  *out = h;
+  return 0;
+}
+
+int MXTPUPredSetInput(PredictorHandle handle, const char* key,
+                      const float* data, uint32_t size) {
+  GILGuard gil;
+  auto* h = static_cast<Predictor*>(handle);
+  // raw float32 bytes across the ABI; Predictor.set_input_flat
+  // np.frombuffer's and reshapes to the declared input shape
+  PyObject* buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(size) * 4);
+  PyObject* r =
+      PyObject_CallMethod(h->obj, "set_input_flat", "sO", key, buf);
+  Py_XDECREF(buf);
+  if (r == nullptr) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUPredForward(PredictorHandle handle) {
+  GILGuard gil;
+  auto* h = static_cast<Predictor*>(handle);
+  PyObject* r = PyObject_CallMethod(h->obj, "forward", nullptr);
+  if (r == nullptr) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                            uint32_t* shape_data, uint32_t* shape_ndim) {
+  GILGuard gil;
+  auto* h = static_cast<Predictor*>(handle);
+  PyObject* shp = PyObject_CallMethod(h->obj, "get_output_shape", "I", index);
+  if (shp == nullptr) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Size(shp);
+  if (shape_data == nullptr) {  // size query
+    *shape_ndim = static_cast<uint32_t>(n);
+    Py_DECREF(shp);
+    return 0;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i)
+    shape_data[i] = static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp, i)));
+  *shape_ndim = static_cast<uint32_t>(n);
+  Py_DECREF(shp);
+  return 0;
+}
+
+int MXTPUPredGetOutput(PredictorHandle handle, uint32_t index, float* data,
+                       uint32_t size) {
+  GILGuard gil;
+  auto* h = static_cast<Predictor*>(handle);
+  PyObject* flat =
+      PyObject_CallMethod(h->obj, "get_output_flat", "I", index);
+  if (flat == nullptr) {
+    SetErrorFromPython();
+    return -1;
+  }
+  char* raw = nullptr;
+  Py_ssize_t nbytes = 0;
+  if (PyBytes_AsStringAndSize(flat, &raw, &nbytes) != 0) {
+    Py_DECREF(flat);
+    SetErrorFromPython();
+    return -1;
+  }
+  if (nbytes != static_cast<Py_ssize_t>(size) * 4) {
+    Py_DECREF(flat);
+    MXTPUSetLastError("output size mismatch");
+    return -1;
+  }
+  std::memcpy(data, raw, static_cast<size_t>(nbytes));
+  Py_DECREF(flat);
+  return 0;
+}
+
+int MXTPUPredFree(PredictorHandle handle) {
+  auto* h = static_cast<Predictor*>(handle);
+  if (Py_IsInitialized()) {
+    GILGuard gil;
+    Py_XDECREF(h->obj);
+  }
+  delete h;
+  return 0;
+}
+
+}  // extern "C"

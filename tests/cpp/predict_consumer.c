@@ -1,0 +1,75 @@
+/* C consumer of the predict mini-API (MXTPUPred*): load a checkpoint
+ * (symbol JSON + param blob) exported by the Python side, run a forward
+ * pass from pure C, and print the outputs for the harness to compare.
+ *
+ * Usage: predict_consumer <symbol.json> <blob.params> <batch> <dim>
+ * Reads <batch>*<dim> floats from stdin, prints outputs one per line. */
+#include <stdio.h>
+#include <stdlib.h>
+#include "mxtpu/c_api.h"
+
+static char* read_file(const char* path, long* out_size) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = (char*)malloc(n + 1);
+  if (fread(buf, 1, n, f) != (size_t)n) { fclose(f); free(buf); return NULL; }
+  buf[n] = 0;
+  fclose(f);
+  if (out_size) *out_size = n;
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  if (argc != 5) { fprintf(stderr, "usage: %s json params batch dim\n", argv[0]); return 2; }
+  long json_size = 0, blob_size = 0;
+  char* json = read_file(argv[1], &json_size);
+  char* blob = read_file(argv[2], &blob_size);
+  if (!json || !blob) { fprintf(stderr, "read failed\n"); return 2; }
+  unsigned batch = (unsigned)atoi(argv[3]), dim = (unsigned)atoi(argv[4]);
+
+  const char* keys[] = {"data"};
+  unsigned int indptr[] = {0, 2};
+  unsigned int shape[] = {batch, dim};
+  PredictorHandle h = NULL;
+  if (MXTPUPredCreate(json, blob, (unsigned long)blob_size, 1, 0,
+                      1, keys, indptr, shape, &h) != 0) {
+    fprintf(stderr, "create failed: %s\n", MXTPUGetLastError());
+    return 1;
+  }
+
+  unsigned n_in = batch * dim;
+  float* in = (float*)malloc(n_in * sizeof(float));
+  for (unsigned i = 0; i < n_in; ++i)
+    if (scanf("%f", &in[i]) != 1) { fprintf(stderr, "stdin short\n"); return 2; }
+  if (MXTPUPredSetInput(h, "data", in, n_in) != 0) {
+    fprintf(stderr, "set_input failed: %s\n", MXTPUGetLastError());
+    return 1;
+  }
+  if (MXTPUPredForward(h) != 0) {
+    fprintf(stderr, "forward failed: %s\n", MXTPUGetLastError());
+    return 1;
+  }
+
+  unsigned ndim = 0;
+  if (MXTPUPredGetOutputShape(h, 0, NULL, &ndim) != 0 || ndim == 0) {
+    fprintf(stderr, "shape failed: %s\n", MXTPUGetLastError());
+    return 1;
+  }
+  unsigned* oshape = (unsigned*)malloc(ndim * sizeof(unsigned));
+  MXTPUPredGetOutputShape(h, 0, oshape, &ndim);
+  unsigned total = 1;
+  for (unsigned i = 0; i < ndim; ++i) total *= oshape[i];
+
+  float* out = (float*)malloc(total * sizeof(float));
+  if (MXTPUPredGetOutput(h, 0, out, total) != 0) {
+    fprintf(stderr, "get_output failed: %s\n", MXTPUGetLastError());
+    return 1;
+  }
+  for (unsigned i = 0; i < total; ++i) printf("%.6f\n", out[i]);
+  MXTPUPredFree(h);
+  free(json); free(blob); free(in); free(oshape); free(out);
+  return 0;
+}

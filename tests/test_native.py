@@ -206,3 +206,54 @@ def test_c_api_sees_late_registered_custom_ops():
         pass
 
     assert "late_custom_op_test" in c_api.list_ops()
+
+
+def test_c_predict_api_from_c(tmp_path):
+    """End-to-end C predict path: train a tiny MLP in Python, save the
+    two-artifact checkpoint, run inference from a pure-C program through
+    MXTPUPred* (embedded-interpreter bridge), compare outputs."""
+    import shutil
+    import subprocess
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    if shutil.which("gcc") is None:
+        pytest.skip("no C compiler")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    net = mx.models.mlp(num_classes=3)
+    rng = np.random.RandomState(0)
+    X = rng.randn(4, 16).astype(np.float32)
+    ex = net.simple_bind(mx.cpu(), data=(4, 16), softmax_label=(4,))
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = rng.randn(*arr.shape) * 0.1
+    ex.arg_dict["data"][:] = X
+    ex.forward(is_train=False)
+    want = ex.outputs[0].asnumpy()
+
+    json_path = str(tmp_path / "m.json")
+    params_path = str(tmp_path / "m.params")
+    net.save(json_path)
+    mx.nd.save(params_path,
+               {f"arg:{k}": v for k, v in ex.arg_dict.items()
+                if k not in ("data", "softmax_label")})
+
+    src = os.path.join(repo, "tests", "cpp", "predict_consumer.c")
+    exe = str(tmp_path / "pred_test")
+    lib_dir = os.path.join(repo, "mxnet_tpu", "lib")
+    subprocess.run(
+        ["gcc", "-I" + os.path.join(repo, "include"), src,
+         "-L" + lib_dir, "-lmxtpu", "-Wl,-rpath," + lib_dir, "-o", exe],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    stdin = "\n".join(f"{v:.8f}" for v in X.reshape(-1))
+    r = subprocess.run([exe, json_path, params_path, "4", "16"],
+                       input=stdin, capture_output=True, text=True,
+                       timeout=280, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    got = np.array([float(x) for x in r.stdout.split()]).reshape(want.shape)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
