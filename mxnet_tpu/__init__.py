@@ -37,6 +37,7 @@ from . import recordio
 from . import rnn_io
 from . import image_io
 from .image_io import ImageRecordIter
+from . import cv
 
 io.ImageRecordIter = ImageRecordIter  # reference exposes it under mx.io
 from . import kvstore

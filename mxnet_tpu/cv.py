@@ -1,0 +1,174 @@
+"""OpenCV bridge (reference plugin/opencv: cv_api.cc + opencv.py).
+
+The reference routed imdecode/resize/copyMakeBorder through its own C++
+OpenCV wrappers into NDArrays; here OpenCV's Python bindings do the
+pixel work on host and results land in NDArrays — same surface:
+``imdecode``, ``resize``, ``copyMakeBorder``, crop/normalize helpers,
+and the simple ``ImageListIter`` file-list iterator.
+
+Images are HWC uint8 BGR on host (cv2 convention), converted to
+NDArray float32 by the iterator like the reference's pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+
+import numpy as np
+
+from . import io as _io
+from . import ndarray as nd
+from .base import MXNetError
+
+__all__ = ["imdecode", "resize", "copyMakeBorder", "scale_down",
+           "fixed_crop", "random_crop", "color_normalize",
+           "random_size_crop", "ImageListIter"]
+
+
+def _cv2():
+    try:
+        import cv2
+        return cv2
+    except ImportError as e:  # pragma: no cover - cv2 is in the image
+        raise MXNetError(
+            "mxnet_tpu.cv needs the opencv-python package") from e
+
+
+def imdecode(str_img, flag=1):
+    """Decode an encoded image byte string to an HWC uint8 NDArray
+    (reference MXCVImdecode)."""
+    cv2 = _cv2()
+    img = cv2.imdecode(np.frombuffer(str_img, np.uint8), flag)
+    if img is None:
+        raise MXNetError("imdecode: cannot decode image")
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return nd.array(img, dtype=np.uint8)
+
+
+def resize(src, size, interpolation=None):
+    """Resize to ``(w, h)`` (reference MXCVResize)."""
+    cv2 = _cv2()
+    interpolation = cv2.INTER_LINEAR if interpolation is None else interpolation
+    out = cv2.resize(src.asnumpy().astype(np.uint8), tuple(size),
+                     interpolation=interpolation)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out, dtype=np.uint8)
+
+
+def copyMakeBorder(src, top, bot, left, right, border_type=None, value=0):
+    """Pad an image (reference MXCVcopyMakeBorder)."""
+    cv2 = _cv2()
+    border_type = cv2.BORDER_CONSTANT if border_type is None else border_type
+    out = cv2.copyMakeBorder(src.asnumpy().astype(np.uint8), top, bot, left,
+                             right, border_type, value=value)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out, dtype=np.uint8)
+
+
+def scale_down(src_size, size):
+    """Scale ``size`` down to fit in ``src_size`` keeping aspect."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interpolation=None):
+    out = nd.array(src.asnumpy()[y0:y0 + h, x0:x0 + w], dtype=np.uint8)
+    if size is not None and (w, h) != tuple(size):
+        out = resize(out, size, interpolation)
+    return out
+
+
+def random_crop(src, size):
+    """Random crop to ``(w, h)`` (scaled down if needed); returns
+    (cropped, (x0, y0, w, h))."""
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src.astype(np.float32) - nd.array(np.asarray(mean, np.float32))
+    if std is not None:
+        src = src / nd.array(np.asarray(std, np.float32))
+    return src
+
+
+def random_size_crop(src, size, min_area=0.25, ratio=(3.0 / 4.0, 4.0 / 3.0)):
+    """Random area+aspect crop (reference random_size_crop); falls back
+    to random_crop when no candidate fits."""
+    h, w = src.shape[0], src.shape[1]
+    area = w * h
+    for _ in range(10):
+        new_area = _pyrandom.uniform(min_area, 1.0) * area
+        new_ratio = _pyrandom.uniform(*ratio)
+        new_w = int(round(np.sqrt(new_area * new_ratio)))
+        new_h = int(round(np.sqrt(new_area / new_ratio)))
+        if _pyrandom.random() < 0.5:
+            new_w, new_h = new_h, new_w
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size)
+            return out, (x0, y0, new_w, new_h)
+    return random_crop(src, size)
+
+
+class ImageListIter(_io.DataIter):
+    """Iterate a file list as batches (reference opencv.py ImageListIter):
+    each line of ``flist`` is "<index>\\t<label>\\t<relative path>"."""
+
+    def __init__(self, root, flist, batch_size, size, mean=None):
+        super().__init__()
+        self.root = root
+        self.batch_size = batch_size
+        self.size = tuple(size)
+        self.mean = None if mean is None else np.asarray(mean, np.float32)
+        self.list = []
+        with open(flist) as f:
+            for line in f:
+                parts = line.strip().split("\t")
+                if len(parts) >= 3:
+                    self.list.append((float(parts[1]), parts[2]))
+        self.cur = 0
+
+    @property
+    def provide_data(self):
+        return [("data", (self.batch_size, self.size[1], self.size[0], 3))]
+
+    @property
+    def provide_label(self):
+        return [("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self.cur = 0
+
+    def next(self):
+        if self.cur + self.batch_size > len(self.list):
+            raise StopIteration
+        data = np.zeros((self.batch_size, self.size[1], self.size[0], 3),
+                        np.float32)
+        label = np.zeros((self.batch_size,), np.float32)
+        for i in range(self.batch_size):
+            lab, path = self.list[self.cur + i]
+            with open(os.path.join(self.root, path), "rb") as f:
+                img = imdecode(f.read())
+            img = resize(img, self.size).asnumpy().astype(np.float32)
+            if self.mean is not None:
+                img = img - self.mean
+            data[i] = img
+            label[i] = lab
+        self.cur += self.batch_size
+        return _io.DataBatch(data=[nd.array(data)], label=[nd.array(label)],
+                             pad=0, index=None)

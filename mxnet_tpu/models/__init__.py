@@ -6,9 +6,9 @@ from .mlp import get_symbol as mlp
 from .resnet import get_symbol as resnet
 from .lstm import lstm_unroll, lstm_cell, LSTMState, LSTMParam
 from .ssd import get_symbol as ssd
-from .inception import inception_bn, inception_bn_small
+from .inception import inception_bn, inception_bn_small, googlenet
 from .vgg import vgg, alexnet
 
 __all__ = ["lenet", "mlp", "resnet", "lstm_unroll", "lstm_cell",
            "LSTMState", "LSTMParam", "ssd",
-           "inception_bn", "inception_bn_small", "vgg", "alexnet"]
+           "inception_bn", "inception_bn_small", "googlenet", "vgg", "alexnet"]

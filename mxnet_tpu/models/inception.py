@@ -121,6 +121,76 @@ _SMALL_BLOCKS = [
 ]
 
 
+def _conv_relu(data, num_filter, kernel, name, stride=(1, 1), pad=(0, 0),
+               layout="NCHW"):
+    """Conv + ReLU without BN (GoogLeNet v1 blocks)."""
+    x = mx_sym.Convolution(data, num_filter=num_filter, kernel=kernel,
+                           stride=stride, pad=pad, layout=layout,
+                           name=f"conv_{name}")
+    return mx_sym.Activation(x, act_type="relu", name=f"relu_{name}")
+
+
+# GoogLeNet block table: (1x1, 3x3red, 3x3, 5x5red, 5x5, proj) per block,
+# None = stride-2 max pool (symbol_googlenet.py get_symbol sequence)
+_GOOGLENET_BLOCKS = [
+    ("in3a", (64, 96, 128, 16, 32, 32)),
+    ("in3b", (128, 128, 192, 32, 96, 64)),
+    ("pool4", None),
+    ("in4a", (192, 96, 208, 16, 48, 64)),
+    ("in4b", (160, 112, 224, 24, 64, 64)),
+    ("in4c", (128, 128, 256, 24, 64, 64)),
+    ("in4d", (112, 144, 288, 32, 64, 64)),
+    ("in4e", (256, 160, 320, 32, 128, 128)),
+    ("pool5", None),
+    ("in5a", (256, 160, 320, 32, 128, 128)),
+    ("in5b", (384, 192, 384, 48, 128, 128)),
+]
+
+
+def googlenet(num_classes=1000, layout="NCHW"):
+    """GoogLeNet / Inception v1 (symbol_googlenet.py): 1x1 + 3x3 + 5x5 +
+    pool-proj branches, no batch norm."""
+    concat_axis = -1 if layout == "NHWC" else 1
+    x = mx_sym.Variable("data")
+    x = _conv_relu(x, 64, (7, 7), "1", stride=(2, 2), pad=(3, 3),
+                   layout=layout)
+    x = mx_sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max",
+                       pooling_convention="full", layout=layout,
+                       name="pool_1")
+    x = _conv_relu(x, 64, (1, 1), "2", layout=layout)
+    x = _conv_relu(x, 192, (3, 3), "3", pad=(1, 1), layout=layout)
+    x = mx_sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max",
+                       pooling_convention="full", layout=layout,
+                       name="pool_3")
+    for name, cfg in _GOOGLENET_BLOCKS:
+        if cfg is None:
+            # legacy mshadow ceil convention keeps the reference's 7x7
+            # map at the head (112->56->28->14->7)
+            x = mx_sym.Pooling(x, kernel=(3, 3), stride=(2, 2),
+                               pool_type="max", pooling_convention="full",
+                               layout=layout, name=name)
+            continue
+        n1, nr3, n3, nr5, n5, proj = cfg
+        b1 = _conv_relu(x, n1, (1, 1), f"{name}_1x1", layout=layout)
+        b3 = _conv_relu(x, nr3, (1, 1), f"{name}_3x3r", layout=layout)
+        b3 = _conv_relu(b3, n3, (3, 3), f"{name}_3x3", pad=(1, 1),
+                        layout=layout)
+        b5 = _conv_relu(x, nr5, (1, 1), f"{name}_5x5r", layout=layout)
+        b5 = _conv_relu(b5, n5, (5, 5), f"{name}_5x5", pad=(2, 2),
+                        layout=layout)
+        bp = mx_sym.Pooling(x, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                            pool_type="max", layout=layout,
+                            name=f"pool_{name}")
+        bp = _conv_relu(bp, proj, (1, 1), f"{name}_proj", layout=layout)
+        x = mx_sym.Concat(b1, b3, b5, bp, num_args=4, dim=concat_axis,
+                          name=f"concat_{name}")
+    x = mx_sym.Pooling(x, kernel=(7, 7), stride=(1, 1), pool_type="avg",
+                       global_pool=True, layout=layout, name="global_pool")
+    x = mx_sym.Flatten(x, name="flatten")
+    x = mx_sym.FullyConnected(x, num_hidden=num_classes, name="fc1")
+    return mx_sym.SoftmaxOutput(x, name="softmax")
+
+
 def inception_bn_small(num_classes=10, layout="NCHW", force_mirroring=False):
     """The CIFAR-10 "28-small" variant (the multi-GPU img/sec baseline,
     symbol_inception-bn-28-small.py); ``force_mirroring`` tags every

@@ -88,3 +88,56 @@ def test_mean_subtract(rec_file):
     b = next(iter_epoch(it))
     img0 = b.data[0].asnumpy()[0]  # label 0: pixels ~0..10 minus mean 30
     assert img0.mean() < 0
+
+
+# -- OpenCV bridge (plugin/opencv parity) -----------------------------------
+def test_cv_imdecode_resize_border(tmp_path):
+    import cv2
+
+    img = (np.arange(32 * 48 * 3) % 255).reshape(32, 48, 3).astype(np.uint8)
+    ok, enc = cv2.imencode(".png", img)
+    assert ok
+    dec = mx.cv.imdecode(enc.tobytes())
+    np.testing.assert_array_equal(dec.asnumpy(), img)
+
+    small = mx.cv.resize(dec, (24, 16))
+    assert small.shape == (16, 24, 3)
+
+    padded = mx.cv.copyMakeBorder(dec, 2, 2, 3, 3)
+    assert padded.shape == (36, 54, 3)
+    np.testing.assert_array_equal(padded.asnumpy()[2:-2, 3:-3], img)
+
+
+def test_cv_crops_and_normalize():
+    rng2 = np.random.RandomState(3)
+    img = mx.nd.array(rng2.randint(0, 255, (40, 60, 3)), dtype=np.uint8)
+    crop = mx.cv.fixed_crop(img, 5, 4, 20, 10)
+    assert crop.shape == (10, 20, 3)
+    out, (x0, y0, w, h) = mx.cv.random_crop(img, (30, 20))
+    assert out.shape == (20, 30, 3)
+    out2, _ = mx.cv.random_size_crop(img, (16, 16))
+    assert out2.shape == (16, 16, 3)
+    norm = mx.cv.color_normalize(img, mean=(1.0, 2.0, 3.0))
+    np.testing.assert_allclose(norm.asnumpy()[0, 0],
+                               img.asnumpy()[0, 0] - [1, 2, 3])
+
+
+def test_cv_image_list_iter(tmp_path):
+    import cv2
+
+    root = tmp_path / "imgs"
+    root.mkdir()
+    lines = []
+    for i in range(4):
+        img = np.full((10 + i, 12, 3), i * 10, np.uint8)
+        cv2.imwrite(str(root / f"im{i}.png"), img)
+        lines.append(f"{i}\t{float(i)}\tim{i}.png")
+    flist = tmp_path / "list.lst"
+    flist.write_text("\n".join(lines) + "\n")
+
+    it = mx.cv.ImageListIter(str(root), str(flist), batch_size=2,
+                             size=(8, 8))
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (2, 8, 8, 3)
+    assert batches[0].label[0].asnumpy().tolist() == [0.0, 1.0]

@@ -79,3 +79,14 @@ def test_inception_small_trains():
             mod.update()
         losses.append(metric.get()[1])
     assert losses[-1] < losses[0]
+
+
+def test_googlenet_forward():
+    net = mx.models.googlenet(num_classes=1000)
+    arg_shapes, out_shapes, _ = net.infer_shape(
+        data=(1, 3, 224, 224), softmax_label=(1,))
+    assert out_shapes == [(1, 1000)]
+    # in5b concat: 384 + 384 + 128 + 128 = 1024
+    names = dict(zip(net.list_arguments(), arg_shapes))
+    assert names["fc1_weight"][1] == 1024
+    _forward(mx.models.googlenet(num_classes=5), (1, 3, 224, 224), 5)
