@@ -48,25 +48,27 @@ def imdecode(str_img, flag=1):
 
 
 def resize(src, size, interpolation=None):
-    """Resize to ``(w, h)`` (reference MXCVResize)."""
+    """Resize to ``(w, h)`` (reference MXCVResize).  Dtype preserved —
+    cv2 handles uint8 and float natively."""
     cv2 = _cv2()
     interpolation = cv2.INTER_LINEAR if interpolation is None else interpolation
-    out = cv2.resize(src.asnumpy().astype(np.uint8), tuple(size),
-                     interpolation=interpolation)
+    arr = src.asnumpy()
+    out = cv2.resize(arr, tuple(size), interpolation=interpolation)
     if out.ndim == 2:
         out = out[:, :, None]
-    return nd.array(out, dtype=np.uint8)
+    return nd.array(out, dtype=arr.dtype)
 
 
 def copyMakeBorder(src, top, bot, left, right, border_type=None, value=0):
-    """Pad an image (reference MXCVcopyMakeBorder)."""
+    """Pad an image (reference MXCVcopyMakeBorder).  Dtype preserved."""
     cv2 = _cv2()
     border_type = cv2.BORDER_CONSTANT if border_type is None else border_type
-    out = cv2.copyMakeBorder(src.asnumpy().astype(np.uint8), top, bot, left,
-                             right, border_type, value=value)
+    arr = src.asnumpy()
+    out = cv2.copyMakeBorder(arr, top, bot, left, right, border_type,
+                             value=value)
     if out.ndim == 2:
         out = out[:, :, None]
-    return nd.array(out, dtype=np.uint8)
+    return nd.array(out, dtype=arr.dtype)
 
 
 def scale_down(src_size, size):
@@ -81,7 +83,8 @@ def scale_down(src_size, size):
 
 
 def fixed_crop(src, x0, y0, w, h, size=None, interpolation=None):
-    out = nd.array(src.asnumpy()[y0:y0 + h, x0:x0 + w], dtype=np.uint8)
+    arr = src.asnumpy()
+    out = nd.array(arr[y0:y0 + h, x0:x0 + w], dtype=arr.dtype)
     if size is not None and (w, h) != tuple(size):
         out = resize(out, size, interpolation)
     return out
@@ -157,14 +160,21 @@ class ImageListIter(_io.DataIter):
     def next(self):
         if self.cur + self.batch_size > len(self.list):
             raise StopIteration
+        cv2 = _cv2()
+        # decode/resize stay pure-host (numpy) — only the finished batch
+        # is placed on device, like the ImageRecordIter pipeline
         data = np.zeros((self.batch_size, self.size[1], self.size[0], 3),
                         np.float32)
         label = np.zeros((self.batch_size,), np.float32)
         for i in range(self.batch_size):
             lab, path = self.list[self.cur + i]
             with open(os.path.join(self.root, path), "rb") as f:
-                img = imdecode(f.read())
-            img = resize(img, self.size).asnumpy().astype(np.float32)
+                img = cv2.imdecode(np.frombuffer(f.read(), np.uint8), 1)
+            if img is None:
+                raise MXNetError(f"cannot decode image {path!r}")
+            img = cv2.resize(img, self.size).astype(np.float32)
+            if img.ndim == 2:
+                img = img[:, :, None]
             if self.mean is not None:
                 img = img - self.mean
             data[i] = img

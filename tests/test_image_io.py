@@ -141,3 +141,12 @@ def test_cv_image_list_iter(tmp_path):
     assert len(batches) == 2
     assert batches[0].data[0].shape == (2, 8, 8, 3)
     assert batches[0].label[0].asnumpy().tolist() == [0.0, 1.0]
+
+
+def test_cv_preserves_float_dtype():
+    img = mx.nd.array(np.full((8, 8, 3), 300.0, np.float32))
+    out = mx.cv.resize(img, (4, 4))
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out.asnumpy(), 300.0)   # no uint8 wraparound
+    pad = mx.cv.copyMakeBorder(img, 1, 1, 1, 1)
+    assert pad.dtype == np.float32
