@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Mixture-of-Experts training demo: expert parallelism over an ``ep``
+mesh axis with in-program all-to-all token dispatch/combine and the
+Switch-style load-balancing auxiliary loss.
+
+On CPU run with:
+
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/train_moe.py --ep 8 --experts 8
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--ep", type=int, default=8, help="expert-parallel ways")
+    p.add_argument("--experts", type=int, default=8)
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--d-hidden", type=int, default=128)
+    p.add_argument("--tokens", type=int, default=512)
+    p.add_argument("--top-k", type=int, default=2)
+    p.add_argument("--steps", type=int, default=40)
+    args = p.parse_args()
+
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+
+    mesh = mx.parallel.make_mesh({"ep": args.ep})
+    layer = mx.parallel.MoELayer(args.d_model, args.d_hidden, args.experts,
+                                 mesh, k=args.top_k, capacity_factor=1.5)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.standard_normal((args.tokens, args.d_model))
+                    .astype(np.float32))
+    tgt = jnp.asarray(np.sin(np.asarray(x)))
+
+    def loss_fn(y):
+        return jnp.mean((y - tgt) ** 2)
+
+    for i in range(args.steps):
+        loss = layer.grad_step(x, loss_fn, lr=0.1)
+        if i % 10 == 0 or i == args.steps - 1:
+            print(f"step {i}: loss {float(loss):.5f} "
+                  f"aux {float(getattr(layer, 'last_aux_loss', 0.0)):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -109,3 +109,15 @@ def test_example_train_ssd_runs():
     _run_example("train_ssd.py",
                  ["--num-epochs", "1", "--batch-size", "2",
                   "--filter-scale", "16", "--num-classes", "3"])
+
+
+def test_example_train_longcontext_runs():
+    _run_example("train_longcontext.py",
+                 ["--sp", "4", "--seq-len", "64", "--dim", "8",
+                  "--heads", "2", "--steps", "3"])
+
+
+def test_example_train_moe_runs():
+    _run_example("train_moe.py",
+                 ["--ep", "4", "--experts", "4", "--d-model", "16",
+                  "--d-hidden", "32", "--tokens", "64", "--steps", "3"])
