@@ -15,14 +15,55 @@ example/image-classification/benchmark.py).
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 2500.0
 
+# TPU backend init can hang when the device tunnel is down; the parent
+# process watchdogs a child attempt and falls back to CPU smoke mode so
+# the harness always emits its JSON line.
+TPU_ATTEMPT_TIMEOUT_S = float(os.environ.get("BENCH_TPU_TIMEOUT", 1800))
+
+
+def _run_with_watchdog():
+    """Try the real benchmark in a child; on hang/crash, rerun on CPU."""
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = "1"
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           timeout=TPU_ATTEMPT_TIMEOUT_S, env=env,
+                           capture_output=True, text=True)
+        if r.returncode == 0 and '"metric"' in r.stdout:
+            sys.stdout.write(r.stdout)
+            sys.stderr.write(r.stderr)
+            return 0
+        sys.stderr.write(f"bench child failed (rc={r.returncode}):\n"
+                         + r.stderr[-2000:] + "\n")
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(
+            f"bench child exceeded {TPU_ATTEMPT_TIMEOUT_S}s "
+            "(device tunnel down?); falling back to CPU smoke mode\n")
+    env["BENCH_FORCE_CPU"] = "1"
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           timeout=TPU_ATTEMPT_TIMEOUT_S, env=env)
+        return r.returncode
+    except subprocess.TimeoutExpired:
+        # last resort: still honor the one-JSON-line contract
+        print(json.dumps({"metric": "resnet50_train_throughput",
+                          "value": 0.0, "unit": "images/sec/chip",
+                          "vs_baseline": 0.0, "error": "bench timed out"}))
+        return 1
+
 
 def main():
     import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
     import numpy as np
 
     import mxnet_tpu as mx
@@ -101,4 +142,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_CHILD"):
+        main()
+    else:
+        sys.exit(_run_with_watchdog())

@@ -50,7 +50,7 @@ def main():
         pooled = o.mean(axis=2) @ p["wo"]
         return jnp.mean((pooled - tgt) ** 2)
 
-    step = jax.jit(lambda p: (loss_fn(p), jax.grad(loss_fn)(p)))
+    step = jax.jit(jax.value_and_grad(loss_fn))
     lr = 0.05
     for i in range(args.steps):
         loss, grads = step(params)

@@ -229,16 +229,18 @@ class MoELayer:
         def objective(params):
             y, aux = moe_apply(params, x, self.mesh, self.axis, self.k,
                                self.capacity_factor)
-            return loss_fn(y) + aux_weight * aux
+            return loss_fn(y) + aux_weight * aux, aux
 
         return objective
 
     def grad_step(self, x, loss_fn, lr=0.01, aux_weight=0.01):
         """One SGD step.  ``loss_fn`` must be a stable function object —
         the jitted update is cached per loss_fn (see
-        trainer.cached_sgd_step)."""
+        trainer.cached_sgd_step).  Updates ``last_aux_loss``."""
         from .trainer import cached_sgd_step
 
-        step = cached_sgd_step(self._steps, loss_fn, self._make_objective)
-        loss, self.params = step(self.params, x, lr, aux_weight)
+        step = cached_sgd_step(self._steps, loss_fn, self._make_objective,
+                               has_aux=True)
+        loss, self.last_aux_loss, self.params = step(self.params, x, lr,
+                                                     aux_weight)
         return loss

@@ -137,5 +137,5 @@ class PipelineModule:
         from .trainer import cached_sgd_step
 
         step = cached_sgd_step(self._steps, loss_fn, self._make_objective)
-        loss, self.params = step(self.params, x, lr)
+        loss, _, self.params = step(self.params, x, lr)
         return loss

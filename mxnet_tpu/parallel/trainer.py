@@ -31,25 +31,31 @@ from .. import ndarray as nd
 __all__ = ["ShardedTrainer", "sgd_opt", "adam_opt", "cached_sgd_step"]
 
 
-def cached_sgd_step(cache, loss_fn, make_objective):
+def cached_sgd_step(cache, loss_fn, make_objective, has_aux=False):
     """Shared jitted-SGD-step cache for the module wrappers
     (PipelineModule / MoELayer).
 
-    Returns a jitted ``step(params, x, lr, *extra) -> (loss, new_params)``
-    cached per ``loss_fn`` identity — the cached closure retains
-    ``loss_fn``, so ids cannot be recycled, but callers must pass a
-    stable function object or every call recompiles.
-    ``make_objective(loss_fn, x, *extra)`` builds the ``params -> loss``
-    objective at trace time.
+    Returns a jitted ``step(params, x, lr, *extra) -> (loss, aux,
+    new_params)`` (``aux`` is None unless ``has_aux``) cached per
+    ``loss_fn`` identity — the cached closure retains ``loss_fn``, so
+    ids cannot be recycled, but callers must pass a stable function
+    object or every call recompiles.  ``make_objective(loss_fn, x,
+    *extra)`` builds the ``params -> loss`` (or ``params -> (loss,
+    aux)`` with ``has_aux``) objective at trace time.
     """
     step = cache.get(id(loss_fn))
     if step is None:
         def step_fn(params, x, lr, *extra):
             objective = make_objective(loss_fn, x, *extra)
-            loss, grads = jax.value_and_grad(objective)(params)
+            if has_aux:
+                (loss, aux), grads = jax.value_and_grad(
+                    objective, has_aux=True)(params)
+            else:
+                loss, grads = jax.value_and_grad(objective)(params)
+                aux = None
             new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
                                                 params, grads)
-            return loss, new_params
+            return loss, aux, new_params
 
         step = jax.jit(step_fn)
         cache[id(loss_fn)] = step
