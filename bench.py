@@ -48,14 +48,22 @@ def _run_with_watchdog():
     env["BENCH_FORCE_CPU"] = "1"
     try:
         r = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                           timeout=TPU_ATTEMPT_TIMEOUT_S, env=env)
-        return r.returncode
+                           timeout=TPU_ATTEMPT_TIMEOUT_S, env=env,
+                           capture_output=True, text=True)
+        if r.returncode == 0 and '"metric"' in r.stdout:
+            sys.stdout.write(r.stdout)
+            sys.stderr.write(r.stderr)
+            return 0
+        err = f"cpu fallback failed (rc={r.returncode})"
+        sys.stderr.write(err + ":\n" + r.stderr[-2000:] + "\n")
     except subprocess.TimeoutExpired:
-        # last resort: still honor the one-JSON-line contract
-        print(json.dumps({"metric": "resnet50_train_throughput",
-                          "value": 0.0, "unit": "images/sec/chip",
-                          "vs_baseline": 0.0, "error": "bench timed out"}))
-        return 1
+        err = "bench timed out"
+        sys.stderr.write(err + "\n")
+    # last resort: still honor the one-JSON-line contract
+    print(json.dumps({"metric": "resnet50_train_throughput", "value": 0.0,
+                      "unit": "images/sec/chip", "vs_baseline": 0.0,
+                      "error": err}))
+    return 1
 
 
 def main():

@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -125,6 +126,7 @@ class ImageRecordIter(DataIter):
                                    random_h=random_h, random_s=random_s,
                                    random_l=random_l, **aug_kwargs)
         self._mean = None
+        self._mean_img_path = mean_img
         if mean_img is not None and os.path.exists(mean_img):
             self._mean = nd.load(mean_img)["mean_img"].asnumpy()
         elif mean_r or mean_g or mean_b:
@@ -149,10 +151,67 @@ class ImageRecordIter(DataIter):
         self._prefetch = prefetch_buffer
         self._order = None
         self._reset_order()
+        if (self._mean_img_path is not None
+                and not os.path.exists(self._mean_img_path)):
+            self._compute_mean_image(offsets, part_index)
         self._queue = None
         self._producer = None
         self._stop = threading.Event()
         self._start_producer()
+
+    def _compute_mean_image(self, all_offsets, part_index, wait_s=600.0):
+        """First-run mean image saved to ``mean_img`` for reuse
+        (reference iter_normalize.h: the mean binary is computed on
+        first run then loaded thereafter).  Only partition 0 computes —
+        over the FULL record set, threaded — and writes atomically;
+        other partitions wait for the file to appear so concurrent
+        workers neither race the write nor get shard-biased means."""
+        if part_index != 0:
+            deadline = time.monotonic() + wait_s
+            while time.monotonic() < deadline:
+                if os.path.exists(self._mean_img_path):
+                    self._mean = nd.load(
+                        self._mean_img_path)["mean_img"].asnumpy()
+                    return
+                time.sleep(0.2)
+            raise MXNetError(
+                f"timed out waiting for mean image {self._mean_img_path!r} "
+                "(is partition 0 running?)")
+
+        def one(off):
+            reader = local.reader
+            reader.handle.seek(off)
+            raw = reader.read()
+            if raw is None:
+                return None
+            _, img = recordio.unpack_img(raw, iscolor=1)
+            img = self._aug(img, np.random.RandomState(0))
+            return img.astype(np.float64).transpose(2, 0, 1)
+
+        local = threading.local()
+        readers = []
+
+        def one_threaded(off):
+            if not hasattr(local, "reader"):
+                local.reader = recordio.MXRecordIO(self._path, "r")
+                readers.append(local.reader)
+            return one(off)
+
+        total = np.zeros(self.data_shape, np.float64)
+        count = 0
+        with ThreadPoolExecutor(max_workers=self._threads,
+                                thread_name_prefix="meanimg") as pool:
+            for chw in pool.map(one_threaded, all_offsets):
+                if chw is not None:
+                    total += chw
+                    count += 1
+        for r in readers:
+            r.close()
+        mean = (total / max(count, 1)).astype(np.float32)
+        tmp = self._mean_img_path + ".tmp"
+        nd.save(tmp, {"mean_img": nd.array(mean)})
+        os.replace(tmp, self._mean_img_path)
+        self._mean = mean
 
     def _reset_order(self):
         self._order = np.arange(len(self._offsets))

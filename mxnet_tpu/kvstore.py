@@ -156,6 +156,11 @@ class KVStore:
 
     set_updater = _set_updater
 
+    def num_dead_node(self, node_id=0, timeout=60.0):
+        """Failure-detection hook (reference kvstore.h:235-244
+        get_num_dead_node over ps-lite heartbeats); 0 for local stores."""
+        return 0
+
     def set_optimizer(self, optimizer):
         """Install an optimizer as the store-side updater.  In dist mode the
         reference pickles the optimizer to PS servers
@@ -282,6 +287,7 @@ class DistPSKVStore(KVStore):
         self._client = ShardedPSClient(addrs.split(","))
         self._rank = int(os.environ.get("MXTPU_PROC_ID", "0"))
         self._nproc = int(os.environ.get("MXTPU_NUM_PROCS", "1"))
+        self._client.hello(self._rank)
         # per-push sync flag (reference sends a server-global kSyncMode
         # command, kvstore.cc:29-38; per-push is strictly safer when two
         # stores share the same servers)
@@ -348,6 +354,11 @@ class DistPSKVStore(KVStore):
             with open(fname, "rb") as f:
                 self._client.set_states(pickle.loads(f.read()))
         self.barrier()
+
+    def num_dead_node(self, node_id=0, timeout=60.0):
+        """Count of workers whose heartbeat lapsed (reference
+        get_num_dead_node over ps-lite heartbeats)."""
+        return len(self._client.dead_nodes(timeout))
 
     def barrier(self):
         self._client.barrier()

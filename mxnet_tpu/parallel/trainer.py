@@ -43,7 +43,7 @@ def cached_sgd_step(cache, loss_fn, make_objective, has_aux=False):
     *extra)`` builds the ``params -> loss`` (or ``params -> (loss,
     aux)`` with ``has_aux``) objective at trace time.
     """
-    step = cache.get(id(loss_fn))
+    step = cache.get((id(loss_fn), has_aux))
     if step is None:
         def step_fn(params, x, lr, *extra):
             objective = make_objective(loss_fn, x, *extra)
@@ -58,7 +58,7 @@ def cached_sgd_step(cache, loss_fn, make_objective, has_aux=False):
             return loss, aux, new_params
 
         step = jax.jit(step_fn)
-        cache[id(loss_fn)] = step
+        cache[(id(loss_fn), has_aux)] = step
     return step
 
 

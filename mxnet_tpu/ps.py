@@ -86,6 +86,7 @@ class PSServer:
         self._cond = threading.Condition(self._lock)
         self._barrier_count = 0
         self._barrier_gen = 0
+        self._last_seen = {}    # worker rank -> monotonic last-contact
         self._stop = threading.Event()
         self._sock = socket.create_server((host, port))
         self._sock.settimeout(0.2)
@@ -164,8 +165,23 @@ class PSServer:
         else:
             self.store[key][...] = recved
 
-    def _handle(self, msg):
+    def _handle(self, msg, rank_holder=None):
         op = msg[0]
+        if op == "hello":
+            # worker-rank registration for heartbeat tracking (reference
+            # ps-lite Postoffice heartbeats / GetDeadNodes)
+            if rank_holder is not None:
+                rank_holder[0] = int(msg[1])
+            with self._lock:
+                self._last_seen[int(msg[1])] = time.monotonic()
+            return ("ok",)
+        if op == "dead_nodes":
+            timeout = float(msg[1])
+            now = time.monotonic()
+            with self._lock:
+                dead = sorted(r for r, t in self._last_seen.items()
+                              if now - t > timeout)
+            return ("ok", dead)
         if op == "init":
             _, key, value = msg
             with self._lock:
@@ -224,6 +240,7 @@ class PSServer:
         return ("err", f"unknown op {op!r}")
 
     def _serve(self, conn):
+        rank_holder = [None]   # set by a "hello" message
         with conn:
             while not self._stop.is_set():
                 try:
@@ -232,8 +249,11 @@ class PSServer:
                     break
                 if msg is None:
                     break
+                if rank_holder[0] is not None:
+                    with self._lock:
+                        self._last_seen[rank_holder[0]] = time.monotonic()
                 try:
-                    reply = self._handle(msg)
+                    reply = self._handle(msg, rank_holder)
                 except Exception as e:  # surface server errors to the worker
                     reply = ("err", repr(e))
                 try:
@@ -336,6 +356,21 @@ class ShardedPSClient:
     def command(self, head, body):
         for c in self.clients:
             c.request("command", head, body)
+
+    def hello(self, rank):
+        """Register this worker's rank with every shard for heartbeat
+        tracking (later requests on these connections refresh it)."""
+        for c in self.clients:
+            c.request("hello", rank)
+
+    def dead_nodes(self, timeout=60.0):
+        """Ranks not heard from within ``timeout`` seconds on ANY shard
+        (a rank alive on one shard is alive)."""
+        dead = None
+        for c in self.clients:
+            d = set(c.request("dead_nodes", timeout))
+            dead = d if dead is None else (dead & d)
+        return sorted(dead or ())
 
     def get_states(self):
         """Merged server-side optimizer states across all shards."""

@@ -23,7 +23,8 @@ import numpy as np
 from .base import MXNetError, dtype_name, np_dtype
 from .ops import OP_REGISTRY
 
-__all__ = ["Symbol", "Variable", "Group", "load", "load_json", "AttrScope", "NameManager"]
+__all__ = ["Symbol", "Variable", "Group", "load", "load_json", "AttrScope",
+           "NameManager", "Prefix"]
 
 
 class AttrScope:
@@ -75,6 +76,27 @@ class NameManager:
         idx = self._counter.get(hint, 0)
         self._counter[hint] = idx + 1
         return f"{hint}{idx}"
+
+    def __enter__(self):
+        self._old = getattr(NameManager._current, "value", None)
+        NameManager._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        NameManager._current.value = self._old
+        return False
+
+
+class Prefix(NameManager):
+    """NameManager that prepends a prefix to every auto name
+    (python/mxnet/name.py Prefix)."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def next_name(self, hint: str) -> str:
+        return self._prefix + super().next_name(hint)
 
 
 class Node:

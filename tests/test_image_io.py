@@ -1,6 +1,7 @@
 """ImageRecordIter pipeline over a synthetic packed .rec dataset
 (rebuild of tests/python/unittest/test_io.py's ImageRecordIter case)."""
 
+import os
 import numpy as np
 import pytest
 
@@ -150,3 +151,21 @@ def test_cv_preserves_float_dtype():
     np.testing.assert_allclose(out.asnumpy(), 300.0)   # no uint8 wraparound
     pad = mx.cv.copyMakeBorder(img, 1, 1, 1, 1)
     assert pad.dtype == np.float32
+
+
+def test_image_record_iter_mean_image_first_run(rec_file, tmp_path):
+    """mean_img is computed over the partition and saved on first run,
+    then loaded on subsequent runs (iter_normalize.h behavior)."""
+    mean_path = str(tmp_path / "mean.params")
+    it = ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
+                         batch_size=8, mean_img=mean_path)
+    assert os.path.exists(mean_path)
+    mean = mx.nd.load(mean_path)["mean_img"].asnumpy()
+    assert mean.shape == (3, 32, 32)
+    assert 0 < mean.mean() < 255
+    batch = next(iter(it))
+    # images are mean-subtracted: batch mean is near zero vs raw ~90
+    assert abs(batch.data[0].asnumpy().mean()) < 30
+    it2 = ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
+                          batch_size=8, mean_img=mean_path)
+    np.testing.assert_allclose(it2._mean, mean)

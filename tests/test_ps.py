@@ -185,3 +185,23 @@ def test_dist_async_kvstore_via_launcher(n_servers):
     assert r.returncode == 0, out[-3000:]
     assert "RANK_0_PS_OK" in out
     assert "RANK_1_PS_OK" in out
+
+
+def test_ps_heartbeat_dead_nodes():
+    """Heartbeat tracking: a silent worker shows up in dead_nodes after
+    the timeout, an active one does not (ps-lite GetDeadNodes analog)."""
+    import time as _time
+
+    servers, mk = _start(num_workers=2)
+    c1, c2 = mk(), mk()
+    try:
+        c1.hello(0)
+        c2.hello(1)
+        assert c1.dead_nodes(timeout=60.0) == []
+        _time.sleep(0.25)
+        # rank 0 stays chatty; rank 1 goes silent
+        c1.init("hb", np.zeros(1, np.float32))
+        assert c1.dead_nodes(timeout=0.2) == [1]
+        assert c1.dead_nodes(timeout=60.0) == []
+    finally:
+        _stop(servers, [c1, c2])

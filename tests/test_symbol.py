@@ -115,3 +115,13 @@ def test_attr_dict_json():
     js = fc.tojson()
     fc2 = mx.sym.load_json(js)
     assert fc2.attr_dict()["fc"]["lr_mult"] == "2"
+
+
+def test_name_manager_prefix():
+    """mx.sym.Prefix scopes auto-generated names (name.py Prefix)."""
+    with mx.sym.Prefix("block1_"):
+        a = mx.sym.Variable("x")
+        s = mx.sym.FullyConnected(a, num_hidden=4)
+    assert s.list_outputs()[0].startswith("block1_fullyconnected")
+    s2 = mx.sym.FullyConnected(mx.sym.Variable("y"), num_hidden=4)
+    assert not s2.list_outputs()[0].startswith("block1_")
