@@ -287,6 +287,10 @@ class DistPSKVStore(KVStore):
         self._client = ShardedPSClient(addrs.split(","))
         self._rank = int(os.environ.get("MXTPU_PROC_ID", "0"))
         self._nproc = int(os.environ.get("MXTPU_NUM_PROCS", "1"))
+        # restarted workers skip startup barriers (reference ps-lite
+        # is_recovery, kvstore_dist.h:35-38) — the surviving peers are
+        # already past them
+        self._is_recovery = bool(os.environ.get("MXTPU_IS_RECOVERY"))
         self._client.hello(self._rank)
         # per-push sync flag (reference sends a server-global kSyncMode
         # command, kvstore.cc:29-38; per-push is strictly safer when two
@@ -308,9 +312,12 @@ class DistPSKVStore(KVStore):
                 raise MXNetError(f"key {k!r} already initialized")
             arr = vs[0].asnumpy()
             self._meta[k] = (arr.shape, arr.dtype)
-            if self._rank == 0:
-                self._client.init(k, arr)
-        self.barrier()
+            if self._rank == 0 or self._is_recovery:
+                # recovery inits are non-forcing: they must not clobber
+                # trained state on the servers
+                self._client.init(k, arr, force=not self._is_recovery)
+        if not self._is_recovery:
+            self.barrier()
 
     def push(self, key, value, priority=0):
         for k, vs in self._normalize(key, value):
@@ -332,9 +339,13 @@ class DistPSKVStore(KVStore):
         """Pickle the optimizer to every server shard — the reference's
         server-side-optimizer capability, restored."""
         self._optimizer = optimizer
-        if self._rank == 0:
+        if self._rank == 0 and not self._is_recovery:
+            # a recovering rank 0 must not replace the server updater —
+            # that would wipe accumulated momentum/Adam state the
+            # surviving workers are still training against
             self._client.command("set_optimizer", pickle.dumps(optimizer))
-        self.barrier()
+        if not self._is_recovery:
+            self.barrier()
 
     def save_optimizer_states(self, fname):
         """Optimizer states live on the servers in PS mode — fetch and
@@ -345,7 +356,8 @@ class DistPSKVStore(KVStore):
         if self._rank == 0:
             with open(fname, "wb") as f:
                 f.write(pickle.dumps(self._client.get_states()))
-        self.barrier()
+        if not self._is_recovery:
+            self.barrier()
 
     def load_optimizer_states(self, fname):
         if self._optimizer is None:
@@ -353,7 +365,8 @@ class DistPSKVStore(KVStore):
         if self._rank == 0:
             with open(fname, "rb") as f:
                 self._client.set_states(pickle.loads(f.read()))
-        self.barrier()
+        if not self._is_recovery:
+            self.barrier()
 
     def num_dead_node(self, node_id=0, timeout=60.0):
         """Count of workers whose heartbeat lapsed (reference

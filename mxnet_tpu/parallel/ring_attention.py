@@ -150,6 +150,26 @@ def _build_ring_run(mesh: Mesh, axis: str, scale: float, causal: bool,
     return run
 
 
+_FLASH_AVAILABLE = None
+
+
+def _flash_available():
+    """One-time probe: compile+run the Pallas kernel on a tiny shape so
+    'auto' can fall back to the XLA body if Mosaic lowering fails on
+    this backend/driver combo rather than erroring mid-training."""
+    global _FLASH_AVAILABLE
+    if _FLASH_AVAILABLE is None:
+        try:
+            from ..ops.flash_attention import flash_attention
+
+            x = jnp.zeros((1, 1, 128, 8), jnp.float32)
+            jax.block_until_ready(flash_attention(x, x, x))
+            _FLASH_AVAILABLE = True
+        except Exception:
+            _FLASH_AVAILABLE = False
+    return _FLASH_AVAILABLE
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
                    impl="auto", block_q=128, block_k=128):
     """Sharded multi-head attention over a sequence-parallel mesh axis.
@@ -172,7 +192,8 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
     if impl == "auto":
         fits = (S_blk % min(block_q, S_blk) == 0
                 and S_blk % min(block_k, S_blk) == 0)
-        impl = "flash" if (not interpret and fits) else "xla"
+        impl = ("flash" if (not interpret and fits and _flash_available())
+                else "xla")
     run = _build_ring_run(mesh, axis, scale, bool(causal), impl,
                           block_q, block_k, interpret)
 

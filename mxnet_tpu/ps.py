@@ -183,9 +183,14 @@ class PSServer:
                               if now - t > timeout)
             return ("ok", dead)
         if op == "init":
-            _, key, value = msg
+            _, key, value, force = msg
             with self._lock:
-                self.store[key] = np.array(value)
+                # force (fresh jobs) overwrites; recovery inits are
+                # no-ops when the key exists, so a restarted worker
+                # cannot clobber trained state (reference is_recovery
+                # rejoin — servers keep state, late inits are ignored)
+                if force or key not in self.store:
+                    self.store[key] = np.array(value)
             return ("ok",)
         if op == "push":
             _, key, value, sync = msg
@@ -248,6 +253,11 @@ class PSServer:
                 except OSError:
                     break
                 if msg is None:
+                    # clean close: deregister so a finished worker is not
+                    # a permanent dead_nodes false positive
+                    if rank_holder[0] is not None:
+                        with self._lock:
+                            self._last_seen.pop(rank_holder[0], None)
                     break
                 if rank_holder[0] is not None:
                     with self._lock:
@@ -319,15 +329,15 @@ class ShardedPSClient:
         return [(f"{key}#stripe{i}", bounds[i], bounds[i + 1])
                 for i in range(n)]
 
-    def init(self, key, value):
+    def init(self, key, value, force=True):
         value = np.asarray(value)
         stripes = self._stripes(key, value.size)
         if stripes is None:
-            self._shard(key).request("init", key, value)
+            self._shard(key).request("init", key, value, force)
             return
         flat = value.reshape(-1)
         for c, (skey, lo, hi) in zip(self.clients, stripes):
-            c.request("init", skey, flat[lo:hi])
+            c.request("init", skey, flat[lo:hi], force)
 
     def push(self, key, value, sync=False):
         value = np.asarray(value)

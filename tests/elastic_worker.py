@@ -1,0 +1,61 @@
+"""Worker for the elastic-recovery test: rank 1 crashes partway through
+its first life (before pushing), the launcher respawns it with
+MXTPU_IS_RECOVERY set, and the restarted worker rejoins — re-init is a
+server-side no-op and startup barriers are skipped (reference ps-lite
+is_recovery: servers keep state, restarted nodes skip the barrier) —
+then training completes exactly.
+
+Launched by test_ps.py via tools/launch.py -n 2 -s 1 --max-restarts 1.
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    rank = int(os.environ["MXTPU_PROC_ID"])
+    marker = os.environ["ELASTIC_MARKER"] + f".rank{rank}"
+    first_life = not os.path.exists(marker)
+    if first_life:
+        with open(marker, "w") as f:
+            f.write("seen")
+
+    kv = mx.kv.create("dist_async")
+    shape = (3, 2)
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0, rescale_grad=1.0))
+    kv.init("w", mx.nd.zeros(shape))
+
+    if rank == 1 and first_life:
+        # crash before contributing; the launcher must respawn us
+        os._exit(3)
+
+    # server-side SGD: w -= grad per push; both contributions -> -3 exactly
+    kv.push("w", mx.nd.ones(shape) * (rank + 1))
+
+    expect = -3.0
+    out = mx.nd.zeros(shape)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        kv.pull("w", out=out)
+        if abs(float(out.asnumpy()[0, 0]) - expect) < 1e-6:
+            print(f"RANK_{rank}_ELASTIC_OK", flush=True)
+            return
+        time.sleep(0.1)
+    raise AssertionError(
+        f"rank {rank}: never saw {expect}, last {out.asnumpy()[0, 0]}")
+
+
+if __name__ == "__main__":
+    main()

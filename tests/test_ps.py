@@ -205,3 +205,29 @@ def test_ps_heartbeat_dead_nodes():
         assert c1.dead_nodes(timeout=60.0) == []
     finally:
         _stop(servers, [c1, c2])
+
+
+def test_elastic_worker_restart(tmp_path):
+    """A worker crash is absorbed: tools/launch.py --max-restarts 1
+    respawns the rank with MXTPU_IS_RECOVERY; the PS keeps state, the
+    re-init is a no-op, and both workers' updates land exactly."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("MXTPU_COORDINATOR", None)
+    env.pop("MXTPU_PS_ADDRS", None)
+    env.pop("MXTPU_IS_RECOVERY", None)
+    env["ELASTIC_MARKER"] = str(tmp_path / "life")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"),
+         "-n", "2", "-s", "1", "--max-restarts", "1", "--",
+         sys.executable, os.path.join(repo, "tests", "elastic_worker.py")],
+        capture_output=True, text=True, timeout=280, env=env)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    assert "RANK_0_ELASTIC_OK" in out
+    assert "RANK_1_ELASTIC_OK" in out
+    assert "restart 1/1" in out   # the crash actually happened

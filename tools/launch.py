@@ -81,10 +81,25 @@ def _spawn_servers(num_servers, num_workers):
         raise
 
 
-def launch_local(n, command, extra_env=None, num_servers=0):
-    """Spawn n local processes with distinct ranks; returns exit code."""
+def launch_local(n, command, extra_env=None, num_servers=0, max_restarts=0):
+    """Spawn n local processes with distinct ranks; returns exit code.
+
+    With ``max_restarts`` > 0 a worker that exits nonzero is respawned
+    under the same rank (elastic recovery: PS servers keep state and
+    treat the restarted worker's re-init as a no-op, the reference's
+    ps-lite is_recovery contract).  Only meaningful with ``-s`` servers;
+    collectives-backed jobs cannot absorb a member restart.
+    """
+    import time
+
+    if max_restarts and not num_servers:
+        raise ValueError(
+            "--max-restarts requires -s servers: a collectives-backed job "
+            "cannot absorb a member restart (the jax.distributed world is "
+            "already formed); it would hang instead of failing fast")
     coordinator = f"127.0.0.1:{_free_port()}"
-    procs = []
+    procs = {}
+    restarts = {rank: 0 for rank in range(n)}
     server_procs = []
     extra = dict(extra_env or {})
     try:
@@ -92,14 +107,33 @@ def launch_local(n, command, extra_env=None, num_servers=0):
             server_procs, addrs = _spawn_servers(num_servers, n)
             extra["MXTPU_PS_ADDRS"] = addrs
         for rank in range(n):
-            procs.append(subprocess.Popen(
-                command, env=_child_env(coordinator, n, rank, extra)))
+            procs[rank] = subprocess.Popen(
+                command, env=_child_env(coordinator, n, rank, extra))
         code = 0
-        for p in procs:
-            code = p.wait() or code
+        pending = set(procs)
+        while pending:
+            for rank in sorted(pending):
+                rc = procs[rank].poll()
+                if rc is None:
+                    continue
+                if rc != 0 and restarts[rank] < max_restarts:
+                    restarts[rank] += 1
+                    sys.stderr.write(
+                        f"worker rank {rank} exited rc={rc}; restart "
+                        f"{restarts[rank]}/{max_restarts}\n")
+                    # reference is_recovery contract: the restarted node
+                    # knows to skip startup barriers
+                    renv = dict(extra)
+                    renv["MXTPU_IS_RECOVERY"] = "1"
+                    procs[rank] = subprocess.Popen(
+                        command, env=_child_env(coordinator, n, rank, renv))
+                else:
+                    code = rc or code
+                    pending.discard(rank)
+            time.sleep(0.1)
         return code
     finally:
-        for p in procs + server_procs:
+        for p in list(procs.values()) + server_procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
 
@@ -133,6 +167,10 @@ def main(argv=None):
     p.add_argument("-s", "--num-servers", type=int, default=0,
                    help="parameter-server shards for dist_async/dist_sync "
                         "PS mode (reference dmlc tracker -s)")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="respawn a crashed worker under the same rank up "
+                        "to N times (PS mode keeps state; is_recovery "
+                        "analog)")
     p.add_argument("-H", "--hostfile", default=None,
                    help="one host per line; enables ssh mode")
     p.add_argument("--launcher", choices=["local", "ssh"], default=None)
@@ -150,7 +188,8 @@ def main(argv=None):
             p.error("ssh mode needs -H hostfile")
         return launch_ssh(args.hostfile, command, args.sync_dir, args.username)
     return launch_local(args.num_workers, command,
-                        num_servers=args.num_servers)
+                        num_servers=args.num_servers,
+                        max_restarts=args.max_restarts)
 
 
 if __name__ == "__main__":
