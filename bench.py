@@ -92,10 +92,19 @@ def main():
 
     batch = batch_per_chip * n_chips
     layout = os.environ.get("BENCH_LAYOUT", "NHWC" if on_tpu else "NCHW")
+    # space-to-depth stem (input pre-transformed to H/2 x W/2 x 4C) keeps
+    # the stem conv dense on the MXU; standard for TPU ResNet training
+    stem = os.environ.get(
+        "BENCH_STEM", "s2d" if on_tpu and layout == "NHWC" else "conv7")
     net = mx.models.resnet(num_classes=1000, num_layers=50,
-                           image_shape=(3, image_hw, image_hw), layout=layout)
-    data_shape = ((batch, image_hw, image_hw, 3) if layout == "NHWC"
-                  else (batch, 3, image_hw, image_hw))
+                           image_shape=(3, image_hw, image_hw), layout=layout,
+                           stem=stem)
+    if stem == "s2d":
+        data_shape = (batch, image_hw // 2, image_hw // 2, 12)
+    elif layout == "NHWC":
+        data_shape = (batch, image_hw, image_hw, 3)
+    else:
+        data_shape = (batch, 3, image_hw, image_hw)
 
     mesh = mx.parallel.local_mesh("dp")
     trainer = mx.parallel.ShardedTrainer(
@@ -144,6 +153,7 @@ def main():
         "n_chips": n_chips,
         "dtype": dtype,
         "layout": layout,
+        "stem": stem,
         "platform": "tpu" if on_tpu else jax.devices()[0].platform,
     }
     print(json.dumps(result))

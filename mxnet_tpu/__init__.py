@@ -51,6 +51,7 @@ from . import visualization as viz
 from . import test_utils
 from . import operator
 from . import rtc
+from . import resource
 from . import parallel
 from . import models
 from . import predict

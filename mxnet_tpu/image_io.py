@@ -166,13 +166,27 @@ class ImageRecordIter(DataIter):
         over the FULL record set, threaded — and writes atomically;
         other partitions wait for the file to appear so concurrent
         workers neither race the write nor get shard-biased means."""
+        marker = self._mean_img_path + ".inprogress"
         if part_index != 0:
             deadline = time.monotonic() + wait_s
-            while time.monotonic() < deadline:
+            while True:
                 if os.path.exists(self._mean_img_path):
                     self._mean = nd.load(
                         self._mean_img_path)["mean_img"].asnumpy()
                     return
+                # a fresh in-progress marker means partition 0 shares
+                # our filesystem and is still grinding through a large
+                # record set — keep waiting past the base deadline
+                # rather than N partitions each recomputing the full
+                # mean (the marker's mtime is refreshed as it works)
+                if time.monotonic() >= deadline:
+                    try:
+                        still_working = (time.time()
+                                         - os.path.getmtime(marker) < 60.0)
+                    except OSError:
+                        still_working = False
+                    if not still_working:
+                        break
                 time.sleep(0.2)
             # no shared filesystem with partition 0 (ssh multi-host):
             # compute locally over the full set — duplicate work, same
@@ -202,21 +216,45 @@ class ImageRecordIter(DataIter):
                 readers.append(local.reader)
             return one(off)
 
+        def touch_marker():
+            try:
+                with open(marker, "a"):
+                    os.utime(marker, None)
+            except OSError:
+                pass  # best effort; waiters fall back to the deadline
+
+        touch_marker()
         total = np.zeros(self.data_shape, np.float64)
         count = 0
-        with ThreadPoolExecutor(max_workers=self._threads,
-                                thread_name_prefix="meanimg") as pool:
-            for chw in pool.map(one_threaded, all_offsets):
-                if chw is not None:
-                    total += chw
-                    count += 1
-        for r in readers:
-            r.close()
-        mean = (total / max(count, 1)).astype(np.float32)
-        tmp = self._mean_img_path + ".tmp"
-        nd.save(tmp, {"mean_img": nd.array(mean)})
-        os.replace(tmp, self._mean_img_path)
-        self._mean = mean
+        last_touch = time.monotonic()
+        try:
+            with ThreadPoolExecutor(max_workers=self._threads,
+                                    thread_name_prefix="meanimg") as pool:
+                for chw in pool.map(one_threaded, all_offsets):
+                    if chw is not None:
+                        total += chw
+                        count += 1
+                    # time-based heartbeat: waiters treat the marker as
+                    # stale after 60s, and record decode rate varies too
+                    # much for a per-N-records rule (slow NFS can take
+                    # minutes per batch of records)
+                    if time.monotonic() - last_touch > 5.0:
+                        touch_marker()
+                        last_touch = time.monotonic()
+            for r in readers:
+                r.close()
+            mean = (total / max(count, 1)).astype(np.float32)
+            # pid-unique tmp: partitions that both fell back to local
+            # compute must not truncate each other mid-write
+            tmp = f"{self._mean_img_path}.tmp.{os.getpid()}"
+            nd.save(tmp, {"mean_img": nd.array(mean)})
+            os.replace(tmp, self._mean_img_path)
+            self._mean = mean
+        finally:
+            try:
+                os.remove(marker)
+            except OSError:
+                pass
 
     def _reset_order(self):
         self._order = np.arange(len(self._offsets))

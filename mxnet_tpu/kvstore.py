@@ -297,6 +297,17 @@ class DistPSKVStore(KVStore):
         # stores share the same servers)
         self._sync = "async" not in kind
         self._meta = {}          # key -> (shape, dtype)
+        # clean process exit must send the explicit "bye" (a bare EOF is
+        # treated as a crash by the server's dead-node tracking)
+        import atexit
+
+        atexit.register(self.close)
+
+    def close(self):
+        """Deregister from the servers; idempotent."""
+        client, self._client = getattr(self, "_client", None), None
+        if client is not None:
+            client.close()
 
     @property
     def rank(self):
@@ -307,6 +318,7 @@ class DistPSKVStore(KVStore):
         return self._nproc
 
     def init(self, key, value):
+        all_existed = True
         for k, vs in self._normalize(key, value):
             if k in self._meta:
                 raise MXNetError(f"key {k!r} already initialized")
@@ -315,11 +327,26 @@ class DistPSKVStore(KVStore):
             if self._rank == 0 or self._is_recovery:
                 # recovery inits are non-forcing: they must not clobber
                 # trained state on the servers
-                self._client.init(k, arr, force=not self._is_recovery)
-        if not self._is_recovery:
-            self.barrier()
+                existed = self._client.init(k, arr,
+                                            force=not self._is_recovery)
+                all_existed = all_existed and existed
+        if self._is_recovery and not all_existed:
+            import logging
+
+            logging.warning(
+                "recovery: servers were missing initialized keys — the "
+                "previous life crashed before startup completed")
+        # Always barrier: rounds the previous life already passed return
+        # instantly (generation-numbered on the server), and the first
+        # round the peers are still waiting in gets its missing member —
+        # both post- and mid-startup crashes recover without deadlock.
+        self.barrier()
 
     def push(self, key, value, priority=0):
+        # first push == the training loop has begun: the startup re-join
+        # (reference ps-lite is_recovery) is over, so later init /
+        # set_optimizer calls get fresh-start semantics again
+        self._is_recovery = False
         for k, vs in self._normalize(key, value):
             if k not in self._meta:
                 raise MXNetError(f"key {k!r} not initialized")
@@ -339,13 +366,17 @@ class DistPSKVStore(KVStore):
         """Pickle the optimizer to every server shard — the reference's
         server-side-optimizer capability, restored."""
         self._optimizer = optimizer
-        if self._rank == 0 and not self._is_recovery:
-            # a recovering rank 0 must not replace the server updater —
-            # that would wipe accumulated momentum/Adam state the
-            # surviving workers are still training against
-            self._client.command("set_optimizer", pickle.dumps(optimizer))
-        if not self._is_recovery:
-            self.barrier()
+        if self._rank == 0 or self._is_recovery:
+            # A recovering worker (any rank) re-sends the optimizer with
+            # if-unset semantics: if the first life crashed before the
+            # updater reached the servers, raw-gradient pushes would
+            # silently be assigned as weights; if it IS installed, the
+            # accumulated momentum/Adam state the surviving workers are
+            # training against must not be wiped.
+            head = ("set_optimizer_if_unset" if self._is_recovery
+                    else "set_optimizer")
+            self._client.command(head, pickle.dumps(optimizer))
+        self.barrier()
 
     def save_optimizer_states(self, fname):
         """Optimizer states live on the servers in PS mode — fetch and
@@ -356,8 +387,7 @@ class DistPSKVStore(KVStore):
         if self._rank == 0:
             with open(fname, "wb") as f:
                 f.write(pickle.dumps(self._client.get_states()))
-        if not self._is_recovery:
-            self.barrier()
+        self.barrier()
 
     def load_optimizer_states(self, fname):
         if self._optimizer is None:
@@ -365,8 +395,7 @@ class DistPSKVStore(KVStore):
         if self._rank == 0:
             with open(fname, "rb") as f:
                 self._client.set_states(pickle.loads(f.read()))
-        if not self._is_recovery:
-            self.barrier()
+        self.barrier()
 
     def num_dead_node(self, node_id=0, timeout=60.0):
         """Count of workers whose heartbeat lapsed (reference

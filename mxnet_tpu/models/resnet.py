@@ -63,16 +63,43 @@ def residual_unit(data, num_filter, stride, dim_match, name, bottle_neck=True,
 
 
 def resnet(units, num_stage, filter_list, num_class, bottle_neck=True,
-           bn_mom=0.9, workspace=512, small_input=False, layout="NCHW"):
+           bn_mom=0.9, workspace=512, small_input=False, layout="NCHW",
+           stem="conv7"):
     bn_axis = -1 if layout == "NHWC" else 1
     data = mx_sym.Variable("data")
     data = mx_sym.BatchNorm(data, fix_gamma=True, eps=2e-5, momentum=bn_mom,
                             axis=bn_axis, name="bn_data")
+    if small_input and stem != "conv7":
+        raise ValueError(
+            f"stem={stem!r} conflicts with small_input: the cifar-style "
+            "3x3 stem takes raw HxWxC images, not s2d-transformed input")
     if small_input:  # cifar-style stem
         body = mx_sym.Convolution(data, layout=layout, num_filter=filter_list[0],
                                   kernel=(3, 3), stride=(1, 1), pad=(1, 1),
                                   no_bias=True, name="conv0",
                                   workspace=workspace)
+    elif stem == "s2d":
+        # Space-to-depth stem (the standard TPU trick): the caller feeds
+        # data already transformed to (N, H/2, W/2, 4C) NHWC, and the
+        # 7x7/s2 conv becomes a dense 4x4/s1 conv — C=3 wastes all but 3
+        # of the MXU's 128 input lanes; C=12 with stride 1 is 4x denser
+        # and removes the strided backward pass.  Receptive field
+        # matches the 7x7 (8x8 zero-padded) conv; train-from-scratch
+        # equivalent, not checkpoint-compatible with stem="conv7".
+        if layout != "NHWC":
+            raise ValueError("s2d stem requires NHWC layout")
+        body = mx_sym.Pad(data, mode="constant",
+                          pad_width=(0, 0, 2, 1, 2, 1, 0, 0))
+        body = mx_sym.Convolution(body, layout=layout,
+                                  num_filter=filter_list[0],
+                                  kernel=(4, 4), stride=(1, 1), pad=(0, 0),
+                                  no_bias=True, name="conv0",
+                                  workspace=workspace)
+        body = mx_sym.BatchNorm(body, fix_gamma=False, eps=2e-5,
+                                momentum=bn_mom, axis=bn_axis, name="bn0")
+        body = mx_sym.Activation(body, act_type="relu", name="relu0")
+        body = mx_sym.Pooling(body, layout=layout, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                              pool_type="max")
     else:  # imagenet stem
         body = mx_sym.Convolution(data, layout=layout, num_filter=filter_list[0],
                                   kernel=(7, 7), stride=(2, 2), pad=(3, 3),
@@ -114,7 +141,7 @@ _DEPTH_CONFIGS = {
 
 
 def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
-               workspace=512, layout="NCHW"):
+               workspace=512, layout="NCHW", stem="conv7"):
     if num_layers not in _DEPTH_CONFIGS:
         raise ValueError(f"unsupported depth {num_layers}")
     units, bottle_neck = _DEPTH_CONFIGS[num_layers]
@@ -125,4 +152,5 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
     small = image_shape[-1] < 64
     return resnet(units=units, num_stage=4, filter_list=filter_list,
                   num_class=num_classes, bottle_neck=bottle_neck,
-                  workspace=workspace, small_input=small, layout=layout)
+                  workspace=workspace, small_input=small, layout=layout,
+                  stem=stem)

@@ -20,6 +20,8 @@ TPU-native lowering notes:
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -275,6 +277,69 @@ class BatchNormParam(Params):
     axis = field(int, default=1, doc="channel axis (use -1 for NHWC)")
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train(x, gamma, beta, axes, eps):
+    """Fused training-mode batchnorm; returns (y, mean, var).
+
+    mean/var are exposed for the moving-stat update (callers
+    stop_gradient them; their cotangents are ignored in the VJP)."""
+    (y, mean, var, _), _ = _bn_train_fwd(x, gamma, beta, axes, eps)
+    return y, mean, var
+
+
+def _bn_stats(x, axes, eps):
+    """One-pass batch statistics: sibling sum/sum-of-squares reductions
+    fuse into a single read of ``x`` (f32 accumulation over bf16 reads),
+    where mean-then-variance would read the activations twice."""
+    n = 1
+    for i in axes:
+        n *= x.shape[i]
+    xf = x.astype(jnp.float32)
+    s = jnp.sum(xf, axis=axes)
+    s2 = jnp.sum(lax.square(xf), axis=axes)
+    mean = s / n
+    var = jnp.maximum(s2 / n - lax.square(mean), 0.0)
+    inv = lax.rsqrt(var + eps)
+    return mean, var, inv, n
+
+
+def _bn_train_fwd(x, gamma, beta, axes, eps):
+    mean, var, inv, _ = _bn_stats(x, axes, eps)
+    ax = [i for i in range(x.ndim) if i not in axes]
+    shape = tuple(x.shape[i] if i in ax else 1 for i in range(x.ndim))
+    a = gamma.astype(jnp.float32) * inv
+    b = beta.astype(jnp.float32) - mean * a
+    y = (x.astype(jnp.float32) * a.reshape(shape) + b.reshape(shape)).astype(x.dtype)
+    return (y, mean, var, inv), (x, gamma, mean, inv)
+
+
+def _bn_train_bwd(axes, eps, res, cts):
+    dy = cts[0]  # mean/var cotangents are zero (stop_gradient'd by callers)
+    x, gamma, mean, inv = res
+    ax = [i for i in range(x.ndim) if i not in axes]
+    shape = tuple(x.shape[i] if i in ax else 1 for i in range(x.ndim))
+    n = 1
+    for i in axes:
+        n *= x.shape[i]
+    dyf = dy.astype(jnp.float32)
+    xhat = (x.astype(jnp.float32) - mean.reshape(shape)) * inv.reshape(shape)
+    # sibling reductions: one fused pass over (dy, x)
+    dbeta = jnp.sum(dyf, axis=axes)
+    dgamma = jnp.sum(dyf * xhat, axis=axes)
+    a = (gamma.astype(jnp.float32) * inv).reshape(shape)
+    dx = a * (dyf - dbeta.reshape(shape) / n - xhat * dgamma.reshape(shape) / n)
+    return (dx.astype(x.dtype), dgamma.astype(gamma.dtype),
+            dbeta.astype(gamma.dtype))
+
+
+def _bn_train_vjp_fwd(x, gamma, beta, axes, eps):
+    (y, mean, var, _), res = _bn_train_fwd(x, gamma, beta, axes, eps)
+    return (y, mean, var), res
+
+
+_bn_train.defvjp(_bn_train_vjp_fwd, _bn_train_bwd)
+
+
 @register_op("BatchNorm", aliases=("CuDNNBatchNorm",))
 class BatchNormOp(OpDef):
     """Batch normalization over axis 1 (reference batch_norm-inl.h:314).
@@ -308,17 +373,17 @@ class BatchNormOp(OpDef):
         axes = tuple(i for i in range(x.ndim) if i != ax)
         shape = tuple(x.shape[i] if i == ax else 1 for i in range(x.ndim))
         if train and not params.use_global_stats:
-            xf = x.astype(jnp.float32)
-            mean = jnp.mean(xf, axis=axes)
-            var = jnp.var(xf, axis=axes)
+            # fused path: one-pass stats + hand-written backward formula
+            # (the cudnn_batch_norm-inl.h analog; autodiff through
+            # mean/var costs several extra HBM passes over activations)
+            y, mean, var = _bn_train(x, gamma, beta, axes, params.eps)
             m = params.momentum
             new_mean = (m * moving_mean + (1 - m) * mean).astype(moving_mean.dtype)
             new_var = (m * moving_var + (1 - m) * var).astype(moving_var.dtype)
-            use_mean, use_var = mean, var
             new_aux = [lax.stop_gradient(new_mean), lax.stop_gradient(new_var)]
-        else:
-            use_mean, use_var = moving_mean, moving_var
-            new_aux = [moving_mean, moving_var]
+            return [y], new_aux
+        use_mean, use_var = moving_mean, moving_var
+        new_aux = [moving_mean, moving_var]
         inv = lax.rsqrt(use_var.astype(jnp.float32) + params.eps)
         y = (x.astype(jnp.float32)
              - use_mean.astype(jnp.float32).reshape(shape)) * inv.reshape(shape)

@@ -162,8 +162,15 @@ def _flash_available():
         try:
             from ..ops.flash_attention import flash_attention
 
-            x = jnp.zeros((1, 1, 128, 8), jnp.float32)
-            jax.block_until_ready(flash_attention(x, x, x))
+            # ensure_compile_time_eval: ring_attention is routinely
+            # called inside a jitted train step, where a plain probe
+            # would be staged into the outer trace (never actually
+            # compiled/run here) and block_until_ready on the tracer
+            # would no-op — caching True without exercising Mosaic.
+            # head_dim 128 matches the MXU lane layout real models use.
+            with jax.ensure_compile_time_eval():
+                x = jnp.zeros((1, 1, 128, 128), jnp.float32)
+                jax.block_until_ready(flash_attention(x, x, x))
             _FLASH_AVAILABLE = True
         except Exception:
             _FLASH_AVAILABLE = False

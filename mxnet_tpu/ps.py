@@ -175,6 +175,15 @@ class PSServer:
             with self._lock:
                 self._last_seen[int(msg[1])] = time.monotonic()
             return ("ok",)
+        if op == "bye":
+            # explicit clean-close: only a deliberate goodbye deregisters
+            # the rank — a bare EOF (crash/SIGKILL also closes the
+            # socket) must keep it tracked so dead_nodes reports it
+            if rank_holder is not None and rank_holder[0] is not None:
+                with self._lock:
+                    self._last_seen.pop(rank_holder[0], None)
+                rank_holder[0] = None
+            return ("ok",)
         if op == "dead_nodes":
             timeout = float(msg[1])
             now = time.monotonic()
@@ -188,10 +197,13 @@ class PSServer:
                 # force (fresh jobs) overwrites; recovery inits are
                 # no-ops when the key exists, so a restarted worker
                 # cannot clobber trained state (reference is_recovery
-                # rejoin — servers keep state, late inits are ignored)
-                if force or key not in self.store:
+                # rejoin — servers keep state, late inits are ignored).
+                # Reports whether the key already existed so recovering
+                # workers can verify the crash postdated startup.
+                existed = key in self.store
+                if force or not existed:
                     self.store[key] = np.array(value)
-            return ("ok",)
+            return ("ok", existed)
         if op == "push":
             _, key, value, sync = msg
             self._handle_push(key, np.asarray(value), sync)
@@ -207,7 +219,19 @@ class PSServer:
                 return ("err", f"key {msg[1]!r} not initialized")
             return ("ok", val)
         if op == "barrier":
+            # Generation-numbered: the client sends its own barrier
+            # ordinal.  A generation the server has already released
+            # returns immediately, which is what makes worker recovery
+            # safe — a restarted worker replays its startup barriers
+            # (instant no-ops for rounds its peers already passed) and
+            # genuinely joins the first round still pending, instead of
+            # skipping barriers wholesale and deadlocking survivors
+            # that crashed mid-startup.  Legacy 1-tuple requests keep
+            # the plain counting behavior.
+            client_gen = msg[1] if len(msg) > 1 else None
             with self._cond:
+                if client_gen is not None and client_gen <= self._barrier_gen:
+                    return ("ok",)  # round already released
                 self._barrier_count += 1
                 gen = self._barrier_gen
                 if self._barrier_count == self.num_workers:
@@ -220,12 +244,17 @@ class PSServer:
             return ("ok",)
         if op == "command":
             _, head, body = msg
-            if head == "set_optimizer":
+            if head in ("set_optimizer", "set_optimizer_if_unset"):
                 from .optimizer import get_updater
 
                 optimizer = pickle.loads(body)
                 with self._lock:
-                    self.updater = get_updater(optimizer)
+                    # the _if_unset variant is the recovery path: a
+                    # restarted rank 0 re-sends the optimizer, but must
+                    # not wipe accumulated momentum/Adam state when the
+                    # first life already installed it
+                    if head == "set_optimizer" or self.updater is None:
+                        self.updater = get_updater(optimizer)
             elif head == "get_states":
                 # optimizer states live server-side; expose them so
                 # workers can checkpoint (save_optimizer_states)
@@ -253,11 +282,9 @@ class PSServer:
                 except OSError:
                     break
                 if msg is None:
-                    # clean close: deregister so a finished worker is not
-                    # a permanent dead_nodes false positive
-                    if rank_holder[0] is not None:
-                        with self._lock:
-                            self._last_seen.pop(rank_holder[0], None)
+                    # EOF without a "bye": a crashed worker's kernel
+                    # closes the socket too — keep the rank registered
+                    # so its lapsed heartbeat surfaces in dead_nodes
                     break
                 if rank_holder[0] is not None:
                     with self._lock:
@@ -287,6 +314,7 @@ class PSClient:
         host, port = addr.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)))
         self._lock = threading.Lock()
+        self._barrier_ordinal = 0
 
     def request(self, *msg):
         with self._lock:
@@ -299,6 +327,12 @@ class PSClient:
         return reply[1] if len(reply) > 1 else None
 
     def close(self):
+        try:
+            # deliberate goodbye so the server deregisters this rank
+            # (a bare socket close is indistinguishable from a crash)
+            self.request("bye")
+        except (OSError, ConnectionError, RuntimeError):
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -330,14 +364,17 @@ class ShardedPSClient:
                 for i in range(n)]
 
     def init(self, key, value, force=True):
+        """Initialize ``key``; returns True when every shard already
+        held it (used by recovery to verify servers kept state)."""
         value = np.asarray(value)
         stripes = self._stripes(key, value.size)
         if stripes is None:
-            self._shard(key).request("init", key, value, force)
-            return
+            return bool(self._shard(key).request("init", key, value, force))
         flat = value.reshape(-1)
+        existed = True
         for c, (skey, lo, hi) in zip(self.clients, stripes):
-            c.request("init", skey, flat[lo:hi], force)
+            existed &= bool(c.request("init", skey, flat[lo:hi], force))
+        return existed
 
     def push(self, key, value, sync=False):
         value = np.asarray(value)
@@ -360,8 +397,12 @@ class ShardedPSClient:
         return np.concatenate(parts).reshape(shape).astype(dtype, copy=False)
 
     def barrier(self):
+        # ordinal-stamped per connection: ranks issue barriers in the
+        # same (SPMD) order, so the ordinal identifies the round and a
+        # recovered worker's replayed rounds return instantly
         for c in self.clients:
-            c.request("barrier")
+            c._barrier_ordinal += 1
+            c.request("barrier", c._barrier_ordinal)
 
     def command(self, head, body):
         for c in self.clients:

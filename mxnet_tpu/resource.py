@@ -1,0 +1,192 @@
+"""Per-context resource manager: temp workspaces and PRNG resources.
+
+Rebuild of the reference ResourceManager (src/resource.cc:96-176,
+include/mxnet/resource.h): operators and user code request shared
+resources per context instead of allocating their own.  Two kinds,
+matching the reference's ``ResourceRequest::Type``:
+
+- ``temp_space``: a scratch buffer shared round-robin over
+  ``MXNET_TPU_EXEC_NUM_TEMP`` copies (reference ``MXNET_EXEC_NUM_TEMP``,
+  resource.cc:101).  On TPU, XLA owns device scratch; these are *host*
+  staging workspaces (pipeline collation, checkpoint IO, custom-op
+  scratch), drawn from the native storage pool (src/storage.cc) when
+  available.  Each copy owns an engine Var so engine-pushed host work
+  can declare a write dependency on the workspace it borrows — the
+  reference's per-resource ``engine var`` discipline (resource.cc:179+).
+- ``random``: a per-context deterministic PRNG chain (reference
+  ``ResourceRandom`` wrapping mshadow::Random, resource.cc:144-176),
+  here a JAX key chain forked from the global seed; ``seed()`` reseeds
+  every context's chain like ``MXRandomSeed``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from . import storage
+from .context import Context, current_context
+from .engine import get_engine
+
+__all__ = ["ResourceRequest", "Resource", "TempSpace", "RandomResource",
+           "ResourceManager", "request", "seed"]
+
+
+class ResourceRequest:
+    """What an operator asks for (reference resource.h ResourceRequest)."""
+
+    TEMP_SPACE = "temp_space"
+    RANDOM = "random"
+
+    def __init__(self, type):
+        if type not in (self.TEMP_SPACE, self.RANDOM):
+            raise ValueError(f"unknown resource type {type!r}")
+        self.type = type
+
+    def __repr__(self):
+        return f"ResourceRequest({self.type!r})"
+
+
+class Resource:
+    """Base resource handle: context + engine var for dependency tracking."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.var = get_engine().new_variable(name=f"resource@{ctx}")
+
+
+class TempSpace(Resource):
+    """A reusable host scratch buffer that grows to the largest request."""
+
+    def __init__(self, ctx: Context):
+        super().__init__(ctx)
+        self._buf = None
+        self._nbytes = 0
+        self._retired = []  # outgrown buffers; see get_space
+        self._lock = threading.Lock()
+
+    def get_space(self, shape, dtype=np.float32) -> np.ndarray:
+        """Borrow a scratch array of ``shape``; contents are undefined.
+
+        A growth reallocation logically invalidates previously borrowed
+        arrays, but their backing memory is parked (not returned to the
+        pool) until ``release()`` — a still-live view must never alias a
+        block the pool has re-issued.  Engine ops that borrow
+        concurrently must declare ``self.var`` mutable (the manager's
+        round-robin makes collisions rare, as in the reference's
+        kTempSpace discipline).
+        """
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        with self._lock:
+            if self._buf is None or nbytes > self._nbytes:
+                if self._buf is not None:
+                    self._retired.append(self._buf)
+                self._buf = storage.StagingBuffer((max(nbytes, 1),), np.uint8)
+                self._nbytes = nbytes
+            flat = self._buf.array[:nbytes]
+        return flat.view(dtype)[: int(np.prod(shape))].reshape(shape)
+
+    def release(self):
+        """Return backing memory to the pool.  Waits for engine ops that
+        declared this workspace's var before freeing, so queued borrows
+        finish first; callers must not use previously returned arrays
+        afterwards."""
+        get_engine().wait_for_var(self.var)
+        with self._lock:
+            bufs, self._retired = self._retired, []
+            if self._buf is not None:
+                bufs.append(self._buf)
+                self._buf = None
+                self._nbytes = 0
+        for b in bufs:
+            b.close()
+
+
+class RandomResource(Resource):
+    """Per-context deterministic key chain (ResourceRandom analog)."""
+
+    def __init__(self, ctx: Context, seed_state: int):
+        super().__init__(ctx)
+        self._lock = threading.Lock()
+        self.reseed(seed_state)
+
+    def reseed(self, seed_state: int):
+        import jax
+
+        # Fold the device id in so each context draws a distinct stream
+        # from the same global seed (reference seeds per-device Random
+        # with a per-device derived seed, common/utils.h).
+        with self._lock:
+            self._key = jax.random.fold_in(
+                jax.random.PRNGKey(int(seed_state)), self.ctx.device_id)
+
+    def next_key(self):
+        import jax
+
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+class ResourceManager:
+    """Singleton per-process manager (reference ResourceManagerImpl)."""
+
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self.num_temp = int(os.environ.get("MXNET_TPU_EXEC_NUM_TEMP", "1"))
+        self._temp = {}     # ctx -> [TempSpace] * num_temp
+        self._rand = {}     # ctx -> RandomResource
+        self._rr = {}       # ctx -> round-robin cursor
+        self._seed = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "ResourceManager":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+        return cls._instance
+
+    def request(self, ctx: Context, req) -> Resource:
+        if isinstance(req, str):
+            req = ResourceRequest(req)
+        with self._lock:
+            if req.type == ResourceRequest.RANDOM:
+                if ctx not in self._rand:
+                    self._rand[ctx] = RandomResource(ctx, self._seed)
+                return self._rand[ctx]
+            if ctx not in self._temp:
+                self._temp[ctx] = [TempSpace(ctx) for _ in range(self.num_temp)]
+                self._rr[ctx] = 0
+            i = self._rr[ctx]
+            self._rr[ctx] = (i + 1) % self.num_temp
+            return self._temp[ctx][i]
+
+    def seed(self, seed_state: int):
+        with self._lock:
+            self._seed = int(seed_state)
+            for r in self._rand.values():
+                r.reseed(seed_state)
+
+    def release_all(self):
+        """Drop temp buffers back to the pool (memory-pressure hook)."""
+        with self._lock:
+            for spaces in self._temp.values():
+                for s in spaces:
+                    s.release()
+        storage.release_all()
+
+
+def request(req, ctx: Context | None = None) -> Resource:
+    """Module-level convenience: ``mx.resource.request("temp_space")``."""
+    return ResourceManager.get().request(ctx or current_context(), req)
+
+
+def seed(seed_state: int):
+    """Reseed every context's random resource (MXRandomSeed analog)."""
+    ResourceManager.get().seed(seed_state)

@@ -441,6 +441,7 @@ def _infer_graph(topo, known, what, partial):
             for (src, idx), v in zip(node.inputs[n_args:], auxs):
                 if src.is_variable and v is not None and values.get(("var", src.name)) is None:
                     values["var", src.name] = tuple(v) if what == "shape" else v
+                    progress = True  # aux var nodes need a second pass
             # write back completed input values to variable sources
             for (src, idx), v in zip(node.inputs[:n_args], comp_in):
                 if src.is_variable and v is not None:

@@ -501,3 +501,47 @@ def test_parity_op_validation():
     x = rng.randn(2, 3).astype(np.float32)
     got = mx.nd.Custom(mx.nd.array(x), op_type="CamelCaseScale").asnumpy()
     assert reldiff(got, x * 3.0) < 1e-6
+
+
+def test_batchnorm_fused_backward_matches_autodiff():
+    """The hand-written BN VJP (ops/nn.py _bn_train_bwd) must agree with
+    autodiff through the naive two-pass formula."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(rng.randn(6, 3, 4, 5).astype(np.float32))
+    gamma = jnp.asarray(rng.rand(3).astype(np.float32) + 0.5)
+    beta = jnp.asarray(rng.randn(3).astype(np.float32))
+    dy = jnp.asarray(rng.randn(6, 3, 4, 5).astype(np.float32))
+    axes, eps = (0, 2, 3), 1e-3
+
+    from mxnet_tpu.ops.nn import _bn_train
+
+    def naive(x, gamma, beta):
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        shape = (1, 3, 1, 1)
+        xhat = (x - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape) + eps)
+        return xhat * gamma.reshape(shape) + beta.reshape(shape)
+
+    def fused(x, gamma, beta):
+        return _bn_train(x, gamma, beta, axes, eps)[0]
+
+    y_ref, vjp_ref = jax.vjp(naive, x, gamma, beta)
+    y_got, vjp_got = jax.vjp(fused, x, gamma, beta)
+    np.testing.assert_allclose(np.asarray(y_got), np.asarray(y_ref),
+                               rtol=1e-4, atol=1e-4)
+    for g_got, g_ref in zip(vjp_got(dy), vjp_ref(dy)):
+        np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_batchnorm_symbol_numeric_gradient():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, fix_gamma=False, eps=1e-3, name="bn")
+    check_numeric_gradient(
+        bn, {"data": rng.randn(4, 3, 2, 2), "bn_gamma": rng.rand(3) + 0.5,
+              "bn_beta": rng.randn(3)},
+        aux_states={"bn_moving_mean": np.zeros(3),
+                    "bn_moving_var": np.ones(3)})

@@ -207,6 +207,31 @@ def test_ps_heartbeat_dead_nodes():
         _stop(servers, [c1, c2])
 
 
+def test_ps_crash_vs_clean_close_dead_nodes():
+    """A bare socket close (crash) keeps the rank tracked so its lapsed
+    heartbeat surfaces in dead_nodes; an explicit close() (bye message)
+    deregisters it."""
+    import time as _time
+
+    servers, mk = _start(num_workers=3)
+    c0, c1, c2 = mk(), mk(), mk()
+    try:
+        c0.hello(0)
+        c1.hello(1)
+        c2.hello(2)
+        # rank 1 "crashes": raw socket close, no goodbye
+        for cl in c1.clients:
+            cl._sock.close()
+        # rank 2 exits cleanly
+        c2.close()
+        _time.sleep(0.25)
+        c0.init("k", np.zeros(1, np.float32))  # keep rank 0 fresh
+        dead = c0.dead_nodes(timeout=0.2)
+        assert dead == [1], dead
+    finally:
+        _stop(servers, [c0])
+
+
 def test_elastic_worker_restart(tmp_path):
     """A worker crash is absorbed: tools/launch.py --max-restarts 1
     respawns the rank with MXTPU_IS_RECOVERY; the PS keeps state, the
