@@ -52,6 +52,9 @@ from . import test_utils
 from . import operator
 from . import rtc
 from . import resource
+from . import caffe
+from . import sframe
+from . import symbol_doc
 from . import parallel
 from . import models
 from . import predict

@@ -1,0 +1,353 @@
+"""Caffe interop (rebuild of plugin/caffe, TPU-native).
+
+The reference plugin embeds libcaffe and runs Caffe layers inside the
+graph (plugin/caffe/caffe_op-inl.h: ``mx.symbol.CaffeOp(data_0=...,
+prototxt='layer{type:"InnerProduct" ...}')`` plus ``CaffeLoss``).  A TPU
+build cannot host Caffe's CPU/CUDA layer implementations, so parity is
+achieved by *translation* instead of embedding: the prototxt layer
+configs are parsed (protobuf text format, no protobuf dependency) and
+mapped onto native operators, which then compile through XLA like any
+other symbol.  Two surfaces:
+
+- ``CaffeOp(data_0=..., prototxt=...)`` / ``CaffeLoss(...)``: drop-in
+  for the plugin API, supporting the layer types the plugin's examples
+  use (InnerProduct, Convolution, Pooling, ReLU/TanH/Sigmoid, LRN,
+  Dropout, Softmax[WithLoss], Concat, Eltwise, Flatten, BatchNorm).
+- ``prototxt_to_symbol(text)``: whole-net importer — reads a train/deploy
+  .prototxt and builds the full symbol graph with named parameters.
+"""
+
+from __future__ import annotations
+
+import re
+
+from . import symbol as sym
+from .base import MXNetError
+
+__all__ = ["parse_prototxt", "prototxt_to_symbol", "CaffeOp", "CaffeLoss",
+           "SUPPORTED_LAYERS"]
+
+
+# -- protobuf text-format parser (subset: messages, repeated fields) --------
+
+_TOKEN = re.compile(r"""
+    (?P<brace_open>\{) | (?P<brace_close>\}) |
+    (?P<name>[A-Za-z_][A-Za-z0-9_]*) \s* (?P<colon>:)? |
+    (?P<string>"(?:[^"\\]|\\.)*") |
+    (?P<number>-?\d+\.?\d*(?:[eE][-+]?\d+)?) |
+    (?P<comment>\#[^\n]*)
+""", re.VERBOSE)
+
+
+def _tokenize(text):
+    pos = 0
+    while pos < len(text):
+        if text[pos].isspace():
+            pos += 1
+            continue
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            raise MXNetError(f"prototxt parse error at {text[pos:pos+40]!r}")
+        pos = m.end()
+        if m.lastgroup == "comment":
+            continue
+        yield m
+
+
+def parse_prototxt(text: str) -> dict:
+    """Parse protobuf text format into a dict; repeated fields become
+    lists.  Handles the subset Caffe net definitions use (no extensions,
+    no type annotations)."""
+    root = {}
+    stack = [root]
+    pending = None  # field name awaiting a value or a message block
+    for tok in _tokenize(text):
+        kind = tok.lastgroup
+        if kind == "colon":  # 'field:' — the name+colon matched together
+            kind = "name"
+        if kind == "name" and pending is None:
+            pending = tok.group("name")
+            # enum values appear as bare names after a 'name:' — handled
+            # below because pending is consumed by the colon branch
+        elif kind == "brace_open":
+            child = {}
+            _append(stack[-1], pending, child)
+            stack.append(child)
+            pending = None
+        elif kind == "brace_close":
+            if len(stack) == 1:
+                raise MXNetError("prototxt: unbalanced braces")
+            stack.pop()
+            pending = None
+        elif kind in ("string", "number", "name"):
+            if pending is None:
+                raise MXNetError(f"prototxt: stray value {tok.group()!r}")
+            if kind == "string":
+                v = tok.group("string")[1:-1]
+            elif kind == "number":
+                s = tok.group("number")
+                v = float(s) if ("." in s or "e" in s or "E" in s) else int(s)
+            else:  # bare name == enum or bool literal
+                s = tok.group("name")
+                v = {"true": True, "false": False}.get(s, s)
+            _append(stack[-1], pending, v)
+            pending = None
+    if len(stack) != 1:
+        raise MXNetError("prototxt: unbalanced braces at EOF")
+    return root
+
+
+def _append(msg, field, value):
+    if field is None:
+        raise MXNetError("prototxt: value without a field name")
+    if field in msg:
+        if not isinstance(msg[field], list):
+            msg[field] = [msg[field]]
+        msg[field].append(value)
+    else:
+        msg[field] = value
+
+
+def _as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+# -- layer translation ------------------------------------------------------
+
+def _pair(param, base, default=0):
+    """Caffe's kernel/stride/pad fields: either `<base>_size`-style
+    single values or `<base>_h`/`<base>_w`."""
+    h = param.get(base + "_h")
+    w = param.get(base + "_w")
+    if h is not None or w is not None:
+        return (int(h or default), int(w or default))
+    v = param.get(base + "_size", param.get(base, default))
+    if isinstance(v, list):
+        v = v[0]
+    return (int(v), int(v))
+
+
+def _conv(layer, ins, name):
+    p = layer.get("convolution_param", {})
+    no_bias = p.get("bias_term") is False
+    num_group = int(p.get("group", 1))
+    if num_group != 1:
+        raise MXNetError(f"caffe layer {name}: grouped convolution "
+                         "is not supported by the importer")
+    return sym.Convolution(
+        ins[0], num_filter=int(p["num_output"]), kernel=_pair(p, "kernel"),
+        stride=_pair(p, "stride", 1), pad=_pair(p, "pad", 0),
+        no_bias=no_bias, name=name)
+
+
+def _pool(layer, ins, name):
+    p = layer.get("pooling_param", {})
+    pool = {0: "max", 1: "avg", "MAX": "max", "AVE": "avg"}.get(
+        p.get("pool", "MAX"))
+    if pool is None:
+        raise MXNetError(f"caffe layer {name}: unsupported pool type "
+                         f"{p.get('pool')!r}")
+    if p.get("global_pooling") is True:
+        return sym.Pooling(ins[0], global_pool=True, kernel=(1, 1),
+                           pool_type=pool, name=name)
+    return sym.Pooling(
+        ins[0], kernel=_pair(p, "kernel"), stride=_pair(p, "stride", 1),
+        pad=_pair(p, "pad", 0), pool_type=pool,
+        pooling_convention="full",  # caffe uses ceil output sizing
+        name=name)
+
+
+def _inner_product(layer, ins, name):
+    p = layer.get("inner_product_param", {})
+    no_bias = p.get("bias_term") is False
+    return sym.FullyConnected(sym.Flatten(ins[0]),
+                              num_hidden=int(p["num_output"]),
+                              no_bias=no_bias, name=name)
+
+
+def _eltwise(layer, ins, name):
+    p = layer.get("eltwise_param", {})
+    op = {0: "prod", 1: "sum", 2: "max", "PROD": "prod", "SUM": "sum",
+          "MAX": "max"}.get(p.get("operation", "SUM"))
+    if op == "sum":
+        out = ins[0]
+        for i in ins[1:]:
+            out = out + i
+        return out
+    if op == "prod":
+        out = ins[0]
+        for i in ins[1:]:
+            out = out * i
+        return out
+    out = ins[0]
+    for i in ins[1:]:
+        out = sym._maximum(out, i)
+    return out
+
+
+def _batchnorm(layer, ins, name):
+    p = layer.get("batch_norm_param", {})
+    # caffe pairs BatchNorm with a following Scale layer for gamma/beta;
+    # our BatchNorm op owns gamma/beta, so a Scale right after BatchNorm
+    # is folded away by the importer (see prototxt_to_symbol)
+    return sym.BatchNorm(ins[0], eps=float(p.get("eps", 1e-5)),
+                         momentum=float(p.get("moving_average_fraction",
+                                              0.999)),
+                         fix_gamma=False, name=name)
+
+
+def _lrn(layer, ins, name):
+    p = layer.get("lrn_param", {})
+    return sym.lrn(ins[0], nsize=int(p.get("local_size", 5)),
+                   alpha=float(p.get("alpha", 1e-4)),
+                   beta=float(p.get("beta", 0.75)),
+                   knorm=float(p.get("k", 2.0)), name=name)
+
+
+SUPPORTED_LAYERS = {
+    "Convolution": _conv,
+    "Pooling": _pool,
+    "InnerProduct": _inner_product,
+    "ReLU": lambda l, ins, n: sym.Activation(ins[0], act_type="relu", name=n),
+    "TanH": lambda l, ins, n: sym.Activation(ins[0], act_type="tanh", name=n),
+    "Sigmoid": lambda l, ins, n: sym.Activation(ins[0], act_type="sigmoid",
+                                                name=n),
+    "Dropout": lambda l, ins, n: sym.Dropout(
+        ins[0], p=float(l.get("dropout_param", {}).get("dropout_ratio", 0.5)),
+        name=n),
+    "Softmax": lambda l, ins, n: sym.SoftmaxActivation(ins[0], name=n),
+    "SoftmaxWithLoss": lambda l, ins, n: sym.SoftmaxOutput(
+        ins[0], *ins[1:2], name=n.replace("loss", "softmax") if "loss" in n
+        else n),
+    "Concat": lambda l, ins, n: sym.Concat(
+        *ins, num_args=len(ins),
+        dim=int(l.get("concat_param", {}).get("axis", 1)), name=n),
+    "Eltwise": _eltwise,
+    "Flatten": lambda l, ins, n: sym.Flatten(ins[0], name=n),
+    "BatchNorm": _batchnorm,
+    "LRN": _lrn,
+}
+
+_SKIPPED_LAYERS = ("Accuracy", "Silence")
+_INPUT_LAYERS = ("Data", "Input", "ImageData", "MemoryData", "HDF5Data")
+
+
+def prototxt_to_symbol(text: str, label_name: str = "softmax_label"):
+    """Import a Caffe net definition as a native Symbol.
+
+    Data layers become the ``data`` Variable; ``SoftmaxWithLoss`` becomes
+    SoftmaxOutput; BatchNorm+Scale pairs are folded (our BatchNorm owns
+    gamma/beta); train/test-phase-restricted duplicates prefer the TRAIN
+    phase.  Raises on layer types outside ``SUPPORTED_LAYERS``.
+    """
+    net = parse_prototxt(text)
+    layers = _as_list(net.get("layer")) or _as_list(net.get("layers"))
+    if not layers:
+        raise MXNetError("prototxt has no layers")
+
+    tops = {}  # caffe top name -> symbol
+    bn_syms = set()  # id()s of BatchNorm outputs, for Scale folding
+    # (Symbol has __slots__, so marker attributes cannot be attached)
+
+    def get_bottom(names):
+        outs = []
+        for b in names:
+            if b in ("label",):
+                outs.append(sym.Variable(label_name))
+            elif b in tops:
+                outs.append(tops[b])
+            elif b == "data":
+                outs.append(sym.Variable("data"))
+            else:
+                raise MXNetError(f"caffe import: unknown bottom {b!r}")
+        return outs
+
+    last = None
+    for layer in layers:
+        ltype = layer.get("type")
+        name = str(layer.get("name", ltype))
+        if isinstance(ltype, int):  # V1 enum ids not supported
+            raise MXNetError("caffe import: V1 (enum-typed) prototxt is "
+                             "not supported; upgrade with caffe's "
+                             "upgrade_net_proto_text tool")
+        # phase-restricted layers: keep TRAIN versions, skip TEST dups
+        include = _as_list(layer.get("include"))
+        if any(i.get("phase") in ("TEST", 1) for i in include if isinstance(i, dict)):
+            continue
+        bottoms = [str(b) for b in _as_list(layer.get("bottom"))]
+        top_names = [str(t) for t in _as_list(layer.get("top"))]
+        if ltype in _INPUT_LAYERS:
+            for t in top_names:
+                if t != "label":
+                    tops[t] = sym.Variable("data")
+            continue
+        if ltype in _SKIPPED_LAYERS:
+            continue
+        if ltype == "Scale" and bottoms and bottoms[0] in tops and \
+                id(tops[bottoms[0]]) in bn_syms:
+            # fold Scale into the preceding BatchNorm (gamma/beta are
+            # already parameters of our BatchNorm op)
+            for t in top_names:
+                tops[t] = tops[bottoms[0]]
+            continue
+        fn = SUPPORTED_LAYERS.get(ltype)
+        if fn is None:
+            raise MXNetError(
+                f"caffe import: unsupported layer type {ltype!r} "
+                f"(supported: {sorted(SUPPORTED_LAYERS)})")
+        out = fn(layer, get_bottom(bottoms), name)
+        if ltype == "BatchNorm":
+            bn_syms.add(id(out))
+        for t in top_names:
+            tops[t] = out
+        last = out
+    return last
+
+
+def _single_layer(prototxt):
+    net = parse_prototxt(prototxt)
+    layer = net.get("layer") or net.get("layers")
+    if isinstance(layer, list):
+        layer = layer[0]
+    if layer is None:
+        raise MXNetError(f"CaffeOp: no layer in prototxt {prototxt!r}")
+    return layer
+
+
+def CaffeOp(*args, prototxt="layer{}", num_data=1, num_weight=0, name=None,
+            **kwargs):
+    """Plugin-API-compatible single-layer op (caffe_op-inl.h).
+
+    Inputs are ``data_0 ... data_{num_data-1}`` (positionally or by
+    keyword); the layer config comes from ``prototxt``.  The layer is
+    translated to native operators rather than run through libcaffe, so
+    it works anywhere the framework does — no Caffe installation.
+    """
+    ins = list(args)
+    for i in range(len(ins), num_data):
+        k = f"data_{i}"
+        if k not in kwargs:
+            break
+        ins.append(kwargs.pop(k))
+    if not ins:
+        raise MXNetError("CaffeOp: no data inputs")
+    layer = _single_layer(prototxt)
+    ltype = layer.get("type")
+    fn = SUPPORTED_LAYERS.get(ltype)
+    if fn is None:
+        raise MXNetError(f"CaffeOp: unsupported layer type {ltype!r}")
+    return fn(layer, ins, name or f"caffe_{ltype.lower()}")
+
+
+def CaffeLoss(data=None, label=None, grad_scale=1.0, prototxt="layer{}",
+              name=None, **kwargs):
+    """Plugin-API-compatible loss (caffe_loss-inl.h): SoftmaxWithLoss
+    maps to SoftmaxOutput with ``grad_scale``."""
+    layer = _single_layer(prototxt)
+    ltype = layer.get("type")
+    if ltype != "SoftmaxWithLoss":
+        raise MXNetError(f"CaffeLoss: unsupported loss type {ltype!r}")
+    return sym.SoftmaxOutput(data, label, grad_scale=float(grad_scale),
+                             name=name or "caffe_loss")

@@ -345,8 +345,14 @@ class DistPSKVStore(KVStore):
     def push(self, key, value, priority=0):
         # first push == the training loop has begun: the startup re-join
         # (reference ps-lite is_recovery) is over, so later init /
-        # set_optimizer calls get fresh-start semantics again
-        self._is_recovery = False
+        # set_optimizer calls get fresh-start semantics again.  Barrier
+        # ordinals resync to the servers' released-round counters here:
+        # the previous life may have passed mid-training barriers this
+        # life never re-executed (periodic checkpoints), and future
+        # rounds must pair with the peers' numbering.
+        if self._is_recovery:
+            self._is_recovery = False
+            self._client.resync_barrier()
         for k, vs in self._normalize(key, value):
             if k not in self._meta:
                 raise MXNetError(f"key {k!r} not initialized")

@@ -84,7 +84,7 @@ class PSServer:
         self._gen = {}          # key -> completed sync-round counter
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._barrier_count = 0
+        self._barrier_arrivals = set()  # rank / connection tokens present
         self._barrier_gen = 0
         self._last_seen = {}    # worker rank -> monotonic last-contact
         self._stop = threading.Event()
@@ -218,24 +218,37 @@ class PSServer:
             if val is None:
                 return ("err", f"key {msg[1]!r} not initialized")
             return ("ok", val)
+        if op == "barrier_gen":
+            # released-round counter; recovered workers resync their
+            # barrier ordinal to it once startup replay is done (their
+            # previous life may have passed mid-training rounds — e.g.
+            # periodic checkpoints — that the new life never re-executes,
+            # so program-order ordinals alone would pair rounds wrong)
+            with self._cond:
+                return ("ok", self._barrier_gen)
         if op == "barrier":
-            # Generation-numbered: the client sends its own barrier
-            # ordinal.  A generation the server has already released
+            # Generation-numbered + rank-keyed: the client sends its own
+            # barrier ordinal; an ordinal the server has already released
             # returns immediately, which is what makes worker recovery
             # safe — a restarted worker replays its startup barriers
             # (instant no-ops for rounds its peers already passed) and
             # genuinely joins the first round still pending, instead of
-            # skipping barriers wholesale and deadlocking survivors
-            # that crashed mid-startup.  Legacy 1-tuple requests keep
-            # the plain counting behavior.
+            # skipping barriers wholesale and deadlocking survivors that
+            # crashed mid-startup.  The pending round tracks arrivals as
+            # a set keyed by rank (or connection identity for clients
+            # that never sent "hello"), so a rank that crashed while
+            # waiting and rejoined is counted once, not twice.
             client_gen = msg[1] if len(msg) > 1 else None
+            token = (rank_holder[0]
+                     if rank_holder is not None and rank_holder[0] is not None
+                     else ("conn", id(rank_holder)))
             with self._cond:
                 if client_gen is not None and client_gen <= self._barrier_gen:
                     return ("ok",)  # round already released
-                self._barrier_count += 1
+                self._barrier_arrivals.add(token)
                 gen = self._barrier_gen
-                if self._barrier_count == self.num_workers:
-                    self._barrier_count = 0
+                if len(self._barrier_arrivals) == self.num_workers:
+                    self._barrier_arrivals = set()
                     self._barrier_gen += 1
                     self._cond.notify_all()
                 else:
@@ -403,6 +416,15 @@ class ShardedPSClient:
         for c in self.clients:
             c._barrier_ordinal += 1
             c.request("barrier", c._barrier_ordinal)
+
+    def resync_barrier(self):
+        """Align barrier ordinals with the servers' released-round
+        counters.  A recovered worker calls this once its startup replay
+        is done: the previous life may have passed extra (mid-training)
+        rounds, so continuing from the replayed ordinal would make every
+        later barrier look like an already-released round and no-op."""
+        for c in self.clients:
+            c._barrier_ordinal = int(c.request("barrier_gen"))
 
     def command(self, head, body):
         for c in self.clients:

@@ -174,11 +174,16 @@ class ResourceManager:
                 r.reseed(seed_state)
 
     def release_all(self):
-        """Drop temp buffers back to the pool (memory-pressure hook)."""
+        """Drop temp buffers back to the pool (memory-pressure hook).
+
+        Snapshot under the lock, release outside it: release() blocks on
+        the engine draining workspace borrowers, and a queued engine op
+        may itself call request() — waiting while holding the manager
+        lock would deadlock the drain."""
         with self._lock:
-            for spaces in self._temp.values():
-                for s in spaces:
-                    s.release()
+            spaces = [s for group in self._temp.values() for s in group]
+        for s in spaces:
+            s.release()
         storage.release_all()
 
 

@@ -1,0 +1,84 @@
+"""SFrame data-iterator bridge (rebuild of plugin/sframe).
+
+The reference plugin builds against Turi/GraphLab's C++ SFrame to feed
+``SFrameIter``/``SFrameImageIter`` from on-disk columnar frames.  Here
+the iterator is duck-typed over any columnar frame object — a
+``turicreate.SFrame``, a ``pandas.DataFrame``, or anything exposing
+``frame[column]`` as an iterable of rows — and materializes the selected
+columns to numpy, then batches through the NDArrayIter machinery
+(host-side collation; device transfer happens at ``load_data_batch``
+like every other iterator).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .io import NDArrayIter
+
+__all__ = ["SFrameIter", "SFrameImageIter"]
+
+
+def _column(frame, name):
+    try:
+        col = frame[name]
+    except (KeyError, TypeError) as e:
+        raise MXNetError(f"SFrameIter: frame has no column {name!r}") from e
+    rows = [np.asarray(r, dtype=np.float32) for r in col]
+    if not rows:
+        raise MXNetError(f"SFrameIter: column {name!r} is empty")
+    first = rows[0].shape
+    if any(r.shape != first for r in rows):
+        raise MXNetError(
+            f"SFrameIter: column {name!r} rows have inconsistent shapes "
+            "(pack images to a fixed shape first)")
+    return np.stack(rows) if first else np.asarray(rows, np.float32)
+
+
+class SFrameIter(NDArrayIter):
+    """Iterate a columnar frame (plugin/sframe SFrameIter analog).
+
+    data_field: column name or list of names — multiple numeric columns
+    are concatenated feature-wise, array-typed columns keep their shape.
+    """
+
+    def __init__(self, sframe, data_field, label_field=None, batch_size=1,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        fields = ([data_field] if isinstance(data_field, str)
+                  else list(data_field))
+        cols = [_column(sframe, f) for f in fields]
+        if len(cols) == 1:
+            data = cols[0]
+        else:
+            flat = [c.reshape(len(c), -1) for c in cols]
+            n = {len(c) for c in flat}
+            if len(n) != 1:
+                raise MXNetError("SFrameIter: columns differ in length")
+            data = np.concatenate(flat, axis=1)
+        label = _column(sframe, label_field) if label_field else None
+        super().__init__(data, label, batch_size=batch_size,
+                         data_name=data_name, label_name=label_name,
+                         **kwargs)
+
+
+class SFrameImageIter(SFrameIter):
+    """Image variant (plugin/sframe SFrameImageIter): the image column
+    holds fixed-shape arrays (H, W, C) or (C, H, W); optional float mean
+    and scale are applied on the host like the reference's
+    mean_r/g/b + scale params."""
+
+    def __init__(self, sframe, data_field, label_field=None, batch_size=1,
+                 mean=None, scale=1.0, **kwargs):
+        super().__init__(sframe, data_field, label_field, batch_size,
+                         **kwargs)
+        arr = self.data[0][1]
+        if arr.ndim != 4:
+            raise MXNetError("SFrameImageIter: image column must hold "
+                             f"fixed-shape 3d arrays, got {arr.shape[1:]}")
+        out = arr.astype(np.float32)
+        if mean is not None:
+            out = out - np.asarray(mean, np.float32)
+        if scale != 1.0:
+            out = out * float(scale)
+        self.data[0] = (self.data[0][0], out)

@@ -1,0 +1,59 @@
+"""Symbol documentation helpers (rebuild of python/mxnet/symbol_doc.py).
+
+The reference attaches extended doc/examples to auto-generated ops and
+exposes ``SymbolDoc.get_output_shape`` as a teaching utility.  Ops here
+carry their docs in the registry (OpDef docstrings + typed Params with
+per-field doc), so this module provides the utility surface: shape
+lookup, and a ``build_doc`` that renders an op's signature the way the
+reference's C-API docstring generator did.
+"""
+
+from __future__ import annotations
+
+from .ops.op import OP_REGISTRY
+
+__all__ = ["SymbolDoc", "build_doc", "list_ops"]
+
+
+class SymbolDoc:
+    """Doc/demo helpers (reference symbol_doc.py SymbolDoc)."""
+
+    @staticmethod
+    def get_output_shape(sym, **input_shapes):
+        """Map output names to inferred shapes for given input shapes."""
+        _, s_outputs, _ = sym.infer_shape(**input_shapes)
+        return dict(zip(sym.list_outputs(), s_outputs))
+
+
+def list_ops():
+    """All registered operator names (discovery surface parity with
+    MXSymbolListAtomicSymbolCreators)."""
+    return sorted(OP_REGISTRY.list())
+
+
+def build_doc(op_name: str) -> str:
+    """Render an op's docstring + parameter table from the registry,
+    the way the reference generated Python docstrings from the C API's
+    key/type/description triples."""
+    op = OP_REGISTRY.get(op_name)
+    lines = [f"{op_name}", ""]
+    doc = (getattr(op, "__doc__", None)
+           or getattr(type(op), "__doc__", None) or "")
+    if doc:
+        lines += [doc.strip(), ""]
+    param_cls = getattr(op, "param_cls", None)
+    if param_cls is not None:
+        lines.append("Parameters")
+        lines.append("----------")
+        for fname, fld in getattr(param_cls, "_fields", {}).items():
+            typ = getattr(fld, "type", None)
+            tname = getattr(typ, "__name__", str(typ))
+            default = getattr(fld, "default", None)
+            req = getattr(fld, "required", False)
+            spec = f"{fname} : {tname}"
+            spec += ", required" if req else f", optional, default={default!r}"
+            lines.append(spec)
+            fdoc = getattr(fld, "doc", None)
+            if fdoc:
+                lines.append(f"    {fdoc}")
+    return "\n".join(lines)

@@ -1,0 +1,147 @@
+"""Caffe interop (mxnet_tpu/caffe.py — rebuild of plugin/caffe as
+translation instead of embedding): prototxt text-format parsing, whole-net
+import, and the CaffeOp/CaffeLoss plugin API."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.caffe as mc
+from mxnet_tpu.base import MXNetError
+
+LENET_PROTOTXT = """
+name: "LeNet"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 4 dim: 1 dim: 28 dim: 28 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 20 kernel_size: 5 stride: 1 } }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "conv2" type: "Convolution" bottom: "pool1" top: "conv2"
+  convolution_param { num_output: 50 kernel_size: 5 stride: 1 } }
+layer { name: "pool2" type: "Pooling" bottom: "conv2" top: "pool2"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "ip1" type: "InnerProduct" bottom: "pool2" top: "ip1"
+  inner_product_param { num_output: 500 } }
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param { num_output: 10 } }
+layer { name: "accuracy" type: "Accuracy" bottom: "ip2" bottom: "label"
+  include { phase: TEST } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" bottom: "label"
+  top: "loss" }
+"""
+
+
+def test_parse_prototxt_structure():
+    net = mc.parse_prototxt(LENET_PROTOTXT)
+    assert net["name"] == "LeNet"
+    layers = net["layer"]
+    assert len(layers) == 10
+    assert layers[1]["type"] == "Convolution"
+    assert layers[1]["convolution_param"]["num_output"] == 20
+    assert layers[2]["pooling_param"]["pool"] == "MAX"
+    # repeated fields (two bottoms) become lists
+    assert net["layer"][-1]["bottom"] == ["ip2", "label"]
+    # nested repeated dims
+    shape = layers[0]["input_param"]["shape"]
+    assert shape["dim"] == [4, 1, 28, 28]
+
+
+def test_lenet_import_shapes_and_forward():
+    net = mc.prototxt_to_symbol(LENET_PROTOTXT)
+    args, outs, _ = net.infer_shape(data=(4, 1, 28, 28))
+    assert outs == [(4, 10)]
+    arg_names = net.list_arguments()
+    assert "conv1_weight" in arg_names and "ip2_bias" in arg_names
+
+    exe = net.simple_bind(mx.cpu(), data=(4, 1, 28, 28),
+                          softmax_label=(4,))
+    for k, v in exe.arg_dict.items():
+        v[:] = np.random.RandomState(0).uniform(-0.05, 0.05, v.shape)
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (4, 10)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_lenet_import_trains():
+    net = mc.prototxt_to_symbol(LENET_PROTOTXT)
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, 64).astype(np.float32)
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod.fit(it, num_epoch=6, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.1})
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=32),
+                      mx.metric.create("acc"))
+    assert dict(score)["accuracy"] >= 0.2  # learns synthetic labels a bit
+
+
+def test_caffe_op_plugin_api():
+    """The plugin README's MLP composition pattern (caffe_net.py)."""
+    data = mx.sym.Variable("data")
+    fc1 = mc.CaffeOp(data, num_weight=2, name="fc1",
+                     prototxt='layer{type:"InnerProduct" '
+                              'inner_product_param{num_output: 128} }')
+    act1 = mc.CaffeOp(fc1, prototxt='layer{type:"TanH"}')
+    fc2 = mc.CaffeOp(act1, num_weight=2, name="fc2",
+                     prototxt='layer{type:"InnerProduct" '
+                              'inner_product_param{num_output: 10}}')
+    label = mx.sym.Variable("softmax_label")
+    mlp = mc.CaffeLoss(data=fc2, label=label, grad_scale=1.0,
+                       prototxt='layer{type:"SoftmaxWithLoss"}')
+    args, outs, _ = mlp.infer_shape(data=(8, 64), softmax_label=(8,))
+    assert outs == [(8, 10)]
+    # kwargs form: data_0=
+    fc = mc.CaffeOp(data_0=data, num_weight=2,
+                    prototxt='layer{type:"InnerProduct" '
+                             'inner_product_param{num_output: 4}}')
+    assert fc.infer_shape(data=(2, 6))[1] == [(2, 4)]
+
+
+def test_eltwise_and_concat():
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    s = mc.CaffeOp(a, b, num_data=2,
+                   prototxt='layer{type:"Eltwise" '
+                            'eltwise_param{operation: MAX}}')
+    ex = s.simple_bind(mx.cpu(), a=(2, 3), b=(2, 3))
+    ex.arg_dict["a"][:] = [[1, 5, 3], [0, 0, 0]]
+    ex.arg_dict["b"][:] = [[4, 2, 6], [1, -1, 2]]
+    got = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, [[4, 5, 6], [1, 0, 2]])
+
+    c = mc.CaffeOp(a, b, num_data=2,
+                   prototxt='layer{type:"Concat" concat_param{axis: 1}}')
+    assert c.infer_shape(a=(2, 3), b=(2, 5))[1] == [(2, 8)]
+
+
+def test_unsupported_layer_raises():
+    with pytest.raises(MXNetError):
+        mc.prototxt_to_symbol('layer { name: "x" type: "Embed" }')
+    with pytest.raises(MXNetError):
+        mc.CaffeOp(mx.sym.Variable("d"), prototxt='layer{type:"PReLU"}')
+
+
+def test_batchnorm_scale_folding():
+    """BatchNorm + Scale pairs fold into one native BatchNorm op."""
+    proto = """
+    layer { name: "data" type: "Input" top: "data" }
+    layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+      convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+    layer { name: "bn1" type: "BatchNorm" bottom: "conv1" top: "bn1"
+      batch_norm_param { eps: 0.001 } }
+    layer { name: "scale1" type: "Scale" bottom: "bn1" top: "bn1" }
+    layer { name: "relu1" type: "ReLU" bottom: "bn1" top: "bn1" }
+    layer { name: "ip" type: "InnerProduct" bottom: "bn1" top: "ip"
+      inner_product_param { num_output: 2 } }
+    layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
+    """
+    net = mc.prototxt_to_symbol(proto)
+    args = net.list_arguments()
+    assert "bn1_gamma" in args and "bn1_beta" in args
+    assert not any("scale1" in a for a in args)  # folded away
+    _, outs, aux = net.infer_shape(data=(2, 3, 6, 6))
+    assert outs == [(2, 2)]
+    assert len(aux) == 2  # moving mean/var

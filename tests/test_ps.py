@@ -256,3 +256,81 @@ def test_elastic_worker_restart(tmp_path):
     assert "RANK_0_ELASTIC_OK" in out
     assert "RANK_1_ELASTIC_OK" in out
     assert "restart 1/1" in out   # the crash actually happened
+
+
+def test_barrier_rank_keyed_no_double_count():
+    """A rank that arrived at a barrier, crashed, and replays the same
+    round is counted once — the round must not release early."""
+    servers, mk = _start(num_workers=3)
+    c0, c1, c2 = mk(), mk(), mk()
+    try:
+        c0.hello(0)
+        c1.hello(1)
+        c2.hello(2)
+        done = []
+
+        def b(client):
+            client.barrier()
+            done.append(1)
+
+        # rank 1 arrives then "crashes" (its request thread just hangs in
+        # the wait); its recovered life re-sends the same round
+        t1 = threading.Thread(target=b, args=(c1,), daemon=True)
+        t1.start()
+        import time as _time
+
+        _time.sleep(0.3)
+        c1b = mk()
+        c1b.hello(1)  # recovered life, same rank
+        t1b = threading.Thread(target=b, args=(c1b,), daemon=True)
+        t1b.start()
+        _time.sleep(0.3)
+        t0 = threading.Thread(target=b, args=(c0,), daemon=True)
+        t0.start()
+        t0.join(timeout=0.5)
+        # ranks {0, 1} present — must NOT release without rank 2
+        assert len(done) == 0, "barrier released without rank 2"
+        b(c2)
+        t0.join(timeout=10)
+        t1b.join(timeout=10)
+        assert len(done) >= 3
+    finally:
+        _stop(servers, [c0, c1b, c2])
+
+
+def test_barrier_resync_after_midtraining_crash():
+    """Ordinal resync: the first life passes extra (checkpoint) barriers
+    the recovered life never replays; after resync_barrier() its next
+    round pairs with the peers' numbering instead of no-opping."""
+    servers, mk = _start(num_workers=2)
+    c0, c1 = mk(), mk()
+    try:
+        c0.hello(0)
+        c1.hello(1)
+        # startup: 1 barrier round; then 2 mid-training rounds
+        for _ in range(3):
+            done = []
+            t = threading.Thread(target=lambda: (c1.barrier(),
+                                                 done.append(1)), daemon=True)
+            t.start()
+            c0.barrier()
+            t.join(timeout=10)
+            assert done
+        # rank 1 crashes and restarts: new connection, replays its single
+        # startup barrier (instant no-op), then resyncs
+        c1b = mk()
+        c1b.hello(1)
+        c1b.barrier()          # replayed startup round: instant
+        c1b.resync_barrier()   # align with released-round counter
+        # next round must require BOTH ranks
+        done = []
+        t = threading.Thread(target=lambda: (c1b.barrier(),
+                                             done.append(1)), daemon=True)
+        t.start()
+        t.join(timeout=0.5)
+        assert not done, "post-recovery barrier no-opped"
+        c0.barrier()
+        t.join(timeout=10)
+        assert done
+    finally:
+        _stop(servers, [c0, c1b])

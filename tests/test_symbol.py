@@ -125,3 +125,21 @@ def test_name_manager_prefix():
     assert s.list_outputs()[0].startswith("block1_fullyconnected")
     s2 = mx.sym.FullyConnected(mx.sym.Variable("y"), num_hidden=4)
     assert not s2.list_outputs()[0].startswith("block1_")
+
+
+def test_symbol_doc_helpers():
+    """symbol_doc.py (reference python/mxnet/symbol_doc.py parity)."""
+    from mxnet_tpu.symbol_doc import SymbolDoc, build_doc, list_ops
+
+    net = mx.sym.Convolution(mx.sym.Variable("data"), kernel=(3, 3),
+                             num_filter=8, name="c")
+    shapes = SymbolDoc.get_output_shape(net, data=(2, 3, 8, 8))
+    assert shapes == {"c_output": (2, 8, 6, 6)}
+
+    ops = list_ops()
+    assert "convolution" in ops and len(ops) > 100
+
+    doc = build_doc("Convolution")
+    assert "kernel" in doc and "required" in doc
+    doc2 = build_doc("Pooling")
+    assert "pool_type" in doc2
