@@ -306,14 +306,116 @@ class ImageRecordIter(DataIter):
                     self._queue.put(("batch", batch))
                 self._queue.put(("end", None))
                 self._reset_order()
+        except Exception as e:  # surface to the consumer; never hang it
+            self._queue.put(("error", e))
         finally:
             pool.shutdown(wait=False)
             reader.close()
 
+    # -- native fast path (src/image_pipeline.cc) ---------------------------
+    def _native_eligible(self):
+        """The C++ pipeline covers the standard chain (resize shorter
+        side, random/center crop, mirror, mean/scale); rotation and HSL
+        jitter stay on the Python path."""
+        import os as _os
+
+        if _os.environ.get("MXNET_TPU_NATIVE_IMAGE", "1") == "0":
+            return False
+        a = self._aug
+        if (a.rotate >= 0 or a.max_rotate_angle > 0
+                or a.random_h or a.random_s or a.random_l):
+            return False
+        if self.data_shape[0] not in (1, 3):
+            return False
+        from .libinfo import find_lib
+
+        lib = find_lib()
+        return lib is not None and bool(lib.MXTPUImgPipeAvailable())
+
+    def _producer_loop_native(self):
+        import ctypes
+
+        from .base import MXNetError as _Err
+        from .libinfo import find_lib
+
+        lib = find_lib()
+        c, h, w = self.data_shape
+        bs = self.batch_size
+        a = self._aug
+        mean_rgb = np.zeros(3, np.float32)
+        mean_img = None
+        if self._mean is not None:
+            if self._mean.size == 3:  # per-channel (BGR order, as decoded)
+                mean_rgb = np.ascontiguousarray(
+                    self._mean.reshape(3), np.float32)
+            else:
+                mean_img = np.ascontiguousarray(self._mean, np.float32)
+                if mean_img.shape != self.data_shape:
+                    # the C++ side reads c*h*w floats unchecked; a mean
+                    # computed at a different data_shape must fail
+                    # loudly like the python broadcast would
+                    self._queue.put(("error", _Err(
+                        f"mean image shape {mean_img.shape} does not "
+                        f"match data_shape {self.data_shape}")))
+                    return
+        offsets = np.ascontiguousarray(self._offsets, np.int64)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        handle = lib.MXTPUImgPipeCreate(
+            self._path.encode(), offsets.ctypes.data_as(i64p), len(offsets),
+            bs, c, h, w, self.label_width,
+            int(a.resize), int(bool(a.rand_crop)), int(bool(a.rand_mirror)),
+            int(bool(a.mirror)), mean_rgb.ctypes.data_as(f32p),
+            float(self._scale),
+            mean_img.ctypes.data_as(f32p) if mean_img is not None else None,
+            self._threads, max(2, self._prefetch),
+            int(self._rng.randint(0, 2**62)))
+        if not handle:
+            # construction failed: fall back to the python chain
+            self._producer_loop()
+            return
+        try:
+            while not self._stop.is_set():
+                # full batches only, matching the python path
+                n_full = (len(self._order) // bs) * bs
+                if n_full == 0:
+                    raise _Err("fewer records than batch_size")
+                epoch = np.ascontiguousarray(
+                    offsets[self._order[:n_full]], np.int64)
+                lib.MXTPUImgPipeReset(handle, epoch.ctypes.data_as(i64p),
+                                      n_full)
+                for _ in range(n_full // bs):
+                    if self._stop.is_set():
+                        return
+                    # fresh buffers per batch: queued batches must not
+                    # alias memory the next Next() call overwrites
+                    # (device_put is async and can be zero-copy on the
+                    # CPU backend)
+                    data_buf = np.empty((bs, c, h, w), np.float32)
+                    label_buf = np.empty((bs, self.label_width), np.float32)
+                    r = lib.MXTPUImgPipeNext(
+                        handle, data_buf.ctypes.data_as(f32p),
+                        label_buf.ctypes.data_as(f32p))
+                    if r <= 0:
+                        from .c_api import last_error
+
+                        raise _Err(f"native image pipeline: {last_error()}")
+                    label = (label_buf.reshape(bs) if self.label_width == 1
+                             else label_buf)
+                    self._queue.put(("batch", DataBatch(
+                        [nd.array(data_buf)], [nd.array(label)], pad=0)))
+                self._queue.put(("end", None))
+                self._reset_order()
+        except Exception as e:  # surface to the consumer; never hang it
+            self._queue.put(("error", e))
+        finally:
+            lib.MXTPUImgPipeDestroy(handle)
+
     def _start_producer(self):
         self._queue = queue.Queue(maxsize=self._prefetch)
-        self._producer = threading.Thread(target=self._producer_loop,
-                                          daemon=True)
+        target = (self._producer_loop_native if self._native_eligible()
+                  else self._producer_loop)
+        self._producer = threading.Thread(target=target, daemon=True)
         self._producer.start()
 
     # -- DataIter protocol ---------------------------------------------------
@@ -329,14 +431,18 @@ class ImageRecordIter(DataIter):
 
     def reset(self):
         while True:
-            kind, _ = self._queue.get()
+            kind, payload = self._queue.get()
             if kind == "end":
                 return
+            if kind == "error":
+                raise payload
 
     def next(self):
         kind, batch = self._queue.get()
         if kind == "end":
             raise StopIteration
+        if kind == "error":
+            raise batch
         return batch
 
     def iter_next(self):

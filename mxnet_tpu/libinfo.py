@@ -66,6 +66,23 @@ def _declare(lib):
     lib.MXTPUStorageReleaseAll.argtypes = []
     lib.MXTPUStorageStats.argtypes = [c.POINTER(c.c_uint64)] * 4
 
+    lib.MXTPUImgPipeAvailable.restype = c.c_int
+    lib.MXTPUImgPipeAvailable.argtypes = []
+    lib.MXTPUImgPipeCreate.restype = c.c_void_p
+    lib.MXTPUImgPipeCreate.argtypes = [
+        c.c_char_p, c.POINTER(c.c_int64), c.c_int64,
+        c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
+        c.c_int, c.c_int, c.c_int, c.c_int,
+        c.POINTER(c.c_float), c.c_float, c.POINTER(c.c_float),
+        c.c_int, c.c_int, c.c_uint64]
+    lib.MXTPUImgPipeReset.restype = c.c_int
+    lib.MXTPUImgPipeReset.argtypes = [c.c_void_p, c.POINTER(c.c_int64),
+                                      c.c_int64]
+    lib.MXTPUImgPipeNext.restype = c.c_int
+    lib.MXTPUImgPipeNext.argtypes = [c.c_void_p, c.POINTER(c.c_float),
+                                     c.POINTER(c.c_float)]
+    lib.MXTPUImgPipeDestroy.argtypes = [c.c_void_p]
+
     lib.MXTPUGetLastError.restype = c.c_char_p
     lib.MXTPUSetLastError.argtypes = [c.c_char_p]
     lib.MXTPURegisterOp.restype = c.c_int
@@ -101,4 +118,13 @@ def find_lib():
             _LIB = _declare(ctypes.CDLL(_LIB_PATH))
         except OSError:
             _LIB = None
+        except AttributeError:
+            # a stale locally-built .so missing newer symbols: try one
+            # rebuild, else degrade to pure-Python like any other failure
+            _LIB = None
+            if not os.environ.get("MXNET_TPU_NO_NATIVE") and _build():
+                try:
+                    _LIB = _declare(ctypes.CDLL(_LIB_PATH))
+                except (OSError, AttributeError):
+                    _LIB = None
         return _LIB

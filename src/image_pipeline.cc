@@ -1,0 +1,412 @@
+// Native threaded image pipeline.
+//
+// C++ rebuild of the reference's ImageRecordIter internals
+// (src/io/iter_image_recordio.cc:150-355 ImageRecordIOParser +
+// iter_batchloader.h + iter_prefetcher.h): N decoder threads pull
+// records from a shared cursor, JPEG-decode via OpenCV, apply the
+// standard augment chain (resize shorter side, random/center crop,
+// mirror), normalize (mean image or per-channel mean, scale), and write
+// float32 CHW directly into per-batch slots; completed batches are
+// delivered to the consumer IN ORDER through a bounded ready window
+// (the prefetch depth).
+//
+// The Python ImageRecordIter uses this as its fast path and keeps the
+// Python/cv2 chain for augmentations outside this set (rotation, HSL
+// jitter) and as the no-native fallback.
+//
+// Built only when OpenCV dev headers are present (MXTPU_HAS_OPENCV);
+// otherwise the entry points report "unavailable" and the frontend
+// falls back.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#ifdef MXTPU_HAS_OPENCV
+#include <opencv2/imgcodecs.hpp>
+#include <opencv2/imgproc.hpp>
+#endif
+
+extern "C" void MXTPUSetLastError(const char* msg);
+
+namespace {
+
+#ifdef MXTPU_HAS_OPENCV
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct PipeConfig {
+  int batch_size, c, h, w, label_width;
+  int resize;          // shorter-side resize target, 0 = off
+  int rand_crop;       // else center crop
+  int rand_mirror;     // 50% horizontal flip
+  int mirror;          // always flip
+  float mean_rgb[3];   // per-channel mean (RGB order), used if no mean_img
+  float scale;
+  uint64_t seed;
+};
+
+struct Batch {
+  std::vector<float> data, label;
+  int n = 0;                     // valid rows
+  std::atomic<int> remaining{0}; // rows still being decoded
+};
+
+class ImagePipeline {
+ public:
+  ImagePipeline(std::string path, const int64_t* offsets, int64_t n,
+                const PipeConfig& cfg, const float* mean_img, int threads,
+                int depth)
+      : path_(std::move(path)), offsets_(offsets, offsets + n), cfg_(cfg),
+        depth_(depth < 1 ? 1 : depth), n_threads_(threads < 1 ? 1 : threads) {
+    if (mean_img != nullptr)
+      mean_img_.assign(mean_img,
+                       mean_img + (size_t)cfg.c * cfg.h * cfg.w);
+    data_elems_ = (size_t)cfg_.batch_size * cfg_.c * cfg_.h * cfg_.w;
+    label_elems_ = (size_t)cfg_.batch_size * cfg_.label_width;
+    for (int i = 0; i < depth_; ++i) {
+      batches_.emplace_back(new Batch);
+      batches_.back()->data.resize(data_elems_);
+      batches_.back()->label.resize(label_elems_);
+    }
+    for (int i = 0; i < n_threads_; ++i)
+      workers_.emplace_back([this, i] { Worker(i); });
+  }
+
+  ~ImagePipeline() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_work_.notify_all();
+    cv_ready_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  // Install a new epoch order (record offsets) and restart production.
+  void Reset(const int64_t* order, int64_t n) {
+    std::unique_lock<std::mutex> lk(mu_);
+    // wait for in-flight rows of the stale epoch to drain so a slow
+    // worker can't write into a recycled slot
+    cv_ready_.wait(lk, [this] { return inflight_ == 0 || stop_; });
+    epoch_.assign(order, order + n);
+    num_batches_ = (n + cfg_.batch_size - 1) / cfg_.batch_size;
+    next_row_ = 0;
+    next_deliver_ = 0;
+    completed_.assign((size_t)num_batches_, 0);
+    ++epoch_id_;
+    lk.unlock();
+    cv_work_.notify_all();
+  }
+
+  // Copy the next batch into caller buffers.  Returns number of valid
+  // rows (pad rows wrap around, reference round-pad), 0 at epoch end,
+  // -1 on decode error.
+  int Next(float* data_out, float* label_out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (next_deliver_ >= num_batches_) return 0;
+    int64_t want = next_deliver_;
+    cv_ready_.wait(lk, [this, want] {
+      return stop_ || !error_.empty() || completed_[want];
+    });
+    if (!error_.empty()) {
+      MXTPUSetLastError(error_.c_str());
+      return -1;
+    }
+    if (stop_) return 0;
+    Batch& b = *batches_[want % depth_];
+    std::memcpy(data_out, b.data.data(), data_elems_ * sizeof(float));
+    std::memcpy(label_out, b.label.data(), label_elems_ * sizeof(float));
+    int valid = b.n;
+    ++next_deliver_;
+    lk.unlock();
+    cv_work_.notify_all();  // slot freed; producers may advance
+    return valid;
+  }
+
+ private:
+  // Claim the next record row, blocking while the slot window is full.
+  bool Claim(int64_t* row, int64_t* epoch_seen) {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (;;) {
+      if (stop_) return false;
+      // claim through the padded tail: the final partial batch's pad
+      // rows wrap to the epoch start (round-pad) and must be decoded
+      // too, or its `remaining` counter never reaches zero
+      if (next_row_ < num_batches_ * cfg_.batch_size) {
+        int64_t batch = next_row_ / cfg_.batch_size;
+        // only decode into slots within the delivery window
+        if (batch < next_deliver_ + depth_) {
+          *row = next_row_++;
+          *epoch_seen = epoch_id_;
+          ++inflight_;
+          // first row of a batch initializes its bookkeeping
+          if (*row % cfg_.batch_size == 0) {
+            Batch& b = *batches_[batch % depth_];
+            int rows = (int)std::min<int64_t>(
+                cfg_.batch_size, (int64_t)epoch_.size() - batch * cfg_.batch_size);
+            b.n = rows;
+            b.remaining.store(cfg_.batch_size);
+          }
+          return true;
+        }
+      }
+      cv_work_.wait(lk);
+    }
+  }
+
+  void Finish(int64_t row, int64_t epoch_seen) {
+    std::unique_lock<std::mutex> lk(mu_);
+    --inflight_;
+    if (epoch_seen != epoch_id_) {  // stale epoch row: discard
+      cv_ready_.notify_all();
+      return;
+    }
+    int64_t batch = row / cfg_.batch_size;
+    Batch& b = *batches_[batch % depth_];
+    if (b.remaining.fetch_sub(1) == 1) {
+      completed_[batch] = 1;
+      cv_ready_.notify_all();
+    }
+  }
+
+  void Fail(const std::string& msg) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (error_.empty()) error_ = msg;
+    cv_ready_.notify_all();
+  }
+
+  void Worker(int tid) {
+    FILE* f = std::fopen(path_.c_str(), "rb");
+    if (f == nullptr) {
+      Fail("image pipeline: cannot open " + path_);
+      return;
+    }
+    std::mt19937_64 rng(cfg_.seed + 0x9e3779b9ull * (tid + 1));
+    std::vector<unsigned char> buf;
+    int64_t row, epoch_seen;
+    while (Claim(&row, &epoch_seen)) {
+      // round-pad: final partial batch wraps to the epoch start
+      int64_t idx = row % (int64_t)epoch_.size();
+      bool ok = DecodeOne(f, epoch_[(size_t)idx], row, rng, &buf);
+      Finish(row, epoch_seen);
+      if (!ok) break;  // error already recorded; consumer sees it
+    }
+    std::fclose(f);
+  }
+
+  bool DecodeOne(FILE* f, int64_t offset, int64_t row, std::mt19937_64& rng,
+                 std::vector<unsigned char>* buf) {
+    // -- record framing: [magic u32][lrec u32][payload][pad to 4] ------
+    uint32_t head[2];
+    if (std::fseek(f, (long)offset, SEEK_SET) != 0 ||
+        std::fread(head, 4, 2, f) != 2 || head[0] != kMagic) {
+      Fail("image pipeline: bad record at offset " + std::to_string(offset));
+      return false;
+    }
+    uint32_t len = head[1] & 0x1fffffffu;
+    buf->resize(len);
+    if (std::fread(buf->data(), 1, len, f) != len) {
+      Fail("image pipeline: truncated record");
+      return false;
+    }
+    // -- IRHeader: <IfQQ> = flag, label, id, id2 -----------------------
+    if (len < 24) {
+      Fail("image pipeline: record shorter than IRHeader");
+      return false;
+    }
+    uint32_t flag;
+    float label0;
+    std::memcpy(&flag, buf->data(), 4);
+    std::memcpy(&label0, buf->data() + 4, 4);
+    const unsigned char* payload = buf->data() + 24;
+    size_t payload_len = len - 24;
+    int64_t batch = row / cfg_.batch_size;
+    Batch& b = *batches_[batch % depth_];
+    size_t slot = (size_t)(row % cfg_.batch_size);
+    float* lab = b.label.data() + slot * cfg_.label_width;
+    if (flag > 0) {  // label vector precedes the image payload
+      size_t nlab = flag;
+      if (payload_len < nlab * 4) {
+        Fail("image pipeline: truncated label vector");
+        return false;
+      }
+      for (int i = 0; i < cfg_.label_width; ++i) {
+        float v = 0.f;
+        if ((size_t)i < nlab) std::memcpy(&v, payload + 4 * i, 4);
+        lab[i] = v;
+      }
+      payload += nlab * 4;
+      payload_len -= nlab * 4;
+    } else {
+      lab[0] = label0;
+      for (int i = 1; i < cfg_.label_width; ++i) lab[i] = 0.f;
+    }
+    // -- decode + augment ---------------------------------------------
+    cv::Mat raw(1, (int)payload_len, CV_8UC1, const_cast<unsigned char*>(payload));
+    cv::Mat img = cv::imdecode(raw, cfg_.c == 1 ? cv::IMREAD_GRAYSCALE
+                                                : cv::IMREAD_COLOR);
+    if (img.empty()) {
+      Fail("image pipeline: imdecode failed at offset " +
+           std::to_string(offset));
+      return false;
+    }
+    if (cfg_.resize > 0) {
+      // truncate like the python chain (int(w * resize / h)) so native
+      // and fallback paths produce identical geometry
+      int sh = img.rows, sw = img.cols;
+      int nh, nw;
+      if (sh < sw) {
+        nh = cfg_.resize;
+        nw = (int)((double)sw * cfg_.resize / sh);
+      } else {
+        nw = cfg_.resize;
+        nh = (int)((double)sh * cfg_.resize / sw);
+      }
+      cv::resize(img, img, cv::Size(nw, nh));
+    }
+    int H = cfg_.h, W = cfg_.w;
+    if (img.rows < H || img.cols < W) {
+      cv::resize(img, img, cv::Size(W > img.cols ? W : img.cols,
+                                    H > img.rows ? H : img.rows));
+    }
+    int y0, x0;
+    if (cfg_.rand_crop) {
+      y0 = (int)(rng() % (uint64_t)(img.rows - H + 1));
+      x0 = (int)(rng() % (uint64_t)(img.cols - W + 1));
+    } else {
+      y0 = (img.rows - H) / 2;
+      x0 = (img.cols - W) / 2;
+    }
+    cv::Mat crop = img(cv::Rect(x0, y0, W, H));
+    bool flip = cfg_.mirror || (cfg_.rand_mirror && (rng() & 1));
+    if (flip) cv::flip(crop, crop, 1);
+    // -- HWC uint8 (BGR) -> CHW float32, normalize --------------------
+    float* dst = b.data.data() + slot * (size_t)cfg_.c * H * W;
+    const float* mean = mean_img_.empty() ? nullptr : mean_img_.data();
+    for (int ch = 0; ch < cfg_.c; ++ch) {
+      // match the python chain: channels kept in decoded (BGR) order
+      float chan_mean = mean ? 0.f : cfg_.mean_rgb[ch];
+      for (int y = 0; y < H; ++y) {
+        const unsigned char* src = crop.ptr<unsigned char>(y);
+        float* out = dst + ((size_t)ch * H + y) * W;
+        const float* m =
+            mean ? mean + ((size_t)ch * H + y) * W : nullptr;
+        for (int x = 0; x < W; ++x) {
+          float v = (float)src[x * cfg_.c + ch];
+          v -= m ? m[x] : chan_mean;
+          out[x] = v * cfg_.scale;
+        }
+      }
+    }
+    return true;
+  }
+
+  std::string path_;
+  std::vector<int64_t> offsets_;
+  PipeConfig cfg_;
+  int depth_, n_threads_;
+  size_t data_elems_, label_elems_;
+  std::vector<float> mean_img_;
+
+  std::mutex mu_;
+  std::condition_variable cv_work_, cv_ready_;
+  std::vector<std::unique_ptr<Batch>> batches_;
+  std::vector<int64_t> epoch_;
+  std::vector<char> completed_;
+  int64_t num_batches_ = 0, next_row_ = 0, next_deliver_ = 0;
+  int64_t epoch_id_ = 0, inflight_ = 0;
+  bool stop_ = false;
+  std::string error_;
+  std::vector<std::thread> workers_;
+};
+
+#endif  // MXTPU_HAS_OPENCV
+
+}  // namespace
+
+extern "C" {
+
+int MXTPUImgPipeAvailable() {
+#ifdef MXTPU_HAS_OPENCV
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+void* MXTPUImgPipeCreate(const char* path, const int64_t* offsets, int64_t n,
+                         int batch_size, int c, int h, int w, int label_width,
+                         int resize, int rand_crop, int rand_mirror,
+                         int mirror, const float* mean_rgb, float scale,
+                         const float* mean_img, int threads, int depth,
+                         uint64_t seed) {
+#ifdef MXTPU_HAS_OPENCV
+  if (n <= 0 || batch_size <= 0 || c <= 0 || h <= 0 || w <= 0) {
+    MXTPUSetLastError("image pipeline: bad config");
+    return nullptr;
+  }
+  PipeConfig cfg;
+  cfg.batch_size = batch_size;
+  cfg.c = c;
+  cfg.h = h;
+  cfg.w = w;
+  cfg.label_width = label_width < 1 ? 1 : label_width;
+  cfg.resize = resize;
+  cfg.rand_crop = rand_crop;
+  cfg.rand_mirror = rand_mirror;
+  cfg.mirror = mirror;
+  for (int i = 0; i < 3; ++i) cfg.mean_rgb[i] = mean_rgb ? mean_rgb[i] : 0.f;
+  cfg.scale = scale;
+  cfg.seed = seed;
+  try {
+    return new ImagePipeline(path, offsets, n, cfg, mean_img, threads, depth);
+  } catch (const std::exception& e) {
+    MXTPUSetLastError(e.what());
+    return nullptr;
+  }
+#else
+  (void)path; (void)offsets; (void)n;
+  MXTPUSetLastError("image pipeline: built without OpenCV");
+  return nullptr;
+#endif
+}
+
+int MXTPUImgPipeReset(void* handle, const int64_t* order, int64_t n) {
+#ifdef MXTPU_HAS_OPENCV
+  if (handle == nullptr || n <= 0) return -1;
+  static_cast<ImagePipeline*>(handle)->Reset(order, n);
+  return 0;
+#else
+  (void)handle; (void)order; (void)n;
+  return -1;
+#endif
+}
+
+int MXTPUImgPipeNext(void* handle, float* data_out, float* label_out) {
+#ifdef MXTPU_HAS_OPENCV
+  if (handle == nullptr) return -1;
+  return static_cast<ImagePipeline*>(handle)->Next(data_out, label_out);
+#else
+  (void)handle; (void)data_out; (void)label_out;
+  return -1;
+#endif
+}
+
+void MXTPUImgPipeDestroy(void* handle) {
+#ifdef MXTPU_HAS_OPENCV
+  delete static_cast<ImagePipeline*>(handle);
+#else
+  (void)handle;
+#endif
+}
+
+}  // extern "C"

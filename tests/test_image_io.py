@@ -169,3 +169,142 @@ def test_image_record_iter_mean_image_first_run(rec_file, tmp_path):
     it2 = ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
                           batch_size=8, mean_img=mean_path)
     np.testing.assert_allclose(it2._mean, mean)
+
+
+def test_native_pipeline_active_and_matches_python(tmp_path):
+    """The C++ pipeline (src/image_pipeline.cc) must be the active
+    producer for standard configs, and deterministic configs must
+    produce identical batches to the Python chain.  PNG records: JPEG
+    decode differs by a few LSB between the cv2 wheel's bundled OpenCV
+    and the system OpenCV the native pipeline links, so lossless input
+    is what makes bit-parity a fair contract."""
+    from mxnet_tpu.libinfo import find_lib
+
+    lib = find_lib()
+    if lib is None or not lib.MXTPUImgPipeAvailable():
+        pytest.skip("native image pipeline unavailable")
+
+    path = str(tmp_path / "parity.rec")
+    writer = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(3)
+    for i in range(24):
+        img = rng.randint(0, 255, (40, 40, 3)).astype(np.uint8)
+        writer.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i % 4), i, 0), img, quality=3,
+            img_fmt=".png"))
+    writer.close()
+
+    kwargs = dict(path_imgrec=path, data_shape=(3, 32, 32),
+                  batch_size=8, preprocess_threads=2,
+                  mean_r=10.0, mean_g=20.0, mean_b=30.0, scale=1 / 255.0)
+    it_native = ImageRecordIter(**kwargs)
+    assert it_native._native_eligible()
+    os.environ["MXNET_TPU_NATIVE_IMAGE"] = "0"
+    try:
+        it_py = ImageRecordIter(**kwargs)
+        assert not it_py._native_eligible()
+    finally:
+        del os.environ["MXNET_TPU_NATIVE_IMAGE"]
+
+    for bn, bp in zip(iter_epoch(it_native), iter_epoch(it_py)):
+        np.testing.assert_allclose(bn.data[0].asnumpy(),
+                                   bp.data[0].asnumpy(), atol=1e-5)
+        np.testing.assert_allclose(bn.label[0].asnumpy(),
+                                   bp.label[0].asnumpy())
+
+
+def test_native_pipeline_rand_augment_and_epochs(rec_file):
+    """Random crop/mirror via the native path: right shapes, values in
+    the normalized range, stable across epochs."""
+    from mxnet_tpu.libinfo import find_lib
+
+    lib = find_lib()
+    if lib is None or not lib.MXTPUImgPipeAvailable():
+        pytest.skip("native image pipeline unavailable")
+    it = ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 24, 24),
+                         batch_size=16, preprocess_threads=3, resize=28,
+                         rand_crop=True, rand_mirror=True, shuffle=True,
+                         scale=1 / 255.0)
+    assert it._native_eligible()
+    for _ in range(3):
+        batches = list(iter_epoch(it))
+        assert len(batches) == 3
+        arr = batches[0].data[0].asnumpy()
+        assert arr.shape == (16, 3, 24, 24)
+        assert 0.0 <= arr.min() and arr.max() <= 1.0
+
+
+def test_native_pipeline_label_vector(tmp_path):
+    """flag>0 records (label vectors) decode through the native path."""
+    from mxnet_tpu.libinfo import find_lib
+
+    lib = find_lib()
+    if lib is None or not lib.MXTPUImgPipeAvailable():
+        pytest.skip("native image pipeline unavailable")
+    path = str(tmp_path / "vec.rec")
+    writer = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = rng.randint(0, 255, (16, 16, 3)).astype(np.uint8)
+        header = recordio.IRHeader(0, np.array([i, i + 0.5], np.float32),
+                                   i, 0)
+        writer.write(recordio.pack_img(header, img, quality=95))
+    writer.close()
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                         batch_size=4, label_width=2, preprocess_threads=2)
+    assert it._native_eligible()
+    b = next(iter(it))
+    assert b.label[0].shape == (4, 2)
+    np.testing.assert_allclose(b.label[0].asnumpy(),
+                               [[0, 0.5], [1, 1.5], [2, 2.5], [3, 3.5]])
+
+
+def test_native_pipeline_resize_parity(tmp_path):
+    """resize geometry must truncate identically on both paths (PNG for
+    lossless decode)."""
+    from mxnet_tpu.libinfo import find_lib
+
+    lib = find_lib()
+    if lib is None or not lib.MXTPUImgPipeAvailable():
+        pytest.skip("native image pipeline unavailable")
+    path = str(tmp_path / "rs.rec")
+    writer = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(5)
+    for i in range(8):
+        img = rng.randint(0, 255, (20, 23, 3)).astype(np.uint8)
+        writer.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, quality=3,
+            img_fmt=".png"))
+    writer.close()
+    kwargs = dict(path_imgrec=path, data_shape=(3, 16, 16), batch_size=4,
+                  preprocess_threads=2, resize=26)
+    it_native = ImageRecordIter(**kwargs)
+    os.environ["MXNET_TPU_NATIVE_IMAGE"] = "0"
+    try:
+        it_py = ImageRecordIter(**kwargs)
+    finally:
+        del os.environ["MXNET_TPU_NATIVE_IMAGE"]
+    for bn, bp in zip(iter_epoch(it_native), iter_epoch(it_py)):
+        np.testing.assert_allclose(bn.data[0].asnumpy(),
+                                   bp.data[0].asnumpy(), atol=1e-5)
+
+
+def test_native_pipeline_error_surfaces(tmp_path, rec_file):
+    """A corrupt record must raise in the consumer, not hang it."""
+    import shutil
+
+    from mxnet_tpu.libinfo import find_lib
+
+    lib = find_lib()
+    if lib is None or not lib.MXTPUImgPipeAvailable():
+        pytest.skip("native image pipeline unavailable")
+    path = str(tmp_path / "bad.rec")
+    shutil.copyfile(rec_file, path)
+    with open(path, "r+b") as f:  # clobber a record header mid-file
+        f.seek(3000)
+        f.write(b"\xde\xad\xbe\xef" * 40)
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                         batch_size=8, preprocess_threads=2)
+    with pytest.raises(Exception):
+        for _ in range(12):
+            it.next()
