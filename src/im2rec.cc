@@ -1,0 +1,261 @@
+// im2rec: pack an image listing into a RecordIO shard (C++ tool).
+//
+// Rebuild of the reference's native packer (tools/im2rec.cc; the python
+// twin lives at tools/im2rec.py).  Reads a .lst listing produced by
+// `python tools/im2rec.py --list` (index \t label... \t relpath), loads
+// each image with OpenCV, optionally shorter-side-resizes/center-crops
+// and re-encodes (jpg/png), then writes records in the framework's
+// recordio framing ([magic u32][lrec u32][IRHeader <IfQQ>][payload] pad
+// to 4) so ImageRecordIter / the native pipeline consume the output
+// directly.
+//
+// Usage: im2rec <prefix> <image_root> [--resize N] [--quality Q]
+//               [--center-crop] [--encoding .jpg|.png] [--color 0|1]
+//               [--threads N]
+//
+// Threaded: reader/encoder workers + a single ordered writer.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <opencv2/imgcodecs.hpp>
+#include <opencv2/imgproc.hpp>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct Item {
+  int64_t index = 0;
+  std::vector<float> labels;
+  std::string path;
+};
+
+struct Options {
+  int resize = 0;
+  int quality = 95;
+  bool center_crop = false;
+  std::string encoding = ".jpg";
+  int color = 1;
+  int threads = (int)std::thread::hardware_concurrency();
+};
+
+std::vector<Item> ReadList(const std::string& path) {
+  std::ifstream in(path);
+  if (!in) {
+    std::cerr << "im2rec: cannot open listing " << path << "\n";
+    std::exit(1);
+  }
+  std::vector<Item> items;
+  std::string line;
+  int64_t lineno = 0;
+  while (std::getline(in, line)) {
+    ++lineno;
+    if (line.empty()) continue;
+    std::istringstream ss(line);
+    std::vector<std::string> cols;
+    std::string col;
+    while (std::getline(ss, col, '\t')) cols.push_back(col);
+    if (cols.size() < 3) continue;
+    Item it;
+    try {  // skip-and-diagnose like unreadable images, don't terminate
+      it.index = std::stoll(cols[0]);
+      for (size_t i = 1; i + 1 < cols.size(); ++i)
+        it.labels.push_back(std::stof(cols[i]));
+    } catch (const std::exception&) {
+      std::cerr << "im2rec: skipping malformed listing line " << lineno
+                << ": " << line << "\n";
+      continue;
+    }
+    it.path = cols.back();
+    items.push_back(std::move(it));
+  }
+  return items;
+}
+
+// Encode one item to a packed record body (IRHeader + image payload).
+bool PackOne(const Item& item, const std::string& root, const Options& opt,
+             std::string* out) {
+  std::string full = root.empty() ? item.path : root + "/" + item.path;
+  cv::Mat img = cv::imread(full, opt.color == 0 ? cv::IMREAD_GRAYSCALE
+                                                : cv::IMREAD_COLOR);
+  if (img.empty()) {
+    std::cerr << "im2rec: skipping unreadable " << full << "\n";
+    return false;
+  }
+  if (opt.resize > 0) {
+    int sh = img.rows, sw = img.cols;
+    int nh, nw;  // shorter-side resize, truncating like the python twin
+    if (sh < sw) {
+      nh = opt.resize;
+      nw = (int)((double)sw * opt.resize / sh);
+    } else {
+      nw = opt.resize;
+      nh = (int)((double)sh * opt.resize / sw);
+    }
+    cv::resize(img, img, cv::Size(nw, nh));
+  }
+  if (opt.center_crop && img.rows != img.cols) {
+    int s = std::min(img.rows, img.cols);
+    img = img(cv::Rect((img.cols - s) / 2, (img.rows - s) / 2, s, s)).clone();
+  }
+  std::vector<unsigned char> enc;
+  std::vector<int> params;
+  if (opt.encoding == ".jpg")
+    params = {cv::IMWRITE_JPEG_QUALITY, opt.quality};
+  else
+    params = {cv::IMWRITE_PNG_COMPRESSION, std::min(opt.quality, 9)};
+  if (!cv::imencode(opt.encoding, img, enc, params)) {
+    std::cerr << "im2rec: encode failed for " << full << "\n";
+    return false;
+  }
+  // IRHeader <IfQQ>: multi-label uses flag = n_labels + trailing floats
+  uint32_t flag = item.labels.size() > 1 ? (uint32_t)item.labels.size() : 0;
+  float label0 = item.labels.empty() ? 0.f : item.labels[0];
+  uint64_t id = (uint64_t)item.index, id2 = 0;
+  out->clear();
+  out->reserve(24 + item.labels.size() * 4 + enc.size());
+  out->append((const char*)&flag, 4);
+  out->append((const char*)&label0, 4);
+  out->append((const char*)&id, 8);
+  out->append((const char*)&id2, 8);
+  if (flag > 0)
+    out->append((const char*)item.labels.data(), item.labels.size() * 4);
+  out->append((const char*)enc.data(), enc.size());
+  return true;
+}
+
+bool WriteRecord(std::FILE* f, const std::string& body) {
+  uint32_t head[2] = {kMagic, (uint32_t)body.size()};
+  if (std::fwrite(head, 4, 2, f) != 2) return false;
+  if (std::fwrite(body.data(), 1, body.size(), f) != body.size())
+    return false;
+  static const char pad[4] = {0, 0, 0, 0};
+  size_t r = body.size() % 4;
+  if (r && std::fwrite(pad, 1, 4 - r, f) != 4 - r) return false;
+  return true;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::cerr << "usage: im2rec <prefix> <image_root> [--resize N] "
+                 "[--quality Q] [--center-crop] [--encoding .jpg|.png] "
+                 "[--color 0|1] [--threads N]\n";
+    return 1;
+  }
+  std::string prefix = argv[1], root = argv[2];
+  Options opt;
+  for (int i = 3; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&](const char* what) {
+      if (i + 1 >= argc) {
+        std::cerr << "im2rec: " << what << " needs a value\n";
+        std::exit(1);
+      }
+      return std::string(argv[++i]);
+    };
+    try {
+      if (a == "--resize") opt.resize = std::stoi(next("--resize"));
+      else if (a == "--quality") opt.quality = std::stoi(next("--quality"));
+      else if (a == "--center-crop") opt.center_crop = true;
+      else if (a == "--encoding") opt.encoding = next("--encoding");
+      else if (a == "--color") opt.color = std::stoi(next("--color"));
+      else if (a == "--threads") opt.threads = std::stoi(next("--threads"));
+      else {
+        std::cerr << "im2rec: unknown option " << a << "\n";
+        return 1;
+      }
+    } catch (const std::exception&) {
+      std::cerr << "im2rec: bad value for " << a << "\n";
+      return 1;
+    }
+  }
+  if (opt.threads < 1) opt.threads = 1;
+
+  std::vector<Item> items = ReadList(prefix + ".lst");
+  if (items.empty()) {
+    std::cerr << "im2rec: empty listing " << prefix << ".lst\n";
+    return 1;
+  }
+  std::FILE* out = std::fopen((prefix + ".rec").c_str(), "wb");
+  if (out == nullptr) {
+    std::cerr << "im2rec: cannot write " << prefix << ".rec\n";
+    return 1;
+  }
+
+  // workers encode; records are written in listing order.  The claim
+  // window bounds how far encoders may run ahead of the writer, so a
+  // slow item can't make the rest of an ImageNet-scale dataset pile up
+  // encoded in RAM.
+  const size_t kWindow = 4 * (size_t)opt.threads + 8;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<size_t, std::string> done;  // ordinal -> body ("" = skipped)
+  size_t cursor = 0, next_write = 0, n_ok = 0;
+  bool write_failed = false;
+
+  auto worker = [&] {
+    for (;;) {
+      size_t i;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [&] {
+          return write_failed || cursor >= items.size()
+                 || cursor < next_write + kWindow;
+        });
+        if (write_failed || cursor >= items.size()) return;
+        i = cursor++;
+      }
+      std::string body;
+      bool ok = PackOne(items[i], root, opt, &body);
+      std::lock_guard<std::mutex> lk(mu);
+      done[i] = ok ? std::move(body) : std::string();
+      cv.notify_all();
+    }
+  };
+  std::vector<std::thread> threads;
+  for (int i = 0; i < opt.threads; ++i) threads.emplace_back(worker);
+
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    while (next_write < items.size()) {
+      cv.wait(lk, [&] { return done.count(next_write) > 0; });
+      auto it = done.find(next_write);
+      if (!it->second.empty()) {
+        if (!WriteRecord(out, it->second)) {
+          std::cerr << "im2rec: write failed (disk full?) at record "
+                    << next_write << "\n";
+          write_failed = true;
+          cv.notify_all();
+          break;
+        }
+        ++n_ok;
+      }
+      done.erase(it);
+      ++next_write;
+      cv.notify_all();  // window advanced; encoders may claim again
+    }
+  }
+  for (auto& t : threads) t.join();
+  if (std::fclose(out) != 0) {
+    std::cerr << "im2rec: close failed for " << prefix << ".rec\n";
+    write_failed = true;
+  }
+  if (write_failed) return 1;
+  std::cout << "im2rec: wrote " << n_ok << "/" << items.size()
+            << " records to " << prefix << ".rec\n";
+  return 0;
+}
