@@ -125,10 +125,9 @@ def main():
     placed = trainer._place_batch({"data": data, "softmax_label": label})
 
     def step():
-        trainer._key, sub = jax.random.split(trainer._key)
-        trainer.params, trainer.opt_state, trainer.aux, outs = \
+        trainer.params, trainer.opt_state, trainer.aux, outs, trainer._key = \
             trainer._train_step(trainer.params, trainer.opt_state, trainer.aux,
-                                placed, sub)
+                                placed, trainer._key)
         return outs
 
     for _ in range(n_warmup):

@@ -225,8 +225,13 @@ class ShardedTrainer:
         graph = self._graph
 
         def train_step(params, opt_state, aux, batch, key):
+            # split inside the step: the whole key chain lives on-device,
+            # so each step is ONE program dispatch (a separate host-side
+            # split program adds a dispatch gap per step)
+            key, sub = jax.random.split(key)
+
             def f(p):
-                outs, new_aux = graph({**p, **batch}, aux, key, True)
+                outs, new_aux = graph({**p, **batch}, aux, sub, True)
                 return outs, new_aux
 
             outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
@@ -235,7 +240,7 @@ class ShardedTrainer:
             scale = self._rescale_grad
             grads = {k: g * scale for k, g in grads.items()}
             new_params, new_opt = self._update_fn(grads, opt_state, params)
-            return new_params, new_opt, new_aux, outs
+            return new_params, new_opt, new_aux, outs, key
 
         def eval_step(params, aux, batch, key):
             outs, _ = graph({**params, **batch}, aux, key, False)
@@ -250,7 +255,7 @@ class ShardedTrainer:
             train_step,
             in_shardings=(p_shard, opt_shardings, aux_shardings,
                           self.batch_shardings, rep),
-            out_shardings=(p_shard, opt_shardings, aux_shardings, None),
+            out_shardings=(p_shard, opt_shardings, aux_shardings, None, rep),
             donate_argnums=(0, 1, 2),
         )
         self._eval_step = jax.jit(
@@ -270,10 +275,10 @@ class ShardedTrainer:
 
     def step(self, batch: dict):
         """One optimizer step on a global batch; returns outputs."""
-        self._key, sub = jax.random.split(self._key)
         placed = self._place_batch(batch)
-        self.params, self.opt_state, self.aux, outs = self._train_step(
-            self.params, self.opt_state, self.aux, placed, sub)
+        self.params, self.opt_state, self.aux, outs, self._key = \
+            self._train_step(self.params, self.opt_state, self.aux, placed,
+                             self._key)
         return outs
 
     def eval(self, batch: dict):

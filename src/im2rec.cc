@@ -112,9 +112,9 @@ bool PackOne(const Item& item, const std::string& root, const Options& opt,
   }
   std::vector<unsigned char> enc;
   std::vector<int> params;
-  if (opt.encoding == ".jpg")
+  if (opt.encoding == ".jpg" || opt.encoding == ".jpeg")
     params = {cv::IMWRITE_JPEG_QUALITY, opt.quality};
-  else
+  else  // validated to .png at argument parsing
     params = {cv::IMWRITE_PNG_COMPRESSION, std::min(opt.quality, 9)};
   if (!cv::imencode(opt.encoding, img, enc, params)) {
     std::cerr << "im2rec: encode failed for " << full << "\n";
@@ -184,6 +184,12 @@ int main(int argc, char** argv) {
     }
   }
   if (opt.threads < 1) opt.threads = 1;
+  if (opt.encoding != ".jpg" && opt.encoding != ".jpeg"
+      && opt.encoding != ".png") {
+    std::cerr << "im2rec: --encoding must be .jpg, .jpeg or .png (got "
+              << opt.encoding << ")\n";
+    return 1;
+  }
 
   std::vector<Item> items = ReadList(prefix + ".lst");
   if (items.empty()) {
@@ -234,19 +240,23 @@ int main(int argc, char** argv) {
     while (next_write < items.size()) {
       cv.wait(lk, [&] { return done.count(next_write) > 0; });
       auto it = done.find(next_write);
-      if (!it->second.empty()) {
-        if (!WriteRecord(out, it->second)) {
+      std::string body = std::move(it->second);
+      done.erase(it);
+      ++next_write;
+      cv.notify_all();  // window advanced; encoders may claim again
+      if (!body.empty()) {
+        lk.unlock();  // file IO off the coordination mutex
+        bool ok = WriteRecord(out, body);
+        lk.lock();
+        if (!ok) {
           std::cerr << "im2rec: write failed (disk full?) at record "
-                    << next_write << "\n";
+                    << (next_write - 1) << "\n";
           write_failed = true;
           cv.notify_all();
           break;
         }
         ++n_ok;
       }
-      done.erase(it);
-      ++next_write;
-      cv.notify_all();  // window advanced; encoders may claim again
     }
   }
   for (auto& t : threads) t.join();
