@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Train inception-bn-28-small on CIFAR-10 (rebuild of
+example/image-classification/train_cifar10.py — the 842/1640/2943
+img/sec baseline config from the reference README's results table).
+
+Real data: --data-dir with cifar/train.rec + cifar/test.rec (pack with
+tools/im2rec.py from the extracted CIFAR png tree).  Without data, runs
+on synthetic batches so the compute path is benchmarkable anywhere.
+"""
+
+import os
+
+import numpy as np
+
+import common
+import mxnet_tpu as mx
+
+
+def get_iters(args):
+    shape = (3, 28, 28)
+    d = args.data_dir
+    if d and os.path.exists(os.path.join(d, "train.rec")):
+        # reference train_cifar10.py augmentation: pad-to-32 was done at
+        # packing time; random 28x28 crop + mirror at train time
+        train = mx.ImageRecordIter(
+            path_imgrec=os.path.join(d, "train.rec"), data_shape=shape,
+            batch_size=args.batch_size, shuffle=True, rand_crop=True,
+            rand_mirror=True, mean_img=os.path.join(d, "mean.bin"),
+            preprocess_threads=args.data_nthreads,
+            part_index=args.part_index, num_parts=args.num_parts)
+        test_path = os.path.join(d, "test.rec")
+        val = mx.ImageRecordIter(
+            path_imgrec=test_path, data_shape=shape,
+            batch_size=args.batch_size,
+            mean_img=os.path.join(d, "mean.bin"),
+            preprocess_threads=args.data_nthreads) \
+            if os.path.exists(test_path) else None
+        return train, val
+    rng = np.random.RandomState(0)
+    n = args.batch_size * 8
+    X = rng.standard_normal((n,) + shape).astype(np.float32)
+    y = rng.randint(0, 10, n).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, args.batch_size), None
+
+
+def main():
+    parser = common.add_fit_args(__import__("argparse").ArgumentParser(
+        description=__doc__))
+    parser.add_argument("--data-dir", default=None)
+    parser.add_argument("--data-nthreads", type=int, default=4)
+    parser.add_argument("--part-index", type=int, default=0)
+    parser.add_argument("--num-parts", type=int, default=1)
+    parser.set_defaults(batch_size=128, lr=0.05, num_epochs=1)
+    args = parser.parse_args()
+
+    net = mx.models.inception_bn_small(num_classes=10)
+    train, val = get_iters(args)
+    common.fit(args, net, train, val)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,15 +20,19 @@ class BatchEndParam:
         self.locals = locals
 
 
-def do_checkpoint(prefix, period=1):
-    """Epoch-end checkpoint callback (callback.py:11-32)."""
+def do_checkpoint(prefix, period=1, async_save=False):
+    """Epoch-end checkpoint callback (callback.py:11-32).  With
+    ``async_save`` the disk write happens on a background thread
+    (model.save_checkpoint async contract) so epochs don't stall on
+    storage."""
     from .model import save_checkpoint
 
     period = int(max(1, period))
 
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+            save_checkpoint(prefix, iter_no + 1, sym, arg, aux,
+                            async_save=async_save)
 
     return _callback
 

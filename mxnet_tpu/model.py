@@ -10,6 +10,7 @@ comm/compute overlap (model.py:87-115), two-artifact checkpointing
 from __future__ import annotations
 
 import logging
+import threading
 
 import numpy as np
 
@@ -91,16 +92,79 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None)
             updater(index * num_device + k, g, w)
 
 
-def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+_async_saves = []
+_async_errors = []
+_async_saves_lock = threading.Lock()
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    async_save=False):
     """Two-artifact checkpoint: ``prefix-symbol.json`` +
-    ``prefix-####.params`` (reference model.py:318-347)."""
+    ``prefix-####.params`` (reference model.py:318-347).
+
+    ``async_save`` gives orbax-style semantics: the device->host snapshot
+    is taken synchronously (the checkpoint reflects this exact step), the
+    disk write runs on a background thread into a temp file that is
+    atomically renamed on completion, so training never waits on storage
+    and a crash mid-write cannot leave a torn checkpoint.  Call
+    ``wait_checkpoints()`` (or exit the process cleanly) before relying
+    on the file."""
     if symbol is not None:
         symbol.save(f"{prefix}-symbol.json")
     save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
     save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
     param_name = f"{prefix}-{epoch:04d}.params"
-    nd.save(param_name, save_dict)
-    logging.info('Saved checkpoint to "%s"', param_name)
+    if not async_save:
+        nd.save(param_name, save_dict)
+        logging.info('Saved checkpoint to "%s"', param_name)
+        return
+    # synchronous snapshot: values are pinned to host numpy NOW (copy=True
+    # — np.asarray would alias caller-owned numpy arrays that training
+    # keeps mutating in place), so later updates can't leak into the file
+    snapshot = {k: (v.asnumpy() if hasattr(v, "asnumpy")
+                    else np.array(v, copy=True))
+                for k, v in save_dict.items()}
+
+    def _write():
+        import os
+
+        tmp = f"{param_name}.tmp.{os.getpid()}"
+        try:
+            nd.save(tmp, snapshot)  # numpy-valued; no device round-trip
+            os.replace(tmp, param_name)
+            logging.info('Saved checkpoint (async) to "%s"', param_name)
+        except BaseException as e:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            with _async_saves_lock:
+                _async_errors.append((param_name, e))
+            raise
+
+    t = threading.Thread(target=_write, daemon=False,
+                         name=f"ckpt-{epoch:04d}")
+    t.start()  # start BEFORE registering: a pre-start thread is not
+    with _async_saves_lock:  # alive and a concurrent prune would drop it
+        _async_saves[:] = [x for x in _async_saves if x.is_alive()]
+        _async_saves.append(t)
+
+
+def wait_checkpoints():
+    """Block until all in-flight async checkpoint writes are on disk.
+    Raises the first failure (disk full etc.) instead of silently
+    reporting success over a missing epoch."""
+    with _async_saves_lock:
+        pending = list(_async_saves)
+        _async_saves.clear()
+    for t in pending:
+        t.join()
+    with _async_saves_lock:
+        errors, _async_errors[:] = list(_async_errors), []
+    if errors:
+        name, err = errors[0]
+        raise MXNetError(
+            f"async checkpoint write failed for {name!r}: {err!r}") from err
 
 
 def load_checkpoint(prefix, epoch):

@@ -389,10 +389,14 @@ def save(fname: str, data):
     """Save a list or str->NDArray dict (two-artifact checkpoint contract)."""
     if isinstance(data, NDArray):
         data = [data]
+    def _np(v):
+        # numpy values serialize directly — no device round-trip
+        return v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+
     if isinstance(data, (list, tuple)):
-        payload = {f"__list__:{i}": a.asnumpy() for i, a in enumerate(data)}
+        payload = {f"__list__:{i}": _np(a) for i, a in enumerate(data)}
     elif isinstance(data, dict):
-        payload = {k: v.asnumpy() for k, v in data.items()}
+        payload = {k: _np(v) for k, v in data.items()}
     else:
         raise TypeError("save expects NDArray, list or dict")
     with open(fname, "wb") as f:

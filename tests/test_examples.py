@@ -121,3 +121,8 @@ def test_example_train_moe_runs():
     _run_example("train_moe.py",
                  ["--ep", "4", "--experts", "4", "--d-model", "16",
                   "--d-hidden", "32", "--tokens", "64", "--steps", "3"])
+
+
+def test_example_train_cifar10_runs():
+    _run_example("train_cifar10.py",
+                 ["--num-epochs", "1", "--batch-size", "32"])

@@ -84,3 +84,104 @@ def test_checkpoint_resume(tmp_path):
     model2.fit(train)
     acc2 = model2.score(train)
     assert acc2 >= acc1 - 0.05
+
+
+def test_async_checkpoint_matches_sync(tmp_path):
+    """async_save writes the same artifact (atomically) as the sync
+    path, pinned to the state at call time — later param mutations must
+    not leak into the file."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.model import (load_checkpoint, save_checkpoint,
+                                 wait_checkpoints)
+
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4),
+        name="softmax")
+    rng = np.random.RandomState(0)
+    args = {"fullyconnected0_weight": mx.nd.array(rng.randn(4, 6)),
+            "fullyconnected0_bias": mx.nd.array(rng.randn(4))}
+    aux = {}
+
+    sync_prefix = str(tmp_path / "sync")
+    async_prefix = str(tmp_path / "async")
+    save_checkpoint(sync_prefix, 3, net, args, aux)
+    save_checkpoint(async_prefix, 3, net, args, aux, async_save=True)
+    # mutate AFTER the async call returns: snapshot semantics
+    args["fullyconnected0_bias"][:] = 999.0
+    wait_checkpoints()
+
+    _, a_sync, _ = load_checkpoint(sync_prefix, 3)
+    _, a_async, _ = load_checkpoint(async_prefix, 3)
+    for k in a_sync:
+        np.testing.assert_allclose(a_async[k].asnumpy(),
+                                   a_sync[k].asnumpy())
+    assert not np.allclose(a_async["fullyconnected0_bias"].asnumpy(), 999.0)
+    # no torn temp files left behind
+    import os
+
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+
+def test_do_checkpoint_async_callback(tmp_path):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.model import load_checkpoint, wait_checkpoints
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 8).astype(np.float32)
+    y = (X.sum(axis=1) > 4).astype(np.float32)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2),
+        name="softmax")
+    prefix = str(tmp_path / "cb")
+    model = mx.FeedForward(net, num_epoch=3, learning_rate=0.05,
+                           numpy_batch_size=16)
+    model.fit(X=mx.io.NDArrayIter(X, y, batch_size=16),
+              epoch_end_callback=mx.callback.do_checkpoint(
+                  prefix, async_save=True))
+    wait_checkpoints()
+    sym2, args2, aux2 = load_checkpoint(prefix, 3)
+    assert any(k.endswith("_weight") for k in args2)
+
+
+def test_async_checkpoint_failure_surfaces(tmp_path):
+    """A failed background write must raise from wait_checkpoints(), not
+    silently report success over a missing artifact."""
+    import numpy as np
+    import pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.model import save_checkpoint, wait_checkpoints
+
+    net = mx.sym.Variable("data")
+    args = {"w": np.zeros(3, np.float32)}
+    prefix = str(tmp_path / "nodir" / "m")  # parent doesn't exist
+    with pytest.raises((MXNetError, OSError, FileNotFoundError)):
+        try:
+            save_checkpoint(prefix, 1, None, args, {}, async_save=True)
+        finally:
+            wait_checkpoints()
+
+
+def test_async_checkpoint_numpy_args_pinned(tmp_path):
+    """Plain-numpy params must be deep-copied at call time."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.model import (load_checkpoint, save_checkpoint,
+                                 wait_checkpoints)
+
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2),
+        name="softmax")
+    w = np.ones((2, 4), np.float32)
+    prefix = str(tmp_path / "np")
+    save_checkpoint(prefix, 1, net, {"w": w}, {}, async_save=True)
+    w[:] = -5.0  # caller mutates in place after the call returns
+    wait_checkpoints()
+    _, a, _ = load_checkpoint(prefix, 1)
+    np.testing.assert_allclose(a["w"].asnumpy(), 1.0)
