@@ -233,6 +233,20 @@ class DistKVStore(KVStore):
         super().__init__(kind)
         self._nproc = jax.process_count()
 
+    def init(self, key, value):
+        """Rank 0's initial values win everywhere (the reference PS
+        contract: workers init to the server's — i.e. rank 0's — state,
+        so all ranks start from identical weights; without this, each
+        rank's own random init diverges the replicas permanently)."""
+        super().init(key, value)
+        if self._nproc > 1:
+            from jax.experimental import multihost_utils
+
+            for k, _ in self._normalize(key, value):
+                stored = self._store[k]
+                synced = multihost_utils.broadcast_one_to_all(stored._data)
+                stored._set(jax.device_put(synced, stored._ctx.jax_device()))
+
     @property
     def rank(self):
         return jax.process_index()

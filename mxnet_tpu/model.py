@@ -128,7 +128,9 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     def _write():
         import os
 
-        tmp = f"{param_name}.tmp.{os.getpid()}"
+        # pid + thread id: two concurrent in-process saves to the same
+        # prefix+epoch must not share (and tear) a temp file
+        tmp = f"{param_name}.tmp.{os.getpid()}.{threading.get_ident()}"
         try:
             nd.save(tmp, snapshot)  # numpy-valued; no device round-trip
             os.replace(tmp, param_name)

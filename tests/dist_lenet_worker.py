@@ -1,0 +1,73 @@
+"""Distributed training convergence worker (rebuild of the reference
+nightly dist_lenet.py / multi_lenet.py intent): each rank trains the
+same conv net on ITS SHARD of a synthetic dataset through kvstore
+``dist_sync``; sync semantics make every rank's parameters bitwise
+identical each round, and the final model must clear an accuracy gate
+on the full dataset.
+
+Launched by test_dist.py via tools/launch.py -n 2.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def synthetic(n=512, c=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, 1, 16, 16), np.float32)
+    y = rng.randint(0, c, n)
+    for i in range(n):
+        X[i, 0, y[i] * 3:y[i] * 3 + 3, 3:13] = 1.0
+    X += rng.randn(*X.shape).astype(np.float32) * 0.1
+    return X, y.astype(np.float32)
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+    X, y = synthetic()
+    # shard like ImageRecordIter part_index/num_parts
+    Xs, ys = X[rank::nworker], y[rank::nworker]
+    train = mx.io.NDArrayIter(Xs, ys, batch_size=32)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1))
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=4)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net)
+    mod.fit(train, num_epoch=6, kvstore=kv,
+            initializer=mx.initializer.Xavier(factor_type="in",
+                                              rnd_type="gaussian",
+                                              magnitude=2),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+
+    # sync determinism: every rank holds identical params
+    args, _ = mod.get_params()
+    digest = float(sum(np.abs(v.asnumpy()).sum() for v in args.values()))
+    print(f"RANK_{rank}_DIGEST {digest:.6f}", flush=True)
+
+    # convergence gate on the FULL dataset
+    acc = mod.score(mx.io.NDArrayIter(X, y, batch_size=32),
+                    mx.metric.create("acc"))
+    acc = dict(acc)["accuracy"]
+    assert acc > 0.9, f"rank {rank} accuracy {acc} below gate"
+    print(f"RANK_{rank}_TRAIN_OK acc={acc:.3f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -27,3 +27,22 @@ def test_dist_sync_kvstore_two_processes():
     assert r.returncode == 0, out[-3000:]
     assert "RANK_0_OK" in out
     assert "RANK_1_OK" in out
+
+
+def test_dist_lenet_training_convergence():
+    """Nightly dist_lenet analog: 2-worker dist_sync training converges
+    and both ranks end with identical parameters."""
+    import re
+
+    env = dict(os.environ)
+    env.pop("MXTPU_COORDINATOR", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--",
+         sys.executable, os.path.join(REPO, "tests", "dist_lenet_worker.py")],
+        capture_output=True, text=True, timeout=280, env=env)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    assert "RANK_0_TRAIN_OK" in out and "RANK_1_TRAIN_OK" in out
+    digests = re.findall(r"RANK_\d_DIGEST ([0-9.]+)", out)
+    assert len(digests) == 2 and digests[0] == digests[1], digests
