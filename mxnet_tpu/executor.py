@@ -391,8 +391,13 @@ class Executor:
         if out_grads is not None:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
-            head = tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
-                         for g in out_grads)
+            # copy head grads to this executor's device (the reference
+            # Backward copies/verifies head grads, graph_executor.cc:1003
+            # — callers routinely pass default-context arrays)
+            dev = self._ctx.jax_device()
+            head = tuple(jax.device_put(
+                g._data if isinstance(g, NDArray) else jnp.asarray(g), dev)
+                for g in out_grads)
             args, aux = self._gather()
             grad_args = {k: args[k] for k in self._grad_names}
             other = {k: v for k, v in args.items() if k not in grad_args}

@@ -56,10 +56,15 @@ def main():
                                               magnitude=2),
             optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
 
-    # sync determinism: every rank holds identical params
+    # sync determinism: every rank holds BITWISE identical params
+    import hashlib
+
     args, _ = mod.get_params()
-    digest = float(sum(np.abs(v.asnumpy()).sum() for v in args.values()))
-    print(f"RANK_{rank}_DIGEST {digest:.6f}", flush=True)
+    h = hashlib.sha256()
+    for k in sorted(args):
+        h.update(k.encode())
+        h.update(np.ascontiguousarray(args[k].asnumpy()).tobytes())
+    print(f"RANK_{rank}_DIGEST {h.hexdigest()}", flush=True)
 
     # convergence gate on the FULL dataset
     acc = mod.score(mx.io.NDArrayIter(X, y, batch_size=32),

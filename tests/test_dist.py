@@ -44,5 +44,5 @@ def test_dist_lenet_training_convergence():
     out = r.stdout + r.stderr
     assert r.returncode == 0, out[-3000:]
     assert "RANK_0_TRAIN_OK" in out and "RANK_1_TRAIN_OK" in out
-    digests = re.findall(r"RANK_\d_DIGEST ([0-9.]+)", out)
+    digests = re.findall(r"RANK_\d_DIGEST ([0-9a-f]+)", out)
     assert len(digests) == 2 and digests[0] == digests[1], digests

@@ -1,0 +1,138 @@
+"""TPU-vs-CPU consistency (rebuild of tests/python/gpu/test_operator_gpu.py:
+run the same symbols on both backends and compare forward/backward within
+dtype tolerances).
+
+The main suite pins JAX to the virtual-CPU backend (conftest.py), so
+these tests drive the REAL chip from a subprocess with the session's
+default (axon) platform.  Gated behind MXTPU_TPU_TESTS=1 — they need
+the tunnel and pay first-compile latency — and skipped cleanly when the
+chip is unreachable.
+
+Run: MXTPU_TPU_TESTS=1 python -m pytest tests/test_tpu_consistency.py -q
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("MXTPU_TPU_TESTS") != "1",
+    reason="TPU consistency tests gated behind MXTPU_TPU_TESTS=1")
+
+_WORKER = r"""
+import json, sys
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import jax
+# full f32 matmul/conv precision: the default bf16 MXU passes are fine
+# for training but flip ReLU boundaries, which makes gradient comparison
+# against CPU meaningless at those elements
+jax.config.update("jax_default_matmul_precision", "highest")
+import mxnet_tpu as mx
+
+cases = {}
+
+def case(name):
+    def deco(fn):
+        cases[name] = fn
+        return fn
+    return deco
+
+@case("conv_bn_relu")
+def _():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                             name="c")
+    net = mx.sym.BatchNorm(net, fix_gamma=False, name="bn")
+    net = mx.sym.Activation(net, act_type="relu")
+    return net, {"data": (4, 3, 8, 8)}, {"bn_moving_var": 1.0}
+
+@case("fc_softmax")
+def _():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax"), \
+        {"data": (8, 12), "softmax_label": (8,)}, {}
+
+@case("pool_flatten_dot")
+def _():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Pooling(data, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Flatten(net)
+    return net, {"data": (4, 2, 6, 6)}, {}
+
+@case("rnn_lstm")
+def _():
+    data = mx.sym.Variable("data")
+    net = mx.sym.RNN(data, state_size=8, num_layers=1, mode="lstm",
+                     name="rnn")
+    return net, {"data": (5, 2, 4)}, {}
+
+name = sys.argv[1]
+sym, shapes, aux_init = cases[name]()
+rng = np.random.RandomState(0)
+exe = sym.simple_bind(mx.tpu(0) if %(tpu)s else mx.cpu(0),
+                      grad_req="write", **shapes)
+for k, v in exe.arg_dict.items():
+    v[:] = rng.normal(0, 1, v.shape)
+for k, v in exe.aux_dict.items():
+    v[:] = aux_init.get(k, 0.0)
+outs = exe.forward(is_train=True)
+exe.backward([mx.nd.ones(o.shape) for o in outs])
+result = {"outs": [np.asarray(o.asnumpy(), np.float64).tolist()
+                   for o in outs],
+          "grads": {k: np.asarray(g.asnumpy(), np.float64).tolist()
+                    for k, g in exe.grad_dict.items() if g is not None}}
+print("RESULT " + json.dumps(result))
+"""
+
+
+def _run(case, tpu):
+    env = dict(os.environ)
+    if not tpu:
+        env["JAX_PLATFORMS"] = "cpu"  # worker calls config.update below
+    elif env.get("JAX_PLATFORMS") == "cpu":
+        # conftest pins the pytest process to CPU; the TPU worker must
+        # not inherit that or it compares CPU against CPU vacuously
+        del env["JAX_PLATFORMS"]
+    src = _WORKER % {"repo": REPO, "tpu": "True" if tpu else "False"}
+    if not tpu:
+        src = src.replace(
+            "import mxnet_tpu as mx",
+            "import jax\njax.config.update('jax_platforms', 'cpu')\n"
+            "import mxnet_tpu as mx")
+    r = subprocess.run([sys.executable, "-c", src, case],
+                       capture_output=True, text=True, timeout=560,
+                       env=env, cwd=REPO)
+    if r.returncode != 0:
+        if tpu and ("Unable to initialize backend" in r.stderr
+                    or "DEADLINE" in r.stderr):
+            pytest.skip("TPU unreachable")
+        raise AssertionError(r.stderr[-2000:])
+    line = [ln for ln in r.stdout.splitlines() if ln.startswith("RESULT ")]
+    assert line, r.stdout[-1000:]
+    return json.loads(line[-1][len("RESULT "):])
+
+
+@pytest.mark.parametrize("case", ["conv_bn_relu", "fc_softmax",
+                                  "pool_flatten_dot", "rnn_lstm"])
+def test_tpu_matches_cpu(case):
+    cpu = _run(case, tpu=False)
+    tpu = _run(case, tpu=True)
+    for o_t, o_c in zip(tpu["outs"], cpu["outs"]):
+        np.testing.assert_allclose(np.array(o_t), np.array(o_c),
+                                   rtol=2e-3, atol=1e-3)
+    for k in cpu["grads"]:
+        # backward through batch statistics cancels catastrophically;
+        # keep gradient tolerance an order looser than forward
+        np.testing.assert_allclose(np.array(tpu["grads"][k]),
+                                   np.array(cpu["grads"][k]),
+                                   rtol=1e-2, atol=5e-3,
+                                   err_msg=f"{case}:{k}")
