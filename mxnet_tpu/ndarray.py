@@ -35,7 +35,7 @@ from .ops import OP_REGISTRY
 __all__ = [
     "NDArray", "array", "empty", "zeros", "ones", "full", "arange",
     "concatenate", "save", "load", "imperative_invoke", "onehot_encode",
-    "waitall",
+    "choose_element_0index", "fill_element_0index", "waitall",
 ]
 
 # Generated op functions (sum, max, slice, abs, ...) shadow builtins in this
@@ -276,12 +276,7 @@ def imperative_invoke(op_name, inputs, kwargs, out=None, ctx=None, train=True):
     op = OP_REGISTRY.get(op_name)
     params = op.make_params(kwargs)
     if inputs:
-        ctx = inputs[0].context
-        for arr in inputs[1:]:
-            if arr.context != ctx:
-                raise MXNetError(
-                    f"{op_name}: inputs on different contexts "
-                    f"({arr.context} vs {ctx}); use copyto/as_in_context")
+        ctx = _check_same_context(op_name, inputs)
     elif ctx is None:
         ctx = current_context()
     fn = _cached_jit(op_name, params, train)
@@ -374,6 +369,52 @@ def onehot_encode(indices: NDArray, out: NDArray) -> NDArray:
     hot = jax.nn.one_hot(indices._data.astype(jnp.int32), depth, dtype=out.dtype)
     out._set(jax.device_put(hot, out._ctx.jax_device()))
     return out
+
+
+def _check_same_context(op_name, arrays):
+    ctx = arrays[0].context
+    for arr in arrays[1:]:
+        if arr.context != ctx:
+            raise MXNetError(
+                f"{op_name}: inputs on different contexts "
+                f"({arr.context} vs {ctx}); use copyto/as_in_context")
+    return ctx
+
+
+def choose_element_0index(lhs: NDArray, rhs: NDArray, out=None) -> NDArray:
+    """Pick ``lhs[i, rhs[i]]`` for each row i (0-based index).
+
+    Reference: ``MXNET_REGISTER_NDARRAY_FUN(choose_element_0index)``
+    src/ndarray/ndarray.cc:728 (MatChooseRowElem kernel).
+    """
+    ctx = _check_same_context("choose_element_0index", [lhs, rhs])
+    idx = rhs._data.astype(jnp.int32)
+    picked = jnp.take_along_axis(lhs._data, idx[:, None], axis=1)[:, 0]
+    if out is not None:
+        out._set(jax.device_put(picked.astype(out.dtype),
+                                out._ctx.jax_device()))
+        return out
+    return NDArray(picked, ctx)
+
+
+def fill_element_0index(lhs: NDArray, mhs: NDArray, rhs: NDArray,
+                        out=None) -> NDArray:
+    """Return a copy of ``lhs`` with ``[i, rhs[i]] = mhs[i]`` per row i
+    (0-based); writes into ``out`` instead when given (pass ``out=lhs``
+    for the in-place form).
+
+    Reference: ``MXNET_REGISTER_NDARRAY_FUN(fill_element_0index)``
+    src/ndarray/ndarray.cc:734 (MatFillRowElem ternary kernel).
+    """
+    ctx = _check_same_context("fill_element_0index", [lhs, mhs, rhs])
+    idx = rhs._data.astype(jnp.int32)
+    rows = jnp.arange(lhs.shape[0])
+    filled = lhs._data.at[rows, idx].set(mhs._data.astype(lhs.dtype))
+    if out is not None:
+        out._set(jax.device_put(filled.astype(out.dtype),
+                                out._ctx.jax_device()))
+        return out
+    return NDArray(filled, ctx)
 
 
 def waitall():

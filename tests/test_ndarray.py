@@ -155,6 +155,21 @@ def test_onehot_encode():
                                np.eye(3)[[1, 0, 2]])
 
 
+def test_choose_fill_element_0index():
+    lhs = mx.nd.array([[1., 2., 3.], [4., 5., 6.], [7., 8., 9.]])
+    rhs = mx.nd.array([2, 0, 1])
+    picked = mx.nd.choose_element_0index(lhs, rhs)
+    np.testing.assert_allclose(picked.asnumpy(), [3., 4., 8.])
+    vals = mx.nd.array([-1., -2., -3.])
+    expect = np.array([[1., 2., -1.], [-2., 5., 6.], [7., -3., 9.]])
+    filled = mx.nd.fill_element_0index(lhs, vals, rhs)
+    np.testing.assert_allclose(filled.asnumpy(), expect)
+    # default call leaves lhs untouched; out=lhs is the in-place form
+    np.testing.assert_allclose(lhs.asnumpy()[0], [1., 2., 3.])
+    mx.nd.fill_element_0index(lhs, vals, rhs, out=lhs)
+    np.testing.assert_allclose(lhs.asnumpy(), expect)
+
+
 def test_ndarray_comparison():
     a = mx.nd.array([1.0, 2.0, 3.0])
     b = mx.nd.array([2.0, 2.0, 2.0])
