@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Stacked denoising autoencoder
+(rebuild of example/autoencoder/{autoencoder.py,mnist_sae.py}).
+
+Greedy layer-wise pretraining of each encoder/decoder pair followed by
+end-to-end fine-tuning, as in the reference's AutoEncoderModel: every
+stage is a LinearRegressionOutput symbol trained with the Module API;
+pretrained weights carry over via set_params/arg sharing.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def ae_stage(n_hidden, idx):
+    """One encode->decode stage reconstructing its own input."""
+    data = mx.sym.Variable("data")
+    enc = mx.sym.FullyConnected(data, name=f"enc_{idx}", num_hidden=n_hidden)
+    act = mx.sym.Activation(enc, name=f"enc_act_{idx}", act_type="relu")
+    dec = mx.sym.FullyConnected(act, name=f"dec_{idx}", num_hidden=0)
+    return enc, act, dec
+
+
+def build_stage_sym(n_in, n_hidden, idx, noise=0.2):
+    data = mx.sym.Variable("data")
+    if noise > 0:
+        corrupted = mx.sym.Dropout(data, name=f"noise_{idx}", p=noise)
+    else:
+        corrupted = data
+    enc = mx.sym.FullyConnected(corrupted, name=f"enc_{idx}",
+                                num_hidden=n_hidden)
+    act = mx.sym.Activation(enc, name=f"enc_act_{idx}", act_type="relu")
+    dec = mx.sym.FullyConnected(act, name=f"dec_{idx}", num_hidden=n_in)
+    return mx.sym.LinearRegressionOutput(dec, name=f"rec_{idx}")
+
+
+def build_finetune_sym(dims):
+    """Full encoder->decoder chain over all layers."""
+    x = mx.sym.Variable("data")
+    h = x
+    for i, d in enumerate(dims[1:]):
+        h = mx.sym.FullyConnected(h, name=f"enc_{i}", num_hidden=d)
+        h = mx.sym.Activation(h, name=f"enc_act_{i}", act_type="relu")
+    for i in reversed(range(len(dims) - 1)):
+        h = mx.sym.FullyConnected(h, name=f"dec_{i}", num_hidden=dims[i])
+        if i > 0:
+            h = mx.sym.Activation(h, name=f"dec_act_{i}", act_type="relu")
+    return mx.sym.LinearRegressionOutput(h, name="rec")
+
+
+def encode(params, X, dims):
+    h = X
+    for i in range(len(dims) - 1):
+        h = np.maximum(h @ params[f"enc_{i}_weight"].asnumpy().T
+                       + params[f"enc_{i}_bias"].asnumpy(), 0.0)
+    return h
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--dims", default="784,256,64",
+                   help="comma-separated layer sizes, input first")
+    p.add_argument("--pretrain-epochs", type=int, default=2)
+    p.add_argument("--finetune-epochs", type=int, default=2)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--n-train", type=int, default=2048)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    ctx = mx.tpu(0)
+    dims = [int(d) for d in args.dims.split(",")]
+
+    rng = np.random.RandomState(0)
+    # low-rank structured data so reconstruction is learnable
+    basis = rng.standard_normal((8, dims[0])).astype(np.float32)
+    codes = rng.standard_normal((args.n_train, 8)).astype(np.float32)
+    X = codes @ basis
+
+    pretrained = {}
+    cur = X
+    for i in range(len(dims) - 1):
+        sym = build_stage_sym(cur.shape[1], dims[i + 1], i)
+        mod = mx.mod.Module(sym, label_names=(f"rec_{i}_label",), context=ctx)
+        it = mx.io.NDArrayIter(cur, cur, args.batch_size, shuffle=True,
+                               label_name=f"rec_{i}_label")
+        mod.fit(it, optimizer="adam",
+                optimizer_params={"learning_rate": args.lr},
+                num_epoch=args.pretrain_epochs, eval_metric="mse")
+        arg_params, _ = mod.get_params()
+        pretrained.update(arg_params)
+        # propagate data through the frozen encoder for the next stage
+        w = arg_params[f"enc_{i}_weight"].asnumpy()
+        b = arg_params[f"enc_{i}_bias"].asnumpy()
+        cur = np.maximum(cur @ w.T + b, 0.0)
+        logging.info("pretrained stage %d: %s -> %s", i, w.shape[1], w.shape[0])
+
+    sym = build_finetune_sym(dims)
+    mod = mx.mod.Module(sym, label_names=("rec_label",), context=ctx)
+    it = mx.io.NDArrayIter(X, X, args.batch_size, shuffle=True,
+                           label_name="rec_label")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.set_params({k: mx.nd.array(v.asnumpy()) if hasattr(v, "asnumpy")
+                    else mx.nd.array(v) for k, v in pretrained.items()},
+                   {}, allow_missing=True)
+    mod.fit(it, optimizer="adam", optimizer_params={"learning_rate": args.lr},
+            num_epoch=args.finetune_epochs, eval_metric="mse")
+
+    # report reconstruction error
+    it.reset()
+    se, n = 0.0, 0
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        rec = mod.get_outputs()[0].asnumpy()
+        ref = batch.data[0].asnumpy()
+        se += ((rec - ref) ** 2).sum()
+        n += ref.size
+    print(f"final reconstruction mse {se / n:.4f}")
+
+
+if __name__ == "__main__":
+    main()

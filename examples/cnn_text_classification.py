@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""CNN sentence classification
+(rebuild of example/cnn_text_classification/text_cnn.py — Kim 2014).
+
+Embedding -> parallel Convolutions with filter widths 3/4/5 over the
+token axis -> max-over-time Pooling -> Concat -> Dropout -> softmax.
+Runs on a synthetic keyword-detection corpus by default.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def text_cnn(seq_len, vocab_size, embed_dim=32, filter_sizes=(3, 4, 5),
+             num_filter=32, num_classes=2, dropout=0.5):
+    data = mx.sym.Variable("data")
+    embed = mx.sym.Embedding(data, name="embed", input_dim=vocab_size,
+                             output_dim=embed_dim)
+    # (batch, 1, seq_len, embed_dim) image for the conv layers
+    conv_input = mx.sym.Reshape(embed, target_shape=(0, 1, seq_len, embed_dim))
+    pooled = []
+    for fs in filter_sizes:
+        conv = mx.sym.Convolution(conv_input, name=f"conv{fs}",
+                                  kernel=(fs, embed_dim),
+                                  num_filter=num_filter)
+        act = mx.sym.Activation(conv, act_type="relu")
+        pool = mx.sym.Pooling(act, pool_type="max",
+                              kernel=(seq_len - fs + 1, 1), stride=(1, 1))
+        pooled.append(pool)
+    concat = mx.sym.Concat(*pooled, num_args=len(pooled), dim=1)
+    h = mx.sym.Reshape(concat, target_shape=(0, num_filter * len(filter_sizes)))
+    if dropout > 0:
+        h = mx.sym.Dropout(h, p=dropout)
+    fc = mx.sym.FullyConnected(h, name="cls", num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def synthetic_corpus(n, seq_len, vocab_size, seed=0):
+    """Label 1 iff the 'positive' trigram 7,8,9 appears."""
+    rng = np.random.RandomState(seed)
+    X = rng.randint(10, vocab_size, (n, seq_len))
+    y = rng.randint(0, 2, n)
+    pos = y == 1
+    starts = rng.randint(0, seq_len - 3, pos.sum())
+    for row, s in zip(np.where(pos)[0], starts):
+        X[row, s:s + 3] = [7, 8, 9]
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=50)
+    p.add_argument("--seq-len", type=int, default=24)
+    p.add_argument("--vocab-size", type=int, default=200)
+    p.add_argument("--num-epochs", type=int, default=4)
+    p.add_argument("--lr", type=float, default=0.005)
+    p.add_argument("--n-train", type=int, default=2000)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    X, y = synthetic_corpus(args.n_train, args.seq_len, args.vocab_size)
+    Xv, yv = synthetic_corpus(500, args.seq_len, args.vocab_size, seed=1)
+    net = text_cnn(args.seq_len, args.vocab_size)
+    mod = mx.mod.Module(net, context=mx.tpu(0))
+    mod.fit(mx.io.NDArrayIter(X, y, args.batch_size, shuffle=True),
+            eval_data=mx.io.NDArrayIter(Xv, yv, args.batch_size),
+            optimizer="adam", optimizer_params={"learning_rate": args.lr},
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+    score = mod.score(mx.io.NDArrayIter(Xv, yv, args.batch_size), "acc")
+    acc = dict(score)["accuracy"]
+    print(f"text-cnn validation accuracy {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""DCGAN (rebuild of example/gan/dcgan.py).
+
+Two Modules trained adversarially: the generator G maps noise to
+images via Deconvolution stacks; the discriminator D is bound with
+``inputs_need_grad=True`` so its input gradients drive G's update —
+the same two-module dance as the reference.  Runs on synthetic
+gaussian-blob "images" by default so it works without a dataset.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def make_dcgan_sym(ngf, ndf, nc, size, no_bias=True, fix_gamma=True,
+                   eps=1e-5 + 1e-12):
+    """Generator + discriminator symbols (reference dcgan.py:8-55),
+    scaled down: `size` is the output resolution (a power of two >= 8)."""
+    rand = mx.sym.Variable("rand")
+    # project 1x1 -> 4x4, then upsample by 2 per layer
+    n_up = 0
+    s = 4
+    while s < size:
+        s *= 2
+        n_up += 1
+    filt = ngf * (2 ** n_up)
+    g = mx.sym.Deconvolution(rand, name="g0", kernel=(4, 4),
+                             num_filter=filt, no_bias=no_bias)
+    g = mx.sym.BatchNorm(g, name="gbn0", fix_gamma=fix_gamma, eps=eps)
+    g = mx.sym.Activation(g, name="gact0", act_type="relu")
+    for i in range(1, n_up + 1):
+        filt //= 2
+        last = i == n_up
+        g = mx.sym.Deconvolution(
+            g, name=f"g{i}", kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+            num_filter=nc if last else filt, no_bias=no_bias)
+        if not last:
+            g = mx.sym.BatchNorm(g, name=f"gbn{i}", fix_gamma=fix_gamma,
+                                 eps=eps)
+            g = mx.sym.Activation(g, name=f"gact{i}", act_type="relu")
+    gout = mx.sym.Activation(g, name="gact_out", act_type="tanh")
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    d = data
+    filt = ndf
+    s = size
+    i = 0
+    while s > 4:
+        d = mx.sym.Convolution(d, name=f"d{i}", kernel=(4, 4), stride=(2, 2),
+                               pad=(1, 1), num_filter=filt, no_bias=no_bias)
+        if i > 0:
+            d = mx.sym.BatchNorm(d, name=f"dbn{i}", fix_gamma=fix_gamma,
+                                 eps=eps)
+        d = mx.sym.LeakyReLU(d, name=f"dact{i}", act_type="leaky", slope=0.2)
+        filt *= 2
+        s //= 2
+        i += 1
+    d = mx.sym.Convolution(d, name=f"d{i}", kernel=(4, 4), num_filter=1,
+                           no_bias=no_bias)
+    d = mx.sym.Flatten(d)
+    dloss = mx.sym.LogisticRegressionOutput(data=d, label=label, name="dloss")
+    return gout, dloss
+
+
+class RandIter(mx.io.DataIter):
+    """Endless gaussian-noise source (reference dcgan.py RandIter)."""
+
+    def __init__(self, batch_size, ndim):
+        super().__init__()
+        self.batch_size = batch_size
+        self.ndim = ndim
+        self.provide_data = [("rand", (batch_size, ndim, 1, 1))]
+        self.provide_label = []
+
+    def iter_next(self):
+        return True
+
+    def getdata(self):
+        return [mx.random.normal(0, 1.0,
+                                 shape=(self.batch_size, self.ndim, 1, 1))]
+
+
+def facc(label, pred):
+    return ((pred.ravel() > 0.5) == label.ravel()).mean()
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--size", type=int, default=32, help="image resolution")
+    p.add_argument("--nc", type=int, default=1, help="image channels")
+    p.add_argument("--ngf", type=int, default=32)
+    p.add_argument("--ndf", type=int, default=32)
+    p.add_argument("--z", type=int, default=64, help="noise dim")
+    p.add_argument("--lr", type=float, default=0.0002)
+    p.add_argument("--beta1", type=float, default=0.5)
+    p.add_argument("--num-epochs", type=int, default=1)
+    p.add_argument("--batches-per-epoch", type=int, default=20)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    ctx = mx.tpu(0)
+    bs = args.batch_size
+
+    symG, symD = make_dcgan_sym(args.ngf, args.ndf, args.nc, args.size)
+
+    # synthetic "real" data: smooth blobs in [-1, 1]
+    rng = np.random.RandomState(0)
+    n = bs * args.batches_per_epoch
+    grid = np.linspace(-1, 1, args.size)
+    yy, xx = np.meshgrid(grid, grid, indexing="ij")
+    cx, cy = rng.uniform(-0.5, 0.5, (2, n))
+    X = np.exp(-(((xx[None] - cx[:, None, None]) ** 2
+                  + (yy[None] - cy[:, None, None]) ** 2) / 0.1))
+    X = (X * 2 - 1).astype(np.float32)[:, None].repeat(args.nc, axis=1)
+    train_iter = mx.io.NDArrayIter(X, batch_size=bs)
+    rand_iter = RandIter(bs, args.z)
+    label = mx.nd.zeros((bs,), ctx=ctx)
+
+    modG = mx.mod.Module(symbol=symG, data_names=("rand",), label_names=None,
+                         context=ctx)
+    modG.bind(data_shapes=rand_iter.provide_data)
+    modG.init_params(initializer=mx.init.Normal(0.02))
+    modG.init_optimizer(optimizer="adam", optimizer_params={
+        "learning_rate": args.lr, "wd": 0., "beta1": args.beta1})
+
+    modD = mx.mod.Module(symbol=symD, data_names=("data",),
+                         label_names=("label",), context=ctx)
+    modD.bind(data_shapes=train_iter.provide_data,
+              label_shapes=[("label", (bs,))], inputs_need_grad=True)
+    modD.init_params(initializer=mx.init.Normal(0.02))
+    modD.init_optimizer(optimizer="adam", optimizer_params={
+        "learning_rate": args.lr, "wd": 0., "beta1": args.beta1})
+
+    metric_acc = mx.metric.CustomMetric(facc)
+    for epoch in range(args.num_epochs):
+        train_iter.reset()
+        metric_acc.reset()
+        for t, batch in enumerate(train_iter):
+            rbatch = rand_iter.next()
+            modG.forward(rbatch, is_train=True)
+            out_g = modG.get_outputs()
+
+            # update D: fake batch (label 0) then real batch (label 1)
+            label[:] = 0
+            modD.forward(mx.io.DataBatch(out_g, [label]), is_train=True)
+            modD.backward()
+            grads_fake = [[g.copyto(g.context) for g in grad_list]
+                          for grad_list in modD._exec_group.grad_arrays]
+            label[:] = 1
+            modD.forward(mx.io.DataBatch(batch.data, [label]), is_train=True)
+            modD.backward()
+            for gradsr, gradsf in zip(modD._exec_group.grad_arrays,
+                                      grads_fake):
+                for gr, gf in zip(gradsr, gradsf):
+                    gr += gf
+            modD.update()
+            metric_acc.update([label], modD.get_outputs())
+
+            # update G: fool D (label 1), grads flow through D's inputs
+            label[:] = 1
+            modD.forward(mx.io.DataBatch(out_g, [label]), is_train=True)
+            modD.backward()
+            diff_d = modD.get_input_grads()
+            modG.backward(diff_d)
+            modG.update()
+        name, acc = metric_acc.get()
+        logging.info("epoch %d: D %s=%.3f", epoch, name, acc)
+    print("dcgan done; final D facc %.3f" % acc)
+
+
+if __name__ == "__main__":
+    main()

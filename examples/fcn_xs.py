@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Fully-convolutional segmentation
+(rebuild of example/fcn-xs — FCN-32s/16s-style skip architecture).
+
+Conv trunk -> 1x1 score head -> Deconvolution upsampling, with a skip
+connection merged via Crop (the reference's offset-matching mechanism)
+and a per-pixel SoftmaxOutput (``multi_output=True``).  Trains on
+synthetic blob masks.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def build_fcn(num_classes):
+    data = mx.sym.Variable("data")
+    # stage 1 (full res -> /2)
+    c1 = mx.sym.Convolution(data, name="conv1", kernel=(3, 3), pad=(1, 1),
+                            num_filter=16)
+    a1 = mx.sym.Activation(c1, act_type="relu")
+    p1 = mx.sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    # stage 2 (/2 -> /4)
+    c2 = mx.sym.Convolution(p1, name="conv2", kernel=(3, 3), pad=(1, 1),
+                            num_filter=32)
+    a2 = mx.sym.Activation(c2, act_type="relu")
+    p2 = mx.sym.Pooling(a2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    # deep head at /4
+    score4 = mx.sym.Convolution(p2, name="score4", kernel=(1, 1),
+                                num_filter=num_classes)
+    # upsample /4 -> /2, merge with skip from stage 1 (fcn-16s pattern)
+    up2 = mx.sym.Deconvolution(score4, name="up2", kernel=(4, 4),
+                               stride=(2, 2), pad=(1, 1),
+                               num_filter=num_classes)
+    score2 = mx.sym.Convolution(p1, name="score2", kernel=(1, 1),
+                                num_filter=num_classes)
+    up2c = mx.sym.Crop(up2, score2, name="crop2", num_args=2)
+    fused = up2c + score2
+    # upsample /2 -> full res
+    up1 = mx.sym.Deconvolution(fused, name="up1", kernel=(4, 4),
+                               stride=(2, 2), pad=(1, 1),
+                               num_filter=num_classes)
+    up1c = mx.sym.Crop(up1, data, name="crop1", num_args=2)
+    return mx.sym.SoftmaxOutput(up1c, name="softmax", multi_output=True,
+                                use_ignore=True, ignore_label=255)
+
+
+def make_data(n, size, seed=0):
+    """Images with a bright disc; mask = disc pixels (2-class)."""
+    rng = np.random.RandomState(seed)
+    X = rng.standard_normal((n, 1, size, size)).astype(np.float32) * 0.2
+    y = np.zeros((n, size, size), np.float32)
+    grid = np.arange(size)
+    yy, xx = np.meshgrid(grid, grid, indexing="ij")
+    for i in range(n):
+        cx, cy = rng.randint(size // 4, 3 * size // 4, 2)
+        r = rng.randint(size // 8, size // 4)
+        mask = (xx - cx) ** 2 + (yy - cy) ** 2 < r * r
+        X[i, 0][mask] += 1.5
+        y[i][mask] = 1.0
+    return X, y
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--size", type=int, default=32)
+    p.add_argument("--num-epochs", type=int, default=4)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--n-train", type=int, default=512)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    X, y = make_data(args.n_train, args.size)
+    train = mx.io.NDArrayIter(X, y, args.batch_size, shuffle=True)
+    net = build_fcn(num_classes=2)
+    mod = mx.mod.Module(net, context=mx.tpu(0))
+    def pixel_acc(label, pred):
+        return float((pred.argmax(axis=1) == label).mean())
+
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            num_epoch=args.num_epochs,
+            eval_metric=mx.metric.CustomMetric(pixel_acc),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+
+    # pixel accuracy on training data
+    train.reset()
+    correct = total = 0
+    for batch in train:
+        mod.forward(batch, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
+        lab = batch.label[0].asnumpy()
+        correct += (pred == lab).sum()
+        total += lab.size
+    print(f"fcn pixel accuracy {correct / total:.3f}")
+
+
+if __name__ == "__main__":
+    main()

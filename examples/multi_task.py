@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Multi-task training with grouped loss heads
+(rebuild of example/multi-task/example_multi_task.py).
+
+One trunk, two SoftmaxOutput heads joined with ``mx.sym.Group``; a
+wrapper iterator duplicates the label stream per head and a custom
+multi-head accuracy metric tracks each head separately.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def build_network():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=64)
+    act2 = mx.sym.Activation(fc2, name="relu2", act_type="relu")
+    fc3 = mx.sym.FullyConnected(act2, name="fc3", num_hidden=10)
+    sm1 = mx.sym.SoftmaxOutput(fc3, name="softmax1")
+    # second task: parity of the digit
+    fc4 = mx.sym.FullyConnected(act2, name="fc4", num_hidden=2)
+    sm2 = mx.sym.SoftmaxOutput(fc4, name="softmax2")
+    return mx.sym.Group([sm1, sm2])
+
+
+class MultiTaskIter(mx.io.DataIter):
+    """Wraps a single-label iterator into (digit, parity) label pairs."""
+
+    def __init__(self, data_iter):
+        super().__init__()
+        self.data_iter = data_iter
+        self.batch_size = data_iter.batch_size
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        name, shape = self.data_iter.provide_label[0]
+        return [("softmax1_label", shape), ("softmax2_label", shape)]
+
+    def reset(self):
+        self.data_iter.reset()
+
+    def next(self):
+        batch = self.data_iter.next()
+        digits = batch.label[0]
+        parity = mx.nd.array(digits.asnumpy() % 2)
+        return mx.io.DataBatch(data=batch.data, label=[digits, parity],
+                               pad=batch.pad, index=batch.index)
+
+
+class MultiAccuracy(mx.metric.EvalMetric):
+    """Per-head accuracy (reference Multi_Accuracy)."""
+
+    def __init__(self, num):
+        super().__init__("multi-accuracy", num=num)
+
+    def update(self, labels, preds):
+        for i in range(self.num):
+            pred = preds[i].asnumpy().argmax(axis=1)
+            label = labels[i].asnumpy().astype("int32")
+            self.sum_metric[i] += (pred.flat == label.flat).sum()
+            self.num_inst[i] += len(pred.flat)
+
+    def get(self):
+        accs = [s / max(n, 1) for s, n in zip(self.sum_metric, self.num_inst)]
+        return ([f"task{i}-accuracy" for i in range(self.num)], accs)
+
+
+def synthetic_mnist(n, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    X = rng.standard_normal((n, 784)).astype(np.float32) * 0.3
+    X[np.arange(n), y * 78] += 2.0
+    return X, y.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--num-epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--n-train", type=int, default=4000)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    X, y = synthetic_mnist(args.n_train)
+    Xv, yv = synthetic_mnist(1000, seed=1)
+    train = MultiTaskIter(mx.io.NDArrayIter(X, y, args.batch_size,
+                                            shuffle=True))
+    val = MultiTaskIter(mx.io.NDArrayIter(Xv, yv, args.batch_size))
+    net = build_network()
+    mod = mx.mod.Module(net, label_names=("softmax1_label", "softmax2_label"),
+                        context=mx.tpu(0))
+    metric = MultiAccuracy(num=2)
+    mod.fit(train, eval_data=val, eval_metric=metric,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            num_epoch=args.num_epochs)
+    names, accs = metric.get()
+    for nm, a in zip(names, accs):
+        print(f"{nm}: {a:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Noise-contrastive estimation (rebuild of example/nce-loss/toy_nce.py).
+
+Instead of a full softmax over the vocabulary, each example is scored
+against its true class plus k sampled noise classes; the loss is
+logistic over those k+1 dot products.  Built from Embedding lookups +
+broadcast arithmetic + LogisticRegressionOutput, mirroring the
+reference's nce.py construction.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def nce_loss(data, label_with_noise, label_weight, embed_dim, num_label):
+    """Score data against num_label candidate classes.
+
+    label_with_noise: (batch, num_label) class ids, col 0 = true class.
+    label_weight: (batch, num_label) 1 for the true class else 0.
+    """
+    class_embed = mx.sym.Embedding(label_with_noise, name="class_embed",
+                                   input_dim=1000, output_dim=embed_dim)
+    class_bias = mx.sym.Embedding(label_with_noise, name="class_bias",
+                                  input_dim=1000, output_dim=1)
+    # (batch, 1, d) * (batch, k, d) -> sum over d -> (batch, k)
+    data3 = mx.sym.Reshape(data, target_shape=(0, 1, embed_dim))
+    prod = mx.sym.broadcast_mul(data3, class_embed)
+    dots = mx.sym.sum(prod, axis=2) + mx.sym.Reshape(class_bias,
+                                                     target_shape=(0, -1))
+    return mx.sym.LogisticRegressionOutput(dots, label=label_weight,
+                                           name="nce")
+
+
+def build_net(num_feat, embed_dim, num_label):
+    data = mx.sym.Variable("data")
+    labels = mx.sym.Variable("label_with_noise")
+    weights = mx.sym.Variable("label_weight")
+    fc = mx.sym.FullyConnected(data, name="proj", num_hidden=embed_dim)
+    h = mx.sym.Activation(fc, act_type="tanh")
+    return nce_loss(h, labels, weights, embed_dim, num_label)
+
+
+class NceIter(mx.io.DataIter):
+    """Yields (data, [true + sampled noise classes], weights)."""
+
+    def __init__(self, X, y, batch_size, num_label, vocab, seed=1):
+        super().__init__()
+        self.X, self.y = X, y
+        self.batch_size, self.num_label, self.vocab = (batch_size, num_label,
+                                                       vocab)
+        self.rng = np.random.RandomState(seed)
+        self.cursor = 0
+        self.provide_data = [("data", (batch_size, X.shape[1])),
+                             ("label_with_noise", (batch_size, num_label)),
+                             ("label_weight", (batch_size, num_label))]
+        self.provide_label = []
+
+    def reset(self):
+        self.cursor = 0
+
+    def next(self):
+        if self.cursor + self.batch_size > len(self.X):
+            raise StopIteration
+        i = self.cursor
+        self.cursor += self.batch_size
+        yb = self.y[i:i + self.batch_size]
+        noise = self.rng.randint(0, self.vocab,
+                                 (self.batch_size, self.num_label))
+        noise[:, 0] = yb
+        w = np.zeros_like(noise, np.float32)
+        w[:, 0] = 1.0
+        return mx.io.DataBatch(
+            [mx.nd.array(self.X[i:i + self.batch_size]),
+             mx.nd.array(noise.astype(np.float32)),
+             mx.nd.array(w)], [])
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--vocab", type=int, default=100)
+    p.add_argument("--embed-dim", type=int, default=32)
+    p.add_argument("--num-label", type=int, default=6,
+                   help="1 true + k noise classes")
+    p.add_argument("--num-epochs", type=int, default=5)
+    p.add_argument("--lr", type=float, default=0.02)
+    p.add_argument("--n-train", type=int, default=3200)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+
+    # learnable mapping: class = argmax over feature groups
+    y = rng.randint(0, args.vocab, args.n_train)
+    X = rng.standard_normal((args.n_train, 64)).astype(np.float32) * 0.3
+    X[np.arange(args.n_train), y % 64] += 2.0
+
+    train = NceIter(X, y, args.batch_size, args.num_label, args.vocab)
+    net = build_net(64, args.embed_dim, args.num_label)
+    mod = mx.mod.Module(net,
+                        data_names=("data", "label_with_noise",
+                                    "label_weight"),
+                        label_names=None, context=mx.tpu(0))
+    mod.bind(data_shapes=train.provide_data)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
+    for epoch in range(args.num_epochs):
+        train.reset()
+        losses = []
+        for batch in train:
+            mod.forward(batch, is_train=True)
+            out = mod.get_outputs()[0].asnumpy()
+            # logistic loss against the weight targets (col 0 = positive)
+            w = batch.data[2].asnumpy()
+            eps = 1e-7
+            losses.append(-np.mean(w * np.log(out + eps)
+                                   + (1 - w) * np.log(1 - out + eps)))
+            mod.backward()
+            mod.update()
+        logging.info("epoch %d nce loss %.4f", epoch, np.mean(losses))
+    print(f"nce final loss {np.mean(losses):.4f} "
+          f"(chance = {-np.log(0.5):.4f} per candidate)")
+
+
+if __name__ == "__main__":
+    main()

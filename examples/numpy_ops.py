@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Custom operators in numpy
+(rebuild of example/numpy-ops/custom_softmax.py + numpy_softmax.py).
+
+Defines softmax twice — once as a ``CustomOp`` (the modern bridge) and
+once as a ``NumpyOp`` (the legacy callback op) — and trains the same
+MLP with each, verifying the host-side op path end to end.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+class Softmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        self.assign(out_data[0], req[0], mx.nd.array(e / e.sum(axis=1,
+                                                               keepdims=True)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        l = in_data[1].asnumpy().ravel().astype(np.int64)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(l.shape[0]), l] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y))
+
+
+@mx.operator.register("demo_softmax")
+class SoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Softmax()
+
+
+class NumpySoftmax(mx.operator.NumpyOp):
+    """Same op through the older NumpyOp callback interface
+    (reference example/numpy-ops/numpy_softmax.py)."""
+
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], (in_shape[0][0],)], [in_shape[0]]
+
+    def forward(self, in_data, out_data):
+        x = in_data[0]
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        out_data[0][:] = e / e.sum(axis=1, keepdims=True)
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        l = in_data[1].ravel().astype(np.int64)
+        y = out_data[0].copy()
+        y[np.arange(l.shape[0]), l] -= 1.0
+        in_grad[0][:] = y
+
+
+def build(kind):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=10)
+    label = mx.sym.Variable("softmax_label")
+    if kind == "custom":
+        return mx.sym.Custom(fc2, label, name="softmax",
+                             op_type="demo_softmax")
+    return NumpySoftmax()(data=fc2, label=label, name="softmax")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--num-epochs", type=int, default=2)
+    p.add_argument("--n-train", type=int, default=2000)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 10, args.n_train)
+    X = rng.standard_normal((args.n_train, 784)).astype(np.float32) * 0.3
+    X[np.arange(args.n_train), y * 78] += 2.0
+
+    for kind in ("custom", "numpy"):
+        net = build(kind)
+        mod = mx.mod.Module(net, context=mx.tpu(0))
+        mod.fit(mx.io.NDArrayIter(X, y.astype(np.float32), args.batch_size,
+                                  shuffle=True),
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                num_epoch=args.num_epochs)
+        acc = dict(mod.score(mx.io.NDArrayIter(X, y.astype(np.float32),
+                                               args.batch_size),
+                             "acc"))["accuracy"]
+        print(f"{kind}-op softmax train accuracy {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

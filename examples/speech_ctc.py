@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""CTC acoustic-model training
+(rebuild of example/warpctc/lstm_ocr.py / example/speech-demo shape:
+LSTM over frames + CTC loss with unaligned label sequences).
+
+Synthetic task: each "utterance" is a sequence of noisy one-hot frames
+stretching a short label string; the model must learn the alignment
+itself — exactly what CTC is for.  Uses the fused RNN op and the
+WarpCTC-parity ``mx.sym.ctc_loss``.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def build_net(seq_len, num_feat, num_classes, num_hidden=64):
+    data = mx.sym.Variable("data")            # (batch, seq_len, num_feat)
+    label = mx.sym.Variable("label")          # (batch, label_len), 0 = blank
+    tns = mx.sym.SwapAxis(data, dim1=0, dim2=1)
+    rnn = mx.sym.RNN(tns, name="lstm", mode="lstm", state_size=num_hidden,
+                     num_layers=1,
+                     parameters=mx.sym.Variable("lstm_parameters"),
+                     state=mx.sym.Variable("lstm_state"),
+                     state_cell=mx.sym.Variable("lstm_state_cell"))
+    flat = mx.sym.Reshape(rnn, shape=(-1, num_hidden))
+    fc = mx.sym.FullyConnected(flat, name="cls", num_hidden=num_classes + 1)
+    pred = mx.sym.Reshape(fc, shape=(seq_len, -1, num_classes + 1))
+    return mx.sym.MakeLoss(mx.sym.ctc_loss(pred, label))
+
+
+def make_data(n, seq_len, label_len, num_classes, seed=0):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(1, num_classes + 1, (n, label_len))
+    num_feat = num_classes + 1
+    X = rng.standard_normal((n, seq_len, num_feat)).astype(np.float32) * 0.3
+    reps = seq_len // label_len
+    for i in range(n):
+        for j, c in enumerate(labels[i]):
+            X[i, j * reps:(j + 1) * reps, c] += 2.0
+    return X, labels.astype(np.float32)
+
+
+def greedy_decode(probs):
+    """Collapse repeats, strip blanks (class 0)."""
+    best = probs.argmax(axis=-1)
+    out = []
+    prev = -1
+    for c in best:
+        if c != prev and c != 0:
+            out.append(int(c))
+        prev = c
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=24)
+    p.add_argument("--label-len", type=int, default=4)
+    p.add_argument("--num-classes", type=int, default=8)
+    p.add_argument("--num-epochs", type=int, default=4)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--n-train", type=int, default=1600)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    X, labels = make_data(args.n_train, args.seq_len, args.label_len,
+                          args.num_classes)
+    train = mx.io.NDArrayIter(X, labels, args.batch_size, shuffle=True,
+                              label_name="label")
+    net = build_net(args.seq_len, X.shape[2], args.num_classes)
+    mod = mx.mod.Module(net, label_names=("label",), context=mx.tpu(0))
+    mod.fit(train, optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            num_epoch=args.num_epochs, eval_metric=mx.metric.Loss(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+
+    # decode a sample with a prediction-only executor
+    data = mx.sym.Variable("data")
+    tns = mx.sym.SwapAxis(data, dim1=0, dim2=1)
+    rnn = mx.sym.RNN(tns, name="lstm", mode="lstm", state_size=64,
+                     num_layers=1,
+                     parameters=mx.sym.Variable("lstm_parameters"),
+                     state=mx.sym.Variable("lstm_state"),
+                     state_cell=mx.sym.Variable("lstm_state_cell"))
+    flat = mx.sym.Reshape(rnn, shape=(-1, 64))
+    fc = mx.sym.FullyConnected(flat, name="cls", num_hidden=args.num_classes + 1)
+    pred_sym = mx.sym.SoftmaxActivation(fc)  # (seq_len*1, C+1) rows
+    arg_params, _ = mod.get_params()
+    exe = pred_sym.simple_bind(ctx=mx.tpu(0), grad_req="null",
+                               data=(1,) + X.shape[1:])
+    for k, v in arg_params.items():
+        # skip batch-shaped RNN initial states (zeros; batch differs here)
+        if k in exe.arg_dict and not k.endswith(("state", "state_cell")):
+            exe.arg_dict[k][:] = v
+    exe.arg_dict["data"][:] = X[:1]
+    exe.forward(is_train=False)
+    probs = exe.outputs[0].asnumpy()  # (seq_len, C+1), batch of one
+    print("target:", labels[0].astype(int).tolist())
+    print("decoded:", greedy_decode(probs))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Stochastic-depth residual network
+(rebuild of example/stochastic-depth/{sd_mnist.py,sd_module.py}).
+
+Residual branches are gated per batch by a host-side Bernoulli draw —
+implemented as a CustomOp (the reference gates at the module level;
+the CustomOp bridge is the TPU-native place for host randomness that
+must not be traced into the compiled graph).  At test time branches
+are always on, scaled by their survival probability.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+class StochasticGate(mx.operator.CustomOp):
+    """Multiplies the branch by 0 or 1 (train) / survival prob (test)."""
+
+    def __init__(self, death_rate):
+        self.death_rate = float(death_rate)
+        self._gate = 1.0
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        if is_train:
+            self._gate = float(np.random.rand() >= self.death_rate)
+        else:
+            self._gate = 1.0 - self.death_rate
+        self.assign(out_data[0], req[0], in_data[0] * self._gate)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0] * self._gate)
+
+
+@mx.operator.register("stochastic_gate")
+class StochasticGateProp(mx.operator.CustomOpProp):
+    def __init__(self, death_rate=0.5):
+        super().__init__(need_top_grad=True)
+        self.death_rate = death_rate
+
+    def list_arguments(self):
+        return ["data"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return StochasticGate(self.death_rate)
+
+
+def residual_unit(data, num_filter, name, death_rate):
+    conv1 = mx.sym.Convolution(data, name=f"{name}_conv1", kernel=(3, 3),
+                               pad=(1, 1), num_filter=num_filter)
+    bn1 = mx.sym.BatchNorm(conv1, name=f"{name}_bn1")
+    act1 = mx.sym.Activation(bn1, act_type="relu")
+    conv2 = mx.sym.Convolution(act1, name=f"{name}_conv2", kernel=(3, 3),
+                               pad=(1, 1), num_filter=num_filter)
+    bn2 = mx.sym.BatchNorm(conv2, name=f"{name}_bn2")
+    gated = mx.sym.Custom(bn2, name=f"{name}_gate", op_type="stochastic_gate",
+                          death_rate=death_rate)
+    return mx.sym.Activation(data + gated, act_type="relu")
+
+
+def build_net(num_units=3, num_filter=16, final_death_rate=0.5):
+    data = mx.sym.Variable("data")
+    body = mx.sym.Convolution(data, name="conv0", kernel=(3, 3), pad=(1, 1),
+                              num_filter=num_filter)
+    body = mx.sym.Activation(body, act_type="relu")
+    for i in range(num_units):
+        # linearly-decayed survival (Huang et al.; reference sd_cifar10.py)
+        death_rate = final_death_rate * (i + 1) / num_units
+        body = residual_unit(body, num_filter, f"unit{i}", death_rate)
+    pool = mx.sym.Pooling(body, global_pool=True, pool_type="avg",
+                          kernel=(1, 1))
+    flat = mx.sym.Flatten(pool)
+    fc = mx.sym.FullyConnected(flat, name="fc", num_hidden=10)
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-epochs", type=int, default=2)
+    p.add_argument("--num-units", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--n-train", type=int, default=1280)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 10, args.n_train)
+    X = rng.standard_normal((args.n_train, 1, 14, 14)).astype(np.float32) * .3
+    X[np.arange(args.n_train), 0, y, y] += 2.5
+
+    net = build_net(num_units=args.num_units)
+    mod = mx.mod.Module(net, context=mx.tpu(0))
+    mod.fit(mx.io.NDArrayIter(X, y.astype(np.float32), args.batch_size,
+                              shuffle=True),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            num_epoch=args.num_epochs)
+    acc = dict(mod.score(mx.io.NDArrayIter(X, y.astype(np.float32),
+                                           args.batch_size), "acc"))["accuracy"]
+    print(f"stochastic-depth train accuracy {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

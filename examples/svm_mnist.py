@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""SVM-head classifier (rebuild of example/svm_mnist/svm_mnist.py).
+
+Same MLP trunk as the softmax examples, but the head is ``SVMOutput``
+— hinge loss (L1 or squared L2 via ``use_linear``), exercising the
+margin-loss op on the projected features.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--num-epochs", type=int, default=4)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--n-train", type=int, default=4000)
+    p.add_argument("--linear", action="store_true",
+                   help="L1 hinge instead of squared hinge")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=512)
+    act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=10)
+    net = mx.sym.SVMOutput(fc2, name="svm", use_linear=args.linear)
+
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 10, args.n_train)
+    X = rng.standard_normal((args.n_train, 784)).astype(np.float32) * 0.3
+    X[np.arange(args.n_train), y * 78] += 2.0
+    yv = rng.randint(0, 10, 1000)
+    Xv = rng.standard_normal((1000, 784)).astype(np.float32) * 0.3
+    Xv[np.arange(1000), yv * 78] += 2.0
+
+    mod = mx.mod.Module(net, label_names=("svm_label",), context=mx.tpu(0))
+    train = mx.io.NDArrayIter(X, y.astype(np.float32), args.batch_size,
+                              shuffle=True, label_name="svm_label")
+    val = mx.io.NDArrayIter(Xv, yv.astype(np.float32), args.batch_size,
+                            label_name="svm_label")
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 0.00001},
+            num_epoch=args.num_epochs)
+    acc = dict(mod.score(val, "acc"))["accuracy"]
+    print(f"svm validation accuracy {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,9 @@ def main():
     p.add_argument("--batch", type=int, default=2)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--impl", default="auto", choices=["auto", "flash", "xla"])
+    p.add_argument("--mode", default="ring", choices=["ring", "ulysses"],
+                   help="sequence-parallel scheme: ring (ppermute K/V) or "
+                        "ulysses (all-to-all head regrouping)")
     args = p.parse_args()
 
     import jax
@@ -45,8 +48,9 @@ def main():
 
     def loss_fn(p):
         q, k, v = x @ p["wq"], x @ p["wk"], x @ p["wv"]
-        o = mx.parallel.ring_attention(q, k, v, mesh, "sp", causal=True,
-                                       impl=args.impl)
+        attn = (mx.parallel.ulysses_attention if args.mode == "ulysses"
+                else mx.parallel.ring_attention)
+        o = attn(q, k, v, mesh, "sp", causal=True, impl=args.impl)
         pooled = o.mean(axis=2) @ p["wo"]
         return jnp.mean((pooled - tgt) ** 2)
 

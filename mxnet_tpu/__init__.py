@@ -24,7 +24,7 @@ from . import attribute
 from . import executor
 from .executor import Executor
 from . import initializer
-from .initializer import init
+from . import initializer as init  # reference: mx.init.Xavier() etc.
 from . import optimizer
 from . import optimizer as opt
 from . import metric

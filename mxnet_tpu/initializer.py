@@ -34,8 +34,19 @@ class Initializer:
             self._init_zero(name, arr)
         elif name.endswith("moving_var"):
             self._init_one(name, arr)
+        elif name.endswith("parameters"):
+            # fused-RNN flat parameter vectors ('<name>_parameters').  The
+            # reference initializer could not handle these (acknowledged
+            # TODO at example/rnn/rnn_cell_demo.py:73-85); small-uniform is
+            # the standard LSTM/GRU flat-weight default.
+            self._init_parameters(name, arr)
+        elif name.endswith("state") or name.endswith("state_cell"):
+            self._init_zero(name, arr)  # fused-RNN initial states
         else:
             self._init_default(name, arr)
+
+    def _init_parameters(self, name, arr):
+        arr[:] = np.random.uniform(-0.07, 0.07, arr.shape)
 
     def _init_bilinear(self, name, arr):
         shape = arr.shape

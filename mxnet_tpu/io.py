@@ -86,10 +86,12 @@ class DataIter:
         raise NotImplementedError
 
     def getdata(self):
-        raise NotImplementedError
+        return None
 
     def getlabel(self):
-        raise NotImplementedError
+        # base returns None (reference io.py:152-160 `pass`): label-free
+        # iterators (e.g. a GAN noise source) only override getdata
+        return None
 
     def getindex(self):
         return None
@@ -97,13 +99,12 @@ class DataIter:
     def getpad(self):
         return 0
 
-    @property
-    def provide_data(self):
-        raise NotImplementedError
-
-    @property
-    def provide_label(self):
-        raise NotImplementedError
+    # NOTE: not properties — the reference idiom lets subclasses simply
+    # assign self.provide_data/provide_label in __init__ (e.g. the
+    # reference DCGAN's RandIter, example/gan/dcgan.py:75-80); read-only
+    # properties here would break such user iterators.
+    provide_data = None
+    provide_label = None
 
 
 def _init_data(data, allow_empty, default_name):

@@ -91,6 +91,16 @@ class NDArray:
     def asnumpy(self) -> np.ndarray:
         return np.asarray(jax.device_get(self._data))
 
+    def __array__(self, dtype=None, copy=None):
+        # numpy interop: np.asarray(nd_arr) / np_buf[:] = nd_arr
+        if copy is False:
+            # device_get always copies; NumPy 2 protocol: never-copy
+            # requests must fail rather than silently detach
+            raise ValueError("NDArray cannot be converted to numpy "
+                             "without a copy; use np.asarray(arr) instead")
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
     def asscalar(self):
         if self.size != 1:
             raise ValueError("the array is not scalar-sized")

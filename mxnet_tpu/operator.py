@@ -30,6 +30,35 @@ __all__ = ["CustomOp", "CustomOpProp", "register", "NumpyOp", "NDArrayOp",
 _CUSTOM_REGISTRY = Registry("custom-op")
 
 
+class _HostArray(np.ndarray):
+    """Buffer handed to custom-op callbacks.
+
+    A plain numpy view (callbacks run inside ``jax.pure_callback``,
+    where dispatching jax ops would deadlock the runtime) extended with
+    the NDArray reading surface reference custom ops use
+    (``asnumpy``/``wait_to_read`` — python/mxnet/operator.py passes
+    NDArrays to CustomOp callbacks)."""
+
+    def asnumpy(self):
+        return np.asarray(self)
+
+    def wait_to_read(self):
+        return self
+
+    def wait_to_write(self):
+        return self
+
+    @property
+    def context(self):
+        from .context import cpu
+
+        return cpu()
+
+
+def _host_array(a):
+    return np.ascontiguousarray(a).view(_HostArray)
+
+
 class CustomOp:
     """Base class for custom op execution (operator.py CustomOp)."""
 
@@ -126,11 +155,12 @@ class _CustomOpDef(OpDef):
         n_out = len(out_shapes)
 
         def host_fwd(*arrs):
-            in_data = [np.asarray(a) for a in arrs]
-            out_data = [np.zeros(s, d) for s, d in zip(out_shapes, out_dtypes)]
+            in_data = [_host_array(a) for a in arrs]
+            out_data = [_host_array(np.zeros(s, d))
+                        for s, d in zip(out_shapes, out_dtypes)]
             op.forward(is_train=train, req=["write"] * n_out,
                        in_data=in_data, out_data=out_data, aux=[])
-            return tuple(out_data)
+            return tuple(np.asarray(o) for o in out_data)
 
         result_shapes = tuple(jax.ShapeDtypeStruct(s, d)
                               for s, d in zip(out_shapes, out_dtypes))
@@ -145,13 +175,14 @@ class _CustomOpDef(OpDef):
         def host_bwd(*arrs):
             n_in = len(inputs)
             n_out = len(outputs)
-            in_data = [np.asarray(a) for a in arrs[:n_in]]
-            out_data = [np.asarray(a) for a in arrs[n_in:n_in + n_out]]
-            ograds = [np.asarray(a) for a in arrs[n_in + n_out:]]
-            in_grad = [np.zeros_like(d) for d in in_data]
+            in_data = [_host_array(a) for a in arrs[:n_in]]
+            out_data = [_host_array(a) for a in arrs[n_in:n_in + n_out]]
+            ograds = [_host_array(a) for a in arrs[n_in + n_out:]]
+            in_grad = [_host_array(np.zeros(d.shape, d.dtype))
+                       for d in in_data]
             op.backward(req=["write"] * n_in, out_grad=ograds, in_data=in_data,
                         out_data=out_data, in_grad=in_grad, aux=[])
-            return tuple(in_grad)
+            return tuple(np.asarray(g) for g in in_grad)
 
         result_shapes = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
                               for x in inputs)
@@ -235,20 +266,42 @@ class NumpyOp:
                 return ins, outs, []
 
             def create_operator(self, ctx, shapes, dtypes):
+                # NumpyOp callbacks work on RAW numpy buffers mutated in
+                # place (reference _Native, operator.py NumpyOp), unlike
+                # CustomOp which receives NDArrays.
+                def _buf(x):
+                    # asnumpy() views can be read-only; legacy callbacks
+                    # mutate their buffers in place
+                    return np.array(x.asnumpy())
+
                 class _Op(CustomOp):
                     def forward(self, is_train, req, in_data, out_data, aux):
-                        legacy.forward(in_data=in_data, out_data=out_data)
+                        ins = [_buf(d) for d in in_data]
+                        outs = [_buf(o) for o in out_data]
+                        legacy.forward(in_data=ins, out_data=outs)
+                        for dst, src in zip(out_data, outs):
+                            dst[:] = src
 
                     def backward(self, req, out_grad, in_data, out_data,
                                  in_grad, aux):
-                        legacy.backward(out_grad=out_grad, in_data=in_data,
-                                        out_data=out_data, in_grad=in_grad)
+                        ogs = [_buf(g) for g in out_grad]
+                        ins = [_buf(d) for d in in_data]
+                        outs = [_buf(o) for o in out_data]
+                        igs = [_buf(g) for g in in_grad]
+                        legacy.backward(out_grad=ogs, in_data=ins,
+                                        out_data=outs, in_grad=igs)
+                        for dst, src in zip(in_grad, igs):
+                            dst[:] = src
 
                 return _Op()
 
         register(name)(_Prop)
         self._registered = name
         return name
+
+    def __call__(self, *args, **kwargs):
+        # reference operator.py:33 — instances are callable symbol factories
+        return self.get_symbol(*args, **kwargs)
 
     def get_symbol(self, *args, **kwargs):
         from . import symbol as sym_mod

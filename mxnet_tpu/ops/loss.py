@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..param import Params, field
 from .op import OpDef, register_op, register_simple_op
@@ -82,10 +83,24 @@ class RegressionParam(Params):
 
 
 def _reg_label_shape(self, params, in_shapes):
+    """Label-shape rule of regression_output-inl.h:105-130: default the
+    label to (n,) for (n, 1) outputs / data shape otherwise, and accept
+    any provided label with matching batch dim and total size."""
     d = in_shapes[0]
     if d is None:
         raise ValueError("regression output: data shape unknown")
-    return [tuple(d), tuple(d)], [tuple(d)], []
+    d = tuple(d)
+    lbl = in_shapes[1]
+    if lbl is None:
+        lbl = (d[0],) if len(d) == 2 and d[1] == 1 else d
+    else:
+        lbl = tuple(lbl)
+        if (lbl[0] != d[0]
+                or int(np.prod(lbl)) != int(np.prod(d))):
+            raise ValueError(
+                f"regression output: shape inconsistent, provided label "
+                f"{lbl}, inferred {d}")
+    return [d, lbl], [d], []
 
 
 @register_op("LinearRegressionOutput")

@@ -1,0 +1,120 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+New first-class TPU capability (absent in the reference — SURVEY.md §2.4
+marks sequence parallelism "No").  Complement to ring attention
+(``parallel/ring_attention.py``): instead of rotating K/V shards around
+the ring, two ``all_to_all`` collectives re-shard the activations from
+sequence-parallel to head-parallel layout and back:
+
+    (B, H, S/n, D)  --all_to_all-->  (B, H/n, S, D)
+         attention over the FULL sequence per local head group
+    (B, H/n, S, D)  --all_to_all-->  (B, H, S/n, D)
+
+Each chip then runs an ordinary (flash) attention over its head subset,
+so the attention inner loop needs no per-step communication — the
+tradeoff vs the ring is 2 all-to-alls of activation size against n
+ppermutes of K/V size, and the head count must divide the mesh axis.
+
+API mirrors ``ring_attention``: ``ulysses_attention(q, k, v, mesh,
+axis, causal, impl)`` with q/k/v (batch, heads, seq, head_dim) sharded
+over ``axis`` on the sequence dimension.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["ulysses_attention"]
+
+
+def _dense_attention(q, k, v, scale, causal):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        S = q.shape[2]
+        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_ulysses_run(mesh: Mesh, axis: str, scale: float, causal: bool,
+                       impl: str, block_q: int, block_k: int,
+                       interpret: bool):
+    """Cached compiled program per (mesh, axis, config) — same caching
+    contract as ring_attention's _build_ring_run."""
+    spec = PartitionSpec(None, None, axis, None)
+
+    @jax.jit
+    def run(q, k, v):
+        def shard_fn(q_s, k_s, v_s):
+            # seq-sharded -> head-sharded: split heads, gather sequence
+            def to_heads(x):
+                return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+            qh, kh, vh = to_heads(q_s), to_heads(k_s), to_heads(v_s)
+            if impl == "flash":
+                from ..ops.flash_attention import flash_attention
+
+                oh = flash_attention(qh, kh, vh, causal=causal,
+                                     block_q=block_q, block_k=block_k,
+                                     interpret=interpret)
+            else:
+                oh = _dense_attention(qh, kh, vh, scale, causal)
+            # head-sharded -> seq-sharded: split sequence, gather heads
+            return lax.all_to_all(oh, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        return shard_map(
+            shard_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)(q, k, v)
+
+    return run
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
+                      impl="auto", block_q=128, block_k=128):
+    """All-to-all sequence-parallel multi-head attention.
+
+    q/k/v: (batch, heads, seq, head_dim) sharded over ``axis`` on the
+    sequence dimension (replicated arrays are accepted and sharded
+    here).  Requires heads %% mesh.shape[axis] == 0.  Returns the
+    attention output with the same sequence sharding.
+
+    impl: "flash" = fused Pallas kernel per head group; "xla" = dense
+    softmax attention; "auto" picks flash on TPU when shapes fit.
+    """
+    from ..ops.flash_attention import _on_tpu
+    from .ring_attention import _flash_available
+
+    n_shards = mesh.shape[axis]
+    H = q.shape[1]
+    if H % n_shards != 0:
+        raise ValueError(
+            f"ulysses_attention: heads ({H}) must be divisible by the "
+            f"'{axis}' mesh axis ({n_shards}); use ring_attention for "
+            "head counts that do not divide the mesh")
+    scale = float(1.0 / np.sqrt(q.shape[-1]))
+    S = q.shape[2]
+    interpret = not _on_tpu()
+    if impl == "auto":
+        fits = (S % min(block_q, S) == 0 and S % min(block_k, S) == 0)
+        impl = ("flash" if (not interpret and fits and _flash_available())
+                else "xla")
+    run = _build_ulysses_run(mesh, axis, scale, bool(causal), impl,
+                             block_q, block_k, interpret)
+
+    if not isinstance(q, jax.core.Tracer):
+        sharding = NamedSharding(mesh, PartitionSpec(None, None, axis, None))
+        q = jax.device_put(q, sharding)
+        k = jax.device_put(k, sharding)
+        v = jax.device_put(v, sharding)
+    return run(q, k, v)

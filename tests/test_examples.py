@@ -126,3 +126,114 @@ def test_example_train_moe_runs():
 def test_example_train_cifar10_runs():
     _run_example("train_cifar10.py",
                  ["--num-epochs", "1", "--batch-size", "32"])
+
+
+def test_example_dcgan_runs(capsys):
+    _run_example("dcgan.py",
+                 ["--num-epochs", "1", "--batches-per-epoch", "4",
+                  "--batch-size", "16", "--size", "16"])
+    assert "dcgan done" in capsys.readouterr().out
+
+
+def test_example_adversary_fgsm_runs(capsys):
+    _run_example("adversary_fgsm.py",
+                 ["--num-epochs", "2", "--n-train", "1000",
+                  "--batch-size", "100"])
+    assert "adversarial" in capsys.readouterr().out
+
+
+def test_example_autoencoder_runs(capsys):
+    _run_example("autoencoder.py",
+                 ["--pretrain-epochs", "1", "--finetune-epochs", "1",
+                  "--n-train", "256", "--batch-size", "32",
+                  "--dims", "64,32,16"])
+    assert "reconstruction mse" in capsys.readouterr().out
+
+
+def test_example_cnn_text_classification_runs(capsys):
+    _run_example("cnn_text_classification.py",
+                 ["--num-epochs", "1", "--n-train", "500",
+                  "--batch-size", "50"])
+    assert "validation accuracy" in capsys.readouterr().out
+
+
+def test_example_multi_task_runs(capsys):
+    _run_example("multi_task.py",
+                 ["--num-epochs", "1", "--n-train", "500",
+                  "--batch-size", "50"])
+    assert "task1-accuracy" in capsys.readouterr().out
+
+
+def test_example_svm_mnist_runs(capsys):
+    _run_example("svm_mnist.py",
+                 ["--num-epochs", "1", "--n-train", "500",
+                  "--batch-size", "50"])
+    assert "svm validation accuracy" in capsys.readouterr().out
+
+
+def test_example_stochastic_depth_runs(capsys):
+    _run_example("stochastic_depth.py",
+                 ["--num-epochs", "1", "--n-train", "256",
+                  "--batch-size", "32"])
+    assert "stochastic-depth" in capsys.readouterr().out
+
+
+def test_example_bi_lstm_sort_runs(capsys):
+    _run_example("bi_lstm_sort.py",
+                 ["--num-epochs", "1", "--n-train", "320",
+                  "--batch-size", "32"])
+    assert "target:" in capsys.readouterr().out
+
+
+def test_example_speech_ctc_runs(capsys):
+    _run_example("speech_ctc.py",
+                 ["--num-epochs", "1", "--n-train", "320",
+                  "--batch-size", "32"])
+    assert "decoded:" in capsys.readouterr().out
+
+
+def test_example_bayes_sgld_runs(capsys):
+    _run_example("bayes_sgld.py",
+                 ["--num-epochs", "2", "--burn-in-epochs", "1",
+                  "--n-train", "256"])
+    assert "posterior-average mse" in capsys.readouterr().out
+
+
+def test_example_numpy_ops_runs(capsys):
+    _run_example("numpy_ops.py", ["--num-epochs", "1", "--n-train", "400"])
+    out = capsys.readouterr().out
+    assert "custom-op softmax" in out and "numpy-op softmax" in out
+
+
+def test_example_nce_loss_runs(capsys):
+    _run_example("nce_loss.py", ["--num-epochs", "1", "--n-train", "320"])
+    assert "nce final loss" in capsys.readouterr().out
+
+
+def test_example_rl_policy_gradient_runs(capsys):
+    _run_example("rl_policy_gradient.py", ["--iterations", "30"])
+    assert "avg reward" in capsys.readouterr().out
+
+
+def test_example_fcn_xs_runs(capsys):
+    _run_example("fcn_xs.py",
+                 ["--num-epochs", "1", "--n-train", "64",
+                  "--batch-size", "16"])
+    assert "fcn pixel accuracy" in capsys.readouterr().out
+
+
+def test_example_memcost_runs(capsys):
+    _run_example("memcost.py",
+                 ["--depth", "8", "--batch-size", "64", "--hidden", "128"])
+    assert "temp buffers" in capsys.readouterr().out
+
+
+def test_example_neural_style_runs(capsys):
+    _run_example("neural_style.py", ["--max-iter", "3", "--size", "32"])
+    assert "style transfer done" in capsys.readouterr().out
+
+
+def test_example_train_longcontext_ulysses_runs():
+    _run_example("train_longcontext.py",
+                 ["--sp", "4", "--seq-len", "64", "--dim", "8",
+                  "--heads", "4", "--steps", "3", "--mode", "ulysses"])
