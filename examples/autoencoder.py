@@ -20,15 +20,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import mxnet_tpu as mx  # noqa: E402
 
 
-def ae_stage(n_hidden, idx):
-    """One encode->decode stage reconstructing its own input."""
-    data = mx.sym.Variable("data")
-    enc = mx.sym.FullyConnected(data, name=f"enc_{idx}", num_hidden=n_hidden)
-    act = mx.sym.Activation(enc, name=f"enc_act_{idx}", act_type="relu")
-    dec = mx.sym.FullyConnected(act, name=f"dec_{idx}", num_hidden=0)
-    return enc, act, dec
-
-
 def build_stage_sym(n_in, n_hidden, idx, noise=0.2):
     data = mx.sym.Variable("data")
     if noise > 0:
@@ -54,14 +45,6 @@ def build_finetune_sym(dims):
         if i > 0:
             h = mx.sym.Activation(h, name=f"dec_act_{i}", act_type="relu")
     return mx.sym.LinearRegressionOutput(h, name="rec")
-
-
-def encode(params, X, dims):
-    h = X
-    for i in range(len(dims) - 1):
-        h = np.maximum(h @ params[f"enc_{i}_weight"].asnumpy().T
-                       + params[f"enc_{i}_bias"].asnumpy(), 0.0)
-    return h
 
 
 def main():

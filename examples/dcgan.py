@@ -155,6 +155,7 @@ def main():
             modD.backward()
             grads_fake = [[g.copyto(g.context) for g in grad_list]
                           for grad_list in modD._exec_group.grad_arrays]
+            metric_acc.update([label], modD.get_outputs())
             label[:] = 1
             modD.forward(mx.io.DataBatch(batch.data, [label]), is_train=True)
             modD.backward()

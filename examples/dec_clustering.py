@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Deep embedded clustering (rebuild of example/dec/dec.py).
+
+An encoder maps points to an embedding; a ``NumpyOp`` computes the
+Student-t soft cluster assignment q against learnable centers (the
+reference's DECLoss NumpyOp), and training minimizes KL(p || q) against
+the sharpened target distribution p, re-estimated every few epochs.
+Runs on synthetic gaussian blobs; reports clustering accuracy by
+greedy cluster-to-label matching.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+class DECLoss(mx.operator.NumpyOp):
+    """Soft assignment + KL(p||q) gradient (reference dec.py DECLoss)."""
+
+    def __init__(self, num_centers, alpha=1.0):
+        super().__init__(need_top_grad=False)
+        self.num_centers = num_centers
+        self.alpha = alpha
+
+    def list_arguments(self):
+        return ["data", "label", "mu"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data = in_shape[0]
+        mu = (self.num_centers, data[1])
+        label = (data[0], self.num_centers)
+        return [data, label, mu], [label]
+
+    def forward(self, in_data, out_data):
+        z, _, mu = in_data
+        d2 = ((z[:, None, :] - mu[None, :, :]) ** 2).sum(axis=2)
+        self.mask = 1.0 / (1.0 + d2 / self.alpha)
+        q = self.mask ** ((self.alpha + 1.0) / 2.0)
+        out_data[0][:] = (q.T / q.sum(axis=1)).T
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        z, p, mu = in_data
+        q = out_data[0]
+        # d KL(p||q) / dz with Student-t kernel
+        coeff = (self.alpha + 1.0) / self.alpha * self.mask * (p - q)
+        diff = z[:, None, :] - mu[None, :, :]
+        in_grad[0][:] = (coeff[:, :, None] * diff).sum(axis=1)
+        in_grad[2][:] = -(coeff[:, :, None] * diff).sum(axis=0)
+        in_grad[1][:] = 0.0
+
+
+def target_distribution(q):
+    w = q ** 2 / q.sum(axis=0)
+    return (w.T / w.sum(axis=1)).T
+
+
+def cluster_acc(pred, y, k):
+    """Greedy cluster->label matching accuracy."""
+    total = 0
+    for c in range(k):
+        members = y[pred == c]
+        if len(members):
+            total += np.bincount(members).max()
+    return total / len(y)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-centers", type=int, default=4)
+    p.add_argument("--embed-dim", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--update-interval", type=int, default=2)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--n", type=int, default=1024)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+    k = args.num_centers
+
+    # blobs in 16-D
+    y = rng.randint(0, k, args.n)
+    centers = rng.standard_normal((k, 16)) * 4
+    X = (centers[y] + rng.standard_normal((args.n, 16))).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    mu = mx.sym.Variable("mu")
+    h = mx.sym.FullyConnected(data, name="enc1", num_hidden=32)
+    h = mx.sym.Activation(h, act_type="relu")
+    z = mx.sym.FullyConnected(h, name="enc2", num_hidden=args.embed_dim)
+    dec = DECLoss(k, alpha=1.0)
+    out = mx.sym.MakeLoss(dec(data=z, label=label, mu=mu, name="dec"))
+
+    mod = mx.mod.Module(out, data_names=("data", "label"), label_names=None,
+                        context=mx.tpu(0))
+    # label (the target distribution p) rides as a second data input so
+    # the python loop can feed the re-estimated p; mu is a learnable
+    # parameter updated through DECLoss's in_grad[2]
+    mod.bind(data_shapes=[("data", (args.batch_size, 16)),
+                          ("label", (args.batch_size, k))])
+    mod.init_params(initializer=mx.init.Mixed(
+        ["mu", ".*"], [mx.init.Zero(), mx.init.Xavier()]))
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
+
+    # embed helper
+    emb = z.simple_bind(mx.tpu(0), grad_req="null", data=(args.n, 16))
+
+    def embed():
+        for name, arr in mod.get_params()[0].items():
+            if name in emb.arg_dict:
+                emb.arg_dict[name][:] = arr
+        emb.arg_dict["data"][:] = X
+        emb.forward(is_train=False)
+        return emb.outputs[0].asnumpy()
+
+    def get_mu():
+        return mod.get_params()[0]["mu"].asnumpy()
+
+    # init centers with a few k-means steps on the initial embedding
+    zs = embed()
+    mu_val = zs[rng.choice(args.n, k, replace=False)]
+    for _ in range(10):
+        d = ((zs[:, None] - mu_val[None]) ** 2).sum(2)
+        assign = d.argmin(1)
+        for c in range(k):
+            if (assign == c).any():
+                mu_val[c] = zs[assign == c].mean(0)
+    arg_params, aux_params = mod.get_params()
+    arg_params = dict(arg_params)
+    arg_params["mu"] = mx.nd.array(mu_val.astype(np.float32))
+    mod.set_params(arg_params, aux_params)
+
+    pvals = None
+    for epoch in range(args.epochs):
+        if epoch % args.update_interval == 0:
+            zs = embed()
+            mu_val = get_mu()
+            d2 = ((zs[:, None] - mu_val[None]) ** 2).sum(2)
+            q = 1.0 / (1.0 + d2)
+            q = (q.T / q.sum(1)).T
+            pvals = target_distribution(q)
+            acc = cluster_acc(q.argmax(1), y, k)
+            logging.info("epoch %d cluster acc %.3f", epoch, acc)
+        perm = rng.permutation(args.n)
+        for i in range(0, args.n - args.batch_size + 1, args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            mod.forward(mx.io.DataBatch(
+                [mx.nd.array(X[idx]), mx.nd.array(pvals[idx])]),
+                is_train=True)
+            mod.backward()
+            mod.update()
+
+    zs = embed()
+    mu_val = get_mu()
+    d2 = ((zs[:, None] - mu_val[None]) ** 2).sum(2)
+    acc = cluster_acc(d2.argmin(1), y, k)
+    print(f"dec final cluster accuracy {acc:.3f} over {k} centers")
+
+
+if __name__ == "__main__":
+    main()

@@ -48,7 +48,6 @@ def compile_step(batch, hidden, depth):
 
 
 def main():
-    import argparse
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--batch-size", type=int, default=256)
     p.add_argument("--hidden", type=int, default=512)

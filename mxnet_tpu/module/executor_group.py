@@ -73,32 +73,70 @@ class DataParallelExecutorGroup:
         self.bind_exec(data_shapes, label_shapes)
 
     # -- binding -----------------------------------------------------------
+    @staticmethod
+    def _batch_axis(desc):
+        """Batch ('N') axis of one input from its layout; -1 = no batch
+        axis, the input is replicated whole to every device (reference
+        DataDesc.get_batch_axis + executor_group.py:193 major_axis)."""
+        layout = getattr(desc, "layout", None)
+        if layout is None:
+            return 0
+        return layout.find("N")
+
     def decide_slices(self, data_shapes):
-        """Batch-axis slicing honoring non-batch-major layouts
-        (reference executor_group.py:193)."""
-        batch_axis = 0
-        batch_size = data_shapes[0][1][batch_axis]
+        """Batch-axis slicing honoring per-input layouts
+        (reference executor_group.py:193): every input with a batch axis
+        must agree on the batch size; axis -1 inputs are not sliced."""
+        self.batch_axes = {}
+        batch_size = None
+        for desc in data_shapes:
+            axis = self._batch_axis(desc)
+            self.batch_axes[desc.name] = axis
+            if axis == -1:
+                continue
+            b = desc.shape[axis]
+            if batch_size is None:
+                batch_size = b
+            elif b != batch_size:
+                raise MXNetError(
+                    f"all data must share one batch size: {desc.name} has "
+                    f"shape {desc.shape} (axis {axis}) vs batch {batch_size}"
+                    "; give no-batch-axis inputs a layout without 'N' "
+                    "via mx.io.DataDesc")
+        if batch_size is None:
+            raise MXNetError("at least one input needs a batch axis")
         self.batch_size = batch_size
         self.slices = _split_input_slice(batch_size, self.workload)
 
-    def _sliced_shape(self, shape, islice):
-        return (islice.stop - islice.start,) + tuple(shape[1:])
+    def _sliced_shape(self, shape, islice, axis=0):
+        if axis == -1:
+            return tuple(shape)
+        shape = list(shape)
+        shape[axis] = islice.stop - islice.start
+        return tuple(shape)
+
+    @staticmethod
+    def _slice_along(arr, islice, axis):
+        if axis == -1:
+            return arr
+        if axis == 0:
+            return arr[islice]
+        return arr[(slice(None),) * axis + (islice,)]
 
     def bind_exec(self, data_shapes, label_shapes):
         self.data_shapes = [DataDesc(*d) if not isinstance(d, DataDesc) else d
                             for d in data_shapes]
         self.label_shapes = ([DataDesc(*l) if not isinstance(l, DataDesc) else l
                               for l in label_shapes] if label_shapes else [])
-        self.decide_slices(self.data_shapes)
+        self.decide_slices(self.data_shapes + self.label_shapes)
         self.data_names = [d.name for d in self.data_shapes]
         self.label_names = [l.name for l in self.label_shapes]
         self.execs = []
         for i, ctx in enumerate(self.contexts):
             islice = self.slices[i]
-            shapes = {d.name: self._sliced_shape(d.shape, islice)
-                      for d in self.data_shapes}
-            for l in self.label_shapes:
-                shapes[l.name] = self._sliced_shape(l.shape, islice)
+            shapes = {d.name: self._sliced_shape(d.shape, islice,
+                                                 self.batch_axes[d.name])
+                      for d in self.data_shapes + self.label_shapes}
             exe = self.symbol.simple_bind(ctx, grad_req=self.grad_req, **shapes)
             self.execs.append(exe)
 
@@ -129,10 +167,12 @@ class DataParallelExecutorGroup:
         for i, exe in enumerate(self.execs):
             islice = self.slices[i]
             for name, arr in zip(self.data_names, data):
-                exe.arg_dict[name][:] = arr[islice]
+                exe.arg_dict[name][:] = self._slice_along(
+                    arr, islice, self.batch_axes[name])
             for name, arr in zip(self.label_names, labels):
                 if name in exe.arg_dict:
-                    exe.arg_dict[name][:] = arr[islice]
+                    exe.arg_dict[name][:] = self._slice_along(
+                        arr, islice, self.batch_axes[name])
             exe.forward(is_train=is_train)
 
     def get_outputs(self, merge_multi_context=True):
@@ -164,7 +204,9 @@ class DataParallelExecutorGroup:
     def update_metric(self, eval_metric, labels):
         for i, exe in enumerate(self.execs):
             islice = self.slices[i]
-            labels_slice = [label[islice] for label in labels]
+            labels_slice = [self._slice_along(label, islice,
+                                              self.batch_axes.get(name, 0))
+                            for name, label in zip(self.label_names, labels)]
             eval_metric.update(labels_slice, exe.outputs)
 
     @property

@@ -237,3 +237,13 @@ def test_example_train_longcontext_ulysses_runs():
     _run_example("train_longcontext.py",
                  ["--sp", "4", "--seq-len", "64", "--dim", "8",
                   "--heads", "4", "--steps", "3", "--mode", "ulysses"])
+
+
+def test_example_dec_clustering_runs(capsys):
+    _run_example("dec_clustering.py", ["--epochs", "2", "--n", "512"])
+    assert "cluster accuracy" in capsys.readouterr().out
+
+
+def test_example_rcnn_roi_runs(capsys):
+    _run_example("rcnn_roi.py", ["--iterations", "30"])
+    assert "roi-head accuracy" in capsys.readouterr().out

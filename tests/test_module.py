@@ -193,3 +193,38 @@ def test_feedforward_save_load(tmp_path):
     p1 = model.predict(X)
     p2 = model2.predict(X)
     np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_executor_group_no_batch_axis_input():
+    """Inputs with a layout lacking 'N' (DataDesc batch axis -1) are
+    replicated whole, not sliced — the reference's rcnn rois pattern."""
+    data = mx.sym.Variable("data")            # (batch, 4)
+    rois = mx.sym.Variable("rois")            # (R, 2), no batch axis
+    # broadcastable combine: mean of rois added to every sample's fc
+    fc = mx.sym.FullyConnected(data, name="fc", num_hidden=2)
+    pooled = mx.sym.sum(rois, axis=0) * 0.01
+    out = mx.sym.MakeLoss(mx.sym.sum(fc) + mx.sym.sum(pooled))
+    mod = mx.mod.Module(out, data_names=("data", "rois"), label_names=None,
+                        context=[mx.cpu(0), mx.cpu(0)])  # 2-exec slicing
+    R = 7  # deliberately != batch and odd, unsliceable across 2 devices
+    mod.bind(data_shapes=[("data", (8, 4)),
+                          mx.io.DataDesc("rois", (R, 2), layout="")])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    batch = mx.io.DataBatch([mx.nd.ones((8, 4)), mx.nd.ones((R, 2))], [])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    out = mod.get_outputs(merge_multi_context=False)[0]
+    assert len(out) == 2  # one scalar loss per device-slice
+    assert all(np.isfinite(o.asnumpy()).all() for o in out)
+
+
+def test_executor_group_mismatched_batch_sizes_error():
+    data = mx.sym.Variable("data")
+    other = mx.sym.Variable("other")
+    out = mx.sym.MakeLoss(mx.sym.sum(data) + mx.sym.sum(other))
+    mod = mx.mod.Module(out, data_names=("data", "other"), label_names=None,
+                        context=mx.cpu(0))
+    with pytest.raises(mx.base.MXNetError, match="batch size"):
+        mod.bind(data_shapes=[("data", (8, 4)), ("other", (6, 4))])
