@@ -31,6 +31,7 @@ from . import metric
 from . import lr_scheduler
 from . import callback
 from . import monitor
+from . import monitor as mon  # reference: mx.mon.Monitor
 from . import profiler
 from . import io
 from . import recordio
@@ -42,6 +43,7 @@ from . import cv
 io.ImageRecordIter = ImageRecordIter  # reference exposes it under mx.io
 from . import kvstore
 from . import kvstore as kv
+from . import kvstore_server
 from . import model
 from .model import FeedForward
 from . import module

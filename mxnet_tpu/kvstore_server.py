@@ -1,0 +1,64 @@
+"""Server-process entry wrapper (rebuild of
+python/mxnet/kvstore_server.py).
+
+The reference auto-enters a server event loop when ``DMLC_ROLE=server``
+(`_init_kvstore_server_module`, kvstore_server.py:58) and wraps it in a
+``KVStoreServer`` class whose ``run()`` blocks until a stop command.
+Here the server is :class:`mxnet_tpu.ps.PSServer` (started standalone by
+``tools/launch.py -s N`` as ``python -m mxnet_tpu.ps``); this module
+keeps the reference's class/entry shape for code that imports it
+directly.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .ps import PSServer
+
+__all__ = ["KVStoreServer", "server_role"]
+
+
+def server_role():
+    """True when this process was launched as a parameter-server shard
+    (reference: ``DMLC_ROLE == 'server'``)."""
+    return os.environ.get("DMLC_ROLE", os.environ.get("MXTPU_ROLE", "")) \
+        == "server"
+
+
+class KVStoreServer:
+    """Blocking server wrapper (reference kvstore_server.py:11-57).
+
+    The reference wraps a worker-side KVStore handle; here the server is
+    self-contained — construct with the worker count (and optional
+    host/port) and ``run()`` serves until a stop command arrives from
+    rank 0 (the reference's ``kStopServer`` command analog).
+    """
+
+    def __init__(self, num_workers, host="127.0.0.1", port=0):
+        self.num_workers = int(num_workers)
+        self.host = host
+        self.port = port
+        self._server = None
+
+    @property
+    def address(self):
+        if self._server is None:
+            raise RuntimeError("server not started; call run()")
+        return self._server.addr
+
+    def run(self):
+        """Serve until stopped (reference KVStoreServer.run)."""
+        self._server = PSServer(self.num_workers, port=self.port,
+                                host=self.host).start()
+        self._server.join()
+
+
+def _init_kvstore_server_module(num_workers=None):
+    """Enter the server loop when launched in the server role
+    (reference kvstore_server.py:58-67)."""
+    if num_workers is None:
+        num_workers = int(os.environ.get("DMLC_NUM_WORKER",
+                                         os.environ.get("MXTPU_NUM_PROCS",
+                                                        "1")))
+    KVStoreServer(num_workers).run()

@@ -187,9 +187,23 @@ class DataParallelExecutorGroup:
             raise MXNetError("bind with inputs_need_grad=True first")
         grads = [[exe.grad_dict[name] for exe in self.execs]
                  for name in self.data_names]
-        if merge_multi_context:
-            return _merge_multi_context(grads)
-        return grads
+        if not merge_multi_context:
+            return grads
+        merged = []
+        for name, parts in zip(self.data_names, grads):
+            axis = self.batch_axes[name]
+            if len(parts) == 1:
+                merged.append(parts[0])
+            elif axis == -1:
+                # replicated input: every device saw the whole array, so
+                # per-device gradients sum (not concatenate)
+                total = parts[0]
+                for p in parts[1:]:
+                    total = total + p
+                merged.append(total)
+            else:
+                merged.append(nd.concatenate(parts, axis=axis))
+        return merged
 
     def backward(self, out_grads=None):
         if not self.for_training:
@@ -202,11 +216,16 @@ class DataParallelExecutorGroup:
                 exe.backward([g[islice] for g in out_grads])
 
     def update_metric(self, eval_metric, labels):
+        # when bound without label_shapes (or handed labels that don't
+        # match the bound names), axes are unknown: slice along axis 0
+        if len(self.label_names) == len(labels):
+            axes = [self.batch_axes.get(n, 0) for n in self.label_names]
+        else:
+            axes = [0] * len(labels)
         for i, exe in enumerate(self.execs):
             islice = self.slices[i]
-            labels_slice = [self._slice_along(label, islice,
-                                              self.batch_axes.get(name, 0))
-                            for name, label in zip(self.label_names, labels)]
+            labels_slice = [self._slice_along(label, islice, axis)
+                            for axis, label in zip(axes, labels)]
             eval_metric.update(labels_slice, exe.outputs)
 
     @property

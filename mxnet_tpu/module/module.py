@@ -176,10 +176,12 @@ class Module(BaseModule):
             if kvstore and kvstore.type == "dist_sync":
                 batch_size *= kvstore.num_workers
             idx2name = dict(enumerate(self._param_names))
+            optimizer_params = dict(optimizer_params)
+            # default rescale to 1/global-batch; explicit user value wins
+            optimizer_params.setdefault("rescale_grad", 1.0 / batch_size)
             optimizer = opt.create(
-                optimizer, rescale_grad=(1.0 / batch_size),
-                param_idx2name=idx2name, sym=self._symbol,
-                **dict(optimizer_params))
+                optimizer, param_idx2name=idx2name, sym=self._symbol,
+                **optimizer_params)
         self._optimizer = optimizer
         self._kvstore = kvstore
         self._update_on_kvstore = update_on_kvstore

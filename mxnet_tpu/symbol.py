@@ -141,6 +141,9 @@ def _topo_order(head_nodes):
     return order
 
 
+_GRAD_OP_CACHE = {}  # (graph-head ids, wrt) -> registered grad-op name
+
+
 class Symbol:
     """A list of output heads over a shared node graph."""
 
@@ -302,9 +305,105 @@ class Symbol:
         out_types = [types["out", id(n), i] for n, i in self._heads]
         return arg_types, out_types, aux_types
 
+    def grad(self, wrt):
+        """Gradient symbol (reference symbol.py:859 `Symbol.grad` /
+        `MXSymbolGrad` c_api.cc:770 -> Symbol::Grad).
+
+        Only meaningful on loss symbols: returns a new Symbol with the
+        same argument names whose outputs are d(loss)/d(arg) for each
+        name in ``wrt`` (head gradients are ones, the loss-layer
+        backward convention).  The gradient computation is ``jax.vjp``
+        over the traced graph, so it is itself traceable/jittable and
+        differentiable again (second-order — beyond the reference).
+        """
+        from .ops.op import OpDef
+
+        base = self
+        wrt = [wrt] if isinstance(wrt, str) else list(wrt)
+        arg_names = base.list_arguments()
+        aux_names = base.list_auxiliary_states()
+        for w in wrt:
+            if w not in arg_names:
+                raise MXNetError(
+                    f"grad: {w!r} is not an argument of this symbol "
+                    f"(arguments: {arg_names})")
+        # one registered op per (graph head, wrt): repeated grad() calls
+        # in a loop reuse it instead of growing the registry
+        cache_key = (tuple((id(n), i) for n, i in self._heads), tuple(wrt))
+        cached_name = _GRAD_OP_CACHE.get(cache_key)
+        if cached_name is not None:
+            bound = {a: Variable(a) for a in arg_names}
+            return _create(cached_name, [], {**bound, "name": cached_name})
+        has_rng = any(not n.is_variable and n.op.need_rng
+                      for n in base._topo())
+
+        class _GradOp(OpDef):
+            need_rng = has_rng
+
+            def __init__(self):
+                self._graph = None
+
+            def list_arguments(self, params):
+                return list(arg_names)
+
+            def list_outputs(self, params):
+                return [f"{w}_grad" for w in wrt]
+
+            def list_auxiliary_states(self, params):
+                return list(aux_names)
+
+            def infer_shape(self, params, in_shapes):
+                known = {n: s for n, s in zip(arg_names, in_shapes)
+                         if s is not None}
+                arg_shapes, _, aux_shapes = base.infer_shape(**known)
+                outs = [arg_shapes[arg_names.index(w)] for w in wrt]
+                return list(arg_shapes), outs, list(aux_shapes)
+
+            def infer_dtype(self, params, in_dtypes):
+                ins, _, auxs = OpDef.infer_dtype(self, params, in_dtypes)
+                return ins, [ins[arg_names.index(w)] for w in wrt], auxs
+
+            def forward(self, params, inputs, aux, train, key):
+                import jax
+                import jax.numpy as jnp
+
+                from .executor import _CompiledGraph
+
+                if self._graph is None:
+                    self._graph = _CompiledGraph(base)
+                graph = self._graph
+                arg_vals = dict(zip(arg_names, inputs))
+                aux_vals = dict(zip(aux_names, aux))
+
+                def f(wvals):
+                    av = dict(arg_vals)
+                    av.update(zip(wrt, wvals))
+                    outs, _ = graph(av, aux_vals, key, train)
+                    return tuple(outs)
+
+                outs, vjp = jax.vjp(f, [arg_vals[w] for w in wrt])
+                grads = vjp(tuple(jnp.ones_like(o) for o in outs))[0]
+                return list(grads), list(aux)
+
+        op = _GradOp()
+        gname = f"_grad_{id(op):x}"
+        op.name = gname
+        op.serializable = False  # process-local closure over `base`
+        OP_REGISTRY.register(gname, op)
+        _GRAD_OP_CACHE[cache_key] = gname
+        bound = {a: Variable(a) for a in arg_names}
+        return _create(gname, [], {**bound, "name": gname})
+
     # -- serialization (static_graph.cc:601-616 JSON contract) --------------
     def tojson(self) -> str:
         nodes = self._topo()
+        for n in nodes:
+            if not n.is_variable and not getattr(n.op, "serializable", True):
+                raise MXNetError(
+                    f"symbol contains process-local op {n.op.name!r} "
+                    "(e.g. a Symbol.grad result) and cannot be serialized; "
+                    "save the base symbol and re-derive the gradient after "
+                    "loading")
         node_ids = {id(n): i for i, n in enumerate(nodes)}
         out_nodes = []
         for n in nodes:

@@ -119,3 +119,33 @@ def test_optimizer_state_roundtrip(tmp_path):
     fname = str(tmp_path / "states.bin")
     kv.save_optimizer_states(fname)
     kv.load_optimizer_states(fname)
+
+
+def test_kvstore_server_module_wrapper():
+    """mx.kvstore_server.KVStoreServer runs a PS shard with the
+    reference entry shape (kvstore_server.py:11-57)."""
+    import threading
+    import time
+
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    from mxnet_tpu.ps import PSClient
+
+    srv = KVStoreServer(num_workers=1)
+    t = threading.Thread(target=srv.run, daemon=True)
+    t.start()
+    addr = None
+    for _ in range(100):
+        try:
+            addr = srv.address
+            break
+        except RuntimeError:
+            time.sleep(0.05)
+    assert addr is not None
+    client = PSClient(addr)
+    client.request("init", 3, np.arange(4, dtype=np.float32), True)
+    got = np.asarray(client.request("pull", 3))
+    np.testing.assert_array_equal(got, np.arange(4, dtype=np.float32))
+    client.request("command", "stop", b"")
+    client.close()
+    t.join(timeout=5)
+    assert not t.is_alive()

@@ -228,3 +228,28 @@ def test_executor_group_mismatched_batch_sizes_error():
                         context=mx.cpu(0))
     with pytest.raises(mx.base.MXNetError, match="batch size"):
         mod.bind(data_shapes=[("data", (8, 4)), ("other", (6, 4))])
+
+
+def test_executor_group_replicated_input_grads_sum():
+    """inputs_need_grad + a replicated (axis -1) input: per-device grads
+    sum instead of concatenating."""
+    data = mx.sym.Variable("data")
+    shared = mx.sym.Variable("shared")
+    out = mx.sym.MakeLoss(mx.sym.sum(data * mx.sym.sum(shared)))
+    mod = mx.mod.Module(out, data_names=("data", "shared"), label_names=None,
+                        context=[mx.cpu(0), mx.cpu(0)])
+    mod.bind(data_shapes=[("data", (8, 3)),
+                          mx.io.DataDesc("shared", (5,), layout="")],
+             inputs_need_grad=True)
+    mod.init_params()
+    x = np.arange(24, dtype=np.float32).reshape(8, 3)
+    s = np.ones(5, np.float32)
+    mod.forward(mx.io.DataBatch([mx.nd.array(x), mx.nd.array(s)], []),
+                is_train=True)
+    mod.backward()
+    gd, gs = mod.get_input_grads()
+    assert gd.shape == (8, 3) and gs.shape == (5,)
+    # d/d shared sum(data * sum(shared)) = sum(data) per element
+    np.testing.assert_allclose(gs.asnumpy(), np.full(5, x.sum()), rtol=1e-5)
+    np.testing.assert_allclose(gd.asnumpy(), np.full((8, 3), s.sum()),
+                               rtol=1e-5)

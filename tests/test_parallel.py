@@ -42,6 +42,7 @@ def test_allreduce_bench_runs():
 
 
 def test_sharded_trainer_dp():
+    np.random.seed(0)  # Xavier draws from numpy's global state
     X, y = _toy()
     net = mx.models.mlp(num_classes=4)
     mesh = mx.parallel.make_mesh({"dp": 8})
@@ -121,3 +122,42 @@ def test_trainer_checkpoint_surface():
     for k in params:
         np.testing.assert_allclose(np.asarray(jax.device_get(tr2.params[k])),
                                    params[k], rtol=1e-6)
+
+
+def test_module_multi_device_training_parity():
+    """1-context vs 2-context data-parallel Module training produces the
+    same parameters given the same init and batches (the nightly
+    multi_lenet.py equality concept, tests/nightly/multi_lenet.py)."""
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(0)
+    X = rng.standard_normal((64, 20)).astype(np.float32)
+    y = rng.randint(0, 4, 64).astype(np.float32)
+
+    def build():
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+        act = mx.sym.Activation(fc1, act_type="relu")
+        fc2 = mx.sym.FullyConnected(act, name="fc2", num_hidden=4)
+        return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    def train(ctxs):
+        np.random.seed(7)  # initializers draw from numpy's global state
+        mod = mx.mod.Module(build(), context=ctxs)
+        it = mx.io.NDArrayIter(X, y, 32)
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(initializer=mx.init.Xavier(rnd_type="gaussian"))
+        mod.init_optimizer(optimizer="sgd", optimizer_params={
+            "learning_rate": 0.1, "rescale_grad": 1.0 / 32})
+        for _ in range(3):
+            it.reset()
+            for batch in it:
+                mod.forward_backward(batch)
+                mod.update()
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    p1 = train([mx.cpu(0)])
+    p2 = train([mx.cpu(0), mx.cpu(0)])
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p2[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)

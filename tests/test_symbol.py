@@ -143,3 +143,37 @@ def test_symbol_doc_helpers():
     assert "kernel" in doc and "required" in doc
     doc2 = build_doc("Pooling")
     assert "pool_type" in doc2
+
+
+def test_symbol_grad():
+    """Symbol.grad(wrt) returns a gradient symbol (reference
+    symbol.py:859 / MXSymbolGrad c_api.cc:770)."""
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, name="fc", num_hidden=3)
+    net = mx.sym.LinearRegressionOutput(fc, name="lro")
+    g = net.grad(["fc_weight", "data"])
+    assert g.list_arguments() == net.list_arguments()
+    assert len(g.list_outputs()) == 2
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 5).astype(np.float32)
+    w = rng.randn(3, 5).astype(np.float32)
+    lbl = rng.randn(4, 3).astype(np.float32)
+    exe = g.simple_bind(mx.cpu(), grad_req="null", data=(4, 5),
+                        fc_weight=(3, 5), fc_bias=(3,), lro_label=(4, 3))
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["fc_weight"][:] = w
+    exe.arg_dict["fc_bias"][:] = 0
+    exe.arg_dict["lro_label"][:] = lbl
+    exe.forward(is_train=True)
+    gw, gd = [o.asnumpy() for o in exe.outputs]
+    gy = (x @ w.T - lbl) / 4  # LinearRegressionOutput backward
+    np.testing.assert_allclose(gw, gy.T @ x, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(gd, gy @ w, rtol=1e-5, atol=1e-6)
+
+
+def test_symbol_grad_unknown_arg_errors():
+    data = mx.sym.Variable("data")
+    net = mx.sym.MakeLoss(mx.sym.sum(data * data))
+    with pytest.raises(mx.base.MXNetError, match="not an argument"):
+        net.grad(["nope"])
