@@ -50,6 +50,8 @@ from . import module
 from . import module as mod
 from . import visualization
 from . import visualization as viz
+# notebook (PandasLogger/LiveLearningCurve) is imported on demand, like
+# the reference: `from mxnet_tpu.notebook import callback`
 from . import test_utils
 from . import operator
 from . import rtc

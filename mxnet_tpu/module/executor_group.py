@@ -216,12 +216,12 @@ class DataParallelExecutorGroup:
                 exe.backward([g[islice] for g in out_grads])
 
     def update_metric(self, eval_metric, labels):
-        # when bound without label_shapes (or handed labels that don't
-        # match the bound names), axes are unknown: slice along axis 0
-        if len(self.label_names) == len(labels):
-            axes = [self.batch_axes.get(n, 0) for n in self.label_names]
-        else:
-            axes = [0] * len(labels)
+        # labels pair positionally with the bound label names; extra
+        # labels beyond the bound names (incl. the bound-without-labels
+        # case) slice along axis 0
+        axes = [self.batch_axes.get(n, 0)
+                for n in self.label_names[:len(labels)]]
+        axes += [0] * (len(labels) - len(axes))
         for i, exe in enumerate(self.execs):
             islice = self.slices[i]
             labels_slice = [self._slice_along(label, islice, axis)

@@ -138,6 +138,20 @@ class NDArray:
             shape = (shape,)
         return NDArray(jnp.reshape(self._data, shape), self._ctx)
 
+    def broadcast_to(self, shape):
+        """Broadcast to ``shape``, allowing only size-1 dims to grow
+        (reference ndarray.py broadcast_to)."""
+        cur = self.shape
+        if len(cur) != len(shape):
+            cur = (1,) * (len(shape) - len(cur)) + tuple(cur)
+        for c, t in zip(cur, shape):
+            if c != t and c != 1:
+                raise ValueError(
+                    f"cannot broadcast {self.shape} to {tuple(shape)}: only "
+                    "size-1 dimensions may be expanded")
+        return NDArray(jnp.broadcast_to(self._data.reshape(cur), shape),
+                       self._ctx)
+
     # -- mutation ----------------------------------------------------------
     def _check_writable(self):
         if not self.writable:

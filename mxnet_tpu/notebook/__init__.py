@@ -1,0 +1,3 @@
+"""Notebook utilities (rebuild of python/mxnet/notebook/)."""
+
+from . import callback

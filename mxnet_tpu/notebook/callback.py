@@ -1,0 +1,146 @@
+"""Training-visualization callbacks for notebooks
+(rebuild of python/mxnet/notebook/callback.py).
+
+The reference renders live bokeh charts from batch/epoch callbacks and
+logs metric history into pandas frames.  Same surface here, with the
+same graceful degradation the reference practices (its import guards):
+history always accumulates; ``PandasLogger`` hands back DataFrames when
+pandas is importable and plain dict-of-lists otherwise; the live chart
+draws with matplotlib when available and stays silent headless.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def _metric_pairs(eval_metric):
+    names, values = eval_metric.get()
+    if not isinstance(names, (list, tuple)):
+        names, values = [names], [values]
+    return list(zip(names, values))
+
+
+class MetricHistory:
+    """Accumulates (epoch, batch, metric) rows from the standard
+    batch/epoch callback protocol; base for the loggers/charts."""
+
+    def __init__(self, frequent=50):
+        self.frequent = frequent
+        self.train = []      # rows: {epoch, batch, elapsed, <metrics...>}
+        self.eval = []       # rows: {epoch, elapsed, <metrics...>}
+        self._start = time.time()
+
+    # -- callback protocol --------------------------------------------------
+    def __call__(self, param):
+        self.train_cb(param)
+
+    def train_cb(self, param):
+        if param.nbatch % self.frequent != 0:
+            return
+        row = {"epoch": param.epoch, "batch": param.nbatch,
+               "elapsed": time.time() - self._start}
+        row.update(_metric_pairs(param.eval_metric))
+        self.train.append(row)
+        self._on_update()
+
+    def epoch_cb(self, epoch=None, symbol=None, arg_params=None,
+                 aux_params=None):
+        self._on_update()
+
+    def eval_cb(self, param):
+        row = {"epoch": param.epoch, "elapsed": time.time() - self._start}
+        row.update(_metric_pairs(param.eval_metric))
+        self.eval.append(row)
+        self._on_update()
+
+    def _on_update(self):
+        pass
+
+
+class PandasLogger(MetricHistory):
+    """Metric history as pandas DataFrames (reference PandasLogger).
+
+    ``train_df`` / ``eval_df`` return DataFrames when pandas is
+    available, else the raw list of row dicts.
+    """
+
+    def _frame(self, rows):
+        try:  # lazy: pandas costs ~0.5s to import and is optional
+            import pandas as pd
+        except ImportError:
+            return rows
+        return pd.DataFrame(rows)
+
+    @property
+    def train_df(self):
+        return self._frame(self.train)
+
+    @property
+    def eval_df(self):
+        return self._frame(self.eval)
+
+
+class LiveLearningCurve(MetricHistory):
+    """Live-updating learning curve (reference LiveLearningCurve, bokeh
+    -> matplotlib here).  Creates the figure lazily on first update so
+    constructing the callback is safe on headless machines."""
+
+    def __init__(self, metric_name="accuracy", frequent=50):
+        super().__init__(frequent=frequent)
+        self.metric_name = metric_name
+        self._fig = None
+        self._disabled = False
+
+    def _on_update(self):
+        if self._disabled:
+            return
+        try:
+            import matplotlib
+            import matplotlib.pyplot as plt
+        except ImportError:
+            self._disabled = True
+            return
+        xs, ys = [], []
+        for row in self.train:
+            if self.metric_name in row:
+                xs.append(row["elapsed"])
+                ys.append(row[self.metric_name])
+        if not xs:
+            return
+        if self._fig is None:
+            self._fig, self._ax = plt.subplots(figsize=(6, 3))
+            self._plt = plt
+        self._ax.clear()
+        self._ax.plot(xs, ys, label=f"train {self.metric_name}")
+        ex = [r["elapsed"] for r in self.eval if self.metric_name in r]
+        ey = [r[self.metric_name] for r in self.eval if self.metric_name in r]
+        if ex:
+            self._ax.plot(ex, ey, label=f"eval {self.metric_name}")
+        self._ax.set_xlabel("seconds")
+        self._ax.set_ylabel(self.metric_name)
+        self._ax.legend(loc="lower right")
+        try:  # live redraw inside IPython; a plain script just keeps history
+            from IPython import display
+
+            display.clear_output(wait=True)
+            display.display(self._fig)
+        except Exception:
+            pass
+
+    def savefig(self, path):
+        self._on_update()
+        if self._fig is not None:
+            self._fig.savefig(path)
+
+    def close(self):
+        """Release the figure from pyplot's global registry."""
+        if self._fig is not None:
+            self._plt.close(self._fig)
+            self._fig = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -48,6 +48,10 @@ class Registry:
             )
         return self._entries[key]
 
+    def remove(self, name: str):
+        """Drop an entry (used to evict transient process-local ops)."""
+        self._entries.pop(name.lower(), None)
+
     def find(self, name: str):
         return self._entries.get(name.lower())
 

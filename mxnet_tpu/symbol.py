@@ -141,7 +141,12 @@ def _topo_order(head_nodes):
     return order
 
 
-_GRAD_OP_CACHE = {}  # (graph-head ids, wrt) -> registered grad-op name
+# (graph-head ids, wrt) -> registered grad-op name.  Bounded: grad ops
+# close over their base graph, so unbounded registration would leak graphs
+# when callers rebuild symbols per iteration; eviction only drops the
+# registry entry — already-built grad symbols hold the op directly.
+_GRAD_OP_CACHE = {}
+_GRAD_OP_CACHE_MAX = 64
 
 
 class Symbol:
@@ -220,6 +225,22 @@ class Symbol:
         for node in self._topo():
             if node.attrs:
                 out[node.name] = dict(node.attrs)
+        return out
+
+    def list_attr(self, recursive=False):
+        """All attributes of this symbol (reference symbol.py:255).
+
+        ``recursive=True`` walks descendants with ``<node>_``-prefixed
+        keys (MXSymbolListAttr); shallow returns only the head node's
+        own attrs, un-prefixed (MXSymbolListAttrShallow)."""
+        if not recursive:
+            if len(self._heads) == 1:
+                return dict(self._heads[0][0].attrs)
+            return {}
+        out = {}
+        for node in self._topo():
+            for k, v in node.attrs.items():
+                out[f"{node.name}_{k}"] = v
         return out
 
     # -- composition -------------------------------------------------------
@@ -391,6 +412,9 @@ class Symbol:
         op.serializable = False  # process-local closure over `base`
         OP_REGISTRY.register(gname, op)
         _GRAD_OP_CACHE[cache_key] = gname
+        while len(_GRAD_OP_CACHE) > _GRAD_OP_CACHE_MAX:
+            old_key = next(iter(_GRAD_OP_CACHE))
+            OP_REGISTRY.remove(_GRAD_OP_CACHE.pop(old_key))
         bound = {a: Variable(a) for a in arg_names}
         return _create(gname, [], {**bound, "name": gname})
 

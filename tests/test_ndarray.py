@@ -187,3 +187,13 @@ def test_clip_and_sample():
     assert 0.4 < float(u.asnumpy().mean()) < 0.6
     n = mx.random.normal(0, 1, shape=(1000,))
     assert abs(float(n.asnumpy().mean())) < 0.15
+
+
+def test_broadcast_to_method():
+    a = mx.nd.array([[1.0], [2.0]])
+    b = a.broadcast_to((2, 3))
+    np.testing.assert_allclose(b.asnumpy(), [[1, 1, 1], [2, 2, 2]])
+    c = mx.nd.array([5.0]).broadcast_to((4, 2))
+    assert c.shape == (4, 2)
+    with pytest.raises(ValueError):
+        a.broadcast_to((3, 3))

@@ -170,3 +170,29 @@ def test_torch_function_eager():
     x = mx.nd.array(np.array([[1.0, 4.0], [9.0, 16.0]], np.float32))
     out = mx.torch_bridge.torch_function(torch.sqrt, x)
     np.testing.assert_allclose(out.asnumpy(), [[1, 2], [3, 4]], rtol=1e-6)
+
+
+def test_notebook_callbacks():
+    """Notebook metric loggers (reference python/mxnet/notebook/callback.py
+    surface: PandasLogger frames + live-curve history)."""
+    import matplotlib
+    matplotlib.use("Agg")
+
+    from mxnet_tpu.notebook.callback import LiveLearningCurve, PandasLogger
+
+    X = np.random.RandomState(0).randn(64, 8).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, name="fc", num_hidden=2)
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+    logger = PandasLogger(frequent=1)
+    curve = LiveLearningCurve(frequent=1)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(mx.io.NDArrayIter(X, y, 16), num_epoch=2,
+            optimizer_params={"learning_rate": 0.5},
+            batch_end_callback=[logger, curve])
+    assert len(logger.train) > 0
+    df = logger.train_df
+    cols = list(df.columns) if hasattr(df, "columns") else list(df[0].keys())
+    assert "accuracy" in cols and "epoch" in cols
+    assert len(curve.train) > 0

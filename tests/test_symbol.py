@@ -177,3 +177,15 @@ def test_symbol_grad_unknown_arg_errors():
     net = mx.sym.MakeLoss(mx.sym.sum(data * data))
     with pytest.raises(mx.base.MXNetError, match="not an argument"):
         net.grad(["nope"])
+
+
+def test_list_attr():
+    with mx.AttrScope(ctx_group="g1"):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, name="fc", num_hidden=2,
+                                   attr={"lr_mult": "0.5"})
+    shallow = fc.list_attr()
+    assert shallow.get("lr_mult") == "0.5" and shallow.get("ctx_group") == "g1"
+    rec = fc.list_attr(recursive=True)
+    assert rec.get("fc_lr_mult") == "0.5"
+    assert any(k.endswith("_ctx_group") for k in rec)
