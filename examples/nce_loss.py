@@ -34,7 +34,7 @@ def nce_loss(data, label_with_noise, label_weight, embed_dim, num_label):
     data3 = mx.sym.Reshape(data, target_shape=(0, 1, embed_dim))
     prod = mx.sym.broadcast_mul(data3, class_embed)
     dots = mx.sym.sum(prod, axis=2) + mx.sym.Reshape(class_bias,
-                                                     target_shape=(0, -1))
+                                                     shape=(0, -1))
     return mx.sym.LogisticRegressionOutput(dots, label=label_weight,
                                            name="nce")
 

@@ -230,26 +230,107 @@ register_simple_op("_crop_assign_scalar", _crop_assign_scalar, nin=1,
 # -- Reshape / Flatten -------------------------------------------------------
 class ReshapeParam(Params):
     shape = field(tuple_of(int), default=None,
-                  doc="target shape; 0 copies input dim, -1 infers")
-    target_shape = field(tuple_of(int), default=None, doc="legacy alias")
+                  doc="target shape; 0 copies input dim, -1 infers one dim, "
+                      "-2 copies all remaining dims, -3 merges two "
+                      "consecutive dims, -4 splits one dim into the next "
+                      "two spec entries")
+    reverse = field(bool, default=False,
+                    doc="match the special codes from the right")
+    target_shape = field(tuple_of(int), default=None,
+                         doc="legacy alias; 0 infers the remainder")
+
+
+def _apply_reshape_codes(src, spec):
+    """Reference InferReshapeShape (reshape-inl.h): resolve the 0/-1/-2/
+    -3/-4 codes of ``spec`` against input shape ``src``."""
+    out = []
+    i = 0  # cursor into src; advanced by the consuming codes
+    j = 0
+    infer_at = None
+    while j < len(spec):
+        d = spec[j]
+        if d in (0, -3, -4) and i >= len(src):
+            raise ValueError(
+                f"Reshape: code {d} at position {j} consumes input dim "
+                f"{i}, but the input has only {len(src)} dims")
+        if d == -3 and i + 1 >= len(src):
+            raise ValueError(
+                f"Reshape: -3 at position {j} merges input dims {i} and "
+                f"{i + 1}, but the input has only {len(src)} dims")
+        if d == 0:
+            out.append(src[i])
+            i += 1
+        elif d == -1:
+            if infer_at is not None:
+                raise ValueError("Reshape: at most one -1 allowed")
+            infer_at = len(out)
+            out.append(1)
+            i += 1
+        elif d == -2:
+            out.extend(src[i:])
+            i = len(src)
+        elif d == -3:
+            out.append(src[i] * src[i + 1])
+            i += 2
+        elif d == -4:
+            if j + 2 >= len(spec):
+                raise ValueError(
+                    "Reshape: -4 needs two following entries in the spec")
+            d1, d2 = spec[j + 1], spec[j + 2]
+            if (d1 == -1 and d2 == -1) or d1 == 0 or d2 == 0 \
+                    or d1 < -1 or d2 < -1:
+                raise ValueError(
+                    f"Reshape: -4 operands must be positive with at most "
+                    f"one -1, got ({d1}, {d2})")
+            whole = src[i]
+            if d1 == -1:
+                d1 = whole // d2
+            if d2 == -1:
+                d2 = whole // d1
+            if d1 * d2 != whole:
+                raise ValueError(
+                    f"Reshape: -4 cannot split {whole} into ({spec[j+1]}, "
+                    f"{spec[j+2]})")
+            out.extend([d1, d2])
+            i += 1
+            j += 2
+        else:
+            out.append(d)
+            i += 1
+        j += 1
+    return out, infer_at
 
 
 def _resolve_reshape(p, in_shape):
-    tgt = list(p.shape if p.shape is not None else p.target_shape)
-    if tgt is None:
-        raise ValueError("Reshape: no target shape")
-    out = []
-    for i, d in enumerate(tgt):
-        if d == 0:
-            out.append(in_shape[i])
+    in_shape = tuple(in_shape)
+    total = int(np.prod(in_shape)) if in_shape else 1
+    if p.shape is not None:
+        spec = list(p.shape)
+        if p.reverse:
+            out, infer_at = _apply_reshape_codes(in_shape[::-1], spec[::-1])
+            out = out[::-1]
+            if infer_at is not None:
+                infer_at = len(out) - 1 - infer_at
         else:
-            out.append(d)
-    if -1 in out:
-        known = int(np.prod([d for d in out if d != -1])) or 1
-        total = int(np.prod(in_shape))
-        out[out.index(-1)] = total // known
-    if int(np.prod(out)) != int(np.prod(in_shape)):
-        raise ValueError(f"Reshape: cannot reshape {in_shape} to {tgt}")
+            out, infer_at = _apply_reshape_codes(in_shape, spec)
+    elif p.target_shape is not None:
+        # legacy API: 0 infers the remaining elements
+        out = list(p.target_shape)
+        infer_at = out.index(0) if 0 in out else None
+        if infer_at is not None:
+            out[infer_at] = 1
+    else:
+        raise ValueError("Reshape: no target shape")
+    spec_desc = p.shape if p.shape is not None else p.target_shape
+    if infer_at is not None:
+        known = int(np.prod(out)) or 1
+        if total % known:
+            raise ValueError(f"Reshape: cannot infer dim reshaping "
+                             f"{in_shape} with {tuple(spec_desc)}")
+        out[infer_at] = total // known
+    if int(np.prod(out) if out else 1) != total:
+        raise ValueError(f"Reshape: cannot reshape {in_shape} to "
+                         f"{tuple(spec_desc)}")
     return tuple(out)
 
 

@@ -545,3 +545,69 @@ def test_batchnorm_symbol_numeric_gradient():
               "bn_beta": rng.randn(3)},
         aux_states={"bn_moving_mean": np.zeros(3),
                     "bn_moving_var": np.ones(3)})
+
+
+def test_reshape_special_codes():
+    """Reshape 0/-1/-2/-3/-4 codes + reverse (reference test_reshape,
+    tests/python/unittest/test_operator.py:933; reshape-inl.h)."""
+    cases = [
+        [(2, 3, 5, 5), (0, -1), False, (2, 75)],
+        [(2, 3, 5, 5), (0, 0, -1), False, (2, 3, 25)],
+        [(5, 3, 4, 5), (0, -1, 0), False, (5, 15, 4)],
+        [(2, 3, 5, 4), (-1, 0, 0), False, (8, 3, 5)],
+        [(2, 3, 5, 5), (0, 0, 0, 0), False, (2, 3, 5, 5)],
+        [(2, 4, 5, 3), (-1, 2, 2, 1), False, (30, 2, 2, 1)],
+        [(2, 3, 5, 6), (-2,), False, (2, 3, 5, 6)],
+        [(2, 3, 5, 6), (6, 1, -2), False, (6, 1, 5, 6)],
+        [(2, 3, 5, 6), (-3, -3), False, (6, 30)],
+        [(2, 3, 5, 6), (-3, -1), False, (6, 30)],
+        [(64,), (-4, 16, 4), False, (16, 4)],
+        [(64,), (-4, 16, -1), False, (16, 4)],
+        [(64, 1, 2, 3), (-4, 16, -1, -2), False, (16, 4, 1, 2, 3)],
+        [(2, 3, 5, 5), (0, -1), True, (5, 30)],
+        [(2, 3, 5, 5), (0, 0, -1), True, (3, 5, 10)],
+        [(5, 3, 4, 5), (0, -1, 0), True, (3, 20, 5)],
+        [(2, 3, 5, 4), (-1, 0, 0), True, (6, 5, 4)],
+        [(2, 3, 4, 5), (3, -1, 0), True, (3, 8, 5)],
+        [(2, 3, 5, 5), (5, 3, 0, -1), True, (5, 3, 5, 2)],
+        [(2, 3, 5, 5), (0, 0, 0, 0), True, (2, 3, 5, 5)],
+        [(2, 3, 5, 6), (-2,), True, (2, 3, 5, 6)],
+        [(2, 3, 5, 6), (-2, 1, 30), True, (2, 3, 1, 30)],
+        [(2, 3, 5, 6), (-3, -3), True, (6, 30)],
+        [(64,), (16, 4, -4), True, (16, 4)],
+        [(64,), (16, -1, -4), True, (16, 4)],
+        [(1, 2, 3, 64), (-2, -1, 16, -4), True, (1, 2, 3, 4, 16)],
+    ]
+    for src, spec, reverse, dst in cases:
+        net = mx.sym.Reshape(mx.sym.Variable("data"), shape=spec,
+                             reverse=reverse)
+        net = mx.sym.load_json(net.tojson())  # survives serialization
+        _, out_shapes, _ = net.infer_shape(data=src)
+        assert out_shapes[0] == dst, (src, spec, reverse, out_shapes[0], dst)
+        x = np.random.RandomState(0).rand(*src).astype(np.float32)
+        g = np.random.RandomState(1).rand(*dst).astype(np.float32)
+        exe = net.simple_bind(mx.cpu(), grad_req="write", data=src)
+        exe.arg_dict["data"][:] = x
+        exe.forward(is_train=True)
+        np.testing.assert_allclose(exe.outputs[0].asnumpy(),
+                                   x.reshape(dst), rtol=1e-6)
+        exe.backward([mx.nd.array(g)])
+        np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                                   g.reshape(src), rtol=1e-6)
+    # legacy target_shape API: 0 infers the remainder
+    net = mx.sym.Reshape(mx.sym.Variable("data"), target_shape=(2, 0))
+    _, out_shapes, _ = net.infer_shape(data=(2, 3, 5, 5))
+    assert out_shapes[0] == (2, 75)
+
+
+def test_reshape_invalid_specs_raise_valueerror():
+    data = mx.sym.Variable("data")
+    for src, spec in [((6,), (-3,)),          # -3 needs two input dims
+                      ((64,), (-4, 16)),      # -4 needs two spec entries
+                      ((64,), (-4, -1, -1)),  # at most one -1 in a split
+                      ((64,), (-4, -1, 0)),   # zero operand
+                      ((2, 3), ()),           # empty spec on non-scalar
+                      ((2, 3), (0, 0, 0))]:   # consumes too many dims
+        net = mx.sym.Reshape(data, shape=spec)
+        with pytest.raises((ValueError, mx.base.MXNetError)):
+            net.infer_shape(data=src)
