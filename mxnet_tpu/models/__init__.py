@@ -8,7 +8,9 @@ from .lstm import lstm_unroll, lstm_cell, LSTMState, LSTMParam
 from .ssd import get_symbol as ssd
 from .inception import inception_bn, inception_bn_small, googlenet
 from .vgg import vgg, alexnet
+from .transformer import gpt
 
 __all__ = ["lenet", "mlp", "resnet", "lstm_unroll", "lstm_cell",
            "LSTMState", "LSTMParam", "ssd",
-           "inception_bn", "inception_bn_small", "googlenet", "vgg", "alexnet"]
+           "inception_bn", "inception_bn_small", "googlenet", "vgg", "alexnet",
+           "gpt"]

@@ -17,6 +17,7 @@ from . import rnn  # noqa: F401
 from . import vision  # noqa: F401
 from . import multibox  # noqa: F401
 from . import sample  # noqa: F401
+from . import attention  # noqa: F401
 
 from .flash_attention import flash_attention
 

@@ -1,0 +1,116 @@
+"""Attention-era operators: LayerNorm, GELU, fused multi-head attention.
+
+Beyond-parity additions (the 2016 reference predates transformers) that
+make the Pallas flash-attention kernel (``ops/flash_attention.py``) and
+a GPT-style model zoo entry (``models/transformer.py``) available from
+the Symbol/NDArray frontends like any reference op.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..param import Params, field
+from .op import OpDef, register_op, register_simple_op
+
+
+# -- LayerNorm ---------------------------------------------------------------
+class LayerNormParam(Params):
+    axis = field(int, default=-1)
+    eps = field(float, default=1e-5)
+
+
+@register_op("LayerNorm", aliases=("layernorm",))
+class LayerNormOp(OpDef):
+    """Normalize over one axis with learnable scale/shift.
+
+    Statistics are computed in f32 regardless of input dtype (bf16-safe,
+    like the fused BatchNorm in ops/nn.py); XLA fuses the whole op into
+    its neighbors.
+    """
+
+    param_cls = LayerNormParam
+
+    def list_arguments(self, params):
+        return ["data", "gamma", "beta"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            raise ValueError("LayerNorm: data shape unknown")
+        c = (d[params.axis % len(d)],)
+        return [tuple(d), c, c], [tuple(d)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        x, gamma, beta = inputs
+        axis = params.axis % x.ndim
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axis, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=axis, keepdims=True)
+        inv = jax.lax.rsqrt(var + params.eps)
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        y = (xf - mean) * inv * gamma.astype(jnp.float32).reshape(shape) \
+            + beta.astype(jnp.float32).reshape(shape)
+        return [y.astype(x.dtype)], []
+
+
+register_simple_op(
+    "gelu",
+    lambda x: (0.5 * x.astype(jnp.float32)
+               * (1.0 + jax.lax.erf(x.astype(jnp.float32)
+                                    / np.sqrt(2.0)))).astype(x.dtype),
+    nin=1)
+
+
+# -- fused multi-head attention ----------------------------------------------
+class FlashAttentionParam(Params):
+    causal = field(bool, default=False)
+    block_q = field(int, default=128)
+    block_k = field(int, default=128)
+    impl = field(str, default="auto", enum=("auto", "flash", "xla"))
+
+
+@register_op("FlashAttention", aliases=("flashattention",))
+class FlashAttentionOp(OpDef):
+    """softmax(Q K^T / sqrt(D)) V over (batch, heads, seq, head_dim).
+
+    On TPU with fitting block sizes this lowers to the fused Pallas
+    kernel (forward + custom-VJP backward); elsewhere it runs the XLA
+    dense formulation.  Differentiable either way.
+    """
+
+    param_cls = FlashAttentionParam
+
+    def list_arguments(self, params):
+        return ["query", "key", "value"]
+
+    def infer_shape(self, params, in_shapes):
+        q = in_shapes[0] or in_shapes[1] or in_shapes[2]
+        if q is None:
+            raise ValueError("FlashAttention: input shapes unknown")
+        return [tuple(q)] * 3, [tuple(q)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        q, k, v = inputs
+        from .flash_attention import _on_tpu, flash_attention
+
+        S = q.shape[2]
+        use_flash = params.impl == "flash" or (
+            params.impl == "auto" and _on_tpu()
+            and S % min(params.block_q, S) == 0
+            and S % min(params.block_k, S) == 0)
+        if use_flash:
+            out = flash_attention(q, k, v, causal=params.causal,
+                                  block_q=params.block_q,
+                                  block_k=params.block_k)
+            return [out], []
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        if params.causal:
+            mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+            s = jnp.where(mask, s, jnp.asarray(-jnp.inf, s.dtype))
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+        return [jnp.einsum("bhqk,bhkd->bhqd", p, v)], []

@@ -9,9 +9,9 @@ with streaming log-sum-exp renormalization).  Peak memory per chip is
 O(S/n * S/n) instead of O(S^2); communication fully overlaps compute on
 the ring.
 
-Exposed as:
-- ``ring_attention(q, k, v, mesh, axis)`` — jitted sharded call;
-- the ``_RingAttention`` symbol op so Symbol graphs can use it.
+Exposed as ``ring_attention(q, k, v, mesh, axis)`` — a jitted sharded
+call (the single-device symbol-graph entry is ``mx.sym.FlashAttention``,
+ops/attention.py; ``parallel/ulysses.py`` is the all-to-all variant).
 """
 
 from __future__ import annotations

@@ -203,7 +203,17 @@ class ShardedTrainer:
             init_fn, update_fn = opt_factory(**(optimizer_params or {}))
         else:
             init_fn, update_fn = optimizer
-        self.opt_state = jax.device_put(init_fn(params))  # inherits shardings
+        # param-shaped state (momentum etc.) inherits the param shardings
+        # through zeros_like; scalar/odd-shaped leaves (Adam's step count)
+        # must be pinned to the mesh explicitly or multi-device jit sees
+        # mixed device sets
+        def _place_state(leaf):
+            sh = getattr(leaf, "sharding", None)
+            if isinstance(sh, NamedSharding) and sh.mesh == mesh:
+                return leaf
+            return jax.device_put(leaf, self._replicated)
+
+        self.opt_state = jax.tree_util.tree_map(_place_state, init_fn(params))
         self._update_fn = update_fn
 
         # Loss-layer backward is un-normalized (reference SoftmaxOutput

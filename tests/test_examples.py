@@ -247,3 +247,18 @@ def test_example_dec_clustering_runs(capsys):
 def test_example_rcnn_roi_runs(capsys):
     _run_example("rcnn_roi.py", ["--iterations", "30"])
     assert "roi-head accuracy" in capsys.readouterr().out
+
+
+def test_example_train_gpt_runs(capsys):
+    _run_example("train_gpt.py",
+                 ["--steps", "10", "--seq-len", "32", "--d-model", "32",
+                  "--batch-size", "8", "--num-layers", "1"])
+    assert "gpt final nll" in capsys.readouterr().out
+
+
+def test_example_train_gpt_sharded_runs(capsys):
+    _run_example("train_gpt.py",
+                 ["--steps", "6", "--seq-len", "32", "--d-model", "32",
+                  "--batch-size", "16", "--num-layers", "1",
+                  "--trainer", "sharded"])
+    assert "gpt final nll" in capsys.readouterr().out

@@ -611,3 +611,85 @@ def test_reshape_invalid_specs_raise_valueerror():
         net = mx.sym.Reshape(data, shape=spec)
         with pytest.raises((ValueError, mx.base.MXNetError)):
             net.infer_shape(data=src)
+
+
+def test_convolution_grouping():
+    """Grouped conv equals per-group convs concatenated (reference
+    test_convolution_grouping, test_operator.py:739)."""
+    num_filter, num_group, kernel = 4, 2, (3, 3)
+    shape = (1, 4, 9, 9)
+    rng = np.random.RandomState(0)
+    x = rng.randn(*shape).astype(np.float32)
+    w = rng.randn(num_filter, shape[1] // num_group, *kernel).astype(np.float32)
+    b = rng.randn(num_filter).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    grouped = mx.sym.Convolution(data, name="conv", num_filter=num_filter,
+                                 num_group=num_group, kernel=kernel)
+    exe = grouped.simple_bind(mx.cpu(), grad_req="null", data=shape)
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["conv_weight"][:] = w
+    exe.arg_dict["conv_bias"][:] = b
+    exe.forward(is_train=False)
+    got = exe.outputs[0].asnumpy()
+
+    # reference construction: slice channels, conv each, concat
+    parts = []
+    for g in range(num_group):
+        sub = mx.sym.Convolution(data, name=f"c{g}",
+                                 num_filter=num_filter // num_group,
+                                 kernel=kernel)
+        e = sub.simple_bind(mx.cpu(), grad_req="null",
+                            data=(1, 2, 9, 9))
+        e.arg_dict["data"][:] = x[:, 2 * g:2 * (g + 1)]
+        e.arg_dict[f"c{g}_weight"][:] = w[2 * g:2 * (g + 1)]
+        e.arg_dict[f"c{g}_bias"][:] = b[2 * g:2 * (g + 1)]
+        e.forward(is_train=False)
+        parts.append(e.outputs[0].asnumpy())
+    want = np.concatenate(parts, axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_convolution_dilated_impulse_response():
+    """A dilated conv's receptive field on an impulse matches the
+    dilation spacing (reference test_run_convolution_dilated_impulse_
+    response, test_operator.py:863)."""
+    for dil in [(1, 1), (2, 2), (3, 3)]:
+        kernel_shape = (3, 3)
+        data = mx.sym.Variable("data")
+        conv = mx.sym.Convolution(data, name="conv", num_filter=1,
+                                  kernel=kernel_shape, dilate=dil,
+                                  no_bias=True)
+        size = 2 * (kernel_shape[0] - 1) * dil[0] + 1
+        exe = conv.simple_bind(mx.cpu(), grad_req="null",
+                               data=(1, 1, size, size))
+        impulse = np.zeros((1, 1, size, size), np.float32)
+        center = size // 2
+        impulse[0, 0, center, center] = 1.0
+        exe.arg_dict["data"][:] = impulse
+        exe.arg_dict["conv_weight"][:] = 1.0
+        exe.forward(is_train=False)
+        out = exe.outputs[0].asnumpy()[0, 0]
+        # response is nonzero exactly at taps dil apart around the center
+        nz = np.transpose(np.nonzero(out))
+        c = out.shape[0] // 2
+        for (r, s) in nz:
+            assert (r - c) % dil[0] == 0 and (s - c) % dil[1] == 0, (r, s)
+        assert out.sum() == pytest.approx(kernel_shape[0] * kernel_shape[1])
+
+
+def test_binary_op_duplicate_input():
+    """Gradient when the same input feeds both sides (reference
+    test_binary_op_duplicate_input, test_operator.py:396):
+    d(a*a)/da = 2a."""
+    rng = np.random.RandomState(0)
+    a = rng.randn(3, 4).astype(np.float32)
+    data = mx.sym.Variable("data")
+    square = data * data
+    exe = square.simple_bind(mx.cpu(), grad_req="write", data=(3, 4))
+    exe.arg_dict["data"][:] = a
+    exe.forward(is_train=True)
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(), a * a, rtol=1e-6)
+    exe.backward([mx.nd.ones((3, 4))])
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), 2 * a,
+                               rtol=1e-5)

@@ -1,0 +1,125 @@
+"""Transformer stack: LayerNorm/gelu/FlashAttention symbol ops and the
+GPT model-zoo entry (beyond-parity additions; models/transformer.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_layernorm_matches_manual():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 6, 8).astype(np.float32) * 3 + 1
+    gamma = rng.rand(8).astype(np.float32) + 0.5
+    beta = rng.randn(8).astype(np.float32)
+    out = mx.nd.LayerNorm(mx.nd.array(x), mx.nd.array(gamma),
+                          mx.nd.array(beta), eps=1e-5).asnumpy()
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    want = (x - mu) / np.sqrt(var + 1e-5) * gamma + beta
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_layernorm_gradient():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.LayerNorm(data, name="ln")
+    rng = np.random.RandomState(1)
+    check_numeric_gradient(
+        net, {"data": rng.randn(3, 5).astype(np.float32)}, check_eps=5e-2)
+
+
+def test_gelu_values():
+    x = np.array([-3.0, -1.0, 0.0, 1.0, 3.0], np.float32)
+    out = mx.nd.gelu(mx.nd.array(x)).asnumpy()
+    from scipy.stats import norm  # exact gelu = x * Phi(x)
+    want = x * norm.cdf(x)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_op_matches_manual():
+    rng = np.random.RandomState(2)
+    B, H, S, D = 2, 3, 8, 4
+    q, k, v = [rng.randn(B, H, S, D).astype(np.float32) for _ in range(3)]
+    for causal in (False, True):
+        out = mx.nd.FlashAttention(mx.nd.array(q), mx.nd.array(k),
+                                   mx.nd.array(v), causal=causal).asnumpy()
+        s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        if causal:
+            mask = np.tril(np.ones((S, S), bool))
+            s = np.where(mask, s, -np.inf)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_gpt_causality():
+    """Output at position t must not depend on tokens after t."""
+    rng = np.random.RandomState(3)
+    V, S = 20, 8
+    net = mx.models.gpt(V, S, num_layers=1, d_model=16, num_heads=2)
+    exe = net.simple_bind(mx.cpu(), grad_req="null", data=(1, S),
+                          softmax_label=(1, S))
+    for name, arr in exe.arg_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        arr[:] = rng.randn(*arr.shape).astype(np.float32) * 0.1
+    toks = rng.randint(0, V, (1, S)).astype(np.float32)
+    exe.arg_dict["data"][:] = toks
+    exe.forward(is_train=False)
+    base = exe.outputs[0].asnumpy().reshape(S, V)
+    # perturb the LAST token: only the last position's output may change
+    toks2 = toks.copy()
+    toks2[0, -1] = (toks2[0, -1] + 1) % V
+    exe.arg_dict["data"][:] = toks2
+    exe.forward(is_train=False)
+    pert = exe.outputs[0].asnumpy().reshape(S, V)
+    np.testing.assert_allclose(base[:-1], pert[:-1], rtol=1e-5, atol=1e-6)
+    assert np.abs(base[-1] - pert[-1]).max() > 1e-6
+
+
+def test_gpt_training_reduces_loss():
+    rng = np.random.RandomState(4)
+    V, S, B = 12, 16, 16
+    # deterministic cycle corpus: fully learnable
+    tokens = np.arange(2000) % V
+    net = mx.models.gpt(V, S, num_layers=1, d_model=32, num_heads=2)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (B, S))],
+             label_shapes=[("softmax_label", (B, S))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 3e-3})
+    nlls = []
+    for step in range(60):
+        starts = rng.randint(0, len(tokens) - S - 1, B)
+        x = np.stack([tokens[s:s + S] for s in starts]).astype(np.float32)
+        y = np.stack([tokens[s + 1:s + S + 1] for s in starts]).astype(np.float32)
+        mod.forward(mx.io.DataBatch([mx.nd.array(x)], [mx.nd.array(y)]),
+                    is_train=True)
+        probs = mod.get_outputs()[0].asnumpy()
+        nll = -np.log(probs[np.arange(len(probs)),
+                            y.reshape(-1).astype(int)] + 1e-9).mean()
+        nlls.append(nll)
+        mod.backward()
+        mod.update()
+    assert nlls[-1] < 0.5, nlls[-1]  # cycle is deterministic: near-zero
+
+
+def test_gpt_sharded_trainer_adam_multichip():
+    """Adam opt state (incl. the scalar step count) must place onto the
+    mesh (regression: mixed device sets on multi-device jit)."""
+    mesh = mx.parallel.make_mesh({"dp": 8})
+    V, S, B = 11, 16, 16
+    net = mx.models.gpt(V, S, num_layers=1, d_model=16, num_heads=2)
+    tr = mx.parallel.ShardedTrainer(
+        net, {"data": (B, S), "softmax_label": (B, S)}, mesh=mesh,
+        optimizer="adam", optimizer_params={"learning_rate": 1e-3},
+        initializer=mx.init.Xavier())
+    rng = np.random.RandomState(5)
+    x = rng.randint(0, V, (B, S)).astype(np.float32)
+    y = rng.randint(0, V, (B, S)).astype(np.float32)
+    outs = tr.step({"data": x, "softmax_label": y})
+    assert np.isfinite(np.asarray(outs[0])).all()
