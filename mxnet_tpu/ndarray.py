@@ -20,6 +20,7 @@ runtime-discovery pattern as the reference's
 
 from __future__ import annotations
 
+import builtins
 import functools
 import sys
 
@@ -441,6 +442,43 @@ def fill_element_0index(lhs: NDArray, mhs: NDArray, rhs: NDArray,
     return NDArray(filled, ctx)
 
 
+def _mixed_nd_binary(left, right, op, scalar_op, rscalar_op, py_op, fname):
+    """NDArray/Number dispatch of the reference module helpers
+    (python/mxnet/ndarray.py:773-850 power/maximum/minimum)."""
+    if isinstance(left, NDArray) and isinstance(right, NDArray):
+        return imperative_invoke(op, [left, right], {})[0]
+    if isinstance(left, NDArray) and isinstance(right, numeric_types):
+        return imperative_invoke(scalar_op, [left],
+                                 {"scalar": float(right)})[0]
+    if isinstance(left, numeric_types) and isinstance(right, NDArray):
+        return imperative_invoke(rscalar_op, [right],
+                                 {"scalar": float(left)})[0]
+    if isinstance(left, numeric_types) and isinstance(right, numeric_types):
+        return py_op(left, right)
+    raise TypeError(
+        f"{fname}: types ({type(left)}, {type(right)}) not supported")
+
+
+def power(lhs, rhs):
+    """lhs ** rhs with NDArray/Number operands (ndarray.py:773)."""
+    return _mixed_nd_binary(lhs, rhs, "_power", "_power_scalar",
+                            "_rpower_scalar", lambda a, b: a ** b, "power")
+
+
+def maximum(lhs, rhs):
+    """Elementwise max with NDArray/Number operands (ndarray.py:799)."""
+    # builtins.max: generated op functions shadow builtins here (the
+    # module already keeps _pyslice/_pysum aliases for the same reason)
+    return _mixed_nd_binary(lhs, rhs, "_maximum", "_maximum_scalar",
+                            "_maximum_scalar", builtins.max, "maximum")
+
+
+def minimum(lhs, rhs):
+    """Elementwise min with NDArray/Number operands (ndarray.py:825)."""
+    return _mixed_nd_binary(lhs, rhs, "_minimum", "_minimum_scalar",
+                            "_minimum_scalar", builtins.min, "minimum")
+
+
 def waitall():
     """Block until all dispatched work completes (Engine::WaitForAll)."""
     from .engine import get_engine
@@ -539,12 +577,17 @@ def Custom(*args, op_type=None, **kwargs):
 
 def _init_ndarray_module():
     mod = sys.modules[__name__]
+    # NDArray/Number dispatch helpers (reference ndarray.py:773-850)
+    # take precedence over raw registry creators of the same name
+    keep = {"power": power, "maximum": maximum, "minimum": minimum}
     for name in OP_REGISTRY.list():
         fn = _make_ndarray_function(name)
         setattr(mod, name, fn)
         canonical = OP_REGISTRY.get(name)
         if canonical.name.lower() == name:
             setattr(mod, canonical.name, fn)  # preserve CamelCase spelling
+    for name, fn in keep.items():
+        setattr(mod, name, fn)
 
 
 _init_ndarray_module()

@@ -14,13 +14,14 @@ op registry at import time, mirroring python/mxnet/symbol.py:999-1120.
 
 from __future__ import annotations
 
+import builtins
 import json
 import sys
 import threading
 
 import numpy as np
 
-from .base import MXNetError, dtype_name, np_dtype
+from .base import MXNetError, dtype_name, np_dtype, numeric_types
 from .ops import OP_REGISTRY
 
 __all__ = ["Symbol", "Variable", "Group", "load", "load_json", "AttrScope",
@@ -523,6 +524,42 @@ def _sym_ufunc(lhs, rhs, op_name, scalar_op_name):
     raise TypeError(f"unsupported operand type {type(rhs)}")
 
 
+def _mixed_binary(left, right, op, scalar_op, rscalar_op, py_op, fname):
+    """Symbol/Number dispatch of the reference module-level helpers
+    (symbol.py:1122-1195 pow/maximum/minimum)."""
+    num = numeric_types
+    if isinstance(left, Symbol) and isinstance(right, Symbol):
+        return _create(op, [left, right], {})
+    if isinstance(left, Symbol) and isinstance(right, num):
+        return _create(scalar_op, [left], {"scalar": float(right)})
+    if isinstance(left, num) and isinstance(right, Symbol):
+        return _create(rscalar_op, [right], {"scalar": float(left)})
+    if isinstance(left, num) and isinstance(right, num):
+        return py_op(left, right)
+    raise TypeError(
+        f"{fname}: types ({type(left)}, {type(right)}) not supported")
+
+
+def pow(base, exp):  # noqa: A001 - reference API name
+    """base ** exp with Symbol/Number operands (reference symbol.py:1122)."""
+    return _mixed_binary(base, exp, "_power", "_power_scalar",
+                         "_rpower_scalar", lambda a, b: a ** b, "pow")
+
+
+def maximum(left, right):
+    """Elementwise max with Symbol/Number operands (symbol.py:1148)."""
+    # builtins.max explicitly: the registry creator for op "max" shadows
+    # the builtin in this module's namespace after _init_symbol_module
+    return _mixed_binary(left, right, "_maximum", "_maximum_scalar",
+                         "_maximum_scalar", builtins.max, "maximum")
+
+
+def minimum(left, right):
+    """Elementwise min with Symbol/Number operands (symbol.py:1174)."""
+    return _mixed_binary(left, right, "_minimum", "_minimum_scalar",
+                         "_minimum_scalar", builtins.min, "minimum")
+
+
 def _infer_graph(topo, known, what, partial):
     """Forward inference over the graph; two passes so late-discovered
     variable values (e.g. FC weight shapes) propagate."""
@@ -706,12 +743,17 @@ def Custom(*args, op_type=None, **kwargs):
 
 def _init_symbol_module():
     mod = sys.modules[__name__]
+    # the Symbol/Number dispatch helpers (reference symbol.py:1122-1195)
+    # take precedence over raw registry creators of the same name
+    keep = {"pow": pow, "maximum": maximum, "minimum": minimum}
     for name in OP_REGISTRY.list():
         fn = _make_symbol_function(name)
         setattr(mod, name, fn)
         canonical = OP_REGISTRY.get(name)
         if canonical.name.lower() == name:
             setattr(mod, canonical.name, fn)
+    for name, fn in keep.items():
+        setattr(mod, name, fn)
 
 
 _init_symbol_module()

@@ -197,3 +197,10 @@ def test_broadcast_to_method():
     assert c.shape == (4, 2)
     with pytest.raises(ValueError):
         a.broadcast_to((3, 3))
+
+
+def test_ndarray_pickle():
+    import pickle
+    a = mx.nd.array(np.random.RandomState(0).rand(3, 4))
+    b = pickle.loads(pickle.dumps(a))
+    np.testing.assert_allclose(a.asnumpy(), b.asnumpy())

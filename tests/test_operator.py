@@ -693,3 +693,42 @@ def test_binary_op_duplicate_input():
     exe.backward([mx.nd.ones((3, 4))])
     np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), 2 * a,
                                rtol=1e-5)
+
+
+def test_pow_maximum_minimum_helpers():
+    """Module-level pow/maximum/minimum with Symbol|Number operands
+    (reference symbol.py:1122-1195, test_scalar_pow/test_symbol_pow/
+    test_pow_fn/test_maximum_minimum[_scalar])."""
+    rng = np.random.RandomState(0)
+    xv = rng.rand(3, 4).astype(np.float32) + 0.5
+    yv = rng.rand(3, 4).astype(np.float32) + 0.5
+    x, y = mx.sym.Variable("x"), mx.sym.Variable("y")
+
+    cases = [
+        (mx.sym.pow(x, y), {"x": xv, "y": yv}, xv ** yv),
+        (mx.sym.pow(x, 3.0), {"x": xv}, xv ** 3.0),
+        (mx.sym.pow(2.0, y), {"y": yv}, 2.0 ** yv),
+        (mx.sym.maximum(x, y), {"x": xv, "y": yv}, np.maximum(xv, yv)),
+        (mx.sym.maximum(x, 0.8), {"x": xv}, np.maximum(xv, 0.8)),
+        (mx.sym.minimum(0.8, y), {"y": yv}, np.minimum(0.8, yv)),
+    ]
+    for expr, args, want in cases:
+        exe = expr.simple_bind(mx.cpu(), grad_req="null",
+                               **{k: v.shape for k, v in args.items()})
+        for k, v in args.items():
+            exe.arg_dict[k][:] = v
+        exe.forward(is_train=False)
+        np.testing.assert_allclose(exe.outputs[0].asnumpy(), want, rtol=1e-5)
+    assert mx.sym.pow(2.0, 3.0) == 8.0
+    assert mx.sym.maximum(2, 5) == 5
+
+    # imperative twins (reference ndarray.py:773-850)
+    a, b = mx.nd.array(xv), mx.nd.array(yv)
+    np.testing.assert_allclose(mx.nd.power(a, b).asnumpy(), xv ** yv,
+                               rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.power(2.0, b).asnumpy(), 2.0 ** yv,
+                               rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.maximum(a, 0.8).asnumpy(),
+                               np.maximum(xv, 0.8), rtol=1e-6)
+    np.testing.assert_allclose(mx.nd.minimum(0.8, b).asnumpy(),
+                               np.minimum(0.8, yv), rtol=1e-6)

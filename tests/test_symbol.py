@@ -189,3 +189,14 @@ def test_list_attr():
     rec = fc.list_attr(recursive=True)
     assert rec.get("fc_lr_mult") == "0.5"
     assert any(k.endswith("_ctx_group") for k in rec)
+
+
+def test_symbol_pickle_and_deepcopy():
+    import copy
+    import pickle
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                              name="fc"), name="softmax")
+    for clone in (pickle.loads(pickle.dumps(net)), copy.deepcopy(net)):
+        assert clone.list_arguments() == net.list_arguments()
+        assert clone.tojson() == net.tojson()
