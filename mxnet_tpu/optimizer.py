@@ -19,7 +19,7 @@ from .base import MXNetError
 from .ndarray import NDArray, zeros
 from .registry import Registry
 
-__all__ = ["Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "Adam", "AdaGrad",
+__all__ = ["Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "Adam", "AdamW", "AdaGrad",
            "RMSProp", "AdaDelta", "Test", "create", "get_updater", "register"]
 
 OPT_REGISTRY = Registry("optimizer")
@@ -407,3 +407,39 @@ def get_updater(optimizer: Optimizer):
     updater.states = states
     updater.optimizer = optimizer
     return updater
+
+
+@register("adamw")
+class AdamW(Adam):
+    """Adam with decoupled weight decay (Loshchilov & Hutter; beyond
+    the 2016 reference — the standard transformer-training optimizer).
+
+    ``wd`` is applied directly to the weights, scaled by the schedule
+    lr, instead of being folded into the gradient."""
+
+    def _build_steps(self):
+        def step(w, g, mv, lr_t, wd_term):
+            m, v = mv
+            g = self._preprocess(g)
+            m_new = self.beta1 * m + (1 - self.beta1) * g
+            v_new = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+            w_new = (w * (1.0 - wd_term)
+                     - lr_t * m_new / (jnp.sqrt(v_new) + self.epsilon))
+            return w_new.astype(w.dtype), (m_new.astype(m.dtype),
+                                           v_new.astype(v.dtype))
+
+        self._step = jax.jit(step, donate_argnums=_donate(0, 2))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        lr_t = lr * math.sqrt(1.0 - self.beta2**t) / (1.0 - self.beta1**t)
+        m, v = state
+        w, (m_new, v_new) = self._step(weight._data, grad._data,
+                                       (m._data, v._data),
+                                       jnp.float32(lr_t),
+                                       jnp.float32(lr * self._get_wd(index)))
+        weight._set(w)
+        m._set(m_new)
+        v._set(v_new)

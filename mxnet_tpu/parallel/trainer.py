@@ -28,7 +28,8 @@ from ..executor import _CompiledGraph
 from ..initializer import Uniform
 from .. import ndarray as nd
 
-__all__ = ["ShardedTrainer", "sgd_opt", "adam_opt", "cached_sgd_step"]
+__all__ = ["ShardedTrainer", "sgd_opt", "adam_opt", "adamw_opt",
+           "cached_sgd_step"]
 
 
 def cached_sgd_step(cache, loss_fn, make_objective, has_aux=False):
@@ -86,8 +87,11 @@ def sgd_opt(learning_rate=0.01, momentum=0.9, weight_decay=0.0):
 
 
 def adam_opt(learning_rate=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
-             weight_decay=0.0):
-    """Functional Adam over a param pytree."""
+             weight_decay=0.0, decoupled=False):
+    """Functional Adam over a param pytree.
+
+    ``decoupled=True`` gives AdamW: weight decay multiplies the weights
+    by (1 - lr*wd) instead of being folded into the gradient."""
 
     def init(params):
         z = {k: jnp.zeros_like(v, dtype=jnp.float32) for k, v in params.items()}
@@ -101,18 +105,30 @@ def adam_opt(learning_rate=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
             1 - beta1**t.astype(jnp.float32))
         new_params, new_m, new_v = {}, {}, {}
         for k, p in params.items():
-            g = grads[k].astype(jnp.float32) + weight_decay * p.astype(jnp.float32)
+            pf = p.astype(jnp.float32)
+            g = grads[k].astype(jnp.float32)
+            if not decoupled:
+                g = g + weight_decay * pf
             m = beta1 * state["m"][k] + (1 - beta1) * g
             v = beta2 * state["v"][k] + (1 - beta2) * jnp.square(g)
             new_m[k], new_v[k] = m, v
-            new_params[k] = (p.astype(jnp.float32)
-                             - lr_t * m / (jnp.sqrt(v) + eps)).astype(p.dtype)
+            if decoupled:
+                pf = pf * (1.0 - learning_rate * weight_decay)
+            new_params[k] = (pf - lr_t * m
+                             / (jnp.sqrt(v) + eps)).astype(p.dtype)
         return new_params, {"m": new_m, "v": new_v, "t": t}
 
     return init, update
 
 
-_OPTS = {"sgd": sgd_opt, "adam": adam_opt}
+def adamw_opt(learning_rate=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+              weight_decay=0.0):
+    """Functional AdamW: adam_opt with decoupled weight decay."""
+    return adam_opt(learning_rate, beta1, beta2, eps, weight_decay,
+                    decoupled=True)
+
+
+_OPTS = {"sgd": sgd_opt, "adam": adam_opt, "adamw": adamw_opt}
 
 
 class ShardedTrainer:
@@ -128,7 +144,7 @@ class ShardedTrainer:
         parallel parameter sharding; unlisted params are replicated
     sequence_specs : {input_name: PartitionSpec} extra input shardings
         (e.g. sequence axis over 'sp' for context parallelism)
-    optimizer : 'sgd' | 'adam' | (init_fn, update_fn)
+    optimizer : 'sgd' | 'adam' | 'adamw' | (init_fn, update_fn)
     dtype : compute dtype for params (bfloat16 recommended on TPU)
     """
 

@@ -161,3 +161,21 @@ def test_module_multi_device_training_parity():
     for k in p1:
         np.testing.assert_allclose(p1[k], p2[k], rtol=2e-5, atol=2e-6,
                                    err_msg=k)
+
+
+def test_sharded_trainer_adamw():
+    np.random.seed(1)
+    X, y = _toy()
+    net = mx.models.mlp(num_classes=4)
+    mesh = mx.parallel.make_mesh({"dp": 4})
+    tr = mx.parallel.ShardedTrainer(
+        net, {"data": (64, 16), "softmax_label": (64,)}, mesh=mesh,
+        optimizer="adamw",
+        optimizer_params={"learning_rate": 0.01, "weight_decay": 0.01},
+        initializer=mx.initializer.Xavier())
+    for i in range(40):
+        b = (i * 64) % (256 - 64)
+        tr.step({"data": X[b:b + 64], "softmax_label": y[b:b + 64]})
+    pred = np.asarray(tr.eval({"data": X[:64],
+                               "softmax_label": y[:64]})[0]).argmax(1)
+    assert (pred == y[:64]).mean() > 0.85
