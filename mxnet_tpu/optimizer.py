@@ -266,10 +266,14 @@ class Adam(Optimizer):
         w, (m_new, v_new) = self._step(weight._data, grad._data,
                                        (m._data, v._data),
                                        jnp.float32(lr_t),
-                                       jnp.float32(self._get_wd(index)))
+                                       jnp.float32(self._wd_arg(index, lr)))
         weight._set(w)
         m._set(m_new)
         v._set(v_new)
+
+    def _wd_arg(self, index, lr):
+        """Weight-decay operand of the step kernel; AdamW overrides."""
+        return self._get_wd(index)
 
 
 @register("adagrad")
@@ -430,16 +434,6 @@ class AdamW(Adam):
 
         self._step = jax.jit(step, donate_argnums=_donate(0, 2))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
-        lr = self._get_lr(index)
-        lr_t = lr * math.sqrt(1.0 - self.beta2**t) / (1.0 - self.beta1**t)
-        m, v = state
-        w, (m_new, v_new) = self._step(weight._data, grad._data,
-                                       (m._data, v._data),
-                                       jnp.float32(lr_t),
-                                       jnp.float32(lr * self._get_wd(index)))
-        weight._set(w)
-        m._set(m_new)
-        v._set(v_new)
+    def _wd_arg(self, index, lr):
+        # decoupled decay: the kernel's wd term is lr-scaled
+        return lr * self._get_wd(index)

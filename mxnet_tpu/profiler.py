@@ -21,7 +21,8 @@ import functools
 
 import jax
 
-__all__ = ["start", "stop", "trace", "scope", "annotate", "device_memory"]
+__all__ = ["start", "stop", "trace", "scope", "annotate",
+           "device_memory", "summarize"]
 
 _active_logdir = None
 
@@ -84,3 +85,48 @@ def device_memory(device=None):
             stats = None
         out[str(d)] = stats
     return out
+
+
+def summarize(logdir, top=20, device_only=True):
+    """Aggregate device time per op from the newest trace under
+    ``logdir``; returns [(name, total_ms, count)] sorted by time.
+
+    Complements TensorBoard/Perfetto with an in-terminal view — the
+    trace itself stays fully compatible with those UIs.
+    """
+    import glob
+    import gzip
+    import json
+    import os
+
+    candidates = sorted(
+        glob.glob(os.path.join(logdir, "plugins", "profile", "*",
+                               "*.trace.json.gz")),
+        key=os.path.getmtime)
+    if not candidates:
+        raise FileNotFoundError(f"no trace found under {logdir}; call "
+                                "profiler.start/stop first")
+    with gzip.open(candidates[-1]) as f:
+        events = json.load(f)["traceEvents"]
+    device_pids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pname = e.get("args", {}).get("name", "")
+            if not device_only or "TPU" in pname or "GPU" in pname \
+                    or "/device" in pname:
+                device_pids.add(e["pid"])
+    if device_only and not device_pids:
+        import warnings
+
+        warnings.warn("profiler.summarize: no device process in this trace "
+                      "(CPU-only capture?); aggregating host events instead",
+                      stacklevel=2)
+    totals, counts = {}, {}
+    for e in events:
+        if e.get("ph") == "X" and (not device_pids
+                                   or e.get("pid") in device_pids):
+            name = e["name"]
+            totals[name] = totals.get(name, 0.0) + e.get("dur", 0) / 1e3
+            counts[name] = counts.get(name, 0) + 1
+    ranked = sorted(totals.items(), key=lambda kv: -kv[1])[:top]
+    return [(name, round(ms, 3), counts[name]) for name, ms in ranked]

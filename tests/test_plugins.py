@@ -196,3 +196,25 @@ def test_notebook_callbacks():
     cols = list(df.columns) if hasattr(df, "columns") else list(df[0].keys())
     assert "accuracy" in cols and "epoch" in cols
     assert len(curve.train) > 0
+
+
+def test_profiler_trace_and_summarize(tmp_path):
+    """profiler.start/stop + summarize aggregates per-op time from the
+    captured XLA trace."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import profiler
+
+    logdir = str(tmp_path / "prof")
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((128, 128))
+    f(x).block_until_ready()      # compile outside the trace
+    profiler.start(logdir)
+    with profiler.scope("bench-step"):
+        for _ in range(3):
+            f(x).block_until_ready()
+    profiler.stop()
+    rows = profiler.summarize(logdir, top=10, device_only=False)
+    assert rows and all(len(r) == 3 for r in rows)
+    assert any(ms > 0 for _, ms, _ in rows)
