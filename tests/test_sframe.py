@@ -55,6 +55,7 @@ def test_sframe_image_iter_mean_scale():
 
 
 def test_sframe_iter_trains_module():
+    mx.random.seed(7)
     rng = np.random.RandomState(2)
     X = rng.rand(64, 8).astype(np.float32)
     w = rng.rand(8)
@@ -65,7 +66,7 @@ def test_sframe_iter_trains_module():
         mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2),
         name="softmax")
     mod = mx.mod.Module(net)
-    mod.fit(it, num_epoch=10, initializer=mx.initializer.Xavier(),
+    mod.fit(it, num_epoch=25, initializer=mx.initializer.Xavier(),
             optimizer_params={"learning_rate": 0.5})
     score = dict(mod.score(it, mx.metric.create("acc")))
     assert score["accuracy"] > 0.8
