@@ -220,16 +220,20 @@ class SVMOutputOp(OpDef):
         return [inputs[0]], []
 
     def backward(self, params, out_grads, inputs, outputs):
+        # One-vs-all hinge, matching the reference kernels
+        # (src/operator/svm_output.cc:12-48): with s_j = +1 for the true
+        # class and -1 otherwise,
+        #   L1: grad_j = -s_j * reg * 1[margin - s_j x_j > 0]
+        #   L2: grad_j = -2 s_j * reg * max(margin - s_j x_j, 0)
         x, label = inputs[0], inputs[1]
         lab = label.astype(jnp.int32)
         onehot = jax.nn.one_hot(lab, x.shape[1], dtype=x.dtype)
-        score_correct = jnp.sum(x * onehot, axis=1, keepdims=True)
-        margin_viol = (x - score_correct + params.margin) > 0
+        sign = 2 * onehot - 1
+        slack = params.margin - sign * x
         if params.use_linear:
-            g = jnp.where(margin_viol, 1.0, 0.0) * (1 - onehot)
+            g = -sign * jnp.where(slack > 0, 1.0, 0.0)
         else:
-            g = 2 * jnp.maximum(x - score_correct + params.margin, 0) * (1 - onehot)
-        g = g - onehot * jnp.sum(g, axis=1, keepdims=True)
+            g = -2 * sign * jnp.maximum(slack, 0)
         g = g * params.regularization_coefficient
         return [g.astype(x.dtype), jnp.zeros_like(label)]
 

@@ -181,6 +181,10 @@ class DeconvolutionOp(OpDef):
         sh, sw = _pair(params.stride)
         ph, pw = _pair(params.pad, 2) if params.pad else (0, 0)
         ah, aw = _pair(params.adj, 2)
+        if ah >= sh or aw >= sw:
+            raise ValueError(
+                f"Deconvolution adj {params.adj} must be smaller than "
+                f"stride {(sh, sw)}")
         oh = sh * (data[2] - 1) + kh - 2 * ph + ah
         ow = sw * (data[3] - 1) + kw - 2 * pw + aw
         wshape = (c, params.num_filter // params.num_group, kh, kw)
@@ -194,10 +198,18 @@ class DeconvolutionOp(OpDef):
         kh, kw = _pair(params.kernel)
         sh, sw = _pair(params.stride)
         ph, pw = _pair(params.pad, 2) if params.pad else (0, 0)
+        ah, aw = _pair(params.adj, 2)
+        # adjoint kernel: (cin, cout/g, kh, kw) -> (cout, cin/g, kh, kw),
+        # in/out swapped within each group, spatially flipped
+        g = params.num_group
+        cin, cpg = w.shape[0], w.shape[1]
+        wk = w.reshape(g, cin // g, cpg, kh, kw).swapaxes(1, 2)
+        wk = jnp.flip(wk.reshape(g * cpg, cin // g, kh, kw), (-1, -2))
         y = lax.conv_general_dilated(
-            x, jnp.flip(w, (-1, -2)).swapaxes(0, 1) if params.num_group == 1 else w,
+            x, wk,
             window_strides=(1, 1),
-            padding=((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)),
+            padding=((kh - 1 - ph, kh - 1 - ph + ah),
+                     (kw - 1 - pw, kw - 1 - pw + aw)),
             lhs_dilation=(sh, sw),
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             feature_group_count=params.num_group,

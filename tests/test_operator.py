@@ -732,3 +732,164 @@ def test_pow_maximum_minimum_helpers():
                                np.maximum(xv, 0.8), rtol=1e-6)
     np.testing.assert_allclose(mx.nd.minimum(0.8, b).asnumpy(),
                                np.minimum(0.8, yv), rtol=1e-6)
+
+
+def _svm_bind(use_linear, x, lab, margin=1.0, reg=1.0):
+    X = mx.sym.Variable("X")
+    L = mx.sym.Variable("L")
+    out = mx.sym.SVMOutput(data=X, label=L, use_linear=use_linear,
+                           margin=margin, regularization_coefficient=reg)
+    exe = out.simple_bind(mx.cpu(), grad_req={"X": "write", "L": "null"},
+                          X=x.shape, L=lab.shape)
+    exe.arg_dict["X"][:] = x
+    exe.arg_dict["L"][:] = lab
+    fwd = exe.forward(is_train=True)[0].asnumpy()
+    exe.backward()
+    return fwd, exe.grad_dict["X"].asnumpy()
+
+
+def test_support_vector_machine_l1_svm():
+    # reference one-vs-all hinge semantics (svm_output.cc L1_SVM):
+    # grad_j = -s_j * 1[1 - s_j x_j > 0], s_j = +1 iff j == label
+    shape = (20, 10)
+    x = rng.rand(*shape).astype(np.float32)
+    lab = rng.randint(0, shape[1], (shape[0],)).astype(np.float32)
+    fwd, g = _svm_bind(True, x, lab)
+    np.testing.assert_allclose(fwd, x, rtol=1e-6)
+    l_mask = np.equal(lab.reshape(shape[0], 1), range(shape[1]))
+    l_mask = l_mask.astype(np.float32) * 2 - 1
+    expect = (-1) * l_mask * np.greater(1 - l_mask * x, 0)
+    np.testing.assert_allclose(g, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_support_vector_machine_l2_svm():
+    shape = (20, 10)
+    x = rng.rand(*shape).astype(np.float32)
+    lab = rng.randint(0, shape[1], (shape[0],)).astype(np.float32)
+    fwd, g = _svm_bind(False, x, lab)
+    np.testing.assert_allclose(fwd, x, rtol=1e-6)
+    l_mask = np.equal(lab.reshape(shape[0], 1), range(shape[1]))
+    l_mask = l_mask.astype(np.float32) * 2 - 1
+    expect = (-2) * l_mask * np.maximum(1 - l_mask * x, 0)
+    np.testing.assert_allclose(g, expect, rtol=1e-4, atol=1e-6)
+
+
+def test_svm_margin_and_reg_scaling():
+    x = rng.rand(6, 4).astype(np.float32)
+    lab = rng.randint(0, 4, (6,)).astype(np.float32)
+    _, g1 = _svm_bind(True, x, lab, margin=0.5, reg=3.0)
+    l_mask = (np.equal(lab.reshape(6, 1), range(4)).astype(np.float32) * 2 - 1)
+    expect = (-1) * l_mask * np.greater(0.5 - l_mask * x, 0) * 3.0
+    np.testing.assert_allclose(g1, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_deconvolution_forward_shape_and_transpose_identity():
+    # Deconvolution forward must equal the data-gradient of Convolution
+    # with the same kernel (transposed-conv identity the reference
+    # realises via the shared im2col core, deconvolution-inl.h).
+    n, cin, cout, h, w, k, s, p = 2, 3, 5, 7, 7, 3, 2, 1
+    x = rng.randn(n, cin, h, w).astype(np.float32)
+    wgt = rng.randn(cin, cout, k, k).astype(np.float32)
+
+    dec = mx.sym.Deconvolution(mx.sym.Variable("data"), kernel=(k, k),
+                               num_filter=cout, stride=(s, s), pad=(p, p),
+                               no_bias=True, name="dec")
+    oh = (h - 1) * s + k - 2 * p
+    arg_shapes, out_shapes, _ = dec.infer_shape(data=(n, cin, h, w))
+    assert out_shapes[0] == (n, cout, oh, oh)
+
+    exe = dec.simple_bind(mx.cpu(), data=(n, cin, h, w))
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["dec_weight"][:] = wgt
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (n, cout, oh, oh)
+
+    # conv that maps (n,cout,oh,oh) -> (n,cin,h,w) with the same weight;
+    # deconv fwd == sum over input contributions == conv backward-data
+    conv = mx.sym.Convolution(mx.sym.Variable("y"), kernel=(k, k),
+                              num_filter=cin, stride=(s, s), pad=(p, p),
+                              no_bias=True, name="conv")
+    cexe = conv.simple_bind(mx.cpu(), grad_req={"y": "write",
+                                                "conv_weight": "null"},
+                            y=(n, cout, oh, oh))
+    cexe.arg_dict["y"][:] = np.zeros((n, cout, oh, oh), np.float32)
+    cexe.arg_dict["conv_weight"][:] = wgt
+    cexe.forward(is_train=True)
+    cexe.backward([mx.nd.array(x)])
+    # grad of <conv(y), x> wrt y at y=0 equals deconv(x)
+    np.testing.assert_allclose(out, cexe.grad_dict["y"].asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_deconvolution_gradient():
+    n, cin, cout, h, k = 2, 2, 3, 5, 3
+    dec = mx.sym.Deconvolution(mx.sym.Variable("data"), kernel=(k, k),
+                               num_filter=cout, stride=(1, 1), pad=(1, 1),
+                               no_bias=True, name="dec")
+    check_numeric_gradient(
+        dec, {"data": rng.randn(n, cin, h, h),
+              "dec_weight": rng.randn(cin, cout, k, k)},
+        numeric_eps=1e-3, check_eps=0.05)
+
+
+def test_deconvolution_bias_and_adj():
+    n, cin, cout, h, k, s = 1, 2, 4, 4, 2, 2
+    dec = mx.sym.Deconvolution(mx.sym.Variable("data"), kernel=(k, k),
+                               num_filter=cout, stride=(s, s), adj=(1, 1),
+                               no_bias=False, name="dec")
+    oh = (h - 1) * s + k + 1  # + adj
+    _, out_shapes, _ = dec.infer_shape(data=(n, cin, h, h))
+    assert out_shapes[0] == (n, cout, oh, oh)
+    exe = dec.simple_bind(mx.cpu(), data=(n, cin, h, h))
+    exe.arg_dict["data"][:] = rng.randn(n, cin, h, h)
+    exe.arg_dict["dec_weight"][:] = rng.randn(cin, cout, k, k)
+    assert exe.forward(is_train=False)[0].shape == (n, cout, oh, oh)
+    bias = rng.randn(cout).astype(np.float32)
+    exe.arg_dict["dec_bias"][:] = bias
+    out = exe.forward(is_train=False)[0].asnumpy()
+    exe.arg_dict["dec_bias"][:] = np.zeros(cout, np.float32)
+    out0 = exe.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out - out0,
+                               np.broadcast_to(bias.reshape(1, -1, 1, 1),
+                                               out.shape), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_deconvolution_grouped():
+    # grouped transposed conv: per-group adjoint kernels (was a crash:
+    # the raw weight has the wrong layout for feature_group_count)
+    n, cin, cout, h, k, g = 2, 4, 6, 5, 3, 2
+    dec = mx.sym.Deconvolution(mx.sym.Variable("data"), kernel=(k, k),
+                               num_filter=cout, num_group=g, pad=(1, 1),
+                               no_bias=True, name="d")
+    x = rng.randn(n, cin, h, h).astype(np.float32)
+    w = rng.randn(cin, cout // g, k, k).astype(np.float32)
+    exe = dec.simple_bind(mx.cpu(), data=(n, cin, h, h))
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["d_weight"][:] = w
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (n, cout, h, h)
+    # group 0 of the output must equal an ungrouped deconv over group-0
+    # slices of data/weight
+    sub = mx.sym.Deconvolution(mx.sym.Variable("data"), kernel=(k, k),
+                               num_filter=cout // g, pad=(1, 1),
+                               no_bias=True, name="s")
+    sexe = sub.simple_bind(mx.cpu(), data=(n, cin // g, h, h))
+    for gi in range(g):
+        sexe.arg_dict["data"][:] = x[:, gi * cin // g:(gi + 1) * cin // g]
+        sexe.arg_dict["s_weight"][:] = w[gi * cin // g:(gi + 1) * cin // g]
+        sout = sexe.forward(is_train=False)[0].asnumpy()
+        np.testing.assert_allclose(
+            out[:, gi * cout // g:(gi + 1) * cout // g], sout,
+            rtol=1e-5, atol=1e-5)
+    check_numeric_gradient(dec, {"data": x, "d_weight": w},
+                           numeric_eps=1e-3, check_eps=0.05)
+
+
+def test_deconvolution_adj_ge_stride_rejected():
+    # reference deconvolution-inl.h enforces adj < stride
+    dec = mx.sym.Deconvolution(mx.sym.Variable("data"), kernel=(3, 3),
+                               num_filter=2, stride=(1, 1), adj=(1, 1),
+                               no_bias=True)
+    with pytest.raises(Exception):
+        dec.infer_shape(data=(1, 2, 4, 4))
