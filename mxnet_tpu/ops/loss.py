@@ -25,6 +25,9 @@ class SoftmaxOutputParam(Params):
     use_ignore = field(bool, default=False)
     preserve_shape = field(bool, default=False)
     normalization = field(str, default="null", enum=("null", "batch", "valid"))
+    out_grad = field(bool, default=False,
+                     doc="scale the gradient by the incoming output "
+                         "gradient (softmax_output-inl.h:132)")
 
 
 @register_op("SoftmaxOutput", aliases=("Softmax",))
@@ -42,9 +45,18 @@ class SoftmaxOutputOp(OpDef):
         d = in_shapes[0]
         if d is None:
             raise ValueError("SoftmaxOutput: data shape unknown")
+        given = in_shapes[1] if len(in_shapes) > 1 else None
+        # label.shape == data.shape: use probability as label
+        # (softmax_output-inl.h InferShape first branch)
+        if given is not None and tuple(given) == tuple(d):
+            return [tuple(d), tuple(d)], [tuple(d)], []
         if params.multi_output:
-            # data (n, c, d1...), label (n, d1...)
+            # data (n, c, d1...), label (n, d1...) (or flattened variants)
             label = (d[0],) + tuple(d[2:])
+            n_rest = int(np.prod(d)) // (d[0] * d[1]) if len(d) > 1 else 1
+            variants = {label, (d[0], n_rest), tuple(d[:1]) + (1,) + tuple(d[2:])}
+            if given is not None and tuple(given) in variants:
+                label = tuple(given)
         else:
             label = (d[0],)
         return [tuple(d), label], [tuple(d)], []
@@ -62,12 +74,28 @@ class SoftmaxOutputOp(OpDef):
         prob = outputs[0]
         label = inputs[1]
         axis = 1 if params.multi_output else -1
+        if label.shape == prob.shape:
+            # probability labels (soft targets)
+            grad = prob - label.astype(prob.dtype)
+            if params.out_grad and out_grads and out_grads[0] is not None:
+                grad = grad * out_grads[0].astype(grad.dtype)
+            grad = grad * params.grad_scale
+            return [grad, jnp.zeros_like(label)]
         nclass = prob.shape[axis]
         lab = label.astype(jnp.int32)
+        if params.multi_output:
+            # canonicalise every accepted label variant to (n, d1, ...):
+            # (n,1,d1,...) and the flattened (n, prod(d1...)) both reshape
+            # to the spatial layout of prob minus its class axis
+            spatial = prob.shape[:1] + prob.shape[2:]
+            if lab.shape != spatial:
+                lab = lab.reshape(spatial)
         onehot = jax.nn.one_hot(lab, nclass, dtype=prob.dtype, axis=axis)
         grad = prob - onehot
+        if params.out_grad and out_grads and out_grads[0] is not None:
+            grad = grad * out_grads[0].astype(grad.dtype)
         if params.use_ignore:
-            mask = (label != params.ignore_label)
+            mask = (lab != int(params.ignore_label))
             grad = grad * jnp.expand_dims(mask, axis).astype(grad.dtype)
             if params.normalization == "valid":
                 valid = jnp.maximum(jnp.sum(mask), 1).astype(grad.dtype)

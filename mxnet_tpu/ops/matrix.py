@@ -238,6 +238,9 @@ class ReshapeParam(Params):
                     doc="match the special codes from the right")
     target_shape = field(tuple_of(int), default=None,
                          doc="legacy alias; 0 infers the remainder")
+    keep_highest = field(bool, default=False,
+                         doc="legacy: ignore target_shape[0] and keep the "
+                             "input's first dim unchanged")
 
 
 def _apply_reshape_codes(src, spec):
@@ -316,6 +319,8 @@ def _resolve_reshape(p, in_shape):
     elif p.target_shape is not None:
         # legacy API: 0 infers the remaining elements
         out = list(p.target_shape)
+        if p.keep_highest:
+            out[0] = in_shape[0]
         infer_at = out.index(0) if 0 in out else None
         if infer_at is not None:
             out[infer_at] = 1

@@ -86,8 +86,9 @@ class ConvolutionParam(Params):
     pad = field(tuple_of(int), default=None)
     num_group = field(int, default=1, lower=1)
     no_bias = field(bool, default=False)
-    workspace = field(int, default=512, doc="ignored (XLA owns scratch)")
+    workspace = field(int, default=1024, doc="ignored (XLA owns scratch)")
     cudnn_tune = field(str, default=None, doc="ignored on TPU")
+    cudnn_off = field(bool, default=False, doc="ignored on TPU")
     layout = field(str, default="NCHW", enum=("NCHW", "NHWC"))
 
 
@@ -604,6 +605,8 @@ class UpSamplingParam(Params):
     sample_type = field(str, default="nearest", enum=("nearest", "bilinear"))
     num_args = field(int, default=1)
     num_filter = field(int, default=0)
+    multi_input_mode = field(str, default="concat", enum=("concat", "sum"))
+    workspace = field(int, default=512, doc="unused on TPU; kept for compat")
 
 
 @register_op("UpSampling")
@@ -618,7 +621,25 @@ class UpSamplingOp(OpDef):
     def infer_shape(self, params, in_shapes):
         d = in_shapes[0]
         oh, ow = d[2] * params.scale, d[3] * params.scale
-        c = sum(s[1] for s in in_shapes if s is not None) if params.num_args > 1 else d[1]
+        if params.num_args > 1:
+            for s in in_shapes:
+                if s is None:
+                    continue
+                if oh % s[2] or ow % s[3]:
+                    raise ValueError(
+                        "UpSampling: input spatial size "
+                        f"{(s[2], s[3])} must evenly divide the output "
+                        f"{(oh, ow)} (= in0 * scale)")
+        if params.num_args > 1 and params.multi_input_mode == "sum":
+            cs = {s[1] for s in in_shapes if s is not None}
+            if len(cs) > 1:
+                raise ValueError(
+                    "UpSampling: number of channels must be the same "
+                    f"when multi_input_mode=sum, got {sorted(cs)}")
+            c = d[1]
+        else:
+            c = (sum(s[1] for s in in_shapes if s is not None)
+                 if params.num_args > 1 else d[1])
         completed = list(in_shapes)
         if params.sample_type == "bilinear":
             k = 2 * params.scale - params.scale % 2
@@ -627,17 +648,25 @@ class UpSamplingOp(OpDef):
 
     def forward(self, params, inputs, aux, train, key):
         s = params.scale
+        # multi-input: each input gets its own scale to reach the common
+        # output size out_h = in0_h * scale (upsampling-inl.h:90, the
+        # FCN-skip-connection pattern)
+        oh, ow = inputs[0].shape[2] * s, inputs[0].shape[3] * s
         outs = []
         for x in (inputs if params.sample_type == "nearest" and params.num_args > 1
                   else inputs[:1]):
             if params.sample_type == "nearest":
-                y = jnp.repeat(jnp.repeat(x, s, axis=2), s, axis=3)
+                si, sj = oh // x.shape[2], ow // x.shape[3]
+                y = jnp.repeat(jnp.repeat(x, si, axis=2), sj, axis=3)
             else:
                 n, c, h, w = x.shape
                 y = jax.image.resize(x, (n, c, h * s, w * s), method="bilinear")
             outs.append(y)
         if len(outs) > 1:
-            # multi-input nearest mode upsamples each to the first's size and concats
+            # multi-input nearest: concat channels, or elementwise sum
+            # (upsampling-inl.h up_enum::kSum)
+            if params.multi_input_mode == "sum":
+                return [functools.reduce(jnp.add, outs)], []
             return [jnp.concatenate(outs, axis=1)], []
         return [outs[0]], []
 

@@ -893,3 +893,125 @@ def test_deconvolution_adj_ge_stride_rejected():
                                no_bias=True)
     with pytest.raises(Exception):
         dec.infer_shape(data=(1, 2, 4, 4))
+
+
+def test_softmax_output_soft_labels_and_out_grad():
+    # probability labels: label.shape == data.shape -> grad = p - label
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    x = rng.randn(4, 5).astype(np.float32)
+    soft = rng.rand(4, 5).astype(np.float32)
+    soft /= soft.sum(axis=1, keepdims=True)
+    sm = mx.sym.SoftmaxOutput(data, label, name="sm")
+    exe = sm.simple_bind(mx.cpu(), grad_req={"data": "write", "label": "null"},
+                         data=(4, 5), label=(4, 5))
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["label"][:] = soft
+    p = exe.forward(is_train=True)[0].asnumpy()
+    exe.backward()
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), p - soft,
+                               rtol=1e-4, atol=1e-6)
+
+    # out_grad=True scales the gradient by the incoming output gradient
+    smo = mx.sym.SoftmaxOutput(data, label, out_grad=True, name="sm2")
+    exe2 = smo.simple_bind(mx.cpu(), grad_req={"data": "write",
+                                               "label": "null"},
+                           data=(4, 5), label=(4,))
+    lab = np.array([0, 2, 1, 4], np.float32)
+    exe2.arg_dict["data"][:] = x
+    exe2.arg_dict["label"][:] = lab
+    p2 = exe2.forward(is_train=True)[0].asnumpy()
+    og = rng.rand(4, 5).astype(np.float32)
+    exe2.backward([mx.nd.array(og)])
+    onehot = np.eye(5)[lab.astype(int)]
+    np.testing.assert_allclose(exe2.grad_dict["data"].asnumpy(),
+                               (p2 - onehot) * og, rtol=1e-4, atol=1e-6)
+
+
+def test_upsampling_multi_input_modes():
+    # FCN-style skip connection: two inputs of different spatial size,
+    # each upsampled by its own factor to in0*scale (upsampling-inl.h:90)
+    a = rng.randn(1, 2, 4, 4).astype(np.float32)
+    b = rng.randn(1, 2, 8, 8).astype(np.float32)
+    up = mx.sym.UpSampling(mx.sym.Variable("a"), mx.sym.Variable("b"),
+                           scale=4, sample_type="nearest", num_args=2)
+    exe = up.simple_bind(mx.cpu(), a=a.shape, b=b.shape)
+    exe.arg_dict["a"][:] = a
+    exe.arg_dict["b"][:] = b
+    out = exe.forward(is_train=False)[0].asnumpy()
+    ra = a.repeat(4, axis=2).repeat(4, axis=3)
+    rb = b.repeat(2, axis=2).repeat(2, axis=3)
+    np.testing.assert_allclose(out, np.concatenate([ra, rb], axis=1),
+                               rtol=1e-6)
+
+    ups = mx.sym.UpSampling(mx.sym.Variable("a"), mx.sym.Variable("b"),
+                            scale=4, sample_type="nearest", num_args=2,
+                            multi_input_mode="sum")
+    exe = ups.simple_bind(mx.cpu(), a=a.shape, b=b.shape)
+    exe.arg_dict["a"][:] = a
+    exe.arg_dict["b"][:] = b
+    out = exe.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out, ra + rb, rtol=1e-6)
+
+
+def test_reshape_keep_highest():
+    x = rng.randn(6, 8).astype(np.float32)
+    r = mx.sym.Reshape(mx.sym.Variable("data"), target_shape=(0, 2, 2, 2),
+                       keep_highest=True)
+    out = mx.test_utils.simple_forward(r, data=x)
+    np.testing.assert_allclose(out, x.reshape(6, 2, 2, 2))
+
+
+def test_softmax_output_multi_output_label_variants():
+    # all three accepted label layouts from the reference InferShape:
+    # (n, d1...), (n, 1, d1...), (n, prod(d1...)) — identical gradients
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    x = rng.randn(2, 3, 2, 2).astype(np.float32)
+    lab = rng.randint(0, 3, (2, 2, 2)).astype(np.float32)
+    grads = []
+    for lshape, lval in [((2, 2, 2), lab),
+                         ((2, 1, 2, 2), lab.reshape(2, 1, 2, 2)),
+                         ((2, 4), lab.reshape(2, 4))]:
+        sm = mx.sym.SoftmaxOutput(data, label, multi_output=True, name="sm")
+        exe = sm.simple_bind(mx.cpu(), grad_req={"data": "write",
+                                                 "label": "null"},
+                             data=x.shape, label=lshape)
+        exe.arg_dict["data"][:] = x
+        exe.arg_dict["label"][:] = lval
+        exe.forward(is_train=True)
+        exe.backward()
+        g = exe.grad_dict["data"].asnumpy()
+        assert g.shape == x.shape
+        grads.append(g)
+    np.testing.assert_allclose(grads[0], grads[1], rtol=1e-6)
+    np.testing.assert_allclose(grads[0], grads[2], rtol=1e-6)
+
+
+def test_softmax_output_multi_output_use_ignore():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    x = rng.randn(2, 3, 2, 2).astype(np.float32)
+    lab = rng.randint(0, 3, (2, 1, 2, 2)).astype(np.float32)
+    lab.reshape(-1)[0] = -1  # ignored position
+    sm = mx.sym.SoftmaxOutput(data, label, multi_output=True,
+                              use_ignore=True, ignore_label=-1, name="sm")
+    exe = sm.simple_bind(mx.cpu(), grad_req={"data": "write",
+                                             "label": "null"},
+                         data=x.shape, label=lab.shape)
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["label"][:] = lab
+    exe.forward(is_train=True)
+    exe.backward()
+    g = exe.grad_dict["data"].asnumpy()
+    assert g.shape == x.shape
+    # the ignored position's gradient column must be exactly zero
+    np.testing.assert_allclose(g[0, :, 0, 0], 0.0)
+    assert np.abs(g).sum() > 0
+
+
+def test_upsampling_non_divisible_rejected():
+    up = mx.sym.UpSampling(mx.sym.Variable("a"), mx.sym.Variable("b"),
+                           scale=4, sample_type="nearest", num_args=2)
+    with pytest.raises(Exception):
+        up.infer_shape(a=(1, 2, 4, 4), b=(1, 2, 3, 3))
