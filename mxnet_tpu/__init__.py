@@ -3,12 +3,25 @@ capabilities (NDArray, Symbol/Executor, Module, KVStore, data iterators)
 rebuilt idiomatically on JAX/XLA/Pallas.  See SURVEY.md for the mapping
 to the reference architecture."""
 
+import os as _os
+
 import jax as _jax
 
 # The reference framework supports float64 end to end (mshadow type switch);
 # enable x64 so dtype parity holds.  Weak-typed python scalars still keep
 # float32 results in f32 graphs, so TPU perf paths are unaffected.
 _jax.config.update("jax_enable_x64", True)
+
+# MXTPU_PLATFORMS: framework-owned backend selector.  JAX_PLATFORMS is
+# unusable for this — accelerator site plugins (axon sitecustomize)
+# overwrite it at interpreter startup, so subprocesses (CLI tools, test
+# workers) that exported JAX_PLATFORMS=cpu would still open the
+# accelerator client and block while another process holds the chip.
+if _os.environ.get("MXTPU_PLATFORMS"):
+    try:
+        _jax.config.update("jax_platforms", _os.environ["MXTPU_PLATFORMS"])
+    except Exception:
+        pass  # backend already initialized by the embedding process
 
 from . import base
 from .base import MXNetError

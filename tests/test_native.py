@@ -257,3 +257,25 @@ def test_c_predict_api_from_c(tmp_path):
     assert r.returncode == 0, r.stderr[-2000:]
     got = np.array([float(x) for x in r.stdout.split()]).reshape(want.shape)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_cpp_engine_stress(tmp_path):
+    """Pure-C++ randomized workload-equivalence stress for the native
+    engine (tests/cpp/engine_stress.cc — the threaded_engine_test.cc
+    analog): serial run and threaded run must agree exactly."""
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ compiler")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(repo, "tests", "cpp", "engine_stress.cc")
+    exe = str(tmp_path / "engine_stress")
+    lib_dir = os.path.join(repo, "mxnet_tpu", "lib")
+    subprocess.run(
+        ["g++", "-O2", "-I" + os.path.join(repo, "include"), src,
+         "-L" + lib_dir, "-lmxtpu", "-Wl,-rpath," + lib_dir, "-o", exe],
+        check=True, capture_output=True)
+    out = subprocess.run([exe], capture_output=True, text=True, check=True,
+                         timeout=120)
+    assert "ENGINE_STRESS_OK" in out.stdout

@@ -1,0 +1,328 @@
+#!/usr/bin/env python
+"""accnn — accelerate a trained CNN by low-rank factorization.
+
+Port of the reference tool suite ``tools/accnn`` (accnn.py, acc_conv.py,
+acc_fc.py, rank_selection.py, utils.py): decompose k×k convolutions into a
+vertical (k×1) + horizontal (1×k) pair and fully-connected layers into two
+smaller ones via SVD, with ranks chosen by dynamic programming over the
+eigenvalue energy under a FLOP budget (``--ratio`` = target speedup).
+
+TPU notes: the factorized model is a plain symbol graph, so XLA re-fuses
+the two thin convs; the win on TPU is reduced MXU work and HBM traffic
+for weight-heavy layers, same as the CUDA original.
+
+Usage:
+  python tools/accnn.py -m model-prefix --load-epoch 1 \
+      --save-model new-model --ratio 2 [--config ranks.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import collections
+import json
+import math
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+# ---------------------------------------------------------------- graph utils
+def topsort(nodes):
+    """Topological order of graph-JSON nodes, inputs re-indexed."""
+    n = len(nodes)
+    deg = [0] * n
+    children = [[] for _ in range(n)]
+    for i, node in enumerate(nodes):
+        for j in node.get("inputs", []):
+            deg[i] += 1
+            children[j[0]].append(i)
+    queue = collections.deque(i for i in range(n) if deg[i] == 0)
+    order = []
+    while queue:
+        i = queue.popleft()
+        order.append(nodes[i])
+        for j in children[i]:
+            deg[j] -= 1
+            if deg[j] == 0:
+                queue.append(j)
+    if len(order) != n:
+        raise ValueError("graph JSON contains a cycle")
+    new_ids = {node["name"]: i for i, node in enumerate(order)}
+    for node in order:
+        for j in node.get("inputs", []):
+            j[0] = new_ids[nodes[j[0]]["name"]]
+    return order
+
+
+def is_input(node):
+    name = node["name"]
+    return (node["op"] == "null" and not node.get("inputs")
+            and "weight" not in name and "bias" not in name
+            and "label" not in name
+            and not name.endswith(("_gamma", "_beta", "_moving_mean",
+                                   "_moving_var")))
+
+
+def _sym_factory(node, data):
+    """Rebuild one op node on top of ``data`` (fresh weights, same name)."""
+    params = {}
+    for k, v in node.get("param", {}).items():
+        try:
+            params[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            params[k] = v
+    op = getattr(mx.symbol, node["op"])
+    if isinstance(data, (list, tuple)):
+        return op(*data, name=node["name"], **params)
+    return op(data, name=node["name"], **params)
+
+
+def replace_layer(model, layer_name, sym_handle, arg_handle,
+                  data_shape=(1, 3, 224, 224)):
+    """Rebuild the model's graph with ``layer_name`` substituted by
+    ``sym_handle(data, node)``; ``arg_handle(arg_shape_dic, arg_params)``
+    then fills in the factorized weights (reference utils.py
+    replace_conv_layer)."""
+    conf = json.loads(model.symbol.tojson())
+    nodes = topsort(conf["nodes"])
+    sym_dict = {}
+    res_sym = None
+    for node in nodes:
+        sym = None
+        if is_input(node):
+            sym = mx.symbol.Variable(name=node["name"])
+        elif node["op"] != "null":
+            input_nodes = [nodes[j[0]] for j in node["inputs"]]
+            datas = [n["name"] for n in input_nodes
+                     if n["name"] in sym_dict
+                     and not n["name"].startswith(node["name"] + "_")]
+            data = [sym_dict[d] for d in datas]
+            if len(data) == 1:
+                data = data[0]
+            if node["name"] == layer_name:
+                sym = sym_handle(data, node)
+            else:
+                sym = _sym_factory(node, data)
+        if sym is not None:
+            sym_dict[node["name"]] = sym
+            res_sym = sym
+
+    arg_params = dict(model.arg_params or {})
+    arg_shapes, _, _ = res_sym.infer_shape(data=data_shape)
+    arg_shape_dic = dict(zip(res_sym.list_arguments(), arg_shapes))
+    arg_handle(arg_shape_dic, arg_params)
+    # drop the replaced layer's own weights
+    valid = set(res_sym.list_arguments())
+    arg_params = {k: v for k, v in arg_params.items() if k in valid}
+
+    return mx.model.FeedForward(
+        symbol=res_sym, ctx=model.ctx, num_epoch=1,
+        epoch_size=model.epoch_size, optimizer="sgd",
+        initializer=model.initializer,
+        numpy_batch_size=model.numpy_batch_size,
+        arg_params=arg_params, aux_params=model.aux_params,
+        allow_extra_params=True, begin_epoch=model.begin_epoch)
+
+
+def _as_np(v):
+    return v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+
+
+# --------------------------------------------------------- conv factorization
+def conv_vh_decomposition(model, layer, K, data_shape=(1, 3, 224, 224)):
+    """k×k conv → (k×1) conv with K filters + (1×k) conv (acc_conv.py):
+    SVD of W viewed as (C·y, N·x)."""
+    W = _as_np(model.arg_params[layer + "_weight"])
+    N, C, y, x = W.shape
+    b = _as_np(model.arg_params[layer + "_bias"]) \
+        if layer + "_bias" in model.arg_params else np.zeros(N, W.dtype)
+    M = W.transpose(1, 2, 0, 3).reshape(C * y, N * x)
+    U, D, Q = np.linalg.svd(M, full_matrices=False)
+    sqrt_d = np.sqrt(D[:K])
+    V = (U[:, :K] * sqrt_d)          # (C*y, K)
+    H = (Q[:K, :].T * sqrt_d)        # (N*x, K)
+    W1 = V.T.reshape(K, C, y, 1)
+    W2 = H.reshape(N, x, 1, K).transpose(0, 3, 2, 1)  # (N, K, 1, x)
+
+    name_v, name_h = layer + "_v", layer + "_h"
+
+    def sym_handle(data, node):
+        kernel = ast.literal_eval(node["param"]["kernel"])
+        pad = ast.literal_eval(node["param"].get("pad", "(0, 0)"))
+        stride = ast.literal_eval(node["param"].get("stride", "(1, 1)"))
+        s1 = mx.symbol.Convolution(data, kernel=(kernel[0], 1),
+                                   pad=(pad[0], 0), stride=(stride[0], 1),
+                                   num_filter=K, name=name_v)
+        return mx.symbol.Convolution(s1, kernel=(1, kernel[1]),
+                                     pad=(0, pad[1]), stride=(1, stride[1]),
+                                     num_filter=N, name=name_h)
+
+    def arg_handle(arg_shape_dic, arg_params):
+        for nm, val in ((name_v + "_weight", W1),
+                        (name_v + "_bias", np.zeros(K, W.dtype)),
+                        (name_h + "_weight", W2), (name_h + "_bias", b)):
+            assert tuple(val.shape) == tuple(arg_shape_dic[nm]), \
+                (nm, val.shape, arg_shape_dic[nm])
+            arg_params[nm] = mx.nd.array(val)
+
+    return replace_layer(model, layer, sym_handle, arg_handle, data_shape)
+
+
+# ----------------------------------------------------------- fc factorization
+def fc_decomposition(model, layer, K, data_shape=(1, 3, 224, 224)):
+    """FC(N) → FC(K, no bias) + FC(N) via truncated SVD (acc_fc.py)."""
+    W = _as_np(model.arg_params[layer + "_weight"])
+    b = _as_np(model.arg_params[layer + "_bias"]) \
+        if layer + "_bias" in model.arg_params else None
+    W2d = W.reshape(W.shape[0], -1)
+    u, s, v = np.linalg.svd(W2d, full_matrices=False)
+    P = u[:, :K]                       # (N, K)
+    Q = (s[:K, None] * v[:K, :])       # (K, in)
+
+    name1, name2 = layer + "_red", layer + "_rec"
+
+    def sym_handle(data, node):
+        s1 = mx.symbol.FullyConnected(data, num_hidden=K, no_bias=True,
+                                      name=name1)
+        return mx.symbol.FullyConnected(s1, num_hidden=W2d.shape[0],
+                                        no_bias=b is None, name=name2)
+
+    def arg_handle(arg_shape_dic, arg_params):
+        arg_params[name1 + "_weight"] = mx.nd.array(
+            Q.reshape(arg_shape_dic[name1 + "_weight"]))
+        arg_params[name2 + "_weight"] = mx.nd.array(
+            P.reshape(arg_shape_dic[name2 + "_weight"]))
+        if b is not None:
+            arg_params[name2 + "_bias"] = mx.nd.array(
+                b.reshape(arg_shape_dic[name2 + "_bias"]))
+
+    return replace_layer(model, layer, sym_handle, arg_handle, data_shape)
+
+
+# -------------------------------------------------------------- rank selection
+def _conv_complexity(ishape, node):
+    y, x = ast.literal_eval(node["param"]["kernel"])
+    N = int(node["param"]["num_filter"])
+    C, Y, X = ishape
+    # (cost per rank of the factorized pair, cost of the original conv)
+    return x * (N + C) * X * Y, x * y * N * C * X * Y
+
+
+def _conv_spectrum(model, node):
+    W = _as_np(model.arg_params[node["name"] + "_weight"])
+    N, C, y, x = W.shape
+    M = W.transpose(1, 2, 0, 3).reshape(C * y, N * x)
+    return np.linalg.svd(M, compute_uv=False)
+
+
+def get_ranksel(model, ratio, data_shape=(1, 3, 224, 224)):
+    """Choose per-conv ranks maximizing summed log eigenvalue energy under
+    a total-FLOP budget original/ratio (rank_selection.py DP)."""
+    conf = json.loads(model.symbol.tojson())
+    internals = model.symbol.get_internals()
+    _, output_shapes, _ = internals.infer_shape(data=data_shape)
+    out_shape = dict(zip(internals.list_outputs(), output_shapes))
+    nodes = topsort(conf["nodes"])
+
+    costs, max_rank, spectra, conv_names = [], [], [], []
+    total = 0
+    for node in nodes:
+        if node["op"] != "Convolution":
+            continue
+        input_nodes = [nodes[j[0]] for j in node["inputs"]]
+        data = [n for n in input_nodes
+                if not n["name"].startswith(node["name"] + "_")][0]
+        if is_input(data):
+            ishape = tuple(data_shape[1:])
+        else:
+            ishape = tuple(out_shape[data["name"] + "_output"][1:])
+        costs.append(_conv_complexity(ishape, node))
+        max_rank.append(int(node["param"]["num_filter"]))
+        spectra.append(np.cumsum(_conv_spectrum(model, node)))
+        conv_names.append(node["name"])
+        total += costs[-1][1]
+
+    budget = total / ratio
+    n = len(costs)
+    dp = {0: 0.0}
+    choice = [{} for _ in range(n)]
+    for i in range(n):
+        nxt = {}
+        per_rank = costs[i][0]
+        for used, value in dp.items():
+            for d in range(min(len(spectra[i]), max_rank[i])):
+                c = used + (d + 1) * per_rank
+                if c > budget:
+                    break
+                v = value + math.log(spectra[i][d])
+                if c not in nxt or v > nxt[c]:
+                    nxt[c] = v
+                    choice[i][c] = (d, used)
+        if not nxt:
+            raise ValueError(
+                f"accnn: ratio {ratio} leaves no feasible rank assignment")
+        dp = nxt
+
+    best_c = max(dp, key=dp.get)
+    ranks = [0] * n
+    c = best_c
+    for i in range(n - 1, -1, -1):
+        d, c = choice[i][c]
+        ranks[i] = d + 1
+    return dict(zip(conv_names, ranks))
+
+
+def compress(model, ratio=2.0, config=None, data_shape=(1, 3, 224, 224)):
+    """Apply rank selection + factorization to every conv/fc in config
+    (accnn.py main flow); returns the new FeedForward model."""
+    if config is None:
+        config = {"conv_params": get_ranksel(model, ratio, data_shape),
+                  "fc_params": {}}
+    new_model = model
+    for layer, K in config.get("conv_params", {}).items():
+        new_model = conv_vh_decomposition(new_model, layer, int(K),
+                                          data_shape)
+    for layer, K in config.get("fc_params", {}).items():
+        new_model = fc_decomposition(new_model, layer, int(K), data_shape)
+    return new_model
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("-m", "--model", required=True,
+                        help="checkpoint prefix of the model to speed up")
+    parser.add_argument("--load-epoch", type=int, default=1)
+    parser.add_argument("--save-model", type=str, default="new-model")
+    parser.add_argument("--config", default=None,
+                        help="JSON file with conv_params/fc_params ranks")
+    parser.add_argument("--ratio", type=float, default=2.0,
+                        help="target speedup when no config is given")
+    parser.add_argument("--data-shape", default="1,3,224,224")
+    args = parser.parse_args(argv)
+
+    data_shape = tuple(int(d) for d in args.data_shape.split(","))
+    model = mx.model.FeedForward.load(args.model, args.load_epoch)
+    if args.config:
+        with open(args.config) as f:
+            config = json.load(f)
+    else:
+        config = {"conv_params": get_ranksel(model, args.ratio, data_shape),
+                  "fc_params": {}}
+        out = f"config-rksel-{args.ratio:.1f}.json"
+        with open(out, "w") as f:
+            json.dump(config, f, indent=2)
+        print(f"accnn: wrote rank selection to {out}")
+    new_model = compress(model, args.ratio, config, data_shape)
+    new_model.save(args.save_model, 1)
+    print(f"accnn: saved factorized model to {args.save_model}")
+
+
+if __name__ == "__main__":
+    main()

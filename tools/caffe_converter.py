@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""caffe_converter — convert a Caffe model to a native checkpoint.
+
+Port of the reference ``tools/caffe_converter`` (convert_symbol.py +
+convert_model.py): translates the prototxt to a Symbol and maps the
+``.caffemodel`` binary's weight blobs onto framework parameter names,
+writing the standard two-artifact checkpoint (symbol JSON + params).
+No Caffe or protobuf installation needed — the binary is decoded by a
+built-in protobuf wire-format reader (mxnet_tpu/caffe.py).
+
+Usage:
+  python tools/caffe_converter.py deploy.prototxt net.caffemodel out-prefix
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import caffe as caffe_mod  # noqa: E402
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("prototxt")
+    parser.add_argument("caffemodel")
+    parser.add_argument("prefix", help="output checkpoint prefix")
+    parser.add_argument("--epoch", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    with open(args.prototxt) as f:
+        prototxt = f.read()
+    with open(args.caffemodel, "rb") as f:
+        blob = f.read()
+    symbol, arg_params, aux_params = caffe_mod.convert_model(prototxt, blob)
+    mx.model.save_checkpoint(args.prefix, args.epoch, symbol, arg_params,
+                             aux_params)
+    print(f"caffe_converter: wrote {args.prefix}-symbol.json and "
+          f"{args.prefix}-{args.epoch:04d}.params "
+          f"({len(arg_params)} args, {len(aux_params)} aux)")
+
+
+if __name__ == "__main__":
+    main()
