@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Time-major RNN language model (rebuild of
+example/rnn-time-major/rnn_cell_demo.py).
+
+The point of the original example: feed sequences **time-major** (T, N)
+end to end, so the fused RNN op consumes its natural layout with no
+SwapAxis transposes in the graph — the reference README measures
+1.5-2x over batch-major.  The batch axis is declared via the DataDesc
+``layout`` field ('TN': batch axis 1), which the executor group honors
+when slicing batches across devices (io.py DataDesc / executor_group
+decide_slices).  On TPU the same layout argument keeps XLA from having
+to fuse away two transposes around the scan.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def build_net(seq_len, vocab_size, num_hidden=64, num_embed=32):
+    data = mx.sym.Variable("data")          # (seq_len, batch) — time-major
+    embed = mx.sym.Embedding(data, name="embed", input_dim=vocab_size,
+                             output_dim=num_embed)  # (T, N, E)
+    rnn = mx.sym.RNN(embed, name="lstm", mode="lstm", state_size=num_hidden,
+                     num_layers=1,
+                     parameters=mx.sym.Variable("lstm_parameters"),
+                     state=mx.sym.Variable("lstm_state"),
+                     state_cell=mx.sym.Variable("lstm_state_cell"))
+    flat = mx.sym.Reshape(rnn, shape=(-1, num_hidden))      # (T*N, H)
+    fc = mx.sym.FullyConnected(flat, name="cls", num_hidden=vocab_size)
+    label = mx.sym.Variable("softmax_label")                # (T, N)
+    label_flat = mx.sym.Reshape(label, shape=(-1,))
+    return mx.sym.SoftmaxOutput(fc, label_flat, name="softmax")
+
+
+class TimeMajorIter(mx.io.DataIter):
+    """Yields (T, N) token batches with next-token labels; DataDescs
+    carry layout='TN' so the module slices the batch on axis 1."""
+
+    def __init__(self, corpus, batch_size, seq_len):
+        super().__init__()
+        self.corpus = corpus
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        n_seq = (len(corpus) - 1) // seq_len
+        self.n_batches = n_seq // batch_size
+        self.cursor = 0
+        self.provide_data = [mx.io.DataDesc(
+            "data", (seq_len, batch_size), layout="TN")]
+        self.provide_label = [mx.io.DataDesc(
+            "softmax_label", (seq_len, batch_size), layout="TN")]
+
+    def reset(self):
+        self.cursor = 0
+
+    def next(self):
+        if self.cursor >= self.n_batches:
+            raise StopIteration
+        i = self.cursor * self.batch_size * self.seq_len
+        self.cursor += 1
+        span = self.batch_size * self.seq_len
+        x = self.corpus[i:i + span].reshape(self.batch_size, self.seq_len).T
+        y = self.corpus[i + 1:i + span + 1].reshape(
+            self.batch_size, self.seq_len).T
+        return mx.io.DataBatch(data=[mx.nd.array(x)],
+                               label=[mx.nd.array(y)],
+                               provide_data=self.provide_data,
+                               provide_label=self.provide_label)
+
+
+def perplexity(label, pred):
+    """Perplexity over flattened (T*N,) labels vs (T*N, V) probs
+    (the reference example's metric)."""
+    label = label.reshape(-1).astype(int)
+    probs = pred[np.arange(len(label)), label]
+    return float(np.exp(-np.mean(np.log(np.maximum(probs, 1e-10)))))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--seq-len", type=int, default=16)
+    p.add_argument("--vocab", type=int, default=64)
+    p.add_argument("--num-epochs", type=int, default=2)
+    p.add_argument("--lr", type=float, default=0.5)
+    p.add_argument("--corpus-len", type=int, default=20000)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    # synthetic markov-ish corpus: token i is usually followed by i+1
+    rng = np.random.RandomState(7)
+    corpus = np.zeros(args.corpus_len, np.int64)
+    for i in range(1, args.corpus_len):
+        corpus[i] = ((corpus[i - 1] + 1) % args.vocab
+                     if rng.rand() < 0.9 else rng.randint(args.vocab))
+
+    net = build_net(args.seq_len, args.vocab)
+    it = TimeMajorIter(corpus.astype(np.float32), args.batch_size,
+                       args.seq_len)
+    mod = mx.mod.Module(net)
+    metric = mx.metric.np(perplexity, name="perplexity")
+    mod.fit(it, eval_metric=metric, num_epoch=args.num_epochs,
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.initializer.Xavier())
+    it.reset()
+    score = dict(mod.score(it, mx.metric.np(perplexity, name="perplexity")))
+    ppl = score["custom(perplexity)"]
+    logging.info("final perplexity %.2f (uniform would be %d)", ppl,
+                 args.vocab)
+    # the 0.9-probability successor structure is learnable: ppl far
+    # below uniform proves the time-major path trains
+    assert ppl < args.vocab / 4, ppl
+    print(f"TIME_MAJOR_OK ppl={ppl:.2f}")
+
+
+if __name__ == "__main__":
+    main()

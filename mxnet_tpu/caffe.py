@@ -371,6 +371,8 @@ def _read_varint(buf, pos):
     result = 0
     shift = 0
     while True:
+        if pos >= len(buf):
+            raise MXNetError("caffemodel: truncated varint")
         b = buf[pos]
         pos += 1
         result |= (b & 0x7F) << shift

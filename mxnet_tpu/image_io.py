@@ -118,6 +118,7 @@ class ImageRecordIter(DataIter):
         self.shuffle = shuffle
         self.data_name = data_name
         self.label_name = label_name
+        self._round_batch = round_batch
         self._rng = np.random.RandomState(seed + part_index)
         self._aug = ImageAugmenter(self.data_shape, resize=resize,
                                    rand_crop=rand_crop,
@@ -280,19 +281,35 @@ class ImageRecordIter(DataIter):
     def _produce_epoch(self, pool, reader):
         bs = self.batch_size
         n = len(self._order)
-        for start in range(0, n - bs + 1, bs):
-            idxs = self._order[start:start + bs]
-            raws = []
-            for i in idxs:
-                reader.handle.seek(self._offsets[i])
-                raws.append(reader.read())
-            seeds = self._rng.randint(0, 2**31, size=bs)
-            results = list(pool.map(self._decode_one, raws, seeds))
-            data = np.stack([r[0] for r in results])
-            label = np.stack([r[1] for r in results])
-            if self.label_width == 1:
-                label = label.reshape(bs)
-            yield DataBatch([nd.array(data)], [nd.array(label)], pad=0)
+        starts = list(range(0, n - bs + 1, bs))
+        leftover = n - len(starts) * bs
+        if not starts and not (leftover and self._round_batch):
+            raise MXNetError("fewer records than batch_size "
+                             "(and round_batch disabled)")
+        for start in starts:
+            yield self._make_batch(pool, reader,
+                                   self._order[start:start + bs], pad=0)
+        if leftover and self._round_batch:
+            # complete the final batch by wrapping to the epoch start and
+            # report the pad count (iter_batchloader.h round_batch /
+            # num_batch_padd semantics)
+            idxs = np.concatenate([self._order[n - leftover:],
+                                   np.resize(self._order, bs - leftover)])
+            yield self._make_batch(pool, reader, idxs, pad=bs - leftover)
+
+    def _make_batch(self, pool, reader, idxs, pad):
+        bs = self.batch_size
+        raws = []
+        for i in idxs:
+            reader.handle.seek(self._offsets[i])
+            raws.append(reader.read())
+        seeds = self._rng.randint(0, 2**31, size=bs)
+        results = list(pool.map(self._decode_one, raws, seeds))
+        data = np.stack([r[0] for r in results])
+        label = np.stack([r[1] for r in results])
+        if self.label_width == 1:
+            label = label.reshape(bs)
+        return DataBatch([nd.array(data)], [nd.array(label)], pad=pad)
 
     def _producer_loop(self):
         pool = ThreadPoolExecutor(max_workers=self._threads,
@@ -320,6 +337,10 @@ class ImageRecordIter(DataIter):
         import os as _os
 
         if _os.environ.get("MXNET_TPU_NATIVE_IMAGE", "1") == "0":
+            return False
+        if self._round_batch and len(self._offsets) % self.batch_size:
+            # ragged dataset: the wrap-around pad batch (round_batch)
+            # is produced by the python chain only
             return False
         a = self._aug
         if (a.rotate >= 0 or a.max_rotate_angle > 0

@@ -3,13 +3,14 @@ python protobuf wire reader + blob->parameter mapping, verified against
 a hand-encoded NetParameter binary."""
 
 import os
-import struct
 import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
 from mxnet_tpu.caffe import (convert_model, load_caffemodel_params,
                              parse_caffemodel)
 
@@ -196,8 +197,9 @@ layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
 
 
 def test_truncated_caffemodel_rejected():
-    import pytest
-    from mxnet_tpu.base import MXNetError
     net, _ = _make_caffemodel()
     with pytest.raises(MXNetError):
         parse_caffemodel(net[:-20])
+    # truncation inside a varint (continuation bit set at EOF)
+    with pytest.raises(MXNetError):
+        parse_caffemodel(b"\x82\x86")

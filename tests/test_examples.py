@@ -262,3 +262,15 @@ def test_example_train_gpt_sharded_runs(capsys):
                   "--batch-size", "16", "--num-layers", "1",
                   "--trainer", "sharded"])
     assert "gpt final nll" in capsys.readouterr().out
+
+
+def test_example_rnn_time_major_runs():
+    _run_example("rnn_time_major.py",
+                 ["--num-epochs", "2", "--batch-size", "16",
+                  "--corpus-len", "8000"])
+
+
+def test_example_kaggle_ndsb_runs(tmp_path):
+    _run_example("kaggle_ndsb.py",
+                 ["--work-dir", str(tmp_path / "ndsb"),
+                  "--num-epochs", "3", "--per-class", "16"])

@@ -157,6 +157,13 @@ def conv_vh_decomposition(model, layer, K, data_shape=(1, 3, 224, 224)):
         kernel = ast.literal_eval(node["param"]["kernel"])
         pad = ast.literal_eval(node["param"].get("pad", "(0, 0)"))
         stride = ast.literal_eval(node["param"].get("stride", "(1, 1)"))
+        dilate = ast.literal_eval(node["param"].get("dilate", "(1, 1)"))
+        groups = int(node["param"].get("num_group", 1))
+        if tuple(dilate) != (1, 1) or groups != 1:
+            raise ValueError(
+                f"accnn: conv {layer!r} uses dilate={tuple(dilate)} / "
+                f"num_group={groups}; the v/h factorization only "
+                "supports plain dense convolutions")
         s1 = mx.symbol.Convolution(data, kernel=(kernel[0], 1),
                                    pad=(pad[0], 0), stride=(stride[0], 1),
                                    num_filter=K, name=name_v)
