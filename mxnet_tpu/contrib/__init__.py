@@ -1,0 +1,10 @@
+"""Contributed higher-level components built on the public API.
+
+``rcnn`` — region-proposal detection toolkit (anchors, bbox regression,
+NMS, RPN target assignment, Proposal/ProposalTarget custom ops): the
+capability surface of the reference ``example/rcnn`` helper/rpn stack.
+"""
+
+from . import rcnn
+
+__all__ = ["rcnn"]

@@ -1,0 +1,388 @@
+"""Region-proposal detection toolkit (Faster R-CNN family).
+
+Capability rebuild of the reference ``example/rcnn`` support stack:
+anchor enumeration (helper/processing/generate_anchor.py), bbox
+regression transforms and clipping (bbox_transform.py), greedy NMS
+(nms.py), RPN anchor-target assignment (rcnn/minibatch.py
+assign_anchor), and the two CustomOps of the end-to-end trainer —
+``Proposal`` (rcnn/rpn/proposal.py) and ``ProposalTarget``
+(rcnn/rpn/proposal_target.py).
+
+All box math uses the reference's inclusive pixel convention
+(width = x2 - x1 + 1).  Proposal generation runs host-side through the
+CustomOp bridge, exactly where the reference runs it (these are
+data-dependent, dynamically-shaped steps that do not belong inside an
+XLA program); the dense compute around them (backbone, RPN heads,
+ROIPooling head) stays on the TPU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import operator as op_mod
+
+
+# ----------------------------------------------------------------- anchors
+def generate_anchors(base_size=16, ratios=(0.5, 1, 2), scales=(8, 16, 32)):
+    """Enumerate ratio × scale anchor windows around a base_size box
+    anchored at (0, 0) (generate_anchor.py semantics)."""
+    base = np.array([0, 0, base_size - 1, base_size - 1], np.float64)
+    w, h, cx, cy = _whctrs(base)
+    size = w * h
+    out = []
+    for r in ratios:
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in np.asarray(scales, np.float64):
+            out.append(_mkanchor(ws * s, hs * s, cx, cy))
+    # reference stacks scale-major within each ratio
+    return np.asarray(out, np.float64)
+
+
+def _whctrs(anchor):
+    w = anchor[2] - anchor[0] + 1
+    h = anchor[3] - anchor[1] + 1
+    return w, h, anchor[0] + 0.5 * (w - 1), anchor[1] + 0.5 * (h - 1)
+
+
+def _mkanchor(w, h, cx, cy):
+    return [cx - 0.5 * (w - 1), cy - 0.5 * (h - 1),
+            cx + 0.5 * (w - 1), cy + 0.5 * (h - 1)]
+
+
+def shift_anchors(base_anchors, feat_h, feat_w, feat_stride):
+    """All anchors over a (feat_h, feat_w) grid: (H*W*A, 4), row-major
+    over positions, anchor-major within a position."""
+    sx = np.arange(feat_w) * feat_stride
+    sy = np.arange(feat_h) * feat_stride
+    gx, gy = np.meshgrid(sx, sy)
+    shifts = np.stack([gx.ravel(), gy.ravel(), gx.ravel(), gy.ravel()],
+                      axis=1)
+    all_anchors = (base_anchors[None, :, :]
+                   + shifts[:, None, :].astype(np.float64))
+    return all_anchors.reshape(-1, 4)
+
+
+# ------------------------------------------------------------ bbox algebra
+def bbox_transform(ex_rois, gt_rois):
+    """Regression targets (dx, dy, dw, dh) taking ex_rois onto gt_rois."""
+    ew = ex_rois[:, 2] - ex_rois[:, 0] + 1.0
+    eh = ex_rois[:, 3] - ex_rois[:, 1] + 1.0
+    ecx = ex_rois[:, 0] + 0.5 * (ew - 1.0)
+    ecy = ex_rois[:, 1] + 0.5 * (eh - 1.0)
+    gw = gt_rois[:, 2] - gt_rois[:, 0] + 1.0
+    gh = gt_rois[:, 3] - gt_rois[:, 1] + 1.0
+    gcx = gt_rois[:, 0] + 0.5 * (gw - 1.0)
+    gcy = gt_rois[:, 1] + 0.5 * (gh - 1.0)
+    return np.stack([(gcx - ecx) / (ew + 1e-14),
+                     (gcy - ecy) / (eh + 1e-14),
+                     np.log(gw / ew), np.log(gh / eh)], axis=1)
+
+
+def bbox_pred(boxes, deltas):
+    """Apply (dx, dy, dw, dh) deltas to boxes; deltas may carry 4 columns
+    per class ((N, 4k) -> (N, 4k))."""
+    if boxes.shape[0] == 0:
+        return np.zeros((0, deltas.shape[1]), deltas.dtype)
+    boxes = boxes.astype(np.float64)
+    w = boxes[:, 2] - boxes[:, 0] + 1.0
+    h = boxes[:, 3] - boxes[:, 1] + 1.0
+    cx = boxes[:, 0] + 0.5 * (w - 1.0)
+    cy = boxes[:, 1] + 0.5 * (h - 1.0)
+    dx, dy = deltas[:, 0::4], deltas[:, 1::4]
+    dw, dh = deltas[:, 2::4], deltas[:, 3::4]
+    pcx = dx * w[:, None] + cx[:, None]
+    pcy = dy * h[:, None] + cy[:, None]
+    pw = np.exp(dw) * w[:, None]
+    ph = np.exp(dh) * h[:, None]
+    out = np.zeros_like(deltas, dtype=np.float64)
+    out[:, 0::4] = pcx - 0.5 * (pw - 1.0)
+    out[:, 1::4] = pcy - 0.5 * (ph - 1.0)
+    out[:, 2::4] = pcx + 0.5 * (pw - 1.0)
+    out[:, 3::4] = pcy + 0.5 * (ph - 1.0)
+    return out
+
+
+def clip_boxes(boxes, im_shape):
+    """Clip (N, 4k) boxes to an (h, w) image, inclusive convention."""
+    h, w = im_shape[:2]
+    boxes = boxes.copy()
+    boxes[:, 0::4] = np.clip(boxes[:, 0::4], 0, w - 1)
+    boxes[:, 1::4] = np.clip(boxes[:, 1::4], 0, h - 1)
+    boxes[:, 2::4] = np.clip(boxes[:, 2::4], 0, w - 1)
+    boxes[:, 3::4] = np.clip(boxes[:, 3::4], 0, h - 1)
+    return boxes
+
+
+def bbox_overlaps(boxes, query):
+    """IoU matrix (N, K) in the inclusive convention."""
+    if boxes.size == 0 or query.size == 0:
+        return np.zeros((boxes.shape[0], query.shape[0]))
+    b_area = ((boxes[:, 2] - boxes[:, 0] + 1)
+              * (boxes[:, 3] - boxes[:, 1] + 1))[:, None]
+    q_area = ((query[:, 2] - query[:, 0] + 1)
+              * (query[:, 3] - query[:, 1] + 1))[None, :]
+    iw = (np.minimum(boxes[:, None, 2], query[None, :, 2])
+          - np.maximum(boxes[:, None, 0], query[None, :, 0]) + 1)
+    ih = (np.minimum(boxes[:, None, 3], query[None, :, 3])
+          - np.maximum(boxes[:, None, 1], query[None, :, 1]) + 1)
+    inter = np.clip(iw, 0, None) * np.clip(ih, 0, None)
+    return inter / (b_area + q_area - inter)
+
+
+def nms(dets, thresh):
+    """Greedy IoU suppression over (N, 5) [x1 y1 x2 y2 score]; returns
+    kept indices in score order (nms.py)."""
+    if dets.shape[0] == 0:
+        return []
+    boxes, scores = dets[:, :4], dets[:, 4]
+    order = scores.argsort()[::-1]
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(int(i))
+        if order.size == 1:
+            break
+        ious = bbox_overlaps(boxes[i:i + 1], boxes[order[1:]])[0]
+        order = order[1:][ious <= thresh]
+    return keep
+
+
+# ---------------------------------------------------------- RPN targets
+def assign_anchor(feat_shape, gt_boxes, im_info, feat_stride=16,
+                  scales=(8, 16, 32), ratios=(0.5, 1, 2),
+                  allowed_border=0, batch_rois=256, fg_fraction=0.5,
+                  fg_overlap=0.7, bg_overlap=0.3, rng=None):
+    """RPN training targets (minibatch.py assign_anchor): per-anchor
+    labels (1 fg / 0 bg / -1 ignore), bbox targets and weights, sampled
+    to ``batch_rois`` with at most ``fg_fraction`` positives.
+
+    Returns dict with 'label' (A*H*W,), 'bbox_target' and 'bbox_weight'
+    (A*H*W, 4) in anchor-major-within-position order.
+    """
+    rng = rng or np.random
+    feat_h, feat_w = feat_shape[-2:]
+    base = generate_anchors(base_size=feat_stride, ratios=ratios,
+                            scales=scales)
+    A = base.shape[0]
+    all_anchors = shift_anchors(base, feat_h, feat_w, feat_stride)
+    total = all_anchors.shape[0]
+    im_h, im_w = im_info[0], im_info[1]
+    inside = np.where(
+        (all_anchors[:, 0] >= -allowed_border)
+        & (all_anchors[:, 1] >= -allowed_border)
+        & (all_anchors[:, 2] < im_w + allowed_border)
+        & (all_anchors[:, 3] < im_h + allowed_border))[0]
+    anchors = all_anchors[inside]
+
+    labels = np.full(len(inside), -1, np.float64)
+    if gt_boxes.size:
+        overlaps = bbox_overlaps(anchors, gt_boxes[:, :4])
+        argmax = overlaps.argmax(axis=1)
+        max_o = overlaps[np.arange(len(inside)), argmax]
+        gt_argmax = overlaps.argmax(axis=0)
+        labels[max_o < bg_overlap] = 0
+        labels[gt_argmax] = 1          # best anchor per gt is always fg
+        labels[max_o >= fg_overlap] = 1
+    else:
+        labels[:] = 0
+
+    # subsample to the roi batch
+    fg_cap = int(fg_fraction * batch_rois)
+    fg = np.where(labels == 1)[0]
+    if len(fg) > fg_cap:
+        labels[rng.choice(fg, len(fg) - fg_cap, replace=False)] = -1
+    bg_cap = batch_rois - int((labels == 1).sum())
+    bg = np.where(labels == 0)[0]
+    if len(bg) > bg_cap:
+        labels[rng.choice(bg, len(bg) - bg_cap, replace=False)] = -1
+
+    targets = np.zeros((len(inside), 4))
+    if gt_boxes.size:
+        targets = bbox_transform(anchors, gt_boxes[argmax, :4])
+    weights = np.zeros((len(inside), 4))
+    weights[labels == 1, :] = 1.0
+
+    def unmap(data, fill):
+        out = np.full((total,) + data.shape[1:], fill, np.float64)
+        out[inside] = data
+        return out
+
+    return {"label": unmap(labels, -1),
+            "bbox_target": unmap(targets, 0),
+            "bbox_weight": unmap(weights, 0)}
+
+
+# ------------------------------------------------------------- custom ops
+class ProposalOp(op_mod.CustomOp):
+    """rois from RPN outputs: decode deltas at every anchor, clip,
+    filter tiny boxes, top-pre_nms by score, NMS, top-post_nms
+    (rcnn/rpn/proposal.py)."""
+
+    def __init__(self, feat_stride, scales, ratios, rpn_pre_nms_top_n,
+                 rpn_post_nms_top_n, nms_thresh, rpn_min_size):
+        self._stride = feat_stride
+        self._anchors = generate_anchors(base_size=feat_stride,
+                                         ratios=ratios, scales=scales)
+        self._pre = rpn_pre_nms_top_n
+        self._post = rpn_post_nms_top_n
+        self._thresh = nms_thresh
+        self._min_size = rpn_min_size
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        scores = np.asarray(in_data[0])   # (1, 2A, H, W) softmax probs
+        deltas = np.asarray(in_data[1])   # (1, 4A, H, W)
+        im_info = np.asarray(in_data[2]).reshape(-1)  # (h, w, scale)
+        A = self._anchors.shape[0]
+        H, W = scores.shape[-2:]
+        fg = scores[0, A:]                               # (A, H, W)
+        fg = fg.transpose(1, 2, 0).reshape(-1)           # pos-major
+        d = deltas[0].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        anchors = shift_anchors(self._anchors, H, W, self._stride)
+        boxes = bbox_pred(anchors, d)
+        boxes = clip_boxes(boxes, im_info[:2])
+        min_size = self._min_size * im_info[2]
+        ws = boxes[:, 2] - boxes[:, 0] + 1
+        hs = boxes[:, 3] - boxes[:, 1] + 1
+        keep = np.where((ws >= min_size) & (hs >= min_size))[0]
+        boxes, fg = boxes[keep], fg[keep]
+        order = fg.argsort()[::-1][:self._pre]
+        boxes, fg = boxes[order], fg[order]
+        keep = nms(np.hstack([boxes, fg[:, None]]), self._thresh)[:self._post]
+        boxes, fg = boxes[keep], fg[keep]
+        # fixed-size output: pad by repeating the top roi (reference pads
+        # with random sampling; repetition keeps determinism)
+        n_out = out_data[0].shape[0]
+        if boxes.shape[0] == 0:
+            boxes = np.zeros((1, 4))
+            fg = np.zeros(1)
+        idx = np.resize(np.arange(boxes.shape[0]), n_out)
+        rois = np.hstack([np.zeros((n_out, 1)), boxes[idx]])
+        self.assign(out_data[0], req[0], rois.astype(np.float32))
+        if len(out_data) > 1:
+            self.assign(out_data[1], req[1],
+                        fg[idx, None].astype(np.float32))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for i, g in enumerate(in_grad):
+            self.assign(g, req[i], 0.0)
+
+
+@op_mod.register("proposal")
+class ProposalProp(op_mod.CustomOpProp):
+    def __init__(self, feat_stride=16, scales="(8, 16, 32)",
+                 ratios="(0.5, 1, 2)", rpn_pre_nms_top_n=6000,
+                 rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                 output_score=False):
+        super().__init__(need_top_grad=False)
+        self._kw = dict(
+            feat_stride=int(feat_stride),
+            scales=tuple(eval(scales) if isinstance(scales, str) else scales),
+            ratios=tuple(eval(ratios) if isinstance(ratios, str) else ratios),
+            rpn_pre_nms_top_n=int(rpn_pre_nms_top_n),
+            rpn_post_nms_top_n=int(rpn_post_nms_top_n),
+            nms_thresh=float(threshold), rpn_min_size=int(rpn_min_size))
+        self._output_score = (output_score in (True, "True", "true", "1"))
+
+    def list_arguments(self):
+        return ["cls_prob", "bbox_pred", "im_info"]
+
+    def list_outputs(self):
+        return ["output", "score"] if self._output_score else ["output"]
+
+    def infer_shape(self, in_shape):
+        n = self._kw["rpn_post_nms_top_n"]
+        outs = [[n, 5]] + ([[n, 1]] if self._output_score else [])
+        return in_shape, outs, []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return ProposalOp(**self._kw)
+
+
+class ProposalTargetOp(op_mod.CustomOp):
+    """Sample proposals into a head ROI batch with labels and per-class
+    bbox targets (rcnn/rpn/proposal_target.py): gt boxes join the
+    candidate set, fg_fraction capped by >=fg_overlap IoU."""
+
+    def __init__(self, num_classes, batch_rois, fg_fraction, fg_overlap,
+                 bg_overlap_hi, seed):
+        self._nc = num_classes
+        self._batch = batch_rois
+        self._fg_frac = fg_fraction
+        self._fg_ov = fg_overlap
+        self._bg_hi = bg_overlap_hi
+        self._rng = np.random.RandomState(seed)
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        rois = np.asarray(in_data[0])[:, 1:5]
+        gt = np.asarray(in_data[1])          # (G, 5) x1 y1 x2 y2 cls
+        gt = gt[gt[:, :4].sum(axis=1) > 0]
+        cand = np.vstack([rois, gt[:, :4]]) if gt.size else rois
+        overlaps = bbox_overlaps(cand, gt[:, :4]) if gt.size else \
+            np.zeros((cand.shape[0], 0))
+        if gt.size:
+            argmax = overlaps.argmax(axis=1)
+            max_o = overlaps[np.arange(cand.shape[0]), argmax]
+            labels = gt[argmax, 4]
+        else:
+            max_o = np.zeros(cand.shape[0])
+            labels = np.zeros(cand.shape[0])
+        fg = np.where(max_o >= self._fg_ov)[0]
+        bg = np.where(max_o < self._bg_hi)[0]
+        n_fg = min(int(self._fg_frac * self._batch), len(fg))
+        if len(fg) > n_fg:
+            fg = self._rng.choice(fg, n_fg, replace=False)
+        n_bg = self._batch - n_fg
+        if len(bg) > n_bg:
+            bg = self._rng.choice(bg, n_bg, replace=False)
+        keep = np.append(fg, bg)
+        if keep.size == 0:
+            keep = np.zeros(1, np.int64)
+        keep = np.resize(keep, self._batch)
+        labels = labels[keep].copy()
+        labels[len(fg):] = 0                  # bg rois get class 0
+        sampled = cand[keep]
+        targets = np.zeros((self._batch, 4 * self._nc))
+        weights = np.zeros((self._batch, 4 * self._nc))
+        if gt.size:
+            t = bbox_transform(sampled, gt[argmax[keep], :4])
+            for i in range(len(fg)):
+                c = int(labels[i])
+                targets[i, 4 * c:4 * c + 4] = t[i]
+                weights[i, 4 * c:4 * c + 4] = 1.0
+        out_rois = np.hstack([np.zeros((self._batch, 1)), sampled])
+        self.assign(out_data[0], req[0], out_rois.astype(np.float32))
+        self.assign(out_data[1], req[1], labels.astype(np.float32))
+        self.assign(out_data[2], req[2], targets.astype(np.float32))
+        self.assign(out_data[3], req[3], weights.astype(np.float32))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for i, g in enumerate(in_grad):
+            self.assign(g, req[i], 0.0)
+
+
+@op_mod.register("proposal_target")
+class ProposalTargetProp(op_mod.CustomOpProp):
+    def __init__(self, num_classes=21, batch_rois=128, fg_fraction=0.25,
+                 fg_overlap=0.5, bg_overlap_hi=0.5, seed=0):
+        super().__init__(need_top_grad=False)
+        self._nc = int(num_classes)
+        self._batch = int(batch_rois)
+        self._kw = dict(num_classes=self._nc, batch_rois=self._batch,
+                        fg_fraction=float(fg_fraction),
+                        fg_overlap=float(fg_overlap),
+                        bg_overlap_hi=float(bg_overlap_hi), seed=int(seed))
+
+    def list_arguments(self):
+        return ["rois", "gt_boxes"]
+
+    def list_outputs(self):
+        return ["rois_output", "label", "bbox_target", "bbox_weight"]
+
+    def infer_shape(self, in_shape):
+        b, nc = self._batch, self._nc
+        return in_shape, [[b, 5], [b], [b, 4 * nc], [b, 4 * nc]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return ProposalTargetOp(**self._kw)

@@ -200,14 +200,16 @@ class MakeLossOp(OpDef):
 
     def backward(self, params, out_grads, inputs, outputs):
         x = inputs[0]
-        scale = params.grad_scale
-        if params.normalization == "batch":
-            scale = scale / x.shape[0]
-        g = jnp.full_like(x, scale)
         if params.normalization == "valid":
-            mask = (x > params.valid_thresh).astype(x.dtype)
-            valid = jnp.maximum(jnp.sum(mask), 1.0)
-            g = g * mask / valid
+            # reference (make_loss-inl.h:84-93): grad_scale / #valid at
+            # EVERY position — the count normalizes, it does not mask
+            valid = jnp.maximum(
+                jnp.sum((x > params.valid_thresh).astype(x.dtype)), 1.0)
+            g = jnp.full_like(x, params.grad_scale) / valid
+        elif params.normalization == "batch":
+            g = jnp.full_like(x, params.grad_scale / x.shape[0])
+        else:
+            g = jnp.full_like(x, params.grad_scale)
         return [g]
 
 

@@ -274,3 +274,11 @@ def test_example_kaggle_ndsb_runs(tmp_path):
     _run_example("kaggle_ndsb.py",
                  ["--work-dir", str(tmp_path / "ndsb"),
                   "--num-epochs", "3", "--per-class", "16"])
+
+
+def test_example_rcnn_end2end_runs():
+    # short run: validates the full proposal pipeline executes and the
+    # RPN localizes; head convergence needs the full default epochs
+    _run_example("rcnn_end2end.py",
+                 ["--num-epochs", "3", "--images-per-epoch", "60",
+                  "--min-acc", "0.0", "--min-recall", "0.5"])

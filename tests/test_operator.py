@@ -1015,3 +1015,27 @@ def test_upsampling_non_divisible_rejected():
                            scale=4, sample_type="nearest", num_args=2)
     with pytest.raises(Exception):
         up.infer_shape(a=(1, 2, 4, 4), b=(1, 2, 3, 3))
+
+
+def test_make_loss_normalization_modes():
+    data = mx.sym.Variable("data")
+    x = np.array([[0.5, -0.2], [0.3, 0.0]], np.float32)
+
+    def grad_of(**kw):
+        ml = mx.sym.MakeLoss(data, **kw)
+        exe = ml.simple_bind(mx.cpu(), data=x.shape)
+        exe.arg_dict["data"][:] = x
+        exe.forward(is_train=True)
+        exe.backward()
+        return exe.grad_dict["data"].asnumpy()
+
+    np.testing.assert_allclose(grad_of(grad_scale=2.0),
+                               np.full_like(x, 2.0))
+    np.testing.assert_allclose(grad_of(grad_scale=2.0,
+                                       normalization="batch"),
+                               np.full_like(x, 1.0))
+    # valid: grad_scale / #(x > thresh) at EVERY position, no masking
+    # (make_loss-inl.h:84-93); here 2 elements exceed 0.1
+    np.testing.assert_allclose(
+        grad_of(grad_scale=3.0, normalization="valid", valid_thresh=0.1),
+        np.full_like(x, 1.5))

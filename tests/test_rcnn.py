@@ -1,0 +1,158 @@
+"""Region-proposal toolkit (mxnet_tpu/contrib/rcnn.py — capability
+rebuild of example/rcnn's helper/processing + rpn stack)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import rcnn
+
+rng = np.random.RandomState(11)
+
+
+def test_generate_anchors_geometry():
+    a = rcnn.generate_anchors(base_size=16, ratios=(0.5, 1, 2),
+                              scales=(8, 16, 32))
+    assert a.shape == (9, 4)
+    # all anchors centered on the base box center (7.5, 7.5)
+    cx = (a[:, 0] + a[:, 2]) / 2
+    cy = (a[:, 1] + a[:, 3]) / 2
+    np.testing.assert_allclose(cx, 7.5)
+    np.testing.assert_allclose(cy, 7.5)
+    # areas scale ~ scale^2, aspect ratios follow the ratio list
+    w = a[:, 2] - a[:, 0] + 1
+    h = a[:, 3] - a[:, 1] + 1
+    ratios = h / w
+    for i, r in enumerate((0.5, 1, 2)):
+        np.testing.assert_allclose(ratios[3 * i:3 * i + 3], r, rtol=0.1)
+        np.testing.assert_allclose(
+            (w * h)[3 * i:3 * i + 3] / (16 * 16 * np.array([64, 256, 1024])),
+            1.0, rtol=0.15)
+
+
+def test_bbox_transform_pred_roundtrip():
+    ex = np.abs(rng.rand(12, 4)) * 40
+    ex[:, 2:] = ex[:, :2] + 10 + ex[:, 2:]
+    gt = np.abs(rng.rand(12, 4)) * 40
+    gt[:, 2:] = gt[:, :2] + 8 + gt[:, 2:]
+    deltas = rcnn.bbox_transform(ex, gt)
+    back = rcnn.bbox_pred(ex, deltas)
+    np.testing.assert_allclose(back, gt, rtol=1e-5, atol=1e-4)
+
+
+def test_clip_boxes_and_overlaps():
+    boxes = np.array([[-5.0, -5, 30, 30], [10, 10, 200, 90]])
+    clipped = rcnn.clip_boxes(boxes, (100, 80))
+    assert clipped.min() >= 0
+    assert clipped[:, 0::4].max() <= 79 and clipped[:, 2::4].max() <= 79
+    assert clipped[:, 1::4].max() <= 99 and clipped[:, 3::4].max() <= 99
+    iou = rcnn.bbox_overlaps(np.array([[0.0, 0, 9, 9]]),
+                             np.array([[0.0, 0, 9, 9], [5, 5, 14, 14],
+                                       [20, 20, 29, 29]]))
+    np.testing.assert_allclose(iou[0, 0], 1.0)
+    np.testing.assert_allclose(iou[0, 1], 25 / 175, rtol=1e-6)
+    np.testing.assert_allclose(iou[0, 2], 0.0)
+
+
+def test_nms_suppresses_overlaps():
+    dets = np.array([
+        [0, 0, 10, 10, 0.9],
+        [1, 1, 11, 11, 0.8],     # heavy overlap with first -> suppressed
+        [50, 50, 60, 60, 0.7],
+        [0, 0, 10, 10, 0.95],    # best scoring duplicate kept first
+    ])
+    keep = rcnn.nms(dets, 0.5)
+    assert keep[0] == 3
+    assert 2 in keep and 1 not in keep and 0 not in keep
+
+
+def test_assign_anchor_labels_and_targets():
+    gt = np.array([[20.0, 20, 60, 60]])
+    out = rcnn.assign_anchor((1, 18, 8, 8), gt, im_info=(128, 128, 1.0),
+                             feat_stride=16, scales=(2, 4), ratios=(1.0,),
+                             batch_rois=32, rng=np.random.RandomState(0))
+    A = 2
+    assert out["label"].shape == (8 * 8 * A,)
+    assert out["bbox_target"].shape == (8 * 8 * A, 4)
+    fg = np.where(out["label"] == 1)[0]
+    assert len(fg) >= 1
+    # fg anchors regress toward the gt box
+    base = rcnn.generate_anchors(base_size=16, ratios=(1.0,), scales=(2, 4))
+    anchors = rcnn.shift_anchors(base, 8, 8, 16)
+    pred = rcnn.bbox_pred(anchors[fg], out["bbox_target"][fg])
+    iou = rcnn.bbox_overlaps(pred, gt)
+    assert iou.max() > 0.99
+    # weights nonzero only at fg
+    assert (out["bbox_weight"][fg] == 1).all()
+    assert out["bbox_weight"][out["label"] != 1].sum() == 0
+
+
+def _rpn_inputs(gt, H=8, W=8, stride=16, scales=(2, 4), ratios=(1.0,)):
+    """Perfect RPN outputs for the given gt: high score + exact deltas at
+    each anchor's best-gt match."""
+    base = rcnn.generate_anchors(base_size=stride, ratios=ratios,
+                                 scales=scales)
+    A = base.shape[0]
+    anchors = rcnn.shift_anchors(base, H, W, stride)
+    iou = rcnn.bbox_overlaps(anchors, gt)
+    best = iou.max(axis=1)
+    argb = iou.argmax(axis=1)
+    scores = np.zeros((1, 2 * A, H, W), np.float32)
+    deltas = np.zeros((1, 4 * A, H, W), np.float32)
+    t = rcnn.bbox_transform(anchors, gt[argb])
+    fg = best.reshape(H, W, A)
+    scores[0, A:] = fg.transpose(2, 0, 1)
+    scores[0, :A] = 1 - fg.transpose(2, 0, 1)
+    d = t.reshape(H, W, A, 4).transpose(2, 3, 0, 1)  # (A,4,H,W)
+    deltas[0] = d.reshape(4 * A, H, W)
+    return scores, deltas
+
+
+def test_proposal_custom_op_recovers_gt():
+    gt = np.array([[20.0, 20, 60, 60], [70, 70, 110, 100]])
+    scores, deltas = _rpn_inputs(gt)
+    cls = mx.sym.Variable("cls_prob")
+    bbox = mx.sym.Variable("bbox_pred")
+    info = mx.sym.Variable("im_info")
+    prop = mx.sym.Custom(cls, bbox, info, op_type="proposal",
+                         feat_stride=16, scales="(2, 4)", ratios="(1.0,)",
+                         rpn_pre_nms_top_n=200, rpn_post_nms_top_n=8,
+                         threshold=0.5, rpn_min_size=4)
+    exe = prop.simple_bind(mx.cpu(), grad_req="null",
+                           cls_prob=scores.shape, bbox_pred=deltas.shape,
+                           im_info=(1, 3))
+    exe.arg_dict["cls_prob"][:] = scores
+    exe.arg_dict["bbox_pred"][:] = deltas
+    exe.arg_dict["im_info"][:] = np.array([[128, 128, 1.0]], np.float32)
+    rois = exe.forward(is_train=False)[0].asnumpy()
+    assert rois.shape == (8, 5)
+    iou = rcnn.bbox_overlaps(rois[:, 1:].astype(np.float64), gt)
+    # each gt recovered by some proposal
+    assert (iou.max(axis=0) > 0.9).all()
+
+
+def test_proposal_target_sampling():
+    rois = np.hstack([np.zeros((20, 1)),
+                      rng.rand(20, 4) * 30]).astype(np.float32)
+    rois[:, 3:] = rois[:, 1:3] + 20 + rois[:, 3:]
+    gt = np.array([[10.0, 10, 40, 40, 2]], np.float32)
+    r = mx.sym.Variable("rois")
+    g = mx.sym.Variable("gt_boxes")
+    pt = mx.sym.Custom(r, g, op_type="proposal_target", num_classes=3,
+                       batch_rois=16, fg_fraction=0.25, fg_overlap=0.5)
+    exe = pt.simple_bind(mx.cpu(), grad_req="null", rois=rois.shape,
+                         gt_boxes=gt.shape)
+    exe.arg_dict["rois"][:] = rois
+    exe.arg_dict["gt_boxes"][:] = gt
+    outs = [o.asnumpy() for o in exe.forward(is_train=True)]
+    out_rois, labels, targets, weights = outs
+    assert out_rois.shape == (16, 5)
+    assert labels.shape == (16,)
+    assert targets.shape == (16, 12) and weights.shape == (16, 12)
+    fg = labels > 0
+    # gt itself joins the candidates, so at least one fg roi exists
+    assert fg.sum() >= 1
+    assert set(np.unique(labels[fg])) == {2.0}
+    # bbox targets live in the class-2 column block for fg rois
+    assert (weights[fg][:, 8:12] == 1).all()
+    assert weights[~fg].sum() == 0
