@@ -120,7 +120,8 @@ def build_symbol(im_hw, post_nms):
 
     return mx.sym.Group([rpn_cls_prob, rpn_bbox_loss, cls_prob, bbox_loss,
                          mx.sym.BlockGrad(label),
-                         mx.sym.BlockGrad(sampled_rois)])
+                         mx.sym.BlockGrad(sampled_rois),
+                         mx.sym.BlockGrad(rois)])
 
 
 def make_image(rng, hw):
@@ -211,7 +212,6 @@ def main():
     mod.init_optimizer(optimizer="sgd",
                        optimizer_params={"learning_rate": args.lr,
                                          "momentum": 0.9})
-    metric = mx.metric.create("loss")
     for epoch in range(args.num_epochs):
         it.reset()
         for nbatch, batch in enumerate(it):
@@ -226,9 +226,10 @@ def main():
     for batch in eval_it:
         mod.forward(batch, is_train=True)
         outs = [o.asnumpy() for o in mod.get_outputs()]
-        cls_prob, label, rois = outs[2], outs[4], outs[5]
-        gt = batch.data[2].asnumpy()[:, :4]
-        iou = rcnn.bbox_overlaps(rois[:, 1:].astype(np.float64), gt)
+        cls_prob, label = outs[2], outs[4]
+        proposals = outs[6]          # raw proposal-op rois, pre-sampling:
+        gt = batch.data[2].asnumpy()[:, :4]   # gt never joins this set
+        iou = rcnn.bbox_overlaps(proposals[:, 1:].astype(np.float64), gt)
         recalls.append(iou.max())
         fg = label > 0
         if fg.any():

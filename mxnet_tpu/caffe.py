@@ -540,3 +540,19 @@ def convert_model(prototxt_text: str, caffemodel: bytes,
     aux_params = {k: nd.array(v) for k, v in raw_aux.items()
                   if k in aux_names}
     return symbol, arg_params, aux_params
+
+
+def load_mean_binaryproto(data: bytes):
+    """Decode a Caffe mean-image ``.binaryproto`` (a bare BlobProto)
+    into a float32 (c, h, w) array (tools/caffe_converter/mean_image.py).
+    Feed the result to ``ImageRecordIter(mean_img=...)`` via
+    ``mx.nd.save`` or subtract it manually."""
+    arr = _blob_to_array(data)
+    arr = _np.asarray(arr, _np.float32)
+    if arr.ndim == 4:  # legacy (1, c, h, w)
+        arr = arr.reshape(arr.shape[-3:])
+    if arr.ndim != 3:
+        raise MXNetError(
+            f"mean binaryproto decoded to shape {arr.shape}; expected "
+            "(c, h, w)")
+    return arr

@@ -177,6 +177,12 @@ def assign_anchor(feat_shape, gt_boxes, im_info, feat_stride=16,
     anchors = all_anchors[inside]
 
     labels = np.full(len(inside), -1, np.float64)
+    if len(inside) == 0:
+        # no anchor fits the image (anchors larger than the image):
+        # everything is ignored rather than crashing downstream argmax
+        return {"label": np.full(total, -1, np.float64),
+                "bbox_target": np.zeros((total, 4)),
+                "bbox_weight": np.zeros((total, 4))}
     if gt_boxes.size:
         overlaps = bbox_overlaps(anchors, gt_boxes[:, :4])
         argmax = overlaps.argmax(axis=1)
@@ -276,10 +282,15 @@ class ProposalProp(op_mod.CustomOpProp):
                  rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
                  output_score=False):
         super().__init__(need_top_grad=False)
+        import ast
+
+        def _tup(v):
+            return tuple(ast.literal_eval(v) if isinstance(v, str) else v)
+
         self._kw = dict(
             feat_stride=int(feat_stride),
-            scales=tuple(eval(scales) if isinstance(scales, str) else scales),
-            ratios=tuple(eval(ratios) if isinstance(ratios, str) else ratios),
+            scales=_tup(scales),
+            ratios=_tup(ratios),
             rpn_pre_nms_top_n=int(rpn_pre_nms_top_n),
             rpn_post_nms_top_n=int(rpn_post_nms_top_n),
             nms_thresh=float(threshold), rpn_min_size=int(rpn_min_size))
@@ -336,18 +347,34 @@ class ProposalTargetOp(op_mod.CustomOp):
         n_bg = self._batch - n_fg
         if len(bg) > n_bg:
             bg = self._rng.choice(bg, n_bg, replace=False)
-        keep = np.append(fg, bg)
-        if keep.size == 0:
-            keep = np.zeros(1, np.int64)
-        keep = np.resize(keep, self._batch)
+        sel = np.append(fg, bg).astype(np.int64)
+        n_pad = self._batch - sel.size
+        pad_is_fg = False
+        if n_pad > 0:
+            # pad from the bg pool so repeated rois never carry
+            # contradictory labels; fall back to fg (keeping their true
+            # class) only when there is no bg at all
+            if len(bg):
+                pad_src = np.asarray(bg, np.int64)
+            elif len(fg):
+                pad_src = np.asarray(fg, np.int64)
+                pad_is_fg = True
+            else:
+                pad_src = np.zeros(1, np.int64)
+            sel = np.append(sel, np.resize(pad_src, n_pad))
+        keep = sel[:self._batch]
+        fg_mask = np.zeros(self._batch, bool)
+        fg_mask[:len(fg)] = True
+        if pad_is_fg:
+            fg_mask[len(fg) + len(bg):] = True
         labels = labels[keep].copy()
-        labels[len(fg):] = 0                  # bg rois get class 0
+        labels[~fg_mask] = 0
         sampled = cand[keep]
         targets = np.zeros((self._batch, 4 * self._nc))
         weights = np.zeros((self._batch, 4 * self._nc))
         if gt.size:
             t = bbox_transform(sampled, gt[argmax[keep], :4])
-            for i in range(len(fg)):
+            for i in np.where(fg_mask)[0]:
                 c = int(labels[i])
                 targets[i, 4 * c:4 * c + 4] = t[i]
                 weights[i, 4 * c:4 * c + 4] = 1.0

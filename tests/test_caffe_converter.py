@@ -203,3 +203,14 @@ def test_truncated_caffemodel_rejected():
     # truncation inside a varint (continuation bit set at EOF)
     with pytest.raises(MXNetError):
         parse_caffemodel(b"\x82\x86")
+
+
+def test_load_mean_binaryproto():
+    from mxnet_tpu.caffe import load_mean_binaryproto
+    mean = rng.rand(3, 6, 5).astype(np.float32)
+    blob = _blob(mean, legacy4d=True)  # (1, 3, 6, 5) legacy shape
+    out = load_mean_binaryproto(blob)
+    assert out.shape == (3, 6, 5)
+    np.testing.assert_allclose(out, mean, rtol=1e-6)
+    blob2 = _blob(mean)                # BlobShape form
+    np.testing.assert_allclose(load_mean_binaryproto(blob2), mean)

@@ -156,3 +156,38 @@ def test_proposal_target_sampling():
     # bbox targets live in the class-2 column block for fg rois
     assert (weights[fg][:, 8:12] == 1).all()
     assert weights[~fg].sum() == 0
+
+
+def test_assign_anchor_no_inside_anchors():
+    # anchors larger than the image: all-ignore targets, no crash
+    out = rcnn.assign_anchor((1, 18, 2, 2), np.array([[1.0, 1, 10, 10]]),
+                             im_info=(16, 16, 1.0), feat_stride=16,
+                             scales=(8, 16, 32), ratios=(0.5, 1, 2))
+    assert (out["label"] == -1).all()
+    assert out["bbox_weight"].sum() == 0
+
+
+def test_proposal_target_pad_labels_consistent():
+    # fewer candidates than batch_rois: padded repeats must never carry
+    # a different label than the original entry
+    rois = np.array([[0, 10.0, 10, 40, 40],   # IoU 1.0 with gt -> fg
+                     [0, 60, 60, 90, 90]],    # no overlap -> bg
+                    np.float32)
+    gt = np.array([[10.0, 10, 40, 40, 1]], np.float32)
+    r = mx.sym.Variable("rois")
+    g = mx.sym.Variable("gt_boxes")
+    pt = mx.sym.Custom(r, g, op_type="proposal_target", num_classes=2,
+                       batch_rois=12, fg_fraction=0.5, fg_overlap=0.5)
+    exe = pt.simple_bind(mx.cpu(), grad_req="null", rois=rois.shape,
+                         gt_boxes=gt.shape)
+    exe.arg_dict["rois"][:] = rois
+    exe.arg_dict["gt_boxes"][:] = gt
+    out_rois, labels, _, _ = [o.asnumpy() for o in exe.forward(is_train=True)]
+    # every (roi, label) pair must be self-consistent: identical rois
+    # agree on their label
+    seen = {}
+    for roi, lab in zip(map(tuple, out_rois.round(3).tolist()),
+                        labels.tolist()):
+        assert seen.setdefault(roi, lab) == lab, (roi, seen[roi], lab)
+    # the gt-overlapping roi stays foreground somewhere in the batch
+    assert (labels > 0).any()
