@@ -343,9 +343,10 @@ class CSVIter(NDArrayIter):
             label = label.reshape((-1,) + tuple(label_shape))
             if label_shape == (1,):
                 label = label.reshape(-1)
+        kwargs.setdefault("label_name", "label")
         super().__init__(data, label, batch_size,
                          last_batch_handle="pad" if round_batch else "discard",
-                         label_name="label", **kwargs)
+                         **kwargs)
 
 
 def _read_idx_file(path):

@@ -282,3 +282,9 @@ def test_example_rcnn_end2end_runs():
     _run_example("rcnn_end2end.py",
                  ["--num-epochs", "3", "--images-per-epoch", "60",
                   "--min-acc", "0.0", "--min-recall", "0.5"])
+
+
+def test_example_kaggle_ndsb2_runs(tmp_path):
+    _run_example("kaggle_ndsb2.py",
+                 ["--work-dir", str(tmp_path / "w"), "--num-epochs", "8",
+                  "--n-train", "300"])

@@ -30,6 +30,10 @@ def main(argv=None):
     parser.add_argument("caffemodel")
     parser.add_argument("prefix", help="output checkpoint prefix")
     parser.add_argument("--epoch", type=int, default=0)
+    parser.add_argument("--mean", default=None,
+                        help="optional mean.binaryproto; decoded and "
+                             "saved as <prefix>-mean.nd for "
+                             "ImageRecordIter(mean_img=...)")
     args = parser.parse_args(argv)
 
     with open(args.prototxt) as f:
@@ -42,6 +46,12 @@ def main(argv=None):
     print(f"caffe_converter: wrote {args.prefix}-symbol.json and "
           f"{args.prefix}-{args.epoch:04d}.params "
           f"({len(arg_params)} args, {len(aux_params)} aux)")
+    if args.mean:
+        with open(args.mean, "rb") as f:
+            mean = caffe_mod.load_mean_binaryproto(f.read())
+        mean_path = args.prefix + "-mean.nd"
+        mx.nd.save(mean_path, {"mean_img": mx.nd.array(mean)})
+        print(f"caffe_converter: wrote {mean_path} {tuple(mean.shape)}")
 
 
 if __name__ == "__main__":
