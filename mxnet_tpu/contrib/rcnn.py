@@ -413,3 +413,40 @@ class ProposalTargetProp(op_mod.CustomOpProp):
 
     def create_operator(self, ctx, shapes, dtypes):
         return ProposalTargetOp(**self._kw)
+
+
+# ------------------------------------------------------------- inference
+def im_detect(rois, cls_prob, bbox_deltas, im_shape, score_thresh=0.05,
+              nms_thresh=0.3, max_per_class=100):
+    """Decode head outputs into per-class detections
+    (rcnn/tester.py pred_eval inner loop + detector.py im_detect).
+
+    rois        : (N, 5) [batch_idx x1 y1 x2 y2] from the proposal op
+    cls_prob    : (N, C) softmax over classes (class 0 = background)
+    bbox_deltas : (N, 4C) per-class regression deltas
+    im_shape    : (h, w) for clipping
+    Returns {class_index: (K, 5) [x1 y1 x2 y2 score]} for classes >= 1.
+    """
+    rois = np.asarray(rois, np.float64)
+    cls_prob = np.asarray(cls_prob, np.float64)
+    bbox_deltas = np.asarray(bbox_deltas, np.float64)
+    if rois.shape[1] == 5 and rois[:, 0].max(initial=0) > 0:
+        # like the reference tester (single-image batches only): refuse
+        # rather than cross-image-NMS a multi-image roi set
+        raise ValueError(
+            "im_detect decodes one image at a time; split the rois by "
+            "their batch_idx column first")
+    boxes = bbox_pred(rois[:, 1:5], bbox_deltas)
+    boxes = clip_boxes(boxes, im_shape)
+    dets = {}
+    for c in range(1, cls_prob.shape[1]):
+        scores = cls_prob[:, c]
+        keep = np.where(scores > score_thresh)[0]
+        if keep.size == 0:
+            dets[c] = np.zeros((0, 5))
+            continue
+        cls_boxes = boxes[keep, 4 * c:4 * c + 4]
+        cls_dets = np.hstack([cls_boxes, scores[keep, None]])
+        keep_nms = nms(cls_dets, nms_thresh)[:max_per_class]
+        dets[c] = cls_dets[keep_nms]
+    return dets

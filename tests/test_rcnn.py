@@ -191,3 +191,34 @@ def test_proposal_target_pad_labels_consistent():
         assert seen.setdefault(roi, lab) == lab, (roi, seen[roi], lab)
     # the gt-overlapping roi stays foreground somewhere in the batch
     assert (labels > 0).any()
+
+
+def test_im_detect_decodes_and_suppresses():
+    # two rois near one object of class 2; deltas refine roi->gt; NMS
+    # keeps a single detection, scores thresholded
+    gt = np.array([[20.0, 20, 50, 50]])
+    rois = np.array([[0, 18.0, 18, 48, 48],
+                     [0, 22, 22, 52, 52],
+                     [0, 70, 70, 90, 90]])
+    nc = 3
+    deltas = np.zeros((3, 4 * nc))
+    for i in range(2):
+        deltas[i, 8:12] = rcnn.bbox_transform(rois[i:i + 1, 1:5], gt)[0]
+    probs = np.array([[0.1, 0.1, 0.8],
+                      [0.2, 0.1, 0.7],
+                      [0.9, 0.05, 0.05]])   # roi 2: background
+    dets = rcnn.im_detect(rois, probs, deltas, im_shape=(100, 100),
+                          score_thresh=0.1, nms_thresh=0.3)
+    assert dets[2].shape[0] == 1             # NMS merged the duplicates
+    iou = rcnn.bbox_overlaps(dets[2][:, :4], gt)
+    assert iou.max() > 0.95
+    assert dets[2][0, 4] == 0.8              # best score kept
+    assert dets[1].shape[0] == 0             # below threshold everywhere
+
+
+def test_im_detect_rejects_multi_image_rois():
+    rois = np.array([[0, 1.0, 1, 10, 10], [1, 1, 1, 10, 10]])
+    probs = np.full((2, 2), 0.5)
+    deltas = np.zeros((2, 8))
+    with pytest.raises(ValueError):
+        rcnn.im_detect(rois, probs, deltas, im_shape=(32, 32))
