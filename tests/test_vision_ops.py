@@ -187,3 +187,33 @@ def test_multibox_detection_nms_topk_limits_survivors():
     kept = out[0][out[0, :, 0] >= 0]
     assert kept.shape[0] == 2  # third detection cut by nms_topk
     np.testing.assert_allclose(kept[:, 1], [0.9, 0.8], atol=1e-5)
+
+
+def test_correlation_brute_force():
+    # displaced channels against a direct numpy evaluation of
+    # corr(x, y)[d] = mean_c f1(p) * f2(p + d) over the kernel window
+    # (correlation-inl.h is_multiply path), and the |f1-f2| mode
+    x = rng.randn(1, 3, 5, 5).astype(np.float32)
+    y = rng.randn(1, 3, 5, 5).astype(np.float32)
+    pad, bd = 1, 1
+    for is_mult in (True, False):
+        sym = mx.sym.Correlation(
+            mx.sym.Variable("data1"), mx.sym.Variable("data2"),
+            kernel_size=1, max_displacement=bd, stride1=1, stride2=1,
+            pad_size=pad, is_multiply=is_mult)
+        out = simple_forward(sym, data1=x, data2=y)
+        _, _, H, W = x.shape
+        p1 = np.pad(x[0], ((0, 0), (pad, pad), (pad, pad)))
+        p2 = np.pad(y[0], ((0, 0), (pad, pad), (pad, pad)))
+        for ci, (dy, dx) in enumerate(
+                (dy, dx) for dy in (-1, 0, 1) for dx in (-1, 0, 1)):
+            ref = np.zeros((H, W), np.float32)
+            for i in range(H):
+                for j in range(W):
+                    a = p1[:, i + bd, j + bd]
+                    b = p2[:, i + bd + dy, j + bd + dx]
+                    v = a * b if is_mult else np.abs(a - b)
+                    ref[i, j] = v.mean()
+            np.testing.assert_allclose(
+                out[0, ci], ref, rtol=1e-4, atol=1e-5,
+                err_msg=f"mult={is_mult} disp=({dy},{dx}) ch={ci}")
