@@ -112,6 +112,144 @@ int MXTPUGetOpInfo(const char* name, const char** out_doc, int* out_n_args,
                    const char*** out_param_types,
                    const char*** out_param_docs);
 
+/* ==== training surface =====================================================
+ * Rebuild of the reference's full training C API (include/mxnet/c_api.h;
+ * src/c_api/c_api.cc:410-1250): NDArray CRUD + imperative invoke, Symbol
+ * create/compose/infer, Executor bind/forward/backward, KVStore, DataIter.
+ * Conventions: 0 = ok, -1 = failure (MXTPUGetLastError()); op/iter/optimizer
+ * parameters travel as parallel key/value C-string arrays; dtype codes are
+ * the mshadow TypeFlag order (0=f32 1=f64 2=f16 3=u8 4=i32 5=i8 6=i64) plus
+ * 7=bf16 and 8=bool; dev_type: 1=cpu 2=gpu 3=cpu_pinned 4=tpu.
+ * Pointer outputs (name lists, shape buffers, JSON) live in per-handle
+ * snapshots and stay valid until the next call on the same handle. */
+
+#define MXTPU_MAX_NDIM 8
+
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+typedef void* KVStoreHandle;
+typedef void* DataIterHandle;
+
+/* ---- NDArray (MXNDArray* analogs) ---- */
+int MXTPUNDArrayCreate(const uint32_t* shape, uint32_t ndim, int dtype,
+                       int dev_type, int dev_id, NDArrayHandle* out);
+int MXTPUNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                                uint64_t nbytes);
+int MXTPUNDArraySyncCopyToCPU(NDArrayHandle handle, void* data,
+                              uint64_t nbytes);
+/* out_shape must have capacity MXTPU_MAX_NDIM. */
+int MXTPUNDArrayGetShape(NDArrayHandle handle, uint32_t* out_ndim,
+                         uint32_t* out_shape);
+int MXTPUNDArrayGetDType(NDArrayHandle handle, int* out_dtype);
+int MXTPUNDArrayWaitAll(void);
+int MXTPUNDArrayFree(NDArrayHandle handle);
+/* keys may be NULL for a nameless list save. */
+int MXTPUNDArraySave(const char* fname, int num, NDArrayHandle* handles,
+                     const char** keys);
+/* out_names entries stay valid as long as their array handle lives;
+ * *out_named is 1 when the file carried a name dict. */
+int MXTPUNDArrayLoad(const char* fname, int cap, NDArrayHandle* out_handles,
+                     const char** out_names, int* out_num, int* out_named);
+/* Imperative op invoke on NDArrays (MXImperativeInvoke analog). */
+int MXTPUFuncInvoke(const char* op_name, int n_in, NDArrayHandle* inputs,
+                    int n_param, const char** keys, const char** vals,
+                    int cap, NDArrayHandle* outputs, int* out_num);
+
+/* ---- Symbol (MXSymbol* analogs) ---- */
+int MXTPUSymbolCreateVariable(const char* name, SymbolHandle* out);
+int MXTPUSymbolCreateAtomicSymbol(const char* op_name, int n_param,
+                                  const char** keys, const char** vals,
+                                  SymbolHandle* out);
+/* Mutates sym in place (reference Compose semantics). keys == NULL means
+ * positional inputs. */
+int MXTPUSymbolCompose(SymbolHandle sym, const char* name, int n_args,
+                       const char** keys, SymbolHandle* args);
+int MXTPUSymbolCreateFromJSON(const char* json, SymbolHandle* out);
+int MXTPUSymbolSaveToJSON(SymbolHandle sym, const char** out_json);
+int MXTPUSymbolListArguments(SymbolHandle sym, int* out_size,
+                             const char*** out);
+int MXTPUSymbolListOutputs(SymbolHandle sym, int* out_size,
+                           const char*** out);
+int MXTPUSymbolListAuxiliaryStates(SymbolHandle sym, int* out_size,
+                                   const char*** out);
+int MXTPUSymbolCopy(SymbolHandle sym, SymbolHandle* out);
+int MXTPUSymbolGetInternals(SymbolHandle sym, SymbolHandle* out);
+int MXTPUSymbolGetOutput(SymbolHandle sym, uint32_t index, SymbolHandle* out);
+int MXTPUSymbolGetAttr(SymbolHandle sym, const char* key, const char** out);
+int MXTPUSymbolSetAttr(SymbolHandle sym, const char* key, const char* value);
+/* MXSymbolInferShape-shaped: known input shapes arrive CSR-style
+ * (keys + arg_ind_ptr[num_args+1] + arg_shape_data); results come back as
+ * three groups (arg/out/aux) of (count, ndim array, shape-data pointer
+ * array), owned by the handle snapshot. *complete is 0 when inference is
+ * underdetermined (partial variant only). */
+int MXTPUSymbolInferShape(SymbolHandle sym, uint32_t num_args,
+                          const char** keys, const uint32_t* arg_ind_ptr,
+                          const uint32_t* arg_shape_data, uint32_t* in_size,
+                          const uint32_t** in_ndim, const uint32_t*** in_data,
+                          uint32_t* out_size, const uint32_t** out_ndim,
+                          const uint32_t*** out_data, uint32_t* aux_size,
+                          const uint32_t** aux_ndim,
+                          const uint32_t*** aux_data, int* complete);
+int MXTPUSymbolInferShapePartial(
+    SymbolHandle sym, uint32_t num_args, const char** keys,
+    const uint32_t* arg_ind_ptr, const uint32_t* arg_shape_data,
+    uint32_t* in_size, const uint32_t** in_ndim, const uint32_t*** in_data,
+    uint32_t* out_size, const uint32_t** out_ndim, const uint32_t*** out_data,
+    uint32_t* aux_size, const uint32_t** aux_ndim, const uint32_t*** aux_data,
+    int* complete);
+int MXTPUSymbolFree(SymbolHandle sym);
+
+/* ---- Executor (MXExecutor* analogs) ---- */
+/* arg_grads entries may be NULL (no gradient buffer); grad_reqs per arg:
+ * 0 = null, 1 = write, 2 = add (NULL means all-write). */
+int MXTPUExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                      uint32_t n_args, NDArrayHandle* args,
+                      NDArrayHandle* arg_grads, const uint32_t* grad_reqs,
+                      uint32_t n_aux, NDArrayHandle* aux,
+                      ExecutorHandle* out);
+int MXTPUExecutorForward(ExecutorHandle handle, int is_train);
+/* head_grads may be n == 0 for loss-op heads (SoftmaxOutput etc.). */
+int MXTPUExecutorBackward(ExecutorHandle handle, uint32_t n,
+                          NDArrayHandle* head_grads);
+/* Writes up to cap fresh NDArray handles (caller frees each). */
+int MXTPUExecutorOutputs(ExecutorHandle handle, int cap, NDArrayHandle* out,
+                        int* out_num);
+int MXTPUExecutorFree(ExecutorHandle handle);
+
+/* ---- KVStore (MXKVStore* analogs) ---- */
+int MXTPUKVStoreCreate(const char* type, KVStoreHandle* out);
+int MXTPUKVStoreInit(KVStoreHandle handle, int num, const int* keys,
+                     NDArrayHandle* vals);
+int MXTPUKVStorePush(KVStoreHandle handle, int num, const int* keys,
+                     NDArrayHandle* vals, int priority);
+int MXTPUKVStorePull(KVStoreHandle handle, int num, const int* keys,
+                     NDArrayHandle* outs, int priority);
+/* Server-side/local optimizer from name + string params (the C analog of
+ * MXKVStoreSetUpdater: the optimizer zoo lives in the runtime). */
+int MXTPUKVStoreSetOptimizer(KVStoreHandle handle, const char* name,
+                             int n_param, const char** keys,
+                             const char** vals);
+int MXTPUKVStoreGetType(KVStoreHandle handle, const char** out);
+int MXTPUKVStoreGetRank(KVStoreHandle handle, int* out);
+int MXTPUKVStoreGetGroupSize(KVStoreHandle handle, int* out);
+int MXTPUKVStoreBarrier(KVStoreHandle handle);
+int MXTPUKVStoreFree(KVStoreHandle handle);
+
+/* ---- DataIter (MXDataIter* analogs) ---- */
+int MXTPUListDataIters(int* out_size, const char*** out_names);
+int MXTPUDataIterCreate(const char* name, int n_param, const char** keys,
+                        const char** vals, DataIterHandle* out);
+int MXTPUDataIterNext(DataIterHandle handle, int* out);
+int MXTPUDataIterBeforeFirst(DataIterHandle handle);
+int MXTPUDataIterGetData(DataIterHandle handle, NDArrayHandle* out);
+int MXTPUDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out);
+int MXTPUDataIterGetPadNum(DataIterHandle handle, int* out);
+int MXTPUDataIterFree(DataIterHandle handle);
+
+/* ---- misc ---- */
+int MXTPURandomSeed(int seed);
+
 #ifdef __cplusplus
 }  /* extern "C" */
 #endif

@@ -1,0 +1,483 @@
+// mxtpu-cpp: header-only C++ frontend over the flat C ABI.
+//
+// The second-language frontend proof for this framework — the role the
+// reference's cpp-package (include/mxnet-cpp/*.hpp, header-only classes
+// over include/mxnet/c_api.h) and its R/Scala bindings play: every
+// operation below reaches the runtime exclusively through the C entry
+// points in mxtpu/c_api.h, never through Python headers, so any
+// language with a C FFI can replicate this layer.
+//
+// RAII value types with shared-handle semantics: copying an NDArray /
+// Symbol / Executor copies a reference to the same underlying handle
+// (reference mxnet-cpp has the same contract).
+//
+//   using namespace mxtpu::cpp;
+//   Symbol data = Symbol::Variable("data");
+//   Symbol fc = Op("FullyConnected", {{"num_hidden", "10"}}, {data}, "fc");
+//   auto shapes = fc.InferShape({{"data", {32, 64}}});
+//   ...
+
+#ifndef MXTPU_CPP_MXTPU_HPP_
+#define MXTPU_CPP_MXTPU_HPP_
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "../c_api.h"
+
+namespace mxtpu {
+namespace cpp {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& where)
+      : std::runtime_error(where + ": " + MXTPUGetLastError()) {}
+};
+
+inline void Check(int rc, const char* where) {
+  if (rc != 0) throw Error(where);
+}
+
+using KwArgs = std::map<std::string, std::string>;
+
+// Split a kwargs map into parallel C-string arrays (valid while the
+// map is alive).
+struct KwView {
+  std::vector<const char*> keys, vals;
+  explicit KwView(const KwArgs& kw) {
+    for (const auto& it : kw) {
+      keys.push_back(it.first.c_str());
+      vals.push_back(it.second.c_str());
+    }
+  }
+  int n() const { return static_cast<int>(keys.size()); }
+};
+
+// ---- NDArray ---------------------------------------------------------------
+
+class NDArray {
+ public:
+  NDArray() = default;
+
+  explicit NDArray(const std::vector<uint32_t>& shape, int dtype = 0,
+                   int dev_type = 1, int dev_id = 0) {
+    NDArrayHandle h = nullptr;
+    Check(MXTPUNDArrayCreate(shape.data(),
+                             static_cast<uint32_t>(shape.size()), dtype,
+                             dev_type, dev_id, &h),
+          "NDArrayCreate");
+    Reset(h);
+  }
+
+  NDArray(const std::vector<float>& data, const std::vector<uint32_t>& shape)
+      : NDArray(shape) {
+    SyncCopyFromCPU(data);
+  }
+
+  // adopt an existing C handle (takes ownership)
+  static NDArray Adopt(NDArrayHandle h) {
+    NDArray a;
+    a.Reset(h);
+    return a;
+  }
+
+  bool IsNone() const { return handle_ == nullptr; }
+  NDArrayHandle handle() const { return handle_ ? handle_->h : nullptr; }
+
+  void SyncCopyFromCPU(const std::vector<float>& data) {
+    Check(MXTPUNDArraySyncCopyFromCPU(handle(), data.data(),
+                                      data.size() * sizeof(float)),
+          "NDArraySyncCopyFromCPU");
+  }
+
+  std::vector<float> SyncCopyToCPU() const {
+    std::vector<float> out(Size());
+    Check(MXTPUNDArraySyncCopyToCPU(handle(), out.data(),
+                                    out.size() * sizeof(float)),
+          "NDArraySyncCopyToCPU");
+    return out;
+  }
+
+  std::vector<uint32_t> Shape() const {
+    uint32_t ndim = 0, buf[MXTPU_MAX_NDIM];
+    Check(MXTPUNDArrayGetShape(handle(), &ndim, buf), "NDArrayGetShape");
+    return std::vector<uint32_t>(buf, buf + ndim);
+  }
+
+  uint64_t Size() const {
+    uint64_t n = 1;
+    for (uint32_t d : Shape()) n *= d;
+    return n;
+  }
+
+  int DType() const {
+    int dt = 0;
+    Check(MXTPUNDArrayGetDType(handle(), &dt), "NDArrayGetDType");
+    return dt;
+  }
+
+  static void WaitAll() { Check(MXTPUNDArrayWaitAll(), "NDArrayWaitAll"); }
+
+ private:
+  struct Owner {
+    explicit Owner(NDArrayHandle hh) : h(hh) {}
+    Owner(const Owner&) = delete;
+    Owner& operator=(const Owner&) = delete;
+    NDArrayHandle h;
+    ~Owner() {
+      if (h) MXTPUNDArrayFree(h);
+    }
+  };
+  void Reset(NDArrayHandle h) { handle_ = std::make_shared<Owner>(h); }
+  std::shared_ptr<Owner> handle_;
+};
+
+// ---- Symbol ----------------------------------------------------------------
+
+class Symbol {
+ public:
+  Symbol() = default;
+
+  static Symbol Variable(const std::string& name) {
+    SymbolHandle h = nullptr;
+    Check(MXTPUSymbolCreateVariable(name.c_str(), &h), "SymbolCreateVariable");
+    return Symbol(h);
+  }
+
+  static Symbol FromJSON(const std::string& json) {
+    SymbolHandle h = nullptr;
+    Check(MXTPUSymbolCreateFromJSON(json.c_str(), &h),
+          "SymbolCreateFromJSON");
+    return Symbol(h);
+  }
+
+  std::string ToJSON() const {
+    const char* js = nullptr;
+    Check(MXTPUSymbolSaveToJSON(handle(), &js), "SymbolSaveToJSON");
+    return js;
+  }
+
+  std::vector<std::string> ListArguments() const {
+    return ListStrs(&MXTPUSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return ListStrs(&MXTPUSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return ListStrs(&MXTPUSymbolListAuxiliaryStates);
+  }
+
+  Symbol GetInternals() const {
+    SymbolHandle out = nullptr;
+    Check(MXTPUSymbolGetInternals(handle(), &out), "SymbolGetInternals");
+    return Symbol(out);
+  }
+
+  Symbol operator[](uint32_t i) const {
+    SymbolHandle out = nullptr;
+    Check(MXTPUSymbolGetOutput(handle(), i, &out), "SymbolGetOutput");
+    return Symbol(out);
+  }
+
+  struct InferredShapes {
+    bool complete = false;
+    std::vector<std::vector<uint32_t>> arg, out, aux;
+  };
+
+  InferredShapes InferShape(
+      const std::map<std::string, std::vector<uint32_t>>& known,
+      bool partial = false) const {
+    std::vector<const char*> keys;
+    std::vector<uint32_t> indptr{0}, data;
+    for (const auto& kv : known) {
+      keys.push_back(kv.first.c_str());
+      for (uint32_t d : kv.second) data.push_back(d);
+      indptr.push_back(static_cast<uint32_t>(data.size()));
+    }
+    uint32_t sizes[3];
+    const uint32_t* ndims[3];
+    const uint32_t** shapes[3];
+    int complete = 0;
+    auto fn = partial ? &MXTPUSymbolInferShapePartial : &MXTPUSymbolInferShape;
+    Check(fn(handle(), static_cast<uint32_t>(keys.size()), keys.data(),
+             indptr.data(), data.data(), &sizes[0], &ndims[0], &shapes[0],
+             &sizes[1], &ndims[1], &shapes[1], &sizes[2], &ndims[2],
+             &shapes[2], &complete),
+          "SymbolInferShape");
+    InferredShapes r;
+    r.complete = complete != 0;
+    std::vector<std::vector<uint32_t>>* groups[3] = {&r.arg, &r.out, &r.aux};
+    for (int g = 0; g < 3; ++g)
+      for (uint32_t i = 0; i < sizes[g]; ++i)
+        groups[g]->emplace_back(shapes[g][i], shapes[g][i] + ndims[g][i]);
+    return r;
+  }
+
+  std::string GetAttr(const std::string& key) const {
+    const char* out = nullptr;
+    Check(MXTPUSymbolGetAttr(handle(), key.c_str(), &out), "SymbolGetAttr");
+    return out;
+  }
+
+  void SetAttr(const std::string& key, const std::string& value) {
+    Check(MXTPUSymbolSetAttr(handle(), key.c_str(), value.c_str()),
+          "SymbolSetAttr");
+  }
+
+  SymbolHandle handle() const { return handle_ ? handle_->h : nullptr; }
+
+  explicit Symbol(SymbolHandle h)
+      : handle_(std::make_shared<Owner>(h)) {}
+
+ private:
+  template <typename Fn>
+  std::vector<std::string> ListStrs(Fn fn) const {
+    int n = 0;
+    const char** strs = nullptr;
+    Check(fn(handle(), &n, &strs), "SymbolList*");
+    return std::vector<std::string>(strs, strs + n);
+  }
+
+  struct Owner {
+    explicit Owner(SymbolHandle hh) : h(hh) {}
+    Owner(const Owner&) = delete;
+    Owner& operator=(const Owner&) = delete;
+    SymbolHandle h;
+    ~Owner() {
+      if (h) MXTPUSymbolFree(h);
+    }
+  };
+  std::shared_ptr<Owner> handle_;
+};
+
+// Atomic-create + compose in one expression — the mxnet-cpp Operator
+// builder equivalent.
+inline Symbol Op(const std::string& op_name, const KwArgs& params,
+                 const std::vector<Symbol>& inputs,
+                 const std::string& name = "") {
+  KwView kw(params);
+  SymbolHandle h = nullptr;
+  Check(MXTPUSymbolCreateAtomicSymbol(op_name.c_str(), kw.n(),
+                                      kw.keys.data(), kw.vals.data(), &h),
+        "SymbolCreateAtomicSymbol");
+  std::vector<SymbolHandle> args;
+  for (const Symbol& s : inputs) args.push_back(s.handle());
+  int rc = MXTPUSymbolCompose(h, name.c_str(),
+                              static_cast<int>(args.size()), nullptr,
+                              args.data());
+  if (rc != 0) {
+    MXTPUSymbolFree(h);
+    throw Error("SymbolCompose");
+  }
+  return Symbol(h);
+}
+
+// ---- Executor --------------------------------------------------------------
+
+enum class GradReq : uint32_t { kNull = 0, kWrite = 1, kAdd = 2 };
+
+class Executor {
+ public:
+  Executor(const Symbol& sym, const std::vector<NDArray>& args,
+           const std::vector<NDArray>& arg_grads,
+           const std::vector<GradReq>& reqs,
+           const std::vector<NDArray>& aux = {}, int dev_type = 1,
+           int dev_id = 0) {
+    if (arg_grads.size() != args.size() || reqs.size() != args.size())
+      throw std::invalid_argument(
+          "Executor: args, arg_grads and reqs must be the same length");
+    std::vector<NDArrayHandle> a, g, x;
+    std::vector<uint32_t> r;
+    for (const auto& nd : args) a.push_back(nd.handle());
+    for (const auto& nd : arg_grads) g.push_back(nd.handle());
+    for (const auto& req : reqs) r.push_back(static_cast<uint32_t>(req));
+    for (const auto& nd : aux) x.push_back(nd.handle());
+    ExecutorHandle h = nullptr;
+    Check(MXTPUExecutorBind(sym.handle(), dev_type, dev_id,
+                            static_cast<uint32_t>(a.size()), a.data(),
+                            g.data(), r.data(),
+                            static_cast<uint32_t>(x.size()),
+                            x.empty() ? nullptr : x.data(), &h),
+          "ExecutorBind");
+    handle_ = std::make_shared<Owner>(h);
+  }
+
+  void Forward(bool is_train) {
+    Check(MXTPUExecutorForward(handle(), is_train ? 1 : 0),
+          "ExecutorForward");
+  }
+
+  void Backward(const std::vector<NDArray>& head_grads = {}) {
+    std::vector<NDArrayHandle> hg;
+    for (const auto& nd : head_grads) hg.push_back(nd.handle());
+    Check(MXTPUExecutorBackward(handle(),
+                                static_cast<uint32_t>(hg.size()),
+                                hg.empty() ? nullptr : hg.data()),
+          "ExecutorBackward");
+  }
+
+  std::vector<NDArray> Outputs() const {
+    NDArrayHandle buf[64];
+    int n = 0;
+    Check(MXTPUExecutorOutputs(handle(), 64, buf, &n), "ExecutorOutputs");
+    std::vector<NDArray> outs;
+    for (int i = 0; i < n; ++i) outs.push_back(NDArray::Adopt(buf[i]));
+    return outs;
+  }
+
+  ExecutorHandle handle() const { return handle_ ? handle_->h : nullptr; }
+
+ private:
+  struct Owner {
+    explicit Owner(ExecutorHandle hh) : h(hh) {}
+    Owner(const Owner&) = delete;
+    Owner& operator=(const Owner&) = delete;
+    ExecutorHandle h;
+    ~Owner() {
+      if (h) MXTPUExecutorFree(h);
+    }
+  };
+  std::shared_ptr<Owner> handle_;
+};
+
+// ---- KVStore ---------------------------------------------------------------
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string& type = "local") {
+    KVStoreHandle h = nullptr;
+    Check(MXTPUKVStoreCreate(type.c_str(), &h), "KVStoreCreate");
+    handle_ = std::make_shared<Owner>(h);
+  }
+
+  void SetOptimizer(const std::string& name, const KwArgs& params) {
+    KwView kw(params);
+    Check(MXTPUKVStoreSetOptimizer(handle(), name.c_str(), kw.n(),
+                                   kw.keys.data(), kw.vals.data()),
+          "KVStoreSetOptimizer");
+  }
+
+  void Init(int key, const NDArray& val) {
+    NDArrayHandle h = val.handle();
+    Check(MXTPUKVStoreInit(handle(), 1, &key, &h), "KVStoreInit");
+  }
+
+  void Push(int key, const NDArray& val, int priority = 0) {
+    NDArrayHandle h = val.handle();
+    Check(MXTPUKVStorePush(handle(), 1, &key, &h, priority), "KVStorePush");
+  }
+
+  void Pull(int key, NDArray* out, int priority = 0) {
+    NDArrayHandle h = out->handle();
+    Check(MXTPUKVStorePull(handle(), 1, &key, &h, priority), "KVStorePull");
+  }
+
+  int Rank() const {
+    int r = 0;
+    Check(MXTPUKVStoreGetRank(handle(), &r), "KVStoreGetRank");
+    return r;
+  }
+
+  int NumWorkers() const {
+    int r = 0;
+    Check(MXTPUKVStoreGetGroupSize(handle(), &r), "KVStoreGetGroupSize");
+    return r;
+  }
+
+  std::string Type() const {
+    const char* t = nullptr;
+    Check(MXTPUKVStoreGetType(handle(), &t), "KVStoreGetType");
+    return t;
+  }
+
+  KVStoreHandle handle() const { return handle_ ? handle_->h : nullptr; }
+
+ private:
+  struct Owner {
+    explicit Owner(KVStoreHandle hh) : h(hh) {}
+    Owner(const Owner&) = delete;
+    Owner& operator=(const Owner&) = delete;
+    KVStoreHandle h;
+    ~Owner() {
+      if (h) MXTPUKVStoreFree(h);
+    }
+  };
+  std::shared_ptr<Owner> handle_;
+};
+
+// ---- DataIter --------------------------------------------------------------
+
+class DataIter {
+ public:
+  DataIter(const std::string& name, const KwArgs& params) {
+    KwView kw(params);
+    DataIterHandle h = nullptr;
+    Check(MXTPUDataIterCreate(name.c_str(), kw.n(), kw.keys.data(),
+                              kw.vals.data(), &h),
+          "DataIterCreate");
+    handle_ = std::make_shared<Owner>(h);
+  }
+
+  static std::vector<std::string> List() {
+    int n = 0;
+    const char** names = nullptr;
+    Check(MXTPUListDataIters(&n, &names), "ListDataIters");
+    return std::vector<std::string>(names, names + n);
+  }
+
+  bool Next() {
+    int more = 0;
+    Check(MXTPUDataIterNext(handle(), &more), "DataIterNext");
+    return more != 0;
+  }
+
+  void Reset() {
+    Check(MXTPUDataIterBeforeFirst(handle()), "DataIterBeforeFirst");
+  }
+
+  NDArray Data() const {
+    NDArrayHandle h = nullptr;
+    Check(MXTPUDataIterGetData(handle(), &h), "DataIterGetData");
+    return NDArray::Adopt(h);
+  }
+
+  NDArray Label() const {
+    NDArrayHandle h = nullptr;
+    Check(MXTPUDataIterGetLabel(handle(), &h), "DataIterGetLabel");
+    return NDArray::Adopt(h);
+  }
+
+  int PadNum() const {
+    int p = 0;
+    Check(MXTPUDataIterGetPadNum(handle(), &p), "DataIterGetPadNum");
+    return p;
+  }
+
+  DataIterHandle handle() const { return handle_ ? handle_->h : nullptr; }
+
+ private:
+  struct Owner {
+    explicit Owner(DataIterHandle hh) : h(hh) {}
+    Owner(const Owner&) = delete;
+    Owner& operator=(const Owner&) = delete;
+    DataIterHandle h;
+    ~Owner() {
+      if (h) MXTPUDataIterFree(h);
+    }
+  };
+  std::shared_ptr<Owner> handle_;
+};
+
+inline void RandomSeed(int seed) {
+  Check(MXTPURandomSeed(seed), "RandomSeed");
+}
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_MXTPU_HPP_

@@ -1,0 +1,396 @@
+"""Python side of the flat C training ABI.
+
+The reference exposes its full training surface through ~109 C entry
+points (``/root/reference/include/mxnet/c_api.h``): NDArray CRUD +
+imperative invoke (``src/c_api/c_api.cc:410-436``), Symbol
+create/compose/infer (``c_api.cc:758+``), Executor
+bind/forward/backward (``c_api.cc:956-1110``), DataIter
+(``c_api.cc:1153``) and KVStore (``c_api.h:1227+``).  Every non-Python
+frontend (R, Scala, Matlab, the C++ amalgamation) is a thin veneer over
+that ABI.
+
+In this framework the runtime *is* the Python/JAX layer, so the native
+``src/train_capi.cc`` bridges each C entry point to one plain function
+here (through the embedded/attached CPython interpreter, the same
+mechanism as ``src/predict_capi.cc``).  Functions in this module
+deliberately take and return only simple types — str/int/bytes/lists
+and opaque objects the C side holds as handles — so the C++ glue stays
+mechanical.
+
+All kwargs arriving from C are strings (the reference's C API has the
+same convention — dmlc::Parameter parses strings); ``_parse`` applies
+``ast.literal_eval`` with a string fallback so ``"(3,3)"``, ``"32"``,
+``"True"`` and ``"relu"`` all coerce correctly.
+"""
+
+from __future__ import annotations
+
+import ast
+
+import numpy as np
+
+__all__ = []  # C-ABI internal; not a user-facing module
+
+
+# int dtype codes across the ABI — the reference's mshadow TypeFlag order
+# (include/mxnet/base.h): 0=f32 1=f64 2=f16 3=u8 4=i32 5=i8 6=i64; we add
+# 7=bf16 (TPU-native) and 8=bool.
+_DTYPES = ["float32", "float64", "float16", "uint8", "int32", "int8",
+           "int64", "bfloat16", "bool"]
+
+
+def _np_dtype(code):
+    name = _DTYPES[code]
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _dtype_code(dtype):
+    name = np.dtype(dtype).name
+    if name not in _DTYPES:
+        raise ValueError(f"no ABI dtype code for {name}")
+    return _DTYPES.index(name)
+
+
+def _parse(s):
+    """String→python value for C-ABI kwargs (dmlc::Parameter analog)."""
+    if not isinstance(s, str):
+        return s
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+def _parse_kwargs(keys, vals):
+    return {k: _parse(v) for k, v in zip(keys, vals)}
+
+
+def _ctx(dev_type, dev_id):
+    from . import context
+    return {1: context.cpu, 2: context.gpu, 3: context.cpu_pinned,
+            4: context.tpu}.get(dev_type, context.cpu)(dev_id)
+
+
+# -- NDArray (MXNDArrayCreate* / SyncCopy* / WaitAll analogs) ---------------
+
+def nd_create(shape, dtype_code, dev_type, dev_id):
+    from .ndarray import NDArray
+    return NDArray(np.zeros(tuple(shape), dtype=_np_dtype(dtype_code)),
+                   ctx=_ctx(dev_type, dev_id))
+
+
+def nd_from_bytes(nd, data):
+    """SyncCopyFromCPU: raw little-endian bytes -> device array."""
+    arr = np.frombuffer(data, dtype=np.dtype(nd.dtype)).reshape(nd.shape)
+    nd[:] = arr
+    return True
+
+
+def nd_to_bytes(nd):
+    """SyncCopyToCPU: device array -> raw bytes (blocks until ready)."""
+    return np.ascontiguousarray(nd.asnumpy()).tobytes()
+
+
+def nd_shape(nd):
+    return tuple(int(d) for d in nd.shape)
+
+
+def nd_dtype(nd):
+    return _dtype_code(nd.dtype)
+
+
+def nd_wait_all():
+    from . import ndarray
+    ndarray.waitall()
+    return True
+
+
+def nd_save(fname, names, arrays):
+    from . import ndarray
+    if names:
+        ndarray.save(fname, dict(zip(names, arrays)))
+    else:
+        ndarray.save(fname, list(arrays))
+    return True
+
+
+def nd_load(fname):
+    from . import ndarray
+    data = ndarray.load(fname)
+    if isinstance(data, dict):
+        names, arrays = list(data.keys()), list(data.values())
+    else:
+        names, arrays = [], list(data)
+    return names, arrays
+
+
+def func_invoke(op_name, inputs, keys, vals):
+    """Imperative op invoke on NDArrays (MXFuncInvoke / MXImperativeInvoke
+    analog, reference c_api.cc:410-436): look the op up in the runtime
+    registry and apply it through the NDArray function surface."""
+    from . import ndarray as nd_mod
+    fn = getattr(nd_mod, op_name, None)
+    if fn is None:
+        from . import nd as nd_ns
+        fn = getattr(nd_ns, op_name, None)
+    if fn is None:
+        raise KeyError(f"no NDArray function {op_name!r}")
+    out = fn(*inputs, **_parse_kwargs(keys, vals))
+    if isinstance(out, (list, tuple)):
+        return list(out)
+    return [out]
+
+
+# -- Symbol (MXSymbolCreate* / Compose / Infer analogs) ---------------------
+
+class AtomicSymbol:
+    """A created-but-uncomposed op, the reference's AtomicSymbolCreator
+    product: MXSymbolCreateAtomicSymbol returns one of these; Compose
+    turns it into a real graph node."""
+
+    def __init__(self, op_name, kwargs):
+        self.op_name = op_name
+        self.kwargs = kwargs
+
+
+def symbol_create_variable(name):
+    from . import symbol
+    return symbol.Variable(name)
+
+
+def symbol_create_atomic(op_name, keys, vals):
+    return AtomicSymbol(op_name, _parse_kwargs(keys, vals))
+
+
+def symbol_compose(handle, name, keys, args):
+    """Compose an atomic symbol with inputs → full Symbol.  ``keys`` may
+    be None (positional) or parallel to ``args`` (named inputs)."""
+    from . import symbol
+    if not isinstance(handle, AtomicSymbol):
+        raise TypeError("compose target must be an uncomposed atomic symbol")
+    kwargs = dict(handle.kwargs)
+    if name:
+        kwargs["name"] = name
+    if keys:
+        kwargs.update(dict(zip(keys, args)))
+        return symbol._create(handle.op_name, [], kwargs)
+    return symbol._create(handle.op_name, list(args), kwargs)
+
+
+def symbol_from_json(json_str):
+    from . import symbol
+    return symbol.load_json(json_str)
+
+
+def symbol_to_json(sym):
+    return sym.tojson()
+
+
+def symbol_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def symbol_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def symbol_list_aux(sym):
+    return list(sym.list_auxiliary_states())
+
+
+def symbol_copy(sym):
+    """Deep graph copy (MXSymbolCopy semantics): the copy's nodes must not
+    share attrs with the original, so round-trip through graph JSON."""
+    from . import symbol
+    return symbol.load_json(sym.tojson())
+
+
+def symbol_get_internals(sym):
+    return sym.get_internals()
+
+
+def symbol_get_output(sym, index):
+    return sym[int(index)]
+
+
+def symbol_get_attr(sym, key):
+    v = sym.attr(key)
+    return "" if v is None else str(v)
+
+
+def symbol_set_attr(sym, key, value):
+    sym._set_attr(**{key: value})
+    return True
+
+
+def symbol_infer_shape(sym, keys, shapes, partial):
+    """Returns (complete, arg_shapes, out_shapes, aux_shapes); shape lists
+    are tuples (empty tuple for unknown when partial)."""
+    kwargs = {k: tuple(s) for k, s in zip(keys, shapes)}
+    fn = sym.infer_shape_partial if partial else sym.infer_shape
+    arg_shapes, out_shapes, aux_shapes = fn(**kwargs)
+    if arg_shapes is None:
+        return False, [], [], []
+    clean = lambda lst: [tuple(int(d) for d in (s or ())) for s in lst]
+    return True, clean(arg_shapes), clean(out_shapes), clean(aux_shapes)
+
+
+# -- Executor (MXExecutorBind/Forward/Backward/Outputs analogs) -------------
+
+_GRAD_REQ = {0: "null", 1: "write", 2: "add"}
+
+
+def executor_bind(sym, dev_type, dev_id, args, arg_grads, reqs, auxs):
+    ctx = _ctx(dev_type, dev_id)
+    grads = list(arg_grads)
+    req = [_GRAD_REQ[int(r)] for r in reqs]
+    return sym.bind(ctx, list(args), args_grad=grads, grad_req=req,
+                    aux_states=list(auxs) if auxs else None)
+
+
+def executor_forward(ex, is_train):
+    ex.forward(is_train=bool(is_train))
+    return True
+
+
+def executor_backward(ex, head_grads):
+    ex.backward(list(head_grads) if head_grads else None)
+    return True
+
+
+def executor_outputs(ex):
+    return list(ex.outputs)
+
+
+# -- KVStore (MXKVStore* analogs) -------------------------------------------
+
+def kvstore_create(kind):
+    from . import kvstore
+    return kvstore.create(kind)
+
+
+def kvstore_init(kv, keys, vals):
+    for k, v in zip(keys, vals):
+        kv.init(int(k), v)
+    return True
+
+
+def kvstore_push(kv, keys, vals, priority):
+    kv.push([int(k) for k in keys], list(vals), priority=priority)
+    return True
+
+
+def kvstore_pull(kv, keys, outs, priority):
+    kv.pull([int(k) for k in keys], out=list(outs), priority=priority)
+    return True
+
+
+def kvstore_set_optimizer(kv, name, keys, vals):
+    from .optimizer import Optimizer
+    opt = Optimizer.create_optimizer(name, **_parse_kwargs(keys, vals))
+    kv.set_optimizer(opt)
+    return True
+
+
+def kvstore_rank(kv):
+    return int(kv.rank)
+
+
+def kvstore_num_workers(kv):
+    return int(kv.num_workers)
+
+
+def kvstore_type(kv):
+    return str(kv.type)
+
+
+def kvstore_barrier(kv):
+    kv.barrier()
+    return True
+
+
+# -- DataIter (MXDataIterCreate*/Next/GetData analogs) ----------------------
+
+def _iter_registry():
+    from . import io
+    reg = {"MNISTIter": io.MNISTIter, "CSVIter": io.CSVIter,
+           "NDArrayIter": io.NDArrayIter}
+    try:
+        from . import image_io
+        reg["ImageRecordIter"] = image_io.ImageRecordIter
+    except Exception:
+        pass
+    return reg
+
+
+def list_data_iters():
+    return sorted(_iter_registry())
+
+
+class _IterAdapter:
+    """One-batch lookahead adapter: C's MXDataIterNext contract is
+    next()->bool then GetData/GetLabel/GetPad on the current batch."""
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+    def next(self):
+        try:
+            self.batch = self.it.next()
+            return True
+        except StopIteration:
+            self.batch = None
+            return False
+
+    def before_first(self):
+        self.it.reset()
+        self.batch = None
+        return True
+
+    def data(self):
+        return self.batch.data[0]
+
+    def label(self):
+        return self.batch.label[0]
+
+    def pad(self):
+        return int(self.batch.pad or 0)
+
+
+def dataiter_create(name, keys, vals):
+    cls = _iter_registry().get(name)
+    if cls is None:
+        raise KeyError(f"no data iterator {name!r}; have {list_data_iters()}")
+    return _IterAdapter(cls(**_parse_kwargs(keys, vals)))
+
+
+def dataiter_next(h):
+    return h.next()
+
+
+def dataiter_before_first(h):
+    return h.before_first()
+
+
+def dataiter_data(h):
+    return h.data()
+
+
+def dataiter_label(h):
+    return h.label()
+
+
+def dataiter_pad(h):
+    return h.pad()
+
+
+# -- misc -------------------------------------------------------------------
+
+def random_seed(seed):
+    from . import random as rnd
+    rnd.seed(int(seed))
+    return True

@@ -15,11 +15,11 @@
 
 #include <cstdint>
 #include <cstring>
-#include <mutex>
 #include <string>
 #include <vector>
 
 #include "mxtpu/c_api.h"
+#include "py_bridge.h"
 
 namespace {
 
@@ -27,62 +27,9 @@ struct Predictor {
   PyObject* obj;  // mxnet_tpu.predict.Predictor instance
 };
 
-bool g_we_initialized = false;
-
-// Set the thread-local error ring from the pending Python exception.
-void SetErrorFromPython() {
-  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
-  PyErr_Fetch(&type, &value, &tb);
-  std::string msg = "python error";
-  if (value != nullptr) {
-    PyObject* s = PyObject_Str(value);
-    if (s != nullptr) {
-      const char* c = PyUnicode_AsUTF8(s);
-      if (c != nullptr) msg = c;
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-  MXTPUSetLastError(msg.c_str());
-}
-
-// Ensure an interpreter exists; returns false on failure.  When this
-// library initializes Python itself (pure-C host), the JAX backend is
-// pinned to CPU first — predict-only deployments are host-side
-// (reference MXNET_PREDICT_ONLY forces the naive engine the same way).
-std::once_flag g_init_once;
-
-bool EnsurePython() {
-  // serialize first-call initialization: two C host threads racing
-  // Py_InitializeEx is undefined behavior
-  std::call_once(g_init_once, []() {
-    if (Py_IsInitialized()) return;
-    Py_InitializeEx(0);
-    if (!Py_IsInitialized()) return;
-    g_we_initialized = true;
-    PyRun_SimpleString(
-        "import jax\n"
-        "jax.config.update('jax_platforms', 'cpu')\n");
-    // release the GIL so later PyGILState_Ensure works from any thread
-    (void)PyEval_SaveThread();
-  });
-  if (!Py_IsInitialized()) {
-    MXTPUSetLastError("failed to initialize embedded Python");
-    return false;
-  }
-  return true;
-}
-
-class GILGuard {
- public:
-  GILGuard() : state_(PyGILState_Ensure()) {}
-  ~GILGuard() { PyGILState_Release(state_); }
-
- private:
-  PyGILState_STATE state_;
-};
+using mxtpu::EnsurePython;
+using mxtpu::GILGuard;
+using mxtpu::SetErrorFromPython;
 
 }  // namespace
 

@@ -1,0 +1,92 @@
+#include "py_bridge.h"
+
+#include <mutex>
+#include <string>
+
+#include "mxtpu/c_api.h"
+
+namespace mxtpu {
+namespace {
+std::once_flag g_init_once;
+}  // namespace
+
+void SetErrorFromPython() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  MXTPUSetLastError(msg.c_str());
+}
+
+bool EnsurePython() {
+  // serialize first-call initialization: two C host threads racing
+  // Py_InitializeEx is undefined behavior
+  std::call_once(g_init_once, []() {
+    if (Py_IsInitialized()) return;
+    Py_InitializeEx(0);
+    if (!Py_IsInitialized()) return;
+    PyRun_SimpleString(
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n");
+    // release the GIL so later PyGILState_Ensure works from any thread
+    (void)PyEval_SaveThread();
+  });
+  if (!Py_IsInitialized()) {
+    MXTPUSetLastError("failed to initialize embedded Python");
+    return false;
+  }
+  return true;
+}
+
+PyObject* Bridge() {
+  // cached borrowed-style pointer; the module lives for the process
+  static PyObject* mod = nullptr;
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("mxnet_tpu.c_api_bridge");
+    if (mod == nullptr) SetErrorFromPython();
+  }
+  return mod;
+}
+
+PyObject* CallBridge(const char* fn, const char* fmt, ...) {
+  PyObject* mod = Bridge();
+  if (mod == nullptr) return nullptr;
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (f == nullptr) {
+    SetErrorFromPython();
+    return nullptr;
+  }
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, ap);
+  va_end(ap);
+  if (args == nullptr) {
+    Py_DECREF(f);
+    SetErrorFromPython();
+    return nullptr;
+  }
+  // Py_BuildValue yields a bare object for single-arg formats; calls
+  // always need a tuple
+  if (!PyTuple_Check(args)) {
+    PyObject* tup = PyTuple_Pack(1, args);
+    Py_DECREF(args);
+    args = tup;
+  }
+  PyObject* r = args ? PyObject_CallObject(f, args) : nullptr;
+  Py_XDECREF(args);
+  Py_DECREF(f);
+  if (r == nullptr) SetErrorFromPython();
+  return r;
+}
+
+}  // namespace mxtpu

@@ -1,0 +1,46 @@
+// Shared embedded-CPython plumbing for the C-ABI surfaces
+// (predict_capi.cc + train_capi.cc): interpreter bootstrap, GIL scope
+// guard, Python-exception -> thread-local error-ring translation.
+//
+// The runtime of this framework is the Python/JAX layer (SURVEY.md §7
+// design split), so the flat C ABI reaches it the way the reference's
+// C API reaches its C++ runtime: direct in-process calls.  When the
+// host process is already Python (ctypes users) the live interpreter
+// is used; a pure-C host gets one initialized lazily, pinned to the
+// CPU backend (the reference's MXNET_PREDICT_ONLY-style host mode).
+
+#ifndef MXTPU_SRC_PY_BRIDGE_H_
+#define MXTPU_SRC_PY_BRIDGE_H_
+
+#ifndef PY_SSIZE_T_CLEAN
+#define PY_SSIZE_T_CLEAN
+#endif
+#include <Python.h>
+
+namespace mxtpu {
+
+// Ensure an interpreter exists; false on failure (error ring set).
+bool EnsurePython();
+
+// Translate the pending Python exception into MXTPUSetLastError.
+void SetErrorFromPython();
+
+class GILGuard {
+ public:
+  GILGuard() : state_(PyGILState_Ensure()) {}
+  ~GILGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+// mxnet_tpu.c_api_bridge module (borrowed ref, cached); NULL on failure.
+PyObject* Bridge();
+
+// Call a c_api_bridge function with Py_BuildValue-style args; returns a
+// new reference or NULL (error ring set).
+PyObject* CallBridge(const char* fn, const char* fmt, ...);
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_SRC_PY_BRIDGE_H_

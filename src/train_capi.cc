@@ -1,0 +1,748 @@
+// C-ABI training surface: NDArray + Symbol + Executor + KVStore +
+// DataIter entry points — the load-bearing contract that makes
+// non-Python frontends possible.
+//
+// Rebuild of the reference's training C API
+// (/root/reference/src/c_api/c_api.cc: NDArray CRUD + function invoke
+// at 410-436, Symbol create/compose/infer at 560-950, Executor
+// bind/forward/backward at 956-1110, DataIter at 1153+, KVStore per
+// include/mxnet/c_api.h:1227+).  Same ABI conventions: opaque handles,
+// int return codes (0 ok, -1 failure + MXTPUGetLastError), all op/iter
+// parameters passed as parallel key/value string arrays.
+//
+// The runtime is the Python/JAX layer, so every entry point is a thin
+// mechanical bridge (py_bridge.h) into mxnet_tpu/c_api_bridge.py —
+// exactly one bridge function per C entry.  Handles own a PyObject*
+// plus snapshot buffers for string/shape outputs, so returned pointers
+// stay valid until the next call on the same handle (the reference's
+// ret_->ret_vec_charp convention, c_api.cc:60-95).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "mxtpu/c_api.h"
+#include "py_bridge.h"
+
+namespace {
+
+using mxtpu::CallBridge;
+using mxtpu::EnsurePython;
+using mxtpu::GILGuard;
+using mxtpu::SetErrorFromPython;
+
+// Opaque handle: a Python object + output snapshot storage.
+struct Obj {
+  PyObject* obj = nullptr;
+  // string-list outputs (list_arguments, attr, json, ...)
+  std::vector<std::string> strs;
+  std::vector<const char*> str_ptrs;
+  std::string scratch;
+  // infer-shape outputs: 3 groups (arg / out / aux)
+  std::vector<std::vector<uint32_t>> shapes[3];
+  std::vector<uint32_t> ndims[3];
+  std::vector<const uint32_t*> shape_ptrs[3];
+};
+
+Obj* Wrap(PyObject* o) {
+  Obj* h = new Obj();
+  h->obj = o;
+  return h;
+}
+
+int FreeHandle(void* handle) {
+  Obj* h = static_cast<Obj*>(handle);
+  if (h == nullptr) return 0;
+  if (Py_IsInitialized()) {
+    GILGuard gil;
+    Py_XDECREF(h->obj);
+  }
+  delete h;
+  return 0;
+}
+
+PyObject* Borrow(void* handle) { return static_cast<Obj*>(handle)->obj; }
+
+// New list of handle objects; NULL entries become None.
+PyObject* HandleList(uint32_t n, void* const* handles) {
+  PyObject* lst = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    PyObject* o = handles && handles[i] ? Borrow(handles[i]) : Py_None;
+    Py_INCREF(o);
+    PyList_SET_ITEM(lst, i, o);
+  }
+  return lst;
+}
+
+PyObject* StrList(int n, const char** strs) {
+  PyObject* lst = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(lst, i, PyUnicode_FromString(strs && strs[i] ? strs[i]
+                                                                 : ""));
+  return lst;
+}
+
+PyObject* IntList(int n, const int* vals) {
+  PyObject* lst = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(lst, i, PyLong_FromLong(vals[i]));
+  return lst;
+}
+
+// r==NULL -> -1 (error already set); otherwise decref and 0.
+int Done(PyObject* r) {
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// Unpack a bridge-returned list of objects into caller handle slots.
+int UnpackHandleList(PyObject* lst, int cap, void** out, int* out_num) {
+  Py_ssize_t n = PyList_Size(lst);
+  if (n > cap) {
+    Py_DECREF(lst);
+    MXTPUSetLastError("output handle capacity too small");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(lst, i);
+    Py_INCREF(o);
+    out[i] = Wrap(o);
+  }
+  *out_num = static_cast<int>(n);
+  Py_DECREF(lst);
+  return 0;
+}
+
+// Copy a python list of str into a handle's snapshot; expose ptrs.
+int SnapshotStrs(Obj* h, PyObject* lst, int* out_size, const char*** out) {
+  if (lst == nullptr) return -1;
+  Py_ssize_t n = PySequence_Size(lst);
+  h->strs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* it = PySequence_GetItem(lst, i);
+    const char* c = it ? PyUnicode_AsUTF8(it) : nullptr;
+    h->strs.emplace_back(c ? c : "");
+    Py_XDECREF(it);
+  }
+  Py_DECREF(lst);
+  h->str_ptrs.clear();
+  for (const auto& s : h->strs) h->str_ptrs.push_back(s.c_str());
+  *out_size = static_cast<int>(h->str_ptrs.size());
+  *out = h->str_ptrs.data();
+  return 0;
+}
+
+// Snapshot one infer-shape group (list of shape tuples) into slot g.
+void SnapshotShapes(Obj* h, int g, PyObject* lst, uint32_t* out_size,
+                    const uint32_t** out_ndim, const uint32_t*** out_data) {
+  Py_ssize_t n = PySequence_Size(lst);
+  h->shapes[g].assign(n, {});
+  h->ndims[g].assign(n, 0);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* tup = PySequence_GetItem(lst, i);
+    Py_ssize_t nd = PySequence_Size(tup);
+    h->ndims[g][i] = static_cast<uint32_t>(nd);
+    for (Py_ssize_t j = 0; j < nd; ++j) {
+      PyObject* d = PySequence_GetItem(tup, j);
+      h->shapes[g][i].push_back(
+          static_cast<uint32_t>(PyLong_AsUnsignedLong(d)));
+      Py_XDECREF(d);
+    }
+    Py_XDECREF(tup);
+  }
+  h->shape_ptrs[g].clear();
+  for (auto& s : h->shapes[g]) h->shape_ptrs[g].push_back(s.data());
+  *out_size = static_cast<uint32_t>(n);
+  *out_ndim = h->ndims[g].data();
+  *out_data = h->shape_ptrs[g].data();
+}
+
+int InferShapeImpl(void* sym, uint32_t num_args, const char** keys,
+                   const uint32_t* arg_ind_ptr,
+                   const uint32_t* arg_shape_data, uint32_t* in_size,
+                   const uint32_t** in_ndim, const uint32_t*** in_data,
+                   uint32_t* out_size, const uint32_t** out_ndim,
+                   const uint32_t*** out_data, uint32_t* aux_size,
+                   const uint32_t** aux_ndim, const uint32_t*** aux_data,
+                   int* complete, int partial) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  Obj* h = static_cast<Obj*>(sym);
+  PyObject* key_list = StrList(static_cast<int>(num_args), keys);
+  PyObject* shape_list = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    uint32_t lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject* tup = PyTuple_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(tup, j - lo,
+                       PyLong_FromUnsignedLong(arg_shape_data[j]));
+    PyList_SET_ITEM(shape_list, i, tup);
+  }
+  PyObject* r = CallBridge("symbol_infer_shape", "(OOOi)", h->obj, key_list,
+                           shape_list, partial);
+  Py_DECREF(key_list);
+  Py_DECREF(shape_list);
+  if (r == nullptr) return -1;
+  // (complete, arg_shapes, out_shapes, aux_shapes)
+  *complete = PyObject_IsTrue(PyTuple_GET_ITEM(r, 0));
+  SnapshotShapes(h, 0, PyTuple_GET_ITEM(r, 1), in_size, in_ndim, in_data);
+  SnapshotShapes(h, 1, PyTuple_GET_ITEM(r, 2), out_size, out_ndim, out_data);
+  SnapshotShapes(h, 2, PyTuple_GET_ITEM(r, 3), aux_size, aux_ndim, aux_data);
+  Py_DECREF(r);
+  return 0;
+}
+
+// stable snapshot for ListDataIters
+std::mutex g_iters_mu;
+std::vector<std::string> g_iter_names;
+std::vector<const char*> g_iter_ptrs;
+
+}  // namespace
+
+extern "C" {
+
+// ---- NDArray ---------------------------------------------------------------
+
+int MXTPUNDArrayCreate(const uint32_t* shape, uint32_t ndim, int dtype,
+                       int dev_type, int dev_id, NDArrayHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* tup = PyTuple_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(tup, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject* r = CallBridge("nd_create", "(Oiii)", tup, dtype, dev_type,
+                           dev_id);
+  Py_DECREF(tup);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPUNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                                uint64_t nbytes) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("nd_from_bytes", "(Oy#)", Borrow(handle),
+                         static_cast<const char*>(data),
+                         static_cast<Py_ssize_t>(nbytes)));
+}
+
+int MXTPUNDArraySyncCopyToCPU(NDArrayHandle handle, void* data,
+                              uint64_t nbytes) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("nd_to_bytes", "(O)", Borrow(handle));
+  if (r == nullptr) return -1;
+  char* raw = nullptr;
+  Py_ssize_t got = 0;
+  if (PyBytes_AsStringAndSize(r, &raw, &got) != 0) {
+    Py_DECREF(r);
+    SetErrorFromPython();
+    return -1;
+  }
+  if (got != static_cast<Py_ssize_t>(nbytes)) {
+    Py_DECREF(r);
+    MXTPUSetLastError("NDArraySyncCopyToCPU: size mismatch");
+    return -1;
+  }
+  std::memcpy(data, raw, static_cast<size_t>(got));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUNDArrayGetShape(NDArrayHandle handle, uint32_t* out_ndim,
+                         uint32_t* out_shape) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("nd_shape", "(O)", Borrow(handle));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyTuple_Size(r);
+  if (n > MXTPU_MAX_NDIM) {
+    Py_DECREF(r);
+    MXTPUSetLastError("ndim exceeds MXTPU_MAX_NDIM");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i)
+    out_shape[i] = static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, i)));
+  *out_ndim = static_cast<uint32_t>(n);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUNDArrayGetDType(NDArrayHandle handle, int* out_dtype) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("nd_dtype", "(O)", Borrow(handle));
+  if (r == nullptr) return -1;
+  *out_dtype = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUNDArrayWaitAll(void) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("nd_wait_all", "()"));
+}
+
+int MXTPUNDArrayFree(NDArrayHandle handle) { return FreeHandle(handle); }
+
+int MXTPUNDArraySave(const char* fname, int num, NDArrayHandle* handles,
+                     const char** keys) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* names = keys ? StrList(num, keys) : PyList_New(0);
+  PyObject* vals = HandleList(num, handles);
+  int rc = Done(CallBridge("nd_save", "(sOO)", fname, names, vals));
+  Py_DECREF(names);
+  Py_DECREF(vals);
+  return rc;
+}
+
+int MXTPUNDArrayLoad(const char* fname, int cap, NDArrayHandle* out_handles,
+                     const char** out_names, int* out_num, int* out_named) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("nd_load", "(s)", fname);
+  if (r == nullptr) return -1;
+  PyObject* names = PyTuple_GET_ITEM(r, 0);
+  PyObject* arrays = PyTuple_GET_ITEM(r, 1);
+  Py_ssize_t n = PyList_Size(arrays);
+  Py_ssize_t n_names = PyList_Size(names);
+  if (n > cap) {
+    Py_DECREF(r);
+    MXTPUSetLastError("NDArrayLoad: capacity too small");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(arrays, i);
+    Py_INCREF(o);
+    out_handles[i] = Wrap(o);
+    if (n_names == n && out_names != nullptr) {
+      // name storage rides the array handle, living as long as it does
+      Obj* h = static_cast<Obj*>(out_handles[i]);
+      h->scratch = PyUnicode_AsUTF8(PyList_GET_ITEM(names, i));
+      out_names[i] = h->scratch.c_str();
+    }
+  }
+  *out_num = static_cast<int>(n);
+  *out_named = n_names == n && n > 0 ? 1 : 0;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUFuncInvoke(const char* op_name, int n_in, NDArrayHandle* inputs,
+                    int n_param, const char** keys, const char** vals,
+                    int cap, NDArrayHandle* outputs, int* out_num) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* ins = HandleList(n_in, inputs);
+  PyObject* k = StrList(n_param, keys);
+  PyObject* v = StrList(n_param, vals);
+  PyObject* r = CallBridge("func_invoke", "(sOOO)", op_name, ins, k, v);
+  Py_DECREF(ins);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (r == nullptr) return -1;
+  return UnpackHandleList(r, cap, outputs, out_num);
+}
+
+// ---- Symbol ----------------------------------------------------------------
+
+int MXTPUSymbolCreateVariable(const char* name, SymbolHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("symbol_create_variable", "(s)", name);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPUSymbolCreateAtomicSymbol(const char* op_name, int n_param,
+                                  const char** keys, const char** vals,
+                                  SymbolHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* k = StrList(n_param, keys);
+  PyObject* v = StrList(n_param, vals);
+  PyObject* r = CallBridge("symbol_create_atomic", "(sOO)", op_name, k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPUSymbolCompose(SymbolHandle sym, const char* name, int n_args,
+                       const char** keys, SymbolHandle* args) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  Obj* h = static_cast<Obj*>(sym);
+  PyObject* key_list = keys ? StrList(n_args, keys) : Py_None;
+  if (key_list == Py_None) Py_INCREF(Py_None);
+  PyObject* arg_list = HandleList(n_args, args);
+  PyObject* r = CallBridge("symbol_compose", "(OsOO)", h->obj,
+                           name ? name : "", key_list, arg_list);
+  Py_DECREF(key_list);
+  Py_DECREF(arg_list);
+  if (r == nullptr) return -1;
+  // reference semantics: Compose mutates the symbol handle in place
+  Py_DECREF(h->obj);
+  h->obj = r;
+  return 0;
+}
+
+int MXTPUSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("symbol_from_json", "(s)", json);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPUSymbolSaveToJSON(SymbolHandle sym, const char** out_json) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  Obj* h = static_cast<Obj*>(sym);
+  PyObject* r = CallBridge("symbol_to_json", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  const char* c = PyUnicode_AsUTF8(r);
+  h->scratch = c ? c : "";
+  Py_DECREF(r);
+  *out_json = h->scratch.c_str();
+  return 0;
+}
+
+static int ListStrsEntry(const char* fn, SymbolHandle sym, int* out_size,
+                         const char*** out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  Obj* h = static_cast<Obj*>(sym);
+  PyObject* r = CallBridge(fn, "(O)", h->obj);
+  if (r == nullptr) return -1;
+  return SnapshotStrs(h, r, out_size, out);
+}
+
+int MXTPUSymbolListArguments(SymbolHandle sym, int* out_size,
+                             const char*** out) {
+  return ListStrsEntry("symbol_list_arguments", sym, out_size, out);
+}
+
+int MXTPUSymbolListOutputs(SymbolHandle sym, int* out_size,
+                           const char*** out) {
+  return ListStrsEntry("symbol_list_outputs", sym, out_size, out);
+}
+
+int MXTPUSymbolListAuxiliaryStates(SymbolHandle sym, int* out_size,
+                                   const char*** out) {
+  return ListStrsEntry("symbol_list_aux", sym, out_size, out);
+}
+
+static int WrapEntry1(const char* fn, void* in, void** out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge(fn, "(O)", Borrow(in));
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPUSymbolCopy(SymbolHandle sym, SymbolHandle* out) {
+  return WrapEntry1("symbol_copy", sym, out);
+}
+
+int MXTPUSymbolGetInternals(SymbolHandle sym, SymbolHandle* out) {
+  return WrapEntry1("symbol_get_internals", sym, out);
+}
+
+int MXTPUSymbolGetOutput(SymbolHandle sym, uint32_t index,
+                         SymbolHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("symbol_get_output", "(OI)", Borrow(sym), index);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPUSymbolGetAttr(SymbolHandle sym, const char* key, const char** out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  Obj* h = static_cast<Obj*>(sym);
+  PyObject* r = CallBridge("symbol_get_attr", "(Os)", h->obj, key);
+  if (r == nullptr) return -1;
+  const char* c = PyUnicode_AsUTF8(r);
+  h->scratch = c ? c : "";
+  Py_DECREF(r);
+  *out = h->scratch.c_str();
+  return 0;
+}
+
+int MXTPUSymbolSetAttr(SymbolHandle sym, const char* key, const char* value) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("symbol_set_attr", "(Oss)", Borrow(sym), key,
+                         value));
+}
+
+int MXTPUSymbolInferShape(SymbolHandle sym, uint32_t num_args,
+                          const char** keys, const uint32_t* arg_ind_ptr,
+                          const uint32_t* arg_shape_data, uint32_t* in_size,
+                          const uint32_t** in_ndim, const uint32_t*** in_data,
+                          uint32_t* out_size, const uint32_t** out_ndim,
+                          const uint32_t*** out_data, uint32_t* aux_size,
+                          const uint32_t** aux_ndim,
+                          const uint32_t*** aux_data, int* complete) {
+  return InferShapeImpl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                        in_size, in_ndim, in_data, out_size, out_ndim,
+                        out_data, aux_size, aux_ndim, aux_data, complete, 0);
+}
+
+int MXTPUSymbolInferShapePartial(
+    SymbolHandle sym, uint32_t num_args, const char** keys,
+    const uint32_t* arg_ind_ptr, const uint32_t* arg_shape_data,
+    uint32_t* in_size, const uint32_t** in_ndim, const uint32_t*** in_data,
+    uint32_t* out_size, const uint32_t** out_ndim, const uint32_t*** out_data,
+    uint32_t* aux_size, const uint32_t** aux_ndim, const uint32_t*** aux_data,
+    int* complete) {
+  return InferShapeImpl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                        in_size, in_ndim, in_data, out_size, out_ndim,
+                        out_data, aux_size, aux_ndim, aux_data, complete, 1);
+}
+
+int MXTPUSymbolFree(SymbolHandle sym) { return FreeHandle(sym); }
+
+// ---- Executor --------------------------------------------------------------
+
+int MXTPUExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                      uint32_t n_args, NDArrayHandle* args,
+                      NDArrayHandle* arg_grads, const uint32_t* grad_reqs,
+                      uint32_t n_aux, NDArrayHandle* aux,
+                      ExecutorHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* a = HandleList(n_args, args);
+  PyObject* g = HandleList(n_args, arg_grads);
+  PyObject* reqs = PyList_New(n_args);
+  for (uint32_t i = 0; i < n_args; ++i)
+    PyList_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(
+                                 grad_reqs ? grad_reqs[i] : 1));
+  PyObject* x = HandleList(n_aux, aux);
+  PyObject* r = CallBridge("executor_bind", "(OiiOOOO)", Borrow(sym),
+                           dev_type, dev_id, a, g, reqs, x);
+  Py_DECREF(a);
+  Py_DECREF(g);
+  Py_DECREF(reqs);
+  Py_DECREF(x);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPUExecutorForward(ExecutorHandle handle, int is_train) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("executor_forward", "(Oi)", Borrow(handle),
+                         is_train));
+}
+
+int MXTPUExecutorBackward(ExecutorHandle handle, uint32_t n,
+                          NDArrayHandle* head_grads) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* hg = HandleList(n, head_grads);
+  int rc = Done(CallBridge("executor_backward", "(OO)", Borrow(handle), hg));
+  Py_DECREF(hg);
+  return rc;
+}
+
+int MXTPUExecutorOutputs(ExecutorHandle handle, int cap, NDArrayHandle* out,
+                         int* out_num) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("executor_outputs", "(O)", Borrow(handle));
+  if (r == nullptr) return -1;
+  return UnpackHandleList(r, cap, out, out_num);
+}
+
+int MXTPUExecutorFree(ExecutorHandle handle) { return FreeHandle(handle); }
+
+// ---- KVStore ---------------------------------------------------------------
+
+int MXTPUKVStoreCreate(const char* type, KVStoreHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("kvstore_create", "(s)", type);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+static int KVKeysVals(const char* fn, KVStoreHandle handle, int num,
+                      const int* keys, NDArrayHandle* vals, int priority,
+                      int with_priority) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* k = IntList(num, keys);
+  PyObject* v = HandleList(num, vals);
+  PyObject* r = with_priority
+                    ? CallBridge(fn, "(OOOi)", Borrow(handle), k, v, priority)
+                    : CallBridge(fn, "(OOO)", Borrow(handle), k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  return Done(r);
+}
+
+int MXTPUKVStoreInit(KVStoreHandle handle, int num, const int* keys,
+                     NDArrayHandle* vals) {
+  return KVKeysVals("kvstore_init", handle, num, keys, vals, 0, 0);
+}
+
+int MXTPUKVStorePush(KVStoreHandle handle, int num, const int* keys,
+                     NDArrayHandle* vals, int priority) {
+  return KVKeysVals("kvstore_push", handle, num, keys, vals, priority, 1);
+}
+
+int MXTPUKVStorePull(KVStoreHandle handle, int num, const int* keys,
+                     NDArrayHandle* outs, int priority) {
+  return KVKeysVals("kvstore_pull", handle, num, keys, outs, priority, 1);
+}
+
+int MXTPUKVStoreSetOptimizer(KVStoreHandle handle, const char* name,
+                             int n_param, const char** keys,
+                             const char** vals) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* k = StrList(n_param, keys);
+  PyObject* v = StrList(n_param, vals);
+  int rc = Done(CallBridge("kvstore_set_optimizer", "(OsOO)", Borrow(handle),
+                           name, k, v));
+  Py_DECREF(k);
+  Py_DECREF(v);
+  return rc;
+}
+
+int MXTPUKVStoreGetType(KVStoreHandle handle, const char** out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  Obj* h = static_cast<Obj*>(handle);
+  PyObject* r = CallBridge("kvstore_type", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  const char* c = PyUnicode_AsUTF8(r);
+  h->scratch = c ? c : "";
+  Py_DECREF(r);
+  *out = h->scratch.c_str();
+  return 0;
+}
+
+static int IntEntry1(const char* fn, void* handle, int* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge(fn, "(O)", Borrow(handle));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUKVStoreGetRank(KVStoreHandle handle, int* out) {
+  return IntEntry1("kvstore_rank", handle, out);
+}
+
+int MXTPUKVStoreGetGroupSize(KVStoreHandle handle, int* out) {
+  return IntEntry1("kvstore_num_workers", handle, out);
+}
+
+int MXTPUKVStoreBarrier(KVStoreHandle handle) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("kvstore_barrier", "(O)", Borrow(handle)));
+}
+
+int MXTPUKVStoreFree(KVStoreHandle handle) { return FreeHandle(handle); }
+
+// ---- DataIter --------------------------------------------------------------
+
+int MXTPUListDataIters(int* out_size, const char*** out_names) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("list_data_iters", "()");
+  if (r == nullptr) return -1;
+  std::lock_guard<std::mutex> lk(g_iters_mu);
+  g_iter_names.clear();
+  Py_ssize_t n = PySequence_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* it = PySequence_GetItem(r, i);
+    const char* c = it ? PyUnicode_AsUTF8(it) : nullptr;
+    g_iter_names.emplace_back(c ? c : "");
+    Py_XDECREF(it);
+  }
+  Py_DECREF(r);
+  g_iter_ptrs.clear();
+  for (const auto& s : g_iter_names) g_iter_ptrs.push_back(s.c_str());
+  *out_size = static_cast<int>(g_iter_ptrs.size());
+  *out_names = g_iter_ptrs.data();
+  return 0;
+}
+
+int MXTPUDataIterCreate(const char* name, int n_param, const char** keys,
+                        const char** vals, DataIterHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* k = StrList(n_param, keys);
+  PyObject* v = StrList(n_param, vals);
+  PyObject* r = CallBridge("dataiter_create", "(sOO)", name, k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPUDataIterNext(DataIterHandle handle, int* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("dataiter_next", "(O)", Borrow(handle));
+  if (r == nullptr) return -1;
+  *out = PyObject_IsTrue(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUDataIterBeforeFirst(DataIterHandle handle) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("dataiter_before_first", "(O)", Borrow(handle)));
+}
+
+int MXTPUDataIterGetData(DataIterHandle handle, NDArrayHandle* out) {
+  return WrapEntry1("dataiter_data", handle, out);
+}
+
+int MXTPUDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out) {
+  return WrapEntry1("dataiter_label", handle, out);
+}
+
+int MXTPUDataIterGetPadNum(DataIterHandle handle, int* out) {
+  return IntEntry1("dataiter_pad", handle, out);
+}
+
+int MXTPUDataIterFree(DataIterHandle handle) { return FreeHandle(handle); }
+
+// ---- misc ------------------------------------------------------------------
+
+int MXTPURandomSeed(int seed) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("random_seed", "(i)", seed));
+}
+
+}  // extern "C"

@@ -16,3 +16,26 @@ import jax
 # The env var alone can be overridden by accelerator plugins (axon);
 # the config update is authoritative.
 jax.config.update("jax_platforms", "cpu")
+
+
+# -- fast/slow tiers ---------------------------------------------------------
+# Default `pytest tests/` is the fast tier (< 5 min, the reference's
+# unittest bucket).  `--runslow` / RUN_SLOW=1 adds the example smokes and
+# multi-process dist tests (the nightly bucket, tests/nightly/test_all.sh
+# analog).
+import pytest
+
+
+def pytest_addoption(parser):
+    parser.addoption("--runslow", action="store_true", default=False,
+                     help="run tests marked slow (nightly tier)")
+
+
+def pytest_collection_modifyitems(config, items):
+    run_slow = os.environ.get("RUN_SLOW", "").lower() not in ("", "0", "false")
+    if config.getoption("--runslow") or run_slow:
+        return
+    skip_slow = pytest.mark.skip(reason="slow tier: use --runslow or RUN_SLOW=1")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip_slow)

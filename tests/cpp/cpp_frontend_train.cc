@@ -1,0 +1,139 @@
+// End-to-end training through the header-only C++ frontend
+// (include/mxtpu/cpp/mxtpu.hpp) — the second-language-frontend proof:
+// builds LeNet, streams MNIST-format idx data through DataIter, trains
+// with a KVStore-side SGD optimizer, asserts accuracy.  The program
+// never touches Python headers; everything routes through the C ABI
+// (reference cpp-package/example/mlp.cpp role).
+//
+// Usage: cpp_frontend_train <images.idx> <labels.idx> <batch> <epochs>
+
+#include <algorithm>
+#include <cstdio>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "mxtpu/cpp/mxtpu.hpp"
+
+using namespace mxtpu::cpp;
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    std::fprintf(stderr, "usage: %s img.idx lab.idx batch epochs\n",
+                 argv[0]);
+    return 2;
+  }
+  const std::string img = argv[1], lab = argv[2];
+  const int batch = std::atoi(argv[3]);
+  const int epochs = std::atoi(argv[4]);
+
+  try {
+    RandomSeed(7);
+
+    // ---- LeNet ----
+    Symbol data = Symbol::Variable("data");
+    Symbol net = Op("Convolution", {{"kernel", "(3, 3)"},
+                                    {"num_filter", "8"}}, {data}, "conv1");
+    net = Op("Activation", {{"act_type", "relu"}}, {net}, "relu1");
+    net = Op("Pooling", {{"kernel", "(2, 2)"}, {"stride", "(2, 2)"},
+                         {"pool_type", "max"}}, {net}, "pool1");
+    net = Op("Flatten", {}, {net}, "flat");
+    net = Op("FullyConnected", {{"num_hidden", "64"}}, {net}, "fc1");
+    net = Op("Activation", {{"act_type", "relu"}}, {net}, "relu2");
+    net = Op("FullyConnected", {{"num_hidden", "10"}}, {net}, "fc2");
+    net = Op("SoftmaxOutput", {{"normalization", "batch"}}, {net},
+             "softmax");
+
+    // JSON round-trip exercises save/load through the frontend
+    net = Symbol::FromJSON(net.ToJSON());
+
+    auto arg_names = net.ListArguments();
+    auto shapes = net.InferShape(
+        {{"data", {static_cast<uint32_t>(batch), 1, 28, 28}}});
+    if (!shapes.complete || shapes.arg.size() != arg_names.size())
+      throw std::runtime_error("shape inference incomplete");
+
+    // ---- arrays ----
+    std::mt19937 rng(7);
+    std::uniform_real_distribution<float> dist(-0.05f, 0.05f);
+    std::vector<NDArray> args, grads;
+    std::vector<GradReq> reqs;
+    int data_idx = -1, label_idx = -1;
+    for (size_t i = 0; i < arg_names.size(); ++i) {
+      args.emplace_back(shapes.arg[i]);
+      const bool is_data = arg_names[i] == "data";
+      const bool is_label = arg_names[i] == "softmax_label";
+      if (is_data) data_idx = static_cast<int>(i);
+      if (is_label) label_idx = static_cast<int>(i);
+      if (is_data || is_label) {
+        grads.emplace_back();  // none
+        reqs.push_back(GradReq::kNull);
+      } else {
+        uint64_t sz = args.back().Size();
+        std::vector<float> init(sz);
+        for (auto& v : init) v = dist(rng);
+        args.back().SyncCopyFromCPU(init);
+        grads.emplace_back(shapes.arg[i]);
+        reqs.push_back(GradReq::kWrite);
+      }
+    }
+
+    Executor exec(net, args, grads, reqs);
+
+    KVStore kv("local");
+    kv.SetOptimizer("sgd", {{"learning_rate", "0.1"}, {"momentum", "0.9"}});
+    for (size_t i = 0; i < args.size(); ++i)
+      if (reqs[i] == GradReq::kWrite) kv.Init(static_cast<int>(i), args[i]);
+
+    DataIter it("MNISTIter", {{"image", img}, {"label", lab},
+                              {"batch_size", std::to_string(batch)},
+                              {"shuffle", "True"}});
+
+    // ---- train ----
+    for (int e = 0; e < epochs; ++e) {
+      it.Reset();
+      while (it.Next()) {
+        args[data_idx].SyncCopyFromCPU(it.Data().SyncCopyToCPU());
+        args[label_idx].SyncCopyFromCPU(it.Label().SyncCopyToCPU());
+        exec.Forward(true);
+        exec.Backward();
+        for (size_t i = 0; i < args.size(); ++i) {
+          if (reqs[i] != GradReq::kWrite) continue;
+          kv.Push(static_cast<int>(i), grads[i],
+                  -static_cast<int>(i));
+          kv.Pull(static_cast<int>(i), &args[i], -static_cast<int>(i));
+        }
+      }
+    }
+
+    // ---- evaluate ----
+    long correct = 0, total = 0;
+    it.Reset();
+    while (it.Next()) {
+      args[data_idx].SyncCopyFromCPU(it.Data().SyncCopyToCPU());
+      auto labels = it.Label().SyncCopyToCPU();
+      exec.Forward(false);
+      auto probs = exec.Outputs()[0].SyncCopyToCPU();
+      for (int b = 0; b < batch; ++b) {
+        int best = static_cast<int>(
+            std::max_element(probs.begin() + b * 10,
+                             probs.begin() + (b + 1) * 10) -
+            (probs.begin() + b * 10));
+        correct += best == static_cast<int>(labels[b]);
+        ++total;
+      }
+    }
+    double acc = static_cast<double>(correct) / static_cast<double>(total);
+    std::fprintf(stderr, "train accuracy: %.3f (%ld/%ld)\n", acc, correct,
+                 total);
+    if (acc < 0.85) {
+      std::fprintf(stderr, "FAIL accuracy %.3f < 0.85\n", acc);
+      return 1;
+    }
+    std::printf("CPP_TRAIN_OK %.3f\n", acc);
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "FAIL exception: %s\n", e.what());
+    return 1;
+  }
+}

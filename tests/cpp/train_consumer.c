@@ -1,0 +1,279 @@
+/* Pure-C end-to-end training through the mxtpu C ABI — the proof that
+ * a non-Python frontend can build, train and evaluate a model, the role
+ * the reference's C API plays for its R/Scala/Matlab frontends
+ * (reference src/c_api/c_api.cc:956-1110 executor surface;
+ * tests/cpp/ unittest style).
+ *
+ * Builds LeNet with MXTPUSymbolCreateAtomicSymbol + Compose, reads an
+ * MNIST-format idx pair through MXTPUDataIterCreate("MNISTIter"),
+ * binds an executor, and trains with a KVStore("local") carrying a
+ * server-side SGD optimizer: forward / backward / push(grad) /
+ * pull(weight) per batch.  Asserts train accuracy and prints
+ * C_TRAIN_OK.
+ *
+ * Usage: train_consumer <images.idx> <labels.idx> <batch> <epochs>
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu/c_api.h"
+
+#define CHK(call)                                                  \
+  do {                                                             \
+    if ((call) != 0) {                                             \
+      fprintf(stderr, "FAIL %s:%d: %s\n  last_error: %s\n",        \
+              __FILE__, __LINE__, #call, MXTPUGetLastError());     \
+      exit(1);                                                     \
+    }                                                              \
+  } while (0)
+
+#define MAX_ARGS 32
+
+/* CreateAtomicSymbol + positional Compose in one step. */
+static SymbolHandle make_op(const char* op, const char* name,
+                            SymbolHandle* inputs, int n_in,
+                            const char** pk, const char** pv, int np) {
+  SymbolHandle s;
+  CHK(MXTPUSymbolCreateAtomicSymbol(op, np, pk, pv, &s));
+  CHK(MXTPUSymbolCompose(s, name, n_in, NULL, inputs));
+  return s;
+}
+
+static float frand(void) { return (float)rand() / (float)RAND_MAX; }
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s img.idx lab.idx batch epochs\n", argv[0]);
+    return 2;
+  }
+  const char* img_path = argv[1];
+  const char* lab_path = argv[2];
+  int batch = atoi(argv[3]);
+  int epochs = atoi(argv[4]);
+  srand(7);
+  CHK(MXTPURandomSeed(7));
+
+  /* ---- LeNet-style symbol ---- */
+  SymbolHandle data, net;
+  CHK(MXTPUSymbolCreateVariable("data", &data));
+  {
+    const char* k[] = {"kernel", "num_filter"};
+    const char* v[] = {"(3, 3)", "8"};
+    net = make_op("Convolution", "conv1", &data, 1, k, v, 2);
+  }
+  {
+    const char* k[] = {"act_type"};
+    const char* v[] = {"relu"};
+    net = make_op("Activation", "relu1", &net, 1, k, v, 1);
+  }
+  {
+    const char* k[] = {"kernel", "stride", "pool_type"};
+    const char* v[] = {"(2, 2)", "(2, 2)", "max"};
+    net = make_op("Pooling", "pool1", &net, 1, k, v, 3);
+  }
+  net = make_op("Flatten", "flat", &net, 1, NULL, NULL, 0);
+  {
+    const char* k[] = {"num_hidden"};
+    const char* v[] = {"64"};
+    net = make_op("FullyConnected", "fc1", &net, 1, k, v, 1);
+  }
+  {
+    const char* k[] = {"act_type"};
+    const char* v[] = {"relu"};
+    net = make_op("Activation", "relu2", &net, 1, k, v, 1);
+  }
+  {
+    const char* k[] = {"num_hidden"};
+    const char* v[] = {"10"};
+    net = make_op("FullyConnected", "fc2", &net, 1, k, v, 1);
+  }
+  {
+    /* batch normalization of the loss grad keeps SGD step size
+     * batch-size independent (reference softmax_output-inl.h) */
+    const char* k[] = {"normalization"};
+    const char* v[] = {"batch"};
+    net = make_op("SoftmaxOutput", "softmax", &net, 1, k, v, 1);
+  }
+
+  /* round-trip the graph through JSON (MXSymbolCreateFromJSON path) */
+  const char* json;
+  CHK(MXTPUSymbolSaveToJSON(net, &json));
+  SymbolHandle net2;
+  CHK(MXTPUSymbolCreateFromJSON(json, &net2));
+  CHK(MXTPUSymbolFree(net));
+  net = net2;
+
+  int n_args;
+  const char** arg_names;
+  CHK(MXTPUSymbolListArguments(net, &n_args, &arg_names));
+  if (n_args > MAX_ARGS) { fprintf(stderr, "too many args\n"); return 1; }
+
+  /* ---- shapes ---- */
+  uint32_t dshape[] = {(uint32_t)batch, 1, 28, 28};
+  const char* skeys[] = {"data"};
+  uint32_t indptr[] = {0, 4};
+  uint32_t in_size, out_size, aux_size;
+  const uint32_t *in_ndim, *out_ndim, *aux_ndim;
+  const uint32_t **in_data, **out_data, **aux_data;
+  int complete;
+  CHK(MXTPUSymbolInferShape(net, 1, skeys, indptr, dshape, &in_size,
+                            &in_ndim, &in_data, &out_size, &out_ndim,
+                            &out_data, &aux_size, &aux_ndim, &aux_data,
+                            &complete));
+  if (!complete || (int)in_size != n_args) {
+    fprintf(stderr, "FAIL infer_shape: complete=%d in_size=%u n_args=%d\n",
+            complete, in_size, n_args);
+    return 1;
+  }
+
+  /* ---- arg + grad arrays; Xavier-ish C-side init ---- */
+  NDArrayHandle args[MAX_ARGS], grads[MAX_ARGS];
+  uint32_t reqs[MAX_ARGS];
+  uint64_t sizes[MAX_ARGS];
+  int is_param[MAX_ARGS];
+  for (int i = 0; i < n_args; ++i) {
+    uint64_t sz = 1;
+    for (uint32_t d = 0; d < in_ndim[i]; ++d) sz *= in_data[i][d];
+    sizes[i] = sz;
+    CHK(MXTPUNDArrayCreate(in_data[i], in_ndim[i], 0, 1, 0, &args[i]));
+    is_param[i] = strcmp(arg_names[i], "data") != 0 &&
+                  strcmp(arg_names[i], "softmax_label") != 0;
+    if (is_param[i]) {
+      float* buf = (float*)malloc(sz * 4);
+      for (uint64_t j = 0; j < sz; ++j)
+        buf[j] = (frand() * 2.f - 1.f) * 0.05f;
+      CHK(MXTPUNDArraySyncCopyFromCPU(args[i], buf, sz * 4));
+      free(buf);
+      CHK(MXTPUNDArrayCreate(in_data[i], in_ndim[i], 0, 1, 0, &grads[i]));
+      reqs[i] = 1;
+    } else {
+      grads[i] = NULL;
+      reqs[i] = 0;
+    }
+  }
+
+  /* ---- executor ---- */
+  ExecutorHandle exec;
+  CHK(MXTPUExecutorBind(net, 1, 0, (uint32_t)n_args, args, grads, reqs, 0,
+                        NULL, &exec));
+
+  /* ---- kvstore with server-side SGD ---- */
+  KVStoreHandle kv;
+  CHK(MXTPUKVStoreCreate("local", &kv));
+  {
+    const char* k[] = {"learning_rate", "momentum"};
+    const char* v[] = {"0.1", "0.9"};
+    CHK(MXTPUKVStoreSetOptimizer(kv, "sgd", 2, k, v));
+  }
+  for (int i = 0; i < n_args; ++i)
+    if (is_param[i]) CHK(MXTPUKVStoreInit(kv, 1, &i, &args[i]));
+
+  /* ---- data ---- */
+  DataIterHandle it;
+  {
+    char bs[16];
+    snprintf(bs, sizeof bs, "%d", batch);
+    const char* k[] = {"image", "label", "batch_size", "shuffle"};
+    const char* v[] = {img_path, lab_path, bs, "True"};
+    CHK(MXTPUDataIterCreate("MNISTIter", 4, k, v, &it));
+  }
+
+  int data_idx = -1, label_idx = -1;
+  for (int i = 0; i < n_args; ++i) {
+    if (strcmp(arg_names[i], "data") == 0) data_idx = i;
+    if (strcmp(arg_names[i], "softmax_label") == 0) label_idx = i;
+  }
+  if (data_idx < 0 || label_idx < 0) { fprintf(stderr, "no data arg\n"); return 1; }
+
+  float* dbuf = (float*)malloc(sizes[data_idx] * 4);
+  float* lbuf = (float*)malloc(sizes[label_idx] * 4);
+  float* obuf = (float*)malloc((uint64_t)batch * 10 * 4);
+
+  /* ---- train ---- */
+  for (int e = 0; e < epochs; ++e) {
+    CHK(MXTPUDataIterBeforeFirst(it));
+    for (;;) {
+      int more;
+      CHK(MXTPUDataIterNext(it, &more));
+      if (!more) break;
+      NDArrayHandle bd, bl;
+      CHK(MXTPUDataIterGetData(it, &bd));
+      CHK(MXTPUDataIterGetLabel(it, &bl));
+      CHK(MXTPUNDArraySyncCopyToCPU(bd, dbuf, sizes[data_idx] * 4));
+      CHK(MXTPUNDArraySyncCopyToCPU(bl, lbuf, sizes[label_idx] * 4));
+      CHK(MXTPUNDArraySyncCopyFromCPU(args[data_idx], dbuf,
+                                      sizes[data_idx] * 4));
+      CHK(MXTPUNDArraySyncCopyFromCPU(args[label_idx], lbuf,
+                                      sizes[label_idx] * 4));
+      CHK(MXTPUNDArrayFree(bd));
+      CHK(MXTPUNDArrayFree(bl));
+      CHK(MXTPUExecutorForward(exec, 1));
+      CHK(MXTPUExecutorBackward(exec, 0, NULL));
+      for (int i = 0; i < n_args; ++i) {
+        if (!is_param[i]) continue;
+        CHK(MXTPUKVStorePush(kv, 1, &i, &grads[i], -i));
+        CHK(MXTPUKVStorePull(kv, 1, &i, &args[i], -i));
+      }
+    }
+  }
+
+  /* ---- evaluate on the training set ---- */
+  long correct = 0, total = 0;
+  CHK(MXTPUDataIterBeforeFirst(it));
+  for (;;) {
+    int more;
+    CHK(MXTPUDataIterNext(it, &more));
+    if (!more) break;
+    NDArrayHandle bd, bl;
+    CHK(MXTPUDataIterGetData(it, &bd));
+    CHK(MXTPUDataIterGetLabel(it, &bl));
+    CHK(MXTPUNDArraySyncCopyToCPU(bd, dbuf, sizes[data_idx] * 4));
+    CHK(MXTPUNDArraySyncCopyToCPU(bl, lbuf, sizes[label_idx] * 4));
+    CHK(MXTPUNDArraySyncCopyFromCPU(args[data_idx], dbuf,
+                                    sizes[data_idx] * 4));
+    CHK(MXTPUNDArrayFree(bd));
+    CHK(MXTPUNDArrayFree(bl));
+    CHK(MXTPUExecutorForward(exec, 0));
+    NDArrayHandle outs[4];
+    int n_out;
+    CHK(MXTPUExecutorOutputs(exec, 4, outs, &n_out));
+    uint32_t ondim, oshape[MXTPU_MAX_NDIM];
+    CHK(MXTPUNDArrayGetShape(outs[0], &ondim, oshape));
+    if (ondim != 2 || (int)oshape[0] != batch || oshape[1] != 10) {
+      fprintf(stderr, "bad output shape\n");
+      return 1;
+    }
+    CHK(MXTPUNDArraySyncCopyToCPU(outs[0], obuf,
+                                  (uint64_t)batch * 10 * 4));
+    for (int n = 0; n < n_out; ++n) CHK(MXTPUNDArrayFree(outs[n]));
+    for (int b = 0; b < batch; ++b) {
+      int best = 0;
+      for (int c = 1; c < 10; ++c)
+        if (obuf[b * 10 + c] > obuf[b * 10 + best]) best = c;
+      correct += best == (int)lbuf[b];
+      total += 1;
+    }
+  }
+  double acc = (double)correct / (double)total;
+  fprintf(stderr, "train accuracy: %.3f (%ld/%ld)\n", acc, correct, total);
+  if (acc < 0.85) {
+    fprintf(stderr, "FAIL accuracy %.3f < 0.85\n", acc);
+    return 1;
+  }
+
+  free(dbuf);
+  free(lbuf);
+  free(obuf);
+  CHK(MXTPUDataIterFree(it));
+  CHK(MXTPUKVStoreFree(kv));
+  CHK(MXTPUExecutorFree(exec));
+  CHK(MXTPUSymbolFree(net));
+  for (int i = 0; i < n_args; ++i) {
+    CHK(MXTPUNDArrayFree(args[i]));
+    if (grads[i]) CHK(MXTPUNDArrayFree(grads[i]));
+  }
+  printf("C_TRAIN_OK %.3f\n", acc);
+  return 0;
+}

@@ -8,6 +8,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import mxnet_tpu as mx
 from mxnet_tpu.rnn_io import BucketSentenceIter, build_vocab, encode_sentences
 

@@ -162,6 +162,7 @@ def test_ps_barrier_and_errors():
         _stop(servers, [c1, c2])
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("n_servers", [1, 2])
 def test_dist_async_kvstore_via_launcher(n_servers):
     """End-to-end: tools/launch.py -s N -n 2 with kv.create('dist_async');
@@ -232,6 +233,7 @@ def test_ps_crash_vs_clean_close_dead_nodes():
         _stop(servers, [c0])
 
 
+@pytest.mark.slow
 def test_elastic_worker_restart(tmp_path):
     """A worker crash is absorbed: tools/launch.py --max-restarts 1
     respawns the rank with MXTPU_IS_RECOVERY; the PS keeps state, the

@@ -96,6 +96,7 @@ def test_parse_log(tmp_path):
     assert csv.splitlines()[0].startswith("epoch,")
 
 
+@pytest.mark.slow
 def test_launch_local_spawns_ranked_processes(tmp_path):
     out = tmp_path / "ranks"
     out.mkdir()
