@@ -234,8 +234,13 @@ def symbol_infer_shape(sym, keys, shapes, partial):
     arg_shapes, out_shapes, aux_shapes = fn(**kwargs)
     if arg_shapes is None:
         return False, [], [], []
+    # partial mode reports unknown shapes as None entries; the C contract
+    # is *complete == 0 whenever inference is underdetermined
+    complete = not any(
+        s is None
+        for s in list(arg_shapes) + list(out_shapes) + list(aux_shapes))
     clean = lambda lst: [tuple(int(d) for d in (s or ())) for s in lst]
-    return True, clean(arg_shapes), clean(out_shapes), clean(aux_shapes)
+    return complete, clean(arg_shapes), clean(out_shapes), clean(aux_shapes)
 
 
 # -- Executor (MXExecutorBind/Forward/Backward/Outputs analogs) -------------

@@ -16,8 +16,6 @@ parameters stacked on a leading axis sharded over ``pp``.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -28,13 +26,42 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 __all__ = ["pipeline_apply", "PipelineModule"]
 
 
-@functools.lru_cache(maxsize=64)
-def _build_pipeline_run(stage_fn, mesh: Mesh, axis: str):
-    """Cached compiled pipeline program per (stage_fn, mesh, axis) —
-    jax.jit caches on function identity, so the shard_map must be built
-    once per config or every call recompiles."""
-    n_stages = mesh.shape[axis]
+# (stage_fn, mesh, axis, flat specs, treedef, feed/out specs) -> jitted run.
+# jax.jit caches on function identity, so the shard_map must be built once
+# per config or every call recompiles; specs form pytrees (unhashable by
+# lru_cache), hence the explicit dict.
+_RUN_CACHE: dict = {}
+
+
+def _build_pipeline_run(stage_fn, mesh: Mesh, axis: str, param_specs=None,
+                        feed_spec=None, out_spec=None):
+    """Compiled pipeline program, optionally composed with other mesh
+    axes: ``param_specs`` (pytree of PartitionSpec, leading dim = stage
+    axis) lets stage weights shard over e.g. ``tp``; ``feed_spec`` /
+    ``out_spec`` shard the microbatch feed (e.g. batch over ``dp``).
+    The stage_fn is then free to use explicit collectives
+    (``lax.psum(..., 'tp')``) — megatron-inside-GPipe composition."""
     rep = PartitionSpec()
+    if feed_spec is None:
+        feed_spec = rep
+    if out_spec is None:
+        out_spec = feed_spec
+    if param_specs is None:
+        p_spec = None
+        key_specs = None
+    else:
+        flat, treedef = jax.tree_util.tree_flatten(param_specs)
+        p_spec = param_specs
+        key_specs = (tuple(flat), treedef)
+    key = (stage_fn, mesh, axis, key_specs, feed_spec, out_spec)
+    if key in _RUN_CACHE:
+        return _RUN_CACHE[key]
+    # bounded like the lru_cache it replaced: fresh stage_fn lambdas at
+    # call sites would otherwise pin compiled programs forever
+    while len(_RUN_CACHE) >= 64:
+        _RUN_CACHE.pop(next(iter(_RUN_CACHE)))
+
+    n_stages = mesh.shape[axis]
 
     def shard_fn(params, feed_local):
         # params: this device's stage slice, leading dim 1
@@ -64,16 +91,22 @@ def _build_pipeline_run(stage_fn, mesh: Mesh, axis: str):
 
     @jax.jit
     def run(stacked_params, feed):
-        p_spec = jax.tree_util.tree_map(lambda _: PartitionSpec(axis),
-                                        stacked_params)
-        return shard_map(shard_fn, mesh=mesh, in_specs=(p_spec, rep),
-                         out_specs=rep, check_vma=False)(stacked_params, feed)
+        if p_spec is None:
+            spec = jax.tree_util.tree_map(lambda _: PartitionSpec(axis),
+                                          stacked_params)
+        else:
+            spec = p_spec
+        return shard_map(shard_fn, mesh=mesh, in_specs=(spec, feed_spec),
+                         out_specs=out_spec, check_vma=False)(stacked_params,
+                                                              feed)
 
+    _RUN_CACHE[key] = run
     return run
 
 
 def pipeline_apply(stage_fn, stacked_params, x, n_microbatches, mesh: Mesh,
-                   axis: str = "pp"):
+                   axis: str = "pp", param_specs=None, feed_spec=None,
+                   out_spec=None):
     """Run ``x`` through ``n_stages`` copies of ``stage_fn`` as a pipeline.
 
     Parameters
@@ -94,7 +127,8 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatches, mesh: Mesh,
     pad = jnp.zeros((n_stages - 1,) + xs.shape[1:], xs.dtype)
     feed = jnp.concatenate([xs, pad], axis=0)  # one injection per tick
 
-    run = _build_pipeline_run(stage_fn, mesh, axis)
+    run = _build_pipeline_run(stage_fn, mesh, axis, param_specs, feed_spec,
+                              out_spec)
     outs = run(stacked_params, feed)
     return outs.reshape((B,) + x.shape[1:])
 
@@ -107,24 +141,36 @@ class PipelineModule:
     """
 
     def __init__(self, stage_fn, stacked_params, mesh, axis="pp",
-                 n_microbatches=4):
+                 n_microbatches=4, param_specs=None, feed_spec=None,
+                 out_spec=None):
         self.stage_fn = stage_fn
         self.mesh = mesh
         self.axis = axis
         self.n_microbatches = n_microbatches
+        self.param_specs = param_specs
+        self.feed_spec = feed_spec
+        self.out_spec = out_spec
         self._steps = {}               # (loss_fn id) -> jitted update
-        spec = jax.tree_util.tree_map(
-            lambda _: NamedSharding(mesh, PartitionSpec(axis)), stacked_params)
+        if param_specs is None:
+            spec = jax.tree_util.tree_map(
+                lambda _: NamedSharding(mesh, PartitionSpec(axis)),
+                stacked_params)
+        else:
+            spec = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), param_specs)
         self.params = jax.device_put(stacked_params, spec)
 
     def forward(self, x):
         return pipeline_apply(self.stage_fn, self.params, x,
-                              self.n_microbatches, self.mesh, self.axis)
+                              self.n_microbatches, self.mesh, self.axis,
+                              self.param_specs, self.feed_spec, self.out_spec)
 
     def _make_objective(self, loss_fn, x):
         def objective(params):
             out = pipeline_apply(self.stage_fn, params, x,
-                                 self.n_microbatches, self.mesh, self.axis)
+                                 self.n_microbatches, self.mesh, self.axis,
+                                 self.param_specs, self.feed_spec,
+                                 self.out_spec)
             return loss_fn(out)
 
         return objective
