@@ -147,7 +147,26 @@ def main():
         "stem": stem,
         "platform": "tpu" if on_tpu else jax.devices()[0].platform,
     }
+    result.update(_mfu_fields(net, {"data": (1,) + data_shape[1:]},
+                              batch, n_iter, dt, n_chips))
     print(json.dumps(result))
+
+
+def _mfu_fields(net, unit_input_shapes, batch, n_iter, dt, n_chips):
+    """Model-FLOPs-utilization fields: analytic fwd FLOPs x3 for the
+    train step (fwd + ~2x bwd) against the chip's bf16 peak."""
+    from mxnet_tpu.flops import count_flops, peak_flops_per_chip
+
+    fwd = count_flops(net, **unit_input_shapes)
+    step_flops = 3 * fwd * batch
+    achieved = step_flops * n_iter / dt
+    peak = peak_flops_per_chip()
+    fields = {"fwd_gflops_per_sample": round(fwd / 1e9, 3),
+              "model_tflops_per_sec": round(achieved / 1e12, 2)}
+    if peak:
+        fields["mfu"] = round(achieved / (peak * n_chips), 4)
+        fields["peak_tflops_per_chip"] = peak / 1e12
+    return fields
 
 
 def _timed_steps(jax, trainer, placed, n_warmup, n_iter):
@@ -206,7 +225,7 @@ def bench_gpt(jax, np, mx, on_tpu, n_chips):
     dt = _timed_steps(jax, trainer, placed, n_warmup, n_iter)
 
     tokens_per_sec = batch * seq_len * n_iter / dt / n_chips
-    print(json.dumps({
+    result = {
         "metric": "gpt_train_throughput",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
@@ -214,7 +233,10 @@ def bench_gpt(jax, np, mx, on_tpu, n_chips):
         "batch": batch, "seq_len": seq_len, "d_model": d_model,
         "n_layers": n_layers, "dtype": dtype,
         "platform": "tpu" if on_tpu else jax.devices()[0].platform,
-    }))
+    }
+    result.update(_mfu_fields(net, {"data": (1, seq_len)},
+                              batch, n_iter, dt, n_chips))
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

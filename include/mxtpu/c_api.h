@@ -47,6 +47,15 @@ VarHandle MXTPUEngineNewVar(EngineHandle engine);
 void MXTPUEnginePush(EngineHandle engine, MXTPUOpCallback fn, void* payload,
                      VarHandle* const_vars, int n_const,
                      VarHandle* mutable_vars, int n_mutable, int prop);
+/* As MXTPUEnginePush with a scheduling priority: among READY ops in a
+ * worker lane, larger priority dispatches sooner (FIFO within a level) —
+ * the reference's threaded_engine_pooled priority queue, which makes
+ * kvstore priority=-key order gradient comm the way the next forward
+ * consumes weights (python/mxnet/model.py:87-97). */
+void MXTPUEnginePushPriority(EngineHandle engine, MXTPUOpCallback fn,
+                             void* payload, VarHandle* const_vars,
+                             int n_const, VarHandle* mutable_vars,
+                             int n_mutable, int prop, int priority);
 void MXTPUEngineWaitForAll(EngineHandle engine);
 void MXTPUEngineWaitForVar(EngineHandle engine, VarHandle var);
 int64_t MXTPUEnginePending(EngineHandle engine);

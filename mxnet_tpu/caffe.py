@@ -480,6 +480,19 @@ def load_caffemodel_params(prototxt_text: str, caffemodel: bytes):
     net = parse_prototxt(prototxt_text)
     layers = _as_list(net.get("layer")) or _as_list(net.get("layers"))
     ltypes = {str(l.get("name", "")): str(l.get("type", "")) for l in layers}
+    # V1 text prototxts write enum-style type names (type: CONVOLUTION);
+    # normalize the weight-bearing ones so their blobs are not silently
+    # routed to the generic {name}_blob{i} fallback (which convert_model
+    # then drops).  Weight-less enum names (RELU, POOLING, ...) and
+    # legitimately-uppercase V2 types (ELU) need no mapping — they carry
+    # no blobs to lose.
+    _v1_weighted = {"CONVOLUTION": "Convolution",
+                    "DECONVOLUTION": "Deconvolution",
+                    "INNER_PRODUCT": "InnerProduct", "BN": "BatchNorm",
+                    "BATCHNORM": "BatchNorm", "SCALE": "Scale"}
+    for name, t in list(ltypes.items()):
+        if t in _v1_weighted:
+            ltypes[name] = _v1_weighted[t]
     # map Scale layers back to the BatchNorm they fold into (same order
     # logic as prototxt_to_symbol: Scale directly consuming a BN top)
     bn_for_scale = {}

@@ -3,8 +3,12 @@
 ``rcnn`` — region-proposal detection toolkit (anchors, bbox regression,
 NMS, RPN target assignment, Proposal/ProposalTarget custom ops): the
 capability surface of the reference ``example/rcnn`` helper/rpn stack.
+
+``rcnn_dataset`` — the dataset/eval layer on top: IMDB/PascalVOC image
+databases and VOC mAP evaluation (reference example/rcnn/helper/dataset).
 """
 
 from . import rcnn
+from . import rcnn_dataset
 
-__all__ = ["rcnn"]
+__all__ = ["rcnn", "rcnn_dataset"]

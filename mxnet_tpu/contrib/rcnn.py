@@ -188,8 +188,13 @@ def assign_anchor(feat_shape, gt_boxes, im_info, feat_stride=16,
         argmax = overlaps.argmax(axis=1)
         max_o = overlaps[np.arange(len(inside)), argmax]
         gt_argmax = overlaps.argmax(axis=0)
+        # expand to ALL anchors tied at each gt's max overlap (reference
+        # minibatch.py: np.where(overlaps == gt_max_overlaps)) — ties are
+        # common on a symmetric anchor grid and every one is foreground
+        gt_max = overlaps[gt_argmax, np.arange(overlaps.shape[1])]
+        gt_argmax = np.where(overlaps == gt_max)[0]
         labels[max_o < bg_overlap] = 0
-        labels[gt_argmax] = 1          # best anchor per gt is always fg
+        labels[gt_argmax] = 1          # best anchor(s) per gt always fg
         labels[max_o >= fg_overlap] = 1
     else:
         labels[:] = 0
@@ -235,6 +240,7 @@ class ProposalOp(op_mod.CustomOp):
         self._post = rpn_post_nms_top_n
         self._thresh = nms_thresh
         self._min_size = rpn_min_size
+        self._rng = np.random.RandomState(0)  # pad-sampling RNG
 
     def forward(self, is_train, req, in_data, out_data, aux):
         scores = np.asarray(in_data[0])   # (1, 2A, H, W) softmax probs
@@ -257,13 +263,20 @@ class ProposalOp(op_mod.CustomOp):
         boxes, fg = boxes[order], fg[order]
         keep = nms(np.hstack([boxes, fg[:, None]]), self._thresh)[:self._post]
         boxes, fg = boxes[keep], fg[keep]
-        # fixed-size output: pad by repeating the top roi (reference pads
-        # with random sampling; repetition keeps determinism)
+        # fixed-size output: pad a short set by randomly re-sampling kept
+        # rois (reference proposal.py npr.choice pad) so downstream
+        # ProposalTarget sampling is not biased toward the top roi
         n_out = out_data[0].shape[0]
         if boxes.shape[0] == 0:
             boxes = np.zeros((1, 4))
             fg = np.zeros(1)
-        idx = np.resize(np.arange(boxes.shape[0]), n_out)
+        if boxes.shape[0] >= n_out:
+            idx = np.arange(n_out)
+        else:
+            idx = np.concatenate([
+                np.arange(boxes.shape[0]),
+                self._rng.choice(boxes.shape[0],
+                                 n_out - boxes.shape[0], replace=True)])
         rois = np.hstack([np.zeros((n_out, 1)), boxes[idx]])
         self.assign(out_data[0], req[0], rois.astype(np.float32))
         if len(out_data) > 1:

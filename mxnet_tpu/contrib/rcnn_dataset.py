@@ -1,0 +1,439 @@
+"""Detection dataset layer for the Faster R-CNN toolkit: image
+databases, Pascal VOC loading, and VOC mAP evaluation.
+
+Capability rebuild of the reference's rcnn dataset helpers —
+``/root/reference/example/rcnn/helper/dataset/imdb.py`` (IMDB roidb
+construction / flipping / recall evaluation),
+``pascal_voc.py`` (VOC devkit layout, XML ground truth, results
+writing, eval driver) and ``voc_eval.py`` (per-class AP with the
+07/ integral metrics) — with the repo's conventions: dense numpy
+``gt_overlaps`` instead of scipy sparse matrices, ``.npz`` proposal
+files instead of MATLAB ``.mat`` selective-search blobs, and logging
+instead of prints.  Geometry comes from ``contrib.rcnn``
+(bbox_overlaps).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+from .rcnn import bbox_overlaps
+
+__all__ = ["IMDB", "PascalVOC", "parse_voc_rec", "voc_ap", "voc_eval"]
+
+log = logging.getLogger(__name__)
+
+
+class IMDB:
+    """General image database: an ordered image-set index plus roidb
+    records ``{'boxes', 'gt_classes', 'gt_overlaps', 'flipped'}``
+    (reference imdb.py:13-106)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.classes = []
+        self.image_set_index = []
+        self.config = {}
+
+    @property
+    def num_classes(self):
+        return len(self.classes)
+
+    @property
+    def num_images(self):
+        return len(self.image_set_index)
+
+    def image_path_from_index(self, index):
+        raise NotImplementedError
+
+    def gt_roidb(self):
+        raise NotImplementedError
+
+    def create_roidb_from_box_list(self, box_list, gt_roidb):
+        """Proposal boxes -> roidb records, scoring each box by its best
+        IoU against the ground truth of its class (imdb.py:31-64)."""
+        if len(box_list) != self.num_images:
+            raise ValueError("box_list length must match number of images")
+        roidb = []
+        for i in range(self.num_images):
+            boxes = np.asarray(box_list[i], dtype=np.float64).reshape(-1, 4)
+            overlaps = np.zeros((boxes.shape[0], self.num_classes),
+                                dtype=np.float32)
+            if gt_roidb is not None and gt_roidb[i]["boxes"].size > 0:
+                gt_boxes = gt_roidb[i]["boxes"].astype(np.float64)
+                gt_classes = gt_roidb[i]["gt_classes"]
+                ious = bbox_overlaps(boxes, gt_boxes)
+                argmaxes = ious.argmax(axis=1)
+                maxes = ious.max(axis=1)
+                pos = np.where(maxes > 0)[0]
+                overlaps[pos, gt_classes[argmaxes[pos]]] = maxes[pos]
+            roidb.append({"boxes": boxes,
+                          "gt_classes": np.zeros(boxes.shape[0], np.int32),
+                          "gt_overlaps": overlaps,
+                          "flipped": False})
+        return roidb
+
+    @staticmethod
+    def merge_roidbs(a, b):
+        """Concatenate per-image records (gt + proposals in one roidb)."""
+        if len(a) != len(b):
+            raise ValueError("roidbs must cover the same images")
+        for i in range(len(a)):
+            a[i]["boxes"] = np.vstack((a[i]["boxes"], b[i]["boxes"]))
+            a[i]["gt_classes"] = np.hstack((a[i]["gt_classes"],
+                                            b[i]["gt_classes"]))
+            a[i]["gt_overlaps"] = np.vstack((a[i]["gt_overlaps"],
+                                             b[i]["gt_overlaps"]))
+        return a
+
+    def image_width(self, index):
+        """Image width for flipping; subclasses may override to avoid
+        decoding (VOC reads it from the annotation XML)."""
+        from ..cv import imdecode
+
+        with open(self.image_path_from_index(index), "rb") as f:
+            return imdecode(f.read()).shape[1]
+
+    def append_flipped_images(self, roidb):
+        """Double the roidb with x-mirrored box records; images flip at
+        load time (imdb.py:80-106)."""
+        if self.num_images != len(roidb):
+            raise ValueError("roidb does not cover the image set")
+        widths = [self.image_width(idx) for idx in self.image_set_index]
+        for i in range(len(widths)):
+            boxes = roidb[i]["boxes"].copy()
+            oldx1 = boxes[:, 0].copy()
+            oldx2 = boxes[:, 2].copy()
+            boxes[:, 0] = widths[i] - oldx2 - 1
+            boxes[:, 2] = widths[i] - oldx1 - 1
+            if not (boxes[:, 2] >= boxes[:, 0]).all():
+                raise ValueError("flipped boxes degenerate")
+            roidb.append({"boxes": boxes,
+                          "gt_classes": roidb[i]["gt_classes"],
+                          "gt_overlaps": roidb[i]["gt_overlaps"],
+                          "flipped": True})
+        self.image_set_index = list(self.image_set_index) * 2
+        return roidb
+
+    def evaluate_recall(self, roidb, candidate_boxes=None, thresholds=None,
+                        limit=None):
+        """Proposal recall across IoU thresholds (imdb.py:108-186):
+        greedily matches each gt to its best-covering proposal and
+        reports recall@t plus the average recall."""
+        gt_overlaps = np.zeros(0)
+        num_pos = 0
+        for i in range(len(roidb)):
+            max_gt = roidb[i]["gt_overlaps"].max(axis=1) \
+                if roidb[i]["gt_overlaps"].size else np.zeros(0)
+            gt_inds = np.where((roidb[i]["gt_classes"] > 0)
+                               & (max_gt == 1))[0]
+            gt_boxes = roidb[i]["boxes"][gt_inds]
+            num_pos += len(gt_inds)
+            if candidate_boxes is None:
+                boxes = roidb[i]["boxes"][roidb[i]["gt_classes"] == 0]
+            else:
+                boxes = candidate_boxes[i]
+            if boxes.shape[0] == 0 or gt_boxes.shape[0] == 0:
+                continue
+            if limit is not None:
+                boxes = boxes[:limit]
+            ious = bbox_overlaps(boxes.astype(np.float64),
+                                 gt_boxes.astype(np.float64))
+            covered = np.zeros(gt_boxes.shape[0])
+            for _ in range(gt_boxes.shape[0]):
+                gt_ind = ious.max(axis=0).argmax()
+                box_ind = ious[:, gt_ind].argmax()
+                covered[gt_ind] = ious[box_ind, gt_ind]
+                ious[box_ind, :] = -1
+                ious[:, gt_ind] = -1
+            gt_overlaps = np.hstack((gt_overlaps, covered))
+        if thresholds is None:
+            thresholds = np.arange(0.5, 0.95 + 1e-5, 0.05)
+        recalls = np.array([(gt_overlaps >= t).sum() / max(num_pos, 1)
+                            for t in thresholds])
+        return {"ar": recalls.mean(), "recalls": recalls,
+                "thresholds": np.asarray(thresholds),
+                "gt_overlaps": np.sort(gt_overlaps)}
+
+    def evaluate_detections(self, detections):
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------- VOC eval
+def parse_voc_rec(filename):
+    """Parse one Pascal VOC annotation XML into object dicts
+    (voc_eval.py:10-29)."""
+    objects = []
+    for obj in ET.parse(filename).findall("object"):
+        bbox = obj.find("bndbox")
+        diff = obj.find("difficult")
+        objects.append({
+            "name": obj.find("name").text.strip(),
+            "difficult": int(diff.text) if diff is not None else 0,
+            "bbox": [int(float(bbox.find(t).text))
+                     for t in ("xmin", "ymin", "xmax", "ymax")]})
+    return objects
+
+
+def voc_ap(rec, prec, use_07_metric=False):
+    """Average precision: the 11-point VOC07 metric or the exact
+    precision-envelope integral (voc_eval.py:32-64)."""
+    if use_07_metric:
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            p = prec[rec >= t].max() if (rec >= t).any() else 0.0
+            ap += p / 11.0
+        return ap
+    mrec = np.concatenate(([0.0], rec, [1.0]))
+    mpre = np.concatenate(([0.0], prec, [0.0]))
+    for i in range(mpre.size - 1, 0, -1):
+        mpre[i - 1] = max(mpre[i - 1], mpre[i])
+    idx = np.where(mrec[1:] != mrec[:-1])[0]
+    return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+
+def voc_eval(detpath, annopath, imageset_file, classname, cache_dir,
+             ovthresh=0.5, use_07_metric=False):
+    """Per-class PASCAL VOC evaluation -> (recall, precision, ap)
+    (voc_eval.py:67-176): detections ranked by confidence, greedy IoU
+    matching against non-difficult ground truth, double detections count
+    as false positives."""
+    os.makedirs(cache_dir, exist_ok=True)
+    cache_file = os.path.join(cache_dir, "annotations.pkl")
+    with open(imageset_file) as f:
+        image_ids_all = [x.strip() for x in f if x.strip()]
+
+    if os.path.isfile(cache_file):
+        with open(cache_file, "rb") as f:
+            recs = pickle.load(f)
+    else:
+        recs = {i: parse_voc_rec(annopath.format(i)) for i in image_ids_all}
+        with open(cache_file, "wb") as f:
+            pickle.dump(recs, f)
+
+    class_recs = {}
+    npos = 0
+    for image_id in image_ids_all:
+        objs = [o for o in recs[image_id] if o["name"] == classname]
+        bbox = np.array([o["bbox"] for o in objs]).reshape(-1, 4)
+        difficult = np.array([o["difficult"] for o in objs], bool)
+        npos += int((~difficult).sum())
+        class_recs[image_id] = {"bbox": bbox, "difficult": difficult,
+                                "det": [False] * len(objs)}
+
+    with open(detpath.format(classname)) as f:
+        lines = [x.strip().split(" ") for x in f if x.strip()]
+    image_ids = [x[0] for x in lines]
+    confidence = np.array([float(x[1]) for x in lines])
+    bb_all = np.array([[float(z) for z in x[2:]] for x in lines]) \
+        .reshape(-1, 4)
+
+    order = np.argsort(-confidence)
+    bb_all = bb_all[order]
+    image_ids = [image_ids[i] for i in order]
+
+    nd = len(image_ids)
+    tp, fp = np.zeros(nd), np.zeros(nd)
+    for d in range(nd):
+        rec_d = class_recs[image_ids[d]]
+        bb = bb_all[d]
+        ovmax, jmax = -np.inf, -1
+        gt = rec_d["bbox"]
+        if gt.size:
+            ixmin = np.maximum(gt[:, 0], bb[0])
+            iymin = np.maximum(gt[:, 1], bb[1])
+            ixmax = np.minimum(gt[:, 2], bb[2])
+            iymax = np.minimum(gt[:, 3], bb[3])
+            iw = np.maximum(ixmax - ixmin + 1.0, 0.0)
+            ih = np.maximum(iymax - iymin + 1.0, 0.0)
+            inter = iw * ih
+            union = ((bb[2] - bb[0] + 1.0) * (bb[3] - bb[1] + 1.0)
+                     + (gt[:, 2] - gt[:, 0] + 1.0)
+                     * (gt[:, 3] - gt[:, 1] + 1.0) - inter)
+            ious = inter / union
+            jmax = int(ious.argmax())
+            ovmax = float(ious.max())
+        if ovmax > ovthresh:
+            if not rec_d["difficult"][jmax]:
+                if not rec_d["det"][jmax]:
+                    tp[d] = 1.0
+                    rec_d["det"][jmax] = True
+                else:
+                    fp[d] = 1.0  # double detection
+        else:
+            fp[d] = 1.0
+
+    fp = np.cumsum(fp)
+    tp = np.cumsum(tp)
+    rec = tp / max(npos, 1)
+    prec = tp / np.maximum(tp + fp, np.finfo(np.float64).eps)
+    return rec, prec, voc_ap(rec, prec, use_07_metric)
+
+
+# --------------------------------------------------------------- PascalVOC
+VOC_CLASSES = (
+    "__background__",
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor")
+
+
+class PascalVOC(IMDB):
+    """Pascal VOC image database over the standard VOCdevkit layout
+    (reference pascal_voc.py): XML ground truth, external proposals
+    (``.npz`` with one array per image, replacing the reference's
+    selective-search ``.mat``), results writing and mAP evaluation."""
+
+    def __init__(self, image_set, year, root_path, devkit_path,
+                 classes=VOC_CLASSES):
+        super().__init__("voc_" + year + "_" + image_set)
+        self.image_set = image_set
+        self.year = year
+        self.root_path = root_path
+        self.devkit_path = devkit_path
+        self.data_path = os.path.join(devkit_path, "VOC" + year)
+        self.classes = list(classes)
+        self.config = {"comp_id": "comp4", "use_diff": False,
+                       "min_size": 2}
+        self.image_set_index = self._load_image_set_index()
+
+    @property
+    def cache_path(self):
+        path = os.path.join(self.root_path, "cache")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def _load_image_set_index(self):
+        path = os.path.join(self.data_path, "ImageSets", "Main",
+                            self.image_set + ".txt")
+        with open(path) as f:
+            return [x.strip() for x in f if x.strip()]
+
+    def image_path_from_index(self, index):
+        path = os.path.join(self.data_path, "JPEGImages", index + ".jpg")
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        return path
+
+    def image_width(self, index):
+        """VOC annotations carry the image size — no decode needed."""
+        xml = os.path.join(self.data_path, "Annotations", index + ".xml")
+        size = ET.parse(xml).getroot().find("size")
+        if size is not None:
+            return int(size.find("width").text)
+        return super().image_width(index)
+
+    def gt_roidb(self):
+        cache_file = os.path.join(self.cache_path, self.name + "_gt_roidb.pkl")
+        if os.path.exists(cache_file):
+            with open(cache_file, "rb") as f:
+                return pickle.load(f)
+        roidb = [self._load_annotation(i) for i in self.image_set_index]
+        with open(cache_file, "wb") as f:
+            pickle.dump(roidb, f)
+        return roidb
+
+    def _load_annotation(self, index):
+        filename = os.path.join(self.data_path, "Annotations",
+                                index + ".xml")
+        objs = parse_voc_rec(filename)
+        if not self.config["use_diff"]:
+            objs = [o for o in objs if not o["difficult"]]
+        boxes = np.zeros((len(objs), 4), np.float64)
+        gt_classes = np.zeros(len(objs), np.int32)
+        overlaps = np.zeros((len(objs), self.num_classes), np.float32)
+        cls_index = {c: i for i, c in enumerate(self.classes)}
+        for ix, obj in enumerate(objs):
+            boxes[ix] = [v - 1 for v in obj["bbox"]]  # 0-based pixels
+            cls = cls_index[obj["name"].lower().strip()]
+            gt_classes[ix] = cls
+            overlaps[ix, cls] = 1.0
+        return {"boxes": boxes, "gt_classes": gt_classes,
+                "gt_overlaps": overlaps, "flipped": False}
+
+    def proposal_roidb(self, gt_roidb, proposals_file):
+        """gt + external proposals merged into one training roidb
+        (the reference's selective_search_roidb / rpn_roidb shape;
+        proposals come from an ``.npz`` holding one (n_i, 4) array per
+        image index)."""
+        data = np.load(proposals_file, allow_pickle=True)
+        box_list = []
+        for index in self.image_set_index:
+            boxes = np.asarray(data[index], np.float64).reshape(-1, 4)
+            keep = _unique_boxes(boxes)
+            boxes = boxes[keep]
+            boxes = boxes[_filter_small(boxes, self.config["min_size"])]
+            box_list.append(boxes)
+        roidb = self.create_roidb_from_box_list(box_list, gt_roidb)
+        if self.image_set != "test" and gt_roidb is not None:
+            roidb = IMDB.merge_roidbs(gt_roidb, roidb)
+        return roidb
+
+    # -- evaluation ---------------------------------------------------------
+    def _result_file(self, cls):
+        folder = os.path.join(self.devkit_path, "results",
+                              "VOC" + self.year, "Main")
+        os.makedirs(folder, exist_ok=True)
+        name = (self.config["comp_id"] + "_det_" + self.image_set
+                + "_{:s}.txt")
+        return os.path.join(folder, name).format(cls)
+
+    def write_pascal_results(self, all_boxes):
+        """``all_boxes[cls][image]`` = (n, 5) [x1 y1 x2 y2 score] arrays
+        -> one devkit-format results file per class (1-based pixels)."""
+        for cls_ind, cls in enumerate(self.classes):
+            if cls == "__background__":
+                continue
+            with open(self._result_file(cls), "w") as f:
+                for im_ind, index in enumerate(self.image_set_index):
+                    dets = np.asarray(all_boxes[cls_ind][im_ind])
+                    for k in range(dets.shape[0] if dets.size else 0):
+                        f.write(
+                            "{:s} {:.3f} {:.1f} {:.1f} {:.1f} {:.1f}\n"
+                            .format(index, dets[k, -1], dets[k, 0] + 1,
+                                    dets[k, 1] + 1, dets[k, 2] + 1,
+                                    dets[k, 3] + 1))
+
+    def do_python_eval(self, ovthresh=0.5):
+        """Per-class AP + mAP over the written results files; the VOC
+        metric switched from 11-point to integral in 2010."""
+        annopath = os.path.join(self.data_path, "Annotations", "{0!s}.xml")
+        imageset_file = os.path.join(self.data_path, "ImageSets", "Main",
+                                     self.image_set + ".txt")
+        use_07 = int(self.year) < 2010
+        aps = {}
+        for cls in self.classes:
+            if cls == "__background__":
+                continue
+            _, _, ap = voc_eval(self._result_file("{:s}"), annopath,
+                                imageset_file, cls,
+                                os.path.join(self.cache_path, self.name),
+                                ovthresh=ovthresh, use_07_metric=use_07)
+            aps[cls] = ap
+            log.info("AP for %s = %.4f", cls, ap)
+        mean_ap = float(np.mean(list(aps.values()))) if aps else 0.0
+        log.info("Mean AP = %.4f", mean_ap)
+        return aps, mean_ap
+
+    def evaluate_detections(self, detections):
+        self.write_pascal_results(detections)
+        return self.do_python_eval()
+
+
+def _unique_boxes(boxes, scale=1.0):
+    """Indices of first occurrences (reference bbox_process.unique_boxes)."""
+    v = np.array([1, 1e3, 1e6, 1e9])
+    hashes = np.round(boxes * scale).dot(v)
+    _, index = np.unique(hashes, return_index=True)
+    return np.sort(index)
+
+
+def _filter_small(boxes, min_size):
+    ws = boxes[:, 2] - boxes[:, 0] + 1
+    hs = boxes[:, 3] - boxes[:, 1] + 1
+    return np.where((ws >= min_size) & (hs >= min_size))[0]

@@ -28,7 +28,6 @@ from __future__ import annotations
 import os
 import threading
 from collections import deque
-from concurrent.futures import ThreadPoolExecutor
 
 __all__ = ["Engine", "Var", "get_engine", "set_engine_type", "FnProperty"]
 
@@ -66,13 +65,15 @@ class Var:
 
 
 class _OpBlock:
-    __slots__ = ("fn", "const_vars", "mutable_vars", "wait", "lock", "prop", "done", "exc")
+    __slots__ = ("fn", "const_vars", "mutable_vars", "wait", "lock", "prop",
+                 "done", "exc", "priority")
 
-    def __init__(self, fn, const_vars, mutable_vars, prop):
+    def __init__(self, fn, const_vars, mutable_vars, prop, priority=0):
         self.fn = fn
         self.const_vars = const_vars
         self.mutable_vars = mutable_vars
         self.prop = prop
+        self.priority = priority
         self.wait = len(const_vars) + len(mutable_vars)
         self.lock = threading.Lock()
         self.done = threading.Event()
@@ -112,7 +113,7 @@ class Engine:
             if id(v) in seen:
                 raise ValueError(f"duplicate variable {v} in dependency sets")
             seen.add(id(v))
-        block = _OpBlock(fn, const_vars, mutable_vars, prop)
+        block = _OpBlock(fn, const_vars, mutable_vars, prop, priority)
         with self._pending_lock:
             self._pending += 1
         if not const_vars and not mutable_vars:
@@ -141,14 +142,23 @@ class Engine:
         self.push(done.set, const_vars=(var,))
         done.wait()
 
+    def check_exceptions(self):
+        """Raise the first exception any completed op left behind
+        (threaded_engine.h on_complete error propagation); callers that
+        synchronize on single vars use this to surface async failures
+        without a full wait_for_all."""
+        with self._pending_lock:
+            if not self._exceptions:
+                return
+            exc = self._exceptions[:]
+            self._exceptions.clear()
+        raise exc[0]
+
     def wait_for_all(self):
         with self._all_done:
             while self._pending:
                 self._all_done.wait()
-        if self._exceptions:
-            exc = self._exceptions[:]
-            self._exceptions.clear()
-            raise exc[0]
+        self.check_exceptions()
 
     def delete_variable(self, var: Var, on_delete=None):
         """Schedule deletion after all pending ops on var complete."""
@@ -229,22 +239,82 @@ class NaiveEngine(Engine):
         self._run(block)
 
 
+class _PriorityPool:
+    """Worker pool draining a priority heap: highest ``priority`` first,
+    FIFO among equals (the reference's std::priority_queue dispatch,
+    threaded_engine_pooled.cc) — this is what makes ``priority=-key``
+    pushes order comm the way the next forward pass consumes weights."""
+
+    def __init__(self, num_workers, name):
+        import heapq
+
+        self._heapq = heapq
+        self._heap = []  # (-priority, seq, fn)
+        self._cv = threading.Condition()
+        self._seq = 0
+        self._shutdown = False
+        self._threads = [
+            threading.Thread(target=self._loop, name=f"{name}-{i}",
+                             daemon=True)
+            for i in range(num_workers)]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, fn, priority=0):
+        with self._cv:
+            self._heapq.heappush(self._heap, (-priority, self._seq, fn))
+            self._seq += 1
+            self._cv.notify()
+
+    def close(self):
+        """Drain the heap then let every worker exit."""
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._heap and not self._shutdown:
+                    self._cv.wait()
+                if self._shutdown and not self._heap:
+                    return
+                _, _, fn = self._heapq.heappop(self._heap)
+            fn()
+
+
+def _close_pools(*pools):
+    for p in pools:
+        p.close()
+
+
 class ThreadedEngine(Engine):
     """Worker-pool execution (src/engine/threaded_engine_perdevice.cc).
 
-    One shared pool for normal work plus a dedicated pool for prioritized /
-    IO work, standing in for the reference's per-device + copy pools (device
-    streams are owned by PJRT here).
+    One shared priority pool for normal work plus a dedicated pool for
+    prioritized / IO work, standing in for the reference's per-device +
+    copy pools (device streams are owned by PJRT here).  Within each
+    pool, ready ops dispatch highest-priority-first.
     """
 
     def __init__(self, num_workers=None):
         super().__init__()
         if num_workers is None:
             num_workers = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS", "4"))
-        self._pool = ThreadPoolExecutor(max_workers=num_workers,
-                                        thread_name_prefix="mxtpu-engine")
-        self._io_pool = ThreadPoolExecutor(max_workers=2,
-                                           thread_name_prefix="mxtpu-engine-io")
+        self._pool = _PriorityPool(num_workers, "mxtpu-engine")
+        self._io_pool = _PriorityPool(2, "mxtpu-engine-io")
+        # non-singleton engines (tests, ad-hoc) must not park worker
+        # threads forever once collected
+        import weakref
+
+        self._finalizer = weakref.finalize(self, _close_pools, self._pool,
+                                           self._io_pool)
+
+    def close(self):
+        """Stop the worker pools (idempotent; runs at GC otherwise)."""
+        self._finalizer()
 
     def _dispatch(self, block):
         pool = (
@@ -253,7 +323,7 @@ class ThreadedEngine(Engine):
                               FnProperty.CPU_PRIORITIZED)
             else self._pool
         )
-        pool.submit(self._run, block)
+        pool.submit(lambda: self._run(block), priority=block.priority)
 
 
 class NativeEngine(Engine):
@@ -316,20 +386,17 @@ class NativeEngine(Engine):
         native_prop = 1 if prop in (FnProperty.COPY_FROM_DEVICE,
                                     FnProperty.COPY_TO_DEVICE,
                                     FnProperty.CPU_PRIORITIZED) else 0
-        self._lib.MXTPUEnginePush(self._handle, ct.cast(cb, ct.c_void_p),
-                                  None, cvars, len(const_vars), mvars,
-                                  len(mutable_vars), native_prop)
+        self._lib.MXTPUEnginePushPriority(
+            self._handle, ct.cast(cb, ct.c_void_p), None, cvars,
+            len(const_vars), mvars, len(mutable_vars), native_prop,
+            int(priority))
 
     def wait_for_var(self, var: Var):
         self._lib.MXTPUEngineWaitForVar(self._handle, var.native)
 
     def wait_for_all(self):
         self._lib.MXTPUEngineWaitForAll(self._handle)
-        with self._pending_lock:
-            if self._exceptions:
-                exc = self._exceptions[:]
-                self._exceptions.clear()
-                raise exc[0]
+        self.check_exceptions()
 
 
 _engine = None

@@ -444,28 +444,52 @@ class Executor:
                     raise MXNetError(f"unknown aux {k!r}")
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
-        """Return a new executor with new input shapes (executor.py reshape)."""
-        new_shapes = {}
-        arg_shapes, _, _ = self._symbol.infer_shape(**kwargs)
-        for name, shp, arr in zip(self.arg_names, arg_shapes, self.arg_arrays):
-            new_shapes[name] = shp
+        """Return a new executor with new input shapes (reference
+        python/mxnet/executor.py reshape semantics):
+
+        - an array NOT named in ``kwargs`` may only change shape when
+          ``partial_shaping=True``;
+        - an array may only GROW when ``allow_up_sizing=True`` (the
+          reference reuses the old buffer for same-or-smaller shapes).
+        """
+        import numpy as _np
+
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
         ctx = self._ctx
-        new_args = []
-        for name, shp, arr in zip(self.arg_names, arg_shapes, self.arg_arrays):
-            if tuple(arr.shape) == tuple(shp):
-                new_args.append(arr)
-            else:
-                new_args.append(nd.zeros(shp, ctx=ctx, dtype=arr.dtype))
-        grad_arrays = []
-        for name, shp, garr in zip(self.arg_names, arg_shapes, self.grad_arrays):
-            if garr is None:
-                grad_arrays.append(None)
-            elif tuple(garr.shape) == tuple(shp):
-                grad_arrays.append(garr)
-            else:
-                grad_arrays.append(nd.zeros(shp, ctx=ctx, dtype=garr.dtype))
+
+        def remake(name, arr, shp, direct):
+            shp = tuple(shp)
+            if tuple(arr.shape) == shp:
+                return arr
+            if not partial_shaping and not direct:
+                raise MXNetError(
+                    f"cannot reshape array {name!r}: its shape changed as a "
+                    "consequence of the requested input shapes; pass "
+                    "partial_shaping=True to allow this")
+            if _np.prod(shp) > _np.prod(arr.shape):
+                if not allow_up_sizing:
+                    raise MXNetError(
+                        f"new shape of arg {name!r} is larger than the "
+                        "original; set allow_up_sizing=True to allocate a "
+                        "bigger array")
+                return nd.zeros(shp, ctx=ctx, dtype=arr.dtype)
+            # same-or-smaller: the reference REUSES the old buffer
+            # (arr.reshape over its leading elements); XLA arrays are
+            # immutable, so carry the data by copying the flat prefix
+            flat = arr._data.reshape(-1)[: int(_np.prod(shp))]
+            return nd.NDArray(flat.reshape(shp), ctx)
+
+        new_args, grad_arrays = [], []
+        for name, shp, arr, garr in zip(self.arg_names, arg_shapes,
+                                        self.arg_arrays, self.grad_arrays):
+            new_args.append(remake(name, arr, shp, name in kwargs))
+            grad_arrays.append(None if garr is None
+                               else remake(name, garr, shp, name in kwargs))
+        new_aux = [remake(name, arr, shp, False)
+                   for name, shp, arr in zip(self._symbol.list_auxiliary_states(),
+                                             aux_shapes, self.aux_arrays)]
         return Executor(self._symbol, ctx, self._grad_req, new_args, grad_arrays,
-                        self.aux_arrays)
+                        new_aux)
 
     def debug_str(self):
         return self._symbol.debug_str()

@@ -20,8 +20,13 @@ backend"):
 
 API shape (init/push/pull with int or str keys, pluggable updater,
 priority hints) matches python/mxnet/kvstore.py so Module/FeedForward
-code ports unchanged.  Priorities are accepted for compatibility; XLA's
-async dispatch already overlaps communication with compute.
+code ports unchanged.  On the device path XLA's async dispatch already
+overlaps communication with compute; on the host PS path
+(DistPSKVStore) pushes are STAGED on the dependency engine's
+prioritized lane at the caller's priority (priority=-key orders sends
+the way the next forward consumes weights — reference
+python/mxnet/model.py:87-97), with per-key vars preserving
+push-before-pull ordering.
 """
 
 from __future__ import annotations
@@ -50,10 +55,20 @@ class Comm:
         if len(arrays) == 1:
             return arrays[0].as_in_context(self.reduce_ctx)
         dev = self.reduce_ctx.jax_device()
-        total = jax.device_put(arrays[0]._data, dev)
-        for a in arrays[1:]:
-            total = total + jax.device_put(a._data, dev)
-        return NDArray(total, self.reduce_ctx)
+        vals = [jax.device_put(a._data, dev) for a in arrays]
+        return NDArray(self._tree_sum(vals), self.reduce_ctx)
+
+    @staticmethod
+    def _tree_sum(vals):
+        """Pairwise (tree) summation: O(log n) dependency depth instead of
+        a sequential add chain — the reference's chunked tree-sum
+        (comm.h:17-176) shape, sized for pod-scale host staging."""
+        while len(vals) > 1:
+            nxt = [vals[i] + vals[i + 1] for i in range(0, len(vals) - 1, 2)]
+            if len(vals) % 2:
+                nxt.append(vals[-1])
+            vals = nxt
+        return vals[0]
 
     def broadcast(self, src: NDArray, dsts):
         for d in dsts:
@@ -78,10 +93,9 @@ class CommDevice(Comm):
     def reduce(self, arrays) -> NDArray:
         target = arrays[0].context
         dev = target.jax_device()
-        total = arrays[0]._data
-        for a in arrays[1:]:
-            total = total + jax.device_put(a._data, dev)
-        return NDArray(total, target)
+        vals = [arrays[0]._data]
+        vals += [jax.device_put(a._data, dev) for a in arrays[1:]]
+        return NDArray(Comm._tree_sum(vals), target)
 
 
 class KVStore:
@@ -311,6 +325,16 @@ class DistPSKVStore(KVStore):
         # stores share the same servers)
         self._sync = "async" not in kind
         self._meta = {}          # key -> (shape, dtype)
+        # staged pushes: network sends run on the host engine's
+        # prioritized lane so the training loop overlaps comm with the
+        # rest of backward (reference comm/compute overlap via
+        # priority=-key, model.py:87-97); per-key engine vars keep
+        # push->pull ordering
+        from .engine import FnProperty, get_engine
+
+        self._engine = get_engine()
+        self._fnprop = FnProperty.CPU_PRIORITIZED
+        self._key_vars = {}
         # clean process exit must send the explicit "bye" (a bare EOF is
         # treated as a crash by the server's dead-node tracking)
         import atexit
@@ -319,9 +343,16 @@ class DistPSKVStore(KVStore):
 
     def close(self):
         """Deregister from the servers; idempotent."""
-        client, self._client = getattr(self, "_client", None), None
-        if client is not None:
-            client.close()
+        if getattr(self, "_client", None) is None:
+            return
+        try:
+            self._flush()  # staged sends must land before the bye
+        except Exception:
+            # a failed staged send (e.g. the server already died) must
+            # not prevent deregistering from the surviving shards
+            pass
+        client, self._client = self._client, None
+        client.close()
 
     @property
     def rank(self):
@@ -371,16 +402,36 @@ class DistPSKVStore(KVStore):
             if k not in self._meta:
                 raise MXNetError(f"key {k!r} not initialized")
             reduced = self._comm.reduce(vs)
-            self._client.push(k, reduced.asnumpy(), sync=self._sync)
+            # device reduce synchronizes here; the network send is staged
+            # asynchronously at the caller's priority so backward keeps
+            # running while earlier grads are in flight
+            arr = reduced.asnumpy()
+            kvar = self._key_vars.setdefault(k, self._engine.new_variable())
+            self._engine.push(
+                lambda a=arr, kk=k, c=self._client, s=self._sync:
+                    c.push(kk, a, sync=s),
+                mutable_vars=(kvar,), prop=self._fnprop, priority=priority)
 
     def pull(self, key, out=None, priority=0):
         for k, outs in self._normalize(key, out):
             if k not in self._meta:
                 raise MXNetError(f"key {k!r} not initialized")
+            # honor per-key ordering: a pull observes every push staged
+            # before it (reference kvstore_dist.h pull-after-push dep)
+            kvar = self._key_vars.get(k)
+            if kvar is not None:
+                self._engine.wait_for_var(kvar)
+                self._engine.check_exceptions()
             shape, dtype = self._meta[k]
             arr = self._client.pull(k, shape, dtype)
             src = NDArray(jnp.asarray(arr), outs[0].context)
             self._comm.broadcast(src, outs)
+
+    def _flush(self):
+        """Complete every staged push and surface its errors."""
+        for kvar in self._key_vars.values():
+            self._engine.wait_for_var(kvar)
+        self._engine.check_exceptions()
 
     def set_optimizer(self, optimizer):
         """Pickle the optimizer to every server shard — the reference's
@@ -423,9 +474,11 @@ class DistPSKVStore(KVStore):
         return len(self._client.dead_nodes(timeout))
 
     def barrier(self):
+        self._flush()
         self._client.barrier()
 
     def send_command_to_servers(self, head, body):
+        self._flush()
         self._client.command(head, body)
 
 

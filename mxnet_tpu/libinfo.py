@@ -44,6 +44,9 @@ def _declare(lib):
     lib.MXTPUEnginePush.argtypes = [
         c.c_void_p, c.c_void_p, c.c_void_p, c.POINTER(c.c_void_p), c.c_int,
         c.POINTER(c.c_void_p), c.c_int, c.c_int]
+    lib.MXTPUEnginePushPriority.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_void_p, c.POINTER(c.c_void_p), c.c_int,
+        c.POINTER(c.c_void_p), c.c_int, c.c_int, c.c_int]
     lib.MXTPUEngineWaitForAll.argtypes = [c.c_void_p]
     lib.MXTPUEngineWaitForVar.argtypes = [c.c_void_p, c.c_void_p]
     lib.MXTPUEnginePending.restype = c.c_int64

@@ -230,8 +230,10 @@ class DataParallelExecutorGroup:
 
     @property
     def grad_arrays(self):
-        """Per-param list of per-device gradient NDArrays."""
-        return [[exe.grad_dict[name] for exe in self.execs]
+        """Per-param list of per-device gradient NDArrays; fixed params
+        (grad_req null) have no gradient buffer and yield None, which
+        the updater paths skip (reference model.py:98-115 contract)."""
+        return [[exe.grad_dict.get(name) for exe in self.execs]
                 for name in self.param_names]
 
     @property

@@ -94,14 +94,33 @@ class SoftmaxOutputOp(OpDef):
         grad = prob - onehot
         if params.out_grad and out_grads and out_grads[0] is not None:
             grad = grad * out_grads[0].astype(grad.dtype)
+        mask = None
         if params.use_ignore:
             mask = (lab != int(params.ignore_label))
             grad = grad * jnp.expand_dims(mask, axis).astype(grad.dtype)
+        if params.multi_output:
+            # reference softmax_output-inl.h multi-output scaling: the
+            # spatial extent always divides (grad_scale/s3[2] in null
+            # mode, grad_scale/(s3[2]*n) in batch mode), and valid-count
+            # normalization applies whether or not use_ignore is set
+            # (all positions count as valid without ignore)
+            spatial = max(int(np.prod(prob.shape[2:])), 1)
             if params.normalization == "valid":
-                valid = jnp.maximum(jnp.sum(mask), 1).astype(grad.dtype)
+                valid = (jnp.maximum(jnp.sum(mask), 1).astype(grad.dtype)
+                         if mask is not None else float(lab.size))
                 grad = grad / valid
-        if params.normalization == "batch":
-            grad = grad / prob.shape[0]
+            elif params.normalization == "batch":
+                grad = grad / (spatial * prob.shape[0])
+            else:
+                grad = grad / spatial
+        else:
+            if params.normalization == "valid":
+                # valid_cnt == label.Size() when nothing is ignored
+                valid = (jnp.maximum(jnp.sum(mask), 1).astype(grad.dtype)
+                         if mask is not None else float(lab.size))
+                grad = grad / valid
+            elif params.normalization == "batch":
+                grad = grad / prob.shape[0]
         grad = grad * params.grad_scale
         return [grad, jnp.zeros_like(label)]
 

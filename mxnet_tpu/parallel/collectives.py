@@ -91,3 +91,50 @@ def allreduce_bench(mesh=None, sizes_mb=(1, 4, 16, 64, 256), n_iter=10,
             print(f"allreduce {mb:7.2f} MB over {n} devices: {dt*1e3:8.2f} ms, "
                   f"{gbps:7.2f} GB/s/device")
     return results
+
+
+def memory_bench(sizes_mb=(64, 256, 1024), n_iter=10, dtype=jnp.float32,
+                 verbose=True):
+    """Single-device memory-system bandwidth: HBM stream (read+write an
+    elementwise op) and host<->device staging transfers.
+
+    The single-chip complement of :func:`allreduce_bench` for the
+    bandwidth artifact (reference tools/bandwidth measures PCIe paths the
+    same way); on TPU the HBM number should sit near the chip's spec
+    (e.g. ~2.7 TB/s on v5p) and staging near PCIe speeds.
+    """
+    dev = jax.devices()[0]
+    results = []
+    add1 = jax.jit(lambda v: v + 1)
+    for mb in sizes_mb:
+        elems = int(mb * 1024 * 1024 / np.dtype(dtype).itemsize)
+        x = jax.device_put(jnp.ones((elems,), dtype), dev)
+        add1(x).block_until_ready()
+        tic = time.perf_counter()
+        for _ in range(n_iter):
+            x = add1(x)
+        x.block_until_ready()
+        dt = (time.perf_counter() - tic) / n_iter
+        hbm_gbps = 2 * elems * np.dtype(dtype).itemsize / dt / 1e9
+
+        host = np.ones((elems,), np.dtype(dtype))
+        tic = time.perf_counter()
+        for _ in range(n_iter):
+            jax.device_put(host, dev).block_until_ready()
+        h2d = elems * host.itemsize * n_iter / (time.perf_counter() - tic) / 1e9
+        # On accelerators device_get already materializes host memory; the
+        # extra np.array copy is only needed on the CPU backend, where
+        # device_get is a zero-copy view that would time as infinite.
+        force_copy = dev.platform == "cpu"
+        tic = time.perf_counter()
+        for _ in range(n_iter):
+            out = jax.device_get(x)
+            if force_copy:
+                np.array(out)
+        d2h = elems * host.itemsize * n_iter / (time.perf_counter() - tic) / 1e9
+        results.append({"size_mb": mb, "hbm_gbps": hbm_gbps,
+                        "h2d_gbps": h2d, "d2h_gbps": d2h})
+        if verbose:
+            print(f"memory {mb:7.2f} MB: HBM {hbm_gbps:8.1f} GB/s, "
+                  f"h2d {h2d:6.2f} GB/s, d2h {d2h:6.2f} GB/s")
+    return results

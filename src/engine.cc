@@ -42,7 +42,17 @@ struct OprBlock {
   std::vector<Var*> const_vars;
   std::vector<Var*> mutable_vars;
   std::atomic<int> wait{0};
-  int prop = 0;  // 0 normal, 1 prioritized/IO
+  int prop = 0;      // 0 normal, 1 prioritized/IO
+  int priority = 0;  // larger runs sooner (threaded_engine_pooled order)
+  uint64_t seq = 0;  // FIFO tiebreak among equal priorities
+};
+
+// max-priority first; FIFO within a priority level
+struct BlockLess {
+  bool operator()(const OprBlock* a, const OprBlock* b) const {
+    if (a->priority != b->priority) return a->priority < b->priority;
+    return a->seq > b->seq;
+  }
 };
 
 class Engine {
@@ -76,11 +86,13 @@ class Engine {
 
   // Push an op with read/write sets (threaded_engine.cc:255-300).
   void Push(OpCallback fn, void* payload, Var** const_vars, int n_const,
-            Var** mutable_vars, int n_mutable, int prop) {
+            Var** mutable_vars, int n_mutable, int prop, int priority = 0) {
     OprBlock* blk = new OprBlock();
     blk->fn = fn;
     blk->payload = payload;
     blk->prop = prop;
+    blk->priority = priority;
+    blk->seq = seq_.fetch_add(1, std::memory_order_relaxed);
     blk->const_vars.assign(const_vars, const_vars + n_const);
     blk->mutable_vars.assign(mutable_vars, mutable_vars + n_mutable);
     blk->wait.store(n_const + n_mutable + 1, std::memory_order_relaxed);
@@ -200,13 +212,13 @@ class Engine {
           return shutdown_ || !tasks_.empty() || !io_tasks_.empty();
         });
         if (shutdown_ && tasks_.empty() && io_tasks_.empty()) return;
-        std::queue<OprBlock*>& primary = io ? io_tasks_ : tasks_;
-        std::queue<OprBlock*>& secondary = io ? tasks_ : io_tasks_;
+        auto& primary = io ? io_tasks_ : tasks_;
+        auto& secondary = io ? tasks_ : io_tasks_;
         if (!primary.empty()) {
-          blk = primary.front();
+          blk = primary.top();
           primary.pop();
         } else if (!secondary.empty()) {
-          blk = secondary.front();
+          blk = secondary.top();
           secondary.pop();
         }
       }
@@ -218,8 +230,9 @@ class Engine {
   }
 
   std::vector<std::thread> workers_;
-  std::queue<OprBlock*> tasks_;
-  std::queue<OprBlock*> io_tasks_;
+  std::priority_queue<OprBlock*, std::vector<OprBlock*>, BlockLess> tasks_;
+  std::priority_queue<OprBlock*, std::vector<OprBlock*>, BlockLess> io_tasks_;
+  std::atomic<uint64_t> seq_{0};
   std::mutex task_mu_;
   std::condition_variable task_cv_;
   bool shutdown_;
@@ -255,6 +268,16 @@ void MXTPUEnginePush(void* engine, mxtpu::OpCallback fn, void* payload,
   static_cast<mxtpu::Engine*>(engine)->Push(
       fn, payload, reinterpret_cast<mxtpu::Var**>(const_vars), n_const,
       reinterpret_cast<mxtpu::Var**>(mutable_vars), n_mutable, prop);
+}
+
+void MXTPUEnginePushPriority(void* engine, mxtpu::OpCallback fn,
+                             void* payload, void** const_vars, int n_const,
+                             void** mutable_vars, int n_mutable, int prop,
+                             int priority) {
+  static_cast<mxtpu::Engine*>(engine)->Push(
+      fn, payload, reinterpret_cast<mxtpu::Var**>(const_vars), n_const,
+      reinterpret_cast<mxtpu::Var**>(mutable_vars), n_mutable, prop,
+      priority);
 }
 
 void MXTPUEngineWaitForAll(void* engine) {

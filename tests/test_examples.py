@@ -290,3 +290,13 @@ def test_example_kaggle_ndsb2_runs(tmp_path):
     _run_example("kaggle_ndsb2.py",
                  ["--work-dir", str(tmp_path / "w"), "--num-epochs", "8",
                   "--n-train", "300"])
+
+
+def test_example_rl_dqn_runs(capsys):
+    _run_example("rl_dqn.py", ["--episodes", "25"])
+    assert "dqn gridworld" in capsys.readouterr().out
+
+
+def test_example_rl_ddpg_runs(capsys):
+    _run_example("rl_ddpg.py", ["--episodes", "12"])
+    assert "ddpg point-mass" in capsys.readouterr().out

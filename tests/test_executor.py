@@ -81,7 +81,8 @@ def test_forward_kwargs_assign():
 def test_reshape():
     out = _setup()
     exe = out.simple_bind(mx.cpu(), a=(2, 2), b=(2, 2))
-    exe2 = exe.reshape(a=(4, 2), b=(4, 2))
+    # growing an array requires allow_up_sizing (reference reshape contract)
+    exe2 = exe.reshape(a=(4, 2), b=(4, 2), allow_up_sizing=True)
     res = exe2.forward(a=np.ones((4, 2)), b=np.ones((4, 2)))[0]
     assert res.shape == (4, 2)
 
@@ -169,3 +170,48 @@ def test_profiler_api_smoke(tmp_path):
             f(np.ones(4))
     mem = profiler.device_memory()
     assert isinstance(mem, dict) and len(mem) >= 1
+
+
+def test_reshape_partial_shaping_and_up_sizing_flags():
+    """Reference executor.py reshape contract: un-named arrays may only
+    change shape under partial_shaping=True; growth requires
+    allow_up_sizing=True."""
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+    ex = net.simple_bind(mx.cpu(), data=(8, 6), softmax_label=(8,))
+
+    # batch-only change: weights keep shape; smaller batch is fine
+    ex2 = ex.reshape(data=(4, 6), softmax_label=(4,))
+    assert ex2.arg_dict["data"].shape == (4, 6)
+    # weight buffers are carried over, not re-allocated
+    assert ex2.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+
+    # growing the batch requires allow_up_sizing
+    with pytest.raises(Exception):
+        ex.reshape(data=(16, 6), softmax_label=(16,))
+    ex3 = ex.reshape(data=(16, 6), softmax_label=(16,),
+                     allow_up_sizing=True)
+    assert ex3.arg_dict["data"].shape == (16, 6)
+
+    # feature-dim change reshapes fc_weight (not named in kwargs):
+    # rejected without partial_shaping
+    with pytest.raises(Exception):
+        ex.reshape(data=(8, 3), softmax_label=(8,))
+    ex4 = ex.reshape(data=(8, 3), softmax_label=(8,),
+                     partial_shaping=True)
+    assert ex4.arg_dict["fc_weight"].shape == (4, 3)
+
+
+def test_reshape_preserves_buffer_prefix():
+    """Same-or-smaller reshape carries the old buffer's leading elements
+    (reference reuses the allocation; content must survive)."""
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+    ex = net.simple_bind(mx.cpu(), data=(8, 6), softmax_label=(8,))
+    w = np.arange(24, dtype=np.float32).reshape(4, 6)
+    ex.arg_dict["fc_weight"][:] = w
+    ex2 = ex.reshape(data=(8, 3), softmax_label=(8,), partial_shaping=True)
+    np.testing.assert_allclose(ex2.arg_dict["fc_weight"].asnumpy(),
+                               w.reshape(-1)[:12].reshape(4, 3))

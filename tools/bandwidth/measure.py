@@ -48,6 +48,8 @@ def main(argv=None):
     p.add_argument("--n-iter", type=int, default=10)
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--json", default=None,
+                   help="also append results as one JSON line to this file")
     p.add_argument("--platform", default=None,
                    help="force a jax backend (e.g. cpu; combine with "
                         "XLA_FLAGS=--xla_force_host_platform_device_count=N "
@@ -73,7 +75,24 @@ def main(argv=None):
         sizes_mb = (max(total_mb, 0.01),)
     else:
         sizes_mb = tuple(float(x) for x in args.sizes_mb.split(","))
-    allreduce_bench(sizes_mb=sizes_mb, n_iter=args.n_iter, dtype=dtype)
+    import jax
+
+    from mxnet_tpu.parallel.collectives import memory_bench
+
+    results = {"n_devices": len(jax.devices()),
+               "platform": jax.devices()[0].platform,
+               "device_kind": getattr(jax.devices()[0], "device_kind", "")}
+    results["allreduce"] = allreduce_bench(
+        sizes_mb=sizes_mb, n_iter=args.n_iter, dtype=dtype)
+    if len(jax.devices()) == 1:
+        # single chip: the collective is degenerate; record the memory
+        # system instead (HBM stream + host staging)
+        results["memory"] = memory_bench(n_iter=args.n_iter, dtype=dtype)
+    if args.json:
+        import json
+
+        with open(args.json, "a") as f:
+            f.write(json.dumps(results) + "\n")
 
 
 if __name__ == "__main__":
