@@ -256,7 +256,48 @@ int MXTPUDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out);
 int MXTPUDataIterGetPadNum(DataIterHandle handle, int* out);
 int MXTPUDataIterFree(DataIterHandle handle);
 
-/* ---- misc ---- */
+/* ---- extended NDArray views / metadata ---- */
+/* Contiguous [begin, end) slice along axis 0 (MXNDArraySlice). */
+int MXTPUNDArraySlice(NDArrayHandle handle, uint32_t begin, uint32_t end,
+                      NDArrayHandle* out);
+/* Index along axis 0, dropping it (MXNDArrayAt). */
+int MXTPUNDArrayAt(NDArrayHandle handle, uint32_t idx, NDArrayHandle* out);
+int MXTPUNDArrayReshape(NDArrayHandle handle, uint32_t ndim,
+                        const uint32_t* shape, NDArrayHandle* out);
+int MXTPUNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
+                           int* out_dev_id);
+int MXTPUNDArrayCopyTo(NDArrayHandle src, NDArrayHandle dst);
+
+/* ---- extended Symbol surface ---- */
+/* Flattened [k0, v0, k1, v1, ...] attribute pairs (MXSymbolListAttr). */
+int MXTPUSymbolListAttr(SymbolHandle sym, int recursive, int* out_size,
+                        const char*** out);
+int MXTPUSymbolGetNumOutputs(SymbolHandle sym, uint32_t* out);
+/* Gradient-graph symbol wrt the named arguments (MXSymbolGrad). */
+int MXTPUSymbolGrad(SymbolHandle sym, uint32_t n_wrt, const char** wrt,
+                    SymbolHandle* out);
+/* Human-readable executor graph dump (MXExecutorPrint). */
+int MXTPUExecutorPrint(ExecutorHandle handle, const char** out);
+
+/* ---- extended KVStore surface ---- */
+/* C-side updater (MXKVStoreSetUpdater): called as
+ * updater(key, recv_grad, local_weight, updater_handle); the callback
+ * must update local_weight IN PLACE (SyncCopyFromCPU works) and may use
+ * any NDArray entry points on the temporary handles it receives. */
+typedef void (*MXTPUKVUpdater)(int key, NDArrayHandle recv,
+                               NDArrayHandle local, void* updater_handle);
+int MXTPUKVStoreSetUpdater(KVStoreHandle handle, MXTPUKVUpdater updater,
+                           void* updater_handle);
+int MXTPUKVStoreSaveOptimizerStates(KVStoreHandle handle, const char* fname);
+int MXTPUKVStoreLoadOptimizerStates(KVStoreHandle handle, const char* fname);
+int MXTPUKVStoreSendCommandToServers(KVStoreHandle handle, int head,
+                                     const char* body);
+int MXTPUKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int* out);
+
+/* ---- profiler / misc ---- */
+int MXTPUProfilerStart(const char* logdir);
+int MXTPUProfilerStop(void);
+int MXTPUGetVersion(const char** out);
 int MXTPURandomSeed(int seed);
 
 #ifdef __cplusplus

@@ -399,3 +399,100 @@ def random_seed(seed):
     from . import random as rnd
     rnd.seed(int(seed))
     return True
+
+
+# -- extended NDArray surface ----------------------------------------------
+
+def nd_slice(nd, begin, end):
+    """MXNDArraySlice: contiguous [begin, end) view along axis 0."""
+    return nd[int(begin):int(end)]
+
+
+def nd_at(nd, idx):
+    """MXNDArrayAt: index along axis 0 (drops the axis)."""
+    return nd[int(idx)]
+
+
+def nd_reshape(nd, shape):
+    return nd.reshape(tuple(int(d) for d in shape))
+
+
+def nd_context(nd):
+    ctx = nd.context
+    return int(ctx.device_typeid), int(ctx.device_id)
+
+
+def nd_copyto(src, dst):
+    src.copyto(dst)
+    return True
+
+
+# -- extended Symbol surface -----------------------------------------------
+
+def symbol_list_attr(sym, recursive):
+    """Flattened [k0, v0, k1, v1, ...] (MXSymbolListAttr shape)."""
+    d = sym.list_attr(recursive=bool(recursive))
+    flat = []
+    for k, v in sorted(d.items()):
+        flat.append(str(k))
+        flat.append(str(v))
+    return flat
+
+
+def symbol_num_outputs(sym):
+    return len(sym.list_outputs())
+
+
+def symbol_grad(sym, wrt):
+    return sym.grad(list(wrt))
+
+
+def executor_print(ex):
+    return ex.debug_str()
+
+
+# -- extended KVStore surface ----------------------------------------------
+
+def kvstore_set_updater(kv, updater):
+    """MXKVStoreSetUpdater: updater(key:int, recv, local) mutates local
+    in place; `updater` is the C trampoline callable."""
+    kv._set_updater(lambda k, recv, local: updater(int(k), recv, local))
+    return True
+
+
+def kvstore_save_optimizer_states(kv, fname):
+    kv.save_optimizer_states(fname)
+    return True
+
+
+def kvstore_load_optimizer_states(kv, fname):
+    kv.load_optimizer_states(fname)
+    return True
+
+
+def kvstore_send_command(kv, head, body):
+    kv.send_command_to_servers(head, body)
+    return True
+
+
+def kvstore_num_dead_node(kv, node_id):
+    return int(kv.num_dead_node(node_id))
+
+
+# -- profiler / misc --------------------------------------------------------
+
+def profiler_start(logdir):
+    from . import profiler
+    profiler.start(logdir)
+    return True
+
+
+def profiler_stop():
+    from . import profiler
+    profiler.stop()
+    return True
+
+
+def get_version():
+    from . import __version__
+    return str(__version__)

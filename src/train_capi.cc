@@ -746,3 +746,239 @@ int MXTPURandomSeed(int seed) {
 }
 
 }  // extern "C"
+
+// ---- extended surface (NDArray views, attrs, updater, profiler) -----------
+
+extern "C" {
+
+int MXTPUNDArraySlice(NDArrayHandle handle, uint32_t begin, uint32_t end,
+                      NDArrayHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("nd_slice", "(OII)", Borrow(handle), begin, end);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPUNDArrayAt(NDArrayHandle handle, uint32_t idx, NDArrayHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("nd_at", "(OI)", Borrow(handle), idx);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPUNDArrayReshape(NDArrayHandle handle, uint32_t ndim,
+                        const uint32_t* shape, NDArrayHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* tup = PyTuple_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(tup, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject* r = CallBridge("nd_reshape", "(OO)", Borrow(handle), tup);
+  Py_DECREF(tup);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPUNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
+                           int* out_dev_id) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("nd_context", "(O)", Borrow(handle));
+  if (r == nullptr) return -1;
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUNDArrayCopyTo(NDArrayHandle src, NDArrayHandle dst) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("nd_copyto", "(OO)", Borrow(src), Borrow(dst)));
+}
+
+int MXTPUSymbolListAttr(SymbolHandle sym, int recursive, int* out_size,
+                        const char*** out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  Obj* h = static_cast<Obj*>(sym);
+  PyObject* r = CallBridge("symbol_list_attr", "(Oi)", h->obj, recursive);
+  if (r == nullptr) return -1;
+  return SnapshotStrs(h, r, out_size, out);
+}
+
+int MXTPUSymbolGetNumOutputs(SymbolHandle sym, uint32_t* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("symbol_num_outputs", "(O)", Borrow(sym));
+  if (r == nullptr) return -1;
+  *out = static_cast<uint32_t>(PyLong_AsUnsignedLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUSymbolGrad(SymbolHandle sym, uint32_t n_wrt, const char** wrt,
+                    SymbolHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* lst = StrList(static_cast<int>(n_wrt), wrt);
+  PyObject* r = CallBridge("symbol_grad", "(OO)", Borrow(sym), lst);
+  Py_DECREF(lst);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPUExecutorPrint(ExecutorHandle handle, const char** out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  Obj* h = static_cast<Obj*>(handle);
+  PyObject* r = CallBridge("executor_print", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  const char* c = PyUnicode_AsUTF8(r);
+  h->scratch = c ? c : "";
+  Py_DECREF(r);
+  *out = h->scratch.c_str();
+  return 0;
+}
+
+}  // extern "C"
+
+namespace {
+
+struct UpdaterCtx {
+  MXTPUKVUpdater fn;
+  void* handle;
+};
+
+// Python-callable trampoline: (key, recv, local) -> the registered C
+// updater, with temporary handles the callback may use for NDArray calls.
+PyObject* UpdaterTrampoline(PyObject* self, PyObject* args) {
+  int key = 0;
+  PyObject *recv = nullptr, *local = nullptr;
+  if (!PyArg_ParseTuple(args, "iOO", &key, &recv, &local)) return nullptr;
+  auto* ctx = static_cast<UpdaterCtx*>(
+      PyCapsule_GetPointer(self, "mxtpu.updater"));
+  if (ctx == nullptr) return nullptr;
+  Py_INCREF(recv);
+  Py_INCREF(local);
+  Obj* r = Wrap(recv);
+  Obj* l = Wrap(local);
+  // the C callback re-enters the ABI (SyncCopy etc.), which re-takes
+  // the GIL per call — release it here to avoid self-deadlock on
+  // engines that run updaters from worker threads
+  Py_BEGIN_ALLOW_THREADS
+  ctx->fn(key, r, l, ctx->handle);
+  Py_END_ALLOW_THREADS
+  FreeHandle(r);
+  FreeHandle(l);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_updater_def = {
+    "mxtpu_updater", reinterpret_cast<PyCFunction>(UpdaterTrampoline),
+    METH_VARARGS, "C kvstore updater trampoline"};
+
+void FreeUpdaterCapsule(PyObject* cap) {
+  delete static_cast<UpdaterCtx*>(
+      PyCapsule_GetPointer(cap, "mxtpu.updater"));
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXTPUKVStoreSetUpdater(KVStoreHandle handle, MXTPUKVUpdater updater,
+                           void* updater_handle) {
+  if (updater == nullptr) {
+    MXTPUSetLastError("MXTPUKVStoreSetUpdater: updater must not be NULL");
+    return -1;
+  }
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  auto* ctx = new UpdaterCtx{updater, updater_handle};
+  PyObject* cap = PyCapsule_New(ctx, "mxtpu.updater", FreeUpdaterCapsule);
+  if (cap == nullptr) {
+    delete ctx;
+    SetErrorFromPython();
+    return -1;
+  }
+  PyObject* fn = PyCFunction_New(&g_updater_def, cap);
+  Py_DECREF(cap);
+  if (fn == nullptr) {
+    SetErrorFromPython();
+    return -1;
+  }
+  int rc = Done(CallBridge("kvstore_set_updater", "(OO)", Borrow(handle),
+                           fn));
+  Py_DECREF(fn);
+  return rc;
+}
+
+int MXTPUKVStoreSaveOptimizerStates(KVStoreHandle handle,
+                                    const char* fname) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("kvstore_save_optimizer_states", "(Os)",
+                         Borrow(handle), fname));
+}
+
+int MXTPUKVStoreLoadOptimizerStates(KVStoreHandle handle,
+                                    const char* fname) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("kvstore_load_optimizer_states", "(Os)",
+                         Borrow(handle), fname));
+}
+
+int MXTPUKVStoreSendCommandToServers(KVStoreHandle handle, int head,
+                                     const char* body) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("kvstore_send_command", "(Ois)", Borrow(handle),
+                         head, body));
+}
+
+int MXTPUKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id,
+                               int* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("kvstore_num_dead_node", "(Oi)", Borrow(handle),
+                           node_id);
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUProfilerStart(const char* logdir) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("profiler_start", "(s)", logdir));
+}
+
+int MXTPUProfilerStop(void) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("profiler_stop", "()"));
+}
+
+int MXTPUGetVersion(const char** out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  static std::string version;  // process-lifetime snapshot
+  PyObject* r = CallBridge("get_version", "()");
+  if (r == nullptr) return -1;
+  const char* c = PyUnicode_AsUTF8(r);
+  version = c ? c : "";
+  Py_DECREF(r);
+  *out = version.c_str();
+  return 0;
+}
+
+}  // extern "C"

@@ -43,6 +43,85 @@ static SymbolHandle make_op(const char* op, const char* name,
 
 static float frand(void) { return (float)rand() / (float)RAND_MAX; }
 
+/* C-side custom optimizer (MXTPUKVStoreSetUpdater): plain SGD computed
+ * in this process, updating the store's weight in place. */
+static void c_sgd_updater(int key, NDArrayHandle recv, NDArrayHandle local,
+                          void* handle) {
+  float lr = *(float*)handle;
+  uint32_t nd, shape[MXTPU_MAX_NDIM];
+  (void)key;
+  CHK(MXTPUNDArrayGetShape(local, &nd, shape));
+  uint64_t sz = 1;
+  for (uint32_t i = 0; i < nd; ++i) sz *= shape[i];
+  float* w = (float*)malloc(sz * 4);
+  float* g = (float*)malloc(sz * 4);
+  CHK(MXTPUNDArraySyncCopyToCPU(local, w, sz * 4));
+  CHK(MXTPUNDArraySyncCopyToCPU(recv, g, sz * 4));
+  for (uint64_t i = 0; i < sz; ++i) w[i] -= lr * g[i];
+  CHK(MXTPUNDArraySyncCopyFromCPU(local, w, sz * 4));
+  free(w);
+  free(g);
+}
+
+/* Exercise the extended surface: views, context, version, C updater. */
+static void extended_surface_check(void) {
+  const char* version;
+  CHK(MXTPUGetVersion(&version));
+  uint32_t shp[2] = {4, 2};
+  NDArrayHandle a;
+  CHK(MXTPUNDArrayCreate(shp, 2, 0, 1, 0, &a));
+  float vals[8] = {0, 1, 2, 3, 4, 5, 6, 7};
+  CHK(MXTPUNDArraySyncCopyFromCPU(a, vals, sizeof vals));
+  NDArrayHandle row, sl, rs;
+  CHK(MXTPUNDArrayAt(a, 2, &row));
+  float rbuf[2];
+  CHK(MXTPUNDArraySyncCopyToCPU(row, rbuf, sizeof rbuf));
+  if (rbuf[0] != 4 || rbuf[1] != 5) { fprintf(stderr, "FAIL At\n"); exit(1); }
+  CHK(MXTPUNDArraySlice(a, 1, 3, &sl));
+  uint32_t nd, sshape[MXTPU_MAX_NDIM];
+  CHK(MXTPUNDArrayGetShape(sl, &nd, sshape));
+  if (nd != 2 || sshape[0] != 2) { fprintf(stderr, "FAIL Slice\n"); exit(1); }
+  uint32_t nshape[1] = {8};
+  CHK(MXTPUNDArrayReshape(a, 1, nshape, &rs));
+  int devt, devi;
+  CHK(MXTPUNDArrayGetContext(a, &devt, &devi));
+  if (devt != 1) { fprintf(stderr, "FAIL ctx\n"); exit(1); }
+
+  /* kvstore with a C-implemented SGD updater */
+  KVStoreHandle kv;
+  CHK(MXTPUKVStoreCreate("local", &kv));
+  static float lr = 0.5f;
+  CHK(MXTPUKVStoreSetUpdater(kv, c_sgd_updater, &lr));
+  uint32_t wshp[1] = {4};
+  NDArrayHandle w, grad, out;
+  CHK(MXTPUNDArrayCreate(wshp, 1, 0, 1, 0, &w));
+  CHK(MXTPUNDArrayCreate(wshp, 1, 0, 1, 0, &grad));
+  CHK(MXTPUNDArrayCreate(wshp, 1, 0, 1, 0, &out));
+  float winit[4] = {1, 2, 3, 4}, gval[4] = {1, 1, 1, 1};
+  CHK(MXTPUNDArraySyncCopyFromCPU(w, winit, sizeof winit));
+  CHK(MXTPUNDArraySyncCopyFromCPU(grad, gval, sizeof gval));
+  int key0 = 0;
+  CHK(MXTPUKVStoreInit(kv, 1, &key0, &w));
+  CHK(MXTPUKVStorePush(kv, 1, &key0, &grad, 0));
+  CHK(MXTPUKVStorePull(kv, 1, &key0, &out, 0));
+  float got[4];
+  CHK(MXTPUNDArraySyncCopyToCPU(out, got, sizeof got));
+  for (int i = 0; i < 4; ++i)
+    if (got[i] != winit[i] - 0.5f) {
+      fprintf(stderr, "FAIL C updater: got[%d]=%f\n", i, got[i]);
+      exit(1);
+    }
+  CHK(MXTPUNDArrayFree(a));
+  CHK(MXTPUNDArrayFree(row));
+  CHK(MXTPUNDArrayFree(sl));
+  CHK(MXTPUNDArrayFree(rs));
+  CHK(MXTPUNDArrayFree(w));
+  CHK(MXTPUNDArrayFree(grad));
+  CHK(MXTPUNDArrayFree(out));
+  CHK(MXTPUKVStoreFree(kv));
+  fprintf(stderr, "extended C surface ok (version %s)\n", version);
+}
+
 int main(int argc, char** argv) {
   if (argc < 5) {
     fprintf(stderr, "usage: %s img.idx lab.idx batch epochs\n", argv[0]);
@@ -54,6 +133,7 @@ int main(int argc, char** argv) {
   int epochs = atoi(argv[4]);
   srand(7);
   CHK(MXTPURandomSeed(7));
+  extended_surface_check();
 
   /* ---- LeNet-style symbol ---- */
   SymbolHandle data, net;

@@ -300,3 +300,21 @@ def test_example_rl_dqn_runs(capsys):
 def test_example_rl_ddpg_runs(capsys):
     _run_example("rl_ddpg.py", ["--episodes", "12"])
     assert "ddpg point-mass" in capsys.readouterr().out
+
+
+@pytest.mark.parametrize("name", ["tutorial", "composite_symbol",
+                                  "simple_bind"])
+def test_notebook_executes(name):
+    """Tutorial notebooks (reference example/notebooks/) must execute
+    top to bottom: every code cell runs in one shared namespace."""
+    import json
+
+    path = os.path.join(REPO, "docs", "notebooks", name + ".ipynb")
+    with open(path) as f:
+        nb = json.load(f)
+    ns = {}
+    for cell in nb["cells"]:
+        if cell["cell_type"] != "code":
+            continue
+        code = "".join(cell["source"])
+        exec(compile(code, f"{name}.ipynb", "exec"), ns)  # noqa: S102

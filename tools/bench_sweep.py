@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Throughput sweep over bench.py configurations.
+
+Runs the ResNet-50 benchmark across layout/stem/batch/dtype combos (and
+the GPT mode) as separate child processes, collects each one-line JSON
+result, and writes ``BENCH_SWEEP.json`` with every point plus the best
+config — the driver's ``bench.py`` defaults should match the winner.
+
+Usage:  python tools/bench_sweep.py [--out BENCH_SWEEP.json] [--quick]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_point(env_overrides, timeout=2400):
+    env = dict(os.environ)
+    env.update(env_overrides)
+    env["BENCH_CHILD"] = "1"
+    try:
+        r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                           capture_output=True, text=True, timeout=timeout,
+                           env=env)
+    except subprocess.TimeoutExpired:
+        return {"config": env_overrides, "error": "timeout"}
+    for line in r.stdout.splitlines():
+        if line.startswith("{"):
+            rec = json.loads(line)
+            rec["config"] = env_overrides
+            return rec
+    return {"config": env_overrides,
+            "error": (r.stderr or "no output")[-500:]}
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default=os.path.join(REPO, "BENCH_SWEEP.json"))
+    p.add_argument("--quick", action="store_true",
+                   help="one batch size per config")
+    args = p.parse_args()
+
+    points = []
+    batches = ["128"] if args.quick else ["128", "256", "512"]
+    for layout, stem in (("NHWC", "s2d"), ("NHWC", "conv7"),
+                         ("NCHW", "conv7")):
+        for bs in batches:
+            points.append({"BENCH_LAYOUT": layout, "BENCH_STEM": stem,
+                           "BENCH_BATCH": bs})
+    gpt_batches = ["16"] if args.quick else ["8", "16", "32"]
+    gpt_points = [{"BENCH_MODEL": "gpt", "BENCH_BATCH": bs}
+                  for bs in gpt_batches]
+
+    results = []
+    for pt in points + gpt_points:
+        rec = run_point(pt)
+        results.append(rec)
+        print(json.dumps(rec))
+
+    resnet = [r for r in results
+              if r.get("metric") == "resnet50_train_throughput"]
+    gpt = [r for r in results if r.get("metric") == "gpt_train_throughput"]
+    best = max(resnet, key=lambda r: r.get("value", 0), default=None)
+    best_gpt = max(gpt, key=lambda r: r.get("value", 0), default=None)
+    out = {"results": results, "best_resnet50": best, "best_gpt": best_gpt}
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {args.out}")
+    if best:
+        print("best resnet50:", json.dumps(best))
+    if best_gpt:
+        print("best gpt:", json.dumps(best_gpt))
+
+
+if __name__ == "__main__":
+    main()
