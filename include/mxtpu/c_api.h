@@ -256,11 +256,15 @@ int MXTPUDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out);
 int MXTPUDataIterGetPadNum(DataIterHandle handle, int* out);
 int MXTPUDataIterFree(DataIterHandle handle);
 
-/* ---- extended NDArray views / metadata ---- */
-/* Contiguous [begin, end) slice along axis 0 (MXNDArraySlice). */
+/* ---- extended NDArray views / metadata ----
+ * COPY SEMANTICS (deliberate design shift from MXNDArraySlice/At/
+ * Reshape, which alias the parent's memory): XLA arrays are immutable,
+ * so Slice/At/Reshape return independent snapshot arrays — writing
+ * through the result does NOT modify the parent.  To update a region of
+ * an array, SyncCopyToCPU the whole buffer, edit, SyncCopyFromCPU. */
 int MXTPUNDArraySlice(NDArrayHandle handle, uint32_t begin, uint32_t end,
                       NDArrayHandle* out);
-/* Index along axis 0, dropping it (MXNDArrayAt). */
+/* Index along axis 0, dropping it. */
 int MXTPUNDArrayAt(NDArrayHandle handle, uint32_t idx, NDArrayHandle* out);
 int MXTPUNDArrayReshape(NDArrayHandle handle, uint32_t ndim,
                         const uint32_t* shape, NDArrayHandle* out);

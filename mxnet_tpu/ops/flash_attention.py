@@ -67,6 +67,17 @@ def _mask_for(i, j, bq, bk, causal, qo, ko):
     return q_pos >= k_pos
 
 
+def _tile_live(i, j, bq, bk, causal, qo, ko):
+    """Decorator: runs the tile body only when the (i, j) tile is NOT
+    entirely above the causal diagonal (max q_pos < min k_pos) — a
+    fully-masked tile's matmuls contribute nothing, and skipping them
+    halves causal-attention FLOPs (the flash-attention block-skip).
+    Non-causal bodies run unconditionally."""
+    if not causal:
+        return lambda body: body()
+    return pl.when(qo + i * bq + (bq - 1) >= ko + j * bk)
+
+
 # -- forward ------------------------------------------------------------------
 
 def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -80,28 +91,31 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_sc[:] = jnp.zeros_like(l_sc)
 
     i = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
-    if mask is not None:
-        s = jnp.where(mask, s, _NEG_INF)
 
-    m_prev = m_sc[:, 0]
-    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-    p = jnp.exp(s - m_cur[:, None])
-    if mask is not None:
-        # without this, a fully-masked row (m_cur == _NEG_INF) would get
-        # p == exp(0) == 1 for every masked entry
-        p = jnp.where(mask, p, 0.0)
-    alpha = jnp.exp(m_prev - m_cur)
-    l_cur = l_sc[:, 0] * alpha + jnp.sum(p, axis=-1)
-    v = v_ref[0].astype(jnp.float32)
-    acc[:] = acc[:] * alpha[:, None] + jnp.dot(
-        p, v, preferred_element_type=jnp.float32)
-    m_sc[:, 0] = m_cur
-    l_sc[:, 0] = l_cur
+    @_tile_live(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_sc[:, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_cur[:, None])
+        if mask is not None:
+            # without this, a fully-masked row (m_cur == _NEG_INF) would
+            # get p == exp(0) == 1 for every masked entry
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_cur)
+        l_cur = l_sc[:, 0] * alpha + jnp.sum(p, axis=-1)
+        v = v_ref[0].astype(jnp.float32)
+        acc[:] = acc[:] * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_sc[:, 0] = m_cur
+        l_sc[:, 0] = l_cur
 
     @pl.when(j == nk - 1)
     def _():
@@ -164,27 +178,30 @@ def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     i = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
-    dlse = dlse_ref[0]
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
-    if mask is not None:
-        s = jnp.where(mask, s, _NEG_INF)
-    p = jnp.exp(s - lse[:, None])
-    if mask is not None:
-        p = jnp.where(mask, p, 0.0)   # fully-masked rows have lse=_NEG_INF
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    # d s from the o path (p*(dp - delta)) and the lse output (p * dlse)
-    ds = p * (dp - delta[:, None] + dlse[:, None]) * scale
-    dq_acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+    @_tile_live(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        dlse = dlse_ref[0]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)  # fully-masked rows have lse=_NEG_INF
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        # d s from the o path (p*(dp - delta)) and the lse output (p*dlse)
+        ds = p * (dp - delta[:, None] + dlse[:, None]) * scale
+        dq_acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     @pl.when(j == nk - 1)
     def _():
@@ -202,29 +219,32 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     j = pl.program_id(1)  # k-block index (outer)
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
-    dlse = dlse_ref[0]
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
-    if mask is not None:
-        s = jnp.where(mask, s, _NEG_INF)
-    p = jnp.exp(s - lse[:, None])
-    if mask is not None:
-        p = jnp.where(mask, p, 0.0)   # fully-masked rows have lse=_NEG_INF
-    dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[:, None] + dlse[:, None]) * scale
-    dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
+    @_tile_live(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        dlse = dlse_ref[0]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)  # fully-masked rows have lse=_NEG_INF
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None] + dlse[:, None]) * scale
+        dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
 
     @pl.when(i == nq - 1)
     def _():

@@ -29,10 +29,14 @@ def run_point(env_overrides, timeout=2400):
     except subprocess.TimeoutExpired:
         return {"config": env_overrides, "error": "timeout"}
     for line in r.stdout.splitlines():
-        if line.startswith("{"):
+        if not line.startswith("{"):
+            continue
+        try:
             rec = json.loads(line)
-            rec["config"] = env_overrides
-            return rec
+        except ValueError:
+            continue  # log noise that happens to start with a brace
+        rec["config"] = env_overrides
+        return rec
     return {"config": env_overrides,
             "error": (r.stderr or "no output")[-500:]}
 
@@ -60,6 +64,9 @@ def main():
         rec = run_point(pt)
         results.append(rec)
         print(json.dumps(rec))
+        # incremental write: a crash mid-sweep keeps completed points
+        with open(args.out, "w") as f:
+            json.dump({"results": results, "partial": True}, f, indent=1)
 
     resnet = [r for r in results
               if r.get("metric") == "resnet50_train_throughput"]
