@@ -93,6 +93,129 @@ class _CompiledGraph:
             self._aux_of_node[id(node)] = (n_args, aux_vars)
             if node.op.has_backward:
                 self._custom[id(node)] = _wrap_custom_vjp(node.op, node.params)
+        self._segments = self._build_segments()
+
+    def _node_mirrored(self, node):
+        return self._mirror_all or node.attrs.get(
+            "force_mirroring", "") in ("1", "true", "True")
+
+    def _build_segments(self):
+        """Group maximal CONTIGUOUS runs of mirrored compute nodes into
+        block-level rematerialization segments: one ``jax.checkpoint``
+        around the whole run saves only the block-boundary activations
+        (reference mirroring marks per-layer boundaries the same way,
+        static_graph.cc:396-440) — per-node checkpointing would still
+        keep every inter-op activation alive.
+
+        Returns a list of ('node', node) / ('remat', [nodes]) entries;
+        only used on the train path (eval and monitor runs stay
+        per-node)."""
+        # variables carry no activations and have no inputs: placing
+        # them all first preserves dataflow order and keeps them from
+        # splitting mirrored runs (weights interleave compute in topo
+        # order)
+        segments = [("node", n) for n in self.topo if n.is_variable]
+        run = []
+
+        def flush():
+            if len(run) > 1:
+                segments.append(("remat", list(run)))
+            else:
+                segments.extend(("node", n) for n in run)
+            run.clear()
+
+        # only nodes carrying a ``mirror_stage`` attr group into blocks
+        # (the reference's mirror-stage grouping); a stage change breaks
+        # the run so each layer checkpoints independently rather than
+        # the whole net collapsing into one full-recompute region.
+        # Mirrored nodes WITHOUT a stage (e.g. the global
+        # MXNET_BACKWARD_DO_MIRROR switch) keep per-node checkpointing.
+        prev_stage = None
+        for node in self.topo:
+            if node.is_variable:
+                continue
+            stage = node.attrs.get("mirror_stage")
+            if self._node_mirrored(node) and stage is not None:
+                if run and stage != prev_stage:
+                    flush()
+                prev_stage = stage
+                run.append(node)
+            else:
+                flush()
+                prev_stage = None
+                segments.append(("node", node))
+        flush()
+
+        # consumers outside each block + heads define the block outputs
+        consumed = {}
+        for node in self.topo:
+            if node.is_variable:
+                continue
+            n_args, _ = self._aux_of_node[id(node)]
+            for src, idx in node.inputs[:n_args]:
+                consumed.setdefault((id(src), idx), set()).add(id(node))
+        head_keys = {(id(n), i) for n, i in self.heads}
+
+        out = []
+        for kind, payload in segments:
+            if kind != "remat":
+                out.append((kind, payload))
+                continue
+            nodes = payload
+            block_ids = {id(n) for n in nodes}
+            ext_keys, seen = [], set()
+            aux_in, aux_seen = [], set()
+            for n in nodes:
+                n_args, aux_names = self._aux_of_node[id(n)]
+                for src, idx in n.inputs[:n_args]:
+                    k = (id(src), idx)
+                    if id(src) not in block_ids and k not in seen:
+                        seen.add(k)
+                        ext_keys.append(k)
+                for a in aux_names:
+                    if a not in aux_seen:
+                        aux_seen.add(a)
+                        aux_in.append(a)
+            out_keys = []
+            for n in nodes:
+                for i in range(n.num_outputs()):
+                    k = (id(n), i)
+                    users = consumed.get(k, set())
+                    if k in head_keys or users - block_ids:
+                        out_keys.append(k)
+            out.append(("remat", (nodes, ext_keys, aux_in, out_keys)))
+        return out
+
+    def _run_node(self, node, env, new_aux, subkeys, rng_idx, train,
+                  collect, use_checkpoint=False):
+        """Evaluate one node from/into env + new_aux."""
+        n_args, aux_names = self._aux_of_node[id(node)]
+        ins = [env[id(src), idx] for src, idx in node.inputs[:n_args]]
+        auxs = [new_aux[a] for a in aux_names]
+        if id(node) in self._custom:
+            outs = list(self._custom[id(node)](*ins))
+            node_new_aux = auxs
+        else:
+            nkey = (subkeys[rng_idx[id(node)]]
+                    if id(node) in rng_idx else None)
+            if use_checkpoint:
+                pure = jax.checkpoint(
+                    lambda *i, _n=node, _k=nkey, _a=auxs: _n.op.forward(
+                        _n.params, list(i), list(_a), train, _k)[0])
+                outs = list(pure(*ins))
+                node_new_aux = node.op.forward(node.params, ins, auxs,
+                                               train, nkey)[1]
+            else:
+                outs, node_new_aux = node.op.forward(node.params, ins, auxs,
+                                                     train, nkey)
+        for a, v in zip(aux_names, node_new_aux):
+            new_aux[a] = v
+        for i, o in enumerate(outs):
+            env[id(node), i] = o
+            if collect is not None:
+                out_name = (f"{node.name}_"
+                            f"{node.op.list_outputs(node.params)[i]}")
+                collect.append((out_name, o))
 
     def __call__(self, arg_vals: dict, aux_vals: dict, key, train: bool,
                  collect=None):
@@ -104,41 +227,66 @@ class _CompiledGraph:
                    if self.rng_nodes else None)
         rng_idx = {id(n): i for i, n in enumerate(self.rng_nodes)}
         new_aux = dict(aux_vals)
-        for node in self.topo:
-            if node.is_variable:
-                if node.name in arg_vals:
-                    env[id(node), 0] = arg_vals[node.name]
-                elif node.name in aux_vals:
-                    env[id(node), 0] = aux_vals[node.name]
-                continue
-            n_args, aux_names = self._aux_of_node[id(node)]
-            ins = [env[id(src), idx] for src, idx in node.inputs[:n_args]]
-            auxs = [new_aux[a] for a in aux_names]
-            mirror = self._mirror_all or node.attrs.get(
-                "force_mirroring", "") in ("1", "true", "True")
-            if id(node) in self._custom:
-                outs = list(self._custom[id(node)](*ins))
-                node_new_aux = auxs
-            else:
-                fwd = node.op.forward
-                nkey = subkeys[rng_idx[id(node)]] if id(node) in rng_idx else None
-                if mirror and train:
-                    # gradient checkpointing: recompute in backward
-                    pure = jax.checkpoint(
-                        lambda *i, _n=node, _k=nkey, _a=auxs: _n.op.forward(
-                            _n.params, list(i), list(_a), train, _k)[0])
-                    outs = list(pure(*ins))
-                    node_new_aux = node.op.forward(node.params, ins, auxs,
-                                                   train, nkey)[1]
+        # block-level remat applies on the train path only (backward is
+        # what stores activations); monitor runs need every output, so
+        # they also take the per-node path
+        use_segments = train and collect is None
+
+        def place_var(node):
+            if node.name in arg_vals:
+                env[id(node), 0] = arg_vals[node.name]
+            elif node.name in aux_vals:
+                env[id(node), 0] = aux_vals[node.name]
+
+        if not use_segments:
+            for node in self.topo:
+                if node.is_variable:
+                    place_var(node)
+                    continue
+                self._run_node(node, env, new_aux, subkeys, rng_idx, train,
+                               collect,
+                               use_checkpoint=train
+                               and self._node_mirrored(node))
+            outputs = tuple(env[id(n), i] for n, i in self.heads)
+            return outputs, new_aux
+
+        for kind, payload in self._segments:
+            if kind == "node":
+                node = payload
+                if node.is_variable:
+                    place_var(node)
                 else:
-                    outs, node_new_aux = fwd(node.params, ins, auxs, train, nkey)
-            for a, v in zip(aux_names, node_new_aux):
+                    self._run_node(node, env, new_aux, subkeys, rng_idx,
+                                   train, None,
+                                   use_checkpoint=self._node_mirrored(node))
+                continue
+            nodes, ext_keys, aux_in, out_keys = payload
+            block_keys = [subkeys[rng_idx[id(n)]] for n in nodes
+                          if id(n) in rng_idx]
+
+            # _run_node's rng plumbing expects (subkeys, rng_idx); build
+            # block-local versions so the checkpointed body stays simple
+            def seg_fn(ext_vals, aux_vals_in, keys_in, _nodes=nodes,
+                       _ext=ext_keys, _aux=aux_in, _out=out_keys):
+                local_env = dict(zip(_ext, ext_vals))
+                local_aux = dict(zip(_aux, aux_vals_in))
+                rng_nodes = [n for n in _nodes if id(n) in rng_idx]
+                local_idx = {id(n): i for i, n in enumerate(rng_nodes)}
+                for n in _nodes:
+                    self._run_node(n, local_env, local_aux, keys_in,
+                                   local_idx, train, None)
+                return (tuple(local_env[k] for k in _out),
+                        tuple(local_aux[a] for a in _aux))
+
+            wrapped = jax.checkpoint(seg_fn)
+            ext_vals = tuple(env[k] for k in ext_keys)
+            aux_vals_in = tuple(new_aux[a] for a in aux_in)
+            out_vals, aux_out = wrapped(ext_vals, aux_vals_in,
+                                        tuple(block_keys))
+            for k, v in zip(out_keys, out_vals):
+                env[k] = v
+            for a, v in zip(aux_in, aux_out):
                 new_aux[a] = v
-            for i, o in enumerate(outs):
-                env[id(node), i] = o
-                if collect is not None:
-                    out_name = f"{node.name}_{node.op.list_outputs(node.params)[i]}"
-                    collect.append((out_name, o))
         outputs = tuple(env[id(n), i] for n, i in self.heads)
         return outputs, new_aux
 

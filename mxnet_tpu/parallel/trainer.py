@@ -151,7 +151,7 @@ class ShardedTrainer:
     def __init__(self, symbol, input_shapes, mesh=None, batch_axis="dp",
                  param_specs=None, sequence_specs=None, optimizer="sgd",
                  optimizer_params=None, initializer=None, dtype="float32",
-                 input_dtypes=None, rescale_grad=None):
+                 input_dtypes=None, rescale_grad=None, grad_accum_steps=1):
         if mesh is None:
             from .mesh import local_mesh
 
@@ -238,6 +238,18 @@ class ShardedTrainer:
         if rescale_grad is None:
             rescale_grad = 1.0 / next(iter(input_shapes.values()))[0]
         self._rescale_grad = rescale_grad
+        # gradient accumulation: the global batch is processed as
+        # grad_accum_steps sequential microbatches inside ONE compiled
+        # step (lax.scan), with a single optimizer update — activation
+        # memory scales with the microbatch, so models whose activations
+        # exceed HBM at the full batch still train
+        self._accum = int(grad_accum_steps)
+        if self._accum > 1:
+            for name, shp in input_shapes.items():
+                if shp[0] % self._accum:
+                    raise ValueError(
+                        f"batch dim of {name!r} ({shp[0]}) must be "
+                        f"divisible by grad_accum_steps ({self._accum})")
 
         self.batch_shardings = {
             n: NamedSharding(mesh, (sequence_specs or {}).get(
@@ -250,19 +262,51 @@ class ShardedTrainer:
     def _build_steps(self):
         graph = self._graph
 
-        def train_step(params, opt_state, aux, batch, key):
-            # split inside the step: the whole key chain lives on-device,
-            # so each step is ONE program dispatch (a separate host-side
-            # split program adds a dispatch gap per step)
-            key, sub = jax.random.split(key)
+        n_accum = self._accum
 
+        def grads_of(params, aux, batch, sub):
             def f(p):
                 outs, new_aux = graph({**p, **batch}, aux, sub, True)
                 return outs, new_aux
 
             outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
             head = tuple(jnp.ones_like(o) for o in outs)
-            grads = vjp_fn(head)[0]
+            return vjp_fn(head)[0], new_aux, outs
+
+        def train_step(params, opt_state, aux, batch, key):
+            # split inside the step: the whole key chain lives on-device,
+            # so each step is ONE program dispatch (a separate host-side
+            # split program adds a dispatch gap per step)
+            key, sub = jax.random.split(key)
+            if n_accum == 1:
+                grads, new_aux, outs = grads_of(params, aux, batch, sub)
+            else:
+                # pin each microbatch's own batch dim to the original
+                # input sharding (accum axis replicated) — otherwise the
+                # partitioner may shard the scan axis and insert
+                # per-microbatch collectives
+                micro = {
+                    k: jax.lax.with_sharding_constraint(
+                        v.reshape((n_accum, v.shape[0] // n_accum)
+                                  + v.shape[1:]),
+                        NamedSharding(self.mesh, PartitionSpec(
+                            None, *self.batch_shardings[k].spec)))
+                    for k, v in batch.items()}
+
+                def body(carry, mb):
+                    g_acc, aux_c, key_c = carry
+                    key_c, s = jax.random.split(key_c)
+                    g, aux_n, outs_mb = grads_of(params, aux_c, mb, s)
+                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                    return (g_acc, aux_n, key_c), outs_mb
+
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+                (grads, new_aux, sub), outs_st = jax.lax.scan(
+                    body, (zeros, aux, sub), micro)
+                # microbatch outputs stacked on a leading accum axis;
+                # flatten back to the global batch for metrics
+                outs = tuple(o.reshape((-1,) + o.shape[2:])
+                             for o in outs_st)
             scale = self._rescale_grad
             grads = {k: g * scale for k, g in grads.items()}
             new_params, new_opt = self._update_fn(grads, opt_state, params)

@@ -220,3 +220,32 @@ def test_sharded_trainer_fit_and_checkpoint(tmp_path):
     p1, p2 = tr.get_params(), tr2.get_params()
     for k in p1:
         np.testing.assert_allclose(p1[k], p2[k], atol=1e-6, rtol=1e-6)
+
+
+def test_grad_accumulation_matches_full_batch():
+    """grad_accum_steps=4 must produce the same update as one full-batch
+    step (deterministic net: no dropout), with microbatch outputs
+    reassembled to the global batch."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(64, 16).astype(np.float32)
+    y = rng.randint(0, 4, 64).astype(np.float32)
+    net = mx.models.mlp(num_classes=4)
+    mesh = mx.parallel.make_mesh({"dp": 8})
+
+    def build(accum):
+        mx.random.seed(0)
+        np.random.seed(0)
+        return mx.parallel.ShardedTrainer(
+            net, {"data": (64, 16), "softmax_label": (64,)}, mesh=mesh,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.2},
+            initializer=mx.initializer.Xavier(), grad_accum_steps=accum)
+
+    t1, t4 = build(1), build(4)
+    batch = {"data": X, "softmax_label": y}
+    o1 = t1.step(batch)
+    o4 = t4.step(batch)
+    np.testing.assert_allclose(np.asarray(o1[0]), np.asarray(o4[0]),
+                               atol=2e-5, rtol=1e-4)
+    p1, p4 = t1.get_params(), t4.get_params()
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p4[k], atol=2e-5, rtol=1e-4)

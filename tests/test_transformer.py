@@ -123,3 +123,30 @@ def test_gpt_sharded_trainer_adam_multichip():
     y = rng.randint(0, V, (B, S)).astype(np.float32)
     outs = tr.step({"data": x, "softmax_label": y})
     assert np.isfinite(np.asarray(outs[0])).all()
+
+
+def test_gpt_remat_matches_plain():
+    """remat=True (force_mirroring rematerialization) must not change the
+    math — same loss trajectory as the plain model."""
+    rng = np.random.RandomState(0)
+    V, S, B = 50, 16, 4
+    X = rng.randint(0, V, (B, S))
+    Y = rng.randint(0, V, (B, S))
+
+    losses = {}
+    for remat in (False, True):
+        net = mx.models.gpt(V, S, num_layers=2, d_model=32, num_heads=2,
+                            remat=remat)
+        mx.random.seed(0)
+        np.random.seed(0)
+        tr = mx.parallel.ShardedTrainer(
+            net, {"data": (B, S), "softmax_label": (B, S)},
+            mesh=mx.parallel.make_mesh({"dp": 1}),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier(),
+            input_dtypes={"data": np.int32, "softmax_label": np.int32})
+        for _ in range(2):
+            tr.step({"data": X, "softmax_label": Y})
+        losses[remat] = tr.get_params()["gpt_head_bias"]
+    np.testing.assert_allclose(losses[False], losses[True],
+                               atol=1e-5, rtol=1e-4)
