@@ -146,12 +146,21 @@ class ShardedTrainer:
         (e.g. sequence axis over 'sp' for context parallelism)
     optimizer : 'sgd' | 'adam' | 'adamw' | (init_fn, update_fn)
     dtype : compute dtype for params (bfloat16 recommended on TPU)
+    grad_accum_steps : process the global batch as N sequential
+        microbatches inside one compiled step (single optimizer update).
+        Exact for deterministic graphs; dropout draws per-microbatch RNG
+        and BatchNorm sees microbatch statistics (standard caveat)
+    shard_optimizer_state : ZeRO-1 — momentum/Adam moments of
+        replicated params shard over the data axis, cutting optimizer
+        memory by the dp degree; math is unchanged (XLA gathers shards
+        where the update needs them)
     """
 
     def __init__(self, symbol, input_shapes, mesh=None, batch_axis="dp",
                  param_specs=None, sequence_specs=None, optimizer="sgd",
                  optimizer_params=None, initializer=None, dtype="float32",
-                 input_dtypes=None, rescale_grad=None, grad_accum_steps=1):
+                 input_dtypes=None, rescale_grad=None, grad_accum_steps=1,
+                 shard_optimizer_state=False):
         if mesh is None:
             from .mesh import local_mesh
 
@@ -223,8 +232,28 @@ class ShardedTrainer:
         # through zeros_like; scalar/odd-shaped leaves (Adam's step count)
         # must be pinned to the mesh explicitly or multi-device jit sees
         # mixed device sets
+        # ZeRO-1: momentum/Adam moments of REPLICATED params shard over
+        # the data axis (each dp rank owns 1/dp of the state; XLA
+        # inserts the gather when the update combines sharded state with
+        # replicated params) — optimizer memory drops by the dp degree
+        dp_size = mesh.shape.get(batch_axis, 1)
+        # built lazily: meshes without a batch axis (pure tp/sp setups)
+        # must not fail NamedSharding validation when ZeRO is off
+        zero_sharding = (NamedSharding(mesh, PartitionSpec(batch_axis))
+                         if shard_optimizer_state
+                         and batch_axis in mesh.shape else None)
+
         def _place_state(leaf):
             sh = getattr(leaf, "sharding", None)
+            param_sharded = (isinstance(sh, NamedSharding)
+                             and sh.mesh == mesh
+                             and sh.spec != PartitionSpec())
+            if param_sharded:
+                return leaf  # tensor-parallel state follows its param
+            if (zero_sharding is not None
+                    and getattr(leaf, "ndim", 0) >= 1
+                    and leaf.shape[0] % dp_size == 0):
+                return jax.device_put(leaf, zero_sharding)
             if isinstance(sh, NamedSharding) and sh.mesh == mesh:
                 return leaf
             return jax.device_put(leaf, self._replicated)
@@ -303,10 +332,14 @@ class ShardedTrainer:
                 zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
                 (grads, new_aux, sub), outs_st = jax.lax.scan(
                     body, (zeros, aux, sub), micro)
-                # microbatch outputs stacked on a leading accum axis;
-                # flatten back to the global batch for metrics
-                outs = tuple(o.reshape((-1,) + o.shape[2:])
-                             for o in outs_st)
+                # microbatch outputs stacked on a leading accum axis:
+                # scalars (reduced losses) combine by mean; batch-leading
+                # outputs flatten back to the global batch for metrics
+                # (outputs whose axis 0 is NOT the batch keep the stack)
+                outs = tuple(
+                    jnp.mean(o, axis=0) if o.ndim == 1
+                    else o.reshape((-1,) + o.shape[2:])
+                    for o in outs_st)
             scale = self._rescale_grad
             grads = {k: g * scale for k, g in grads.items()}
             new_params, new_opt = self._update_fn(grads, opt_state, params)
