@@ -29,11 +29,18 @@ __all__ = ["ImageRecordIter", "ImageAugmenter"]
 
 class ImageAugmenter:
     """Default augmentation chain (reference DefaultImageAugParam,
-    src/io/image_aug_default.cc:314): resize, random/center crop, mirror,
-    HSL jitter, rotation."""
+    src/io/image_aug_default.cc:314): resize, affine
+    (rotation + shear + random scale + aspect ratio, with img-size
+    clamping), padding, random-size square crop, random/center crop,
+    mirror, HSL jitter — same stage order and distributions as the
+    reference's Process()."""
 
     def __init__(self, data_shape, resize=0, rand_crop=False, rand_mirror=False,
                  mirror=False, rotate=-1, max_rotate_angle=0,
+                 max_aspect_ratio=0.0, max_shear_ratio=0.0,
+                 max_random_scale=1.0, min_random_scale=1.0,
+                 max_crop_size=-1, min_crop_size=-1,
+                 max_img_size=1e10, min_img_size=0.0, pad=0,
                  random_h=0, random_s=0, random_l=0, fill_value=255,
                  inter_method=1, seed=0):
         self.data_shape = data_shape
@@ -43,14 +50,32 @@ class ImageAugmenter:
         self.mirror = mirror
         self.rotate = rotate
         self.max_rotate_angle = max_rotate_angle
+        self.max_aspect_ratio = max_aspect_ratio
+        self.max_shear_ratio = max_shear_ratio
+        self.max_random_scale = max_random_scale
+        self.min_random_scale = min_random_scale
+        self.max_crop_size = max_crop_size
+        self.min_crop_size = min_crop_size
+        self.max_img_size = max_img_size
+        self.min_img_size = min_img_size
+        self.pad = pad
         self.random_h = random_h
         self.random_s = random_s
         self.random_l = random_l
         self.fill_value = fill_value
 
+    def _needs_affine(self):
+        return (self.rotate >= 0 or self.max_rotate_angle > 0
+                or self.max_shear_ratio > 0
+                or self.max_random_scale != 1.0
+                or self.min_random_scale != 1.0
+                or self.max_aspect_ratio != 0.0
+                or self.max_img_size != 1e10 or self.min_img_size != 0.0)
+
     def __call__(self, img, rng):
         import cv2
 
+        fill = (self.fill_value,) * 3
         if self.resize > 0:
             h, w = img.shape[:2]
             if h < w:
@@ -58,28 +83,81 @@ class ImageAugmenter:
             else:
                 new_h, new_w = int(h * self.resize / w), self.resize
             img = cv2.resize(img, (new_w, new_h))
-        angle = None
-        if self.rotate >= 0:
-            angle = self.rotate
-        elif self.max_rotate_angle > 0:
-            angle = rng.uniform(-self.max_rotate_angle, self.max_rotate_angle)
-        if angle is not None:
+
+        # -- affine: rotation + shear + anisotropic random scale --------
+        if self._needs_affine():
+            shear = (rng.uniform(-self.max_shear_ratio, self.max_shear_ratio)
+                     if self.max_shear_ratio > 0 else 0.0)
+            if self.rotate >= 0:
+                angle = self.rotate
+            elif self.max_rotate_angle > 0:
+                angle = rng.randint(-self.max_rotate_angle,
+                                    self.max_rotate_angle + 1)
+            else:
+                angle = 0.0
+            a = np.cos(np.deg2rad(angle))
+            b = np.sin(np.deg2rad(angle))
+            scale = rng.uniform(self.min_random_scale, self.max_random_scale)
+            ratio = 1.0 + (rng.uniform(-self.max_aspect_ratio,
+                                       self.max_aspect_ratio)
+                           if self.max_aspect_ratio else 0.0)
+            # split the scale between height/width so the AREA scales by
+            # scale^2 while w/h changes by `ratio`
+            hs = 2.0 * scale / (1.0 + ratio)
+            ws = ratio * hs
             h, w = img.shape[:2]
-            mat = cv2.getRotationMatrix2D((w / 2, h / 2), angle, 1.0)
-            img = cv2.warpAffine(img, mat, (w, h),
-                                 borderValue=(self.fill_value,) * 3)
-        # crop to target
+            new_w = int(max(self.min_img_size,
+                            min(self.max_img_size, scale * w)))
+            new_h = int(max(self.min_img_size,
+                            min(self.max_img_size, scale * h)))
+            M = np.zeros((2, 3), np.float32)
+            M[0, 0] = hs * a - shear * b * ws
+            M[1, 0] = -b * ws
+            M[0, 1] = hs * b + shear * a * ws
+            M[1, 1] = a * ws
+            # center the transformed image in the new canvas
+            M[0, 2] = (new_w - (M[0, 0] * w + M[0, 1] * h)) / 2.0
+            M[1, 2] = (new_h - (M[1, 0] * w + M[1, 1] * h)) / 2.0
+            img = cv2.warpAffine(img, M, (max(new_w, 1), max(new_h, 1)),
+                                 flags=cv2.INTER_LINEAR,
+                                 borderMode=cv2.BORDER_CONSTANT,
+                                 borderValue=fill)
+
+        if self.pad > 0:
+            img = cv2.copyMakeBorder(img, self.pad, self.pad, self.pad,
+                                     self.pad, cv2.BORDER_CONSTANT,
+                                     value=fill)
+
         th, tw = self.data_shape[1], self.data_shape[2]
         h, w = img.shape[:2]
-        if h < th or w < tw:
-            img = cv2.resize(img, (max(tw, w), max(th, h)))
-            h, w = img.shape[:2]
-        if self.rand_crop:
-            y0 = rng.randint(0, h - th + 1)
-            x0 = rng.randint(0, w - tw + 1)
+        if self.max_crop_size != -1 or self.min_crop_size != -1:
+            # random-size square crop, resized to the target shape; the
+            # reference requires both bounds (CHECK max >= min)
+            lo, hi = self.min_crop_size, self.max_crop_size
+            if lo == -1 or hi == -1 or hi < lo:
+                raise MXNetError(
+                    "min_crop_size and max_crop_size must both be set "
+                    f"with min <= max (got {lo}, {hi})")
+            if h < hi or w < hi:
+                raise MXNetError("input image smaller than max_crop_size")
+            size = rng.randint(lo, hi + 1)
+            if self.rand_crop:
+                y0 = rng.randint(0, h - size + 1)
+                x0 = rng.randint(0, w - size + 1)
+            else:
+                y0, x0 = (h - size) // 2, (w - size) // 2
+            img = cv2.resize(img[y0:y0 + size, x0:x0 + size], (tw, th))
         else:
-            y0, x0 = (h - th) // 2, (w - tw) // 2
-        img = img[y0:y0 + th, x0:x0 + tw]
+            if h < th or w < tw:
+                img = cv2.resize(img, (max(tw, w), max(th, h)))
+                h, w = img.shape[:2]
+            if self.rand_crop:
+                y0 = rng.randint(0, h - th + 1)
+                x0 = rng.randint(0, w - tw + 1)
+            else:
+                y0, x0 = (h - th) // 2, (w - tw) // 2
+            img = img[y0:y0 + th, x0:x0 + tw]
+
         if self.mirror or (self.rand_mirror and rng.rand() < 0.5):
             img = img[:, ::-1]
         if self.random_h or self.random_s or self.random_l:
@@ -343,7 +421,8 @@ class ImageRecordIter(DataIter):
             # is produced by the python chain only
             return False
         a = self._aug
-        if (a.rotate >= 0 or a.max_rotate_angle > 0
+        if (a._needs_affine() or a.pad > 0 or a.max_crop_size != -1
+                or a.min_crop_size != -1
                 or a.random_h or a.random_s or a.random_l):
             return False
         if self.data_shape[0] not in (1, 3):

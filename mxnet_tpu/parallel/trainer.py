@@ -332,12 +332,17 @@ class ShardedTrainer:
                 zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
                 (grads, new_aux, sub), outs_st = jax.lax.scan(
                     body, (zeros, aux, sub), micro)
-                # microbatch outputs stacked on a leading accum axis:
-                # scalars (reduced losses) combine by mean; batch-leading
-                # outputs flatten back to the global batch for metrics
-                # (outputs whose axis 0 is NOT the batch keep the stack)
+                # microbatch outputs stacked on a leading accum axis.
+                # ASSUMPTION: head outputs are per-sample batch-leading
+                # (the SoftmaxOutput/MakeLoss contract this trainer
+                # targets) or scalar.  Batch-leading outputs flatten back
+                # to the global batch for metrics; scalar heads combine
+                # by SUM — consistent with the un-normalized loss
+                # contract (rescale_grad=1/global_batch assumes
+                # sum-losses); a mean-reduced head will read differently
+                # across accumulation settings.
                 outs = tuple(
-                    jnp.mean(o, axis=0) if o.ndim == 1
+                    jnp.sum(o, axis=0) if o.ndim == 1
                     else o.reshape((-1,) + o.shape[2:])
                     for o in outs_st)
             scale = self._rescale_grad

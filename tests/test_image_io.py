@@ -338,3 +338,35 @@ def test_image_record_iter_round_batch(tmp_path):
                           round_batch=False)
     with _pytest.raises(Exception):
         list(iter_epoch(it2))
+
+
+def test_augmenter_affine_scale_aspect_shear(tmp_path):
+    """The reference's affine-family augmentations (random scale, aspect
+    ratio, shear, size clamping, pad, random-size crop) produce valid
+    target-shaped outputs and actually vary geometry."""
+    from mxnet_tpu.image_io import ImageAugmenter
+
+    rng = np.random.RandomState(0)
+    img = np.zeros((80, 80, 3), np.uint8)
+    img[20:60, 20:60] = 200  # bright square to track geometry
+
+    aug = ImageAugmenter((3, 32, 32), rand_crop=True,
+                         max_random_scale=1.5, min_random_scale=0.7,
+                         max_aspect_ratio=0.25, max_shear_ratio=0.1,
+                         max_rotate_angle=10)
+    outs = [aug(img, rng) for _ in range(8)]
+    assert all(o.shape == (32, 32, 3) for o in outs)
+    means = [float(o.mean()) for o in outs]
+    assert max(means) - min(means) > 1.0  # geometry actually varies
+
+    # random-size square crop path
+    aug2 = ImageAugmenter((3, 32, 32), rand_crop=True,
+                          max_crop_size=64, min_crop_size=40)
+    o2 = aug2(img, rng)
+    assert o2.shape == (32, 32, 3)
+
+    # pad + size clamping
+    aug3 = ImageAugmenter((3, 32, 32), pad=4, max_random_scale=3.0,
+                          min_random_scale=3.0, max_img_size=100)
+    o3 = aug3(img, rng)
+    assert o3.shape == (32, 32, 3)
