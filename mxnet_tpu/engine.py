@@ -184,6 +184,11 @@ class Engine:
             return False
 
     def _complete(self, block):
+        # publish the exception BEFORE releasing vars: a waiter woken by
+        # the release must find it in check_exceptions (no race window)
+        if block.exc is not None:
+            with self._pending_lock:
+                self._exceptions.append(block.exc)
         for v in block.const_vars:
             self._release(v, is_write=False)
         for v in block.mutable_vars:
@@ -191,8 +196,6 @@ class Engine:
         block.done.set()
         with self._pending_lock:
             self._pending -= 1
-            if block.exc is not None:
-                self._exceptions.append(block.exc)
             if self._pending == 0:
                 self._all_done.notify_all()
 

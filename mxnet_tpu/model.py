@@ -125,27 +125,40 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     else np.array(v, copy=True))
                 for k, v in save_dict.items()}
 
-    def _write():
-        import os
+    stage_async_write(
+        param_name, lambda tmp: nd.save(tmp, snapshot),
+        on_done=lambda: logging.info('Saved checkpoint (async) to "%s"',
+                                     param_name))
 
+
+def stage_async_write(path, writer, on_done=None):
+    """Stage an ATOMIC background file write tracked by
+    :func:`wait_checkpoints`: ``writer(tmp_path)`` produces the file,
+    which is renamed over ``path`` only on success; failures are
+    recorded per path and re-raised at wait time.  Shared by
+    FeedForward/Module checkpoints and ShardedTrainer checkpoints."""
+    import os
+
+    def _write():
         # pid + thread id: two concurrent in-process saves to the same
-        # prefix+epoch must not share (and tear) a temp file
-        tmp = f"{param_name}.tmp.{os.getpid()}.{threading.get_ident()}"
+        # path must not share (and tear) a temp file
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
         try:
-            nd.save(tmp, snapshot)  # numpy-valued; no device round-trip
-            os.replace(tmp, param_name)
-            logging.info('Saved checkpoint (async) to "%s"', param_name)
+            writer(tmp)
+            os.replace(tmp, path)
+            if on_done is not None:
+                on_done()
         except BaseException as e:
             try:
                 os.remove(tmp)
             except OSError:
                 pass
             with _async_saves_lock:
-                _async_errors.append((param_name, e))
+                _async_errors.append((path, e))
             raise
 
     t = threading.Thread(target=_write, daemon=False,
-                         name=f"ckpt-{epoch:04d}")
+                         name=f"ckpt-write")
     t.start()  # start BEFORE registering: a pre-start thread is not
     with _async_saves_lock:  # alive and a concurrent prune would drop it
         _async_saves[:] = [x for x in _async_saves if x.is_alive()]

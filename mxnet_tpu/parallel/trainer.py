@@ -472,28 +472,51 @@ class ShardedTrainer:
         return metric
 
     # -- checkpointing ------------------------------------------------------
-    def save_checkpoint(self, prefix, epoch=0):
+    def save_checkpoint(self, prefix, epoch=0, async_save=False):
         """Two-artifact checkpoint (reference model.save contract:
-        symbol JSON + params blob) plus the optimizer state, so a
-        sharded run resumes exactly."""
+        symbol JSON + params blob) plus the optimizer state + RNG key,
+        so a sharded run resumes exactly.
+
+        ``async_save=True`` gives orbax-style semantics: the
+        device->host snapshot happens now (later steps cannot corrupt
+        it); serialization + file IO run on background writers with
+        atomic temp-file renames (shared machinery with
+        ``model.save_checkpoint``).  Call :meth:`wait_checkpoints` (or
+        ``mx.model.wait_checkpoints()``) before relying on the files."""
         import pickle
 
-        from .. import ndarray as nd
+        from .. import model as model_mod
 
-        self.symbol.save(f"{prefix}-symbol.json")
-        params = {f"arg:{k}": nd.array(v)
-                  for k, v in self.get_params().items()}
-        params.update({f"aux:{k}": nd.array(np.asarray(jax.device_get(v)))
-                       for k, v in self.aux.items()})
-        nd.save(f"{prefix}-{epoch:04d}.params", params)
+        # plain-numpy snapshot: nd.save serializes numpy directly, so no
+        # host->device->host round-trip for large param sets
+        arg_params = self.get_params()
+        aux_params = {k: np.asarray(jax.device_get(v))
+                      for k, v in self.aux.items()}
+        model_mod.save_checkpoint(prefix, epoch, self.symbol, arg_params,
+                                  aux_params, async_save=async_save)
         opt_host = jax.tree_util.tree_map(
             lambda x: np.asarray(jax.device_get(x)), self.opt_state)
         # the RNG key is part of exact-resume state: dropout chains must
         # continue where the interrupted run left off
-        blob = {"opt_state": opt_host,
-                "rng_key": np.asarray(jax.device_get(self._key))}
-        with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
-            f.write(pickle.dumps(blob))
+        blob = pickle.dumps({"opt_state": opt_host,
+                             "rng_key": np.asarray(jax.device_get(self._key))})
+        states_name = f"{prefix}-{epoch:04d}.states"
+
+        def write_states(path):
+            with open(path, "wb") as f:
+                f.write(blob)
+
+        if async_save:
+            model_mod.stage_async_write(states_name, write_states)
+        else:
+            write_states(states_name)
+
+    def wait_checkpoints(self):
+        """Block until in-flight async checkpoint writes are on disk,
+        surfacing any write failure (per-file attribution)."""
+        from .. import model as model_mod
+
+        model_mod.wait_checkpoints()
 
     def load_checkpoint(self, prefix, epoch=0):
         """Restore params, aux and optimizer state with the trainer's
