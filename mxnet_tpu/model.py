@@ -98,7 +98,7 @@ _async_saves_lock = threading.Lock()
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
-                    async_save=False):
+                    async_save=False, snapshot_owned=False):
     """Two-artifact checkpoint: ``prefix-symbol.json`` +
     ``prefix-####.params`` (reference model.py:318-347).
 
@@ -108,7 +108,12 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     atomically renamed on completion, so training never waits on storage
     and a crash mid-write cannot leave a torn checkpoint.  Call
     ``wait_checkpoints()`` (or exit the process cleanly) before relying
-    on the file."""
+    on the file.
+
+    ``snapshot_owned=True`` declares the passed arrays are fresh copies
+    the caller will not mutate (e.g. ShardedTrainer.get_params output),
+    skipping the defensive per-array copy — avoids a second full host
+    copy of large models."""
     if symbol is not None:
         symbol.save(f"{prefix}-symbol.json")
     save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
@@ -121,9 +126,12 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     # synchronous snapshot: values are pinned to host numpy NOW (copy=True
     # — np.asarray would alias caller-owned numpy arrays that training
     # keeps mutating in place), so later updates can't leak into the file
-    snapshot = {k: (v.asnumpy() if hasattr(v, "asnumpy")
-                    else np.array(v, copy=True))
-                for k, v in save_dict.items()}
+    if snapshot_owned:
+        snapshot = save_dict
+    else:
+        snapshot = {k: (v.asnumpy() if hasattr(v, "asnumpy")
+                        else np.array(v, copy=True))
+                    for k, v in save_dict.items()}
 
     stage_async_write(
         param_name, lambda tmp: nd.save(tmp, snapshot),
@@ -157,8 +165,10 @@ def stage_async_write(path, writer, on_done=None):
                 _async_errors.append((path, e))
             raise
 
+    import os as _os
+
     t = threading.Thread(target=_write, daemon=False,
-                         name=f"ckpt-write")
+                         name=f"ckpt-{_os.path.basename(path)}")
     t.start()  # start BEFORE registering: a pre-start thread is not
     with _async_saves_lock:  # alive and a concurrent prune would drop it
         _async_saves[:] = [x for x in _async_saves if x.is_alive()]

@@ -493,7 +493,8 @@ class ShardedTrainer:
         aux_params = {k: np.asarray(jax.device_get(v))
                       for k, v in self.aux.items()}
         model_mod.save_checkpoint(prefix, epoch, self.symbol, arg_params,
-                                  aux_params, async_save=async_save)
+                                  aux_params, async_save=async_save,
+                                  snapshot_owned=True)
         opt_host = jax.tree_util.tree_map(
             lambda x: np.asarray(jax.device_get(x)), self.opt_state)
         # the RNG key is part of exact-resume state: dropout chains must

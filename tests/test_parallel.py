@@ -319,9 +319,14 @@ def test_async_checkpoint_overlaps_and_restores(tmp_path):
     for k, v in tr2.get_params().items():
         np.testing.assert_allclose(v, snap[k], atol=1e-6)
 
-    # failure surfacing: unwritable prefix raises (sync symbol write or
-    # async param write — either way the error must not be swallowed)
-    with pytest.raises(Exception):
-        tr.save_checkpoint(str(tmp_path / "nodir" / "x"), 2,
-                           async_save=True)
+    # failure surfacing: an async writer that fails must re-raise at
+    # wait_checkpoints (exercises the staged path, not the sync
+    # symbol.save precheck)
+    from mxnet_tpu import model as model_mod
+
+    def bad_writer(tmp):
+        raise OSError("disk full")
+
+    model_mod.stage_async_write(str(tmp_path / "bad.bin"), bad_writer)
+    with pytest.raises(Exception, match="disk full"):
         tr.wait_checkpoints()

@@ -75,6 +75,24 @@ def _():
                      name="rnn")
     return net, {"data": (5, 2, 4)}, {}
 
+@case("flash_attention_causal")
+def _():
+    # real Pallas kernel on TPU vs the interpreter on CPU, including the
+    # causal block-skip path
+    q = mx.sym.Variable("q")
+    k = mx.sym.Variable("k")
+    v = mx.sym.Variable("v")
+    net = mx.sym.FlashAttention(q, k, v, causal=True)
+    shp = (2, 2, 16, 8)
+    return net, {"q": shp, "k": shp, "v": shp}, {}
+
+@case("layernorm_gelu")
+def _():
+    data = mx.sym.Variable("data")
+    net = mx.sym.LayerNorm(data, name="ln")
+    net = mx.sym.gelu(net)
+    return net, {"data": (4, 32)}, {}
+
 name = sys.argv[1]
 sym, shapes, aux_init = cases[name]()
 rng = np.random.RandomState(0)
@@ -122,7 +140,9 @@ def _run(case, tpu):
 
 
 @pytest.mark.parametrize("case", ["conv_bn_relu", "fc_softmax",
-                                  "pool_flatten_dot", "rnn_lstm"])
+                                  "pool_flatten_dot", "rnn_lstm",
+                                  "flash_attention_causal",
+                                  "layernorm_gelu"])
 def test_tpu_matches_cpu(case):
     cpu = _run(case, tpu=False)
     tpu = _run(case, tpu=True)
