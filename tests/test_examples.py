@@ -318,3 +318,12 @@ def test_notebook_executes(name):
             continue
         code = "".join(cell["source"])
         exec(compile(code, f"{name}.ipynb", "exec"), ns)  # noqa: S102
+
+
+def test_example_char_rnn_runs(capsys):
+    _run_example("char_rnn.py", ["--epochs", "4", "--sample-len", "32"])
+    out = capsys.readouterr().out
+    assert "char-rnn sample cycle accuracy" in out
+    # trained stepwise sampler must reproduce the cycle far above chance
+    acc = float(out.rsplit("accuracy", 1)[1].split()[0])
+    assert acc > 0.8, out
