@@ -298,6 +298,73 @@ int MXTPUKVStoreSendCommandToServers(KVStoreHandle handle, int head,
                                      const char* body);
 int MXTPUKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int* out);
 
+/* ---- NDArray raw/blocking tail ---- */
+int MXTPUNDArrayWaitToRead(NDArrayHandle handle);
+int MXTPUNDArrayWaitToWrite(NDArrayHandle handle);
+/* Self-describing single-array blob; buffer owned by the handle until
+ * the next call on it. */
+int MXTPUNDArraySaveRawBytes(NDArrayHandle handle, uint64_t* out_size,
+                             const char** out_buf);
+int MXTPUNDArrayLoadFromRawBytes(const void* buf, uint64_t size,
+                                 int dev_type, int dev_id,
+                                 NDArrayHandle* out);
+
+/* ---- Symbol tail ---- */
+int MXTPUSymbolCreateFromFile(const char* path, SymbolHandle* out);
+int MXTPUSymbolCreateGroup(uint32_t n, SymbolHandle* symbols,
+                           SymbolHandle* out);
+int MXTPUSymbolGetName(SymbolHandle sym, const char** out);
+/* Dtype inference: codes as in the dtype table above, -1 = unknown. */
+int MXTPUSymbolInferType(SymbolHandle sym, uint32_t num_args,
+                         const char** keys, const int* arg_types,
+                         uint32_t* in_size, const int** in_types,
+                         uint32_t* out_size, const int** out_types,
+                         uint32_t* aux_size, const int** aux_types,
+                         int* complete);
+/* Non-recursive attribute pairs [k0, v0, ...]. */
+int MXTPUSymbolListAttrShallow(SymbolHandle sym, int* out_size,
+                               const char*** out);
+
+/* ---- DataIter tail ---- */
+int MXTPUDataIterGetIndex(DataIterHandle handle, uint64_t* out_size,
+                          const uint64_t** out_index);
+
+/* ---- imperative optimizer (MXOptimizer*) ---- */
+typedef void* OptimizerHandle;
+int MXTPUOptimizerCreateOptimizer(const char* name, int n_param,
+                                  const char** keys, const char** vals,
+                                  OptimizerHandle* out);
+/* Stateful in-place weight update; per-index optimizer state lives in
+ * the handle. */
+int MXTPUOptimizerUpdate(OptimizerHandle handle, int index,
+                         NDArrayHandle weight, NDArrayHandle grad);
+int MXTPUOptimizerFree(OptimizerHandle handle);
+
+/* ---- RecordIO reader/writer (MXRecordIO*) ---- */
+typedef void* RecordIOHandle;
+int MXTPURecordIOWriterCreate(const char* path, RecordIOHandle* out);
+int MXTPURecordIOReaderCreate(const char* path, RecordIOHandle* out);
+int MXTPURecordIOWriterWriteRecord(RecordIOHandle handle, const void* buf,
+                                   uint64_t size);
+int MXTPURecordIOWriterTell(RecordIOHandle handle, uint64_t* out);
+/* Next record payload; *out_size == 0 at end of file; buffer owned by
+ * the handle until the next call. */
+int MXTPURecordIOReaderReadRecord(RecordIOHandle handle, uint64_t* out_size,
+                                  const char** out_buf);
+/* Rewind to the first record. */
+int MXTPURecordIOReaderSeek(RecordIOHandle handle);
+int MXTPURecordIOClose(RecordIOHandle handle);
+
+/* ---- PS roles / lifecycle ---- */
+int MXTPUKVStoreIsWorkerNode(int* out);
+int MXTPUKVStoreIsServerNode(int* out);
+int MXTPUKVStoreIsSchedulerNode(int* out);
+/* Enter the blocking server loop when launched in the server role. */
+int MXTPUKVStoreRunServer(KVStoreHandle handle);
+int MXTPUInitPSEnv(int num, const char** keys, const char** vals);
+/* Drain the host engine before process teardown (MXNotifyShutdown). */
+int MXTPUNotifyShutdown(void);
+
 /* ---- profiler / misc ---- */
 int MXTPUProfilerStart(const char* logdir);
 int MXTPUProfilerStop(void);

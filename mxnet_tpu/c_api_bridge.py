@@ -496,3 +496,155 @@ def profiler_stop():
 def get_version():
     from . import __version__
     return str(__version__)
+
+
+# -- completion of the reference entry-point surface ------------------------
+
+def nd_save_raw(nd):
+    """MXNDArraySaveRawBytes: self-describing single-array blob."""
+    import io as _io
+    buf = _io.BytesIO()
+    np.save(buf, np.ascontiguousarray(nd.asnumpy()), allow_pickle=False)
+    return buf.getvalue()
+
+
+def nd_load_raw(data, dev_type, dev_id):
+    import io as _io
+    from .ndarray import NDArray
+    arr = np.load(_io.BytesIO(bytes(data)), allow_pickle=False)
+    return NDArray(arr, ctx=_ctx(dev_type, dev_id))
+
+
+def nd_wait_to_read(nd):
+    nd.wait_to_read()
+    return True
+
+
+def nd_wait_to_write(nd):
+    nd.wait_to_write()
+    return True
+
+
+def symbol_from_file(path):
+    from . import symbol
+    return symbol.load(path)
+
+
+def symbol_group(syms):
+    from . import symbol
+    return symbol.Group(list(syms))
+
+
+def symbol_name(sym):
+    return sym.name or ""
+
+
+def symbol_infer_type(sym, keys, dtype_codes):
+    """(complete, arg_codes, out_codes, aux_codes) with -1 = unknown."""
+    # -1 input codes mean "no constraint" — never index the dtype table
+    kwargs = {k: _np_dtype(c) for k, c in zip(keys, dtype_codes)
+              if c >= 0}
+    arg_t, out_t, aux_t = sym.infer_type(**kwargs)
+    if arg_t is None:
+        return False, [], [], []
+
+    def codes(ts):
+        out = []
+        for t in ts:
+            try:
+                out.append(_dtype_code(t) if t is not None else -1)
+            except ValueError:
+                out.append(-1)
+        return out
+
+    return True, codes(arg_t), codes(out_t), codes(aux_t)
+
+
+def dataiter_index(h):
+    idx = getattr(h.batch, "index", None)
+    if idx is None:
+        return []
+    return [int(i) for i in np.asarray(idx).reshape(-1)]
+
+
+# imperative optimizer surface (MXOptimizerCreateOptimizer/Update/Free):
+# a stateful updater closure per handle, state keyed by index
+def optimizer_create(name, keys, vals):
+    from .optimizer import Optimizer, get_updater
+    opt = Optimizer.create_optimizer(name, **_parse_kwargs(keys, vals))
+    return get_updater(opt)
+
+
+def optimizer_update(updater, index, weight, grad):
+    updater(int(index), grad, weight)
+    return True
+
+
+def recordio_writer_create(path):
+    from .recordio import MXRecordIO
+    return MXRecordIO(path, "w")
+
+
+def recordio_reader_create(path):
+    from .recordio import MXRecordIO
+    return MXRecordIO(path, "r")
+
+
+def recordio_write(h, data):
+    h.write(bytes(data))
+    return True
+
+
+def recordio_read(h):
+    out = h.read()
+    return b"" if out is None else out
+
+
+def recordio_tell(h):
+    return int(h.tell())
+
+
+def recordio_reset(h):
+    h.reset()
+    return True
+
+
+def recordio_close(h):
+    h.close()
+    return True
+
+
+def kvstore_role():
+    """'worker' | 'server' | 'scheduler' from the launcher env
+    (reference DMLC_ROLE); single source of truth is kvstore_server."""
+    import os
+    from .kvstore_server import server_role
+    if server_role():
+        return "server"
+    return os.environ.get("DMLC_ROLE",
+                          os.environ.get("MXTPU_ROLE", "worker")) or "worker"
+
+
+def kvstore_run_server(kv):
+    """Enter the blocking server loop when launched in the server role
+    (MXKVStoreRunServer; ``kv`` kept for ABI fidelity — the server is
+    self-contained); returns immediately for workers."""
+    from .kvstore_server import _init_kvstore_server_module, server_role
+    if not server_role():
+        return False
+    _init_kvstore_server_module()
+    return True
+
+
+def init_ps_env(keys, vals):
+    import os
+    for k, v in zip(keys, vals):
+        os.environ[str(k)] = str(v)
+    return True
+
+
+def notify_shutdown():
+    """MXNotifyShutdown: drain the host engine before teardown."""
+    from .engine import get_engine
+    get_engine().wait_for_all()
+    return True

@@ -47,6 +47,7 @@ struct Obj {
   std::vector<std::vector<uint32_t>> shapes[3];
   std::vector<uint32_t> ndims[3];
   std::vector<const uint32_t*> shape_ptrs[3];
+  std::vector<uint64_t> u64s;  // typed snapshot (DataIterGetIndex)
 };
 
 Obj* Wrap(PyObject* o) {
@@ -979,6 +980,313 @@ int MXTPUGetVersion(const char** out) {
   Py_DECREF(r);
   *out = version.c_str();
   return 0;
+}
+
+}  // extern "C"
+
+// ---- remaining reference-surface entries ----------------------------------
+
+extern "C" {
+
+int MXTPUNDArrayWaitToRead(NDArrayHandle handle) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("nd_wait_to_read", "(O)", Borrow(handle)));
+}
+
+int MXTPUNDArrayWaitToWrite(NDArrayHandle handle) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("nd_wait_to_write", "(O)", Borrow(handle)));
+}
+
+int MXTPUNDArraySaveRawBytes(NDArrayHandle handle, uint64_t* out_size,
+                             const char** out_buf) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  Obj* h = static_cast<Obj*>(handle);
+  PyObject* r = CallBridge("nd_save_raw", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  char* raw = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &raw, &n) != 0) {
+    Py_DECREF(r);
+    SetErrorFromPython();
+    return -1;
+  }
+  h->scratch.assign(raw, static_cast<size_t>(n));
+  Py_DECREF(r);
+  *out_size = static_cast<uint64_t>(h->scratch.size());
+  *out_buf = h->scratch.data();
+  return 0;
+}
+
+int MXTPUNDArrayLoadFromRawBytes(const void* buf, uint64_t size,
+                                 int dev_type, int dev_id,
+                                 NDArrayHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("nd_load_raw", "(y#ii)",
+                           static_cast<const char*>(buf),
+                           static_cast<Py_ssize_t>(size), dev_type, dev_id);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPUSymbolCreateFromFile(const char* path, SymbolHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("symbol_from_file", "(s)", path);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPUSymbolCreateGroup(uint32_t n, SymbolHandle* symbols,
+                           SymbolHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* lst = HandleList(n, symbols);
+  PyObject* r = CallBridge("symbol_group", "(O)", lst);
+  Py_DECREF(lst);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPUSymbolGetName(SymbolHandle sym, const char** out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  Obj* h = static_cast<Obj*>(sym);
+  PyObject* r = CallBridge("symbol_name", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  const char* c = PyUnicode_AsUTF8(r);
+  h->scratch = c ? c : "";
+  Py_DECREF(r);
+  *out = h->scratch.c_str();
+  return 0;
+}
+
+int MXTPUSymbolInferType(SymbolHandle sym, uint32_t num_args,
+                         const char** keys, const int* arg_types,
+                         uint32_t* in_size, const int** in_types,
+                         uint32_t* out_size, const int** out_types,
+                         uint32_t* aux_size, const int** aux_types,
+                         int* complete) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  Obj* h = static_cast<Obj*>(sym);
+  PyObject* key_list = StrList(static_cast<int>(num_args), keys);
+  PyObject* code_list = IntList(static_cast<int>(num_args), arg_types);
+  PyObject* r = CallBridge("symbol_infer_type", "(OOO)", h->obj, key_list,
+                           code_list);
+  Py_DECREF(key_list);
+  Py_DECREF(code_list);
+  if (r == nullptr) return -1;
+  *complete = PyObject_IsTrue(PyTuple_GET_ITEM(r, 0));
+  // reuse the uint32 shape snapshots as int storage (codes fit)
+  static_assert(sizeof(uint32_t) == sizeof(int), "code storage");
+  uint32_t* sizes[3] = {in_size, out_size, aux_size};
+  const int** outs[3] = {in_types, out_types, aux_types};
+  for (int g = 0; g < 3; ++g) {
+    PyObject* lst = PyTuple_GET_ITEM(r, g + 1);
+    Py_ssize_t n = PySequence_Size(lst);
+    h->shapes[g].assign(1, {});
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* it = PySequence_GetItem(lst, i);
+      h->shapes[g][0].push_back(
+          static_cast<uint32_t>(PyLong_AsLong(it)));
+      Py_XDECREF(it);
+    }
+    *sizes[g] = static_cast<uint32_t>(n);
+    *outs[g] = reinterpret_cast<const int*>(h->shapes[g][0].data());
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUSymbolListAttrShallow(SymbolHandle sym, int* out_size,
+                               const char*** out) {
+  // flattened non-recursive [k, v, ...] pairs
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  Obj* h = static_cast<Obj*>(sym);
+  PyObject* r = CallBridge("symbol_list_attr", "(Oi)", h->obj, 0);
+  if (r == nullptr) return -1;
+  return SnapshotStrs(h, r, out_size, out);
+}
+
+int MXTPUDataIterGetIndex(DataIterHandle handle, uint64_t* out_size,
+                          const uint64_t** out_index) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  Obj* h = static_cast<Obj*>(handle);
+  PyObject* r = CallBridge("dataiter_index", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PySequence_Size(r);
+  h->u64s.resize(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* it = PySequence_GetItem(r, i);
+    h->u64s[static_cast<size_t>(i)] =
+        static_cast<uint64_t>(PyLong_AsUnsignedLongLong(it));
+    Py_XDECREF(it);
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<uint64_t>(n);
+  *out_index = h->u64s.data();
+  return 0;
+}
+
+// ---- imperative optimizer (MXOptimizer*) ----------------------------------
+
+int MXTPUOptimizerCreateOptimizer(const char* name, int n_param,
+                                  const char** keys, const char** vals,
+                                  OptimizerHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* k = StrList(n_param, keys);
+  PyObject* v = StrList(n_param, vals);
+  PyObject* r = CallBridge("optimizer_create", "(sOO)", name, k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPUOptimizerUpdate(OptimizerHandle handle, int index,
+                         NDArrayHandle weight, NDArrayHandle grad) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("optimizer_update", "(OiOO)", Borrow(handle),
+                         index, Borrow(weight), Borrow(grad)));
+}
+
+int MXTPUOptimizerFree(OptimizerHandle handle) { return FreeHandle(handle); }
+
+// ---- RecordIO reader/writer (MXRecordIO*) ---------------------------------
+
+int MXTPURecordIOWriterCreate(const char* path, RecordIOHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("recordio_writer_create", "(s)", path);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPURecordIOReaderCreate(const char* path, RecordIOHandle* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("recordio_reader_create", "(s)", path);
+  if (r == nullptr) return -1;
+  *out = Wrap(r);
+  return 0;
+}
+
+int MXTPURecordIOWriterWriteRecord(RecordIOHandle handle, const void* buf,
+                                   uint64_t size) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("recordio_write", "(Oy#)", Borrow(handle),
+                         static_cast<const char*>(buf),
+                         static_cast<Py_ssize_t>(size)));
+}
+
+int MXTPURecordIOWriterTell(RecordIOHandle handle, uint64_t* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("recordio_tell", "(O)", Borrow(handle));
+  if (r == nullptr) return -1;
+  *out = static_cast<uint64_t>(PyLong_AsUnsignedLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+// Next record payload; *out_size == 0 at end of file.
+int MXTPURecordIOReaderReadRecord(RecordIOHandle handle, uint64_t* out_size,
+                                  const char** out_buf) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  Obj* h = static_cast<Obj*>(handle);
+  PyObject* r = CallBridge("recordio_read", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  char* raw = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &raw, &n) != 0) {
+    Py_DECREF(r);
+    SetErrorFromPython();
+    return -1;
+  }
+  h->scratch.assign(raw, static_cast<size_t>(n));
+  Py_DECREF(r);
+  *out_size = static_cast<uint64_t>(h->scratch.size());
+  *out_buf = h->scratch.data();
+  return 0;
+}
+
+int MXTPURecordIOReaderSeek(RecordIOHandle handle) {
+  // rewind to the first record (reset); byte-offset seeks are not part
+  // of the sequential-reader contract here
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("recordio_reset", "(O)", Borrow(handle)));
+}
+
+int MXTPURecordIOClose(RecordIOHandle handle) {
+  if (!EnsurePython()) return -1;
+  int rc;
+  {
+    GILGuard gil;
+    // a failed close (flush error on a full disk) must surface: the
+    // caller would otherwise believe the records were durably written
+    rc = Done(CallBridge("recordio_close", "(O)", Borrow(handle)));
+  }
+  FreeHandle(handle);
+  return rc;
+}
+
+// ---- PS roles / lifecycle --------------------------------------------------
+
+static int RoleIs(const char* want, int* out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* r = CallBridge("kvstore_role", "()");
+  if (r == nullptr) return -1;
+  const char* c = PyUnicode_AsUTF8(r);
+  *out = (c != nullptr && std::strcmp(c, want) == 0) ? 1 : 0;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUKVStoreIsWorkerNode(int* out) { return RoleIs("worker", out); }
+int MXTPUKVStoreIsServerNode(int* out) { return RoleIs("server", out); }
+int MXTPUKVStoreIsSchedulerNode(int* out) {
+  return RoleIs("scheduler", out);
+}
+
+int MXTPUKVStoreRunServer(KVStoreHandle handle) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("kvstore_run_server", "(O)", Borrow(handle)));
+}
+
+int MXTPUInitPSEnv(int num, const char** keys, const char** vals) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject* k = StrList(num, keys);
+  PyObject* v = StrList(num, vals);
+  int rc = Done(CallBridge("init_ps_env", "(OO)", k, v));
+  Py_DECREF(k);
+  Py_DECREF(v);
+  return rc;
+}
+
+int MXTPUNotifyShutdown(void) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  return Done(CallBridge("notify_shutdown", "()"));
 }
 
 }  // extern "C"

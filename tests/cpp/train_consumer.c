@@ -119,6 +119,90 @@ static void extended_surface_check(void) {
   CHK(MXTPUNDArrayFree(grad));
   CHK(MXTPUNDArrayFree(out));
   CHK(MXTPUKVStoreFree(kv));
+
+  /* raw-bytes roundtrip */
+  uint32_t rshp[2] = {2, 3};
+  NDArrayHandle ra, rb;
+  CHK(MXTPUNDArrayCreate(rshp, 2, 0, 1, 0, &ra));
+  float rv[6] = {1, 2, 3, 4, 5, 6};
+  CHK(MXTPUNDArraySyncCopyFromCPU(ra, rv, sizeof rv));
+  uint64_t blob_n;
+  const char* blob;
+  CHK(MXTPUNDArraySaveRawBytes(ra, &blob_n, &blob));
+  CHK(MXTPUNDArrayLoadFromRawBytes(blob, blob_n, 1, 0, &rb));
+  float rv2[6];
+  CHK(MXTPUNDArraySyncCopyToCPU(rb, rv2, sizeof rv2));
+  for (int i = 0; i < 6; ++i)
+    if (rv2[i] != rv[i]) { fprintf(stderr, "FAIL raw\n"); exit(1); }
+  CHK(MXTPUNDArrayWaitToRead(ra));
+  CHK(MXTPUNDArrayFree(ra));
+  CHK(MXTPUNDArrayFree(rb));
+
+  /* imperative optimizer: one SGD step */
+  OptimizerHandle opt;
+  {
+    const char* k[] = {"learning_rate"};
+    const char* v[] = {"0.5"};
+    CHK(MXTPUOptimizerCreateOptimizer("sgd", 1, k, v, &opt));
+  }
+  NDArrayHandle ow, og;
+  uint32_t oshp[1] = {3};
+  CHK(MXTPUNDArrayCreate(oshp, 1, 0, 1, 0, &ow));
+  CHK(MXTPUNDArrayCreate(oshp, 1, 0, 1, 0, &og));
+  float wv[3] = {1, 1, 1}, gv[3] = {2, 2, 2};
+  CHK(MXTPUNDArraySyncCopyFromCPU(ow, wv, sizeof wv));
+  CHK(MXTPUNDArraySyncCopyFromCPU(og, gv, sizeof gv));
+  CHK(MXTPUOptimizerUpdate(opt, 0, ow, og));
+  float wafter[3];
+  CHK(MXTPUNDArraySyncCopyToCPU(ow, wafter, sizeof wafter));
+  if (wafter[0] >= 1.0f) { fprintf(stderr, "FAIL opt update\n"); exit(1); }
+  CHK(MXTPUOptimizerFree(opt));
+  CHK(MXTPUNDArrayFree(ow));
+  CHK(MXTPUNDArrayFree(og));
+
+  /* recordio writer/reader roundtrip */
+  const char* rec_path = "/tmp/mxtpu_c_rec_test.rec";
+  RecordIOHandle wr, rd;
+  CHK(MXTPURecordIOWriterCreate(rec_path, &wr));
+  CHK(MXTPURecordIOWriterWriteRecord(wr, "hello", 5));
+  CHK(MXTPURecordIOWriterWriteRecord(wr, "worlds!", 7));
+  uint64_t pos;
+  CHK(MXTPURecordIOWriterTell(wr, &pos));
+  CHK(MXTPURecordIOClose(wr));
+  CHK(MXTPURecordIOReaderCreate(rec_path, &rd));
+  uint64_t rn;
+  const char* rec_buf;
+  CHK(MXTPURecordIOReaderReadRecord(rd, &rn, &rec_buf));
+  if (rn != 5 || strncmp(rec_buf, "hello", 5)) {
+    fprintf(stderr, "FAIL rec read\n"); exit(1);
+  }
+  CHK(MXTPURecordIOReaderSeek(rd));
+  CHK(MXTPURecordIOReaderReadRecord(rd, &rn, &rec_buf));
+  if (rn != 5) { fprintf(stderr, "FAIL rec seek\n"); exit(1); }
+  CHK(MXTPURecordIOClose(rd));
+
+  /* symbol group/name/infer-type */
+  SymbolHandle va, vb, grp;
+  CHK(MXTPUSymbolCreateVariable("a", &va));
+  CHK(MXTPUSymbolCreateVariable("b", &vb));
+  SymbolHandle pair[2] = {va, vb};
+  CHK(MXTPUSymbolCreateGroup(2, pair, &grp));
+  int nouts_sz;
+  const char** outs_names;
+  CHK(MXTPUSymbolListOutputs(grp, &nouts_sz, &outs_names));
+  if (nouts_sz != 2) { fprintf(stderr, "FAIL group\n"); exit(1); }
+  const char* nm;
+  CHK(MXTPUSymbolGetName(va, &nm));
+  if (strcmp(nm, "a")) { fprintf(stderr, "FAIL name\n"); exit(1); }
+  CHK(MXTPUSymbolFree(va));
+  CHK(MXTPUSymbolFree(vb));
+  CHK(MXTPUSymbolFree(grp));
+
+  /* roles + lifecycle */
+  int is_worker = 0;
+  CHK(MXTPUKVStoreIsWorkerNode(&is_worker));
+  if (!is_worker) { fprintf(stderr, "FAIL role\n"); exit(1); }
+  CHK(MXTPUNotifyShutdown());
   fprintf(stderr, "extended C surface ok (version %s)\n", version);
 }
 
