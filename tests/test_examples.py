@@ -327,3 +327,30 @@ def test_example_char_rnn_runs(capsys):
     # trained stepwise sampler must reproduce the cycle far above chance
     acc = float(out.rsplit("accuracy", 1)[1].split()[0])
     assert acc > 0.8, out
+
+
+def test_example_cpp_train_mlp(tmp_path):
+    """The user-facing C++ training example compiles and converges."""
+    import shutil
+    import subprocess
+
+    from mxnet_tpu.libinfo import find_lib
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ compiler")
+    if find_lib() is None:
+        pytest.skip("native lib unavailable")
+    exe = str(tmp_path / "train_mlp")
+    subprocess.run(
+        ["g++", "-std=c++17", os.path.join(REPO, "examples", "cpp",
+                                           "train_mlp.cc"),
+         "-I" + os.path.join(REPO, "include"),
+         "-L" + os.path.join(REPO, "mxnet_tpu", "lib"), "-lmxtpu",
+         "-Wl,-rpath," + os.path.join(REPO, "mxnet_tpu", "lib"),
+         "-o", exe], check=True, capture_output=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([exe], capture_output=True, text=True, timeout=280,
+                       env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    assert "accuracy over final steps" in r.stdout
