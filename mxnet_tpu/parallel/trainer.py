@@ -71,16 +71,17 @@ def sgd_opt(learning_rate=0.01, momentum=0.9, weight_decay=0.0):
             return {}
         return {k: jnp.zeros_like(v) for k, v in params.items()}
 
-    def update(grads, state, params):
+    def update(grads, state, params, lr_scale=1.0):
+        lr = learning_rate * lr_scale
         new_params, new_state = {}, {}
         for k, p in params.items():
             g = grads[k].astype(jnp.float32) + weight_decay * p.astype(jnp.float32)
             if momentum != 0.0:
-                m = momentum * state[k].astype(jnp.float32) - learning_rate * g
+                m = momentum * state[k].astype(jnp.float32) - lr * g
                 new_state[k] = m.astype(p.dtype)
                 new_params[k] = (p.astype(jnp.float32) + m).astype(p.dtype)
             else:
-                new_params[k] = (p.astype(jnp.float32) - learning_rate * g).astype(p.dtype)
+                new_params[k] = (p.astype(jnp.float32) - lr * g).astype(p.dtype)
         return new_params, new_state
 
     return init, update
@@ -99,10 +100,11 @@ def adam_opt(learning_rate=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
                               for k, val in params.items()},
                 "t": jnp.zeros((), jnp.int32)}
 
-    def update(grads, state, params):
+    def update(grads, state, params, lr_scale=1.0):
         t = state["t"] + 1
-        lr_t = learning_rate * jnp.sqrt(1 - beta2**t.astype(jnp.float32)) / (
-            1 - beta1**t.astype(jnp.float32))
+        lr_t = (learning_rate * lr_scale
+                * jnp.sqrt(1 - beta2**t.astype(jnp.float32))
+                / (1 - beta1**t.astype(jnp.float32)))
         new_params, new_m, new_v = {}, {}, {}
         for k, p in params.items():
             pf = p.astype(jnp.float32)
@@ -113,7 +115,8 @@ def adam_opt(learning_rate=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
             v = beta2 * state["v"][k] + (1 - beta2) * jnp.square(g)
             new_m[k], new_v[k] = m, v
             if decoupled:
-                pf = pf * (1.0 - learning_rate * weight_decay)
+                # decay strength follows the SCHEDULED lr (standard AdamW)
+                pf = pf * (1.0 - learning_rate * lr_scale * weight_decay)
             new_params[k] = (pf - lr_t * m
                              / (jnp.sqrt(v) + eps)).astype(p.dtype)
         return new_params, {"m": new_m, "v": new_v, "t": t}
@@ -154,13 +157,17 @@ class ShardedTrainer:
         replicated params shard over the data axis, cutting optimizer
         memory by the dp degree; math is unchanged (XLA gathers shards
         where the update needs them)
+    lr_scheduler : ``mx.lr_scheduler.LRScheduler`` (or any
+        ``step -> lr`` callable) evaluated on host each step; the value
+        enters the compiled step as a traced scalar, so schedules
+        (warmup, factor decay, cosine) never trigger recompilation
     """
 
     def __init__(self, symbol, input_shapes, mesh=None, batch_axis="dp",
                  param_specs=None, sequence_specs=None, optimizer="sgd",
                  optimizer_params=None, initializer=None, dtype="float32",
                  input_dtypes=None, rescale_grad=None, grad_accum_steps=1,
-                 shard_optimizer_state=False):
+                 shard_optimizer_state=False, lr_scheduler=None):
         if mesh is None:
             from .mesh import local_mesh
 
@@ -223,11 +230,41 @@ class ShardedTrainer:
         self.aux = aux
 
         # -- optimizer ------------------------------------------------------
+        import inspect
+
         if isinstance(optimizer, str):
             opt_factory = _OPTS[optimizer]
             init_fn, update_fn = opt_factory(**(optimizer_params or {}))
+            # the scale denominator must be the optimizer's REAL base lr,
+            # including each factory's own default (sgd 0.01, adam 1e-3)
+            factory_default = inspect.signature(
+                opt_factory).parameters["learning_rate"].default
+            base_lr = float((optimizer_params or {}).get(
+                "learning_rate", factory_default))
         else:
             init_fn, update_fn = optimizer
+            base_lr = float((optimizer_params or {}).get(
+                "learning_rate", 1.0))
+            try:
+                inspect.signature(update_fn).bind(None, None, None, 1.0)
+            except TypeError:
+                # custom optimizers predating lr scaling cannot honor a
+                # schedule — refuse rather than silently train flat
+                if lr_scheduler is not None:
+                    raise MXNetError(
+                        "lr_scheduler requires the custom optimizer's "
+                        "update(grads, state, params, lr_scale) to accept "
+                        "a 4th lr_scale argument") from None
+                _inner_update = update_fn
+                update_fn = (lambda grads, state, params, lr_scale=1.0:
+                             _inner_update(grads, state, params))
+        self._lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and hasattr(lr_scheduler, "base_lr"):
+            # the reference optimizer wiring (optimizer.py:43-45): the
+            # scheduler's base lr IS the optimizer's lr
+            lr_scheduler.base_lr = base_lr
+        self._base_lr = base_lr
+        self._num_update = 0
         # param-shaped state (momentum etc.) inherits the param shardings
         # through zeros_like; scalar/odd-shaped leaves (Adam's step count)
         # must be pinned to the mesh explicitly or multi-device jit sees
@@ -302,7 +339,7 @@ class ShardedTrainer:
             head = tuple(jnp.ones_like(o) for o in outs)
             return vjp_fn(head)[0], new_aux, outs
 
-        def train_step(params, opt_state, aux, batch, key):
+        def train_step(params, opt_state, aux, batch, key, lr_scale):
             # split inside the step: the whole key chain lives on-device,
             # so each step is ONE program dispatch (a separate host-side
             # split program adds a dispatch gap per step)
@@ -347,7 +384,8 @@ class ShardedTrainer:
                     for o in outs_st)
             scale = self._rescale_grad
             grads = {k: g * scale for k, g in grads.items()}
-            new_params, new_opt = self._update_fn(grads, opt_state, params)
+            new_params, new_opt = self._update_fn(grads, opt_state, params,
+                                                  lr_scale)
             return new_params, new_opt, new_aux, outs, key
 
         def eval_step(params, aux, batch, key):
@@ -362,7 +400,7 @@ class ShardedTrainer:
         self._train_step = jax.jit(
             train_step,
             in_shardings=(p_shard, opt_shardings, aux_shardings,
-                          self.batch_shardings, rep),
+                          self.batch_shardings, rep, rep),
             out_shardings=(p_shard, opt_shardings, aux_shardings, None, rep),
             donate_argnums=(0, 1, 2),
         )
@@ -381,12 +419,20 @@ class ShardedTrainer:
             placed[name] = jax.device_put(v, self.batch_shardings[name])
         return placed
 
+    def _lr_scale(self):
+        """Host-side schedule evaluation -> traced scalar multiplier."""
+        self._num_update += 1
+        if self._lr_scheduler is None:
+            return np.float32(1.0)
+        lr = float(self._lr_scheduler(self._num_update))
+        return np.float32(lr / max(self._base_lr, 1e-30))
+
     def step(self, batch: dict):
         """One optimizer step on a global batch; returns outputs."""
         placed = self._place_batch(batch)
         self.params, self.opt_state, self.aux, outs, self._key = \
             self._train_step(self.params, self.opt_state, self.aux, placed,
-                             self._key)
+                             self._key, self._lr_scale())
         return outs
 
     def eval(self, batch: dict):
@@ -458,7 +504,7 @@ class ShardedTrainer:
                 placed, labels = item
                 self.params, self.opt_state, self.aux, outs, self._key = \
                     self._train_step(self.params, self.opt_state, self.aux,
-                                     placed, self._key)
+                                     placed, self._key, self._lr_scale())
                 nbatch += 1
                 if metric is not None and labels:
                     # host sync happens only when metrics are requested
@@ -499,8 +545,16 @@ class ShardedTrainer:
             lambda x: np.asarray(jax.device_get(x)), self.opt_state)
         # the RNG key is part of exact-resume state: dropout chains must
         # continue where the interrupted run left off
+        sched_state = None
+        if self._lr_scheduler is not None:
+            try:
+                sched_state = pickle.dumps(self._lr_scheduler)
+            except Exception:
+                sched_state = None  # unpicklable custom callable
         blob = pickle.dumps({"opt_state": opt_host,
-                             "rng_key": np.asarray(jax.device_get(self._key))})
+                             "rng_key": np.asarray(jax.device_get(self._key)),
+                             "num_update": self._num_update,
+                             "lr_scheduler": sched_state})
         states_name = f"{prefix}-{epoch:04d}.states"
 
         def write_states(path):
@@ -542,3 +596,10 @@ class ShardedTrainer:
             opt_host, self.opt_state)
         if isinstance(blob, dict) and "rng_key" in blob:
             self._key = jax.device_put(blob["rng_key"], self._replicated)
+        if isinstance(blob, dict):
+            self._num_update = int(blob.get("num_update", self._num_update))
+            if blob.get("lr_scheduler") is not None:
+                # stateful schedulers (factor counters) rewind with the
+                # checkpoint; without this an earlier checkpoint would
+                # resume at a permanently-decayed lr
+                self._lr_scheduler = pickle.loads(blob["lr_scheduler"])
