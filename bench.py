@@ -171,10 +171,14 @@ def _mfu_fields(net, unit_input_shapes, batch, n_iter, dt, n_chips):
 
 def _timed_steps(jax, trainer, placed, n_warmup, n_iter):
     """Shared warmup + timed-loop harness over a ShardedTrainer step."""
+    import numpy as np
+
+    one = np.float32(1.0)
+
     def step():
         trainer.params, trainer.opt_state, trainer.aux, outs, trainer._key = \
             trainer._train_step(trainer.params, trainer.opt_state,
-                                trainer.aux, placed, trainer._key)
+                                trainer.aux, placed, trainer._key, one)
         return outs
 
     for _ in range(n_warmup):

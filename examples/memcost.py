@@ -42,7 +42,7 @@ def compile_step(batch, hidden, depth):
         "data": rng.standard_normal((batch, hidden)).astype(np.float32),
         "softmax_label": rng.randint(0, 10, batch).astype(np.float32)})
     comp = tr._train_step.lower(tr.params, tr.opt_state, tr.aux, placed,
-                                tr._key).compile()
+                                tr._key, np.float32(1.0)).compile()
     mem = comp.memory_analysis()
     return mem
 

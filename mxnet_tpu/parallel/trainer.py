@@ -16,6 +16,8 @@ trainer is the performance path for pod-scale runs.
 
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 
 import jax
@@ -246,15 +248,26 @@ class ShardedTrainer:
             base_lr = float((optimizer_params or {}).get(
                 "learning_rate", 1.0))
             try:
-                inspect.signature(update_fn).bind(None, None, None, 1.0)
-            except TypeError:
+                sig = inspect.signature(update_fn)
+            except (TypeError, ValueError):
+                sig = None  # non-introspectable (C extension etc.)
+            if sig is not None:
+                # the 4th argument must actually BE the schedule hook —
+                # a probe that only checks arity would feed the traced
+                # multiplier into an unrelated parameter (clip etc.)
+                has_scale = ("lr_scale" in sig.parameters
+                             or any(p.kind is inspect.Parameter.VAR_KEYWORD
+                                    for p in sig.parameters.values()))
+            else:
+                has_scale = lr_scheduler is not None
+            if not has_scale:
                 # custom optimizers predating lr scaling cannot honor a
                 # schedule — refuse rather than silently train flat
                 if lr_scheduler is not None:
                     raise MXNetError(
                         "lr_scheduler requires the custom optimizer's "
                         "update(grads, state, params, lr_scale) to accept "
-                        "a 4th lr_scale argument") from None
+                        "an 'lr_scale' argument") from None
                 _inner_update = update_fn
                 update_fn = (lambda grads, state, params, lr_scale=1.0:
                              _inner_update(grads, state, params))
@@ -551,6 +564,11 @@ class ShardedTrainer:
                 sched_state = pickle.dumps(self._lr_scheduler)
             except Exception:
                 sched_state = None  # unpicklable custom callable
+                logging.warning(
+                    "lr_scheduler %r is not picklable; checkpoint will "
+                    "not carry scheduler state and a resumed run keeps "
+                    "the live scheduler object as-is",
+                    type(self._lr_scheduler).__name__)
         blob = pickle.dumps({"opt_state": opt_host,
                              "rng_key": np.asarray(jax.device_get(self._key)),
                              "num_update": self._num_update,
@@ -598,8 +616,12 @@ class ShardedTrainer:
             self._key = jax.device_put(blob["rng_key"], self._replicated)
         if isinstance(blob, dict):
             self._num_update = int(blob.get("num_update", self._num_update))
-            if blob.get("lr_scheduler") is not None:
+            if (blob.get("lr_scheduler") is not None
+                    and self._lr_scheduler is not None):
                 # stateful schedulers (factor counters) rewind with the
                 # checkpoint; without this an earlier checkpoint would
-                # resume at a permanently-decayed lr
+                # resume at a permanently-decayed lr.  Guarded on the
+                # trainer HAVING a scheduler: a trainer built with
+                # lr_scheduler=None (constant-lr fine-tune) must not
+                # silently inherit the checkpointed schedule
                 self._lr_scheduler = pickle.loads(blob["lr_scheduler"])
