@@ -11,6 +11,7 @@ from .mesh import Mesh, NamedSharding, PartitionSpec, make_mesh, local_mesh, \
 from .collectives import allreduce, allreduce_bench, psum, all_gather, \
     reduce_scatter, ppermute
 from .trainer import ShardedTrainer, sgd_opt, adam_opt, adamw_opt
+from .checkpoint import save_sharded, load_sharded
 from .ring_attention import ring_attention, attention_reference
 from .ulysses import ulysses_attention
 from .pipeline import pipeline_apply, PipelineModule
@@ -19,7 +20,8 @@ from .moe import moe_apply, moe_reference, MoELayer, init_moe_params
 __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "make_mesh", "local_mesh",
            "replicated", "shard_along", "allreduce", "allreduce_bench", "psum",
            "all_gather", "reduce_scatter", "ppermute", "ShardedTrainer",
-           "sgd_opt", "adam_opt", "adamw_opt", "ring_attention",
+           "sgd_opt", "adam_opt", "adamw_opt",
+           "save_sharded", "load_sharded", "ring_attention",
            "attention_reference",
            "ulysses_attention",
            "pipeline_apply", "PipelineModule",

@@ -1,0 +1,242 @@
+"""Sharded (per-host) checkpointing for pod-scale trainer state.
+
+The classic two-artifact checkpoint (``model.save_checkpoint``,
+reference model.py:318-347) gathers every array to one host — fine for
+reference-era model sizes, quadratically painful for pod-sharded
+parameter trees where no single host can even hold the gathered state.
+This module writes each array as its device shards: every process saves
+only the shards it can address (one replica of each distinct shard
+index), so a multi-host save is naturally parallel and each host's peak
+memory is bounded by its locally-addressable state, not the global tree
+(the local snapshot is held in RAM until written — the price of
+async-safe point-in-time semantics).
+
+Restore goes through ``jax.make_array_from_callback`` so the saved
+layout does NOT need to match the loading layout: each device's shard
+is assembled from whichever saved pieces intersect it.  That makes the
+checkpoint reshardable — save on a ``dp×tp`` mesh, restore on ``tp``
+only, or on a different device count (the elastic-restart story for
+sharded runs; the orbax design, rebuilt minimally over npz + JSON).
+
+Layout of a checkpoint directory::
+
+    step-0003/
+      meta-proc0.json   # per array: global shape/dtype + shard index map
+      shards-proc0.npz  # the shard payloads owned by process 0
+      [meta-proc1.json, shards-proc1.npz, ...]   # multi-host
+      extra.json        # host-side scalars (process 0 only)
+
+All payloads live in ``.npz`` entries keyed ``<array-key>|<n>``;
+bfloat16 is stored as a tagged uint16 view (npz cannot hold bf16, same
+trick as ``nd.save``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+import jax
+
+from ..base import MXNetError, np_dtype
+
+__all__ = ["save_sharded", "load_sharded"]
+
+
+def _tree_leaves(tree):
+    """Flatten a pytree into {stable-string-key: leaf}."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        out[jax.tree_util.keystr(path)] = leaf
+    return out
+
+
+def _norm_index(index, shape):
+    """Normalize a shard index (tuple of slices) to [[start, stop], ...]."""
+    norm = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        norm.append([start, stop])
+    # scalar / rank-0 arrays have an empty index tuple
+    return norm
+
+
+def save_sharded(ckpt_dir, tree, extra=None, async_save=False):
+    """Write the addressable shards of every array in ``tree`` (any
+    pytree of jax.Arrays) under ``ckpt_dir``.
+
+    ``extra`` is an optional JSON-serializable dict of host-side state
+    (step counters etc.), written by process 0.  With ``async_save``
+    the device->host shard snapshot happens now; file IO runs on the
+    background writer shared with ``model.save_checkpoint`` (use
+    ``model.wait_checkpoints()`` / ``Trainer.wait_checkpoints``).
+    """
+    from .. import model as model_mod
+
+    proc = jax.process_index()
+    os.makedirs(ckpt_dir, exist_ok=True)
+    leaves = _tree_leaves(tree)
+
+    meta = {}
+    payload = {}
+    for key, arr in leaves.items():
+        arr = jax.numpy.asarray(arr)  # tolerate numpy/scalar leaves
+        shards_meta = []
+        n = 0
+        for shard in arr.addressable_shards:
+            if shard.replica_id != 0:
+                continue  # exactly one copy of each distinct index
+            entry = f"{key}|{n}"
+            data = np.asarray(jax.device_get(shard.data))
+            if data.dtype == np_dtype("bfloat16"):
+                payload["__bf16__:" + entry] = data.view(np.uint16)
+            else:
+                payload[entry] = data
+            shards_meta.append({"entry": entry, "proc": proc,
+                                "index": _norm_index(shard.index, arr.shape)})
+            n += 1
+        meta[key] = {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                     "shards": shards_meta}
+
+    meta_path = os.path.join(ckpt_dir, f"meta-proc{proc}.json")
+    npz_path = os.path.join(ckpt_dir, f"shards-proc{proc}.npz")
+
+    def write_npz(path):
+        with open(path, "wb") as f:
+            np.savez(f, **payload)
+
+    def write_meta(path):
+        with open(path, "w") as f:
+            json.dump(meta, f)
+
+    writers = [(npz_path, write_npz), (meta_path, write_meta)]
+    if proc == 0 and extra is not None:
+        blob = json.dumps(extra)
+        writers.append((os.path.join(ckpt_dir, "extra.json"),
+                        lambda p, b=blob: open(p, "w").write(b)))
+    for path, writer in writers:
+        if async_save:
+            model_mod.stage_async_write(path, writer)
+        else:
+            writer(path + ".tmp")
+            os.replace(path + ".tmp", path)
+
+
+def _read_meta(ckpt_dir):
+    metas = sorted(f for f in os.listdir(ckpt_dir)
+                   if f.startswith("meta-proc") and f.endswith(".json"))
+    if not metas:
+        raise MXNetError(f"no sharded checkpoint found in {ckpt_dir!r}")
+    merged = {}
+    for fname in metas:
+        with open(os.path.join(ckpt_dir, fname)) as f:
+            part = json.load(f)
+        for key, info in part.items():
+            if key in merged:
+                merged[key]["shards"].extend(info["shards"])
+            else:
+                merged[key] = info
+    return merged
+
+
+class _ShardReader:
+    """Lazily-opened per-process npz files with bf16 untagging."""
+
+    def __init__(self, ckpt_dir):
+        self.dir = ckpt_dir
+        self._files = {}
+        self._cache = {}
+
+    def get(self, proc, entry):
+        # memoized: replicated arrays request the same entry once per
+        # local device, and target shards can straddle saved pieces
+        cached = self._cache.get((proc, entry))
+        if cached is not None:
+            return cached
+        npz = self._files.get(proc)
+        if npz is None:
+            npz = np.load(os.path.join(self.dir, f"shards-proc{proc}.npz"))
+            self._files[proc] = npz
+        if "__bf16__:" + entry in npz.files:
+            data = npz["__bf16__:" + entry].view(np_dtype("bfloat16"))
+        else:
+            data = npz[entry]
+        self._cache[(proc, entry)] = data
+        return data
+
+
+def load_sharded(ckpt_dir, target):
+    """Restore a checkpoint written by :func:`save_sharded` into the
+    layout of ``target`` (a pytree of jax.Arrays whose shardings define
+    where each piece should live — typically the live trainer state).
+
+    Returns ``(new_tree, extra)`` where ``new_tree`` mirrors ``target``
+    with restored values and ``extra`` is the saved host-side dict (or
+    ``None``).  Saved and target layouts may differ: each target shard
+    is assembled from every saved piece that intersects it.
+    """
+    meta = _read_meta(ckpt_dir)
+    reader = _ShardReader(ckpt_dir)
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(target)
+    new_leaves = []
+    for path, cur in flat:
+        key = jax.tree_util.keystr(path)
+        info = meta.get(key)
+        if info is None:
+            raise MXNetError(
+                f"checkpoint {ckpt_dir!r} has no entry for {key!r}")
+        shape = tuple(info["shape"])
+        if shape != tuple(np.shape(cur)):
+            raise MXNetError(
+                f"shape mismatch for {key!r}: checkpoint {shape} vs "
+                f"live {tuple(np.shape(cur))}")
+        dtype = np_dtype(info["dtype"])
+        # the live layout is the authority on dtype (a trainer built
+        # with dtype='bfloat16' must not silently come back f32)
+        target_dtype = getattr(cur, "dtype", None) or dtype
+        shards = info["shards"]
+
+        def make(index, *, _shards=shards, _shape=shape, _dtype=dtype,
+                 _target_dtype=target_dtype, _key=key):
+            bounds = _norm_index(index, _shape)
+            out_shape = tuple(b[1] - b[0] for b in bounds)
+            out = np.empty(out_shape, _dtype)
+            filled = 0
+            for sh in _shards:
+                src_b = sh["index"]
+                inter = [(max(a0, b0), min(a1, b1))
+                         for (a0, a1), (b0, b1) in zip(bounds, src_b)]
+                if any(lo >= hi for lo, hi in inter):
+                    continue
+                data = reader.get(sh["proc"], sh["entry"])
+                src_sel = tuple(slice(lo - b0, hi - b0)
+                                for (lo, hi), (b0, _) in zip(inter, src_b))
+                dst_sel = tuple(slice(lo - a0, hi - a0)
+                                for (lo, hi), (a0, _) in zip(inter, bounds))
+                out[dst_sel] = data[src_sel]
+                filled += int(np.prod([hi - lo for lo, hi in inter]))
+            if filled < int(np.prod(out_shape)):
+                raise MXNetError(
+                    f"checkpoint shards for {_key!r} do not cover the "
+                    "requested region (torn or partial save?)")
+            if np_dtype(_target_dtype) != _dtype:
+                out = out.astype(np_dtype(_target_dtype))
+            return out
+
+        sharding = cur.sharding if hasattr(cur, "sharding") else None
+        if sharding is None:
+            new_leaves.append(jax.numpy.asarray(make(
+                tuple(slice(0, d) for d in shape))))
+        else:
+            new_leaves.append(jax.make_array_from_callback(
+                shape, sharding, make))
+    extra = None
+    extra_path = os.path.join(ckpt_dir, "extra.json")
+    if os.path.exists(extra_path):
+        with open(extra_path) as f:
+            extra = json.load(f)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves), extra

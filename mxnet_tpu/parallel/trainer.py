@@ -17,6 +17,7 @@ trainer is the performance path for pod-scale runs.
 from __future__ import annotations
 
 import logging
+import os
 
 import numpy as np
 
@@ -252,12 +253,16 @@ class ShardedTrainer:
             except (TypeError, ValueError):
                 sig = None  # non-introspectable (C extension etc.)
             if sig is not None:
-                # the 4th argument must actually BE the schedule hook —
-                # a probe that only checks arity would feed the traced
-                # multiplier into an unrelated parameter (clip etc.)
-                has_scale = ("lr_scale" in sig.parameters
-                             or any(p.kind is inspect.Parameter.VAR_KEYWORD
-                                    for p in sig.parameters.values()))
+                # the schedule hook must actually be NAMED lr_scale (or
+                # absorbed by **kwargs) — a probe that only checks arity
+                # would feed the traced multiplier into an unrelated
+                # parameter (clip etc.); the call site passes it by
+                # keyword for the same reason
+                try:
+                    sig.bind(None, None, None, lr_scale=1.0)
+                    has_scale = True
+                except TypeError:
+                    has_scale = False
             else:
                 has_scale = lr_scheduler is not None
             if not has_scale:
@@ -269,8 +274,13 @@ class ShardedTrainer:
                         "update(grads, state, params, lr_scale) to accept "
                         "an 'lr_scale' argument") from None
                 _inner_update = update_fn
-                update_fn = (lambda grads, state, params, lr_scale=1.0:
-                             _inner_update(grads, state, params))
+                try:  # 4-positional-arg legacy form: feed a constant 1.0
+                    sig.bind(None, None, None, 1.0)
+                    update_fn = (lambda grads, state, params, lr_scale=1.0:
+                                 _inner_update(grads, state, params, 1.0))
+                except TypeError:
+                    update_fn = (lambda grads, state, params, lr_scale=1.0:
+                                 _inner_update(grads, state, params))
         self._lr_scheduler = lr_scheduler
         if lr_scheduler is not None and hasattr(lr_scheduler, "base_lr"):
             # the reference optimizer wiring (optimizer.py:43-45): the
@@ -398,7 +408,7 @@ class ShardedTrainer:
             scale = self._rescale_grad
             grads = {k: g * scale for k, g in grads.items()}
             new_params, new_opt = self._update_fn(grads, opt_state, params,
-                                                  lr_scale)
+                                                  lr_scale=lr_scale)
             return new_params, new_opt, new_aux, outs, key
 
         def eval_step(params, aux, batch, key):
@@ -625,3 +635,59 @@ class ShardedTrainer:
                 # lr_scheduler=None (constant-lr fine-tune) must not
                 # silently inherit the checkpointed schedule
                 self._lr_scheduler = pickle.loads(blob["lr_scheduler"])
+
+    # -- sharded (per-host) checkpointing -----------------------------------
+    def save_checkpoint_sharded(self, ckpt_dir, epoch=0, async_save=False):
+        """Pod-scale checkpoint: every process writes only its local
+        shards (peak host memory = largest local shard, multi-host saves
+        are parallel), via :mod:`mxnet_tpu.parallel.checkpoint`.  The
+        dense two-artifact path (:meth:`save_checkpoint`) stays the
+        portable/interop format; this one is for state that should never
+        be gathered.  Restore may use a different mesh/sharding."""
+        import base64
+        import pickle
+
+        from . import checkpoint as ckpt
+
+        step_dir = os.path.join(ckpt_dir, f"step-{epoch:04d}")
+        extra = {"num_update": self._num_update, "epoch": int(epoch)}
+        if self._lr_scheduler is not None:
+            try:
+                extra["lr_scheduler"] = base64.b64encode(
+                    pickle.dumps(self._lr_scheduler)).decode("ascii")
+            except Exception:
+                logging.warning(
+                    "lr_scheduler %r is not picklable; sharded checkpoint "
+                    "will not carry scheduler state",
+                    type(self._lr_scheduler).__name__)
+        ckpt.save_sharded(step_dir, self._ckpt_tree(), extra=extra,
+                          async_save=async_save)
+        if jax.process_index() == 0:
+            self.symbol.save(os.path.join(step_dir, "symbol.json"))
+
+    def load_checkpoint_sharded(self, ckpt_dir, epoch=0):
+        """Restore a :meth:`save_checkpoint_sharded` checkpoint into this
+        trainer's own layout (resharding from the saved layout as
+        needed)."""
+        import base64
+        import pickle
+
+        from . import checkpoint as ckpt
+
+        step_dir = os.path.join(ckpt_dir, f"step-{epoch:04d}")
+        state, extra = ckpt.load_sharded(step_dir, self._ckpt_tree())
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+        self.aux = state["aux"]
+        self._key = state["rng_key"]
+        if extra:
+            self._num_update = int(extra.get("num_update",
+                                             self._num_update))
+            if (extra.get("lr_scheduler") is not None
+                    and self._lr_scheduler is not None):
+                self._lr_scheduler = pickle.loads(
+                    base64.b64decode(extra["lr_scheduler"]))
+
+    def _ckpt_tree(self):
+        return {"params": self.params, "opt_state": self.opt_state,
+                "aux": self.aux, "rng_key": self._key}
