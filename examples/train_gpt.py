@@ -52,9 +52,16 @@ def main():
     p.add_argument("--data", default=None, help="utf-8 text file")
     p.add_argument("--trainer", default="module",
                    choices=["module", "sharded"])
+    p.add_argument("--generate", type=int, default=0, metavar="N",
+                   help="after training, KV-cache-decode N tokens from a "
+                        "corpus prompt (models/generate.py)")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="sampling temperature for --generate (0 = greedy)")
     args = p.parse_args()
     if args.steps < 1:
         p.error("--steps must be >= 1")
+    if args.generate > 0 and args.seq_len - args.generate < 1:
+        p.error("--generate must leave room for a prompt within --seq-len")
     logging.basicConfig(level=logging.INFO)
     rng = np.random.RandomState(0)
 
@@ -112,6 +119,26 @@ def main():
                 logging.info("step %d nll %.4f (uniform %.4f)", step, nll,
                              np.log(args.vocab))
     print(f"gpt final nll {nll:.4f} vs uniform {np.log(args.vocab):.4f}")
+
+    if args.generate > 0:
+        if args.trainer == "sharded":
+            params = tr.get_params()
+        else:
+            params = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+        prompt_len = min(8, args.seq_len - args.generate)
+        prompt = tokens[:prompt_len][None]
+        out = mx.models.gpt_generate(params, prompt, args.generate,
+                                     num_heads=args.num_heads,
+                                     temperature=args.temperature)
+        cont = out[0, prompt_len:]
+        if args.data and os.path.exists(args.data):
+            inv = {i: c for c, i in lut.items()}
+            text = bytes(inv[int(t)] for t in out[0]).decode(
+                "utf-8", "replace")
+            print(f"generated: {text!r}")
+        else:
+            print(f"prompt {list(map(int, prompt[0]))} -> "
+                  f"continuation {list(map(int, cont))}")
 
 
 if __name__ == "__main__":

@@ -9,8 +9,9 @@ from .ssd import get_symbol as ssd
 from .inception import inception_bn, inception_bn_small, googlenet
 from .vgg import vgg, alexnet
 from .transformer import gpt
+from .generate import gpt_generate
 
 __all__ = ["lenet", "mlp", "resnet", "lstm_unroll", "lstm_cell",
            "LSTMState", "LSTMParam", "ssd",
            "inception_bn", "inception_bn_small", "googlenet", "vgg", "alexnet",
-           "gpt"]
+           "gpt", "gpt_generate"]

@@ -1,0 +1,194 @@
+"""Incremental (KV-cache) decoding for the GPT model family.
+
+Training runs the full-sequence graph (models/transformer.py); serving
+wants O(1) work per generated token.  This module rebuilds the decoder
+as a single-token step over cached keys/values and runs the WHOLE
+generation loop as one ``lax.scan`` inside one jit — prompt prefill and
+sampling included — so a generate call is one XLA program dispatch with
+the cache resident in HBM (the TPU-idiomatic shape for autoregressive
+serving; contrast the reference's per-step executor calls in
+example/rnn char-rnn style inference).
+
+Operates directly on a trained parameter dict (``Module.get_params()``
+/ ``FeedForward`` checkpoints / ``ShardedTrainer.get_params()``), with
+a parity test against the training graph in
+``tests/test_generate.py``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["gpt_generate"]
+
+_decoder_cache = {}
+
+
+def _ln(x, gamma, beta, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * gamma.astype(jnp.float32)
+            + beta.astype(jnp.float32)).astype(x.dtype)
+
+
+def _fc(x, w, b):
+    return x @ w.T.astype(x.dtype) + b.astype(x.dtype)
+
+
+def _gelu(x):
+    xf = x.astype(jnp.float32)
+    return (0.5 * xf * (1.0 + jax.lax.erf(xf / np.sqrt(2.0)))).astype(x.dtype)
+
+
+def gpt_generate(params, prompt, max_new_tokens, num_heads,
+                 temperature=0.0, top_k=None, key=None, name="gpt"):
+    """Generate continuations for ``prompt`` with a KV cache.
+
+    Args:
+      params: dict name->array of trained GPT weights (numpy or jax),
+        with the naming of :func:`mxnet_tpu.models.gpt`.
+      prompt: int array (batch, prompt_len) of token ids.
+      max_new_tokens: tokens to append after the prompt.
+      num_heads: attention head count the model was built with (not
+        recoverable from weight shapes).
+      temperature: 0.0 -> greedy argmax; otherwise sample from
+        softmax(logits / temperature).
+      top_k: optionally restrict sampling to the k most likely tokens.
+      key: jax PRNG key for sampling (defaults to PRNGKey(0)).
+      name: the symbol-name prefix used when building the model.
+
+    Returns ``(batch, prompt_len + max_new_tokens)`` numpy int32 ids
+    (prompt included).  The compiled decode loop is cached per
+    (config, shapes) so repeated calls don't re-trace.
+    """
+    prompt = np.asarray(prompt)
+    if prompt.ndim != 2:
+        raise ValueError("prompt must be (batch, prompt_len)")
+    B, P = prompt.shape
+    if P < 1:
+        raise ValueError("prompt must hold at least one token")
+
+    try:
+        tok_w = params[f"{name}_tok_embed_weight"]
+        pos_w = params[f"{name}_pos_embed_weight"]
+    except KeyError:
+        raise ValueError(
+            f"params has no '{name}_tok_embed_weight' — wrong name "
+            "prefix or not a gpt() parameter dict") from None
+    d_model = tok_w.shape[1]
+    S = pos_w.shape[1]
+    n_layers = 0
+    while f"{name}_l{n_layers}_q_weight" in params:
+        n_layers += 1
+    if n_layers == 0:
+        raise ValueError(f"no '{name}_l0_q_weight' in params — wrong "
+                         "name prefix or not a gpt() parameter dict")
+    if d_model % num_heads:
+        raise ValueError("num_heads must divide d_model")
+    head_dim = d_model // num_heads
+    T = P + max_new_tokens
+    if T > S:
+        raise ValueError(
+            f"prompt_len + max_new_tokens = {T} exceeds the model's "
+            f"positional table ({S})")
+
+    if max_new_tokens < 1:
+        return np.asarray(prompt, np.int32)
+
+    cfg = (name, n_layers, num_heads, head_dim, B, P, max_new_tokens, S,
+           float(temperature), top_k,
+           str(jnp.asarray(tok_w).dtype))
+    run = _decoder_cache.get(cfg)
+    if run is None:
+        run = _build_decoder(name, n_layers, num_heads, head_dim, B, P,
+                             max_new_tokens, S, float(temperature), top_k)
+        _decoder_cache[cfg] = run
+
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    jparams = {k: jnp.asarray(v) for k, v in params.items()}
+    ids = run(jparams, jnp.asarray(prompt, jnp.int32), key)
+    return np.asarray(jax.device_get(ids), np.int32)
+
+
+def _build_decoder(name, n_layers, num_heads, head_dim, B, P,
+                   max_new_tokens, S, temperature, top_k):
+    d_model = num_heads * head_dim
+    T = P + max_new_tokens
+
+    def step_token(params, tok, t, cache_k, cache_v):
+        """One decode position: tok (B,) int32 at position t; caches
+        (L, B, H, S, Dh).  Returns logits (B, V) + updated caches."""
+        x = (params[f"{name}_tok_embed_weight"][tok]
+             + params[f"{name}_pos_embed_weight"][0, t])      # (B, D)
+        pos_mask = (jnp.arange(S) <= t)                        # (S,)
+        for i in range(n_layers):
+            p = f"{name}_l{i}"
+            h = _ln(x, params[f"{p}_ln1_gamma"], params[f"{p}_ln1_beta"])
+            q = _fc(h, params[f"{p}_q_weight"], params[f"{p}_q_bias"])
+            k = _fc(h, params[f"{p}_k_weight"], params[f"{p}_k_bias"])
+            v = _fc(h, params[f"{p}_v_weight"], params[f"{p}_v_bias"])
+            qh = q.reshape(B, num_heads, head_dim)
+            kh = k.reshape(B, num_heads, head_dim)
+            vh = v.reshape(B, num_heads, head_dim)
+            # write this token's k/v at position t, then attend over <=t
+            cache_k = cache_k.at[i, :, :, t, :].set(kh)
+            cache_v = cache_v.at[i, :, :, t, :].set(vh)
+            scores = jnp.einsum("bhd,bhsd->bhs", qh, cache_k[i])
+            scores = scores / np.sqrt(head_dim)
+            scores = jnp.where(pos_mask[None, None, :], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+            attn = jnp.einsum("bhs,bhsd->bhd", probs.astype(x.dtype),
+                              cache_v[i])
+            x = x + _fc(attn.reshape(B, d_model),
+                        params[f"{p}_proj_weight"], params[f"{p}_proj_bias"])
+            h2 = _ln(x, params[f"{p}_ln2_gamma"], params[f"{p}_ln2_beta"])
+            up = _gelu(_fc(h2, params[f"{p}_ff_up_weight"],
+                           params[f"{p}_ff_up_bias"]))
+            x = x + _fc(up, params[f"{p}_ff_down_weight"],
+                        params[f"{p}_ff_down_bias"])
+        final = _ln(x, params[f"{name}_ln_f_gamma"],
+                    params[f"{name}_ln_f_beta"])
+        logits = _fc(final, params[f"{name}_head_weight"],
+                     params[f"{name}_head_bias"])
+        return logits, cache_k, cache_v
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits.astype(jnp.float32) / temperature
+        if top_k is not None:
+            kth = jnp.sort(logits, axis=-1)[:, -int(top_k)][:, None]
+            logits = jnp.where(logits >= kth, logits, -jnp.inf)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    def run(params, prompt, key):
+        cache_k = jnp.zeros((n_layers, B, num_heads, S, head_dim),
+                            params[f"{name}_tok_embed_weight"].dtype)
+        cache_v = jnp.zeros_like(cache_k)
+        # tokens fed at each step: prompt for t < P, then sampled
+        prompt_t = jnp.transpose(prompt)                      # (P, B)
+
+        def body(carry, t):
+            cache_k, cache_v, next_tok, key = carry
+            tok = jnp.where(t < P,
+                            prompt_t[jnp.minimum(t, P - 1)], next_tok)
+            logits, cache_k, cache_v = step_token(params, tok, t,
+                                                  cache_k, cache_v)
+            key, sub = jax.random.split(key)
+            sampled = sample(logits, sub)
+            return (cache_k, cache_v, sampled, key), (tok, sampled)
+
+        init = (cache_k, cache_v, jnp.zeros((B,), jnp.int32), key)
+        _, (fed, sampled) = jax.lax.scan(body, init, jnp.arange(T - 1))
+        # position t's sample is the token for position t+1; the ids
+        # actually consumed are fed[0:T-1] plus the final sample
+        ids = jnp.concatenate([fed, sampled[-1:]], axis=0)    # (T, B)
+        return jnp.transpose(ids)
+
+    return jax.jit(run)

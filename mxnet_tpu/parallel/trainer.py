@@ -66,7 +66,31 @@ def cached_sgd_step(cache, loss_fn, make_objective, has_aux=False):
     return step
 
 
-def sgd_opt(learning_rate=0.01, momentum=0.9, weight_decay=0.0):
+def _clip_grads(grads, clip_gradient=None, clip_by_global_norm=None):
+    """Gradient clipping shared by the optimizer factories.
+
+    ``clip_gradient`` is the reference's per-element clamp to
+    [-c, c] (optimizer.py SGD/Adam ``clip_gradient``); modern
+    ``clip_by_global_norm`` rescales the whole pytree when its L2 norm
+    exceeds the bound.  Both compute in f32; under a sharded step the
+    global-norm sum becomes one scalar psum inserted by the
+    partitioner."""
+    if clip_gradient is not None:
+        c = float(clip_gradient)
+        grads = {k: jnp.clip(g.astype(jnp.float32), -c, c)
+                 for k, g in grads.items()}
+    if clip_by_global_norm is not None:
+        c = float(clip_by_global_norm)
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                 for g in grads.values())
+        norm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, c / jnp.maximum(norm, 1e-12))
+        grads = {k: g.astype(jnp.float32) * scale for k, g in grads.items()}
+    return grads
+
+
+def sgd_opt(learning_rate=0.01, momentum=0.9, weight_decay=0.0,
+            clip_gradient=None, clip_by_global_norm=None):
     """Functional SGD(+momentum) over a param pytree."""
 
     def init(params):
@@ -75,6 +99,7 @@ def sgd_opt(learning_rate=0.01, momentum=0.9, weight_decay=0.0):
         return {k: jnp.zeros_like(v) for k, v in params.items()}
 
     def update(grads, state, params, lr_scale=1.0):
+        grads = _clip_grads(grads, clip_gradient, clip_by_global_norm)
         lr = learning_rate * lr_scale
         new_params, new_state = {}, {}
         for k, p in params.items():
@@ -91,7 +116,8 @@ def sgd_opt(learning_rate=0.01, momentum=0.9, weight_decay=0.0):
 
 
 def adam_opt(learning_rate=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
-             weight_decay=0.0, decoupled=False):
+             weight_decay=0.0, decoupled=False,
+             clip_gradient=None, clip_by_global_norm=None):
     """Functional Adam over a param pytree.
 
     ``decoupled=True`` gives AdamW: weight decay multiplies the weights
@@ -104,6 +130,7 @@ def adam_opt(learning_rate=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
                 "t": jnp.zeros((), jnp.int32)}
 
     def update(grads, state, params, lr_scale=1.0):
+        grads = _clip_grads(grads, clip_gradient, clip_by_global_norm)
         t = state["t"] + 1
         lr_t = (learning_rate * lr_scale
                 * jnp.sqrt(1 - beta2**t.astype(jnp.float32))
@@ -128,10 +155,12 @@ def adam_opt(learning_rate=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
 
 
 def adamw_opt(learning_rate=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
-              weight_decay=0.0):
+              weight_decay=0.0, clip_gradient=None,
+              clip_by_global_norm=None):
     """Functional AdamW: adam_opt with decoupled weight decay."""
     return adam_opt(learning_rate, beta1, beta2, eps, weight_decay,
-                    decoupled=True)
+                    decoupled=True, clip_gradient=clip_gradient,
+                    clip_by_global_norm=clip_by_global_norm)
 
 
 _OPTS = {"sgd": sgd_opt, "adam": adam_opt, "adamw": adamw_opt}

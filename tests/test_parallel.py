@@ -375,3 +375,52 @@ def test_lr_scheduler_in_trainer():
     p1, p2 = t1.get_params(), t2.get_params()
     for k in p1:
         np.testing.assert_allclose(p1[k], p2[k], atol=1e-6, rtol=1e-5)
+
+
+def test_gradient_clipping_semantics():
+    """clip_gradient clamps per element (reference optimizer.py
+    clip_gradient); clip_by_global_norm rescales the whole tree."""
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.trainer import _clip_grads
+
+    grads = {"a": jnp.array([3.0, -5.0, 0.5]),
+             "b": jnp.array([[4.0, -0.1]])}
+    clipped = _clip_grads(grads, clip_gradient=1.0)
+    np.testing.assert_allclose(np.asarray(clipped["a"]), [1.0, -1.0, 0.5])
+    np.testing.assert_allclose(np.asarray(clipped["b"]), [[1.0, -0.1]])
+
+    norm = np.sqrt(sum((np.asarray(g) ** 2).sum() for g in grads.values()))
+    scaled = _clip_grads(grads, clip_by_global_norm=1.0)
+    for k in grads:
+        np.testing.assert_allclose(np.asarray(scaled[k]),
+                                   np.asarray(grads[k]) / norm, rtol=1e-6)
+    # under the bound: untouched
+    small = _clip_grads({"a": jnp.array([0.1])}, clip_by_global_norm=5.0)
+    np.testing.assert_allclose(np.asarray(small["a"]), [0.1], rtol=1e-6)
+
+
+def test_trainer_clip_by_global_norm_trains():
+    """The clipped step runs sharded and matches a manual clipped
+    update on step 1 (zero momentum state)."""
+    mesh = mx.parallel.make_mesh({"dp": 8})
+    d = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(d, num_hidden=4, name="fc"), name="softmax")
+    X, y = _toy(n=16, d=8)
+
+    def build():
+        t = mx.parallel.ShardedTrainer(
+            net, {"data": (16, 8), "softmax_label": (16,)}, mesh=mesh,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.0,
+                              "clip_by_global_norm": 1e-3})
+        return t
+
+    t = build()
+    p0 = t.get_params()
+    t.step({"data": X, "softmax_label": y})
+    p1 = t.get_params()
+    # with a tiny norm bound the update magnitude is exactly lr * c
+    # distributed over the tree: ||delta||_2 == lr * 1e-3
+    delta = np.sqrt(sum(((p1[k] - p0[k]) ** 2).sum() for k in p0))
+    np.testing.assert_allclose(delta, 0.5 * 1e-3, rtol=1e-4)
