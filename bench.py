@@ -86,7 +86,9 @@ def main():
         return bench_gpt(jax, np, mx, on_tpu, n_chips)
 
     if on_tpu:
-        batch_per_chip = int(os.environ.get("BENCH_BATCH", "256"))
+        # bs=128 measured fastest on a single v5e chip (BENCH_NOTES.md
+        # round-2 sweep: 2845 img/s @128 vs 2736 @256 vs 2639 @512)
+        batch_per_chip = int(os.environ.get("BENCH_BATCH", "128"))
         image_hw = 224
         dtype = "bfloat16"
         n_warmup, n_iter = 5, 20

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Data-pipeline throughput benchmark: the ImageRecordIter decode +
+augment + batch path (C++ src/image_pipeline.cc), measured the way the
+reference documents its ">1,000 images/s with 4 decode threads" figure
+(docs/how_to/perf.md:9; example/image-classification/README.md:169-175).
+
+Packs a synthetic JPEG .rec (256x256, ImageNet-ish decode cost), then
+measures epochs of ImageRecordIter at several thread counts with
+training augmentation (rand_crop + mirror to 224).  Prints one JSON
+line.  ``vs_baseline`` is the absolute ratio against the reference's
+1,000 img/s; on hosts with fewer than 4 cores that figure is not
+reachable by construction, so the pass/fail exit gates on
+per-core throughput (reference: 250 img/s/core) instead.
+
+Usage: python tools/io_bench.py [--images 2048] [--out IO_BENCH.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASELINE_IMG_PER_SEC = 1000.0  # reference: 4 decode threads, OpenCV
+BASELINE_PER_CORE = BASELINE_IMG_PER_SEC / 4.0  # the comparable unit
+
+
+def build_dataset(path, n_images, hw=256):
+    import cv2  # noqa: F401  (verifies the encode path exists)
+
+    from mxnet_tpu import recordio
+
+    rng = np.random.RandomState(0)
+    writer = recordio.MXRecordIO(path, "w")
+    for i in range(n_images):
+        # random-noise JPEGs are the worst case for entropy decoding —
+        # real photos decode faster, so this is a conservative figure
+        img = rng.randint(0, 256, (hw, hw, 3), np.uint8)
+        header = recordio.IRHeader(0, float(i % 1000), i, 0)
+        writer.write(recordio.pack_img(header, img, quality=90))
+    writer.close()
+
+
+def measure(path, threads, batch_size=128, epochs=2):
+    from mxnet_tpu.image_io import ImageRecordIter
+
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 224, 224),
+                         batch_size=batch_size, preprocess_threads=threads,
+                         rand_crop=True, rand_mirror=True, shuffle=True)
+    # consecutive epochs WITHOUT reset(): StopIteration marks the epoch
+    # boundary and production continues (a reset here would silently
+    # discard a fully-decoded epoch).  First epoch warms the page cache
+    # and thread pool; the last is timed.  Pad rows don't count.
+    n = 0
+    tic = None
+    for epoch in range(epochs):
+        if epoch == epochs - 1:
+            tic = time.perf_counter()
+        while True:
+            try:
+                batch = it.next()
+            except StopIteration:
+                break
+            if epoch == epochs - 1:
+                n += batch.data[0].shape[0] - batch.pad
+    return n / (time.perf_counter() - tic)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--images", type=int, default=2048)
+    cores = os.cpu_count() or 1
+    # oversubscribing a small host only measures scheduler contention
+    default_threads = sorted({1, 2, 4, cores, 2 * cores} & set(
+        range(1, 2 * cores + 1)))
+    p.add_argument("--threads", type=int, nargs="+",
+                   default=default_threads)
+    p.add_argument("--out", default=None,
+                   help="also write the JSON record to this path")
+    args = p.parse_args()
+
+    # a ragged dataset (images % batch) would route to the Python
+    # fallback chain instead of the C++ pipeline under test
+    n_images = max(128, (args.images // 128) * 128)
+    if n_images != args.images:
+        print(f"note: rounding --images to {n_images} "
+              "(multiple of the 128 batch keeps the native path)",
+              file=sys.stderr)
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "bench.rec")
+        build_dataset(path, n_images)
+        by_threads = {}
+        for t in args.threads:
+            by_threads[str(t)] = round(measure(path, t), 1)
+
+    best = max(by_threads.values())
+    cores = os.cpu_count() or 1
+    # the threads actually able to run concurrently bound the per-core
+    # figure; extra threads on a small host only measure contention
+    per_core = best / min(cores, max(int(t) for t in by_threads))
+    result = {
+        "metric": "image_pipeline_throughput",
+        "value": best,
+        "unit": "images/sec",
+        "vs_baseline": round(best / BASELINE_IMG_PER_SEC, 4),
+        "per_core": round(per_core, 1),
+        "vs_baseline_per_core": round(per_core / BASELINE_PER_CORE, 4),
+        "host_cores": cores,
+        "by_threads": by_threads,
+        "image_hw": 256,
+        "out_hw": 224,
+        "augment": "rand_crop+mirror",
+        "n_images": n_images,
+    }
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if cores >= 4 and "4" in by_threads:
+        # the documented contract on comparable hosts: 4-thread absolute
+        return 0 if by_threads["4"] >= BASELINE_IMG_PER_SEC else 1
+    return 0 if per_core >= BASELINE_PER_CORE else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
