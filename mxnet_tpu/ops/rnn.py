@@ -123,6 +123,18 @@ def _run_direction(mode, x, h0, c0, wi, wh, bi, bh, reverse):
     gx = jnp.einsum("tni,gi->tng", x, wi) + bi
     if reverse:
         gx = jnp.flip(gx, axis=0)
+    if mode == "lstm":
+        from .pallas_lstm import fused_lstm, fused_lstm_eligible
+
+        T, N, _ = gx.shape
+        H = h0.shape[-1]
+        if fused_lstm_eligible(T, N, H):
+            # Pallas kernel: recurrent weights + state stay in VMEM for
+            # the whole sequence instead of streaming per scan step
+            ys, hT, cT = fused_lstm(gx, h0, c0, wh, bh)
+            if reverse:
+                ys = jnp.flip(ys, axis=0)
+            return ys, hT, cT
     step = _cell_step(mode, h0.shape[-1])
     if mode == "lstm":
         (hT, cT), ys = lax.scan(lambda c, g: step(c, (g, wh, bh)), (h0, c0), gx)

@@ -1,0 +1,204 @@
+"""Fused Pallas LSTM (ops/pallas_lstm.py) vs the lax.scan reference
+cell — forward and full backward parity through the interpreter (the
+identical kernel code runs jit-compiled on TPU)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.pallas_lstm import fused_lstm, fused_lstm_eligible
+
+
+def _scan_lstm(gx, h0, c0, wh, bh):
+    """The ops/rnn.py scan cell, inlined as the numerical reference."""
+    def step(carry, g):
+        h, c = carry
+        gates = g + jnp.dot(h, wh.T) + bh
+        i, f, gg, o = jnp.split(gates, 4, axis=-1)
+        c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
+        h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+        return (h2, c2), h2
+
+    (hT, cT), ys = jax.lax.scan(step, (h0, c0), gx)
+    return ys, hT, cT
+
+
+def _rand(T=6, N=4, H=8, seed=0, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    gx = rng.randn(T, N, 4 * H).astype(dtype) * 0.5
+    h0 = rng.randn(N, H).astype(dtype) * 0.5
+    c0 = rng.randn(N, H).astype(dtype) * 0.5
+    wh = rng.randn(4 * H, H).astype(dtype) * 0.3
+    bh = rng.randn(4 * H).astype(dtype) * 0.1
+    return gx, h0, c0, wh, bh
+
+
+@pytest.mark.parametrize("shape", [(6, 4, 8), (13, 3, 16), (1, 2, 8)])
+def test_forward_matches_scan(shape):
+    T, N, H = shape
+    gx, h0, c0, wh, bh = _rand(T, N, H)
+    ys, hT, cT = fused_lstm(gx, h0, c0, wh, bh, interpret=True)
+    rys, rhT, rcT = _scan_lstm(gx, h0, c0, wh, bh)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(rys),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hT), np.asarray(rhT),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cT), np.asarray(rcT),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_backward_matches_scan_all_outputs():
+    """Gradients w.r.t. every input, through a loss touching ys, hT
+    and cT so every cotangent path is exercised."""
+    gx, h0, c0, wh, bh = _rand(T=7, N=4, H=8, seed=1)
+
+    def loss_fused(gx, h0, c0, wh, bh):
+        ys, hT, cT = fused_lstm(gx, h0, c0, wh, bh, interpret=True)
+        return (jnp.sum(ys * ys) + jnp.sum(jnp.sin(hT))
+                + 2.0 * jnp.sum(cT))
+
+    def loss_scan(gx, h0, c0, wh, bh):
+        ys, hT, cT = _scan_lstm(gx, h0, c0, wh, bh)
+        return (jnp.sum(ys * ys) + jnp.sum(jnp.sin(hT))
+                + 2.0 * jnp.sum(cT))
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4))(gx, h0, c0, wh, bh)
+    gr = jax.grad(loss_scan, argnums=(0, 1, 2, 3, 4))(gx, h0, c0, wh, bh)
+    for name, a, b in zip(("gx", "h0", "c0", "wh", "bh"), gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_backward_ys_only_loss():
+    """hT/cT cotangents are zero arrays; the reverse stream must still
+    initialize correctly from them."""
+    gx, h0, c0, wh, bh = _rand(T=5, N=2, H=8, seed=2)
+
+    def f(impl):
+        def loss(gx):
+            ys, _, _ = impl(gx, h0, c0, wh, bh)
+            return jnp.sum(ys[2])  # gradient flows only to steps <= 2
+        return jax.grad(loss)(gx)
+
+    gf = f(lambda *a: fused_lstm(*a, interpret=True))
+    gr = f(_scan_lstm)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                               rtol=1e-5, atol=1e-6)
+    # causality: steps after 2 get exactly zero gradient
+    assert np.all(np.asarray(gf)[3:] == 0.0)
+
+
+def test_bf16_inputs():
+    gx, h0, c0, wh, bh = _rand(T=4, N=2, H=8, seed=3)
+    bf = jnp.bfloat16
+    ys, hT, cT = fused_lstm(gx.astype(bf), h0.astype(bf), c0.astype(bf),
+                            wh.astype(bf), bh.astype(bf), interpret=True)
+    assert ys.dtype == bf
+    rys, _, _ = _scan_lstm(*[jnp.asarray(a, jnp.float32)
+                             for a in (gx, h0, c0, wh, bh)])
+    np.testing.assert_allclose(np.asarray(ys, np.float32),
+                               np.asarray(rys), rtol=5e-2, atol=5e-2)
+
+
+def test_rnn_op_uses_fused_when_forced(monkeypatch):
+    """MXNET_TPU_FUSED_RNN=1 routes the RNN symbol op through the
+    kernel (interpret off-TPU) with unchanged results."""
+    monkeypatch.setenv("MXNET_TPU_FUSED_RNN", "1")
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(4)
+    T, N, I, H = 5, 3, 6, 8
+    x = rng.randn(T, N, I).astype(np.float32)
+
+    def run():
+        data = mx.sym.Variable("data")
+        net = mx.sym.RNN(data, mx.sym.Variable("parameters"),
+                         mx.sym.Variable("state"),
+                         mx.sym.Variable("state_cell"),
+                         state_size=H, num_layers=1, mode="lstm",
+                         name="rnn")
+        exe = net.simple_bind(mx.cpu(), grad_req="write",
+                              data=(T, N, I))
+        for name, arr in exe.arg_dict.items():
+            if name == "data":
+                arr[:] = x
+            else:
+                arr[:] = (rng.randn(*arr.shape) * 0.2).astype(np.float32)
+        return exe
+
+    rng = np.random.RandomState(4)
+    exe1 = run()
+    exe1.forward(is_train=True)
+    fused_out = exe1.outputs[0].asnumpy()
+    head = np.ones_like(fused_out)
+    exe1.backward([mx.nd.array(head)])
+    fused_grads = {k: v.asnumpy() for k, v in exe1.grad_dict.items()}
+
+    monkeypatch.setenv("MXNET_TPU_FUSED_RNN", "0")
+    rng = np.random.RandomState(4)
+    exe2 = run()
+    exe2.forward(is_train=True)
+    scan_out = exe2.outputs[0].asnumpy()
+    exe2.backward([mx.nd.array(head)])
+    scan_grads = {k: v.asnumpy() for k, v in exe2.grad_dict.items()}
+
+    np.testing.assert_allclose(fused_out, scan_out, rtol=1e-5, atol=1e-5)
+    for k in scan_grads:
+        np.testing.assert_allclose(fused_grads[k], scan_grads[k],
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def test_eligibility_gates(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_FUSED_RNN", raising=False)
+    assert not fused_lstm_eligible(4, 8, 128)        # off-TPU, not forced
+    assert fused_lstm_eligible(16, 8, 128, force=True)
+    monkeypatch.setenv("MXNET_TPU_FUSED_RNN", "0")
+    assert not fused_lstm_eligible(128, 8, 128, force=True)
+
+
+@pytest.mark.tpu
+@pytest.mark.skipif("MXTPU_TPU_TESTS" not in __import__("os").environ,
+                    reason="real-chip compile test; MXTPU_TPU_TESTS=1")
+def test_fused_lstm_compiles_on_tpu():
+    """Mosaic-compile and run the jit (non-interpret) kernel on the real
+    chip at an eligible shape, checking numerics against the scan."""
+    import subprocess
+    import sys
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = f"""
+import sys; sys.path.insert(0, {repo!r})
+import numpy as np, jax, jax.numpy as jnp
+from mxnet_tpu.ops.pallas_lstm import fused_lstm
+from tests.test_pallas_lstm import _scan_lstm, _rand
+gx, h0, c0, wh, bh = _rand(T=32, N=8, H=128, seed=11)
+ys, hT, cT = fused_lstm(gx, h0, c0, wh, bh, interpret=False)
+rys, rhT, rcT = _scan_lstm(*map(jnp.asarray, (gx, h0, c0, wh, bh)))
+np.testing.assert_allclose(np.asarray(ys), np.asarray(rys), rtol=2e-3, atol=2e-3)
+g = jax.grad(lambda w: jnp.sum(fused_lstm(gx, h0, c0, w, bh,
+                                          interpret=False)[0]))(wh)
+gr = jax.grad(lambda w: jnp.sum(_scan_lstm(gx, h0, c0, w, bh)[0]))(wh)
+np.testing.assert_allclose(np.asarray(g), np.asarray(gr), rtol=5e-3, atol=5e-3)
+print("TPU_FUSED_LSTM_OK")
+"""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    r = subprocess.run([sys.executable, "-c", src], capture_output=True,
+                       text=True, timeout=600, env=env)
+    assert "TPU_FUSED_LSTM_OK" in r.stdout, r.stderr[-2000:]
+
+
+def test_mixed_dtype_bias_gradient():
+    """bf16 weights with an f32 bias: the bias cotangent must keep the
+    bias's own dtype (custom-VJP aval check)."""
+    gx, h0, c0, wh, bh = _rand(T=4, N=2, H=8, seed=12)
+    bf = jnp.bfloat16
+    g = jax.grad(lambda b: jnp.sum(
+        fused_lstm(gx.astype(bf), h0.astype(bf), c0.astype(bf),
+                   wh.astype(bf), b, interpret=True)[0]
+        .astype(jnp.float32)))(bh)
+    assert g.dtype == jnp.float32
+    assert np.isfinite(np.asarray(g)).all()
