@@ -53,8 +53,11 @@ def _sigmoid(x):
 # -- forward ------------------------------------------------------------------
 
 def _fwd_kernel(gx_ref, h0_ref, c0_ref, wh_ref, bh_ref,
-                ys_ref, hT_ref, cT_ref, acts_ref, cells_ref,
-                h_sc, c_sc, *, T, H):
+                *refs, T, H, save):
+    if save:
+        ys_ref, hT_ref, cT_ref, acts_ref, cells_ref, h_sc, c_sc = refs
+    else:
+        ys_ref, hT_ref, cT_ref, h_sc, c_sc = refs
     t = pl.program_id(0)
 
     @pl.when(t == 0)
@@ -73,8 +76,9 @@ def _fwd_kernel(gx_ref, h0_ref, c0_ref, wh_ref, bh_ref,
     o = _sigmoid(gates[:, 3 * H:4 * H])
     c = f * c_sc[:] + i * g
     h = o * jnp.tanh(c)
-    acts_ref[0] = jnp.concatenate([i, f, g, o], axis=-1)
-    cells_ref[0] = c
+    if save:
+        acts_ref[0] = jnp.concatenate([i, f, g, o], axis=-1)
+        cells_ref[0] = c
     ys_ref[0] = h.astype(ys_ref.dtype)
     h_sc[:] = h
     c_sc[:] = c
@@ -85,35 +89,42 @@ def _fwd_kernel(gx_ref, h0_ref, c0_ref, wh_ref, bh_ref,
         cT_ref[:] = c.astype(cT_ref.dtype)
 
 
-def _fwd(gx, h0, c0, wh, bh, interpret):
+def _fwd(gx, h0, c0, wh, bh, interpret, save):
+    """``save=False`` (inference / undifferentiated primal) skips the
+    residual outputs — a pallas_call cannot have unused outputs DCE'd,
+    and the backward residuals are 5x the useful HBM write traffic."""
     T, N, G = gx.shape
     H = G // 4
-    kernel = functools.partial(_fwd_kernel, T=T, H=H)
+    kernel = functools.partial(_fwd_kernel, T=T, H=H, save=save)
     full = lambda t: (0, 0)
+    step3 = lambda t: (t, 0, 0)
+    out_specs = [
+        pl.BlockSpec((1, N, H), step3),
+        pl.BlockSpec((N, H), full),
+        pl.BlockSpec((N, H), full),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((T, N, H), gx.dtype),       # ys
+        jax.ShapeDtypeStruct((N, H), gx.dtype),          # hT
+        jax.ShapeDtypeStruct((N, H), gx.dtype),          # cT
+    ]
+    if save:
+        out_specs += [pl.BlockSpec((1, N, G), step3),
+                      pl.BlockSpec((1, N, H), step3)]
+        out_shape += [jax.ShapeDtypeStruct((T, N, G), jnp.float32),
+                      jax.ShapeDtypeStruct((T, N, H), jnp.float32)]
     return pl.pallas_call(
         kernel,
         grid=(T,),
         in_specs=[
-            pl.BlockSpec((1, N, G), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, N, G), step3),
             pl.BlockSpec((N, H), full),
             pl.BlockSpec((N, H), full),
             pl.BlockSpec((G, H), full),
             pl.BlockSpec((1, G), full),
         ],
-        out_specs=[
-            pl.BlockSpec((1, N, H), lambda t: (t, 0, 0)),
-            pl.BlockSpec((N, H), full),
-            pl.BlockSpec((N, H), full),
-            pl.BlockSpec((1, N, G), lambda t: (t, 0, 0)),
-            pl.BlockSpec((1, N, H), lambda t: (t, 0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T, N, H), gx.dtype),       # ys
-            jax.ShapeDtypeStruct((N, H), gx.dtype),          # hT
-            jax.ShapeDtypeStruct((N, H), gx.dtype),          # cT
-            jax.ShapeDtypeStruct((T, N, G), jnp.float32),    # gate acts
-            jax.ShapeDtypeStruct((T, N, H), jnp.float32),    # cell states
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((N, H), jnp.float32),
             pltpu.VMEM((N, H), jnp.float32),
@@ -232,12 +243,14 @@ def _bwd_call(acts, cells, ys, h0, c0, wh, dys, dhT, dcT, gx_dtype,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
 def _fused(gx, h0, c0, wh, bh, interpret):
-    ys, hT, cT, _, _ = _fwd(gx, h0, c0, wh, bh, interpret)
+    # undifferentiated path (inference): no residual outputs
+    ys, hT, cT = _fwd(gx, h0, c0, wh, bh, interpret, save=False)
     return ys, hT, cT
 
 
 def _fused_fwd(gx, h0, c0, wh, bh, interpret):
-    ys, hT, cT, acts, cells = _fwd(gx, h0, c0, wh, bh, interpret)
+    ys, hT, cT, acts, cells = _fwd(gx, h0, c0, wh, bh, interpret,
+                                   save=True)
     return (ys, hT, cT), (acts, cells, ys, h0, c0, wh, bh)
 
 
@@ -277,8 +290,14 @@ def fused_lstm_eligible(T, N, H, force=None):
     if on_tpu:
         if H % 128 or N % 8:
             return False
-        # wh f32 + dwh f32 scratch: 2 * 4H*H * 4 bytes within half VMEM
-        if 2 * 4 * H * H * 4 > 8 * 1024 * 1024:
+        # VMEM residency: wh + dwh-accumulator f32 (weight term) plus
+        # the batch-proportional working set — h/c scratch, the per-step
+        # (N,4H)/(N,H) in/out blocks and their pipelining double
+        # buffers (~24 (N,H)-equivalents is a conservative count).
+        # Oversize shapes must fall back to the scan, not crash Mosaic.
+        weight_bytes = 2 * 4 * H * H * 4
+        batch_bytes = 24 * N * H * 4
+        if weight_bytes + batch_bytes > 12 * 1024 * 1024:
             return False
     if forced:
         return True

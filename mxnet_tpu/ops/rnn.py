@@ -117,24 +117,40 @@ def _cell_step(mode, H):
     return step
 
 
+def _fused_dispatch(mode, gx, h0, c0, wh, bh):
+    """Route gated cells through their Pallas kernels (weights + state
+    VMEM-resident for the whole sequence) when eligible; returns
+    (ys, hT, cT-or-None), or None to use the scan fallback."""
+    if mode not in ("lstm", "gru"):
+        return None
+    T, N, _ = gx.shape
+    H = h0.shape[-1]
+    if mode == "lstm":
+        from .pallas_lstm import fused_lstm, fused_lstm_eligible
+
+        if not fused_lstm_eligible(T, N, H):
+            return None
+        return fused_lstm(gx, h0, c0, wh, bh)
+    from .pallas_gru import fused_gru, fused_gru_eligible
+
+    if not fused_gru_eligible(T, N, H):
+        return None
+    ys, hT = fused_gru(gx, h0, wh, bh)
+    return ys, hT, None
+
+
 def _run_direction(mode, x, h0, c0, wi, wh, bi, bh, reverse):
     """One layer, one direction over the full sequence."""
     # time-batched input projection: (T, N, I) x (GH, I) -> (T, N, GH)
     gx = jnp.einsum("tni,gi->tng", x, wi) + bi
     if reverse:
         gx = jnp.flip(gx, axis=0)
-    if mode == "lstm":
-        from .pallas_lstm import fused_lstm, fused_lstm_eligible
-
-        T, N, _ = gx.shape
-        H = h0.shape[-1]
-        if fused_lstm_eligible(T, N, H):
-            # Pallas kernel: recurrent weights + state stay in VMEM for
-            # the whole sequence instead of streaming per scan step
-            ys, hT, cT = fused_lstm(gx, h0, c0, wh, bh)
-            if reverse:
-                ys = jnp.flip(ys, axis=0)
-            return ys, hT, cT
+    fused = _fused_dispatch(mode, gx, h0, c0, wh, bh)
+    if fused is not None:
+        ys, hT, cT = fused
+        if reverse:
+            ys = jnp.flip(ys, axis=0)
+        return ys, hT, cT
     step = _cell_step(mode, h0.shape[-1])
     if mode == "lstm":
         (hT, cT), ys = lax.scan(lambda c, g: step(c, (g, wh, bh)), (h0, c0), gx)

@@ -1,0 +1,94 @@
+"""Fused Pallas GRU (ops/pallas_gru.py) vs the lax.scan reference cell
+— forward/backward parity through the interpreter."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.pallas_gru import fused_gru
+
+
+def _scan_gru(gx, h0, wh, bh):
+    """The ops/rnn.py GRU scan cell, inlined as the reference."""
+    def step(h, g):
+        hp = jnp.dot(h, wh.T) + bh
+        rx, zx, nx = jnp.split(g, 3, axis=-1)
+        rh, zh, nh = jnp.split(hp, 3, axis=-1)
+        r = jax.nn.sigmoid(rx + rh)
+        z = jax.nn.sigmoid(zx + zh)
+        n = jnp.tanh(nx + r * nh)
+        h2 = (1 - z) * n + z * h
+        return h2, h2
+
+    hT, ys = jax.lax.scan(step, h0, gx)
+    return ys, hT
+
+
+def _rand(T=6, N=4, H=8, seed=0):
+    rng = np.random.RandomState(seed)
+    gx = rng.randn(T, N, 3 * H).astype(np.float32) * 0.5
+    h0 = rng.randn(N, H).astype(np.float32) * 0.5
+    wh = rng.randn(3 * H, H).astype(np.float32) * 0.3
+    bh = rng.randn(3 * H).astype(np.float32) * 0.1
+    return gx, h0, wh, bh
+
+
+@pytest.mark.parametrize("shape", [(6, 4, 8), (11, 3, 16), (1, 2, 8)])
+def test_forward_matches_scan(shape):
+    T, N, H = shape
+    gx, h0, wh, bh = _rand(T, N, H)
+    ys, hT = fused_gru(gx, h0, wh, bh, interpret=True)
+    rys, rhT = _scan_gru(gx, h0, wh, bh)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(rys),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hT), np.asarray(rhT),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_backward_matches_scan():
+    gx, h0, wh, bh = _rand(T=7, N=4, H=8, seed=1)
+
+    def loss(impl):
+        def f(gx, h0, wh, bh):
+            ys, hT = impl(gx, h0, wh, bh)
+            return jnp.sum(ys * ys) + jnp.sum(jnp.sin(hT))
+        return jax.grad(f, argnums=(0, 1, 2, 3))(gx, h0, wh, bh)
+
+    gf = loss(lambda *a: fused_gru(*a, interpret=True))
+    gr = loss(_scan_gru)
+    for name, a, b in zip(("gx", "h0", "wh", "bh"), gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_rnn_op_gru_fused_matches_scan(monkeypatch):
+    import mxnet_tpu as mx
+
+    T, N, I, H = 5, 3, 6, 8
+    x = np.random.RandomState(4).randn(T, N, I).astype(np.float32)
+
+    def run():
+        rng = np.random.RandomState(7)
+        data = mx.sym.Variable("data")
+        net = mx.sym.RNN(data, mx.sym.Variable("parameters"),
+                         mx.sym.Variable("state"), state_size=H,
+                         num_layers=1, mode="gru", name="rnn")
+        exe = net.simple_bind(mx.cpu(), grad_req="write", data=(T, N, I))
+        for name, arr in exe.arg_dict.items():
+            arr[:] = (x if name == "data"
+                      else (rng.randn(*arr.shape) * 0.2).astype(np.float32))
+        exe.forward(is_train=True)
+        out = exe.outputs[0].asnumpy()
+        exe.backward([mx.nd.array(np.ones_like(out))])
+        return out, {k: v.asnumpy() for k, v in exe.grad_dict.items()}
+
+    monkeypatch.setenv("MXNET_TPU_FUSED_RNN", "1")
+    fused_out, fused_g = run()
+    monkeypatch.setenv("MXNET_TPU_FUSED_RNN", "0")
+    scan_out, scan_g = run()
+    np.testing.assert_allclose(fused_out, scan_out, rtol=1e-5, atol=1e-5)
+    for k in scan_g:
+        np.testing.assert_allclose(fused_g[k], scan_g[k],
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
