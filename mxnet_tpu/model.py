@@ -163,7 +163,10 @@ def stage_async_write(path, writer, on_done=None):
                 pass
             with _async_saves_lock:
                 _async_errors.append((path, e))
-            raise
+            # surfaced by wait_checkpoints(); re-raising here would only
+            # trip threading.excepthook as an unhandled thread error
+            logging.warning("async checkpoint write failed for %r: %r",
+                            path, e)
 
     import os as _os
 

@@ -111,6 +111,7 @@ def test_rank_selection_respects_budget():
     assert used <= total / 2.0
 
 
+@pytest.mark.slow
 def test_compress_end_to_end_and_cli(tmp_path):
     model, params = _small_model()
     x = rng.randn(2, 3, 8, 8).astype(np.float32)

@@ -159,6 +159,7 @@ def test_convert_model_runs_forward():
     np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_cli_roundtrip(tmp_path):
     net, _ = _make_caffemodel()
     pt = tmp_path / "deploy.prototxt"

@@ -94,6 +94,7 @@ def test_errors():
                                num_heads=H, name="other")
 
 
+@pytest.mark.slow
 def test_train_then_generate_learns_cycle():
     """End-to-end: train on a deterministic token cycle with the Module
     stack, then gpt_generate continues the cycle from a prompt."""

@@ -107,6 +107,7 @@ def test_moe_matches_reference():
     assert (np.abs(np.asarray(y)).sum(axis=1) > 0).mean() > 0.5
 
 
+@pytest.mark.slow
 def test_moe_topk_and_grads():
     rng = np.random.RandomState(1)
     mesh = mx.parallel.make_mesh({"ep": 4})
@@ -313,6 +314,7 @@ def test_ulysses_attention_matches_dense(causal):
                                atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_attention_8way_grads():
     from mxnet_tpu.parallel.ulysses import ulysses_attention
 

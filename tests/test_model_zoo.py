@@ -42,6 +42,7 @@ def test_inception_bn_imagenet_shapes():
 
 
 @pytest.mark.parametrize("depth", [11, 16])
+@pytest.mark.slow
 def test_vgg_forward(depth):
     _forward(mx.models.vgg(num_classes=13, num_layers=depth),
              (1, 3, 224, 224), 13)
@@ -56,6 +57,7 @@ def test_alexnet_forward():
     _forward(mx.models.alexnet(num_classes=7), (1, 3, 227, 227), 7)
 
 
+@pytest.mark.slow
 def test_inception_small_trains():
     """A few SGD steps reduce loss on random-but-fixed CIFAR-shaped data."""
     net = mx.models.inception_bn_small(num_classes=4)
@@ -81,6 +83,7 @@ def test_inception_small_trains():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_googlenet_forward():
     net = mx.models.googlenet(num_classes=1000)
     arg_shapes, out_shapes, _ = net.infer_shape(

@@ -172,6 +172,7 @@ def test_torch_function_eager():
     np.testing.assert_allclose(out.asnumpy(), [[1, 2], [3, 4]], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_notebook_callbacks():
     """Notebook metric loggers (reference python/mxnet/notebook/callback.py
     surface: PandasLogger frames + live-curve history)."""

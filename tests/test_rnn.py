@@ -120,6 +120,7 @@ def test_bidirectional_multilayer():
                                atol=1e-5)
 
 
+@pytest.mark.slow
 def test_rnn_lm_trains():
     """Tiny LSTM LM via the fused op learns a deterministic pattern."""
     V, T, N, H = 12, 8, 16, 32

@@ -13,6 +13,7 @@ def small_input():
     return (2, 3, 300, 300)
 
 
+@pytest.mark.slow
 def test_ssd_train_graph(small_input):
     np.random.seed(0)
     net = mx.models.ssd(num_classes=3, mode="train", filter_scale=16)

@@ -125,6 +125,7 @@ def test_gpt_sharded_trainer_adam_multichip():
     assert np.isfinite(np.asarray(outs[0])).all()
 
 
+@pytest.mark.slow
 def test_gpt_remat_matches_plain():
     """remat=True (force_mirroring rematerialization) must not change the
     math — same loss trajectory as the plain model."""

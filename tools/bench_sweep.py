@@ -14,6 +14,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -46,6 +47,13 @@ def main():
     p.add_argument("--out", default=os.path.join(REPO, "BENCH_SWEEP.json"))
     p.add_argument("--quick", action="store_true",
                    help="one batch size per config")
+    p.add_argument("--retry-failed", action="store_true",
+                   help="re-run only the error points of an existing --out "
+                        "file, keeping its good results (tunnel-flake "
+                        "recovery)")
+    p.add_argument("--retries", type=int, default=2,
+                   help="extra attempts per point on error (the axon "
+                        "tunnel drops transiently)")
     args = p.parse_args()
 
     points = []
@@ -59,9 +67,24 @@ def main():
     gpt_points = [{"BENCH_MODEL": "gpt", "BENCH_BATCH": bs}
                   for bs in gpt_batches]
 
+    todo = points + gpt_points
     results = []
-    for pt in points + gpt_points:
+    if args.retry_failed and os.path.exists(args.out):
+        prior = json.load(open(args.out)).get("results", [])
+        good = [r for r in prior if "error" not in r]
+        done = [r.get("config") for r in good]
+        results = list(good)
+        todo = [pt for pt in todo if pt not in done]
+        print(f"retry mode: {len(good)} good points kept, "
+              f"{len(todo)} to (re)run")
+
+    for pt in todo:
         rec = run_point(pt)
+        for _ in range(args.retries):
+            if "error" not in rec:
+                break
+            time.sleep(30)  # give a dropped tunnel a moment to return
+            rec = run_point(pt)
         results.append(rec)
         print(json.dumps(rec))
         # incremental write: a crash mid-sweep keeps completed points
