@@ -1,0 +1,58 @@
+"""Driver-contract regression gate for bench.py.
+
+The driver runs ``python bench.py`` at the end of every round and
+records its one JSON line; a crash (e.g. an internal trainer-API
+signature change) silently downgrades the round's official perf record
+to a CPU fallback or an error line.  These tests run both benchmark
+modes in CPU smoke mode and assert the contract fields, so the break
+is caught in CI instead of on round-end hardware.  (SURVEY.md §4 lists
+"no perf regression gates" among the reference's testing gaps to
+improve on.)
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(extra_env):
+    env = dict(os.environ)
+    env.update(extra_env)
+    # BENCH_CHILD skips the watchdog wrapper; BENCH_FORCE_CPU pins the
+    # backend so the test never touches (or waits for) the TPU tunnel
+    env["BENCH_CHILD"] = "1"
+    env["BENCH_FORCE_CPU"] = "1"
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert lines, f"no JSON line in output: {r.stdout!r}"
+    return json.loads(lines[-1])
+
+
+def _check_contract(rec, metric, unit):
+    assert rec["metric"] == metric
+    assert rec["unit"] == unit
+    assert rec["value"] > 0
+    assert rec["vs_baseline"] > 0
+    assert rec["platform"] == "cpu"
+    # MFU accounting fields (VERDICT round-1 weak #2)
+    assert rec["fwd_gflops_per_sample"] > 0
+    assert rec["model_tflops_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_resnet_bench_contract():
+    rec = _run_bench({})
+    _check_contract(rec, "resnet50_train_throughput", "images/sec/chip")
+
+
+@pytest.mark.slow
+def test_gpt_bench_contract():
+    rec = _run_bench({"BENCH_MODEL": "gpt"})
+    _check_contract(rec, "gpt_train_throughput", "tokens/sec/chip")

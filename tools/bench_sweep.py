@@ -47,17 +47,21 @@ def main():
     p.add_argument("--out", default=os.path.join(REPO, "BENCH_SWEEP.json"))
     p.add_argument("--quick", action="store_true",
                    help="one batch size per config")
+    p.add_argument("--fresh", action="store_true",
+                   help="ignore an existing --out file and re-measure every "
+                        "point (default: keep its good results and only run "
+                        "missing/failed points, so a tunnel flake can never "
+                        "clobber real measurements)")
+    # kept as an alias of the (now default) merge behavior
     p.add_argument("--retry-failed", action="store_true",
-                   help="re-run only the error points of an existing --out "
-                        "file, keeping its good results (tunnel-flake "
-                        "recovery)")
+                   help=argparse.SUPPRESS)
     p.add_argument("--retries", type=int, default=2,
                    help="extra attempts per point on error (the axon "
                         "tunnel drops transiently)")
     args = p.parse_args()
 
     points = []
-    batches = ["128"] if args.quick else ["128", "256", "512"]
+    batches = ["128"] if args.quick else ["64", "128", "256", "512"]
     for layout, stem in (("NHWC", "s2d"), ("NHWC", "conv7"),
                          ("NCHW", "conv7")):
         for bs in batches:
@@ -69,14 +73,17 @@ def main():
 
     todo = points + gpt_points
     results = []
-    if args.retry_failed and os.path.exists(args.out):
+    if not args.fresh and os.path.exists(args.out):
         prior = json.load(open(args.out)).get("results", [])
-        good = [r for r in prior if "error" not in r]
+        # only real-hardware measurements count as done: a CPU-fallback
+        # record must not mask the point on the next TPU-healthy run
+        good = [r for r in prior
+                if "error" not in r and r.get("platform") == "tpu"]
         done = [r.get("config") for r in good]
         results = list(good)
         todo = [pt for pt in todo if pt not in done]
-        print(f"retry mode: {len(good)} good points kept, "
-              f"{len(todo)} to (re)run")
+        print(f"merge mode: {len(good)} good points kept, "
+              f"{len(todo)} to (re)run (--fresh to re-measure all)")
 
     for pt in todo:
         rec = run_point(pt)
