@@ -321,14 +321,7 @@ def kvstore_barrier(kv):
 
 def _iter_registry():
     from . import io
-    reg = {"MNISTIter": io.MNISTIter, "CSVIter": io.CSVIter,
-           "NDArrayIter": io.NDArrayIter}
-    try:
-        from . import image_io
-        reg["ImageRecordIter"] = image_io.ImageRecordIter
-    except Exception:
-        pass
-    return reg
+    return io.iter_registry()
 
 
 def list_data_iters():

@@ -7,7 +7,8 @@ import math
 import time
 
 __all__ = ["do_checkpoint", "module_checkpoint", "Speedometer", "ProgressBar",
-           "log_train_metric", "BatchEndParam"]
+           "log_train_metric", "BatchEndParam",
+           "LogValidationMetricsCallback"]
 
 
 class BatchEndParam:
@@ -109,3 +110,15 @@ class ProgressBar:
         percents = math.ceil(100.0 * count / float(self.total))
         prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
         logging.info("[%s] %s%s", prog_bar, percents, "%")
+
+
+class LogValidationMetricsCallback:
+    """Log eval metrics at epoch end (reference callback.py:127-136);
+    pass as ``eval_end_callback`` to ``fit``."""
+
+    def __call__(self, param):
+        if not param.eval_metric:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f",
+                         param.epoch, name, value)

@@ -13,6 +13,7 @@ iter_mnist.cc).  The ImageRecordIter pipeline lives in image_io.py.
 from __future__ import annotations
 
 import queue
+import re
 import struct
 import threading
 
@@ -23,7 +24,9 @@ from .base import MXNetError
 from .ndarray import NDArray
 
 __all__ = ["DataIter", "DataBatch", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter", "DataDesc"]
+           "PrefetchingIter", "CSVIter", "MNISTIter", "DataDesc",
+           "LayoutMapper", "DefaultLayoutMapper", "MXDataIter",
+           "iter_registry"]
 
 
 class DataDesc:
@@ -390,3 +393,63 @@ class MNISTIter(NDArrayIter):
             images, labels = images[perm], labels[perm]
         super().__init__(images, labels, batch_size, shuffle=False,
                          last_batch_handle="discard", **kwargs)
+
+
+# -- layout mappers (reference io.py:24-85) ---------------------------------
+
+class LayoutMapper:
+    """Decide the layout (hence batch axis) of a stream from its NAME
+    alone — the reference protocol (io.py:24-57) used when shapes come
+    without :class:`DataDesc` metadata.  The TPU build carries layouts
+    on ``DataDesc`` directly; this mapper exists for reference-style
+    code that encodes layout in names instead."""
+
+    def get_layout_string(self, name):
+        raise NotImplementedError
+
+    def get_batch_axis(self, name):
+        """Index of the 'N' axis; -1 when the stream has no batch axis."""
+        layout = self.get_layout_string(name)
+        return -1 if layout is None else layout.find("N")
+
+
+class DefaultLayoutMapper(LayoutMapper):
+    """Name-tag layout mapper (reference io.py:59-85): a name carrying a
+    ``:__layout_NTC__`` tag yields that layout; anything else yields the
+    constructor default.  (The tag regex accepts a full layout string —
+    multi-character — rather than the single character the reference's
+    pattern matched.)"""
+
+    LAYOUT_PATTERN = re.compile(r":__layout_([A-Za-z]+)__")
+
+    def __init__(self, default_layout="NCHW"):
+        self._default = default_layout
+
+    def get_layout_string(self, name):
+        m = self.LAYOUT_PATTERN.search(name)
+        return m.group(1) if m else self._default
+
+
+# -- by-name iterator factory (reference io.py:521 MXDataIter) --------------
+
+def iter_registry():
+    """Name → iterator class for every registered iterator; the same
+    registry backs the C ABI's MXTPUListDataIters/MXTPUDataIterCreate
+    (reference: runtime-discovered C++ iterators, MXNET_REGISTER_IO_ITER
+    include/mxnet/io.h:24-98)."""
+    from . import image_io
+    return {"MNISTIter": MNISTIter, "CSVIter": CSVIter,
+            "NDArrayIter": NDArrayIter,
+            "ImageRecordIter": image_io.ImageRecordIter}
+
+
+def MXDataIter(name, **kwargs):
+    """Create a registered iterator by name — the reference's handle-based
+    ``MXDataIter`` (io.py:521, backed by MXDataIterCreateIter) as a
+    factory.  In the TPU build every iterator is a Python class with a
+    native fast path, so the 'handle' is simply the instance."""
+    cls = iter_registry().get(name)
+    if cls is None:
+        raise MXNetError(
+            f"no data iterator {name!r}; available: {sorted(iter_registry())}")
+    return cls(**kwargs)

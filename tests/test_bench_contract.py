@@ -41,9 +41,12 @@ def _check_contract(rec, metric, unit):
     assert rec["value"] > 0
     assert rec["vs_baseline"] > 0
     assert rec["platform"] == "cpu"
-    # MFU accounting fields (VERDICT round-1 weak #2)
+    # MFU accounting fields (VERDICT round-1 weak #2).  model_tflops is
+    # rounded to 2 decimals and can legitimately be 0.0 on a very slow
+    # CI box, so assert presence only; the 3-decimal per-sample FLOPs
+    # field is a deterministic analytic count and must be positive.
     assert rec["fwd_gflops_per_sample"] > 0
-    assert rec["model_tflops_per_sec"] > 0
+    assert "model_tflops_per_sec" in rec
 
 
 @pytest.mark.slow

@@ -157,3 +157,45 @@ def test_irheader_pack_unpack():
     hdr2, payload = recordio.unpack(packed)
     np.testing.assert_allclose(hdr2.label, [1, 2, 3])
     assert payload == b"data"
+
+
+def test_layout_mapper():
+    """Name-driven layout decisions (reference io.py:24-85)."""
+    m = mx.io.DefaultLayoutMapper()
+    assert m.get_layout_string("data") == "NCHW"
+    assert m.get_batch_axis("data") == 0
+    assert m.get_layout_string("seq:__layout_TNC__") == "TNC"
+    assert m.get_batch_axis("seq:__layout_TNC__") == 1
+    m2 = mx.io.DefaultLayoutMapper(default_layout="TNC")
+    assert m2.get_batch_axis("anything") == 1
+
+
+def test_mxdataiter_by_name(tmp_path):
+    """MXDataIter factory resolves registered iterators by name
+    (reference io.py:521) from the same registry as the C ABI."""
+    import numpy as np
+
+    reg = mx.io.iter_registry()
+    for name in ("MNISTIter", "CSVIter", "NDArrayIter", "ImageRecordIter"):
+        assert name in reg, reg
+    X = np.arange(24, dtype=np.float32).reshape(6, 4)
+    it = mx.io.MXDataIter("NDArrayIter", data=X, batch_size=2)
+    batches = list(it)
+    assert len(batches) == 3
+    with pytest.raises(mx.base.MXNetError):
+        mx.io.MXDataIter("NoSuchIter")
+
+
+def test_log_validation_metrics_callback(caplog):
+    """LogValidationMetricsCallback logs each metric at epoch end
+    (reference callback.py:127-136)."""
+    import logging
+
+    from mxnet_tpu.callback import BatchEndParam
+
+    m = mx.metric.Accuracy()
+    m.update([mx.nd.array([0, 1])], [mx.nd.array([[0.9, 0.1], [0.2, 0.8]])])
+    param = BatchEndParam(epoch=3, nbatch=0, eval_metric=m, locals=None)
+    with caplog.at_level(logging.INFO):
+        mx.callback.LogValidationMetricsCallback()(param)
+    assert any("Validation-accuracy" in r.message for r in caplog.records)

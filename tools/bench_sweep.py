@@ -19,6 +19,15 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _git_rev():
+    try:
+        r = subprocess.run(["git", "-C", REPO, "rev-parse", "--short", "HEAD"],
+                           capture_output=True, text=True, timeout=10)
+        return r.stdout.strip() or None
+    except Exception:
+        return None
+
+
 def run_point(env_overrides, timeout=2400):
     env = dict(os.environ)
     env.update(env_overrides)
@@ -73,17 +82,32 @@ def main():
 
     todo = points + gpt_points
     results = []
+    rev = _git_rev()
     if not args.fresh and os.path.exists(args.out):
         prior = json.load(open(args.out)).get("results", [])
         # only real-hardware measurements count as done: a CPU-fallback
-        # record must not mask the point on the next TPU-healthy run
+        # record must not mask the point on the next TPU-healthy run.
+        # Records whose config left the grid are dropped so a removed
+        # configuration can never win "best".
         good = [r for r in prior
-                if "error" not in r and r.get("platform") == "tpu"]
+                if "error" not in r and r.get("platform") == "tpu"
+                and r.get("config") in todo]
         done = [r.get("config") for r in good]
         results = list(good)
         todo = [pt for pt in todo if pt not in done]
         print(f"merge mode: {len(good)} good points kept, "
               f"{len(todo)} to (re)run (--fresh to re-measure all)")
+        stale = sorted({r.get("git_rev") for r in good
+                        if r.get("git_rev") not in (None, rev)})
+        if stale:
+            print(f"WARNING: {sum(1 for r in good if r.get('git_rev') != rev)}"
+                  f" kept points were measured at other revision(s) "
+                  f"{stale} (current {rev}); pass --fresh if the compute "
+                  "path changed", file=sys.stderr)
+        if not todo:
+            print("WARNING: nothing to measure — every grid point is "
+                  "already recorded; pass --fresh to re-measure",
+                  file=sys.stderr)
 
     for pt in todo:
         rec = run_point(pt)
@@ -92,6 +116,7 @@ def main():
                 break
             time.sleep(30)  # give a dropped tunnel a moment to return
             rec = run_point(pt)
+        rec["git_rev"] = rev
         results.append(rec)
         print(json.dumps(rec))
         # incremental write: a crash mid-sweep keeps completed points
