@@ -80,7 +80,36 @@ def main():
     gpt_points = [{"BENCH_MODEL": "gpt", "BENCH_BATCH": bs}
                   for bs in gpt_batches]
 
-    todo = points + gpt_points
+    # XLA flag experiments on the best-known config: scoped-VMEM headroom
+    # lets the fusion cost model build larger fusions (public TPU perf
+    # knob); unknown/ineffective flags just reproduce the base number.
+    flag_points = []
+    if not args.quick:
+        for kib in ("32768", "65536"):
+            flag_points.append({
+                "BENCH_LAYOUT": "NHWC", "BENCH_STEM": "s2d",
+                "BENCH_BATCH": "128",
+                "LIBTPU_INIT_ARGS":
+                    f"--xla_tpu_scoped_vmem_limit_kib={kib}"})
+
+    # the complete current grid, independent of --quick: merge mode keeps
+    # any prior record whose config is still part of THIS grid, so a
+    # --quick run can never drop full-sweep measurements
+    full_grid = []
+    for layout, stem in (("NHWC", "s2d"), ("NHWC", "conv7"),
+                         ("NCHW", "conv7")):
+        for bs in ("64", "128", "256", "512"):
+            full_grid.append({"BENCH_LAYOUT": layout, "BENCH_STEM": stem,
+                              "BENCH_BATCH": bs})
+    full_grid += [{"BENCH_MODEL": "gpt", "BENCH_BATCH": bs}
+                  for bs in ("8", "16", "32")]
+    full_grid += [{"BENCH_LAYOUT": "NHWC", "BENCH_STEM": "s2d",
+                   "BENCH_BATCH": "128",
+                   "LIBTPU_INIT_ARGS":
+                       f"--xla_tpu_scoped_vmem_limit_kib={kib}"}
+                  for kib in ("32768", "65536")]
+
+    todo = points + gpt_points + flag_points
     results = []
     rev = _git_rev()
     if not args.fresh and os.path.exists(args.out):
@@ -91,7 +120,7 @@ def main():
         # configuration can never win "best".
         good = [r for r in prior
                 if "error" not in r and r.get("platform") == "tpu"
-                and r.get("config") in todo]
+                and r.get("config") in full_grid]
         done = [r.get("config") for r in good]
         results = list(good)
         todo = [pt for pt in todo if pt not in done]
@@ -100,10 +129,11 @@ def main():
         stale = sorted({r.get("git_rev") for r in good
                         if r.get("git_rev") not in (None, rev)})
         if stale:
-            print(f"WARNING: {sum(1 for r in good if r.get('git_rev') != rev)}"
-                  f" kept points were measured at other revision(s) "
-                  f"{stale} (current {rev}); pass --fresh if the compute "
-                  "path changed", file=sys.stderr)
+            n_stale = sum(1 for r in good
+                          if r.get("git_rev") not in (None, rev))
+            print(f"WARNING: {n_stale} kept points were measured at other "
+                  f"revision(s) {stale} (current {rev}); pass --fresh if "
+                  "the compute path changed", file=sys.stderr)
         if not todo:
             print("WARNING: nothing to measure — every grid point is "
                   "already recorded; pass --fresh to re-measure",

@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Persistent TPU benchmark capture loop.
+
+The device tunnel in this environment comes and goes; this watchdog
+keeps probing and, whenever the TPU is reachable, captures the full
+artifact set in priority order:
+
+  1. bench.py (ResNet-50 throughput)        -> BENCH_TPU_LATEST.json
+  2. bench.py BENCH_MODEL=gpt               -> BENCH_GPT_LATEST.json
+  3. tools/bandwidth/measure.py --json      -> BANDWIDTH.json
+  4. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
+
+Each successful TPU-platform result is also appended to
+BENCH_ATTEMPTS.jsonl with a timestamp so nothing is lost if a later
+stage hangs.  Run it in the background; it exits once all four
+artifacts have been captured on real TPU (or runs forever with
+--forever, re-measuring).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "BENCH_ATTEMPTS.jsonl")
+
+
+def log(msg):
+    sys.stderr.write(f"[bench_watch {time.strftime('%H:%M:%S')}] {msg}\n")
+    sys.stderr.flush()
+
+
+def probe(timeout=150):
+    """Cheap reachability check: can a fresh process list a TPU device?"""
+    code = ("import jax; import sys; "
+            "sys.exit(0 if any(d.platform=='tpu' for d in jax.devices()) "
+            "else 1)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                           capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def record(tag, rec):
+    rec = dict(rec)
+    rec["_tag"] = tag
+    rec["_ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def run_bench(env_overrides, out_path, tag, timeout=1500):
+    env = dict(os.environ)
+    env.update(env_overrides)
+    env["BENCH_CHILD"] = "1"  # no CPU fallback: we want TPU or nothing
+    try:
+        r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                           capture_output=True, text=True, timeout=timeout,
+                           env=env)
+    except subprocess.TimeoutExpired:
+        log(f"{tag}: timed out after {timeout}s")
+        return False
+    for line in r.stdout.splitlines():
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("platform") == "tpu" or rec.get("on_tpu"):
+            record(tag, rec)
+            with open(out_path, "w") as f:
+                f.write(json.dumps(rec) + "\n")
+            log(f"{tag}: captured {rec.get('value')} {rec.get('unit')}")
+            return True
+        log(f"{tag}: non-TPU result ({rec.get('platform')}), discarding")
+        return False
+    log(f"{tag}: no JSON line (rc={r.returncode}): {(r.stderr or '')[-300:]}")
+    return False
+
+
+def run_bandwidth(timeout=1200):
+    out = os.path.join(REPO, "BANDWIDTH.json")
+    tmp = out + ".tmp"
+    if os.path.exists(tmp):
+        os.unlink(tmp)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "bandwidth",
+                                          "measure.py"), "--dtype", "bfloat16",
+             "--json", tmp],
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        log("bandwidth: timed out")
+        return False
+    if not os.path.exists(tmp):
+        log(f"bandwidth: no JSON written (rc={r.returncode}): "
+            f"{(r.stderr or '')[-300:]}")
+        return False
+    with open(tmp) as f:
+        payload = json.loads(f.readlines()[-1])
+    os.unlink(tmp)
+    if payload.get("platform") != "tpu":
+        log("bandwidth: not a TPU measurement, discarding")
+        return False
+    record("bandwidth", payload)
+    with open(out, "w") as f:
+        f.write(json.dumps(payload, indent=1) + "\n")
+    log("bandwidth: captured")
+    return True
+
+
+def run_sweep(timeout=7200):
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "bench_sweep.py")],
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        log("sweep: timed out (partial results kept by its incremental writer)")
+        return False
+    # exit 0 alone is not success: the sweep exits cleanly even when
+    # every point errored (tunnel drop mid-sweep) — require that the
+    # artifact holds at least one real-TPU record for every grid point
+    out = os.path.join(REPO, "BENCH_SWEEP.json")
+    try:
+        recs = json.load(open(out)).get("results", [])
+    except (OSError, ValueError):
+        recs = []
+    n_tpu = sum(1 for x in recs
+                if "error" not in x and x.get("platform") == "tpu")
+    n_err = len(recs) - n_tpu
+    log(f"sweep: rc={r.returncode}, {n_tpu} TPU points, {n_err} errors")
+    return r.returncode == 0 and n_tpu > 0 and n_err == 0
+
+
+def run_tpu_consistency(timeout=2400):
+    """The cpu-vs-tpu numerics gate (tests/test_tpu_consistency.py) has
+    only ever run when a session held the chip; record a pass here."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest",
+             os.path.join(REPO, "tests", "test_tpu_consistency.py"),
+             "-q", "--no-header"],
+            capture_output=True, text=True, timeout=timeout,
+            env={**os.environ, "MXTPU_TPU_TESTS": "1"})
+    except subprocess.TimeoutExpired:
+        log("tpu_consistency: timed out")
+        return False
+    tail = (r.stdout or "").strip().splitlines()[-1:] or [""]
+    rec = {"rc": r.returncode, "tail": tail[0]}
+    if r.returncode == 0 and "skipped" not in tail[0]:
+        record("tpu_consistency", rec)
+        with open(os.path.join(REPO, "TPU_CONSISTENCY.json"), "w") as f:
+            f.write(json.dumps(rec) + "\n")
+        log(f"tpu_consistency: PASSED ({tail[0]})")
+        return True
+    log(f"tpu_consistency: rc={r.returncode} {tail[0]}")
+    return False
+
+
+def main():
+    forever = "--forever" in sys.argv
+    done = {"resnet": False, "gpt": False, "bandwidth": False,
+            "consistency": False, "sweep": False}
+    fails = {k: 0 for k in done}
+    MAX_FAILS = 6  # give up on a stage that fails repeatedly WITH the
+    #               probe passing (a code bug, not a tunnel flake)
+
+    def attempt(name, fn):
+        ok = fn()
+        if ok:
+            fails[name] = 0
+            return True
+        fails[name] += 1
+        if fails[name] >= MAX_FAILS:
+            log(f"{name}: {MAX_FAILS} consecutive failures, giving up "
+                "on this stage")
+            return True  # mark done so later stages still get captured
+        # back off: a failed stage with a passing probe would otherwise
+        # hot-loop fresh JAX processes against the shared chip
+        time.sleep(90)
+        return False
+
+    while True:
+        if not probe():
+            log("TPU unreachable; retrying in 60s")
+            time.sleep(60)
+            continue
+        log("TPU reachable")
+        if not done["resnet"]:
+            done["resnet"] = attempt("resnet", lambda: run_bench(
+                {}, os.path.join(REPO, "BENCH_TPU_LATEST.json"), "resnet"))
+            continue  # re-probe between stages: the tunnel may drop anytime
+        if not done["gpt"]:
+            done["gpt"] = attempt("gpt", lambda: run_bench(
+                {"BENCH_MODEL": "gpt"},
+                os.path.join(REPO, "BENCH_GPT_LATEST.json"), "gpt"))
+            continue
+        if not done["bandwidth"]:
+            done["bandwidth"] = attempt("bandwidth", run_bandwidth)
+            continue
+        if not done["consistency"]:
+            done["consistency"] = attempt("consistency", run_tpu_consistency)
+            continue
+        if not done["sweep"]:
+            done["sweep"] = attempt("sweep", run_sweep)
+            continue
+        if not forever:
+            log("all artifacts captured; exiting")
+            return 0
+        time.sleep(600)
+        done = {k: False for k in done}
+        fails = {k: 0 for k in fails}
+
+
+if __name__ == "__main__":
+    sys.exit(main())
