@@ -212,8 +212,10 @@ def bench_gpt(jax, np, mx, on_tpu, n_chips):
         n_warmup, n_iter = 2, 4
     batch = batch_per_chip * n_chips
 
+    fused_qkv = os.environ.get("BENCH_FUSED_QKV", "1") == "1"
     net = mx.models.gpt(vocab, seq_len, num_layers=n_layers,
-                        d_model=d_model, num_heads=n_heads)
+                        d_model=d_model, num_heads=n_heads,
+                        fused_qkv=fused_qkv)
     mesh = mx.parallel.local_mesh("dp")
     trainer = mx.parallel.ShardedTrainer(
         net, {"data": (batch, seq_len), "softmax_label": (batch, seq_len)},
@@ -237,7 +239,7 @@ def bench_gpt(jax, np, mx, on_tpu, n_chips):
         "unit": "tokens/sec/chip",
         "vs_baseline": round(tokens_per_sec / baseline_tokens_per_sec, 4),
         "batch": batch, "seq_len": seq_len, "d_model": d_model,
-        "n_layers": n_layers, "dtype": dtype,
+        "n_layers": n_layers, "dtype": dtype, "fused_qkv": fused_qkv,
         "platform": "tpu" if on_tpu else jax.devices()[0].platform,
     }
     result.update(_mfu_fields(net, {"data": (1, seq_len)},

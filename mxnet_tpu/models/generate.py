@@ -82,12 +82,26 @@ def gpt_generate(params, prompt, max_new_tokens, num_heads,
             "prefix or not a gpt() parameter dict") from None
     d_model = tok_w.shape[1]
     S = pos_w.shape[1]
+    if f"{name}_l0_qkv_weight" in params:
+        # fused_qkv=True checkpoint layout: split each (3D, D) projection
+        # back into the q/k/v entries the decoder addresses
+        params = dict(params)
+        i = 0
+        while f"{name}_l{i}_qkv_weight" in params:
+            for kind in ("weight", "bias"):
+                parts = np.split(
+                    np.asarray(params.pop(f"{name}_l{i}_qkv_{kind}")), 3,
+                    axis=0)
+                for x, part in zip(("q", "k", "v"), parts):
+                    params[f"{name}_l{i}_{x}_{kind}"] = part
+            i += 1
     n_layers = 0
     while f"{name}_l{n_layers}_q_weight" in params:
         n_layers += 1
     if n_layers == 0:
-        raise ValueError(f"no '{name}_l0_q_weight' in params — wrong "
-                         "name prefix or not a gpt() parameter dict")
+        raise ValueError(f"no '{name}_l0_q_weight' (or '_l0_qkv_weight') "
+                         f"in params — wrong name prefix or not a gpt() "
+                         "parameter dict")
     if d_model % num_heads:
         raise ValueError("num_heads must divide d_model")
     head_dim = d_model // num_heads

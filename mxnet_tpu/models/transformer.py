@@ -17,7 +17,8 @@ from .. import symbol as sym
 
 
 def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
-        d_ff=None, dropout=0.0, causal=True, remat=False, name="gpt"):
+        d_ff=None, dropout=0.0, causal=True, remat=False, fused_qkv=False,
+        name="gpt"):
     """Symbol computing next-token softmax loss.
 
     Inputs: ``data`` (batch, seq_len) token ids; ``softmax_label``
@@ -28,6 +29,12 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
     the executor rematerializes its activations in backward
     (jax.checkpoint) — activation memory drops from O(layers x seq) to
     O(seq) at ~1/3 extra FLOPs, the standard long-context trade.
+
+    ``fused_qkv=True`` computes Q/K/V as ONE (d_model, 3*d_model) matmul
+    per layer instead of three: the MXU does the same FLOPs but the
+    activation tile is read from HBM once, and one weight layout stays
+    resident.  Changes the checkpoint layout (``*_qkv_weight`` replaces
+    ``*_{q,k,v}_weight``), so it is opt-in.
     """
     if d_model % num_heads:
         raise ValueError("d_model must divide into num_heads")
@@ -55,9 +62,21 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
             # -- attention block (pre-LN) -------------------------------
             ln1 = sym.LayerNorm(h, name=f"{p}_ln1")
             flat = sym.Reshape(ln1, shape=(-1, d_model))
-            q = sym.FullyConnected(flat, name=f"{p}_q", num_hidden=d_model)
-            k = sym.FullyConnected(flat, name=f"{p}_k", num_hidden=d_model)
-            v = sym.FullyConnected(flat, name=f"{p}_v", num_hidden=d_model)
+            if fused_qkv:
+                qkv = sym.FullyConnected(flat, name=f"{p}_qkv",
+                                         num_hidden=3 * d_model)
+                q = sym.slice_axis(qkv, axis=1, begin=0, end=d_model)
+                k = sym.slice_axis(qkv, axis=1, begin=d_model,
+                                   end=2 * d_model)
+                v = sym.slice_axis(qkv, axis=1, begin=2 * d_model,
+                                   end=3 * d_model)
+            else:
+                q = sym.FullyConnected(flat, name=f"{p}_q",
+                                       num_hidden=d_model)
+                k = sym.FullyConnected(flat, name=f"{p}_k",
+                                       num_hidden=d_model)
+                v = sym.FullyConnected(flat, name=f"{p}_v",
+                                       num_hidden=d_model)
 
             def heads(x):
                 x = sym.Reshape(x, shape=(-1, seq_len, num_heads, head_dim))

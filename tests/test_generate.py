@@ -140,3 +140,34 @@ def test_decoder_cache_distinguishes_d_model():
     a = mx.models.gpt_generate(p16, prompt, 3, num_heads=2)
     b = mx.models.gpt_generate(p32, prompt, 3, num_heads=2)
     assert a.shape == b.shape == (1, 5)
+
+
+def test_generate_accepts_fused_qkv_checkpoint():
+    """fused_qkv=True checkpoints must decode identically to their
+    unfused translation (the layouts are the same math)."""
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(7)
+    V, S = 30, 10
+    net = mx.models.gpt(V, S, num_layers=1, d_model=16, num_heads=2,
+                        fused_qkv=True)
+    exe = net.simple_bind(mx.cpu(), grad_req="null", data=(1, S),
+                          softmax_label=(1, S))
+    params = {}
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            w = rng.randn(*arr.shape).astype(np.float32) * 0.1
+            params[name] = w
+    prompt = rng.randint(0, V, (2, 3))
+    ids = mx.models.gpt_generate(params, prompt, max_new_tokens=3,
+                                 num_heads=2)
+    assert ids.shape == (2, 6)
+    # manual split to the unfused layout gives the same continuation
+    unfused = dict(params)
+    for kind in ("weight", "bias"):
+        parts = np.split(unfused.pop(f"gpt_l0_qkv_{kind}"), 3, axis=0)
+        for x, part in zip(("q", "k", "v"), parts):
+            unfused[f"gpt_l0_{x}_{kind}"] = part
+    ids2 = mx.models.gpt_generate(unfused, prompt, max_new_tokens=3,
+                                  num_heads=2)
+    np.testing.assert_array_equal(ids, ids2)

@@ -151,3 +151,42 @@ def test_gpt_remat_matches_plain():
         losses[remat] = tr.get_params()["gpt_head_bias"]
     np.testing.assert_allclose(losses[False], losses[True],
                                atol=1e-5, rtol=1e-4)
+
+
+def test_gpt_fused_qkv_matches_plain():
+    """fused_qkv=True is the same math: with qkv_weight/bias set to the
+    concatenation of the per-projection weights, forward output matches
+    the three-matmul model exactly."""
+    rng = np.random.RandomState(5)
+    V, S, B = 20, 8, 2
+    kw = dict(num_layers=2, d_model=16, num_heads=2)
+    plain = mx.models.gpt(V, S, **kw)
+    fused = mx.models.gpt(V, S, fused_qkv=True, **kw)
+
+    exe_p = plain.simple_bind(mx.cpu(), grad_req="null", data=(B, S),
+                              softmax_label=(B, S))
+    for name, arr in exe_p.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = rng.randn(*arr.shape).astype(np.float32) * 0.1
+
+    exe_f = fused.simple_bind(mx.cpu(), grad_req="null", data=(B, S),
+                              softmax_label=(B, S))
+    pd = exe_p.arg_dict
+    for name, arr in exe_f.arg_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        if "_qkv_" in name:
+            arr[:] = np.concatenate(
+                [pd[name.replace("_qkv_", f"_{x}_")].asnumpy()
+                 for x in ("q", "k", "v")], axis=0)
+        else:
+            arr[:] = pd[name].asnumpy()
+
+    toks = rng.randint(0, V, (B, S)).astype(np.float32)
+    exe_p.arg_dict["data"][:] = toks
+    exe_f.arg_dict["data"][:] = toks
+    exe_p.forward(is_train=False)
+    exe_f.forward(is_train=False)
+    np.testing.assert_allclose(exe_f.outputs[0].asnumpy(),
+                               exe_p.outputs[0].asnumpy(),
+                               rtol=1e-5, atol=1e-6)

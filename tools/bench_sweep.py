@@ -69,47 +69,29 @@ def main():
                         "tunnel drops transiently)")
     args = p.parse_args()
 
-    points = []
-    batches = ["128"] if args.quick else ["64", "128", "256", "512"]
-    for layout, stem in (("NHWC", "s2d"), ("NHWC", "conv7"),
-                         ("NCHW", "conv7")):
-        for bs in batches:
-            points.append({"BENCH_LAYOUT": layout, "BENCH_STEM": stem,
-                           "BENCH_BATCH": bs})
-    gpt_batches = ["16"] if args.quick else ["8", "16", "32"]
-    gpt_points = [{"BENCH_MODEL": "gpt", "BENCH_BATCH": bs}
-                  for bs in gpt_batches]
-
-    # XLA flag experiments on the best-known config: scoped-VMEM headroom
-    # lets the fusion cost model build larger fusions (public TPU perf
-    # knob); unknown/ineffective flags just reproduce the base number.
-    flag_points = []
-    if not args.quick:
+    # ONE grid definition; --quick runs the subset marked quick=True.
+    # BENCH_FUSED_QKV is explicit in gpt configs so a compute-path change
+    # there reads as a NEW config (merge mode won't keep stale records).
+    def grid_points():
+        for layout, stem in (("NHWC", "s2d"), ("NHWC", "conv7"),
+                             ("NCHW", "conv7")):
+            for bs in ("64", "128", "256", "512"):
+                yield ({"BENCH_LAYOUT": layout, "BENCH_STEM": stem,
+                        "BENCH_BATCH": bs}, bs == "128")
+        for bs in ("8", "16", "32"):
+            yield ({"BENCH_MODEL": "gpt", "BENCH_BATCH": bs,
+                    "BENCH_FUSED_QKV": "1"}, bs == "16")
+        # XLA flag experiments on the best-known config: scoped-VMEM
+        # headroom lets the fusion cost model build larger fusions
+        # (public TPU perf knob); ineffective flags reproduce the base
         for kib in ("32768", "65536"):
-            flag_points.append({
-                "BENCH_LAYOUT": "NHWC", "BENCH_STEM": "s2d",
-                "BENCH_BATCH": "128",
-                "LIBTPU_INIT_ARGS":
-                    f"--xla_tpu_scoped_vmem_limit_kib={kib}"})
+            yield ({"BENCH_LAYOUT": "NHWC", "BENCH_STEM": "s2d",
+                    "BENCH_BATCH": "128",
+                    "LIBTPU_INIT_ARGS":
+                        f"--xla_tpu_scoped_vmem_limit_kib={kib}"}, False)
 
-    # the complete current grid, independent of --quick: merge mode keeps
-    # any prior record whose config is still part of THIS grid, so a
-    # --quick run can never drop full-sweep measurements
-    full_grid = []
-    for layout, stem in (("NHWC", "s2d"), ("NHWC", "conv7"),
-                         ("NCHW", "conv7")):
-        for bs in ("64", "128", "256", "512"):
-            full_grid.append({"BENCH_LAYOUT": layout, "BENCH_STEM": stem,
-                              "BENCH_BATCH": bs})
-    full_grid += [{"BENCH_MODEL": "gpt", "BENCH_BATCH": bs}
-                  for bs in ("8", "16", "32")]
-    full_grid += [{"BENCH_LAYOUT": "NHWC", "BENCH_STEM": "s2d",
-                   "BENCH_BATCH": "128",
-                   "LIBTPU_INIT_ARGS":
-                       f"--xla_tpu_scoped_vmem_limit_kib={kib}"}
-                  for kib in ("32768", "65536")]
-
-    todo = points + gpt_points + flag_points
+    full_grid = [pt for pt, _ in grid_points()]
+    todo = [pt for pt, quick in grid_points() if quick or not args.quick]
     results = []
     rev = _git_rev()
     if not args.fresh and os.path.exists(args.out):

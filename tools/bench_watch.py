@@ -97,12 +97,13 @@ def run_bandwidth(timeout=1200):
     except subprocess.TimeoutExpired:
         log("bandwidth: timed out")
         return False
-    if not os.path.exists(tmp):
-        log(f"bandwidth: no JSON written (rc={r.returncode}): "
+    try:
+        with open(tmp) as f:
+            payload = json.loads(f.readlines()[-1])
+    except (OSError, IndexError, ValueError) as e:
+        log(f"bandwidth: no/partial JSON (rc={r.returncode}, {e}): "
             f"{(r.stderr or '')[-300:]}")
         return False
-    with open(tmp) as f:
-        payload = json.loads(f.readlines()[-1])
     os.unlink(tmp)
     if payload.get("platform") != "tpu":
         log("bandwidth: not a TPU measurement, discarding")
@@ -164,6 +165,10 @@ def run_tpu_consistency(timeout=2400):
 
 def main():
     forever = "--forever" in sys.argv
+    # hard deadline: the loop must be gone before the round driver runs
+    # its own bench.py against the same (single-client) chip
+    deadline = time.time() + 3600 * float(
+        os.environ.get("BENCH_WATCH_HOURS", "9"))
     done = {"resnet": False, "gpt": False, "bandwidth": False,
             "consistency": False, "sweep": False}
     fails = {k: 0 for k in done}
@@ -186,6 +191,13 @@ def main():
         return False
 
     while True:
+        # the deadline clamps every stage's subprocess timeout too: a
+        # stage may not START before the deadline and then hold the chip
+        # past it (the driver's own bench.py needs the single-client TPU)
+        left = deadline - time.time()
+        if left < 120:
+            log("deadline reached; exiting to free the chip")
+            return 0
         if not probe():
             log("TPU unreachable; retrying in 60s")
             time.sleep(60)
@@ -193,21 +205,27 @@ def main():
         log("TPU reachable")
         if not done["resnet"]:
             done["resnet"] = attempt("resnet", lambda: run_bench(
-                {}, os.path.join(REPO, "BENCH_TPU_LATEST.json"), "resnet"))
+                {}, os.path.join(REPO, "BENCH_TPU_LATEST.json"), "resnet",
+                timeout=min(1500, left)))
             continue  # re-probe between stages: the tunnel may drop anytime
         if not done["gpt"]:
             done["gpt"] = attempt("gpt", lambda: run_bench(
                 {"BENCH_MODEL": "gpt"},
-                os.path.join(REPO, "BENCH_GPT_LATEST.json"), "gpt"))
+                os.path.join(REPO, "BENCH_GPT_LATEST.json"), "gpt",
+                timeout=min(1500, left)))
             continue
         if not done["bandwidth"]:
-            done["bandwidth"] = attempt("bandwidth", run_bandwidth)
+            done["bandwidth"] = attempt(
+                "bandwidth", lambda: run_bandwidth(timeout=min(1200, left)))
             continue
         if not done["consistency"]:
-            done["consistency"] = attempt("consistency", run_tpu_consistency)
+            done["consistency"] = attempt(
+                "consistency",
+                lambda: run_tpu_consistency(timeout=min(2400, left)))
             continue
         if not done["sweep"]:
-            done["sweep"] = attempt("sweep", run_sweep)
+            done["sweep"] = attempt(
+                "sweep", lambda: run_sweep(timeout=min(7200, left)))
             continue
         if not forever:
             log("all artifacts captured; exiting")
