@@ -59,3 +59,22 @@ def test_resnet_bench_contract():
 def test_gpt_bench_contract():
     rec = _run_bench({"BENCH_MODEL": "gpt"})
     _check_contract(rec, "gpt_train_throughput", "tokens/sec/chip")
+
+
+@pytest.mark.slow
+def test_cifar_bench_contract():
+    rec = _run_bench({"BENCH_MODEL": "cifar"})
+    _check_contract(rec, "cifar_inception_bn_small_train_throughput",
+                    "images/sec/chip")
+
+
+@pytest.mark.slow
+def test_xla_cost_analysis_cross_check():
+    """XLA's own cost model and the analytic FLOP counter must agree to
+    ~15% on the resnet step (guards count_flops against drift)."""
+    rec = _run_bench({})
+    # CPU cost_analysis is always available: absence of the fields means
+    # the lowering plumbing drifted (exactly what this gate exists for)
+    assert "xla_step_gflops" in rec, rec
+    ratio = rec["xla_step_gflops"] / rec["analytic_step_gflops"]
+    assert 0.85 < ratio < 1.3, rec

@@ -81,6 +81,9 @@ def main():
         for bs in ("8", "16", "32"):
             yield ({"BENCH_MODEL": "gpt", "BENCH_BATCH": bs,
                     "BENCH_FUSED_QKV": "1"}, bs == "16")
+        for bs in ("256", "512", "1024"):
+            yield ({"BENCH_MODEL": "cifar", "BENCH_BATCH": bs},
+                   bs == "512")
         # XLA flag experiments on the best-known config: scoped-VMEM
         # headroom lets the fusion cost model build larger fusions
         # (public TPU perf knob); ineffective flags reproduce the base
@@ -135,19 +138,21 @@ def main():
         with open(args.out, "w") as f:
             json.dump({"results": results, "partial": True}, f, indent=1)
 
-    resnet = [r for r in results
-              if r.get("metric") == "resnet50_train_throughput"]
-    gpt = [r for r in results if r.get("metric") == "gpt_train_throughput"]
-    best = max(resnet, key=lambda r: r.get("value", 0), default=None)
-    best_gpt = max(gpt, key=lambda r: r.get("value", 0), default=None)
-    out = {"results": results, "best_resnet50": best, "best_gpt": best_gpt}
+    def best_of(metric):
+        cands = [r for r in results if r.get("metric") == metric]
+        return max(cands, key=lambda r: r.get("value", 0), default=None)
+
+    out = {"results": results,
+           "best_resnet50": best_of("resnet50_train_throughput"),
+           "best_gpt": best_of("gpt_train_throughput"),
+           "best_cifar": best_of(
+               "cifar_inception_bn_small_train_throughput")}
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     print(f"wrote {args.out}")
-    if best:
-        print("best resnet50:", json.dumps(best))
-    if best_gpt:
-        print("best gpt:", json.dumps(best_gpt))
+    for key in ("best_resnet50", "best_gpt", "best_cifar"):
+        if out[key]:
+            print(f"{key}:", json.dumps(out[key]))
 
 
 if __name__ == "__main__":

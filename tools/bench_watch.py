@@ -7,14 +7,18 @@ artifact set in priority order:
 
   1. bench.py (ResNet-50 throughput)        -> BENCH_TPU_LATEST.json
   2. bench.py BENCH_MODEL=gpt               -> BENCH_GPT_LATEST.json
-  3. tools/bandwidth/measure.py --json      -> BANDWIDTH.json
-  4. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
+  3. bench.py BENCH_MODEL=cifar             -> BENCH_CIFAR_LATEST.json
+  4. tools/bandwidth/measure.py             -> BANDWIDTH.json
+  5. tests/test_tpu_consistency.py          -> TPU_CONSISTENCY.json
+  6. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
 
 Each successful TPU-platform result is also appended to
 BENCH_ATTEMPTS.jsonl with a timestamp so nothing is lost if a later
-stage hangs.  Run it in the background; it exits once all four
-artifacts have been captured on real TPU (or runs forever with
---forever, re-measuring).
+stage hangs.  Run it in the background; it exits once every stage has
+been captured on real TPU (or a stage fails MAX_FAILS times), and
+unconditionally at the BENCH_WATCH_HOURS deadline (default 9h) so it
+can never contend with the round driver's own bench run.  --forever
+re-measures on a 10-minute cycle instead of exiting.
 """
 
 import json
@@ -169,8 +173,8 @@ def main():
     # its own bench.py against the same (single-client) chip
     deadline = time.time() + 3600 * float(
         os.environ.get("BENCH_WATCH_HOURS", "9"))
-    done = {"resnet": False, "gpt": False, "bandwidth": False,
-            "consistency": False, "sweep": False}
+    done = {"resnet": False, "gpt": False, "cifar": False,
+            "bandwidth": False, "consistency": False, "sweep": False}
     fails = {k: 0 for k in done}
     MAX_FAILS = 6  # give up on a stage that fails repeatedly WITH the
     #               probe passing (a code bug, not a tunnel flake)
@@ -203,6 +207,11 @@ def main():
             time.sleep(60)
             continue
         log("TPU reachable")
+        # probe() itself can block up to 150s; recompute the remaining
+        # budget so a stage never starts with a stale (too-large) timeout
+        left = deadline - time.time()
+        if left < 120:
+            continue
         if not done["resnet"]:
             done["resnet"] = attempt("resnet", lambda: run_bench(
                 {}, os.path.join(REPO, "BENCH_TPU_LATEST.json"), "resnet",
@@ -212,6 +221,12 @@ def main():
             done["gpt"] = attempt("gpt", lambda: run_bench(
                 {"BENCH_MODEL": "gpt"},
                 os.path.join(REPO, "BENCH_GPT_LATEST.json"), "gpt",
+                timeout=min(1500, left)))
+            continue
+        if not done["cifar"]:
+            done["cifar"] = attempt("cifar", lambda: run_bench(
+                {"BENCH_MODEL": "cifar"},
+                os.path.join(REPO, "BENCH_CIFAR_LATEST.json"), "cifar",
                 timeout=min(1500, left)))
             continue
         if not done["bandwidth"]:
