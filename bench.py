@@ -73,7 +73,35 @@ def _run_with_watchdog():
     return 1
 
 
+def _adopt_sweep_winner():
+    """Default unset BENCH_* / LIBTPU knobs to the sweep's measured
+    best config (tools/bench_sweep.py promises "the driver's bench.py
+    defaults should match the winner" — this automates it).  Explicit
+    env vars always win; numbers are never reused, only knobs.  Must
+    run before jax import: LIBTPU_INIT_ARGS is read at backend init."""
+    model = os.environ.get("BENCH_MODEL", "resnet50")
+    key = {"resnet50": "best_resnet50", "gpt": "best_gpt",
+           "cifar": "best_cifar"}.get(model)
+    path = os.environ.get(
+        "BENCH_SWEEP_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_SWEEP.json"))
+    try:
+        with open(path) as f:
+            best = json.load(f).get(key)
+    except (OSError, ValueError):
+        return
+    if not best or best.get("platform") != "tpu":
+        return
+    for k, v in (best.get("config") or {}).items():
+        if k != "BENCH_MODEL":
+            os.environ.setdefault(k, v)
+
+
 def main():
+    if not os.environ.get("BENCH_FORCE_CPU"):
+        _adopt_sweep_winner()
+
     import jax
 
     if os.environ.get("BENCH_FORCE_CPU"):
@@ -213,14 +241,17 @@ def _mfu_fields(net, unit_input_shapes, batch, n_iter, dt, n_chips,
         "0" if jax.default_backend() == "tpu" else "1") == "1"
     if trainer is not None and placed is not None and want_costcheck:
         import numpy as _np
-        compiled = trainer._train_step.lower(
-            trainer.params, trainer.opt_state, trainer.aux, placed,
-            trainer._key, _np.float32(1.0)).compile()
         try:
+            compiled = trainer._train_step.lower(
+                trainer.params, trainer.opt_state, trainer.aux, placed,
+                trainer._key, _np.float32(1.0)).compile()
             ca = compiled.cost_analysis()
             ca = ca[0] if isinstance(ca, (list, tuple)) else ca
             xla_flops = float(ca.get("flops", 0.0))
-        except Exception:  # cost model availability varies by backend
+        except Exception:
+            # never crash a completed measurement over the cross-check;
+            # the CPU contract test still fails loudly on drift because
+            # the fields end up absent (test asserts their presence)
             xla_flops = 0.0
         if xla_flops > 0:
             # cost_analysis reports the per-device SPMD program, so

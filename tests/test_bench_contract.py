@@ -78,3 +78,38 @@ def test_xla_cost_analysis_cross_check():
     assert "xla_step_gflops" in rec, rec
     ratio = rec["xla_step_gflops"] / rec["analytic_step_gflops"]
     assert 0.85 < ratio < 1.3, rec
+
+
+def test_adopt_sweep_winner(tmp_path, monkeypatch):
+    """bench.py defaults to the sweep's measured best config; explicit
+    env always wins; CPU-fallback records are never adopted."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    import bench
+
+    # full isolation: the function writes os.environ via setdefault,
+    # which monkeypatch's per-key records would NOT restore — swap the
+    # whole mapping for a plain dict copy instead (auto-restored)
+    env = dict(os.environ)
+    monkeypatch.setattr(os, "environ", env)
+    for k in ("BENCH_BATCH", "BENCH_LAYOUT", "BENCH_STEM"):
+        env.pop(k, None)
+
+    sweep = tmp_path / "sweep.json"
+    sweep.write_text(json.dumps({"best_resnet50": {
+        "platform": "tpu",
+        "config": {"BENCH_BATCH": "64", "BENCH_LAYOUT": "NHWC",
+                   "BENCH_STEM": "s2d"}}}))
+    env["BENCH_SWEEP_PATH"] = str(sweep)
+    bench._adopt_sweep_winner()
+    assert env["BENCH_BATCH"] == "64"
+
+    env["BENCH_BATCH"] = "512"
+    bench._adopt_sweep_winner()
+    assert env["BENCH_BATCH"] == "512"  # explicit wins
+
+    sweep.write_text(json.dumps({"best_resnet50": {
+        "platform": "cpu", "config": {"BENCH_BATCH": "8"}}}))
+    env.pop("BENCH_BATCH")
+    bench._adopt_sweep_winner()
+    assert "BENCH_BATCH" not in env  # cpu record ignored

@@ -32,6 +32,11 @@ def run_point(env_overrides, timeout=2400):
     env = dict(os.environ)
     env.update(env_overrides)
     env["BENCH_CHILD"] = "1"
+    # grid points must run EXACTLY their own config: block bench.py's
+    # adopt-the-last-winner defaulting, which would otherwise leak a
+    # prior winner's flags (e.g. LIBTPU_INIT_ARGS) into base points and
+    # corrupt the flag-vs-base comparison
+    env["BENCH_SWEEP_PATH"] = os.devnull
     try:
         r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
                            capture_output=True, text=True, timeout=timeout,
