@@ -28,6 +28,14 @@ def add_fit_args(parser):
     parser.add_argument("--log-interval", type=int, default=50)
     parser.add_argument("--gpus", default=None,
                         help="device indices, e.g. 0,1 (default: all)")
+    parser.add_argument("--optimizer", default="sgd",
+                        help="sgd / lars / lamb / adam / adamw / ... "
+                             "(lars+cosine is the TPU-pod large-batch "
+                             "recipe)")
+    parser.add_argument("--lr-scheduler", default="factor",
+                        choices=["factor", "cosine", "poly"])
+    parser.add_argument("--warmup-epochs", type=float, default=0.0,
+                        help="linear lr warmup (cosine/poly schedulers)")
     return parser
 
 
@@ -52,9 +60,17 @@ def fit(args, net, train_iter, val_iter=None, eval_metric="acc"):
                       "begin_epoch": args.load_epoch}
 
     lr_scheduler = None
-    if args.lr_factor < 1.0:
-        epoch_size = max(getattr(train_iter, "num_data", 50000)
-                         // args.batch_size, 1)
+    epoch_size = max(getattr(train_iter, "num_data", 50000)
+                     // args.batch_size, 1)
+    sched_name = getattr(args, "lr_scheduler", "factor")
+    if sched_name in ("cosine", "poly"):
+        cls = (mx.lr_scheduler.CosineScheduler if sched_name == "cosine"
+               else mx.lr_scheduler.PolyScheduler)
+        lr_scheduler = cls(
+            max_update=epoch_size * args.num_epochs,
+            warmup_steps=int(epoch_size
+                             * getattr(args, "warmup_epochs", 0.0)))
+    elif args.lr_factor < 1.0:
         lr_scheduler = mx.lr_scheduler.FactorScheduler(
             step=max(int(epoch_size * args.lr_factor_epoch), 1),
             factor=args.lr_factor)
@@ -62,9 +78,17 @@ def fit(args, net, train_iter, val_iter=None, eval_metric="acc"):
     checkpoint = (mx.callback.do_checkpoint(args.model_prefix)
                   if args.model_prefix else None)
 
+    opt_name = getattr(args, "optimizer", "sgd")
+    opt_kwargs = ({"momentum": 0.9} if opt_name
+                  in ("sgd", "ccsgd", "nag", "lars") else {})
+    if args.load_epoch is not None:
+        # seed the update count so cosine/poly schedules resume from
+        # the checkpoint's position instead of replaying the warmup
+        opt_kwargs["begin_num_update"] = args.load_epoch * epoch_size
     model = mx.FeedForward(
         net, ctx=contexts(args), num_epoch=args.num_epochs,
-        learning_rate=args.lr, momentum=0.9, wd=1e-4,
+        optimizer=opt_name,
+        learning_rate=args.lr, wd=1e-4, **opt_kwargs,
         initializer=mx.initializer.Xavier(factor_type="in", magnitude=2.34),
         lr_scheduler=lr_scheduler, **model_args)
     model.fit(X=train_iter, eval_data=val_iter, eval_metric=eval_metric,

@@ -3,8 +3,10 @@
 from __future__ import annotations
 
 import logging
+import math
 
-__all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler"]
+__all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
+           "PolyScheduler", "CosineScheduler"]
 
 
 class LRScheduler:
@@ -62,3 +64,57 @@ class MultiFactorScheduler(LRScheduler):
             self.cur_step_ind += 1
             logging.info("Update[%d]: change lr to %.5e", num_update, self.base_lr)
         return self.base_lr
+
+
+class PolyScheduler(LRScheduler):
+    """Polynomial decay from base_lr to ``final_lr`` over
+    ``max_update`` steps (beyond the 2016 reference; the classic
+    ImageNet alternative to step decay), with optional linear warmup."""
+
+    def __init__(self, max_update, power=2.0, final_lr=0.0,
+                 warmup_steps=0, warmup_begin_lr=0.0):
+        super().__init__()
+        if max_update < 1:
+            raise ValueError("max_update must be at least 1")
+        self.max_update = max_update
+        self.power = power
+        self.final_lr = final_lr
+        self.warmup_steps = warmup_steps
+        self.warmup_begin_lr = warmup_begin_lr
+
+    def _warmup(self, num_update):
+        return (self.warmup_begin_lr
+                + (self.base_lr - self.warmup_begin_lr)
+                * num_update / self.warmup_steps)
+
+    def _progress(self, num_update):
+        """Post-warmup decay fraction in [0, 1] (clamped past max)."""
+        return min(
+            (num_update - self.warmup_steps)
+            / max(self.max_update - self.warmup_steps, 1), 1.0)
+
+    def __call__(self, num_update):
+        if num_update < self.warmup_steps:
+            return self._warmup(num_update)
+        frac = self._progress(num_update)
+        return (self.final_lr + (self.base_lr - self.final_lr)
+                * (1.0 - frac) ** self.power)
+
+
+class CosineScheduler(PolyScheduler):
+    """Cosine decay from base_lr to ``final_lr`` over ``max_update``
+    steps with optional linear warmup (beyond the 2016 reference; the
+    standard TPU-era large-batch schedule, paired with LARS/LAMB)."""
+
+    def __init__(self, max_update, final_lr=0.0, warmup_steps=0,
+                 warmup_begin_lr=0.0):
+        super().__init__(max_update, final_lr=final_lr,
+                         warmup_steps=warmup_steps,
+                         warmup_begin_lr=warmup_begin_lr)
+
+    def __call__(self, num_update):
+        if num_update < self.warmup_steps:
+            return self._warmup(num_update)
+        frac = self._progress(num_update)
+        return (self.final_lr + (self.base_lr - self.final_lr)
+                * 0.5 * (1.0 + math.cos(math.pi * frac)))

@@ -437,3 +437,98 @@ class AdamW(Adam):
     def _wd_arg(self, index, lr):
         # decoupled decay: the kernel's wd term is lr-scaled
         return lr * self._get_wd(index)
+
+
+@register("lars")
+class LARS(SGD):
+    """Layer-wise Adaptive Rate Scaling (You et al. 2017; beyond the
+    2016 reference — the standard large-batch ResNet optimizer on TPU
+    pods).  SGD+momentum whose per-layer lr is scaled by the trust
+    ratio ``eta * ||w|| / (||g|| + wd * ||w||)``.  The adaptation is
+    applied only to matrix/conv weights (ndim > 1); biases and norm
+    scales update as plain SGD — the standard exclusion that keeps
+    BatchNorm/bias updates from being crushed by their tiny norms."""
+
+    def __init__(self, trust_coefficient=0.001, epsilon=1e-9, **kwargs):
+        self.trust_coefficient = trust_coefficient
+        self.epsilon = epsilon
+        super().__init__(**kwargs)
+
+    def _build_steps(self):
+        super()._build_steps()
+        eta, eps = self.trust_coefficient, self.epsilon
+
+        def step(w, g, m, lr, wd):
+            g = self._preprocess(g)
+            wf = w.astype(jnp.float32)
+            gf = g.astype(jnp.float32)
+            w_norm = jnp.sqrt(jnp.sum(jnp.square(wf)))
+            g_norm = jnp.sqrt(jnp.sum(jnp.square(gf)))
+            ratio = jnp.where(
+                (w_norm > 0) & (g_norm > 0),
+                eta * w_norm / (g_norm + wd * w_norm + eps), 1.0)
+            gf = gf + wd * wf
+            m_new = self.momentum * m + lr * ratio * gf
+            return (wf - m_new).astype(w.dtype), m_new.astype(m.dtype)
+
+        self._step_lars = jax.jit(step, donate_argnums=_donate(0, 2))
+
+    def create_state(self, index, weight):
+        # momentum buffer always exists (the trust-ratio step needs it)
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        if len(weight.shape) <= 1:
+            # bias/gamma/beta: plain SGD(+momentum) path
+            return super().update(index, weight, grad, state)
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        w, m = self._step_lars(weight._data, grad._data, state._data,
+                               jnp.float32(lr), jnp.float32(wd))
+        weight._set(w)
+        state._set(m)
+
+
+@register("lamb")
+class LAMB(Adam):
+    """Layer-wise Adaptive Moments (You et al. 2019; beyond the 2016
+    reference — the large-batch BERT/transformer optimizer).  Adam
+    moments; the final update direction ``r = m̂/(sqrt(v̂)+eps) + wd*w``
+    is rescaled per layer by ``||w|| / ||r||`` (matrix weights only)."""
+
+    def _build_steps(self):
+        def step(w, g, mv, coefs, wd):
+            m, v = mv
+            lr, coef1, coef2 = coefs
+            g = self._preprocess(g)
+            m_new = self.beta1 * m + (1 - self.beta1) * g
+            v_new = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+            m_hat = m_new / coef1
+            v_hat = v_new / coef2
+            wf = w.astype(jnp.float32)
+            r = m_hat / (jnp.sqrt(v_hat) + self.epsilon) + wd * wf
+            w_norm = jnp.sqrt(jnp.sum(jnp.square(wf)))
+            r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+            ratio = jnp.where((w_norm > 0) & (r_norm > 0),
+                              w_norm / r_norm, 1.0)
+            if w.ndim <= 1:
+                ratio = 1.0  # bias/norm params: no layer adaptation
+            w_new = wf - lr * ratio * r
+            return w_new.astype(w.dtype), (m_new.astype(m.dtype),
+                                           v_new.astype(v.dtype))
+
+        self._step_lamb = jax.jit(step, donate_argnums=_donate(0, 2))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        m, v = state
+        coefs = (jnp.float32(lr), jnp.float32(1.0 - self.beta1**t),
+                 jnp.float32(1.0 - self.beta2**t))
+        w, (m_new, v_new) = self._step_lamb(
+            weight._data, grad._data, (m._data, v._data), coefs,
+            jnp.float32(self._get_wd(index)))
+        weight._set(w)
+        m._set(m_new)
+        v._set(v_new)

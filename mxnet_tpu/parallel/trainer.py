@@ -163,7 +163,88 @@ def adamw_opt(learning_rate=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
                     clip_by_global_norm=clip_by_global_norm)
 
 
-_OPTS = {"sgd": sgd_opt, "adam": adam_opt, "adamw": adamw_opt}
+def lars_opt(learning_rate=0.01, momentum=0.9, weight_decay=0.0,
+             trust_coefficient=0.001, eps=1e-9,
+             clip_gradient=None, clip_by_global_norm=None):
+    """Functional LARS (You et al. 2017) — SGD+momentum with a
+    per-layer trust ratio ``eta*||w||/(||g||+wd*||w||)``, the standard
+    large-batch ResNet optimizer on TPU pods.  Bias/norm params
+    (ndim <= 1) update as plain SGD (standard exclusion)."""
+
+    def init(params):
+        return {k: jnp.zeros_like(v, dtype=jnp.float32)
+                for k, v in params.items()}
+
+    def update(grads, state, params, lr_scale=1.0):
+        grads = _clip_grads(grads, clip_gradient, clip_by_global_norm)
+        lr = learning_rate * lr_scale
+        new_params, new_state = {}, {}
+        for k, p in params.items():
+            pf = p.astype(jnp.float32)
+            g = grads[k].astype(jnp.float32)
+            if p.ndim > 1:
+                w_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+                g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+                ratio = jnp.where(
+                    (w_norm > 0) & (g_norm > 0),
+                    trust_coefficient * w_norm
+                    / (g_norm + weight_decay * w_norm + eps), 1.0)
+            else:
+                ratio = 1.0
+            g = g + weight_decay * pf
+            m = momentum * state[k] + lr * ratio * g
+            new_state[k] = m
+            new_params[k] = (pf - m).astype(p.dtype)
+        return new_params, new_state
+
+    return init, update
+
+
+def lamb_opt(learning_rate=1e-3, beta1=0.9, beta2=0.999, eps=1e-6,
+             weight_decay=0.0, clip_gradient=None,
+             clip_by_global_norm=None):
+    """Functional LAMB (You et al. 2019) — Adam moments with a
+    per-layer ``||w||/||r||`` rescale of the update direction, the
+    large-batch BERT/transformer optimizer.  Bias/norm params skip the
+    adaptation."""
+
+    def init(params):
+        z = {k: jnp.zeros_like(v, dtype=jnp.float32)
+             for k, v in params.items()}
+        return {"m": z, "v": {k: jnp.zeros_like(val, dtype=jnp.float32)
+                              for k, val in params.items()},
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update(grads, state, params, lr_scale=1.0):
+        grads = _clip_grads(grads, clip_gradient, clip_by_global_norm)
+        t = state["t"] + 1
+        tf = t.astype(jnp.float32)
+        lr = learning_rate * lr_scale
+        new_params, new_m, new_v = {}, {}, {}
+        for k, p in params.items():
+            pf = p.astype(jnp.float32)
+            g = grads[k].astype(jnp.float32)
+            m = beta1 * state["m"][k] + (1 - beta1) * g
+            v = beta2 * state["v"][k] + (1 - beta2) * jnp.square(g)
+            new_m[k], new_v[k] = m, v
+            m_hat = m / (1 - beta1**tf)
+            v_hat = v / (1 - beta2**tf)
+            r = m_hat / (jnp.sqrt(v_hat) + eps) + weight_decay * pf
+            if p.ndim > 1:
+                w_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+                r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+                ratio = jnp.where((w_norm > 0) & (r_norm > 0),
+                                  w_norm / r_norm, 1.0)
+            else:
+                ratio = 1.0
+            new_params[k] = (pf - lr * ratio * r).astype(p.dtype)
+        return new_params, {"m": new_m, "v": new_v, "t": t}
+
+    return init, update
+
+
+_OPTS = {"sgd": sgd_opt, "adam": adam_opt, "adamw": adamw_opt,
+         "lars": lars_opt, "lamb": lamb_opt}
 
 
 class ShardedTrainer:

@@ -153,3 +153,97 @@ def test_adamw_trains_module():
             optimizer_params={"learning_rate": 0.05, "wd": 0.01})
     acc = dict(mod.score(mx.io.NDArrayIter(X, y, 32), "acc"))["accuracy"]
     assert acc > 0.9
+
+
+def test_lars_trust_ratio_math():
+    """Matrix weights scale by eta*||w||/(||g||+wd*||w||); bias params
+    take the plain SGD step."""
+    opt = mx.optimizer.create("lars", learning_rate=1.0, momentum=0.0,
+                              wd=0.0, trust_coefficient=0.01)
+    w = mx.nd.array(np.full((2, 2), 3.0, np.float32))   # ||w|| = 6
+    g = mx.nd.array(np.full((2, 2), 1.0, np.float32))   # ||g|| = 2
+    st = opt.create_state(0, w)
+    opt.update(0, w, g, st)
+    # ratio = 0.01 * 6/2 = 0.03 -> step = lr * ratio * g = 0.03
+    np.testing.assert_allclose(w.asnumpy(), 3.0 - 0.03, rtol=1e-5)
+
+    b = mx.nd.array(np.full(4, 3.0, np.float32))
+    gb = mx.nd.array(np.full(4, 1.0, np.float32))
+    stb = opt.create_state(1, b)
+    opt.update(1, b, gb, stb)
+    np.testing.assert_allclose(b.asnumpy(), 2.0, rtol=1e-5)  # plain step
+
+
+def test_lamb_bias_skips_adaptation():
+    opt = mx.optimizer.create("lamb", learning_rate=0.1)
+    b = mx.nd.array(np.full(3, 1.0, np.float32))
+    gb = mx.nd.array(np.full(3, 0.5, np.float32))
+    st = opt.create_state(0, b)
+    opt.update(0, b, gb, st)
+    # first adam step with bias correction moves by ~lr regardless of g scale
+    np.testing.assert_allclose(b.asnumpy(), 1.0 - 0.1, rtol=1e-3)
+
+
+def test_lars_lamb_train_module():
+    X = np.random.RandomState(0).randn(128, 10).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    for name, params in (("lars", {"learning_rate": 2.0, "momentum": 0.9,
+                                   "trust_coefficient": 0.1}),
+                         ("lamb", {"learning_rate": 0.1})):
+        net = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                  name="fc"), name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu())
+        # Xavier init: LARS/LAMB step sizes are proportional to ||w||,
+        # so the default Uniform(0.01) init would crawl
+        mod.fit(mx.io.NDArrayIter(X, y, 32), num_epoch=10, optimizer=name,
+                optimizer_params=params,
+                initializer=mx.initializer.Xavier())
+        acc = dict(mod.score(mx.io.NDArrayIter(X, y, 32), "acc"))["accuracy"]
+        assert acc > 0.9, (name, acc)
+
+
+def test_lars_lamb_sharded_trainer():
+    rng = np.random.RandomState(1)
+    X = rng.randn(64, 8).astype(np.float32)
+    y = (X[:, 1] > 0).astype(np.float32)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fc"), name="softmax")
+    for name, params in (("lars", {"learning_rate": 1.0,
+                                   "trust_coefficient": 0.05}),
+                         ("lamb", {"learning_rate": 0.05})):
+        tr = mx.parallel.ShardedTrainer(
+            net, {"data": (64, 8), "softmax_label": (64,)},
+            mesh=mx.parallel.local_mesh("dp"), optimizer=name,
+            optimizer_params=params,
+            initializer=mx.initializer.Xavier())
+        for _ in range(40):
+            outs = tr.step({"data": X, "softmax_label": y})
+        probs = np.asarray(outs[0])
+        acc = (probs.argmax(1) == y).mean()
+        assert acc > 0.9, (name, acc)
+
+
+def test_cosine_poly_schedulers():
+    s = mx.lr_scheduler.CosineScheduler(100, final_lr=0.1,
+                                        warmup_steps=10)
+    s.base_lr = 1.0
+    assert abs(s(5) - 0.5) < 1e-9          # linear warmup
+    assert abs(s(10) - 1.0) < 1e-9         # peak
+    assert abs(s(55) - (0.1 + 0.9 * 0.5)) < 1e-6   # midpoint
+    assert abs(s(100) - 0.1) < 1e-9        # floor
+    assert abs(s(1000) - 0.1) < 1e-9       # clamped past max_update
+    p = mx.lr_scheduler.PolyScheduler(100, power=2.0)
+    p.base_lr = 1.0
+    assert abs(p(50) - 0.25) < 1e-9
+
+    # end-to-end: scheduler drives the optimizer lr
+    opt = mx.optimizer.create("sgd", learning_rate=1.0,
+                              lr_scheduler=mx.lr_scheduler.CosineScheduler(
+                                  10, final_lr=0.0))
+    w = mx.nd.array(np.ones(2, np.float32))
+    g = mx.nd.array(np.ones(2, np.float32))
+    for _ in range(12):
+        opt.update(0, w, g, None)
+    assert opt._get_lr(0) < 0.05  # decayed near the floor
