@@ -93,12 +93,16 @@ class PolyScheduler(LRScheduler):
             (num_update - self.warmup_steps)
             / max(self.max_update - self.warmup_steps, 1), 1.0)
 
+    def _decay(self, frac):
+        """Decay weight in [0, 1] at post-warmup progress ``frac``;
+        subclasses override this single hook."""
+        return (1.0 - frac) ** self.power
+
     def __call__(self, num_update):
         if num_update < self.warmup_steps:
             return self._warmup(num_update)
-        frac = self._progress(num_update)
         return (self.final_lr + (self.base_lr - self.final_lr)
-                * (1.0 - frac) ** self.power)
+                * self._decay(self._progress(num_update)))
 
 
 class CosineScheduler(PolyScheduler):
@@ -112,9 +116,5 @@ class CosineScheduler(PolyScheduler):
                          warmup_steps=warmup_steps,
                          warmup_begin_lr=warmup_begin_lr)
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self._warmup(num_update)
-        frac = self._progress(num_update)
-        return (self.final_lr + (self.base_lr - self.final_lr)
-                * 0.5 * (1.0 + math.cos(math.pi * frac)))
+    def _decay(self, frac):
+        return 0.5 * (1.0 + math.cos(math.pi * frac))

@@ -9,8 +9,9 @@ artifact set in priority order:
   2. bench.py BENCH_MODEL=gpt               -> BENCH_GPT_LATEST.json
   3. bench.py BENCH_MODEL=cifar             -> BENCH_CIFAR_LATEST.json
   4. tools/bandwidth/measure.py             -> BANDWIDTH.json
-  5. tests/test_tpu_consistency.py          -> TPU_CONSISTENCY.json
-  6. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
+  5. tools/flash_bench.py                   -> FLASH_BENCH.json
+  6. tests/test_tpu_consistency.py          -> TPU_CONSISTENCY.json
+  7. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
 
 Each successful TPU-platform result is also appended to
 BENCH_ATTEMPTS.jsonl with a timestamp so nothing is lost if a later
@@ -87,36 +88,50 @@ def run_bench(env_overrides, out_path, tag, timeout=1500):
     return False
 
 
-def run_bandwidth(timeout=1200):
-    out = os.path.join(REPO, "BANDWIDTH.json")
+def run_json_artifact(tag, cmd_tail, out_name, timeout, validate=None):
+    """Shared shape of the file-emitting artifact stages: run a tool
+    with ``--json <tmpfile>``, parse the last line, require a real-TPU
+    payload (plus any stage-specific ``validate``), then write the
+    artifact and the attempts-log entry."""
+    out = os.path.join(REPO, out_name)
     tmp = out + ".tmp"
     if os.path.exists(tmp):
         os.unlink(tmp)
     try:
-        r = subprocess.run(
-            [sys.executable, os.path.join(REPO, "tools", "bandwidth",
-                                          "measure.py"), "--dtype", "bfloat16",
-             "--json", tmp],
-            capture_output=True, text=True, timeout=timeout)
+        r = subprocess.run([sys.executable] + cmd_tail + ["--json", tmp],
+                           capture_output=True, text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
-        log("bandwidth: timed out")
+        log(f"{tag}: timed out")
         return False
     try:
         with open(tmp) as f:
             payload = json.loads(f.readlines()[-1])
     except (OSError, IndexError, ValueError) as e:
-        log(f"bandwidth: no/partial JSON (rc={r.returncode}, {e}): "
+        log(f"{tag}: no/partial JSON (rc={r.returncode}, {e}): "
             f"{(r.stderr or '')[-300:]}")
         return False
     os.unlink(tmp)
     if payload.get("platform") != "tpu":
-        log("bandwidth: not a TPU measurement, discarding")
+        log(f"{tag}: not a TPU measurement, discarding")
         return False
-    record("bandwidth", payload)
+    if validate is not None:
+        err = validate(payload)
+        if err:
+            log(f"{tag}: invalid payload ({err}), discarding")
+            return False
+    record(tag, payload)
     with open(out, "w") as f:
         f.write(json.dumps(payload, indent=1) + "\n")
-    log("bandwidth: captured")
+    log(f"{tag}: captured")
     return True
+
+
+def run_bandwidth(timeout=1200):
+    return run_json_artifact(
+        "bandwidth",
+        [os.path.join(REPO, "tools", "bandwidth", "measure.py"),
+         "--dtype", "bfloat16"],
+        "BANDWIDTH.json", timeout)
 
 
 def run_sweep(timeout=7200):
@@ -140,6 +155,20 @@ def run_sweep(timeout=7200):
     n_err = len(recs) - n_tpu
     log(f"sweep: rc={r.returncode}, {n_tpu} TPU points, {n_err} errors")
     return r.returncode == 0 and n_tpu > 0 and n_err == 0
+
+
+def run_flash_bench(timeout=1800):
+    """Pallas flash-attention vs dense XLA attention at training shapes
+    (tools/flash_bench.py) — the kernel-quality artifact."""
+
+    def validate(payload):
+        good = [p for p in payload.get("points", [])
+                if p.get("flash_ms") and "flash_error" not in p]
+        return None if good else "no successful flash point"
+
+    return run_json_artifact(
+        "flash", [os.path.join(REPO, "tools", "flash_bench.py")],
+        "FLASH_BENCH.json", timeout, validate=validate)
 
 
 def run_tpu_consistency(timeout=2400):
@@ -174,7 +203,8 @@ def main():
     deadline = time.time() + 3600 * float(
         os.environ.get("BENCH_WATCH_HOURS", "9"))
     done = {"resnet": False, "gpt": False, "cifar": False,
-            "bandwidth": False, "consistency": False, "sweep": False}
+            "bandwidth": False, "flash": False, "consistency": False,
+            "sweep": False}
     fails = {k: 0 for k in done}
     MAX_FAILS = 6  # give up on a stage that fails repeatedly WITH the
     #               probe passing (a code bug, not a tunnel flake)
@@ -232,6 +262,10 @@ def main():
         if not done["bandwidth"]:
             done["bandwidth"] = attempt(
                 "bandwidth", lambda: run_bandwidth(timeout=min(1200, left)))
+            continue
+        if not done["flash"]:
+            done["flash"] = attempt(
+                "flash", lambda: run_flash_bench(timeout=min(1800, left)))
             continue
         if not done["consistency"]:
             done["consistency"] = attempt(

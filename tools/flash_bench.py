@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Flash-attention kernel benchmark: Pallas kernel vs dense XLA attention.
+
+Measures fwd+bwd wall time of the fused Pallas flash-attention kernel
+(ops/flash_attention.py) against the dense XLA formulation at training
+shapes, and reports the speedup + achieved TFLOP/s.  The dense path
+materializes the (S x S) score matrix in HBM; flash streams it through
+VMEM — the gap widens with sequence length until the dense path OOMs
+entirely (the kernel's raison d'etre).
+
+Usage: python tools/flash_bench.py [--seqs 1024,2048,4096] [--json OUT]
+"""
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def bench_one(jax, jnp, S, B, H, D, causal, n_iter=10):
+    import numpy as np
+
+    from mxnet_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    shape = (B, H, S, D)
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    q, k, v = (jnp.asarray(rng.randn(*shape), dt) for _ in range(3))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal)
+                       .astype(jnp.float32))
+
+    def loss_dense(q, k, v):
+        scale = 1.0 / np.sqrt(D)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        if causal:
+            mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+            s = jnp.where(mask, s, jnp.asarray(-jnp.inf, s.dtype))
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, v)
+                       .astype(jnp.float32))
+
+    results = {}
+    for name, fn in (("flash", loss_flash), ("dense", loss_dense)):
+        step = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
+        try:
+            out = step(q, k, v)
+            jax.block_until_ready(out)
+            tic = time.perf_counter()
+            for _ in range(n_iter):
+                out = step(q, k, v)
+            jax.block_until_ready(out)
+            dt_s = (time.perf_counter() - tic) / n_iter
+            results[name] = dt_s
+        except Exception as e:  # dense path OOMs at long S — that's data
+            results[name] = None
+            results[name + "_error"] = type(e).__name__
+    # attention FLOPs: fwd 4*B*H*S^2*D (2 matmuls), bwd ~2.5x fwd;
+    # causal halves the live tiles
+    flops = 4.0 * B * H * S * S * D * 3.5 * (0.5 if causal else 1.0)
+    rec = {"seq_len": S, "batch": B, "heads": H, "head_dim": D,
+           "causal": causal,
+           "flash_ms": None if results["flash"] is None
+           else round(results["flash"] * 1e3, 3),
+           "dense_ms": None if results["dense"] is None
+           else round(results["dense"] * 1e3, 3)}
+    if results["flash"]:
+        rec["flash_tflops"] = round(flops / results["flash"] / 1e12, 2)
+    if results["flash"] and results["dense"]:
+        rec["speedup"] = round(results["dense"] / results["flash"], 2)
+    for k2 in ("flash_error", "dense_error"):
+        if k2 in results:
+            rec[k2] = results[k2]
+    return rec
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seqs", default="1024,2048,4096")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--json", default=None,
+                   help="append results as one JSON line to this file")
+    p.add_argument("--platform", default=None)
+    args = p.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    points = []
+    for S in (int(x) for x in args.seqs.split(",")):
+        for causal in (True, False):
+            rec = bench_one(jax, jnp, S, args.batch, args.heads,
+                            args.head_dim, causal)
+            print(json.dumps(rec))
+            points.append(rec)
+    out = {"platform": jax.default_backend(),
+           "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+           "points": points}
+    if args.json:
+        with open(args.json, "a") as f:
+            f.write(json.dumps(out) + "\n")
+
+
+if __name__ == "__main__":
+    main()
