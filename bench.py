@@ -253,12 +253,21 @@ def _mfu_fields(net, unit_input_shapes, batch, n_iter, dt, n_chips,
             # the CPU contract test still fails loudly on drift because
             # the fields end up absent (test asserts their presence)
             xla_flops = 0.0
+            ca = {}
         if xla_flops > 0:
             # cost_analysis reports the per-device SPMD program, so
             # compare against the per-chip analytic share
             fields["xla_step_gflops"] = round(xla_flops / 1e9, 2)
             fields["analytic_step_gflops"] = round(
                 step_flops / n_chips / 1e9, 2)
+            # bytes accessed -> arithmetic intensity (flops/byte): how
+            # compute- vs HBM-bound XLA thinks the step is (the roofline
+            # coordinate; v5e crossover is ~240 flops/byte at bf16 peak)
+            xla_bytes = float(ca.get("bytes accessed", 0.0))
+            if xla_bytes > 0:
+                fields["xla_step_gbytes"] = round(xla_bytes / 1e9, 2)
+                fields["arith_intensity_flops_per_byte"] = round(
+                    xla_flops / xla_bytes, 1)
     return fields
 
 

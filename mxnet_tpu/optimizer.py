@@ -496,9 +496,11 @@ class LAMB(Adam):
     moments; the final update direction ``r = m̂/(sqrt(v̂)+eps) + wd*w``
     is rescaled per layer by ``||w|| / ||r||`` (matrix weights only)."""
 
-    def __init__(self, epsilon=1e-6, **kwargs):
+    def __init__(self, *, epsilon=1e-6, **kwargs):
         # paper default 1e-6 — also keeps this surface numerically
-        # identical to the functional lamb_opt in parallel/trainer.py
+        # identical to the functional lamb_opt in parallel/trainer.py.
+        # Keyword-only: a positional first arg must not silently land
+        # in epsilon when Adam's first positional is learning_rate.
         super().__init__(epsilon=epsilon, **kwargs)
 
     def _build_steps(self):

@@ -185,6 +185,7 @@ def test_lamb_bias_skips_adaptation():
 
 
 def test_lars_lamb_train_module():
+    mx.random.seed(7)  # init draws from global RNG: pin against ordering
     X = np.random.RandomState(0).randn(128, 10).astype(np.float32)
     y = (X[:, 0] > 0).astype(np.float32)
     for name, params in (("lars", {"learning_rate": 2.0, "momentum": 0.9,
@@ -204,6 +205,7 @@ def test_lars_lamb_train_module():
 
 
 def test_lars_lamb_sharded_trainer():
+    mx.random.seed(7)
     rng = np.random.RandomState(1)
     X = rng.randn(64, 8).astype(np.float32)
     y = (X[:, 1] > 0).astype(np.float32)

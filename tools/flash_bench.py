@@ -12,7 +12,6 @@ Usage: python tools/flash_bench.py [--seqs 1024,2048,4096] [--json OUT]
 """
 
 import argparse
-import functools
 import json
 import os
 import sys
