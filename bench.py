@@ -106,6 +106,22 @@ def main():
 
     if os.environ.get("BENCH_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # persistent XLA compile cache: repeat runs (sweep points, the
+        # watchdog's retry-after-tunnel-flake loop) skip the 20-40 s+
+        # per-program compiles for shapes already seen.  Same standard
+        # env vars bench_watch.py sets — an operator's own value wins.
+        cache_dir = os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                                          "/tmp/mxtpu_compile_cache")
+        os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                              "1")
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              float(os.environ[
+                                  "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+        except Exception:
+            pass  # older jax without the persistent cache: not fatal
 
     import numpy as np
 

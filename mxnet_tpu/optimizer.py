@@ -449,7 +449,9 @@ class LARS(SGD):
     scales update as plain SGD — the standard exclusion that keeps
     BatchNorm/bias updates from being crushed by their tiny norms."""
 
-    def __init__(self, trust_coefficient=0.001, epsilon=1e-9, **kwargs):
+    def __init__(self, *, trust_coefficient=0.001, epsilon=1e-9, **kwargs):
+        # keyword-only: LARS(0.9) must not silently set a 900x trust
+        # coefficient when SGD's first positional is momentum
         self.trust_coefficient = trust_coefficient
         self.epsilon = epsilon
         super().__init__(**kwargs)

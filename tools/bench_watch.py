@@ -31,6 +31,13 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "BENCH_ATTEMPTS.jsonl")
 
+# every child (bench modes, sweep points, flash/bandwidth tools) shares
+# one persistent XLA compile cache, so a tunnel flake mid-stage only
+# costs the measurement, not the recompiles
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/mxtpu_compile_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
 
 def log(msg):
     sys.stderr.write(f"[bench_watch {time.strftime('%H:%M:%S')}] {msg}\n")
