@@ -112,7 +112,7 @@ def main():
         # per-program compiles for shapes already seen.  Same standard
         # env vars bench_watch.py sets — an operator's own value wins.
         cache_dir = os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                                          "/tmp/mxtpu_compile_cache")
+                                          f"/tmp/mxtpu_compile_cache_{os.getuid()}")
         os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
                               "1")
         try:
@@ -284,6 +284,14 @@ def _mfu_fields(net, unit_input_shapes, batch, n_iter, dt, n_chips,
                 fields["xla_step_gbytes"] = round(xla_bytes / 1e9, 2)
                 fields["arith_intensity_flops_per_byte"] = round(
                     xla_flops / xla_bytes, 1)
+            try:
+                ma = compiled.memory_analysis()
+                peak = (ma.temp_size_in_bytes + ma.argument_size_in_bytes
+                        + ma.output_size_in_bytes
+                        - ma.alias_size_in_bytes)
+                fields["xla_peak_hbm_gb"] = round(peak / 1e9, 3)
+            except Exception:
+                pass  # memory_analysis availability varies by backend
     return fields
 
 

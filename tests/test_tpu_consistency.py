@@ -156,7 +156,8 @@ def _run(case, tpu):
                            env=env, cwd=REPO)
     except subprocess.TimeoutExpired as e:
         out = e.stdout or b""
-        out = out.decode() if isinstance(out, bytes) else out
+        out = (out.decode(errors="replace")
+               if isinstance(out, bytes) else out)
         if tpu and "INIT_OK" not in out:
             # a down tunnel HANGS backend init rather than failing fast
             pytest.skip("TPU unreachable (backend init hang)")

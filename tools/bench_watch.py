@@ -35,7 +35,7 @@ LOG = os.path.join(REPO, "BENCH_ATTEMPTS.jsonl")
 # one persistent XLA compile cache, so a tunnel flake mid-stage only
 # costs the measurement, not the recompiles
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      "/tmp/mxtpu_compile_cache")
+                      f"/tmp/mxtpu_compile_cache_{os.getuid()}")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 
