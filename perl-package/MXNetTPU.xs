@@ -1,0 +1,507 @@
+/* XS bindings: the mxtpu flat C ABI (include/mxtpu/c_api.h) exposed to
+ * Perl — the second-scripting-language frontend proof, playing the role
+ * the reference's R-package/src Rcpp layer plays over its C API.
+ *
+ * Design: handles cross as plain IVs (pointer-sized integers); bulk
+ * tensor data crosses as packed byte strings (Perl pack("f*", ...)),
+ * so no per-element marshalling happens here.  Every C failure croaks
+ * with MXTPUGetLastError().
+ */
+
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "mxtpu/c_api.h"
+
+#define MXPL_MAX 256
+
+static void* xs_chk(pTHX_ int rc, const char* what) {
+  if (rc != 0)
+    croak("MXNetTPU: %s failed: %s", what, MXTPUGetLastError());
+  return NULL;
+}
+#define CHK(call) xs_chk(aTHX_ (call), #call)
+
+/* AV of numbers -> uint32 buffer; returns count */
+static int av_to_u32(pTHX_ SV* sv, uint32_t* buf, int cap, const char* what) {
+  AV* av;
+  int n, i;
+  if (!SvROK(sv) || SvTYPE(SvRV(sv)) != SVt_PVAV)
+    croak("MXNetTPU: %s must be an ARRAY ref", what);
+  av = (AV*)SvRV(sv);
+  n = av_len(av) + 1;
+  if (n > cap) croak("MXNetTPU: %s too long (%d > %d)", what, n, cap);
+  for (i = 0; i < n; ++i) {
+    SV** e = av_fetch(av, i, 0);
+    buf[i] = e ? (uint32_t)SvUV(*e) : 0;
+  }
+  return n;
+}
+
+/* AV of handle IVs -> void* buffer (0 -> NULL); returns count */
+static int av_to_handles(pTHX_ SV* sv, void** buf, int cap, const char* what) {
+  AV* av;
+  int n, i;
+  if (!SvROK(sv) || SvTYPE(SvRV(sv)) != SVt_PVAV)
+    croak("MXNetTPU: %s must be an ARRAY ref", what);
+  av = (AV*)SvRV(sv);
+  n = av_len(av) + 1;
+  if (n > cap) croak("MXNetTPU: %s too long (%d > %d)", what, n, cap);
+  for (i = 0; i < n; ++i) {
+    SV** e = av_fetch(av, i, 0);
+    buf[i] = (e && SvIV(*e)) ? INT2PTR(void*, SvIV(*e)) : NULL;
+  }
+  return n;
+}
+
+/* AV of strings -> const char* buffer (pointers borrowed from the SVs);
+ * returns count */
+static int av_to_strs(pTHX_ SV* sv, const char** buf, int cap,
+                      const char* what) {
+  AV* av;
+  int n, i;
+  if (!SvROK(sv) || SvTYPE(SvRV(sv)) != SVt_PVAV)
+    croak("MXNetTPU: %s must be an ARRAY ref", what);
+  av = (AV*)SvRV(sv);
+  n = av_len(av) + 1;
+  if (n > cap) croak("MXNetTPU: %s too long (%d > %d)", what, n, cap);
+  for (i = 0; i < n; ++i) {
+    SV** e = av_fetch(av, i, 0);
+    buf[i] = e ? SvPV_nolen(*e) : "";
+  }
+  return n;
+}
+
+static SV* strs_to_av(pTHX_ int n, const char** names) {
+  AV* av = newAV();
+  int i;
+  for (i = 0; i < n; ++i)
+    av_push(av, newSVpv(names[i], 0));
+  return newRV_noinc((SV*)av);
+}
+
+static SV* shapes_to_av(pTHX_ uint32_t n, const uint32_t* ndim,
+                        const uint32_t** data) {
+  AV* av = newAV();
+  uint32_t i, d;
+  for (i = 0; i < n; ++i) {
+    AV* s = newAV();
+    for (d = 0; d < ndim[i]; ++d)
+      av_push(s, newSVuv(data[i][d]));
+    av_push(av, newRV_noinc((SV*)s));
+  }
+  return newRV_noinc((SV*)av);
+}
+
+MODULE = MXNetTPU  PACKAGE = MXNetTPU  PREFIX = mxpl_
+
+PROTOTYPES: DISABLE
+
+const char*
+mxpl_last_error()
+  CODE:
+    RETVAL = MXTPUGetLastError();
+  OUTPUT:
+    RETVAL
+
+void
+mxpl_random_seed(int seed)
+  CODE:
+    CHK(MXTPURandomSeed(seed));
+
+SV*
+mxpl_list_ops()
+  PREINIT:
+    int n;
+    const char** names;
+  CODE:
+    CHK(MXTPUListOps(&n, &names));
+    RETVAL = strs_to_av(aTHX_ n, names);
+  OUTPUT:
+    RETVAL
+
+# ---- NDArray -------------------------------------------------------------
+
+IV
+mxpl_ndarray_create(SV* shape, int dtype, int dev_type, int dev_id)
+  PREINIT:
+    uint32_t shp[MXTPU_MAX_NDIM];
+    int nd;
+    NDArrayHandle h;
+  CODE:
+    nd = av_to_u32(aTHX_ shape, shp, MXTPU_MAX_NDIM, "shape");
+    CHK(MXTPUNDArrayCreate(shp, (uint32_t)nd, dtype, dev_type, dev_id, &h));
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+void
+mxpl_ndarray_free(IV h)
+  CODE:
+    CHK(MXTPUNDArrayFree(INT2PTR(NDArrayHandle, h)));
+
+void
+mxpl_ndarray_set_bytes(IV h, SV* bytes)
+  PREINIT:
+    STRLEN len;
+    const char* p;
+  CODE:
+    p = SvPV(bytes, len);
+    CHK(MXTPUNDArraySyncCopyFromCPU(INT2PTR(NDArrayHandle, h), p,
+                                    (uint64_t)len));
+
+SV*
+mxpl_ndarray_get_bytes(IV h, UV nbytes)
+  PREINIT:
+    SV* out;
+    char* p;
+  CODE:
+    out = newSV(nbytes + 1);
+    SvPOK_on(out);
+    p = SvPVX(out);
+    CHK(MXTPUNDArraySyncCopyToCPU(INT2PTR(NDArrayHandle, h), p,
+                                  (uint64_t)nbytes));
+    p[nbytes] = '\0';
+    SvCUR_set(out, nbytes);
+    RETVAL = out;
+  OUTPUT:
+    RETVAL
+
+SV*
+mxpl_ndarray_shape(IV h)
+  PREINIT:
+    uint32_t nd, shp[MXTPU_MAX_NDIM];
+    AV* av;
+    uint32_t i;
+  CODE:
+    CHK(MXTPUNDArrayGetShape(INT2PTR(NDArrayHandle, h), &nd, shp));
+    av = newAV();
+    for (i = 0; i < nd; ++i)
+      av_push(av, newSVuv(shp[i]));
+    RETVAL = newRV_noinc((SV*)av);
+  OUTPUT:
+    RETVAL
+
+int
+mxpl_ndarray_dtype(IV h)
+  PREINIT:
+    int dt;
+  CODE:
+    CHK(MXTPUNDArrayGetDType(INT2PTR(NDArrayHandle, h), &dt));
+    RETVAL = dt;
+  OUTPUT:
+    RETVAL
+
+void
+mxpl_ndarray_wait_all()
+  CODE:
+    CHK(MXTPUNDArrayWaitAll());
+
+# ---- Symbol --------------------------------------------------------------
+
+IV
+mxpl_symbol_variable(const char* name)
+  PREINIT:
+    SymbolHandle h;
+  CODE:
+    CHK(MXTPUSymbolCreateVariable(name, &h));
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+IV
+mxpl_symbol_atomic(const char* op, SV* keys, SV* vals)
+  PREINIT:
+    const char *k[MXPL_MAX], *v[MXPL_MAX];
+    int nk, nv;
+    SymbolHandle h;
+  CODE:
+    nk = av_to_strs(aTHX_ keys, k, MXPL_MAX, "keys");
+    nv = av_to_strs(aTHX_ vals, v, MXPL_MAX, "vals");
+    if (nk != nv) croak("MXNetTPU: keys/vals length mismatch");
+    CHK(MXTPUSymbolCreateAtomicSymbol(op, nk, k, v, &h));
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+void
+mxpl_symbol_compose(IV h, const char* name, SV* args)
+  PREINIT:
+    void* in[MXPL_MAX];
+    int n;
+  CODE:
+    n = av_to_handles(aTHX_ args, in, MXPL_MAX, "args");
+    CHK(MXTPUSymbolCompose(INT2PTR(SymbolHandle, h), name, n, NULL,
+                           (SymbolHandle*)in));
+
+SV*
+mxpl_symbol_list_arguments(IV h)
+  PREINIT:
+    int n;
+    const char** names;
+  CODE:
+    CHK(MXTPUSymbolListArguments(INT2PTR(SymbolHandle, h), &n, &names));
+    RETVAL = strs_to_av(aTHX_ n, names);
+  OUTPUT:
+    RETVAL
+
+SV*
+mxpl_symbol_list_outputs(IV h)
+  PREINIT:
+    int n;
+    const char** names;
+  CODE:
+    CHK(MXTPUSymbolListOutputs(INT2PTR(SymbolHandle, h), &n, &names));
+    RETVAL = strs_to_av(aTHX_ n, names);
+  OUTPUT:
+    RETVAL
+
+const char*
+mxpl_symbol_tojson(IV h)
+  PREINIT:
+    const char* js;
+  CODE:
+    CHK(MXTPUSymbolSaveToJSON(INT2PTR(SymbolHandle, h), &js));
+    RETVAL = js;
+  OUTPUT:
+    RETVAL
+
+IV
+mxpl_symbol_fromjson(const char* json)
+  PREINIT:
+    SymbolHandle h;
+  CODE:
+    CHK(MXTPUSymbolCreateFromJSON(json, &h));
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+void
+mxpl_symbol_free(IV h)
+  CODE:
+    CHK(MXTPUSymbolFree(INT2PTR(SymbolHandle, h)));
+
+void
+mxpl_symbol_infer_shape(IV h, SV* keys, SV* shapes)
+  PREINIT:
+    const char* k[MXPL_MAX];
+    uint32_t indptr[MXPL_MAX + 1];
+    uint32_t flat[MXPL_MAX * MXTPU_MAX_NDIM];
+    int nk, i, nflat;
+    AV* shp_av;
+    uint32_t in_size, out_size, aux_size;
+    const uint32_t *in_ndim, *out_ndim, *aux_ndim;
+    const uint32_t **in_data, **out_data, **aux_data;
+    int complete;
+  PPCODE:
+    nk = av_to_strs(aTHX_ keys, k, MXPL_MAX, "keys");
+    if (!SvROK(shapes) || SvTYPE(SvRV(shapes)) != SVt_PVAV)
+      croak("MXNetTPU: shapes must be an ARRAY ref of ARRAY refs");
+    shp_av = (AV*)SvRV(shapes);
+    if (av_len(shp_av) + 1 != nk)
+      croak("MXNetTPU: keys/shapes length mismatch");
+    indptr[0] = 0;
+    nflat = 0;
+    for (i = 0; i < nk; ++i) {
+      SV** e = av_fetch(shp_av, i, 0);
+      if (!e) croak("MXNetTPU: missing shape %d", i);
+      nflat += av_to_u32(aTHX_ *e, flat + nflat, MXTPU_MAX_NDIM,
+                         "shape entry");
+      indptr[i + 1] = (uint32_t)nflat;
+    }
+    CHK(MXTPUSymbolInferShape(INT2PTR(SymbolHandle, h), (uint32_t)nk, k,
+                              indptr, flat, &in_size, &in_ndim, &in_data,
+                              &out_size, &out_ndim, &out_data, &aux_size,
+                              &aux_ndim, &aux_data, &complete));
+    EXTEND(SP, 4);
+    PUSHs(sv_2mortal(shapes_to_av(aTHX_ in_size, in_ndim, in_data)));
+    PUSHs(sv_2mortal(shapes_to_av(aTHX_ out_size, out_ndim, out_data)));
+    PUSHs(sv_2mortal(shapes_to_av(aTHX_ aux_size, aux_ndim, aux_data)));
+    PUSHs(sv_2mortal(newSViv(complete)));
+
+# ---- Executor ------------------------------------------------------------
+
+IV
+mxpl_executor_bind(IV sym, int dev_type, int dev_id, SV* args, SV* grads, SV* reqs, SV* aux)
+  PREINIT:
+    void *a[MXPL_MAX], *g[MXPL_MAX], *x[MXPL_MAX];
+    uint32_t r[MXPL_MAX];
+    int na, ng, nr, nx;
+    ExecutorHandle h;
+  CODE:
+    na = av_to_handles(aTHX_ args, a, MXPL_MAX, "args");
+    ng = av_to_handles(aTHX_ grads, g, MXPL_MAX, "grads");
+    nr = av_to_u32(aTHX_ reqs, r, MXPL_MAX, "reqs");
+    nx = av_to_handles(aTHX_ aux, x, MXPL_MAX, "aux");
+    if (ng != na || nr != na)
+      croak("MXNetTPU: args/grads/reqs length mismatch");
+    CHK(MXTPUExecutorBind(INT2PTR(SymbolHandle, sym), dev_type, dev_id,
+                          (uint32_t)na, (NDArrayHandle*)a,
+                          (NDArrayHandle*)g, r, (uint32_t)nx,
+                          (NDArrayHandle*)x, &h));
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+void
+mxpl_executor_forward(IV h, int is_train)
+  CODE:
+    CHK(MXTPUExecutorForward(INT2PTR(ExecutorHandle, h), is_train));
+
+void
+mxpl_executor_backward(IV h)
+  CODE:
+    CHK(MXTPUExecutorBackward(INT2PTR(ExecutorHandle, h), 0, NULL));
+
+SV*
+mxpl_executor_outputs(IV h)
+  PREINIT:
+    NDArrayHandle outs[MXPL_MAX];
+    int n, i;
+    AV* av;
+  CODE:
+    CHK(MXTPUExecutorOutputs(INT2PTR(ExecutorHandle, h), MXPL_MAX, outs,
+                             &n));
+    av = newAV();
+    for (i = 0; i < n; ++i)
+      av_push(av, newSViv(PTR2IV(outs[i])));
+    RETVAL = newRV_noinc((SV*)av);
+  OUTPUT:
+    RETVAL
+
+void
+mxpl_executor_free(IV h)
+  CODE:
+    CHK(MXTPUExecutorFree(INT2PTR(ExecutorHandle, h)));
+
+# ---- KVStore -------------------------------------------------------------
+
+IV
+mxpl_kv_create(const char* type)
+  PREINIT:
+    KVStoreHandle h;
+  CODE:
+    CHK(MXTPUKVStoreCreate(type, &h));
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+void
+mxpl_kv_init(IV h, SV* keys, SV* vals)
+  PREINIT:
+    uint32_t ku[MXPL_MAX];
+    int k[MXPL_MAX];
+    void* v[MXPL_MAX];
+    int nk, nv, i;
+  CODE:
+    nk = av_to_u32(aTHX_ keys, ku, MXPL_MAX, "keys");
+    nv = av_to_handles(aTHX_ vals, v, MXPL_MAX, "vals");
+    if (nk != nv) croak("MXNetTPU: keys/vals length mismatch");
+    for (i = 0; i < nk; ++i) k[i] = (int)ku[i];
+    CHK(MXTPUKVStoreInit(INT2PTR(KVStoreHandle, h), nk, k,
+                         (NDArrayHandle*)v));
+
+void
+mxpl_kv_push(IV h, SV* keys, SV* vals, int priority)
+  PREINIT:
+    uint32_t ku[MXPL_MAX];
+    int k[MXPL_MAX];
+    void* v[MXPL_MAX];
+    int nk, nv, i;
+  CODE:
+    nk = av_to_u32(aTHX_ keys, ku, MXPL_MAX, "keys");
+    nv = av_to_handles(aTHX_ vals, v, MXPL_MAX, "vals");
+    if (nk != nv) croak("MXNetTPU: keys/vals length mismatch");
+    for (i = 0; i < nk; ++i) k[i] = (int)ku[i];
+    CHK(MXTPUKVStorePush(INT2PTR(KVStoreHandle, h), nk, k,
+                         (NDArrayHandle*)v, priority));
+
+void
+mxpl_kv_pull(IV h, SV* keys, SV* vals, int priority)
+  PREINIT:
+    uint32_t ku[MXPL_MAX];
+    int k[MXPL_MAX];
+    void* v[MXPL_MAX];
+    int nk, nv, i;
+  CODE:
+    nk = av_to_u32(aTHX_ keys, ku, MXPL_MAX, "keys");
+    nv = av_to_handles(aTHX_ vals, v, MXPL_MAX, "vals");
+    if (nk != nv) croak("MXNetTPU: keys/vals length mismatch");
+    for (i = 0; i < nk; ++i) k[i] = (int)ku[i];
+    CHK(MXTPUKVStorePull(INT2PTR(KVStoreHandle, h), nk, k,
+                         (NDArrayHandle*)v, priority));
+
+void
+mxpl_kv_set_optimizer(IV h, const char* name, SV* keys, SV* vals)
+  PREINIT:
+    const char *k[MXPL_MAX], *v[MXPL_MAX];
+    int nk, nv;
+  CODE:
+    nk = av_to_strs(aTHX_ keys, k, MXPL_MAX, "keys");
+    nv = av_to_strs(aTHX_ vals, v, MXPL_MAX, "vals");
+    if (nk != nv) croak("MXNetTPU: keys/vals length mismatch");
+    CHK(MXTPUKVStoreSetOptimizer(INT2PTR(KVStoreHandle, h), name, nk, k, v));
+
+void
+mxpl_kv_free(IV h)
+  CODE:
+    CHK(MXTPUKVStoreFree(INT2PTR(KVStoreHandle, h)));
+
+# ---- DataIter ------------------------------------------------------------
+
+IV
+mxpl_dataiter_create(const char* name, SV* keys, SV* vals)
+  PREINIT:
+    const char *k[MXPL_MAX], *v[MXPL_MAX];
+    int nk, nv;
+    DataIterHandle h;
+  CODE:
+    nk = av_to_strs(aTHX_ keys, k, MXPL_MAX, "keys");
+    nv = av_to_strs(aTHX_ vals, v, MXPL_MAX, "vals");
+    if (nk != nv) croak("MXNetTPU: keys/vals length mismatch");
+    CHK(MXTPUDataIterCreate(name, nk, k, v, &h));
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+int
+mxpl_dataiter_next(IV h)
+  PREINIT:
+    int more;
+  CODE:
+    CHK(MXTPUDataIterNext(INT2PTR(DataIterHandle, h), &more));
+    RETVAL = more;
+  OUTPUT:
+    RETVAL
+
+void
+mxpl_dataiter_before_first(IV h)
+  CODE:
+    CHK(MXTPUDataIterBeforeFirst(INT2PTR(DataIterHandle, h)));
+
+IV
+mxpl_dataiter_data(IV h)
+  PREINIT:
+    NDArrayHandle out;
+  CODE:
+    CHK(MXTPUDataIterGetData(INT2PTR(DataIterHandle, h), &out));
+    RETVAL = PTR2IV(out);
+  OUTPUT:
+    RETVAL
+
+IV
+mxpl_dataiter_label(IV h)
+  PREINIT:
+    NDArrayHandle out;
+  CODE:
+    CHK(MXTPUDataIterGetLabel(INT2PTR(DataIterHandle, h), &out));
+    RETVAL = PTR2IV(out);
+  OUTPUT:
+    RETVAL
+
+void
+mxpl_dataiter_free(IV h)
+  CODE:
+    CHK(MXTPUDataIterFree(INT2PTR(DataIterHandle, h)));

@@ -1,0 +1,109 @@
+#!/usr/bin/perl
+# Train an MLP on MNIST-format idx data, entirely from Perl through the
+# mxtpu C ABI: symbol compose -> infer_shape -> executor bind ->
+# MNISTIter batches -> forward/backward -> KVStore SGD push/pull.
+# The Perl twin of tests/cpp/train_consumer.c.
+#
+# Usage: train_mlp.pl <images.idx> <labels.idx> <batch> <epochs>
+
+use strict;
+use warnings;
+use FindBin;
+use lib "$FindBin::Bin/../lib", "$FindBin::Bin/../blib/lib",
+    "$FindBin::Bin/../blib/arch";
+
+use MXNetTPU;
+
+my ($img, $lab, $batch, $epochs) = @ARGV;
+die "usage: $0 img.idx lab.idx batch epochs\n" unless defined $epochs;
+
+MXNetTPU::seed(7);
+srand(7);
+
+# ---- symbol ----------------------------------------------------------------
+my $data = MXNetTPU::Symbol->variable('data');
+my $net  = MXNetTPU::Symbol->op('Flatten', 'flat', [$data]);
+$net = MXNetTPU::Symbol->op('FullyConnected', 'fc1', [$net],
+                            num_hidden => 64);
+$net = MXNetTPU::Symbol->op('Activation', 'relu1', [$net],
+                            act_type => 'relu');
+$net = MXNetTPU::Symbol->op('FullyConnected', 'fc2', [$net],
+                            num_hidden => 10);
+$net = MXNetTPU::Symbol->op('SoftmaxOutput', 'softmax', [$net],
+                            normalization => 'batch');
+
+# graph JSON round-trip (the checkpoint-format path)
+$net = MXNetTPU::Symbol->from_json($net->to_json);
+
+# ---- bind ------------------------------------------------------------------
+my $exe = $net->simple_bind(data => [$batch, 1, 28, 28],
+                            softmax_label => [$batch]);
+
+# uniform init for the parameters
+for my $name (@{ $exe->param_names }) {
+    my $arr = $exe->arg($name);
+    $arr->set_floats([ map { (rand() * 2 - 1) * 0.07 } 1 .. $arr->size ]);
+}
+
+# ---- kvstore with the runtime's SGD ---------------------------------------
+my $kv = MXNetTPU::KVStore->new('local');
+$kv->set_optimizer('sgd', learning_rate => 0.1, momentum => 0.9,
+                   rescale_grad => 1.0);
+my $pnames = $exe->param_names;
+my @keys = (0 .. $#$pnames);
+$kv->init(\@keys, [ map { $exe->arg($_) } @$pnames ]);
+
+# ---- data ------------------------------------------------------------------
+my $iter = MXNetTPU::DataIter->new(
+    'MNISTIter', image => $img, label => $lab,
+    batch_size => $batch, shuffle => 1, seed => 7);
+
+# ---- training loop ---------------------------------------------------------
+for my $epoch (1 .. $epochs) {
+    my ($hit, $tot) = (0, 0);
+    $iter->reset;
+    while ($iter->next) {
+        $exe->arg('data')->set_floats($iter->data->to_floats);
+        my $labels = $iter->label->to_floats;
+        $exe->arg('softmax_label')->set_floats($labels);
+
+        $exe->forward(is_train => 1);
+        $exe->backward;
+        $kv->push_(\@keys, [ map { $exe->grad($_) } @$pnames ]);
+        $kv->pull(\@keys, [ map { $exe->arg($_) } @$pnames ]);
+
+        my $probs = $exe->outputs->[0]->to_floats;
+        for my $i (0 .. $#$labels) {
+            my ($best, $arg) = (-1e30, 0);
+            for my $c (0 .. 9) {
+                my $p = $probs->[ $i * 10 + $c ];
+                ($best, $arg) = ($p, $c) if $p > $best;
+            }
+            ++$hit if $arg == int($labels->[$i]);
+            ++$tot;
+        }
+    }
+    printf "epoch %d train-accuracy %.4f\n", $epoch, $hit / $tot;
+}
+
+my ($hit, $tot) = (0, 0);
+$iter->reset;
+while ($iter->next) {
+    $exe->arg('data')->set_floats($iter->data->to_floats);
+    my $labels = $iter->label->to_floats;
+    $exe->forward(is_train => 0);
+    my $probs = $exe->outputs->[0]->to_floats;
+    for my $i (0 .. $#$labels) {
+        my ($best, $arg) = (-1e30, 0);
+        for my $c (0 .. 9) {
+            my $p = $probs->[ $i * 10 + $c ];
+            ($best, $arg) = ($p, $c) if $p > $best;
+        }
+        ++$hit if $arg == int($labels->[$i]);
+        ++$tot;
+    }
+}
+my $acc = $hit / $tot;
+printf "final accuracy %.4f\n", $acc;
+die "PERL_TRAIN_FAIL accuracy=$acc\n" if $acc < 0.95;
+print "PERL_TRAIN_OK\n";

@@ -1,0 +1,311 @@
+package MXNetTPU;
+
+# Perl frontend for the mxtpu TPU-native framework, layered purely on
+# the flat C ABI (include/mxtpu/c_api.h) — the role the reference's
+# R-package plays over its C API (reference R-package/src/): a thin
+# object layer over runtime-discovered operators, able to build
+# symbols, bind executors, iterate data, and train through a KVStore
+# optimizer with no Python in the frontend process' source.
+
+use strict;
+use warnings;
+
+our $VERSION = '0.01';
+
+# DynaLoader with RTLD_GLOBAL (0x01): libmxtpu embeds CPython, and the
+# interpreter's own extension modules (math, etc.) resolve Py* symbols
+# from the global scope — a default RTLD_LOCAL load would strand them.
+require DynaLoader;
+our @ISA = ('DynaLoader');
+sub dl_load_flags { 0x01 }
+__PACKAGE__->bootstrap($VERSION);
+
+sub seed { MXNetTPU::random_seed($_[0]) }
+sub list_ops { MXNetTPU::list_ops() }
+
+# ---------------------------------------------------------------------------
+package MXNetTPU::NDArray;
+
+use strict;
+use warnings;
+
+# dtype code 0 = float32 (c_api.h TypeFlag order); dev_type 1 = cpu
+# (meaning "runtime default device" — the runtime places on TPU when
+# one is attached, matching the C consumer's usage)
+sub new {
+    my ($class, $shape, %opt) = @_;
+    my $h = MXNetTPU::ndarray_create($shape, $opt{dtype} // 0,
+                                     $opt{dev_type} // 1,
+                                     $opt{dev_id} // 0);
+    return bless { h => $h, own => 1 }, $class;
+}
+
+sub _wrap {    # adopt an existing handle (executor outputs, iter views)
+    my ($class, $h, $own) = @_;
+    return bless { h => $h, own => $own ? 1 : 0 }, $class;
+}
+
+sub handle { $_[0]{h} }
+
+sub shape { MXNetTPU::ndarray_shape($_[0]{h}) }
+
+sub size {
+    my $n = 1;
+    $n *= $_ for @{ $_[0]->shape };
+    return $n;
+}
+
+sub set_floats {
+    my ($self, @vals) = @_;
+    my $flat = (@vals == 1 && ref $vals[0] eq 'ARRAY') ? $vals[0] : \@vals;
+    MXNetTPU::ndarray_set_bytes($self->{h}, pack('f*', @$flat));
+    return $self;
+}
+
+sub to_floats {
+    my ($self) = @_;
+    my $bytes = MXNetTPU::ndarray_get_bytes($self->{h}, 4 * $self->size);
+    return [ unpack('f*', $bytes) ];
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    MXNetTPU::ndarray_free($self->{h}) if $self->{own} && $self->{h};
+    $self->{h} = 0;
+}
+
+# ---------------------------------------------------------------------------
+package MXNetTPU::Symbol;
+
+use strict;
+use warnings;
+
+sub variable {
+    my ($class, $name) = @_;
+    return bless { h => MXNetTPU::symbol_variable($name) }, 'MXNetTPU::Symbol';
+}
+
+# MXNetTPU::Symbol->op('Convolution', 'conv1', [$data], kernel => '(3, 3)',
+#                      num_filter => '8')
+sub op {
+    my ($class, $opname, $name, $inputs, %params) = @_;
+    my (@k, @v);
+    for my $key (sort keys %params) {
+        push @k, $key;
+        push @v, "$params{$key}";
+    }
+    my $h = MXNetTPU::symbol_atomic($opname, \@k, \@v);
+    MXNetTPU::symbol_compose($h, $name, [ map { $_->{h} } @$inputs ]);
+    return bless { h => $h }, 'MXNetTPU::Symbol';
+}
+
+sub from_json {
+    my ($class, $json) = @_;
+    return bless { h => MXNetTPU::symbol_fromjson($json) }, 'MXNetTPU::Symbol';
+}
+
+sub handle { $_[0]{h} }
+sub to_json { MXNetTPU::symbol_tojson($_[0]{h}) }
+sub list_arguments { MXNetTPU::symbol_list_arguments($_[0]{h}) }
+sub list_outputs { MXNetTPU::symbol_list_outputs($_[0]{h}) }
+
+# ($arg_shapes, $out_shapes, $aux_shapes, $complete)
+sub infer_shape {
+    my ($self, %known) = @_;
+    my (@keys, @shapes);
+    for my $k (sort keys %known) {
+        push @keys, $k;
+        push @shapes, $known{$k};
+    }
+    return MXNetTPU::symbol_infer_shape($self->{h}, \@keys, \@shapes);
+}
+
+sub simple_bind {
+    my ($self, %known) = @_;
+    my ($arg_shapes, undef, undef, $complete) = $self->infer_shape(%known);
+    die "MXNetTPU: shape inference incomplete\n" unless $complete;
+    my $names = $self->list_arguments;
+    my (@args, @grads, @reqs, %arg_of, %grad_of);
+    for my $i (0 .. $#$names) {
+        my $name = $names->[$i];
+        my $arr = MXNetTPU::NDArray->new($arg_shapes->[$i]);
+        push @args, $arr;
+        $arg_of{$name} = $arr;
+        if (exists $known{$name}) {    # data/label inputs: no gradient
+            push @grads, 0;
+            push @reqs, 0;
+        } else {
+            my $g = MXNetTPU::NDArray->new($arg_shapes->[$i]);
+            push @grads, $g;
+            $grad_of{$name} = $g;
+            push @reqs, 1;             # write
+        }
+    }
+    return MXNetTPU::Executor->_bind($self, \@args, \@grads, \@reqs,
+                                     \%arg_of, \%grad_of);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    MXNetTPU::symbol_free($self->{h}) if $self->{h};
+    $self->{h} = 0;
+}
+
+# ---------------------------------------------------------------------------
+package MXNetTPU::Executor;
+
+use strict;
+use warnings;
+
+sub _bind {
+    my ($class, $sym, $args, $grads, $reqs, $arg_of, $grad_of) = @_;
+    my $h = MXNetTPU::executor_bind(
+        $sym->{h}, 1, 0,
+        [ map { $_->{h} } @$args ],
+        [ map { ref $_ ? $_->{h} : 0 } @$grads ],
+        $reqs, []);
+    return bless {
+        h => $h, sym => $sym, args => $args, grads => $grads,
+        arg_of => $arg_of, grad_of => $grad_of,
+    }, $class;
+}
+
+sub arg { $_[0]{arg_of}{ $_[1] } }
+sub grad { $_[0]{grad_of}{ $_[1] } }
+sub param_names { [ sort keys %{ $_[0]{grad_of} } ] }
+
+sub forward {
+    my ($self, %opt) = @_;
+    MXNetTPU::executor_forward($self->{h}, $opt{is_train} ? 1 : 0);
+    return $self;
+}
+
+sub backward {
+    MXNetTPU::executor_backward($_[0]{h});
+    return $_[0];
+}
+
+sub outputs {
+    my ($self) = @_;
+    return [ map { MXNetTPU::NDArray->_wrap($_, 1) }
+             @{ MXNetTPU::executor_outputs($self->{h}) } ];
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    MXNetTPU::executor_free($self->{h}) if $self->{h};
+    $self->{h} = 0;
+}
+
+# ---------------------------------------------------------------------------
+package MXNetTPU::KVStore;
+
+use strict;
+use warnings;
+
+sub new {
+    my ($class, $type) = @_;
+    return bless { h => MXNetTPU::kv_create($type // 'local') }, $class;
+}
+
+sub set_optimizer {
+    my ($self, $name, %params) = @_;
+    my (@k, @v);
+    for my $key (sort keys %params) {
+        push @k, $key;
+        push @v, "$params{$key}";
+    }
+    MXNetTPU::kv_set_optimizer($self->{h}, $name, \@k, \@v);
+    return $self;
+}
+
+sub init {
+    my ($self, $keys, $vals) = @_;
+    MXNetTPU::kv_init($self->{h}, $keys, [ map { $_->{h} } @$vals ]);
+}
+
+sub push_ {
+    my ($self, $keys, $vals, $priority) = @_;
+    MXNetTPU::kv_push($self->{h}, $keys, [ map { $_->{h} } @$vals ],
+                      $priority // 0);
+}
+
+sub pull {
+    my ($self, $keys, $vals, $priority) = @_;
+    MXNetTPU::kv_pull($self->{h}, $keys, [ map { $_->{h} } @$vals ],
+                      $priority // 0);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    MXNetTPU::kv_free($self->{h}) if $self->{h};
+    $self->{h} = 0;
+}
+
+# ---------------------------------------------------------------------------
+package MXNetTPU::DataIter;
+
+use strict;
+use warnings;
+
+sub new {
+    my ($class, $name, %params) = @_;
+    my (@k, @v);
+    for my $key (sort keys %params) {
+        push @k, $key;
+        push @v, "$params{$key}";
+    }
+    return bless { h => MXNetTPU::dataiter_create($name, \@k, \@v) }, $class;
+}
+
+sub next { MXNetTPU::dataiter_next($_[0]{h}) }
+sub reset { MXNetTPU::dataiter_before_first($_[0]{h}) }
+
+# GetData/GetLabel return FRESH caller-owned handles each call (the
+# C-API WrapEntry convention, like ExecutorOutputs) — own them so the
+# per-batch views free with their Perl wrappers
+sub data { MXNetTPU::NDArray->_wrap(MXNetTPU::dataiter_data($_[0]{h}), 1) }
+sub label { MXNetTPU::NDArray->_wrap(MXNetTPU::dataiter_label($_[0]{h}), 1) }
+
+sub DESTROY {
+    my ($self) = @_;
+    MXNetTPU::dataiter_free($self->{h}) if $self->{h};
+    $self->{h} = 0;
+}
+
+1;
+
+__END__
+
+=head1 NAME
+
+MXNetTPU - Perl frontend for the mxtpu TPU-native deep learning framework
+
+=head1 SYNOPSIS
+
+    use MXNetTPU;
+
+    my $data = MXNetTPU::Symbol->variable('data');
+    my $net  = MXNetTPU::Symbol->op('FullyConnected', 'fc1', [$data],
+                                    num_hidden => 64);
+    $net = MXNetTPU::Symbol->op('Activation', 'relu1', [$net],
+                                act_type => 'relu');
+    $net = MXNetTPU::Symbol->op('FullyConnected', 'fc2', [$net],
+                                num_hidden => 10);
+    $net = MXNetTPU::Symbol->op('SoftmaxOutput', 'softmax', [$net],
+                                normalization => 'batch');
+
+    my $exe = $net->simple_bind(data => [50, 784]);
+    # ... see examples/train_mlp.pl for the full training loop
+
+=head1 DESCRIPTION
+
+A thin object layer over the mxtpu flat C ABI: NDArray, Symbol
+(compose + infer_shape + JSON), Executor (bind/forward/backward),
+KVStore (with the runtime optimizer zoo), and DataIter.  Operators are
+discovered from the runtime registry (C<MXNetTPU::list_ops>), so the
+surface tracks the framework without regenerating bindings — the same
+property the reference framework's C API gives its R and Scala
+frontends.
+
+=cut

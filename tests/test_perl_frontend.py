@@ -1,0 +1,69 @@
+"""Perl frontend (perl-package/): a second SCRIPTING-language binding
+built purely on the flat C ABI — the capability row the reference's
+R-package fills over its C API (reference R-package/src/ Rcpp layer).
+
+The XS extension (perl-package/MXNetTPU.xs) is compiled here with the
+stock Perl toolchain (ExtUtils::MakeMaker), then
+perl-package/examples/train_mlp.pl builds an MLP symbol, binds an
+executor, streams MNIST-format idx batches through MNISTIter, and
+trains via KVStore SGD to ~1.0 accuracy — no Python in the frontend
+process' source."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from test_native import _make_idx_dataset  # noqa: F401  (fixture helper)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _have_perl_toolchain():
+    if shutil.which("perl") is None:
+        return False
+    r = subprocess.run(
+        ["perl", "-MConfig", "-MExtUtils::MakeMaker", "-e",
+         "print $Config{archlibexp}"],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        return False
+    return os.path.exists(os.path.join(r.stdout.strip(), "CORE", "perl.h"))
+
+
+@pytest.mark.slow
+def test_perl_frontend_trains(tmp_path):
+    if not _have_perl_toolchain():
+        pytest.skip("no perl XS toolchain")
+    if not os.path.exists(os.path.join(REPO, "mxnet_tpu", "lib",
+                                       "libmxtpu.so")):
+        pytest.skip("libmxtpu.so not built")
+
+    # out-of-tree build: copy the package sources so MakeMaker's
+    # generated Makefile/blib never dirty the repo
+    pkg = tmp_path / "perl-package"
+    shutil.copytree(os.path.join(REPO, "perl-package"), pkg,
+                    ignore=shutil.ignore_patterns(
+                        "blib", "*.o", "*.c", "*.bs", "Makefile",
+                        "Makefile.old", "MYMETA*", "pm_to_blib"))
+    env = dict(os.environ)
+    env["MXTPU_HOME"] = REPO
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("MXTPU_PLATFORMS", "cpu")
+
+    r = subprocess.run(["perl", "Makefile.PL"], cwd=pkg, env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, (r.stdout + "\n" + r.stderr)[-3000:]
+    r = subprocess.run(["make"], cwd=pkg, env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, (r.stdout + "\n" + r.stderr)[-3000:]
+
+    img_path, lab_path = _make_idx_dataset(tmp_path, seed=2)
+    r = subprocess.run(
+        ["perl", os.path.join(pkg, "examples", "train_mlp.pl"),
+         img_path, lab_path, "50", "12"],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, (r.stdout + "\n" + r.stderr)[-3000:]
+    assert "PERL_TRAIN_OK" in r.stdout, r.stdout[-2000:]
