@@ -248,6 +248,18 @@ mxpl_symbol_list_arguments(IV h)
     RETVAL
 
 SV*
+mxpl_symbol_list_aux(IV h)
+  PREINIT:
+    int n;
+    const char** names;
+  CODE:
+    CHK(MXTPUSymbolListAuxiliaryStates(INT2PTR(SymbolHandle, h), &n,
+                                       &names));
+    RETVAL = strs_to_av(aTHX_ n, names);
+  OUTPUT:
+    RETVAL
+
+SV*
 mxpl_symbol_list_outputs(IV h)
   PREINIT:
     int n;

@@ -25,6 +25,9 @@ my $data = MXNetTPU::Symbol->variable('data');
 my $net  = MXNetTPU::Symbol->op('Flatten', 'flat', [$data]);
 $net = MXNetTPU::Symbol->op('FullyConnected', 'fc1', [$net],
                             num_hidden => 64);
+# BatchNorm exercises the auxiliary-state path (moving mean/var)
+$net = MXNetTPU::Symbol->op('BatchNorm', 'bn1', [$net],
+                            fix_gamma => 0);
 $net = MXNetTPU::Symbol->op('Activation', 'relu1', [$net],
                             act_type => 'relu');
 $net = MXNetTPU::Symbol->op('FullyConnected', 'fc2', [$net],
@@ -39,10 +42,17 @@ $net = MXNetTPU::Symbol->from_json($net->to_json);
 my $exe = $net->simple_bind(data => [$batch, 1, 28, 28],
                             softmax_label => [$batch]);
 
-# uniform init for the parameters
+# init: uniform weights, gamma = 1, beta = 0 (the standard pattern)
 for my $name (@{ $exe->param_names }) {
     my $arr = $exe->arg($name);
-    $arr->set_floats([ map { (rand() * 2 - 1) * 0.07 } 1 .. $arr->size ]);
+    if ($name =~ /gamma$/) {
+        $arr->set_floats([ (1) x $arr->size ]);
+    } elsif ($name =~ /beta$/) {
+        $arr->set_floats([ (0) x $arr->size ]);
+    } else {
+        $arr->set_floats(
+            [ map { (rand() * 2 - 1) * 0.07 } 1 .. $arr->size ]);
+    }
 }
 
 # ---- kvstore with the runtime's SGD ---------------------------------------

@@ -21,7 +21,7 @@ sub dl_load_flags { 0x01 }
 __PACKAGE__->bootstrap($VERSION);
 
 sub seed { MXNetTPU::random_seed($_[0]) }
-sub list_ops { MXNetTPU::list_ops() }
+# (list_ops comes straight from XS at this exact name)
 
 # ---------------------------------------------------------------------------
 package MXNetTPU::NDArray;
@@ -108,6 +108,7 @@ sub handle { $_[0]{h} }
 sub to_json { MXNetTPU::symbol_tojson($_[0]{h}) }
 sub list_arguments { MXNetTPU::symbol_list_arguments($_[0]{h}) }
 sub list_outputs { MXNetTPU::symbol_list_outputs($_[0]{h}) }
+sub list_auxiliary_states { MXNetTPU::symbol_list_aux($_[0]{h}) }
 
 # ($arg_shapes, $out_shapes, $aux_shapes, $complete)
 sub infer_shape {
@@ -122,7 +123,8 @@ sub infer_shape {
 
 sub simple_bind {
     my ($self, %known) = @_;
-    my ($arg_shapes, undef, undef, $complete) = $self->infer_shape(%known);
+    my ($arg_shapes, undef, $aux_shapes, $complete) =
+        $self->infer_shape(%known);
     die "MXNetTPU: shape inference incomplete\n" unless $complete;
     my $names = $self->list_arguments;
     my (@args, @grads, @reqs, %arg_of, %grad_of);
@@ -141,8 +143,21 @@ sub simple_bind {
             push @reqs, 1;             # write
         }
     }
+    # auxiliary states (BatchNorm moving stats etc.): zero-filled
+    # buffers bound alongside the args
+    my $aux_names = $self->list_auxiliary_states;
+    my (@aux, %aux_of);
+    for my $i (0 .. $#$aux_names) {
+        my $arr = MXNetTPU::NDArray->new($aux_shapes->[$i]);
+        # variance-like states start at 1 (BatchNorm moving_var), the
+        # rest at 0 — the standard aux initialization
+        my $fill = $aux_names->[$i] =~ /var$/ ? 1 : 0;
+        $arr->set_floats([ ($fill) x $arr->size ]);
+        push @aux, $arr;
+        $aux_of{ $aux_names->[$i] } = $arr;
+    }
     return MXNetTPU::Executor->_bind($self, \@args, \@grads, \@reqs,
-                                     \%arg_of, \%grad_of);
+                                     \@aux, \%arg_of, \%grad_of, \%aux_of);
 }
 
 sub DESTROY {
@@ -158,20 +173,22 @@ use strict;
 use warnings;
 
 sub _bind {
-    my ($class, $sym, $args, $grads, $reqs, $arg_of, $grad_of) = @_;
+    my ($class, $sym, $args, $grads, $reqs, $aux, $arg_of, $grad_of,
+        $aux_of) = @_;
     my $h = MXNetTPU::executor_bind(
         $sym->{h}, 1, 0,
         [ map { $_->{h} } @$args ],
         [ map { ref $_ ? $_->{h} : 0 } @$grads ],
-        $reqs, []);
+        $reqs, [ map { $_->{h} } @$aux ]);
     return bless {
-        h => $h, sym => $sym, args => $args, grads => $grads,
-        arg_of => $arg_of, grad_of => $grad_of,
+        h => $h, sym => $sym, args => $args, grads => $grads, aux => $aux,
+        arg_of => $arg_of, grad_of => $grad_of, aux_of => $aux_of,
     }, $class;
 }
 
 sub arg { $_[0]{arg_of}{ $_[1] } }
 sub grad { $_[0]{grad_of}{ $_[1] } }
+sub aux { $_[0]{aux_of}{ $_[1] } }
 sub param_names { [ sort keys %{ $_[0]{grad_of} } ] }
 
 sub forward {

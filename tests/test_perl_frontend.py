@@ -12,11 +12,10 @@ process' source."""
 import os
 import shutil
 import subprocess
-import sys
 
 import pytest
 
-from test_native import _make_idx_dataset  # noqa: F401  (fixture helper)
+from test_native import _make_idx_dataset
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
