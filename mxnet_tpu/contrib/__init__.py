@@ -8,7 +8,8 @@ capability surface of the reference ``example/rcnn`` helper/rpn stack.
 databases and VOC mAP evaluation (reference example/rcnn/helper/dataset).
 """
 
+from . import quantization
 from . import rcnn
 from . import rcnn_dataset
 
-__all__ = ["rcnn", "rcnn_dataset"]
+__all__ = ["quantization", "rcnn", "rcnn_dataset"]

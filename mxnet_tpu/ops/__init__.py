@@ -15,6 +15,7 @@ from . import loss  # noqa: F401
 from . import sequence  # noqa: F401
 from . import rnn  # noqa: F401
 from . import vision  # noqa: F401
+from . import quantized  # noqa: F401
 from . import multibox  # noqa: F401
 from . import sample  # noqa: F401
 from . import attention  # noqa: F401

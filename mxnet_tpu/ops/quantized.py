@@ -1,0 +1,187 @@
+"""Quantized inference operators (beyond the 2016 reference, which has
+no quantization story; later MXNet grew contrib/quantization — this is
+the TPU-native version of that capability).
+
+Two execution modes per op, chosen by whether an activation scale was
+calibrated:
+
+- weight-only (``act_scale == 0``): int8 weights dequantize on the fly
+  and the matmul runs in the activation dtype — 4x smaller/faster
+  weight reads (HBM-bandwidth win), bit-identical activation math.
+- full int8 (``act_scale > 0``): activations quantize per tensor,
+  the MXU runs an int8 x int8 -> int32 contraction (double the int8
+  throughput of bf16 on v5e+), and the result rescales by
+  ``act_scale * per-channel weight scale``.
+
+Weights are stored transposed-quantized with PER-OUTPUT-CHANNEL scales
+(the standard accuracy-preserving choice; a whole-tensor scale loses
+~1 bit of effective precision on typical layers).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..param import Params, field, tuple_of
+from .nn import _pair
+from .op import OpDef, register_op
+
+
+class QuantizedFullyConnectedParam(Params):
+    num_hidden = field(int, required=True, lower=1)
+    no_bias = field(bool, default=False)
+    flatten = field(bool, default=True)
+    act_scale = field(float, default=0.0,
+                      doc="calibrated activation scale; 0 = weight-only")
+
+
+@register_op("QuantizedFullyConnected")
+class QuantizedFullyConnectedOp(OpDef):
+    """y = x @ (w_int8 * wscale).T + b, optionally with the x-side also
+    int8-quantized so the contraction itself runs on int8 (see module
+    docstring).  Inference-oriented: round() has zero gradient."""
+
+    param_cls = QuantizedFullyConnectedParam
+
+    def list_arguments(self, params):
+        args = ["data", "weight", "wscale"]
+        if not params.no_bias:
+            args.append("bias")
+        return args
+
+    def infer_shape(self, params, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise ValueError("QuantizedFullyConnected: data shape unknown")
+        in_dim = int(np.prod(data[1:]))
+        completed = [tuple(data), (params.num_hidden, in_dim),
+                     (params.num_hidden,)]
+        if not params.no_bias:
+            completed.append((params.num_hidden,))
+        return completed, [(data[0], params.num_hidden)], []
+
+    def infer_dtype(self, params, in_dtypes):
+        act = in_dtypes[0] or np.dtype(np.float32)
+        ins = [act, np.dtype(np.int8), np.dtype(np.float32)]
+        if not params.no_bias:
+            ins.append(np.dtype(np.float32))
+        return ins, [act], []
+
+    def forward(self, params, inputs, aux, train, key):
+        x = inputs[0]
+        wq = inputs[1]
+        wscale = inputs[2].astype(jnp.float32)
+        x2 = x.reshape(x.shape[0], -1)
+        if params.act_scale > 0.0:
+            inv = 1.0 / params.act_scale
+            xq = jnp.clip(jnp.round(x2.astype(jnp.float32) * inv),
+                          -127, 127).astype(jnp.int8)
+            y32 = lax.dot_general(xq, wq, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+            y = (y32.astype(jnp.float32)
+                 * (params.act_scale * wscale)[None, :])
+        else:
+            w = wq.astype(x.dtype) * wscale.astype(x.dtype)[:, None]
+            y = jnp.dot(x2, w.T).astype(jnp.float32)
+        if not params.no_bias:
+            y = y + inputs[-1].astype(jnp.float32)
+        return [y.astype(x.dtype)], []
+
+
+class QuantizedConvolutionParam(Params):
+    kernel = field(tuple_of(int), required=True)
+    num_filter = field(int, required=True, lower=1)
+    stride = field(tuple_of(int), default=None)
+    pad = field(tuple_of(int), default=None)
+    no_bias = field(bool, default=False)
+    layout = field(str, default="NCHW", enum=("NCHW", "NHWC"))
+    act_scale = field(float, default=0.0)
+
+
+@register_op("QuantizedConvolution")
+class QuantizedConvolutionOp(OpDef):
+    """Convolution with int8 weights + per-output-channel scales
+    (weight-only dequant path; full int8 conv accumulate when a
+    calibrated ``act_scale`` is present)."""
+
+    param_cls = QuantizedConvolutionParam
+
+    def list_arguments(self, params):
+        args = ["data", "weight", "wscale"]
+        if not params.no_bias:
+            args.append("bias")
+        return args
+
+    def _wshape(self, params, in_ch):
+        # weight layout is OIHW in BOTH layouts — exactly like the float
+        # ConvolutionOp (ops/nn.py), so quantization is shape-preserving
+        kh, kw = _pair(params.kernel)
+        return (params.num_filter, in_ch, kh, kw)
+
+    def infer_shape(self, params, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise ValueError("QuantizedConvolution: data shape unknown")
+        n, h, w, c = ((data[0], data[1], data[2], data[3])
+                      if params.layout == "NHWC"
+                      else (data[0], data[2], data[3], data[1]))
+        kh, kw = _pair(params.kernel)
+        sh, sw = _pair(params.stride)
+        ph, pw = _pair(params.pad, 2) if params.pad else (0, 0)
+        oh = (h + 2 * ph - kh) // sh + 1
+        ow = (w + 2 * pw - kw) // sw + 1
+        out = ((n, oh, ow, params.num_filter) if params.layout == "NHWC"
+               else (n, params.num_filter, oh, ow))
+        completed = [tuple(data), self._wshape(params, c),
+                     (params.num_filter,)]
+        if not params.no_bias:
+            completed.append((params.num_filter,))
+        return completed, [out], []
+
+    def infer_dtype(self, params, in_dtypes):
+        act = in_dtypes[0] or np.dtype(np.float32)
+        ins = [act, np.dtype(np.int8), np.dtype(np.float32)]
+        if not params.no_bias:
+            ins.append(np.dtype(np.float32))
+        return ins, [act], []
+
+    def forward(self, params, inputs, aux, train, key):
+        x = inputs[0]
+        wq = inputs[1]
+        wscale = inputs[2].astype(jnp.float32)
+        sh, sw = _pair(params.stride)
+        ph, pw = _pair(params.pad, 2) if params.pad else (0, 0)
+        if params.layout == "NHWC":
+            dn = lax.conv_dimension_numbers(x.shape, wq.shape,
+                                            ("NHWC", "OIHW", "NHWC"))
+            ch_axis = -1
+        else:
+            dn = lax.conv_dimension_numbers(x.shape, wq.shape,
+                                            ("NCHW", "OIHW", "NCHW"))
+            ch_axis = 1
+        if params.act_scale > 0.0:
+            inv = 1.0 / params.act_scale
+            xq = jnp.clip(jnp.round(x.astype(jnp.float32) * inv),
+                          -127, 127).astype(jnp.int8)
+            y32 = lax.conv_general_dilated(
+                xq, wq, (sh, sw), [(ph, ph), (pw, pw)],
+                dimension_numbers=dn, preferred_element_type=jnp.int32)
+            scale = params.act_scale * wscale
+            shape = [1] * y32.ndim
+            shape[ch_axis] = y32.shape[ch_axis]
+            y = y32.astype(jnp.float32) * scale.reshape(shape)
+        else:
+            wshape = [1] * wq.ndim
+            wshape[0] = wq.shape[0]  # O leads in both OHWI and OIHW
+            w = wq.astype(x.dtype) * wscale.astype(x.dtype).reshape(wshape)
+            y = lax.conv_general_dilated(
+                x, w, (sh, sw), [(ph, ph), (pw, pw)],
+                dimension_numbers=dn).astype(jnp.float32)
+        if not params.no_bias:
+            b = inputs[-1].astype(jnp.float32)
+            shape = [1] * y.ndim
+            shape[ch_axis] = b.shape[0]
+            y = y + b.reshape(shape)
+        return [y.astype(x.dtype)], []
