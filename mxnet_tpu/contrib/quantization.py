@@ -132,19 +132,35 @@ def quantize_model(symbol, arg_params, aux_params=None, calib_data=None,
     carry calibrated activation scales (full-int8 contractions);
     without it they run the weight-only dequant path.
     """
+    if isinstance(exclude, str):
+        exclude = (exclude,)  # a bare string must not degrade to chars
     exclude = set(exclude)
     conf = json.loads(symbol.tojson())
     nodes = conf["nodes"]
 
     # layer -> the internal-output name feeding its data input (the
     # calibration tap): variables tap by their own name, op outputs by
-    # "<name>_output"
+    # "<name>_output" (multi-output ops: "<name>_output<k>")
+    internal_names = set(symbol.get_internals().list_outputs())
+
+    def _tap_name(src, out_idx):
+        if src["op"] == "null":
+            return src["name"]
+        single = src["name"] + "_output"
+        if out_idx == 0 and single in internal_names:
+            return single
+        multi = f"{src['name']}_output{out_idx}"
+        if multi in internal_names:
+            return multi
+        raise MXNetError(
+            f"quantize_model: cannot locate internal output {out_idx} of "
+            f"'{src['name']}' for calibration")
+
     taps = {}
     for node in nodes:
         if _eligible(node, exclude) and node["name"] + "_weight" in arg_params:
-            src = nodes[node["inputs"][0][0]]
-            taps[node["name"]] = (src["name"] if src["op"] == "null"
-                                  else src["name"] + "_output")
+            src_idx, out_idx = node["inputs"][0][0], node["inputs"][0][1]
+            taps[node["name"]] = _tap_name(nodes[src_idx], out_idx)
 
     act_scales = {}
     if calib_data is not None and taps:

@@ -179,3 +179,26 @@ def test_quantized_conv_nhwc_and_ragged_calibration():
         exe, probs_q = _run_quantized(qsym, qargs, X)
         assert (probs_q.argmax(1) == probs_f.argmax(1)).mean() > 0.93, \
             ("calib" if calib else "weight-only")
+
+
+def test_multi_output_source_and_string_exclude():
+    """Calibration taps resolve multi-output sources by output index,
+    and a bare-string exclude= means one name, not its characters."""
+    data = mx.sym.Variable("data")
+    parts = mx.sym.SliceChannel(data, num_outputs=2, axis=1, name="slice")
+    net = mx.sym.FullyConnected(parts[1], num_hidden=3, name="fcm")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(5)
+    shapes = dict(zip(net.list_arguments(),
+                      net.infer_shape(data=(8, 4))[0]))
+    args = {n: mx.nd.array(rng.randn(*shapes[n]).astype(np.float32))
+            for n in shapes if n not in ("data", "softmax_label")}
+    X = rng.randn(8, 4).astype(np.float32)
+    qsym, qargs, _ = quantize_model(net, args, calib_data=[X])
+    assert qargs["fcm_weight"].dtype == np.int8
+    exe, probs = _run_quantized(qsym, qargs, X)
+    assert probs.shape == (8, 3)
+
+    # string exclude: the named layer must NOT be quantized
+    q2, qa2, _ = quantize_model(net, args, exclude="fcm")
+    assert qa2["fcm_weight"].dtype == np.float32

@@ -10,8 +10,9 @@ artifact set in priority order:
   3. bench.py BENCH_MODEL=cifar             -> BENCH_CIFAR_LATEST.json
   4. tools/bandwidth/measure.py             -> BANDWIDTH.json
   5. tools/flash_bench.py                   -> FLASH_BENCH.json
-  6. tests/test_tpu_consistency.py          -> TPU_CONSISTENCY.json
-  7. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
+  6. tools/quant_bench.py                   -> QUANT_BENCH.json
+  7. tests/test_tpu_consistency.py          -> TPU_CONSISTENCY.json
+  8. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
 
 Each successful TPU-platform result is also appended to
 BENCH_ATTEMPTS.jsonl with a timestamp so nothing is lost if a later
@@ -178,6 +179,19 @@ def run_flash_bench(timeout=1800):
         "FLASH_BENCH.json", timeout, validate=validate)
 
 
+def run_quant_bench(timeout=1800):
+    """Float vs int8 ResNet-50 inference (tools/quant_bench.py) — the
+    quantization-subsystem measurement."""
+
+    def validate(payload):
+        return (None if payload.get("int8_img_per_sec", 0) > 0
+                else "no int8 measurement")
+
+    return run_json_artifact(
+        "quant", [os.path.join(REPO, "tools", "quant_bench.py")],
+        "QUANT_BENCH.json", timeout, validate=validate)
+
+
 def run_tpu_consistency(timeout=2400):
     """The cpu-vs-tpu numerics gate (tests/test_tpu_consistency.py) has
     only ever run when a session held the chip; record a pass here."""
@@ -210,8 +224,8 @@ def main():
     deadline = time.time() + 3600 * float(
         os.environ.get("BENCH_WATCH_HOURS", "9"))
     done = {"resnet": False, "gpt": False, "cifar": False,
-            "bandwidth": False, "flash": False, "consistency": False,
-            "sweep": False}
+            "bandwidth": False, "flash": False, "quant": False,
+            "consistency": False, "sweep": False}
     fails = {k: 0 for k in done}
     MAX_FAILS = 6  # give up on a stage that fails repeatedly WITH the
     #               probe passing (a code bug, not a tunnel flake)
@@ -273,6 +287,10 @@ def main():
         if not done["flash"]:
             done["flash"] = attempt(
                 "flash", lambda: run_flash_bench(timeout=min(1800, left)))
+            continue
+        if not done["quant"]:
+            done["quant"] = attempt(
+                "quant", lambda: run_quant_bench(timeout=min(1800, left)))
             continue
         if not done["consistency"]:
             done["consistency"] = attempt(
