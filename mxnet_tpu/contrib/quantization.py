@@ -83,11 +83,11 @@ def _calibrate(symbol, arg_params, aux_params, taps, calib_data,
                num_batches, data_name):
     """Max-abs activation calibration: bind the FLOAT net's internals so
     each target layer's INPUT activation is observed on real batches;
-    ``taps`` maps layer name -> internal output name.  Returns
-    {layer_name: act_scale}."""
+    ``taps`` maps layer name -> POSITIONAL internal-output index.
+    Returns {layer_name: act_scale}."""
     internals = symbol.get_internals()
     names = list(taps)
-    group = sym_mod.Group([internals[taps[n]] for n in names])
+    group = sym_mod.Group([internals[int(taps[n])] for n in names])
 
     amax = {n: 0.0 for n in names}
     exes = {}  # batch shape -> bound executor (ragged final batches)
@@ -138,29 +138,37 @@ def quantize_model(symbol, arg_params, aux_params=None, calib_data=None,
     conf = json.loads(symbol.tojson())
     nodes = conf["nodes"]
 
-    # layer -> the internal-output name feeding its data input (the
-    # calibration tap): variables tap by their own name, op outputs by
-    # "<name>_output" (multi-output ops: "<name>_output<k>")
-    internal_names = set(symbol.get_internals().list_outputs())
+    # layer -> POSITIONAL index of the internal output feeding its data
+    # input (the calibration tap).  Indexing internals by position —
+    # tojson emits nodes in the same topo order get_internals walks, one
+    # entry per op output — avoids name collisions (e.g. an RNN's
+    # 'rnn_state' output vs its 'rnn_state' initial-state variable).
+    # Resolution only happens — and can only raise — on the calibrated
+    # path.
+    from ..ops import OP_REGISTRY
 
-    def _tap_name(src, out_idx):
-        if src["op"] == "null":
-            return src["name"]
-        single = src["name"] + "_output"
-        if out_idx == 0 and single in internal_names:
-            return single
-        multi = f"{src['name']}_output{out_idx}"
-        if multi in internal_names:
-            return multi
-        raise MXNetError(
-            f"quantize_model: cannot locate internal output {out_idx} of "
-            f"'{src['name']}' for calibration")
-
+    targets = [node for node in nodes
+               if _eligible(node, exclude)
+               and node["name"] + "_weight" in arg_params]
     taps = {}
-    for node in nodes:
-        if _eligible(node, exclude) and node["name"] + "_weight" in arg_params:
+    if calib_data is not None:
+        offsets, total = [], 0
+        for node in nodes:
+            offsets.append(total)
+            if node["op"] == "null":
+                total += 1
+            else:
+                op = OP_REGISTRY.get(node["op"])
+                total += op.num_outputs(op.make_params(node.get("param",
+                                                                {})))
+        n_internal = len(symbol.get_internals().list_outputs())
+        if total != n_internal:
+            raise MXNetError(
+                f"quantize_model: internal-output count mismatch "
+                f"({total} vs {n_internal})")
+        for node in targets:
             src_idx, out_idx = node["inputs"][0][0], node["inputs"][0][1]
-            taps[node["name"]] = _tap_name(nodes[src_idx], out_idx)
+            taps[node["name"]] = offsets[src_idx] + out_idx
 
     act_scales = {}
     if calib_data is not None and taps:
@@ -172,11 +180,12 @@ def quantize_model(symbol, arg_params, aux_params=None, calib_data=None,
     # rebuild the node list in topological order: each quantized layer's
     # wscale variable must appear BEFORE its consumer, so indices shift
     # and every reference is remapped through old -> new
+    target_names = {node["name"] for node in targets}
     new_nodes = []
     remap = {}
     for old_idx, node in enumerate(nodes):
         name = node["name"]
-        if name in taps:
+        if name in target_names:
             w = qargs.pop(name + "_weight")
             wq, scale = quantize_weight(w.asnumpy())
             qargs[name + "_weight"] = nd.array(wq, dtype=np.int8)

@@ -82,6 +82,16 @@ def gpt_generate(params, prompt, max_new_tokens, num_heads,
             "prefix or not a gpt() parameter dict") from None
     d_model = tok_w.shape[1]
     S = pos_w.shape[1]
+    if any(k.endswith("_wscale") for k in params):
+        # quantized checkpoint (contrib/quantization.py): dequantize the
+        # int8 weights once at load — decode then runs the normal path
+        # (weight-only int8 semantics)
+        params = dict(params)
+        for k in [k for k in params if k.endswith("_wscale")]:
+            stem = k[: -len("_wscale")]
+            wq = np.asarray(params[stem + "_weight"], np.float32)
+            scale = np.asarray(params.pop(k), np.float32)
+            params[stem + "_weight"] = wq * scale[:, None]
     if f"{name}_l0_qkv_weight" in params:
         # fused_qkv=True checkpoint layout: split each (3D, D) projection
         # back into the q/k/v entries the decoder addresses

@@ -171,3 +171,36 @@ def test_generate_accepts_fused_qkv_checkpoint():
     ids2 = mx.models.gpt_generate(unfused, prompt, max_new_tokens=3,
                                   num_heads=2)
     np.testing.assert_array_equal(ids, ids2)
+
+
+def test_generate_accepts_quantized_checkpoint():
+    """gpt_generate consumes contrib-quantized (int8 + wscale) params:
+    weight-only dequant at load, then normal decoding — matching the
+    dequantized-by-hand baseline exactly."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib.quantization import quantize_model
+
+    rng = np.random.RandomState(9)
+    V, S = 24, 10
+    net = mx.models.gpt(V, S, num_layers=1, d_model=16, num_heads=2)
+    exe = net.simple_bind(mx.cpu(), grad_req="null", data=(1, S),
+                          softmax_label=(1, S))
+    params = {}
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            params[name] = rng.randn(*arr.shape).astype(np.float32) * 0.1
+    qsym, qargs, _ = quantize_model(
+        net, {k: mx.nd.array(v) for k, v in params.items()})
+    qnp = {k: v.asnumpy() for k, v in qargs.items()}
+    prompt = rng.randint(0, V, (2, 3))
+    ids_q = mx.models.gpt_generate(qnp, prompt, max_new_tokens=3,
+                                   num_heads=2)
+    # manual dequant -> same decode
+    manual = dict(params)
+    for k in [k for k in qnp if k.endswith("_wscale")]:
+        stem = k[: -len("_wscale")]
+        manual[stem + "_weight"] = (qnp[stem + "_weight"].astype(np.float32)
+                                    * qnp[k][:, None])
+    ids_m = mx.models.gpt_generate(manual, prompt, max_new_tokens=3,
+                                   num_heads=2)
+    np.testing.assert_array_equal(ids_q, ids_m)

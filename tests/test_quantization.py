@@ -202,3 +202,37 @@ def test_multi_output_source_and_string_exclude():
     # string exclude: the named layer must NOT be quantized
     q2, qa2, _ = quantize_model(net, args, exclude="fcm")
     assert qa2["fcm_weight"].dtype == np.float32
+
+
+def test_quantized_predict_api():
+    """The predict-only deployment surface consumes quantized
+    artifacts unchanged (symbol JSON + int8 param blob)."""
+    net, args, aux, X, y, probs_f = _trained_mlp()
+    qsym, qargs, _ = quantize_model(net, args, aux)
+    pred = mx.predict.create(qsym.tojson(),
+                             {"arg:" + k: v for k, v in qargs.items()},
+                             {"data": X.shape})
+    out = np.asarray(pred.forward(data=X)[0])
+    assert (out.argmax(1) == probs_f.argmax(1)).mean() > 0.98
+
+
+def test_tap_resolves_ambiguous_output_names():
+    """Calibration taps index internals POSITIONALLY: an RNN's
+    'rnn_state' output collides with its 'rnn_state' initial-state
+    variable, which a name lookup would mis-resolve; weight-only mode
+    must not touch tap resolution at all."""
+    data = mx.sym.Variable("data")
+    rnn = mx.sym.RNN(data, state_size=8, num_layers=1, mode="lstm",
+                     state_outputs=True, name="rnn")
+    net = mx.sym.FullyConnected(rnn[1], num_hidden=3, name="fcs")
+    net = mx.sym.SoftmaxOutput(mx.sym.Reshape(net, shape=(-1, 3)),
+                               name="softmax")
+    shapes = dict(zip(net.list_arguments(),
+                      net.infer_shape(data=(5, 2, 4))[0]))
+    rng = np.random.RandomState(6)
+    args = {n: mx.nd.array(rng.randn(*shapes[n]).astype(np.float32))
+            for n in shapes if n not in ("data", "softmax_label")}
+    X = rng.randn(5, 2, 4).astype(np.float32)
+    for calib in (None, [X]):
+        qsym, qargs, _ = quantize_model(net, args, calib_data=calib)
+        assert qargs["fcs_weight"].dtype == np.int8
