@@ -1,0 +1,98 @@
+"""Int8 post-training quantization workflow on ResNet-50
+(contrib/quantization.py; see docs/how_to/quantization.md).
+
+Train-or-load -> calibrate on a few batches -> quantize -> compare
+float vs int8 outputs -> save the int8 deployment artifacts.  Runs on
+synthetic data by default so it works anywhere; point --data-dir at an
+ImageNet rec set for the real thing.
+
+Usage:
+  python examples/quantize_resnet.py [--num-layers 18] [--batch 8]
+         [--weight-only] [--out /tmp/resnet_int8]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.contrib.quantization import quantize_model  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-layers", type=int, default=18)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--image-hw", type=int, default=32,
+                   help="synthetic image size (224 for real ImageNet)")
+    p.add_argument("--weight-only", action="store_true",
+                   help="skip calibration (int8 weights, float compute)")
+    p.add_argument("--out", default="/tmp/resnet_int8")
+    args = p.parse_args()
+
+    hw = args.image_hw
+    net = mx.models.resnet(num_classes=1000, num_layers=args.num_layers,
+                           image_shape=(3, hw, hw))
+    data_shape = (args.batch, 3, hw, hw)
+
+    # stand-in for a trained checkpoint: random-initialized params
+    # (swap for mx.model.load_checkpoint(prefix, epoch) in real use)
+    rng = np.random.RandomState(0)
+    arg_shapes, _, aux_shapes = net.infer_shape(data=data_shape)
+    arg_params = {
+        n: mx.nd.array(rng.standard_normal(s).astype(np.float32) * 0.05)
+        for n, s in zip(net.list_arguments(), arg_shapes)
+        if n not in ("data", "softmax_label")}
+    aux_params = {
+        n: mx.nd.array(np.ones(s, np.float32) if n.endswith("var")
+                       else np.zeros(s, np.float32))
+        for n, s in zip(net.list_auxiliary_states(), aux_shapes)}
+
+    calib = None
+    if not args.weight_only:
+        calib = [rng.uniform(-1, 1, data_shape).astype(np.float32)
+                 for _ in range(4)]
+    qsym, qargs, qaux = quantize_model(
+        net, arg_params, aux_params, calib_data=calib,
+        exclude=("conv0",))  # image-space stem stays float
+
+    n_int8 = sum(1 for v in qargs.values() if v.dtype == np.int8)
+    f_bytes = sum(int(np.prod(v.shape)) * 4 for v in arg_params.values())
+    q_bytes = sum(int(np.prod(v.shape)) * (1 if v.dtype == np.int8 else 4)
+                  for v in qargs.values())
+    print(f"quantized {n_int8} layers; params {f_bytes / 1e6:.1f} MB -> "
+          f"{q_bytes / 1e6:.1f} MB")
+
+    # float vs int8 agreement on a held-out batch
+    X = rng.uniform(-1, 1, data_shape).astype(np.float32)
+
+    def forward(sym, params, aux):
+        exe = sym.simple_bind(mx.cpu(), grad_req="null", data=data_shape,
+                              softmax_label=(args.batch,))
+        for k, v in params.items():
+            if k in exe.arg_dict:
+                exe.arg_dict[k][:] = v
+        for k, v in aux.items():
+            if k in exe.aux_dict:
+                exe.aux_dict[k][:] = v
+        exe.arg_dict["data"][:] = X
+        return exe.forward(is_train=False)[0].asnumpy()
+
+    p_f = forward(net, arg_params, aux_params)
+    p_q = forward(qsym, qargs, qaux)
+    agree = (p_f.argmax(1) == p_q.argmax(1)).mean()
+    print(f"top-1 agreement float vs int8: {agree:.3f}")
+
+    qsym.save(args.out + "-symbol.json")
+    mx.nd.save(args.out + "-0000.params",
+               {"arg:" + k: v for k, v in qargs.items()}
+               | {"aux:" + k: v for k, v in qaux.items()})
+    print(f"saved {args.out}-symbol.json / -0000.params")
+
+
+if __name__ == "__main__":
+    main()

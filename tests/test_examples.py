@@ -354,3 +354,12 @@ def test_example_cpp_train_mlp(tmp_path):
                        env=env)
     assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
     assert "accuracy over final steps" in r.stdout
+
+
+def test_example_quantize_resnet_runs(tmp_path, capsys):
+    _run_example("quantize_resnet.py",
+                 ["--num-layers", "18", "--batch", "4", "--image-hw", "32",
+                  "--out", str(tmp_path / "q")])
+    out = capsys.readouterr().out
+    assert "top-1 agreement" in out
+    assert (tmp_path / "q-symbol.json").exists()
