@@ -74,6 +74,14 @@ static int av_to_strs(pTHX_ SV* sv, const char** buf, int cap,
   return n;
 }
 
+static SV* handles_to_av(pTHX_ int n, void** handles) {
+  AV* av = newAV();
+  int i;
+  for (i = 0; i < n; ++i)
+    av_push(av, newSViv(PTR2IV(handles[i])));
+  return newRV_noinc((SV*)av);
+}
+
 static SV* strs_to_av(pTHX_ int n, const char** names) {
   AV* av = newAV();
   int i;
@@ -198,6 +206,24 @@ void
 mxpl_ndarray_wait_all()
   CODE:
     CHK(MXTPUNDArrayWaitAll());
+
+SV*
+mxpl_func_invoke(const char* op, SV* inputs, SV* keys, SV* vals)
+  PREINIT:
+    void* in[MXPL_MAX];
+    const char *k[MXPL_MAX], *v[MXPL_MAX];
+    NDArrayHandle outs[MXPL_MAX];
+    int n_in, nk, nv, n_out;
+  CODE:
+    n_in = av_to_handles(aTHX_ inputs, in, MXPL_MAX, "inputs");
+    nk = av_to_strs(aTHX_ keys, k, MXPL_MAX, "keys");
+    nv = av_to_strs(aTHX_ vals, v, MXPL_MAX, "vals");
+    if (nk != nv) croak("MXNetTPU: keys/vals length mismatch");
+    CHK(MXTPUFuncInvoke(op, n_in, (NDArrayHandle*)in, nk, k, v,
+                        MXPL_MAX, outs, &n_out));
+    RETVAL = handles_to_av(aTHX_ n_out, (void**)outs);
+  OUTPUT:
+    RETVAL
 
 # ---- Symbol --------------------------------------------------------------
 
@@ -371,15 +397,11 @@ SV*
 mxpl_executor_outputs(IV h)
   PREINIT:
     NDArrayHandle outs[MXPL_MAX];
-    int n, i;
-    AV* av;
+    int n;
   CODE:
     CHK(MXTPUExecutorOutputs(INT2PTR(ExecutorHandle, h), MXPL_MAX, outs,
                              &n));
-    av = newAV();
-    for (i = 0; i < n; ++i)
-      av_push(av, newSViv(PTR2IV(outs[i])));
-    RETVAL = newRV_noinc((SV*)av);
+    RETVAL = handles_to_av(aTHX_ n, (void**)outs);
   OUTPUT:
     RETVAL
 

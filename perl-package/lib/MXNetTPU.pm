@@ -68,6 +68,55 @@ sub to_floats {
     return [ unpack('f*', $bytes) ];
 }
 
+# Imperative op invoke from the runtime registry (MXImperativeInvoke
+# analog): MXNetTPU::NDArray->invoke('_plus', [$a, $b]) — ops are
+# DISCOVERED, not hand-bound, the property that keeps thin frontends in
+# sync with the framework (see MXNetTPU::list_ops).
+sub invoke {
+    my ($class, $op, $inputs, %params) = @_;
+    my (@k, @v);
+    for my $key (sort keys %params) {
+        push @k, $key;
+        push @v, "$params{$key}";
+    }
+    my $outs = MXNetTPU::func_invoke($op, [ map { $_->{h} } @$inputs ],
+                                     \@k, \@v);
+    my @wrapped = map { MXNetTPU::NDArray->_wrap($_, 1) } @$outs;
+    return wantarray ? @wrapped : $wrapped[0];
+}
+
+# operator sugar over the registry's elementwise zoo; numeric operands
+# route to the *_scalar variants, anything else croaks clearly
+sub _is_nd { ref $_[0] && Scalar::Util::blessed($_[0])
+             && $_[0]->isa('MXNetTPU::NDArray') }
+
+sub _binop {
+    my ($op, $scalar_op, $rscalar_op, $a, $b, $swap) = @_;
+    ($a, $b) = ($b, $a) if $swap;
+    if (_is_nd($a) && _is_nd($b)) {
+        return MXNetTPU::NDArray->invoke($op, [ $a, $b ]);
+    }
+    if (_is_nd($a) && defined $b && !ref $b
+            && $b =~ /^-?(\d+\.?\d*|\.\d+)([eE][-+]?\d+)?$/) {
+        return MXNetTPU::NDArray->invoke($scalar_op, [$a], scalar => $b);
+    }
+    if (_is_nd($b) && defined $a && !ref $a
+            && $a =~ /^-?(\d+\.?\d*|\.\d+)([eE][-+]?\d+)?$/) {
+        return MXNetTPU::NDArray->invoke($rscalar_op, [$b], scalar => $a);
+    }
+    require Carp;
+    Carp::croak("MXNetTPU::NDArray $op: operands must be NDArrays "
+                . "or numbers");
+}
+
+use Scalar::Util ();
+use overload
+    '+' => sub { _binop('_plus', '_plus_scalar', '_plus_scalar', @_) },
+    '-' => sub { _binop('_minus', '_minus_scalar', '_rminus_scalar', @_) },
+    '*' => sub { _binop('_mul', '_mul_scalar', '_mul_scalar', @_) },
+    'bool' => sub { 1 }, '""' => sub { "MXNetTPU::NDArray(@{[
+        join 'x', @{ $_[0]->shape } ]})" };
+
 sub DESTROY {
     my ($self) = @_;
     MXNetTPU::ndarray_free($self->{h}) if $self->{own} && $self->{h};

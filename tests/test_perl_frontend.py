@@ -2,8 +2,8 @@
 built purely on the flat C ABI — the capability row the reference's
 R-package fills over its C API (reference R-package/src/ Rcpp layer).
 
-The XS extension (perl-package/MXNetTPU.xs) is compiled here with the
-stock Perl toolchain (ExtUtils::MakeMaker), then
+The XS extension (perl-package/MXNetTPU.xs) is compiled once per module
+with the stock Perl toolchain (ExtUtils::MakeMaker), then
 perl-package/examples/train_mlp.pl builds an MLP symbol, binds an
 executor, streams MNIST-format idx batches through MNISTIter, and
 trains via KVStore SGD to ~1.0 accuracy — no Python in the frontend
@@ -32,17 +32,17 @@ def _have_perl_toolchain():
     return os.path.exists(os.path.join(r.stdout.strip(), "CORE", "perl.h"))
 
 
-@pytest.mark.slow
-def test_perl_frontend_trains(tmp_path):
+@pytest.fixture(scope="module")
+def perl_pkg(tmp_path_factory):
+    """Out-of-tree build of the XS package, shared by every test in
+    this module: (pkg_dir, env).  Copying the sources keeps MakeMaker's
+    Makefile/blib out of the repo."""
     if not _have_perl_toolchain():
         pytest.skip("no perl XS toolchain")
     if not os.path.exists(os.path.join(REPO, "mxnet_tpu", "lib",
                                        "libmxtpu.so")):
         pytest.skip("libmxtpu.so not built")
-
-    # out-of-tree build: copy the package sources so MakeMaker's
-    # generated Makefile/blib never dirty the repo
-    pkg = tmp_path / "perl-package"
+    pkg = tmp_path_factory.mktemp("perl") / "perl-package"
     shutil.copytree(os.path.join(REPO, "perl-package"), pkg,
                     ignore=shutil.ignore_patterns(
                         "blib", "*.o", "*.c", "*.bs", "Makefile",
@@ -51,14 +51,16 @@ def test_perl_frontend_trains(tmp_path):
     env["MXTPU_HOME"] = REPO
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.setdefault("MXTPU_PLATFORMS", "cpu")
+    for cmd in (["perl", "Makefile.PL"], ["make"]):
+        r = subprocess.run(cmd, cwd=pkg, env=env, capture_output=True,
+                           text=True)
+        assert r.returncode == 0, (r.stdout + "\n" + r.stderr)[-3000:]
+    return pkg, env
 
-    r = subprocess.run(["perl", "Makefile.PL"], cwd=pkg, env=env,
-                       capture_output=True, text=True)
-    assert r.returncode == 0, (r.stdout + "\n" + r.stderr)[-3000:]
-    r = subprocess.run(["make"], cwd=pkg, env=env,
-                       capture_output=True, text=True)
-    assert r.returncode == 0, (r.stdout + "\n" + r.stderr)[-3000:]
 
+@pytest.mark.slow
+def test_perl_frontend_trains(perl_pkg, tmp_path):
+    pkg, env = perl_pkg
     img_path, lab_path = _make_idx_dataset(tmp_path, seed=2)
     r = subprocess.run(
         ["perl", os.path.join(pkg, "examples", "train_mlp.pl"),
@@ -66,3 +68,32 @@ def test_perl_frontend_trains(tmp_path):
         env=env, capture_output=True, text=True, timeout=420)
     assert r.returncode == 0, (r.stdout + "\n" + r.stderr)[-3000:]
     assert "PERL_TRAIN_OK" in r.stdout, r.stdout[-2000:]
+
+
+@pytest.mark.slow
+def test_perl_imperative_ops(perl_pkg):
+    """Imperative NDArray ops from Perl via MXTPUFuncInvoke: ops are
+    runtime-discovered (list_ops), with operator-overload sugar incl.
+    scalar operands and clear croaks on misuse."""
+    pkg, env = perl_pkg
+    script = r'''
+use blib; use MXNetTPU;
+my $a = MXNetTPU::NDArray->new([2,2])->set_floats([1,2,3,4]);
+my $b = MXNetTPU::NDArray->new([2,2])->set_floats([10,20,30,40]);
+my $s = $a + $b;
+die "add" unless join(",", @{$s->to_floats}) eq "11,22,33,44";
+my $m = MXNetTPU::NDArray->invoke("_mul", [$a, $b]);
+die "mul" unless join(",", @{$m->to_floats}) eq "10,40,90,160";
+my $p = $a + 1;                       # scalar routes to _plus_scalar
+die "plus_scalar" unless join(",", @{$p->to_floats}) eq "2,3,4,5";
+my $r = 10 - $a;                      # swapped scalar -> _rminus_scalar
+die "rminus" unless join(",", @{$r->to_floats}) eq "9,8,7,6";
+eval { my $bad = $a + {}; };
+die "croak" unless $@ =~ /operands must be NDArrays or numbers/;
+die "ops" unless scalar(@{MXNetTPU::list_ops()}) > 100;
+print "PERL_IMPERATIVE_OK\n";
+'''
+    r = subprocess.run(["perl", "-e", script], cwd=pkg, env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (r.stdout + "\n" + r.stderr)[-2000:]
+    assert "PERL_IMPERATIVE_OK" in r.stdout
