@@ -124,6 +124,13 @@ class KVStore:
     def num_workers(self):
         return 1
 
+    def set_gradient_compression(self, compression_params):
+        """Gradient compression is a dist-transport feature (the wire is
+        what it shrinks); local stores reject it like the reference."""
+        raise MXNetError(
+            "gradient compression requires a dist kvstore "
+            f"(this store is {self._kind!r})")
+
     # -- core --------------------------------------------------------------
     @staticmethod
     def _normalize(key, value):
@@ -234,6 +241,11 @@ def _maybe_init_distributed():
 class DistKVStore(KVStore):
     """Multi-host store over JAX collectives (replaces kvstore_dist.h).
 
+    ``set_gradient_compression`` (overridden below) is rejected with a
+    pointer at the PS tier: this path's all-reduce rides ICI/DCN
+    collectives inside XLA, where host-side 2-bit packing has no wire
+    to shrink.
+
     Each host pushes its locally-reduced gradient; cross-host aggregation
     is an all-reduce over DCN/ICI via multihost allgather+sum.  Sync mode
     is inherent (collectives are synchronous across processes); true
@@ -246,6 +258,13 @@ class DistKVStore(KVStore):
         _maybe_init_distributed()
         super().__init__(kind)
         self._nproc = jax.process_count()
+
+    def set_gradient_compression(self, compression_params):
+        raise MXNetError(
+            "gradient compression applies to the parameter-server "
+            "transport; this store aggregates via in-XLA collectives. "
+            "Launch server shards (tools/launch.py -s N) to get the PS "
+            "tier (DistPSKVStore), which supports it")
 
     def init(self, key, value):
         """Rank 0's initial values win everywhere (the reference PS
@@ -325,6 +344,7 @@ class DistPSKVStore(KVStore):
         # stores share the same servers)
         self._sync = "async" not in kind
         self._meta = {}          # key -> (shape, dtype)
+        self._compressor = None  # set_gradient_compression
         # staged pushes: network sends run on the host engine's
         # prioritized lane so the training loop overlaps comm with the
         # rest of backward (reference comm/compute overlap via
@@ -362,6 +382,18 @@ class DistPSKVStore(KVStore):
     def num_workers(self):
         return self._nproc
 
+    def set_gradient_compression(self, compression_params):
+        """2-bit gradient compression with error feedback (the later-
+        MXNet kvstore capability): pushes travel as packed 2-bit codes
+        (16x smaller), the quantization error feeds into the next push.
+        Call BEFORE ``init`` — compressed keys must not stripe."""
+        from .gradcomp import make_compressor
+
+        if self._meta:
+            raise MXNetError(
+                "set_gradient_compression must be called before init")
+        self._compressor = make_compressor(compression_params)
+
     def init(self, key, value):
         all_existed = True
         for k, vs in self._normalize(key, value):
@@ -369,6 +401,10 @@ class DistPSKVStore(KVStore):
                 raise MXNetError(f"key {k!r} already initialized")
             arr = vs[0].asnumpy()
             self._meta[k] = (arr.shape, arr.dtype)
+            if self._compressor is not None:
+                # compressed pushes are whole-key; the weight must live
+                # un-striped on the owner shard
+                self._client.mark_unstriped(k)
             if self._rank == 0 or self._is_recovery:
                 # recovery inits are non-forcing: they must not clobber
                 # trained state on the servers
@@ -406,6 +442,10 @@ class DistPSKVStore(KVStore):
             # asynchronously at the caller's priority so backward keeps
             # running while earlier grads are in flight
             arr = reduced.asnumpy()
+            if self._compressor is not None:
+                # 2-bit + error feedback; the residual update must
+                # happen HERE (in push order), not on the engine thread
+                arr = self._compressor.compress(k, arr)
             kvar = self._key_vars.setdefault(k, self._engine.new_variable())
             self._engine.push(
                 lambda a=arr, kk=k, c=self._client, s=self._sync:

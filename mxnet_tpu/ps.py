@@ -116,6 +116,12 @@ class PSServer:
         """Sync/async is carried per push (per-kvstore, not server-global:
         a server-global flag would let one store's creation silently flip
         the semantics of another live store on the same servers)."""
+        from .gradcomp import decompress_2bit, is_compressed
+
+        if is_compressed(value):
+            # 2-bit compressed gradient (kvstore gradient compression):
+            # expand before merge/apply — the server stores full precision
+            value = decompress_2bit(value)
         with self._cond:
             if sync:
                 acc, count = self._merge.get(key, (None, 0))
@@ -205,8 +211,12 @@ class PSServer:
                     self.store[key] = np.array(value)
             return ("ok", existed)
         if op == "push":
+            from .gradcomp import is_compressed
+
             _, key, value, sync = msg
-            self._handle_push(key, np.asarray(value), sync)
+            if not is_compressed(value):
+                value = np.asarray(value)
+            self._handle_push(key, value, sync)
             return ("ok",)
         if op == "pull":
             with self._lock:
@@ -360,6 +370,7 @@ class ShardedPSClient:
 
     def __init__(self, addrs):
         self.clients = [PSClient(a) for a in addrs]
+        self._no_stripe = set()
 
     def _shard(self, key):
         # stable across processes — builtin hash() is randomized per
@@ -368,9 +379,15 @@ class ShardedPSClient:
         h = zlib.crc32(str(key).encode())
         return self.clients[h % len(self.clients)]
 
+    def mark_unstriped(self, key):
+        """Force whole-key placement on the owner shard (used by
+        gradient compression, whose whole-key payloads must land where
+        the weight lives; call before ``init``)."""
+        self._no_stripe.add(key)
+
     def _stripes(self, key, size):
         n = len(self.clients)
-        if n == 1 or size < BIGARRAY_BOUND:
+        if n == 1 or size < BIGARRAY_BOUND or key in self._no_stripe:
             return None
         bounds = [size * i // n for i in range(n + 1)]
         return [(f"{key}#stripe{i}", bounds[i], bounds[i + 1])
@@ -390,6 +407,13 @@ class ShardedPSClient:
         return existed
 
     def push(self, key, value, sync=False):
+        from .gradcomp import is_compressed
+
+        if is_compressed(value):
+            # compressed payloads are ~16x smaller than the striping
+            # threshold assumed; send whole to the owner shard
+            self._shard(key).request("push", key, value, sync)
+            return
         value = np.asarray(value)
         stripes = self._stripes(key, value.size)
         if stripes is None:

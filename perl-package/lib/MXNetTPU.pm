@@ -115,7 +115,10 @@ use overload
     '-' => sub { _binop('_minus', '_minus_scalar', '_rminus_scalar', @_) },
     '*' => sub { _binop('_mul', '_mul_scalar', '_mul_scalar', @_) },
     'bool' => sub { 1 }, '""' => sub { "MXNetTPU::NDArray(@{[
-        join 'x', @{ $_[0]->shape } ]})" };
+        join 'x', @{ $_[0]->shape } ]})" },
+    # un-overloaded ops (==, etc.) keep their default Perl semantics
+    # (identity compare on the reference) instead of dying
+    fallback => 1;
 
 sub DESTROY {
     my ($self) = @_;
