@@ -172,13 +172,15 @@ def main():
         metric="resnet50_train_throughput", unit="images/sec/chip",
         per_chip_divisor=batch, baseline=BASELINE_IMG_PER_SEC_PER_CHIP,
         extra_fields={"batch_per_chip": batch_per_chip,
-                      "image_hw": image_hw, "layout": layout, "stem": stem})
+                      "image_hw": image_hw, "layout": layout,
+                      "stem": stem},
+        a100_baseline=True)
 
 
 def _train_throughput(jax, np, mx, net, input_shapes, label_classes, dtype,
                       n_warmup, n_iter, on_tpu, n_chips, metric, unit,
                       per_chip_divisor, baseline, extra_fields,
-                      optimizer="sgd",
+                      a100_baseline=False, optimizer="sgd",
                       optimizer_params=None, initializer=None,
                       input_dtypes=None):
     """Shared body of every bench mode: build a dp ShardedTrainer over
@@ -221,6 +223,18 @@ def _train_throughput(jax, np, mx, net, input_shapes, label_classes, dtype,
         "dtype": dtype,
         "platform": "tpu" if on_tpu else jax.devices()[0].platform,
     }
+    # chip-fairness companion ratio: the resnet/gpt baselines are
+    # A100-class measurements (312 TF/s bf16 peak); normalizing by each
+    # chip's peak compares IMPLEMENTATION efficiency rather than silicon
+    # size (v5e peak = 197 TF/s)
+    if on_tpu and a100_baseline:
+        from mxnet_tpu.flops import peak_flops_per_chip
+
+        peak = peak_flops_per_chip()
+        if peak:
+            result["vs_baseline_per_peak_tflop"] = round(
+                (value_per_chip / baseline) * (312e12 / peak), 4)
+            result["baseline_chip_peak_tflops"] = 312.0
     result.update(extra_fields)
     result.update(_mfu_fields(net, {"data": (1,) + tuple(data_shape[1:])},
                               batch, n_iter, dt, n_chips,
@@ -386,6 +400,7 @@ def bench_gpt(jax, np, mx, on_tpu, n_chips):
         extra_fields={"batch": batch, "seq_len": seq_len,
                       "d_model": d_model, "n_layers": n_layers,
                       "fused_qkv": fused_qkv},
+        a100_baseline=True,
         optimizer="adam", optimizer_params={"learning_rate": 3e-4},
         initializer=mx.initializer.Xavier(),
         # int32 ids: the bf16 compute dtype must not touch token inputs
