@@ -383,10 +383,12 @@ class DistPSKVStore(KVStore):
         return self._nproc
 
     def set_gradient_compression(self, compression_params):
-        """2-bit gradient compression with error feedback (the later-
-        MXNet kvstore capability): pushes travel as packed 2-bit codes
-        (16x smaller), the quantization error feeds into the next push.
-        Call BEFORE ``init`` — compressed keys must not stripe."""
+        """Gradient compression with error feedback (the later-MXNet
+        kvstore capability): ``{"type": "2bit", "threshold": t}`` sends
+        packed 2-bit codes (16x smaller wire), ``{"type": "1bit"}``
+        sends signs with one adaptive scale (32x); either way the
+        quantization error feeds into the next push.  Call BEFORE
+        ``init`` — compressed keys must not stripe."""
         from .gradcomp import make_compressor
 
         if self._meta:
@@ -443,7 +445,7 @@ class DistPSKVStore(KVStore):
             # running while earlier grads are in flight
             arr = reduced.asnumpy()
             if self._compressor is not None:
-                # 2-bit + error feedback; the residual update must
+                # 1/2-bit + error feedback; the residual update must
                 # happen HERE (in push order), not on the engine thread
                 arr = self._compressor.compress(k, arr)
             kvar = self._key_vars.setdefault(k, self._engine.new_variable())

@@ -116,12 +116,13 @@ class PSServer:
         """Sync/async is carried per push (per-kvstore, not server-global:
         a server-global flag would let one store's creation silently flip
         the semantics of another live store on the same servers)."""
-        from .gradcomp import decompress_2bit, is_compressed
+        from .gradcomp import decompress, is_compressed
 
         if is_compressed(value):
-            # 2-bit compressed gradient (kvstore gradient compression):
-            # expand before merge/apply — the server stores full precision
-            value = decompress_2bit(value)
+            # compressed gradient (kvstore gradient compression, 1- or
+            # 2-bit by wire tag): expand before merge/apply — the server
+            # stores full precision
+            value = decompress(value)
         with self._cond:
             if sync:
                 acc, count = self._merge.get(key, (None, 0))

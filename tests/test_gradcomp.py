@@ -49,9 +49,11 @@ def test_make_compressor_contract():
     c = make_compressor({"type": "2bit", "threshold": 0.25})
     assert isinstance(c, TwoBitCompressor) and c.threshold == 0.25
     with pytest.raises(ValueError):
-        make_compressor({"type": "1bit"})
+        make_compressor({"type": "4bit"})
     with pytest.raises(ValueError):
         make_compressor({"type": "2bit", "threshold": 0.0})
+    with pytest.raises(ValueError):
+        make_compressor({"threshold": 0.5})  # missing type
 
 
 def test_local_kvstore_rejects_compression():
@@ -180,3 +182,46 @@ def test_big_key_unstriped_across_shards():
         del os.environ["MXTPU_PS_ADDRS"]
         for s in servers:
             s.stop()
+
+
+def test_1bit_roundtrip_and_convergence():
+    """1-bit sign compression (32x wire): roundtrip, error feedback,
+    and end-to-end convergence through the PS."""
+    import os
+
+    from mxnet_tpu.gradcomp import (OneBitCompressor, compress_1bit,
+                                    decompress_1bit)
+
+    g = np.array([0.9, -0.3, 0.0, 2.0], np.float32)
+    payload, residual = compress_1bit(g)
+    deq = decompress_1bit(payload)
+    s = np.mean(np.abs(g))
+    np.testing.assert_allclose(deq, [s, -s, s, s], rtol=1e-6)
+    np.testing.assert_allclose(deq + residual, g, atol=1e-6)
+
+    comp = make_compressor({"type": "1bit"})
+    assert isinstance(comp, OneBitCompressor)
+    with pytest.raises(ValueError):
+        make_compressor({"type": "1bit", "threshold": 0.5})
+
+    server = PSServer(num_workers=1).start()
+    os.environ["MXTPU_PS_ADDRS"] = server.addr
+    os.environ["MXTPU_NUM_PROCS"] = "1"
+    try:
+        kv = mx.kv.create("dist_async")
+        kv.set_gradient_compression({"type": "1bit"})
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+        rng = np.random.RandomState(3)
+        w_true = rng.randn(8).astype(np.float32)
+        w = mx.nd.array(np.zeros(8, np.float32))
+        kv.init("w", w)
+        for step in range(400):
+            X = rng.randn(16, 8).astype(np.float32)
+            grad = 2.0 * X.T @ (X @ w.asnumpy() - X @ w_true) / 16
+            kv.push("w", mx.nd.array(grad))
+            kv.pull("w", out=w)
+        err = np.linalg.norm(w.asnumpy() - w_true) / np.linalg.norm(w_true)
+        assert err < 0.25, err
+    finally:
+        del os.environ["MXTPU_PS_ADDRS"]
+        server.stop()
