@@ -114,39 +114,44 @@ def decompress(payload):
     return decompress_1bit(payload)
 
 
-class TwoBitCompressor:
-    """Stateful per-key compressor: keeps the error-feedback residual."""
+class _ErrorFeedbackCompressor:
+    """Shared per-key error-feedback flow: residual joins the next
+    gradient, the codec hook quantizes, the new residual is stashed."""
 
-    def __init__(self, threshold=0.5):
-        if threshold <= 0:
-            raise ValueError("2bit threshold must be positive")
-        self.threshold = float(threshold)
+    def __init__(self):
         self._residual = {}
+
+    def _quantize(self, grad):
+        raise NotImplementedError
 
     def compress(self, key, grad):
         grad = np.asarray(grad, np.float32)
         res = self._residual.get(key)
         if res is not None:
             grad = grad + res
-        payload, residual = compress_2bit(grad, self.threshold)
+        payload, residual = self._quantize(grad)
         self._residual[key] = residual
         return payload
 
 
-class OneBitCompressor:
-    """Stateful per-key 1-bit compressor with error feedback."""
+class TwoBitCompressor(_ErrorFeedbackCompressor):
+    """2-bit codec: {-threshold, 0, +threshold} per element."""
 
-    def __init__(self):
-        self._residual = {}
+    def __init__(self, threshold=0.5):
+        if threshold <= 0:
+            raise ValueError("2bit threshold must be positive")
+        super().__init__()
+        self.threshold = float(threshold)
 
-    def compress(self, key, grad):
-        grad = np.asarray(grad, np.float32)
-        prev = self._residual.get(key)
-        if prev is not None:
-            grad = grad + prev
-        payload, residual = compress_1bit(grad)
-        self._residual[key] = residual
-        return payload
+    def _quantize(self, grad):
+        return compress_2bit(grad, self.threshold)
+
+
+class OneBitCompressor(_ErrorFeedbackCompressor):
+    """1-bit codec: sign * adaptive per-push scale."""
+
+    def _quantize(self, grad):
+        return compress_1bit(grad)
 
 
 def make_compressor(params):

@@ -303,7 +303,7 @@ def test_example_rl_ddpg_runs(capsys):
 
 
 @pytest.mark.parametrize("name", ["tutorial", "composite_symbol",
-                                  "simple_bind"])
+                                  "simple_bind", "quantization"])
 def test_notebook_executes(name):
     """Tutorial notebooks (reference example/notebooks/) must execute
     top to bottom: every code cell runs in one shared namespace."""
