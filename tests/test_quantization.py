@@ -2,6 +2,8 @@
 ops/quantized.py — beyond the 2016 reference; the contrib/quantize.py
 capability of later MXNet, rebuilt TPU-native)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -236,3 +238,27 @@ def test_tap_resolves_ambiguous_output_names():
     for calib in (None, [X]):
         qsym, qargs, _ = quantize_model(net, args, calib_data=calib)
         assert qargs["fcs_weight"].dtype == np.int8
+
+
+def test_quantize_cli_tool(tmp_path):
+    """tools/quantize.py round-trips a trained checkpoint to an int8
+    pair loadable through the standard loaders."""
+    import subprocess
+    import sys as _sys
+
+    net, args_p, aux_p, X, y, probs_f = _trained_mlp()
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 3, net, args_p, aux_p)
+    out = str(tmp_path / "m_int8")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [_sys.executable, os.path.join(repo, "tools", "quantize.py"),
+         "--prefix", prefix, "--epoch", "3", "--out", out],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "MXTPU_PLATFORMS": "cpu"})
+    assert r.returncode == 0, (r.stdout + "\n" + r.stderr)[-2000:]
+    assert "quantized 2 layers" in r.stdout
+    sym2, args2, aux2 = mx.model.load_checkpoint(out, 0)
+    assert args2["fc1_weight"].dtype == np.int8
+    _, probs_q = _run_quantized(sym2, args2, X)
+    assert (probs_q.argmax(1) == probs_f.argmax(1)).mean() > 0.98

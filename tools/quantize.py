@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Quantize a trained two-artifact checkpoint to int8.
+
+CLI over mxnet_tpu.contrib.quantization for deployment pipelines:
+
+  python tools/quantize.py --prefix model --epoch 12 --out model_int8 \
+         [--calib-rec data.rec --calib-batches 5 --batch-size 64] \
+         [--exclude conv0,fc_last] [--data-shape 3,224,224]
+
+Reads ``<prefix>-symbol.json`` + ``<prefix>-%04d.params``, writes the
+quantized pair under ``--out`` (epoch 0).  With ``--calib-rec`` (a
+RecordIO dataset readable by ImageRecordIter) activation scales are
+calibrated on real batches for full-int8 contractions; without it the
+weight-only path is used.
+"""
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--prefix", required=True)
+    p.add_argument("--epoch", type=int, required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--exclude", default="",
+                   help="comma-separated layer names to keep in float")
+    p.add_argument("--calib-rec", default=None,
+                   help="RecordIO file for activation calibration")
+    p.add_argument("--calib-batches", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--data-shape", default="3,224,224")
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib.quantization import quantize_model
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.prefix, args.epoch)
+
+    calib = None
+    if args.calib_rec:
+        shape = tuple(int(x) for x in args.data_shape.split(","))
+        it = mx.io.ImageRecordIter(
+            path_imgrec=args.calib_rec, data_shape=shape,
+            batch_size=args.batch_size)
+        calib = it
+    exclude = tuple(x.strip() for x in args.exclude.split(",")
+                if x.strip())
+
+    qsym, qargs, qaux = quantize_model(
+        sym, arg_params, aux_params, calib_data=calib,
+        num_calib_batches=args.calib_batches, exclude=exclude)
+
+    n_int8 = sum(1 for v in qargs.values() if v.dtype == np.int8)
+    before = sum(int(np.prod(v.shape)) * 4 for v in arg_params.values())
+    after = sum(int(np.prod(v.shape)) * (1 if v.dtype == np.int8 else 4)
+                for v in qargs.values())
+    print(f"quantized {n_int8} layers; params "
+          f"{before / 1e6:.1f} MB -> {after / 1e6:.1f} MB")
+
+    mx.model.save_checkpoint(args.out, 0, qsym, qargs, qaux)
+    print(f"saved {args.out}-symbol.json / {args.out}-0000.params")
+
+
+if __name__ == "__main__":
+    main()
