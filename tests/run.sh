@@ -1,0 +1,29 @@
+#!/bin/sh
+# CI lanes (the reference tests/travis/run_test.sh + nightly/test_all.sh
+# analog).  Usage: tests/run.sh [fast|slow|native|perl|tpu|all]
+#
+#   fast    default `pytest tests/` tier (< 5 min; unittest bucket)
+#   slow    full tier incl. example smokes, dist launchers, sanitizers
+#   native  C/C++ surface only (C ABI consumers, engine stress, TSAN/ASAN)
+#   perl    the Perl frontend lane
+#   tpu     cpu-vs-tpu consistency gate (needs the chip)
+#   all     fast + slow
+set -e
+cd "$(dirname "$0")/.."
+
+lane="${1:-fast}"
+case "$lane" in
+  fast)
+    python -m pytest tests/ -q ;;
+  slow|all)
+    RUN_SLOW=1 python -m pytest tests/ -q ;;
+  native)
+    python -m pytest tests/test_native.py -q --runslow ;;
+  perl)
+    python -m pytest tests/test_perl_frontend.py -q --runslow ;;
+  tpu)
+    MXTPU_TPU_TESTS=1 python -m pytest tests/test_tpu_consistency.py -q ;;
+  *)
+    echo "unknown lane: $lane (fast|slow|native|perl|tpu|all)" >&2
+    exit 2 ;;
+esac

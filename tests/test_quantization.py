@@ -262,3 +262,55 @@ def test_quantize_cli_tool(tmp_path):
     assert args2["fc1_weight"].dtype == np.int8
     _, probs_q = _run_quantized(sym2, args2, X)
     assert (probs_q.argmax(1) == probs_f.argmax(1)).mean() > 0.98
+
+
+def test_quantize_cli_calibrated_rec(tmp_path):
+    """The --calib-rec path: a RecordIO dataset drives activation
+    calibration with training-matched preprocessing, and act_scale
+    lands in the output symbol."""
+    import json
+    import subprocess
+    import sys as _sys
+
+    from mxnet_tpu import recordio
+
+    pytest.importorskip("cv2")
+    rng = np.random.RandomState(7)
+    rec_path = str(tmp_path / "calib.rec")
+    writer = recordio.MXRecordIO(rec_path, "w")
+    for i in range(8):
+        img = rng.randint(0, 255, (12, 12, 3), dtype=np.uint8)
+        writer.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i % 2), i, 0), img, quality=95))
+    writer.close()
+
+    net = mx.sym.Convolution(mx.sym.Variable("data"), kernel=(3, 3),
+                             num_filter=4, pad=(1, 1), name="c1")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=2,
+                                name="f1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    shapes = dict(zip(net.list_arguments(),
+                      net.infer_shape(data=(4, 3, 12, 12))[0]))
+    args_p = {n: mx.nd.array(
+        np.random.RandomState(8).randn(*shapes[n]).astype(np.float32) * 0.1)
+        for n in shapes if n not in ("data", "softmax_label")}
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 1, net, args_p, {})
+
+    out = str(tmp_path / "m_int8")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [_sys.executable, os.path.join(repo, "tools", "quantize.py"),
+         "--prefix", prefix, "--epoch", "1", "--out", out,
+         "--calib-rec", rec_path, "--batch-size", "4",
+         "--data-shape", "3,12,12", "--scale", str(1.0 / 255)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "MXTPU_PLATFORMS": "cpu"})
+    assert r.returncode == 0, (r.stdout + "\n" + r.stderr)[-2000:]
+    conf = json.loads(open(out + "-symbol.json").read())
+    scales = [float(n["param"]["act_scale"]) for n in conf["nodes"]
+              if n["op"].startswith("Quantized")]
+    assert scales and all(s > 0 for s in scales), scales
+    # preprocessing applied: calibrated input scale reflects /255 pixels
+    first = min(scales)
+    assert first < 1.0, scales  # raw 0-255 calibration would be >> 1

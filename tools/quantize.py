@@ -34,6 +34,14 @@ def main(argv=None):
     p.add_argument("--calib-batches", type=int, default=5)
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--data-shape", default="3,224,224")
+    p.add_argument("--mean-r", type=float, default=0.0)
+    p.add_argument("--mean-g", type=float, default=0.0)
+    p.add_argument("--mean-b", type=float, default=0.0)
+    p.add_argument("--mean-img", default=None)
+    p.add_argument("--scale", type=float, default=1.0,
+                   help="pixel scale applied AFTER mean subtraction; "
+                        "MUST match training preprocessing or the "
+                        "calibrated activation scales are wrong")
     args = p.parse_args(argv)
 
     import numpy as np
@@ -47,10 +55,11 @@ def main(argv=None):
     calib = None
     if args.calib_rec:
         shape = tuple(int(x) for x in args.data_shape.split(","))
-        it = mx.io.ImageRecordIter(
+        calib = mx.io.ImageRecordIter(
             path_imgrec=args.calib_rec, data_shape=shape,
-            batch_size=args.batch_size)
-        calib = it
+            batch_size=args.batch_size, mean_img=args.mean_img,
+            mean_r=args.mean_r, mean_g=args.mean_g, mean_b=args.mean_b,
+            scale=args.scale)
     exclude = tuple(x.strip() for x in args.exclude.split(",")
                 if x.strip())
 
@@ -59,8 +68,9 @@ def main(argv=None):
         num_calib_batches=args.calib_batches, exclude=exclude)
 
     n_int8 = sum(1 for v in qargs.values() if v.dtype == np.int8)
-    before = sum(int(np.prod(v.shape)) * 4 for v in arg_params.values())
-    after = sum(int(np.prod(v.shape)) * (1 if v.dtype == np.int8 else 4)
+    before = sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                 for v in arg_params.values())
+    after = sum(int(np.prod(v.shape)) * v.dtype.itemsize
                 for v in qargs.values())
     print(f"quantized {n_int8} layers; params "
           f"{before / 1e6:.1f} MB -> {after / 1e6:.1f} MB")
