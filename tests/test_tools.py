@@ -168,3 +168,43 @@ def test_native_im2rec_roundtrip(tmp_path):
     assert len(batches) == 2
     np.testing.assert_allclose(batches[0].label[0].asnumpy(),
                                [0, 1, 2, 3, 0])
+
+
+def test_compare_baseline_table(tmp_path):
+    """tools/compare_baseline.py renders whatever artifact subset
+    exists into one markdown table."""
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # synthetic artifact set in an isolated dir
+    (tmp_path / "BENCH_TPU_LATEST.json").write_text(json.dumps({
+        "metric": "resnet50_train_throughput", "value": 2845.0,
+        "unit": "images/sec/chip", "vs_baseline": 1.138,
+        "platform": "tpu", "mfu": 0.358,
+        "vs_baseline_per_peak_tflop": 1.80}))
+    (tmp_path / "IO_BENCH.json").write_text(json.dumps({
+        "metric": "image_pipeline_throughput", "value": 539.5,
+        "vs_baseline_per_core": 2.158, "host_cores": 1}))
+    # bench_watch writes these artifacts as INDENTED multi-line JSON —
+    # the loader must accept that format, not just one-liners
+    (tmp_path / "QUANT_BENCH.json").write_text(json.dumps({
+        "metric": "resnet50_int8_inference", "platform": "tpu",
+        "int8_img_per_sec": 5200.0, "int8_speedup": 1.9}, indent=1))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "compare_baseline.py"),
+         "--repo", str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "1.138x" in r.stdout and "1.80x per peak TFLOP" in r.stdout
+    assert "2.16x/core" in r.stdout
+    assert "1.90x" in r.stdout  # the indented QUANT artifact parsed
+    # empty dir renders the placeholder row, still exit 0
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "compare_baseline.py"),
+         "--repo", str(empty)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0 and "no TPU artifacts" in r.stdout
