@@ -11,6 +11,7 @@ from __future__ import annotations
 import threading
 
 import jax
+import numpy as np
 
 __all__ = ["seed", "next_key", "uniform", "normal"]
 
@@ -24,8 +25,15 @@ def _get_key():
 
 
 def seed(seed_state: int):
-    """Seed the global random number chain (parity: mx.random.seed)."""
+    """Seed every framework RNG.  PROCESS-GLOBAL like the reference
+    (mx.random.seed seeds the global mshadow RNGs its initializers draw
+    from): covers this thread's JAX key chain (imperative samplers,
+    executor/trainer key forks) AND numpy's process-wide generator (the
+    initializer zoo), so one call makes init + training reproducible.
+    Threads wanting independent chains should seed with distinct values
+    and not interleave initializer construction."""
     _state.key = jax.random.PRNGKey(int(seed_state))
+    np.random.seed(int(seed_state) & 0xFFFFFFFF)
 
 
 def next_key():

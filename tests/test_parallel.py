@@ -424,3 +424,35 @@ def test_trainer_clip_by_global_norm_trains():
     # distributed over the tree: ||delta||_2 == lr * 1e-3
     delta = np.sqrt(sum(((p1[k] - p0[k]) ** 2).sum() for k in p0))
     np.testing.assert_allclose(delta, 0.5 * 1e-3, rtol=1e-4)
+
+
+def test_sharded_trainer_deterministic_replay():
+    """Two trainers built with the same seed must produce BITWISE
+    identical parameters after the same batch sequence — the engine
+    suite's deterministic-replay property (SURVEY §5 race detection)
+    applied to the modern sharded path, with dropout RNG in the graph."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 10).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+
+    def run():
+        mx.random.seed(11)
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                    num_hidden=16, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.Dropout(net, p=0.3)   # RNG rides the step chain
+        net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        tr = mx.parallel.ShardedTrainer(
+            net, {"data": (32, 10), "softmax_label": (32,)},
+            mesh=mx.parallel.local_mesh("dp"), optimizer="adam",
+            optimizer_params={"learning_rate": 1e-2},
+            initializer=mx.initializer.Xavier())
+        for _ in range(5):
+            tr.step({"data": X, "softmax_label": y})
+        return {k: np.asarray(v) for k, v in tr.get_params().items()}
+
+    p1, p2 = run(), run()
+    assert p1.keys() == p2.keys()
+    for k in p1:
+        np.testing.assert_array_equal(p1[k], p2[k], err_msg=k)
