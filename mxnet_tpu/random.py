@@ -34,6 +34,11 @@ def seed(seed_state: int):
     and not interleave initializer construction."""
     _state.key = jax.random.PRNGKey(int(seed_state))
     np.random.seed(int(seed_state) & 0xFFFFFFFF)
+    # per-context RandomResource chains (mx.resource.request("random"))
+    # reseed too — the reference's MXRandomSeed hits exactly those
+    from . import resource as _resource
+
+    _resource.seed(seed_state)
 
 
 def next_key():

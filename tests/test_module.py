@@ -253,3 +253,31 @@ def test_executor_group_replicated_input_grads_sum():
     np.testing.assert_allclose(gs.asnumpy(), np.full(5, x.sum()), rtol=1e-5)
     np.testing.assert_allclose(gd.asnumpy(), np.full((8, 3), s.sum()),
                                rtol=1e-5)
+
+
+def test_module_deterministic_replay():
+    """Same seed -> bitwise-identical fitted params through the Module
+    path (shuffled NDArrayIter + dropout + Xavier init all ride
+    mx.random.seed)."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(64, 12).astype(np.float32)
+    y = (X[:, 1] > 0).astype(np.float32)
+
+    def run():
+        mx.random.seed(21)
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                    num_hidden=8, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.Dropout(net, p=0.25)
+        net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu())
+        mod.fit(mx.io.NDArrayIter(X, y, 16, shuffle=True), num_epoch=3,
+                initializer=mx.initializer.Xavier(),
+                optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}
+
+    p1, p2 = run(), run()
+    for k in p1:
+        np.testing.assert_array_equal(p1[k], p2[k], err_msg=k)

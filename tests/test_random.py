@@ -76,3 +76,21 @@ def test_sample_op_via_ndarray_function():
     arr = u.asnumpy()
     assert arr.min() >= 2.0 and arr.max() <= 4.0
     assert abs(arr.mean() - 3.0) < 0.05
+
+
+def test_seed_covers_resource_random():
+    """mx.random.seed reseeds the per-context RandomResource chains
+    (reference MXRandomSeed parity)."""
+    import mxnet_tpu.resource as resource
+
+    def draw():
+        r = resource.request("random")
+        return np.asarray(mx.nd.NDArray(
+            __import__("jax").random.uniform(r.next_key(), (4,)),
+            mx.cpu()).asnumpy())
+
+    mx.random.seed(5)
+    a = draw()
+    mx.random.seed(5)
+    b = draw()
+    np.testing.assert_array_equal(a, b)
