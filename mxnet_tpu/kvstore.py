@@ -331,13 +331,15 @@ class DistPSKVStore(KVStore):
         from .ps import ShardedPSClient
 
         super().__init__(kind)
-        self._client = ShardedPSClient(addrs.split(","))
-        self._rank = int(os.environ.get("MXTPU_PROC_ID", "0"))
-        self._nproc = int(os.environ.get("MXTPU_NUM_PROCS", "1"))
         # restarted workers skip startup barriers (reference ps-lite
         # is_recovery, kvstore_dist.h:35-38) — the surviving peers are
-        # already past them
+        # already past them; their client must REPLAY those rounds as
+        # no-ops (no creation-time alignment) until push() resyncs
         self._is_recovery = bool(os.environ.get("MXTPU_IS_RECOVERY"))
+        self._client = ShardedPSClient(addrs.split(","),
+                                       align_barriers=not self._is_recovery)
+        self._rank = int(os.environ.get("MXTPU_PROC_ID", "0"))
+        self._nproc = int(os.environ.get("MXTPU_NUM_PROCS", "1"))
         self._client.hello(self._rank)
         # per-push sync flag (reference sends a server-global kSyncMode
         # command, kvstore.cc:29-38; per-push is strictly safer when two

@@ -369,9 +369,20 @@ class ShardedPSClient:
     arrays over BIGARRAY_BOUND elements are striped evenly across all
     servers so no shard holds the whole tensor."""
 
-    def __init__(self, addrs):
+    def __init__(self, addrs, align_barriers=True):
         self.clients = [PSClient(a) for a in addrs]
         self._no_stripe = set()
+        # A SECOND store on the same servers must not replay barrier
+        # rounds earlier stores already released: ordinals restart at 0
+        # per connection while the server's round counter is global, so
+        # every barrier of the new store would look already-released
+        # and silently no-op (racing its init/push/pull ordering).
+        # Start from each server's current counter instead.  RECOVERY
+        # clients opt out (align_barriers=False): they must replay the
+        # startup rounds their previous life passed as instant no-ops
+        # and call resync_barrier() themselves once replay is done.
+        if align_barriers:
+            self.resync_barrier()
 
     def _shard(self, key):
         # stable across processes — builtin hash() is randomized per

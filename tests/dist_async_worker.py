@@ -53,6 +53,20 @@ def main():
     expect2 = -float(sum(r + 1 for r in range(nworker)))
     assert np.allclose(out2.asnumpy(), expect2), (rank, out2.asnumpy()[0, 0])
 
+    # gradient-compression leg: 2-bit pushes decompress exactly at the
+    # server when every element sits on the quantization grid
+    kv3 = mx.kv.create("dist_async")
+    kv3.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    kv3.set_optimizer(mx.optimizer.SGD(learning_rate=1.0,
+                                       rescale_grad=1.0))
+    kv3.init("c", mx.nd.zeros(shape))
+    kv3.push("c", mx.nd.ones(shape))   # transmits exactly +1.0 per elem
+    kv3.barrier()
+    out3 = mx.nd.zeros(shape)
+    kv3.pull("c", out=out3)
+    assert np.allclose(out3.asnumpy(), -float(nworker)), \
+        (rank, out3.asnumpy()[0, 0])
+
     print(f"RANK_{rank}_PS_OK", flush=True)
 
 

@@ -15,7 +15,8 @@ from mxnet_tpu.ps import BIGARRAY_BOUND, PSClient, PSServer, ShardedPSClient
 
 def _start(num_workers, n_servers=1):
     servers = [PSServer(num_workers).start() for _ in range(n_servers)]
-    client_of = lambda: ShardedPSClient([s.addr for s in servers])
+    client_of = lambda **kw: ShardedPSClient(
+        [s.addr for s in servers], **kw)
     return servers, client_of
 
 
@@ -318,9 +319,10 @@ def test_barrier_resync_after_midtraining_crash():
             c0.barrier()
             t.join(timeout=10)
             assert done
-        # rank 1 crashes and restarts: new connection, replays its single
-        # startup barrier (instant no-op), then resyncs
-        c1b = mk()
+        # rank 1 crashes and restarts: a RECOVERY connection (no
+        # creation-time alignment) replays its single startup barrier
+        # (instant no-op), then resyncs
+        c1b = mk(align_barriers=False)
         c1b.hello(1)
         c1b.barrier()          # replayed startup round: instant
         c1b.resync_barrier()   # align with released-round counter
