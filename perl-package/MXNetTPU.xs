@@ -225,6 +225,100 @@ mxpl_func_invoke(const char* op, SV* inputs, SV* keys, SV* vals)
   OUTPUT:
     RETVAL
 
+# ---- Predict mini-API ------------------------------------------------------
+
+IV
+mxpl_pred_create(SV* symbol_json, SV* param_bytes, SV* input_names, SV* input_shapes, int dev_type, int dev_id)
+  PREINIT:
+    const char* names[MXPL_MAX];
+    uint32_t indptr[MXPL_MAX + 1];
+    uint32_t flat[MXPL_MAX * MXTPU_MAX_NDIM];
+    int nk, i, nflat;
+    AV* shp_av;
+    STRLEN blob_len;
+    const char* blob;
+    PredictorHandle h;
+  CODE:
+    nk = av_to_strs(aTHX_ input_names, names, MXPL_MAX, "input_names");
+    if (!SvROK(input_shapes) || SvTYPE(SvRV(input_shapes)) != SVt_PVAV)
+      croak("MXNetTPU: input_shapes must be an ARRAY ref of ARRAY refs");
+    shp_av = (AV*)SvRV(input_shapes);
+    if (av_len(shp_av) + 1 != nk)
+      croak("MXNetTPU: input_names/input_shapes length mismatch");
+    indptr[0] = 0;
+    nflat = 0;
+    for (i = 0; i < nk; ++i) {
+      SV** e = av_fetch(shp_av, i, 0);
+      if (!e) croak("MXNetTPU: missing shape %d", i);
+      nflat += av_to_u32(aTHX_ *e, flat + nflat, MXTPU_MAX_NDIM,
+                         "shape entry");
+      indptr[i + 1] = (uint32_t)nflat;
+    }
+    blob = SvPV(param_bytes, blob_len);
+    CHK(MXTPUPredCreate(SvPV_nolen(symbol_json), blob,
+                        (uint64_t)blob_len, dev_type, dev_id,
+                        (uint32_t)nk, names, indptr, flat, &h));
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+void
+mxpl_pred_set_input(IV h, const char* key, SV* floats_packed)
+  PREINIT:
+    STRLEN len;
+    const char* p;
+  CODE:
+    p = SvPV(floats_packed, len);
+    CHK(MXTPUPredSetInput(INT2PTR(PredictorHandle, h), key,
+                          (const float*)p, (uint32_t)(len / 4)));
+
+void
+mxpl_pred_forward(IV h)
+  CODE:
+    CHK(MXTPUPredForward(INT2PTR(PredictorHandle, h)));
+
+SV*
+mxpl_pred_output_shape(IV h, UV index)
+  PREINIT:
+    uint32_t ndim, shape[MXTPU_MAX_NDIM];
+    AV* av;
+    uint32_t i;
+  CODE:
+    CHK(MXTPUPredGetOutputShape(INT2PTR(PredictorHandle, h),
+                                (uint32_t)index, NULL, &ndim));
+    if (ndim > MXTPU_MAX_NDIM)
+      croak("MXNetTPU: output ndim %u exceeds MXTPU_MAX_NDIM", ndim);
+    CHK(MXTPUPredGetOutputShape(INT2PTR(PredictorHandle, h),
+                                (uint32_t)index, shape, &ndim));
+    av = newAV();
+    for (i = 0; i < ndim; ++i)
+      av_push(av, newSVuv(shape[i]));
+    RETVAL = newRV_noinc((SV*)av);
+  OUTPUT:
+    RETVAL
+
+SV*
+mxpl_pred_output(IV h, UV index, UV n_floats)
+  PREINIT:
+    SV* out;
+    char* p;
+  CODE:
+    out = newSV(n_floats * 4 + 1);
+    SvPOK_on(out);
+    p = SvPVX(out);
+    CHK(MXTPUPredGetOutput(INT2PTR(PredictorHandle, h), (uint32_t)index,
+                           (float*)p, (uint32_t)n_floats));
+    p[n_floats * 4] = '\0';
+    SvCUR_set(out, n_floats * 4);
+    RETVAL = out;
+  OUTPUT:
+    RETVAL
+
+void
+mxpl_pred_free(IV h)
+  CODE:
+    CHK(MXTPUPredFree(INT2PTR(PredictorHandle, h)));
+
 # ---- Symbol --------------------------------------------------------------
 
 IV

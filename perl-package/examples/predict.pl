@@ -1,0 +1,28 @@
+#!/usr/bin/perl
+# Serve a trained two-artifact checkpoint from Perl through the predict
+# mini-API (MXTPUPred*) — train anywhere, deploy from Perl.
+#
+# Usage: predict.pl <prefix> <epoch> <input_name> <d0,d1,...> < floats.txt
+# Reads whitespace-separated floats for one batch on stdin, prints the
+# first output row.
+
+use strict;
+use warnings;
+use FindBin;
+use lib "$FindBin::Bin/../lib", "$FindBin::Bin/../blib/lib",
+    "$FindBin::Bin/../blib/arch";
+
+use MXNetTPU;
+
+my ($prefix, $epoch, $name, $shape_s) = @ARGV;
+die "usage: $0 prefix epoch input_name d0,d1,...\n" unless defined $shape_s;
+my @shape = map { 0 + $_ } split /,/, $shape_s;
+
+my $p = MXNetTPU::Predictor->from_checkpoint($prefix, $epoch,
+                                             { $name => \@shape });
+my @x = map { 0 + $_ } split " ", do { local $/; <STDIN> };
+my ($probs, $oshape) = $p->predict($name => \@x);
+my $row = $oshape->[-1] // scalar @$probs;
+print "output shape: @$oshape\n";
+print "row 0: @{$probs}[0 .. $row - 1]\n";
+print "PERL_PREDICT_OK\n";

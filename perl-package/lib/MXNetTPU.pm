@@ -267,6 +267,66 @@ sub DESTROY {
 }
 
 # ---------------------------------------------------------------------------
+package MXNetTPU::Predictor;
+
+use strict;
+use warnings;
+
+# Serving surface over the predict mini-API (MXTPUPred*): load a
+# two-artifact checkpoint (train it in any frontend) and run forward —
+# the classic cross-language deployment flow.
+#   my $p = MXNetTPU::Predictor->new($json, $param_blob,
+#                                    { data => [1, 784] });
+#   my $probs = $p->predict(data => \@floats);
+sub new {
+    my ($class, $symbol_json, $param_bytes, $input_shapes, %opt) = @_;
+    my (@names, @shapes);
+    for my $k (sort keys %$input_shapes) {
+        push @names, $k;
+        push @shapes, $input_shapes->{$k};
+    }
+    my $h = MXNetTPU::pred_create($symbol_json, $param_bytes,
+                                  \@names, \@shapes,
+                                  $opt{dev_type} // 1, $opt{dev_id} // 0);
+    return bless { h => $h }, $class;
+}
+
+sub from_checkpoint {
+    my ($class, $prefix, $epoch, $input_shapes, %opt) = @_;
+    my $json = do {
+        open my $f, "<", "$prefix-symbol.json" or die "open: $!";
+        local $/; <$f>;
+    };
+    my $blob = do {
+        open my $f, "<:raw", sprintf("%s-%04d.params", $prefix, $epoch)
+            or die "open: $!";
+        local $/; <$f>;
+    };
+    return $class->new($json, $blob, $input_shapes, %opt);
+}
+
+sub predict {
+    my ($self, %inputs) = @_;
+    for my $k (sort keys %inputs) {
+        MXNetTPU::pred_set_input($self->{h}, $k,
+                                 pack('f*', @{ $inputs{$k} }));
+    }
+    MXNetTPU::pred_forward($self->{h});
+    my $shape = MXNetTPU::pred_output_shape($self->{h}, 0);
+    my $n = 1;
+    $n *= $_ for @$shape;
+    my $bytes = MXNetTPU::pred_output($self->{h}, 0, $n);
+    return (wantarray ? ([ unpack('f*', $bytes) ], $shape)
+            : [ unpack('f*', $bytes) ]);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    MXNetTPU::pred_free($self->{h}) if $self->{h};
+    $self->{h} = 0;
+}
+
+# ---------------------------------------------------------------------------
 package MXNetTPU::KVStore;
 
 use strict;

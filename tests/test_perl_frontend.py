@@ -97,3 +97,43 @@ print "PERL_IMPERATIVE_OK\n";
                        capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, (r.stdout + "\n" + r.stderr)[-2000:]
     assert "PERL_IMPERATIVE_OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_perl_predict_serves_python_checkpoint(perl_pkg, tmp_path):
+    """Cross-language serving: a checkpoint trained in Python loads and
+    predicts from Perl through the predict mini-API, matching the
+    Python predictor's outputs."""
+    pkg, env = perl_pkg
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(4)
+    X = rng.randn(16, 6).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fc"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(mx.io.NDArrayIter(X, y, 8), num_epoch=4,
+            initializer=mx.initializer.Xavier())
+    args, aux = mod.get_params()
+    prefix = str(tmp_path / "ck")
+    mx.model.save_checkpoint(prefix, 1, net, args, aux)
+    ref = mx.predict.create(
+        net.tojson(), {"arg:" + k: v for k, v in args.items()},
+        {"data": X.shape})
+    want = np.asarray(ref.forward(data=X)[0])
+
+    floats = " ".join(str(float(v)) for v in X.reshape(-1))
+    r = subprocess.run(
+        ["perl", os.path.join(pkg, "examples", "predict.pl"),
+         prefix, "1", "data", "16,6"],
+        input=floats, env=env, capture_output=True, text=True,
+        timeout=300)
+    assert r.returncode == 0, (r.stdout + "\n" + r.stderr)[-2000:]
+    assert "PERL_PREDICT_OK" in r.stdout
+    row0 = [float(v) for v in
+            r.stdout.split("row 0:")[1].splitlines()[0].split()]
+    np.testing.assert_allclose(row0, want[0], rtol=1e-5, atol=1e-6)
