@@ -8,7 +8,8 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu.gradcomp import (TwoBitCompressor, compress_2bit,
+from mxnet_tpu.gradcomp import (TwoBitCompressor, compress_1bit,
+                                compress_2bit, decompress_1bit,
                                 decompress_2bit, make_compressor)
 from mxnet_tpu.ps import PSServer, ShardedPSClient
 
@@ -225,3 +226,28 @@ def test_1bit_roundtrip_and_convergence():
     finally:
         del os.environ["MXTPU_PS_ADDRS"]
         server.stop()
+
+
+def test_codec_roundtrip_property():
+    """deq + residual reconstructs grad for both codecs across edge
+    shapes (empty, scalar-ish, non-multiples of the packing width).
+    (x-d)+d rounds, so the bound is ulps OF THE QUANT VALUE d — e.g.
+    the 1-bit scale can be ~100x larger than a small element."""
+    rng = np.random.RandomState(11)
+    shapes = [(0,), (1,), (3,), (7,), (8,), (9,), (2, 3, 5), (127,),
+              (128,), (129,)]
+    for seed_shift in range(5):   # not seed-lucky: several draws/shape
+        for shape in shapes:
+            g = (rng.randn(*shape) * rng.choice([0.01, 1.0, 100.0])
+                 ).astype(np.float32)
+            p2, r2 = compress_2bit(g, threshold=0.37)
+            atol2 = 2 * np.spacing(np.float32(0.37))
+            np.testing.assert_allclose(decompress_2bit(p2) + r2, g,
+                                       rtol=1e-6, atol=atol2,
+                                       err_msg=f"2bit {shape}")
+            p1, r1 = compress_1bit(g)
+            scale = np.float32(p1[1])
+            atol1 = 2 * np.spacing(max(scale, np.float32(1e-30)))
+            np.testing.assert_allclose(decompress_1bit(p1) + r1, g,
+                                       rtol=1e-6, atol=atol1,
+                                       err_msg=f"1bit {shape}")
