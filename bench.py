@@ -235,11 +235,55 @@ def _train_throughput(jax, np, mx, net, input_shapes, label_classes, dtype,
             result["vs_baseline_per_peak_tflop"] = round(
                 (value_per_chip / baseline) * (312e12 / peak), 4)
             result["baseline_chip_peak_tflops"] = 312.0
+    if not on_tpu:
+        prior = _best_tpu_record(metric)
+        if prior:
+            # a CPU-fallback line (tunnel down) still carries the BEST
+            # recorded real-hardware measurement of this metric, clearly
+            # labeled as prior provenance — not the current run
+            result["best_tpu_record"] = prior
     result.update(extra_fields)
     result.update(_mfu_fields(net, {"data": (1,) + tuple(data_shape[1:])},
                               batch, n_iter, dt, n_chips,
                               trainer=trainer, placed=placed))
     print(json.dumps(result))
+
+
+def _best_tpu_record(metric):
+    """BEST recorded real-TPU value of ``metric`` from the committed
+    artifacts (BENCH_*_LATEST.json, then the sweep), trimmed to the
+    headline fields + its source file.  Honors BENCH_SWEEP_PATH like
+    _adopt_sweep_winner, so sweep children (which pin it to /dev/null)
+    and tests stay isolated."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    latest = {"resnet50_train_throughput": "BENCH_TPU_LATEST.json",
+              "gpt_train_throughput": "BENCH_GPT_LATEST.json",
+              "cifar_inception_bn_small_train_throughput":
+                  "BENCH_CIFAR_LATEST.json"}.get(metric)
+    candidates = []
+    if latest:
+        candidates.append((os.path.join(here, latest), None))
+    candidates.append((os.environ.get(
+        "BENCH_SWEEP_PATH", os.path.join(here, "BENCH_SWEEP.json")),
+        "results"))
+    for path, key in candidates:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        recs = data.get(key, []) if key else [data]
+        recs = [r for r in recs if isinstance(r, dict)
+                and r.get("metric") == metric
+                and r.get("platform") == "tpu" and "error" not in r]
+        if recs:
+            best = max(recs, key=lambda r: r.get("value", 0))
+            out = {k: best[k] for k in ("value", "unit", "vs_baseline",
+                                        "mfu", "batch_per_chip", "batch")
+                   if k in best}
+            out["source"] = os.path.basename(path)
+            return out
+    return None
 
 
 def _mfu_fields(net, unit_input_shapes, batch, n_iter, dt, n_chips,
