@@ -112,11 +112,13 @@ def find_lib():
         if _TRIED:
             return _LIB
         _TRIED = True
-        if not os.path.exists(_LIB_PATH):
-            if os.environ.get("MXNET_TPU_NO_NATIVE"):
-                return None
-            if not _build():
-                return None
+        if os.environ.get("MXNET_TPU_NO_NATIVE"):
+            return None
+        # Always run make: it is an incremental no-op when the .so is
+        # current, and rebuilds it when a src/*.cc is newer (the .so is
+        # a local build product, never committed).
+        if not _build() and not os.path.exists(_LIB_PATH):
+            return None
         try:
             _LIB = _declare(ctypes.CDLL(_LIB_PATH))
         except OSError:
