@@ -73,6 +73,11 @@ def _run_with_watchdog():
     return 1
 
 
+# env knobs _adopt_sweep_winner defaulted from the sweep winner this
+# run (empty when every knob was explicit or no winner was adopted)
+_ADOPTED_CONFIG = {}
+
+
 def _adopt_sweep_winner():
     """Default unset BENCH_* / LIBTPU knobs to the sweep's measured
     best config (tools/bench_sweep.py promises "the driver's bench.py
@@ -93,9 +98,15 @@ def _adopt_sweep_winner():
         return
     if not best or best.get("platform") != "tpu":
         return
+    adopted = {}
     for k, v in (best.get("config") or {}).items():
-        if k != "BENCH_MODEL":
-            os.environ.setdefault(k, v)
+        if k != "BENCH_MODEL" and os.environ.get(k) is None:
+            os.environ[k] = v
+            adopted[k] = v
+    # surface the adopted knobs in the result JSON so two "default"
+    # runs against different BENCH_SWEEP.json contents stay comparable
+    if adopted:
+        _ADOPTED_CONFIG.update(adopted)
 
 
 def main():
@@ -223,6 +234,8 @@ def _train_throughput(jax, np, mx, net, input_shapes, label_classes, dtype,
         "dtype": dtype,
         "platform": "tpu" if on_tpu else jax.devices()[0].platform,
     }
+    if _ADOPTED_CONFIG:
+        result["adopted_config"] = dict(_ADOPTED_CONFIG)
     # chip-fairness companion ratio: the resnet/gpt baselines are
     # A100-class measurements (312 TF/s bf16 peak); normalizing by each
     # chip's peak compares IMPLEMENTATION efficiency rather than silicon

@@ -269,6 +269,10 @@ mxpl_pred_set_input(IV h, const char* key, SV* floats_packed)
     const char* p;
   CODE:
     p = SvPV(floats_packed, len);
+    if (len % 4 != 0)
+        croak("mxpl_pred_set_input: packed length %lu for key '%s' is not "
+              "a multiple of 4 (expected pack('f*', ...))",
+              (unsigned long)len, key);
     CHK(MXTPUPredSetInput(INT2PTR(PredictorHandle, h), key,
                           (const float*)p, (uint32_t)(len / 4)));
 
