@@ -217,15 +217,23 @@ class _CompiledGraph:
                             f"{node.op.list_outputs(node.params)[i]}")
                 collect.append((out_name, o))
 
+    def rng_state(self, key):
+        """(subkeys, rng_idx) for one evaluation — THE key-splitting
+        scheme.  Both the fused path (__call__) and the stepwise path
+        (Executor.partial_forward) derive per-node keys through this one
+        helper, so a stepwise run reproduces fused randomness exactly."""
+        subkeys = (jax.random.split(key, len(self.rng_nodes))
+                   if self.rng_nodes else None)
+        rng_idx = {id(n): i for i, n in enumerate(self.rng_nodes)}
+        return subkeys, rng_idx
+
     def __call__(self, arg_vals: dict, aux_vals: dict, key, train: bool,
                  collect=None):
         """Evaluate the graph.  JAX-traceable for fixed ``train``.
 
         Returns (outputs tuple, new_aux dict)."""
         env = {}
-        subkeys = (jax.random.split(key, len(self.rng_nodes))
-                   if self.rng_nodes else None)
-        rng_idx = {id(n): i for i, n in enumerate(self.rng_nodes)}
+        subkeys, rng_idx = self.rng_state(key)
         new_aux = dict(aux_vals)
         # block-level remat applies on the train path only (backward is
         # what stores activations); monitor runs need every output, so
@@ -330,6 +338,14 @@ class Executor:
         self._outputs = None
         self._pending_grads = None
         self._monitor_callback = None
+        # stepwise-execution state (partial_forward)
+        self._fwd_nodes = [n for n in self._graph.topo if not n.is_variable]
+        self._partial = None
+        self._partial_key = None
+        # key of the last executed forward (fused or stepwise): explicit
+        # out_grads backward re-runs the fused program with it so RNG ops
+        # (dropout) reproduce the activations the caller observed
+        self._last_key = None
 
         # -- context assignment (model parallelism) -------------------------
         from .graph import SegmentedGraph, assign_contexts
@@ -343,6 +359,7 @@ class Executor:
         distinct = {c for c in ctx_of.values()}
         self._multi_ctx = len(distinct) > 1
         if self._multi_ctx:
+            self._ctx_of = ctx_of
             self._seg_graph = SegmentedGraph(symbol, ctx_of,
                                              self._graph._custom)
             self._pending_chain = None
@@ -400,6 +417,41 @@ class Executor:
             k: v for k, v in type_dict.items()})
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
+
+        def _reuse(pool, name, shape, dtype, c):
+            """Memory sharing with ``shared_exec`` (reference
+            GraphStoragePool / executor_group._bind_ith_exec:439-533):
+            reuse the shared executor's NDArray OBJECT when name, shape,
+            dtype and context all match — both executors then see every
+            update to it.  XLA buffer assignment owns the internal
+            activation memory, so the array objects are the entire
+            shareable surface here.  Inputs the caller gave shapes for
+            (data/labels — the non-param arguments) are never shared:
+            a deferred backward re-gathers its executor's inputs, and
+            aliasing them across executors would let another module's
+            batch leak into those gradients.  A name the donor holds at
+            a DIFFERENT shape/dtype/context is an error, not a silent
+            fresh allocation: partial sharing would leave that one
+            parameter training independently while the master dicts stay
+            shared (the reference's _bind_ith_exec asserts the same)."""
+            if pool is None or name in kwargs:
+                return None
+            arr = pool.get(name)
+            if arr is None:
+                return None
+            if (tuple(arr.shape) != tuple(shape)
+                    or np.dtype(arr.dtype) != np.dtype(dtype or np.float32)
+                    or arr.context != c):
+                raise MXNetError(
+                    f"shared_exec holds {name!r} with shape "
+                    f"{tuple(arr.shape)} dtype {arr.dtype} on {arr.context}"
+                    f", incompatible with required shape {tuple(shape)} "
+                    f"dtype {np.dtype(dtype or np.float32)} on {c}")
+            return arr
+
+        shared_args = shared_exec.arg_dict if shared_exec is not None else None
+        shared_grads = shared_exec.grad_dict if shared_exec is not None else None
+        shared_aux = shared_exec.aux_dict if shared_exec is not None else None
         # with ctx groups, allocate each variable on its assigned context
         # (reference simple_bind honors AssignContext placements)
         if group2ctx:
@@ -414,14 +466,22 @@ class Executor:
             name_ctx = {}
         arg_ctxs = [name_ctx.get(k, ctx) for k in arg_names]
         aux_ctxs = [name_ctx.get(k, ctx) for k in aux_names]
-        arg_arrays = [nd.zeros(s, ctx=c, dtype=t or np.float32)
-                      for s, t, c in zip(arg_shapes, arg_types, arg_ctxs)]
-        aux_arrays = [nd.zeros(s, ctx=c, dtype=t or np.float32)
-                      for s, t, c in zip(aux_shapes, aux_types, aux_ctxs)]
+        def _alloc(pool, k, s, t, c):
+            arr = _reuse(pool, k, s, t, c)
+            if arr is None:
+                arr = nd.zeros(s, ctx=c, dtype=t or np.float32)
+            return arr
+
+        arg_arrays = [_alloc(shared_args, k, s, t, c)
+                      for k, s, t, c in zip(arg_names, arg_shapes, arg_types,
+                                            arg_ctxs)]
+        aux_arrays = [_alloc(shared_aux, k, s, t, c)
+                      for k, s, t, c in zip(aux_names, aux_shapes, aux_types,
+                                            aux_ctxs)]
         req = grad_req if isinstance(grad_req, dict) else {
             k: grad_req for k in arg_names}
         grad_arrays = [
-            nd.zeros(s, ctx=c, dtype=t or np.float32)
+            _alloc(shared_grads, k, s, t, c)
             if req.get(k, "null") != "null" else None
             for k, s, t, c in zip(arg_names, arg_shapes, arg_types, arg_ctxs)
         ]
@@ -453,7 +513,22 @@ class Executor:
 
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
+        self._last_key = sub
         return sub
+
+    def _run_fused_bwd(self, key, head=None):
+        """Fused forward+backward over the CURRENT arrays with ``key``.
+        ``head=None`` means ones per output — the loss-layer head-grad
+        contract.  Single source for the deferred-grad, explicit
+        out_grads, and completed-stepwise backward paths."""
+        args, aux = self._gather()
+        grad_args = {k: args[k] for k in self._grad_names}
+        other = {k: v for k, v in args.items() if k not in grad_args}
+        if head is None:
+            outs_probe = jax.eval_shape(
+                lambda a, x, k: self._fwd_train(a, x, k)[0], args, aux, key)
+            head = tuple(jnp.ones(o.shape, o.dtype) for o in outs_probe)
+        return self._fwd_bwd(grad_args, other, aux, key, head)
 
     def forward(self, is_train=False, **kwargs):
         """Run forward (reference executor.py:84).  kwargs assign input
@@ -467,6 +542,9 @@ class Executor:
                 self.arg_dict[k][:] = nd.array(v, ctx=self._ctx)
         args, aux = self._gather()
         key = self._next_key()
+        # a fresh full forward invalidates any stepwise run in flight
+        self._partial = None
+        self._partial_key = None
 
         if self._multi_ctx:
             build_vjp = bool(is_train and self._grad_names)
@@ -486,13 +564,20 @@ class Executor:
             outs, new_aux = self._graph(args, aux, key, is_train, collect=collect)
             for name, val in collect:
                 self._monitor_callback(name, NDArray(val, self._ctx))
+            if is_train and self._grad_names:
+                # monitoring runs the graph eagerly for the per-output
+                # stats; gradients come from the fused program with the
+                # SAME key (identical activations), so backward() after a
+                # monitored train step works exactly like an unmonitored
+                # one — the reference Monitor is a training-loop tool
+                _, grads, _ = self._run_fused_bwd(key)
+                self._pending_grads = grads
+            else:
+                # no gradients for THIS run; a stale pending set from an
+                # earlier fused train step must not survive it
+                self._pending_grads = None
         elif is_train and self._grad_names:
-            grad_args = {k: args[k] for k in self._grad_names}
-            other = {k: v for k, v in args.items() if k not in grad_args}
-            outs_probe = jax.eval_shape(
-                lambda a, x, k: self._fwd_train(a, x, k)[0], args, aux, key)
-            head = tuple(jnp.ones(o.shape, o.dtype) for o in outs_probe)
-            outs, grads, new_aux = self._fwd_bwd(grad_args, other, aux, key, head)
+            outs, grads, new_aux = self._run_fused_bwd(key)
             self._pending_grads = grads
         else:
             fn = self._fwd_train if is_train else self._fwd_eval
@@ -504,6 +589,101 @@ class Executor:
                 arr._set(new_aux[k])
         self._outputs = [NDArray(o, self._ctx) for o in outs]
         return self._outputs
+
+    @property
+    def num_forward_nodes(self):
+        """Number of forward compute nodes = number of partial_forward
+        steps (reference GraphExecutor num_forward_nodes_)."""
+        return len(self._fwd_nodes)
+
+    def partial_forward(self, is_train=False, step=0):
+        """Run forward node ``step`` only and return the number of steps
+        left (reference ``GraphExecutor::PartialForward``,
+        src/symbol/graph_executor.cc:994-1001; contract in
+        include/mxnet/symbolic.h:326-340: keep calling with increasing
+        ``step`` until 0 is returned).
+
+        This is the stepwise debugging path: each node executes eagerly
+        (un-fused, like the reference disabling bulk exec), firing the
+        monitor callback per output when one is installed.  After the
+        final step, ``outputs`` matches a full ``forward`` run bit-for-bit
+        and — on the single-context path — ``backward()`` works with the
+        same key-reuse semantics as the fused train step.
+        """
+        if step >= len(self._fwd_nodes):
+            return 0
+        st = self._partial
+        if step == 0:
+            # starting a stepwise run invalidates any earlier fused run's
+            # pending gradients/chain — they describe other activations
+            self._pending_grads = None
+            self._partial_key = None
+            if self._multi_ctx:
+                self._pending_chain = None
+            args, aux = self._gather()
+            key = self._next_key()
+            env = {}
+            for node in self._graph.topo:
+                if node.is_variable:
+                    if node.name in args:
+                        env[id(node), 0] = args[node.name]
+                    elif node.name in aux:
+                        env[id(node), 0] = aux[node.name]
+            subkeys, rng_idx = self._graph.rng_state(key)
+            st = self._partial = {
+                "env": env,
+                "aux": dict(aux),
+                "subkeys": subkeys,
+                "rng_idx": rng_idx,
+                "key": key,
+                "next": 0,
+            }
+        if st is None or step != st["next"]:
+            expected = 0 if st is None else st["next"]
+            raise MXNetError(
+                f"partial_forward step {step}: steps must be executed in "
+                f"increasing order from 0 (expected step {expected})")
+        env = st["env"]
+        node = self._fwd_nodes[step]
+        n_args, _ = self._graph._aux_of_node[id(node)]
+        if self._multi_ctx:
+            # honor the node's assigned context (model parallelism): move
+            # its inputs like the auto-inserted _CrossDeviceCopy nodes
+            dev = self._ctx_of[id(node)].jax_device()
+            for src, idx in node.inputs[:n_args]:
+                env[id(src), idx] = jax.device_put(env[id(src), idx], dev)
+        collect = [] if self._monitor_callback is not None else None
+        self._graph._run_node(node, env, st["aux"], st["subkeys"],
+                              st["rng_idx"], is_train, collect)
+        st["next"] = step + 1
+        if collect:
+            out_ctx = (self._ctx_of[id(node)] if self._multi_ctx
+                       else self._ctx)
+            for name, val in collect:
+                self._monitor_callback(name, NDArray(val, out_ctx))
+        step_left = len(self._fwd_nodes) - step - 1
+        if step_left == 0:
+            outs = tuple(env[id(n), i] for n, i in self._graph.heads)
+            ctxs = (self._head_ctx if self._multi_ctx
+                    else [self._ctx] * len(outs))
+            self._outputs = [NDArray(o, c) for o, c in zip(outs, ctxs)]
+            if is_train:
+                for k, arr in zip(self.aux_names, self.aux_arrays):
+                    arr._set(jax.device_put(st["aux"][k],
+                                            arr._ctx.jax_device()))
+                if not self._multi_ctx:
+                    # backward() without out_grads re-runs the fused
+                    # program with this key, reproducing the stepwise
+                    # run's randomness exactly
+                    self._partial_key = st["key"]
+            self._pending_grads = None
+            if self._multi_ctx:
+                # a chain from an earlier fused forward would describe
+                # stale activations; backward after a stepwise multi-ctx
+                # run requires explicit out_grads through a fresh forward
+                self._pending_chain = None
+            self._partial = None
+        return step_left
 
     def backward(self, out_grads=None):
         """Commit gradients (reference executor.py:123).
@@ -537,23 +717,29 @@ class Executor:
             self._pending_chain = None
             return
         if out_grads is not None:
+            if self._last_key is None:
+                raise MXNetError("backward called before forward")
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             # copy head grads to this executor's device (the reference
             # Backward copies/verifies head grads, graph_executor.cc:1003
-            # — callers routinely pass default-context arrays)
+            # — callers routinely pass default-context arrays); re-run
+            # with the LAST forward's key so RNG ops reproduce the
+            # activations the caller observed
             dev = self._ctx.jax_device()
             head = tuple(jax.device_put(
                 g._data if isinstance(g, NDArray) else jnp.asarray(g), dev)
                 for g in out_grads)
-            args, aux = self._gather()
-            grad_args = {k: args[k] for k in self._grad_names}
-            other = {k: v for k, v in args.items() if k not in grad_args}
-            _, grads, _ = self._fwd_bwd(grad_args, other, aux, self._key, head)
-        else:
-            if self._pending_grads is None:
-                raise MXNetError("backward called before forward(is_train=True)")
+            _, grads, _ = self._run_fused_bwd(self._last_key, head)
+        elif self._pending_grads is not None:
             grads = self._pending_grads
+        elif self._partial_key is not None:
+            # completed stepwise train run: compute grads by re-running
+            # the fused program with the SAME key the partial run used
+            # (identical randomness => identical activations)
+            _, grads, _ = self._run_fused_bwd(self._partial_key)
+        else:
+            raise MXNetError("backward called before forward(is_train=True)")
         for k, garr in zip(self.arg_names, self.grad_arrays):
             if garr is None or self._grad_req[k] == "null":
                 continue
@@ -563,6 +749,7 @@ class Executor:
             else:
                 garr._set(g)
         self._pending_grads = None
+        self._partial_key = None
 
     @property
     def outputs(self):

@@ -83,24 +83,47 @@ class BucketingModule(BaseModule):
         if self.binded:
             self.logger.warning("Already binded, ignoring bind()")
             return
-        if shared_module is not None:
-            raise MXNetError("shared_module not supported for BucketingModule")
+        if shared_module is not None and not isinstance(shared_module,
+                                                        BucketingModule):
+            raise MXNetError(
+                "shared_module for BucketingModule must itself be a "
+                "BucketingModule")
+        if shared_module is not None and not (shared_module.binded
+                                              and shared_module.params_initialized):
+            raise MXNetError(
+                "shared_module must be binded and params-initialized")
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
-        self.binded = True
         sym, data_names, label_names = self._call_sym_gen(self._default_bucket_key)
+        # external sharing (beyond the reference, which asserts
+        # shared_module is None here — bucketing_module.py:176): a
+        # train/eval BucketingModule pair shares one set of parameter
+        # arrays and one optimizer through the default-bucket Module;
+        # each bucket bound later inherits the sharing via switch_bucket
+        shared_default = (
+            shared_module._buckets[shared_module._default_bucket_key]
+            if shared_module is not None else None)
         module = Module(sym, data_names, label_names, logger=self.logger,
                         context=self._context,
                         work_load_list=self._work_load_list)
         module.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
-                    force_rebind=False, shared_module=None, grad_req=grad_req)
+                    force_rebind=False, shared_module=shared_default,
+                    grad_req=grad_req)
+        self.binded = True
         self._curr_module = module
         self._curr_bucket_key = self._default_bucket_key
         self._buckets[self._default_bucket_key] = module
+        if shared_module is not None:
+            self.params_initialized = True
+        if module.optimizer_initialized:
+            self._shared_optimizer_source = module
+            self.optimizer_initialized = True
 
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
         """Switch to (lazily binding) the bucket's module, sharing params
-        with the default bucket (reference bucketing_module.py:195)."""
+        with the default bucket (reference bucketing_module.py:195).
+        Like the reference, binding a NEW bucket requires init_params to
+        have run (Module.bind's shared_module contract)."""
         if not self.binded:
             raise MXNetError("call bind before switch_bucket")
         if bucket_key not in self._buckets:
@@ -112,11 +135,6 @@ class BucketingModule(BaseModule):
                         self._curr_module.inputs_need_grad, force_rebind=False,
                         shared_module=self._buckets[self._default_bucket_key])
             self._buckets[bucket_key] = module
-            if self.params_initialized:
-                arg_params, aux_params = self.get_params()
-                module.init_params(arg_params=arg_params, aux_params=aux_params,
-                                   allow_missing=False, force_init=True)
-                module.optimizer_initialized = False
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
 
@@ -151,12 +169,7 @@ class BucketingModule(BaseModule):
     def _propagate_optimizer(self, module):
         """Reuse the one optimizer/updater/kvstore across bucket modules so
         update counts and state are shared."""
-        src = self._shared_optimizer_source
-        module._optimizer = src._optimizer
-        module._kvstore = src._kvstore
-        module._update_on_kvstore = src._update_on_kvstore
-        module._updater = src._updater
-        module.optimizer_initialized = True
+        module.borrow_optimizer(self._shared_optimizer_source)
 
     # -- compute -----------------------------------------------------------
     def forward(self, data_batch, is_train=None):
@@ -167,23 +180,18 @@ class BucketingModule(BaseModule):
                                data_batch.provide_label)
             if self.optimizer_initialized and not self._curr_module.optimizer_initialized:
                 self._propagate_optimizer(self._curr_module)
-            # keep current params flowing into the switched bucket
-            if self.params_initialized:
-                src = self._buckets[self._default_bucket_key]
-                if self._curr_module is not src and src._params_dirty:
-                    pass
         self._curr_module.forward(data_batch, is_train=is_train)
 
     def backward(self, out_grads=None):
         self._curr_module.backward(out_grads)
 
     def update(self):
+        # bucket modules ALIAS one set of parameter arrays and one dirty
+        # flag (shared_exec wiring in switch_bucket -> Module.bind ->
+        # simple_bind), so the update is visible to every bucket without
+        # a propagation copy — the same single-copy semantics as the
+        # reference's shared executor memory (executor_group.py:439-533)
         self._curr_module.update()
-        # params now live in curr module's executors; propagate master copy
-        arg_params, aux_params = self._curr_module.get_params()
-        for key, module in self._buckets.items():
-            if module is not self._curr_module and module.params_initialized:
-                module.set_params(arg_params, aux_params)
 
     def get_outputs(self, merge_multi_context=True):
         return self._curr_module.get_outputs(merge_multi_context)

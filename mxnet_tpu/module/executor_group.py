@@ -58,6 +58,15 @@ class DataParallelExecutorGroup:
         self.aux_names = symbol.list_auxiliary_states()
         self.execs = []
         self.shared_group = shared_group
+        if shared_group is not None and list(shared_group.contexts) != list(
+                contexts):
+            # silent partial sharing (some executors aliased, others
+            # fresh) would leave the extras training on stale weights;
+            # the reference's _bind_ith_exec likewise requires matching
+            # device lists
+            raise MXNetError(
+                f"shared_group contexts {shared_group.contexts} do not "
+                f"match this group's contexts {contexts}")
 
         self.grad_req = {}
         for name in self.arg_names:
@@ -137,7 +146,15 @@ class DataParallelExecutorGroup:
             shapes = {d.name: self._sliced_shape(d.shape, islice,
                                                  self.batch_axes[d.name])
                       for d in self.data_shapes + self.label_shapes}
-            exe = self.symbol.simple_bind(ctx, grad_req=self.grad_req, **shapes)
+            # memory sharing across bound groups (reference
+            # _bind_ith_exec shared_exec, executor_group.py:439-533):
+            # the i-th executor of the shared group donates its
+            # matching param/grad/aux arrays
+            shared_exec = (self.shared_group.execs[i]
+                           if self.shared_group is not None
+                           and i < len(self.shared_group.execs) else None)
+            exe = self.symbol.simple_bind(ctx, grad_req=self.grad_req,
+                                          shared_exec=shared_exec, **shapes)
             self.execs.append(exe)
 
     # -- params ------------------------------------------------------------

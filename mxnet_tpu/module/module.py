@@ -52,12 +52,24 @@ class Module(BaseModule):
 
         self._arg_params = None
         self._aux_params = None
-        self._params_dirty = False
+        # dirty = device arrays newer than the CPU master dicts.  Held
+        # in a one-element list so modules sharing one set of params
+        # (shared_module) share ONE flag: an update through any of them
+        # makes get_params on all of them resync
+        self._dirty_ref = [False]
         self._exec_group = None
         self._optimizer = None
         self._kvstore = None
         self._update_on_kvstore = None
         self._updater = None
+
+    @property
+    def _params_dirty(self):
+        return self._dirty_ref[0]
+
+    @_params_dirty.setter
+    def _params_dirty(self, value):
+        self._dirty_ref[0] = value
 
     @property
     def data_names(self):
@@ -95,18 +107,66 @@ class Module(BaseModule):
         if self.binded:
             self.logger.warning("Already binded, ignoring bind()")
             return
+        shared_group = None
+        arg_params = aux_params = None
+        if shared_module is not None:
+            # the reference requires both (module.py:260-261); an
+            # uninitialized donor would let two modules write divergent
+            # random inits into the SAME aliased arrays.  Validate (and
+            # sync a dirty donor) BEFORE mutating any state so a raise
+            # leaves this module cleanly unbound
+            if not (shared_module.binded and shared_module.params_initialized):
+                raise MXNetError(
+                    "shared_module must be binded and params-initialized")
+            missing = [n for n in self._param_names + self._aux_names
+                       if n not in shared_module._arg_params
+                       and n not in shared_module._aux_params]
+            if missing:
+                raise MXNetError(
+                    f"shared_module does not hold parameters {missing}: "
+                    "every param/aux of a sharing module must exist in "
+                    "the donor (the shared master dicts would otherwise "
+                    "have no entry to sync them into)")
+            shared_module.get_params()   # device->master sync if dirty
+            shared_group = shared_module._exec_group
+            arg_params = shared_module._arg_params
+            aux_params = shared_module._aux_params
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
         self.binded = True
-        shared_group = shared_module._exec_group if shared_module else None
         self._exec_group = DataParallelExecutorGroup(
             self._symbol, self._context, self._work_load_list, data_shapes,
             label_shapes, self._param_names, for_training, inputs_need_grad,
             shared_group=shared_group, logger=self.logger,
             fixed_param_names=self._fixed_param_names, grad_req=grad_req)
-        if self._arg_params is not None:
+        if shared_module is not None:
+            # share the master param dicts AND the dirty flag (reference
+            # module.py:285-288) — both modules see every update.  Every
+            # param/aux array ALIASES the donor's (simple_bind raises on
+            # any name/shape/dtype/ctx mismatch and the donor-coverage
+            # check above rejects extras), so no set_params push is
+            # needed — the aliased arrays already hold the live values
+            self.params_initialized = True
+            self._arg_params = arg_params
+            self._aux_params = aux_params
+            self._dirty_ref = shared_module._dirty_ref
+        elif self._arg_params is not None:
             # params from a previous bind/init: push into new executors
             self._exec_group.set_params(self._arg_params, self._aux_params)
+        if shared_module is not None and shared_module.optimizer_initialized:
+            self.borrow_optimizer(shared_module)
+
+    def borrow_optimizer(self, shared_module):
+        """Share the optimizer/updater/kvstore of an already-initialized
+        module so update counts and state are one (reference
+        module.py:362-370)."""
+        if not shared_module.optimizer_initialized:
+            raise MXNetError("optimizer of shared_module is not initialized")
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
 
     # -- params ------------------------------------------------------------
     def init_params(self, initializer=Uniform(0.01), arg_params=None,

@@ -65,7 +65,21 @@ class SequentialModule(BaseModule):
             self.logger.warning("Already binded, ignoring bind()")
             return
         if shared_module is not None:
-            raise MXNetError("shared_module not supported for SequentialModule")
+            # beyond the reference (which asserts None here,
+            # sequential_module.py:217): share layer-by-layer with a
+            # structurally identical SequentialModule
+            if not isinstance(shared_module, SequentialModule):
+                raise MXNetError(
+                    "shared_module for SequentialModule must itself be a "
+                    "SequentialModule")
+            if len(shared_module._modules) != len(self._modules):
+                raise MXNetError(
+                    "shared_module must contain the same number of "
+                    f"sub-modules ({len(shared_module._modules)} vs "
+                    f"{len(self._modules)})")
+            if not (shared_module.binded and shared_module.params_initialized):
+                raise MXNetError(
+                    "shared_module must be binded and params-initialized")
         if not self._modules:
             raise MXNetError("add modules first")
         self.for_training = for_training
@@ -89,11 +103,18 @@ class SequentialModule(BaseModule):
             module.bind(data_shapes=my_data_shapes, label_shapes=my_label_shapes,
                         for_training=for_training,
                         inputs_need_grad=my_inputs_need_grad,
-                        force_rebind=force_rebind, grad_req=grad_req)
+                        force_rebind=force_rebind,
+                        shared_module=(shared_module._modules[i_layer]
+                                       if shared_module is not None else None),
+                        grad_req=grad_req)
             my_data_shapes = module.output_shapes
         if not anybody_ever_needs_label:
             self._label_shapes = None
         self.binded = True
+        if shared_module is not None:
+            self.params_initialized = True
+            if shared_module.optimizer_initialized:
+                self.optimizer_initialized = True
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
                     aux_params=None, allow_missing=False, force_init=False):

@@ -457,6 +457,15 @@ class Symbol:
     # -- binding (executor factory; implemented in executor.py) -------------
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
              group2ctx=None, shared_exec=None):
+        """Bind to caller-provided arrays (reference symbol.py:724).
+
+        ``shared_exec`` is accepted for reference API compatibility but
+        has no effect here: the reference shares internal activation
+        memory between executors (GraphStoragePool), which XLA buffer
+        assignment owns in this build, and ``bind``'s argument arrays are
+        supplied by the caller — pass the SAME NDArray objects to both
+        executors for parameter sharing, or use ``simple_bind(...,
+        shared_exec=...)`` which does that automatically."""
         from .executor import Executor
 
         return Executor._bind(self, ctx, args, args_grad, grad_req, aux_states,
@@ -464,6 +473,12 @@ class Symbol:
 
     def simple_bind(self, ctx, grad_req="write", type_dict=None, group2ctx=None,
                     shared_exec=None, **kwargs):
+        """Infer shapes, allocate arrays, bind (reference symbol.py:643).
+
+        With ``shared_exec``, parameter/gradient/aux arrays whose name,
+        shape, dtype and context match the shared executor's are REUSED
+        (the same NDArray objects — updates are visible to both); inputs
+        named in ``kwargs`` are always freshly allocated."""
         from .executor import Executor
 
         return Executor._simple_bind(self, ctx, grad_req, type_dict, group2ctx,

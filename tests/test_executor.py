@@ -215,3 +215,146 @@ def test_reshape_preserves_buffer_prefix():
     ex2 = ex.reshape(data=(8, 3), softmax_label=(8,), partial_shaping=True)
     np.testing.assert_allclose(ex2.arg_dict["fc_weight"].asnumpy(),
                                w.reshape(-1)[:12].reshape(4, 3))
+
+
+# -- partial_forward (stepwise execution) -----------------------------------
+# reference: GraphExecutor::PartialForward, graph_executor.cc:994-1001
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def test_partial_forward_prefix_equality():
+    net = _mlp()
+    exe = net.simple_bind(mx.cpu(), data=(5, 7), softmax_label=(5,))
+    rng = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        arr[:] = rng.uniform(-1, 1, arr.shape)
+    full = exe.forward()[0].asnumpy()
+
+    step = 0
+    steps_seen = 0
+    while True:
+        left = exe.partial_forward(is_train=False, step=step)
+        steps_seen += 1
+        if left == 0:
+            break
+        step += 1
+    assert steps_seen == exe.num_forward_nodes
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(), full, rtol=1e-6)
+
+
+def test_partial_forward_out_of_order_raises():
+    net = _mlp()
+    exe = net.simple_bind(mx.cpu(), data=(3, 7), softmax_label=(3,))
+    with pytest.raises(mx.MXNetError, match="increasing order"):
+        exe.partial_forward(step=2)
+
+
+def test_partial_forward_past_end_returns_zero():
+    net = _mlp()
+    exe = net.simple_bind(mx.cpu(), data=(3, 7), softmax_label=(3,))
+    assert exe.partial_forward(step=exe.num_forward_nodes + 5) == 0
+
+
+def test_partial_forward_monitor_callback():
+    net = _mlp()
+    exe = net.simple_bind(mx.cpu(), data=(4, 7), softmax_label=(4,))
+    rng = np.random.RandomState(1)
+    for name, arr in exe.arg_dict.items():
+        arr[:] = rng.uniform(-1, 1, arr.shape)
+    seen = []
+    exe.set_monitor_callback(lambda name, arr: seen.append(name))
+    step = 0
+    while exe.partial_forward(step=step) != 0:
+        step += 1
+    assert any("fc1" in n for n in seen)
+    assert any("softmax" in n for n in seen)
+
+
+def test_partial_forward_then_backward():
+    """Train-mode stepwise run then backward() — grads must match the
+    fused forward(is_train=True)+backward() path."""
+    net = _mlp()
+    exe = net.simple_bind(mx.cpu(), data=(6, 7), softmax_label=(6,))
+    rng = np.random.RandomState(2)
+    for name, arr in exe.arg_dict.items():
+        if name == "softmax_label":
+            arr[:] = rng.randint(0, 4, arr.shape).astype(np.float32)
+        else:
+            arr[:] = rng.uniform(-1, 1, arr.shape)
+
+    step = 0
+    while exe.partial_forward(is_train=True, step=step) != 0:
+        step += 1
+    exe.backward()
+    got = {k: v.asnumpy().copy() for k, v in exe.grad_dict.items()}
+
+    exe.forward(is_train=True)
+    exe.backward()
+    want = {k: v.asnumpy() for k, v in exe.grad_dict.items()}
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-5, atol=1e-6)
+
+
+def test_partial_forward_batchnorm_aux_commit():
+    """Completing a train-mode stepwise run commits aux (moving stats)
+    exactly like forward(is_train=True)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.BatchNorm(data, name="bn")
+    exe = net.simple_bind(mx.cpu(), data=(8, 3))
+    exe2 = net.simple_bind(mx.cpu(), data=(8, 3))
+    rng = np.random.RandomState(3)
+    x = rng.uniform(-2, 2, (8, 3)).astype(np.float32)
+    for e in (exe, exe2):
+        e.arg_dict["data"][:] = x
+        e.arg_dict["bn_gamma"][:] = 1
+        e.arg_dict["bn_beta"][:] = 0
+
+    step = 0
+    while exe.partial_forward(is_train=True, step=step) != 0:
+        step += 1
+    exe2.forward(is_train=True)
+    for k in exe.aux_dict:
+        np.testing.assert_allclose(exe.aux_dict[k].asnumpy(),
+                                   exe2.aux_dict[k].asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(),
+                               exe2.outputs[0].asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_monitor_train_backward_grads_match():
+    """Monitor installed during TRAINING: backward() must produce the
+    same gradients as the unmonitored fused path (the reference Monitor
+    is a training-loop tool)."""
+    net = _mlp()
+
+    def make():
+        exe = net.simple_bind(mx.cpu(), data=(6, 7), softmax_label=(6,))
+        rng = np.random.RandomState(4)
+        for name, arr in exe.arg_dict.items():
+            if name == "softmax_label":
+                arr[:] = rng.randint(0, 4, arr.shape).astype(np.float32)
+            else:
+                arr[:] = rng.uniform(-1, 1, arr.shape)
+        return exe
+
+    plain = make()
+    plain.forward(is_train=True)
+    plain.backward()
+    want = {k: v.asnumpy() for k, v in plain.grad_dict.items()}
+
+    mon = make()
+    seen = []
+    mon.set_monitor_callback(lambda name, arr: seen.append(name))
+    mon.forward(is_train=True)
+    mon.backward()
+    assert seen  # stats actually collected
+    got = {k: v.asnumpy() for k, v in mon.grad_dict.items()}
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-5, atol=1e-6)

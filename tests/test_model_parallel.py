@@ -110,3 +110,27 @@ def test_mixed_device_bind_arrays():
     np.testing.assert_allclose(ga.asnumpy(), np.full((3, 3), 4.0), rtol=1e-6)
     np.testing.assert_allclose(gb.asnumpy(), np.full((3, 3), 2.0), rtol=1e-6)
     assert ga.context == mx.cpu(0) and gb.context == mx.cpu(1)
+
+
+def test_partial_forward_multi_context():
+    """Stepwise execution honors ctx_group placement and matches the
+    fused multi-context forward."""
+    net = _net()
+    shape = (4, 10)
+    rng = np.random.RandomState(7)
+    arg_shapes, _, _ = net.infer_shape(data=shape)
+    values = {name: rng.randn(*s).astype(np.float32) * 0.5
+              for name, s in zip(net.list_arguments(), arg_shapes)}
+    values["softmax_label"] = rng.randint(0, 4, 4).astype(np.float32)
+
+    group2ctx = {"dev1": mx.cpu(0), "dev2": mx.cpu(1)}
+    exe = net.simple_bind(mx.cpu(0), group2ctx=group2ctx, data=shape)
+    for k, v in values.items():
+        exe.arg_dict[k][:] = v
+    full = exe.forward()[0].asnumpy()
+
+    step = 0
+    while exe.partial_forward(step=step) != 0:
+        step += 1
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(), full,
+                               rtol=1e-5, atol=1e-6)

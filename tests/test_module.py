@@ -281,3 +281,256 @@ def test_module_deterministic_replay():
     p1, p2 = run(), run()
     for k in p1:
         np.testing.assert_array_equal(p1[k], p2[k], err_msg=k)
+
+
+# -- shared_module (memory sharing across bound modules) --------------------
+# reference: Module.bind shared_module (module.py:259-295) + the shared
+# executor memory of bucketing (executor_group.py:439-533).  Bucketing/
+# Sequential external sharing goes BEYOND the reference, which asserts
+# shared_module is None there.
+
+
+def test_shared_module_params_alias():
+    """A module bound with shared_module= aliases the donor's parameter
+    arrays: no set_params copy is ever needed between them."""
+    net = _mlp()
+    X, y = _toy_data()
+    train = mx.mod.Module(net, context=mx.cpu())
+    train.bind([("data", (32, 10))], [("softmax_label", (32,))])
+    train.init_params(mx.initializer.Xavier())
+    train.init_optimizer(kvstore=None,
+                         optimizer_params={"learning_rate": 0.1})
+
+    # different batch size, shared params (the classic train/val pair)
+    val = mx.mod.Module(net, context=mx.cpu())
+    val.bind([("data", (64, 10))], [("softmax_label", (64,))],
+             for_training=False, shared_module=train)
+    assert val.params_initialized          # inherited, no init_params call
+    assert val.optimizer_initialized       # borrowed
+
+    t_exe = train._exec_group.execs[0]
+    v_exe = val._exec_group.execs[0]
+    for name in ("fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"):
+        assert v_exe.arg_dict[name] is t_exe.arg_dict[name]
+    # data arrays differ in shape -> NOT shared
+    assert v_exe.arg_dict["data"] is not t_exe.arg_dict["data"]
+
+    # one train step; val must see the new weights with NO copying
+    before = v_exe.arg_dict["fc1_weight"].asnumpy().copy()
+    batch = DataBatch([mx.nd.array(X[:32])], [mx.nd.array(y[:32])])
+    train.forward(batch, is_train=True)
+    train.backward()
+    train.update()
+    after = v_exe.arg_dict["fc1_weight"].asnumpy()
+    assert not np.allclose(before, after)
+    np.testing.assert_allclose(after,
+                               t_exe.arg_dict["fc1_weight"].asnumpy())
+    # and the master dicts are one object
+    assert val._arg_params is train._arg_params
+
+
+def test_shared_module_unbound_donor_raises():
+    net = _mlp()
+    donor = mx.mod.Module(net, context=mx.cpu())
+    mod = mx.mod.Module(net, context=mx.cpu())
+    with pytest.raises(mx.MXNetError, match="binded"):
+        mod.bind([("data", (8, 10))], [("softmax_label", (8,))],
+                 shared_module=donor)
+
+
+def test_bucketing_internal_buckets_alias_memory():
+    """switch_bucket's shared_exec wiring gives every bucket THE SAME
+    parameter arrays (reference: one GraphStoragePool across bucket
+    executors) — update in one bucket is visible in all, no copies."""
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        emb = mx.sym.Embedding(data, input_dim=20, output_dim=6, name="emb")
+        pooled = mx.sym.mean(emb, axis=(1,))
+        fc = mx.sym.FullyConnected(pooled, name="fc", num_hidden=4)
+        return mx.sym.SoftmaxOutput(fc, name="softmax"), ["data"], \
+            ["softmax_label"]
+
+    from mxnet_tpu.io import DataDesc
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=16,
+                                 context=mx.cpu())
+    mod.bind([DataDesc("data", (8, 16))], [DataDesc("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.switch_bucket(8, [DataDesc("data", (8, 8))],
+                      [DataDesc("softmax_label", (8,))])
+    e16 = mod._buckets[16]._exec_group.execs[0]
+    e8 = mod._buckets[8]._exec_group.execs[0]
+    for name in ("emb_weight", "fc_weight", "fc_bias"):
+        assert e8.arg_dict[name] is e16.arg_dict[name]
+
+
+def test_bucketing_shared_module_external():
+    rng = np.random.RandomState(3)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        emb = mx.sym.Embedding(data, input_dim=20, output_dim=6, name="emb")
+        pooled = mx.sym.mean(emb, axis=(1,))
+        fc = mx.sym.FullyConnected(pooled, name="fc", num_hidden=4)
+        return mx.sym.SoftmaxOutput(fc, name="softmax"), ["data"], \
+            ["softmax_label"]
+
+    from mxnet_tpu.io import DataDesc
+
+    train = mx.mod.BucketingModule(sym_gen, default_bucket_key=16,
+                                   context=mx.cpu())
+    train.bind([DataDesc("data", (8, 16))],
+               [DataDesc("softmax_label", (8,))])
+    train.init_params(mx.initializer.Xavier())
+    train.init_optimizer(kvstore=None,
+                         optimizer_params={"learning_rate": 0.1})
+
+    val = mx.mod.BucketingModule(sym_gen, default_bucket_key=16,
+                                 context=mx.cpu())
+    val.bind([DataDesc("data", (8, 16))], [DataDesc("softmax_label", (8,))],
+             for_training=False, shared_module=train)
+    assert val.params_initialized
+
+    # train one step on the default bucket; val sees the result directly
+    batch = DataBatch([mx.nd.array(rng.randint(0, 20, (8, 16)))],
+                      [mx.nd.array(rng.randint(0, 4, 8))],
+                      bucket_key=16,
+                      provide_data=[DataDesc("data", (8, 16))],
+                      provide_label=[DataDesc("softmax_label", (8,))])
+    train.forward(batch, is_train=True)
+    train.backward()
+    train.update()
+    tw = train._buckets[16]._exec_group.execs[0].arg_dict["emb_weight"]
+    vw = val._buckets[16]._exec_group.execs[0].arg_dict["emb_weight"]
+    assert vw is tw
+
+    # val can still score through its own (shared-memory) graph
+    val.forward(batch, is_train=False)
+    assert val.get_outputs()[0].shape == (8, 4)
+
+
+def test_sequential_shared_module_external():
+    def make_seq():
+        seq = mx.mod.SequentialModule()
+        net1 = mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc1",
+                                     num_hidden=8)
+        net2 = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc2",
+                                  num_hidden=3), name="softmax")
+        seq.add(mx.mod.Module(net1, label_names=None, context=mx.cpu()))
+        seq.add(mx.mod.Module(net2, context=mx.cpu()),
+                take_labels=True, auto_wiring=True)
+        return seq
+
+    X, y = _toy_data()
+    train = make_seq()
+    train.bind([("data", (32, 10))], [("softmax_label", (32,))])
+    train.init_params(mx.initializer.Xavier())
+
+    val = make_seq()
+    val.bind([("data", (32, 10))], [("softmax_label", (32,))],
+             for_training=False, shared_module=train)
+    assert val.params_initialized
+    t0 = train._modules[0]._exec_group.execs[0]
+    v0 = val._modules[0]._exec_group.execs[0]
+    assert v0.arg_dict["fc1_weight"] is t0.arg_dict["fc1_weight"]
+
+    val.forward(DataBatch([mx.nd.array(X[:32])], [mx.nd.array(y[:32])]),
+                is_train=False)
+    assert val.get_outputs()[0].shape == (32, 3)
+
+
+def test_sequential_shared_module_mismatch_raises():
+    seq1 = mx.mod.SequentialModule()
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                              name="fc"), name="softmax")
+    seq1.add(mx.mod.Module(net, context=mx.cpu()))
+    seq1.bind([("data", (8, 10))], [("softmax_label", (8,))])
+    seq2 = mx.mod.SequentialModule()
+    seq2.add(mx.mod.Module(net, context=mx.cpu()))
+    seq2.add(mx.mod.Module(net, context=mx.cpu()))
+    with pytest.raises(mx.MXNetError, match="number of sub-modules"):
+        seq2.bind([("data", (8, 10))], [("softmax_label", (8,))],
+                  shared_module=seq1)
+
+
+def test_bucketing_switch_after_update_preserves_trained_params():
+    """Regression: binding a NEW bucket after updates must not push the
+    stale CPU master params back into the (aliased) trained arrays."""
+    rng = np.random.RandomState(5)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        emb = mx.sym.Embedding(data, input_dim=20, output_dim=6, name="emb")
+        pooled = mx.sym.mean(emb, axis=(1,))
+        fc = mx.sym.FullyConnected(pooled, name="fc", num_hidden=4)
+        return mx.sym.SoftmaxOutput(fc, name="softmax"), ["data"], \
+            ["softmax_label"]
+
+    from mxnet_tpu.io import DataDesc
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=16,
+                                 context=mx.cpu())
+    mod.bind([DataDesc("data", (8, 16))], [DataDesc("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=None,
+                       optimizer_params={"learning_rate": 0.5})
+    batch16 = DataBatch([mx.nd.array(rng.randint(0, 20, (8, 16)))],
+                        [mx.nd.array(rng.randint(0, 4, 8))],
+                        bucket_key=16,
+                        provide_data=[DataDesc("data", (8, 16))],
+                        provide_label=[DataDesc("softmax_label", (8,))])
+    init_w = mod._buckets[16]._exec_group.execs[0].arg_dict[
+        "fc_weight"].asnumpy().copy()
+    for _ in range(3):
+        mod.forward(batch16, is_train=True)
+        mod.backward()
+        mod.update()
+    trained_w = mod._buckets[16]._exec_group.execs[0].arg_dict[
+        "fc_weight"].asnumpy().copy()
+    assert not np.allclose(init_w, trained_w)
+
+    # first bind of bucket 8 happens AFTER training steps (master dirty)
+    batch8 = DataBatch([mx.nd.array(rng.randint(0, 20, (8, 8)))],
+                       [mx.nd.array(rng.randint(0, 4, 8))],
+                       bucket_key=8,
+                       provide_data=[DataDesc("data", (8, 8))],
+                       provide_label=[DataDesc("softmax_label", (8,))])
+    mod.forward(batch8, is_train=True)
+    now_w = mod._buckets[16]._exec_group.execs[0].arg_dict[
+        "fc_weight"].asnumpy()
+    np.testing.assert_allclose(now_w, trained_w, rtol=1e-6)
+
+
+def test_shared_module_shape_mismatch_raises():
+    """A donor holding a same-named param at a different shape must be
+    rejected, not silently partially shared."""
+    netA = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                              name="fc"), name="softmax")
+    netB = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                              name="fc"), name="softmax")
+    donor = mx.mod.Module(netA, context=mx.cpu())
+    donor.bind([("data", (4, 10))], [("softmax_label", (4,))])
+    donor.init_params(mx.initializer.Xavier())
+    mod = mx.mod.Module(netB, context=mx.cpu())
+    with pytest.raises(mx.MXNetError, match="incompatible"):
+        mod.bind([("data", (4, 10))], [("softmax_label", (4,))],
+                 shared_module=donor)
+
+
+def test_shared_module_failed_bind_leaves_module_unbound():
+    net = _mlp()
+    donor = mx.mod.Module(net, context=mx.cpu())   # never bound
+    mod = mx.mod.Module(net, context=mx.cpu())
+    with pytest.raises(mx.MXNetError):
+        mod.bind([("data", (8, 10))], [("softmax_label", (8,))],
+                 shared_module=donor)
+    assert not mod.binded
+    # a later clean bind must work
+    mod.bind([("data", (8, 10))], [("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    assert mod.binded
