@@ -200,12 +200,20 @@ def _train_throughput(jax, np, mx, net, input_shapes, label_classes, dtype,
     per_chip_divisor * n_iter / dt / n_chips, in ``unit``)."""
     data_shape = input_shapes["data"]
     batch = data_shape[0]
+    optimizer_params = dict(optimizer_params
+                            or {"learning_rate": 0.1, "momentum": 0.9})
+    # sweepable optimizer-state dtype (momentum buffer storage): default
+    # follows param dtype (bf16 under BENCH -> half the optimizer HBM
+    # traffic); BENCH_OPT_STATE_DTYPE=float32 measures full-precision
+    # accumulation
+    opt_state_dtype = os.environ.get("BENCH_OPT_STATE_DTYPE")
+    if opt_state_dtype and optimizer == "sgd":
+        optimizer_params["state_dtype"] = opt_state_dtype
     trainer = mx.parallel.ShardedTrainer(
         net, input_shapes,
         mesh=mx.parallel.local_mesh("dp"),
         optimizer=optimizer,
-        optimizer_params=(optimizer_params
-                          or {"learning_rate": 0.1, "momentum": 0.9}),
+        optimizer_params=optimizer_params,
         initializer=(initializer
                      or mx.initializer.Xavier(rnd_type="gaussian",
                                               factor_type="in", magnitude=2)),

@@ -1,0 +1,116 @@
+"""Worker for the 2-process jax.distributed SPMD dryrun (the DCN path).
+
+Each process contributes 4 virtual CPU devices to ONE global 8-device
+``dp`` mesh; the jitted training step therefore spans processes — data
+parallelism over the process boundary rides the same XLA collectives
+that cross DCN on a multi-host pod (SURVEY.md §5 "distributed
+communication backend": in-program collectives replace the reference's
+ps-lite transport, kvstore_dist.h:181-226).
+
+Asserts, per rank:
+ 1. DistKVStore.init broadcast: rank 0's values win everywhere
+    (the reference PS contract, kvstore_dist_server.h DataHandle).
+ 2. NUMERICAL PARITY: two sharded global training steps produce exactly
+    the params of a single-device dense run of the same global batch —
+    the N-CPU-contexts equality trick extended across processes.
+
+Launched by tests/test_dist.py via tools/launch.py -n 2.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore import _maybe_init_distributed
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def main():
+    _maybe_init_distributed()
+    rank = jax.process_index()
+    n_procs = int(os.environ["MXTPU_NUM_PROCS"])
+    assert jax.process_count() == n_procs
+    assert len(jax.devices()) == 4 * n_procs, len(jax.devices())
+    assert len(jax.local_devices()) == 4
+
+    # -- 1. DistKVStore init broadcast across the process boundary ------
+    kv = mx.kv.create("dist_sync")
+    assert kv.rank == rank and kv.num_workers == n_procs
+    kv.init(7, mx.nd.ones((3, 3)) * (rank + 1) * 10)  # ranks disagree
+    got = mx.nd.zeros((3, 3))
+    kv.pull(7, got)
+    np.testing.assert_array_equal(got.asnumpy(), 10.0)  # rank 0 won
+    kv.barrier()
+
+    # -- 2. process-spanning dp training step with numerical parity -----
+    lr = 0.1
+    dp = 4 * n_procs
+    batch, d_in = 2 * dp, 10
+    mesh = mx.parallel.make_mesh({"dp": dp}, devices=jax.devices())
+    mx.random.seed(0)
+    trainer = mx.parallel.ShardedTrainer(
+        _net(), {"data": (batch, d_in), "softmax_label": (batch,)},
+        mesh=mesh, batch_axis="dp",
+        optimizer="sgd", optimizer_params={"learning_rate": lr,
+                                           "momentum": 0.9},
+        initializer=mx.initializer.Xavier())
+
+    # dense single-LOCAL-device reference with identical params + key
+    ref_mesh = mx.parallel.make_mesh({"dp": 1},
+                                     devices=jax.local_devices()[:1])
+    mx.random.seed(0)
+    ref = mx.parallel.ShardedTrainer(
+        _net(), {"data": (batch, d_in), "softmax_label": (batch,)},
+        mesh=ref_mesh, batch_axis="dp",
+        optimizer="sgd", optimizer_params={"learning_rate": lr,
+                                           "momentum": 0.9},
+        initializer=mx.initializer.Xavier())
+    p0 = trainer.get_params()
+    ref.set_params(p0)
+    key_np = np.asarray(jax.device_get(trainer._key))
+    ref._key = jax.device_put(key_np, ref._replicated)
+
+    rng = np.random.RandomState(42)  # same global batch on every rank
+    feed = {"data": rng.standard_normal((batch, d_in)).astype(np.float32),
+            "softmax_label": rng.randint(0, 4, batch).astype(np.float32)}
+    for _ in range(2):  # second step covers momentum-state parity
+        jax.block_until_ready(trainer.step(feed))
+        jax.block_until_ready(ref.step(feed))
+    p_global = trainer.get_params()
+    p_ref = ref.get_params()
+    for k in p0:
+        np.testing.assert_allclose(p_global[k], p_ref[k],
+                                   atol=5e-6, rtol=1e-5)
+        assert not np.allclose(p0[k], p_global[k])  # training moved
+
+    # every rank must also hold IDENTICAL global params (replica sync)
+    import hashlib
+
+    digest = hashlib.sha1()
+    for k in sorted(p_global):
+        digest.update(np.ascontiguousarray(p_global[k]).tobytes())
+    print(f"RANK_{rank}_SPMD_DIGEST {digest.hexdigest()}")
+    print(f"RANK_{rank}_SPMD_PARITY_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -456,3 +456,37 @@ def test_sharded_trainer_deterministic_replay():
     assert p1.keys() == p2.keys()
     for k in p1:
         np.testing.assert_array_equal(p1[k], p2[k], err_msg=k)
+
+
+def test_sgd_opt_state_dtype():
+    """Momentum storage dtype is selectable independently of the param
+    dtype (the sweep's optimizer-state experiment): f32 state under bf16
+    params matches the f32-everything update exactly."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel.trainer import sgd_opt
+
+    p32 = {"w": jnp.linspace(-1, 1, 8, dtype=jnp.float32)}
+    g = {"w": jnp.full((8,), 0.25, jnp.float32)}
+
+    # bf16 params + f32 state
+    init_f32, upd_f32 = sgd_opt(learning_rate=0.1, momentum=0.9,
+                                state_dtype="float32")
+    pb = {"w": p32["w"].astype(jnp.bfloat16)}
+    s = init_f32(pb)
+    assert s["w"].dtype == jnp.float32
+    # default: state follows the (bf16) param dtype
+    init_d, _ = sgd_opt(learning_rate=0.1, momentum=0.9)
+    assert init_d(pb)["w"].dtype == jnp.bfloat16
+
+    # two steps with f32 state match the all-f32 reference to bf16
+    # rounding of the params only (state itself carries no rounding)
+    init_r, upd_r = sgd_opt(learning_rate=0.1, momentum=0.9)
+    pr, sr = dict(p32), init_r(p32)
+    for _ in range(2):
+        pb, s = upd_f32(g, s, pb)
+        pr, sr = upd_r(g, sr, pr)
+    np.testing.assert_allclose(np.asarray(s["w"]), np.asarray(sr["w"]),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pb["w"], np.float32),
+                               np.asarray(pr["w"]), atol=1e-2)

@@ -97,6 +97,19 @@ def main():
                     "BENCH_BATCH": "128",
                     "LIBTPU_INIT_ARGS":
                         f"--xla_tpu_scoped_vmem_limit_kib={kib}"}, False)
+        # optimizer-state dtype: f32 momentum doubles optimizer HBM
+        # traffic vs the bf16 default — measures how update-phase-bound
+        # the step is (VERDICT r2 item 1)
+        yield ({"BENCH_LAYOUT": "NHWC", "BENCH_STEM": "s2d",
+                "BENCH_BATCH": "128",
+                "BENCH_OPT_STATE_DTYPE": "float32"}, False)
+        # latency-hiding scheduler: overlaps collective/copy latency
+        # with compute inside the step program (public TPU perf knob)
+        yield ({"BENCH_LAYOUT": "NHWC", "BENCH_STEM": "s2d",
+                "BENCH_BATCH": "128",
+                "LIBTPU_INIT_ARGS":
+                    "--xla_tpu_enable_latency_hiding_scheduler=true"},
+               False)
 
     full_grid = [pt for pt, _ in grid_points()]
     todo = [pt for pt, quick in grid_points() if quick or not args.quick]
