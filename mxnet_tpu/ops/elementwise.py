@@ -113,6 +113,8 @@ class ElementWiseSumOp(OpDef):
     """Variadic sum (src/operator/elementwise_sum-inl.h; also the NDArray
     function ElementwiseSum, src/ndarray/ndarray.cc:292+)."""
 
+    key_var_num_args = "num_args"
+
     param_cls = ElementWiseSumParam
 
     def list_arguments(self, params):

@@ -372,6 +372,7 @@ class ConcatParam(Params):
 
 @register_op("Concat", aliases=("concat",))
 class ConcatOp(OpDef):
+    key_var_num_args = "num_args"
     param_cls = ConcatParam
 
     def list_arguments(self, params):
@@ -464,6 +465,7 @@ class CropParam(Params):
 
 @register_op("Crop")
 class CropOp(OpDef):
+    key_var_num_args = "num_args"
     param_cls = CropParam
 
     def list_arguments(self, params):

@@ -688,6 +688,12 @@ def _create(op_name, sym_inputs, kwargs):
     # split kwargs into symbol inputs vs op params
     sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
     param_kwargs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
+    # var-arg ops (Concat/ElementWiseSum/Crop) get num_args from the
+    # positional count when not given — the reference key_var_num_args
+    # auto-fill (python/mxnet/symbol.py:1056-1058), opt-in per op
+    kv = op.key_var_num_args
+    if kv and kv not in param_kwargs and sym_inputs:
+        param_kwargs[kv] = len(sym_inputs)
     params = op.make_params(param_kwargs)
     arg_names = op.list_arguments(params)
     if name is None:

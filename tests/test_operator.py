@@ -1039,3 +1039,15 @@ def test_make_loss_normalization_modes():
     np.testing.assert_allclose(
         grad_of(grad_scale=3.0, normalization="valid", valid_thresh=0.1),
         np.full_like(x, 1.5))
+
+
+def test_upsampling_bilinear_positional_weight_not_varargs():
+    """Regression: key_var_num_args autofill must NOT apply to
+    UpSampling, whose num_args means nearest-mode input count — a
+    positional bilinear weight is a legal call that keeps num_args=1."""
+    data = mx.sym.Variable("data")
+    weight = mx.sym.Variable("weight")
+    net = mx.sym.UpSampling(data, weight, sample_type="bilinear",
+                            scale=2, num_filter=4)
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(2, 4, 5, 5))
+    assert out_shapes[0] == (2, 4, 10, 10)

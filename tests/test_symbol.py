@@ -200,3 +200,16 @@ def test_symbol_pickle_and_deepcopy():
     for clone in (pickle.loads(pickle.dumps(net)), copy.deepcopy(net)):
         assert clone.list_arguments() == net.list_arguments()
         assert clone.tojson() == net.tojson()
+
+
+def test_var_arg_ops_num_args_autofill():
+    """Reference key_var_num_args convention (symbol.py:1056-1058):
+    Concat/ElementWiseSum called bare with positional symbols infer
+    num_args; an explicit num_args still wins."""
+    a, b, c = (mx.sym.Variable(n) for n in "abc")
+    cat = mx.sym.Concat(a, b, c, dim=1)
+    assert len(cat.list_arguments()) == 3
+    s = mx.sym.ElementWiseSum(a, b)
+    assert s.list_arguments() == ["a", "b"]
+    exp = mx.sym.Concat(a, b, num_args=2, dim=0)
+    assert len(exp.list_arguments()) == 2

@@ -117,13 +117,132 @@ def _():
                      name="rnng")
     return net, {"data": (8, 8, 16)}, {}
 
+@case("deconv")
+def _():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Deconvolution(data, kernel=(4, 4), stride=(2, 2),
+                               pad=(1, 1), num_filter=6, name="dc")
+    return net, {"data": (2, 3, 7, 7)}, {}
+
+@case("lrn_leaky")
+def _():
+    data = mx.sym.Variable("data")
+    net = mx.sym.LRN(data, nsize=3, alpha=1e-4, beta=0.75, knorm=2.0)
+    net = mx.sym.LeakyReLU(net, act_type="leaky", slope=0.1)
+    return net, {"data": (2, 8, 6, 6)}, {}
+
+@case("softmax_activation_channel")
+def _():
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxActivation(data, mode="channel")
+    return net, {"data": (2, 5, 4, 4)}, {}
+
+@case("upsampling_bilinear")
+def _():
+    data = mx.sym.Variable("data")
+    net = mx.sym.UpSampling(data, scale=2, sample_type="bilinear",
+                            num_filter=4, name="up")
+    return net, {"data": (2, 4, 5, 5)}, {}
+
+@case("spatial_transformer")
+def _():
+    data = mx.sym.Variable("data")
+    loc = mx.sym.Variable("loc")
+    net = mx.sym.SpatialTransformer(
+        data, loc, target_shape=(6, 6), transform_type="affine",
+        sampler_type="bilinear", name="st")
+    return net, {"data": (2, 3, 8, 8), "loc": (2, 6)}, {}, {
+        # near-identity affine params keep the sample grid in-bounds
+        "loc": lambda rng: (np.tile(
+            np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+            + rng.normal(0, 0.05, (2, 6)).astype(np.float32))}
+
+@case("roi_pooling")
+def _():
+    data = mx.sym.Variable("data")
+    rois = mx.sym.Variable("rois")
+    net = mx.sym.ROIPooling(data, rois, pooled_size=(3, 3),
+                            spatial_scale=1.0, name="roi")
+    return net, {"data": (1, 4, 10, 10), "rois": (3, 5)}, {}, {
+        "rois": lambda rng: np.array(
+            [[0, 1, 1, 7, 7], [0, 0, 0, 9, 9], [0, 2, 3, 6, 8]],
+            np.float32)}
+
+@case("correlation")
+def _():
+    a = mx.sym.Variable("data1")
+    b = mx.sym.Variable("data2")
+    net = mx.sym.Correlation(a, b, kernel_size=1, max_displacement=2,
+                             stride1=1, stride2=1, pad_size=2)
+    return net, {"data1": (1, 3, 8, 8), "data2": (1, 3, 8, 8)}, {}
+
+@case("instance_l2norm")
+def _():
+    data = mx.sym.Variable("data")
+    net = mx.sym.InstanceNorm(data, name="in")
+    net = mx.sym.L2Normalization(net, mode="instance")
+    return net, {"data": (3, 4, 5, 5)}, {}
+
+@case("concat_slice_swap")
+def _():
+    a = mx.sym.Variable("data1")
+    b = mx.sym.Variable("data2")
+    net = mx.sym.Concat(a, b, dim=1)
+    net = mx.sym.SwapAxis(net, dim1=1, dim2=2)
+    parts = mx.sym.SliceChannel(net, num_outputs=2, axis=2)
+    return parts[0] + parts[1], {"data1": (2, 3, 6), "data2": (2, 3, 6)}, {}
+
+@case("pad_crop_pool_avg")
+def _():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Pad(data, mode="edge", pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
+    net = mx.sym.Crop(net, offset=(1, 1), h_w=(6, 6))
+    net = mx.sym.Pooling(net, global_pool=True, pool_type="avg",
+                         kernel=(1, 1))
+    return net, {"data": (2, 3, 6, 6)}, {}
+
+@case("sequence_mask_reverse_last")
+def _():
+    data = mx.sym.Variable("data")
+    lengths = mx.sym.Variable("len")
+    net = mx.sym.SequenceMask(data, use_sequence_length=True,
+                              sequence_length=lengths, value=0.0)
+    net = mx.sym.SequenceReverse(net, use_sequence_length=True,
+                                 sequence_length=lengths)
+    net = mx.sym.SequenceLast(net, use_sequence_length=True,
+                              sequence_length=lengths)
+    return net, {"data": (6, 3, 4), "len": (3,)}, {}, {
+        "len": lambda rng: np.array([2, 6, 4], np.float32)}
+
+@case("dropout_rng_invariance")
+def _():
+    # threefry is bit-identical across backends: the SAME mx seed must
+    # produce the SAME dropout mask on CPU and TPU, making even a
+    # stochastic op cross-platform comparable
+    data = mx.sym.Variable("data")
+    net = mx.sym.Dropout(data, p=0.4)
+    return net * 3.0, {"data": (16, 32)}, {}
+
+@case("embedding_gather_scatter")
+def _():
+    idx = mx.sym.Variable("idx")
+    emb = mx.sym.Embedding(idx, input_dim=11, output_dim=6, name="emb")
+    return mx.sym.sum(emb, axis=(1,)), {"idx": (4, 5)}, {}, {
+        "idx": lambda rng: rng.randint(0, 11, (4, 5)).astype(np.float32)}
+
 name = sys.argv[1]
-sym, shapes, aux_init = cases[name]()
+spec = cases[name]()
+sym, shapes, aux_init = spec[0], spec[1], spec[2]
+arg_init = spec[3] if len(spec) > 3 else {}
 rng = np.random.RandomState(0)
+mx.random.seed(0)   # RNG ops (dropout) draw identical keys on both sides
 exe = sym.simple_bind(mx.tpu(0) if %(tpu)s else mx.cpu(0),
                       grad_req="write", **shapes)
 for k, v in exe.arg_dict.items():
-    v[:] = rng.normal(0, 1, v.shape)
+    if k in arg_init:
+        v[:] = arg_init[k](rng)
+    else:
+        v[:] = rng.normal(0, 1, v.shape)
 for k, v in exe.aux_dict.items():
     v[:] = aux_init.get(k, 0.0)
 outs = exe.forward(is_train=True)
@@ -177,7 +296,17 @@ def _run(case, tpu):
                                   "pool_flatten_dot", "rnn_lstm",
                                   "flash_attention_causal",
                                   "layernorm_gelu",
-                                  "rnn_lstm_pallas", "rnn_gru_pallas"])
+                                  "rnn_lstm_pallas", "rnn_gru_pallas",
+                                  "deconv", "lrn_leaky",
+                                  "softmax_activation_channel",
+                                  "upsampling_bilinear",
+                                  "spatial_transformer", "roi_pooling",
+                                  "correlation", "instance_l2norm",
+                                  "concat_slice_swap",
+                                  "pad_crop_pool_avg",
+                                  "sequence_mask_reverse_last",
+                                  "dropout_rng_invariance",
+                                  "embedding_gather_scatter"])
 def test_tpu_matches_cpu(case):
     cpu = _run(case, tpu=False)
     tpu = _run(case, tpu=True)

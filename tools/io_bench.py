@@ -31,12 +31,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # host-side; batches land as host arrays either way.  Pin jax to CPU so
 # the measurement never blocks on accelerator-backend init (the axon
 # tunnel here drops for hours at a time, and a hung device probe would
-# read as an IO-pipeline hang).  MXTPU_PLATFORMS must be pinned too —
-# mxnet_tpu/__init__.py re-applies it over jax_platforms when set.
+# read as an IO-pipeline hang).  Env-only: jax reads JAX_PLATFORMS at
+# backend init and mxnet_tpu/__init__.py re-applies MXTPU_PLATFORMS,
+# so no eager jax import is needed here.
 os.environ["MXTPU_PLATFORMS"] = "cpu"
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 BASELINE_IMG_PER_SEC = 1000.0  # reference: 4 decode threads, OpenCV
 BASELINE_PER_CORE = BASELINE_IMG_PER_SEC / 4.0  # the comparable unit
