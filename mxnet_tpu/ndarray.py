@@ -299,6 +299,12 @@ def imperative_invoke(op_name, inputs, kwargs, out=None, ctx=None, train=True):
     """Invoke a registered op on NDArrays (reference MXFuncInvoke path,
     src/c_api/c_api.cc:410-436 → registered function → Engine::PushSync)."""
     op = OP_REGISTRY.get(op_name)
+    # var-arg ops infer num_args from the input count, matching the
+    # symbol frontend (reference key_var_num_args fills in BOTH
+    # frontends, python/mxnet/ndarray.py:1128-1305)
+    kv = op.key_var_num_args
+    if kv and kv not in kwargs and inputs:
+        kwargs = {**kwargs, kv: len(inputs)}
     params = op.make_params(kwargs)
     if inputs:
         ctx = _check_same_context(op_name, inputs)

@@ -612,6 +612,11 @@ class UpSamplingParam(Params):
 @register_op("UpSampling")
 class UpSamplingOp(OpDef):
     param_cls = UpSamplingParam
+    # reference upsampling.cc:58 set_key_var_num_args("num_args"): the
+    # positional count fills num_args; bilinear mode IGNORES it for the
+    # signature (ListArguments returns {data, weight} regardless,
+    # upsampling-inl.h:180-189)
+    key_var_num_args = "num_args"
 
     def list_arguments(self, params):
         if params.sample_type == "bilinear":
@@ -621,7 +626,8 @@ class UpSamplingOp(OpDef):
     def infer_shape(self, params, in_shapes):
         d = in_shapes[0]
         oh, ow = d[2] * params.scale, d[3] * params.scale
-        if params.num_args > 1:
+        multi = params.sample_type == "nearest" and params.num_args > 1
+        if multi:
             for s in in_shapes:
                 if s is None:
                     continue
@@ -630,7 +636,7 @@ class UpSamplingOp(OpDef):
                         "UpSampling: input spatial size "
                         f"{(s[2], s[3])} must evenly divide the output "
                         f"{(oh, ow)} (= in0 * scale)")
-        if params.num_args > 1 and params.multi_input_mode == "sum":
+        if multi and params.multi_input_mode == "sum":
             cs = {s[1] for s in in_shapes if s is not None}
             if len(cs) > 1:
                 raise ValueError(
@@ -639,7 +645,7 @@ class UpSamplingOp(OpDef):
             c = d[1]
         else:
             c = (sum(s[1] for s in in_shapes if s is not None)
-                 if params.num_args > 1 else d[1])
+                 if multi else d[1])
         completed = list(in_shapes)
         if params.sample_type == "bilinear":
             k = 2 * params.scale - params.scale % 2

@@ -47,10 +47,10 @@ class OpDef:
     param_cls: type = None
     need_rng: bool = False  # op consumes a PRNG key (Dropout, samplers)
     is_loss: bool = False  # backward ignores head gradient (SoftmaxOutput &co)
-    # name of the param the frontend fills with the positional-input
+    # name of the param the frontends fill with the positional-input
     # count when not given (reference key_var_num_args, an OPT-IN per-op
-    # property: Concat/ElementWiseSum/Crop; NOT UpSampling, where
-    # num_args is the nearest-mode input count, not the call arity)
+    # property: Concat/ElementWiseSum/Crop/UpSampling — the last ignores
+    # it for the signature in bilinear mode, like the reference)
     key_var_num_args: str = None
 
     # -- signature ---------------------------------------------------------

@@ -279,6 +279,15 @@ class ShardedTrainer:
         replicated params shard over the data axis, cutting optimizer
         memory by the dp degree; math is unchanged (XLA gathers shards
         where the update needs them)
+    fsdp : ZeRO-3 — STORE parameters sharded over the data axis
+        (largest dp-divisible dim per param).  XLA all-gathers each
+        param where a layer consumes it and reduce-scatters its
+        gradient, so per-device param+grad+optimizer memory drops by
+        the dp degree while the math is unchanged.  Composes with
+        param_specs (explicit specs win, e.g. tensor-parallel layers)
+        and grad_accum_steps.  ``fsdp_min_size`` (elements) keeps small
+        params replicated — their all-gather latency outweighs the
+        bytes saved
     lr_scheduler : ``mx.lr_scheduler.LRScheduler`` (or any
         ``step -> lr`` callable) evaluated on host each step; the value
         enters the compiled step as a traced scalar, so schedules
@@ -289,7 +298,8 @@ class ShardedTrainer:
                  param_specs=None, sequence_specs=None, optimizer="sgd",
                  optimizer_params=None, initializer=None, dtype="float32",
                  input_dtypes=None, rescale_grad=None, grad_accum_steps=1,
-                 shard_optimizer_state=False, lr_scheduler=None):
+                 shard_optimizer_state=False, lr_scheduler=None,
+                 fsdp=False, fsdp_min_size=2 ** 17):
         if mesh is None:
             from .mesh import local_mesh
 
@@ -327,11 +337,32 @@ class ShardedTrainer:
         initializer = initializer or Uniform(0.07)
         import re
 
+        fsdp_dp = mesh.shape.get(batch_axis, 1) if fsdp else 1
+
+        def fsdp_spec(name):
+            """FSDP / ZeRO-3: STORE the param sharded over the data axis
+            (largest dp-divisible dim); XLA all-gathers it where a layer
+            consumes it and reduce-scatters its gradient — per-device
+            param+grad+state memory drops by the dp degree.  Small
+            params (< fsdp_min_size elements) stay replicated: their
+            all-gather latency outweighs the bytes saved."""
+            shape = name2shape[name]
+            size = int(np.prod(shape)) if shape else 0
+            if fsdp_dp <= 1 or size < fsdp_min_size:
+                return PartitionSpec()
+            dims = [d for d in range(len(shape)) if shape[d] % fsdp_dp == 0]
+            if not dims:
+                return PartitionSpec()
+            dim = max(dims, key=lambda d: shape[d])
+            spec = [None] * len(shape)
+            spec[dim] = batch_axis
+            return PartitionSpec(*spec)
+
         def spec_for(name):
             for pat, spec in (param_specs or {}).items():
                 if pat == name or re.fullmatch(pat, name):
                     return spec
-            return PartitionSpec()
+            return fsdp_spec(name)
 
         self.param_shardings = {n: NamedSharding(mesh, spec_for(n))
                                 for n in self.param_names}

@@ -1051,3 +1051,30 @@ def test_upsampling_bilinear_positional_weight_not_varargs():
                             scale=2, num_filter=4)
     arg_shapes, out_shapes, _ = net.infer_shape(data=(2, 4, 5, 5))
     assert out_shapes[0] == (2, 4, 10, 10)
+
+
+def test_upsampling_nearest_multi_input_positional():
+    """Reference key_var_num_args on UpSampling (upsampling.cc:58): the
+    FCN skip-connection pattern — multiple nearest inputs passed
+    positionally with num_args inferred."""
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    net = mx.sym.UpSampling(a, b, scale=2, sample_type="nearest")
+    arg_shapes, out_shapes, _ = net.infer_shape(a=(1, 3, 4, 4),
+                                                b=(1, 2, 8, 8))
+    # a upsampled 2x to 8x8, b upsampled 1x; channels concat: 3+2
+    assert out_shapes[0] == (1, 5, 8, 8)
+    exe = net.bind(mx.cpu(), args={"a": mx.nd.ones((1, 3, 4, 4)),
+                                   "b": mx.nd.ones((1, 2, 8, 8))})
+    assert exe.forward()[0].shape == (1, 5, 8, 8)
+
+
+def test_var_arg_ops_imperative_autofill():
+    """num_args autofill applies to the NDArray frontend too (the
+    reference fills key_var_num_args in both frontends)."""
+    x = mx.nd.ones((2, 3))
+    y = mx.nd.ones((2, 4))
+    out = mx.nd.Concat(x, y, dim=1)
+    assert out.shape == (2, 7)
+    s = mx.nd.ElementWiseSum(x, x, x)
+    np.testing.assert_allclose(s.asnumpy(), 3.0)
