@@ -451,9 +451,13 @@ def bench_gpt(jax, np, mx, on_tpu, n_chips):
     batch = batch_per_chip * n_chips
 
     fused_qkv = os.environ.get("BENCH_FUSED_QKV", "1") == "1"
+    # sequence-major attention (no BSHD<->BHSD activation transposes —
+    # the only activation transposes in the step HLO); sweepable, off
+    # by default until on-chip numbers pick the winner
+    attn_layout = os.environ.get("BENCH_ATTN_LAYOUT", "bhsd")
     net = mx.models.gpt(vocab, seq_len, num_layers=n_layers,
                         d_model=d_model, num_heads=n_heads,
-                        fused_qkv=fused_qkv)
+                        fused_qkv=fused_qkv, attn_layout=attn_layout)
     _train_throughput(
         jax, np, mx, net,
         input_shapes={"data": (batch, seq_len),
@@ -464,7 +468,7 @@ def bench_gpt(jax, np, mx, on_tpu, n_chips):
         per_chip_divisor=batch * seq_len, baseline=baseline_tokens_per_sec,
         extra_fields={"batch": batch, "seq_len": seq_len,
                       "d_model": d_model, "n_layers": n_layers,
-                      "fused_qkv": fused_qkv},
+                      "fused_qkv": fused_qkv, "attn_layout": attn_layout},
         a100_baseline=True,
         optimizer="adam", optimizer_params={"learning_rate": 3e-4},
         initializer=mx.initializer.Xavier(),

@@ -18,7 +18,7 @@ from .. import symbol as sym
 
 def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
         d_ff=None, dropout=0.0, causal=True, remat=False, fused_qkv=False,
-        name="gpt"):
+        attn_layout="bhsd", name="gpt"):
     """Symbol computing next-token softmax loss.
 
     Inputs: ``data`` (batch, seq_len) token ids; ``softmax_label``
@@ -35,6 +35,12 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
     activation tile is read from HBM once, and one weight layout stays
     resident.  Changes the checkpoint layout (``*_qkv_weight`` replaces
     ``*_{q,k,v}_weight``), so it is opt-in.
+
+    ``attn_layout="bshd"`` keeps activations sequence-major through
+    attention (kernel indexes the head dim; no BSHD<->BHSD transposes —
+    the only activation transposes in the step's HLO).  Same math and
+    checkpoint layout; opt-in pending on-chip measurement
+    (BENCH_ATTN_LAYOUT sweep point).
     """
     if d_model % num_heads:
         raise ValueError("d_model must divide into num_heads")
@@ -78,14 +84,26 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
                 v = sym.FullyConnected(flat, name=f"{p}_v",
                                        num_hidden=d_model)
 
-            def heads(x):
-                x = sym.Reshape(x, shape=(-1, seq_len, num_heads, head_dim))
-                return sym.SwapAxis(x, dim1=1, dim2=2)   # (B, H, S, Dh)
+            if attn_layout == "bshd":
+                # sequence-major: (B, S, H, Dh) straight from the
+                # projection reshape, no transpose in or out
+                def heads(x):
+                    return sym.Reshape(x, shape=(-1, seq_len, num_heads,
+                                                 head_dim))
+            else:
+                def heads(x):
+                    x = sym.Reshape(x, shape=(-1, seq_len, num_heads,
+                                              head_dim))
+                    return sym.SwapAxis(x, dim1=1, dim2=2)   # (B, H, S, Dh)
 
             attn = sym.FlashAttention(heads(q), heads(k), heads(v),
-                                      name=f"{p}_attn", causal=causal)
-            merged = sym.Reshape(sym.SwapAxis(attn, dim1=1, dim2=2),
-                                 shape=(-1, d_model))
+                                      name=f"{p}_attn", causal=causal,
+                                      layout=attn_layout)
+            if attn_layout == "bshd":
+                merged = sym.Reshape(attn, shape=(-1, d_model))
+            else:
+                merged = sym.Reshape(sym.SwapAxis(attn, dim1=1, dim2=2),
+                                     shape=(-1, d_model))
             proj = sym.FullyConnected(merged, name=f"{p}_proj",
                                       num_hidden=d_model)
             if dropout > 0:

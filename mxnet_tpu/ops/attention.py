@@ -71,11 +71,14 @@ class FlashAttentionParam(Params):
     block_q = field(int, default=128)
     block_k = field(int, default=128)
     impl = field(str, default="auto", enum=("auto", "flash", "xla"))
+    layout = field(str, default="bhsd", enum=("bhsd", "bshd"))
 
 
 @register_op("FlashAttention", aliases=("flashattention",))
 class FlashAttentionOp(OpDef):
-    """softmax(Q K^T / sqrt(D)) V over (batch, heads, seq, head_dim).
+    """softmax(Q K^T / sqrt(D)) V over (batch, heads, seq, head_dim)
+    [layout='bhsd'] or (batch, seq, heads, head_dim) [layout='bshd',
+    sequence-major — no activation transpose feeding the kernel].
 
     On TPU with fitting block sizes this lowers to the fused Pallas
     kernel (forward + custom-VJP backward); elsewhere it runs the XLA
@@ -97,7 +100,8 @@ class FlashAttentionOp(OpDef):
         q, k, v = inputs
         from .flash_attention import _on_tpu, flash_attention
 
-        S = q.shape[2]
+        seq_axis = 1 if params.layout == "bshd" else 2
+        S = q.shape[seq_axis]
         use_flash = params.impl == "flash" or (
             params.impl == "auto" and _on_tpu()
             and S % min(params.block_q, S) == 0
@@ -105,12 +109,18 @@ class FlashAttentionOp(OpDef):
         if use_flash:
             out = flash_attention(q, k, v, causal=params.causal,
                                   block_q=params.block_q,
-                                  block_k=params.block_k)
+                                  block_k=params.block_k,
+                                  layout=params.layout)
             return [out], []
         scale = 1.0 / np.sqrt(q.shape[-1])
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        if params.layout == "bshd":
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        else:
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
         if params.causal:
             mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
             s = jnp.where(mask, s, jnp.asarray(-jnp.inf, s.dtype))
         p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+        if params.layout == "bshd":
+            return [jnp.einsum("bhqk,bkhd->bqhd", p, v)], []
         return [jnp.einsum("bhqk,bhkd->bhqd", p, v)], []

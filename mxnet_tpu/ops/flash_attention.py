@@ -80,6 +80,19 @@ def _tile_live(i, j, bq, bk, causal, qo, ko):
 
 # -- forward ------------------------------------------------------------------
 
+def _t(ref):
+    """(blk, D) tile from a (1, blk, D) [BHSD] or (1, blk, 1, D) [BSHD]
+    block ref — the kernel bodies are layout-agnostic through this."""
+    return ref[0] if len(ref.shape) == 3 else ref[0, :, 0, :]
+
+
+def _st(ref, val):
+    if len(ref.shape) == 3:
+        ref[0] = val
+    else:
+        ref[0, :, 0, :] = val
+
+
 def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc, m_sc, l_sc, *, scale, causal, bq, bk, nk):
     j = pl.program_id(2)
@@ -94,8 +107,8 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @_tile_live(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
+        q = _t(q_ref).astype(jnp.float32)
+        k = _t(k_ref).astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
@@ -111,7 +124,7 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_cur)
         l_cur = l_sc[:, 0] * alpha + jnp.sum(p, axis=-1)
-        v = v_ref[0].astype(jnp.float32)
+        v = _t(v_ref).astype(jnp.float32)
         acc[:] = acc[:] * alpha[:, None] + jnp.dot(
             p, v, preferred_element_type=jnp.float32)
         m_sc[:, 0] = m_cur
@@ -122,8 +135,8 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_row = l_sc[:, 0]
         valid = l_row > 0.0           # False only for fully-masked rows
         l_fin = jnp.maximum(l_row, 1e-30)
-        o_ref[0] = jnp.where(valid[:, None], acc[:] / l_fin[:, None],
-                             0.0).astype(o_ref.dtype)
+        _st(o_ref, jnp.where(valid[:, None], acc[:] / l_fin[:, None],
+                             0.0).astype(o_ref.dtype))
         lse_ref[0] = jnp.where(valid, m_sc[:, 0] + jnp.log(l_fin), _NEG_INF)
 
 
@@ -132,28 +145,58 @@ def _scalar_spec():
                         memory_space=pltpu.SMEM)
 
 
+def _dims(q, k):
+    """(BH, Sq, Sk, D, H) for a 3D (BH, S, D) [BHSD, flattened] or 4D
+    (B, S, H, D) [BSHD] tensor pair.  H is None in the 3D case."""
+    if q.ndim == 3:
+        BH, Sq, D = q.shape
+        return BH, Sq, k.shape[1], D, None
+    B, Sq, H, D = q.shape
+    return B * H, Sq, k.shape[1], D, H
+
+
+def _seq_spec(blk, D, H, pick):
+    """Block spec for a Q/K/V/dO-class tensor: one (blk, D) tile per
+    grid step.  BHSD (H=None): blocks of the flattened (BH, S, D)
+    array.  BSHD: blocks of the native (B, S, H, D) array — the head
+    dim is INDEXED (bh %% H), never transposed, so feeding the kernel
+    from sequence-major activations costs no HBM data movement.
+    ``pick`` selects which grid axis is this tensor's sequence block."""
+    if H is None:
+        return pl.BlockSpec((1, blk, D), lambda *g: (g[0], pick(g), 0))
+    return pl.BlockSpec((1, blk, 1, D),
+                        lambda *g: (g[0] // H, pick(g), g[0] % H, 0))
+
+
+def _out_shape(BH, S, D, H, dtype):
+    if H is None:
+        return jax.ShapeDtypeStruct((BH, S, D), dtype)
+    return jax.ShapeDtypeStruct((BH // H, S, H, D), dtype)
+
+
 def _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret):
-    BH, Sq, D = q.shape
-    Sk = k.shape[1]
+    BH, Sq, Sk, D, H = _dims(q, k)
     nq, nk = Sq // bq, Sk // bk
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, nk=nk)
+    qi = lambda g: g[1]
+    ki = lambda g: g[2]
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, nq, nk),
         in_specs=[
             _scalar_spec(),
             _scalar_spec(),
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            _seq_spec(bq, D, H, qi),
+            _seq_spec(bk, D, H, ki),
+            _seq_spec(bk, D, H, ki),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            _seq_spec(bq, D, H, qi),
             pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+            _out_shape(BH, Sq, D, H, q.dtype),
             jax.ShapeDtypeStruct((BH, Sq), jnp.float32),
         ],
         scratch_shapes=[
@@ -181,10 +224,10 @@ def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @_tile_live(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = _t(q_ref).astype(jnp.float32)
+        k = _t(k_ref).astype(jnp.float32)
+        v = _t(v_ref).astype(jnp.float32)
+        do = _t(do_ref).astype(jnp.float32)
         lse = lse_ref[0]
         delta = delta_ref[0]
         dlse = dlse_ref[0]
@@ -205,7 +248,7 @@ def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(j == nk - 1)
     def _():
-        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+        _st(dq_ref, dq_acc[:].astype(dq_ref.dtype))
 
 
 def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -222,10 +265,10 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @_tile_live(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = _t(q_ref).astype(jnp.float32)
+        k = _t(k_ref).astype(jnp.float32)
+        v = _t(v_ref).astype(jnp.float32)
+        do = _t(do_ref).astype(jnp.float32)
         lse = lse_ref[0]
         delta = delta_ref[0]
         dlse = dlse_ref[0]
@@ -248,22 +291,26 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(i == nq - 1)
     def _():
-        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+        _st(dk_ref, dk_acc[:].astype(dk_ref.dtype))
+        _st(dv_ref, dv_acc[:].astype(dv_ref.dtype))
 
 
 def _bwd(scale, causal, bq, bk, interpret, res, g):
     q, k, v, qo, ko, o, lse = res
     do, dlse_in = g
-    BH, Sq, D = q.shape
-    Sk = k.shape[1]
+    BH, Sq, Sk, D, H = _dims(q, k)
     nq, nk = Sq // bq, Sk // bk
 
     do = do.astype(jnp.float32)
     dlse = (jnp.zeros_like(lse) if dlse_in is None
             else dlse_in.astype(jnp.float32))
     delta = jnp.sum(do * o.astype(jnp.float32), axis=-1)
+    if H is not None:
+        # (B, Sq, H) -> the kernels' (BH, Sq) row layout; tiny (no D dim)
+        delta = jnp.moveaxis(delta, 1, 2).reshape(BH, Sq)
 
+    qi = lambda g: g[1]
+    ki = lambda g: g[2]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk),
@@ -271,20 +318,22 @@ def _bwd(scale, causal, bq, bk, interpret, res, g):
         in_specs=[
             _scalar_spec(),
             _scalar_spec(),
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            _seq_spec(bq, D, H, qi),
+            _seq_spec(bk, D, H, ki),
+            _seq_spec(bk, D, H, ki),
+            _seq_spec(bq, D, H, qi),
             pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
             pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
             pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+        out_specs=_seq_spec(bq, D, H, qi),
+        out_shape=_out_shape(BH, Sq, D, H, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
     )(qo, ko, q, k, v, do, lse, delta, dlse)
 
+    qj = lambda g: g[2]
+    kj = lambda g: g[1]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq),
@@ -292,21 +341,21 @@ def _bwd(scale, causal, bq, bk, interpret, res, g):
         in_specs=[
             _scalar_spec(),
             _scalar_spec(),
-            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            _seq_spec(bq, D, H, qj),
+            _seq_spec(bk, D, H, kj),
+            _seq_spec(bk, D, H, kj),
+            _seq_spec(bq, D, H, qj),
             pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
             pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
             pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            _seq_spec(bk, D, H, kj),
+            _seq_spec(bk, D, H, kj),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
+            _out_shape(BH, Sk, D, H, k.dtype),
+            _out_shape(BH, Sk, D, H, v.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
@@ -330,10 +379,18 @@ _flash.defvjp(_flash_fwd, _bwd)
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
                     block_k=128, q_offset=0, k_offset=0, return_lse=False,
-                    interpret=None):
+                    interpret=None, layout="bhsd"):
     """Fused multi-head attention: softmax(QK^T * scale) V.
 
-    q: (B, H, Sq, D); k/v: (B, H, Sk, D).  Differentiable (custom VJP).
+    ``layout="bhsd"``: q (B, H, Sq, D), k/v (B, H, Sk, D) — the
+    classic shape.  ``layout="bshd"``: q (B, Sq, H, D), k/v
+    (B, Sk, H, D) — sequence-major, fed to the kernel with the head dim
+    INDEXED in the block specs, so activations coming from a
+    (B, S, D)-major transformer stack need no HBM transpose on the way
+    in or out (the per-layer BSHD<->BHSD shuffles are the only
+    activation transposes in the GPT train step's HLO).  Differentiable
+    (custom VJP) either way; output matches the input layout.
+
     Sequence lengths must be divisible by the (clamped) block sizes.
     ``q_offset``/``k_offset`` shift the causal-mask positions (may be
     traced values — used for ring-attention shards).  With
@@ -341,22 +398,30 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     returned (differentiable).  Off-TPU the kernels run in the Pallas
     interpreter unless ``interpret`` is explicitly set.
     """
-    B, H, Sq, D = q.shape
-    Sk = k.shape[2]
+    if layout == "bshd":
+        B, Sq, H, D = q.shape
+        Sk = k.shape[1]
+    else:
+        B, H, Sq, D = q.shape
+        Sk = k.shape[2]
     if scale is None:
         scale = float(1.0 / np.sqrt(D))
     if interpret is None:
         interpret = not _on_tpu()
     bq, bk = _block_sizes(Sq, Sk, block_q, block_k)
 
-    qf = q.reshape(B * H, Sq, D)
-    kf = k.reshape(B * H, Sk, D)
-    vf = v.reshape(B * H, Sk, D)
+    if layout == "bshd":
+        qf, kf, vf = q, k, v              # native 4D, no data movement
+    else:
+        qf = q.reshape(B * H, Sq, D)
+        kf = k.reshape(B * H, Sk, D)
+        vf = v.reshape(B * H, Sk, D)
     qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
     ko = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
     o, lse = _flash(qf, kf, vf, qo, ko, scale, bool(causal), bq, bk,
                     bool(interpret))
-    o = o.reshape(B, H, Sq, D)
+    if layout != "bshd":
+        o = o.reshape(B, H, Sq, D)
     if return_lse:
         return o, lse.reshape(B, H, Sq)
     return o

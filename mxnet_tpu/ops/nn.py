@@ -594,6 +594,15 @@ class EmbeddingOp(OpDef):
         w = (params.input_dim, params.output_dim)
         return [tuple(d), w], [tuple(d) + (params.output_dim,)], []
 
+    def infer_dtype(self, params, in_dtypes):
+        """Output/weight type is the TABLE's type, never the index
+        type: integer ids (the TPU-friendly input) must not leak int32
+        into every downstream parameter through the default
+        first-known-input rule."""
+        w = in_dtypes[1] if in_dtypes[1] is not None else np.dtype(np.float32)
+        d = in_dtypes[0] if in_dtypes[0] is not None else w
+        return [d, w], [w], []
+
     def forward(self, params, inputs, aux, train, key):
         idx = inputs[0].astype(jnp.int32)
         return [jnp.take(inputs[1], idx, axis=0)], []

@@ -396,3 +396,35 @@ def test_pipeline_dp_tp_pp_composition():
         got = np.asarray(jax.device_get(pmod.params[k]))
         want = w0 - 0.01 * np.asarray(jax.device_get(dense_grads[k]))
         np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+
+
+def test_flash_attention_bshd_layout_parity():
+    """Sequence-major (BSHD) kernel path: forward and gradients match
+    the BHSD path bit-for-tolerance; blocks index the head dim instead
+    of transposing activations."""
+    from mxnet_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.RandomState(3)
+    B, H, S, D = 2, 3, 64, 16
+    q = jnp.asarray(rng.standard_normal((B, H, S, D)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((B, H, S, D)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((B, H, S, D)).astype(np.float32))
+    qs, ks, vs = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+
+    for causal in (False, True):
+        o = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        os_ = flash_attention(qs, ks, vs, causal=causal, block_q=32,
+                              block_k=32, layout="bshd")
+        np.testing.assert_allclose(np.asarray(os_.transpose(0, 2, 1, 3)),
+                                   np.asarray(o), atol=1e-5, rtol=1e-5)
+
+        g_ref = jax.grad(lambda a, b, c: jnp.sum(flash_attention(
+            a, b, c, causal=causal, block_q=32, block_k=32) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        g_bshd = jax.grad(lambda a, b, c: jnp.sum(flash_attention(
+            a, b, c, causal=causal, block_q=32, block_k=32,
+            layout="bshd") ** 2), argnums=(0, 1, 2))(qs, ks, vs)
+        for gr, gs in zip(g_ref, g_bshd):
+            np.testing.assert_allclose(
+                np.asarray(gs.transpose(0, 2, 1, 3)), np.asarray(gr),
+                atol=1e-4, rtol=1e-4)

@@ -190,3 +190,60 @@ def test_gpt_fused_qkv_matches_plain():
     np.testing.assert_allclose(exe_f.outputs[0].asnumpy(),
                                exe_p.outputs[0].asnumpy(),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_gpt_attn_layout_bshd_matches_bhsd():
+    """attn_layout='bshd' removes the per-layer activation transposes;
+    same params must give the same loss/gradients as the default."""
+    vocab, seq_len = 97, 32
+    common = dict(num_layers=2, d_model=32, num_heads=4)
+    a = mx.models.gpt(vocab, seq_len, **common)
+    b = mx.models.gpt(vocab, seq_len, attn_layout="bshd", **common)
+    assert a.list_arguments() == b.list_arguments()  # same checkpoint
+
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, vocab, (2, seq_len))
+    label = rng.randint(0, vocab, (2, seq_len)).astype(np.float32)
+
+    def run(net):
+        exe = net.simple_bind(mx.cpu(), data=(2, seq_len),
+                              softmax_label=(2, seq_len),
+                              type_dict={"data": np.int32})
+        for name, arr in exe.arg_dict.items():
+            if name == "data":
+                arr[:] = data
+            elif name == "softmax_label":
+                arr[:] = label
+            else:
+                arr[:] = rng2.uniform(-0.1, 0.1, arr.shape)
+        out = exe.forward(is_train=True)[0].asnumpy()
+        exe.backward()
+        return out, {k: g.asnumpy() for k, g in exe.grad_dict.items()
+                     if k not in ("data", "softmax_label")}
+
+    rng2 = np.random.RandomState(1)
+    out_a, g_a = run(a)
+    rng2 = np.random.RandomState(1)
+    out_b, g_b = run(b)
+    np.testing.assert_allclose(out_b, out_a, atol=2e-5, rtol=1e-4)
+    for k in g_a:
+        np.testing.assert_allclose(g_b[k], g_a[k], atol=2e-4, rtol=2e-3,
+                                   err_msg=k)
+
+
+def test_gpt_bshd_removes_activation_transposes():
+    """The structural claim: the bshd model's graph has NO SwapAxis
+    (BSHD<->BHSD shuffle) nodes — the bhsd model has 4 per layer
+    (q/k/v on the way in, attention output on the way out).  (On
+    TPU the flash kernel consumes BSHD natively; the HLO-level transpose
+    audit lives in BENCH_NOTES.md and the BENCH_ATTN_LAYOUT sweep
+    point measures the effect on chip.)"""
+
+    def count_swaps(attn_layout):
+        net = mx.models.gpt(211, 32, num_layers=3, d_model=32, num_heads=4,
+                            attn_layout=attn_layout)
+        return sum(1 for n in net._topo()
+                   if not n.is_variable and n.op.name == "SwapAxis")
+
+    assert count_swaps("bhsd") == 12   # 4 per layer (q, k, v in; out)
+    assert count_swaps("bshd") == 0
