@@ -554,3 +554,48 @@ def test_fsdp_parity_and_sharding():
     assert tuple(both.param_shardings["fc1_weight"].spec) == ("tp", None)
     assert "dp" in tuple(both.param_shardings["fc2_weight"].spec)
     jax.block_until_ready(both.step(feed))
+
+
+def test_fsdp_checkpoint_reshard_roundtrip(tmp_path):
+    """FSDP-sharded params save through the sharded checkpoint path and
+    reload into a trainer with a DIFFERENT sharding (replicated) and
+    vice versa — the reshard-on-load contract covers ZeRO-3 storage."""
+    mesh = mx.parallel.make_mesh({"dp": 8})
+    net = mx.models.mlp(num_classes=4)
+    shapes = {"data": (16, 16), "softmax_label": (16,)}
+    kw = dict(mesh=mesh, optimizer="sgd",
+              optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+              initializer=mx.initializer.Xavier())
+
+    mx.random.seed(1)
+    fsdp = mx.parallel.ShardedTrainer(net, shapes, fsdp=True,
+                                      fsdp_min_size=64, **kw)
+    rng = np.random.RandomState(0)
+    feed = {"data": rng.randn(16, 16).astype(np.float32),
+            "softmax_label": rng.randint(0, 4, 16).astype(np.float32)}
+    fsdp.step(feed)
+    ckpt = str(tmp_path / "fsdp_ck")
+    fsdp.save_checkpoint_sharded(ckpt, 1)
+
+    # reload into a REPLICATED trainer (reshard-on-load)
+    mx.random.seed(2)
+    rep = mx.parallel.ShardedTrainer(net, shapes, **kw)
+    rep.load_checkpoint_sharded(ckpt, 1)
+    for k, v in fsdp.get_params().items():
+        np.testing.assert_allclose(rep.get_params()[k], v, atol=1e-6,
+                                   err_msg=k)
+    # and back into an FSDP trainer from the replicated one's save
+    ckpt2 = str(tmp_path / "rep_ck")
+    rep.save_checkpoint_sharded(ckpt2, 1)
+    mx.random.seed(3)
+    fsdp2 = mx.parallel.ShardedTrainer(net, shapes, fsdp=True,
+                                       fsdp_min_size=64, **kw)
+    fsdp2.load_checkpoint_sharded(ckpt2, 1)
+    key = np.asarray(jax.device_get(fsdp._key))
+    for t in (fsdp, fsdp2):
+        t._key = jax.device_put(key, t._replicated)
+    fsdp.step(feed)
+    fsdp2.step(feed)
+    for k, v in fsdp.get_params().items():
+        np.testing.assert_allclose(fsdp2.get_params()[k], v, atol=1e-5,
+                                   err_msg=k)
