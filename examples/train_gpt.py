@@ -52,6 +52,13 @@ def main():
     p.add_argument("--data", default=None, help="utf-8 text file")
     p.add_argument("--trainer", default="module",
                    choices=["module", "sharded"])
+    p.add_argument("--attn-layout", default="bhsd",
+                   choices=["bhsd", "bshd"],
+                   help="bshd = sequence-major attention (no activation "
+                        "transposes; see BENCH_NOTES.md)")
+    p.add_argument("--fsdp", action="store_true",
+                   help="ZeRO-3: store params sharded over dp "
+                        "(--trainer sharded only)")
     p.add_argument("--generate", type=int, default=0, metavar="N",
                    help="after training, KV-cache-decode N tokens from a "
                         "corpus prompt (models/generate.py)")
@@ -78,7 +85,8 @@ def main():
         tokens = synthetic_corpus(50000, args.vocab)
 
     net = mx.models.gpt(args.vocab, args.seq_len, num_layers=args.num_layers,
-                        d_model=args.d_model, num_heads=args.num_heads)
+                        d_model=args.d_model, num_heads=args.num_heads,
+                        attn_layout=args.attn_layout)
 
     if args.trainer == "sharded":
         mesh = mx.parallel.local_mesh("dp")
@@ -88,7 +96,7 @@ def main():
             mesh=mesh, optimizer="adam",
             optimizer_params={"learning_rate": args.lr},
             initializer=mx.init.Xavier(),
-            input_dtypes={"data": np.float32})
+            input_dtypes={"data": np.float32}, fsdp=args.fsdp)
         for step in range(args.steps):
             x, y = batches(tokens, args.batch_size, args.seq_len, rng)
             outs = tr.step({"data": x, "softmax_label": y})
