@@ -43,6 +43,7 @@ from . import optimizer as opt
 from . import metric
 from . import lr_scheduler
 from . import callback
+from . import misc
 from . import monitor
 from . import monitor as mon  # reference: mx.mon.Monitor
 from . import profiler

@@ -9,6 +9,8 @@ exception types and small helpers used across the package.
 
 from __future__ import annotations
 
+import ctypes
+
 import numpy as np
 
 __all__ = [
@@ -18,7 +20,47 @@ __all__ = [
     "np_dtype",
     "dtype_name",
     "DTYPE_NAMES",
+    "c_array",
+    "c_str",
+    "ctypes2buffer",
+    "ctypes2numpy_shared",
 ]
+
+
+def c_array(ctype, values):
+    """ctypes array from a python sequence (reference base.py c_array)
+    — used by C-ABI consumers of this package (libinfo/c_api_bridge)."""
+    return (ctype * len(values))(*values)
+
+
+def c_str(string):
+    """ctypes char pointer from a python string (reference base.py)."""
+    return ctypes.c_char_p(string.encode("utf-8"))
+
+
+def ctypes2buffer(cptr, length):
+    """Copy a ctypes char pointer into a bytearray (reference
+    base.py ctypes2buffer)."""
+    if not isinstance(cptr, ctypes.POINTER(ctypes.c_char)):
+        raise TypeError("expected char pointer")
+    res = bytearray(length)
+    rptr = (ctypes.c_char * length).from_buffer(res)
+    if not ctypes.memmove(rptr, cptr, length):
+        raise RuntimeError("memmove failed")
+    return res
+
+
+def ctypes2numpy_shared(cptr, shape):
+    """Zero-copy numpy view over ctypes float memory (reference
+    base.py ctypes2numpy_shared)."""
+    if not isinstance(cptr, ctypes.POINTER(ctypes.c_float)):
+        raise TypeError("expected float pointer")
+    size = 1
+    for s in shape:
+        size *= s
+    dbuffer = (ctypes.c_float * size).from_address(
+        ctypes.addressof(cptr.contents))
+    return np.frombuffer(dbuffer, dtype=np.float32).reshape(shape)
 
 
 class MXNetError(Exception):

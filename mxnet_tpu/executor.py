@@ -757,6 +757,20 @@ class Executor:
             raise MXNetError("run forward() first")
         return self._outputs
 
+    @property
+    def output_dict(self):
+        """Name -> output NDArray (reference executor.py:215-233; raises
+        on duplicated output names like the reference)."""
+        outs = self.outputs
+        d = {}
+        for name, arr in zip(self.output_names, outs):
+            if name in d:
+                raise MXNetError(
+                    f"duplicate output name {name!r}: use `outputs` for "
+                    "positional access")
+            d[name] = arr
+        return d
+
     # -- misc API -----------------------------------------------------------
     def set_monitor_callback(self, callback):
         """Install per-output stat callback; switches to eager (un-fused)

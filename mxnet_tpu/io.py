@@ -169,6 +169,11 @@ class NDArrayIter(DataIter):
         return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
                 for k, v in self.label]
 
+    def hard_reset(self):
+        """Ignore roll_over: rewind to the exact start (reference
+        io.py:477)."""
+        self.cursor = -self.batch_size
+
     def reset(self):
         if self.last_batch_handle == "roll_over" and self.cursor > self.num_data:
             self.cursor = -self.batch_size + (self.cursor % self.num_data) % self.batch_size

@@ -105,6 +105,20 @@ def _declare(lib):
     return lib
 
 
+def find_lib_path():
+    """Paths of the native library (reference libinfo.py find_lib_path
+    contract: non-empty list or RuntimeError).  Triggers the lazy build
+    the same way loading does, so a fresh checkout with a toolchain
+    still returns a usable path."""
+    find_lib()
+    if not os.path.exists(_LIB_PATH):
+        raise RuntimeError(
+            f"Cannot find the native library: tried {_LIB_PATH} and "
+            f"building from {_SRC_DIR} failed (set MXNET_TPU_NO_NATIVE "
+            "to run pure-Python)")
+    return [_LIB_PATH]
+
+
 def find_lib():
     """Load (building if needed) the native library, or None."""
     global _LIB, _TRIED

@@ -215,6 +215,15 @@ class CompositeEvalMetric(EvalMetric):
     def add(self, metric):
         self.metrics.append(metric)
 
+    def get_metric(self, index):
+        """Child metric by position (reference metric.py:96)."""
+        try:
+            return self.metrics[index]
+        except IndexError:
+            raise ValueError(
+                f"Metric index {index} is out of range 0 and "
+                f"{len(self.metrics)}") from None
+
     def reset(self):
         for m in getattr(self, "metrics", []):
             m.reset()

@@ -1,0 +1,38 @@
+"""Legacy helpers (reference python/mxnet/misc.py).
+
+The reference's ``misc.LearningRateScheduler`` predates
+``lr_scheduler.LRScheduler``; it survives there as a deprecated alias
+and does here too — new code should use ``mx.lr_scheduler``.
+"""
+
+from __future__ import annotations
+
+__all__ = ["LearningRateScheduler", "FactorScheduler"]
+
+
+class LearningRateScheduler:
+    """Base class of the legacy scheduler API (reference misc.py:7-34):
+    a callable ``iteration -> learning rate`` carrying ``base_lr``."""
+
+    def __init__(self):
+        self.base_lr = 0.01
+
+    def __call__(self, iteration):
+        raise NotImplementedError("must override this")
+
+
+class FactorScheduler(LearningRateScheduler):
+    """lr = base_lr * factor^(iteration // step) (reference
+    misc.py FactorScheduler)."""
+
+    def __init__(self, step, factor=0.1):
+        super().__init__()
+        if step < 1:
+            raise ValueError("Schedule step must be greater or equal than 1")
+        if factor >= 1.0:
+            raise ValueError("Factor must be less than 1 to make lr reduce")
+        self.step = step
+        self.factor = factor
+
+    def __call__(self, iteration):
+        return self.base_lr * (self.factor ** int(iteration / self.step))

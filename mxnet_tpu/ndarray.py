@@ -37,6 +37,7 @@ __all__ = [
     "NDArray", "array", "empty", "zeros", "ones", "full", "arange",
     "concatenate", "save", "load", "imperative_invoke", "onehot_encode",
     "choose_element_0index", "fill_element_0index", "waitall",
+    "add", "subtract", "multiply", "divide", "true_divide",
 ]
 
 # Generated op functions (sum, max, slice, abs, ...) shadow builtins in this
@@ -483,6 +484,38 @@ def minimum(lhs, rhs):
     """Elementwise min with NDArray/Number operands (ndarray.py:825)."""
     return _mixed_nd_binary(lhs, rhs, "_minimum", "_minimum_scalar",
                             "_minimum_scalar", builtins.min, "minimum")
+
+
+def add(lhs, rhs):
+    """Elementwise sum, either operand an NDArray or scalar (reference
+    ndarray.py:669)."""
+    if isinstance(lhs, NDArray):
+        return lhs + rhs
+    return rhs + lhs
+
+
+def subtract(lhs, rhs):
+    """Elementwise difference (reference ndarray.py:695)."""
+    if isinstance(lhs, NDArray):
+        return lhs - rhs
+    return rhs.__rsub__(lhs)
+
+
+def multiply(lhs, rhs):
+    """Elementwise product (reference ndarray.py:721)."""
+    if isinstance(lhs, NDArray):
+        return lhs * rhs
+    return rhs * lhs
+
+
+def divide(lhs, rhs):
+    """Elementwise quotient (reference ndarray.py:747)."""
+    if isinstance(lhs, NDArray):
+        return lhs / rhs
+    return rhs.__rtruediv__(lhs)
+
+
+true_divide = divide
 
 
 def waitall():

@@ -24,8 +24,8 @@ from .ndarray import NDArray
 from .ops.op import OpDef, OP_REGISTRY
 from .registry import Registry
 
-__all__ = ["CustomOp", "CustomOpProp", "register", "NumpyOp", "NDArrayOp",
-           "get_all_registered_operators"]
+__all__ = ["CustomOp", "CustomOpProp", "register", "PythonOp", "NumpyOp",
+           "NDArrayOp", "get_all_registered_operators"]
 
 _CUSTOM_REGISTRY = Registry("custom-op")
 
@@ -103,6 +103,17 @@ class CustomOpProp:
 
     def need_top_grad(self):
         return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        """Ids of blobs backward needs (reference operator.py custom-op
+        default).  Informational here: jax.vjp tracks true dependencies
+        and XLA prunes the rest — kept for API parity."""
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
 
     def create_operator(self, ctx, shapes, dtypes):
         raise NotImplementedError
@@ -222,13 +233,20 @@ def get_all_registered_operators():
     return OP_REGISTRY.list()
 
 
-class NumpyOp:
-    """Legacy callback op over numpy buffers (reference operator.py
-    NumpyOp / _Native).  Subclass and call ``get_symbol``."""
+class PythonOp:
+    """Base class of legacy python operators (reference operator.py:19
+    PythonOp): callable symbol factory with need_top_grad metadata.
+    Subclasses: NumpyOp (raw-buffer callbacks), NDArrayOp (NDArray
+    callbacks)."""
 
     def __init__(self, need_top_grad=True):
         self.need_top_grad_ = need_top_grad
         self._registered = None
+
+    def need_top_grad(self):
+        """Whether backward needs the head gradient (reference
+        operator.py:110)."""
+        return self.need_top_grad_
 
     def list_arguments(self):
         return ["data"]
@@ -310,6 +328,23 @@ class NumpyOp:
         return getattr(sym_mod, name)(*args, **kwargs)
 
 
-class NDArrayOp(NumpyOp):
+class NumpyOp(PythonOp):
+    """Legacy callback op over numpy buffers (reference operator.py
+    NumpyOp / _Native).  Subclass and call ``get_symbol``."""
+
+
+class NDArrayOp(PythonOp):
     """Legacy callback op over NDArrays (reference operator.py NDArrayOp).
     Same bridge as NumpyOp here: callbacks receive numpy views."""
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        """Ids of blobs backward needs (reference operator.py:372-393).
+        Informational here: jax.vjp tracks true data dependencies and
+        XLA dead-code-eliminates the rest, so the declaration cannot
+        cause stale-buffer bugs — kept for API parity."""
+        deps = []
+        if self.need_top_grad():
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps

@@ -123,6 +123,32 @@ class Optimizer:
     def create_optimizer(name, rescale_grad=1.0, **kwargs):
         return OPT_REGISTRY.get(name)(rescale_grad=rescale_grad, **kwargs)
 
+    @staticmethod
+    def register(klass):
+        """Register an optimizer class under its lowercased name
+        (reference optimizer.py:17-28; usable as a decorator).  Like
+        the reference, an existing name is OVERRIDDEN with a warning —
+        users replace built-ins this way."""
+        import warnings
+
+        name = klass.__name__.lower()
+        prev = OPT_REGISTRY._entries.get(name)
+        if prev is not None and prev is not klass:
+            warnings.warn(
+                f"New optimizer {klass.__module__}.{klass.__name__} is "
+                f"overriding existing optimizer {prev.__module__}."
+                f"{prev.__name__}")
+            OPT_REGISTRY._entries[name] = klass
+        else:
+            OPT_REGISTRY.register(name)(klass)
+        return klass
+
+    def set_lr_scale(self, args_lrscale):
+        """Deprecated since the reference itself (optimizer.py:126-128);
+        use ``set_lr_mult``."""
+        raise DeprecationWarning("set_lr_scale is deprecated; use "
+                                 "set_lr_mult")
+
 
 create = Optimizer.create_optimizer
 

@@ -58,17 +58,43 @@ class AttrScope:
         AttrScope._current.value = self._old
         return False
 
+    def get(self, attr):
+        """Merge user-passed attrs over this scope's attrs (reference
+        attribute.py:26-44): scope values are defaults, explicit symbol
+        attrs win."""
+        if self._attrs:
+            ret = self._attrs.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+
+class _NameGet:
+    """``NameManager.get()`` (classmethod style) returns the current
+    manager — this build's internal accessor; ``manager.get(name, hint)``
+    (instance style) is the reference canonical-name API
+    (python/mxnet/name.py:16): the user name wins, else an auto name
+    from the hint."""
+
+    def __get__(self, obj, objtype):
+        if obj is None:
+            return objtype._current_manager
+        return obj._ref_get
+
 
 class NameManager:
     """Automatic unique naming (python/mxnet/name.py)."""
 
     _current = threading.local()
 
+    get = _NameGet()
+
     def __init__(self):
         self._counter = {}
 
     @classmethod
-    def get(cls):
+    def _current_manager(cls):
         if getattr(cls._current, "value", None) is None:
             cls._current.value = NameManager()
         return cls._current.value
@@ -77,6 +103,11 @@ class NameManager:
         idx = self._counter.get(hint, 0)
         self._counter[hint] = idx + 1
         return f"{hint}{idx}"
+
+    def _ref_get(self, name, hint):
+        """Reference name.py:16-38 canonical-name rule: a truthy user
+        name wins, else an auto name from the hint."""
+        return name if name else self.next_name(hint)
 
     def __enter__(self):
         self._old = getattr(NameManager._current, "value", None)
@@ -98,6 +129,13 @@ class Prefix(NameManager):
 
     def next_name(self, hint: str) -> str:
         return self._prefix + super().next_name(hint)
+
+    def _ref_get(self, name, hint):
+        """Reference name.py:73-75: the prefix applies to USER names
+        too (``super().get`` then prepend)."""
+        if name:
+            return self._prefix + name
+        return self.next_name(hint)   # already prefixed
 
 
 class Node:

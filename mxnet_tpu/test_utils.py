@@ -18,14 +18,58 @@ from . import ndarray as nd
 from . import symbol as sym_mod
 from .base import MXNetError
 
-__all__ = ["default_context", "rand_ndarray", "check_numeric_gradient",
+__all__ = ["default_context", "set_default_context", "default_dtype",
+           "default_numerical_threshold", "rand_ndarray", "random_arrays",
+           "np_reduce", "check_numeric_gradient",
            "check_symbolic_forward", "check_symbolic_backward",
            "check_consistency", "check_speed", "reldiff", "same",
-           "assert_almost_equal", "simple_forward"]
+           "almost_equal", "assert_almost_equal", "simple_forward"]
 
 
 def default_context():
     return ctx_mod.current_context()
+
+
+def set_default_context(ctx):
+    """Set the default context (reference test_utils.py:24)."""
+    ctx_mod.Context._default_ctx.value = ctx
+
+
+def default_dtype():
+    """Default dtype for regression tests (reference test_utils.py:28)."""
+    return np.float32
+
+
+def default_numerical_threshold():
+    """Default numerical tolerance (reference test_utils.py:34)."""
+    return 1e-6
+
+
+def random_arrays(*shapes):
+    """Random numpy arrays, one per shape; a lone shape returns the bare
+    array (reference test_utils.py:41)."""
+    arrays = [np.random.randn(*s).astype(default_dtype()) for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    """Reduce over (possibly multiple) axes with optional kept dims
+    (reference test_utils.py:50) — the comparison twin for reduce ops."""
+    if isinstance(axis, int):
+        axis = [axis]
+    else:
+        axis = list(axis) if axis is not None else range(len(dat.shape))
+    ret = dat
+    for i in reversed(sorted(axis)):
+        ret = numpy_reduce_func(ret, axis=i)
+    if keepdims:
+        keepdims_shape = list(dat.shape)
+        for i in axis:
+            keepdims_shape[i] = 1
+        ret = ret.reshape(tuple(keepdims_shape))
+    return ret
 
 
 def same(a, b):
@@ -36,6 +80,14 @@ def reldiff(a, b):
     diff = np.abs(a - b).sum()
     norm = (np.abs(a) + np.abs(b)).sum()
     return diff / norm if norm != 0 else diff
+
+
+def almost_equal(a, b, threshold=None):
+    """True when two arrays agree within reldiff threshold (reference
+    test_utils.py:111)."""
+    threshold = threshold or default_numerical_threshold()
+    rel = reldiff(np.asarray(a), np.asarray(b))
+    return not np.isnan(rel) and rel <= threshold
 
 
 def assert_almost_equal(a, b, threshold=1e-5, rtol=None, atol=None):

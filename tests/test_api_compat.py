@@ -1,0 +1,188 @@
+"""Reference public-API surface corners (round-3 sweep): names reference
+users call that have no dedicated suite elsewhere.  Sources cited per
+item against /root/reference/python/mxnet/."""
+
+import ctypes
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_nd_module_ufuncs():
+    """add/subtract/multiply/divide/true_divide with scalar on either
+    side (reference ndarray.py:669-860)."""
+    x = mx.nd.full((3,), 4.0)
+    np.testing.assert_allclose(mx.nd.add(1.0, x).asnumpy(), 5.0)
+    np.testing.assert_allclose(mx.nd.add(x, x).asnumpy(), 8.0)
+    np.testing.assert_allclose(mx.nd.subtract(6.0, x).asnumpy(), 2.0)
+    np.testing.assert_allclose(mx.nd.multiply(0.5, x).asnumpy(), 2.0)
+    np.testing.assert_allclose(mx.nd.divide(8.0, x).asnumpy(), 2.0)
+    assert mx.nd.true_divide is mx.nd.divide
+
+
+def test_executor_output_dict():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    exe = net.simple_bind(mx.cpu(), data=(2, 4))
+    exe.forward()
+    d = exe.output_dict
+    assert list(d) == ["fc_output"]
+    assert d["fc_output"] is exe.outputs[0]
+
+
+def test_ndarrayiter_hard_reset():
+    it = mx.io.NDArrayIter(np.arange(12).reshape(6, 2), np.zeros(6), 2,
+                           last_batch_handle="roll_over")
+    for _ in it:
+        pass
+    it.hard_reset()
+    first = next(it)
+    np.testing.assert_allclose(first.data[0].asnumpy(),
+                               [[0, 1], [2, 3]])
+
+
+def test_python_op_hierarchy_and_backward_deps():
+    """PythonOp base + declare_backward_dependency defaults (reference
+    operator.py:19, :372-393)."""
+    assert issubclass(mx.operator.NumpyOp, mx.operator.PythonOp)
+    assert issubclass(mx.operator.NDArrayOp, mx.operator.PythonOp)
+    op = mx.operator.NDArrayOp(need_top_grad=True)
+    assert op.need_top_grad()
+    assert op.declare_backward_dependency([9], [1, 2], [5]) == [9, 1, 2, 5]
+    op2 = mx.operator.NDArrayOp(need_top_grad=False)
+    assert op2.declare_backward_dependency([9], [1, 2], [5]) == [1, 2, 5]
+    prop = mx.operator.CustomOpProp(need_top_grad=False)
+    assert prop.declare_backward_dependency([9], [1], [5]) == [1, 5]
+
+
+def test_test_utils_helpers():
+    a = np.ones((2, 3))
+    assert mx.test_utils.almost_equal(a, a + 1e-9)
+    assert not mx.test_utils.almost_equal(a, a + 1.0)
+    np.testing.assert_allclose(
+        mx.test_utils.np_reduce(np.arange(6.0).reshape(2, 3), 1, True,
+                                np.sum),
+        np.array([[3.0], [12.0]]))
+    arrs = mx.test_utils.random_arrays((2, 2), (3,))
+    assert arrs[0].shape == (2, 2) and arrs[1].shape == (3,)
+    assert mx.test_utils.default_dtype() is np.float32
+    assert mx.test_utils.default_numerical_threshold() < 1e-4
+
+    old = mx.test_utils.default_context()
+    mx.test_utils.set_default_context(mx.cpu(0))
+    assert mx.test_utils.default_context() == mx.cpu(0)
+    mx.test_utils.set_default_context(old)
+
+
+def test_name_manager_reference_get():
+    from mxnet_tpu.symbol import NameManager, Prefix
+
+    mgr = NameManager.get()      # current-manager accessor still works
+    assert isinstance(mgr, NameManager)
+    fresh = NameManager()
+    assert fresh.get("user", "fc") == "user"
+    assert fresh.get(None, "fc") == "fc0"
+    assert fresh.get(None, "fc") == "fc1"
+    pre = Prefix("net_")
+    assert pre.get(None, "fc") == "net_fc0"
+
+
+def test_attr_scope_get():
+    scope = mx.AttrScope(ctx_group="dev1")
+    assert scope.get(None) == {"ctx_group": "dev1"}
+    assert scope.get({"lr_mult": "2"}) == {"ctx_group": "dev1",
+                                           "lr_mult": "2"}
+    assert mx.AttrScope().get({"a": "1"}) == {"a": "1"}
+
+
+def test_optimizer_register_and_lr_scale():
+    @mx.optimizer.Optimizer.register
+    class MyTestOpt(mx.optimizer.Optimizer):
+        def create_state(self, index, weight):
+            return None
+
+        def update(self, index, weight, grad, state):
+            pass
+
+    opt = mx.optimizer.create("mytestopt")
+    assert isinstance(opt, MyTestOpt)
+    with pytest.raises(DeprecationWarning):
+        opt.set_lr_scale({})
+
+
+def test_composite_metric_get_metric():
+    cm = mx.metric.CompositeEvalMetric(["acc", "mse"])
+    assert cm.get_metric(0).name == "accuracy"
+    with pytest.raises(ValueError):
+        cm.get_metric(5)
+
+
+def test_base_ctypes_helpers():
+    arr = mx.base.c_array(ctypes.c_float, [1.0, 2.0, 3.0])
+    assert arr[2] == 3.0
+    buf = (ctypes.c_char * 4)(b"a", b"b", b"c", b"d")
+    out = mx.base.ctypes2buffer(
+        ctypes.cast(buf, ctypes.POINTER(ctypes.c_char)), 4)
+    assert bytes(out) == b"abcd"
+    fbuf = (ctypes.c_float * 6)(*range(6))
+    view = mx.base.ctypes2numpy_shared(
+        ctypes.cast(fbuf, ctypes.POINTER(ctypes.c_float)), (2, 3))
+    np.testing.assert_allclose(view, np.arange(6.0).reshape(2, 3))
+    fbuf[0] = 99.0   # shared memory: the view sees writes
+    assert view[0, 0] == 99.0
+
+
+def test_libinfo_find_lib_path():
+    from mxnet_tpu import libinfo
+
+    libinfo.find_lib()           # ensure built
+    paths = libinfo.find_lib_path()
+    assert isinstance(paths, list)
+
+
+def test_misc_learning_rate_scheduler():
+    s = mx.misc.FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    assert s(0) == 1.0 and s(10) == 0.5 and s(25) == 0.25
+    base = mx.misc.LearningRateScheduler()
+    with pytest.raises(NotImplementedError):
+        base(1)
+    with pytest.raises(ValueError):
+        mx.misc.FactorScheduler(step=0)
+
+
+def test_prefix_applies_to_user_names():
+    """Reference name.py:73-75: Prefix prefixes user names too."""
+    from mxnet_tpu.symbol import Prefix
+
+    pre = Prefix("net_")
+    assert pre.get("fc1", "fc") == "net_fc1"
+    assert pre.get("", "fc") == "net_fc0"    # falsy name -> auto
+
+
+def test_optimizer_register_overrides_with_warning():
+    import warnings
+
+    @mx.optimizer.Optimizer.register
+    class OverrideProbe(mx.optimizer.Optimizer):
+        def update(self, index, weight, grad, state):
+            pass
+
+    class Second(mx.optimizer.Optimizer):
+        def update(self, index, weight, grad, state):
+            pass
+
+    Second.__name__ = "OverrideProbe"
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        mx.optimizer.Optimizer.register(Second)
+    assert any("overriding" in str(w.message) for w in caught)
+    assert isinstance(mx.optimizer.create("overrideprobe"), Second)
+
+
+def test_misc_factor_scheduler_default_factor():
+    s = mx.misc.FactorScheduler(step=10)     # reference default 0.1
+    s.base_lr = 1.0
+    assert abs(s(10) - 0.1) < 1e-12
