@@ -35,6 +35,7 @@ from . import symbol as sym
 from .symbol import AttrScope, Variable, Group
 from . import attribute
 from . import executor
+from . import executor_manager
 from .executor import Executor
 from . import initializer
 from . import initializer as init  # reference: mx.init.Xavier() etc.

@@ -48,6 +48,25 @@ class DataDesc:
     def __repr__(self):
         return f"DataDesc[{self.name},{self.shape},{self.dtype},{self.layout}]"
 
+    @staticmethod
+    def get_batch_axis(layout):
+        """Batch ('N') axis of a layout string; 0 for None (whole-array
+        default), -1 when the layout has no batch axis (reference io.py
+        DataDesc.get_batch_axis — the one implementation; the executor
+        group's slicing delegates here)."""
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+    @staticmethod
+    def get_list(shapes, types):
+        """DataDesc list from (name, shape) pairs and optional
+        (name, type) pairs (reference io.py:629-643)."""
+        if types is not None:
+            type_dict = dict(types)
+            return [DataDesc(x[0], x[1], type_dict[x[0]]) for x in shapes]
+        return [DataDesc(x[0], x[1]) for x in shapes]
+
 
 class DataBatch:
     """One mini-batch (reference io.py:86)."""

@@ -86,11 +86,8 @@ class DataParallelExecutorGroup:
     def _batch_axis(desc):
         """Batch ('N') axis of one input from its layout; -1 = no batch
         axis, the input is replicated whole to every device (reference
-        DataDesc.get_batch_axis + executor_group.py:193 major_axis)."""
-        layout = getattr(desc, "layout", None)
-        if layout is None:
-            return 0
-        return layout.find("N")
+        executor_group.py:193 major_axis)."""
+        return DataDesc.get_batch_axis(getattr(desc, "layout", None))
 
     def decide_slices(self, data_shapes):
         """Batch-axis slicing honoring per-input layouts

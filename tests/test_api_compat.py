@@ -186,3 +186,80 @@ def test_misc_factor_scheduler_default_factor():
     s = mx.misc.FactorScheduler(step=10)     # reference default 0.1
     s.base_lr = 1.0
     assert abs(s(10) - 0.1) < 1e-12
+
+
+def test_data_parallel_executor_manager_legacy():
+    """The FeedForward-era manager API (reference
+    executor_manager.py:276-424) trains over 2 CPU contexts."""
+    from mxnet_tpu.executor_manager import DataParallelExecutorManager
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 10).astype(np.float32)
+    W = rng.randn(10, 3).astype(np.float32)
+    y = X.dot(W).argmax(axis=1).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+
+    arg_names = net.list_arguments()
+    param_names = [n for n in arg_names
+                   if n not in ("data", "softmax_label")]
+    mgr = DataParallelExecutorManager(
+        net, [mx.cpu(0), mx.cpu(0)], it, arg_names, param_names,
+        net.list_auxiliary_states())
+
+    arg_params = {n: mx.nd.zeros(a[0].shape)
+                  for n, a in zip(param_names, mgr.param_arrays)}
+    for n in arg_params:
+        arg_params[n][:] = rng.uniform(-0.1, 0.1, arg_params[n].shape)
+    mgr.set_params(arg_params, {})
+
+    metric = mx.metric.create("acc")
+    updater = mx.optimizer.get_updater(
+        mx.optimizer.create("sgd", learning_rate=0.5,
+                            rescale_grad=1.0 / 16))
+    for _ in range(12):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mgr.load_data_batch(batch)
+            mgr.forward(is_train=True)
+            mgr.backward()
+            for idx, (ws, gs) in enumerate(zip(mgr.param_arrays,
+                                               mgr.grad_arrays)):
+                for k, (w, g) in enumerate(zip(ws, gs)):
+                    updater(idx * 2 + k, g, w)
+            mgr.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.9
+    out_params = {n: mx.nd.zeros(v.shape) for n, v in arg_params.items()}
+    mgr.copy_to(out_params, {})
+    assert not np.allclose(out_params["fc_weight"].asnumpy(),
+                           arg_params["fc_weight"].asnumpy())
+
+
+def test_datadesc_get_batch_axis_static():
+    """Reference static form: DataDesc.get_batch_axis(layout)."""
+    from mxnet_tpu.io import DataDesc
+
+    assert DataDesc.get_batch_axis("TNC") == 1
+    assert DataDesc.get_batch_axis("NCHW") == 0
+    assert DataDesc.get_batch_axis(None) == 0
+    assert DataDesc.get_batch_axis("CT") == -1
+
+
+def test_executor_manager_forward_before_load_raises():
+    from mxnet_tpu.executor_manager import DataParallelExecutorManager
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=2, name="fc"),
+        name="softmax")
+    it = mx.io.NDArrayIter(np.zeros((8, 4), np.float32),
+                           np.zeros(8, np.float32), 4)
+    mgr = DataParallelExecutorManager(
+        net, [mx.cpu(0)], it, net.list_arguments(),
+        ["fc_weight", "fc_bias"], [])
+    with pytest.raises(ValueError, match="load_data_batch"):
+        mgr.forward()
