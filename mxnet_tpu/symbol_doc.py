@@ -57,3 +57,93 @@ def build_doc(op_name: str) -> str:
             if fdoc:
                 lines.append(f"    {fdoc}")
     return "\n".join(lines)
+
+
+# -- per-op extended doc classes (reference symbol_doc.py pattern) ----------
+# The reference attaches extra examples to generated ops by writing a
+# ``<Op>Doc`` class whose docstring is appended to the op's docs.  The
+# same hook exists here: subclass SymbolDoc, name it after the op.
+
+
+class ActivationDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> x = mx.sym.Variable('x')
+    >>> h = mx.sym.FullyConnected(x, num_hidden=64, name='proj')
+    >>> h = mx.sym.Activation(h, act_type='relu', name='act')
+
+    act_type is one of relu / sigmoid / tanh / softrelu; the lowering is
+    one fused XLA elementwise op either way.
+    """
+
+
+class DropoutDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> h = mx.sym.Dropout(h, p=0.5)
+
+    Active only under ``forward(is_train=True)``; the mask is drawn from
+    the executor's threefry key chain, so a seeded run replays exactly
+    (and identically across CPU/TPU backends).
+    """
+
+
+class EmbeddingDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> ids = mx.sym.Variable('ids')       # (batch, seq) token ids
+    >>> emb = mx.sym.Embedding(ids, input_dim=50000, output_dim=256)
+
+    Integer inputs are welcome (int32 ids are the TPU-friendly form);
+    the output takes the TABLE's float dtype.  Backward is a native XLA
+    scatter-add.
+    """
+
+
+class FlattenDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> conv = mx.sym.Convolution(x, kernel=(3, 3), num_filter=32)
+    >>> fc = mx.sym.FullyConnected(mx.sym.Flatten(conv), num_hidden=10)
+
+    Collapses all trailing axes: (N, C, H, W) -> (N, C*H*W).
+    """
+
+
+class FullyConnectedDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> fc = mx.sym.FullyConnected(x, num_hidden=128, name='fc')
+    >>> fc.list_arguments()
+    ['x', 'fc_weight', 'fc_bias']
+
+    Weight layout is (num_hidden, input_dim) — the reference
+    convention, preserved so checkpoints interchange; the MXU matmul
+    absorbs the transpose.
+    """
+
+
+class ConcatDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> out = mx.sym.Concat(a, b, c, dim=1)
+
+    ``num_args`` is inferred from the positional count
+    (key_var_num_args); pass ``dim`` to pick the axis (default 1).
+    """
+
+
+class BroadcastPlusDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> out = mx.sym.broadcast_plus(x, bias)   # numpy-style broadcasting
+
+    Size-1 axes broadcast; the gradient sums over broadcast axes.
+    """
