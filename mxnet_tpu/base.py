@@ -50,6 +50,31 @@ def ctypes2buffer(cptr, length):
     return res
 
 
+def ctypes2docstring(num_args, arg_names, arg_types, arg_descs,
+                     remove_dup=True):
+    """Render a parameter docstring from C-API registry metadata
+    (reference base.py ctypes2docstring) — the generator thin frontends
+    use when building docs from runtime-discovered op signatures."""
+    param_keys = set()
+    param_str = []
+    for i in range(num_args.value if hasattr(num_args, "value")
+                   else num_args):
+        key = (arg_names[i].decode() if isinstance(arg_names[i], bytes)
+               else arg_names[i])
+        if key in param_keys and remove_dup:
+            continue
+        param_keys.add(key)
+        atype = (arg_types[i].decode() if isinstance(arg_types[i], bytes)
+                 else arg_types[i])
+        desc = (arg_descs[i].decode() if isinstance(arg_descs[i], bytes)
+                else arg_descs[i])
+        ret = f"{key} : {atype}"
+        if desc:
+            ret += f"\n    {desc}"
+        param_str.append(ret)
+    return "Parameters\n----------\n" + "\n".join(param_str) + "\n"
+
+
 def ctypes2numpy_shared(cptr, shape):
     """Zero-copy numpy view over ctypes float memory (reference
     base.py ctypes2numpy_shared)."""

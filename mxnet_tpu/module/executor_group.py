@@ -173,7 +173,17 @@ class DataParallelExecutorGroup:
             aux_params[name][:] = aux
 
     # -- compute -----------------------------------------------------------
-    def forward(self, data_batch, is_train=None):
+    def load_data_batch(self, data_batch):
+        """Stage a batch for ``forward`` (reference executor_group
+        load_data_batch); here forward fuses staging+compute, so this
+        just records the batch for a following bare forward call."""
+        self._staged_batch = data_batch
+
+    def forward(self, data_batch=None, is_train=None):
+        if data_batch is None:
+            data_batch = getattr(self, "_staged_batch", None)
+            if data_batch is None:
+                raise MXNetError("no batch: pass one or load_data_batch first")
         if is_train is None:
             is_train = self.for_training
         data = data_batch.data

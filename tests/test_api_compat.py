@@ -263,3 +263,30 @@ def test_executor_manager_forward_before_load_raises():
         ["fc_weight", "fc_bias"], [])
     with pytest.raises(ValueError, match="load_data_batch"):
         mgr.forward()
+
+
+def test_base_ctypes2docstring():
+    doc = mx.base.ctypes2docstring(
+        2, [b"alpha", b"beta"], [b"float", b"int"], [b"scale", b""])
+    assert "alpha : float" in doc and "scale" in doc
+    assert "beta : int" in doc and doc.startswith("Parameters")
+
+
+def test_exec_group_load_data_batch():
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.module.executor_group import DataParallelExecutorGroup
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=2, name="fc"),
+        name="softmax")
+    grp = DataParallelExecutorGroup(
+        net, [mx.cpu(0)], [1], [("data", (4, 3))],
+        [("softmax_label", (4,))], ["fc_weight", "fc_bias"],
+        for_training=True, inputs_need_grad=False)
+    grp.set_params({"fc_weight": mx.nd.ones((2, 3)),
+                    "fc_bias": mx.nd.zeros(2)}, {})
+    batch = DataBatch([mx.nd.ones((4, 3))], [mx.nd.zeros(4)])
+    grp.load_data_batch(batch)
+    grp.forward()                       # bare forward uses staged batch
+    assert grp.get_outputs()[0].shape == (4, 2)
