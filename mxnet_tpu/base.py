@@ -23,6 +23,7 @@ __all__ = [
     "c_array",
     "c_str",
     "ctypes2buffer",
+    "ctypes2docstring",
     "ctypes2numpy_shared",
 ]
 

@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import logging
 
-import numpy as np
 
 from .io import DataDesc
 from .module.executor_group import (DataParallelExecutorGroup,
@@ -119,25 +118,17 @@ class DataParallelExecutorManager:
             self.curr_execgrp = self.execgrp_bucket[key]
         else:
             self.curr_execgrp = self.execgrp
-        # snapshot the arrays NOW (the reference copies to device in
-        # load_data_batch): a caller recycling its batch buffers between
-        # load and forward must not train on mutated data
-        from .io import DataBatch as _DataBatch
-
-        def _snap(arrs):
-            return [a.copy() if hasattr(a, "copy") else np.array(a)
-                    for a in (arrs or [])]
-
-        self._pending_batch = _DataBatch(
-            _snap(data_batch.data), _snap(data_batch.label),
-            data_batch.pad, data_batch.index)
+        # the group snapshots the arrays (the reference copies to device
+        # at load): buffer-recycling pipelines can't leak mutations
+        self.curr_execgrp.load_data_batch(data_batch)
+        self._pending_batch = data_batch
 
     def forward(self, is_train=False):
         """Forward on the current executor group (:412-414) over the
         batch staged by ``load_data_batch``."""
         if self._pending_batch is None:
             raise ValueError("call load_data_batch before forward")
-        self.curr_execgrp.forward(self._pending_batch, is_train=is_train)
+        self.curr_execgrp.forward(is_train=is_train)
 
     def backward(self):
         self.curr_execgrp.backward()

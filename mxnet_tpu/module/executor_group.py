@@ -174,16 +174,31 @@ class DataParallelExecutorGroup:
 
     # -- compute -----------------------------------------------------------
     def load_data_batch(self, data_batch):
-        """Stage a batch for ``forward`` (reference executor_group
-        load_data_batch); here forward fuses staging+compute, so this
-        just records the batch for a following bare forward call."""
-        self._staged_batch = data_batch
+        """Stage a batch for a bare ``forward`` (reference
+        executor_group load_data_batch).  Arrays are SNAPSHOTTED — the
+        reference copies to device at load, so a data pipeline that
+        recycles its batch buffers between load and forward must not
+        leak the mutation into training (same contract as
+        DataParallelExecutorManager.load_data_batch)."""
+        from ..io import DataBatch as _DataBatch
+
+        def _snap(arrs):
+            return [a.copy() if hasattr(a, "copy") else np.array(a)
+                    for a in (arrs or [])]
+
+        self._staged_batch = _DataBatch(
+            _snap(data_batch.data), _snap(data_batch.label),
+            data_batch.pad, data_batch.index)
 
     def forward(self, data_batch=None, is_train=None):
         if data_batch is None:
             data_batch = getattr(self, "_staged_batch", None)
             if data_batch is None:
                 raise MXNetError("no batch: pass one or load_data_batch first")
+        else:
+            # "bare forward re-runs the last batch" must mean the MOST
+            # RECENT one, however it arrived
+            self._staged_batch = data_batch
         if is_train is None:
             is_train = self.for_training
         data = data_batch.data

@@ -12,7 +12,9 @@ from __future__ import annotations
 
 from .ops.op import OP_REGISTRY
 
-__all__ = ["SymbolDoc", "build_doc", "list_ops"]
+__all__ = ["SymbolDoc", "build_doc", "list_ops",
+           "ActivationDoc", "DropoutDoc", "EmbeddingDoc", "FlattenDoc",
+           "FullyConnectedDoc", "ConcatDoc", "BroadcastPlusDoc"]
 
 
 class SymbolDoc:
@@ -56,6 +58,17 @@ def build_doc(op_name: str) -> str:
             fdoc = getattr(fld, "doc", None)
             if fdoc:
                 lines.append(f"    {fdoc}")
+    # the reference hook: a ``<Op>Doc`` subclass of SymbolDoc in this
+    # module contributes its docstring (Examples etc.) to the op's docs.
+    # Lookup is case/underscore-insensitive so snake_case op names
+    # (broadcast_plus) find their CamelCase doc class (BroadcastPlusDoc)
+    target = op_name.replace("_", "").lower() + "doc"
+    for key, extra in globals().items():
+        if (key.replace("_", "").lower() == target
+                and isinstance(extra, type) and issubclass(extra, SymbolDoc)
+                and extra.__doc__):
+            lines += ["", extra.__doc__.strip()]
+            break
     return "\n".join(lines)
 
 

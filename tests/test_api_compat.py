@@ -290,3 +290,45 @@ def test_exec_group_load_data_batch():
     grp.load_data_batch(batch)
     grp.forward()                       # bare forward uses staged batch
     assert grp.get_outputs()[0].shape == (4, 2)
+
+
+def test_symbol_doc_classes_feed_build_doc():
+    """The <Op>Doc hook is live: build_doc appends the doc class's
+    Examples section, including snake_case op -> CamelCase class."""
+    from mxnet_tpu import symbol_doc
+
+    doc = symbol_doc.build_doc("Activation")
+    assert "Examples" in doc and "act_type" in doc
+    doc2 = symbol_doc.build_doc("broadcast_plus")
+    assert "broadcasting" in doc2
+
+
+def test_exec_group_staging_snapshots_and_refreshes():
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.module.executor_group import DataParallelExecutorGroup
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=2, name="fc"),
+        name="softmax")
+    grp = DataParallelExecutorGroup(
+        net, [mx.cpu(0)], [1], [("data", (2, 3))],
+        [("softmax_label", (2,))], ["fc_weight", "fc_bias"],
+        for_training=False, inputs_need_grad=False)
+    grp.set_params({"fc_weight": mx.nd.ones((2, 3)),
+                    "fc_bias": mx.nd.zeros(2)}, {})
+
+    # mutation AFTER load must not leak (snapshot-at-load contract)
+    src = mx.nd.ones((2, 3))
+    grp.load_data_batch(DataBatch([src], [mx.nd.zeros(2)]))
+    src[:] = 999.0
+    grp.forward()
+    np.testing.assert_allclose(grp.get_outputs()[0].asnumpy().sum(), 2.0,
+                               atol=1e-5)  # softmax rows sum to 1 each
+
+    # an explicit forward(batch) becomes the staged batch
+    b2 = DataBatch([mx.nd.full((2, 3), 2.0)], [mx.nd.zeros(2)])
+    grp.forward(b2)
+    out_b2 = grp.get_outputs()[0].asnumpy()
+    grp.forward()            # bare: must re-run b2, not the old one
+    np.testing.assert_allclose(grp.get_outputs()[0].asnumpy(), out_b2)
