@@ -83,15 +83,25 @@ def _ring_body(q, k, v, axis_name, n_shards, scale, causal, q_index):
 
 
 def _ring_body_flash(q, k, v, axis_name, n_shards, scale, causal, q_index,
-                     block_q, block_k, interpret):
+                     block_q, block_k, interpret, layout="bhsd"):
     """Ring loop where each shard-pair attention block is the fused
     Pallas flash kernel (ops/flash_attention.py); per-step normalized
     outputs are stream-combined via their log-sum-exps.  The kernel's
     causal mask uses global positions = shard_index * S_blk + local, so
-    diagonal / past / future K-V shards all fall out of one kernel."""
+    diagonal / past / future K-V shards all fall out of one kernel.
+
+    ``layout="bshd"`` keeps shards sequence-major end to end (the
+    kernel indexes the head dim; the only reshuffle is the tiny
+    D-free log-sum-exp row map)."""
     from ..ops.flash_attention import flash_attention
 
-    B, H, S_blk, D = q.shape
+    bshd = layout == "bshd"
+    if bshd:
+        B, S_blk, H, D = q.shape
+        row0 = (B, S_blk, H)
+    else:
+        B, H, S_blk, D = q.shape
+        row0 = (B, H, S_blk)
 
     def step(carry, i):
         k_cur, v_cur, o_acc, m_acc, l_acc = carry
@@ -100,7 +110,10 @@ def _ring_body_flash(q, k, v, axis_name, n_shards, scale, causal, q_index,
             q, k_cur, v_cur, causal=causal, scale=scale,
             block_q=block_q, block_k=block_k,
             q_offset=q_index * S_blk, k_offset=kv_index * S_blk,
-            return_lse=True, interpret=interpret)
+            return_lse=True, interpret=interpret, layout=layout)
+        if bshd:
+            # lse is (B, H, S); the output rows are (B, S, H)
+            lse_b = jnp.moveaxis(lse_b, 1, 2)
         # streaming logsumexp-weighted combine of normalized outputs;
         # accumulate in float32 regardless of input dtype (bf16 inputs
         # would otherwise promote the scan carry and break its type)
@@ -116,8 +129,8 @@ def _ring_body_flash(q, k, v, axis_name, n_shards, scale, causal, q_index,
         return (k_next, v_next, o_new, m_new, l_new), None
 
     o0 = jnp.zeros(q.shape, jnp.float32)
-    m0 = jnp.full((B, H, S_blk), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((B, H, S_blk), jnp.float32)
+    m0 = jnp.full(row0, -jnp.inf, jnp.float32)
+    l0 = jnp.zeros(row0, jnp.float32)
     (k, v, o, m, l), _ = lax.scan(step, (k, v, o0, m0, l0),
                                   jnp.arange(n_shards))
     return (o / jnp.maximum(l, 1e-20)[..., None]).astype(q.dtype)
@@ -125,12 +138,14 @@ def _ring_body_flash(q, k, v, axis_name, n_shards, scale, causal, q_index,
 
 @functools.lru_cache(maxsize=64)
 def _build_ring_run(mesh: Mesh, axis: str, scale: float, causal: bool,
-                    impl: str, block_q: int, block_k: int, interpret: bool):
+                    impl: str, block_q: int, block_k: int, interpret: bool,
+                    layout: str = "bhsd"):
     """Cached compiled ring-attention program per (mesh, axis, config) —
     jax.jit caches on function identity, so the shard_map must be built
     once per config or every call recompiles."""
     n_shards = mesh.shape[axis]
-    spec = PartitionSpec(None, None, axis, None)
+    bshd = layout == "bshd"
+    spec = _ring_spec(layout, axis)
 
     @jax.jit
     def run(q, k, v):
@@ -139,7 +154,15 @@ def _build_ring_run(mesh: Mesh, axis: str, scale: float, causal: bool,
             if impl == "flash":
                 return _ring_body_flash(q_s, k_s, v_s, axis, n_shards, scale,
                                         causal, idx, block_q, block_k,
-                                        interpret)
+                                        interpret, layout=layout)
+            if bshd:
+                # dense fallback computes in BHSD; transpose at the
+                # shard boundary (correctness path, not the TPU path)
+                o = _ring_body(q_s.transpose(0, 2, 1, 3),
+                               k_s.transpose(0, 2, 1, 3),
+                               v_s.transpose(0, 2, 1, 3),
+                               axis, n_shards, scale, causal, idx)
+                return o.transpose(0, 2, 1, 3)
             return _ring_body(q_s, k_s, v_s, axis, n_shards, scale, causal,
                               idx)
 
@@ -150,15 +173,25 @@ def _build_ring_run(mesh: Mesh, axis: str, scale: float, causal: bool,
     return run
 
 
-_FLASH_AVAILABLE = None
+def _ring_spec(layout, axis):
+    """The one seq-sharded PartitionSpec both the shard_map and the
+    caller-side device_put use — they must never desync."""
+    if layout == "bshd":
+        return PartitionSpec(None, axis, None, None)
+    return PartitionSpec(None, None, axis, None)
 
 
-def _flash_available():
-    """One-time probe: compile+run the Pallas kernel on a tiny shape so
-    'auto' can fall back to the XLA body if Mosaic lowering fails on
-    this backend/driver combo rather than erroring mid-training."""
-    global _FLASH_AVAILABLE
-    if _FLASH_AVAILABLE is None:
+_FLASH_AVAILABLE = {}
+
+
+def _flash_available(layout="bhsd"):
+    """One-time probe PER LAYOUT: compile+run the Pallas kernel on a
+    tiny shape so 'auto' can fall back to the XLA body if Mosaic
+    lowering fails on this backend/driver combo rather than erroring
+    mid-training.  The bhsd (flattened 3D) and bshd (4D head-indexed
+    BlockSpec) lowerings are distinct programs, so each layout is
+    probed separately."""
+    if layout not in _FLASH_AVAILABLE:
         try:
             from ..ops.flash_attention import flash_attention
 
@@ -169,21 +202,27 @@ def _flash_available():
             # would no-op — caching True without exercising Mosaic.
             # head_dim 128 matches the MXU lane layout real models use.
             with jax.ensure_compile_time_eval():
-                x = jnp.zeros((1, 1, 128, 128), jnp.float32)
-                jax.block_until_ready(flash_attention(x, x, x))
-            _FLASH_AVAILABLE = True
+                shape = ((1, 128, 1, 128) if layout == "bshd"
+                         else (1, 1, 128, 128))   # S=128, H=1 either way
+                x = jnp.zeros(shape, jnp.float32)
+                jax.block_until_ready(
+                    flash_attention(x, x, x, layout=layout))
+            _FLASH_AVAILABLE[layout] = True
         except Exception:
-            _FLASH_AVAILABLE = False
-    return _FLASH_AVAILABLE
+            _FLASH_AVAILABLE[layout] = False
+    return _FLASH_AVAILABLE[layout]
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
-                   impl="auto", block_q=128, block_k=128):
+                   impl="auto", block_q=128, block_k=128, layout="bhsd"):
     """Sharded multi-head attention over a sequence-parallel mesh axis.
 
-    q/k/v: (batch, heads, seq, head_dim), sharded over ``axis`` on the
-    seq dimension (replicated arrays are accepted and sharded here).
-    Returns the attention output with the same sharding.
+    q/k/v: (batch, heads, seq, head_dim) for ``layout="bhsd"`` or
+    (batch, seq, heads, head_dim) for ``layout="bshd"`` (sequence-major
+    — shards feed the flash kernel with zero activation transposes),
+    sharded over ``axis`` on the seq dimension (replicated arrays are
+    accepted and sharded here).  Returns the attention output with the
+    same layout and sharding.
 
     impl: "flash" runs each shard-pair block through the fused Pallas
     kernel; "xla" uses the jnp blockwise body; "auto" picks flash on
@@ -192,20 +231,24 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
     """
     from ..ops.flash_attention import _on_tpu
 
+    if layout not in ("bhsd", "bshd"):
+        raise ValueError(f"layout must be 'bhsd' or 'bshd', got {layout!r}")
+    seq_axis = 1 if layout == "bshd" else 2
     scale = float(1.0 / np.sqrt(q.shape[-1]))
     n_shards = mesh.shape[axis]
-    S_blk = q.shape[2] // n_shards
+    S_blk = q.shape[seq_axis] // n_shards
     interpret = not _on_tpu()
     if impl == "auto":
         fits = (S_blk % min(block_q, S_blk) == 0
                 and S_blk % min(block_k, S_blk) == 0)
-        impl = ("flash" if (not interpret and fits and _flash_available())
+        impl = ("flash" if (not interpret and fits
+                            and _flash_available(layout))
                 else "xla")
     run = _build_ring_run(mesh, axis, scale, bool(causal), impl,
-                          block_q, block_k, interpret)
+                          block_q, block_k, interpret, layout)
 
     if not isinstance(q, jax.core.Tracer):
-        sharding = NamedSharding(mesh, PartitionSpec(None, None, axis, None))
+        sharding = NamedSharding(mesh, _ring_spec(layout, axis))
         q = jax.device_put(q, sharding)
         k = jax.device_put(k, sharding)
         v = jax.device_put(v, sharding)

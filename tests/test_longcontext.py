@@ -428,3 +428,32 @@ def test_flash_attention_bshd_layout_parity():
             np.testing.assert_allclose(
                 np.asarray(gs.transpose(0, 2, 1, 3)), np.asarray(gr),
                 atol=1e-4, rtol=1e-4)
+
+
+def test_ring_attention_bshd_layout():
+    """Sequence-major ring attention matches the dense reference and
+    the bhsd ring result, for both impls, causal and not."""
+    mesh = mx.parallel.make_mesh({"sp": 4})
+    rng = np.random.RandomState(11)
+    B, H, S, D = 2, 2, 64, 8
+    q, k, v = (jnp.asarray(rng.standard_normal((B, H, S, D))
+                           .astype(np.float32)) for _ in range(3))
+    qs, ks, vs = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+
+    for causal in (False, True):
+        want = attention_reference(q, k, v, causal=causal)
+        for impl in ("xla", "flash"):
+            got = ring_attention(qs, ks, vs, mesh, axis="sp",
+                                 causal=causal, impl=impl,
+                                 block_q=16, block_k=16, layout="bshd")
+            np.testing.assert_allclose(
+                np.asarray(got).transpose(0, 2, 1, 3), np.asarray(want),
+                atol=2e-5, rtol=1e-4,
+                err_msg=f"impl={impl} causal={causal}")
+
+
+def test_ring_attention_bad_layout_raises():
+    mesh = mx.parallel.make_mesh({"sp": 2})
+    x = jnp.zeros((1, 2, 8, 4), jnp.float32)
+    with pytest.raises(ValueError, match="layout"):
+        ring_attention(x, x, x, mesh, layout="BSHD")
