@@ -28,6 +28,9 @@ def main():
     p.add_argument("--mode", default="ring", choices=["ring", "ulysses"],
                    help="sequence-parallel scheme: ring (ppermute K/V) or "
                         "ulysses (all-to-all head regrouping)")
+    p.add_argument("--layout", default="bhsd", choices=["bhsd", "bshd"],
+                   help="bshd = sequence-major ring shards (no activation "
+                        "transposes feeding the flash kernel; ring only)")
     args = p.parse_args()
 
     import jax
@@ -48,9 +51,17 @@ def main():
 
     def loss_fn(p):
         q, k, v = x @ p["wq"], x @ p["wk"], x @ p["wv"]
-        attn = (mx.parallel.ulysses_attention if args.mode == "ulysses"
-                else mx.parallel.ring_attention)
-        o = attn(q, k, v, mesh, "sp", causal=True, impl=args.impl)
+        if args.mode == "ulysses":
+            o = mx.parallel.ulysses_attention(q, k, v, mesh, "sp",
+                                              causal=True, impl=args.impl)
+        elif args.layout == "bshd":
+            o = mx.parallel.ring_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), mesh, "sp", causal=True,
+                impl=args.impl, layout="bshd").transpose(0, 2, 1, 3)
+        else:
+            o = mx.parallel.ring_attention(q, k, v, mesh, "sp",
+                                           causal=True, impl=args.impl)
         pooled = o.mean(axis=2) @ p["wo"]
         return jnp.mean((pooled - tgt) ** 2)
 
