@@ -29,8 +29,8 @@ def main():
                    help="sequence-parallel scheme: ring (ppermute K/V) or "
                         "ulysses (all-to-all head regrouping)")
     p.add_argument("--layout", default="bhsd", choices=["bhsd", "bshd"],
-                   help="bshd = sequence-major ring shards (no activation "
-                        "transposes feeding the flash kernel; ring only)")
+                   help="bshd = sequence-major shards (no activation "
+                        "transposes feeding the flash kernel)")
     args = p.parse_args()
 
     import jax
@@ -51,17 +51,14 @@ def main():
 
     def loss_fn(p):
         q, k, v = x @ p["wq"], x @ p["wk"], x @ p["wv"]
-        if args.mode == "ulysses":
-            o = mx.parallel.ulysses_attention(q, k, v, mesh, "sp",
-                                              causal=True, impl=args.impl)
-        elif args.layout == "bshd":
-            o = mx.parallel.ring_attention(
-                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                v.transpose(0, 2, 1, 3), mesh, "sp", causal=True,
-                impl=args.impl, layout="bshd").transpose(0, 2, 1, 3)
+        attn = (mx.parallel.ulysses_attention if args.mode == "ulysses"
+                else mx.parallel.ring_attention)
+        if args.layout == "bshd":
+            o = attn(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                     v.transpose(0, 2, 1, 3), mesh, "sp", causal=True,
+                     impl=args.impl, layout="bshd").transpose(0, 2, 1, 3)
         else:
-            o = mx.parallel.ring_attention(q, k, v, mesh, "sp",
-                                           causal=True, impl=args.impl)
+            o = attn(q, k, v, mesh, "sp", causal=True, impl=args.impl)
         pooled = o.mean(axis=2) @ p["wo"]
         return jnp.mean((pooled - tgt) ** 2)
 

@@ -16,7 +16,10 @@ tradeoff vs the ring is 2 all-to-alls of activation size against n
 ppermutes of K/V size, and the head count must divide the mesh axis.
 
 API mirrors ``ring_attention``: ``ulysses_attention(q, k, v, mesh,
-axis, causal, impl)`` with q/k/v (batch, heads, seq, head_dim) sharded
+axis, causal, impl, layout)`` with q/k/v (batch, heads, seq, head_dim)
+for ``layout="bhsd"`` or sequence-major (batch, seq, heads, head_dim)
+for ``layout="bshd"`` (the all-to-alls split/concat the same two axes
+in either order, so BSHD stays transpose-free end to end), sharded
 over ``axis`` on the sequence dimension.
 """
 
@@ -29,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.sharding import Mesh, NamedSharding
 
 __all__ = ["ulysses_attention"]
 
@@ -47,18 +50,24 @@ def _dense_attention(q, k, v, scale, causal):
 @functools.lru_cache(maxsize=64)
 def _build_ulysses_run(mesh: Mesh, axis: str, scale: float, causal: bool,
                        impl: str, block_q: int, block_k: int,
-                       interpret: bool):
+                       interpret: bool, layout: str = "bhsd"):
     """Cached compiled program per (mesh, axis, config) — same caching
     contract as ring_attention's _build_ring_run."""
-    spec = PartitionSpec(None, None, axis, None)
+    from .ring_attention import _ring_spec
+
+    bshd = layout == "bshd"
+    spec = _ring_spec(layout, axis)
+    # the all-to-all trades the sharded axis for the head axis; both
+    # layouts keep their own order end to end (bshd: seq=1, heads=2)
+    seq_ax, head_ax = (1, 2) if bshd else (2, 1)
 
     @jax.jit
     def run(q, k, v):
         def shard_fn(q_s, k_s, v_s):
             # seq-sharded -> head-sharded: split heads, gather sequence
             def to_heads(x):
-                return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
-                                      tiled=True)
+                return lax.all_to_all(x, axis, split_axis=head_ax,
+                                      concat_axis=seq_ax, tiled=True)
 
             qh, kh, vh = to_heads(q_s), to_heads(k_s), to_heads(v_s)
             if impl == "flash":
@@ -66,12 +75,17 @@ def _build_ulysses_run(mesh: Mesh, axis: str, scale: float, causal: bool,
 
                 oh = flash_attention(qh, kh, vh, causal=causal,
                                      block_q=block_q, block_k=block_k,
-                                     interpret=interpret)
+                                     interpret=interpret, layout=layout)
+            elif bshd:
+                oh = _dense_attention(qh.transpose(0, 2, 1, 3),
+                                      kh.transpose(0, 2, 1, 3),
+                                      vh.transpose(0, 2, 1, 3),
+                                      scale, causal).transpose(0, 2, 1, 3)
             else:
                 oh = _dense_attention(qh, kh, vh, scale, causal)
             # head-sharded -> seq-sharded: split sequence, gather heads
-            return lax.all_to_all(oh, axis, split_axis=2, concat_axis=1,
-                                  tiled=True)
+            return lax.all_to_all(oh, axis, split_axis=seq_ax,
+                                  concat_axis=head_ax, tiled=True)
 
         return shard_map(
             shard_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -81,39 +95,46 @@ def _build_ulysses_run(mesh: Mesh, axis: str, scale: float, causal: bool,
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
-                      impl="auto", block_q=128, block_k=128):
+                      impl="auto", block_q=128, block_k=128, layout="bhsd"):
     """All-to-all sequence-parallel multi-head attention.
 
-    q/k/v: (batch, heads, seq, head_dim) sharded over ``axis`` on the
-    sequence dimension (replicated arrays are accepted and sharded
-    here).  Requires heads %% mesh.shape[axis] == 0.  Returns the
-    attention output with the same sequence sharding.
+    q/k/v: (batch, heads, seq, head_dim) for ``layout="bhsd"`` or
+    (batch, seq, heads, head_dim) for ``layout="bshd"`` (sequence-major
+    — the all-to-alls and the kernel preserve the order, so no
+    activation transposes), sharded over ``axis`` on the sequence
+    dimension (replicated arrays are accepted and sharded here).
+    Requires heads %% mesh.shape[axis] == 0.  Returns the attention
+    output with the same layout and sequence sharding.
 
     impl: "flash" = fused Pallas kernel per head group; "xla" = dense
     softmax attention; "auto" picks flash on TPU when shapes fit.
     """
     from ..ops.flash_attention import _on_tpu
-    from .ring_attention import _flash_available
+    from .ring_attention import _flash_available, _ring_spec
 
+    if layout not in ("bhsd", "bshd"):
+        raise ValueError(f"layout must be 'bhsd' or 'bshd', got {layout!r}")
+    head_axis, seq_axis = (2, 1) if layout == "bshd" else (1, 2)
     n_shards = mesh.shape[axis]
-    H = q.shape[1]
+    H = q.shape[head_axis]
     if H % n_shards != 0:
         raise ValueError(
             f"ulysses_attention: heads ({H}) must be divisible by the "
             f"'{axis}' mesh axis ({n_shards}); use ring_attention for "
             "head counts that do not divide the mesh")
     scale = float(1.0 / np.sqrt(q.shape[-1]))
-    S = q.shape[2]
+    S = q.shape[seq_axis]
     interpret = not _on_tpu()
     if impl == "auto":
         fits = (S % min(block_q, S) == 0 and S % min(block_k, S) == 0)
-        impl = ("flash" if (not interpret and fits and _flash_available())
+        impl = ("flash" if (not interpret and fits
+                            and _flash_available(layout))
                 else "xla")
     run = _build_ulysses_run(mesh, axis, scale, bool(causal), impl,
-                             block_q, block_k, interpret)
+                             block_q, block_k, interpret, layout)
 
     if not isinstance(q, jax.core.Tracer):
-        sharding = NamedSharding(mesh, PartitionSpec(None, None, axis, None))
+        sharding = NamedSharding(mesh, _ring_spec(layout, axis))
         q = jax.device_put(q, sharding)
         k = jax.device_put(k, sharding)
         v = jax.device_put(v, sharding)

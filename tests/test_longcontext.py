@@ -457,3 +457,25 @@ def test_ring_attention_bad_layout_raises():
     x = jnp.zeros((1, 2, 8, 4), jnp.float32)
     with pytest.raises(ValueError, match="layout"):
         ring_attention(x, x, x, mesh, layout="BSHD")
+
+
+def test_ulysses_attention_bshd_layout():
+    """Sequence-major Ulysses: the all-to-alls preserve BSHD order and
+    results match the dense reference for both impls."""
+    mesh = mx.parallel.make_mesh({"sp": 4})
+    rng = np.random.RandomState(13)
+    B, H, S, D = 2, 4, 64, 8
+    q, k, v = (jnp.asarray(rng.standard_normal((B, H, S, D))
+                           .astype(np.float32)) for _ in range(3))
+    qs, ks, vs = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+
+    for causal in (False, True):
+        want = attention_reference(q, k, v, causal=causal)
+        for impl in ("xla", "flash"):
+            got = mx.parallel.ulysses_attention(
+                qs, ks, vs, mesh, axis="sp", causal=causal, impl=impl,
+                block_q=16, block_k=16, layout="bshd")
+            np.testing.assert_allclose(
+                np.asarray(got).transpose(0, 2, 1, 3), np.asarray(want),
+                atol=2e-5, rtol=1e-4,
+                err_msg=f"impl={impl} causal={causal}")
