@@ -89,16 +89,23 @@ def capacity_for(tokens_per_shard, num_experts, k=1, capacity_factor=1.25):
 
 
 @functools.lru_cache(maxsize=64)
-def _build_moe_run(mesh: Mesh, axis: str, k: int, E: int, C: int, expert_fn):
+def _build_moe_run(mesh: Mesh, axis: str, k: int, E: int, C: int, expert_fn,
+                   batch_axis=None):
     """Cached compiled MoE step for one (mesh, routing config) combo.
 
     jax.jit caches on function identity + input shapes, so the shard_map
     program must be built once per config, not per call — otherwise every
     training step recompiles.
+
+    ``batch_axis``: optional data-parallel mesh axis the token dim is
+    ALSO sharded over.  Each dp replica routes its own tokens among its
+    ep group (the all-to-alls stay inside the ep axis, riding ICI), so
+    expert parallelism composes with data parallelism in one mesh.
     """
     n_shards = mesh.shape[axis]
     epl = E // n_shards            # experts per shard
-    tok_spec = PartitionSpec(axis, None)
+    tok_dims = (batch_axis, axis) if batch_axis else axis
+    tok_spec = PartitionSpec(tok_dims, None)
     gate_spec = PartitionSpec(None, None)
 
     def shard_fn(gate_w, experts_local, x_local):
@@ -123,6 +130,8 @@ def _build_moe_run(mesh: Mesh, axis: str, k: int, E: int, C: int, expert_fn):
         y_local = jnp.einsum("tec,ecd->td", combine, out)
         # aux loss: average over shards so the global loss is one scalar
         aux = lax.pmean(aux, axis)
+        if batch_axis:
+            aux = lax.pmean(aux, batch_axis)
         return y_local, aux
 
     @jax.jit
@@ -139,7 +148,8 @@ def _build_moe_run(mesh: Mesh, axis: str, k: int, E: int, C: int, expert_fn):
 
 
 def moe_apply(params, x, mesh: Mesh, axis: str = "ep", k: int = 1,
-              capacity_factor: float = 1.25, expert_fn=_expert_ffn):
+              capacity_factor: float = 1.25, expert_fn=_expert_ffn,
+              batch_axis=None):
     """Expert-parallel MoE layer over mesh axis ``axis``.
 
     Parameters
@@ -151,20 +161,36 @@ def moe_apply(params, x, mesh: Mesh, axis: str = "ep", k: int = 1,
     expert_fn : must be a stable function object — compiled programs are
         cached per (mesh, routing config, expert_fn); a fresh lambda per
         call recompiles and churns the cache.
+    batch_axis : optional dp mesh axis the token dim is additionally
+        sharded over (dp-major ordering); expert params stay replicated
+        across it and each dp replica's ep group routes independently.
+        Per-shard capacity then uses tokens / (dp * ep).
     Returns (y, aux_loss) with y sharded like x.
     """
     n_shards = mesh.shape[axis]
     E = params["gate_w"].shape[1]
     if E % n_shards:
         raise ValueError(f"num_experts {E} not divisible by ep={n_shards}")
+    if batch_axis is not None:
+        if batch_axis == axis:
+            raise ValueError(
+                f"batch_axis must differ from the expert axis ({axis!r})")
+        if batch_axis not in mesh.shape:
+            raise ValueError(
+                f"batch_axis {batch_axis!r} not in mesh axes "
+                f"{tuple(mesh.shape)}")
+    n_tok_shards = n_shards * (mesh.shape[batch_axis] if batch_axis else 1)
     T = x.shape[0]
-    if T % n_shards:
-        raise ValueError(f"tokens {T} not divisible by ep={n_shards}")
-    C = capacity_for(T // n_shards, E, k, capacity_factor)
-    run = _build_moe_run(mesh, axis, k, E, C, expert_fn)
+    if T % n_tok_shards:
+        raise ValueError(
+            f"tokens {T} not divisible by token shards {n_tok_shards}")
+    C = capacity_for(T // n_tok_shards, E, k, capacity_factor)
+    run = _build_moe_run(mesh, axis, k, E, C, expert_fn, batch_axis)
 
     if not isinstance(x, jax.core.Tracer):
-        x = jax.device_put(x, NamedSharding(mesh, PartitionSpec(axis, None)))
+        tok_dims = (batch_axis, axis) if batch_axis else axis
+        x = jax.device_put(x,
+                           NamedSharding(mesh, PartitionSpec(tok_dims, None)))
     return run(params["gate_w"], params["experts"], x)
 
 
@@ -212,8 +238,9 @@ class MoELayer:
     """Stateful convenience wrapper around ``moe_apply`` (trainable)."""
 
     def __init__(self, d_model, d_hidden, num_experts, mesh, axis="ep",
-                 k=1, capacity_factor=1.25, seed=0):
+                 k=1, capacity_factor=1.25, seed=0, batch_axis=None):
         self.mesh, self.axis, self.k = mesh, axis, k
+        self.batch_axis = batch_axis
         self.capacity_factor = capacity_factor
         self.params = init_moe_params(np.random.RandomState(seed), d_model,
                                       d_hidden, num_experts)
@@ -221,14 +248,16 @@ class MoELayer:
 
     def __call__(self, x):
         y, aux = moe_apply(self.params, x, self.mesh, self.axis, self.k,
-                           self.capacity_factor)
+                           self.capacity_factor,
+                           batch_axis=self.batch_axis)
         self.last_aux_loss = aux
         return y
 
     def _make_objective(self, loss_fn, x, aux_weight):
         def objective(params):
             y, aux = moe_apply(params, x, self.mesh, self.axis, self.k,
-                               self.capacity_factor)
+                               self.capacity_factor,
+                               batch_axis=self.batch_axis)
             return loss_fn(y) + aux_weight * aux, aux
 
         return objective

@@ -107,6 +107,48 @@ def test_moe_matches_reference():
     assert (np.abs(np.asarray(y)).sum(axis=1) > 0).mean() > 0.5
 
 
+def test_moe_dp_ep_composition():
+    """MoE folded into a combined dp x ep mesh (batch_axis="dp"): tokens
+    shard over dp x ep jointly (dp-major), each dp replica's ep group
+    routes independently with per-shard capacity T/(dp*ep) — numerical
+    parity vs the single-device reference with n_shards = dp*ep, plus
+    gradient parity through the combined mesh."""
+    rng = np.random.RandomState(5)
+    mesh = mx.parallel.make_mesh({"dp": 2, "ep": 4})
+    E, D, H, T = 4, 8, 16, 64
+    params = jax.tree_util.tree_map(
+        jnp.asarray, mx.parallel.init_moe_params(rng, D, H, E))
+    x = jnp.asarray(rng.standard_normal((T, D)).astype(np.float32))
+
+    y, aux = mx.parallel.moe_apply(params, x, mesh, "ep",
+                                   capacity_factor=2.0, batch_axis="dp")
+    y_ref, aux_ref = mx.parallel.moe_reference(params, x, 8,
+                                               capacity_factor=2.0)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-4)
+
+    def obj(p):
+        y, aux = mx.parallel.moe_apply(p, x, mesh, "ep",
+                                       capacity_factor=2.0, batch_axis="dp")
+        return jnp.sum(y ** 2) + 0.01 * aux
+
+    def obj_ref(p):
+        y, aux = mx.parallel.moe_reference(p, x, 8, capacity_factor=2.0)
+        return jnp.sum(y ** 2) + 0.01 * aux
+
+    g = jax.grad(obj)(params)
+    g_ref = jax.grad(obj_ref)(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+    # token count must divide the COMBINED dp x ep shard count
+    with pytest.raises(ValueError, match="not divisible"):
+        mx.parallel.moe_apply(params, x[:12], mesh, "ep", batch_axis="dp")
+
+
 @pytest.mark.slow
 def test_moe_topk_and_grads():
     rng = np.random.RandomState(1)
