@@ -27,6 +27,15 @@ BASELINE_IMG_PER_SEC_PER_CHIP = 2500.0
 TPU_ATTEMPT_TIMEOUT_S = float(os.environ.get("BENCH_TPU_TIMEOUT", 1800))
 
 
+# metric -> round-capture artifact filename; tools/compare_baseline.py
+# imports this (single source of truth for the regression gate)
+LATEST_ARTIFACTS = {
+    "resnet50_train_throughput": "BENCH_TPU_LATEST.json",
+    "gpt_train_throughput": "BENCH_GPT_LATEST.json",
+    "cifar_inception_bn_small_train_throughput": "BENCH_CIFAR_LATEST.json",
+}
+
+
 def _run_with_watchdog():
     """Try the real benchmark in a child; on hang/crash, rerun on CPU."""
     env = dict(os.environ)
@@ -277,10 +286,7 @@ def _best_tpu_record(metric):
     _adopt_sweep_winner, so sweep children (which pin it to /dev/null)
     and tests stay isolated."""
     here = os.path.dirname(os.path.abspath(__file__))
-    latest = {"resnet50_train_throughput": "BENCH_TPU_LATEST.json",
-              "gpt_train_throughput": "BENCH_GPT_LATEST.json",
-              "cifar_inception_bn_small_train_throughput":
-                  "BENCH_CIFAR_LATEST.json"}.get(metric)
+    latest = LATEST_ARTIFACTS.get(metric)
     candidates = []
     if latest:
         candidates.append((os.path.join(here, latest), None))
@@ -455,9 +461,14 @@ def bench_gpt(jax, np, mx, on_tpu, n_chips):
     # the only activation transposes in the step HLO); sweepable, off
     # by default until on-chip numbers pick the winner
     attn_layout = os.environ.get("BENCH_ATTN_LAYOUT", "bhsd")
+    # Mosaic kernels can't be auto-partitioned by GSPMD: a multi-chip dp
+    # mesh must take the XLA attention (or a ring/Ulysses sp mesh);
+    # single-chip keeps the fused Pallas kernel
+    attn_impl = "xla" if (on_tpu and n_chips > 1) else "auto"
     net = mx.models.gpt(vocab, seq_len, num_layers=n_layers,
                         d_model=d_model, num_heads=n_heads,
-                        fused_qkv=fused_qkv, attn_layout=attn_layout)
+                        fused_qkv=fused_qkv, attn_layout=attn_layout,
+                        attn_impl=attn_impl)
     _train_throughput(
         jax, np, mx, net,
         input_shapes={"data": (batch, seq_len),

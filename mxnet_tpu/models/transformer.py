@@ -18,7 +18,7 @@ from .. import symbol as sym
 
 def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
         d_ff=None, dropout=0.0, causal=True, remat=False, fused_qkv=False,
-        attn_layout="bhsd", name="gpt"):
+        attn_layout="bhsd", attn_impl="auto", name="gpt"):
     """Symbol computing next-token softmax loss.
 
     Inputs: ``data`` (batch, seq_len) token ids; ``softmax_label``
@@ -41,6 +41,12 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
     the only activation transposes in the step's HLO).  Same math and
     checkpoint layout; opt-in pending on-chip measurement
     (BENCH_ATTN_LAYOUT sweep point).
+
+    ``attn_impl``: "auto" uses the fused Pallas kernel on TPU.  Mosaic
+    kernels cannot be auto-partitioned by GSPMD, so a MULTI-DEVICE
+    data-parallel trainer over this model must pass "xla" (or shard
+    the sequence with ring/Ulysses attention instead); single-chip
+    training keeps the fused kernel.
     """
     if d_model % num_heads:
         raise ValueError("d_model must divide into num_heads")
@@ -98,7 +104,7 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
 
             attn = sym.FlashAttention(heads(q), heads(k), heads(v),
                                       name=f"{p}_attn", causal=causal,
-                                      layout=attn_layout)
+                                      layout=attn_layout, impl=attn_impl)
             if attn_layout == "bshd":
                 merged = sym.Reshape(attn, shape=(-1, d_model))
             else:

@@ -37,7 +37,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention"]
 
-_NEG_INF = -1e30
+# np.float32, not a Python float: inside Mosaic-lowered kernel bodies a
+# bare Python float is a weak float64 constant, and Mosaic has no
+# f64->f32 cast — the kernel would fail TPU lowering (caught by
+# tests/test_perf_contract.py's cross-platform lowering gate)
+_NEG_INF = np.float32(-1e30)
+_ZERO = np.float32(0.0)
+_TINY = np.float32(1e-30)
 
 
 def _on_tpu():
@@ -79,65 +85,117 @@ def _tile_live(i, j, bq, bk, causal, qo, ko):
 
 
 # -- forward ------------------------------------------------------------------
+#
+# Layout strategy (Mosaic tiling rule: the last two dims of every block
+# must divide (8, 128) or equal the array dims):
+#
+# - BHSD: inputs flattened to (BH, S, D); grid (BH, nq, nk); blocks
+#   (1, blk, D) — last two dims (blk, D) legal.  One head per grid row.
+# - BSHD (sequence-major): the array stays (B, S, H, D) — blocks must
+#   span the FULL (H, D) trailing dims to be legal, so the grid is
+#   (B, nq, nk) and the kernel loops the (static, unrolled) head axis,
+#   slicing each (blk, H, D) VMEM tile per head.  All head shuffling
+#   happens in VMEM/registers: zero HBM activation transposes, which is
+#   the point of the layout.
+#
+# Per-row tensors (lse/delta/dlse) are (BH, 1, S) [bhsd] or (B, H, S)
+# [bshd] so their blocks' trailing dims can be 'equal' to the array's.
 
-def _t(ref):
-    """(blk, D) tile from a (1, blk, D) [BHSD] or (1, blk, 1, D) [BSHD]
-    block ref — the kernel bodies are layout-agnostic through this."""
-    return ref[0] if len(ref.shape) == 3 else ref[0, :, 0, :]
+
+def _heads(H):
+    return [None] if H is None else list(range(H))
 
 
-def _st(ref, val):
-    if len(ref.shape) == 3:
+def _load(ref, h):
+    """(blk, D) float32 tile: 3D block (1, blk, D), or head ``h`` of a
+    4D (1, blk, H, D) block (static sublane index — VMEM-local)."""
+    x = ref[0]
+    if h is not None:
+        x = x[:, h, :]
+    return x.astype(jnp.float32)
+
+
+def _store(ref, h, val):
+    if h is None:
         ref[0] = val
     else:
-        ref[0, :, 0, :] = val
+        ref[0, :, h, :] = val
+
+
+def _row(ref, h):
+    """(blk,) row from a (1, 1, blk) [bhsd] or (1, H, blk) [bshd] block."""
+    return ref[0, 0] if h is None else ref[0, h]
+
+
+def _row_set(ref, h, val):
+    if h is None:
+        ref[0, 0] = val
+    else:
+        ref[0, h] = val
+
+
+def _sget(ref, h):
+    """Scratch slab: whole ref (bhsd) or leading-index ``h`` (bshd)."""
+    return ref[...] if h is None else ref[h]
+
+
+def _sset(ref, h, val):
+    if h is None:
+        ref[...] = val
+    else:
+        ref[h] = val
 
 
 def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc, m_sc, l_sc, *, scale, causal, bq, bk, nk):
+                acc, m_sc, l_sc, *, scale, causal, bq, bk, nk, H):
     j = pl.program_id(2)
 
     @pl.when(j == 0)
     def _():
-        acc[:] = jnp.zeros_like(acc)
-        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
-        l_sc[:] = jnp.zeros_like(l_sc)
+        acc[...] = jnp.zeros_like(acc)
+        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
 
     i = pl.program_id(1)
 
     @_tile_live(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
     def _():
-        q = _t(q_ref).astype(jnp.float32)
-        k = _t(k_ref).astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
         mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
-        if mask is not None:
-            s = jnp.where(mask, s, _NEG_INF)
+        for h in _heads(H):
+            q = _load(q_ref, h)
+            k = _load(k_ref, h)
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+            if mask is not None:
+                s = jnp.where(mask, s, _NEG_INF)
 
-        m_prev = m_sc[:, 0]
-        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_cur[:, None])
-        if mask is not None:
-            # without this, a fully-masked row (m_cur == _NEG_INF) would
-            # get p == exp(0) == 1 for every masked entry
-            p = jnp.where(mask, p, 0.0)
-        alpha = jnp.exp(m_prev - m_cur)
-        l_cur = l_sc[:, 0] * alpha + jnp.sum(p, axis=-1)
-        v = _t(v_ref).astype(jnp.float32)
-        acc[:] = acc[:] * alpha[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
-        m_sc[:, 0] = m_cur
-        l_sc[:, 0] = l_cur
+            m_prev = _sget(m_sc, h)[:, 0]
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_cur[:, None])
+            if mask is not None:
+                # without this, a fully-masked row (m_cur == _NEG_INF)
+                # would get p == exp(0) == 1 for every masked entry
+                p = jnp.where(mask, p, _ZERO)
+            alpha = jnp.exp(m_prev - m_cur)
+            l_cur = _sget(l_sc, h)[:, 0] * alpha + jnp.sum(p, axis=-1)
+            v = _load(v_ref, h)
+            _sset(acc, h, _sget(acc, h) * alpha[:, None] + jnp.dot(
+                p, v, preferred_element_type=jnp.float32))
+            _sset(m_sc, h, m_cur[:, None])
+            _sset(l_sc, h, l_cur[:, None])
 
     @pl.when(j == nk - 1)
     def _():
-        l_row = l_sc[:, 0]
-        valid = l_row > 0.0           # False only for fully-masked rows
-        l_fin = jnp.maximum(l_row, 1e-30)
-        _st(o_ref, jnp.where(valid[:, None], acc[:] / l_fin[:, None],
-                             0.0).astype(o_ref.dtype))
-        lse_ref[0] = jnp.where(valid, m_sc[:, 0] + jnp.log(l_fin), _NEG_INF)
+        for h in _heads(H):
+            l_row = _sget(l_sc, h)[:, 0]
+            valid = l_row > _ZERO     # False only for fully-masked rows
+            l_fin = jnp.maximum(l_row, _TINY)
+            _store(o_ref, h,
+                   jnp.where(valid[:, None], _sget(acc, h) / l_fin[:, None],
+                             _ZERO).astype(o_ref.dtype))
+            _row_set(lse_ref, h,
+                     jnp.where(valid, _sget(m_sc, h)[:, 0] + jnp.log(l_fin),
+                               _NEG_INF))
 
 
 def _scalar_spec():
@@ -156,16 +214,15 @@ def _dims(q, k):
 
 
 def _seq_spec(blk, D, H, pick):
-    """Block spec for a Q/K/V/dO-class tensor: one (blk, D) tile per
-    grid step.  BHSD (H=None): blocks of the flattened (BH, S, D)
-    array.  BSHD: blocks of the native (B, S, H, D) array — the head
-    dim is INDEXED (bh %% H), never transposed, so feeding the kernel
-    from sequence-major activations costs no HBM data movement.
-    ``pick`` selects which grid axis is this tensor's sequence block."""
+    """Block spec for a Q/K/V/dO-class tensor: BHSD (H=None) gets a
+    (blk, D) tile of the flattened (BH, S, D) array per grid step; BSHD
+    gets a (blk, H, D) tile spanning ALL heads (Mosaic requires full
+    trailing (H, D) dims; the kernel head-loops in VMEM).  ``pick``
+    selects which grid axis is this tensor's sequence block."""
     if H is None:
         return pl.BlockSpec((1, blk, D), lambda *g: (g[0], pick(g), 0))
-    return pl.BlockSpec((1, blk, 1, D),
-                        lambda *g: (g[0] // H, pick(g), g[0] % H, 0))
+    return pl.BlockSpec((1, blk, H, D),
+                        lambda *g: (g[0], pick(g), 0, 0))
 
 
 def _out_shape(BH, S, D, H, dtype):
@@ -174,16 +231,36 @@ def _out_shape(BH, S, D, H, dtype):
     return jax.ShapeDtypeStruct((BH // H, S, H, D), dtype)
 
 
+def _row_spec(blk, H, pick):
+    """Block spec for an lse/delta-class per-row tensor, stored
+    (BH, 1, S) [bhsd] or (B, H, S) [bshd]: Mosaic requires the last two
+    block dims to divide (8, 128) or equal the array dims — a (1, blk)
+    block of a 2D (BH, S) array fails that whenever BH > 1, so the row
+    tensors carry a middle dim the block can be 'equal' on."""
+    if H is None:
+        return pl.BlockSpec((1, 1, blk), lambda *g: (g[0], 0, pick(g)))
+    return pl.BlockSpec((1, H, blk), lambda *g: (g[0], 0, pick(g)))
+
+
+def _row_shape(BH, S, H):
+    if H is None:
+        return (BH, 1, S)
+    return (BH // H, H, S)
+
+
 def _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret):
     BH, Sq, Sk, D, H = _dims(q, k)
     nq, nk = Sq // bq, Sk // bk
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nk=nk)
+    kernel = functools.partial(_fwd_kernel, scale=np.float32(scale),
+                               causal=causal, bq=bq, bk=bk, nk=nk, H=H)
     qi = lambda g: g[1]
     ki = lambda g: g[2]
+    grid0 = BH if H is None else BH // H
+    sc = (lambda *dims: pltpu.VMEM(dims, jnp.float32)) if H is None else (
+        lambda *dims: pltpu.VMEM((H,) + dims, jnp.float32))
     o, lse = pl.pallas_call(
         kernel,
-        grid=(BH, nq, nk),
+        grid=(grid0, nq, nk),
         in_specs=[
             _scalar_spec(),
             _scalar_spec(),
@@ -193,106 +270,113 @@ def _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret):
         ],
         out_specs=[
             _seq_spec(bq, D, H, qi),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            _row_spec(bq, H, qi),
         ],
         out_shape=[
             _out_shape(BH, Sq, D, H, q.dtype),
-            jax.ShapeDtypeStruct((BH, Sq), jnp.float32),
+            jax.ShapeDtypeStruct(_row_shape(BH, Sq, H), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bq, D), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
+            sc(bq, D),
+            sc(bq, 1),
+            sc(bq, 1),
         ],
         interpret=interpret,
     )(qo, ko, q, k, v)
-    return o, lse
+    return o, lse.reshape(BH, Sq)
 
 
 # -- backward -----------------------------------------------------------------
 
 def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                    delta_ref, dlse_ref, dq_ref, dq_acc, *, scale, causal,
-                   bq, bk, nk):
+                   bq, bk, nk, H):
     j = pl.program_id(2)
 
     @pl.when(j == 0)
     def _():
-        dq_acc[:] = jnp.zeros_like(dq_acc)
+        dq_acc[...] = jnp.zeros_like(dq_acc)
 
     i = pl.program_id(1)
 
     @_tile_live(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
     def _():
-        q = _t(q_ref).astype(jnp.float32)
-        k = _t(k_ref).astype(jnp.float32)
-        v = _t(v_ref).astype(jnp.float32)
-        do = _t(do_ref).astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
-        dlse = dlse_ref[0]
-
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
         mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
-        if mask is not None:
-            s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        if mask is not None:
-            p = jnp.where(mask, p, 0.0)  # fully-masked rows have lse=_NEG_INF
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        # d s from the o path (p*(dp - delta)) and the lse output (p*dlse)
-        ds = p * (dp - delta[:, None] + dlse[:, None]) * scale
-        dq_acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        for h in _heads(H):
+            q = _load(q_ref, h)
+            k = _load(k_ref, h)
+            v = _load(v_ref, h)
+            do = _load(do_ref, h)
+            lse = _row(lse_ref, h)
+            delta = _row(delta_ref, h)
+            dlse = _row(dlse_ref, h)
+
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+            if mask is not None:
+                s = jnp.where(mask, s, _NEG_INF)
+            p = jnp.exp(s - lse[:, None])
+            if mask is not None:
+                p = jnp.where(mask, p, _ZERO)  # fully-masked: lse=_NEG_INF
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            # ds from the o path (p*(dp - delta)) and the lse output (p*dlse)
+            ds = p * (dp - delta[:, None] + dlse[:, None]) * scale
+            _sset(dq_acc, h, _sget(dq_acc, h) + jnp.dot(
+                ds, k, preferred_element_type=jnp.float32))
 
     @pl.when(j == nk - 1)
     def _():
-        _st(dq_ref, dq_acc[:].astype(dq_ref.dtype))
+        for h in _heads(H):
+            _store(dq_ref, h, _sget(dq_acc, h).astype(dq_ref.dtype))
 
 
 def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    scale, causal, bq, bk, nq):
+                    scale, causal, bq, bk, nq, H):
     i = pl.program_id(2)  # q-block index (inner loop)
 
     @pl.when(i == 0)
     def _():
-        dk_acc[:] = jnp.zeros_like(dk_acc)
-        dv_acc[:] = jnp.zeros_like(dv_acc)
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
 
     j = pl.program_id(1)  # k-block index (outer)
 
     @_tile_live(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
     def _():
-        q = _t(q_ref).astype(jnp.float32)
-        k = _t(k_ref).astype(jnp.float32)
-        v = _t(v_ref).astype(jnp.float32)
-        do = _t(do_ref).astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
-        dlse = dlse_ref[0]
-
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
         mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
-        if mask is not None:
-            s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        if mask is not None:
-            p = jnp.where(mask, p, 0.0)  # fully-masked rows have lse=_NEG_INF
-        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None] + dlse[:, None]) * scale
-        dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
+        for h in _heads(H):
+            q = _load(q_ref, h)
+            k = _load(k_ref, h)
+            v = _load(v_ref, h)
+            do = _load(do_ref, h)
+            lse = _row(lse_ref, h)
+            delta = _row(delta_ref, h)
+            dlse = _row(dlse_ref, h)
+
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+            if mask is not None:
+                s = jnp.where(mask, s, _NEG_INF)
+            p = jnp.exp(s - lse[:, None])
+            if mask is not None:
+                p = jnp.where(mask, p, _ZERO)  # fully-masked: lse=_NEG_INF
+            _sset(dv_acc, h, _sget(dv_acc, h) + jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[:, None] + dlse[:, None]) * scale
+            _sset(dk_acc, h, _sget(dk_acc, h) + jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
 
     @pl.when(i == nq - 1)
     def _():
-        _st(dk_ref, dk_acc[:].astype(dk_ref.dtype))
-        _st(dv_ref, dv_acc[:].astype(dv_ref.dtype))
+        for h in _heads(H):
+            _store(dk_ref, h, _sget(dk_acc, h).astype(dk_ref.dtype))
+            _store(dv_ref, h, _sget(dv_acc, h).astype(dv_ref.dtype))
 
 
 def _bwd(scale, causal, bq, bk, interpret, res, g):
@@ -306,15 +390,23 @@ def _bwd(scale, causal, bq, bk, interpret, res, g):
             else dlse_in.astype(jnp.float32))
     delta = jnp.sum(do * o.astype(jnp.float32), axis=-1)
     if H is not None:
-        # (B, Sq, H) -> the kernels' (BH, Sq) row layout; tiny (no D dim)
-        delta = jnp.moveaxis(delta, 1, 2).reshape(BH, Sq)
+        # (B, Sq, H) -> (B, H, Sq): the kernels' row layout; tiny (no D)
+        delta = jnp.moveaxis(delta, 1, 2)
+    # row tensors carry a middle dim for Mosaic (see _row_spec)
+    row_shape = _row_shape(BH, Sq, H)
+    lse = lse.reshape(row_shape)
+    delta = delta.reshape(row_shape)
+    dlse = dlse.reshape(row_shape)
 
+    grid0 = BH if H is None else BH // H
+    sc = (lambda *dims: pltpu.VMEM(dims, jnp.float32)) if H is None else (
+        lambda *dims: pltpu.VMEM((H,) + dims, jnp.float32))
     qi = lambda g: g[1]
     ki = lambda g: g[2]
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk),
-        grid=(BH, nq, nk),
+        functools.partial(_bwd_dq_kernel, scale=np.float32(scale),
+                          causal=causal, bq=bq, bk=bk, nk=nk, H=H),
+        grid=(grid0, nq, nk),
         in_specs=[
             _scalar_spec(),
             _scalar_spec(),
@@ -322,22 +414,22 @@ def _bwd(scale, causal, bq, bk, interpret, res, g):
             _seq_spec(bk, D, H, ki),
             _seq_spec(bk, D, H, ki),
             _seq_spec(bq, D, H, qi),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            _row_spec(bq, H, qi),
+            _row_spec(bq, H, qi),
+            _row_spec(bq, H, qi),
         ],
         out_specs=_seq_spec(bq, D, H, qi),
         out_shape=_out_shape(BH, Sq, D, H, q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        scratch_shapes=[sc(bq, D)],
         interpret=interpret,
     )(qo, ko, q, k, v, do, lse, delta, dlse)
 
     qj = lambda g: g[2]
     kj = lambda g: g[1]
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq),
-        grid=(BH, nk, nq),
+        functools.partial(_bwd_dkv_kernel, scale=np.float32(scale),
+                          causal=causal, bq=bq, bk=bk, nq=nq, H=H),
+        grid=(grid0, nk, nq),
         in_specs=[
             _scalar_spec(),
             _scalar_spec(),
@@ -345,9 +437,9 @@ def _bwd(scale, causal, bq, bk, interpret, res, g):
             _seq_spec(bk, D, H, kj),
             _seq_spec(bk, D, H, kj),
             _seq_spec(bq, D, H, qj),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            _row_spec(bq, H, qj),
+            _row_spec(bq, H, qj),
+            _row_spec(bq, H, qj),
         ],
         out_specs=[
             _seq_spec(bk, D, H, kj),
@@ -357,8 +449,7 @@ def _bwd(scale, causal, bq, bk, interpret, res, g):
             _out_shape(BH, Sk, D, H, k.dtype),
             _out_shape(BH, Sk, D, H, v.dtype),
         ],
-        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
-                        pltpu.VMEM((bk, D), jnp.float32)],
+        scratch_shapes=[sc(bk, D), sc(bk, D)],
         interpret=interpret,
     )(qo, ko, q, k, v, do, lse, delta, dlse)
     return dq, dk, dv, None, None

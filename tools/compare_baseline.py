@@ -3,11 +3,20 @@
 the repo root and print ONE markdown table of metric vs reference
 baseline (the judge/README view of ARTIFACTS.md).
 
-Usage: python tools/compare_baseline.py [--repo DIR]
+Usage: python tools/compare_baseline.py [--repo DIR] [--check [--threshold F]]
 Exits 0 with whatever subset of artifacts exists.
+
+``--check`` is the regression gate: for each headline metric, the
+CURRENT artifact (BENCH_*_LATEST.json) is compared against the BEST
+prior TPU record anywhere in the history (BENCH_r*.json round records,
+their embedded best_tpu_record, BENCH_SWEEP.json results); a current
+TPU value more than ``--threshold`` (default 5%) below the best prior
+exits 1.  Run by tests/test_perf_contract.py, so a committed artifact
+that regresses a previous round's measurement fails CI.
 """
 
 import argparse
+import glob
 import json
 import os
 
@@ -83,12 +92,88 @@ def rows_from(repo):
     return rows
 
 
+def _latest_map():
+    """metric -> LATEST artifact filename, imported from bench.py (the
+    single source of truth) with a frozen fallback for standalone use."""
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    try:
+        from bench import LATEST_ARTIFACTS
+        return LATEST_ARTIFACTS
+    except Exception:
+        return {"resnet50_train_throughput": "BENCH_TPU_LATEST.json",
+                "gpt_train_throughput": "BENCH_GPT_LATEST.json",
+                "cifar_inception_bn_small_train_throughput":
+                    "BENCH_CIFAR_LATEST.json"}
+
+
+def _tpu_records(rec, metric):
+    """Every TPU measurement of ``metric`` reachable from one artifact
+    payload: the record itself, its embedded best_tpu_record (CPU
+    fallback lines carry the best prior hardware number), and sweep
+    result lists."""
+    if not isinstance(rec, dict):
+        return
+    if (rec.get("metric") == metric and rec.get("platform") == "tpu"
+            and "error" not in rec and rec.get("value")):
+        yield float(rec["value"])
+    embedded = rec.get("best_tpu_record")
+    if isinstance(embedded, dict) and embedded.get("value") and (
+            rec.get("metric") == metric):
+        yield float(embedded["value"])
+    for child in rec.get("results", []):
+        yield from _tpu_records(child, metric)
+    for child in rec.values():
+        # sweep best_* entries (explicit metric match only)
+        if isinstance(child, dict) and "config" in child and \
+                child.get("metric") == metric and \
+                child.get("platform") == "tpu" and child.get("value"):
+            yield float(child["value"])
+
+
+def check(repo, threshold):
+    """Regression gate; returns a list of failure strings."""
+    failures = []
+    history = sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))) + [
+        os.path.join(repo, "BENCH_SWEEP.json")]
+    for metric, latest_name in _latest_map().items():
+        cur_rec = _load(os.path.join(repo, latest_name))
+        if not cur_rec or cur_rec.get("platform") != "tpu":
+            continue                    # nothing current to gate
+        cur = float(cur_rec.get("value", 0))
+        prior = [v for path in history
+                 for v in _tpu_records(_load(path), metric)]
+        if not prior:
+            continue
+        best = max(prior)
+        if cur < best * (1.0 - threshold):
+            failures.append(
+                f"{metric}: current {cur:.1f} ({latest_name}) is "
+                f"{(1 - cur / best) * 100:.1f}% below best prior {best:.1f} "
+                f"(threshold {threshold * 100:.0f}%)")
+    return failures
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--repo",
                    default=os.path.dirname(os.path.dirname(
                        os.path.abspath(__file__))))
+    p.add_argument("--check", action="store_true",
+                   help="regression gate: exit 1 if a current artifact "
+                        "regresses the best prior TPU record")
+    p.add_argument("--threshold", type=float, default=0.05)
     args = p.parse_args()
+    if args.check:
+        failures = check(args.repo, args.threshold)
+        for f in failures:
+            print(f"REGRESSION: {f}")
+        if failures:
+            raise SystemExit(1)
+        print("regression gate: OK")
+        return
     rows = rows_from(args.repo)
     print("| Metric | Measured | vs baseline | Notes |")
     print("|---|---|---|---|")

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Structural audit of the lowered bench train steps.
+
+Lowers the EXACT ``ShardedTrainer._train_step`` each bench mode runs
+(bench.py model configs, tiny trace shapes) to StableHLO — which is
+platform-independent, so the audit is valid with the TPU tunnel down —
+and counts layout-relevant ops.  The round-3 audits (BENCH_NOTES.md)
+found: ResNet-50 NHWC/s2d = 3 transposes (all the FC-head weight),
+CIFAR inception-bn-small = 3 (same), GPT bshd = zero activation
+transposes.  ``tests/test_perf_contract.py`` pins these counts so a
+layout regression (a new activation transpose slipping into the step)
+fails CI on CPU alone.
+
+Usage: python tools/hlo_audit.py [--tpu] [resnet|cifar|gpt|gpt_bshd ...]
+Prints one JSON line per model: {"model", "transposes", "convolutions",
+"dot_generals", "all_to_alls"}.
+"""
+
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _force_cpu():
+    os.environ.setdefault("MXTPU_PLATFORMS", "cpu")
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def _lower_step(net, input_shapes, dtype="float32", input_dtypes=None,
+                mesh=None, **trainer_kwargs):
+    """Build the same dp ShardedTrainer bench.py builds; returns
+    (trainer, placed) ready for ``lower_text``."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    import jax
+
+    # single-device mesh: the audit mirrors the real bench program (one
+    # chip).  A multi-device mesh would also hit GSPMD's "Mosaic kernels
+    # cannot be automatically partitioned" on the flash path — multi-chip
+    # attention goes through ring/Ulysses shard_map or attn_impl="xla"
+    # (models.gpt), not auto-partitioned Pallas.
+    if mesh is None:
+        mesh = mx.parallel.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = mx.parallel.ShardedTrainer(
+        net, input_shapes,
+        mesh=mesh,
+        optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                          factor_type="in", magnitude=2),
+        dtype=dtype, input_dtypes=input_dtypes, **trainer_kwargs)
+    rng = np.random.RandomState(0)
+    data_shape = input_shapes["data"]
+    if input_dtypes and np.issubdtype(input_dtypes.get("data"), np.integer):
+        data = rng.randint(0, 32, data_shape)
+    else:
+        data = rng.uniform(-1, 1, data_shape).astype(np.float32)
+    label_dtype = (input_dtypes.get("softmax_label", np.float32)
+                   if input_dtypes else np.float32)
+    label = rng.randint(0, 16, input_shapes["softmax_label"]).astype(
+        label_dtype)
+    placed = trainer._place_batch({"data": data, "softmax_label": label})
+    return trainer, placed
+
+
+def lower_text(trainer, placed, platform=None, force_flash=False):
+    """StableHLO text of the train step.  ``platform="tpu"`` uses
+    cross-platform AOT lowering (works without the chip — Mosaic
+    compiles kernels at lowering time), which is how the audit checks
+    the REAL TPU program while the tunnel is down.  ``force_flash``
+    patches the op layer's TPU detection so the FlashAttention symbol op
+    takes the Pallas path the way it would on hardware."""
+    import contextlib
+    import importlib
+
+    import numpy as np
+
+    fam = importlib.import_module("mxnet_tpu.ops.flash_attention")
+
+    @contextlib.contextmanager
+    def _patched():
+        orig = fam._on_tpu
+        if force_flash:
+            fam._on_tpu = lambda: True
+        try:
+            yield
+        finally:
+            fam._on_tpu = orig
+
+    with _patched():
+        traced = trainer._train_step.trace(
+            trainer.params, trainer.opt_state, trainer.aux, placed,
+            trainer._key, np.float32(1.0))
+        if platform:
+            lowered = traced.lower(lowering_platforms=(platform,))
+        else:
+            lowered = traced.lower()
+    return lowered.as_text()
+
+
+def audit_counts(text):
+    """Count layout-relevant StableHLO ops in lowered text.
+
+    ``activation_transposes`` counts transposes of rank >= 3 operands:
+    rank-2 transposes are the mxnet (num_hidden, input) weight-storage
+    convention meeting dot's layout (a few MB of weight traffic,
+    negligible); rank >= 3 transposes shuffle activations (GB-scale at
+    bench batch sizes) and are the thing a layout regression adds."""
+    dims_lists = re.findall(r"stablehlo\.transpose[^\n]*dims = \[([^\]]*)\]",
+                            text)
+    act = sum(1 for d in dims_lists if len(d.split(",")) >= 3)
+    return {
+        "transposes": len(dims_lists),
+        "activation_transposes": act,
+        "convolutions": len(re.findall(r"stablehlo\.convolution", text)),
+        "dot_generals": len(re.findall(r"stablehlo\.dot_general", text)),
+        "all_to_alls": len(re.findall(r"all_to_all", text)),
+    }
+
+
+def build(model, batch=8):
+    """Lower one bench model's train step (tiny trace shapes; same model
+    constructors and layouts as bench.py's TPU configs)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    if model == "resnet":
+        # bench.py TPU config: NHWC + space-to-depth stem (hw >= 64:
+        # the s2d stem needs the full-size 7x7-equivalent entry, not
+        # the cifar-style small-input stem)
+        hw = 64
+        net = mx.models.resnet(num_classes=1000, num_layers=50,
+                               image_shape=(3, hw, hw), layout="NHWC",
+                               stem="s2d")
+        shapes = {"data": (batch, hw // 2, hw // 2, 12),
+                  "softmax_label": (batch,)}
+        return _lower_step(net, shapes)
+    if model == "cifar":
+        # bench_cifar: inception-bn-small NHWC
+        net = mx.models.inception_bn_small(num_classes=10, layout="NHWC")
+        shapes = {"data": (batch, 28, 28, 3), "softmax_label": (batch,)}
+        return _lower_step(net, shapes)
+    if model in ("gpt", "gpt_bshd"):
+        # bench_gpt config family, tiny: the structural story is
+        # per-layer, so 2 layers suffice
+        seq = 32
+        net = mx.models.gpt(211, seq, num_layers=2, d_model=64, num_heads=4,
+                            fused_qkv=True,
+                            attn_layout="bshd" if model == "gpt_bshd"
+                            else "bhsd")
+        shapes = {"data": (batch, seq), "softmax_label": (batch, seq)}
+        return _lower_step(net, shapes,
+                           input_dtypes={"data": np.int32,
+                                         "softmax_label": np.float32})
+    raise SystemExit(f"unknown model {model!r}")
+
+
+def main(argv):
+    _force_cpu()
+    tpu = "--tpu" in argv
+    models = [a for a in argv if not a.startswith("--")] or [
+        "resnet", "cifar", "gpt", "gpt_bshd"]
+    for model in models:
+        trainer, placed = build(model)
+        rec = {"model": model, "platform": "tpu" if tpu else "cpu"}
+        text = lower_text(trainer, placed,
+                          platform="tpu" if tpu else None,
+                          force_flash=tpu)
+        rec.update(audit_counts(text))
+        rec["tpu_custom_calls"] = len(re.findall(r"tpu_custom_call", text))
+        print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
