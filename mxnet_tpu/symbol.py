@@ -458,6 +458,14 @@ class Symbol:
         return _create(gname, [], {**bound, "name": gname})
 
     # -- serialization (static_graph.cc:601-616 JSON contract) --------------
+    def __reduce__(self):
+        """Pickle via the JSON graph (reference symbol.py __getstate__:
+        the handle is process-local; the graph is the state).  Lets
+        objects that CARRY a symbol — an Optimizer created with
+        ``sym=`` riding to a kvstore server, a checkpointed module —
+        pickle without dragging registry lambdas along."""
+        return (load_json, (self.tojson(),))
+
     def tojson(self) -> str:
         nodes = self._topo()
         for n in nodes:

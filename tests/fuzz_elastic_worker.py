@@ -1,0 +1,110 @@
+"""Kill/restart fuzz worker: each rank crashes at SEEDED-RANDOM points
+across its first lives (different batch each life, sometimes during
+init, sometimes mid-epoch), the launcher respawns it under
+--max-restarts, and training must still converge past an accuracy gate.
+
+This is the adversarial extension of elastic_worker.py (one scripted
+crash) to the reference's nightly fault-tolerance intent
+(tests/nightly/dist_sync_kvstore.py class of risk): the PS control
+plane — heartbeats, is_recovery re-init no-ops, rank-keyed barriers —
+must absorb crashes at ARBITRARY protocol points, not one chosen one.
+
+dist_async (the fault-tolerant mode: a crashed worker's pending round
+cannot stall peers).  Launched by test_ps.py via
+tools/launch.py -n 3 -s 2 --max-restarts 2.
+
+Env: FUZZ_MARKER (life-tracking file prefix), FUZZ_SEED.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def synthetic(n=384, c=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, 1, 16, 16), np.float32)
+    y = rng.randint(0, c, n)
+    for i in range(n):
+        X[i, 0, y[i] * 3:y[i] * 3 + 3, 3:13] = 1.0
+    X += rng.randn(*X.shape).astype(np.float32) * 0.1
+    return X, y.astype(np.float32)
+
+
+def main():
+    rank = int(os.environ["MXTPU_PROC_ID"])
+    seed = int(os.environ.get("FUZZ_SEED", "0"))
+    max_restarts = int(os.environ.get("FUZZ_MAX_RESTARTS", "2"))
+    marker = os.environ["FUZZ_MARKER"] + f".rank{rank}"
+
+    # life index = how many times this rank has started
+    with open(marker, "a") as f:
+        f.write("x")
+    with open(marker) as f:
+        life = len(f.read()) - 1
+
+    # deterministic per-(seed, rank, life) crash plan; the LAST allowed
+    # life never crashes, so the job always completes
+    rng = np.random.RandomState(seed * 1000 + rank * 10 + life)
+    crash_batch = None
+    if life < max_restarts and rank != 0:
+        # rank 0 stays alive (some rank must see the job through while
+        # peers churn); others crash with high probability at a random
+        # global batch, occasionally before kvstore init (the nastiest
+        # protocol point: a corpse that never said hello)
+        if rng.rand() < 0.85:
+            crash_batch = int(rng.randint(-1, 18))
+
+    if crash_batch == -1:
+        os._exit(3)                       # die before any PS contact
+
+    kv = mx.kv.create("dist_async")
+    nworker = kv.num_workers
+    X, y = synthetic(seed=seed)
+    Xs, ys = X[rank::nworker], y[rank::nworker]
+    train = mx.io.NDArrayIter(Xs, ys, batch_size=32)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1))
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=4)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    seen = {"batches": 0}
+
+    def maybe_crash(_param):
+        seen["batches"] += 1
+        if crash_batch is not None and seen["batches"] >= crash_batch:
+            os._exit(3)                   # mid-training corpse
+
+    mod = mx.mod.Module(net)
+    mod.fit(train, num_epoch=8, kvstore=kv,
+            initializer=mx.initializer.Xavier(factor_type="in",
+                                              rnd_type="gaussian",
+                                              magnitude=2),
+            optimizer_params={"learning_rate": 0.05},
+            batch_end_callback=maybe_crash)
+
+    # convergence gate on the FULL dataset (async + restarts add noise;
+    # the separable synthetic task still must be learned)
+    acc = mod.score(mx.io.NDArrayIter(X, y, batch_size=32),
+                    mx.metric.create("acc"))
+    acc = dict(acc)["accuracy"]
+    assert acc > 0.85, f"rank {rank} accuracy {acc} below gate"
+    print(f"RANK_{rank}_FUZZ_OK acc={acc:.3f} life={life}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -283,3 +283,81 @@ def test_regression_gate_on_real_repo():
     """The committed artifact set must currently satisfy its own gate."""
     r = _run_gate(REPO)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# -- 5. Bucketing recompile audit -------------------------------------------
+
+@pytest.mark.slow
+def test_bucketing_compiles_once_per_bucket():
+    """Steady-state bucket switching must not recompile: each bucket's
+    executor programs compile on FIRST visit only (the reference's
+    bucketing promise — switch_bucket reuses the bound executor,
+    bucketing_module.py:195-220; here the jit cache is the mechanism).
+    A regression that defeats the cache (e.g. a fresh lambda per
+    switch, a shape leaking into a python closure) turns every bucket
+    revisit into a 20-40 s TPU recompile and this test catches it on
+    CPU by counting XLA compile log lines."""
+    import logging
+
+    from mxnet_tpu.io import DataBatch, DataDesc
+
+    rng = np.random.RandomState(0)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        emb = mx.sym.Embedding(data, input_dim=20, output_dim=6, name="emb")
+        pooled = mx.sym.mean(emb, axis=(1,))
+        fc = mx.sym.FullyConnected(pooled, name="fc", num_hidden=4)
+        return mx.sym.SoftmaxOutput(fc, name="softmax"), ["data"], [
+            "softmax_label"]
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=16,
+                                 context=mx.cpu())
+    mod.bind([DataDesc("data", (8, 16))], [DataDesc("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=None,
+                       optimizer_params={"learning_rate": 0.1})
+
+    compiles = []
+
+    class _Counter(logging.Handler):
+        def emit(self, record):
+            msg = record.getMessage()
+            if msg.startswith("Finished XLA compilation"):
+                compiles.append(msg)
+
+    handler = _Counter()
+    logger = logging.getLogger("jax._src.dispatch")
+    prior_level = logger.level
+    logger.addHandler(handler)
+    logger.setLevel(logging.WARNING)
+    import jax as _jax
+
+    prior_log_compiles = _jax.config.jax_log_compiles
+    _jax.config.update("jax_log_compiles", True)
+
+    def run_round():
+        for key in (16, 8, 4, 8, 16, 4):
+            batch = DataBatch(
+                [mx.nd.array(rng.randint(0, 20, (8, key)))],
+                [mx.nd.array(rng.randint(0, 4, 8))],
+                bucket_key=key,
+                provide_data=[DataDesc("data", (8, key))],
+                provide_label=[DataDesc("softmax_label", (8,))])
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+
+    try:
+        run_round()          # first visits: compiles expected
+        warm = len(compiles)
+        assert warm > 0, "counter captured nothing — logging plumbing broke"
+        run_round()          # every bucket already seen
+        run_round()
+        assert len(compiles) == warm, (
+            f"bucket revisits recompiled: {len(compiles) - warm} new "
+            f"compiles after warmup:\n" + "\n".join(compiles[warm:]))
+    finally:
+        _jax.config.update("jax_log_compiles", prior_log_compiles)
+        logger.removeHandler(handler)
+        logger.setLevel(prior_level)

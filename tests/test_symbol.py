@@ -213,3 +213,23 @@ def test_var_arg_ops_num_args_autofill():
     assert s.list_arguments() == ["a", "b"]
     exp = mx.sym.Concat(a, b, num_args=2, dim=0)
     assert len(exp.list_arguments()) == 2
+
+
+def test_symbol_pickles_via_json():
+    """Symbols pickle through their JSON graph (reference symbol.py
+    __getstate__ contract) so optimizer objects created with ``sym=``
+    survive the trip to a kvstore server process (the Module.fit +
+    dist_async path the kill/restart fuzz exercises)."""
+    import pickle
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    s2 = pickle.loads(pickle.dumps(net))
+    assert s2.list_arguments() == net.list_arguments()
+    assert s2.tojson() == net.tojson()
+
+    opt = mx.optimizer.create("sgd", param_idx2name={0: "fc_weight"},
+                              sym=net, learning_rate=0.05)
+    o2 = pickle.loads(pickle.dumps(opt))
+    assert o2.lr == opt.lr

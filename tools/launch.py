@@ -32,6 +32,19 @@ def _free_port():
     return port
 
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _with_repo_path(env):
+    """Children must import mxnet_tpu regardless of the caller's cwd
+    (the launcher is invoked from anywhere; the package is not
+    pip-installed)."""
+    pp = env.get("PYTHONPATH", "")
+    if _REPO not in pp.split(os.pathsep):
+        env["PYTHONPATH"] = _REPO + (os.pathsep + pp if pp else "")
+    return env
+
+
 def _child_env(coordinator, n, rank, extra=None):
     env = dict(os.environ)
     env.update({
@@ -41,7 +54,7 @@ def _child_env(coordinator, n, rank, extra=None):
     })
     if extra:
         env.update(extra)
-    return env
+    return _with_repo_path(env)
 
 
 def _drain(stream):
@@ -66,7 +79,8 @@ def _spawn_servers(num_servers, num_workers):
             proc = subprocess.Popen(
                 [sys.executable, "-m", "mxnet_tpu.ps",
                  "--workers", str(num_workers)],
-                stdout=subprocess.PIPE, text=True)
+                stdout=subprocess.PIPE, text=True,
+                env=_with_repo_path(dict(os.environ)))
             procs.append(proc)
             line = proc.stdout.readline().strip()
             if not line.startswith("PS_ADDR "):
@@ -151,7 +165,11 @@ def launch_ssh(hostfile, command, sync_dir=None, username=None):
             subprocess.check_call(
                 ["rsync", "-az", "--delete", cwd + "/", f"{target}:{cwd}/"])
         env_prefix = (f"MXTPU_COORDINATOR={coordinator} "
-                      f"MXTPU_NUM_PROCS={n} MXTPU_PROC_ID={rank}")
+                      f"MXTPU_NUM_PROCS={n} MXTPU_PROC_ID={rank} "
+                      # same contract as _with_repo_path: remote ranks
+                      # must import mxnet_tpu from the synced tree no
+                      # matter what cwd the job uses
+                      f"PYTHONPATH={_REPO}${{PYTHONPATH:+:$PYTHONPATH}}")
         remote = f"cd {cwd} && {env_prefix} {' '.join(command)}"
         procs.append(subprocess.Popen(["ssh", "-o", "BatchMode=yes",
                                        target, remote]))
