@@ -461,14 +461,12 @@ def bench_gpt(jax, np, mx, on_tpu, n_chips):
     # the only activation transposes in the step HLO); sweepable, off
     # by default until on-chip numbers pick the winner
     attn_layout = os.environ.get("BENCH_ATTN_LAYOUT", "bhsd")
-    # Mosaic kernels can't be auto-partitioned by GSPMD: a multi-chip dp
-    # mesh must take the XLA attention (or a ring/Ulysses sp mesh);
-    # single-chip keeps the fused Pallas kernel
-    attn_impl = "xla" if (on_tpu and n_chips > 1) else "auto"
+    # multi-chip dp keeps the fused kernel too: ShardedTrainer sets the
+    # ambient-mesh context and the FlashAttention op shard_maps its
+    # Mosaic call over the batch axis (ops/attention.py spmd_attention)
     net = mx.models.gpt(vocab, seq_len, num_layers=n_layers,
                         d_model=d_model, num_heads=n_heads,
-                        fused_qkv=fused_qkv, attn_layout=attn_layout,
-                        attn_impl=attn_impl)
+                        fused_qkv=fused_qkv, attn_layout=attn_layout)
     _train_throughput(
         jax, np, mx, net,
         input_shapes={"data": (batch, seq_len),

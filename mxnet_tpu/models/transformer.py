@@ -42,11 +42,12 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
     checkpoint layout; opt-in pending on-chip measurement
     (BENCH_ATTN_LAYOUT sweep point).
 
-    ``attn_impl``: "auto" uses the fused Pallas kernel on TPU.  Mosaic
-    kernels cannot be auto-partitioned by GSPMD, so a MULTI-DEVICE
-    data-parallel trainer over this model must pass "xla" (or shard
-    the sequence with ring/Ulysses attention instead); single-chip
-    training keeps the fused kernel.
+    ``attn_impl``: "auto" uses the fused Pallas kernel on TPU —
+    including under a multi-device data-parallel ShardedTrainer, where
+    the op shard_maps the kernel over the batch axis (Mosaic custom
+    calls cannot be GSPMD-auto-partitioned; ops/attention.py
+    spmd_attention supplies the mesh).  "xla" forces the dense
+    formulation; sequence sharding uses ring/Ulysses instead.
     """
     if d_model % num_heads:
         raise ValueError("d_model must divide into num_heads")

@@ -8,12 +8,35 @@ the Symbol/NDArray frontends like any reference op.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..param import Params, field
 from .op import OpDef, register_op, register_simple_op
+
+# Ambient SPMD context for the fused-attention op: Mosaic kernels cannot
+# be auto-partitioned by GSPMD, so when a FlashAttention op runs inside
+# a multi-device sharded program the kernel call must be wrapped in a
+# shard_map over the batch axis (attention is embarrassingly parallel
+# across data-parallel shards).  ShardedTrainer sets this around its
+# traced graph calls; single-device programs never touch it.
+_SPMD_ATTN = contextvars.ContextVar("spmd_attention", default=None)
+
+
+@contextlib.contextmanager
+def spmd_attention(mesh, batch_axis):
+    """While active, FlashAttention ops wrap their Pallas kernel in
+    ``shard_map(..., in_specs=P(batch_axis, ...))`` over ``mesh`` so
+    fused attention composes with data parallelism."""
+    token = _SPMD_ATTN.set((mesh, batch_axis))
+    try:
+        yield
+    finally:
+        _SPMD_ATTN.reset(token)
 
 
 # -- LayerNorm ---------------------------------------------------------------
@@ -107,6 +130,36 @@ class FlashAttentionOp(OpDef):
             and S % min(params.block_q, S) == 0
             and S % min(params.block_k, S) == 0)
         if use_flash:
+            spmd = _SPMD_ATTN.get()
+            # wrap only when the BATCH axis is actually sharded: a
+            # dp=1 x tp=N mesh must not funnel tp-sharded activations
+            # through a batch-replicated shard_map (redundant compute +
+            # resharding); with dp=1 the kernel call is single-program
+            # per GSPMD and needs no wrap.  (A custom_partitioning rule
+            # on flash_attention would decouple this from the trainer
+            # entirely — candidate future work.)
+            if spmd is not None and \
+                    dict(spmd[0].shape).get(spmd[1], 1) > 1:
+                # data-parallel sharded program: run the kernel per
+                # batch shard under shard_map (GSPMD cannot partition a
+                # Mosaic custom call on its own)
+                from jax import shard_map
+                from jax.sharding import PartitionSpec
+
+                mesh, batch_axis = spmd
+                spec = PartitionSpec(batch_axis, *([None] * (q.ndim - 1)))
+
+                def _local(q_s, k_s, v_s):
+                    return flash_attention(q_s, k_s, v_s,
+                                           causal=params.causal,
+                                           block_q=params.block_q,
+                                           block_k=params.block_k,
+                                           layout=params.layout)
+
+                out = shard_map(_local, mesh=mesh,
+                                in_specs=(spec, spec, spec),
+                                out_specs=spec, check_vma=False)(q, k, v)
+                return [out], []
             out = flash_attention(q, k, v, causal=params.causal,
                                   block_q=params.block_q,
                                   block_k=params.block_k,

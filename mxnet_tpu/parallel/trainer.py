@@ -499,13 +499,20 @@ class ShardedTrainer:
 
     # ------------------------------------------------------------------ #
     def _build_steps(self):
+        from ..ops.attention import spmd_attention
+
         graph = self._graph
 
         n_accum = self._accum
+        mesh, batch_axis = self.mesh, self.batch_axis
 
         def grads_of(params, aux, batch, sub):
             def f(p):
-                outs, new_aux = graph({**p, **batch}, aux, sub, True)
+                # ambient mesh for fused-attention ops: their Mosaic
+                # kernels must shard_map over the batch axis inside a
+                # multi-device program (GSPMD can't partition them)
+                with spmd_attention(mesh, batch_axis):
+                    outs, new_aux = graph({**p, **batch}, aux, sub, True)
                 return outs, new_aux
 
             outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
@@ -562,7 +569,8 @@ class ShardedTrainer:
             return new_params, new_opt, new_aux, outs, key
 
         def eval_step(params, aux, batch, key):
-            outs, _ = graph({**params, **batch}, aux, key, False)
+            with spmd_attention(mesh, batch_axis):
+                outs, _ = graph({**params, **batch}, aux, key, False)
             return outs
 
         p_shard = self.param_shardings

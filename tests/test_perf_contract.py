@@ -361,3 +361,73 @@ def test_bucketing_compiles_once_per_bucket():
         _jax.config.update("jax_log_compiles", prior_log_compiles)
         logger.removeHandler(handler)
         logger.setLevel(prior_level)
+
+
+# -- 6. Fused attention composes with data parallelism ----------------------
+
+@pytest.mark.slow
+def test_dp_sharded_flash_gpt_parity():
+    """A multi-device dp ShardedTrainer over a flash-attention GPT must
+    (a) match the single-device run numerically (the op shard_maps its
+    Pallas call over the batch axis via the ambient-mesh context) and
+    (b) lower for TPU — GSPMD alone cannot partition Mosaic custom
+    calls, which used to make multi-chip dp + fused attention refuse to
+    compile."""
+    import importlib
+
+    vocab, seq = 53, 32
+
+    def build(mesh, impl):
+        net = mx.models.gpt(vocab, seq, num_layers=1, d_model=32,
+                            num_heads=2, attn_impl=impl)
+        return mx.parallel.ShardedTrainer(
+            net, {"data": (8, seq), "softmax_label": (8, seq)},
+            mesh=mesh, batch_axis="dp", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier(),
+            input_dtypes={"data": np.int32, "softmax_label": np.float32})
+
+    mesh2 = mx.parallel.make_mesh({"dp": 2})
+    mesh1 = mx.parallel.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    t2 = build(mesh2, "flash")       # interpreter kernels on CPU
+    t1 = build(mesh1, "flash")
+    p0 = t2.get_params()
+    t1.set_params(p0)
+    key = np.asarray(jax.device_get(t2._key))
+    t1._key = jax.device_put(key, t1._replicated)
+    t2._key = jax.device_put(key, t2._replicated)
+    rng = np.random.RandomState(0)
+    batch = {"data": rng.randint(0, vocab, (8, seq)),
+             "softmax_label": rng.randint(0, vocab, (8, seq)).astype(
+                 np.float32)}
+    o2, o1 = t2.step(batch), t1.step(batch)
+    np.testing.assert_allclose(np.asarray(o2[0]), np.asarray(o1[0]),
+                               atol=2e-5, rtol=2e-4)
+    p2, p1 = t2.get_params(), t1.get_params()
+    for k in p0:
+        np.testing.assert_allclose(p2[k], p1[k], atol=5e-5, rtol=2e-4,
+                                   err_msg=k)
+
+    # (b) the dp=8 program lowers for TPU with Mosaic kernels inside
+    fam = importlib.import_module("mxnet_tpu.ops.flash_attention")
+    orig = fam._on_tpu
+    fam._on_tpu = lambda: True
+    try:
+        net = mx.models.gpt(211, seq, num_layers=2, d_model=64,
+                            num_heads=4, fused_qkv=True)
+        mesh8 = mx.parallel.make_mesh({"dp": 8})
+        tr8 = mx.parallel.ShardedTrainer(
+            net, {"data": (16, seq), "softmax_label": (16, seq)},
+            mesh=mesh8, batch_axis="dp", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier(),
+            input_dtypes={"data": np.int32, "softmax_label": np.float32})
+        placed = tr8._place_batch(
+            {"data": np.zeros((16, seq), np.int64),
+             "softmax_label": np.zeros((16, seq), np.float32)})
+        text = tr8._train_step.trace(
+            tr8.params, tr8.opt_state, tr8.aux, placed, tr8._key,
+            np.float32(1.0)).lower(lowering_platforms=("tpu",)).as_text()
+        assert len(re.findall(r"tpu_custom_call", text)) == 6  # 2 layers x 3
+    finally:
+        fam._on_tpu = orig
