@@ -28,11 +28,17 @@ _SPMD_ATTN = contextvars.ContextVar("spmd_attention", default=None)
 
 
 @contextlib.contextmanager
-def spmd_attention(mesh, batch_axis):
-    """While active, FlashAttention ops wrap their Pallas kernel in
-    ``shard_map(..., in_specs=P(batch_axis, ...))`` over ``mesh`` so
-    fused attention composes with data parallelism."""
-    token = _SPMD_ATTN.set((mesh, batch_axis))
+def spmd_attention(mesh, batch_axis, seq_axis=None):
+    """While active, FlashAttention ops adapt to the sharded program:
+
+    - ``seq_axis`` sharded (sequence parallelism): the op routes to
+      ring attention over that axis — per-shard local attention would
+      silently attend within shards only, so the ring's global-position
+      ppermute schedule is REQUIRED for correctness, whatever impl.
+    - otherwise, batch sharded + Pallas path: the kernel call is
+      wrapped in ``shard_map(..., in_specs=P(batch_axis, ...))`` so
+      fused attention composes with data parallelism."""
+    token = _SPMD_ATTN.set((mesh, batch_axis, seq_axis))
     try:
         yield
     finally:
@@ -123,6 +129,26 @@ class FlashAttentionOp(OpDef):
         q, k, v = inputs
         from .flash_attention import _on_tpu, flash_attention
 
+        spmd = _SPMD_ATTN.get()
+        mesh = batch_ax = None
+        batch_sharded = False
+        if spmd is not None:
+            mesh, batch_ax, seq_ax = spmd
+            mshape = dict(mesh.shape)
+            batch_sharded = mshape.get(batch_ax, 1) > 1
+            if seq_ax is not None and mshape.get(seq_ax, 1) > 1:
+                # sequence-parallel program: global attention over the
+                # sharded sequence REQUIRES the ring schedule — local
+                # per-shard attention would be silently wrong
+                from ..parallel.ring_attention import ring_attention
+
+                out = ring_attention(
+                    q, k, v, mesh, axis=seq_ax, causal=params.causal,
+                    impl=params.impl, block_q=params.block_q,
+                    block_k=params.block_k, layout=params.layout,
+                    batch_axis=batch_ax if batch_sharded else None)
+                return [out], []
+
         seq_axis = 1 if params.layout == "bshd" else 2
         S = q.shape[seq_axis]
         use_flash = params.impl == "flash" or (
@@ -130,7 +156,6 @@ class FlashAttentionOp(OpDef):
             and S % min(params.block_q, S) == 0
             and S % min(params.block_k, S) == 0)
         if use_flash:
-            spmd = _SPMD_ATTN.get()
             # wrap only when the BATCH axis is actually sharded: a
             # dp=1 x tp=N mesh must not funnel tp-sharded activations
             # through a batch-replicated shard_map (redundant compute +
@@ -138,16 +163,14 @@ class FlashAttentionOp(OpDef):
             # per GSPMD and needs no wrap.  (A custom_partitioning rule
             # on flash_attention would decouple this from the trainer
             # entirely — candidate future work.)
-            if spmd is not None and \
-                    dict(spmd[0].shape).get(spmd[1], 1) > 1:
+            if batch_sharded:
                 # data-parallel sharded program: run the kernel per
                 # batch shard under shard_map (GSPMD cannot partition a
                 # Mosaic custom call on its own)
                 from jax import shard_map
                 from jax.sharding import PartitionSpec
 
-                mesh, batch_axis = spmd
-                spec = PartitionSpec(batch_axis, *([None] * (q.ndim - 1)))
+                spec = PartitionSpec(batch_ax, *([None] * (q.ndim - 1)))
 
                 def _local(q_s, k_s, v_s):
                     return flash_attention(q_s, k_s, v_s,

@@ -139,13 +139,13 @@ def _ring_body_flash(q, k, v, axis_name, n_shards, scale, causal, q_index,
 @functools.lru_cache(maxsize=64)
 def _build_ring_run(mesh: Mesh, axis: str, scale: float, causal: bool,
                     impl: str, block_q: int, block_k: int, interpret: bool,
-                    layout: str = "bhsd"):
+                    layout: str = "bhsd", batch_axis=None):
     """Cached compiled ring-attention program per (mesh, axis, config) —
     jax.jit caches on function identity, so the shard_map must be built
     once per config or every call recompiles."""
     n_shards = mesh.shape[axis]
     bshd = layout == "bshd"
-    spec = _ring_spec(layout, axis)
+    spec = _ring_spec(layout, axis, batch_axis)
 
     @jax.jit
     def run(q, k, v):
@@ -173,12 +173,15 @@ def _build_ring_run(mesh: Mesh, axis: str, scale: float, causal: bool,
     return run
 
 
-def _ring_spec(layout, axis):
+def _ring_spec(layout, axis, batch_axis=None):
     """The one seq-sharded PartitionSpec both the shard_map and the
-    caller-side device_put use — they must never desync."""
+    caller-side device_put use — they must never desync.  With
+    ``batch_axis`` the batch dim is additionally dp-sharded (combined
+    dp x sp mesh: each dp replica's sp group runs its own ring — the
+    ppermutes stay inside the sp axis)."""
     if layout == "bshd":
-        return PartitionSpec(None, axis, None, None)
-    return PartitionSpec(None, None, axis, None)
+        return PartitionSpec(batch_axis, axis, None, None)
+    return PartitionSpec(batch_axis, None, axis, None)
 
 
 _FLASH_AVAILABLE = {}
@@ -214,7 +217,8 @@ def _flash_available(layout="bhsd"):
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
-                   impl="auto", block_q=128, block_k=128, layout="bhsd"):
+                   impl="auto", block_q=128, block_k=128, layout="bhsd",
+                   batch_axis=None):
     """Sharded multi-head attention over a sequence-parallel mesh axis.
 
     q/k/v: (batch, heads, seq, head_dim) for ``layout="bhsd"`` or
@@ -228,6 +232,10 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
     kernel; "xla" uses the jnp blockwise body; "auto" picks flash on
     TPU (when the shard length divides the kernel block sizes) and xla
     elsewhere.
+
+    batch_axis: optional dp mesh axis the batch dim is ALSO sharded
+    over (combined dp x sp data+sequence parallelism); each dp
+    replica's sp group runs an independent ring.
     """
     from ..ops.flash_attention import _on_tpu
 
@@ -245,10 +253,10 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
                             and _flash_available(layout))
                 else "xla")
     run = _build_ring_run(mesh, axis, scale, bool(causal), impl,
-                          block_q, block_k, interpret, layout)
+                          block_q, block_k, interpret, layout, batch_axis)
 
     if not isinstance(q, jax.core.Tracer):
-        sharding = NamedSharding(mesh, _ring_spec(layout, axis))
+        sharding = NamedSharding(mesh, _ring_spec(layout, axis, batch_axis))
         q = jax.device_put(q, sharding)
         k = jax.device_put(k, sharding)
         v = jax.device_put(v, sharding)

@@ -299,7 +299,7 @@ class ShardedTrainer:
                  optimizer_params=None, initializer=None, dtype="float32",
                  input_dtypes=None, rescale_grad=None, grad_accum_steps=1,
                  shard_optimizer_state=False, lr_scheduler=None,
-                 fsdp=False, fsdp_min_size=2 ** 17):
+                 fsdp=False, fsdp_min_size=2 ** 17, seq_axis=None):
         if mesh is None:
             from .mesh import local_mesh
 
@@ -494,6 +494,29 @@ class ShardedTrainer:
             n: NamedSharding(mesh, (sequence_specs or {}).get(
                 n, PartitionSpec(batch_axis)))
             for n in self.input_names}
+        # the sequence-parallel mesh axis: FlashAttention ops in the
+        # graph route to ring attention over it — per-shard local
+        # attention over a sharded sequence would be silently wrong.
+        # Explicit ``seq_axis=`` wins; otherwise inferred as the one
+        # non-batch axis sequence_specs shard over, and AMBIGUOUS specs
+        # raise rather than silently disabling the routing (which would
+        # make GSPMD all-gather the sequence at every attention).
+        if seq_axis is not None:
+            self._attn_seq_axis = seq_axis
+        else:
+            seq_axes = set()
+            for spec in (sequence_specs or {}).values():
+                for entry in spec:
+                    for nm in (entry if isinstance(entry, (tuple, list))
+                               else (entry,)):
+                        if nm is not None and nm != batch_axis:
+                            seq_axes.add(nm)
+            if len(seq_axes) > 1:
+                raise ValueError(
+                    f"sequence_specs shard over multiple non-batch axes "
+                    f"{sorted(seq_axes)}; pass seq_axis= to name the "
+                    "sequence-parallel axis explicitly")
+            self._attn_seq_axis = seq_axes.pop() if seq_axes else None
         self._key = _random.next_key()
         self._build_steps()
 
@@ -505,13 +528,15 @@ class ShardedTrainer:
 
         n_accum = self._accum
         mesh, batch_axis = self.mesh, self.batch_axis
+        seq_axis = self._attn_seq_axis
 
         def grads_of(params, aux, batch, sub):
             def f(p):
                 # ambient mesh for fused-attention ops: their Mosaic
                 # kernels must shard_map over the batch axis inside a
-                # multi-device program (GSPMD can't partition them)
-                with spmd_attention(mesh, batch_axis):
+                # multi-device program (GSPMD can't partition them), and
+                # a sharded sequence axis routes them to ring attention
+                with spmd_attention(mesh, batch_axis, seq_axis):
                     outs, new_aux = graph({**p, **batch}, aux, sub, True)
                 return outs, new_aux
 
@@ -569,7 +594,7 @@ class ShardedTrainer:
             return new_params, new_opt, new_aux, outs, key
 
         def eval_step(params, aux, batch, key):
-            with spmd_attention(mesh, batch_axis):
+            with spmd_attention(mesh, batch_axis, seq_axis):
                 outs, _ = graph({**params, **batch}, aux, key, False)
             return outs
 

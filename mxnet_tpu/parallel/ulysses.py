@@ -50,13 +50,14 @@ def _dense_attention(q, k, v, scale, causal):
 @functools.lru_cache(maxsize=64)
 def _build_ulysses_run(mesh: Mesh, axis: str, scale: float, causal: bool,
                        impl: str, block_q: int, block_k: int,
-                       interpret: bool, layout: str = "bhsd"):
+                       interpret: bool, layout: str = "bhsd",
+                       batch_axis=None):
     """Cached compiled program per (mesh, axis, config) — same caching
     contract as ring_attention's _build_ring_run."""
     from .ring_attention import _ring_spec
 
     bshd = layout == "bshd"
-    spec = _ring_spec(layout, axis)
+    spec = _ring_spec(layout, axis, batch_axis)
     # the all-to-all trades the sharded axis for the head axis; both
     # layouts keep their own order end to end (bshd: seq=1, heads=2)
     seq_ax, head_ax = (1, 2) if bshd else (2, 1)
@@ -95,7 +96,8 @@ def _build_ulysses_run(mesh: Mesh, axis: str, scale: float, causal: bool,
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
-                      impl="auto", block_q=128, block_k=128, layout="bhsd"):
+                      impl="auto", block_q=128, block_k=128, layout="bhsd",
+                      batch_axis=None):
     """All-to-all sequence-parallel multi-head attention.
 
     q/k/v: (batch, heads, seq, head_dim) for ``layout="bhsd"`` or
@@ -131,10 +133,11 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
                             and _flash_available(layout))
                 else "xla")
     run = _build_ulysses_run(mesh, axis, scale, bool(causal), impl,
-                             block_q, block_k, interpret, layout)
+                             block_q, block_k, interpret, layout,
+                             batch_axis)
 
     if not isinstance(q, jax.core.Tracer):
-        sharding = NamedSharding(mesh, _ring_spec(layout, axis))
+        sharding = NamedSharding(mesh, _ring_spec(layout, axis, batch_axis))
         q = jax.device_put(q, sharding)
         k = jax.device_put(k, sharding)
         v = jax.device_put(v, sharding)

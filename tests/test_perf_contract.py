@@ -431,3 +431,42 @@ def test_dp_sharded_flash_gpt_parity():
         assert len(re.findall(r"tpu_custom_call", text)) == 6  # 2 layers x 3
     finally:
         fam._on_tpu = orig
+
+
+@pytest.mark.slow
+def test_dp_sp_flash_gpt_lowers_for_tpu():
+    """The combined dp x sp sequence-parallel GPT train step — flash
+    kernels inside the ring schedule inside the sharded trainer — must
+    lower for TPU: Mosaic custom calls present, collective-permutes
+    moving K/V around the sp ring, and NO all-gather of the sequence."""
+    import importlib
+
+    from jax.sharding import PartitionSpec as P
+
+    fam = importlib.import_module("mxnet_tpu.ops.flash_attention")
+    orig = fam._on_tpu
+    fam._on_tpu = lambda: True
+    try:
+        vocab, seq = 211, 512           # shard length 128 = kernel block
+        net = mx.models.gpt(vocab, seq, num_layers=2, d_model=64,
+                            num_heads=4, attn_impl="flash")
+        mesh = mx.parallel.make_mesh({"dp": 2, "sp": 4})
+        tr = mx.parallel.ShardedTrainer(
+            net, {"data": (4, seq), "softmax_label": (4, seq)},
+            mesh=mesh, batch_axis="dp",
+            sequence_specs={"data": P("dp", "sp"),
+                            "softmax_label": P("dp", "sp")},
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier(),
+            input_dtypes={"data": np.int32, "softmax_label": np.float32})
+        placed = tr._place_batch(
+            {"data": np.zeros((4, seq), np.int64),
+             "softmax_label": np.zeros((4, seq), np.float32)})
+        text = tr._train_step.trace(
+            tr.params, tr.opt_state, tr.aux, placed, tr._key,
+            np.float32(1.0)).lower(lowering_platforms=("tpu",)).as_text()
+        assert len(re.findall(r"tpu_custom_call", text)) >= 3
+        assert len(re.findall(r"collective_permute", text)) >= 2
+        assert len(re.findall(r"all_gather", text)) == 0
+    finally:
+        fam._on_tpu = orig
