@@ -18,7 +18,8 @@ from .. import symbol as sym
 
 def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
         d_ff=None, dropout=0.0, causal=True, remat=False, fused_qkv=False,
-        attn_layout="bhsd", attn_impl="auto", name="gpt"):
+        attn_layout="bhsd", attn_impl="auto", attn_sp_impl="ring",
+        name="gpt"):
     """Symbol computing next-token softmax loss.
 
     Inputs: ``data`` (batch, seq_len) token ids; ``softmax_label``
@@ -47,7 +48,12 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
     the op shard_maps the kernel over the batch axis (Mosaic custom
     calls cannot be GSPMD-auto-partitioned; ops/attention.py
     spmd_attention supplies the mesh).  "xla" forces the dense
-    formulation; sequence sharding uses ring/Ulysses instead.
+    formulation.
+
+    ``attn_sp_impl``: the schedule used when a ShardedTrainer shards
+    the sequence axis (sequence_specs) — "ring" (ppermuted K/V shards;
+    any head count) or "ulysses" (two all-to-alls re-shard seq<->heads;
+    needs num_heads % sp == 0).
     """
     if d_model % num_heads:
         raise ValueError("d_model must divide into num_heads")
@@ -105,7 +111,8 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
 
             attn = sym.FlashAttention(heads(q), heads(k), heads(v),
                                       name=f"{p}_attn", causal=causal,
-                                      layout=attn_layout, impl=attn_impl)
+                                      layout=attn_layout, impl=attn_impl,
+                                      sp_impl=attn_sp_impl)
             if attn_layout == "bshd":
                 merged = sym.Reshape(attn, shape=(-1, d_model))
             else:

@@ -31,10 +31,11 @@ _SPMD_ATTN = contextvars.ContextVar("spmd_attention", default=None)
 def spmd_attention(mesh, batch_axis, seq_axis=None):
     """While active, FlashAttention ops adapt to the sharded program:
 
-    - ``seq_axis`` sharded (sequence parallelism): the op routes to
-      ring attention over that axis — per-shard local attention would
-      silently attend within shards only, so the ring's global-position
-      ppermute schedule is REQUIRED for correctness, whatever impl.
+    - ``seq_axis`` sharded (sequence parallelism): the op routes to a
+      sharded-attention schedule over that axis — ring (default) or
+      Ulysses per the op's ``sp_impl`` param.  Per-shard local
+      attention would silently attend within shards only, so SOME
+      global schedule is required for correctness, whatever impl.
     - otherwise, batch sharded + Pallas path: the kernel call is
       wrapped in ``shard_map(..., in_specs=P(batch_axis, ...))`` so
       fused attention composes with data parallelism."""
@@ -101,6 +102,10 @@ class FlashAttentionParam(Params):
     block_k = field(int, default=128)
     impl = field(str, default="auto", enum=("auto", "flash", "xla"))
     layout = field(str, default="bhsd", enum=("bhsd", "bshd"))
+    # sequence-parallel variant when the ambient seq axis is sharded:
+    # ring (ppermute K/V shards; any head count) or ulysses (two
+    # all-to-alls re-shard seq<->heads; needs heads % sp == 0)
+    sp_impl = field(str, default="ring", enum=("ring", "ulysses"))
 
 
 @register_op("FlashAttention", aliases=("flashattention",))
@@ -138,11 +143,16 @@ class FlashAttentionOp(OpDef):
             batch_sharded = mshape.get(batch_ax, 1) > 1
             if seq_ax is not None and mshape.get(seq_ax, 1) > 1:
                 # sequence-parallel program: global attention over the
-                # sharded sequence REQUIRES the ring schedule — local
+                # sharded sequence REQUIRES a sharded schedule — local
                 # per-shard attention would be silently wrong
-                from ..parallel.ring_attention import ring_attention
+                if params.sp_impl == "ulysses":
+                    from ..parallel.ulysses import ulysses_attention \
+                        as sp_attention
+                else:
+                    from ..parallel.ring_attention import ring_attention \
+                        as sp_attention
 
-                out = ring_attention(
+                out = sp_attention(
                     q, k, v, mesh, axis=seq_ax, causal=params.causal,
                     impl=params.impl, block_q=params.block_q,
                     block_k=params.block_k, layout=params.layout,

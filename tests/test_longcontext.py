@@ -523,21 +523,23 @@ def test_ulysses_attention_bshd_layout():
                 err_msg=f"impl={impl} causal={causal}")
 
 
-def test_sharded_trainer_sequence_parallel_gpt():
+@pytest.mark.parametrize("sp_impl,heads", [("ring", 2), ("ulysses", 4)])
+def test_sharded_trainer_sequence_parallel_gpt(sp_impl, heads):
     """Symbol-level sequence parallelism end to end: a ShardedTrainer
     over models.gpt with sequence_specs sharding (B, S) tokens across a
-    dp x sp mesh routes the FlashAttention ops to ring attention (the
-    ambient-mesh context) — one train step matches the single-device
-    run exactly, params included.  Per-shard local attention instead of
-    the ring would fail this test (tokens would only attend within
-    their shard)."""
+    dp x sp mesh routes the FlashAttention ops to the sharded schedule
+    named by attn_sp_impl (ring ppermutes / Ulysses all-to-alls) via
+    the ambient-mesh context — one train step matches the single-device
+    run exactly, params included.  Per-shard local attention instead
+    would fail this test (tokens would only attend within their
+    shard)."""
     from jax.sharding import PartitionSpec as P
 
     vocab, seq = 53, 32
 
     def build(mesh, seq_specs=None):
         net = mx.models.gpt(vocab, seq, num_layers=1, d_model=32,
-                            num_heads=2)
+                            num_heads=heads, attn_sp_impl=sp_impl)
         return mx.parallel.ShardedTrainer(
             net, {"data": (8, seq), "softmax_label": (8, seq)},
             mesh=mesh, batch_axis="dp", sequence_specs=seq_specs,
