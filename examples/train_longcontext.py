@@ -12,8 +12,12 @@ is O((S/n)^2) instead of O(S^2).  On CPU run with:
 """
 
 import argparse
+import os
+import sys
 
 import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def main():
@@ -31,12 +35,22 @@ def main():
     p.add_argument("--layout", default="bhsd", choices=["bhsd", "bshd"],
                    help="bshd = sequence-major shards (no activation "
                         "transposes feeding the flash kernel)")
+    p.add_argument("--trainer", action="store_true",
+                   help="use the symbol-level path instead: a "
+                        "ShardedTrainer over models.gpt with "
+                        "sequence_specs — the FlashAttention ops route "
+                        "to ring/Ulysses automatically")
+    p.add_argument("--dp", type=int, default=2,
+                   help="data-parallel ways for --trainer mode")
     args = p.parse_args()
 
     import jax
     import jax.numpy as jnp
 
     import mxnet_tpu as mx
+
+    if args.trainer:
+        return train_symbol_level(args, jax, mx)
 
     mesh = mx.parallel.make_mesh({"sp": args.sp})
     B, H, S, D = args.batch, args.heads, args.seq_len, args.dim
@@ -70,6 +84,40 @@ def main():
                                         params, grads)
         if i % 5 == 0 or i == args.steps - 1:
             print(f"step {i}: loss {float(loss):.5f}")
+
+
+def train_symbol_level(args, jax, mx):
+    """The user-level path: sequence_specs shard the (B, S) token batch
+    across a dp x sp mesh and every sym.FlashAttention in models.gpt
+    routes to ring (or Ulysses via --mode) attention automatically."""
+    from jax.sharding import PartitionSpec as P
+
+    vocab = 97
+    B, S = args.batch * args.dp, args.seq_len
+    net = mx.models.gpt(vocab, S, num_layers=2, d_model=args.dim,
+                        num_heads=args.heads, attn_layout=args.layout,
+                        attn_impl=args.impl, attn_sp_impl=args.mode)
+    trainer = mx.parallel.ShardedTrainer(
+        net, {"data": (B, S), "softmax_label": (B, S)},
+        mesh=mx.parallel.make_mesh({"dp": args.dp, "sp": args.sp}),
+        batch_axis="dp",
+        sequence_specs={"data": P("dp", "sp"),
+                        "softmax_label": P("dp", "sp")},
+        optimizer="adam", optimizer_params={"learning_rate": 1e-3},
+        initializer=mx.initializer.Xavier(),
+        input_dtypes={"data": np.int32, "softmax_label": np.float32})
+
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, vocab, (B, S))
+    Y = np.roll(X, -1, axis=1).astype(np.float32)
+    for i in range(args.steps):
+        outs = trainer.step({"data": X, "softmax_label": Y})
+        if i % 5 == 0 or i == args.steps - 1:
+            probs = np.asarray(outs[0])
+            nll = -np.mean(np.log(
+                probs[np.arange(probs.shape[0]),
+                      Y.reshape(-1).astype(int)] + 1e-9))
+            print(f"step {i}: nll {nll:.4f}")
 
 
 if __name__ == "__main__":
