@@ -55,14 +55,24 @@ def main():
     args = p.parse_args()
 
     results = {}
-    for mirror in ("0", "1"):
-        os.environ["MXNET_BACKWARD_DO_MIRROR"] = mirror
-        mem = compile_step(args.batch_size, args.hidden, args.depth)
-        temp_mb = mem.temp_size_in_bytes / 1e6
-        results[mirror] = temp_mb
-        print(f"mirror={mirror}: temp buffers {temp_mb:.1f} MB "
-              f"(args {mem.argument_size_in_bytes / 1e6:.1f} MB, "
-              f"output {mem.output_size_in_bytes / 1e6:.1f} MB)")
+    prior = os.environ.get("MXNET_BACKWARD_DO_MIRROR")
+    try:
+        for mirror in ("0", "1"):
+            os.environ["MXNET_BACKWARD_DO_MIRROR"] = mirror
+            mem = compile_step(args.batch_size, args.hidden, args.depth)
+            temp_mb = mem.temp_size_in_bytes / 1e6
+            results[mirror] = temp_mb
+            print(f"mirror={mirror}: temp buffers {temp_mb:.1f} MB "
+                  f"(args {mem.argument_size_in_bytes / 1e6:.1f} MB, "
+                  f"output {mem.output_size_in_bytes / 1e6:.1f} MB)")
+    finally:
+        # restore: this example runs IN-PROCESS in the test suite
+        # (runpy), and a leaked mirror flag changes how every later
+        # trace in the process lowers (jax.checkpoint everywhere)
+        if prior is None:
+            os.environ.pop("MXNET_BACKWARD_DO_MIRROR", None)
+        else:
+            os.environ["MXNET_BACKWARD_DO_MIRROR"] = prior
     if results["1"] < results["0"]:
         print(f"mirroring saved {results['0'] - results['1']:.1f} MB of "
               "temp memory (recompute in backward)")
