@@ -35,6 +35,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_util import idx32
+
 __all__ = ["flash_attention"]
 
 # np.float32, not a Python float: inside Mosaic-lowered kernel bodies a
@@ -199,7 +201,7 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _scalar_spec():
-    return pl.BlockSpec((1, 1), lambda b, x, y: (0, 0),
+    return pl.BlockSpec((1, 1), idx32(lambda b, x, y: (0, 0)),
                         memory_space=pltpu.SMEM)
 
 
@@ -220,9 +222,9 @@ def _seq_spec(blk, D, H, pick):
     trailing (H, D) dims; the kernel head-loops in VMEM).  ``pick``
     selects which grid axis is this tensor's sequence block."""
     if H is None:
-        return pl.BlockSpec((1, blk, D), lambda *g: (g[0], pick(g), 0))
+        return pl.BlockSpec((1, blk, D), idx32(lambda *g: (g[0], pick(g), 0)))
     return pl.BlockSpec((1, blk, H, D),
-                        lambda *g: (g[0], pick(g), 0, 0))
+                        idx32(lambda *g: (g[0], pick(g), 0, 0)))
 
 
 def _out_shape(BH, S, D, H, dtype):
@@ -238,8 +240,8 @@ def _row_spec(blk, H, pick):
     block of a 2D (BH, S) array fails that whenever BH > 1, so the row
     tensors carry a middle dim the block can be 'equal' on."""
     if H is None:
-        return pl.BlockSpec((1, 1, blk), lambda *g: (g[0], 0, pick(g)))
-    return pl.BlockSpec((1, H, blk), lambda *g: (g[0], 0, pick(g)))
+        return pl.BlockSpec((1, 1, blk), idx32(lambda *g: (g[0], 0, pick(g))))
+    return pl.BlockSpec((1, H, blk), idx32(lambda *g: (g[0], 0, pick(g))))
 
 
 def _row_shape(BH, S, H):

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_util import idx32
+
 from .pallas_lstm import _on_tpu, fused_lstm_eligible
 
 __all__ = ["fused_gru", "fused_gru_eligible"]
@@ -74,8 +76,8 @@ def _fwd(gx, h0, wh, bh, interpret, save):
     T, N, G = gx.shape
     H = G // 3
     kernel = functools.partial(_fwd_kernel, T=T, H=H, save=save)
-    full = lambda t: (0, 0)
-    step3 = lambda t: (t, 0, 0)
+    full = idx32(lambda t: (0, 0))
+    step3 = idx32(lambda t: (t, 0, 0))
     out_specs = [pl.BlockSpec((1, N, H), step3),
                  pl.BlockSpec((N, H), full)]
     out_shape = [jax.ShapeDtypeStruct((T, N, H), gx.dtype),   # ys
@@ -154,9 +156,9 @@ def _bwd_call(acts, ys, h0, wh, dys, dhT, out_dtype, interpret):
     H = ys.shape[-1]
     G = 3 * H
     kernel = functools.partial(_bwd_kernel, T=T, H=H)
-    full = lambda rt: (0, 0)
-    rev = lambda rt: (T - 1 - rt, 0, 0)
-    rev_m1 = lambda rt: (jnp.maximum(T - 2 - rt, 0), 0, 0)
+    full = idx32(lambda rt: (0, 0))
+    rev = idx32(lambda rt: (T - 1 - rt, 0, 0))
+    rev_m1 = idx32(lambda rt: (jnp.maximum(T - 2 - rt, 0), 0, 0))
     return pl.pallas_call(
         kernel,
         grid=(T,),

@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_util import idx32
+
 __all__ = ["fused_lstm", "fused_lstm_eligible"]
 
 
@@ -96,8 +98,8 @@ def _fwd(gx, h0, c0, wh, bh, interpret, save):
     T, N, G = gx.shape
     H = G // 4
     kernel = functools.partial(_fwd_kernel, T=T, H=H, save=save)
-    full = lambda t: (0, 0)
-    step3 = lambda t: (t, 0, 0)
+    full = idx32(lambda t: (0, 0))
+    step3 = idx32(lambda t: (t, 0, 0))
     out_specs = [
         pl.BlockSpec((1, N, H), step3),
         pl.BlockSpec((N, H), full),
@@ -195,11 +197,11 @@ def _bwd_call(acts, cells, ys, h0, c0, wh, dys, dhT, dcT, gx_dtype,
     T, N, G = acts.shape
     H = G // 4
     kernel = functools.partial(_bwd_kernel, T=T, H=H)
-    full = lambda rt: (0, 0)
-    rev = lambda rt: (T - 1 - rt, 0, 0)
+    full = idx32(lambda rt: (0, 0))
+    rev = idx32(lambda rt: (T - 1 - rt, 0, 0))
     # previous-step streams: block t-1 (clamped at 0; the t==0 value is
     # replaced by h0/c0 inside the kernel)
-    rev_m1 = lambda rt: (jnp.maximum(T - 2 - rt, 0), 0, 0)
+    rev_m1 = idx32(lambda rt: (jnp.maximum(T - 2 - rt, 0), 0, 0))
     return pl.pallas_call(
         kernel,
         grid=(T,),
