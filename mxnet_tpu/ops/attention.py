@@ -98,8 +98,8 @@ register_simple_op(
 # -- fused multi-head attention ----------------------------------------------
 class FlashAttentionParam(Params):
     causal = field(bool, default=False)
-    block_q = field(int, default=128)
-    block_k = field(int, default=128)
+    block_q = field(int, default=512)
+    block_k = field(int, default=512)
     impl = field(str, default="auto", enum=("auto", "flash", "xla"))
     layout = field(str, default="bhsd", enum=("bhsd", "bshd"))
     # sequence-parallel variant when the ambient seq axis is sharded:
@@ -161,10 +161,10 @@ class FlashAttentionOp(OpDef):
 
         seq_axis = 1 if params.layout == "bshd" else 2
         S = q.shape[seq_axis]
+        from .flash_attention import flash_eligible
         use_flash = params.impl == "flash" or (
             params.impl == "auto" and _on_tpu()
-            and S % min(params.block_q, S) == 0
-            and S % min(params.block_k, S) == 0)
+            and flash_eligible(S, S, params.block_q, params.block_k))
         if use_flash:
             # wrap only when the BATCH axis is actually sharded: a
             # dp=1 x tp=N mesh must not funnel tp-sharded activations

@@ -217,7 +217,7 @@ def _flash_available(layout="bhsd"):
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
-                   impl="auto", block_q=128, block_k=128, layout="bhsd",
+                   impl="auto", block_q=512, block_k=512, layout="bhsd",
                    batch_axis=None):
     """Sharded multi-head attention over a sequence-parallel mesh axis.
 
@@ -247,8 +247,8 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
     S_blk = q.shape[seq_axis] // n_shards
     interpret = not _on_tpu()
     if impl == "auto":
-        fits = (S_blk % min(block_q, S_blk) == 0
-                and S_blk % min(block_k, S_blk) == 0)
+        from ..ops.flash_attention import flash_eligible
+        fits = flash_eligible(S_blk, S_blk, block_q, block_k)
         impl = ("flash" if (not interpret and fits
                             and _flash_available(layout))
                 else "xla")

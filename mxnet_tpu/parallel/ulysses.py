@@ -96,7 +96,7 @@ def _build_ulysses_run(mesh: Mesh, axis: str, scale: float, causal: bool,
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
-                      impl="auto", block_q=128, block_k=128, layout="bhsd",
+                      impl="auto", block_q=512, block_k=512, layout="bhsd",
                       batch_axis=None):
     """All-to-all sequence-parallel multi-head attention.
 
@@ -128,7 +128,8 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
     S = q.shape[seq_axis]
     interpret = not _on_tpu()
     if impl == "auto":
-        fits = (S % min(block_q, S) == 0 and S % min(block_k, S) == 0)
+        from ..ops.flash_attention import flash_eligible
+        fits = flash_eligible(S, S, block_q, block_k)
         impl = ("flash" if (not interpret and fits
                             and _flash_available(layout))
                 else "xla")

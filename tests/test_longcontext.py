@@ -569,3 +569,34 @@ def test_sharded_trainer_sequence_parallel_gpt(sp_impl, heads):
     for k in p0:
         np.testing.assert_allclose(psp[k], p1[k], atol=5e-5, rtol=2e-4,
                                    err_msg=k)
+
+
+def test_block_size_autofit():
+    """Requested flash block sizes are upper bounds that shrink by
+    halving to divide the sequence; eligibility rejects degenerate
+    fits (ops/flash_attention.py:_fit_block / flash_eligible)."""
+    from mxnet_tpu.ops.flash_attention import (_block_sizes, _fit_block,
+                                               flash_attention,
+                                               flash_eligible)
+
+    assert _fit_block(2048, 512) == 512       # divides: untouched
+    assert _fit_block(768, 512) == 256        # halves to a divisor
+    assert _fit_block(16, 512) == 16          # short seq: whole seq
+    assert _fit_block(1000, 512) == 8         # degenerate fit
+    assert _block_sizes(768, 2048, 512, 512) == (256, 512)
+    with pytest.raises(ValueError):           # explicit flash at S=1000
+        _block_sizes(1000, 1000, 512, 512)    # must croak, not crawl
+    assert _block_sizes(40, 40, 8, 8) == (8, 8)   # deliberate small
+    assert flash_eligible(2048, 2048)
+    assert flash_eligible(768, 768)           # 256-tile: MXU-scale
+    assert flash_eligible(16, 16)             # whole-sequence tile
+    assert not flash_eligible(1000, 1000)     # 8-tile would crawl
+
+    # numerics are block-size independent (interpret mode)
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(1, 2, 48, 16), jnp.float32)
+               for _ in range(3))
+    hi = flash_attention(q, k, v, causal=True)            # fits to 48
+    lo = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(hi), np.asarray(lo),
+                               atol=1e-5, rtol=1e-5)
