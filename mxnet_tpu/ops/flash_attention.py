@@ -98,6 +98,43 @@ def flash_eligible(Sq, Sk, block_q=512, block_k=512):
     return (bq == Sq or bq >= 128) and (bk == Sk or bk >= 128)
 
 
+# ~16 MB VMEM per v5e core; leave headroom for Mosaic's own temporaries
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _vmem_bytes(bq, bk, D, H):
+    """Conservative per-grid-step VMEM footprint of the kernels: Q-class
+    tiles (q, do) + K-class tiles (k, v, + pipelining slack), all
+    double-buffered float32, plus accumulator scratch and the score
+    tile.  An estimate, not Mosaic's allocator — it only needs to stop
+    the block autofit from requesting tiles that cannot possibly fit."""
+    Hf = 1 if H is None else H
+    tile = lambda blk: 2 * blk * Hf * D * 4          # double-buffered f32
+    return (2 * tile(bq) + 3 * tile(bk)
+            + 2 * Hf * max(bq, bk) * D * 4           # acc/dk/dv scratch
+            + bq * bk * 4)                           # score tile
+
+
+def _fit_vmem(bq, bk, Sq, Sk, D, H):
+    """Halve the larger block (never below 128 or the whole-sequence
+    tile) until the estimated footprint fits the VMEM budget.  The 512
+    default was benchmarked on bhsd D=64 where it fits easily; bshd
+    blocks span ALL heads, so high-H configs must scale back or Mosaic
+    dies with an opaque allocation failure mid-train."""
+    def shrinkable(b, S):
+        return b > 128 and b == _fit_block(S, b)     # stays a divisor
+    while _vmem_bytes(bq, bk, D, H) > _VMEM_BUDGET:
+        if bk >= bq and shrinkable(bk, Sk):
+            bk //= 2
+        elif shrinkable(bq, Sq):
+            bq //= 2
+        elif shrinkable(bk, Sk):
+            bk //= 2
+        else:
+            break                                    # floor: let Mosaic try
+    return bq, bk
+
+
 def _mask_for(i, j, bq, bk, causal, qo, ko):
     """Score mask for Q tile i vs K tile j (True = keep); qo/ko are
     global position offsets (ring-step shards), possibly traced."""
@@ -551,6 +588,7 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
     if interpret is None:
         interpret = not _on_tpu()
     bq, bk = _block_sizes(Sq, Sk, block_q, block_k)
+    bq, bk = _fit_vmem(bq, bk, Sq, Sk, D, H if layout == "bshd" else None)
 
     if layout == "bshd":
         qf, kf, vf = q, k, v              # native 4D, no data movement

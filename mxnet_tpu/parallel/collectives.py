@@ -52,6 +52,28 @@ def allreduce(arrays, mesh: Mesh, axis_name="dp"):
     return _ar(arrays)
 
 
+def _device_loop_s(step, x0, n_iter):
+    """Per-iteration seconds of ``step`` with the loop ON DEVICE.
+
+    The chip can sit behind an async remote-dispatch runtime (axon
+    tunnel) where every host-side call pays a round trip that dwarfs
+    ms-scale device work, so host loops measure dispatch, not compute.
+    ``fori_loop`` with a TRACED trip count compiles once and serializes
+    iterations through the carried value; the slope between two trip
+    counts cancels the constant per-call overhead."""
+    run_n = jax.jit(lambda n: jax.lax.fori_loop(0, n, lambda i, c: step(c),
+                                                x0))
+    jax.block_until_ready(run_n(1))           # compile + warm
+    n_lo, n_hi = 2, 2 + n_iter
+    tic = time.perf_counter()
+    jax.block_until_ready(run_n(n_lo))
+    t_lo = time.perf_counter() - tic
+    tic = time.perf_counter()
+    jax.block_until_ready(run_n(n_hi))
+    t_hi = time.perf_counter() - tic
+    return max((t_hi - t_lo) / (n_hi - n_lo), 1e-9)
+
+
 def allreduce_bench(mesh=None, sizes_mb=(1, 4, 16, 64, 256), n_iter=10,
                     dtype=jnp.float32, verbose=True):
     """Measure all-reduce algorithmic bandwidth per device over the mesh.
@@ -72,18 +94,10 @@ def allreduce_bench(mesh=None, sizes_mb=(1, 4, 16, 64, 256), n_iter=10,
         x = jax.device_put(
             jnp.ones((n, elems), dtype), sharding)
 
-        @jax.jit
-        def ar(v):
-            return shard_map(lambda t: jax.lax.psum(t, axis), mesh=mesh,
-                             in_specs=PartitionSpec(axis),
-                             out_specs=PartitionSpec(axis))(v)
-
-        ar(x).block_until_ready()  # compile
-        tic = time.perf_counter()
-        for _ in range(n_iter):
-            x = ar(x)
-        x.block_until_ready()
-        dt = (time.perf_counter() - tic) / n_iter
+        step = lambda v: shard_map(lambda t: jax.lax.psum(t, axis),
+                                   mesh=mesh, in_specs=PartitionSpec(axis),
+                                   out_specs=PartitionSpec(axis))(v)
+        dt = _device_loop_s(step, x, n_iter)
         bytes_moved = 2 * (n - 1) / max(n, 1) * elems * np.dtype(dtype).itemsize
         gbps = bytes_moved / dt / 1e9
         results.append({"size_mb": mb, "time_s": dt, "gbps_per_device": gbps})
@@ -105,16 +119,10 @@ def memory_bench(sizes_mb=(64, 256, 1024), n_iter=10, dtype=jnp.float32,
     """
     dev = jax.devices()[0]
     results = []
-    add1 = jax.jit(lambda v: v + 1)
     for mb in sizes_mb:
         elems = int(mb * 1024 * 1024 / np.dtype(dtype).itemsize)
         x = jax.device_put(jnp.ones((elems,), dtype), dev)
-        add1(x).block_until_ready()
-        tic = time.perf_counter()
-        for _ in range(n_iter):
-            x = add1(x)
-        x.block_until_ready()
-        dt = (time.perf_counter() - tic) / n_iter
+        dt = _device_loop_s(lambda v: v + 1, x, n_iter)
         hbm_gbps = 2 * elems * np.dtype(dtype).itemsize / dt / 1e9
 
         host = np.ones((elems,), np.dtype(dtype))

@@ -587,6 +587,15 @@ def test_block_size_autofit():
     with pytest.raises(ValueError):           # explicit flash at S=1000
         _block_sizes(1000, 1000, 512, 512)    # must croak, not crawl
     assert _block_sizes(40, 40, 8, 8) == (8, 8)   # deliberate small
+
+    # VMEM-aware shrink: bshd blocks span all heads, so high-H configs
+    # must scale back below the 512 default; bhsd D=64 keeps it
+    from mxnet_tpu.ops.flash_attention import _fit_vmem, _vmem_bytes
+    assert _fit_vmem(512, 512, 2048, 2048, 64, None) == (512, 512)
+    bq, bk = _fit_vmem(512, 512, 2048, 2048, 128, 16)
+    assert (bq, bk) == (128, 128)                 # shrank to the floor
+    assert _vmem_bytes(bq, bk, 128, 16) < \
+        _vmem_bytes(512, 512, 128, 16) / 4        # far off the 50MB ask
     assert flash_eligible(2048, 2048)
     assert flash_eligible(768, 768)           # 256-tile: MXU-scale
     assert flash_eligible(16, 16)             # whole-sequence tile

@@ -45,40 +45,23 @@ def bench_one(jax, jnp, S, B, H, D, causal, n_iter=100):
         return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, v)
                        .astype(jnp.float32))
 
-    # The chip sits behind an async remote-dispatch runtime: independent
-    # step() calls pipeline/reorder, so a host-side timing loop measures
-    # dispatch, not compute (block_until_ready on the last of N
-    # independent calls does not wait for the other N-1).  Run the loop
-    # ON DEVICE instead — each iteration's inputs depend on the previous
-    # grads, the trip count is traced (one compile, no unrolling) — and
-    # take the slope between two trip counts so the constant per-call
-    # tunnel overhead cancels.
+    # The chip sits behind an async remote-dispatch runtime where a
+    # host-side timing loop measures dispatch, not compute: the loop
+    # must run ON DEVICE with each iteration's inputs depending on the
+    # previous grads.  _device_loop_s (parallel/collectives.py) is the
+    # shared fori-loop + two-trip-count-slope harness.
+    from mxnet_tpu.parallel.collectives import _device_loop_s
+
     def timed_loop(grad_fn):
         eps = jnp.asarray(1e-6, dt)
 
-        def body(carry, _):
+        def step(carry):
             qc, kc, vc = carry
             dq, dk, dv = grad_fn(qc, kc, vc)
             return (q + dq.astype(dt) * eps, k + dk.astype(dt) * eps,
-                    v + dv.astype(dt) * eps), ()
+                    v + dv.astype(dt) * eps)
 
-        # fori_loop with a traced bound lowers to a while loop — ONE
-        # executable serves any n, so the two trip counts below share a
-        # compile and differ only in device-side work
-        @jax.jit
-        def run_n(n):
-            return jax.lax.fori_loop(
-                0, n, lambda i, c: body(c, None)[0], (q, k, v))
-
-        jax.block_until_ready(run_n(1))  # compile + first dispatch
-        n_lo, n_hi = 2, 2 + n_iter
-        tic = time.perf_counter()
-        jax.block_until_ready(run_n(n_lo))
-        t_lo = time.perf_counter() - tic
-        tic = time.perf_counter()
-        jax.block_until_ready(run_n(n_hi))
-        t_hi = time.perf_counter() - tic
-        return (t_hi - t_lo) / (n_hi - n_lo)
+        return _device_loop_s(step, (q, k, v), n_iter)
 
     results = {}
     for name, fn in (("flash", loss_flash), ("dense", loss_dense)):

@@ -28,9 +28,15 @@ def bench_forward(exe, data, n_warmup, n_iter):
         outs = exe.forward(is_train=False)
     jax.block_until_ready([o._data for o in outs])
     tic = time.perf_counter()
+    # keep EVERY call's outputs and block on all of them: the remote
+    # runtime executes independent dispatches out of order, so blocking
+    # only on the last call's buffers would not wait for the other
+    # n_iter - 1 (pipelined throughput is the honest serving number,
+    # but only once every inference actually finished)
+    all_outs = []
     for _ in range(n_iter):
-        outs = exe.forward(is_train=False)
-    jax.block_until_ready([o._data for o in outs])
+        all_outs.append([o._data for o in exe.forward(is_train=False)])
+    jax.block_until_ready(all_outs)
     return time.perf_counter() - tic
 
 
