@@ -116,6 +116,11 @@ def main():
                 "LIBTPU_INIT_ARGS":
                     "--xla_tpu_enable_latency_hiding_scheduler=true"},
                False)
+        # whole timed loop on device (fori_loop over the train step):
+        # removes any per-dispatch queue gap the tunnel adds — if this
+        # beats the default mode, the gap was dispatch, not compute
+        yield ({"BENCH_LAYOUT": "NHWC", "BENCH_STEM": "s2d",
+                "BENCH_BATCH": "128", "BENCH_DEVICE_LOOP": "1"}, False)
 
     full_grid = [pt for pt, _ in grid_points()]
     todo = [pt for pt, quick in grid_points() if quick or not args.quick]
