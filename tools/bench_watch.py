@@ -179,6 +179,20 @@ def run_flash_bench(timeout=1800):
         "FLASH_BENCH.json", timeout, validate=validate)
 
 
+def run_rnn_bench(timeout=1800):
+    """Fused Pallas LSTM/GRU vs lax.scan (tools/rnn_bench.py) — the
+    cuDNN-RNN-analog kernel-quality artifact."""
+
+    def validate(payload):
+        good = [p for p in payload.get("points", [])
+                if p.get("fused_ms") and "fused_error" not in p]
+        return None if good else "no successful fused point"
+
+    return run_json_artifact(
+        "rnn", [os.path.join(REPO, "tools", "rnn_bench.py")],
+        "RNN_BENCH.json", timeout, validate=validate)
+
+
 def run_quant_bench(timeout=1800):
     """Float vs int8 ResNet-50 inference (tools/quant_bench.py) — the
     quantization-subsystem measurement."""
@@ -224,8 +238,8 @@ def main():
     deadline = time.time() + 3600 * float(
         os.environ.get("BENCH_WATCH_HOURS", "9"))
     done = {"resnet": False, "gpt": False, "cifar": False,
-            "bandwidth": False, "flash": False, "quant": False,
-            "consistency": False, "sweep": False}
+            "bandwidth": False, "flash": False, "rnn": False,
+            "quant": False, "consistency": False, "sweep": False}
     fails = {k: 0 for k in done}
     MAX_FAILS = 6  # give up on a stage that fails repeatedly WITH the
     #               probe passing (a code bug, not a tunnel flake)
@@ -287,6 +301,10 @@ def main():
         if not done["flash"]:
             done["flash"] = attempt(
                 "flash", lambda: run_flash_bench(timeout=min(1800, left)))
+            continue
+        if not done["rnn"]:
+            done["rnn"] = attempt(
+                "rnn", lambda: run_rnn_bench(timeout=min(1800, left)))
             continue
         if not done["quant"]:
             done["quant"] = attempt(
