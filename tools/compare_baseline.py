@@ -78,6 +78,15 @@ def rows_from(repo):
                          f"up to {max(sp):.2f}x",
                          f"{len(sp)} shapes"))
 
+    rnn = _load(os.path.join(repo, "RNN_BENCH.json"))
+    if rnn and rnn.get("platform") == "tpu":
+        sp = [p.get("speedup") for p in rnn.get("points", [])
+              if p.get("speedup") and p.get("eligible")]
+        if sp:
+            rows.append(("fused RNN (vs lax.scan cell)", "—",
+                         f"up to {max(sp):.2f}x",
+                         f"{len(sp)} shapes"))
+
     io_rec = _load(os.path.join(repo, "IO_BENCH.json"))
     if io_rec:
         rows.append(("image pipeline (vs ref 250 img/s/core)",
