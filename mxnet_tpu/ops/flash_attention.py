@@ -179,12 +179,17 @@ def _heads(H):
 
 
 def _load(ref, h):
-    """(blk, D) float32 tile: 3D block (1, blk, D), or head ``h`` of a
-    4D (1, blk, H, D) block (static sublane index — VMEM-local)."""
+    """(blk, D) tile in the INPUT dtype: 3D block (1, blk, D), or head
+    ``h`` of a 4D (1, blk, H, D) block (static sublane index).
+
+    No f32 upcast here: the MXU's fast path is bf16 x bf16 with float32
+    accumulation (``preferred_element_type`` on every dot) — upcasting
+    the operands would run the matmuls at the ~4x slower f32 MXU rate
+    while gaining nothing the f32 accumulator doesn't already give."""
     x = ref[0]
     if h is not None:
         x = x[:, h, :]
-    return x.astype(jnp.float32)
+    return x
 
 
 def _store(ref, h, val):
@@ -251,8 +256,11 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             alpha = jnp.exp(m_prev - m_cur)
             l_cur = _sget(l_sc, h)[:, 0] * alpha + jnp.sum(p, axis=-1)
             v = _load(v_ref, h)
+            # p cast DOWN to v's dtype so a bf16 input keeps the PV
+            # matmul on the fast MXU path (f32 @ bf16 would promote v
+            # and run the slow f32 pass); accumulation stays f32
             _sset(acc, h, _sget(acc, h) * alpha[:, None] + jnp.dot(
-                p, v, preferred_element_type=jnp.float32))
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32))
             _sset(m_sc, h, m_cur[:, None])
             _sset(l_sc, h, l_cur[:, None])
 
@@ -407,8 +415,10 @@ def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                                      preferred_element_type=jnp.float32)
             # ds from the o path (p*(dp - delta)) and the lse output (p*dlse)
             ds = p * (dp - delta[:, None] + dlse[:, None]) * scale
+            # ds cast down to the input dtype for the same MXU-path
+            # reason as p in the forward (standard flash bwd recipe)
             _sset(dq_acc, h, _sget(dq_acc, h) + jnp.dot(
-                ds, k, preferred_element_type=jnp.float32))
+                ds.astype(k.dtype), k, preferred_element_type=jnp.float32))
 
     @pl.when(j == nk - 1)
     def _():
@@ -448,13 +458,13 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             if mask is not None:
                 p = jnp.where(mask, p, _ZERO)  # fully-masked: lse=_NEG_INF
             _sset(dv_acc, h, _sget(dv_acc, h) + jax.lax.dot_general(
-                p, do, (((0,), (0,)), ((), ())),
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32))
             dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32)
             ds = p * (dp - delta[:, None] + dlse[:, None]) * scale
             _sset(dk_acc, h, _sget(dk_acc, h) + jax.lax.dot_general(
-                ds, q, (((0,), (0,)), ((), ())),
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32))
 
     @pl.when(i == nq - 1)
@@ -470,10 +480,13 @@ def _bwd(scale, causal, bq, bk, interpret, res, g):
     BH, Sq, Sk, D, H = _dims(q, k)
     nq, nk = Sq // bq, Sk // bk
 
-    do = do.astype(jnp.float32)
+    # do stays in the kernels' input dtype (bf16 on TPU): the dot with v
+    # runs the fast MXU pass with f32 accumulation; only the rowwise
+    # delta reduction upcasts (outside the kernels, O(S) not O(S^2))
+    do = do.astype(q.dtype)
     dlse = (jnp.zeros_like(lse) if dlse_in is None
             else dlse_in.astype(jnp.float32))
-    delta = jnp.sum(do * o.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     if H is not None:
         # (B, Sq, H) -> (B, H, Sq): the kernels' row layout; tiny (no D)
         delta = jnp.moveaxis(delta, 1, 2)

@@ -609,3 +609,34 @@ def test_block_size_autofit():
     lo = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
     np.testing.assert_allclose(np.asarray(hi), np.asarray(lo),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_flash_attention_bf16_path():
+    """bf16 inputs keep the matmuls in the input dtype (MXU fast path;
+    f32 accumulation via preferred_element_type) — numerics must stay
+    within bf16 tolerance of the f32 dense reference, fwd and bwd."""
+    from mxnet_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.RandomState(7)
+    B, H, S, D = 2, 2, 64, 32
+    qf, kf, vf = (jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+                  for _ in range(3))
+    qb, kb, vb = (t.astype(jnp.bfloat16) for t in (qf, kf, vf))
+
+    ref = attention_reference(qf, kf, vf, causal=True)
+    out = flash_attention(qb, kb, vb, causal=True).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-2, rtol=3e-2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    gb = jax.grad(loss_flash, argnums=(0, 1, 2))(qb, kb, vb)
+    gf = jax.grad(loss_ref, argnums=(0, 1, 2))(qf, kf, vf)
+    for a, b in zip(gb, gf):
+        np.testing.assert_allclose(np.asarray(a.astype(jnp.float32)),
+                                   np.asarray(b), atol=2e-1, rtol=5e-2)
