@@ -51,8 +51,13 @@ def _fwd_kernel(gx_ref, h0_ref, wh_ref, bh_ref, *refs, T, H, save):
     def _():
         h_sc[:] = h0_ref[:].astype(jnp.float32)
 
-    wh = wh_ref[:].astype(jnp.float32)               # (3H, H)
-    hp = (jax.lax.dot_general(h_sc[:], wh, (((1,), (1,)), ((), ())),
+    # recurrent matmul in the ACTIVATION dtype (bf16 MXU fast path),
+    # keyed off gx like the flash kernels; carried state stays f32 in
+    # scratch, accumulation f32 via preferred_element_type
+    dt_lo = gx_ref.dtype
+    hp = (jax.lax.dot_general(h_sc[:].astype(dt_lo),
+                              wh_ref[:].astype(dt_lo),
+                              (((1,), (1,)), ((), ())),
                               preferred_element_type=jnp.float32)
           + bh_ref[0].astype(jnp.float32))           # (N, 3H)
     gx = gx_ref[0].astype(jnp.float32)
@@ -136,12 +141,14 @@ def _bwd_kernel(acts_ref, hprev_ref, h0_ref, wh_ref, dys_ref, dhT_ref,
     dhp = jnp.concatenate([dr_pre, dz_pre, dnh], axis=-1)        # d hp
 
     dgx_ref[0] = dgates.astype(dgx_ref.dtype)
-    dwh_sc[:] += jax.lax.dot_general(dhp, h_prev,
+    # matmul operands in the activation dtype (MXU fast path, f32 acc)
+    dt_lo = dgx_ref.dtype
+    dhp_lo = dhp.astype(dt_lo)
+    dwh_sc[:] += jax.lax.dot_general(dhp_lo, h_prev.astype(dt_lo),
                                      (((0,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
     dbh_sc[0, :] += jnp.sum(dhp, axis=0)
-    wh = wh_ref[:].astype(jnp.float32)
-    dh_sc[:] = dh * z + jnp.dot(dhp, wh,
+    dh_sc[:] = dh * z + jnp.dot(dhp_lo, wh_ref[:].astype(dt_lo),
                                 preferred_element_type=jnp.float32)
 
     @pl.when(rt == T - 1)

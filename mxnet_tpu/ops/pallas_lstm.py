@@ -67,9 +67,17 @@ def _fwd_kernel(gx_ref, h0_ref, c0_ref, wh_ref, bh_ref,
         h_sc[:] = h0_ref[:].astype(jnp.float32)
         c_sc[:] = c0_ref[:].astype(jnp.float32)
 
-    wh = wh_ref[:].astype(jnp.float32)              # (4H, H), VMEM-resident
+    # recurrent matmul in the ACTIVATION dtype (bf16 MXU fast path; f32
+    # runs the ~4x slower pass) — keyed off gx like the flash kernels,
+    # so f32 master weights with bf16 activations still engage it.  The
+    # carried state itself stays f32 in scratch for stability across T
+    # steps; only matmul operands are cast, accumulation is f32 via
+    # preferred_element_type.
+    dt_lo = gx_ref.dtype
     gates = (gx_ref[0].astype(jnp.float32)
-             + jax.lax.dot_general(h_sc[:], wh, (((1,), (1,)), ((), ())),
+             + jax.lax.dot_general(h_sc[:].astype(dt_lo),
+                                   wh_ref[:].astype(dt_lo),
+                                   (((1,), (1,)), ((), ())),
                                    preferred_element_type=jnp.float32)
              + bh_ref[0].astype(jnp.float32))
     i = _sigmoid(gates[:, 0 * H:1 * H])
@@ -175,13 +183,16 @@ def _bwd_kernel(acts_ref, cells_ref, cprev_ref, hprev_ref, h0_ref, c0_ref,
          dg * (1.0 - g * g), do * o * (1.0 - o)], axis=-1)   # (N, 4H)
 
     dgx_ref[0] = dgates.astype(dgx_ref.dtype)
+    # matmul operands in the activation dtype (MXU fast path, f32 acc)
+    dt_lo = dgx_ref.dtype
+    dg_lo = dgates.astype(dt_lo)
     # dWh += dgates^T @ h_prev : contract over batch
-    dwh_sc[:] += jax.lax.dot_general(dgates, h_prev,
+    dwh_sc[:] += jax.lax.dot_general(dg_lo, h_prev.astype(dt_lo),
                                      (((0,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
     dbh_sc[0, :] += jnp.sum(dgates, axis=0)
-    wh = wh_ref[:].astype(jnp.float32)
-    dh_sc[:] = jnp.dot(dgates, wh, preferred_element_type=jnp.float32)
+    dh_sc[:] = jnp.dot(dg_lo, wh_ref[:].astype(dt_lo),
+                       preferred_element_type=jnp.float32)
     dc_sc[:] = dc * f
 
     @pl.when(rt == T - 1)

@@ -63,6 +63,40 @@ def test_backward_matches_scan():
                                    rtol=2e-4, atol=2e-5, err_msg=name)
 
 
+def test_bf16_fwd_and_gradients():
+    """bf16 fwd + bwd vs the f32 scan reference, incl. the f32
+    master-weights / bf16-activations regime (matmul operands run in
+    the ACTIVATION dtype — the MXU fast path must still engage)."""
+    gx, h0, wh, bh = _rand(T=4, N=2, H=8, seed=6)
+    bf = jnp.bfloat16
+
+    ys, _ = fused_gru(gx.astype(bf), h0.astype(bf), wh.astype(bf),
+                      bh.astype(bf), interpret=True)
+    assert ys.dtype == bf
+    rys, _ = _scan_gru(*[jnp.asarray(a, jnp.float32)
+                         for a in (gx, h0, wh, bh)])
+    np.testing.assert_allclose(np.asarray(ys, np.float32), np.asarray(rys),
+                               rtol=5e-2, atol=5e-2)
+
+    def loss_fused(gx_, wh_):
+        ys, _ = fused_gru(gx_, h0.astype(gx_.dtype), wh_, bh.astype(bf),
+                          interpret=True)
+        return jnp.sum(ys.astype(jnp.float32) ** 2)
+
+    def loss_ref(gx_, wh_):
+        ys, _ = _scan_gru(gx_, h0, wh_, bh)
+        return jnp.sum(ys ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1))(
+        jnp.asarray(gx, jnp.float32), jnp.asarray(wh, jnp.float32))
+    for wdtype in (bf, jnp.float32):
+        g = jax.grad(loss_fused, argnums=(0, 1))(
+            gx.astype(bf), wh.astype(wdtype))
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b), rtol=8e-2, atol=8e-2)
+
+
 def test_rnn_op_gru_fused_matches_scan(monkeypatch):
     import mxnet_tpu as mx
 

@@ -102,6 +102,34 @@ def test_bf16_inputs():
                                np.asarray(rys), rtol=5e-2, atol=5e-2)
 
 
+def test_bf16_gradients():
+    """bf16 fwd AND bwd: matmul operands run in the activation dtype
+    (MXU fast path); gradients must stay within bf16 tolerance of the
+    f32 scan reference, incl. f32 master weights with bf16 activations
+    (the mixed regime that must still engage the cast)."""
+    gx, h0, c0, wh, bh = _rand(T=4, N=2, H=8, seed=5)
+    bf = jnp.bfloat16
+
+    def loss_fused(gx_, wh_):
+        ys, hT, cT = fused_lstm(gx_, h0.astype(gx_.dtype),
+                                c0.astype(gx_.dtype), wh_, bh.astype(bf),
+                                interpret=True)
+        return jnp.sum(ys.astype(jnp.float32) ** 2)
+
+    def loss_ref(gx_, wh_):
+        ys, _, _ = _scan_lstm(gx_, h0, c0, wh_, bh)
+        return jnp.sum(ys ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1))(
+        jnp.asarray(gx, jnp.float32), jnp.asarray(wh, jnp.float32))
+    for wdtype in (bf, jnp.float32):     # bf16 and master-f32 weights
+        g = jax.grad(loss_fused, argnums=(0, 1))(
+            gx.astype(bf), wh.astype(wdtype))
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b), rtol=8e-2, atol=8e-2)
+
+
 def test_rnn_op_uses_fused_when_forced(monkeypatch):
     """MXNET_TPU_FUSED_RNN=1 routes the RNN symbol op through the
     kernel (interpret off-TPU) with unchanged results."""
