@@ -92,7 +92,10 @@ def bench_one(jax, jnp, S, B, H, D, causal, n_iter=100):
 
 def main():
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--seqs", default="1024,2048,4096")
+    # S=8192 is the dense-OOM point on a 16GB v5e: the (S x S) f32 score
+    # tensor alone is 64 x 8192^2 x 4 = 17GB, while flash streams it
+    # through VMEM — the kernel's raison d'etre, recorded as data
+    p.add_argument("--seqs", default="1024,2048,4096,8192")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=64)
