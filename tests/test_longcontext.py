@@ -640,3 +640,33 @@ def test_flash_attention_bf16_path():
     for a, b in zip(gb, gf):
         np.testing.assert_allclose(np.asarray(a.astype(jnp.float32)),
                                    np.asarray(b), atol=2e-1, rtol=5e-2)
+
+
+def test_flash_attention_cross_lengths():
+    """Sq != Sk (decoder cross-attention shapes): the kernel grids and
+    causal offsets are defined over separate q/k lengths — pin it."""
+    from mxnet_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.RandomState(9)
+    B, H, D = 2, 2, 16
+    Sq, Sk = 32, 64
+    q = jnp.asarray(rng.randn(B, H, Sq, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, H, Sk, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, Sk, D), jnp.float32)
+
+    out = flash_attention(q, k, v, block_q=16, block_k=16)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-4)
+
+    g = jax.grad(lambda a, b, c: jnp.sum(
+        flash_attention(a, b, c, block_q=16, block_k=16) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: jnp.sum(jnp.einsum(
+        "bhqk,bhkd->bhqd",
+        jax.nn.softmax(jnp.einsum("bhqd,bhkd->bhqk", a, b) / np.sqrt(D),
+                       axis=-1), c) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-4)
