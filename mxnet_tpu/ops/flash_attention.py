@@ -102,20 +102,22 @@ def flash_eligible(Sq, Sk, block_q=512, block_k=512):
 _VMEM_BUDGET = 12 * 1024 * 1024
 
 
-def _vmem_bytes(bq, bk, D, H):
+def _vmem_bytes(bq, bk, D, H, itemsize=4):
     """Conservative per-grid-step VMEM footprint of the kernels: Q-class
     tiles (q, do) + K-class tiles (k, v, + pipelining slack), all
-    double-buffered float32, plus accumulator scratch and the score
-    tile.  An estimate, not Mosaic's allocator — it only needs to stop
-    the block autofit from requesting tiles that cannot possibly fit."""
+    double-buffered in the INPUT dtype (``itemsize`` — the kernels keep
+    matmul operands native, so bf16 tiles are half the size), plus f32
+    accumulator scratch and the f32 score tile.  An estimate, not
+    Mosaic's allocator — it only needs to stop the block autofit from
+    requesting tiles that cannot possibly fit."""
     Hf = 1 if H is None else H
-    tile = lambda blk: 2 * blk * Hf * D * 4          # double-buffered f32
+    tile = lambda blk: 2 * blk * Hf * D * itemsize   # double-buffered
     return (2 * tile(bq) + 3 * tile(bk)
             + 2 * Hf * max(bq, bk) * D * 4           # acc/dk/dv scratch
             + bq * bk * 4)                           # score tile
 
 
-def _fit_vmem(bq, bk, Sq, Sk, D, H):
+def _fit_vmem(bq, bk, Sq, Sk, D, H, itemsize=4):
     """Halve the larger block (never below 128 or the whole-sequence
     tile) until the estimated footprint fits the VMEM budget.  The 512
     default was benchmarked on bhsd D=64 where it fits easily; bshd
@@ -123,7 +125,7 @@ def _fit_vmem(bq, bk, Sq, Sk, D, H):
     dies with an opaque allocation failure mid-train."""
     def shrinkable(b, S):
         return b > 128 and b == _fit_block(S, b)     # stays a divisor
-    while _vmem_bytes(bq, bk, D, H) > _VMEM_BUDGET:
+    while _vmem_bytes(bq, bk, D, H, itemsize) > _VMEM_BUDGET:
         if bk >= bq and shrinkable(bk, Sk):
             bk //= 2
         elif shrinkable(bq, Sq):
@@ -601,7 +603,9 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
     if interpret is None:
         interpret = not _on_tpu()
     bq, bk = _block_sizes(Sq, Sk, block_q, block_k)
-    bq, bk = _fit_vmem(bq, bk, Sq, Sk, D, H if layout == "bshd" else None)
+    bq, bk = _fit_vmem(bq, bk, Sq, Sk, D,
+                       H if layout == "bshd" else None,
+                       itemsize=jnp.dtype(q.dtype).itemsize)
 
     if layout == "bshd":
         qf, kf, vf = q, k, v              # native 4D, no data movement
