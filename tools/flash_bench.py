@@ -21,7 +21,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def bench_one(jax, jnp, S, B, H, D, causal, n_iter=100):
+def bench_one(jax, jnp, S, B, H, D, causal, n_iter=100,
+              block_q=None, block_k=None):
     import numpy as np
 
     from mxnet_tpu.ops.flash_attention import flash_attention
@@ -30,9 +31,12 @@ def bench_one(jax, jnp, S, B, H, D, causal, n_iter=100):
     shape = (B, H, S, D)
     dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
     q, k, v = (jnp.asarray(rng.randn(*shape), dt) for _ in range(3))
+    blk = {}
+    if block_q:
+        blk = {"block_q": block_q, "block_k": block_k}
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=causal)
+        return jnp.sum(flash_attention(q, k, v, causal=causal, **blk)
                        .astype(jnp.float32))
 
     def loss_dense(q, k, v):
@@ -75,7 +79,7 @@ def bench_one(jax, jnp, S, B, H, D, causal, n_iter=100):
     # causal halves the live tiles
     flops = 4.0 * B * H * S * S * D * 3.5 * (0.5 if causal else 1.0)
     rec = {"seq_len": S, "batch": B, "heads": H, "head_dim": D,
-           "causal": causal,
+           "causal": causal, **blk,
            "flash_ms": None if results["flash"] is None
            else round(results["flash"] * 1e3, 3),
            "dense_ms": None if results["dense"] is None
@@ -102,6 +106,10 @@ def main():
     p.add_argument("--json", default=None,
                    help="append results as one JSON line to this file")
     p.add_argument("--platform", default=None)
+    p.add_argument("--tune", action="store_true",
+                   help="sweep block-size pairs at the first --seqs shape "
+                        "(causal) and report the fastest — repeatable form "
+                        "of the on-chip tuning that picked the 512 default")
     args = p.parse_args()
 
     import jax
@@ -109,6 +117,28 @@ def main():
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     import jax.numpy as jnp
+
+    if args.tune:
+        S = int(args.seqs.split(",")[0])
+        grid = [(128, 128), (256, 256), (256, 512), (512, 256),
+                (512, 512), (512, 1024), (1024, 512)]
+        best = None
+        for bq, bk in grid:
+            if bq > S or bk > S:
+                continue
+            rec = bench_one(jax, jnp, S, args.batch, args.heads,
+                            args.head_dim, True, block_q=bq, block_k=bk)
+            print(json.dumps(rec))
+            if rec.get("flash_ms") and (best is None
+                                        or rec["flash_ms"] < best["flash_ms"]):
+                best = rec
+        out = {"platform": jax.default_backend(), "tune": True,
+               "best": best}
+        print(json.dumps(out))
+        if args.json:
+            with open(args.json, "a") as f:
+                f.write(json.dumps(out) + "\n")
+        return
 
     points = []
     for S in (int(x) for x in args.seqs.split(",")):
