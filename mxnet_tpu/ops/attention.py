@@ -98,6 +98,9 @@ register_simple_op(
 # -- fused multi-head attention ----------------------------------------------
 class FlashAttentionParam(Params):
     causal = field(bool, default=False)
+    # sliding-window (local) attention radius; 0 = full attention
+    # (negative values rejected at the kernel entry)
+    window = field(int, default=0)
     block_q = field(int, default=512)
     block_k = field(int, default=512)
     impl = field(str, default="auto", enum=("auto", "flash", "xla"))
@@ -145,6 +148,11 @@ class FlashAttentionOp(OpDef):
                 # sequence-parallel program: global attention over the
                 # sharded sequence REQUIRES a sharded schedule — local
                 # per-shard attention would be silently wrong
+                if params.window:
+                    raise NotImplementedError(
+                        "FlashAttention(window=...) under sequence "
+                        "parallelism is not implemented — drop the sp "
+                        "axis or use full attention")
                 if params.sp_impl == "ulysses":
                     from ..parallel.ulysses import ulysses_attention \
                         as sp_attention
@@ -187,7 +195,8 @@ class FlashAttentionOp(OpDef):
                                            causal=params.causal,
                                            block_q=params.block_q,
                                            block_k=params.block_k,
-                                           layout=params.layout)
+                                           layout=params.layout,
+                                           window=params.window)
 
                 out = shard_map(_local, mesh=mesh,
                                 in_specs=(spec, spec, spec),
@@ -196,16 +205,30 @@ class FlashAttentionOp(OpDef):
             out = flash_attention(q, k, v, causal=params.causal,
                                   block_q=params.block_q,
                                   block_k=params.block_k,
-                                  layout=params.layout)
+                                  layout=params.layout,
+                                  window=params.window)
             return [out], []
         scale = 1.0 / np.sqrt(q.shape[-1])
         if params.layout == "bshd":
             s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
         else:
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        pos_q = jnp.arange(S)[:, None]
+        pos_k = jnp.arange(S)[None, :]
+        keep = None
         if params.causal:
-            mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
-            s = jnp.where(mask, s, jnp.asarray(-jnp.inf, s.dtype))
+            keep = pos_q >= pos_k
+        if params.window < 0:
+            raise ValueError(
+                f"FlashAttention: window must be >= 0 "
+                f"(got {params.window})")
+        if params.window:
+            band = pos_q - pos_k < params.window
+            if not params.causal:
+                band = jnp.logical_and(band, pos_k - pos_q < params.window)
+            keep = band if keep is None else jnp.logical_and(keep, band)
+        if keep is not None:
+            s = jnp.where(keep, s, jnp.asarray(-jnp.inf, s.dtype))
         p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
         if params.layout == "bshd":
             return [jnp.einsum("bhqk,bkhd->bqhd", p, v)], []

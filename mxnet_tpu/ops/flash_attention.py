@@ -137,25 +137,50 @@ def _fit_vmem(bq, bk, Sq, Sk, D, H, itemsize=4):
     return bq, bk
 
 
-def _mask_for(i, j, bq, bk, causal, qo, ko):
+def _mask_for(i, j, bq, bk, causal, qo, ko, window=0):
     """Score mask for Q tile i vs K tile j (True = keep); qo/ko are
-    global position offsets (ring-step shards), possibly traced."""
-    if not causal:
+    global position offsets (ring-step shards), possibly traced.
+    ``window`` > 0 adds sliding-window locality: query q attends keys in
+    (q - window, q] — Mistral-class local attention.  Tiles fully
+    outside the band skip their COMPUTE (the FLOPs drop to
+    O(S * window)); the grid still visits and fetches every K/V tile,
+    so HBM traffic remains O(S^2 / bk) block fetches."""
+    if not causal and not window:
         return None
     q_pos = qo + i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = ko + j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return q_pos >= k_pos
+    if causal:
+        keep = q_pos >= k_pos
+        if window:
+            keep = jnp.logical_and(keep, q_pos - k_pos < window)
+        return keep
+    # bidirectional window: exactly the symmetric band |q - k| < window
+    return jnp.logical_and(q_pos - k_pos < window, k_pos - q_pos < window)
 
 
-def _tile_live(i, j, bq, bk, causal, qo, ko):
-    """Decorator: runs the tile body only when the (i, j) tile is NOT
-    entirely above the causal diagonal (max q_pos < min k_pos) — a
-    fully-masked tile's matmuls contribute nothing, and skipping them
-    halves causal-attention FLOPs (the flash-attention block-skip).
-    Non-causal bodies run unconditionally."""
-    if not causal:
+def _tile_live(i, j, bq, bk, causal, qo, ko, window=0):
+    """Decorator: runs the tile body only when the (i, j) tile overlaps
+    the live mask region — above-diagonal tiles (causal) and tiles
+    entirely outside the sliding-window band contribute nothing, and
+    skipping them is where the causal-FLOPs halving and the window's
+    O(S * window) bound come from.  Unmasked bodies run
+    unconditionally."""
+    if not causal and not window:
         return lambda body: body()
-    return pl.when(qo + i * bq + (bq - 1) >= ko + j * bk)
+    q_lo = qo + i * bq                 # first/last q position of the tile
+    q_hi = q_lo + (bq - 1)
+    k_lo = ko + j * bk
+    k_hi = k_lo + (bk - 1)
+    live = True
+    if causal:
+        live = jnp.logical_and(live, q_hi >= k_lo)
+    if window:
+        # any (q, k) in the tile with q - k < window (causal band) or
+        # |q - k| < window (bidirectional)
+        live = jnp.logical_and(live, q_lo - k_hi < window)
+        if not causal:
+            live = jnp.logical_and(live, k_lo - q_hi < window)
+    return pl.when(live)
 
 
 # -- forward ------------------------------------------------------------------
@@ -226,7 +251,8 @@ def _sset(ref, h, val):
 
 
 def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc, m_sc, l_sc, *, scale, causal, bq, bk, nk, H):
+                acc, m_sc, l_sc, *, scale, causal, bq, bk, nk, H,
+                window=0):
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -237,9 +263,10 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     i = pl.program_id(1)
 
-    @_tile_live(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
+    @_tile_live(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0], window)
     def _():
-        mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
+        mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0],
+                         window)
         for h in _heads(H):
             q = _load(q_ref, h)
             k = _load(k_ref, h)
@@ -342,11 +369,12 @@ def _params(interpret):
                              pltpu.ARBITRARY))}
 
 
-def _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret):
+def _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret, window=0):
     BH, Sq, Sk, D, H = _dims(q, k)
     nq, nk = Sq // bq, Sk // bk
     kernel = functools.partial(_fwd_kernel, scale=np.float32(scale),
-                               causal=causal, bq=bq, bk=bk, nk=nk, H=H)
+                               causal=causal, bq=bq, bk=bk, nk=nk, H=H,
+                               window=window)
     qi = lambda g: g[1]
     ki = lambda g: g[2]
     grid0 = BH if H is None else BH // H
@@ -385,7 +413,7 @@ def _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret):
 
 def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                    delta_ref, dlse_ref, dq_ref, dq_acc, *, scale, causal,
-                   bq, bk, nk, H):
+                   bq, bk, nk, H, window=0):
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -394,9 +422,10 @@ def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     i = pl.program_id(1)
 
-    @_tile_live(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
+    @_tile_live(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0], window)
     def _():
-        mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
+        mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0],
+                         window)
         for h in _heads(H):
             q = _load(q_ref, h)
             k = _load(k_ref, h)
@@ -430,7 +459,7 @@ def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    scale, causal, bq, bk, nq, H):
+                    scale, causal, bq, bk, nq, H, window=0):
     i = pl.program_id(2)  # q-block index (inner loop)
 
     @pl.when(i == 0)
@@ -440,9 +469,10 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     j = pl.program_id(1)  # k-block index (outer)
 
-    @_tile_live(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
+    @_tile_live(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0], window)
     def _():
-        mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0])
+        mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0],
+                         window)
         for h in _heads(H):
             q = _load(q_ref, h)
             k = _load(k_ref, h)
@@ -476,7 +506,7 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             _store(dv_ref, h, _sget(dv_acc, h).astype(dv_ref.dtype))
 
 
-def _bwd(scale, causal, bq, bk, interpret, res, g):
+def _bwd(scale, causal, bq, bk, interpret, window, res, g):
     q, k, v, qo, ko, o, lse = res
     do, dlse_in = g
     BH, Sq, Sk, D, H = _dims(q, k)
@@ -505,7 +535,8 @@ def _bwd(scale, causal, bq, bk, interpret, res, g):
     ki = lambda g: g[2]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=np.float32(scale),
-                          causal=causal, bq=bq, bk=bk, nk=nk, H=H),
+                          causal=causal, bq=bq, bk=bk, nk=nk, H=H,
+                          window=window),
         grid=(grid0, nq, nk),
         in_specs=[
             _scalar_spec(),
@@ -529,7 +560,8 @@ def _bwd(scale, causal, bq, bk, interpret, res, g):
     kj = lambda g: g[1]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=np.float32(scale),
-                          causal=causal, bq=bq, bk=bk, nq=nq, H=H),
+                          causal=causal, bq=bq, bk=bk, nq=nq, H=H,
+                          window=window),
         grid=(grid0, nk, nq),
         in_specs=[
             _scalar_spec(),
@@ -557,13 +589,13 @@ def _bwd(scale, causal, bq, bk, interpret, res, g):
     return dq, dk, dv, None, None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def _flash(q, k, v, qo, ko, scale, causal, bq, bk, interpret):
-    return _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, qo, ko, scale, causal, bq, bk, interpret, window):
+    return _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret, window)
 
 
-def _flash_fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret):
-    o, lse = _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret)
+def _flash_fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret, window):
+    o, lse = _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret, window)
     return (o, lse), (q, k, v, qo, ko, o, lse)
 
 
@@ -572,7 +604,7 @@ _flash.defvjp(_flash_fwd, _bwd)
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
                     block_k=512, q_offset=0, k_offset=0, return_lse=False,
-                    interpret=None, layout="bhsd"):
+                    interpret=None, layout="bhsd", window=0):
     """Fused multi-head attention: softmax(QK^T * scale) V.
 
     ``layout="bhsd"``: q (B, H, Sq, D), k/v (B, H, Sk, D) — the
@@ -585,13 +617,22 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
     (custom VJP) either way; output matches the input layout.
 
     ``block_q``/``block_k`` are upper bounds; they shrink (by
-    halving) to fit the sequence lengths.
+    halving) to fit the sequence lengths.  ``window`` > 0 enables
+    sliding-window (local) attention: each query sees keys within
+    ``window`` positions (causal: the trailing band (q-window, q];
+    bidirectional: |q-k| < window).  Tiles fully outside the band skip
+    their matmuls — attention FLOPs drop to O(S * window) — though the
+    grid still streams every K/V tile, so HBM traffic stays O(S^2/bk).
     ``q_offset``/``k_offset`` shift the causal-mask positions (may be
     traced values — used for ring-attention shards).  With
     ``return_lse`` the per-row log-sum-exp (B, H, Sq) float32 is also
     returned (differentiable).  Off-TPU the kernels run in the Pallas
     interpreter unless ``interpret`` is explicitly set.
     """
+    if window < 0:
+        raise ValueError(
+            f"flash_attention: window must be >= 0 (got {window}); a "
+            "negative band would mask every score")
     if layout == "bshd":
         B, Sq, H, D = q.shape
         Sk = k.shape[1]
@@ -616,7 +657,7 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
     qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
     ko = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
     o, lse = _flash(qf, kf, vf, qo, ko, scale, bool(causal), bq, bk,
-                    bool(interpret))
+                    bool(interpret), int(window))
     if layout != "bshd":
         o = o.reshape(B, H, Sq, D)
     if return_lse:

@@ -670,3 +670,66 @@ def test_flash_attention_cross_lengths():
     for a, b in zip(g, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-5, rtol=5e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_sliding_window(causal):
+    """window > 0 = Mistral-class local attention: causal keeps the
+    trailing (q-window, q] band, bidirectional keeps |q-k| < window.
+    Kernel (with tile skipping) vs a dense masked reference, fwd+bwd."""
+    from mxnet_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.RandomState(11)
+    B, H, S, D, W = 2, 2, 64, 16, 24
+    q, k, v = (jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+               for _ in range(3))
+
+    def dense_ref(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        pq, pk = jnp.arange(S)[:, None], jnp.arange(S)[None, :]
+        keep = pq - pk < W
+        if causal:
+            keep = jnp.logical_and(keep, pq >= pk)
+        else:
+            keep = jnp.logical_and(keep, pk - pq < W)
+        s = jnp.where(keep, s, -jnp.inf)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, causal=causal, window=-1)
+    out = flash_attention(q, k, v, causal=causal, window=W,
+                          block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense_ref(q, k, v)),
+                               atol=2e-5, rtol=2e-4)
+
+    g = jax.grad(lambda a, b, c: jnp.sum(flash_attention(
+        a, b, c, causal=causal, window=W, block_q=16, block_k=16) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: jnp.sum(dense_ref(a, b, c) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-4)
+
+
+def test_flash_attention_window_symbol_level():
+    """The FlashAttention symbol op exposes window= and the XLA dense
+    fallback applies the same band mask (parity flash vs dense impl)."""
+    data_shapes = {"q": (1, 2, 32, 8), "k": (1, 2, 32, 8),
+                   "v": (1, 2, 32, 8)}
+    rng = np.random.RandomState(12)
+    feed = {n: rng.randn(*s).astype(np.float32)
+            for n, s in data_shapes.items()}
+    outs = {}
+    for impl in ("flash", "xla"):
+        q = mx.sym.Variable("q")
+        k = mx.sym.Variable("k")
+        v = mx.sym.Variable("v")
+        net = mx.sym.FlashAttention(q, k, v, causal=True, window=8,
+                                    impl=impl, block_q=8, block_k=8)
+        exe = net.simple_bind(mx.cpu(0), **data_shapes)
+        for n, val in feed.items():
+            exe.arg_dict[n][:] = val
+        outs[impl] = np.asarray(exe.forward()[0].asnumpy())
+    np.testing.assert_allclose(outs["flash"], outs["xla"],
+                               atol=2e-5, rtol=2e-4)
