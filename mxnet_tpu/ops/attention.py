@@ -117,9 +117,14 @@ class FlashAttentionOp(OpDef):
     [layout='bhsd'] or (batch, seq, heads, head_dim) [layout='bshd',
     sequence-major — no activation transpose feeding the kernel].
 
-    On TPU with fitting block sizes this lowers to the fused Pallas
-    kernel (forward + custom-VJP backward); elsewhere it runs the XLA
-    dense formulation.  Differentiable either way.
+    K/V may carry FEWER heads than Q (grouped-query / multi-query
+    attention; q heads must be a multiple of kv heads): native in the
+    Pallas kernels under layout='bshd' (one shared K/V head streamed
+    per group), expanded under 'bhsd', the dense fallback, and the
+    sequence-parallel schedules.  ``window`` > 0 adds sliding-window
+    locality.  On TPU with fitting block sizes this lowers to the fused
+    Pallas kernel (forward + custom-VJP backward); elsewhere it runs
+    the XLA dense formulation.  Differentiable either way.
     """
 
     param_cls = FlashAttentionParam
@@ -128,10 +133,15 @@ class FlashAttentionOp(OpDef):
         return ["query", "key", "value"]
 
     def infer_shape(self, params, in_shapes):
-        q = in_shapes[0] or in_shapes[1] or in_shapes[2]
-        if q is None:
+        q = in_shapes[0]
+        kv = in_shapes[1] or in_shapes[2]
+        if q is None and kv is None:
             raise ValueError("FlashAttention: input shapes unknown")
-        return [tuple(q)] * 3, [tuple(q)], []
+        if q is None:
+            q = kv
+        if kv is None:
+            kv = q          # MHA default; GQA needs k/v shapes known
+        return [tuple(q), tuple(kv), tuple(kv)], [tuple(q)], []
 
     def forward(self, params, inputs, aux, train, key):
         q, k, v = inputs
@@ -153,6 +163,20 @@ class FlashAttentionOp(OpDef):
                         "FlashAttention(window=...) under sequence "
                         "parallelism is not implemented — drop the sp "
                         "axis or use full attention")
+                h_ax = 2 if params.layout == "bshd" else 1
+                if k.shape[h_ax] != q.shape[h_ax]:
+                    # grouped-query K/V under sequence parallelism:
+                    # expand to full heads before the sharded schedule
+                    # (ring streams whole K/V shards; ulysses must
+                    # all-to-all the head axis across sp shards)
+                    rep, rem = divmod(q.shape[h_ax], k.shape[h_ax])
+                    if rem or not k.shape[h_ax]:
+                        raise ValueError(
+                            f"FlashAttention: q heads ({q.shape[h_ax]}) "
+                            f"must be a multiple of kv heads "
+                            f"({k.shape[h_ax]})")
+                    k = jnp.repeat(k, rep, axis=h_ax)
+                    v = jnp.repeat(v, rep, axis=h_ax)
                 if params.sp_impl == "ulysses":
                     from ..parallel.ulysses import ulysses_attention \
                         as sp_attention
@@ -209,6 +233,16 @@ class FlashAttentionOp(OpDef):
                                   window=params.window)
             return [out], []
         scale = 1.0 / np.sqrt(q.shape[-1])
+        h_ax = 2 if params.layout == "bshd" else 1
+        if k.shape[h_ax] != q.shape[h_ax]:
+            # grouped-query attention through the dense path: expand K/V
+            rep, rem = divmod(q.shape[h_ax], k.shape[h_ax])
+            if rem or not k.shape[h_ax]:
+                raise ValueError(
+                    f"FlashAttention: q heads ({q.shape[h_ax]}) must be "
+                    f"a multiple of kv heads ({k.shape[h_ax]})")
+            k = jnp.repeat(k, rep, axis=h_ax)
+            v = jnp.repeat(v, rep, axis=h_ax)
         if params.layout == "bshd":
             s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
         else:

@@ -102,7 +102,7 @@ def flash_eligible(Sq, Sk, block_q=512, block_k=512):
 _VMEM_BUDGET = 12 * 1024 * 1024
 
 
-def _vmem_bytes(bq, bk, D, H, itemsize=4):
+def _vmem_bytes(bq, bk, D, H, itemsize=4, Hkv=None):
     """Conservative per-grid-step VMEM footprint of the kernels: Q-class
     tiles (q, do) + K-class tiles (k, v, + pipelining slack), all
     double-buffered in the INPUT dtype (``itemsize`` — the kernels keep
@@ -111,13 +111,14 @@ def _vmem_bytes(bq, bk, D, H, itemsize=4):
     Mosaic's allocator — it only needs to stop the block autofit from
     requesting tiles that cannot possibly fit."""
     Hf = 1 if H is None else H
-    tile = lambda blk: 2 * blk * Hf * D * itemsize   # double-buffered
-    return (2 * tile(bq) + 3 * tile(bk)
+    Hk = Hf if Hkv is None else Hkv                  # GQA: fewer kv heads
+    tile = lambda blk, h: 2 * blk * h * D * itemsize  # double-buffered
+    return (2 * tile(bq, Hf) + 3 * tile(bk, Hk)
             + 2 * Hf * max(bq, bk) * D * 4           # acc/dk/dv scratch
             + bq * bk * 4)                           # score tile
 
 
-def _fit_vmem(bq, bk, Sq, Sk, D, H, itemsize=4):
+def _fit_vmem(bq, bk, Sq, Sk, D, H, itemsize=4, Hkv=None):
     """Halve the larger block (never below 128 or the whole-sequence
     tile) until the estimated footprint fits the VMEM budget.  The 512
     default was benchmarked on bhsd D=64 where it fits easily; bshd
@@ -125,7 +126,7 @@ def _fit_vmem(bq, bk, Sq, Sk, D, H, itemsize=4):
     dies with an opaque allocation failure mid-train."""
     def shrinkable(b, S):
         return b > 128 and b == _fit_block(S, b)     # stays a divisor
-    while _vmem_bytes(bq, bk, D, H, itemsize) > _VMEM_BUDGET:
+    while _vmem_bytes(bq, bk, D, H, itemsize, Hkv) > _VMEM_BUDGET:
         if bk >= bq and shrinkable(bk, Sk):
             bk //= 2
         elif shrinkable(bq, Sq):
@@ -205,6 +206,12 @@ def _heads(H):
     return [None] if H is None else list(range(H))
 
 
+def _kv(h, group):
+    """KV head for q-head ``h``: grouped-query attention maps ``group``
+    consecutive q heads onto one shared K/V head (group == 1 = MHA)."""
+    return h if h is None or group == 1 else h // group
+
+
 def _load(ref, h):
     """(blk, D) tile in the INPUT dtype: 3D block (1, blk, D), or head
     ``h`` of a 4D (1, blk, H, D) block (static sublane index).
@@ -252,7 +259,7 @@ def _sset(ref, h, val):
 
 def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc, m_sc, l_sc, *, scale, causal, bq, bk, nk, H,
-                window=0):
+                window=0, group=1):
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -269,7 +276,7 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                          window)
         for h in _heads(H):
             q = _load(q_ref, h)
-            k = _load(k_ref, h)
+            k = _load(k_ref, _kv(h, group))
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) * scale
             if mask is not None:
@@ -284,7 +291,7 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 p = jnp.where(mask, p, _ZERO)
             alpha = jnp.exp(m_prev - m_cur)
             l_cur = _sget(l_sc, h)[:, 0] * alpha + jnp.sum(p, axis=-1)
-            v = _load(v_ref, h)
+            v = _load(v_ref, _kv(h, group))
             # p cast DOWN to v's dtype so a bf16 input keeps the PV
             # matmul on the fast MXU path (f32 @ bf16 would promote v
             # and run the slow f32 pass); accumulation stays f32
@@ -372,9 +379,12 @@ def _params(interpret):
 def _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret, window=0):
     BH, Sq, Sk, D, H = _dims(q, k)
     nq, nk = Sq // bq, Sk // bk
+    # grouped-query attention (bshd only): K/V may carry fewer heads
+    Hkv = None if H is None else k.shape[2]
+    group = 1 if H is None else H // Hkv
     kernel = functools.partial(_fwd_kernel, scale=np.float32(scale),
                                causal=causal, bq=bq, bk=bk, nk=nk, H=H,
-                               window=window)
+                               window=window, group=group)
     qi = lambda g: g[1]
     ki = lambda g: g[2]
     grid0 = BH if H is None else BH // H
@@ -387,8 +397,8 @@ def _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret, window=0):
             _scalar_spec(),
             _scalar_spec(),
             _seq_spec(bq, D, H, qi),
-            _seq_spec(bk, D, H, ki),
-            _seq_spec(bk, D, H, ki),
+            _seq_spec(bk, D, Hkv, ki),
+            _seq_spec(bk, D, Hkv, ki),
         ],
         out_specs=[
             _seq_spec(bq, D, H, qi),
@@ -413,7 +423,7 @@ def _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret, window=0):
 
 def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                    delta_ref, dlse_ref, dq_ref, dq_acc, *, scale, causal,
-                   bq, bk, nk, H, window=0):
+                   bq, bk, nk, H, window=0, group=1):
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -428,8 +438,8 @@ def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                          window)
         for h in _heads(H):
             q = _load(q_ref, h)
-            k = _load(k_ref, h)
-            v = _load(v_ref, h)
+            k = _load(k_ref, _kv(h, group))
+            v = _load(v_ref, _kv(h, group))
             do = _load(do_ref, h)
             lse = _row(lse_ref, h)
             delta = _row(delta_ref, h)
@@ -459,7 +469,7 @@ def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    scale, causal, bq, bk, nq, H, window=0):
+                    scale, causal, bq, bk, nq, H, window=0, group=1):
     i = pl.program_id(2)  # q-block index (inner loop)
 
     @pl.when(i == 0)
@@ -474,9 +484,10 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         mask = _mask_for(i, j, bq, bk, causal, qo_ref[0, 0], ko_ref[0, 0],
                          window)
         for h in _heads(H):
+            hk = _kv(h, group)
             q = _load(q_ref, h)
-            k = _load(k_ref, h)
-            v = _load(v_ref, h)
+            k = _load(k_ref, hk)
+            v = _load(v_ref, hk)
             do = _load(do_ref, h)
             lse = _row(lse_ref, h)
             delta = _row(delta_ref, h)
@@ -489,21 +500,24 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             p = jnp.exp(s - lse[:, None])
             if mask is not None:
                 p = jnp.where(mask, p, _ZERO)  # fully-masked: lse=_NEG_INF
-            _sset(dv_acc, h, _sget(dv_acc, h) + jax.lax.dot_general(
+            # grouped-query attention: every q head of the group adds
+            # into the SAME kv-head accumulator slab — the dK/dV sum
+            # over the group happens right here in VMEM
+            _sset(dv_acc, hk, _sget(dv_acc, hk) + jax.lax.dot_general(
                 p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32))
             dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32)
             ds = p * (dp - delta[:, None] + dlse[:, None]) * scale
-            _sset(dk_acc, h, _sget(dk_acc, h) + jax.lax.dot_general(
+            _sset(dk_acc, hk, _sget(dk_acc, hk) + jax.lax.dot_general(
                 ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32))
 
     @pl.when(i == nq - 1)
     def _():
-        for h in _heads(H):
-            _store(dk_ref, h, _sget(dk_acc, h).astype(dk_ref.dtype))
-            _store(dv_ref, h, _sget(dv_acc, h).astype(dv_ref.dtype))
+        for hk in _heads(H if H is None else H // group):
+            _store(dk_ref, hk, _sget(dk_acc, hk).astype(dk_ref.dtype))
+            _store(dv_ref, hk, _sget(dv_acc, hk).astype(dv_ref.dtype))
 
 
 def _bwd(scale, causal, bq, bk, interpret, window, res, g):
@@ -529,6 +543,8 @@ def _bwd(scale, causal, bq, bk, interpret, window, res, g):
     dlse = dlse.reshape(row_shape)
 
     grid0 = BH if H is None else BH // H
+    Hkv = None if H is None else k.shape[2]
+    group = 1 if H is None else H // Hkv
     sc = (lambda *dims: pltpu.VMEM(dims, jnp.float32)) if H is None else (
         lambda *dims: pltpu.VMEM((H,) + dims, jnp.float32))
     qi = lambda g: g[1]
@@ -536,14 +552,14 @@ def _bwd(scale, causal, bq, bk, interpret, window, res, g):
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=np.float32(scale),
                           causal=causal, bq=bq, bk=bk, nk=nk, H=H,
-                          window=window),
+                          window=window, group=group),
         grid=(grid0, nq, nk),
         in_specs=[
             _scalar_spec(),
             _scalar_spec(),
             _seq_spec(bq, D, H, qi),
-            _seq_spec(bk, D, H, ki),
-            _seq_spec(bk, D, H, ki),
+            _seq_spec(bk, D, Hkv, ki),
+            _seq_spec(bk, D, Hkv, ki),
             _seq_spec(bq, D, H, qi),
             _row_spec(bq, H, qi),
             _row_spec(bq, H, qi),
@@ -558,31 +574,34 @@ def _bwd(scale, causal, bq, bk, interpret, window, res, g):
 
     qj = lambda g: g[2]
     kj = lambda g: g[1]
+    sc_kv = sc if H is None else (
+        lambda *dims: pltpu.VMEM((Hkv,) + dims, jnp.float32))
+    BHkv = BH if H is None else (BH // H) * Hkv
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=np.float32(scale),
                           causal=causal, bq=bq, bk=bk, nq=nq, H=H,
-                          window=window),
+                          window=window, group=group),
         grid=(grid0, nk, nq),
         in_specs=[
             _scalar_spec(),
             _scalar_spec(),
             _seq_spec(bq, D, H, qj),
-            _seq_spec(bk, D, H, kj),
-            _seq_spec(bk, D, H, kj),
+            _seq_spec(bk, D, Hkv, kj),
+            _seq_spec(bk, D, Hkv, kj),
             _seq_spec(bq, D, H, qj),
             _row_spec(bq, H, qj),
             _row_spec(bq, H, qj),
             _row_spec(bq, H, qj),
         ],
         out_specs=[
-            _seq_spec(bk, D, H, kj),
-            _seq_spec(bk, D, H, kj),
+            _seq_spec(bk, D, Hkv, kj),
+            _seq_spec(bk, D, Hkv, kj),
         ],
         out_shape=[
-            _out_shape(BH, Sk, D, H, k.dtype),
-            _out_shape(BH, Sk, D, H, v.dtype),
+            _out_shape(BHkv, Sk, D, Hkv, k.dtype),
+            _out_shape(BHkv, Sk, D, Hkv, v.dtype),
         ],
-        scratch_shapes=[sc(bk, D), sc(bk, D)],
+        scratch_shapes=[sc_kv(bk, D), sc_kv(bk, D)],
         interpret=interpret,
         **_params(interpret),
     )(qo, ko, q, k, v, do, lse, delta, dlse)
@@ -635,10 +654,19 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
             "negative band would mask every score")
     if layout == "bshd":
         B, Sq, H, D = q.shape
-        Sk = k.shape[1]
+        Sk, Hkv = k.shape[1], k.shape[2]
     else:
         B, H, Sq, D = q.shape
-        Sk = k.shape[2]
+        Sk, Hkv = k.shape[2], k.shape[1]
+    if Hkv != H:
+        # grouped-query / multi-query attention: `group` consecutive q
+        # heads share one K/V head
+        if Hkv == 0 or H % Hkv:
+            raise ValueError(
+                f"flash_attention: q heads ({H}) must be a multiple of "
+                f"kv heads ({Hkv}) for grouped-query attention")
+        if v.shape != k.shape:
+            raise ValueError("flash_attention: k and v shapes must match")
     if scale is None:
         scale = float(1.0 / np.sqrt(D))
     if interpret is None:
@@ -646,11 +674,19 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
     bq, bk = _block_sizes(Sq, Sk, block_q, block_k)
     bq, bk = _fit_vmem(bq, bk, Sq, Sk, D,
                        H if layout == "bshd" else None,
-                       itemsize=jnp.dtype(q.dtype).itemsize)
+                       itemsize=jnp.dtype(q.dtype).itemsize,
+                       Hkv=Hkv if layout == "bshd" else None)
 
     if layout == "bshd":
         qf, kf, vf = q, k, v              # native 4D, no data movement
+        # (GQA handled natively: the kernels map q heads onto kv heads)
     else:
+        if Hkv != H:
+            # the flattened (BH, S, D) layout has no head axis for the
+            # kernel to group on — expand K/V instead (correct, but the
+            # traffic saving needs layout='bshd', where GQA is native)
+            k = jnp.repeat(k, H // Hkv, axis=1)
+            v = jnp.repeat(v, H // Hkv, axis=1)
         qf = q.reshape(B * H, Sq, D)
         kf = k.reshape(B * H, Sk, D)
         vf = v.reshape(B * H, Sk, D)

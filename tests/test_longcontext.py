@@ -733,3 +733,106 @@ def test_flash_attention_window_symbol_level():
         outs[impl] = np.asarray(exe.forward()[0].asnumpy())
     np.testing.assert_allclose(outs["flash"], outs["xla"],
                                atol=2e-5, rtol=2e-4)
+
+
+@pytest.mark.parametrize("layout", ["bshd", "bhsd"])
+def test_flash_attention_grouped_query(layout):
+    """GQA/MQA: Hkv < H with H % Hkv == 0.  bshd runs it natively in the
+    kernels (shared K/V head per group, dK/dV accumulated per kv head in
+    VMEM); bhsd expands K/V.  Both must match the dense repeat-based
+    reference, forward and gradients — incl. dK/dV summing over the
+    group."""
+    from mxnet_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.RandomState(13)
+    B, H, Hkv, S, D = 2, 4, 2, 32, 16
+    if layout == "bshd":
+        q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+        k = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.float32)
+        v = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.float32)
+        expand = lambda t: jnp.repeat(t, H // Hkv, axis=2)
+        to_bhsd = lambda t: t.transpose(0, 2, 1, 3)
+    else:
+        q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+        k = jnp.asarray(rng.randn(B, Hkv, S, D), jnp.float32)
+        v = jnp.asarray(rng.randn(B, Hkv, S, D), jnp.float32)
+        expand = lambda t: jnp.repeat(t, H // Hkv, axis=1)
+        to_bhsd = lambda t: t
+
+    def dense_ref(q, k, v):
+        qb, kb, vb = to_bhsd(q), to_bhsd(expand(k)), to_bhsd(expand(v))
+        s = jnp.einsum("bhqd,bhkd->bhqk", qb, kb) / np.sqrt(D)
+        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        s = jnp.where(mask, s, -jnp.inf)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vb)
+        return o if layout == "bhsd" else o.transpose(0, 2, 1, 3)
+
+    out = flash_attention(q, k, v, causal=True, layout=layout,
+                          block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(dense_ref(q, k, v)),
+                               atol=2e-5, rtol=2e-4)
+
+    g = jax.grad(lambda a, b, c: jnp.sum(flash_attention(
+        a, b, c, causal=True, layout=layout,
+        block_q=16, block_k=16) ** 2), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: jnp.sum(dense_ref(a, b, c) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip(("dq", "dk", "dv"), g, gr):
+        assert a.shape == b.shape, name
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-4, err_msg=name)
+
+    hax = 2 if layout == "bshd" else 1
+    k3 = jnp.take(expand(k), jnp.arange(3), axis=hax)
+    v3 = jnp.take(expand(v), jnp.arange(3), axis=hax)
+    with pytest.raises(ValueError, match="multiple"):
+        flash_attention(q, k3, v3, causal=True, layout=layout)
+
+
+def test_flash_attention_gqa_symbol_level():
+    """Symbol-level GQA: k/v with fewer heads flow through infer_shape,
+    and the flash and dense impls agree."""
+    shapes = {"q": (1, 16, 4, 8), "k": (1, 16, 2, 8), "v": (1, 16, 2, 8)}
+    rng = np.random.RandomState(14)
+    feed = {n: rng.randn(*s).astype(np.float32) for n, s in shapes.items()}
+    outs = {}
+    for impl in ("flash", "xla"):
+        q = mx.sym.Variable("q")
+        k = mx.sym.Variable("k")
+        v = mx.sym.Variable("v")
+        net = mx.sym.FlashAttention(q, k, v, causal=True, layout="bshd",
+                                    impl=impl, block_q=8, block_k=8)
+        exe = net.simple_bind(mx.cpu(0), **shapes)
+        for n, val in feed.items():
+            exe.arg_dict[n][:] = val
+        outs[impl] = np.asarray(exe.forward()[0].asnumpy())
+    assert outs["flash"].shape == (1, 16, 4, 8)
+    np.testing.assert_allclose(outs["flash"], outs["xla"],
+                               atol=2e-5, rtol=2e-4)
+
+
+def test_flash_attention_gqa_sequence_parallel():
+    """GQA k/v under a sharded seq axis: the op expands K/V to full
+    heads, then runs the ring schedule — parity vs the same op without
+    the sp context (uncommitted arrays so the shard_map mesh can place
+    them; the trainer path does this with real shardings)."""
+    from mxnet_tpu.ops.attention import (FlashAttentionOp,
+                                         FlashAttentionParam,
+                                         spmd_attention)
+
+    mesh = mx.parallel.make_mesh({"sp": 4})
+    B, S, H, Hkv, D = 1, 16, 4, 2, 8
+    rng = np.random.RandomState(15)
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, Hkv, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, Hkv, D).astype(np.float32))
+
+    op = FlashAttentionOp()
+    params = FlashAttentionParam(causal=True, layout="bshd",
+                                 block_q=4, block_k=4)
+    with spmd_attention(mesh, None, "sp"):
+        out_sp = op.forward(params, [q, k, v], [], False, None)[0][0]
+    out = op.forward(params, [q, k, v], [], False, None)[0][0]
+    np.testing.assert_allclose(np.asarray(out_sp), np.asarray(out),
+                               atol=2e-5, rtol=2e-4)
