@@ -19,7 +19,7 @@ from .. import symbol as sym
 def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
         d_ff=None, dropout=0.0, causal=True, remat=False, fused_qkv=False,
         attn_layout="bhsd", attn_impl="auto", attn_sp_impl="ring",
-        name="gpt"):
+        kv_heads=None, attn_window=0, name="gpt"):
     """Symbol computing next-token softmax loss.
 
     Inputs: ``data`` (batch, seq_len) token ids; ``softmax_label``
@@ -54,11 +54,23 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
     the sequence axis (sequence_specs) — "ring" (ppermuted K/V shards;
     any head count) or "ulysses" (two all-to-alls re-shard seq<->heads;
     needs num_heads % sp == 0).
+
+    ``kv_heads`` < num_heads is grouped-query/multi-query attention:
+    the K/V projections shrink to kv_heads * head_dim and each group of
+    q heads shares one K/V head (native in the Pallas kernel under
+    attn_layout="bshd").  ``attn_window`` > 0 adds sliding-window
+    locality (Mistral-class local attention).
     """
     if d_model % num_heads:
         raise ValueError("d_model must divide into num_heads")
     d_ff = d_ff or 4 * d_model
     head_dim = d_model // num_heads
+    kv_heads = kv_heads or num_heads
+    if num_heads % kv_heads:
+        raise ValueError("num_heads must be a multiple of kv_heads")
+    # GQA composes with fused_qkv: the fused projection emits
+    # (d_model + 2*d_kv) columns and the slice bounds below use d_kv
+    d_kv = kv_heads * head_dim
 
     def layer_scope(i):
         # mirror_stage separates per-layer checkpoint blocks: without it
@@ -83,36 +95,39 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
             flat = sym.Reshape(ln1, shape=(-1, d_model))
             if fused_qkv:
                 qkv = sym.FullyConnected(flat, name=f"{p}_qkv",
-                                         num_hidden=3 * d_model)
+                                         num_hidden=d_model + 2 * d_kv)
                 q = sym.slice_axis(qkv, axis=1, begin=0, end=d_model)
                 k = sym.slice_axis(qkv, axis=1, begin=d_model,
-                                   end=2 * d_model)
-                v = sym.slice_axis(qkv, axis=1, begin=2 * d_model,
-                                   end=3 * d_model)
+                                   end=d_model + d_kv)
+                v = sym.slice_axis(qkv, axis=1, begin=d_model + d_kv,
+                                   end=d_model + 2 * d_kv)
             else:
                 q = sym.FullyConnected(flat, name=f"{p}_q",
                                        num_hidden=d_model)
                 k = sym.FullyConnected(flat, name=f"{p}_k",
-                                       num_hidden=d_model)
+                                       num_hidden=d_kv)
                 v = sym.FullyConnected(flat, name=f"{p}_v",
-                                       num_hidden=d_model)
+                                       num_hidden=d_kv)
 
             if attn_layout == "bshd":
                 # sequence-major: (B, S, H, Dh) straight from the
                 # projection reshape, no transpose in or out
-                def heads(x):
-                    return sym.Reshape(x, shape=(-1, seq_len, num_heads,
+                def heads(x, n):
+                    return sym.Reshape(x, shape=(-1, seq_len, n,
                                                  head_dim))
             else:
-                def heads(x):
-                    x = sym.Reshape(x, shape=(-1, seq_len, num_heads,
+                def heads(x, n):
+                    x = sym.Reshape(x, shape=(-1, seq_len, n,
                                               head_dim))
-                    return sym.SwapAxis(x, dim1=1, dim2=2)   # (B, H, S, Dh)
+                    return sym.SwapAxis(x, dim1=1, dim2=2)   # (B, n, S, Dh)
 
-            attn = sym.FlashAttention(heads(q), heads(k), heads(v),
+            attn = sym.FlashAttention(heads(q, num_heads),
+                                      heads(k, kv_heads),
+                                      heads(v, kv_heads),
                                       name=f"{p}_attn", causal=causal,
                                       layout=attn_layout, impl=attn_impl,
-                                      sp_impl=attn_sp_impl)
+                                      sp_impl=attn_sp_impl,
+                                      window=attn_window)
             if attn_layout == "bshd":
                 merged = sym.Reshape(attn, shape=(-1, d_model))
             else:

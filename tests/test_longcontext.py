@@ -836,3 +836,38 @@ def test_flash_attention_gqa_sequence_parallel():
     out = op.forward(params, [q, k, v], [], False, None)[0][0]
     np.testing.assert_allclose(np.asarray(out_sp), np.asarray(out),
                                atol=2e-5, rtol=2e-4)
+
+
+@pytest.mark.parametrize("fused_qkv", [False, True])
+def test_gpt_model_gqa_trains(fused_qkv):
+    """models.gpt(kv_heads=..., attn_window=...): the GQA+window GPT
+    builds, shape-infers, and takes a finite train step both projection
+    layouts."""
+    vocab, seq = 17, 16
+    net = mx.models.gpt(vocab, seq, num_layers=1, d_model=32, num_heads=4,
+                        kv_heads=2, attn_window=8, attn_layout="bshd",
+                        fused_qkv=fused_qkv)
+    exe = net.simple_bind(mx.cpu(0), grad_req="write",
+                          data=(2, seq), softmax_label=(2, seq))
+    rng = np.random.RandomState(16)
+    # param sanity: K/V projections carry kv_heads * head_dim columns
+    shapes = dict(zip(net.list_arguments(),
+                      net.infer_shape(data=(2, seq),
+                                      softmax_label=(2, seq))[0]))
+    if fused_qkv:
+        assert shapes["gpt_l0_qkv_weight"][0] == 32 + 2 * 16
+    else:
+        assert shapes["gpt_l0_k_weight"][0] == 16
+    for name, arr in exe.arg_dict.items():
+        if name == "data":
+            arr[:] = rng.randint(0, vocab, (2, seq)).astype(np.float32)
+        elif name == "softmax_label":
+            arr[:] = rng.randint(0, vocab, (2, seq)).astype(np.float32)
+        else:
+            arr[:] = rng.normal(0, 0.05, arr.shape)
+    outs = exe.forward(is_train=True)
+    exe.backward([mx.nd.ones(o.shape) for o in outs])
+    assert np.isfinite(np.asarray(outs[0].asnumpy())).all()
+    gnorm = sum(float(np.abs(np.asarray(g.asnumpy())).sum())
+                for g in exe.grad_dict.values() if g is not None)
+    assert np.isfinite(gnorm) and gnorm > 0
