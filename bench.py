@@ -490,12 +490,16 @@ def bench_gpt(jax, np, mx, on_tpu, n_chips):
     # the only activation transposes in the step HLO); sweepable, off
     # by default until on-chip numbers pick the winner
     attn_layout = os.environ.get("BENCH_ATTN_LAYOUT", "bhsd")
+    # grouped-query attention (BENCH_KV_HEADS < n_heads shrinks the K/V
+    # projections and, under bshd, the kernel's K/V streams)
+    kv_heads = int(os.environ.get("BENCH_KV_HEADS", "0")) or None
     # multi-chip dp keeps the fused kernel too: ShardedTrainer sets the
     # ambient-mesh context and the FlashAttention op shard_maps its
     # Mosaic call over the batch axis (ops/attention.py spmd_attention)
     net = mx.models.gpt(vocab, seq_len, num_layers=n_layers,
                         d_model=d_model, num_heads=n_heads,
-                        fused_qkv=fused_qkv, attn_layout=attn_layout)
+                        fused_qkv=fused_qkv, attn_layout=attn_layout,
+                        kv_heads=kv_heads)
     _train_throughput(
         jax, np, mx, net,
         input_shapes={"data": (batch, seq_len),
@@ -506,7 +510,8 @@ def bench_gpt(jax, np, mx, on_tpu, n_chips):
         per_chip_divisor=batch * seq_len, baseline=baseline_tokens_per_sec,
         extra_fields={"batch": batch, "seq_len": seq_len,
                       "d_model": d_model, "n_layers": n_layers,
-                      "fused_qkv": fused_qkv, "attn_layout": attn_layout},
+                      "fused_qkv": fused_qkv, "attn_layout": attn_layout,
+                      "kv_heads": kv_heads or n_heads},
         a100_baseline=True,
         optimizer="adam", optimizer_params={"learning_rate": 3e-4},
         initializer=mx.initializer.Xavier(),

@@ -92,6 +92,11 @@ def main():
         yield ({"BENCH_MODEL": "gpt", "BENCH_BATCH": "16",
                 "BENCH_FUSED_QKV": "1",
                 "BENCH_ATTN_LAYOUT": "bshd"}, False)
+        # grouped-query attention: kv_heads=2 of 8 — smaller K/V
+        # projections + (bshd) kernel K/V streams
+        yield ({"BENCH_MODEL": "gpt", "BENCH_BATCH": "16",
+                "BENCH_FUSED_QKV": "1", "BENCH_ATTN_LAYOUT": "bshd",
+                "BENCH_KV_HEADS": "2"}, False)
         for bs in ("256", "512", "1024"):
             yield ({"BENCH_MODEL": "cifar", "BENCH_BATCH": bs},
                    bs == "512")
