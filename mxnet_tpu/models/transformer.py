@@ -19,7 +19,7 @@ from .. import symbol as sym
 def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
         d_ff=None, dropout=0.0, causal=True, remat=False, fused_qkv=False,
         attn_layout="bhsd", attn_impl="auto", attn_sp_impl="ring",
-        kv_heads=None, attn_window=0, name="gpt"):
+        kv_heads=None, attn_window=0, pos_embed="learned", name="gpt"):
     """Symbol computing next-token softmax loss.
 
     Inputs: ``data`` (batch, seq_len) token ids; ``softmax_label``
@@ -55,6 +55,11 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
     any head count) or "ulysses" (two all-to-alls re-shard seq<->heads;
     needs num_heads % sp == 0).
 
+    ``pos_embed``: "learned" (reference-style additive table) or
+    "rope" (rotary embeddings applied to Q/K per layer — relative
+    positions, the long-context standard; no position table in the
+    checkpoint).
+
     ``kv_heads`` < num_heads is grouped-query/multi-query attention:
     the K/V projections shrink to kv_heads * head_dim and each group of
     q heads shares one K/V head (native in the Pallas kernel under
@@ -80,12 +85,19 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
             return sym.AttrScope(force_mirroring="1", mirror_stage=str(i))
         return contextlib.nullcontext()
 
+    if pos_embed not in ("learned", "rope"):
+        raise ValueError(f"pos_embed must be learned|rope, got {pos_embed}")
+    if pos_embed == "rope" and head_dim % 2:
+        raise ValueError("rope needs an even head_dim")
     data = sym.Variable("data")
     tok = sym.Embedding(data, name=f"{name}_tok_embed", input_dim=vocab_size,
                         output_dim=d_model)                  # (B, S, D)
-    pos = sym.Variable(f"{name}_pos_embed_weight",
-                       shape=(1, seq_len, d_model))
-    h = sym.broadcast_plus(tok, pos)
+    if pos_embed == "learned":
+        pos = sym.Variable(f"{name}_pos_embed_weight",
+                           shape=(1, seq_len, d_model))
+        h = sym.broadcast_plus(tok, pos)
+    else:
+        h = tok              # rope: positions enter at each Q/K rotation
 
     for i in range(num_layers):
         p = f"{name}_l{i}"
@@ -121,9 +133,11 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
                                               head_dim))
                     return sym.SwapAxis(x, dim1=1, dim2=2)   # (B, n, S, Dh)
 
-            attn = sym.FlashAttention(heads(q, num_heads),
-                                      heads(k, kv_heads),
-                                      heads(v, kv_heads),
+            qh, kh = heads(q, num_heads), heads(k, kv_heads)
+            if pos_embed == "rope":
+                qh = sym.RoPE(qh, layout=attn_layout)
+                kh = sym.RoPE(kh, layout=attn_layout)
+            attn = sym.FlashAttention(qh, kh, heads(v, kv_heads),
                                       name=f"{p}_attn", causal=causal,
                                       layout=attn_layout, impl=attn_impl,
                                       sp_impl=attn_sp_impl,

@@ -267,3 +267,58 @@ class FlashAttentionOp(OpDef):
         if params.layout == "bshd":
             return [jnp.einsum("bhqk,bkhd->bqhd", p, v)], []
         return [jnp.einsum("bhqk,bhkd->bhqd", p, v)], []
+
+
+# -- rotary position embedding ------------------------------------------------
+class RoPEParam(Params):
+    base = field(float, default=10000.0)
+    layout = field(str, default="bshd", enum=("bshd", "bhsd"))
+    # global position of the first row — sequence-parallel shards and
+    # autoregressive decode pass their offset, mirroring the flash
+    # kernel's q_offset/k_offset contract
+    offset = field(int, default=0)
+
+
+@register_op("RoPE", aliases=("rope",))
+class RoPEOp(OpDef):
+    """Rotary position embedding (RoFormer; the long-context standard):
+    rotates each head-dim pair (x_i, x_{i+D/2}) by pos * base^(-2i/D),
+    making Q.K^T depend on relative position only.  Applied to Q and K
+    after the head reshape — composes with FlashAttention in either
+    layout, GQA (apply per tensor), and sequence shards via ``offset``.
+    Elementwise cos/sin — XLA fuses it into the surrounding projections;
+    no kernel needed.
+    """
+
+    param_cls = RoPEParam
+
+    def list_arguments(self, params):
+        return ["data"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            raise ValueError("RoPE: data shape unknown")
+        if d[-1] % 2:
+            raise ValueError(f"RoPE: head_dim must be even, got {d[-1]}")
+        return [tuple(d)], [tuple(d)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        (x,) = inputs
+        seq_axis = 1 if params.layout == "bshd" else 2
+        S, D = x.shape[seq_axis], x.shape[-1]
+        half = D // 2
+        inv_freq = params.base ** (
+            -jnp.arange(0, half, dtype=jnp.float32) / half)
+        pos = jnp.arange(S, dtype=jnp.float32) + params.offset
+        ang = pos[:, None] * inv_freq[None, :]          # (S, D/2)
+        shape = [1] * x.ndim
+        shape[seq_axis] = S
+        shape[-1] = half
+        cos = jnp.cos(ang).reshape(shape)
+        sin = jnp.sin(ang).reshape(shape)
+        xf = x.astype(jnp.float32)
+        x1, x2 = xf[..., :half], xf[..., half:]
+        out = jnp.concatenate([x1 * cos - x2 * sin,
+                               x1 * sin + x2 * cos], axis=-1)
+        return [out.astype(x.dtype)], []
