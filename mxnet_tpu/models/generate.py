@@ -46,7 +46,8 @@ def _gelu(x):
 
 
 def gpt_generate(params, prompt, max_new_tokens, num_heads,
-                 temperature=0.0, top_k=None, key=None, name="gpt"):
+                 temperature=0.0, top_k=None, key=None, window=0,
+                 name="gpt"):
     """Generate continuations for ``prompt`` with a KV cache.
 
     Args:
@@ -60,7 +61,14 @@ def gpt_generate(params, prompt, max_new_tokens, num_heads,
         softmax(logits / temperature).
       top_k: optionally restrict sampling to the k most likely tokens.
       key: jax PRNG key for sampling (defaults to PRNGKey(0)).
+      window: sliding-window radius the model was TRAINED with
+        (models.gpt attn_window); 0 = full attention.
       name: the symbol-name prefix used when building the model.
+
+    Grouped-query attention (kv_heads < num_heads) and rotary
+    embeddings (pos_embed="rope") are detected from the checkpoint:
+    the K projection's row count gives kv_heads, and a missing
+    position table means rope.
 
     Returns ``(batch, prompt_len + max_new_tokens)`` numpy int32 ids
     (prompt included).  The compiled decode loop is cached per
@@ -69,19 +77,24 @@ def gpt_generate(params, prompt, max_new_tokens, num_heads,
     prompt = np.asarray(prompt)
     if prompt.ndim != 2:
         raise ValueError("prompt must be (batch, prompt_len)")
+    if window < 0:
+        raise ValueError(f"window must be >= 0 (got {window})")
     B, P = prompt.shape
     if P < 1:
         raise ValueError("prompt must hold at least one token")
 
     try:
         tok_w = params[f"{name}_tok_embed_weight"]
-        pos_w = params[f"{name}_pos_embed_weight"]
     except KeyError:
         raise ValueError(
             f"params has no '{name}_tok_embed_weight' — wrong name "
             "prefix or not a gpt() parameter dict") from None
     d_model = tok_w.shape[1]
-    S = pos_w.shape[1]
+    # pos_embed="rope" checkpoints carry no position table; positions
+    # then have no trained length limit, so the cache sizes to the
+    # request instead of the table
+    pos_w = params.get(f"{name}_pos_embed_weight")
+    S = None if pos_w is None else pos_w.shape[1]
     if any(k.endswith("_wscale") for k in params):
         # quantized checkpoint (contrib/quantization.py): dequantize the
         # int8 weights once at load — decode then runs the normal path
@@ -93,15 +106,19 @@ def gpt_generate(params, prompt, max_new_tokens, num_heads,
             scale = np.asarray(params.pop(k), np.float32)
             params[stem + "_weight"] = wq * scale[:, None]
     if f"{name}_l0_qkv_weight" in params:
-        # fused_qkv=True checkpoint layout: split each (3D, D) projection
-        # back into the q/k/v entries the decoder addresses
+        # fused_qkv=True checkpoint layout: split each projection back
+        # into the q/k/v entries the decoder addresses.  GQA fused
+        # checkpoints emit (d_model + 2*d_kv) rows, so split at the
+        # boundaries rather than in thirds.
         params = dict(params)
+        rows = np.asarray(params[f"{name}_l0_qkv_weight"]).shape[0]
+        d_kv_f = (rows - d_model) // 2
         i = 0
         while f"{name}_l{i}_qkv_weight" in params:
             for kind in ("weight", "bias"):
-                parts = np.split(
-                    np.asarray(params.pop(f"{name}_l{i}_qkv_{kind}")), 3,
-                    axis=0)
+                whole = np.asarray(params.pop(f"{name}_l{i}_qkv_{kind}"))
+                parts = np.split(whole, [d_model, d_model + d_kv_f],
+                                 axis=0)
                 for x, part in zip(("q", "k", "v"), parts):
                     params[f"{name}_l{i}_{x}_{kind}"] = part
             i += 1
@@ -115,22 +132,27 @@ def gpt_generate(params, prompt, max_new_tokens, num_heads,
     if d_model % num_heads:
         raise ValueError("num_heads must divide d_model")
     head_dim = d_model // num_heads
+    kv_heads = (np.asarray(params[f"{name}_l0_k_weight"]).shape[0]
+                // head_dim)
     T = P + max_new_tokens
-    if T > S:
+    if S is not None and T > S:
         raise ValueError(
             f"prompt_len + max_new_tokens = {T} exceeds the model's "
             f"positional table ({S})")
+    S_cache = T if S is None else S
 
     if max_new_tokens < 1:
         return np.asarray(prompt, np.int32)
 
-    cfg = (name, n_layers, num_heads, head_dim, B, P, max_new_tokens, S,
-           float(temperature), top_k,
-           str(jnp.asarray(tok_w).dtype))
+    cfg = (name, n_layers, num_heads, head_dim, B, P, max_new_tokens,
+           S_cache, float(temperature), top_k, kv_heads, S is None,
+           int(window), str(jnp.asarray(tok_w).dtype))
     run = _decoder_cache.get(cfg)
     if run is None:
         run = _build_decoder(name, n_layers, num_heads, head_dim, B, P,
-                             max_new_tokens, S, float(temperature), top_k)
+                             max_new_tokens, S_cache, float(temperature),
+                             top_k, kv_heads=kv_heads, rope=S is None,
+                             window=int(window))
         _decoder_cache[cfg] = run
 
     if key is None:
@@ -141,16 +163,36 @@ def gpt_generate(params, prompt, max_new_tokens, num_heads,
 
 
 def _build_decoder(name, n_layers, num_heads, head_dim, B, P,
-                   max_new_tokens, S, temperature, top_k):
+                   max_new_tokens, S, temperature, top_k, kv_heads=None,
+                   rope=False, window=0):
     d_model = num_heads * head_dim
     T = P + max_new_tokens
+    kv_heads = kv_heads or num_heads
+    group = num_heads // kv_heads
+    half = head_dim // 2
+
+    def _rot(u, t):
+        """RoPE rotation of (B, H, Dh) at scalar position t (matches
+        ops/attention.py RoPEOp with offset folded into t)."""
+        inv = 10000.0 ** (-jnp.arange(half, dtype=jnp.float32) / half)
+        ang = t.astype(jnp.float32) * inv                     # (half,)
+        cos, sin = jnp.cos(ang), jnp.sin(ang)
+        uf = u.astype(jnp.float32)
+        u1, u2 = uf[..., :half], uf[..., half:]
+        return jnp.concatenate([u1 * cos - u2 * sin,
+                                u1 * sin + u2 * cos],
+                               axis=-1).astype(u.dtype)
 
     def step_token(params, tok, t, cache_k, cache_v):
         """One decode position: tok (B,) int32 at position t; caches
-        (L, B, H, S, Dh).  Returns logits (B, V) + updated caches."""
-        x = (params[f"{name}_tok_embed_weight"][tok]
-             + params[f"{name}_pos_embed_weight"][0, t])      # (B, D)
+        (L, B, Hkv, S, Dh).  Returns logits (B, V) + updated caches."""
+        x = params[f"{name}_tok_embed_weight"][tok]            # (B, D)
+        if not rope:
+            x = x + params[f"{name}_pos_embed_weight"][0, t]
         pos_mask = (jnp.arange(S) <= t)                        # (S,)
+        if window:
+            pos_mask = jnp.logical_and(pos_mask,
+                                       jnp.arange(S) > t - window)
         for i in range(n_layers):
             p = f"{name}_l{i}"
             h = _ln(x, params[f"{p}_ln1_gamma"], params[f"{p}_ln1_beta"])
@@ -158,16 +200,21 @@ def _build_decoder(name, n_layers, num_heads, head_dim, B, P,
             k = _fc(h, params[f"{p}_k_weight"], params[f"{p}_k_bias"])
             v = _fc(h, params[f"{p}_v_weight"], params[f"{p}_v_bias"])
             qh = q.reshape(B, num_heads, head_dim)
-            kh = k.reshape(B, num_heads, head_dim)
-            vh = v.reshape(B, num_heads, head_dim)
+            kh = k.reshape(B, kv_heads, head_dim)
+            vh = v.reshape(B, kv_heads, head_dim)
+            if rope:
+                qh, kh = _rot(qh, t), _rot(kh, t)
             # write this token's k/v at position t, then attend over <=t
             cache_k = cache_k.at[i, :, :, t, :].set(kh)
             cache_v = cache_v.at[i, :, :, t, :].set(vh)
-            scores = jnp.einsum("bhd,bhsd->bhs", qh, cache_k[i])
+            # grouped-query: kv head g serves q heads [g*group, ...)
+            qg = qh.reshape(B, kv_heads, group, head_dim)
+            scores = jnp.einsum("bkgd,bksd->bkgs", qg, cache_k[i])
             scores = scores / np.sqrt(head_dim)
-            scores = jnp.where(pos_mask[None, None, :], scores, -jnp.inf)
+            scores = jnp.where(pos_mask[None, None, None, :], scores,
+                               -jnp.inf)
             probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-            attn = jnp.einsum("bhs,bhsd->bhd", probs.astype(x.dtype),
+            attn = jnp.einsum("bkgs,bksd->bkgd", probs.astype(x.dtype),
                               cache_v[i])
             x = x + _fc(attn.reshape(B, d_model),
                         params[f"{p}_proj_weight"], params[f"{p}_proj_bias"])
@@ -192,7 +239,7 @@ def _build_decoder(name, n_layers, num_heads, head_dim, B, P,
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     def run(params, prompt, key):
-        cache_k = jnp.zeros((n_layers, B, num_heads, S, head_dim),
+        cache_k = jnp.zeros((n_layers, B, kv_heads, S, head_dim),
                             params[f"{name}_tok_embed_weight"].dtype)
         cache_v = jnp.zeros_like(cache_k)
         # tokens fed at each step: prompt for t < P, then sampled

@@ -8,8 +8,9 @@ import pytest
 import mxnet_tpu as mx
 
 
-def _random_gpt(V=23, S=12, L=2, D=16, H=2, seed=0):
-    net = mx.models.gpt(V, S, num_layers=L, d_model=D, num_heads=H)
+def _random_gpt(V=23, S=12, L=2, D=16, H=2, seed=0, **model_kwargs):
+    net = mx.models.gpt(V, S, num_layers=L, d_model=D, num_heads=H,
+                        **model_kwargs)
     exe = net.simple_bind(mx.cpu(), grad_req="null", data=(1, S),
                           softmax_label=(1, S))
     rng = np.random.RandomState(seed)
@@ -23,14 +24,9 @@ def _random_gpt(V=23, S=12, L=2, D=16, H=2, seed=0):
     return net, exe, params
 
 
-def test_greedy_matches_full_graph():
-    V, S, H = 23, 12, 2
-    net, exe, params = _random_gpt(V=V, S=S, H=H)
-    rng = np.random.RandomState(1)
-    prompt = rng.randint(0, V, (1, 4))
-
-    # reference: grow the sequence one token at a time through the
-    # TRAINING graph (causality makes right-padding irrelevant)
+def _greedy_rollout(exe, prompt, S, V):
+    """Teacher-forced greedy growth through the TRAINING graph
+    (causality makes right-padding irrelevant) — the decode reference."""
     ids = list(prompt[0])
     while len(ids) < S:
         padded = np.zeros((1, S), np.float32)
@@ -39,6 +35,16 @@ def test_greedy_matches_full_graph():
         exe.forward(is_train=False)
         probs = exe.outputs[0].asnumpy().reshape(S, V)
         ids.append(int(probs[len(ids) - 1].argmax()))
+    return ids
+
+
+def test_greedy_matches_full_graph():
+    V, S, H = 23, 12, 2
+    net, exe, params = _random_gpt(V=V, S=S, H=H)
+    rng = np.random.RandomState(1)
+    prompt = rng.randint(0, V, (1, 4))
+
+    ids = _greedy_rollout(exe, prompt, S, V)
 
     out = mx.models.gpt_generate(params, prompt, max_new_tokens=S - 4,
                                  num_heads=H)
@@ -204,3 +210,26 @@ def test_generate_accepts_quantized_checkpoint():
     ids_m = mx.models.gpt_generate(manual, prompt, max_new_tokens=3,
                                    num_heads=2)
     np.testing.assert_array_equal(ids_q, ids_m)
+
+
+@pytest.mark.parametrize("opts", [
+    {"kv_heads": 1},                                  # MQA
+    {"pos_embed": "rope"},
+    {"kv_heads": 1, "pos_embed": "rope", "fused_qkv": True},
+    {"attn_window": 6},
+])
+def test_greedy_matches_full_graph_variants(opts):
+    """KV-cache decode reproduces the training graph's argmax for the
+    new model options: GQA/MQA (kv_heads detected from the K projection
+    rows), rotary embeddings (no position table in the checkpoint),
+    their fused-qkv composition, and sliding-window attention."""
+    V, S, H = 19, 12, 2
+    window = opts.pop("attn_window", 0)
+    net, exe, params = _random_gpt(V=V, S=S, H=H, seed=7,
+                                   attn_window=window, **opts)
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(0, V, (1, 4))
+    ids = _greedy_rollout(exe, prompt, S, V)
+    out = mx.models.gpt_generate(params, prompt, max_new_tokens=S - 4,
+                                 num_heads=H, window=window)
+    np.testing.assert_array_equal(out[0], np.array(ids, np.int32))
