@@ -59,6 +59,14 @@ def main():
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3: store params sharded over dp "
                         "(--trainer sharded only)")
+    p.add_argument("--kv-heads", type=int, default=0,
+                   help="grouped-query attention: K/V heads "
+                        "(0 = num-heads, i.e. standard MHA)")
+    p.add_argument("--rope", action="store_true",
+                   help="rotary position embeddings instead of the "
+                        "learned table")
+    p.add_argument("--window", type=int, default=0,
+                   help="sliding-window attention radius (0 = full)")
     p.add_argument("--generate", type=int, default=0, metavar="N",
                    help="after training, KV-cache-decode N tokens from a "
                         "corpus prompt (models/generate.py)")
@@ -86,7 +94,10 @@ def main():
 
     net = mx.models.gpt(args.vocab, args.seq_len, num_layers=args.num_layers,
                         d_model=args.d_model, num_heads=args.num_heads,
-                        attn_layout=args.attn_layout)
+                        attn_layout=args.attn_layout,
+                        kv_heads=args.kv_heads or None,
+                        pos_embed="rope" if args.rope else "learned",
+                        attn_window=args.window)
 
     if args.trainer == "sharded":
         mesh = mx.parallel.local_mesh("dp")
@@ -137,7 +148,8 @@ def main():
         prompt = tokens[:prompt_len][None]
         out = mx.models.gpt_generate(params, prompt, args.generate,
                                      num_heads=args.num_heads,
-                                     temperature=args.temperature)
+                                     temperature=args.temperature,
+                                     window=args.window)
         cont = out[0, prompt_len:]
         if args.data and os.path.exists(args.data):
             inv = {i: c for c, i in lut.items()}
