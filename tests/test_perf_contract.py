@@ -93,6 +93,40 @@ def test_flash_kernel_lowers_for_tpu(layout, shape):
         "backward did not lower to three Mosaic kernels"
 
 
+@pytest.mark.parametrize("opts,qshape,kshape", [
+    # sliding window: band-masked tiles + tile skipping
+    ({"window": 256}, (2, 8, 1024, 64), None),
+    # GQA (bshd native): 8 q heads on 2 kv heads
+    ({"layout": "bshd"}, (2, 1024, 8, 64), (2, 1024, 2, 64)),
+    # GQA + window + causal composed
+    ({"layout": "bshd", "window": 256}, (2, 1024, 8, 64), (2, 1024, 2, 64)),
+])
+def test_flash_kernel_features_lower_for_tpu(opts, qshape, kshape):
+    """The window/GQA kernel variants must survive Mosaic lowering, not
+    just the CPU interpreter — the x64-index-map bug class hid exactly
+    here (pallas_util.idx32)."""
+    from mxnet_tpu.ops.flash_attention import flash_attention
+
+    q = jnp.zeros(qshape, jnp.bfloat16)
+    k = q if kshape is None else jnp.zeros(kshape, jnp.bfloat16)
+
+    def fwd(q, k):
+        return flash_attention(q, k, k, causal=True, interpret=False,
+                               **opts)
+
+    def bwd(q, k):
+        # differentiate BOTH operands: k/v grads unused would let XLA
+        # DCE the dkv kernel and the count would vacuously pass at 2
+        return jax.grad(lambda x, y: flash_attention(
+            x, y, y, causal=True, interpret=False,
+            **opts).astype(jnp.float32).sum(), argnums=(0, 1))(q, k)
+
+    t = _tpu_text(fwd, q, k)
+    assert len(re.findall(r"tpu_custom_call", t)) == 1
+    t = _tpu_text(bwd, q, k)
+    assert len(re.findall(r"tpu_custom_call", t)) == 3
+
+
 def test_fused_rnn_kernels_lower_for_tpu():
     from mxnet_tpu.ops.pallas_gru import fused_gru
     from mxnet_tpu.ops.pallas_lstm import fused_lstm
