@@ -92,6 +92,32 @@ def _():
     shp = (2, 2, 16, 8)
     return net, {"q": shp, "k": shp, "v": shp}, {}
 
+@case("flash_attention_window_gqa")
+def _():
+    # sliding-window band + grouped-query (bshd native) composed: the
+    # round-4 kernel variants, real Mosaic on TPU vs interpreter on CPU
+    q = mx.sym.Variable("q")
+    k = mx.sym.Variable("k")
+    v = mx.sym.Variable("v")
+    net = mx.sym.FlashAttention(q, k, v, causal=True, layout="bshd",
+                                window=8, block_q=8, block_k=8)
+    return net, {"q": (2, 16, 4, 8), "k": (2, 16, 2, 8),
+                 "v": (2, 16, 2, 8)}, {}
+
+@case("rope_gpt_block")
+def _():
+    # RoPE rotation feeding fused attention (rope is elementwise XLA,
+    # but its trig must agree cross-platform through the kernel)
+    q = mx.sym.Variable("q")
+    k = mx.sym.Variable("k")
+    v = mx.sym.Variable("v")
+    net = mx.sym.FlashAttention(mx.sym.RoPE(q, layout="bshd"),
+                                mx.sym.RoPE(k, layout="bshd"), v,
+                                causal=True, layout="bshd",
+                                block_q=8, block_k=8)
+    shp = (2, 16, 2, 8)
+    return net, {"q": shp, "k": shp, "v": shp}, {}
+
 @case("layernorm_gelu")
 def _():
     data = mx.sym.Variable("data")
@@ -304,6 +330,8 @@ def _run(case, tpu):
 @pytest.mark.parametrize("case", ["conv_bn_relu", "fc_softmax",
                                   "pool_flatten_dot", "rnn_lstm",
                                   "flash_attention_causal",
+                                  "flash_attention_window_gqa",
+                                  "rope_gpt_block",
                                   "layernorm_gelu",
                                   "rnn_lstm_pallas", "rnn_gru_pallas",
                                   "deconv", "lrn_leaky",
