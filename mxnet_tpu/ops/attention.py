@@ -166,17 +166,12 @@ class FlashAttentionOp(OpDef):
                 h_ax = 2 if params.layout == "bshd" else 1
                 if k.shape[h_ax] != q.shape[h_ax]:
                     # grouped-query K/V under sequence parallelism:
-                    # expand to full heads before the sharded schedule
-                    # (ring streams whole K/V shards; ulysses must
-                    # all-to-all the head axis across sp shards)
-                    rep, rem = divmod(q.shape[h_ax], k.shape[h_ax])
-                    if rem or not k.shape[h_ax]:
-                        raise ValueError(
-                            f"FlashAttention: q heads ({q.shape[h_ax]}) "
-                            f"must be a multiple of kv heads "
-                            f"({k.shape[h_ax]})")
-                    k = jnp.repeat(k, rep, axis=h_ax)
-                    v = jnp.repeat(v, rep, axis=h_ax)
+                    # validate for a clean error here; ring streams the
+                    # REDUCED K/V shards natively (bshd — bhsd expands
+                    # inside the kernel call), ulysses expands at entry
+                    # (its all-to-alls re-shard the head axis)
+                    from .flash_attention import gqa_group
+                    gqa_group(q.shape[h_ax], k.shape[h_ax])
                 if params.sp_impl == "ulysses":
                     from ..parallel.ulysses import ulysses_attention \
                         as sp_attention
@@ -236,11 +231,8 @@ class FlashAttentionOp(OpDef):
         h_ax = 2 if params.layout == "bshd" else 1
         if k.shape[h_ax] != q.shape[h_ax]:
             # grouped-query attention through the dense path: expand K/V
-            rep, rem = divmod(q.shape[h_ax], k.shape[h_ax])
-            if rem or not k.shape[h_ax]:
-                raise ValueError(
-                    f"FlashAttention: q heads ({q.shape[h_ax]}) must be "
-                    f"a multiple of kv heads ({k.shape[h_ax]})")
+            from .flash_attention import gqa_group
+            rep = gqa_group(q.shape[h_ax], k.shape[h_ax])
             k = jnp.repeat(k, rep, axis=h_ax)
             v = jnp.repeat(v, rep, axis=h_ax)
         if params.layout == "bshd":

@@ -37,7 +37,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_util import idx32
 
-__all__ = ["flash_attention", "flash_eligible"]
+__all__ = ["flash_attention", "flash_eligible", "gqa_group"]
 
 # np.float32, not a Python float: inside Mosaic-lowered kernel bodies a
 # bare Python float is a weak float64 constant, and Mosaic has no
@@ -204,6 +204,18 @@ def _tile_live(i, j, bq, bk, causal, qo, ko, window=0):
 
 def _heads(H):
     return [None] if H is None else list(range(H))
+
+
+def gqa_group(Hq, Hkv):
+    """Validated grouped-query factor: q heads per shared K/V head.
+    The single source of the 'multiple of kv heads' contract — every
+    GQA entry point (kernel, op, ring, ulysses) validates through
+    here so zero/non-multiple head counts fail identically."""
+    if Hkv <= 0 or Hq % Hkv:
+        raise ValueError(
+            f"grouped-query attention: q heads ({Hq}) must be a "
+            f"multiple of kv heads ({Hkv})")
+    return Hq // Hkv
 
 
 def _kv(h, group):
@@ -661,10 +673,7 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
     if Hkv != H:
         # grouped-query / multi-query attention: `group` consecutive q
         # heads share one K/V head
-        if Hkv == 0 or H % Hkv:
-            raise ValueError(
-                f"flash_attention: q heads ({H}) must be a multiple of "
-                f"kv heads ({Hkv}) for grouped-query attention")
+        gqa_group(H, Hkv)
         if v.shape != k.shape:
             raise ValueError("flash_attention: k and v shapes must match")
     if scale is None:

@@ -48,6 +48,16 @@ def _block_attn(q, k, v, scale, causal_mask=None):
 def _ring_body(q, k, v, axis_name, n_shards, scale, causal, q_index):
     """Per-shard ring loop: rotate K/V, accumulate with LSE renorm."""
     B, H, S_blk, D = q.shape
+    if k.shape[1] != H:
+        # grouped-query k/v through the dense fallback: expand here.
+        # (The flash body passes reduced K/V to the kernel, which
+        # groups natively under bshd; under bhsd the kernel expands
+        # internally per step — still reduced traffic on the ring's
+        # ppermutes either way.)
+        from ..ops.flash_attention import gqa_group
+        rep = gqa_group(H, k.shape[1])
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
 
     def step(carry, i):
         k_cur, v_cur, o_acc, m_acc, l_acc = carry
@@ -231,7 +241,10 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
     impl: "flash" runs each shard-pair block through the fused Pallas
     kernel; "xla" uses the jnp blockwise body; "auto" picks flash on
     TPU (when the shard length divides the kernel block sizes) and xla
-    elsewhere.
+    elsewhere.  K/V may carry fewer heads than q (grouped-query
+    attention): the flash body streams the reduced K/V shards around
+    the ring natively — the GQA traffic saving applies to the ring
+    ppermutes too — and the dense body expands.
 
     batch_axis: optional dp mesh axis the batch dim is ALSO sharded
     over (combined dp x sp data+sequence parallelism); each dp

@@ -119,6 +119,13 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
     head_axis, seq_axis = (2, 1) if layout == "bshd" else (1, 2)
     n_shards = mesh.shape[axis]
     H = q.shape[head_axis]
+    if k.shape[head_axis] != H:
+        # grouped-query k/v: the all-to-alls re-shard the HEAD axis, so
+        # expand to full heads first (ring_attention keeps GQA native)
+        from ..ops.flash_attention import gqa_group
+        rep = gqa_group(H, k.shape[head_axis])
+        k = jnp.repeat(k, rep, axis=head_axis)
+        v = jnp.repeat(v, rep, axis=head_axis)
     if H % n_shards != 0:
         raise ValueError(
             f"ulysses_attention: heads ({H}) must be divisible by the "

@@ -938,3 +938,59 @@ def test_gpt_model_rope_trains():
     gnorm = sum(float(np.abs(np.asarray(g.asnumpy())).sum())
                 for g in exe.grad_dict.values() if g is not None)
     assert np.isfinite(gnorm) and gnorm > 0
+
+
+@pytest.mark.parametrize("layout", ["bhsd", "bshd"])
+def test_ring_attention_gqa_native(layout):
+    """Ring attention carries grouped-query K/V natively: the REDUCED
+    shards go around the ring (flash body groups in-kernel; dense body
+    expands per shard) — parity vs the expanded dense reference, both
+    impls."""
+    rng = np.random.RandomState(21)
+    B, H, Hkv, S, D = 1, 4, 2, 32, 16
+    mesh = mx.parallel.make_mesh({"sp": 4})
+    if layout == "bshd":
+        q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+        k = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.float32)
+        v = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.float32)
+        kx = jnp.repeat(k, H // Hkv, axis=2).transpose(0, 2, 1, 3)
+        vx = jnp.repeat(v, H // Hkv, axis=2).transpose(0, 2, 1, 3)
+        ref = attention_reference(q.transpose(0, 2, 1, 3), kx, vx,
+                                  causal=True).transpose(0, 2, 1, 3)
+    else:
+        q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+        k = jnp.asarray(rng.randn(B, Hkv, S, D), jnp.float32)
+        v = jnp.asarray(rng.randn(B, Hkv, S, D), jnp.float32)
+        ref = attention_reference(q, jnp.repeat(k, H // Hkv, axis=1),
+                                  jnp.repeat(v, H // Hkv, axis=1),
+                                  causal=True)
+    for impl in ("xla", "flash"):
+        out = ring_attention(q, k, v, mesh, axis="sp", causal=True,
+                             impl=impl, block_q=8, block_k=8,
+                             layout=layout)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-4,
+                                   err_msg=f"{layout}:{impl}")
+
+
+def test_ulysses_attention_gqa_expands():
+    """Ulysses must expand GQA K/V (its all-to-alls re-shard the head
+    axis) — parity vs the dense reference, plus the clean error for a
+    non-multiple head count."""
+    from mxnet_tpu.parallel.ulysses import ulysses_attention
+
+    rng = np.random.RandomState(22)
+    B, H, Hkv, S, D = 1, 4, 2, 32, 16
+    mesh = mx.parallel.make_mesh({"sp": 4})
+    q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, Hkv, S, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, Hkv, S, D), jnp.float32)
+    ref = attention_reference(q, jnp.repeat(k, 2, axis=1),
+                              jnp.repeat(v, 2, axis=1), causal=True)
+    out = ulysses_attention(q, k, v, mesh, axis="sp", causal=True,
+                            impl="xla", block_q=8, block_k=8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-4)
+    with pytest.raises(ValueError, match="multiple"):
+        ulysses_attention(q, k[:, :1][:, [0, 0, 0]], v[:, :1][:, [0, 0, 0]],
+                          mesh, axis="sp", causal=True, impl="xla")
