@@ -19,7 +19,8 @@ from .. import symbol as sym
 def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
         d_ff=None, dropout=0.0, causal=True, remat=False, fused_qkv=False,
         attn_layout="bhsd", attn_impl="auto", attn_sp_impl="ring",
-        kv_heads=None, attn_window=0, pos_embed="learned", name="gpt"):
+        kv_heads=None, attn_window=0, pos_embed="learned", loss="softmax",
+        name="gpt"):
     """Symbol computing next-token softmax loss.
 
     Inputs: ``data`` (batch, seq_len) token ids; ``softmax_label``
@@ -55,6 +56,12 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
     any head count) or "ulysses" (two all-to-alls re-shard seq<->heads;
     needs num_heads % sp == 0).
 
+    ``loss``: "softmax" (reference SoftmaxOutput — per-position
+    probabilities as the output) or "ce" (fused SoftmaxCELoss — the
+    output is the (B*S,) per-position NLL; skips materializing the
+    (B*S, vocab) probability tensor, gigabytes of HBM at transformer
+    vocabularies).
+
     ``pos_embed``: "learned" (reference-style additive table) or
     "rope" (rotary embeddings applied to Q/K per layer — relative
     positions, the long-context standard; no position table in the
@@ -87,6 +94,8 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
 
     if pos_embed not in ("learned", "rope"):
         raise ValueError(f"pos_embed must be learned|rope, got {pos_embed}")
+    if loss not in ("softmax", "ce"):
+        raise ValueError(f"loss must be softmax|ce, got {loss}")
     if pos_embed == "rope" and head_dim % 2:
         raise ValueError("rope needs an even head_dim")
     data = sym.Variable("data")
@@ -170,4 +179,6 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
                                 name=f"{name}_head", num_hidden=vocab_size)
     label = sym.Variable("softmax_label")        # (batch, seq_len)
     label_flat = sym.Reshape(label, shape=(-1,))
+    if loss == "ce":
+        return sym.SoftmaxCELoss(logits, label_flat, name="softmax")
     return sym.SoftmaxOutput(logits, label_flat, name="softmax")

@@ -468,3 +468,63 @@ def _softmax_cross_entropy_backward(params, out_grads, inputs, outputs):
 register_simple_op("softmax_cross_entropy", _softmax_cross_entropy, nin=2,
                    shape_rule=_softmax_cross_entropy_shape,
                    backward_fn=_softmax_cross_entropy_backward)
+
+
+class SoftmaxCELossParam(Params):
+    grad_scale = field(float, default=1.0)
+    ignore_label = field(float, default=-1.0)
+    use_ignore = field(bool, default=False)
+
+
+@register_op("SoftmaxCELoss", aliases=("softmax_ce_loss",))
+class SoftmaxCELossOp(OpDef):
+    """Fused cross-entropy head: per-position NLL straight from logits.
+
+    ``SoftmaxOutput`` (the reference head) must emit the full (N, V)
+    probability tensor as its output — at transformer vocabularies
+    that is gigabytes of HBM write+read per step just to feed a scalar
+    loss.  This head outputs the (N,) losses instead
+    (loss = logsumexp(x) - x[label], f32) and recomputes
+    softmax(x) - onehot in backward from the logits it already has —
+    no (N, V) output, no probability residual.  Opt-in via
+    ``models.gpt(loss="ce")``; SoftmaxOutput stays the default for
+    reference-parity semantics (probabilities as outputs).
+    """
+
+    param_cls = SoftmaxCELossParam
+    is_loss = True
+
+    def list_arguments(self, params):
+        return ["data", "label"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            raise ValueError("SoftmaxCELoss: data shape unknown")
+        if len(d) != 2:
+            raise ValueError(
+                f"SoftmaxCELoss: data must be (N, V) logits, got {d}")
+        return [tuple(d), (d[0],)], [(d[0],)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        x, label = inputs
+        xf = x.astype(jnp.float32)
+        lab = label.astype(jnp.int32)
+        lse = jax.scipy.special.logsumexp(xf, axis=-1)
+        picked = jnp.take_along_axis(xf, lab[:, None], axis=-1)[:, 0]
+        loss = lse - picked
+        if params.use_ignore:
+            loss = jnp.where(lab == int(params.ignore_label), 0.0, loss)
+        return [loss], []
+
+    def backward(self, params, out_grads, inputs, outputs):
+        x, label = inputs
+        lab = label.astype(jnp.int32)
+        prob = jax.nn.softmax(x.astype(jnp.float32), axis=-1)
+        grad = prob - jax.nn.one_hot(lab, x.shape[-1], dtype=prob.dtype)
+        if params.use_ignore:
+            grad = grad * (lab != int(params.ignore_label))[:, None]
+        if out_grads and out_grads[0] is not None:
+            grad = grad * out_grads[0].astype(grad.dtype)[:, None]
+        grad = grad * params.grad_scale
+        return [grad.astype(x.dtype), jnp.zeros_like(label)]

@@ -994,3 +994,43 @@ def test_ulysses_attention_gqa_expands():
     with pytest.raises(ValueError, match="multiple"):
         ulysses_attention(q, k[:, :1][:, [0, 0, 0]], v[:, :1][:, [0, 0, 0]],
                           mesh, axis="sp", causal=True, impl="xla")
+
+
+def test_gpt_fused_ce_loss_parity():
+    """loss='ce' (fused SoftmaxCELoss head): per-position NLL equals
+    -log(probs[label]) of the SoftmaxOutput head, and the parameter
+    gradients of one train step match exactly (same backward math,
+    no (N, V) probability materialization)."""
+    vocab, seq = 29, 8
+    rng = np.random.RandomState(23)
+    feed_x = rng.randint(0, vocab, (2, seq)).astype(np.float32)
+    feed_y = rng.randint(0, vocab, (2, seq)).astype(np.float32)
+
+    def run(loss):
+        net = mx.models.gpt(vocab, seq, num_layers=1, d_model=16,
+                            num_heads=2, loss=loss)
+        exe = net.simple_bind(mx.cpu(0), grad_req="write",
+                              data=(2, seq), softmax_label=(2, seq))
+        prng = np.random.RandomState(3)
+        for name, arr in exe.arg_dict.items():
+            if name == "data":
+                arr[:] = feed_x
+            elif name == "softmax_label":
+                arr[:] = feed_y
+            else:
+                arr[:] = prng.normal(0, 0.1, arr.shape)
+        outs = exe.forward(is_train=True)
+        exe.backward([mx.nd.ones(o.shape) for o in outs])
+        grads = {k: np.asarray(g.asnumpy())
+                 for k, g in exe.grad_dict.items() if g is not None}
+        return np.asarray(outs[0].asnumpy()), grads
+
+    probs, g_soft = run("softmax")
+    losses, g_ce = run("ce")
+    lab = feed_y.reshape(-1).astype(int)
+    nll_ref = -np.log(probs[np.arange(lab.size), lab] + 1e-12)
+    np.testing.assert_allclose(losses, nll_ref, atol=1e-5, rtol=1e-5)
+    assert set(g_ce) == set(g_soft)
+    for k in g_soft:
+        np.testing.assert_allclose(g_ce[k], g_soft[k], atol=1e-5,
+                                   rtol=1e-4, err_msg=k)
