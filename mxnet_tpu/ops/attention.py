@@ -122,7 +122,10 @@ class FlashAttentionOp(OpDef):
     Pallas kernels under layout='bshd' (one shared K/V head streamed
     per group), expanded under 'bhsd', the dense fallback, and the
     sequence-parallel schedules.  ``window`` > 0 adds sliding-window
-    locality.  On TPU with fitting block sizes this lowers to the fused
+    locality — including under sequence parallelism (ring masks with
+    global positions and bounds its steps to the band; ulysses sees the
+    full sequence after its all-to-all).  On TPU with fitting block
+    sizes this lowers to the fused
     Pallas kernel (forward + custom-VJP backward); elsewhere it runs
     the XLA dense formulation.  Differentiable either way.
     """
@@ -158,11 +161,6 @@ class FlashAttentionOp(OpDef):
                 # sequence-parallel program: global attention over the
                 # sharded sequence REQUIRES a sharded schedule — local
                 # per-shard attention would be silently wrong
-                if params.window:
-                    raise NotImplementedError(
-                        "FlashAttention(window=...) under sequence "
-                        "parallelism is not implemented — drop the sp "
-                        "axis or use full attention")
                 h_ax = 2 if params.layout == "bshd" else 1
                 if k.shape[h_ax] != q.shape[h_ax]:
                     # grouped-query K/V under sequence parallelism:
@@ -183,7 +181,8 @@ class FlashAttentionOp(OpDef):
                     q, k, v, mesh, axis=seq_ax, causal=params.causal,
                     impl=params.impl, block_q=params.block_q,
                     block_k=params.block_k, layout=params.layout,
-                    batch_axis=batch_ax if batch_sharded else None)
+                    batch_axis=batch_ax if batch_sharded else None,
+                    window=params.window)
                 return [out], []
 
         seq_axis = 1 if params.layout == "bshd" else 2

@@ -474,6 +474,10 @@ class SoftmaxCELossParam(Params):
     grad_scale = field(float, default=1.0)
     ignore_label = field(float, default=-1.0)
     use_ignore = field(bool, default=False)
+    out_grad = field(bool, default=False,
+                     doc="scale the gradient by the incoming output "
+                         "gradient (loss-layer contract: ignored by "
+                         "default, like SoftmaxOutput)")
 
 
 @register_op("SoftmaxCELoss", aliases=("softmax_ce_loss",))
@@ -524,7 +528,7 @@ class SoftmaxCELossOp(OpDef):
         grad = prob - jax.nn.one_hot(lab, x.shape[-1], dtype=prob.dtype)
         if params.use_ignore:
             grad = grad * (lab != int(params.ignore_label))[:, None]
-        if out_grads and out_grads[0] is not None:
+        if params.out_grad and out_grads and out_grads[0] is not None:
             grad = grad * out_grads[0].astype(grad.dtype)[:, None]
         grad = grad * params.grad_scale
         return [grad.astype(x.dtype), jnp.zeros_like(label)]

@@ -45,7 +45,8 @@ def _block_attn(q, k, v, scale, causal_mask=None):
     return o, m, l
 
 
-def _ring_body(q, k, v, axis_name, n_shards, scale, causal, q_index):
+def _ring_body(q, k, v, axis_name, n_shards, scale, causal, q_index,
+               window=0, n_steps=None):
     """Per-shard ring loop: rotate K/V, accumulate with LSE renorm."""
     B, H, S_blk, D = q.shape
     if k.shape[1] != H:
@@ -61,12 +62,15 @@ def _ring_body(q, k, v, axis_name, n_shards, scale, causal, q_index):
 
     def step(carry, i):
         k_cur, v_cur, o_acc, m_acc, l_acc = carry
-        if causal:
-            # global block index of the current K/V shard
+        if causal or window:
+            # the kernel's own global-position band mask (ONE source of
+            # the causal/window semantics — plain jnp, works outside
+            # pallas too), with the current K/V shard's offset
+            from ..ops.flash_attention import _mask_for
+
             kv_index = (q_index - i) % n_shards
-            q_pos = q_index * S_blk + jnp.arange(S_blk)[:, None]
-            k_pos = kv_index * S_blk + jnp.arange(S_blk)[None, :]
-            mask = q_pos >= k_pos
+            mask = _mask_for(0, 0, S_blk, S_blk, causal,
+                             q_index * S_blk, kv_index * S_blk, window)
             mask = jnp.broadcast_to(mask, (B, H, S_blk, S_blk))
         else:
             mask = None
@@ -88,12 +92,13 @@ def _ring_body(q, k, v, axis_name, n_shards, scale, causal, q_index):
     m0 = jnp.full((B, H, S_blk), -jnp.inf, q.dtype)
     l0 = jnp.zeros((B, H, S_blk), q.dtype)
     (k, v, o, m, l), _ = lax.scan(step, (k, v, o0, m0, l0),
-                                  jnp.arange(n_shards))
+                                  jnp.arange(n_steps or n_shards))
     return o / jnp.maximum(l, 1e-20)[..., None]
 
 
 def _ring_body_flash(q, k, v, axis_name, n_shards, scale, causal, q_index,
-                     block_q, block_k, interpret, layout="bhsd"):
+                     block_q, block_k, interpret, layout="bhsd", window=0,
+                     n_steps=None):
     """Ring loop where each shard-pair attention block is the fused
     Pallas flash kernel (ops/flash_attention.py); per-step normalized
     outputs are stream-combined via their log-sum-exps.  The kernel's
@@ -120,7 +125,8 @@ def _ring_body_flash(q, k, v, axis_name, n_shards, scale, causal, q_index,
             q, k_cur, v_cur, causal=causal, scale=scale,
             block_q=block_q, block_k=block_k,
             q_offset=q_index * S_blk, k_offset=kv_index * S_blk,
-            return_lse=True, interpret=interpret, layout=layout)
+            return_lse=True, interpret=interpret, layout=layout,
+            window=window)
         if bshd:
             # lse is (B, H, S); the output rows are (B, S, H)
             lse_b = jnp.moveaxis(lse_b, 1, 2)
@@ -142,14 +148,15 @@ def _ring_body_flash(q, k, v, axis_name, n_shards, scale, causal, q_index,
     m0 = jnp.full(row0, -jnp.inf, jnp.float32)
     l0 = jnp.zeros(row0, jnp.float32)
     (k, v, o, m, l), _ = lax.scan(step, (k, v, o0, m0, l0),
-                                  jnp.arange(n_shards))
+                                  jnp.arange(n_steps or n_shards))
     return (o / jnp.maximum(l, 1e-20)[..., None]).astype(q.dtype)
 
 
 @functools.lru_cache(maxsize=64)
 def _build_ring_run(mesh: Mesh, axis: str, scale: float, causal: bool,
                     impl: str, block_q: int, block_k: int, interpret: bool,
-                    layout: str = "bhsd", batch_axis=None):
+                    layout: str = "bhsd", batch_axis=None, window=0,
+                    n_steps=None):
     """Cached compiled ring-attention program per (mesh, axis, config) —
     jax.jit caches on function identity, so the shard_map must be built
     once per config or every call recompiles."""
@@ -164,17 +171,19 @@ def _build_ring_run(mesh: Mesh, axis: str, scale: float, causal: bool,
             if impl == "flash":
                 return _ring_body_flash(q_s, k_s, v_s, axis, n_shards, scale,
                                         causal, idx, block_q, block_k,
-                                        interpret, layout=layout)
+                                        interpret, layout=layout,
+                                        window=window, n_steps=n_steps)
             if bshd:
                 # dense fallback computes in BHSD; transpose at the
                 # shard boundary (correctness path, not the TPU path)
                 o = _ring_body(q_s.transpose(0, 2, 1, 3),
                                k_s.transpose(0, 2, 1, 3),
                                v_s.transpose(0, 2, 1, 3),
-                               axis, n_shards, scale, causal, idx)
+                               axis, n_shards, scale, causal, idx,
+                               window=window, n_steps=n_steps)
                 return o.transpose(0, 2, 1, 3)
             return _ring_body(q_s, k_s, v_s, axis, n_shards, scale, causal,
-                              idx)
+                              idx, window=window, n_steps=n_steps)
 
         return shard_map(
             shard_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -228,7 +237,7 @@ def _flash_available(layout="bhsd"):
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
                    impl="auto", block_q=512, block_k=512, layout="bhsd",
-                   batch_axis=None):
+                   batch_axis=None, window=0):
     """Sharded multi-head attention over a sequence-parallel mesh axis.
 
     q/k/v: (batch, heads, seq, head_dim) for ``layout="bhsd"`` or
@@ -265,8 +274,22 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
         impl = ("flash" if (not interpret and fits
                             and _flash_available(layout))
                 else "xla")
+    if window < 0:
+        raise ValueError(f"ring_attention: window must be >= 0 "
+                         f"(got {window})")
+    n_steps = None
+    if window and causal:
+        # sliding-window + causal bounds the ring: a K/V shard i steps
+        # back is entirely below the band once (i-1)*S_blk + 1 >= window
+        # (min q-k distance between the shards), so only the diagonal
+        # and ceil((window-1)/S_blk) predecessors can contribute — at
+        # long S with small windows the ring shrinks to neighbor
+        # exchanges (the point of windowed attention over shards)
+        import math
+        n_steps = min(n_shards, 1 + math.ceil((window - 1) / S_blk))
     run = _build_ring_run(mesh, axis, scale, bool(causal), impl,
-                          block_q, block_k, interpret, layout, batch_axis)
+                          block_q, block_k, interpret, layout, batch_axis,
+                          int(window), n_steps)
 
     if not isinstance(q, jax.core.Tracer):
         sharding = NamedSharding(mesh, _ring_spec(layout, axis, batch_axis))

@@ -37,12 +37,16 @@ from jax.sharding import Mesh, NamedSharding
 __all__ = ["ulysses_attention"]
 
 
-def _dense_attention(q, k, v, scale, causal):
+def _dense_attention(q, k, v, scale, causal, window=0):
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-    if causal:
+    if causal or window:
+        # the kernel's band-mask helper is the single source of the
+        # causal/window semantics
+        from ..ops.flash_attention import _mask_for
+
         S = q.shape[2]
-        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
-        s = jnp.where(mask, s, -jnp.inf)
+        s = jnp.where(_mask_for(0, 0, S, S, causal, 0, 0, window),
+                      s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
@@ -51,7 +55,7 @@ def _dense_attention(q, k, v, scale, causal):
 def _build_ulysses_run(mesh: Mesh, axis: str, scale: float, causal: bool,
                        impl: str, block_q: int, block_k: int,
                        interpret: bool, layout: str = "bhsd",
-                       batch_axis=None):
+                       batch_axis=None, window=0):
     """Cached compiled program per (mesh, axis, config) — same caching
     contract as ring_attention's _build_ring_run."""
     from .ring_attention import _ring_spec
@@ -71,19 +75,24 @@ def _build_ulysses_run(mesh: Mesh, axis: str, scale: float, causal: bool,
                                       concat_axis=seq_ax, tiled=True)
 
             qh, kh, vh = to_heads(q_s), to_heads(k_s), to_heads(v_s)
+            # window passes straight through: after the all-to-all
+            # each head group holds the FULL sequence, so the band
+            # mask is the ordinary local one
             if impl == "flash":
                 from ..ops.flash_attention import flash_attention
 
                 oh = flash_attention(qh, kh, vh, causal=causal,
                                      block_q=block_q, block_k=block_k,
-                                     interpret=interpret, layout=layout)
+                                     interpret=interpret, layout=layout,
+                                     window=window)
             elif bshd:
                 oh = _dense_attention(qh.transpose(0, 2, 1, 3),
                                       kh.transpose(0, 2, 1, 3),
                                       vh.transpose(0, 2, 1, 3),
-                                      scale, causal).transpose(0, 2, 1, 3)
+                                      scale, causal,
+                                      window).transpose(0, 2, 1, 3)
             else:
-                oh = _dense_attention(qh, kh, vh, scale, causal)
+                oh = _dense_attention(qh, kh, vh, scale, causal, window)
             # head-sharded -> seq-sharded: split sequence, gather heads
             return lax.all_to_all(oh, axis, split_axis=seq_ax,
                                   concat_axis=head_ax, tiled=True)
@@ -97,7 +106,7 @@ def _build_ulysses_run(mesh: Mesh, axis: str, scale: float, causal: bool,
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
                       impl="auto", block_q=512, block_k=512, layout="bhsd",
-                      batch_axis=None):
+                      batch_axis=None, window=0):
     """All-to-all sequence-parallel multi-head attention.
 
     q/k/v: (batch, heads, seq, head_dim) for ``layout="bhsd"`` or
@@ -131,6 +140,9 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
             f"ulysses_attention: heads ({H}) must be divisible by the "
             f"'{axis}' mesh axis ({n_shards}); use ring_attention for "
             "head counts that do not divide the mesh")
+    if window < 0:
+        raise ValueError(f"ulysses_attention: window must be >= 0 "
+                         f"(got {window})")
     scale = float(1.0 / np.sqrt(q.shape[-1]))
     S = q.shape[seq_axis]
     interpret = not _on_tpu()
@@ -142,7 +154,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
                 else "xla")
     run = _build_ulysses_run(mesh, axis, scale, bool(causal), impl,
                              block_q, block_k, interpret, layout,
-                             batch_axis)
+                             batch_axis, int(window))
 
     if not isinstance(q, jax.core.Tracer):
         sharding = NamedSharding(mesh, _ring_spec(layout, axis, batch_axis))
