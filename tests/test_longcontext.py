@@ -523,12 +523,16 @@ def test_ulysses_attention_bshd_layout():
                 err_msg=f"impl={impl} causal={causal}")
 
 
-@pytest.mark.parametrize("sp_impl,heads,pos_embed", [
-    ("ring", 2, "learned"), ("ulysses", 4, "learned"),
+@pytest.mark.parametrize("sp_impl,heads,pos_embed,window", [
+    ("ring", 2, "learned", 0), ("ulysses", 4, "learned", 0),
     # rope positions must stay GLOBAL under sequence sharding (the
     # iota is computed at full traced length and GSPMD partitions it)
-    ("ring", 2, "rope")])
-def test_sharded_trainer_sequence_parallel_gpt(sp_impl, heads, pos_embed):
+    ("ring", 2, "rope", 0),
+    # sliding window through the symbol-level sp path (band masked
+    # with global positions inside the ring)
+    ("ring", 2, "rope", 12)])
+def test_sharded_trainer_sequence_parallel_gpt(sp_impl, heads, pos_embed,
+                                               window):
     """Symbol-level sequence parallelism end to end: a ShardedTrainer
     over models.gpt with sequence_specs sharding (B, S) tokens across a
     dp x sp mesh routes the FlashAttention ops to the sharded schedule
@@ -544,7 +548,7 @@ def test_sharded_trainer_sequence_parallel_gpt(sp_impl, heads, pos_embed):
     def build(mesh, seq_specs=None):
         net = mx.models.gpt(vocab, seq, num_layers=1, d_model=32,
                             num_heads=heads, attn_sp_impl=sp_impl,
-                            pos_embed=pos_embed)
+                            pos_embed=pos_embed, attn_window=window)
         return mx.parallel.ShardedTrainer(
             net, {"data": (8, seq), "softmax_label": (8, seq)},
             mesh=mesh, batch_axis="dp", sequence_specs=seq_specs,
