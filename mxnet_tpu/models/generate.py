@@ -65,10 +65,11 @@ def gpt_generate(params, prompt, max_new_tokens, num_heads,
         (models.gpt attn_window); 0 = full attention.
       name: the symbol-name prefix used when building the model.
 
-    Grouped-query attention (kv_heads < num_heads) and rotary
-    embeddings (pos_embed="rope") are detected from the checkpoint:
-    the K projection's row count gives kv_heads, and a missing
-    position table means rope.
+    Model variants are detected from the checkpoint itself: the K
+    projection's row count gives kv_heads (grouped-query attention), a
+    missing position table means rope, an ``*_ff_gate_weight`` means a
+    SwiGLU MLP, and a missing ``*_head_weight`` means the LM head is
+    the tied token-embedding matrix.
 
     Returns ``(batch, prompt_len + max_new_tokens)`` numpy int32 ids
     (prompt included).  The compiled decode loop is cached per
@@ -144,15 +145,17 @@ def gpt_generate(params, prompt, max_new_tokens, num_heads,
     if max_new_tokens < 1:
         return np.asarray(prompt, np.int32)
 
+    swiglu = f"{name}_l0_ff_gate_weight" in params
+    tied = f"{name}_head_weight" not in params
     cfg = (name, n_layers, num_heads, head_dim, B, P, max_new_tokens,
            S_cache, float(temperature), top_k, kv_heads, S is None,
-           int(window), str(jnp.asarray(tok_w).dtype))
+           int(window), swiglu, tied, str(jnp.asarray(tok_w).dtype))
     run = _decoder_cache.get(cfg)
     if run is None:
         run = _build_decoder(name, n_layers, num_heads, head_dim, B, P,
                              max_new_tokens, S_cache, float(temperature),
                              top_k, kv_heads=kv_heads, rope=S is None,
-                             window=int(window))
+                             window=int(window), swiglu=swiglu, tied=tied)
         _decoder_cache[cfg] = run
 
     if key is None:
@@ -164,7 +167,7 @@ def gpt_generate(params, prompt, max_new_tokens, num_heads,
 
 def _build_decoder(name, n_layers, num_heads, head_dim, B, P,
                    max_new_tokens, S, temperature, top_k, kv_heads=None,
-                   rope=False, window=0):
+                   rope=False, window=0, swiglu=False, tied=False):
     d_model = num_heads * head_dim
     T = P + max_new_tokens
     kv_heads = kv_heads or num_heads
@@ -219,14 +222,27 @@ def _build_decoder(name, n_layers, num_heads, head_dim, B, P,
             x = x + _fc(attn.reshape(B, d_model),
                         params[f"{p}_proj_weight"], params[f"{p}_proj_bias"])
             h2 = _ln(x, params[f"{p}_ln2_gamma"], params[f"{p}_ln2_beta"])
-            up = _gelu(_fc(h2, params[f"{p}_ff_up_weight"],
-                           params[f"{p}_ff_up_bias"]))
+            if swiglu:
+                g = _fc(h2, params[f"{p}_ff_gate_weight"],
+                        params[f"{p}_ff_gate_bias"])
+                up = (g * jax.nn.sigmoid(g.astype(jnp.float32))
+                      .astype(g.dtype)
+                      * _fc(h2, params[f"{p}_ff_up_weight"],
+                            params[f"{p}_ff_up_bias"]))
+            else:
+                up = _gelu(_fc(h2, params[f"{p}_ff_up_weight"],
+                               params[f"{p}_ff_up_bias"]))
             x = x + _fc(up, params[f"{p}_ff_down_weight"],
                         params[f"{p}_ff_down_bias"])
         final = _ln(x, params[f"{name}_ln_f_gamma"],
                     params[f"{name}_ln_f_beta"])
-        logits = _fc(final, params[f"{name}_head_weight"],
-                     params[f"{name}_head_bias"])
+        if tied:
+            # tied checkpoint: the LM head is the embedding matrix
+            logits = final @ params[f"{name}_tok_embed_weight"].T.astype(
+                final.dtype)
+        else:
+            logits = _fc(final, params[f"{name}_head_weight"],
+                         params[f"{name}_head_bias"])
         return logits, cache_k, cache_v
 
     def sample(logits, key):

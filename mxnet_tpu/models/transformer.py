@@ -20,7 +20,7 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
         d_ff=None, dropout=0.0, causal=True, remat=False, fused_qkv=False,
         attn_layout="bhsd", attn_impl="auto", attn_sp_impl="ring",
         kv_heads=None, attn_window=0, pos_embed="learned", loss="softmax",
-        name="gpt"):
+        mlp="gelu", tie_embeddings=False, name="gpt"):
     """Symbol computing next-token softmax loss.
 
     Inputs: ``data`` (batch, seq_len) token ids; ``softmax_label``
@@ -62,6 +62,13 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
     (B*S, vocab) probability tensor, gigabytes of HBM at transformer
     vocabularies).
 
+    ``mlp``: "gelu" (GPT-2-style up/GELU/down) or "swiglu"
+    (llama-style gated MLP: silu(gate) * up -> down; pass a ~2/3-scaled
+    ``d_ff`` to hold parameter count).  ``tie_embeddings=True`` shares
+    the token-embedding matrix with the LM head (same named variable —
+    the executor accumulates both gradient paths; no separate
+    ``*_head_weight`` in the checkpoint).
+
     ``pos_embed``: "learned" (reference-style additive table) or
     "rope" (rotary embeddings applied to Q/K per layer — relative
     positions, the long-context standard; no position table in the
@@ -96,6 +103,8 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
         raise ValueError(f"pos_embed must be learned|rope, got {pos_embed}")
     if loss not in ("softmax", "ce"):
         raise ValueError(f"loss must be softmax|ce, got {loss}")
+    if mlp not in ("gelu", "swiglu"):
+        raise ValueError(f"mlp must be gelu|swiglu, got {mlp}")
     if pos_embed == "rope" and head_dim % 2:
         raise ValueError("rope needs an even head_dim")
     data = sym.Variable("data")
@@ -166,8 +175,13 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
             ln2 = sym.LayerNorm(h, name=f"{p}_ln2")
             flat2 = sym.Reshape(ln2, shape=(-1, d_model))
             up = sym.FullyConnected(flat2, name=f"{p}_ff_up",
-                                    num_hidden=d_ff)
-            act = sym.gelu(up)
+                                     num_hidden=d_ff)
+            if mlp == "swiglu":
+                gate = sym.FullyConnected(flat2, name=f"{p}_ff_gate",
+                                          num_hidden=d_ff)
+                act = gate * sym.sigmoid(gate) * up      # silu(g) * up
+            else:
+                act = sym.gelu(up)
             down = sym.FullyConnected(act, name=f"{p}_ff_down",
                                       num_hidden=d_model)
             if dropout > 0:
@@ -175,8 +189,17 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
             h = h + sym.Reshape(down, shape=(-1, seq_len, d_model))
 
     final = sym.LayerNorm(h, name=f"{name}_ln_f")
-    logits = sym.FullyConnected(sym.Reshape(final, shape=(-1, d_model)),
-                                name=f"{name}_head", num_hidden=vocab_size)
+    final_flat = sym.Reshape(final, shape=(-1, d_model))
+    if tie_embeddings:
+        # same named variable as the Embedding: the executor binds one
+        # array and sums both ops' gradient contributions
+        tok_w = sym.Variable(f"{name}_tok_embed_weight")
+        logits = sym.FullyConnected(final_flat, weight=tok_w,
+                                    name=f"{name}_head",
+                                    num_hidden=vocab_size, no_bias=True)
+    else:
+        logits = sym.FullyConnected(final_flat, name=f"{name}_head",
+                                    num_hidden=vocab_size)
     label = sym.Variable("softmax_label")        # (batch, seq_len)
     label_flat = sym.Reshape(label, shape=(-1,))
     if loss == "ce":

@@ -217,6 +217,9 @@ def test_generate_accepts_quantized_checkpoint():
     {"pos_embed": "rope"},
     {"kv_heads": 1, "pos_embed": "rope", "fused_qkv": True},
     {"attn_window": 6},
+    {"mlp": "swiglu"},
+    {"tie_embeddings": True},
+    {"mlp": "swiglu", "tie_embeddings": True, "pos_embed": "rope"},
 ])
 def test_greedy_matches_full_graph_variants(opts):
     """KV-cache decode reproduces the training graph's argmax for the

@@ -1081,3 +1081,45 @@ def test_ulysses_attention_windowed():
                                 window=W)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-4, err_msg=impl)
+
+
+def test_gpt_tied_embeddings_gradients():
+    """tie_embeddings=True: one named array serves Embedding AND LM
+    head; its gradient must be the SUM of both paths (checked against
+    the untied model's embed-grad + head-grad with identical params)."""
+    vocab, seq = 17, 8
+    rng = np.random.RandomState(26)
+    fx = rng.randint(0, vocab, (2, seq)).astype(np.float32)
+    fy = rng.randint(0, vocab, (2, seq)).astype(np.float32)
+    w_embed = rng.normal(0, 0.1, (vocab, 16)).astype(np.float32)
+
+    def run(tied):
+        net = mx.models.gpt(vocab, seq, num_layers=1, d_model=16,
+                            num_heads=2, tie_embeddings=tied)
+        exe = net.simple_bind(mx.cpu(0), grad_req="write",
+                              data=(2, seq), softmax_label=(2, seq))
+        prng = np.random.RandomState(4)
+        for name, arr in exe.arg_dict.items():
+            if name == "data":
+                arr[:] = fx
+            elif name == "softmax_label":
+                arr[:] = fy
+            elif name == "gpt_tok_embed_weight":
+                arr[:] = w_embed
+            elif name == "gpt_head_weight":
+                arr[:] = w_embed          # untied twin starts tied
+            elif name == "gpt_head_bias":
+                arr[:] = 0.0
+            else:
+                arr[:] = prng.normal(0, 0.1, arr.shape)
+        outs = exe.forward(is_train=True)
+        exe.backward([mx.nd.ones(o.shape) for o in outs])
+        return {k: np.asarray(g.asnumpy())
+                for k, g in exe.grad_dict.items() if g is not None}
+
+    g_tied = run(True)
+    g_untied = run(False)
+    np.testing.assert_allclose(
+        g_tied["gpt_tok_embed_weight"],
+        g_untied["gpt_tok_embed_weight"] + g_untied["gpt_head_weight"],
+        atol=1e-5, rtol=1e-4)
