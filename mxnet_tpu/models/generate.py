@@ -29,6 +29,10 @@ _decoder_cache = {}
 
 def _ln(x, gamma, beta, eps=1e-5):
     xf = x.astype(jnp.float32)
+    if beta is None:          # rmsnorm checkpoint: no shift, no centering
+        ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        return (xf * jax.lax.rsqrt(ms + eps)
+                * gamma.astype(jnp.float32)).astype(x.dtype)
     mean = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
     y = (xf - mean) * jax.lax.rsqrt(var + eps)
@@ -147,15 +151,18 @@ def gpt_generate(params, prompt, max_new_tokens, num_heads,
 
     swiglu = f"{name}_l0_ff_gate_weight" in params
     tied = f"{name}_head_weight" not in params
+    rmsnorm = f"{name}_l0_ln1_beta" not in params
     cfg = (name, n_layers, num_heads, head_dim, B, P, max_new_tokens,
            S_cache, float(temperature), top_k, kv_heads, S is None,
-           int(window), swiglu, tied, str(jnp.asarray(tok_w).dtype))
+           int(window), swiglu, tied, rmsnorm,
+           str(jnp.asarray(tok_w).dtype))
     run = _decoder_cache.get(cfg)
     if run is None:
         run = _build_decoder(name, n_layers, num_heads, head_dim, B, P,
                              max_new_tokens, S_cache, float(temperature),
                              top_k, kv_heads=kv_heads, rope=S is None,
-                             window=int(window), swiglu=swiglu, tied=tied)
+                             window=int(window), swiglu=swiglu, tied=tied,
+                             rmsnorm=rmsnorm)
         _decoder_cache[cfg] = run
 
     if key is None:
@@ -167,7 +174,8 @@ def gpt_generate(params, prompt, max_new_tokens, num_heads,
 
 def _build_decoder(name, n_layers, num_heads, head_dim, B, P,
                    max_new_tokens, S, temperature, top_k, kv_heads=None,
-                   rope=False, window=0, swiglu=False, tied=False):
+                   rope=False, window=0, swiglu=False, tied=False,
+                   rmsnorm=False):
     d_model = num_heads * head_dim
     T = P + max_new_tokens
     kv_heads = kv_heads or num_heads
@@ -198,7 +206,8 @@ def _build_decoder(name, n_layers, num_heads, head_dim, B, P,
                                        jnp.arange(S) > t - window)
         for i in range(n_layers):
             p = f"{name}_l{i}"
-            h = _ln(x, params[f"{p}_ln1_gamma"], params[f"{p}_ln1_beta"])
+            h = _ln(x, params[f"{p}_ln1_gamma"],
+                    None if rmsnorm else params[f"{p}_ln1_beta"])
             q = _fc(h, params[f"{p}_q_weight"], params[f"{p}_q_bias"])
             k = _fc(h, params[f"{p}_k_weight"], params[f"{p}_k_bias"])
             v = _fc(h, params[f"{p}_v_weight"], params[f"{p}_v_bias"])
@@ -221,12 +230,13 @@ def _build_decoder(name, n_layers, num_heads, head_dim, B, P,
                               cache_v[i])
             x = x + _fc(attn.reshape(B, d_model),
                         params[f"{p}_proj_weight"], params[f"{p}_proj_bias"])
-            h2 = _ln(x, params[f"{p}_ln2_gamma"], params[f"{p}_ln2_beta"])
+            h2 = _ln(x, params[f"{p}_ln2_gamma"],
+                     None if rmsnorm else params[f"{p}_ln2_beta"])
             if swiglu:
                 g = _fc(h2, params[f"{p}_ff_gate_weight"],
                         params[f"{p}_ff_gate_bias"])
-                up = (g * jax.nn.sigmoid(g.astype(jnp.float32))
-                      .astype(g.dtype)
+                gf = g.astype(jnp.float32)       # f32 silu == sym.silu
+                up = ((gf * jax.nn.sigmoid(gf)).astype(g.dtype)
                       * _fc(h2, params[f"{p}_ff_up_weight"],
                             params[f"{p}_ff_up_bias"]))
             else:
@@ -235,7 +245,7 @@ def _build_decoder(name, n_layers, num_heads, head_dim, B, P,
             x = x + _fc(up, params[f"{p}_ff_down_weight"],
                         params[f"{p}_ff_down_bias"])
         final = _ln(x, params[f"{name}_ln_f_gamma"],
-                    params[f"{name}_ln_f_beta"])
+                    None if rmsnorm else params[f"{name}_ln_f_beta"])
         if tied:
             # tied checkpoint: the LM head is the embedding matrix
             logits = final @ params[f"{name}_tok_embed_weight"].T.astype(

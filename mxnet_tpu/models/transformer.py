@@ -20,7 +20,7 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
         d_ff=None, dropout=0.0, causal=True, remat=False, fused_qkv=False,
         attn_layout="bhsd", attn_impl="auto", attn_sp_impl="ring",
         kv_heads=None, attn_window=0, pos_embed="learned", loss="softmax",
-        mlp="gelu", tie_embeddings=False, name="gpt"):
+        mlp="gelu", tie_embeddings=False, norm="layernorm", name="gpt"):
     """Symbol computing next-token softmax loss.
 
     Inputs: ``data`` (batch, seq_len) token ids; ``softmax_label``
@@ -61,6 +61,9 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
     output is the (B*S,) per-position NLL; skips materializing the
     (B*S, vocab) probability tensor, gigabytes of HBM at transformer
     vocabularies).
+
+    ``norm``: "layernorm" (GPT-2-style) or "rmsnorm" (llama-style —
+    no mean subtraction or shift; ``*_gamma`` only in the checkpoint).
 
     ``mlp``: "gelu" (GPT-2-style up/GELU/down) or "swiglu"
     (llama-style gated MLP: silu(gate) * up -> down; pass a ~2/3-scaled
@@ -105,6 +108,13 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
         raise ValueError(f"loss must be softmax|ce, got {loss}")
     if mlp not in ("gelu", "swiglu"):
         raise ValueError(f"mlp must be gelu|swiglu, got {mlp}")
+    if norm not in ("layernorm", "rmsnorm"):
+        raise ValueError(f"norm must be layernorm|rmsnorm, got {norm}")
+
+    def norm_layer(x, nm):
+        if norm == "rmsnorm":
+            return sym.RMSNorm(x, name=nm)
+        return sym.LayerNorm(x, name=nm)
     if pos_embed == "rope" and head_dim % 2:
         raise ValueError("rope needs an even head_dim")
     data = sym.Variable("data")
@@ -121,7 +131,7 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
         p = f"{name}_l{i}"
         with layer_scope(i):
             # -- attention block (pre-LN) -------------------------------
-            ln1 = sym.LayerNorm(h, name=f"{p}_ln1")
+            ln1 = norm_layer(h, f"{p}_ln1")
             flat = sym.Reshape(ln1, shape=(-1, d_model))
             if fused_qkv:
                 qkv = sym.FullyConnected(flat, name=f"{p}_qkv",
@@ -172,14 +182,14 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
             h = h + sym.Reshape(proj, shape=(-1, seq_len, d_model))
 
             # -- MLP block (pre-LN) -------------------------------------
-            ln2 = sym.LayerNorm(h, name=f"{p}_ln2")
+            ln2 = norm_layer(h, f"{p}_ln2")
             flat2 = sym.Reshape(ln2, shape=(-1, d_model))
             up = sym.FullyConnected(flat2, name=f"{p}_ff_up",
                                      num_hidden=d_ff)
             if mlp == "swiglu":
                 gate = sym.FullyConnected(flat2, name=f"{p}_ff_gate",
                                           num_hidden=d_ff)
-                act = gate * sym.sigmoid(gate) * up      # silu(g) * up
+                act = sym.silu(gate) * up       # f32 silu, like gelu
             else:
                 act = sym.gelu(up)
             down = sym.FullyConnected(act, name=f"{p}_ff_down",
@@ -188,7 +198,7 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
                 down = sym.Dropout(down, p=dropout)
             h = h + sym.Reshape(down, shape=(-1, seq_len, d_model))
 
-    final = sym.LayerNorm(h, name=f"{name}_ln_f")
+    final = norm_layer(h, f"{name}_ln_f")
     final_flat = sym.Reshape(final, shape=(-1, d_model))
     if tie_embeddings:
         # same named variable as the Embedding: the executor binds one

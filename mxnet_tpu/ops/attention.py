@@ -87,11 +87,55 @@ class LayerNormOp(OpDef):
         return [y.astype(x.dtype)], []
 
 
+class RMSNormParam(Params):
+    axis = field(int, default=-1)
+    eps = field(float, default=1e-5)
+
+
+@register_op("RMSNorm", aliases=("rmsnorm",))
+class RMSNormOp(OpDef):
+    """Root-mean-square normalization (llama-style LayerNorm without
+    the mean subtraction or shift): y = x / rms(x) * gamma.  Stats in
+    f32 like LayerNorm; XLA fuses it into its neighbors."""
+
+    param_cls = RMSNormParam
+
+    def list_arguments(self, params):
+        return ["data", "gamma"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            raise ValueError("RMSNorm: data shape unknown")
+        c = (d[params.axis % len(d)],)
+        return [tuple(d), c], [tuple(d)], []
+
+    def forward(self, params, inputs, aux, train, key):
+        x, gamma = inputs
+        axis = params.axis % x.ndim
+        xf = x.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        y = xf * jax.lax.rsqrt(ms + params.eps) \
+            * gamma.astype(jnp.float32).reshape(shape)
+        return [y.astype(x.dtype)], []
+
+
 register_simple_op(
     "gelu",
     lambda x: (0.5 * x.astype(jnp.float32)
                * (1.0 + jax.lax.erf(x.astype(jnp.float32)
                                     / np.sqrt(2.0)))).astype(x.dtype),
+    nin=1)
+
+# f32-activation convention like gelu: bf16 models must compute the
+# swiglu gate identically in the training graph and the KV-cache
+# decoder or near-tie logits round differently between them
+register_simple_op(
+    "silu",
+    lambda x: (x.astype(jnp.float32)
+               * jax.nn.sigmoid(x.astype(jnp.float32))).astype(x.dtype),
     nin=1)
 
 

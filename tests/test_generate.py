@@ -220,6 +220,10 @@ def test_generate_accepts_quantized_checkpoint():
     {"mlp": "swiglu"},
     {"tie_embeddings": True},
     {"mlp": "swiglu", "tie_embeddings": True, "pos_embed": "rope"},
+    {"norm": "rmsnorm"},
+    # the full llama-style configuration
+    {"norm": "rmsnorm", "mlp": "swiglu", "tie_embeddings": True,
+     "pos_embed": "rope", "kv_heads": 1},
 ])
 def test_greedy_matches_full_graph_variants(opts):
     """KV-cache decode reproduces the training graph's argmax for the
