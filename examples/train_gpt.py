@@ -32,6 +32,15 @@ def synthetic_corpus(n_tokens, vocab, seed=0):
     return toks
 
 
+def _nll(out0, y, ce_loss):
+    """Mean next-token NLL from the head's output: per-position losses
+    (loss='ce') or softmax probabilities (default head)."""
+    if ce_loss:
+        return float(np.mean(out0))
+    return float(-np.log(out0[np.arange(len(out0)),
+                              y.reshape(-1).astype(int)] + 1e-9).mean())
+
+
 def batches(tokens, batch_size, seq_len, rng):
     starts = rng.randint(0, len(tokens) - seq_len - 1, batch_size)
     x = np.stack([tokens[s:s + seq_len] for s in starts])
@@ -67,6 +76,12 @@ def main():
                         "learned table")
     p.add_argument("--window", type=int, default=0,
                    help="sliding-window attention radius (0 = full)")
+    p.add_argument("--llama-style", action="store_true",
+                   help="rmsnorm + swiglu + rope + tied embeddings "
+                        "(the modern decoder recipe) in one flag")
+    p.add_argument("--ce-loss", action="store_true",
+                   help="fused cross-entropy head (no (B*S, vocab) "
+                        "probability tensor)")
     p.add_argument("--generate", type=int, default=0, metavar="N",
                    help="after training, KV-cache-decode N tokens from a "
                         "corpus prompt (models/generate.py)")
@@ -96,8 +111,13 @@ def main():
                         d_model=args.d_model, num_heads=args.num_heads,
                         attn_layout=args.attn_layout,
                         kv_heads=args.kv_heads or None,
-                        pos_embed="rope" if args.rope else "learned",
-                        attn_window=args.window)
+                        pos_embed=("rope" if (args.rope or args.llama_style)
+                                   else "learned"),
+                        attn_window=args.window,
+                        norm="rmsnorm" if args.llama_style else "layernorm",
+                        mlp="swiglu" if args.llama_style else "gelu",
+                        tie_embeddings=args.llama_style,
+                        loss="ce" if args.ce_loss else "softmax")
 
     if args.trainer == "sharded":
         mesh = mx.parallel.local_mesh("dp")
@@ -112,9 +132,8 @@ def main():
             x, y = batches(tokens, args.batch_size, args.seq_len, rng)
             outs = tr.step({"data": x, "softmax_label": y})
             if step % 20 == 0 or step == args.steps - 1:
-                probs = np.asarray(outs[0])
-                nll = -np.log(probs[np.arange(len(probs)),
-                                    y.reshape(-1).astype(int)] + 1e-9).mean()
+                out0 = np.asarray(outs[0])
+                nll = _nll(out0, y, args.ce_loss)
                 logging.info("step %d nll %.4f (uniform %.4f)", step, nll,
                              np.log(args.vocab))
     else:
@@ -132,9 +151,8 @@ def main():
             mod.backward()
             mod.update()
             if step % 20 == 0 or step == args.steps - 1:
-                probs = mod.get_outputs()[0].asnumpy()
-                nll = -np.log(probs[np.arange(len(probs)),
-                                    y.reshape(-1).astype(int)] + 1e-9).mean()
+                out0 = mod.get_outputs()[0].asnumpy()
+                nll = _nll(out0, y, args.ce_loss)
                 logging.info("step %d nll %.4f (uniform %.4f)", step, nll,
                              np.log(args.vocab))
     print(f"gpt final nll {nll:.4f} vs uniform {np.log(args.vocab):.4f}")
