@@ -495,13 +495,17 @@ def bench_gpt(jax, np, mx, on_tpu, n_chips):
     kv_heads = int(os.environ.get("BENCH_KV_HEADS", "0")) or None
     # fused CE head: skips the (B*S, vocab) probability materialization
     loss = os.environ.get("BENCH_GPT_LOSS", "softmax")
+    # the llama-style recipe in one knob: rmsnorm + swiglu + rope + tied
+    style = os.environ.get("BENCH_GPT_STYLE", "gpt2")
+    style_kw = ({"norm": "rmsnorm", "mlp": "swiglu", "pos_embed": "rope",
+                 "tie_embeddings": True} if style == "llama" else {})
     # multi-chip dp keeps the fused kernel too: ShardedTrainer sets the
     # ambient-mesh context and the FlashAttention op shard_maps its
     # Mosaic call over the batch axis (ops/attention.py spmd_attention)
     net = mx.models.gpt(vocab, seq_len, num_layers=n_layers,
                         d_model=d_model, num_heads=n_heads,
                         fused_qkv=fused_qkv, attn_layout=attn_layout,
-                        kv_heads=kv_heads, loss=loss)
+                        kv_heads=kv_heads, loss=loss, **style_kw)
     _train_throughput(
         jax, np, mx, net,
         input_shapes={"data": (batch, seq_len),
@@ -513,7 +517,8 @@ def bench_gpt(jax, np, mx, on_tpu, n_chips):
         extra_fields={"batch": batch, "seq_len": seq_len,
                       "d_model": d_model, "n_layers": n_layers,
                       "fused_qkv": fused_qkv, "attn_layout": attn_layout,
-                      "kv_heads": kv_heads or n_heads, "loss": loss},
+                      "kv_heads": kv_heads or n_heads, "loss": loss,
+                      "style": style},
         a100_baseline=True,
         optimizer="adam", optimizer_params={"learning_rate": 3e-4},
         initializer=mx.initializer.Xavier(),

@@ -100,6 +100,11 @@ def main():
         # fused CE head: no (B*S, 32768) probability tensor in HBM
         yield ({"BENCH_MODEL": "gpt", "BENCH_BATCH": "16",
                 "BENCH_FUSED_QKV": "1", "BENCH_GPT_LOSS": "ce"}, False)
+        # the full modern recipe: llama style + GQA + CE + bshd
+        yield ({"BENCH_MODEL": "gpt", "BENCH_BATCH": "16",
+                "BENCH_FUSED_QKV": "1", "BENCH_ATTN_LAYOUT": "bshd",
+                "BENCH_KV_HEADS": "2", "BENCH_GPT_LOSS": "ce",
+                "BENCH_GPT_STYLE": "llama"}, False)
         for bs in ("256", "512", "1024"):
             yield ({"BENCH_MODEL": "cifar", "BENCH_BATCH": bs},
                    bs == "512")
