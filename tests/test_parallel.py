@@ -599,3 +599,51 @@ def test_fsdp_checkpoint_reshard_roundtrip(tmp_path):
     for k, v in fsdp.get_params().items():
         np.testing.assert_allclose(fsdp2.get_params()[k], v, atol=1e-5,
                                    err_msg=k)
+
+
+def test_fsdp_llama_gpt_tied_parity():
+    """ZeRO-3 over the llama-style GPT: the TIED embedding matrix (one
+    named array used by Embedding and the LM head) shards over dp and
+    the two-step training math still matches the replicated trainer
+    exactly — the all-gather/reduce-scatter schedule must reassemble
+    the shared weight for BOTH uses and sum both gradient paths."""
+    devices = jax.devices()[:4]
+    mesh = mx.parallel.make_mesh({"dp": 4}, devices=devices)
+    vocab, seq = 37, 8
+
+    def net():
+        return mx.models.gpt(vocab, seq, num_layers=1, d_model=32,
+                             num_heads=2, kv_heads=1, pos_embed="rope",
+                             norm="rmsnorm", mlp="swiglu",
+                             tie_embeddings=True, loss="ce")
+
+    shapes = {"data": (8, seq), "softmax_label": (8, seq)}
+    lr = 0.1
+
+    def build(fsdp):
+        mx.random.seed(11)
+        return mx.parallel.ShardedTrainer(
+            net(), shapes, mesh=mesh, batch_axis="dp",
+            optimizer="sgd", optimizer_params={"learning_rate": lr},
+            initializer=mx.initializer.Xavier(),
+            input_dtypes={"data": np.int32, "softmax_label": np.float32},
+            fsdp=fsdp, fsdp_min_size=256)
+
+    fsdp = build(True)
+    assert "dp" in tuple(fsdp.param_shardings["gpt_tok_embed_weight"].spec)
+    ref = build(False)
+    ref.set_params(fsdp.get_params())
+    key = np.asarray(jax.device_get(fsdp._key))
+    ref._key = jax.device_put(key, ref._replicated)
+
+    rng = np.random.RandomState(1)
+    feed = {"data": rng.randint(0, vocab, (8, seq)),
+            "softmax_label": rng.randint(0, vocab, (8, seq)).astype(
+                np.float32)}
+    for _ in range(2):
+        jax.block_until_ready(fsdp.step(feed))
+        jax.block_until_ready(ref.step(feed))
+    pf, pr = fsdp.get_params(), ref.get_params()
+    for k in pf:
+        np.testing.assert_allclose(pf[k], pr[k], atol=5e-5, rtol=2e-4,
+                                   err_msg=k)
