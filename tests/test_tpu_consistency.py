@@ -118,6 +118,20 @@ def _():
     shp = (2, 16, 2, 8)
     return net, {"q": shp, "k": shp, "v": shp}, {}
 
+@case("llama_gpt_step")
+def _():
+    # the whole round-4 stack in one case: rmsnorm + swiglu + rope +
+    # tied embeddings + GQA + windowed flash attention + fused CE head
+    net = mx.models.gpt(13, 8, num_layers=1, d_model=16, num_heads=2,
+                        kv_heads=1, attn_window=4, pos_embed="rope",
+                        norm="rmsnorm", mlp="swiglu", tie_embeddings=True,
+                        loss="ce")
+    return net, {"data": (2, 8), "softmax_label": (2, 8)}, {}, {
+        "data": lambda rng, shape: rng.randint(0, 13, shape)
+        .astype(np.float32),
+        "softmax_label": lambda rng, shape: rng.randint(0, 13, shape)
+        .astype(np.float32)}
+
 @case("layernorm_gelu")
 def _():
     data = mx.sym.Variable("data")
@@ -332,6 +346,7 @@ def _run(case, tpu):
                                   "flash_attention_causal",
                                   "flash_attention_window_gqa",
                                   "rope_gpt_block",
+                                  "llama_gpt_step",
                                   "layernorm_gelu",
                                   "rnn_lstm_pallas", "rnn_gru_pallas",
                                   "deconv", "lrn_leaky",
