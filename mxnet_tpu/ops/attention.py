@@ -210,8 +210,9 @@ class FlashAttentionOp(OpDef):
                     # grouped-query K/V under sequence parallelism:
                     # validate for a clean error here; ring streams the
                     # REDUCED K/V shards natively (bshd — bhsd expands
-                    # inside the kernel call), ulysses expands at entry
-                    # (its all-to-alls re-shard the head axis)
+                    # inside the kernel call), ulysses keeps K/V native
+                    # when kv heads divide the sp axis and expands at
+                    # entry otherwise
                     from .flash_attention import gqa_group
                     gqa_group(q.shape[h_ax], k.shape[h_ax])
                 if params.sp_impl == "ulysses":

@@ -75,6 +75,13 @@ def _build_ulysses_run(mesh: Mesh, axis: str, scale: float, causal: bool,
                                       concat_axis=seq_ax, tiled=True)
 
             qh, kh, vh = to_heads(q_s), to_heads(k_s), to_heads(v_s)
+            if kh.shape[head_ax] != qh.shape[head_ax] and impl != "flash":
+                # native-GQA shards reach the dense body with fewer kv
+                # heads per group; the kernel groups natively but the
+                # einsum needs equal head counts — expand per shard
+                rep = qh.shape[head_ax] // kh.shape[head_ax]
+                kh = jnp.repeat(kh, rep, axis=head_ax)
+                vh = jnp.repeat(vh, rep, axis=head_ax)
             # window passes straight through: after the all-to-all
             # each head group holds the FULL sequence, so the band
             # mask is the ordinary local one
@@ -129,12 +136,16 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False,
     n_shards = mesh.shape[axis]
     H = q.shape[head_axis]
     if k.shape[head_axis] != H:
-        # grouped-query k/v: the all-to-alls re-shard the HEAD axis, so
-        # expand to full heads first (ring_attention keeps GQA native)
+        # grouped-query k/v: the all-to-alls re-shard the HEAD axis.
+        # When the kv heads ALSO divide the mesh axis the K/V
+        # all-to-alls simply split the reduced axis — GQA stays native
+        # (each head group attends with Hkv/sp shared K/V heads in the
+        # kernel).  Otherwise expand to full heads first.
         from ..ops.flash_attention import gqa_group
         rep = gqa_group(H, k.shape[head_axis])
-        k = jnp.repeat(k, rep, axis=head_axis)
-        v = jnp.repeat(v, rep, axis=head_axis)
+        if k.shape[head_axis] % n_shards:
+            k = jnp.repeat(k, rep, axis=head_axis)
+            v = jnp.repeat(v, rep, axis=head_axis)
     if H % n_shards != 0:
         raise ValueError(
             f"ulysses_attention: heads ({H}) must be divisible by the "

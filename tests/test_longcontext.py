@@ -978,9 +978,9 @@ def test_ring_attention_gqa_native(layout):
 
 
 def test_ulysses_attention_gqa_expands():
-    """Ulysses must expand GQA K/V (its all-to-alls re-shard the head
-    axis) — parity vs the dense reference, plus the clean error for a
-    non-multiple head count."""
+    """Ulysses GQA: when kv heads do NOT divide the sp axis the K/V
+    expand before the all-to-alls; parity vs the dense reference, plus
+    the clean error for a non-multiple head count."""
     from mxnet_tpu.parallel.ulysses import ulysses_attention
 
     rng = np.random.RandomState(22)
@@ -998,6 +998,51 @@ def test_ulysses_attention_gqa_expands():
     with pytest.raises(ValueError, match="multiple"):
         ulysses_attention(q, k[:, :1][:, [0, 0, 0]], v[:, :1][:, [0, 0, 0]],
                           mesh, axis="sp", causal=True, impl="xla")
+
+
+def test_ulysses_attention_gqa_native():
+    """kv_heads % sp == 0: the K/V all-to-alls split the REDUCED head
+    axis and the kernel runs GQA natively per head group — parity vs
+    the expanded dense reference, both impls."""
+    from mxnet_tpu.parallel.ulysses import ulysses_attention
+
+    rng = np.random.RandomState(28)
+    # Hkv/sp = 2 kv heads per group vs 4 q heads: einsum cannot
+    # broadcast this — the per-shard expansion in the dense body is
+    # genuinely exercised (flash groups natively)
+    B, H, Hkv, S, D = 1, 8, 4, 32, 16
+    mesh = mx.parallel.make_mesh({"sp": 2})
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.float32)
+    kx = jnp.repeat(k, 2, axis=2).transpose(0, 2, 1, 3)
+    vx = jnp.repeat(v, 2, axis=2).transpose(0, 2, 1, 3)
+    ref = attention_reference(q.transpose(0, 2, 1, 3), kx, vx,
+                              causal=True).transpose(0, 2, 1, 3)
+    for impl in ("xla", "flash"):
+        out = ulysses_attention(q, k, v, mesh, axis="sp", causal=True,
+                                impl=impl, block_q=16, block_k=16,
+                                layout="bshd")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-4, err_msg=impl)
+
+    # the bhsd dense branch and window x native-GQA composition
+    qb, kb, vb = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    W = 12
+    sw = jnp.einsum("bhqd,bhkd->bhqk", qb.repeat(1, axis=1),
+                    jnp.repeat(kb, 2, axis=1)) / np.sqrt(D)
+    pq, pk = jnp.arange(S)[:, None], jnp.arange(S)[None, :]
+    keep = jnp.logical_and(pq >= pk, pq - pk < W)
+    sw = jnp.where(keep, sw, -jnp.inf)
+    ref_w = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(sw, axis=-1),
+                       jnp.repeat(vb, 2, axis=1))
+    for impl in ("xla", "flash"):
+        out = ulysses_attention(qb, kb, vb, mesh, axis="sp", causal=True,
+                                impl=impl, block_q=16, block_k=16,
+                                layout="bhsd", window=W)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_w),
+                                   atol=2e-5, rtol=2e-4,
+                                   err_msg=f"bhsd:{impl}")
 
 
 def test_gpt_fused_ce_loss_parity():
