@@ -534,3 +534,45 @@ def test_shared_module_failed_bind_leaves_module_unbound():
     mod.bind([("data", (8, 10))], [("softmax_label", (8,))])
     mod.init_params(mx.initializer.Xavier())
     assert mod.binded
+
+
+def test_bucketing_gpt_rope():
+    """Variable-context GPT through BucketingModule: with
+    pos_embed='rope' every parameter is bucket-length-independent (a
+    learned position table would be per-bucket-shaped and unshareable),
+    so buckets 8 and 16 share ALL weights — the transformer form of the
+    reference's bucketing LM."""
+    rng = np.random.RandomState(5)
+    vocab = 19
+
+    def sym_gen(seq_len):
+        net = mx.models.gpt(vocab, seq_len, num_layers=1, d_model=16,
+                            num_heads=2, pos_embed="rope",
+                            tie_embeddings=True)
+        return net, ["data"], ["softmax_label"]
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=16,
+                                 context=mx.cpu())
+    from mxnet_tpu.io import DataDesc
+
+    mod.bind([DataDesc("data", (4, 16))],
+             [DataDesc("softmax_label", (4, 16))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=None,
+                       optimizer_params={"learning_rate": 0.05})
+    for key in (16, 8, 16, 8):
+        batch = DataBatch(
+            [mx.nd.array(rng.randint(0, vocab, (4, key)))],
+            [mx.nd.array(rng.randint(0, vocab, (4, key)))],
+            bucket_key=key,
+            provide_data=[DataDesc("data", (4, key))],
+            provide_label=[DataDesc("softmax_label", (4, key))])
+        mod.forward(batch, is_train=True)
+        assert mod.get_outputs()[0].shape == (4 * key, vocab)
+        mod.backward()
+        mod.update()
+    w16 = mod._buckets[16]._exec_group.execs[0] \
+        .arg_dict["gpt_tok_embed_weight"].asnumpy()
+    w8 = mod._buckets[8]._exec_group.execs[0] \
+        .arg_dict["gpt_tok_embed_weight"].asnumpy()
+    np.testing.assert_allclose(w16, w8, atol=1e-6)
