@@ -165,9 +165,8 @@ def main():
         prompt_len = min(8, args.seq_len - args.generate)
         prompt = tokens[:prompt_len][None]
         out = mx.models.gpt_generate(params, prompt, args.generate,
-                                     num_heads=args.num_heads,
                                      temperature=args.temperature,
-                                     window=args.window)
+                                     symbol=net)
         cont = out[0, prompt_len:]
         if args.data and os.path.exists(args.data):
             inv = {i: c for c, i in lut.items()}

@@ -17,12 +17,14 @@ a parity test against the training graph in
 
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["gpt_generate"]
+__all__ = ["gpt_generate", "gpt_decode_config"]
 
 _decoder_cache = {}
 
@@ -49,9 +51,27 @@ def _gelu(x):
     return (0.5 * xf * (1.0 + jax.lax.erf(xf / np.sqrt(2.0)))).astype(x.dtype)
 
 
-def gpt_generate(params, prompt, max_new_tokens, num_heads,
-                 temperature=0.0, top_k=None, key=None, window=0,
-                 name="gpt"):
+def gpt_decode_config(symbol):
+    """Decode-time config a :func:`mxnet_tpu.models.gpt` symbol carries
+    that is NOT recoverable from weight shapes: ``num_heads`` and the
+    trained sliding-window radius (``attn_window``).  Works on a freshly
+    built symbol or one round-tripped through the two-artifact
+    checkpoint (``model.load_checkpoint``), since node attrs serialize.
+    Returns ``{"num_heads": int, "window": int}``; raises if the symbol
+    carries no gpt config attrs (predates them, or not a gpt symbol)."""
+    heads = symbol.attr("__gpt_num_heads__")
+    if heads is None:
+        raise ValueError(
+            "symbol carries no __gpt_num_heads__ attr — not built by "
+            "models.gpt(), or saved before decode-config persistence; "
+            "pass num_heads/window to gpt_generate explicitly")
+    return {"num_heads": int(heads),
+            "window": int(symbol.attr("__gpt_attn_window__") or 0)}
+
+
+def gpt_generate(params, prompt, max_new_tokens, num_heads=None,
+                 temperature=0.0, top_k=None, key=None, window=None,
+                 name="gpt", symbol=None):
     """Generate continuations for ``prompt`` with a KV cache.
 
     Args:
@@ -82,6 +102,35 @@ def gpt_generate(params, prompt, max_new_tokens, num_heads,
     prompt = np.asarray(prompt)
     if prompt.ndim != 2:
         raise ValueError("prompt must be (batch, prompt_len)")
+    if symbol is not None:
+        cfg = gpt_decode_config(symbol)
+        if num_heads is None:
+            num_heads = cfg["num_heads"]
+        elif int(num_heads) != cfg["num_heads"]:
+            raise ValueError(
+                f"num_heads={num_heads} contradicts the symbol's "
+                f"num_heads={cfg['num_heads']} — the reshapes would "
+                "succeed and decode garbage")
+        if window is None:
+            window = cfg["window"]
+        elif int(window) != cfg["window"]:
+            raise ValueError(
+                f"window={window} contradicts the symbol's trained "
+                f"attn_window={cfg['window']} — decoding with a "
+                "different window silently changes the model")
+    if num_heads is None:
+        raise ValueError("num_heads is required (pass it, or pass "
+                         "symbol= to read it from the trained graph)")
+    if window is None:
+        # not auto-detectable from weights alone: a window-trained
+        # checkpoint decoded without window= would silently run full
+        # attention.  Explicit window=0 (or symbol=) silences this.
+        warnings.warn(
+            "gpt_generate: window not given and no symbol= to detect it "
+            "from; assuming full attention (window=0). If the model was "
+            "trained with attn_window>0 this is a silent mismatch — "
+            "pass window= or symbol=.", stacklevel=2)
+        window = 0
     if window < 0:
         raise ValueError(f"window must be >= 0 (got {window})")
     B, P = prompt.shape

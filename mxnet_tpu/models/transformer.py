@@ -213,5 +213,12 @@ def gpt(vocab_size, seq_len, num_layers=2, d_model=128, num_heads=4,
     label = sym.Variable("softmax_label")        # (batch, seq_len)
     label_flat = sym.Reshape(label, shape=(-1,))
     if loss == "ce":
-        return sym.SoftmaxCELoss(logits, label_flat, name="softmax")
-    return sym.SoftmaxOutput(logits, label_flat, name="softmax")
+        out = sym.SoftmaxCELoss(logits, label_flat, name="softmax")
+    else:
+        out = sym.SoftmaxOutput(logits, label_flat, name="softmax")
+    # decode-time config NOT derivable from weight shapes (generate.py
+    # detects kv_heads/rope/swiglu/tied from the checkpoint, but head
+    # count and the trained sliding window are invisible there) —
+    # persist it in the symbol so the two-artifact checkpoint carries it
+    out._set_attr(__gpt_num_heads__=num_heads, __gpt_attn_window__=attn_window)
+    return out

@@ -135,6 +135,17 @@ def _fit_vmem(bq, bk, Sq, Sk, D, H, itemsize=4, Hkv=None):
             bk //= 2
         else:
             break                                    # floor: let Mosaic try
+    # The floor zone (12-16 MB estimated) is left to Mosaic — the
+    # estimate is conservative and small overshoots usually fit.  Past
+    # physical VMEM the allocation CANNOT succeed; fail with the config
+    # instead of Mosaic's opaque allocation error mid-train.
+    if _vmem_bytes(bq, bk, D, H, itemsize, Hkv) > 16 * 1024 * 1024:
+        raise ValueError(
+            f"flash_attention: no block config fits VMEM (floor "
+            f"block_q={bq}, block_k={bk} needs "
+            f"~{_vmem_bytes(bq, bk, D, H, itemsize, Hkv) >> 20} MB for "
+            f"D={D}, H={H}, kv_heads={Hkv}); use layout='bhsd' (per-head "
+            f"tiles) or fall back to dense attention (impl='xla')")
     return bq, bk
 
 

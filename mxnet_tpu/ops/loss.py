@@ -474,6 +474,10 @@ class SoftmaxCELossParam(Params):
     grad_scale = field(float, default=1.0)
     ignore_label = field(float, default=-1.0)
     use_ignore = field(bool, default=False)
+    normalization = field(str, default="null", enum=("null", "batch", "valid"),
+                          doc="gradient normalization, mirroring "
+                              "SoftmaxOutputParam so loss='ce' keeps the "
+                              "effective gradient scale of loss='softmax'")
     out_grad = field(bool, default=False,
                      doc="scale the gradient by the incoming output "
                          "gradient (loss-layer contract: ignored by "
@@ -526,8 +530,19 @@ class SoftmaxCELossOp(OpDef):
         lab = label.astype(jnp.int32)
         prob = jax.nn.softmax(x.astype(jnp.float32), axis=-1)
         grad = prob - jax.nn.one_hot(lab, x.shape[-1], dtype=prob.dtype)
+        mask = None
         if params.use_ignore:
-            grad = grad * (lab != int(params.ignore_label))[:, None]
+            mask = (lab != int(params.ignore_label))
+            grad = grad * mask[:, None]
+        # same semantics as SoftmaxOutput's non-multi-output branch
+        # (softmax_output-inl.h): valid divides by the non-ignored count,
+        # batch by dim0; the loss output itself is never normalized
+        if params.normalization == "valid":
+            valid = (jnp.maximum(jnp.sum(mask), 1).astype(grad.dtype)
+                     if mask is not None else float(lab.size))
+            grad = grad / valid
+        elif params.normalization == "batch":
+            grad = grad / x.shape[0]
         if params.out_grad and out_grads and out_grads[0] is not None:
             grad = grad * out_grads[0].astype(grad.dtype)[:, None]
         grad = grad * params.grad_scale

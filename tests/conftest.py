@@ -9,13 +9,24 @@ import os
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# MXTPU_TEST_PLATFORM=default lifts the CPU pin so a chip window can run
+# the convergence tier on real TPU (tools/bench_watch.py train_tier
+# stage); any other value pins that platform explicitly.
+_test_platform = os.environ.get("MXTPU_TEST_PLATFORM", "cpu")
 
 import jax
 
-# The env var alone can be overridden by accelerator plugins (axon);
-# the config update is authoritative.
-jax.config.update("jax_platforms", "cpu")
+if _test_platform != "default":
+    os.environ.setdefault("JAX_PLATFORMS", _test_platform)
+    # The env var alone can be overridden by accelerator plugins (axon);
+    # the config update is authoritative.
+    jax.config.update("jax_platforms", _test_platform)
+else:
+    # an on-chip tier must not silently fall back to CPU and report a
+    # "tpu" pass (jax auto-falls-back when the tunnel drops mid-init)
+    assert any(d.platform == "tpu" for d in jax.devices()), \
+        "MXTPU_TEST_PLATFORM=default requires a reachable TPU"
 
 
 # -- fast/slow tiers ---------------------------------------------------------

@@ -7,6 +7,11 @@ import pytest
 
 import mxnet_tpu as mx
 
+# window= is deliberately omitted in most cases here (they test full
+# attention); the omission warning is itself tested explicitly below
+pytestmark = pytest.mark.filterwarnings(
+    "ignore:gpt_generate. window not given:UserWarning")
+
 
 def _random_gpt(V=23, S=12, L=2, D=16, H=2, seed=0, **model_kwargs):
     net = mx.models.gpt(V, S, num_layers=L, d_model=D, num_heads=H,
@@ -210,6 +215,38 @@ def test_generate_accepts_quantized_checkpoint():
     ids_m = mx.models.gpt_generate(manual, prompt, max_new_tokens=3,
                                    num_heads=2)
     np.testing.assert_array_equal(ids_q, ids_m)
+
+
+def test_decode_config_from_symbol():
+    """The trained symbol persists decode config (num_heads, window)
+    that weight shapes cannot reveal; gpt_generate(symbol=...) uses it,
+    contradicting window= raises, and the legacy no-window path warns
+    (silent full-attention decode of a window-trained model was the
+    round-4 advisor finding)."""
+    V, S, H, W = 19, 12, 2, 6
+    net, exe, params = _random_gpt(V=V, S=S, H=H, seed=7, attn_window=W)
+    cfg = mx.models.gpt_decode_config(net)
+    assert cfg == {"num_heads": H, "window": W}
+    # round-trips through the serialized two-artifact checkpoint
+    reloaded = mx.sym.load_json(net.tojson())
+    assert mx.models.gpt_decode_config(reloaded) == cfg
+
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(0, V, (1, 4))
+    ids = _greedy_rollout(exe, prompt, S, V)
+    out = mx.models.gpt_generate(params, prompt, max_new_tokens=S - 4,
+                                 symbol=net)           # no num_heads/window
+    np.testing.assert_array_equal(out[0], np.array(ids, np.int32))
+
+    with pytest.raises(ValueError, match="contradicts"):
+        mx.models.gpt_generate(params, prompt, 2, symbol=net, window=0)
+    with pytest.warns(UserWarning, match="window not given"):
+        mx.models.gpt_generate(params, prompt, 2, num_heads=H)
+    with pytest.raises(ValueError, match="num_heads is required"):
+        mx.models.gpt_generate(params, prompt, 2)
+    plain = mx.sym.Variable("x")
+    with pytest.raises(ValueError, match="no __gpt_num_heads__"):
+        mx.models.gpt_decode_config(plain)
 
 
 @pytest.mark.parametrize("opts", [

@@ -33,7 +33,7 @@ def bench_one(jax, jnp, S, B, H, D, causal, n_iter=100,
     q, k, v = (jnp.asarray(rng.randn(*shape), dt) for _ in range(3))
     blk = {}
     if block_q:
-        blk = {"block_q": block_q, "block_k": block_k}
+        blk = {"block_q": block_q, "block_k": block_k or block_q}
 
     def loss_flash(q, k, v):
         return jnp.sum(flash_attention(q, k, v, causal=causal, **blk)
