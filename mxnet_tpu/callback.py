@@ -112,6 +112,48 @@ class ProgressBar:
         logging.info("[%s] %s%s", prog_bar, percents, "%")
 
 
+class DeadNodeMonitor:
+    """Surface kvstore dead-worker detection inside the training loop.
+
+    The reference exposes failure detection only as a pollable
+    ``kvstore.num_dead_node`` (kvstore.h:235-244 over ps-lite
+    heartbeats); this callback closes the loop to the trainer: pass it
+    as a ``batch_end_callback`` (or ``epoch_end_callback``) to
+    ``Module.fit`` / ``FeedForward.fit`` and every ``period`` calls it
+    queries ``kv.dead_nodes(timeout)``.  On detection it invokes
+    ``on_dead(ranks)`` if given (e.g. trigger a checkpoint + clean exit
+    so the launcher's elastic restart takes over), else raises
+    ``RuntimeError`` naming the dead ranks — failing the job fast
+    instead of hanging in the next sync round.
+    """
+
+    def __init__(self, kv, period=50, timeout=60.0, on_dead=None):
+        self.kv = kv
+        self.period = max(int(period), 1)
+        self.timeout = timeout
+        self.on_dead = on_dead
+        self._count = 0
+
+    def __call__(self, *args, **kwargs):
+        # every callback slot has a different invocation signature
+        # (BatchEndParam here, (epoch, symbol, arg, aux) in Module's
+        # epoch-end, (epoch, trainer) in ShardedTrainer's) — the
+        # monitor ignores the payload, so accept them all
+        self._count += 1
+        if self._count % self.period:
+            return
+        dead = self.kv.dead_nodes(self.timeout)
+        if not dead:
+            return
+        if self.on_dead is not None:
+            self.on_dead(dead)
+            return
+        raise RuntimeError(
+            f"dead workers detected: ranks {dead} missed heartbeats for "
+            f">{self.timeout}s (kvstore '{getattr(self.kv, 'type', '?')}')"
+            " — failing fast; restart the job from the last checkpoint")
+
+
 class LogValidationMetricsCallback:
     """Log eval metrics at epoch end (reference callback.py:127-136);
     pass as ``eval_end_callback`` to ``fit``."""

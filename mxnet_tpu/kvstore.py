@@ -177,10 +177,17 @@ class KVStore:
 
     set_updater = _set_updater
 
+    def dead_nodes(self, timeout=60.0):
+        """Worker ranks whose heartbeat lapsed (empty for local stores;
+        the PS-backed store reports real ranks).  The list form behind
+        :meth:`num_dead_node`, surfaced so training loops can name the
+        dead peers (``mx.callback.DeadNodeMonitor``)."""
+        return []
+
     def num_dead_node(self, node_id=0, timeout=60.0):
         """Failure-detection hook (reference kvstore.h:235-244
         get_num_dead_node over ps-lite heartbeats); 0 for local stores."""
-        return 0
+        return len(self.dead_nodes(timeout))
 
     def set_optimizer(self, optimizer):
         """Install an optimizer as the store-side updater.  In dist mode the
@@ -512,10 +519,11 @@ class DistPSKVStore(KVStore):
                 self._client.set_states(pickle.loads(f.read()))
         self.barrier()
 
-    def num_dead_node(self, node_id=0, timeout=60.0):
-        """Count of workers whose heartbeat lapsed (reference
-        get_num_dead_node over ps-lite heartbeats)."""
-        return len(self._client.dead_nodes(timeout))
+    def dead_nodes(self, timeout=60.0):
+        """Ranks whose heartbeat lapsed on every shard (this worker's
+        own requests keep refreshing its registration).  The base
+        class's ``num_dead_node`` counts this list."""
+        return self._client.dead_nodes(timeout)
 
     def barrier(self):
         self._flush()

@@ -125,6 +125,42 @@ def save_sharded(ckpt_dir, tree, extra=None, async_save=False):
             os.replace(path + ".tmp", path)
 
 
+def latest_complete_step(ckpt_dir, n_procs=None):
+    """Newest ``step-NNNN`` under ``ckpt_dir`` whose per-process
+    artifacts are COMPLETE, or None.  Complete = every proc in
+    ``range(n_procs)`` has both its meta json and shard npz (metas are
+    written tmp+rename after the payload, so presence implies a whole
+    shard file).  ``n_procs`` defaults to ``jax.process_count()``.
+
+    This is the elastic gang-restart resume point (tools/launch.py
+    --gang-restarts): a crash mid-save leaves the newest dir partial,
+    and the job must fall back to the last step everyone finished —
+    the reference tracker's restart-from-model.save analog."""
+    if n_procs is None:
+        n_procs = jax.process_count()
+    def step_no(d):
+        try:
+            return int(d.split("-", 1)[1])
+        except ValueError:
+            return None
+
+    try:
+        # numeric sort: lexicographic would rank step-9999 over
+        # step-10000 once past the 4-digit zero padding
+        steps = sorted((d for d in os.listdir(ckpt_dir)
+                        if d.startswith("step-") and step_no(d) is not None),
+                       key=step_no, reverse=True)
+    except OSError:
+        return None
+    for d in steps:
+        full = os.path.join(ckpt_dir, d)
+        if all(os.path.exists(os.path.join(full, f"meta-proc{p}.json"))
+               and os.path.exists(os.path.join(full, f"shards-proc{p}.npz"))
+               for p in range(n_procs)):
+            return step_no(d)
+    return None
+
+
 def _read_meta(ckpt_dir):
     metas = sorted(f for f in os.listdir(ckpt_dir)
                    if f.startswith("meta-proc") and f.endswith(".json"))

@@ -61,15 +61,33 @@ def main():
     np.testing.assert_array_equal(got.asnumpy(), 10.0)  # rank 0 won
     kv.barrier()
 
-    # -- 2. process-spanning dp training step with numerical parity -----
+    # -- 2. process-spanning training step with numerical parity --------
+    # MXTPU_SPMD_MESH=dp (default): pure data parallel over all devices.
+    # MXTPU_SPMD_MESH=dp_tp: dp spans the PROCESS boundary (DCN axis),
+    # tp spans each process's local devices (ICI axis) with megatron
+    # column/row FC shards — the canonical multi-host mesh layout
+    # (slow axis outermost), crossing processes on the dp collectives
+    # and staying intra-process for the tp ones.
     lr = 0.1
-    dp = 4 * n_procs
+    mesh_kind = os.environ.get("MXTPU_SPMD_MESH", "dp")
+    from jax.sharding import PartitionSpec as P
+
+    if mesh_kind == "dp_tp":
+        dp, tp = n_procs, 4
+        mesh = mx.parallel.make_mesh({"dp": dp, "tp": tp},
+                                     devices=jax.devices())
+        param_specs = {"fc1_weight": P("tp", None),   # column-parallel
+                       "fc1_bias": P("tp"),
+                       "fc2_weight": P(None, "tp")}   # row-parallel
+    else:
+        dp, tp = 4 * n_procs, 1
+        mesh = mx.parallel.make_mesh({"dp": dp}, devices=jax.devices())
+        param_specs = None
     batch, d_in = 2 * dp, 10
-    mesh = mx.parallel.make_mesh({"dp": dp}, devices=jax.devices())
     mx.random.seed(0)
     trainer = mx.parallel.ShardedTrainer(
         _net(), {"data": (batch, d_in), "softmax_label": (batch,)},
-        mesh=mesh, batch_axis="dp",
+        mesh=mesh, batch_axis="dp", param_specs=param_specs,
         optimizer="sgd", optimizer_params={"learning_rate": lr,
                                            "momentum": 0.9},
         initializer=mx.initializer.Xavier())

@@ -1,0 +1,109 @@
+"""Worker for the collectives-mode elastic drill (gang restart).
+
+The SPMD (jax.distributed collectives) world cannot absorb a single
+member restart the way the PS mode can: one dead rank hangs everyone
+else inside a collective.  Elasticity is therefore gang-level —
+tools/launch.py --gang-restarts kills the survivors and respawns the
+WHOLE job, and each new life resumes from the latest COMPLETE sharded
+checkpoint (parallel/checkpoint.py latest_complete_step).  This is the
+TPU-pod analog of the reference tracker restarting a dead job from its
+``model.save`` files (tests/nightly dist fault-tolerance intent).
+
+Script: 2 procs x 2 virtual devices = one global dp=4 mesh; 6
+deterministic training steps, a synchronized sharded checkpoint after
+every step.  On the first life (MXTPU_RESTART_COUNT=0) with
+ELASTIC_SPMD_CRASH=1, rank 1 kills itself after the step-3 checkpoint
+barrier.  Recovery lives resume from the newest complete step.  Every
+rank prints a params digest at step 6; the test asserts the crashed
+run's digest equals an uninterrupted run's digest EXACTLY.
+
+Launched by test_dist.py via tools/launch.py -n 2 --gang-restarts 1.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2"
+                           ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import hashlib
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore import _maybe_init_distributed
+from mxnet_tpu.parallel import checkpoint as ckpt
+
+STEPS = 6
+CRASH_AFTER = 3
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def _batch(step):
+    rng = np.random.RandomState(1000 + step)  # same batch on every rank
+    return {"data": rng.standard_normal((8, 10)).astype(np.float32),
+            "softmax_label": rng.randint(0, 4, 8).astype(np.float32)}
+
+
+def main():
+    _maybe_init_distributed()
+    rank = jax.process_index()
+    life = int(os.environ.get("MXTPU_RESTART_COUNT", "0"))
+    crash = os.environ.get("ELASTIC_SPMD_CRASH") == "1" and life == 0
+    ckpt_dir = os.environ["ELASTIC_SPMD_CKPT"]
+
+    mesh = mx.parallel.make_mesh({"dp": 4}, devices=jax.devices())
+    mx.random.seed(0)
+    trainer = mx.parallel.ShardedTrainer(
+        _net(), {"data": (8, 10), "softmax_label": (8,)},
+        mesh=mesh, batch_axis="dp",
+        optimizer="sgd", optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9},
+        initializer=mx.initializer.Xavier())
+    kv = mx.kv.create("dist_sync")  # barrier surface for save sync
+
+    start = 0
+    resume = ckpt.latest_complete_step(ckpt_dir)
+    if life > 0:
+        assert os.environ.get("MXTPU_IS_RECOVERY") == "1"
+        assert resume is not None, "gang restart found no checkpoint"
+        trainer.load_checkpoint_sharded(ckpt_dir, epoch=resume)
+        start = resume
+        print(f"RANK_{rank}_RESUMED_FROM {resume}", flush=True)
+
+    for step in range(start + 1, STEPS + 1):
+        jax.block_until_ready(trainer.step(_batch(step)))
+        trainer.save_checkpoint_sharded(ckpt_dir, epoch=step)
+        # both procs' shards durable before anyone proceeds: the crash
+        # (and any real failure) can then never strand a torn newest
+        # step that latest_complete_step would have to skip past a
+        # never-written older one
+        kv.barrier()
+        if crash and rank == 1 and step == CRASH_AFTER:
+            os._exit(3)
+
+    params = trainer.get_params()
+    digest = hashlib.sha1()
+    for k in sorted(params):
+        digest.update(np.ascontiguousarray(params[k]).tobytes())
+    print(f"RANK_{rank}_DIGEST {digest.hexdigest()}", flush=True)
+    print(f"RANK_{rank}_ELASTIC_SPMD_OK life={life}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

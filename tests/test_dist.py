@@ -60,3 +60,77 @@ def test_dist_spmd_two_process_mesh_parity():
     import __graft_entry__
 
     __graft_entry__.dryrun_multiprocess(2)
+
+
+def test_dist_sync_kvstore_four_processes():
+    """VERDICT r4 item 4: the multi-host story past 2 processes — the
+    dist_sync exactness gate at -n 4 (reference
+    tests/nightly/dist_sync_kvstore.py ran its cluster-size sweep the
+    same way, kvstore_dist.h:149-158)."""
+    env = dict(os.environ)
+    env.pop("MXTPU_COORDINATOR", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "4", "--",
+         sys.executable, os.path.join(REPO, "tests", "dist_sync_worker.py")],
+        capture_output=True, text=True, timeout=540, env=env)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    for rank in range(4):
+        assert f"RANK_{rank}_OK" in out, out[-3000:]
+
+
+def test_dist_spmd_four_process_dp_tp_parity():
+    """dpxtp across FOUR processes: dp crosses the process (DCN) axis,
+    tp shards megatron-style over each process's local devices — the
+    jitted step's parity gate vs a dense single-device run, plus
+    identical replica digests on every rank."""
+    import re
+
+    env = dict(os.environ)
+    env.pop("MXTPU_COORDINATOR", None)
+    env["MXTPU_SPMD_MESH"] = "dp_tp"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "4", "--",
+         sys.executable, os.path.join(REPO, "tests", "dist_spmd_worker.py")],
+        capture_output=True, text=True, timeout=540, env=env)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    for rank in range(4):
+        assert f"RANK_{rank}_SPMD_PARITY_OK" in out, out[-3000:]
+    digests = set(re.findall(r"RANK_\d_SPMD_DIGEST ([0-9a-f]+)", out))
+    assert len(digests) == 1, digests
+
+
+def _run_elastic_spmd(tmp_path, crash):
+    import re
+
+    env = dict(os.environ)
+    env.pop("MXTPU_COORDINATOR", None)
+    env["ELASTIC_SPMD_CKPT"] = str(tmp_path / ("crash" if crash else "ref"))
+    env["ELASTIC_SPMD_CRASH"] = "1" if crash else "0"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--gang-restarts", "1", "--",
+         sys.executable,
+         os.path.join(REPO, "tests", "elastic_spmd_worker.py")],
+        capture_output=True, text=True, timeout=540, env=env)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    digests = set(re.findall(r"RANK_\d_DIGEST ([0-9a-f]+)", out))
+    assert len(digests) == 1, out[-3000:]
+    return digests.pop(), out
+
+
+def test_elastic_gang_restart_checkpoint_resume(tmp_path):
+    """The automated kill-one-worker -> checkpoint-restart drill
+    (VERDICT r4 item 4): rank 1 dies mid-run, launch.py --gang-restarts
+    respawns the whole job, the new life resumes from the latest
+    COMPLETE sharded checkpoint, and the final params match an
+    uninterrupted run EXACTLY (momentum state included)."""
+    d_crash, out = _run_elastic_spmd(tmp_path, crash=True)
+    assert "RANK_0_RESUMED_FROM" in out and "RANK_1_RESUMED_FROM" in out
+    assert "life=1" in out
+    d_ref, _ = _run_elastic_spmd(tmp_path, crash=False)
+    assert d_crash == d_ref

@@ -376,3 +376,48 @@ def test_ps_kill_restart_fuzz(tmp_path, seed):
     # the fuzz must actually fuzz: at least one crash/restart happened
     # (guards the seeded crash-plan math against becoming vacuous)
     assert "restart " in out, out[-2000:]
+
+
+def test_dead_node_monitor_callback(monkeypatch):
+    """mx.callback.DeadNodeMonitor surfaces PS heartbeat failure to the
+    training loop (VERDICT r4 item 4 'dead-worker detection surfaced to
+    the trainer'): driven against the REAL DistPSKVStore — a peer rank
+    crashes (bare socket close) and the batch-end callback raises,
+    naming the rank; the on_dead hook form is called instead when
+    given."""
+    import time as _time
+
+    from mxnet_tpu.kvstore import DistPSKVStore
+
+    servers, mk = _start(num_workers=2)
+    monkeypatch.setenv("MXTPU_PROC_ID", "0")
+    monkeypatch.setenv("MXTPU_NUM_PROCS", "2")
+    kv = DistPSKVStore("dist_async", ",".join(s.addr for s in servers))
+    peer = mk()
+    try:
+        peer.hello(1)
+        mon = mx.callback.DeadNodeMonitor(kv, period=2, timeout=60.0)
+        # every callback slot's signature must be accepted: batch-end
+        # (BatchEndParam), Module epoch-end (epoch, sym, arg, aux)
+        mon(None)                    # below period: no query, no raise
+        mon(1, None, {}, {})         # everyone alive: no raise
+        # rank 1 crashes without a goodbye.  (No kv.init here: init
+        # barriers on ALL workers, and hanging on a dead peer is exactly
+        # the failure mode the monitor exists to pre-empt.  The
+        # monitor's own dead_nodes query refreshes rank 0's heartbeat.)
+        for cl in peer.clients:
+            cl._sock.close()
+        _time.sleep(0.25)
+        fast = mx.callback.DeadNodeMonitor(kv, period=1, timeout=0.2)
+        with pytest.raises(RuntimeError, match=r"ranks \[1\]"):
+            fast()
+        seen = []
+        hooked = mx.callback.DeadNodeMonitor(kv, period=1, timeout=0.2,
+                                             on_dead=seen.append)
+        hooked()                     # hook form: no raise
+        assert seen == [[1]]
+        assert kv.num_dead_node() == 0      # default 60s window
+        assert kv.num_dead_node(timeout=0.2) == 1
+    finally:
+        _stop(servers, [kv._client])
+        kv._client = None            # atexit close() becomes a no-op

@@ -152,6 +152,65 @@ def launch_local(n, command, extra_env=None, num_servers=0, max_restarts=0):
                 p.send_signal(signal.SIGTERM)
 
 
+def launch_gang(n, command, extra_env=None, gang_restarts=0):
+    """Spawn n ranks as ONE gang: if any member dies, kill the rest and
+    respawn the whole job (fresh coordinator port) up to
+    ``gang_restarts`` times, with ``MXTPU_RESTART_COUNT`` incremented
+    and ``MXTPU_IS_RECOVERY=1`` set for every rank of the new life.
+
+    This is the collectives-backed (SPMD) elastic contract — the
+    jax.distributed world cannot absorb a single-member restart the way
+    the PS mode can (--max-restarts), so recovery is gang-level:
+    workers are expected to resume from their latest complete sharded
+    checkpoint (parallel/checkpoint.py), the pod-scale analog of the
+    reference's tracker restarting a dead job from model.save files."""
+    import time
+
+    life = 0
+    while True:
+        coordinator = f"127.0.0.1:{_free_port()}"
+        extra = dict(extra_env or {})
+        extra["MXTPU_RESTART_COUNT"] = str(life)
+        if life:
+            extra["MXTPU_IS_RECOVERY"] = "1"
+        procs = {rank: subprocess.Popen(
+            command, env=_child_env(coordinator, n, rank, extra))
+            for rank in range(n)}
+        failed = None
+        pending = set(procs)
+        code = 0
+        try:
+            while pending and failed is None:
+                for rank in sorted(pending):
+                    rc = procs[rank].poll()
+                    if rc is None:
+                        continue
+                    if rc != 0:
+                        failed = (rank, rc)
+                        break
+                    pending.discard(rank)
+                time.sleep(0.1)
+        finally:
+            if failed is not None or pending:
+                # one death hangs peers in collectives: kill the gang
+                for p in procs.values():
+                    if p.poll() is None:
+                        p.kill()
+                for p in procs.values():
+                    p.wait()
+        if failed is None:
+            return 0
+        if life >= gang_restarts:
+            sys.stderr.write(
+                f"gang member rank {failed[0]} exited rc={failed[1]}; "
+                "restart budget exhausted\n")
+            return failed[1]
+        life += 1
+        sys.stderr.write(
+            f"gang member rank {failed[0]} exited rc={failed[1]}; "
+            f"gang restart {life}/{gang_restarts}\n")
+
+
 def launch_ssh(hostfile, command, sync_dir=None, username=None):
     with open(hostfile) as f:
         hosts = [h.strip() for h in f if h.strip() and not h.startswith("#")]
@@ -189,6 +248,10 @@ def main(argv=None):
                    help="respawn a crashed worker under the same rank up "
                         "to N times (PS mode keeps state; is_recovery "
                         "analog)")
+    p.add_argument("--gang-restarts", type=int, default=0,
+                   help="collectives-mode elastic: if any rank dies, "
+                        "restart the WHOLE job up to N times (workers "
+                        "resume from their latest sharded checkpoint)")
     p.add_argument("-H", "--hostfile", default=None,
                    help="one host per line; enables ssh mode")
     p.add_argument("--launcher", choices=["local", "ssh"], default=None)
@@ -205,6 +268,12 @@ def main(argv=None):
         if not args.hostfile:
             p.error("ssh mode needs -H hostfile")
         return launch_ssh(args.hostfile, command, args.sync_dir, args.username)
+    if args.gang_restarts:
+        if args.num_servers or args.max_restarts:
+            p.error("--gang-restarts is the collectives-mode elastic "
+                    "path; it does not compose with -s/--max-restarts")
+        return launch_gang(args.num_workers, command,
+                           gang_restarts=args.gang_restarts)
     return launch_local(args.num_workers, command,
                         num_servers=args.num_servers,
                         max_restarts=args.max_restarts)

@@ -154,11 +154,13 @@ def main():
     args = p.parse_args()
 
     if args.platform:
-        os.environ.setdefault("JAX_PLATFORMS", args.platform)
+        os.environ["JAX_PLATFORMS"] = args.platform
     import jax
 
-    if jax.default_backend() != "tpu" and len(jax.devices()) < 2:
-        # ring lane needs a mesh: re-exec with a virtual CPU mesh
+    if (jax.default_backend() != "tpu" and len(jax.devices()) < 2
+            and not os.environ.get("_MXTPU_LCB_REEXEC")):
+        # ring lane needs a mesh: re-exec ONCE with a virtual CPU mesh
+        os.environ["_MXTPU_LCB_REEXEC"] = "1"
         os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                    + " --xla_force_host_platform_device_count=8")
         os.execv(sys.executable, [sys.executable] + sys.argv
