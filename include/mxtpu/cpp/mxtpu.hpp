@@ -20,9 +20,12 @@
 #ifndef MXTPU_CPP_MXTPU_HPP_
 #define MXTPU_CPP_MXTPU_HPP_
 
+#include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <map>
 #include <memory>
+#include <random>
 #include <stdexcept>
 #include <string>
 #include <utility>
@@ -476,6 +479,340 @@ class DataIter {
 inline void RandomSeed(int seed) {
   Check(MXTPURandomSeed(seed), "RandomSeed");
 }
+
+// ---- op registry discovery -------------------------------------------------
+// The frontend does not hard-code the operator set: names and per-op
+// metadata come from the runtime registry (reference
+// MXSymbolListAtomicSymbolCreators + MXSymbolGetAtomicSymbolInfo, the
+// machinery cpp-package's OpWrapperGenerator.py consumes).  Op() above
+// composes any discovered name.
+
+inline std::vector<std::string> ListOps() {
+  int n = 0;
+  const char** names = nullptr;
+  Check(MXTPUListOps(&n, &names), "ListOps");
+  return std::vector<std::string>(names, names + n);
+}
+
+struct OpInfo {
+  std::string doc;
+  std::vector<std::string> arg_names;                  // data inputs
+  std::vector<std::string> param_names, param_types, param_docs;
+};
+
+inline OpInfo GetOpInfo(const std::string& name) {
+  const char* doc = nullptr;
+  int n_args = 0, n_params = 0;
+  const char **arg_names = nullptr, **param_names = nullptr,
+             **param_types = nullptr, **param_docs = nullptr;
+  Check(MXTPUGetOpInfo(name.c_str(), &doc, &n_args, &arg_names, &n_params,
+                       &param_names, &param_types, &param_docs),
+        "GetOpInfo");
+  OpInfo info;
+  info.doc = doc ? doc : "";
+  for (int i = 0; i < n_args; ++i) info.arg_names.emplace_back(arg_names[i]);
+  for (int i = 0; i < n_params; ++i) {
+    info.param_names.emplace_back(param_names[i]);
+    info.param_types.emplace_back(param_types[i] ? param_types[i] : "");
+    info.param_docs.emplace_back(param_docs[i] ? param_docs[i] : "");
+  }
+  return info;
+}
+
+// ---- Optimizer -------------------------------------------------------------
+// Imperative worker-side optimizer over the C handle (reference
+// MXOptimizerCreateOptimizer/MXOptimizerUpdate); per-index state
+// (momentum etc.) lives behind the handle.
+
+class Optimizer {
+ public:
+  Optimizer(const std::string& name, const KwArgs& params) {
+    KwView kw(params);
+    OptimizerHandle h = nullptr;
+    Check(MXTPUOptimizerCreateOptimizer(name.c_str(), kw.n(),
+                                        kw.keys.data(), kw.vals.data(), &h),
+          "OptimizerCreateOptimizer");
+    handle_ = std::make_shared<Owner>(h);
+  }
+
+  void Update(int index, const NDArray& weight, const NDArray& grad) {
+    Check(MXTPUOptimizerUpdate(handle(), index, weight.handle(),
+                               grad.handle()),
+          "OptimizerUpdate");
+  }
+
+  OptimizerHandle handle() const { return handle_ ? handle_->h : nullptr; }
+
+ private:
+  struct Owner {
+    explicit Owner(OptimizerHandle hh) : h(hh) {}
+    Owner(const Owner&) = delete;
+    Owner& operator=(const Owner&) = delete;
+    OptimizerHandle h;
+    ~Owner() {
+      if (h) MXTPUOptimizerFree(h);
+    }
+  };
+  std::shared_ptr<Owner> handle_;
+};
+
+// ---- initializers ----------------------------------------------------------
+// Client-side like the reference cpp-package (initializers run in the
+// frontend, only the filled arrays cross the ABI).
+
+class Initializer {
+ public:
+  virtual ~Initializer() = default;
+  virtual void operator()(const std::string& name, NDArray* arr) = 0;
+};
+
+class Xavier : public Initializer {
+ public:
+  explicit Xavier(double magnitude = 3.0, unsigned seed = 0)
+      : magnitude_(magnitude), rng_(seed) {}
+
+  void operator()(const std::string& name, NDArray* arr) override {
+    auto shape = arr->Shape();
+    std::vector<float> buf(arr->Size(), 0.0f);
+    const bool is_weight =
+        name.size() >= 6 && name.compare(name.size() - 6, 6, "weight") == 0;
+    const bool is_gamma =
+        name.size() >= 5 && name.compare(name.size() - 5, 5, "gamma") == 0;
+    if (is_weight && !shape.empty()) {
+      double fan_out = shape[0], fan_in = 1.0;
+      for (size_t i = 1; i < shape.size(); ++i) fan_in *= shape[i];
+      double scale = std::sqrt(magnitude_ * 2.0 / (fan_in + fan_out));
+      std::uniform_real_distribution<float> dist(
+          static_cast<float>(-scale), static_cast<float>(scale));
+      for (auto& v : buf) v = dist(rng_);
+    } else if (is_gamma) {
+      for (auto& v : buf) v = 1.0f;     // BN scale starts at identity
+    }  // biases/betas zero (reference initializer contract)
+    arr->SyncCopyFromCPU(buf);
+  }
+
+ private:
+  double magnitude_;
+  std::mt19937 rng_;
+};
+
+// ---- Module ----------------------------------------------------------------
+// The high-level training loop (reference module/module.py shape, via
+// the executor): bind from shapes, init params, fit over a DataIter
+// with an imperative optimizer, score, save/load params.  User code is
+// symbol -> Module -> Fit, same as the Python frontend.
+
+class Module {
+ public:
+  explicit Module(Symbol net) : net_(std::move(net)) {}
+
+  void Bind(const std::map<std::string, std::vector<uint32_t>>& data_shapes) {
+    arg_names_ = net_.ListArguments();
+    aux_names_ = net_.ListAuxiliaryStates();
+    auto shapes = net_.InferShape(data_shapes);
+    if (!shapes.complete || shapes.arg.size() != arg_names_.size())
+      throw std::runtime_error("Module::Bind: shape inference incomplete");
+    args_.clear();
+    grads_.clear();
+    reqs_.clear();
+    aux_.clear();
+    for (size_t i = 0; i < arg_names_.size(); ++i) {
+      args_.emplace_back(shapes.arg[i]);
+      if (data_shapes.count(arg_names_[i])) {
+        grads_.emplace_back();
+        reqs_.push_back(GradReq::kNull);
+      } else {
+        grads_.emplace_back(shapes.arg[i]);
+        reqs_.push_back(GradReq::kWrite);
+      }
+    }
+    for (const auto& s : shapes.aux) aux_.emplace_back(s);
+    exec_ = std::make_shared<Executor>(net_, args_, grads_, reqs_, aux_);
+  }
+
+  void InitParams(Initializer& init) {
+    EnsureBound();
+    for (size_t i = 0; i < args_.size(); ++i)
+      if (reqs_[i] == GradReq::kWrite) init(arg_names_[i], &args_[i]);
+    // aux states have fixed semantics, not initializer-drawn ones:
+    // variance-like states start at 1, means/counters at 0 (the
+    // Python executor applies the same contract)
+    for (size_t i = 0; i < aux_.size(); ++i) {
+      const std::string& n = aux_names_[i];
+      const bool ones =
+          n.size() >= 4 && (n.find("_var") != std::string::npos ||
+                            n.find("gamma") != std::string::npos);
+      aux_[i].SyncCopyFromCPU(
+          std::vector<float>(aux_[i].Size(), ones ? 1.0f : 0.0f));
+    }
+  }
+
+  void InitOptimizer(const std::string& name, const KwArgs& params) {
+    opt_ = std::make_shared<Optimizer>(name, params);
+  }
+
+  // One pass over the iterator; returns training accuracy of the pass
+  // (argmax of outputs[0] vs the label input, pad-aware).
+  double FitEpoch(DataIter& it, const std::string& data_name = "data",
+                  const std::string& label_name = "softmax_label") {
+    EnsureBound();
+    if (!opt_) throw std::runtime_error("Module: InitOptimizer first");
+    long correct = 0, total = 0;
+    it.Reset();
+    while (it.Next()) {
+      FeedBatch(it, data_name, label_name);
+      exec_->Forward(true);
+      exec_->Backward();
+      for (size_t i = 0; i < args_.size(); ++i)
+        if (reqs_[i] == GradReq::kWrite)
+          opt_->Update(static_cast<int>(i), args_[i], grads_[i]);
+      Accumulate(it, label_name, &correct, &total);
+    }
+    return total ? static_cast<double>(correct) / total : 0.0;
+  }
+
+  double Fit(DataIter& train, int epochs,
+             const std::string& data_name = "data",
+             const std::string& label_name = "softmax_label") {
+    double acc = 0.0;
+    for (int e = 0; e < epochs; ++e) acc = FitEpoch(train, data_name,
+                                                    label_name);
+    return acc;
+  }
+
+  double Score(DataIter& it, const std::string& data_name = "data",
+               const std::string& label_name = "softmax_label") {
+    EnsureBound();
+    long correct = 0, total = 0;
+    it.Reset();
+    while (it.Next()) {
+      FeedBatch(it, data_name, label_name);
+      exec_->Forward(false);
+      Accumulate(it, label_name, &correct, &total);
+    }
+    return total ? static_cast<double>(correct) / total : 0.0;
+  }
+
+  // Single-batch inference on caller data (shape = bound data shape).
+  std::vector<float> Predict(const std::vector<float>& data,
+                             const std::string& data_name = "data") {
+    EnsureBound();
+    args_[InputIdx(data_name)].SyncCopyFromCPU(data);
+    exec_->Forward(false);
+    return exec_->Outputs()[0].SyncCopyToCPU();
+  }
+
+  // Reference .params naming: "arg:<name>" / "aux:<name>" prefixes, so
+  // the file carries the full model state (BatchNorm moving stats
+  // included) and interoperates with the Python loader's convention.
+  void SaveParams(const std::string& fname) {
+    EnsureBound();
+    std::vector<std::string> key_store;
+    std::vector<NDArrayHandle> hs;
+    for (size_t i = 0; i < args_.size(); ++i)
+      if (reqs_[i] == GradReq::kWrite) {
+        hs.push_back(args_[i].handle());
+        key_store.push_back("arg:" + arg_names_[i]);
+      }
+    for (size_t i = 0; i < aux_.size(); ++i) {
+      hs.push_back(aux_[i].handle());
+      key_store.push_back("aux:" + aux_names_[i]);
+    }
+    std::vector<const char*> keys;
+    for (const auto& k : key_store) keys.push_back(k.c_str());
+    Check(MXTPUNDArraySave(fname.c_str(), static_cast<int>(hs.size()),
+                           hs.data(), keys.data()),
+          "NDArraySave");
+  }
+
+  void LoadParams(const std::string& fname) {
+    EnsureBound();
+    // 4096 covers any model this frontend binds in one executor; the C
+    // entry fails loudly ("capacity too small") rather than truncating
+    std::vector<NDArrayHandle> buf(4096);
+    std::vector<const char*> names(4096);
+    int n = 0, named = 0;
+    Check(MXTPUNDArrayLoad(fname.c_str(), static_cast<int>(buf.size()),
+                           buf.data(), names.data(), &n, &named),
+          "NDArrayLoad");
+    // adopt everything FIRST so every handle is owned (and freed) no
+    // matter which validation below throws
+    std::map<std::string, NDArray> loaded;
+    for (int i = 0; i < n; ++i)
+      loaded.emplace(named ? names[i] : std::to_string(i),
+                     NDArray::Adopt(buf[i]));
+    if (!named) throw std::runtime_error("Module::LoadParams: nameless file");
+
+    auto fetch = [&](const std::string& prefixed) -> const NDArray* {
+      auto it = loaded.find(prefixed);
+      if (it != loaded.end()) return &it->second;
+      // tolerate prefixless saves (e.g. hand-written files)
+      auto bare = loaded.find(prefixed.substr(prefixed.find(':') + 1));
+      return bare != loaded.end() ? &bare->second : nullptr;
+    };
+    for (size_t i = 0; i < args_.size(); ++i) {
+      if (reqs_[i] != GradReq::kWrite) continue;
+      const NDArray* src = fetch("arg:" + arg_names_[i]);
+      if (!src)
+        throw std::runtime_error("Module::LoadParams: missing " +
+                                 arg_names_[i]);
+      args_[i].SyncCopyFromCPU(src->SyncCopyToCPU());
+    }
+    for (size_t i = 0; i < aux_.size(); ++i) {
+      const NDArray* src = fetch("aux:" + aux_names_[i]);
+      if (!src)
+        throw std::runtime_error("Module::LoadParams: missing aux " +
+                                 aux_names_[i]);
+      aux_[i].SyncCopyFromCPU(src->SyncCopyToCPU());
+    }
+  }
+
+  const std::vector<std::string>& ArgNames() const { return arg_names_; }
+  NDArray& Arg(const std::string& name) { return args_[InputIdx(name)]; }
+  Executor& Exec() { return *exec_; }
+
+ private:
+  void EnsureBound() const {
+    if (!exec_) throw std::runtime_error("Module: call Bind first");
+  }
+
+  int InputIdx(const std::string& name) const {
+    for (size_t i = 0; i < arg_names_.size(); ++i)
+      if (arg_names_[i] == name) return static_cast<int>(i);
+    throw std::runtime_error("Module: unknown argument " + name);
+  }
+
+  void FeedBatch(DataIter& it, const std::string& data_name,
+                 const std::string& label_name) {
+    args_[InputIdx(data_name)].SyncCopyFromCPU(it.Data().SyncCopyToCPU());
+    last_labels_ = it.Label().SyncCopyToCPU();
+    args_[InputIdx(label_name)].SyncCopyFromCPU(last_labels_);
+  }
+
+  void Accumulate(DataIter& it, const std::string& /*label_name*/,
+                  long* correct, long* total) {
+    // labels cached host-side by FeedBatch: no device round-trip here
+    const std::vector<float>& labels = last_labels_;
+    auto probs = exec_->Outputs()[0].SyncCopyToCPU();
+    const long batch = static_cast<long>(labels.size());
+    const long classes = batch ? static_cast<long>(probs.size()) / batch : 0;
+    const long live = batch - it.PadNum();     // round-pad tail excluded
+    for (long b = 0; b < live; ++b) {
+      auto row = probs.begin() + b * classes;
+      long best = std::max_element(row, row + classes) - row;
+      *correct += best == static_cast<long>(labels[b]);
+      ++*total;
+    }
+  }
+
+  Symbol net_;
+  std::vector<std::string> arg_names_, aux_names_;
+  std::vector<NDArray> args_, grads_, aux_;
+  std::vector<GradReq> reqs_;
+  std::vector<float> last_labels_;
+  std::shared_ptr<Executor> exec_;
+  std::shared_ptr<Optimizer> opt_;
+};
 
 }  // namespace cpp
 }  // namespace mxtpu

@@ -59,6 +59,13 @@ def publish_registry(lib=None):
         return False
     for name in sorted(OP_REGISTRY._entries):
         op = OP_REGISTRY.get(name)
+        # the registry's keys are lowercase lookup names; publish the
+        # canonical display name ("Convolution") for an op's primary
+        # key so C consumers discover the names the docs/examples use
+        # (alias keys pass through as themselves: "_add", "crop", ...)
+        canonical = getattr(op, "name", name)
+        if isinstance(canonical, str) and canonical.lower() == name:
+            name = canonical
         try:
             params = op.make_params({}) if op.param_cls else None
         except Exception:

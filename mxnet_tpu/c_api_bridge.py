@@ -641,3 +641,20 @@ def notify_shutdown():
     from .engine import get_engine
     get_engine().wait_for_all()
     return True
+
+
+# ---- boot-time registry publication ----------------------------------------
+# A pure-C/C++ consumer calls MXTPUListOps/MXTPUGetOpInfo against the
+# NATIVE registry (src/c_api.cc), which only the Python side can fill.
+# When this bridge module boots inside the embedded interpreter, publish
+# the full Python op registry through MXTPURegisterOp so runtime op
+# discovery works for non-Python frontends (reference parity:
+# MXSymbolListAtomicSymbolCreators sees every NNVM-registered op).
+# ctypes.CDLL on the already-loaded .so resolves to the same module, so
+# the registrations land in the globals the consumer binary reads.
+try:
+    from . import c_api as _c_api
+
+    _c_api.publish_registry()
+except Exception:  # never block the bridge boot over discovery metadata
+    pass

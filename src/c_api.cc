@@ -13,6 +13,7 @@
 // import, after which any in-process frontend can enumerate ops through
 // the C ABI exactly like the reference's frontends do.
 
+#include <cctype>
 #include <map>
 #include <mutex>
 #include <string>
@@ -89,8 +90,12 @@ int MXTPURegisterOp(const char* name, const char* doc,
     info.param_docs.emplace_back(param_docs && param_docs[i] ? param_docs[i]
                                                              : "");
   }
+  // keyed case-insensitively (the Python registry's lookup contract);
+  // info.name keeps the canonical display form for ListOps
+  std::string key = info.name;
+  for (auto& c : key) c = static_cast<char>(std::tolower(c));
   std::lock_guard<std::mutex> lk(mxtpu::reg_mu);
-  mxtpu::OpInfo& slot = mxtpu::Registry()[info.name];
+  mxtpu::OpInfo& slot = mxtpu::Registry()[key];
   slot = std::move(info);
   slot.RebuildPtrs();
   return 0;
@@ -103,7 +108,7 @@ int MXTPUListOps(int* out_size, const char*** out_names) {
   std::lock_guard<std::mutex> lk(mxtpu::reg_mu);
   mxtpu::list_snapshot.clear();
   for (auto& kv : mxtpu::Registry())
-    mxtpu::list_snapshot.push_back(kv.first.c_str());
+    mxtpu::list_snapshot.push_back(kv.second.name.c_str());
   *out_size = static_cast<int>(mxtpu::list_snapshot.size());
   *out_names = mxtpu::list_snapshot.data();
   return 0;
@@ -116,8 +121,10 @@ int MXTPUGetOpInfo(const char* name, const char** out_doc, int* out_n_args,
                    const char*** out_param_names,
                    const char*** out_param_types,
                    const char*** out_param_docs) {
+  std::string key = name ? name : "";
+  for (auto& c : key) c = static_cast<char>(std::tolower(c));
   std::lock_guard<std::mutex> lk(mxtpu::reg_mu);
-  auto it = mxtpu::Registry().find(name ? name : "");
+  auto it = mxtpu::Registry().find(key);
   if (it == mxtpu::Registry().end()) {
     mxtpu::last_error = std::string("unknown op: ") + (name ? name : "");
     return -1;

@@ -178,7 +178,6 @@ def launch_gang(n, command, extra_env=None, gang_restarts=0):
             for rank in range(n)}
         failed = None
         pending = set(procs)
-        code = 0
         try:
             while pending and failed is None:
                 for rank in sorted(pending):
