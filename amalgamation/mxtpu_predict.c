@@ -1,0 +1,1057 @@
+/* Single-file inference runtime for .mxa artifacts.  See
+ * mxtpu_predict.h for the contract.  C99, libc + libm only.
+ *
+ * Structure: error buffer -> file slurp -> STORED-zip reader -> .npy
+ * reader -> mini JSON parser -> tensor helpers -> ops -> graph
+ * interpreter -> public API.  The graph comes from symbol.json (the
+ * framework's serialized Symbol: topo-ordered nodes with string
+ * params, reference graph JSON shape), the weights from params.npz
+ * ("arg:<name>"/"aux:<name>" keys, float32 or tagged-bf16 uint16).
+ */
+#include "mxtpu_predict.h"
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* strdup is POSIX, not C99 — own copy keeps the file freestanding */
+static char* xstrdup(const char* s) {
+  size_t n = strlen(s) + 1;
+  char* d = (char*)malloc(n);
+  if (d) memcpy(d, s, n);
+  return d;
+}
+
+/* ---- error ---------------------------------------------------------- */
+
+static char mxa_err[512];
+
+const char* mxa_last_error(void) { return mxa_err; }
+
+static void seterr(const char* fmt, const char* a) {
+  snprintf(mxa_err, sizeof(mxa_err), fmt, a ? a : "");
+}
+
+/* ---- slurp ---------------------------------------------------------- */
+
+static uint8_t* slurp(const char* path, size_t* out_len) {
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    seterr("cannot open %s", path);
+    return NULL;
+  }
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  uint8_t* buf = (uint8_t*)malloc((size_t)n);
+  if (!buf || fread(buf, 1, (size_t)n, f) != (size_t)n) {
+    seterr("cannot read %s", path);
+    free(buf);
+    fclose(f);
+    return NULL;
+  }
+  fclose(f);
+  *out_len = (size_t)n;
+  return buf;
+}
+
+/* ---- STORED zip reader ---------------------------------------------- */
+
+static uint32_t rd32(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+static uint16_t rd16(const uint8_t* p) {
+  return (uint16_t)((uint32_t)p[0] | ((uint32_t)p[1] << 8));
+}
+
+/* Find entry `name`; returns pointer into `zip` and sets *out_len.
+ * STORED entries only (the exporter writes no deflate). */
+static const uint8_t* zip_find(const uint8_t* zip, size_t len,
+                               const char* name, size_t* out_len) {
+  if (len < 22) {
+    seterr("zip too small%s", NULL);
+    return NULL;
+  }
+  /* EOCD: scan back for PK\5\6 (comment can follow) */
+  size_t i = len - 22;
+  for (;;) {
+    if (zip[i] == 0x50 && zip[i + 1] == 0x4b && zip[i + 2] == 0x05 &&
+        zip[i + 3] == 0x06)
+      break;
+    if (i == 0 || len - i > 22 + 65535) {
+      seterr("zip: no end-of-central-directory%s", NULL);
+      return NULL;
+    }
+    --i;
+  }
+  uint16_t n_entries = rd16(zip + i + 10);
+  uint32_t cd_off = rd32(zip + i + 16);
+  size_t p = cd_off;
+  for (uint16_t e = 0; e < n_entries; ++e) {
+    if (p + 46 > len || rd32(zip + p) != 0x02014b50) {
+      seterr("zip: bad central directory%s", NULL);
+      return NULL;
+    }
+    uint16_t method = rd16(zip + p + 10);
+    uint32_t csize = rd32(zip + p + 20);
+    uint16_t nlen = rd16(zip + p + 28);
+    uint16_t xlen = rd16(zip + p + 30);
+    uint16_t clen = rd16(zip + p + 32);
+    uint32_t lho = rd32(zip + p + 42);
+    const char* ename = (const char*)(zip + p + 46);
+    if ((size_t)nlen == strlen(name) && memcmp(ename, name, nlen) == 0) {
+      if (method != 0) {
+        seterr("zip entry %s is compressed (runtime reads STORED only)",
+               name);
+        return NULL;
+      }
+      /* local header: skip its own (possibly different) name/extra */
+      if (lho + 30 > len || rd32(zip + lho) != 0x04034b50) {
+        seterr("zip: bad local header for %s", name);
+        return NULL;
+      }
+      uint16_t lnlen = rd16(zip + lho + 26);
+      uint16_t lxlen = rd16(zip + lho + 28);
+      size_t data = (size_t)lho + 30 + lnlen + lxlen;
+      if (data + csize > len) {
+        seterr("zip: entry %s truncated", name);
+        return NULL;
+      }
+      *out_len = csize;
+      return zip + data;
+    }
+    p += 46 + (size_t)nlen + xlen + clen;
+  }
+  seterr("zip: entry %s not found", name);
+  return NULL;
+}
+
+/* ---- npy ------------------------------------------------------------- */
+
+typedef struct {
+  int ndim;
+  int64_t dims[MXA_MAX_NDIM];
+  int64_t size;
+  float* data; /* always converted to f32, owned */
+} npy_arr;
+
+static int npy_parse(const uint8_t* buf, size_t len, npy_arr* out,
+                     int is_bf16_tagged) {
+  if (len < 10 || memcmp(buf, "\x93NUMPY", 6) != 0) {
+    seterr("bad npy magic%s", NULL);
+    return -1;
+  }
+  int major = buf[6];
+  size_t hlen, hoff;
+  if (major == 1) {
+    hlen = rd16(buf + 8);
+    hoff = 10;
+  } else {
+    if (len < 12) {
+      seterr("npy: truncated header%s", NULL);
+      return -1;
+    }
+    hlen = rd32(buf + 8);
+    hoff = 12;
+  }
+  if (hoff + hlen > len) { /* also guards the avail subtraction below */
+    seterr("npy: header exceeds entry%s", NULL);
+    return -1;
+  }
+  /* NUL-terminated copy: the in-zip header is not a C string */
+  char hcopy[1024];
+  size_t hn = hlen < sizeof(hcopy) - 1 ? hlen : sizeof(hcopy) - 1;
+  memcpy(hcopy, buf + hoff, hn);
+  hcopy[hn] = 0;
+  const char* h = hcopy;
+  /* descr */
+  const char* d = strstr(h, "'descr'");
+  if (!d) {
+    seterr("npy: no descr%s", NULL);
+    return -1;
+  }
+  d = strchr(d + 7, '\'');
+  if (!d) return -1;
+  char descr[16] = {0};
+  {
+    const char* e = strchr(d + 1, '\'');
+    if (!e) {
+      seterr("npy: unterminated descr%s", NULL);
+      return -1;
+    }
+    size_t n = (size_t)(e - d - 1);
+    if (n >= sizeof(descr)) n = sizeof(descr) - 1;
+    memcpy(descr, d + 1, n);
+  }
+  if (strstr(h, "'fortran_order': True")) {
+    seterr("npy: fortran order unsupported%s", NULL);
+    return -1;
+  }
+  /* shape */
+  const char* s = strstr(h, "'shape'");
+  if (!s || !strchr(s, '(')) {
+    seterr("npy: no shape%s", NULL);
+    return -1;
+  }
+  s = strchr(s, '(');
+  out->ndim = 0;
+  out->size = 1;
+  const char* q = s + 1;
+  while (*q && *q != ')') {
+    while (*q == ' ' || *q == ',') ++q;
+    if (*q == ')' || !*q) break;
+    int64_t v = strtoll(q, (char**)&q, 10);
+    if (out->ndim >= MXA_MAX_NDIM) {
+      seterr("npy: ndim too large%s", NULL);
+      return -1;
+    }
+    out->dims[out->ndim++] = v;
+    out->size *= v;
+  }
+  if (out->ndim == 0) { /* scalar */
+    out->ndim = 1;
+    out->dims[0] = 1;
+  }
+  const uint8_t* payload = buf + hoff + hlen;
+  size_t avail = len - hoff - hlen;
+  out->data = (float*)malloc(sizeof(float) * (size_t)out->size);
+  if (!out->data) {
+    seterr("oom%s", NULL);
+    return -1;
+  }
+  int64_t n = out->size;
+  if (strcmp(descr, "<f4") == 0) {
+    if (avail < (size_t)n * 4) goto trunc;
+    memcpy(out->data, payload, (size_t)n * 4);
+  } else if (strcmp(descr, "<u2") == 0 && is_bf16_tagged) {
+    if (avail < (size_t)n * 2) goto trunc;
+    for (int64_t i = 0; i < n; ++i) {
+      uint32_t bits = ((uint32_t)payload[2 * i] |
+                       ((uint32_t)payload[2 * i + 1] << 8))
+                      << 16;
+      memcpy(&out->data[i], &bits, 4);
+    }
+  } else if (strcmp(descr, "<f8") == 0) {
+    if (avail < (size_t)n * 8) goto trunc;
+    for (int64_t i = 0; i < n; ++i) {
+      double v;
+      memcpy(&v, payload + 8 * i, 8);
+      out->data[i] = (float)v;
+    }
+  } else if (strcmp(descr, "<i4") == 0) {
+    if (avail < (size_t)n * 4) goto trunc;
+    for (int64_t i = 0; i < n; ++i) {
+      int32_t v;
+      memcpy(&v, payload + 4 * i, 4);
+      out->data[i] = (float)v;
+    }
+  } else if (strcmp(descr, "<i8") == 0) {
+    if (avail < (size_t)n * 8) goto trunc;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t v;
+      memcpy(&v, payload + 8 * i, 8);
+      out->data[i] = (float)v;
+    }
+  } else {
+    seterr("npy: unsupported dtype %s", descr);
+    free(out->data);
+    return -1;
+  }
+  return 0;
+trunc:
+  seterr("npy: truncated payload%s", NULL);
+  free(out->data);
+  return -1;
+}
+
+/* ---- mini JSON ------------------------------------------------------- */
+
+typedef enum { J_NULL, J_BOOL, J_NUM, J_STR, J_ARR, J_OBJ } jtype;
+
+typedef struct jval {
+  jtype t;
+  double num;
+  char* str;                 /* J_STR */
+  struct jval** items;       /* J_ARR / J_OBJ values */
+  char** keys;               /* J_OBJ keys */
+  int n;
+} jval;
+
+static void jfree(jval* v) {
+  if (!v) return;
+  free(v->str);
+  for (int i = 0; i < v->n; ++i) {
+    jfree(v->items ? v->items[i] : NULL);
+    if (v->keys) free(v->keys[i]);
+  }
+  free(v->items);
+  free(v->keys);
+  free(v);
+}
+
+static void jskip(const char** p) {
+  while (**p == ' ' || **p == '\n' || **p == '\t' || **p == '\r') ++*p;
+}
+
+static jval* jparse(const char** p);
+
+static char* jstring(const char** p) {
+  if (**p != '"') return NULL;
+  ++*p;
+  size_t cap = 16, n = 0;
+  char* s = (char*)malloc(cap);
+  while (**p && **p != '"') {
+    char c = **p;
+    if (c == '\\') {
+      ++*p;
+      char e = **p;
+      switch (e) {
+        case 'n': c = '\n'; break;
+        case 't': c = '\t'; break;
+        case 'r': c = '\r'; break;
+        case 'b': c = '\b'; break;
+        case 'f': c = '\f'; break;
+        case 'u': { /* \uXXXX: keep ASCII, replace others with '?' */
+          unsigned v = 0;
+          for (int k = 0; k < 4 && (*p)[1]; ++k) {
+            ++*p;
+            char h = **p;
+            v = v * 16 + (h <= '9' ? (unsigned)(h - '0')
+                                   : (unsigned)((h | 32) - 'a' + 10));
+          }
+          c = v < 128 ? (char)v : '?';
+          break;
+        }
+        default: c = e;
+      }
+    }
+    if (n + 2 > cap) {
+      cap *= 2;
+      s = (char*)realloc(s, cap);
+    }
+    s[n++] = c;
+    ++*p;
+  }
+  if (**p == '"') ++*p;
+  s[n] = 0;
+  return s;
+}
+
+static jval* jnew(jtype t) {
+  jval* v = (jval*)calloc(1, sizeof(jval));
+  v->t = t;
+  return v;
+}
+
+static jval* jparse(const char** p) {
+  jskip(p);
+  char c = **p;
+  if (c == '{') {
+    jval* v = jnew(J_OBJ);
+    ++*p;
+    jskip(p);
+    while (**p && **p != '}') {
+      char* key = jstring(p);
+      jskip(p);
+      if (**p == ':') ++*p;
+      jval* item = jparse(p);
+      v->items = (jval**)realloc(v->items, sizeof(jval*) * (size_t)(v->n + 1));
+      v->keys = (char**)realloc(v->keys, sizeof(char*) * (size_t)(v->n + 1));
+      v->items[v->n] = item;
+      v->keys[v->n] = key;
+      ++v->n;
+      jskip(p);
+      if (**p == ',') {
+        ++*p;
+        jskip(p);
+      }
+    }
+    if (**p == '}') ++*p;
+    return v;
+  }
+  if (c == '[') {
+    jval* v = jnew(J_ARR);
+    ++*p;
+    jskip(p);
+    while (**p && **p != ']') {
+      jval* item = jparse(p);
+      v->items = (jval**)realloc(v->items, sizeof(jval*) * (size_t)(v->n + 1));
+      v->items[v->n++] = item;
+      jskip(p);
+      if (**p == ',') {
+        ++*p;
+        jskip(p);
+      }
+    }
+    if (**p == ']') ++*p;
+    return v;
+  }
+  if (c == '"') {
+    jval* v = jnew(J_STR);
+    v->str = jstring(p);
+    return v;
+  }
+  if (strncmp(*p, "true", 4) == 0) {
+    *p += 4;
+    jval* v = jnew(J_BOOL);
+    v->num = 1;
+    return v;
+  }
+  if (strncmp(*p, "false", 5) == 0) {
+    *p += 5;
+    return jnew(J_BOOL);
+  }
+  if (strncmp(*p, "null", 4) == 0) {
+    *p += 4;
+    return jnew(J_NULL);
+  }
+  jval* v = jnew(J_NUM);
+  v->num = strtod(*p, (char**)p);
+  return v;
+}
+
+static jval* jget(const jval* obj, const char* key) {
+  if (!obj || obj->t != J_OBJ) return NULL;
+  for (int i = 0; i < obj->n; ++i)
+    if (strcmp(obj->keys[i], key) == 0) return obj->items[i];
+  return NULL;
+}
+
+/* ---- param-string helpers ("(5, 5)", "True", "relu", "3") ----------- */
+
+static const char* pstr(const jval* params, const char* key,
+                        const char* dflt) {
+  jval* v = jget(params, key);
+  return v && v->t == J_STR ? v->str : dflt;
+}
+
+static int pbool(const jval* params, const char* key, int dflt) {
+  const char* s = pstr(params, key, NULL);
+  if (!s) return dflt;
+  return s[0] == 'T' || s[0] == 't' || s[0] == '1';
+}
+
+static double pnum(const jval* params, const char* key, double dflt) {
+  const char* s = pstr(params, key, NULL);
+  return s ? strtod(s, NULL) : dflt;
+}
+
+/* parse "(a, b, ...)" or "a" into ints; returns count */
+static int ptuple(const jval* params, const char* key, int64_t* out,
+                  int cap, int64_t dflt_val, int dflt_n) {
+  const char* s = pstr(params, key, NULL);
+  if (!s) {
+    for (int i = 0; i < dflt_n; ++i) out[i] = dflt_val;
+    return dflt_n;
+  }
+  int n = 0;
+  const char* q = s;
+  while (*q && n < cap) {
+    while (*q && (*q == '(' || *q == ')' || *q == ',' || *q == ' ' ||
+                  *q == '[' || *q == ']'))
+      ++q;
+    if (!*q) break;
+    out[n++] = strtoll(q, (char**)&q, 10);
+  }
+  if (n == 0) {
+    for (int i = 0; i < dflt_n; ++i) out[i] = dflt_val;
+    return dflt_n;
+  }
+  return n;
+}
+
+/* ---- tensors --------------------------------------------------------- */
+
+static mxa_tensor* tnew(int ndim, const int64_t* dims) {
+  mxa_tensor* t = (mxa_tensor*)calloc(1, sizeof(mxa_tensor));
+  t->ndim = ndim;
+  t->size = 1;
+  for (int i = 0; i < ndim; ++i) {
+    t->dims[i] = dims[i];
+    t->size *= dims[i];
+  }
+  t->data = (float*)calloc((size_t)t->size, sizeof(float));
+  return t;
+}
+
+void mxa_free_tensor(mxa_tensor* t) {
+  if (t) {
+    free(t->data);
+    free(t);
+  }
+}
+
+/* ---- model ----------------------------------------------------------- */
+
+typedef struct {
+  char* name;
+  npy_arr arr;
+} named_param;
+
+struct mxa_model {
+  jval* graph;     /* symbol.json */
+  jval* manifest;  /* manifest.json */
+  named_param* params;
+  int n_params;
+  char* input_name;
+  int input_ndim;
+  int64_t input_dims[MXA_MAX_NDIM];
+};
+
+static const npy_arr* find_param(const mxa_model* m, const char* prefix,
+                                 const char* name) {
+  char key[256];
+  snprintf(key, sizeof(key), "%s%s", prefix, name);
+  for (int i = 0; i < m->n_params; ++i)
+    if (strcmp(m->params[i].name, key) == 0) return &m->params[i].arr;
+  return NULL;
+}
+
+/* ---- ops ------------------------------------------------------------- */
+
+static mxa_tensor* op_convolution(const jval* params, mxa_tensor** in,
+                                  int n_in) {
+  if (n_in < 2) {
+    seterr("Convolution: missing weight%s", NULL);
+    return NULL;
+  }
+  int64_t kernel[2] = {1, 1}, stride[2] = {1, 1}, pad[2] = {0, 0},
+          dilate[2] = {1, 1};
+  ptuple(params, "kernel", kernel, 2, 1, 2);
+  ptuple(params, "stride", stride, 2, 1, 2);
+  ptuple(params, "pad", pad, 2, 0, 2);
+  ptuple(params, "dilate", dilate, 2, 1, 2);
+  if (pnum(params, "num_group", 1) != 1) {
+    seterr("Convolution: num_group > 1 unsupported%s", NULL);
+    return NULL;
+  }
+  if (strcmp(pstr(params, "layout", "NCHW"), "NCHW") != 0) {
+    seterr("Convolution: only NCHW layout supported%s", NULL);
+    return NULL;
+  }
+  const mxa_tensor* x = in[0];
+  const mxa_tensor* w = in[1];
+  const mxa_tensor* b = (n_in > 2 && !pbool(params, "no_bias", 0)) ? in[2]
+                                                                   : NULL;
+  if (x->ndim != 4 || w->ndim != 4) {
+    seterr("Convolution: NCHW 2D only%s", NULL);
+    return NULL;
+  }
+  int64_t N = x->dims[0], C = x->dims[1], H = x->dims[2], W = x->dims[3];
+  int64_t F = w->dims[0], kh = kernel[0], kw = kernel[1];
+  int64_t oh = (H + 2 * pad[0] - dilate[0] * (kh - 1) - 1) / stride[0] + 1;
+  int64_t ow = (W + 2 * pad[1] - dilate[1] * (kw - 1) - 1) / stride[1] + 1;
+  int64_t od[4] = {N, F, oh, ow};
+  mxa_tensor* out = tnew(4, od);
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t f = 0; f < F; ++f)
+      for (int64_t y = 0; y < oh; ++y)
+        for (int64_t xo = 0; xo < ow; ++xo) {
+          double acc = b ? b->data[f] : 0.0;
+          for (int64_t c = 0; c < C; ++c)
+            for (int64_t i = 0; i < kh; ++i) {
+              int64_t iy = y * stride[0] - pad[0] + i * dilate[0];
+              if (iy < 0 || iy >= H) continue;
+              const float* xrow = x->data + ((n * C + c) * H + iy) * W;
+              const float* wrow = w->data + ((f * C + c) * kh + i) * kw;
+              for (int64_t j = 0; j < kw; ++j) {
+                int64_t ix = xo * stride[1] - pad[1] + j * dilate[1];
+                if (ix < 0 || ix >= W) continue;
+                acc += (double)xrow[ix] * wrow[j];
+              }
+            }
+          out->data[((n * F + f) * oh + y) * ow + xo] = (float)acc;
+        }
+  return out;
+}
+
+static mxa_tensor* op_fully_connected(const jval* params, mxa_tensor** in,
+                                      int n_in) {
+  if (n_in < 2) {
+    seterr("FullyConnected: missing weight%s", NULL);
+    return NULL;
+  }
+  const mxa_tensor* x = in[0];
+  const mxa_tensor* w = in[1];
+  const mxa_tensor* b = (n_in > 2 && !pbool(params, "no_bias", 0)) ? in[2]
+                                                                   : NULL;
+  int64_t N = x->dims[0];
+  int64_t D = x->size / N;
+  int64_t Hh = w->dims[0];
+  if (w->size != Hh * D) {
+    seterr("FullyConnected: weight/input mismatch%s", NULL);
+    return NULL;
+  }
+  int64_t od[2] = {N, Hh};
+  mxa_tensor* out = tnew(2, od);
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t h = 0; h < Hh; ++h) {
+      double acc = b ? b->data[h] : 0.0;
+      const float* xr = x->data + n * D;
+      const float* wr = w->data + h * D;
+      for (int64_t d = 0; d < D; ++d) acc += (double)xr[d] * wr[d];
+      out->data[n * Hh + h] = (float)acc;
+    }
+  return out;
+}
+
+static mxa_tensor* op_activation(const jval* params, mxa_tensor** in,
+                                 int n_in) {
+  (void)n_in;
+  const char* act = pstr(params, "act_type", "relu");
+  mxa_tensor* out = tnew(in[0]->ndim, in[0]->dims);
+  for (int64_t i = 0; i < in[0]->size; ++i) {
+    float v = in[0]->data[i];
+    if (strcmp(act, "relu") == 0)
+      v = v > 0 ? v : 0;
+    else if (strcmp(act, "tanh") == 0)
+      v = tanhf(v);
+    else if (strcmp(act, "sigmoid") == 0)
+      v = 1.0f / (1.0f + expf(-v));
+    else if (strcmp(act, "softrelu") == 0)
+      v = log1pf(expf(v));
+    else {
+      seterr("Activation: unsupported act_type %s", act);
+      mxa_free_tensor(out);
+      return NULL;
+    }
+    out->data[i] = v;
+  }
+  return out;
+}
+
+static mxa_tensor* op_pooling(const jval* params, mxa_tensor** in,
+                              int n_in) {
+  (void)n_in;
+  const mxa_tensor* x = in[0];
+  if (x->ndim != 4) {
+    seterr("Pooling: NCHW only%s", NULL);
+    return NULL;
+  }
+  const char* type = pstr(params, "pool_type", "max");
+  int is_avg = strcmp(type, "avg") == 0;
+  if (!is_avg && strcmp(type, "max") != 0) {
+    seterr("Pooling: unsupported pool_type %s", type);
+    return NULL;
+  }
+  if (strcmp(pstr(params, "pooling_convention", "valid"), "valid") != 0) {
+    seterr("Pooling: only pooling_convention='valid' supported%s", NULL);
+    return NULL;
+  }
+  if (strcmp(pstr(params, "layout", "NCHW"), "NCHW") != 0) {
+    seterr("Pooling: only NCHW layout supported%s", NULL);
+    return NULL;
+  }
+  int64_t N = x->dims[0], C = x->dims[1], H = x->dims[2], W = x->dims[3];
+  int64_t kernel[2] = {H, W}, stride[2] = {1, 1}, pad[2] = {0, 0};
+  if (pbool(params, "global_pool", 0)) {
+    kernel[0] = H;
+    kernel[1] = W;
+    stride[0] = stride[1] = 1;
+  } else {
+    ptuple(params, "kernel", kernel, 2, 1, 2);
+    ptuple(params, "stride", stride, 2, 1, 2);
+    ptuple(params, "pad", pad, 2, 0, 2);
+  }
+  int64_t oh = (H + 2 * pad[0] - kernel[0]) / stride[0] + 1;
+  int64_t ow = (W + 2 * pad[1] - kernel[1]) / stride[1] + 1;
+  if (oh < 1) oh = 1;
+  if (ow < 1) ow = 1;
+  int64_t od[4] = {N, C, oh, ow};
+  mxa_tensor* out = tnew(4, od);
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t c = 0; c < C; ++c)
+      for (int64_t y = 0; y < oh; ++y)
+        for (int64_t xo = 0; xo < ow; ++xo) {
+          double acc = is_avg ? 0.0 : -INFINITY;
+          for (int64_t i = 0; i < kernel[0]; ++i) {
+            int64_t iy = y * stride[0] - pad[0] + i;
+            if (iy < 0 || iy >= H) continue;
+            for (int64_t j = 0; j < kernel[1]; ++j) {
+              int64_t ix = xo * stride[1] - pad[1] + j;
+              if (ix < 0 || ix >= W) continue;
+              float v = x->data[((n * C + c) * H + iy) * W + ix];
+              if (is_avg)
+                acc += v;
+              else if (v > acc)
+                acc = v;
+            }
+          }
+          /* avg divides by the FULL kernel area, padding included —
+           * the mshadow convention the framework reproduces */
+          out->data[((n * C + c) * oh + y) * ow + xo] =
+              is_avg ? (float)(acc / (double)(kernel[0] * kernel[1]))
+                     : (float)acc;
+        }
+  return out;
+}
+
+static mxa_tensor* op_batchnorm(const jval* params, mxa_tensor** in,
+                                int n_in) {
+  /* inputs: data, gamma, beta + aux moving_mean, moving_var (wired by
+   * the interpreter); inference always uses the moving stats */
+  if (n_in < 5) {
+    seterr("BatchNorm: missing moving stats%s", NULL);
+    return NULL;
+  }
+  const mxa_tensor* x = in[0];
+  const float* gamma = in[1]->data;
+  const float* beta = in[2]->data;
+  const float* mean = in[3]->data;
+  const float* var = in[4]->data;
+  double eps = pnum(params, "eps", 1e-3);
+  int fix_gamma = pbool(params, "fix_gamma", 1);
+  int64_t C = x->ndim > 1 ? x->dims[1] : x->dims[0];
+  int64_t inner = 1;
+  for (int i = 2; i < x->ndim; ++i) inner *= x->dims[i];
+  int64_t N = x->dims[0];
+  mxa_tensor* out = tnew(x->ndim, x->dims);
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t c = 0; c < C; ++c) {
+      float g = fix_gamma ? 1.0f : gamma[c];
+      float scale = (float)((double)g / sqrt((double)var[c] + eps));
+      float shift = beta[c] - mean[c] * scale;
+      float* dst = out->data + (n * C + c) * inner;
+      const float* src = x->data + (n * C + c) * inner;
+      for (int64_t i = 0; i < inner; ++i) dst[i] = src[i] * scale + shift;
+    }
+  return out;
+}
+
+static mxa_tensor* op_flatten(mxa_tensor** in) {
+  int64_t od[2] = {in[0]->dims[0], in[0]->size / in[0]->dims[0]};
+  mxa_tensor* out = tnew(2, od);
+  memcpy(out->data, in[0]->data, sizeof(float) * (size_t)out->size);
+  return out;
+}
+
+static mxa_tensor* op_reshape(const jval* params, mxa_tensor** in) {
+  int64_t spec[MXA_MAX_NDIM];
+  int n = ptuple(params, "shape", spec, MXA_MAX_NDIM, 0, 0);
+  if (n == 0) {
+    seterr("Reshape: missing shape%s", NULL);
+    return NULL;
+  }
+  int64_t od[MXA_MAX_NDIM];
+  int64_t known = 1;
+  int infer = -1;
+  for (int i = 0; i < n; ++i) {
+    int64_t v = spec[i];
+    if (v == 0) v = in[0]->dims[i]; /* mxnet: 0 copies the input dim */
+    if (v == -1) {
+      infer = i;
+      od[i] = 1;
+    } else {
+      od[i] = v;
+      known *= v;
+    }
+  }
+  if (infer >= 0) od[infer] = in[0]->size / known;
+  mxa_tensor* out = tnew(n, od);
+  if (out->size != in[0]->size) {
+    seterr("Reshape: size mismatch%s", NULL);
+    mxa_free_tensor(out);
+    return NULL;
+  }
+  memcpy(out->data, in[0]->data, sizeof(float) * (size_t)out->size);
+  return out;
+}
+
+static mxa_tensor* op_concat(const jval* params, mxa_tensor** in, int n_in) {
+  int64_t axis = (int64_t)pnum(params, "dim", 1);
+  const mxa_tensor* a = in[0];
+  int64_t od[MXA_MAX_NDIM];
+  memcpy(od, a->dims, sizeof(od));
+  for (int i = 1; i < n_in; ++i) od[axis] += in[i]->dims[axis];
+  mxa_tensor* out = tnew(a->ndim, od);
+  int64_t outer = 1, inner = 1;
+  for (int i = 0; i < (int)axis; ++i) outer *= a->dims[i];
+  for (int i = (int)axis + 1; i < a->ndim; ++i) inner *= a->dims[i];
+  int64_t off = 0;
+  for (int t = 0; t < n_in; ++t) {
+    int64_t ax = in[t]->dims[axis];
+    for (int64_t o = 0; o < outer; ++o)
+      memcpy(out->data + (o * od[axis] + off) * inner,
+             in[t]->data + o * ax * inner,
+             sizeof(float) * (size_t)(ax * inner));
+    off += ax;
+  }
+  return out;
+}
+
+static mxa_tensor* op_softmax_output(mxa_tensor** in) {
+  const mxa_tensor* x = in[0];
+  int64_t N = x->dims[0], C = x->size / x->dims[0];
+  mxa_tensor* out = tnew(x->ndim, x->dims);
+  for (int64_t n = 0; n < N; ++n) {
+    const float* xr = x->data + n * C;
+    float* o = out->data + n * C;
+    float mx = xr[0];
+    for (int64_t c = 1; c < C; ++c)
+      if (xr[c] > mx) mx = xr[c];
+    double sum = 0.0;
+    for (int64_t c = 0; c < C; ++c) {
+      o[c] = expf(xr[c] - mx);
+      sum += o[c];
+    }
+    for (int64_t c = 0; c < C; ++c) o[c] = (float)(o[c] / sum);
+  }
+  return out;
+}
+
+static mxa_tensor* op_elemwise(const char* op, mxa_tensor** in, int n_in) {
+  if (n_in != 2 || in[0]->size != in[1]->size) {
+    seterr("%s: needs two same-shape inputs", op);
+    return NULL;
+  }
+  mxa_tensor* out = tnew(in[0]->ndim, in[0]->dims);
+  const float* a = in[0]->data;
+  const float* b = in[1]->data;
+  char k = op[1]; /* _plus/_minus/_mul */
+  for (int64_t i = 0; i < out->size; ++i)
+    out->data[i] = k == 'p' ? a[i] + b[i]
+                 : k == 'm' && op[2] == 'i' ? a[i] - b[i]
+                                            : a[i] * b[i];
+  return out;
+}
+
+/* ---- interpreter ----------------------------------------------------- */
+
+const char* mxa_input_name(const mxa_model* m) { return m->input_name; }
+int mxa_input_ndim(const mxa_model* m) { return m->input_ndim; }
+const int64_t* mxa_input_dims(const mxa_model* m) { return m->input_dims; }
+
+mxa_tensor* mxa_forward(mxa_model* m, const float* data,
+                        const int64_t* dims, int ndim) {
+  jval* nodes = jget(m->graph, "nodes");
+  jval* heads = jget(m->graph, "heads");
+  if (!nodes || !heads || heads->n < 1) {
+    seterr("graph: missing nodes/heads%s", NULL);
+    return NULL;
+  }
+  int n_nodes = nodes->n;
+  /* per-node single-output values (multi-output ops unsupported) */
+  mxa_tensor** vals = (mxa_tensor**)calloc((size_t)n_nodes,
+                                           sizeof(mxa_tensor*));
+  mxa_tensor* result = NULL;
+
+  for (int i = 0; i < n_nodes; ++i) {
+    jval* node = nodes->items[i];
+    const char* op = jget(node, "op")->str;
+    const char* name = jget(node, "name")->str;
+    jval* params = jget(node, "param");
+    jval* inputs = jget(node, "inputs");
+
+    if (strcmp(op, "null") == 0) {
+      /* variable: data input, weight, or aux state */
+      if (strcmp(name, m->input_name) == 0) {
+        mxa_tensor* t = tnew(ndim, dims);
+        memcpy(t->data, data, sizeof(float) * (size_t)t->size);
+        vals[i] = t;
+      } else {
+        const npy_arr* p = find_param(m, "arg:", name);
+        if (!p) p = find_param(m, "aux:", name);
+        if (!p) {
+          /* unused free input (a label at inference): leave NULL; ops
+           * that would consume it (SoftmaxOutput) ignore it */
+          vals[i] = NULL;
+          continue;
+        }
+        mxa_tensor* t = tnew(p->ndim, p->dims);
+        memcpy(t->data, p->data, sizeof(float) * (size_t)t->size);
+        vals[i] = t;
+      }
+      continue;
+    }
+
+    /* gather inputs (fail loudly on overflow — silent truncation would
+     * return wrong results for e.g. a 17-branch Concat) */
+    mxa_tensor* ins[64];
+    int n_in = 0;
+    for (int k = 0; inputs && k < inputs->n; ++k) {
+      int src = (int)inputs->items[k]->items[0]->num;
+      if (vals[src] == NULL) continue; /* skipped free input (label) */
+      if (n_in >= 64) {
+        seterr("op %s: more than 64 inputs unsupported", name);
+        goto fail;
+      }
+      ins[n_in++] = vals[src];
+    }
+
+    mxa_tensor* out = NULL;
+    if (strcmp(op, "Convolution") == 0)
+      out = op_convolution(params, ins, n_in);
+    else if (strcmp(op, "FullyConnected") == 0)
+      out = op_fully_connected(params, ins, n_in);
+    else if (strcmp(op, "Activation") == 0)
+      out = op_activation(params, ins, n_in);
+    else if (strcmp(op, "Pooling") == 0)
+      out = op_pooling(params, ins, n_in);
+    else if (strcmp(op, "BatchNorm") == 0)
+      out = op_batchnorm(params, ins, n_in);
+    else if (strcmp(op, "Flatten") == 0)
+      out = op_flatten(ins);
+    else if (strcmp(op, "Reshape") == 0)
+      out = op_reshape(params, ins);
+    else if (strcmp(op, "Concat") == 0)
+      out = op_concat(params, ins, n_in);
+    else if (strcmp(op, "Dropout") == 0) {
+      out = tnew(ins[0]->ndim, ins[0]->dims);
+      memcpy(out->data, ins[0]->data, sizeof(float) * (size_t)out->size);
+    } else if (strcmp(op, "SoftmaxOutput") == 0)
+      out = op_softmax_output(ins);
+    else if (strcmp(op, "_plus") == 0 || strcmp(op, "_minus") == 0 ||
+             strcmp(op, "_mul") == 0 || strcmp(op, "elemwise_add") == 0)
+      out = op_elemwise(op[0] == 'e' ? "_plus" : op, ins, n_in);
+    else {
+      seterr("unsupported op in deploy artifact: %s", op);
+      goto fail;
+    }
+    if (!out) goto fail;
+    vals[i] = out;
+  }
+
+  {
+    int head = (int)heads->items[0]->items[0]->num;
+    if (!vals[head]) {
+      seterr("graph head has no value%s", NULL);
+      goto fail;
+    }
+    /* detach the head so the cleanup below keeps it alive */
+    result = vals[head];
+    vals[head] = NULL;
+  }
+
+fail:
+  for (int i = 0; i < n_nodes; ++i) mxa_free_tensor(vals[i]);
+  free(vals);
+  return result;
+}
+
+/* ---- load / free ----------------------------------------------------- */
+
+mxa_model* mxa_load(const char* path) {
+  size_t zlen = 0;
+  uint8_t* zip = slurp(path, &zlen);
+  if (!zip) return NULL;
+  mxa_model* m = (mxa_model*)calloc(1, sizeof(mxa_model));
+
+  size_t slen = 0, mlen = 0, plen = 0;
+  const uint8_t* sj = zip_find(zip, zlen, "symbol.json", &slen);
+  const uint8_t* mj = zip_find(zip, zlen, "manifest.json", &mlen);
+  const uint8_t* pz = zip_find(zip, zlen, "params.npz", &plen);
+  if (!sj || !mj || !pz) goto fail;
+
+  {
+    char* txt = (char*)malloc(slen + 1);
+    memcpy(txt, sj, slen);
+    txt[slen] = 0;
+    const char* p = txt;
+    m->graph = jparse(&p);
+    free(txt);
+    txt = (char*)malloc(mlen + 1);
+    memcpy(txt, mj, mlen);
+    txt[mlen] = 0;
+    p = txt;
+    m->manifest = jparse(&p);
+    free(txt);
+  }
+
+  /* params.npz: a stored zip of <key>.npy entries */
+  {
+    size_t p = 0;
+    if (plen < 22) {
+      seterr("params.npz: too small%s", NULL);
+      goto fail;
+    }
+    /* iterate central directory of the inner zip */
+    size_t i = plen - 22;
+    for (;;) {
+      if (pz[i] == 0x50 && pz[i + 1] == 0x4b && pz[i + 2] == 0x05 &&
+          pz[i + 3] == 0x06)
+        break;
+      if (i == 0) {
+        seterr("params.npz: no EOCD%s", NULL);
+        goto fail;
+      }
+      --i;
+    }
+    uint16_t n_entries = rd16(pz + i + 10);
+    p = rd32(pz + i + 16);
+    for (uint16_t e = 0; e < n_entries; ++e) {
+      if (rd32(pz + p) != 0x02014b50) {
+        seterr("params.npz: bad central directory%s", NULL);
+        goto fail;
+      }
+      uint16_t method = rd16(pz + p + 10);
+      uint32_t csize = rd32(pz + p + 20);
+      uint16_t nlen = rd16(pz + p + 28);
+      uint16_t xlen = rd16(pz + p + 30);
+      uint16_t clen = rd16(pz + p + 32);
+      uint32_t lho = rd32(pz + p + 42);
+      char ename[256] = {0};
+      memcpy(ename, pz + p + 46, nlen < 255 ? nlen : 255);
+      if (method != 0) {
+        seterr("params.npz entry %s compressed", ename);
+        goto fail;
+      }
+      uint16_t lnlen = rd16(pz + lho + 26);
+      uint16_t lxlen = rd16(pz + lho + 28);
+      const uint8_t* payload = pz + lho + 30 + lnlen + lxlen;
+
+      /* strip .npy; detect the bf16 tag the framework's savez applies */
+      char key[256];
+      snprintf(key, sizeof(key), "%s", ename);
+      size_t kl = strlen(key);
+      if (kl > 4 && strcmp(key + kl - 4, ".npy") == 0) key[kl - 4] = 0;
+      int bf16 = strncmp(key, "__bf16__:", 9) == 0;
+
+      npy_arr arr;
+      if (npy_parse(payload, csize, &arr, bf16) != 0) goto fail;
+      m->params = (named_param*)realloc(
+          m->params, sizeof(named_param) * (size_t)(m->n_params + 1));
+      m->params[m->n_params].name = xstrdup(bf16 ? key + 9 : key);
+      m->params[m->n_params].arr = arr;
+      ++m->n_params;
+      p += 46 + (size_t)nlen + xlen + clen;
+    }
+  }
+
+  /* manifest: single data input (v1 contract) */
+  {
+    jval* names = jget(m->manifest, "data_names");
+    if (!names || names->t != J_ARR || names->n != 1) {
+      seterr("manifest: exactly one data input supported%s", NULL);
+      goto fail;
+    }
+    m->input_name = xstrdup(names->items[0]->str);
+    jval* shapes = jget(m->manifest, "input_shapes");
+    jval* shp = jget(shapes, m->input_name);
+    m->input_ndim = shp ? shp->n : 0;
+    for (int i = 0; shp && i < shp->n && i < MXA_MAX_NDIM; ++i)
+      m->input_dims[i] = (int64_t)shp->items[i]->num;
+  }
+
+  free(zip);
+  return m;
+
+fail:
+  free(zip);
+  mxa_free(m);
+  return NULL;
+}
+
+void mxa_free(mxa_model* m) {
+  if (!m) return;
+  jfree(m->graph);
+  jfree(m->manifest);
+  for (int i = 0; i < m->n_params; ++i) {
+    free(m->params[i].name);
+    free(m->params[i].arr.data);
+  }
+  free(m->params);
+  free(m->input_name);
+  free(m);
+}

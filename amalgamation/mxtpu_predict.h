@@ -1,0 +1,67 @@
+/* mxtpu amalgamation: single-file, dependency-free C inference runtime.
+ *
+ * The deploy analog of the reference's amalgamation/ predict-only build
+ * (c_predict_api.h consumed from one compiled file on mobile/JS): this
+ * pair (mxtpu_predict.h + mxtpu_predict.c) compiles with any C99
+ * compiler against libc + libm ONLY — no Python, no jax, no zlib — and
+ * runs the .mxa artifact `mxnet_tpu.predict.export_model` (or
+ * `tools/export_model.py`) produces: a STORED zip holding symbol.json
+ * + params.npz (+ StableHLO for jax-side consumers, ignored here).
+ *
+ *   cc -O2 app.c mxtpu_predict.c -lm
+ *
+ *   mxa_model* m = mxa_load("model.mxa");
+ *   mxa_tensor* out = mxa_forward(m, data, dims, 4);
+ *   ... out->data[0..out->size) ...
+ *   mxa_free_tensor(out); mxa_free(m);
+ *
+ * Inference-only, float32, NCHW.  Supported ops: Convolution,
+ * FullyConnected, BatchNorm (moving stats), Activation, Pooling
+ * (max/avg/global), Flatten, Reshape, Concat, Dropout (identity),
+ * SoftmaxOutput, elementwise _plus/_minus/_mul — the full ResNet /
+ * LeNet / MLP / VGG inference family.  Anything else fails loudly via
+ * mxa_last_error().
+ */
+#ifndef MXTPU_AMALGAMATION_PREDICT_H_
+#define MXTPU_AMALGAMATION_PREDICT_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MXA_MAX_NDIM 8
+
+typedef struct {
+  int ndim;
+  int64_t dims[MXA_MAX_NDIM];
+  int64_t size;
+  float* data;
+} mxa_tensor;
+
+typedef struct mxa_model mxa_model;
+
+/* Load a .mxa artifact; NULL on failure (see mxa_last_error). */
+mxa_model* mxa_load(const char* path);
+
+/* Name/shape of the (single) data input recorded at export time. */
+const char* mxa_input_name(const mxa_model* m);
+int mxa_input_ndim(const mxa_model* m);
+const int64_t* mxa_input_dims(const mxa_model* m);
+
+/* Run the graph on one batch (any leading batch size; trailing dims
+ * must match the export shape).  Returns a fresh tensor (caller frees
+ * with mxa_free_tensor) or NULL on failure. */
+mxa_tensor* mxa_forward(mxa_model* m, const float* data,
+                        const int64_t* dims, int ndim);
+
+const char* mxa_last_error(void);
+void mxa_free_tensor(mxa_tensor* t);
+void mxa_free(mxa_model* m);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_AMALGAMATION_PREDICT_H_ */

@@ -57,15 +57,13 @@ def publish_registry(lib=None):
     lib = lib or libinfo.find_lib()
     if lib is None:
         return False
-    for name in sorted(OP_REGISTRY._entries):
-        op = OP_REGISTRY.get(name)
+    for key in sorted(OP_REGISTRY._entries):
+        op = OP_REGISTRY.get(key)
         # the registry's keys are lowercase lookup names; publish the
         # canonical display name ("Convolution") for an op's primary
         # key so C consumers discover the names the docs/examples use
         # (alias keys pass through as themselves: "_add", "crop", ...)
-        canonical = getattr(op, "name", name)
-        if isinstance(canonical, str) and canonical.lower() == name:
-            name = canonical
+        name = _canonical_name(key)
         try:
             params = op.make_params({}) if op.param_cls else None
         except Exception:
@@ -94,11 +92,22 @@ def _ensure_published(lib):
         publish_registry(lib)
 
 
+def _canonical_name(key):
+    """Display form of a registry key: the op's canonical name for its
+    primary key, the key itself for aliases (same rule the native
+    registry publication applies)."""
+    op = OP_REGISTRY.get(key)
+    canonical = getattr(op, "name", key)
+    return (canonical if isinstance(canonical, str)
+            and canonical.lower() == key else key)
+
+
 def list_ops():
     """Op names via the C ABI (MXSymbolListAtomicSymbolCreators shape)."""
     lib = libinfo.find_lib()
     if lib is None:
-        return sorted(OP_REGISTRY._entries)
+        # same canonical-name contract as the native path
+        return sorted(_canonical_name(k) for k in OP_REGISTRY._entries)
     _ensure_published(lib)
     n = ctypes.c_int()
     names = ctypes.POINTER(ctypes.c_char_p)()

@@ -240,6 +240,7 @@ def create(symbol_json, params, input_shapes, ctx=None, **kwargs):
 _MANIFEST = "manifest.json"
 _STABLEHLO = "model.stablehlo"
 _PARAMS = "params.npz"
+_SYMBOL = "symbol.json"
 
 
 def export_model(path, symbol, arg_params, aux_params, input_shapes,
@@ -310,10 +311,15 @@ def export_model(path, symbol, arg_params, aux_params, input_shapes,
 
     buf = io.BytesIO()
     np.savez(buf, **_encode_bf16(params_np))
-    with zipfile.ZipFile(path, "w") as zf:
+    # entries deliberately STORED (no deflate): the amalgamation C
+    # runtime (amalgamation/mxtpu_predict.c) parses the zip + npz with
+    # no zlib — one artifact serves both the jax loader and the
+    # Python-free deploy target
+    with zipfile.ZipFile(path, "w", compression=zipfile.ZIP_STORED) as zf:
         zf.writestr(_MANIFEST, json.dumps(manifest, indent=1))
         zf.writestr(_STABLEHLO, exported.serialize())
         zf.writestr(_PARAMS, buf.getvalue())
+        zf.writestr(_SYMBOL, symbol.tojson())
 
 
 class ExportedPredictor:

@@ -1,0 +1,119 @@
+/* Deploy-artifact consumer: compiled against ONLY the amalgamation
+ * pair + libm (no libmxtpu, no Python): proves "one file + artifact
+ * runs without the Python tree" (reference amalgamation/ contract).
+ *
+ * Usage: amalgamation_consumer model.mxa input.npy output.npy
+ * Reads a float32 C-order .npy batch, runs the graph, writes the
+ * output as .npy v1 for the test harness to compare against the
+ * Python predictor. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "../../amalgamation/mxtpu_predict.h"
+
+static float* read_npy(const char* path, int64_t* dims, int* ndim) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return NULL;
+  unsigned char hdr[10];
+  if (fread(hdr, 1, 10, f) != 10 || memcmp(hdr, "\x93NUMPY", 6) != 0) {
+    fclose(f);
+    return NULL;
+  }
+  unsigned hlen = hdr[8] | (hdr[9] << 8);
+  char* h = (char*)malloc(hlen + 1);
+  if (fread(h, 1, hlen, f) != hlen) {
+    free(h);
+    fclose(f);
+    return NULL;
+  }
+  h[hlen] = 0;
+  if (!strstr(h, "<f4")) {
+    fprintf(stderr, "input must be float32\n");
+    free(h);
+    fclose(f);
+    return NULL;
+  }
+  char* s = strchr(strstr(h, "'shape'"), '(');
+  *ndim = 0;
+  int64_t size = 1;
+  char* q = s + 1;
+  while (*q && *q != ')') {
+    while (*q == ' ' || *q == ',') ++q;
+    if (*q == ')' || !*q) break;
+    dims[(*ndim)++] = strtoll(q, &q, 10);
+    size *= dims[*ndim - 1];
+  }
+  free(h);
+  float* data = (float*)malloc(sizeof(float) * (size_t)size);
+  if (fread(data, sizeof(float), (size_t)size, f) != (size_t)size) {
+    free(data);
+    fclose(f);
+    return NULL;
+  }
+  fclose(f);
+  return data;
+}
+
+static int write_npy(const char* path, const mxa_tensor* t) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return -1;
+  char shape[128] = "";
+  for (int i = 0; i < t->ndim; ++i) {
+    char d[24];
+    snprintf(d, sizeof(d), "%lld,", (long long)t->dims[i]);
+    strcat(shape, d);
+  }
+  char dict[256];
+  snprintf(dict, sizeof(dict),
+           "{'descr': '<f4', 'fortran_order': False, 'shape': (%s), }",
+           shape);
+  size_t dlen = strlen(dict);
+  size_t total = 10 + dlen;
+  size_t pad = (64 - total % 64) % 64;
+  unsigned hlen = (unsigned)(dlen + pad);
+  fwrite("\x93NUMPY\x01\x00", 1, 8, f);
+  fputc(hlen & 0xff, f);
+  fputc((hlen >> 8) & 0xff, f);
+  fwrite(dict, 1, dlen, f);
+  for (size_t i = 0; i < pad - 1; ++i) fputc(' ', f);
+  fputc('\n', f);
+  fwrite(t->data, sizeof(float), (size_t)t->size, f);
+  fclose(f);
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s model.mxa in.npy out.npy\n", argv[0]);
+    return 2;
+  }
+  mxa_model* m = mxa_load(argv[1]);
+  if (!m) {
+    fprintf(stderr, "FAIL load: %s\n", mxa_last_error());
+    return 1;
+  }
+  fprintf(stderr, "model input %s ndim=%d\n", mxa_input_name(m),
+          mxa_input_ndim(m));
+  int64_t dims[MXA_MAX_NDIM];
+  int ndim = 0;
+  float* data = read_npy(argv[2], dims, &ndim);
+  if (!data) {
+    fprintf(stderr, "FAIL reading %s\n", argv[2]);
+    return 1;
+  }
+  mxa_tensor* out = mxa_forward(m, data, dims, ndim);
+  if (!out) {
+    fprintf(stderr, "FAIL forward: %s\n", mxa_last_error());
+    return 1;
+  }
+  if (write_npy(argv[3], out) != 0) {
+    fprintf(stderr, "FAIL writing %s\n", argv[3]);
+    return 1;
+  }
+  printf("AMALGAMATION_OK %lld\n", (long long)out->size);
+  mxa_free_tensor(out);
+  mxa_free(m);
+  free(data);
+  return 0;
+}
