@@ -313,7 +313,9 @@ static char* jstring(const char** p) {
         case 'r': c = '\r'; break;
         case 'b': c = '\b'; break;
         case 'f': c = '\f'; break;
-        case 'u': { /* \uXXXX: keep ASCII, replace others with '?' */
+        case 'u': { /* \uXXXX -> UTF-8 (npz keys keep raw UTF-8, so
+                     * names must round-trip byte-exactly for
+                     * find_param to match) */
           unsigned v = 0;
           for (int k = 0; k < 4 && (*p)[1]; ++k) {
             ++*p;
@@ -321,8 +323,22 @@ static char* jstring(const char** p) {
             v = v * 16 + (h <= '9' ? (unsigned)(h - '0')
                                    : (unsigned)((h | 32) - 'a' + 10));
           }
-          c = v < 128 ? (char)v : '?';
-          break;
+          if (n + 5 > cap) {
+            cap = cap * 2 + 8;
+            s = (char*)realloc(s, cap);
+          }
+          if (v < 0x80) {
+            s[n++] = (char)v;
+          } else if (v < 0x800) {
+            s[n++] = (char)(0xC0 | (v >> 6));
+            s[n++] = (char)(0x80 | (v & 0x3F));
+          } else { /* BMP (surrogate pairs not expected in node names) */
+            s[n++] = (char)(0xE0 | (v >> 12));
+            s[n++] = (char)(0x80 | ((v >> 6) & 0x3F));
+            s[n++] = (char)(0x80 | (v & 0x3F));
+          }
+          ++*p;
+          continue;
         }
         default: c = e;
       }
@@ -597,28 +613,35 @@ static mxa_tensor* op_fully_connected(const jval* params, mxa_tensor** in,
   return out;
 }
 
+static float act_relu(float v) { return v > 0 ? v : 0; }
+static float act_sigmoid(float v) { return 1.0f / (1.0f + expf(-v)); }
+static float act_softrelu(float v) {
+  /* stable softplus: expf overflows past ~88, jax.nn.softplus doesn't */
+  return (v > 0 ? v : 0) + log1pf(expf(-fabsf(v)));
+}
+
 static mxa_tensor* op_activation(const jval* params, mxa_tensor** in,
                                  int n_in) {
   (void)n_in;
   const char* act = pstr(params, "act_type", "relu");
-  mxa_tensor* out = tnew(in[0]->ndim, in[0]->dims);
-  for (int64_t i = 0; i < in[0]->size; ++i) {
-    float v = in[0]->data[i];
-    if (strcmp(act, "relu") == 0)
-      v = v > 0 ? v : 0;
-    else if (strcmp(act, "tanh") == 0)
-      v = tanhf(v);
-    else if (strcmp(act, "sigmoid") == 0)
-      v = 1.0f / (1.0f + expf(-v));
-    else if (strcmp(act, "softrelu") == 0)
-      v = log1pf(expf(v));
-    else {
-      seterr("Activation: unsupported act_type %s", act);
-      mxa_free_tensor(out);
-      return NULL;
-    }
-    out->data[i] = v;
+  /* dispatch ONCE — this is the deploy hot path, and failing before
+   * allocation keeps the error path clean */
+  float (*fn)(float) = NULL;
+  if (strcmp(act, "relu") == 0)
+    fn = act_relu;
+  else if (strcmp(act, "tanh") == 0)
+    fn = tanhf;
+  else if (strcmp(act, "sigmoid") == 0)
+    fn = act_sigmoid;
+  else if (strcmp(act, "softrelu") == 0)
+    fn = act_softrelu;
+  else {
+    seterr("Activation: unsupported act_type %s", act);
+    return NULL;
   }
+  mxa_tensor* out = tnew(in[0]->ndim, in[0]->dims);
+  for (int64_t i = 0; i < in[0]->size; ++i)
+    out->data[i] = fn(in[0]->data[i]);
   return out;
 }
 
@@ -703,6 +726,10 @@ static mxa_tensor* op_batchnorm(const jval* params, mxa_tensor** in,
   const float* var = in[4]->data;
   double eps = pnum(params, "eps", 1e-3);
   int fix_gamma = pbool(params, "fix_gamma", 1);
+  if (pnum(params, "axis", 1) != 1) {
+    seterr("BatchNorm: only axis=1 (NCHW channel) supported%s", NULL);
+    return NULL;
+  }
   int64_t C = x->ndim > 1 ? x->dims[1] : x->dims[0];
   int64_t inner = 1;
   for (int i = 2; i < x->ndim; ++i) inner *= x->dims[i];
@@ -825,6 +852,10 @@ const int64_t* mxa_input_dims(const mxa_model* m) { return m->input_dims; }
 
 mxa_tensor* mxa_forward(mxa_model* m, const float* data,
                         const int64_t* dims, int ndim) {
+  if (ndim < 1 || ndim > MXA_MAX_NDIM) {
+    seterr("mxa_forward: ndim out of range [1, 8]%s", NULL);
+    return NULL;
+  }
   jval* nodes = jget(m->graph, "nodes");
   jval* heads = jget(m->graph, "heads");
   if (!nodes || !heads || heads->n < 1) {
@@ -981,7 +1012,9 @@ mxa_model* mxa_load(const char* path) {
     uint16_t n_entries = rd16(pz + i + 10);
     p = rd32(pz + i + 16);
     for (uint16_t e = 0; e < n_entries; ++e) {
-      if (rd32(pz + p) != 0x02014b50) {
+      /* same bounds discipline as zip_find: a corrupt artifact must
+       * seterr, never read past the slurped buffer */
+      if (p + 46 > plen || rd32(pz + p) != 0x02014b50) {
         seterr("params.npz: bad central directory%s", NULL);
         goto fail;
       }
@@ -991,14 +1024,26 @@ mxa_model* mxa_load(const char* path) {
       uint16_t xlen = rd16(pz + p + 30);
       uint16_t clen = rd16(pz + p + 32);
       uint32_t lho = rd32(pz + p + 42);
+      if (p + 46 + (size_t)nlen > plen) {
+        seterr("params.npz: entry name out of bounds%s", NULL);
+        goto fail;
+      }
       char ename[256] = {0};
       memcpy(ename, pz + p + 46, nlen < 255 ? nlen : 255);
       if (method != 0) {
         seterr("params.npz entry %s compressed", ename);
         goto fail;
       }
+      if ((size_t)lho + 30 > plen || rd32(pz + lho) != 0x04034b50) {
+        seterr("params.npz: bad local header for %s", ename);
+        goto fail;
+      }
       uint16_t lnlen = rd16(pz + lho + 26);
       uint16_t lxlen = rd16(pz + lho + 28);
+      if ((size_t)lho + 30 + lnlen + lxlen + csize > plen) {
+        seterr("params.npz: entry %s truncated", ename);
+        goto fail;
+      }
       const uint8_t* payload = pz + lho + 30 + lnlen + lxlen;
 
       /* strip .npy; detect the bf16 tag the framework's savez applies */

@@ -27,6 +27,7 @@
 #include <mutex>
 #include <random>
 #include <string>
+#include <cstdlib>
 #include <thread>
 #include <vector>
 
@@ -67,6 +68,19 @@ class ImagePipeline {
                 int depth)
       : path_(std::move(path)), offsets_(offsets, offsets + n), cfg_(cfg),
         depth_(depth < 1 ? 1 : depth), n_threads_(threads < 1 ? 1 : threads) {
+    // Decode threads beyond the physical cores usually cannot add
+    // throughput — they only add involuntary context switches on a
+    // saturated core (measured: 554 -> 440 img/s going 1 -> 2 threads
+    // on a 1-core host, IO_BENCH.json).  Clamp to the hardware width
+    // by default; MXTPU_IO_THREADS_UNCAPPED=1 honors the raw request
+    // for hosts where decode threads spend real time blocked on
+    // storage (NFS/spinning disk) and oversubscription overlaps the
+    // fread stalls.
+    const char* uncapped = std::getenv("MXTPU_IO_THREADS_UNCAPPED");
+    if (uncapped == nullptr || uncapped[0] != '1') {
+      unsigned hw = std::thread::hardware_concurrency();
+      if (hw > 0 && n_threads_ > (int)hw) n_threads_ = (int)hw;
+    }
     if (mean_img != nullptr)
       mean_img_.assign(mean_img,
                        mean_img + (size_t)cfg.c * cfg.h * cfg.w);

@@ -41,6 +41,12 @@ static float* read_npy(const char* path, int64_t* dims, int* ndim) {
   while (*q && *q != ')') {
     while (*q == ' ' || *q == ',') ++q;
     if (*q == ')' || !*q) break;
+    if (*ndim >= MXA_MAX_NDIM) {
+      fprintf(stderr, "input ndim > %d unsupported\n", MXA_MAX_NDIM);
+      free(h);
+      fclose(f);
+      return NULL;
+    }
     dims[(*ndim)++] = strtoll(q, &q, 10);
     size *= dims[*ndim - 1];
   }
@@ -58,25 +64,32 @@ static float* read_npy(const char* path, int64_t* dims, int* ndim) {
 static int write_npy(const char* path, const mxa_tensor* t) {
   FILE* f = fopen(path, "wb");
   if (!f) return -1;
-  char shape[128] = "";
+  char shape[256] = "";
+  size_t used = 0;
   for (int i = 0; i < t->ndim; ++i) {
-    char d[24];
-    snprintf(d, sizeof(d), "%lld,", (long long)t->dims[i]);
-    strcat(shape, d);
+    int w = snprintf(shape + used, sizeof(shape) - used, "%lld,",
+                     (long long)t->dims[i]);
+    if (w < 0 || used + (size_t)w >= sizeof(shape)) {
+      fclose(f);
+      return -1;
+    }
+    used += (size_t)w;
   }
-  char dict[256];
+  char dict[512];
   snprintf(dict, sizeof(dict),
            "{'descr': '<f4', 'fortran_order': False, 'shape': (%s), }",
            shape);
   size_t dlen = strlen(dict);
-  size_t total = 10 + dlen;
-  size_t pad = (64 - total % 64) % 64;
+  /* header (magic+len+dict+pad) must be 64-aligned and END in \n:
+   * at least one pad byte is always needed for the newline */
+  size_t pad = 64 - (10 + dlen) % 64;
+  if (pad == 0) pad = 64;
   unsigned hlen = (unsigned)(dlen + pad);
   fwrite("\x93NUMPY\x01\x00", 1, 8, f);
   fputc(hlen & 0xff, f);
   fputc((hlen >> 8) & 0xff, f);
   fwrite(dict, 1, dlen, f);
-  for (size_t i = 0; i < pad - 1; ++i) fputc(' ', f);
+  for (size_t i = 0; i + 1 < pad; ++i) fputc(' ', f);
   fputc('\n', f);
   fwrite(t->data, sizeof(float), (size_t)t->size, f);
   fclose(f);

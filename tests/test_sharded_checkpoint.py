@@ -221,3 +221,30 @@ def test_bf16_shards_roundtrip(tmp_path):
     assert restored["w"].dtype == jax.numpy.bfloat16.dtype
     np.testing.assert_array_equal(np.asarray(restored["w"], np.float32),
                                   np.asarray(tree["w"], np.float32))
+
+
+def test_latest_complete_step_numeric_and_partial(tmp_path):
+    """Resume-point scan: numeric ordering past the 4-digit padding
+    (step-10000 > step-9999 despite lexicographic order) and torn-save
+    skipping (a step missing any proc's meta/npz is not complete)."""
+    import os
+
+    from mxnet_tpu.parallel.checkpoint import latest_complete_step
+
+    def make(step, procs, torn=False):
+        d = tmp_path / f"step-{step:04d}"
+        d.mkdir()
+        for p in range(procs):
+            (d / f"meta-proc{p}.json").write_text("{}")
+            if not (torn and p == procs - 1):
+                (d / f"shards-proc{p}.npz").write_text("x")
+
+    assert latest_complete_step(str(tmp_path), n_procs=2) is None
+    make(3, 2)
+    make(9999, 2)
+    make(10000, 2)          # lexicographically BELOW step-9999
+    make(10001, 2, torn=True)   # newest but incomplete -> skipped
+    (tmp_path / "step-bogus").mkdir()   # non-numeric dir ignored
+    assert latest_complete_step(str(tmp_path), n_procs=2) == 10000
+    # no step carries a third proc's shards: nothing is complete at 3
+    assert latest_complete_step(str(tmp_path), n_procs=3) is None
