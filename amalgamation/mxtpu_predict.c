@@ -862,6 +862,12 @@ mxa_tensor* mxa_forward(mxa_model* m, const float* data,
     seterr("graph: missing nodes/heads%s", NULL);
     return NULL;
   }
+  if (heads->n > 1) { /* returning head[0] alone would silently drop
+                       * outputs of a grouped symbol */
+    seterr("graph has multiple outputs; the amalgamation runtime "
+           "serves single-output inference graphs%s", NULL);
+    return NULL;
+  }
   int n_nodes = nodes->n;
   /* per-node single-output values (multi-output ops unsupported) */
   mxa_tensor** vals = (mxa_tensor**)calloc((size_t)n_nodes,

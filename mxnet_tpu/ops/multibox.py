@@ -130,7 +130,10 @@ class MultiBoxTargetOp(OpDef):
         anchors = inputs[0][0]  # (A, 4)
         labels = inputs[1]  # (N, M, 5)
         cls_preds = inputs[2]  # (N, cls+1, A)
-        variances = jnp.asarray(params.variances)
+        # pin to the input dtype: a bare asarray of the python-float
+        # tuple becomes f64 under the package's x64 default and leaks
+        # into the outputs (infer_dtype promises the input dtype)
+        variances = jnp.asarray(params.variances, dtype=anchors.dtype)
         A = anchors.shape[0]
 
         def encode(anchor, gt):
@@ -182,7 +185,8 @@ class MultiBoxTargetOp(OpDef):
                     (params.negative_mining_ratio * num_pos).astype(jnp.int32),
                     params.minimum_negative_samples)
                 order = jnp.argsort(-neg_score)
-                rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A))
+                rank = jnp.zeros((A,), jnp.int32).at[order].set(
+                    jnp.arange(A, dtype=jnp.int32))
                 keep_neg = (~pos) & (rank < num_neg)
                 cls_t = jnp.where(pos | keep_neg, cls_t, params.ignore_label)
             return loc_t, loc_m, cls_t
@@ -229,7 +233,7 @@ class MultiBoxDetectionOp(OpDef):
     def forward(self, params, inputs, aux, train, key):
         cls_prob, loc_pred, anchors = inputs
         anchors = anchors[0]
-        variances = jnp.asarray(params.variances)
+        variances = jnp.asarray(params.variances, dtype=anchors.dtype)
         N = cls_prob.shape[0]
         A = anchors.shape[0]
 

@@ -113,15 +113,21 @@ int main(int argc, char** argv) {
   float* data = read_npy(argv[2], dims, &ndim);
   if (!data) {
     fprintf(stderr, "FAIL reading %s\n", argv[2]);
+    mxa_free(m);
     return 1;
   }
   mxa_tensor* out = mxa_forward(m, data, dims, ndim);
   if (!out) {
     fprintf(stderr, "FAIL forward: %s\n", mxa_last_error());
+    free(data);
+    mxa_free(m);
     return 1;
   }
   if (write_npy(argv[3], out) != 0) {
     fprintf(stderr, "FAIL writing %s\n", argv[3]);
+    mxa_free_tensor(out);
+    free(data);
+    mxa_free(m);
     return 1;
   }
   printf("AMALGAMATION_OK %lld\n", (long long)out->size);

@@ -164,3 +164,21 @@ def test_longcontext_bench_contract():
     ring = payload["ring"]["points"]
     assert [p["sp"] for p in ring] == [1, 2]
     assert all(p["tokens_per_sec"] > 0 for p in ring)
+
+
+@pytest.mark.slow
+def test_watchdog_rejects_stale_promoted_record(tmp_path):
+    """bench_watch.run_bench must NOT persist bench.py's stale-promoted
+    prior record as a fresh capture (that would launder an old number as
+    new and retire the stage): platform:tpu + stale:true is rejected."""
+    if not os.path.exists(os.path.join(REPO, "BENCH_TPU_LATEST.json")):
+        pytest.skip("no committed TPU record to promote")
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_watch
+
+    out = tmp_path / "captured.json"
+    ok = bench_watch.run_bench(
+        {"BENCH_FORCE_CPU": "1", "BENCH_PROMOTE_PRIOR": "1"},
+        str(out), "stale-test", timeout=580)
+    assert ok is False
+    assert not out.exists()
