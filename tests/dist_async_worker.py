@@ -1,9 +1,10 @@
-"""Worker script for the PS-backed dist_async kvstore test: two workers
+"""Worker script for the PS-backed dist_async kvstore test: N workers
 push gradients into a server-side SGD optimizer (the reference's
 pickled-updater-at-server capability, kvstore_dist_server.h) and verify
-the additive result is exact regardless of push order.
+the additive result is exact regardless of push order.  Fully generic
+over worker/server counts.
 
-Launched by test_ps.py via tools/launch.py -n 2 -s 1.
+Launched by test_ps.py via tools/launch.py -n {2,4} -s {1,2}.
 """
 
 import os
