@@ -31,6 +31,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "BENCH_ATTEMPTS.jsonl")
+WATCH_START = time.time()
 
 # every child (bench modes, sweep points, flash/bandwidth tools) shares
 # one persistent XLA compile cache, so a tunnel flake mid-stage only
@@ -114,18 +115,23 @@ def run_json_artifact(tag, cmd_tail, out_name, timeout, validate=None):
     tmp = out + ".tmp"
     if os.path.exists(tmp):
         os.unlink(tmp)
+    clean_exit = True
+    stderr_tail = ""
     try:
         r = subprocess.run([sys.executable] + cmd_tail + ["--json", tmp],
                            capture_output=True, text=True, timeout=timeout)
+        clean_exit = r.returncode == 0
+        stderr_tail = (r.stderr or "")[-300:]
     except subprocess.TimeoutExpired:
         log(f"{tag}: timed out")
-        return False
+        clean_exit = False
+    # the tools rewrite --json after every point, so a tunnel drop or
+    # timeout mid-run still leaves a salvageable partial payload
     try:
         with open(tmp) as f:
             payload = json.loads(f.readlines()[-1])
     except (OSError, IndexError, ValueError) as e:
-        log(f"{tag}: no/partial JSON (rc={r.returncode}, {e}): "
-            f"{(r.stderr or '')[-300:]}")
+        log(f"{tag}: no JSON ({e}): {stderr_tail}")
         return False
     os.unlink(tmp)
     if payload.get("platform") != "tpu":
@@ -136,11 +142,35 @@ def run_json_artifact(tag, cmd_tail, out_name, timeout, validate=None):
         if err:
             log(f"{tag}: invalid payload ({err}), discarding")
             return False
+    # the tool's own word wins: point-streaming tools stamp "complete"
+    # themselves (a final flush with complete=True means all points
+    # ran, whatever the exit code did afterwards); single-shot tools
+    # (bandwidth, quant) have no mid-run snapshots, so a parsed payload
+    # from them is by construction a full one
+    complete = bool(payload.get("complete", True))
+    if not complete:
+        payload["partial_capture"] = True
+        # never let a shorter retry clobber a better capture from THIS
+        # session (an older round's artifact is stale data the fresh
+        # partial should replace — e.g. the pre-tuning flash record)
+        try:
+            this_session = os.path.getmtime(out) >= WATCH_START
+            with open(out) as f:
+                prev = json.loads(f.read())
+            if this_session and (not prev.get("partial_capture")
+                                 or len(prev.get("points", []))
+                                 >= len(payload.get("points", []))):
+                log(f"{tag}: partial no better than existing capture")
+                return False
+        except (OSError, ValueError):
+            pass
     record(tag, payload)
     with open(out, "w") as f:
         f.write(json.dumps(payload, indent=1) + "\n")
-    log(f"{tag}: captured")
-    return True
+    log(f"{tag}: captured{'' if complete else ' (PARTIAL)'}")
+    # a persisted partial keeps the stage pending (bounded retries via
+    # attempt(); if the budget runs out the partial is what we keep)
+    return True if complete else "partial"
 
 
 def run_bandwidth(timeout=1200):
@@ -301,14 +331,19 @@ def main():
 
     def attempt(name, fn):
         ok = fn()
-        if ok:
+        if ok is True:
             fails[name] = 0
             return True
         fails[name] += 1
         if fails[name] >= MAX_FAILS:
-            log(f"{name}: {MAX_FAILS} consecutive failures, giving up "
-                "on this stage")
+            log(f"{name}: {MAX_FAILS} attempts exhausted, "
+                + ("keeping the partial capture" if ok == "partial"
+                   else "giving up on this stage"))
             return True  # mark done so later stages still get captured
+        if ok == "partial":
+            # real progress persisted: retry (bounded) but don't burn
+            # 90s — the stage itself just consumed a long window slice
+            return False
         # back off: a failed stage with a passing probe would otherwise
         # hot-loop fresh JAX processes against the shared chip
         time.sleep(90)

@@ -135,24 +135,28 @@ def main():
         out = {"platform": jax.default_backend(), "tune": True,
                "best": best}
         print(json.dumps(out))
-        if args.json:
-            with open(args.json, "a") as f:
-                f.write(json.dumps(out) + "\n")
+        from tools.bench_io import make_flush
+
+        make_flush(args.json, out)(True)   # same atomic single-line write
         return
 
     points = []
+    out = {"platform": jax.default_backend(),
+           "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+           "points": points}
+
+    from tools.bench_io import make_flush
+
+    flush = make_flush(args.json, out)
+
     for S in (int(x) for x in args.seqs.split(",")):
         for causal in (True, False):
             rec = bench_one(jax, jnp, S, args.batch, args.heads,
                             args.head_dim, causal)
             print(json.dumps(rec))
             points.append(rec)
-    out = {"platform": jax.default_backend(),
-           "device_kind": getattr(jax.devices()[0], "device_kind", ""),
-           "points": points}
-    if args.json:
-        with open(args.json, "a") as f:
-            f.write(json.dumps(out) + "\n")
+            flush(False)
+    flush(True)
 
 
 if __name__ == "__main__":

@@ -171,8 +171,14 @@ def main():
     out = {"platform": jax.default_backend(),
            "device_kind": getattr(jax.devices()[0], "device_kind", ""),
            "n_devices": len(jax.devices())}
+
+    from tools.bench_io import make_flush
+
+    flush = make_flush(args.json, out)
+
     if args.lane in ("single", "both"):
         pts = []
+        out["points"] = pts
         for S in (int(x) for x in args.seqs.split(",")):
             if not on_tpu and S > 8192:
                 continue                 # CPU smoke: keep it tractable
@@ -181,7 +187,7 @@ def main():
                                n_iter=30 if on_tpu else 3)
             print(json.dumps(rec))
             pts.append(rec)
-        out["points"] = pts
+            flush(False)
     if args.lane in ("ring", "both"):
         S_ring = args.ring_seq or (int(args.seqs.split(",")[0])
                                    if on_tpu else 4096)
@@ -197,9 +203,7 @@ def main():
                        "cpu virtual mesh: scaling SHAPE only; rerun on "
                        "a multi-chip slice for absolute numbers"}
     print(json.dumps(out))
-    if args.json:
-        with open(args.json, "a") as f:
-            f.write(json.dumps(out) + "\n")
+    flush(True)
 
 
 if __name__ == "__main__":

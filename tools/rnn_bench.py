@@ -122,18 +122,22 @@ def main():
     import jax.numpy as jnp
 
     points = []
+    out = {"platform": jax.default_backend(),
+           "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+           "points": points}
+
+    from tools.bench_io import make_flush
+
+    flush = make_flush(args.json, out)
+
     for trip in args.shapes.split(";"):
         T, N, H = (int(x) for x in trip.split(","))
         for mode in ("lstm", "gru"):
             rec = bench_one(jax, jnp, mode, T, N, H, n_iter=args.n_iter)
             print(json.dumps(rec))
             points.append(rec)
-    out = {"platform": jax.default_backend(),
-           "device_kind": getattr(jax.devices()[0], "device_kind", ""),
-           "points": points}
-    if args.json:
-        with open(args.json, "a") as f:
-            f.write(json.dumps(out) + "\n")
+            flush(False)
+    flush(True)
 
 
 if __name__ == "__main__":
