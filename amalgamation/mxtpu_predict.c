@@ -202,9 +202,20 @@ static int npy_parse(const uint8_t* buf, size_t len, npy_arr* out,
   while (*q && *q != ')') {
     while (*q == ' ' || *q == ',') ++q;
     if (*q == ')' || !*q) break;
+    const char* before = q;
     int64_t v = strtoll(q, (char**)&q, 10);
-    if (out->ndim >= MXA_MAX_NDIM) {
-      seterr("npy: ndim too large%s", NULL);
+    if (q == before) { /* garbage byte in a corrupt header: no spin */
+      seterr("npy: malformed shape%s", NULL);
+      return -1;
+    }
+    if (out->ndim >= MXA_MAX_NDIM || v < 0) {
+      seterr("npy: bad shape%s", NULL);
+      return -1;
+    }
+    /* overflow-safe: check BEFORE multiplying (a wrapped int64 product
+     * is UB and can sneak back under the cap) */
+    if (v != 0 && out->size > ((int64_t)1 << 40) / v) {
+      seterr("npy: implausible element count%s", NULL);
       return -1;
     }
     out->dims[out->ndim++] = v;
@@ -307,6 +318,7 @@ static char* jstring(const char** p) {
     if (c == '\\') {
       ++*p;
       char e = **p;
+      if (!e) break;  /* buffer ends in a lone backslash: stop at NUL */
       switch (e) {
         case 'n': c = '\n'; break;
         case 't': c = '\t'; break;
@@ -424,15 +436,35 @@ static jval* jparse(const char** p) {
     return jnew(J_NULL);
   }
   jval* v = jnew(J_NUM);
+  const char* before = *p;
   v->num = strtod(*p, (char**)p);
+  if (*p == before && **p) ++*p; /* unparseable byte: consume it (but
+                           * never step past the NUL) — every jparse
+                           * call must make progress or corrupt input
+                           * spins the object/array loops forever */
   return v;
 }
 
 static jval* jget(const jval* obj, const char* key) {
   if (!obj || obj->t != J_OBJ) return NULL;
   for (int i = 0; i < obj->n; ++i)
-    if (strcmp(obj->keys[i], key) == 0) return obj->items[i];
+    if (obj->keys[i] && strcmp(obj->keys[i], key) == 0)
+      return obj->items[i];
   return NULL;
+}
+
+/* corrupt-input-safe accessors for the graph walk */
+static const char* jstr_of(const jval* obj, const char* key) {
+  jval* v = jget(obj, key);
+  return v && v->t == J_STR && v->str ? v->str : NULL;
+}
+
+static int jint_at(const jval* arr, int idx, int* out) {
+  if (!arr || arr->t != J_ARR || idx >= arr->n) return 0;
+  jval* v = arr->items[idx];
+  if (!v || v->t != J_NUM) return 0;
+  *out = (int)v->num;
+  return 1;
 }
 
 /* ---- param-string helpers ("(5, 5)", "True", "relu", "3") ----------- */
@@ -876,8 +908,12 @@ mxa_tensor* mxa_forward(mxa_model* m, const float* data,
 
   for (int i = 0; i < n_nodes; ++i) {
     jval* node = nodes->items[i];
-    const char* op = jget(node, "op")->str;
-    const char* name = jget(node, "name")->str;
+    const char* op = jstr_of(node, "op");
+    const char* name = jstr_of(node, "name");
+    if (!op || !name) {
+      seterr("graph: node missing op/name (corrupt symbol.json)%s", NULL);
+      goto fail;
+    }
     jval* params = jget(node, "param");
     jval* inputs = jget(node, "inputs");
 
@@ -907,14 +943,22 @@ mxa_tensor* mxa_forward(mxa_model* m, const float* data,
      * return wrong results for e.g. a 17-branch Concat) */
     mxa_tensor* ins[64];
     int n_in = 0;
-    for (int k = 0; inputs && k < inputs->n; ++k) {
-      int src = (int)inputs->items[k]->items[0]->num;
+    for (int k = 0; inputs && inputs->t == J_ARR && k < inputs->n; ++k) {
+      int src = -1;
+      if (!jint_at(inputs->items[k], 0, &src) || src < 0 || src >= i) {
+        seterr("graph: node %s has a bad input reference", name);
+        goto fail; /* topo order: inputs may only reference earlier nodes */
+      }
       if (vals[src] == NULL) continue; /* skipped free input (label) */
       if (n_in >= 64) {
         seterr("op %s: more than 64 inputs unsupported", name);
         goto fail;
       }
       ins[n_in++] = vals[src];
+    }
+    if (n_in < 1) { /* every supported op consumes at least data */
+      seterr("graph: op node %s has no live inputs", name);
+      goto fail;
     }
 
     mxa_tensor* out = NULL;
@@ -951,8 +995,9 @@ mxa_tensor* mxa_forward(mxa_model* m, const float* data,
   }
 
   {
-    int head = (int)heads->items[0]->items[0]->num;
-    if (!vals[head]) {
+    int head = -1;
+    if (heads->t != J_ARR || !jint_at(heads->items[0], 0, &head)
+        || head < 0 || head >= n_nodes || !vals[head]) {
       seterr("graph head has no value%s", NULL);
       goto fail;
     }
@@ -1073,8 +1118,11 @@ mxa_model* mxa_load(const char* path) {
   /* manifest: single data input (v1 contract) */
   {
     jval* names = jget(m->manifest, "data_names");
-    if (!names || names->t != J_ARR || names->n != 1) {
-      seterr("manifest: exactly one data input supported%s", NULL);
+    if (!names || names->t != J_ARR || names->n != 1
+        || !names->items[0] || names->items[0]->t != J_STR
+        || !names->items[0]->str) {
+      seterr("manifest: exactly one (string) data input supported%s",
+             NULL);
       goto fail;
     }
     m->input_name = xstrdup(names->items[0]->str);

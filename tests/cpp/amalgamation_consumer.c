@@ -47,8 +47,11 @@ static float* read_npy(const char* path, int64_t* dims, int* ndim) {
       fclose(f);
       return NULL;
     }
-    dims[(*ndim)++] = strtoll(q, &q, 10);
-    size *= dims[*ndim - 1];
+    char* before = q;
+    int64_t v = strtoll(q, &q, 10);
+    if (q == before) break; /* malformed header: no spin, no bogus dim */
+    dims[(*ndim)++] = v;
+    size *= v;
   }
   free(h);
   float* data = (float*)malloc(sizeof(float) * (size_t)size);

@@ -110,3 +110,31 @@ def test_export_model_direct(tmp_path):
     loaded.forward(data=x)
     out = loaded.get_output(0)
     np.testing.assert_allclose(out.sum(axis=1), np.ones(2), rtol=1e-5)
+
+
+def test_export_model_multi_platform_artifact(tmp_path):
+    """platforms=["cpu","tpu"] lowers the StableHLO leg for both
+    backends (the amalgamation mobile-targets analog: one artifact,
+    several deploy targets); the cpu host can still load and run it."""
+    import numpy as np
+
+    net = mx.models.mlp(num_classes=4)
+    rng = np.random.RandomState(2)
+    arg_shapes, _, _ = net.infer_shape(data=(2, 20))
+    args = {n: mx.nd.array(rng.randn(*s).astype(np.float32) * 0.2)
+            for n, s in zip(net.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    art = str(tmp_path / "multi.mxa")
+    mx.predict.export_model(art, net, args, {}, {"data": (2, 20)},
+                            platforms=["cpu", "tpu"])
+    pred = mx.predict.load_exported(art)
+    x = rng.randn(2, 20).astype(np.float32)
+    pred.forward(data=x)
+    out = np.asarray(pred.get_output(0))
+    assert out.shape == (2, 4)
+    # parity vs the live predictor on this host
+    blob = {f"arg:{k}": v for k, v in args.items()}
+    live = mx.predict.create(net.tojson(), blob, {"data": (2, 20)})
+    live.forward(data=x)
+    np.testing.assert_allclose(out, np.asarray(live.get_output(0)),
+                               atol=1e-5, rtol=1e-4)
