@@ -15,11 +15,38 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Tunnel-state cache across cases: a down tunnel HANGS backend init, so
+# without this every remaining case would burn its full 560s subprocess
+# timeout (24 cases = hours of lost window).  After one observed init
+# hang, later cases first run a cheap 45s probe and skip instantly
+# while a recent probe failure is still fresh.
+_TUNNEL = {"down_at": 0.0, "probe_failed_at": 0.0}
+_PROBE_TTL_S = 120.0
+
+
+def _probe_tpu(timeout=45):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # conftest pins pytest itself to CPU
+    code = ("import jax, sys; "
+            "sys.exit(0 if any(d.platform == 'tpu' for d in jax.devices()) "
+            "else 1)")
+    try:
+        ok = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                            capture_output=True, env=env).returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+    if ok:
+        _TUNNEL["down_at"] = _TUNNEL["probe_failed_at"] = 0.0
+    else:
+        _TUNNEL["probe_failed_at"] = time.time()
+    return ok
 
 pytestmark = pytest.mark.skipif(
     os.environ.get("MXTPU_TPU_TESTS") != "1",
@@ -312,6 +339,13 @@ def _run(case, tpu):
         # conftest pins the pytest process to CPU; the TPU worker must
         # not inherit that or it compares CPU against CPU vacuously
         del env["JAX_PLATFORMS"]
+    if tpu and _TUNNEL["down_at"]:
+        # a prior case observed an init hang this session: don't pay
+        # another full worker timeout until a cheap probe passes again
+        if time.time() - _TUNNEL["probe_failed_at"] < _PROBE_TTL_S:
+            pytest.skip("TPU unreachable (probe failed recently)")
+        if not _probe_tpu():
+            pytest.skip("TPU unreachable (probe)")
     src = _WORKER % {"repo": REPO, "tpu": "True" if tpu else "False"}
     if not tpu:
         src = src.replace(
@@ -328,6 +362,7 @@ def _run(case, tpu):
                if isinstance(out, bytes) else out)
         if tpu and "INIT_OK" not in out:
             # a down tunnel HANGS backend init rather than failing fast
+            _TUNNEL["down_at"] = _TUNNEL["probe_failed_at"] = time.time()
             pytest.skip("TPU unreachable (backend init hang)")
         # init completed but the case hung: a real kernel/compile hang
         raise
