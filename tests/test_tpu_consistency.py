@@ -31,7 +31,7 @@ _TUNNEL = {"down_at": 0.0, "probe_failed_at": 0.0}
 _PROBE_TTL_S = 120.0
 
 
-def _probe_tpu(timeout=45):
+def _probe_tpu(timeout=90):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # conftest pins pytest itself to CPU
     code = ("import jax, sys; "
@@ -45,8 +45,20 @@ def _probe_tpu(timeout=45):
     if ok:
         _TUNNEL["down_at"] = _TUNNEL["probe_failed_at"] = 0.0
     else:
-        _TUNNEL["probe_failed_at"] = time.time()
+        _TUNNEL["probe_failed_at"] = time.monotonic()
     return ok
+
+
+def _skip_if_tunnel_down():
+    """Skip (cheaply) while the tunnel is known down.  Used both before
+    the CPU-side run — no point computing a reference the TPU side will
+    discard — and before spawning the TPU worker."""
+    if not _TUNNEL["down_at"]:
+        return
+    if time.monotonic() - _TUNNEL["probe_failed_at"] < _PROBE_TTL_S:
+        pytest.skip("TPU unreachable (probe failed recently)")
+    if not _probe_tpu():
+        pytest.skip("TPU unreachable (probe)")
 
 pytestmark = pytest.mark.skipif(
     os.environ.get("MXTPU_TPU_TESTS") != "1",
@@ -339,13 +351,10 @@ def _run(case, tpu):
         # conftest pins the pytest process to CPU; the TPU worker must
         # not inherit that or it compares CPU against CPU vacuously
         del env["JAX_PLATFORMS"]
-    if tpu and _TUNNEL["down_at"]:
+    if tpu:
         # a prior case observed an init hang this session: don't pay
         # another full worker timeout until a cheap probe passes again
-        if time.time() - _TUNNEL["probe_failed_at"] < _PROBE_TTL_S:
-            pytest.skip("TPU unreachable (probe failed recently)")
-        if not _probe_tpu():
-            pytest.skip("TPU unreachable (probe)")
+        _skip_if_tunnel_down()
     src = _WORKER % {"repo": REPO, "tpu": "True" if tpu else "False"}
     if not tpu:
         src = src.replace(
@@ -362,7 +371,7 @@ def _run(case, tpu):
                if isinstance(out, bytes) else out)
         if tpu and "INIT_OK" not in out:
             # a down tunnel HANGS backend init rather than failing fast
-            _TUNNEL["down_at"] = _TUNNEL["probe_failed_at"] = time.time()
+            _TUNNEL["down_at"] = _TUNNEL["probe_failed_at"] = time.monotonic()
             pytest.skip("TPU unreachable (backend init hang)")
         # init completed but the case hung: a real kernel/compile hang
         raise
@@ -395,6 +404,10 @@ def _run(case, tpu):
                                   "dropout_rng_invariance",
                                   "embedding_gather_scatter"])
 def test_tpu_matches_cpu(case):
+    # check tunnel state BEFORE the CPU reference run too: while the
+    # tunnel is down the CPU worker would spend tens of seconds per case
+    # computing a reference the TPU side immediately discards
+    _skip_if_tunnel_down()
     cpu = _run(case, tpu=False)
     tpu = _run(case, tpu=True)
     # The fused recurrent kernels compare DIFFERENT implementations
