@@ -6,8 +6,8 @@ ring attention), pipeline parallel (pp), expert parallel (ep, MoE) and
 the all-reduce bandwidth benchmark harness.
 """
 
-from .mesh import Mesh, NamedSharding, PartitionSpec, make_mesh, local_mesh, \
-    replicated, shard_along
+from .mesh import Mesh, NamedSharding, PartitionSpec, make_mesh, \
+    make_hybrid_mesh, local_mesh, replicated, shard_along
 from .collectives import allreduce, allreduce_bench, psum, all_gather, \
     reduce_scatter, ppermute
 from .trainer import ShardedTrainer, sgd_opt, adam_opt, adamw_opt
@@ -17,7 +17,7 @@ from .ulysses import ulysses_attention
 from .pipeline import pipeline_apply, PipelineModule
 from .moe import moe_apply, moe_reference, MoELayer, init_moe_params
 
-__all__ = ["Mesh", "NamedSharding", "PartitionSpec", "make_mesh", "local_mesh",
+__all__ = ["Mesh", "NamedSharding", "PartitionSpec", "make_mesh", "make_hybrid_mesh", "local_mesh",
            "replicated", "shard_along", "allreduce", "allreduce_bench", "psum",
            "all_gather", "reduce_scatter", "ppermute", "ShardedTrainer",
            "sgd_opt", "adam_opt", "adamw_opt",

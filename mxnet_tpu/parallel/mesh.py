@@ -14,8 +14,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-__all__ = ["make_mesh", "Mesh", "NamedSharding", "PartitionSpec", "replicated",
-           "shard_along", "local_mesh"]
+__all__ = ["make_mesh", "make_hybrid_mesh", "Mesh", "NamedSharding",
+           "PartitionSpec", "replicated", "shard_along", "local_mesh"]
 
 
 def make_mesh(axes, devices=None) -> Mesh:
@@ -41,6 +41,99 @@ def make_mesh(axes, devices=None) -> Mesh:
                          f"have {n_dev}")
     grid = np.asarray(devices[:total]).reshape(sizes)
     return Mesh(grid, names)
+
+
+def _slice_groups(devices, n_slices=None):
+    """Group ``devices`` by TPU slice, one list per slice.
+
+    Multi-slice TPU runtimes expose ``slice_index`` on each device; when
+    present it is authoritative (and ``n_slices``, if also given, is
+    cross-checked).  CPU/test devices carry no slice attribute, so the
+    caller must say how many slices to emulate and the devices are split
+    into that many contiguous blocks — the same order a slice-major
+    ``jax.devices()`` enumeration would produce on real hardware.
+    """
+    ids = [getattr(d, "slice_index", None) for d in devices]
+    if any(i is not None for i in ids):
+        if any(i is None for i in ids):
+            raise ValueError("mixed device list: some devices carry "
+                             "slice_index and some do not — filter to one "
+                             "device kind before building a hybrid mesh")
+        by_slice = {}
+        for d, i in zip(devices, ids):
+            by_slice.setdefault(int(i), []).append(d)
+        groups = [sorted(g, key=lambda d: d.id)
+                  for _, g in sorted(by_slice.items())]
+        if n_slices is not None and len(groups) != n_slices:
+            raise ValueError(f"devices span {len(groups)} slices, "
+                             f"caller expected {n_slices}")
+    else:
+        if n_slices is None:
+            raise ValueError("devices carry no slice_index attribute; "
+                             "pass the dcn axis sizes concretely (they "
+                             "define the slice count)")
+        if len(devices) % n_slices:
+            raise ValueError(f"{len(devices)} devices do not split into "
+                             f"{n_slices} equal slices")
+        per = len(devices) // n_slices
+        groups = [list(devices[i * per:(i + 1) * per])
+                  for i in range(n_slices)]
+    if len({len(g) for g in groups}) != 1:
+        raise ValueError("uneven slice sizes: "
+                         f"{[len(g) for g in groups]}")
+    return groups
+
+
+def make_hybrid_mesh(dcn_axes, ici_axes, devices=None) -> Mesh:
+    """Mesh over a multi-slice topology: DCN axes outermost.
+
+    ``dcn_axes`` span slices (joined only by DCN), ``ici_axes`` span the
+    chips within each slice (joined by ICI).  This encodes the
+    slow-axis-outermost rule (docs/how_to/cloud.md): axes whose
+    collectives are small and latency-tolerant (dp gradient psums) cross
+    slices, while bandwidth-hungry axes (tp all-gathers, sp ring
+    permutes) stay inside one slice::
+
+        # 2 slices x 4 chips: dp crosses DCN, tp rides ICI
+        mesh = make_hybrid_mesh({"dp": 2}, {"tp": 4})
+
+    Devices are grouped by their ``slice_index`` attribute (real
+    multi-slice TPU); CPU/test devices fall back to contiguous blocks,
+    so the dryrun can validate the layout on a virtual mesh.  A size of
+    -1 in ``ici_axes`` absorbs the rest of a slice; DCN sizes must be
+    concrete (their product defines the slice count when the runtime
+    doesn't).
+    """
+    if devices is None:
+        devices = jax.devices()
+    dcn_names, ici_names = list(dcn_axes), list(ici_axes)
+    dcn_sizes = [dcn_axes[n] for n in dcn_names]
+    if any(s == -1 for s in dcn_sizes):
+        raise ValueError("dcn axis sizes must be concrete (-1 is only "
+                         "supported on ici axes)")
+    n_slices = int(np.prod(dcn_sizes)) if dcn_sizes else 1
+    groups = _slice_groups(devices, n_slices=n_slices)
+    per_slice = len(groups[0])
+    ici_sizes = [ici_axes[n] for n in ici_names]
+    if -1 in ici_sizes:
+        known = int(np.prod([s for s in ici_sizes if s != -1]))
+        if per_slice % known:
+            raise ValueError(f"cannot infer ici axis: {per_slice} "
+                             f"chips/slice, known {known}")
+        ici_sizes[ici_sizes.index(-1)] = per_slice // known
+    ici_total = int(np.prod(ici_sizes)) if ici_sizes else 1
+    if ici_total != per_slice:
+        # strict: an undersized ici spec would silently idle chips in
+        # every slice (use -1 to absorb a slice's remainder explicitly)
+        raise ValueError(f"ici axes {dict(zip(ici_names, ici_sizes))} need "
+                         f"{ici_total} chips/slice, have {per_slice}"
+                         + ("" if ici_total > per_slice else
+                            " (use -1 to absorb the remainder)"))
+    grid = np.empty((n_slices, ici_total), dtype=object)
+    for i, g in enumerate(groups):
+        grid[i, :] = g[:ici_total]
+    grid = grid.reshape(dcn_sizes + ici_sizes)
+    return Mesh(grid, dcn_names + ici_names)
 
 
 def local_mesh(axis_name="dp") -> Mesh:
