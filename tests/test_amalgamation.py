@@ -148,6 +148,8 @@ def test_one_command_export_cli(tmp_path):
     mod.save_checkpoint(prefix, 0)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"         # the test tier's pinned backend
+    env["MXTPU_PLATFORMS"] = "cpu"       # authoritative (config.update)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no tunnel probe in a CPU export
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "export_model.py"),
          "--prefix", prefix, "--epoch", "0", "--data-shape", "2,20"],

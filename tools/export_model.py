@@ -37,6 +37,16 @@ def main():
                    help="comma list for the StableHLO leg (e.g. cpu,tpu)")
     args = p.parse_args()
 
+    # Export is trace+serialize work — any backend is fine, and on a
+    # machine whose accelerator tunnel is down the default backend HANGS
+    # in init.  Accelerator site plugins overwrite JAX_PLATFORMS at
+    # interpreter startup (docs/env_vars.md), so map it onto the
+    # framework-owned MXTPU_PLATFORMS selector, which `import mxnet_tpu`
+    # applies authoritatively via jax.config.update.
+    if os.environ.get("JAX_PLATFORMS") and not os.environ.get(
+            "MXTPU_PLATFORMS"):
+        os.environ["MXTPU_PLATFORMS"] = os.environ["JAX_PLATFORMS"]
+
     import mxnet_tpu as mx
 
     sym, arg_params, aux_params = mx.model.load_checkpoint(

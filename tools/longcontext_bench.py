@@ -157,6 +157,11 @@ def main():
         os.environ["JAX_PLATFORMS"] = args.platform
     import jax
 
+    if args.platform:
+        # env alone is not authoritative: the TPU site package can
+        # override it, and a down tunnel then hangs backend init
+        jax.config.update("jax_platforms", args.platform)
+
     if (jax.default_backend() != "tpu" and len(jax.devices()) < 2
             and not os.environ.get("_MXTPU_LCB_REEXEC")):
         # ring lane needs a mesh: re-exec ONCE with a virtual CPU mesh
