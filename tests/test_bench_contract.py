@@ -167,6 +167,28 @@ def test_longcontext_bench_contract():
 
 
 @pytest.mark.slow
+def test_decode_bench_contract():
+    """tools/decode_bench.py emits decode tokens/sec points for both the
+    gpt2-style and llama-style KV-cache decoders on CPU smoke shapes."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # no tunnel for a CPU smoke
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "decode_bench.py"),
+         "--platform", "cpu", "--layers", "2", "--d-model", "64",
+         "--heads", "4", "--vocab", "97", "--prompt", "8",
+         "--t1", "4", "--t2", "24", "--batches", "1,2"],
+        capture_output=True, text=True, timeout=540, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads([l for l in r.stdout.splitlines()
+                          if l.startswith("{")][-1])
+    assert payload["platform"] == "cpu"
+    assert {pt["config"] for pt in payload["points"]}         == {"gpt2", "llama-style/kv1"}
+    assert {pt["batch"] for pt in payload["points"]} == {1, 2}
+    for pt in payload["points"]:
+        assert pt.get("decode_tok_per_sec", 0) > 0             or "decode_error" in pt, pt
+
+
+@pytest.mark.slow
 def test_watchdog_rejects_stale_promoted_record(tmp_path):
     """bench_watch.run_bench must NOT persist bench.py's stale-promoted
     prior record as a fresh capture (that would launder an old number as

@@ -309,6 +309,20 @@ def run_quant_bench(timeout=1800):
         "QUANT_BENCH.json", timeout, validate=validate)
 
 
+def run_decode_bench(timeout=1800):
+    """KV-cache decode tokens/sec, gpt2-style + llama-style
+    (tools/decode_bench.py) — the inference-side throughput record."""
+
+    def validate(payload):
+        good = [p for p in payload.get("points", [])
+                if p.get("decode_tok_per_sec")]
+        return None if good else "no successful decode point"
+
+    return run_json_artifact(
+        "decode", [os.path.join(REPO, "tools", "decode_bench.py")],
+        "DECODE_BENCH.json", timeout, validate=validate)
+
+
 def run_tpu_consistency(timeout=2400):
     """The cpu-vs-tpu numerics gate (tests/test_tpu_consistency.py) has
     only ever run when a session held the chip; record a pass here."""
@@ -347,7 +361,8 @@ def main():
     done = {"consistency": False, "flash": False, "rnn": False,
             "resnet": False, "resnet256": False, "gpt": False,
             "longcontext": False, "bandwidth": False, "cifar": False,
-            "quant": False, "train_tier": False, "sweep": False}
+            "quant": False, "decode": False, "train_tier": False,
+            "sweep": False}
     fails = {k: 0 for k in done}
     MAX_FAILS = 6  # give up on a stage that fails repeatedly WITH the
     #               probe passing (a code bug, not a tunnel flake)
@@ -413,6 +428,7 @@ def main():
                 os.path.join(REPO, "BENCH_CIFAR_LATEST.json"), "cifar",
                 timeout=min(1500, left))),
             ("quant", lambda: run_quant_bench(timeout=min(1800, left))),
+            ("decode", lambda: run_decode_bench(timeout=min(1800, left))),
             ("train_tier", lambda: run_train_tier(timeout=min(3000, left))),
         ]
         pending = next(((n, fn) for n, fn in stages if not done[n]), None)

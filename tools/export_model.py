@@ -39,10 +39,11 @@ def main():
 
     # Export is trace+serialize work — any backend is fine, and on a
     # machine whose accelerator tunnel is down the default backend HANGS
-    # in init.  Accelerator site plugins overwrite JAX_PLATFORMS at
-    # interpreter startup (docs/env_vars.md), so map it onto the
-    # framework-owned MXTPU_PLATFORMS selector, which `import mxnet_tpu`
-    # applies authoritatively via jax.config.update.
+    # in init.  Accelerator site plugins OUTRANK the JAX_PLATFORMS env
+    # var (its value survives but jax ignores it — docs/env_vars.md), so
+    # map it onto the framework-owned MXTPU_PLATFORMS selector, which
+    # `import mxnet_tpu` applies authoritatively via jax.config.update.
+    # MXTPU_PLATFORMS itself always wins when set.
     if os.environ.get("JAX_PLATFORMS") and not os.environ.get(
             "MXTPU_PLATFORMS"):
         os.environ["MXTPU_PLATFORMS"] = os.environ["JAX_PLATFORMS"]
