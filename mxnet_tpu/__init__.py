@@ -77,6 +77,7 @@ from . import symbol_doc
 from . import parallel
 from . import models
 from . import predict
+from . import serve
 from . import torch_bridge
 from . import c_api
 

@@ -24,7 +24,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["gpt_generate", "gpt_decode_config"]
+__all__ = ["gpt_generate", "gpt_decode_config", "normalize_gpt_params",
+           "detect_gpt_variant", "reconcile_decode_config"]
 
 _decoder_cache = {}
 
@@ -69,6 +70,110 @@ def gpt_decode_config(symbol):
             "window": int(symbol.attr("__gpt_attn_window__") or 0)}
 
 
+def reconcile_decode_config(symbol, num_heads, window):
+    """Merge explicit ``num_heads``/``window`` overrides with the
+    symbol's persisted decode config (:func:`gpt_decode_config`),
+    raising on contradiction — the reshapes would succeed either way
+    and silently decode garbage.  Shared by :func:`gpt_generate` and
+    ``serve.Engine`` so the two decoders cannot drift.  Returns the
+    resolved ``(num_heads, window)``."""
+    cfg = gpt_decode_config(symbol)
+    if num_heads is None:
+        num_heads = cfg["num_heads"]
+    elif int(num_heads) != cfg["num_heads"]:
+        raise ValueError(
+            f"num_heads={num_heads} contradicts the symbol's "
+            f"num_heads={cfg['num_heads']} — the reshapes would "
+            "succeed and decode garbage")
+    if window is None:
+        window = cfg["window"]
+    elif int(window) != cfg["window"]:
+        raise ValueError(
+            f"window={window} contradicts the symbol's trained "
+            f"attn_window={cfg['window']} — decoding with a "
+            "different window silently changes the model")
+    return num_heads, window
+
+
+def normalize_gpt_params(params, name="gpt"):
+    """Canonicalize a gpt() checkpoint for decoding: dequantize
+    weight-only-int8 entries (``*_wscale``) and split ``fused_qkv``
+    projections back into the per-tensor ``*_{q,k,v}_*`` layout every
+    decoder (generate.py's scan loop, serve.Engine's paged steps)
+    addresses.  Returns the input dict unchanged when neither applies.
+    """
+    try:
+        tok_w = params[f"{name}_tok_embed_weight"]
+    except KeyError:
+        raise ValueError(
+            f"params has no '{name}_tok_embed_weight' — wrong name "
+            "prefix or not a gpt() parameter dict") from None
+    d_model = tok_w.shape[1]
+    if any(k.endswith("_wscale") for k in params):
+        # quantized checkpoint (contrib/quantization.py): dequantize the
+        # int8 weights once at load — decode then runs the normal path
+        # (weight-only int8 semantics)
+        params = dict(params)
+        for k in [k for k in params if k.endswith("_wscale")]:
+            stem = k[: -len("_wscale")]
+            wq = np.asarray(params[stem + "_weight"], np.float32)
+            scale = np.asarray(params.pop(k), np.float32)
+            params[stem + "_weight"] = wq * scale[:, None]
+    if f"{name}_l0_qkv_weight" in params:
+        # fused_qkv=True checkpoint layout: split each projection back
+        # into the q/k/v entries the decoder addresses.  GQA fused
+        # checkpoints emit (d_model + 2*d_kv) rows, so split at the
+        # boundaries rather than in thirds.
+        params = dict(params)
+        rows = np.asarray(params[f"{name}_l0_qkv_weight"]).shape[0]
+        d_kv_f = (rows - d_model) // 2
+        i = 0
+        while f"{name}_l{i}_qkv_weight" in params:
+            for kind in ("weight", "bias"):
+                whole = np.asarray(params.pop(f"{name}_l{i}_qkv_{kind}"))
+                parts = np.split(whole, [d_model, d_model + d_kv_f],
+                                 axis=0)
+                for x, part in zip(("q", "k", "v"), parts):
+                    params[f"{name}_l{i}_{x}_{kind}"] = part
+            i += 1
+    return params
+
+
+def detect_gpt_variant(params, num_heads, name="gpt"):
+    """Model-variant flags recoverable from a NORMALIZED checkpoint
+    (see :func:`normalize_gpt_params`): layer count, head-dim split,
+    grouped-query kv_heads, rope-vs-learned positions (``pos_table`` is
+    the table length, None for rope), SwiGLU MLP, tied LM head, and
+    rmsnorm.  ``num_heads`` itself is NOT recoverable from shapes —
+    callers read it from the symbol (gpt_decode_config) or take it
+    explicitly."""
+    tok_w = params[f"{name}_tok_embed_weight"]
+    d_model = tok_w.shape[1]
+    pos_w = params.get(f"{name}_pos_embed_weight")
+    n_layers = 0
+    while f"{name}_l{n_layers}_q_weight" in params:
+        n_layers += 1
+    if n_layers == 0:
+        raise ValueError(f"no '{name}_l0_q_weight' (or '_l0_qkv_weight') "
+                         f"in params — wrong name prefix or not a gpt() "
+                         "parameter dict")
+    if d_model % num_heads:
+        raise ValueError("num_heads must divide d_model")
+    head_dim = d_model // num_heads
+    return {
+        "n_layers": n_layers,
+        "d_model": d_model,
+        "head_dim": head_dim,
+        "kv_heads": (np.asarray(params[f"{name}_l0_k_weight"]).shape[0]
+                     // head_dim),
+        "vocab": tok_w.shape[0],
+        "pos_table": None if pos_w is None else pos_w.shape[1],
+        "swiglu": f"{name}_l0_ff_gate_weight" in params,
+        "tied": f"{name}_head_weight" not in params,
+        "rmsnorm": f"{name}_l0_ln1_beta" not in params,
+    }
+
+
 def gpt_generate(params, prompt, max_new_tokens, num_heads=None,
                  temperature=0.0, top_k=None, key=None, window=None,
                  name="gpt", symbol=None):
@@ -103,21 +208,8 @@ def gpt_generate(params, prompt, max_new_tokens, num_heads=None,
     if prompt.ndim != 2:
         raise ValueError("prompt must be (batch, prompt_len)")
     if symbol is not None:
-        cfg = gpt_decode_config(symbol)
-        if num_heads is None:
-            num_heads = cfg["num_heads"]
-        elif int(num_heads) != cfg["num_heads"]:
-            raise ValueError(
-                f"num_heads={num_heads} contradicts the symbol's "
-                f"num_heads={cfg['num_heads']} — the reshapes would "
-                "succeed and decode garbage")
-        if window is None:
-            window = cfg["window"]
-        elif int(window) != cfg["window"]:
-            raise ValueError(
-                f"window={window} contradicts the symbol's trained "
-                f"attn_window={cfg['window']} — decoding with a "
-                "different window silently changes the model")
+        num_heads, window = reconcile_decode_config(symbol, num_heads,
+                                                    window)
     if num_heads is None:
         raise ValueError("num_heads is required (pass it, or pass "
                          "symbol= to read it from the trained graph)")
@@ -137,57 +229,16 @@ def gpt_generate(params, prompt, max_new_tokens, num_heads=None,
     if P < 1:
         raise ValueError("prompt must hold at least one token")
 
-    try:
-        tok_w = params[f"{name}_tok_embed_weight"]
-    except KeyError:
-        raise ValueError(
-            f"params has no '{name}_tok_embed_weight' — wrong name "
-            "prefix or not a gpt() parameter dict") from None
-    d_model = tok_w.shape[1]
+    params = normalize_gpt_params(params, name)
+    spec = detect_gpt_variant(params, num_heads, name)
+    tok_w = params[f"{name}_tok_embed_weight"]
+    n_layers, head_dim = spec["n_layers"], spec["head_dim"]
+    kv_heads = spec["kv_heads"]
+    swiglu, tied, rmsnorm = spec["swiglu"], spec["tied"], spec["rmsnorm"]
     # pos_embed="rope" checkpoints carry no position table; positions
     # then have no trained length limit, so the cache sizes to the
     # request instead of the table
-    pos_w = params.get(f"{name}_pos_embed_weight")
-    S = None if pos_w is None else pos_w.shape[1]
-    if any(k.endswith("_wscale") for k in params):
-        # quantized checkpoint (contrib/quantization.py): dequantize the
-        # int8 weights once at load — decode then runs the normal path
-        # (weight-only int8 semantics)
-        params = dict(params)
-        for k in [k for k in params if k.endswith("_wscale")]:
-            stem = k[: -len("_wscale")]
-            wq = np.asarray(params[stem + "_weight"], np.float32)
-            scale = np.asarray(params.pop(k), np.float32)
-            params[stem + "_weight"] = wq * scale[:, None]
-    if f"{name}_l0_qkv_weight" in params:
-        # fused_qkv=True checkpoint layout: split each projection back
-        # into the q/k/v entries the decoder addresses.  GQA fused
-        # checkpoints emit (d_model + 2*d_kv) rows, so split at the
-        # boundaries rather than in thirds.
-        params = dict(params)
-        rows = np.asarray(params[f"{name}_l0_qkv_weight"]).shape[0]
-        d_kv_f = (rows - d_model) // 2
-        i = 0
-        while f"{name}_l{i}_qkv_weight" in params:
-            for kind in ("weight", "bias"):
-                whole = np.asarray(params.pop(f"{name}_l{i}_qkv_{kind}"))
-                parts = np.split(whole, [d_model, d_model + d_kv_f],
-                                 axis=0)
-                for x, part in zip(("q", "k", "v"), parts):
-                    params[f"{name}_l{i}_{x}_{kind}"] = part
-            i += 1
-    n_layers = 0
-    while f"{name}_l{n_layers}_q_weight" in params:
-        n_layers += 1
-    if n_layers == 0:
-        raise ValueError(f"no '{name}_l0_q_weight' (or '_l0_qkv_weight') "
-                         f"in params — wrong name prefix or not a gpt() "
-                         "parameter dict")
-    if d_model % num_heads:
-        raise ValueError("num_heads must divide d_model")
-    head_dim = d_model // num_heads
-    kv_heads = (np.asarray(params[f"{name}_l0_k_weight"]).shape[0]
-                // head_dim)
+    S = spec["pos_table"]
     T = P + max_new_tokens
     if S is not None and T > S:
         raise ValueError(
@@ -198,9 +249,6 @@ def gpt_generate(params, prompt, max_new_tokens, num_heads=None,
     if max_new_tokens < 1:
         return np.asarray(prompt, np.int32)
 
-    swiglu = f"{name}_l0_ff_gate_weight" in params
-    tied = f"{name}_head_weight" not in params
-    rmsnorm = f"{name}_l0_ln1_beta" not in params
     cfg = (name, n_layers, num_heads, head_dim, B, P, max_new_tokens,
            S_cache, float(temperature), top_k, kv_heads, S is None,
            int(window), swiglu, tied, rmsnorm,

@@ -12,7 +12,7 @@ import re
 
 from .ndarray import NDArray
 
-__all__ = ["Monitor"]
+__all__ = ["Monitor", "ServeMonitor"]
 
 
 class Monitor:
@@ -66,3 +66,42 @@ class Monitor:
     def toc_print(self):
         for n, k, v_list in self.toc():
             logging.info("Batch: %7d %30s %s", n, k, str(v_list))
+
+
+class ServeMonitor:
+    """Periodic logger for the serving engine, the inference-side
+    analog of ``callback.Speedometer``'s samples/sec line and this
+    module's tic/toc convention: call :meth:`tic` once per engine
+    step; every ``interval`` steps it snapshots
+    ``serve.Engine.stats()`` and logs one line.
+
+        mon = mx.monitor.ServeMonitor(engine, interval=100)
+        while engine.scheduler.has_work():
+            engine.step()
+            mon.tic()
+    """
+
+    def __init__(self, engine, interval=100, logger=None):
+        self.engine = engine
+        self.interval = int(interval)
+        if self.interval < 1:
+            raise ValueError(
+                f"interval must be >= 1 (got {interval})")
+        self.step = 0
+        self.logger = logger or logging.getLogger(__name__)
+
+    def tic(self):
+        self.step += 1
+        if self.step % self.interval == 0:
+            self.log_now()
+
+    def log_now(self):
+        s = self.engine.stats()
+        self.logger.info(
+            "Serve: step %7d queue=%d running=%d done=%d rej=%d "
+            "preempt=%d blocks=%d/%d (%.0f%%) ttft_ms=%s tok/s=%s",
+            s.steps, s.queue_depth, s.running, s.completed, s.rejected,
+            s.preemptions, s.blocks_in_use, s.blocks_total,
+            100.0 * s.block_utilization, s.ttft_ms_mean,
+            s.decode_tok_per_sec or s.total_tok_per_sec)
+        return s

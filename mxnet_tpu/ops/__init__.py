@@ -20,7 +20,8 @@ from . import multibox  # noqa: F401
 from . import sample  # noqa: F401
 from . import attention  # noqa: F401
 
+from .attention import paged_attention
 from .flash_attention import flash_attention
 
 __all__ = ["OP_REGISTRY", "OpDef", "SimpleOpDef", "register_op",
-           "register_simple_op", "flash_attention"]
+           "register_simple_op", "flash_attention", "paged_attention"]

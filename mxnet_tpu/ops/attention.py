@@ -248,8 +248,9 @@ class FlashAttentionOp(OpDef):
                 # data-parallel sharded program: run the kernel per
                 # batch shard under shard_map (GSPMD cannot partition a
                 # Mosaic custom call on its own)
-                from jax import shard_map
                 from jax.sharding import PartitionSpec
+
+                from ..jax_compat import shard_map
 
                 spec = PartitionSpec(batch_ax, *([None] * (q.ndim - 1)))
 
@@ -303,6 +304,64 @@ class FlashAttentionOp(OpDef):
         if params.layout == "bshd":
             return [jnp.einsum("bhqk,bkhd->bqhd", p, v)], []
         return [jnp.einsum("bhqk,bhkd->bhqd", p, v)], []
+
+
+# -- paged attention (serving) -----------------------------------------------
+def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
+                    window=0, scale=None):
+    """Single-token decode attention over a paged KV-cache.
+
+    The serving engine (``mxnet_tpu/serve``) keeps one fixed
+    device-resident cache carved into fixed-size blocks; each request
+    owns a per-request *block table* mapping its logical token
+    positions onto physical blocks.  This op gathers K/V through the
+    tables and attends each query against its own context — the
+    vLLM-style paged-attention formulation, expressed as an XLA
+    gather + masked softmax so it runs on every backend (a Mosaic
+    kernel that streams blocks from HBM is the TPU follow-up).
+
+    Args:
+      q: (B, Hq, Dh) — one query token per sequence.
+      k_cache/v_cache: (num_blocks, block_size, Hkv, Dh) physical
+        cache.  Hq must be a multiple of Hkv (grouped-query native:
+        kv head g serves q heads [g*group, (g+1)*group)).
+      block_tables: (B, W) int32 physical block ids per sequence, in
+        logical order; rows pad with the null block (id 0) past the
+        sequence's last block.
+      context_lens: (B,) int32 — valid cache entries per sequence
+        (the current token's K/V already written).  Padded table
+        entries sit beyond the context and are masked out.
+      window: sliding-window radius (0 = full attention), matching
+        the FlashAttention op's ``window`` semantics at decode: the
+        query at position L-1 sees positions > L-1-window only.
+      scale: score scale; default 1/sqrt(Dh).
+
+    Returns (B, Hq, Dh) attention output in q's dtype.
+    """
+    B, Hq, Dh = q.shape
+    nb, bs, Hkv, _ = k_cache.shape
+    if window < 0:
+        raise ValueError(f"paged_attention: window must be >= 0 "
+                         f"(got {window})")
+    from .flash_attention import gqa_group
+    group = gqa_group(Hq, Hkv)
+    scale = scale if scale is not None else 1.0 / np.sqrt(Dh)
+    S = block_tables.shape[1] * bs
+    # (B, W, bs, Hkv, Dh) -> (B, S, Hkv, Dh): each row's logical view
+    k = k_cache[block_tables].reshape(B, S, Hkv, Dh)
+    v = v_cache[block_tables].reshape(B, S, Hkv, Dh)
+    qg = q.reshape(B, Hkv, group, Dh)
+    s = jnp.einsum("bkgd,bskd->bkgs", qg, k) * scale
+    pos = jnp.arange(S)[None, :]
+    keep = pos < context_lens[:, None]
+    if window:
+        keep = jnp.logical_and(keep,
+                               pos > context_lens[:, None] - 1 - window)
+    s = jnp.where(keep[:, None, None, :], s,
+                  jnp.asarray(-jnp.inf, s.dtype))
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgs,bskd->bkgd", p, v)
+    return out.reshape(B, Hq, Dh)
 
 
 # -- rotary position embedding ------------------------------------------------

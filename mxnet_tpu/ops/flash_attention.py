@@ -394,9 +394,13 @@ def _params(interpret):
     (ARBITRARY).  Unsupported by the interpreter backend."""
     if interpret:
         return {}
-    return {"compiler_params": pltpu.CompilerParams(
-        dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
-                             pltpu.ARBITRARY))}
+    # renamed upstream: TPUCompilerParams (older jax) -> CompilerParams;
+    # the string spellings parse in both generations, where the
+    # pltpu.PARALLEL/ARBITRARY constants only exist in the newer one
+    cp = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return {"compiler_params": cp(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))}
 
 
 def _fwd(q, k, v, qo, ko, scale, causal, bq, bk, interpret, window=0):
@@ -710,6 +714,14 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
         qf = q.reshape(B * H, Sq, D)
         kf = k.reshape(B * H, Sk, D)
         vf = v.reshape(B * H, Sk, D)
+    if not causal and not window:
+        # no mask consumes positions, so the offsets are inert — drop
+        # them to constants.  More than hygiene: ring attention passes
+        # axis_index-derived offsets, and XLA's SPMD partitioner
+        # refuses a partition-id-rooted operand threaded into the
+        # kernel call inside the ring's scan (PartitionId UNIMPLEMENTED
+        # on CPU) when nothing in the kernel reads it.
+        q_offset, k_offset = 0, 0
     qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
     ko = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
     o, lse = _flash(qf, kf, vf, qo, ko, scale, bool(causal), bq, bk,

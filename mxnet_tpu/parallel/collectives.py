@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
-from jax import shard_map
+from ..jax_compat import shard_map
 
 __all__ = ["psum", "all_gather", "reduce_scatter", "ppermute", "allreduce",
            "allreduce_bench"]

@@ -260,6 +260,8 @@ def export_model(path, symbol, arg_params, aux_params, input_shapes,
     bf16-accumulated passes), so outputs match per-platform, not across.
     """
     import jax
+    import jax.export  # older jax: the submodule must be imported
+    #                    before jax.export attribute access resolves
 
     from .executor import _CompiledGraph
 
@@ -328,6 +330,7 @@ class ExportedPredictor:
 
     def __init__(self, path):
         import jax
+        import jax.export  # see export_model: explicit submodule import
 
         with zipfile.ZipFile(path) as zf:
             self.manifest = json.loads(zf.read(_MANIFEST))

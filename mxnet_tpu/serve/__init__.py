@@ -1,0 +1,31 @@
+"""Continuous-batching inference serving (beyond-parity subsystem).
+
+The reference framework serves predictions one static batch at a time
+(``predict.Predictor``); this package adds the modern multi-tenant
+serving stack on top of the same checkpoints:
+
+- ``kv_block_manager`` — paged KV-cache block accounting (vLLM-style):
+  one fixed device cache carved into blocks, per-request block tables,
+  LRU eviction of finished/preempted requests' blocks.
+- ``scheduler`` — iteration-level continuous batching (Orca-style):
+  bounded FIFO admission, prefill/decode interleaving, preemption by
+  recomputation under cache pressure, per-request deadlines with
+  graceful rejection instead of OOM.
+- ``engine`` — the public ``serve.Engine``: ``submit() -> Request``,
+  ``stream()``, ``step()``, ``shutdown()``, bucketed jit programs.
+- ``stats`` — ``ServeStats`` snapshots (queue depth, TTFT, tokens/sec,
+  block utilization, preemption/eviction counters); pair with
+  ``mxnet_tpu.monitor.ServeMonitor`` for periodic logging.
+
+Benchmark: ``tools/serve_bench.py`` (SERVE_BENCH.json artifact).
+"""
+
+from .engine import Engine
+from .kv_block_manager import BlockManager, NoFreeBlocks
+from .scheduler import (CANCELLED, FINISHED, REJECTED, RUNNING, WAITING,
+                        QueueFull, Request, Scheduler)
+from .stats import ServeStats, StatsRecorder
+
+__all__ = ["Engine", "BlockManager", "NoFreeBlocks", "QueueFull",
+           "Request", "Scheduler", "ServeStats", "StatsRecorder",
+           "WAITING", "RUNNING", "FINISHED", "REJECTED", "CANCELLED"]

@@ -1,0 +1,502 @@
+"""The public serving engine: continuous batching over a paged KV-cache.
+
+``serve.Engine`` drives a gpt() checkpoint (the same parameter dicts
+``models/generate.py`` decodes) as a multi-tenant service:
+
+  eng = mx.serve.Engine(params, symbol=net, num_blocks=512)
+  req = eng.submit(prompt_ids, max_new_tokens=64)   # may raise QueueFull
+  for tok in eng.stream(req):
+      ...
+  eng.shutdown()
+
+Each ``step()`` is one scheduler iteration: at most
+``max_prefills_per_step`` whole-prompt prefills (one jit-compiled
+program per prompt-length bucket) followed by ONE batched single-token
+decode over every running request (one program per batch bucket).  All
+shapes are padded to power-of-two buckets and the block-table width is
+fixed at ``max_model_len / block_size``, so the number of distinct XLA
+programs is bounded by O(log max_batch + log max_model_len) — no
+per-request recompiles, the serving analog of ``BucketingModule``'s
+bucket trick.
+
+The KV-cache is ONE device-resident array pair per engine,
+(layers, num_blocks, block_size, kv_heads, head_dim), carved into
+blocks by ``kv_block_manager.BlockManager``; decode attends through
+``ops.attention.paged_attention``.  Cache-pressure policy lives in
+``scheduler.Scheduler`` (preemption + back-pressure), never here —
+the engine only executes the schedule it is handed.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models.generate import (_fc, _gelu, _ln, detect_gpt_variant,
+                               normalize_gpt_params,
+                               reconcile_decode_config)
+from ..ops.attention import paged_attention
+from .kv_block_manager import BlockManager
+from .scheduler import CANCELLED, FINISHED, QueueFull, Request, Scheduler
+from .stats import StatsRecorder
+
+__all__ = ["Engine"]
+
+# Compiled prefill/decode programs shared across Engine instances with
+# identical static configs (the serve_bench serial-baseline engine
+# reuses every program its batched twin compiled).  The cached
+# closures capture ONLY the immutable _ModelCfg — never an Engine —
+# so a retired engine (and its multi-GB parameter dict) stays
+# collectable while its programs outlive it.
+_STEP_CACHE = {}
+
+# the static model/sampling config the compiled programs close over
+_ModelCfg = collections.namedtuple("_ModelCfg", [
+    "name", "n_layers", "num_heads", "head_dim", "kv_heads",
+    "pos_table", "swiglu", "tied", "rmsnorm", "window", "block_size",
+    "temperature", "top_k"])
+
+
+def _next_bucket(n, cap):
+    """Smallest power-of-two >= n, clamped to cap."""
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+def _rope(u, pos, base=10000.0):
+    """Rotate (N, H, Dh) rows by their own positions (N,) — matches
+    ops/attention.py RoPEOp / generate.py's scalar-position _rot."""
+    half = u.shape[-1] // 2
+    inv = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None] * inv          # (N, half)
+    cos = jnp.cos(ang)[:, None, :]
+    sin = jnp.sin(ang)[:, None, :]
+    uf = u.astype(jnp.float32)
+    u1, u2 = uf[..., :half], uf[..., half:]
+    return jnp.concatenate([u1 * cos - u2 * sin,
+                            u1 * sin + u2 * cos], axis=-1).astype(u.dtype)
+
+
+class Engine:
+    """Continuous-batching inference engine over a paged KV-cache.
+
+    Args:
+      params: gpt() parameter dict (numpy or jax arrays; quantized and
+        fused-qkv checkpoints are normalized at load).
+      num_heads / window: decode config not recoverable from weight
+        shapes; pass them or pass ``symbol=`` (the trained graph) to
+        read both, exactly like ``gpt_generate``.
+      block_size: tokens per KV-cache block
+        (env ``MXTPU_SERVE_BLOCK_SIZE``, default 16).
+      num_blocks: physical blocks in the cache, incl. the reserved
+        null block (env ``MXTPU_SERVE_NUM_BLOCKS``, default 512).
+      max_batch: decode batch ceiling
+        (env ``MXTPU_SERVE_MAX_BATCH``, default 8).
+      max_queue: admission-queue bound; ``submit`` beyond it raises
+        ``QueueFull`` (env ``MXTPU_SERVE_MAX_QUEUE``, default 64).
+      max_model_len: longest prompt+generation length served; defaults
+        to the positional-table length (learned positions) or the
+        cache capacity at ``max_batch`` concurrency (rope).
+      max_prefills_per_step: prompt prefills interleaved per iteration
+        ahead of the batched decode (default 1).
+      temperature/top_k/seed: sampling config (0.0 = greedy argmax —
+        deterministic, which preemption-resume equivalence relies on).
+      clock: injectable monotonic clock (tests drive deadlines with a
+        fake clock).
+    """
+
+    def __init__(self, params, num_heads=None, window=None, symbol=None,
+                 name="gpt", block_size=None, num_blocks=None,
+                 max_batch=None, max_queue=None, max_model_len=None,
+                 max_prefills_per_step=1, temperature=0.0, top_k=None,
+                 seed=0, clock=time.monotonic):
+        if symbol is not None:
+            num_heads, window = reconcile_decode_config(symbol, num_heads,
+                                                        window)
+        if num_heads is None:
+            raise ValueError("num_heads is required (pass it, or pass "
+                             "symbol= to read it from the trained graph)")
+        window = 0 if window is None else int(window)
+        if window < 0:
+            raise ValueError(f"window must be >= 0 (got {window})")
+
+        def _env(key, default):
+            return int(os.environ.get(key, default))
+
+        self.block_size = (int(block_size) if block_size is not None
+                           else _env("MXTPU_SERVE_BLOCK_SIZE", 16))
+        self.num_blocks = (int(num_blocks) if num_blocks is not None
+                           else _env("MXTPU_SERVE_NUM_BLOCKS", 512))
+        self.max_batch = (int(max_batch) if max_batch is not None
+                          else _env("MXTPU_SERVE_MAX_BATCH", 8))
+        max_queue = (int(max_queue) if max_queue is not None
+                     else _env("MXTPU_SERVE_MAX_QUEUE", 64))
+
+        params = normalize_gpt_params(params, name)
+        self.spec = detect_gpt_variant(params, num_heads, name)
+        self.name = name
+        self.num_heads = int(num_heads)
+        self.window = window
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        cache_tokens = (self.num_blocks - 1) * self.block_size
+        if max_model_len is None:
+            # learned positions cap the servable length at the table;
+            # rope has no trained limit, so cap where max_batch peers
+            # can still coexist in the cache (pure heuristic — override
+            # freely; admission re-checks the cache either way)
+            max_model_len = (self.spec["pos_table"]
+                             or max(self.block_size,
+                                    cache_tokens // max(1, self.max_batch)))
+        self.max_model_len = int(min(max_model_len, cache_tokens))
+        if (self.spec["pos_table"] is not None
+                and self.max_model_len > self.spec["pos_table"]):
+            raise ValueError(
+                f"max_model_len={self.max_model_len} exceeds the "
+                f"positional table ({self.spec['pos_table']})")
+        # fixed block-table width: one decode program per batch bucket
+        self.table_width = -(-self.max_model_len // self.block_size)
+
+        self.blocks = BlockManager(self.num_blocks, self.block_size)
+        self.scheduler = Scheduler(self.blocks, self.max_batch, max_queue,
+                                   max_prefills_per_step, clock=clock)
+        self._stats = StatsRecorder(clock=clock)
+        self.clock = clock
+
+        self.params = {k: jnp.asarray(v) for k, v in params.items()}
+        dt = self.params[f"{name}_tok_embed_weight"].dtype
+        L = self.spec["n_layers"]
+        shape = (L, self.num_blocks, self.block_size,
+                 self.spec["kv_heads"], self.spec["head_dim"])
+        self._cache_k = jnp.zeros(shape, dt)
+        self._cache_v = jnp.zeros(shape, dt)
+        self._key = jax.random.PRNGKey(seed)
+        # donating the cache through each step avoids a full cache copy
+        # per token; CPU PJRT can't donate (it would warn every call)
+        self._donate = (jax.default_backend() != "cpu")
+        self._cfg = _ModelCfg(
+            name=name, n_layers=L, num_heads=self.num_heads,
+            head_dim=self.spec["head_dim"], kv_heads=self.spec["kv_heads"],
+            pos_table=self.spec["pos_table"], swiglu=self.spec["swiglu"],
+            tied=self.spec["tied"], rmsnorm=self.spec["rmsnorm"],
+            window=self.window, block_size=self.block_size,
+            temperature=self.temperature, top_k=self.top_k)
+        self._alive = True
+        self._noop_steps = 0
+
+    # -- static config key for the shared program cache ----------------------
+    def _spec_key(self):
+        # _ModelCfg pins the math; the extras pin the traced SHAPES
+        # (cache geometry + dtype) and the donation policy
+        return (self._cfg, self.num_blocks, self.table_width,
+                str(self._cache_k.dtype), self._donate)
+
+    # -- public API ----------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=64, deadline_s=None):
+        """Queue one generation request; returns its ``Request`` handle.
+
+        Raises ``QueueFull`` when the admission queue is at capacity
+        (back-pressure — retry later).  A request that could never fit
+        (longer than ``max_model_len`` or the whole cache) is returned
+        already REJECTED rather than queued to deadlock.
+        """
+        if not self._alive:
+            raise RuntimeError("engine is shut down")
+        req = Request(prompt, max_new_tokens, deadline_s=deadline_s)
+        if req.target_len() > self.max_model_len:
+            self.scheduler._reject(req, "exceeds_max_len")
+            return req
+        try:
+            return self.scheduler.submit(req)
+        except QueueFull:
+            self._stats.on_reject()      # back-pressure event counter
+            raise
+
+    def step(self):
+        """One scheduler iteration: admit + prefill, then one batched
+        decode.  Returns the number of tokens emitted."""
+        if not self._alive:
+            raise RuntimeError("engine is shut down")
+        prefills, decodes = self.scheduler.schedule()
+        # blocks for this iteration are all held right now — the
+        # honest high-water sample (post-drain reads would be ~0)
+        self._stats.on_utilization(self.blocks.utilization())
+        emitted = 0
+        for req in prefills:
+            self._run_prefill(req)
+            emitted += 1
+        if decodes:
+            emitted += self._run_decode(decodes)
+        if emitted == 0 and not prefills and not decodes:
+            self._noop_steps += 1
+            if self._noop_steps > 1000 and self.scheduler.has_work():
+                raise RuntimeError(
+                    "scheduler stalled: work queued but 1000 consecutive "
+                    "steps scheduled nothing (cache/queue misconfigured?)")
+        else:
+            self._noop_steps = 0
+        self._stats.on_step(emitted)
+        return emitted
+
+    def run(self):
+        """Pump ``step()`` until every queued request resolves."""
+        while self.scheduler.has_work():
+            self.step()
+
+    def stream(self, req):
+        """Yield ``req``'s tokens as they are generated, pumping the
+        engine as needed (every co-scheduled request advances too)."""
+        sent = 0
+        while True:
+            while sent < len(req.tokens):
+                yield int(req.tokens[sent])
+                sent += 1
+            if req.done or not self.scheduler.has_work():
+                return
+            self.step()
+
+    def stats(self):
+        """Immutable ``ServeStats`` snapshot of the engine right now."""
+        return self._stats.snapshot(self.scheduler, self.blocks)
+
+    def shutdown(self):
+        """Cancel in-flight work and release the device cache."""
+        if not self._alive:
+            return
+        for req in list(self.scheduler.running):
+            self.scheduler.finish(req, status=CANCELLED)
+        for req in self.scheduler.waiting:
+            req.status = CANCELLED
+            req.finish_t = self.clock()
+        self.scheduler.waiting = []
+        self._cache_k = self._cache_v = None
+        self.params = None            # free the device-resident weights
+        self._alive = False
+
+    # -- execution -----------------------------------------------------------
+    def _slots(self, table, n, pad_to):
+        """(block, offset) scatter targets for logical slots [0, n),
+        padded to ``pad_to`` with null-block writes."""
+        blk = np.zeros(pad_to, np.int32)
+        off = np.arange(pad_to, dtype=np.int32) % self.block_size
+        pos = np.arange(n)
+        blk[:n] = np.asarray(table, np.int32)[pos // self.block_size]
+        return blk, off
+
+    def _run_prefill(self, req):
+        ids = req.prefill_ids()
+        n = ids.size
+        bucket = _next_bucket(n, self.max_model_len)
+        toks = np.zeros(bucket, np.int32)
+        toks[:n] = ids
+        blk, off = self._slots(self.blocks.table(req.rid), n, bucket)
+        fn = self._prefill_fn(bucket)
+        self._key, sub = jax.random.split(self._key)
+        tok, self._cache_k, self._cache_v = fn(
+            self.params, self._cache_k, self._cache_v,
+            jnp.asarray(toks), jnp.asarray(n, jnp.int32),
+            jnp.asarray(blk), jnp.asarray(off), sub)
+        req.cache_len = n
+        self.scheduler.running.append(req)
+        now = self.clock()
+        if req.first_token_t is None:
+            req.first_token_t = now
+            self._stats.on_first_token(req.ttft() or 0.0)
+        req.tokens.append(int(tok))
+        self._maybe_finish(req)
+
+    def _run_decode(self, reqs):
+        B = len(reqs)
+        bucket = _next_bucket(B, self.max_batch)
+        toks = np.zeros(bucket, np.int32)
+        pos = np.zeros(bucket, np.int32)
+        tables = np.zeros((bucket, self.table_width), np.int32)
+        for i, req in enumerate(reqs):
+            toks[i] = req.tokens[-1]
+            pos[i] = req.cache_len
+            t = self.blocks.table(req.rid)
+            tables[i, :len(t)] = t
+        fn = self._decode_fn(bucket)
+        self._key, sub = jax.random.split(self._key)
+        out, self._cache_k, self._cache_v = fn(
+            self.params, self._cache_k, self._cache_v,
+            jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables), sub)
+        out = np.asarray(out)
+        for i, req in enumerate(reqs):
+            req.cache_len += 1
+            req.tokens.append(int(out[i]))
+            self._maybe_finish(req)
+        return B
+
+    def _maybe_finish(self, req):
+        if len(req.tokens) >= req.max_new_tokens:
+            self.scheduler.finish(req, status=FINISHED)
+            self._stats.on_complete(req)
+
+    # -- compiled programs ---------------------------------------------------
+    def _decode_fn(self, B):
+        key = (self._spec_key(), "decode", B)
+        fn = _STEP_CACHE.get(key)
+        if fn is None:
+            fn = _build_decode(self._cfg, self._donate)
+            _STEP_CACHE[key] = fn
+        return fn
+
+    def _prefill_fn(self, P):
+        key = (self._spec_key(), "prefill", P)
+        fn = _STEP_CACHE.get(key)
+        if fn is None:
+            fn = _build_prefill(self._cfg, P, self._donate)
+            _STEP_CACHE[key] = fn
+        return fn
+
+
+# -- compiled-program bodies (close over _ModelCfg ONLY — never an
+# Engine, so the shared _STEP_CACHE cannot retain a retired engine's
+# parameter dict) -------------------------------------------------------------
+def _sample(cfg, logits, key):
+    """Greedy argmax (temperature 0) or temperature/top-k sampling.
+    ``logits`` (..., V) -> int32 ids of the leading shape."""
+    if cfg.temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = logits.astype(jnp.float32) / cfg.temperature
+    if cfg.top_k is not None:
+        kth = jnp.sort(lg, axis=-1)[..., -int(cfg.top_k), None]
+        lg = jnp.where(lg >= kth, lg, -jnp.inf)
+    return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+
+def _mlp(cfg, params, p, x):
+    h2 = _ln(x, params[f"{p}_ln2_gamma"],
+             None if cfg.rmsnorm else params[f"{p}_ln2_beta"])
+    if cfg.swiglu:
+        g = _fc(h2, params[f"{p}_ff_gate_weight"],
+                params[f"{p}_ff_gate_bias"])
+        gf = g.astype(jnp.float32)               # f32 silu == sym.silu
+        up = ((gf * jax.nn.sigmoid(gf)).astype(g.dtype)
+              * _fc(h2, params[f"{p}_ff_up_weight"],
+                    params[f"{p}_ff_up_bias"]))
+    else:
+        up = _gelu(_fc(h2, params[f"{p}_ff_up_weight"],
+                       params[f"{p}_ff_up_bias"]))
+    return _fc(up, params[f"{p}_ff_down_weight"],
+               params[f"{p}_ff_down_bias"])
+
+
+def _logits(cfg, params, x):
+    name = cfg.name
+    final = _ln(x, params[f"{name}_ln_f_gamma"],
+                None if cfg.rmsnorm else params[f"{name}_ln_f_beta"])
+    if cfg.tied:
+        return final @ params[f"{name}_tok_embed_weight"].T.astype(
+            final.dtype)
+    return _fc(final, params[f"{name}_head_weight"],
+               params[f"{name}_head_bias"])
+
+
+def _forward_token_batch(cfg, params, ck, cv, toks, pos, tables):
+    """Shared decode math: write each row's K/V at its position,
+    attend through the block tables, return logits (B, V)."""
+    name = cfg.name
+    Hq, Hkv, Dh = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    d_model = Hq * Dh
+    B = toks.shape[0]
+    x = params[f"{name}_tok_embed_weight"][toks]           # (B, D)
+    if cfg.pos_table is not None:
+        x = x + params[f"{name}_pos_embed_weight"][0, pos]
+    blk = jnp.take_along_axis(tables, (pos // cfg.block_size)[:, None],
+                              axis=1)[:, 0]
+    off = pos % cfg.block_size
+    ctx = pos + 1
+    for i in range(cfg.n_layers):
+        p = f"{name}_l{i}"
+        h = _ln(x, params[f"{p}_ln1_gamma"],
+                None if cfg.rmsnorm else params[f"{p}_ln1_beta"])
+        q = _fc(h, params[f"{p}_q_weight"], params[f"{p}_q_bias"])
+        k = _fc(h, params[f"{p}_k_weight"], params[f"{p}_k_bias"])
+        v = _fc(h, params[f"{p}_v_weight"], params[f"{p}_v_bias"])
+        qh = q.reshape(B, Hq, Dh)
+        kh = k.reshape(B, Hkv, Dh)
+        vh = v.reshape(B, Hkv, Dh)
+        if cfg.pos_table is None:
+            qh, kh = _rope(qh, pos), _rope(kh, pos)
+        ck = ck.at[i, blk, off].set(kh)
+        cv = cv.at[i, blk, off].set(vh)
+        attn = paged_attention(qh, ck[i], cv[i], tables, ctx,
+                               window=cfg.window)
+        x = x + _fc(attn.reshape(B, d_model),
+                    params[f"{p}_proj_weight"],
+                    params[f"{p}_proj_bias"])
+        x = x + _mlp(cfg, params, p, x)
+    return _logits(cfg, params, x), ck, cv
+
+
+def _build_decode(cfg, donate):
+    def decode(params, ck, cv, toks, pos, tables, rng):
+        logits, ck, cv = _forward_token_batch(cfg, params, ck, cv,
+                                              toks, pos, tables)
+        return _sample(cfg, logits, rng), ck, cv
+
+    return jax.jit(decode, donate_argnums=(1, 2) if donate else ())
+
+
+def _build_prefill(cfg, P, donate):
+    name = cfg.name
+    Hq, Hkv, Dh = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    group = Hq // Hkv
+    d_model = Hq * Dh
+    window = cfg.window
+
+    def prefill(params, ck, cv, toks, plen, blk, off, rng):
+        """Whole-prompt pass at padded length P for ONE request:
+        writes K/V for positions [0, plen) through the block
+        table and samples the token after position plen-1."""
+        pos = jnp.arange(P)
+        x = params[f"{name}_tok_embed_weight"][toks]       # (P, D)
+        if cfg.pos_table is not None:
+            x = x + params[f"{name}_pos_embed_weight"][0, :P]
+        qp = pos[:, None]
+        kp = pos[None, :]
+        keep = qp >= kp                                    # causal
+        if window:
+            keep = jnp.logical_and(keep, qp - kp < window)
+        for i in range(cfg.n_layers):
+            p = f"{name}_l{i}"
+            h = _ln(x, params[f"{p}_ln1_gamma"],
+                    None if cfg.rmsnorm else params[f"{p}_ln1_beta"])
+            q = _fc(h, params[f"{p}_q_weight"], params[f"{p}_q_bias"])
+            k = _fc(h, params[f"{p}_k_weight"], params[f"{p}_k_bias"])
+            v = _fc(h, params[f"{p}_v_weight"], params[f"{p}_v_bias"])
+            qh = q.reshape(P, Hq, Dh)
+            kh = k.reshape(P, Hkv, Dh)
+            vh = v.reshape(P, Hkv, Dh)
+            if cfg.pos_table is None:
+                qh, kh = _rope(qh, pos), _rope(kh, pos)
+            ck = ck.at[i, blk, off].set(kh)
+            cv = cv.at[i, blk, off].set(vh)
+            # grouped-query dense causal attention within the
+            # prompt (same head grouping as paged_attention)
+            qg = qh.reshape(P, Hkv, group, Dh)
+            sc = jnp.einsum("qkgd,skd->kgqs", qg, kh)
+            sc = sc / np.sqrt(Dh)
+            sc = jnp.where(keep[None, None], sc,
+                           jnp.asarray(-jnp.inf, sc.dtype))
+            pr = jax.nn.softmax(sc.astype(jnp.float32),
+                                axis=-1).astype(x.dtype)
+            at = jnp.einsum("kgqs,skd->qkgd", pr, vh)
+            x = x + _fc(at.reshape(P, d_model),
+                        params[f"{p}_proj_weight"],
+                        params[f"{p}_proj_bias"])
+            x = x + _mlp(cfg, params, p, x)
+        logits = _logits(cfg, params, x[plen - 1][None])
+        return _sample(cfg, logits, rng)[0], ck, cv
+
+    return jax.jit(prefill, donate_argnums=(1, 2) if donate else ())

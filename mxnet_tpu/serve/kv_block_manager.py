@@ -1,0 +1,147 @@
+"""Paged KV-cache block accounting for the serving engine.
+
+One fixed device-resident cache (allocated once by ``serve.Engine``)
+is carved into ``num_blocks`` blocks of ``block_size`` token slots
+each.  This module owns the HOST-side bookkeeping only: which physical
+blocks belong to which request (the per-request *block table*), the
+free list, and the LRU eviction tier — the device arrays never move.
+``ops.attention.paged_attention`` consumes the tables to gather K/V.
+
+Block id 0 is the permanent *null block*: it is never allocated, block
+tables pad with it past a request's last real block, and padded scatter
+positions write into it.  Its contents are garbage by design — every
+consumer masks by context length before the softmax.
+
+Lifecycle of a block set:
+
+  allocate()  -> owned by a live request (counted in ``blocks_in_use``)
+  free()      -> retained: the ids park in an LRU of finished/preempted
+                 requests and still hold their K/V (a future
+                 prefix-cache hit could resurrect them); they are
+                 reclaimed lazily, oldest request first, only when the
+                 free list runs dry
+  evict       -> back on the free list, contents forgotten
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+
+__all__ = ["BlockManager", "NoFreeBlocks"]
+
+
+class NoFreeBlocks(Exception):
+    """Raised when an allocation cannot be satisfied even after
+    evicting every retained (finished/preempted) block set.  The
+    scheduler catches this and preempts a running request instead of
+    letting the cache OOM."""
+
+
+def blocks_for(n_tokens, block_size):
+    """Physical blocks needed to hold ``n_tokens`` cache slots."""
+    return -(-n_tokens // block_size)
+
+
+class BlockManager:
+    def __init__(self, num_blocks, block_size):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is the null block)")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # block 0 reserved as the null/padding block
+        self._free = deque(range(1, num_blocks))
+        self._tables = {}          # rid -> [block ids] (live requests)
+        self._lens = {}            # rid -> reserved token capacity
+        self._retained = OrderedDict()   # rid -> [block ids], LRU order
+        self.evictions = 0
+
+    # -- capacity ------------------------------------------------------------
+    @property
+    def total_blocks(self):
+        """Allocatable blocks (the null block excluded)."""
+        return self.num_blocks - 1
+
+    @property
+    def blocks_in_use(self):
+        return sum(len(t) for t in self._tables.values())
+
+    @property
+    def free_blocks(self):
+        """Immediately or lazily reclaimable blocks."""
+        return len(self._free) + sum(len(b) for b in self._retained.values())
+
+    def utilization(self):
+        return self.blocks_in_use / max(1, self.total_blocks)
+
+    def can_allocate(self, n_tokens):
+        return blocks_for(n_tokens, self.block_size) <= self.free_blocks
+
+    def fits_at_all(self, n_tokens):
+        """Whether a request of ``n_tokens`` could EVER hold the cache
+        alone — the admission-time rejection test (back-pressure
+        instead of a guaranteed later OOM)."""
+        return blocks_for(n_tokens, self.block_size) <= self.total_blocks
+
+    # -- allocation ----------------------------------------------------------
+    def _take(self, n):
+        """Pop n free blocks, evicting LRU retained sets as needed."""
+        while len(self._free) < n:
+            if not self._retained:
+                raise NoFreeBlocks(
+                    f"need {n} blocks, {len(self._free)} free and "
+                    "nothing retained to evict")
+            _, blocks = self._retained.popitem(last=False)  # oldest
+            self._free.extend(blocks)
+            self.evictions += 1
+        return [self._free.popleft() for _ in range(n)]
+
+    def allocate(self, rid, n_tokens):
+        """Create ``rid``'s block table covering ``n_tokens`` slots."""
+        if rid in self._tables:
+            raise ValueError(f"request {rid!r} already has a block table")
+        if rid in self._retained:
+            # a preempted request resuming: its parked blocks hold stale
+            # K/V (resume recomputes), so reclaim them up front rather
+            # than leaking the entry when this rid is freed again later
+            self._free.extend(self._retained.pop(rid))
+        n = blocks_for(n_tokens, self.block_size)
+        self._tables[rid] = self._take(n)
+        self._lens[rid] = n * self.block_size
+        return list(self._tables[rid])
+
+    def ensure_capacity(self, rid, n_tokens):
+        """Grow ``rid``'s table to cover ``n_tokens`` slots (decode
+        appends).  Raises NoFreeBlocks when the cache is exhausted —
+        the scheduler's preemption trigger."""
+        table = self._tables[rid]
+        need = blocks_for(n_tokens, self.block_size) - len(table)
+        if need > 0:
+            table.extend(self._take(need))
+            self._lens[rid] = len(table) * self.block_size
+        return list(table)
+
+    def table(self, rid):
+        return list(self._tables[rid])
+
+    def capacity(self, rid):
+        """Token slots currently reserved for ``rid``."""
+        return self._lens[rid]
+
+    def free(self, rid, retain=True):
+        """Release ``rid``'s blocks.  ``retain=True`` (finished or
+        preempted requests) parks them in the LRU tier; ``retain=False``
+        returns them to the free list immediately."""
+        blocks = self._tables.pop(rid)
+        self._lens.pop(rid)
+        if retain:
+            self._retained[rid] = blocks
+        else:
+            self._free.extend(blocks)
+
+    def reset(self):
+        self._free = deque(range(1, self.num_blocks))
+        self._tables.clear()
+        self._lens.clear()
+        self._retained.clear()

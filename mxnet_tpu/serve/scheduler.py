@@ -1,0 +1,216 @@
+"""Iteration-level continuous-batching scheduler (Orca-style).
+
+Every engine step the scheduler re-decides the batch from scratch:
+finished requests leave between iterations, waiting requests join as
+soon as a decode slot AND cache blocks open up, so the device batch
+stays full without waiting for stragglers (continuous batching, vs the
+static-batch serving of the reference's predictor).
+
+Admission is a bounded FIFO queue — ``submit`` on a full queue raises
+``QueueFull`` (back-pressure to the caller) and a request whose
+``deadline_s`` expires before its prefill is rejected, never silently
+dropped.  When decode outgrows the cache mid-flight the LOWEST-priority
+running request (latest arrival) is preempted: its blocks are freed
+(parked in the block manager's LRU tier) and the request re-enters the
+front of the waiting queue to resume by recomputation — prompt plus
+already-generated tokens re-prefill together, which greedy decoding
+makes token-exact (tested by test_serve.py's resume-equivalence case).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+
+import numpy as np
+
+from .kv_block_manager import NoFreeBlocks, blocks_for
+
+__all__ = ["Request", "Scheduler", "QueueFull",
+           "WAITING", "RUNNING", "FINISHED", "REJECTED", "CANCELLED"]
+
+WAITING = "waiting"        # in the admission queue (incl. preempted)
+RUNNING = "running"        # holds cache blocks, in the decode batch
+FINISHED = "finished"      # produced max_new_tokens
+REJECTED = "rejected"      # back-pressure: deadline/capacity, never ran to completion
+CANCELLED = "cancelled"    # engine shutdown with the request in flight
+
+
+class QueueFull(Exception):
+    """Admission queue at capacity — back-pressure; resubmit later."""
+
+
+_rid_counter = itertools.count()
+
+
+class Request:
+    """One generation request and its serving-side bookkeeping."""
+
+    def __init__(self, prompt, max_new_tokens, deadline_s=None):
+        self.rid = next(_rid_counter)
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline_s = deadline_s
+        self.status = WAITING
+        self.tokens = []           # generated ids (ints)
+        self.cache_len = 0         # K/V slots written for this request
+        self.submit_t = None       # stamped by the scheduler
+        self.first_token_t = None
+        self.finish_t = None
+        self.n_preemptions = 0
+        self.reject_reason = None
+
+    # -- derived -------------------------------------------------------------
+    @property
+    def done(self):
+        return self.status in (FINISHED, REJECTED, CANCELLED)
+
+    def prefill_ids(self):
+        """Token ids the next prefill must run over: the prompt plus —
+        after a preemption — everything already generated (resume by
+        recomputation)."""
+        if self.tokens:
+            return np.concatenate(
+                [self.prompt, np.asarray(self.tokens, np.int32)])
+        return self.prompt
+
+    def target_len(self):
+        """Total sequence length when this request completes."""
+        return self.prompt.size + self.max_new_tokens
+
+    def ttft(self):
+        if self.first_token_t is None or self.submit_t is None:
+            return None
+        return self.first_token_t - self.submit_t
+
+
+class Scheduler:
+    def __init__(self, block_mgr, max_batch, max_queue,
+                 max_prefills_per_step=1, clock=time.monotonic):
+        self.blocks = block_mgr
+        self.max_batch = int(max_batch)
+        self.max_queue = int(max_queue)
+        self.max_prefills_per_step = int(max_prefills_per_step)
+        self.clock = clock
+        self.waiting = []          # FIFO by arrival (rids are monotonic)
+        self.running = []          # admission order preserved
+        self.preemptions = 0
+        self.rejections = 0
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, req):
+        if len(self.waiting) >= self.max_queue:
+            raise QueueFull(
+                f"admission queue full ({self.max_queue} waiting)")
+        if not self.blocks.fits_at_all(req.target_len()):
+            # would OOM the cache even running alone: reject NOW, at
+            # submit, rather than deadlock in the waiting queue
+            self._reject(req, "exceeds_cache")
+            return req
+        req.submit_t = self.clock()
+        self.waiting.append(req)
+        return req
+
+    def _reject(self, req, reason):
+        req.status = REJECTED
+        req.reject_reason = reason
+        req.finish_t = self.clock()
+        self.rejections += 1
+
+    @property
+    def queue_depth(self):
+        return len(self.waiting)
+
+    def has_work(self):
+        return bool(self.waiting or self.running)
+
+    # -- one iteration's decisions -------------------------------------------
+    def schedule(self):
+        """Decide this iteration's work: ``(prefills, decodes)``.
+
+        1. Expire overdue waiting requests (deadline -> REJECTED).
+        2. Secure the next cache slot for every running request,
+           preempting latest arrivals when blocks run out.
+        3. Admit from the queue front while a batch slot, the prefill
+           budget, and blocks for prompt+1 tokens are all available
+           (the +1 guarantees the first decode step cannot be the one
+           that discovers the cache is full).  Decode slots were
+           secured FIRST, so admission never steals a running
+           request's block and a just-admitted request is never the
+           same iteration's preemption victim.
+        """
+        now = self.clock()
+        keep = []
+        for req in self.waiting:
+            if (req.deadline_s is not None
+                    and now - req.submit_t > req.deadline_s):
+                self._reject(req, "deadline")
+            else:
+                keep.append(req)
+        self.waiting = keep
+
+        decodes = []
+        for req in list(self.running):
+            if req not in self.running:
+                continue           # preempted as an earlier victim
+            try:
+                self.blocks.ensure_capacity(req.rid, req.cache_len + 1)
+            except NoFreeBlocks:
+                victim = self._pick_victim(req)
+                self.preempt(victim)
+                if victim is not req:
+                    # retry once with the victim's blocks reclaimed
+                    try:
+                        self.blocks.ensure_capacity(req.rid,
+                                                    req.cache_len + 1)
+                    except NoFreeBlocks:
+                        self.preempt(req)
+                        continue
+                else:
+                    continue
+            decodes.append(req)
+        # a request scheduled early in the loop can still become a later
+        # request's preemption victim — keep only survivors
+        decodes = [r for r in decodes if r in self.running]
+
+        prefills = []
+        while (self.waiting
+               and len(self.running) + len(prefills) < self.max_batch
+               and len(prefills) < self.max_prefills_per_step):
+            req = self.waiting[0]
+            need = req.prefill_ids().size + 1
+            if not self.blocks.can_allocate(need):
+                break              # FIFO head-of-line: no skipping ahead
+            self.waiting.pop(0)
+            self.blocks.allocate(req.rid, need)
+            req.status = RUNNING
+            prefills.append(req)
+        return prefills, decodes
+
+    def _pick_victim(self, needy):
+        """Lowest priority = latest arrival among running requests."""
+        return max(self.running, key=lambda r: r.rid)
+
+    def preempt(self, req):
+        """Free ``req``'s blocks and push it back to the FRONT of the
+        waiting queue (it arrived before everything waiting behind it,
+        so resuming it first preserves FIFO fairness)."""
+        self.running.remove(req)
+        self.blocks.free(req.rid, retain=True)
+        req.status = WAITING
+        req.cache_len = 0
+        req.n_preemptions += 1
+        self.preemptions += 1
+        self.waiting.append(req)
+        self.waiting.sort(key=lambda r: r.rid)   # arrival order
+
+    def finish(self, req, status=FINISHED):
+        if req in self.running:
+            self.running.remove(req)
+            self.blocks.free(req.rid, retain=True)
+        req.status = status
+        req.finish_t = self.clock()

@@ -1,0 +1,127 @@
+"""Serving observability: counters + the ``ServeStats`` snapshot.
+
+The engine owns one ``StatsRecorder`` and stamps it from the serving
+loop; ``snapshot()`` freezes the current view into an immutable
+``ServeStats`` for dashboards, ``tools/serve_bench.py``'s JSON record,
+and the periodic ``mxnet_tpu.monitor.ServeMonitor`` log line (the
+serving-side analog of ``Speedometer``'s samples/sec callback).
+
+Tokens/sec is reported two ways: ``decode_tok_per_sec`` over a sliding
+window of recent steps (the live rate a dashboard wants) and
+``total_tok_per_sec`` over the engine's whole life (the benchmark
+aggregate).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import asdict, dataclass
+
+__all__ = ["ServeStats", "StatsRecorder"]
+
+
+@dataclass(frozen=True)
+class ServeStats:
+    """One immutable snapshot of the serving engine."""
+    steps: int
+    queue_depth: int
+    running: int
+    completed: int
+    rejected: int
+    preemptions: int
+    evictions: int
+    tokens_generated: int
+    prompt_tokens: int
+    blocks_in_use: int
+    blocks_total: int
+    block_utilization: float           # right now
+    peak_block_utilization: float      # high-water mark across steps
+    ttft_ms_mean: float | None
+    ttft_ms_max: float | None
+    decode_tok_per_sec: float | None   # sliding window over recent steps
+    total_tok_per_sec: float | None    # engine lifetime aggregate
+
+    def as_dict(self):
+        return asdict(self)
+
+
+class StatsRecorder:
+    def __init__(self, clock=time.monotonic, window_steps=64):
+        self.clock = clock
+        self.steps = 0
+        self.completed = 0
+        self.rejected = 0
+        self.tokens_generated = 0
+        self.prompt_tokens = 0
+        self._ttfts = []
+        self._start_t = None
+        self.peak_block_utilization = 0.0
+        # (t, tokens_emitted) per step for the sliding-window rate
+        self._window = deque(maxlen=window_steps)
+
+    def on_step(self, new_tokens):
+        now = self.clock()
+        if self._start_t is None:
+            self._start_t = now
+        self.steps += 1
+        self.tokens_generated += new_tokens
+        self._window.append((now, new_tokens))
+
+    def on_utilization(self, frac):
+        """Stamp the cache high-water mark (the engine samples right
+        after scheduling, when this step's blocks are all held —
+        sampling after a drain would always read ~0)."""
+        if frac > self.peak_block_utilization:
+            self.peak_block_utilization = frac
+
+    def on_first_token(self, ttft_s):
+        self._ttfts.append(ttft_s)
+
+    def on_complete(self, req):
+        self.completed += 1
+        self.prompt_tokens += int(req.prompt.size)
+
+    def on_reject(self):
+        self.rejected += 1
+
+    def _window_rate(self):
+        if len(self._window) < 2:
+            return None
+        dt = self._window[-1][0] - self._window[0][0]
+        if dt <= 0:
+            return None
+        # the first entry's tokens predate the window's time span
+        toks = sum(n for _, n in list(self._window)[1:])
+        return toks / dt
+
+    def snapshot(self, scheduler, blocks):
+        now = self.clock()
+        total_rate = None
+        if self._start_t is not None and now > self._start_t:
+            total_rate = self.tokens_generated / (now - self._start_t)
+        ttft_mean = (sum(self._ttfts) / len(self._ttfts)
+                     if self._ttfts else None)
+        return ServeStats(
+            steps=self.steps,
+            queue_depth=scheduler.queue_depth,
+            running=len(scheduler.running),
+            completed=self.completed,
+            rejected=scheduler.rejections + self.rejected,
+            preemptions=scheduler.preemptions,
+            evictions=blocks.evictions,
+            tokens_generated=self.tokens_generated,
+            prompt_tokens=self.prompt_tokens,
+            blocks_in_use=blocks.blocks_in_use,
+            blocks_total=blocks.total_blocks,
+            block_utilization=round(blocks.utilization(), 4),
+            peak_block_utilization=round(self.peak_block_utilization, 4),
+            ttft_ms_mean=(round(ttft_mean * 1e3, 3)
+                          if ttft_mean is not None else None),
+            ttft_ms_max=(round(max(self._ttfts) * 1e3, 3)
+                         if self._ttfts else None),
+            decode_tok_per_sec=(round(self._window_rate(), 1)
+                                if self._window_rate() else None),
+            total_tok_per_sec=(round(total_rate, 1)
+                               if total_rate else None),
+        )

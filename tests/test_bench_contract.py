@@ -189,6 +189,37 @@ def test_decode_bench_contract():
 
 
 @pytest.mark.slow
+def test_serve_bench_contract():
+    """tools/serve_bench.py (the SERVE_BENCH.json bench_watch stage)
+    emits the serving record on CPU smoke shapes: last line is the
+    payload with aggregate tokens/sec, mean TTFT, preemption count,
+    the serial-decode speedup, zero silent drops, and complete:true
+    (the bench_io contract the watchdog trusts)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # no tunnel for a CPU smoke
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--backend", "cpu", "--layers", "2", "--d-model", "64",
+         "--heads", "4", "--vocab", "211", "--requests", "12",
+         "--concurrency", "4", "--prompt-lens", "8,16,24",
+         "--max-new", "8"],
+        capture_output=True, text=True, timeout=540, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads([l for l in r.stdout.splitlines()
+                          if l.startswith("{")][-1])
+    assert payload["platform"] == "cpu"
+    assert payload["complete"] is True      # stamped BEFORE the print
+    assert payload["tokens_per_sec"] > 0
+    assert payload["ttft_ms_mean"] > 0
+    assert payload["preemptions"] >= 0
+    assert payload["completed"] == 12
+    assert payload["dropped_without_rejection"] == 0
+    assert payload["speedup_vs_serial"] > 0
+    modes = {pt["mode"] for pt in payload["points"]}
+    assert modes == {"continuous/closed", "serial/closed"}
+
+
+@pytest.mark.slow
 def test_watchdog_rejects_stale_promoted_record(tmp_path):
     """bench_watch.run_bench must NOT persist bench.py's stale-promoted
     prior record as a fresh capture (that would launder an old number as

@@ -160,18 +160,27 @@ def test_native_priority_dispatch_order():
     if find_lib() is None:
         pytest.skip("native lib unavailable")
     eng = NativeEngine(num_workers=1, num_io_workers=1)
-    release = threading.Event()
     order = []
     # block BOTH lanes: native workers steal from the other lane's queue
-    # when their own is empty
+    # when their own is empty.  Wait for each blocker to REPORT it is
+    # running (a fixed sleep races worker startup), and release them one
+    # at a time: with both released, TWO workers drain the queue — pops
+    # stay priority-ordered but the appends interleave (observed flake).
+    # One free worker at a time makes completion order deterministic.
     from mxnet_tpu.engine import FnProperty
-    eng.push(lambda: release.wait(10))
-    eng.push(lambda: release.wait(10), prop=FnProperty.CPU_PRIORITIZED)
-    time.sleep(0.05)
+    started = [threading.Event(), threading.Event()]
+    release = [threading.Event(), threading.Event()]
+    eng.push(lambda: (started[0].set(), release[0].wait(10)))
+    eng.push(lambda: (started[1].set(), release[1].wait(10)),
+             prop=FnProperty.CPU_PRIORITIZED)
+    assert started[0].wait(5) and started[1].wait(5)
     for prio in [2, -1, 7, 0, 4]:
         eng.push(lambda p=prio: order.append(p), priority=prio)
-    time.sleep(0.05)
-    release.set()
+    release[0].set()                  # single consumer drains the queue
+    deadline = time.monotonic() + 5
+    while len(order) < 5 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    release[1].set()
     eng.wait_for_all()
     assert order == sorted(order, reverse=True) and len(order) == 5, order
 

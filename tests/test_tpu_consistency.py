@@ -59,6 +59,11 @@ def _skip_if_tunnel_down():
         pytest.skip("TPU unreachable (probe failed recently)")
     if not _probe_tpu():
         pytest.skip("TPU unreachable (probe)")
+    # the tunnel came BACK: an empty TPU batch cached while it was down
+    # is stale — evict it so the remaining cases spawn a fresh worker
+    # instead of all skipping on "no TPU result"
+    if not _BATCH.get("tpu", True):
+        del _BATCH["tpu"]
 
 pytestmark = pytest.mark.skipif(
     os.environ.get("MXTPU_TPU_TESTS") != "1",
@@ -383,6 +388,15 @@ CASES = ["conv_bn_relu", "fc_softmax",
 _BATCH = {}
 
 
+def _batch_timeout(n_cases, tpu):
+    """Worker budget scaled to the batch it actually runs: a fixed
+    allowance for jax import + backend init (the tunnel-dominated
+    cost) plus a per-case compile+run slice.  At the full 24-case
+    batch this lands on the historical 1800s/1200s budgets; a 2-case
+    retry batch no longer inherits a 24-case timeout."""
+    return int((300 if tpu else 240) + (62 if tpu else 40) * n_cases)
+
+
 def _spawn(names, tpu, timeout):
     """Run one worker over ``names``; returns (results, init_ok).
     Results map case -> payload dict or {"error": traceback}; cases
@@ -421,9 +435,13 @@ def _spawn(names, tpu, timeout):
     init_ok = "INIT_OK" in out
     if in_flight is not None and in_flight not in results:
         # the worker died (timeout / hard crash, e.g. a Mosaic abort)
-        # with this case on the device — that's a real per-case failure,
-        # not a tunnel problem, IF init had completed
-        if init_ok:
+        # with this case on the device — a real per-case failure IF
+        # init had completed AND the case plausibly hung on its own.
+        # A timeout with earlier cases already completed means THEY
+        # consumed the batch budget; blaming the in-flight case would
+        # turn a slow tunnel into a false failure — leave it missing
+        # (retried in a smaller follow-up batch, else skipped).
+        if init_ok and not (timed_out and results):
             results[in_flight] = {
                 "error": f"worker died mid-case ({'timeout' if timed_out else 'crash'}): "
                          + stderr[-1500:]}
@@ -445,7 +463,8 @@ def _get_results(tpu):
             _TUNNEL["down_at"] = time.monotonic()
             _BATCH[key] = {}
             return _BATCH[key]
-    results, init_ok = _spawn(CASES, tpu, timeout=1800 if tpu else 1200)
+    results, init_ok = _spawn(CASES, tpu,
+                              timeout=_batch_timeout(len(CASES), tpu))
     if tpu and not init_ok and not results:
         # a down tunnel HANGS backend init rather than failing fast
         _TUNNEL["down_at"] = _TUNNEL["probe_failed_at"] = time.monotonic()
@@ -453,7 +472,8 @@ def _get_results(tpu):
         return _BATCH[key]
     missing = [c for c in CASES if c not in results]
     if missing and (init_ok or not tpu):
-        retry, _ = _spawn(missing, tpu, timeout=900 if tpu else 600)
+        retry, _ = _spawn(missing, tpu,
+                          timeout=_batch_timeout(len(missing), tpu))
         results.update(retry)
     _BATCH[key] = results
     return results

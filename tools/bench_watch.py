@@ -12,7 +12,8 @@ artifact set in priority order:
   5. tools/flash_bench.py                   -> FLASH_BENCH.json
   6. tools/quant_bench.py                   -> QUANT_BENCH.json
   7. tests/test_tpu_consistency.py          -> TPU_CONSISTENCY.json
-  8. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
+  8. tools/serve_bench.py                   -> SERVE_BENCH.json
+  9. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
 
 Each successful TPU-platform result is also appended to
 BENCH_ATTEMPTS.jsonl with a timestamp so nothing is lost if a later
@@ -106,6 +107,12 @@ def run_bench(env_overrides, out_path, tag, timeout=1500):
     return False
 
 
+# bench.py metrics where a larger value is better — the only ones a
+# challenger may be promoted on (a latency-/bytes-class metric would
+# promote regressions; anything unknown is left alone)
+HIGHER_IS_BETTER_UNITS = ("images/sec/chip", "tokens/sec/chip")
+
+
 def run_bench_challenger(env_overrides, tag, timeout=1500):
     """Measure an alternative config (e.g. bs=256 — the VERDICT r4 MFU
     experiment) and promote it to BENCH_TPU_LATEST.json only when it
@@ -117,10 +124,22 @@ def run_bench_challenger(env_overrides, tag, timeout=1500):
     latest = os.path.join(REPO, "BENCH_TPU_LATEST.json")
     try:
         new = json.load(open(out))
+    except (OSError, ValueError):
+        return True                 # capture vanished under us; keep stage done
+    try:
         cur = json.load(open(latest))
     except (OSError, ValueError):
+        # no (or unreadable) incumbent: this fresh TPU capture IS the
+        # best known record — promote it rather than silently retiring
+        # the stage with LATEST still missing
+        with open(latest, "w") as f:
+            f.write(json.dumps(new) + "\n")
+        log(f"{tag}: no readable BENCH_TPU_LATEST — promoted challenger "
+            f"({new.get('value')} {new.get('unit')})")
         return True
     if (new.get("metric") == cur.get("metric")
+            and new.get("unit") == cur.get("unit")
+            and new.get("unit") in HIGHER_IS_BETTER_UNITS
             and new.get("value", 0) > cur.get("value", 0)):
         with open(latest, "w") as f:
             f.write(json.dumps(new) + "\n")
@@ -323,6 +342,23 @@ def run_decode_bench(timeout=1800):
         "DECODE_BENCH.json", timeout, validate=validate)
 
 
+def run_serve_bench(timeout=2400):
+    """Continuous-batching serving throughput (tools/serve_bench.py) —
+    aggregate tokens/sec, TTFT and preemption behavior of the paged
+    KV-cache engine, plus its speedup over serial decode."""
+
+    def validate(payload):
+        if not payload.get("tokens_per_sec"):
+            return "no serving throughput"
+        if payload.get("dropped_without_rejection"):
+            return "requests dropped without rejection"
+        return None
+
+    return run_json_artifact(
+        "serve", [os.path.join(REPO, "tools", "serve_bench.py")],
+        "SERVE_BENCH.json", timeout, validate=validate)
+
+
 def run_tpu_consistency(timeout=2400):
     """The cpu-vs-tpu numerics gate (tests/test_tpu_consistency.py) has
     only ever run when a session held the chip; record a pass here."""
@@ -361,8 +397,8 @@ def main():
     done = {"consistency": False, "flash": False, "rnn": False,
             "resnet": False, "resnet256": False, "gpt": False,
             "longcontext": False, "bandwidth": False, "cifar": False,
-            "quant": False, "decode": False, "train_tier": False,
-            "sweep": False}
+            "quant": False, "decode": False, "serve": False,
+            "train_tier": False, "sweep": False}
     fails = {k: 0 for k in done}
     MAX_FAILS = 6  # give up on a stage that fails repeatedly WITH the
     #               probe passing (a code bug, not a tunnel flake)
@@ -429,6 +465,7 @@ def main():
                 timeout=min(1500, left))),
             ("quant", lambda: run_quant_bench(timeout=min(1800, left))),
             ("decode", lambda: run_decode_bench(timeout=min(1800, left))),
+            ("serve", lambda: run_serve_bench(timeout=min(2400, left))),
             ("train_tier", lambda: run_train_tier(timeout=min(3000, left))),
         ]
         pending = next(((n, fn) for n, fn in stages if not done[n]), None)

@@ -135,8 +135,11 @@ def main():
             print(json.dumps(rec))
             pts.append(rec)
             flush(False)
-    print(json.dumps(out))
+    # stamp completion BEFORE the stdout record: the last line printed
+    # is the driver's contract, and a finished run must not say
+    # "complete": false there (the artifact write orders the same way)
     flush(True)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -207,8 +207,11 @@ def main():
                        "note": None if on_tpu else
                        "cpu virtual mesh: scaling SHAPE only; rerun on "
                        "a multi-chip slice for absolute numbers"}
-    print(json.dumps(out))
+    # stamp completion BEFORE the stdout record (same contract as
+    # decode_bench: the last stdout line must carry "complete": true
+    # on a finished run)
     flush(True)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""Continuous-batching serving benchmark: aggregate tokens/sec, TTFT,
+and preemption behavior of ``mxnet_tpu.serve.Engine`` under load.
+
+The serving-side companion to tools/decode_bench.py (single-stream
+decode): builds a checkpoint-shaped random GPT, replays a mixed
+prompt-length workload through the engine, and reports the numbers a
+serving operator tunes for — aggregate tokens/sec, mean/max
+time-to-first-token, preemptions/evictions under cache pressure, and
+the speedup over serial single-request decode of the SAME workload
+(the continuous-batching win itself).
+
+Two load modes:
+
+  closed  at most --concurrency requests in flight; a completion
+          immediately admits the next (throughput-oriented).
+  open    Poisson arrivals at --rate req/s; admission-queue overflow
+          is counted as back-pressure rejection, never a silent drop
+          (latency/SLO-oriented).
+
+Emits the same last-line JSON + ``--json`` artifact contract as the
+other bench tools (tools/bench_io.py), so tools/bench_watch.py tracks
+it as the SERVE_BENCH.json stage.
+
+Usage: python tools/serve_bench.py [--backend cpu] [--json OUT]
+           [--requests 32 --concurrency 8 --prompt-lens 16,32,64,128]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_workload(rng, args):
+    """(prompt, max_new) pairs cycling the mixed prompt lengths."""
+    lens = [int(x) for x in args.prompt_lens.split(",")]
+    work = []
+    for i in range(args.requests):
+        n = lens[i % len(lens)]
+        work.append((rng.randint(0, args.vocab, (n,)).astype("int32"),
+                     args.max_new))
+    return work
+
+
+def run_closed(mx, engine, workload, concurrency, deadline_s=None):
+    """Closed loop: keep ``concurrency`` requests in flight.  A full
+    admission queue throttles the loop (closed-loop clients WAIT for
+    capacity — e.g. --max-queue below --concurrency), it never drops."""
+    reqs, inflight, held = [], [], None
+    it = iter(workload)
+    t0 = time.perf_counter()
+    while True:
+        while len(inflight) < concurrency:
+            nxt = held if held is not None else next(it, None)
+            if nxt is None:
+                break
+            held = None
+            prompt, max_new = nxt
+            try:
+                reqs.append(engine.submit(prompt, max_new_tokens=max_new,
+                                          deadline_s=deadline_s))
+            except mx.serve.QueueFull:
+                held = nxt            # back-pressure: retry after a step
+                break
+            inflight.append(reqs[-1])
+        if not inflight and held is None:
+            break
+        engine.step()
+        inflight = [r for r in inflight if not r.done]
+    return reqs, time.perf_counter() - t0
+
+
+def run_open(mx, engine, workload, rate, rng, deadline_s=None):
+    """Open loop: Poisson arrivals at ``rate`` req/s; a full admission
+    queue rejects (counted), it never blocks the arrival process."""
+    arrivals = rng.exponential(1.0 / rate, len(workload)).cumsum()
+    reqs, queue_full = [], 0
+    t0 = time.perf_counter()
+    i = 0
+    while i < len(workload) or engine.scheduler.has_work():
+        now = time.perf_counter() - t0
+        while i < len(workload) and arrivals[i] <= now:
+            prompt, max_new = workload[i]
+            try:
+                reqs.append(engine.submit(prompt, max_new_tokens=max_new,
+                                          deadline_s=deadline_s))
+            except mx.serve.QueueFull:
+                queue_full += 1
+            i += 1
+        if engine.scheduler.has_work():
+            engine.step()
+        elif i < len(workload):
+            time.sleep(min(0.005, arrivals[i] - now))
+    return reqs, time.perf_counter() - t0, queue_full
+
+
+def summarize(tag, reqs, wall, stats, n_requests, queue_full=0):
+    done = [r for r in reqs if r.status == "finished"]
+    rejected = [r for r in reqs if r.status == "rejected"]
+    ttfts = [r.ttft() for r in done if r.ttft() is not None]
+    toks = sum(len(r.tokens) for r in done)
+    rec = {"mode": tag, "requests": n_requests,
+           "completed": len(done),
+           "rejected": len(rejected) + queue_full,
+           "queue_full_rejects": queue_full,
+           "dropped_without_rejection":
+               n_requests - len(done) - len(rejected) - queue_full,
+           "wall_s": round(wall, 3),
+           "new_tokens": toks,
+           "tokens_per_sec": round(toks / wall, 1) if wall > 0 else None,
+           "preemptions": stats.preemptions,
+           "evictions": stats.evictions,
+           "peak_block_utilization": stats.peak_block_utilization,
+           "steps": stats.steps}
+    if ttfts:
+        ttfts.sort()
+        rec["ttft_ms_mean"] = round(sum(ttfts) / len(ttfts) * 1e3, 2)
+        rec["ttft_ms_p50"] = round(ttfts[len(ttfts) // 2] * 1e3, 2)
+        rec["ttft_ms_max"] = round(ttfts[-1] * 1e3, 2)
+    return rec
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--layers", type=int, default=None,
+                   help="default 12 on tpu, 4 off (CPU-tractable smoke)")
+    p.add_argument("--d-model", type=int, default=None,
+                   help="default 768 on tpu, 256 off")
+    p.add_argument("--heads", type=int, default=None,
+                   help="default 12 on tpu, 8 off")
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="GQA kv heads (default heads//4, min 1)")
+    p.add_argument("--vocab", type=int, default=None,
+                   help="default 50304 on tpu, 2048 off")
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--concurrency", type=int, default=8)
+    p.add_argument("--prompt-lens", default="16,32,64,128")
+    p.add_argument("--max-new", type=int, default=32)
+    p.add_argument("--mode", default="closed", choices=("closed", "open"))
+    p.add_argument("--rate", type=float, default=16.0,
+                   help="open-loop arrival rate, requests/sec")
+    p.add_argument("--deadline-s", type=float, default=None)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--num-blocks", type=int, default=None,
+                   help="cache blocks (default: fits ~concurrency+2 "
+                        "max-length requests -> real preemption pressure)")
+    p.add_argument("--max-queue", type=int, default=None)
+    p.add_argument("--no-serial", action="store_true",
+                   help="skip the serial single-request baseline")
+    p.add_argument("--warmup", type=int, default=1,
+                   help="untimed warmup pass to populate the program "
+                        "cache (0 to include compiles in the timing)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json", default=None)
+    p.add_argument("--backend", "--platform", dest="platform", default=None)
+    args = p.parse_args()
+
+    if args.platform:
+        # the framework-owned selector: authoritative even where the
+        # accelerator site plugin outranks JAX_PLATFORMS
+        os.environ["MXTPU_PLATFORMS"] = args.platform
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    import jax
+
+    from tools.bench_io import make_flush
+    from tools.decode_bench import make_params
+
+    on_tpu_now = jax.default_backend() == "tpu"
+    # gpt-small-class on chip (decode_bench's config); a CPU run keeps
+    # the same serving dynamics on a tractable model
+    args.layers = args.layers or (12 if on_tpu_now else 4)
+    args.d_model = args.d_model or (768 if on_tpu_now else 256)
+    args.heads = args.heads or (12 if on_tpu_now else 8)
+    args.vocab = args.vocab or (50304 if on_tpu_now else 2048)
+
+    lens = [int(x) for x in args.prompt_lens.split(",")]
+    max_len = max(lens) + args.max_new
+    kv = args.kv_heads or max(1, args.heads // 4)
+    S = max_len
+    net = mx.models.gpt(args.vocab, S, num_layers=args.layers,
+                        d_model=args.d_model, num_heads=args.heads,
+                        norm="rmsnorm", mlp="swiglu", pos_embed="rope",
+                        tie_embeddings=True, kv_heads=kv)
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = "bfloat16" if on_tpu else "float32"
+    params = make_params(net, 1, S, dtype)
+
+    blocks_per_req = -(-max_len // args.block_size)
+    num_blocks = args.num_blocks or (
+        1 + blocks_per_req * (args.concurrency + 2))
+    max_queue = args.max_queue or max(args.requests, 2 * args.concurrency)
+
+    def make_engine(max_batch):
+        return mx.serve.Engine(
+            params, symbol=net, block_size=args.block_size,
+            num_blocks=num_blocks, max_batch=max_batch,
+            max_queue=max_queue, max_model_len=max_len,
+            max_prefills_per_step=2)
+
+    out = {"platform": jax.default_backend(),
+           "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+           "layers": args.layers, "d_model": args.d_model,
+           "heads": args.heads, "kv_heads": kv, "vocab": args.vocab,
+           "block_size": args.block_size, "num_blocks": num_blocks,
+           "concurrency": args.concurrency, "mode": args.mode,
+           "param_dtype": dtype}
+    flush = make_flush(args.json, out)
+    pts = []
+    out["points"] = pts
+    rng = np.random.RandomState(args.seed)
+    workload = build_workload(rng, args)
+
+    if args.warmup:
+        # cover the prompt-length and batch buckets so the measured
+        # runs time serving, not XLA compiles: long enough generations
+        # that the decode batch actually FILLS (every batch bucket up
+        # to the concurrency compiles during ramp-up/drain), plus the
+        # half-length prompts preemption-resume prefills would hit.
+        # Mid-run preemption can still compile an odd resume-length
+        # bucket — acceptable noise.
+        # full prompts at the workload's own max_new (anything longer
+        # would breach max_model_len and be rejected at submit)
+        wl = [(pr, args.max_new) for pr, _ in workload[: args.concurrency]]
+        wl += [(pr[: max(1, len(pr) // 2)], min(4, args.max_new))
+               for pr, _ in workload[: args.concurrency]]
+        eng = make_engine(args.concurrency)
+        run_closed(mx, eng, wl, args.concurrency)
+        eng.shutdown()
+        eng = make_engine(1)
+        run_closed(mx, eng, wl[: 2], 1)
+        eng.shutdown()
+
+    engine = make_engine(args.concurrency)
+    if args.mode == "open":
+        reqs, wall, qfull = run_open(mx, engine, workload, args.rate,
+                                     rng, args.deadline_s)
+    else:
+        reqs, wall = run_closed(mx, engine, workload, args.concurrency,
+                                args.deadline_s)
+        qfull = 0
+    stats = engine.stats()
+    rec = summarize(f"continuous/{args.mode}", reqs, wall, stats,
+                    args.requests, qfull)
+    engine.shutdown()
+    print(json.dumps(rec))
+    pts.append(rec)
+    flush(False)
+
+    if not args.no_serial:
+        serial = make_engine(1)
+        sreqs, swall = run_closed(mx, serial, workload, 1)
+        srec = summarize("serial/closed", sreqs, swall, serial.stats(),
+                         args.requests)
+        serial.shutdown()
+        print(json.dumps(srec))
+        pts.append(srec)
+        if srec.get("tokens_per_sec") and rec.get("tokens_per_sec"):
+            out["speedup_vs_serial"] = round(
+                rec["tokens_per_sec"] / srec["tokens_per_sec"], 2)
+
+    # headline summary fields (the bench_watch / ARTIFACTS row)
+    out["tokens_per_sec"] = rec.get("tokens_per_sec")
+    out["ttft_ms_mean"] = rec.get("ttft_ms_mean")
+    out["preemptions"] = rec.get("preemptions")
+    out["completed"] = rec.get("completed")
+    out["rejected"] = rec.get("rejected")
+    out["dropped_without_rejection"] = rec.get("dropped_without_rejection")
+    flush(True)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
