@@ -28,6 +28,7 @@ from .base import MXNetError
 from .context import Context, cpu, cpu_pinned, current_context, gpu, tpu, num_devices
 from . import engine
 from . import random
+from . import telemetry
 from . import ndarray
 from . import ndarray as nd
 from . import symbol

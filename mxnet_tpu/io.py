@@ -16,10 +16,12 @@ import queue
 import re
 import struct
 import threading
+import time
 
 import numpy as np
 
 from . import ndarray as nd
+from . import telemetry
 from .base import MXNetError
 from .ndarray import NDArray
 
@@ -82,6 +84,19 @@ class DataBatch:
         self.provide_label = provide_label
 
 
+def _tel_batch_counter(it):
+    """Per-instance cached ``mxtpu_io_batches_total{iterator=...}``
+    child (the shared NOOP when telemetry is disabled, so the counting
+    costs one attribute call per batch)."""
+    child = getattr(it, "_tel_batches", None)
+    if child is None:
+        child = telemetry.counter(
+            "mxtpu_io_batches_total", "batches produced by data iterators",
+            ("iterator",)).labels(iterator=type(it).__name__)
+        it._tel_batches = child
+    return child
+
+
 class DataIter:
     """Iterator protocol (reference io.py:100): reset / next / iter, with
     provide_data/provide_label shape advertisement."""
@@ -100,6 +115,7 @@ class DataIter:
 
     def next(self) -> DataBatch:
         if self.iter_next():
+            _tel_batch_counter(self).inc()
             return DataBatch(self.getdata(), self.getlabel(), self.getpad(),
                              self.getindex())
         raise StopIteration
@@ -326,8 +342,23 @@ class PrefetchingIter(DataIter):
             if kind == "end":
                 break
 
+    def _tel_wait_hist(self):
+        hist = getattr(self, "_tel_wait", None)
+        if hist is None:
+            hist = telemetry.histogram(
+                "mxtpu_io_wait_seconds",
+                "time the consumer blocked on the prefetch queue",
+                ("iterator",)).labels(iterator=type(self).__name__)
+            self._tel_wait = hist
+        return hist
+
     def iter_next(self):
+        # queue wait == how far the producer thread is behind the
+        # consumer (0 means the pipeline keeps up; the per-batch analog
+        # of the fit loop's data_wait phase)
+        t0 = time.perf_counter()
         kind, batches = self._queue.get()
+        self._tel_wait_hist().observe(time.perf_counter() - t0)
         if kind == "end":
             return False
         data = sum([b.data for b in batches], [])
@@ -338,6 +369,7 @@ class PrefetchingIter(DataIter):
 
     def next(self):
         if self.iter_next():
+            _tel_batch_counter(self).inc()
             return self.current_batch
         raise StopIteration
 

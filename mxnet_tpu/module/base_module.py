@@ -16,6 +16,7 @@ import numpy as np
 
 from .. import metric as metric_mod
 from .. import ndarray as nd
+from .. import telemetry
 from ..base import MXNetError
 from ..callback import BatchEndParam
 from ..initializer import Uniform
@@ -192,15 +193,52 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        # telemetry handles resolved once per fit() call (no-op objects
+        # when MXTPU_TELEMETRY is unset — the disabled-path contract)
+        tel_batches = telemetry.counter(
+            "mxtpu_fit_batches_total", "batches processed by fit()")
+        tel_epochs = telemetry.counter(
+            "mxtpu_fit_epochs_total", "epochs completed by fit()")
+        tel_epoch_secs = telemetry.histogram(
+            "mxtpu_fit_epoch_seconds", "wall time per epoch",
+            buckets=(1.0, 5.0, 15.0, 60.0, 300.0, 1800.0, 7200.0))
+        tel_phase = telemetry.histogram(
+            "mxtpu_fit_phase_seconds", "per-batch fit-loop phase time",
+            ("phase",))
+        ph_data = tel_phase.labels(phase="data_wait")
+        ph_fwbw = tel_phase.labels(phase="forward_backward")
+        ph_update = tel_phase.labels(phase="update")
+        ph_metric = tel_phase.labels(phase="update_metric")
+
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            # perf_counter, not time.time(): NTP slews/steps make the
+            # wall clock non-monotonic, so "Time cost=" lines could jump
+            tic = time.perf_counter()
             eval_metric.reset()
-            for nbatch, data_batch in enumerate(train_data):
+            data_iter = iter(train_data)
+            nbatch = 0
+            while True:
+                t0 = time.perf_counter()
+                with telemetry.span("fit.data_wait"):
+                    data_batch = next(data_iter, None)
+                if data_batch is None:
+                    break
+                ph_data.observe(time.perf_counter() - t0)
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                self.update_metric(eval_metric, data_batch.label)
+                t0 = time.perf_counter()
+                with telemetry.span("fit.forward_backward"):
+                    self.forward_backward(data_batch)
+                ph_fwbw.observe(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                with telemetry.span("fit.update"):
+                    self.update()
+                ph_update.observe(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                with telemetry.span("fit.update_metric"):
+                    self.update_metric(eval_metric, data_batch.label)
+                ph_metric.observe(time.perf_counter() - t0)
+                tel_batches.inc()
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
@@ -208,9 +246,19 @@ class BaseModule:
                                           eval_metric=eval_metric)
                     for cb in _as_list(batch_end_callback):
                         cb(param)
+                nbatch += 1
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+            epoch_secs = time.perf_counter() - tic
+            tel_epochs.inc()
+            tel_epoch_secs.observe(epoch_secs)
+            if telemetry.enabled():
+                # enclosing epoch span (same perf_counter clock as the
+                # per-phase spans, so it nests around them in the trace)
+                telemetry.tracer().add_complete(
+                    "fit.epoch", tic, time.perf_counter(),
+                    {"epoch": epoch, "batches": nbatch})
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, epoch_secs)
 
             arg_params, aux_params = self.get_params()
             if epoch_end_callback is not None:

@@ -95,13 +95,22 @@ class ServeMonitor:
         if self.step % self.interval == 0:
             self.log_now()
 
+    @staticmethod
+    def _fmt(value):
+        """Grep/parse-stable field: ``-`` for not-yet-measured (None),
+        one decimal otherwise (raw floats would make the line width and
+        precision vary run to run)."""
+        return "-" if value is None else f"{float(value):.1f}"
+
     def log_now(self):
         s = self.engine.stats()
+        rate = (s.decode_tok_per_sec if s.decode_tok_per_sec is not None
+                else s.total_tok_per_sec)
         self.logger.info(
             "Serve: step %7d queue=%d running=%d done=%d rej=%d "
             "preempt=%d blocks=%d/%d (%.0f%%) ttft_ms=%s tok/s=%s",
             s.steps, s.queue_depth, s.running, s.completed, s.rejected,
             s.preemptions, s.blocks_in_use, s.blocks_total,
-            100.0 * s.block_utilization, s.ttft_ms_mean,
-            s.decode_tok_per_sec or s.total_tok_per_sec)
+            100.0 * s.block_utilization, self._fmt(s.ttft_ms_mean),
+            self._fmt(rate))
         return s

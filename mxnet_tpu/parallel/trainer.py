@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 
 import numpy as np
 
@@ -26,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .. import random as _random
+from .. import telemetry
 from ..base import MXNetError, np_dtype
 from ..executor import _CompiledGraph
 from ..initializer import Uniform
@@ -518,6 +520,17 @@ class ShardedTrainer:
                     "sequence-parallel axis explicitly")
             self._attn_seq_axis = seq_axes.pop() if seq_axes else None
         self._key = _random.next_key()
+        # telemetry handles (no-op objects when disabled).  step time is
+        # HOST time around the jitted call — dispatch cost when XLA runs
+        # async, the full device step when the result is consumed
+        self._tel_steps = telemetry.counter(
+            "mxtpu_trainer_steps_total", "ShardedTrainer optimizer steps")
+        self._tel_step_secs = telemetry.histogram(
+            "mxtpu_trainer_step_seconds",
+            "host wall time per train_step dispatch")
+        self._tel_data_wait = telemetry.histogram(
+            "mxtpu_trainer_data_wait_seconds",
+            "fit() wait on the host->device staging queue")
         self._build_steps()
 
     # ------------------------------------------------------------------ #
@@ -635,10 +648,14 @@ class ShardedTrainer:
 
     def step(self, batch: dict):
         """One optimizer step on a global batch; returns outputs."""
-        placed = self._place_batch(batch)
-        self.params, self.opt_state, self.aux, outs, self._key = \
-            self._train_step(self.params, self.opt_state, self.aux, placed,
-                             self._key, self._lr_scale())
+        t0 = time.perf_counter()
+        with telemetry.span("trainer.step"):
+            placed = self._place_batch(batch)
+            self.params, self.opt_state, self.aux, outs, self._key = \
+                self._train_step(self.params, self.opt_state, self.aux,
+                                 placed, self._key, self._lr_scale())
+        self._tel_step_secs.observe(time.perf_counter() - t0)
+        self._tel_steps.inc()
         return outs
 
     def eval(self, batch: dict):
@@ -701,16 +718,24 @@ class ShardedTrainer:
             t.start()
             nbatch = 0
             while True:
-                item = q.get()
+                t0 = time.perf_counter()
+                with telemetry.span("trainer.data_wait"):
+                    item = q.get()
+                self._tel_data_wait.observe(time.perf_counter() - t0)
                 if item is None:
                     break
                 if isinstance(item, BaseException):
                     t.join()
                     raise item
                 placed, labels = item
-                self.params, self.opt_state, self.aux, outs, self._key = \
-                    self._train_step(self.params, self.opt_state, self.aux,
-                                     placed, self._key, self._lr_scale())
+                t0 = time.perf_counter()
+                with telemetry.span("trainer.step"):
+                    self.params, self.opt_state, self.aux, outs, self._key = \
+                        self._train_step(self.params, self.opt_state,
+                                         self.aux, placed, self._key,
+                                         self._lr_scale())
+                self._tel_step_secs.observe(time.perf_counter() - t0)
+                self._tel_steps.inc()
                 nbatch += 1
                 if metric is not None and labels:
                     # host sync happens only when metrics are requested

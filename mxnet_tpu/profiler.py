@@ -29,17 +29,30 @@ _active_logdir = None
 
 def start(logdir):
     """Begin capturing an XLA trace into ``logdir`` (TensorBoard
-    `profile` plugin / xprof format)."""
+    `profile` plugin / xprof format).
+
+    Raises ``RuntimeError`` when a trace is already active — the
+    underlying jax failure for a double-start is an opaque XLA error
+    that doesn't name the first capture."""
     global _active_logdir
+    if _active_logdir is not None:
+        raise RuntimeError(
+            f"a profiler trace is already active (logdir="
+            f"{_active_logdir!r}); call profiler.stop() before starting "
+            "a new capture")
     jax.profiler.start_trace(logdir)
     _active_logdir = logdir
 
 
 def stop():
-    """Finish the capture started by ``start``."""
+    """Finish the capture started by ``start``.  The active-trace state
+    resets even when the underlying ``stop_trace`` raises (a failed
+    capture must not wedge every later ``start``)."""
     global _active_logdir
-    jax.profiler.stop_trace()
-    _active_logdir = None
+    try:
+        jax.profiler.stop_trace()
+    finally:
+        _active_logdir = None
 
 
 @contextlib.contextmanager

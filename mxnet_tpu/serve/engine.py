@@ -38,6 +38,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
 from ..models.generate import (_fc, _gelu, _ln, detect_gpt_variant,
                                normalize_gpt_params,
                                reconcile_decode_config)
@@ -191,6 +192,25 @@ class Engine:
             temperature=self.temperature, top_k=self.top_k)
         self._alive = True
         self._noop_steps = 0
+        # live-state gauges stamped once per step (no-op when telemetry
+        # is disabled); cumulative serve counters live in StatsRecorder
+        self._tel_queue = telemetry.gauge(
+            "mxtpu_serve_queue_depth", "requests waiting for admission")
+        self._tel_running = telemetry.gauge(
+            "mxtpu_serve_running", "requests in the decode batch")
+        self._tel_blocks = telemetry.gauge(
+            "mxtpu_serve_blocks_in_use", "KV-cache blocks allocated")
+        self._tel_block_util = telemetry.gauge(
+            "mxtpu_serve_block_utilization", "KV-cache block fraction used")
+        self._tel_preempt = telemetry.gauge(
+            "mxtpu_serve_preemptions", "scheduler preemptions (lifetime)")
+        self._tel_evict = telemetry.gauge(
+            "mxtpu_serve_evictions", "retained-block evictions (lifetime)")
+        self._tel_rejected = telemetry.gauge(
+            "mxtpu_serve_rejected", "rejected requests (lifetime)")
+        telemetry.gauge("mxtpu_serve_blocks_total",
+                        "allocatable KV-cache blocks").set(
+            self.blocks.total_blocks)
 
     # -- static config key for the shared program cache ----------------------
     def _spec_key(self):
@@ -225,25 +245,36 @@ class Engine:
         decode.  Returns the number of tokens emitted."""
         if not self._alive:
             raise RuntimeError("engine is shut down")
-        prefills, decodes = self.scheduler.schedule()
-        # blocks for this iteration are all held right now — the
-        # honest high-water sample (post-drain reads would be ~0)
-        self._stats.on_utilization(self.blocks.utilization())
-        emitted = 0
-        for req in prefills:
-            self._run_prefill(req)
-            emitted += 1
-        if decodes:
-            emitted += self._run_decode(decodes)
-        if emitted == 0 and not prefills and not decodes:
-            self._noop_steps += 1
-            if self._noop_steps > 1000 and self.scheduler.has_work():
-                raise RuntimeError(
-                    "scheduler stalled: work queued but 1000 consecutive "
-                    "steps scheduled nothing (cache/queue misconfigured?)")
-        else:
-            self._noop_steps = 0
-        self._stats.on_step(emitted)
+        with telemetry.span("serve.step"):
+            prefills, decodes = self.scheduler.schedule()
+            # blocks for this iteration are all held right now — the
+            # honest high-water sample (post-drain reads would be ~0)
+            self._stats.on_utilization(self.blocks.utilization())
+            emitted = 0
+            for req in prefills:
+                with telemetry.span("serve.prefill", rid=req.rid):
+                    self._run_prefill(req)
+                emitted += 1
+            if decodes:
+                with telemetry.span("serve.decode", batch=len(decodes)):
+                    emitted += self._run_decode(decodes)
+            if emitted == 0 and not prefills and not decodes:
+                self._noop_steps += 1
+                if self._noop_steps > 1000 and self.scheduler.has_work():
+                    raise RuntimeError(
+                        "scheduler stalled: work queued but 1000 consecutive "
+                        "steps scheduled nothing (cache/queue misconfigured?)")
+            else:
+                self._noop_steps = 0
+            self._stats.on_step(emitted)
+            self._tel_queue.set(self.scheduler.queue_depth)
+            self._tel_running.set(len(self.scheduler.running))
+            self._tel_blocks.set(self.blocks.blocks_in_use)
+            self._tel_block_util.set(self.blocks.utilization())
+            self._tel_preempt.set(self.scheduler.preemptions)
+            self._tel_evict.set(self.blocks.evictions)
+            self._tel_rejected.set(self.scheduler.rejections
+                                   + self._stats.rejected)
         return emitted
 
     def run(self):

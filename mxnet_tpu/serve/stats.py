@@ -18,6 +18,8 @@ import time
 from collections import deque
 from dataclasses import asdict, dataclass
 
+from .. import telemetry
+
 __all__ = ["ServeStats", "StatsRecorder"]
 
 
@@ -59,6 +61,24 @@ class StatsRecorder:
         self.peak_block_utilization = 0.0
         # (t, tokens_emitted) per step for the sliding-window rate
         self._window = deque(maxlen=window_steps)
+        # telemetry bridge: every recorder event ALSO feeds the
+        # process-wide registry, so ServeStats and the Prometheus
+        # exposition agree by construction (no-op objects when
+        # MXTPU_TELEMETRY is unset)
+        self._m_steps = telemetry.counter(
+            "mxtpu_serve_steps_total", "engine scheduler iterations")
+        self._m_tokens = telemetry.counter(
+            "mxtpu_serve_tokens_generated_total", "decode tokens emitted")
+        self._m_completed = telemetry.counter(
+            "mxtpu_serve_completed_total", "requests finished")
+        self._m_prompt_tokens = telemetry.counter(
+            "mxtpu_serve_prompt_tokens_total",
+            "prompt tokens of completed requests")
+        self._m_rejected = telemetry.counter(
+            "mxtpu_serve_backpressure_rejects_total",
+            "submits rejected by admission-queue back-pressure")
+        self._m_ttft = telemetry.histogram(
+            "mxtpu_serve_ttft_seconds", "time to first token")
 
     def on_step(self, new_tokens):
         now = self.clock()
@@ -67,6 +87,9 @@ class StatsRecorder:
         self.steps += 1
         self.tokens_generated += new_tokens
         self._window.append((now, new_tokens))
+        self._m_steps.inc()
+        if new_tokens:
+            self._m_tokens.inc(new_tokens)
 
     def on_utilization(self, frac):
         """Stamp the cache high-water mark (the engine samples right
@@ -77,13 +100,17 @@ class StatsRecorder:
 
     def on_first_token(self, ttft_s):
         self._ttfts.append(ttft_s)
+        self._m_ttft.observe(ttft_s)
 
     def on_complete(self, req):
         self.completed += 1
         self.prompt_tokens += int(req.prompt.size)
+        self._m_completed.inc()
+        self._m_prompt_tokens.inc(int(req.prompt.size))
 
     def on_reject(self):
         self.rejected += 1
+        self._m_rejected.inc()
 
     def _window_rate(self):
         if len(self._window) < 2:

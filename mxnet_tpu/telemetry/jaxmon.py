@@ -1,0 +1,70 @@
+"""Bridge jax.monitoring events into the metrics registry.
+
+JAX stamps named events through ``jax.monitoring`` — most usefully the
+compile-path durations (``/jax/core/compile/backend_compile_duration``
+and friends, names vary by version).  Installing the listeners turns
+recompile storms (the classic bucketing bug: a new XLA program per
+batch shape) into visible counters:
+
+  mxtpu_jax_events_total{event=...}        every monitored jax event
+  mxtpu_jax_compile_total{event=...}       compile-path events only
+  mxtpu_jax_compile_seconds{event=...}     compile-path durations
+
+Listeners are registered once per process and gate on the telemetry
+enabled flag at *call* time, so a later ``telemetry.disable()`` stops
+the recording without needing jax's ``clear_event_listeners`` (which
+would drop other libraries' listeners too).
+"""
+
+from __future__ import annotations
+
+__all__ = ["install"]
+
+# compile durations stretch far beyond the request-latency defaults
+COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                   30.0, 60.0, 120.0, 300.0)
+
+_installed = False
+
+
+def install(registry, enabled_fn):
+    """Register jax.monitoring listeners feeding ``registry``;
+    ``enabled_fn() -> bool`` is consulted on every event."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:
+        return False
+
+# families are re-resolved per event (get-or-create is one locked dict
+    # lookup, and jax events are rare — compiles, not steps) so a test's
+    # registry.clear() can't leave the listeners feeding detached series
+
+    def _on_event(event, **kwargs):
+        if not enabled_fn():
+            return
+        registry.counter("mxtpu_jax_events_total",
+                         "jax.monitoring events seen",
+                         ("event",)).labels(event=event).inc()
+        if "compile" in event:
+            registry.counter("mxtpu_jax_compile_total",
+                             "jax compile-path events",
+                             ("event",)).labels(event=event).inc()
+
+    def _on_duration(event, duration, **kwargs):
+        _on_event(event, **kwargs)
+        if enabled_fn() and "compile" in event:
+            registry.histogram(
+                "mxtpu_jax_compile_seconds", "jax compile-path durations",
+                ("event",), buckets=COMPILE_BUCKETS
+            ).labels(event=event).observe(duration)
+
+    try:
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        return False
+    _installed = True
+    return True

@@ -1,0 +1,157 @@
+"""Host-side span tracer emitting Chrome-trace-format JSON.
+
+``profiler.py`` captures what the *device* does (XLA traces via
+jax.profiler); this tracer captures what the *host* does around it —
+data wait, dispatch, compile, optimizer update, serve step — as
+complete ("ph": "X") events that Perfetto / chrome://tracing load
+directly.  Open the host trace next to the XLA device trace and the
+host phases line up against device time (docs/how_to/observability.md
+shows the workflow).
+
+Every span additionally enters a ``jax.profiler.TraceAnnotation`` when
+one can be constructed, so if an XLA trace IS active
+(``profiler.start()``), the same host phases appear *inside* the
+device trace too — zero-cost when no capture is running.
+
+Events are buffered in memory (bounded by ``max_events``; overflow is
+counted, never grows unbounded) and written by :meth:`SpanTracer.write`
+or the telemetry atexit dump.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["SpanTracer", "NOOP_SPAN"]
+
+
+class _NoopSpan:
+    """Reentrant do-nothing context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "args", "_t0", "_xla")
+
+    def __init__(self, tracer, name, args):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+        self._xla = None
+
+    def __enter__(self):
+        ann = self._tracer._annotation_cls()
+        if ann is not None:
+            try:
+                self._xla = ann(self.name)
+                self._xla.__enter__()
+            except Exception:
+                self._xla = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        end = time.perf_counter()
+        if self._xla is not None:
+            try:
+                self._xla.__exit__(*exc)
+            except Exception:
+                pass
+        self._tracer.add_complete(self.name, self._t0, end, self.args)
+        return False
+
+
+class SpanTracer:
+    def __init__(self, max_events=200_000):
+        self.max_events = int(max_events)
+        self.dropped = 0
+        self._events = []
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+        # perf_counter epoch all span timestamps are relative to
+        self._t0 = time.perf_counter()
+        self._ann_cls = False          # False = not resolved yet
+
+    def _annotation_cls(self):
+        if self._ann_cls is False:
+            try:
+                import jax
+
+                self._ann_cls = jax.profiler.TraceAnnotation
+            except Exception:
+                self._ann_cls = None
+        return self._ann_cls
+
+    def span(self, name, **args):
+        """Context manager recording one complete event around a block."""
+        return _Span(self, name, args)
+
+    def add_complete(self, name, start, end, args=None):
+        ev = {"name": name, "ph": "X", "cat": "host",
+              "pid": self._pid, "tid": threading.get_ident(),
+              "ts": (start - self._t0) * 1e6,
+              "dur": max(0.0, (end - start) * 1e6)}
+        if args:
+            ev["args"] = dict(args)
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+
+    def instant(self, name, **args):
+        """Zero-duration marker ("ph": "i")."""
+        ev = {"name": name, "ph": "i", "s": "t", "cat": "host",
+              "pid": self._pid, "tid": threading.get_ident(),
+              "ts": (time.perf_counter() - self._t0) * 1e6}
+        if args:
+            ev["args"] = dict(args)
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+
+    def trace_events(self):
+        """Buffered events plus the process/thread metadata records
+        Perfetto uses for track names."""
+        with self._lock:
+            events = list(self._events)
+        meta = [{"name": "process_name", "ph": "M", "pid": self._pid,
+                 "args": {"name": "mxtpu host"}}]
+        for tid in sorted({e["tid"] for e in events}):
+            meta.append({"name": "thread_name", "ph": "M",
+                         "pid": self._pid, "tid": tid,
+                         "args": {"name": f"host-thread-{tid}"}})
+        return meta + events
+
+    def write(self, path):
+        """Write the Chrome-trace JSON object form (Perfetto /
+        chrome://tracing / ``profiler.summarize``-style consumers)."""
+        payload = {"traceEvents": self.trace_events(),
+                   "displayTimeUnit": "ms",
+                   "otherData": {"producer": "mxnet_tpu.telemetry",
+                                 "dropped_events": self.dropped}}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        return path
+
+    def clear(self):
+        with self._lock:
+            self._events = []
+            self.dropped = 0

@@ -217,6 +217,10 @@ def test_serve_bench_contract():
     assert payload["speedup_vs_serial"] > 0
     modes = {pt["mode"] for pt in payload["points"]}
     assert modes == {"continuous/closed", "serial/closed"}
+    # every serving record carries the telemetry snapshot field (the
+    # registry is empty-disabled unless MXTPU_TELEMETRY=1 was exported)
+    assert "telemetry" in payload
+    assert payload["telemetry"]["enabled"] in (True, False)
 
 
 @pytest.mark.slow

@@ -64,6 +64,13 @@ def record(tag, rec):
     rec = dict(rec)
     rec["_tag"] = tag
     rec["_ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    # every attempts-log record carries a telemetry snapshot field.  A
+    # child payload that measured one (serve_bench with MXTPU_TELEMETRY
+    # set) keeps its own; otherwise stamp the empty-disabled shape.
+    # Deliberately NOT mxnet_tpu.telemetry.snapshot(): importing the
+    # package here would open a jax client in the watchdog process and
+    # contend with the children for the single-client chip.
+    rec.setdefault("telemetry", {"enabled": False, "metrics": {}})
     with open(LOG, "a") as f:
         f.write(json.dumps(rec) + "\n")
 

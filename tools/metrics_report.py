@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Render a telemetry artifact as a terminal table.
+
+Reads any of the three artifact forms the telemetry subsystem writes
+(docs/how_to/observability.md):
+
+  metrics.jsonl     appended registry snapshots -> renders the LAST
+                    line by default (``--line N`` for an earlier one,
+                    negative indexes from the end)
+  metrics.prom      Prometheus text exposition
+  <dir>/            a telemetry dir (MXTPU_TELEMETRY_DIR); picks
+                    metrics.jsonl, falling back to metrics.prom
+
+Counters/gauges print name, labels, value; histograms print count, sum,
+mean and the estimated p50/p90/p99 interpolated from the cumulative
+buckets (the standard Prometheus ``histogram_quantile`` estimate, so
+the numbers here match what a dashboard would show).
+
+Usage:
+  python tools/metrics_report.py [PATH] [--line N] [--filter SUBSTR]
+  (PATH defaults to ./mxtpu_telemetry)
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+QUANTILES = (0.5, 0.9, 0.99)
+
+
+# -- loading -----------------------------------------------------------------
+def load_jsonl(path, line_index=-1):
+    with open(path) as f:
+        lines = [l for l in f if l.strip()]
+    if not lines:
+        raise SystemExit(f"{path}: empty snapshot log")
+    try:
+        rec = json.loads(lines[line_index])
+    except IndexError:
+        raise SystemExit(f"{path}: has {len(lines)} snapshot lines, "
+                         f"no line {line_index}")
+    return rec.get("metrics", rec), rec.get("ts")
+
+
+def parse_prometheus_text(text):
+    """Parse the exposition format back into the registry-snapshot
+    shape (inverse of telemetry.to_prometheus_text for the subset the
+    registry emits)."""
+    metrics = {}
+    types, helps = {}, {}
+    sample_re = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$')
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            types[name] = kind
+            continue
+        if line.startswith("# HELP "):
+            _, _, name, help_text = (line.split(None, 3) + [""])[:4]
+            helps[name] = help_text
+            continue
+        if line.startswith("#"):
+            continue
+        m = sample_re.match(line)
+        if not m:
+            continue
+        name, labels_text, value = m.groups()
+        # single-pass unescape: sequential .replace() calls would turn
+        # an escaped backslash followed by 'n' into a real newline
+        unescape = {"n": "\n", '"': '"', "\\": "\\"}
+        labels = {k: re.sub(r"\\(.)",
+                            lambda mm: unescape.get(mm.group(1),
+                                                    mm.group(0)), v)
+                  for k, v in label_re.findall(labels_text or "")}
+        value = float(value) if value != "+Inf" else float("inf")
+
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        if types.get(base) == "histogram" and name != base:
+            fam = metrics.setdefault(base, {
+                "kind": "histogram", "help": helps.get(base, ""),
+                "label_names": [], "samples": []})
+            key_labels = {k: v for k, v in labels.items() if k != "le"}
+            sample = next((s for s in fam["samples"]
+                           if s["labels"] == key_labels), None)
+            if sample is None:
+                sample = {"labels": key_labels, "count": 0, "sum": 0.0,
+                          "buckets": []}
+                fam["samples"].append(sample)
+            if name.endswith("_bucket"):
+                le = labels["le"]
+                sample["buckets"].append(
+                    ["+Inf" if le == "+Inf" else float(le), int(value)])
+            elif name.endswith("_sum"):
+                sample["sum"] = value
+            elif name.endswith("_count"):
+                sample["count"] = int(value)
+        else:
+            fam = metrics.setdefault(name, {
+                "kind": types.get(name, "untyped"),
+                "help": helps.get(name, ""), "label_names": [],
+                "samples": []})
+            fam["samples"].append({"labels": labels, "value": value})
+    return metrics
+
+
+def load(path, line_index=-1):
+    if os.path.isdir(path):
+        jsonl = os.path.join(path, "metrics.jsonl")
+        prom = os.path.join(path, "metrics.prom")
+        if os.path.exists(jsonl):
+            path = jsonl
+        elif os.path.exists(prom):
+            path = prom
+        else:
+            raise SystemExit(f"{path}: no metrics.jsonl or metrics.prom "
+                             "inside (is telemetry enabled? set "
+                             "MXTPU_TELEMETRY=1)")
+    if path.endswith(".jsonl"):
+        return load_jsonl(path, line_index)
+    with open(path) as f:
+        return parse_prometheus_text(f.read()), None
+
+
+# -- rendering ---------------------------------------------------------------
+def quantile_estimate(buckets, q):
+    """Prometheus histogram_quantile: linear interpolation inside the
+    bucket the q-th observation falls into."""
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total == 0:
+        return None
+    rank = q * total
+    prev_ub, prev_c = 0.0, 0
+    for ub, c in buckets:
+        ub_f = float("inf") if ub == "+Inf" else float(ub)
+        if c >= rank:
+            if ub_f == float("inf"):
+                return float(prev_ub)   # open-ended: clamp to last bound
+            if c == prev_c:
+                return ub_f
+            return prev_ub + (ub_f - prev_ub) * (rank - prev_c) / (c - prev_c)
+        prev_ub, prev_c = ub_f, c
+    return float(prev_ub)
+
+
+def fmt_num(v):
+    if v is None:
+        return "-"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e12:
+        return str(int(f))
+    if abs(f) >= 0.001:
+        return f"{f:.4g}"
+    return f"{f:.3e}"
+
+
+def fmt_labels(labels):
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items())) or "-"
+
+
+def render_table(rows, headers):
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    def line(cells):
+        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+    out = [line(headers), line(["-" * w for w in widths])]
+    out += [line(r) for r in rows]
+    return "\n".join(out)
+
+
+def report(metrics, filter_substr=None):
+    scalar_rows, hist_rows = [], []
+    for name in sorted(metrics):
+        if filter_substr and filter_substr not in name:
+            continue
+        fam = metrics[name]
+        for s in fam["samples"]:
+            if fam["kind"] == "histogram":
+                qs = [quantile_estimate(s.get("buckets", []), q)
+                      for q in QUANTILES]
+                count = s.get("count", 0)
+                mean = s["sum"] / count if count else None
+                hist_rows.append([name, fmt_labels(s["labels"]),
+                                  fmt_num(count), fmt_num(s.get("sum")),
+                                  fmt_num(mean)] + [fmt_num(q) for q in qs])
+            else:
+                scalar_rows.append([name, fam["kind"],
+                                    fmt_labels(s["labels"]),
+                                    fmt_num(s.get("value"))])
+    chunks = []
+    if scalar_rows:
+        chunks.append(render_table(scalar_rows,
+                                   ["METRIC", "KIND", "LABELS", "VALUE"]))
+    if hist_rows:
+        chunks.append(render_table(
+            hist_rows, ["HISTOGRAM", "LABELS", "COUNT", "SUM", "MEAN"]
+            + [f"p{int(q * 100)}" for q in QUANTILES]))
+    if not chunks:
+        return "(no metrics recorded)"
+    return "\n\n".join(chunks)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="render a telemetry snapshot as a terminal table")
+    p.add_argument("path", nargs="?", default="mxtpu_telemetry",
+                   help="metrics.jsonl / metrics.prom / telemetry dir "
+                        "(default ./mxtpu_telemetry)")
+    p.add_argument("--line", type=int, default=-1,
+                   help="which jsonl snapshot line (default -1 = latest)")
+    p.add_argument("--filter", default=None,
+                   help="only metrics whose name contains this substring")
+    args = p.parse_args(argv)
+    metrics, ts = load(args.path, args.line)
+    if ts is not None:
+        import datetime
+
+        stamp = datetime.datetime.fromtimestamp(ts).isoformat(" ", "seconds")
+        print(f"# snapshot at {stamp}")
+    print(report(metrics, args.filter))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -273,6 +273,10 @@ def main():
     out["completed"] = rec.get("completed")
     out["rejected"] = rec.get("rejected")
     out["dropped_without_rejection"] = rec.get("dropped_without_rejection")
+    # registry snapshot rides along with every record ({"enabled":
+    # false, "metrics": {}} unless MXTPU_TELEMETRY=1) — render with
+    # tools/metrics_report.py
+    out["telemetry"] = mx.telemetry.snapshot()
     flush(True)
     print(json.dumps(out))
 
