@@ -25,7 +25,31 @@ __all__ = [
     "ctypes2buffer",
     "ctypes2docstring",
     "ctypes2numpy_shared",
+    "env_flag",
+    "env_int",
 ]
+
+
+def env_flag(name, default=True):
+    """Boolean MXTPU_* knob: one parse for every call site so accepted
+    spellings can't drift between features."""
+    import os
+
+    value = os.environ.get(name)
+    if value is None or value == "":
+        return default
+    return value not in ("0", "false", "False", "FALSE", "no", "off")
+
+
+def env_int(name, default):
+    """Integer MXTPU_* knob; a malformed value falls back to the
+    default instead of crashing the caller's hot path."""
+    import os
+
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 def c_array(ctype, values):

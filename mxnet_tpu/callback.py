@@ -81,7 +81,11 @@ class Speedometer:
         self.last_count = count
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                # perf_counter, not time.time(): an NTP step between
+                # ticks would report garbage samples/sec (same fix as
+                # the fit loop's epoch clock)
+                speed = self.frequent * self.batch_size / (
+                    time.perf_counter() - self.tic)
                 self.last_speed = speed
                 if param.eval_metric is not None:
                     for name, value in param.eval_metric.get_name_value():
@@ -91,10 +95,10 @@ class Speedometer:
                 else:
                     logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
                                  param.epoch, count, speed)
-                self.tic = time.time()
+                self.tic = time.perf_counter()
         else:
             self.init = True
-            self.tic = time.time()
+            self.tic = time.perf_counter()
 
 
 class ProgressBar:

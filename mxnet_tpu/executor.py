@@ -528,6 +528,9 @@ class Executor:
             outs_probe = jax.eval_shape(
                 lambda a, x, k: self._fwd_train(a, x, k)[0], args, aux, key)
             head = tuple(jnp.ones(o.shape, o.dtype) for o in outs_probe)
+        from .optimizer import _dispatch_inc
+
+        _dispatch_inc(self, "fwd_bwd")
         return self._fwd_bwd(grad_args, other, aux, key, head)
 
     def forward(self, is_train=False, **kwargs):

@@ -22,7 +22,7 @@ import numpy as np
 
 from . import ndarray as nd
 from . import telemetry
-from .base import MXNetError
+from .base import MXNetError, env_int
 from .ndarray import NDArray
 
 __all__ = ["DataIter", "DataBatch", "NDArrayIter", "ResizeIter",
@@ -294,7 +294,8 @@ class PrefetchingIter(DataIter):
     """Background-thread prefetch over one or more iterators
     (reference io.py:236 + dmlc ThreadedIter double-buffering)."""
 
-    def __init__(self, iters, rename_data=None, rename_label=None, capacity=2):
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 capacity=None):
         super().__init__()
         if not isinstance(iters, list):
             iters = [iters]
@@ -302,7 +303,11 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0][1][0]
-        self._queue = queue.Queue(maxsize=capacity)
+        if capacity is None:
+            # deployment-wide default; the constructor argument wins
+            capacity = env_int("MXTPU_PREFETCH_CAPACITY", 2)
+        self.capacity = max(1, int(capacity))
+        self._queue = queue.Queue(maxsize=self.capacity)
         self._epoch = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._producer, daemon=True)
@@ -343,14 +348,29 @@ class PrefetchingIter(DataIter):
                 break
 
     def _tel_wait_hist(self):
-        hist = getattr(self, "_tel_wait", None)
-        if hist is None:
+        # cached per instance, re-resolved when telemetry enablement
+        # flips — an iterator built before enable() must not stay a
+        # permanent no-op
+        cached = getattr(self, "_tel_wait", None)
+        enabled = telemetry.enabled()
+        if cached is None or cached[0] is not enabled:
             hist = telemetry.histogram(
                 "mxtpu_io_wait_seconds",
                 "time the consumer blocked on the prefetch queue",
                 ("iterator",)).labels(iterator=type(self).__name__)
-            self._tel_wait = hist
-        return hist
+            self._tel_wait = cached = (enabled, hist)
+        return cached[1]
+
+    def _tel_depth_gauge(self):
+        cached = getattr(self, "_tel_depth", None)
+        enabled = telemetry.enabled()
+        if cached is None or cached[0] is not enabled:
+            g = telemetry.gauge(
+                "mxtpu_io_prefetch_depth",
+                "batches currently buffered in the prefetch queue",
+                ("iterator",)).labels(iterator=type(self).__name__)
+            self._tel_depth = cached = (enabled, g)
+        return cached[1]
 
     def iter_next(self):
         # queue wait == how far the producer thread is behind the
@@ -359,6 +379,9 @@ class PrefetchingIter(DataIter):
         t0 = time.perf_counter()
         kind, batches = self._queue.get()
         self._tel_wait_hist().observe(time.perf_counter() - t0)
+        # live depth AFTER the pop: capacity means the producer is fully
+        # ahead, 0 means the consumer is about to block
+        self._tel_depth_gauge().set(self._queue.qsize())
         if kind == "end":
             return False
         data = sum([b.data for b in batches], [])

@@ -26,11 +26,27 @@ def check_label_shapes(labels, preds, shape=False):
 
 
 class EvalMetric:
-    """Base metric with running (sum, count) state (metric.py:14-76)."""
+    """Base metric with running (sum, count) state (metric.py:14-76).
+
+    Device accumulation (opt-in via :meth:`device_accumulate`): metrics
+    that define ``_device_update(label, pred) -> (sum, count)`` — a pure
+    jax-traceable batch contribution — can keep their running state ON
+    DEVICE, so the per-batch ``update_metric`` in the fit loop is one
+    async jitted add instead of an ``asnumpy()`` pipeline stall.  Host
+    ``sum_metric``/``num_inst`` only materialize at sync points: every
+    ``frequent`` device updates, and lazily whenever :meth:`get` reads
+    the value (so epoch-end logs and Speedometer callbacks are always
+    correct)."""
+
+    _device_update = None  # subclasses define (self, label, pred)->(s, n)
 
     def __init__(self, name, num=None):
         self.name = name
         self.num = num
+        self._device_frequent = 0
+        self._dev_state = None
+        self._dev_pending = 0
+        self._dev_fn = None
         self.reset()
 
     def reset(self):
@@ -40,11 +56,84 @@ class EvalMetric:
         else:
             self.num_inst = [0] * self.num
             self.sum_metric = [0.0] * self.num
+        self._dev_state = None
+        self._dev_pending = 0
 
     def update(self, labels, preds):
         raise NotImplementedError
 
+    # -- device accumulation ------------------------------------------------
+    def device_accumulate(self, frequent=50):
+        """Opt in to on-device (sum, count) accumulation, syncing to the
+        host every ``frequent`` batches.  Returns True when this metric
+        supports it (it defines ``_device_update`` and is single-valued);
+        unsupported metrics return False and keep the host path.
+
+        ``frequent=0`` (or any falsy value) switches BACK to host
+        accumulation — any pending device contributions are folded in
+        first, so no data is lost.  ``Module.fit`` sets the mode
+        explicitly each run, so a metric instance reused across fits
+        follows the current run's path, not a previous run's."""
+        if not frequent:
+            self._sync_device()
+            self._device_frequent = 0
+            return False
+        if self.num is not None or self._device_update is None:
+            return False
+        self._device_frequent = max(1, int(frequent))
+        return True
+
+    @property
+    def device_active(self):
+        return self._device_frequent > 0 and self._device_update is not None
+
+    def update_device(self, labels, preds):
+        """Add one batch's contribution on device (one async jitted
+        dispatch); host state updates only at the sync cadence."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._dev_fn is None:
+            contrib = self._device_update
+
+            def accum(ls, ps, acc):
+                s, n = acc
+                for label, pred in zip(ls, ps):
+                    ds, dn = contrib(label, pred)
+                    s = s + ds
+                    n = n + dn
+                return s, n
+
+            self._dev_fn = jax.jit(accum)
+        ls = [l._data if isinstance(l, NDArray) else jnp.asarray(l)
+              for l in labels]
+        ps = [p._data if isinstance(p, NDArray) else jnp.asarray(p)
+              for p in preds]
+        if self._dev_state is None:
+            self._dev_state = (jnp.zeros((), jnp.float32),
+                               jnp.zeros((), jnp.float32))
+        self._dev_state = self._dev_fn(ls, ps, self._dev_state)
+        self._dev_pending += 1
+        if self._dev_pending >= self._device_frequent:
+            self._sync_device()
+
+    def _sync_device(self):
+        """Fold the device accumulator into the host running state (the
+        only point the metric path touches the host)."""
+        if getattr(self, "_dev_state", None) is None:
+            self._dev_pending = 0
+            return
+        s, n = self._dev_state
+        self.sum_metric += float(s)
+        # device counts are integral by construction; keep num_inst int
+        # so host-path and device-path readings agree exactly
+        self.num_inst += int(round(float(n)))
+        self._dev_state = None
+        self._dev_pending = 0
+
     def get(self):
+        if getattr(self, "_dev_pending", 0):
+            self._sync_device()
         if self.num is None:
             value = self.sum_metric / self.num_inst if self.num_inst else float("nan")
             return self.name, value
@@ -81,6 +170,16 @@ class Accuracy(EvalMetric):
             self.sum_metric += float((pred == label).sum())
             self.num_inst += label.size
 
+    def _device_update(self, label, pred):
+        import jax.numpy as jnp
+
+        if pred.ndim > 1:
+            p = jnp.argmax(pred, axis=-1).astype(jnp.int32)
+        else:
+            p = (pred > 0.5).astype(jnp.int32)
+        l = label.astype(jnp.int32).reshape(p.shape)
+        return (jnp.sum(p == l).astype(jnp.float32), jnp.float32(l.size))
+
 
 @METRIC_REGISTRY.register("top_k_accuracy", aliases=("top_k_acc",))
 class TopKAccuracy(EvalMetric):
@@ -95,6 +194,15 @@ class TopKAccuracy(EvalMetric):
             topk = _numpy.argsort(pred, axis=-1)[:, -self.top_k:]
             self.sum_metric += float((topk == label[:, None]).any(axis=1).sum())
             self.num_inst += label.shape[0]
+
+    def _device_update(self, label, pred):
+        import jax
+        import jax.numpy as jnp
+
+        _, topk = jax.lax.top_k(pred, self.top_k)
+        l = label.astype(jnp.int32)
+        hits = jnp.any(topk == l[:, None], axis=1)
+        return (jnp.sum(hits).astype(jnp.float32), jnp.float32(l.shape[0]))
 
 
 @METRIC_REGISTRY.register("f1")
@@ -116,6 +224,20 @@ class F1(EvalMetric):
             self.sum_metric += f1
             self.num_inst += 1
 
+    def _device_update(self, label, pred):
+        import jax.numpy as jnp
+
+        p = jnp.argmax(pred, axis=-1)
+        l = label.astype(jnp.int32).reshape(p.shape)
+        tp = jnp.sum((p == 1) & (l == 1)).astype(jnp.float32)
+        fp = jnp.sum((p == 1) & (l == 0)).astype(jnp.float32)
+        fn = jnp.sum((p == 0) & (l == 1)).astype(jnp.float32)
+        precision = jnp.where(tp + fp > 0, tp / (tp + fp), 0.0)
+        recall = jnp.where(tp + fn > 0, tp / (tp + fn), 0.0)
+        f1 = jnp.where(precision + recall > 0,
+                       2 * precision * recall / (precision + recall), 0.0)
+        return f1.astype(jnp.float32), jnp.float32(1)
+
 
 @METRIC_REGISTRY.register("mae")
 class MAE(EvalMetric):
@@ -127,6 +249,12 @@ class MAE(EvalMetric):
             label, pred = _as_np(label), _as_np(pred)
             self.sum_metric += float(_numpy.abs(label.reshape(pred.shape) - pred).mean())
             self.num_inst += 1
+
+    def _device_update(self, label, pred):
+        import jax.numpy as jnp
+
+        err = jnp.mean(jnp.abs(label.reshape(pred.shape) - pred))
+        return err.astype(jnp.float32), jnp.float32(1)
 
 
 @METRIC_REGISTRY.register("mse")
@@ -140,6 +268,12 @@ class MSE(EvalMetric):
             self.sum_metric += float(((label.reshape(pred.shape) - pred) ** 2).mean())
             self.num_inst += 1
 
+    def _device_update(self, label, pred):
+        import jax.numpy as jnp
+
+        err = jnp.mean(jnp.square(label.reshape(pred.shape) - pred))
+        return err.astype(jnp.float32), jnp.float32(1)
+
 
 @METRIC_REGISTRY.register("rmse")
 class RMSE(EvalMetric):
@@ -152,6 +286,12 @@ class RMSE(EvalMetric):
             self.sum_metric += float(
                 _numpy.sqrt(((label.reshape(pred.shape) - pred) ** 2).mean()))
             self.num_inst += 1
+
+    def _device_update(self, label, pred):
+        import jax.numpy as jnp
+
+        err = jnp.sqrt(jnp.mean(jnp.square(label.reshape(pred.shape) - pred)))
+        return err.astype(jnp.float32), jnp.float32(1)
 
 
 @METRIC_REGISTRY.register("ce", aliases=("cross-entropy",))
@@ -168,6 +308,14 @@ class CrossEntropy(EvalMetric):
             self.sum_metric += float((-_numpy.log(prob + self.eps)).sum())
             self.num_inst += label.shape[0]
 
+    def _device_update(self, label, pred):
+        import jax.numpy as jnp
+
+        l = label.ravel().astype(jnp.int32)
+        prob = pred[jnp.arange(l.shape[0]), l]
+        return (jnp.sum(-jnp.log(prob + self.eps)).astype(jnp.float32),
+                jnp.float32(l.shape[0]))
+
 
 @METRIC_REGISTRY.register("loss")
 class Loss(EvalMetric):
@@ -181,6 +329,13 @@ class Loss(EvalMetric):
             pred = _as_np(pred)
             self.sum_metric += float(pred.sum())
             self.num_inst += pred.size
+
+    def _device_update(self, label, pred):
+        # Loss ignores labels; the device path still pairs label/pred
+        # positionally, matching the host zip() truncation semantics
+        import jax.numpy as jnp
+
+        return jnp.sum(pred).astype(jnp.float32), jnp.float32(pred.size)
 
 
 @METRIC_REGISTRY.register("torch")
@@ -198,6 +353,11 @@ class Torch(Loss):
             pred = _as_np(pred)
             self.sum_metric += float(pred.mean())
             self.num_inst += 1
+
+    def _device_update(self, label, pred):
+        import jax.numpy as jnp
+
+        return jnp.mean(pred).astype(jnp.float32), jnp.float32(1)
 
 
 @METRIC_REGISTRY.register("caffe")

@@ -80,14 +80,17 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None)
         if kvstore:
             kvstore.push(index, grad_list, priority=-index)
             kvstore.pull(index, grad_list, priority=-index)
-        else:
-            # sum gradients in place of kvstore local-reduce
-            if len(grad_list) > 1:
-                total = grad_list[0].copyto(grad_list[0].context)
-                for g in grad_list[1:]:
-                    total += g.as_in_context(total.context)
-                for g in grad_list:
-                    g[:] = total
+        elif len(grad_list) > 1:
+            # sum gradients ONCE in place of kvstore local-reduce and
+            # feed the reduced grad straight to each device's updater —
+            # no write-back copy into every grad buffer (the old path
+            # materialized `total` then copied it N times)
+            total = grad_list[0]
+            for g in grad_list[1:]:
+                total = total + g.as_in_context(total.context)
+            grad_list = [total if g.context == total.context
+                         else total.as_in_context(g.context)
+                         for g in grad_list]
         for k, (w, g) in enumerate(zip(arg_list, grad_list)):
             updater(index * num_device + k, g, w)
 

@@ -3,9 +3,11 @@
 from .base_module import BaseModule
 from .bucketing_module import BucketingModule
 from .executor_group import DataParallelExecutorGroup
+from .fused_step import FusedTrainStep
 from .module import Module
 from .python_module import PythonLossModule, PythonModule
 from .sequential_module import SequentialModule
 
 __all__ = ["BaseModule", "Module", "BucketingModule", "SequentialModule",
-           "PythonModule", "PythonLossModule", "DataParallelExecutorGroup"]
+           "PythonModule", "PythonLossModule", "DataParallelExecutorGroup",
+           "FusedTrainStep"]

@@ -17,7 +17,7 @@ import numpy as np
 from .. import metric as metric_mod
 from .. import ndarray as nd
 from .. import telemetry
-from ..base import MXNetError
+from ..base import MXNetError, env_flag, env_int
 from ..callback import BatchEndParam
 from ..initializer import Uniform
 
@@ -108,6 +108,24 @@ class BaseModule:
     def forward_backward(self, data_batch):
         self.forward(data_batch, is_train=True)
         self.backward()
+
+    def train_step(self, data_batch):
+        """One forward+backward+optimizer step.  Subclasses with a
+        fused single-dispatch program (Module) override; the default is
+        the classic two-phase loop.  Returns True when fused."""
+        self.forward_backward(data_batch)
+        self.update()
+        return False
+
+    def _select_fused(self):
+        """Fused-train-step object when this module supports the
+        single-dispatch path (Module overrides), else None."""
+        return None
+
+    def _stage_batch(self, data_batch):
+        """Pre-stage a batch's arrays onto the device (non-blocking);
+        default no-op for modules without a single device context."""
+        return data_batch
 
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
@@ -210,43 +228,66 @@ class BaseModule:
         ph_update = tel_phase.labels(phase="update")
         ph_metric = tel_phase.labels(phase="update_metric")
 
+        # single-dispatch path: forward+backward+update compiled into
+        # one donated XLA program, async batch staging, and (when the
+        # metric supports it) device-side metric accumulation so no
+        # per-batch host sync remains.  Monitors force the classic loop
+        # (_select_fused rejects them — they need eager execution).
+        fused = self._select_fused() if monitor is None else None
+        # registered only when taken, so the classic loop's phase set
+        # stays exactly {data_wait, forward_backward, update, update_metric}
+        ph_fused = (tel_phase.labels(phase="fused_step")
+                    if fused is not None else None)
+        if fused is not None and env_flag("MXTPU_DEVICE_METRICS"):
+            eval_metric.device_accumulate(
+                env_int("MXTPU_METRIC_SYNC_FREQUENT", 50))
+        else:
+            # explicit: a metric instance reused from an earlier fused
+            # fit must follow THIS run's (classic/host) path
+            eval_metric.device_accumulate(0)
+
         for epoch in range(begin_epoch, num_epoch):
             # perf_counter, not time.time(): NTP slews/steps make the
             # wall clock non-monotonic, so "Time cost=" lines could jump
             tic = time.perf_counter()
             eval_metric.reset()
             data_iter = iter(train_data)
-            nbatch = 0
-            while True:
-                t0 = time.perf_counter()
-                with telemetry.span("fit.data_wait"):
-                    data_batch = next(data_iter, None)
-                if data_batch is None:
-                    break
-                ph_data.observe(time.perf_counter() - t0)
-                if monitor is not None:
-                    monitor.tic()
-                t0 = time.perf_counter()
-                with telemetry.span("fit.forward_backward"):
-                    self.forward_backward(data_batch)
-                ph_fwbw.observe(time.perf_counter() - t0)
-                t0 = time.perf_counter()
-                with telemetry.span("fit.update"):
-                    self.update()
-                ph_update.observe(time.perf_counter() - t0)
-                t0 = time.perf_counter()
-                with telemetry.span("fit.update_metric"):
-                    self.update_metric(eval_metric, data_batch.label)
-                ph_metric.observe(time.perf_counter() - t0)
-                tel_batches.inc()
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    param = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                          eval_metric=eval_metric)
-                    for cb in _as_list(batch_end_callback):
-                        cb(param)
-                nbatch += 1
+            if fused is not None:
+                nbatch = self._fit_epoch_fused(
+                    data_iter, eval_metric, batch_end_callback, epoch,
+                    ph_data, ph_fused, ph_metric, tel_batches)
+            else:
+                nbatch = 0
+                while True:
+                    t0 = time.perf_counter()
+                    with telemetry.span("fit.data_wait"):
+                        data_batch = next(data_iter, None)
+                    if data_batch is None:
+                        break
+                    ph_data.observe(time.perf_counter() - t0)
+                    if monitor is not None:
+                        monitor.tic()
+                    t0 = time.perf_counter()
+                    with telemetry.span("fit.forward_backward"):
+                        self.forward_backward(data_batch)
+                    ph_fwbw.observe(time.perf_counter() - t0)
+                    t0 = time.perf_counter()
+                    with telemetry.span("fit.update"):
+                        self.update()
+                    ph_update.observe(time.perf_counter() - t0)
+                    t0 = time.perf_counter()
+                    with telemetry.span("fit.update_metric"):
+                        self.update_metric(eval_metric, data_batch.label)
+                    ph_metric.observe(time.perf_counter() - t0)
+                    tel_batches.inc()
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        param = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                              eval_metric=eval_metric)
+                        for cb in _as_list(batch_end_callback):
+                            cb(param)
+                    nbatch += 1
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             epoch_secs = time.perf_counter() - tic
@@ -273,6 +314,65 @@ class BaseModule:
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
             train_data.reset()
+
+    def _fit_epoch_fused(self, data_iter, eval_metric, batch_end_callback,
+                         epoch, ph_data, ph_fused, ph_metric, tel_batches):
+        """One epoch on the single-dispatch path: each batch is one
+        donated compiled program (forward+backward+whole-pytree update),
+        batch t+1 is pulled from the iterator and staged to the device
+        while step t is still in flight (JAX async dispatch — nothing
+        here blocks), and metric accumulation stays on device until its
+        sync point.  Returns the batch count."""
+        from ..optimizer import _dispatch_inc
+
+        nbatch = 0
+        warned_fallback = False
+        t0 = time.perf_counter()
+        with telemetry.span("fit.data_wait"):
+            nxt = next(data_iter, None)
+        wait = time.perf_counter() - t0
+        staged = self._stage_batch(nxt)
+        while staged is not None:
+            ph_data.observe(wait)
+            batch = staged
+            t0 = time.perf_counter()
+            with telemetry.span("fit.fused_step"):
+                fused_ran = self.train_step(batch)
+            if fused_ran:
+                ph_fused.observe(time.perf_counter() - t0)
+            elif not warned_fallback:
+                # eligibility flipped mid-fit (env kill switch, monitor
+                # installed from a callback): the batches still train on
+                # the classic loop; say so once instead of silently
+                # reporting fused-phase timings over per-param dispatches
+                warned_fallback = True
+                self.logger.warning(
+                    "fused train step fell back to the classic loop "
+                    "mid-fit; fused_step phase timings stop here")
+            # overlap: host iterator + host->device copy of batch t+1
+            # run while the device crunches batch t
+            t0 = time.perf_counter()
+            with telemetry.span("fit.data_wait"):
+                nxt = next(data_iter, None)
+            wait = time.perf_counter() - t0
+            staged = self._stage_batch(nxt)
+            t0 = time.perf_counter()
+            with telemetry.span("fit.update_metric"):
+                self.update_metric(eval_metric, batch.label)
+            ph_metric.observe(time.perf_counter() - t0)
+            if getattr(eval_metric, "device_active", False):
+                # the device accumulator's one jitted add; counted here
+                # (not in update_device) so validation-time device
+                # metrics don't pollute the per-TRAIN-batch accounting
+                _dispatch_inc(self, "metric")
+            tel_batches.inc()
+            if batch_end_callback is not None:
+                param = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                      eval_metric=eval_metric)
+                for cb in _as_list(batch_end_callback):
+                    cb(param)
+            nbatch += 1
+        return nbatch
 
     # -- checkpointing -----------------------------------------------------
     def save_params(self, fname):

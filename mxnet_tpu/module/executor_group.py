@@ -255,6 +255,15 @@ class DataParallelExecutorGroup:
                 exe.backward([g[islice] for g in out_grads])
 
     def update_metric(self, eval_metric, labels):
+        if (getattr(eval_metric, "device_active", False)
+                and len(self.execs) == 1
+                and len(labels) == len(self.execs[0].outputs)):
+            # device-side accumulation: one async jitted contribution,
+            # no asnumpy stall.  Pairing must be positional 1:1 (the
+            # host kernels zip the same way); anything else — multiple
+            # devices, label/output arity mismatch — keeps the host path
+            eval_metric.update_device(labels, self.execs[0].outputs)
+            return
         # labels pair positionally with the bound label names; extra
         # labels beyond the bound names (incl. the bound-without-labels
         # case) slice along axis 0

@@ -16,13 +16,14 @@ import numpy as np
 from .. import context as ctx_mod
 from .. import ndarray as nd
 from .. import optimizer as opt
-from ..base import MXNetError
+from ..base import MXNetError, env_flag
 from ..initializer import Uniform
 from ..kvstore import KVStore
 from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
                      _update_params_on_kvstore, load_checkpoint, save_checkpoint)
 from .base_module import BaseModule
 from .executor_group import DataParallelExecutorGroup
+from .fused_step import FusedTrainStep
 
 __all__ = ["Module"]
 
@@ -62,6 +63,7 @@ class Module(BaseModule):
         self._kvstore = None
         self._update_on_kvstore = None
         self._updater = None
+        self._fused = None
 
     @property
     def _params_dirty(self):
@@ -103,6 +105,7 @@ class Module(BaseModule):
              grad_req="write"):
         if force_rebind:
             self._exec_group = None
+            self._fused = None
             self.binded = False
         if self.binded:
             self.logger.warning("Already binded, ignoring bind()")
@@ -134,6 +137,7 @@ class Module(BaseModule):
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
         self.binded = True
+        self._fused = None  # executor changes: stale fused program
         self._exec_group = DataParallelExecutorGroup(
             self._symbol, self._context, self._work_load_list, data_shapes,
             label_shapes, self._param_names, for_training, inputs_need_grad,
@@ -166,6 +170,7 @@ class Module(BaseModule):
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
+        self._fused = None
         self.optimizer_initialized = True
 
     # -- params ------------------------------------------------------------
@@ -256,7 +261,92 @@ class Module(BaseModule):
             kvstore.set_optimizer(self._optimizer)
         else:
             self._updater = opt.get_updater(optimizer)
+        self._fused = None  # optimizer changed: rebuild the fused program
         self.optimizer_initialized = True
+
+    # -- fused train step --------------------------------------------------
+    def _select_fused(self):
+        """The single-dispatch :class:`FusedTrainStep` when this module
+        can take it, else None (→ classic per-param loop).
+
+        Eligibility mirrors what the one compiled program can express:
+        a single context / single executor without ctx-group segments,
+        local (non-kvstore) updates through the module's own updater, a
+        ``step_param``-capable optimizer, plain ``write`` grads over the
+        module's own parameters, and no monitor (monitoring needs the
+        eager per-node path).  ``MXTPU_FUSED_STEP=0`` force-disables.
+        """
+        if not env_flag("MXTPU_FUSED_STEP"):
+            return None
+        if self._fused is not None:
+            # fast path for the per-batch call in custom train_step
+            # loops: the full eligibility scan below is O(num_params)
+            # host work; every mutation that could flip the verdict
+            # (bind, init_optimizer, borrow_optimizer, install_monitor)
+            # resets self._fused to None
+            return self._fused
+        if not (self.binded and self.params_initialized
+                and self.optimizer_initialized and self.for_training):
+            return None
+        if self._update_on_kvstore or self._kvstore is not None:
+            return None
+        if self._updater is None or \
+                getattr(self._updater, "optimizer", None) is not self._optimizer:
+            return None  # custom updater closure: unknown numerics
+        if not getattr(self._optimizer, "supports_step_tree", False):
+            return None
+        if len(self._context) != 1 or len(self._exec_group.execs) != 1:
+            return None
+        exe = self._exec_group.execs[0]
+        if getattr(exe, "_multi_ctx", False) \
+                or exe._monitor_callback is not None:
+            return None
+        if not exe._grad_names:
+            return None
+        if not set(exe._grad_names) <= set(self._param_names):
+            return None  # inputs_need_grad: input grads need backward()
+        if any(exe._grad_req[n] != "write" for n in exe._grad_names):
+            return None
+        self._fused = FusedTrainStep(
+            exe, self._optimizer, self._updater, self._param_names,
+            self._exec_group.data_names, self._exec_group.label_names)
+        return self._fused
+
+    def train_step(self, data_batch):
+        """One forward+backward+update.  Takes the fused single-dispatch
+        program when eligible; otherwise the classic loop.  Returns True
+        when the fused path ran."""
+        fused = self._select_fused()
+        if fused is None:
+            return super().train_step(data_batch)
+        fused.step(data_batch)
+        self._params_dirty = True
+        return True
+
+    def _stage_batch(self, data_batch):
+        """Move a batch's arrays to the (single) device ahead of the
+        step that consumes it — ``jax.device_put`` is non-blocking, so
+        staging batch t+1 overlaps the in-flight step t."""
+        if data_batch is None or len(self._context) != 1:
+            return data_batch
+        import jax
+
+        from ..io import DataBatch
+        from ..optimizer import _dispatch_inc
+
+        ctx = self._context[0]
+        dev = ctx.jax_device()
+
+        def put(arrs):
+            out = []
+            for a in arrs or []:
+                raw = a._data if isinstance(a, nd.NDArray) else np.asarray(a)
+                out.append(nd.NDArray(jax.device_put(raw, dev), ctx))
+            return out
+
+        _dispatch_inc(self, "stage")
+        return DataBatch(put(data_batch.data), put(data_batch.label),
+                         data_batch.pad, data_batch.index)
 
     # -- compute -----------------------------------------------------------
     def forward(self, data_batch, is_train=None):
@@ -299,6 +389,7 @@ class Module(BaseModule):
     def install_monitor(self, mon):
         if not self.binded:
             raise MXNetError("call bind first")
+        self._fused = None  # monitors need the eager per-node path
         self._exec_group.install_monitor(mon)
 
     # -- checkpoint --------------------------------------------------------

@@ -9,8 +9,6 @@ update (src/optimizer/sgd-inl.h) — no host round-trips in the hot loop.
 
 from __future__ import annotations
 
-import math
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -30,6 +28,53 @@ def _donate(*argnums):
     """Donate buffers only where XLA supports it (TPU); CPU backend would
     warn and ignore."""
     return argnums if jax.default_backend() == "tpu" else ()
+
+
+def _dispatch_inc(owner, kind):
+    """Count one compiled-program dispatch on
+    ``mxtpu_train_dispatches_total{kind=...}`` — the counter the fused
+    train step's O(1)-vs-O(num_params) claim is asserted against
+    (tests/test_fused_step.py).  The labeled child is cached on
+    ``owner`` per kind and re-resolved when telemetry enablement flips,
+    so the hot path pays dict lookups, not a registry lock per
+    dispatch; like every instance-cached handle, it detaches from
+    snapshots across a ``telemetry.reset()`` (metrics.Registry.clear
+    contract — count by snapshot delta, as tools/train_bench.py does)."""
+    from . import telemetry
+
+    cache = getattr(owner, "_tel_dispatch", None)
+    if cache is None:
+        cache = owner._tel_dispatch = {}
+    enabled = telemetry.enabled()
+    cached = cache.get(kind)
+    if cached is None or cached[0] is not enabled:
+        child = telemetry.counter(
+            "mxtpu_train_dispatches_total",
+            "compiled-program dispatches issued by the training stack",
+            ("kind",)).labels(kind=kind)
+        cached = cache[kind] = (enabled, child)
+    cached[1].inc()
+
+
+def _state_leaves(state):
+    """Raw jax arrays of an optimizer state (None / NDArray / tuple of
+    NDArrays) — the representation ``step_param`` operates on."""
+    if state is None:
+        return None
+    if isinstance(state, (tuple, list)):
+        return tuple(s._data for s in state)
+    return state._data
+
+
+def _state_commit(state, new_leaves):
+    """Write ``step_param`` result leaves back into the NDArray state."""
+    if state is None:
+        return
+    if isinstance(state, (tuple, list)):
+        for s, v in zip(state, new_leaves):
+            s._set(v)
+    else:
+        state._set(new_leaves)
 
 
 class Optimizer:
@@ -60,17 +105,35 @@ class Optimizer:
                     self.lr_mult[name] = float(a["__lr_mult__"])
                 if "__wd_mult__" in a:
                     self.wd_mult[name] = float(a["__wd_mult__"])
+        # jit is lazy (attributes are read at first trace), so building
+        # here works even though subclass __init__ sets its knobs after
+        # this returns
+        self._build_steps()
 
     # -- pickling ----------------------------------------------------------
     # Optimizers are pickled to dist-kvstore servers (reference
     # kvstore.py:231-256) and into checkpoint states; jitted step
     # kernels are not picklable, so they are dropped and rebuilt.
     def _build_steps(self):
-        """Recreate jitted update kernels; overridden by subclasses."""
+        """Recreate the jitted per-param update kernel around
+        :meth:`step_param`; optimizers with a custom update (SGLD's RNG
+        operand) override."""
+        if not self.supports_step_tree:
+            self._step = None
+            return
+
+        def kernel(w, g, state, lr, wd, t):
+            # dispatch through self at trace time so attribute values
+            # (momentum, betas, clip) are read when the kernel compiles
+            return self.step_param(w, g, state, lr, wd, t)
+
+        self._step = jax.jit(kernel, donate_argnums=_donate(0, 2))
 
     def __getstate__(self):
+        # jitted kernels and the cached telemetry child (it holds a
+        # threading.Lock) are process-local; dropped and rebuilt
         return {k: v for k, v in self.__dict__.items()
-                if not k.startswith("_step")}
+                if not k.startswith("_step") and k != "_tel_dispatch"}
 
     def __setstate__(self, state):
         self.__dict__.update(state)
@@ -80,8 +143,60 @@ class Optimizer:
     def create_state(self, index, weight):
         return None
 
+    # -- functional update surface ----------------------------------------
+    # ``step_param`` is THE update rule: a pure, traceable function over
+    # raw jax arrays.  The per-param ``update`` below jits it with
+    # donated weight/state buffers; the fused whole-pytree train step
+    # (module/fused_step.py) traces it through ``step_tree`` inside one
+    # donated XLA program — numerics are shared by construction.
+    #
+    #   w, g           weight / gradient arrays
+    #   state          raw state leaves (None / array / tuple of arrays,
+    #                  matching ``create_state``'s structure)
+    #   lr, wd, t      per-param learning rate / weight decay and the
+    #                  update count, passed as traced scalars so a
+    #                  schedule change never recompiles
+    step_param = None  # overridden by every fusable optimizer
+
+    @property
+    def supports_step_tree(self):
+        """Whether this optimizer exposes the pure functional update the
+        fused train step requires."""
+        return callable(getattr(self, "step_param", None))
+
+    def step_tree(self, params, grads, states, lr_tree, wd_tree, num_update):
+        """Apply :meth:`step_param` across a whole ``name -> array``
+        pytree (traceable; the body of the fused train step's optimizer
+        stage).  Entries with no gradient pass through unchanged."""
+        new_params, new_states = {}, {}
+        for name, w in params.items():
+            g = grads.get(name)
+            if g is None:
+                new_params[name] = w
+                new_states[name] = states.get(name)
+                continue
+            new_params[name], new_states[name] = self.step_param(
+                w, g, states.get(name), lr_tree[name], wd_tree[name],
+                num_update)
+        return new_params, new_states
+
     def update(self, index, weight, grad, state):
-        raise NotImplementedError
+        """One per-parameter update through the jitted ``step_param``
+        kernel (the reference's engine-scheduled fused update; the
+        fallback path when the whole-pytree fused step is ineligible)."""
+        if getattr(self, "_step", None) is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} defines neither step_param nor a "
+                "custom update")
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        _dispatch_inc(self, "per_param_update")
+        w, new_state = self._step(weight._data, grad._data,
+                                  _state_leaves(state), jnp.float32(lr),
+                                  jnp.float32(wd), jnp.int32(t))
+        weight._set(w)
+        _state_commit(state, new_state)
 
     # -- multipliers / schedules (optimizer.py:120-233) ---------------------
     def set_lr_mult(self, args_lr_mult):
@@ -158,39 +273,20 @@ class SGD(Optimizer):
     """SGD with momentum / weight decay / grad clipping (optimizer.py:234)."""
 
     def __init__(self, momentum=0.0, **kwargs):
-        super().__init__(**kwargs)
         self.momentum = momentum
-        self._build_steps()
+        super().__init__(**kwargs)
 
-    def _build_steps(self):
-        def step(w, g, m, lr, wd):
-            g = self._preprocess(g) + wd * w
-            m_new = self.momentum * m - lr * g
-            return (w + m_new).astype(w.dtype), m_new.astype(m.dtype)
-
-        def step_nomom(w, g, lr, wd):
-            g = self._preprocess(g) + wd * w
-            return (w - lr * g).astype(w.dtype)
-
-        self._step = jax.jit(step, donate_argnums=_donate(0, 2))
-        self._step_nomom = jax.jit(step_nomom, donate_argnums=_donate(0))
+    def step_param(self, w, g, m, lr, wd, t):
+        g = self._preprocess(g) + wd * w
+        if m is None:
+            return (w - lr * g).astype(w.dtype), None
+        m_new = self.momentum * m - lr * g
+        return (w + m_new).astype(w.dtype), m_new.astype(m.dtype)
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
         return zeros(weight.shape, weight.context, dtype=weight.dtype)
-
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        if state is not None:
-            w, m = self._step(weight._data, grad._data, state._data,
-                              jnp.float32(lr), jnp.float32(wd))
-            weight._set(w)
-            state._set(m)
-        else:
-            weight._set(self._step_nomom(weight._data, grad._data,
-                                         jnp.float32(lr), jnp.float32(wd)))
 
 
 @register("ccsgd")
@@ -205,38 +301,26 @@ class NAG(Optimizer):
     """Nesterov accelerated gradient (optimizer.py:313)."""
 
     def __init__(self, momentum=0.0, **kwargs):
-        super().__init__(**kwargs)
         self.momentum = momentum
-        self._build_steps()
+        super().__init__(**kwargs)
 
-    def _build_steps(self):
-        def step(w, g, m, lr, wd):
-            g = self._preprocess(g) + wd * w
-            m_new = self.momentum * m + g
-            g_eff = g + self.momentum * m_new
-            return (w - lr * g_eff).astype(w.dtype), m_new.astype(m.dtype)
-
-        self._step = jax.jit(step, donate_argnums=_donate(0, 2))
+    def step_param(self, w, g, m, lr, wd, t):
+        g = self._preprocess(g) + wd * w
+        m_new = self.momentum * m + g
+        g_eff = g + self.momentum * m_new
+        return (w - lr * g_eff).astype(w.dtype), m_new.astype(m.dtype)
 
     def create_state(self, index, weight):
         return zeros(weight.shape, weight.context, dtype=weight.dtype)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        w, m = self._step(weight._data, grad._data, state._data,
-                          jnp.float32(lr), jnp.float32(wd))
-        weight._set(w)
-        state._set(m)
-
 
 @register("sgld")
 class SGLD(Optimizer):
-    """Stochastic Gradient Langevin Dynamics (optimizer.py:361)."""
+    """Stochastic Gradient Langevin Dynamics (optimizer.py:361).
 
-    def __init__(self, **kwargs):
-        super().__init__(**kwargs)
-        self._build_steps()
+    Keeps a custom ``update`` (the noise draw needs an RNG key operand);
+    no ``step_param``, so the fused train step falls back to the
+    per-param loop for it."""
 
     def _build_steps(self):
         def step(w, g, lr, wd, key):
@@ -257,49 +341,36 @@ class SGLD(Optimizer):
 
 @register("adam")
 class Adam(Optimizer):
-    """Adam (optimizer.py:504) with the reference's bias-corrected lr."""
+    """Adam (optimizer.py:504) with the reference's bias-corrected lr.
+
+    The bias correction is computed inside the traced kernel from the
+    update count ``t`` (a traced scalar), so neither the per-param nor
+    the fused path recompiles as ``t`` advances."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):
-        super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
-        self._build_steps()
+        super().__init__(learning_rate=learning_rate, **kwargs)
 
-    def _build_steps(self):
-        def step(w, g, mv, lr_t, wd):
-            m, v = mv
-            g = self._preprocess(g) + wd * w
-            m_new = self.beta1 * m + (1 - self.beta1) * g
-            v_new = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
-            w_new = w - lr_t * m_new / (jnp.sqrt(v_new) + self.epsilon)
-            return w_new.astype(w.dtype), (m_new.astype(m.dtype),
-                                           v_new.astype(v.dtype))
+    def _bias_corrected_lr(self, lr, t):
+        tf = jnp.asarray(t, jnp.float32)
+        coef1 = 1.0 - jnp.power(jnp.float32(self.beta1), tf)
+        coef2 = 1.0 - jnp.power(jnp.float32(self.beta2), tf)
+        return lr * jnp.sqrt(coef2) / coef1
 
-        self._step = jax.jit(step, donate_argnums=_donate(0, 2))
+    def step_param(self, w, g, mv, lr, wd, t):
+        m, v = mv
+        g = self._preprocess(g) + wd * w
+        m_new = self.beta1 * m + (1 - self.beta1) * g
+        v_new = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        lr_t = self._bias_corrected_lr(lr, t)
+        w_new = w - lr_t * m_new / (jnp.sqrt(v_new) + self.epsilon)
+        return w_new.astype(w.dtype), (m_new.astype(m.dtype),
+                                       v_new.astype(v.dtype))
 
     def create_state(self, index, weight):
         return (zeros(weight.shape, weight.context, dtype=weight.dtype),
                 zeros(weight.shape, weight.context, dtype=weight.dtype))
-
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
-        lr = self._get_lr(index)
-        coef1 = 1.0 - self.beta1**t
-        coef2 = 1.0 - self.beta2**t
-        lr_t = lr * math.sqrt(coef2) / coef1
-        m, v = state
-        w, (m_new, v_new) = self._step(weight._data, grad._data,
-                                       (m._data, v._data),
-                                       jnp.float32(lr_t),
-                                       jnp.float32(self._wd_arg(index, lr)))
-        weight._set(w)
-        m._set(m_new)
-        v._set(v_new)
-
-    def _wd_arg(self, index, lr):
-        """Weight-decay operand of the step kernel; AdamW overrides."""
-        return self._get_wd(index)
 
 
 @register("adagrad")
@@ -307,29 +378,17 @@ class AdaGrad(Optimizer):
     """AdaGrad (optimizer.py:605)."""
 
     def __init__(self, eps=1e-7, **kwargs):
-        super().__init__(**kwargs)
         self.float_stable_eps = eps
-        self._build_steps()
+        super().__init__(**kwargs)
 
-    def _build_steps(self):
-        def step(w, g, h, lr, wd):
-            g = self._preprocess(g)
-            h_new = h + jnp.square(g)
-            w_new = w - lr * (g / jnp.sqrt(h_new + self.float_stable_eps) + wd * w)
-            return w_new.astype(w.dtype), h_new.astype(h.dtype)
-
-        self._step = jax.jit(step, donate_argnums=_donate(0, 2))
+    def step_param(self, w, g, h, lr, wd, t):
+        g = self._preprocess(g)
+        h_new = h + jnp.square(g)
+        w_new = w - lr * (g / jnp.sqrt(h_new + self.float_stable_eps) + wd * w)
+        return w_new.astype(w.dtype), h_new.astype(h.dtype)
 
     def create_state(self, index, weight):
         return zeros(weight.shape, weight.context, dtype=weight.dtype)
-
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        w, h = self._step(weight._data, grad._data, state._data,
-                          jnp.float32(self._get_lr(index)),
-                          jnp.float32(self._get_wd(index)))
-        weight._set(w)
-        state._set(h)
 
 
 @register("rmsprop")
@@ -339,39 +398,23 @@ class RMSProp(Optimizer):
 
     def __init__(self, learning_rate=0.002, gamma1=0.95, gamma2=0.9,
                  epsilon=1e-4, **kwargs):
-        super().__init__(learning_rate=learning_rate, **kwargs)
         self.gamma1, self.gamma2, self.epsilon = gamma1, gamma2, epsilon
-        self._build_steps()
+        super().__init__(learning_rate=learning_rate, **kwargs)
 
-    def _build_steps(self):
-        def step(w, g, state, lr, wd):
-            n, gavg, delta = state
-            g = self._preprocess(g) + wd * w
-            n_new = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n
-            gavg_new = (1 - self.gamma1) * g + self.gamma1 * gavg
-            denom = jnp.sqrt(n_new - jnp.square(gavg_new) + self.epsilon)
-            delta_new = self.gamma2 * delta - lr * g / denom
-            return ((w + delta_new).astype(w.dtype),
-                    (n_new.astype(n.dtype), gavg_new.astype(gavg.dtype),
-                     delta_new.astype(delta.dtype)))
-
-        self._step = jax.jit(step, donate_argnums=_donate(0, 2))
+    def step_param(self, w, g, state, lr, wd, t):
+        n, gavg, delta = state
+        g = self._preprocess(g) + wd * w
+        n_new = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n
+        gavg_new = (1 - self.gamma1) * g + self.gamma1 * gavg
+        denom = jnp.sqrt(n_new - jnp.square(gavg_new) + self.epsilon)
+        delta_new = self.gamma2 * delta - lr * g / denom
+        return ((w + delta_new).astype(w.dtype),
+                (n_new.astype(n.dtype), gavg_new.astype(gavg.dtype),
+                 delta_new.astype(delta.dtype)))
 
     def create_state(self, index, weight):
         z = lambda: zeros(weight.shape, weight.context, dtype=weight.dtype)
         return (z(), z(), z())
-
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        n, gavg, delta = state
-        w, (n2, g2, d2) = self._step(weight._data, grad._data,
-                                     (n._data, gavg._data, delta._data),
-                                     jnp.float32(self._get_lr(index)),
-                                     jnp.float32(self._get_wd(index)))
-        weight._set(w)
-        n._set(n2)
-        gavg._set(g2)
-        delta._set(d2)
 
 
 @register("adadelta")
@@ -379,37 +422,23 @@ class AdaDelta(Optimizer):
     """AdaDelta (optimizer.py:730)."""
 
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
-        super().__init__(**kwargs)
         self.rho, self.epsilon = rho, epsilon
-        self._build_steps()
+        super().__init__(**kwargs)
 
-    def _build_steps(self):
-        def step(w, g, state, wd):
-            acc_g, acc_delta = state
-            g = self._preprocess(g)
-            acc_g_new = self.rho * acc_g + (1 - self.rho) * jnp.square(g)
-            delta = (jnp.sqrt(acc_delta + self.epsilon)
-                     / jnp.sqrt(acc_g_new + self.epsilon)) * g
-            acc_delta_new = self.rho * acc_delta + (1 - self.rho) * jnp.square(delta)
-            w_new = w - delta - wd * w
-            return w_new.astype(w.dtype), (acc_g_new.astype(acc_g.dtype),
-                                           acc_delta_new.astype(acc_delta.dtype))
-
-        self._step = jax.jit(step, donate_argnums=_donate(0, 2))
+    def step_param(self, w, g, state, lr, wd, t):
+        acc_g, acc_delta = state
+        g = self._preprocess(g)
+        acc_g_new = self.rho * acc_g + (1 - self.rho) * jnp.square(g)
+        delta = (jnp.sqrt(acc_delta + self.epsilon)
+                 / jnp.sqrt(acc_g_new + self.epsilon)) * g
+        acc_delta_new = self.rho * acc_delta + (1 - self.rho) * jnp.square(delta)
+        w_new = w - delta - wd * w
+        return w_new.astype(w.dtype), (acc_g_new.astype(acc_g.dtype),
+                                       acc_delta_new.astype(acc_delta.dtype))
 
     def create_state(self, index, weight):
         return (zeros(weight.shape, weight.context, dtype=weight.dtype),
                 zeros(weight.shape, weight.context, dtype=weight.dtype))
-
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        ag, ad = state
-        w, (ag2, ad2) = self._step(weight._data, grad._data,
-                                   (ag._data, ad._data),
-                                   jnp.float32(self._get_wd(index)))
-        weight._set(w)
-        ag._set(ag2)
-        ad._set(ad2)
 
 
 @register("test")
@@ -447,22 +476,18 @@ class AdamW(Adam):
     ``wd`` is applied directly to the weights, scaled by the schedule
     lr, instead of being folded into the gradient."""
 
-    def _build_steps(self):
-        def step(w, g, mv, lr_t, wd_term):
-            m, v = mv
-            g = self._preprocess(g)
-            m_new = self.beta1 * m + (1 - self.beta1) * g
-            v_new = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
-            w_new = (w * (1.0 - wd_term)
-                     - lr_t * m_new / (jnp.sqrt(v_new) + self.epsilon))
-            return w_new.astype(w.dtype), (m_new.astype(m.dtype),
-                                           v_new.astype(v.dtype))
-
-        self._step = jax.jit(step, donate_argnums=_donate(0, 2))
-
-    def _wd_arg(self, index, lr):
-        # decoupled decay: the kernel's wd term is lr-scaled
-        return lr * self._get_wd(index)
+    def step_param(self, w, g, mv, lr, wd, t):
+        m, v = mv
+        g = self._preprocess(g)
+        m_new = self.beta1 * m + (1 - self.beta1) * g
+        v_new = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        lr_t = self._bias_corrected_lr(lr, t)
+        # decoupled decay: the weight shrinks by the schedule-lr-scaled
+        # wd, independent of the moments
+        w_new = (w * (1.0 - lr * wd)
+                 - lr_t * m_new / (jnp.sqrt(v_new) + self.epsilon))
+        return w_new.astype(w.dtype), (m_new.astype(m.dtype),
+                                       v_new.astype(v.dtype))
 
 
 @register("lars")
@@ -482,39 +507,26 @@ class LARS(SGD):
         self.epsilon = epsilon
         super().__init__(**kwargs)
 
-    def _build_steps(self):
-        super()._build_steps()
+    def step_param(self, w, g, m, lr, wd, t):
+        if w.ndim <= 1:
+            # bias/gamma/beta: plain SGD(+momentum) step, state kept
+            return SGD.step_param(self, w, g, m, lr, wd, t)
         eta, eps = self.trust_coefficient, self.epsilon
-
-        def step(w, g, m, lr, wd):
-            g = self._preprocess(g)
-            wf = w.astype(jnp.float32)
-            gf = g.astype(jnp.float32)
-            w_norm = jnp.sqrt(jnp.sum(jnp.square(wf)))
-            g_norm = jnp.sqrt(jnp.sum(jnp.square(gf)))
-            ratio = jnp.where(
-                (w_norm > 0) & (g_norm > 0),
-                eta * w_norm / (g_norm + wd * w_norm + eps), 1.0)
-            gf = gf + wd * wf
-            m_new = self.momentum * m + lr * ratio * gf
-            return (wf - m_new).astype(w.dtype), m_new.astype(m.dtype)
-
-        self._step_lars = jax.jit(step, donate_argnums=_donate(0, 2))
+        g = self._preprocess(g)
+        wf = w.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(wf)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(gf)))
+        ratio = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            eta * w_norm / (g_norm + wd * w_norm + eps), 1.0)
+        gf = gf + wd * wf
+        m_new = self.momentum * m + lr * ratio * gf
+        return (wf - m_new).astype(w.dtype), m_new.astype(m.dtype)
 
     def create_state(self, index, weight):
         # momentum buffer always exists (the trust-ratio step needs it)
         return zeros(weight.shape, weight.context, dtype=weight.dtype)
-
-    def update(self, index, weight, grad, state):
-        if len(weight.shape) <= 1:
-            # bias/gamma/beta: plain SGD(+momentum) path
-            return super().update(index, weight, grad, state)
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        w, m = self._step_lars(weight._data, grad._data, state._data,
-                               jnp.float32(lr), jnp.float32(wd))
-        weight._set(w)
-        state._set(m)
 
 
 @register("lamb")
@@ -531,39 +543,24 @@ class LAMB(Adam):
         # in epsilon when Adam's first positional is learning_rate.
         super().__init__(epsilon=epsilon, **kwargs)
 
-    def _build_steps(self):
-        def step(w, g, mv, coefs, wd):
-            m, v = mv
-            lr, coef1, coef2 = coefs
-            g = self._preprocess(g)
-            m_new = self.beta1 * m + (1 - self.beta1) * g
-            v_new = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
-            m_hat = m_new / coef1
-            v_hat = v_new / coef2
-            wf = w.astype(jnp.float32)
-            r = m_hat / (jnp.sqrt(v_hat) + self.epsilon) + wd * wf
-            w_norm = jnp.sqrt(jnp.sum(jnp.square(wf)))
-            r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
-            ratio = jnp.where((w_norm > 0) & (r_norm > 0),
-                              w_norm / r_norm, 1.0)
-            if w.ndim <= 1:
-                ratio = 1.0  # bias/norm params: no layer adaptation
-            w_new = wf - lr * ratio * r
-            return w_new.astype(w.dtype), (m_new.astype(m.dtype),
-                                           v_new.astype(v.dtype))
-
-        self._step_lamb = jax.jit(step, donate_argnums=_donate(0, 2))
-
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
-        lr = self._get_lr(index)
-        m, v = state
-        coefs = (jnp.float32(lr), jnp.float32(1.0 - self.beta1**t),
-                 jnp.float32(1.0 - self.beta2**t))
-        w, (m_new, v_new) = self._step_lamb(
-            weight._data, grad._data, (m._data, v._data), coefs,
-            jnp.float32(self._get_wd(index)))
-        weight._set(w)
-        m._set(m_new)
-        v._set(v_new)
+    def step_param(self, w, g, mv, lr, wd, t):
+        m, v = mv
+        tf = jnp.asarray(t, jnp.float32)
+        coef1 = 1.0 - jnp.power(jnp.float32(self.beta1), tf)
+        coef2 = 1.0 - jnp.power(jnp.float32(self.beta2), tf)
+        g = self._preprocess(g)
+        m_new = self.beta1 * m + (1 - self.beta1) * g
+        v_new = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        m_hat = m_new / coef1
+        v_hat = v_new / coef2
+        wf = w.astype(jnp.float32)
+        r = m_hat / (jnp.sqrt(v_hat) + self.epsilon) + wd * wf
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(wf)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0),
+                          w_norm / r_norm, 1.0)
+        if w.ndim <= 1:
+            ratio = 1.0  # bias/norm params: no layer adaptation
+        w_new = wf - lr * ratio * r
+        return w_new.astype(w.dtype), (m_new.astype(m.dtype),
+                                       v_new.astype(v.dtype))

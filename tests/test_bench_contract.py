@@ -224,6 +224,42 @@ def test_serve_bench_contract():
 
 
 @pytest.mark.slow
+def test_train_bench_contract(tmp_path):
+    """tools/train_bench.py (the TRAIN_BENCH.json bench_watch stage)
+    emits the training-path comparison on a CPU smoke config: both
+    modes measured, per-batch dispatch counts showing the O(1)-vs-
+    O(num_params) contrast, and complete:true stamped before the final
+    record."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # no tunnel for a CPU smoke
+    out = str(tmp_path / "train_bench.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "train_bench.py"),
+         "--backend", "cpu", "--layers", "4", "--hidden", "32",
+         "--batches", "8", "--epochs", "2", "--json", out],
+        capture_output=True, text=True, timeout=540, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads([l for l in r.stdout.splitlines()
+                          if l.startswith("{")][-1])
+    assert payload["platform"] == "cpu"
+    assert payload["complete"] is True      # stamped BEFORE the print
+    assert payload["fused_steps_per_sec"] > 0
+    assert payload["unfused_steps_per_sec"] > 0
+    assert payload["speedup"] > 0
+    # the dispatch contract: fused <= 3 per batch, per-param pays
+    # 1 (fwd_bwd) + num_params
+    assert payload["fused_dispatches_per_batch"] <= 3
+    assert (payload["unfused_dispatches_per_batch"]
+            >= payload["num_params"] + 1)
+    assert {pt["mode"] for pt in payload["points"]} == {"fused", "per_param"}
+    assert "telemetry" in payload
+    # the --json artifact matches the printed record
+    disk = json.loads(open(out).read())
+    assert disk["complete"] is True
+    assert disk["fused_steps_per_sec"] == payload["fused_steps_per_sec"]
+
+
+@pytest.mark.slow
 def test_watchdog_rejects_stale_promoted_record(tmp_path):
     """bench_watch.run_bench must NOT persist bench.py's stale-promoted
     prior record as a fresh capture (that would launder an old number as

@@ -1,0 +1,299 @@
+"""Fused single-dispatch train step: numerical parity with the
+per-param loop for every registered optimizer, the O(1) dispatch-count
+contract, device-side metric parity, and fallback selection."""
+
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.io import NDArrayIter
+
+
+@pytest.fixture
+def tel():
+    """Fresh enabled telemetry, restored to disabled+empty afterwards."""
+    telemetry.enable()
+    telemetry.reset()
+    yield telemetry
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=2)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _data(n=64, d=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    return X, y
+
+
+def _train(fused, optimizer, opt_params, num_epoch=2, wd=0.0):
+    os.environ["MXTPU_FUSED_STEP"] = "1" if fused else "0"
+    try:
+        mx.random.seed(7)  # pin the initializer's draws
+        X, y = _data()
+        it = NDArrayIter(X, y, batch_size=16)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        params = dict(opt_params)
+        if wd:
+            params["wd"] = wd
+        mod.fit(it, num_epoch=num_epoch, optimizer=optimizer,
+                optimizer_params=params,
+                initializer=mx.initializer.Xavier(), kvstore=None)
+        return mod
+    finally:
+        os.environ.pop("MXTPU_FUSED_STEP", None)
+
+
+OPTIMIZERS = [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("ccsgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+    ("adamw", {"learning_rate": 0.01}),
+    ("adagrad", {"learning_rate": 0.1}),
+    ("rmsprop", {"learning_rate": 0.002}),
+    ("adadelta", {}),
+    ("lars", {"learning_rate": 0.5, "momentum": 0.9,
+              "trust_coefficient": 0.01}),
+    ("lamb", {"learning_rate": 0.05}),
+]
+
+
+@pytest.mark.parametrize("name,params", OPTIMIZERS,
+                         ids=[f"{n}{'-mom' if p.get('momentum') else ''}"
+                              for n, p in OPTIMIZERS])
+def test_fused_vs_unfused_parity(name, params):
+    """N training steps through the fused whole-pytree program land on
+    the same weights as the per-param update loop (both trace the same
+    step_param, so this pins the wiring: grads, lr/wd trees, update
+    counts, state round-trips)."""
+    mod_f = _train(True, name, params, wd=0.001)
+    mod_u = _train(False, name, params, wd=0.001)
+    args_f, _ = mod_f.get_params()
+    args_u, _ = mod_u.get_params()
+    assert mod_f._select_fused() is not None  # fused actually ran
+    for k in args_u:
+        np.testing.assert_allclose(
+            args_f[k].asnumpy(), args_u[k].asnumpy(), rtol=1e-4, atol=1e-5,
+            err_msg=f"{name}: param {k} diverged between fused and unfused")
+
+
+def test_fused_dispatch_count(tel):
+    """The fused path issues <= 3 compiled dispatches per training batch
+    (step + staging + metric); the per-param path issues O(num_params)."""
+    nbatches = 2 * 4  # epochs * batches
+    _train(True, "sgd", {"learning_rate": 0.1, "momentum": 0.9})
+    snap = tel.registry().snapshot()["mxtpu_train_dispatches_total"]
+    fused = {s["labels"]["kind"]: s["value"] for s in snap["samples"]}
+    assert fused.get("fused_step") == nbatches
+    assert "per_param_update" not in fused
+    assert "fwd_bwd" not in fused
+    assert sum(fused.values()) / nbatches <= 3
+
+    tel.reset()
+    _train(False, "sgd", {"learning_rate": 0.1, "momentum": 0.9})
+    snap = tel.registry().snapshot()["mxtpu_train_dispatches_total"]
+    perparam = {s["labels"]["kind"]: s["value"] for s in snap["samples"]}
+    num_params = 4  # fc1/fc2 weight+bias
+    assert perparam.get("per_param_update") == nbatches * num_params
+    assert perparam.get("fwd_bwd") == nbatches
+    assert "fused_step" not in perparam
+
+
+def test_fused_phase_telemetry(tel):
+    """The fused loop reports its own phase (fused_step) plus
+    data_wait/update_metric — no forward_backward/update observations."""
+    _train(True, "sgd", {"learning_rate": 0.1})
+    snap = tel.registry().snapshot()
+    phases = {s["labels"]["phase"]: s["count"]
+              for s in snap["mxtpu_fit_phase_seconds"]["samples"]}
+    assert phases["fused_step"] == 8
+    assert phases["data_wait"] == 8
+    assert phases["update_metric"] == 8
+    assert phases.get("forward_backward", 0) == 0
+    assert phases.get("update", 0) == 0
+    names = {e["name"] for e in telemetry.tracer().trace_events()}
+    assert "fit.fused_step" in names
+
+
+def test_device_metric_parity():
+    """Device-side (sum, count) accumulation matches the host asnumpy
+    path bit-for-bit on counts and to float32 tolerance on sums."""
+    rng = np.random.RandomState(3)
+    host = mx.metric.create("acc")
+    dev = mx.metric.create("acc")
+    assert dev.device_accumulate(frequent=3)  # sync mid-stream too
+    for _ in range(8):
+        pred = mx.nd.array(rng.rand(16, 4).astype(np.float32))
+        label = mx.nd.array(rng.randint(0, 4, 16).astype(np.float32))
+        host.update([label], [pred])
+        dev.update_device([label], [pred])
+    hname, hval = host.get()
+    dname, dval = dev.get()
+    assert host.num_inst == dev.num_inst
+    assert hval == pytest.approx(dval, rel=1e-6)
+
+    # regression metrics accumulate means-per-batch
+    host = mx.metric.create("mse")
+    dev = mx.metric.create("mse")
+    assert dev.device_accumulate(frequent=50)  # sync only at get()
+    for _ in range(4):
+        pred = mx.nd.array(rng.rand(8, 1).astype(np.float32))
+        label = mx.nd.array(rng.rand(8).astype(np.float32))
+        host.update([label], [pred])
+        dev.update_device([label], [pred])
+    assert host.get()[1] == pytest.approx(dev.get()[1], rel=1e-5)
+
+
+def test_device_metric_reset_discards():
+    dev = mx.metric.create("acc")
+    dev.device_accumulate(frequent=100)
+    pred = mx.nd.array(np.eye(4, dtype=np.float32))
+    label = mx.nd.array(np.arange(4).astype(np.float32))
+    dev.update_device([label], [pred])
+    dev.reset()
+    name, val = dev.get()
+    assert np.isnan(val)  # nothing synced into a fresh epoch
+
+
+def test_fused_fit_uses_device_metric(tel):
+    """End to end: a fused fit accumulates the metric on device (the
+    dispatch counter sees `metric` contributions, not asnumpy stalls)
+    and still reports a sane epoch-end value."""
+    os.environ["MXTPU_FUSED_STEP"] = "1"
+    try:
+        mx.random.seed(7)
+        X, y = _data()
+        it = NDArrayIter(X, y, batch_size=16)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        metric = mx.metric.create("acc")
+        mod.fit(it, num_epoch=3, eval_metric=metric,
+                optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+                initializer=mx.initializer.Xavier(), kvstore=None)
+    finally:
+        os.environ.pop("MXTPU_FUSED_STEP", None)
+    assert metric.device_active
+    snap = tel.registry().snapshot()["mxtpu_train_dispatches_total"]
+    kinds = {s["labels"]["kind"]: s["value"] for s in snap["samples"]}
+    assert kinds.get("metric", 0) > 0
+    acc = mod.score(NDArrayIter(X, y, batch_size=16), "acc")[0][1]
+    assert acc > 0.8
+
+
+def test_device_metric_not_sticky_across_fits(tel):
+    """A metric instance enabled for device accumulation by a fused fit
+    reverts to the host path when a later fit runs classic — the env
+    kill switches keep their documented meaning."""
+    mx.random.seed(7)
+    X, y = _data()
+    it = NDArrayIter(X, y, batch_size=16)
+    metric = mx.metric.create("acc")
+
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, eval_metric=metric, kvstore=None)
+    assert metric.device_active
+
+    os.environ["MXTPU_FUSED_STEP"] = "0"
+    try:
+        mod2 = mx.mod.Module(_mlp(), context=mx.cpu())
+        tel.reset()
+        mod2.fit(it, num_epoch=1, eval_metric=metric, kvstore=None)
+    finally:
+        os.environ.pop("MXTPU_FUSED_STEP", None)
+    assert not metric.device_active
+    snap = tel.registry().snapshot()["mxtpu_train_dispatches_total"]
+    kinds = {s["labels"]["kind"] for s in snap["samples"]}
+    assert "metric" not in kinds  # host asnumpy accumulation ran
+
+
+def test_fallback_selection():
+    """Ineligible configurations return None from _select_fused and
+    train on the classic path (which still converges)."""
+    mx.random.seed(7)
+    X, y = _data()
+    it = NDArrayIter(X, y, batch_size=16)
+
+    # unsupported optimizer (SGLD needs an RNG operand per update)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(kvstore=None, optimizer="sgld")
+    assert mod._select_fused() is None
+
+    # eligible single-context module DOES select it...
+    mod2 = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod2.bind(it.provide_data, it.provide_label)
+    mod2.init_params()
+    mod2.init_optimizer(kvstore=None, optimizer="sgd")
+    assert mod2._select_fused() is not None
+    # ...but the env kill-switch wins
+    os.environ["MXTPU_FUSED_STEP"] = "0"
+    try:
+        assert mod2._select_fused() is None
+    finally:
+        os.environ.pop("MXTPU_FUSED_STEP", None)
+
+    # multiple contexts: per-device executors can't be one program
+    mod3 = mx.mod.Module(_mlp(), context=[mx.cpu(0), mx.cpu(1)])
+    mod3.bind(it.provide_data, it.provide_label)
+    mod3.init_params()
+    mod3.init_optimizer(kvstore=None, optimizer="sgd")
+    assert mod3._select_fused() is None
+
+    # monitor: needs eager per-node execution
+    mod4 = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod4.bind(it.provide_data, it.provide_label)
+    mod4.init_params()
+    mod4.init_optimizer(kvstore=None, optimizer="sgd")
+    mod4.install_monitor(mx.monitor.Monitor(1))
+    assert mod4._select_fused() is None
+
+
+def test_train_step_api_parity():
+    """Module.train_step is usable directly in a custom loop and matches
+    forward_backward+update numerics."""
+    mx.random.seed(7)
+    X, y = _data()
+    it = NDArrayIter(X, y, batch_size=16)
+
+    def build():
+        mx.random.seed(11)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        mod.bind(it.provide_data, it.provide_label)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(kvstore=None, optimizer="adam",
+                           optimizer_params={"learning_rate": 0.01})
+        return mod
+
+    mod_a, mod_b = build(), build()
+    it.reset()
+    for batch in it:
+        assert mod_a.train_step(batch) is True
+        mod_b.forward_backward(batch)
+        mod_b.update()
+    pa, _ = mod_a.get_params()
+    pb, _ = mod_b.get_params()
+    for k in pa:
+        np.testing.assert_allclose(pa[k].asnumpy(), pb[k].asnumpy(),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_fused_convergence():
+    """The headline check: a fused fit actually learns."""
+    mod = _train(True, "sgd", {"learning_rate": 0.5, "momentum": 0.9},
+                 num_epoch=6)
+    X, y = _data()
+    acc = mod.score(NDArrayIter(X, y, batch_size=16), "acc")[0][1]
+    assert acc > 0.9, f"fused-path accuracy {acc} below gate"

@@ -70,6 +70,41 @@ def test_prefetching_iter():
     assert total == 12
 
 
+def test_prefetching_iter_capacity_env():
+    """MXTPU_PREFETCH_CAPACITY sets the queue depth when the ctor
+    doesn't; an explicit capacity argument always wins; the live queue
+    depth is exported as a telemetry gauge."""
+    import os
+
+    from mxnet_tpu import telemetry
+
+    data = np.zeros((20, 2), np.float32)
+    os.environ["MXTPU_PREFETCH_CAPACITY"] = "5"
+    try:
+        it = PrefetchingIter(NDArrayIter(data, np.zeros(20), batch_size=5))
+        assert it.capacity == 5
+        assert it._queue.maxsize == 5
+        it2 = PrefetchingIter(NDArrayIter(data, np.zeros(20), batch_size=5),
+                              capacity=3)
+        assert it2.capacity == 3
+    finally:
+        os.environ.pop("MXTPU_PREFETCH_CAPACITY", None)
+
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        it3 = PrefetchingIter(NDArrayIter(data, np.zeros(20), batch_size=5))
+        for _ in it3:
+            pass
+        snap = telemetry.registry().snapshot()
+        sample = snap["mxtpu_io_prefetch_depth"]["samples"][0]
+        assert sample["labels"]["iterator"] == "PrefetchingIter"
+        assert 0 <= sample["value"] <= it3.capacity
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
 def test_csv_iter(tmp_path):
     data = np.random.rand(10, 4).astype(np.float32)
     labels = np.arange(10).astype(np.float32)

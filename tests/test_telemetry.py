@@ -248,7 +248,14 @@ def _fit_tiny_mlp(num_epoch=1, batches=4, batch_size=16):
     net = mx.sym.FullyConnected(data, name="fc1", num_hidden=2)
     net = mx.sym.SoftmaxOutput(net, name="softmax")
     mod = mx.mod.Module(net, context=mx.cpu())
-    mod.fit(it, num_epoch=num_epoch, kvstore=None)
+    # pin the CLASSIC loop: these tests contract the per-phase
+    # instrumentation of the unfused path (the fused single-dispatch
+    # path has its own phase contract in tests/test_fused_step.py)
+    os.environ["MXTPU_FUSED_STEP"] = "0"
+    try:
+        mod.fit(it, num_epoch=num_epoch, kvstore=None)
+    finally:
+        os.environ.pop("MXTPU_FUSED_STEP", None)
     return batches * num_epoch
 
 
@@ -500,7 +507,9 @@ mod.fit(it, num_epoch=1, kvstore=None)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env.update({"MXTPU_TELEMETRY": "1",
                 "MXTPU_TELEMETRY_DIR": str(tmp_path / "tel"),
-                "MXTPU_PLATFORMS": "cpu", "JAX_PLATFORMS": "cpu"})
+                "MXTPU_PLATFORMS": "cpu", "JAX_PLATFORMS": "cpu",
+                # classic-loop span contract (fit.forward_backward)
+                "MXTPU_FUSED_STEP": "0"})
     r = subprocess.run([sys.executable, "-c", code], env=env, timeout=300,
                        capture_output=True, text=True)
     assert r.returncode == 0, r.stderr[-2000:]

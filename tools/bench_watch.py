@@ -366,6 +366,23 @@ def run_serve_bench(timeout=2400):
         "SERVE_BENCH.json", timeout, validate=validate)
 
 
+def run_train_bench(timeout=1800):
+    """Fused single-dispatch train step vs per-param loop
+    (tools/train_bench.py) — steps/sec and per-batch host dispatch
+    count for the training stack's two update paths."""
+
+    def validate(payload):
+        if not payload.get("fused_steps_per_sec"):
+            return "no fused throughput"
+        if not payload.get("unfused_steps_per_sec"):
+            return "no per-param baseline"
+        return None
+
+    return run_json_artifact(
+        "train_bench", [os.path.join(REPO, "tools", "train_bench.py")],
+        "TRAIN_BENCH.json", timeout, validate=validate)
+
+
 def run_tpu_consistency(timeout=2400):
     """The cpu-vs-tpu numerics gate (tests/test_tpu_consistency.py) has
     only ever run when a session held the chip; record a pass here."""
@@ -405,7 +422,7 @@ def main():
             "resnet": False, "resnet256": False, "gpt": False,
             "longcontext": False, "bandwidth": False, "cifar": False,
             "quant": False, "decode": False, "serve": False,
-            "train_tier": False, "sweep": False}
+            "train_bench": False, "train_tier": False, "sweep": False}
     fails = {k: 0 for k in done}
     MAX_FAILS = 6  # give up on a stage that fails repeatedly WITH the
     #               probe passing (a code bug, not a tunnel flake)
@@ -473,6 +490,7 @@ def main():
             ("quant", lambda: run_quant_bench(timeout=min(1800, left))),
             ("decode", lambda: run_decode_bench(timeout=min(1800, left))),
             ("serve", lambda: run_serve_bench(timeout=min(2400, left))),
+            ("train_bench", lambda: run_train_bench(timeout=min(1800, left))),
             ("train_tier", lambda: run_train_tier(timeout=min(3000, left))),
         ]
         pending = next(((n, fn) for n, fn in stages if not done[n]), None)
