@@ -25,6 +25,12 @@ if _os.environ.get("MXTPU_PLATFORMS"):
 
 from . import base
 from .base import MXNetError
+from . import aot
+
+# MXTPU_COMPILE_CACHE=<dir>: persist XLA compiles across processes.
+# Wired before any jit can run so the first compile of the process
+# already reads/writes the cache (docs/how_to/startup.md).
+aot.enable_from_env()
 from .context import Context, cpu, cpu_pinned, current_context, gpu, tpu, num_devices
 from . import engine
 from . import random

@@ -1,0 +1,109 @@
+"""Traffic-replay warmup manifests.
+
+A bucketed engine compiles one program per (kind, bucket) it actually
+serves — which shapes those are is a property of the *traffic*, not the
+config.  The manifest captures it: one JSONL line per distinct program
+the engine executed, appended live while serving (opt-in via
+``MXTPU_WARMUP_MANIFEST=<path>``)::
+
+  {"kind": "prefill", "bucket": 32}
+  {"kind": "decode", "bucket": 4}
+
+A restarted (or pre-baked, ``tools/aot_warmup.py``) process replays it
+through ``Engine.warmup(manifest)`` before admitting traffic, so the
+first unlucky request never pays a trace+compile.  Lines also carry a
+``spec`` digest of the recording engine's program key; replay ignores
+entries recorded by an incompatibly-configured engine instead of
+compiling programs the new config can never serve.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["ManifestRecorder", "load_manifest", "ENV_MANIFEST"]
+
+ENV_MANIFEST = "MXTPU_WARMUP_MANIFEST"
+
+
+class ManifestRecorder:
+    """Dedup-and-append recorder for one engine's program hits.
+
+    In-memory always (``entries()`` feeds ``Engine.save_manifest``);
+    mirrored to ``path`` as JSONL when one is given.  Append-per-line
+    keeps concurrent engines on one file safe — dedup is per recorder,
+    replay dedups again on load.
+    """
+
+    def __init__(self, spec_digest, path=None):
+        self.spec = spec_digest
+        self.path = path
+        self._seen = {}
+
+    def record(self, kind, bucket):
+        key = (str(kind), int(bucket))
+        if key in self._seen:
+            return False
+        entry = {"kind": key[0], "bucket": key[1], "spec": self.spec}
+        self._seen[key] = entry
+        if self.path:
+            try:
+                with open(self.path, "a") as f:
+                    f.write(json.dumps(entry) + "\n")
+            except OSError:
+                self.path = None       # never let recording break serving
+        return True
+
+    def entries(self):
+        return list(self._seen.values())
+
+
+def load_manifest(source, spec_digest=None):
+    """Normalize a manifest ``source`` into deduped (kind, bucket)
+    entries.
+
+    ``source`` may be a path (JSONL file), an iterable of entry dicts
+    (e.g. another engine's ``manifest()``), or None — which resolves
+    ``MXTPU_WARMUP_MANIFEST`` and yields [] when unset/absent.  Entries
+    recorded under a different ``spec`` digest are skipped when the
+    caller passes its own (an old manifest must not force-compile
+    programs the current engine cannot serve); entries with no spec are
+    trusted (hand-written grids).
+    """
+    if source is None:
+        source = os.environ.get(ENV_MANIFEST)
+        if not source:
+            return []
+    if isinstance(source, (str, os.PathLike)):
+        try:
+            with open(source) as f:
+                lines = f.readlines()
+        except OSError:
+            return []
+        raw = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                raw.append(json.loads(line))
+            except ValueError:
+                continue               # torn tail line of a live file
+    else:
+        raw = list(source)
+    out, seen = [], set()
+    for e in raw:
+        try:
+            kind = str(e["kind"])
+            bucket = int(e["bucket"])
+        except (TypeError, KeyError, ValueError):
+            continue
+        if (spec_digest is not None and e.get("spec") is not None
+                and e["spec"] != spec_digest):
+            continue
+        if (kind, bucket) in seen:
+            continue
+        seen.add((kind, bucket))
+        out.append({"kind": kind, "bucket": bucket})
+    return out

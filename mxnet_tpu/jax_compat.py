@@ -19,7 +19,8 @@ except ImportError:                     # older jax: experimental path
     from jax.experimental.shard_map import shard_map as _shard_map
     _CHECK_KW = "check_rep"
 
-__all__ = ["shard_map"]
+__all__ = ["shard_map", "jax_export", "export_fn", "serialize_exported",
+           "deserialize_exported"]
 
 
 def shard_map(f, mesh=None, in_specs=None, out_specs=None,
@@ -30,3 +31,51 @@ def shard_map(f, mesh=None, in_specs=None, out_specs=None,
         kw[_CHECK_KW] = check_vma
     return _shard_map(f, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, **kw)
+
+
+def jax_export():
+    """The ``jax.export`` module, or None when this jax has no
+    serializable-executable support.
+
+    On the 0.4.x line the submodule must be imported explicitly before
+    ``jax.export`` attribute access resolves; before 0.4.30 the same
+    functions lived at ``jax.experimental.export``.  Callers treat None
+    as "no AOT artifacts on this install" and fall back to fresh
+    tracing — never as an error.
+    """
+    try:
+        import jax.export as ex
+        return ex
+    except ImportError:
+        pass
+    try:
+        from jax.experimental import export as ex
+        return ex
+    except ImportError:
+        return None
+
+
+def export_fn(jitted, *arg_specs, **kw):
+    """``jax.export.export(jitted)(*arg_specs)``: trace+lower a jitted
+    callable at the given ``jax.ShapeDtypeStruct`` specs into an
+    ``Exported`` (serializable StableHLO).  Raises RuntimeError when the
+    installed jax cannot export."""
+    ex = jax_export()
+    if ex is None:
+        raise RuntimeError("this jax installation has no jax.export — "
+                           "AOT executable artifacts are unavailable")
+    return ex.export(jitted, **kw)(*arg_specs)
+
+
+def serialize_exported(exported):
+    """Exported -> bytes (StableHLO + calling convention)."""
+    return exported.serialize()
+
+
+def deserialize_exported(blob):
+    """bytes -> Exported; raises on a corrupt or incompatible blob
+    (callers catch and fall back to fresh compilation)."""
+    ex = jax_export()
+    if ex is None:
+        raise RuntimeError("this jax installation has no jax.export")
+    return ex.deserialize(blob)

@@ -10,6 +10,7 @@ comm/compute overlap (model.py:87-115), two-artifact checkpointing
 from __future__ import annotations
 
 import logging
+import os
 import threading
 
 import numpy as np
@@ -148,7 +149,6 @@ def stage_async_write(path, writer, on_done=None):
     which is renamed over ``path`` only on success; failures are
     recorded per path and re-raised at wait time.  Shared by
     FeedForward/Module checkpoints and ShardedTrainer checkpoints."""
-    import os
 
     def _write():
         # pid + thread id: two concurrent in-process saves to the same
@@ -171,10 +171,8 @@ def stage_async_write(path, writer, on_done=None):
             logging.warning("async checkpoint write failed for %r: %r",
                             path, e)
 
-    import os as _os
-
     t = threading.Thread(target=_write, daemon=False,
-                         name=f"ckpt-{_os.path.basename(path)}")
+                         name=f"ckpt-{os.path.basename(path)}")
     t.start()  # start BEFORE registering: a pre-start thread is not
     with _async_saves_lock:  # alive and a concurrent prune would drop it
         _async_saves[:] = [x for x in _async_saves if x.is_alive()]

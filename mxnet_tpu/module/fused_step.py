@@ -29,10 +29,14 @@ forward/backward/per-param loop.
 
 from __future__ import annotations
 
+import hashlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import jax_compat
+from ..aot import export_store as aot_store
 from ..base import MXNetError
 from ..ndarray import NDArray
 from ..optimizer import (_dispatch_inc, _donate, _state_commit,
@@ -89,6 +93,64 @@ class FusedTrainStep:
         # donate weights (arg 0) and optimizer state (arg 3): on TPU the
         # update reuses their buffers in place, halving peak param memory
         self._program = jax.jit(program, donate_argnums=_donate(0, 3))
+        # AOT restart path (mxnet_tpu/aot/): resolved lazily at the
+        # first step, when the concrete arg shapes exist
+        self._aot_resolved = self._aot_store() is None
+
+    # -- AOT export/load (mxnet_tpu/aot/) ----------------------------------
+    @staticmethod
+    def _aot_store():
+        return aot_store.default_store()
+
+    def _aot_fingerprint(self, args):
+        """What pins the traced fused program: the symbol graph, the
+        optimizer's baked-in scalars (anything read at trace time —
+        momentum, rescale_grad, clip — becomes a compiled constant),
+        every leaf shape/dtype, and the donation policy.  lr/wd/t are
+        runtime operands and deliberately absent."""
+        opt = self._opt
+        # num_update/begin_num_update are runtime operands (t), not
+        # trace-time constants — keying on them would re-export on
+        # every checkpoint resume.  np.generic covers numpy scalars
+        # (rescale_grad=np.float32(...) is baked into the trace just
+        # like a Python float and must key the artifact the same way).
+        baked = {k: (v.item() if isinstance(v, np.generic) else v)
+                 for k, v in sorted(vars(opt).items())
+                 if isinstance(v, (int, float, str, bool, type(None),
+                                   np.generic))
+                 and k not in ("num_update", "begin_num_update")}
+        leaves = [(str(jax.tree_util.tree_structure(args)),)]
+        for leaf in jax.tree_util.tree_leaves(args):
+            leaves.append((tuple(getattr(leaf, "shape", ())),
+                           str(getattr(leaf, "dtype", type(leaf)))))
+        sym_hash = hashlib.sha256(
+            self._exe._symbol.tojson().encode()).hexdigest()
+        return aot_store.fingerprint(
+            subsystem="fused_step", symbol=sym_hash,
+            optimizer=type(opt).__name__, baked=baked, leaves=leaves,
+            donate=list(_donate(0, 3)))
+
+    def _resolve_aot(self, args):
+        """Swap self._program for an AOT artifact (or write one): the
+        restarted process deserializes instead of re-tracing forward+
+        backward+update, and the XLA compile of the round-tripped
+        module hits the persistent compile cache."""
+        self._aot_resolved = True
+        store = self._aot_store()
+        if store is None:
+            return
+        specs = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args)
+        fp = self._aot_fingerprint(specs)
+        exported = store.load(fp, label="fused-step")
+        if exported is None:
+            try:
+                exported = jax_compat.export_fn(self._program, *specs)
+            except Exception:
+                return                 # unexportable: keep the plain jit
+            store.save(fp, exported, label="fused-step")
+        self._program = jax.jit(exported.call,
+                                donate_argnums=_donate(0, 3))
 
     # -- staging -----------------------------------------------------------
     def _as_device_value(self, src, bound, name):
@@ -154,9 +216,13 @@ class FusedTrainStep:
                         for n in self._trainable}
         key = exe._next_key()
 
+        t_op = jnp.int32(t)
+        if not self._aot_resolved:
+            self._resolve_aot((params, others, aux, state_leaves, key,
+                               lrs, wds, t_op))
         _dispatch_inc(self, "fused_step")
         outs, new_params, new_states, new_aux = self._program(
-            params, others, aux, state_leaves, key, lrs, wds, jnp.int32(t))
+            params, others, aux, state_leaves, key, lrs, wds, t_op)
 
         # commit: rebind executor arrays to the program's results (no
         # device work — the references move, the buffers stay put)

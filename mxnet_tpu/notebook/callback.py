@@ -29,7 +29,10 @@ class MetricHistory:
         self.frequent = frequent
         self.train = []      # rows: {epoch, batch, elapsed, <metrics...>}
         self.eval = []       # rows: {epoch, elapsed, <metrics...>}
-        self._start = time.time()
+        # perf_counter: elapsed must be monotonic (an NTP slew under
+        # time.time() would bend the learning-curve x axis) — the same
+        # fix Speedometer and the fit loop already carry
+        self._start = time.perf_counter()
 
     # -- callback protocol --------------------------------------------------
     def __call__(self, param):
@@ -39,7 +42,7 @@ class MetricHistory:
         if param.nbatch % self.frequent != 0:
             return
         row = {"epoch": param.epoch, "batch": param.nbatch,
-               "elapsed": time.time() - self._start}
+               "elapsed": time.perf_counter() - self._start}
         row.update(_metric_pairs(param.eval_metric))
         self.train.append(row)
         self._on_update()
@@ -49,7 +52,8 @@ class MetricHistory:
         self._on_update()
 
     def eval_cb(self, param):
-        row = {"epoch": param.epoch, "elapsed": time.time() - self._start}
+        row = {"epoch": param.epoch,
+               "elapsed": time.perf_counter() - self._start}
         row.update(_metric_pairs(param.eval_metric))
         self.eval.append(row)
         self._on_update()

@@ -129,9 +129,29 @@ def make_hybrid_mesh(dcn_axes, ici_axes, devices=None) -> Mesh:
                          f"{ici_total} chips/slice, have {per_slice}"
                          + ("" if ici_total > per_slice else
                             " (use -1 to absorb the remainder)"))
-    grid = np.empty((n_slices, ici_total), dtype=object)
+    # real multi-slice hardware (slice_index present): let mesh_utils
+    # order each slice's sub-grid by physical torus coordinates, so
+    # with 2+ ICI axes collectives land on neighbor chips instead of
+    # the id-sorted order (which interleaves across the torus).  The
+    # contiguous-block reshape remains the virtual-device fallback —
+    # CPU/test devices have no topology to order by.
+    real_slices = all(getattr(d, "slice_index", None) is not None
+                      for d in devices)
+    grid = np.empty((n_slices,) + tuple(ici_sizes), dtype=object)
     for i, g in enumerate(groups):
-        grid[i, :] = g[:ici_total]
+        sub = None
+        if real_slices and ici_sizes:
+            try:
+                from jax.experimental import mesh_utils
+
+                sub = np.asarray(mesh_utils.create_device_mesh(
+                    tuple(ici_sizes), devices=g[:ici_total]))
+            except Exception:
+                sub = None             # no topology info: fall back
+        if sub is None:
+            sub = np.asarray(g[:ici_total],
+                             dtype=object).reshape(ici_sizes)
+        grid[i] = sub
     grid = grid.reshape(dcn_sizes + ici_sizes)
     return Mesh(grid, dcn_names + ici_names)
 

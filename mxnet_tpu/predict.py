@@ -260,10 +260,9 @@ def export_model(path, symbol, arg_params, aux_params, input_shapes,
     bf16-accumulated passes), so outputs match per-platform, not across.
     """
     import jax
-    import jax.export  # older jax: the submodule must be imported
-    #                    before jax.export attribute access resolves
 
     from .executor import _CompiledGraph
+    from .jax_compat import export_fn
 
     graph = _CompiledGraph(symbol)
     arg_names = symbol.list_arguments()
@@ -301,7 +300,7 @@ def export_model(path, symbol, arg_params, aux_params, input_shapes,
     param_spec = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                   for k, v in params_np.items()}
     kw = {"platforms": list(platforms)} if platforms else {}
-    exported = jax.export.export(jax.jit(infer_fn), **kw)(data_spec, param_spec)
+    exported = export_fn(jax.jit(infer_fn), data_spec, param_spec, **kw)
     manifest = {
         "format": "mxnet_tpu.exported_model.v1",
         "data_names": data_names,
@@ -329,12 +328,11 @@ class ExportedPredictor:
     time — the graph is already compiled to StableHLO."""
 
     def __init__(self, path):
-        import jax
-        import jax.export  # see export_model: explicit submodule import
+        from .jax_compat import deserialize_exported
 
         with zipfile.ZipFile(path) as zf:
             self.manifest = json.loads(zf.read(_MANIFEST))
-            self._exported = jax.export.deserialize(zf.read(_STABLEHLO))
+            self._exported = deserialize_exported(zf.read(_STABLEHLO))
             from .ndarray import _decode_bf16
 
             with np.load(io.BytesIO(zf.read(_PARAMS))) as pz:

@@ -30,6 +30,7 @@ the engine only executes the schedule it is handed.
 from __future__ import annotations
 
 import collections
+import json
 import os
 import time
 
@@ -38,7 +39,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .. import telemetry
+from .. import jax_compat, telemetry
+from ..aot import export_store as aot_store
+from ..aot import warmup as aot_warmup
 from ..models.generate import (_fc, _gelu, _ln, detect_gpt_variant,
                                normalize_gpt_params,
                                reconcile_decode_config)
@@ -112,13 +115,19 @@ class Engine:
         deterministic, which preemption-resume equivalence relies on).
       clock: injectable monotonic clock (tests drive deadlines with a
         fake clock).
+      aot_dir: exported-executable store for AOT restart
+        (env ``MXTPU_AOT_DIR``; see mxnet_tpu/aot/).  When set, bucket
+        programs are serialized on first build and restarted engines
+        load them instead of re-tracing; ``warmup()`` replays a traffic
+        manifest (env ``MXTPU_WARMUP_MANIFEST`` records one) so every
+        program is ready before the first request.
     """
 
     def __init__(self, params, num_heads=None, window=None, symbol=None,
                  name="gpt", block_size=None, num_blocks=None,
                  max_batch=None, max_queue=None, max_model_len=None,
                  max_prefills_per_step=1, temperature=0.0, top_k=None,
-                 seed=0, clock=time.monotonic):
+                 seed=0, clock=time.monotonic, aot_dir=None):
         if symbol is not None:
             num_heads, window = reconcile_decode_config(symbol, num_heads,
                                                         window)
@@ -190,6 +199,13 @@ class Engine:
             tied=self.spec["tied"], rmsnorm=self.spec["rmsnorm"],
             window=self.window, block_size=self.block_size,
             temperature=self.temperature, top_k=self.top_k)
+        # -- AOT startup wiring (mxnet_tpu/aot/) ---------------------------
+        self._aot = (aot_store.ExportStore(aot_dir) if aot_dir is not None
+                     else aot_store.default_store())
+        self._spec_digest = aot_store.digest(self._aot_base_fp())[:16]
+        self._manifest = aot_warmup.ManifestRecorder(
+            self._spec_digest, os.environ.get(aot_warmup.ENV_MANIFEST))
+        self._warming = False
         self._alive = True
         self._noop_steps = 0
         # live-state gauges stamped once per step (no-op when telemetry
@@ -218,6 +234,15 @@ class Engine:
         # (cache geometry + dtype) and the donation policy
         return (self._cfg, self.num_blocks, self.table_width,
                 str(self._cache_k.dtype), self._donate)
+
+    def _aot_base_fp(self):
+        """The on-disk form of _spec_key(): same fields, JSON-stable,
+        plus jax version + backend (aot.fingerprint), so an artifact
+        from an incompatible process can never be loaded."""
+        return aot_store.fingerprint(
+            subsystem="serve", cfg=self._cfg._asdict(),
+            num_blocks=self.num_blocks, table_width=self.table_width,
+            cache_dtype=str(self._cache_k.dtype), donate=self._donate)
 
     # -- public API ----------------------------------------------------------
     def submit(self, prompt, max_new_tokens=64, deadline_s=None):
@@ -372,22 +397,155 @@ class Engine:
             self.scheduler.finish(req, status=FINISHED)
             self._stats.on_complete(req)
 
+    # -- AOT warmup / manifests (mxnet_tpu/aot/) -----------------------------
+    def manifest(self):
+        """The (kind, bucket) programs this engine has executed so far
+        — the traffic-replay warmup manifest (list of entry dicts)."""
+        return self._manifest.entries()
+
+    def save_manifest(self, path):
+        """Write the manifest as JSONL for a later ``warmup(path)``."""
+        with open(path, "w") as f:
+            for e in self._manifest.entries():
+                f.write(json.dumps(e) + "\n")
+        return path
+
+    def warmup(self, manifest=None):
+        """Compile (or AOT-load) every program ``manifest`` lists,
+        before traffic arrives.
+
+        ``manifest`` is a JSONL path, an iterable of entry dicts
+        (another engine's :meth:`manifest`), or None — which replays
+        ``MXTPU_WARMUP_MANIFEST`` when set, else warms the full bucket
+        grid (every decode batch bucket and power-of-two prompt bucket
+        this config can serve).  Entries recorded by an incompatibly-
+        configured engine, or outside this engine's bucket range, are
+        skipped.  Returns the number of programs made ready.
+        """
+        if not self._alive:
+            raise RuntimeError("engine is shut down")
+        entries = aot_warmup.load_manifest(manifest, self._spec_digest)
+        if not entries and manifest is None:
+            entries = self._warmup_grid()
+        ready = 0
+        self._warming = True   # warmup must not re-record the manifest
+        try:
+            with telemetry.span("serve.warmup", programs=len(entries)):
+                for e in entries:
+                    kind, bucket = e["kind"], int(e["bucket"])
+                    if kind == "decode" and 1 <= bucket <= self.max_batch:
+                        self._decode_fn(_next_bucket(bucket, self.max_batch))
+                    elif (kind == "prefill"
+                          and 1 <= bucket <= self.max_model_len):
+                        self._prefill_fn(
+                            _next_bucket(bucket, self.max_model_len))
+                    else:
+                        continue
+                    ready += 1
+        finally:
+            self._warming = False
+        return ready
+
+    def _warmup_grid(self):
+        """Every program this config can ever run: the offline pre-bake
+        default when no traffic manifest exists yet.  Reachable buckets
+        are the powers of two below each cap PLUS the cap itself —
+        ``_next_bucket`` clamps, so a non-power-of-two cap is a real
+        bucket live traffic hits."""
+
+        def buckets(cap):
+            out, b = [], 1
+            while b < cap:
+                out.append(b)
+                b *= 2
+            return out + [cap]
+
+        return ([{"kind": "decode", "bucket": b}
+                 for b in buckets(self.max_batch)]
+                + [{"kind": "prefill", "bucket": p}
+                   for p in buckets(self.max_model_len)])
+
     # -- compiled programs ---------------------------------------------------
     def _decode_fn(self, B):
-        key = (self._spec_key(), "decode", B)
-        fn = _STEP_CACHE.get(key)
-        if fn is None:
-            fn = _build_decode(self._cfg, self._donate)
-            _STEP_CACHE[key] = fn
-        return fn
+        return self._program("decode", B)
 
     def _prefill_fn(self, P):
-        key = (self._spec_key(), "prefill", P)
+        return self._program("prefill", P)
+
+    def _program(self, kind, bucket):
+        key = (self._spec_key(), kind, bucket)
         fn = _STEP_CACHE.get(key)
         if fn is None:
-            fn = _build_prefill(self._cfg, P, self._donate)
+            fn = self._resolve_program(kind, bucket)
             _STEP_CACHE[key] = fn
+        if not self._warming:
+            self._manifest.record(kind, bucket)
         return fn
+
+    def _program_specs(self, kind, bucket):
+        """ShapeDtypeStructs matching exactly what _run_prefill /
+        _run_decode pass — the export/AOT-compile signature."""
+        sds = jax.ShapeDtypeStruct
+        i32 = jnp.dtype(jnp.int32)
+        pspec = {k: sds(v.shape, v.dtype) for k, v in self.params.items()}
+        cspec = sds(self._cache_k.shape, self._cache_k.dtype)
+        kspec = sds(self._key.shape, self._key.dtype)
+        if kind == "decode":
+            return (pspec, cspec, cspec, sds((bucket,), i32),
+                    sds((bucket,), i32),
+                    sds((bucket, self.table_width), i32), kspec)
+        return (pspec, cspec, cspec, sds((bucket,), i32), sds((), i32),
+                sds((bucket,), i32), sds((bucket,), i32), kspec)
+
+    def _resolve_program(self, kind, bucket):
+        """One bucket program: AOT-load it from the export store, or
+        trace it fresh (and write it through for the next restart).
+        ``mxtpu_aot_programs_total{kind,source}`` counts which happened
+        — ``source="trace"`` is exactly a cold-start compile the warm
+        path is supposed to avoid.
+
+        Every path eagerly compiles (``.lower(specs).compile()``): on
+        the hot path the compile was due this very step anyway, and
+        eagerness is what makes ``warmup()`` mean "ready" rather than
+        "will compile at the first unlucky request"."""
+        specs = self._program_specs(kind, bucket)
+
+        def build():
+            telemetry.counter(
+                "mxtpu_aot_programs_total", "bucket-program resolutions",
+                ("kind", "source")).labels(kind=kind, source="trace").inc()
+            if kind == "decode":
+                return _build_decode(self._cfg, self._donate)
+            return _build_prefill(self._cfg, bucket, self._donate)
+
+        def compiled(jitted):
+            try:
+                return jitted.lower(*specs).compile()
+            except Exception:
+                return jitted          # lazy compile on first call
+
+        if self._aot is None:
+            return compiled(build())
+        fp = dict(self._aot_base_fp(), kind=kind, bucket=int(bucket))
+        label = f"serve-{kind}{bucket}"
+        exported = self._aot.load(fp, label=label)
+        if exported is None:
+            jitted = build()
+            try:
+                exported = jax_compat.export_fn(jitted, *specs)
+            except Exception:
+                return compiled(jitted)  # this jax cannot export
+            self._aot.save(fp, exported, label=label)
+        else:
+            telemetry.counter(
+                "mxtpu_aot_programs_total", "bucket-program resolutions",
+                ("kind", "source")).labels(kind=kind,
+                                           source="artifact").inc()
+        # both the cold and the warm process execute the round-tripped
+        # module, so the XLA compile below has the same persistent-cache
+        # key in both — a warm start's compile is a disk read
+        return compiled(jax.jit(
+            exported.call, donate_argnums=(1, 2) if self._donate else ()))
 
 
 # -- compiled-program bodies (close over _ModelCfg ONLY — never an

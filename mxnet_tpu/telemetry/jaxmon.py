@@ -52,6 +52,19 @@ def install(registry, enabled_fn):
             registry.counter("mxtpu_jax_compile_total",
                              "jax compile-path events",
                              ("event",)).labels(event=event).inc()
+        # persistent-compile-cache traffic (mxnet_tpu/aot/cache.py): jax
+        # stamps a hit per executable read back from disk and a miss per
+        # executable it is about to write — so on this event stream a
+        # counted miss IS a put (misses that fail the cache's size/time
+        # thresholds stamp neither and are invisible here by design)
+        if event.endswith("/compilation_cache/cache_hits"):
+            registry.counter("mxtpu_compile_cache_hits",
+                             "persistent compile-cache hits").inc()
+        elif event.endswith("/compilation_cache/cache_misses"):
+            registry.counter("mxtpu_compile_cache_misses",
+                             "persistent compile-cache misses").inc()
+            registry.counter("mxtpu_compile_cache_puts",
+                             "persistent compile-cache writes").inc()
 
     def _on_duration(event, duration, **kwargs):
         _on_event(event, **kwargs)

@@ -260,6 +260,47 @@ def test_train_bench_contract(tmp_path):
 
 
 @pytest.mark.slow
+def test_startup_bench_contract(tmp_path):
+    """tools/startup_bench.py (the STARTUP_BENCH.json bench_watch
+    stage) emits the cold-vs-warm restart record on CPU smoke shapes:
+    warm engine-ready-time at most half of cold (the ISSUE acceptance
+    bar), ZERO fresh traces on the warm start, token parity between the
+    two runs, and complete:true stamped before the final record."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # no tunnel for a CPU smoke
+    # a surrounding compile-cache/AOT config must not leak into the
+    # bench's own cold/warm dirs
+    for k in ("MXTPU_COMPILE_CACHE", "MXTPU_AOT_DIR",
+              "MXTPU_WARMUP_MANIFEST"):
+        env.pop(k, None)
+    out = str(tmp_path / "startup_bench.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "startup_bench.py"),
+         "--backend", "cpu", "--json", out],
+        capture_output=True, text=True, timeout=540, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads([l for l in r.stdout.splitlines()
+                          if l.startswith("{")][-1])
+    assert payload["platform"] == "cpu"
+    assert payload["complete"] is True      # stamped BEFORE the print
+    assert payload["cold_ready_s"] > 0 and payload["warm_ready_s"] > 0
+    assert payload["warm_ready_s"] <= 0.5 * payload["cold_ready_s"], \
+        "warm start did not skip enough compilation"
+    assert payload["warm_fresh_traces"] == 0
+    assert payload["warm_artifact_loads"] > 0
+    assert payload["token_parity"] is True
+    assert {pt["mode"] for pt in payload["points"]} == {"cold", "warm"}
+    cold, warm = payload["points"]
+    # the warm child's compiles were all persistent-cache disk hits
+    assert warm["cache_misses"] == 0
+    assert warm["cache_hits"] > 0
+    assert cold["fresh_traces"] == cold["warmup_programs"]
+    disk = json.loads(open(out).read())
+    assert disk["complete"] is True
+    assert disk["warm_ready_s"] == payload["warm_ready_s"]
+
+
+@pytest.mark.slow
 def test_watchdog_rejects_stale_promoted_record(tmp_path):
     """bench_watch.run_bench must NOT persist bench.py's stale-promoted
     prior record as a fresh capture (that would launder an old number as

@@ -76,6 +76,57 @@ def test_slice_groups_uses_slice_index_attribute():
         _slice_groups(devs + [Bare(4), Bare(5)], n_slices=3)
 
 
+def test_hybrid_mesh_topology_aware_ici_on_real_slices(monkeypatch):
+    """When devices carry slice_index (real multi-slice hardware), each
+    slice's ICI sub-grid must be built by mesh_utils.create_device_mesh
+    (physical-torus-aware ordering), not the id-sorted reshape; virtual
+    devices (no slice_index) keep the contiguous-block fallback."""
+    from jax.experimental import mesh_utils
+
+    from mxnet_tpu.parallel import mesh as mesh_mod
+
+    class Dev:
+        # enough surface for Mesh bookkeeping; no topology attributes,
+        # so an un-monkeypatched create_device_mesh would raise and the
+        # wiring under test would silently fall back (asserted against)
+        def __init__(self, id, slice_index):
+            self.id = id
+            self.slice_index = slice_index
+            self.platform = "tpu"
+            self.process_index = 0
+
+        def __repr__(self):
+            return f"Dev({self.id})"
+
+    calls = []
+    real = mesh_utils.create_device_mesh
+
+    def tracking(mesh_shape, devices=None, **kw):
+        calls.append((tuple(mesh_shape), [d.id for d in devices]))
+        # reversed order stands in for a topology-aware permutation —
+        # the mesh must adopt it, proving the sub-grid came from here
+        return np.asarray(list(reversed(devices)),
+                          dtype=object).reshape(mesh_shape)
+
+    monkeypatch.setattr(mesh_utils, "create_device_mesh", tracking)
+    try:
+        devs = [Dev(i, i // 4) for i in range(8)]
+        mesh = mesh_mod.make_hybrid_mesh({"dp": 2}, {"pp": 2, "tp": 2},
+                                         devices=devs)
+    finally:
+        monkeypatch.setattr(mesh_utils, "create_device_mesh", real)
+    assert calls == [((2, 2), [0, 1, 2, 3]), ((2, 2), [4, 5, 6, 7])]
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    assert ids.tolist() == [[[3, 2], [1, 0]], [[7, 6], [5, 4]]]
+    # virtual devices (the 8-CPU test mesh): no topology call, id order
+    calls.clear()
+    monkeypatch.setattr(mesh_utils, "create_device_mesh", tracking)
+    mesh2 = mesh_mod.make_hybrid_mesh({"dp": 2}, {"tp": 4})
+    assert calls == []
+    ids2 = np.vectorize(lambda d: d.id)(mesh2.devices)
+    assert ids2.tolist() == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+
 def test_hybrid_mesh_trainer_matches_dp():
     """dp-over-DCN x tp-over-ICI sharding must not change the math."""
     rng = np.random.RandomState(0)

@@ -167,6 +167,31 @@ def test_async_checkpoint_failure_surfaces(tmp_path):
             wait_checkpoints()
 
 
+def test_stage_async_write_failure_leaves_no_tmp_orphan(tmp_path):
+    """A writer that produced its temp file and THEN died must not
+    leave the ``.tmp.*`` behind (a crash-looping writer would otherwise
+    fill the checkpoint volume with torn temps)."""
+    import os
+
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.model import stage_async_write, wait_checkpoints
+
+    target = str(tmp_path / "ckpt.params")
+
+    def writer(tmp):
+        with open(tmp, "w") as f:
+            f.write("half a checkpoint")
+        raise RuntimeError("disk full")
+
+    stage_async_write(target, writer)
+    with pytest.raises(MXNetError, match="disk full"):
+        wait_checkpoints()
+    assert not os.path.exists(target)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+
 def test_async_checkpoint_numpy_args_pinned(tmp_path):
     """Plain-numpy params must be deep-copied at call time."""
     import numpy as np

@@ -383,6 +383,25 @@ def run_train_bench(timeout=1800):
         "TRAIN_BENCH.json", timeout, validate=validate)
 
 
+def run_startup_bench(timeout=1800):
+    """Cold vs warm engine-ready time through the AOT subsystem
+    (tools/startup_bench.py) — the restart-cost record: warm must load
+    every bucket program (0 fresh traces) and match cold's tokens."""
+
+    def validate(payload):
+        if not payload.get("cold_ready_s") or not payload.get("warm_ready_s"):
+            return "missing a ready-time point"
+        if payload.get("warm_fresh_traces", 1) != 0:
+            return "warm start traced fresh programs"
+        if not payload.get("token_parity"):
+            return "warm tokens differ from cold"
+        return None
+
+    return run_json_artifact(
+        "startup", [os.path.join(REPO, "tools", "startup_bench.py")],
+        "STARTUP_BENCH.json", timeout, validate=validate)
+
+
 def run_tpu_consistency(timeout=2400):
     """The cpu-vs-tpu numerics gate (tests/test_tpu_consistency.py) has
     only ever run when a session held the chip; record a pass here."""
@@ -422,7 +441,8 @@ def main():
             "resnet": False, "resnet256": False, "gpt": False,
             "longcontext": False, "bandwidth": False, "cifar": False,
             "quant": False, "decode": False, "serve": False,
-            "train_bench": False, "train_tier": False, "sweep": False}
+            "train_bench": False, "startup": False, "train_tier": False,
+            "sweep": False}
     fails = {k: 0 for k in done}
     MAX_FAILS = 6  # give up on a stage that fails repeatedly WITH the
     #               probe passing (a code bug, not a tunnel flake)
@@ -491,6 +511,7 @@ def main():
             ("decode", lambda: run_decode_bench(timeout=min(1800, left))),
             ("serve", lambda: run_serve_bench(timeout=min(2400, left))),
             ("train_bench", lambda: run_train_bench(timeout=min(1800, left))),
+            ("startup", lambda: run_startup_bench(timeout=min(1800, left))),
             ("train_tier", lambda: run_train_tier(timeout=min(3000, left))),
         ]
         pending = next(((n, fn) for n, fn in stages if not done[n]), None)
