@@ -108,6 +108,14 @@ class CompileCacheManager:
             pass                       # nothing compiled yet: no memo
         self.enabled = True
         self.prune()
+        # live introspection: /statusz shows cache geometry, on-disk
+        # occupancy and the hit/miss/put traffic counters.  A strong
+        # ref is deliberate: the active manager is a process singleton
+        # (enable() replaces _active AND, via the fixed name here, the
+        # provider) — there is no retire-without-replacement path
+        from ..telemetry import statusz
+
+        statusz.register("aot.compile_cache", self.statusz)
         return self
 
     # -- inspection --------------------------------------------------------
@@ -147,6 +155,24 @@ class CompileCacheManager:
                 "bytes": sum(s for _, _, s in entries),
                 "max_bytes": self.max_bytes,
                 "max_entries": self.max_entries}
+
+    def statusz(self):
+        """/statusz provider: on-disk stats plus the
+        ``mxtpu_compile_cache_{hits,misses,puts}`` counters collected
+        by the jaxmon bridge (zero when telemetry is disabled).  The
+        three families are read directly — not via a full registry
+        snapshot, which every /statusz render and flight dump would
+        pay for all metrics just to extract three values."""
+        from .. import telemetry
+
+        out = dict(self.stats(), enabled=self.enabled)
+        reg = telemetry.registry()
+        for short, help in (("hits", "persistent compile-cache hits"),
+                            ("misses", "persistent compile-cache misses"),
+                            ("puts", "persistent compile-cache writes")):
+            out[short] = reg.counter(f"mxtpu_compile_cache_{short}",
+                                     help).labels().value
+        return out
 
     # how long an unused sibling jax-version namespace survives: a
     # rolling deploy / rollback window keeps BOTH versions' caches warm

@@ -29,7 +29,9 @@ forward/backward/per-param loop.
 
 from __future__ import annotations
 
+import collections
 import hashlib
+import time
 
 import jax
 import jax.numpy as jnp
@@ -37,12 +39,42 @@ import numpy as np
 
 from .. import jax_compat
 from ..aot import export_store as aot_store
-from ..base import MXNetError
+from ..base import MXNetError, env_flag
 from ..ndarray import NDArray
 from ..optimizer import (_dispatch_inc, _donate, _state_commit,
                          _state_leaves)
+from ..telemetry import flight as flight_mod
+from ..telemetry import statusz as statusz_mod
 
-__all__ = ["FusedTrainStep"]
+__all__ = ["FusedTrainStep", "note_selection", "selection_state"]
+
+# -- fused-path selection log (the /statusz "why is training unfused?"
+# answer): Module._select_fused records every verdict here ---------------------
+_selections = collections.deque(maxlen=16)
+
+
+def note_selection(selected, reason):
+    """Record one fused-path eligibility verdict (Module._select_fused).
+    Repeats of the same verdict fold into the last entry's ``count`` —
+    a custom train loop re-scanning every batch logs one line, not
+    sixteen."""
+    if (_selections and _selections[-1]["selected"] == bool(selected)
+            and _selections[-1]["reason"] == str(reason)):
+        _selections[-1]["t"] = round(time.time(), 3)
+        _selections[-1]["count"] = _selections[-1].get("count", 1) + 1
+        return
+    _selections.append({"t": round(time.time(), 3),
+                        "selected": bool(selected), "reason": str(reason)})
+
+
+def selection_state():
+    """Recent verdicts, newest last — served under /statusz."""
+    return {"recent": list(_selections),
+            "fused_env_enabled": env_flag("MXTPU_FUSED_STEP"),
+            "numeric_watch": env_flag("MXTPU_NUMERIC_WATCH", False)}
+
+
+statusz_mod.register("train.fused_step", selection_state)
 
 
 class FusedTrainStep:
@@ -75,6 +107,12 @@ class FusedTrainStep:
 
         graph = executor._graph
         opt = optimizer
+        # opt-in numeric watchdog (MXTPU_NUMERIC_WATCH): the program
+        # additionally returns (outputs-finite, global grad norm) and
+        # the host checks them — one forced sync per step, the price of
+        # catching a NaN the step it appears instead of epochs later
+        self._watch = env_flag("MXTPU_NUMERIC_WATCH", False)
+        watch = self._watch
 
         def program(params, others, aux, states, key, lrs, wds, t):
             def f(p):
@@ -88,6 +126,17 @@ class FusedTrainStep:
             grads = vjp_fn(head)[0]
             new_params, new_states = opt.step_tree(params, grads, states,
                                                    lrs, wds, t)
+            if watch:
+                outs_ok = jnp.asarray(True)
+                for o in outs:
+                    outs_ok = jnp.logical_and(outs_ok,
+                                              jnp.isfinite(o).all())
+                gsq = jnp.asarray(0.0, jnp.float32)
+                for g in jax.tree_util.tree_leaves(grads):
+                    gsq = gsq + jnp.sum(
+                        jnp.square(g.astype(jnp.float32)))
+                return (outs, new_params, new_states, new_aux,
+                        outs_ok, jnp.sqrt(gsq))
             return outs, new_params, new_states, new_aux
 
         # donate weights (arg 0) and optimizer state (arg 3): on TPU the
@@ -128,7 +177,7 @@ class FusedTrainStep:
         return aot_store.fingerprint(
             subsystem="fused_step", symbol=sym_hash,
             optimizer=type(opt).__name__, baked=baked, leaves=leaves,
-            donate=list(_donate(0, 3)))
+            donate=list(_donate(0, 3)), numeric_watch=self._watch)
 
     def _resolve_aot(self, args):
         """Swap self._program for an AOT artifact (or write one): the
@@ -221,8 +270,26 @@ class FusedTrainStep:
             self._resolve_aot((params, others, aux, state_leaves, key,
                                lrs, wds, t_op))
         _dispatch_inc(self, "fused_step")
-        outs, new_params, new_states, new_aux = self._program(
-            params, others, aux, state_leaves, key, lrs, wds, t_op)
+        if self._watch:
+            (outs, new_params, new_states, new_aux, outs_ok,
+             gnorm) = self._program(params, others, aux, state_leaves,
+                                    key, lrs, wds, t_op)
+            # the float() is the watchdog's forced sync; the values are
+            # tiny scalars, the wait is for the step itself
+            gn = float(gnorm)
+            from .. import telemetry
+
+            telemetry.gauge("mxtpu_train_grad_norm",
+                            "global gradient norm (numeric watchdog)"
+                            ).set(gn)
+            if not bool(outs_ok):
+                flight_mod.record_anomaly("fused_step_loss", step=int(t))
+            if not np.isfinite(gn):
+                flight_mod.record_anomaly("fused_step_grad_norm",
+                                          step=int(t))
+        else:
+            outs, new_params, new_states, new_aux = self._program(
+                params, others, aux, state_leaves, key, lrs, wds, t_op)
 
         # commit: rebind executor arrays to the program's results (no
         # device work — the references move, the buffers stay put)

@@ -276,8 +276,16 @@ class Module(BaseModule):
         module's own parameters, and no monitor (monitoring needs the
         eager per-node path).  ``MXTPU_FUSED_STEP=0`` force-disables.
         """
-        if not env_flag("MXTPU_FUSED_STEP"):
+        from . import fused_step as fused_step_mod
+
+        def _no(reason):
+            # every fallback verdict lands in the /statusz selection
+            # log — "why is training unfused?" without a debugger
+            fused_step_mod.note_selection(False, reason)
             return None
+
+        if not env_flag("MXTPU_FUSED_STEP"):
+            return _no("env_disabled")
         if self._fused is not None:
             # fast path for the per-batch call in custom train_step
             # loops: the full eligibility scan below is O(num_params)
@@ -287,29 +295,30 @@ class Module(BaseModule):
             return self._fused
         if not (self.binded and self.params_initialized
                 and self.optimizer_initialized and self.for_training):
-            return None
+            return _no("not_ready")
         if self._update_on_kvstore or self._kvstore is not None:
-            return None
+            return _no("kvstore")
         if self._updater is None or \
                 getattr(self._updater, "optimizer", None) is not self._optimizer:
-            return None  # custom updater closure: unknown numerics
+            return _no("custom_updater")  # unknown numerics
         if not getattr(self._optimizer, "supports_step_tree", False):
-            return None
+            return _no("optimizer_no_step_tree")
         if len(self._context) != 1 or len(self._exec_group.execs) != 1:
-            return None
+            return _no("multi_context")
         exe = self._exec_group.execs[0]
         if getattr(exe, "_multi_ctx", False) \
                 or exe._monitor_callback is not None:
-            return None
+            return _no("monitor_or_ctx_groups")
         if not exe._grad_names:
-            return None
+            return _no("no_trainable_grads")
         if not set(exe._grad_names) <= set(self._param_names):
-            return None  # inputs_need_grad: input grads need backward()
+            return _no("inputs_need_grad")  # input grads need backward()
         if any(exe._grad_req[n] != "write" for n in exe._grad_names):
-            return None
+            return _no("grad_req_not_write")
         self._fused = FusedTrainStep(
             exe, self._optimizer, self._updater, self._param_names,
             self._exec_group.data_names, self._exec_group.label_names)
+        fused_step_mod.note_selection(True, "eligible")
         return self._fused
 
     def train_step(self, data_batch):

@@ -102,14 +102,25 @@ class ServeMonitor:
         precision vary run to run)."""
         return "-" if value is None else f"{float(value):.1f}"
 
+    @staticmethod
+    def _fmt_reasons(reasons):
+        """Cumulative rejection reasons as a grep-stable bracket:
+        ``[deadline=2,queue_full=1]`` sorted by reason, ``[-]`` when
+        none — back-pressure and its cause are visible straight from
+        the log line, no metrics endpoint needed."""
+        if not reasons:
+            return "-"
+        return ",".join(f"{k}={reasons[k]}" for k in sorted(reasons))
+
     def log_now(self):
         s = self.engine.stats()
         rate = (s.decode_tok_per_sec if s.decode_tok_per_sec is not None
                 else s.total_tok_per_sec)
         self.logger.info(
-            "Serve: step %7d queue=%d running=%d done=%d rej=%d "
+            "Serve: step %7d queue=%d running=%d done=%d rej=%d[%s] "
             "preempt=%d blocks=%d/%d (%.0f%%) ttft_ms=%s tok/s=%s",
             s.steps, s.queue_depth, s.running, s.completed, s.rejected,
+            self._fmt_reasons(getattr(s, "reject_reasons", None)),
             s.preemptions, s.blocks_in_use, s.blocks_total,
             100.0 * s.block_utilization, self._fmt(s.ttft_ms_mean),
             self._fmt(rate))

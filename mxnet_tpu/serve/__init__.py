@@ -14,8 +14,16 @@ serving stack on top of the same checkpoints:
 - ``engine`` — the public ``serve.Engine``: ``submit() -> Request``,
   ``stream()``, ``step()``, ``shutdown()``, bucketed jit programs.
 - ``stats`` — ``ServeStats`` snapshots (queue depth, TTFT, tokens/sec,
-  block utilization, preemption/eviction counters); pair with
-  ``mxnet_tpu.monitor.ServeMonitor`` for periodic logging.
+  block utilization, preemption/eviction counters, rejection reasons);
+  pair with ``mxnet_tpu.monitor.ServeMonitor`` for periodic logging.
+
+Request-scoped observability (docs/how_to/observability.md): every
+request carries a trace id and event timeline (``MXTPU_REQUEST_TRACE``
+exports JSONL; ``tools/trace_report.py`` folds it into per-phase
+latency percentiles), lifecycle events always feed the telemetry
+flight-recorder ring (``MXTPU_FLIGHT_DIR`` dumps it on engine
+exceptions / SLO breaches), and live engines appear on the telemetry
+server's ``/statusz`` page.
 
 Benchmark: ``tools/serve_bench.py`` (SERVE_BENCH.json artifact).
 """

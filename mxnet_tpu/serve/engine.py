@@ -33,6 +33,7 @@ import collections
 import json
 import os
 import time
+import traceback
 
 import numpy as np
 
@@ -42,12 +43,17 @@ import jax.numpy as jnp
 from .. import jax_compat, telemetry
 from ..aot import export_store as aot_store
 from ..aot import warmup as aot_warmup
+from ..base import env_flag
 from ..models.generate import (_fc, _gelu, _ln, detect_gpt_variant,
                                normalize_gpt_params,
                                reconcile_decode_config)
 from ..ops.attention import paged_attention
+from ..telemetry import flight as flight_mod
+from ..telemetry import statusz as statusz_mod
+from ..telemetry.request_trace import RequestTracer
 from .kv_block_manager import BlockManager
-from .scheduler import CANCELLED, FINISHED, QueueFull, Request, Scheduler
+from .scheduler import (CANCELLED, FINISHED, WAITING, QueueFull, Request,
+                        Scheduler)
 from .stats import StatsRecorder
 
 __all__ = ["Engine"]
@@ -61,10 +67,13 @@ __all__ = ["Engine"]
 _STEP_CACHE = {}
 
 # the static model/sampling config the compiled programs close over
+# (numeric_watch is part of it: the watchdog variant returns an extra
+# logits-finite flag, so it is a DIFFERENT compiled program and a
+# different AOT artifact)
 _ModelCfg = collections.namedtuple("_ModelCfg", [
     "name", "n_layers", "num_heads", "head_dim", "kv_heads",
     "pos_table", "swiglu", "tied", "rmsnorm", "window", "block_size",
-    "temperature", "top_k"])
+    "temperature", "top_k", "numeric_watch"])
 
 
 def _next_bucket(n, cap):
@@ -176,10 +185,28 @@ class Engine:
         self.table_width = -(-self.max_model_len // self.block_size)
 
         self.blocks = BlockManager(self.num_blocks, self.block_size)
+        # request-scoped observability: the tracer threads every
+        # lifecycle event (scheduler decisions included) into the
+        # flight-recorder ring, the optional JSONL export
+        # (MXTPU_REQUEST_TRACE) and the Chrome-trace request tracks
+        self._rtrace = RequestTracer()
+        self._rtrace.on_terminal = self._on_request_terminal
         self.scheduler = Scheduler(self.blocks, self.max_batch, max_queue,
-                                   max_prefills_per_step, clock=clock)
+                                   max_prefills_per_step, clock=clock,
+                                   trace=self._rtrace)
         self._stats = StatsRecorder(clock=clock)
         self.clock = clock
+        self._step_id = 0
+        # SLO breach -> flight dump: deadline misses always (rate-
+        # limited by the recorder), rejection rate when the env
+        # threshold is set (fraction of the last 100 terminal requests)
+        self._slo_window = collections.deque(maxlen=100)
+        try:
+            self._reject_rate_thr = float(
+                os.environ.get(flight_mod.ENV_REJECT_RATE, "") or 0.0)
+        except ValueError:
+            self._reject_rate_thr = 0.0
+        self._numeric_watch = env_flag("MXTPU_NUMERIC_WATCH", False)
 
         self.params = {k: jnp.asarray(v) for k, v in params.items()}
         dt = self.params[f"{name}_tok_embed_weight"].dtype
@@ -198,7 +225,8 @@ class Engine:
             pos_table=self.spec["pos_table"], swiglu=self.spec["swiglu"],
             tied=self.spec["tied"], rmsnorm=self.spec["rmsnorm"],
             window=self.window, block_size=self.block_size,
-            temperature=self.temperature, top_k=self.top_k)
+            temperature=self.temperature, top_k=self.top_k,
+            numeric_watch=self._numeric_watch)
         # -- AOT startup wiring (mxnet_tpu/aot/) ---------------------------
         self._aot = (aot_store.ExportStore(aot_dir) if aot_dir is not None
                      else aot_store.default_store())
@@ -227,6 +255,9 @@ class Engine:
         telemetry.gauge("mxtpu_serve_blocks_total",
                         "allocatable KV-cache blocks").set(
             self.blocks.total_blocks)
+        # live introspection: /statusz shows this engine while it is
+        # alive (weakref — a retired engine drops off the page)
+        self._statusz_name = statusz_mod.register_weak(self, "serve.engine")
 
     # -- static config key for the shared program cache ----------------------
     def _spec_key(self):
@@ -267,9 +298,28 @@ class Engine:
 
     def step(self):
         """One scheduler iteration: admit + prefill, then one batched
-        decode.  Returns the number of tokens emitted."""
+        decode.  Returns the number of tokens emitted.
+
+        An unhandled exception dumps the flight-recorder ring to
+        ``MXTPU_FLIGHT_DIR`` before propagating — the post-mortem
+        exists even when nobody had tracing on."""
         if not self._alive:
+            # caller usage error, not an engine failure: raise without
+            # the force-dump (a retry loop on a dead engine must not
+            # write one full post-mortem per call)
             raise RuntimeError("engine is shut down")
+        try:
+            return self._step_inner()
+        except Exception:
+            rec = flight_mod.recorder()
+            rec.record("error", site="engine.step",
+                       error=traceback.format_exc(limit=4))
+            rec.dump("engine_exception", force=True,
+                     extra={"traceback": traceback.format_exc(limit=30)})
+            raise
+
+    def _step_inner(self):
+        self._step_id += 1
         with telemetry.span("serve.step"):
             prefills, decodes = self.scheduler.schedule()
             # blocks for this iteration are all held right now — the
@@ -283,6 +333,14 @@ class Engine:
             if decodes:
                 with telemetry.span("serve.decode", batch=len(decodes)):
                     emitted += self._run_decode(decodes)
+            if prefills or decodes:
+                # scheduler decisions ride the flight ring (bounded,
+                # always on) so post-mortems see the recent schedule
+                flight_mod.recorder().record(
+                    "step", id=self._step_id, prefills=len(prefills),
+                    decodes=len(decodes),
+                    queue=self.scheduler.queue_depth,
+                    blocks_in_use=self.blocks.blocks_in_use)
             if emitted == 0 and not prefills and not decodes:
                 self._noop_steps += 1
                 if self._noop_steps > 1000 and self.scheduler.has_work():
@@ -298,8 +356,7 @@ class Engine:
             self._tel_block_util.set(self.blocks.utilization())
             self._tel_preempt.set(self.scheduler.preemptions)
             self._tel_evict.set(self.blocks.evictions)
-            self._tel_rejected.set(self.scheduler.rejections
-                                   + self._stats.rejected)
+            self._tel_rejected.set(self.scheduler.rejections)
         return emitted
 
     def run(self):
@@ -323,6 +380,78 @@ class Engine:
         """Immutable ``ServeStats`` snapshot of the engine right now."""
         return self._stats.snapshot(self.scheduler, self.blocks)
 
+    # -- SLO breach detection (flight-recorder triggers) ---------------------
+    def _on_request_terminal(self, req, name, args):
+        """Runs on every request's terminal trace event: a deadline
+        miss dumps the flight ring immediately (rate-limited), and a
+        rejection rate over ``MXTPU_FLIGHT_REJECT_RATE`` across the
+        recent-terminal window dumps too."""
+        rejected = name == "rejected"
+        self._slo_window.append(1 if rejected else 0)
+        if rejected and args.get("reason") == "deadline":
+            flight_mod.recorder().dump(
+                "deadline_miss", extra={"rid": req.rid,
+                                        "deadline_s": req.deadline_s})
+        thr = self._reject_rate_thr
+        if thr and len(self._slo_window) >= 20:
+            rate = sum(self._slo_window) / len(self._slo_window)
+            if rate >= thr:
+                flight_mod.recorder().dump(
+                    "rejection_rate",
+                    extra={"rate": round(rate, 4), "threshold": thr,
+                           "window": len(self._slo_window)})
+
+    # -- live introspection (/statusz provider) ------------------------------
+    def statusz(self):
+        """Live engine state for the ``/statusz`` endpoint: in-flight
+        requests with ages and phases, queue/cache occupancy, program
+        and AOT-store state."""
+        now = self.clock()
+        reqs = []
+        for req in list(self.scheduler.running) + list(self.scheduler.waiting):
+            if req.status == WAITING:
+                phase = "queued" if req.n_preemptions == 0 else "preempted"
+            else:
+                phase = "prefill" if req.cache_len == 0 else "decode"
+            reqs.append({
+                "rid": req.rid, "trace_id": req.trace_id,
+                "status": req.status, "phase": phase,
+                "age_s": (round(now - req.submit_t, 3)
+                          if req.submit_t is not None else None),
+                "prompt_tokens": int(req.prompt.size),
+                "generated": len(req.tokens),
+                "target": req.target_len(),
+                "n_preemptions": req.n_preemptions})
+        aot = {"dir": getattr(self._aot, "dir", None)}
+        if self._aot is not None:
+            entries = self._aot.entries()
+            aot.update(artifacts=len(entries),
+                       bytes=sum(b for _, b in entries))
+        return {
+            "alive": self._alive,
+            "steps": self._step_id,
+            "queue_depth": self.scheduler.queue_depth,
+            "running": len(self.scheduler.running),
+            "in_flight": reqs,
+            "completed": self._stats.completed,
+            "preemptions": self.scheduler.preemptions,
+            "reject_reasons": dict(self.scheduler.reject_reasons),
+            "kv_blocks": {"in_use": self.blocks.blocks_in_use,
+                          "total": self.blocks.total_blocks,
+                          "utilization": round(self.blocks.utilization(), 4),
+                          "evictions": self.blocks.evictions},
+            "max_batch": self.max_batch,
+            "max_model_len": self.max_model_len,
+            "programs_recorded": len(self._manifest.entries()),
+            "request_trace": {"enabled": self._rtrace.enabled,
+                              "sample": self._rtrace.sample,
+                              "traced": self._rtrace.traced,
+                              "written": self._rtrace.written,
+                              "path": self._rtrace.path},
+            "numeric_watch": self._numeric_watch,
+            "aot": aot,
+        }
+
     def shutdown(self):
         """Cancel in-flight work and release the device cache."""
         if not self._alive:
@@ -332,7 +461,10 @@ class Engine:
         for req in self.scheduler.waiting:
             req.status = CANCELLED
             req.finish_t = self.clock()
+            self._rtrace.terminal(req, CANCELLED)
         self.scheduler.waiting = []
+        self._rtrace.close()
+        statusz_mod.unregister(self._statusz_name)
         self._cache_k = self._cache_v = None
         self.params = None            # free the device-resident weights
         self._alive = False
@@ -350,16 +482,30 @@ class Engine:
     def _run_prefill(self, req):
         ids = req.prefill_ids()
         n = ids.size
+        resume = req.n_preemptions > 0
         bucket = _next_bucket(n, self.max_model_len)
+        self._rtrace.event(req, "prefill_start", tokens=int(n),
+                           bucket=bucket, resume=resume)
         toks = np.zeros(bucket, np.int32)
         toks[:n] = ids
         blk, off = self._slots(self.blocks.table(req.rid), n, bucket)
         fn = self._prefill_fn(bucket)
         self._key, sub = jax.random.split(self._key)
-        tok, self._cache_k, self._cache_v = fn(
-            self.params, self._cache_k, self._cache_v,
-            jnp.asarray(toks), jnp.asarray(n, jnp.int32),
-            jnp.asarray(blk), jnp.asarray(off), sub)
+        if self._cfg.numeric_watch:
+            tok, ok, self._cache_k, self._cache_v = fn(
+                self.params, self._cache_k, self._cache_v,
+                jnp.asarray(toks), jnp.asarray(n, jnp.int32),
+                jnp.asarray(blk), jnp.asarray(off), sub)
+            if not bool(ok):
+                flight_mod.record_anomaly("prefill_logits", rid=req.rid,
+                                          step=self._step_id)
+        else:
+            tok, self._cache_k, self._cache_v = fn(
+                self.params, self._cache_k, self._cache_v,
+                jnp.asarray(toks), jnp.asarray(n, jnp.int32),
+                jnp.asarray(blk), jnp.asarray(off), sub)
+        self._rtrace.event(req, "prefill_end", tokens=int(n),
+                           resume=resume)
         req.cache_len = n
         self.scheduler.running.append(req)
         now = self.clock()
@@ -382,13 +528,26 @@ class Engine:
             tables[i, :len(t)] = t
         fn = self._decode_fn(bucket)
         self._key, sub = jax.random.split(self._key)
-        out, self._cache_k, self._cache_v = fn(
-            self.params, self._cache_k, self._cache_v,
-            jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables), sub)
+        if self._cfg.numeric_watch:
+            out, ok, self._cache_k, self._cache_v = fn(
+                self.params, self._cache_k, self._cache_v,
+                jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables),
+                sub)
+            if not bool(ok):
+                flight_mod.record_anomaly(
+                    "decode_logits", step=self._step_id, batch_size=B,
+                    rids=[r.rid for r in reqs])
+        else:
+            out, self._cache_k, self._cache_v = fn(
+                self.params, self._cache_k, self._cache_v,
+                jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables),
+                sub)
         out = np.asarray(out)
         for i, req in enumerate(reqs):
             req.cache_len += 1
             req.tokens.append(int(out[i]))
+            self._rtrace.event(req, "decode", batch=self._step_id,
+                               batch_size=B, tokens=len(req.tokens))
             self._maybe_finish(req)
         return B
 
@@ -632,7 +791,14 @@ def _build_decode(cfg, donate):
     def decode(params, ck, cv, toks, pos, tables, rng):
         logits, ck, cv = _forward_token_batch(cfg, params, ck, cv,
                                               toks, pos, tables)
-        return _sample(cfg, logits, rng), ck, cv
+        tok = _sample(cfg, logits, rng)
+        if cfg.numeric_watch:
+            # one extra all-reduce over the logits: the watchdog flag
+            # rides back with the sampled tokens (the host syncs on
+            # them anyway), so a NaN fires the flight recorder instead
+            # of silently poisoning every later token
+            return tok, jnp.isfinite(logits).all(), ck, cv
+        return tok, ck, cv
 
     return jax.jit(decode, donate_argnums=(1, 2) if donate else ())
 
@@ -686,6 +852,9 @@ def _build_prefill(cfg, P, donate):
                         params[f"{p}_proj_bias"])
             x = x + _mlp(cfg, params, p, x)
         logits = _logits(cfg, params, x[plen - 1][None])
-        return _sample(cfg, logits, rng)[0], ck, cv
+        tok = _sample(cfg, logits, rng)[0]
+        if cfg.numeric_watch:
+            return tok, jnp.isfinite(logits).all(), ck, cv
+        return tok, ck, cv
 
     return jax.jit(prefill, donate_argnums=(1, 2) if donate else ())

@@ -24,6 +24,7 @@ import time
 
 import numpy as np
 
+from ..telemetry.request_trace import NOOP_TRACER
 from .kv_block_manager import NoFreeBlocks, blocks_for
 
 __all__ = ["Request", "Scheduler", "QueueFull",
@@ -56,6 +57,7 @@ class Request:
         self.max_new_tokens = int(max_new_tokens)
         self.deadline_s = deadline_s
         self.status = WAITING
+        self.trace_id = None           # stamped by the request tracer
         self.tokens = []           # generated ids (ints)
         self.cache_len = 0         # K/V slots written for this request
         self.submit_t = None       # stamped by the scheduler
@@ -90,20 +92,37 @@ class Request:
 
 class Scheduler:
     def __init__(self, block_mgr, max_batch, max_queue,
-                 max_prefills_per_step=1, clock=time.monotonic):
+                 max_prefills_per_step=1, clock=time.monotonic,
+                 trace=None):
         self.blocks = block_mgr
         self.max_batch = int(max_batch)
         self.max_queue = int(max_queue)
         self.max_prefills_per_step = int(max_prefills_per_step)
         self.clock = clock
+        # request tracer (telemetry.request_trace) — every lifecycle
+        # decision this scheduler makes is an event on it; the default
+        # no-op keeps bare Scheduler tests wiring-free
+        self.trace = trace if trace is not None else NOOP_TRACER
         self.waiting = []          # FIFO by arrival (rids are monotonic)
         self.running = []          # admission order preserved
         self.preemptions = 0
         self.rejections = 0
+        self.reject_reasons = {}   # reason -> cumulative count
 
     # -- admission -----------------------------------------------------------
     def submit(self, req):
+        self.trace.submitted(req)
         if len(self.waiting) >= self.max_queue:
+            # back-pressure raise: the request never queues, but it
+            # counts in rejections/reject_reasons and its trace closes
+            # with the same reason code — the scheduler is the single
+            # owner of the rejected total, so every view (ServeStats,
+            # monitor bracket, trace) agrees even for callers driving
+            # a bare Scheduler (the caller may retry with a NEW Request)
+            self.rejections += 1
+            self.reject_reasons["queue_full"] = \
+                self.reject_reasons.get("queue_full", 0) + 1
+            self.trace.terminal(req, "rejected", reason="queue_full")
             raise QueueFull(
                 f"admission queue full ({self.max_queue} waiting)")
         if not self.blocks.fits_at_all(req.target_len()):
@@ -120,6 +139,13 @@ class Scheduler:
         req.reject_reason = reason
         req.finish_t = self.clock()
         self.rejections += 1
+        self.reject_reasons[reason] = self.reject_reasons.get(reason, 0) + 1
+        if req.trace_id is None:
+            # rejected before scheduler.submit ever saw it (the
+            # engine's exceeds_max_len guard): open the trace so the
+            # timeline is still submitted -> rejected
+            self.trace.submitted(req)
+        self.trace.terminal(req, "rejected", reason=reason)
 
     @property
     def queue_depth(self):
@@ -188,6 +214,10 @@ class Scheduler:
             self.waiting.pop(0)
             self.blocks.allocate(req.rid, need)
             req.status = RUNNING
+            self.trace.event(req,
+                             "resumed" if req.n_preemptions else "admitted",
+                             queue_depth=len(self.waiting),
+                             n_preemptions=req.n_preemptions)
             prefills.append(req)
         return prefills, decodes
 
@@ -205,6 +235,8 @@ class Scheduler:
         req.cache_len = 0
         req.n_preemptions += 1
         self.preemptions += 1
+        self.trace.event(req, "preempted", reason="cache_pressure",
+                         generated=len(req.tokens))
         self.waiting.append(req)
         self.waiting.sort(key=lambda r: r.rid)   # arrival order
 
@@ -214,3 +246,4 @@ class Scheduler:
             self.blocks.free(req.rid, retain=True)
         req.status = status
         req.finish_t = self.clock()
+        self.trace.terminal(req, status, generated=len(req.tokens))

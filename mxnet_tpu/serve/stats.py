@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from dataclasses import asdict, dataclass
+from dataclasses import asdict, dataclass, field
 
 from .. import telemetry
 
@@ -43,6 +43,10 @@ class ServeStats:
     ttft_ms_max: float | None
     decode_tok_per_sec: float | None   # sliding window over recent steps
     total_tok_per_sec: float | None    # engine lifetime aggregate
+    # cumulative rejections by reason code (queue_full / deadline /
+    # exceeds_cache / exceeds_max_len) — the same codes the request
+    # trace and mxtpu_serve_rejections_total{reason} carry
+    reject_reasons: dict = field(default_factory=dict)
 
     def as_dict(self):
         return asdict(self)
@@ -109,6 +113,10 @@ class StatsRecorder:
         self._m_prompt_tokens.inc(int(req.prompt.size))
 
     def on_reject(self):
+        """Counts the Prometheus back-pressure series only.  The
+        rejected TOTAL is owned by ``Scheduler.rejections`` (which
+        counts queue-full at submit too), so ServeStats never
+        double-counts and a bare Scheduler stays self-consistent."""
         self.rejected += 1
         self._m_rejected.inc()
 
@@ -134,7 +142,7 @@ class StatsRecorder:
             queue_depth=scheduler.queue_depth,
             running=len(scheduler.running),
             completed=self.completed,
-            rejected=scheduler.rejections + self.rejected,
+            rejected=scheduler.rejections,
             preemptions=scheduler.preemptions,
             evictions=blocks.evictions,
             tokens_generated=self.tokens_generated,
@@ -151,4 +159,5 @@ class StatsRecorder:
                                 if self._window_rate() else None),
             total_tok_per_sec=(round(total_rate, 1)
                                if total_rate else None),
+            reject_reasons=dict(scheduler.reject_reasons),
         )

@@ -8,7 +8,20 @@ Everything is **off by default** and env-gated:
   MXTPU_TELEMETRY=1            enable (or call ``telemetry.enable()``)
   MXTPU_TELEMETRY_DIR          artifact dir for the atexit dump
                                (default ./mxtpu_telemetry)
-  MXTPU_TELEMETRY_HTTP_PORT    also serve a live /metrics endpoint
+  MXTPU_TELEMETRY_HTTP_PORT    also serve live /metrics + /statusz
+                               endpoints
+
+Request-scoped observability rides the same package (each with its own
+opt-in; docs/how_to/observability.md):
+
+  MXTPU_REQUEST_TRACE[=path]   per-request serve timelines, JSONL
+                               (request_trace.py; sample-rate knob
+                               MXTPU_REQUEST_TRACE_SAMPLE)
+  MXTPU_FLIGHT_DIR             flight-recorder auto-dump directory
+                               (flight.py; the in-memory ring is
+                               always on)
+  MXTPU_NUMERIC_WATCH=1        NaN/Inf watchdog on fused-train-step
+                               loss/grad-norm and serve logits
 
 Disabled, every accessor returns a shared no-op object — instrumented
 hot paths (Module.fit, io iterators, serve.Engine, ShardedTrainer) pay
@@ -36,17 +49,22 @@ import atexit
 import functools
 import os
 
-from . import exporters, jaxmon, metrics, tracing
+from . import (exporters, flight, jaxmon, metrics, request_trace, statusz,
+               tracing)
 from .exporters import (append_jsonl, serve_http, to_prometheus_text,
                         write_prometheus)
+from .flight import FlightRecorder
 from .metrics import DEFAULT_BUCKETS, NOOP, Registry
+from .request_trace import RequestTracer
 from .tracing import NOOP_SPAN, SpanTracer
 
 __all__ = ["enabled", "enable", "disable", "reset", "counter", "gauge",
            "histogram", "span", "traced", "registry", "tracer",
            "snapshot", "dump", "out_dir", "NOOP", "NOOP_SPAN",
            "DEFAULT_BUCKETS", "to_prometheus_text", "write_prometheus",
-           "append_jsonl", "serve_http", "Registry", "SpanTracer"]
+           "append_jsonl", "serve_http", "Registry", "SpanTracer",
+           "flight", "statusz", "request_trace", "FlightRecorder",
+           "RequestTracer"]
 
 _enabled = False
 _registry = Registry()

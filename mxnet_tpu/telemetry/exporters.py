@@ -87,9 +87,13 @@ def append_jsonl(registry, path, extra=None):
 
 
 def serve_http(registry, port, host="127.0.0.1"):
-    """Start a daemon-thread ``/metrics`` endpoint; returns the server
+    """Start a daemon-thread HTTP endpoint; returns the server
     (``server.server_address[1]`` is the bound port — pass ``port=0``
-    for an ephemeral one; ``server.shutdown()`` stops it)."""
+    for an ephemeral one; ``server.shutdown()`` stops it).
+
+    Routes: ``/metrics`` (Prometheus text), ``/metrics.json`` (registry
+    snapshot), ``/statusz`` (live introspection HTML) and
+    ``/statusz.json`` (same as JSON — statusz.py providers)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
@@ -100,6 +104,16 @@ def serve_http(registry, port, host="127.0.0.1"):
             elif self.path == "/metrics.json":
                 body = json.dumps(registry.snapshot()).encode()
                 ctype = "application/json"
+            elif self.path in ("/statusz", "/statusz.json"):
+                from . import statusz
+
+                snap = statusz.snapshot()
+                if self.path.endswith(".json"):
+                    body = json.dumps(snap, default=str).encode()
+                    ctype = "application/json"
+                else:
+                    body = statusz.render_html(snap).encode()
+                    ctype = "text/html; charset=utf-8"
             else:
                 self.send_error(404)
                 return

@@ -1,0 +1,127 @@
+"""/statusz: live process introspection behind the telemetry HTTP server.
+
+Metrics answer "how fast"; `/statusz` answers "what is the process
+doing RIGHT NOW": in-flight serve requests with ages and phases, queue
+depth, KV block-manager occupancy, AOT compile-cache and export-store
+state, fused-train-step selection decisions, the jax backend/device
+inventory, and uptime — one JSON (``/statusz.json``) or HTML
+(``/statusz``) snapshot assembled from registered *providers*.
+
+A provider is a zero-arg callable returning a JSON-serializable dict.
+Subsystems register at construction time (``serve.Engine``,
+``CompileCacheManager``, the fused-step selector); long-lived objects
+register through a weakref (:func:`register_weak`) so a retired engine
+drops out of the page instead of pinning multi-GB parameter dicts.  A
+provider that raises contributes ``{"error": ...}`` — one broken
+subsystem never takes down the page.
+
+The snapshot is also embedded in every flight-recorder dump, so
+post-mortems carry the same live-state view the endpoint would have
+served.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+
+__all__ = ["register", "register_weak", "unregister", "snapshot",
+           "render_html"]
+
+_lock = threading.Lock()
+_providers = {}                  # name -> zero-arg callable
+_start_t = time.time()
+_uid = itertools.count()
+
+
+def register(name, fn):
+    """Register provider ``fn`` under ``name`` (replacing any previous
+    one).  Returns ``name`` for a later :func:`unregister`."""
+    with _lock:
+        _providers[str(name)] = fn
+    return str(name)
+
+
+def register_weak(obj, name, method="statusz"):
+    """Register ``obj.<method>()`` without keeping ``obj`` alive; the
+    entry auto-unregisters once ``obj`` is collected."""
+    import weakref
+
+    name = f"{name}#{next(_uid)}"
+    ref = weakref.ref(obj)
+
+    def provider():
+        target = ref()
+        if target is None:
+            unregister(name)
+            return None
+        return getattr(target, method)()
+
+    return register(name, provider)
+
+
+def unregister(name):
+    with _lock:
+        _providers.pop(name, None)
+
+
+def _jax_inventory():
+    try:
+        import jax
+
+        return {"version": jax.__version__,
+                "backend": jax.default_backend(),
+                "device_count": jax.device_count(),
+                "devices": [{"id": d.id, "platform": d.platform,
+                             "kind": getattr(d, "device_kind", "")}
+                            for d in jax.devices()]}
+    except Exception as e:                       # jax not initialized yet
+        return {"error": repr(e)}
+
+
+def snapshot():
+    """One JSON-serializable snapshot of every registered provider plus
+    the process section (pid, uptime, jax inventory)."""
+    with _lock:
+        providers = dict(_providers)
+    out = {"process": {"pid": os.getpid(),
+                       "uptime_s": round(time.time() - _start_t, 3),
+                       "time": round(time.time(), 3)},
+           "jax": _jax_inventory()}
+    for name, fn in sorted(providers.items()):
+        try:
+            section = fn()
+        except Exception as e:
+            section = {"error": repr(e)}
+        if section is not None:                  # None = dead weakref
+            out[name] = section
+    return out
+
+
+def _html_value(value):
+    import html as _html
+    import json as _json
+
+    return ("<pre>"
+            + _html.escape(_json.dumps(value, indent=2, default=str))
+            + "</pre>")
+
+
+def render_html(snap=None):
+    """Minimal dependency-free HTML view of :func:`snapshot` — one
+    <section> per provider with the JSON pretty-printed."""
+    import html as _html
+
+    snap = snapshot() if snap is None else snap
+    parts = ["<!doctype html><html><head><title>mxtpu /statusz</title>",
+             "<style>body{font-family:monospace;margin:1em}",
+             "h2{border-bottom:1px solid #999;margin:1em 0 .2em}",
+             "pre{margin:.2em 0 .8em;white-space:pre-wrap}</style>",
+             "</head><body><h1>mxtpu /statusz</h1>"]
+    for name in snap:
+        parts.append(f"<h2>{_html.escape(str(name))}</h2>")
+        parts.append(_html_value(snap[name]))
+    parts.append("</body></html>")
+    return "".join(parts)

@@ -13,13 +13,22 @@ one can be constructed, so if an XLA trace IS active
 (``profiler.start()``), the same host phases appear *inside* the
 device trace too — zero-cost when no capture is running.
 
-Events are buffered in memory (bounded by ``max_events``; overflow is
-counted, never grows unbounded) and written by :meth:`SpanTracer.write`
-or the telemetry atexit dump.
+Events are buffered in a bounded in-memory RING (``max_events``): on
+overflow the OLDEST event is evicted and counted in ``dropped``, so a
+long-running serve always keeps the most recent tail — exactly the
+window a post-mortem needs (dropping the newest would discard the
+moments before the failure).  The buffer is written by
+:meth:`SpanTracer.write` or the telemetry atexit dump.
+
+Besides the implicit per-OS-thread tracks, callers may emit events on
+*virtual* tracks (explicit ``tid`` + :meth:`SpanTracer.set_track_name`)
+— the request tracer renders one track per in-flight serve request this
+way, next to the host-thread spans.
 """
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import threading
@@ -78,9 +87,10 @@ class SpanTracer:
     def __init__(self, max_events=200_000):
         self.max_events = int(max_events)
         self.dropped = 0
-        self._events = []
+        self._events = collections.deque()
         self._lock = threading.Lock()
         self._pid = os.getpid()
+        self._track_names = {}         # explicit tid -> display name
         # perf_counter epoch all span timestamps are relative to
         self._t0 = time.perf_counter()
         self._ann_cls = False          # False = not resolved yet
@@ -99,43 +109,61 @@ class SpanTracer:
         """Context manager recording one complete event around a block."""
         return _Span(self, name, args)
 
-    def add_complete(self, name, start, end, args=None):
-        ev = {"name": name, "ph": "X", "cat": "host",
-              "pid": self._pid, "tid": threading.get_ident(),
+    def _push(self, ev):
+        # ring semantics: evict the OLDEST event on overflow so the
+        # buffer always holds the newest tail; evictions count in
+        # ``dropped``
+        with self._lock:
+            while len(self._events) >= self.max_events:
+                self._events.popleft()
+                self.dropped += 1
+            self._events.append(ev)
+
+    def add_complete(self, name, start, end, args=None, tid=None,
+                     cat="host"):
+        ev = {"name": name, "ph": "X", "cat": cat,
+              "pid": self._pid,
+              "tid": threading.get_ident() if tid is None else int(tid),
               "ts": (start - self._t0) * 1e6,
               "dur": max(0.0, (end - start) * 1e6)}
         if args:
             ev["args"] = dict(args)
-        with self._lock:
-            if len(self._events) >= self.max_events:
-                self.dropped += 1
-                return
-            self._events.append(ev)
+        self._push(ev)
 
-    def instant(self, name, **args):
+    def instant(self, name, _tid=None, **args):
         """Zero-duration marker ("ph": "i")."""
         ev = {"name": name, "ph": "i", "s": "t", "cat": "host",
-              "pid": self._pid, "tid": threading.get_ident(),
+              "pid": self._pid,
+              "tid": threading.get_ident() if _tid is None else int(_tid),
               "ts": (time.perf_counter() - self._t0) * 1e6}
         if args:
             ev["args"] = dict(args)
+        self._push(ev)
+
+    def now(self):
+        """Current timestamp on this tracer's clock (perf_counter —
+        pass to :meth:`add_complete` start/end)."""
+        return time.perf_counter()
+
+    def set_track_name(self, tid, name):
+        """Name a virtual track (explicit-tid events, e.g. one per
+        in-flight serve request)."""
         with self._lock:
-            if len(self._events) >= self.max_events:
-                self.dropped += 1
-                return
-            self._events.append(ev)
+            self._track_names[int(tid)] = str(name)
 
     def trace_events(self):
         """Buffered events plus the process/thread metadata records
         Perfetto uses for track names."""
         with self._lock:
             events = list(self._events)
+            track_names = dict(self._track_names)
         meta = [{"name": "process_name", "ph": "M", "pid": self._pid,
                  "args": {"name": "mxtpu host"}}]
         for tid in sorted({e["tid"] for e in events}):
             meta.append({"name": "thread_name", "ph": "M",
                          "pid": self._pid, "tid": tid,
-                         "args": {"name": f"host-thread-{tid}"}})
+                         "args": {"name": track_names.get(
+                             tid, f"host-thread-{tid}")}})
         return meta + events
 
     def write(self, path):
@@ -153,5 +181,6 @@ class SpanTracer:
 
     def clear(self):
         with self._lock:
-            self._events = []
+            self._events = collections.deque()
+            self._track_names = {}
             self.dropped = 0

@@ -1,0 +1,649 @@
+"""Request-scoped observability tests (mxnet_tpu/telemetry +
+mxnet_tpu/serve integration).
+
+Covers the PR-5 acceptance surface end to end on CPU-deterministic
+workloads:
+
+  * request traces: a serve run with MXTPU_REQUEST_TRACE=1 over a
+    workload that preempts AND rejects leaves complete
+    submitted->terminal timelines (no orphan events), which
+    tools/trace_report.py folds into per-phase latency percentiles
+  * reason-code agreement: ServeStats.reject_reasons, the
+    mxtpu_serve_{rejections,preemptions}_total{reason} counters and the
+    trace events carry the SAME codes for queue-full, deadline and
+    preempt-resume
+  * flight recorder: bounded always-on ring; a forced engine exception
+    / deadline miss leaves a valid atomic dump under MXTPU_FLIGHT_DIR
+  * /statusz: live in-flight / KV / AOT / fused-step state over the
+    telemetry HTTP server, JSON and HTML
+  * numeric watchdog: NaN logits and NaN fused-step outputs fire
+    mxtpu_numeric_anomalies_total{site} + a flight dump
+  * satellites: SpanTracer ring keeps the newest events, ServeMonitor
+    logs cumulative rejection reasons, tools/check_env_docs.py pins the
+    env-var table against drift
+"""
+
+import json
+import logging
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.telemetry import flight, request_trace, statusz
+from mxnet_tpu.telemetry.tracing import SpanTracer
+
+
+@pytest.fixture
+def tel():
+    telemetry.reset()
+    telemetry.enable()
+    yield telemetry
+    telemetry.disable()
+    telemetry.reset()
+
+
+@pytest.fixture(autouse=True)
+def clean_flight():
+    """Every test starts with an empty flight ring and no dump-rate
+    state (the recorder is a process singleton)."""
+    flight.recorder().clear()
+    yield
+    flight.recorder().clear()
+
+
+# -- satellite: SpanTracer ring semantics ------------------------------------
+def test_span_tracer_ring_keeps_newest():
+    """On overflow the OLDEST events are evicted (a long-running serve
+    keeps the tail, not the startup); evictions count in dropped."""
+    tr = SpanTracer(max_events=3)
+    for i in range(7):
+        tr.add_complete(f"e{i}", 0.0, 1.0)
+    kept = [e["name"] for e in tr.trace_events() if e["ph"] == "X"]
+    assert kept == ["e4", "e5", "e6"]
+    assert tr.dropped == 4
+
+
+def test_span_tracer_virtual_tracks():
+    tr = SpanTracer(max_events=10)
+    tr.set_track_name(10_001, "serve-req-slot-1")
+    tr.add_complete("decode", 0.0, 1.0, args={"rid": 3}, tid=10_001,
+                    cat="request")
+    events = tr.trace_events()
+    x = [e for e in events if e["ph"] == "X"][0]
+    assert x["tid"] == 10_001 and x["cat"] == "request"
+    names = {e["tid"]: e["args"]["name"] for e in events
+             if e["name"] == "thread_name"}
+    assert names[10_001] == "serve-req-slot-1"
+
+
+# -- serve model fixture (same tiny gpt as test_serve) -----------------------
+VOCAB = 53
+
+
+@pytest.fixture(scope="module")
+def model():
+    S = 96
+    net = mx.models.gpt(VOCAB, S, num_layers=2, d_model=32, num_heads=4)
+    arg_shapes, _, _ = net.infer_shape(data=(1, S), softmax_label=(1, S))
+    rng = np.random.RandomState(3)
+    params = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        scale = 0.35 if name.endswith("weight") else 0.0
+        params[name] = (rng.randn(*shp) * scale
+                        + (1.0 if name.endswith("gamma") else 0.0)
+                        ).astype(np.float32)
+    return net, params
+
+
+def _engine(model, **kw):
+    net, params = model
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("max_prefills_per_step", 2)
+    return mx.serve.Engine(params, symbol=net, **kw)
+
+
+def _run_contended_workload(model, monkeypatch, tmp_path, trace_file):
+    """A scripted serve run that hits preemption AND two rejection
+    paths (queue_full at submit, deadline in the queue).  Returns
+    (engine, submitted requests)."""
+    monkeypatch.setenv("MXTPU_REQUEST_TRACE", "1")
+    monkeypatch.setenv("MXTPU_REQUEST_TRACE_FILE", str(trace_file))
+    t = {"now": 0.0}
+    rng = np.random.RandomState(11)
+    # 20 blocks is tight enough that four 24-token generations preempt
+    eng = _engine(model, num_blocks=20, max_queue=4,
+                  clock=lambda: t["now"])
+    prompts = [rng.randint(0, VOCAB, (n,)).astype(np.int32)
+               for n in (8, 12, 16, 10)]
+    reqs = [eng.submit(p, max_new_tokens=24) for p in prompts[:3]]
+    # deadline rejection: queued behind the others, expires unserved
+    late = eng.submit(rng.randint(0, VOCAB, (6,)).astype(np.int32),
+                      max_new_tokens=4, deadline_s=0.5)
+    with pytest.raises(mx.serve.QueueFull):
+        eng.submit(prompts[3], max_new_tokens=4)
+    t["now"] = 1.0                    # late's deadline passes in queue
+    eng.run()
+    assert all(r.status == "finished" for r in reqs)
+    assert late.status == "rejected" and late.reject_reason == "deadline"
+    assert eng.stats().preemptions > 0, \
+        "workload did not preempt — test is vacuous"
+    return eng, reqs + [late]
+
+
+# -- tentpole: complete request timelines + trace_report ---------------------
+def test_request_trace_complete_timelines(model, tmp_path, monkeypatch):
+    trace_file = tmp_path / "rt.jsonl"
+    eng, reqs = _run_contended_workload(model, monkeypatch, tmp_path,
+                                        trace_file)
+    eng.shutdown()
+    lines = [json.loads(l) for l in open(trace_file)]
+    # every submitted request (finished, deadline-rejected AND the
+    # queue-full overflow) has exactly one complete timeline
+    assert len(lines) == 5
+    by_status = {}
+    for line in lines:
+        evs = [e["ev"] for e in line["events"]]
+        assert evs[0] == "submitted", evs
+        assert evs[-1] in request_trace.TERMINAL_EVENTS, evs
+        # no events after the terminal one (no orphans)
+        assert sum(1 for e in evs
+                   if e in request_trace.TERMINAL_EVENTS) == 1
+        ts = [e["t"] for e in line["events"]]
+        assert ts == sorted(ts)
+        by_status.setdefault(line["status"], []).append(line)
+    assert len(by_status["finished"]) == 3
+    assert len(by_status["rejected"]) == 2
+    reasons = sorted(e["reason"] for line in by_status["rejected"]
+                     for e in line["events"] if e["ev"] == "rejected")
+    assert reasons == ["deadline", "queue_full"]
+    # the preempted request's timeline shows preempted -> resumed ->
+    # fresh prefill (resume by recomputation)
+    preempted = [l for l in lines if l["n_preemptions"] > 0]
+    assert preempted
+    evs = [e["ev"] for e in preempted[0]["events"]]
+    i = evs.index("preempted")
+    assert "resumed" in evs[i:]
+    assert "prefill_start" in evs[evs.index("resumed"):]
+    # decode events carry the batch id + token count
+    decode = [e for l in by_status["finished"] for e in l["events"]
+              if e["ev"] == "decode"]
+    assert decode and all("batch" in e and "tokens" in e for e in decode)
+
+
+def test_trace_report_reconstructs_phases(model, tmp_path, monkeypatch):
+    trace_file = tmp_path / "rt.jsonl"
+    eng, _ = _run_contended_workload(model, monkeypatch, tmp_path,
+                                     trace_file)
+    eng.shutdown()
+    import trace_report
+
+    out = tmp_path / "report.json"
+    assert trace_report.main([str(trace_file), "--json", str(out),
+                              "--check"]) == 0
+    summary = json.loads(open(out).read())
+    assert summary["requests"] == 5 and summary["complete"] == 5
+    assert summary["broken"] == []
+    assert summary["statuses"] == {"finished": 3, "rejected": 2}
+    assert summary["reject_reasons"] == {"deadline": 1, "queue_full": 1}
+    assert summary["preemptions"] >= 1
+    for phase in ("queue", "prefill", "decode", "preempted", "total"):
+        s = summary["phases"][phase]
+        assert s["count"] == 5
+        assert s["p50_ms"] is not None and s["p99_ms"] is not None
+        assert s["p50_ms"] <= s["p99_ms"] + 1e-9
+    # a finished request spent real time decoding
+    assert summary["phases"]["decode"]["max_ms"] > 0
+    # --check rejects a truncated (orphaned) timeline
+    broken = tmp_path / "broken.jsonl"
+    rec = json.loads(open(trace_file).readline())
+    rec["events"] = rec["events"][:-1]       # drop the terminal event
+    broken.write_text(json.dumps(rec) + "\n")
+    assert trace_report.main([str(broken), "--check"]) == 1
+
+
+def test_trace_report_phase_math():
+    """Synthetic timeline with known durations: queue 1s, prefill 2s
+    (1+1 across a preemption), preempted 3s, decode 5s (2s before the
+    preemption + 3s after the resume prefill)."""
+    import trace_report
+
+    events = [
+        {"ev": "submitted", "t": 0.0},
+        {"ev": "admitted", "t": 0.5},
+        {"ev": "prefill_start", "t": 1.0},
+        {"ev": "prefill_end", "t": 2.0},
+        {"ev": "decode", "t": 3.0},
+        {"ev": "preempted", "t": 4.0, "reason": "cache_pressure"},
+        {"ev": "resumed", "t": 6.0},
+        {"ev": "prefill_start", "t": 7.0},
+        {"ev": "prefill_end", "t": 8.0},
+        {"ev": "decode", "t": 9.0},
+        {"ev": "finished", "t": 11.0},
+    ]
+    phases, status, reason, complete = trace_report.phase_breakdown(events)
+    assert complete and status == "finished" and reason is None
+    assert phases["queue"] == pytest.approx(1.0)
+    assert phases["prefill"] == pytest.approx(2.0)
+    assert phases["preempted"] == pytest.approx(3.0)
+    assert phases["decode"] == pytest.approx(5.0)
+    assert phases["total"] == pytest.approx(11.0)
+    # the stdlib-only reimplementation and the Chrome-track emitter's
+    # _phases apply the SAME boundary rules (they cannot share code:
+    # trace_report must not import the package) — pin their agreement
+    intervals = request_trace._phases(events)
+    by_phase = {}
+    for name, start, end, _ in intervals:
+        by_phase[name] = by_phase.get(name, 0.0) + (end - start)
+    assert by_phase["queued"] == pytest.approx(phases["queue"])
+    assert by_phase["prefill"] == pytest.approx(phases["prefill"])
+    assert by_phase["preempted"] == pytest.approx(phases["preempted"])
+    assert by_phase["decode"] == pytest.approx(phases["decode"])
+
+
+def test_request_trace_sampling_zero(model, tmp_path, monkeypatch):
+    """sample=0: no JSONL lines, but the flight ring still sees every
+    request event (post-mortems never depend on sampling)."""
+    monkeypatch.setenv("MXTPU_REQUEST_TRACE", "1")
+    monkeypatch.setenv("MXTPU_REQUEST_TRACE_FILE",
+                       str(tmp_path / "rt.jsonl"))
+    monkeypatch.setenv("MXTPU_REQUEST_TRACE_SAMPLE", "0")
+    eng = _engine(model)
+    eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=3)
+    eng.run()
+    eng.shutdown()
+    assert not os.path.exists(tmp_path / "rt.jsonl")
+    kinds = {e["kind"] for e in flight.recorder().events()}
+    assert "request" in kinds and "step" in kinds
+
+
+# -- satellite: reason codes agree across all three views --------------------
+def test_reason_codes_agree_across_views(tel, model, tmp_path, monkeypatch):
+    trace_file = tmp_path / "rt.jsonl"
+    eng, _ = _run_contended_workload(model, monkeypatch, tmp_path,
+                                     trace_file)
+    st = eng.stats()
+    snap = tel.registry().snapshot()
+    eng.shutdown()
+
+    # 1) ServeStats
+    assert st.reject_reasons == {"deadline": 1, "queue_full": 1}
+    assert st.rejected == sum(st.reject_reasons.values())
+    # 2) registry counters
+    rej = {s["labels"]["reason"]: s["value"]
+           for s in snap["mxtpu_serve_rejections_total"]["samples"]}
+    assert rej == {"deadline": 1.0, "queue_full": 1.0}
+    pre = {s["labels"]["reason"]: s["value"]
+           for s in snap["mxtpu_serve_preemptions_total"]["samples"]}
+    assert pre == {"cache_pressure": float(st.preemptions)}
+    # 3) trace events
+    lines = [json.loads(l) for l in open(trace_file)]
+    trace_rej = {}
+    trace_pre = 0
+    for line in lines:
+        for e in line["events"]:
+            if e["ev"] == "rejected":
+                trace_rej[e["reason"]] = trace_rej.get(e["reason"], 0) + 1
+            elif e["ev"] == "preempted":
+                assert e["reason"] == "cache_pressure"
+                trace_pre += 1
+    assert trace_rej == st.reject_reasons
+    assert trace_pre == st.preemptions
+
+
+def test_bare_scheduler_queue_full_accounting():
+    """queue-full at submit counts in BOTH rejections and
+    reject_reasons on the scheduler itself — a bare Scheduler (no
+    engine wrapper) stays self-consistent."""
+    from mxnet_tpu.serve import BlockManager, Scheduler
+
+    m = BlockManager(num_blocks=9, block_size=4)
+    s = Scheduler(m, max_batch=2, max_queue=1, clock=lambda: 0.0)
+    s.submit(mx.serve.Request(np.arange(1, 5), 4))
+    with pytest.raises(mx.serve.QueueFull):
+        s.submit(mx.serve.Request(np.arange(1, 5), 4))
+    assert s.rejections == 1
+    assert s.reject_reasons == {"queue_full": 1}
+
+
+# -- flight recorder ---------------------------------------------------------
+def test_flight_ring_bounded():
+    rec = flight.FlightRecorder(max_events=8, min_dump_interval_s=0)
+    for i in range(20):
+        rec.record("step", id=i)
+    events = rec.events()
+    assert len(events) == 8 and rec.seen == 20
+    assert [e["id"] for e in events] == list(range(12, 20))
+
+
+def test_flight_dump_atomic_and_rate_limited(tmp_path):
+    rec = flight.FlightRecorder(max_events=8, min_dump_interval_s=3600)
+    rec.record("error", site="x")
+    p1 = rec.dump("breach", dir=str(tmp_path))
+    p2 = rec.dump("breach", dir=str(tmp_path))       # rate-limited
+    p3 = rec.dump("breach", dir=str(tmp_path), force=True)
+    assert p1 and p2 is None and p3
+    payload = json.loads(open(p1).read())
+    assert payload["reason"] == "breach"
+    assert payload["events"][0]["kind"] == "error"
+    assert "registry" in payload and "statusz" in payload
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    # no dir configured -> automatic dumps are off
+    assert flight.FlightRecorder().dump("whatever") is None
+
+
+def test_flight_dump_on_engine_exception(model, tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    eng = _engine(model)
+    eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=4)
+
+    def boom(req):
+        raise RuntimeError("injected prefill failure")
+
+    monkeypatch.setattr(eng, "_run_prefill", boom)
+    with pytest.raises(RuntimeError, match="injected prefill failure"):
+        eng.step()
+    dumps = [f for f in os.listdir(tmp_path / "flight")
+             if f.endswith("engine_exception.json")]
+    assert len(dumps) == 1
+    payload = json.loads(open(tmp_path / "flight" / dumps[0]).read())
+    assert payload["reason"] == "engine_exception"
+    assert "injected prefill failure" in payload["extra"]["traceback"]
+    kinds = [e["kind"] for e in payload["events"]]
+    assert "request" in kinds and kinds[-1] == "error"
+    # ring events keep their wall-clock stamp even when a payload
+    # field could collide with the schema
+    assert all(e["t"] > 1e9 for e in payload["events"])
+    # a step() on a shut-down engine is a caller error, not an engine
+    # failure: no second post-mortem per retry
+    eng.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        eng.step()
+    assert len([f for f in os.listdir(tmp_path / "flight")
+                if f.endswith("engine_exception.json")]) == 1
+
+
+def test_flight_dump_on_deadline_miss(model, tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    t = {"now": 0.0}
+    eng = _engine(model, max_batch=1, clock=lambda: t["now"])
+    a = eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=8)
+    b = eng.submit(np.arange(1, 7, dtype=np.int32), max_new_tokens=4,
+                   deadline_s=0.5)
+    eng.step()                       # a admitted; b waits behind it
+    t["now"] = 1.0                   # b's deadline passes in the queue
+    eng.run()
+    assert a.status == "finished" and b.status == "rejected"
+    dumps = [f for f in os.listdir(tmp_path / "flight")
+             if f.endswith("deadline_miss.json")]
+    assert len(dumps) == 1
+    payload = json.loads(open(tmp_path / "flight" / dumps[0]).read())
+    assert payload["extra"]["rid"] == b.rid
+    eng.shutdown()
+
+
+def test_flight_dump_on_rejection_rate(model, tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    monkeypatch.setenv("MXTPU_FLIGHT_REJECT_RATE", "0.5")
+    eng = _engine(model, max_queue=1)
+    prompt = np.arange(1, 9, dtype=np.int32)
+    # 20 terminal outcomes, every second one a queue-full rejection
+    for _ in range(10):
+        req = eng.submit(prompt, max_new_tokens=1)
+        with pytest.raises(mx.serve.QueueFull):
+            eng.submit(prompt, max_new_tokens=1)
+        eng.run()
+        assert req.status == "finished"
+    dumps = [f for f in os.listdir(tmp_path / "flight")
+             if f.endswith("rejection_rate.json")]
+    assert len(dumps) == 1           # rate-limited: one, not ten
+    payload = json.loads(open(tmp_path / "flight" / dumps[0]).read())
+    assert payload["extra"]["rate"] >= 0.5
+    eng.shutdown()
+
+
+# -- /statusz ----------------------------------------------------------------
+def test_statusz_endpoint_live_state(tel, model):
+    import urllib.request
+
+    eng = _engine(model)
+    eng.submit(np.arange(1, 20, dtype=np.int32), max_new_tokens=30)
+    eng.submit(np.arange(1, 12, dtype=np.int32), max_new_tokens=30)
+    for _ in range(3):
+        eng.step()                   # mid-flight, nothing finished
+    server = telemetry.serve_http(telemetry.registry(), 0)
+    try:
+        port = server.server_address[1]
+        snap = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/statusz.json", timeout=10).read())
+        assert snap["process"]["pid"] == os.getpid()
+        assert snap["process"]["uptime_s"] >= 0
+        assert snap["jax"]["backend"] == "cpu"
+        assert snap["jax"]["device_count"] >= 1
+        engines = [v for k, v in snap.items()
+                   if k.startswith("serve.engine")]
+        assert len(engines) == 1
+        es = engines[0]
+        assert es["alive"] and es["running"] == 2
+        assert len(es["in_flight"]) == 2
+        for r in es["in_flight"]:
+            assert r["phase"] in ("queued", "prefill", "decode",
+                                  "preempted")
+            assert r["age_s"] is not None and r["generated"] >= 1
+        assert es["kv_blocks"]["in_use"] > 0
+        assert es["kv_blocks"]["total"] == 63
+        assert "aot" in es and "request_trace" in es
+        assert "train.fused_step" in snap
+        html = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/statusz", timeout=10).read().decode()
+        assert "mxtpu /statusz" in html and "serve.engine" in html
+    finally:
+        server.shutdown()
+    eng.shutdown()
+    # a shut-down engine drops off the page
+    assert not [k for k in statusz.snapshot()
+                if k.startswith("serve.engine")]
+
+
+def test_statusz_broken_provider_is_isolated():
+    def broken():
+        raise ValueError("provider exploded")
+
+    name = statusz.register("test.broken", broken)
+    try:
+        snap = statusz.snapshot()
+        assert "provider exploded" in snap["test.broken"]["error"]
+        assert "process" in snap     # the rest of the page survives
+    finally:
+        statusz.unregister(name)
+
+
+# -- Chrome-trace request tracks ---------------------------------------------
+def test_request_chrome_tracks(tel, model, tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_REQUEST_TRACE", "1")
+    monkeypatch.setenv("MXTPU_REQUEST_TRACE_FILE",
+                       str(tmp_path / "rt.jsonl"))
+    eng = _engine(model)
+    eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=4)
+    eng.submit(np.arange(1, 14, dtype=np.int32), max_new_tokens=4)
+    eng.run()
+    eng.shutdown()
+    events = tel.tracer().trace_events()
+    req_events = [e for e in events
+                  if e.get("cat") == "request" and e["ph"] == "X"]
+    assert req_events, "no request-track events emitted"
+    phases = {e["name"] for e in req_events}
+    assert {"queued", "prefill", "decode"} <= phases
+    # one tid per in-flight request, alongside (not inside) host spans
+    tids = {e["tid"] for e in req_events}
+    assert len(tids) == 2 and all(t >= 10_000 for t in tids)
+    for e in req_events:
+        assert "rid" in e["args"] and "trace_id" in e["args"]
+    tracks = {e["args"]["name"] for e in events
+              if e["name"] == "thread_name"}
+    assert any(t.startswith("serve-req-slot-") for t in tracks)
+    host = {e["name"] for e in events if e.get("cat") == "host"}
+    assert "serve.step" in host      # request tracks ride ALONGSIDE
+
+
+# -- numeric watchdog --------------------------------------------------------
+def test_numeric_watch_serve_logits(model, tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_NUMERIC_WATCH", "1")
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    telemetry.reset()
+    net, params = model
+    bad = {k: v.copy() for k, v in params.items()}
+    bad["gpt_l0_q_weight"][0, 0] = np.nan     # NaN propagates to logits
+    eng = mx.serve.Engine(bad, symbol=net, block_size=4, num_blocks=64,
+                          max_batch=4, max_model_len=64)
+    eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=3)
+    eng.run()
+    eng.shutdown()
+    snap = telemetry.registry().snapshot()
+    sites = {s["labels"]["site"]: s["value"]
+             for s in snap["mxtpu_numeric_anomalies_total"]["samples"]}
+    assert sites.get("prefill_logits", 0) >= 1
+    assert sites.get("decode_logits", 0) >= 1
+    dumps = [f for f in os.listdir(tmp_path / "flight")
+             if f.endswith("numeric_anomaly.json")]
+    assert len(dumps) == 1           # rate-limited
+    telemetry.reset()
+
+
+def test_numeric_watch_off_by_default(model):
+    eng = _engine(model)
+    assert eng._cfg.numeric_watch is False
+    eng.shutdown()
+
+
+def test_numeric_watch_fused_step(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_NUMERIC_WATCH", "1")
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    telemetry.reset()
+    from mxnet_tpu.io import NDArrayIter
+
+    X = np.full((16, 10), np.nan, np.float32)  # poisoned batch
+    y = np.zeros(16, np.float32)
+    it = NDArrayIter(X, y, batch_size=16)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=2)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(kvstore=None, optimizer="sgd")
+    it.reset()
+    batch = next(iter(it))
+    assert mod.train_step(batch) is True       # fused path selected
+    snap = telemetry.registry().snapshot()
+    sites = {s["labels"]["site"]: s["value"]
+             for s in snap["mxtpu_numeric_anomalies_total"]["samples"]}
+    assert sites.get("fused_step_loss", 0) >= 1
+    assert sites.get("fused_step_grad_norm", 0) >= 1
+    assert os.listdir(tmp_path / "flight")
+    telemetry.reset()
+
+
+# -- fused-step selection state (/statusz provider) --------------------------
+def test_fused_selection_state_records_verdicts(monkeypatch):
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.module import fused_step as fs
+
+    X = np.random.RandomState(0).randn(16, 10).astype(np.float32)
+    it = NDArrayIter(X, np.zeros(16, np.float32), batch_size=16)
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, name="fc1", num_hidden=2),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(kvstore=None, optimizer="sgd")
+    assert mod._select_fused() is not None
+    state = fs.selection_state()
+    assert state["recent"][-1] == pytest.approx(state["recent"][-1])
+    assert state["recent"][-1]["selected"] is True
+    assert state["recent"][-1]["reason"] == "eligible"
+    monkeypatch.setenv("MXTPU_FUSED_STEP", "0")
+    mod._fused = None
+    assert mod._select_fused() is None
+    state = fs.selection_state()
+    assert state["recent"][-1]["selected"] is False
+    assert state["recent"][-1]["reason"] == "env_disabled"
+    # repeats fold into a count instead of flooding the log
+    mod._select_fused()
+    state = fs.selection_state()
+    assert state["recent"][-1]["count"] >= 2
+
+
+# -- satellite: ServeMonitor reasons -----------------------------------------
+def test_serve_monitor_logs_rejection_reasons(caplog):
+    from mxnet_tpu.serve.stats import ServeStats
+
+    class _FakeEngine:
+        def __init__(self, **overrides):
+            base = dict(steps=5, queue_depth=3, running=2, completed=3,
+                        rejected=0, preemptions=0, evictions=0,
+                        tokens_generated=10, prompt_tokens=12,
+                        blocks_in_use=4, blocks_total=8,
+                        block_utilization=0.5, peak_block_utilization=0.5,
+                        ttft_ms_mean=None, ttft_ms_max=None,
+                        decode_tok_per_sec=None, total_tok_per_sec=None)
+            base.update(overrides)
+            self._stats = ServeStats(**base)
+
+        def stats(self):
+            return self._stats
+
+    logger = logging.getLogger("test_obs_monitor")
+    with caplog.at_level(logging.INFO, logger=logger.name):
+        mx.monitor.ServeMonitor(_FakeEngine(), interval=1,
+                                logger=logger).log_now()
+        mx.monitor.ServeMonitor(
+            _FakeEngine(rejected=3, reject_reasons={"queue_full": 1,
+                                                    "deadline": 2}),
+            interval=1, logger=logger).log_now()
+    first, second = caplog.messages[:2]
+    assert "queue=3" in first and "rej=0[-]" in first
+    assert "rej=3[deadline=2,queue_full=1]" in second
+
+
+# -- satellite: env-var docs drift gate --------------------------------------
+def test_env_docs_complete():
+    """Every MXTPU_* var read under mxnet_tpu/ or tools/ has a row in
+    docs/env_vars.md (tools/check_env_docs.py is the standalone form)."""
+    import check_env_docs
+
+    missing, documented = check_env_docs.check(REPO)
+    assert not missing, f"undocumented MXTPU_* vars: {missing}"
+    assert len(documented) >= 30
+
+
+def test_env_docs_detects_drift(tmp_path):
+    import check_env_docs
+
+    (tmp_path / "mxnet_tpu").mkdir()
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "mxnet_tpu" / "x.py").write_text(
+        'import os\nA = os.environ.get("MXTPU_DOCUMENTED_VAR")\n'
+        'B = os.environ.get("MXTPU_BRAND_NEW_KNOB")\n')
+    (tmp_path / "docs" / "env_vars.md").write_text(
+        "| `MXTPU_DOCUMENTED_VAR` | unset | fine |\n")
+    missing, _ = check_env_docs.check(str(tmp_path))
+    assert list(missing) == ["MXTPU_BRAND_NEW_KNOB"]
+    assert missing["MXTPU_BRAND_NEW_KNOB"] == [
+        os.path.join("mxnet_tpu", "x.py") + ":3"]
+    assert check_env_docs.main(["--repo", str(tmp_path)]) == 1

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Fail when an ``MXTPU_*`` env var read in code is missing from
+docs/env_vars.md.
+
+The env-var table is the framework's runtime-config contract, and it
+drifts: a feature lands reading a new knob, the table doesn't hear
+about it, and six months later nobody knows the knob exists.  This tool
+pins the invariant the other way around — every ``MXTPU_*`` name that
+appears in ``mxnet_tpu/`` or ``tools/`` sources must have a row (any
+mention) in docs/env_vars.md.  Documented-but-unread names are fine
+(some vars are *set* for subprocesses rather than read, e.g. the
+launcher's coordination vars).
+
+Runs as a tier-1 test (tests/test_observability.py) and standalone:
+
+  python tools/check_env_docs.py [--repo PATH]   # exit 1 on drift
+"""
+
+import argparse
+import os
+import re
+import sys
+
+VAR_RE = re.compile(r"\bMXTPU_[A-Z0-9]+(?:_[A-Z0-9]+)*\b")
+
+# scanned source roots, relative to the repo
+CODE_ROOTS = ("mxnet_tpu", "tools")
+DOC = os.path.join("docs", "env_vars.md")
+
+
+def code_vars(repo):
+    """{var: [file:line, ...]} for every MXTPU_* mention in sources."""
+    found = {}
+    for root in CODE_ROOTS:
+        base = os.path.join(repo, root)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                try:
+                    with open(path, encoding="utf-8", errors="replace") as f:
+                        for i, line in enumerate(f, 1):
+                            for var in VAR_RE.findall(line):
+                                rel = os.path.relpath(path, repo)
+                                found.setdefault(var, []).append(
+                                    f"{rel}:{i}")
+                except OSError:
+                    continue
+    return found
+
+
+def doc_vars(repo):
+    path = os.path.join(repo, DOC)
+    with open(path, encoding="utf-8") as f:
+        return set(VAR_RE.findall(f.read()))
+
+
+def check(repo):
+    """(missing: {var: [sites]}, documented: set) — missing is the
+    drift this tool exists to catch."""
+    code = code_vars(repo)
+    docs = doc_vars(repo)
+    missing = {v: sites for v, sites in sorted(code.items())
+               if v not in docs}
+    return missing, docs
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="detect MXTPU_* env vars missing from docs/env_vars.md")
+    p.add_argument("--repo", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    args = p.parse_args(argv)
+    missing, docs = check(args.repo)
+    if not missing:
+        print(f"env docs OK: {len(docs)} MXTPU_* vars documented, "
+              "none missing")
+        return 0
+    print(f"{len(missing)} MXTPU_* var(s) read in code but missing from "
+          f"{DOC}:", file=sys.stderr)
+    for var, sites in missing.items():
+        shown = ", ".join(sites[:3])
+        more = f" (+{len(sites) - 3} more)" if len(sites) > 3 else ""
+        print(f"  {var}: {shown}{more}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

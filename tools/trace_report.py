@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Reconstruct per-request latency breakdowns from a request-trace JSONL
+file (``MXTPU_REQUEST_TRACE=1`` — docs/how_to/observability.md).
+
+Each input line is one request's COMPLETE event timeline (written at its
+terminal state by ``mxnet_tpu/telemetry/request_trace.py``).  This tool
+folds every timeline into per-phase durations —
+
+  queue      submitted -> first prefill_start (admission + queue wait)
+  prefill    sum of prefill_start -> prefill_end (incl. resume prefills)
+  preempted  sum of preempted -> the resume's prefill_start
+  decode     everything else up to the terminal event (residual)
+
+— then prints per-phase p50/p90/p99 percentiles, terminal-status and
+reject/preempt-reason counts, and a completeness audit (a timeline that
+does not run submitted -> terminal is reported as broken; orphan events
+make the run exit non-zero under ``--check``).
+
+Pure stdlib — usable on a laptop against a file scp'd from production.
+
+Usage:
+  python tools/trace_report.py TRACE.jsonl [--json OUT] [--check]
+      [--top N]   # also show the N slowest requests end-to-end
+"""
+
+import argparse
+import json
+import sys
+
+PHASES = ("queue", "prefill", "decode", "preempted")
+TERMINAL = ("finished", "rejected", "cancelled")
+
+
+# -- per-request folding -----------------------------------------------------
+def phase_breakdown(events):
+    """Fold one ordered event timeline into phase durations (seconds).
+
+    Returns ``(phases, status, reason, complete)`` where ``phases`` is a
+    dict over :data:`PHASES` plus ``total``, and ``complete`` means the
+    timeline runs submitted -> terminal with sane ordering.
+
+    Applies the same boundary rules as the Chrome-track emitter
+    (``mxnet_tpu/telemetry/request_trace.py::_phases``) without
+    importing the package (this tool must stay stdlib-only);
+    tests/test_observability.py pins the two to agree."""
+    out = {p: 0.0 for p in PHASES}
+    if not events:
+        return out, None, None, False
+    names = [e.get("ev") for e in events]
+    status = names[-1] if names[-1] in TERMINAL else None
+    complete = names[0] == "submitted" and status is not None
+    t0 = events[0].get("t", 0.0)
+    t_end = events[-1].get("t", t0)
+    out["total"] = max(0.0, t_end - t0)
+
+    first_prefill = None
+    prefill_open = None
+    preempt_open = None
+    reason = None
+    for ev in events:
+        name, t = ev.get("ev"), ev.get("t", 0.0)
+        if name == "prefill_start":
+            if first_prefill is None:
+                first_prefill = t
+            if preempt_open is not None:
+                out["preempted"] += max(0.0, t - preempt_open)
+                preempt_open = None
+            prefill_open = t
+        elif name == "prefill_end" and prefill_open is not None:
+            out["prefill"] += max(0.0, t - prefill_open)
+            prefill_open = None
+        elif name == "preempted":
+            preempt_open = t
+        elif name == "rejected":
+            reason = ev.get("reason")
+    if preempt_open is not None:       # preempted, never resumed
+        out["preempted"] += max(0.0, t_end - preempt_open)
+    out["queue"] = max(0.0, (first_prefill if first_prefill is not None
+                             else t_end) - t0)
+    out["decode"] = max(0.0, out["total"] - out["queue"] - out["prefill"]
+                        - out["preempted"])
+    return out, status, reason, complete
+
+
+def load_traces(path):
+    """[(record, phases, status, reason, complete)] per JSONL line."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            phases, status, reason, complete = phase_breakdown(
+                rec.get("events", []))
+            out.append((rec, phases, status, reason, complete))
+    return out
+
+
+# -- aggregation -------------------------------------------------------------
+def percentile(sorted_vals, q):
+    """Nearest-rank percentile of an ascending list."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[int(idx)]
+
+
+def aggregate(traces):
+    phases = {p: [] for p in PHASES + ("total",)}
+    statuses, reasons, broken = {}, {}, []
+    preemptions = 0
+    for rec, ph, status, reason, complete in traces:
+        if not complete:
+            broken.append(rec.get("trace_id") or rec.get("rid"))
+            continue
+        for p in phases:
+            phases[p].append(ph[p])
+        statuses[status] = statuses.get(status, 0) + 1
+        if reason:
+            reasons[reason] = reasons.get(reason, 0) + 1
+        preemptions += int(rec.get("n_preemptions", 0))
+    summary = {"requests": len(traces), "complete": len(traces) - len(broken),
+               "broken": broken, "statuses": statuses,
+               "reject_reasons": reasons, "preemptions": preemptions,
+               "phases": {}}
+    for p, vals in phases.items():
+        vals.sort()
+        summary["phases"][p] = {
+            "count": len(vals),
+            "mean_ms": (round(sum(vals) / len(vals) * 1e3, 3)
+                        if vals else None),
+            "p50_ms": _ms(percentile(vals, 0.50)),
+            "p90_ms": _ms(percentile(vals, 0.90)),
+            "p99_ms": _ms(percentile(vals, 0.99)),
+            "max_ms": _ms(vals[-1] if vals else None)}
+    return summary
+
+
+def _ms(v):
+    return None if v is None else round(v * 1e3, 3)
+
+
+def _fmt(v):
+    return "-" if v is None else f"{v:.3f}"
+
+
+def render(summary, traces, top=0):
+    lines = [f"requests: {summary['requests']} "
+             f"(complete {summary['complete']}, "
+             f"broken {len(summary['broken'])})",
+             "statuses: " + (", ".join(
+                 f"{k}={v}" for k, v in sorted(summary["statuses"].items()))
+                 or "-"),
+             "reject reasons: " + (", ".join(
+                 f"{k}={v}"
+                 for k, v in sorted(summary["reject_reasons"].items()))
+                 or "-"),
+             f"preemptions: {summary['preemptions']}", "",
+             f"{'PHASE':<10} {'COUNT':>6} {'MEAN_MS':>9} {'P50_MS':>9} "
+             f"{'P90_MS':>9} {'P99_MS':>9} {'MAX_MS':>9}"]
+    for p in ("queue", "prefill", "decode", "preempted", "total"):
+        s = summary["phases"][p]
+        lines.append(f"{p:<10} {s['count']:>6} {_fmt(s['mean_ms']):>9} "
+                     f"{_fmt(s['p50_ms']):>9} {_fmt(s['p90_ms']):>9} "
+                     f"{_fmt(s['p99_ms']):>9} {_fmt(s['max_ms']):>9}")
+    if top:
+        slowest = sorted((t for t in traces if t[4]),
+                         key=lambda t: -t[1]["total"])[:top]
+        lines += ["", f"slowest {len(slowest)} requests:"]
+        for rec, ph, status, reason, _ in slowest:
+            lines.append(
+                f"  {rec.get('trace_id')}: total={ph['total'] * 1e3:.1f}ms "
+                f"queue={ph['queue'] * 1e3:.1f} "
+                f"prefill={ph['prefill'] * 1e3:.1f} "
+                f"decode={ph['decode'] * 1e3:.1f} "
+                f"preempted={ph['preempted'] * 1e3:.1f} "
+                f"[{status}{'/' + reason if reason else ''}"
+                f" gen={rec.get('generated')}"
+                f" preempt={rec.get('n_preemptions')}]")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="per-request latency breakdown from a request trace")
+    p.add_argument("path", help="request_trace.jsonl")
+    p.add_argument("--json", default=None,
+                   help="also write the summary as JSON")
+    p.add_argument("--top", type=int, default=5,
+                   help="show the N slowest requests (0 to hide)")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 when any timeline is incomplete")
+    args = p.parse_args(argv)
+    traces = load_traces(args.path)
+    summary = aggregate(traces)
+    print(render(summary, traces, args.top))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=2)
+    if args.check and summary["broken"]:
+        print(f"BROKEN timelines: {summary['broken']}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
