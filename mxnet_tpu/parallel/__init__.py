@@ -8,6 +8,8 @@ the all-reduce bandwidth benchmark harness.
 
 from .mesh import Mesh, NamedSharding, PartitionSpec, make_mesh, \
     make_hybrid_mesh, local_mesh, replicated, shard_along
+from .partition import match_partition_rules, gpt_partition_rules, \
+    parse_rules, rules_digest, named_shardings
 from .collectives import allreduce, allreduce_bench, psum, all_gather, \
     reduce_scatter, ppermute
 from .trainer import ShardedTrainer, sgd_opt, adam_opt, adamw_opt
@@ -18,7 +20,10 @@ from .pipeline import pipeline_apply, PipelineModule
 from .moe import moe_apply, moe_reference, MoELayer, init_moe_params
 
 __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "make_mesh", "make_hybrid_mesh", "local_mesh",
-           "replicated", "shard_along", "allreduce", "allreduce_bench", "psum",
+           "replicated", "shard_along",
+           "match_partition_rules", "gpt_partition_rules", "parse_rules",
+           "rules_digest", "named_shardings",
+           "allreduce", "allreduce_bench", "psum",
            "all_gather", "reduce_scatter", "ppermute", "ShardedTrainer",
            "sgd_opt", "adam_opt", "adamw_opt",
            "save_sharded", "load_sharded", "ring_attention",

@@ -337,18 +337,16 @@ class ShardedTrainer:
 
         # -- initialize params on host, then place with shardings ----------
         initializer = initializer or Uniform(0.07)
-        import re
 
         fsdp_dp = mesh.shape.get(batch_axis, 1) if fsdp else 1
 
-        def fsdp_spec(name):
+        def fsdp_spec(name, shape):
             """FSDP / ZeRO-3: STORE the param sharded over the data axis
             (largest dp-divisible dim); XLA all-gathers it where a layer
             consumes it and reduce-scatters its gradient — per-device
             param+grad+state memory drops by the dp degree.  Small
             params (< fsdp_min_size elements) stay replicated: their
             all-gather latency outweighs the bytes saved."""
-            shape = name2shape[name]
             size = int(np.prod(shape)) if shape else 0
             if fsdp_dp <= 1 or size < fsdp_min_size:
                 return PartitionSpec()
@@ -360,13 +358,18 @@ class ShardedTrainer:
             spec[dim] = batch_axis
             return PartitionSpec(*spec)
 
-        def spec_for(name):
-            for pat, spec in (param_specs or {}).items():
-                if pat == name or re.fullmatch(pat, name):
-                    return spec
-            return fsdp_spec(name)
+        # param_specs resolve through the shared regex-rule partitioner
+        # (parallel/partition.py — the same matcher serve.Engine shards
+        # with); dict order is rule priority, mode="full" keeps the
+        # historical exact-name-or-fullmatch key contract, and the FSDP
+        # heuristic remains the fallback for unmatched params
+        from .partition import match_partition_rules
 
-        self.param_shardings = {n: NamedSharding(mesh, spec_for(n))
+        param_spec_tree = match_partition_rules(
+            (param_specs or {}).items(),
+            {n: name2shape[n] for n in self.param_names},
+            default=fsdp_spec, mode="full")
+        self.param_shardings = {n: NamedSharding(mesh, param_spec_tree[n])
                                 for n in self.param_names}
         self._replicated = NamedSharding(mesh, PartitionSpec())
 

@@ -12,7 +12,11 @@ serving stack on top of the same checkpoints:
   recomputation under cache pressure, per-request deadlines with
   graceful rejection instead of OOM.
 - ``engine`` — the public ``serve.Engine``: ``submit() -> Request``,
-  ``stream()``, ``step()``, ``shutdown()``, bucketed jit programs.
+  ``stream()``, ``step()``, ``shutdown()``, bucketed jit programs;
+  ``tp=N`` (env ``MXTPU_SERVE_TP``) runs the same programs
+  tensor-parallel over a ``{'tp': N}`` mesh with regex-rule parameter
+  sharding (``parallel.partition``) and a head-sharded KV-cache
+  (docs/how_to/serve.md "Tensor-parallel sharded serving").
 - ``stats`` — ``ServeStats`` snapshots (queue depth, TTFT, tokens/sec,
   block utilization, preemption/eviction counters, rejection reasons);
   pair with ``mxnet_tpu.monitor.ServeMonitor`` for periodic logging.

@@ -25,6 +25,15 @@ blocks by ``kv_block_manager.BlockManager``; decode attends through
 ``ops.attention.paged_attention``.  Cache-pressure policy lives in
 ``scheduler.Scheduler`` (preemption + back-pressure), never here —
 the engine only executes the schedule it is handed.
+
+With ``tp=N`` (env ``MXTPU_SERVE_TP``) the same programs run GSPMD-
+partitioned over a ``{'tp': N}`` mesh: parameters shard per the
+regex partition rules (``parallel.partition``, Megatron/TP layout —
+two all-reduces per layer), the KV-cache shards on its head axis so
+every chip holds ``kv_heads/N`` of every block, and the exported AOT
+artifacts key on the sharding (tp degree + rule digest enter the
+fingerprint).  Block accounting, scheduling and the public API are
+identical at every tp.
 """
 
 from __future__ import annotations
@@ -47,6 +56,8 @@ from ..base import env_flag
 from ..models.generate import (_fc, _gelu, _ln, detect_gpt_variant,
                                normalize_gpt_params,
                                reconcile_decode_config)
+from ..parallel import partition as partition_mod
+from ..parallel.mesh import NamedSharding, PartitionSpec, make_mesh
 from ..ops.attention import paged_attention
 from ..telemetry import flight as flight_mod
 from ..telemetry import statusz as statusz_mod
@@ -74,6 +85,16 @@ _ModelCfg = collections.namedtuple("_ModelCfg", [
     "name", "n_layers", "num_heads", "head_dim", "kv_heads",
     "pos_table", "swiglu", "tied", "rmsnorm", "window", "block_size",
     "temperature", "top_k", "numeric_watch"])
+
+# per-engine GSPMD placement bundle for tensor-parallel serving (None
+# on the single-device path): the tp mesh, the per-parameter
+# NamedShardings resolved from the partition rules, the head-sharded
+# KV-cache sharding, and the replicated sharding for tokens/positions/
+# tables/rng.  Passed to the program builders — like _ModelCfg it holds
+# no Engine reference, so _STEP_CACHE still cannot retain a retired
+# engine's parameter dict.
+_Shardings = collections.namedtuple("_Shardings",
+                                    ["mesh", "params", "cache", "rep"])
 
 
 def _next_bucket(n, cap):
@@ -130,13 +151,28 @@ class Engine:
         load them instead of re-tracing; ``warmup()`` replays a traffic
         manifest (env ``MXTPU_WARMUP_MANIFEST`` records one) so every
         program is ready before the first request.
+      tp: tensor-parallel degree (env ``MXTPU_SERVE_TP``, default 1).
+        ``tp > 1`` builds a ``{'tp': tp}`` device mesh, shards the
+        parameter dict per the partition rules (attention heads and
+        MLP hidden split across chips, GSPMD inserting two all-reduces
+        per layer) and head-shards the paged KV-cache, so each chip
+        holds ``kv_heads/tp`` of every block — per-chip KV bytes drop
+        by ``tp`` and a model larger than one chip's HBM serves at
+        all.  ``num_heads`` and ``kv_heads`` must divide by ``tp``.
+      partition_rules: tensor-parallel sharding rules — a list of
+        ``(regex, PartitionSpec)`` pairs, or a string in the
+        ``MXTPU_SERVE_PARTITION_RULES`` syntax
+        (``parallel.partition.parse_rules``).  Default: the env var,
+        else ``parallel.partition.gpt_partition_rules`` keyed to this
+        checkpoint's naming.  Ignored at ``tp=1``.
     """
 
     def __init__(self, params, num_heads=None, window=None, symbol=None,
                  name="gpt", block_size=None, num_blocks=None,
                  max_batch=None, max_queue=None, max_model_len=None,
                  max_prefills_per_step=1, temperature=0.0, top_k=None,
-                 seed=0, clock=time.monotonic, aot_dir=None):
+                 seed=0, clock=time.monotonic, aot_dir=None, tp=None,
+                 partition_rules=None):
         if symbol is not None:
             num_heads, window = reconcile_decode_config(symbol, num_heads,
                                                         window)
@@ -166,6 +202,48 @@ class Engine:
         self.window = window
         self.temperature = float(temperature)
         self.top_k = top_k
+        # -- tensor-parallel mesh + partition rules ------------------------
+        self.tp = int(tp) if tp is not None else _env("MXTPU_SERVE_TP", 1)
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1 (got {self.tp})")
+        self.mesh = None
+        self._shardings = None
+        self._rules = None
+        self._rules_digest = None
+        if self.tp > 1:
+            if self.tp > jax.device_count():
+                raise ValueError(
+                    f"tp={self.tp} exceeds the {jax.device_count()} "
+                    f"visible {jax.default_backend()} devices")
+            if self.num_heads % self.tp or self.spec["kv_heads"] % self.tp:
+                raise ValueError(
+                    f"tp={self.tp} must divide num_heads="
+                    f"{self.num_heads} and kv_heads="
+                    f"{self.spec['kv_heads']} (head-sharded attention "
+                    "and KV-cache)")
+            if partition_rules is None:
+                partition_rules = os.environ.get(
+                    "MXTPU_SERVE_PARTITION_RULES") or None
+            if isinstance(partition_rules, str):
+                self._rules = partition_mod.parse_rules(partition_rules)
+            elif partition_rules is not None:
+                self._rules = list(partition_rules)
+            if not self._rules:
+                self._rules = partition_mod.gpt_partition_rules(
+                    name=name, axis="tp")
+            self._rules_digest = partition_mod.rules_digest(self._rules)
+            self.mesh = make_mesh({"tp": self.tp})
+            specs = partition_mod.match_partition_rules(self._rules, params)
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            self._shardings = _Shardings(
+                mesh=self.mesh,
+                params=partition_mod.named_shardings(self.mesh, specs),
+                # each chip holds kv_heads/tp of EVERY block: block
+                # accounting (BlockManager) is unchanged, per-chip KV
+                # bytes drop by tp
+                cache=NamedSharding(self.mesh, PartitionSpec(
+                    None, None, None, "tp", None)),
+                rep=rep)
         cache_tokens = (self.num_blocks - 1) * self.block_size
         if max_model_len is None:
             # learned positions cap the servable length at the table;
@@ -208,13 +286,40 @@ class Engine:
             self._reject_rate_thr = 0.0
         self._numeric_watch = env_flag("MXTPU_NUMERIC_WATCH", False)
 
-        self.params = {k: jnp.asarray(v) for k, v in params.items()}
+        # place weights (sharded per the rules when tp > 1) and track
+        # which device arrays THIS engine materialized: shutdown()
+        # deletes exactly those, deterministically, without ever
+        # invalidating caller-owned jax arrays that passed through
+        self._owned = []
+        placed = {}
+        for k, v in params.items():
+            if self._shardings is not None:
+                # device_put straight from the source array: each chip
+                # receives only its shard — no transient full-size copy
+                # on device 0 (which could OOM exactly the models tp
+                # exists to serve)
+                arr = jax.device_put(v, self._shardings.params[k])
+            else:
+                arr = jnp.asarray(v)
+            if arr is not v:
+                self._owned.append(arr)
+            placed[k] = arr
+        self.params = placed
         dt = self.params[f"{name}_tok_embed_weight"].dtype
         L = self.spec["n_layers"]
         shape = (L, self.num_blocks, self.block_size,
                  self.spec["kv_heads"], self.spec["head_dim"])
-        self._cache_k = jnp.zeros(shape, dt)
-        self._cache_v = jnp.zeros(shape, dt)
+        if self._shardings is not None:
+            # allocate the cache BORN sharded: a jnp.zeros-then-reshard
+            # would transiently hold the whole cache on device 0, which
+            # OOMs exactly the aggregate-HBM-sized configs tp unlocks
+            zeros = jax.jit(lambda: jnp.zeros(shape, dt),
+                            out_shardings=self._shardings.cache)
+            self._cache_k = zeros()
+            self._cache_v = zeros()
+        else:
+            self._cache_k = jnp.zeros(shape, dt)
+            self._cache_v = jnp.zeros(shape, dt)
         self._key = jax.random.PRNGKey(seed)
         # donating the cache through each step avoids a full cache copy
         # per token; CPU PJRT can't donate (it would warn every call)
@@ -262,18 +367,29 @@ class Engine:
     # -- static config key for the shared program cache ----------------------
     def _spec_key(self):
         # _ModelCfg pins the math; the extras pin the traced SHAPES
-        # (cache geometry + dtype) and the donation policy
+        # (cache geometry + dtype), the donation policy, and the
+        # sharding layout (tp degree + partition-rule digest) — a tp=2
+        # program must never be served to a tp=4 engine
         return (self._cfg, self.num_blocks, self.table_width,
-                str(self._cache_k.dtype), self._donate)
+                str(self._cache_k.dtype), self._donate, self.tp,
+                self._rules_digest)
 
     def _aot_base_fp(self):
         """The on-disk form of _spec_key(): same fields, JSON-stable,
         plus jax version + backend (aot.fingerprint), so an artifact
         from an incompatible process can never be loaded."""
+        # sharding fields enter the fingerprint ONLY at tp > 1: a tp=1
+        # engine's digest is unchanged from pre-sharding releases, so
+        # an upgraded fleet keeps loading its existing artifacts and
+        # manifests instead of silently cold-compiling once per upgrade
+        sharded = ({} if self.tp == 1 else dict(
+            tp=self.tp, mesh_shape=dict(self.mesh.shape),
+            partition_rules=self._rules_digest))
         return aot_store.fingerprint(
             subsystem="serve", cfg=self._cfg._asdict(),
             num_blocks=self.num_blocks, table_width=self.table_width,
-            cache_dtype=str(self._cache_k.dtype), donate=self._donate)
+            cache_dtype=str(self._cache_k.dtype), donate=self._donate,
+            **sharded)
 
     # -- public API ----------------------------------------------------------
     def submit(self, prompt, max_new_tokens=64, deadline_s=None):
@@ -314,8 +430,13 @@ class Engine:
             rec = flight_mod.recorder()
             rec.record("error", site="engine.step",
                        error=traceback.format_exc(limit=4))
+            # spec/sharding digests identify WHICH compiled (possibly
+            # sharded) program was live when the process died
             rec.dump("engine_exception", force=True,
-                     extra={"traceback": traceback.format_exc(limit=30)})
+                     extra={"traceback": traceback.format_exc(limit=30),
+                            "spec_digest": self._spec_digest,
+                            "tp": self.tp,
+                            "sharding_rules_digest": self._rules_digest})
             raise
 
     def _step_inner(self):
@@ -436,10 +557,9 @@ class Engine:
             "completed": self._stats.completed,
             "preemptions": self.scheduler.preemptions,
             "reject_reasons": dict(self.scheduler.reject_reasons),
-            "kv_blocks": {"in_use": self.blocks.blocks_in_use,
-                          "total": self.blocks.total_blocks,
-                          "utilization": round(self.blocks.utilization(), 4),
-                          "evictions": self.blocks.evictions},
+            "kv_blocks": self.blocks.occupancy(),
+            "kv_cache": self.kv_cache_stats(),
+            "sharding": self.sharding_info(),
             "max_batch": self.max_batch,
             "max_model_len": self.max_model_len,
             "programs_recorded": len(self._manifest.entries()),
@@ -452,8 +572,49 @@ class Engine:
             "aot": aot,
         }
 
+    def sharding_info(self):
+        """Live sharding layout: tp degree, mesh shape/devices, rule
+        digest, and per-device HBM-resident parameter bytes — the
+        /statusz "where do the bytes live" section (replicated arrays
+        count once per device, which is exactly their real footprint)."""
+        info = {"tp": self.tp,
+                "rules_digest": self._rules_digest,
+                "spec_digest": self._spec_digest}
+        if self.mesh is not None:
+            info["mesh"] = {
+                "axes": {k: int(v) for k, v in self.mesh.shape.items()},
+                "devices": [int(d.id) for d in self.mesh.devices.flat]}
+        if self.params:
+            info["params_bytes_per_device"] = statusz_mod.bytes_by_device(
+                self.params.values())
+        return info
+
+    def kv_cache_stats(self):
+        """KV-cache memory accounting, global and per chip.  Block
+        ACCOUNTING never changes with tp — each chip holds
+        ``kv_heads/tp`` of every block, so per-chip bytes (total and
+        in-use) drop by the tp degree and the same per-chip HBM budget
+        funds ``tp``x the blocks."""
+        if self._cache_k is None:
+            return None
+        total = 2 * int(self._cache_k.nbytes)          # K and V
+        per_dev = total // self.tp
+        per_block = per_dev // self.num_blocks
+        return {"bytes_total": total,
+                "bytes_per_device": per_dev,
+                "bytes_per_block_per_device": per_block,
+                "bytes_in_use_per_device":
+                    per_block * self.blocks.blocks_in_use}
+
     def shutdown(self):
-        """Cancel in-flight work and release the device cache."""
+        """Cancel in-flight work and release the device cache.
+
+        Device buffers this engine materialized — sharded or
+        replicated parameter placements and the KV cache — are deleted
+        explicitly (not left to GC), so constructing engines
+        back-to-back in one process can never transiently hold two
+        models' HBM.  Arrays the caller passed in that were adopted
+        as-is are never touched."""
         if not self._alive:
             return
         for req in list(self.scheduler.running):
@@ -465,6 +626,12 @@ class Engine:
         self.scheduler.waiting = []
         self._rtrace.close()
         statusz_mod.unregister(self._statusz_name)
+        for arr in self._owned + [self._cache_k, self._cache_v]:
+            try:
+                arr.delete()
+            except Exception:
+                pass              # already donated-away or deleted
+        self._owned = []
         self._cache_k = self._cache_v = None
         self.params = None            # free the device-resident weights
         self._alive = False
@@ -643,11 +810,24 @@ class Engine:
 
     def _program_specs(self, kind, bucket):
         """ShapeDtypeStructs matching exactly what _run_prefill /
-        _run_decode pass — the export/AOT-compile signature."""
-        sds = jax.ShapeDtypeStruct
+        _run_decode pass — the export/AOT-compile signature.  Under
+        tensor parallelism each spec carries its NamedSharding: that is
+        what lets ``.lower(specs).compile()`` AOT-compile the sharded
+        program (and export/reload it) without example arrays."""
         i32 = jnp.dtype(jnp.int32)
-        pspec = {k: sds(v.shape, v.dtype) for k, v in self.params.items()}
-        cspec = sds(self._cache_k.shape, self._cache_k.dtype)
+        sh = self._shardings
+
+        def sds(shape, dtype, sharding=None):
+            if sh is None:
+                return jax.ShapeDtypeStruct(shape, dtype)
+            return jax.ShapeDtypeStruct(shape, dtype,
+                                        sharding=sharding or sh.rep)
+
+        pspec = {k: sds(v.shape, v.dtype,
+                        sh.params[k] if sh is not None else None)
+                 for k, v in self.params.items()}
+        cspec = sds(self._cache_k.shape, self._cache_k.dtype,
+                    sh.cache if sh is not None else None)
         kspec = sds(self._key.shape, self._key.dtype)
         if kind == "decode":
             return (pspec, cspec, cspec, sds((bucket,), i32),
@@ -674,8 +854,10 @@ class Engine:
                 "mxtpu_aot_programs_total", "bucket-program resolutions",
                 ("kind", "source")).labels(kind=kind, source="trace").inc()
             if kind == "decode":
-                return _build_decode(self._cfg, self._donate)
-            return _build_prefill(self._cfg, bucket, self._donate)
+                return _build_decode(self._cfg, self._donate,
+                                     self._shardings)
+            return _build_prefill(self._cfg, bucket, self._donate,
+                                  self._shardings)
 
         def compiled(jitted):
             try:
@@ -787,7 +969,26 @@ def _forward_token_batch(cfg, params, ck, cv, toks, pos, tables):
     return _logits(cfg, params, x), ck, cv
 
 
-def _build_decode(cfg, donate):
+def _jit_kwargs(cfg, donate, shardings, n_token_args):
+    """Shared jit options for the bucket programs.  With a tp mesh the
+    in/out shardings are pinned explicitly — params per the partition
+    rules, KV-cache head-sharded, everything host-fed replicated — so
+    GSPMD partitions the program (inserting the two all-reduces per
+    layer) instead of inferring a layout per call site."""
+    kw = {"donate_argnums": (1, 2) if donate else ()}
+    if shardings is not None:
+        rep = shardings.rep
+        cache = shardings.cache
+        kw["in_shardings"] = ((shardings.params, cache, cache)
+                              + (rep,) * n_token_args + (rep,))
+        out = (rep, cache, cache)
+        if cfg.numeric_watch:
+            out = (rep, rep, cache, cache)
+        kw["out_shardings"] = out
+    return kw
+
+
+def _build_decode(cfg, donate, shardings=None):
     def decode(params, ck, cv, toks, pos, tables, rng):
         logits, ck, cv = _forward_token_batch(cfg, params, ck, cv,
                                               toks, pos, tables)
@@ -800,10 +1001,10 @@ def _build_decode(cfg, donate):
             return tok, jnp.isfinite(logits).all(), ck, cv
         return tok, ck, cv
 
-    return jax.jit(decode, donate_argnums=(1, 2) if donate else ())
+    return jax.jit(decode, **_jit_kwargs(cfg, donate, shardings, 3))
 
 
-def _build_prefill(cfg, P, donate):
+def _build_prefill(cfg, P, donate, shardings=None):
     name = cfg.name
     Hq, Hkv, Dh = cfg.num_heads, cfg.kv_heads, cfg.head_dim
     group = Hq // Hkv
@@ -857,4 +1058,4 @@ def _build_prefill(cfg, P, donate):
             return tok, jnp.isfinite(logits).all(), ck, cv
         return tok, ck, cv
 
-    return jax.jit(prefill, donate_argnums=(1, 2) if donate else ())
+    return jax.jit(prefill, **_jit_kwargs(cfg, donate, shardings, 4))

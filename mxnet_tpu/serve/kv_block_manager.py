@@ -72,8 +72,26 @@ class BlockManager:
         """Immediately or lazily reclaimable blocks."""
         return len(self._free) + sum(len(b) for b in self._retained.values())
 
+    @property
+    def retained_blocks(self):
+        """Blocks parked in the LRU tier (reclaimable, K/V intact)."""
+        return sum(len(b) for b in self._retained.values())
+
     def utilization(self):
         return self.blocks_in_use / max(1, self.total_blocks)
+
+    def occupancy(self):
+        """One JSON-ready snapshot of the block accounting — the
+        /statusz and flight-dump occupancy section.  Counts are BLOCK
+        counts and identical at every tensor-parallel degree; byte
+        translation per chip lives with the cache owner
+        (``Engine.kv_cache_stats``), which knows the sharding."""
+        return {"in_use": self.blocks_in_use,
+                "retained": self.retained_blocks,
+                "free": len(self._free),
+                "total": self.total_blocks,
+                "utilization": round(self.utilization(), 4),
+                "evictions": self.evictions}
 
     def can_allocate(self, n_tokens):
         return blocks_for(n_tokens, self.block_size) <= self.free_blocks

@@ -28,7 +28,7 @@ import threading
 import time
 
 __all__ = ["register", "register_weak", "unregister", "snapshot",
-           "render_html"]
+           "render_html", "bytes_by_device"]
 
 _lock = threading.Lock()
 _providers = {}                  # name -> zero-arg callable
@@ -65,6 +65,31 @@ def register_weak(obj, name, method="statusz"):
 def unregister(name):
     with _lock:
         _providers.pop(name, None)
+
+
+def bytes_by_device(arrays):
+    """Per-device HBM-resident bytes for a collection of jax arrays:
+    ``{device_id: bytes}`` summed over each array's addressable
+    shards.  Sharded arrays count each shard where it lives; a
+    replicated array counts once per device — exactly its real
+    footprint.  Non-jax leaves (numpy, None) are skipped, so callers
+    can pass a mixed parameter dict's values directly."""
+    out = {}
+    for arr in arrays:
+        shards = getattr(arr, "addressable_shards", None)
+        if shards is None:
+            continue
+        try:
+            for shard in shards:
+                dev = getattr(shard.device, "id", None)
+                if dev is None:
+                    continue
+                data = shard.data
+                out[int(dev)] = (out.get(int(dev), 0)
+                                 + int(getattr(data, "nbytes", 0)))
+        except Exception:
+            continue                 # deleted/donated-away array
+    return out
 
 
 def _jax_inventory():

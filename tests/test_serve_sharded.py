@@ -1,0 +1,358 @@
+"""Tensor-parallel sharded serving tests (serve.Engine tp=N over the
+forced-host-device CPU mesh) plus the shared regex-rule partitioner
+(parallel/partition.py).
+
+The conftest forces 8 virtual XLA host devices, so the {'tp': N}
+GSPMD path — params sharded per the partition rules, head-sharded
+paged KV-cache, all-reduces inserted by the partitioner — runs in
+tier-1 without TPU hardware.  The guarantees pinned here:
+
+- tp=2 serving is TOKEN-IDENTICAL to tp=1 on the same prompts
+  (greedy argmax; sharding is layout, never math);
+- per-chip KV bytes drop by the tp degree while block ACCOUNTING is
+  unchanged (same num_blocks per chip -> >= 1.9x KV budget per chip);
+- sharded programs restart through the AOT export store with zero
+  fresh traces, and their fingerprints key on (tp, rules digest);
+- shutdown() deletes the sharded device buffers deterministically,
+  so back-to-back engines in one process never hold two models.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu.aot import export_store
+from mxnet_tpu.parallel import partition
+from mxnet_tpu.parallel.mesh import PartitionSpec as P
+from mxnet_tpu.serve import engine as engine_mod
+
+VOCAB = 53
+
+
+# -- the shared partitioner --------------------------------------------------
+def test_match_partition_rules_first_match_wins_and_scalars_replicate():
+    params = {"a_q_weight": np.zeros((8, 4)), "a_q_bias": np.zeros((8,)),
+              "a_scale": np.zeros(()), "a_other": np.zeros((4, 4))}
+    rules = [(r"_q_weight$", P("tp", None)), (r"_q_", P("tp"))]
+    specs = partition.match_partition_rules(rules, params)
+    assert specs["a_q_weight"] == P("tp", None)   # first match, not second
+    assert specs["a_q_bias"] == P("tp")
+    assert specs["a_scale"] == P()                # scalar: replicated
+    assert specs["a_other"] == P()                # default
+
+
+def test_match_partition_rules_default_and_raise():
+    params = {"w": np.zeros((4, 4))}
+    got = partition.match_partition_rules(
+        [], params, default=lambda name, shape: P(None, "x"))
+    assert got["w"] == P(None, "x")
+    with pytest.raises(ValueError, match="no partition rule"):
+        partition.match_partition_rules([], params, default="raise")
+    # shapes (not arrays) work too — partition before materializing
+    got = partition.match_partition_rules([(r"w", P("tp", None))],
+                                          {"w": (4, 4)})
+    assert got["w"] == P("tp", None)
+
+
+def test_match_partition_rules_full_mode_is_trainer_contract():
+    """mode='full': a key is an exact name or a fullmatch regex —
+    ShardedTrainer's historical param_specs semantics."""
+    params = {"fc1_weight": np.zeros((8, 4)),
+              "fc1_weight_extra": np.zeros((8, 4))}
+    rules = [("fc1_weight", P("tp", None))]
+    full = partition.match_partition_rules(rules, params, mode="full")
+    assert full["fc1_weight"] == P("tp", None)
+    assert full["fc1_weight_extra"] == P()        # no substring match
+    search = partition.match_partition_rules(rules, params)
+    assert search["fc1_weight_extra"] == P("tp", None)  # re.search hits
+
+
+def test_parse_rules_syntax_and_digest():
+    rules = partition.parse_rules(
+        r".*_(q|k|v)_weight$=tp,-; .*_proj_weight$=-,tp ; .*=")
+    assert rules == [(r".*_(q|k|v)_weight$", P("tp", None)),
+                     (r".*_proj_weight$", P(None, "tp")),
+                     (r".*", P())]
+    assert partition.parse_rules("") == []
+    assert partition.parse_rules(None) == []
+    with pytest.raises(ValueError):
+        partition.parse_rules("no-equals-sign")
+    # a stray comma must fail fast, never silently shift axes onto
+    # earlier dimensions
+    with pytest.raises(ValueError, match="empty entry"):
+        partition.parse_rules(".*_w$=tp,,hidden")
+    d1 = partition.rules_digest(rules)
+    d2 = partition.rules_digest(partition.gpt_partition_rules())
+    assert d1 != d2 and len(d1) == 64
+    # digest is stable across equal rule lists
+    assert d1 == partition.rules_digest(list(rules))
+
+
+def test_gpt_rules_cover_every_param_of_both_variants(model, gqa_model):
+    for net, params in (model, gqa_model):
+        params = mx.models.generate.normalize_gpt_params(params, "gpt")
+        specs = partition.match_partition_rules(
+            partition.gpt_partition_rules(), params, default="raise")
+        assert specs["gpt_l0_q_weight"] == P("tp", None)
+        assert specs["gpt_l0_proj_weight"] == P(None, "tp")
+        assert specs["gpt_tok_embed_weight"] == P()
+        assert specs["gpt_l0_ln1_gamma"] == P()
+        # down/proj biases replicated (their matmuls are the partial
+        # sums GSPMD all-reduces; the bias adds once, after)
+        assert specs["gpt_l0_proj_bias"] == P()
+
+
+# -- shared model fixtures (test_serve recipe) -------------------------------
+def _gpt_params(net, seed=3):
+    arg_shapes, _, _ = net.infer_shape(data=(1, 96), softmax_label=(1, 96))
+    rng = np.random.RandomState(seed)
+    params = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        scale = 0.35 if name.endswith("weight") else 0.0
+        params[name] = (rng.randn(*shp) * scale
+                        + (1.0 if name.endswith("gamma") else 0.0)
+                        ).astype(np.float32)
+    return params
+
+
+@pytest.fixture(scope="module")
+def model():
+    net = mx.models.gpt(VOCAB, 96, num_layers=2, d_model=32, num_heads=4)
+    return net, _gpt_params(net)
+
+
+@pytest.fixture(scope="module")
+def gqa_model():
+    """llama-style variant: rope + rmsnorm + swiglu + GQA + tied head."""
+    net = mx.models.gpt(VOCAB, 96, num_layers=2, d_model=32, num_heads=4,
+                        kv_heads=2, norm="rmsnorm", mlp="swiglu",
+                        pos_embed="rope", tie_embeddings=True)
+    return net, _gpt_params(net, seed=9)
+
+
+def _engine(model, **kw):
+    net, params = model
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("max_prefills_per_step", 2)
+    return mx.serve.Engine(params, symbol=net, **kw)
+
+
+def _prompts(n=4, seed=7, lo=6, hi=22):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, (rng.randint(lo, hi),)).astype(np.int32)
+            for _ in range(n)]
+
+
+def _serve(eng, prompts, max_new=12):
+    reqs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+    eng.run()
+    assert all(r.status == "finished" for r in reqs)
+    return [r.tokens for r in reqs]
+
+
+# -- tp correctness ----------------------------------------------------------
+def test_tp2_token_identical_to_tp1(model):
+    prompts = _prompts()
+    e1 = _engine(model)
+    assert e1.tp == 1 and e1.mesh is None
+    t1 = _serve(e1, prompts)
+    e1.shutdown()
+    e2 = _engine(model, tp=2)
+    assert e2.tp == 2 and dict(e2.mesh.shape) == {"tp": 2}
+    t2 = _serve(e2, prompts)
+    e2.shutdown()
+    assert t1 == t2
+
+
+def test_tp2_token_identical_gqa_variant_under_preemption(gqa_model):
+    """The llama variant, AND with cache pressure: preemption-resume
+    through sharded programs stays token-exact."""
+    prompts = _prompts(4, seed=11, lo=8, hi=24)
+    calm = _engine(gqa_model)
+    t1 = _serve(calm, prompts, max_new=24)
+    calm.shutdown()
+    tight = _engine(gqa_model, tp=2, num_blocks=20)
+    t2 = _serve(tight, prompts, max_new=24)
+    stats = tight.stats()
+    tight.shutdown()
+    assert stats.preemptions > 0, "no cache pressure — test is vacuous"
+    assert t1 == t2
+
+
+def test_tp_validation_errors(model):
+    net, params = model
+    with pytest.raises(ValueError, match="must divide"):
+        _engine(model, tp=3)          # 4 heads % 3 != 0
+    with pytest.raises(ValueError, match="exceeds"):
+        _engine(model, tp=2 * jax.device_count())
+    with pytest.raises(ValueError, match="tp must be"):
+        _engine(model, tp=0)
+
+
+def test_tp_env_default(model, monkeypatch):
+    monkeypatch.setenv("MXTPU_SERVE_TP", "2")
+    eng = _engine(model)
+    assert eng.tp == 2 and eng.mesh is not None
+    eng.shutdown()
+
+
+def test_custom_partition_rules_string(model):
+    """An operator rule override (env syntax) keys a different digest
+    and still serves correctly."""
+    rules = (r".*_(q|k|v)_weight$=tp,-;.*_(q|k|v)_bias$=tp;"
+             r".*_proj_weight$=-,tp;.*=")
+    dflt = _engine(model, tp=2)
+    t_dflt = _serve(dflt, _prompts())
+    dflt_digest = dflt._rules_digest
+    dflt.shutdown()
+    eng = _engine(model, tp=2, partition_rules=rules)
+    assert eng._rules_digest != dflt_digest
+    assert export_store.digest(eng._aot_base_fp()) != \
+        export_store.digest(_fp_for(model, tp=2))
+    assert _serve(eng, _prompts()) == t_dflt     # layout, not math
+    eng.shutdown()
+
+
+# -- capacity ----------------------------------------------------------------
+def test_kv_capacity_scales_with_tp(model):
+    e1 = _engine(model)
+    e2 = _engine(model, tp=2)
+    kv1, kv2 = e1.kv_cache_stats(), e2.kv_cache_stats()
+    # same block accounting at every tp…
+    assert e1.blocks.total_blocks == e2.blocks.total_blocks
+    assert kv1["bytes_total"] == kv2["bytes_total"]
+    # …but per-chip bytes drop by tp: the same per-chip HBM budget
+    # funds >= 1.9x the blocks (exactly 2x here)
+    assert kv1["bytes_per_device"] >= 1.9 * kv2["bytes_per_device"]
+    # statusz agrees with the actual shard sizes on device
+    from mxnet_tpu.telemetry import statusz
+    per_dev = statusz.bytes_by_device([e2._cache_k, e2._cache_v])
+    assert len(per_dev) == 2
+    assert all(b == kv2["bytes_per_device"] for b in per_dev.values())
+    e1.shutdown()
+    e2.shutdown()
+
+
+def test_statusz_reports_mesh_and_per_chip_occupancy(model):
+    eng = _engine(model, tp=2)
+    req = eng.submit(_prompts(1)[0], max_new_tokens=4)
+    eng.step()
+    s = eng.statusz()
+    sh = s["sharding"]
+    assert sh["tp"] == 2
+    assert sh["mesh"]["axes"] == {"tp": 2}
+    assert len(sh["mesh"]["devices"]) == 2
+    assert sh["rules_digest"] and sh["spec_digest"]
+    assert len(sh["params_bytes_per_device"]) == 2
+    assert s["kv_blocks"]["in_use"] > 0
+    assert s["kv_cache"]["bytes_in_use_per_device"] == \
+        s["kv_blocks"]["in_use"] * s["kv_cache"]["bytes_per_block_per_device"]
+    assert s["kv_cache"]["bytes_per_device"] * 2 == \
+        s["kv_cache"]["bytes_total"]
+    eng.run()
+    assert req.status == "finished"
+    eng.shutdown()
+
+
+# -- AOT / fingerprints ------------------------------------------------------
+def _fp_for(model, **kw):
+    eng = _engine(model, **kw)
+    fp = eng._aot_base_fp()
+    eng.shutdown()
+    return fp
+
+
+def test_fingerprint_differs_when_tp_differs(model):
+    d1 = export_store.digest(_fp_for(model))
+    d2 = export_store.digest(_fp_for(model, tp=2))
+    d4 = export_store.digest(_fp_for(model, tp=4))
+    assert len({d1, d2, d4}) == 3
+    # and the in-process program cache keys separately too
+    e1, e2 = _engine(model), _engine(model, tp=2)
+    assert e1._spec_key() != e2._spec_key()
+    e1.shutdown()
+    e2.shutdown()
+
+
+def test_sharded_aot_warm_restart_zero_fresh_traces(model, tmp_path):
+    """A restarted tp=2 engine loads every sharded bucket program from
+    the export store — zero fresh traces — and serves token-identically
+    (the tp analog of test_aot's cold/warm gate)."""
+    from mxnet_tpu import telemetry
+
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        def traces(source):
+            snap = telemetry.registry().snapshot().get(
+                "mxtpu_aot_programs_total", {"samples": []})
+            return sum(s["value"] for s in snap["samples"]
+                       if s["labels"].get("source") == source)
+
+        prompts = _prompts(3, seed=5)
+        engine_mod._STEP_CACHE.clear()     # earlier tests share the key
+        cold = _engine(model, tp=2, aot_dir=str(tmp_path))
+        toks_cold = _serve(cold, prompts, max_new=8)
+        manifest = cold.manifest()
+        cold.shutdown()
+        assert traces("trace") >= 3
+        assert len(cold._aot.entries()) == len(manifest)
+
+        engine_mod._STEP_CACHE.clear()     # simulate the process restart
+        before = traces("trace")
+        warm = _engine(model, tp=2, aot_dir=str(tmp_path))
+        assert warm.warmup(manifest) == len(manifest)
+        assert traces("trace") == before               # ZERO fresh traces
+        assert traces("artifact") == len(manifest)
+        assert _serve(warm, prompts, max_new=8) == toks_cold
+        warm.shutdown()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+# -- deterministic buffer release --------------------------------------------
+def test_shutdown_releases_sharded_buffers_back_to_back(model):
+    """Two tp engines back-to-back on the 4-device mesh: the first
+    shutdown() must DELETE its sharded params + KV (not wait for GC),
+    and the caller's numpy checkpoint must stay usable."""
+    prompts = _prompts(2)
+    eng1 = _engine(model, tp=4)
+    t1 = _serve(eng1, prompts)
+    held = list(eng1.params.values()) + [eng1._cache_k, eng1._cache_v]
+    owned = list(eng1._owned)
+    assert owned, "sharded placement must materialize engine-owned arrays"
+    eng1.shutdown()
+    assert eng1.params is None and eng1._owned == []
+    assert all(a.is_deleted() for a in owned)
+    assert all(a.is_deleted() for a in held[-2:])       # both caches
+    # same checkpoint immediately serves again, token-identically
+    eng2 = _engine(model, tp=4)
+    assert _serve(eng2, prompts) == t1
+    eng2.shutdown()
+
+
+def test_tp1_shutdown_never_deletes_caller_arrays(model):
+    """Arrays the caller passed in that the engine adopted as-is must
+    survive shutdown (only engine-materialized buffers are deleted)."""
+    import jax.numpy as jnp
+
+    net, params = model
+    jparams = {k: jnp.asarray(v) for k, v in params.items()}
+    eng = mx.serve.Engine(jparams, symbol=net, block_size=4,
+                          num_blocks=16, max_batch=2, max_model_len=32)
+    eng.shutdown()
+    assert all(not v.is_deleted() for v in jparams.values())

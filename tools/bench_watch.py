@@ -13,6 +13,7 @@ artifact set in priority order:
   6. tools/quant_bench.py                   -> QUANT_BENCH.json
   7. tests/test_tpu_consistency.py          -> TPU_CONSISTENCY.json
   8. tools/serve_bench.py                   -> SERVE_BENCH.json
+     tools/serve_bench.py --tp 2            -> SERVE_TP_BENCH.json
   9. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
 
 Each successful TPU-platform result is also appended to
@@ -366,6 +367,28 @@ def run_serve_bench(timeout=2400):
         "SERVE_BENCH.json", timeout, validate=validate)
 
 
+def run_serve_tp_bench(timeout=2400):
+    """Tensor-parallel sharded serving (tools/serve_bench.py --tp 2) —
+    throughput/TTFT of the same engine with params + KV-cache sharded
+    over a {'tp': 2} mesh, GSPMD collectives in the decode loop."""
+
+    def validate(payload):
+        if not payload.get("tokens_per_sec"):
+            return "no serving throughput"
+        if int(payload.get("tp") or 1) < 2:
+            return "no tensor-parallel mesh"
+        if not payload.get("mesh_shape"):
+            return "mesh shape not recorded"
+        if payload.get("dropped_without_rejection"):
+            return "requests dropped without rejection"
+        return None
+
+    return run_json_artifact(
+        "serve_tp",
+        [os.path.join(REPO, "tools", "serve_bench.py"), "--tp", "2"],
+        "SERVE_TP_BENCH.json", timeout, validate=validate)
+
+
 def run_train_bench(timeout=1800):
     """Fused single-dispatch train step vs per-param loop
     (tools/train_bench.py) — steps/sec and per-batch host dispatch
@@ -441,6 +464,7 @@ def main():
             "resnet": False, "resnet256": False, "gpt": False,
             "longcontext": False, "bandwidth": False, "cifar": False,
             "quant": False, "decode": False, "serve": False,
+            "serve_tp": False,
             "train_bench": False, "startup": False, "train_tier": False,
             "sweep": False}
     fails = {k: 0 for k in done}
@@ -510,6 +534,8 @@ def main():
             ("quant", lambda: run_quant_bench(timeout=min(1800, left))),
             ("decode", lambda: run_decode_bench(timeout=min(1800, left))),
             ("serve", lambda: run_serve_bench(timeout=min(2400, left))),
+            ("serve_tp",
+             lambda: run_serve_tp_bench(timeout=min(2400, left))),
             ("train_bench", lambda: run_train_bench(timeout=min(1800, left))),
             ("startup", lambda: run_startup_bench(timeout=min(1800, left))),
             ("train_tier", lambda: run_train_tier(timeout=min(3000, left))),

@@ -145,6 +145,13 @@ def main():
     p.add_argument("--rate", type=float, default=16.0,
                    help="open-loop arrival rate, requests/sec")
     p.add_argument("--deadline-s", type=float, default=None)
+    p.add_argument("--tp", type=int, default=0,
+                   help="tensor-parallel degree: shard params + KV-cache "
+                        "over a {'tp': N} mesh. Absent/0 defers to "
+                        "MXTPU_SERVE_TP; an explicit --tp 1 forces the "
+                        "single-device baseline even when the env var is "
+                        "set. On the cpu backend virtual host devices are "
+                        "forced so the sharded path benches without a TPU")
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--num-blocks", type=int, default=None,
                    help="cache blocks (default: fits ~concurrency+2 "
@@ -164,6 +171,24 @@ def main():
         # the framework-owned selector: authoritative even where the
         # accelerator site plugin outranks JAX_PLATFORMS
         os.environ["MXTPU_PLATFORMS"] = args.platform
+    try:
+        env_tp = int(os.environ.get("MXTPU_SERVE_TP", "1") or 1)
+    except ValueError:
+        env_tp = 1
+    # an explicit --tp (including --tp 1) beats the deployment env
+    # default; only an absent/zero flag defers to MXTPU_SERVE_TP
+    eff_tp = args.tp if args.tp else env_tp
+    if eff_tp > 1:
+        # a tp mesh (CLI flag or deployment env default) needs >= tp
+        # devices; on the host platform that means forcing virtual
+        # devices BEFORE jax initializes (no-op for a real TPU backend
+        # — the flag only affects cpu)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={eff_tp}"
+            ).strip()
     import numpy as np
 
     import mxnet_tpu as mx
@@ -184,6 +209,11 @@ def main():
     lens = [int(x) for x in args.prompt_lens.split(",")]
     max_len = max(lens) + args.max_new
     kv = args.kv_heads or max(1, args.heads // 4)
+    if eff_tp > 1 and kv % eff_tp:
+        # the head-sharded KV-cache needs kv_heads % tp == 0; bump the
+        # GQA default to the mesh width (explicit --kv-heads still wins
+        # and may fail loudly in the engine)
+        kv = eff_tp if args.kv_heads is None else kv
     S = max_len
     net = mx.models.gpt(args.vocab, S, num_layers=args.layers,
                         d_model=args.d_model, num_heads=args.heads,
@@ -198,12 +228,14 @@ def main():
         1 + blocks_per_req * (args.concurrency + 2))
     max_queue = args.max_queue or max(args.requests, 2 * args.concurrency)
 
+    tp = args.tp if args.tp else None    # --tp 1 forces single-device
+
     def make_engine(max_batch):
         return mx.serve.Engine(
             params, symbol=net, block_size=args.block_size,
             num_blocks=num_blocks, max_batch=max_batch,
             max_queue=max_queue, max_model_len=max_len,
-            max_prefills_per_step=2)
+            max_prefills_per_step=2, tp=tp)
 
     out = {"platform": jax.default_backend(),
            "device_kind": getattr(jax.devices()[0], "device_kind", ""),
@@ -239,6 +271,13 @@ def main():
         eng.shutdown()
 
     engine = make_engine(args.concurrency)
+    # sharding payload fields come from the measured engine itself —
+    # engine.tp, not the CLI flag, so a run sharded via MXTPU_SERVE_TP
+    # can never be mislabeled as a tp=1 baseline
+    out["tp"] = engine.tp
+    out["mesh_shape"] = (dict(engine.mesh.shape)
+                         if engine.mesh is not None else None)
+    out["kv_bytes_per_device"] = engine.kv_cache_stats()["bytes_per_device"]
     if args.mode == "open":
         reqs, wall, qfull = run_open(mx, engine, workload, args.rate,
                                      rng, args.deadline_s)
