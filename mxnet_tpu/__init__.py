@@ -20,8 +20,10 @@ _jax.config.update("jax_enable_x64", True)
 if _os.environ.get("MXTPU_PLATFORMS"):
     try:
         _jax.config.update("jax_platforms", _os.environ["MXTPU_PLATFORMS"])
+    # mxtpu-lint: disable=swallowed-exception (import-time guard: the
+    # embedding process owns the backend; there is nowhere to report)
     except Exception:
-        pass  # backend already initialized by the embedding process
+        pass
 
 from . import base
 from .base import MXNetError
@@ -93,7 +95,9 @@ from . import c_api
 # ops via MXSymbolListAtomicSymbolCreators at import)
 try:
     c_api.publish_registry()
-except Exception:  # native lib optional; frontends fall back to Python
+# mxtpu-lint: disable=swallowed-exception (native lib is optional;
+# frontends fall back to the pure-Python registry)
+except Exception:
     pass
 
 __version__ = "0.1.0"

@@ -27,6 +27,7 @@ __all__ = [
     "ctypes2numpy_shared",
     "env_flag",
     "env_int",
+    "env_float",
 ]
 
 
@@ -50,6 +51,17 @@ def env_int(name, default):
         return int(os.environ.get(name, "") or default)
     except ValueError:
         return default
+
+
+def env_float(name, default):
+    """Float MXTPU_* knob (timeouts, rates); malformed values fall back
+    to the default like :func:`env_int`."""
+    import os
+
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return float(default)
 
 
 def c_array(ctype, values):

@@ -656,5 +656,7 @@ try:
     from . import c_api as _c_api
 
     _c_api.publish_registry()
-except Exception:  # never block the bridge boot over discovery metadata
+# mxtpu-lint: disable=swallowed-exception (never block the bridge boot
+# over discovery metadata — the C ABI surface stays functional)
+except Exception:
     pass

@@ -211,6 +211,9 @@ class _CompiledGraph:
         for a, v in zip(aux_names, node_new_aux):
             new_aux[a] = v
         for i, o in enumerate(outs):
+            # mxtpu-lint: disable=jit-cache-capture (env is the caller's
+            # per-invocation value environment — traversal state over a
+            # graph the executor owns, not a program cache)
             env[id(node), i] = o
             if collect is not None:
                 out_name = (f"{node.name}_"

@@ -260,6 +260,8 @@ class ImageRecordIter(DataIter):
                 # mean (the marker's mtime is refreshed as it works)
                 if time.monotonic() >= deadline:
                     try:
+                        # mxtpu-lint: disable=wall-clock (compared
+                        # against the marker file's wall-clock mtime)
                         still_working = (time.time()
                                          - os.path.getmtime(marker) < 60.0)
                     except OSError:

@@ -199,8 +199,15 @@ class KVStore:
 
         try:
             optimizer = pickle.loads(pickle.dumps(optimizer))
-        except Exception:
-            pass
+        except Exception as e:
+            import logging
+
+            # the optimizer still works in-process; checkpoint parity
+            # across restarts is what just silently degraded — say so
+            logging.warning("optimizer %s is not picklable (%s): "
+                            "checkpoint/dist serialization will fall "
+                            "back to the live object",
+                            type(optimizer).__name__, e)
         self._optimizer = optimizer
         self._set_updater(get_updater(optimizer))
 
@@ -238,10 +245,12 @@ def _maybe_init_distributed():
 
     if _DIST_INITIALIZED or "MXTPU_COORDINATOR" not in os.environ:
         return
+    from .base import env_int
+
     jax.distributed.initialize(
         coordinator_address=os.environ["MXTPU_COORDINATOR"],
-        num_processes=int(os.environ["MXTPU_NUM_PROCS"]),
-        process_id=int(os.environ["MXTPU_PROC_ID"]))
+        num_processes=env_int("MXTPU_NUM_PROCS", 1),
+        process_id=env_int("MXTPU_PROC_ID", 0))
     _DIST_INITIALIZED = True
 
 
@@ -342,11 +351,13 @@ class DistPSKVStore(KVStore):
         # is_recovery, kvstore_dist.h:35-38) — the surviving peers are
         # already past them; their client must REPLAY those rounds as
         # no-ops (no creation-time alignment) until push() resyncs
-        self._is_recovery = bool(os.environ.get("MXTPU_IS_RECOVERY"))
+        from .base import env_flag, env_int
+
+        self._is_recovery = env_flag("MXTPU_IS_RECOVERY", False)
         self._client = ShardedPSClient(addrs.split(","),
                                        align_barriers=not self._is_recovery)
-        self._rank = int(os.environ.get("MXTPU_PROC_ID", "0"))
-        self._nproc = int(os.environ.get("MXTPU_NUM_PROCS", "1"))
+        self._rank = env_int("MXTPU_PROC_ID", 0)
+        self._nproc = env_int("MXTPU_NUM_PROCS", 1)
         self._client.hello(self._rank)
         # per-push sync flag (reference sends a server-global kSyncMode
         # command, kvstore.cc:29-38; per-push is strictly safer when two
@@ -376,10 +387,13 @@ class DistPSKVStore(KVStore):
             return
         try:
             self._flush()  # staged sends must land before the bye
-        except Exception:
+        except Exception as e:
+            import logging
+
             # a failed staged send (e.g. the server already died) must
             # not prevent deregistering from the surviving shards
-            pass
+            logging.warning("kvstore close: final flush failed (%s); "
+                            "deregistering anyway", e)
         client, self._client = self._client, None
         client.close()
 

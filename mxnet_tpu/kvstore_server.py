@@ -58,7 +58,11 @@ def _init_kvstore_server_module(num_workers=None):
     """Enter the server loop when launched in the server role
     (reference kvstore_server.py:58-67)."""
     if num_workers is None:
-        num_workers = int(os.environ.get("DMLC_NUM_WORKER",
-                                         os.environ.get("MXTPU_NUM_PROCS",
-                                                        "1")))
+        from .base import env_int
+
+        # DMLC_NUM_WORKER (reference launcher contract) wins; the
+        # MXTPU_* fallback rides the shared parser
+        dmlc = os.environ.get("DMLC_NUM_WORKER")
+        num_workers = (int(dmlc) if dmlc
+                       else env_int("MXTPU_NUM_PROCS", 1))
     KVStoreServer(num_workers).run()

@@ -1,0 +1,30 @@
+"""mxtpu-lint: JAX-aware static analysis for the TPU framework.
+
+An AST-based checker suite encoding the contracts this codebase keeps
+re-learning the hard way: no wall-clock in perf paths, no forced
+device syncs in step loops, no live objects in program-cache keys, no
+reads of donated buffers, one parser for MXTPU_* env knobs, documented
+lock discipline, and no silently swallowed exceptions.
+
+Entry points:
+
+* ``python tools/mxtpu_lint.py mxnet_tpu tools`` — the CLI (human or
+  ``--json`` reports, baseline management).
+* ``tests/test_lint.py`` — the tier-1 gate: the tree must be clean
+  against the committed baseline on every test run.
+* :func:`mxnet_tpu.lint.run_lint` — programmatic API.
+* :func:`mxnet_tpu.lint.hot_path` — decorator marking hot entry points
+  for the ``host-sync`` checker (runtime-inert).
+
+See docs/how_to/static_analysis.md for the checker gallery, the
+suppression / baseline workflow, and how to add a checker.
+"""
+
+from .annotations import hot_path
+from .core import (Finding, LintContext, SourceFile, all_checkers,
+                   apply_baseline, iter_py_files, load_baseline,
+                   run_lint, save_baseline)
+
+__all__ = ["hot_path", "Finding", "SourceFile", "LintContext",
+           "all_checkers", "run_lint", "iter_py_files",
+           "load_baseline", "save_baseline", "apply_baseline"]

@@ -1,0 +1,46 @@
+"""Zero-dependency source annotations the linter understands.
+
+This module must stay import-cycle-free: anything in the package (the
+serve engine, the fused train step, io prefetch) may import it to mark
+hot entry points, so it imports nothing from mxnet_tpu and nothing
+heavyweight.
+
+Two annotation surfaces exist:
+
+``@hot_path``
+    Marks a function as an entry point of a latency-critical loop (a
+    serve step, a fused train step).  The ``host-sync`` checker seeds
+    its reachability walk at these functions: any ``float()`` /
+    ``bool()`` / ``.item()`` / ``np.asarray`` style forced device→host
+    sync inside them (or inside same-module functions they call) is a
+    finding unless suppressed with a reason.
+
+``# guarded-by: <lock>`` (comment, not code)
+    On a ``self.attr = ...`` line (usually in ``__init__``), documents
+    that ``attr`` must only be mutated while holding ``self.<lock>``.
+    The ``unlocked-shared-state`` checker enforces it lexically.
+
+Suppressions are comments too::
+
+    x = time.time()   # mxtpu-lint: disable=wall-clock (jsonl timestamp)
+
+A comment-only line suppresses the next code line, so long statements
+can carry their waiver above them.
+"""
+
+__all__ = ["hot_path", "HOT_PATH_ATTR"]
+
+HOT_PATH_ATTR = "__mxtpu_hot_path__"
+
+
+def hot_path(fn):
+    """Mark ``fn`` as a hot entry point for the ``host-sync`` checker.
+
+    Runtime-inert: the only effect is a marker attribute (and the
+    decorator's *name* appearing in the AST, which is what the static
+    checker actually keys on)."""
+    try:
+        setattr(fn, HOT_PATH_ATTR, True)
+    except (AttributeError, TypeError):
+        pass          # builtins/partials: the AST marker still works
+    return fn

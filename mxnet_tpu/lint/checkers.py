@@ -1,0 +1,831 @@
+"""The mxtpu-lint checker suite.
+
+Every checker here is grounded in a bug class this repo actually
+shipped and re-fixed by hand across PRs 2-6 (see
+docs/how_to/static_analysis.md for the before/after gallery):
+
+  wall-clock             time.time() where perf_counter/monotonic is
+                         required (PR 2/3/4 each converted stragglers)
+  host-sync              float()/bool()/.item()/np.asarray on device
+                         values inside fit/serve step loops (PR 3's
+                         dispatch-count work was exactly this hunt)
+  jit-cache-capture      module caches / lru_cache keying compiled
+                         programs by object identity or capturing
+                         engines (the _STEP_CACHE rule from PR 6)
+  use-after-donate       reading a buffer after passing it to a
+                         donate_argnums jit — runs fine on CPU (XLA
+                         ignores donation there), corrupts on TPU
+  env-discipline         MXTPU_* reads that bypass base.env_flag /
+                         env_int / env_float, or undocumented vars
+                         (subsumes tools/check_env_docs.py)
+  unlocked-shared-state  mutation of a ``# guarded-by: <lock>``
+                         attribute outside ``with self.<lock>``
+  swallowed-exception    bare/broad except whose body is only
+                         pass/continue — failures must count or log
+
+Checkers are AST + comment based (see core.SourceFile); they never
+import the code under analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import register
+
+__all__ = []  # programmatic access goes through core.all_checkers()
+
+
+# -- shared AST helpers -------------------------------------------------------
+def dotted(node):
+    """Best-effort dotted name for Name/Attribute chains:
+    ``self._cache_k`` -> "self._cache_k", ``np.asarray`` ->
+    "np.asarray".  None for anything not a pure chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node):
+    """Dotted name of a Call's callee, or None."""
+    return dotted(node.func) if isinstance(node, ast.Call) else None
+
+
+def contains(node, predicate):
+    return any(predicate(n) for n in ast.walk(node))
+
+
+def _const_ints(node):
+    """Literal ints inside a tuple/list/int constant, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def donated_argnums(call):
+    """Donated positions of a ``jax.jit(...)`` call, or None when the
+    call is not a jit / donates nothing / is statically unresolvable.
+
+    Resolves literal tuples and the repo's ``_donate(i, j)`` guard
+    (donation on TPU only — which is exactly why a use-after-donate
+    survives every CPU test run)."""
+    if call_name(call) not in ("jax.jit", "jit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        ints = _const_ints(kw.value)
+        if ints:
+            return ints
+        if isinstance(kw.value, ast.Call) \
+                and (call_name(kw.value) or "").endswith("_donate"):
+            ints = [a.value for a in kw.value.args
+                    if isinstance(a, ast.Constant)
+                    and isinstance(a.value, int)]
+            return ints or None
+        return None
+    return None
+
+
+def functions_of(tree):
+    """[(qualname, classname_or_None, node)] for every def in a
+    module, including methods (qualname ``Class.method``).  Nested
+    defs inside functions are skipped — in this codebase those are
+    overwhelmingly traced jax closures, not host code."""
+    out = []
+
+    def visit(body, cls):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{cls}.{node.name}" if cls else node.name
+                out.append((qn, cls, node))
+            elif isinstance(node, ast.ClassDef):
+                visit(node.body, node.name)
+
+    visit(tree.body, None)
+    return out
+
+
+def walk_host_stmts(fn_node):
+    """Walk a function's statements, skipping nested function/lambda
+    bodies (traced-jax closure code is not host code)."""
+    for stmt in fn_node.body:
+        yield from _walk_skip_defs(stmt)
+
+
+def _walk_skip_defs(node):
+    yield node
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield from _walk_skip_defs(child)
+
+
+class Checker:
+    id = None
+    doc = ""
+
+    def check(self, sf, ctx):
+        raise NotImplementedError
+
+
+# -- wall-clock ---------------------------------------------------------------
+@register
+class WallClockChecker(Checker):
+    id = "wall-clock"
+    doc = ("time.time() is wall-clock: NTP slews/steps make it "
+           "non-monotonic, so elapsed-time math and deadlines computed "
+           "from it can jump backwards. Use time.perf_counter() for "
+           "durations, time.monotonic() for deadlines/rate limits; "
+           "suppress with a reason only where a real timestamp is "
+           "required (log records, filenames, comparisons against "
+           "filesystem mtimes).")
+
+    def check(self, sf, ctx):
+        for node in ast.walk(sf.tree):
+            if call_name(node) == "time.time":
+                yield sf.finding(
+                    self.id, node,
+                    "time.time() — use perf_counter() (durations) or "
+                    "monotonic() (deadlines); if a wall-clock timestamp "
+                    "is semantically required, suppress with the reason")
+
+
+# -- host-sync ----------------------------------------------------------------
+_SYNC_ATTRS = {"item", "asnumpy", "tolist", "block_until_ready"}
+_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+               "jax.device_get"}
+
+
+@register
+class HostSyncChecker(Checker):
+    id = "host-sync"
+    doc = ("A float()/bool()/.item()/np.asarray/jax.device_get on a "
+           "jax value blocks the host until the device catches up — "
+           "inside a fit/serve step loop that stall serializes "
+           "dispatch and shows up directly in steps/sec. Entry points "
+           "are seeded with @hot_path (mxnet_tpu.lint.hot_path); the "
+           "checker walks same-module calls reachable from them. "
+           "Deliberate sync points (returning sampled tokens to the "
+           "scheduler, an opt-in watchdog) carry suppressions naming "
+           "the contract.")
+
+    def check(self, sf, ctx):
+        funcs = functions_of(sf.tree)
+        by_qual = {qn: node for qn, _, node in funcs}
+        hot = set()
+        for qn, _, node in funcs:
+            for dec in node.decorator_list:
+                name = dotted(dec) or dotted(getattr(dec, "func", None)) \
+                    or ""
+                if name.split(".")[-1] == "hot_path":
+                    hot.add(qn)
+        if not hot:
+            return
+        # same-module reachability: self.m() -> Class.m, f() -> module f
+        edges = {}
+        for qn, cls, node in funcs:
+            callees = set()
+            for n in walk_host_stmts(node):
+                cn = call_name(n)
+                if not cn:
+                    continue
+                if cn.startswith("self.") and cls:
+                    target = f"{cls}.{cn[5:]}"
+                    if target in by_qual:
+                        callees.add(target)
+                elif cn in by_qual:
+                    callees.add(cn)
+            edges[qn] = callees
+        reach, frontier = set(hot), list(hot)
+        while frontier:
+            for nxt in edges.get(frontier.pop(), ()):
+                if nxt not in reach:
+                    reach.add(nxt)
+                    frontier.append(nxt)
+
+        for qn in sorted(reach):
+            for node in walk_host_stmts(by_qual[qn]):
+                if not isinstance(node, ast.Call):
+                    continue
+                cn = call_name(node)
+                msg = None
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _SYNC_ATTRS:
+                    msg = f".{node.func.attr}() forces a device sync"
+                elif cn in _SYNC_CALLS:
+                    msg = f"{cn}() forces a device sync"
+                elif cn in ("float", "bool") and node.args and not \
+                        isinstance(node.args[0], ast.Constant):
+                    msg = f"{cn}() on a computed value forces a device " \
+                          "sync if it is a jax array"
+                if msg:
+                    yield sf.finding(
+                        self.id, node,
+                        f"{msg} inside hot path `{qn}` — hoist it off "
+                        "the step loop, batch it with other reads, or "
+                        "suppress naming the designed sync point")
+
+
+# -- jit-cache-capture --------------------------------------------------------
+_LRU_NAMES = {"functools.lru_cache", "lru_cache", "functools.cache",
+              "cache"}
+
+
+@register
+class JitCacheCaptureChecker(Checker):
+    id = "jit-cache-capture"
+    doc = ("Module-level program caches must key on immutable config, "
+           "never on live objects: an engine/module key (or an id() of "
+           "one) pins multi-GB parameter dicts forever — or worse, "
+           "id() recycling hands a NEW object another object's "
+           "compiled program. The _STEP_CACHE/_ModelCfg rule from the "
+           "serve engine, generalized. functools.lru_cache on methods "
+           "is the same bug: self becomes a cache key and the instance "
+           "becomes immortal.")
+
+    def check(self, sf, ctx):
+        module_dicts = set()
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Dict):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        module_dicts.add(t.id)
+
+        # (a) lru_cache on a method: self is hashed into every key
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            args = node.args.posonlyargs + node.args.args
+            if not (args and args[0].arg in ("self", "cls")):
+                continue
+            for dec in node.decorator_list:
+                name = dotted(dec) or dotted(getattr(dec, "func",
+                                                     None)) or ""
+                if name in _LRU_NAMES:
+                    yield sf.finding(
+                        self.id, dec,
+                        f"lru_cache on method {node.name!r}: self "
+                        "becomes part of every cache key, pinning "
+                        "the instance (and any device buffers it "
+                        "holds) for the cache's lifetime — cache "
+                        "on a module-level function keyed by "
+                        "immutable config")
+
+        # (b)/(c) need receiver scope: id()-keyed LOCAL dicts are the
+        # standard ephemeral graph-traversal idiom (ids stable while
+        # the traversal holds the objects) and self-owned dicts keyed
+        # by ids of objects the same instance owns are fine too.  The
+        # bug class needs the cache to OUTLIVE the keyed object:
+        # module-level dicts and caches passed in as parameters.
+        for qn, cls, fn in functions_of(sf.tree):
+            local_dicts, params, tainted = set(), set(), set()
+            a = fn.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                        + ([a.vararg] if a.vararg else [])
+                        + ([a.kwarg] if a.kwarg else [])):
+                params.add(arg.arg)
+            for n in walk_host_stmts(fn):
+                if isinstance(n, ast.Assign):
+                    if isinstance(n.value, (ast.Dict, ast.DictComp)):
+                        for t in n.targets:
+                            if isinstance(t, ast.Name):
+                                local_dicts.add(t.id)
+                    # one-step taint: `key = (self, bucket)` — a BARE
+                    # self (not self.attr / self.method()) in a local
+                    # later used as a cache key is still a capture
+                    elif _has_bare_self(n.value):
+                        for t in n.targets:
+                            if isinstance(t, ast.Name):
+                                tainted.add(t.id)
+
+            def self_keyed(slc):
+                return _has_bare_self(slc) or (
+                    isinstance(slc, ast.Name) and slc.id in tainted)
+
+            def shared(recv):
+                """Receiver outlives the function: a module-level dict
+                or a caller-owned cache parameter (minus self/cls)."""
+                if not isinstance(recv, ast.Name):
+                    return False
+                if recv.id in local_dicts:
+                    return False
+                return recv.id in module_dicts or recv.id in params
+
+            for n in walk_host_stmts(fn):
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if not isinstance(t, ast.Subscript):
+                            continue
+                        if shared(t.value) and contains(
+                                t.slice, self._is_id_call):
+                            yield sf.finding(
+                                self.id, t,
+                                "cache key built from id(obj): ids are "
+                                "recycled after GC (a fresh object can "
+                                "inherit a dead object's compiled "
+                                "program) and the entry pins whatever "
+                                "the closure captured — key on the "
+                                "object itself or on immutable config, "
+                                "with bounded eviction")
+                        elif isinstance(t.value, ast.Name) \
+                                and t.value.id in module_dicts \
+                                and self_keyed(t.slice):
+                            yield sf.finding(
+                                self.id, t,
+                                f"module-level cache {t.value.id!r} "
+                                "keyed by self: the cache outlives the "
+                                "instance and retains it (and its "
+                                "device buffers) forever — key on an "
+                                "immutable config tuple (the "
+                                "_STEP_CACHE/_ModelCfg rule)")
+                elif isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr in ("get", "setdefault") \
+                        and shared(n.func.value) \
+                        and any(contains(arg, self._is_id_call)
+                                for arg in n.args):
+                    yield sf.finding(
+                        self.id, n,
+                        "cache lookup keyed by id(obj) — see the "
+                        "paired store; key on the object or immutable "
+                        "config")
+
+    @staticmethod
+    def _is_id_call(n):
+        return isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+            and n.func.id == "id" and len(n.args) == 1
+
+
+def _has_bare_self(node):
+    """A Name 'self' used as a VALUE (not as the base of self.attr /
+    self.method() — attribute access consumes it)."""
+    if isinstance(node, ast.Name):
+        return node.id == "self"
+    if isinstance(node, ast.Attribute):
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            return False
+        return _has_bare_self(node.value)
+    return any(_has_bare_self(c) for c in ast.iter_child_nodes(node))
+
+
+# -- use-after-donate ---------------------------------------------------------
+@register
+class UseAfterDonateChecker(Checker):
+    id = "use-after-donate"
+    doc = ("donate_argnums hands the argument's buffer to XLA: after "
+           "the call the array is logically deleted. CPU ignores "
+           "donation, so a read-after-donate passes every CPU test and "
+           "fails only on TPU (with a deleted-buffer error at best, "
+           "silent corruption via aliasing at worst). The checker "
+           "tracks jits created with donate_argnums — including "
+           "through the repo's _donate() TPU-only guard — and flags "
+           "reads of a donated name/attribute after the donating call "
+           "in the same function, unless it was reassigned (the "
+           "`x, … = f(x, …)` commit idiom).")
+
+    def check(self, sf, ctx):
+        funcs = functions_of(sf.tree)
+
+        def annotated(n):
+            """`# mxtpu-lint: donates=i,j` positions on any line of the
+            assignment — the opt-in for factory-returned donating
+            programs (e.g. cached_sgd_step) that per-module analysis
+            cannot see into."""
+            for ln in range(n.lineno, getattr(n, "end_lineno",
+                                              n.lineno) + 1):
+                if ln in sf.donates:
+                    return list(sf.donates[ln])
+            return None
+
+        donated_fns = {}        # callable dotted-name -> positions
+        for _, cls, node in funcs:
+            for n in walk_host_stmts(node):
+                if not isinstance(n, ast.Assign):
+                    continue
+                pos = donated_argnums(n.value) if isinstance(
+                    n.value, ast.Call) else None
+                pos = pos or annotated(n)
+                if not pos:
+                    continue
+                for t in n.targets:
+                    name = dotted(t)
+                    if name:
+                        donated_fns[name] = pos
+        # module-level jits too
+        for n in sf.tree.body:
+            if isinstance(n, ast.Assign):
+                pos = donated_argnums(n.value) if isinstance(
+                    n.value, ast.Call) else None
+                pos = pos or annotated(n)
+                if pos:
+                    for t in n.targets:
+                        name = dotted(t)
+                        if name:
+                            donated_fns[name] = pos
+        if not donated_fns:
+            return
+
+        for qn, cls, fn_node in funcs:
+            yield from self._check_fn(sf, qn, fn_node, donated_fns)
+
+    def _check_fn(self, sf, qn, fn_node, donated_fns):
+        # statement-level path bookkeeping: for each donating call,
+        # "later" means the statements AFTER its enclosing statement in
+        # every enclosing block (linear flow only — no sibling
+        # branches, no loop back-edges: branch- and loop-carried flows
+        # are out of scope, trading false negatives for zero noise).
+        donations = []        # (chain, donating stmt, path)
+
+        def scan(block, path):
+            for i, stmt in enumerate(block):
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                here = path + [(block, i)]
+                for node in _stmt_nodes(stmt):
+                    if isinstance(node, ast.Call):
+                        cn = call_name(node)
+                        pos = donated_fns.get(cn) if cn else None
+                        if not pos:
+                            continue
+                        for p in pos:
+                            if p < len(node.args):
+                                chain = dotted(node.args[p])
+                                if chain:
+                                    donations.append(
+                                        (chain, stmt, node, list(here)))
+                for sub in _sub_blocks(stmt):
+                    scan(sub, here)
+
+        scan(fn_node.body, [])
+
+        for chain, stmt, call, path in donations:
+            # reassigned by the donating statement itself (the
+            # `x, … = f(x, …)` commit idiom) — satisfied immediately
+            if chain in _stmt_store_chains(stmt):
+                continue
+            # linearized execution order after the donating statement:
+            # rest of the innermost block first, then outer blocks
+            later = []
+            for block, i in reversed(path):
+                later.extend(block[i + 1:])
+            reassigned = False
+            for nxt in later:
+                if reassigned:
+                    break
+                loads, stores = _stmt_chain_uses(nxt)
+                if chain in loads:
+                    yield sf.finding(
+                        self.id, call,
+                        f"`{chain}` is read at line "
+                        f"{loads[chain]} after being donated here "
+                        "(donate_argnums): on TPU its buffer is gone "
+                        "after this call — reassign it from the "
+                        "program's outputs or drop the donation")
+                    break
+                if chain in stores:
+                    reassigned = True
+
+
+def _sub_blocks(stmt):
+    """Nested statement blocks of a compound statement (if/for/while/
+    with/try bodies), excluding function/class defs."""
+    for field in ("body", "orelse", "finalbody"):
+        block = getattr(stmt, field, None)
+        if isinstance(block, list) and not isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)):
+            yield block
+    for h in getattr(stmt, "handlers", []) or []:
+        yield h.body
+
+
+def _stmt_nodes(stmt):
+    """Nodes belonging to the statement HEAD only (test/items/value —
+    not nested blocks, not nested defs)."""
+    blocks = set()
+    for b in _sub_blocks(stmt):
+        blocks.update(id(s) for s in b)
+
+    def walk(node):
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if id(child) in blocks or isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda, ast.ClassDef)):
+                continue
+            yield from walk(child)
+
+    yield from walk(stmt)
+
+
+def _stmt_chain_uses(stmt):
+    """({chain: first load line}, {chain: first store line}) over a
+    whole statement including nested blocks (but not nested defs)."""
+    loads, stores = {}, {}
+    for node in _walk_skip_defs(stmt):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            chain = dotted(node)
+            if not chain:
+                continue
+            book = stores if isinstance(node.ctx,
+                                        (ast.Store, ast.Del)) else loads
+            book.setdefault(chain, node.lineno)
+    return loads, stores
+
+
+def _stmt_store_chains(stmt):
+    """Chains stored by the statement head (assignment targets)."""
+    out = set()
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, (ast.Name, ast.Attribute)) \
+                    and isinstance(node.ctx, ast.Store):
+                chain = dotted(node)
+                if chain:
+                    out.add(chain)
+    return out
+
+
+# -- env-discipline -----------------------------------------------------------
+_ENV_PARSERS = {"env_flag", "env_int", "env_float", "base.env_flag",
+                "base.env_int", "base.env_float"}
+
+
+@register
+class EnvDisciplineChecker(Checker):
+    id = "env-discipline"
+    doc = ("MXTPU_* knobs are the runtime-config contract: every name "
+           "must have a row in docs/env_vars.md (the drift gate "
+           "tools/check_env_docs.py pioneered, folded into this "
+           "checker), and boolean/numeric knobs must parse through "
+           "base.env_flag/env_int/env_float so accepted spellings "
+           "can't fork per call site (inline int(os.environ[...]) "
+           "crashes on a malformed value; ad-hoc truthiness helpers "
+           "drift).")
+
+    def check(self, sf, ctx):
+        docs = ctx.doc_vars()
+        var_re = ctx.ENV_VAR_RE
+        # (u) undocumented vars: text-level, any mention counts (same
+        # contract as the original check_env_docs gate)
+        for i, line in enumerate(sf.lines, 1):
+            for var in var_re.findall(line):
+                if var not in docs:
+                    f = sf.finding(self.id, _FakeNode(i),
+                                   f"{var} is not documented in "
+                                   f"docs/env_vars.md — add a row "
+                                   "(name, default, meaning)")
+                    yield f
+        # (p) inline parsing of MXTPU_* reads
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in ("int", "float",
+                                                      "bool"):
+                # contains(), not a direct match: `int(get(...) or 1)`
+                # style wrappers are the same inline parse
+                if node.args and contains(node.args[0],
+                                          self._mxtpu_env_read):
+                    yield sf.finding(
+                        self.id, node,
+                        f"inline {fn.id}() over an MXTPU_* env read — "
+                        "use base.env_flag/env_int/env_float (one "
+                        "parser, malformed values fall back instead "
+                        "of raising)")
+            elif isinstance(fn, ast.Name) \
+                    and fn.id.lstrip("_").startswith("env") \
+                    and fn.id not in _ENV_PARSERS \
+                    and any(self._mentions_mxtpu(a, ctx)
+                            for a in node.args):
+                yield sf.finding(
+                    self.id, node,
+                    f"custom env parser {fn.id}() over an MXTPU_* "
+                    "knob — accepted spellings fork per helper; use "
+                    "base.env_flag/env_int/env_float")
+
+    @staticmethod
+    def _mxtpu_env_read(node):
+        """os.environ.get("MXTPU_…"), os.getenv("MXTPU_…"),
+        os.environ["MXTPU_…"]."""
+        def lit_mxtpu(n):
+            return isinstance(n, ast.Constant) \
+                and isinstance(n.value, str) \
+                and n.value.startswith("MXTPU_")
+
+        if isinstance(node, ast.Call):
+            cn = call_name(node) or ""
+            if cn in ("os.environ.get", "os.getenv", "environ.get",
+                      "getenv") and node.args:
+                return lit_mxtpu(node.args[0])
+        if isinstance(node, ast.Subscript):
+            base = dotted(node.value)
+            if base in ("os.environ", "environ"):
+                return lit_mxtpu(node.slice)
+        return False
+
+    def _mentions_mxtpu(self, node, ctx):
+        """An env read of an MXTPU var, or an MXTPU_* name literal —
+        `_env("MXTPU_SERVE_TP", 1)`-style helpers take the NAME, not
+        the read, and must not evade the rule."""
+        def mxtpu_literal(n):
+            return isinstance(n, ast.Constant) \
+                and isinstance(n.value, str) \
+                and n.value.startswith("MXTPU_")
+
+        return contains(node, self._mxtpu_env_read) \
+            or contains(node, mxtpu_literal)
+
+
+class _FakeNode:
+    """Line-only anchor for text-level findings."""
+
+    def __init__(self, lineno, col_offset=0):
+        self.lineno = lineno
+        self.col_offset = col_offset
+
+
+# -- unlocked-shared-state ----------------------------------------------------
+_MUTATORS = {"append", "appendleft", "extend", "insert", "pop", "popleft",
+             "popitem", "remove", "clear", "update", "add", "discard",
+             "setdefault", "sort", "reverse"}
+
+
+@register
+class UnlockedSharedStateChecker(Checker):
+    id = "unlocked-shared-state"
+    doc = ("An attribute annotated `# guarded-by: <lock>` on its "
+           "declaring assignment documents a locking contract; this "
+           "checker enforces it lexically: every mutation (assignment, "
+           "augmented assignment, item store, or a mutating method "
+           "like .append/.pop/.update) in any method other than "
+           "__init__ must sit inside `with self.<lock>:`. Cross-thread "
+           "state in the serve scheduler, block manager, flight "
+           "recorder and prefetch iterators carries these "
+           "annotations.")
+
+    def check(self, sf, ctx):
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(sf, node)
+
+    def _check_class(self, sf, cls):
+        guarded = {}          # attr -> lock attr name (self.<lock>)
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        for m in methods:
+            for node in ast.walk(m):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                lock = sf.guards.get(node.lineno)
+                if not lock:
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    chain = dotted(t)
+                    if chain and chain.startswith("self."):
+                        guarded[chain[5:]] = lock.split(".")[-1]
+        if not guarded:
+            return
+        for m in methods:
+            if m.name == "__init__":
+                continue      # construction precedes sharing
+            yield from self._check_method(sf, cls.name, m, guarded)
+
+    def _check_method(self, sf, clsname, method, guarded):
+        def visit(node, locks):
+            if isinstance(node, ast.With):
+                held = set(locks)
+                for item in node.items:
+                    chain = dotted(item.context_expr)
+                    if chain and chain.startswith("self."):
+                        held.add(chain[5:])
+                for child in node.body:
+                    yield from visit(child, held)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            yield from self._mutations(sf, clsname, method, node,
+                                       locks, guarded)
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, locks)
+
+        for stmt in method.body:
+            yield from visit(stmt, set())
+
+    def _mutations(self, sf, clsname, method, node, locks, guarded):
+        def flag(attr, what, anchor):
+            lock = guarded[attr]
+            if lock not in locks:
+                yield sf.finding(
+                    self.id, anchor,
+                    f"{what} of self.{attr} in "
+                    f"{clsname}.{method.name} outside `with "
+                    f"self.{lock}` (declared # guarded-by: {lock})")
+
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                chain = dotted(t)
+                if chain and chain.startswith("self.") \
+                        and chain[5:] in guarded:
+                    yield from flag(chain[5:], "assignment", node)
+                elif isinstance(t, ast.Subscript):
+                    chain = dotted(t.value)
+                    if chain and chain.startswith("self.") \
+                            and chain[5:] in guarded:
+                        yield from flag(chain[5:], "item store", node)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                chain = dotted(base)
+                if chain and chain.startswith("self.") \
+                        and chain[5:] in guarded:
+                    yield from flag(chain[5:], "delete", node)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            chain = dotted(node.func.value)
+            if chain and chain.startswith("self.") \
+                    and chain[5:] in guarded:
+                yield from flag(chain[5:],
+                                f".{node.func.attr}()", node)
+
+
+# -- swallowed-exception ------------------------------------------------------
+@register
+class SwallowedExceptionChecker(Checker):
+    id = "swallowed-exception"
+    doc = ("A bare `except:` or `except Exception:` whose body is only "
+           "pass/continue erases the failure: no counter moves, no log "
+           "line lands, and the outage is debugged from nothing. "
+           "Handlers must at minimum count an errors-total metric or "
+           "log before continuing; intentional last-resort guards "
+           "(interpreter-exit paths) carry suppressions. Handlers that "
+           "assign a fallback, return, raise, or call anything are "
+           "considered handled.")
+
+    _BROAD = {"Exception", "BaseException"}
+
+    def check(self, sf, ctx):
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node.type):
+                continue
+            if self._body_swallows(node.body):
+                what = "bare except:" if node.type is None else \
+                    f"except {ast.unparse(node.type)}:"
+                yield sf.finding(
+                    self.id, node,
+                    f"{what} with a pass/continue-only body swallows "
+                    "the failure — count an mxtpu_*_errors_total "
+                    "counter or log before continuing (or narrow the "
+                    "exception type to the expected case)")
+
+    def _is_broad(self, type_node):
+        if type_node is None:
+            return True
+        if isinstance(type_node, ast.Tuple):
+            return any(self._is_broad(e) for e in type_node.elts)
+        name = dotted(type_node)
+        return name in self._BROAD if name else False
+
+    @staticmethod
+    def _body_swallows(body):
+        for stmt in body:
+            if isinstance(stmt, (ast.Pass, ast.Continue)):
+                continue
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                         ast.Constant):
+                continue      # docstring/comment-like constant
+            return False      # anything else is handling
+        return True

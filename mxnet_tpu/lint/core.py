@@ -1,0 +1,323 @@
+"""mxtpu-lint core: source scanning, checker registry, suppressions,
+baseline bookkeeping.
+
+The linter is pure stdlib (``ast`` + ``tokenize``) so it can run in any
+environment the package installs into — including the tier-1 test tier
+with ``JAX_PLATFORMS=cpu`` — without importing jax or the modules under
+analysis.  Everything is text-level: checkers receive a parsed
+:class:`SourceFile` and return :class:`Finding` objects.
+
+Vocabulary:
+
+* **checker** — one registered rule (``wall-clock``, ``host-sync``, …)
+  with a stable id; see checkers.py for the implementations.
+* **suppression** — ``# mxtpu-lint: disable=<id>[,<id>…] (reason)``
+  on the offending line (or on a comment-only line directly above it).
+  ``disable=all`` silences every checker for that line.  The reason
+  parenthetical is convention, not syntax — but reviews should treat a
+  reasonless waiver as a smell.
+* **baseline** — a committed JSON file of grandfathered findings; the
+  CLI fails only on findings NOT in the baseline, so the gate can land
+  before the burn-down finishes.  Entries match on
+  ``(check, path, stripped source line)`` — stable across unrelated
+  line drift — with a count, so N identical offending lines in one
+  file need a count of N.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+
+__all__ = ["Finding", "SourceFile", "LintContext", "register",
+           "all_checkers", "run_lint", "load_baseline", "save_baseline",
+           "apply_baseline", "iter_py_files"]
+
+SUPPRESS_RE = re.compile(
+    r"#\s*mxtpu-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+# `x = make_step(...)   # mxtpu-lint: donates=0,3` — declares that the
+# bound callable donates those positional args (how factory-returned
+# donating programs, invisible to cross-module analysis, opt into the
+# use-after-donate checker at their call sites)
+DONATES_RE = re.compile(r"#\s*mxtpu-lint:\s*donates=([0-9, ]+)")
+
+
+class Finding:
+    """One lint finding, pinned to a source line."""
+
+    __slots__ = ("check", "path", "line", "col", "message", "code")
+
+    def __init__(self, check, path, line, col, message, code=""):
+        self.check = check
+        self.path = path
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+        self.code = code.strip()
+
+    def baseline_key(self):
+        """(check, path, stripped code line) — survives line drift."""
+        return (self.check, self.path, self.code)
+
+    def to_dict(self):
+        return {"check": self.check, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message, "code": self.code}
+
+    def render(self):
+        return f"{self.path}:{self.line}:{self.col}: " \
+               f"[{self.check}] {self.message}"
+
+    def __repr__(self):
+        return f"Finding({self.render()!r})"
+
+
+class SourceFile:
+    """One parsed source file: AST + per-line comment annotations.
+
+    ``suppressions`` maps line -> set of checker ids disabled there
+    (``{"all"}`` disables everything).  A suppression on a comment-only
+    line applies to the next line, so multi-line statements can carry
+    their waiver above the code.  ``guards`` maps line -> lock name
+    from ``# guarded-by: <lock>`` annotations.
+    """
+
+    def __init__(self, path, text, relpath=None):
+        self.path = path
+        self.relpath = relpath or path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text)       # SyntaxError propagates
+        self.suppressions = {}
+        self.guards = {}
+        self.donates = {}          # line -> (donated positions, ...)
+        self._scan_comments()
+
+    def _scan_comments(self):
+        comments = {}                      # line -> comment text
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    comments[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError):
+            # fall back to a naive per-line scan; a '#' inside a string
+            # may over-match, which can only over-suppress one line
+            for i, line in enumerate(self.lines, 1):
+                if "#" in line:
+                    comments[i] = line[line.index("#"):]
+        def code_before_hash(i):
+            line = self.lines[i - 1] if 0 < i <= len(self.lines) else ""
+            return line[:line.index("#")] if "#" in line else line
+
+        for lineno, comment in comments.items():
+            target = lineno
+            if not code_before_hash(lineno).strip():
+                # standalone comment: applies to the next code line,
+                # skipping over the rest of the comment block
+                target = lineno + 1
+                while target <= len(self.lines) and (
+                        not self.lines[target - 1].strip()
+                        or self.lines[target - 1].lstrip()
+                        .startswith("#")):
+                    target += 1
+            m = SUPPRESS_RE.search(comment)
+            if m:
+                checks = {c.strip() for c in m.group(1).split(",")
+                          if c.strip()}
+                self.suppressions.setdefault(target, set()).update(checks)
+            g = GUARD_RE.search(comment)
+            if g:
+                # guard annotations always bind to the code on THEIR
+                # line (they sit on the attribute assignment)
+                self.guards[lineno] = g.group(1)
+            d = DONATES_RE.search(comment)
+            if d:
+                pos = tuple(int(x) for x in d.group(1).split(",")
+                            if x.strip())
+                if pos:
+                    self.donates[target] = pos
+
+    def suppressed(self, line, check):
+        s = self.suppressions.get(line)
+        return bool(s) and (check in s or "all" in s)
+
+    def finding(self, check, node, message):
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        code = self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+        return Finding(check, self.relpath, line, col, message, code)
+
+
+class LintContext:
+    """Run-wide state checkers may consult (repo root, documented env
+    vars).  Built once per ``run_lint`` call."""
+
+    ENV_DOC = os.path.join("docs", "env_vars.md")
+    ENV_VAR_RE = re.compile(r"\bMXTPU_[A-Z0-9]+(?:_[A-Z0-9]+)*\b")
+
+    def __init__(self, repo):
+        self.repo = repo
+        self._doc_vars = None
+
+    def doc_vars(self):
+        """MXTPU_* names documented in docs/env_vars.md (empty set when
+        the doc is absent — every var is then a finding, which is the
+        correct failure mode for a repo that lost its env table)."""
+        if self._doc_vars is None:
+            path = os.path.join(self.repo, self.ENV_DOC)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    self._doc_vars = set(self.ENV_VAR_RE.findall(f.read()))
+            except OSError:
+                self._doc_vars = set()
+        return self._doc_vars
+
+
+# -- checker registry ---------------------------------------------------------
+_CHECKERS = {}
+
+
+def register(cls):
+    """Class decorator: add a checker to the registry by its ``id``."""
+    if not getattr(cls, "id", None):
+        raise ValueError(f"checker {cls!r} needs a non-empty id")
+    if cls.id in _CHECKERS:
+        raise ValueError(f"duplicate checker id {cls.id!r}")
+    _CHECKERS[cls.id] = cls
+    return cls
+
+
+def all_checkers():
+    """{id: checker class}, import-complete (checkers.py registers on
+    import)."""
+    from . import checkers  # noqa: F401  (registration side effect)
+
+    return dict(_CHECKERS)
+
+
+# -- running ------------------------------------------------------------------
+def iter_py_files(paths):
+    """Yield every .py file under the given files/directories, skipping
+    __pycache__ and hidden directories, in sorted order."""
+    seen = set()
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py") and p not in seen:
+                seen.add(p)
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    full = os.path.join(dirpath, fn)
+                    if full not in seen:
+                        seen.add(full)
+                        yield full
+
+
+def run_lint(paths, repo=None, checks=None):
+    """Lint every .py file under ``paths``.
+
+    Returns ``(findings, errors)`` — findings sorted by (path, line,
+    check) with suppressed ones already dropped; errors is a list of
+    ``(path, message)`` for files that failed to parse (a parse failure
+    is loud, not silent: the CLI reports and fails on them).
+    """
+    repo = repo or os.getcwd()
+    ctx = LintContext(repo)
+    registry = all_checkers()
+    if checks:
+        unknown = set(checks) - set(registry)
+        if unknown:
+            raise ValueError(f"unknown checker(s): {sorted(unknown)}; "
+                             f"known: {sorted(registry)}")
+        registry = {k: v for k, v in registry.items() if k in checks}
+    instances = [cls() for _, cls in sorted(registry.items())]
+
+    findings, errors = [], []
+    for path in iter_py_files(paths):
+        rel = os.path.relpath(path, repo)
+        if rel.startswith(".."):
+            rel = path
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+            sf = SourceFile(path, text, relpath=rel.replace(os.sep, "/"))
+        except SyntaxError as e:
+            errors.append((rel, f"syntax error: {e}"))
+            continue
+        except OSError as e:
+            errors.append((rel, f"unreadable: {e}"))
+            continue
+        for chk in instances:
+            for finding in chk.check(sf, ctx):
+                if not sf.suppressed(finding.line, finding.check):
+                    findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.check))
+    return findings, errors
+
+
+# -- baseline -----------------------------------------------------------------
+def load_baseline(path):
+    """Baseline file -> multiset {(check, path, code): count}.  Every
+    entry is expected to carry a ``why`` justifying its grandfathering;
+    absent files mean an empty baseline."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError:
+        return {}
+    counts = {}
+    for e in data.get("entries", []):
+        key = (e["check"], e["path"], e.get("code", "").strip())
+        counts[key] = counts.get(key, 0) + int(e.get("count", 1))
+    return counts
+
+
+def save_baseline(path, findings, why="grandfathered at baseline creation"):
+    """Write the current findings as a baseline (the burn-down
+    starting point).  Identical (check, path, code) findings fold into
+    one entry with a count."""
+    counts = {}
+    for f in findings:
+        counts[f.baseline_key()] = counts.get(f.baseline_key(), 0) + 1
+    entries = [{"check": c, "path": p, "code": code, "count": n,
+                "why": why}
+               for (c, p, code), n in sorted(counts.items())]
+    payload = {
+        "comment": "mxtpu-lint baseline: grandfathered findings. Every "
+                   "entry needs a 'why'; new code must be clean. Shrink "
+                   "this file, never grow it.",
+        "entries": entries}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def apply_baseline(findings, baseline):
+    """Split findings into (new, baselined) against the baseline
+    multiset, and report stale baseline entries (entries no current
+    finding matched — they should be deleted).
+
+    Returns ``(new, baselined, stale)``.
+    """
+    remaining = dict(baseline)
+    new, matched = [], []
+    for f in findings:
+        key = f.baseline_key()
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            matched.append(f)
+        else:
+            new.append(f)
+    stale = [k for k, n in remaining.items() if n > 0]
+    return new, matched, stale

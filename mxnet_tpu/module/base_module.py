@@ -17,6 +17,7 @@ import numpy as np
 from .. import metric as metric_mod
 from .. import ndarray as nd
 from .. import telemetry
+from ..lint.annotations import hot_path
 from ..base import MXNetError, env_flag, env_int
 from ..callback import BatchEndParam
 from ..initializer import Uniform
@@ -315,6 +316,7 @@ class BaseModule:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
             train_data.reset()
 
+    @hot_path
     def _fit_epoch_fused(self, data_iter, eval_metric, batch_end_callback,
                          epoch, ph_data, ph_fused, ph_metric, tel_batches):
         """One epoch on the single-dispatch path: each batch is one

@@ -129,6 +129,8 @@ class LiveLearningCurve(MetricHistory):
 
             display.clear_output(wait=True)
             display.display(self._fig)
+        # mxtpu-lint: disable=swallowed-exception (plain-script mode:
+        # no IPython display — the curve history is still kept)
         except Exception:
             pass
 
@@ -146,5 +148,7 @@ class LiveLearningCurve(MetricHistory):
     def __del__(self):
         try:
             self.close()
+        # mxtpu-lint: disable=swallowed-exception (interpreter-teardown
+        # guard: pyplot may already be torn down under us)
         except Exception:
             pass

@@ -222,6 +222,9 @@ def register(reg_name):
 
             if _c_api._PUBLISHED:
                 _c_api.publish_registry()
+        # mxtpu-lint: disable=swallowed-exception (C-ABI re-publish is
+        # best-effort sync for in-process frontends; Python registry
+        # already holds the op)
         except Exception:
             pass
         return prop_cls

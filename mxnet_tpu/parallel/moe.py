@@ -268,8 +268,8 @@ class MoELayer:
         trainer.cached_sgd_step).  Updates ``last_aux_loss``."""
         from .trainer import cached_sgd_step
 
-        step = cached_sgd_step(self._steps, loss_fn, self._make_objective,
-                               has_aux=True)
+        step = cached_sgd_step(self._steps, loss_fn,  # mxtpu-lint: donates=0
+                               self._make_objective, has_aux=True)
         loss, self.last_aux_loss, self.params = step(self.params, x, lr,
                                                      aux_weight)
         return loss

@@ -182,6 +182,7 @@ class PipelineModule:
         is cached per loss_fn, so a fresh lambda per call recompiles."""
         from .trainer import cached_sgd_step
 
+        # mxtpu-lint: donates=0 (params buffers reused in place on TPU)
         step = cached_sgd_step(self._steps, loss_fn, self._make_objective)
         loss, _, self.params = step(self.params, x, lr)
         return loss

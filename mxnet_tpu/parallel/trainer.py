@@ -31,6 +31,7 @@ from .. import telemetry
 from ..base import MXNetError, np_dtype
 from ..executor import _CompiledGraph
 from ..initializer import Uniform
+from ..lint.annotations import hot_path
 from .. import ndarray as nd
 
 __all__ = ["ShardedTrainer", "sgd_opt", "adam_opt", "adamw_opt",
@@ -43,13 +44,28 @@ def cached_sgd_step(cache, loss_fn, make_objective, has_aux=False):
 
     Returns a jitted ``step(params, x, lr, *extra) -> (loss, aux,
     new_params)`` (``aux`` is None unless ``has_aux``) cached per
-    ``loss_fn`` identity — the cached closure retains ``loss_fn``, so
-    ids cannot be recycled, but callers must pass a stable function
-    object or every call recompiles.  ``make_objective(loss_fn, x,
+    ``loss_fn`` OBJECT — never per ``id(loss_fn)``: an id can be
+    recycled after GC, handing a brand-new loss_fn another function's
+    compiled program (mxtpu-lint's jit-cache-capture rule).  Keying by
+    the object keeps the entry correct, and the bounded eviction below
+    keeps fresh-lambda call sites from pinning compiled programs (and
+    the objective closures they capture) forever.  Callers must still
+    pass a stable function object or every call recompiles.
+
+    ``params`` is donated (TPU-only, like every train step in this
+    repo): the update reuses the weight buffers in place, so callers
+    must rebind — ``…, self.params = step(self.params, …)`` — and never
+    read the donated pytree afterwards.  Cross-module analysis cannot
+    see this factory's jit, so call sites annotate the binding with
+    ``# mxtpu-lint: donates=0`` to put the use-after-donate checker on
+    duty there.  ``make_objective(loss_fn, x,
     *extra)`` builds the ``params -> loss`` (or ``params -> (loss,
     aux)`` with ``has_aux``) objective at trace time.
     """
-    step = cache.get((id(loss_fn), has_aux))
+    from ..optimizer import _donate
+
+    key = (loss_fn, has_aux)
+    step = cache.get(key)
     if step is None:
         def step_fn(params, x, lr, *extra):
             objective = make_objective(loss_fn, x, *extra)
@@ -63,8 +79,11 @@ def cached_sgd_step(cache, loss_fn, make_objective, has_aux=False):
                                                 params, grads)
             return loss, aux, new_params
 
-        step = jax.jit(step_fn)
-        cache[(id(loss_fn), has_aux)] = step
+        step = jax.jit(step_fn, donate_argnums=_donate(0))
+        # bounded like pipeline's _RUN_CACHE: evict oldest first
+        while len(cache) >= 64:
+            cache.pop(next(iter(cache)))
+        cache[key] = step
     return step
 
 
@@ -636,7 +655,10 @@ class ShardedTrainer:
         for name in self.input_names:
             v = batch[name]
             if isinstance(v, nd.NDArray):
+                # mxtpu-lint: disable=host-sync (host batch ingestion —
+                # the input pipeline hands over host arrays here)
                 v = v.asnumpy()
+            # mxtpu-lint: disable=host-sync (host batch ingestion)
             v = np.asarray(v, dtype=self._input_dtypes[name])
             placed[name] = jax.device_put(v, self.batch_shardings[name])
         return placed
@@ -646,9 +668,12 @@ class ShardedTrainer:
         self._num_update += 1
         if self._lr_scheduler is None:
             return np.float32(1.0)
+        # mxtpu-lint: disable=host-sync (host-side Python schedule —
+        # no device value ever flows through the lr scheduler)
         lr = float(self._lr_scheduler(self._num_update))
         return np.float32(lr / max(self._base_lr, 1e-30))
 
+    @hot_path
     def step(self, batch: dict):
         """One optimizer step on a global batch; returns outputs."""
         t0 = time.perf_counter()

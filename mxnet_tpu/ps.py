@@ -37,6 +37,8 @@ import zlib
 
 import numpy as np
 
+from .base import env_float
+
 __all__ = ["PSServer", "PSClient", "ShardedPSClient", "BIGARRAY_BOUND"]
 
 # reference MXNET_KVSTORE_BIGARRAY_BOUND default (kvstore_dist.h)
@@ -44,7 +46,7 @@ BIGARRAY_BOUND = int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 10 ** 6))
 
 # a sync merge or barrier that outlives this is treated as a dead-worker
 # failure and surfaced as an error instead of hanging the job
-SYNC_TIMEOUT_S = float(os.environ.get("MXTPU_PS_SYNC_TIMEOUT", 300))
+SYNC_TIMEOUT_S = env_float("MXTPU_PS_SYNC_TIMEOUT", 300)
 
 
 def _send_msg(sock, obj):

@@ -52,7 +52,8 @@ import jax.numpy as jnp
 from .. import jax_compat, telemetry
 from ..aot import export_store as aot_store
 from ..aot import warmup as aot_warmup
-from ..base import env_flag
+from ..base import env_flag, env_int
+from ..lint.annotations import hot_path
 from ..models.generate import (_fc, _gelu, _ln, detect_gpt_variant,
                                normalize_gpt_params,
                                reconcile_decode_config)
@@ -183,17 +184,14 @@ class Engine:
         if window < 0:
             raise ValueError(f"window must be >= 0 (got {window})")
 
-        def _env(key, default):
-            return int(os.environ.get(key, default))
-
         self.block_size = (int(block_size) if block_size is not None
-                           else _env("MXTPU_SERVE_BLOCK_SIZE", 16))
+                           else env_int("MXTPU_SERVE_BLOCK_SIZE", 16))
         self.num_blocks = (int(num_blocks) if num_blocks is not None
-                           else _env("MXTPU_SERVE_NUM_BLOCKS", 512))
+                           else env_int("MXTPU_SERVE_NUM_BLOCKS", 512))
         self.max_batch = (int(max_batch) if max_batch is not None
-                          else _env("MXTPU_SERVE_MAX_BATCH", 8))
+                          else env_int("MXTPU_SERVE_MAX_BATCH", 8))
         max_queue = (int(max_queue) if max_queue is not None
-                     else _env("MXTPU_SERVE_MAX_QUEUE", 64))
+                     else env_int("MXTPU_SERVE_MAX_QUEUE", 64))
 
         params = normalize_gpt_params(params, name)
         self.spec = detect_gpt_variant(params, num_heads, name)
@@ -203,7 +201,8 @@ class Engine:
         self.temperature = float(temperature)
         self.top_k = top_k
         # -- tensor-parallel mesh + partition rules ------------------------
-        self.tp = int(tp) if tp is not None else _env("MXTPU_SERVE_TP", 1)
+        self.tp = (int(tp) if tp is not None
+                   else env_int("MXTPU_SERVE_TP", 1))
         if self.tp < 1:
             raise ValueError(f"tp must be >= 1 (got {self.tp})")
         self.mesh = None
@@ -439,6 +438,7 @@ class Engine:
                             "sharding_rules_digest": self._rules_digest})
             raise
 
+    @hot_path
     def _step_inner(self):
         self._step_id += 1
         with telemetry.span("serve.step"):
@@ -619,17 +619,16 @@ class Engine:
             return
         for req in list(self.scheduler.running):
             self.scheduler.finish(req, status=CANCELLED)
-        for req in self.scheduler.waiting:
+        for req in self.scheduler.drain_waiting():
             req.status = CANCELLED
             req.finish_t = self.clock()
             self._rtrace.terminal(req, CANCELLED)
-        self.scheduler.waiting = []
         self._rtrace.close()
         statusz_mod.unregister(self._statusz_name)
         for arr in self._owned + [self._cache_k, self._cache_v]:
             try:
                 arr.delete()
-            except Exception:
+            except (RuntimeError, ValueError):
                 pass              # already donated-away or deleted
         self._owned = []
         self._cache_k = self._cache_v = None
@@ -643,9 +642,12 @@ class Engine:
         blk = np.zeros(pad_to, np.int32)
         off = np.arange(pad_to, dtype=np.int32) % self.block_size
         pos = np.arange(n)
+        # mxtpu-lint: disable=host-sync (block tables are host lists —
+        # pure host-side scatter-target math, no device values)
         blk[:n] = np.asarray(table, np.int32)[pos // self.block_size]
         return blk, off
 
+    @hot_path
     def _run_prefill(self, req):
         ids = req.prefill_ids()
         n = ids.size
@@ -663,7 +665,13 @@ class Engine:
                 self.params, self._cache_k, self._cache_v,
                 jnp.asarray(toks), jnp.asarray(n, jnp.int32),
                 jnp.asarray(blk), jnp.asarray(off), sub)
-            if not bool(ok):
+            # one batched read: the sampled token must reach the host
+            # anyway, so the watchdog flag rides the same sync instead
+            # of forcing a second one
+            # mxtpu-lint: disable=host-sync (designed sync point: the
+            # scheduler needs the sampled token on the host)
+            tok, ok = jax.device_get((tok, ok))
+            if not ok:
                 flight_mod.record_anomaly("prefill_logits", rid=req.rid,
                                           step=self._step_id)
         else:
@@ -674,7 +682,7 @@ class Engine:
         self._rtrace.event(req, "prefill_end", tokens=int(n),
                            resume=resume)
         req.cache_len = n
-        self.scheduler.running.append(req)
+        self.scheduler.admit_running(req)
         now = self.clock()
         if req.first_token_t is None:
             req.first_token_t = now
@@ -682,6 +690,7 @@ class Engine:
         req.tokens.append(int(tok))
         self._maybe_finish(req)
 
+    @hot_path
     def _run_decode(self, reqs):
         B = len(reqs)
         bucket = _next_bucket(B, self.max_batch)
@@ -700,7 +709,12 @@ class Engine:
                 self.params, self._cache_k, self._cache_v,
                 jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables),
                 sub)
-            if not bool(ok):
+            # one batched read for tokens + watchdog flag (not a
+            # bool(ok) stall followed by a second asarray stall)
+            # mxtpu-lint: disable=host-sync (designed sync point: the
+            # scheduler needs the sampled tokens on the host)
+            out, ok = jax.device_get((out, ok))
+            if not ok:
                 flight_mod.record_anomaly(
                     "decode_logits", step=self._step_id, batch_size=B,
                     rids=[r.rid for r in reqs])
@@ -709,7 +723,9 @@ class Engine:
                 self.params, self._cache_k, self._cache_v,
                 jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables),
                 sub)
-        out = np.asarray(out)
+            # mxtpu-lint: disable=host-sync (designed sync point: the
+            # scheduler needs the sampled tokens on the host)
+            out = np.asarray(out)
         for i, req in enumerate(reqs):
             req.cache_len += 1
             req.tokens.append(int(out[i]))
@@ -875,7 +891,13 @@ class Engine:
             try:
                 exported = jax_compat.export_fn(jitted, *specs)
             except Exception:
-                return compiled(jitted)  # this jax cannot export
+                # this jax cannot export: fall back to the plain jit,
+                # but count it — a fleet silently serving unexportable
+                # programs loses its warm-restart story
+                telemetry.counter(
+                    "mxtpu_aot_errors_total", "AOT artifact failures",
+                    ("kind",)).labels(kind="export").inc()
+                return compiled(jitted)
             self._aot.save(fp, exported, label=label)
         else:
             telemetry.counter(

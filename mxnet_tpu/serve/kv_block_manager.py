@@ -25,6 +25,7 @@ Lifecycle of a block set:
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict, deque
 
 __all__ = ["BlockManager", "NoFreeBlocks"]
@@ -43,6 +44,14 @@ def blocks_for(n_tokens, block_size):
 
 
 class BlockManager:
+    """Host-side block accounting.  Mutations are serialized by the
+    RLock below: the scheduler drives allocation from the engine's step
+    thread while /statusz snapshots and admission checks may read from
+    others (reads of the annotated structures are point-in-time
+    snapshots; every write path is lock-wrapped and enforced by
+    mxtpu-lint's unlocked-shared-state checker).  Reentrant because
+    ``allocate``/``ensure_capacity`` call ``_take`` under the lock."""
+
     def __init__(self, num_blocks, block_size):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is the null block)")
@@ -50,12 +59,13 @@ class BlockManager:
             raise ValueError("block_size must be >= 1")
         self.num_blocks = num_blocks
         self.block_size = block_size
+        self._lock = threading.RLock()
         # block 0 reserved as the null/padding block
-        self._free = deque(range(1, num_blocks))
-        self._tables = {}          # rid -> [block ids] (live requests)
-        self._lens = {}            # rid -> reserved token capacity
-        self._retained = OrderedDict()   # rid -> [block ids], LRU order
-        self.evictions = 0
+        self._free = deque(range(1, num_blocks))  # guarded-by: _lock
+        self._tables = {}                         # guarded-by: _lock
+        self._lens = {}                           # guarded-by: _lock
+        self._retained = OrderedDict()            # guarded-by: _lock
+        self.evictions = 0                        # guarded-by: _lock
 
     # -- capacity ------------------------------------------------------------
     @property
@@ -65,17 +75,21 @@ class BlockManager:
 
     @property
     def blocks_in_use(self):
-        return sum(len(t) for t in self._tables.values())
+        with self._lock:
+            return sum(len(t) for t in self._tables.values())
 
     @property
     def free_blocks(self):
         """Immediately or lazily reclaimable blocks."""
-        return len(self._free) + sum(len(b) for b in self._retained.values())
+        with self._lock:
+            return (len(self._free)
+                    + sum(len(b) for b in self._retained.values()))
 
     @property
     def retained_blocks(self):
         """Blocks parked in the LRU tier (reclaimable, K/V intact)."""
-        return sum(len(b) for b in self._retained.values())
+        with self._lock:
+            return sum(len(b) for b in self._retained.values())
 
     def utilization(self):
         return self.blocks_in_use / max(1, self.total_blocks)
@@ -85,13 +99,16 @@ class BlockManager:
         /statusz and flight-dump occupancy section.  Counts are BLOCK
         counts and identical at every tensor-parallel degree; byte
         translation per chip lives with the cache owner
-        (``Engine.kv_cache_stats``), which knows the sharding."""
-        return {"in_use": self.blocks_in_use,
-                "retained": self.retained_blocks,
-                "free": len(self._free),
-                "total": self.total_blocks,
-                "utilization": round(self.utilization(), 4),
-                "evictions": self.evictions}
+        (``Engine.kv_cache_stats``), which knows the sharding.  Taken
+        under the lock: a /statusz scrape must see one consistent
+        snapshot, not a dict resizing under its iteration."""
+        with self._lock:
+            return {"in_use": self.blocks_in_use,
+                    "retained": self.retained_blocks,
+                    "free": len(self._free),
+                    "total": self.total_blocks,
+                    "utilization": round(self.utilization(), 4),
+                    "evictions": self.evictions}
 
     def can_allocate(self, n_tokens):
         return blocks_for(n_tokens, self.block_size) <= self.free_blocks
@@ -105,61 +122,70 @@ class BlockManager:
     # -- allocation ----------------------------------------------------------
     def _take(self, n):
         """Pop n free blocks, evicting LRU retained sets as needed."""
-        while len(self._free) < n:
-            if not self._retained:
-                raise NoFreeBlocks(
-                    f"need {n} blocks, {len(self._free)} free and "
-                    "nothing retained to evict")
-            _, blocks = self._retained.popitem(last=False)  # oldest
-            self._free.extend(blocks)
-            self.evictions += 1
-        return [self._free.popleft() for _ in range(n)]
+        with self._lock:
+            while len(self._free) < n:
+                if not self._retained:
+                    raise NoFreeBlocks(
+                        f"need {n} blocks, {len(self._free)} free and "
+                        "nothing retained to evict")
+                _, blocks = self._retained.popitem(last=False)  # oldest
+                self._free.extend(blocks)
+                self.evictions += 1
+            return [self._free.popleft() for _ in range(n)]
 
     def allocate(self, rid, n_tokens):
         """Create ``rid``'s block table covering ``n_tokens`` slots."""
-        if rid in self._tables:
-            raise ValueError(f"request {rid!r} already has a block table")
-        if rid in self._retained:
-            # a preempted request resuming: its parked blocks hold stale
-            # K/V (resume recomputes), so reclaim them up front rather
-            # than leaking the entry when this rid is freed again later
-            self._free.extend(self._retained.pop(rid))
-        n = blocks_for(n_tokens, self.block_size)
-        self._tables[rid] = self._take(n)
-        self._lens[rid] = n * self.block_size
-        return list(self._tables[rid])
+        with self._lock:
+            if rid in self._tables:
+                raise ValueError(
+                    f"request {rid!r} already has a block table")
+            if rid in self._retained:
+                # a preempted request resuming: its parked blocks hold
+                # stale K/V (resume recomputes), so reclaim them up
+                # front rather than leaking the entry when this rid is
+                # freed again later
+                self._free.extend(self._retained.pop(rid))
+            n = blocks_for(n_tokens, self.block_size)
+            self._tables[rid] = self._take(n)
+            self._lens[rid] = n * self.block_size
+            return list(self._tables[rid])
 
     def ensure_capacity(self, rid, n_tokens):
         """Grow ``rid``'s table to cover ``n_tokens`` slots (decode
         appends).  Raises NoFreeBlocks when the cache is exhausted —
         the scheduler's preemption trigger."""
-        table = self._tables[rid]
-        need = blocks_for(n_tokens, self.block_size) - len(table)
-        if need > 0:
-            table.extend(self._take(need))
-            self._lens[rid] = len(table) * self.block_size
-        return list(table)
+        with self._lock:
+            table = self._tables[rid]
+            need = blocks_for(n_tokens, self.block_size) - len(table)
+            if need > 0:
+                table.extend(self._take(need))
+                self._lens[rid] = len(table) * self.block_size
+            return list(table)
 
     def table(self, rid):
-        return list(self._tables[rid])
+        with self._lock:
+            return list(self._tables[rid])
 
     def capacity(self, rid):
         """Token slots currently reserved for ``rid``."""
-        return self._lens[rid]
+        with self._lock:
+            return self._lens[rid]
 
     def free(self, rid, retain=True):
         """Release ``rid``'s blocks.  ``retain=True`` (finished or
         preempted requests) parks them in the LRU tier; ``retain=False``
         returns them to the free list immediately."""
-        blocks = self._tables.pop(rid)
-        self._lens.pop(rid)
-        if retain:
-            self._retained[rid] = blocks
-        else:
-            self._free.extend(blocks)
+        with self._lock:
+            blocks = self._tables.pop(rid)
+            self._lens.pop(rid)
+            if retain:
+                self._retained[rid] = blocks
+            else:
+                self._free.extend(blocks)
 
     def reset(self):
-        self._free = deque(range(1, self.num_blocks))
-        self._tables.clear()
-        self._lens.clear()
-        self._retained.clear()
+        with self._lock:
+            self._free = deque(range(1, self.num_blocks))
+            self._tables.clear()
+            self._lens.clear()
+            self._retained.clear()

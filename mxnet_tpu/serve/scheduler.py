@@ -20,6 +20,7 @@ makes token-exact (tested by test_serve.py's resume-equivalence case).
 from __future__ import annotations
 
 import itertools
+import threading
 import time
 
 import numpy as np
@@ -91,6 +92,13 @@ class Request:
 
 
 class Scheduler:
+    """Iteration scheduler.  ``submit()`` may be called from request-
+    handler threads while the engine's step thread runs ``schedule()``;
+    the RLock below covers every mutation of the shared queues and
+    counters (reentrant, because ``schedule`` preempts inline).  The
+    ``# guarded-by`` annotations are enforced lexically by mxtpu-lint's
+    unlocked-shared-state checker."""
+
     def __init__(self, block_mgr, max_batch, max_queue,
                  max_prefills_per_step=1, clock=time.monotonic,
                  trace=None):
@@ -103,43 +111,48 @@ class Scheduler:
         # decision this scheduler makes is an event on it; the default
         # no-op keeps bare Scheduler tests wiring-free
         self.trace = trace if trace is not None else NOOP_TRACER
-        self.waiting = []          # FIFO by arrival (rids are monotonic)
-        self.running = []          # admission order preserved
-        self.preemptions = 0
-        self.rejections = 0
-        self.reject_reasons = {}   # reason -> cumulative count
+        self._lock = threading.RLock()
+        self.waiting = []          # guarded-by: _lock
+        self.running = []          # guarded-by: _lock
+        self.preemptions = 0       # guarded-by: _lock
+        self.rejections = 0        # guarded-by: _lock
+        self.reject_reasons = {}   # guarded-by: _lock
 
     # -- admission -----------------------------------------------------------
     def submit(self, req):
         self.trace.submitted(req)
-        if len(self.waiting) >= self.max_queue:
-            # back-pressure raise: the request never queues, but it
-            # counts in rejections/reject_reasons and its trace closes
-            # with the same reason code — the scheduler is the single
-            # owner of the rejected total, so every view (ServeStats,
-            # monitor bracket, trace) agrees even for callers driving
-            # a bare Scheduler (the caller may retry with a NEW Request)
-            self.rejections += 1
-            self.reject_reasons["queue_full"] = \
-                self.reject_reasons.get("queue_full", 0) + 1
-            self.trace.terminal(req, "rejected", reason="queue_full")
-            raise QueueFull(
-                f"admission queue full ({self.max_queue} waiting)")
-        if not self.blocks.fits_at_all(req.target_len()):
-            # would OOM the cache even running alone: reject NOW, at
-            # submit, rather than deadlock in the waiting queue
-            self._reject(req, "exceeds_cache")
-            return req
-        req.submit_t = self.clock()
-        self.waiting.append(req)
+        with self._lock:
+            if len(self.waiting) >= self.max_queue:
+                # back-pressure raise: the request never queues, but it
+                # counts in rejections/reject_reasons and its trace
+                # closes with the same reason code — the scheduler is
+                # the single owner of the rejected total, so every view
+                # (ServeStats, monitor bracket, trace) agrees even for
+                # callers driving a bare Scheduler (the caller may
+                # retry with a NEW Request)
+                self.rejections += 1
+                self.reject_reasons["queue_full"] = \
+                    self.reject_reasons.get("queue_full", 0) + 1
+                self.trace.terminal(req, "rejected", reason="queue_full")
+                raise QueueFull(
+                    f"admission queue full ({self.max_queue} waiting)")
+            if not self.blocks.fits_at_all(req.target_len()):
+                # would OOM the cache even running alone: reject NOW,
+                # at submit, rather than deadlock in the waiting queue
+                self._reject(req, "exceeds_cache")
+                return req
+            req.submit_t = self.clock()
+            self.waiting.append(req)
         return req
 
     def _reject(self, req, reason):
         req.status = REJECTED
         req.reject_reason = reason
         req.finish_t = self.clock()
-        self.rejections += 1
-        self.reject_reasons[reason] = self.reject_reasons.get(reason, 0) + 1
+        with self._lock:
+            self.rejections += 1
+            self.reject_reasons[reason] = \
+                self.reject_reasons.get(reason, 0) + 1
         if req.trace_id is None:
             # rejected before scheduler.submit ever saw it (the
             # engine's exceeds_max_len guard): open the trace so the
@@ -170,56 +183,58 @@ class Scheduler:
            same iteration's preemption victim.
         """
         now = self.clock()
-        keep = []
-        for req in self.waiting:
-            if (req.deadline_s is not None
-                    and now - req.submit_t > req.deadline_s):
-                self._reject(req, "deadline")
-            else:
-                keep.append(req)
-        self.waiting = keep
-
-        decodes = []
-        for req in list(self.running):
-            if req not in self.running:
-                continue           # preempted as an earlier victim
-            try:
-                self.blocks.ensure_capacity(req.rid, req.cache_len + 1)
-            except NoFreeBlocks:
-                victim = self._pick_victim(req)
-                self.preempt(victim)
-                if victim is not req:
-                    # retry once with the victim's blocks reclaimed
-                    try:
-                        self.blocks.ensure_capacity(req.rid,
-                                                    req.cache_len + 1)
-                    except NoFreeBlocks:
-                        self.preempt(req)
-                        continue
+        with self._lock:
+            keep = []
+            for req in self.waiting:
+                if (req.deadline_s is not None
+                        and now - req.submit_t > req.deadline_s):
+                    self._reject(req, "deadline")
                 else:
-                    continue
-            decodes.append(req)
-        # a request scheduled early in the loop can still become a later
-        # request's preemption victim — keep only survivors
-        decodes = [r for r in decodes if r in self.running]
+                    keep.append(req)
+            self.waiting = keep
 
-        prefills = []
-        while (self.waiting
-               and len(self.running) + len(prefills) < self.max_batch
-               and len(prefills) < self.max_prefills_per_step):
-            req = self.waiting[0]
-            need = req.prefill_ids().size + 1
-            if not self.blocks.can_allocate(need):
-                break              # FIFO head-of-line: no skipping ahead
-            self.waiting.pop(0)
-            self.blocks.allocate(req.rid, need)
-            req.status = RUNNING
-            self.trace.event(req,
-                             "resumed" if req.n_preemptions else "admitted",
-                             queue_depth=len(self.waiting),
-                             n_preemptions=req.n_preemptions)
-            prefills.append(req)
-        return prefills, decodes
+            decodes = []
+            for req in list(self.running):
+                if req not in self.running:
+                    continue       # preempted as an earlier victim
+                try:
+                    self.blocks.ensure_capacity(req.rid,
+                                                req.cache_len + 1)
+                except NoFreeBlocks:
+                    victim = self._pick_victim(req)
+                    self.preempt(victim)
+                    if victim is not req:
+                        # retry once with the victim's blocks reclaimed
+                        try:
+                            self.blocks.ensure_capacity(
+                                req.rid, req.cache_len + 1)
+                        except NoFreeBlocks:
+                            self.preempt(req)
+                            continue
+                    else:
+                        continue
+                decodes.append(req)
+            # a request scheduled early in the loop can still become a
+            # later request's preemption victim — keep only survivors
+            decodes = [r for r in decodes if r in self.running]
+
+            prefills = []
+            while (self.waiting
+                   and len(self.running) + len(prefills) < self.max_batch
+                   and len(prefills) < self.max_prefills_per_step):
+                req = self.waiting[0]
+                need = req.prefill_ids().size + 1
+                if not self.blocks.can_allocate(need):
+                    break          # FIFO head-of-line: no skipping ahead
+                self.waiting.pop(0)
+                self.blocks.allocate(req.rid, need)
+                req.status = RUNNING
+                self.trace.event(
+                    req, "resumed" if req.n_preemptions else "admitted",
+                    queue_depth=len(self.waiting),
+                    n_preemptions=req.n_preemptions)
+                prefills.append(req)
+            return prefills, decodes
 
     def _pick_victim(self, needy):
         """Lowest priority = latest arrival among running requests."""
@@ -229,21 +244,36 @@ class Scheduler:
         """Free ``req``'s blocks and push it back to the FRONT of the
         waiting queue (it arrived before everything waiting behind it,
         so resuming it first preserves FIFO fairness)."""
-        self.running.remove(req)
-        self.blocks.free(req.rid, retain=True)
-        req.status = WAITING
-        req.cache_len = 0
-        req.n_preemptions += 1
-        self.preemptions += 1
-        self.trace.event(req, "preempted", reason="cache_pressure",
-                         generated=len(req.tokens))
-        self.waiting.append(req)
-        self.waiting.sort(key=lambda r: r.rid)   # arrival order
-
-    def finish(self, req, status=FINISHED):
-        if req in self.running:
+        with self._lock:
             self.running.remove(req)
             self.blocks.free(req.rid, retain=True)
+            req.status = WAITING
+            req.cache_len = 0
+            req.n_preemptions += 1
+            self.preemptions += 1
+            self.trace.event(req, "preempted", reason="cache_pressure",
+                             generated=len(req.tokens))
+            self.waiting.append(req)
+            self.waiting.sort(key=lambda r: r.rid)   # arrival order
+
+    def finish(self, req, status=FINISHED):
+        with self._lock:
+            if req in self.running:
+                self.running.remove(req)
+                self.blocks.free(req.rid, retain=True)
         req.status = status
         req.finish_t = self.clock()
         self.trace.terminal(req, status, generated=len(req.tokens))
+
+    def admit_running(self, req):
+        """Engine hook: a prefilled request enters the decode batch."""
+        with self._lock:
+            self.running.append(req)
+
+    def drain_waiting(self):
+        """Engine shutdown: atomically take (and clear) the waiting
+        queue so a racing ``submit`` cannot land a request in a list
+        nobody will ever schedule again."""
+        with self._lock:
+            drained, self.waiting = self.waiting, []
+            return drained

@@ -214,18 +214,36 @@ def dump(dir=None):
     }
 
 
+def _note_internal_error(site):
+    """Count a telemetry-internal failure on
+    ``mxtpu_telemetry_errors_total{site}`` — observability failures
+    must at least move a counter (mxtpu-lint swallowed-exception),
+    even though they may never raise into the caller."""
+    try:
+        _registry.counter("mxtpu_telemetry_errors_total",
+                          "telemetry-internal failures",
+                          ("site",)).labels(site=site).inc()
+    # mxtpu-lint: disable=swallowed-exception (last-resort guard: the
+    # error accountant itself must never raise into serving code)
+    except Exception:
+        pass
+
+
 def _atexit_dump():
     try:
         dump()
     except Exception:
-        pass  # never let telemetry turn a clean exit into a traceback
+        # never let telemetry turn a clean exit into a traceback — but
+        # leave a trace for anyone still scraping /metrics at teardown
+        _note_internal_error("atexit_dump")
 
 
-def _env_truthy(value):
-    return value not in (None, "", "0", "false", "False", "off")
+# one parser for every MXTPU_* boolean knob (base.env_flag), so the
+# accepted spellings can't fork between telemetry and the rest of the
+# stack (mxtpu-lint env-discipline)
+from ..base import env_flag  # noqa: E402
 
-
-if _env_truthy(os.environ.get("MXTPU_TELEMETRY")):
+if env_flag("MXTPU_TELEMETRY", False):
     _port = os.environ.get("MXTPU_TELEMETRY_HTTP_PORT")
     enable(dir=os.environ.get("MXTPU_TELEMETRY_DIR"),
            http_port=int(_port) if _port else None,

@@ -78,6 +78,7 @@ def write_prometheus(registry, path):
 
 def append_jsonl(registry, path, extra=None):
     """Append one ``{"ts": ..., "metrics": {...}}`` snapshot line."""
+    # mxtpu-lint: disable=wall-clock (JSONL record timestamp)
     rec = {"ts": round(time.time(), 3), "metrics": registry.snapshot()}
     if extra:
         rec.update(extra)

@@ -57,11 +57,14 @@ class FlightRecorder:
             max_events = env_int(ENV_EVENTS, DEFAULT_EVENTS)
         self.max_events = max(1, int(max_events))
         self.min_dump_interval_s = float(min_dump_interval_s)
-        self._events = deque(maxlen=self.max_events)
-        self._seen = 0
         self._lock = threading.Lock()
-        self._last_dump = {}           # reason -> wall time of last dump
-        self.dumps = 0
+        self._events = deque(maxlen=self.max_events)  # guarded-by: _lock
+        self._seen = 0                                # guarded-by: _lock
+        # reason -> monotonic time of last dump (rate limiting must not
+        # ride the wall clock: an NTP step backwards would re-arm — or
+        # suppress — every reason at once)
+        self._last_dump = {}                          # guarded-by: _lock
+        self.dumps = 0                                # guarded-by: _lock
 
     # -- recording (the always-on hot path) --------------------------------
     def record(self, kind, **fields):
@@ -71,6 +74,8 @@ class FlightRecorder:
         # the ring's own schema and must never be clobbered by a
         # caller's same-named payload field
         ev = dict(fields) if fields else {}
+        # post-mortem events correlate with external logs by timestamp
+        # mxtpu-lint: disable=wall-clock (wall timestamp is the point)
         ev["t"] = time.time()
         ev["kind"] = kind
         with self._lock:
@@ -109,12 +114,17 @@ class FlightRecorder:
         d = self._dir(dir)
         if not d:
             return None
+        # wall for the payload/filename (operators correlate dumps with
+        # logs), monotonic for the rate limit (immune to NTP steps)
+        # mxtpu-lint: disable=wall-clock (post-mortem file timestamp)
         now = time.time()
+        mono = time.monotonic()
         with self._lock:
-            last = self._last_dump.get(reason, 0.0)
-            if not force and now - last < self.min_dump_interval_s:
+            last = self._last_dump.get(reason)
+            if not force and last is not None \
+                    and mono - last < self.min_dump_interval_s:
                 return None
-            self._last_dump[reason] = now
+            self._last_dump[reason] = mono
             events = list(self._events)
             seen = self._seen
         payload = {"ts": round(now, 3), "reason": str(reason),
@@ -131,14 +141,18 @@ class FlightRecorder:
             from mxnet_tpu import telemetry
 
             payload["registry"] = telemetry.registry().snapshot()
-        except Exception:
-            pass
+        except Exception as e:
+            # a broken snapshot must not kill the dump — but the dump
+            # itself records that its registry section is missing
+            payload.setdefault("snapshot_errors", []).append(
+                f"registry: {e!r}")
         try:
             from . import statusz
 
             payload["statusz"] = statusz.snapshot()
-        except Exception:
-            pass
+        except Exception as e:
+            payload.setdefault("snapshot_errors", []).append(
+                f"statusz: {e!r}")
         safe = "".join(c if c.isalnum() or c in "-_" else "_"
                        for c in str(reason))[:64] or "dump"
         path = os.path.join(d, f"flight-{int(now * 1000)}-{safe}.json")
@@ -150,7 +164,8 @@ class FlightRecorder:
             os.replace(tmp, path)
         except OSError:
             return None
-        self.dumps += 1
+        with self._lock:
+            self.dumps += 1
         return path
 
 

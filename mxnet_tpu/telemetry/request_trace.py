@@ -204,7 +204,11 @@ class RequestTracer:
             try:
                 self.on_terminal(req, name, args)
             except Exception:
-                pass               # observability never kills serving
+                # observability never kills serving — but a broken SLO
+                # hook is a real bug and must move a counter
+                from mxnet_tpu import telemetry
+
+                telemetry._note_internal_error("on_terminal_hook")
         events = getattr(req, "_trace_events", None)
         if events is None:
             return

@@ -32,7 +32,9 @@ __all__ = ["register", "register_weak", "unregister", "snapshot",
 
 _lock = threading.Lock()
 _providers = {}                  # name -> zero-arg callable
-_start_t = time.time()
+# uptime is an ELAPSED quantity: monotonic, so an NTP step can't make
+# a 2-minute-old process report hours (or negative seconds) of uptime
+_start_m = time.monotonic()
 _uid = itertools.count()
 
 
@@ -87,7 +89,7 @@ def bytes_by_device(arrays):
                 data = shard.data
                 out[int(dev)] = (out.get(int(dev), 0)
                                  + int(getattr(data, "nbytes", 0)))
-        except Exception:
+        except (RuntimeError, ValueError):
             continue                 # deleted/donated-away array
     return out
 
@@ -112,7 +114,10 @@ def snapshot():
     with _lock:
         providers = dict(_providers)
     out = {"process": {"pid": os.getpid(),
-                       "uptime_s": round(time.time() - _start_t, 3),
+                       "uptime_s": round(time.monotonic() - _start_m, 3),
+                       # mxtpu-lint: disable=wall-clock (the "time"
+                       # field IS the wall timestamp readers correlate
+                       # with their logs)
                        "time": round(time.time(), 3)},
            "jax": _jax_inventory()}
     for name, fn in sorted(providers.items()):

@@ -69,6 +69,7 @@ class _Span:
                 self._xla.__enter__()
             except Exception:
                 self._xla = None
+                self._tracer.xla_ann_errors += 1
         self._t0 = time.perf_counter()
         return self
 
@@ -78,7 +79,9 @@ class _Span:
             try:
                 self._xla.__exit__(*exc)
             except Exception:
-                pass
+                # the host span must still land; the failure is
+                # visible as a counter on the tracer (xla_ann_errors)
+                self._tracer.xla_ann_errors += 1
         self._tracer.add_complete(self.name, self._t0, end, self.args)
         return False
 
@@ -87,6 +90,9 @@ class SpanTracer:
     def __init__(self, max_events=200_000):
         self.max_events = int(max_events)
         self.dropped = 0
+        # jax.profiler.TraceAnnotation enter/exit failures (counted,
+        # never raised — spans still record host-side)
+        self.xla_ann_errors = 0
         self._events = collections.deque()
         self._lock = threading.Lock()
         self._pid = os.getpid()

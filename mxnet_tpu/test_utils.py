@@ -306,21 +306,21 @@ def check_speed(sym, location=None, ctx=None, N=20, grad_req="write",
         exe.forward(is_train=True)
         exe.backward()
         [o.wait_to_read() for o in exe.outputs]
-        tic = time.time()
+        tic = time.perf_counter()
         for _ in range(N):
             exe.forward(is_train=True)
             exe.backward()
         for o in exe.outputs:
             o.wait_to_read()
         nd.waitall()
-        return (time.time() - tic) / N
+        return (time.perf_counter() - tic) / N
     elif typ == "forward":
         exe.forward(is_train=False)
         [o.wait_to_read() for o in exe.outputs]
-        tic = time.time()
+        tic = time.perf_counter()
         for _ in range(N):
             exe.forward(is_train=False)
         for o in exe.outputs:
             o.wait_to_read()
-        return (time.time() - tic) / N
+        return (time.perf_counter() - tic) / N
     raise ValueError("typ must be 'whole' or 'forward'")

@@ -1,0 +1,20 @@
+"""Pre-fix shapes from this PR: inline int()/bool()/float() parses of
+MXTPU_* knobs (kvstore.py, ps.py) and a private truthiness helper
+(telemetry's _env_truthy) — every one a chance for accepted spellings
+to fork between features.  Uses vars documented in docs/env_vars.md so
+only the parse rule fires here (the undocumented-var rule has its own
+tmp-repo test)."""
+import os
+
+
+def _env_truthy(value):
+    return value not in (None, "", "0")
+
+
+def load_config():
+    nproc = int(os.environ.get("MXTPU_NUM_PROCS", "1"))
+    rank = int(os.environ["MXTPU_PROC_ID"])
+    recovery = bool(os.environ.get("MXTPU_IS_RECOVERY"))
+    timeout = float(os.environ.get("MXTPU_PS_SYNC_TIMEOUT", 300))
+    telemetry_on = _env_truthy(os.environ.get("MXTPU_TELEMETRY"))
+    return nproc, rank, recovery, timeout, telemetry_on

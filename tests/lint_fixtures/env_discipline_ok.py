@@ -1,0 +1,15 @@
+"""Post-fix shape: one parser per type in mxnet_tpu.base; raw string
+reads (paths, addresses) stay plain os.environ."""
+import os
+
+from mxnet_tpu.base import env_flag, env_float, env_int
+
+
+def load_config():
+    nproc = env_int("MXTPU_NUM_PROCS", 1)
+    rank = env_int("MXTPU_PROC_ID", 0)
+    recovery = env_flag("MXTPU_IS_RECOVERY", False)
+    timeout = env_float("MXTPU_PS_SYNC_TIMEOUT", 300)
+    telemetry_on = env_flag("MXTPU_TELEMETRY", False)
+    trace_path = os.environ.get("MXTPU_REQUEST_TRACE")   # string: fine
+    return nproc, rank, recovery, timeout, telemetry_on, trace_path

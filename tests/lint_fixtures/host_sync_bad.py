@@ -1,0 +1,27 @@
+"""Pre-fix shape of module/fused_step.py's numeric-watch branch (this
+PR): TWO separate forced syncs per step — float(gnorm) blocks, then
+bool(outs_ok) blocks again — inside the hot step loop.  Also covers
+reachability: the sync hides in a helper the hot entry point calls."""
+import numpy as np
+
+from mxnet_tpu.lint.annotations import hot_path
+
+
+class FusedStep:
+    @hot_path
+    def step(self, batch):
+        outs, outs_ok, gnorm = self._program(batch)
+        gn = float(gnorm)          # sync #1
+        if not bool(outs_ok):      # sync #2
+            self._note_anomaly()
+        return self._collect(outs), gn
+
+    def _collect(self, outs):
+        # reachable from @hot_path step() -> flagged too
+        return np.asarray(outs)
+
+    def _program(self, batch):
+        raise NotImplementedError
+
+    def _note_anomaly(self):
+        pass

@@ -1,0 +1,34 @@
+"""Pre-fix shapes of the program-cache capture bug class:
+
+* parallel/trainer.py's cached_sgd_step (this PR): a caller-owned cache
+  keyed by id(loss_fn) — ids recycle after GC, and the entry pins the
+  captured closure forever;
+* the module-level-cache-keyed-by-self variant (the PR 6 _STEP_CACHE
+  rule: an engine key retains a retired engine's parameter dict);
+* functools.lru_cache on a method (self becomes a cache key).
+"""
+import functools
+
+import jax
+
+_PROGRAMS = {}
+
+
+def cached_step(cache, loss_fn, build):
+    step = cache.get((id(loss_fn), True))
+    if step is None:
+        step = jax.jit(build(loss_fn))
+        cache[(id(loss_fn), True)] = step
+    return step
+
+
+class Engine:
+    def compile(self, bucket):
+        key = (self, bucket)
+        if key not in _PROGRAMS:
+            _PROGRAMS[key] = jax.jit(lambda x: x)
+        return _PROGRAMS[key]
+
+    @functools.lru_cache(maxsize=None)
+    def program_for(self, bucket):
+        return jax.jit(lambda x: x)

@@ -1,0 +1,36 @@
+"""Post-fix shapes: key by the object itself (bounded) or by an
+immutable config tuple; id()-keyed LOCAL traversal dicts stay legal
+(ephemeral state over objects the traversal holds alive)."""
+import jax
+
+_STEP_CACHE = {}
+
+
+def cached_step(cache, loss_fn, build):
+    key = (loss_fn, True)
+    step = cache.get(key)
+    if step is None:
+        step = jax.jit(build(loss_fn))
+        while len(cache) >= 64:
+            cache.pop(next(iter(cache)))
+        cache[key] = step
+    return step
+
+
+class Engine:
+    def _spec_key(self):
+        return ("gpt", 12, 64)      # immutable config, never self
+
+    def compile(self, bucket):
+        key = (self._spec_key(), bucket)
+        if key not in _STEP_CACHE:
+            _STEP_CACHE[key] = jax.jit(lambda x: x)
+        return _STEP_CACHE[key]
+
+
+def copy_graph(nodes):
+    # local id()-keyed dict: the standard ephemeral traversal idiom
+    copies = {}
+    for node in nodes:
+        copies[id(node)] = object()
+    return [copies[id(n)] for n in nodes]

@@ -1,0 +1,18 @@
+"""Pre-fix shapes from this PR (telemetry atexit dump, request-trace
+hooks, engine shutdown): broad handlers whose body is only
+pass/continue — the failure evaporates."""
+
+
+def atexit_dump(dump):
+    try:
+        dump()
+    except Exception:
+        pass
+
+
+def drain(queue, handle):
+    for item in queue:
+        try:
+            handle(item)
+        except:  # noqa: E722
+            continue

@@ -1,0 +1,38 @@
+"""Post-fix shapes: count or log before continuing, narrow to the
+expected exception, assign a fallback — or carry a justified
+suppression for a genuine last-resort guard."""
+import logging
+
+errors = {"atexit_dump": 0}
+
+
+def atexit_dump(dump):
+    try:
+        dump()
+    except Exception:
+        errors["atexit_dump"] += 1
+
+
+def drain(queue, handle):
+    for item in queue:
+        try:
+            handle(item)
+        except Exception as e:
+            logging.warning("drain: %s failed: %s", item, e)
+
+
+def delete_buffers(arrays):
+    for arr in arrays:
+        try:
+            arr.delete()
+        except (RuntimeError, ValueError):
+            pass               # narrow: already donated-away/deleted
+
+
+def teardown_guard(close):
+    try:
+        close()
+    # mxtpu-lint: disable=swallowed-exception (interpreter-teardown
+    # guard: there is nowhere left to report)
+    except Exception:
+        pass

@@ -1,0 +1,27 @@
+"""Pre-fix shape of telemetry/flight.py (this PR): ``dumps`` was
+declared lock-guarded but incremented outside the lock — and the bare
+scheduler queues carried no lock at all."""
+import threading
+
+
+class FlightRecorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = []          # guarded-by: _lock
+        self.dumps = 0             # guarded-by: _lock
+
+    def record(self, ev):
+        with self._lock:
+            self._events.append(ev)
+
+    def dump(self):
+        with self._lock:
+            events = list(self._events)
+        self._write(events)
+        self.dumps += 1            # mutation outside the lock
+
+    def clear(self):
+        self._events.clear()       # mutation outside the lock
+
+    def _write(self, events):
+        pass

@@ -1,0 +1,32 @@
+"""Post-fix shape: every mutation of a guarded attribute sits inside
+``with self._lock`` (— __init__ is exempt: construction precedes
+sharing)."""
+import threading
+
+
+class FlightRecorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = []          # guarded-by: _lock
+        self.dumps = 0             # guarded-by: _lock
+        self.unguarded_note = None     # no annotation, no contract
+
+    def record(self, ev):
+        with self._lock:
+            self._events.append(ev)
+
+    def dump(self):
+        with self._lock:
+            events = list(self._events)
+            self.dumps += 1
+        self._write(events)
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    def note(self, msg):
+        self.unguarded_note = msg      # unannotated: not checked
+
+    def _write(self, events):
+        pass

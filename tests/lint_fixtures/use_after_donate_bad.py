@@ -1,0 +1,42 @@
+"""The donate-then-read bug shape cached_sgd_step's callers must never
+regress into (params donated on TPU; CPU tests pass regardless — which
+is exactly why only static analysis catches it).  Both the local-jit
+and the self-attribute (fused-step style, via the _donate TPU guard)
+variants."""
+import jax
+
+
+def _donate(*argnums):
+    return argnums
+
+
+def train_loop(step_fn, params, batches):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    for batch in batches:
+        loss, _, new_params = step(params, batch, 0.01)
+    return params, loss        # read of the donated pytree
+
+
+def factory_train(trainer, make_step, batches):
+    # factory-returned donating program (cached_sgd_step style): the
+    # annotation is what makes the call sites checkable cross-module
+    step = make_step(trainer.loss_fn)      # mxtpu-lint: donates=0
+    for b in batches:
+        loss, _, new_params = step(trainer.params, b)
+    return trainer.params                  # read of the donated pytree
+
+
+class FusedStep:
+    def __init__(self, program):
+        self._program = jax.jit(program, donate_argnums=_donate(0, 3))
+
+    def step(self, others, aux, batch):
+        params = self.params
+        state = self.state
+        outs, new_params, new_state = self._program(params, others,
+                                                    aux, state)
+        self.commit(new_params, new_state)
+        return outs, state     # donated state read after the call
+
+    def commit(self, p, s):
+        self.params, self.state = p, s

@@ -1,0 +1,20 @@
+"""Post-fix shape: perf_counter for durations, monotonic for
+deadlines, and a justified suppression where the wall timestamp IS the
+payload."""
+import time
+
+
+def check_speed(run, N):
+    tic = time.perf_counter()
+    for _ in range(N):
+        run()
+    return (time.perf_counter() - tic) / N
+
+
+def watch_deadline(hours):
+    return time.monotonic() + 3600 * hours
+
+
+def snapshot_record(metrics):
+    # mxtpu-lint: disable=wall-clock (JSONL record timestamp)
+    return {"ts": round(time.time(), 3), "metrics": metrics}

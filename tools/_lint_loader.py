@@ -1,0 +1,42 @@
+"""Load ``mxnet_tpu/lint`` WITHOUT executing ``mxnet_tpu/__init__.py``.
+
+The linter's contract is "never imports the code under analysis" — but
+``from mxnet_tpu.lint import cli`` would execute the package root,
+which imports jax and nearly every module the linter is about to scan.
+That is slow (a jax client per lint run), and worse: a syntax error
+anywhere in the package's import graph — exactly the state the linter
+must REPORT as a loud parse-error finding — would crash the CLI with
+an import traceback before linting starts.
+
+This loader mounts the lint subpackage stand-alone under the alias
+``_mxtpu_lint`` via importlib, so the CLI tools stay pure-stdlib no
+matter what state the rest of the tree is in.  Everything inside the
+lint package uses relative imports, which resolve against the alias.
+"""
+
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ALIAS = "_mxtpu_lint"
+
+
+def load_lint():
+    """The ``mxnet_tpu.lint`` package, loaded stand-alone (cached)."""
+    if _ALIAS in sys.modules:
+        return sys.modules[_ALIAS]
+    pkg_dir = os.path.join(_REPO, "mxnet_tpu", "lint")
+    spec = importlib.util.spec_from_file_location(
+        _ALIAS, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    # registered BEFORE exec so the package's own relative imports
+    # (`from .core import ...`) resolve against the alias
+    sys.modules[_ALIAS] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(_ALIAS, None)
+        raise
+    return mod

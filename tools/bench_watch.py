@@ -33,6 +33,8 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "BENCH_ATTEMPTS.jsonl")
+# compared against os.path.getmtime() (wall-clock filesystem stamps)
+# mxtpu-lint: disable=wall-clock (filesystem mtime comparison)
 WATCH_START = time.time()
 
 # every child (bench modes, sweep points, flash/bandwidth tools) shares
@@ -221,6 +223,40 @@ def run_json_artifact(tag, cmd_tail, out_name, timeout, validate=None):
     # a persisted partial keeps the stage pending (bounded retries via
     # attempt(); if the budget runs out the partial is what we keep)
     return True if complete else "partial"
+
+
+def run_lint_stage(timeout=300):
+    """Static-analysis trend line: run mxtpu-lint in JSON mode and
+    record per-checker finding counts in the attempts log, so finding
+    counts are tracked across rounds exactly like perf numbers (a
+    checker count creeping up is a regression even while the tier-1
+    gate is green thanks to suppressions/baseline).  Needs no TPU —
+    it is the cheapest stage in the ladder."""
+    try:
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "mxtpu_lint.py"), "--json"],
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        log("lint: timed out")
+        return False
+    try:
+        doc = json.loads(r.stdout)
+    except ValueError as e:
+        log(f"lint: no JSON ({e}): {(r.stderr or '')[-300:]}")
+        return False
+    record("lint", {
+        "clean": doc.get("clean"),
+        "counts": doc.get("counts"),          # NEW findings per checker
+        "counts_all": doc.get("counts_all"),  # incl. baselined ones
+        "baselined": doc.get("baselined"),
+        "stale_baseline_entries": len(doc.get("stale_baseline_entries",
+                                              [])),
+        "parse_errors": len(doc.get("errors", [])),
+    })
+    log("lint: clean" if doc.get("clean")
+        else f"lint: FINDINGS {doc.get('counts')}")
+    return True
 
 
 def run_bandwidth(timeout=1200):
@@ -454,13 +490,16 @@ def main():
     forever = "--forever" in sys.argv
     # hard deadline: the loop must be gone before the round driver runs
     # its own bench.py against the same (single-client) chip
-    deadline = time.time() + 3600 * float(
+    # monotonic: an NTP step during a 9h watch must not move the
+    # deadline (the chip handoff to the round driver depends on it)
+    deadline = time.monotonic() + 3600 * float(
         os.environ.get("BENCH_WATCH_HOURS", "9"))
     # VERDICT r4 priority: the unproven claims first — the consistency
     # lane (24 cases, 21 ever green), the tuned flash blocks (committed
     # record shows flash LOSING), the never-measured fused RNN — then
     # the headline benches, then the new r5 records, then the long tail
-    done = {"consistency": False, "flash": False, "rnn": False,
+    done = {"lint": False,
+            "consistency": False, "flash": False, "rnn": False,
             "resnet": False, "resnet256": False, "gpt": False,
             "longcontext": False, "bandwidth": False, "cifar": False,
             "quant": False, "decode": False, "serve": False,
@@ -495,10 +534,16 @@ def main():
         # the deadline clamps every stage's subprocess timeout too: a
         # stage may not START before the deadline and then hold the chip
         # past it (the driver's own bench.py needs the single-client TPU)
-        left = deadline - time.time()
+        left = deadline - time.monotonic()
         if left < 120:
             log("deadline reached; exiting to free the chip")
             return 0
+        # the lint stage needs no TPU: run it ahead of the probe so
+        # the finding-count trend gets a point even on rounds where
+        # the chip never comes up
+        if not done["lint"]:
+            done["lint"] = attempt(
+                "lint", lambda: run_lint_stage(timeout=min(600, left)))
         if not probe():
             log("TPU unreachable; retrying in 60s")
             time.sleep(60)
@@ -506,7 +551,7 @@ def main():
         log("TPU reachable")
         # probe() itself can block up to 150s; recompute the remaining
         # budget so a stage never starts with a stale (too-large) timeout
-        left = deadline - time.time()
+        left = deadline - time.monotonic()
         if left < 120:
             continue
         stages = [

@@ -11,47 +11,62 @@ mention) in docs/env_vars.md.  Documented-but-unread names are fine
 (some vars are *set* for subprocesses rather than read, e.g. the
 launcher's coordination vars).
 
-Runs as a tier-1 test (tests/test_observability.py) and standalone:
+Since PR 7 this gate is one face of mxtpu-lint's ``env-discipline``
+checker (``python tools/mxtpu_lint.py``) — this module keeps the
+original standalone CLI and ``check(repo)`` API, but rides the
+linter's file scanner and doc parser so the two can never disagree
+about what counts as a var or which files are scanned.
+
+Runs as a tier-1 test (tests/test_observability.py, plus the
+regression pin in tests/test_lint.py) and standalone:
 
   python tools/check_env_docs.py [--repo PATH]   # exit 1 on drift
 """
 
 import argparse
 import os
-import re
 import sys
 
-VAR_RE = re.compile(r"\bMXTPU_[A-Z0-9]+(?:_[A-Z0-9]+)*\b")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
 
-# scanned source roots, relative to the repo
+# the lint package loaded stand-alone (stdlib-only, no jax, no
+# mxnet_tpu/__init__) — see tools/_lint_loader.py
+from _lint_loader import load_lint  # noqa: E402
+
+_lint = load_lint()
+LintContext, iter_py_files = _lint.LintContext, _lint.iter_py_files
+
+VAR_RE = LintContext.ENV_VAR_RE
+
+# scanned source roots, relative to the repo (the same roots the
+# tier-1 lint gate covers)
 CODE_ROOTS = ("mxnet_tpu", "tools")
-DOC = os.path.join("docs", "env_vars.md")
+DOC = LintContext.ENV_DOC
 
 
 def code_vars(repo):
     """{var: [file:line, ...]} for every MXTPU_* mention in sources."""
     found = {}
-    for root in CODE_ROOTS:
-        base = os.path.join(repo, root)
-        for dirpath, dirnames, filenames in os.walk(base):
-            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-            for fn in filenames:
-                if not fn.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fn)
-                try:
-                    with open(path, encoding="utf-8", errors="replace") as f:
-                        for i, line in enumerate(f, 1):
-                            for var in VAR_RE.findall(line):
-                                rel = os.path.relpath(path, repo)
-                                found.setdefault(var, []).append(
-                                    f"{rel}:{i}")
-                except OSError:
-                    continue
+    roots = [os.path.join(repo, r) for r in CODE_ROOTS]
+    for path in iter_py_files([r for r in roots if os.path.isdir(r)]):
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                for i, line in enumerate(f, 1):
+                    for var in VAR_RE.findall(line):
+                        rel = os.path.relpath(path, repo)
+                        found.setdefault(var, []).append(f"{rel}:{i}")
+        except OSError:
+            continue
     return found
 
 
 def doc_vars(repo):
+    """MXTPU_* names documented in docs/env_vars.md (the linter's
+    parse — raises if the doc itself is unreadable, matching the
+    original behavior)."""
     path = os.path.join(repo, DOC)
     with open(path, encoding="utf-8") as f:
         return set(VAR_RE.findall(f.read()))
@@ -70,8 +85,7 @@ def check(repo):
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="detect MXTPU_* env vars missing from docs/env_vars.md")
-    p.add_argument("--repo", default=os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))))
+    p.add_argument("--repo", default=_REPO)
     args = p.parse_args(argv)
     missing, docs = check(args.repo)
     if not missing:

@@ -123,7 +123,7 @@ def pack(prefix, root, args):
         idx_path = f"{prefix}{suffix}.idx"
         writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
         tasks = [(i, lab, fn, root, args) for i, lab, fn in shard]
-        tic = time.time()
+        tic = time.perf_counter()
         n_done = 0
         if args.num_thread > 1:
             with multiprocessing.Pool(args.num_thread) as pool:
@@ -138,7 +138,7 @@ def pack(prefix, root, args):
                     writer.write_idx(idx, payload)
                     n_done += 1
         writer.close()
-        dt = time.time() - tic
+        dt = time.perf_counter() - tic
         print(f"wrote {rec_path}: {n_done} records in {dt:.1f}s "
               f"({n_done / max(dt, 1e-9):.0f} img/s)")
 

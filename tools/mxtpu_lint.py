@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""JAX-aware static analysis over mxnet_tpu/ + tools/ (mxtpu-lint).
+
+Thin launcher for :mod:`mxnet_tpu.lint.cli` so the suite runs without
+installation:
+
+  python tools/mxtpu_lint.py                  # lint mxnet_tpu + tools
+  python tools/mxtpu_lint.py --json           # machine-readable report
+  python tools/mxtpu_lint.py --list-checks    # checker gallery
+  python tools/mxtpu_lint.py --write-baseline # grandfather current tree
+
+Exit 0 = clean against the committed baseline
+(tools/lint_baseline.json); the same invocation gates tier-1 via
+tests/test_lint.py.  See docs/how_to/static_analysis.md.
+"""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+# stand-alone load of mxnet_tpu/lint (stdlib-only): the linter must
+# still run — and report parse errors as findings — when the package
+# itself is broken, so it never imports mxnet_tpu/__init__.py
+from _lint_loader import load_lint  # noqa: E402
+
+load_lint()
+import importlib  # noqa: E402
+
+cli = importlib.import_module("_mxtpu_lint.cli")
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--repo" not in argv:
+        argv += ["--repo", _REPO]
+    return cli.main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -172,6 +172,11 @@ def main():
         # accelerator site plugin outranks JAX_PLATFORMS
         os.environ["MXTPU_PLATFORMS"] = args.platform
     try:
+        # parsed BEFORE importing mxnet_tpu/jax (tp decides the host
+        # virtual-device count, which must be set pre-import); the
+        # try/except mirrors base.env_int's malformed-value fallback
+        # mxtpu-lint: disable=env-discipline (pre-import parse, cannot
+        # touch mxnet_tpu.base yet)
         env_tp = int(os.environ.get("MXTPU_SERVE_TP", "1") or 1)
     except ValueError:
         env_tp = 1
