@@ -87,6 +87,7 @@ from . import parallel
 from . import models
 from . import predict
 from . import serve
+from . import fleet
 from . import torch_bridge
 from . import c_api
 

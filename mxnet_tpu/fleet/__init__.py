@@ -1,0 +1,40 @@
+"""Fleet layer: one engine process -> a service that survives losing it.
+
+The reference framework's production story was a *process fleet*
+(ps-lite's ZMQ node groups, dmlc-core's cluster tracker); the serving
+analog here (ROADMAP item 3) is this package:
+
+- ``replica``   — ``ReplicaServer``: a stdlib-HTTP front over one
+  ``serve.Engine`` (``/generate``, ``/healthz``, ``/drain``,
+  ``/statusz.json``), idempotent on client request ids; runnable as a
+  process via ``tools/serve_replica.py``.
+- ``router``    — ``Router``: least-loaded routing on scraped statusz
+  signals (queue depth + KV occupancy), per-hop timeout, capped
+  exponential backoff, retry-on-sibling, per-replica circuit breaker,
+  and trace-id propagation so ``tools/trace_report.py --stitch``
+  reassembles a request's hops across replicas.
+- ``supervisor``— ``Supervisor``: spawn/monitor/restart N replica
+  slots, crash-restart with backoff, and drain -> AOT-warm restart
+  rolling restarts (zero client-visible failures; PR 4's warm start is
+  what makes this cheap).
+- ``faults``    — ``FaultInjector``: the deterministic chaos hook
+  (``MXTPU_FAULT_SPEC``: kill/delay/refuse/hang at request k) that the
+  chaos gates in tests/test_fleet.py and tools/fleet_bench.py replay.
+
+Docs: docs/how_to/fleet.md.  Benchmark: ``tools/fleet_bench.py``
+(FLEET_BENCH.json artifact — availability under one injected kill plus
+rolling-restart downtime).
+"""
+
+from .faults import Fault, FaultInjector, parse_fault_spec
+from .replica import (DEAD, DRAINING, READY, STARTING, ReplicaServer,
+                      TRACE_HEADER)
+from .router import (FleetError, NoReplicaAvailable, PermanentError,
+                     Router, RouterResult)
+from .supervisor import ProcessReplica, Supervisor, probe_health
+
+__all__ = ["ReplicaServer", "Router", "RouterResult", "Supervisor",
+           "ProcessReplica", "FaultInjector", "Fault",
+           "parse_fault_spec", "probe_health", "FleetError",
+           "PermanentError", "NoReplicaAvailable", "TRACE_HEADER",
+           "STARTING", "READY", "DRAINING", "DEAD"]

@@ -1,0 +1,492 @@
+"""One fleet replica: a stdlib-HTTP serving front over ``serve.Engine``.
+
+The engine (PRs 1/4/5/6) is a library; a fleet needs a *process* with a
+wire protocol a router can balance over and a supervisor can manage:
+
+  POST /generate       JSON {"prompt": [ids], "max_new_tokens": N,
+                       "deadline_s"?, "tenant"?, "request_id"?} ->
+                       {"tokens": [...], "rid", "trace_id", "replica"}.
+                       503 + {"retriable": true, "error": reason} for
+                       back-pressure/draining (retry on a sibling);
+                       400 for requests that can never succeed
+                       (too long for the model, expired deadline).
+                       ``X-MXTPU-Trace-Id`` propagates the router's
+                       trace id into the PR 5 RequestTracer so one
+                       request's hops across replicas share a timeline.
+  GET  /healthz        cheap liveness/readiness: {"state": "ready" |
+                       "draining" | "dead", in_flight, queue_depth}.
+  POST /drain          stop admitting, finish in-flight work
+                       token-identically, report {"state": "draining"}.
+  GET  /statusz.json   the full statusz snapshot plus a "replica"
+                       section — the router's load-balancing signal
+                       (queue depth + KV occupancy).
+
+Idempotency: a ``request_id`` names the client request across retries.
+A re-send of an id that already completed returns the CACHED response
+(no recompute, no duplicate); a re-send while the first attempt is
+still in flight attaches to it.  That is what makes router retries safe
+— at-most-once execution per request id per replica, exactly-one
+response per id at the client.
+
+Faults (``faults.FaultInjector``) hook ``/generate`` arrivals so the
+chaos tests can kill/delay/refuse/hang this replica at a deterministic
+request index.  A *kill* is a hard death — ``on_kill`` defaults to an
+in-process crash (HTTP socket torn down mid-request, engine abandoned
+un-shutdown); ``tools/serve_replica.py`` passes ``os._exit`` so a
+process replica dies for real.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+
+from .. import telemetry
+from ..serve.scheduler import FINISHED, QueueFull, REJECTED
+from ..telemetry import statusz as statusz_mod
+
+__all__ = ["ReplicaServer", "STARTING", "READY", "DRAINING", "DEAD",
+           "RETRIABLE_REASONS", "PERMANENT_REASONS", "TRACE_HEADER"]
+
+STARTING = "starting"
+READY = "ready"
+DRAINING = "draining"
+DEAD = "dead"
+
+# rejection reasons a sibling replica might still serve (503) vs.
+# requests no replica can ever serve (400) — the router's retry
+# decision rides this split
+RETRIABLE_REASONS = ("queue_full", "tenant_share", "deadline", "draining")
+PERMANENT_REASONS = ("exceeds_max_len", "exceeds_cache",
+                     "deadline_at_submit")
+
+TRACE_HEADER = "X-MXTPU-Trace-Id"
+
+_DONE_CACHE_SIZE = 1024
+
+
+def _errors(site):
+    return telemetry.counter("mxtpu_fleet_replica_errors_total",
+                             "replica-front internal failures",
+                             ("site",)).labels(site=site)
+
+
+class ReplicaServer:
+    """HTTP front + engine step-loop thread for one replica.
+
+    Args:
+      engine: a constructed ``serve.Engine`` (this server owns its
+        lifecycle from ``start()`` on: ``stop()`` shuts it down).
+      host/port: bind address (port 0 = ephemeral; read ``.port``).
+      replica_id: name in responses/telemetry (default ``replica-<port>``).
+      fault_injector: a ``faults.FaultInjector`` (default: env spec —
+        which is empty/no-op unless ``MXTPU_FAULT_SPEC`` is set).
+      on_kill: what a *kill* fault does (default: in-process hard stop;
+        process replicas pass ``os._exit``).
+      poll_s: completion-poll period of waiting request handlers.
+    """
+
+    def __init__(self, engine, host="127.0.0.1", port=0, replica_id=None,
+                 fault_injector=None, on_kill=None, poll_s=0.002):
+        from . import faults as faults_mod
+
+        self.engine = engine
+        self.host = host
+        self._requested_port = int(port)
+        self.port = None
+        self.replica_id = replica_id
+        self.faults = (fault_injector if fault_injector is not None
+                       else faults_mod.FaultInjector())
+        self._on_kill = on_kill if on_kill is not None else self.hard_stop
+        self.poll_s = float(poll_s)
+        self._lock = threading.RLock()
+        self._state = STARTING       # guarded-by: _lock
+        self._served = 0             # guarded-by: _lock
+        self._inflight = {}          # guarded-by: _lock
+        self._done_cache = collections.OrderedDict()  # guarded-by: _lock
+        self._server = None
+        self._http_thread = None
+        self._step_thread = None
+        self._stop_evt = threading.Event()
+        self._work_evt = threading.Event()
+        self._health_name = None
+        self._statusz_name = None
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def state(self):
+        with self._lock:
+            return self._state
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def start(self):
+        """Bind, spin up the HTTP and step threads, go READY."""
+        from http.server import ThreadingHTTPServer
+
+        replica = self
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+
+            def handle_error(self, request, client_address):
+                # torn connections are EXPECTED here (aborted handlers
+                # during a kill fault, clients timing out) — count
+                # instead of stack-tracing to stderr per event
+                _errors("http").inc()
+
+        self._server = _Server((self.host, self._requested_port),
+                               _Handler)
+        self._server.replica = self
+        self.port = self._server.server_address[1]
+        if self.replica_id is None:
+            self.replica_id = f"replica-{self.port}"
+        self._http_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"mxtpu-replica-http-{self.port}")
+        self._http_thread.start()
+        self._step_thread = threading.Thread(
+            target=self._step_loop, daemon=True,
+            name=f"mxtpu-replica-step-{self.port}")
+        self._step_thread.start()
+        self._health_name = statusz_mod.register_health(
+            f"fleet.{self.replica_id}", self._health)
+        self._statusz_name = statusz_mod.register(
+            f"fleet.{self.replica_id}", self._replica_state)
+        with self._lock:
+            self._state = READY
+        return self
+
+    def drain(self):
+        """Stop admitting new requests; in-flight work keeps stepping
+        to completion (token-identically — the schedule is untouched,
+        only admission closes)."""
+        with self._lock:
+            if self._state == READY:
+                self._state = DRAINING
+        telemetry.counter("mxtpu_fleet_replica_drains_total",
+                          "drain requests accepted").inc()
+        return self.state
+
+    def drained(self):
+        """True once draining AND no queued or in-flight work remains
+        (the supervisor's terminate-safe signal)."""
+        return (self.state == DRAINING
+                and not self.engine.scheduler.has_work()
+                and not self._inflight)
+
+    def stop(self):
+        """Clean shutdown: step thread stops, engine releases its
+        device buffers, HTTP socket closes."""
+        with self._lock:
+            if self._state == DEAD:
+                return
+            self._state = DEAD
+        self._teardown_http()
+        self._stop_evt.set()
+        self._work_evt.set()
+        if self._step_thread is not None:
+            self._step_thread.join(timeout=10)
+        try:
+            self.engine.shutdown()
+        except Exception:
+            _errors("shutdown").inc()
+
+    def hard_stop(self):
+        """Simulate a crash (the in-process analog of ``os._exit``):
+        the HTTP socket dies mid-request, waiting handlers abort their
+        connections, the engine is abandoned WITHOUT shutdown — exactly
+        what a killed process leaves behind."""
+        with self._lock:
+            self._state = DEAD
+        self._stop_evt.set()
+        self._work_evt.set()
+        self._teardown_http()
+        # the abandoned engine must still leave the process-global
+        # /statusz page NOW (a real crash takes the whole registry with
+        # it; the in-process simulation has to evict explicitly rather
+        # than wait for cyclic GC to collect the engine's weakref)
+        statusz_mod.unregister(getattr(self.engine, "_statusz_name", ""))
+
+    def _teardown_http(self):
+        statusz_mod.unregister_health(self._health_name)
+        statusz_mod.unregister(self._statusz_name)
+        server, self._server = self._server, None
+        if server is not None:
+            # shutdown() stops serve_forever; server_close() frees the
+            # port and snaps open keep-alive connections
+            threading.Thread(target=server.shutdown, daemon=True).start()
+            try:
+                server.server_close()
+            except OSError:
+                _errors("server_close").inc()
+
+    # -- engine pump ---------------------------------------------------------
+    def _step_loop(self):
+        while not self._stop_evt.is_set():
+            if self.engine.scheduler.has_work():
+                try:
+                    self.engine.step()
+                except Exception:
+                    # an engine that cannot step is a dead replica: fail
+                    # fast so the router's probes see it gone (the
+                    # engine already force-dumped the flight ring)
+                    _errors("step").inc()
+                    self.hard_stop()
+                    return
+            else:
+                self._work_evt.wait(0.05)
+                self._work_evt.clear()
+
+    # -- request handling (called from HTTP handler threads) -----------------
+    def handle_generate(self, body, trace_id=None):
+        """Returns ``(http_status, payload_dict)`` or ``None`` meaning
+        "abort the connection without a response" (replica died)."""
+        fault = self.faults.on_request()
+        if fault is not None and fault.action == "refuse":
+            return 503, {"error": "fault_refuse", "retriable": True}
+        if fault is not None and fault.action == "delay":
+            time.sleep(fault.arg)
+        if fault is not None and fault.action == "hang":
+            # hold the connection unanswered until the client gives up
+            # (bounded by arg so a test teardown never waits forever)
+            deadline = time.monotonic() + fault.arg
+            while time.monotonic() < deadline \
+                    and not self._stop_evt.is_set():
+                time.sleep(min(0.05, self.poll_s * 10))
+            return None
+        kill = fault is not None and fault.action == "kill"
+        result = self._serve_generate(body, trace_id, kill)
+        if kill and result is not None:
+            # the arrival the fault spec kills must never be answered —
+            # whatever its answer would have been (a dedup-cache hit, a
+            # rejection, or a generation that finished before the
+            # mid-stream threshold); deterministic chaos means the
+            # replica IS dead after request k, full stop
+            self._on_kill()
+            return None
+        return result
+
+    def _serve_generate(self, body, trace_id, kill):
+        if self.state != READY:
+            return 503, {"error": "draining", "retriable": True,
+                         "state": self.state}
+        request_id = body.get("request_id")
+        try:
+            prompt = [int(t) for t in body["prompt"]]
+            max_new = int(body.get("max_new_tokens", 64))
+        except (KeyError, TypeError, ValueError):
+            return 400, {"error": "bad_request", "retriable": False}
+        deadline_s = body.get("deadline_s")
+        if deadline_s is not None:
+            try:
+                deadline_s = float(deadline_s)
+            except (TypeError, ValueError):
+                return 400, {"error": "bad_request", "retriable": False}
+        if not prompt or max_new < 1:
+            # invalid on EVERY replica: a clean 400, never a 500 the
+            # router would count as a transport failure and retry
+            # fleet-wide (three such requests would otherwise open
+            # every breaker)
+            return 400, {"error": "bad_request", "retriable": False}
+        tenant = body.get("tenant")
+        if tenant is not None:
+            # bound client-supplied tenant labels: they key per-tenant
+            # scheduler/telemetry state, which must not grow with
+            # arbitrary client strings
+            tenant = str(tenant)[:64]
+
+        def submit():
+            return self.engine.submit(prompt, max_new_tokens=max_new,
+                                      deadline_s=deadline_s,
+                                      tenant=tenant, trace_id=trace_id)
+
+        try:
+            if request_id is not None:
+                # reserve-or-attach is ONE atomic step: cache lookup,
+                # in-flight lookup and engine submit all under _lock,
+                # so two concurrent retries of the same id can never
+                # both execute (engine.submit only takes the scheduler
+                # lock — no inverse ordering exists)
+                with self._lock:
+                    cached = self._done_cache.get(request_id)
+                    if cached is not None:
+                        # retry of a completed id: same answer, no
+                        # recompute
+                        return 200, dict(cached, deduped=True)
+                    req = self._inflight.get(request_id)
+                    if req is None:
+                        req = submit()
+                        if req.status != REJECTED:
+                            self._inflight[request_id] = req
+            else:
+                req = submit()
+        except QueueFull:
+            return 503, {"error": "queue_full", "retriable": True}
+        except ValueError:
+            # anything Request/engine validation still rejects is a
+            # client error, not a replica failure
+            return 400, {"error": "bad_request", "retriable": False}
+        if req.status == REJECTED:
+            return self._reject_response(req)
+        self._work_evt.set()
+
+        # a kill fault dies MID-STREAM: once the request has produced
+        # about half its tokens — the worst moment
+        kill_after = max(1, max_new // 2) if kill else None
+        while not req.done:
+            if kill_after is not None and len(req.tokens) >= kill_after:
+                self._on_kill()
+                return None
+            if self._stop_evt.is_set():
+                return None              # replica died under us: abort
+            time.sleep(self.poll_s)
+        if req.status != FINISHED:
+            if request_id is not None:
+                with self._lock:
+                    self._inflight.pop(request_id, None)
+            if req.status == REJECTED:
+                return self._reject_response(req)
+            return 503, {"error": req.status, "retriable": True}
+        payload = {"tokens": list(req.tokens), "rid": req.rid,
+                   "trace_id": req.trace_id, "tenant": req.tenant,
+                   "replica": self.replica_id,
+                   "n_preemptions": req.n_preemptions}
+        with self._lock:
+            # cache-write and in-flight pop are ONE locked step: a
+            # retry arriving between them would miss both lookups and
+            # re-execute.  When several handlers attached to one
+            # in-flight request, only the first to land here counts it
+            # served and writes the cache; the rest return the same
+            # payload without double-counting.
+            if request_id is None:
+                self._served += 1
+            elif request_id not in self._done_cache:
+                self._served += 1
+                self._done_cache[request_id] = payload
+                while len(self._done_cache) > _DONE_CACHE_SIZE:
+                    self._done_cache.popitem(last=False)
+            if request_id is not None:
+                self._inflight.pop(request_id, None)
+        return 200, payload
+
+    def _reject_response(self, req):
+        reason = req.reject_reason or "rejected"
+        retriable = reason in RETRIABLE_REASONS
+        return ((503 if retriable else 400),
+                {"error": reason, "retriable": retriable,
+                 "rid": req.rid, "trace_id": req.trace_id})
+
+    # -- introspection -------------------------------------------------------
+    def _health(self):
+        state = self.state
+        return {"status": "ok" if state == READY else state,
+                "state": state,
+                "in_flight": len(self._inflight),
+                "queue_depth": self.engine.scheduler.queue_depth,
+                "running": len(self.engine.scheduler.running)}
+
+    def _replica_state(self):
+        """The router's balancing signal: readiness plus live load
+        (queue depth, decode batch occupancy, KV occupancy)."""
+        eng = self.engine
+        with self._lock:
+            state, served = self._state, self._served
+            inflight = len(self._inflight)
+        return {"replica": self.replica_id, "state": state,
+                "served": served, "in_flight": inflight,
+                "queue_depth": eng.scheduler.queue_depth,
+                "running": len(eng.scheduler.running),
+                "max_batch": eng.max_batch,
+                "kv_utilization": round(eng.blocks.utilization(), 4),
+                "faults_fired": len(self.faults.fired)}
+
+    def statusz_snapshot(self):
+        """Global statusz plus THIS server's "replica" section (several
+        in-process replicas share one global provider registry; the
+        scraping router needs to know which one answered)."""
+        snap = statusz_mod.snapshot()
+        snap["replica"] = self._replica_state()
+        return snap
+
+
+# BaseHTTPRequestHandler at module scope (not a per-start() closure) so
+# a process serving many replicas shares one handler class; per-replica
+# state rides the server object (``self.server.replica``).
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def replica(self):
+        return self.server.replica
+
+    def _send_json(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _abort(self):
+        """Close the connection without any response (the client sees
+        a mid-request disconnect and treats it as retriable)."""
+        try:
+            self.close_connection = True
+            self.connection.close()
+        except OSError:
+            _errors("abort").inc()
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._send_json(200, self.replica._health())
+        elif self.path in ("/statusz.json", "/statusz"):
+            self._send_json(200, self.replica.statusz_snapshot())
+        else:
+            self.send_error(404)
+
+    def do_POST(self):
+        if self.path == "/drain":
+            try:                 # consume any body (keep-alive hygiene)
+                self.rfile.read(int(self.headers.get("Content-Length",
+                                                     0) or 0))
+            except (ValueError, OSError):
+                _errors("drain_body").inc()
+            state = self.replica.drain()
+            self._send_json(200, {"state": state,
+                                  "queue_depth":
+                                      self.replica.engine.scheduler
+                                      .queue_depth})
+            return
+        if self.path != "/generate":
+            self.send_error(404)
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+        except (ValueError, OSError):
+            self._send_json(400, {"error": "bad_json",
+                                  "retriable": False})
+            return
+        trace_id = self.headers.get(TRACE_HEADER) or body.get("trace_id")
+        try:
+            result = self.replica.handle_generate(body, trace_id=trace_id)
+        except Exception:
+            _errors("generate").inc()
+            result = 500, {"error": "internal", "retriable": True}
+        if result is None:
+            self._abort()
+            return
+        code, payload = result
+        try:
+            self._send_json(code, payload)
+        except OSError:
+            _errors("respond").inc()  # client went away mid-response
+
+    def log_message(self, *args):      # no stderr chatter per request
+        pass

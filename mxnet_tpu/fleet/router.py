@@ -1,0 +1,440 @@
+"""The fleet front: SLO-aware routing with retries over N replicas.
+
+The router turns "one excellent engine process" into a service a
+client can trust: it picks the least-loaded READY replica (live queue
+depth + decode occupancy + KV occupancy scraped from each replica's
+``/statusz.json``), bounds each hop with a timeout, and retries
+rejected/failed/timed-out requests on a sibling with capped
+exponential backoff — so a single-replica failure (crash, drain,
+back-pressure, hang) is invisible to the caller.  A replica whose hops
+fail ``breaker_fails`` times consecutively at the TRANSPORT level
+(timeout, disconnect, internal 500 — structured 503 back-pressure is a
+healthy replica and never counts) trips a circuit breaker and leaves
+rotation for ``breaker_reset_s`` (one half-open probe at a time
+re-admits it), so a dying replica cannot eat every request's first
+attempt.
+
+Retries are safe because they are idempotent by construction: every
+client request carries one ``request_id`` across all attempts (the
+replica dedups on it) and one ``trace_id`` propagated in the
+``X-MXTPU-Trace-Id`` header, so each hop's request-trace JSONL line
+shares the id and ``tools/trace_report.py --stitch`` reassembles the
+cross-replica story.
+
+Pure stdlib (urllib); no background machinery unless ``start()`` is
+called (the scrape thread).  All knobs take constructor arguments
+first, ``MXTPU_FLEET_*`` env defaults second.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+
+from .. import telemetry
+from ..base import env_float, env_int
+from .replica import TRACE_HEADER
+
+__all__ = ["Router", "RouterResult", "FleetError", "PermanentError",
+           "NoReplicaAvailable"]
+
+
+class FleetError(RuntimeError):
+    """Base class for router-visible request failures."""
+
+
+class PermanentError(FleetError):
+    """The request can never succeed on any replica (e.g. longer than
+    the model serves) — retrying would only burn capacity."""
+
+
+class NoReplicaAvailable(FleetError):
+    """Every attempt failed (replicas down/draining/rejecting) within
+    the retry budget."""
+
+
+class RouterResult:
+    """One successful routed generation."""
+
+    __slots__ = ("tokens", "replica", "trace_id", "request_id",
+                 "attempts", "hops", "wall_s", "added_s")
+
+    def __init__(self, tokens, replica, trace_id, request_id, attempts,
+                 hops, wall_s, added_s):
+        self.tokens = tokens
+        self.replica = replica
+        self.trace_id = trace_id
+        self.request_id = request_id
+        self.attempts = attempts
+        self.hops = hops           # [{"replica", "status", "wall_s"}]
+        self.wall_s = wall_s
+        self.added_s = added_s     # router-added latency (non-HTTP time)
+
+
+class _ReplicaState:
+    """Router-side view of one replica: scrape signal + breaker."""
+
+    __slots__ = ("url", "name", "state", "load", "consecutive_failures",
+                 "open_until", "probing", "last_scrape_t")
+
+    def __init__(self, url):
+        self.url = url.rstrip("/")
+        self.name = self.url
+        self.state = "unknown"      # ready/draining/down/unknown
+        self.load = 0.0
+        self.consecutive_failures = 0
+        self.open_until = None      # breaker-open deadline (monotonic)
+        self.probing = False        # half-open probe in flight
+        self.last_scrape_t = None
+
+
+class Router:
+    """Load-balancing, retrying front over replica URLs.
+
+    Args (env default in parens):
+      replicas: iterable of base URLs (``http://host:port``).
+      timeout_s: per-hop HTTP timeout (``MXTPU_FLEET_TIMEOUT``, 30).
+      retries: max attempts per request across replicas
+        (``MXTPU_FLEET_RETRIES``, 3; the first try counts).
+      backoff_s / backoff_max_s: capped exponential backoff between
+        attempts (``MXTPU_FLEET_BACKOFF`` 0.05 /
+        ``MXTPU_FLEET_BACKOFF_MAX`` 1.0) — attempt k (k >= 2) sleeps
+        ``min(backoff_max_s, backoff_s * 2**(k-2))`` first.
+      breaker_fails: consecutive hop failures that open a replica's
+        circuit breaker (``MXTPU_FLEET_BREAKER_FAILS``, 3).
+      breaker_reset_s: how long an open breaker keeps the replica out
+        of rotation before one probe request may re-close it
+        (``MXTPU_FLEET_BREAKER_RESET``, 5.0).
+      scrape_interval_s: background statusz scrape period
+        (``MXTPU_FLEET_SCRAPE_INTERVAL``, 0.5); ``start()`` launches
+        the thread, or call ``scrape()`` manually (tests).
+      clock: injectable monotonic clock (breaker/backoff tests).
+      sleep: injectable sleep (backoff tests).
+    """
+
+    def __init__(self, replicas, timeout_s=None, retries=None,
+                 backoff_s=None, backoff_max_s=None, breaker_fails=None,
+                 breaker_reset_s=None, scrape_interval_s=None,
+                 clock=time.monotonic, sleep=time.sleep):
+        self.timeout_s = (float(timeout_s) if timeout_s is not None
+                          else env_float("MXTPU_FLEET_TIMEOUT", 30.0))
+        self.retries = (int(retries) if retries is not None
+                        else env_int("MXTPU_FLEET_RETRIES", 3))
+        self.backoff_s = (float(backoff_s) if backoff_s is not None
+                          else env_float("MXTPU_FLEET_BACKOFF", 0.05))
+        self.backoff_max_s = (
+            float(backoff_max_s) if backoff_max_s is not None
+            else env_float("MXTPU_FLEET_BACKOFF_MAX", 1.0))
+        self.breaker_fails = (
+            int(breaker_fails) if breaker_fails is not None
+            else env_int("MXTPU_FLEET_BREAKER_FAILS", 3))
+        self.breaker_reset_s = (
+            float(breaker_reset_s) if breaker_reset_s is not None
+            else env_float("MXTPU_FLEET_BREAKER_RESET", 5.0))
+        self.scrape_interval_s = (
+            float(scrape_interval_s) if scrape_interval_s is not None
+            else env_float("MXTPU_FLEET_SCRAPE_INTERVAL", 0.5))
+        self.clock = clock
+        self.sleep = sleep
+        self._lock = threading.RLock()
+        # membership + each entry's breaker/scrape fields are mutated
+        # from request threads AND the scrape thread
+        self._replicas = [_ReplicaState(u) for u in replicas]  # guarded-by: _lock
+        self._rr = itertools.count()
+        self._scrape_thread = None
+        self._stop_evt = threading.Event()
+        self._m_requests = telemetry.counter(
+            "mxtpu_fleet_requests_total", "routed client requests",
+            ("outcome",))
+        self._m_hops = telemetry.counter(
+            "mxtpu_fleet_hops_total", "per-replica attempt outcomes",
+            ("replica", "status"))
+        self._m_retries = telemetry.counter(
+            "mxtpu_fleet_retries_total", "attempts after the first")
+        self._m_breaker = telemetry.counter(
+            "mxtpu_fleet_breaker_opens_total", "circuit-breaker trips",
+            ("replica",))
+        self._m_added = telemetry.histogram(
+            "mxtpu_fleet_router_added_seconds",
+            "router-added latency (request wall minus replica HTTP time)")
+
+    # -- membership ----------------------------------------------------------
+    def replicas(self):
+        with self._lock:
+            return list(self._replicas)
+
+    def add_replica(self, url):
+        with self._lock:
+            self._replicas.append(_ReplicaState(url))
+
+    def remove_replica(self, url):
+        url = url.rstrip("/")
+        with self._lock:
+            self._replicas = [r for r in self._replicas if r.url != url]
+
+    # -- scraping ------------------------------------------------------------
+    def start(self):
+        """Launch the background scrape thread (no-op when the
+        interval is 0 — drive ``scrape()`` manually instead)."""
+        if self.scrape_interval_s <= 0 or self._scrape_thread is not None:
+            return self
+        self._scrape_thread = threading.Thread(
+            target=self._scrape_loop, daemon=True,
+            name="mxtpu-fleet-router-scrape")
+        self._scrape_thread.start()
+        return self
+
+    def stop(self):
+        self._stop_evt.set()
+        if self._scrape_thread is not None:
+            self._scrape_thread.join(timeout=5)
+            self._scrape_thread = None
+
+    def _scrape_loop(self):
+        while not self._stop_evt.wait(self.scrape_interval_s):
+            self.scrape()
+
+    def scrape(self):
+        """One pass over every replica's ``/statusz.json``: refresh
+        readiness + load.  Unreachable replicas go ``down``.
+
+        Replicas are scraped CONCURRENTLY (one short-lived thread
+        each): a single blackholed replica eating its full probe
+        timeout must not stall drain/down detection on every sibling
+        past the scrape interval."""
+        replicas = self.replicas()
+        if not replicas:
+            return self.snapshot()
+        threads = [threading.Thread(target=self._scrape_one, args=(r,),
+                                    daemon=True) for r in replicas]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=min(self.timeout_s, 5.0) + 1.0)
+        return self.snapshot()
+
+    def _scrape_one(self, r):
+        try:
+            with urllib.request.urlopen(
+                    f"{r.url}/statusz.json",
+                    timeout=min(self.timeout_s, 5.0)) as resp:
+                snap = json.loads(resp.read())
+            sec = snap.get("replica") or {}
+            with self._lock:
+                r.state = ("ready" if sec.get("state") == "ready"
+                           else sec.get("state") or "down")
+                r.name = sec.get("replica") or r.name
+                r.load = self._load_score(sec)
+                r.last_scrape_t = self.clock()
+        except (OSError, ValueError):
+            with self._lock:
+                r.state = "down"
+                r.last_scrape_t = self.clock()
+
+    @staticmethod
+    def _load_score(sec):
+        """Scalar routing score from a replica's statusz section:
+        queued work normalized by batch width plus KV occupancy — both
+        saturate at ~1, so an idle replica scores ~0 and a saturated
+        one ~2+."""
+        width = max(1, int(sec.get("max_batch") or 1))
+        queued = (int(sec.get("queue_depth") or 0)
+                  + int(sec.get("running") or 0))
+        return queued / width + float(sec.get("kv_utilization") or 0.0)
+
+    def snapshot(self):
+        """Router-side fleet view (statusz provider shape)."""
+        with self._lock:
+            now = self.clock()
+            return [{"url": r.url, "replica": r.name, "state": r.state,
+                     "load": round(r.load, 4),
+                     "consecutive_failures": r.consecutive_failures,
+                     "breaker_open": bool(r.open_until is not None
+                                          and r.open_until > now)}
+                    for r in self._replicas]
+
+    # -- picking -------------------------------------------------------------
+    def _pick(self, exclude):
+        """Least-loaded READY replica with a closed (or probe-ready)
+        breaker, excluding already-tried ones; round-robin tiebreak."""
+        with self._lock:
+            now = self.clock()
+            rr = next(self._rr)
+            n = max(1, len(self._replicas))
+            ranked = []
+            for i, r in enumerate(self._replicas):
+                if r.url in exclude:
+                    continue
+                if r.state in ("draining", "down"):
+                    continue
+                if r.open_until is not None:
+                    if r.open_until > now:
+                        continue        # breaker open
+                    if r.probing:
+                        continue        # half-open: ONE probe at a time
+                ranked.append((r.load, (i - rr) % n, r))
+            if not ranked:
+                return None
+            ranked.sort(key=lambda t: (t[0], t[1]))
+            best = ranked[0][2]
+            if best.open_until is not None:
+                best.probing = True     # this attempt IS the probe
+            return best
+
+    @staticmethod
+    def _counts_for_breaker(code, payload):
+        """Only TRANSPORT-level failures trip the breaker: timeouts,
+        disconnects, garbage responses, and replica-internal 500s.  A
+        structured 503 rejection (queue_full / tenant_share / draining
+        / fault_refuse) is a healthy replica applying back-pressure —
+        it must be retried on a sibling, but counting it as a failure
+        would let one overload burst open EVERY breaker and take the
+        whole fleet out for well-behaved clients."""
+        if code in ("timeout", "disconnect", "bad_response"):
+            return True
+        return isinstance(code, int) and code >= 500 and code != 503
+
+    def _hop_failed(self, r, status, breaker=True):
+        with self._lock:
+            r.probing = False
+            if breaker:
+                now = self.clock()
+                r.consecutive_failures += 1
+                # (re-)arm whenever the breaker is not CURRENTLY open:
+                # a stale past deadline means a half-open probe just
+                # failed, and the breaker must open again, not retire
+                if r.consecutive_failures >= self.breaker_fails \
+                        and (r.open_until is None or r.open_until <= now):
+                    r.open_until = now + self.breaker_reset_s
+                    self._m_breaker.labels(replica=r.name).inc()
+        self._m_hops.labels(replica=r.name, status=status).inc()
+
+    def _hop_ok(self, r, status="ok"):
+        with self._lock:
+            r.consecutive_failures = 0
+            r.open_until = None
+            r.probing = False
+        self._m_hops.labels(replica=r.name, status=status).inc()
+
+    # -- the request path ----------------------------------------------------
+    def generate(self, prompt, max_new_tokens=64, deadline_s=None,
+                 tenant=None, request_id=None, trace_id=None):
+        """Route one generation; returns :class:`RouterResult`.
+
+        Raises :class:`PermanentError` for requests no replica can
+        serve and :class:`NoReplicaAvailable` once the retry budget is
+        exhausted."""
+        request_id = request_id or uuid.uuid4().hex
+        trace_id = trace_id or f"fleet-{uuid.uuid4().hex[:16]}"
+        base = {"prompt": [int(t) for t in prompt],
+                "max_new_tokens": int(max_new_tokens),
+                "deadline_s": deadline_s, "tenant": tenant,
+                "request_id": request_id}
+        body = json.dumps(base).encode()
+        t0 = time.perf_counter()
+        hops = []
+        tried = set()
+        last_error = "no_replica"
+        for attempt in range(1, max(1, self.retries) + 1):
+            if attempt > 1:
+                self._m_retries.inc()
+                self.sleep(min(self.backoff_max_s,
+                               self.backoff_s * 2 ** (attempt - 2)))
+            if deadline_s is not None:
+                # the deadline is an END-TO-END SLO: each hop gets the
+                # REMAINING budget, not a fresh one — and once it is
+                # spent, retrying anywhere is pointless
+                remaining = deadline_s - (time.perf_counter() - t0)
+                if remaining <= 0:
+                    self._m_requests.labels(outcome="deadline").inc()
+                    raise PermanentError(
+                        f"deadline_s={deadline_s} exhausted after "
+                        f"{attempt - 1} attempt(s) (last error: "
+                        f"{last_error})")
+                body = json.dumps(dict(base,
+                                       deadline_s=remaining)).encode()
+            r = self._pick(tried)
+            if r is None and tried:
+                # every replica tried once: second pass may retry one
+                # (it may have recovered / stopped rejecting)
+                tried = set()
+                r = self._pick(tried)
+            if r is None:
+                last_error = "no_replica"
+                continue
+            tried.add(r.url)
+            h0 = time.perf_counter()
+            code, payload = self._post(r, body, trace_id)
+            hop_wall = time.perf_counter() - h0
+            hops.append({"replica": r.name, "status": code,
+                         "wall_s": round(hop_wall, 6)})
+            if code == 200:
+                self._hop_ok(r)
+                wall = time.perf_counter() - t0
+                added = max(0.0, wall - sum(h["wall_s"] for h in hops))
+                self._m_added.observe(added)
+                self._m_requests.labels(outcome="ok").inc()
+                return RouterResult(
+                    tokens=payload["tokens"], replica=payload["replica"],
+                    trace_id=trace_id, request_id=request_id,
+                    attempts=attempt, hops=hops, wall_s=wall,
+                    added_s=added)
+            if code == "rejected_permanent":
+                # the replica is ALIVE and answered correctly — clear
+                # its breaker state before giving the caller its 400
+                self._hop_ok(r, status="rejected_permanent")
+                self._m_requests.labels(outcome="permanent").inc()
+                raise PermanentError(
+                    f"request rejected as unservable: "
+                    f"{payload.get('error')} (replica {r.name})")
+            # retriable: 503-class rejection, timeout, disconnect
+            last_error = (payload or {}).get("error", str(code))
+            self._hop_failed(r, str(code),
+                             breaker=self._counts_for_breaker(code,
+                                                              payload))
+            if last_error == "draining":
+                # fast rotation exit — don't wait for the next scrape
+                with self._lock:
+                    r.state = "draining"
+        self._m_requests.labels(outcome="exhausted").inc()
+        raise NoReplicaAvailable(
+            f"request {request_id} failed after {self.retries} attempts "
+            f"(last error: {last_error}); hops: "
+            + ", ".join(f"{h['replica']}:{h['status']}" for h in hops))
+
+    def _post(self, r, body, trace_id):
+        """One hop.  Returns ``(200, payload)``,
+        ``("rejected_permanent", payload)`` for a 400-class rejection,
+        or ``(status_label, payload_or_None)`` for retriable failures
+        (503 rejections, timeouts, disconnects)."""
+        req = urllib.request.Request(
+            f"{r.url}/generate", data=body, method="POST",
+            headers={"Content-Type": "application/json",
+                     TRACE_HEADER: trace_id})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as resp:
+                return 200, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+            except ValueError:
+                payload = {"error": f"http_{e.code}"}
+            if e.code == 400 or not payload.get("retriable", True):
+                return "rejected_permanent", payload
+            return e.code, payload
+        except TimeoutError:
+            return "timeout", {"error": "timeout"}
+        except (urllib.error.URLError, OSError) as e:
+            # URLError wraps socket timeouts on some Python versions
+            reason = getattr(e, "reason", e)
+            if isinstance(reason, TimeoutError) or "timed out" in str(e):
+                return "timeout", {"error": "timeout"}
+            return "disconnect", {"error": f"disconnect: {e}"}
+        except ValueError:
+            return "bad_response", {"error": "bad_response"}

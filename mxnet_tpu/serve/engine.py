@@ -173,7 +173,7 @@ class Engine:
                  max_batch=None, max_queue=None, max_model_len=None,
                  max_prefills_per_step=1, temperature=0.0, top_k=None,
                  seed=0, clock=time.monotonic, aot_dir=None, tp=None,
-                 partition_rules=None):
+                 partition_rules=None, tenant_share=None):
         if symbol is not None:
             num_heads, window = reconcile_decode_config(symbol, num_heads,
                                                         window)
@@ -270,7 +270,8 @@ class Engine:
         self._rtrace.on_terminal = self._on_request_terminal
         self.scheduler = Scheduler(self.blocks, self.max_batch, max_queue,
                                    max_prefills_per_step, clock=clock,
-                                   trace=self._rtrace)
+                                   trace=self._rtrace,
+                                   tenant_share=tenant_share)
         self._stats = StatsRecorder(clock=clock)
         self.clock = clock
         self._step_id = 0
@@ -391,17 +392,26 @@ class Engine:
             **sharded)
 
     # -- public API ----------------------------------------------------------
-    def submit(self, prompt, max_new_tokens=64, deadline_s=None):
+    def submit(self, prompt, max_new_tokens=64, deadline_s=None,
+               tenant=None, trace_id=None):
         """Queue one generation request; returns its ``Request`` handle.
 
         Raises ``QueueFull`` when the admission queue is at capacity
         (back-pressure — retry later).  A request that could never fit
         (longer than ``max_model_len`` or the whole cache) is returned
         already REJECTED rather than queued to deadlock.
+
+        ``tenant`` labels the request for fair-share admission and the
+        per-tenant telemetry series; ``trace_id`` pre-stamps the trace
+        identity (a fleet router propagates one so a request retried
+        across replicas stitches into a single cross-process timeline).
         """
         if not self._alive:
             raise RuntimeError("engine is shut down")
-        req = Request(prompt, max_new_tokens, deadline_s=deadline_s)
+        req = Request(prompt, max_new_tokens, deadline_s=deadline_s,
+                      tenant=tenant)
+        if trace_id:
+            req.trace_id = str(trace_id)
         if req.target_len() > self.max_model_len:
             self.scheduler._reject(req, "exceeds_max_len")
             return req
@@ -536,7 +546,7 @@ class Engine:
                 phase = "prefill" if req.cache_len == 0 else "decode"
             reqs.append({
                 "rid": req.rid, "trace_id": req.trace_id,
-                "status": req.status, "phase": phase,
+                "tenant": req.tenant, "status": req.status, "phase": phase,
                 "age_s": (round(now - req.submit_t, 3)
                           if req.submit_t is not None else None),
                 "prompt_tokens": int(req.prompt.size),
@@ -557,6 +567,7 @@ class Engine:
             "completed": self._stats.completed,
             "preemptions": self.scheduler.preemptions,
             "reject_reasons": dict(self.scheduler.reject_reasons),
+            "tenants": self.scheduler.tenant_stats(),
             "kv_blocks": self.blocks.occupancy(),
             "kv_cache": self.kv_cache_stats(),
             "sharding": self.sharding_info(),

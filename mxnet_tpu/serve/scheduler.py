@@ -48,7 +48,7 @@ _rid_counter = itertools.count()
 class Request:
     """One generation request and its serving-side bookkeeping."""
 
-    def __init__(self, prompt, max_new_tokens, deadline_s=None):
+    def __init__(self, prompt, max_new_tokens, deadline_s=None, tenant=None):
         self.rid = next(_rid_counter)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
@@ -57,6 +57,7 @@ class Request:
             raise ValueError("max_new_tokens must be >= 1")
         self.max_new_tokens = int(max_new_tokens)
         self.deadline_s = deadline_s
+        self.tenant = str(tenant) if tenant is not None else None
         self.status = WAITING
         self.trace_id = None           # stamped by the request tracer
         self.tokens = []           # generated ids (ints)
@@ -101,12 +102,20 @@ class Scheduler:
 
     def __init__(self, block_mgr, max_batch, max_queue,
                  max_prefills_per_step=1, clock=time.monotonic,
-                 trace=None):
+                 trace=None, tenant_share=None):
         self.blocks = block_mgr
         self.max_batch = int(max_batch)
         self.max_queue = int(max_queue)
         self.max_prefills_per_step = int(max_prefills_per_step)
         self.clock = clock
+        # fair-share admission: one tenant may hold at most this
+        # fraction of the queue (1.0 = off, the strict-FIFO default);
+        # below 1.0 admission also interleaves tenants round-robin
+        if tenant_share is None:
+            from ..base import env_float
+
+            tenant_share = env_float("MXTPU_SERVE_TENANT_SHARE", 1.0)
+        self.tenant_share = min(1.0, max(0.0, float(tenant_share)))
         # request tracer (telemetry.request_trace) — every lifecycle
         # decision this scheduler makes is an event on it; the default
         # no-op keeps bare Scheduler tests wiring-free
@@ -117,10 +126,29 @@ class Scheduler:
         self.preemptions = 0       # guarded-by: _lock
         self.rejections = 0        # guarded-by: _lock
         self.reject_reasons = {}   # guarded-by: _lock
+        # per-tenant admission/outcome/latency accounting (statusz +
+        # ServeStats.tenants; the telemetry tenant series mirror it).
+        # Bounded: client-supplied tenant strings must not grow
+        # scheduler state without limit (oldest-seen evicted past cap)
+        self.tenants = {}          # guarded-by: _lock
+        self.max_tenants = 1024
+        # tenant label values ever exported to the telemetry registry:
+        # metric children are never evicted there, so past the cap new
+        # tenants fold into one "other" label (bounded cardinality)
+        self._tenant_labels = set()  # guarded-by: _lock
+        # fair-share rotation cursor over the (bounded, rebuilt per
+        # admission) list of tenants currently waiting
+        self._rr_idx = 0           # guarded-by: _lock
 
     # -- admission -----------------------------------------------------------
     def submit(self, req):
         self.trace.submitted(req)
+        if req.deadline_s is not None and req.deadline_s <= 0:
+            # already expired when handed to us: reject at admission
+            # (same three-view accounting as a queue-expired deadline)
+            # instead of queuing work whose answer nobody can use
+            self._reject(req, "deadline_at_submit")
+            return req
         with self._lock:
             if len(self.waiting) >= self.max_queue:
                 # back-pressure raise: the request never queues, but it
@@ -133,17 +161,105 @@ class Scheduler:
                 self.rejections += 1
                 self.reject_reasons["queue_full"] = \
                     self.reject_reasons.get("queue_full", 0) + 1
-                self.trace.terminal(req, "rejected", reason="queue_full")
-                raise QueueFull(
-                    f"admission queue full ({self.max_queue} waiting)")
-            if not self.blocks.fits_at_all(req.target_len()):
+                outcome = "queue_full"
+            elif self.tenant_share < 1.0 and self._over_share(req):
+                # fair share: this tenant already holds its fraction of
+                # the queue — rejecting IT (retriable) leaves headroom
+                # for every other tenant, so one abusive client cannot
+                # starve the rest into QueueFull
+                outcome = "tenant_share"
+            elif not self.blocks.fits_at_all(req.target_len()):
                 # would OOM the cache even running alone: reject NOW,
                 # at submit, rather than deadlock in the waiting queue
-                self._reject(req, "exceeds_cache")
-                return req
-            req.submit_t = self.clock()
-            self.waiting.append(req)
+                outcome = "exceeds_cache"
+            else:
+                req.submit_t = self.clock()
+                self.waiting.append(req)
+                outcome = None
+        # trace/telemetry emission stays OUTSIDE the lock: the step
+        # thread's schedule()/finish() must never contend with an
+        # admission's metric-registry work
+        if outcome == "queue_full":
+            self._tenant_event(req, "rejected", reason="queue_full")
+            self.trace.terminal(req, "rejected", reason="queue_full")
+            raise QueueFull(
+                f"admission queue full ({self.max_queue} waiting)")
+        if outcome is not None:
+            self._reject(req, outcome)
+            return req
+        self._tenant_event(req, "submitted")
         return req
+
+    def _over_share(self, req):
+        """Whether admitting ``req`` would push its tenant past its
+        fair share of the waiting queue (called under ``_lock``).
+        Tenant identity uses the same ``None -> "default"`` coalescing
+        as admission rotation and tenant_stats — an untagged request
+        and an explicit "default" are ONE tenant sharing one cap."""
+        cap = max(1, int(self.max_queue * self.tenant_share))
+        tenant = req.tenant or "default"
+        held = sum(1 for r in self.waiting
+                   if (r.tenant or "default") == tenant)
+        return held >= cap
+
+    def _tenant_event(self, req, outcome, reason=None, latency_s=None):
+        """Fold one lifecycle outcome into the per-tenant table and the
+        telemetry tenant series (no-ops unless MXTPU_TELEMETRY)."""
+        tenant = req.tenant or "default"
+        with self._lock:
+            t = self.tenants.setdefault(
+                tenant, {"submitted": 0, "completed": 0, "rejected": 0,
+                         "latency_s_sum": 0.0, "latency_s_max": 0.0})
+            if outcome in t:
+                t[outcome] += 1
+            if latency_s is not None:
+                t["latency_s_sum"] += latency_s
+                t["latency_s_max"] = max(t["latency_s_max"], latency_s)
+            while len(self.tenants) > self.max_tenants:
+                # oldest-seen eviction (insertion-ordered dict): an
+                # attacker minting fresh tenant strings loses history,
+                # never grows the table
+                self.tenants.pop(next(iter(self.tenants)))
+            if tenant in self._tenant_labels \
+                    or len(self._tenant_labels) < self.max_tenants:
+                self._tenant_labels.add(tenant)
+                label = tenant
+            else:
+                label = "other"    # registry children never evict
+        from .. import telemetry
+
+        if outcome == "rejected":
+            telemetry.counter(
+                "mxtpu_serve_tenant_rejections_total",
+                "per-tenant rejected requests",
+                ("tenant", "reason")).labels(
+                    tenant=label, reason=reason or "unknown").inc()
+        elif outcome == "completed":
+            telemetry.counter(
+                "mxtpu_serve_tenant_completed_total",
+                "per-tenant finished requests",
+                ("tenant",)).labels(tenant=label).inc()
+            if latency_s is not None:
+                telemetry.histogram(
+                    "mxtpu_serve_tenant_latency_seconds",
+                    "per-tenant submit-to-finish latency",
+                    ("tenant",)).labels(tenant=label).observe(latency_s)
+
+    def tenant_stats(self):
+        """Immutable per-tenant snapshot: submitted/completed/rejected
+        counts plus mean/max end-to-end latency of finished requests."""
+        with self._lock:
+            out = {}
+            for tenant, t in self.tenants.items():
+                row = dict(t)
+                done = row["completed"]
+                lat_sum = row.pop("latency_s_sum")
+                row["latency_s_mean"] = (round(lat_sum / done, 6)
+                                         if done else None)
+                row["latency_s_max"] = (round(row["latency_s_max"], 6)
+                                        if done else None)
+                out[tenant] = row
+            return out
 
     def _reject(self, req, reason):
         req.status = REJECTED
@@ -153,10 +269,14 @@ class Scheduler:
             self.rejections += 1
             self.reject_reasons[reason] = \
                 self.reject_reasons.get(reason, 0) + 1
-        if req.trace_id is None:
-            # rejected before scheduler.submit ever saw it (the
-            # engine's exceeds_max_len guard): open the trace so the
-            # timeline is still submitted -> rejected
+        self._tenant_event(req, "rejected", reason=reason)
+        if getattr(req, "_trace_sampled", None) is None:
+            # rejected before the TRACER ever saw it (the engine's
+            # exceeds_max_len guard): open the trace so the timeline is
+            # still submitted -> rejected.  Keyed on the tracer's own
+            # sampling mark, not on trace_id — a fleet router
+            # pre-stamps trace ids, and those requests still need
+            # their JSONL line
             self.trace.submitted(req)
         self.trace.terminal(req, "rejected", reason=reason)
 
@@ -222,12 +342,14 @@ class Scheduler:
             while (self.waiting
                    and len(self.running) + len(prefills) < self.max_batch
                    and len(prefills) < self.max_prefills_per_step):
-                req = self.waiting[0]
+                req = self._next_admission()
                 need = req.prefill_ids().size + 1
                 if not self.blocks.can_allocate(need):
                     break          # FIFO head-of-line: no skipping ahead
-                self.waiting.pop(0)
+                self.waiting.remove(req)
                 self.blocks.allocate(req.rid, need)
+                if self.tenant_share < 1.0:
+                    self._rr_idx += 1    # rotation advances on ADMIT
                 req.status = RUNNING
                 self.trace.event(
                     req, "resumed" if req.n_preemptions else "admitted",
@@ -235,6 +357,37 @@ class Scheduler:
                     n_preemptions=req.n_preemptions)
                 prefills.append(req)
             return prefills, decodes
+
+    def _next_admission(self):
+        """The next waiting request to consider (called under ``_lock``
+        with ``waiting`` non-empty).  Strict FIFO by default; under
+        fair share (``tenant_share < 1.0``) admission rotates
+        round-robin across the tenants CURRENTLY waiting — FIFO within
+        each tenant — so a deep single-tenant backlog cannot
+        head-of-line-block everyone else's first request.  The tenant
+        list is rebuilt from the waiting queue each call (bounded by
+        ``max_queue``, so cost is O(queue), never O(tenants-ever-seen)).
+
+        The ``_rr_idx`` cursor advances in the admission loop, only
+        AFTER a candidate actually got its blocks: when the picked
+        request cannot allocate, the same tenant's head is retried
+        first on every following step — other tenants cannot leapfrog
+        and refill the cache indefinitely, so strict FIFO's progress
+        guarantee (a big request eventually fits as running work
+        drains) survives inside each rotation slot."""
+        with self._lock:           # reentrant: schedule() holds it
+            if self.tenant_share >= 1.0:
+                return self.waiting[0]
+            tenants = []
+            for r in self.waiting:
+                t = r.tenant or "default"
+                if t not in tenants:
+                    tenants.append(t)
+            tenant = tenants[self._rr_idx % len(tenants)]
+            for r in self.waiting:
+                if (r.tenant or "default") == tenant:
+                    return r
+            return self.waiting[0]
 
     def _pick_victim(self, needy):
         """Lowest priority = latest arrival among running requests."""
@@ -263,6 +416,11 @@ class Scheduler:
                 self.blocks.free(req.rid, retain=True)
         req.status = status
         req.finish_t = self.clock()
+        if status == FINISHED:
+            self._tenant_event(
+                req, "completed",
+                latency_s=(req.finish_t - req.submit_t
+                           if req.submit_t is not None else None))
         self.trace.terminal(req, status, generated=len(req.tokens))
 
     def admit_running(self, req):

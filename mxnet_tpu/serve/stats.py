@@ -44,9 +44,13 @@ class ServeStats:
     decode_tok_per_sec: float | None   # sliding window over recent steps
     total_tok_per_sec: float | None    # engine lifetime aggregate
     # cumulative rejections by reason code (queue_full / deadline /
-    # exceeds_cache / exceeds_max_len) — the same codes the request
-    # trace and mxtpu_serve_rejections_total{reason} carry
+    # deadline_at_submit / tenant_share / exceeds_cache /
+    # exceeds_max_len) — the same codes the request trace and
+    # mxtpu_serve_rejections_total{reason} carry
     reject_reasons: dict = field(default_factory=dict)
+    # per-tenant admission/outcome/latency table
+    # (Scheduler.tenant_stats) — empty until requests carry tenants
+    tenants: dict = field(default_factory=dict)
 
     def as_dict(self):
         return asdict(self)
@@ -160,4 +164,5 @@ class StatsRecorder:
             total_tok_per_sec=(round(total_rate, 1)
                                if total_rate else None),
             reject_reasons=dict(scheduler.reject_reasons),
+            tenants=scheduler.tenant_stats(),
         )

@@ -93,8 +93,11 @@ def serve_http(registry, port, host="127.0.0.1"):
     for an ephemeral one; ``server.shutdown()`` stops it).
 
     Routes: ``/metrics`` (Prometheus text), ``/metrics.json`` (registry
-    snapshot), ``/statusz`` (live introspection HTML) and
-    ``/statusz.json`` (same as JSON — statusz.py providers)."""
+    snapshot), ``/statusz`` (live introspection HTML),
+    ``/statusz.json`` (same as JSON — statusz.py providers) and
+    ``/healthz`` (cheap liveness/readiness JSON from the statusz
+    health providers — no registry render, no statusz assembly, so
+    supervisors/routers can probe at high frequency)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
@@ -102,6 +105,11 @@ def serve_http(registry, port, host="127.0.0.1"):
             if self.path in ("/", "/metrics"):
                 body = to_prometheus_text(registry).encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif self.path == "/healthz":
+                from . import statusz
+
+                body = json.dumps(statusz.health()).encode()
+                ctype = "application/json"
             elif self.path == "/metrics.json":
                 body = json.dumps(registry.snapshot()).encode()
                 ctype = "application/json"

@@ -175,8 +175,12 @@ class RequestTracer:
     # -- recording hooks (scheduler + engine call these) -------------------
     def submitted(self, req):
         """First event of a request's life; stamps trace identity on
-        the Request."""
-        req.trace_id = f"{self._pid:x}-{req.rid}"
+        the Request — unless the caller pre-stamped one (a fleet
+        router propagates its trace id across replica hops, so every
+        hop's JSONL line shares the id and ``tools/trace_report.py``
+        can stitch the request back together)."""
+        if req.trace_id is None:
+            req.trace_id = f"{self._pid:x}-{req.rid}"
         req._trace_sampled = self.enabled and _sampled(req.rid, self.sample)
         req._trace_events = [] if req._trace_sampled else None
         if req._trace_sampled:
@@ -229,6 +233,7 @@ class RequestTracer:
     # -- JSONL export ------------------------------------------------------
     def _write_line(self, req, status, events):
         line = json.dumps({"trace_id": req.trace_id, "rid": req.rid,
+                           "tenant": getattr(req, "tenant", None),
                            "status": status,
                            "prompt_tokens": int(req.prompt.size),
                            "max_new_tokens": req.max_new_tokens,

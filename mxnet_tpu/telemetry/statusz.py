@@ -28,10 +28,12 @@ import threading
 import time
 
 __all__ = ["register", "register_weak", "unregister", "snapshot",
-           "render_html", "bytes_by_device"]
+           "render_html", "bytes_by_device", "register_health",
+           "unregister_health", "health"]
 
 _lock = threading.Lock()
 _providers = {}                  # name -> zero-arg callable
+_health_providers = {}           # name -> zero-arg callable
 # uptime is an ELAPSED quantity: monotonic, so an NTP step can't make
 # a 2-minute-old process report hours (or negative seconds) of uptime
 _start_m = time.monotonic()
@@ -67,6 +69,52 @@ def register_weak(obj, name, method="statusz"):
 def unregister(name):
     with _lock:
         _providers.pop(name, None)
+
+
+# -- /healthz: liveness/readiness, deliberately CHEAP -------------------------
+# A supervisor or router probing every replica every few hundred ms
+# must not pay the /statusz.json assembly cost (every provider runs,
+# jax inventory, JSON of the whole engine state).  Health providers are
+# a separate, tiny registry: each returns a small dict with a
+# ``status`` field ("ok" / "draining" / anything else = unhealthy) and
+# the endpoint renders only those.
+def register_health(name, fn):
+    """Register health provider ``fn`` (zero-arg -> small dict with a
+    ``status`` key) under ``name``; returns ``name``."""
+    with _lock:
+        _health_providers[str(name)] = fn
+    return str(name)
+
+
+def unregister_health(name):
+    with _lock:
+        _health_providers.pop(name, None)
+
+
+def health():
+    """One cheap liveness/readiness snapshot: ``status`` is "ok" when
+    every provider reports ok, else the first non-ok status (providers
+    that raise report status "error" without taking the page down).
+    Never touches the metrics registry or the statusz providers."""
+    with _lock:
+        providers = dict(_health_providers)
+    out = {"status": "ok", "pid": os.getpid(),
+           "uptime_s": round(time.monotonic() - _start_m, 3)}
+    checks = {}
+    for name, fn in sorted(providers.items()):
+        try:
+            c = fn()
+        except Exception as e:
+            c = {"status": "error", "error": repr(e)}
+        if c is None:               # dead weakref-style provider
+            continue
+        checks[name] = c
+        st = c.get("status") if isinstance(c, dict) else None
+        if st is not None and st != "ok" and out["status"] == "ok":
+            out["status"] = str(st)
+    if checks:
+        out["checks"] = checks
+    return out
 
 
 def bytes_by_device(arrays):

@@ -416,8 +416,13 @@ def test_flight_dump_on_rejection_rate(model, tmp_path, monkeypatch):
 
 # -- /statusz ----------------------------------------------------------------
 def test_statusz_endpoint_live_state(tel, model):
+    import gc
     import urllib.request
 
+    # engines from earlier tests (this file's and test_serve's) may
+    # not have been cyclically collected yet; their weakref statusz
+    # providers would inflate the engine-section count below
+    gc.collect()
     eng = _engine(model)
     eng.submit(np.arange(1, 20, dtype=np.int32), max_new_tokens=30)
     eng.submit(np.arange(1, 12, dtype=np.int32), max_new_tokens=30)

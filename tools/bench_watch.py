@@ -16,6 +16,11 @@ artifact set in priority order:
      tools/serve_bench.py --tp 2            -> SERVE_TP_BENCH.json
   9. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
 
+Two stages need no TPU and run ahead of the probe (so chip-down rounds
+still capture them): mxtpu-lint finding counts, and
+tools/fleet_bench.py -> FLEET_BENCH.json (replica subprocesses are
+CPU-pinned by design — N processes cannot share the single chip).
+
 Each successful TPU-platform result is also appended to
 BENCH_ATTEMPTS.jsonl with a timestamp so nothing is lost if a later
 stage hangs.  Run it in the background; it exits once every stage has
@@ -259,6 +264,58 @@ def run_lint_stage(timeout=300):
     return True
 
 
+def run_fleet_stage(timeout=900):
+    """Fleet robustness artifact (tools/fleet_bench.py): availability
+    under one injected replica kill + rolling-restart downtime through
+    the router/supervisor stack.  Deliberately CPU (N replica
+    processes cannot share the single-client chip, and the property —
+    fault-transparent routing — is backend-agnostic), so like the lint
+    stage it needs no TPU and runs even on chip-down rounds."""
+    out = os.path.join(REPO, "FLEET_BENCH.json")
+    tmp = out + ".tmp"
+    if os.path.exists(tmp):
+        os.unlink(tmp)
+    # own process group: a timeout must take the 3 replica
+    # subprocesses down WITH fleet_bench — SIGKILLing only the parent
+    # would orphan them for the rest of the watch window
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "fleet_bench.py"),
+         "--json", tmp],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
+    stderr_tail = ""
+    try:
+        _, stderr = proc.communicate(timeout=timeout)
+        stderr_tail = (stderr or "")[-300:]
+    except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            pass               # group already gone
+        proc.wait()
+        log("fleet: timed out (process group killed)")
+        return False
+    try:
+        with open(tmp) as f:
+            payload = json.loads(f.readlines()[-1])
+        os.unlink(tmp)
+    except (OSError, IndexError, ValueError) as e:
+        log(f"fleet: no JSON ({e}): {stderr_tail}")
+        return False
+    if not payload.get("complete") or payload.get("availability") != 1.0:
+        log(f"fleet: contract failed (complete={payload.get('complete')}, "
+            f"availability={payload.get('availability')})")
+        return False
+    record("fleet", payload)
+    with open(out, "w") as f:
+        f.write(json.dumps(payload, indent=1) + "\n")
+    log(f"fleet: captured (availability={payload['availability']}, "
+        f"rolling_restart_s={payload.get('rolling_restart_s')})")
+    return True
+
+
 def run_bandwidth(timeout=1200):
     return run_json_artifact(
         "bandwidth",
@@ -498,7 +555,7 @@ def main():
     # lane (24 cases, 21 ever green), the tuned flash blocks (committed
     # record shows flash LOSING), the never-measured fused RNN — then
     # the headline benches, then the new r5 records, then the long tail
-    done = {"lint": False,
+    done = {"lint": False, "fleet": False,
             "consistency": False, "flash": False, "rnn": False,
             "resnet": False, "resnet256": False, "gpt": False,
             "longcontext": False, "bandwidth": False, "cifar": False,
@@ -544,6 +601,15 @@ def main():
         if not done["lint"]:
             done["lint"] = attempt(
                 "lint", lambda: run_lint_stage(timeout=min(600, left)))
+        # the fleet stage is CPU-only by design (replica subprocesses):
+        # like lint it runs ahead of the probe so chip-down rounds
+        # still capture the robustness artifact
+        if not done["fleet"]:
+            left = deadline - time.monotonic()
+            if left < 120:
+                continue
+            done["fleet"] = attempt(
+                "fleet", lambda: run_fleet_stage(timeout=min(900, left)))
         if not probe():
             log("TPU unreachable; retrying in 60s")
             time.sleep(60)

@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""Fleet robustness benchmark: availability under chaos + rolling
+restart, measured against a real 3-replica process fleet.
+
+Three ``tools/serve_replica.py`` processes (identical weights by
+seed), one ``fleet.Supervisor`` (crash restarts), one ``fleet.Router``
+(least-loaded + retry-on-sibling).  The run:
+
+  phase 1  open-loop Poisson load through the router while a
+           deterministic fault spec (``kill@K``) hard-kills one
+           replica mid-stream; the supervisor restarts it.
+  phase 2  drain-based rolling restart of ALL replicas under light
+           load.
+
+Recorded (FLEET_BENCH.json, the bench_watch ``fleet`` stage):
+
+  availability            completed / submitted over phase 1 (the
+                          headline: 1.0 means the kill was invisible)
+  p99_added_router_ms     p99 of (request wall - time inside replica
+                          HTTP calls) — what the router itself costs
+  rolling_restart_s       phase 2 wall for all replicas
+  slot_restart_s          per-slot drain->ready times
+  restart_rejects         client-visible failures during phase 2
+                          (contract: 0)
+  token_consistent        identical prompts produced identical tokens
+                          regardless of which replica served them
+
+Contract (pinned by tests/test_fleet.py's slow-tier case): the payload
+stamps ``complete: true`` and ``availability == 1.0`` on the CPU
+smoke.  This bench runs the replicas on the CPU backend by design —
+N single-host processes cannot share one TPU client, and the
+property under test (fault-transparent routing) is backend-agnostic.
+
+Usage: python tools/fleet_bench.py [--json OUT] [--replicas 3]
+           [--requests 24 --rate 8 --max-new 16 --kill-at 4]
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# The orchestrating parent pins ITSELF to the cpu backend before the
+# package import: it must never claim the (single-client) TPU the
+# round driver owns just to spawn subprocesses — and the replica
+# children pin cpu explicitly anyway (N processes cannot share a chip).
+os.environ.setdefault("MXTPU_PLATFORMS", "cpu")
+
+from mxnet_tpu.fleet import ProcessReplica, Router, Supervisor  # noqa: E402
+from mxnet_tpu.fleet.supervisor import replica_command  # noqa: E402
+# one percentile definition for the whole tool suite: this payload's
+# p99 must mean the same thing as a trace_report p99 over the same data
+from tools.trace_report import percentile as _percentile  # noqa: E402
+
+
+def percentile(vals, q):
+    return _percentile(sorted(vals), q)
+
+
+def build_workload(rng, args):
+    lens = [int(x) for x in args.prompt_lens.split(",")]
+    return [rng.randint(1, args.vocab, size=lens[i % len(lens)]).tolist()
+            for i in range(args.requests)]
+
+
+def run_load(router, workload, rate, max_new, rng, tag):
+    """Open loop: Poisson arrivals, one thread per in-flight request.
+    Returns (results, failures) keyed by request index."""
+    arrivals = []
+    t = 0.0
+    for _ in workload:
+        t += rng.exponential(1.0 / rate)
+        arrivals.append(t)
+    results, failures = {}, {}
+    lock = threading.Lock()
+
+    def one(i, prompt):
+        rid = f"{tag}-{i}"
+        try:
+            res = router.generate(prompt, max_new_tokens=max_new,
+                                  request_id=rid,
+                                  trace_id=f"{tag}-trace-{i}")
+            with lock:
+                results[i] = res
+        except Exception as e:
+            with lock:
+                failures[i] = f"{type(e).__name__}: {e}"
+
+    threads = []
+    t0 = time.perf_counter()
+    for i, prompt in enumerate(workload):
+        wait = arrivals[i] - (time.perf_counter() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        th = threading.Thread(target=one, args=(i, prompt), daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=180)
+    return results, failures
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--requests", type=int, default=24)
+    p.add_argument("--rate", type=float, default=8.0,
+                   help="open-loop arrival rate, requests/sec")
+    p.add_argument("--prompt-lens", default="8,12,16")
+    p.add_argument("--max-new", type=int, default=16)
+    p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--kill-at", type=int, default=4,
+                   help="fault spec kill@K armed on replica slot 1's "
+                        "first life (0 disables the chaos phase)")
+    p.add_argument("--restart-requests", type=int, default=12,
+                   help="light-load requests during the rolling restart")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json", default=None)
+    args = p.parse_args()
+
+    import numpy as np
+
+    rng = np.random.RandomState(args.seed)
+    out = {"platform": "cpu", "replicas": args.replicas,
+           "requests": args.requests, "rate": args.rate,
+           "max_new": args.max_new,
+           "kill_spec": (f"kill@{args.kill_at}" if args.kill_at else None),
+           "complete": False}
+
+    def flush():
+        if args.json:
+            tmp = args.json + ".wip"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(out) + "\n")
+            os.replace(tmp, args.json)
+
+    spec_armed = {1: False}
+
+    def spawn(slot):
+        env = dict(os.environ)
+        env.pop("MXTPU_FAULT_SPEC", None)
+        if slot == 1 and args.kill_at and not spec_armed[1]:
+            # only the FIRST life of slot 1 carries the kill — its
+            # crash-restart replacement must come back healthy
+            spec_armed[1] = True
+            env["MXTPU_FAULT_SPEC"] = f"kill@{args.kill_at}"
+        handle = ProcessReplica(
+            replica_command(extra_args=[
+                "--backend", "cpu", "--seed", str(args.seed),
+                "--vocab", str(args.vocab), "--warmup", "full",
+                "--exit-on-drained"]),
+            env=env)
+        handle.wait_ready(timeout_s=240)
+        return handle
+
+    router = Router([], scrape_interval_s=0.25, timeout_s=60.0,
+                    retries=4, backoff_s=0.05, backoff_max_s=0.5,
+                    breaker_fails=3, breaker_reset_s=2.0)
+    sup = Supervisor(spawn, args.replicas, router=router,
+                     restart_backoff_s=0.2)
+    t_start = time.perf_counter()
+    # startup INSIDE the try: a slot that fails wait_ready mid-start
+    # must still tear down the replicas already spawned (sup.stop()
+    # terminates every handle in the slots list) instead of orphaning
+    # them for the rest of the bench_watch window
+    try:
+        sup.start()
+        out["fleet_ready_s"] = round(time.perf_counter() - t_start, 3)
+        router.scrape()
+        router.start()
+        sup.run(interval_s=0.25)
+        flush()
+        # -- phase 1: chaos load ------------------------------------------
+        workload = build_workload(rng, args)
+        t1 = time.perf_counter()
+        results, failures = run_load(router, workload, args.rate,
+                                     args.max_new, rng, "chaos")
+        wall = time.perf_counter() - t1
+        completed = len(results)
+        out["submitted"] = len(workload)
+        out["completed"] = completed
+        out["failures"] = dict(list(failures.items())[:5])
+        out["availability"] = round(completed / max(1, len(workload)), 4)
+        out["wall_s"] = round(wall, 3)
+        out["retried_requests"] = sum(
+            1 for r in results.values() if r.attempts > 1)
+        out["p99_added_router_ms"] = (
+            round(1e3 * percentile(
+                [r.added_s for r in results.values()], 0.99), 3)
+            if results else None)
+        out["p50_request_ms"] = (
+            round(1e3 * percentile(
+                [r.wall_s for r in results.values()], 0.50), 3)
+            if results else None)
+        # identical prompts must yield identical tokens, whichever
+        # replica (or retry path) served them
+        by_prompt = {}
+        consistent = True
+        for i, res in results.items():
+            key = tuple(workload[i])
+            prev = by_prompt.setdefault(key, res.tokens)
+            consistent = consistent and (prev == res.tokens)
+        out["token_consistent"] = consistent
+        out["replicas_used"] = sorted(
+            {r.replica for r in results.values()})
+        out["crash_restarts"] = int(sum(sup._restarts))
+        flush()
+
+        # -- phase 2: rolling restart under light load --------------------
+        light = build_workload(
+            rng, argparse.Namespace(
+                prompt_lens=args.prompt_lens, vocab=args.vocab,
+                requests=args.restart_requests))
+        r_results, r_failures = {}, {}
+        load_done = threading.Event()
+
+        def light_load():
+            res, fail = run_load(
+                router, light, max(2.0, args.rate / 2), args.max_new,
+                np.random.RandomState(args.seed + 1), "restart")
+            r_results.update(res)
+            r_failures.update(fail)
+            load_done.set()
+
+        lt = threading.Thread(target=light_load, daemon=True)
+        t2 = time.perf_counter()
+        slot_times = []
+        lt.start()
+        for slot in range(args.replicas):
+            s0 = time.perf_counter()
+            sup.drain_and_restart(slot)
+            slot_times.append(round(time.perf_counter() - s0, 3))
+        out["rolling_restart_s"] = round(time.perf_counter() - t2, 3)
+        out["slot_restart_s"] = slot_times
+        load_done.wait(timeout=300)
+        out["restart_submitted"] = len(light)
+        out["restart_completed"] = len(r_results)
+        out["restart_rejects"] = len(r_failures)
+        out["complete"] = bool(
+            completed == len(workload) and not failures
+            and len(r_results) == len(light) and not r_failures
+            and consistent)
+    finally:
+        router.stop()
+        sup.stop()
+    flush()
+    print(json.dumps(out))
+    return 0 if out["complete"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

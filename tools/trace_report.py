@@ -19,8 +19,13 @@ make the run exit non-zero under ``--check``).
 Pure stdlib — usable on a laptop against a file scp'd from production.
 
 Usage:
-  python tools/trace_report.py TRACE.jsonl [--json OUT] [--check]
+  python tools/trace_report.py TRACE.jsonl [MORE.jsonl ...]
+      [--json OUT] [--check] [--stitch]
       [--top N]   # also show the N slowest requests end-to-end
+
+Passing several files (one per fleet replica) plus ``--stitch`` groups
+lines by the router-propagated ``trace_id``, so a request retried
+across replicas reads as one multi-hop story (docs/how_to/fleet.md).
 """
 
 import argparse
@@ -95,6 +100,51 @@ def load_traces(path):
                 rec.get("events", []))
             out.append((rec, phases, status, reason, complete))
     return out
+
+
+# rejection reasons no replica can ever serve — the same 400-class
+# set the fleet replica maps to non-retriable responses
+# (mxnet_tpu/fleet/replica.py PERMANENT_REASONS; change together)
+PERMANENT_REJECTS = ("exceeds_max_len", "exceeds_cache",
+                     "deadline_at_submit")
+
+
+def stitch(traces):
+    """Cross-replica view: group records by ``trace_id``.
+
+    A fleet router propagates ONE trace id across every replica hop of
+    a client request (X-MXTPU-Trace-Id -> ``Engine.submit(trace_id=)``),
+    so feeding this tool the trace files of ALL replicas shows each
+    retried request as one multi-hop group: e.g. a hop rejected
+    ``queue_full`` on replica A followed by ``finished`` on replica B.
+
+    Returns ``{"requests": distinct ids, "multi_hop": ids with > 1
+    line, "max_hops": ..., "unresolved": ids where no hop finished}``.
+    A request whose final word was a PERMANENT rejection (the client
+    got a correct 400 — :data:`PERMANENT_REJECTS`) is resolved, not
+    lost; ``unresolved`` flags only requests that vanished mid-retry.
+    """
+    by_id = {}
+    for rec, _, status, reason, _ in traces:
+        tid = rec.get("trace_id")
+        if tid is None:
+            continue
+        by_id.setdefault(tid, []).append((status, reason))
+    multi = {tid: hops for tid, hops in by_id.items() if len(hops) > 1}
+
+    def resolved(hops):
+        return any(status == "finished"
+                   or (status == "rejected"
+                       and reason in PERMANENT_REJECTS)
+                   for status, reason in hops)
+
+    return {
+        "requests": len(by_id),
+        "multi_hop": len(multi),
+        "max_hops": max((len(h) for h in by_id.values()), default=0),
+        "unresolved": sorted(tid for tid, hops in by_id.items()
+                             if not resolved(hops)),
+    }
 
 
 # -- aggregation -------------------------------------------------------------
@@ -184,22 +234,43 @@ def render(summary, traces, top=0):
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="per-request latency breakdown from a request trace")
-    p.add_argument("path", help="request_trace.jsonl")
+    p.add_argument("paths", nargs="+", metavar="path",
+                   help="request_trace.jsonl file(s) — pass every "
+                        "replica's file to stitch a fleet's view")
     p.add_argument("--json", default=None,
                    help="also write the summary as JSON")
     p.add_argument("--top", type=int, default=5,
                    help="show the N slowest requests (0 to hide)")
     p.add_argument("--check", action="store_true",
                    help="exit 1 when any timeline is incomplete")
+    p.add_argument("--stitch", action="store_true",
+                   help="group lines by trace_id across the input "
+                        "files (cross-replica request view); with "
+                        "--check also fail on unresolved requests")
     args = p.parse_args(argv)
-    traces = load_traces(args.path)
+    traces = []
+    for path in args.paths:
+        traces.extend(load_traces(path))
     summary = aggregate(traces)
+    stitched = None
+    if args.stitch or len(args.paths) > 1:
+        stitched = stitch(traces)
+        summary["stitched"] = stitched
     print(render(summary, traces, args.top))
+    if stitched is not None:
+        print(f"\nstitched: {stitched['requests']} requests across "
+              f"{len(args.paths)} file(s), {stitched['multi_hop']} "
+              f"multi-hop (max {stitched['max_hops']} hops), "
+              f"{len(stitched['unresolved'])} unresolved")
     if args.json:
         with open(args.json, "w") as f:
             json.dump(summary, f, indent=2)
     if args.check and summary["broken"]:
         print(f"BROKEN timelines: {summary['broken']}", file=sys.stderr)
+        return 1
+    if args.check and args.stitch and stitched["unresolved"]:
+        print(f"UNRESOLVED requests (no hop finished): "
+              f"{stitched['unresolved']}", file=sys.stderr)
         return 1
     return 0
 
