@@ -389,7 +389,10 @@ class ReplicaServer:
                 "state": state,
                 "in_flight": len(self._inflight),
                 "queue_depth": self.engine.scheduler.queue_depth,
-                "running": len(self.engine.scheduler.running)}
+                # mid-chunked-prefill requests hold a batch slot too —
+                # a replica grinding a long prefill must report the load
+                "running": (len(self.engine.scheduler.running)
+                            + len(self.engine.scheduler.prefilling))}
 
     def _replica_state(self):
         """The router's balancing signal: readiness plus live load
@@ -401,7 +404,11 @@ class ReplicaServer:
         return {"replica": self.replica_id, "state": state,
                 "served": served, "in_flight": inflight,
                 "queue_depth": eng.scheduler.queue_depth,
-                "running": len(eng.scheduler.running),
+                # running includes the chunked-prefill lane: those
+                # requests occupy batch slots and the prefill budget,
+                # so the router's load score must see them
+                "running": (len(eng.scheduler.running)
+                            + len(eng.scheduler.prefilling)),
                 "max_batch": eng.max_batch,
                 "kv_utilization": round(eng.blocks.utilization(), 4),
                 "faults_fired": len(self.faults.fired)}

@@ -10,14 +10,19 @@
   eng.shutdown()
 
 Each ``step()`` is one scheduler iteration: at most
-``max_prefills_per_step`` whole-prompt prefills (one jit-compiled
-program per prompt-length bucket) followed by ONE batched single-token
-decode over every running request (one program per batch bucket).  All
-shapes are padded to power-of-two buckets and the block-table width is
-fixed at ``max_model_len / block_size``, so the number of distinct XLA
-programs is bounded by O(log max_batch + log max_model_len) — no
-per-request recompiles, the serving analog of ``BucketingModule``'s
-bucket trick.
+``max_prefills_per_step`` prefills (one jit-compiled program per
+prompt-length bucket) followed by ONE batched single-token decode over
+every running request (one program per batch bucket).  A prefill skips
+whatever block-aligned prefix the content-addressed KV cache already
+holds (``MXTPU_SERVE_PREFIX_CACHE``) and runs only the suffix through
+a third program family — the *chunk* program, which attends through
+the block table to the cached positions; the same program prefills
+long prompts one ``MXTPU_SERVE_PREFILL_CHUNK``-token chunk per
+iteration, interleaved with decodes.  All shapes are padded to
+power-of-two buckets and the block-table width is fixed at
+``max_model_len / block_size``, so the number of distinct XLA programs
+is bounded by O(log max_batch + log max_model_len) — no per-request
+recompiles, the serving analog of ``BucketingModule``'s bucket trick.
 
 The KV-cache is ONE device-resident array pair per engine,
 (layers, num_blocks, block_size, kv_heads, head_dim), carved into
@@ -166,6 +171,17 @@ class Engine:
         (``parallel.partition.parse_rules``).  Default: the env var,
         else ``parallel.partition.gpt_partition_rules`` keyed to this
         checkpoint's naming.  Ignored at ``tp=1``.
+      prefix_cache: content-addressed KV-block sharing across requests
+        (env ``MXTPU_SERVE_PREFIX_CACHE``, default on): a new prompt's
+        longest block-aligned cached prefix is reused and only the
+        suffix is prefilled (RadixAttention-style; see
+        ``kv_block_manager`` and docs/how_to/serve.md).
+      prefill_chunk: chunked-prefill threshold in tokens (env
+        ``MXTPU_SERVE_PREFILL_CHUNK``, default 512): a prompt whose
+        uncached remainder exceeds it prefills one chunk per iteration
+        interleaved with decode steps, so a very long prompt cannot
+        stall the decode batch for a whole-prompt prefill.  0 disables
+        chunking (whole-prompt prefills only).
     """
 
     def __init__(self, params, num_heads=None, window=None, symbol=None,
@@ -173,7 +189,8 @@ class Engine:
                  max_batch=None, max_queue=None, max_model_len=None,
                  max_prefills_per_step=1, temperature=0.0, top_k=None,
                  seed=0, clock=time.monotonic, aot_dir=None, tp=None,
-                 partition_rules=None, tenant_share=None):
+                 partition_rules=None, tenant_share=None,
+                 prefix_cache=None, prefill_chunk=None):
         if symbol is not None:
             num_heads, window = reconcile_decode_config(symbol, num_heads,
                                                         window)
@@ -261,7 +278,8 @@ class Engine:
         # fixed block-table width: one decode program per batch bucket
         self.table_width = -(-self.max_model_len // self.block_size)
 
-        self.blocks = BlockManager(self.num_blocks, self.block_size)
+        self.blocks = BlockManager(self.num_blocks, self.block_size,
+                                   prefix_cache=prefix_cache)
         # request-scoped observability: the tracer threads every
         # lifecycle event (scheduler decisions included) into the
         # flight-recorder ring, the optional JSONL export
@@ -271,7 +289,8 @@ class Engine:
         self.scheduler = Scheduler(self.blocks, self.max_batch, max_queue,
                                    max_prefills_per_step, clock=clock,
                                    trace=self._rtrace,
-                                   tenant_share=tenant_share)
+                                   tenant_share=tenant_share,
+                                   prefill_chunk=prefill_chunk)
         self._stats = StatsRecorder(clock=clock)
         self.clock = clock
         self._step_id = 0
@@ -459,8 +478,11 @@ class Engine:
             emitted = 0
             for req in prefills:
                 with telemetry.span("serve.prefill", rid=req.rid):
-                    self._run_prefill(req)
-                emitted += 1
+                    # the per-iteration prefill token budget is shared
+                    # with the decode slots: each decode emits one
+                    # token this step, so a chunk shrinks by the batch
+                    emitted += self._run_prefill(
+                        req, decode_slots=len(decodes))
             if decodes:
                 with telemetry.span("serve.decode", batch=len(decodes)):
                     emitted += self._run_decode(decodes)
@@ -539,11 +561,16 @@ class Engine:
         and AOT-store state."""
         now = self.clock()
         reqs = []
-        for req in list(self.scheduler.running) + list(self.scheduler.waiting):
+        mid_prefill = {id(r) for r in self.scheduler.prefilling}
+        for req in (list(self.scheduler.running)
+                    + list(self.scheduler.prefilling)
+                    + list(self.scheduler.waiting)):
             if req.status == WAITING:
                 phase = "queued" if req.n_preemptions == 0 else "preempted"
+            elif id(req) in mid_prefill or not req.tokens:
+                phase = "prefill"
             else:
-                phase = "prefill" if req.cache_len == 0 else "decode"
+                phase = "decode"
             reqs.append({
                 "rid": req.rid, "trace_id": req.trace_id,
                 "tenant": req.tenant, "status": req.status, "phase": phase,
@@ -552,6 +579,13 @@ class Engine:
                 "prompt_tokens": int(req.prompt.size),
                 "generated": len(req.tokens),
                 "target": req.target_len(),
+                # how a mid-prefill request is progressing: slots
+                # reused from the prefix cache at admission, slots
+                # written so far, and the admission-time prefill goal
+                # (None while waiting)
+                "cached_tokens": req.cached_prefix_len,
+                "prefill_done": int(req.cache_len),
+                "prefill_target": req.prefill_target,
                 "n_preemptions": req.n_preemptions})
         aot = {"dir": getattr(self._aot, "dir", None)}
         if self._aot is not None:
@@ -569,6 +603,9 @@ class Engine:
             "reject_reasons": dict(self.scheduler.reject_reasons),
             "tenants": self.scheduler.tenant_stats(),
             "kv_blocks": self.blocks.occupancy(),
+            # the prefix-cache section an operator reads to explain a
+            # cache-cold replica (also nested in kv_blocks.prefix_cache)
+            "prefix_cache": self.blocks.prefix_stats(),
             "kv_cache": self.kv_cache_stats(),
             "sharding": self.sharding_info(),
             "max_batch": self.max_batch,
@@ -628,7 +665,8 @@ class Engine:
         as-is are never touched."""
         if not self._alive:
             return
-        for req in list(self.scheduler.running):
+        for req in (list(self.scheduler.running)
+                    + list(self.scheduler.prefilling)):
             self.scheduler.finish(req, status=CANCELLED)
         for req in self.scheduler.drain_waiting():
             req.status = CANCELLED
@@ -659,23 +697,63 @@ class Engine:
         return blk, off
 
     @hot_path
-    def _run_prefill(self, req):
+    def _run_prefill(self, req, decode_slots=0):
+        """Run one prefill pass for ``req``: the whole uncached suffix
+        (cold path, or a prefix-cache hit's remainder), or — when the
+        scheduler put it in the chunked-prefill lane — ONE budget-sized
+        chunk.  Returns the tokens emitted (1 on the pass that samples
+        the first token, 0 for an intermediate chunk)."""
         ids = req.prefill_ids()
-        n = ids.size
+        n = int(ids.size)
+        start = int(req.cache_len)     # cached prefix + finished chunks
         resume = req.n_preemptions > 0
-        bucket = _next_bucket(n, self.max_model_len)
-        self._rtrace.event(req, "prefill_start", tokens=int(n),
-                           bucket=bucket, resume=resume)
-        toks = np.zeros(bucket, np.int32)
-        toks[:n] = ids
-        blk, off = self._slots(self.blocks.table(req.rid), n, bucket)
-        fn = self._prefill_fn(bucket)
+        chunked = self.scheduler.is_prefilling(req)
+        if chunked:
+            budget = max(1, self.scheduler.prefill_chunk - decode_slots)
+            end = min(n, start + budget)
+        else:
+            end = n
+        if not req._prefill_started:
+            req._prefill_started = True
+            self._rtrace.event(req, "prefill_start", tokens=int(n - start),
+                               cached=start, chunked=chunked,
+                               resume=resume)
+        span = end - start
         self._key, sub = jax.random.split(self._key)
+        if start == 0 and end == n:
+            # cold whole-prompt pass: the dense O(n^2)-attention
+            # program (exactly the pre-prefix-cache path)
+            bucket = _next_bucket(n, self.max_model_len)
+            toks = np.zeros(bucket, np.int32)
+            toks[:n] = ids
+            blk, off = self._slots(self.blocks.table(req.rid), n, bucket)
+            fn = self._prefill_fn(bucket)
+            args = (self.params, self._cache_k, self._cache_v,
+                    jnp.asarray(toks), jnp.asarray(n, jnp.int32),
+                    jnp.asarray(blk), jnp.asarray(off), sub)
+        else:
+            # suffix/chunk pass: positions [start, end) attend through
+            # the block table to the K/V already in the cache (cached
+            # prefix + earlier chunks) — cached positions are never
+            # recomputed and shared blocks are never written
+            bucket = _next_bucket(span, self._chunk_cap())
+            toks = np.zeros(bucket, np.int32)
+            toks[:span] = ids[start:end]
+            table = self.blocks.table(req.rid)
+            tw = np.zeros(self.table_width, np.int32)
+            tw[:len(table)] = table
+            pos = start + np.arange(span)
+            blk = np.zeros(bucket, np.int32)   # padded rows -> null blk
+            blk[:span] = tw[pos // self.block_size]
+            off = ((start + np.arange(bucket))
+                   % self.block_size).astype(np.int32)
+            fn = self._chunk_fn(bucket)
+            args = (self.params, self._cache_k, self._cache_v,
+                    jnp.asarray(toks), jnp.asarray(start, jnp.int32),
+                    jnp.asarray(span, jnp.int32), jnp.asarray(tw),
+                    jnp.asarray(blk), jnp.asarray(off), sub)
         if self._cfg.numeric_watch:
-            tok, ok, self._cache_k, self._cache_v = fn(
-                self.params, self._cache_k, self._cache_v,
-                jnp.asarray(toks), jnp.asarray(n, jnp.int32),
-                jnp.asarray(blk), jnp.asarray(off), sub)
+            tok, ok, self._cache_k, self._cache_v = fn(*args)
             # one batched read: the sampled token must reach the host
             # anyway, so the watchdog flag rides the same sync instead
             # of forcing a second one
@@ -686,13 +764,23 @@ class Engine:
                 flight_mod.record_anomaly("prefill_logits", rid=req.rid,
                                           step=self._step_id)
         else:
-            tok, self._cache_k, self._cache_v = fn(
-                self.params, self._cache_k, self._cache_v,
-                jnp.asarray(toks), jnp.asarray(n, jnp.int32),
-                jnp.asarray(blk), jnp.asarray(off), sub)
-        self._rtrace.event(req, "prefill_end", tokens=int(n),
+            tok, self._cache_k, self._cache_v = fn(*args)
+        req.cache_len = end
+        self._stats.on_prefill(span)
+        # publish the newly-FULL blocks under their chain keys so later
+        # prompts (or this request's own post-preemption resume) can
+        # reuse them — host-side dict work only
+        self.blocks.note_tokens(req.rid, ids[:end])
+        if end < n:
+            # intermediate chunk: the sampled token is bogus (mid-
+            # prompt) and dropped; the request stays in the prefilling
+            # lane and owns the next iteration's prefill budget
+            self._rtrace.event(req, "prefill_chunk", done=int(end),
+                               target=int(n), tokens=int(span))
+            return 0
+        self._rtrace.event(req, "prefill_end", tokens=int(n - start),
                            resume=resume)
-        req.cache_len = n
+        self.scheduler.prefill_done(req)
         self.scheduler.admit_running(req)
         now = self.clock()
         if req.first_token_t is None:
@@ -700,6 +788,7 @@ class Engine:
             self._stats.on_first_token(req.ttft() or 0.0)
         req.tokens.append(int(tok))
         self._maybe_finish(req)
+        return 1
 
     @hot_path
     def _run_decode(self, reqs):
@@ -792,6 +881,10 @@ class Engine:
                           and 1 <= bucket <= self.max_model_len):
                         self._prefill_fn(
                             _next_bucket(bucket, self.max_model_len))
+                    elif (kind == "chunk"
+                          and 1 <= bucket <= self._chunk_cap()):
+                        self._chunk_fn(
+                            _next_bucket(bucket, self._chunk_cap()))
                     else:
                         continue
                     ready += 1
@@ -816,7 +909,12 @@ class Engine:
         return ([{"kind": "decode", "bucket": b}
                  for b in buckets(self.max_batch)]
                 + [{"kind": "prefill", "bucket": p}
-                   for p in buckets(self.max_model_len)])
+                   for p in buckets(self.max_model_len)]
+                # suffix/chunk prefills (prefix-cache hits + chunked
+                # long prompts) run their own program family — a warm
+                # restart must be zero-fresh-trace for those too
+                + [{"kind": "chunk", "bucket": c}
+                   for c in buckets(self._chunk_cap())])
 
     # -- compiled programs ---------------------------------------------------
     def _decode_fn(self, B):
@@ -824,6 +922,20 @@ class Engine:
 
     def _prefill_fn(self, P):
         return self._program("prefill", P)
+
+    def _chunk_fn(self, C):
+        return self._program("chunk", C)
+
+    def _chunk_cap(self):
+        """Largest chunk-program bucket live traffic can hit.  With
+        chunking on, a non-chunked suffix is <= prefill_chunk by the
+        scheduler's lane test and a chunk is <= the budget; with
+        chunking off only prefix-hit suffixes use the chunk program,
+        and those can reach the full model length."""
+        chunk = self.scheduler.prefill_chunk
+        if chunk > 0:
+            return _next_bucket(chunk, self.max_model_len)
+        return self.max_model_len
 
     def _program(self, kind, bucket):
         key = (self._spec_key(), kind, bucket)
@@ -860,6 +972,12 @@ class Engine:
             return (pspec, cspec, cspec, sds((bucket,), i32),
                     sds((bucket,), i32),
                     sds((bucket, self.table_width), i32), kspec)
+        if kind == "chunk":
+            # toks, start, n_valid, table, blk, off, rng
+            return (pspec, cspec, cspec, sds((bucket,), i32),
+                    sds((), i32), sds((), i32),
+                    sds((self.table_width,), i32),
+                    sds((bucket,), i32), sds((bucket,), i32), kspec)
         return (pspec, cspec, cspec, sds((bucket,), i32), sds((), i32),
                 sds((bucket,), i32), sds((bucket,), i32), kspec)
 
@@ -883,6 +1001,9 @@ class Engine:
             if kind == "decode":
                 return _build_decode(self._cfg, self._donate,
                                      self._shardings)
+            if kind == "chunk":
+                return _build_chunk(self._cfg, bucket, self._donate,
+                                    self._shardings)
             return _build_prefill(self._cfg, bucket, self._donate,
                                   self._shardings)
 
@@ -1092,3 +1213,72 @@ def _build_prefill(cfg, P, donate, shardings=None):
         return tok, ck, cv
 
     return jax.jit(prefill, **_jit_kwargs(cfg, donate, shardings, 4))
+
+
+def _build_chunk(cfg, C, donate, shardings=None):
+    """Suffix/chunk prefill program: C token rows of ONE request whose
+    earlier positions' K/V already sit in the cache (a prefix-cache hit
+    or previous chunks of the same prompt).  The rows' K/V is written
+    through the block table FIRST and each row then attends to every
+    cache position <= its own through the table — the same
+    write-then-attend trick the decode program uses, which makes
+    in-chunk causality exact without a dense (P, P) score matrix."""
+    name = cfg.name
+    Hq, Hkv, Dh = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    group = Hq // Hkv
+    d_model = Hq * Dh
+    window = cfg.window
+
+    def chunk(params, ck, cv, toks, start, n_valid, table, blk, off, rng):
+        """Rows hold positions [start, start+n_valid) (rows past
+        n_valid are padding: they write into the null block and their
+        outputs are discarded).  Samples the token after position
+        start+n_valid-1 — meaningful on the final chunk only."""
+        pos = start + jnp.arange(C)
+        x = params[f"{name}_tok_embed_weight"][toks]       # (C, D)
+        if cfg.pos_table is not None:
+            # clamp padded rows: their position may exceed the table
+            pidx = jnp.minimum(pos, cfg.pos_table - 1)
+            x = x + params[f"{name}_pos_embed_weight"][0, pidx]
+        S = table.shape[0] * cfg.block_size
+        spos = jnp.arange(S)[None, :]          # logical cache positions
+        keep = spos <= pos[:, None]            # causal, self included
+        if window:
+            keep = jnp.logical_and(keep, spos > pos[:, None] - window)
+        for i in range(cfg.n_layers):
+            p = f"{name}_l{i}"
+            h = _ln(x, params[f"{p}_ln1_gamma"],
+                    None if cfg.rmsnorm else params[f"{p}_ln1_beta"])
+            q = _fc(h, params[f"{p}_q_weight"], params[f"{p}_q_bias"])
+            k = _fc(h, params[f"{p}_k_weight"], params[f"{p}_k_bias"])
+            v = _fc(h, params[f"{p}_v_weight"], params[f"{p}_v_bias"])
+            qh = q.reshape(C, Hq, Dh)
+            kh = k.reshape(C, Hkv, Dh)
+            vh = v.reshape(C, Hkv, Dh)
+            if cfg.pos_table is None:
+                qh, kh = _rope(qh, pos), _rope(kh, pos)
+            ck = ck.at[i, blk, off].set(kh)
+            cv = cv.at[i, blk, off].set(vh)
+            # all rows share one table: gather the request's logical
+            # cache view ONCE per layer, then mask per-row by position
+            kb = ck[i][table].reshape(S, Hkv, Dh)
+            vb = cv[i][table].reshape(S, Hkv, Dh)
+            qg = qh.reshape(C, Hkv, group, Dh)
+            sc = jnp.einsum("ckgd,skd->kgcs", qg, kb)
+            sc = sc / np.sqrt(Dh)
+            sc = jnp.where(keep[None, None], sc,
+                           jnp.asarray(-jnp.inf, sc.dtype))
+            pr = jax.nn.softmax(sc.astype(jnp.float32),
+                                axis=-1).astype(x.dtype)
+            at = jnp.einsum("kgcs,skd->ckgd", pr, vb)
+            x = x + _fc(at.reshape(C, d_model),
+                        params[f"{p}_proj_weight"],
+                        params[f"{p}_proj_bias"])
+            x = x + _mlp(cfg, params, p, x)
+        logits = _logits(cfg, params, x[n_valid - 1][None])
+        tok = _sample(cfg, logits, rng)[0]
+        if cfg.numeric_watch:
+            return tok, jnp.isfinite(logits).all(), ck, cv
+        return tok, ck, cv
+
+    return jax.jit(chunk, **_jit_kwargs(cfg, donate, shardings, 6))

@@ -4,7 +4,8 @@ One fixed device-resident cache (allocated once by ``serve.Engine``)
 is carved into ``num_blocks`` blocks of ``block_size`` token slots
 each.  This module owns the HOST-side bookkeeping only: which physical
 blocks belong to which request (the per-request *block table*), the
-free list, and the LRU eviction tier — the device arrays never move.
+free list, refcounts, the content-addressed prefix index and the LRU
+eviction tier — the device arrays never move.
 ``ops.attention.paged_attention`` consumes the tables to gather K/V.
 
 Block id 0 is the permanent *null block*: it is never allocated, block
@@ -12,35 +13,79 @@ tables pad with it past a request's last real block, and padded scatter
 positions write into it.  Its contents are garbage by design — every
 consumer masks by context length before the softmax.
 
-Lifecycle of a block set:
+Prefix caching (RadixAttention/PagedAttention-style sharing)
+-----------------------------------------------------------
 
-  allocate()  -> owned by a live request (counted in ``blocks_in_use``)
-  free()      -> retained: the ids park in an LRU of finished/preempted
-                 requests and still hold their K/V (a future
-                 prefix-cache hit could resurrect them); they are
-                 reclaimed lazily, oldest request first, only when the
-                 free list runs dry
-  evict       -> back on the free list, contents forgotten
+With ``prefix_cache`` on (env ``MXTPU_SERVE_PREFIX_CACHE``, default
+on), every FULL block whose token content is known is *published*
+under a content-addressed key ``H(parent_key, block_token_ids)``.
+Chaining the parent key into each block's hash makes the key table an
+implicit radix tree over token prefixes: walking a new request's
+prompt block-by-block down the chain yields the longest cached prefix,
+as a chain of refcounted physical blocks.  ``allocate(rid, n,
+token_ids=...)`` returns ``(table, cached_tokens)`` — the table starts
+with the shared chain (each hit block's refcount incremented) and the
+engine prefills only the suffix.
+
+Sharing changes the lifecycle:
+
+  allocate()  -> every table entry holds a reference (fresh blocks at
+                 refcount 1, prefix hits incremented)
+  free()      -> DECREF, never a blind release: blocks still referenced
+                 by another request's table are untouched.  A block
+                 reaching refcount 0 parks — published blocks in the
+                 prefix LRU (K/V intact, a future ``allocate`` can hit
+                 them again), unpublished blocks in the legacy
+                 per-request retained tier
+  evict       -> only refcount-0 blocks are ever reclaimed, and
+                 published blocks only as radix LEAVES (no cached
+                 children), oldest-first — an interior block is never
+                 pulled out from under a cached descendant chain
+
+Copy-on-write: a shared block is never partially overwritten.  The one
+place that could happen — a prompt fully covered by cached blocks still
+needs its last position's logits, so the final span must be recomputed
+— is handled at lookup time by capping the hit at ``n_tokens - 1``: the
+last matched block is dropped from the hit and the engine recomputes
+its tokens into a FRESH private block (recomputation is the copy).
 """
 
 from __future__ import annotations
 
+import hashlib
 import threading
 from collections import OrderedDict, deque
 
+import numpy as np
+
+from .. import telemetry
+from ..base import env_flag
+
 __all__ = ["BlockManager", "NoFreeBlocks"]
+
+# chain anchor for the first block of every sequence (the radix root)
+_ROOT = b"mxtpu-radix-root"
 
 
 class NoFreeBlocks(Exception):
     """Raised when an allocation cannot be satisfied even after
-    evicting every retained (finished/preempted) block set.  The
-    scheduler catches this and preempts a running request instead of
-    letting the cache OOM."""
+    evicting every refcount-0 retained/cached block.  The scheduler
+    catches this and preempts a running request instead of letting the
+    cache OOM."""
 
 
 def blocks_for(n_tokens, block_size):
     """Physical blocks needed to hold ``n_tokens`` cache slots."""
     return -(-n_tokens // block_size)
+
+
+def _block_key(parent, token_ids):
+    """Content-addressed key of one full block: chain-hash of the
+    parent block's key and this block's token ids.  Chaining makes
+    equal keys mean equal whole PREFIXES, not just equal blocks."""
+    h = hashlib.sha1(parent)
+    h.update(np.asarray(token_ids, np.int32).tobytes())
+    return h.digest()
 
 
 class BlockManager:
@@ -52,20 +97,51 @@ class BlockManager:
     mxtpu-lint's unlocked-shared-state checker).  Reentrant because
     ``allocate``/``ensure_capacity`` call ``_take`` under the lock."""
 
-    def __init__(self, num_blocks, block_size):
+    def __init__(self, num_blocks, block_size, prefix_cache=None):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is the null block)")
         if block_size < 1:
             raise ValueError("block_size must be >= 1")
         self.num_blocks = num_blocks
         self.block_size = block_size
+        if prefix_cache is None:
+            prefix_cache = env_flag("MXTPU_SERVE_PREFIX_CACHE", True)
+        self.prefix_cache = bool(prefix_cache)
         self._lock = threading.RLock()
         # block 0 reserved as the null/padding block
         self._free = deque(range(1, num_blocks))  # guarded-by: _lock
         self._tables = {}                         # guarded-by: _lock
         self._lens = {}                           # guarded-by: _lock
         self._retained = OrderedDict()            # guarded-by: _lock
+        # block id -> live table references (entries removed at 0)
+        self._refs = {}                           # guarded-by: _lock
+        # content-addressed radix index: key -> published block id
+        self._index = {}                          # guarded-by: _lock
+        self._key_of = {}                         # guarded-by: _lock
+        self._parent = {}                         # guarded-by: _lock
+        # key -> number of cached (published) children; leaf == absent
+        self._children = {}                       # guarded-by: _lock
+        # refcount-0 published blocks, reusable AND evictable (LRU)
+        self._lru = OrderedDict()                 # guarded-by: _lock
+        # per-request published chain of block keys (prefix order)
+        self._chain = {}                          # guarded-by: _lock
+        # reclaim EVENTS, not blocks: one legacy retained SET (however
+        # many blocks it held) or one published leaf block each count
+        # 1 — trend block-granular cache pressure via prefix_evictions
         self.evictions = 0                        # guarded-by: _lock
+        self.prefix_hits = 0                      # guarded-by: _lock
+        self.prefix_misses = 0                    # guarded-by: _lock
+        self.prefix_tokens_saved = 0              # guarded-by: _lock
+        self.prefix_evictions = 0                 # guarded-by: _lock
+        self._m_hits = telemetry.counter(
+            "mxtpu_serve_prefix_hits_total",
+            "prefix-cache lookups that reused >= 1 cached block")
+        self._m_misses = telemetry.counter(
+            "mxtpu_serve_prefix_misses_total",
+            "prefix-cache lookups that reused nothing")
+        self._m_saved = telemetry.counter(
+            "mxtpu_serve_prefix_tokens_saved_total",
+            "prompt tokens whose prefill was skipped via the prefix cache")
 
     # -- capacity ------------------------------------------------------------
     @property
@@ -75,21 +151,27 @@ class BlockManager:
 
     @property
     def blocks_in_use(self):
+        """Distinct physical blocks referenced by at least one table
+        (a block shared by N requests counts ONCE — it occupies one
+        physical block, whatever its refcount)."""
         with self._lock:
-            return sum(len(t) for t in self._tables.values())
+            return len(self._refs)
 
     @property
     def free_blocks(self):
         """Immediately or lazily reclaimable blocks."""
         with self._lock:
-            return (len(self._free)
+            return (len(self._free) + len(self._lru)
                     + sum(len(b) for b in self._retained.values()))
 
     @property
     def retained_blocks(self):
-        """Blocks parked in the LRU tier (reclaimable, K/V intact)."""
+        """Blocks parked refcount-0 (reclaimable; published ones hold
+        reusable K/V in the prefix LRU, unpublished ones are the legacy
+        per-request retained tier)."""
         with self._lock:
-            return sum(len(b) for b in self._retained.values())
+            return (len(self._lru)
+                    + sum(len(b) for b in self._retained.values()))
 
     def utilization(self):
         return self.blocks_in_use / max(1, self.total_blocks)
@@ -108,10 +190,38 @@ class BlockManager:
                     "free": len(self._free),
                     "total": self.total_blocks,
                     "utilization": round(self.utilization(), 4),
-                    "evictions": self.evictions}
+                    "evictions": self.evictions,
+                    "prefix_cache": self.prefix_stats()}
 
-    def can_allocate(self, n_tokens):
-        return blocks_for(n_tokens, self.block_size) <= self.free_blocks
+    def prefix_stats(self):
+        """The prefix-cache section of ``occupancy()``/``/statusz``:
+        how much of the radix index is populated, shared and reusable,
+        and the hit/miss/evict counters that explain a cache-cold
+        replica."""
+        with self._lock:
+            looked = self.prefix_hits + self.prefix_misses
+            shared = sum(1 for r in self._refs.values() if r > 1)
+            return {"enabled": self.prefix_cache,
+                    "cached_blocks": len(self._index),
+                    "reusable_blocks": len(self._lru),
+                    "shared_blocks": shared,
+                    "max_refcount": max(self._refs.values(), default=0),
+                    "hits": self.prefix_hits,
+                    "misses": self.prefix_misses,
+                    "hit_rate": (round(self.prefix_hits / looked, 4)
+                                 if looked else None),
+                    "tokens_saved": self.prefix_tokens_saved,
+                    "evictions": self.prefix_evictions}
+
+    def can_allocate(self, n_tokens, token_ids=None):
+        """Whether ``allocate(n_tokens, token_ids=...)`` would succeed
+        right now: blocks a prefix walk would reuse don't need to come
+        off the free list."""
+        need = blocks_for(n_tokens, self.block_size)
+        if token_ids is not None:
+            cached_blocks, _ = self.prefix_probe(token_ids)
+            need -= cached_blocks
+        return need <= self.free_blocks
 
     def fits_at_all(self, n_tokens):
         """Whether a request of ``n_tokens`` could EVER hold the cache
@@ -119,35 +229,161 @@ class BlockManager:
         instead of a guaranteed later OOM)."""
         return blocks_for(n_tokens, self.block_size) <= self.total_blocks
 
+    # -- prefix lookup -------------------------------------------------------
+    def _walk(self, token_ids):
+        """Longest cached prefix of ``token_ids`` at block granularity
+        (called under ``_lock``): returns the matched ``[(key, block)]``
+        chain, copy-on-write capped so at least ONE token is left for
+        the engine to recompute (a fully-cached prompt still needs its
+        last position's logits, and the recompute must never scribble
+        into the shared final block)."""
+        n = len(token_ids)
+        bs = self.block_size
+        hits = []
+        parent = _ROOT
+        while (len(hits) + 1) * bs <= n:
+            b = len(hits)
+            key = _block_key(parent, token_ids[b * bs:(b + 1) * bs])
+            blk = self._index.get(key)
+            if blk is None:
+                break
+            hits.append((key, blk))
+            parent = key
+        while hits and len(hits) * bs > n - 1:
+            hits.pop()                 # COW: recompute the final span
+        return hits
+
+    def prefix_probe(self, token_ids):
+        """(cached_blocks, cached_tokens) an ``allocate`` with these
+        ``token_ids`` would reuse — admission-time capacity math, no
+        state mutated."""
+        with self._lock:
+            if not self.prefix_cache or token_ids is None:
+                return 0, 0
+            hits = self._walk(token_ids)
+            return len(hits), len(hits) * self.block_size
+
     # -- allocation ----------------------------------------------------------
     def _take(self, n):
-        """Pop n free blocks, evicting LRU retained sets as needed."""
+        """Pop n free blocks, evicting refcount-0 parked blocks as
+        needed: legacy retained sets first (their K/V is stale by
+        construction), then prefix-LRU radix LEAVES oldest-first (an
+        interior block never leaves before its cached children)."""
         with self._lock:
             while len(self._free) < n:
-                if not self._retained:
+                if self._retained:
+                    _, blocks = self._retained.popitem(last=False)  # oldest
+                    self._free.extend(blocks)
+                    self.evictions += 1
+                    continue
+                if not self._evict_prefix_leaf():
                     raise NoFreeBlocks(
                         f"need {n} blocks, {len(self._free)} free and "
-                        "nothing retained to evict")
-                _, blocks = self._retained.popitem(last=False)  # oldest
-                self._free.extend(blocks)
-                self.evictions += 1
-            return [self._free.popleft() for _ in range(n)]
+                        "nothing refcount-0 left to evict")
+            taken = [self._free.popleft() for _ in range(n)]
+            for blk in taken:
+                self._refs[blk] = 1
+            return taken
 
-    def allocate(self, rid, n_tokens):
-        """Create ``rid``'s block table covering ``n_tokens`` slots."""
+    def _evict_prefix_leaf(self):
+        """Reclaim the oldest refcount-0 published block that is a
+        radix leaf (no cached children).  Reentrant-locked: every
+        caller already holds ``_lock``."""
+        with self._lock:
+            for key in self._lru:       # oldest first
+                if self._children.get(key, 0) == 0:
+                    blk = self._unpublish(key)
+                    self._free.append(blk)
+                    self.evictions += 1
+                    self.prefix_evictions += 1
+                    return True
+            return False
+
+    def _unpublish(self, key):
+        """Drop ``key`` from the radix index; returns its physical
+        block.  Reentrant-locked: every caller already holds ``_lock``."""
+        with self._lock:
+            blk = self._index.pop(key)
+            self._key_of.pop(blk, None)
+            parent = self._parent.pop(key, None)
+            if parent is not None and parent in self._children:
+                self._children[parent] -= 1
+                if not self._children[parent]:
+                    del self._children[parent]
+            self._children.pop(key, None)
+            self._lru.pop(key, None)
+            return blk
+
+    def _ref_hit(self, blk):
+        """Take one reference on a cached block: a refcount-0 LRU
+        resident leaves the evictable tier the moment a table starts
+        reading it.  Reentrant-locked: callers already hold ``_lock``."""
+        with self._lock:
+            self._refs[blk] = self._refs.get(blk, 0) + 1
+            if self._refs[blk] == 1:
+                self._lru.pop(self._key_of[blk], None)
+
+    def allocate(self, rid, n_tokens, token_ids=None):
+        """Create ``rid``'s block table covering ``n_tokens`` slots.
+
+        Without ``token_ids`` (legacy callers): fresh blocks only,
+        returns the table list.  With ``token_ids`` (the sequence the
+        engine is about to prefill): the longest cached prefix is
+        reused — hit blocks head the table with their refcounts
+        incremented, only the remainder comes off the free list — and
+        the return is ``(table, cached_tokens)`` so the caller prefills
+        just the suffix."""
         with self._lock:
             if rid in self._tables:
                 raise ValueError(
                     f"request {rid!r} already has a block table")
             if rid in self._retained:
-                # a preempted request resuming: its parked blocks hold
-                # stale K/V (resume recomputes), so reclaim them up
-                # front rather than leaking the entry when this rid is
-                # freed again later
+                # a preempted request resuming: its parked UNPUBLISHED
+                # blocks hold stale K/V (resume recomputes), so reclaim
+                # them up front rather than leaking the entry when this
+                # rid is freed again later (its published blocks live
+                # in the prefix index and may be hit again right here)
                 self._free.extend(self._retained.pop(rid))
+            hits = []
+            if self.prefix_cache and token_ids is not None:
+                hits = self._walk(token_ids)
+            # clear-miss precheck BEFORE any mutation or eviction (the
+            # same optimistic math as can_allocate, one walk instead of
+            # two): a request that cannot fit even by reclaiming every
+            # parked block must not evict anything, count a hit, or
+            # take references on the way to failing
+            if blocks_for(n_tokens, self.block_size) - len(hits) \
+                    > self.free_blocks:
+                raise NoFreeBlocks(
+                    f"request {rid!r} needs "
+                    f"{blocks_for(n_tokens, self.block_size)} blocks "
+                    f"({len(hits)} cached), {self.free_blocks} "
+                    "free/reclaimable")
+            if self.prefix_cache and token_ids is not None:
+                if hits:
+                    self.prefix_hits += 1
+                    self.prefix_tokens_saved += len(hits) * self.block_size
+                    self._m_hits.inc()
+                    self._m_saved.inc(len(hits) * self.block_size)
+                else:
+                    self.prefix_misses += 1
+                    self._m_misses.inc()
+                for _, blk in hits:
+                    self._ref_hit(blk)
             n = blocks_for(n_tokens, self.block_size)
-            self._tables[rid] = self._take(n)
+            try:
+                fresh = self._take(n - len(hits))
+            except NoFreeBlocks:
+                # undo the hit references: a failed allocation must not
+                # leave cached blocks pinned un-evictable forever
+                for key, blk in hits:
+                    self._deref(blk, retain=True)
+                raise
+            self._tables[rid] = [blk for _, blk in hits] + fresh
             self._lens[rid] = n * self.block_size
+            self._chain[rid] = [key for key, _ in hits]
+            if token_ids is not None:
+                return list(self._tables[rid]), len(hits) * self.block_size
             return list(self._tables[rid])
 
     def ensure_capacity(self, rid, n_tokens):
@@ -171,17 +407,94 @@ class BlockManager:
         with self._lock:
             return self._lens[rid]
 
+    def reclaimable_blocks(self, rid):
+        """Blocks ``free(rid)`` would actually park/release right now —
+        the refcount-1 subset of its table.  A request whose blocks are
+        all shared with other live tables reclaims nothing, which is
+        what makes preempting it pointless (``Scheduler._pick_victim``
+        consults this)."""
+        with self._lock:
+            return sum(1 for b in self._tables.get(rid, ())
+                       if self._refs.get(b, 0) == 1)
+
+    # -- publishing ----------------------------------------------------------
+    def note_tokens(self, rid, token_ids):
+        """Publish ``rid``'s newly-FULL blocks under their chain keys.
+
+        ``token_ids`` is the sequence whose K/V has been written so far
+        (prompt prefix during prefill, prompt+generated during decode);
+        every full block not yet in ``rid``'s chain is keyed and
+        indexed.  A key already mapping to a DIFFERENT physical block
+        (two identical prompts prefilled concurrently) keeps the
+        existing mapping — this request's duplicate block simply stays
+        private.  No-op with the prefix cache off."""
+        if not self.prefix_cache:
+            return
+        with self._lock:
+            table = self._tables.get(rid)
+            if table is None:
+                return
+            chain = self._chain.setdefault(rid, [])
+            n_full = min(len(token_ids) // self.block_size, len(table))
+            while len(chain) < n_full:
+                b = len(chain)
+                parent = chain[-1] if chain else _ROOT
+                key = _block_key(
+                    parent,
+                    token_ids[b * self.block_size:(b + 1) * self.block_size])
+                blk = table[b]
+                if key not in self._index and blk not in self._key_of:
+                    self._index[key] = blk
+                    self._key_of[blk] = key
+                    self._parent[key] = (parent if chain else None)
+                    if chain:
+                        self._children[parent] = \
+                            self._children.get(parent, 0) + 1
+                chain.append(key)
+
+    # -- release -------------------------------------------------------------
+    def _deref(self, blk, retain):
+        """Drop one reference; returns the block if it reached
+        refcount 0 UNPUBLISHED (the caller decides the retained-vs-free
+        fate), else None.  Reentrant-locked: callers hold ``_lock``."""
+        with self._lock:
+            self._refs[blk] -= 1
+            if self._refs[blk] > 0:
+                return None            # another table still reads it
+            del self._refs[blk]
+            key = self._key_of.get(blk)
+            if key is not None:
+                if retain:
+                    self._lru[key] = blk   # reusable AND evictable
+                    self._lru.move_to_end(key)
+                else:
+                    self._unpublish(key)
+                    self._free.append(blk)
+                return None
+            return blk
+
     def free(self, rid, retain=True):
-        """Release ``rid``'s blocks.  ``retain=True`` (finished or
-        preempted requests) parks them in the LRU tier; ``retain=False``
-        returns them to the free list immediately."""
+        """Release ``rid``'s references.  DECREF semantics: blocks
+        shared with another live table are untouched (preempting a
+        sharer can never free blocks a running request still reads).
+        Refcount-0 published blocks park in the prefix LRU (K/V intact,
+        future prefix hits resurrect them); refcount-0 unpublished
+        blocks park in the legacy retained tier with ``retain=True`` or
+        return to the free list with ``retain=False``."""
         with self._lock:
             blocks = self._tables.pop(rid)
             self._lens.pop(rid)
-            if retain:
-                self._retained[rid] = blocks
-            else:
-                self._free.extend(blocks)
+            self._chain.pop(rid, None)
+            loose = []
+            for blk in blocks:
+                released = self._deref(blk, retain)
+                if released is not None:
+                    loose.append(released)
+            if loose:
+                if retain:
+                    self._retained[rid] = loose
+                else:
+                    self._free.extend(loose)
 
     def reset(self):
         with self._lock:
@@ -189,3 +502,10 @@ class BlockManager:
             self._tables.clear()
             self._lens.clear()
             self._retained.clear()
+            self._refs.clear()
+            self._index.clear()
+            self._key_of.clear()
+            self._parent.clear()
+            self._children.clear()
+            self._lru.clear()
+            self._chain.clear()

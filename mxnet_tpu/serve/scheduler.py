@@ -11,10 +11,22 @@ Admission is a bounded FIFO queue — ``submit`` on a full queue raises
 ``deadline_s`` expires before its prefill is rejected, never silently
 dropped.  When decode outgrows the cache mid-flight the LOWEST-priority
 running request (latest arrival) is preempted: its blocks are freed
-(parked in the block manager's LRU tier) and the request re-enters the
-front of the waiting queue to resume by recomputation — prompt plus
-already-generated tokens re-prefill together, which greedy decoding
-makes token-exact (tested by test_serve.py's resume-equivalence case).
+(refcount-decremented — blocks shared through the prefix cache with a
+still-running request are never reclaimed) and the request re-enters
+the front of the waiting queue to resume by recomputation — prompt plus
+already-generated tokens re-prefill together (minus whatever prefix the
+cache still holds), which greedy decoding makes token-exact (tested by
+test_serve.py's resume-equivalence case).
+
+Chunked prefill: a prompt whose uncached remainder exceeds
+``prefill_chunk`` tokens (env ``MXTPU_SERVE_PREFILL_CHUNK``) is
+admitted into the ``prefilling`` lane and prefilled one chunk per
+iteration, interleaved with the batched decode — one 32k-token prompt
+can no longer stall every running request for a whole-prompt prefill.
+The per-iteration prefill token budget is shared between the decode
+slots and AT MOST ONE chunk (the engine shrinks the chunk by the decode
+batch size), and while a chunked prefill is in flight no new request is
+admitted — the chunk owns the prefill budget.
 """
 
 from __future__ import annotations
@@ -61,7 +73,10 @@ class Request:
         self.status = WAITING
         self.trace_id = None           # stamped by the request tracer
         self.tokens = []           # generated ids (ints)
-        self.cache_len = 0         # K/V slots written for this request
+        self.cache_len = 0         # K/V slots valid for this request
+        self.cached_prefix_len = 0  # slots reused from the prefix cache
+        self.prefill_target = None  # prefill length at admission
+        self._prefill_started = False
         self.submit_t = None       # stamped by the scheduler
         self.first_token_t = None
         self.finish_t = None
@@ -102,12 +117,20 @@ class Scheduler:
 
     def __init__(self, block_mgr, max_batch, max_queue,
                  max_prefills_per_step=1, clock=time.monotonic,
-                 trace=None, tenant_share=None):
+                 trace=None, tenant_share=None, prefill_chunk=None):
         self.blocks = block_mgr
         self.max_batch = int(max_batch)
         self.max_queue = int(max_queue)
         self.max_prefills_per_step = int(max_prefills_per_step)
         self.clock = clock
+        # chunked prefill: a prompt whose uncached remainder exceeds
+        # this many tokens prefills one chunk per iteration instead of
+        # monopolizing a step (0 = whole-prompt prefills only)
+        if prefill_chunk is None:
+            from ..base import env_int
+
+            prefill_chunk = env_int("MXTPU_SERVE_PREFILL_CHUNK", 512)
+        self.prefill_chunk = max(0, int(prefill_chunk))
         # fair-share admission: one tenant may hold at most this
         # fraction of the queue (1.0 = off, the strict-FIFO default);
         # below 1.0 admission also interleaves tenants round-robin
@@ -123,6 +146,9 @@ class Scheduler:
         self._lock = threading.RLock()
         self.waiting = []          # guarded-by: _lock
         self.running = []          # guarded-by: _lock
+        # admitted requests still mid-chunked-prefill: they hold cache
+        # blocks and a batch slot but are not yet in the decode batch
+        self.prefilling = []       # guarded-by: _lock
         self.preemptions = 0       # guarded-by: _lock
         self.rejections = 0        # guarded-by: _lock
         self.reject_reasons = {}   # guarded-by: _lock
@@ -285,7 +311,7 @@ class Scheduler:
         return len(self.waiting)
 
     def has_work(self):
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.running or self.prefilling)
 
     # -- one iteration's decisions -------------------------------------------
     def schedule(self):
@@ -294,13 +320,22 @@ class Scheduler:
         1. Expire overdue waiting requests (deadline -> REJECTED).
         2. Secure the next cache slot for every running request,
            preempting latest arrivals when blocks run out.
-        3. Admit from the queue front while a batch slot, the prefill
+        3. Continue any in-flight chunked prefill: its request leads
+           ``prefills`` (the engine runs ONE chunk) and owns this
+           iteration's prefill budget — no new admissions until it
+           finishes.
+        4. Admit from the queue front while a batch slot, the prefill
            budget, and blocks for prompt+1 tokens are all available
            (the +1 guarantees the first decode step cannot be the one
-           that discovers the cache is full).  Decode slots were
-           secured FIRST, so admission never steals a running
-           request's block and a just-admitted request is never the
-           same iteration's preemption victim.
+           that discovers the cache is full).  Allocation walks the
+           prefix cache: cached blocks head the request's table and
+           ``cache_len`` starts at the cached span, so the engine
+           prefills only the suffix.  A request whose uncached
+           remainder exceeds ``prefill_chunk`` enters the
+           ``prefilling`` lane instead of prefilling whole.  Decode
+           slots were secured FIRST, so admission never steals a
+           running request's block and a just-admitted request is
+           never the same iteration's preemption victim.
         """
         now = self.clock()
         with self._lock:
@@ -339,23 +374,52 @@ class Scheduler:
             decodes = [r for r in decodes if r in self.running]
 
             prefills = []
+            if self.prefilling:
+                # one chunk per iteration, and it owns the prefill
+                # budget: no whole-prefill admissions ride along
+                prefills.append(self.prefilling[0])
+                return prefills, decodes
             while (self.waiting
-                   and len(self.running) + len(prefills) < self.max_batch
+                   and (len(self.running) + len(prefills)
+                        < self.max_batch)
                    and len(prefills) < self.max_prefills_per_step):
                 req = self._next_admission()
-                need = req.prefill_ids().size + 1
-                if not self.blocks.can_allocate(need):
-                    break          # FIFO head-of-line: no skipping ahead
+                ids = req.prefill_ids()
+                need = ids.size + 1
+                try:
+                    # one call, one prefix walk: allocate prechecks the
+                    # clear miss itself (nothing mutated or evicted on
+                    # that path — FIFO head-of-line, no skipping ahead).
+                    # It can also fail AFTER partial eviction: its
+                    # fit estimate is optimistic under sharing (the
+                    # blocks a prefix walk would reuse may BE the
+                    # reclaimable blocks it counted, and an LRU interior
+                    # pinned by a cached child is counted free but not
+                    # evictable).  A failed allocate undoes its hit
+                    # refs, so treating both as does-not-fit-yet is
+                    # safe — the request stays at the queue head
+                    _, cached = self.blocks.allocate(req.rid, need,
+                                                     token_ids=ids)
+                except NoFreeBlocks:
+                    break
                 self.waiting.remove(req)
-                self.blocks.allocate(req.rid, need)
+                req.cache_len = cached
+                req.cached_prefix_len = cached
+                req.prefill_target = int(ids.size)
                 if self.tenant_share < 1.0:
                     self._rr_idx += 1    # rotation advances on ADMIT
                 req.status = RUNNING
+                chunked = (self.prefill_chunk > 0
+                           and ids.size - cached > self.prefill_chunk)
                 self.trace.event(
                     req, "resumed" if req.n_preemptions else "admitted",
                     queue_depth=len(self.waiting),
-                    n_preemptions=req.n_preemptions)
+                    n_preemptions=req.n_preemptions,
+                    cached_tokens=cached, chunked=chunked)
                 prefills.append(req)
+                if chunked:
+                    self.prefilling.append(req)
+                    break          # the chunk consumed the budget
             return prefills, decodes
 
     def _next_admission(self):
@@ -390,18 +454,31 @@ class Scheduler:
             return self.waiting[0]
 
     def _pick_victim(self, needy):
-        """Lowest priority = latest arrival among running requests."""
-        return max(self.running, key=lambda r: r.rid)
+        """Lowest priority = latest arrival among running requests —
+        but refcount-aware: a request whose blocks are ALL shared with
+        other live tables reclaims nothing when preempted (``free`` is
+        a decref, never a blind release), so prefer the latest arrival
+        that would actually return blocks.  Falls back to plain latest
+        arrival when every candidate is a pure sharer (preempting one
+        still drops refcounts, unblocking a later eviction)."""
+        yielding = [r for r in self.running
+                    if self.blocks.reclaimable_blocks(r.rid) > 0]
+        return max(yielding or self.running, key=lambda r: r.rid)
 
     def preempt(self, req):
-        """Free ``req``'s blocks and push it back to the FRONT of the
-        waiting queue (it arrived before everything waiting behind it,
-        so resuming it first preserves FIFO fairness)."""
+        """Release ``req``'s block references and push it back to the
+        FRONT of the waiting queue (it arrived before everything
+        waiting behind it, so resuming it first preserves FIFO
+        fairness).  Blocks shared with another running request are
+        refcount-decremented, never freed from under the sharer."""
         with self._lock:
             self.running.remove(req)
             self.blocks.free(req.rid, retain=True)
             req.status = WAITING
             req.cache_len = 0
+            req.cached_prefix_len = 0
+            req.prefill_target = None
+            req._prefill_started = False
             req.n_preemptions += 1
             self.preemptions += 1
             self.trace.event(req, "preempted", reason="cache_pressure",
@@ -409,10 +486,29 @@ class Scheduler:
             self.waiting.append(req)
             self.waiting.sort(key=lambda r: r.rid)   # arrival order
 
+    def is_prefilling(self, req):
+        """Whether ``req`` is mid-chunked-prefill (holds blocks and a
+        batch slot, not yet in the decode batch)."""
+        with self._lock:
+            return req in self.prefilling
+
+    def prefill_done(self, req):
+        """Engine hook: ``req``'s last prefill chunk ran — it leaves
+        the prefilling lane (no-op for whole-prompt prefills)."""
+        with self._lock:
+            if req in self.prefilling:
+                self.prefilling.remove(req)
+
     def finish(self, req, status=FINISHED):
         with self._lock:
             if req in self.running:
                 self.running.remove(req)
+                self.blocks.free(req.rid, retain=True)
+            elif req in self.prefilling:
+                # cancelled mid-chunked-prefill (engine shutdown): it
+                # holds cache blocks without ever reaching the decode
+                # batch — release its references like a running peer's
+                self.prefilling.remove(req)
                 self.blocks.free(req.rid, retain=True)
         req.status = status
         req.finish_t = self.clock()

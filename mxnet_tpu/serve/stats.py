@@ -43,6 +43,17 @@ class ServeStats:
     ttft_ms_max: float | None
     decode_tok_per_sec: float | None   # sliding window over recent steps
     total_tok_per_sec: float | None    # engine lifetime aggregate
+    # prefix-cache view (BlockManager.prefix_stats): prompt tokens the
+    # engine actually ran prefill compute over vs tokens whose K/V was
+    # reused from the content-addressed radix cache — the shared-prefix
+    # workload's headline ratio (tools/serve_bench.py --workload
+    # shared-prefix)
+    prefill_tokens_computed: int = 0
+    prefix_hits: int = 0
+    prefix_misses: int = 0
+    prefix_hit_rate: float | None = None
+    prefix_tokens_saved: int = 0
+    prefix_evictions: int = 0
     # cumulative rejections by reason code (queue_full / deadline /
     # deadline_at_submit / tenant_share / exceeds_cache /
     # exceeds_max_len) — the same codes the request trace and
@@ -64,6 +75,7 @@ class StatsRecorder:
         self.rejected = 0
         self.tokens_generated = 0
         self.prompt_tokens = 0
+        self.prefill_tokens_computed = 0
         self._ttfts = []
         self._start_t = None
         self.peak_block_utilization = 0.0
@@ -87,6 +99,16 @@ class StatsRecorder:
             "submits rejected by admission-queue back-pressure")
         self._m_ttft = telemetry.histogram(
             "mxtpu_serve_ttft_seconds", "time to first token")
+        self._m_prefill_tokens = telemetry.counter(
+            "mxtpu_serve_prefill_tokens_computed_total",
+            "prompt tokens actually run through a prefill program "
+            "(prefix-cache hits never reach here)")
+
+    def on_prefill(self, tokens_computed):
+        """One prefill pass (whole prompt, suffix, or one chunk) ran
+        compute over ``tokens_computed`` prompt tokens."""
+        self.prefill_tokens_computed += int(tokens_computed)
+        self._m_prefill_tokens.inc(int(tokens_computed))
 
     def on_step(self, new_tokens):
         now = self.clock()
@@ -136,6 +158,7 @@ class StatsRecorder:
 
     def snapshot(self, scheduler, blocks):
         now = self.clock()
+        pfx = blocks.prefix_stats()
         total_rate = None
         if self._start_t is not None and now > self._start_t:
             total_rate = self.tokens_generated / (now - self._start_t)
@@ -165,4 +188,10 @@ class StatsRecorder:
                                if total_rate else None),
             reject_reasons=dict(scheduler.reject_reasons),
             tenants=scheduler.tenant_stats(),
+            prefill_tokens_computed=self.prefill_tokens_computed,
+            prefix_hits=pfx["hits"],
+            prefix_misses=pfx["misses"],
+            prefix_hit_rate=pfx["hit_rate"],
+            prefix_tokens_saved=pfx["tokens_saved"],
+            prefix_evictions=pfx["evictions"],
         )

@@ -361,18 +361,22 @@ def test_engine_warmup_grid_and_range_checks(tel, model):
     out-of-range or unknown entries are skipped, not compiled."""
     eng = _engine(model, max_batch=2, max_model_len=16)
     n = eng.warmup()
-    # decode {1,2} + prefill {1,2,4,8,16}
-    assert n == 7
+    # decode {1,2} + prefill {1,2,4,8,16} + chunk {1,2,4,8,16} (the
+    # suffix/chunk program family prefix-cache hits and chunked
+    # prefills run; its cap clamps to max_model_len here)
+    assert n == 12
     assert eng.warmup([{"kind": "decode", "bucket": 99},
                        {"kind": "prefill", "bucket": 1000},
+                       {"kind": "chunk", "bucket": 1000},
                        {"kind": "mystery", "bucket": 2},
                        {"kind": "decode", "bucket": 2}]) == 1
     eng.shutdown()
     # non-power-of-two caps are real clamp buckets live traffic hits —
-    # the grid must include them (decode {1,2,3} + prefill {1..16,24})
+    # the grid must include them (decode {1,2,3} + prefill {1..16,24}
+    # + chunk {1..16,24})
     engine_mod._STEP_CACHE.clear()
     eng2 = _engine(model, max_batch=3, max_model_len=24)
-    assert eng2.warmup() == 9
+    assert eng2.warmup() == 15
     eng2.shutdown()
 
 

@@ -224,6 +224,47 @@ def test_serve_bench_contract():
 
 
 @pytest.mark.slow
+def test_prefix_bench_contract():
+    """tools/serve_bench.py --workload prefix (the PREFIX_BENCH.json
+    bench_watch stage) emits both prefix-cache acceptance records on
+    CPU smoke shapes: the shared-prefix A/B with hit rate > 0.8, a
+    >= 2x prefill-compute reduction and byte-identical tokens, and the
+    mixed-length A/B with the chunked decode-stall p99 beating the
+    whole-prompt one — the exact invariants the serve_prefix watchdog
+    gate trusts."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # no tunnel for a CPU smoke
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--backend", "cpu", "--workload", "prefix",
+         "--layers", "2", "--d-model", "64", "--heads", "4",
+         "--vocab", "211", "--prefixes", "2", "--continuations", "6",
+         "--prefix-len", "32", "--suffix-len", "8", "--max-new", "8",
+         "--long-prompt", "1024", "--prefill-chunk", "128"],
+        capture_output=True, text=True, timeout=540, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads([l for l in r.stdout.splitlines()
+                          if l.startswith("{")][-1])
+    assert payload["platform"] == "cpu"
+    assert payload["complete"] is True      # stamped BEFORE the print
+    modes = {pt["mode"] for pt in payload["points"]}
+    assert modes == {"shared-prefix", "mixed-len"}
+    # the acceptance bars the serve_prefix stage gates on
+    assert payload["tokens_identical"] is True
+    assert payload["prefix_hit_rate"] > 0.8
+    assert payload["prefill_compute_ratio"] >= 2
+    assert payload["prefill_tokens_saved"] > 0
+    assert payload["stall_improved"] is True
+    assert (payload["decode_stall_p99_ms_chunked"]
+            < payload["decode_stall_p99_ms_whole"])
+    sp = next(pt for pt in payload["points"]
+              if pt["mode"] == "shared-prefix")
+    assert sp["completed_on"] == sp["completed_off"] == sp["requests"]
+    assert sp["prefix_misses"] == 2         # one cold prefill per prefix
+    assert "telemetry" in payload
+
+
+@pytest.mark.slow
 def test_train_bench_contract(tmp_path):
     """tools/train_bench.py (the TRAIN_BENCH.json bench_watch stage)
     emits the training-path comparison on a CPU smoke config: both

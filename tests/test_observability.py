@@ -349,7 +349,7 @@ def test_flight_dump_on_engine_exception(model, tmp_path, monkeypatch):
     eng = _engine(model)
     eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=4)
 
-    def boom(req):
+    def boom(req, decode_slots=0):
         raise RuntimeError("injected prefill failure")
 
     monkeypatch.setattr(eng, "_run_prefill", boom)

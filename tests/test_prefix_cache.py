@@ -1,0 +1,476 @@
+"""Prefix-cached KV sharing + chunked prefill (mxnet_tpu/serve).
+
+The parity suite for the RadixAttention-style content-addressed block
+cache and the Orca-style chunked prefill: radix index/COW/refcount unit
+semantics on a bare ``BlockManager``, the refcount-aware preemption
+regression (preempting a sharer must never free blocks a running
+request still reads), and the engine-level acceptance gates — cached
+vs cold token identity (gpt and llama/GQA variants, under preemption
+and under eviction pressure), chunked-prefill vs whole-prefill
+identity, and the decode-latency ceiling (a long prompt can no longer
+monopolize an iteration).
+
+Everything is CPU-deterministic on tiny models; the measured
+shared-prefix/mixed-length benchmark contract lives in
+test_bench_contract.py (slow tier) against tools/serve_bench.py.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu.serve import (BlockManager, NoFreeBlocks, Request,
+                             Scheduler)
+
+VOCAB = 53
+
+
+# -- radix index / refcount units (pure host-side bookkeeping) ---------------
+def test_radix_publish_hit_and_refcounts():
+    m = BlockManager(num_blocks=16, block_size=4, prefix_cache=True)
+    ids = list(range(10, 19))                      # 9 tokens
+    t1, c1 = m.allocate("a", 10, token_ids=ids)
+    assert c1 == 0 and m.prefix_misses == 1        # cold: nothing cached
+    m.note_tokens("a", ids)                        # publishes blocks 0,1
+    t2, c2 = m.allocate("b", 10, token_ids=ids)
+    assert c2 == 8                                 # two full blocks reused
+    assert t2[:2] == t1[:2] and t2[2] != t1[2]     # shared head, fresh tail
+    assert m.prefix_hits == 1 and m.prefix_tokens_saved == 8
+    # a shared physical block occupies ONE block whatever its refcount
+    assert m.blocks_in_use == len(set(t1) | set(t2))
+    assert m._refs[t1[0]] == 2
+    stats = m.prefix_stats()
+    assert stats["shared_blocks"] == 2 and stats["max_refcount"] == 2
+    assert stats["hit_rate"] == 0.5
+
+
+def test_radix_key_chains_whole_prefix():
+    """Equal block CONTENT under a different parent chain must not hit:
+    the key is hash(parent_key, block_tokens), i.e. the whole prefix."""
+    m = BlockManager(num_blocks=16, block_size=4)
+    a = [1, 2, 3, 4, 5, 6, 7, 8]
+    m.allocate("a", 9, token_ids=a)
+    m.note_tokens("a", a)
+    # b's first block content equals a's SECOND block content
+    assert m.prefix_probe([5, 6, 7, 8, 9]) == (0, 0)
+    t, c = m.allocate("b", 6, token_ids=[5, 6, 7, 8, 9])
+    assert c == 0
+
+
+def test_cow_cap_leaves_last_span_uncached():
+    """A prompt fully covered by cached blocks still needs its final
+    position's logits: the hit is capped at n-1 tokens so the last
+    span recomputes into a FRESH block (recomputation is the COW)."""
+    m = BlockManager(num_blocks=16, block_size=4)
+    ids = list(range(8))                           # exactly 2 blocks
+    t1, _ = m.allocate("a", 9, token_ids=ids)
+    m.note_tokens("a", ids)
+    t2, c2 = m.allocate("b", 9, token_ids=ids)     # identical prompt
+    assert c2 == 4                                 # NOT 8: last block COWs
+    assert t2[0] == t1[0] and t2[1] != t1[1]
+    assert m._refs[t1[1]] == 1                     # a's tail stays private
+
+
+def test_shared_blocks_survive_sharers_free():
+    """The refcount regression pinned by ISSUE 9: releasing one sharer
+    (finish or preemption both call ``free``) must never free blocks
+    another live table still reads."""
+    m = BlockManager(num_blocks=16, block_size=4)
+    ids = list(range(20, 29))
+    t1, _ = m.allocate("a", 10, token_ids=ids)
+    m.note_tokens("a", ids)
+    t2, c2 = m.allocate("b", 10, token_ids=ids)
+    assert c2 == 8
+    m.free("a", retain=True)                       # preempt/finish "a"
+    for blk in t2:                                 # b's table fully intact
+        assert m._refs.get(blk, 0) >= 1
+        assert blk not in m._free
+    # pressure: allocations may evict parked blocks but never b's
+    while True:
+        try:
+            m.allocate(f"fill{m.evictions}-{len(m._tables)}", 4)
+        except NoFreeBlocks:
+            break
+    for blk in t2:
+        assert blk in m._refs and blk not in m._free
+    m.free("b", retain=True)                       # now refcount-0: parked
+    assert all(blk not in m._refs for blk in t2)   # reclaimable at last
+
+
+def test_eviction_reclaims_leaves_before_interiors():
+    """LRU eviction may only take refcount-0 radix LEAVES: an interior
+    block is never pulled out from under a cached descendant chain."""
+    m = BlockManager(num_blocks=5, block_size=4)   # 4 allocatable
+    ids = list(range(30, 39))                      # 2 full blocks + tail
+    m.allocate("a", 9, token_ids=ids)              # uses 3 blocks
+    m.note_tokens("a", ids)
+    m.free("a", retain=True)                       # chain parks in LRU
+    assert m.prefix_stats()["reusable_blocks"] == 2
+    # taking 3 blocks burns the free one, the legacy-retained tail,
+    # and ONE prefix block — which must be the LEAF (block 1 of the
+    # chain) even though the root is older in the LRU
+    m.allocate("b", 12)
+    assert m.prefix_evictions == 1
+    assert m.prefix_probe(ids) == (1, 4)           # root survived, leaf gone
+    m.free("b", retain=False)
+    # pressure again: now the root (a leaf once its child is gone) goes
+    m.allocate("c", 13)
+    assert m.prefix_probe(ids) == (0, 0)
+    assert m.prefix_evictions == 2
+
+
+def test_prefix_probe_matches_allocate():
+    m = BlockManager(num_blocks=16, block_size=4)
+    ids = list(range(40, 52))
+    m.allocate("a", 13, token_ids=ids)
+    m.note_tokens("a", ids)
+    blocks, tokens = m.prefix_probe(ids)
+    _, cached = m.allocate("b", 13, token_ids=ids)
+    assert cached == tokens == blocks * 4
+    # probe mutates nothing
+    assert m.prefix_probe(ids) == (blocks, tokens)
+
+
+def test_concurrent_identical_prompts_keep_first_publication():
+    """Two identical prompts admitted the same iteration both prefill
+    cold; publishing keeps the FIRST mapping and the duplicate block
+    simply stays private — free/realloc stays consistent."""
+    m = BlockManager(num_blocks=16, block_size=4)
+    ids = list(range(8))
+    m.allocate("a", 9, token_ids=ids)              # both miss: nothing
+    m.allocate("b", 9, token_ids=ids)              # published yet
+    m.note_tokens("a", ids)
+    m.note_tokens("b", ids)                        # duplicate: kept private
+    assert m.prefix_probe(ids + [9]) == (2, 8)
+    m.free("a", retain=True)
+    m.free("b", retain=True)
+    t3, c3 = m.allocate("c", 9, token_ids=ids)
+    assert c3 == 4                                 # COW-capped hit works
+    m.free("c", retain=True)
+    m.reset()
+    assert m.free_blocks == 15 and m.blocks_in_use == 0
+
+
+# -- scheduler: refcount-aware preemption + the chunked lane -----------------
+def _mk_req(n_prompt, max_new=4):
+    return Request(np.arange(1, n_prompt + 1), max_new)
+
+
+def test_pick_victim_prefers_latest_reclaimable():
+    """``_pick_victim`` must skip pure sharers (freeing them reclaims
+    nothing) and take the LATEST arrival that actually yields blocks,
+    falling back to plain latest arrival when nobody yields."""
+    m = BlockManager(num_blocks=16, block_size=4)
+    s = Scheduler(m, max_batch=4, max_queue=8, clock=lambda: 0.0)
+    a, b, c = _mk_req(4), _mk_req(4), _mk_req(4)
+    s.running = [a, b, c]
+    reclaim = {a.rid: 2, b.rid: 1, c.rid: 0}       # c latest, pure sharer
+    m.reclaimable_blocks = lambda rid: reclaim[rid]
+    assert s._pick_victim(a) is b                  # latest that yields
+    reclaim = {a.rid: 0, b.rid: 0, c.rid: 0}
+    assert s._pick_victim(a) is c                  # fallback: latest
+
+
+def test_chunked_lane_blocks_admissions_and_owns_budget():
+    m = BlockManager(num_blocks=64, block_size=4)
+    s = Scheduler(m, max_batch=4, max_queue=8, max_prefills_per_step=4,
+                  clock=lambda: 0.0, prefill_chunk=8)
+    big = s.submit(_mk_req(40))
+    small = s.submit(_mk_req(4))
+    prefills, _ = s.schedule()
+    assert prefills == [big] and s.is_prefilling(big)
+    # while the chunk is in flight, nobody else is admitted — the
+    # chunk owns the iteration's prefill budget
+    prefills, _ = s.schedule()
+    assert prefills == [big]
+    assert small.status == "waiting"
+    s.prefill_done(big)
+    s.admit_running(big)
+    big.cache_len = 41
+    prefills, decodes = s.schedule()
+    assert prefills == [small] and decodes == [big]
+
+
+# -- engine-level parity gates (tiny models, real jit programs on CPU) -------
+@pytest.fixture(scope="module")
+def model():
+    """gpt2-style tiny net (learned positions, MHA) — weight scale
+    chosen so greedy argmax yields varied token sequences."""
+    S = 96
+    net = mx.models.gpt(VOCAB, S, num_layers=2, d_model=32, num_heads=4)
+    return net, _rand_params(net, S, seed=3)
+
+
+@pytest.fixture(scope="module")
+def llama_model():
+    """llama-style variant: rope + rmsnorm + swiglu + GQA + tied."""
+    S = 96
+    net = mx.models.gpt(VOCAB, S, num_layers=2, d_model=32, num_heads=4,
+                        kv_heads=2, norm="rmsnorm", mlp="swiglu",
+                        pos_embed="rope", tie_embeddings=True)
+    return net, _rand_params(net, S, seed=9)
+
+
+def _rand_params(net, S, seed):
+    arg_shapes, _, _ = net.infer_shape(data=(1, S), softmax_label=(1, S))
+    rng = np.random.RandomState(seed)
+    params = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        scale = 0.35 if name.endswith("weight") else 0.0
+        params[name] = (rng.randn(*shp) * scale
+                        + (1.0 if name.endswith("gamma") else 0.0)
+                        ).astype(np.float32)
+    return params
+
+
+def _engine(model, **kw):
+    net, params = model
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("max_prefills_per_step", 2)
+    return mx.serve.Engine(params, symbol=net, **kw)
+
+
+def _shared_prompts(n_prefixes=2, n_cont=4, prefix_len=20, cont_len=5,
+                    seed=7):
+    """n_prefixes distinct system prompts x n_cont continuations."""
+    rng = np.random.RandomState(seed)
+    prefixes = [rng.randint(0, VOCAB, (prefix_len,)).astype(np.int32)
+                for _ in range(n_prefixes)]
+    return [np.concatenate([p, rng.randint(0, VOCAB,
+                                           (cont_len,)).astype(np.int32)])
+            for _ in range(n_cont) for p in prefixes]
+
+
+def _serve_sequential(eng, prompts, max_new=8):
+    """Submit one at a time, draining between submits, so every prompt
+    after the first sees the published blocks of its predecessors."""
+    reqs = []
+    for p in prompts:
+        reqs.append(eng.submit(p, max_new_tokens=max_new))
+        eng.run()
+    return reqs
+
+
+def _identity_check(model, **cache_on_kw):
+    cold = _engine(model, prefix_cache=False)
+    prompts = _shared_prompts()
+    ref = _serve_sequential(cold, prompts)
+    assert cold.stats().prefix_hits == 0
+    cold.shutdown()
+
+    warm = _engine(model, **cache_on_kw)
+    got = _serve_sequential(warm, prompts)
+    st = warm.stats()
+    warm.shutdown()
+    assert st.prefix_hits > 0, "no prefix hits — test is vacuous"
+    assert st.prefix_tokens_saved > 0
+    assert st.prefill_tokens_computed < cold.stats().prefill_tokens_computed
+    for a, b in zip(ref, got):
+        assert a.status == b.status == "finished"
+        assert a.tokens == b.tokens
+    return st
+
+
+def test_cached_vs_cold_identity_gpt(model):
+    """Acceptance: byte-identical outputs with the cache on vs off,
+    with a real prefill-compute reduction (gpt2-style variant)."""
+    st = _identity_check(model)
+    assert st.prefix_hit_rate > 0.5
+
+
+def test_cached_vs_cold_identity_llama_gqa(llama_model):
+    """Same gate on the llama-style variant (rope positions exercise
+    the chunk program's position-offset rotary path; GQA exercises its
+    grouped gather)."""
+    _identity_check(llama_model)
+
+
+def test_chunked_vs_whole_prefill_identity(model):
+    """A long prompt prefilled in chunks must emit exactly the tokens
+    of a whole-prompt prefill — and actually take multiple iterations."""
+    rng = np.random.RandomState(11)
+    long_prompt = rng.randint(0, VOCAB, (50,)).astype(np.int32)
+    whole = _engine(model, prefix_cache=False, prefill_chunk=0)
+    ref = whole.submit(long_prompt, max_new_tokens=8)
+    whole.run()
+    whole.shutdown()
+
+    eng = _engine(model, prefix_cache=False, prefill_chunk=8)
+    req = eng.submit(long_prompt, max_new_tokens=8)
+    chunk_steps = 0
+    while eng.scheduler.has_work():
+        before = req.cache_len
+        eng.step()
+        if not req.done and req.cache_len > before and not req.tokens:
+            chunk_steps += 1
+    eng.shutdown()
+    assert chunk_steps >= 3, "prompt never actually chunked"
+    assert req.tokens == ref.tokens
+
+
+def test_chunked_and_cached_compose(model):
+    """A prefix-cache hit on a long prompt chunks only the SUFFIX."""
+    rng = np.random.RandomState(13)
+    prefix = rng.randint(0, VOCAB, (16,)).astype(np.int32)
+    long_a = np.concatenate([prefix, rng.randint(0, VOCAB, (30,))
+                             .astype(np.int32)])
+    long_b = np.concatenate([prefix, rng.randint(0, VOCAB, (30,))
+                             .astype(np.int32)])
+    cold = _engine(model, prefix_cache=False, prefill_chunk=0)
+    refs = _serve_sequential(cold, [long_a, long_b])
+    cold.shutdown()
+    eng = _engine(model, prefill_chunk=8)
+    got = _serve_sequential(eng, [long_a, long_b])
+    st = eng.stats()
+    eng.shutdown()
+    assert st.prefix_hits >= 1
+    for a, b in zip(refs, got):
+        assert a.tokens == b.tokens
+
+
+def test_eviction_pressure_then_reprefill_identity(model):
+    """Cached blocks evicted under pressure must not poison a later
+    identical prompt: the re-prefill recomputes and still matches."""
+    prompt = _shared_prompts(n_prefixes=1, n_cont=1)[0]
+    ref_eng = _engine(model, prefix_cache=False)
+    ref = ref_eng.submit(prompt, max_new_tokens=8)
+    ref_eng.run()
+    ref_eng.shutdown()
+
+    # 15 allocatable blocks: each ~8-block request forces the previous
+    # one's parked chain out of the radix LRU
+    eng = _engine(model, num_blocks=16, max_model_len=48)
+    first = eng.submit(prompt, max_new_tokens=8)
+    eng.run()
+    rng = np.random.RandomState(29)
+    for _ in range(3):                     # churn: evict the cached chain
+        eng.submit(rng.randint(0, VOCAB, (24,)).astype(np.int32),
+                   max_new_tokens=8)
+        eng.run()
+    again = eng.submit(prompt, max_new_tokens=8)
+    eng.run()
+    st = eng.stats()
+    eng.shutdown()
+    assert st.prefix_evictions > 0, "no eviction pressure — vacuous"
+    assert first.tokens == ref.tokens
+    assert again.tokens == ref.tokens
+
+
+def test_preemption_with_sharing_identity(model):
+    """The PR 1 resume-equivalence gate, replayed with prefix sharing
+    live: preempting a request whose blocks are shared must neither
+    corrupt the survivor nor the resumed request (free is a decref)."""
+    prompts = _shared_prompts(n_prefixes=2, n_cont=3, prefix_len=12,
+                              cont_len=4, seed=17)
+
+    def run(num_blocks):
+        eng = _engine(model, num_blocks=num_blocks)
+        reqs = [eng.submit(p, max_new_tokens=16) for p in prompts]
+        eng.run()
+        stats = eng.stats()
+        eng.shutdown()
+        return reqs, stats
+
+    calm_reqs, calm_stats = run(num_blocks=64)
+    tight_reqs, tight_stats = run(num_blocks=22)
+    assert calm_stats.preemptions == 0
+    assert tight_stats.preemptions > 0, "no cache pressure — vacuous"
+    for calm, tight in zip(calm_reqs, tight_reqs):
+        assert calm.status == tight.status == "finished"
+        assert calm.tokens == tight.tokens
+
+
+def test_long_prompt_no_longer_starves_decodes(model):
+    """The decode-latency ceiling: while a long prompt chunk-prefills,
+    already-running requests receive a token EVERY iteration, and no
+    single iteration computes more prefill tokens than the chunk
+    budget (whole-prompt prefill would do all 50 in one step)."""
+    chunk = 8
+    eng = _engine(model, prefill_chunk=chunk, max_model_len=64,
+                  num_blocks=64)
+    rng = np.random.RandomState(19)
+    short = eng.submit(rng.randint(0, VOCAB, (6,)).astype(np.int32),
+                       max_new_tokens=24)
+    eng.step()                             # short admitted + decoding
+    long_req = eng.submit(rng.randint(0, VOCAB, (50,)).astype(np.int32),
+                          max_new_tokens=4)
+    max_advance = 0
+    while not long_req.tokens and eng.scheduler.has_work():
+        sh, lg = len(short.tokens), long_req.cache_len
+        eng.step()
+        if long_req.cache_len > lg:        # a chunk ran this iteration
+            max_advance = max(max_advance, long_req.cache_len - lg)
+            if not short.done:             # ... and decode still moved
+                assert len(short.tokens) == sh + 1
+    eng.run()
+    eng.shutdown()
+    assert 0 < max_advance <= chunk
+    assert short.status == long_req.status == "finished"
+
+
+def test_statusz_and_stats_expose_prefix_cache(model):
+    eng = _engine(model)
+    _serve_sequential(eng, _shared_prompts(n_prefixes=1, n_cont=2))
+    sz = eng.statusz()
+    pfx = sz["prefix_cache"]
+    assert pfx["enabled"] is True
+    assert pfx["hits"] >= 1 and pfx["tokens_saved"] > 0
+    assert sz["kv_blocks"]["prefix_cache"] == pfx
+    st = eng.stats()
+    assert st.prefix_hits == pfx["hits"]
+    assert st.prefix_tokens_saved == pfx["tokens_saved"]
+    assert st.as_dict()["prefix_hit_rate"] == pfx["hit_rate"]
+    eng.shutdown()
+
+
+def test_prefix_metrics_series(model):
+    """The prefix counters agree between ServeStats and the telemetry
+    registry (mxtpu_serve_prefix_{hits,misses,tokens_saved}_total plus
+    the prefill-compute counter) — the series /statusz and trace_report
+    use to explain a cache-cold replica."""
+    from mxnet_tpu import telemetry
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        eng = _engine(model)
+        _serve_sequential(eng, _shared_prompts(n_prefixes=1, n_cont=3))
+        st = eng.stats()
+        snap = telemetry.registry().snapshot()
+        eng.shutdown()
+
+        def val(name):
+            return snap[name]["samples"][0]["value"]
+
+        assert st.prefix_hits > 0          # vacuity guard
+        assert val("mxtpu_serve_prefix_hits_total") == float(st.prefix_hits)
+        assert val("mxtpu_serve_prefix_misses_total") == \
+            float(st.prefix_misses)
+        assert val("mxtpu_serve_prefix_tokens_saved_total") == \
+            float(st.prefix_tokens_saved)
+        assert val("mxtpu_serve_prefill_tokens_computed_total") == \
+            float(st.prefill_tokens_computed)
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_prefix_cache_disabled_is_inert(model):
+    eng = _engine(model, prefix_cache=False)
+    reqs = _serve_sequential(eng, _shared_prompts(n_prefixes=1, n_cont=3))
+    st = eng.stats()
+    pfx = eng.blocks.prefix_stats()
+    eng.shutdown()
+    assert all(r.status == "finished" for r in reqs)
+    assert st.prefix_hits == st.prefix_misses == 0
+    assert pfx["enabled"] is False and pfx["cached_blocks"] == 0

@@ -14,6 +14,7 @@ artifact set in priority order:
   7. tests/test_tpu_consistency.py          -> TPU_CONSISTENCY.json
   8. tools/serve_bench.py                   -> SERVE_BENCH.json
      tools/serve_bench.py --tp 2            -> SERVE_TP_BENCH.json
+     tools/serve_bench.py --workload prefix -> PREFIX_BENCH.json
   9. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
 
 Two stages need no TPU and run ahead of the probe (so chip-down rounds
@@ -482,6 +483,30 @@ def run_serve_tp_bench(timeout=2400):
         "SERVE_TP_BENCH.json", timeout, validate=validate)
 
 
+def run_serve_prefix_bench(timeout=2400):
+    """Prefix-cached KV sharing + chunked prefill (tools/serve_bench.py
+    --workload prefix) — the shared-prefix cache A/B (hit rate,
+    prefill-compute ratio, token identity) and the mixed-length
+    decode-stall A/B (chunked vs whole-prompt prefill p99)."""
+
+    def validate(payload):
+        if not payload.get("tokens_identical"):
+            return "cached/chunked tokens differ from the cold path"
+        if (payload.get("prefix_hit_rate") or 0) <= 0.8:
+            return "prefix hit rate <= 0.8"
+        if (payload.get("prefill_compute_ratio") or 0) < 2:
+            return "prefill-compute reduction under 2x"
+        if not payload.get("stall_improved"):
+            return "chunked prefill did not improve decode-stall p99"
+        return None
+
+    return run_json_artifact(
+        "serve_prefix",
+        [os.path.join(REPO, "tools", "serve_bench.py"),
+         "--workload", "prefix"],
+        "PREFIX_BENCH.json", timeout, validate=validate)
+
+
 def run_train_bench(timeout=1800):
     """Fused single-dispatch train step vs per-param loop
     (tools/train_bench.py) — steps/sec and per-batch host dispatch
@@ -560,7 +585,7 @@ def main():
             "resnet": False, "resnet256": False, "gpt": False,
             "longcontext": False, "bandwidth": False, "cifar": False,
             "quant": False, "decode": False, "serve": False,
-            "serve_tp": False,
+            "serve_tp": False, "serve_prefix": False,
             "train_bench": False, "startup": False, "train_tier": False,
             "sweep": False}
     fails = {k: 0 for k in done}
@@ -647,6 +672,8 @@ def main():
             ("serve", lambda: run_serve_bench(timeout=min(2400, left))),
             ("serve_tp",
              lambda: run_serve_tp_bench(timeout=min(2400, left))),
+            ("serve_prefix",
+             lambda: run_serve_prefix_bench(timeout=min(2400, left))),
             ("train_bench", lambda: run_train_bench(timeout=min(1800, left))),
             ("startup", lambda: run_startup_bench(timeout=min(1800, left))),
             ("train_tier", lambda: run_train_tier(timeout=min(3000, left))),

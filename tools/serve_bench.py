@@ -47,6 +47,162 @@ def build_workload(rng, args):
     return work
 
 
+def build_shared_prefix_workload(rng, args):
+    """The prefix-cache workload: ``--prefixes`` distinct system
+    prompts x ``--continuations`` short unique suffixes each,
+    interleaved prefix-major so the first wave is exactly one cold
+    prefill per prefix and everything after can hit the cache."""
+    import numpy as np
+
+    prefixes = [rng.randint(0, args.vocab,
+                            (args.prefix_len,)).astype("int32")
+                for _ in range(args.prefixes)]
+    work = []
+    for _ in range(args.continuations):
+        for p in prefixes:
+            sfx = rng.randint(0, args.vocab,
+                              (args.suffix_len,)).astype("int32")
+            work.append((np.concatenate([p, sfx]), args.max_new))
+    return work
+
+
+def run_shared_prefix(mx, args, make_engine, workload):
+    """Cache-on vs cache-off over the shared-prefix workload: the
+    prefill-compute ratio, hit rate, tokens saved — and byte-identical
+    output tokens (the acceptance bar)."""
+    # first wave = one cold prefill per distinct prefix: cap the closed
+    # loop there so later admissions see the published chains
+    conc = min(args.concurrency, args.prefixes)
+    sp_len = args.prefix_len + args.suffix_len + args.max_new
+    blocks_for = mx.serve.kv_block_manager.blocks_for
+    # room for the published prefix chains PLUS conc private suffixes
+    # (cache-off needs conc full-length residents, strictly less)
+    num_blocks = (1 + args.prefixes * blocks_for(args.prefix_len,
+                                                 args.block_size)
+                  + (conc + 2) * blocks_for(sp_len + 1, args.block_size))
+    kw = dict(max_model_len=sp_len, num_blocks=num_blocks,
+              max_queue=len(workload) + 1)
+
+    def once(prefix_cache):
+        eng = make_engine(conc, prefix_cache=prefix_cache, **kw)
+        reqs, wall = run_closed(mx, eng, workload, conc)
+        st = eng.stats()
+        eng.shutdown()
+        return reqs, wall, st
+
+    weng = make_engine(conc, **kw)
+    weng.warmup()                  # dense + chunk + decode buckets
+    weng.shutdown()
+    off_reqs, off_wall, off_st = once(False)
+    on_reqs, on_wall, on_st = once(True)
+    identical = all(
+        a.status == b.status == "finished" and a.tokens == b.tokens
+        for a, b in zip(off_reqs, on_reqs))
+    ratio = (round(off_st.prefill_tokens_computed
+                   / on_st.prefill_tokens_computed, 2)
+             if on_st.prefill_tokens_computed else None)
+    return {
+        "mode": "shared-prefix",
+        "requests": len(workload),
+        "prefixes": args.prefixes,
+        "continuations": args.continuations,
+        "prefix_len": args.prefix_len,
+        "suffix_len": args.suffix_len,
+        "completed_on": sum(r.status == "finished" for r in on_reqs),
+        "completed_off": sum(r.status == "finished" for r in off_reqs),
+        "prefix_hit_rate": on_st.prefix_hit_rate,
+        "prefix_hits": on_st.prefix_hits,
+        "prefix_misses": on_st.prefix_misses,
+        "prefill_tokens_saved": on_st.prefix_tokens_saved,
+        "prefill_tokens_computed_on": on_st.prefill_tokens_computed,
+        "prefill_tokens_computed_off": off_st.prefill_tokens_computed,
+        "prefill_compute_ratio": ratio,
+        "tokens_identical": identical,
+        "wall_s_on": round(on_wall, 3),
+        "wall_s_off": round(off_wall, 3),
+        "tokens_per_sec_on": (round(sum(len(r.tokens) for r in on_reqs)
+                                    / on_wall, 1) if on_wall else None),
+        "tokens_per_sec_off": (round(sum(len(r.tokens) for r in off_reqs)
+                                     / off_wall, 1) if off_wall else None),
+        "preemptions_on": on_st.preemptions,
+    }
+
+
+def run_mixed_len(mx, args, make_engine):
+    """One very long prompt amid steadily-decoding short requests:
+    chunked prefill vs whole-prompt prefill, reporting the p99
+    inter-token latency (decode stall) of the short requests while the
+    long prefill is in flight — the chunked-prefill acceptance bar."""
+    import numpy as np
+
+    from tools.trace_report import percentile
+
+    rng = np.random.RandomState(args.seed + 1)
+    long_len = args.long_prompt
+    chunk = args.prefill_chunk or max(32, long_len // 8)
+    n_short, short_len, short_new = 4, 16, 96
+    short_prompts = [rng.randint(0, args.vocab,
+                                 (short_len,)).astype("int32")
+                     for _ in range(n_short)]
+    long_prompt = rng.randint(0, args.vocab, (long_len,)).astype("int32")
+    blocks_for = mx.serve.kv_block_manager.blocks_for
+    num_blocks = (2 + blocks_for(long_len + 16, args.block_size)
+                  + (n_short + 1) * blocks_for(short_len + short_new + 1,
+                                               args.block_size))
+    kw = dict(max_model_len=long_len + 16, num_blocks=num_blocks,
+              prefix_cache=False, max_queue=n_short + 2)
+
+    weng = make_engine(n_short + 1, prefill_chunk=chunk, **kw)
+    weng.warmup()                  # whole-prefill + chunk + decode buckets
+    weng.shutdown()
+
+    def once(prefill_chunk):
+        eng = make_engine(n_short + 1, prefill_chunk=prefill_chunk, **kw)
+        shorts = [eng.submit(p, max_new_tokens=short_new)
+                  for p in short_prompts]
+        while any(not s.tokens for s in shorts):
+            eng.step()             # ramp: every short is decoding
+        long_req = eng.submit(long_prompt, max_new_tokens=8)
+        last = {s.rid: time.perf_counter() for s in shorts}
+        counts = {s.rid: len(s.tokens) for s in shorts}
+        gaps = []
+        while not long_req.done and eng.scheduler.has_work():
+            eng.step()
+            now = time.perf_counter()
+            for s in shorts:
+                if len(s.tokens) > counts[s.rid]:
+                    gaps.append(now - last[s.rid])
+                    last[s.rid] = now
+                    counts[s.rid] = len(s.tokens)
+        eng.run()                  # drain the shorts
+        st = eng.stats()
+        eng.shutdown()
+        return long_req, shorts, gaps, st
+
+    long_w, shorts_w, gaps_w, _ = once(0)            # whole-prompt
+    long_c, shorts_c, gaps_c, st_c = once(chunk)     # chunked
+    identical = (long_w.tokens == long_c.tokens and all(
+        a.tokens == b.tokens for a, b in zip(shorts_w, shorts_c)))
+    p99_w = percentile(sorted(g * 1e3 for g in gaps_w), 0.99)
+    p99_c = percentile(sorted(g * 1e3 for g in gaps_c), 0.99)
+    return {
+        "mode": "mixed-len",
+        "long_prompt": long_len,
+        "prefill_chunk": chunk,
+        "short_requests": n_short,
+        "decode_gaps_whole": len(gaps_w),
+        "decode_gaps_chunked": len(gaps_c),
+        "decode_stall_p99_ms_whole": round(p99_w, 2),
+        "decode_stall_p99_ms_chunked": round(p99_c, 2),
+        "decode_stall_max_ms_whole": round(max(gaps_w) * 1e3, 2),
+        "decode_stall_max_ms_chunked": round(max(gaps_c) * 1e3, 2),
+        "stall_improvement": (round(p99_w / p99_c, 2) if p99_c else None),
+        "improved": bool(p99_c < p99_w),
+        "tokens_identical": identical,
+        "prefill_tokens_computed_chunked": st_c.prefill_tokens_computed,
+    }
+
+
 def run_closed(mx, engine, workload, concurrency, deadline_s=None):
     """Closed loop: keep ``concurrency`` requests in flight.  A full
     admission queue throttles the loop (closed-loop clients WAIT for
@@ -142,6 +298,30 @@ def main():
     p.add_argument("--prompt-lens", default="16,32,64,128")
     p.add_argument("--max-new", type=int, default=32)
     p.add_argument("--mode", default="closed", choices=("closed", "open"))
+    p.add_argument("--workload", default="default",
+                   choices=("default", "shared-prefix", "mixed-len",
+                            "prefix"),
+                   help="default: the mixed prompt-length load. "
+                        "shared-prefix: --prefixes system prompts x "
+                        "--continuations suffixes, cache-on vs cache-off "
+                        "(prefix-cache acceptance: hit rate, prefill-"
+                        "compute ratio, token identity). mixed-len: one "
+                        "--long-prompt amid short decoders, chunked vs "
+                        "whole-prompt prefill (decode-stall p99 "
+                        "acceptance). prefix: both prefix workloads in "
+                        "one payload -> the PREFIX_BENCH.json stage")
+    p.add_argument("--prefixes", type=int, default=4,
+                   help="shared-prefix: distinct system prompts")
+    p.add_argument("--continuations", type=int, default=6,
+                   help="shared-prefix: unique suffixes per prefix")
+    p.add_argument("--prefix-len", type=int, default=96,
+                   help="shared-prefix: shared system-prompt tokens")
+    p.add_argument("--suffix-len", type=int, default=12,
+                   help="shared-prefix: unique continuation tokens")
+    p.add_argument("--long-prompt", type=int, default=2048,
+                   help="mixed-len: the long prompt's token count")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="mixed-len: chunk size (0 = long-prompt/8)")
     p.add_argument("--rate", type=float, default=16.0,
                    help="open-loop arrival rate, requests/sec")
     p.add_argument("--deadline-s", type=float, default=None)
@@ -213,6 +393,13 @@ def main():
 
     lens = [int(x) for x in args.prompt_lens.split(",")]
     max_len = max(lens) + args.max_new
+    # the prefix workloads size the model themselves: the net must
+    # cover whatever max_model_len their engines will use
+    if args.workload in ("shared-prefix", "prefix"):
+        max_len = max(max_len,
+                      args.prefix_len + args.suffix_len + args.max_new)
+    if args.workload in ("mixed-len", "prefix"):
+        max_len = max(max_len, args.long_prompt + 16)
     kv = args.kv_heads or max(1, args.heads // 4)
     if eff_tp > 1 and kv % eff_tp:
         # the head-sharded KV-cache needs kv_heads % tp == 0; bump the
@@ -235,12 +422,12 @@ def main():
 
     tp = args.tp if args.tp else None    # --tp 1 forces single-device
 
-    def make_engine(max_batch):
-        return mx.serve.Engine(
-            params, symbol=net, block_size=args.block_size,
-            num_blocks=num_blocks, max_batch=max_batch,
-            max_queue=max_queue, max_model_len=max_len,
-            max_prefills_per_step=2, tp=tp)
+    def make_engine(max_batch, **kw):
+        base = dict(block_size=args.block_size, num_blocks=num_blocks,
+                    max_batch=max_batch, max_queue=max_queue,
+                    max_model_len=max_len, max_prefills_per_step=2, tp=tp)
+        base.update(kw)   # the prefix workloads override capacity knobs
+        return mx.serve.Engine(params, symbol=net, **base)
 
     out = {"platform": jax.default_backend(),
            "device_kind": getattr(jax.devices()[0], "device_kind", ""),
@@ -248,11 +435,47 @@ def main():
            "heads": args.heads, "kv_heads": kv, "vocab": args.vocab,
            "block_size": args.block_size, "num_blocks": num_blocks,
            "concurrency": args.concurrency, "mode": args.mode,
+           "workload": args.workload,
            "param_dtype": dtype}
     flush = make_flush(args.json, out)
     pts = []
     out["points"] = pts
     rng = np.random.RandomState(args.seed)
+
+    if args.workload != "default":
+        # prefix-cache / chunked-prefill acceptance workloads: each
+        # runner is a self-contained cached-vs-cold (or chunked-vs-
+        # whole) A/B with its own capacity math; the headline fields
+        # land at top level for the bench_watch serve_prefix contract
+        recs = []
+        if args.workload in ("shared-prefix", "prefix"):
+            wl = build_shared_prefix_workload(rng, args)
+            rec = run_shared_prefix(mx, args, make_engine, wl)
+            print(json.dumps(rec))
+            pts.append(rec)
+            recs.append(rec)
+            out["prefix_hit_rate"] = rec["prefix_hit_rate"]
+            out["prefill_tokens_saved"] = rec["prefill_tokens_saved"]
+            out["prefill_compute_ratio"] = rec["prefill_compute_ratio"]
+            flush(False)
+        if args.workload in ("mixed-len", "prefix"):
+            rec = run_mixed_len(mx, args, make_engine)
+            print(json.dumps(rec))
+            pts.append(rec)
+            recs.append(rec)
+            out["decode_stall_p99_ms_whole"] = \
+                rec["decode_stall_p99_ms_whole"]
+            out["decode_stall_p99_ms_chunked"] = \
+                rec["decode_stall_p99_ms_chunked"]
+            out["stall_improvement"] = rec["stall_improvement"]
+            out["stall_improved"] = rec["improved"]
+            flush(False)
+        out["tokens_identical"] = all(r["tokens_identical"] for r in recs)
+        out["telemetry"] = mx.telemetry.snapshot()
+        flush(True)
+        print(json.dumps(out))
+        return
+
     workload = build_workload(rng, args)
 
     if args.warmup:
