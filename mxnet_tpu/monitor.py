@@ -116,12 +116,21 @@ class ServeMonitor:
         s = self.engine.stats()
         rate = (s.decode_tok_per_sec if s.decode_tok_per_sec is not None
                 else s.total_tok_per_sec)
+        # the tok/s above is fed from ACTUAL per-iteration emitted
+        # counts, so it stays honest with speculative decoding on; the
+        # spec tail (acceptance rate / mean accepted-per-verify) only
+        # appears once a verify has run — plain-decode lines are
+        # byte-identical to the pre-spec format
+        spec = ""
+        if getattr(s, "spec_verifies", 0):
+            spec = (f" spec={s.spec_accept_rate:.2f}"
+                    f"/{s.accepted_per_verify:.2f}")
         self.logger.info(
             "Serve: step %7d queue=%d running=%d done=%d rej=%d[%s] "
-            "preempt=%d blocks=%d/%d (%.0f%%) ttft_ms=%s tok/s=%s",
+            "preempt=%d blocks=%d/%d (%.0f%%) ttft_ms=%s tok/s=%s%s",
             s.steps, s.queue_depth, s.running, s.completed, s.rejected,
             self._fmt_reasons(getattr(s, "reject_reasons", None)),
             s.preemptions, s.blocks_in_use, s.blocks_total,
             100.0 * s.block_utilization, self._fmt(s.ttft_ms_mean),
-            self._fmt(rate))
+            self._fmt(rate), spec)
         return s

@@ -36,8 +36,10 @@ from .engine import Engine
 from .kv_block_manager import BlockManager, NoFreeBlocks
 from .scheduler import (CANCELLED, FINISHED, REJECTED, RUNNING, WAITING,
                         QueueFull, Request, Scheduler)
+from .spec import DraftWorker
 from .stats import ServeStats, StatsRecorder
 
-__all__ = ["Engine", "BlockManager", "NoFreeBlocks", "QueueFull",
-           "Request", "Scheduler", "ServeStats", "StatsRecorder",
+__all__ = ["Engine", "BlockManager", "DraftWorker", "NoFreeBlocks",
+           "QueueFull", "Request", "Scheduler", "ServeStats",
+           "StatsRecorder",
            "WAITING", "RUNNING", "FINISHED", "REJECTED", "CANCELLED"]

@@ -71,6 +71,7 @@ from ..telemetry.request_trace import RequestTracer
 from .kv_block_manager import BlockManager
 from .scheduler import (CANCELLED, FINISHED, WAITING, QueueFull, Request,
                         Scheduler)
+from . import spec as spec_mod
 from .stats import StatsRecorder
 
 __all__ = ["Engine"]
@@ -182,6 +183,21 @@ class Engine:
         interleaved with decode steps, so a very long prompt cannot
         stall the decode batch for a whole-prompt prefill.  0 disables
         chunking (whole-prompt prefills only).
+      spec_k: draft-model speculative decoding (env ``MXTPU_SERVE_SPEC``,
+        default 0 — off and byte-for-byte inert): each decode iteration
+        a small draft model proposes ``spec_k`` tokens per running
+        request (one dispatch, the k-step loop unrolled) and the target
+        model verifies all ``k+1`` positions in ONE bucketed dispatch,
+        emitting the longest agreeing prefix plus one corrected token.
+        Greedy acceptance keeps the output token-identical to plain
+        decode, so ``spec_k > 0`` requires ``temperature == 0``.  See
+        ``serve/spec.py`` and docs/how_to/serve.md.
+      draft_params: the draft model's gpt() parameter dict (required
+        when ``spec_k > 0``; same vocab as the target — token ids
+        cross between the two models).  ``draft_num_heads`` /
+        ``draft_window`` / ``draft_symbol`` mirror the target-side
+        decode-config arguments; ``draft_name`` is the draft
+        checkpoint's symbol-name prefix (default: the target's).
     """
 
     def __init__(self, params, num_heads=None, window=None, symbol=None,
@@ -190,7 +206,9 @@ class Engine:
                  max_prefills_per_step=1, temperature=0.0, top_k=None,
                  seed=0, clock=time.monotonic, aot_dir=None, tp=None,
                  partition_rules=None, tenant_share=None,
-                 prefix_cache=None, prefill_chunk=None):
+                 prefix_cache=None, prefill_chunk=None, spec_k=None,
+                 draft_params=None, draft_num_heads=None,
+                 draft_window=None, draft_symbol=None, draft_name=None):
         if symbol is not None:
             num_heads, window = reconcile_decode_config(symbol, num_heads,
                                                         window)
@@ -278,6 +296,24 @@ class Engine:
         # fixed block-table width: one decode program per batch bucket
         self.table_width = -(-self.max_model_len // self.block_size)
 
+        # -- speculative decoding (serve/spec.py) --------------------------
+        self.spec_k = (int(spec_k) if spec_k is not None
+                       else env_int("MXTPU_SERVE_SPEC", 0))
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0 (got {self.spec_k})")
+        if self.spec_k:
+            if self.temperature != 0.0:
+                raise ValueError(
+                    "speculative decoding (spec_k > 0) requires greedy "
+                    "sampling (temperature=0.0): the acceptance rule is "
+                    "exact argmax-prefix match, which is what makes the "
+                    "output token-identical to plain decode")
+            if draft_params is None:
+                raise ValueError(
+                    "spec_k > 0 requires draft_params (a small gpt() "
+                    "checkpoint whose vocab matches the target's)")
+        self._spec = None           # DraftWorker, attached below
+
         self.blocks = BlockManager(self.num_blocks, self.block_size,
                                    prefix_cache=prefix_cache)
         # request-scoped observability: the tracer threads every
@@ -290,7 +326,8 @@ class Engine:
                                    max_prefills_per_step, clock=clock,
                                    trace=self._rtrace,
                                    tenant_share=tenant_share,
-                                   prefill_chunk=prefill_chunk)
+                                   prefill_chunk=prefill_chunk,
+                                   spec_slots=self.spec_k)
         self._stats = StatsRecorder(clock=clock)
         self.clock = clock
         self._step_id = 0
@@ -351,6 +388,25 @@ class Engine:
             window=self.window, block_size=self.block_size,
             temperature=self.temperature, top_k=self.top_k,
             numeric_watch=self._numeric_watch)
+        # draft worker last among the device placements: params, then
+        # the target cache, then the (much smaller) draft side — the
+        # same one-model-at-a-time HBM discipline shutdown() preserves
+        self._draft_shardings = None
+        if self.spec_k:
+            from .spec import DraftWorker
+
+            self._spec = DraftWorker(
+                self, draft_params, num_heads=draft_num_heads,
+                window=draft_window, symbol=draft_symbol,
+                name=draft_name or name)
+            if self._shardings is not None:
+                # the draft replicates under tensor parallelism (its
+                # params and cache are small by design); its programs
+                # still need mesh-aware jit kwargs so GSPMD sees one
+                # consistent layout
+                rep = self._shardings.rep
+                self._draft_shardings = _Shardings(
+                    mesh=self.mesh, params=rep, cache=rep, rep=rep)
         # -- AOT startup wiring (mxnet_tpu/aot/) ---------------------------
         self._aot = (aot_store.ExportStore(aot_dir) if aot_dir is not None
                      else aot_store.default_store())
@@ -391,7 +447,9 @@ class Engine:
         # program must never be served to a tp=4 engine
         return (self._cfg, self.num_blocks, self.table_width,
                 str(self._cache_k.dtype), self._donate, self.tp,
-                self._rules_digest)
+                self._rules_digest, self.spec_k,
+                None if self._spec is None else
+                (self._spec.cfg, str(self._spec.cache_k.dtype)))
 
     def _aot_base_fp(self):
         """The on-disk form of _spec_key(): same fields, JSON-stable,
@@ -404,11 +462,18 @@ class Engine:
         sharded = ({} if self.tp == 1 else dict(
             tp=self.tp, mesh_shape=dict(self.mesh.shape),
             partition_rules=self._rules_digest))
+        # like the sharding fields, spec enters the fingerprint ONLY
+        # when on: a spec-off engine keeps its pre-spec digests, so an
+        # upgraded fleet keeps loading its existing artifacts/manifests
+        spec = ({} if self._spec is None else dict(
+            spec_k=self.spec_k,
+            draft=dict(self._spec.cfg._asdict(),
+                       cache_dtype=str(self._spec.cache_k.dtype))))
         return aot_store.fingerprint(
             subsystem="serve", cfg=self._cfg._asdict(),
             num_blocks=self.num_blocks, table_width=self.table_width,
             cache_dtype=str(self._cache_k.dtype), donate=self._donate,
-            **sharded)
+            **sharded, **spec)
 
     # -- public API ----------------------------------------------------------
     def submit(self, prompt, max_new_tokens=64, deadline_s=None,
@@ -479,13 +544,19 @@ class Engine:
             for req in prefills:
                 with telemetry.span("serve.prefill", rid=req.rid):
                     # the per-iteration prefill token budget is shared
-                    # with the decode slots: each decode emits one
-                    # token this step, so a chunk shrinks by the batch
+                    # with the decode slots: each decode slot emits up
+                    # to 1 + spec_k tokens this step (one, without
+                    # speculative decoding), so a chunk shrinks by the
+                    # batch's worst-case token count
                     emitted += self._run_prefill(
-                        req, decode_slots=len(decodes))
+                        req,
+                        decode_slots=len(decodes) * (1 + self.spec_k))
             if decodes:
                 with telemetry.span("serve.decode", batch=len(decodes)):
-                    emitted += self._run_decode(decodes)
+                    if self._spec is not None:
+                        emitted += self._run_spec_decode(decodes)
+                    else:
+                        emitted += self._run_decode(decodes)
             if prefills or decodes:
                 # scheduler decisions ride the flight ring (bounded,
                 # always on) so post-mortems see the recent schedule
@@ -502,7 +573,14 @@ class Engine:
                         "steps scheduled nothing (cache/queue misconfigured?)")
             else:
                 self._noop_steps = 0
-            self._stats.on_step(emitted)
+            self._stats.on_step(emitted, decode_batch=len(decodes))
+            if self._spec is not None:
+                # bound the draft ingest ledger by the LIVE running
+                # set: a request that leaves the engine between decodes
+                # (preempted, then deadline-rejected or cancelled)
+                # never reaches the forget() in _run_spec_decode.  A
+                # pruned-then-resumed request simply re-ingests.
+                self._spec.prune({r.rid for r in self.scheduler.running})
             self._tel_queue.set(self.scheduler.queue_depth)
             self._tel_running.set(len(self.scheduler.running))
             self._tel_blocks.set(self.blocks.blocks_in_use)
@@ -608,6 +686,11 @@ class Engine:
             "prefix_cache": self.blocks.prefix_stats(),
             "kv_cache": self.kv_cache_stats(),
             "sharding": self.sharding_info(),
+            # speculative decoding: k, the draft model's shape/bytes,
+            # the rolling acceptance rate and the verify bucket grid
+            # (None with spec off)
+            "spec": (None if self._spec is None
+                     else self._spec.statusz(self)),
             "max_batch": self.max_batch,
             "max_model_len": self.max_model_len,
             "programs_recorded": len(self._manifest.entries()),
@@ -674,6 +757,9 @@ class Engine:
             self._rtrace.terminal(req, CANCELLED)
         self._rtrace.close()
         statusz_mod.unregister(self._statusz_name)
+        if self._spec is not None:
+            self._spec.shutdown()
+            self._spec = None
         for arr in self._owned + [self._cache_k, self._cache_v]:
             try:
                 arr.delete()
@@ -830,9 +916,133 @@ class Engine:
             req.cache_len += 1
             req.tokens.append(int(out[i]))
             self._rtrace.event(req, "decode", batch=self._step_id,
-                               batch_size=B, tokens=len(req.tokens))
+                               batch_size=B, tokens=len(req.tokens),
+                               emitted=1)
             self._maybe_finish(req)
         return B
+
+    def _spec_ingest(self, req):
+        """Bring the draft cache up to date with ``req``'s context —
+        positions ``[0, cache_len)`` run through the draft model's
+        chunk program in one dispatch.  Needed at admission and after
+        a preemption-resume (the draft side re-ingests into the new
+        block table; a prefix-cache hit's shared blocks are simply
+        rewritten with recomputed values, which can only perturb the
+        ACCEPTANCE rate of other sharers, never any emitted token)."""
+        span = self._spec.context_gap(req)
+        if span <= 0:
+            return
+        ids = req.prefill_ids()[:span]
+        bucket = _next_bucket(span, self.max_model_len)
+        toks = np.zeros(bucket, np.int32)
+        toks[:span] = ids
+        table = self.blocks.table(req.rid)
+        tw = np.zeros(self.table_width, np.int32)
+        tw[:len(table)] = table
+        pos = np.arange(span)
+        blk = np.zeros(bucket, np.int32)       # padded rows -> null blk
+        blk[:span] = tw[pos // self.block_size]
+        off = (np.arange(bucket) % self.block_size).astype(np.int32)
+        self._key, sub = jax.random.split(self._key)
+        sw = self._spec
+        with telemetry.span("serve.spec_ingest", rid=req.rid,
+                            tokens=span):
+            # the chunk program built over the DRAFT config: same
+            # write-then-attend body, draft params and draft caches
+            _, sw.cache_k, sw.cache_v = self._program(
+                "draft_chunk", bucket)(
+                    sw.params, sw.cache_k, sw.cache_v,
+                    jnp.asarray(toks), jnp.asarray(0, jnp.int32),
+                    jnp.asarray(span, jnp.int32), jnp.asarray(tw),
+                    jnp.asarray(blk), jnp.asarray(off), sub)
+        sw.note_ingested(req, span)
+
+    @hot_path
+    def _run_spec_decode(self, reqs):
+        """One speculative decode iteration over the batch: one draft
+        dispatch proposes ``spec_k`` tokens per request, one verify
+        dispatch scores all ``k+1`` positions through the block tables,
+        and greedy acceptance emits the agreeing prefix plus the
+        target's corrected token — between 1 and ``k+1`` tokens per
+        request, all of them exactly what plain decode would emit."""
+        B = len(reqs)
+        k = self.spec_k
+        sw = self._spec
+        for req in reqs:
+            self._spec_ingest(req)
+        bucket = _next_bucket(B, self.max_batch)
+        toks = np.zeros(bucket, np.int32)
+        pos = np.zeros(bucket, np.int32)
+        tables = np.zeros((bucket, self.table_width), np.int32)
+        for i, req in enumerate(reqs):
+            toks[i] = req.tokens[-1]
+            pos[i] = req.cache_len
+            t = self.blocks.table(req.rid)
+            tables[i, :len(t)] = t
+        jp, jtab = jnp.asarray(pos), jnp.asarray(tables)
+        self._key, sub = jax.random.split(self._key)
+        with telemetry.span("serve.draft", batch=B, k=k):
+            drafted, sw.cache_k, sw.cache_v = self._draft_fn(bucket)(
+                sw.params, sw.cache_k, sw.cache_v, jnp.asarray(toks),
+                jp, jtab, sub)
+            # mxtpu-lint: disable=host-sync (designed sync point: the
+            # drafted ids feed the verify dispatch's host-built rows)
+            drafted = np.asarray(drafted)
+        rows = np.zeros((bucket, k + 1), np.int32)
+        rows[:, 0] = toks
+        rows[:, 1:] = drafted
+        fn = self._verify_fn(bucket)
+        self._key, sub = jax.random.split(self._key)
+        with telemetry.span("serve.verify", batch=B, k=k):
+            if self._cfg.numeric_watch:
+                out, ok, self._cache_k, self._cache_v = fn(
+                    self.params, self._cache_k, self._cache_v,
+                    jnp.asarray(rows), jp, jtab, sub)
+                # one batched read for tokens + watchdog flag
+                # mxtpu-lint: disable=host-sync (designed sync point:
+                # acceptance needs the target tokens on the host)
+                out, ok = jax.device_get((out, ok))
+                if not ok:
+                    flight_mod.record_anomaly(
+                        "verify_logits", step=self._step_id,
+                        batch_size=B, rids=[r.rid for r in reqs])
+            else:
+                out, self._cache_k, self._cache_v = fn(
+                    self.params, self._cache_k, self._cache_v,
+                    jnp.asarray(rows), jp, jtab, sub)
+                # mxtpu-lint: disable=host-sync (designed sync point:
+                # acceptance needs the target tokens on the host)
+                out = np.asarray(out)
+        emitted = 0
+        for i, req in enumerate(reqs):
+            accepted, emit = spec_mod.accept_greedy(drafted[i], out[i], k)
+            # the verify wrote every candidate position's K/V — the
+            # draft loop did too, so the next draft never has a gap
+            sw.note_drafted(req, int(pos[i]) + k + 1)
+            # a run that would overshoot the generation quota is capped
+            # exactly where plain decode would have stopped
+            emit = emit[:req.max_new_tokens - len(req.tokens)]
+            # acceptance accounting counts only drafts that were
+            # actually EMITTED — a quota-capped final iteration must
+            # not inflate the rate with agreed-but-discarded drafts
+            accepted = min(accepted, len(emit))
+            sw.on_verify(k, accepted)
+            self._stats.on_verify(k, accepted)
+            req.tokens.extend(emit)
+            req.cache_len += len(emit)
+            emitted += len(emit)
+            self._rtrace.event(req, "decode", batch=self._step_id,
+                               batch_size=B, tokens=len(req.tokens),
+                               emitted=len(emit), accepted=accepted)
+            self._maybe_finish(req)
+            if req.done:
+                sw.forget(req.rid)
+            else:
+                # roll back the speculative tail: blocks reserved past
+                # the accepted sequence return to the free list (never
+                # a shared prefix block — truncate stops at refcount>1)
+                self.blocks.truncate(req.rid, req.cache_len)
+        return emitted
 
     def _maybe_finish(self, req):
         if len(req.tokens) >= req.max_new_tokens:
@@ -885,6 +1095,17 @@ class Engine:
                           and 1 <= bucket <= self._chunk_cap()):
                         self._chunk_fn(
                             _next_bucket(bucket, self._chunk_cap()))
+                    elif (kind in ("verify", "draft")
+                          and self._spec is not None
+                          and 1 <= bucket <= self.max_batch):
+                        self._program(kind,
+                                      _next_bucket(bucket, self.max_batch))
+                    elif (kind == "draft_chunk"
+                          and self._spec is not None
+                          and 1 <= bucket <= self.max_model_len):
+                        self._program(
+                            "draft_chunk",
+                            _next_bucket(bucket, self.max_model_len))
                     else:
                         continue
                     ready += 1
@@ -898,15 +1119,8 @@ class Engine:
         are the powers of two below each cap PLUS the cap itself —
         ``_next_bucket`` clamps, so a non-power-of-two cap is a real
         bucket live traffic hits."""
-
-        def buckets(cap):
-            out, b = [], 1
-            while b < cap:
-                out.append(b)
-                b *= 2
-            return out + [cap]
-
-        return ([{"kind": "decode", "bucket": b}
+        buckets = self._bucket_ladder
+        grid = ([{"kind": "decode", "bucket": b}
                  for b in buckets(self.max_batch)]
                 + [{"kind": "prefill", "bucket": p}
                    for p in buckets(self.max_model_len)]
@@ -915,6 +1129,17 @@ class Engine:
                 # restart must be zero-fresh-trace for those too
                 + [{"kind": "chunk", "bucket": c}
                    for c in buckets(self._chunk_cap())])
+        if self._spec is not None:
+            # speculative decoding adds three families: the target
+            # verify pass and the draft's propose/ingest programs — a
+            # spec-enabled warm restart must be zero-fresh-trace too
+            grid += ([{"kind": "verify", "bucket": b}
+                      for b in buckets(self.max_batch)]
+                     + [{"kind": "draft", "bucket": b}
+                        for b in buckets(self.max_batch)]
+                     + [{"kind": "draft_chunk", "bucket": c}
+                        for c in buckets(self.max_model_len)])
+        return grid
 
     # -- compiled programs ---------------------------------------------------
     def _decode_fn(self, B):
@@ -925,6 +1150,32 @@ class Engine:
 
     def _chunk_fn(self, C):
         return self._program("chunk", C)
+
+    def _verify_fn(self, B):
+        return self._program("verify", B)
+
+    def _draft_fn(self, B):
+        return self._program("draft", B)
+
+    @staticmethod
+    def _bucket_ladder(cap):
+        """Power-of-two buckets up to (and always including) ``cap`` —
+        THE bucket enumeration: the warmup grid and every bucket view
+        (statusz verify_buckets) must agree with what live traffic's
+        ``_next_bucket`` clamp can hit."""
+        out, b = [], 1
+        while b < cap:
+            out.append(b)
+            b *= 2
+        return out + [cap]
+
+    def verify_buckets(self):
+        """The verify program family's bucket grid (empty when
+        speculative decoding is off) — the /statusz ``spec`` section's
+        'which programs exist' view."""
+        if self._spec is None:
+            return []
+        return self._bucket_ladder(self.max_batch)
 
     def _chunk_cap(self):
         """Largest chunk-program bucket live traffic can hit.  With
@@ -962,14 +1213,37 @@ class Engine:
             return jax.ShapeDtypeStruct(shape, dtype,
                                         sharding=sharding or sh.rep)
 
+        kspec = sds(self._key.shape, self._key.dtype)
+        if kind in ("draft", "draft_chunk"):
+            # draft-side programs: the draft checkpoint's params and
+            # its own (replicated-under-tp) cache pair, the target's
+            # table geometry
+            sw = self._spec
+            dpspec = {k: sds(v.shape, v.dtype)
+                      for k, v in sw.params.items()}
+            dcspec = sds(sw.cache_k.shape, sw.cache_k.dtype)
+            if kind == "draft":
+                return (dpspec, dcspec, dcspec, sds((bucket,), i32),
+                        sds((bucket,), i32),
+                        sds((bucket, self.table_width), i32), kspec)
+            # draft_chunk: toks, start, n_valid, table, blk, off, rng
+            return (dpspec, dcspec, dcspec, sds((bucket,), i32),
+                    sds((), i32), sds((), i32),
+                    sds((self.table_width,), i32),
+                    sds((bucket,), i32), sds((bucket,), i32), kspec)
         pspec = {k: sds(v.shape, v.dtype,
                         sh.params[k] if sh is not None else None)
                  for k, v in self.params.items()}
         cspec = sds(self._cache_k.shape, self._cache_k.dtype,
                     sh.cache if sh is not None else None)
-        kspec = sds(self._key.shape, self._key.dtype)
         if kind == "decode":
             return (pspec, cspec, cspec, sds((bucket,), i32),
+                    sds((bucket,), i32),
+                    sds((bucket, self.table_width), i32), kspec)
+        if kind == "verify":
+            # rows (B, k+1), pos0 (B,), tables (B, W), rng
+            return (pspec, cspec, cspec,
+                    sds((bucket, self.spec_k + 1), i32),
                     sds((bucket,), i32),
                     sds((bucket, self.table_width), i32), kspec)
         if kind == "chunk":
@@ -1004,6 +1278,17 @@ class Engine:
             if kind == "chunk":
                 return _build_chunk(self._cfg, bucket, self._donate,
                                     self._shardings)
+            if kind == "verify":
+                return spec_mod._build_verify(self._cfg, self.spec_k,
+                                              self._donate,
+                                              self._shardings)
+            if kind == "draft":
+                return spec_mod._build_draft(self._spec.cfg, self.spec_k,
+                                             self._donate,
+                                             self._draft_shardings)
+            if kind == "draft_chunk":
+                return _build_chunk(self._spec.cfg, bucket, self._donate,
+                                    self._draft_shardings)
             return _build_prefill(self._cfg, bucket, self._donate,
                                   self._shardings)
 

@@ -417,6 +417,44 @@ class BlockManager:
             return sum(1 for b in self._tables.get(rid, ())
                        if self._refs.get(b, 0) == 1)
 
+    def truncate(self, rid, n_tokens):
+        """Shrink ``rid``'s table to cover just ``n_tokens`` slots,
+        releasing the tail blocks — the speculative-decoding rollback
+        (rejected draft tokens' K/V lives in over-reserved tail blocks
+        that the accepted sequence no longer needs).
+
+        Bounded and share-safe by construction: only blocks BEYOND
+        ``blocks_for(n_tokens)`` are candidates, and a candidate whose
+        refcount exceeds 1 (shared through the prefix cache with
+        another live table) stops the walk — truncation can never free,
+        or even decref, a block another request still reads.  A
+        released tail block that was published (cannot happen for a
+        purely speculative tail — only accepted tokens are ever noted —
+        but guarded anyway) is unpublished before returning to the
+        free list.  Returns the number of blocks released."""
+        with self._lock:
+            table = self._tables.get(rid)
+            if table is None:
+                return 0
+            keep = max(1, blocks_for(max(1, int(n_tokens)),
+                                     self.block_size))
+            freed = 0
+            while len(table) > keep:
+                blk = table[-1]
+                if self._refs.get(blk, 0) > 1:
+                    break          # shared prefix block — never touch
+                table.pop()
+                released = self._deref(blk, retain=False)
+                if released is not None:
+                    self._free.append(released)
+                freed += 1
+            self._lens[rid] = len(table) * self.block_size
+            chain = self._chain.get(rid)
+            if chain is not None and len(chain) > len(table):
+                # the published chain can never extend past the table
+                del chain[len(table):]
+            return freed
+
     # -- publishing ----------------------------------------------------------
     def note_tokens(self, rid, token_ids):
         """Publish ``rid``'s newly-FULL blocks under their chain keys.

@@ -117,12 +117,20 @@ class Scheduler:
 
     def __init__(self, block_mgr, max_batch, max_queue,
                  max_prefills_per_step=1, clock=time.monotonic,
-                 trace=None, tenant_share=None, prefill_chunk=None):
+                 trace=None, tenant_share=None, prefill_chunk=None,
+                 spec_slots=0):
         self.blocks = block_mgr
         self.max_batch = int(max_batch)
         self.max_queue = int(max_queue)
         self.max_prefills_per_step = int(max_prefills_per_step)
         self.clock = clock
+        # speculative decoding: each decode iteration may write up to
+        # 1 + spec_slots cache positions per running request (the last
+        # token plus k drafted tokens through the verify program), so
+        # capacity checks reserve that many slots ahead instead of the
+        # plain-decode 1.  0 = plain decode (byte-for-byte the old
+        # arithmetic).
+        self.spec_slots = max(0, int(spec_slots))
         # chunked prefill: a prompt whose uncached remainder exceeds
         # this many tokens prefills one chunk per iteration instead of
         # monopolizing a step (0 = whole-prompt prefills only)
@@ -352,17 +360,26 @@ class Scheduler:
             for req in list(self.running):
                 if req not in self.running:
                     continue       # preempted as an earlier victim
+                # with speculative decoding the verify program writes
+                # up to spec_slots positions past the plain-decode one
+                # — reserve them NOW so the dispatch can never be the
+                # step that discovers the cache is full.  Capped at the
+                # request's final length: speculative positions beyond
+                # it route to the null block inside the programs, so
+                # they never need (and must never allocate — the block
+                # table has exactly max_model_len/block_size slots)
+                # real blocks
+                need = min(req.cache_len + 1 + self.spec_slots,
+                           req.target_len())
                 try:
-                    self.blocks.ensure_capacity(req.rid,
-                                                req.cache_len + 1)
+                    self.blocks.ensure_capacity(req.rid, need)
                 except NoFreeBlocks:
                     victim = self._pick_victim(req)
                     self.preempt(victim)
                     if victim is not req:
                         # retry once with the victim's blocks reclaimed
                         try:
-                            self.blocks.ensure_capacity(
-                                req.rid, req.cache_len + 1)
+                            self.blocks.ensure_capacity(req.rid, need)
                         except NoFreeBlocks:
                             self.preempt(req)
                             continue
@@ -385,7 +402,11 @@ class Scheduler:
                    and len(prefills) < self.max_prefills_per_step):
                 req = self._next_admission()
                 ids = req.prefill_ids()
-                need = ids.size + 1
+                # same target_len() cap as the decode loop above (and
+                # ids.size + 1 <= target_len() always, so the cap can
+                # never starve the plain prompt+1 reservation)
+                need = min(ids.size + 1 + self.spec_slots,
+                           req.target_len())
                 try:
                     # one call, one prefix walk: allocate prechecks the
                     # clear miss itself (nothing mutated or evicted on

@@ -1,0 +1,425 @@
+"""Draft-model speculative decoding for the serving engine.
+
+Plain continuous-batching decode emits exactly ONE token per running
+request per iteration — decode throughput is bound by one bucketed
+dispatch per token.  Speculative decoding (Leviathan et al. 2023;
+Chen et al. 2023) breaks that bound: a small *draft* model proposes
+``k`` tokens per request, and the *target* model scores all ``k+1``
+positions in ONE bucketed verify dispatch.  With greedy (temperature
+0) acceptance — keep the longest prefix of drafted tokens whose target
+argmax agrees, plus the target's own token at the first disagreement —
+the emitted stream is **provably token-identical to plain decode**: the
+target's argmax decides every emitted token, the draft only decides how
+many arrive per dispatch.
+
+Per engine iteration with ``spec_k = k`` the decode batch costs
+
+  1 draft dispatch   (``k+1`` single-token steps of the small draft
+                      model, unrolled inside one XLA program)
+  1 verify dispatch  (the target model over ``k+1`` rows per request,
+                      write-then-attend through the paged block table)
+
+and emits between 1 and ``k+1`` tokens per request — vs one target
+dispatch per token.  The win is largest where per-dispatch overhead or
+memory-bound decode dominates, exactly the serving decode hot loop.
+
+The :class:`DraftWorker` here owns the draft side: the draft
+checkpoint's parameters, its OWN (much smaller) paged K/V cache pair,
+and the per-request ingest bookkeeping.  The draft cache shares the
+target's block geometry and per-request block *tables* verbatim — the
+target's ``BlockManager`` already guarantees table disjointness, so the
+draft needs no block accounting of its own.  Draft-cache contents
+affect ONLY the acceptance rate, never the output: correctness rides
+entirely on the target's verify pass, which is why the draft side may
+lazily re-ingest context (admission, preemption-resume, prefix-cache
+hits) without any bitwise-reproducibility obligations.
+
+Rollback: the verify pass writes target K/V for all ``k+1`` candidate
+positions; after acceptance the engine truncates the request's block
+table back to the accepted length (``BlockManager.truncate``) so
+rejected drafts never hold cache blocks across iterations.  Stale K/V
+*within* kept blocks is overwritten write-then-attend before any later
+position can read it, the same argument that makes null-block garbage
+safe.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import env_float
+from ..models.generate import (detect_gpt_variant, normalize_gpt_params,
+                               reconcile_decode_config)
+from ..telemetry import flight as flight_mod
+
+__all__ = ["DraftWorker", "ENV_SPEC", "ENV_MIN_ACCEPT"]
+
+ENV_SPEC = "MXTPU_SERVE_SPEC"
+ENV_MIN_ACCEPT = "MXTPU_SPEC_MIN_ACCEPT"
+
+# rolling acceptance-rate window length (verify events); the low-
+# acceptance flight dump waits for MIN_WINDOW events before judging
+WINDOW = 256
+MIN_WINDOW = 32
+
+
+class DraftWorker:
+    """The draft-model half of speculative decoding.
+
+    Owns the draft checkpoint's device-resident parameters and its own
+    K/V cache pair shaped ``(draft_layers, num_blocks, block_size,
+    draft_kv_heads, draft_head_dim)`` — the same block geometry as the
+    target so the per-request block tables are shared verbatim.  All
+    compiled draft programs resolve through the owning engine's program
+    machinery (``_STEP_CACHE`` / AOT export store / warmup manifests),
+    keyed ``kind="draft"`` (the k-step proposal loop, bucketed over the
+    decode batch) and ``kind="draft_chunk"`` (context ingest, the chunk
+    program built over the draft config).
+
+    Mutable state is the per-request ingest ledger and the rolling
+    acceptance window; both are read by ``/statusz`` scrapes from other
+    threads, so mutations lock.
+    """
+
+    def __init__(self, engine, params, num_heads=None, window=None,
+                 symbol=None, name="gpt"):
+        if symbol is not None:
+            num_heads, window = reconcile_decode_config(symbol, num_heads,
+                                                        window)
+        if num_heads is None:
+            raise ValueError(
+                "draft num_heads is required (pass draft_num_heads=, or "
+                "draft_symbol= to read it from the draft's trained graph)")
+        window = 0 if window is None else int(window)
+        if window < 0:
+            raise ValueError(f"draft window must be >= 0 (got {window})")
+        params = normalize_gpt_params(params, name)
+        spec = detect_gpt_variant(params, num_heads, name)
+        if spec["vocab"] != engine.spec["vocab"]:
+            raise ValueError(
+                f"draft vocab ({spec['vocab']}) must match the target's "
+                f"({engine.spec['vocab']}) — drafted token ids feed the "
+                "target verify program directly")
+        if (spec["pos_table"] is not None
+                and spec["pos_table"] < engine.max_model_len):
+            raise ValueError(
+                f"draft positional table ({spec['pos_table']}) is shorter "
+                f"than max_model_len ({engine.max_model_len}) — the draft "
+                "must be able to read every position the target serves")
+        from .engine import _ModelCfg
+
+        self.name = name
+        self.cfg = _ModelCfg(
+            name=name, n_layers=spec["n_layers"],
+            num_heads=int(num_heads), head_dim=spec["head_dim"],
+            kv_heads=spec["kv_heads"], pos_table=spec["pos_table"],
+            swiglu=spec["swiglu"], tied=spec["tied"],
+            rmsnorm=spec["rmsnorm"], window=window,
+            block_size=engine.block_size,
+            # the draft ALWAYS proposes greedily; sampling acceptance
+            # (rejection sampling) is a later extension — the engine
+            # enforces temperature 0 end to end while spec is on
+            temperature=0.0, top_k=None, numeric_watch=False)
+        # place the draft weights; under tensor parallelism they
+        # replicate (the draft is small by design — sharding it would
+        # buy latency nothing and complicate the program cache keys)
+        rep = (engine._shardings.rep if engine._shardings is not None
+               else None)
+        self._owned = []
+        placed = {}
+        for k, v in params.items():
+            arr = (jax.device_put(v, rep) if rep is not None
+                   else jnp.asarray(v))
+            if arr is not v:
+                self._owned.append(arr)
+            placed[k] = arr
+        self.params = placed
+        dt = self.params[f"{name}_tok_embed_weight"].dtype
+        shape = (spec["n_layers"], engine.num_blocks, engine.block_size,
+                 spec["kv_heads"], spec["head_dim"])
+        self.cache_k = jnp.zeros(shape, dt)
+        self.cache_v = jnp.zeros(shape, dt)
+        self.min_accept = env_float(ENV_MIN_ACCEPT, 0.0)
+        self._lock = threading.Lock()
+        # rid -> (preemption epoch, draft-valid positions): which
+        # prefix of the request's context the draft cache holds.  A
+        # resume-by-recomputation bumps the epoch, forcing a full
+        # re-ingest into the request's NEW block table.
+        self._valid = {}                          # guarded-by: _lock
+        # rolling (k, accepted) per verify — the statusz acceptance
+        # window and the low-acceptance anomaly trigger
+        self._window = collections.deque(maxlen=WINDOW)  # guarded-by: _lock
+
+    # -- context ingest ------------------------------------------------------
+    def context_gap(self, req):
+        """Positions ``[0, req.cache_len)`` the draft cache does NOT
+        yet hold for ``req`` (0 when drafting can start right away)."""
+        with self._lock:
+            state = self._valid.get(req.rid)
+        if state is not None and state[0] == req.n_preemptions \
+                and state[1] >= req.cache_len:
+            return 0
+        return int(req.cache_len)
+
+    def note_ingested(self, req, n_positions):
+        with self._lock:
+            self._valid[req.rid] = (req.n_preemptions, int(n_positions))
+
+    def note_drafted(self, req, n_positions):
+        """The draft program just wrote K/V through ``n_positions``
+        (the k-step loop writes every candidate position, so the next
+        iteration never has an ingest gap whatever was accepted)."""
+        self.note_ingested(req, n_positions)
+
+    def forget(self, rid):
+        """Request left the engine (finished/cancelled): drop its
+        ingest ledger entry so the table stays bounded by the number of
+        in-flight requests."""
+        with self._lock:
+            self._valid.pop(rid, None)
+
+    def prune(self, live_rids):
+        """Drop ledger entries for rids no longer running — requests
+        that left the engine between decode iterations (preempted then
+        rejected/cancelled) never pass the per-batch ``forget`` path,
+        and the table must stay bounded by the live running set."""
+        with self._lock:
+            for rid in [r for r in self._valid if r not in live_rids]:
+                del self._valid[rid]
+
+    # -- acceptance accounting ----------------------------------------------
+    def on_verify(self, k, accepted):
+        """One verify pass proposed ``k`` tokens and the target
+        accepted ``accepted``.  Feeds the rolling window; when the
+        windowed rate sits below ``MXTPU_SPEC_MIN_ACCEPT`` the flight
+        recorder dumps (rate-limited per reason) — a silently diverging
+        draft is a perf regression nobody sees in correctness tests."""
+        with self._lock:
+            self._window.append((int(k), int(accepted)))
+            rate = self._window_rate_locked()
+            n = len(self._window)
+        if (self.min_accept > 0.0 and n >= MIN_WINDOW
+                and rate is not None and rate < self.min_accept):
+            flight_mod.recorder().dump(
+                "spec_low_acceptance",
+                extra={"accept_rate": round(rate, 4),
+                       "threshold": self.min_accept, "window": n})
+
+    def _window_rate_locked(self):
+        drafted = sum(k for k, _ in self._window)
+        if not drafted:
+            return None
+        return sum(a for _, a in self._window) / drafted
+
+    def accept_rate_window(self):
+        """Acceptance rate over the rolling window (None before any
+        verify)."""
+        with self._lock:
+            rate = self._window_rate_locked()
+        return None if rate is None else round(rate, 4)
+
+    # -- introspection -------------------------------------------------------
+    def statusz(self, engine):
+        """The engine's ``/statusz`` ``spec`` section."""
+        cfg = self.cfg
+        with self._lock:
+            window_n = len(self._window)
+            rate = self._window_rate_locked()
+            tracked = len(self._valid)
+        return {
+            "k": engine.spec_k,
+            "draft": {
+                "name": self.name,
+                "n_layers": cfg.n_layers,
+                "d_model": cfg.num_heads * cfg.head_dim,
+                "kv_heads": cfg.kv_heads,
+                "params_bytes": sum(int(v.nbytes)
+                                    for v in self.params.values()),
+                "kv_cache_bytes": 2 * int(self.cache_k.nbytes),
+            },
+            "accept_rate_window": (None if rate is None
+                                   else round(rate, 4)),
+            "window_verifies": window_n,
+            "min_accept": self.min_accept,
+            "tracked_requests": tracked,
+            "verify_buckets": engine.verify_buckets(),
+        }
+
+    def shutdown(self):
+        """Release the draft-side device buffers (mirrors
+        ``Engine.shutdown``'s exactly-what-we-placed policy)."""
+        for arr in self._owned + [self.cache_k, self.cache_v]:
+            try:
+                arr.delete()
+            except (RuntimeError, ValueError):
+                pass              # already donated-away or deleted
+        self._owned = []
+        self.cache_k = self.cache_v = None
+        self.params = None
+        with self._lock:
+            self._valid.clear()
+
+
+# -- acceptance rule (host-side, pure) ---------------------------------------
+def accept_greedy(drafted_row, target_row, k):
+    """Greedy acceptance for one request: ``drafted_row`` holds the k
+    drafted tokens, ``target_row`` the target's k+1 argmax tokens (row
+    j scored after consuming row j's input).  Returns ``(accepted,
+    emit)``: the agreeing-prefix length and the tokens to emit — the
+    accepted drafts plus the target's own token at the first
+    disagreement (or its bonus token when everything agreed).  The
+    emitted stream is exactly what plain greedy decode would produce.
+    """
+    a = 0
+    while a < k and int(drafted_row[a]) == int(target_row[a]):
+        a += 1
+    return a, [int(x) for x in drafted_row[:a]] + [int(target_row[a])]
+
+
+# -- compiled-program bodies -------------------------------------------------
+def _rope_rows(u, pos):
+    """RoPE over arbitrary leading dims: flatten rows, reuse the
+    engine's rotation, restore the shape."""
+    from .engine import _rope
+
+    lead = u.shape[:-2]
+    flat = u.reshape((-1,) + u.shape[-2:])
+    return _rope(flat, pos.reshape(-1)).reshape(
+        lead + u.shape[-2:])
+
+
+def _build_draft(cfg, k, donate, shardings=None):
+    """The k-step draft-proposal program (kind="draft", bucketed over
+    the decode batch).  Unrolls ``k+1`` single-token steps of the draft
+    model inside ONE jit: step ``j`` writes the fed token's K/V at
+    ``pos+j`` through the (target-shared) block table, attends via
+    ``paged_attention``, and its argmax feeds step ``j+1``.  Steps
+    ``0..k-1`` produce the k drafted tokens; step ``k`` is write-only —
+    it parks the last draft's K/V so the next iteration never has an
+    ingest gap even when every draft is accepted (its logits head is
+    dead code XLA eliminates).
+    """
+    from .engine import _forward_token_batch
+
+    def draft(params, ck, cv, toks, pos, tables, rng):
+        S = tables.shape[1] * cfg.block_size
+        cur = toks
+        outs = []
+        for j in range(k + 1):
+            # a step past the table's last slot writes to the null
+            # block (zeroed table row) instead of clamp-aliasing onto
+            # the request's last real block; the row's own output is
+            # garbage, but it can only ever be a beyond-quota draft
+            # the verify-side emit cap drops
+            tbl = jnp.where((pos + j < S)[:, None], tables, 0)
+            logits, ck, cv = _forward_token_batch(
+                cfg, params, ck, cv, cur, pos + j, tbl)
+            if j < k:
+                cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                outs.append(cur)
+        return jnp.stack(outs, axis=1), ck, cv
+
+    kw = {"donate_argnums": (1, 2) if donate else ()}
+    if shardings is not None:
+        rep = shardings.rep
+        kw["in_shardings"] = (rep,) * 7
+        kw["out_shardings"] = (rep, rep, rep)
+    return jax.jit(draft, **kw)
+
+
+def _build_verify(cfg, k, donate, shardings=None):
+    """The target-model verify program (kind="verify", bucketed over
+    the decode batch; ``k`` is static config).  Scores ``k+1`` rows per
+    request — the last emitted token plus the k drafts — through the
+    paged block table in one dispatch: all rows' K/V is written FIRST,
+    then each row attends to every cache position <= its own (the
+    write-then-attend trick of the decode and chunk programs, which
+    makes in-window causality exact without a dense score matrix).  The
+    attention math mirrors ``ops.attention.paged_attention`` (same
+    gather, same scale-by-multiply, same f32 softmax) so a verify row's
+    logits track what the single-token decode program would compute for
+    the same context.
+    """
+    from .engine import _fc, _ln, _logits, _mlp, _sample
+
+    name = cfg.name
+    Hq, Hkv, Dh = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    group = Hq // Hkv
+    d_model = Hq * Dh
+    window = cfg.window
+    K1 = k + 1
+    scale = 1.0 / np.sqrt(Dh)
+
+    def verify(params, ck, cv, rows, pos0, tables, rng):
+        """``rows`` (B, K1) int32 token ids; ``pos0`` (B,) the cache
+        position of each request's row 0; ``tables`` (B, W).  Returns
+        the target's (B, K1) greedy tokens (row j's token decided after
+        consuming rows 0..j)."""
+        B = rows.shape[0]
+        pos = pos0[:, None] + jnp.arange(K1)[None, :]      # (B, K1)
+        x = params[f"{name}_tok_embed_weight"][rows]       # (B, K1, D)
+        if cfg.pos_table is not None:
+            # clamp padded rows: their position may exceed the table
+            pidx = jnp.minimum(pos, cfg.pos_table - 1)
+            x = x + params[f"{name}_pos_embed_weight"][0, pidx]
+        S = tables.shape[1] * cfg.block_size
+        # candidate rows past the request's final position (a quota-
+        # capped last iteration) write to the NULL block: a clamped
+        # gather would alias them onto the LAST table slot and clobber
+        # real K/V.  Null-block garbage is never read back — the
+        # causal mask only admits logical positions backed by real
+        # blocks — and the emit cap drops those rows' tokens anyway.
+        bidx = jnp.minimum(pos // cfg.block_size, tables.shape[1] - 1)
+        blk = jnp.where(pos < S,
+                        jnp.take_along_axis(tables, bidx, axis=1), 0)
+        off = pos % cfg.block_size
+        spos = jnp.arange(S)[None, None, :]
+        keep = spos <= pos[:, :, None]                     # (B, K1, S)
+        if window:
+            keep = jnp.logical_and(keep, spos > pos[:, :, None] - window)
+        for i in range(cfg.n_layers):
+            p = f"{name}_l{i}"
+            h = _ln(x, params[f"{p}_ln1_gamma"],
+                    None if cfg.rmsnorm else params[f"{p}_ln1_beta"])
+            q = _fc(h, params[f"{p}_q_weight"], params[f"{p}_q_bias"])
+            kk = _fc(h, params[f"{p}_k_weight"], params[f"{p}_k_bias"])
+            v = _fc(h, params[f"{p}_v_weight"], params[f"{p}_v_bias"])
+            qh = q.reshape(B, K1, Hq, Dh)
+            kh = kk.reshape(B, K1, Hkv, Dh)
+            vh = v.reshape(B, K1, Hkv, Dh)
+            if cfg.pos_table is None:
+                qh, kh = _rope_rows(qh, pos), _rope_rows(kh, pos)
+            ck = ck.at[i, blk, off].set(kh)
+            cv = cv.at[i, blk, off].set(vh)
+            # every row of a request shares its table: gather the
+            # request's logical cache view once per layer, mask per
+            # row by position (paged_attention's formulation with a
+            # row axis added)
+            kb = ck[i][tables].reshape(B, S, Hkv, Dh)
+            vb = cv[i][tables].reshape(B, S, Hkv, Dh)
+            qg = qh.reshape(B, K1, Hkv, group, Dh)
+            sc = jnp.einsum("bckgd,bskd->bkgcs", qg, kb) * scale
+            sc = jnp.where(keep[:, None, None], sc,
+                           jnp.asarray(-jnp.inf, sc.dtype))
+            pr = jax.nn.softmax(sc.astype(jnp.float32),
+                                axis=-1).astype(x.dtype)
+            at = jnp.einsum("bkgcs,bskd->bckgd", pr, vb)
+            x = x + _fc(at.reshape(B, K1, d_model),
+                        params[f"{p}_proj_weight"],
+                        params[f"{p}_proj_bias"])
+            x = x + _mlp(cfg, params, p, x)
+        logits = _logits(cfg, params, x)                   # (B, K1, V)
+        tok = _sample(cfg, logits, rng)
+        if cfg.numeric_watch:
+            return tok, jnp.isfinite(logits).all(), ck, cv
+        return tok, ck, cv
+
+    from .engine import _jit_kwargs
+
+    return jax.jit(verify, **_jit_kwargs(cfg, donate, shardings, 3))

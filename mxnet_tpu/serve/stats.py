@@ -54,6 +54,22 @@ class ServeStats:
     prefix_hit_rate: float | None = None
     prefix_tokens_saved: int = 0
     prefix_evictions: int = 0
+    # speculative decoding (serve/spec.py): draft-proposed tokens and
+    # the target's accept/reject split, plus the per-verify mean run
+    # length and lifetime acceptance rate.  Zero/None with spec off.
+    # tokens_generated and the tok/s rates above are fed from ACTUAL
+    # emitted-token counts per iteration, so they stay correct when a
+    # verify step emits up to k+1 tokens per request.
+    spec_drafted_tokens: int = 0
+    spec_accepted_tokens: int = 0
+    spec_rejected_tokens: int = 0
+    spec_verifies: int = 0
+    accepted_per_verify: float | None = None
+    spec_accept_rate: float | None = None
+    # mean decode-batch occupancy over the recent-step window (decode
+    # slots scheduled / max_batch) — slot-based, so it stays honest
+    # whatever the per-slot token yield is
+    decode_occupancy: float | None = None
     # cumulative rejections by reason code (queue_full / deadline /
     # deadline_at_submit / tenant_share / exceeds_cache /
     # exceeds_max_len) — the same codes the request trace and
@@ -103,6 +119,36 @@ class StatsRecorder:
             "mxtpu_serve_prefill_tokens_computed_total",
             "prompt tokens actually run through a prefill program "
             "(prefix-cache hits never reach here)")
+        # speculative decoding: the draft/accept/reject token split —
+        # agrees with ServeStats.spec_* by construction (one feed)
+        self.spec_drafted_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_rejected_tokens = 0
+        self.spec_verifies = 0
+        self._m_spec_drafted = telemetry.counter(
+            "mxtpu_serve_spec_drafted_tokens_total",
+            "draft-model tokens proposed to the verify program")
+        self._m_spec_accepted = telemetry.counter(
+            "mxtpu_serve_spec_accepted_tokens_total",
+            "drafted tokens the target model accepted")
+        self._m_spec_rejected = telemetry.counter(
+            "mxtpu_serve_spec_rejected_tokens_total",
+            "drafted tokens the target model rejected")
+
+    def on_verify(self, drafted, accepted):
+        """One speculative verify pass: ``drafted`` tokens proposed,
+        ``accepted`` of them kept (the +1 corrected/bonus token is
+        counted by ``on_step``'s emitted total, not here)."""
+        drafted, accepted = int(drafted), int(accepted)
+        self.spec_verifies += 1
+        self.spec_drafted_tokens += drafted
+        self.spec_accepted_tokens += accepted
+        self.spec_rejected_tokens += drafted - accepted
+        self._m_spec_drafted.inc(drafted)
+        if accepted:
+            self._m_spec_accepted.inc(accepted)
+        if drafted - accepted:
+            self._m_spec_rejected.inc(drafted - accepted)
 
     def on_prefill(self, tokens_computed):
         """One prefill pass (whole prompt, suffix, or one chunk) ran
@@ -110,13 +156,17 @@ class StatsRecorder:
         self.prefill_tokens_computed += int(tokens_computed)
         self._m_prefill_tokens.inc(int(tokens_computed))
 
-    def on_step(self, new_tokens):
+    def on_step(self, new_tokens, decode_batch=0):
+        """One engine iteration emitted ``new_tokens`` tokens (the
+        ACTUAL count — a speculative verify step contributes up to
+        ``k+1`` per request) with ``decode_batch`` decode slots
+        scheduled."""
         now = self.clock()
         if self._start_t is None:
             self._start_t = now
         self.steps += 1
         self.tokens_generated += new_tokens
-        self._window.append((now, new_tokens))
+        self._window.append((now, new_tokens, int(decode_batch)))
         self._m_steps.inc()
         if new_tokens:
             self._m_tokens.inc(new_tokens)
@@ -153,8 +203,15 @@ class StatsRecorder:
         if dt <= 0:
             return None
         # the first entry's tokens predate the window's time span
-        toks = sum(n for _, n in list(self._window)[1:])
+        toks = sum(n for _, n, _ in list(self._window)[1:])
         return toks / dt
+
+    def _window_occupancy(self, max_batch):
+        """Mean decode-slot occupancy over the recent-step window."""
+        if not self._window or not max_batch:
+            return None
+        slots = sum(b for _, _, b in self._window)
+        return slots / (len(self._window) * max_batch)
 
     def snapshot(self, scheduler, blocks):
         now = self.clock()
@@ -164,6 +221,9 @@ class StatsRecorder:
             total_rate = self.tokens_generated / (now - self._start_t)
         ttft_mean = (sum(self._ttfts) / len(self._ttfts)
                      if self._ttfts else None)
+        occupancy = self._window_occupancy(scheduler.max_batch)
+        if occupancy is not None:
+            occupancy = round(occupancy, 4)
         return ServeStats(
             steps=self.steps,
             queue_depth=scheduler.queue_depth,
@@ -186,6 +246,18 @@ class StatsRecorder:
                                 if self._window_rate() else None),
             total_tok_per_sec=(round(total_rate, 1)
                                if total_rate else None),
+            spec_drafted_tokens=self.spec_drafted_tokens,
+            spec_accepted_tokens=self.spec_accepted_tokens,
+            spec_rejected_tokens=self.spec_rejected_tokens,
+            spec_verifies=self.spec_verifies,
+            accepted_per_verify=(
+                round(self.spec_accepted_tokens / self.spec_verifies, 4)
+                if self.spec_verifies else None),
+            spec_accept_rate=(
+                round(self.spec_accepted_tokens
+                      / self.spec_drafted_tokens, 4)
+                if self.spec_drafted_tokens else None),
+            decode_occupancy=occupancy,
             reject_reasons=dict(scheduler.reject_reasons),
             tenants=scheduler.tenant_stats(),
             prefill_tokens_computed=self.prefill_tokens_computed,

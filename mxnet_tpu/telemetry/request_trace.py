@@ -6,7 +6,9 @@ causality).  Every ``serve.Request`` gets a trace id and an event
 timeline —
 
   submitted → admitted/resumed → prefill_start/prefill_end →
-  decode (one per iteration: batch id, batch size, tokens so far) →
+  decode (one per iteration: batch id, batch size, tokens so far,
+  tokens emitted this iteration — up to k+1 under speculative
+  decoding, where the event also carries the accepted draft count) →
   preempted (reason) → … → finished | rejected (reason) | cancelled
 
 — recorded by the scheduler and the engine through the hooks below.

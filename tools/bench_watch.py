@@ -15,6 +15,7 @@ artifact set in priority order:
   8. tools/serve_bench.py                   -> SERVE_BENCH.json
      tools/serve_bench.py --tp 2            -> SERVE_TP_BENCH.json
      tools/serve_bench.py --workload prefix -> PREFIX_BENCH.json
+     tools/serve_bench.py --workload spec   -> SPEC_BENCH.json
   9. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
 
 Two stages need no TPU and run ahead of the probe (so chip-down rounds
@@ -507,6 +508,32 @@ def run_serve_prefix_bench(timeout=2400):
         "PREFIX_BENCH.json", timeout, validate=validate)
 
 
+def run_serve_spec_bench(timeout=2400):
+    """Draft-model speculative decoding A/B (tools/serve_bench.py
+    --workload spec) — spec-on vs spec-off over the same repeat-heavy
+    prompts: tok/s ratio, acceptance rate, and byte-identical output
+    tokens (the correctness contract greedy acceptance guarantees)."""
+
+    def validate(payload):
+        if not payload.get("tokens_identical"):
+            return "spec-on tokens differ from plain decode"
+        if (payload.get("spec_speedup") or 0) < 1.3:
+            return "spec-on under 1.3x spec-off tok/s"
+        rate = payload.get("spec_accept_rate")
+        if not rate:
+            return "no measured acceptance rate"
+        if rate >= 1.0:
+            return ("acceptance rate 1.0 — the draft never disagreed, "
+                    "so the rollback path went unmeasured")
+        return None
+
+    return run_json_artifact(
+        "serve_spec",
+        [os.path.join(REPO, "tools", "serve_bench.py"),
+         "--workload", "spec", "--max-new", "64"],
+        "SPEC_BENCH.json", timeout, validate=validate)
+
+
 def run_train_bench(timeout=1800):
     """Fused single-dispatch train step vs per-param loop
     (tools/train_bench.py) — steps/sec and per-batch host dispatch
@@ -586,6 +613,7 @@ def main():
             "longcontext": False, "bandwidth": False, "cifar": False,
             "quant": False, "decode": False, "serve": False,
             "serve_tp": False, "serve_prefix": False,
+            "serve_spec": False,
             "train_bench": False, "startup": False, "train_tier": False,
             "sweep": False}
     fails = {k: 0 for k in done}
@@ -674,6 +702,8 @@ def main():
              lambda: run_serve_tp_bench(timeout=min(2400, left))),
             ("serve_prefix",
              lambda: run_serve_prefix_bench(timeout=min(2400, left))),
+            ("serve_spec",
+             lambda: run_serve_spec_bench(timeout=min(2400, left))),
             ("train_bench", lambda: run_train_bench(timeout=min(1800, left))),
             ("startup", lambda: run_startup_bench(timeout=min(1800, left))),
             ("train_tier", lambda: run_train_tier(timeout=min(3000, left))),

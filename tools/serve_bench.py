@@ -66,6 +66,118 @@ def build_shared_prefix_workload(rng, args):
     return work
 
 
+def build_repeat_heavy_workload(rng, args):
+    """The spec workload: repeat-heavy prompts — a short random motif
+    tiled to each prompt length — cycling the mixed lengths.  Highly
+    regular continuations are where a small draft model tracks the
+    target best, i.e. the workload class speculative decoding is FOR
+    (system-prompt boilerplate, code, templated output)."""
+    import numpy as np
+
+    lens = [int(x) for x in args.prompt_lens.split(",")]
+    work = []
+    for i in range(args.requests):
+        n = lens[i % len(lens)]
+        motif = rng.randint(0, args.vocab, (max(2, n // 8),))
+        prompt = np.tile(motif, -(-n // motif.size))[:n]
+        # a short random tail breaks the pure cycle: each request gets
+        # its own transient before the continuation settles, so the
+        # draft has real chances to be WRONG (a bench where the target
+        # never disagrees would leave the rollback path unmeasured)
+        tail = max(1, n // 8)
+        prompt[-tail:] = rng.randint(0, args.vocab, (tail,))
+        work.append((prompt.astype("int32"), args.max_new))
+    return work
+
+
+def distill_family(params, layers, draft_layers, scale=0.05):
+    """A target/draft checkpoint pair for the spec A/B: the target is
+    ``params`` with every layer >= ``draft_layers`` damped (its proj /
+    ff_down residual contributions scaled by ``scale``), the draft is
+    the first ``draft_layers`` layers of that SAME checkpoint.  The
+    damped target stays a full ``layers``-deep model (every dispatch
+    costs full depth); damping just makes the truncation a *plausible*
+    draft — the well-distilled-draft situation the feature assumes —
+    instead of an uncorrelated one.  Identity never depends on this:
+    the A/B reruns the exact damped target spec-off."""
+    target = dict(params)
+    for k, v in params.items():
+        for i in range(draft_layers, layers):
+            if k.startswith(f"gpt_l{i}_") and (
+                    k.endswith("proj_weight")
+                    or k.endswith("ff_down_weight")):
+                target[k] = v * scale
+    cut = tuple(f"gpt_l{i}_" for i in range(draft_layers, layers))
+    draft = {k: v for k, v in target.items() if not k.startswith(cut)}
+    return target, draft
+
+
+def run_spec(mx, args, make_engine, workload, draft):
+    """Spec-on vs spec-off over the same repeat-heavy prompts: tok/s
+    ratio, acceptance rate — and byte-identical output tokens (the
+    acceptance bar)."""
+    conc = args.concurrency
+    k = args.spec_k
+    blocks_for = mx.serve.kv_block_manager.blocks_for
+    max_len = max(len(p) for p, _ in workload) + args.max_new
+    # headroom for the verify pass's k+1 transient slots per request
+    num_blocks = 1 + (conc + 2) * blocks_for(max_len + k + 1,
+                                             args.block_size)
+    kw = dict(num_blocks=num_blocks, max_queue=len(workload) + 1)
+    spec_kw = dict(spec_k=k, draft_params=draft,
+                   draft_num_heads=args.heads, draft_window=0, **kw)
+
+    # warm both program families (spec on/off key the program cache
+    # separately: the verify/draft/draft_chunk families only exist —
+    # and fingerprint — when spec is on)
+    for wkw in (kw, spec_kw):
+        weng = make_engine(conc, **wkw)
+        weng.warmup()
+        weng.shutdown()
+
+    def once(ekw):
+        eng = make_engine(conc, **ekw)
+        reqs, wall = run_closed(mx, eng, workload, conc)
+        st = eng.stats()
+        eng.shutdown()
+        return reqs, wall, st
+
+    off_reqs, off_wall, off_st = once(kw)
+    on_reqs, on_wall, on_st = once(spec_kw)
+    identical = all(
+        a.status == b.status == "finished" and a.tokens == b.tokens
+        for a, b in zip(off_reqs, on_reqs))
+    tps_off = (sum(len(r.tokens) for r in off_reqs) / off_wall
+               if off_wall else None)
+    tps_on = (sum(len(r.tokens) for r in on_reqs) / on_wall
+              if on_wall else None)
+    return {
+        "mode": "spec",
+        "requests": len(workload),
+        "spec_k": k,
+        "draft_layers": args.draft_layers,
+        "completed_on": sum(r.status == "finished" for r in on_reqs),
+        "completed_off": sum(r.status == "finished" for r in off_reqs),
+        "tokens_identical": identical,
+        "wall_s_on": round(on_wall, 3),
+        "wall_s_off": round(off_wall, 3),
+        "tokens_per_sec_on": round(tps_on, 1) if tps_on else None,
+        "tokens_per_sec_off": round(tps_off, 1) if tps_off else None,
+        "spec_speedup": (round(tps_on / tps_off, 2)
+                         if tps_on and tps_off else None),
+        "spec_accept_rate": on_st.spec_accept_rate,
+        "accepted_per_verify": on_st.accepted_per_verify,
+        "spec_verifies": on_st.spec_verifies,
+        "spec_drafted_tokens": on_st.spec_drafted_tokens,
+        "spec_accepted_tokens": on_st.spec_accepted_tokens,
+        "spec_rejected_tokens": on_st.spec_rejected_tokens,
+        "decode_occupancy_on": on_st.decode_occupancy,
+        "steps_on": on_st.steps,
+        "steps_off": off_st.steps,
+        "preemptions_on": on_st.preemptions,
+    }
+
+
 def run_shared_prefix(mx, args, make_engine, workload):
     """Cache-on vs cache-off over the shared-prefix workload: the
     prefill-compute ratio, hit rate, tokens saved — and byte-identical
@@ -300,7 +412,7 @@ def main():
     p.add_argument("--mode", default="closed", choices=("closed", "open"))
     p.add_argument("--workload", default="default",
                    choices=("default", "shared-prefix", "mixed-len",
-                            "prefix"),
+                            "prefix", "spec"),
                    help="default: the mixed prompt-length load. "
                         "shared-prefix: --prefixes system prompts x "
                         "--continuations suffixes, cache-on vs cache-off "
@@ -309,7 +421,11 @@ def main():
                         "--long-prompt amid short decoders, chunked vs "
                         "whole-prompt prefill (decode-stall p99 "
                         "acceptance). prefix: both prefix workloads in "
-                        "one payload -> the PREFIX_BENCH.json stage")
+                        "one payload -> the PREFIX_BENCH.json stage. "
+                        "spec: speculative decoding on vs off over the "
+                        "same repeat-heavy prompts (tok/s ratio, "
+                        "acceptance rate, token identity) -> the "
+                        "SPEC_BENCH.json stage")
     p.add_argument("--prefixes", type=int, default=4,
                    help="shared-prefix: distinct system prompts")
     p.add_argument("--continuations", type=int, default=6,
@@ -318,6 +434,15 @@ def main():
                    help="shared-prefix: shared system-prompt tokens")
     p.add_argument("--suffix-len", type=int, default=12,
                    help="shared-prefix: unique continuation tokens")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="spec: drafted tokens per verify iteration")
+    p.add_argument("--draft-layers", type=int, default=1,
+                   help="spec: layers kept in the truncated draft "
+                        "checkpoint (the target keeps all --layers)")
+    p.add_argument("--distill-scale", type=float, default=0.05,
+                   help="spec: damping on the target's above-draft "
+                        "layers — higher = a worse draft, lower "
+                        "acceptance (1.0 = undistilled)")
     p.add_argument("--long-prompt", type=int, default=2048,
                    help="mixed-len: the long prompt's token count")
     p.add_argument("--prefill-chunk", type=int, default=0,
@@ -414,6 +539,14 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     dtype = "bfloat16" if on_tpu else "float32"
     params = make_params(net, 1, S, dtype)
+    draft = None
+    if args.workload == "spec":
+        # the A/B's checkpoint pair: damped target + truncated draft
+        # (both engines below serve the SAME damped target, so the
+        # identity check compares like with like)
+        params, draft = distill_family(params, args.layers,
+                                       args.draft_layers,
+                                       scale=args.distill_scale)
 
     blocks_per_req = -(-max_len // args.block_size)
     num_blocks = args.num_blocks or (
@@ -469,6 +602,19 @@ def main():
                 rec["decode_stall_p99_ms_chunked"]
             out["stall_improvement"] = rec["stall_improvement"]
             out["stall_improved"] = rec["improved"]
+            flush(False)
+        if args.workload == "spec":
+            wl = build_repeat_heavy_workload(rng, args)
+            rec = run_spec(mx, args, make_engine, wl, draft)
+            print(json.dumps(rec))
+            pts.append(rec)
+            recs.append(rec)
+            out["spec_k"] = rec["spec_k"]
+            out["spec_speedup"] = rec["spec_speedup"]
+            out["spec_accept_rate"] = rec["spec_accept_rate"]
+            out["accepted_per_verify"] = rec["accepted_per_verify"]
+            out["tokens_per_sec_on"] = rec["tokens_per_sec_on"]
+            out["tokens_per_sec_off"] = rec["tokens_per_sec_off"]
             flush(False)
         out["tokens_identical"] = all(r["tokens_identical"] for r in recs)
         out["telemetry"] = mx.telemetry.snapshot()

@@ -16,6 +16,15 @@ reject/preempt-reason counts, and a completeness audit (a timeline that
 does not run submitted -> terminal is reported as broken; orphan events
 make the run exit non-zero under ``--check``).
 
+Decode events carry a per-iteration ``emitted`` token count: 1 in
+plain decode, up to ``k+1`` when speculative decoding is on (one
+verify dispatch emits the accepted draft run plus the target's own
+token).  The phase math is time-based so it needs no correction, but
+tokens-per-iteration is the speculative win itself — the report
+derives each request's mean accepted run length (mean tokens emitted
+per decode iteration) and aggregates it, so a production trace shows
+whether the draft model is actually earning its dispatches.
+
 Pure stdlib — usable on a laptop against a file scp'd from production.
 
 Usage:
@@ -85,6 +94,21 @@ def phase_breakdown(events):
     out["decode"] = max(0.0, out["total"] - out["queue"] - out["prefill"]
                         - out["preempted"])
     return out, status, reason, complete
+
+
+def decode_profile(events):
+    """(iterations, tokens_emitted) over a timeline's decode events.
+
+    ``emitted`` is the per-iteration token count the engine stamps on
+    every decode event (1 in plain decode, up to k+1 per speculative
+    verify); a pre-``emitted`` trace file counts 1 per event, which is
+    exactly what those engines did."""
+    iters = emitted = 0
+    for ev in events:
+        if ev.get("ev") == "decode":
+            iters += 1
+            emitted += int(ev.get("emitted", 1))
+    return iters, emitted
 
 
 def load_traces(path):
@@ -160,6 +184,8 @@ def aggregate(traces):
     phases = {p: [] for p in PHASES + ("total",)}
     statuses, reasons, broken = {}, {}, []
     preemptions = 0
+    decode_iters = decode_tokens = 0
+    run_lens = []
     for rec, ph, status, reason, complete in traces:
         if not complete:
             broken.append(rec.get("trace_id") or rec.get("rid"))
@@ -170,9 +196,27 @@ def aggregate(traces):
         if reason:
             reasons[reason] = reasons.get(reason, 0) + 1
         preemptions += int(rec.get("n_preemptions", 0))
+        iters, emitted = decode_profile(rec.get("events", []))
+        decode_iters += iters
+        decode_tokens += emitted
+        if iters:
+            run_lens.append(emitted / iters)
+    run_lens.sort()
     summary = {"requests": len(traces), "complete": len(traces) - len(broken),
                "broken": broken, "statuses": statuses,
                "reject_reasons": reasons, "preemptions": preemptions,
+               # tokens-per-decode-iteration: 1.0 everywhere in plain
+               # decode; above it, the mean accepted run length the
+               # speculative verify dispatches are earning
+               "decode_iterations": decode_iters,
+               "decode_tokens_emitted": decode_tokens,
+               "mean_run_len": (round(decode_tokens / decode_iters, 3)
+                                if decode_iters else None),
+               "mean_run_len_per_request": (
+                   round(sum(run_lens) / len(run_lens), 3)
+                   if run_lens else None),
+               "max_run_len_per_request": (round(run_lens[-1], 3)
+                                           if run_lens else None),
                "phases": {}}
     for p, vals in phases.items():
         vals.sort()
@@ -206,7 +250,12 @@ def render(summary, traces, top=0):
                  f"{k}={v}"
                  for k, v in sorted(summary["reject_reasons"].items()))
                  or "-"),
-             f"preemptions: {summary['preemptions']}", "",
+             f"preemptions: {summary['preemptions']}",
+             f"decode iterations: {summary['decode_iterations']} "
+             f"({summary['decode_tokens_emitted']} tokens, "
+             f"mean run {_fmt(summary['mean_run_len'])}, "
+             f"per-request mean "
+             f"{_fmt(summary['mean_run_len_per_request'])})", "",
              f"{'PHASE':<10} {'COUNT':>6} {'MEAN_MS':>9} {'P50_MS':>9} "
              f"{'P90_MS':>9} {'P99_MS':>9} {'MAX_MS':>9}"]
     for p in ("queue", "prefill", "decode", "preempted", "total"):
@@ -219,6 +268,8 @@ def render(summary, traces, top=0):
                          key=lambda t: -t[1]["total"])[:top]
         lines += ["", f"slowest {len(slowest)} requests:"]
         for rec, ph, status, reason, _ in slowest:
+            iters, emitted = decode_profile(rec.get("events", []))
+            run = f" run={emitted / iters:.2f}" if iters else ""
             lines.append(
                 f"  {rec.get('trace_id')}: total={ph['total'] * 1e3:.1f}ms "
                 f"queue={ph['queue'] * 1e3:.1f} "
@@ -227,7 +278,7 @@ def render(summary, traces, top=0):
                 f"preempted={ph['preempted'] * 1e3:.1f} "
                 f"[{status}{'/' + reason if reason else ''}"
                 f" gen={rec.get('generated')}"
-                f" preempt={rec.get('n_preemptions')}]")
+                f" preempt={rec.get('n_preemptions')}{run}]")
     return "\n".join(lines)
 
 
