@@ -10,11 +10,13 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..lint.annotations import hot_path
 from ..param import Params, field
 from .op import OpDef, register_op, register_simple_op
 
@@ -307,18 +309,55 @@ class FlashAttentionOp(OpDef):
 
 
 # -- paged attention (serving) -----------------------------------------------
+def paged_eligible(block_size, head_dim):
+    """Whether the Mosaic kernel's tile shapes are worth lowering for
+    this cache geometry: head_dim should fill MXU/VPU lanes (multiples
+    of 8 keep Mosaic's f32 tiling happy; 128 is the sweet spot) and the
+    per-step K/V tile is one block, so a 1-token block would crawl
+    through a 16x larger grid than the default geometry."""
+    return head_dim % 8 == 0 and block_size >= 4
+
+
+def resolve_paged_impl(block_size, head_dim, impl=None):
+    """The implementation :func:`paged_attention` will trace for this
+    cache geometry — ``"pallas"`` or ``"jnp"``.  Pure host logic (env +
+    backend + eligibility), no Pallas import: callers that key compiled
+    artifacts on the choice (serve.Engine's AOT fingerprint — an
+    exported program bakes the lowering and replays it regardless of
+    the env at load time) consult this without touching
+    ``jax.experimental.pallas``."""
+    if impl is None:
+        impl = os.environ.get("MXTPU_PAGED_ATTENTION") or "auto"
+    if impl not in ("auto", "pallas", "jnp"):
+        raise ValueError(f"paged_attention: impl must be auto|pallas|jnp "
+                         f"(got {impl!r})")
+    if impl == "jnp":
+        return "jnp"
+    from .flash_attention import _on_tpu
+    if impl == "pallas" or (_on_tpu()
+                            and paged_eligible(block_size, head_dim)):
+        return "pallas"
+    return "jnp"
+
+
+@hot_path
 def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
-                    window=0, scale=None):
+                    window=0, scale=None, k_scale=None, v_scale=None,
+                    impl=None):
     """Single-token decode attention over a paged KV-cache.
 
     The serving engine (``mxnet_tpu/serve``) keeps one fixed
     device-resident cache carved into fixed-size blocks; each request
     owns a per-request *block table* mapping its logical token
-    positions onto physical blocks.  This op gathers K/V through the
-    tables and attends each query against its own context — the
-    vLLM-style paged-attention formulation, expressed as an XLA
-    gather + masked softmax so it runs on every backend (a Mosaic
-    kernel that streams blocks from HBM is the TPU follow-up).
+    positions onto physical blocks.  Each query attends against its
+    own context through the tables — the vLLM-style paged-attention
+    formulation.  On TPU this dispatches to the Mosaic kernel in
+    ``ops/pallas_paged_attention.py`` that streams K/V blocks from HBM
+    with f32 accumulation (``impl="auto"`` default, overridable per
+    process via ``MXTPU_PAGED_ATTENTION=auto|pallas|jnp`` — the same
+    selection shape as ``flash_attention``); everywhere else it runs
+    the XLA gather + masked softmax below, which doubles as the
+    kernel's parity oracle.
 
     Args:
       q: (B, Hq, Dh) — one query token per sequence.
@@ -330,11 +369,19 @@ def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
         sequence's last block.
       context_lens: (B,) int32 — valid cache entries per sequence
         (the current token's K/V already written).  Padded table
-        entries sit beyond the context and are masked out.
+        entries sit beyond the context and are masked out.  A row with
+        0 valid entries (a dead slot in a bucketed batch) returns
+        zeros — never a fully-masked softmax's NaN.
       window: sliding-window radius (0 = full attention), matching
         the FlashAttention op's ``window`` semantics at decode: the
         query at position L-1 sees positions > L-1-window only.
       scale: score scale; default 1/sqrt(Dh).
+      k_scale/v_scale: per-slot-per-head f32 dequantization scales
+        (num_blocks, block_size, Hkv) for int8 K/V caches
+        (``MXTPU_SERVE_KV_DTYPE=int8``): the cache entry is
+        ``int8 * scale``.  Pass both or neither.
+      impl: "auto" (kernel on TPU), "pallas", or "jnp"; default the
+        ``MXTPU_PAGED_ATTENTION`` env var, else "auto".
 
     Returns (B, Hq, Dh) attention output in q's dtype.
     """
@@ -343,13 +390,35 @@ def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
     if window < 0:
         raise ValueError(f"paged_attention: window must be >= 0 "
                          f"(got {window})")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("paged_attention: k_scale and v_scale must be "
+                         "given together")
     from .flash_attention import gqa_group
     group = gqa_group(Hq, Hkv)
+    if resolve_paged_impl(bs, Dh, impl) == "pallas":
+        # deferred import: impl="jnp" is the escape hatch when the
+        # kernel (or jax.experimental.pallas itself) misbehaves, so it
+        # must not require the Pallas modules to import
+        from .pallas_paged_attention import paged_attention_kernel
+        return paged_attention_kernel(
+            q, k_cache, v_cache, block_tables, context_lens,
+            window=window, scale=scale, k_scale=k_scale,
+            v_scale=v_scale)
     scale = scale if scale is not None else 1.0 / np.sqrt(Dh)
     S = block_tables.shape[1] * bs
     # (B, W, bs, Hkv, Dh) -> (B, S, Hkv, Dh): each row's logical view
     k = k_cache[block_tables].reshape(B, S, Hkv, Dh)
     v = v_cache[block_tables].reshape(B, S, Hkv, Dh)
+    if k_scale is not None:
+        # int8 blocks dequantize through the same gathered view; the
+        # scale arrays ride the same block tables (serve/engine.py owns
+        # them alongside k_cache/v_cache)
+        k = (k.astype(jnp.float32)
+             * k_scale[block_tables].reshape(B, S, Hkv)[..., None]
+             ).astype(q.dtype)
+        v = (v.astype(jnp.float32)
+             * v_scale[block_tables].reshape(B, S, Hkv)[..., None]
+             ).astype(q.dtype)
     qg = q.reshape(B, Hkv, group, Dh)
     s = jnp.einsum("bkgd,bskd->bkgs", qg, k) * scale
     pos = jnp.arange(S)[None, :]
@@ -361,6 +430,11 @@ def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
                   jnp.asarray(-jnp.inf, s.dtype))
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
     out = jnp.einsum("bkgs,bskd->bkgd", p, v)
+    # an all-masked row's softmax is 0/0 = NaN: a bucketed batch's dead
+    # slot (context_lens == 0) must yield zeros, or one padded row
+    # poisons MXTPU_NUMERIC_WATCH's logits-finite flag for the batch
+    out = jnp.where((context_lens > 0)[:, None, None, None], out,
+                    jnp.zeros((), out.dtype))
     return out.reshape(B, Hq, Dh)
 
 

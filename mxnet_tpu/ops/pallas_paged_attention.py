@@ -1,0 +1,216 @@
+"""Paged-attention decode as a Pallas TPU kernel.
+
+The serving decode hot loop (``ops.attention.paged_attention``) is a
+jnp gather + masked softmax: XLA materializes each request's whole
+logical K/V view ``(B, S, Hkv, Dh)`` in HBM before attending, even
+though a decode step only *reads* ``context_lens`` tokens of it.  This
+kernel is the Mosaic follow-up the jnp docstring names: the grid walks
+``(batch, table_slot)`` and streams ONE physical K/V block per step
+from HBM into VMEM through the request's block table (scalar-prefetched
+so the DMA's source index is known before the body runs — the
+vLLM-PagedAttention formulation on TPU), updating flash-style running
+max / sum-exp / f32 accumulators per kv head.  No gathered copy of the
+cache ever exists; HBM traffic is exactly the live context bytes.
+
+Grouped-query attention is native: the kernel loops the (static) kv
+heads and each grid step's block fetch serves every q head of the
+group — with int8 KV blocks (``k_scale``/``v_scale`` per-slot-per-head
+f32 scales) the dequantize happens in VMEM, fused into the same pass,
+so the HBM read is the int8 bytes.
+
+Padded table rows point at the null block (id 0); their positions sit
+at or beyond ``context_lens`` so the mask (and the compute-skip guard)
+drops them, and a fully-empty row (``context_lens == 0``) never runs a
+tile — its accumulator stays zero and the output is zeros, matching
+the jnp path's empty-row guard.
+
+``interpret=True`` (automatic off-TPU) runs the kernel through the
+Pallas interpreter so the parity tests exercise the identical code
+path on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..lint.annotations import hot_path
+# the single eligibility definition lives with the dispatcher (which
+# must be importable without Pallas); re-exported here for the tests
+from .attention import paged_eligible  # noqa: F401
+from .flash_attention import _on_tpu, gqa_group
+from .pallas_util import idx32
+
+__all__ = ["paged_attention_kernel", "paged_eligible"]
+
+# np.float32, not Python floats: under jax_enable_x64 a bare literal in
+# a Mosaic kernel body is a weak f64 constant with no f64->f32 cast
+# (same rule as ops/flash_attention.py)
+_NEG_INF = np.float32(-1e30)
+_ZERO = np.float32(0.0)
+_TINY = np.float32(1e-30)
+
+
+def _kernel(bt_ref, ctx_ref, q_ref, k_ref, v_ref, *rest, scale, bs, nW,
+            Hkv, group, window, quant):
+    """One grid step (b, w): stream physical block ``bt[b, w]`` and
+    fold its ``bs`` positions into the running softmax state of every
+    kv head.  With ``quant`` the K/V refs are int8 and two
+    per-slot-per-head scale refs follow them in the input list."""
+    if quant:
+        ksc_ref, vsc_ref, o_ref, acc, m_sc, l_sc = rest
+    else:
+        (o_ref, acc, m_sc, l_sc), ksc_ref, vsc_ref = rest, None, None
+    b = pl.program_id(0)
+    w = pl.program_id(1)
+
+    @pl.when(w == 0)
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+
+    ctx = ctx_ref[b]
+    base = w * bs
+    # compute-skip: blocks entirely beyond the context (padded table
+    # rows -> the null block) or entirely below the window band
+    # contribute nothing; the DMA still ran, the math doesn't
+    live = base < ctx
+    if window:
+        live = jnp.logical_and(live, base + bs > ctx - 1 - window)
+
+    @pl.when(live)
+    def _():
+        pos = base + jax.lax.broadcasted_iota(jnp.int32, (group, bs), 1)
+        keep = pos < ctx
+        if window:
+            keep = jnp.logical_and(keep, pos > ctx - 1 - window)
+        for h in range(Hkv):
+            k = k_ref[0, :, h, :]
+            v = v_ref[0, :, h, :]
+            if quant:
+                # fused dequant in VMEM: the HBM stream was int8
+                k = k.astype(jnp.float32) * ksc_ref[0, :, h][:, None]
+                v = v.astype(jnp.float32) * vsc_ref[0, :, h][:, None]
+            q = q_ref[0, h]                              # (group, Dh)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            s = jnp.where(keep, s, _NEG_INF)
+            m_prev = m_sc[h, :, 0]
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            p = jnp.where(keep, jnp.exp(s - m_cur[:, None]), _ZERO)
+            alpha = jnp.exp(m_prev - m_cur)
+            l_cur = l_sc[h, :, 0] * alpha + jnp.sum(p, axis=-1)
+            # p cast to v's dtype keeps a bf16 cache's PV matmul on the
+            # fast MXU pass (dequantized int8 is already f32)
+            acc[h] = acc[h] * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_sc[h, :, 0] = m_cur
+            l_sc[h, :, 0] = l_cur
+
+    @pl.when(w == nW - 1)
+    def _():
+        for h in range(Hkv):
+            l_row = l_sc[h, :, 0]
+            # a fully-masked row (context_lens == 0) accumulated
+            # nothing: emit zeros, never 0/0 NaN
+            valid = l_row > _ZERO
+            l_fin = jnp.maximum(l_row, _TINY)
+            o_ref[0, h] = jnp.where(valid[:, None],
+                                    acc[h] / l_fin[:, None],
+                                    _ZERO).astype(o_ref.dtype)
+
+
+def _params(interpret):
+    """Batch rows are independent (parallel); the table-slot axis
+    carries the running-softmax scratch and must stay sequential."""
+    if interpret:
+        return {}
+    cp = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return {"compiler_params": cp(
+        dimension_semantics=("parallel", "arbitrary"))}
+
+
+@hot_path
+def paged_attention_kernel(q, k_cache, v_cache, block_tables,
+                           context_lens, window=0, scale=None,
+                           k_scale=None, v_scale=None, interpret=None):
+    """Single-token paged decode attention, block-streamed.
+
+    Same contract as ``ops.attention.paged_attention``: q ``(B, Hq,
+    Dh)``, caches ``(num_blocks, block_size, Hkv, Dh)`` (int8 when
+    ``k_scale``/``v_scale`` — ``(num_blocks, block_size, Hkv)`` f32 —
+    are given), ``block_tables (B, W)`` int32 padded with the null
+    block, ``context_lens (B,)``.  Returns ``(B, Hq, Dh)`` in q's
+    dtype.  Empty rows (``context_lens == 0``) return zeros.
+    """
+    B, Hq, Dh = q.shape
+    nb, bs, Hkv, _ = k_cache.shape
+    if window < 0:
+        raise ValueError(f"paged_attention: window must be >= 0 "
+                         f"(got {window})")
+    group = gqa_group(Hq, Hkv)
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("paged_attention: k_scale and v_scale must be "
+                         "given together (quantized K/V blocks carry "
+                         "both)")
+    quant = k_scale is not None
+    scale = np.float32(scale if scale is not None else 1.0 / np.sqrt(Dh))
+    if interpret is None:
+        interpret = not _on_tpu()
+    W = block_tables.shape[1]
+    q4 = q.reshape(B, Hkv, group, Dh)
+
+    def blk(*shape):
+        """Whole-trailing-dims block (Mosaic: the last two block dims
+        must divide the tile or equal the array dims — spanning the
+        full (Hkv, Dh) / (Hkv,) trailing axes always satisfies it)."""
+        return shape
+
+    per_req = idx32(lambda b, w, bt, ctx: (b, 0, 0, 0))
+    per_blk = idx32(lambda b, w, bt, ctx: (bt[b, w], 0, 0, 0))
+    per_blk_sc = idx32(lambda b, w, bt, ctx: (bt[b, w], 0, 0))
+    in_specs = [
+        pl.BlockSpec(blk(1, Hkv, group, Dh), per_req),
+        pl.BlockSpec(blk(1, bs, Hkv, Dh), per_blk),
+        pl.BlockSpec(blk(1, bs, Hkv, Dh), per_blk),
+    ]
+    args = [q4, k_cache, v_cache]
+    if quant:
+        in_specs += [
+            pl.BlockSpec(blk(1, bs, Hkv), per_blk_sc),
+            pl.BlockSpec(blk(1, bs, Hkv), per_blk_sc),
+        ]
+        args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, W),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(blk(1, Hkv, group, Dh), per_req),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, group, Dh), jnp.float32),
+            pltpu.VMEM((Hkv, group, 1), jnp.float32),
+            pltpu.VMEM((Hkv, group, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale, bs=bs, nW=W, Hkv=Hkv,
+                          group=group, window=int(window), quant=quant),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, group, Dh), q.dtype),
+        # mxtpu-lint: disable=host-sync (static host flag chosen at
+        # trace time — never a device value, nothing to sync)
+        interpret=bool(interpret),
+        **_params(interpret),
+    )(jnp.asarray(block_tables, jnp.int32),
+      jnp.asarray(context_lens, jnp.int32), *args)
+    return out.reshape(B, Hq, Dh)

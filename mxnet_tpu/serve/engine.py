@@ -87,11 +87,14 @@ _STEP_CACHE = {}
 # the static model/sampling config the compiled programs close over
 # (numeric_watch is part of it: the watchdog variant returns an extra
 # logits-finite flag, so it is a DIFFERENT compiled program and a
-# different AOT artifact)
+# different AOT artifact; kv_quant likewise — the int8-KV variant
+# threads two scale arrays through every program.  kv_quant=False is
+# REMOVED from the AOT fingerprint dict so a quant-off engine keeps
+# its pre-quant digests — see _aot_base_fp)
 _ModelCfg = collections.namedtuple("_ModelCfg", [
     "name", "n_layers", "num_heads", "head_dim", "kv_heads",
     "pos_table", "swiglu", "tied", "rmsnorm", "window", "block_size",
-    "temperature", "top_k", "numeric_watch"])
+    "temperature", "top_k", "numeric_watch", "kv_quant"])
 
 # per-engine GSPMD placement bundle for tensor-parallel serving (None
 # on the single-device path): the tp mesh, the per-parameter
@@ -100,8 +103,12 @@ _ModelCfg = collections.namedtuple("_ModelCfg", [
 # tables/rng.  Passed to the program builders — like _ModelCfg it holds
 # no Engine reference, so _STEP_CACHE still cannot retain a retired
 # engine's parameter dict.
+# ``scale`` is the int8-KV scale arrays' sharding (head axis, like the
+# cache); None outside kv_quant engines
 _Shardings = collections.namedtuple("_Shardings",
-                                    ["mesh", "params", "cache", "rep"])
+                                    ["mesh", "params", "cache", "rep",
+                                     "scale"],
+                                    defaults=(None,))
 
 
 def _next_bucket(n, cap):
@@ -198,6 +205,26 @@ class Engine:
         ``draft_window`` / ``draft_symbol`` mirror the target-side
         decode-config arguments; ``draft_name`` is the draft
         checkpoint's symbol-name prefix (default: the target's).
+      quantize: weight-only quantized serving (env
+        ``MXTPU_SERVE_QUANT``, default off — and off is byte-for-byte
+        inert): ``"int8"`` quantizes every matmul projection of the
+        checkpoint per-output-channel at load
+        (``contrib.quantization.quantize_weight``) and the compiled
+        programs dequantize on the fly — 4x smaller weight reads on
+        the memory-bandwidth-bound decode loop.  Embeddings, norms,
+        biases and a tied LM head stay fp.  Tokens may differ from
+        the fp engine (weight rounding); greedy agreement is gated in
+        serve_bench's quant workload.
+      kv_dtype: ``"int8"`` (env ``MXTPU_SERVE_KV_DTYPE``) stores K/V
+        cache blocks as int8 with per-slot-per-head f32 scales in a
+        small parallel array pair indexed by the same block tables —
+        roughly half (bf16) to a quarter (f32) the per-chip KV bytes,
+        so the same HBM funds proportionally more in-flight context.
+        Block accounting, the prefix cache, COW and truncate are
+        untouched (block identity never changes); every program
+        quantizes on write and dequantizes inside attention, and
+        quantization is per-slot so preemption-by-recomputation stays
+        token-stable.  Default: the parameter dtype, unquantized.
     """
 
     def __init__(self, params, num_heads=None, window=None, symbol=None,
@@ -208,7 +235,8 @@ class Engine:
                  partition_rules=None, tenant_share=None,
                  prefix_cache=None, prefill_chunk=None, spec_k=None,
                  draft_params=None, draft_num_heads=None,
-                 draft_window=None, draft_symbol=None, draft_name=None):
+                 draft_window=None, draft_symbol=None, draft_name=None,
+                 quantize=None, kv_dtype=None):
         if symbol is not None:
             num_heads, window = reconcile_decode_config(symbol, num_heads,
                                                         window)
@@ -235,6 +263,26 @@ class Engine:
         self.window = window
         self.temperature = float(temperature)
         self.top_k = top_k
+        # -- quantized serving (weight-only int8 + int8 KV blocks) ---------
+        # both default OFF and off is byte-for-byte inert: the traced
+        # programs, the warmup grid, the AOT fingerprints and every
+        # emitted token are identical to a pre-quant engine's
+        if quantize is None:
+            quantize = os.environ.get("MXTPU_SERVE_QUANT") or None
+        if quantize not in (None, "int8"):
+            raise ValueError(
+                f"quantize must be None or 'int8' (got {quantize!r})")
+        self.quantize = quantize
+        if kv_dtype is None:
+            kv_dtype = os.environ.get("MXTPU_SERVE_KV_DTYPE") or None
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None or 'int8' (got {kv_dtype!r})")
+        self._kv_quant = kv_dtype == "int8"
+        if self.quantize:
+            # per-output-channel int8 + *_wscale vectors; detection ran
+            # on the fp checkpoint, the programs dequantize on the fly
+            params = _quantize_gpt_params(params, name, self.spec)
         # -- tensor-parallel mesh + partition rules ------------------------
         self.tp = (int(tp) if tp is not None
                    else env_int("MXTPU_SERVE_TP", 1))
@@ -277,7 +325,12 @@ class Engine:
                 # bytes drop by tp
                 cache=NamedSharding(self.mesh, PartitionSpec(
                     None, None, None, "tp", None)),
-                rep=rep)
+                rep=rep,
+                # int8-KV scale arrays shard on the SAME head axis as
+                # the cache blocks they dequantize (kv_heads % tp is
+                # already enforced above)
+                scale=NamedSharding(self.mesh, PartitionSpec(
+                    None, None, None, "tp")))
         cache_tokens = (self.num_blocks - 1) * self.block_size
         if max_model_len is None:
             # learned positions cap the servable length at the table;
@@ -363,19 +416,35 @@ class Engine:
         self.params = placed
         dt = self.params[f"{name}_tok_embed_weight"].dtype
         L = self.spec["n_layers"]
+        # int8 KV blocks store quantized slots plus per-slot-per-head
+        # f32 scales in a small parallel array pair indexed by the SAME
+        # block ids — BlockManager accounting, the radix prefix cache,
+        # COW and truncate are untouched because block identity and
+        # refcounts never change
+        cache_dt = jnp.dtype(jnp.int8) if self._kv_quant else dt
         shape = (L, self.num_blocks, self.block_size,
                  self.spec["kv_heads"], self.spec["head_dim"])
+        sshape = shape[:-1]
+        self._scale_k = self._scale_v = None
         if self._shardings is not None:
             # allocate the cache BORN sharded: a jnp.zeros-then-reshard
             # would transiently hold the whole cache on device 0, which
             # OOMs exactly the aggregate-HBM-sized configs tp unlocks
-            zeros = jax.jit(lambda: jnp.zeros(shape, dt),
+            zeros = jax.jit(lambda: jnp.zeros(shape, cache_dt),
                             out_shardings=self._shardings.cache)
             self._cache_k = zeros()
             self._cache_v = zeros()
+            if self._kv_quant:
+                szeros = jax.jit(lambda: jnp.zeros(sshape, jnp.float32),
+                                 out_shardings=self._shardings.scale)
+                self._scale_k = szeros()
+                self._scale_v = szeros()
         else:
-            self._cache_k = jnp.zeros(shape, dt)
-            self._cache_v = jnp.zeros(shape, dt)
+            self._cache_k = jnp.zeros(shape, cache_dt)
+            self._cache_v = jnp.zeros(shape, cache_dt)
+            if self._kv_quant:
+                self._scale_k = jnp.zeros(sshape, jnp.float32)
+                self._scale_v = jnp.zeros(sshape, jnp.float32)
         self._key = jax.random.PRNGKey(seed)
         # donating the cache through each step avoids a full cache copy
         # per token; CPU PJRT can't donate (it would warn every call)
@@ -387,7 +456,8 @@ class Engine:
             tied=self.spec["tied"], rmsnorm=self.spec["rmsnorm"],
             window=self.window, block_size=self.block_size,
             temperature=self.temperature, top_k=self.top_k,
-            numeric_watch=self._numeric_watch)
+            numeric_watch=self._numeric_watch,
+            kv_quant=self._kv_quant)
         # draft worker last among the device placements: params, then
         # the target cache, then the (much smaller) draft side — the
         # same one-model-at-a-time HBM discipline shutdown() preserves
@@ -449,7 +519,16 @@ class Engine:
                 str(self._cache_k.dtype), self._donate, self.tp,
                 self._rules_digest, self.spec_k,
                 None if self._spec is None else
-                (self._spec.cfg, str(self._spec.cache_k.dtype)))
+                (self._spec.cfg, str(self._spec.cache_k.dtype)),
+                # weight-only quant changes the params PYTREE (the
+                # *_wscale leaves), so a quantized engine's programs
+                # must never be served to an unquantized twin
+                self.quantize,
+                # the paged-attention lowering is chosen at trace time
+                # (env + backend + geometry): a kernel-decode program
+                # must never be served to an engine whose env pinned
+                # the jnp formulation, and vice versa
+                self._paged_impl())
 
     def _aot_base_fp(self):
         """The on-disk form of _spec_key(): same fields, JSON-stable,
@@ -469,11 +548,38 @@ class Engine:
             spec_k=self.spec_k,
             draft=dict(self._spec.cfg._asdict(),
                        cache_dtype=str(self._spec.cache_k.dtype))))
+        # quant fields follow the same only-when-on rule: kv_quant=False
+        # leaves the cfg dict (and cache_dtype) exactly as pre-quant
+        # releases emitted them, and weight-only off adds no key — an
+        # upgraded quant-off fleet keeps its artifacts and manifests
+        cfg_d = {k: v for k, v in self._cfg._asdict().items()
+                 if k != "kv_quant" or v}
+        draft_d = spec.get("draft")
+        if draft_d is not None and not draft_d.get("kv_quant"):
+            del draft_d["kv_quant"]
+        quant = {} if not self.quantize else dict(quantize=self.quantize)
+        # the Mosaic paged-decode kernel follows the only-when-on rule
+        # too: "jnp" is the historical program (digests keep), but an
+        # exported artifact BAKES the lowering and replays it whatever
+        # the env says at load — without this key, a TPU fleet that
+        # upgrades into the kernel (or escapes it via
+        # MXTPU_PAGED_ATTENTION=jnp after a kernel bug) would silently
+        # warm-load the other implementation's artifacts forever
+        paged = ({} if self._paged_impl() != "pallas"
+                 else dict(paged_attention="pallas"))
         return aot_store.fingerprint(
-            subsystem="serve", cfg=self._cfg._asdict(),
+            subsystem="serve", cfg=cfg_d,
             num_blocks=self.num_blocks, table_width=self.table_width,
             cache_dtype=str(self._cache_k.dtype), donate=self._donate,
-            **sharded, **spec)
+            **sharded, **spec, **quant, **paged)
+
+    def _paged_impl(self):
+        """The paged-attention implementation this engine's programs
+        trace ("pallas" or "jnp") — resolved from the env/backend/cache
+        geometry exactly as ``ops.attention.paged_attention`` will."""
+        from ..ops.attention import resolve_paged_impl
+        return resolve_paged_impl(self.block_size,
+                                  self.spec["head_dim"])
 
     # -- public API ----------------------------------------------------------
     def submit(self, prompt, max_new_tokens=64, deadline_s=None,
@@ -685,6 +791,9 @@ class Engine:
             # cache-cold replica (also nested in kv_blocks.prefix_cache)
             "prefix_cache": self.blocks.prefix_stats(),
             "kv_cache": self.kv_cache_stats(),
+            # quantized serving: which of the two int8 modes are live
+            # (None when both are off — the inert default)
+            "quant": self.quant_info(),
             "sharding": self.sharding_info(),
             # speculative decoding: k, the draft model's shape/bytes,
             # the rolling acceptance rate and the verify bucket grid
@@ -702,6 +811,25 @@ class Engine:
             "numeric_watch": self._numeric_watch,
             "aot": aot,
         }
+
+    def quant_info(self):
+        """The ``/statusz`` ``quant`` section: weight-only mode, KV
+        dtype, and the byte savings each one buys (None when quantized
+        serving is off entirely)."""
+        if not self.quantize and not self._kv_quant:
+            return None
+        info = {"weights": self.quantize,
+                "kv_dtype": str(self._cache_k.dtype)
+                if self._cache_k is not None else None}
+        if self.quantize and self.params:
+            info["quantized_weights"] = sum(
+                1 for k in self.params if k.endswith("_wscale"))
+            info["weight_bytes"] = sum(
+                int(v.nbytes) for k, v in self.params.items()
+                if k.endswith("_weight") or k.endswith("_wscale"))
+        if self._kv_quant and self._scale_k is not None:
+            info["kv_scale_bytes"] = 2 * int(self._scale_k.nbytes)
+        return info
 
     def sharding_info(self):
         """Live sharding layout: tp degree, mesh shape/devices, rule
@@ -731,11 +859,23 @@ class Engine:
         total = 2 * int(self._cache_k.nbytes)          # K and V
         per_dev = total // self.tp
         per_block = per_dev // self.num_blocks
-        return {"bytes_total": total,
-                "bytes_per_device": per_dev,
-                "bytes_per_block_per_device": per_block,
-                "bytes_in_use_per_device":
-                    per_block * self.blocks.blocks_in_use}
+        out = {"bytes_total": total,
+               "bytes_per_device": per_dev,
+               "bytes_per_block_per_device": per_block,
+               "bytes_in_use_per_device":
+                   per_block * self.blocks.blocks_in_use,
+               "dtype": str(self._cache_k.dtype)}
+        if self._kv_quant:
+            # the dequantization scales are real HBM too: the honest
+            # per-chip KV footprint is bytes + scale_bytes — an f32
+            # scale per head_dim int8 elements, so the reduction is
+            # dtype_bytes / (1 + 4/head_dim): at head_dim 64 that is
+            # 3.76x from f32 and 1.88x from bf16 (the CPU smoke's
+            # 3.56x is f32 at head_dim 32)
+            sb = 2 * int(self._scale_k.nbytes)
+            out["scale_bytes_total"] = sb
+            out["scale_bytes_per_device"] = sb // self.tp
+        return out
 
     def shutdown(self):
         """Cancel in-flight work and release the device cache.
@@ -760,17 +900,38 @@ class Engine:
         if self._spec is not None:
             self._spec.shutdown()
             self._spec = None
-        for arr in self._owned + [self._cache_k, self._cache_v]:
+        for arr in (self._owned + [self._cache_k, self._cache_v]
+                    + ([self._scale_k, self._scale_v]
+                       if self._scale_k is not None else [])):
             try:
                 arr.delete()
             except (RuntimeError, ValueError):
                 pass              # already donated-away or deleted
         self._owned = []
         self._cache_k = self._cache_v = None
+        self._scale_k = self._scale_v = None
         self.params = None            # free the device-resident weights
         self._alive = False
 
     # -- execution -----------------------------------------------------------
+    def _cache_args(self):
+        """The device cache operands every target-model program takes:
+        (k, v) — plus the int8-KV scale pair when quantized (the same
+        order the program builders and ``_program_specs`` use)."""
+        if self._kv_quant:
+            return (self._cache_k, self._cache_v,
+                    self._scale_k, self._scale_v)
+        return (self._cache_k, self._cache_v)
+
+    def _set_caches(self, arrs):
+        """Adopt a program's returned (donated-through) cache operands
+        — the tail of its output tuple, mirroring :meth:`_cache_args`."""
+        if self._kv_quant:
+            (self._cache_k, self._cache_v,
+             self._scale_k, self._scale_v) = arrs
+        else:
+            self._cache_k, self._cache_v = arrs
+
     def _slots(self, table, n, pad_to):
         """(block, offset) scatter targets for logical slots [0, n),
         padded to ``pad_to`` with null-block writes."""
@@ -814,7 +975,7 @@ class Engine:
             toks[:n] = ids
             blk, off = self._slots(self.blocks.table(req.rid), n, bucket)
             fn = self._prefill_fn(bucket)
-            args = (self.params, self._cache_k, self._cache_v,
+            args = (self.params,) + self._cache_args() + (
                     jnp.asarray(toks), jnp.asarray(n, jnp.int32),
                     jnp.asarray(blk), jnp.asarray(off), sub)
         else:
@@ -834,12 +995,14 @@ class Engine:
             off = ((start + np.arange(bucket))
                    % self.block_size).astype(np.int32)
             fn = self._chunk_fn(bucket)
-            args = (self.params, self._cache_k, self._cache_v,
+            args = (self.params,) + self._cache_args() + (
                     jnp.asarray(toks), jnp.asarray(start, jnp.int32),
                     jnp.asarray(span, jnp.int32), jnp.asarray(tw),
                     jnp.asarray(blk), jnp.asarray(off), sub)
+        outs = fn(*args)
         if self._cfg.numeric_watch:
-            tok, ok, self._cache_k, self._cache_v = fn(*args)
+            tok, ok = outs[0], outs[1]
+            self._set_caches(outs[2:])
             # one batched read: the sampled token must reach the host
             # anyway, so the watchdog flag rides the same sync instead
             # of forcing a second one
@@ -850,7 +1013,8 @@ class Engine:
                 flight_mod.record_anomaly("prefill_logits", rid=req.rid,
                                           step=self._step_id)
         else:
-            tok, self._cache_k, self._cache_v = fn(*args)
+            tok = outs[0]
+            self._set_caches(outs[1:])
         req.cache_len = end
         self._stats.on_prefill(span)
         # publish the newly-FULL blocks under their chain keys so later
@@ -890,11 +1054,12 @@ class Engine:
             tables[i, :len(t)] = t
         fn = self._decode_fn(bucket)
         self._key, sub = jax.random.split(self._key)
+        outs = fn(self.params, *self._cache_args(),
+                  jnp.asarray(toks), jnp.asarray(pos),
+                  jnp.asarray(tables), sub)
         if self._cfg.numeric_watch:
-            out, ok, self._cache_k, self._cache_v = fn(
-                self.params, self._cache_k, self._cache_v,
-                jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables),
-                sub)
+            out, ok = outs[0], outs[1]
+            self._set_caches(outs[2:])
             # one batched read for tokens + watchdog flag (not a
             # bool(ok) stall followed by a second asarray stall)
             # mxtpu-lint: disable=host-sync (designed sync point: the
@@ -905,10 +1070,8 @@ class Engine:
                     "decode_logits", step=self._step_id, batch_size=B,
                     rids=[r.rid for r in reqs])
         else:
-            out, self._cache_k, self._cache_v = fn(
-                self.params, self._cache_k, self._cache_v,
-                jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables),
-                sub)
+            out = outs[0]
+            self._set_caches(outs[1:])
             # mxtpu-lint: disable=host-sync (designed sync point: the
             # scheduler needs the sampled tokens on the host)
             out = np.asarray(out)
@@ -994,10 +1157,11 @@ class Engine:
         fn = self._verify_fn(bucket)
         self._key, sub = jax.random.split(self._key)
         with telemetry.span("serve.verify", batch=B, k=k):
+            outs = fn(self.params, *self._cache_args(),
+                      jnp.asarray(rows), jp, jtab, sub)
             if self._cfg.numeric_watch:
-                out, ok, self._cache_k, self._cache_v = fn(
-                    self.params, self._cache_k, self._cache_v,
-                    jnp.asarray(rows), jp, jtab, sub)
+                out, ok = outs[0], outs[1]
+                self._set_caches(outs[2:])
                 # one batched read for tokens + watchdog flag
                 # mxtpu-lint: disable=host-sync (designed sync point:
                 # acceptance needs the target tokens on the host)
@@ -1007,9 +1171,8 @@ class Engine:
                         "verify_logits", step=self._step_id,
                         batch_size=B, rids=[r.rid for r in reqs])
             else:
-                out, self._cache_k, self._cache_v = fn(
-                    self.params, self._cache_k, self._cache_v,
-                    jnp.asarray(rows), jp, jtab, sub)
+                out = outs[0]
+                self._set_caches(outs[1:])
                 # mxtpu-lint: disable=host-sync (designed sync point:
                 # acceptance needs the target tokens on the host)
                 out = np.asarray(out)
@@ -1236,23 +1399,31 @@ class Engine:
                  for k, v in self.params.items()}
         cspec = sds(self._cache_k.shape, self._cache_k.dtype,
                     sh.cache if sh is not None else None)
+        # int8-KV engines thread the two scale arrays right after the
+        # caches in every target-model program (same order as
+        # _cache_args)
+        caches = (cspec, cspec)
+        if self._kv_quant:
+            sspec = sds(self._scale_k.shape, self._scale_k.dtype,
+                        sh.scale if sh is not None else None)
+            caches = (cspec, cspec, sspec, sspec)
         if kind == "decode":
-            return (pspec, cspec, cspec, sds((bucket,), i32),
+            return (pspec,) + caches + (sds((bucket,), i32),
                     sds((bucket,), i32),
                     sds((bucket, self.table_width), i32), kspec)
         if kind == "verify":
             # rows (B, k+1), pos0 (B,), tables (B, W), rng
-            return (pspec, cspec, cspec,
+            return (pspec,) + caches + (
                     sds((bucket, self.spec_k + 1), i32),
                     sds((bucket,), i32),
                     sds((bucket, self.table_width), i32), kspec)
         if kind == "chunk":
             # toks, start, n_valid, table, blk, off, rng
-            return (pspec, cspec, cspec, sds((bucket,), i32),
+            return (pspec,) + caches + (sds((bucket,), i32),
                     sds((), i32), sds((), i32),
                     sds((self.table_width,), i32),
                     sds((bucket,), i32), sds((bucket,), i32), kspec)
-        return (pspec, cspec, cspec, sds((bucket,), i32), sds((), i32),
+        return (pspec,) + caches + (sds((bucket,), i32), sds((), i32),
                 sds((bucket,), i32), sds((bucket,), i32), kspec)
 
     def _resolve_program(self, kind, bucket):
@@ -1324,8 +1495,86 @@ class Engine:
         # both the cold and the warm process execute the round-tripped
         # module, so the XLA compile below has the same persistent-cache
         # key in both — a warm start's compile is a disk read
+        n_caches = (4 if self._cfg.kv_quant
+                    and kind not in ("draft", "draft_chunk") else 2)
         return compiled(jax.jit(
-            exported.call, donate_argnums=(1, 2) if self._donate else ()))
+            exported.call,
+            donate_argnums=(tuple(range(1, 1 + n_caches))
+                            if self._donate else ())))
+
+
+# -- quantized serving helpers ------------------------------------------------
+def _quantize_gpt_params(params, name, spec):
+    """Weight-only int8 at load: every matmul projection of the
+    normalized gpt() checkpoint gets per-output-channel symmetric int8
+    weights (``contrib.quantization.quantize_weight``) plus a
+    ``*_wscale`` f32 vector that ``_wfc`` dequantizes on the fly —
+    4x smaller weight reads on the decode hot loop, the
+    ``ops/quantized.py`` weight-only convention.  Embeddings, norms
+    and biases stay fp; a tied LM head IS the embedding matrix, so it
+    stays fp too (quantizing it would also perturb every input
+    embedding lookup)."""
+    from ..contrib.quantization import quantize_weight
+
+    out = dict(params)
+    stems = []
+    for i in range(spec["n_layers"]):
+        p = f"{name}_l{i}"
+        stems += [f"{p}_q", f"{p}_k", f"{p}_v", f"{p}_proj",
+                  f"{p}_ff_up", f"{p}_ff_down"]
+        if spec["swiglu"]:
+            stems.append(f"{p}_ff_gate")
+    if not spec["tied"]:
+        stems.append(f"{name}_head")
+    for stem in stems:
+        w = out.get(f"{stem}_weight")
+        if w is None:
+            continue
+        # mxtpu-lint: disable=host-sync (load path, runs once at
+        # engine construction: the checkpoint must reach the host to
+        # quantize before placement)
+        wq, sc = quantize_weight(np.asarray(w, np.float32))
+        out[f"{stem}_weight"] = wq
+        out[f"{stem}_wscale"] = sc
+    return out
+
+
+def _wfc(params, stem, x):
+    """``_fc`` through a possibly weight-only-int8 checkpoint entry:
+    when ``<stem>_wscale`` exists the int8 weight dequantizes on the
+    fly (``ops/quantized.py``'s weight-only mode — activation-dtype
+    math, 4x smaller weight reads); without it this is exactly
+    ``_fc`` on the fp entry, so quant-off traced programs are
+    byte-for-byte what they were before quantized serving existed."""
+    w = params[f"{stem}_weight"]
+    sc = params.get(f"{stem}_wscale")
+    if sc is not None:
+        w = w.astype(x.dtype) * sc.astype(x.dtype)[:, None]
+    return _fc(x, w, params[f"{stem}_bias"])
+
+
+def _kv_quant_vals(vals):
+    """Per-slot-per-head symmetric int8 for K/V rows ``(..., Hkv, Dh)``
+    -> ``(int8 rows, f32 scales (..., Hkv))``.  Each written slot
+    quantizes independently over its own head vector, so the cache
+    contents are a pure function of the fp values written — write
+    ORDER cannot change them, which is what keeps preemption-by-
+    recomputation and chunked re-prefill token-stable under int8 KV
+    (a block-granular scale would re-scale earlier slots on every
+    later write).  Zero vectors keep scale 1.0, ``quantize_weight``'s
+    convention, so untouched cache stays exactly zero."""
+    vf = vals.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(vf), axis=-1)
+    sc = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(vf / sc[..., None]), -127, 127).astype(jnp.int8)
+    return q, sc
+
+
+def _kv_dequant(q, sc, dtype):
+    """Invert :func:`_kv_quant_vals`: ``(..., Hkv, Dh)`` int8 plus
+    ``(..., Hkv)`` scales -> fp rows in ``dtype``."""
+    return (q.astype(jnp.float32)
+            * sc.astype(jnp.float32)[..., None]).astype(dtype)
 
 
 # -- compiled-program bodies (close over _ModelCfg ONLY — never an
@@ -1347,17 +1596,13 @@ def _mlp(cfg, params, p, x):
     h2 = _ln(x, params[f"{p}_ln2_gamma"],
              None if cfg.rmsnorm else params[f"{p}_ln2_beta"])
     if cfg.swiglu:
-        g = _fc(h2, params[f"{p}_ff_gate_weight"],
-                params[f"{p}_ff_gate_bias"])
+        g = _wfc(params, f"{p}_ff_gate", h2)
         gf = g.astype(jnp.float32)               # f32 silu == sym.silu
         up = ((gf * jax.nn.sigmoid(gf)).astype(g.dtype)
-              * _fc(h2, params[f"{p}_ff_up_weight"],
-                    params[f"{p}_ff_up_bias"]))
+              * _wfc(params, f"{p}_ff_up", h2))
     else:
-        up = _gelu(_fc(h2, params[f"{p}_ff_up_weight"],
-                       params[f"{p}_ff_up_bias"]))
-    return _fc(up, params[f"{p}_ff_down_weight"],
-               params[f"{p}_ff_down_bias"])
+        up = _gelu(_wfc(params, f"{p}_ff_up", h2))
+    return _wfc(params, f"{p}_ff_down", up)
 
 
 def _logits(cfg, params, x):
@@ -1367,13 +1612,15 @@ def _logits(cfg, params, x):
     if cfg.tied:
         return final @ params[f"{name}_tok_embed_weight"].T.astype(
             final.dtype)
-    return _fc(final, params[f"{name}_head_weight"],
-               params[f"{name}_head_bias"])
+    return _wfc(params, f"{name}_head", final)
 
 
-def _forward_token_batch(cfg, params, ck, cv, toks, pos, tables):
+def _forward_token_batch(cfg, params, ck, cv, ksc, vsc, toks, pos, tables):
     """Shared decode math: write each row's K/V at its position,
-    attend through the block tables, return logits (B, V)."""
+    attend through the block tables, return logits (B, V).  With
+    ``cfg.kv_quant`` the caches are int8 and ``ksc``/``vsc`` carry the
+    per-slot-per-head f32 scales (None otherwise): writes quantize,
+    attention dequantizes through the same tables."""
     name = cfg.name
     Hq, Hkv, Dh = cfg.num_heads, cfg.kv_heads, cfg.head_dim
     d_model = Hq * Dh
@@ -1389,56 +1636,91 @@ def _forward_token_batch(cfg, params, ck, cv, toks, pos, tables):
         p = f"{name}_l{i}"
         h = _ln(x, params[f"{p}_ln1_gamma"],
                 None if cfg.rmsnorm else params[f"{p}_ln1_beta"])
-        q = _fc(h, params[f"{p}_q_weight"], params[f"{p}_q_bias"])
-        k = _fc(h, params[f"{p}_k_weight"], params[f"{p}_k_bias"])
-        v = _fc(h, params[f"{p}_v_weight"], params[f"{p}_v_bias"])
+        q = _wfc(params, f"{p}_q", h)
+        k = _wfc(params, f"{p}_k", h)
+        v = _wfc(params, f"{p}_v", h)
         qh = q.reshape(B, Hq, Dh)
         kh = k.reshape(B, Hkv, Dh)
         vh = v.reshape(B, Hkv, Dh)
         if cfg.pos_table is None:
             qh, kh = _rope(qh, pos), _rope(kh, pos)
-        ck = ck.at[i, blk, off].set(kh)
-        cv = cv.at[i, blk, off].set(vh)
-        attn = paged_attention(qh, ck[i], cv[i], tables, ctx,
-                               window=cfg.window)
-        x = x + _fc(attn.reshape(B, d_model),
-                    params[f"{p}_proj_weight"],
-                    params[f"{p}_proj_bias"])
+        if cfg.kv_quant:
+            kq, ks = _kv_quant_vals(kh)
+            vq, vs = _kv_quant_vals(vh)
+            ck = ck.at[i, blk, off].set(kq)
+            ksc = ksc.at[i, blk, off].set(ks)
+            cv = cv.at[i, blk, off].set(vq)
+            vsc = vsc.at[i, blk, off].set(vs)
+            attn = paged_attention(qh, ck[i], cv[i], tables, ctx,
+                                   window=cfg.window,
+                                   k_scale=ksc[i], v_scale=vsc[i])
+        else:
+            ck = ck.at[i, blk, off].set(kh)
+            cv = cv.at[i, blk, off].set(vh)
+            attn = paged_attention(qh, ck[i], cv[i], tables, ctx,
+                                   window=cfg.window)
+        x = x + _wfc(params, f"{p}_proj", attn.reshape(B, d_model))
         x = x + _mlp(cfg, params, p, x)
-    return _logits(cfg, params, x), ck, cv
+    return _logits(cfg, params, x), ck, cv, ksc, vsc
+
+
+def _split_cache_args(cfg, rest):
+    """Unpack a program's post-params positional args: the cache
+    operands (2, or 4 with int8-KV scales) then the host-fed args.
+    Returns ``(ck, cv, ksc, vsc, tail)`` with None scales when not
+    quantized — the builders' one place to agree with _cache_args."""
+    if cfg.kv_quant:
+        return rest[0], rest[1], rest[2], rest[3], rest[4:]
+    return rest[0], rest[1], None, None, rest[2:]
+
+
+def _cache_outs(cfg, ck, cv, ksc, vsc):
+    """The cache tail of a program's output tuple (mirrors
+    :func:`_split_cache_args`)."""
+    if cfg.kv_quant:
+        return (ck, cv, ksc, vsc)
+    return (ck, cv)
 
 
 def _jit_kwargs(cfg, donate, shardings, n_token_args):
     """Shared jit options for the bucket programs.  With a tp mesh the
     in/out shardings are pinned explicitly — params per the partition
-    rules, KV-cache head-sharded, everything host-fed replicated — so
-    GSPMD partitions the program (inserting the two all-reduces per
-    layer) instead of inferring a layout per call site."""
-    kw = {"donate_argnums": (1, 2) if donate else ()}
+    rules, KV-cache head-sharded (scale arrays too, under int8 KV),
+    everything host-fed replicated — so GSPMD partitions the program
+    (inserting the two all-reduces per layer) instead of inferring a
+    layout per call site."""
+    n_caches = 4 if cfg.kv_quant else 2
+    kw = {"donate_argnums": (tuple(range(1, 1 + n_caches))
+                             if donate else ())}
     if shardings is not None:
         rep = shardings.rep
-        cache = shardings.cache
-        kw["in_shardings"] = ((shardings.params, cache, cache)
+        caches = (shardings.cache,) * 2
+        if cfg.kv_quant:
+            caches += (shardings.scale,) * 2
+        kw["in_shardings"] = ((shardings.params,) + caches
                               + (rep,) * n_token_args + (rep,))
-        out = (rep, cache, cache)
+        out = (rep,) + caches
         if cfg.numeric_watch:
-            out = (rep, rep, cache, cache)
+            out = (rep, rep) + caches
         kw["out_shardings"] = out
     return kw
 
 
 def _build_decode(cfg, donate, shardings=None):
-    def decode(params, ck, cv, toks, pos, tables, rng):
-        logits, ck, cv = _forward_token_batch(cfg, params, ck, cv,
-                                              toks, pos, tables)
+    def decode(params, *rest):
+        ck, cv, ksc, vsc, (toks, pos, tables, rng) = \
+            _split_cache_args(cfg, rest)
+        logits, ck, cv, ksc, vsc = _forward_token_batch(
+            cfg, params, ck, cv, ksc, vsc, toks, pos, tables)
         tok = _sample(cfg, logits, rng)
+        caches = _cache_outs(cfg, ck, cv, ksc, vsc)
         if cfg.numeric_watch:
             # one extra all-reduce over the logits: the watchdog flag
             # rides back with the sampled tokens (the host syncs on
             # them anyway), so a NaN fires the flight recorder instead
             # of silently poisoning every later token
-            return tok, jnp.isfinite(logits).all(), ck, cv
-        return tok, ck, cv
+            return (tok, jnp.isfinite(logits).all()) + caches
+        return (tok,) + caches
 
     return jax.jit(decode, **_jit_kwargs(cfg, donate, shardings, 3))
 
@@ -1450,10 +1732,12 @@ def _build_prefill(cfg, P, donate, shardings=None):
     d_model = Hq * Dh
     window = cfg.window
 
-    def prefill(params, ck, cv, toks, plen, blk, off, rng):
+    def prefill(params, *rest):
         """Whole-prompt pass at padded length P for ONE request:
         writes K/V for positions [0, plen) through the block
         table and samples the token after position plen-1."""
+        ck, cv, ksc, vsc, (toks, plen, blk, off, rng) = \
+            _split_cache_args(cfg, rest)
         pos = jnp.arange(P)
         x = params[f"{name}_tok_embed_weight"][toks]       # (P, D)
         if cfg.pos_table is not None:
@@ -1467,16 +1751,30 @@ def _build_prefill(cfg, P, donate, shardings=None):
             p = f"{name}_l{i}"
             h = _ln(x, params[f"{p}_ln1_gamma"],
                     None if cfg.rmsnorm else params[f"{p}_ln1_beta"])
-            q = _fc(h, params[f"{p}_q_weight"], params[f"{p}_q_bias"])
-            k = _fc(h, params[f"{p}_k_weight"], params[f"{p}_k_bias"])
-            v = _fc(h, params[f"{p}_v_weight"], params[f"{p}_v_bias"])
+            q = _wfc(params, f"{p}_q", h)
+            k = _wfc(params, f"{p}_k", h)
+            v = _wfc(params, f"{p}_v", h)
             qh = q.reshape(P, Hq, Dh)
             kh = k.reshape(P, Hkv, Dh)
             vh = v.reshape(P, Hkv, Dh)
             if cfg.pos_table is None:
                 qh, kh = _rope(qh, pos), _rope(kh, pos)
-            ck = ck.at[i, blk, off].set(kh)
-            cv = cv.at[i, blk, off].set(vh)
+            if cfg.kv_quant:
+                kq, ks = _kv_quant_vals(kh)
+                vq, vs = _kv_quant_vals(vh)
+                ck = ck.at[i, blk, off].set(kq)
+                ksc = ksc.at[i, blk, off].set(ks)
+                cv = cv.at[i, blk, off].set(vq)
+                vsc = vsc.at[i, blk, off].set(vs)
+                # attend to the DEQUANTIZED values: every path must
+                # see the cache's int8 round-trip, or a later chunk /
+                # decode step reading the cache would diverge from the
+                # hidden states this very pass computed
+                kh = _kv_dequant(kq, ks, x.dtype)
+                vh = _kv_dequant(vq, vs, x.dtype)
+            else:
+                ck = ck.at[i, blk, off].set(kh)
+                cv = cv.at[i, blk, off].set(vh)
             # grouped-query dense causal attention within the
             # prompt (same head grouping as paged_attention)
             qg = qh.reshape(P, Hkv, group, Dh)
@@ -1487,15 +1785,14 @@ def _build_prefill(cfg, P, donate, shardings=None):
             pr = jax.nn.softmax(sc.astype(jnp.float32),
                                 axis=-1).astype(x.dtype)
             at = jnp.einsum("kgqs,skd->qkgd", pr, vh)
-            x = x + _fc(at.reshape(P, d_model),
-                        params[f"{p}_proj_weight"],
-                        params[f"{p}_proj_bias"])
+            x = x + _wfc(params, f"{p}_proj", at.reshape(P, d_model))
             x = x + _mlp(cfg, params, p, x)
         logits = _logits(cfg, params, x[plen - 1][None])
         tok = _sample(cfg, logits, rng)[0]
+        caches = _cache_outs(cfg, ck, cv, ksc, vsc)
         if cfg.numeric_watch:
-            return tok, jnp.isfinite(logits).all(), ck, cv
-        return tok, ck, cv
+            return (tok, jnp.isfinite(logits).all()) + caches
+        return (tok,) + caches
 
     return jax.jit(prefill, **_jit_kwargs(cfg, donate, shardings, 4))
 
@@ -1514,11 +1811,13 @@ def _build_chunk(cfg, C, donate, shardings=None):
     d_model = Hq * Dh
     window = cfg.window
 
-    def chunk(params, ck, cv, toks, start, n_valid, table, blk, off, rng):
+    def chunk(params, *rest):
         """Rows hold positions [start, start+n_valid) (rows past
         n_valid are padding: they write into the null block and their
         outputs are discarded).  Samples the token after position
         start+n_valid-1 — meaningful on the final chunk only."""
+        ck, cv, ksc, vsc, (toks, start, n_valid, table, blk, off, rng) = \
+            _split_cache_args(cfg, rest)
         pos = start + jnp.arange(C)
         x = params[f"{name}_tok_embed_weight"][toks]       # (C, D)
         if cfg.pos_table is not None:
@@ -1534,20 +1833,33 @@ def _build_chunk(cfg, C, donate, shardings=None):
             p = f"{name}_l{i}"
             h = _ln(x, params[f"{p}_ln1_gamma"],
                     None if cfg.rmsnorm else params[f"{p}_ln1_beta"])
-            q = _fc(h, params[f"{p}_q_weight"], params[f"{p}_q_bias"])
-            k = _fc(h, params[f"{p}_k_weight"], params[f"{p}_k_bias"])
-            v = _fc(h, params[f"{p}_v_weight"], params[f"{p}_v_bias"])
+            q = _wfc(params, f"{p}_q", h)
+            k = _wfc(params, f"{p}_k", h)
+            v = _wfc(params, f"{p}_v", h)
             qh = q.reshape(C, Hq, Dh)
             kh = k.reshape(C, Hkv, Dh)
             vh = v.reshape(C, Hkv, Dh)
             if cfg.pos_table is None:
                 qh, kh = _rope(qh, pos), _rope(kh, pos)
-            ck = ck.at[i, blk, off].set(kh)
-            cv = cv.at[i, blk, off].set(vh)
+            if cfg.kv_quant:
+                kq, ks = _kv_quant_vals(kh)
+                vq, vs = _kv_quant_vals(vh)
+                ck = ck.at[i, blk, off].set(kq)
+                ksc = ksc.at[i, blk, off].set(ks)
+                cv = cv.at[i, blk, off].set(vq)
+                vsc = vsc.at[i, blk, off].set(vs)
+            else:
+                ck = ck.at[i, blk, off].set(kh)
+                cv = cv.at[i, blk, off].set(vh)
             # all rows share one table: gather the request's logical
             # cache view ONCE per layer, then mask per-row by position
             kb = ck[i][table].reshape(S, Hkv, Dh)
             vb = cv[i][table].reshape(S, Hkv, Dh)
+            if cfg.kv_quant:
+                kb = _kv_dequant(kb, ksc[i][table].reshape(S, Hkv),
+                                 x.dtype)
+                vb = _kv_dequant(vb, vsc[i][table].reshape(S, Hkv),
+                                 x.dtype)
             qg = qh.reshape(C, Hkv, group, Dh)
             sc = jnp.einsum("ckgd,skd->kgcs", qg, kb)
             sc = sc / np.sqrt(Dh)
@@ -1556,14 +1868,13 @@ def _build_chunk(cfg, C, donate, shardings=None):
             pr = jax.nn.softmax(sc.astype(jnp.float32),
                                 axis=-1).astype(x.dtype)
             at = jnp.einsum("kgcs,skd->ckgd", pr, vb)
-            x = x + _fc(at.reshape(C, d_model),
-                        params[f"{p}_proj_weight"],
-                        params[f"{p}_proj_bias"])
+            x = x + _wfc(params, f"{p}_proj", at.reshape(C, d_model))
             x = x + _mlp(cfg, params, p, x)
         logits = _logits(cfg, params, x[n_valid - 1][None])
         tok = _sample(cfg, logits, rng)[0]
+        caches = _cache_outs(cfg, ck, cv, ksc, vsc)
         if cfg.numeric_watch:
-            return tok, jnp.isfinite(logits).all(), ck, cv
-        return tok, ck, cv
+            return (tok, jnp.isfinite(logits).all()) + caches
+        return (tok,) + caches
 
     return jax.jit(chunk, **_jit_kwargs(cfg, donate, shardings, 6))

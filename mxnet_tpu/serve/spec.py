@@ -124,8 +124,12 @@ class DraftWorker:
             block_size=engine.block_size,
             # the draft ALWAYS proposes greedily; sampling acceptance
             # (rejection sampling) is a later extension — the engine
-            # enforces temperature 0 end to end while spec is on
-            temperature=0.0, top_k=None, numeric_watch=False)
+            # enforces temperature 0 end to end while spec is on.
+            # The draft cache stays fp even under MXTPU_SERVE_KV_DTYPE=
+            # int8: it is small by design, and draft-cache contents
+            # only ever move the acceptance rate, never a token
+            temperature=0.0, top_k=None, numeric_watch=False,
+            kv_quant=False)
         # place the draft weights; under tensor parallelism they
         # replicate (the draft is small by design — sharding it would
         # buy latency nothing and complicate the program cache keys)
@@ -318,8 +322,8 @@ def _build_draft(cfg, k, donate, shardings=None):
             # garbage, but it can only ever be a beyond-quota draft
             # the verify-side emit cap drops
             tbl = jnp.where((pos + j < S)[:, None], tables, 0)
-            logits, ck, cv = _forward_token_batch(
-                cfg, params, ck, cv, cur, pos + j, tbl)
+            logits, ck, cv, _, _ = _forward_token_batch(
+                cfg, params, ck, cv, None, None, cur, pos + j, tbl)
             if j < k:
                 cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 outs.append(cur)
@@ -346,7 +350,8 @@ def _build_verify(cfg, k, donate, shardings=None):
     logits track what the single-token decode program would compute for
     the same context.
     """
-    from .engine import _fc, _ln, _logits, _mlp, _sample
+    from .engine import (_cache_outs, _kv_dequant, _kv_quant_vals, _ln,
+                         _logits, _mlp, _sample, _split_cache_args, _wfc)
 
     name = cfg.name
     Hq, Hkv, Dh = cfg.num_heads, cfg.kv_heads, cfg.head_dim
@@ -356,11 +361,13 @@ def _build_verify(cfg, k, donate, shardings=None):
     K1 = k + 1
     scale = 1.0 / np.sqrt(Dh)
 
-    def verify(params, ck, cv, rows, pos0, tables, rng):
+    def verify(params, *rest):
         """``rows`` (B, K1) int32 token ids; ``pos0`` (B,) the cache
         position of each request's row 0; ``tables`` (B, W).  Returns
         the target's (B, K1) greedy tokens (row j's token decided after
         consuming rows 0..j)."""
+        ck, cv, ksc, vsc, (rows, pos0, tables, rng) = \
+            _split_cache_args(cfg, rest)
         B = rows.shape[0]
         pos = pos0[:, None] + jnp.arange(K1)[None, :]      # (B, K1)
         x = params[f"{name}_tok_embed_weight"][rows]       # (B, K1, D)
@@ -387,22 +394,35 @@ def _build_verify(cfg, k, donate, shardings=None):
             p = f"{name}_l{i}"
             h = _ln(x, params[f"{p}_ln1_gamma"],
                     None if cfg.rmsnorm else params[f"{p}_ln1_beta"])
-            q = _fc(h, params[f"{p}_q_weight"], params[f"{p}_q_bias"])
-            kk = _fc(h, params[f"{p}_k_weight"], params[f"{p}_k_bias"])
-            v = _fc(h, params[f"{p}_v_weight"], params[f"{p}_v_bias"])
+            q = _wfc(params, f"{p}_q", h)
+            kk = _wfc(params, f"{p}_k", h)
+            v = _wfc(params, f"{p}_v", h)
             qh = q.reshape(B, K1, Hq, Dh)
             kh = kk.reshape(B, K1, Hkv, Dh)
             vh = v.reshape(B, K1, Hkv, Dh)
             if cfg.pos_table is None:
                 qh, kh = _rope_rows(qh, pos), _rope_rows(kh, pos)
-            ck = ck.at[i, blk, off].set(kh)
-            cv = cv.at[i, blk, off].set(vh)
+            if cfg.kv_quant:
+                kq, ks = _kv_quant_vals(kh)
+                vq, vs = _kv_quant_vals(vh)
+                ck = ck.at[i, blk, off].set(kq)
+                ksc = ksc.at[i, blk, off].set(ks)
+                cv = cv.at[i, blk, off].set(vq)
+                vsc = vsc.at[i, blk, off].set(vs)
+            else:
+                ck = ck.at[i, blk, off].set(kh)
+                cv = cv.at[i, blk, off].set(vh)
             # every row of a request shares its table: gather the
             # request's logical cache view once per layer, mask per
             # row by position (paged_attention's formulation with a
             # row axis added)
             kb = ck[i][tables].reshape(B, S, Hkv, Dh)
             vb = cv[i][tables].reshape(B, S, Hkv, Dh)
+            if cfg.kv_quant:
+                kb = _kv_dequant(kb, ksc[i][tables].reshape(B, S, Hkv),
+                                 x.dtype)
+                vb = _kv_dequant(vb, vsc[i][tables].reshape(B, S, Hkv),
+                                 x.dtype)
             qg = qh.reshape(B, K1, Hkv, group, Dh)
             sc = jnp.einsum("bckgd,bskd->bkgcs", qg, kb) * scale
             sc = jnp.where(keep[:, None, None], sc,
@@ -410,15 +430,14 @@ def _build_verify(cfg, k, donate, shardings=None):
             pr = jax.nn.softmax(sc.astype(jnp.float32),
                                 axis=-1).astype(x.dtype)
             at = jnp.einsum("bkgcs,bskd->bckgd", pr, vb)
-            x = x + _fc(at.reshape(B, K1, d_model),
-                        params[f"{p}_proj_weight"],
-                        params[f"{p}_proj_bias"])
+            x = x + _wfc(params, f"{p}_proj", at.reshape(B, K1, d_model))
             x = x + _mlp(cfg, params, p, x)
         logits = _logits(cfg, params, x)                   # (B, K1, V)
         tok = _sample(cfg, logits, rng)
+        caches = _cache_outs(cfg, ck, cv, ksc, vsc)
         if cfg.numeric_watch:
-            return tok, jnp.isfinite(logits).all(), ck, cv
-        return tok, ck, cv
+            return (tok, jnp.isfinite(logits).all()) + caches
+        return (tok,) + caches
 
     from .engine import _jit_kwargs
 

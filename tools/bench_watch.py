@@ -16,6 +16,7 @@ artifact set in priority order:
      tools/serve_bench.py --tp 2            -> SERVE_TP_BENCH.json
      tools/serve_bench.py --workload prefix -> PREFIX_BENCH.json
      tools/serve_bench.py --workload spec   -> SPEC_BENCH.json
+     tools/serve_bench.py --workload quant  -> QUANT_SERVE_BENCH.json
   9. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
 
 Two stages need no TPU and run ahead of the probe (so chip-down rounds
@@ -534,6 +535,36 @@ def run_serve_spec_bench(timeout=2400):
         "SPEC_BENCH.json", timeout, validate=validate)
 
 
+def run_serve_quant_bench(timeout=2400):
+    """Quantized serving A/B/C (tools/serve_bench.py --workload quant)
+    — quant-off vs weight-only int8 vs weight-only + int8-KV on the
+    same int8-snapped checkpoint: tok/s ratios, per-chip KV bytes
+    (cache + scales), and each variant's greedy-token agreement
+    against the fp baseline."""
+
+    def validate(payload):
+        if (payload.get("agreement_weight_only") or 0) < 0.99:
+            return "weight-only greedy agreement under 0.99"
+        if (payload.get("agreement_int8_kv") or 0) < 0.99:
+            return "int8-KV greedy agreement under 0.99"
+        # the honest ceiling is dtype_bytes / (1 + 4/head_dim) — f32
+        # scales ride every head_dim int8 elements — so a bf16 run at
+        # the TPU default Dh=64 tops out at 128/68 = 1.88x; gate each
+        # dtype just under its theoretical floor
+        floor = 1.9 if payload.get("param_dtype") == "float32" else 1.85
+        if (payload.get("kv_bytes_ratio") or 0) < floor:
+            return f"per-chip KV bytes dropped under {floor}x"
+        if payload.get("kv_cache_dtype_int8") != "int8":
+            return "int8-KV engine's cache dtype is not int8"
+        return None
+
+    return run_json_artifact(
+        "serve_quant",
+        [os.path.join(REPO, "tools", "serve_bench.py"),
+         "--workload", "quant"],
+        "QUANT_SERVE_BENCH.json", timeout, validate=validate)
+
+
 def run_train_bench(timeout=1800):
     """Fused single-dispatch train step vs per-param loop
     (tools/train_bench.py) — steps/sec and per-batch host dispatch
@@ -613,7 +644,7 @@ def main():
             "longcontext": False, "bandwidth": False, "cifar": False,
             "quant": False, "decode": False, "serve": False,
             "serve_tp": False, "serve_prefix": False,
-            "serve_spec": False,
+            "serve_spec": False, "serve_quant": False,
             "train_bench": False, "startup": False, "train_tier": False,
             "sweep": False}
     fails = {k: 0 for k in done}
@@ -704,6 +735,8 @@ def main():
              lambda: run_serve_prefix_bench(timeout=min(2400, left))),
             ("serve_spec",
              lambda: run_serve_spec_bench(timeout=min(2400, left))),
+            ("serve_quant",
+             lambda: run_serve_quant_bench(timeout=min(2400, left))),
             ("train_bench", lambda: run_train_bench(timeout=min(1800, left))),
             ("startup", lambda: run_startup_bench(timeout=min(1800, left))),
             ("train_tier", lambda: run_train_tier(timeout=min(3000, left))),
