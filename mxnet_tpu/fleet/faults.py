@@ -32,6 +32,21 @@ Examples::
 The supervisor/bench inject a spec into ONE replica's environment; the
 others run clean.  An empty/unset spec parses to an injector that never
 fires, so the hook can stay unconditionally wired in the replica.
+
+Host-KV restore-delay fault (not arrival-indexed)
+-------------------------------------------------
+
+``MXTPU_FAULT_HOST_RESTORE_DELAY=<seconds>`` simulates a slow
+DRAM→HBM copy on every host-KV-tier restore claim inside the serve
+engine (``serve.kv_block_manager.HostKVPool``).  With
+``MXTPU_SERVE_HOST_KV_RESTORE_BUDGET`` set, a delay past the budget
+DEGRADES that radix hit to recompute — the entry stays hosted, the
+engine prefills the span as if it missed — instead of stalling the
+step loop on the copy; the pool's ``degraded`` counter and the
+replica's ``host_kv_utilization`` load signal make the degradation
+observable fleet-wide.  Read at pool construction (engine start), so
+the chaos harness sets it in the target replica's environment like
+``MXTPU_FAULT_SPEC``.
 """
 
 from __future__ import annotations
@@ -39,9 +54,17 @@ from __future__ import annotations
 import threading
 
 __all__ = ["Fault", "FaultInjector", "parse_fault_spec", "ENV_SPEC",
+           "ENV_HOST_RESTORE_DELAY", "ENV_HOST_RESTORE_BUDGET",
            "ACTIONS"]
 
 ENV_SPEC = "MXTPU_FAULT_SPEC"
+
+# declared as plain strings (NOT imported from serve.kv_block_manager,
+# whose module also names them — that import would drag the whole
+# serve/jax chain into this deliberately stdlib-only module); the
+# canonical reader is serve.kv_block_manager.HostKVPool
+ENV_HOST_RESTORE_DELAY = "MXTPU_FAULT_HOST_RESTORE_DELAY"
+ENV_HOST_RESTORE_BUDGET = "MXTPU_SERVE_HOST_KV_RESTORE_BUDGET"
 
 ACTIONS = ("kill", "delay", "refuse", "hang")
 

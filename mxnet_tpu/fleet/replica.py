@@ -385,6 +385,7 @@ class ReplicaServer:
     # -- introspection -------------------------------------------------------
     def _health(self):
         state = self.state
+        hk = self.engine.host_kv_stats()
         return {"status": "ok" if state == READY else state,
                 "state": state,
                 "in_flight": len(self._inflight),
@@ -392,7 +393,12 @@ class ReplicaServer:
                 # mid-chunked-prefill requests hold a batch slot too —
                 # a replica grinding a long prefill must report the load
                 "running": (len(self.engine.scheduler.running)
-                            + len(self.engine.scheduler.prefilling))}
+                            + len(self.engine.scheduler.prefilling)),
+                # host-DRAM KV tier occupancy (None with the tier off):
+                # a saturated pool means further evictions re-pay
+                # recompute, so the tier's headroom IS a load signal
+                "host_kv_utilization": (hk["utilization"]
+                                        if hk is not None else None)}
 
     def _replica_state(self):
         """The router's balancing signal: readiness plus live load
@@ -401,6 +407,7 @@ class ReplicaServer:
         with self._lock:
             state, served = self._state, self._served
             inflight = len(self._inflight)
+        hk = eng.host_kv_stats()
         return {"replica": self.replica_id, "state": state,
                 "served": served, "in_flight": inflight,
                 "queue_depth": eng.scheduler.queue_depth,
@@ -411,6 +418,9 @@ class ReplicaServer:
                             + len(eng.scheduler.prefilling)),
                 "max_batch": eng.max_batch,
                 "kv_utilization": round(eng.blocks.utilization(), 4),
+                # host-DRAM KV tier occupancy (None with the tier off)
+                "host_kv_utilization": (hk["utilization"]
+                                        if hk is not None else None),
                 "faults_fired": len(self.faults.fired)}
 
     def statusz_snapshot(self):

@@ -6,7 +6,11 @@ serving stack on top of the same checkpoints:
 
 - ``kv_block_manager`` — paged KV-cache block accounting (vLLM-style):
   one fixed device cache carved into blocks, per-request block tables,
-  LRU eviction of finished/preempted requests' blocks.
+  LRU eviction of finished/preempted requests' blocks; with
+  ``MXTPU_SERVE_HOST_KV_BYTES`` set, evicted prefix-cache blocks park
+  in a bounded host-DRAM pool (``HostKVPool``) and restore on radix
+  hit instead of recomputing (docs/how_to/serve.md "Host-RAM KV
+  offload tier").
 - ``scheduler`` — iteration-level continuous batching (Orca-style):
   bounded FIFO admission, prefill/decode interleaving, preemption by
   recomputation under cache pressure, per-request deadlines with
@@ -33,13 +37,13 @@ Benchmark: ``tools/serve_bench.py`` (SERVE_BENCH.json artifact).
 """
 
 from .engine import Engine
-from .kv_block_manager import BlockManager, NoFreeBlocks
+from .kv_block_manager import BlockManager, HostKVPool, NoFreeBlocks
 from .scheduler import (CANCELLED, FINISHED, REJECTED, RUNNING, WAITING,
                         QueueFull, Request, Scheduler)
 from .spec import DraftWorker
 from .stats import ServeStats, StatsRecorder
 
-__all__ = ["Engine", "BlockManager", "DraftWorker", "NoFreeBlocks",
-           "QueueFull", "Request", "Scheduler", "ServeStats",
-           "StatsRecorder",
+__all__ = ["Engine", "BlockManager", "DraftWorker", "HostKVPool",
+           "NoFreeBlocks", "QueueFull", "Request", "Scheduler",
+           "ServeStats", "StatsRecorder",
            "WAITING", "RUNNING", "FINISHED", "REJECTED", "CANCELLED"]

@@ -68,7 +68,7 @@ from ..ops.attention import paged_attention
 from ..telemetry import flight as flight_mod
 from ..telemetry import statusz as statusz_mod
 from ..telemetry.request_trace import RequestTracer
-from .kv_block_manager import BlockManager
+from .kv_block_manager import BlockManager, HostKVPool
 from .scheduler import (CANCELLED, FINISHED, WAITING, QueueFull, Request,
                         Scheduler)
 from . import spec as spec_mod
@@ -225,6 +225,20 @@ class Engine:
         quantizes on write and dequantizes inside attention, and
         quantization is per-slot so preemption-by-recomputation stays
         token-stable.  Default: the parameter dtype, unquantized.
+      host_kv_bytes: host-DRAM offload tier for the prefix cache (env
+        ``MXTPU_SERVE_HOST_KV_BYTES``, default 0 — off and byte-for-
+        byte inert: same programs, same AOT fingerprints, same
+        tokens).  With a byte budget set, a refcount-0 published block
+        reclaimed by the prefix LRU parks its K/V (and int8 scale
+        slots) device→host instead of discarding it, and a later radix
+        hit on that prefix restores the block host→device — an async
+        ``device_put`` dispatched ahead of the first program that
+        reads it — instead of recomputing.  DRAM is 10-100x HBM, so
+        the prefix cache's effective capacity scales with host memory;
+        restored spans are token-identical to recompute by
+        construction (content-addressed keys, per-slot quantization).
+        The pool runs its own LRU under the budget with the same
+        leaf-only radix discipline.
     """
 
     def __init__(self, params, num_heads=None, window=None, symbol=None,
@@ -236,7 +250,7 @@ class Engine:
                  prefix_cache=None, prefill_chunk=None, spec_k=None,
                  draft_params=None, draft_num_heads=None,
                  draft_window=None, draft_symbol=None, draft_name=None,
-                 quantize=None, kv_dtype=None):
+                 quantize=None, kv_dtype=None, host_kv_bytes=None):
         if symbol is not None:
             num_heads, window = reconcile_decode_config(symbol, num_heads,
                                                         window)
@@ -367,8 +381,24 @@ class Engine:
                     "checkpoint whose vocab matches the target's)")
         self._spec = None           # DraftWorker, attached below
 
+        # -- host-DRAM KV offload tier (kv_block_manager.HostKVPool) -------
+        # default OFF and off is byte-for-byte inert: no restore
+        # program family, unchanged warmup grid, unchanged AOT
+        # fingerprints, identical tokens
+        self.host_kv_bytes = (int(host_kv_bytes)
+                              if host_kv_bytes is not None
+                              else env_int("MXTPU_SERVE_HOST_KV_BYTES", 0))
+        if self.host_kv_bytes < 0:
+            raise ValueError(
+                f"host_kv_bytes must be >= 0 (got {self.host_kv_bytes})")
+        self._host_pool = (HostKVPool(self.host_kv_bytes,
+                                      block_tokens=self.block_size)
+                           if self.host_kv_bytes else None)
         self.blocks = BlockManager(self.num_blocks, self.block_size,
-                                   prefix_cache=prefix_cache)
+                                   prefix_cache=prefix_cache,
+                                   host_pool=self._host_pool)
+        if self._host_pool is not None:
+            self.blocks.set_offload_source(self._host_kv_fetch)
         # request-scoped observability: the tracer threads every
         # lifecycle event (scheduler decisions included) into the
         # flight-recorder ring, the optional JSONL export
@@ -643,6 +673,11 @@ class Engine:
         self._step_id += 1
         with telemetry.span("serve.step"):
             prefills, decodes = self.scheduler.schedule()
+            if self._host_pool is not None:
+                # host-tier hits allocated by this schedule() queue
+                # their restores; dispatch them NOW, before the first
+                # prefill/decode program that reads the blocks
+                self._restore_pending()
             # blocks for this iteration are all held right now — the
             # honest high-water sample (post-drain reads would be ~0)
             self._stats.on_utilization(self.blocks.utilization())
@@ -665,12 +700,19 @@ class Engine:
                         emitted += self._run_decode(decodes)
             if prefills or decodes:
                 # scheduler decisions ride the flight ring (bounded,
-                # always on) so post-mortems see the recent schedule
-                flight_mod.recorder().record(
-                    "step", id=self._step_id, prefills=len(prefills),
+                # always on) so post-mortems see the recent schedule;
+                # with the host tier live its occupancy rides along
+                # (off-path records stay byte-identical)
+                step_fields = dict(
+                    id=self._step_id, prefills=len(prefills),
                     decodes=len(decodes),
                     queue=self.scheduler.queue_depth,
                     blocks_in_use=self.blocks.blocks_in_use)
+                if self._host_pool is not None:
+                    step_fields["host_kv_entries"] = len(self._host_pool)
+                    step_fields["host_kv_bytes"] = \
+                        self._host_pool.bytes_used
+                flight_mod.recorder().record("step", **step_fields)
             if emitted == 0 and not prefills and not decodes:
                 self._noop_steps += 1
                 if self._noop_steps > 1000 and self.scheduler.has_work():
@@ -768,6 +810,7 @@ class Engine:
                 # written so far, and the admission-time prefill goal
                 # (None while waiting)
                 "cached_tokens": req.cached_prefix_len,
+                "host_tokens": req.host_restored_len,
                 "prefill_done": int(req.cache_len),
                 "prefill_target": req.prefill_target,
                 "n_preemptions": req.n_preemptions})
@@ -791,6 +834,9 @@ class Engine:
             # cache-cold replica (also nested in kv_blocks.prefix_cache)
             "prefix_cache": self.blocks.prefix_stats(),
             "kv_cache": self.kv_cache_stats(),
+            # host-DRAM offload tier occupancy and hit/restore counters
+            # (None when the tier is off — the inert default)
+            "host_kv": self.host_kv_stats(),
             # quantized serving: which of the two int8 modes are live
             # (None when both are off — the inert default)
             "quant": self.quant_info(),
@@ -830,6 +876,23 @@ class Engine:
         if self._kv_quant and self._scale_k is not None:
             info["kv_scale_bytes"] = 2 * int(self._scale_k.nbytes)
         return info
+
+    def host_kv_stats(self):
+        """The ``/statusz`` ``host_kv`` section: DRAM budget and
+        occupancy, offload/restore/eviction counters and the per-block
+        host bytes (None when the tier is off).  The fleet replica's
+        load signal reads the same snapshot — a replica whose host tier
+        is saturated re-pays recompute on every further eviction."""
+        if self._host_pool is None:
+            return None
+        out = self._host_pool.stats()
+        # bytes one parked block costs in DRAM: K + V (+ scale slots)
+        per_block = 2 * (self._cache_k.nbytes // self.num_blocks
+                         if self._cache_k is not None else 0)
+        if self._kv_quant and self._scale_k is not None:
+            per_block += 2 * (self._scale_k.nbytes // self.num_blocks)
+        out["block_bytes"] = int(per_block)
+        return out
 
     def sharding_info(self):
         """Live sharding layout: tp degree, mesh shape/devices, rule
@@ -910,6 +973,12 @@ class Engine:
         self._owned = []
         self._cache_k = self._cache_v = None
         self._scale_k = self._scale_v = None
+        if self._host_pool is not None:
+            # the DRAM tier releases WITH the device buffers: two
+            # engines back-to-back must never transiently hold two
+            # host pools' worth of parked K/V either
+            self._host_pool.clear()
+            self._host_pool = None
         self.params = None            # free the device-resident weights
         self._alive = False
 
@@ -931,6 +1000,67 @@ class Engine:
              self._scale_k, self._scale_v) = arrs
         else:
             self._cache_k, self._cache_v = arrs
+
+    def _host_kv_fetch(self, blk):
+        """Device→host copy of ONE block's K/V (and int8 scale slots)
+        for the offload tier — called by the BlockManager's prefix-LRU
+        eviction just before the device block is recycled.  The copies
+        start asynchronously and the sync covers one block only (tens
+        of KB), a bounded, designed cost on the eviction path; under tp
+        the gather round-trips each chip's head shard into one full
+        host block."""
+        if self._cache_k is None:
+            return None
+        parts = [self._cache_k[:, blk], self._cache_v[:, blk]]
+        if self._kv_quant:
+            parts += [self._scale_k[:, blk], self._scale_v[:, blk]]
+        for a in parts:
+            start = getattr(a, "copy_to_host_async", None)
+            if start is not None:
+                start()
+        # mxtpu-lint: disable=host-sync (designed sync point: the
+        # evicted block's bytes must reach DRAM before its device
+        # buffer is reused — one small bounded copy per eviction)
+        return tuple(np.asarray(a) for a in parts)
+
+    @hot_path
+    def _restore_pending(self):
+        """Dispatch the queued host→device restores as ONE bucketed
+        ``restore`` program per batch: the copies ride the async
+        dispatch stream AHEAD of this iteration's prefill/decode
+        programs, so the cache dataflow (the restored arrays feed the
+        next program's cache operands) fences them before the first
+        read and the step loop never blocks on a copy."""
+        pending = self.blocks.take_pending_restores()
+        if not pending:
+            return
+        L, bs = self._cfg.n_layers, self.block_size
+        Hkv, Dh = self._cfg.kv_heads, self._cfg.head_dim
+        cap = self.table_width
+        while pending:
+            batch, pending = pending[:cap], pending[cap:]
+            bucket = _next_bucket(len(batch), cap)
+            blks = np.zeros(bucket, np.int32)   # pad rows -> null block
+            hk = np.zeros((L, bucket, bs, Hkv, Dh), self._cache_k.dtype)
+            hv = np.zeros_like(hk)
+            if self._kv_quant:
+                hks = np.zeros((L, bucket, bs, Hkv), np.float32)
+                hvs = np.zeros_like(hks)
+            for i, (blk, arrays) in enumerate(batch):
+                blks[i] = blk
+                hk[:, i] = arrays[0]
+                hv[:, i] = arrays[1]
+                if self._kv_quant:
+                    hks[:, i] = arrays[2]
+                    hvs[:, i] = arrays[3]
+            args = self._cache_args() + (jnp.asarray(blks),
+                                         jnp.asarray(hk),
+                                         jnp.asarray(hv))
+            if self._kv_quant:
+                args += (jnp.asarray(hks), jnp.asarray(hvs))
+            with telemetry.span("serve.host_kv_restore",
+                                blocks=len(batch)):
+                self._set_caches(self._program("restore", bucket)(*args))
 
     def _slots(self, table, n, pad_to):
         """(block, offset) scatter targets for logical slots [0, n),
@@ -1242,6 +1372,16 @@ class Engine:
         entries = aot_warmup.load_manifest(manifest, self._spec_digest)
         if not entries and manifest is None:
             entries = self._warmup_grid()
+        elif self._host_pool is not None:
+            # the host tier shares the tier-off engines' programs AND
+            # fingerprints (it changes no existing program), so a
+            # manifest recorded by a tier-off predecessor replays
+            # cleanly — but it lists no restore programs.  Force the
+            # (small) restore ladder in, or the first host-tier radix
+            # hit after an upgrade would trace mid-step
+            entries = list(entries) + [
+                {"kind": "restore", "bucket": b}
+                for b in self._bucket_ladder(self.table_width)]
         ready = 0
         self._warming = True   # warmup must not re-record the manifest
         try:
@@ -1269,6 +1409,12 @@ class Engine:
                         self._program(
                             "draft_chunk",
                             _next_bucket(bucket, self.max_model_len))
+                    elif (kind == "restore"
+                          and self._host_pool is not None
+                          and 1 <= bucket <= self.table_width):
+                        self._program(
+                            "restore",
+                            _next_bucket(bucket, self.table_width))
                     else:
                         continue
                     ready += 1
@@ -1302,6 +1448,12 @@ class Engine:
                         for b in buckets(self.max_batch)]
                      + [{"kind": "draft_chunk", "bucket": c}
                         for c in buckets(self.max_model_len)])
+        if self._host_pool is not None:
+            # the host tier's restore family exists ONLY when the tier
+            # is on (the only-when-on rule: a tier-off engine's grid,
+            # manifests and fingerprints are untouched)
+            grid += [{"kind": "restore", "bucket": b}
+                     for b in buckets(self.table_width)]
         return grid
 
     # -- compiled programs ---------------------------------------------------
@@ -1407,6 +1559,18 @@ class Engine:
             sspec = sds(self._scale_k.shape, self._scale_k.dtype,
                         sh.scale if sh is not None else None)
             caches = (cspec, cspec, sspec, sspec)
+        if kind == "restore":
+            # host-tier restore: caches first (no params, no rng),
+            # then the block ids and the replicated host copies —
+            # blks, hk, hv[, hks, hvs] (same order as _restore_pending)
+            L, bs = self._cfg.n_layers, self.block_size
+            Hkv, Dh = self._cfg.kv_heads, self._cfg.head_dim
+            hspec = sds((L, bucket, bs, Hkv, Dh), self._cache_k.dtype)
+            specs = caches + (sds((bucket,), i32), hspec, hspec)
+            if self._kv_quant:
+                s = sds((L, bucket, bs, Hkv), jnp.dtype(jnp.float32))
+                specs += (s, s)
+            return specs
         if kind == "decode":
             return (pspec,) + caches + (sds((bucket,), i32),
                     sds((bucket,), i32),
@@ -1460,6 +1624,9 @@ class Engine:
             if kind == "draft_chunk":
                 return _build_chunk(self._spec.cfg, bucket, self._donate,
                                     self._draft_shardings)
+            if kind == "restore":
+                return _build_restore(self._cfg, self._donate,
+                                      self._shardings)
             return _build_prefill(self._cfg, bucket, self._donate,
                                   self._shardings)
 
@@ -1497,9 +1664,12 @@ class Engine:
         # key in both — a warm start's compile is a disk read
         n_caches = (4 if self._cfg.kv_quant
                     and kind not in ("draft", "draft_chunk") else 2)
+        # the restore program has no params operand: its donated cache
+        # arguments START the signature instead of following the pytree
+        first = 0 if kind == "restore" else 1
         return compiled(jax.jit(
             exported.call,
-            donate_argnums=(tuple(range(1, 1 + n_caches))
+            donate_argnums=(tuple(range(first, first + n_caches))
                             if self._donate else ())))
 
 
@@ -1795,6 +1965,42 @@ def _build_prefill(cfg, P, donate, shardings=None):
         return (tok,) + caches
 
     return jax.jit(prefill, **_jit_kwargs(cfg, donate, shardings, 4))
+
+
+def _build_restore(cfg, donate, shardings=None):
+    """Host-tier restore program: scatter R parked blocks' host copies
+    back into the device cache through their (freshly allocated) block
+    ids.  Pure data movement — no params, no sampling: the caches are
+    donated through so the copy is in-place, padding rows write zeros
+    into the null block (contents garbage by design), and under tp the
+    replicated host operands scatter onto the head-sharded cache."""
+
+    def restore(*args):
+        if cfg.kv_quant:
+            ck, cv, ksc, vsc = args[:4]
+            blks, hk, hv, hks, hvs = args[4:]
+        else:
+            ck, cv = args[:2]
+            ksc = vsc = None
+            blks, hk, hv = args[2:]
+        ck = ck.at[:, blks].set(hk)
+        cv = cv.at[:, blks].set(hv)
+        if cfg.kv_quant:
+            ksc = ksc.at[:, blks].set(hks)
+            vsc = vsc.at[:, blks].set(hvs)
+        return _cache_outs(cfg, ck, cv, ksc, vsc)
+
+    n_caches = 4 if cfg.kv_quant else 2
+    kw = {"donate_argnums": (tuple(range(n_caches)) if donate else ())}
+    if shardings is not None:
+        rep = shardings.rep
+        caches = (shardings.cache,) * 2
+        if cfg.kv_quant:
+            caches += (shardings.scale,) * 2
+        n_host = 5 if cfg.kv_quant else 3
+        kw["in_shardings"] = caches + (rep,) * n_host
+        kw["out_shardings"] = caches
+    return jax.jit(restore, **kw)
 
 
 def _build_chunk(cfg, C, donate, shardings=None):

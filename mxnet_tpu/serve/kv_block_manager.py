@@ -48,20 +48,48 @@ needs its last position's logits, so the final span must be recomputed
 — is handled at lookup time by capping the hit at ``n_tokens - 1``: the
 last matched block is dropped from the hit and the engine recomputes
 its tokens into a FRESH private block (recomputation is the copy).
+
+Host-RAM offload tier (``HostKVPool``)
+--------------------------------------
+
+With a pool attached (env ``MXTPU_SERVE_HOST_KV_BYTES`` > 0), a
+refcount-0 published LEAF reclaimed by the prefix LRU no longer
+discards its K/V: the block's device contents are copied device→host
+(the engine's ``set_offload_source`` callback) and parked in a bounded
+host-DRAM numpy pool under the block's existing content key — the
+HBM-as-L1 / DRAM-as-L2 hierarchy vLLM-style engines use for swapped
+blocks.  ``_walk`` extends the radix chain walk into the host tier: a
+host hit claims a FRESH device block, queues an async host→device
+restore (``take_pending_restores`` — the engine dispatches the copies
+before the first program that reads the blocks) and counts the span as
+cached.  Restored blocks are token-identical to recompute by
+construction (content-addressed keys + per-slot KV quantization), so
+the tier is a pure capacity extension: DRAM is 10-100x HBM, and the
+pool has its own LRU with the same leaf-only discipline.  Without a
+pool every prefix eviction throws K/V away; ``discarded_tokens``
+counts exactly those tokens — the number this tier exists to drive
+down.
 """
 
 from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from collections import OrderedDict, deque
 
 import numpy as np
 
 from .. import telemetry
-from ..base import env_flag
+from ..base import env_flag, env_float
 
-__all__ = ["BlockManager", "NoFreeBlocks"]
+__all__ = ["BlockManager", "HostKVPool", "NoFreeBlocks"]
+
+# chaos-harness fault: simulated seconds per host-tier restore claim (a
+# slow DRAM copy); with a restore budget set, a delay past the budget
+# DEGRADES the hit to recompute instead of stalling the step loop
+ENV_HOST_RESTORE_DELAY = "MXTPU_FAULT_HOST_RESTORE_DELAY"
+ENV_HOST_RESTORE_BUDGET = "MXTPU_SERVE_HOST_KV_RESTORE_BUDGET"
 
 # chain anchor for the first block of every sequence (the radix root)
 _ROOT = b"mxtpu-radix-root"
@@ -88,6 +116,187 @@ def _block_key(parent, token_ids):
     return h.digest()
 
 
+class HostKVPool:
+    """Bounded host-DRAM pool of evicted prefix-cache blocks.
+
+    Entries are keyed by the block's content-addressed radix key and
+    hold the block's K/V as host numpy arrays (plus the int8 scale
+    slots under quantized KV) — the same content the device block held,
+    so a restore is byte-identical to recompute by construction.  The
+    pool runs its own LRU under ``max_bytes`` with the same leaf-only
+    discipline as the device tier (an entry whose CHILD is hosted is
+    never evicted first: without the interior, the deeper entries are
+    unreachable by the chain walk and would be dead bytes — the child
+    link is registered before any room-making eviction, so an insert
+    can never reclaim its own chain's interior).  An entry whose
+    parent has already left BOTH tiers (a niche partial-unpublish
+    path) is unreachable until its parent re-parks; the LRU simply
+    ages it out.
+
+    Chaos hook: ``MXTPU_FAULT_HOST_RESTORE_DELAY`` simulates a slow
+    DRAM copy per claim; with ``MXTPU_SERVE_HOST_KV_RESTORE_BUDGET``
+    set, a delay past the budget degrades the claim to a miss (the
+    entry stays hosted, the engine recomputes) instead of stalling the
+    serving step loop on the copy.
+    """
+
+    def __init__(self, max_bytes, block_tokens=0):
+        self.max_bytes = int(max_bytes)
+        if self.max_bytes <= 0:
+            raise ValueError(
+                f"max_bytes must be > 0 (got {max_bytes}); an absent "
+                "pool is host_pool=None, not a zero-byte pool")
+        self.block_tokens = int(block_tokens)
+        self._lock = threading.RLock()
+        # key -> (parent_key, arrays tuple, nbytes), LRU order
+        self._entries = OrderedDict()   # guarded-by: _lock
+        # parent key -> number of hosted entries chained under it
+        # (leaf == absent); survives the parent's own restore so a
+        # re-offloaded interior keeps protecting its hosted children
+        self._by_parent = {}            # guarded-by: _lock
+        self.bytes_used = 0             # guarded-by: _lock
+        self.bytes_peak = 0             # guarded-by: _lock
+        self.offloads = 0               # guarded-by: _lock
+        self.restores = 0               # guarded-by: _lock
+        self.evictions = 0              # guarded-by: _lock
+        self.rejects = 0                # guarded-by: _lock
+        self.degraded = 0               # guarded-by: _lock
+        self.discarded_tokens = 0       # guarded-by: _lock
+        self.fault_delay_s = env_float(ENV_HOST_RESTORE_DELAY, 0.0)
+        self.restore_budget_s = env_float(ENV_HOST_RESTORE_BUDGET, 0.0)
+        self._m_offloads = telemetry.counter(
+            "mxtpu_serve_host_kv_offloads_total",
+            "prefix-cache blocks parked in the host-DRAM tier")
+        self._m_discarded = telemetry.counter(
+            "mxtpu_serve_prefix_discarded_tokens_total",
+            "tokens whose cached K/V an eviction threw away for good")
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def has(self, key):
+        with self._lock:
+            return key in self._entries
+
+    def _remove(self, key):
+        """Drop one entry (called under ``_lock``); returns its
+        ``(parent, arrays, nbytes)``."""
+        with self._lock:
+            parent, arrays, nbytes = self._entries.pop(key)
+            self.bytes_used -= nbytes
+            if parent is not None and parent in self._by_parent:
+                self._by_parent[parent] -= 1
+                if not self._by_parent[parent]:
+                    del self._by_parent[parent]
+            return parent, arrays, nbytes
+
+    def _evict_leaf(self):
+        """Reclaim the oldest hosted entry with no hosted children —
+        the host tier's final discard (called under ``_lock``)."""
+        with self._lock:
+            for key in self._entries:          # oldest first
+                if self._by_parent.get(key, 0) == 0:
+                    self._remove(key)
+                    self.evictions += 1
+                    self.discarded_tokens += self.block_tokens
+                    self._m_discarded.inc(self.block_tokens)
+                    return True
+            return False
+
+    def _insert(self, key, parent, arrays):
+        """Budget-checked insert (called under ``_lock``); returns
+        whether the entry was parked."""
+        with self._lock:
+            nbytes = sum(int(a.nbytes) for a in arrays)
+            if nbytes > self.max_bytes:
+                self.rejects += 1
+                return False
+            if key in self._entries:
+                # re-offload of a restored block: content-addressed
+                # keys mean the bytes are identical — refresh recency
+                self._remove(key)
+            # register the parent link BEFORE making room: the budget
+            # eviction below must never reclaim the incoming entry's
+            # own hosted parent to fit the child — that would park
+            # bytes the chain walk can no longer reach
+            if parent is not None:
+                self._by_parent[parent] = self._by_parent.get(parent, 0) + 1
+            while self.bytes_used + nbytes > self.max_bytes:
+                if not self._evict_leaf():
+                    if parent is not None and parent in self._by_parent:
+                        self._by_parent[parent] -= 1
+                        if not self._by_parent[parent]:
+                            del self._by_parent[parent]
+                    self.rejects += 1
+                    return False
+            self._entries[key] = (parent, tuple(arrays), nbytes)
+            self.bytes_used += nbytes
+            self.bytes_peak = max(self.bytes_peak, self.bytes_used)
+            return True
+
+    def put(self, key, parent, arrays):
+        """Park one evicted block's host copies under ``key``.  Returns
+        False (the caller counts a discard) when the entry cannot fit
+        even after evicting every hosted leaf."""
+        with self._lock:
+            if not self._insert(key, parent, arrays):
+                return False
+            self.offloads += 1
+            self._m_offloads.inc()
+            return True
+
+    def claim(self, key):
+        """Pop ``key``'s host copies for a device restore; None on
+        miss — including the chaos-degraded case, where the simulated
+        DRAM copy would exceed the restore budget and the entry STAYS
+        hosted while the caller falls back to recompute."""
+        with self._lock:
+            if key not in self._entries:
+                return None
+            if self.fault_delay_s:
+                if (self.restore_budget_s
+                        and self.fault_delay_s > self.restore_budget_s):
+                    self.degraded += 1
+                    return None
+                time.sleep(self.fault_delay_s)   # the simulated copy
+            _, arrays, _ = self._remove(key)
+            self.restores += 1
+            return arrays
+
+    def unclaim(self, key, parent, arrays):
+        """Return a claimed entry after a failed allocation (no new
+        offload is counted — the bytes never left the pool's custody
+        semantically)."""
+        with self._lock:
+            self._insert(key, parent, arrays)
+
+    def clear(self):
+        """Deterministic release of every hosted array (engine
+        shutdown rides this alongside its device-buffer deletes)."""
+        with self._lock:
+            self._entries.clear()
+            self._by_parent.clear()
+            self.bytes_used = 0
+
+    def stats(self):
+        """JSON-ready snapshot — the ``/statusz`` ``host_kv`` section
+        and the replica load signal's host-tier occupancy."""
+        with self._lock:
+            return {"max_bytes": self.max_bytes,
+                    "bytes_used": self.bytes_used,
+                    "bytes_peak": self.bytes_peak,
+                    "utilization": round(
+                        self.bytes_used / self.max_bytes, 4),
+                    "entries": len(self._entries),
+                    "offloads": self.offloads,
+                    "restores": self.restores,
+                    "evictions": self.evictions,
+                    "rejects": self.rejects,
+                    "degraded": self.degraded,
+                    "discarded_tokens": self.discarded_tokens}
+
+
 class BlockManager:
     """Host-side block accounting.  Mutations are serialized by the
     RLock below: the scheduler drives allocation from the engine's step
@@ -97,7 +306,8 @@ class BlockManager:
     mxtpu-lint's unlocked-shared-state checker).  Reentrant because
     ``allocate``/``ensure_capacity`` call ``_take`` under the lock."""
 
-    def __init__(self, num_blocks, block_size, prefix_cache=None):
+    def __init__(self, num_blocks, block_size, prefix_cache=None,
+                 host_pool=None):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is the null block)")
         if block_size < 1:
@@ -107,6 +317,9 @@ class BlockManager:
         if prefix_cache is None:
             prefix_cache = env_flag("MXTPU_SERVE_PREFIX_CACHE", True)
         self.prefix_cache = bool(prefix_cache)
+        # host-DRAM offload tier (None = off: every prefix eviction
+        # discards, exactly the pre-offload lifecycle)
+        self.host = host_pool
         self._lock = threading.RLock()
         # block 0 reserved as the null/padding block
         self._free = deque(range(1, num_blocks))  # guarded-by: _lock
@@ -133,6 +346,24 @@ class BlockManager:
         self.prefix_misses = 0                    # guarded-by: _lock
         self.prefix_tokens_saved = 0              # guarded-by: _lock
         self.prefix_evictions = 0                 # guarded-by: _lock
+        # tokens whose cached K/V a prefix eviction threw away FOR GOOD
+        # (not parked in the host tier) — the recompute debt the
+        # offload tier exists to drive down; the host pool adds its own
+        # final-discard count on top in prefix_stats()
+        self.prefix_discarded_tokens = 0          # guarded-by: _lock
+        self.host_hits = 0                        # guarded-by: _lock
+        self.host_restored_tokens = 0             # guarded-by: _lock
+        # device→host extraction for offload, registered by the cache
+        # owner (the engine) via set_offload_source; None = every
+        # eviction discards even with a pool attached
+        self._offload_fetch = None                # guarded-by: _lock
+        # (block, host arrays) pairs awaiting the engine's host→device
+        # restore dispatch — drained via take_pending_restores() before
+        # the first program that reads the blocks
+        self._pending_restores = []               # guarded-by: _lock
+        # rid -> tokens of its table restored from the host tier (the
+        # admission trace / statusz in-flight split of cached_tokens)
+        self._host_tokens = {}                    # guarded-by: _lock
         self._m_hits = telemetry.counter(
             "mxtpu_serve_prefix_hits_total",
             "prefix-cache lookups that reused >= 1 cached block")
@@ -142,6 +373,19 @@ class BlockManager:
         self._m_saved = telemetry.counter(
             "mxtpu_serve_prefix_tokens_saved_total",
             "prompt tokens whose prefill was skipped via the prefix cache")
+        self._m_discarded = telemetry.counter(
+            "mxtpu_serve_prefix_discarded_tokens_total",
+            "tokens whose cached K/V an eviction threw away for good")
+        self._m_restored = telemetry.counter(
+            "mxtpu_serve_host_kv_restored_tokens_total",
+            "prompt tokens restored host->device instead of recomputed")
+
+    def set_offload_source(self, fetch):
+        """Register the device→host block extractor the eviction path
+        calls to park a reclaimed block in the host tier (``fetch(blk)
+        -> tuple of host arrays``, or None to skip offload)."""
+        with self._lock:
+            self._offload_fetch = fetch
 
     # -- capacity ------------------------------------------------------------
     @property
@@ -201,6 +445,12 @@ class BlockManager:
         with self._lock:
             looked = self.prefix_hits + self.prefix_misses
             shared = sum(1 for r in self._refs.values() if r > 1)
+            discarded = self.prefix_discarded_tokens
+            if self.host is not None:
+                # the host tier's own LRU evictions are the FINAL
+                # discard — the two sites together are every token
+                # whose cached K/V is gone for good
+                discarded += self.host.discarded_tokens
             return {"enabled": self.prefix_cache,
                     "cached_blocks": len(self._index),
                     "reusable_blocks": len(self._lru),
@@ -211,12 +461,38 @@ class BlockManager:
                     "hit_rate": (round(self.prefix_hits / looked, 4)
                                  if looked else None),
                     "tokens_saved": self.prefix_tokens_saved,
-                    "evictions": self.prefix_evictions}
+                    "evictions": self.prefix_evictions,
+                    "discarded_tokens": discarded,
+                    "host_hits": self.host_hits,
+                    "host_restored_tokens": self.host_restored_tokens}
+
+    def host_stats(self):
+        """The host-tier occupancy snapshot (None without a pool)."""
+        with self._lock:
+            return None if self.host is None else self.host.stats()
+
+    def host_tokens(self, rid):
+        """Tokens of ``rid``'s current table that were restored from
+        the host tier rather than recomputed (0 for everyone else)."""
+        with self._lock:
+            return self._host_tokens.get(rid, 0)
+
+    def take_pending_restores(self):
+        """Atomically drain the queued (block, host arrays) restores —
+        the engine dispatches the host→device copies before the first
+        program that reads the blocks, so the step loop never blocks on
+        a copy and the restored spans are in place by construction."""
+        with self._lock:
+            out, self._pending_restores = self._pending_restores, []
+            return out
 
     def can_allocate(self, n_tokens, token_ids=None):
         """Whether ``allocate(n_tokens, token_ids=...)`` would succeed
         right now: blocks a prefix walk would reuse don't need to come
-        off the free list."""
+        off the free list.  Host-tier hits are counted on the TOKEN
+        side only — a restored span still claims a fresh device block
+        (the capacity math must never mistake DRAM bytes for HBM
+        blocks), which ``prefix_probe``'s split encodes."""
         need = blocks_for(n_tokens, self.block_size)
         if token_ids is not None:
             cached_blocks, _ = self.prefix_probe(token_ids)
@@ -232,11 +508,14 @@ class BlockManager:
     # -- prefix lookup -------------------------------------------------------
     def _walk(self, token_ids):
         """Longest cached prefix of ``token_ids`` at block granularity
-        (called under ``_lock``): returns the matched ``[(key, block)]``
-        chain, copy-on-write capped so at least ONE token is left for
-        the engine to recompute (a fully-cached prompt still needs its
-        last position's logits, and the recompute must never scribble
-        into the shared final block)."""
+        (called under ``_lock``): returns the matched device
+        ``[(key, block)]`` chain plus the ``[key]`` continuation the
+        HOST tier holds past the device break (empty without a pool).
+        Copy-on-write capped so at least ONE token is left for the
+        engine to recompute (a fully-cached prompt still needs its last
+        position's logits, and the recompute must never scribble into
+        the shared final block) — host hits shed first: they are the
+        deeper end of the chain."""
         n = len(token_ids)
         bs = self.block_size
         hits = []
@@ -249,19 +528,31 @@ class BlockManager:
                 break
             hits.append((key, blk))
             parent = key
-        while hits and len(hits) * bs > n - 1:
-            hits.pop()                 # COW: recompute the final span
-        return hits
+        host = []
+        if self.host is not None:
+            while (len(hits) + len(host) + 1) * bs <= n:
+                b = len(hits) + len(host)
+                key = _block_key(parent, token_ids[b * bs:(b + 1) * bs])
+                if not self.host.has(key):
+                    break
+                host.append(key)
+                parent = key
+        while (len(hits) + len(host)) * bs > n - 1:
+            (host or hits).pop()       # COW: recompute the final span
+        return hits, host
 
     def prefix_probe(self, token_ids):
         """(cached_blocks, cached_tokens) an ``allocate`` with these
         ``token_ids`` would reuse — admission-time capacity math, no
-        state mutated."""
+        state mutated.  ``cached_blocks`` counts only DEVICE hits (the
+        blocks that need not come off the free list: a host-tier hit
+        restores into a fresh device block); ``cached_tokens`` is the
+        full prefill span skipped, device and host together."""
         with self._lock:
             if not self.prefix_cache or token_ids is None:
                 return 0, 0
-            hits = self._walk(token_ids)
-            return len(hits), len(hits) * self.block_size
+            hits, host = self._walk(token_ids)
+            return len(hits), (len(hits) + len(host)) * self.block_size
 
     # -- allocation ----------------------------------------------------------
     def _take(self, n):
@@ -287,12 +578,27 @@ class BlockManager:
 
     def _evict_prefix_leaf(self):
         """Reclaim the oldest refcount-0 published block that is a
-        radix leaf (no cached children).  Reentrant-locked: every
-        caller already holds ``_lock``."""
+        radix leaf (no cached children).  With a host pool attached the
+        block's K/V parks device→host under its existing content key
+        before the device block is reused; otherwise (or when the pool
+        rejects it) the K/V is gone for good and ``discarded_tokens``
+        counts the loss.  Reentrant-locked: every caller already holds
+        ``_lock``."""
         with self._lock:
             for key in self._lru:       # oldest first
                 if self._children.get(key, 0) == 0:
-                    blk = self._unpublish(key)
+                    blk = self._index[key]
+                    parked = False
+                    if (self.host is not None
+                            and self._offload_fetch is not None):
+                        arrays = self._offload_fetch(blk)
+                        if arrays is not None:
+                            parked = self.host.put(
+                                key, self._parent.get(key), arrays)
+                    if not parked:
+                        self.prefix_discarded_tokens += self.block_size
+                        self._m_discarded.inc(self.block_size)
+                    self._unpublish(key)
                     self._free.append(blk)
                     self.evictions += 1
                     self.prefix_evictions += 1
@@ -344,14 +650,16 @@ class BlockManager:
                 # rid is freed again later (its published blocks live
                 # in the prefix index and may be hit again right here)
                 self._free.extend(self._retained.pop(rid))
-            hits = []
+            hits, host_keys = [], []
             if self.prefix_cache and token_ids is not None:
-                hits = self._walk(token_ids)
+                hits, host_keys = self._walk(token_ids)
             # clear-miss precheck BEFORE any mutation or eviction (the
             # same optimistic math as can_allocate, one walk instead of
             # two): a request that cannot fit even by reclaiming every
             # parked block must not evict anything, count a hit, or
-            # take references on the way to failing
+            # take references on the way to failing.  Host hits never
+            # discount the block need — a restored span still claims a
+            # fresh device block
             if blocks_for(n_tokens, self.block_size) - len(hits) \
                     > self.free_blocks:
                 raise NoFreeBlocks(
@@ -359,31 +667,68 @@ class BlockManager:
                     f"{blocks_for(n_tokens, self.block_size)} blocks "
                     f"({len(hits)} cached), {self.free_blocks} "
                     "free/reclaimable")
+            # claim host entries BEFORE _take: eviction inside _take
+            # offloads more blocks, and the pool's own LRU churn could
+            # otherwise evict the very entries this walk matched.  A
+            # claim that degrades (chaos restore-delay past the budget)
+            # truncates the restored span — the rest recomputes
+            claimed = []
+            parent_key = hits[-1][0] if hits else None
+            for key in host_keys:
+                arrays = self.host.claim(key)
+                if arrays is None:
+                    break
+                claimed.append((key, parent_key, arrays))
+                parent_key = key
             if self.prefix_cache and token_ids is not None:
-                if hits:
+                if hits or claimed:
+                    saved = (len(hits) + len(claimed)) * self.block_size
                     self.prefix_hits += 1
-                    self.prefix_tokens_saved += len(hits) * self.block_size
+                    self.prefix_tokens_saved += saved
                     self._m_hits.inc()
-                    self._m_saved.inc(len(hits) * self.block_size)
+                    self._m_saved.inc(saved)
                 else:
                     self.prefix_misses += 1
                     self._m_misses.inc()
+                if claimed:
+                    self.host_hits += 1
+                    self.host_restored_tokens += \
+                        len(claimed) * self.block_size
+                    self._m_restored.inc(len(claimed) * self.block_size)
                 for _, blk in hits:
                     self._ref_hit(blk)
             n = blocks_for(n_tokens, self.block_size)
             try:
                 fresh = self._take(n - len(hits))
             except NoFreeBlocks:
-                # undo the hit references: a failed allocation must not
-                # leave cached blocks pinned un-evictable forever
+                # undo the hit references and re-park the claimed host
+                # entries: a failed allocation must not leave cached
+                # blocks pinned un-evictable or hosted K/V dropped
                 for key, blk in hits:
                     self._deref(blk, retain=True)
+                for key, parent, arrays in claimed:
+                    self.host.unclaim(key, parent, arrays)
                 raise
+            # restored blocks publish immediately under their existing
+            # content keys (they ARE the cached chain, back on device)
+            # and queue their host→device copies for the engine to
+            # dispatch before anything reads them
+            for (key, parent, arrays), blk in zip(claimed, fresh):
+                self._index[key] = blk
+                self._key_of[blk] = key
+                self._parent[key] = parent
+                if parent is not None:
+                    self._children[parent] = \
+                        self._children.get(parent, 0) + 1
+                self._pending_restores.append((blk, arrays))
             self._tables[rid] = [blk for _, blk in hits] + fresh
             self._lens[rid] = n * self.block_size
-            self._chain[rid] = [key for key, _ in hits]
+            self._chain[rid] = ([key for key, _ in hits]
+                                + [key for key, _, _ in claimed])
             if token_ids is not None:
-                return list(self._tables[rid]), len(hits) * self.block_size
+                self._host_tokens[rid] = len(claimed) * self.block_size
+                return (list(self._tables[rid]),
+                        (len(hits) + len(claimed)) * self.block_size)
             return list(self._tables[rid])
 
     def ensure_capacity(self, rid, n_tokens):
@@ -491,6 +836,27 @@ class BlockManager:
                 chain.append(key)
 
     # -- release -------------------------------------------------------------
+    def _drop_pending(self, blk):
+        """``blk`` left every table before its queued host→device
+        restore was dispatched (cannot happen through the engine — it
+        drains restores in the same step as the allocate — but the
+        public API allows it): the device block never received the
+        K/V, so it must NOT stay published as resurrectable.  Re-park
+        the host copies and unpublish.  Called under ``_lock``."""
+        with self._lock:
+            kept, dropped = [], []
+            for b, a in self._pending_restores:
+                (dropped if b == blk else kept).append((b, a))
+            if not dropped:
+                return
+            self._pending_restores[:] = kept
+            key = self._key_of.get(blk)
+            if key is not None:
+                parent = self._parent.get(key)
+                self._unpublish(key)
+                if self.host is not None:
+                    self.host.unclaim(key, parent, dropped[0][1])
+
     def _deref(self, blk, retain):
         """Drop one reference; returns the block if it reached
         refcount 0 UNPUBLISHED (the caller decides the retained-vs-free
@@ -500,6 +866,8 @@ class BlockManager:
             if self._refs[blk] > 0:
                 return None            # another table still reads it
             del self._refs[blk]
+            if self._pending_restores:
+                self._drop_pending(blk)
             key = self._key_of.get(blk)
             if key is not None:
                 if retain:
@@ -523,6 +891,7 @@ class BlockManager:
             blocks = self._tables.pop(rid)
             self._lens.pop(rid)
             self._chain.pop(rid, None)
+            self._host_tokens.pop(rid, None)
             loose = []
             for blk in blocks:
                 released = self._deref(blk, retain)
@@ -547,3 +916,8 @@ class BlockManager:
             self._children.clear()
             self._lru.clear()
             self._chain.clear()
+            self._host_tokens.clear()
+            # hosted entries stay: they are content-addressed, so their
+            # K/V remains valid for the tokens they hash — but restores
+            # queued against now-recycled device blocks must not land
+            del self._pending_restores[:]

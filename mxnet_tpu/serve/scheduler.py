@@ -75,6 +75,9 @@ class Request:
         self.tokens = []           # generated ids (ints)
         self.cache_len = 0         # K/V slots valid for this request
         self.cached_prefix_len = 0  # slots reused from the prefix cache
+        # of cached_prefix_len, the slots restored host->device from
+        # the DRAM offload tier (0 means all device-resident hits)
+        self.host_restored_len = 0
         self.prefill_target = None  # prefill length at admission
         self._prefill_started = False
         self.submit_t = None       # stamped by the scheduler
@@ -426,6 +429,7 @@ class Scheduler:
                 self.waiting.remove(req)
                 req.cache_len = cached
                 req.cached_prefix_len = cached
+                req.host_restored_len = self.blocks.host_tokens(req.rid)
                 req.prefill_target = int(ids.size)
                 if self.tenant_share < 1.0:
                     self._rr_idx += 1    # rotation advances on ADMIT
@@ -436,7 +440,8 @@ class Scheduler:
                     req, "resumed" if req.n_preemptions else "admitted",
                     queue_depth=len(self.waiting),
                     n_preemptions=req.n_preemptions,
-                    cached_tokens=cached, chunked=chunked)
+                    cached_tokens=cached,
+                    host_tokens=req.host_restored_len, chunked=chunked)
                 prefills.append(req)
                 if chunked:
                     self.prefilling.append(req)
@@ -498,6 +503,7 @@ class Scheduler:
             req.status = WAITING
             req.cache_len = 0
             req.cached_prefix_len = 0
+            req.host_restored_len = 0
             req.prefill_target = None
             req._prefill_started = False
             req.n_preemptions += 1

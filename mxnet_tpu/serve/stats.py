@@ -54,6 +54,21 @@ class ServeStats:
     prefix_hit_rate: float | None = None
     prefix_tokens_saved: int = 0
     prefix_evictions: int = 0
+    # tokens whose cached K/V eviction threw away for good (device
+    # discards plus the host tier's own final evictions) — the
+    # recompute debt the DRAM offload tier exists to drive down
+    prefix_discarded_tokens: int = 0
+    # host-DRAM offload tier (BlockManager.host / HostKVPool): lookups
+    # that restored at least one parked block, the restored token
+    # total, and the pool's live occupancy.  All zero with the tier
+    # off (MXTPU_SERVE_HOST_KV_BYTES=0).
+    host_kv_hits: int = 0
+    host_kv_restored_tokens: int = 0
+    host_kv_offloads: int = 0
+    host_kv_evictions: int = 0
+    host_kv_degraded: int = 0
+    host_kv_bytes_used: int = 0
+    host_kv_entries: int = 0
     # speculative decoding (serve/spec.py): draft-proposed tokens and
     # the target's accept/reject split, plus the per-verify mean run
     # length and lifetime acceptance rate.  Zero/None with spec off.
@@ -216,6 +231,7 @@ class StatsRecorder:
     def snapshot(self, scheduler, blocks):
         now = self.clock()
         pfx = blocks.prefix_stats()
+        host = blocks.host_stats() or {}
         total_rate = None
         if self._start_t is not None and now > self._start_t:
             total_rate = self.tokens_generated / (now - self._start_t)
@@ -266,4 +282,12 @@ class StatsRecorder:
             prefix_hit_rate=pfx["hit_rate"],
             prefix_tokens_saved=pfx["tokens_saved"],
             prefix_evictions=pfx["evictions"],
+            prefix_discarded_tokens=pfx["discarded_tokens"],
+            host_kv_hits=pfx["host_hits"],
+            host_kv_restored_tokens=pfx["host_restored_tokens"],
+            host_kv_offloads=host.get("offloads", 0),
+            host_kv_evictions=host.get("evictions", 0),
+            host_kv_degraded=host.get("degraded", 0),
+            host_kv_bytes_used=host.get("bytes_used", 0),
+            host_kv_entries=host.get("entries", 0),
         )

@@ -265,6 +265,51 @@ def test_prefix_bench_contract():
 
 
 @pytest.mark.slow
+def test_offload_bench_contract():
+    """tools/serve_bench.py --workload offload (the OFFLOAD_BENCH.json
+    bench_watch stage) on CPU smoke shapes: with the HBM prefix LRU
+    sized to thrash, the host tier recovers the hit rate to >= 0.8 of
+    the unconstrained-HBM run, cuts prefill compute >= 2x vs
+    offload-off, and every arm (cold, off, on, int8-KV, tp=2) emits
+    byte-identical tokens — the invariants the serve_offload watchdog
+    gate trusts."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # no tunnel for a CPU smoke
+    # a pre-set host device count (this repo's conftest pins 8; dev
+    # shells sometimes pin 1) would make serve_bench skip forcing its
+    # own — drop it so the tp=2 arm always runs
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--backend", "cpu", "--workload", "offload",
+         "--layers", "2", "--d-model", "64", "--heads", "4",
+         "--kv-heads", "2", "--vocab", "211", "--offload-prefixes", "6",
+         "--continuations", "4", "--prefix-len", "48",
+         "--suffix-len", "8", "--max-new", "8"],
+        capture_output=True, text=True, timeout=540, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads([l for l in r.stdout.splitlines()
+                          if l.startswith("{")][-1])
+    assert payload["platform"] == "cpu"
+    assert payload["complete"] is True      # stamped BEFORE the print
+    # the acceptance bars the serve_offload stage gates on
+    assert payload["tokens_identical"] is True
+    assert payload["hit_rate_recovery"] >= 0.8
+    assert payload["prefill_compute_ratio"] >= 2
+    assert payload["host_restores"] > 0
+    rec = payload["points"][0]
+    assert rec["identity"]["int8_on_vs_off"] is True
+    assert rec["tp2"] is not None, "tp=2 arm was skipped (no 2nd device)"
+    assert rec["identity"]["tp2_on_vs_cold"] is True
+    # the off arm really thrashed (discarding is what motivates the
+    # tier) and the on arm really parked instead
+    assert payload["discarded_tokens_off"] > 0
+    assert rec["discarded_tokens_on"] == 0
+    assert rec["hit_rate_off"] < rec["hit_rate_on"]
+    assert "telemetry" in payload
+
+
+@pytest.mark.slow
 def test_train_bench_contract(tmp_path):
     """tools/train_bench.py (the TRAIN_BENCH.json bench_watch stage)
     emits the training-path comparison on a CPU smoke config: both

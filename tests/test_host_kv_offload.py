@@ -1,0 +1,678 @@
+"""Host-RAM KV offload tier (mxnet_tpu/serve, ISSUE 12).
+
+The parity suite for the DRAM second tier under the radix prefix
+cache: ``HostKVPool`` unit semantics (byte budget, LRU with the
+leaf-only radix discipline, claim/unclaim, the chaos restore-delay
+degrade), BlockManager offload-on-eviction / host-chain walk /
+restore-and-publish bookkeeping, a randomized interleaved stress test
+over the full block lifecycle, and the engine-level acceptance gates —
+byte-identical tokens vs the cold path after HBM churn (gpt,
+llama/GQA + int8 KV, tp=2, preemption pressure, chunked prefill,
+spec-decode verify), tier-off inertness (same grids, same AOT
+fingerprints), deterministic shutdown of the pool, and the
+stats/statusz/metrics three-view agreement.
+
+Everything is CPU-deterministic on tiny models; the measured offload
+A/B contract lives in test_bench_contract.py (slow tier) against
+tools/serve_bench.py --workload offload.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu.serve import BlockManager, HostKVPool, NoFreeBlocks
+from mxnet_tpu.serve.kv_block_manager import blocks_for
+from mxnet_tpu.telemetry import statusz as statusz_mod
+
+VOCAB = 53
+
+
+def _arrs(tag, nbytes=64):
+    """A fake per-block host copy: one float32 array of ``nbytes``."""
+    return (np.full(nbytes // 4, float(tag), np.float32),)
+
+
+# -- HostKVPool units --------------------------------------------------------
+def test_pool_put_claim_budget_and_lru():
+    p = HostKVPool(256, block_tokens=4)
+    assert p.put(b"a", None, _arrs(1)) and p.put(b"b", None, _arrs(2))
+    assert len(p) == 2 and p.bytes_used == 128
+    # oversize entry rejected outright (never evicts the world for it)
+    assert not p.put(b"huge", None, _arrs(9, nbytes=512))
+    assert p.rejects == 1 and len(p) == 2
+    # budget pressure: two more 64-byte entries evict the two oldest
+    assert p.put(b"c", None, _arrs(3)) and p.put(b"d", None, _arrs(4))
+    assert p.put(b"e", None, _arrs(5))
+    assert not p.has(b"a") and p.evictions >= 1
+    assert p.discarded_tokens == p.evictions * 4
+    assert p.bytes_used <= p.max_bytes
+    # claim pops; a second claim misses
+    got = p.claim(b"e")
+    assert got is not None and got[0][0] == 5.0
+    assert p.claim(b"e") is None and p.restores == 1
+    p.clear()
+    assert len(p) == 0 and p.bytes_used == 0
+
+
+def test_pool_leaf_discipline_protects_hosted_chains():
+    """An interior entry whose child is hosted is never evicted first:
+    without it the deeper entries are unreachable by the chain walk."""
+    p = HostKVPool(192, block_tokens=4)
+    # device eviction order is leaf-first, so the CHILD parks first
+    assert p.put(b"child", b"root", _arrs(1))
+    assert p.put(b"root", None, _arrs(2))
+    # root is now OLDER in recency terms than nothing — child is the
+    # oldest entry, and also the only leaf (root has a hosted child)
+    assert p.put(b"x", None, _arrs(3))     # fills the budget
+    assert p.put(b"y", None, _arrs(4))     # forces one eviction
+    # child (oldest leaf) went; root survived even though x/y are newer
+    assert not p.has(b"child") and p.has(b"root")
+    # with its hosted child gone, root is evictable again
+    assert p.put(b"z", None, _arrs(5))
+    assert not p.has(b"root")
+
+
+def test_pool_insert_never_evicts_own_parent():
+    """Making room for a child must never reclaim the child's own
+    hosted parent — that would park bytes the chain walk can no longer
+    reach (the child link registers before the eviction loop)."""
+    p = HostKVPool(128, block_tokens=4)        # exactly two entries
+    p.put(b"A", None, _arrs(1))
+    p.put(b"x", None, _arrs(2))                # budget full
+    assert p.put(b"B", b"A", _arrs(3))         # evicts x, NOT A
+    assert p.has(b"A") and p.has(b"B") and not p.has(b"x")
+    assert p.stats()["bytes_peak"] == 128
+
+
+def test_pool_restore_delay_degrades_claim():
+    p = HostKVPool(1024, block_tokens=4)
+    p.put(b"k", None, _arrs(7))
+    p.fault_delay_s = 1.0
+    p.restore_budget_s = 0.05
+    assert p.claim(b"k") is None          # degraded, not served slowly
+    assert p.degraded == 1 and p.has(b"k")  # the entry STAYS hosted
+    p.fault_delay_s = 0.0
+    assert p.claim(b"k") is not None      # fault cleared: normal claim
+
+
+# -- BlockManager + pool bookkeeping -----------------------------------------
+def _mgr(num_blocks=16, block_size=4, pool_bytes=4096):
+    pool = HostKVPool(pool_bytes, block_tokens=block_size) \
+        if pool_bytes else None
+    m = BlockManager(num_blocks, block_size, prefix_cache=True,
+                     host_pool=pool)
+    fetched = []
+    if pool is not None:
+        def fetch(blk):
+            fetched.append(blk)
+            return (np.full(16, float(blk), np.float32),)
+        m.set_offload_source(fetch)
+    return m, pool, fetched
+
+
+def test_eviction_offloads_and_host_walk_restores():
+    m, pool, fetched = _mgr(num_blocks=6)     # 5 allocatable
+    ids = list(range(10, 19))                 # 2 full blocks + tail
+    t1, _ = m.allocate("a", 9, token_ids=ids)
+    m.note_tokens("a", ids)
+    m.free("a", retain=True)                  # chain parks in device LRU
+    # pressure: both published blocks leave HBM — and park in DRAM
+    m.allocate("b", 17)                       # needs 5 blocks
+    assert m.prefix_evictions >= 2 and len(pool) == 2
+    assert fetched and m.prefix_discarded_tokens == 0
+    assert m.prefix_stats()["discarded_tokens"] == 0
+    m.free("b", retain=False)
+    # probe: 0 device blocks to reuse, but 8 tokens restorable
+    assert m.prefix_probe(ids) == (0, 8)
+    t2, cached = m.allocate("c", 10, token_ids=ids)
+    assert cached == 8 and m.host_hits == 1
+    assert m.host_restored_tokens == 8 and len(pool) == 0
+    # restored blocks are published again and queue their H2D copies
+    pend = m.take_pending_restores()
+    assert sorted(b for b, _ in pend) == sorted(t2[:2])
+    assert [a[0][0] for _, a in pend]         # host copies ride along
+    assert m.take_pending_restores() == []    # drained exactly once
+    assert m.host_tokens("c") == 8
+    # the restored chain is a normal published chain: a sharer hits it
+    t3, c3 = m.allocate("d", 10, token_ids=ids)
+    assert c3 == 8 and t3[:2] == t2[:2]
+
+
+def test_failed_allocate_unclaims_host_entries():
+    m, pool, _ = _mgr(num_blocks=6)
+    ids = list(range(20, 29))
+    m.allocate("a", 9, token_ids=ids)
+    m.note_tokens("a", ids)
+    m.free("a", retain=True)
+    m.allocate("b", 17)                       # evicts chain into DRAM
+    assert len(pool) == 2
+    # "c" would reuse 8 host tokens but cannot get blocks: the claim
+    # must roll back — hosted K/V is not dropped on a failed admission
+    with pytest.raises(NoFreeBlocks):
+        m.allocate("c", 17, token_ids=ids)
+    assert len(pool) == 2
+    m.free("b", retain=False)
+    _, cached = m.allocate("c2", 10, token_ids=ids)
+    assert cached == 8                        # still restorable
+
+
+def test_discarded_tokens_without_pool():
+    m, _, _ = _mgr(num_blocks=5, pool_bytes=0)
+    ids = list(range(30, 39))
+    m.allocate("a", 9, token_ids=ids)
+    m.note_tokens("a", ids)
+    m.free("a", retain=True)
+    m.allocate("b", 13)                       # evicts published blocks
+    stats = m.prefix_stats()
+    assert m.prefix_evictions >= 1
+    assert stats["discarded_tokens"] == m.prefix_evictions * 4
+    assert stats["host_hits"] == 0 and m.host_stats() is None
+
+
+def test_free_before_restore_drain_reparks_host_copy():
+    """A block freed before its queued restore is dispatched (possible
+    through the public API, never through the engine) must not stay
+    published with never-written K/V: the host copy re-parks and the
+    chain stays restorable."""
+    m, pool, _ = _mgr(num_blocks=6)
+    ids = list(range(50, 59))
+    m.allocate("a", 9, token_ids=ids)
+    m.note_tokens("a", ids)
+    m.free("a", retain=True)
+    m.allocate("b", 17)                       # chain -> DRAM
+    m.free("b", retain=False)
+    t, cached = m.allocate("c", 10, token_ids=ids)
+    assert cached == 8 and len(m._pending_restores) == 2
+    m.free("c", retain=True)                  # BEFORE the engine drain
+    assert m.take_pending_restores() == []    # restores dropped...
+    assert len(pool) == 2                     # ...and re-parked
+    assert m.prefix_probe(ids) == (0, 8)      # not falsely published
+    _, cached = m.allocate("d", 10, token_ids=ids)
+    assert cached == 8                        # still restorable
+    assert len(m.take_pending_restores()) == 2
+
+
+def test_degraded_claim_truncates_restored_span():
+    m, pool, _ = _mgr(num_blocks=6)
+    ids = list(range(40, 49))
+    m.allocate("a", 9, token_ids=ids)
+    m.note_tokens("a", ids)
+    m.free("a", retain=True)
+    m.allocate("b", 17)
+    m.free("b", retain=False)
+    pool.fault_delay_s, pool.restore_budget_s = 1.0, 0.01
+    _, cached = m.allocate("c", 10, token_ids=ids)
+    assert cached == 0                        # degraded -> recompute
+    assert pool.degraded >= 1 and m.host_hits == 0
+    assert m.take_pending_restores() == []
+    assert len(pool) == 2                     # entries stayed hosted
+
+
+# -- randomized lifecycle stress ---------------------------------------------
+def _check_invariants(m, pool):
+    with m._lock:
+        free = list(m._free)
+        assert len(free) == len(set(free)), "duplicate free blocks"
+        assert 0 not in free, "null block freed"
+        refs = {}
+        for table in m._tables.values():
+            for blk in table:
+                refs[blk] = refs.get(blk, 0) + 1
+        assert refs == m._refs, "refcounts drifted from table contents"
+        lru = set(m._lru.values())
+        retained = [b for bs in m._retained.values() for b in bs]
+        assert len(retained) == len(set(retained))
+        groups = [set(free), set(refs), lru, set(retained)]
+        for i in range(len(groups)):
+            for j in range(i + 1, len(groups)):
+                assert groups[i].isdisjoint(groups[j]), \
+                    "a block is free+referenced+parked at once"
+        assert set().union(*groups) == set(range(1, m.num_blocks)), \
+            "a block leaked out of the accounting"
+        for key, blk in m._index.items():
+            assert m._key_of[blk] == key
+        assert set(m._lru) <= set(m._index)
+        for blk, _ in m._pending_restores:
+            assert m._refs.get(blk, 0) >= 1, \
+                "pending restore targets an unreferenced block"
+            assert blk in m._key_of, \
+                "pending restore targets an unpublished block"
+    if pool is not None:
+        with pool._lock:
+            assert pool.bytes_used <= pool.max_bytes
+            assert pool.bytes_used == sum(
+                n for _, _, n in pool._entries.values())
+
+
+def test_block_manager_stress_interleaved_lifecycle():
+    """Randomized allocate/free/evict/offload/restore/truncate churn
+    preserves every structural invariant: refcounts == table
+    membership, the free/referenced/parked partitions stay disjoint
+    and exhaustive, no block is simultaneously free+parked, pending
+    restores only target live referenced blocks, and the host tier
+    never exceeds its byte budget."""
+    rng = np.random.RandomState(1234)
+    # a tiny pool budget forces host-tier eviction/reject churn too
+    m, pool, _ = _mgr(num_blocks=12, block_size=4, pool_bytes=256)
+    master = rng.randint(0, 7, 64).tolist()   # tiny alphabet: collisions
+    live = []
+    rid_n = [0]
+
+    def some_ids():
+        take = int(rng.randint(4, 40))
+        tail = rng.randint(0, 7, int(rng.randint(0, 6))).tolist()
+        return master[:take] + tail
+
+    for step in range(400):
+        op = rng.randint(0, 6)
+        if op == 0 or not live:                      # allocate
+            rid = f"r{rid_n[0]}"
+            rid_n[0] += 1
+            ids = some_ids()
+            try:
+                m.allocate(rid, len(ids) + 1, token_ids=ids)
+                live.append((rid, ids))
+            except NoFreeBlocks:
+                pass
+        elif op == 1:                                # publish
+            rid, ids = live[rng.randint(len(live))]
+            m.note_tokens(rid, ids)
+        elif op == 2:                                # free
+            rid, _ = live.pop(rng.randint(len(live)))
+            m.free(rid, retain=bool(rng.randint(2)))
+        elif op == 3:                                # truncate
+            rid, ids = live[rng.randint(len(live))]
+            m.truncate(rid, int(rng.randint(1, len(ids) + 2)))
+        elif op == 4:                                # decode growth
+            rid, ids = live[rng.randint(len(live))]
+            try:
+                m.ensure_capacity(rid, m.capacity(rid) + 1)
+            except NoFreeBlocks:
+                pass
+        else:                                        # engine drains
+            m.take_pending_restores()
+        _check_invariants(m, pool)
+    # fixed seed: this sequence offloads, restores AND host-evicts
+    assert pool.offloads > 0 and pool.restores > 0 \
+        and pool.evictions > 0, \
+        "stress never exercised the host tier — vacuous"
+
+
+# -- engine-level parity gates (tiny models, real jit programs on CPU) -------
+@pytest.fixture(scope="module")
+def model():
+    S = 96
+    net = mx.models.gpt(VOCAB, S, num_layers=2, d_model=32, num_heads=4)
+    return net, _rand_params(net, S, seed=3)
+
+
+@pytest.fixture(scope="module")
+def llama_model():
+    S = 96
+    net = mx.models.gpt(VOCAB, S, num_layers=2, d_model=32, num_heads=4,
+                        kv_heads=2, norm="rmsnorm", mlp="swiglu",
+                        pos_embed="rope", tie_embeddings=True)
+    return net, _rand_params(net, S, seed=9)
+
+
+def _rand_params(net, S, seed):
+    arg_shapes, _, _ = net.infer_shape(data=(1, S), softmax_label=(1, S))
+    rng = np.random.RandomState(seed)
+    params = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        scale = 0.35 if name.endswith("weight") else 0.0
+        params[name] = (rng.randn(*shp) * scale
+                        + (1.0 if name.endswith("gamma") else 0.0)
+                        ).astype(np.float32)
+    return params
+
+
+def _engine(model, params=None, **kw):
+    net, p = model
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_model_len", 48)
+    kw.setdefault("max_prefills_per_step", 2)
+    return mx.serve.Engine(params if params is not None else p,
+                           symbol=net, **kw)
+
+
+POOL = 1 << 24
+
+
+def _churn_identity(model, ref_kw=None, on_kw=None, max_new=8, seed=7):
+    """The acceptance recipe: serve a prompt, churn its chain out of a
+    deliberately tiny HBM cache, serve it again.  Returns (ref, first,
+    again, stats) with ref from a calm reference engine."""
+    rng = np.random.RandomState(seed)
+    prompt = rng.randint(0, VOCAB, (24,)).astype(np.int32)
+    fills = [rng.randint(0, VOCAB, (24,)).astype(np.int32)
+             for _ in range(3)]
+
+    ref_eng = _engine(model, prefix_cache=False, **(ref_kw or {}))
+    ref = ref_eng.submit(prompt, max_new_tokens=max_new)
+    ref_eng.run()
+    ref_eng.shutdown()
+
+    eng = _engine(model, num_blocks=16, host_kv_bytes=POOL,
+                  **(on_kw or {}))
+    first = eng.submit(prompt, max_new_tokens=max_new)
+    eng.run()
+    for f in fills:
+        eng.submit(f, max_new_tokens=max_new)
+        eng.run()
+    again = eng.submit(prompt, max_new_tokens=max_new)
+    eng.run()
+    st = eng.stats()
+    eng.shutdown()
+    return ref, first, again, st
+
+
+def test_offload_identity_gpt(model):
+    """Acceptance: after the HBM prefix LRU churns the chain out, the
+    re-served prompt restores from DRAM and stays byte-identical to
+    the cold path — with real host-tier traffic (vacuity-guarded)."""
+    ref, first, again, st = _churn_identity(model)
+    assert first.tokens == ref.tokens
+    assert again.tokens == ref.tokens
+    assert st.host_kv_hits > 0, "no host-tier hit — test is vacuous"
+    assert st.host_kv_restored_tokens > 0
+    assert st.host_kv_offloads > 0
+    assert st.prefix_discarded_tokens == 0    # nothing thrown away
+
+
+def test_offload_identity_llama_gqa_int8(llama_model):
+    """Same gate on the llama/GQA variant with int8 KV blocks: the
+    quantized slots AND their scale slots round-trip DRAM (identity is
+    within the int8 pair — int8 legitimately moves tokens vs fp)."""
+    ref, first, again, st = _churn_identity(
+        llama_model, ref_kw=dict(kv_dtype="int8"),
+        on_kw=dict(kv_dtype="int8"))
+    assert first.tokens == ref.tokens
+    assert again.tokens == ref.tokens
+    assert st.host_kv_hits > 0
+
+
+def test_offload_identity_tp2(model):
+    """tp=2 head-sharded blocks round-trip the host tier (the D2H
+    gather folds both chips' head shards into one host block; the
+    replicated restore operand scatters back onto the sharded cache)."""
+    ref, first, again, st = _churn_identity(
+        model, ref_kw=dict(tp=2), on_kw=dict(tp=2))
+    assert first.tokens == ref.tokens
+    assert again.tokens == ref.tokens
+    assert st.host_kv_hits > 0
+
+
+def test_offload_under_preemption_pressure(model):
+    """Concurrent requests tight enough to preempt, with the host tier
+    live: resume-by-recomputation, refcounted sharing and DRAM restores
+    compose without perturbing a single token."""
+    rng = np.random.RandomState(17)
+    prompts = [rng.randint(0, VOCAB, (16,)).astype(np.int32)
+               for _ in range(6)]
+
+    def run(**kw):
+        eng = _engine(model, **kw)
+        reqs = [eng.submit(p, max_new_tokens=16) for p in prompts]
+        eng.run()
+        st = eng.stats()
+        eng.shutdown()
+        return reqs, st
+
+    calm_reqs, calm_st = run(num_blocks=64)
+    tight_reqs, tight_st = run(num_blocks=22, host_kv_bytes=POOL)
+    assert calm_st.preemptions == 0
+    assert tight_st.preemptions > 0, "no cache pressure — vacuous"
+    assert tight_st.host_kv_offloads > 0
+    for calm, tight in zip(calm_reqs, tight_reqs):
+        assert calm.status == tight.status == "finished"
+        assert calm.tokens == tight.tokens
+
+
+def test_offload_with_chunked_prefill(model):
+    """A DRAM-restored prefix followed by a chunked suffix prefill:
+    the restore fence holds across multi-iteration prefills too."""
+    rng = np.random.RandomState(23)
+    prefix = rng.randint(0, VOCAB, (16,)).astype(np.int32)
+    long_a = np.concatenate([prefix,
+                             rng.randint(0, VOCAB, (20,)).astype(np.int32)])
+    long_b = np.concatenate([prefix,
+                             rng.randint(0, VOCAB, (20,)).astype(np.int32)])
+    fills = [rng.randint(0, VOCAB, (24,)).astype(np.int32)
+             for _ in range(3)]
+
+    def run(**kw):
+        eng = _engine(model, prefill_chunk=8, **kw)
+        out = []
+        for p in (long_a, *fills, long_b):
+            out.append(eng.submit(p, max_new_tokens=8))
+            eng.run()
+        st = eng.stats()
+        eng.shutdown()
+        return out, st
+
+    ref_reqs, _ = run(num_blocks=64, prefix_cache=False)
+    got_reqs, st = run(num_blocks=16, host_kv_bytes=POOL)
+    assert st.host_kv_hits > 0, "chunked run never hit the host tier"
+    for a, b in zip(ref_reqs, got_reqs):
+        assert a.tokens == b.tokens
+
+
+def test_offload_with_spec_decode_verify(model):
+    """Speculative decoding over a DRAM-restored prefix: the verify
+    dispatch reads restored blocks and the share-safe truncate rollback
+    composes with republished chains — still byte-identical."""
+    net, params = model
+    src, draft = dict(params), {k: v for k, v in params.items()
+                                if not k.startswith("gpt_l1_")}
+    for k, v in params.items():
+        if k.startswith("gpt_l1_") and (k.endswith("proj_weight")
+                                        or k.endswith("ff_down_weight")):
+            src[k] = v * 0.05
+    spec_kw = dict(spec_k=2, draft_params=draft, draft_num_heads=4,
+                   draft_window=0)
+    ref, first, again, st = _churn_identity(
+        (net, src), ref_kw=spec_kw, on_kw=spec_kw)
+    assert first.tokens == ref.tokens
+    assert again.tokens == ref.tokens
+    assert st.host_kv_hits > 0
+    assert st.spec_verifies > 0, "spec never verified — vacuous"
+
+
+def test_restore_delay_fault_degrades_to_recompute(model):
+    """The chaos gate: a restore delay past the budget must not stall
+    the step loop — the hit degrades to recompute, tokens stay
+    identical, and the degradation is counted."""
+    os.environ["MXTPU_FAULT_HOST_RESTORE_DELAY"] = "30"
+    os.environ["MXTPU_SERVE_HOST_KV_RESTORE_BUDGET"] = "0.05"
+    try:
+        ref, first, again, st = _churn_identity(model)
+    finally:
+        del os.environ["MXTPU_FAULT_HOST_RESTORE_DELAY"]
+        del os.environ["MXTPU_SERVE_HOST_KV_RESTORE_BUDGET"]
+    assert first.tokens == ref.tokens
+    assert again.tokens == ref.tokens
+    assert st.host_kv_hits == 0               # every claim degraded
+    assert st.host_kv_degraded > 0
+    assert st.host_kv_offloads > 0            # the tier still parked
+
+
+def test_host_kv_off_is_inert(model):
+    """MXTPU_SERVE_HOST_KV_BYTES=0 is byte-for-byte inert: no pool, no
+    restore program family, identical warmup grid and AOT fingerprints,
+    zeroed stats — the PR 10/11 only-when-on rule."""
+    os.environ["MXTPU_SERVE_HOST_KV_BYTES"] = "0"
+    try:
+        eng0 = _engine(model)
+    finally:
+        del os.environ["MXTPU_SERVE_HOST_KV_BYTES"]
+    eng_def = _engine(model)                  # env unset: same default
+    assert eng0._host_pool is None and eng_def._host_pool is None
+    assert eng0._warmup_grid() == eng_def._warmup_grid()
+    assert all(g["kind"] != "restore" for g in eng0._warmup_grid())
+    assert eng0._aot_base_fp() == eng_def._aot_base_fp()
+    assert eng0.statusz()["host_kv"] is None
+    st = eng0.stats()
+    assert st.host_kv_hits == st.host_kv_offloads == 0
+    assert st.host_kv_bytes_used == 0
+    eng0.shutdown()
+    eng_def.shutdown()
+    # the tier ON adds ONLY the restore family, and the base
+    # fingerprint is unchanged (restore artifacts key on kind)
+    on = _engine(model, host_kv_bytes=POOL)
+    off_kinds = {g["kind"] for g in eng_def._warmup_grid()}
+    on_kinds = {g["kind"] for g in on._warmup_grid()}
+    assert on_kinds - off_kinds == {"restore"}
+    on.shutdown()
+
+
+def test_warmup_from_tier_off_manifest_warms_restore(model):
+    """An upgraded (tier-on) engine replaying a tier-off predecessor's
+    traffic manifest must still pre-compile the restore family — the
+    first host-tier hit after the upgrade must never trace mid-step."""
+    from mxnet_tpu.serve.engine import _STEP_CACHE
+
+    off = _engine(model)
+    rng = np.random.RandomState(53)
+    off.submit(rng.randint(0, VOCAB, (12,)).astype(np.int32),
+               max_new_tokens=4)
+    off.run()
+    man = off.manifest()
+    assert man and all(e["kind"] != "restore" for e in man)
+    off.shutdown()
+
+    on = _engine(model, host_kv_bytes=POOL)
+    ready = on.warmup(man)
+    assert ready > len(man)                   # the forced ladder ran
+    key = on._spec_key()
+    assert any(k[1] == "restore" for k in _STEP_CACHE if k[0] == key)
+    on.shutdown()
+
+
+def test_env_budget_default_and_arg_wins(model):
+    os.environ["MXTPU_SERVE_HOST_KV_BYTES"] = "65536"
+    try:
+        eng = _engine(model)
+        assert eng._host_pool is not None
+        assert eng._host_pool.max_bytes == 65536
+        eng.shutdown()
+        eng = _engine(model, host_kv_bytes=0)     # explicit arg wins
+        assert eng._host_pool is None
+        eng.shutdown()
+    finally:
+        del os.environ["MXTPU_SERVE_HOST_KV_BYTES"]
+
+
+def test_shutdown_releases_pool_back_to_back_engines(model):
+    """Engine.shutdown() releases the DRAM pool deterministically with
+    the device buffers, and the statusz weakref section (host_kv
+    included) drops — two engines back-to-back never hold two pools."""
+    ref, first, again, st = _churn_identity(model)
+    assert st.host_kv_offloads > 0
+    eng = _engine(model, num_blocks=16, host_kv_bytes=POOL)
+    rng = np.random.RandomState(31)
+    for _ in range(4):
+        eng.submit(rng.randint(0, VOCAB, (24,)).astype(np.int32),
+                   max_new_tokens=8)
+        eng.run()
+    pool = eng._host_pool
+    assert len(pool) > 0
+    name = eng._statusz_name
+    assert name in statusz_mod.snapshot()
+    sz = eng.statusz()
+    assert sz["host_kv"] is not None and sz["host_kv"]["entries"] > 0
+    eng.shutdown()
+    assert len(pool) == 0 and pool.bytes_used == 0
+    assert eng._host_pool is None
+    assert name not in statusz_mod.snapshot()
+    # a second engine starts clean and serves correctly
+    eng2 = _engine(model, num_blocks=16, host_kv_bytes=POOL)
+    req = eng2.submit(rng.randint(0, VOCAB, (12,)).astype(np.int32),
+                      max_new_tokens=4)
+    eng2.run()
+    assert req.status == "finished"
+    assert eng2.host_kv_stats()["offloads"] == 0    # fresh pool
+    eng2.shutdown()
+
+
+def test_stats_statusz_metrics_three_view_agreement(model):
+    """ServeStats, /statusz and the telemetry registry agree on the
+    host-tier counters (offloads, restored tokens, discarded tokens)
+    — the series an operator reads to size the DRAM budget."""
+    from mxnet_tpu import telemetry
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        ref, first, again, st = _churn_identity(model)
+        snap = telemetry.registry().snapshot()
+
+        def val(name):
+            return snap[name]["samples"][0]["value"]
+
+        assert st.host_kv_offloads > 0        # vacuity guard
+        assert val("mxtpu_serve_host_kv_offloads_total") == \
+            float(st.host_kv_offloads)
+        assert val("mxtpu_serve_host_kv_restored_tokens_total") == \
+            float(st.host_kv_restored_tokens)
+        fam = snap.get("mxtpu_serve_prefix_discarded_tokens_total")
+        discarded = (fam["samples"][0]["value"]
+                     if fam and fam["samples"] else 0.0)
+        assert discarded == float(st.prefix_discarded_tokens)
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_statusz_and_stats_expose_host_tier(model):
+    eng = _engine(model, num_blocks=16, host_kv_bytes=POOL)
+    rng = np.random.RandomState(41)
+    prompt = rng.randint(0, VOCAB, (24,)).astype(np.int32)
+    eng.submit(prompt, max_new_tokens=8)
+    eng.run()
+    for _ in range(3):
+        eng.submit(rng.randint(0, VOCAB, (24,)).astype(np.int32),
+                   max_new_tokens=8)
+        eng.run()
+    eng.submit(prompt, max_new_tokens=8)
+    eng.run()
+    sz = eng.statusz()
+    st = eng.stats()
+    hk = sz["host_kv"]
+    assert hk["max_bytes"] == POOL
+    assert hk["offloads"] == st.host_kv_offloads
+    assert hk["bytes_used"] == st.host_kv_bytes_used
+    assert hk["block_bytes"] > 0
+    pfx = sz["prefix_cache"]
+    assert pfx["host_hits"] == st.host_kv_hits
+    assert pfx["host_restored_tokens"] == st.host_kv_restored_tokens
+    assert pfx["discarded_tokens"] == st.prefix_discarded_tokens
+    eng.shutdown()
+
+
+def test_replica_load_signal_includes_host_tier(model):
+    """The fleet replica's /healthz and balancing signal carry the
+    host-tier occupancy (None with the tier off)."""
+    from mxnet_tpu.fleet.replica import ReplicaServer
+
+    eng = _engine(model, num_blocks=16, host_kv_bytes=POOL)
+    rep = ReplicaServer(eng, replica_id="r0")
+    h = rep._health()
+    s = rep._replica_state()
+    assert h["host_kv_utilization"] is not None
+    assert s["host_kv_utilization"] == eng.host_kv_stats()["utilization"]
+    eng.shutdown()
+    eng2 = _engine(model)
+    rep2 = ReplicaServer(eng2, replica_id="r1")
+    assert rep2._health()["host_kv_utilization"] is None
+    eng2.shutdown()

@@ -17,6 +17,7 @@ artifact set in priority order:
      tools/serve_bench.py --workload prefix -> PREFIX_BENCH.json
      tools/serve_bench.py --workload spec   -> SPEC_BENCH.json
      tools/serve_bench.py --workload quant  -> QUANT_SERVE_BENCH.json
+     tools/serve_bench.py --workload offload -> OFFLOAD_BENCH.json
   9. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
 
 Two stages need no TPU and run ahead of the probe (so chip-down rounds
@@ -565,6 +566,31 @@ def run_serve_quant_bench(timeout=2400):
         "QUANT_SERVE_BENCH.json", timeout, validate=validate)
 
 
+def run_serve_offload_bench(timeout=2400):
+    """Host-RAM KV offload tier A/B (tools/serve_bench.py --workload
+    offload) — an HBM prefix cache sized to thrash, offload-on vs off:
+    hit rate recovered vs the unconstrained-HBM reference, prefill
+    compute saved, tokens byte-identical in every arm (cold, off, on,
+    int8-KV, tp=2)."""
+
+    def validate(payload):
+        if not payload.get("tokens_identical"):
+            return "offload-tier tokens differ from the cold path"
+        if (payload.get("hit_rate_recovery") or 0) < 0.8:
+            return "hit rate recovered to < 0.8 of unconstrained HBM"
+        if (payload.get("prefill_compute_ratio") or 0) < 2:
+            return "prefill-compute reduction under 2x vs offload-off"
+        if not payload.get("host_restores"):
+            return "no host-tier restores — the thrash never offloaded"
+        return None
+
+    return run_json_artifact(
+        "serve_offload",
+        [os.path.join(REPO, "tools", "serve_bench.py"),
+         "--workload", "offload"],
+        "OFFLOAD_BENCH.json", timeout, validate=validate)
+
+
 def run_train_bench(timeout=1800):
     """Fused single-dispatch train step vs per-param loop
     (tools/train_bench.py) — steps/sec and per-batch host dispatch
@@ -645,6 +671,7 @@ def main():
             "quant": False, "decode": False, "serve": False,
             "serve_tp": False, "serve_prefix": False,
             "serve_spec": False, "serve_quant": False,
+            "serve_offload": False,
             "train_bench": False, "startup": False, "train_tier": False,
             "sweep": False}
     fails = {k: 0 for k in done}
@@ -737,6 +764,8 @@ def main():
              lambda: run_serve_spec_bench(timeout=min(2400, left))),
             ("serve_quant",
              lambda: run_serve_quant_bench(timeout=min(2400, left))),
+            ("serve_offload",
+             lambda: run_serve_offload_bench(timeout=min(2400, left))),
             ("train_bench", lambda: run_train_bench(timeout=min(1800, left))),
             ("startup", lambda: run_startup_bench(timeout=min(1800, left))),
             ("train_tier", lambda: run_train_tier(timeout=min(3000, left))),

@@ -66,6 +66,141 @@ def build_shared_prefix_workload(rng, args):
     return work
 
 
+def build_offload_workload(rng, args):
+    """The host-KV-offload workload: ``--offload-prefixes`` distinct
+    system prompts x ``--continuations`` suffixes, prefix-major rounds
+    — each round touches EVERY prefix once, so an HBM prefix LRU sized
+    for only a couple of chains re-misses on-chip every round and must
+    either recompute the prefix (offload off) or restore it from DRAM
+    (offload on)."""
+    import numpy as np
+
+    prefixes = [rng.randint(0, args.vocab,
+                            (args.prefix_len,)).astype("int32")
+                for _ in range(args.offload_prefixes)]
+    work = []
+    for _ in range(args.continuations):
+        for p in prefixes:
+            sfx = rng.randint(0, args.vocab,
+                              (args.suffix_len,)).astype("int32")
+            work.append((np.concatenate([p, sfx]), args.max_new))
+    return work
+
+
+def run_offload(mx, args, make_engine, workload):
+    """Host-RAM KV offload A/B over an HBM prefix cache sized to
+    thrash: offload-on vs offload-off on the SAME small cache, plus an
+    unconstrained-HBM reference (the hit rate the tier should recover)
+    and a cache-off cold baseline.  Int8-KV and tp=2 arms rerun the
+    offload-on/off pair under those modes.  The acceptance bars: hit
+    rate recovered to >= 0.8 of unconstrained, >= 2x less prefill
+    compute than offload-off, tokens byte-identical in every arm."""
+    import jax
+
+    conc = 1     # sequential: each request sees its predecessors'
+    #              evictions deterministically — the thrash is the test
+    sp_len = args.prefix_len + args.suffix_len + args.max_new
+    bf = mx.serve.kv_block_manager.blocks_for
+    chain = bf(args.prefix_len, args.block_size)
+    # thrashing HBM: the live request plus ~2 chains' worth of LRU —
+    # by round 2 every prefix has been pushed out on-chip, so the A/B
+    # isolates what the DRAM tier recovers
+    small = 1 + 2 * chain + bf(sp_len + 1, args.block_size) + 1
+    # every request's full published chain (prefix + suffix + decode
+    # tail) stays resident — the reference arm must never evict
+    big = 1 + (len(workload) + 2) * bf(sp_len + 1, args.block_size)
+    # DRAM budget covering every chain with headroom (the tier's whole
+    # point: DRAM is orders of magnitude larger than the HBM cache)
+    host_bytes = 1 << 30
+    kw = dict(max_model_len=sp_len, max_queue=len(workload) + 1)
+
+    # warm both program families (the restore family exists — and
+    # fingerprints — only with the tier on)
+    for wkw in (dict(num_blocks=big),
+                dict(num_blocks=small, host_kv_bytes=host_bytes)):
+        weng = make_engine(conc, **dict(kw, **wkw))
+        weng.warmup()
+        weng.shutdown()
+
+    def once(num_blocks, **ekw):
+        eng = make_engine(conc, num_blocks=num_blocks, **dict(kw, **ekw))
+        reqs, wall = run_closed(mx, eng, workload, conc)
+        st = eng.stats()
+        hk = eng.host_kv_stats()
+        eng.shutdown()
+        return reqs, wall, st, hk
+
+    cold_reqs, _, cold_st, _ = once(big, prefix_cache=False)
+    ref_reqs, _, ref_st, _ = once(big)                 # unconstrained
+    off_reqs, off_wall, off_st, _ = once(small)        # thrash, no tier
+    on_reqs, on_wall, on_st, on_hk = once(small, host_kv_bytes=host_bytes)
+
+    def identical(a, b):
+        return all(x.status == y.status == "finished"
+                   and x.tokens == y.tokens for x, y in zip(a, b))
+
+    idents = {"off_vs_cold": identical(off_reqs, cold_reqs),
+              "on_vs_cold": identical(on_reqs, cold_reqs),
+              "ref_vs_cold": identical(ref_reqs, cold_reqs)}
+
+    # int8-KV arm: quantized cache contents round-trip the host tier
+    # (scale slots ride along); identity is WITHIN the int8 pair —
+    # int8 legitimately moves tokens vs fp
+    i8_off, _, _, _ = once(small, kv_dtype="int8")
+    i8_on, _, i8_st, _ = once(small, kv_dtype="int8",
+                              host_kv_bytes=host_bytes)
+    idents["int8_on_vs_off"] = identical(i8_on, i8_off)
+
+    # tp=2 arm: head-sharded blocks round-trip the host tier per-shard
+    # (needs >= 2 devices and tp-divisible heads; skipped otherwise)
+    tp2 = None
+    if (jax.device_count() >= 2 and args.heads % 2 == 0
+            and (args.kv_heads or max(1, args.heads // 4)) % 2 == 0):
+        t2_reqs, _, t2_st, _ = once(small, tp=2,
+                                    host_kv_bytes=host_bytes)
+        idents["tp2_on_vs_cold"] = identical(t2_reqs, cold_reqs)
+        tp2 = {"host_kv_hits": t2_st.host_kv_hits,
+               "restored_tokens": t2_st.host_kv_restored_tokens}
+
+    ratio = (round(off_st.prefill_tokens_computed
+                   / on_st.prefill_tokens_computed, 2)
+             if on_st.prefill_tokens_computed else None)
+    recovery = (round(on_st.prefix_hit_rate / ref_st.prefix_hit_rate, 4)
+                if ref_st.prefix_hit_rate else None)
+    return {
+        "mode": "offload",
+        "requests": len(workload),
+        "offload_prefixes": args.offload_prefixes,
+        "prefix_len": args.prefix_len,
+        "num_blocks_small": small,
+        "num_blocks_unconstrained": big,
+        "host_kv_bytes": host_bytes,
+        "hit_rate_unconstrained": ref_st.prefix_hit_rate,
+        "hit_rate_off": off_st.prefix_hit_rate,
+        "hit_rate_on": on_st.prefix_hit_rate,
+        "hit_rate_recovery": recovery,
+        "prefill_tokens_computed_off": off_st.prefill_tokens_computed,
+        "prefill_tokens_computed_on": on_st.prefill_tokens_computed,
+        "prefill_compute_ratio": ratio,
+        "discarded_tokens_off": off_st.prefix_discarded_tokens,
+        "discarded_tokens_on": on_st.prefix_discarded_tokens,
+        "host_offloads": on_st.host_kv_offloads,
+        "host_restores": on_st.host_kv_hits,
+        "host_restored_tokens": on_st.host_kv_restored_tokens,
+        "host_bytes_peak": (on_hk or {}).get("bytes_peak"),
+        "int8_host_kv_hits": i8_st.host_kv_hits,
+        "tp2": tp2,
+        "tokens_identical": all(idents.values()),
+        "identity": idents,
+        "wall_s_on": round(on_wall, 3),
+        "wall_s_off": round(off_wall, 3),
+        "tokens_per_sec_on": (round(sum(len(r.tokens) for r in on_reqs)
+                                    / on_wall, 1) if on_wall else None),
+        "tokens_per_sec_off": (round(sum(len(r.tokens) for r in off_reqs)
+                                     / off_wall, 1) if off_wall else None),
+    }
+
+
 def build_repeat_heavy_workload(rng, args):
     """The spec workload: repeat-heavy prompts — a short random motif
     tiled to each prompt length — cycling the mixed lengths.  Highly
@@ -540,7 +675,7 @@ def main():
     p.add_argument("--mode", default="closed", choices=("closed", "open"))
     p.add_argument("--workload", default="default",
                    choices=("default", "shared-prefix", "mixed-len",
-                            "prefix", "spec", "quant"),
+                            "prefix", "spec", "quant", "offload"),
                    help="default: the mixed prompt-length load. "
                         "shared-prefix: --prefixes system prompts x "
                         "--continuations suffixes, cache-on vs cache-off "
@@ -558,7 +693,16 @@ def main():
                         "weight-only + int8-KV on the same (int8-"
                         "snapped) checkpoint: tok/s ratios, per-chip "
                         "KV bytes, greedy-token agreement -> the "
-                        "QUANT_SERVE_BENCH.json stage")
+                        "QUANT_SERVE_BENCH.json stage. "
+                        "offload: host-RAM KV tier A/B over an HBM "
+                        "prefix cache sized to thrash — offload-on vs "
+                        "off hit rate/prefill compute, vs an "
+                        "unconstrained-HBM reference, with int8-KV and "
+                        "tp=2 arms, tokens byte-identical everywhere "
+                        "-> the OFFLOAD_BENCH.json stage")
+    p.add_argument("--offload-prefixes", type=int, default=6,
+                   help="offload: distinct system prompts (sized to "
+                        "overflow the deliberately small HBM LRU)")
     p.add_argument("--prefixes", type=int, default=4,
                    help="shared-prefix: distinct system prompts")
     p.add_argument("--continuations", type=int, default=6,
@@ -621,6 +765,16 @@ def main():
     # an explicit --tp (including --tp 1) beats the deployment env
     # default; only an absent/zero flag defers to MXTPU_SERVE_TP
     eff_tp = args.tp if args.tp else env_tp
+    if args.workload == "offload" and eff_tp <= 1:
+        # the offload workload's tp=2 arm needs two devices; on the
+        # host platform force them BEFORE jax initializes (no-op for a
+        # real TPU backend — the flag only affects cpu).  The tp=1
+        # arms are unaffected: everything still runs on device 0
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
     if eff_tp > 1:
         # a tp mesh (CLI flag or deployment env default) needs >= tp
         # devices; on the host platform that means forcing virtual
@@ -653,7 +807,7 @@ def main():
     max_len = max(lens) + args.max_new
     # the prefix workloads size the model themselves: the net must
     # cover whatever max_model_len their engines will use
-    if args.workload in ("shared-prefix", "prefix"):
+    if args.workload in ("shared-prefix", "prefix", "offload"):
         max_len = max(max_len,
                       args.prefix_len + args.suffix_len + args.max_new)
     if args.workload in ("mixed-len", "prefix"):
@@ -752,6 +906,22 @@ def main():
             out["accepted_per_verify"] = rec["accepted_per_verify"]
             out["tokens_per_sec_on"] = rec["tokens_per_sec_on"]
             out["tokens_per_sec_off"] = rec["tokens_per_sec_off"]
+            flush(False)
+        if args.workload == "offload":
+            wl = build_offload_workload(rng, args)
+            rec = run_offload(mx, args, make_engine, wl)
+            print(json.dumps(rec))
+            pts.append(rec)
+            recs.append(rec)
+            # the bench_watch serve_offload contract fields
+            out["hit_rate_unconstrained"] = rec["hit_rate_unconstrained"]
+            out["hit_rate_off"] = rec["hit_rate_off"]
+            out["hit_rate_on"] = rec["hit_rate_on"]
+            out["hit_rate_recovery"] = rec["hit_rate_recovery"]
+            out["prefill_compute_ratio"] = rec["prefill_compute_ratio"]
+            out["host_restores"] = rec["host_restores"]
+            out["host_restored_tokens"] = rec["host_restored_tokens"]
+            out["discarded_tokens_off"] = rec["discarded_tokens_off"]
             flush(False)
         if args.workload == "quant":
             wl = build_workload(rng, args)
