@@ -6,13 +6,20 @@ analog here (ROADMAP item 3) is this package:
 
 - ``replica``   — ``ReplicaServer``: a stdlib-HTTP front over one
   ``serve.Engine`` (``/generate``, ``/healthz``, ``/drain``,
-  ``/statusz.json``), idempotent on client request ids; runnable as a
-  process via ``tools/serve_replica.py``.
+  ``/statusz.json``, ``/handoff``), idempotent on client request ids;
+  runnable as a process via ``tools/serve_replica.py``.  With
+  ``role="prefill"|"decode"`` (``MXTPU_FLEET_ROLE``) the fleet splits
+  DistServe-style: prefill replicas export a prompt's KV chain as
+  content-keyed records and decode replicas ingest them through the
+  host-RAM tier — decode iterations never share an engine with long
+  prefills (docs/how_to/fleet.md "Disaggregated prefill/decode").
 - ``router``    — ``Router``: least-loaded routing on scraped statusz
-  signals (queue depth + KV occupancy), per-hop timeout, capped
-  exponential backoff, retry-on-sibling, per-replica circuit breaker,
-  and trace-id propagation so ``tools/trace_report.py --stitch``
-  reassembles a request's hops across replicas.
+  signals (queue depth + KV occupancy + in-flight handoff ingests),
+  per-hop timeout, capped exponential backoff, retry-on-sibling,
+  per-replica circuit breaker, trace-id propagation so
+  ``tools/trace_report.py --stitch`` reassembles a request's hops
+  across replicas, and prefill→decode handoff orchestration
+  (``/handoff_probe`` dedup + re-handoff on sibling).
 - ``supervisor``— ``Supervisor``: spawn/monitor/restart N replica
   slots, crash-restart with backoff, and drain -> AOT-warm restart
   rolling restarts (zero client-visible failures; PR 4's warm start is
@@ -27,8 +34,8 @@ rolling-restart downtime).
 """
 
 from .faults import Fault, FaultInjector, parse_fault_spec
-from .replica import (DEAD, DRAINING, READY, STARTING, ReplicaServer,
-                      TRACE_HEADER)
+from .replica import (DEAD, DRAINING, READY, ROLES, STARTING,
+                      ReplicaServer, TRACE_HEADER)
 from .router import (FleetError, NoReplicaAvailable, PermanentError,
                      Router, RouterResult)
 from .supervisor import ProcessReplica, Supervisor, probe_health
@@ -37,4 +44,4 @@ __all__ = ["ReplicaServer", "Router", "RouterResult", "Supervisor",
            "ProcessReplica", "FaultInjector", "Fault",
            "parse_fault_spec", "probe_health", "FleetError",
            "PermanentError", "NoReplicaAvailable", "TRACE_HEADER",
-           "STARTING", "READY", "DRAINING", "DEAD"]
+           "ROLES", "STARTING", "READY", "DRAINING", "DEAD"]

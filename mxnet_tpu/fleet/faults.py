@@ -47,6 +47,32 @@ replica's ``host_kv_utilization`` load signal make the degradation
 observable fleet-wide.  Read at pool construction (engine start), so
 the chaos harness sets it in the target replica's environment like
 ``MXTPU_FAULT_SPEC``.
+
+Handoff faults (disaggregated prefill/decode fleets)
+----------------------------------------------------
+
+Two chaos knobs target the prefill→decode KV handoff a role-split
+fleet rides (docs/how_to/fleet.md "Disaggregated prefill/decode"):
+
+``MXTPU_FAULT_HANDOFF_DELAY=<seconds>`` sleeps that long at the START
+of every ``/handoff`` arrival at the target replica — a simulated slow
+wire.  Pushed past the router's per-hop timeout it exercises the
+retry-on-sibling re-handoff path (the router still holds the payload).
+
+``MXTPU_FAULT_HANDOFF_DROP=<n>`` discards the KV records of the first
+``n`` handoff arrivals at the target replica before import — the
+payload "arrives truncated".  The receiving replica degrades to
+recompute-from-prompt (the handoff body always carries the prompt),
+so tokens stay byte-identical to a role="both" run; only the prefill
+compute is re-paid and the replica's ``handoff`` counters show zero
+imports.
+
+Both are read at ``ReplicaServer`` construction (constructor arguments
+``handoff_delay_s=`` / ``handoff_drop=`` win), set per target replica
+like ``MXTPU_FAULT_SPEC``.  The arrival-indexed grammar above also
+covers ``/handoff``: the injector counts handoff arrivals through the
+same ``on_request`` hook, so ``kill@2`` on a decode replica kills it
+mid-stream while serving its 2nd handoff.
 """
 
 from __future__ import annotations
@@ -55,7 +81,7 @@ import threading
 
 __all__ = ["Fault", "FaultInjector", "parse_fault_spec", "ENV_SPEC",
            "ENV_HOST_RESTORE_DELAY", "ENV_HOST_RESTORE_BUDGET",
-           "ACTIONS"]
+           "ENV_HANDOFF_DELAY", "ENV_HANDOFF_DROP", "ACTIONS"]
 
 ENV_SPEC = "MXTPU_FAULT_SPEC"
 
@@ -65,6 +91,10 @@ ENV_SPEC = "MXTPU_FAULT_SPEC"
 # canonical reader is serve.kv_block_manager.HostKVPool
 ENV_HOST_RESTORE_DELAY = "MXTPU_FAULT_HOST_RESTORE_DELAY"
 ENV_HOST_RESTORE_BUDGET = "MXTPU_SERVE_HOST_KV_RESTORE_BUDGET"
+
+# prefill→decode handoff chaos (canonical reader: replica.ReplicaServer)
+ENV_HANDOFF_DELAY = "MXTPU_FAULT_HANDOFF_DELAY"
+ENV_HANDOFF_DROP = "MXTPU_FAULT_HANDOFF_DROP"
 
 ACTIONS = ("kill", "delay", "refuse", "hang")
 

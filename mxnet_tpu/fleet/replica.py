@@ -28,33 +28,80 @@ still in flight attaches to it.  That is what makes router retries safe
 — at-most-once execution per request id per replica, exactly-one
 response per id at the client.
 
-Faults (``faults.FaultInjector``) hook ``/generate`` arrivals so the
-chaos tests can kill/delay/refuse/hang this replica at a deterministic
-request index.  A *kill* is a hard death — ``on_kill`` defaults to an
-in-process crash (HTTP socket torn down mid-request, engine abandoned
-un-shutdown); ``tools/serve_replica.py`` passes ``os._exit`` so a
-process replica dies for real.
+Disaggregated prefill/decode roles (``role=`` / ``MXTPU_FLEET_ROLE``)
+---------------------------------------------------------------------
+
+A replica serves one of three roles (default ``"both"`` — the
+pre-disaggregation behavior, byte-for-byte):
+
+* ``"both"``    — ``/generate`` runs prefill AND decode (the classic
+  replica); ``/handoff`` ingests work too.
+* ``"prefill"`` — ``/generate`` runs admission + (chunked) prefill
+  only, then answers with a ``handoff`` envelope instead of tokens:
+  the prompt's cached KV chain serialized as content-keyed records
+  (``BlockManager.export_blocks`` — device blocks gathered D2H via
+  the PR 12 offload path).  The router moves that payload to a decode
+  replica; ``/handoff`` here is refused (503 ``wrong_role``).
+* ``"decode"``  — ``/generate`` is refused (503 ``wrong_role``);
+  ``POST /handoff`` ingests a prefill replica's records into the
+  host-RAM KV tier under the same content keys
+  (``BlockManager.import_blocks`` — requires the tier, so the role
+  demands ``MXTPU_SERVE_HOST_KV_BYTES`` > 0), then serves the request
+  like a normal prompt: the radix walk hits the imported chain, the
+  async restore program pulls it HBM-ward ahead of the first decode
+  read, and only the final span recomputes.  ``POST /handoff_probe``
+  answers which record keys this replica already caches (either
+  tier), so a sender skips those bytes — the radix key IS the
+  transfer dedup.
+
+Every record is verified against its chain hash at import, so a
+truncated/corrupt/chaos-dropped payload degrades to recompute-from-
+prompt (the body always carries the prompt) — token output stays
+byte-identical to a role="both" fleet in every failure arm.
+
+Faults (``faults.FaultInjector``) hook ``/generate`` AND ``/handoff``
+arrivals so the chaos tests can kill/delay/refuse/hang this replica at
+a deterministic request index.  A *kill* is a hard death — ``on_kill``
+defaults to an in-process crash (HTTP socket torn down mid-request,
+engine abandoned un-shutdown); ``tools/serve_replica.py`` passes
+``os._exit`` so a process replica dies for real.  Two handoff-specific
+chaos knobs ride the replica too: ``MXTPU_FAULT_HANDOFF_DELAY``
+(simulated slow wire per handoff arrival) and
+``MXTPU_FAULT_HANDOFF_DROP`` (the first N handoffs' KV records are
+discarded — "arrived truncated" — and recomputed from the prompt).
 """
 
 from __future__ import annotations
 
+import base64
 import collections
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler
 
+import numpy as np
+
 from .. import telemetry
+from ..base import env_float, env_int
 from ..serve.scheduler import FINISHED, QueueFull, REJECTED
 from ..telemetry import statusz as statusz_mod
+from . import faults as faults_mod
 
-__all__ = ["ReplicaServer", "STARTING", "READY", "DRAINING", "DEAD",
-           "RETRIABLE_REASONS", "PERMANENT_REASONS", "TRACE_HEADER"]
+__all__ = ["ReplicaServer", "ROLES", "STARTING", "READY", "DRAINING",
+           "DEAD", "RETRIABLE_REASONS", "PERMANENT_REASONS",
+           "TRACE_HEADER"]
 
 STARTING = "starting"
 READY = "ready"
 DRAINING = "draining"
 DEAD = "dead"
+
+# fleet roles: "both" interleaves prefill+decode on one engine (the
+# inert default), "prefill"/"decode" split them across replicas with
+# KV-block handoff over the wire (DistServe-style disaggregation)
+ROLES = ("both", "prefill", "decode")
 
 # rejection reasons a sibling replica might still serve (503) vs.
 # requests no replica can ever serve (400) — the router's retry
@@ -74,6 +121,20 @@ def _errors(site):
                              ("site",)).labels(site=site)
 
 
+def _handoff_bytes(direction):
+    return telemetry.counter(
+        "mxtpu_fleet_handoff_bytes_total",
+        "KV bytes moved over prefill->decode handoffs",
+        ("direction",)).labels(direction=direction)
+
+
+def _handoff_blocks(result):
+    return telemetry.counter(
+        "mxtpu_fleet_handoff_blocks_total",
+        "handoff record outcomes at the receiving replica",
+        ("result",)).labels(result=result)
+
+
 class ReplicaServer:
     """HTTP front + engine step-loop thread for one replica.
 
@@ -87,12 +148,20 @@ class ReplicaServer:
       on_kill: what a *kill* fault does (default: in-process hard stop;
         process replicas pass ``os._exit``).
       poll_s: completion-poll period of waiting request handlers.
+      role: ``"both"`` (default) | ``"prefill"`` | ``"decode"`` — the
+        disaggregation role (env ``MXTPU_FLEET_ROLE``; see the module
+        docstring).  ``"decode"`` requires the engine's host-RAM KV
+        tier (``host_kv_bytes`` > 0): handoff records land there.
+      handoff_delay_s / handoff_drop: chaos knobs (env
+        ``MXTPU_FAULT_HANDOFF_DELAY`` / ``MXTPU_FAULT_HANDOFF_DROP``):
+        seconds slept per ``/handoff`` arrival (a simulated slow
+        wire), and how many handoffs' KV records to discard before
+        import ("arrived truncated" — degrades to recompute).
     """
 
     def __init__(self, engine, host="127.0.0.1", port=0, replica_id=None,
-                 fault_injector=None, on_kill=None, poll_s=0.002):
-        from . import faults as faults_mod
-
+                 fault_injector=None, on_kill=None, poll_s=0.002,
+                 role=None, handoff_delay_s=None, handoff_drop=None):
         self.engine = engine
         self.host = host
         self._requested_port = int(port)
@@ -102,11 +171,46 @@ class ReplicaServer:
                        else faults_mod.FaultInjector())
         self._on_kill = on_kill if on_kill is not None else self.hard_stop
         self.poll_s = float(poll_s)
+        if role is None:
+            role = os.environ.get("MXTPU_FLEET_ROLE") or "both"
+        if role not in ROLES:
+            raise ValueError(
+                f"role must be one of {ROLES} (got {role!r})")
+        if role == "decode" and engine.blocks.host is None:
+            raise ValueError(
+                "role='decode' requires the host-RAM KV tier "
+                "(Engine(host_kv_bytes=) / MXTPU_SERVE_HOST_KV_BYTES "
+                "> 0): handoff records are ingested into it")
+        self.role = role
+        self._handoff_delay_s = (
+            float(handoff_delay_s) if handoff_delay_s is not None
+            else env_float(faults_mod.ENV_HANDOFF_DELAY, 0.0))
         self._lock = threading.RLock()
+        # serializes engine.step() dispatches against handoff exports:
+        # export_blocks gathers device cache blocks D2H from an HTTP
+        # handler thread, and on TPU the step thread's programs DONATE
+        # the cache buffers — a concurrent dispatch would invalidate
+        # the very buffer mid-gather (CPU never donates, so only a
+        # real-chip replica can hit it)
+        self._step_lock = threading.Lock()
+        self._handoff_drops_left = (
+            int(handoff_drop) if handoff_drop is not None
+            else env_int(faults_mod.ENV_HANDOFF_DROP, 0))  # guarded-by: _lock
         self._state = STARTING       # guarded-by: _lock
         self._served = 0             # guarded-by: _lock
         self._inflight = {}          # guarded-by: _lock
         self._done_cache = collections.OrderedDict()  # guarded-by: _lock
+        # prefill→decode handoff accounting (the replica statusz
+        # "handoff" section and the /healthz load signal)
+        self._handoff_ingesting = 0      # guarded-by: _lock
+        self._handoffs_received = 0      # guarded-by: _lock
+        self._handoffs_exported = 0      # guarded-by: _lock
+        self._handoff_imported = 0       # guarded-by: _lock
+        self._handoff_deduped = 0        # guarded-by: _lock
+        self._handoff_rejected = 0       # guarded-by: _lock
+        self._handoff_drops = 0          # guarded-by: _lock
+        self._handoff_bytes_received = 0  # guarded-by: _lock
+        self._handoff_bytes_exported = 0  # guarded-by: _lock
         self._server = None
         self._http_thread = None
         self._step_thread = None
@@ -231,7 +335,8 @@ class ReplicaServer:
         while not self._stop_evt.is_set():
             if self.engine.scheduler.has_work():
                 try:
-                    self.engine.step()
+                    with self._step_lock:
+                        self.engine.step()
                 except Exception:
                     # an engine that cannot step is a dead replica: fail
                     # fast so the router's probes see it gone (the
@@ -247,6 +352,18 @@ class ReplicaServer:
     def handle_generate(self, body, trace_id=None):
         """Returns ``(http_status, payload_dict)`` or ``None`` meaning
         "abort the connection without a response" (replica died)."""
+        return self._with_faults(self._serve_generate, body, trace_id)
+
+    def handle_handoff(self, body, trace_id=None):
+        """``POST /handoff``: ingest a prefill replica's exported KV
+        chain, then serve the request's decode.  Same return contract
+        as :meth:`handle_generate`; the fault injector counts handoff
+        arrivals through the same hook, so ``kill@k`` on a decode
+        replica fires mid-stream while serving its k-th handoff."""
+        return self._with_faults(self._serve_handoff, body, trace_id)
+
+    def _with_faults(self, fn, body, trace_id):
+        """Apply this arrival's chaos verdict around ``fn``."""
         fault = self.faults.on_request()
         if fault is not None and fault.action == "refuse":
             return 503, {"error": "fault_refuse", "retriable": True}
@@ -261,7 +378,7 @@ class ReplicaServer:
                 time.sleep(min(0.05, self.poll_s * 10))
             return None
         kill = fault is not None and fault.action == "kill"
-        result = self._serve_generate(body, trace_id, kill)
+        result = fn(body, trace_id, kill)
         if kill and result is not None:
             # the arrival the fault spec kills must never be answered —
             # whatever its answer would have been (a dedup-cache hit, a
@@ -272,10 +389,16 @@ class ReplicaServer:
             return None
         return result
 
-    def _serve_generate(self, body, trace_id, kill):
+    def _serve_generate(self, body, trace_id, kill, handoff=False):
         if self.state != READY:
             return 503, {"error": "draining", "retriable": True,
                          "state": self.state}
+        if self.role == "decode" and not handoff:
+            # a decode-role replica only ingests /handoff work; a
+            # misrouted prompt (stale scrape) retries on a sibling
+            return 503, {"error": "wrong_role", "retriable": True,
+                         "role": self.role}
+        prefill_only = self.role == "prefill" and not handoff
         request_id = body.get("request_id")
         try:
             prompt = [int(t) for t in body["prompt"]]
@@ -294,17 +417,34 @@ class ReplicaServer:
             # fleet-wide (three such requests would otherwise open
             # every breaker)
             return 400, {"error": "bad_request", "retriable": False}
+        if prefill_only \
+                and len(prompt) + max_new > self.engine.max_model_len:
+            # a prefill replica only submits prompt+1 (it never
+            # decodes), so the engine's own exceeds_max_len guard
+            # would miss the FULL request length — check it here, or
+            # the fleet would pay a whole prefill + handoff before the
+            # decode replica's admission rejects it
+            return 400, {"error": "exceeds_max_len", "retriable": False}
         tenant = body.get("tenant")
         if tenant is not None:
             # bound client-supplied tenant labels: they key per-tenant
             # scheduler/telemetry state, which must not grow with
             # arbitrary client strings
             tenant = str(tenant)[:64]
+        # a prefill-role replica runs admission + (chunked) prefill
+        # only: max_new_tokens=1 makes the prefill pass's own sampled
+        # token the request's last — it FINISHES at prefill end, its
+        # blocks park published with K/V intact, and export_blocks
+        # re-walks them by content.  The one emitted token is
+        # discarded; the decode replica regenerates it when it
+        # recomputes the final span (greedy — byte-identical)
+        serve_new = 1 if prefill_only else max_new
 
         def submit():
-            return self.engine.submit(prompt, max_new_tokens=max_new,
+            return self.engine.submit(prompt, max_new_tokens=serve_new,
                                       deadline_s=deadline_s,
-                                      tenant=tenant, trace_id=trace_id)
+                                      tenant=tenant, trace_id=trace_id,
+                                      handoff=handoff)
 
         try:
             if request_id is not None:
@@ -337,8 +477,9 @@ class ReplicaServer:
         self._work_evt.set()
 
         # a kill fault dies MID-STREAM: once the request has produced
-        # about half its tokens — the worst moment
-        kill_after = max(1, max_new // 2) if kill else None
+        # about half its tokens — the worst moment (on a prefill-role
+        # replica that is the moment prefill completes)
+        kill_after = max(1, serve_new // 2) if kill else None
         while not req.done:
             if kill_after is not None and len(req.tokens) >= kill_after:
                 self._on_kill()
@@ -353,10 +494,28 @@ class ReplicaServer:
             if req.status == REJECTED:
                 return self._reject_response(req)
             return 503, {"error": req.status, "retriable": True}
-        payload = {"tokens": list(req.tokens), "rid": req.rid,
-                   "trace_id": req.trace_id, "tenant": req.tenant,
-                   "replica": self.replica_id,
-                   "n_preemptions": req.n_preemptions}
+        if prefill_only:
+            # the prefill answer is a HANDOFF ENVELOPE, not tokens:
+            # the prompt's cached chain as content-keyed wire records
+            # (the router moves it to a decode replica).  Exported
+            # under the step lock: the D2H gather must never race a
+            # step dispatch that donates the cache buffers away
+            with self._step_lock:
+                records, nbytes = self._encode_records(
+                    self.engine.blocks.export_blocks(req.rid, prompt))
+            payload = {"handoff": {"records": records,
+                                   "prefill_replica": self.replica_id,
+                                   "cached_tokens": req.cached_prefix_len,
+                                   "prefilled": int(req.cache_len)},
+                       "rid": req.rid, "trace_id": req.trace_id,
+                       "tenant": req.tenant,
+                       "replica": self.replica_id}
+        else:
+            nbytes = 0
+            payload = {"tokens": list(req.tokens), "rid": req.rid,
+                       "trace_id": req.trace_id, "tenant": req.tenant,
+                       "replica": self.replica_id,
+                       "n_preemptions": req.n_preemptions}
         with self._lock:
             # cache-write and in-flight pop are ONE locked step: a
             # retry arriving between them would miss both lookups and
@@ -364,16 +523,168 @@ class ReplicaServer:
             # in-flight request, only the first to land here counts it
             # served and writes the cache; the rest return the same
             # payload without double-counting.
+            first = request_id is None or request_id not in self._done_cache
             if request_id is None:
                 self._served += 1
-            elif request_id not in self._done_cache:
+            elif first:
                 self._served += 1
                 self._done_cache[request_id] = payload
                 while len(self._done_cache) > _DONE_CACHE_SIZE:
                     self._done_cache.popitem(last=False)
             if request_id is not None:
                 self._inflight.pop(request_id, None)
+            if first and prefill_only:
+                self._handoffs_exported += 1
+                self._handoff_bytes_exported += nbytes
+        if first and prefill_only:
+            _handoff_bytes("exported").inc(nbytes)
         return 200, payload
+
+    def _serve_handoff(self, body, trace_id, kill):
+        """Ingest one prefill→decode handoff, then serve its decode.
+
+        The KV records import into the host tier under their content
+        keys; the request then runs like a plain prompt — the radix
+        walk hits the imported chain, so only the final span (and
+        whatever a failed/dropped/truncated import left uncovered)
+        recomputes.  Degradation is always recompute-from-prompt,
+        never an error: the body carries the prompt."""
+        if self.state != READY:
+            return 503, {"error": "draining", "retriable": True,
+                         "state": self.state}
+        if self.role == "prefill":
+            return 503, {"error": "wrong_role", "retriable": True,
+                         "role": self.role}
+        if self._handoff_delay_s > 0:
+            # chaos: simulated slow wire (pushed past the router's
+            # per-hop timeout it exercises re-handoff on a sibling)
+            time.sleep(self._handoff_delay_s)
+            if self._stop_evt.is_set():
+                return None
+        request_id = body.get("request_id")
+        if request_id is not None:
+            with self._lock:
+                done = request_id in self._done_cache
+            if done:
+                # a re-handoff of an id this replica already completed
+                # (first delivery's response was lost): skip the whole
+                # base64 decode + import — _serve_generate answers
+                # from the done-cache either way
+                return self._serve_generate(body, trace_id, kill,
+                                            handoff=True)
+        records = body.get("records") or []
+        with self._lock:
+            dropped = self._handoff_drops_left > 0 and bool(records)
+            if dropped:
+                self._handoff_drops_left -= 1
+                self._handoff_drops += 1
+        if dropped:
+            records = []    # "arrived truncated": recompute from prompt
+        imported = deduped = rejected = 0
+        nbytes = 0
+        with self._lock:
+            self._handoff_ingesting += 1
+        try:
+            try:
+                parsed, nbytes = self._decode_records(records)
+                imported, deduped, rejected = \
+                    self.engine.blocks.import_blocks(parsed)
+            except (KeyError, TypeError, ValueError):
+                # malformed payload: the prompt is still fully
+                # servable here — degrade to recompute, never a 400
+                # (which the router would treat as permanent)
+                rejected = len(records)
+        finally:
+            with self._lock:
+                self._handoff_ingesting -= 1
+                self._handoffs_received += 1
+                self._handoff_imported += imported
+                self._handoff_deduped += deduped
+                self._handoff_rejected += rejected
+                self._handoff_bytes_received += nbytes
+        _handoff_bytes("received").inc(nbytes)
+        if imported:
+            _handoff_blocks("imported").inc(imported)
+        if deduped:
+            _handoff_blocks("deduped").inc(deduped)
+        if rejected:
+            _handoff_blocks("rejected").inc(rejected)
+        return self._serve_generate(body, trace_id, kill, handoff=True)
+
+    def _encode_records(self, recs):
+        """``export_blocks`` output -> JSON-ready wire records (raw
+        K/V bytes base64'd, plus a payload digest — the chain hash
+        covers keys/tokens only, so corruption of the K/V bytes
+        themselves needs its own check).  Returns ``(records,
+        payload_bytes)``."""
+        import hashlib
+
+        records, nbytes = [], 0
+        for key, parent, tokens, arrays in recs:
+            rec = {"key": key.hex(),
+                   "parent": parent.hex() if parent is not None else None,
+                   "tokens": tokens}
+            digest = hashlib.sha1()
+            for name, a in zip(("k", "v", "ksc", "vsc"), arrays):
+                raw = np.ascontiguousarray(a).tobytes()
+                digest.update(raw)
+                rec[name] = base64.b64encode(raw).decode("ascii")
+                nbytes += len(raw)
+            rec["digest"] = digest.hexdigest()[:16]
+            records.append(rec)
+        return records, nbytes
+
+    def _decode_records(self, records):
+        """Wire records -> ``import_blocks`` input, every payload
+        validated against the engine's host-block spec (shape x dtype
+        bytes) AND its payload digest — the chain hash
+        ``import_blocks`` re-verifies covers only keys/tokens, so
+        same-length byte corruption needs the digest to be caught
+        before wrong K/V can park under a valid content key.  A
+        record without payload fields is a dedup-probe skip (the
+        sender knows this replica already hosts the block)."""
+        import hashlib
+
+        specs = self.engine.host_block_spec()
+        names = ("k", "v", "ksc", "vsc")[:len(specs)]
+        parsed, nbytes = [], 0
+        for rec in records:
+            key = bytes.fromhex(rec["key"])
+            parent = (bytes.fromhex(rec["parent"])
+                      if rec.get("parent") else None)
+            tokens = [int(t) for t in rec["tokens"]]
+            arrays = None
+            if all(n in rec for n in names):
+                arrays = []
+                digest = hashlib.sha1()
+                for n, (shape, dt) in zip(names, specs):
+                    raw = base64.b64decode(rec[n])
+                    want = int(np.prod(shape)) * dt.itemsize
+                    if len(raw) != want:
+                        raise ValueError(
+                            f"handoff record {n} holds {len(raw)} "
+                            f"bytes, expected {want}")
+                    digest.update(raw)
+                    arrays.append(np.frombuffer(raw, dt).reshape(shape))
+                    nbytes += len(raw)
+                if rec.get("digest") is not None \
+                        and digest.hexdigest()[:16] != rec["digest"]:
+                    raise ValueError("handoff record payload digest "
+                                     "mismatch (corrupted in transit)")
+                arrays = tuple(arrays)
+            parsed.append((key, parent, tokens, arrays))
+        return parsed, nbytes
+
+    @property
+    def waiting_handoffs(self):
+        """Handoff ingests this replica has accepted but not yet
+        admitted to prefill/decode (mid-import, or queued awaiting
+        restore) — the /healthz load-signal component that stops the
+        router's least-loaded pick from dog-piling a replica whose
+        in-flight ingests haven't reached the running set yet."""
+        with self._lock:
+            ingesting = self._handoff_ingesting
+        return ingesting + self.engine.scheduler.waiting_handoffs()
 
     def _reject_response(self, req):
         reason = req.reject_reason or "rejected"
@@ -388,12 +699,20 @@ class ReplicaServer:
         hk = self.engine.host_kv_stats()
         return {"status": "ok" if state == READY else state,
                 "state": state,
+                # the disaggregation role: the router routes prompts
+                # to prefill-capable replicas and handoffs to
+                # decode-capable ones
+                "role": self.role,
                 "in_flight": len(self._inflight),
                 "queue_depth": self.engine.scheduler.queue_depth,
                 # mid-chunked-prefill requests hold a batch slot too —
                 # a replica grinding a long prefill must report the load
                 "running": (len(self.engine.scheduler.running)
                             + len(self.engine.scheduler.prefilling)),
+                # accepted handoff ingests not yet running: without
+                # this a decode replica mid-ingest under-reports load
+                # and attracts every next handoff
+                "waiting_handoffs": self.waiting_handoffs,
                 # host-DRAM KV tier occupancy (None with the tier off):
                 # a saturated pool means further evictions re-pay
                 # recompute, so the tier's headroom IS a load signal
@@ -408,7 +727,17 @@ class ReplicaServer:
             state, served = self._state, self._served
             inflight = len(self._inflight)
         hk = eng.host_kv_stats()
+        with self._lock:
+            handoff = {"received": self._handoffs_received,
+                       "exported": self._handoffs_exported,
+                       "blocks_imported": self._handoff_imported,
+                       "blocks_deduped": self._handoff_deduped,
+                       "blocks_rejected": self._handoff_rejected,
+                       "drops": self._handoff_drops,
+                       "bytes_received": self._handoff_bytes_received,
+                       "bytes_exported": self._handoff_bytes_exported}
         return {"replica": self.replica_id, "state": state,
+                "role": self.role,
                 "served": served, "in_flight": inflight,
                 "queue_depth": eng.scheduler.queue_depth,
                 # running includes the chunked-prefill lane: those
@@ -416,6 +745,12 @@ class ReplicaServer:
                 # so the router's load score must see them
                 "running": (len(eng.scheduler.running)
                             + len(eng.scheduler.prefilling)),
+                # in-flight handoff ingests count toward load too —
+                # the router's least-loaded decode pick reads this
+                "waiting_handoffs": self.waiting_handoffs,
+                # prefill→decode handoff traffic (the disaggregation
+                # observability: wire bytes, dedup hits, drop arms)
+                "handoff": handoff,
                 "max_batch": eng.max_batch,
                 "kv_utilization": round(eng.blocks.utilization(), 4),
                 # host-DRAM KV tier occupancy (None with the tier off)
@@ -480,7 +815,7 @@ class _Handler(BaseHTTPRequestHandler):
                                       self.replica.engine.scheduler
                                       .queue_depth})
             return
-        if self.path != "/generate":
+        if self.path not in ("/generate", "/handoff", "/handoff_probe"):
             self.send_error(404)
             return
         try:
@@ -490,11 +825,31 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": "bad_json",
                                   "retriable": False})
             return
+        if self.path == "/handoff_probe":
+            # dedup probe: which of these content keys does this
+            # replica already cache (either tier)?  The sender skips
+            # those blocks' bytes on the wire.  Never fault-injected —
+            # a probe is an optimization, not a request arrival
+            try:
+                keys = [bytes.fromhex(k) for k in body.get("keys") or []]
+            except (TypeError, ValueError):
+                self._send_json(400, {"error": "bad_request",
+                                      "retriable": False})
+                return
+            have = set(self.replica.engine.blocks.has_blocks(keys))
+            self._send_json(200, {"missing": [k.hex() for k in keys
+                                              if k not in have]})
+            return
         trace_id = self.headers.get(TRACE_HEADER) or body.get("trace_id")
+        handler = (self.replica.handle_handoff
+                   if self.path == "/handoff"
+                   else self.replica.handle_generate)
         try:
-            result = self.replica.handle_generate(body, trace_id=trace_id)
+            result = handler(body, trace_id=trace_id)
         except Exception:
-            _errors("generate").inc()
+            # label by endpoint: a throwing handoff ingest path must
+            # not send the operator to debug /generate
+            _errors(self.path.lstrip("/")).inc()
             result = 500, {"error": "internal", "retriable": True}
         if result is None:
             self._abort()

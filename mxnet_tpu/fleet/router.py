@@ -21,6 +21,22 @@ replica dedups on it) and one ``trace_id`` propagated in the
 shares the id and ``tools/trace_report.py --stitch`` reassembles the
 cross-replica story.
 
+Disaggregated fleets: replicas advertise a ``role`` in their scraped
+load signal ("both"/"prefill"/"decode" — replica.py).  Prompts route
+least-loaded among prefill-capable replicas; when the chosen replica
+is prefill-role its 200 answer is a *handoff envelope* (the prompt's
+KV chain as content-keyed records) and the router moves it to the
+least-loaded decode-capable replica via ``POST /handoff`` — after a
+``/handoff_probe`` dedup round that skips the bytes of blocks the
+target already caches (the radix key IS the transfer dedup).  The
+handoff hop keeps every fleet guarantee: remaining end-to-end
+deadline forwarded, same trace id (one stitched timeline across both
+roles), same request-id idempotency, and retry-on-sibling — a handoff
+that times out or lands on a dead decode replica is re-sent to
+another one from the payload still in hand, and a payload that
+arrives truncated degrades to recompute-from-prompt on the receiver
+(token-identical either way).
+
 Pure stdlib (urllib); no background machinery unless ``start()`` is
 called (the scrape thread).  All knobs take constructor arguments
 first, ``MXTPU_FLEET_*`` env defaults second.
@@ -79,13 +95,17 @@ class RouterResult:
 class _ReplicaState:
     """Router-side view of one replica: scrape signal + breaker."""
 
-    __slots__ = ("url", "name", "state", "load", "consecutive_failures",
-                 "open_until", "probing", "last_scrape_t")
+    __slots__ = ("url", "name", "state", "role", "load",
+                 "consecutive_failures", "open_until", "probing",
+                 "last_scrape_t")
 
     def __init__(self, url):
         self.url = url.rstrip("/")
         self.name = self.url
         self.state = "unknown"      # ready/draining/down/unknown
+        # "both" until a scrape says otherwise: a legacy replica that
+        # never advertises a role serves everything
+        self.role = "both"
         self.load = 0.0
         self.consecutive_failures = 0
         self.open_until = None      # breaker-open deadline (monotonic)
@@ -162,6 +182,12 @@ class Router:
         self._m_added = telemetry.histogram(
             "mxtpu_fleet_router_added_seconds",
             "router-added latency (request wall minus replica HTTP time)")
+        self._m_handoffs = telemetry.counter(
+            "mxtpu_fleet_handoffs_total",
+            "prefill->decode KV handoffs routed", ("outcome",))
+        self._m_handoff_dedup = telemetry.counter(
+            "mxtpu_fleet_handoff_dedup_blocks_total",
+            "handoff blocks whose bytes the dedup probe skipped")
 
     # -- membership ----------------------------------------------------------
     def replicas(self):
@@ -229,6 +255,7 @@ class Router:
                 r.state = ("ready" if sec.get("state") == "ready"
                            else sec.get("state") or "down")
                 r.name = sec.get("replica") or r.name
+                r.role = sec.get("role") or "both"
                 r.load = self._load_score(sec)
                 r.last_scrape_t = self.clock()
         except (OSError, ValueError):
@@ -241,10 +268,14 @@ class Router:
         """Scalar routing score from a replica's statusz section:
         queued work normalized by batch width plus KV occupancy — both
         saturate at ~1, so an idle replica scores ~0 and a saturated
-        one ~2+."""
+        one ~2+.  In-flight handoff ingests (mid-import, not yet
+        queued) count as queued work: a decode replica swallowing a
+        large KV payload must not under-report and attract the next
+        handoff too."""
         width = max(1, int(sec.get("max_batch") or 1))
         queued = (int(sec.get("queue_depth") or 0)
-                  + int(sec.get("running") or 0))
+                  + int(sec.get("running") or 0)
+                  + int(sec.get("waiting_handoffs") or 0))
         return queued / width + float(sec.get("kv_utilization") or 0.0)
 
     def snapshot(self):
@@ -252,6 +283,7 @@ class Router:
         with self._lock:
             now = self.clock()
             return [{"url": r.url, "replica": r.name, "state": r.state,
+                     "role": r.role,
                      "load": round(r.load, 4),
                      "consecutive_failures": r.consecutive_failures,
                      "breaker_open": bool(r.open_until is not None
@@ -259,9 +291,13 @@ class Router:
                     for r in self._replicas]
 
     # -- picking -------------------------------------------------------------
-    def _pick(self, exclude):
+    def _pick(self, exclude, want=None):
         """Least-loaded READY replica with a closed (or probe-ready)
-        breaker, excluding already-tried ones; round-robin tiebreak."""
+        breaker, excluding already-tried ones; round-robin tiebreak.
+        ``want`` filters by role capability: ``"prefill"`` skips
+        decode-only replicas, ``"decode"`` skips prefill-only ones
+        (role "both" — and never-scraped legacy replicas — serve
+        either)."""
         with self._lock:
             now = self.clock()
             rr = next(self._rr)
@@ -271,6 +307,10 @@ class Router:
                 if r.url in exclude:
                     continue
                 if r.state in ("draining", "down"):
+                    continue
+                if want == "prefill" and r.role == "decode":
+                    continue
+                if want == "decode" and r.role == "prefill":
                     continue
                 if r.open_until is not None:
                     if r.open_until > now:
@@ -358,12 +398,12 @@ class Router:
                         f"{last_error})")
                 body = json.dumps(dict(base,
                                        deadline_s=remaining)).encode()
-            r = self._pick(tried)
+            r = self._pick(tried, want="prefill")
             if r is None and tried:
                 # every replica tried once: second pass may retry one
                 # (it may have recovered / stopped rejecting)
                 tried = set()
-                r = self._pick(tried)
+                r = self._pick(tried, want="prefill")
             if r is None:
                 last_error = "no_replica"
                 continue
@@ -373,6 +413,14 @@ class Router:
             hop_wall = time.perf_counter() - h0
             hops.append({"replica": r.name, "status": code,
                          "wall_s": round(hop_wall, 6)})
+            if code == 200 and "handoff" in payload:
+                # a prefill-role replica answered with the KV handoff
+                # envelope, not tokens: move it (and the remaining
+                # deadline + the same trace id) to a decode replica
+                self._hop_ok(r, status="prefill_ok")
+                return self._route_handoff(
+                    payload["handoff"], base, request_id, trace_id,
+                    deadline_s, t0, hops, attempt)
             if code == 200:
                 self._hop_ok(r)
                 wall = time.perf_counter() - t0
@@ -407,13 +455,129 @@ class Router:
             f"(last error: {last_error}); hops: "
             + ", ".join(f"{h['replica']}:{h['status']}" for h in hops))
 
-    def _post(self, r, body, trace_id):
+    def _route_handoff(self, ho, base, request_id, trace_id,
+                       deadline_s, t0, hops, attempts):
+        """Move one prefill replica's handoff envelope to a decode
+        replica and return the completed generation.
+
+        Own sibling-retry loop: the KV payload stays in the router's
+        hand, so a handoff that times out, disconnects, or lands on a
+        dead/draining decode replica is simply re-sent to another one
+        (request-id idempotency makes the re-send safe, content-keyed
+        records make a partial first delivery harmless).  The deadline
+        is the SAME end-to-end budget the prefill hop was already
+        drawing down; each attempt first runs the ``/handoff_probe``
+        dedup round and skips the bytes of blocks the target already
+        caches."""
+        records = list(ho.get("records") or [])
+        keys = [rec.get("key") for rec in records]
+        tried = set()
+        last_error = "no_decode_replica"
+        for attempt in range(1, max(1, self.retries) + 1):
+            if attempt > 1:
+                self._m_retries.inc()
+                self.sleep(min(self.backoff_max_s,
+                               self.backoff_s * 2 ** (attempt - 2)))
+            remaining = None
+            if deadline_s is not None:
+                remaining = deadline_s - (time.perf_counter() - t0)
+                if remaining <= 0:
+                    self._m_requests.labels(outcome="deadline").inc()
+                    self._m_handoffs.labels(outcome="deadline").inc()
+                    raise PermanentError(
+                        f"deadline_s={deadline_s} exhausted during "
+                        f"handoff after {attempt - 1} attempt(s) "
+                        f"(last error: {last_error})")
+            r = self._pick(tried, want="decode")
+            if r is None and tried:
+                tried = set()
+                r = self._pick(tried, want="decode")
+            if r is None:
+                last_error = "no_decode_replica"
+                continue
+            tried.add(r.url)
+            send = records
+            if keys and all(keys):
+                missing = self._probe_handoff(r, keys)
+                if missing is not None:
+                    miss = set(missing)
+                    skipped = sum(1 for k in keys if k not in miss)
+                    if skipped:
+                        self._m_handoff_dedup.inc(skipped)
+                    # the radix key IS the dedup: blocks the target
+                    # already caches travel as key+tokens only (the
+                    # receiver re-verifies the chain either way)
+                    send = [rec if rec["key"] in miss else
+                            {k: rec[k]
+                             for k in ("key", "parent", "tokens")}
+                            for rec in records]
+            body = json.dumps(dict(base, records=send,
+                                   deadline_s=remaining)).encode()
+            h0 = time.perf_counter()
+            code, payload = self._post(r, body, trace_id,
+                                       path="/handoff")
+            hop_wall = time.perf_counter() - h0
+            hops.append({"replica": r.name, "status": code,
+                         "wall_s": round(hop_wall, 6),
+                         "hop": "handoff"})
+            if code == 200:
+                self._hop_ok(r)
+                wall = time.perf_counter() - t0
+                added = max(0.0, wall - sum(h["wall_s"] for h in hops))
+                self._m_added.observe(added)
+                self._m_requests.labels(outcome="ok").inc()
+                self._m_handoffs.labels(outcome="ok").inc()
+                return RouterResult(
+                    tokens=payload["tokens"],
+                    replica=payload["replica"], trace_id=trace_id,
+                    request_id=request_id, attempts=attempts + attempt,
+                    hops=hops, wall_s=wall, added_s=added)
+            if code == "rejected_permanent":
+                self._hop_ok(r, status="rejected_permanent")
+                self._m_requests.labels(outcome="permanent").inc()
+                self._m_handoffs.labels(outcome="permanent").inc()
+                raise PermanentError(
+                    f"handoff rejected as unservable: "
+                    f"{payload.get('error')} (replica {r.name})")
+            last_error = (payload or {}).get("error", str(code))
+            self._hop_failed(r, str(code),
+                             breaker=self._counts_for_breaker(code,
+                                                              payload))
+            if last_error == "draining":
+                with self._lock:
+                    r.state = "draining"
+        self._m_requests.labels(outcome="exhausted").inc()
+        self._m_handoffs.labels(outcome="exhausted").inc()
+        raise NoReplicaAvailable(
+            f"handoff for {request_id} failed after {self.retries} "
+            f"attempt(s) (last error: {last_error}); hops: "
+            + ", ".join(f"{h['replica']}:{h['status']}" for h in hops))
+
+    def _probe_handoff(self, r, keys):
+        """``/handoff_probe`` dedup round: the subset of ``keys`` the
+        target does NOT cache (those need their bytes).  None when the
+        probe itself fails — the probe is purely a bytes optimization,
+        so failure means "send everything", never an error."""
+        req = urllib.request.Request(
+            f"{r.url}/handoff_probe",
+            data=json.dumps({"keys": keys}).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=min(self.timeout_s, 5.0)) as resp:
+                out = json.loads(resp.read())
+            missing = out.get("missing")
+            return missing if isinstance(missing, list) else None
+        except (OSError, ValueError):
+            return None
+
+    def _post(self, r, body, trace_id, path="/generate"):
         """One hop.  Returns ``(200, payload)``,
         ``("rejected_permanent", payload)`` for a 400-class rejection,
         or ``(status_label, payload_or_None)`` for retriable failures
         (503 rejections, timeouts, disconnects)."""
         req = urllib.request.Request(
-            f"{r.url}/generate", data=body, method="POST",
+            f"{r.url}{path}", data=body, method="POST",
             headers={"Content-Type": "application/json",
                      TRACE_HEADER: trace_id})
         try:

@@ -316,7 +316,12 @@ class Supervisor:
             if hz is not None and hz.get("state") == "draining" \
                     and not hz.get("in_flight") \
                     and not hz.get("queue_depth") \
-                    and not hz.get("running"):
+                    and not hz.get("running") \
+                    and not hz.get("waiting_handoffs"):
+                # waiting_handoffs: a decode replica mid-KV-ingest has
+                # work the queue/running counts don't show yet — a
+                # drain is not complete until those land or resolve
+                # (absent on legacy replicas: falsy, same decision)
                 return True
             self.sleep(0.05)
         return False

@@ -397,8 +397,12 @@ class Engine:
         self.blocks = BlockManager(self.num_blocks, self.block_size,
                                    prefix_cache=prefix_cache,
                                    host_pool=self._host_pool)
-        if self._host_pool is not None:
-            self.blocks.set_offload_source(self._host_kv_fetch)
+        # always registered: the eviction path only offloads with a
+        # pool attached, but export_blocks (the prefill→decode handoff
+        # serializer) gathers device blocks D2H through the same fetch
+        # on pool-less prefill replicas too — pure numpy, no program or
+        # fingerprint changes, byte-for-byte inert for plain serving
+        self.blocks.set_offload_source(self._host_kv_fetch)
         # request-scoped observability: the tracer threads every
         # lifecycle event (scheduler decisions included) into the
         # flight-recorder ring, the optional JSONL export
@@ -613,7 +617,7 @@ class Engine:
 
     # -- public API ----------------------------------------------------------
     def submit(self, prompt, max_new_tokens=64, deadline_s=None,
-               tenant=None, trace_id=None):
+               tenant=None, trace_id=None, handoff=False):
         """Queue one generation request; returns its ``Request`` handle.
 
         Raises ``QueueFull`` when the admission queue is at capacity
@@ -624,12 +628,15 @@ class Engine:
         ``tenant`` labels the request for fair-share admission and the
         per-tenant telemetry series; ``trace_id`` pre-stamps the trace
         identity (a fleet router propagates one so a request retried
-        across replicas stitches into a single cross-process timeline).
+        across replicas stitches into a single cross-process timeline);
+        ``handoff`` marks a prefill→decode handoff ingest (the decode
+        replica's re-submission) for the admit trace event and the
+        scheduler's ``waiting_handoffs`` load signal.
         """
         if not self._alive:
             raise RuntimeError("engine is shut down")
         req = Request(prompt, max_new_tokens, deadline_s=deadline_s,
-                      tenant=tenant)
+                      tenant=tenant, handoff=handoff)
         if trace_id:
             req.trace_id = str(trace_id)
         if req.target_len() > self.max_model_len:
@@ -876,6 +883,23 @@ class Engine:
         if self._kv_quant and self._scale_k is not None:
             info["kv_scale_bytes"] = 2 * int(self._scale_k.nbytes)
         return info
+
+    def host_block_spec(self):
+        """Shapes/dtypes of ONE block's host-copy arrays — the layout
+        ``_host_kv_fetch`` produces and the restore program consumes:
+        K and V ``(layers, block_size, kv_heads, head_dim)`` in the
+        cache dtype, plus the two f32 scale-slot arrays under int8 KV.
+        This is the prefill→decode handoff wire decoder's contract: a
+        receiving replica validates every record's raw bytes against
+        these specs before trusting them."""
+        L, bs = self._cfg.n_layers, self.block_size
+        Hkv, Dh = self._cfg.kv_heads, self._cfg.head_dim
+        dt = np.dtype(str(self._cache_k.dtype))
+        specs = [((L, bs, Hkv, Dh), dt), ((L, bs, Hkv, Dh), dt)]
+        if self._kv_quant:
+            f32 = np.dtype(np.float32)
+            specs += [((L, bs, Hkv), f32), ((L, bs, Hkv), f32)]
+        return specs
 
     def host_kv_stats(self):
         """The ``/statusz`` ``host_kv`` section: DRAM budget and

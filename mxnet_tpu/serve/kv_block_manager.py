@@ -69,6 +69,26 @@ pool has its own LRU with the same leaf-only discipline.  Without a
 pool every prefix eviction throws K/V away; ``discarded_tokens``
 counts exactly those tokens — the number this tier exists to drive
 down.
+
+Prefill/decode handoff (``export_blocks`` / ``import_blocks``)
+--------------------------------------------------------------
+
+The same content-keyed host copies double as the WIRE FORMAT for
+disaggregated serving (DistServe-style role-split fleets): a
+prefill-role replica serializes a finished prompt's cached chain with
+``export_blocks`` (device blocks gathered D2H through the offload
+fetch path, already-parked blocks peeked from the pool) and a
+decode-role replica ingests the records with ``import_blocks`` into
+ITS host pool under the same keys — the existing radix walk + async
+restore program then pull them HBM-ward ahead of the first decode
+read, so a transferred span counts as ``cached_tokens`` and no decode
+program changes.  Every record is verified against the chain hash
+``H(parent_key, token_ids)`` at import: a truncated or corrupted
+payload fails verification, the chain stops there, and the receiver
+simply recomputes the rest from the prompt (degradation, never
+corruption).  Equal keys mean equal prefixes, so the radix key IS the
+transfer dedup — a receiver that already holds a block (either tier)
+skips its bytes.
 """
 
 from __future__ import annotations
@@ -170,6 +190,12 @@ class HostKVPool:
         self._m_discarded = telemetry.counter(
             "mxtpu_serve_prefix_discarded_tokens_total",
             "tokens whose cached K/V an eviction threw away for good")
+        # a fleet silently degrading restores to recompute must be
+        # visible in Prometheus, not only in the pool's local counter
+        self._m_degraded = telemetry.counter(
+            "mxtpu_serve_host_kv_degraded_total",
+            "host-tier restore claims degraded to recompute "
+            "(restore budget exceeded)")
 
     def __len__(self):
         with self._lock:
@@ -258,11 +284,21 @@ class HostKVPool:
                 if (self.restore_budget_s
                         and self.fault_delay_s > self.restore_budget_s):
                     self.degraded += 1
+                    self._m_degraded.inc()
                     return None
                 time.sleep(self.fault_delay_s)   # the simulated copy
             _, arrays, _ = self._remove(key)
             self.restores += 1
             return arrays
+
+    def peek(self, key):
+        """``key``'s host arrays WITHOUT claiming (the entry stays
+        parked, recency untouched); None on miss.  The handoff export
+        path reads parked blocks through this — an export must never
+        chaos-delay, degrade, or pop the local tier."""
+        with self._lock:
+            entry = self._entries.get(key)
+            return None if entry is None else entry[1]
 
     def unclaim(self, key, parent, arrays):
         """Return a claimed entry after a failed allocation (no new
@@ -553,6 +589,98 @@ class BlockManager:
                 return 0, 0
             hits, host = self._walk(token_ids)
             return len(hits), (len(hits) + len(host)) * self.block_size
+
+    # -- prefill/decode handoff ----------------------------------------------
+    def export_blocks(self, rid, token_ids):
+        """Serialize ``rid``'s cached prefix chain for ``token_ids``
+        (its prompt) as wire records — the prefill side of a
+        disaggregated prefill→decode handoff.
+
+        Returns ``[(key, parent_key, block_token_ids, arrays), ...]``
+        in prefix order: ``key``/``parent_key`` are the content-
+        addressed radix keys (``parent_key`` None for the root block),
+        ``arrays`` the block's host copies in the offload-tier layout
+        (K, V[, int8 scale pairs]).  Derivation is purely content-
+        addressed — the chain is re-walked from the token ids, so the
+        export works both while ``rid`` is live and right after it
+        finished (its published blocks park refcount-0 with K/V
+        intact).  Device-resident blocks gather D2H through the
+        registered offload fetch; already-parked blocks are peeked
+        from the host pool without claiming.  A block missing from
+        both tiers (evicted under pressure) ends the chain — the
+        importer recomputes the rest, never a gap."""
+        with self._lock:
+            if not self.prefix_cache or self._offload_fetch is None:
+                return []
+            bs = self.block_size
+            n = len(token_ids)
+            out = []
+            parent = _ROOT
+            parent_key = None
+            while (len(out) + 1) * bs <= n:
+                b = len(out)
+                tok = [int(t) for t in token_ids[b * bs:(b + 1) * bs]]
+                key = _block_key(parent, tok)
+                blk = self._index.get(key)
+                arrays = None
+                if blk is not None:
+                    arrays = self._offload_fetch(blk)
+                elif self.host is not None:
+                    arrays = self.host.peek(key)
+                if arrays is None:
+                    break
+                out.append((key, parent_key, tok, tuple(arrays)))
+                parent_key = key
+                parent = key
+            return out
+
+    def import_blocks(self, records):
+        """Ingest handoff records into the host tier under their
+        content keys — the decode side of a prefill→decode handoff.
+
+        ``records`` is ``export_blocks``'s shape, in prefix order;
+        ``arrays`` may be None for a block the sender's dedup probe
+        found already hosted here (bytes skipped on the wire).  Every
+        record is VERIFIED against the chain hash before it parks: a
+        key that doesn't equal ``H(parent, token_ids)``, a record out
+        of chain order, or a missing/undersized payload breaks the
+        chain right there (content addressing is the integrity check —
+        a truncated or corrupted handoff degrades to recompute, it can
+        never poison the radix index).  Returns ``(imported, deduped,
+        rejected)`` block counts; imported blocks are radix-walk hits
+        from the very next ``allocate``, restored HBM-ward by the
+        existing async restore path."""
+        imported = deduped = 0
+        with self._lock:
+            expect_parent = None
+            parent = _ROOT
+            for key, parent_key, token_ids, arrays in records:
+                if (parent_key != expect_parent
+                        or len(token_ids) != self.block_size
+                        or _block_key(parent, token_ids) != key):
+                    break
+                if key in self._index or (self.host is not None
+                                          and self.host.has(key)):
+                    deduped += 1
+                elif (arrays is None or self.host is None
+                        or not self.host.put(key, parent_key,
+                                             tuple(arrays))):
+                    break
+                else:
+                    imported += 1
+                expect_parent = key
+                parent = key
+        return imported, deduped, len(records) - imported - deduped
+
+    def has_blocks(self, keys):
+        """The subset of ``keys`` cached in EITHER tier right now —
+        the handoff dedup probe (a sender skips the bytes of blocks
+        the receiver already holds; a probe-then-evict race just means
+        the chain breaks at import and the tail recomputes)."""
+        with self._lock:
+            return [k for k in keys
+                    if k in self._index
+                    or (self.host is not None and self.host.has(k))]
 
     # -- allocation ----------------------------------------------------------
     def _take(self, n):

@@ -60,8 +60,14 @@ _rid_counter = itertools.count()
 class Request:
     """One generation request and its serving-side bookkeeping."""
 
-    def __init__(self, prompt, max_new_tokens, deadline_s=None, tenant=None):
+    def __init__(self, prompt, max_new_tokens, deadline_s=None, tenant=None,
+                 handoff=False):
         self.rid = next(_rid_counter)
+        # prefill→decode handoff ingest (disaggregated fleets): the
+        # decode replica marks the re-submitted request so the admit
+        # trace and the /healthz waiting_handoffs load signal can tell
+        # an in-flight ingest from a plain prompt
+        self.handoff = bool(handoff)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -321,6 +327,13 @@ class Scheduler:
     def queue_depth(self):
         return len(self.waiting)
 
+    def waiting_handoffs(self):
+        """Handoff-ingested requests still awaiting admission — the
+        decode replica's /healthz load signal: a router's least-loaded
+        pick must see in-flight ingests, not just decode occupancy."""
+        with self._lock:
+            return sum(1 for r in self.waiting if r.handoff)
+
     def has_work(self):
         return bool(self.waiting or self.running or self.prefilling)
 
@@ -441,7 +454,10 @@ class Scheduler:
                     queue_depth=len(self.waiting),
                     n_preemptions=req.n_preemptions,
                     cached_tokens=cached,
-                    host_tokens=req.host_restored_len, chunked=chunked)
+                    host_tokens=req.host_restored_len, chunked=chunked,
+                    # only-when-on: plain requests' trace lines stay
+                    # byte-identical to pre-handoff releases
+                    **({"handoff": True} if req.handoff else {}))
                 prefills.append(req)
                 if chunked:
                     self.prefilling.append(req)

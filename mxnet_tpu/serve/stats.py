@@ -67,6 +67,10 @@ class ServeStats:
     host_kv_offloads: int = 0
     host_kv_evictions: int = 0
     host_kv_degraded: int = 0
+    # pool inserts rejected for size (offloads AND handoff imports —
+    # a decode-role replica whose pool rejects ingests re-pays the
+    # prefill compute the handoff was meant to ship)
+    host_kv_rejects: int = 0
     host_kv_bytes_used: int = 0
     host_kv_entries: int = 0
     # speculative decoding (serve/spec.py): draft-proposed tokens and
@@ -288,6 +292,7 @@ class StatsRecorder:
             host_kv_offloads=host.get("offloads", 0),
             host_kv_evictions=host.get("evictions", 0),
             host_kv_degraded=host.get("degraded", 0),
+            host_kv_rejects=host.get("rejects", 0),
             host_kv_bytes_used=host.get("bytes_used", 0),
             host_kv_entries=host.get("entries", 0),
         )

@@ -675,6 +675,8 @@ def test_replica_and_fleet_env_knobs_documented():
     with open(os.path.join(REPO, "docs", "env_vars.md")) as f:
         doc = f.read()
     for var in ("MXTPU_FAULT_SPEC", "MXTPU_FLEET_TIMEOUT",
+                "MXTPU_FLEET_ROLE", "MXTPU_FAULT_HANDOFF_DELAY",
+                "MXTPU_FAULT_HANDOFF_DROP",
                 "MXTPU_FLEET_RETRIES", "MXTPU_FLEET_BACKOFF",
                 "MXTPU_FLEET_BACKOFF_MAX", "MXTPU_FLEET_BREAKER_FAILS",
                 "MXTPU_FLEET_BREAKER_RESET",

@@ -634,6 +634,33 @@ def test_stats_statusz_metrics_three_view_agreement(model):
         telemetry.reset()
 
 
+def test_degraded_counter_reaches_metrics_registry():
+    """Satellite (ISSUE 13): `HostKVPool` counts restore-budget
+    degradations locally, and the registry must see the SAME number as
+    `mxtpu_serve_host_kv_degraded_total` — a fleet silently falling
+    back to recompute was invisible in Prometheus before this."""
+    from mxnet_tpu import telemetry
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        p = HostKVPool(1024, block_tokens=4)
+        p.put(b"k1", None, _arrs(1))
+        p.put(b"k2", b"k1", _arrs(2))
+        p.fault_delay_s, p.restore_budget_s = 1.0, 0.05
+        assert p.claim(b"k1") is None
+        assert p.claim(b"k2") is None
+        assert p.claim(b"missing") is None    # a MISS never counts
+        assert p.degraded == 2
+        snap = telemetry.registry().snapshot()
+        fam = snap["mxtpu_serve_host_kv_degraded_total"]
+        assert fam["samples"][0]["value"] == float(p.degraded)
+        # and the ServeStats view is fed from the same pool counter
+        assert p.stats()["degraded"] == p.degraded
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
 def test_statusz_and_stats_expose_host_tier(model):
     eng = _engine(model, num_blocks=16, host_kv_bytes=POOL)
     rng = np.random.RandomState(41)

@@ -268,23 +268,21 @@ def run_lint_stage(timeout=300):
     return True
 
 
-def run_fleet_stage(timeout=900):
-    """Fleet robustness artifact (tools/fleet_bench.py): availability
-    under one injected replica kill + rolling-restart downtime through
-    the router/supervisor stack.  Deliberately CPU (N replica
-    processes cannot share the single-client chip, and the property —
-    fault-transparent routing — is backend-agnostic), so like the lint
-    stage it needs no TPU and runs even on chip-down rounds."""
-    out = os.path.join(REPO, "FLEET_BENCH.json")
+def _run_fleet_artifact(name, cli_args, artifact, gate, summary,
+                        timeout):
+    """Shared driver for the fleet-family stages: spawn
+    tools/fleet_bench.py in its OWN process group (a timeout must take
+    the replica subprocesses down WITH it — SIGKILLing only the parent
+    would orphan them for the rest of the watch window), parse the
+    atomic JSON, gate the contract (``gate(payload)`` returns a
+    failure reason or None), record + commit the artifact."""
+    out = os.path.join(REPO, artifact)
     tmp = out + ".tmp"
     if os.path.exists(tmp):
         os.unlink(tmp)
-    # own process group: a timeout must take the 3 replica
-    # subprocesses down WITH fleet_bench — SIGKILLing only the parent
-    # would orphan them for the rest of the watch window
     proc = subprocess.Popen(
-        [sys.executable, os.path.join(REPO, "tools", "fleet_bench.py"),
-         "--json", tmp],
+        [sys.executable, os.path.join(REPO, "tools", "fleet_bench.py")]
+        + cli_args + ["--json", tmp],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         start_new_session=True)
     stderr_tail = ""
@@ -299,25 +297,67 @@ def run_fleet_stage(timeout=900):
         except (OSError, ProcessLookupError):
             pass               # group already gone
         proc.wait()
-        log("fleet: timed out (process group killed)")
+        log(f"{name}: timed out (process group killed)")
         return False
     try:
         with open(tmp) as f:
             payload = json.loads(f.readlines()[-1])
         os.unlink(tmp)
     except (OSError, IndexError, ValueError) as e:
-        log(f"fleet: no JSON ({e}): {stderr_tail}")
+        log(f"{name}: no JSON ({e}): {stderr_tail}")
         return False
-    if not payload.get("complete") or payload.get("availability") != 1.0:
-        log(f"fleet: contract failed (complete={payload.get('complete')}, "
-            f"availability={payload.get('availability')})")
+    reason = gate(payload)
+    if reason:
+        log(f"{name}: contract failed ({reason})")
         return False
-    record("fleet", payload)
+    record(name, payload)
     with open(out, "w") as f:
         f.write(json.dumps(payload, indent=1) + "\n")
-    log(f"fleet: captured (availability={payload['availability']}, "
-        f"rolling_restart_s={payload.get('rolling_restart_s')})")
+    log(f"{name}: captured ({summary(payload)})")
     return True
+
+
+def run_fleet_stage(timeout=900):
+    """Fleet robustness artifact (tools/fleet_bench.py): availability
+    under one injected replica kill + rolling-restart downtime through
+    the router/supervisor stack.  Deliberately CPU (N replica
+    processes cannot share the single-client chip, and the property —
+    fault-transparent routing — is backend-agnostic), so like the lint
+    stage it needs no TPU and runs even on chip-down rounds."""
+    def gate(p):
+        if not p.get("complete") or p.get("availability") != 1.0:
+            return (f"complete={p.get('complete')}, "
+                    f"availability={p.get('availability')}")
+        return None
+
+    return _run_fleet_artifact(
+        "fleet", [], "FLEET_BENCH.json", gate,
+        lambda p: (f"availability={p['availability']}, "
+                   f"rolling_restart_s={p.get('rolling_restart_s')}"),
+        timeout)
+
+
+def run_fleet_disagg_stage(timeout=900):
+    """Disaggregated prefill/decode artifact (tools/fleet_bench.py
+    --disagg): role-split fleet vs role="both" fleet on one seeded
+    workload — decode-stall p99 both ways, handoff bytes/dedup, token
+    identity.  CPU-only like the fleet stage (replica subprocesses),
+    so it runs ahead of the chip probe too.  Contract: complete:true
+    (availability 1.0 both arms + byte-identical tokens + handoffs
+    actually flowed) AND decode-stall p99 improved >= 3x."""
+    def gate(p):
+        if not p.get("complete") or not p.get("tokens_identical") \
+                or (p.get("stall_improvement") or 0) < 3:
+            return (f"complete={p.get('complete')}, "
+                    f"identical={p.get('tokens_identical')}, "
+                    f"improvement={p.get('stall_improvement')}")
+        return None
+
+    return _run_fleet_artifact(
+        "fleet_disagg", ["--disagg"], "DISAGG_BENCH.json", gate,
+        lambda p: (f"stall improvement {p.get('stall_improvement')}x, "
+                   f"dedup {p.get('handoff_dedup_blocks')} blocks"),
+        timeout)
 
 
 def run_bandwidth(timeout=1200):
@@ -664,7 +704,7 @@ def main():
     # lane (24 cases, 21 ever green), the tuned flash blocks (committed
     # record shows flash LOSING), the never-measured fused RNN — then
     # the headline benches, then the new r5 records, then the long tail
-    done = {"lint": False, "fleet": False,
+    done = {"lint": False, "fleet": False, "fleet_disagg": False,
             "consistency": False, "flash": False, "rnn": False,
             "resnet": False, "resnet256": False, "gpt": False,
             "longcontext": False, "bandwidth": False, "cifar": False,
@@ -721,6 +761,15 @@ def main():
                 continue
             done["fleet"] = attempt(
                 "fleet", lambda: run_fleet_stage(timeout=min(900, left)))
+        # disaggregated prefill/decode A/B: CPU-only for the same
+        # reason (role-split replica subprocesses), probe-free too
+        if not done["fleet_disagg"]:
+            left = deadline - time.monotonic()
+            if left < 120:
+                continue
+            done["fleet_disagg"] = attempt(
+                "fleet_disagg",
+                lambda: run_fleet_disagg_stage(timeout=min(900, left)))
         if not probe():
             log("TPU unreachable; retrying in 60s")
             time.sleep(60)

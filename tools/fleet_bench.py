@@ -31,8 +31,24 @@ smoke.  This bench runs the replicas on the CPU backend by design —
 N single-host processes cannot share one TPU client, and the
 property under test (fault-transparent routing) is backend-agnostic.
 
+``--disagg`` runs the disaggregated prefill/decode A/B instead
+(DISAGG_BENCH.json, the bench_watch ``fleet_disagg`` stage): a
+1-prefill + N-decode role-split fleet vs an equal-size role="both"
+fleet, same seeded workload — steady decode streams with long prompts
+injected mid-run.  Per-replica request traces yield the headline:
+**decode-stall p99** (gaps between a running stream's consecutive
+decode iterations).  On role="both" replicas an arriving long prompt's
+whole-prompt prefill stalls every co-resident stream; on decode-role
+replicas prefill work is ~zero (imported KV chains restore from the
+handoff, only the final span recomputes), so streams emit a token
+every iteration regardless of arriving prompt length.  Also recorded:
+handoff wire bytes, dedup hits (content keys the receivers already
+cached), availability, and token identity between the two arms.
+
 Usage: python tools/fleet_bench.py [--json OUT] [--replicas 3]
            [--requests 24 --rate 8 --max-new 16 --kill-at 4]
+       python tools/fleet_bench.py --disagg [--json OUT]
+           [--decode-replicas 2 --decoders 4 --long-prompts 3]
 """
 
 import argparse
@@ -105,6 +121,204 @@ def run_load(router, workload, rate, max_new, rng, tag):
     return results, failures
 
 
+def _disagg_workload(args):
+    """Deterministic disagg workload: steady decode streams (short
+    shared-prefix prompts, long generations) plus long prompts (half
+    shared among themselves — handoff dedup fodder) injected mid-run.
+    Returns ``(decoders, longs)`` as (prompt, max_new) lists."""
+    import numpy as np
+
+    rng = np.random.RandomState(args.seed + 7)
+    shared = rng.randint(1, args.vocab, size=8).tolist()
+    decoders = [(shared + rng.randint(
+        1, args.vocab, size=max(1, args.decoder_len - 8)).tolist(),
+        args.decode_new) for _ in range(args.decoders)]
+    long_shared = rng.randint(1, args.vocab,
+                              size=args.long_len // 2).tolist()
+    longs = [(long_shared + rng.randint(
+        1, args.vocab, size=args.long_len - len(long_shared)).tolist(),
+        args.long_new) for _ in range(args.long_prompts)]
+    return decoders, longs
+
+
+def _decode_stall_gaps(trace_files):
+    """Per-request gaps between consecutive decode-iteration trace
+    events, pooled across the replicas' request-trace JSONL files —
+    the decode-stall distribution (a long prompt monopolizing an
+    iteration shows up as one big gap in every co-scheduled stream)."""
+    gaps = []
+    for path in trace_files:
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                ts = [e["t"] for e in rec.get("events", [])
+                      if e.get("ev") == "decode"]
+                gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+    return gaps
+
+
+def _run_disagg_arm(args, roles, tag, trace_dir):
+    """One fleet arm: spawn ``roles``-shaped replicas, drive the
+    workload, scrape the handoff counters, tear down.  Returns the
+    arm record (tokens per request, stall gaps, handoff stats)."""
+    from mxnet_tpu.fleet import ProcessReplica, Router, Supervisor
+    from mxnet_tpu.fleet.supervisor import replica_command
+    import urllib.request
+
+    def spawn(slot):
+        env = dict(os.environ)
+        env.pop("MXTPU_FAULT_SPEC", None)
+        env["MXTPU_FLEET_ROLE"] = roles[slot]
+        env["MXTPU_REQUEST_TRACE"] = os.path.join(
+            trace_dir, f"{tag}-{slot}.jsonl")
+        handle = ProcessReplica(
+            replica_command(extra_args=[
+                "--backend", "cpu", "--seed", str(args.seed),
+                "--vocab", str(args.vocab), "--warmup", "full",
+                "--max-model-len", str(args.max_model_len),
+                "--num-blocks", str(args.num_blocks),
+                # a bigger-than-smoke model: the A/B exists to show
+                # prefill/decode interference, which needs prefill
+                # compute that actually dominates a decode iteration
+                "--layers", str(args.model_layers),
+                "--d-model", str(args.model_d),
+                "--heads", str(args.model_heads),
+                "--role", roles[slot]]),
+            env=env)
+        handle.wait_ready(timeout_s=300)
+        return handle
+
+    router = Router([], scrape_interval_s=0.25, timeout_s=60.0,
+                    retries=4, backoff_s=0.05, backoff_max_s=0.5,
+                    breaker_fails=3, breaker_reset_s=2.0)
+    sup = Supervisor(spawn, len(roles), router=router,
+                     restart_backoff_s=0.2)
+    decoders, longs = _disagg_workload(args)
+    results, failures = {}, {}
+    lock = threading.Lock()
+
+    def one(idx, prompt, max_new):
+        try:
+            res = router.generate(prompt, max_new_tokens=max_new,
+                                  request_id=f"{tag}-{idx}",
+                                  trace_id=f"{tag}-trace-{idx}")
+            with lock:
+                results[idx] = res
+        except Exception as e:
+            with lock:
+                failures[idx] = f"{type(e).__name__}: {e}"
+
+    handoff = {"received": 0, "exported": 0, "blocks_imported": 0,
+               "blocks_deduped": 0, "blocks_rejected": 0,
+               "bytes_received": 0, "bytes_exported": 0}
+    try:
+        sup.start()
+        router.scrape()
+        router.start()
+        sup.run(interval_s=0.25)
+        threads = []
+        # steady streams first, long prompts injected while they run
+        for i, (prompt, max_new) in enumerate(decoders):
+            th = threading.Thread(target=one, args=(i, prompt, max_new),
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+            time.sleep(0.05)
+        time.sleep(args.long_delay)
+        for j, (prompt, max_new) in enumerate(longs):
+            th = threading.Thread(
+                target=one, args=(len(decoders) + j, prompt, max_new),
+                daemon=True)
+            th.start()
+            threads.append(th)
+            time.sleep(args.long_gap)
+        for th in threads:
+            th.join(timeout=300)
+        # scrape the per-replica handoff counters before teardown
+        for h in sup.handles():
+            if h is None or not h.url:
+                continue
+            try:
+                with urllib.request.urlopen(f"{h.url}/statusz.json",
+                                            timeout=10) as resp:
+                    sec = json.loads(resp.read()).get("replica") or {}
+            except (OSError, ValueError):
+                continue
+            for k in handoff:
+                handoff[k] += int((sec.get("handoff") or {}).get(k, 0))
+    finally:
+        router.stop()
+        sup.stop()
+    gaps = _decode_stall_gaps(
+        [os.path.join(trace_dir, f"{tag}-{s}.jsonl")
+         for s in range(len(roles))])
+    n = len(decoders) + len(longs)
+    return {"roles": roles, "submitted": n, "completed": len(results),
+            "availability": round(len(results) / max(1, n), 4),
+            "failures": dict(list(failures.items())[:5]),
+            "tokens": {i: results[i].tokens for i in results},
+            "decode_gaps": len(gaps),
+            "decode_stall_p99_ms": (round(1e3 * percentile(gaps, 0.99), 3)
+                                    if gaps else None),
+            "decode_stall_max_ms": (round(1e3 * max(gaps), 3)
+                                    if gaps else None),
+            "handoff": handoff}
+
+
+def run_disagg(args):
+    """The --disagg A/B: role-split fleet vs role="both" fleet on one
+    seeded workload -> DISAGG_BENCH.json."""
+    import tempfile
+
+    out = {"platform": "cpu", "mode": "disagg",
+           "decode_replicas": args.decode_replicas,
+           "decoders": args.decoders, "decode_new": args.decode_new,
+           "long_prompts": args.long_prompts, "long_len": args.long_len,
+           "complete": False}
+
+    def flush():
+        if args.json:
+            tmp = args.json + ".wip"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(out) + "\n")
+            os.replace(tmp, args.json)
+
+    n_replicas = 1 + args.decode_replicas
+    with tempfile.TemporaryDirectory(prefix="mxtpu-disagg-") as tdir:
+        disagg = _run_disagg_arm(
+            args, ["prefill"] + ["decode"] * args.decode_replicas,
+            "disagg", tdir)
+        out["disagg"] = {k: v for k, v in disagg.items() if k != "tokens"}
+        flush()
+        both = _run_disagg_arm(args, ["both"] * n_replicas, "both", tdir)
+        out["interleaved"] = {k: v for k, v in both.items()
+                              if k != "tokens"}
+    identical = (set(disagg["tokens"]) == set(both["tokens"])
+                 and all(disagg["tokens"][i] == both["tokens"][i]
+                         for i in disagg["tokens"]))
+    out["tokens_identical"] = identical
+    p99_d = disagg["decode_stall_p99_ms"]
+    p99_b = both["decode_stall_p99_ms"]
+    out["stall_improvement"] = (round(p99_b / p99_d, 2)
+                                if p99_d and p99_b else None)
+    out["handoff_bytes"] = disagg["handoff"]["bytes_received"]
+    out["handoff_dedup_blocks"] = disagg["handoff"]["blocks_deduped"]
+    out["complete"] = bool(
+        disagg["availability"] == 1.0 and both["availability"] == 1.0
+        and identical and disagg["handoff"]["received"] > 0)
+    flush()
+    print(json.dumps(out))
+    return 0 if out["complete"] else 1
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--replicas", type=int, default=3)
@@ -121,7 +335,40 @@ def main():
                    help="light-load requests during the rolling restart")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--json", default=None)
+    # -- disaggregated prefill/decode A/B (DISAGG_BENCH.json) ----------
+    p.add_argument("--disagg", action="store_true",
+                   help="run the role-split vs role='both' A/B instead "
+                        "of the chaos/rolling-restart phases")
+    p.add_argument("--decode-replicas", type=int, default=2,
+                   help="decode-role replicas beside the 1 prefill "
+                        "replica (the 'both' arm matches the total)")
+    p.add_argument("--decoders", type=int, default=4,
+                   help="steady decode streams running when the long "
+                        "prompts arrive")
+    p.add_argument("--decoder-len", type=int, default=16)
+    p.add_argument("--decode-new", type=int, default=100,
+                   help="tokens each steady stream generates (long "
+                        "enough to outlive the long-prompt injections)")
+    p.add_argument("--long-prompts", type=int, default=6)
+    p.add_argument("--long-len", type=int, default=800,
+                   help="long-prompt length: dense prefill is O(n^2), "
+                        "so this sets how hard an arrival stalls an "
+                        "interleaved replica's decode batch")
+    p.add_argument("--long-new", type=int, default=8)
+    p.add_argument("--long-delay", type=float, default=0.1,
+                   help="seconds the streams decode before the first "
+                        "long prompt arrives")
+    p.add_argument("--long-gap", type=float, default=0.08,
+                   help="seconds between long-prompt arrivals")
+    p.add_argument("--max-model-len", type=int, default=896)
+    p.add_argument("--num-blocks", type=int, default=768)
+    p.add_argument("--model-layers", type=int, default=4)
+    p.add_argument("--model-d", type=int, default=256)
+    p.add_argument("--model-heads", type=int, default=8)
     args = p.parse_args()
+
+    if args.disagg:
+        return run_disagg(args)
 
     import numpy as np
 

@@ -91,6 +91,17 @@ def main():
     p.add_argument("--tenant-share", type=float, default=None,
                    help="fair-share fraction of the queue per tenant "
                         "(default MXTPU_SERVE_TENANT_SHARE / 1.0 = off)")
+    p.add_argument("--role", choices=("both", "prefill", "decode"),
+                   default=None,
+                   help="disaggregation role (default MXTPU_FLEET_ROLE "
+                        "/ both): prefill replicas answer /generate "
+                        "with a KV handoff envelope, decode replicas "
+                        "serve /handoff ingests only")
+    p.add_argument("--host-kv-bytes", type=int, default=None,
+                   help="host-RAM KV tier byte budget (default "
+                        "MXTPU_SERVE_HOST_KV_BYTES; a decode role "
+                        "without one gets a 256 MiB default — handoff "
+                        "records land in this pool)")
     p.add_argument("--warmup", choices=("auto", "full", "none"),
                    default="auto",
                    help="auto: replay MXTPU_WARMUP_MANIFEST when set; "
@@ -112,12 +123,20 @@ def main():
     import jax
 
     net, params = build_model(mx, args)
+    role = args.role or os.environ.get("MXTPU_FLEET_ROLE") or "both"
+    host_kv = args.host_kv_bytes
+    if host_kv is None and role == "decode" \
+            and not os.environ.get("MXTPU_SERVE_HOST_KV_BYTES"):
+        # a decode replica's entire purpose is ingesting handoff KV —
+        # it needs the host tier; default a 256 MiB pool when nothing
+        # was configured (tiny smoke models use a fraction of it)
+        host_kv = 256 << 20
     engine = mx.serve.Engine(
         params, symbol=net, block_size=args.block_size,
         num_blocks=args.num_blocks, max_batch=args.max_batch,
         max_queue=args.max_queue, max_model_len=args.max_model_len,
         max_prefills_per_step=args.max_prefills,
-        tenant_share=args.tenant_share)
+        tenant_share=args.tenant_share, host_kv_bytes=host_kv)
     warmed = 0
     if args.warmup == "full":
         warmed = engine.warmup()
@@ -126,7 +145,7 @@ def main():
 
     replica = mx.fleet.ReplicaServer(
         engine, host=args.host, port=args.port,
-        replica_id=args.replica_id,
+        replica_id=args.replica_id, role=role,
         on_kill=lambda: os._exit(1))       # a kill fault is a real death
     replica.start()
 
@@ -140,6 +159,7 @@ def main():
     print(json.dumps({
         "ready": True, "port": replica.port, "host": args.host,
         "pid": os.getpid(), "replica_id": replica.replica_id,
+        "role": replica.role,
         "backend": jax.default_backend(),
         "ready_s": round(time.perf_counter() - t0, 3),
         "warmed": warmed,
