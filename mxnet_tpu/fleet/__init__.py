@@ -27,21 +27,38 @@ analog here (ROADMAP item 3) is this package:
 - ``faults``    — ``FaultInjector``: the deterministic chaos hook
   (``MXTPU_FAULT_SPEC``: kill/delay/refuse/hang at request k) that the
   chaos gates in tests/test_fleet.py and tools/fleet_bench.py replay.
+- ``collector`` — ``FleetCollector``: the live observability plane —
+  scrapes every replica's ``/statusz.json`` + ``/metrics`` into
+  per-replica time series (failures isolated per replica), aggregates
+  a role-keyed fleet view at ``GET /fleetz``(+``.json``), receives
+  pushed terminal request-trace lines (``MXTPU_TRACE_PUSH_URL``) for
+  live cross-role stitched timelines, and carries the fleet timeline
+  annotations (supervisor lifecycle, SLO alerts).  Rendered by
+  ``tools/fleet_report.py``; the sensor half of autoscaling.
+- ``slo``       — declarative objectives (``MXTPU_SLO_SPEC``, e.g.
+  ``ttft_p99_ms=500;availability=0.999``) with SRE-workbook
+  fast/slow multi-window burn-rate alerting: a firing alert counts
+  ``mxtpu_slo_burning{objective}``, annotates the fleet timeline and
+  flight-dumps the offending replicas.
 
 Docs: docs/how_to/fleet.md.  Benchmark: ``tools/fleet_bench.py``
 (FLEET_BENCH.json artifact — availability under one injected kill plus
 rolling-restart downtime).
 """
 
+from .collector import FleetCollector
 from .faults import Fault, FaultInjector, parse_fault_spec
 from .replica import (DEAD, DRAINING, READY, ROLES, STARTING,
                       ReplicaServer, TRACE_HEADER)
 from .router import (FleetError, NoReplicaAvailable, PermanentError,
                      Router, RouterResult)
+from .slo import Objective, SLOEvaluator, parse_slo_spec
 from .supervisor import ProcessReplica, Supervisor, probe_health
 
 __all__ = ["ReplicaServer", "Router", "RouterResult", "Supervisor",
            "ProcessReplica", "FaultInjector", "Fault",
            "parse_fault_spec", "probe_health", "FleetError",
            "PermanentError", "NoReplicaAvailable", "TRACE_HEADER",
-           "ROLES", "STARTING", "READY", "DRAINING", "DEAD"]
+           "ROLES", "STARTING", "READY", "DRAINING", "DEAD",
+           "FleetCollector", "SLOEvaluator", "Objective",
+           "parse_slo_spec"]

@@ -250,6 +250,11 @@ class ReplicaServer:
         self.port = self._server.server_address[1]
         if self.replica_id is None:
             self.replica_id = f"replica-{self.port}"
+        # stamp this replica's identity onto the engine's request
+        # tracer: every trace line it writes/ships (MXTPU_TRACE_PUSH_URL
+        # -> the fleet collector) names the replica that served it, so
+        # the collector can attribute SLO-offending requests
+        self.engine._rtrace.identity = self.replica_id
         self._http_thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name=f"mxtpu-replica-http-{self.port}")
@@ -736,9 +741,32 @@ class ReplicaServer:
                        "drops": self._handoff_drops,
                        "bytes_received": self._handoff_bytes_received,
                        "bytes_exported": self._handoff_bytes_exported}
+        s = eng.stats()
         return {"replica": self.replica_id, "state": state,
                 "role": self.role,
                 "served": served, "in_flight": inflight,
+                # the serving ground truth the fleet collector
+                # aggregates (three-view agreement: fleet /fleetz ==
+                # sum of these == the collector's registry series):
+                # monotonic totals plus the local tail-latency SLO
+                # inputs and per-tenant goodput counts
+                "stats": {
+                    "tokens_generated": s.tokens_generated,
+                    "prompt_tokens": s.prompt_tokens,
+                    "completed": s.completed,
+                    "rejected": s.rejected,
+                    "reject_reasons": dict(s.reject_reasons),
+                    "preemptions": s.preemptions,
+                    "decode_tok_per_sec": s.decode_tok_per_sec,
+                    "total_tok_per_sec": s.total_tok_per_sec,
+                    "ttft_ms_p50": s.ttft_ms_p50,
+                    "ttft_ms_p99": s.ttft_ms_p99,
+                    "tpot_ms_p50": s.tpot_ms_p50,
+                    "tpot_ms_p99": s.tpot_ms_p99,
+                    "decode_occupancy": s.decode_occupancy,
+                    "tenants": {t: row.get("completed", 0)
+                                for t, row in s.tenants.items()},
+                },
                 "queue_depth": eng.scheduler.queue_depth,
                 # running includes the chunked-prefill lane: those
                 # requests occupy batch slots and the prefill budget,
@@ -799,6 +827,19 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, self.replica._health())
         elif self.path in ("/statusz.json", "/statusz"):
             self._send_json(200, self.replica.statusz_snapshot())
+        elif self.path == "/metrics":
+            # Prometheus text exposition of the process registry — the
+            # fleet collector's second scrape target (empty until
+            # MXTPU_TELEMETRY enables recording; the endpoint itself
+            # costs nothing when the registry is empty)
+            body = telemetry.to_prometheus_text(
+                telemetry.registry()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self.send_error(404)
 
@@ -814,6 +855,32 @@ class _Handler(BaseHTTPRequestHandler):
                                   "queue_depth":
                                       self.replica.engine.scheduler
                                       .queue_depth})
+            return
+        if self.path == "/flight_dump":
+            # fleet-triggered post-mortem: the collector's SLO layer
+            # asks the OFFENDING replica to dump its flight-recorder
+            # ring when a burn-rate alert fires.  Rides the recorder's
+            # own per-reason rate limit (never force), so an alert
+            # storm cannot fill this replica's disk; never
+            # fault-injected (a post-mortem request is not traffic)
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+            except (ValueError, OSError):
+                body = {}
+            from ..telemetry import flight as flight_mod
+
+            reason = str(body.get("reason") or "fleet_request")[:64]
+            path = flight_mod.recorder().dump(
+                reason, extra={"requested_by": "fleet",
+                               "replica": self.replica.replica_id})
+            telemetry.counter(
+                "mxtpu_fleet_flight_dump_requests_total",
+                "fleet-triggered flight-dump requests",
+                ("outcome",)).labels(
+                    outcome="written" if path else "suppressed").inc()
+            self._send_json(200, {"path": path,
+                                  "replica": self.replica.replica_id})
             return
         if self.path not in ("/generate", "/handoff", "/handoff_probe"):
             self.send_error(404)

@@ -54,6 +54,7 @@ import uuid
 
 from .. import telemetry
 from ..base import env_float, env_int
+from ..telemetry.request_trace import RequestTracer
 from .replica import TRACE_HEADER
 
 __all__ = ["Router", "RouterResult", "FleetError", "PermanentError",
@@ -188,6 +189,27 @@ class Router:
         self._m_handoff_dedup = telemetry.counter(
             "mxtpu_fleet_handoff_dedup_blocks_total",
             "handoff blocks whose bytes the dedup probe skipped")
+        # per-hop wall time by outcome: the stitched-view "router time"
+        # a replica-side trace can never see (ok / reject = structured
+        # 503 back-pressure / timeout / retry = transport failure that
+        # moves to a sibling)
+        self._m_hop_seconds = telemetry.histogram(
+            "mxtpu_fleet_router_hop_seconds",
+            "per-replica hop HTTP wall time by outcome", ("outcome",))
+        self._m_breaker_state = telemetry.gauge(
+            "mxtpu_fleet_breaker_state",
+            "replica circuit breaker: 0 closed, 0.5 half-open probe, "
+            "1 open", ("replica",))
+        # router-side trace lines (the same MXTPU_REQUEST_TRACE /
+        # MXTPU_TRACE_PUSH_URL opt-ins the serve engine honors): one
+        # complete timeline per routed request — pick / hop / probe /
+        # handoff events — under the SAME trace id as the replica-side
+        # lines, so `trace_report --stitch` shows router time next to
+        # replica time.  Inert (no events, no file, no pusher) when
+        # neither knob is set.
+        self._trace = RequestTracer(source="router")
+        self._trace.identity = "router"
+        self._trace_rid = itertools.count(1)
 
     # -- membership ----------------------------------------------------------
     def replicas(self):
@@ -220,6 +242,7 @@ class Router:
         if self._scrape_thread is not None:
             self._scrape_thread.join(timeout=5)
             self._scrape_thread = None
+        self._trace.close()
 
     def _scrape_loop(self):
         while not self._stop_evt.wait(self.scrape_interval_s):
@@ -322,9 +345,12 @@ class Router:
                 return None
             ranked.sort(key=lambda t: (t[0], t[1]))
             best = ranked[0][2]
-            if best.open_until is not None:
+            probing = best.open_until is not None
+            if probing:
                 best.probing = True     # this attempt IS the probe
-            return best
+        if probing:
+            self._m_breaker_state.labels(replica=best.name).set(0.5)
+        return best
 
     @staticmethod
     def _counts_for_breaker(code, payload):
@@ -352,6 +378,10 @@ class Router:
                         and (r.open_until is None or r.open_until <= now):
                     r.open_until = now + self.breaker_reset_s
                     self._m_breaker.labels(replica=r.name).inc()
+            open_now = (r.open_until is not None
+                        and r.open_until > self.clock())
+        self._m_breaker_state.labels(replica=r.name).set(
+            1.0 if open_now else 0.0)
         self._m_hops.labels(replica=r.name, status=status).inc()
 
     def _hop_ok(self, r, status="ok"):
@@ -359,7 +389,53 @@ class Router:
             r.consecutive_failures = 0
             r.open_until = None
             r.probing = False
+        self._m_breaker_state.labels(replica=r.name).set(0.0)
         self._m_hops.labels(replica=r.name, status=status).inc()
+
+    @staticmethod
+    def _hop_outcome(code):
+        """The ``mxtpu_fleet_router_hop_seconds`` outcome label:
+        structured rejections (503-class back-pressure and permanent
+        400s) are ``reject``; transport failures that will move to a
+        sibling are ``retry``; timeouts get their own bucket."""
+        if code == 200:
+            return "ok"
+        if code == "timeout":
+            return "timeout"
+        if code == "rejected_permanent" or code == 503:
+            return "reject"
+        return "retry"
+
+    def _observe_hop(self, code, wall_s):
+        self._m_hop_seconds.labels(
+            outcome=self._hop_outcome(code)).observe(wall_s)
+
+    # -- router-side trace timeline (hop-level events) -----------------------
+    def _trace_begin(self, prompt_len, max_new, tenant, trace_id):
+        """Open a router-side timeline for one routed request (None
+        when tracing is off — every hook below no-ops on None)."""
+        if not self._trace.enabled:
+            return None
+        import types
+
+        req = types.SimpleNamespace(
+            rid=next(self._trace_rid), trace_id=trace_id, tenant=tenant,
+            prompt=types.SimpleNamespace(size=int(prompt_len)),
+            max_new_tokens=int(max_new), tokens=[], n_preemptions=0)
+        self._trace.submitted(req)
+        return req
+
+    def _trace_ev(self, rt, name, **args):
+        if rt is not None:
+            self._trace.event(rt, name, **args)
+
+    def _trace_end(self, rt, name, **args):
+        # terminal names: "finished" for a served request, "cancelled"
+        # for a router-level failure — never "rejected", which would
+        # double-count mxtpu_serve_rejections_total against the
+        # replica-side line that already owns the rejection
+        if rt is not None:
+            self._trace.terminal(rt, name, **args)
 
     # -- the request path ----------------------------------------------------
     def generate(self, prompt, max_new_tokens=64, deadline_s=None,
@@ -377,12 +453,16 @@ class Router:
                 "request_id": request_id}
         body = json.dumps(base).encode()
         t0 = time.perf_counter()
+        rt = self._trace_begin(len(base["prompt"]), max_new_tokens,
+                               tenant, trace_id)
         hops = []
         tried = set()
         last_error = "no_replica"
         for attempt in range(1, max(1, self.retries) + 1):
             if attempt > 1:
                 self._m_retries.inc()
+                self._trace_ev(rt, "retry", attempt=attempt,
+                               last_error=last_error)
                 self.sleep(min(self.backoff_max_s,
                                self.backoff_s * 2 ** (attempt - 2)))
             if deadline_s is not None:
@@ -392,6 +472,7 @@ class Router:
                 remaining = deadline_s - (time.perf_counter() - t0)
                 if remaining <= 0:
                     self._m_requests.labels(outcome="deadline").inc()
+                    self._trace_end(rt, "cancelled", reason="deadline")
                     raise PermanentError(
                         f"deadline_s={deadline_s} exhausted after "
                         f"{attempt - 1} attempt(s) (last error: "
@@ -408,9 +489,13 @@ class Router:
                 last_error = "no_replica"
                 continue
             tried.add(r.url)
+            self._trace_ev(rt, "pick", replica=r.name, attempt=attempt)
             h0 = time.perf_counter()
             code, payload = self._post(r, body, trace_id)
             hop_wall = time.perf_counter() - h0
+            self._observe_hop(code, hop_wall)
+            self._trace_ev(rt, "hop", replica=r.name, status=str(code),
+                           wall_ms=round(hop_wall * 1e3, 3))
             hops.append({"replica": r.name, "status": code,
                          "wall_s": round(hop_wall, 6)})
             if code == 200 and "handoff" in payload:
@@ -420,13 +505,18 @@ class Router:
                 self._hop_ok(r, status="prefill_ok")
                 return self._route_handoff(
                     payload["handoff"], base, request_id, trace_id,
-                    deadline_s, t0, hops, attempt)
+                    deadline_s, t0, hops, attempt, rt=rt)
             if code == 200:
                 self._hop_ok(r)
                 wall = time.perf_counter() - t0
                 added = max(0.0, wall - sum(h["wall_s"] for h in hops))
                 self._m_added.observe(added)
                 self._m_requests.labels(outcome="ok").inc()
+                if rt is not None:
+                    rt.tokens = list(payload.get("tokens") or [])
+                    self._trace_end(rt, "finished",
+                                    replica=payload.get("replica"),
+                                    attempts=attempt)
                 return RouterResult(
                     tokens=payload["tokens"], replica=payload["replica"],
                     trace_id=trace_id, request_id=request_id,
@@ -437,6 +527,8 @@ class Router:
                 # its breaker state before giving the caller its 400
                 self._hop_ok(r, status="rejected_permanent")
                 self._m_requests.labels(outcome="permanent").inc()
+                self._trace_end(rt, "cancelled", reason="permanent",
+                                error=str(payload.get("error")))
                 raise PermanentError(
                     f"request rejected as unservable: "
                     f"{payload.get('error')} (replica {r.name})")
@@ -450,13 +542,15 @@ class Router:
                 with self._lock:
                     r.state = "draining"
         self._m_requests.labels(outcome="exhausted").inc()
+        self._trace_end(rt, "cancelled", reason="exhausted",
+                        error=str(last_error))
         raise NoReplicaAvailable(
             f"request {request_id} failed after {self.retries} attempts "
             f"(last error: {last_error}); hops: "
             + ", ".join(f"{h['replica']}:{h['status']}" for h in hops))
 
     def _route_handoff(self, ho, base, request_id, trace_id,
-                       deadline_s, t0, hops, attempts):
+                       deadline_s, t0, hops, attempts, rt=None):
         """Move one prefill replica's handoff envelope to a decode
         replica and return the completed generation.
 
@@ -476,6 +570,8 @@ class Router:
         for attempt in range(1, max(1, self.retries) + 1):
             if attempt > 1:
                 self._m_retries.inc()
+                self._trace_ev(rt, "retry", attempt=attempt,
+                               hop="handoff", last_error=last_error)
                 self.sleep(min(self.backoff_max_s,
                                self.backoff_s * 2 ** (attempt - 2)))
             remaining = None
@@ -484,6 +580,7 @@ class Router:
                 if remaining <= 0:
                     self._m_requests.labels(outcome="deadline").inc()
                     self._m_handoffs.labels(outcome="deadline").inc()
+                    self._trace_end(rt, "cancelled", reason="deadline")
                     raise PermanentError(
                         f"deadline_s={deadline_s} exhausted during "
                         f"handoff after {attempt - 1} attempt(s) "
@@ -496,6 +593,8 @@ class Router:
                 last_error = "no_decode_replica"
                 continue
             tried.add(r.url)
+            self._trace_ev(rt, "pick", replica=r.name, attempt=attempt,
+                           hop="handoff")
             send = records
             if keys and all(keys):
                 missing = self._probe_handoff(r, keys)
@@ -504,6 +603,9 @@ class Router:
                     skipped = sum(1 for k in keys if k not in miss)
                     if skipped:
                         self._m_handoff_dedup.inc(skipped)
+                    self._trace_ev(rt, "probe", replica=r.name,
+                                   skipped=skipped,
+                                   missing=len(miss))
                     # the radix key IS the dedup: blocks the target
                     # already caches travel as key+tokens only (the
                     # receiver re-verifies the chain either way)
@@ -517,6 +619,11 @@ class Router:
             code, payload = self._post(r, body, trace_id,
                                        path="/handoff")
             hop_wall = time.perf_counter() - h0
+            self._observe_hop(code, hop_wall)
+            self._trace_ev(rt, "handoff", replica=r.name,
+                           status=str(code),
+                           wall_ms=round(hop_wall * 1e3, 3),
+                           records=len(send))
             hops.append({"replica": r.name, "status": code,
                          "wall_s": round(hop_wall, 6),
                          "hop": "handoff"})
@@ -527,6 +634,11 @@ class Router:
                 self._m_added.observe(added)
                 self._m_requests.labels(outcome="ok").inc()
                 self._m_handoffs.labels(outcome="ok").inc()
+                if rt is not None:
+                    rt.tokens = list(payload.get("tokens") or [])
+                    self._trace_end(rt, "finished",
+                                    replica=payload.get("replica"),
+                                    attempts=attempts + attempt)
                 return RouterResult(
                     tokens=payload["tokens"],
                     replica=payload["replica"], trace_id=trace_id,
@@ -536,6 +648,8 @@ class Router:
                 self._hop_ok(r, status="rejected_permanent")
                 self._m_requests.labels(outcome="permanent").inc()
                 self._m_handoffs.labels(outcome="permanent").inc()
+                self._trace_end(rt, "cancelled", reason="permanent",
+                                error=str(payload.get("error")))
                 raise PermanentError(
                     f"handoff rejected as unservable: "
                     f"{payload.get('error')} (replica {r.name})")
@@ -548,6 +662,8 @@ class Router:
                     r.state = "draining"
         self._m_requests.labels(outcome="exhausted").inc()
         self._m_handoffs.labels(outcome="exhausted").inc()
+        self._trace_end(rt, "cancelled", reason="exhausted",
+                        error=str(last_error))
         raise NoReplicaAvailable(
             f"handoff for {request_id} failed after {self.retries} "
             f"attempt(s) (last error: {last_error}); hops: "

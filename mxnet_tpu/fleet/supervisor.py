@@ -152,12 +152,18 @@ class Supervisor:
         slot is restarted anyway (``MXTPU_FLEET_DRAIN_TIMEOUT``, 120).
       router: optional ``fleet.Router`` whose membership follows
         respawns (old url out, new url in).
+      collector: optional ``fleet.FleetCollector`` — lifecycle events
+        (crash-restart, drain, respawn, rolling-restart phases) are
+        pushed as annotations onto its fleet timeline, so ``/fleetz``
+        explains a load dip ("slot 2 was rolling") without log
+        archaeology.
       clock/sleep: injectable (tests).
     """
 
     def __init__(self, spawn, n, restart_backoff_s=None,
                  restart_backoff_max_s=None, drain_timeout_s=None,
-                 router=None, clock=time.monotonic, sleep=time.sleep):
+                 router=None, collector=None, clock=time.monotonic,
+                 sleep=time.sleep):
         self.spawn = spawn
         self.n = int(n)
         self.restart_backoff_s = (
@@ -171,6 +177,7 @@ class Supervisor:
             float(drain_timeout_s) if drain_timeout_s is not None
             else env_float("MXTPU_FLEET_DRAIN_TIMEOUT", 120.0))
         self.router = router
+        self.collector = collector
         self.clock = clock
         self.sleep = sleep
         self._lock = threading.RLock()
@@ -184,8 +191,22 @@ class Supervisor:
         self._monitor = None
         self._stop_evt = threading.Event()
         self._m_restarts = telemetry.counter(
-            "mxtpu_fleet_restarts_total", "replica crash-restarts",
-            ("slot",))
+            "mxtpu_fleet_restarts_total",
+            "replica restarts by slot and reason (crash / rolling)",
+            ("slot", "reason"))
+
+    def _annotate(self, kind, **fields):
+        """Push one lifecycle event onto the fleet timeline (no-op
+        without a collector; a broken collector must never take the
+        supervisor down with it)."""
+        if self.collector is None:
+            return
+        try:
+            self.collector.annotate(kind, **fields)
+        except Exception:
+            telemetry.counter(
+                "mxtpu_fleet_supervisor_errors_total",
+                "supervisor monitor failures").inc()
 
     # -- membership ----------------------------------------------------------
     def handles(self):
@@ -236,13 +257,21 @@ class Supervisor:
                     continue
                 self._rolling.add(slot)
                 self._restarts[slot] += 1
+                n_restarts = self._restarts[slot]
                 backoff = min(self.restart_backoff_max_s,
                               self.restart_backoff_s
-                              * 2 ** (self._restarts[slot] - 1))
+                              * 2 ** (n_restarts - 1))
                 self._next_restart_t[slot] = now + backoff
-            self._m_restarts.labels(slot=str(slot)).inc()
+            self._m_restarts.labels(slot=str(slot), reason="crash").inc()
+            self._annotate("replica_crash_restart", slot=slot,
+                           url=getattr(h, "url", None),
+                           restarts=n_restarts,
+                           backoff_s=round(backoff, 3))
             try:
-                self._spawn_slot(slot)
+                handle = self._spawn_slot(slot)
+                self._annotate("replica_respawn", slot=slot,
+                               url=getattr(handle, "url", None),
+                               reason="crash")
             finally:
                 with self._lock:
                     self._rolling.discard(slot)
@@ -342,12 +371,23 @@ class Supervisor:
                     break
             self.sleep(0.05)
         try:
+            self._annotate("rolling_restart_slot", slot=slot,
+                           phase="drain")
             self.drain(slot)
             self.wait_drained(slot)
             h = self.handles()[slot]
             if h is not None:
+                self._annotate("rolling_restart_slot", slot=slot,
+                               phase="terminate",
+                               url=getattr(h, "url", None))
                 h.terminate()
             handle = self._spawn_slot(slot)
+            self._m_restarts.labels(slot=str(slot),
+                                    reason="rolling").inc()
+            self._annotate("rolling_restart_slot", slot=slot,
+                           phase="respawned",
+                           url=getattr(handle, "url", None),
+                           wall_s=round(self.clock() - t0, 3))
         finally:
             with self._lock:
                 self._rolling.discard(slot)
@@ -362,6 +402,8 @@ class Supervisor:
         """Drain-and-restart every slot, one at a time — the fleet
         never loses more than one replica of capacity, and the router
         retries each drain's rejections on the live siblings."""
+        self._annotate("rolling_restart", phase="start", slots=self.n)
         for slot in range(self.n):
             self.drain_and_restart(slot)
+        self._annotate("rolling_restart", phase="done", slots=self.n)
         return self.urls()

@@ -113,6 +113,16 @@ class ServeMonitor:
         return ",".join(f"{k}={reasons[k]}" for k in sorted(reasons))
 
     def log_now(self):
+        # the periodic logging cadence doubles as the local time-series
+        # sampling beat: with MXTPU_TIMESERIES set, each log tick also
+        # snapshots the metrics registry into the bounded ring (rate-
+        # limited per MXTPU_TIMESERIES_INTERVAL), so windowed rates —
+        # tok/s over the last minute, reject rate over five — are
+        # readable from /statusz without any external scraper.  A
+        # no-op (None check) when the ring is unconfigured.
+        from .telemetry import timeseries
+
+        timeseries.sample()
         s = self.engine.stats()
         rate = (s.decode_tok_per_sec if s.decode_tok_per_sec is not None
                 else s.total_tok_per_sec)

@@ -1190,6 +1190,11 @@ class Engine:
         if req.first_token_t is None:
             req.first_token_t = now
             self._stats.on_first_token(req.ttft() or 0.0)
+        else:
+            # resume prefill after preemption: the re-emitted token's
+            # gap (spanning the preempted wait) IS the client-visible
+            # inter-token latency — it belongs in the TPOT tail
+            self._stats.on_tokens(req, 1, now=now)
         req.tokens.append(int(tok))
         self._maybe_finish(req)
         return 1
@@ -1229,9 +1234,11 @@ class Engine:
             # mxtpu-lint: disable=host-sync (designed sync point: the
             # scheduler needs the sampled tokens on the host)
             out = np.asarray(out)
+        now = self.clock()
         for i, req in enumerate(reqs):
             req.cache_len += 1
             req.tokens.append(int(out[i]))
+            self._stats.on_tokens(req, 1, now=now)
             self._rtrace.event(req, "decode", batch=self._step_id,
                                batch_size=B, tokens=len(req.tokens),
                                emitted=1)
@@ -1348,6 +1355,7 @@ class Engine:
             req.tokens.extend(emit)
             req.cache_len += len(emit)
             emitted += len(emit)
+            self._stats.on_tokens(req, len(emit))
             self._rtrace.event(req, "decode", batch=self._step_id,
                                batch_size=B, tokens=len(req.tokens),
                                emitted=len(emit), accepted=accepted)

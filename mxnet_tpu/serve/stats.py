@@ -14,13 +14,60 @@ aggregate).
 
 from __future__ import annotations
 
+import random
 import time
 from collections import deque
 from dataclasses import asdict, dataclass, field
 
 from .. import telemetry
 
-__all__ = ["ServeStats", "StatsRecorder"]
+__all__ = ["ServeStats", "StatsRecorder", "Reservoir"]
+
+
+class Reservoir:
+    """Bounded uniform sample of a stream (Vitter's algorithm R) with
+    EXACT running count/sum/max — so means and maxima never degrade
+    while the percentile view stays O(capacity) memory however long
+    the engine serves.  Seeded RNG: two engines fed identical streams
+    report identical percentiles (deterministic tests).
+
+    Not locked: every writer is the engine step thread (the same
+    single-writer discipline as the rest of StatsRecorder); snapshot
+    readers copy under the GIL."""
+
+    __slots__ = ("capacity", "_sample", "_rng", "count", "sum", "max")
+
+    def __init__(self, capacity=2048, seed=0):
+        self.capacity = max(1, int(capacity))
+        self._sample = []
+        self._rng = random.Random(seed)
+        self.count = 0
+        self.sum = 0.0
+        self.max = None
+
+    def add(self, value):
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        if self.max is None or value > self.max:
+            self.max = value
+        if len(self._sample) < self.capacity:
+            self._sample.append(value)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.capacity:
+                self._sample[j] = value
+
+    @property
+    def mean(self):
+        return self.sum / self.count if self.count else None
+
+    def percentile(self, q):
+        """Nearest-rank percentile of the retained sample (exact until
+        ``count`` exceeds ``capacity``, a uniform estimate after)."""
+        from ..telemetry.timeseries import nearest_rank
+
+        return nearest_rank(sorted(self._sample), q)
 
 
 @dataclass(frozen=True)
@@ -85,6 +132,19 @@ class ServeStats:
     spec_verifies: int = 0
     accepted_per_verify: float | None = None
     spec_accept_rate: float | None = None
+    # tail latency (bounded-reservoir percentiles — the SLO inputs):
+    # TTFT is submit -> first token; TPOT (time-per-output-token /
+    # inter-token latency) is the gap between consecutive token
+    # emissions for one request, divided by the tokens the step
+    # emitted (so a speculative verify's k+1-token step contributes
+    # k+1 honest per-token observations, not one giant gap)
+    ttft_ms_p50: float | None = None
+    ttft_ms_p90: float | None = None
+    ttft_ms_p99: float | None = None
+    tpot_ms_mean: float | None = None
+    tpot_ms_p50: float | None = None
+    tpot_ms_p90: float | None = None
+    tpot_ms_p99: float | None = None
     # mean decode-batch occupancy over the recent-step window (decode
     # slots scheduled / max_batch) — slot-based, so it stays honest
     # whatever the per-slot token yield is
@@ -102,6 +162,11 @@ class ServeStats:
         return asdict(self)
 
 
+def _pct_ms(res, q):
+    v = res.percentile(q)
+    return None if v is None else round(v * 1e3, 3)
+
+
 class StatsRecorder:
     def __init__(self, clock=time.monotonic, window_steps=64):
         self.clock = clock
@@ -111,7 +176,11 @@ class StatsRecorder:
         self.tokens_generated = 0
         self.prompt_tokens = 0
         self.prefill_tokens_computed = 0
-        self._ttfts = []
+        # bounded tail-latency reservoirs (mean/max stay exact): the
+        # unbounded per-request TTFT list a long-lived replica would
+        # otherwise grow is exactly what these replace
+        self._ttft_res = Reservoir()
+        self._tpot_res = Reservoir(seed=1)
         self._start_t = None
         self.peak_block_utilization = 0.0
         # (t, tokens_emitted) per step for the sliding-window rate
@@ -134,6 +203,9 @@ class StatsRecorder:
             "submits rejected by admission-queue back-pressure")
         self._m_ttft = telemetry.histogram(
             "mxtpu_serve_ttft_seconds", "time to first token")
+        self._m_tpot = telemetry.histogram(
+            "mxtpu_serve_tpot_seconds",
+            "inter-token latency (per emitted token)")
         self._m_prefill_tokens = telemetry.counter(
             "mxtpu_serve_prefill_tokens_computed_total",
             "prompt tokens actually run through a prefill program "
@@ -198,8 +270,32 @@ class StatsRecorder:
             self.peak_block_utilization = frac
 
     def on_first_token(self, ttft_s):
-        self._ttfts.append(ttft_s)
+        self._ttft_res.add(ttft_s)
         self._m_ttft.observe(ttft_s)
+
+    def on_tokens(self, req, n, now=None):
+        """``n`` decode tokens just landed on ``req``: record their
+        per-token gap (TPOT) since the request's previous emission.
+        The first token has no gap — it is the TTFT observation — so
+        callers invoke this only from the second emission on (the
+        engine stamps ``_last_token_t`` at the first)."""
+        if n < 1:
+            return
+        now = self.clock() if now is None else now
+        last = getattr(req, "_last_token_t", None)
+        if last is None:
+            last = req.first_token_t
+        req._last_token_t = now
+        if last is None:
+            return
+        gap = max(0.0, (now - last) / n)
+        # the histogram is per EMITTED token, like the reservoir: a
+        # k+1-token speculative verify contributes k+1 observations to
+        # BOTH, or the registry-derived TPOT would diverge from the
+        # ServeStats percentiles exactly when spec decoding is on
+        for _ in range(n):
+            self._tpot_res.add(gap)
+            self._m_tpot.observe(gap)
 
     def on_complete(self, req):
         self.completed += 1
@@ -239,8 +335,7 @@ class StatsRecorder:
         total_rate = None
         if self._start_t is not None and now > self._start_t:
             total_rate = self.tokens_generated / (now - self._start_t)
-        ttft_mean = (sum(self._ttfts) / len(self._ttfts)
-                     if self._ttfts else None)
+        ttft_mean = self._ttft_res.mean
         occupancy = self._window_occupancy(scheduler.max_batch)
         if occupancy is not None:
             occupancy = round(occupancy, 4)
@@ -260,8 +355,16 @@ class StatsRecorder:
             peak_block_utilization=round(self.peak_block_utilization, 4),
             ttft_ms_mean=(round(ttft_mean * 1e3, 3)
                           if ttft_mean is not None else None),
-            ttft_ms_max=(round(max(self._ttfts) * 1e3, 3)
-                         if self._ttfts else None),
+            ttft_ms_max=(round(self._ttft_res.max * 1e3, 3)
+                         if self._ttft_res.max is not None else None),
+            ttft_ms_p50=_pct_ms(self._ttft_res, 0.50),
+            ttft_ms_p90=_pct_ms(self._ttft_res, 0.90),
+            ttft_ms_p99=_pct_ms(self._ttft_res, 0.99),
+            tpot_ms_mean=(round(self._tpot_res.mean * 1e3, 3)
+                          if self._tpot_res.mean is not None else None),
+            tpot_ms_p50=_pct_ms(self._tpot_res, 0.50),
+            tpot_ms_p90=_pct_ms(self._tpot_res, 0.90),
+            tpot_ms_p99=_pct_ms(self._tpot_res, 0.99),
             decode_tok_per_sec=(round(self._window_rate(), 1)
                                 if self._window_rate() else None),
             total_tok_per_sec=(round(total_rate, 1)

@@ -50,7 +50,7 @@ import functools
 import os
 
 from . import (exporters, flight, jaxmon, metrics, request_trace, statusz,
-               tracing)
+               timeseries, tracing)
 from .exporters import (append_jsonl, serve_http, to_prometheus_text,
                         write_prometheus)
 from .flight import FlightRecorder
@@ -63,8 +63,8 @@ __all__ = ["enabled", "enable", "disable", "reset", "counter", "gauge",
            "snapshot", "dump", "out_dir", "NOOP", "NOOP_SPAN",
            "DEFAULT_BUCKETS", "to_prometheus_text", "write_prometheus",
            "append_jsonl", "serve_http", "Registry", "SpanTracer",
-           "flight", "statusz", "request_trace", "FlightRecorder",
-           "RequestTracer"]
+           "flight", "statusz", "request_trace", "timeseries",
+           "FlightRecorder", "RequestTracer"]
 
 _enabled = False
 _registry = Registry()

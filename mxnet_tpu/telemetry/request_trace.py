@@ -48,11 +48,16 @@ import time
 from . import flight
 
 __all__ = ["RequestTracer", "NOOP_TRACER", "ENV_ENABLE", "ENV_FILE",
-           "ENV_SAMPLE", "TERMINAL_EVENTS"]
+           "ENV_SAMPLE", "ENV_PUSH", "TERMINAL_EVENTS"]
 
 ENV_ENABLE = "MXTPU_REQUEST_TRACE"
 ENV_FILE = "MXTPU_REQUEST_TRACE_FILE"
 ENV_SAMPLE = "MXTPU_REQUEST_TRACE_SAMPLE"
+# live trace shipping: terminal request-trace lines are ALSO POSTed to
+# this URL (the fleet collector's /trace endpoint), so cross-replica
+# stitched timelines exist while the fleet runs instead of only after
+# collecting every replica's JSONL file
+ENV_PUSH = "MXTPU_TRACE_PUSH_URL"
 
 TERMINAL_EVENTS = ("finished", "rejected", "cancelled")
 
@@ -105,6 +110,84 @@ class _NoopTracer:
 NOOP_TRACER = _NoopTracer()
 
 
+class _TracePusher:
+    """Background shipper of terminal trace lines to one URL.
+
+    One daemon worker per distinct URL (shared across tracers via
+    :func:`_pusher_for`), fed through a bounded queue — serving threads
+    only ever enqueue; a slow or dead collector costs a queue slot and
+    a dropped-line count, never a stalled request handler."""
+
+    def __init__(self, url, maxsize=256, timeout_s=2.0):
+        import queue
+
+        self.url = url
+        self.timeout_s = float(timeout_s)
+        self._q = queue.Queue(maxsize=int(maxsize))
+        self.pushed = 0            # guarded-by: _lock
+        self.dropped = 0           # guarded-by: _lock
+        self.errors = 0            # guarded-by: _lock
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="mxtpu-trace-push")
+        self._thread.start()
+
+    def push(self, record):
+        try:
+            self._q.put_nowait(record)
+        except Exception:
+            # full queue: drop — shipping is best-effort by design, the
+            # local JSONL file (when configured) still has the line
+            with self._lock:
+                self.dropped += 1
+            self._count("dropped")
+
+    @staticmethod
+    def _count(outcome):
+        from mxnet_tpu import telemetry
+
+        telemetry.counter("mxtpu_trace_push_total",
+                          "terminal trace lines shipped to "
+                          "MXTPU_TRACE_PUSH_URL", ("outcome",)
+                          ).labels(outcome=outcome).inc()
+
+    def _run(self):
+        import urllib.request
+
+        while True:
+            record = self._q.get()
+            try:
+                req = urllib.request.Request(
+                    self.url, data=json.dumps(record).encode(),
+                    method="POST",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=self.timeout_s):
+                    pass
+                with self._lock:
+                    self.pushed += 1
+                self._count("ok")
+            except Exception:
+                # collector down/unreachable: count and move on — the
+                # pusher must survive the collector's whole lifecycle
+                with self._lock:
+                    self.errors += 1
+                self._count("error")
+
+
+_pushers = {}                      # guarded-by: _pushers_lock
+_pushers_lock = threading.Lock()
+
+
+def _pusher_for(url):
+    """One shared pusher (thread + queue) per distinct URL — many
+    engines in one process must not each grow a shipping thread."""
+    with _pushers_lock:
+        p = _pushers.get(url)
+        if p is None:
+            p = _pushers[url] = _TracePusher(url)
+        return p
+
+
 def _sampled(rid, rate):
     if rate >= 1.0:
         return True
@@ -122,7 +205,8 @@ class RequestTracer:
     scheduler to one).  ``path``/``sample`` override the env knobs.
     """
 
-    def __init__(self, path=None, sample=None, source="serve"):
+    def __init__(self, path=None, sample=None, source="serve",
+                 push_url=None):
         env = os.environ.get(ENV_ENABLE, "")
         if path is None and env and env not in ("0", "false", "False",
                                                 "off", "no"):
@@ -133,7 +217,19 @@ class RequestTracer:
             else:
                 path = os.environ.get(ENV_FILE) or self._default_path()
         self.path = path
-        self.enabled = path is not None
+        # live shipping (MXTPU_TRACE_PUSH_URL -> the fleet collector's
+        # /trace endpoint): enables timeline collection even without a
+        # local JSONL file; the shared per-URL pusher thread only
+        # exists once a URL is configured (inert otherwise)
+        if push_url is None:
+            push_url = os.environ.get(ENV_PUSH) or None
+        self._pusher = _pusher_for(push_url) if push_url else None
+        # replica identity stamped onto shipped/written lines (the
+        # fleet front sets it so the collector can attribute a line —
+        # e.g. an SLO-offending request — to the replica that served
+        # it); None keeps the line schema byte-identical to older runs
+        self.identity = None
+        self.enabled = path is not None or self._pusher is not None
         if sample is None:
             try:
                 sample = float(os.environ.get(ENV_SAMPLE, "") or 1.0)
@@ -234,14 +330,26 @@ class RequestTracer:
 
     # -- JSONL export ------------------------------------------------------
     def _write_line(self, req, status, events):
-        line = json.dumps({"trace_id": req.trace_id, "rid": req.rid,
-                           "tenant": getattr(req, "tenant", None),
-                           "status": status,
-                           "prompt_tokens": int(req.prompt.size),
-                           "max_new_tokens": req.max_new_tokens,
-                           "generated": len(req.tokens),
-                           "n_preemptions": req.n_preemptions,
-                           "events": events})
+        record = {"trace_id": req.trace_id, "rid": req.rid,
+                  "tenant": getattr(req, "tenant", None),
+                  "status": status,
+                  "prompt_tokens": int(req.prompt.size),
+                  "max_new_tokens": req.max_new_tokens,
+                  "generated": len(req.tokens),
+                  "n_preemptions": req.n_preemptions,
+                  "events": events}
+        if self.identity is not None:      # only-when-set: schema pin
+            record["replica"] = self.identity
+        if self.source != "serve":
+            # mark non-engine lines (the router's) so the collector's
+            # SLO layer can tell client-truth lines from replica-local
+            # ones; engine lines keep their historical schema
+            record["source"] = self.source
+        if self._pusher is not None:
+            self._pusher.push(record)
+        if self.path is None:
+            return
+        line = json.dumps(record)
         try:
             with self._lock:
                 if self._file is None:

@@ -504,8 +504,9 @@ def test_handoff_deadline_propagates_end_to_end(model, fleet_cleanup):
 # -- traces + load signal -----------------------------------------------------
 def test_trace_stitches_across_roles(model, tmp_path, monkeypatch,
                                      fleet_cleanup):
-    """One trace id spans the prefill hop and the decode hop —
-    trace_report --stitch sees a single two-hop request."""
+    """One trace id spans the prefill hop, the decode hop AND (since
+    PR 14) the router's own hop-event line — trace_report --stitch
+    sees a single multi-hop request."""
     monkeypatch.setenv("MXTPU_REQUEST_TRACE",
                        str(tmp_path / "trace.jsonl"))
     prompts = _prompts(1, seed=31)
@@ -522,8 +523,17 @@ def test_trace_stitches_across_roles(model, tmp_path, monkeypatch,
              (tmp_path / "trace.jsonl").read_text().splitlines()
              if l.strip()]
     hops = [l for l in lines if l.get("trace_id") == "disagg-tr-1"]
-    assert len(hops) == 2                     # one line per role
+    # one line per role plus the router's hop-event line (it writes
+    # under the same MXTPU_REQUEST_TRACE opt-in, same trace id)
+    assert len(hops) == 3
     assert all(h["status"] == "finished" for h in hops)
+    router_lines = [h for h in hops if h.get("replica") == "router"]
+    assert len(router_lines) == 1
+    router_evs = [e["ev"] for e in router_lines[0]["events"]]
+    # the stitched view shows router time: pick + generate hop +
+    # the handoff move to the decode replica
+    assert "pick" in router_evs and "hop" in router_evs
+    assert "handoff" in router_evs
     # the decode hop's admit event is marked as a handoff ingest with
     # the transferred span counted as cached tokens
     admits = [e for h in hops for e in h["events"]
@@ -538,7 +548,7 @@ def test_trace_stitches_across_roles(model, tmp_path, monkeypatch,
     for h in hops:
         traces.append((h, {}, h["status"], None, True))
     s = trace_report.stitch(traces)
-    assert s["requests"] == 1 and s["max_hops"] == 2
+    assert s["requests"] == 1 and s["max_hops"] == 3
     assert s["unresolved"] == []
 
 

@@ -360,6 +360,31 @@ def run_fleet_disagg_stage(timeout=900):
         timeout)
 
 
+def run_fleet_obs_stage(timeout=900):
+    """Fleet observability artifact (tools/fleet_bench.py --obs):
+    collector-on vs collector-off tok/s (the observability plane must
+    cost ~nothing), SLO attainment on a clean run (alert silent), and
+    the chaos arm (delay+kill faults, tight latency objective) where
+    the burn-rate alert must FIRE and flight-dump the offender.
+    CPU-only like the other fleet stages — runs ahead of the probe."""
+    def gate(p):
+        if not p.get("complete") or p.get("alert_fired_clean") \
+                or not p.get("alert_fired_chaos") \
+                or (p.get("overhead_ratio") or 0) < 0.75:
+            return (f"complete={p.get('complete')}, "
+                    f"fired_clean={p.get('alert_fired_clean')}, "
+                    f"fired_chaos={p.get('alert_fired_chaos')}, "
+                    f"overhead={p.get('overhead_ratio')}")
+        return None
+
+    return _run_fleet_artifact(
+        "fleet_obs", ["--obs"], "FLEET_OBS_BENCH.json", gate,
+        lambda p: (f"overhead_ratio={p.get('overhead_ratio')}, "
+                   f"chaos alert fired with "
+                   f"{p.get('chaos_flight_dumps')} flight dump(s)"),
+        timeout)
+
+
 def run_bandwidth(timeout=1200):
     return run_json_artifact(
         "bandwidth",
@@ -705,6 +730,7 @@ def main():
     # record shows flash LOSING), the never-measured fused RNN — then
     # the headline benches, then the new r5 records, then the long tail
     done = {"lint": False, "fleet": False, "fleet_disagg": False,
+            "fleet_obs": False,
             "consistency": False, "flash": False, "rnn": False,
             "resnet": False, "resnet256": False, "gpt": False,
             "longcontext": False, "bandwidth": False, "cifar": False,
@@ -770,6 +796,15 @@ def main():
             done["fleet_disagg"] = attempt(
                 "fleet_disagg",
                 lambda: run_fleet_disagg_stage(timeout=min(900, left)))
+        # fleet observability A/B (collector overhead + burn-rate
+        # alert under chaos): CPU-only replica subprocesses, probe-free
+        if not done["fleet_obs"]:
+            left = deadline - time.monotonic()
+            if left < 120:
+                continue
+            done["fleet_obs"] = attempt(
+                "fleet_obs",
+                lambda: run_fleet_obs_stage(timeout=min(900, left)))
         if not probe():
             log("TPU unreachable; retrying in 60s")
             time.sleep(60)

@@ -45,10 +45,25 @@ every iteration regardless of arriving prompt length.  Also recorded:
 handoff wire bytes, dedup hits (content keys the receivers already
 cached), availability, and token identity between the two arms.
 
+``--obs`` runs the fleet-observability A/B instead
+(FLEET_OBS_BENCH.json, the bench_watch ``fleet_obs`` stage): the same
+seeded workload through (arm A) a plain fleet and (arm B) a fleet with
+the full observability plane live — FleetCollector scraping every
+replica, terminal trace lines pushed to its ``/trace``, a lenient
+``MXTPU_SLO_SPEC`` evaluated after every scrape — recording
+**collector overhead** (tok/s on/off ratio; contract: within noise)
+and **SLO attainment** (per-objective bad fractions), with the clean
+arm pinned alert-silent.  A third chaos arm (delay + kill faults on
+one replica, a tight ``total_p99_ms`` objective, responsive windows)
+pins that the burn-rate alert demonstrably FIRES and the flight dump
+lands on the offending replica.
+
 Usage: python tools/fleet_bench.py [--json OUT] [--replicas 3]
            [--requests 24 --rate 8 --max-new 16 --kill-at 4]
        python tools/fleet_bench.py --disagg [--json OUT]
            [--decode-replicas 2 --decoders 4 --long-prompts 3]
+       python tools/fleet_bench.py --obs [--json OUT]
+           [--obs-replicas 2 --obs-requests 16]
 """
 
 import argparse
@@ -319,6 +334,194 @@ def run_disagg(args):
     return 0 if out["complete"] else 1
 
 
+def _spawn_obs_replica(args, slot, env_extra):
+    """One CPU replica for the obs arms (smoke model, full warmup)."""
+    env = dict(os.environ)
+    env.pop("MXTPU_FAULT_SPEC", None)
+    env.pop("MXTPU_TRACE_PUSH_URL", None)
+    env.pop("MXTPU_REQUEST_TRACE", None)
+    env.update(env_extra)
+    handle = ProcessReplica(
+        replica_command(extra_args=[
+            "--backend", "cpu", "--seed", str(args.seed),
+            "--vocab", str(args.vocab), "--warmup", "full"]),
+        env=env)
+    handle.wait_ready(timeout_s=240)
+    return handle
+
+
+def _run_obs_arm(args, tag, n_replicas, env_for_slot, collector=None,
+                 requests=None, deadline_s=None):
+    """Spawn one fleet, drive the seeded workload through a router,
+    tear down.  Returns (results, failures, wall_s, tokens_total)."""
+    import numpy as np
+
+    router = Router([], scrape_interval_s=0.25, timeout_s=60.0,
+                    retries=4, backoff_s=0.05, backoff_max_s=0.5,
+                    breaker_fails=5, breaker_reset_s=2.0)
+    sup = Supervisor(
+        lambda slot: _spawn_obs_replica(args, slot, env_for_slot(slot)),
+        n_replicas, router=router, restart_backoff_s=0.2,
+        collector=collector)
+    if collector is not None:
+        collector.router = router
+    rng = np.random.RandomState(args.seed)
+    workload = build_workload(rng, argparse.Namespace(
+        prompt_lens=args.prompt_lens, vocab=args.vocab,
+        requests=requests if requests is not None else args.obs_requests))
+    try:
+        sup.start()
+        router.scrape()
+        router.start()
+        sup.run(interval_s=0.25)
+        if collector is not None:
+            collector.scrape()
+            collector.start()
+        t0 = time.perf_counter()
+        results, failures = run_load(
+            router, workload, args.obs_rate, args.max_new,
+            np.random.RandomState(args.seed + 3), tag)
+        wall = time.perf_counter() - t0
+        if collector is not None:
+            time.sleep(0.6)          # let the last trace pushes land
+            collector.scrape()       # final aggregate + SLO pass
+    finally:
+        if collector is not None:
+            collector.stop()
+        router.stop()
+        sup.stop()
+    tokens = sum(len(r.tokens) for r in results.values())
+    return results, failures, wall, tokens
+
+
+def run_obs(args):
+    """The --obs A/B/chaos run -> FLEET_OBS_BENCH.json."""
+    import tempfile
+
+    from mxnet_tpu.fleet import FleetCollector, SLOEvaluator, \
+        parse_slo_spec
+
+    out = {"platform": "cpu", "mode": "obs",
+           "replicas": args.obs_replicas,
+           "requests": args.obs_requests, "complete": False}
+
+    def flush():
+        if args.json:
+            tmp = args.json + ".wip"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(out) + "\n")
+            os.replace(tmp, args.json)
+
+    with tempfile.TemporaryDirectory(prefix="mxtpu-obs-") as tdir:
+        # -- arm A: plain fleet, no observability plane -------------------
+        res_a, fail_a, wall_a, tok_a = _run_obs_arm(
+            args, "off", args.obs_replicas, lambda slot: {})
+        out["off"] = {"completed": len(res_a), "failures": len(fail_a),
+                      "wall_s": round(wall_a, 3), "tokens": tok_a,
+                      "tok_per_sec": round(tok_a / wall_a, 2)}
+        flush()
+
+        # -- arm B: collector + trace push + lenient SLOs (clean) ---------
+        col = FleetCollector(urls=[], interval_s=0.25, port=0,
+                             slo_spec="")
+        col.slo = SLOEvaluator(
+            parse_slo_spec(args.obs_slo_clean), col,
+            fast_s=10.0, slow_s=30.0, fast_burn=10.0, slow_burn=5.0,
+            min_requests=5)
+        col.start()                      # endpoint up before replicas
+
+        def env_on(slot):
+            return {"MXTPU_REQUEST_TRACE":
+                    os.path.join(tdir, f"on-{slot}.jsonl"),
+                    "MXTPU_TRACE_PUSH_URL": col.url + "/trace"}
+
+        res_b, fail_b, wall_b, tok_b = _run_obs_arm(
+            args, "on", args.obs_replicas, env_on, collector=col)
+        view = col.fleet_view()
+        fired_clean = any(o["fired_total"]
+                          for o in view["slo"]["objectives"])
+        out["on"] = {"completed": len(res_b), "failures": len(fail_b),
+                     "wall_s": round(wall_b, 3), "tokens": tok_b,
+                     "tok_per_sec": round(tok_b / wall_b, 2),
+                     "traces_received": view["traces"]["received"],
+                     "scrape_passes": view["scrape_passes"],
+                     "totals": view["totals"]}
+        out["slo_attainment"] = {
+            o["objective"]: {"bad_slow": o.get("bad_slow"),
+                             "total_slow": o.get("total_slow"),
+                             "burn_slow": o.get("burn_slow")}
+            for o in view["slo"]["objectives"]}
+        out["alert_fired_clean"] = bool(fired_clean)
+        out["overhead_ratio"] = round(
+            out["on"]["tok_per_sec"] / out["off"]["tok_per_sec"], 3)
+        # three-view spot check: fleet totals vs summed router results
+        out["fleet_tokens_agree"] = (
+            view["totals"]["tokens_generated"] >= tok_b)
+        flush()
+
+        # -- arm C: chaos — delay+kill on slot 1, tight SLO, must FIRE ----
+        chaos_dir = os.path.join(tdir, "flight")
+        col_c = FleetCollector(urls=[], interval_s=0.25, port=0,
+                               slo_spec="")
+        col_c.slo = SLOEvaluator(
+            parse_slo_spec(f"total_p{args.obs_chaos_pct}_ms="
+                           f"{args.obs_chaos_target_ms}"),
+            col_c, fast_s=15.0, slow_s=45.0, fast_burn=1.5,
+            slow_burn=1.0, min_requests=4, dump_interval_s=0.0)
+        col_c.start()
+        delays = ";".join(f"delay@{k}:{args.obs_chaos_delay}"
+                          for k in range(1, 8))
+
+        def env_chaos(slot):
+            env = {"MXTPU_REQUEST_TRACE":
+                   os.path.join(tdir, f"chaos-{slot}.jsonl"),
+                   "MXTPU_TRACE_PUSH_URL": col_c.url + "/trace",
+                   "MXTPU_FLIGHT_DIR": chaos_dir}
+            if slot == 1:
+                env["MXTPU_FAULT_SPEC"] = delays + ";kill@8"
+            return env
+
+        # the ROUTER's trace line is the one that sees client-visible
+        # latency (the delay fault sleeps before the engine ever sees
+        # the request, so engine-side totals stay clean) — trace the
+        # bench parent's router into the same collector
+        os.environ["MXTPU_TRACE_PUSH_URL"] = col_c.url + "/trace"
+        try:
+            res_c, fail_c, wall_c, tok_c = _run_obs_arm(
+                args, "chaos", args.obs_replicas, env_chaos,
+                collector=col_c, requests=args.obs_requests)
+        finally:
+            os.environ.pop("MXTPU_TRACE_PUSH_URL", None)
+        view_c = col_c.fleet_view()
+        fired_chaos = any(o["fired_total"]
+                          for o in view_c["slo"]["objectives"])
+        dumps = sorted(
+            f for f in (os.listdir(chaos_dir)
+                        if os.path.isdir(chaos_dir) else [])
+            if f.startswith("flight-") and "slo_burn" in f)
+        out["chaos"] = {"completed": len(res_c),
+                        "failures": len(fail_c),
+                        "kill_spec": delays + ";kill@8",
+                        "traces_received":
+                            view_c["traces"]["received"],
+                        "slo": view_c["slo"]["objectives"],
+                        "annotations": [
+                            a for a in view_c["annotations"]
+                            if a["kind"].startswith("slo")]}
+        out["alert_fired_chaos"] = bool(fired_chaos)
+        out["chaos_flight_dumps"] = len(dumps)
+    out["complete"] = bool(
+        len(res_a) == len(res_b)
+        and not fail_a and not fail_b
+        and not out["alert_fired_clean"]
+        and out["alert_fired_chaos"]
+        and out["chaos_flight_dumps"] > 0
+        and out["overhead_ratio"] >= args.obs_overhead_floor)
+    flush()
+    print(json.dumps(out))
+    return 0 if out["complete"] else 1
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--replicas", type=int, default=3)
@@ -365,10 +568,35 @@ def main():
     p.add_argument("--model-layers", type=int, default=4)
     p.add_argument("--model-d", type=int, default=256)
     p.add_argument("--model-heads", type=int, default=8)
+    # -- fleet observability A/B (FLEET_OBS_BENCH.json) ----------------
+    p.add_argument("--obs", action="store_true",
+                   help="run the collector-on vs collector-off A/B "
+                        "plus the SLO chaos arm instead")
+    p.add_argument("--obs-replicas", type=int, default=2)
+    p.add_argument("--obs-requests", type=int, default=16)
+    p.add_argument("--obs-rate", type=float, default=6.0,
+                   help="open-loop arrival rate of the obs arms")
+    p.add_argument("--obs-slo-clean", default="availability=0.5;"
+                   "total_p99_ms=60000",
+                   help="lenient objectives for the clean arm (the "
+                        "alert must stay silent)")
+    p.add_argument("--obs-chaos-pct", default="90",
+                   help="percentile of the chaos arm's total-latency "
+                        "objective")
+    p.add_argument("--obs-chaos-target-ms", type=float, default=400.0,
+                   help="chaos-arm latency target — the injected "
+                        "delays push most requests past it")
+    p.add_argument("--obs-chaos-delay", type=float, default=1.0,
+                   help="seconds each delay fault sleeps")
+    p.add_argument("--obs-overhead-floor", type=float, default=0.75,
+                   help="min tok/s ratio (collector-on / off) the "
+                        "contract accepts — CPU smoke noise is large")
     args = p.parse_args()
 
     if args.disagg:
         return run_disagg(args)
+    if args.obs:
+        return run_obs(args)
 
     import numpy as np
 

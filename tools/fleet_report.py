@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Terminal dashboard over the fleet collector's ``/fleetz.json``.
+
+``tools/trace_report.py`` answers "what happened" from files after the
+run; this tool answers "what is the fleet doing right now" from the
+live collector (``mxnet_tpu/fleet/collector.py``): per-role aggregates
+(queue depth, tokens/sec, KV headroom, ``waiting_handoffs``),
+per-replica rows with staleness and scrape-failure counts, SLO
+burn-rate state, the recent fleet-timeline annotations (supervisor
+restarts, firing alerts), and the pushed-trace window summary.
+
+Pure stdlib — point it at the collector from any machine that can
+reach it, or at a saved ``fleetz.json`` for post-mortems.
+
+Usage:
+  python tools/fleet_report.py --url http://host:port [--watch SECS]
+  python tools/fleet_report.py --file fleetz.json [--json OUT]
+"""
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def fetch(url, timeout=5.0):
+    with urllib.request.urlopen(f"{url.rstrip('/')}/fleetz.json",
+                                timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _fmt(v, nd=1):
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return "y" if v else "n"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def render(view):
+    lines = []
+    totals = view.get("totals") or {}
+    lines.append(
+        f"fleet: {totals.get('replicas', 0)} replica(s), "
+        f"{totals.get('stale', 0)} stale | scrape passes "
+        f"{view.get('scrape_passes')} @ {view.get('interval_s')}s | "
+        f"rates over {view.get('rate_window_s')}s")
+    lines.append("")
+
+    hdr = (f"{'ROLE':<8} {'REP':>3} {'STALE':>5} {'QUEUE':>5} "
+           f"{'RUN':>4} {'HANDOFF':>7} {'TOK/S':>8} {'TOKENS':>9} "
+           f"{'DONE':>6} {'REJ':>5} {'KV%':>5} {'HOSTKV%':>7}")
+    lines.append(hdr)
+    roles = view.get("roles") or {}
+    for role in sorted(roles):
+        a = roles[role]
+        kv = a.get("kv_utilization_mean")
+        hkv = a.get("host_kv_utilization_mean")
+        lines.append(
+            f"{role:<8} {a.get('replicas', 0):>3} "
+            f"{a.get('stale', 0):>5} {a.get('queue_depth', 0):>5} "
+            f"{a.get('running', 0):>4} "
+            f"{a.get('waiting_handoffs', 0):>7} "
+            f"{_fmt(a.get('tok_per_sec')):>8} "
+            f"{a.get('tokens_generated', 0):>9} "
+            f"{a.get('completed', 0):>6} {a.get('rejected', 0):>5} "
+            f"{_fmt(100 * kv if kv is not None else None, 0):>5} "
+            f"{_fmt(100 * hkv if hkv is not None else None, 0):>7}")
+    lines.append("")
+
+    lines.append(f"{'REPLICA':<24} {'ROLE':<8} {'STATE':<9} "
+                 f"{'STALE':>5} {'FAILS':>5} {'QUEUE':>5} {'RUN':>4} "
+                 f"{'TOK/S':>8} {'TTFT_P99':>9} {'TPOT_P99':>9}")
+    for r in view.get("replicas") or []:
+        lines.append(
+            f"{str(r.get('replica'))[:24]:<24} "
+            f"{str(r.get('role')):<8} {str(r.get('state'))[:9]:<9} "
+            f"{_fmt(r.get('stale')):>5} "
+            f"{r.get('total_failures', 0):>5} "
+            f"{r.get('queue_depth', 0):>5} {r.get('running', 0):>4} "
+            f"{_fmt(r.get('tok_per_sec')):>8} "
+            f"{_fmt(r.get('ttft_ms_p99')):>9} "
+            f"{_fmt(r.get('tpot_ms_p99')):>9}")
+
+    slo = view.get("slo")
+    if slo:
+        lines.append("")
+        lines.append(
+            f"SLO (fast {slo.get('fast_window_s')}s x"
+            f"{slo.get('fast_burn')}, slow {slo.get('slow_window_s')}s "
+            f"x{slo.get('slow_burn')}):")
+        lines.append(f"  {'OBJECTIVE':<20} {'TARGET':>9} {'BURN_F':>8} "
+                     f"{'BURN_S':>8} {'BAD/TOT_F':>10} {'FIRING':>6}")
+        for o in slo.get("objectives") or []:
+            lines.append(
+                f"  {o['objective']:<20} {_fmt(o.get('target')):>9} "
+                f"{_fmt(o.get('burn_fast'), 2):>8} "
+                f"{_fmt(o.get('burn_slow'), 2):>8} "
+                f"{_fmt(o.get('bad_fast'))}/"
+                f"{_fmt(o.get('total_fast')):>5} "
+                f"{('FIRING' if o.get('firing') else 'ok'):>6}")
+
+    tr = view.get("traces") or {}
+    lines.append("")
+    lines.append(
+        f"traces: {tr.get('received', 0)} received "
+        f"({tr.get('bad', 0)} bad) | window: "
+        f"{tr.get('window_requests', 0)} req, "
+        f"avail {_fmt(tr.get('window_availability'), 3)}, "
+        f"ttft_p99 {_fmt(tr.get('window_ttft_p99_ms'))}ms, "
+        f"tpot_p99 {_fmt(tr.get('window_tpot_p99_ms'))}ms")
+
+    ann = view.get("annotations") or []
+    if ann:
+        lines.append("")
+        lines.append(f"annotations (last {min(len(ann), 10)}):")
+        for ev in ann[-10:]:
+            extra = {k: v for k, v in ev.items()
+                     if k not in ("kind", "t", "time")}
+            lines.append(f"  [{_fmt(ev.get('time'), 3)}] "
+                         f"{ev.get('kind')}: "
+                         + json.dumps(extra, default=str))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="terminal dashboard over the fleet collector")
+    p.add_argument("--url", default=None,
+                   help="collector base URL (http://host:port)")
+    p.add_argument("--file", default=None,
+                   help="render a saved fleetz.json instead")
+    p.add_argument("--watch", type=float, default=0,
+                   help="refresh every N seconds (0 = once)")
+    p.add_argument("--json", default=None,
+                   help="also write the raw view as JSON")
+    args = p.parse_args(argv)
+    if bool(args.url) == bool(args.file):
+        p.error("pass exactly one of --url / --file")
+    while True:
+        if args.file:
+            with open(args.file) as f:
+                view = json.load(f)
+        else:
+            try:
+                view = fetch(args.url)
+            except (OSError, ValueError) as e:
+                print(f"collector unreachable: {e}", file=sys.stderr)
+                return 1
+        if args.watch:
+            print("\x1b[2J\x1b[H", end="")     # clear screen
+        print(render(view))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(view, f, indent=2, default=str)
+        if not args.watch or args.file:
+            return 0
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
