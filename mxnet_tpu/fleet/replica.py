@@ -284,9 +284,12 @@ class ReplicaServer:
 
     def drained(self):
         """True once draining AND no queued or in-flight work remains
-        (the supervisor's terminate-safe signal)."""
+        (the supervisor's terminate-safe signal).  ``engine.has_work``
+        covers the scheduler AND n>1 fanout siblings still awaiting
+        release — a drain must not terminate a replica whose sample
+        group hasn't fully entered the scheduler yet."""
         return (self.state == DRAINING
-                and not self.engine.scheduler.has_work()
+                and not self.engine.has_work()
                 and not self._inflight)
 
     def stop(self):
@@ -337,8 +340,14 @@ class ReplicaServer:
 
     # -- engine pump ---------------------------------------------------------
     def _step_loop(self):
+        # engine.has_work (not scheduler.has_work): n>1 siblings wait
+        # ENGINE-side until their primary's prefill publishes the
+        # prompt's blocks — a primary that finishes in its very first
+        # step (max_new=1) would otherwise leave the scheduler empty,
+        # park this loop, and hang the waiting /generate handler with
+        # its siblings never released
         while not self._stop_evt.is_set():
-            if self.engine.scheduler.has_work():
+            if self.engine.has_work():
                 try:
                     with self._step_lock:
                         self.engine.step()
@@ -416,6 +425,28 @@ class ReplicaServer:
                 deadline_s = float(deadline_s)
             except (TypeError, ValueError):
                 return 400, {"error": "bad_request", "retriable": False}
+        # per-request sampling params: malformed values are clean 400s
+        # on EVERY replica — never 500s the router would count as
+        # transport failures and open breakers fleet-wide
+        try:
+            temperature = body.get("temperature")
+            temperature = (None if temperature is None
+                           else float(temperature))
+            top_p = body.get("top_p")
+            top_p = None if top_p is None else float(top_p)
+            top_k = body.get("top_k")
+            top_k = None if top_k is None else int(top_k)
+            n = int(body.get("n", 1))
+            logprobs = int(body.get("logprobs", 0))
+        except (TypeError, ValueError):
+            return 400, {"error": "bad_request", "retriable": False}
+        if ((temperature is not None
+             and not (np.isfinite(temperature) and temperature >= 0))
+                or (top_p is not None
+                    and not (np.isfinite(top_p) and 0 < top_p <= 1))
+                or (top_k is not None and top_k < 0)
+                or not 1 <= n <= 64 or not 0 <= logprobs <= 5):
+            return 400, {"error": "bad_request", "retriable": False}
         if not prompt or max_new < 1:
             # invalid on EVERY replica: a clean 400, never a 500 the
             # router would count as a transport failure and retry
@@ -444,12 +475,19 @@ class ReplicaServer:
         # discarded; the decode replica regenerates it when it
         # recomputes the final span (greedy — byte-identical)
         serve_new = 1 if prefill_only else max_new
+        # a prefill replica never fans out: the decode replica serves
+        # the n>1 group itself after the handoff (the shared prefix
+        # travels once either way)
+        serve_n = 1 if prefill_only else n
 
         def submit():
             return self.engine.submit(prompt, max_new_tokens=serve_new,
                                       deadline_s=deadline_s,
                                       tenant=tenant, trace_id=trace_id,
-                                      handoff=handoff)
+                                      handoff=handoff,
+                                      temperature=temperature,
+                                      top_p=top_p, top_k=top_k,
+                                      n=serve_n, logprobs=logprobs)
 
         try:
             if request_id is not None:
@@ -485,7 +523,9 @@ class ReplicaServer:
         # about half its tokens — the worst moment (on a prefill-role
         # replica that is the moment prefill completes)
         kill_after = max(1, serve_new // 2) if kill else None
-        while not req.done:
+        while (not req.done
+               or (req.samples
+                   and any(not s.done for s in req.samples))):
             if kill_after is not None and len(req.tokens) >= kill_after:
                 self._on_kill()
                 return None
@@ -521,6 +561,20 @@ class ReplicaServer:
                        "trace_id": req.trace_id, "tenant": req.tenant,
                        "replica": self.replica_id,
                        "n_preemptions": req.n_preemptions}
+            # sampling extras ride only-when-asked, so plain requests'
+            # response payloads stay byte-identical
+            if logprobs:
+                payload["token_logprobs"] = list(req.token_logprobs)
+                payload["top_logprobs"] = list(req.top_logprobs)
+            if req.samples:
+                payload["samples"] = [
+                    dict({"tokens": list(s.tokens), "rid": s.rid},
+                         **({"status": s.status}
+                            if s.status != FINISHED else {}),
+                         **({"token_logprobs": list(s.token_logprobs),
+                             "top_logprobs": list(s.top_logprobs)}
+                            if logprobs else {}))
+                    for s in req.samples]
         with self._lock:
             # cache-write and in-flight pop are ONE locked step: a
             # retry arriving between them would miss both lookups and

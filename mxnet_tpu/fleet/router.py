@@ -79,10 +79,12 @@ class RouterResult:
     """One successful routed generation."""
 
     __slots__ = ("tokens", "replica", "trace_id", "request_id",
-                 "attempts", "hops", "wall_s", "added_s")
+                 "attempts", "hops", "wall_s", "added_s", "samples",
+                 "token_logprobs", "top_logprobs")
 
     def __init__(self, tokens, replica, trace_id, request_id, attempts,
-                 hops, wall_s, added_s):
+                 hops, wall_s, added_s, samples=None,
+                 token_logprobs=None, top_logprobs=None):
         self.tokens = tokens
         self.replica = replica
         self.trace_id = trace_id
@@ -91,6 +93,11 @@ class RouterResult:
         self.hops = hops           # [{"replica", "status", "wall_s"}]
         self.wall_s = wall_s
         self.added_s = added_s     # router-added latency (non-HTTP time)
+        # per-request sampling extras (None unless the request asked):
+        # n>1 sample list and the emitted tokens' logprob views
+        self.samples = samples
+        self.token_logprobs = token_logprobs
+        self.top_logprobs = top_logprobs
 
 
 class _ReplicaState:
@@ -439,8 +446,16 @@ class Router:
 
     # -- the request path ----------------------------------------------------
     def generate(self, prompt, max_new_tokens=64, deadline_s=None,
-                 tenant=None, request_id=None, trace_id=None):
+                 tenant=None, request_id=None, trace_id=None,
+                 temperature=None, top_p=None, top_k=None, n=None,
+                 logprobs=None):
         """Route one generation; returns :class:`RouterResult`.
+
+        ``temperature``/``top_p``/``top_k``/``n``/``logprobs`` are the
+        per-request sampling params — forwarded to the serving replica
+        verbatim (and re-forwarded on a prefill→decode handoff, which
+        reuses the same base body), only-when-set so plain requests'
+        wire bodies stay byte-identical to pre-sampling releases.
 
         Raises :class:`PermanentError` for requests no replica can
         serve and :class:`NoReplicaAvailable` once the retry budget is
@@ -451,6 +466,11 @@ class Router:
                 "max_new_tokens": int(max_new_tokens),
                 "deadline_s": deadline_s, "tenant": tenant,
                 "request_id": request_id}
+        for key, val in (("temperature", temperature), ("top_p", top_p),
+                         ("top_k", top_k), ("n", n),
+                         ("logprobs", logprobs)):
+            if val is not None:
+                base[key] = val
         body = json.dumps(base).encode()
         t0 = time.perf_counter()
         rt = self._trace_begin(len(base["prompt"]), max_new_tokens,
@@ -521,7 +541,9 @@ class Router:
                     tokens=payload["tokens"], replica=payload["replica"],
                     trace_id=trace_id, request_id=request_id,
                     attempts=attempt, hops=hops, wall_s=wall,
-                    added_s=added)
+                    added_s=added, samples=payload.get("samples"),
+                    token_logprobs=payload.get("token_logprobs"),
+                    top_logprobs=payload.get("top_logprobs"))
             if code == "rejected_permanent":
                 # the replica is ALIVE and answered correctly — clear
                 # its breaker state before giving the caller its 400
@@ -643,7 +665,10 @@ class Router:
                     tokens=payload["tokens"],
                     replica=payload["replica"], trace_id=trace_id,
                     request_id=request_id, attempts=attempts + attempt,
-                    hops=hops, wall_s=wall, added_s=added)
+                    hops=hops, wall_s=wall, added_s=added,
+                    samples=payload.get("samples"),
+                    token_logprobs=payload.get("token_logprobs"),
+                    top_logprobs=payload.get("top_logprobs"))
             if code == "rejected_permanent":
                 self._hop_ok(r, status="rejected_permanent")
                 self._m_requests.labels(outcome="permanent").inc()

@@ -17,6 +17,11 @@ serving stack on top of the same checkpoints:
   graceful rejection instead of OOM.
 - ``engine`` — the public ``serve.Engine``: ``submit() -> Request``,
   ``stream()``, ``step()``, ``shutdown()``, bucketed jit programs;
+  per-request ``temperature``/``top_p``/``top_k``/``n``/``logprobs``
+  ride the batch as traced OPERANDS in sampling mode
+  (env ``MXTPU_SERVE_SAMPLING`` — one program per bucket serves any
+  mix of sampling configs; docs/how_to/serve.md "Per-request
+  sampling");
   ``tp=N`` (env ``MXTPU_SERVE_TP``) runs the same programs
   tensor-parallel over a ``{'tp': N}`` mesh with regex-rule parameter
   sharding (``parallel.partition``) and a head-sharded KV-cache

@@ -46,6 +46,7 @@ from __future__ import annotations
 import collections
 import json
 import os
+import threading
 import time
 import traceback
 
@@ -69,8 +70,8 @@ from ..telemetry import flight as flight_mod
 from ..telemetry import statusz as statusz_mod
 from ..telemetry.request_trace import RequestTracer
 from .kv_block_manager import BlockManager, HostKVPool
-from .scheduler import (CANCELLED, FINISHED, WAITING, QueueFull, Request,
-                        Scheduler)
+from .scheduler import (CANCELLED, FINISHED, REJECTED, WAITING, QueueFull,
+                        Request, Scheduler)
 from . import spec as spec_mod
 from .stats import StatsRecorder
 
@@ -84,17 +85,29 @@ __all__ = ["Engine"]
 # collectable while its programs outlive it.
 _STEP_CACHE = {}
 
-# the static model/sampling config the compiled programs close over
+# the static model config the compiled programs close over
 # (numeric_watch is part of it: the watchdog variant returns an extra
 # logits-finite flag, so it is a DIFFERENT compiled program and a
 # different AOT artifact; kv_quant likewise — the int8-KV variant
 # threads two scale arrays through every program.  kv_quant=False is
 # REMOVED from the AOT fingerprint dict so a quant-off engine keeps
-# its pre-quant digests — see _aot_base_fp)
+# its pre-quant digests — see _aot_base_fp).
+# ``sampling``/``sample_cap`` replace the old per-engine
+# temperature/top_k TRACE KEYS: sampling params are per-request
+# (B,)-shaped OPERANDS of the sampling-mode programs, so one program
+# per bucket serves any mix of temperature/top-p/top-k with zero
+# retraces.  sampling=False is the historical greedy program,
+# byte-for-byte (and _aot_base_fp re-emits the historical
+# temperature=0.0/top_k=None fingerprint fields for it).
 _ModelCfg = collections.namedtuple("_ModelCfg", [
     "name", "n_layers", "num_heads", "head_dim", "kv_heads",
     "pos_table", "swiglu", "tied", "rmsnorm", "window", "block_size",
-    "temperature", "top_k", "numeric_watch", "kv_quant"])
+    "sampling", "sample_cap", "numeric_watch", "kv_quant"])
+
+# top-logprob candidates every sampling-mode program returns per
+# sampled position (static — the per-request ``logprobs`` count only
+# selects how many of them the host surfaces)
+TOP_LOGPROBS = 5
 
 # per-engine GSPMD placement bundle for tensor-parallel serving (None
 # on the single-device path): the tp mesh, the per-parameter
@@ -117,6 +130,48 @@ def _next_bucket(n, cap):
     while b < n:
         b *= 2
     return min(b, cap)
+
+
+# -- per-request sampling-parameter validation (submit + Engine defaults) ----
+def _valid_temperature(t):
+    t = float(t)
+    if not np.isfinite(t) or t < 0.0:
+        raise ValueError(f"temperature must be finite and >= 0 (got {t})")
+    return t
+
+
+def _valid_top_p(p):
+    p = float(p)
+    if not np.isfinite(p) or not 0.0 < p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1] (got {p})")
+    return p
+
+
+def _valid_top_k(k):
+    """None/0 = off; else a positive int (values past the engine's
+    ``sample_cap`` behave as the cap — documented in serve.md)."""
+    if k is None or k == 0:
+        return None
+    k = int(k)
+    if k < 1:
+        raise ValueError(f"top_k must be None/0 or >= 1 (got {k})")
+    return k
+
+
+def _cfg_fp_fields(cfg):
+    """``_ModelCfg`` -> AOT-fingerprint fields.  The sampling-mode
+    fields follow the only-when-on rule: a sampling-off cfg re-emits
+    the historical ``temperature=0.0``/``top_k=None`` trace-key fields
+    (dropping sampling/sample_cap), so a greedy engine's digests are
+    byte-identical to pre-operand releases and an upgraded greedy
+    fleet keeps loading its existing artifacts and manifests."""
+    d = dict(cfg._asdict())
+    if not d.get("sampling"):
+        d.pop("sampling", None)
+        d.pop("sample_cap", None)
+        d["temperature"] = 0.0
+        d["top_k"] = None
+    return d
 
 
 def _rope(u, pos, base=10000.0):
@@ -155,8 +210,27 @@ class Engine:
         cache capacity at ``max_batch`` concurrency (rope).
       max_prefills_per_step: prompt prefills interleaved per iteration
         ahead of the batched decode (default 1).
-      temperature/top_k/seed: sampling config (0.0 = greedy argmax —
-        deterministic, which preemption-resume equivalence relies on).
+      temperature/top_p/top_k/seed: the PER-REQUEST sampling defaults
+        (``submit()`` overrides them per request).  0.0/1.0/None is
+        greedy argmax — deterministic, which preemption-resume
+        equivalence relies on.  Any stochastic default flips the
+        engine into sampling mode (see ``sampling``).
+      sampling: per-request sampling mode (env ``MXTPU_SERVE_SAMPLING``;
+        auto-on when the defaults above are stochastic).  In sampling
+        mode temperature/top-p/top-k ride every program as
+        ``(B,)``-shaped traced OPERANDS — one bucketed program serves
+        any mix of per-request configs (greedy rows included) with
+        zero fresh traces, and every emitted token returns its
+        logprob (+ top-``TOP_LOGPROBS`` candidates).  Off (the
+        default) is the historical greedy-only engine, byte-for-byte:
+        same programs, same AOT fingerprints, same tokens.
+      sample_cap: top-k/top-p candidate cap of the sampling-mode
+        programs (env ``MXTPU_SERVE_SAMPLE_CAP``, default 64): the
+        warp ranks the leading ``sample_cap`` logits with one
+        ``jax.lax.top_k`` instead of a full-vocab sort and samples
+        within them — ``top_k`` values past the cap behave as the
+        cap, and a nucleus needing more than ``cap`` candidates is
+        truncated there (exact whenever cap >= vocab).
       clock: injectable monotonic clock (tests drive deadlines with a
         fake clock).
       aot_dir: exported-executable store for AOT restart
@@ -196,8 +270,10 @@ class Engine:
         request (one dispatch, the k-step loop unrolled) and the target
         model verifies all ``k+1`` positions in ONE bucketed dispatch,
         emitting the longest agreeing prefix plus one corrected token.
-        Greedy acceptance keeps the output token-identical to plain
-        decode, so ``spec_k > 0`` requires ``temperature == 0``.  See
+        Greedy engines use exact argmax-prefix acceptance
+        (token-identical to plain decode); sampling-mode engines use
+        rejection-sampling acceptance — distribution-identical to
+        plain sampling at any temperature/top-p/top-k.  See
         ``serve/spec.py`` and docs/how_to/serve.md.
       draft_params: the draft model's gpt() parameter dict (required
         when ``spec_k > 0``; same vocab as the target — token ids
@@ -245,6 +321,7 @@ class Engine:
                  name="gpt", block_size=None, num_blocks=None,
                  max_batch=None, max_queue=None, max_model_len=None,
                  max_prefills_per_step=1, temperature=0.0, top_k=None,
+                 top_p=None, sampling=None, sample_cap=None,
                  seed=0, clock=time.monotonic, aot_dir=None, tp=None,
                  partition_rules=None, tenant_share=None,
                  prefix_cache=None, prefill_chunk=None, spec_k=None,
@@ -275,8 +352,35 @@ class Engine:
         self.name = name
         self.num_heads = int(num_heads)
         self.window = window
-        self.temperature = float(temperature)
-        self.top_k = top_k
+        # -- sampling mode (params as traced OPERANDS, never trace keys) ----
+        # the engine-level temperature/top_p/top_k are per-request
+        # DEFAULTS applied at submit(); any stochastic default (or an
+        # explicit sampling=True / MXTPU_SERVE_SAMPLING=1) flips the
+        # engine into sampling mode, where every program threads
+        # (B,)-shaped temperature/top-p/top-k operands and returns
+        # per-token logprobs — one program per bucket serves any mix
+        # of sampling configs with zero retraces.  sampling=False is
+        # the historical greedy engine, byte-for-byte: same programs,
+        # same AOT fingerprints, same tokens.
+        self.temperature = _valid_temperature(temperature)
+        self.top_p = _valid_top_p(1.0 if top_p is None else top_p)
+        self.top_k = _valid_top_k(top_k)
+        stochastic_defaults = (self.temperature > 0.0 or self.top_p < 1.0
+                               or self.top_k is not None)
+        if sampling is None:
+            sampling = (env_flag("MXTPU_SERVE_SAMPLING", False)
+                        or stochastic_defaults)
+        self._sampling = bool(sampling)
+        if not self._sampling and stochastic_defaults:
+            raise ValueError(
+                "sampling=False forces the greedy-only programs, which "
+                "cannot serve temperature/top_p/top_k defaults — drop "
+                "sampling=False or the stochastic defaults")
+        self.sample_cap = (int(sample_cap) if sample_cap is not None
+                           else env_int("MXTPU_SERVE_SAMPLE_CAP", 64))
+        if self.sample_cap < 1:
+            raise ValueError(
+                f"sample_cap must be >= 1 (got {self.sample_cap})")
         # -- quantized serving (weight-only int8 + int8 KV blocks) ---------
         # both default OFF and off is byte-for-byte inert: the traced
         # programs, the warmup grid, the AOT fingerprints and every
@@ -369,12 +473,13 @@ class Engine:
         if self.spec_k < 0:
             raise ValueError(f"spec_k must be >= 0 (got {self.spec_k})")
         if self.spec_k:
-            if self.temperature != 0.0:
-                raise ValueError(
-                    "speculative decoding (spec_k > 0) requires greedy "
-                    "sampling (temperature=0.0): the acceptance rule is "
-                    "exact argmax-prefix match, which is what makes the "
-                    "output token-identical to plain decode")
+            # temperature > 0 is served by REJECTION-SAMPLING
+            # acceptance (Leviathan/Chen 2023): accept a drafted token
+            # with prob min(1, p_target/p_draft), resample from the
+            # normalized residual on reject — distribution-identical
+            # to plain sampling, so the spec speedup covers stochastic
+            # traffic too.  Greedy engines keep the exact argmax-
+            # prefix acceptance (byte-identical to plain decode).
             if draft_params is None:
                 raise ValueError(
                     "spec_k > 0 requires draft_params (a small gpt() "
@@ -418,6 +523,11 @@ class Engine:
         self._stats = StatsRecorder(clock=clock)
         self.clock = clock
         self._step_id = 0
+        # n>1 sample groups whose siblings wait for the primary's
+        # prefill to publish the prompt's blocks (submit() appends from
+        # handler threads, the step thread drains)
+        self._fanout_lock = threading.Lock()
+        self._pending_fanout = []      # guarded-by: _fanout_lock
         # SLO breach -> flight dump: deadline misses always (rate-
         # limited by the recorder), rejection rate when the env
         # threshold is set (fraction of the last 100 terminal requests)
@@ -489,7 +599,8 @@ class Engine:
             pos_table=self.spec["pos_table"], swiglu=self.spec["swiglu"],
             tied=self.spec["tied"], rmsnorm=self.spec["rmsnorm"],
             window=self.window, block_size=self.block_size,
-            temperature=self.temperature, top_k=self.top_k,
+            sampling=self._sampling,
+            sample_cap=self.sample_cap if self._sampling else 0,
             numeric_watch=self._numeric_watch,
             kv_quant=self._kv_quant)
         # draft worker last among the device placements: params, then
@@ -580,13 +691,16 @@ class Engine:
         # upgraded fleet keeps loading its existing artifacts/manifests
         spec = ({} if self._spec is None else dict(
             spec_k=self.spec_k,
-            draft=dict(self._spec.cfg._asdict(),
+            draft=dict(_cfg_fp_fields(self._spec.cfg),
                        cache_dtype=str(self._spec.cache_k.dtype))))
         # quant fields follow the same only-when-on rule: kv_quant=False
         # leaves the cfg dict (and cache_dtype) exactly as pre-quant
         # releases emitted them, and weight-only off adds no key — an
-        # upgraded quant-off fleet keeps its artifacts and manifests
-        cfg_d = {k: v for k, v in self._cfg._asdict().items()
+        # upgraded quant-off fleet keeps its artifacts and manifests.
+        # _cfg_fp_fields applies the sampling-mode only-when-on rule:
+        # a sampling-off cfg re-emits the historical temperature/top_k
+        # trace-key fields, so greedy digests never move
+        cfg_d = {k: v for k, v in _cfg_fp_fields(self._cfg).items()
                  if k != "kv_quant" or v}
         draft_d = spec.get("draft")
         if draft_d is not None and not draft_d.get("kv_quant"):
@@ -617,7 +731,9 @@ class Engine:
 
     # -- public API ----------------------------------------------------------
     def submit(self, prompt, max_new_tokens=64, deadline_s=None,
-               tenant=None, trace_id=None, handoff=False):
+               tenant=None, trace_id=None, handoff=False,
+               temperature=None, top_p=None, top_k=None, n=1,
+               logprobs=0):
         """Queue one generation request; returns its ``Request`` handle.
 
         Raises ``QueueFull`` when the admission queue is at capacity
@@ -632,21 +748,92 @@ class Engine:
         ``handoff`` marks a prefill→decode handoff ingest (the decode
         replica's re-submission) for the admit trace event and the
         scheduler's ``waiting_handoffs`` load signal.
+
+        ``temperature``/``top_p``/``top_k`` are PER-REQUEST sampling
+        params (None defers to the engine defaults): on a sampling-mode
+        engine they ride the decode batch as traced operands, so any
+        mix of configs shares one bucketed program — a greedy-only
+        engine (``sampling=False``) rejects non-greedy values with
+        ``ValueError``.  ``n > 1`` serves that many independent samples
+        of the same prompt, sharing the prompt's radix-cached prefix
+        blocks copy-on-write (one prefill pays for all ``n``; the
+        handles are on ``req.samples``).  ``logprobs`` (0..5) returns
+        that many top-logprob candidates per emitted token alongside
+        each token's own logprob (``req.token_logprobs`` /
+        ``req.top_logprobs``).
         """
         if not self._alive:
             raise RuntimeError("engine is shut down")
-        req = Request(prompt, max_new_tokens, deadline_s=deadline_s,
-                      tenant=tenant, handoff=handoff)
+        temperature = (self.temperature if temperature is None
+                       else _valid_temperature(temperature))
+        top_p = self.top_p if top_p is None else _valid_top_p(top_p)
+        top_k = self.top_k if top_k is None else _valid_top_k(top_k)
+        logprobs = int(logprobs)
+        if not 0 <= logprobs <= TOP_LOGPROBS:
+            raise ValueError(
+                f"logprobs must be in [0, {TOP_LOGPROBS}] "
+                f"(got {logprobs})")
+        n = int(n)
+        if not 1 <= n <= 64:
+            raise ValueError(f"n must be in [1, 64] (got {n})")
+        if not self._sampling and (temperature > 0.0 or top_p < 1.0
+                                   or top_k is not None or logprobs):
+            raise ValueError(
+                "per-request sampling/logprobs require a sampling-mode "
+                "engine (Engine(sampling=True) / MXTPU_SERVE_SAMPLING=1 "
+                "or stochastic engine defaults) — greedy-only engines "
+                "keep the historical programs byte-for-byte")
+        if n > 1 and not self.blocks.prefix_cache:
+            raise ValueError(
+                "n > 1 requires the prefix cache (siblings share the "
+                "prompt's radix-cached blocks copy-on-write — one "
+                "prefill, n samples)")
+        kw = dict(deadline_s=deadline_s, tenant=tenant, handoff=handoff,
+                  temperature=temperature, top_p=top_p, top_k=top_k,
+                  logprobs=logprobs)
+        req = Request(prompt, max_new_tokens, **kw)
         if trace_id:
             req.trace_id = str(trace_id)
+        if n > 1:
+            sibs = []
+            for i in range(1, n):
+                s = Request(prompt, max_new_tokens, **kw)
+                s.group, s.sample_index = req.rid, i
+                if trace_id:
+                    s.trace_id = str(trace_id)
+                sibs.append(s)
+            req.group, req.sample_index = req.rid, 0
+            req.samples = [req] + sibs
         if req.target_len() > self.max_model_len:
-            self.scheduler._reject(req, "exceeds_max_len")
+            for r in (req.samples or [req]):
+                self.scheduler._reject(r, "exceeds_max_len")
             return req
         try:
-            return self.scheduler.submit(req)
+            out = self.scheduler.submit(req)
         except QueueFull:
             self._stats.on_reject()      # back-pressure event counter
+            if req.samples:
+                for s in req.samples[1:]:
+                    # each sibling is one more back-pressure event —
+                    # the Prometheus series and the rejection-rate
+                    # breach window must see the whole group
+                    self.scheduler._reject(s, "queue_full")
+                    self._stats.on_reject()
             raise
+        if req.samples:
+            if req.status == REJECTED:
+                for s in req.samples[1:]:
+                    self.scheduler._reject(s, req.reject_reason
+                                           or "rejected")
+            else:
+                # siblings queue ENGINE-side until the primary's
+                # prefill publishes the prompt's blocks — only then
+                # does their radix walk share the whole block-aligned
+                # prefix (released by _release_fanout each step)
+                with self._fanout_lock:
+                    self._pending_fanout.append((req,
+                                                 list(req.samples[1:])))
+        return out
 
     def step(self):
         """One scheduler iteration: admit + prefill, then one batched
@@ -675,10 +862,53 @@ class Engine:
                             "sharding_rules_digest": self._rules_digest})
             raise
 
+    def _has_pending_fanout(self):
+        with self._fanout_lock:
+            return bool(self._pending_fanout)
+
+    def _release_fanout(self):
+        """Move n>1 siblings into the scheduler once their primary's
+        prefill has published the prompt's blocks: each sibling's
+        radix walk then reuses the whole block-aligned prefix
+        copy-on-write (the final span recomputes into a fresh private
+        block — recomputation is the copy), so n samples pay ONE
+        prefill however the admission interleaves."""
+        with self._fanout_lock:
+            if not self._pending_fanout:
+                return
+            pending, self._pending_fanout = self._pending_fanout, []
+        keep = []
+        for primary, sibs in pending:
+            if not primary.tokens and not primary.done:
+                keep.append((primary, sibs))
+                continue
+            rest = []
+            for i, s in enumerate(sibs):
+                if self.scheduler.queue_depth >= self.scheduler.max_queue:
+                    rest = sibs[i:]      # queue full: retry next step
+                    break
+                try:
+                    self.scheduler.submit(s)
+                except QueueFull:
+                    # raced a handler thread into the last queue slot:
+                    # the scheduler already counted + traced the
+                    # rejection — finalize the handle and count the
+                    # back-pressure event like any other queue-full
+                    s.status = REJECTED
+                    s.reject_reason = "queue_full"
+                    s.finish_t = self.clock()
+                    self._stats.on_reject()
+            if rest:
+                keep.append((primary, rest))
+        if keep:
+            with self._fanout_lock:
+                self._pending_fanout = keep + self._pending_fanout
+
     @hot_path
     def _step_inner(self):
         self._step_id += 1
         with telemetry.span("serve.step"):
+            self._release_fanout()
             prefills, decodes = self.scheduler.schedule()
             if self._host_pool is not None:
                 # host-tier hits allocated by this schedule() queue
@@ -745,9 +975,17 @@ class Engine:
             self._tel_rejected.set(self.scheduler.rejections)
         return emitted
 
+    def has_work(self):
+        """Whether ``step()`` still has anything to do: scheduler
+        queues/batches, OR n>1 siblings awaiting release — a step-loop
+        driver that only polled ``scheduler.has_work()`` would park
+        with fanout siblings still pending (the fleet replica's pump
+        reads this)."""
+        return self.scheduler.has_work() or self._has_pending_fanout()
+
     def run(self):
         """Pump ``step()`` until every queued request resolves."""
-        while self.scheduler.has_work():
+        while self.has_work():
             self.step()
 
     def stream(self, req):
@@ -758,7 +996,7 @@ class Engine:
             while sent < len(req.tokens):
                 yield int(req.tokens[sent])
                 sent += 1
-            if req.done or not self.scheduler.has_work():
+            if req.done or not self.has_work():
                 return
             self.step()
 
@@ -847,6 +1085,9 @@ class Engine:
             # quantized serving: which of the two int8 modes are live
             # (None when both are off — the inert default)
             "quant": self.quant_info(),
+            # sampling mode: per-request params as traced operands
+            # (None on greedy-only engines — the inert default)
+            "sampling": self.sampling_info(),
             "sharding": self.sharding_info(),
             # speculative decoding: k, the draft model's shape/bytes,
             # the rolling acceptance rate and the verify bucket grid
@@ -864,6 +1105,18 @@ class Engine:
             "numeric_watch": self._numeric_watch,
             "aot": aot,
         }
+
+    def sampling_info(self):
+        """The ``/statusz`` ``sampling`` section: cap, engine defaults
+        and the greedy-vs-stochastic spec acceptance split (None on
+        greedy-only engines — the inert default)."""
+        if not self._sampling:
+            return None
+        info = {"enabled": True, "sample_cap": self.sample_cap,
+                "top_logprobs": TOP_LOGPROBS,
+                "defaults": {"temperature": self.temperature,
+                             "top_p": self.top_p, "top_k": self.top_k}}
+        return info
 
     def quant_info(self):
         """The ``/statusz`` ``quant`` section: weight-only mode, KV
@@ -982,6 +1235,15 @@ class Engine:
             req.status = CANCELLED
             req.finish_t = self.clock()
             self._rtrace.terminal(req, CANCELLED)
+        with self._fanout_lock:
+            pending, self._pending_fanout = self._pending_fanout, []
+        for _, sibs in pending:
+            # n>1 siblings still engine-side (their primary never
+            # finished prefill) resolve like drained waiters
+            for s in sibs:
+                s.status = CANCELLED
+                s.finish_t = self.clock()
+                self._rtrace.terminal(s, CANCELLED)
         self._rtrace.close()
         statusz_mod.unregister(self._statusz_name)
         if self._spec is not None:
@@ -1007,6 +1269,74 @@ class Engine:
         self._alive = False
 
     # -- execution -----------------------------------------------------------
+    def _req_sampling_operands(self, req):
+        """(1,)-shaped per-request sampling operands for the prefill
+        and chunk programs (empty on greedy-only engines — their
+        program signatures are the historical ones)."""
+        if not self._sampling:
+            return ()
+        return (jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.top_p], jnp.float32),
+                jnp.asarray([req.top_k or 0], jnp.int32))
+
+    def _batch_sampling_operands(self, reqs, bucket):
+        """(B,)-shaped per-SLOT sampling operands for the decode /
+        draft / verify programs — THE tentpole mechanism: temperature,
+        top-p and top-k ride the batch as data, so one bucketed
+        program serves any mix of sampling configs with zero fresh
+        traces (padding rows are greedy — harmless, their outputs are
+        dropped)."""
+        if not self._sampling:
+            return ()
+        temp = np.zeros(bucket, np.float32)
+        topp = np.ones(bucket, np.float32)
+        topk = np.zeros(bucket, np.int32)
+        for i, req in enumerate(reqs):
+            temp[i] = req.temperature
+            topp[i] = req.top_p
+            topk[i] = req.top_k or 0
+        return (jnp.asarray(temp), jnp.asarray(topp), jnp.asarray(topk))
+
+    def _note_logprobs(self, req, chosen, tv, ti):
+        """Record emitted tokens' logprob outputs on the request: the
+        chosen-token logprob always (sampling mode), the top view
+        trimmed to the request's ``logprobs`` ask."""
+        for j in range(len(chosen)):
+            # mxtpu-lint: disable=host-sync (host numpy already: the
+            # logprob views arrived in _unpack_outs's batched read)
+            req.token_logprobs.append(float(chosen[j]))
+            if req.logprobs:
+                req.top_logprobs.append(
+                    # mxtpu-lint: disable=host-sync (host numpy
+                    # already — same batched read as above)
+                    [[int(t), float(v)]
+                     for t, v in zip(ti[j][:req.logprobs],
+                                     tv[j][:req.logprobs])])
+
+    def _unpack_outs(self, outs, n_lead, anomaly, **fields):
+        """Split a program's output tuple: adopt the donated-through
+        caches, bring the ``n_lead`` host-bound outputs (sampled
+        tokens, and in sampling mode the logprob views) to the host in
+        ONE batched read, and fire the numeric-watchdog anomaly when
+        the logits-finite flag rode along false."""
+        if self._cfg.numeric_watch:
+            lead, ok = outs[:n_lead], outs[n_lead]
+            self._set_caches(outs[n_lead + 1:])
+            # one batched read: the sampled tokens must reach the host
+            # anyway, so the watchdog flag rides the same sync instead
+            # of forcing a second one
+            # mxtpu-lint: disable=host-sync (designed sync point: the
+            # scheduler needs the sampled tokens on the host)
+            got = jax.device_get(tuple(lead) + (ok,))
+            if not got[-1]:
+                flight_mod.record_anomaly(anomaly, step=self._step_id,
+                                          **fields)
+            return got[:-1]
+        self._set_caches(outs[n_lead:])
+        # mxtpu-lint: disable=host-sync (designed sync point: the
+        # scheduler needs the sampled tokens on the host)
+        return jax.device_get(tuple(outs[:n_lead]))
+
     def _cache_args(self):
         """The device cache operands every target-model program takes:
         (k, v) — plus the int8-KV scale pair when quantized (the same
@@ -1131,7 +1461,8 @@ class Engine:
             fn = self._prefill_fn(bucket)
             args = (self.params,) + self._cache_args() + (
                     jnp.asarray(toks), jnp.asarray(n, jnp.int32),
-                    jnp.asarray(blk), jnp.asarray(off), sub)
+                    jnp.asarray(blk), jnp.asarray(off)) \
+                + self._req_sampling_operands(req) + (sub,)
         else:
             # suffix/chunk pass: positions [start, end) attend through
             # the block table to the K/V already in the cache (cached
@@ -1152,23 +1483,12 @@ class Engine:
             args = (self.params,) + self._cache_args() + (
                     jnp.asarray(toks), jnp.asarray(start, jnp.int32),
                     jnp.asarray(span, jnp.int32), jnp.asarray(tw),
-                    jnp.asarray(blk), jnp.asarray(off), sub)
+                    jnp.asarray(blk), jnp.asarray(off)) \
+                + self._req_sampling_operands(req) + (sub,)
         outs = fn(*args)
-        if self._cfg.numeric_watch:
-            tok, ok = outs[0], outs[1]
-            self._set_caches(outs[2:])
-            # one batched read: the sampled token must reach the host
-            # anyway, so the watchdog flag rides the same sync instead
-            # of forcing a second one
-            # mxtpu-lint: disable=host-sync (designed sync point: the
-            # scheduler needs the sampled token on the host)
-            tok, ok = jax.device_get((tok, ok))
-            if not ok:
-                flight_mod.record_anomaly("prefill_logits", rid=req.rid,
-                                          step=self._step_id)
-        else:
-            tok = outs[0]
-            self._set_caches(outs[1:])
+        lead = self._unpack_outs(outs, 4 if self._sampling else 1,
+                                 "prefill_logits", rid=req.rid)
+        tok = lead[0]
         req.cache_len = end
         self._stats.on_prefill(span)
         # publish the newly-FULL blocks under their chain keys so later
@@ -1196,6 +1516,8 @@ class Engine:
             # inter-token latency — it belongs in the TPOT tail
             self._stats.on_tokens(req, 1, now=now)
         req.tokens.append(int(tok))
+        if self._sampling:
+            self._note_logprobs(req, [lead[1]], [lead[2]], [lead[3]])
         self._maybe_finish(req)
         return 1
 
@@ -1215,29 +1537,19 @@ class Engine:
         self._key, sub = jax.random.split(self._key)
         outs = fn(self.params, *self._cache_args(),
                   jnp.asarray(toks), jnp.asarray(pos),
-                  jnp.asarray(tables), sub)
-        if self._cfg.numeric_watch:
-            out, ok = outs[0], outs[1]
-            self._set_caches(outs[2:])
-            # one batched read for tokens + watchdog flag (not a
-            # bool(ok) stall followed by a second asarray stall)
-            # mxtpu-lint: disable=host-sync (designed sync point: the
-            # scheduler needs the sampled tokens on the host)
-            out, ok = jax.device_get((out, ok))
-            if not ok:
-                flight_mod.record_anomaly(
-                    "decode_logits", step=self._step_id, batch_size=B,
-                    rids=[r.rid for r in reqs])
-        else:
-            out = outs[0]
-            self._set_caches(outs[1:])
-            # mxtpu-lint: disable=host-sync (designed sync point: the
-            # scheduler needs the sampled tokens on the host)
-            out = np.asarray(out)
+                  jnp.asarray(tables),
+                  *self._batch_sampling_operands(reqs, bucket), sub)
+        lead = self._unpack_outs(outs, 4 if self._sampling else 1,
+                                 "decode_logits", batch_size=B,
+                                 rids=[r.rid for r in reqs])
+        out = lead[0]
         now = self.clock()
         for i, req in enumerate(reqs):
             req.cache_len += 1
             req.tokens.append(int(out[i]))
+            if self._sampling:
+                self._note_logprobs(req, lead[1][i:i + 1],
+                                    lead[2][i:i + 1], lead[3][i:i + 1])
             self._stats.on_tokens(req, 1, now=now)
             self._rtrace.event(req, "decode", batch=self._step_id,
                                batch_size=B, tokens=len(req.tokens),
@@ -1286,9 +1598,19 @@ class Engine:
         """One speculative decode iteration over the batch: one draft
         dispatch proposes ``spec_k`` tokens per request, one verify
         dispatch scores all ``k+1`` positions through the block tables,
-        and greedy acceptance emits the agreeing prefix plus the
-        target's corrected token — between 1 and ``k+1`` tokens per
-        request, all of them exactly what plain decode would emit."""
+        and acceptance emits between 1 and ``k+1`` tokens per request.
+
+        Greedy engines use exact argmax-prefix acceptance (host-side
+        ``accept_greedy`` — byte-identical to plain decode).  Sampling
+        engines use REJECTION-SAMPLING acceptance (Leviathan/Chen
+        2023), entirely on device: the draft SAMPLES each proposal
+        from its warped distribution q and ships q with the tokens
+        (device-to-device), the verify accepts draft j with prob
+        ``min(1, p/q)`` and resamples the first rejection from the
+        normalized residual ``max(p - q, 0)`` — the emitted stream is
+        distribution-identical to plain sampling from p, whatever the
+        draft proposes (greedy rows degenerate to argmax-prefix
+        acceptance exactly: p and q are one-hot there)."""
         B = len(reqs)
         k = self.spec_k
         sw = self._spec
@@ -1305,6 +1627,56 @@ class Engine:
             tables[i, :len(t)] = t
         jp, jtab = jnp.asarray(pos), jnp.asarray(tables)
         self._key, sub = jax.random.split(self._key)
+        if self._sampling:
+            samp = self._batch_sampling_operands(reqs, bucket)
+            with telemetry.span("serve.draft", batch=B, k=k):
+                drafted, q_at, q_vals, q_idx, sw.cache_k, sw.cache_v = \
+                    self._draft_fn(bucket)(
+                        sw.params, sw.cache_k, sw.cache_v,
+                        jnp.asarray(toks), jp, jtab, *samp, sub)
+            # drafted ids and their candidate-space q views stay ON
+            # DEVICE: acceptance runs inside the verify program, so
+            # the only host sync this iteration is the emitted rows
+            fn = self._verify_fn(bucket)
+            self._key, sub = jax.random.split(self._key)
+            with telemetry.span("serve.verify", batch=B, k=k):
+                outs = fn(self.params, *self._cache_args(),
+                          jnp.asarray(toks), drafted, q_at, q_vals,
+                          q_idx, jp, jtab, *samp, sub)
+                emit_rows, acc, lp, tv, ti = self._unpack_outs(
+                    outs, 5, "verify_logits", batch_size=B,
+                    rids=[r.rid for r in reqs])
+            emitted = 0
+            now = self.clock()
+            for i, req in enumerate(reqs):
+                accepted = int(acc[i])
+                emit = [int(x) for x in emit_rows[i][:accepted + 1]]
+                # the verify wrote every candidate position's K/V —
+                # the draft loop did too, so the next draft never has
+                # an ingest gap
+                sw.note_drafted(req, int(pos[i]) + k + 1)
+                emit = emit[:req.max_new_tokens - len(req.tokens)]
+                accepted = min(accepted, len(emit))
+                sw.on_verify(k, accepted)
+                self._stats.on_verify(k, accepted,
+                                      stochastic=req.temperature > 0.0)
+                req.tokens.extend(emit)
+                self._note_logprobs(req, lp[i][:len(emit)],
+                                    tv[i][:len(emit)],
+                                    ti[i][:len(emit)])
+                req.cache_len += len(emit)
+                emitted += len(emit)
+                self._stats.on_tokens(req, len(emit), now=now)
+                self._rtrace.event(req, "decode", batch=self._step_id,
+                                   batch_size=B,
+                                   tokens=len(req.tokens),
+                                   emitted=len(emit), accepted=accepted)
+                self._maybe_finish(req)
+                if req.done:
+                    sw.forget(req.rid)
+                else:
+                    self.blocks.truncate(req.rid, req.cache_len)
+            return emitted
         with telemetry.span("serve.draft", batch=B, k=k):
             drafted, sw.cache_k, sw.cache_v = self._draft_fn(bucket)(
                 sw.params, sw.cache_k, sw.cache_v, jnp.asarray(toks),
@@ -1561,6 +1933,16 @@ class Engine:
                                         sharding=sharding or sh.rep)
 
         kspec = sds(self._key.shape, self._key.dtype)
+        f32 = jnp.dtype(jnp.float32)
+
+        def samp(shape):
+            # the sampling-mode programs' per-request operand triple
+            # (temperature, top_p, top_k) — absent on greedy engines,
+            # whose program signatures are the historical ones
+            if not self._cfg.sampling:
+                return ()
+            return (sds(shape, f32), sds(shape, f32), sds(shape, i32))
+
         if kind in ("draft", "draft_chunk"):
             # draft-side programs: the draft checkpoint's params and
             # its own (replicated-under-tp) cache pair, the target's
@@ -1572,7 +1954,8 @@ class Engine:
             if kind == "draft":
                 return (dpspec, dcspec, dcspec, sds((bucket,), i32),
                         sds((bucket,), i32),
-                        sds((bucket, self.table_width), i32), kspec)
+                        sds((bucket, self.table_width), i32)) \
+                    + samp((bucket,)) + (kspec,)
             # draft_chunk: toks, start, n_valid, table, blk, off, rng
             return (dpspec, dcspec, dcspec, sds((bucket,), i32),
                     sds((), i32), sds((), i32),
@@ -1606,8 +1989,24 @@ class Engine:
         if kind == "decode":
             return (pspec,) + caches + (sds((bucket,), i32),
                     sds((bucket,), i32),
-                    sds((bucket, self.table_width), i32), kspec)
+                    sds((bucket, self.table_width), i32)) \
+                + samp((bucket,)) + (kspec,)
         if kind == "verify":
+            if self._cfg.sampling:
+                # toks (B,), drafted (B, k), then the draft's q in
+                # candidate space — q_at (B, k), q_vals/q_idx
+                # (B, k, cap) — device-to-device from the draft
+                # dispatch; pos0, tables, the operand triple, rng
+                cap = min(self.sample_cap, self.spec["vocab"])
+                return (pspec,) + caches + (
+                        sds((bucket,), i32),
+                        sds((bucket, self.spec_k), i32),
+                        sds((bucket, self.spec_k), f32),
+                        sds((bucket, self.spec_k, cap), f32),
+                        sds((bucket, self.spec_k, cap), i32),
+                        sds((bucket,), i32),
+                        sds((bucket, self.table_width), i32)) \
+                    + samp((bucket,)) + (kspec,)
             # rows (B, k+1), pos0 (B,), tables (B, W), rng
             return (pspec,) + caches + (
                     sds((bucket, self.spec_k + 1), i32),
@@ -1618,9 +2017,11 @@ class Engine:
             return (pspec,) + caches + (sds((bucket,), i32),
                     sds((), i32), sds((), i32),
                     sds((self.table_width,), i32),
-                    sds((bucket,), i32), sds((bucket,), i32), kspec)
+                    sds((bucket,), i32), sds((bucket,), i32)) \
+                + samp((1,)) + (kspec,)
         return (pspec,) + caches + (sds((bucket,), i32), sds((), i32),
-                sds((bucket,), i32), sds((bucket,), i32), kspec)
+                sds((bucket,), i32), sds((bucket,), i32)) \
+            + samp((1,)) + (kspec,)
 
     def _resolve_program(self, kind, bucket):
         """One bucket program: AOT-load it from the export store, or
@@ -1650,9 +2051,15 @@ class Engine:
                                               self._donate,
                                               self._shardings)
             if kind == "draft":
-                return spec_mod._build_draft(self._spec.cfg, self.spec_k,
-                                             self._donate,
-                                             self._draft_shardings)
+                # sampling engines draft by SAMPLING from the warped
+                # distribution (sample_cfg carries the target cfg's
+                # cap/operand layout); greedy engines keep the
+                # historical argmax draft program byte-for-byte
+                return spec_mod._build_draft(
+                    self._spec.cfg, self.spec_k, self._donate,
+                    self._draft_shardings,
+                    sample_cfg=(self._cfg if self._cfg.sampling
+                                else None))
             if kind == "draft_chunk":
                 return _build_chunk(self._spec.cfg, bucket, self._donate,
                                     self._draft_shardings)
@@ -1783,15 +2190,112 @@ def _kv_dequant(q, sc, dtype):
 # Engine, so the shared _STEP_CACHE cannot retain a retired engine's
 # parameter dict) -------------------------------------------------------------
 def _sample(cfg, logits, key):
-    """Greedy argmax (temperature 0) or temperature/top-k sampling.
-    ``logits`` (..., V) -> int32 ids of the leading shape."""
-    if cfg.temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    lg = logits.astype(jnp.float32) / cfg.temperature
-    if cfg.top_k is not None:
-        kth = jnp.sort(lg, axis=-1)[..., -int(cfg.top_k), None]
-        lg = jnp.where(lg >= kth, lg, -jnp.inf)
-    return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+    """Greedy argmax — the sampling-OFF programs' sampler, exactly the
+    historical temperature-0 path (``key`` stays in the signature so
+    the greedy program's operand list never moves).  Stochastic
+    serving threads per-request operands through :func:`_sample_ops`
+    inside the sampling-mode programs instead — temperature/top-k are
+    no longer trace keys anywhere."""
+    del key
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+# -- operand sampling (the sampling-mode programs' warp + sample) ------------
+def _filter_logits(cfg, logits, temp, top_p, top_k):
+    """Temperature/top-k/top-p warping with PER-ROW traced operands.
+
+    ``logits`` (..., V); ``temp``/``top_p`` f32 and ``top_k`` int32
+    broadcastable over the leading dims (0 = filter off for top_k).
+    Returns ``(masked, idx)``: the top-``sample_cap`` candidates'
+    warped logits (filtered positions at -inf) in descending order,
+    and their vocab ids.  ``jax.lax.top_k`` replaces the old
+    full-vocab ``jnp.sort``: the kth-largest threshold only ever
+    needs the leading ``cap`` candidates, and top-p needs the same
+    descending slice — one top_k call serves both (numerical
+    equivalence vs the sort formulation is pinned in
+    tests/test_sampling.py).  Candidates past the cap are never
+    sampled — the cap itself acts as a top-``cap`` filter (exact
+    whenever cap >= vocab, e.g. the tiny-vocab statistical pins).
+    Greedy rows (temp <= 0) come out one-hot on the argmax, so a
+    categorical draw over ``masked`` IS argmax there — every other
+    candidate sits at -inf.
+    """
+    V = logits.shape[-1]
+    cap = min(cfg.sample_cap, V) if cfg.sample_cap else V
+    greedy = temp <= 0.0
+    lg = logits.astype(jnp.float32)
+    scaled = lg / jnp.where(greedy, 1.0, temp)[..., None]
+    vals, idx = jax.lax.top_k(scaled, cap)             # descending
+    # fence the sort's outputs: XLA-CPU's producer-duplicating fusion
+    # otherwise re-runs the whole top-k sort inside every consumer of
+    # ``idx`` (measured 15x on the verify program's acceptance gather)
+    vals, idx = jax.lax.optimization_barrier((vals, idx))
+    j = jnp.arange(cap)
+    k_eff = jnp.where(top_k > 0, jnp.minimum(top_k, cap), cap)
+    keep = j < k_eff[..., None]
+    probs = jax.nn.softmax(jnp.where(keep, vals, -jnp.inf), axis=-1)
+    csum = jnp.cumsum(probs, axis=-1)
+    # nucleus: the smallest candidate set whose mass reaches top_p —
+    # a candidate stays while the mass BEFORE it is under top_p
+    keep = jnp.logical_and(keep, (csum - probs) < top_p[..., None])
+    masked = jnp.where(keep, vals, -jnp.inf)
+    return jnp.where(greedy[..., None],
+                     jnp.where(j == 0, 0.0, -jnp.inf), masked), idx
+
+
+def _sample_ops(cfg, logits, key, temp, top_p, top_k):
+    """Sample one token per row from the warped distribution (greedy
+    rows are exact argmax); int32 ids of the leading shape."""
+    masked, idx = _filter_logits(cfg, logits, temp, top_p, top_k)
+    choice = jax.random.categorical(key, masked, axis=-1)
+    return jnp.take_along_axis(
+        idx, choice[..., None], axis=-1)[..., 0].astype(jnp.int32)
+
+
+def _scatter_probs(probs, idx, V):
+    """Scatter per-candidate probabilities ``(..., cap)`` back onto
+    their vocab ids -> a full ``(..., V)`` probability vector (zeros
+    off the candidate set)."""
+    lead = probs.shape[:-1]
+    flat_p = probs.reshape((-1, probs.shape[-1]))
+    flat_i = idx.reshape((-1, idx.shape[-1]))
+    n = flat_p.shape[0]
+    full = jnp.zeros((n, V), jnp.float32).at[
+        jnp.arange(n)[:, None], flat_i].set(flat_p)
+    return full.reshape(lead + (V,))
+
+
+def _filtered_probs_full(cfg, logits, temp, top_p, top_k):
+    """The warped SAMPLING distribution as a full-vocab probability
+    vector ``(..., V)`` — the REFERENCE view of the warp, used by the
+    test suite's sort-equivalence and distribution pins.  The serving
+    hot path never materializes it: the programs sample straight from
+    the candidate representation (`_filter_logits` + categorical) and
+    the verify program's rejection-sampling acceptance evaluates p and
+    q purely at candidate ids (serve/spec.py)."""
+    masked, idx = _filter_logits(cfg, logits, temp, top_p, top_k)
+    return _scatter_probs(jax.nn.softmax(masked, axis=-1), idx,
+                          logits.shape[-1])
+
+
+def _safe_log(p):
+    """log(p) with exact -inf at p == 0 (a zero-probability token can
+    never win a categorical draw, and a one-hot row samples its hot
+    token deterministically)."""
+    return jnp.where(p > 0, jnp.log(jnp.maximum(p, 1e-38)), -jnp.inf)
+
+
+def _logprob_outs(logits, toks):
+    """The logprob outputs every sampling-mode program returns for its
+    sampled positions: the chosen token's log-softmax plus the
+    ``TOP_LOGPROBS`` best candidates (values + ids).  RAW model
+    logprobs (pre-temperature/filtering, the OpenAI-style convention)
+    — greedy and stochastic rows report the same quantity."""
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    chosen = jnp.take_along_axis(
+        lp, toks[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    tv, ti = jax.lax.top_k(lp, min(TOP_LOGPROBS, lp.shape[-1]))
+    return chosen, tv, ti.astype(jnp.int32)
 
 
 def _mlp(cfg, params, p, x):
@@ -1884,14 +2388,25 @@ def _cache_outs(cfg, ck, cv, ksc, vsc):
     return (ck, cv)
 
 
-def _jit_kwargs(cfg, donate, shardings, n_token_args):
+def _jit_kwargs(cfg, donate, shardings, n_token_args, n_lead=None):
     """Shared jit options for the bucket programs.  With a tp mesh the
     in/out shardings are pinned explicitly — params per the partition
     rules, KV-cache head-sharded (scale arrays too, under int8 KV),
     everything host-fed replicated — so GSPMD partitions the program
     (inserting the two all-reduces per layer) instead of inferring a
-    layout per call site."""
+    layout per call site.
+
+    ``n_token_args`` counts the host-fed operands between the caches
+    and the rng key AS THE GREEDY PROGRAM takes them; sampling-mode
+    programs append the (temp, top_p, top_k) triple, counted here.
+    ``n_lead`` is the host-bound output count ahead of the watchdog
+    flag/caches (default: 1 sampled-token output, +3 logprob views in
+    sampling mode)."""
     n_caches = 4 if cfg.kv_quant else 2
+    if cfg.sampling:
+        n_token_args += 3
+    if n_lead is None:
+        n_lead = 4 if cfg.sampling else 1
     kw = {"donate_argnums": (tuple(range(1, 1 + n_caches))
                              if donate else ())}
     if shardings is not None:
@@ -1901,28 +2416,36 @@ def _jit_kwargs(cfg, donate, shardings, n_token_args):
             caches += (shardings.scale,) * 2
         kw["in_shardings"] = ((shardings.params,) + caches
                               + (rep,) * n_token_args + (rep,))
-        out = (rep,) + caches
+        out = (rep,) * n_lead
         if cfg.numeric_watch:
-            out = (rep, rep) + caches
-        kw["out_shardings"] = out
+            out += (rep,)
+        kw["out_shardings"] = out + caches
     return kw
 
 
 def _build_decode(cfg, donate, shardings=None):
     def decode(params, *rest):
-        ck, cv, ksc, vsc, (toks, pos, tables, rng) = \
-            _split_cache_args(cfg, rest)
+        ck, cv, ksc, vsc, tail = _split_cache_args(cfg, rest)
+        if cfg.sampling:
+            toks, pos, tables, temp, topp, topk, rng = tail
+        else:
+            toks, pos, tables, rng = tail
         logits, ck, cv, ksc, vsc = _forward_token_batch(
             cfg, params, ck, cv, ksc, vsc, toks, pos, tables)
-        tok = _sample(cfg, logits, rng)
+        if cfg.sampling:
+            tok = _sample_ops(cfg, logits, rng, temp, topp, topk)
+            lead = (tok,) + _logprob_outs(logits, tok)
+        else:
+            tok = _sample(cfg, logits, rng)
+            lead = (tok,)
         caches = _cache_outs(cfg, ck, cv, ksc, vsc)
         if cfg.numeric_watch:
             # one extra all-reduce over the logits: the watchdog flag
             # rides back with the sampled tokens (the host syncs on
             # them anyway), so a NaN fires the flight recorder instead
             # of silently poisoning every later token
-            return (tok, jnp.isfinite(logits).all()) + caches
-        return (tok,) + caches
+            return lead + (jnp.isfinite(logits).all(),) + caches
+        return lead + caches
 
     return jax.jit(decode, **_jit_kwargs(cfg, donate, shardings, 3))
 
@@ -1938,8 +2461,11 @@ def _build_prefill(cfg, P, donate, shardings=None):
         """Whole-prompt pass at padded length P for ONE request:
         writes K/V for positions [0, plen) through the block
         table and samples the token after position plen-1."""
-        ck, cv, ksc, vsc, (toks, plen, blk, off, rng) = \
-            _split_cache_args(cfg, rest)
+        ck, cv, ksc, vsc, tail = _split_cache_args(cfg, rest)
+        if cfg.sampling:
+            toks, plen, blk, off, temp, topp, topk, rng = tail
+        else:
+            toks, plen, blk, off, rng = tail
         pos = jnp.arange(P)
         x = params[f"{name}_tok_embed_weight"][toks]       # (P, D)
         if cfg.pos_table is not None:
@@ -1990,11 +2516,17 @@ def _build_prefill(cfg, P, donate, shardings=None):
             x = x + _wfc(params, f"{p}_proj", at.reshape(P, d_model))
             x = x + _mlp(cfg, params, p, x)
         logits = _logits(cfg, params, x[plen - 1][None])
-        tok = _sample(cfg, logits, rng)[0]
         caches = _cache_outs(cfg, ck, cv, ksc, vsc)
+        if cfg.sampling:
+            tok = _sample_ops(cfg, logits, rng, temp, topp, topk)
+            lp, tv, ti = _logprob_outs(logits, tok)
+            lead = (tok[0], lp[0], tv[0], ti[0])
+        else:
+            tok = _sample(cfg, logits, rng)[0]
+            lead = (tok,)
         if cfg.numeric_watch:
-            return (tok, jnp.isfinite(logits).all()) + caches
-        return (tok,) + caches
+            return lead + (jnp.isfinite(logits).all(),) + caches
+        return lead + caches
 
     return jax.jit(prefill, **_jit_kwargs(cfg, donate, shardings, 4))
 
@@ -2054,8 +2586,12 @@ def _build_chunk(cfg, C, donate, shardings=None):
         n_valid are padding: they write into the null block and their
         outputs are discarded).  Samples the token after position
         start+n_valid-1 — meaningful on the final chunk only."""
-        ck, cv, ksc, vsc, (toks, start, n_valid, table, blk, off, rng) = \
-            _split_cache_args(cfg, rest)
+        ck, cv, ksc, vsc, tail = _split_cache_args(cfg, rest)
+        if cfg.sampling:
+            toks, start, n_valid, table, blk, off, temp, topp, topk, \
+                rng = tail
+        else:
+            toks, start, n_valid, table, blk, off, rng = tail
         pos = start + jnp.arange(C)
         x = params[f"{name}_tok_embed_weight"][toks]       # (C, D)
         if cfg.pos_table is not None:
@@ -2109,10 +2645,16 @@ def _build_chunk(cfg, C, donate, shardings=None):
             x = x + _wfc(params, f"{p}_proj", at.reshape(C, d_model))
             x = x + _mlp(cfg, params, p, x)
         logits = _logits(cfg, params, x[n_valid - 1][None])
-        tok = _sample(cfg, logits, rng)[0]
         caches = _cache_outs(cfg, ck, cv, ksc, vsc)
+        if cfg.sampling:
+            tok = _sample_ops(cfg, logits, rng, temp, topp, topk)
+            lp, tv, ti = _logprob_outs(logits, tok)
+            lead = (tok[0], lp[0], tv[0], ti[0])
+        else:
+            tok = _sample(cfg, logits, rng)[0]
+            lead = (tok,)
         if cfg.numeric_watch:
-            return (tok, jnp.isfinite(logits).all()) + caches
-        return (tok,) + caches
+            return lead + (jnp.isfinite(logits).all(),) + caches
+        return lead + caches
 
     return jax.jit(chunk, **_jit_kwargs(cfg, donate, shardings, 6))

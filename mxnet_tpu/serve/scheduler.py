@@ -61,7 +61,8 @@ class Request:
     """One generation request and its serving-side bookkeeping."""
 
     def __init__(self, prompt, max_new_tokens, deadline_s=None, tenant=None,
-                 handoff=False):
+                 handoff=False, temperature=0.0, top_p=1.0, top_k=None,
+                 logprobs=0):
         self.rid = next(_rid_counter)
         # prefill→decode handoff ingest (disaggregated fleets): the
         # decode replica marks the re-submitted request so the admit
@@ -76,9 +77,25 @@ class Request:
         self.max_new_tokens = int(max_new_tokens)
         self.deadline_s = deadline_s
         self.tenant = str(tenant) if tenant is not None else None
+        # per-request sampling params: OPERANDS of the engine's
+        # sampling-mode programs, never trace keys (Engine.submit
+        # validates; the greedy defaults here keep bare Request users
+        # on the historical path)
+        self.temperature = float(temperature)
+        self.top_p = float(top_p)
+        self.top_k = int(top_k) if top_k else None
+        self.logprobs = int(logprobs)
+        # n>1 sample-group bookkeeping (stamped by Engine.submit):
+        # every member shares the primary's rid as ``group`` and the
+        # primary carries the full handle list on ``samples``
+        self.group = None
+        self.sample_index = 0
+        self.samples = None
         self.status = WAITING
         self.trace_id = None           # stamped by the request tracer
         self.tokens = []           # generated ids (ints)
+        self.token_logprobs = []   # per emitted token (sampling mode)
+        self.top_logprobs = []     # [[token, logprob] x logprobs] rows
         self.cache_len = 0         # K/V slots valid for this request
         self.cached_prefix_len = 0  # slots reused from the prefix cache
         # of cached_prefix_len, the slots restored host->device from
@@ -114,6 +131,21 @@ class Request:
         if self.first_token_t is None or self.submit_t is None:
             return None
         return self.first_token_t - self.submit_t
+
+    def trace_sampling(self):
+        """Admit-event trace fields for per-request sampling params —
+        only-when-on, so plain greedy requests' trace lines stay
+        byte-identical to pre-sampling releases."""
+        if (self.temperature == 0.0 and self.top_p >= 1.0
+                and not self.top_k and not self.logprobs
+                and self.group is None):
+            return {}
+        samp = {"temperature": self.temperature, "top_p": self.top_p,
+                "top_k": self.top_k, "logprobs": self.logprobs}
+        if self.group is not None:
+            samp["group"] = self.group
+            samp["sample_index"] = self.sample_index
+        return {"sampling": samp}
 
 
 class Scheduler:
@@ -457,7 +489,9 @@ class Scheduler:
                     host_tokens=req.host_restored_len, chunked=chunked,
                     # only-when-on: plain requests' trace lines stay
                     # byte-identical to pre-handoff releases
-                    **({"handoff": True} if req.handoff else {}))
+                    **({"handoff": True} if req.handoff else {}),
+                    # per-request sampling params (only-when-on too)
+                    **req.trace_sampling())
                 prefills.append(req)
                 if chunked:
                     self.prefilling.append(req)

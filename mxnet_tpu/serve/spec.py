@@ -23,6 +23,19 @@ and emits between 1 and ``k+1`` tokens per request — vs one target
 dispatch per token.  The win is largest where per-dispatch overhead or
 memory-bound decode dominates, exactly the serving decode hot loop.
 
+At temperature > 0 (a sampling-mode engine) acceptance switches to
+SPECULATIVE SAMPLING (the same papers' stochastic rule): the draft
+samples each proposal from its warped distribution q, the target
+accepts proposal ``x`` with probability ``min(1, p(x)/q(x))`` and the
+first rejection resamples from the normalized residual
+``max(p - q, 0)`` — the emitted stream is distribution-identical to
+plain sampling from p, so the spec speedup extends to stochastic
+traffic.  The whole acceptance chain runs inside the verify program
+(``_build_verify`` with ``cfg.sampling``); the draft's q vectors ship
+device-to-device from the draft dispatch and the host only ever syncs
+the emitted rows.  Greedy rows (one-hot p and q) degenerate to the
+argmax rule exactly, so a mixed batch needs no special casing.
+
 The :class:`DraftWorker` here owns the draft side: the draft
 checkpoint's parameters, its OWN (much smaller) paged K/V cache pair,
 and the per-request ingest bookkeeping.  The draft cache shares the
@@ -122,13 +135,15 @@ class DraftWorker:
             swiglu=spec["swiglu"], tied=spec["tied"],
             rmsnorm=spec["rmsnorm"], window=window,
             block_size=engine.block_size,
-            # the draft ALWAYS proposes greedily; sampling acceptance
-            # (rejection sampling) is a later extension — the engine
-            # enforces temperature 0 end to end while spec is on.
+            # the draft cfg itself stays sampling=False: on a
+            # sampling-mode engine the draft program's warp/operand
+            # layout rides the TARGET cfg (``_build_draft(sample_cfg=)``
+            # — keyed by the engine cfg in _spec_key either way), and
+            # the draft_chunk ingest program never samples at all.
             # The draft cache stays fp even under MXTPU_SERVE_KV_DTYPE=
             # int8: it is small by design, and draft-cache contents
             # only ever move the acceptance rate, never a token
-            temperature=0.0, top_k=None, numeric_watch=False,
+            sampling=False, sample_cap=0, numeric_watch=False,
             kv_quant=False)
         # place the draft weights; under tensor parallelism they
         # replicate (the draft is small by design — sharding it would
@@ -236,8 +251,15 @@ class DraftWorker:
             window_n = len(self._window)
             rate = self._window_rate_locked()
             tracked = len(self._valid)
+        rate_greedy, rate_stochastic = engine._stats.spec_mode_rates()
         return {
             "k": engine.spec_k,
+            # the greedy-vs-stochastic acceptance split (rejection-
+            # sampled verifies vs exact argmax ones) — the SAME
+            # formula ServeStats.snapshot reads, so the views cannot
+            # drift
+            "accept_rate_greedy": rate_greedy,
+            "accept_rate_stochastic": rate_stochastic,
             "draft": {
                 "name": self.name,
                 "n_layers": cfg.n_layers,
@@ -298,18 +320,32 @@ def _rope_rows(u, pos):
         lead + u.shape[-2:])
 
 
-def _build_draft(cfg, k, donate, shardings=None):
+def _build_draft(cfg, k, donate, shardings=None, sample_cfg=None):
     """The k-step draft-proposal program (kind="draft", bucketed over
     the decode batch).  Unrolls ``k+1`` single-token steps of the draft
     model inside ONE jit: step ``j`` writes the fed token's K/V at
     ``pos+j`` through the (target-shared) block table, attends via
-    ``paged_attention``, and its argmax feeds step ``j+1``.  Steps
+    ``paged_attention``, and its proposal feeds step ``j+1``.  Steps
     ``0..k-1`` produce the k drafted tokens; step ``k`` is write-only —
     it parks the last draft's K/V so the next iteration never has an
     ingest gap even when every draft is accepted (its logits head is
     dead code XLA eliminates).
+
+    With ``sample_cfg`` (the TARGET engine's sampling-mode cfg) each
+    step SAMPLES its proposal from the draft's warped distribution q
+    — per-request (B,)-shaped temperature/top-p/top-k operands, the
+    same warp the target applies — and the program additionally
+    returns q in CANDIDATE space: the sampled token's own probability
+    ``q_at (B, k)`` plus the per-step candidate probabilities and
+    vocab ids ``(B, k, cap)`` pairs.  That is everything the verify
+    program's rejection-sampling acceptance ever evaluates q at (the
+    drafted tokens and the target's own candidate ids), shipped
+    device-to-device at ``cap``-width instead of a dense ``(B, k,
+    vocab)`` tensor — on a 50k vocab that is ~400x less inter-dispatch
+    HBM traffic on the decode hot path.  Without it (greedy engines)
+    the proposal is the historical argmax, byte-for-byte.
     """
-    from .engine import _forward_token_batch
+    from .engine import _filter_logits, _forward_token_batch
 
     def draft(params, ck, cv, toks, pos, tables, rng):
         S = tables.shape[1] * cfg.block_size
@@ -329,12 +365,46 @@ def _build_draft(cfg, k, donate, shardings=None):
                 outs.append(cur)
         return jnp.stack(outs, axis=1), ck, cv
 
+    def draft_rs(params, ck, cv, toks, pos, tables, temp, topp, topk,
+                 rng):
+        S = tables.shape[1] * cfg.block_size
+        keys = jax.random.split(rng, k)
+        cur = toks
+        outs, q_at, q_vals, q_idx = [], [], [], []
+        for j in range(k + 1):
+            tbl = jnp.where((pos + j < S)[:, None], tables, 0)
+            logits, ck, cv, _, _ = _forward_token_batch(
+                cfg, params, ck, cv, None, None, cur, pos + j, tbl)
+            if j < k:
+                # sample the proposal from the warped draft
+                # distribution and keep that EXACT distribution —
+                # q(x) of min(1, p/q) acceptance — as the candidate
+                # (probability, vocab-id) pairs plus the sampled
+                # token's own q
+                masked, idx = _filter_logits(sample_cfg, logits, temp,
+                                             topp, topk)
+                probs = jax.nn.softmax(masked, axis=-1)
+                choice = jax.random.categorical(keys[j], masked,
+                                                axis=-1)
+                cur = jnp.take_along_axis(
+                    idx, choice[..., None],
+                    axis=-1)[..., 0].astype(jnp.int32)
+                outs.append(cur)
+                q_at.append(jnp.take_along_axis(
+                    probs, choice[..., None], axis=-1)[..., 0])
+                q_vals.append(probs)
+                q_idx.append(idx)
+        return (jnp.stack(outs, axis=1), jnp.stack(q_at, axis=1),
+                jnp.stack(q_vals, axis=1), jnp.stack(q_idx, axis=1),
+                ck, cv)
+
+    sampling = sample_cfg is not None
     kw = {"donate_argnums": (1, 2) if donate else ()}
     if shardings is not None:
         rep = shardings.rep
-        kw["in_shardings"] = (rep,) * 7
-        kw["out_shardings"] = (rep, rep, rep)
-    return jax.jit(draft, **kw)
+        kw["in_shardings"] = (rep,) * (10 if sampling else 7)
+        kw["out_shardings"] = (rep,) * (6 if sampling else 3)
+    return jax.jit(draft_rs if sampling else draft, **kw)
 
 
 def _build_verify(cfg, k, donate, shardings=None):
@@ -349,9 +419,26 @@ def _build_verify(cfg, k, donate, shardings=None):
     gather, same scale-by-multiply, same f32 softmax) so a verify row's
     logits track what the single-token decode program would compute for
     the same context.
+
+    On a sampling-mode engine (``cfg.sampling``) the program ALSO owns
+    acceptance: rejection sampling (Leviathan et al. 2023; Chen et al.
+    2023) entirely on device.  With p the target's warped distribution
+    at each position and q the draft's (shipped in as ``(B, k, V)``
+    operands straight off the draft dispatch), draft j is accepted
+    with probability ``min(1, p(x_j)/q(x_j))``; the first rejection
+    resamples from the normalized residual ``max(p - q, 0)`` and a
+    fully-accepted run samples a bonus token from the last row's p.
+    The emitted prefix is distribution-identical to sampling from p
+    token by token — whatever the draft proposed — and greedy rows
+    (one-hot p and q) degenerate to exact argmax-prefix acceptance.
+    Outputs: the emit rows ``(B, k+1)``, accepted counts ``(B,)`` and
+    the emitted tokens' logprob views, so the host's only sync is the
+    result.
     """
-    from .engine import (_cache_outs, _kv_dequant, _kv_quant_vals, _ln,
-                         _logits, _mlp, _sample, _split_cache_args, _wfc)
+    from .engine import (_cache_outs, _filter_logits, _kv_dequant,
+                         _kv_quant_vals, _ln, _logits, _logprob_outs,
+                         _mlp, _safe_log, _sample, _split_cache_args,
+                         _wfc)
 
     name = cfg.name
     Hq, Hkv, Dh = cfg.num_heads, cfg.kv_heads, cfg.head_dim
@@ -365,9 +452,15 @@ def _build_verify(cfg, k, donate, shardings=None):
         """``rows`` (B, K1) int32 token ids; ``pos0`` (B,) the cache
         position of each request's row 0; ``tables`` (B, W).  Returns
         the target's (B, K1) greedy tokens (row j's token decided after
-        consuming rows 0..j)."""
-        ck, cv, ksc, vsc, (rows, pos0, tables, rng) = \
-            _split_cache_args(cfg, rest)
+        consuming rows 0..j) — or, in sampling mode, the
+        rejection-sampled emit rows + accepted counts + logprobs."""
+        ck, cv, ksc, vsc, tail = _split_cache_args(cfg, rest)
+        if cfg.sampling:
+            toks0, drafted, q_at, q_vals, q_idx, pos0, tables, temp, \
+                topp, topk, rng = tail
+            rows = jnp.concatenate([toks0[:, None], drafted], axis=1)
+        else:
+            rows, pos0, tables, rng = tail
         B = rows.shape[0]
         pos = pos0[:, None] + jnp.arange(K1)[None, :]      # (B, K1)
         x = params[f"{name}_tok_embed_weight"][rows]       # (B, K1, D)
@@ -433,12 +526,83 @@ def _build_verify(cfg, k, donate, shardings=None):
             x = x + _wfc(params, f"{p}_proj", at.reshape(B, K1, d_model))
             x = x + _mlp(cfg, params, p, x)
         logits = _logits(cfg, params, x)                   # (B, K1, V)
-        tok = _sample(cfg, logits, rng)
         caches = _cache_outs(cfg, ck, cv, ksc, vsc)
+        if cfg.sampling:
+            # -- rejection-sampling acceptance, on device --------------
+            # everything runs in CANDIDATE space (sample_cap wide,
+            # never vocab-wide): the residual max(p - q, 0) is
+            # supported only where p > 0, i.e. inside the target's
+            # candidate set, so neither distribution materializes a
+            # full-vocab vector — q arrives as the draft's candidate
+            # (probability, id) pairs and is re-evaluated at the
+            # target's candidate ids by id matching
+            kacc, kres, kbonus = jax.random.split(rng, 3)
+            # p: the target's warped sampling distribution per row
+            # (operands broadcast over the K1 axis); greedy rows are
+            # exactly one-hot, so accept degenerates to argmax match
+            masked_p, idx_p = _filter_logits(
+                cfg, logits, temp[:, None], topp[:, None],
+                topk[:, None])                           # (B, K1, cap)
+            p_cand = jax.nn.softmax(masked_p, axis=-1)
+            idx_k = idx_p[:, :K1 - 1]                    # (B, k, cap)
+            # p(x_j): x_j's probability under the target's filtered
+            # distribution (0 when the draft proposed outside the
+            # target's candidate set); q(x_j) shipped from the draft
+            p_at = jnp.sum(
+                jnp.where(idx_k == drafted[..., None],
+                          p_cand[:, :K1 - 1], 0.0), axis=-1)
+            u = jax.random.uniform(kacc, drafted.shape)
+            # u < min(1, p/q)  <=>  u*q < p (q(x_j) > 0: x_j was
+            # sampled from q)
+            accept = u * q_at < p_at
+            acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32),
+                                      axis=1), axis=1)     # (B,)
+            # the first rejection resamples from the normalized
+            # residual max(p - q, 0) — together with the acceptance
+            # rule this reproduces p exactly (Leviathan 2023, Thm 1).
+            # An identically-zero residual means p == q: acceptance
+            # was certain there, the row is never read — substitute p
+            # to keep the categorical well-defined
+            # q at the TARGET's candidate ids, by id matching the
+            # draft's candidate pairs (candidate ids are unique per
+            # row, so at most one match contributes)
+            q_cand = jnp.sum(
+                jnp.where(idx_k[..., :, None] == q_idx[..., None, :],
+                          q_vals[..., None, :], 0.0), axis=-1)
+            res = jnp.maximum(p_cand[:, :K1 - 1] - q_cand, 0.0)
+            rsum = jnp.sum(res, axis=-1, keepdims=True)
+            res = jnp.where(rsum > 0, res / rsum, p_cand[:, :K1 - 1])
+            corr_c = jax.random.categorical(kres, _safe_log(res),
+                                            axis=-1)       # (B, k)
+            corr = jnp.take_along_axis(
+                idx_k, corr_c[..., None], axis=-1)[..., 0]
+            # the bonus token samples from the last row's p directly
+            # (categorical over the masked logits IS sampling from p;
+            # greedy rows pick candidate 0 — the argmax — exactly)
+            bonus_c = jax.random.categorical(kbonus, masked_p[:, K1 - 1],
+                                             axis=-1)
+            bonus = jnp.take_along_axis(
+                idx_p[:, K1 - 1], bonus_c[..., None], axis=-1)[..., 0]
+            first_rej = jnp.minimum(acc, K1 - 2)
+            corr_at = jnp.take_along_axis(
+                corr, first_rej[:, None], axis=1)[:, 0]
+            fixed = jnp.where(acc < K1 - 1, corr_at,
+                              bonus).astype(jnp.int32)
+            jj = jnp.arange(K1)[None, :]
+            pad = jnp.concatenate(
+                [drafted, jnp.zeros((B, 1), jnp.int32)], axis=1)
+            emit = jnp.where(jj < acc[:, None], pad,
+                             fixed[:, None]).astype(jnp.int32)
+            outs = (emit, acc.astype(jnp.int32)) \
+                + _logprob_outs(logits, emit)
+        else:
+            outs = (_sample(cfg, logits, rng),)
         if cfg.numeric_watch:
-            return (tok, jnp.isfinite(logits).all()) + caches
-        return (tok,) + caches
+            outs = outs + (jnp.isfinite(logits).all(),)
+        return outs + caches
 
     from .engine import _jit_kwargs
 
-    return jax.jit(verify, **_jit_kwargs(cfg, donate, shardings, 3))
+    return jax.jit(verify, **_jit_kwargs(
+        cfg, donate, shardings, 7 if cfg.sampling else 3,
+        n_lead=5 if cfg.sampling else None))

@@ -132,6 +132,15 @@ class ServeStats:
     spec_verifies: int = 0
     accepted_per_verify: float | None = None
     spec_accept_rate: float | None = None
+    # the greedy-vs-stochastic acceptance split: rejection-sampled
+    # (temperature>0) verifies accept by min(1, p/q) while greedy ones
+    # accept by exact argmax match, and a draft can diverge on one
+    # class of traffic while looking healthy on the other.  Stochastic
+    # raw counts ride along (greedy = total - stochastic).
+    spec_drafted_tokens_stochastic: int = 0
+    spec_accepted_tokens_stochastic: int = 0
+    spec_accept_rate_greedy: float | None = None
+    spec_accept_rate_stochastic: float | None = None
     # tail latency (bounded-reservoir percentiles — the SLO inputs):
     # TTFT is submit -> first token; TPOT (time-per-output-token /
     # inter-token latency) is the gap between consecutive token
@@ -216,6 +225,18 @@ class StatsRecorder:
         self.spec_accepted_tokens = 0
         self.spec_rejected_tokens = 0
         self.spec_verifies = 0
+        # the greedy-vs-stochastic split (rejection-sampled verifies
+        # vs exact argmax ones) — same single feed as the totals
+        self.spec_drafted_tokens_stochastic = 0
+        self.spec_accepted_tokens_stochastic = 0
+        self._m_spec_mode_drafted = telemetry.counter(
+            "mxtpu_serve_spec_mode_drafted_tokens_total",
+            "draft-model tokens proposed, split by sampling mode",
+            ("mode",))
+        self._m_spec_mode_accepted = telemetry.counter(
+            "mxtpu_serve_spec_mode_accepted_tokens_total",
+            "accepted drafted tokens, split by sampling mode",
+            ("mode",))
         self._m_spec_drafted = telemetry.counter(
             "mxtpu_serve_spec_drafted_tokens_total",
             "draft-model tokens proposed to the verify program")
@@ -226,20 +247,46 @@ class StatsRecorder:
             "mxtpu_serve_spec_rejected_tokens_total",
             "drafted tokens the target model rejected")
 
-    def on_verify(self, drafted, accepted):
+    def on_verify(self, drafted, accepted, stochastic=False):
         """One speculative verify pass: ``drafted`` tokens proposed,
         ``accepted`` of them kept (the +1 corrected/bonus token is
-        counted by ``on_step``'s emitted total, not here)."""
+        counted by ``on_step``'s emitted total, not here).
+        ``stochastic`` marks a rejection-sampled (temperature>0)
+        verify — the per-mode split rides the same single feed."""
         drafted, accepted = int(drafted), int(accepted)
         self.spec_verifies += 1
         self.spec_drafted_tokens += drafted
         self.spec_accepted_tokens += accepted
         self.spec_rejected_tokens += drafted - accepted
+        if stochastic:
+            self.spec_drafted_tokens_stochastic += drafted
+            self.spec_accepted_tokens_stochastic += accepted
+        mode = "stochastic" if stochastic else "greedy"
+        if drafted:
+            self._m_spec_mode_drafted.labels(mode=mode).inc(drafted)
+        if accepted:
+            self._m_spec_mode_accepted.labels(mode=mode).inc(accepted)
         self._m_spec_drafted.inc(drafted)
         if accepted:
             self._m_spec_accepted.inc(accepted)
         if drafted - accepted:
             self._m_spec_rejected.inc(drafted - accepted)
+
+    def spec_mode_rates(self):
+        """(greedy, stochastic) acceptance rates — the ONE formula
+        both ``snapshot()`` and the statusz ``spec`` section read, so
+        the two views cannot drift (None with no drafted tokens in
+        that mode)."""
+        drafted_g = (self.spec_drafted_tokens
+                     - self.spec_drafted_tokens_stochastic)
+        accepted_g = (self.spec_accepted_tokens
+                      - self.spec_accepted_tokens_stochastic)
+        greedy = round(accepted_g / drafted_g, 4) if drafted_g else None
+        stochastic = (
+            round(self.spec_accepted_tokens_stochastic
+                  / self.spec_drafted_tokens_stochastic, 4)
+            if self.spec_drafted_tokens_stochastic else None)
+        return greedy, stochastic
 
     def on_prefill(self, tokens_computed):
         """One prefill pass (whole prompt, suffix, or one chunk) ran
@@ -330,6 +377,7 @@ class StatsRecorder:
 
     def snapshot(self, scheduler, blocks):
         now = self.clock()
+        rate_greedy, rate_stochastic = self.spec_mode_rates()
         pfx = blocks.prefix_stats()
         host = blocks.host_stats() or {}
         total_rate = None
@@ -380,6 +428,12 @@ class StatsRecorder:
                 round(self.spec_accepted_tokens
                       / self.spec_drafted_tokens, 4)
                 if self.spec_drafted_tokens else None),
+            spec_drafted_tokens_stochastic=(
+                self.spec_drafted_tokens_stochastic),
+            spec_accepted_tokens_stochastic=(
+                self.spec_accepted_tokens_stochastic),
+            spec_accept_rate_greedy=rate_greedy,
+            spec_accept_rate_stochastic=rate_stochastic,
             decode_occupancy=occupancy,
             reject_reasons=dict(scheduler.reject_reasons),
             tenants=scheduler.tenant_stats(),

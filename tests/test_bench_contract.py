@@ -265,6 +265,40 @@ def test_prefix_bench_contract():
 
 
 @pytest.mark.slow
+def test_sampling_bench_contract():
+    """tools/serve_bench.py --workload sampling (the SAMPLING_BENCH.json
+    bench_watch stage) on the default CPU smoke shapes (the tiny
+    2-layer shapes other contracts use make dispatches too cheap for
+    spec to win): a mixed-sampling-config batch with ZERO fresh traces
+    and greedy rows byte-identical to a greedy-only engine,
+    rejection-sampled spec >= 1.25x plain sampling at temperature>0,
+    and the spec-on/off token distributions statistically
+    indistinguishable — the invariants the serve_sampling watchdog
+    gate trusts."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # no tunnel for a CPU smoke
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--backend", "cpu", "--workload", "sampling",
+         "--max-new", "64", "--spec-k", "6",
+         "--agreement-samples", "128"],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads([l for l in r.stdout.splitlines()
+                          if l.startswith("{")][-1])
+    assert payload["platform"] == "cpu"
+    assert payload["complete"] is True      # stamped BEFORE the print
+    # the acceptance bars the serve_sampling stage gates on
+    assert payload["retraces"] == 0
+    assert payload["greedy_rows_identical"] is True
+    assert payload["logprobs_ok"] is True
+    assert payload["sampling_spec_speedup"] >= 1.25
+    assert 0 < payload["accept_rate_stochastic"] < 1
+    assert abs(payload["agreement_z"]) < 5
+    assert "telemetry" in payload
+
+
+@pytest.mark.slow
 def test_offload_bench_contract():
     """tools/serve_bench.py --workload offload (the OFFLOAD_BENCH.json
     bench_watch stage) on CPU smoke shapes: with the HBM prefix LRU

@@ -309,8 +309,12 @@ def test_spec_k0_is_byte_for_byte_inert(model):
 
 def test_spec_argument_validation(model):
     _, draft = _draft_of(model[1])
-    with pytest.raises(ValueError, match="temperature"):
-        _engine(model, temperature=0.7, **_spec_kw(draft))
+    # temperature > 0 with spec is now SERVED (rejection-sampling
+    # acceptance, tests/test_sampling.py) — but an explicitly
+    # greedy-only engine still refuses stochastic defaults
+    with pytest.raises(ValueError, match="sampling"):
+        _engine(model, temperature=0.7, sampling=False,
+                **_spec_kw(draft))
     with pytest.raises(ValueError, match="draft_params"):
         _engine(model, spec_k=3)
     with pytest.raises(ValueError, match="spec_k"):

@@ -601,6 +601,39 @@ def run_serve_spec_bench(timeout=2400):
         "SPEC_BENCH.json", timeout, validate=validate)
 
 
+def run_serve_sampling_bench(timeout=2400):
+    """Per-request sampling operands (tools/serve_bench.py --workload
+    sampling) — mixed-config batch on ONE warmed program set (zero
+    fresh traces, greedy rows byte-identical to a greedy engine),
+    spec-on vs spec-off tok/s at temperature>0 (rejection-sampling
+    acceptance), and a two-sample chi-square distribution-agreement
+    pin between the arms."""
+
+    def validate(payload):
+        if payload.get("retraces", 1) != 0:
+            return "mixed-sampling-config batch traced fresh programs"
+        if not payload.get("greedy_rows_identical"):
+            return "greedy rows differ from the greedy-only engine"
+        if not payload.get("logprobs_ok"):
+            return "logprob outputs missing or mis-shaped"
+        if (payload.get("sampling_spec_speedup") or 0) < 1.25:
+            return "spec-on under 1.25x spec-off tok/s at temp>0"
+        rate = payload.get("accept_rate_stochastic")
+        if not rate or not 0 < rate < 1:
+            return "no measured stochastic acceptance rate in (0, 1)"
+        z = payload.get("agreement_z")
+        if z is None or abs(z) > 5:
+            return ("spec-on vs spec-off token distributions disagree "
+                    f"(chi-square z={z})")
+        return None
+
+    return run_json_artifact(
+        "serve_sampling",
+        [os.path.join(REPO, "tools", "serve_bench.py"),
+         "--workload", "sampling", "--max-new", "64", "--spec-k", "6"],
+        "SAMPLING_BENCH.json", timeout, validate=validate)
+
+
 def run_serve_quant_bench(timeout=2400):
     """Quantized serving A/B/C (tools/serve_bench.py --workload quant)
     — quant-off vs weight-only int8 vs weight-only + int8-KV on the
@@ -736,8 +769,8 @@ def main():
             "longcontext": False, "bandwidth": False, "cifar": False,
             "quant": False, "decode": False, "serve": False,
             "serve_tp": False, "serve_prefix": False,
-            "serve_spec": False, "serve_quant": False,
-            "serve_offload": False,
+            "serve_spec": False, "serve_sampling": False,
+            "serve_quant": False, "serve_offload": False,
             "train_bench": False, "startup": False, "train_tier": False,
             "sweep": False}
     fails = {k: 0 for k in done}
@@ -846,6 +879,8 @@ def main():
              lambda: run_serve_prefix_bench(timeout=min(2400, left))),
             ("serve_spec",
              lambda: run_serve_spec_bench(timeout=min(2400, left))),
+            ("serve_sampling",
+             lambda: run_serve_sampling_bench(timeout=min(2400, left))),
             ("serve_quant",
              lambda: run_serve_quant_bench(timeout=min(2400, left))),
             ("serve_offload",

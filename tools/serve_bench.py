@@ -338,6 +338,183 @@ def _run_spec_pinned(mx, args, make_engine, workload, draft, conc, k):
     }
 
 
+SAMPLING_CYCLE = (
+    {},                                            # greedy row
+    {"temperature": 0.7},
+    {"temperature": 1.0, "top_k": 8},
+    {"temperature": 0.9, "top_p": 0.8},
+    {"temperature": 0.25, "top_k": 16, "logprobs": 2},
+)
+
+
+def sampling_config(i):
+    """The mixed-config cycle: request ``i``'s per-request sampling
+    kwargs — greedy rows interleaved with distinct temperature /
+    top-k / top-p / logprobs asks, all served by ONE bucketed decode
+    program (params are operands, not trace keys)."""
+    return dict(SAMPLING_CYCLE[i % len(SAMPLING_CYCLE)])
+
+
+def _two_sample_chisq(a_tokens, b_tokens, min_count=10):
+    """Pooled two-sample chi-square over the observed categories
+    (rare ones folded into "other").  Returns ``(z, tv, ncat)``:
+    the normal-approximated z-score of the statistic vs its df (a
+    same-distribution pair sits near 0) and the total-variation
+    distance of the two empirical histograms."""
+    from collections import Counter
+
+    ca, cb = Counter(a_tokens), Counter(b_tokens)
+    cats = [c for c in set(ca) | set(cb)
+            if ca.get(c, 0) + cb.get(c, 0) >= min_count]
+    other = [c for c in set(ca) | set(cb) if c not in cats]
+    na, nb = len(a_tokens), len(b_tokens)
+    rows = [(ca.get(c, 0), cb.get(c, 0)) for c in cats]
+    if other:
+        rows.append((sum(ca.get(c, 0) for c in other),
+                     sum(cb.get(c, 0) for c in other)))
+    stat = 0.0
+    for xa, xb in rows:
+        tot = xa + xb
+        ea = tot * na / (na + nb)
+        eb = tot * nb / (na + nb)
+        if ea > 0:
+            stat += (xa - ea) ** 2 / ea
+        if eb > 0:
+            stat += (xb - eb) ** 2 / eb
+    df = max(1, len(rows) - 1)
+    z = (stat - df) / (2 * df) ** 0.5
+    # TV over the SAME pooled categories (raw singleton categories
+    # would inflate the empirical TV of two identical distributions)
+    tv = 0.5 * sum(abs(xa / na - xb / nb) for xa, xb in rows)
+    return round(z, 3), round(tv, 4), len(rows)
+
+
+def run_sampling(mx, args, make_engine, workload, draft):
+    """The sampling workload's three arms (one payload):
+
+    1. mixed-config batch: a warmed sampling-mode engine serves the
+       greedy/temperature/top-k/top-p/logprobs cycle — ZERO fresh
+       traces (program-cache growth pinned at 0, the operand-vs-
+       trace-key contract) and the greedy rows byte-identical to a
+       greedy-only engine's output;
+    2. spec-on vs spec-off tok/s at temperature > 0 — the rejection-
+       sampling acceptance extends the spec speedup to stochastic
+       traffic (gate >= 1.25x);
+    3. distribution agreement: the (token0, token1) pairs of many
+       2-token generations, spec-on vs spec-off, must be two samples
+       of ONE distribution (pooled two-sample chi-square z + TV
+       distance).
+
+    ``MXTPU_PAGED_ATTENTION=jnp`` pinned for the same per-formulation
+    reason as the spec workload."""
+    import os as _os
+
+    prev = _os.environ.get("MXTPU_PAGED_ATTENTION")
+    _os.environ["MXTPU_PAGED_ATTENTION"] = "jnp"
+    try:
+        return _run_sampling_pinned(mx, args, make_engine, workload,
+                                    draft)
+    finally:
+        if prev is None:
+            _os.environ.pop("MXTPU_PAGED_ATTENTION", None)
+        else:
+            _os.environ["MXTPU_PAGED_ATTENTION"] = prev
+
+
+def _run_sampling_pinned(mx, args, make_engine, workload, draft):
+    from mxnet_tpu.serve import engine as engine_mod
+
+    blocks_for = mx.serve.kv_block_manager.blocks_for
+    conc = args.concurrency
+    k = args.spec_k
+    temp = args.sampling_temp
+    max_len = max(len(p) for p, _ in workload) + args.max_new
+    num_blocks = 1 + (conc + 2) * blocks_for(max_len + k + 1,
+                                             args.block_size)
+    kw = dict(num_blocks=num_blocks, max_queue=len(workload) + 1,
+              sampling=True)
+    spec_kw = dict(spec_k=k, draft_params=draft,
+                   draft_num_heads=args.heads, draft_window=0, **kw)
+
+    # -- arm 1: mixed configs, zero fresh traces, greedy rows exact ----
+    geng = make_engine(conc, num_blocks=num_blocks,
+                       max_queue=len(workload) + 1)
+    g_reqs, _ = run_closed(mx, geng, workload, conc)
+    geng.shutdown()
+    eng = make_engine(conc, **kw)
+    eng.warmup()
+    cache_before = len(engine_mod._STEP_CACHE)
+    m_reqs, m_wall = run_closed(mx, eng, workload, conc,
+                                cfg_fn=sampling_config)
+    retraces = len(engine_mod._STEP_CACHE) - cache_before
+    greedy_identical = all(
+        a.status == b.status == "finished" and a.tokens == b.tokens
+        for i, (a, b) in enumerate(zip(g_reqs, m_reqs))
+        if not sampling_config(i))
+    logprobs_ok = True
+    for i, r in enumerate(m_reqs):
+        want = sampling_config(i).get("logprobs", 0)
+        if not want:
+            continue
+        if (len(r.token_logprobs) != len(r.tokens)
+                or len(r.top_logprobs) != len(r.tokens)
+                or any(len(t) != want for t in r.top_logprobs)):
+            logprobs_ok = False
+    mixed_tps = (sum(len(r.tokens) for r in m_reqs) / m_wall
+                 if m_wall else None)
+    eng.shutdown()
+
+    # -- arm 2: spec on/off tok/s at temperature > 0 -------------------
+    def once(ekw, wl, cfg_fn):
+        e = make_engine(conc, **ekw)
+        e.warmup()
+        rs, wall = run_closed(mx, e, wl, conc, cfg_fn=cfg_fn)
+        st = e.stats()
+        e.shutdown()
+        return rs, wall, st
+
+    stoch = lambda i: {"temperature": temp}   # noqa: E731
+    off_reqs, off_wall, off_st = once(kw, workload, stoch)
+    on_reqs, on_wall, on_st = once(spec_kw, workload, stoch)
+    tps_off = (sum(len(r.tokens) for r in off_reqs) / off_wall
+               if off_wall else None)
+    tps_on = (sum(len(r.tokens) for r in on_reqs) / on_wall
+              if on_wall else None)
+
+    # -- arm 3: distribution agreement, spec-on vs spec-off ------------
+    M = args.agreement_samples
+    pair_wl = [(workload[0][0], 2)] * M
+
+    def pairs(ekw):
+        rs, _, _ = once(ekw, pair_wl, stoch)
+        return [(r.tokens[0], r.tokens[1]) for r in rs
+                if len(r.tokens) == 2]
+
+    z, tv, ncat = _two_sample_chisq(pairs(kw), pairs(spec_kw))
+
+    return {
+        "mode": "sampling",
+        "requests": len(workload),
+        "spec_k": k,
+        "sampling_temp": temp,
+        "retraces": retraces,
+        "greedy_rows_identical": bool(greedy_identical),
+        "logprobs_ok": bool(logprobs_ok),
+        "mixed_tokens_per_sec": (round(mixed_tps, 1)
+                                 if mixed_tps else None),
+        "tokens_per_sec_on": round(tps_on, 1) if tps_on else None,
+        "tokens_per_sec_off": round(tps_off, 1) if tps_off else None,
+        "sampling_spec_speedup": (round(tps_on / tps_off, 2)
+                                  if tps_on and tps_off else None),
+        "accept_rate_stochastic": on_st.spec_accept_rate_stochastic,
+        "spec_verifies": on_st.spec_verifies,
+        "agreement_samples": M,
+        "agreement_z": z,
+        "agreement_tv": tv,
+        "agreement_categories": ncat,
+    }
+
+
 def snap_int8(params, num_heads):
     """Snap every engine-eligible matmul projection onto its
     per-output-channel int8 grid (``w -> dequant(quantize(w))``).
@@ -578,12 +755,15 @@ def run_mixed_len(mx, args, make_engine):
     }
 
 
-def run_closed(mx, engine, workload, concurrency, deadline_s=None):
+def run_closed(mx, engine, workload, concurrency, deadline_s=None,
+               cfg_fn=None):
     """Closed loop: keep ``concurrency`` requests in flight.  A full
     admission queue throttles the loop (closed-loop clients WAIT for
-    capacity — e.g. --max-queue below --concurrency), it never drops."""
+    capacity — e.g. --max-queue below --concurrency), it never drops.
+    ``cfg_fn(i)`` supplies per-request extra submit kwargs (the
+    sampling workload's mixed-config cycle)."""
     reqs, inflight, held = [], [], None
-    it = iter(workload)
+    it = iter(enumerate(workload))
     t0 = time.perf_counter()
     while True:
         while len(inflight) < concurrency:
@@ -591,10 +771,12 @@ def run_closed(mx, engine, workload, concurrency, deadline_s=None):
             if nxt is None:
                 break
             held = None
-            prompt, max_new = nxt
+            i, (prompt, max_new) = nxt
             try:
                 reqs.append(engine.submit(prompt, max_new_tokens=max_new,
-                                          deadline_s=deadline_s))
+                                          deadline_s=deadline_s,
+                                          **(cfg_fn(i) if cfg_fn
+                                             else {})))
             except mx.serve.QueueFull:
                 held = nxt            # back-pressure: retry after a step
                 break
@@ -675,7 +857,8 @@ def main():
     p.add_argument("--mode", default="closed", choices=("closed", "open"))
     p.add_argument("--workload", default="default",
                    choices=("default", "shared-prefix", "mixed-len",
-                            "prefix", "spec", "quant", "offload"),
+                            "prefix", "spec", "quant", "offload",
+                            "sampling"),
                    help="default: the mixed prompt-length load. "
                         "shared-prefix: --prefixes system prompts x "
                         "--continuations suffixes, cache-on vs cache-off "
@@ -699,7 +882,14 @@ def main():
                         "off hit rate/prefill compute, vs an "
                         "unconstrained-HBM reference, with int8-KV and "
                         "tp=2 arms, tokens byte-identical everywhere "
-                        "-> the OFFLOAD_BENCH.json stage")
+                        "-> the OFFLOAD_BENCH.json stage. "
+                        "sampling: per-request sampling operands — "
+                        "mixed-config batch with zero fresh traces + "
+                        "greedy-row identity, spec-on vs spec-off "
+                        "tok/s at temperature>0 (rejection-sampling "
+                        "acceptance) and a chi-square/TV distribution-"
+                        "agreement pin -> the SAMPLING_BENCH.json "
+                        "stage")
     p.add_argument("--offload-prefixes", type=int, default=6,
                    help="offload: distinct system prompts (sized to "
                         "overflow the deliberately small HBM LRU)")
@@ -720,6 +910,13 @@ def main():
                    help="spec: damping on the target's above-draft "
                         "layers — higher = a worse draft, lower "
                         "acceptance (1.0 = undistilled)")
+    p.add_argument("--sampling-temp", type=float, default=0.25,
+                   help="sampling: the temperature of the spec A/B "
+                        "and agreement arms (>0; low keeps the "
+                        "distilled draft's acceptance high)")
+    p.add_argument("--agreement-samples", type=int, default=192,
+                   help="sampling: 2-token generations per arm of the "
+                        "distribution-agreement chi-square")
     p.add_argument("--long-prompt", type=int, default=2048,
                    help="mixed-len: the long prompt's token count")
     p.add_argument("--prefill-chunk", type=int, default=0,
@@ -831,7 +1028,7 @@ def main():
         # the quant A/B serves an int8-snapped checkpoint so agreement
         # measures serving-stack rounding, not random-logit ties
         params = snap_int8(params, args.heads)
-    if args.workload == "spec":
+    if args.workload in ("spec", "sampling"):
         # the A/B's checkpoint pair: damped target + truncated draft
         # (both engines below serve the SAME damped target, so the
         # identity check compares like with like)
@@ -906,6 +1103,24 @@ def main():
             out["accepted_per_verify"] = rec["accepted_per_verify"]
             out["tokens_per_sec_on"] = rec["tokens_per_sec_on"]
             out["tokens_per_sec_off"] = rec["tokens_per_sec_off"]
+            flush(False)
+        if args.workload == "sampling":
+            wl = build_repeat_heavy_workload(rng, args)
+            rec = run_sampling(mx, args, make_engine, wl, draft)
+            print(json.dumps(rec))
+            pts.append(rec)
+            recs.append(rec)
+            # the bench_watch serve_sampling contract fields
+            out["retraces"] = rec["retraces"]
+            out["greedy_rows_identical"] = rec["greedy_rows_identical"]
+            out["logprobs_ok"] = rec["logprobs_ok"]
+            out["sampling_spec_speedup"] = rec["sampling_spec_speedup"]
+            out["tokens_per_sec_on"] = rec["tokens_per_sec_on"]
+            out["tokens_per_sec_off"] = rec["tokens_per_sec_off"]
+            out["accept_rate_stochastic"] = rec["accept_rate_stochastic"]
+            out["agreement_z"] = rec["agreement_z"]
+            out["agreement_tv"] = rec["agreement_tv"]
+            out["agreement_samples"] = rec["agreement_samples"]
             flush(False)
         if args.workload == "offload":
             wl = build_offload_workload(rng, args)
