@@ -35,6 +35,18 @@ analog here (ROADMAP item 3) is this package:
   live cross-role stitched timelines, and carries the fleet timeline
   annotations (supervisor lifecycle, SLO alerts).  Rendered by
   ``tools/fleet_report.py``; the sensor half of autoscaling.
+- ``autoscaler``— ``Autoscaler``: the policy half of the control
+  plane (``MXTPU_AUTOSCALE_SPEC``, e.g. ``prefill=1:4;decode=1:8;
+  up_queue=16``): scales each role's pool independently on its own
+  signals (prefill: queue depth + TTFT burn; decode: waiting
+  handoffs + KV/host-KV headroom + TPOT burn) with per-role bounds,
+  asymmetric hysteresis and an oscillation cooldown; actuates via
+  ``Supervisor.add_slot``/``remove_slot`` (AOT-warm spawns), router
+  membership follows.
+- ``deploy``    — ``Deployer``: rolling weight-reload — replace
+  slots drain-by-drain behind a token-parity canary probe, mixed
+  versions coexist mid-rollout, automatic whole-rollout rollback on
+  parity failure or SLO burn alert.
 - ``slo``       — declarative objectives (``MXTPU_SLO_SPEC``, e.g.
   ``ttft_p99_ms=500;availability=0.999``) with SRE-workbook
   fast/slow multi-window burn-rate alerting: a firing alert counts
@@ -46,7 +58,9 @@ Docs: docs/how_to/fleet.md.  Benchmark: ``tools/fleet_bench.py``
 rolling-restart downtime).
 """
 
+from .autoscaler import Autoscaler, parse_autoscale_spec
 from .collector import FleetCollector
+from .deploy import Deployer
 from .faults import Fault, FaultInjector, parse_fault_spec
 from .replica import (DEAD, DRAINING, READY, ROLES, STARTING,
                       ReplicaServer, TRACE_HEADER)
@@ -61,4 +75,5 @@ __all__ = ["ReplicaServer", "Router", "RouterResult", "Supervisor",
            "PermanentError", "NoReplicaAvailable", "TRACE_HEADER",
            "ROLES", "STARTING", "READY", "DRAINING", "DEAD",
            "FleetCollector", "SLOEvaluator", "Objective",
-           "parse_slo_spec"]
+           "parse_slo_spec", "Autoscaler", "parse_autoscale_spec",
+           "Deployer"]

@@ -80,7 +80,7 @@ _STATS_FIELDS = ("tokens_generated", "prompt_tokens", "completed",
 class _ReplicaView:
     """Collector-side view of one replica: identity + its ring."""
 
-    __slots__ = ("url", "name", "role", "state", "ring",
+    __slots__ = ("url", "name", "role", "state", "version", "ring",
                  "last_attempt_t", "last_success_t",
                  "consecutive_failures", "total_failures", "scrapes")
 
@@ -89,6 +89,7 @@ class _ReplicaView:
         self.name = self.url
         self.role = "both"
         self.state = "unknown"
+        self.version = None
         self.ring = TimeSeriesRing(ring_capacity, clock=clock)
         self.last_attempt_t = None
         self.last_success_t = None
@@ -304,6 +305,7 @@ class FleetCollector:
             view.name = sec.get("replica") or view.name
             view.role = sec.get("role") or "both"
             view.state = sec.get("state") or "unknown"
+            view.version = sec.get("version")
             view.consecutive_failures = 0
             view.last_success_t = self.clock()
             view.scrapes += 1
@@ -425,11 +427,9 @@ class FleetCollector:
     # -- aggregation ---------------------------------------------------------
     def _replica_row(self, view, now):
         ring = view.ring
-        latest = {f: ring.latest(f) for f in _GAUGE_FIELDS}
-        totals = {f: ring.latest(f)
-                  for f in ("tokens_generated", "completed", "rejected")}
         row = {"url": view.url, "replica": view.name, "role": view.role,
                "state": view.state,
+               "version": view.version,
                "stale": self.is_stale(view, now),
                "consecutive_failures": view.consecutive_failures,
                "total_failures": view.total_failures,
@@ -437,6 +437,15 @@ class FleetCollector:
                "age_s": (round(now - view.last_success_t, 3)
                          if view.last_success_t is not None else None),
                "samples": len(ring)}
+        if row["stale"]:
+            # a stale replica's last-scraped load signals are dead
+            # data: past the age cap the row keeps identity/failure
+            # fields only, so neither the role aggregates nor a policy
+            # reader (the autoscaler) can scale on a corpse's queue
+            return row
+        latest = {f: ring.latest(f) for f in _GAUGE_FIELDS}
+        totals = {f: ring.latest(f)
+                  for f in ("tokens_generated", "completed", "rejected")}
         row.update({k: v for k, v in latest.items() if v is not None})
         row.update({k: int(v) for k, v in totals.items()
                     if v is not None})
@@ -470,11 +479,15 @@ class FleetCollector:
                 "tokens_generated": 0, "completed": 0, "rejected": 0,
                 "tok_per_sec": 0.0, "_kv": [], "_hkv": [],
                 "_ttft": [], "_tpot": [],
-                "tenant_goodput": {}})
+                "tenant_goodput": {}, "versions": {}})
             agg["replicas"] += 1
             if row["stale"]:
                 agg["stale"] += 1
                 continue
+            if row.get("version"):
+                # fresh replicas by deploy tag: >1 key mid-rollout
+                agg["versions"][row["version"]] = \
+                    agg["versions"].get(row["version"], 0) + 1
             for f in ("queue_depth", "running", "waiting_handoffs",
                       "tokens_generated", "completed", "rejected"):
                 agg[f] += int(row.get(f) or 0)

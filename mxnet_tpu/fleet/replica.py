@@ -157,11 +157,16 @@ class ReplicaServer:
         seconds slept per ``/handoff`` arrival (a simulated slow
         wire), and how many handoffs' KV records to discard before
         import ("arrived truncated" — degrades to recompute).
+      version: deploy identity tag (checkpoint digest or a
+        ``--version`` string), surfaced on /healthz and
+        /statusz.json so mixed fleets mid-rollout stay tellable
+        apart; None = untagged.
     """
 
     def __init__(self, engine, host="127.0.0.1", port=0, replica_id=None,
                  fault_injector=None, on_kill=None, poll_s=0.002,
-                 role=None, handoff_delay_s=None, handoff_drop=None):
+                 role=None, handoff_delay_s=None, handoff_drop=None,
+                 version=None):
         self.engine = engine
         self.host = host
         self._requested_port = int(port)
@@ -182,6 +187,10 @@ class ReplicaServer:
                 "(Engine(host_kv_bytes=) / MXTPU_SERVE_HOST_KV_BYTES "
                 "> 0): handoff records are ingested into it")
         self.role = role
+        # deploy identity (checkpoint digest or --version tag): mixed
+        # fleets coexist mid-rollout, so every status surface carries
+        # it — the collector/deployer tell versions apart by this
+        self.version = version
         self._handoff_delay_s = (
             float(handoff_delay_s) if handoff_delay_s is not None
             else env_float(faults_mod.ENV_HANDOFF_DELAY, 0.0))
@@ -756,7 +765,7 @@ class ReplicaServer:
     def _health(self):
         state = self.state
         hk = self.engine.host_kv_stats()
-        return {"status": "ok" if state == READY else state,
+        payload = {"status": "ok" if state == READY else state,
                 "state": state,
                 # the disaggregation role: the router routes prompts
                 # to prefill-capable replicas and handoffs to
@@ -777,6 +786,11 @@ class ReplicaServer:
                 # recompute, so the tier's headroom IS a load signal
                 "host_kv_utilization": (hk["utilization"]
                                         if hk is not None else None)}
+        # deploy identity is optional: untagged replicas keep the
+        # pre-control-plane /healthz schema byte-for-byte
+        if self.version is not None:
+            payload["version"] = self.version
+        return payload
 
     def _replica_state(self):
         """The router's balancing signal: readiness plus live load
@@ -798,6 +812,7 @@ class ReplicaServer:
         s = eng.stats()
         return {"replica": self.replica_id, "state": state,
                 "role": self.role,
+                "version": self.version,
                 "served": served, "in_flight": inflight,
                 # the serving ground truth the fleet collector
                 # aggregates (three-view agreement: fleet /fleetz ==

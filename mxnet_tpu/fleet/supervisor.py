@@ -188,6 +188,10 @@ class Supervisor:
         # respawn them (it would see the intentionally-terminated
         # handle as a crash and double-spawn an orphan replica)
         self._rolling = set()                # guarded-by: _lock
+        # slots drained out by scale-down.  Indices are NEVER reused —
+        # a slot keeps its identity in metrics/annotations forever, so
+        # "slot 3 restarted twice" stays meaningful across pool resizes
+        self._retired = set()                # guarded-by: _lock
         self._monitor = None
         self._stop_evt = threading.Event()
         self._m_restarts = telemetry.counter(
@@ -216,14 +220,23 @@ class Supervisor:
     def urls(self):
         return [h.url for h in self.handles() if h is not None]
 
+    def active_slots(self):
+        """Slot indices currently backing the pool (retired scale-down
+        slots excluded)."""
+        with self._lock:
+            return [s for s in range(self.n) if s not in self._retired]
+
+    def pool_size(self):
+        return len(self.active_slots())
+
     def start(self):
         """Spawn every slot (serially — replica startup may compile)."""
         for slot in range(self.n):
             self._spawn_slot(slot)
         return self
 
-    def _spawn_slot(self, slot):
-        handle = self.spawn(slot)
+    def _spawn_slot(self, slot, factory=None):
+        handle = (factory or self.spawn)(slot)
         with self._lock:
             old = self._handles[slot]
             self._handles[slot] = handle
@@ -337,6 +350,8 @@ class Supervisor:
         timeout_s = (self.drain_timeout_s if timeout_s is None
                      else timeout_s)
         h = self.handles()[slot]
+        if h is None:
+            return False            # empty/retired slot: nothing to wait on
         deadline = self.clock() + timeout_s
         while self.clock() < deadline:
             if h.poll() is not None:
@@ -355,37 +370,46 @@ class Supervisor:
             self.sleep(0.05)
         return False
 
-    def drain_and_restart(self, slot):
-        """The zero-downtime slot restart: drain -> wait ->
-        terminate -> respawn (warm via the AOT/warmup env the spawn
-        command carries).  Returns the replacement handle."""
-        t0 = self.clock()
-        # claim the slot EXCLUSIVELY: if the crash monitor is mid-spawn
-        # on it (it holds the claim across its slow spawn), wait for it
-        # to finish rather than replacing a handle it is about to set
-        # (which would orphan the monitor's live replacement process)
+    def _claim(self, slot):
+        """Claim a slot EXCLUSIVELY: if the crash monitor is mid-spawn
+        on it (it holds the claim across its slow spawn), wait for it
+        to finish rather than replacing a handle it is about to set
+        (which would orphan the monitor's live replacement process)."""
         while True:
             with self._lock:
                 if slot not in self._rolling:
                     self._rolling.add(slot)
-                    break
+                    return
             self.sleep(0.05)
+
+    def replace_slot(self, slot, factory=None, reason="rolling"):
+        """The zero-downtime slot replacement: drain -> wait ->
+        terminate -> spawn-with-``factory`` (default: this
+        supervisor's own ``spawn``, i.e. a plain restart — warm via
+        the AOT/warmup env the spawn command carries) under the
+        ``_rolling`` exclusive claim, so the deployer never races the
+        crash monitor.  Returns the replacement handle, or None for a
+        retired slot."""
+        with self._lock:
+            if slot in self._retired:
+                return None
+        t0 = self.clock()
+        kind = ("rolling_restart_slot" if reason == "rolling"
+                else "deploy_replace_slot")
+        self._claim(slot)
         try:
-            self._annotate("rolling_restart_slot", slot=slot,
-                           phase="drain")
+            self._annotate(kind, slot=slot, phase="drain")
             self.drain(slot)
             self.wait_drained(slot)
             h = self.handles()[slot]
             if h is not None:
-                self._annotate("rolling_restart_slot", slot=slot,
-                               phase="terminate",
+                self._annotate(kind, slot=slot, phase="terminate",
                                url=getattr(h, "url", None))
                 h.terminate()
-            handle = self._spawn_slot(slot)
+            handle = self._spawn_slot(slot, factory)
             self._m_restarts.labels(slot=str(slot),
-                                    reason="rolling").inc()
-            self._annotate("rolling_restart_slot", slot=slot,
-                           phase="respawned",
+                                    reason=reason).inc()
+            self._annotate(kind, slot=slot, phase="respawned",
                            url=getattr(handle, "url", None),
                            wall_s=round(self.clock() - t0, 3))
         finally:
@@ -398,12 +422,79 @@ class Supervisor:
         ).observe(self.clock() - t0)
         return handle
 
+    def drain_and_restart(self, slot):
+        """The zero-downtime slot restart (same-factory
+        :meth:`replace_slot`).  Returns the replacement handle."""
+        return self.replace_slot(slot)
+
     def rolling_restart(self):
         """Drain-and-restart every slot, one at a time — the fleet
         never loses more than one replica of capacity, and the router
         retries each drain's rejections on the live siblings."""
-        self._annotate("rolling_restart", phase="start", slots=self.n)
-        for slot in range(self.n):
+        slots = self.active_slots()
+        self._annotate("rolling_restart", phase="start",
+                       slots=len(slots))
+        for slot in slots:
             self.drain_and_restart(slot)
-        self._annotate("rolling_restart", phase="done", slots=self.n)
+        self._annotate("rolling_restart", phase="done",
+                       slots=len(slots))
         return self.urls()
+
+    # -- pool resizing (the autoscaler's actuations) -------------------------
+    def add_slot(self, factory=None):
+        """Grow the pool by one slot: append a fresh slot index and
+        spawn it (claimed in ``_rolling`` for the duration so the
+        crash monitor never touches a half-born slot).  Returns the
+        new slot index."""
+        with self._lock:
+            slot = self.n
+            self.n += 1
+            self._handles.append(None)
+            self._restarts.append(0)
+            self._next_restart_t.append(0.0)
+            self._rolling.add(slot)
+        try:
+            handle = self._spawn_slot(slot, factory)
+        except Exception:
+            with self._lock:
+                # a slot whose first spawn failed never joined the
+                # pool; retire it so monitors/rolls skip the stub
+                self._retired.add(slot)
+            raise
+        finally:
+            with self._lock:
+                self._rolling.discard(slot)
+        self._annotate("scale_up_slot", slot=slot,
+                       url=getattr(handle, "url", None))
+        return slot
+
+    def remove_slot(self, slot):
+        """Shrink the pool by one slot: drain -> wait -> terminate,
+        then RETIRE the index (router membership follows).  Returns
+        True when the slot was removed, False when already retired."""
+        with self._lock:
+            if slot in self._retired:
+                return False
+        self._claim(slot)
+        try:
+            with self._lock:
+                if slot in self._retired:
+                    return False
+            self._annotate("scale_down_slot", slot=slot, phase="drain")
+            self.drain(slot)
+            self.wait_drained(slot)
+            with self._lock:
+                h = self._handles[slot]
+                self._handles[slot] = None
+                self._retired.add(slot)
+            if h is not None:
+                if self.router is not None and h.url:
+                    self.router.remove_replica(h.url)
+                h.terminate()
+            self._annotate("scale_down_slot", slot=slot,
+                           phase="terminated",
+                           url=getattr(h, "url", None))
+        finally:
+            with self._lock:
+                self._rolling.discard(slot)
+        return True

@@ -1,0 +1,586 @@
+"""Fleet control-plane tests (mxnet_tpu/fleet/autoscaler.py +
+deploy.py): role-aware autoscaling policy, supervisor pool resizing /
+slot replacement, and rolling weight-reload deploys with SLO-gated
+rollback.
+
+Two tiers of harness, both tier-1 CPU-deterministic:
+
+* **policy tests** drive ``Autoscaler.evaluate`` with a fake clock, a
+  fake collector (settable role aggregates + SLO section) and fake
+  per-role pools — no engines, no HTTP — pinning the decision rules:
+  scale-up on a queue step, scale-down only after quiet windows,
+  flapping load never actuates more than once per cooldown, prefill
+  pressure never grows the decode pool, min/max bounds hold, and a
+  role whose replicas are all stale is never scaled (dead data).
+* **fleet tests** use real in-process ``ReplicaServer`` HTTP fronts
+  over real engines (the test_fleet.py tiny-model recipe) to pin
+  ``Supervisor.replace_slot`` (including crash-during-replace),
+  ``add_slot``/``remove_slot`` retirement, the deployer's token-parity
+  gate (pass and fail), rollback-on-burn, and mixed-version routing
+  with per-slot versions in ``/fleetz``.
+"""
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.fleet import (Autoscaler, Deployer, FleetCollector,
+                             ReplicaServer, Router, Supervisor,
+                             parse_autoscale_spec)
+
+VOCAB = 53
+
+
+@pytest.fixture(scope="module")
+def model():
+    """Tiny gpt2-style net + params (the test_fleet recipe)."""
+    S = 96
+    net = mx.models.gpt(VOCAB, S, num_layers=2, d_model=32, num_heads=4)
+    arg_shapes, _, _ = net.infer_shape(data=(1, S), softmax_label=(1, S))
+    rng = np.random.RandomState(3)
+    params = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        scale = 0.35 if name.endswith("weight") else 0.0
+        params[name] = (rng.randn(*shp) * scale
+                        + (1.0 if name.endswith("gamma") else 0.0)
+                        ).astype(np.float32)
+    return net, params
+
+
+@pytest.fixture(scope="module")
+def model_b(model):
+    """Same architecture, DIFFERENT weights (seed 11) — the "new
+    checkpoint that is not the weights it claims to be" of the parity
+    failure arm."""
+    net, _ = model
+    S = 96
+    arg_shapes, _, _ = net.infer_shape(data=(1, S), softmax_label=(1, S))
+    rng = np.random.RandomState(11)
+    params = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        scale = 0.35 if name.endswith("weight") else 0.0
+        params[name] = (rng.randn(*shp) * scale
+                        + (1.0 if name.endswith("gamma") else 0.0)
+                        ).astype(np.float32)
+    return net, params
+
+
+def _engine(model, **kw):
+    net, params = model
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("max_prefills_per_step", 2)
+    return mx.serve.Engine(params, symbol=net, **kw)
+
+
+def _get(url, path, timeout=10):
+    with urllib.request.urlopen(f"{url}{path}", timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture
+def fleet_cleanup():
+    items = []
+    yield items
+    for obj in reversed(items):
+        try:
+            obj.stop()
+        except Exception:
+            pass
+
+
+@pytest.fixture
+def tel():
+    telemetry.reset()
+    telemetry.enable()
+    yield telemetry.registry()
+    telemetry.disable()
+    telemetry.reset()
+
+
+class _InProcHandle:
+    def __init__(self, replica):
+        self.replica = replica
+        self.url = replica.url
+
+    def poll(self):
+        return None if self.replica.state != "dead" else 1
+
+    def terminate(self, grace_s=None):
+        self.replica.stop()
+
+
+def _factory(model, fleet_cleanup, version):
+    """spawn(slot) -> in-process replica handle tagged ``version``."""
+    def spawn(slot):
+        rep = ReplicaServer(_engine(model),
+                            replica_id=f"{version}-s{slot}",
+                            version=version).start()
+        fleet_cleanup.append(rep)
+        return _InProcHandle(rep)
+    return spawn
+
+
+# -- policy-test fakes --------------------------------------------------------
+class _FakePool:
+    """Actuator stub: real pool bookkeeping, no spawning."""
+
+    def __init__(self, n=1):
+        self.slots = list(range(n))
+        self._next = n
+        self.added = []
+        self.removed = []
+
+    def pool_size(self):
+        return len(self.slots)
+
+    def active_slots(self):
+        return list(self.slots)
+
+    def add_slot(self, factory=None):
+        slot = self._next
+        self._next += 1
+        self.slots.append(slot)
+        self.added.append(slot)
+        return slot
+
+    def remove_slot(self, slot):
+        self.slots.remove(slot)
+        self.removed.append(slot)
+        return True
+
+
+class _FakeCollector:
+    def __init__(self):
+        self.roles = {}
+        self.slo = None
+        self.slo_section = None
+        self.notes = []
+
+    def fleet_view(self):
+        return {"roles": self.roles, "slo": self.slo_section}
+
+    def annotate(self, kind, **fields):
+        self.notes.append(dict(kind=kind, **fields))
+
+
+def _agg(replicas=1, stale=0, queue=0, running=0, handoffs=0,
+         kv=None, hkv=None):
+    return {"replicas": replicas, "stale": stale,
+            "queue_depth": queue, "running": running,
+            "waiting_handoffs": handoffs,
+            "kv_utilization_mean": kv,
+            "host_kv_utilization_mean": hkv}
+
+
+# -- spec grammar -------------------------------------------------------------
+def test_autoscale_spec_grammar():
+    cfg = parse_autoscale_spec(
+        "prefill=1:4;decode=1:8;up_queue=16;down_idle_s=30")
+    assert cfg["bounds"] == {"prefill": (1, 4), "decode": (1, 8)}
+    assert cfg["up_queue"] == 16.0 and cfg["down_idle_s"] == 30.0
+    assert cfg["up_handoffs"] == 4.0 and cfg["cooldown_s"] == 15.0
+    assert parse_autoscale_spec("both=2:2")["bounds"] == {
+        "both": (2, 2)}
+    for bad in ("prefill=4:1",          # min > max
+                "prefill=1",            # no :max
+                "replica=1:2",          # unknown role
+                "up_queue=-3",          # negative knob
+                "prefill=1:2;prefill=1:3",   # duplicate role
+                "up_queue=16",          # knobs only: nothing to manage
+                "prefill=a:b",
+                "wat"):
+        with pytest.raises(ValueError):
+            parse_autoscale_spec(bad)
+
+
+# -- scaling policy (fake clock, fake pools) ----------------------------------
+def test_scale_up_on_queue_step(tel):
+    col = _FakeCollector()
+    pool = _FakePool(1)
+    clock = {"now": 0.0}
+    a = Autoscaler(col, {"prefill": pool},
+                   spec="prefill=1:4;up_queue=16",
+                   clock=lambda: clock["now"])
+    col.roles = {"prefill": _agg(replicas=1, queue=3)}
+    assert a.evaluate() == []                 # under threshold: hold
+    col.roles = {"prefill": _agg(replicas=1, queue=40)}
+    assert a.evaluate() == [("prefill", "up", "queue")]
+    assert pool.pool_size() == 2
+    # threshold is per FRESH replica: 40 queued over 2 replicas is
+    # still 20 >= 16 -> next window (cooldown first) scales again
+    clock["now"] = 20.0
+    col.roles = {"prefill": _agg(replicas=2, queue=40)}
+    assert a.evaluate() == [("prefill", "up", "queue")]
+    snap = telemetry.registry().snapshot()
+    events = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in snap["mxtpu_fleet_scale_events_total"]["samples"]}
+    key = (("direction", "up"), ("reason", "queue"),
+           ("role", "prefill"))
+    assert events[key] == 2.0
+    # the actuation trail: timeline annotations carry the decision
+    assert [n for n in col.notes if n["kind"] == "autoscale"]
+
+
+def test_scale_down_only_after_quiet_windows():
+    col = _FakeCollector()
+    pool = _FakePool(3)
+    clock = {"now": 0.0}
+    a = Autoscaler(col, {"both": pool},
+                   spec="both=1:4;down_idle_s=30;cooldown_s=5",
+                   clock=lambda: clock["now"])
+    col.roles = {"both": _agg(replicas=3)}    # fully quiet
+    assert a.evaluate() == []                 # ledger starts at t=0
+    clock["now"] = 29.0
+    assert a.evaluate() == []                 # not quiet long enough
+    clock["now"] = 31.0
+    assert a.evaluate() == [("both", "down", "idle")]
+    assert pool.pool_size() == 2
+    # the actuation resets the ledger: a FULL fresh window is needed
+    clock["now"] = 36.0
+    assert a.evaluate() == []                 # ledger restarts at 36
+    clock["now"] = 60.0
+    assert a.evaluate() == []                 # 24s quiet < 30
+    clock["now"] = 67.0
+    assert a.evaluate() == [("both", "down", "idle")]
+    assert pool.pool_size() == 1              # at min now
+    clock["now"] = 200.0
+    assert a.evaluate() == []                 # min bound holds
+    assert pool.removed == [3 - 1, 2 - 1]     # newest slots first
+
+
+def test_hysteresis_flapping_load_one_actuation_per_cooldown():
+    col = _FakeCollector()
+    pool = _FakePool(1)
+    clock = {"now": 0.0}
+    a = Autoscaler(col, {"both": pool},
+                   spec="both=1:8;up_queue=4;cooldown_s=10",
+                   clock=lambda: clock["now"])
+    pressured = {"both": _agg(replicas=1, queue=50)}
+    quiet = {"both": _agg(replicas=1)}
+    actions = []
+    for i in range(20):                       # flap every 0.5s for 10s
+        clock["now"] = i * 0.5
+        col.roles = pressured if i % 2 == 0 else quiet
+        actions += a.evaluate()
+    assert len(actions) == 1                  # <= 1 per cooldown window
+    clock["now"] = 10.5                       # cooldown elapsed
+    col.roles = pressured
+    assert a.evaluate() == [("both", "up", "queue")]
+    # a pressure blip also resets the scale-down ledger: quiet resumes
+    # from scratch, it does not inherit pre-blip quiet time
+    assert len(actions) + 1 == len(pool.added)
+
+
+def test_per_role_independence_and_decode_signals():
+    col = _FakeCollector()
+    pre, dec = _FakePool(1), _FakePool(1)
+    clock = {"now": 0.0}
+    a = Autoscaler(col, {"prefill": pre, "decode": dec},
+                   spec="prefill=1:4;decode=1:4;up_queue=8;"
+                        "up_handoffs=4;cooldown_s=0",
+                   clock=lambda: clock["now"])
+    # prefill pressure NEVER grows decode
+    col.roles = {"prefill": _agg(replicas=1, queue=100),
+                 "decode": _agg(replicas=1)}
+    assert a.evaluate() == [("prefill", "up", "queue")]
+    assert dec.added == []
+    # decode scales on its own signals: handoffs, then KV headroom
+    clock["now"] = 1.0
+    col.roles = {"prefill": _agg(replicas=2),
+                 "decode": _agg(replicas=1, handoffs=9)}
+    assert a.evaluate() == [("decode", "up", "handoffs")]
+    clock["now"] = 2.0
+    col.roles = {"prefill": _agg(replicas=2),
+                 "decode": _agg(replicas=2, hkv=0.95)}
+    assert a.evaluate() == [("decode", "up", "host_kv")]
+    assert pre.added == [1]                   # prefill grew exactly once
+    # decode queue pressure means nothing to a prefill pool and
+    # vice-versa: queue_depth on decode is not a decode signal
+    clock["now"] = 3.0
+    col.roles = {"prefill": _agg(replicas=2),
+                 "decode": _agg(replicas=3, queue=100)}
+    assert a.evaluate() == []
+
+
+def test_min_max_bounds_and_burn_signals():
+    col = _FakeCollector()
+    pool = _FakePool(2)
+    clock = {"now": 0.0}
+    a = Autoscaler(col, {"both": pool},
+                   spec="both=1:2;cooldown_s=0",
+                   clock=lambda: clock["now"])
+    # at max: pressure cannot grow the pool
+    col.roles = {"both": _agg(replicas=2, queue=500)}
+    assert a.evaluate() == []
+    # a firing ttft objective is prefill-side pressure (here: capped)
+    col.roles = {"both": _agg(replicas=2)}
+    col.slo_section = {"objectives": [
+        {"objective": "ttft_p99_ms", "firing": True}]}
+    assert a.evaluate() == []                 # still capped at max=2
+    pool.slots = [0]                          # shrink out-of-band
+    assert a.evaluate() == [("both", "up", "ttft_burn")]
+    # a firing objective also blocks scale-down quiet credit
+    col.roles = {"both": _agg(replicas=2)}
+    clock["now"] = 1000.0
+    assert a.evaluate() == []
+    col.slo_section = None
+    # below min: restored even with no aggregates scraped yet
+    empty = _FakePool(0)
+    b = Autoscaler(col, {"both": empty}, spec="both=1:2;cooldown_s=0",
+                   clock=lambda: clock["now"])
+    assert b.evaluate() == [("both", "up", "min_bound")]
+    assert empty.pool_size() == 1
+
+
+def test_never_scales_on_stale_aggregates():
+    """A role whose replicas are ALL stale reports load numbers the
+    autoscaler must ignore entirely — dead data scales nothing, in
+    either direction."""
+    col = _FakeCollector()
+    pool = _FakePool(2)
+    clock = {"now": 0.0}
+    a = Autoscaler(col, {"both": pool},
+                   spec="both=1:4;down_idle_s=1;cooldown_s=0",
+                   clock=lambda: clock["now"])
+    col.roles = {"both": _agg(replicas=2, stale=2, queue=500)}
+    for t in (0.0, 5.0, 50.0):
+        clock["now"] = t
+        assert a.evaluate() == []
+    assert pool.added == [] and pool.removed == []
+
+
+# -- collector age cap (regression pin) ---------------------------------------
+def test_collector_stale_row_drops_load_signals(model, fleet_cleanup):
+    """Regression: the collector used to keep serving a stale
+    replica's last-scraped load signals forever; past the staleness
+    age cap the row must carry identity/failure fields ONLY."""
+    rep = ReplicaServer(_engine(model), replica_id="r0",
+                        version="v1").start()
+    fleet_cleanup.append(rep)
+    clock = {"now": 0.0}
+    col = FleetCollector(urls=[rep.url], interval_s=0, stale_after=3.0,
+                         clock=lambda: clock["now"])
+    fleet_cleanup.append(col)
+    col.scrape()
+    view = col.fleet_view()
+    row = view["replicas"][0]
+    assert not row["stale"]
+    assert "queue_depth" in row and "kv_utilization" in row
+    assert row["version"] == "v1"
+    assert view["roles"]["both"]["versions"] == {"v1": 1}
+    clock["now"] = 10.0              # > stale_after * max(interval, 1)
+    view = col.fleet_view()
+    row = view["replicas"][0]
+    assert row["stale"]
+    for f in ("queue_depth", "running", "in_flight", "kv_utilization",
+              "tok_per_sec", "tokens_generated", "ttft_ms_p99"):
+        assert f not in row, f       # the dead data the fix removes
+    # identity and failure accounting stay visible
+    assert row["replica"] == "r0" and row["version"] == "v1"
+    assert row["scrapes"] == 1
+    agg = view["roles"]["both"]
+    assert agg["stale"] == 1 and agg["versions"] == {}
+
+
+# -- supervisor: replace_slot + pool resizing ---------------------------------
+def test_replace_slot_swaps_factory_and_router_membership(
+        model, fleet_cleanup, tel):
+    old = _factory(model, fleet_cleanup, "v1")
+    new = _factory(model, fleet_cleanup, "v2")
+    col = FleetCollector(urls=[], interval_s=0)
+    fleet_cleanup.append(col)
+    router = Router([], scrape_interval_s=0)
+    fleet_cleanup.append(router)
+    sup = Supervisor(old, 1, drain_timeout_s=10, router=router,
+                     collector=col)
+    fleet_cleanup.append(sup)
+    sup.start()
+    old_url = sup.urls()[0]
+    assert _get(old_url, "/healthz")["version"] == "v1"
+    handle = sup.replace_slot(0, new, reason="deploy")
+    assert handle.url != old_url
+    assert _get(handle.url, "/healthz")["version"] == "v2"
+    assert _get(handle.url, "/statusz.json")["replica"]["version"] \
+        == "v2"
+    assert [r.url for r in router.replicas()] == [handle.url]
+    phases = [a["phase"] for a in col.annotations()
+              if a["kind"] == "deploy_replace_slot"]
+    assert phases == ["drain", "terminate", "respawned"]
+    snap = telemetry.registry().snapshot()
+    reasons = {s["labels"]["reason"]: s["value"]
+               for s in snap["mxtpu_fleet_restarts_total"]["samples"]}
+    assert reasons == {"deploy": 1}
+
+
+def test_replace_slot_crash_during_replace(model, fleet_cleanup):
+    """A replica that dies mid-replace (here: before the drain can
+    even be posted) is still replaced — wait_drained observes the
+    death, terminate is a no-op, the factory spawn proceeds."""
+    old = _factory(model, fleet_cleanup, "v1")
+    new = _factory(model, fleet_cleanup, "v2")
+    sup = Supervisor(old, 1, drain_timeout_s=10)
+    fleet_cleanup.append(sup)
+    sup.start()
+    sup.handles()[0].replica.hard_stop()      # crash
+    handle = sup.replace_slot(0, new, reason="deploy")
+    assert handle is not None
+    assert _get(handle.url, "/healthz")["version"] == "v2"
+    # and the crash monitor never double-spawned: one live handle
+    assert len(sup.urls()) == 1
+
+
+def test_add_remove_slot_retires_indices(model, fleet_cleanup):
+    spawn = _factory(model, fleet_cleanup, "v1")
+    router = Router([], scrape_interval_s=0)
+    fleet_cleanup.append(router)
+    sup = Supervisor(spawn, 1, drain_timeout_s=10, router=router)
+    fleet_cleanup.append(sup)
+    sup.start()
+    slot = sup.add_slot()
+    assert slot == 1
+    assert sup.pool_size() == 2 and len(router.replicas()) == 2
+    assert sup.remove_slot(1) is True
+    assert sup.pool_size() == 1 and len(router.replicas()) == 1
+    assert sup.active_slots() == [0]
+    assert sup.remove_slot(1) is False        # already retired
+    assert sup.check() == []                  # monitor skips retired
+    # retired indices are never reused: the next growth is slot 2
+    assert sup.add_slot() == 2
+    assert sup.pool_size() == 2
+    # rolling restart walks ACTIVE slots only (a retired slot would
+    # crash the drain path with its None handle)
+    assert len(sup.rolling_restart()) == 2
+
+
+# -- rolling deploys ----------------------------------------------------------
+def test_rolling_deploy_parity_gate_pass(model, fleet_cleanup, tel):
+    old = _factory(model, fleet_cleanup, "v1")
+    new = _factory(model, fleet_cleanup, "v2")   # same weights, new tag
+    sup = Supervisor(old, 2, drain_timeout_s=10)
+    fleet_cleanup.append(sup)
+    sup.start()
+    dep = Deployer(sup)                       # bare sup -> {"both": sup}
+    ref = dep.probe(sup.urls()[0], "both")
+    report = dep.rollout(new, version="v2")
+    assert report["status"] == "ok" and report["reason"] is None
+    assert report["replaced"] == 2 and report["rolled_back"] == 0
+    for url in sup.urls():
+        assert _get(url, "/healthz")["version"] == "v2"
+        assert dep.probe(url, "both") == ref  # weight-reload: parity
+    snap = telemetry.registry().snapshot()
+    assert snap["mxtpu_deploy_slots_replaced_total"]["samples"][0][
+        "value"] == 2.0
+    assert sum(s["value"] for s in snap.get(
+        "mxtpu_deploy_rollbacks_total", {}).get("samples", ())) == 0.0
+
+
+def test_rolling_deploy_parity_failure_rolls_back(model, model_b,
+                                                  fleet_cleanup, tel):
+    old = _factory(model, fleet_cleanup, "v1")
+    bad = _factory(model_b, fleet_cleanup, "v2")  # DIFFERENT weights
+    sup = Supervisor(old, 2, drain_timeout_s=10)
+    fleet_cleanup.append(sup)
+    sup.start()
+    dep = Deployer(sup)
+    ref = dep.probe(sup.urls()[0], "both")
+    report = dep.rollout(bad, version="v2", old_factory=old)
+    assert report["status"] == "rolled_back"
+    assert report["reason"] == "parity"
+    assert report["replaced"] == 1            # first slot failed the gate
+    assert report["rolled_back"] == 1
+    # the restored fleet serves tokens IDENTICAL to the pre-rollout
+    # reference, on every slot
+    assert sup.pool_size() == 2
+    for url in sup.urls():
+        assert _get(url, "/healthz")["version"] == "v1"
+        assert dep.probe(url, "both") == ref
+    snap = telemetry.registry().snapshot()
+    assert snap["mxtpu_deploy_rollbacks_total"]["samples"][0][
+        "value"] == 1.0
+
+
+class _FiringSLO:
+    def __init__(self):
+        self.firing = False
+
+    def statusz(self):
+        return {"objectives": [{"objective": "ttft_p99_ms",
+                                "firing": self.firing}]}
+
+
+def test_rolling_deploy_rollback_on_slo_burn(model, fleet_cleanup,
+                                             tel):
+    old = _factory(model, fleet_cleanup, "v1")
+    new = _factory(model, fleet_cleanup, "v2")
+    col = FleetCollector(urls=[], interval_s=0)
+    fleet_cleanup.append(col)
+    col.slo = _FiringSLO()
+    sup = Supervisor(old, 2, drain_timeout_s=10, collector=col)
+    fleet_cleanup.append(sup)
+    sup.start()
+    dep = Deployer(sup, collector=col)
+    col.slo.firing = True                     # the fleet is burning
+    report = dep.rollout(new, version="v2", old_factory=old)
+    assert report["status"] == "rolled_back"
+    assert report["reason"] == "slo_burn"
+    for url in sup.urls():
+        assert _get(url, "/healthz")["version"] == "v1"
+    kinds = [a["kind"] for a in col.annotations()]
+    assert "deploy_rollback" in kinds
+
+
+def test_mixed_version_fleet_routes_and_surfaces_versions(
+        model, fleet_cleanup):
+    """Mid-rollout reality: one v1 and one v2 replica (same weights)
+    coexist — the router serves the mixed fleet token-identically and
+    /fleetz tells the versions apart per slot and per role."""
+    r1 = ReplicaServer(_engine(model), replica_id="old-r",
+                       version="v1").start()
+    r2 = ReplicaServer(_engine(model), replica_id="new-r",
+                       version="v2").start()
+    fleet_cleanup += [r1, r2]
+    router = Router([r1.url, r2.url], scrape_interval_s=0)
+    fleet_cleanup.append(router)
+    col = FleetCollector(urls=[r1.url, r2.url], interval_s=0)
+    fleet_cleanup.append(col)
+    col.scrape()
+    view = col.fleet_view()
+    rows = {r["replica"]: r for r in view["replicas"]}
+    assert rows["old-r"]["version"] == "v1"
+    assert rows["new-r"]["version"] == "v2"
+    assert view["roles"]["both"]["versions"] == {"v1": 1, "v2": 1}
+    # same weights => the mixed fleet is token-transparent: every
+    # request lands somewhere and both versions answer identically
+    dep = Deployer({"both": None}, canary_max_new=6)
+    assert dep.probe(r1.url, "both") == dep.probe(r2.url, "both")
+    rng = np.random.RandomState(5)
+    for i in range(6):
+        prompt = [int(t) for t in rng.randint(0, VOCAB, (7,))]
+        res = router.generate(prompt, max_new_tokens=5,
+                              request_id=f"mix-{i}")
+        assert res.tokens
+
+
+def test_control_plane_env_knobs_documented():
+    with open(os.path.join(REPO, "docs", "env_vars.md")) as f:
+        text = f.read()
+    for var in ("MXTPU_AUTOSCALE_SPEC", "MXTPU_DEPLOY_CANARY_NEW",
+                "MXTPU_DEPLOY_PROBE_TIMEOUT"):
+        assert f"`{var}`" in text, var

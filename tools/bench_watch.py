@@ -385,6 +385,35 @@ def run_fleet_obs_stage(timeout=900):
         timeout)
 
 
+def run_fleet_autoscale_stage(timeout=900):
+    """Fleet control-plane artifact (tools/fleet_bench.py --workload
+    autoscale): the autoscaler must GROW the pool under a load step
+    and SHRINK it back after the idle window, then a rolling deploy
+    with a kill-armed canary must auto-roll back token-identically —
+    all with availability 1.0.  CPU-only like the other fleet stages
+    (replica subprocesses), so it runs ahead of the chip probe."""
+    def gate(p):
+        if not p.get("complete") or p.get("availability") != 1.0 \
+                or not p.get("scaled_up") or not p.get("scaled_down") \
+                or not p.get("rollback_token_identical"):
+            return (f"complete={p.get('complete')}, "
+                    f"availability={p.get('availability')}, "
+                    f"up={p.get('scaled_up')}, "
+                    f"down={p.get('scaled_down')}, "
+                    f"rollback_identical="
+                    f"{p.get('rollback_token_identical')}")
+        return None
+
+    return _run_fleet_artifact(
+        "fleet_autoscale", ["--workload", "autoscale"],
+        "AUTOSCALE_BENCH.json", gate,
+        lambda p: (f"peak={p.get('peak_replicas')} -> "
+                   f"settled={p.get('settled_replicas')}, "
+                   f"rollout={p.get('rollout', {}).get('status')}, "
+                   f"availability={p.get('availability')}"),
+        timeout)
+
+
 def run_bandwidth(timeout=1200):
     return run_json_artifact(
         "bandwidth",
@@ -763,7 +792,7 @@ def main():
     # record shows flash LOSING), the never-measured fused RNN — then
     # the headline benches, then the new r5 records, then the long tail
     done = {"lint": False, "fleet": False, "fleet_disagg": False,
-            "fleet_obs": False,
+            "fleet_obs": False, "fleet_autoscale": False,
             "consistency": False, "flash": False, "rnn": False,
             "resnet": False, "resnet256": False, "gpt": False,
             "longcontext": False, "bandwidth": False, "cifar": False,
@@ -838,6 +867,16 @@ def main():
             done["fleet_obs"] = attempt(
                 "fleet_obs",
                 lambda: run_fleet_obs_stage(timeout=min(900, left)))
+        # fleet control plane (autoscaler grow/shrink + SLO-gated
+        # deploy rollback): CPU-only replica subprocesses, probe-free
+        if not done["fleet_autoscale"]:
+            left = deadline - time.monotonic()
+            if left < 120:
+                continue
+            done["fleet_autoscale"] = attempt(
+                "fleet_autoscale",
+                lambda: run_fleet_autoscale_stage(
+                    timeout=min(900, left)))
         if not probe():
             log("TPU unreachable; retrying in 60s")
             time.sleep(60)

@@ -58,12 +58,28 @@ one replica, a tight ``total_p99_ms`` objective, responsive windows)
 pins that the burn-rate alert demonstrably FIRES and the flight dump
 lands on the offending replica.
 
+``--workload autoscale`` runs the fleet control-plane smoke instead
+(AUTOSCALE_BENCH.json, the bench_watch ``fleet_autoscale`` stage): a
+role="both" process pool under a live ``fleet.Autoscaler``
+(``MXTPU_AUTOSCALE_SPEC`` grammar via ``--autoscale-spec``) and
+``fleet.FleetCollector``.  Phase A steps the load up (open-loop burst
+past the pool's capacity) and the autoscaler must GROW the pool;
+phase B goes quiet and it must SHRINK back to the min bound after the
+idle window; phase C rolls a deploy whose new version is armed with a
+``kill@2`` fault spec — the canary dies mid-parity-probe and the
+``fleet.Deployer`` must auto-roll the fleet back to the old version,
+byte-identical on the canary set, while light load keeps flowing
+(availability 1.0 across every phase; the router retries around both
+the kill and the drains).
+
 Usage: python tools/fleet_bench.py [--json OUT] [--replicas 3]
            [--requests 24 --rate 8 --max-new 16 --kill-at 4]
        python tools/fleet_bench.py --disagg [--json OUT]
            [--decode-replicas 2 --decoders 4 --long-prompts 3]
        python tools/fleet_bench.py --obs [--json OUT]
            [--obs-replicas 2 --obs-requests 16]
+       python tools/fleet_bench.py --workload autoscale [--json OUT]
+           [--autoscale-spec 'both=2:4;up_queue=1.5;down_idle_s=4']
 """
 
 import argparse
@@ -82,7 +98,8 @@ sys.path.insert(0, REPO)
 # children pin cpu explicitly anyway (N processes cannot share a chip).
 os.environ.setdefault("MXTPU_PLATFORMS", "cpu")
 
-from mxnet_tpu.fleet import ProcessReplica, Router, Supervisor  # noqa: E402
+from mxnet_tpu.fleet import ProcessReplica, Router, Supervisor, \
+    probe_health  # noqa: E402
 from mxnet_tpu.fleet.supervisor import replica_command  # noqa: E402
 # one percentile definition for the whole tool suite: this payload's
 # p99 must mean the same thing as a trace_report p99 over the same data
@@ -522,6 +539,233 @@ def run_obs(args):
     return 0 if out["complete"] else 1
 
 
+def run_autoscale(args):
+    """The --workload autoscale control-plane smoke ->
+    AUTOSCALE_BENCH.json: step load up (autoscaler grows the pool),
+    go quiet (it shrinks to the min bound), then roll a deploy whose
+    kill-armed canary forces an automatic token-identical rollback
+    under light load."""
+    import tempfile
+
+    import numpy as np
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.fleet import (Autoscaler, Deployer, FleetCollector,
+                                 parse_autoscale_spec)
+
+    spec = parse_autoscale_spec(args.autoscale_spec)
+    lo, hi = spec["bounds"]["both"]
+    out = {"platform": "cpu", "mode": "autoscale",
+           "spec": args.autoscale_spec, "min_replicas": lo,
+           "max_replicas_bound": hi, "complete": False,
+           "scaled_up": False, "scaled_down": False,
+           "rollback_token_identical": False}
+
+    def flush():
+        if args.json:
+            tmp = args.json + ".wip"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(out) + "\n")
+            os.replace(tmp, args.json)
+
+    def make_spawn(version, seed, fault=None):
+        """A version-tagged spawn factory — the deploy arm passes a
+        second one as the 'new checkpoint' (same weights iff same
+        seed) with an optional fault spec armed on its replicas."""
+        def spawn(slot):
+            env = dict(os.environ)
+            env.pop("MXTPU_FAULT_SPEC", None)
+            # the parent's flight dir is for the CONTROL PLANE's
+            # actuation dumps; children must not write into the count
+            env.pop("MXTPU_FLIGHT_DIR", None)
+            if fault:
+                env["MXTPU_FAULT_SPEC"] = fault
+            handle = ProcessReplica(
+                replica_command(extra_args=[
+                    "--backend", "cpu", "--seed", str(seed),
+                    "--vocab", str(args.vocab), "--warmup", "full",
+                    "--version", version]),
+                env=env)
+            handle.wait_ready(timeout_s=240)
+            return handle
+        return spawn
+
+    telemetry.enable()              # the parent hosts the control
+    # plane, so its registry carries the scale/deploy counters
+    router = Router([], scrape_interval_s=0.25, timeout_s=60.0,
+                    retries=4, backoff_s=0.05, backoff_max_s=0.5,
+                    breaker_fails=5, breaker_reset_s=2.0)
+    col = FleetCollector(urls=[], interval_s=0.3, port=0, slo_spec="")
+    sup = Supervisor(make_spawn("v1", args.seed), lo, router=router,
+                     restart_backoff_s=0.2, collector=col)
+    col.router = router
+    scaler = Autoscaler(col, sup, spec=args.autoscale_spec,
+                        interval_s=0.5)
+    deployer = Deployer(sup, collector=col)
+    rng = np.random.RandomState(args.seed)
+    t_start = time.perf_counter()
+    tdir = tempfile.TemporaryDirectory(prefix="mxtpu-autoscale-")
+    flight_dir = os.path.join(tdir.name, "flight")
+    os.environ["MXTPU_FLIGHT_DIR"] = flight_dir
+    try:
+        sup.start()
+        out["fleet_ready_s"] = round(time.perf_counter() - t_start, 3)
+        router.scrape()
+        router.start()
+        sup.run(interval_s=0.25)
+        col.scrape()
+        col.start()
+        scaler.start()
+        flush()
+
+        # -- phase A: step load up -> the pool must GROW ------------------
+        workload = build_workload(rng, argparse.Namespace(
+            prompt_lens=args.prompt_lens, vocab=args.vocab,
+            requests=args.scale_requests))
+        hi_results, hi_failures = {}, {}
+        burst_done = threading.Event()
+
+        def burst():
+            res, fail = run_load(
+                router, workload, args.scale_rate, args.max_new,
+                np.random.RandomState(args.seed + 3), "burst")
+            hi_results.update(res)
+            hi_failures.update(fail)
+            burst_done.set()
+
+        threading.Thread(target=burst, daemon=True).start()
+        peak = sup.pool_size()
+        deadline = time.monotonic() + 180
+        grace_end = None            # set when the burst finishes
+        while time.monotonic() < deadline:
+            peak = max(peak, sup.pool_size())
+            if burst_done.is_set():
+                if peak > lo:
+                    break
+                if grace_end is None:
+                    # the burst drained before a scale-up landed: give
+                    # the (slow, spawn-bound) actuation a beat to show
+                    grace_end = time.monotonic() + 20
+                elif time.monotonic() > grace_end:
+                    break
+            time.sleep(0.1)
+        burst_done.wait(timeout=300)
+        peak = max(peak, sup.pool_size())
+        out["peak_replicas"] = peak
+        out["scaled_up"] = peak > lo
+        out["burst_submitted"] = len(workload)
+        out["burst_completed"] = len(hi_results)
+        out["burst_failures"] = dict(list(hi_failures.items())[:5])
+        flush()
+
+        # -- phase B: quiet -> the pool must SHRINK to the min bound ------
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and sup.pool_size() > lo:
+            time.sleep(0.2)
+        out["settled_replicas"] = sup.pool_size()
+        out["scaled_down"] = (out["scaled_up"]
+                              and sup.pool_size() == lo)
+        scaler.stop()               # the deploy phase owns the pool now
+        snap = telemetry.registry().snapshot().get(
+            "mxtpu_fleet_scale_events_total") or {}
+        out["scale_events"] = [
+            {"labels": s["labels"], "value": s["value"]}
+            for s in snap.get("samples", [])]
+        flush()
+
+        # -- phase C: rolling deploy, canary killed mid-probe -------------
+        ref_url = None
+        for slot in sup.active_slots():
+            h = sup.handles()[slot]
+            if h is not None and h.url:
+                ref_url = h.url
+                break
+        ref = deployer.probe(ref_url, "both")
+        light = build_workload(rng, argparse.Namespace(
+            prompt_lens=args.prompt_lens, vocab=args.vocab,
+            requests=args.rollout_requests))
+        lo_results, lo_failures = {}, {}
+        light_done = threading.Event()
+
+        def light_load():
+            res, fail = run_load(
+                router, light, args.rollout_rate, args.max_new,
+                np.random.RandomState(args.seed + 5), "deploy")
+            lo_results.update(res)
+            lo_failures.update(fail)
+            light_done.set()
+
+        threading.Thread(target=light_load, daemon=True).start()
+        time.sleep(0.3)             # the rollout lands MID-load
+        # the "new checkpoint" is a different seed (parity must fail
+        # even if a routed request burns the kill arrival first) armed
+        # to die on its 2nd /generate — the canary probe or a routed
+        # request kills it mid-rollout either way
+        report = deployer.rollout(
+            make_spawn("v2", args.seed + 1, fault="kill@2"),
+            version="v2")
+        light_done.wait(timeout=300)
+        out["rollout"] = {k: report[k] for k in
+                          ("status", "reason", "replaced",
+                           "rolled_back")}
+        out["deploy_submitted"] = len(light)
+        out["deploy_completed"] = len(lo_results)
+        out["restart_rejects"] = len(lo_failures)
+        out["deploy_failures"] = dict(list(lo_failures.items())[:5])
+
+        # the rollback must have restored the OLD weights everywhere:
+        # every surviving replica re-serves the canary byte-identically
+        identical = report["status"] == "rolled_back"
+        versions = set()
+        for slot in sup.active_slots():
+            h = sup.handles()[slot]
+            if h is None or not h.url:
+                identical = False
+                continue
+            try:
+                identical = identical and deployer.probe(
+                    h.url, "both") == ref
+            except (OSError, ValueError):
+                identical = False
+            hz = probe_health(h.url)
+            versions.add((hz or {}).get("version"))
+        out["rollback_token_identical"] = bool(identical)
+        out["post_rollback_versions"] = sorted(
+            v for v in versions if v)
+        out["crash_restarts"] = int(sum(sup._restarts))
+        out["flight_dumps"] = len(
+            [f for f in (os.listdir(flight_dir)
+                         if os.path.isdir(flight_dir) else [])
+             if f.startswith("flight-")])
+        out["annotations"] = [
+            {"kind": a["kind"],
+             **{k: a[k] for k in ("role", "direction", "reason",
+                                  "status", "phase") if k in a}}
+            for a in col.fleet_view().get("annotations", ())
+            if a["kind"].startswith(("autoscale", "deploy",
+                                     "scale_"))][-40:]
+        submitted = len(workload) + len(light)
+        completed = len(hi_results) + len(lo_results)
+        out["availability"] = round(completed / max(1, submitted), 4)
+        out["complete"] = bool(
+            out["availability"] == 1.0
+            and not hi_failures and not lo_failures
+            and out["scaled_up"] and out["scaled_down"]
+            and report["status"] == "rolled_back"
+            and out["rollback_token_identical"]
+            and out["post_rollback_versions"] == ["v1"])
+    finally:
+        os.environ.pop("MXTPU_FLIGHT_DIR", None)
+        scaler.stop()
+        col.stop()
+        router.stop()
+        sup.stop()
+        tdir.cleanup()
+    flush()
+    print(json.dumps(out))
+    return 0 if out["complete"] else 1
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--replicas", type=int, default=3)
@@ -591,12 +835,32 @@ def main():
     p.add_argument("--obs-overhead-floor", type=float, default=0.75,
                    help="min tok/s ratio (collector-on / off) the "
                         "contract accepts — CPU smoke noise is large")
+    # -- fleet control plane smoke (AUTOSCALE_BENCH.json) --------------
+    p.add_argument("--workload", default=None, choices=["autoscale"],
+                   help="'autoscale' runs the control-plane smoke "
+                        "(autoscaler grow/shrink + kill-armed deploy "
+                        "rollback) instead")
+    p.add_argument("--autoscale-spec",
+                   default="both=2:4;up_queue=1.5;down_idle_s=4;"
+                           "cooldown_s=2",
+                   help="the MXTPU_AUTOSCALE_SPEC grammar driving the "
+                        "arm's Autoscaler (bounds + thresholds)")
+    p.add_argument("--scale-requests", type=int, default=32,
+                   help="burst requests of the step-up phase")
+    p.add_argument("--scale-rate", type=float, default=24.0,
+                   help="burst arrival rate — past the min pool's "
+                        "capacity so queue pressure builds")
+    p.add_argument("--rollout-requests", type=int, default=8,
+                   help="light-load requests riding the deploy phase")
+    p.add_argument("--rollout-rate", type=float, default=2.0)
     args = p.parse_args()
 
     if args.disagg:
         return run_disagg(args)
     if args.obs:
         return run_obs(args)
+    if args.workload == "autoscale":
+        return run_autoscale(args)
 
     import numpy as np
 

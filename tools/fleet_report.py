@@ -72,12 +72,14 @@ def render(view):
     lines.append("")
 
     lines.append(f"{'REPLICA':<24} {'ROLE':<8} {'STATE':<9} "
+                 f"{'VERSION':<14} "
                  f"{'STALE':>5} {'FAILS':>5} {'QUEUE':>5} {'RUN':>4} "
                  f"{'TOK/S':>8} {'TTFT_P99':>9} {'TPOT_P99':>9}")
     for r in view.get("replicas") or []:
         lines.append(
             f"{str(r.get('replica'))[:24]:<24} "
             f"{str(r.get('role')):<8} {str(r.get('state'))[:9]:<9} "
+            f"{str(r.get('version') or '-')[:14]:<14} "
             f"{_fmt(r.get('stale')):>5} "
             f"{r.get('total_failures', 0):>5} "
             f"{r.get('queue_depth', 0):>5} {r.get('running', 0):>4} "

@@ -81,6 +81,12 @@ def main():
     p.add_argument("--kv-heads", type=int, default=None)
     p.add_argument("--vocab", type=int, default=512)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--version", default=None,
+                   help="deploy identity tag surfaced on /healthz, "
+                        "/statusz.json and the ready line (default: "
+                        "a short digest of the model config + seed — "
+                        "the synthetic-checkpoint equivalent of a "
+                        "checkpoint digest)")
     # engine config
     p.add_argument("--block-size", type=int, default=8)
     p.add_argument("--num-blocks", type=int, default=128)
@@ -143,9 +149,19 @@ def main():
     elif args.warmup == "auto" and os.environ.get("MXTPU_WARMUP_MANIFEST"):
         warmed = engine.warmup()
 
+    version = args.version
+    if version is None:
+        # weights here are a pure function of the model flags + seed,
+        # so their digest is: same version tag <=> identical weights
+        import hashlib
+        cfg = (f"{args.layers}/{args.d_model}/{args.heads}/"
+               f"{args.kv_heads}/{args.vocab}/{args.max_model_len}/"
+               f"{args.seed}")
+        version = "cfg-" + hashlib.sha1(cfg.encode()).hexdigest()[:10]
+
     replica = mx.fleet.ReplicaServer(
         engine, host=args.host, port=args.port,
-        replica_id=args.replica_id, role=role,
+        replica_id=args.replica_id, role=role, version=version,
         on_kill=lambda: os._exit(1))       # a kill fault is a real death
     replica.start()
 
@@ -160,6 +176,7 @@ def main():
         "ready": True, "port": replica.port, "host": args.host,
         "pid": os.getpid(), "replica_id": replica.replica_id,
         "role": replica.role,
+        "version": replica.version,
         "backend": jax.default_backend(),
         "ready_s": round(time.perf_counter() - t0, 3),
         "warmed": warmed,
