@@ -333,6 +333,12 @@ class FleetCollector:
         for k, v in (sec.get("handoff") or {}).items():
             if isinstance(v, (int, float)):
                 values[f"handoff_{k}"] = v
+        # performance-attribution summary (replicas predating it, or
+        # running MXTPU_PERF_ATTRIB=0, ship no section — None-skipped
+        # like every other absent field)
+        for k, v in (sec.get("perf") or {}).items():
+            if isinstance(v, (int, float)):
+                values[f"perf_{k}"] = v
         return values
 
     def is_stale(self, view, now=None):
@@ -453,7 +459,9 @@ class FleetCollector:
                          now=now)
         if rate is not None:
             row["tok_per_sec"] = round(rate, 3)
-        for f in ("ttft_ms_p99", "tpot_ms_p99"):
+        for f in ("ttft_ms_p99", "tpot_ms_p99", "perf_mfu",
+                  "perf_achieved_tflops", "perf_tok_flops",
+                  "perf_cost_per_1k_tokens_s", "perf_sampled"):
             v = ring.latest(f)
             if v is not None:
                 row[f] = v
@@ -477,8 +485,9 @@ class FleetCollector:
                 "replicas": 0, "stale": 0, "queue_depth": 0,
                 "running": 0, "waiting_handoffs": 0,
                 "tokens_generated": 0, "completed": 0, "rejected": 0,
-                "tok_per_sec": 0.0, "_kv": [], "_hkv": [],
-                "_ttft": [], "_tpot": [],
+                "tok_per_sec": 0.0, "achieved_tflops": 0.0,
+                "_kv": [], "_hkv": [],
+                "_ttft": [], "_tpot": [], "_mfu": [],
                 "tenant_goodput": {}, "versions": {}})
             agg["replicas"] += 1
             if row["stale"]:
@@ -501,6 +510,14 @@ class FleetCollector:
                 agg["_ttft"].append(row["ttft_ms_p99"])
             if row.get("tpot_ms_p99") is not None:
                 agg["_tpot"].append(row["tpot_ms_p99"])
+            # role-keyed goodput: MFU averages over the role's fresh
+            # replicas, achieved TFLOP/s sums to the role's delivered
+            # compute rate (both absent until a replica has sampled)
+            if row.get("perf_mfu") is not None:
+                agg["_mfu"].append(row["perf_mfu"])
+            agg["achieved_tflops"] = round(
+                agg["achieved_tflops"]
+                + (row.get("perf_achieved_tflops") or 0.0), 6)
             view = by_url[row["url"]]
             for key in view.ring.names():
                 if key.startswith("tenant_completed{tenant="):
@@ -514,6 +531,7 @@ class FleetCollector:
             ttfts, tpots = agg.pop("_ttft"), agg.pop("_tpot")
             agg["ttft_ms_p99_max"] = max(ttfts) if ttfts else None
             agg["tpot_ms_p99_max"] = max(tpots) if tpots else None
+            agg["mfu_mean"] = _mean(agg.pop("_mfu"))
         totals = {"replicas": len(rows),
                   "stale": sum(1 for r in rows if r["stale"])}
         for f in ("queue_depth", "running", "waiting_handoffs",
@@ -581,7 +599,11 @@ class FleetCollector:
                     ("rejected", agg["rejected"]),
                     ("tok_per_sec", agg["tok_per_sec"]),
                     ("replicas", agg["replicas"]),
-                    ("stale", agg["stale"])):
+                    ("stale", agg["stale"]),
+                    ("achieved_tflops", agg["achieved_tflops"]),
+                    ("mfu_mean", agg["mfu_mean"])):
+                if value is None:     # no replica has sampled yet
+                    continue
                 telemetry.gauge(
                     f"mxtpu_fleet_agg_{field}",
                     f"fleet-aggregated {field} by role",

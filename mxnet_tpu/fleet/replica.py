@@ -853,6 +853,12 @@ class ReplicaServer:
                 # host-DRAM KV tier occupancy (None with the tier off)
                 "host_kv_utilization": (hk["utilization"]
                                         if hk is not None else None),
+                # per-program performance attribution (None with
+                # MXTPU_PERF_ATTRIB=0, or on engines predating it):
+                # the collector flattens this into role-keyed
+                # MFU/goodput aggregates on /fleetz
+                "perf": (eng.perf_summary()
+                         if hasattr(eng, "perf_summary") else None),
                 "faults_fired": len(self.faults.fired)}
 
     def statusz_snapshot(self):

@@ -20,7 +20,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["count_flops", "peak_flops_per_chip"]
+__all__ = ["count_flops", "peak_flops_per_chip", "peak_hbm_bytes_per_chip",
+           "gpt_token_flops", "gpt_prefill_flops"]
 
 
 def _prod(t):
@@ -85,6 +86,59 @@ def count_flops(symbol, **input_shapes) -> int:
     return int(total)
 
 
+def gpt_token_flops(n_layers, d_model, num_heads, head_dim, kv_heads,
+                    vocab, context, d_ff=None, swiglu=False):
+    """Analytic forward FLOPs for ONE token of a normalized ``gpt()``
+    checkpoint attending over ``context`` cached positions (GQA-aware).
+
+    Counts the matmul-dominant terms only — QKV/out projections, the
+    per-head score and weighted-sum dots against the KV cache, the MLP
+    (gate included under ``swiglu``), and the LM head — matching the
+    :func:`count_flops` convention (1 MAC = 2 FLOPs, elementwise free).
+    This is the per-token MFU denominator for serve-side attribution
+    when a backend has no ``cost_analysis()``; the serve programs pad
+    to bucket shapes, so pass the PADDED context (table capacity), not
+    the live sequence length, to match compiled-program cost.
+    """
+    d_attn = num_heads * head_dim
+    d_kv = kv_heads * head_dim
+    d_ff = int(d_ff) if d_ff else 4 * d_model
+    per_layer = 2 * d_model * d_attn          # Q projection
+    per_layer += 2 * 2 * d_model * d_kv       # K + V projections (GQA)
+    per_layer += 2 * d_attn * d_model         # output projection
+    # scores (q . k) and weighted sum (p . v), 2 FLOPs/MAC each, over
+    # the full padded context
+    per_layer += 4 * num_heads * head_dim * int(context)
+    mlp = 2 * d_model * d_ff + 2 * d_ff * d_model      # up + down
+    if swiglu:
+        mlp += 2 * d_model * d_ff                      # gate
+    per_layer += mlp
+    return int(n_layers) * per_layer + 2 * d_model * int(vocab)
+
+
+def gpt_prefill_flops(n_layers, d_model, num_heads, head_dim, kv_heads,
+                      vocab, seq_len, d_ff=None, swiglu=False,
+                      logits_positions=None):
+    """Analytic forward FLOPs for a dense ``seq_len``-token prefill of a
+    normalized ``gpt()`` checkpoint.
+
+    The serve prefill/chunk programs materialize the full (masked)
+    TxT score matrix, so attention costs ``context = seq_len`` per
+    position — not the triangle — which is what ``cost_analysis()``
+    reports for the compiled program.  ``logits_positions`` bounds the
+    LM-head term (1 for last-position-only programs; defaults to all
+    positions).
+    """
+    T = int(seq_len)
+    per_tok = gpt_token_flops(n_layers, d_model, num_heads, head_dim,
+                              kv_heads, vocab, context=T, d_ff=d_ff,
+                              swiglu=swiglu)
+    head = 2 * d_model * int(vocab)
+    total = T * (per_tok - head)
+    n_logits = T if logits_positions is None else int(logits_positions)
+    return total + n_logits * head
+
+
 # bf16 peak FLOP/s per chip by device_kind substring (public figures)
 _PEAKS = [
     ("v6e", 918e12), ("v6", 918e12),
@@ -104,6 +158,33 @@ def peak_flops_per_chip(device=None):
     if d.platform != "tpu":
         return None
     for tag, peak in _PEAKS:
+        if tag in kind:
+            return peak
+    return None
+
+
+# peak HBM bandwidth (bytes/s) per chip by device_kind substring
+# (public figures) — the MBU denominator
+_HBM_PEAKS = [
+    ("v6e", 1640e9), ("v6", 1640e9),
+    ("v5p", 2765e9), ("v5 lite", 819e9), ("v5e", 819e9), ("v5", 2765e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+]
+
+
+def peak_hbm_bytes_per_chip(device=None):
+    """Peak HBM bandwidth (bytes/s) for the local accelerator, or None
+    if unknown — memory-bandwidth-utilization's denominator, the
+    figure decode (bandwidth-bound) is judged against."""
+    import jax
+
+    d = device or jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    if d.platform != "tpu":
+        return None
+    for tag, peak in _HBM_PEAKS:
         if tag in kind:
             return peak
     return None

@@ -135,12 +135,27 @@ class ServeMonitor:
         if getattr(s, "spec_verifies", 0):
             spec = (f" spec={s.spec_accept_rate:.2f}"
                     f"/{s.accepted_per_verify:.2f}")
+        # performance-attribution tail (the same only-once-measured
+        # rule as the spec tail): appears only after a sampled timing
+        # exists (MXTPU_PERF_ATTRIB_SAMPLE>0 and a sampled step ran),
+        # so plain lines stay byte-identical to the pre-attribution
+        # format — and engines without perf_summary (fakes, older
+        # duck-typed drivers) log exactly as before
+        perf = ""
+        summary = getattr(self.engine, "perf_summary", None)
+        p = summary() if callable(summary) else None
+        if p and p.get("sampled"):
+            mfu = p.get("mfu")
+            mfu_s = "-" if mfu is None else f"{100.0 * mfu:.1f}%"
+            tf = p.get("tok_flops")
+            tf_s = "-" if tf is None else f"{tf / 1e6:.2f}M"
+            perf = f" mfu={mfu_s} tok_flops={tf_s}"
         self.logger.info(
             "Serve: step %7d queue=%d running=%d done=%d rej=%d[%s] "
-            "preempt=%d blocks=%d/%d (%.0f%%) ttft_ms=%s tok/s=%s%s",
+            "preempt=%d blocks=%d/%d (%.0f%%) ttft_ms=%s tok/s=%s%s%s",
             s.steps, s.queue_depth, s.running, s.completed, s.rejected,
             self._fmt_reasons(getattr(s, "reject_reasons", None)),
             s.preemptions, s.blocks_in_use, s.blocks_total,
             100.0 * s.block_utilization, self._fmt(s.ttft_ms_mean),
-            self._fmt(rate), spec)
+            self._fmt(rate), spec, perf)
         return s

@@ -68,6 +68,7 @@ from ..parallel.mesh import NamedSharding, PartitionSpec, make_mesh
 from ..ops.attention import paged_attention
 from ..telemetry import flight as flight_mod
 from ..telemetry import statusz as statusz_mod
+from ..telemetry.perf_attrib import PerfAttrib
 from ..telemetry.request_trace import RequestTracer
 from .kv_block_manager import BlockManager, HostKVPool
 from .scheduler import (CANCELLED, FINISHED, REJECTED, WAITING, QueueFull,
@@ -631,6 +632,16 @@ class Engine:
         self._warming = False
         self._alive = True
         self._noop_steps = 0
+        # per-program performance attribution (telemetry/perf_attrib):
+        # cost table fills at program-resolve cadence (default on),
+        # sampled device timing rides the step cadence behind
+        # MXTPU_PERF_ATTRIB_SAMPLE.  Constructed here — after
+        # telemetry.enable() in the usual ordering — because it caches
+        # its metric handles at construction (the handle-caching
+        # asymmetry), and NEVER enters _spec_key/_aot_base_fp: both
+        # knobs in any combination leave tokens, program cache keys
+        # and AOT fingerprints byte-identical
+        self._perf = PerfAttrib()
         # live-state gauges stamped once per step (no-op when telemetry
         # is disabled); cumulative serve counters live in StatsRecorder
         self._tel_queue = telemetry.gauge(
@@ -907,6 +918,10 @@ class Engine:
     @hot_path
     def _step_inner(self):
         self._step_id += 1
+        # arm (or not) this step's dispatch timing — with sampling off
+        # (the default) every t0() below returns None and no dispatch
+        # gains a sync
+        self._perf.arm(self._step_id)
         with telemetry.span("serve.step"):
             self._release_fanout()
             prefills, decodes = self.scheduler.schedule()
@@ -959,6 +974,7 @@ class Engine:
             else:
                 self._noop_steps = 0
             self._stats.on_step(emitted, decode_batch=len(decodes))
+            self._perf.on_step(emitted)
             if self._spec is not None:
                 # bound the draft ingest ledger by the LIVE running
                 # set: a request that leaves the engine between decodes
@@ -1094,6 +1110,10 @@ class Engine:
             # (None with spec off)
             "spec": (None if self._spec is None
                      else self._spec.statusz(self)),
+            # per-program cost/timing attribution: cost table always
+            # (default-on), device-time columns once sampling has run
+            # (None with MXTPU_PERF_ATTRIB=0 — the inert default rule)
+            "perf": self._perf.statusz(),
             "max_batch": self.max_batch,
             "max_model_len": self.max_model_len,
             "programs_recorded": len(self._manifest.entries()),
@@ -1105,6 +1125,13 @@ class Engine:
             "numeric_watch": self._numeric_watch,
             "aot": aot,
         }
+
+    def perf_summary(self):
+        """Compact performance-attribution summary — sampled dispatch
+        count, MFU/achieved-TFLOP/s, flops-per-token and device cost
+        per 1k tokens (None with ``MXTPU_PERF_ATTRIB=0``).  The
+        ServeMonitor tail and the fleet replica scrape row read this."""
+        return self._perf.summary()
 
     def sampling_info(self):
         """The ``/statusz`` ``sampling`` section: cap, engine defaults
@@ -1414,7 +1441,10 @@ class Engine:
                 args += (jnp.asarray(hks), jnp.asarray(hvs))
             with telemetry.span("serve.host_kv_restore",
                                 blocks=len(batch)):
-                self._set_caches(self._program("restore", bucket)(*args))
+                t0 = self._perf.t0()
+                outs = self._program("restore", bucket)(*args)
+                self._perf.done(t0, "restore", bucket, outs)
+                self._set_caches(outs)
 
     def _slots(self, table, n, pad_to):
         """(block, offset) scatter targets for logical slots [0, n),
@@ -1458,6 +1488,7 @@ class Engine:
             toks = np.zeros(bucket, np.int32)
             toks[:n] = ids
             blk, off = self._slots(self.blocks.table(req.rid), n, bucket)
+            pkind = "prefill"
             fn = self._prefill_fn(bucket)
             args = (self.params,) + self._cache_args() + (
                     jnp.asarray(toks), jnp.asarray(n, jnp.int32),
@@ -1479,13 +1510,16 @@ class Engine:
             blk[:span] = tw[pos // self.block_size]
             off = ((start + np.arange(bucket))
                    % self.block_size).astype(np.int32)
+            pkind = "chunk"
             fn = self._chunk_fn(bucket)
             args = (self.params,) + self._cache_args() + (
                     jnp.asarray(toks), jnp.asarray(start, jnp.int32),
                     jnp.asarray(span, jnp.int32), jnp.asarray(tw),
                     jnp.asarray(blk), jnp.asarray(off)) \
                 + self._req_sampling_operands(req) + (sub,)
+        t0 = self._perf.t0()
         outs = fn(*args)
+        self._perf.done(t0, pkind, bucket, outs)
         lead = self._unpack_outs(outs, 4 if self._sampling else 1,
                                  "prefill_logits", rid=req.rid)
         tok = lead[0]
@@ -1535,10 +1569,12 @@ class Engine:
             tables[i, :len(t)] = t
         fn = self._decode_fn(bucket)
         self._key, sub = jax.random.split(self._key)
+        t0 = self._perf.t0()
         outs = fn(self.params, *self._cache_args(),
                   jnp.asarray(toks), jnp.asarray(pos),
                   jnp.asarray(tables),
                   *self._batch_sampling_operands(reqs, bucket), sub)
+        self._perf.done(t0, "decode", bucket, outs)
         lead = self._unpack_outs(outs, 4 if self._sampling else 1,
                                  "decode_logits", batch_size=B,
                                  rids=[r.rid for r in reqs])
@@ -1585,12 +1621,14 @@ class Engine:
                             tokens=span):
             # the chunk program built over the DRAFT config: same
             # write-then-attend body, draft params and draft caches
-            _, sw.cache_k, sw.cache_v = self._program(
-                "draft_chunk", bucket)(
-                    sw.params, sw.cache_k, sw.cache_v,
-                    jnp.asarray(toks), jnp.asarray(0, jnp.int32),
-                    jnp.asarray(span, jnp.int32), jnp.asarray(tw),
-                    jnp.asarray(blk), jnp.asarray(off), sub)
+            t0 = self._perf.t0()
+            outs = self._program("draft_chunk", bucket)(
+                sw.params, sw.cache_k, sw.cache_v,
+                jnp.asarray(toks), jnp.asarray(0, jnp.int32),
+                jnp.asarray(span, jnp.int32), jnp.asarray(tw),
+                jnp.asarray(blk), jnp.asarray(off), sub)
+            self._perf.done(t0, "draft_chunk", bucket, outs)
+            _, sw.cache_k, sw.cache_v = outs
         sw.note_ingested(req, span)
 
     @hot_path
@@ -1630,19 +1668,24 @@ class Engine:
         if self._sampling:
             samp = self._batch_sampling_operands(reqs, bucket)
             with telemetry.span("serve.draft", batch=B, k=k):
+                t0 = self._perf.t0()
+                douts = self._draft_fn(bucket)(
+                    sw.params, sw.cache_k, sw.cache_v,
+                    jnp.asarray(toks), jp, jtab, *samp, sub)
+                self._perf.done(t0, "draft", bucket, douts)
                 drafted, q_at, q_vals, q_idx, sw.cache_k, sw.cache_v = \
-                    self._draft_fn(bucket)(
-                        sw.params, sw.cache_k, sw.cache_v,
-                        jnp.asarray(toks), jp, jtab, *samp, sub)
+                    douts
             # drafted ids and their candidate-space q views stay ON
             # DEVICE: acceptance runs inside the verify program, so
             # the only host sync this iteration is the emitted rows
             fn = self._verify_fn(bucket)
             self._key, sub = jax.random.split(self._key)
             with telemetry.span("serve.verify", batch=B, k=k):
+                t0 = self._perf.t0()
                 outs = fn(self.params, *self._cache_args(),
                           jnp.asarray(toks), drafted, q_at, q_vals,
                           q_idx, jp, jtab, *samp, sub)
+                self._perf.done(t0, "verify", bucket, outs)
                 emit_rows, acc, lp, tv, ti = self._unpack_outs(
                     outs, 5, "verify_logits", batch_size=B,
                     rids=[r.rid for r in reqs])
@@ -1678,9 +1721,12 @@ class Engine:
                     self.blocks.truncate(req.rid, req.cache_len)
             return emitted
         with telemetry.span("serve.draft", batch=B, k=k):
-            drafted, sw.cache_k, sw.cache_v = self._draft_fn(bucket)(
+            t0 = self._perf.t0()
+            douts = self._draft_fn(bucket)(
                 sw.params, sw.cache_k, sw.cache_v, jnp.asarray(toks),
                 jp, jtab, sub)
+            self._perf.done(t0, "draft", bucket, douts)
+            drafted, sw.cache_k, sw.cache_v = douts
             # mxtpu-lint: disable=host-sync (designed sync point: the
             # drafted ids feed the verify dispatch's host-built rows)
             drafted = np.asarray(drafted)
@@ -1690,8 +1736,10 @@ class Engine:
         fn = self._verify_fn(bucket)
         self._key, sub = jax.random.split(self._key)
         with telemetry.span("serve.verify", batch=B, k=k):
+            t0 = self._perf.t0()
             outs = fn(self.params, *self._cache_args(),
                       jnp.asarray(rows), jp, jtab, sub)
+            self._perf.done(t0, "verify", bucket, outs)
             if self._cfg.numeric_watch:
                 out, ok = outs[0], outs[1]
                 self._set_caches(outs[2:])
@@ -1915,7 +1963,61 @@ class Engine:
             _STEP_CACHE[key] = fn
         if not self._warming:
             self._manifest.record(kind, bucket)
+        if self._perf.enabled and self._perf.cost(kind, bucket) is None:
+            # cost-table capture sits HERE — the one chokepoint all
+            # three resolve paths share (fresh trace, warm AOT load,
+            # and a process-local _STEP_CACHE hit from a twin engine),
+            # so a warm-started engine never reports an empty perf
+            # section.  Idempotent per (kind, bucket): after the first
+            # capture this is one dict probe per dispatch.
+            af, ab = self._analytic_cost(kind, bucket)
+            self._perf.note_cost(kind, bucket, fn,
+                                 fallback_flops=af, fallback_bytes=ab)
         return fn
+
+    def _analytic_cost(self, kind, bucket):
+        """Analytic (flops, bytes) estimate for one (kind, bucket)
+        dispatch, from the GQA-aware closed forms in ``flops.py`` over
+        the PADDED program shapes (bucket rows, table-capacity
+        context).  The cost-table fallback when a backend exposes no
+        ``cost_analysis()``, and the cross-check pinned against it in
+        tests/test_perf_contract.py."""
+        from .. import flops as flops_mod
+
+        if kind in ("draft", "draft_chunk") and self._spec is not None:
+            cfg, params = self._spec.cfg, self._spec.params
+        else:
+            cfg, params = self._cfg, self.params
+        try:
+            tok_w = params[f"{cfg.name}_tok_embed_weight"]
+            ffw = params.get(f"{cfg.name}_l0_ff_up_weight")
+            kw = dict(n_layers=cfg.n_layers,
+                      d_model=int(tok_w.shape[1]),
+                      num_heads=cfg.num_heads, head_dim=cfg.head_dim,
+                      kv_heads=cfg.kv_heads, vocab=int(tok_w.shape[0]),
+                      d_ff=int(ffw.shape[0]) if ffw is not None else None,
+                      swiglu=cfg.swiglu)
+        except Exception:
+            return None, None          # params already freed (shutdown)
+        ctx = self.table_width * self.block_size
+        per_tok = flops_mod.gpt_token_flops(context=ctx, **kw)
+        if kind == "prefill":
+            return flops_mod.gpt_prefill_flops(seq_len=bucket, **kw), None
+        if kind in ("chunk", "draft_chunk"):
+            return bucket * per_tok, None
+        if kind == "verify":
+            return bucket * (self.spec_k + 1) * per_tok, None
+        if kind == "draft":
+            return bucket * self.spec_k * per_tok, None
+        if kind == "restore":
+            # pure copy program: no matmuls — bytes are the K+V block
+            # payload in and out (the MBU numerator)
+            L, bs = self._cfg.n_layers, self.block_size
+            Hkv, Dh = self._cfg.kv_heads, self._cfg.head_dim
+            payload = (2 * L * bucket * bs * Hkv * Dh
+                       * self._cache_k.dtype.itemsize)
+            return None, 2 * payload
+        return bucket * per_tok, None      # decode
 
     def _program_specs(self, kind, bucket):
         """ShapeDtypeStructs matching exactly what _run_prefill /
@@ -2023,6 +2125,42 @@ class Engine:
                 sds((bucket,), i32), sds((bucket,), i32)) \
             + samp((1,)) + (kspec,)
 
+    def _program_builder(self, kind, bucket):
+        """The freshly-traced jitted program for (kind, bucket) — the
+        switch over program families, shared by ``_resolve_program``
+        and ``tools/hlo_audit.py``'s serve lowering (which audits the
+        exact builders traffic runs, not a reconstruction).  The
+        builders close over immutable ``_ModelCfg``s only — never an
+        Engine (the _STEP_CACHE retention rule)."""
+        if kind == "decode":
+            return _build_decode(self._cfg, self._donate,
+                                 self._shardings)
+        if kind == "chunk":
+            return _build_chunk(self._cfg, bucket, self._donate,
+                                self._shardings)
+        if kind == "verify":
+            return spec_mod._build_verify(self._cfg, self.spec_k,
+                                          self._donate,
+                                          self._shardings)
+        if kind == "draft":
+            # sampling engines draft by SAMPLING from the warped
+            # distribution (sample_cfg carries the target cfg's
+            # cap/operand layout); greedy engines keep the
+            # historical argmax draft program byte-for-byte
+            return spec_mod._build_draft(
+                self._spec.cfg, self.spec_k, self._donate,
+                self._draft_shardings,
+                sample_cfg=(self._cfg if self._cfg.sampling
+                            else None))
+        if kind == "draft_chunk":
+            return _build_chunk(self._spec.cfg, bucket, self._donate,
+                                self._draft_shardings)
+        if kind == "restore":
+            return _build_restore(self._cfg, self._donate,
+                                  self._shardings)
+        return _build_prefill(self._cfg, bucket, self._donate,
+                              self._shardings)
+
     def _resolve_program(self, kind, bucket):
         """One bucket program: AOT-load it from the export store, or
         trace it fresh (and write it through for the next restart).
@@ -2040,34 +2178,7 @@ class Engine:
             telemetry.counter(
                 "mxtpu_aot_programs_total", "bucket-program resolutions",
                 ("kind", "source")).labels(kind=kind, source="trace").inc()
-            if kind == "decode":
-                return _build_decode(self._cfg, self._donate,
-                                     self._shardings)
-            if kind == "chunk":
-                return _build_chunk(self._cfg, bucket, self._donate,
-                                    self._shardings)
-            if kind == "verify":
-                return spec_mod._build_verify(self._cfg, self.spec_k,
-                                              self._donate,
-                                              self._shardings)
-            if kind == "draft":
-                # sampling engines draft by SAMPLING from the warped
-                # distribution (sample_cfg carries the target cfg's
-                # cap/operand layout); greedy engines keep the
-                # historical argmax draft program byte-for-byte
-                return spec_mod._build_draft(
-                    self._spec.cfg, self.spec_k, self._donate,
-                    self._draft_shardings,
-                    sample_cfg=(self._cfg if self._cfg.sampling
-                                else None))
-            if kind == "draft_chunk":
-                return _build_chunk(self._spec.cfg, bucket, self._donate,
-                                    self._draft_shardings)
-            if kind == "restore":
-                return _build_restore(self._cfg, self._donate,
-                                      self._shardings)
-            return _build_prefill(self._cfg, bucket, self._donate,
-                                  self._shardings)
+            return self._program_builder(kind, bucket)
 
         def compiled(jitted):
             try:

@@ -344,6 +344,42 @@ def test_offload_bench_contract():
 
 
 @pytest.mark.slow
+def test_perf_attrib_bench_contract():
+    """tools/serve_bench.py --workload perf-attrib (the
+    PERF_ATTRIB_BENCH.json bench_watch stage) on CPU smoke shapes:
+    device-timing sampling on vs off emits byte-identical tokens with
+    unchanged AOT fingerprints, records sampled dispatches and a
+    populated nonzero-flops cost table, and the off arm records zero
+    timings — the invariants the serve_perf watchdog gate trusts."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # no tunnel for a CPU smoke
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--backend", "cpu", "--workload", "perf-attrib",
+         "--layers", "2", "--d-model", "64", "--heads", "4",
+         "--vocab", "211", "--requests", "12", "--concurrency", "4",
+         "--prompt-lens", "8,16,24", "--max-new", "8"],
+        capture_output=True, text=True, timeout=540, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads([l for l in r.stdout.splitlines()
+                          if l.startswith("{")][-1])
+    assert payload["platform"] == "cpu"
+    assert payload["complete"] is True      # stamped BEFORE the print
+    # the acceptance bars the serve_perf stage gates on
+    assert payload["tokens_identical"] is True
+    assert payload["fingerprint_identical"] is True
+    assert payload["cost_flops_nonzero"] is True
+    assert payload["sampled_dispatches"] > 0
+    assert "decode" in payload["cost_table_kinds"]
+    assert "prefill" in payload["cost_table_kinds"]
+    rec = payload["points"][0]
+    assert rec["off_sampled_steps"] == 0    # sampling-off is inert
+    assert rec["sampled_steps"] > 0
+    assert rec["cost_errors"] == 0
+    assert "telemetry" in payload
+
+
+@pytest.mark.slow
 def test_train_bench_contract(tmp_path):
     """tools/train_bench.py (the TRAIN_BENCH.json bench_watch stage)
     emits the training-path comparison on a CPU smoke config: both

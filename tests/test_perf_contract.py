@@ -513,3 +513,88 @@ def test_dp_sp_flash_gpt_lowers_for_tpu():
         assert len(re.findall(r"all_gather", text)) == 0
     finally:
         fam._on_tpu = orig
+
+
+# -- 4. Serve program-family audits (perf-attribution gate) -----------------
+# The serve-side analog of layer 2: lower the EXACT bucketed programs
+# serve.Engine dispatches (via hlo_audit.build_serve_engine +
+# engine._program_builder) and pin dot_general / transpose counts plus
+# cost_analysis() flops, so a lowering regression in the decode hot
+# path — an extra gather-induced transpose, a duplicated matmul, a
+# flops blow-up — fails CI on CPU alone.  Counts measured identical
+# under cpu and --tpu lowering at this config (no Pallas at these tiny
+# shapes), so the CPU pins audit the real TPU program structure too.
+
+SERVE_PINS = {
+    # (kind, bucket): transposes, act_transposes, dot_generals, flops
+    ("prefill", 8):     (17, 4, 17, 451136),
+    ("chunk", 8):       (17, 4, 17, 518645),
+    ("decode", 4):      (13, 0, 17, 275472),
+    ("draft", 4):       (16, 0, 20, 106390),
+    ("draft_chunk", 8): (9, 2, 9, 82665),
+    ("verify", 4):      (17, 4, 17, 824608),
+    ("restore", 4):     (0, 0, 0, 566),
+}
+
+
+@pytest.fixture(scope="module")
+def serve_audit_engine():
+    eng = hlo_audit.build_serve_engine()
+    yield eng
+    eng.shutdown()
+
+
+@pytest.mark.parametrize("kind,bucket", sorted(SERVE_PINS))
+def test_serve_program_op_counts(serve_audit_engine, kind, bucket):
+    """Each serve program family keeps its pinned op structure."""
+    transposes, act, dots, _ = SERVE_PINS[(kind, bucket)]
+    c = _counts(hlo_audit.serve_lower_text(serve_audit_engine, kind,
+                                           bucket))
+    assert c["dot_generals"] == dots, (kind, c)
+    assert c["transposes"] == transposes, (kind, c)
+    assert c["activation_transposes"] == act, (kind, c)
+    assert c["convolutions"] == 0 and c["all_to_alls"] == 0, (kind, c)
+
+
+@pytest.mark.parametrize("kind,bucket", sorted(SERVE_PINS))
+def test_serve_program_cost_flops(serve_audit_engine, kind, bucket):
+    """cost_analysis() flops — the numbers the engine's perf cost
+    table captures at resolve time — stay pinned per family."""
+    flops = hlo_audit.serve_cost_flops(serve_audit_engine, kind, bucket)
+    assert flops is not None, (kind, bucket)
+    assert int(flops) == SERVE_PINS[(kind, bucket)][3], (kind, flops)
+
+
+def test_analytic_flops_cross_check(serve_audit_engine):
+    """flops.gpt_token_flops / gpt_prefill_flops (the analytic fallback
+    and the MFU denominators surfaced in docs) agree with the XLA
+    cost_analysis() numbers to within model-shape slop: the analytic
+    count ignores softmax/layernorm flops while cost_analysis bills
+    them, so the ratio analytic/measured sits in a tight band below 1
+    at tiny d_model and approaches 1 as matmuls dominate."""
+    from mxnet_tpu import flops as F
+
+    spec = serve_audit_engine.spec
+    d_model = spec["d_model"]
+    head_dim, kvh = spec["head_dim"], spec["kv_heads"]
+    heads = d_model // head_dim
+    # decode attends over the PADDED paged context (the whole table)
+    ctx = serve_audit_engine.max_model_len
+
+    per_tok = F.gpt_token_flops(
+        n_layers=spec["n_layers"], d_model=d_model, num_heads=heads,
+        head_dim=head_dim, kv_heads=kvh, vocab=spec["vocab"],
+        context=ctx)
+    measured = hlo_audit.serve_cost_flops(serve_audit_engine,
+                                          "decode", 4)
+    ratio = (4 * per_tok) / measured
+    assert 0.5 < ratio < 1.5, (4 * per_tok, measured)
+
+    pre = F.gpt_prefill_flops(
+        n_layers=spec["n_layers"], d_model=d_model, num_heads=heads,
+        head_dim=head_dim, kv_heads=kvh, vocab=spec["vocab"],
+        seq_len=8)
+    measured = hlo_audit.serve_cost_flops(serve_audit_engine,
+                                          "prefill", 8)
+    ratio = pre / measured
+    assert 0.5 < ratio < 1.5, (pre, measured)

@@ -18,6 +18,7 @@ artifact set in priority order:
      tools/serve_bench.py --workload spec   -> SPEC_BENCH.json
      tools/serve_bench.py --workload quant  -> QUANT_SERVE_BENCH.json
      tools/serve_bench.py --workload offload -> OFFLOAD_BENCH.json
+     tools/serve_bench.py --workload perf-attrib -> PERF_ATTRIB_BENCH.json
   9. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
 
 Two stages need no TPU and run ahead of the probe (so chip-down rounds
@@ -718,6 +719,34 @@ def run_serve_offload_bench(timeout=2400):
         "OFFLOAD_BENCH.json", timeout, validate=validate)
 
 
+def run_serve_perf_bench(timeout=2400):
+    """Performance-attribution A/B (tools/serve_bench.py --workload
+    perf-attrib) — device-timing sampling on vs off over the same
+    workload: tokens byte-identical, AOT fingerprints unchanged, the
+    sampled sync overhead within noise, and the per-program cost
+    table populated with nonzero flops (on real chips this is also
+    where measured MFU/achieved-TFLOP/s lands)."""
+
+    def validate(payload):
+        if not payload.get("tokens_identical"):
+            return "sampling-on tokens differ from sampling-off"
+        if not payload.get("fingerprint_identical"):
+            return "sampling changed the AOT fingerprint"
+        if not payload.get("cost_flops_nonzero"):
+            return "cost table missing or zero-flops"
+        if not payload.get("sampled_dispatches"):
+            return "no sampled dispatches recorded"
+        if (payload.get("overhead_ratio") or 99) > 1.5:
+            return "sampling overhead above 1.5x (should be noise)"
+        return None
+
+    return run_json_artifact(
+        "serve_perf",
+        [os.path.join(REPO, "tools", "serve_bench.py"),
+         "--workload", "perf-attrib"],
+        "PERF_ATTRIB_BENCH.json", timeout, validate=validate)
+
+
 def run_train_bench(timeout=1800):
     """Fused single-dispatch train step vs per-param loop
     (tools/train_bench.py) — steps/sec and per-batch host dispatch
@@ -800,6 +829,7 @@ def main():
             "serve_tp": False, "serve_prefix": False,
             "serve_spec": False, "serve_sampling": False,
             "serve_quant": False, "serve_offload": False,
+            "serve_perf": False,
             "train_bench": False, "startup": False, "train_tier": False,
             "sweep": False}
     fails = {k: 0 for k in done}
@@ -924,6 +954,8 @@ def main():
              lambda: run_serve_quant_bench(timeout=min(2400, left))),
             ("serve_offload",
              lambda: run_serve_offload_bench(timeout=min(2400, left))),
+            ("serve_perf",
+             lambda: run_serve_perf_bench(timeout=min(2400, left))),
             ("train_bench", lambda: run_train_bench(timeout=min(1800, left))),
             ("startup", lambda: run_startup_bench(timeout=min(1800, left))),
             ("train_tier", lambda: run_train_tier(timeout=min(3000, left))),

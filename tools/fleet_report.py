@@ -52,13 +52,15 @@ def render(view):
 
     hdr = (f"{'ROLE':<8} {'REP':>3} {'STALE':>5} {'QUEUE':>5} "
            f"{'RUN':>4} {'HANDOFF':>7} {'TOK/S':>8} {'TOKENS':>9} "
-           f"{'DONE':>6} {'REJ':>5} {'KV%':>5} {'HOSTKV%':>7}")
+           f"{'DONE':>6} {'REJ':>5} {'KV%':>5} {'HOSTKV%':>7} "
+           f"{'MFU%':>5} {'TFLOPS':>7}")
     lines.append(hdr)
     roles = view.get("roles") or {}
     for role in sorted(roles):
         a = roles[role]
         kv = a.get("kv_utilization_mean")
         hkv = a.get("host_kv_utilization_mean")
+        mfu = a.get("mfu_mean")
         lines.append(
             f"{role:<8} {a.get('replicas', 0):>3} "
             f"{a.get('stale', 0):>5} {a.get('queue_depth', 0):>5} "
@@ -68,7 +70,9 @@ def render(view):
             f"{a.get('tokens_generated', 0):>9} "
             f"{a.get('completed', 0):>6} {a.get('rejected', 0):>5} "
             f"{_fmt(100 * kv if kv is not None else None, 0):>5} "
-            f"{_fmt(100 * hkv if hkv is not None else None, 0):>7}")
+            f"{_fmt(100 * hkv if hkv is not None else None, 0):>7} "
+            f"{_fmt(100 * mfu if mfu is not None else None, 1):>5} "
+            f"{_fmt(a.get('achieved_tflops'), 2):>7}")
     lines.append("")
 
     lines.append(f"{'REPLICA':<24} {'ROLE':<8} {'STATE':<9} "

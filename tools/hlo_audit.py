@@ -11,7 +11,14 @@ transposes.  ``tests/test_perf_contract.py`` pins these counts so a
 layout regression (a new activation transpose slipping into the step)
 fails CI on CPU alone.
 
-Usage: python tools/hlo_audit.py [--tpu] [resnet|cifar|gpt|gpt_bshd ...]
+``serve`` audits the SERVE program families the same way: the exact
+bucketed programs ``serve.Engine`` dispatches (prefill/chunk/decode/
+draft/draft_chunk/verify/restore, via ``engine._program_builder`` +
+``_program_specs``), one JSON line per (kind, bucket) with op counts
+plus ``cost_analysis()`` flops — the perf-attribution regression gate
+(tests/test_perf_contract.py pins the counts on CPU).
+
+Usage: python tools/hlo_audit.py [--tpu] [resnet|cifar|gpt|gpt_bshd|serve ...]
 Prints one JSON line per model: {"model", "transposes", "convolutions",
 "dot_generals", "all_to_alls"}.
 """
@@ -132,6 +139,84 @@ def audit_counts(text):
     }
 
 
+# -- serve program families ---------------------------------------------------
+# the serve-side analog of the train-step audit: lower the EXACT
+# bucketed programs serve.Engine dispatches (engine._program_builder —
+# the same builder traffic resolves through) and count layout ops +
+# cost_analysis flops, so a lowering regression in the decode hot path
+# fails CI on CPU alone (tests/test_perf_contract.py pins the counts)
+
+# audited (kind, bucket) grid: one representative bucket per family
+SERVE_KINDS = (("prefill", 8), ("chunk", 8), ("decode", 4),
+               ("draft", 4), ("draft_chunk", 8), ("verify", 4),
+               ("restore", 4))
+
+
+def build_serve_engine(spec_k=2, **kw):
+    """A tiny CPU serve engine exposing every program family: target
+    gpt + a smaller draft checkpoint (spec decoding on), host-tier
+    geometry compatible with the restore program.  Program builders
+    close over static config only, so lowering needs no warmup and no
+    traffic."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    def tiny_params(net, seq):
+        arg_shapes, _, _ = net.infer_shape(data=(1, seq),
+                                           softmax_label=(1, seq))
+        rng = np.random.RandomState(0)
+        out = {}
+        for name, shp in zip(net.list_arguments(), arg_shapes):
+            if name in ("data", "softmax_label"):
+                continue
+            scale = 0.1 if name.endswith("weight") else 0.0
+            out[name] = (rng.randn(*shp) * scale
+                         + (1.0 if name.endswith("gamma") else 0.0)
+                         ).astype(np.float32)
+        return out
+
+    seq = 64
+    net = mx.models.gpt(53, seq, num_layers=2, d_model=32, num_heads=4)
+    draft = mx.models.gpt(53, seq, num_layers=1, d_model=16, num_heads=2)
+    ekw = dict(block_size=4, num_blocks=64, max_batch=4,
+               max_model_len=32, spec_k=spec_k,
+               draft_params=tiny_params(draft, seq), draft_symbol=draft)
+    ekw.update(kw)
+    return mx.serve.Engine(tiny_params(net, seq), symbol=net, **ekw)
+
+
+def serve_lower_text(eng, kind, bucket, platform=None):
+    """StableHLO text of one serve program, traced from the engine's
+    own builder + ShapeDtypeStruct signature (no live arrays, no
+    compile) — ``platform="tpu"`` audits the real TPU lowering from a
+    CPU-only CI box, exactly like the train-step path."""
+    jitted = eng._program_builder(kind, bucket)
+    specs = eng._program_specs(kind, bucket)
+    traced = jitted.trace(*specs)
+    if platform:
+        lowered = traced.lower(lowering_platforms=(platform,))
+    else:
+        lowered = traced.lower()
+    return lowered.as_text()
+
+
+def serve_cost_flops(eng, kind, bucket):
+    """cost_analysis() flops of the program compiled for the CURRENT
+    backend (None when the backend reports none) — the number the
+    engine's cost table captures at resolve time."""
+    jitted = eng._program_builder(kind, bucket)
+    specs = eng._program_specs(kind, bucket)
+    try:
+        ca = jitted.lower(*specs).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        f = float(ca.get("flops", 0.0) or 0.0)
+        return f if f > 0.0 else None
+    except Exception:
+        return None
+
+
 def build(model, batch=8):
     """Lower one bench model's train step (tiny trace shapes; same model
     constructors and layouts as bench.py's TPU configs)."""
@@ -176,6 +261,26 @@ def main(argv):
     models = [a for a in argv if not a.startswith("--")] or [
         "resnet", "cifar", "gpt", "gpt_bshd"]
     for model in models:
+        if model == "serve":
+            # one line per serve program family: the bucketed programs
+            # serve.Engine dispatches, traced from their real builders
+            eng = build_serve_engine()
+            try:
+                for kind, bucket in SERVE_KINDS:
+                    rec = {"model": f"serve_{kind}", "bucket": bucket,
+                           "platform": "tpu" if tpu else "cpu"}
+                    text = serve_lower_text(
+                        eng, kind, bucket,
+                        platform="tpu" if tpu else None)
+                    rec.update(audit_counts(text))
+                    rec["tpu_custom_calls"] = len(
+                        re.findall(r"tpu_custom_call", text))
+                    rec["cost_flops"] = serve_cost_flops(eng, kind,
+                                                         bucket)
+                    print(json.dumps(rec))
+            finally:
+                eng.shutdown()
+            continue
         trainer, placed = build(model)
         rec = {"model": model, "platform": "tpu" if tpu else "cpu"}
         text = lower_text(trainer, placed,

@@ -166,6 +166,21 @@ def fmt_labels(labels):
     return ",".join(f"{k}={v}" for k, v in sorted(labels.items())) or "-"
 
 
+def label_sort_key(sample):
+    """Numeric-aware ordering for a family's labeled samples: bucket
+    "16" sorts after "2", not between "1" and "2" — so the {kind,
+    bucket} histogram families the serve perf-attribution plane emits
+    render grouped by kind with buckets ascending, deterministically,
+    instead of in child-insertion (first-dispatch) order."""
+    key = []
+    for k, v in sorted(sample["labels"].items()):
+        try:
+            key.append((k, 0, float(v), ""))
+        except (TypeError, ValueError):
+            key.append((k, 1, 0.0, str(v)))
+    return key
+
+
 def render_table(rows, headers):
     widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
               for i, h in enumerate(headers)]
@@ -182,7 +197,7 @@ def report(metrics, filter_substr=None):
         if filter_substr and filter_substr not in name:
             continue
         fam = metrics[name]
-        for s in fam["samples"]:
+        for s in sorted(fam["samples"], key=label_sort_key):
             if fam["kind"] == "histogram":
                 qs = [quantile_estimate(s.get("buckets", []), q)
                       for q in QUANTILES]

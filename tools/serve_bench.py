@@ -618,6 +618,84 @@ def run_quant(mx, args, make_engine, workload):
     return rec
 
 
+def run_perf_attrib(mx, args, make_engine, workload):
+    """Performance-attribution A/B over the SAME workload: sampled
+    device timing on (every step) vs off.  The acceptance bar: tokens
+    byte-identical, the AOT fingerprint unchanged, the sampling
+    overhead within measurement noise, and the on-arm's cost table
+    populated with nonzero flops for every dispatched family."""
+    import os as _os
+
+    from mxnet_tpu.telemetry import perf_attrib as pa
+
+    conc = args.concurrency
+
+    def once(sample_every):
+        prev = _os.environ.get(pa.ENV_SAMPLE)
+        _os.environ[pa.ENV_SAMPLE] = str(sample_every)
+        try:
+            eng = make_engine(conc, max_queue=len(workload) + 1)
+            reqs, wall = run_closed(mx, eng, workload, conc)
+            perf = eng.statusz()["perf"]
+            fp = eng._spec_digest
+            eng.shutdown()
+        finally:
+            if prev is None:
+                _os.environ.pop(pa.ENV_SAMPLE, None)
+            else:
+                _os.environ[pa.ENV_SAMPLE] = prev
+        return reqs, wall, perf, fp
+
+    # warm the shared program cache AND replay the workload once so
+    # neither arm pays compiles or first-touch allocator costs — the
+    # overhead_ratio must compare sampling, not run order
+    weng = make_engine(conc, max_queue=len(workload) + 1)
+    weng.warmup()
+    run_closed(mx, weng, workload, conc)
+    weng.shutdown()
+
+    off_reqs, off_wall, off_perf, off_fp = once(0)
+    on_reqs, on_wall, on_perf, on_fp = once(1)
+    identical = all(
+        a.status == b.status == "finished" and a.tokens == b.tokens
+        for a, b in zip(off_reqs, on_reqs))
+    tps_off = (sum(len(r.tokens) for r in off_reqs) / off_wall
+               if off_wall else None)
+    tps_on = (sum(len(r.tokens) for r in on_reqs) / on_wall
+              if on_wall else None)
+    rows = on_perf["programs"]
+    rec = {
+        "mode": "perf-attrib",
+        "requests": len(workload),
+        "completed_on": sum(r.status == "finished" for r in on_reqs),
+        "completed_off": sum(r.status == "finished" for r in off_reqs),
+        "tokens_identical": identical,
+        "fingerprint_identical": on_fp == off_fp,
+        "wall_s_on": round(on_wall, 3),
+        "wall_s_off": round(off_wall, 3),
+        "tokens_per_sec_on": round(tps_on, 1) if tps_on else None,
+        "tokens_per_sec_off": round(tps_off, 1) if tps_off else None,
+        # >1 means the sampled sync cost wall time; CI gates this
+        # loosely (CPU walls are noisy) — the honest number to track
+        "overhead_ratio": (round(on_wall / off_wall, 3)
+                           if off_wall else None),
+        # the off arm must record ZERO timings (inert default)...
+        "off_sampled_steps": off_perf["sampled_steps"],
+        # ...while the on arm attributes every step
+        "sampled_steps": on_perf["sampled_steps"],
+        "sampled_dispatches": sum(r["sampled"] for r in rows),
+        "cost_table_kinds": sorted({r["kind"] for r in rows}),
+        "cost_flops_nonzero": bool(rows) and all(
+            r["flops"] and r["flops"] > 0 for r in rows),
+        "cost_errors": on_perf["cost_errors"],
+        "achieved_tflops": on_perf["achieved_tflops"],
+        "mfu": on_perf["mfu"],
+        "tok_flops": on_perf["tok_flops"],
+        "cost_per_1k_tokens_s": on_perf["cost_per_1k_tokens_s"],
+    }
+    return rec
+
+
 def run_shared_prefix(mx, args, make_engine, workload):
     """Cache-on vs cache-off over the shared-prefix workload: the
     prefill-compute ratio, hit rate, tokens saved — and byte-identical
@@ -858,7 +936,7 @@ def main():
     p.add_argument("--workload", default="default",
                    choices=("default", "shared-prefix", "mixed-len",
                             "prefix", "spec", "quant", "offload",
-                            "sampling"),
+                            "sampling", "perf-attrib"),
                    help="default: the mixed prompt-length load. "
                         "shared-prefix: --prefixes system prompts x "
                         "--continuations suffixes, cache-on vs cache-off "
@@ -889,7 +967,12 @@ def main():
                         "tok/s at temperature>0 (rejection-sampling "
                         "acceptance) and a chi-square/TV distribution-"
                         "agreement pin -> the SAMPLING_BENCH.json "
-                        "stage")
+                        "stage. "
+                        "perf-attrib: device-timing sampling on vs "
+                        "off over the same workload — overhead within "
+                        "noise, tokens byte-identical, fingerprints "
+                        "unchanged, cost table populated -> the "
+                        "PERF_ATTRIB_BENCH.json stage")
     p.add_argument("--offload-prefixes", type=int, default=6,
                    help="offload: distinct system prompts (sized to "
                         "overflow the deliberately small HBM LRU)")
@@ -1137,6 +1220,23 @@ def main():
             out["host_restores"] = rec["host_restores"]
             out["host_restored_tokens"] = rec["host_restored_tokens"]
             out["discarded_tokens_off"] = rec["discarded_tokens_off"]
+            flush(False)
+        if args.workload == "perf-attrib":
+            wl = build_workload(rng, args)
+            rec = run_perf_attrib(mx, args, make_engine, wl)
+            print(json.dumps(rec))
+            pts.append(rec)
+            recs.append(rec)
+            # the bench_watch serve_perf contract fields
+            out["fingerprint_identical"] = rec["fingerprint_identical"]
+            out["overhead_ratio"] = rec["overhead_ratio"]
+            out["sampled_dispatches"] = rec["sampled_dispatches"]
+            out["cost_table_kinds"] = rec["cost_table_kinds"]
+            out["cost_flops_nonzero"] = rec["cost_flops_nonzero"]
+            out["achieved_tflops"] = rec["achieved_tflops"]
+            out["mfu"] = rec["mfu"]
+            out["tokens_per_sec_on"] = rec["tokens_per_sec_on"]
+            out["tokens_per_sec_off"] = rec["tokens_per_sec_off"]
             flush(False)
         if args.workload == "quant":
             wl = build_workload(rng, args)
