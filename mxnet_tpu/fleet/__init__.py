@@ -19,7 +19,14 @@ analog here (ROADMAP item 3) is this package:
   per-replica circuit breaker, trace-id propagation so
   ``tools/trace_report.py --stitch`` reassembles a request's hops
   across replicas, and prefill→decode handoff orchestration
-  (``/handoff_probe`` dedup + re-handoff on sibling).
+  (``/handoff_probe`` dedup + re-handoff on sibling).  With
+  ``MXTPU_ROUTE_AFFINITY`` > 0 it becomes cache-aware: each scrape
+  carries the replica's radix-cache advertisement (top-K chain keys
+  + counting bloom) and the router scores candidates by longest
+  advertised prompt-prefix ancestry, attaching a peer pull hint so
+  a cold sibling fetches the missing KV chain over the handoff
+  import path instead of recomputing it (the fleet-global KV
+  fabric; docs/how_to/fleet.md "Cache-aware routing").
 - ``supervisor``— ``Supervisor``: spawn/monitor/restart N replica
   slots, crash-restart with backoff, and drain -> AOT-warm restart
   rolling restarts (zero client-visible failures; PR 4's warm start is

@@ -74,7 +74,10 @@ _GAUGE_FIELDS = ("queue_depth", "running", "in_flight",
 _STATS_FIELDS = ("tokens_generated", "prompt_tokens", "completed",
                  "rejected", "preemptions", "decode_tok_per_sec",
                  "total_tok_per_sec", "ttft_ms_p50", "ttft_ms_p99",
-                 "tpot_ms_p50", "tpot_ms_p99", "decode_occupancy")
+                 "tpot_ms_p50", "tpot_ms_p99", "decode_occupancy",
+                 "prefix_hits", "prefix_misses",
+                 "prefix_resurrections", "prefix_tokens_saved",
+                 "prefill_tokens_computed")
 
 
 class _ReplicaView:
@@ -339,6 +342,17 @@ class FleetCollector:
         for k, v in (sec.get("perf") or {}).items():
             if isinstance(v, (int, float)):
                 values[f"perf_{k}"] = v
+        # fleet KV fabric: peer-to-peer pull counters plus the size of
+        # the radix summary the replica is advertising to the router
+        # (replicas predating the fabric — or running with the prefix
+        # cache off — ship neither section)
+        for k, v in (sec.get("pull") or {}).items():
+            if isinstance(v, (int, float)):
+                values[f"pull_{k}"] = v
+        summary = sec.get("kv_summary")
+        if isinstance(summary, dict) \
+                and isinstance(summary.get("keys"), (int, float)):
+            values["summary_keys"] = summary["keys"]
         return values
 
     def is_stale(self, view, now=None):
@@ -461,7 +475,10 @@ class FleetCollector:
             row["tok_per_sec"] = round(rate, 3)
         for f in ("ttft_ms_p99", "tpot_ms_p99", "perf_mfu",
                   "perf_achieved_tflops", "perf_tok_flops",
-                  "perf_cost_per_1k_tokens_s", "perf_sampled"):
+                  "perf_cost_per_1k_tokens_s", "perf_sampled",
+                  "prefix_hits", "prefix_resurrections",
+                  "prefix_tokens_saved", "summary_keys",
+                  "pull_attempts", "pull_blocks_imported"):
             v = ring.latest(f)
             if v is not None:
                 row[f] = v

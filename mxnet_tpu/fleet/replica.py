@@ -59,6 +59,22 @@ truncated/corrupt/chaos-dropped payload degrades to recompute-from-
 prompt (the body always carries the prompt) — token output stays
 byte-identical to a role="both" fleet in every failure arm.
 
+Fleet KV fabric (cache-aware routing + peer-to-peer pull)
+---------------------------------------------------------
+
+Every replica advertises a ``kv_summary`` (``BlockManager.summary()``
+— a counting-bloom + top-K ``RadixSummary`` snapshot, size-bounded,
+maintained incrementally off publish/evict events) on ``/healthz``
+and in the ``/statusz.json`` replica section; the affinity router
+(``MXTPU_ROUTE_AFFINITY`` > 0) probes it to route a prompt toward
+its cached prefix.  When the router's pick holds LESS of the chain
+than a sibling advertises, the ``/generate`` body carries a
+``kv_pull`` hint and this replica pulls the chain from the sibling's
+``POST /chain_export`` into its host-RAM tier through the same
+verified import path as a handoff — sha1 payload digests plus
+chain-hash verification, any failure (timeout, corruption, bloom
+false positive) degrading to recompute-from-prompt.
+
 Faults (``faults.FaultInjector``) hook ``/generate`` AND ``/handoff``
 arrivals so the chaos tests can kill/delay/refuse/hang this replica at
 a deterministic request index.  A *kill* is a hard death — ``on_kill``
@@ -79,6 +95,7 @@ import json
 import os
 import threading
 import time
+import urllib.request
 from http.server import BaseHTTPRequestHandler
 
 import numpy as np
@@ -133,6 +150,14 @@ def _handoff_blocks(result):
         "mxtpu_fleet_handoff_blocks_total",
         "handoff record outcomes at the receiving replica",
         ("result",)).labels(result=result)
+
+
+def _pull_result(outcome):
+    return telemetry.counter(
+        "mxtpu_fleet_chain_pulls_total",
+        "peer-to-peer KV chain pull outcomes at the pulling replica "
+        "(ok / false_positive / failed)",
+        ("outcome",)).labels(outcome=outcome)
 
 
 class ReplicaServer:
@@ -220,6 +245,22 @@ class ReplicaServer:
         self._handoff_drops = 0          # guarded-by: _lock
         self._handoff_bytes_received = 0  # guarded-by: _lock
         self._handoff_bytes_exported = 0  # guarded-by: _lock
+        # fleet KV fabric: peer-to-peer chain pull accounting (the
+        # statusz "pull" section CACHE_ROUTE_BENCH.json reads).  A
+        # pull is the router-hinted fetch of a sibling's cached chain
+        # into THIS replica's host tier; chain_export_* counts the
+        # serving side of someone else's pull
+        self._pull_timeout_s = env_float("MXTPU_ROUTE_PULL_TIMEOUT", 5.0)
+        self._pull_attempts = 0           # guarded-by: _lock
+        self._pull_imported = 0           # guarded-by: _lock
+        self._pull_deduped = 0            # guarded-by: _lock
+        self._pull_rejected = 0           # guarded-by: _lock
+        self._pull_false_positives = 0    # guarded-by: _lock
+        self._pull_failures = 0           # guarded-by: _lock
+        self._pull_bytes_received = 0     # guarded-by: _lock
+        self._chain_exports = 0           # guarded-by: _lock
+        self._chain_export_blocks = 0     # guarded-by: _lock
+        self._chain_export_bytes = 0      # guarded-by: _lock
         self._server = None
         self._http_thread = None
         self._step_thread = None
@@ -476,6 +517,13 @@ class ReplicaServer:
             # scheduler/telemetry state, which must not grow with
             # arbitrary client strings
             tenant = str(tenant)[:64]
+        pull = body.get("kv_pull")
+        if pull is not None:
+            # router hint: a sibling advertises more of this prompt's
+            # chain than we hold — pull it into the host tier before
+            # admission so the radix walk hits it.  Strictly
+            # best-effort: every failure arm degrades to recompute
+            self._maybe_pull_chain(pull, prompt)
         # a prefill-role replica runs admission + (chunked) prefill
         # only: max_new_tokens=1 makes the prefill pass's own sampled
         # token the request's last — it FINISHES at prefill end, its
@@ -679,6 +727,100 @@ class ReplicaServer:
             _handoff_blocks("rejected").inc(rejected)
         return self._serve_generate(body, trace_id, kill, handoff=True)
 
+    def _maybe_pull_chain(self, spec, prompt):
+        """Pull a sibling's cached KV chain for ``prompt`` into the
+        local host tier — the peer-to-peer leg of the fleet KV fabric.
+
+        ``spec`` is the router's ``kv_pull`` hint: ``{"peer": url,
+        "tokens": advertised_prefix_tokens}``.  The pull POSTs the
+        peer's ``/chain_export`` and lands the records through the
+        SAME verified import path as a prefill→decode handoff
+        (payload sha1 in ``_decode_records``, chain hash in
+        ``import_blocks``) — so a shared prefix is prefilled once per
+        fleet and shipped once per host.  Best-effort by contract:
+        a malformed hint, an unreachable/slow peer
+        (``MXTPU_ROUTE_PULL_TIMEOUT``), a corrupted payload, or a
+        bloom false positive (the peer exports nothing) all degrade
+        to recompute-from-prompt — never an error, never a wrong
+        token.  Skipped outright when the local cache already covers
+        at least the advertised span, or without a host tier to land
+        the records in."""
+        eng = self.engine
+        if eng.blocks.host is None or not eng.blocks.prefix_cache:
+            return
+        try:
+            peer = str(spec.get("peer") or "")
+            tokens = int(spec.get("tokens") or 0)
+        except (AttributeError, TypeError, ValueError):
+            return
+        if not peer.startswith("http") \
+                or tokens < eng.blocks.block_size:
+            return
+        _, local = eng.blocks.prefix_probe(prompt)
+        if local >= tokens:
+            return            # already as warm as the peer advertises
+        with self._lock:
+            self._pull_attempts += 1
+        try:
+            req = urllib.request.Request(
+                f"{peer.rstrip('/')}/chain_export",
+                data=json.dumps({"prompt": prompt}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(
+                    req, timeout=self._pull_timeout_s) as resp:
+                out = json.loads(resp.read())
+            records = out.get("records") or []
+            parsed, nbytes = self._decode_records(records)
+            imported, deduped, rejected = \
+                eng.ingest_pulled_blocks(parsed)
+        except (OSError, KeyError, TypeError, ValueError):
+            # transport failure, truncation, or digest mismatch: the
+            # prompt is still fully servable here — recompute
+            with self._lock:
+                self._pull_failures += 1
+            _pull_result("failed").inc()
+            return
+        # an empty export despite the advertisement is the bloom
+        # false-positive arm (or the chain was evicted since the
+        # scrape) — count it so the advertised FP bound is observable
+        false_positive = not records
+        with self._lock:
+            self._pull_imported += imported
+            self._pull_deduped += deduped
+            self._pull_rejected += rejected
+            self._pull_bytes_received += nbytes
+            if false_positive:
+                self._pull_false_positives += 1
+        _handoff_bytes("pulled").inc(nbytes)
+        _pull_result("false_positive" if false_positive else "ok").inc()
+
+    def handle_chain_export(self, body):
+        """``POST /chain_export``: serialize this replica's cached
+        chain for a peer's prompt — the serving half of a peer-to-peer
+        pull.  Read-only against the cache (D2H gather + host-pool
+        peek, never a claim, never an index mutation) and never
+        fault-injected: a pull is a bytes optimization, and chaos must
+        exercise the PULLER's degrade path, not synthesize fake
+        request arrivals here.  Exported under the step lock exactly
+        like a prefill handoff: the gather must not race a step
+        dispatch that donates the cache buffers away."""
+        try:
+            prompt = [int(t) for t in body["prompt"]]
+        except (KeyError, TypeError, ValueError):
+            return 400, {"error": "bad_request", "retriable": False}
+        if not prompt:
+            return 400, {"error": "bad_request", "retriable": False}
+        with self._step_lock:
+            records, nbytes = self._encode_records(
+                self.engine.blocks.export_blocks(None, prompt))
+        with self._lock:
+            self._chain_exports += 1
+            self._chain_export_blocks += len(records)
+            self._chain_export_bytes += nbytes
+        _handoff_bytes("chain_exported").inc(nbytes)
+        return 200, {"replica": self.replica_id, "records": records}
+
     def _encode_records(self, recs):
         """``export_blocks`` output -> JSON-ready wire records (raw
         K/V bytes base64'd, plus a payload digest — the chain hash
@@ -785,7 +927,13 @@ class ReplicaServer:
                 # a saturated pool means further evictions re-pay
                 # recompute, so the tier's headroom IS a load signal
                 "host_kv_utilization": (hk["utilization"]
-                                        if hk is not None else None)}
+                                        if hk is not None else None),
+                # the routable-cache advertisement (RadixSummary
+                # snapshot; None with the prefix cache off).  Size-
+                # bounded by construction: bloom_bits/8 bytes of
+                # bitmap + top_k truncated-hex keys, ~1.2 KB at the
+                # defaults, independent of cache size
+                "kv_summary": self.engine.kv_summary()}
         # deploy identity is optional: untagged replicas keep the
         # pre-control-plane /healthz schema byte-for-byte
         if self.version is not None:
@@ -809,6 +957,16 @@ class ReplicaServer:
                        "drops": self._handoff_drops,
                        "bytes_received": self._handoff_bytes_received,
                        "bytes_exported": self._handoff_bytes_exported}
+            pull = {"attempts": self._pull_attempts,
+                    "blocks_imported": self._pull_imported,
+                    "blocks_deduped": self._pull_deduped,
+                    "blocks_rejected": self._pull_rejected,
+                    "false_positives": self._pull_false_positives,
+                    "failures": self._pull_failures,
+                    "bytes_received": self._pull_bytes_received,
+                    "chain_exports": self._chain_exports,
+                    "chain_export_blocks": self._chain_export_blocks,
+                    "chain_export_bytes": self._chain_export_bytes}
         s = eng.stats()
         return {"replica": self.replica_id, "state": state,
                 "role": self.role,
@@ -833,6 +991,16 @@ class ReplicaServer:
                     "tpot_ms_p50": s.tpot_ms_p50,
                     "tpot_ms_p99": s.tpot_ms_p99,
                     "decode_occupancy": s.decode_occupancy,
+                    # prefix-cache goodput (the cache-aware router's
+                    # A/B ground truth: hits split from LRU
+                    # resurrections, plus the prefill compute the
+                    # cache actually avoided)
+                    "prefix_hits": s.prefix_hits,
+                    "prefix_misses": s.prefix_misses,
+                    "prefix_resurrections": s.prefix_resurrections,
+                    "prefix_tokens_saved": s.prefix_tokens_saved,
+                    "prefill_tokens_computed":
+                        s.prefill_tokens_computed,
                     "tenants": {t: row.get("completed", 0)
                                 for t, row in s.tenants.items()},
                 },
@@ -848,6 +1016,13 @@ class ReplicaServer:
                 # prefill→decode handoff traffic (the disaggregation
                 # observability: wire bytes, dedup hits, drop arms)
                 "handoff": handoff,
+                # peer-to-peer chain pull traffic (the fleet KV
+                # fabric observability: hit/false-positive/failure
+                # arms, wire bytes both directions)
+                "pull": pull,
+                # the routable-cache advertisement the affinity
+                # router probes (None with the prefix cache off)
+                "kv_summary": eng.kv_summary(),
                 "max_batch": eng.max_batch,
                 "kv_utilization": round(eng.blocks.utilization(), 4),
                 # host-DRAM KV tier occupancy (None with the tier off)
@@ -957,7 +1132,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, {"path": path,
                                   "replica": self.replica.replica_id})
             return
-        if self.path not in ("/generate", "/handoff", "/handoff_probe"):
+        if self.path not in ("/generate", "/handoff", "/handoff_probe",
+                             "/chain_export"):
             self.send_error(404)
             return
         try:
@@ -981,6 +1157,20 @@ class _Handler(BaseHTTPRequestHandler):
             have = set(self.replica.engine.blocks.has_blocks(keys))
             self._send_json(200, {"missing": [k.hex() for k in keys
                                               if k not in have]})
+            return
+        if self.path == "/chain_export":
+            # peer-to-peer pull: serialize our cached chain for the
+            # peer's prompt.  Never fault-injected (see
+            # handle_chain_export)
+            try:
+                result = self.replica.handle_chain_export(body)
+            except Exception:
+                _errors("chain_export").inc()
+                result = 500, {"error": "internal", "retriable": True}
+            try:
+                self._send_json(*result)
+            except OSError:
+                _errors("respond").inc()
             return
         trace_id = self.headers.get(TRACE_HEADER) or body.get("trace_id")
         handler = (self.replica.handle_handoff

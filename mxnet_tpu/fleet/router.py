@@ -37,23 +37,44 @@ another one from the payload still in hand, and a payload that
 arrives truncated degrades to recompute-from-prompt on the receiver
 (token-identical either way).
 
-Pure stdlib (urllib); no background machinery unless ``start()`` is
-called (the scrape thread).  All knobs take constructor arguments
-first, ``MXTPU_FLEET_*`` env defaults second.
+Cache-aware routing (``MXTPU_ROUTE_AFFINITY`` > 0): every scrape also
+captures the replica's advertised ``kv_summary`` (a RadixSummary —
+counting bloom over its published KV block keys + top-K recent chain
+keys).  The router hashes each prompt's block chain tokenizer-side
+(``serve.kv_block_manager.chain_keys`` — the same
+``H(parent, block_tokens)`` chain as the radix index, no model
+loaded), probes each candidate's summary for the longest advertised
+ancestor, and ranks on ``load − affinity × advertised_fraction`` —
+sticky enough that a returning conversation lands on its prefix,
+load-aware enough that a hot prefix doesn't melt one replica.  When
+the pick holds less of the chain than a sibling advertises, the
+``/generate`` body carries a ``kv_pull`` hint and the serving replica
+pulls the chain peer-to-peer (``/chain_export``) into its host tier.
+At affinity 0 (the default) all of this is byte-inert: no chain keys
+computed, no summary probed, wire bodies and pick order identical to
+the pre-affinity router.  Summaries older than
+``MXTPU_ROUTE_SUMMARY_STALE`` scrape intervals score zero.
+
+Pure stdlib (urllib + a persistent per-replica keep-alive scrape
+connection); no background machinery unless ``start()`` is called
+(the scrape thread).  All knobs take constructor arguments first,
+``MXTPU_FLEET_*`` / ``MXTPU_ROUTE_*`` env defaults second.
 """
 
 from __future__ import annotations
 
+import http.client
 import itertools
 import json
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 import uuid
 
 from .. import telemetry
-from ..base import env_float, env_int
+from ..base import env_flag, env_float, env_int
 from ..telemetry.request_trace import RequestTracer
 from .replica import TRACE_HEADER
 
@@ -105,7 +126,8 @@ class _ReplicaState:
 
     __slots__ = ("url", "name", "state", "role", "load",
                  "consecutive_failures", "open_until", "probing",
-                 "last_scrape_t")
+                 "last_scrape_t", "summary", "summary_t", "conn",
+                 "scrape_lock", "connects")
 
     def __init__(self, url):
         self.url = url.rstrip("/")
@@ -119,6 +141,23 @@ class _ReplicaState:
         self.open_until = None      # breaker-open deadline (monotonic)
         self.probing = False        # half-open probe in flight
         self.last_scrape_t = None
+        # cache-aware routing: the replica's advertised RadixSummary
+        # snapshot and the scrape time it was captured (None until a
+        # scrape sees one; a summary past the staleness cap scores
+        # zero affinity — the PR 16 stale-data rule)
+        self.summary = None
+        self.summary_t = None
+        # persistent scrape connection (keep-alive: the affinity
+        # probe raises scrape frequency, so per-poll TCP connects
+        # would be pure overhead); `connects` counts socket setups —
+        # the connection-reuse regression pin reads it
+        self.conn = None
+        # non-blocking ownership of `conn`: an overlapping scrape
+        # pass (a blackholed sibling can make passes overlap) skips a
+        # replica whose connection is still mid-request rather than
+        # interleaving two HTTP exchanges on one socket
+        self.scrape_lock = threading.Lock()
+        self.connects = 0
 
 
 class Router:
@@ -141,6 +180,16 @@ class Router:
       scrape_interval_s: background statusz scrape period
         (``MXTPU_FLEET_SCRAPE_INTERVAL``, 0.5); ``start()`` launches
         the thread, or call ``scrape()`` manually (tests).
+      affinity: cache-aware routing weight (``MXTPU_ROUTE_AFFINITY``,
+        0.0 = byte-inert least-loaded): subtracts
+        ``affinity × advertised_prefix_fraction`` from a candidate's
+        load score.
+      pull: attach ``kv_pull`` peer-hints when a sibling advertises
+        more of the prompt's chain than the pick
+        (``MXTPU_ROUTE_PULL``, on; effective only with affinity > 0).
+      summary_stale: advertised summaries older than this many scrape
+        intervals score zero affinity
+        (``MXTPU_ROUTE_SUMMARY_STALE``, 3.0).
       clock: injectable monotonic clock (breaker/backoff tests).
       sleep: injectable sleep (backoff tests).
     """
@@ -148,6 +197,7 @@ class Router:
     def __init__(self, replicas, timeout_s=None, retries=None,
                  backoff_s=None, backoff_max_s=None, breaker_fails=None,
                  breaker_reset_s=None, scrape_interval_s=None,
+                 affinity=None, pull=None, summary_stale=None,
                  clock=time.monotonic, sleep=time.sleep):
         self.timeout_s = (float(timeout_s) if timeout_s is not None
                           else env_float("MXTPU_FLEET_TIMEOUT", 30.0))
@@ -167,6 +217,26 @@ class Router:
         self.scrape_interval_s = (
             float(scrape_interval_s) if scrape_interval_s is not None
             else env_float("MXTPU_FLEET_SCRAPE_INTERVAL", 0.5))
+        # cache-aware routing weight: each candidate's score becomes
+        # ``load - affinity * advertised_prefix_fraction`` (fraction
+        # of the prompt's tokens the replica's RadixSummary says it
+        # caches, 0..1 — same scale as one unit of load).  0 is the
+        # BYTE-INERT default: no chain keys computed, no summary
+        # probed, the pick identical to least-loaded by construction
+        self.affinity = (float(affinity) if affinity is not None
+                         else env_float("MXTPU_ROUTE_AFFINITY", 0.0))
+        # peer-to-peer pull hints (effective only with affinity > 0):
+        # when the pick holds less of the prompt's chain than the best
+        # advertiser, the /generate body carries a kv_pull hint and
+        # the serving replica pulls the chain from that sibling
+        self.pull = (bool(pull) if pull is not None
+                     else env_flag("MXTPU_ROUTE_PULL", True))
+        # summaries older than this many scrape intervals contribute
+        # ZERO affinity (the PR 16 stale-data rule: never route on
+        # data the fleet stopped refreshing)
+        self.summary_stale = (
+            float(summary_stale) if summary_stale is not None
+            else env_float("MXTPU_ROUTE_SUMMARY_STALE", 3.0))
         self.clock = clock
         self.sleep = sleep
         self._lock = threading.RLock()
@@ -196,6 +266,14 @@ class Router:
         self._m_handoff_dedup = telemetry.counter(
             "mxtpu_fleet_handoff_dedup_blocks_total",
             "handoff blocks whose bytes the dedup probe skipped")
+        self._m_affinity = telemetry.counter(
+            "mxtpu_fleet_affinity_picks_total",
+            "affinity-routed picks by whether the chosen replica "
+            "advertised any of the prompt's chain", ("outcome",))
+        self._m_pull_hints = telemetry.counter(
+            "mxtpu_fleet_pull_hints_total",
+            "kv_pull hints attached to routed requests (a sibling "
+            "advertised more of the chain than the pick)")
         # per-hop wall time by outcome: the stitched-view "router time"
         # a replica-side trace can never see (ok / reject = structured
         # 503 back-pressure / timeout / retry = transport failure that
@@ -250,6 +328,14 @@ class Router:
             self._scrape_thread.join(timeout=5)
             self._scrape_thread = None
         self._trace.close()
+        for r in self.replicas():
+            with self._lock:
+                conn, r.conn = r.conn, None
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
     def _scrape_loop(self):
         while not self._stop_evt.wait(self.scrape_interval_s):
@@ -275,12 +361,35 @@ class Router:
         return self.snapshot()
 
     def _scrape_one(self, r):
+        """One replica's scrape over its PERSISTENT keep-alive
+        connection (opened lazily, reused across passes — the
+        affinity probe raises scrape frequency, and paying a TCP
+        connect per poll per replica was pure overhead).  Any
+        transport or parse failure closes the connection (it may be
+        half-broken) and marks the replica down; the next pass
+        reconnects.  Guarded by a non-blocking per-replica lock so an
+        overlapping pass never interleaves two exchanges on one
+        socket — it just skips this replica for one round."""
+        if not r.scrape_lock.acquire(blocking=False):
+            return                      # an older pass still owns conn
         try:
-            with urllib.request.urlopen(
-                    f"{r.url}/statusz.json",
-                    timeout=min(self.timeout_s, 5.0)) as resp:
-                snap = json.loads(resp.read())
+            conn = r.conn
+            if conn is None:
+                parsed = urllib.parse.urlsplit(r.url)
+                conn = http.client.HTTPConnection(
+                    parsed.hostname, parsed.port,
+                    timeout=min(self.timeout_s, 5.0))
+                with self._lock:
+                    r.conn = conn
+                    r.connects += 1
+            conn.request("GET", "/statusz.json")
+            resp = conn.getresponse()
+            raw = resp.read()
+            if resp.status != 200:
+                raise OSError(f"statusz http {resp.status}")
+            snap = json.loads(raw)
             sec = snap.get("replica") or {}
+            summary = sec.get("kv_summary")
             with self._lock:
                 r.state = ("ready" if sec.get("state") == "ready"
                            else sec.get("state") or "down")
@@ -288,10 +397,21 @@ class Router:
                 r.role = sec.get("role") or "both"
                 r.load = self._load_score(sec)
                 r.last_scrape_t = self.clock()
-        except (OSError, ValueError):
+                if isinstance(summary, dict):
+                    r.summary = summary
+                    r.summary_t = r.last_scrape_t
+        except (OSError, ValueError, http.client.HTTPException):
+            try:
+                if r.conn is not None:
+                    r.conn.close()
+            except OSError:
+                pass
             with self._lock:
+                r.conn = None
                 r.state = "down"
                 r.last_scrape_t = self.clock()
+        finally:
+            r.scrape_lock.release()
 
     @staticmethod
     def _load_score(sec):
@@ -320,14 +440,78 @@ class Router:
                                           and r.open_until > now)}
                     for r in self._replicas]
 
+    # -- cache-aware routing (affinity > 0 only) -----------------------------
+    def _affinity_plan(self, prompt):
+        """Per-replica advertised-prefix match for ``prompt``: probe
+        each FRESH ``kv_summary`` (stale ones score zero — the PR 16
+        rule: never route on data the fleet stopped refreshing) for
+        the longest advertised ancestor of the prompt's chain.  The
+        chain keys are computed ONCE per distinct advertised
+        block_size (the tokenizer-side ``chain_keys`` helper — same
+        ``H(parent, block_tokens)`` hash as the radix index, no model
+        loaded).  Returns ``{"scores": {url: {"tokens", "frac"}},
+        "best": {...}}`` or None when nothing matched anywhere (the
+        pick then degenerates to pure least-loaded).  Never called
+        with ``affinity == 0`` — the byte-inert path skips it
+        entirely."""
+        from ..serve.kv_block_manager import RadixSummary, chain_keys
+
+        now = self.clock()
+        stale_after = (self.summary_stale
+                       * max(self.scrape_interval_s, 1.0))
+        with self._lock:
+            rows = [(r.url, r.name, r.summary, r.summary_t)
+                    for r in self._replicas]
+        keys_by_bs = {}
+        scores = {}
+        best = None
+        for url, name, summary, summary_t in rows:
+            if not summary or summary_t is None:
+                continue
+            if now - summary_t > stale_after:
+                continue                # stale: zero affinity
+            bs = int(summary.get("block_size") or 0)
+            if bs < 1:
+                continue
+            if bs not in keys_by_bs:
+                keys_by_bs[bs] = chain_keys(prompt, bs)
+            depth = RadixSummary.match(summary, keys_by_bs[bs])
+            if depth <= 0:
+                continue
+            tokens = depth * bs
+            scores[url] = {"tokens": tokens,
+                           "frac": tokens / max(1, len(prompt))}
+            if best is None or tokens > best["tokens"]:
+                best = {"url": url, "name": name, "tokens": tokens}
+        if not scores:
+            return None
+        return {"scores": scores, "best": best}
+
+    def _pull_hint(self, plan, r):
+        """The ``kv_pull`` hint for pick ``r`` under ``plan``: the
+        best-advertising SIBLING's url + advertised token span, or
+        None when the pick already matches the fleet's best (or pull
+        is disabled).  The serving replica does the actual fetch —
+        the router never moves KV bytes on this path."""
+        best = plan["best"]
+        if not self.pull or best is None or best["url"] == r.url:
+            return None
+        mine = plan["scores"].get(r.url)
+        if mine is not None and mine["tokens"] >= best["tokens"]:
+            return None
+        return {"peer": best["url"], "tokens": int(best["tokens"])}
+
     # -- picking -------------------------------------------------------------
-    def _pick(self, exclude, want=None):
+    def _pick(self, exclude, want=None, weights=None):
         """Least-loaded READY replica with a closed (or probe-ready)
         breaker, excluding already-tried ones; round-robin tiebreak.
         ``want`` filters by role capability: ``"prefill"`` skips
         decode-only replicas, ``"decode"`` skips prefill-only ones
         (role "both" — and never-scraped legacy replicas — serve
-        either)."""
+        either).  ``weights`` (affinity routing) maps replica url ->
+        score credit subtracted from its load before ranking; None —
+        the affinity-off path — ranks on raw load, bit-identically to
+        the pre-affinity router."""
         with self._lock:
             now = self.clock()
             rr = next(self._rr)
@@ -347,7 +531,10 @@ class Router:
                         continue        # breaker open
                     if r.probing:
                         continue        # half-open: ONE probe at a time
-                ranked.append((r.load, (i - rr) % n, r))
+                score = r.load
+                if weights:
+                    score -= weights.get(r.url, 0.0)
+                ranked.append((score, (i - rr) % n, r))
             if not ranked:
                 return None
             ranked.sort(key=lambda t: (t[0], t[1]))
@@ -475,9 +662,21 @@ class Router:
         t0 = time.perf_counter()
         rt = self._trace_begin(len(base["prompt"]), max_new_tokens,
                                tenant, trace_id)
+        # cache-aware routing: with affinity ON, score every fresh
+        # advertised summary against this prompt's chain ONCE (not per
+        # attempt — the fleet view only changes at scrape cadence).
+        # With affinity 0 this whole plane is byte-inert: no chain
+        # keys, no weights, no body growth, the pre-affinity pick
+        plan = weights = None
+        if self.affinity > 0:
+            plan = self._affinity_plan(base["prompt"])
+            if plan is not None:
+                weights = {u: self.affinity * s["frac"]
+                           for u, s in plan["scores"].items()}
         hops = []
         tried = set()
         last_error = "no_replica"
+        remaining = None
         for attempt in range(1, max(1, self.retries) + 1):
             if attempt > 1:
                 self._m_retries.inc()
@@ -499,19 +698,40 @@ class Router:
                         f"{last_error})")
                 body = json.dumps(dict(base,
                                        deadline_s=remaining)).encode()
-            r = self._pick(tried, want="prefill")
+            r = self._pick(tried, want="prefill", weights=weights)
             if r is None and tried:
                 # every replica tried once: second pass may retry one
                 # (it may have recovered / stopped rejecting)
                 tried = set()
-                r = self._pick(tried, want="prefill")
+                r = self._pick(tried, want="prefill", weights=weights)
             if r is None:
                 last_error = "no_replica"
                 continue
             tried.add(r.url)
-            self._trace_ev(rt, "pick", replica=r.name, attempt=attempt)
+            send_body = body
+            if plan is not None:
+                sc = plan["scores"].get(r.url)
+                mine = sc["tokens"] if sc else 0
+                self._m_affinity.labels(
+                    outcome="hit" if mine else "cold").inc()
+                hint = self._pull_hint(plan, r)
+                if hint is not None:
+                    extra = dict(base, kv_pull=hint)
+                    if deadline_s is not None:
+                        extra["deadline_s"] = remaining
+                    send_body = json.dumps(extra).encode()
+                    self._m_pull_hints.inc()
+                self._trace_ev(
+                    rt, "pick", replica=r.name, attempt=attempt,
+                    affinity_tokens=mine,
+                    **({"pull_peer": hint["peer"],
+                        "pull_tokens": hint["tokens"]}
+                       if hint is not None else {}))
+            else:
+                self._trace_ev(rt, "pick", replica=r.name,
+                               attempt=attempt)
             h0 = time.perf_counter()
-            code, payload = self._post(r, body, trace_id)
+            code, payload = self._post(r, send_body, trace_id)
             hop_wall = time.perf_counter() - h0
             self._observe_hop(code, hop_wall)
             self._trace_ev(rt, "hop", replica=r.name, status=str(code),

@@ -1198,6 +1198,25 @@ class Engine:
         out["block_bytes"] = int(per_block)
         return out
 
+    def kv_summary(self):
+        """The routable-cache advertisement: the BlockManager's
+        ``RadixSummary`` snapshot (counting bloom over every published
+        block key in both tiers + the top-K recently published chain
+        keys; None with the prefix cache off).  Size-bounded and
+        incremental — safe for the fleet replica to publish on every
+        ``/healthz``/``/statusz`` scrape at any cache size."""
+        return self.blocks.summary()
+
+    def ingest_pulled_blocks(self, records):
+        """Land a peer-pulled KV chain in the host tier — the engine
+        half of the fleet fabric's peer-to-peer pull.  ``records`` is
+        the decoded handoff wire shape; ingestion is the SAME
+        chain-hash-verified ``import_blocks`` path a prefill→decode
+        handoff uses, so a truncated or corrupted pull breaks the
+        chain and the suffix recomputes (degradation, never
+        corruption).  Returns ``(imported, deduped, rejected)``."""
+        return self.blocks.import_blocks(records)
+
     def sharding_info(self):
         """Live sharding layout: tp degree, mesh shape/devices, rule
         digest, and per-device HBM-resident parameter bytes — the

@@ -93,6 +93,7 @@ skips its bytes.
 
 from __future__ import annotations
 
+import base64
 import hashlib
 import threading
 import time
@@ -101,9 +102,10 @@ from collections import OrderedDict, deque
 import numpy as np
 
 from .. import telemetry
-from ..base import env_flag, env_float
+from ..base import env_flag, env_float, env_int
 
-__all__ = ["BlockManager", "HostKVPool", "NoFreeBlocks"]
+__all__ = ["BlockManager", "HostKVPool", "NoFreeBlocks", "RadixSummary",
+           "chain_keys"]
 
 # chaos-harness fault: simulated seconds per host-tier restore claim (a
 # slow DRAM copy); with a restore budget set, a delay past the budget
@@ -134,6 +136,164 @@ def _block_key(parent, token_ids):
     h = hashlib.sha1(parent)
     h.update(np.asarray(token_ids, np.int32).tobytes())
     return h.digest()
+
+
+def chain_keys(token_ids, block_size, max_blocks=None):
+    """Chain keys of ``token_ids``'s full blocks, in prefix order.
+
+    The tokenizer-side half of cache-aware routing: the fleet router
+    hashes an incoming prompt with THIS function (same
+    ``H(parent_key, block_tokens)`` chain as the radix index, no model
+    loaded) and probes each replica's advertised ``RadixSummary`` for
+    the longest cached ancestor.  Copy-on-write capped exactly like
+    ``_walk``: the final token's block always recomputes, so it is
+    never part of the routable prefix."""
+    bs = int(block_size)
+    if bs < 1 or token_ids is None:
+        return []
+    n_full = len(token_ids) // bs
+    if n_full and n_full * bs > len(token_ids) - 1:
+        n_full -= 1                    # COW: last span recomputes
+    if max_blocks is not None:
+        n_full = min(n_full, int(max_blocks))
+    out = []
+    parent = _ROOT
+    for b in range(n_full):
+        key = _block_key(parent, token_ids[b * bs:(b + 1) * bs])
+        out.append(key)
+        parent = key
+    return out
+
+
+class RadixSummary:
+    """Compact advertisement of the radix cache's contents — the
+    ``kv_summary`` payload a replica publishes on ``/healthz`` /
+    ``/statusz`` so the fleet router can score prefix affinity without
+    ever walking the tree.
+
+    Two complementary structures, both maintained O(1) per
+    publish/evict event (incremental — never a full-tree walk, and
+    ``snapshot()`` on the scrape path only packs bits):
+
+    - a COUNTING Bloom filter over every published block key in either
+      tier: the ``k`` probe positions are carved straight out of the
+      key's sha1 bytes (the key already IS a uniform hash — no second
+      hash family), ``add`` increments / ``remove`` decrements a
+      uint16 count, and the snapshot packs ``count > 0`` into a base64
+      bitmap (``m`` bits -> ``m/8`` bytes on the wire: ~512 B + ~1/3
+      base64 overhead at the default m=4096).  The false-positive rate
+      is bounded by ``(1 - e^(-k*n/m))^k`` (~2.4% at n=512 keys) and a
+      false positive is HARMLESS by contract: the router sends a
+      request to a replica that turns out cache-cold, which recomputes
+      — never an error, never a wrong token.  False negatives cannot
+      happen while counts stay below the uint16 ceiling (add saturates
+      rather than wraps, so a saturated position just stays set).
+    - ``top``: the most recently published chain keys (truncated hex,
+      the handoff codec's 16-char idiom), bounded at ``top_k`` — an
+      exact-membership fast path for the hottest chains.
+
+    Mutations arrive under the BlockManager/HostKVPool locks; the
+    summary keeps its own leaf lock anyway so the two tiers can never
+    race an unguarded numpy increment."""
+
+    def __init__(self, block_size, bloom_bits=None, top_k=None):
+        self.block_size = int(block_size)
+        m = (env_int("MXTPU_ROUTE_SUMMARY_BLOOM_BITS", 4096)
+             if bloom_bits is None else int(bloom_bits))
+        self.m = max(64, int(m))
+        self.k = 4
+        self.top_k = max(0, env_int("MXTPU_ROUTE_SUMMARY_TOPK", 32)
+                         if top_k is None else int(top_k))
+        self._lock = threading.Lock()
+        self._counts = np.zeros(self.m, np.uint16)  # guarded-by: _lock
+        self._top = OrderedDict()                   # guarded-by: _lock
+        self.keys = 0                               # guarded-by: _lock
+        self.version = 0                            # guarded-by: _lock
+
+    def _positions(self, key):
+        return [int.from_bytes(key[4 * i:4 * i + 4], "little") % self.m
+                for i in range(self.k)]
+
+    def add(self, key):
+        """One block published (either tier) under ``key``."""
+        with self._lock:
+            for p in self._positions(key):
+                if self._counts[p] < np.iinfo(np.uint16).max:
+                    self._counts[p] += 1
+            self.keys += 1
+            self.version += 1
+            if self.top_k:
+                hexk = key.hex()[:16]
+                self._top[hexk] = True
+                self._top.move_to_end(hexk)
+                while len(self._top) > self.top_k:
+                    self._top.popitem(last=False)
+
+    def remove(self, key):
+        """One block unpublished/evicted (either tier)."""
+        with self._lock:
+            for p in self._positions(key):
+                if self._counts[p] > 0:
+                    self._counts[p] -= 1
+            self.keys = max(0, self.keys - 1)
+            self.version += 1
+            self._top.pop(key.hex()[:16], None)
+
+    def clear(self):
+        with self._lock:
+            self._counts[:] = 0
+            self._top.clear()
+            self.keys = 0
+            self.version += 1
+
+    def snapshot(self):
+        """JSON-ready advertisement (the wire form ``match`` probes).
+        Size-bounded by construction: m/8 bloom bytes + top_k hex
+        keys, independent of how many blocks are cached."""
+        with self._lock:
+            bits = np.packbits(self._counts > 0).tobytes()
+            return {"block_size": self.block_size,
+                    "keys": self.keys,
+                    "version": self.version,
+                    "bloom": {"m": self.m, "k": self.k,
+                              "bits": base64.b64encode(bits)
+                              .decode("ascii")},
+                    "top": list(self._top)}
+
+    @staticmethod
+    def match(snapshot, keys):
+        """How many leading ``keys`` (full digests, prefix order) the
+        ``snapshot`` advertises — the router-side probe.  Chaining
+        makes the first miss final: a block cannot be cached without
+        its ancestor, so a deeper bloom hit past a miss would be a
+        guaranteed false positive.  Pure stdlib (bytes + int ops) so
+        the per-request router path never touches numpy, and any
+        malformed snapshot scores zero instead of raising."""
+        if not snapshot or not keys:
+            return 0
+        bloom = snapshot.get("bloom") or {}
+        try:
+            m = int(bloom.get("m") or 0)
+            k = int(bloom.get("k") or 0)
+            raw = base64.b64decode(bloom.get("bits") or "")
+        except (TypeError, ValueError):
+            return 0
+        bloom_ok = m > 0 and k > 0 and len(raw) * 8 >= m
+        top = set(snapshot.get("top") or ())
+        depth = 0
+        for key in keys:
+            if key.hex()[:16] in top:
+                depth += 1
+                continue
+            if not bloom_ok:
+                break
+            pos = [int.from_bytes(key[4 * i:4 * i + 4], "little") % m
+                   for i in range(k)]
+            if all((raw[p >> 3] >> (7 - (p & 7))) & 1 for p in pos):
+                depth += 1
+            else:
+                break
+        return depth
 
 
 class HostKVPool:
@@ -174,6 +334,10 @@ class HostKVPool:
         # (leaf == absent); survives the parent's own restore so a
         # re-offloaded interior keeps protecting its hosted children
         self._by_parent = {}            # guarded-by: _lock
+        # (on_add, on_remove) key callbacks the owning BlockManager
+        # registers so its RadixSummary tracks host-tier membership
+        # incrementally (None = nobody advertising)
+        self._listener = None           # guarded-by: _lock
         self.bytes_used = 0             # guarded-by: _lock
         self.bytes_peak = 0             # guarded-by: _lock
         self.offloads = 0               # guarded-by: _lock
@@ -205,6 +369,19 @@ class HostKVPool:
         with self._lock:
             return key in self._entries
 
+    def keys(self):
+        """Every hosted content key (LRU order) — the summary rebuild
+        after a ``BlockManager.reset()``, never the scrape path."""
+        with self._lock:
+            return list(self._entries)
+
+    def set_listener(self, on_add, on_remove):
+        """Register per-key add/remove callbacks (the BlockManager's
+        RadixSummary maintenance).  Callbacks run under ``_lock`` and
+        must be leaf operations — they get the key only."""
+        with self._lock:
+            self._listener = (on_add, on_remove)
+
     def _remove(self, key):
         """Drop one entry (called under ``_lock``); returns its
         ``(parent, arrays, nbytes)``."""
@@ -215,6 +392,8 @@ class HostKVPool:
                 self._by_parent[parent] -= 1
                 if not self._by_parent[parent]:
                     del self._by_parent[parent]
+            if self._listener is not None:
+                self._listener[1](key)
             return parent, arrays, nbytes
 
     def _evict_leaf(self):
@@ -259,6 +438,8 @@ class HostKVPool:
             self._entries[key] = (parent, tuple(arrays), nbytes)
             self.bytes_used += nbytes
             self.bytes_peak = max(self.bytes_peak, self.bytes_used)
+            if self._listener is not None:
+                self._listener[0](key)
             return True
 
     def put(self, key, parent, arrays):
@@ -311,6 +492,9 @@ class HostKVPool:
         """Deterministic release of every hosted array (engine
         shutdown rides this alongside its device-buffer deletes)."""
         with self._lock:
+            if self._listener is not None:
+                for key in self._entries:
+                    self._listener[1](key)
             self._entries.clear()
             self._by_parent.clear()
             self.bytes_used = 0
@@ -380,6 +564,12 @@ class BlockManager:
         self.evictions = 0                        # guarded-by: _lock
         self.prefix_hits = 0                      # guarded-by: _lock
         self.prefix_misses = 0                    # guarded-by: _lock
+        # the subset of prefix_hits that resurrected >= 1 refcount-0
+        # block parked in the prefix LRU (vs hits that only shared
+        # blocks another live table already pinned) — what separates
+        # "the park saved us" from "concurrency saved us" in the
+        # cache-route bench
+        self.prefix_resurrections = 0             # guarded-by: _lock
         self.prefix_tokens_saved = 0              # guarded-by: _lock
         self.prefix_evictions = 0                 # guarded-by: _lock
         # tokens whose cached K/V a prefix eviction threw away FOR GOOD
@@ -415,6 +605,20 @@ class BlockManager:
         self._m_restored = telemetry.counter(
             "mxtpu_serve_host_kv_restored_tokens_total",
             "prompt tokens restored host->device instead of recomputed")
+        self._m_resurrections = telemetry.counter(
+            "mxtpu_serve_prefix_resurrections_total",
+            "prefix hits that revived >= 1 block parked refcount-0 "
+            "in the prefix LRU")
+        # the routable-cache advertisement (None with the prefix cache
+        # off — nothing content-addressed to advertise); maintained
+        # incrementally at every publish/unpublish site in BOTH tiers
+        self._summary = (RadixSummary(block_size)
+                         if self.prefix_cache else None)
+        if self._summary is not None and host_pool is not None:
+            host_pool.set_listener(self._summary.add,
+                                   self._summary.remove)
+            for key in host_pool.keys():
+                self._summary.add(key)
 
     def set_offload_source(self, fetch):
         """Register the device→host block extractor the eviction path
@@ -494,6 +698,7 @@ class BlockManager:
                     "max_refcount": max(self._refs.values(), default=0),
                     "hits": self.prefix_hits,
                     "misses": self.prefix_misses,
+                    "resurrections": self.prefix_resurrections,
                     "hit_rate": (round(self.prefix_hits / looked, 4)
                                  if looked else None),
                     "tokens_saved": self.prefix_tokens_saved,
@@ -506,6 +711,15 @@ class BlockManager:
         """The host-tier occupancy snapshot (None without a pool)."""
         with self._lock:
             return None if self.host is None else self.host.stats()
+
+    def summary(self):
+        """The JSON-ready ``RadixSummary`` advertisement the replica
+        publishes on ``/healthz``/``/statusz`` (None with the prefix
+        cache off).  O(m/8) bit-packing, never a tree walk — safe on
+        the scrape path at any cache size."""
+        if self._summary is None:
+            return None
+        return self._summary.snapshot()
 
     def host_tokens(self, rid):
         """Tokens of ``rid``'s current table that were restored from
@@ -746,16 +960,22 @@ class BlockManager:
                     del self._children[parent]
             self._children.pop(key, None)
             self._lru.pop(key, None)
+            if self._summary is not None:
+                self._summary.remove(key)
             return blk
 
     def _ref_hit(self, blk):
         """Take one reference on a cached block: a refcount-0 LRU
         resident leaves the evictable tier the moment a table starts
-        reading it.  Reentrant-locked: callers already hold ``_lock``."""
+        reading it.  Returns whether the block was actually parked in
+        the LRU (a RESURRECTION, as opposed to sharing a block another
+        live table already pins).  Reentrant-locked: callers already
+        hold ``_lock``."""
         with self._lock:
             self._refs[blk] = self._refs.get(blk, 0) + 1
             if self._refs[blk] == 1:
-                self._lru.pop(self._key_of[blk], None)
+                return self._lru.pop(self._key_of[blk], None) is not None
+            return False
 
     def allocate(self, rid, n_tokens, token_ids=None):
         """Create ``rid``'s block table covering ``n_tokens`` slots.
@@ -823,8 +1043,13 @@ class BlockManager:
                     self.host_restored_tokens += \
                         len(claimed) * self.block_size
                     self._m_restored.inc(len(claimed) * self.block_size)
+                resurrected = 0
                 for _, blk in hits:
-                    self._ref_hit(blk)
+                    if self._ref_hit(blk):
+                        resurrected += 1
+                if resurrected:
+                    self.prefix_resurrections += 1
+                    self._m_resurrections.inc()
             n = blocks_for(n_tokens, self.block_size)
             try:
                 fresh = self._take(n - len(hits))
@@ -848,6 +1073,8 @@ class BlockManager:
                 if parent is not None:
                     self._children[parent] = \
                         self._children.get(parent, 0) + 1
+                if self._summary is not None:
+                    self._summary.add(key)
                 self._pending_restores.append((blk, arrays))
             self._tables[rid] = [blk for _, blk in hits] + fresh
             self._lens[rid] = n * self.block_size
@@ -961,6 +1188,8 @@ class BlockManager:
                     if chain:
                         self._children[parent] = \
                             self._children.get(parent, 0) + 1
+                    if self._summary is not None:
+                        self._summary.add(key)
                 chain.append(key)
 
     # -- release -------------------------------------------------------------
@@ -1049,3 +1278,11 @@ class BlockManager:
             # K/V remains valid for the tokens they hash — but restores
             # queued against now-recycled device blocks must not land
             del self._pending_restores[:]
+            # the advertisement rebuilds from the surviving host tier
+            # (reset is rare and operator-driven — never the scrape
+            # path, so the one-off pool walk is fine here)
+            if self._summary is not None:
+                self._summary.clear()
+                if self.host is not None:
+                    for key in self.host.keys():
+                        self._summary.add(key)

@@ -98,6 +98,11 @@ class ServeStats:
     prefill_tokens_computed: int = 0
     prefix_hits: int = 0
     prefix_misses: int = 0
+    # hits whose first reused block was sitting on the evictable LRU
+    # (refcount 0) — reuse that only exists because eviction had not
+    # reached it yet; split out from plain hits so cache-route benches
+    # can tell "still referenced" from "brought back from the brink"
+    prefix_resurrections: int = 0
     prefix_hit_rate: float | None = None
     prefix_tokens_saved: int = 0
     prefix_evictions: int = 0
@@ -440,6 +445,7 @@ class StatsRecorder:
             prefill_tokens_computed=self.prefill_tokens_computed,
             prefix_hits=pfx["hits"],
             prefix_misses=pfx["misses"],
+            prefix_resurrections=pfx.get("resurrections", 0),
             prefix_hit_rate=pfx["hit_rate"],
             prefix_tokens_saved=pfx["tokens_saved"],
             prefix_evictions=pfx["evictions"],

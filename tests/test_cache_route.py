@@ -1,0 +1,483 @@
+"""Cache-aware routing + fleet-global KV fabric (ISSUE 18).
+
+The radix-summary advertisement (counting bloom + top-K exact keys,
+incrementally maintained, size-bounded), the router's affinity plan
+(tokenizer-side chain keys, longest-advertised-ancestor probe, stale
+summaries scoring zero), the byte-inert ``MXTPU_ROUTE_AFFINITY=0``
+contract (identical routing decisions, identical request bytes), the
+keep-alive scrape connection pin, and the peer-to-peer chain pull over
+``/chain_export`` — including the full degradation matrix: bloom false
+positive (empty export), corrupted records, hung peer — every arm
+recomputing instead of erroring and producing byte-identical tokens.
+
+In-process CPU fleets over real engines (the test_fleet.py recipe); the
+measured A/B contract lives in ``tools/fleet_bench.py --workload
+cache-route`` (CACHE_ROUTE_BENCH.json).
+"""
+
+import http.server
+import json
+import math
+import os
+import socket
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu.fleet import ReplicaServer, Router
+from mxnet_tpu.serve import BlockManager
+from mxnet_tpu.serve.kv_block_manager import (RadixSummary, _ROOT,
+                                              _block_key, chain_keys)
+
+VOCAB = 53
+POOL = 1 << 22
+
+
+@pytest.fixture(scope="module")
+def model():
+    S = 96
+    net = mx.models.gpt(VOCAB, S, num_layers=2, d_model=32, num_heads=4)
+    arg_shapes, _, _ = net.infer_shape(data=(1, S), softmax_label=(1, S))
+    rng = np.random.RandomState(3)
+    params = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        scale = 0.35 if name.endswith("weight") else 0.0
+        params[name] = (rng.randn(*shp) * scale
+                        + (1.0 if name.endswith("gamma") else 0.0)
+                        ).astype(np.float32)
+    return net, params
+
+
+def _engine(model, **kw):
+    net, params = model
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("max_prefills_per_step", 2)
+    return mx.serve.Engine(params, symbol=net, **kw)
+
+
+@pytest.fixture
+def fleet_cleanup():
+    items = []
+    yield items
+    for obj in reversed(items):
+        try:
+            obj.stop()
+        except Exception:
+            pass
+
+
+def _reference_tokens(model, prompt, max_new=8):
+    eng = _engine(model)
+    req = eng.submit(np.asarray(prompt, np.int32),
+                     max_new_tokens=max_new)
+    eng.run()
+    assert req.status == "finished"
+    out = list(req.tokens)
+    eng.shutdown()
+    return out
+
+
+def _gen(url, body, timeout=60):
+    req = urllib.request.Request(
+        f"{url}/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _pull_stats(url):
+    with urllib.request.urlopen(f"{url}/statusz.json",
+                                timeout=10) as resp:
+        return (json.loads(resp.read()).get("replica") or {}) \
+            .get("pull") or {}
+
+
+def _prompt(seed, prefix=None, suffix_len=6):
+    rng = np.random.RandomState(seed)
+    body = rng.randint(0, VOCAB, (suffix_len,)).tolist()
+    return list(prefix or []) + body
+
+
+PREFIX = np.random.RandomState(11).randint(0, VOCAB, (20,)).tolist()
+
+
+# -- chain_keys + RadixSummary units ------------------------------------------
+def test_chain_keys_match_block_manager_hash():
+    """The router-side helper derives the SAME content keys the radix
+    index publishes — chaining from the root, COW rule excluding the
+    last span even when block-aligned."""
+    toks = list(range(1, 14))            # 13 tokens, bs=4 -> 3 full
+    keys = chain_keys(toks, 4)
+    assert len(keys) == 3
+    parent = _ROOT
+    for b, key in enumerate(keys):
+        expect = _block_key(parent, np.asarray(toks[b * 4:(b + 1) * 4],
+                                               np.int32))
+        assert key == expect
+        parent = key
+    # block-aligned prompt: the final block is COW (recomputed), so it
+    # never joins the routable chain
+    assert len(chain_keys(list(range(16)), 4)) == 3
+    assert chain_keys([1, 2], 4) == []
+    assert chain_keys(list(range(40)), 4, max_blocks=2) == \
+        chain_keys(list(range(40)), 4)[:2]
+
+
+def test_bloom_fp_rate_below_configured_bound():
+    """Under load (n live keys) the measured false-positive rate stays
+    below the classic bound ``(1 - e^(-kn/m))^k`` with margin.  top_k=0
+    so the exact set cannot mask the bloom."""
+    m, k, n = 4096, 4, 256
+    s = RadixSummary(block_size=4, bloom_bits=m, top_k=0)
+    rng = np.random.RandomState(5)
+    for _ in range(n):
+        s.add(rng.bytes(20))
+    snap = s.snapshot()
+    probes = 4000
+    fps = sum(RadixSummary.match(snap, [rng.bytes(20)])
+              for _ in range(probes))
+    bound = (1.0 - math.exp(-k * n / m)) ** k
+    assert fps / probes <= 2.0 * bound + 1e-3
+    # membership has no false negatives
+    s2 = RadixSummary(block_size=4, bloom_bits=m, top_k=0)
+    keys = [rng.bytes(20) for _ in range(64)]
+    for key in keys:
+        s2.add(key)
+    snap2 = s2.snapshot()
+    assert all(RadixSummary.match(snap2, [key]) for key in keys)
+
+
+def test_counting_bloom_remove_and_bounded_snapshot():
+    """Evictions decrement real counts: add+remove leaves no residue,
+    and the snapshot stays byte-bounded no matter how many keys passed
+    through (the /healthz growth contract)."""
+    s = RadixSummary(block_size=4, bloom_bits=1024, top_k=8)
+    rng = np.random.RandomState(9)
+    keys = [rng.bytes(20) for _ in range(500)]
+    for key in keys:
+        s.add(key)
+    big = len(s.snapshot()["bloom"]["bits"])
+    for key in keys:
+        s.remove(key)
+    snap = s.snapshot()
+    assert snap["keys"] == 0
+    assert snap["top"] == []
+    assert not any(RadixSummary.match(snap, [key]) for key in keys)
+    # bits field is packbits(m)/8 base64 — capacity-independent
+    assert big <= (1024 // 8) * 4 // 3 + 4
+    assert len(snap["top"]) <= 8
+    # malformed snapshots never throw in the router
+    assert RadixSummary.match(None, keys) == 0
+    assert RadixSummary.match({"bloom": {"bits": "!!"}}, keys) == 0
+
+
+def test_resurrection_counter_split():
+    """A hit whose first reused block sat on the evictable LRU
+    (refcount 0) counts as a resurrection; a hit on a still-referenced
+    chain does not.  Both remain plain hits."""
+    m = BlockManager(num_blocks=16, block_size=4, prefix_cache=True)
+    toks = np.arange(1, 14, dtype=np.int32)        # 3 publishable
+    m.allocate("a", len(toks), token_ids=toks)
+    m.note_tokens("a", toks)
+    m.free("a")                                    # chain -> LRU
+    _, cached = m.allocate("b", len(toks), token_ids=toks)
+    assert cached == 12
+    st = m.prefix_stats()
+    assert st["hits"] == 1 and st["resurrections"] == 1
+    # "b" still holds the chain: the next hit is NOT a resurrection
+    _, cached2 = m.allocate("c", len(toks), token_ids=toks)
+    assert cached2 == 12
+    st = m.prefix_stats()
+    assert st["hits"] == 2 and st["resurrections"] == 1
+
+
+def test_summary_tracks_publish_and_evict():
+    """The advertised summary follows the radix index incrementally:
+    publishes appear, unpublishes disappear, reset clears."""
+    m = BlockManager(num_blocks=16, block_size=4, prefix_cache=True)
+    toks = np.arange(1, 14, dtype=np.int32)
+    keys = chain_keys(toks.tolist(), 4)
+    assert m.summary()["keys"] == 0
+    m.allocate("a", len(toks), token_ids=toks)
+    m.note_tokens("a", toks)
+    snap = m.summary()
+    assert snap["keys"] == 3
+    assert RadixSummary.match(snap, keys) == 3
+    m.free("a")
+    m.reset()
+    snap = m.summary()
+    assert snap["keys"] == 0
+    assert RadixSummary.match(snap, keys) == 0
+
+
+# -- keep-alive scrape (satellite: connection churn pin) ----------------------
+def test_scrape_reuses_one_connection(model, fleet_cleanup):
+    """N scrape passes ride ONE persistent keep-alive connection per
+    replica — the regression pin for the per-poll TCP connect churn."""
+    rep = ReplicaServer(_engine(model), replica_id="ka").start()
+    fleet_cleanup.append(rep)
+    router = Router([rep.url], scrape_interval_s=0, timeout_s=10)
+    fleet_cleanup.append(router)
+    for _ in range(8):
+        router.scrape()
+    (state,) = router.replicas()
+    assert state.state == "ready"
+    assert state.connects == 1
+    assert state.conn is not None
+
+
+# -- affinity routing ---------------------------------------------------------
+def test_affinity_pins_returning_user(model, fleet_cleanup):
+    """With affinity on, a returning user's requests pin to the
+    replica advertising their prefix chain instead of round-robining
+    across equally-idle siblings."""
+    reps = [ReplicaServer(_engine(model), replica_id=f"r{i}").start()
+            for i in range(2)]
+    fleet_cleanup.extend(reps)
+    router = Router([r.url for r in reps], scrape_interval_s=0,
+                    timeout_s=30, retries=3, backoff_s=0.01,
+                    backoff_max_s=0.05, affinity=1.0, pull=False)
+    fleet_cleanup.append(router)
+    router.scrape()
+    first = router.generate(_prompt(1, PREFIX), max_new_tokens=4)
+    router.scrape()                      # pick up the new summary
+    plan = router._affinity_plan(_prompt(2, PREFIX))
+    assert plan is not None
+    assert plan["best"]["name"] == first.replica
+    assert plan["best"]["tokens"] >= 16
+    for seed in range(2, 6):
+        res = router.generate(_prompt(seed, PREFIX), max_new_tokens=4)
+        assert res.replica == first.replica
+        router.scrape()
+
+
+def test_affinity_zero_is_decision_inert(model, fleet_cleanup):
+    """MXTPU_ROUTE_AFFINITY=0 (the default): same routing decisions as
+    the pre-affinity router — pure least-loaded with round-robin
+    tiebreak — even when summaries advertise a warm replica, and no
+    request ever carries a kv_pull hint."""
+    reps = [ReplicaServer(_engine(model), replica_id=f"z{i}").start()
+            for i in range(2)]
+    fleet_cleanup.extend(reps)
+    router = Router([r.url for r in reps], scrape_interval_s=0,
+                    timeout_s=30, retries=3, backoff_s=0.01,
+                    backoff_max_s=0.05)
+    fleet_cleanup.append(router)
+    assert router.affinity == 0.0
+    router.scrape()
+    served = []
+    for seed in range(4):
+        res = router.generate(_prompt(seed, PREFIX), max_new_tokens=4)
+        served.append(res.replica)
+        router.scrape()
+    # idle fleet + zero affinity = strict round-robin alternation (the
+    # warm replica earns no pull): byte-inert routing decisions
+    assert served == ["z0", "z1", "z0", "z1"]
+    for rep in reps:
+        pull = _pull_stats(rep.url)
+        assert pull["attempts"] == 0 and pull["chain_exports"] == 0
+
+
+def test_stale_summary_scores_zero_affinity(model, fleet_cleanup):
+    """A summary past the age cap contributes no affinity: the plan
+    comes back empty and routing degrades to least-loaded."""
+    rep = ReplicaServer(_engine(model), replica_id="st").start()
+    fleet_cleanup.append(rep)
+    router = Router([rep.url], scrape_interval_s=0, timeout_s=30,
+                    retries=3, backoff_s=0.01, backoff_max_s=0.05,
+                    affinity=1.0, summary_stale=3.0)
+    fleet_cleanup.append(router)
+    router.scrape()
+    router.generate(_prompt(1, PREFIX), max_new_tokens=4)
+    router.scrape()
+    prompt = _prompt(2, PREFIX)
+    assert router._affinity_plan(prompt) is not None
+    # age the advertisement past summary_stale * max(interval, 1s)
+    (state,) = router.replicas()
+    state.summary_t -= 3.0 * 1.0 + 0.5
+    assert router._affinity_plan(prompt) is None
+
+
+# -- peer-to-peer chain pull --------------------------------------------------
+def test_pull_imports_chain_token_identical(model, fleet_cleanup):
+    """The happy path: a cold replica handed a kv_pull hint imports
+    the peer's chain over /chain_export (sha1 + chain-hash verified
+    into the host tier) and serves byte-identical tokens."""
+    warm = ReplicaServer(_engine(model, host_kv_bytes=POOL),
+                         replica_id="warm").start()
+    cold = ReplicaServer(_engine(model, host_kv_bytes=POOL),
+                         replica_id="cold").start()
+    fleet_cleanup.extend([warm, cold])
+    prompt = _prompt(21, PREFIX)
+    ref = _reference_tokens(model, prompt)
+    first = _gen(warm.url, {"prompt": prompt, "max_new_tokens": 8,
+                            "request_id": "w1"})
+    assert first["tokens"] == ref
+    pulled = _gen(cold.url, {"prompt": prompt, "max_new_tokens": 8,
+                             "request_id": "c1",
+                             "kv_pull": {"peer": warm.url,
+                                         "tokens": 16}})
+    assert pulled["tokens"] == ref
+    pull = _pull_stats(cold.url)
+    assert pull["attempts"] == 1
+    assert pull["blocks_imported"] >= 4
+    assert pull["failures"] == 0 and pull["false_positives"] == 0
+    assert pull["bytes_received"] > 0
+    exp = _pull_stats(warm.url)
+    assert exp["chain_exports"] == 1
+    assert exp["chain_export_blocks"] >= 4
+
+
+def test_pull_false_positive_degrades_to_recompute(model,
+                                                   fleet_cleanup):
+    """A bloom FP sends the puller to a peer that has nothing: the
+    export comes back empty, the replica recomputes, tokens exact."""
+    peer = ReplicaServer(_engine(model, host_kv_bytes=POOL),
+                         replica_id="fp-peer").start()
+    rep = ReplicaServer(_engine(model, host_kv_bytes=POOL),
+                        replica_id="fp").start()
+    fleet_cleanup.extend([peer, rep])
+    prompt = _prompt(22, PREFIX)
+    res = _gen(rep.url, {"prompt": prompt, "max_new_tokens": 8,
+                         "kv_pull": {"peer": peer.url, "tokens": 16}})
+    assert res["tokens"] == _reference_tokens(model, prompt)
+    pull = _pull_stats(rep.url)
+    assert pull["attempts"] == 1 and pull["false_positives"] == 1
+    assert pull["failures"] == 0 and pull["blocks_imported"] == 0
+
+
+def test_pull_corruption_degrades_to_recompute(model, fleet_cleanup):
+    """A peer answering garbage (bad digest / truncated records) never
+    corrupts the puller: the import rejects, the request recomputes,
+    tokens stay exact and the failure is counted."""
+    class _EvilPeer(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            body = json.dumps({"replica": "evil", "records": [
+                {"key": "00" * 8, "parent": "11" * 8,
+                 "tokens": [1, 2, 3, 4], "k": "AAAA", "v": "AAAA",
+                 "digest": "feedfacefeedface"}]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _EvilPeer)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    rep = ReplicaServer(_engine(model, host_kv_bytes=POOL),
+                        replica_id="corrupt").start()
+    fleet_cleanup.append(rep)
+    try:
+        prompt = _prompt(23, PREFIX)
+        res = _gen(rep.url, {
+            "prompt": prompt, "max_new_tokens": 8,
+            "kv_pull": {"peer":
+                        f"http://127.0.0.1:{srv.server_address[1]}",
+                        "tokens": 16}})
+        assert res["tokens"] == _reference_tokens(model, prompt)
+        pull = _pull_stats(rep.url)
+        assert pull["attempts"] == 1 and pull["failures"] == 1
+        assert pull["blocks_imported"] == 0
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_pull_timeout_degrades_to_recompute(model, fleet_cleanup,
+                                            monkeypatch):
+    """A hung peer burns only MXTPU_ROUTE_PULL_TIMEOUT, then the
+    request recomputes — the serving path never wedges on the fabric."""
+    hole = socket.socket()
+    hole.bind(("127.0.0.1", 0))
+    hole.listen(4)                       # accepts, never answers
+    monkeypatch.setenv("MXTPU_ROUTE_PULL_TIMEOUT", "0.3")
+    rep = ReplicaServer(_engine(model, host_kv_bytes=POOL),
+                        replica_id="hang").start()
+    fleet_cleanup.append(rep)
+    try:
+        prompt = _prompt(24, PREFIX)
+        res = _gen(rep.url, {
+            "prompt": prompt, "max_new_tokens": 8,
+            "kv_pull": {"peer":
+                        f"http://127.0.0.1:{hole.getsockname()[1]}",
+                        "tokens": 16}})
+        assert res["tokens"] == _reference_tokens(model, prompt)
+        pull = _pull_stats(rep.url)
+        assert pull["attempts"] == 1 and pull["failures"] == 1
+    finally:
+        hole.close()
+
+
+def test_pull_skipped_when_already_warm(model, fleet_cleanup):
+    """A hint naming a span the replica already caches locally is a
+    no-op — no probe, no wire bytes (the only-when-beneficial rule)."""
+    rep = ReplicaServer(_engine(model, host_kv_bytes=POOL),
+                        replica_id="selfwarm").start()
+    fleet_cleanup.append(rep)
+    prompt = _prompt(25, PREFIX)
+    _gen(rep.url, {"prompt": prompt, "max_new_tokens": 8})
+    _gen(rep.url, {"prompt": _prompt(26, PREFIX), "max_new_tokens": 8,
+                   "kv_pull": {"peer": "http://127.0.0.1:9",
+                               "tokens": 16}})
+    assert _pull_stats(rep.url)["attempts"] == 0
+
+
+def test_chain_export_rejects_bad_prompt(model, fleet_cleanup):
+    rep = ReplicaServer(_engine(model, host_kv_bytes=POOL),
+                        replica_id="val").start()
+    fleet_cleanup.append(rep)
+    req = urllib.request.Request(
+        f"{rep.url}/chain_export",
+        data=json.dumps({"prompt": "nope"}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+
+
+def test_router_attaches_pull_hint_end_to_end(model, fleet_cleanup):
+    """Full loop: user warms replica A through the router; the router
+    is then forced onto replica B (A excluded by load), attaches the
+    kv_pull hint, and B imports A's chain before serving."""
+    reps = [ReplicaServer(_engine(model, host_kv_bytes=POOL),
+                          replica_id=f"p{i}").start()
+            for i in range(2)]
+    fleet_cleanup.extend(reps)
+    router = Router([r.url for r in reps], scrape_interval_s=0,
+                    timeout_s=30, retries=3, backoff_s=0.01,
+                    backoff_max_s=0.05, affinity=1.0, pull=True)
+    fleet_cleanup.append(router)
+    router.scrape()
+    first = router.generate(_prompt(31, PREFIX), max_new_tokens=4)
+    router.scrape()
+    warm = next(r for r in reps if r.replica_id == first.replica)
+    other = next(r for r in reps if r.replica_id != first.replica)
+    # make the warm replica look saturated so load beats affinity and
+    # the pick lands on the cold sibling WITH a pull hint
+    with router._lock:
+        for state in router._replicas:
+            if state.name == first.replica:
+                state.load = 50.0
+    ref = _reference_tokens(model, _prompt(32, PREFIX), max_new=4)
+    res = router.generate(_prompt(32, PREFIX), max_new_tokens=4)
+    assert res.replica == other.replica_id
+    assert list(res.tokens) == ref
+    assert _pull_stats(other.url)["attempts"] == 1
+    assert _pull_stats(other.url)["blocks_imported"] >= 4
+    assert _pull_stats(warm.url)["chain_exports"] == 1

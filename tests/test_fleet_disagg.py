@@ -599,7 +599,15 @@ def test_role_unset_is_inert_schema(model, fleet_cleanup):
     legacy = {"status", "state", "in_flight", "queue_depth", "running",
               "host_kv_utilization"}
     assert legacy <= set(hz)
-    assert set(hz) - legacy == {"role", "waiting_handoffs"}
+    # the documented additive fields: the disaggregation role/load
+    # signals plus the (size-bounded) routable-cache advertisement
+    assert set(hz) - legacy == {"role", "waiting_handoffs",
+                                "kv_summary"}
+    # the advertisement stays bounded: bloom bitmap of m/8 bytes plus
+    # at most top_k truncated-hex keys, whatever the cache holds
+    ks = hz["kv_summary"]
+    assert ks["bloom"]["m"] // 8 >= len(ks["bloom"]["bits"]) * 3 // 4 - 3
+    assert len(ks["top"]) <= 32
 
 
 # -- process-fleet A/B contract (slow tier) -----------------------------------

@@ -415,6 +415,37 @@ def run_fleet_autoscale_stage(timeout=900):
         timeout)
 
 
+def run_fleet_cache_route_stage(timeout=900):
+    """Cache-aware routing artifact (tools/fleet_bench.py --workload
+    cache-route): the returning-users A/B — affinity routing + p2p
+    chain pull vs the byte-inert least-loaded baseline, one replica
+    killed mid-run.  Contract: complete:true, tokens byte-identical
+    across arms, fleet prefix hit rate >= 2x the baseline's, and
+    availability 1.0 through the kill.  CPU-only like the other fleet
+    stages (replica subprocesses), so it runs ahead of the probe."""
+    def gate(p):
+        aff = (p.get("affinity") or {}).get("availability")
+        base = (p.get("baseline") or {}).get("availability")
+        if not p.get("complete") or not p.get("tokens_identical") \
+                or (p.get("hit_rate_improvement") or 0) < 2 \
+                or aff != 1.0 or base != 1.0:
+            return (f"complete={p.get('complete')}, "
+                    f"identical={p.get('tokens_identical')}, "
+                    f"improvement={p.get('hit_rate_improvement')}, "
+                    f"availability={base}/{aff}")
+        return None
+
+    return _run_fleet_artifact(
+        "fleet_cache_route", ["--workload", "cache-route"],
+        "CACHE_ROUTE_BENCH.json", gate,
+        lambda p: (f"hit rate {p.get('hit_rate_baseline')} -> "
+                   f"{p.get('hit_rate_affinity')} "
+                   f"({p.get('hit_rate_improvement')}x), "
+                   f"pulled {p.get('pull_demo', {}).get('blocks_imported')} "
+                   f"block(s)"),
+        timeout)
+
+
 def run_bandwidth(timeout=1200):
     return run_json_artifact(
         "bandwidth",
@@ -822,6 +853,7 @@ def main():
     # the headline benches, then the new r5 records, then the long tail
     done = {"lint": False, "fleet": False, "fleet_disagg": False,
             "fleet_obs": False, "fleet_autoscale": False,
+            "fleet_cache_route": False,
             "consistency": False, "flash": False, "rnn": False,
             "resnet": False, "resnet256": False, "gpt": False,
             "longcontext": False, "bandwidth": False, "cifar": False,
@@ -906,6 +938,16 @@ def main():
             done["fleet_autoscale"] = attempt(
                 "fleet_autoscale",
                 lambda: run_fleet_autoscale_stage(
+                    timeout=min(900, left)))
+        # cache-aware routing A/B (affinity + p2p pull vs least-
+        # loaded): CPU-only replica subprocesses, probe-free too
+        if not done["fleet_cache_route"]:
+            left = deadline - time.monotonic()
+            if left < 120:
+                continue
+            done["fleet_cache_route"] = attempt(
+                "fleet_cache_route",
+                lambda: run_fleet_cache_route_stage(
                     timeout=min(900, left)))
         if not probe():
             log("TPU unreachable; retrying in 60s")

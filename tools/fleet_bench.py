@@ -72,6 +72,19 @@ byte-identical on the canary set, while light load keeps flowing
 (availability 1.0 across every phase; the router retries around both
 the kill and the drains).
 
+``--workload cache-route`` runs the cache-aware-routing A/B
+(CACHE_ROUTE_BENCH.json, the bench_watch ``fleet_cache_route`` stage):
+the same returning-users order (distinct multi-block prefix per user,
+shuffled arrivals) through (arm A) a least-loaded fleet with
+``MXTPU_ROUTE_AFFINITY=0`` — the byte-inert baseline — and (arm B) the
+cache-aware fleet: replicas advertise radix summaries, the router
+scores ``affinity x cached-fraction - load`` and attaches ``kv_pull``
+hints, one replica hard-killed mid-run.  Gates: fleet prefix hit rate
+at least 2x the baseline's, prefill FLOPs (perf-attribution cost
+tables) no higher, availability 1.0 through the kill, tokens
+byte-identical across arms, and a directed two-replica pull demo
+importing a chain over ``/chain_export`` token-identically.
+
 Usage: python tools/fleet_bench.py [--json OUT] [--replicas 3]
            [--requests 24 --rate 8 --max-new 16 --kill-at 4]
        python tools/fleet_bench.py --disagg [--json OUT]
@@ -766,6 +779,311 @@ def run_autoscale(args):
     return 0 if out["complete"] else 1
 
 
+def _cache_route_order(args):
+    """Returning-users workload: ``route_users`` users, each with a
+    distinct multi-block prefix, each sending one request per round
+    with a fresh suffix.  Per-round arrival order is shuffled (fixed
+    seed) so a least-loaded router's round-robin tiebreak cannot
+    accidentally pin a user to one replica — the baseline arm must
+    earn its hit rate, not inherit it from arrival phase.  Returns the
+    flat [(user, prompt), ...] list BOTH arms replay identically."""
+    import numpy as np
+
+    rng = np.random.RandomState(args.seed + 11)
+    prefixes = [rng.randint(1, args.vocab,
+                            size=args.route_prefix_len).tolist()
+                for _ in range(args.route_users)]
+    order = []
+    for _ in range(args.route_rounds):
+        users = list(range(args.route_users))
+        rng.shuffle(users)
+        for u in users:
+            suffix = rng.randint(1, args.vocab,
+                                 size=args.route_suffix_len).tolist()
+            order.append((u, prefixes[u] + suffix))
+    return prefixes, order
+
+
+def _scrape_route_stats(handles):
+    """Sum prefix-cache / pull counters and prefill FLOPs across the
+    fleet's /statusz.json snapshots; also returns the per-replica rows
+    the payload keeps for attribution."""
+    import urllib.request
+
+    agg = {"prefix_hits": 0, "prefix_misses": 0,
+           "prefix_resurrections": 0, "prefix_tokens_saved": 0,
+           "prefill_tokens_computed": 0, "prefill_flops": 0,
+           "pull_attempts": 0, "pull_blocks_imported": 0,
+           "pull_blocks_rejected": 0, "pull_false_positives": 0,
+           "pull_failures": 0, "chain_exports": 0}
+    rows = []
+    for h in handles:
+        if h is None or not h.url:
+            continue
+        try:
+            with urllib.request.urlopen(f"{h.url}/statusz.json",
+                                        timeout=10) as resp:
+                snap = json.loads(resp.read())
+        except (OSError, ValueError):
+            continue
+        sec = snap.get("replica") or {}
+        stats = sec.get("stats") or {}
+        pull = sec.get("pull") or {}
+        summary = sec.get("kv_summary") or {}
+        # per-program cost table (PR's perf-attribution plane): the
+        # prefill FLOPs the arm actually dispatched — the compute the
+        # cache-aware arm exists to not spend
+        flops = 0
+        for name, section in snap.items():
+            if not (isinstance(section, dict)
+                    and name.startswith("serve")):
+                continue
+            for prog in (section.get("perf") or {}).get("programs", []):
+                if "prefill" in str(prog.get("kind", "")) \
+                        and prog.get("flops"):
+                    flops += int(prog["flops"]) * int(
+                        prog.get("dispatches") or 0)
+        row = {"replica": sec.get("replica"),
+               "prefix_hits": int(stats.get("prefix_hits") or 0),
+               "prefix_misses": int(stats.get("prefix_misses") or 0),
+               "prefix_resurrections":
+                   int(stats.get("prefix_resurrections") or 0),
+               "prefix_tokens_saved":
+                   int(stats.get("prefix_tokens_saved") or 0),
+               "prefill_tokens_computed":
+                   int(stats.get("prefill_tokens_computed") or 0),
+               "prefill_flops": flops,
+               "summary_keys": int(summary.get("keys") or 0),
+               "pull": {k: int(v) for k, v in pull.items()}}
+        rows.append(row)
+        for k in ("prefix_hits", "prefix_misses",
+                  "prefix_resurrections", "prefix_tokens_saved",
+                  "prefill_tokens_computed", "prefill_flops"):
+            agg[k] += row[k]
+        for k, v in pull.items():
+            if f"pull_{k}" in agg:
+                agg[f"pull_{k}"] += int(v)
+        agg["chain_exports"] += int(pull.get("chain_exports") or 0)
+    hm = agg["prefix_hits"] + agg["prefix_misses"]
+    agg["fleet_hit_rate"] = (round(agg["prefix_hits"] / hm, 4)
+                             if hm else None)
+    return agg, rows
+
+
+def _run_cache_route_arm(args, tag, order, affinity, kill_at=0):
+    """One cache-route arm: a role='both' fleet with the host-KV tier
+    on, the shared returning-users order driven round by round (a beat
+    between rounds lets the router's scrape pick up fresh summaries),
+    prefix/pull counters scraped before teardown."""
+    spec_armed = {1: False}
+
+    def spawn(slot):
+        env = dict(os.environ)
+        env.pop("MXTPU_FAULT_SPEC", None)
+        if slot == 1 and kill_at and not spec_armed[1]:
+            # first life only: the crash-restart replacement (cache
+            # cold — exactly what the pull path exists for) must come
+            # back clean
+            spec_armed[1] = True
+            env["MXTPU_FAULT_SPEC"] = f"kill@{kill_at}"
+        handle = ProcessReplica(
+            replica_command(extra_args=[
+                "--backend", "cpu", "--seed", str(args.seed),
+                "--vocab", str(args.vocab), "--warmup", "full",
+                "--num-blocks", str(args.route_num_blocks),
+                "--host-kv-bytes", str(args.route_host_kv_bytes)]),
+            env=env)
+        handle.wait_ready(timeout_s=240)
+        return handle
+
+    router = Router([], scrape_interval_s=0.2, timeout_s=60.0,
+                    retries=4, backoff_s=0.05, backoff_max_s=0.5,
+                    breaker_fails=3, breaker_reset_s=2.0,
+                    affinity=affinity, pull=affinity > 0)
+    sup = Supervisor(spawn, args.route_replicas, router=router,
+                     restart_backoff_s=0.2)
+    results, failures = {}, {}
+    lock = threading.Lock()
+
+    def one(idx, prompt):
+        try:
+            res = router.generate(prompt,
+                                  max_new_tokens=args.route_new,
+                                  request_id=f"{tag}-{idx}",
+                                  trace_id=f"{tag}-trace-{idx}")
+            with lock:
+                results[idx] = res
+        except Exception as e:
+            with lock:
+                failures[idx] = f"{type(e).__name__}: {e}"
+
+    try:
+        sup.start()
+        router.scrape()
+        router.start()
+        sup.run(interval_s=0.25)
+        per_round = args.route_users
+        for start in range(0, len(order), per_round):
+            threads = []
+            for idx in range(start, min(start + per_round, len(order))):
+                th = threading.Thread(target=one,
+                                      args=(idx, order[idx][1]),
+                                      daemon=True)
+                th.start()
+                threads.append(th)
+                time.sleep(0.02)
+            for th in threads:
+                th.join(timeout=180)
+            # two scrape periods: published blocks must reach the
+            # router's summary view before the users come back
+            time.sleep(0.5)
+        agg, rows = _scrape_route_stats(sup.handles())
+        urls = [h.url for h in sup.handles()
+                if h is not None and h.url]
+    finally:
+        router.stop()
+        sup.stop()
+    n = len(order)
+    return {"affinity": affinity, "submitted": n,
+            "completed": len(results),
+            "availability": round(len(results) / max(1, n), 4),
+            "failures": dict(list(failures.items())[:5]),
+            "tokens": {i: results[i].tokens for i in results},
+            "replica_of": {i: results[i].replica for i in results},
+            "retried_requests": sum(1 for r in results.values()
+                                    if r.attempts > 1),
+            "stats": agg, "replicas": rows, "urls": urls}
+
+
+def _cache_route_pull_demo(args, prefixes):
+    """Directed p2p-pull check: serve one user's prompt on replica A
+    (publishing its chain), then hand replica B the same prompt WITH a
+    ``kv_pull`` hint naming A — B must import the chain over
+    /chain_export (sha1 + chain-hash verified) and produce the exact
+    tokens A produces.  Returns the payload section."""
+    import urllib.request
+
+    import numpy as np
+
+    def spawn(slot):
+        env = dict(os.environ)
+        env.pop("MXTPU_FAULT_SPEC", None)
+        handle = ProcessReplica(
+            replica_command(extra_args=[
+                "--backend", "cpu", "--seed", str(args.seed),
+                "--vocab", str(args.vocab), "--warmup", "full",
+                "--num-blocks", str(args.route_num_blocks),
+                "--host-kv-bytes", str(args.route_host_kv_bytes)]),
+            env=env)
+        handle.wait_ready(timeout_s=240)
+        return handle
+
+    rng = np.random.RandomState(args.seed + 13)
+    prompt = prefixes[0] + rng.randint(
+        1, args.vocab, size=args.route_suffix_len).tolist()
+
+    def gen(url, body):
+        req = urllib.request.Request(
+            f"{url}/generate", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return json.loads(resp.read())
+
+    sup = Supervisor(spawn, 2)
+    try:
+        sup.start()
+        a, b = (h.url for h in sup.handles())
+        warm = gen(a, {"prompt": prompt,
+                       "max_new_tokens": args.route_new,
+                       "request_id": "pull-demo-warm"})
+        pulled = gen(b, {"prompt": prompt,
+                         "max_new_tokens": args.route_new,
+                         "request_id": "pull-demo-cold",
+                         "kv_pull": {"peer": a,
+                                     "tokens": args.route_prefix_len}})
+        with urllib.request.urlopen(f"{b}/statusz.json",
+                                    timeout=10) as resp:
+            pull = (json.loads(resp.read()).get("replica")
+                    or {}).get("pull") or {}
+    finally:
+        sup.stop()
+    return {"tokens_identical": warm["tokens"] == pulled["tokens"],
+            "blocks_imported": int(pull.get("blocks_imported") or 0),
+            "blocks_rejected": int(pull.get("blocks_rejected") or 0),
+            "bytes_received": int(pull.get("bytes_received") or 0),
+            "failures": int(pull.get("failures") or 0)}
+
+
+def run_cache_route(args):
+    """The --workload cache-route A/B -> CACHE_ROUTE_BENCH.json: the
+    same returning-users order through a least-loaded fleet
+    (affinity=0, the byte-inert baseline) and a cache-aware fleet
+    (affinity routing + p2p pull) with one mid-run replica kill — the
+    cache-aware arm must at least double the fleet prefix hit rate,
+    spend fewer prefill FLOPs, keep availability 1.0 through the kill,
+    and produce byte-identical tokens."""
+    prefixes, order = _cache_route_order(args)
+    out = {"platform": "cpu", "mode": "cache-route",
+           "replicas": args.route_replicas,
+           "users": args.route_users, "rounds": args.route_rounds,
+           "prefix_len": args.route_prefix_len,
+           "suffix_len": args.route_suffix_len,
+           "requests": len(order),
+           "kill_spec": (f"kill@{args.route_kill_at}"
+                         if args.route_kill_at else None),
+           "complete": False}
+
+    def flush():
+        if args.json:
+            tmp = args.json + ".wip"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(out) + "\n")
+            os.replace(tmp, args.json)
+
+    flush()
+    base = _run_cache_route_arm(args, "route-base", order, affinity=0.0)
+    out["baseline"] = {k: v for k, v in base.items()
+                       if k not in ("tokens", "replica_of", "urls")}
+    flush()
+    aff = _run_cache_route_arm(args, "route-aff", order,
+                               affinity=args.route_affinity,
+                               kill_at=args.route_kill_at)
+    out["affinity"] = {k: v for k, v in aff.items()
+                       if k not in ("tokens", "replica_of", "urls")}
+    identical = (set(base["tokens"]) == set(aff["tokens"])
+                 and all(base["tokens"][i] == aff["tokens"][i]
+                         for i in base["tokens"]))
+    out["tokens_identical"] = identical
+    hr_b = base["stats"]["fleet_hit_rate"] or 0.0
+    hr_a = aff["stats"]["fleet_hit_rate"] or 0.0
+    out["hit_rate_baseline"] = hr_b
+    out["hit_rate_affinity"] = hr_a
+    out["hit_rate_improvement"] = (round(hr_a / hr_b, 2) if hr_b
+                                   else None)
+    fb = base["stats"]["prefill_flops"]
+    fa = aff["stats"]["prefill_flops"]
+    out["prefill_flops_baseline"] = fb
+    out["prefill_flops_affinity"] = fa
+    out["prefill_flops_ratio"] = round(fa / fb, 4) if fb else None
+    out["prefill_tokens_computed_baseline"] = \
+        base["stats"]["prefill_tokens_computed"]
+    out["prefill_tokens_computed_affinity"] = \
+        aff["stats"]["prefill_tokens_computed"]
+    out["pull_demo"] = _cache_route_pull_demo(args, prefixes)
+    out["complete"] = bool(
+        base["availability"] == 1.0 and aff["availability"] == 1.0
+        and identical
+        and hr_b > 0 and hr_a >= 2.0 * hr_b
+        and out["prefill_tokens_computed_affinity"]
+        <= out["prefill_tokens_computed_baseline"]
+        and out["pull_demo"]["tokens_identical"]
+        and out["pull_demo"]["blocks_imported"] > 0
+        and out["pull_demo"]["failures"] == 0)
+    flush()
+    print(json.dumps(out))
+    return 0 if out["complete"] else 1
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--replicas", type=int, default=3)
@@ -836,10 +1154,13 @@ def main():
                    help="min tok/s ratio (collector-on / off) the "
                         "contract accepts — CPU smoke noise is large")
     # -- fleet control plane smoke (AUTOSCALE_BENCH.json) --------------
-    p.add_argument("--workload", default=None, choices=["autoscale"],
+    p.add_argument("--workload", default=None,
+                   choices=["autoscale", "cache-route"],
                    help="'autoscale' runs the control-plane smoke "
                         "(autoscaler grow/shrink + kill-armed deploy "
-                        "rollback) instead")
+                        "rollback) instead; 'cache-route' runs the "
+                        "cache-aware-routing A/B (affinity + p2p pull "
+                        "vs least-loaded) -> CACHE_ROUTE_BENCH.json")
     p.add_argument("--autoscale-spec",
                    default="both=2:4;up_queue=1.5;down_idle_s=4;"
                            "cooldown_s=2",
@@ -853,6 +1174,37 @@ def main():
     p.add_argument("--rollout-requests", type=int, default=8,
                    help="light-load requests riding the deploy phase")
     p.add_argument("--rollout-rate", type=float, default=2.0)
+    # -- cache-aware routing A/B (CACHE_ROUTE_BENCH.json) --------------
+    p.add_argument("--route-replicas", type=int, default=4)
+    p.add_argument("--route-users", type=int, default=8,
+                   help="returning users, each owning one multi-block "
+                        "prefix the affinity router should pin")
+    p.add_argument("--route-rounds", type=int, default=6,
+                   help="times each user comes back (round 1 is cold)")
+    p.add_argument("--route-prefix-len", type=int, default=48,
+                   help="per-user shared-prefix tokens (must span "
+                        "several KV blocks to exercise the chain)")
+    p.add_argument("--route-suffix-len", type=int, default=8,
+                   help="fresh per-request suffix tokens")
+    p.add_argument("--route-new", type=int, default=8)
+    p.add_argument("--route-affinity", type=float, default=1.0,
+                   help="MXTPU_ROUTE_AFFINITY weight of the cache-"
+                        "aware arm (the baseline arm always runs 0)")
+    p.add_argument("--route-kill-at", type=int, default=3,
+                   help="kill@K armed on slot 1's first life in the "
+                        "cache-aware arm (0 disables the chaos)")
+    p.add_argument("--route-num-blocks", type=int, default=24,
+                   help="device KV blocks per replica — sized so only "
+                        "~2 users' chains stay cached: the baseline "
+                        "arm churns the LRU while the affinity arm's "
+                        "pinning retains (an uncapacitated cache lets "
+                        "every replica eventually hold every prefix, "
+                        "which flatters the least-loaded baseline)")
+    p.add_argument("--route-host-kv-bytes", type=int, default=16 << 10,
+                   help="host-DRAM KV tier per replica — the landing "
+                        "zone for pulled chains; kept as tight as the "
+                        "device tier so it cannot quietly hold the "
+                        "whole working set either")
     args = p.parse_args()
 
     if args.disagg:
@@ -861,6 +1213,8 @@ def main():
         return run_obs(args)
     if args.workload == "autoscale":
         return run_autoscale(args)
+    if args.workload == "cache-route":
+        return run_cache_route(args)
 
     import numpy as np
 

@@ -75,11 +75,20 @@ def render(view):
             f"{_fmt(a.get('achieved_tflops'), 2):>7}")
     lines.append("")
 
+    # AFFINITY = radix-summary keys the replica currently advertises to
+    # the router (its routable cache surface); HITS = prefix hits, with
+    # resurrections (reuse rescued off the eviction LRU) after "+"
     lines.append(f"{'REPLICA':<24} {'ROLE':<8} {'STATE':<9} "
                  f"{'VERSION':<14} "
                  f"{'STALE':>5} {'FAILS':>5} {'QUEUE':>5} {'RUN':>4} "
-                 f"{'TOK/S':>8} {'TTFT_P99':>9} {'TPOT_P99':>9}")
+                 f"{'TOK/S':>8} {'TTFT_P99':>9} {'TPOT_P99':>9} "
+                 f"{'AFFINITY':>8} {'HITS':>9} {'PULLS':>5}")
     for r in view.get("replicas") or []:
+        hits = r.get("prefix_hits")
+        if hits is None:
+            hits_s = "-"
+        else:
+            hits_s = f"{int(hits)}+{int(r.get('prefix_resurrections') or 0)}"
         lines.append(
             f"{str(r.get('replica'))[:24]:<24} "
             f"{str(r.get('role')):<8} {str(r.get('state'))[:9]:<9} "
@@ -89,7 +98,10 @@ def render(view):
             f"{r.get('queue_depth', 0):>5} {r.get('running', 0):>4} "
             f"{_fmt(r.get('tok_per_sec')):>8} "
             f"{_fmt(r.get('ttft_ms_p99')):>9} "
-            f"{_fmt(r.get('tpot_ms_p99')):>9}")
+            f"{_fmt(r.get('tpot_ms_p99')):>9} "
+            f"{_fmt(r.get('summary_keys')):>8} "
+            f"{hits_s:>9} "
+            f"{_fmt(r.get('pull_attempts')):>5}")
 
     slo = view.get("slo")
     if slo:

@@ -143,7 +143,13 @@ def stitch(traces):
     ``queue_full`` on replica A followed by ``finished`` on replica B.
 
     Returns ``{"requests": distinct ids, "multi_hop": ids with > 1
-    line, "max_hops": ..., "unresolved": ids where no hop finished}``.
+    line, "max_hops": ..., "unresolved": ids where no hop finished,
+    "hops": {trace_id: [hop, ...]}}``.  Each hop names the replica
+    whose engine ran it plus its ``cached_tokens`` — the prompt prefix
+    that replica reused from its radix cache instead of recomputing
+    (the engine stamps it on ``prefill_start``; a router-side line has
+    no engine events and reports None) — so a cache-aware-routing run
+    reads as "which replica served each hop and how warm it was".
     A request whose final word was a PERMANENT rejection (the client
     got a correct 400 — :data:`PERMANENT_REJECTS`) is resolved, not
     lost; ``unresolved`` flags only requests that vanished mid-retry.
@@ -153,21 +159,36 @@ def stitch(traces):
         tid = rec.get("trace_id")
         if tid is None:
             continue
-        by_id.setdefault(tid, []).append((status, reason))
+        cached = None
+        for ev in rec.get("events", []):
+            if ev.get("ev") == "prefill_start":
+                cached = int(ev.get("cached", 0))
+                break
+        by_id.setdefault(tid, []).append(
+            {"replica": rec.get("replica"),
+             "source": rec.get("source") or "serve",
+             "status": status, "reason": reason,
+             "cached_tokens": cached})
     multi = {tid: hops for tid, hops in by_id.items() if len(hops) > 1}
 
     def resolved(hops):
-        return any(status == "finished"
-                   or (status == "rejected"
-                       and reason in PERMANENT_REJECTS)
-                   for status, reason in hops)
+        return any(h["status"] == "finished"
+                   or (h["status"] == "rejected"
+                       and h["reason"] in PERMANENT_REJECTS)
+                   for h in hops)
 
+    served = [h for hops in by_id.values() for h in hops
+              if h["cached_tokens"] is not None]
     return {
         "requests": len(by_id),
         "multi_hop": len(multi),
         "max_hops": max((len(h) for h in by_id.values()), default=0),
         "unresolved": sorted(tid for tid, hops in by_id.items()
                              if not resolved(hops)),
+        "hops": by_id,
+        "cached_tokens_total": sum(h["cached_tokens"] for h in served),
+        "warm_hops": sum(1 for h in served if h["cached_tokens"] > 0),
+        "engine_hops": len(served),
     }
 
 
@@ -313,6 +334,25 @@ def main(argv=None):
               f"{len(args.paths)} file(s), {stitched['multi_hop']} "
               f"multi-hop (max {stitched['max_hops']} hops), "
               f"{len(stitched['unresolved'])} unresolved")
+        print(f"cache: {stitched['warm_hops']}/"
+              f"{stitched['engine_hops']} engine hops served warm, "
+              f"{stitched['cached_tokens_total']} prompt tokens reused")
+        shown = 0
+        for tid in sorted(stitched["hops"]):
+            hops = stitched["hops"][tid]
+            # engine hops only: the router's own line describes the
+            # same request and would double-print every hop
+            engine = [h for h in hops if h["cached_tokens"] is not None]
+            if not engine or shown >= max(args.top, 0):
+                continue
+            shown += 1
+            path = " -> ".join(
+                f"{h['replica'] or '?'}"
+                f"[cached={h['cached_tokens']}"
+                f",{h['status']}"
+                + (f"/{h['reason']}" if h["reason"] else "") + "]"
+                for h in engine)
+            print(f"  {tid}: {path}")
     if args.json:
         with open(args.json, "w") as f:
             json.dump(summary, f, indent=2)
