@@ -50,6 +50,13 @@ analog here (ROADMAP item 3) is this package:
   asymmetric hysteresis and an oscillation cooldown; actuates via
   ``Supervisor.add_slot``/``remove_slot`` (AOT-warm spawns), router
   membership follows.
+- ``catalog``   — ``CatalogRebalancer``: the model-catalog actuator —
+  compares per-adapter traffic (the collector's per-model goodput)
+  against placement (each replica's advertised adapter ids) and moves
+  hot LoRA adapters replica-to-replica over ``/adapter_export`` →
+  ``/load_adapter``; invoked by ``Supervisor.rebalance_catalog``
+  (manually, or by the autoscaler after a scale-up so a fresh replica
+  picks up the hot adapters).
 - ``deploy``    — ``Deployer``: rolling weight-reload — replace
   slots drain-by-drain behind a token-parity canary probe, mixed
   versions coexist mid-rollout, automatic whole-rollout rollback on
@@ -66,6 +73,7 @@ rolling-restart downtime).
 """
 
 from .autoscaler import Autoscaler, parse_autoscale_spec
+from .catalog import CatalogRebalancer
 from .collector import FleetCollector
 from .deploy import Deployer
 from .faults import Fault, FaultInjector, parse_fault_spec
@@ -83,4 +91,4 @@ __all__ = ["ReplicaServer", "Router", "RouterResult", "Supervisor",
            "ROLES", "STARTING", "READY", "DRAINING", "DEAD",
            "FleetCollector", "SLOEvaluator", "Objective",
            "parse_slo_spec", "Autoscaler", "parse_autoscale_spec",
-           "Deployer"]
+           "Deployer", "CatalogRebalancer"]

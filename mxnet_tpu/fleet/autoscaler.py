@@ -299,6 +299,12 @@ class Autoscaler:
             f"autoscale_{direction}_{role}",
             extra={"role": role, "direction": direction,
                    "reason": reason, "slot": slot, "pool_size": size})
+        if direction == "up" and hasattr(sup, "rebalance_catalog"):
+            # a scaled-up replica starts with an empty adapter store:
+            # one catalog pass spreads the hot adapters onto the pool
+            # (no-op without an attached rebalancer — and the fresh
+            # replica converges on later passes once it is scraped)
+            sup.rebalance_catalog(reason=f"scale_up_{role}")
 
     # -- background loop -----------------------------------------------------
     def start(self):

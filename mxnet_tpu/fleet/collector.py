@@ -83,7 +83,8 @@ _STATS_FIELDS = ("tokens_generated", "prompt_tokens", "completed",
 class _ReplicaView:
     """Collector-side view of one replica: identity + its ring."""
 
-    __slots__ = ("url", "name", "role", "state", "version", "ring",
+    __slots__ = ("url", "name", "role", "state", "version", "model",
+                 "adapters", "ring",
                  "last_attempt_t", "last_success_t",
                  "consecutive_failures", "total_failures", "scrapes")
 
@@ -93,6 +94,10 @@ class _ReplicaView:
         self.role = "both"
         self.state = "unknown"
         self.version = None
+        # catalog identity: carried checkpoint + registered adapter
+        # ids (None until a scrape advertises them)
+        self.model = None
+        self.adapters = None
         self.ring = TimeSeriesRing(ring_capacity, clock=clock)
         self.last_attempt_t = None
         self.last_success_t = None
@@ -309,6 +314,10 @@ class FleetCollector:
             view.role = sec.get("role") or "both"
             view.state = sec.get("state") or "unknown"
             view.version = sec.get("version")
+            view.model = sec.get("model")
+            adp = sec.get("adapters")
+            view.adapters = (list(adp.get("ids") or [])
+                             if isinstance(adp, dict) else None)
             view.consecutive_failures = 0
             view.last_success_t = self.clock()
             view.scrapes += 1
@@ -333,6 +342,14 @@ class FleetCollector:
             values[f"rejected{{reason={reason}}}"] = n
         for tenant, done in (stats.get("tenants") or {}).items():
             values[f"tenant_completed{{tenant={tenant}}}"] = done
+        # per-adapter goodput (catalog traffic attribution — rows
+        # exist only for requests that carried an adapter id; the
+        # replica wire schema pre-flattens the engine's nested
+        # ``adapters`` rows into these two series)
+        for a, done in (stats.get("adapter_completed") or {}).items():
+            values[f"adapter_completed{{adapter={a}}}"] = done
+        for a, toks in (stats.get("adapter_tokens") or {}).items():
+            values[f"adapter_tokens{{adapter={a}}}"] = toks
         for k, v in (sec.get("handoff") or {}).items():
             if isinstance(v, (int, float)):
                 values[f"handoff_{k}"] = v
@@ -450,6 +467,8 @@ class FleetCollector:
         row = {"url": view.url, "replica": view.name, "role": view.role,
                "state": view.state,
                "version": view.version,
+               "model": view.model,
+               "adapters": view.adapters,
                "stale": self.is_stale(view, now),
                "consecutive_failures": view.consecutive_failures,
                "total_failures": view.total_failures,
@@ -497,7 +516,40 @@ class FleetCollector:
         by_url = {v.url: v for v in views}
         rows = [self._replica_row(v, now) for v in views]
         roles = {}
+        # model-catalog aggregates: per-model traffic/goodput across
+        # fresh replicas carrying that checkpoint tag, plus adapter
+        # placement counts (how many replicas host each adapter id)
+        models = {}
         for row in rows:
+            tag = row.get("model")
+            if tag is not None:
+                m = models.setdefault(tag, {
+                    "replicas": 0, "stale": 0, "completed": 0,
+                    "tokens_generated": 0, "tok_per_sec": 0.0,
+                    "adapters": {}, "adapter_goodput": {},
+                    "adapter_tokens": {}})
+                m["replicas"] += 1
+                if row["stale"]:
+                    m["stale"] += 1
+                else:
+                    m["completed"] += int(row.get("completed") or 0)
+                    m["tokens_generated"] += \
+                        int(row.get("tokens_generated") or 0)
+                    m["tok_per_sec"] = round(
+                        m["tok_per_sec"]
+                        + (row.get("tok_per_sec") or 0.0), 3)
+                    for a in row.get("adapters") or []:
+                        m["adapters"][a] = m["adapters"].get(a, 0) + 1
+                    mview = by_url[row["url"]]
+                    for key in mview.ring.names():
+                        for series, out in (
+                                ("adapter_completed", "adapter_goodput"),
+                                ("adapter_tokens", "adapter_tokens")):
+                            pre = f"{series}{{adapter="
+                            if key.startswith(pre):
+                                a = key[len(pre):-1]
+                                m[out][a] = m[out].get(a, 0) \
+                                    + int(mview.ring.latest(key) or 0)
             agg = roles.setdefault(row["role"], {
                 "replicas": 0, "stale": 0, "queue_depth": 0,
                 "running": 0, "waiting_handoffs": 0,
@@ -568,6 +620,7 @@ class FleetCollector:
             "rate_window_s": self.rate_window_s,
             "replicas": rows,
             "roles": roles,
+            "models": models,
             "totals": totals,
             "slo": None if self.slo is None else self.slo.statusz(),
             "annotations": self.annotations(),

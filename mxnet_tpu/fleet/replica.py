@@ -75,6 +75,25 @@ verified import path as a handoff — sha1 payload digests plus
 chain-hash verification, any failure (timeout, corruption, bloom
 false positive) degrading to recompute-from-prompt.
 
+Model catalog (multi-tenant adapters over the fleet)
+----------------------------------------------------
+
+A replica optionally declares the checkpoint it carries (``model=`` /
+``MXTPU_FLEET_MODEL``) and — on an adapters-mode engine — the LoRA
+adapters registered on its ``AdapterStore``.  Both ride ``/healthz``
+and the ``/statusz.json`` replica section only-when-set, so untagged
+fleets keep the historical schemas byte-for-byte.  ``/generate``
+accepts ``"model"`` / ``"adapter"`` fields with the PR 15 sampling-
+param discipline: malformed or unknown values are clean 400s (never
+500s that would open breakers fleet-wide), a model mismatch is
+``wrong_model``, and an adapter whose device slots are all pinned
+rejects retriable (``adapter_slots`` — a sibling carrying the adapter
+may still serve it).  Three catalog-management endpoints let the
+supervisor's rebalancer move adapters at runtime: ``POST
+/load_adapter`` (an ``export_records`` wire payload or a host path),
+``POST /unload_adapter``, and ``POST /adapter_export`` (serialize a
+registered adapter for a peer's load).
+
 Faults (``faults.FaultInjector``) hook ``/generate`` AND ``/handoff``
 arrivals so the chaos tests can kill/delay/refuse/hang this replica at
 a deterministic request index.  A *kill* is a hard death — ``on_kill``
@@ -123,7 +142,8 @@ ROLES = ("both", "prefill", "decode")
 # rejection reasons a sibling replica might still serve (503) vs.
 # requests no replica can ever serve (400) — the router's retry
 # decision rides this split
-RETRIABLE_REASONS = ("queue_full", "tenant_share", "deadline", "draining")
+RETRIABLE_REASONS = ("queue_full", "tenant_share", "deadline", "draining",
+                     "adapter_slots")
 PERMANENT_REASONS = ("exceeds_max_len", "exceeds_cache",
                      "deadline_at_submit")
 
@@ -191,7 +211,7 @@ class ReplicaServer:
     def __init__(self, engine, host="127.0.0.1", port=0, replica_id=None,
                  fault_injector=None, on_kill=None, poll_s=0.002,
                  role=None, handoff_delay_s=None, handoff_drop=None,
-                 version=None):
+                 version=None, model=None):
         self.engine = engine
         self.host = host
         self._requested_port = int(port)
@@ -216,6 +236,12 @@ class ReplicaServer:
         # fleets coexist mid-rollout, so every status surface carries
         # it — the collector/deployer tell versions apart by this
         self.version = version
+        # catalog identity: the checkpoint this replica carries.  The
+        # router filters candidates by it; None = uncataloged (every
+        # model-less request matches, model-tagged requests don't)
+        if model is None:
+            model = os.environ.get("MXTPU_FLEET_MODEL") or None
+        self.model = str(model)[:64] if model is not None else None
         self._handoff_delay_s = (
             float(handoff_delay_s) if handoff_delay_s is not None
             else env_float(faults_mod.ENV_HANDOFF_DELAY, 0.0))
@@ -305,6 +331,7 @@ class ReplicaServer:
         # -> the fleet collector) names the replica that served it, so
         # the collector can attribute SLO-offending requests
         self.engine._rtrace.identity = self.replica_id
+        self.engine._rtrace.model = self.model
         self._http_thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name=f"mxtpu-replica-http-{self.port}")
@@ -517,13 +544,34 @@ class ReplicaServer:
             # scheduler/telemetry state, which must not grow with
             # arbitrary client strings
             tenant = str(tenant)[:64]
+        # catalog params, same discipline as the sampling params above:
+        # unknown/malformed values are clean 400s on every replica —
+        # the router filters by model BEFORE forwarding, so a mismatch
+        # here means a stale scrape or a direct client; either way no
+        # retry on this replica can succeed
+        model = body.get("model")
+        if model is not None:
+            if not isinstance(model, str) or not model:
+                return 400, {"error": "bad_request", "retriable": False}
+            if model[:64] != self.model:
+                return 400, {"error": "wrong_model", "retriable": False,
+                             "model": self.model}
+        adapter = body.get("adapter")
+        if adapter is not None:
+            if not isinstance(adapter, str) or not adapter:
+                return 400, {"error": "bad_request", "retriable": False}
+            adapter = adapter[:64]
+            store = getattr(self.engine, "adapter_store", None)
+            if store is None or not store.known(adapter):
+                return 400, {"error": "unknown_adapter",
+                             "retriable": False, "adapter": adapter}
         pull = body.get("kv_pull")
         if pull is not None:
             # router hint: a sibling advertises more of this prompt's
             # chain than we hold — pull it into the host tier before
             # admission so the radix walk hits it.  Strictly
             # best-effort: every failure arm degrades to recompute
-            self._maybe_pull_chain(pull, prompt)
+            self._maybe_pull_chain(pull, prompt, salt=adapter)
         # a prefill-role replica runs admission + (chunked) prefill
         # only: max_new_tokens=1 makes the prefill pass's own sampled
         # token the request's last — it FINISHES at prefill end, its
@@ -544,7 +592,8 @@ class ReplicaServer:
                                       handoff=handoff,
                                       temperature=temperature,
                                       top_p=top_p, top_k=top_k,
-                                      n=serve_n, logprobs=logprobs)
+                                      n=serve_n, logprobs=logprobs,
+                                      adapter_id=adapter)
 
         try:
             if request_id is not None:
@@ -604,7 +653,8 @@ class ReplicaServer:
             # step dispatch that donates the cache buffers away
             with self._step_lock:
                 records, nbytes = self._encode_records(
-                    self.engine.blocks.export_blocks(req.rid, prompt))
+                    self.engine.blocks.export_blocks(req.rid, prompt,
+                                                     salt=adapter))
             payload = {"handoff": {"records": records,
                                    "prefill_replica": self.replica_id,
                                    "cached_tokens": req.cached_prefix_len,
@@ -703,8 +753,13 @@ class ReplicaServer:
         try:
             try:
                 parsed, nbytes = self._decode_records(records)
+                # the sender salted the chain with the request's
+                # adapter id; verification needs the same root
+                adp = body.get("adapter")
+                adp = (adp[:64] if isinstance(adp, str) and adp
+                       else None)
                 imported, deduped, rejected = \
-                    self.engine.blocks.import_blocks(parsed)
+                    self.engine.blocks.import_blocks(parsed, salt=adp)
             except (KeyError, TypeError, ValueError):
                 # malformed payload: the prompt is still fully
                 # servable here — degrade to recompute, never a 400
@@ -727,7 +782,7 @@ class ReplicaServer:
             _handoff_blocks("rejected").inc(rejected)
         return self._serve_generate(body, trace_id, kill, handoff=True)
 
-    def _maybe_pull_chain(self, spec, prompt):
+    def _maybe_pull_chain(self, spec, prompt, salt=None):
         """Pull a sibling's cached KV chain for ``prompt`` into the
         local host tier — the peer-to-peer leg of the fleet KV fabric.
 
@@ -756,15 +811,20 @@ class ReplicaServer:
         if not peer.startswith("http") \
                 or tokens < eng.blocks.block_size:
             return
-        _, local = eng.blocks.prefix_probe(prompt)
+        _, local = eng.blocks.prefix_probe(prompt, salt=salt)
         if local >= tokens:
             return            # already as warm as the peer advertises
         with self._lock:
             self._pull_attempts += 1
         try:
+            pull_body = {"prompt": prompt}
+            if salt is not None:
+                # adapter-salted chains live in a disjoint key space;
+                # the peer must export with the same salt
+                pull_body["adapter"] = salt
             req = urllib.request.Request(
                 f"{peer.rstrip('/')}/chain_export",
-                data=json.dumps({"prompt": prompt}).encode(),
+                data=json.dumps(pull_body).encode(),
                 method="POST",
                 headers={"Content-Type": "application/json"})
             with urllib.request.urlopen(
@@ -773,7 +833,7 @@ class ReplicaServer:
             records = out.get("records") or []
             parsed, nbytes = self._decode_records(records)
             imported, deduped, rejected = \
-                eng.ingest_pulled_blocks(parsed)
+                eng.ingest_pulled_blocks(parsed, salt=salt)
         except (OSError, KeyError, TypeError, ValueError):
             # transport failure, truncation, or digest mismatch: the
             # prompt is still fully servable here — recompute
@@ -811,9 +871,12 @@ class ReplicaServer:
             return 400, {"error": "bad_request", "retriable": False}
         if not prompt:
             return 400, {"error": "bad_request", "retriable": False}
+        adp = body.get("adapter")
+        adp = adp[:64] if isinstance(adp, str) and adp else None
         with self._step_lock:
             records, nbytes = self._encode_records(
-                self.engine.blocks.export_blocks(None, prompt))
+                self.engine.blocks.export_blocks(None, prompt,
+                                                 salt=adp))
         with self._lock:
             self._chain_exports += 1
             self._chain_export_blocks += len(records)
@@ -885,6 +948,72 @@ class ReplicaServer:
             parsed.append((key, parent, tokens, arrays))
         return parsed, nbytes
 
+    # -- catalog management (supervisor rebalance surface) -------------------
+    def _adapter_store_or_400(self, body):
+        store = getattr(self.engine, "adapter_store", None)
+        if store is None:
+            return None, None, (400, {"error": "adapters_off",
+                                      "retriable": False})
+        adapter = body.get("adapter")
+        if not isinstance(adapter, str) or not adapter:
+            return None, None, (400, {"error": "bad_request",
+                                      "retriable": False})
+        return store, adapter[:64], None
+
+    def handle_load_adapter(self, body):
+        """Register an adapter at runtime: either an ``export_records``
+        wire payload (sha1-verified per array) or a ``save_file`` host
+        path.  Idempotent — re-loading registered content dedups by
+        digest."""
+        store, adapter, err = self._adapter_store_or_400(body)
+        if err is not None:
+            return err
+        try:
+            if body.get("records") is not None:
+                store.import_records(adapter, body)
+            elif body.get("path") is not None:
+                store.load_file(adapter, str(body["path"]))
+            else:
+                return 400, {"error": "bad_request", "retriable": False}
+        except (KeyError, OSError, TypeError, ValueError) as e:
+            # a corrupt/oversized/malformed payload is the CALLER's
+            # problem — never a 500 that opens breakers
+            return 400, {"error": "bad_adapter", "retriable": False,
+                         "detail": str(e)[:200]}
+        return 200, {"adapter": adapter, "adapters": store.ids(),
+                     "replica": self.replica_id}
+
+    def handle_unload_adapter(self, body):
+        """De-catalog an adapter (rebalance move-away).  An adapter
+        pinned by running requests refuses retriable — the caller
+        drains and retries."""
+        store, adapter, err = self._adapter_store_or_400(body)
+        if err is not None:
+            return err
+        try:
+            removed = store.forget(adapter)
+        except RuntimeError:
+            return 503, {"error": "adapter_pinned", "retriable": True}
+        if not removed:
+            return 400, {"error": "unknown_adapter", "retriable": False,
+                         "adapter": adapter}
+        return 200, {"adapter": adapter, "adapters": store.ids(),
+                     "replica": self.replica_id}
+
+    def handle_adapter_export(self, body):
+        """Serialize a registered adapter for a peer's /load_adapter
+        (the rebalancer's copy half — adapters move replica-to-replica
+        without a shared filesystem)."""
+        store, adapter, err = self._adapter_store_or_400(body)
+        if err is not None:
+            return err
+        if not store.known(adapter):
+            return 400, {"error": "unknown_adapter", "retriable": False,
+                         "adapter": adapter}
+        payload = store.export_records(adapter)
+        payload["replica"] = self.replica_id
+        return 200, payload
+
     @property
     def waiting_handoffs(self):
         """Handoff ingests this replica has accepted but not yet
@@ -938,6 +1067,13 @@ class ReplicaServer:
         # pre-control-plane /healthz schema byte-for-byte
         if self.version is not None:
             payload["version"] = self.version
+        # catalog advertisement, only-when-set for the same reason:
+        # the carried checkpoint and the registered (routable) adapters
+        if self.model is not None:
+            payload["model"] = self.model
+        store = getattr(self.engine, "adapter_store", None)
+        if store is not None:
+            payload["adapters"] = store.ids()
         return payload
 
     def _replica_state(self):
@@ -971,6 +1107,11 @@ class ReplicaServer:
         return {"replica": self.replica_id, "state": state,
                 "role": self.role,
                 "version": self.version,
+                # catalog identity + adapter-store occupancy (None on
+                # an uncataloged / adapters-off replica)
+                "model": self.model,
+                "adapters": (eng.adapter_info()
+                             if hasattr(eng, "adapter_info") else None),
                 "served": served, "in_flight": inflight,
                 # the serving ground truth the fleet collector
                 # aggregates (three-view agreement: fleet /fleetz ==
@@ -1003,6 +1144,15 @@ class ReplicaServer:
                         s.prefill_tokens_computed,
                     "tenants": {t: row.get("completed", 0)
                                 for t, row in s.tenants.items()},
+                    # per-adapter goodput (empty without adapter
+                    # traffic — the collector's per-model/adapter
+                    # /fleetz aggregation input)
+                    "adapter_completed": {
+                        a: row.get("completed", 0)
+                        for a, row in s.adapters.items()},
+                    "adapter_tokens": {
+                        a: row.get("tokens", 0)
+                        for a, row in s.adapters.items()},
                 },
                 "queue_depth": eng.scheduler.queue_depth,
                 # running includes the chunked-prefill lane: those
@@ -1133,7 +1283,8 @@ class _Handler(BaseHTTPRequestHandler):
                                   "replica": self.replica.replica_id})
             return
         if self.path not in ("/generate", "/handoff", "/handoff_probe",
-                             "/chain_export"):
+                             "/chain_export", "/load_adapter",
+                             "/unload_adapter", "/adapter_export"):
             self.send_error(404)
             return
         try:
@@ -1166,6 +1317,24 @@ class _Handler(BaseHTTPRequestHandler):
                 result = self.replica.handle_chain_export(body)
             except Exception:
                 _errors("chain_export").inc()
+                result = 500, {"error": "internal", "retriable": True}
+            try:
+                self._send_json(*result)
+            except OSError:
+                _errors("respond").inc()
+            return
+        if self.path in ("/load_adapter", "/unload_adapter",
+                         "/adapter_export"):
+            # catalog management: never fault-injected (a rebalance
+            # move is control-plane, not traffic)
+            fn = {"/load_adapter": self.replica.handle_load_adapter,
+                  "/unload_adapter": self.replica.handle_unload_adapter,
+                  "/adapter_export": self.replica.handle_adapter_export}[
+                      self.path]
+            try:
+                result = fn(body)
+            except Exception:
+                _errors(self.path.lstrip("/")).inc()
                 result = 500, {"error": "internal", "retriable": True}
             try:
                 self._send_json(*result)

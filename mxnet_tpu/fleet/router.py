@@ -127,7 +127,7 @@ class _ReplicaState:
     __slots__ = ("url", "name", "state", "role", "load",
                  "consecutive_failures", "open_until", "probing",
                  "last_scrape_t", "summary", "summary_t", "conn",
-                 "scrape_lock", "connects")
+                 "scrape_lock", "connects", "model", "adapters")
 
     def __init__(self, url):
         self.url = url.rstrip("/")
@@ -136,6 +136,13 @@ class _ReplicaState:
         # "both" until a scrape says otherwise: a legacy replica that
         # never advertises a role serves everything
         self.role = "both"
+        # catalog advertisement: the carried checkpoint id and the
+        # registered adapter ids.  None until a scrape says otherwise —
+        # an uncataloged/legacy replica matches model-less requests
+        # only, and a None adapter list never filters (the replica may
+        # still know the adapter; its own 400 is the backstop)
+        self.model = None
+        self.adapters = None
         self.load = 0.0
         self.consecutive_failures = 0
         self.open_until = None      # breaker-open deadline (monotonic)
@@ -395,6 +402,10 @@ class Router:
                            else sec.get("state") or "down")
                 r.name = sec.get("replica") or r.name
                 r.role = sec.get("role") or "both"
+                r.model = sec.get("model")
+                adp = sec.get("adapters")
+                r.adapters = (list(adp.get("ids") or [])
+                              if isinstance(adp, dict) else None)
                 r.load = self._load_score(sec)
                 r.last_scrape_t = self.clock()
                 if isinstance(summary, dict):
@@ -434,6 +445,8 @@ class Router:
             now = self.clock()
             return [{"url": r.url, "replica": r.name, "state": r.state,
                      "role": r.role,
+                     "model": r.model,
+                     "adapters": r.adapters,
                      "load": round(r.load, 4),
                      "consecutive_failures": r.consecutive_failures,
                      "breaker_open": bool(r.open_until is not None
@@ -441,7 +454,7 @@ class Router:
                     for r in self._replicas]
 
     # -- cache-aware routing (affinity > 0 only) -----------------------------
-    def _affinity_plan(self, prompt):
+    def _affinity_plan(self, prompt, salt=None):
         """Per-replica advertised-prefix match for ``prompt``: probe
         each FRESH ``kv_summary`` (stale ones score zero — the PR 16
         rule: never route on data the fleet stopped refreshing) for
@@ -474,7 +487,10 @@ class Router:
             if bs < 1:
                 continue
             if bs not in keys_by_bs:
-                keys_by_bs[bs] = chain_keys(prompt, bs)
+                # the replicas salt adapter chains (disjoint key
+                # space per adapter); probe with the same salt or an
+                # adapter request would score base-chain affinity
+                keys_by_bs[bs] = chain_keys(prompt, bs, salt=salt)
             depth = RadixSummary.match(summary, keys_by_bs[bs])
             if depth <= 0:
                 continue
@@ -502,16 +518,23 @@ class Router:
         return {"peer": best["url"], "tokens": int(best["tokens"])}
 
     # -- picking -------------------------------------------------------------
-    def _pick(self, exclude, want=None, weights=None):
+    def _pick(self, exclude, want=None, weights=None, model=None,
+              adapter=None):
         """Least-loaded READY replica with a closed (or probe-ready)
         breaker, excluding already-tried ones; round-robin tiebreak.
         ``want`` filters by role capability: ``"prefill"`` skips
         decode-only replicas, ``"decode"`` skips prefill-only ones
         (role "both" — and never-scraped legacy replicas — serve
-        either).  ``weights`` (affinity routing) maps replica url ->
-        score credit subtracted from its load before ranking; None —
-        the affinity-off path — ranks on raw load, bit-identically to
-        the pre-affinity router."""
+        either).  ``model`` filters by catalog identity: a model-tagged
+        request only lands on replicas advertising that checkpoint
+        (composing with role and affinity; model-less requests rank
+        every replica, the historical pick).  ``adapter`` filters by
+        advertised adapter ids when the replica advertises any — a
+        replica with no advertisement passes (its own validation is
+        the backstop).  ``weights`` (affinity routing) maps replica
+        url -> score credit subtracted from its load before ranking;
+        None — the affinity-off path — ranks on raw load,
+        bit-identically to the pre-affinity router."""
         with self._lock:
             now = self.clock()
             rr = next(self._rr)
@@ -525,6 +548,11 @@ class Router:
                 if want == "prefill" and r.role == "decode":
                     continue
                 if want == "decode" and r.role == "prefill":
+                    continue
+                if model is not None and r.model != model:
+                    continue
+                if (adapter is not None and r.adapters is not None
+                        and adapter not in r.adapters):
                     continue
                 if r.open_until is not None:
                     if r.open_until > now:
@@ -635,7 +663,7 @@ class Router:
     def generate(self, prompt, max_new_tokens=64, deadline_s=None,
                  tenant=None, request_id=None, trace_id=None,
                  temperature=None, top_p=None, top_k=None, n=None,
-                 logprobs=None):
+                 logprobs=None, model=None, adapter=None):
         """Route one generation; returns :class:`RouterResult`.
 
         ``temperature``/``top_p``/``top_k``/``n``/``logprobs`` are the
@@ -643,19 +671,35 @@ class Router:
         verbatim (and re-forwarded on a prefill→decode handoff, which
         reuses the same base body), only-when-set so plain requests'
         wire bodies stay byte-identical to pre-sampling releases.
+        ``model``/``adapter`` (catalog params) ride the same rule, and
+        additionally FILTER the pick: a model id no scraped replica
+        advertises is a :class:`PermanentError` before any hop —
+        routing it anywhere could only produce per-replica 400s.
 
         Raises :class:`PermanentError` for requests no replica can
         serve and :class:`NoReplicaAvailable` once the retry budget is
         exhausted."""
         request_id = request_id or uuid.uuid4().hex
         trace_id = trace_id or f"fleet-{uuid.uuid4().hex[:16]}"
+        if model is not None:
+            model = str(model)[:64]
+            with self._lock:
+                known = any(r.model == model for r in self._replicas)
+            if not known:
+                self._m_requests.labels(outcome="permanent").inc()
+                raise PermanentError(
+                    f"unknown model: {model!r} (no replica in the "
+                    "fleet advertises it)")
+        if adapter is not None:
+            adapter = str(adapter)[:64]
         base = {"prompt": [int(t) for t in prompt],
                 "max_new_tokens": int(max_new_tokens),
                 "deadline_s": deadline_s, "tenant": tenant,
                 "request_id": request_id}
         for key, val in (("temperature", temperature), ("top_p", top_p),
                          ("top_k", top_k), ("n", n),
-                         ("logprobs", logprobs)):
+                         ("logprobs", logprobs), ("model", model),
+                         ("adapter", adapter)):
             if val is not None:
                 base[key] = val
         body = json.dumps(base).encode()
@@ -669,7 +713,7 @@ class Router:
         # keys, no weights, no body growth, the pre-affinity pick
         plan = weights = None
         if self.affinity > 0:
-            plan = self._affinity_plan(base["prompt"])
+            plan = self._affinity_plan(base["prompt"], salt=adapter)
             if plan is not None:
                 weights = {u: self.affinity * s["frac"]
                            for u, s in plan["scores"].items()}
@@ -698,12 +742,14 @@ class Router:
                         f"{last_error})")
                 body = json.dumps(dict(base,
                                        deadline_s=remaining)).encode()
-            r = self._pick(tried, want="prefill", weights=weights)
+            r = self._pick(tried, want="prefill", weights=weights,
+                           model=model, adapter=adapter)
             if r is None and tried:
                 # every replica tried once: second pass may retry one
                 # (it may have recovered / stopped rejecting)
                 tried = set()
-                r = self._pick(tried, want="prefill", weights=weights)
+                r = self._pick(tried, want="prefill", weights=weights,
+                               model=model, adapter=adapter)
             if r is None:
                 last_error = "no_replica"
                 continue
@@ -807,6 +853,10 @@ class Router:
         caches."""
         records = list(ho.get("records") or [])
         keys = [rec.get("key") for rec in records]
+        # catalog params ride `base`, so they re-forward on this hop
+        # automatically; the decode pick must honor them too
+        model = base.get("model")
+        adapter = base.get("adapter")
         tried = set()
         last_error = "no_decode_replica"
         for attempt in range(1, max(1, self.retries) + 1):
@@ -827,10 +877,12 @@ class Router:
                         f"deadline_s={deadline_s} exhausted during "
                         f"handoff after {attempt - 1} attempt(s) "
                         f"(last error: {last_error})")
-            r = self._pick(tried, want="decode")
+            r = self._pick(tried, want="decode", model=model,
+                           adapter=adapter)
             if r is None and tried:
                 tried = set()
-                r = self._pick(tried, want="decode")
+                r = self._pick(tried, want="decode", model=model,
+                               adapter=adapter)
             if r is None:
                 last_error = "no_decode_replica"
                 continue

@@ -162,10 +162,14 @@ class Supervisor:
 
     def __init__(self, spawn, n, restart_backoff_s=None,
                  restart_backoff_max_s=None, drain_timeout_s=None,
-                 router=None, collector=None, clock=time.monotonic,
-                 sleep=time.sleep):
+                 router=None, collector=None, catalog=None,
+                 clock=time.monotonic, sleep=time.sleep):
         self.spawn = spawn
         self.n = int(n)
+        # optional CatalogRebalancer: the adapter-placement actuator
+        # behind rebalance_catalog() (wired once at construction,
+        # read-only afterwards — no lock needed)
+        self.catalog = catalog
         self.restart_backoff_s = (
             float(restart_backoff_s) if restart_backoff_s is not None
             else env_float("MXTPU_FLEET_RESTART_BACKOFF", 0.5))
@@ -498,3 +502,27 @@ class Supervisor:
             with self._lock:
                 self._rolling.discard(slot)
         return True
+
+    def rebalance_catalog(self, reason="manual"):
+        """Catalog-rebalance actuator: one plan+apply pass of the
+        attached ``CatalogRebalancer`` (adapter placement follows
+        traffic — see fleet/catalog.py).  Invoked manually or by the
+        autoscaler after a scale-up so a fresh replica picks up the
+        hot adapters.  No-op (empty list) without an attached
+        rebalancer; a failing pass is annotated, never raised — the
+        catalog converging late must not take the pool down."""
+        if self.catalog is None:
+            return []
+        try:
+            results = self.catalog.rebalance()
+        except Exception:
+            telemetry.counter(
+                "mxtpu_fleet_supervisor_errors_total",
+                "supervisor monitor failures").inc()
+            self._annotate("catalog_rebalance_failed", reason=reason)
+            return []
+        if results:
+            self._annotate("catalog_rebalance", reason=reason,
+                           applied=len(results),
+                           ok=sum(1 for r in results if r["ok"]))
+        return results

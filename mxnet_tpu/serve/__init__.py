@@ -26,6 +26,15 @@ serving stack on top of the same checkpoints:
   tensor-parallel over a ``{'tp': N}`` mesh with regex-rule parameter
   sharding (``parallel.partition``) and a head-sharded KV-cache
   (docs/how_to/serve.md "Tensor-parallel sharded serving").
+- ``adapters`` — paged multi-tenant LoRA (S-LoRA/Punica-style):
+  ``AdapterStore`` pages per-projection A/B delta stacks in
+  engine-owned device arrays (content-addressed, refcounted,
+  LRU-evicted to a host-RAM tier), and ``Engine.submit(adapter_id=)``
+  threads each row's adapter slot through the bucket programs as a
+  traced OPERAND — one program per bucket serves any adapter mix with
+  zero fresh traces, slot 0 a true zero delta (env
+  ``MXTPU_SERVE_ADAPTERS``; docs/how_to/serve.md "Multi-tenant
+  adapters").
 - ``stats`` — ``ServeStats`` snapshots (queue depth, TTFT, tokens/sec,
   block utilization, preemption/eviction counters, rejection reasons);
   pair with ``mxnet_tpu.monitor.ServeMonitor`` for periodic logging.
@@ -41,6 +50,7 @@ server's ``/statusz`` page.
 Benchmark: ``tools/serve_bench.py`` (SERVE_BENCH.json artifact).
 """
 
+from .adapters import AdapterStore, NoAdapterSlots
 from .engine import Engine
 from .kv_block_manager import BlockManager, HostKVPool, NoFreeBlocks
 from .scheduler import (CANCELLED, FINISHED, REJECTED, RUNNING, WAITING,
@@ -48,7 +58,7 @@ from .scheduler import (CANCELLED, FINISHED, REJECTED, RUNNING, WAITING,
 from .spec import DraftWorker
 from .stats import ServeStats, StatsRecorder
 
-__all__ = ["Engine", "BlockManager", "DraftWorker", "HostKVPool",
-           "NoFreeBlocks", "QueueFull", "Request", "Scheduler",
-           "ServeStats", "StatsRecorder",
+__all__ = ["AdapterStore", "Engine", "BlockManager", "DraftWorker",
+           "HostKVPool", "NoAdapterSlots", "NoFreeBlocks", "QueueFull",
+           "Request", "Scheduler", "ServeStats", "StatsRecorder",
            "WAITING", "RUNNING", "FINISHED", "REJECTED", "CANCELLED"]

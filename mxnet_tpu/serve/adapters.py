@@ -1,0 +1,433 @@
+"""Paged multi-tenant LoRA adapter store (S-LoRA / Punica style).
+
+Hundreds of tenants' fine-tuned models served at shared base-model
+cost: each adapter is a set of low-rank ``(A, B)`` delta pairs per
+projection matmul, and every projection adds ``scale * x @ A.T @ B.T``
+on top of the frozen base weight.  The deltas live in engine-owned
+**paged device stacks** — one ``(S, r_max, d_in)`` A-stack and one
+``(S, d_out, r_max)`` B-stack per projection stem plus an ``(S,)``
+f32 scale vector — indexed by a per-request *slot* operand inside the
+bucketed programs, so ONE traced program per bucket serves any mix of
+adapters (the PR 15 traced-operand rule: slots are operands, never
+trace keys).
+
+Slot discipline (BlockManager-style accounting):
+
+* **slot 0 is the base model** — its A/B rows and scale are true
+  zeros, so a base-row's logits are ``base + 0.0``: token-identical
+  to an adapters-off engine.
+* slots are **content-addressed** by the sha1 digest of the adapter's
+  arrays (ids are aliases onto digests — two tenants uploading the
+  same weights share one slot),
+* **refcounted** while any queued/running request pins them (a
+  preempted request keeps its pin — preemption never fires the
+  terminal hook),
+* **LRU-evicted** to the host-RAM tier when cold (refcount 0); the
+  host tier has its own byte budget and evicts registrations that are
+  not device-resident,
+* loadable at runtime from **disk** (``save_file``/``load_file``,
+  ``np.savez`` container) or **over the wire**
+  (``export_records``/``import_records`` — the handoff codec's
+  base64 + per-array sha1 framing, corrupt payloads rejected).
+
+Capacity pressure is a *transient* condition: ``acquire`` raises
+:class:`NoAdapterSlots` when every slot is pinned, which the engine
+maps to a retriable ``adapter_slots`` rejection (fleet replicas
+return 503, never a breaker-opening 500).
+"""
+
+import base64
+import collections
+import hashlib
+import threading
+
+import numpy as np
+
+
+class NoAdapterSlots(RuntimeError):
+    """Every adapter slot is pinned by a running request (transient —
+    retry once some request finishes and drops its refcount)."""
+
+
+def gpt_stems(name, n_layers, swiglu, tied, params):
+    """Projection-stem map ``stem -> (d_out, d_in)`` for a GPT tower,
+    read from the checkpoint's ``*_weight`` shapes — the exact stem
+    enumeration the quantizer uses, minus the head/embedding (adapters
+    never touch the tied embedding or the logits head)."""
+    props = ["q", "k", "v", "proj", "ff_up", "ff_down"]
+    if swiglu:
+        props.append("ff_gate")
+    stems = collections.OrderedDict()
+    for i in range(n_layers):
+        for p in props:
+            stem = f"{name}_l{i}_{p}"
+            w = params.get(f"{stem}_weight")
+            if w is None:
+                raise ValueError(f"missing projection weight: {stem}")
+            stems[stem] = (int(w.shape[0]), int(w.shape[1]))
+    return stems
+
+
+def _digest(arrays, alpha):
+    """Content address: sha1 over the sorted (stem, shape, bytes)
+    stream plus the scaling alpha — byte-identical uploads under
+    different ids collapse onto one digest (and one device slot)."""
+    h = hashlib.sha1()
+    h.update(f"alpha={float(alpha)}".encode())
+    for stem in sorted(arrays):
+        a, b = arrays[stem]
+        for tag, arr in (("A", a), ("B", b)):
+            arr = np.ascontiguousarray(arr)
+            h.update(f"{stem}.{tag}:{arr.dtype}:{arr.shape}".encode())
+            h.update(arr.tobytes())
+    return h.hexdigest()[:16]
+
+
+class AdapterStore:
+    """Paged device-resident LoRA adapter slots + a host-RAM tier.
+
+    ``stems`` maps projection stem -> ``(d_out, d_in)``; ``rank`` is
+    the padded per-slot rank ceiling (adapters with a smaller rank are
+    zero-padded — padding rows contribute exactly 0 to the delta);
+    ``slots`` counts device slots INCLUDING the reserved all-zero
+    slot 0; ``shardings`` optionally maps each device-array key to a
+    ``NamedSharding`` so the stacks shard with their parent
+    projections under tp.
+    """
+
+    def __init__(self, stems, rank, slots, dtype=np.float32,
+                 host_bytes=None, shardings=None):
+        if slots < 2:
+            raise ValueError("adapters needs >= 2 slots "
+                             "(slot 0 is the reserved base-model row)")
+        if rank < 1:
+            raise ValueError("adapter rank must be >= 1")
+        self.stems = dict(stems)
+        self.rank = int(rank)
+        self.slots = int(slots)
+        self.dtype = np.dtype(dtype)
+        self.host_bytes = host_bytes
+        self.sharding = dict(shardings) if shardings else None
+        self._lock = threading.RLock()
+        self._alias = {}                 # guarded-by: _lock (id -> digest)
+        self._host = collections.OrderedDict()  # guarded-by: _lock
+        self._host_used = 0              # guarded-by: _lock
+        self._loaded = {}                # guarded-by: _lock (digest -> slot)
+        self._slot_digest = [None] * self.slots  # guarded-by: _lock
+        self._slot_ref = [0] * self.slots        # guarded-by: _lock
+        self._free = list(range(1, self.slots))  # guarded-by: _lock
+        self._cold = collections.OrderedDict()   # guarded-by: _lock
+        self.loads = 0                   # guarded-by: _lock
+        self.device_evictions = 0        # guarded-by: _lock
+        self.host_evictions = 0          # guarded-by: _lock
+        import jax.numpy as jnp
+
+        device = {}
+        for stem, (dout, din) in self.stems.items():
+            device[f"{stem}_A"] = jnp.zeros(
+                (self.slots, self.rank, din), self.dtype)
+            device[f"{stem}_B"] = jnp.zeros(
+                (self.slots, dout, self.rank), self.dtype)
+        device["scale"] = jnp.zeros((self.slots,), jnp.float32)
+        if self.sharding:
+            import jax
+
+            device = {k: jax.device_put(v, self.sharding[k])
+                      for k, v in device.items()}
+        self._device = device            # guarded-by: _lock (rebinds)
+
+    # -- registration (host tier) -------------------------------------
+
+    def register(self, adapter_id, arrays, alpha=None):
+        """Register ``{stem: (A, B)}`` numpy pairs under ``adapter_id``
+        in the host tier (device load is lazy, at first ``acquire``).
+        ``A`` is ``(r, d_in)``, ``B`` is ``(d_out, r)`` with
+        ``r <= rank``; stems absent from ``arrays`` stay zero.
+        Returns the content digest."""
+        if not isinstance(adapter_id, str) or not adapter_id:
+            raise ValueError("adapter id must be a non-empty string")
+        clean, nbytes = {}, 0
+        for stem, pair in arrays.items():
+            if stem not in self.stems:
+                raise ValueError(f"unknown projection stem: {stem}")
+            a, b = (np.asarray(x) for x in pair)
+            dout, din = self.stems[stem]
+            r = a.shape[0] if a.ndim == 2 else -1
+            if a.ndim != 2 or b.ndim != 2 or r > self.rank or r < 1 \
+                    or a.shape[1] != din or b.shape != (dout, r):
+                raise ValueError(
+                    f"{stem}: want A (r<={self.rank}, {din}) / "
+                    f"B ({dout}, r), got A {a.shape} / B {b.shape}")
+            clean[stem] = (a, b)
+            nbytes += a.nbytes + b.nbytes
+        if not clean:
+            raise ValueError("adapter has no projection deltas")
+        ranks = {p[0].shape[0] for p in clean.values()}
+        if len(ranks) != 1:
+            raise ValueError(f"mixed per-stem ranks: {sorted(ranks)}")
+        r = ranks.pop()
+        alpha = float(alpha) if alpha is not None else float(r)
+        digest = _digest(clean, alpha)
+        with self._lock:
+            if digest not in self._host:
+                self._host_make_room(nbytes)
+                self._host[digest] = {
+                    "arrays": clean, "alpha": alpha, "rank": r,
+                    "bytes": nbytes, "ids": set(),
+                }
+                self._host_used += nbytes
+            self._host[digest]["ids"].add(adapter_id)
+            self._host.move_to_end(digest)
+            self._alias[adapter_id] = digest
+        return digest
+
+    def _host_make_room(self, nbytes):
+        # called with _lock held (reentrant — re-entering is free and
+        # keeps the lock discipline checkable)
+        with self._lock:
+            if self.host_bytes is None:
+                return
+            if nbytes > self.host_bytes:
+                raise ValueError(
+                    f"adapter ({nbytes}B) exceeds the host tier budget "
+                    f"({self.host_bytes}B, MXTPU_SERVE_ADAPTER_HOST_BYTES)")
+            for digest in list(self._host):
+                if self._host_used + nbytes <= self.host_bytes:
+                    break
+                if digest in self._loaded:
+                    continue        # device-resident copies stay pinned
+                rec = self._host.pop(digest)
+                self._host_used -= rec["bytes"]
+                self.host_evictions += 1
+                for aid in rec["ids"]:
+                    self._alias.pop(aid, None)
+            if self._host_used + nbytes > self.host_bytes:
+                raise ValueError("host adapter tier full (every entry "
+                                 "is device-resident)")
+
+    def known(self, adapter_id):
+        with self._lock:
+            return adapter_id in self._alias
+
+    def ids(self):
+        with self._lock:
+            return sorted(self._alias)
+
+    def loaded(self):
+        """Adapter ids currently device-resident (hot or cold)."""
+        with self._lock:
+            out = set()
+            for digest in self._loaded:
+                rec = self._host.get(digest)
+                out |= rec["ids"] if rec else set()
+            return sorted(out)
+
+    # -- slot accounting ----------------------------------------------
+
+    def acquire(self, adapter_id):
+        """Pin ``adapter_id`` for one request and return its device
+        slot, loading it from the host tier (evicting the coldest
+        resident adapter if no slot is free).  Raises ``KeyError`` for
+        an unknown id, :class:`NoAdapterSlots` when every slot is
+        pinned by running requests."""
+        with self._lock:
+            digest = self._alias[adapter_id]
+            slot = self._loaded.get(digest)
+            if slot is not None:
+                if self._slot_ref[slot] == 0:
+                    self._cold.pop(slot, None)
+                self._slot_ref[slot] += 1
+                self._host.move_to_end(digest)
+                return slot
+            if self._free:
+                slot = self._free.pop()
+            elif self._cold:
+                slot, old = self._cold.popitem(last=False)
+                del self._loaded[old]
+                self._slot_digest[slot] = None
+                self.device_evictions += 1
+            else:
+                raise NoAdapterSlots(
+                    f"all {self.slots - 1} adapter slots are pinned")
+            self._load_slot(slot, digest)
+            self._loaded[digest] = slot
+            self._slot_digest[slot] = digest
+            self._slot_ref[slot] = 1
+            self._host.move_to_end(digest)
+            return slot
+
+    def release(self, slot):
+        """Drop one pin (idempotent per request — the engine zeroes
+        the request's slot after calling).  A slot at refcount 0 stays
+        loaded and joins the cold-LRU tail."""
+        with self._lock:
+            if not 0 < slot < self.slots or self._slot_ref[slot] == 0:
+                return
+            self._slot_ref[slot] -= 1
+            if self._slot_ref[slot] == 0:
+                self._cold[slot] = self._slot_digest[slot]
+                self._cold.move_to_end(slot)
+
+    def unload(self, adapter_id):
+        """Force an adapter off the device (catalog rebalance).  Only
+        cold adapters unload; a pinned one raises ``RuntimeError``.
+        The host-tier registration stays."""
+        with self._lock:
+            digest = self._alias[adapter_id]
+            slot = self._loaded.get(digest)
+            if slot is None:
+                return False
+            if self._slot_ref[slot]:
+                raise RuntimeError(
+                    f"adapter {adapter_id!r} is pinned by "
+                    f"{self._slot_ref[slot]} running request(s)")
+            self._cold.pop(slot, None)
+            del self._loaded[digest]
+            self._slot_digest[slot] = None
+            self._free.append(slot)
+            return True
+
+    def forget(self, adapter_id):
+        """De-catalog an adapter (the rebalancer's unload half):
+        device-unload it AND drop its host-tier registration, so the
+        replica stops advertising it.  Cold only — a pinned adapter
+        raises ``RuntimeError`` (drain first).  Other ids aliasing the
+        same content keep theirs; returns False for an unknown id."""
+        with self._lock:
+            digest = self._alias.get(adapter_id)
+            if digest is None:
+                return False
+            rec = self._host[digest]
+            if len(rec["ids"]) == 1:
+                self.unload(adapter_id)        # RuntimeError if pinned
+                self._host.pop(digest)
+                self._host_used -= rec["bytes"]
+            rec["ids"].discard(adapter_id)
+            self._alias.pop(adapter_id, None)
+            return True
+
+    def _load_slot(self, slot, digest):
+        # called with _lock held (reentrant — re-entering is free and
+        # keeps the lock discipline checkable)
+        with self._lock:
+            rec = self._host[digest]
+            import jax
+            import jax.numpy as jnp
+
+            device = dict(self._device)
+            for stem, (dout, din) in self.stems.items():
+                a = np.zeros((self.rank, din), self.dtype)
+                b = np.zeros((dout, self.rank), self.dtype)
+                pair = rec["arrays"].get(stem)
+                if pair is not None:
+                    r = pair[0].shape[0]
+                    a[:r] = pair[0]
+                    b[:, :r] = pair[1]
+                for tag, row in (("A", a), ("B", b)):
+                    key = f"{stem}_{tag}"
+                    new = device[key].at[slot].set(jnp.asarray(row))
+                    if self.sharding:
+                        new = jax.device_put(new, self.sharding[key])
+                    device[key] = new
+            scale = np.float32(rec["alpha"] / rec["rank"])
+            device["scale"] = device["scale"].at[slot].set(scale)
+            if self.sharding:
+                device["scale"] = jax.device_put(device["scale"],
+                                                 self.sharding["scale"])
+            self._device = device
+            self.loads += 1
+
+    @property
+    def device(self):
+        """The program operand: the current device-stack pytree."""
+        with self._lock:
+            return self._device
+
+    # -- disk + wire codecs -------------------------------------------
+
+    def save_file(self, adapter_id, path):
+        with self._lock:
+            rec = self._host[self._alias[adapter_id]]
+            arrays = {f"{s}.A": p[0] for s, p in rec["arrays"].items()}
+            arrays.update(
+                {f"{s}.B": p[1] for s, p in rec["arrays"].items()})
+            alpha = rec["alpha"]
+        np.savez(path, __alpha__=np.float64(alpha), **arrays)
+
+    def load_file(self, adapter_id, path):
+        """Register an adapter from a ``save_file`` container."""
+        with np.load(path) as z:
+            alpha = float(z["__alpha__"])
+            arrays = {}
+            for name in z.files:
+                if name == "__alpha__":
+                    continue
+                stem, tag = name.rsplit(".", 1)
+                arrays.setdefault(stem, [None, None])
+                arrays[stem][0 if tag == "A" else 1] = z[name]
+        return self.register(adapter_id, {s: tuple(p)
+                                          for s, p in arrays.items()},
+                             alpha=alpha)
+
+    def export_records(self, adapter_id):
+        """Wire payload (the handoff codec's base64 + sha1 framing):
+        JSON-safe, integrity-checked per array on import."""
+        with self._lock:
+            digest = self._alias[adapter_id]
+            rec = self._host[digest]
+            records = []
+            for stem, (a, b) in sorted(rec["arrays"].items()):
+                for tag, arr in (("A", a), ("B", b)):
+                    raw = np.ascontiguousarray(arr).tobytes()
+                    records.append({
+                        "name": f"{stem}.{tag}",
+                        "dtype": str(arr.dtype),
+                        "shape": list(arr.shape),
+                        "sha1": hashlib.sha1(raw).hexdigest()[:16],
+                        "data": base64.b64encode(raw).decode("ascii"),
+                    })
+            return {"adapter": adapter_id, "digest": digest,
+                    "alpha": rec["alpha"], "rank": rec["rank"],
+                    "records": records}
+
+    def import_records(self, adapter_id, payload):
+        """Register from an ``export_records`` payload; any array whose
+        sha1 disagrees with its bytes rejects the whole adapter."""
+        arrays = {}
+        for r in payload.get("records") or []:
+            raw = base64.b64decode(r["data"])
+            if hashlib.sha1(raw).hexdigest()[:16] != r["sha1"]:
+                raise ValueError(
+                    f"adapter array {r['name']!r} failed its sha1 "
+                    "integrity check")
+            arr = np.frombuffer(raw, dtype=np.dtype(r["dtype"]))
+            arr = arr.reshape(r["shape"]).copy()
+            stem, tag = r["name"].rsplit(".", 1)
+            arrays.setdefault(stem, [None, None])
+            arrays[stem][0 if tag == "A" else 1] = arr
+        if any(a is None or b is None for a, b in arrays.values()):
+            raise ValueError("adapter payload missing an A/B half")
+        return self.register(
+            adapter_id, {s: tuple(p) for s, p in arrays.items()},
+            alpha=payload.get("alpha"))
+
+    # -- introspection ------------------------------------------------
+
+    def stats(self):
+        with self._lock:
+            used = sum(1 for d in self._slot_digest[1:] if d)
+            return {
+                "slots": self.slots,
+                "rank": self.rank,
+                "slots_used": used,
+                "slots_pinned": sum(1 for r in self._slot_ref[1:] if r),
+                "slots_free": self.slots - 1 - used,
+                "ids": sorted(self._alias),
+                "loaded": self.loaded(),
+                "registered": len(self._host),
+                "host_bytes_used": self._host_used,
+                "host_bytes_budget": self.host_bytes,
+                "loads": self.loads,
+                "device_evictions": self.device_evictions,
+                "host_evictions": self.host_evictions,
+            }

@@ -70,6 +70,7 @@ from ..telemetry import flight as flight_mod
 from ..telemetry import statusz as statusz_mod
 from ..telemetry.perf_attrib import PerfAttrib
 from ..telemetry.request_trace import RequestTracer
+from . import adapters as adapters_mod
 from .kv_block_manager import BlockManager, HostKVPool
 from .scheduler import (CANCELLED, FINISHED, REJECTED, WAITING, QueueFull,
                         Request, Scheduler)
@@ -103,7 +104,13 @@ _STEP_CACHE = {}
 _ModelCfg = collections.namedtuple("_ModelCfg", [
     "name", "n_layers", "num_heads", "head_dim", "kv_heads",
     "pos_table", "swiglu", "tied", "rmsnorm", "window", "block_size",
-    "sampling", "sample_cap", "numeric_watch", "kv_quant"])
+    "sampling", "sample_cap", "numeric_watch", "kv_quant",
+    # paged LoRA multiplexing (serve/adapters.py): slot count and the
+    # padded rank ceiling.  adapters=0 (off, the default) follows the
+    # sampling precedent — both fields leave the AOT fingerprint so an
+    # adapters-off engine keeps its historical digests
+    "adapters", "adapter_rank"],
+    defaults=(0, 0))
 
 # top-logprob candidates every sampling-mode program returns per
 # sampled position (static — the per-request ``logprobs`` count only
@@ -119,10 +126,13 @@ TOP_LOGPROBS = 5
 # engine's parameter dict.
 # ``scale`` is the int8-KV scale arrays' sharding (head axis, like the
 # cache); None outside kv_quant engines
+#  ``adapters`` is the LoRA device-stack pytree's shardings (A/B stacks
+# shard on the same axes as their parent projections); None outside
+# adapter engines
 _Shardings = collections.namedtuple("_Shardings",
                                     ["mesh", "params", "cache", "rep",
-                                     "scale"],
-                                    defaults=(None,))
+                                     "scale", "adapters"],
+                                    defaults=(None, None))
 
 
 def _next_bucket(n, cap):
@@ -172,6 +182,10 @@ def _cfg_fp_fields(cfg):
         d.pop("sample_cap", None)
         d["temperature"] = 0.0
         d["top_k"] = None
+    if not d.get("adapters"):
+        # same only-when-on rule: adapters-off keeps pre-LoRA digests
+        d.pop("adapters", None)
+        d.pop("adapter_rank", None)
     return d
 
 
@@ -328,7 +342,9 @@ class Engine:
                  prefix_cache=None, prefill_chunk=None, spec_k=None,
                  draft_params=None, draft_num_heads=None,
                  draft_window=None, draft_symbol=None, draft_name=None,
-                 quantize=None, kv_dtype=None, host_kv_bytes=None):
+                 quantize=None, kv_dtype=None, host_kv_bytes=None,
+                 adapters=None, adapter_rank=None,
+                 adapter_host_bytes=None):
         if symbol is not None:
             num_heads, window = reconcile_decode_config(symbol, num_heads,
                                                         window)
@@ -402,6 +418,30 @@ class Engine:
             # per-output-channel int8 + *_wscale vectors; detection ran
             # on the fp checkpoint, the programs dequantize on the fly
             params = _quantize_gpt_params(params, name, self.spec)
+        # -- paged LoRA adapter multiplexing (serve/adapters.py) -----------
+        # default OFF and off is byte-for-byte inert: no slot operand,
+        # unchanged program-cache keys, unchanged AOT fingerprints,
+        # identical tokens.  ``adapters`` counts device slots INCLUDING
+        # the reserved all-zero base slot 0
+        self._adapters = (int(adapters) if adapters is not None
+                          else env_int("MXTPU_SERVE_ADAPTERS", 0))
+        if self._adapters < 0 or self._adapters == 1:
+            raise ValueError(
+                f"adapters must be 0 (off) or >= 2 slots including the "
+                f"reserved base slot 0 (got {self._adapters})")
+        self.adapter_rank = (int(adapter_rank) if adapter_rank is not None
+                             else env_int("MXTPU_SERVE_ADAPTER_RANK", 8))
+        if self._adapters and self.adapter_rank < 1:
+            raise ValueError(
+                f"adapter_rank must be >= 1 (got {self.adapter_rank})")
+        self.adapter_host_bytes = (
+            int(adapter_host_bytes) if adapter_host_bytes is not None
+            else env_int("MXTPU_SERVE_ADAPTER_HOST_BYTES", 0)) or None
+        adapter_stems = None
+        if self._adapters:
+            adapter_stems = adapters_mod.gpt_stems(
+                name, self.spec["n_layers"], self.spec["swiglu"],
+                self.spec["tied"], params)
         # -- tensor-parallel mesh + partition rules ------------------------
         self.tp = (int(tp) if tp is not None
                    else env_int("MXTPU_SERVE_TP", 1))
@@ -436,9 +476,28 @@ class Engine:
             self.mesh = make_mesh({"tp": self.tp})
             specs = partition_mod.match_partition_rules(self._rules, params)
             rep = NamedSharding(self.mesh, PartitionSpec())
+            # LoRA stacks shard on the SAME axes as their parent
+            # projections: an out-sharded parent ((tp, None) weight)
+            # shards the B stack's d_out axis (A replicated); an
+            # in-sharded parent ((None, tp)) shards the A stack's d_in
+            # axis (B replicated) — the delta's partial-sum joins the
+            # layer's existing all-reduce
+            adapter_shardings = None
+            if self._adapters:
+                adapter_shardings = {}
+                for stem in adapter_stems:
+                    wspec = specs.get(f"{stem}_weight") or PartitionSpec()
+                    out_ax = wspec[0] if len(wspec) > 0 else None
+                    in_ax = wspec[1] if len(wspec) > 1 else None
+                    adapter_shardings[f"{stem}_A"] = NamedSharding(
+                        self.mesh, PartitionSpec(None, None, in_ax))
+                    adapter_shardings[f"{stem}_B"] = NamedSharding(
+                        self.mesh, PartitionSpec(None, out_ax, None))
+                adapter_shardings["scale"] = rep
             self._shardings = _Shardings(
                 mesh=self.mesh,
                 params=partition_mod.named_shardings(self.mesh, specs),
+                adapters=adapter_shardings,
                 # each chip holds kv_heads/tp of EVERY block: block
                 # accounting (BlockManager) is unchanged, per-chip KV
                 # bytes drop by tp
@@ -560,6 +619,17 @@ class Engine:
             placed[k] = arr
         self.params = placed
         dt = self.params[f"{name}_tok_embed_weight"].dtype
+        # paged LoRA slots live in engine-owned device stacks shaped by
+        # the checkpoint (A/B in the activation dtype — the base may be
+        # int8-quantized, the deltas never are); slot 0 stays all-zero
+        self.adapter_store = None
+        if self._adapters:
+            self.adapter_store = adapters_mod.AdapterStore(
+                adapter_stems, self.adapter_rank, self._adapters,
+                dtype=np.dtype(str(dt)),
+                host_bytes=self.adapter_host_bytes,
+                shardings=(None if self._shardings is None
+                           else self._shardings.adapters))
         L = self.spec["n_layers"]
         # int8 KV blocks store quantized slots plus per-slot-per-head
         # f32 scales in a small parallel array pair indexed by the SAME
@@ -603,7 +673,9 @@ class Engine:
             sampling=self._sampling,
             sample_cap=self.sample_cap if self._sampling else 0,
             numeric_watch=self._numeric_watch,
-            kv_quant=self._kv_quant)
+            kv_quant=self._kv_quant,
+            adapters=self._adapters,
+            adapter_rank=self.adapter_rank if self._adapters else 0)
         # draft worker last among the device placements: params, then
         # the target cache, then the (much smaller) draft side — the
         # same one-model-at-a-time HBM discipline shutdown() preserves
@@ -744,7 +816,7 @@ class Engine:
     def submit(self, prompt, max_new_tokens=64, deadline_s=None,
                tenant=None, trace_id=None, handoff=False,
                temperature=None, top_p=None, top_k=None, n=1,
-               logprobs=0):
+               logprobs=0, adapter_id=None):
         """Queue one generation request; returns its ``Request`` handle.
 
         Raises ``QueueFull`` when the admission queue is at capacity
@@ -772,6 +844,16 @@ class Engine:
         that many top-logprob candidates per emitted token alongside
         each token's own logprob (``req.token_logprobs`` /
         ``req.top_logprobs``).
+
+        ``adapter_id`` serves the request through a registered LoRA
+        adapter (adapters mode only — ``Engine(adapters=S)`` /
+        ``MXTPU_SERVE_ADAPTERS``): the request pins the adapter's
+        device slot until it terminates and its rows add the adapter's
+        low-rank delta inside the SAME bucketed programs base rows use
+        (the slot index is a traced operand — any adapter mix shares
+        one program with zero retraces).  Unknown ids raise
+        ``ValueError``; a fully-pinned slot table rejects with the
+        retriable ``adapter_slots`` reason.
         """
         if not self._alive:
             raise RuntimeError("engine is shut down")
@@ -799,9 +881,19 @@ class Engine:
                 "n > 1 requires the prefix cache (siblings share the "
                 "prompt's radix-cached blocks copy-on-write — one "
                 "prefill, n samples)")
+        if adapter_id is not None:
+            if not self._adapters:
+                raise ValueError(
+                    "adapter_id requires an adapters-mode engine "
+                    "(Engine(adapters=S) / MXTPU_SERVE_ADAPTERS) — "
+                    "adapters-off engines keep the historical programs "
+                    "byte-for-byte")
+            if (not isinstance(adapter_id, str)
+                    or not self.adapter_store.known(adapter_id)):
+                raise ValueError(f"unknown adapter: {adapter_id!r}")
         kw = dict(deadline_s=deadline_s, tenant=tenant, handoff=handoff,
                   temperature=temperature, top_p=top_p, top_k=top_k,
-                  logprobs=logprobs)
+                  logprobs=logprobs, adapter_id=adapter_id)
         req = Request(prompt, max_new_tokens, **kw)
         if trace_id:
             req.trace_id = str(trace_id)
@@ -819,6 +911,19 @@ class Engine:
             for r in (req.samples or [req]):
                 self.scheduler._reject(r, "exceeds_max_len")
             return req
+        if adapter_id is not None:
+            # every row (primary + siblings) pins the slot once: the
+            # pin survives preemption (preempt never fires the terminal
+            # trace hook) and drops in _on_request_terminal.  All slots
+            # pinned is TRANSIENT capacity pressure — the retriable
+            # adapter_slots rejection (fleet replicas 503, not 400)
+            try:
+                for r in (req.samples or [req]):
+                    r.adapter_slot = self.adapter_store.acquire(adapter_id)
+            except adapters_mod.NoAdapterSlots:
+                for r in (req.samples or [req]):
+                    self.scheduler._reject(r, "adapter_slots")
+                return req
         try:
             out = self.scheduler.submit(req)
         except QueueFull:
@@ -1026,6 +1131,13 @@ class Engine:
         miss dumps the flight ring immediately (rate-limited), and a
         rejection rate over ``MXTPU_FLIGHT_REJECT_RATE`` across the
         recent-terminal window dumps too."""
+        slot = getattr(req, "adapter_slot", 0)
+        if slot and self.adapter_store is not None:
+            # drop the request's adapter pin exactly once per lifetime
+            # (terminal events never fire twice for one request; the
+            # zeroed slot makes a double-call a no-op anyway)
+            self.adapter_store.release(slot)
+            req.adapter_slot = 0
         rejected = name == "rejected"
         self._slo_window.append(1 if rejected else 0)
         if rejected and args.get("reason") == "deadline":
@@ -1104,6 +1216,9 @@ class Engine:
             # sampling mode: per-request params as traced operands
             # (None on greedy-only engines — the inert default)
             "sampling": self.sampling_info(),
+            # paged LoRA multiplexing: slot occupancy, refcounts and
+            # the loaded-adapter set (None when off — the inert default)
+            "adapters": self.adapter_info(),
             "sharding": self.sharding_info(),
             # speculative decoding: k, the draft model's shape/bytes,
             # the rolling acceptance rate and the verify bucket grid
@@ -1132,6 +1247,14 @@ class Engine:
         per 1k tokens (None with ``MXTPU_PERF_ATTRIB=0``).  The
         ServeMonitor tail and the fleet replica scrape row read this."""
         return self._perf.summary()
+
+    def adapter_info(self):
+        """The ``/statusz`` ``adapters`` section: slot occupancy,
+        refcounts and the loaded-adapter ids (None when off — the
+        inert default)."""
+        if not self._adapters:
+            return None
+        return self.adapter_store.stats()
 
     def sampling_info(self):
         """The ``/statusz`` ``sampling`` section: cap, engine defaults
@@ -1207,7 +1330,7 @@ class Engine:
         ``/healthz``/``/statusz`` scrape at any cache size."""
         return self.blocks.summary()
 
-    def ingest_pulled_blocks(self, records):
+    def ingest_pulled_blocks(self, records, salt=None):
         """Land a peer-pulled KV chain in the host tier — the engine
         half of the fleet fabric's peer-to-peer pull.  ``records`` is
         the decoded handoff wire shape; ingestion is the SAME
@@ -1215,7 +1338,7 @@ class Engine:
         handoff uses, so a truncated or corrupted pull breaks the
         chain and the suffix recomputes (degradation, never
         corruption).  Returns ``(imported, deduped, rejected)``."""
-        return self.blocks.import_blocks(records)
+        return self.blocks.import_blocks(records, salt=salt)
 
     def sharding_info(self):
         """Live sharding layout: tp degree, mesh shape/devices, rule
@@ -1342,6 +1465,34 @@ class Engine:
             topp[i] = req.top_p
             topk[i] = req.top_k or 0
         return (jnp.asarray(temp), jnp.asarray(topp), jnp.asarray(topk))
+
+    def _adapter_args(self):
+        """The LoRA device-stack operand every target-model program
+        takes right after the params (empty on adapters-off engines —
+        their program signatures are the historical ones)."""
+        if not self._adapters:
+            return ()
+        return (self.adapter_store.device,)
+
+    def _req_adapter_operand(self, req):
+        """Scalar adapter-slot operand for the prefill/chunk programs
+        (empty when off)."""
+        if not self._adapters:
+            return ()
+        return (jnp.asarray(req.adapter_slot, jnp.int32),)
+
+    def _batch_adapter_operands(self, reqs, bucket):
+        """(B,)-shaped per-slot adapter indices for the decode/verify
+        programs — the second traced-operand family after sampling:
+        each row gathers its own A/B slices, so one bucketed program
+        serves any adapter mix (padding and base rows are slot 0, the
+        true zero delta)."""
+        if not self._adapters:
+            return ()
+        slots = np.zeros(bucket, np.int32)
+        for i, req in enumerate(reqs):
+            slots[i] = req.adapter_slot
+        return (jnp.asarray(slots),)
 
     def _note_logprobs(self, req, chosen, tv, ti):
         """Record emitted tokens' logprob outputs on the request: the
@@ -1509,9 +1660,11 @@ class Engine:
             blk, off = self._slots(self.blocks.table(req.rid), n, bucket)
             pkind = "prefill"
             fn = self._prefill_fn(bucket)
-            args = (self.params,) + self._cache_args() + (
+            args = (self.params,) + self._adapter_args() \
+                + self._cache_args() + (
                     jnp.asarray(toks), jnp.asarray(n, jnp.int32),
                     jnp.asarray(blk), jnp.asarray(off)) \
+                + self._req_adapter_operand(req) \
                 + self._req_sampling_operands(req) + (sub,)
         else:
             # suffix/chunk pass: positions [start, end) attend through
@@ -1531,10 +1684,12 @@ class Engine:
                    % self.block_size).astype(np.int32)
             pkind = "chunk"
             fn = self._chunk_fn(bucket)
-            args = (self.params,) + self._cache_args() + (
+            args = (self.params,) + self._adapter_args() \
+                + self._cache_args() + (
                     jnp.asarray(toks), jnp.asarray(start, jnp.int32),
                     jnp.asarray(span, jnp.int32), jnp.asarray(tw),
                     jnp.asarray(blk), jnp.asarray(off)) \
+                + self._req_adapter_operand(req) \
                 + self._req_sampling_operands(req) + (sub,)
         t0 = self._perf.t0()
         outs = fn(*args)
@@ -1547,7 +1702,9 @@ class Engine:
         # publish the newly-FULL blocks under their chain keys so later
         # prompts (or this request's own post-preemption resume) can
         # reuse them — host-side dict work only
-        self.blocks.note_tokens(req.rid, ids[:end])
+        # the request's adapter id salts the chain: adapter K/V is
+        # content-disjoint from base (and other-adapter) K/V
+        self.blocks.note_tokens(req.rid, ids[:end], salt=req.adapter_id)
         if end < n:
             # intermediate chunk: the sampled token is bogus (mid-
             # prompt) and dropped; the request stays in the prefilling
@@ -1589,9 +1746,11 @@ class Engine:
         fn = self._decode_fn(bucket)
         self._key, sub = jax.random.split(self._key)
         t0 = self._perf.t0()
-        outs = fn(self.params, *self._cache_args(),
+        outs = fn(self.params, *self._adapter_args(),
+                  *self._cache_args(),
                   jnp.asarray(toks), jnp.asarray(pos),
                   jnp.asarray(tables),
+                  *self._batch_adapter_operands(reqs, bucket),
                   *self._batch_sampling_operands(reqs, bucket), sub)
         self._perf.done(t0, "decode", bucket, outs)
         lead = self._unpack_outs(outs, 4 if self._sampling else 1,
@@ -1701,9 +1860,12 @@ class Engine:
             self._key, sub = jax.random.split(self._key)
             with telemetry.span("serve.verify", batch=B, k=k):
                 t0 = self._perf.t0()
-                outs = fn(self.params, *self._cache_args(),
+                outs = fn(self.params, *self._adapter_args(),
+                          *self._cache_args(),
                           jnp.asarray(toks), drafted, q_at, q_vals,
-                          q_idx, jp, jtab, *samp, sub)
+                          q_idx, jp, jtab,
+                          *self._batch_adapter_operands(reqs, bucket),
+                          *samp, sub)
                 self._perf.done(t0, "verify", bucket, outs)
                 emit_rows, acc, lp, tv, ti = self._unpack_outs(
                     outs, 5, "verify_logits", batch_size=B,
@@ -1756,8 +1918,10 @@ class Engine:
         self._key, sub = jax.random.split(self._key)
         with telemetry.span("serve.verify", batch=B, k=k):
             t0 = self._perf.t0()
-            outs = fn(self.params, *self._cache_args(),
-                      jnp.asarray(rows), jp, jtab, sub)
+            outs = fn(self.params, *self._adapter_args(),
+                      *self._cache_args(),
+                      jnp.asarray(rows), jp, jtab,
+                      *self._batch_adapter_operands(reqs, bucket), sub)
             self._perf.done(t0, "verify", bucket, outs)
             if self._cfg.numeric_watch:
                 out, ok = outs[0], outs[1]
@@ -2064,6 +2228,23 @@ class Engine:
                 return ()
             return (sds(shape, f32), sds(shape, f32), sds(shape, i32))
 
+        def adp():
+            # the LoRA device-stack pytree right after the params —
+            # absent on adapters-off engines (historical signatures)
+            if not self._cfg.adapters:
+                return ()
+            ash = self.adapter_store.sharding or {}
+            return ({k: sds(v.shape, v.dtype,
+                            ash.get(k) if sh is not None else None)
+                     for k, v in self.adapter_store.device.items()},)
+
+        def aslot(shape):
+            # the per-request adapter-slot index operand (scalar for
+            # prefill/chunk, (B,) for decode/verify)
+            if not self._cfg.adapters:
+                return ()
+            return (sds(shape, i32),)
+
         if kind in ("draft", "draft_chunk"):
             # draft-side programs: the draft checkpoint's params and
             # its own (replicated-under-tp) cache pair, the target's
@@ -2108,10 +2289,10 @@ class Engine:
                 specs += (s, s)
             return specs
         if kind == "decode":
-            return (pspec,) + caches + (sds((bucket,), i32),
+            return (pspec,) + adp() + caches + (sds((bucket,), i32),
                     sds((bucket,), i32),
                     sds((bucket, self.table_width), i32)) \
-                + samp((bucket,)) + (kspec,)
+                + aslot((bucket,)) + samp((bucket,)) + (kspec,)
         if kind == "verify":
             if self._cfg.sampling:
                 # toks (B,), drafted (B, k), then the draft's q in
@@ -2119,7 +2300,7 @@ class Engine:
                 # (B, k, cap) — device-to-device from the draft
                 # dispatch; pos0, tables, the operand triple, rng
                 cap = min(self.sample_cap, self.spec["vocab"])
-                return (pspec,) + caches + (
+                return (pspec,) + adp() + caches + (
                         sds((bucket,), i32),
                         sds((bucket, self.spec_k), i32),
                         sds((bucket, self.spec_k), f32),
@@ -2127,22 +2308,24 @@ class Engine:
                         sds((bucket, self.spec_k, cap), i32),
                         sds((bucket,), i32),
                         sds((bucket, self.table_width), i32)) \
-                    + samp((bucket,)) + (kspec,)
+                    + aslot((bucket,)) + samp((bucket,)) + (kspec,)
             # rows (B, k+1), pos0 (B,), tables (B, W), rng
-            return (pspec,) + caches + (
+            return (pspec,) + adp() + caches + (
                     sds((bucket, self.spec_k + 1), i32),
                     sds((bucket,), i32),
-                    sds((bucket, self.table_width), i32), kspec)
+                    sds((bucket, self.table_width), i32)) \
+                + aslot((bucket,)) + (kspec,)
         if kind == "chunk":
             # toks, start, n_valid, table, blk, off, rng
-            return (pspec,) + caches + (sds((bucket,), i32),
+            return (pspec,) + adp() + caches + (sds((bucket,), i32),
                     sds((), i32), sds((), i32),
                     sds((self.table_width,), i32),
                     sds((bucket,), i32), sds((bucket,), i32)) \
-                + samp((1,)) + (kspec,)
-        return (pspec,) + caches + (sds((bucket,), i32), sds((), i32),
+                + aslot(()) + samp((1,)) + (kspec,)
+        return (pspec,) + adp() + caches + (sds((bucket,), i32),
+                sds((), i32),
                 sds((bucket,), i32), sds((bucket,), i32)) \
-            + samp((1,)) + (kspec,)
+            + aslot(()) + samp((1,)) + (kspec,)
 
     def _program_builder(self, kind, bucket):
         """The freshly-traced jitted program for (kind, bucket) — the
@@ -2234,8 +2417,16 @@ class Engine:
         n_caches = (4 if self._cfg.kv_quant
                     and kind not in ("draft", "draft_chunk") else 2)
         # the restore program has no params operand: its donated cache
-        # arguments START the signature instead of following the pytree
-        first = 0 if kind == "restore" else 1
+        # arguments START the signature instead of following the pytree.
+        # Adapter-mode target programs carry the LoRA stack pytree
+        # between the params and the caches, shifting the donated
+        # argnums by one more (draft programs stay base-model)
+        if kind == "restore":
+            first = 0
+        elif self._cfg.adapters and kind not in ("draft", "draft_chunk"):
+            first = 2
+        else:
+            first = 1
         return compiled(jax.jit(
             exported.call,
             donate_argnums=(tuple(range(first, first + n_caches))
@@ -2290,6 +2481,42 @@ def _wfc(params, stem, x):
     if sc is not None:
         w = w.astype(x.dtype) * sc.astype(x.dtype)[:, None]
     return _fc(x, w, params[f"{stem}_bias"])
+
+
+def _lora_delta(adp, stem, x, slots):
+    """The paged-LoRA low-rank delta for one projection: gather each
+    row's (A, B) slices from the device stacks by its slot operand and
+    compute ``scale * x @ A.T @ B.T`` — never materializing a merged
+    weight.  Slot 0's rows and scale are true zeros, so base rows add
+    exactly ``+0.0`` (token-identical to an adapters-off engine).
+
+    ``slots`` is a scalar for the one-request prefill/chunk programs,
+    ``(B,)`` for decode (2-D ``x``) and verify (3-D ``(B, K+1, D)``
+    ``x`` — the slot broadcasts over the candidate positions)."""
+    a = adp[f"{stem}_A"].astype(x.dtype)          # (S, r, d_in)
+    b = adp[f"{stem}_B"].astype(x.dtype)          # (S, d_out, r)
+    sc = adp["scale"]
+    if slots.ndim == 0:
+        u = x @ a[slots].T                        # (..., r)
+        return (u @ b[slots].T) * sc[slots].astype(x.dtype)
+    ga, gb = a[slots], b[slots]
+    s = sc[slots].astype(x.dtype)
+    if x.ndim == 2:
+        u = jnp.einsum("bi,bri->br", x, ga)
+        return jnp.einsum("br,bor->bo", u, gb) * s[:, None]
+    u = jnp.einsum("bki,bri->bkr", x, ga)
+    return jnp.einsum("bkr,bor->bko", u, gb) * s[:, None, None]
+
+
+def _awfc(cfg, params, adp, stem, x, slots):
+    """:func:`_wfc` plus the request's LoRA delta when the program
+    threads the adapter stacks.  ``adp`` is None on adapters-off
+    engines — a Python-level branch, so their traced programs stay
+    byte-for-byte the historical ones."""
+    base = _wfc(params, stem, x)
+    if adp is None:
+        return base
+    return base + _lora_delta(adp, stem, x, slots)
 
 
 def _kv_quant_vals(vals):
@@ -2428,17 +2655,17 @@ def _logprob_outs(logits, toks):
     return chosen, tv, ti.astype(jnp.int32)
 
 
-def _mlp(cfg, params, p, x):
+def _mlp(cfg, params, p, x, adp=None, slots=None):
     h2 = _ln(x, params[f"{p}_ln2_gamma"],
              None if cfg.rmsnorm else params[f"{p}_ln2_beta"])
     if cfg.swiglu:
-        g = _wfc(params, f"{p}_ff_gate", h2)
+        g = _awfc(cfg, params, adp, f"{p}_ff_gate", h2, slots)
         gf = g.astype(jnp.float32)               # f32 silu == sym.silu
         up = ((gf * jax.nn.sigmoid(gf)).astype(g.dtype)
-              * _wfc(params, f"{p}_ff_up", h2))
+              * _awfc(cfg, params, adp, f"{p}_ff_up", h2, slots))
     else:
-        up = _gelu(_wfc(params, f"{p}_ff_up", h2))
-    return _wfc(params, f"{p}_ff_down", up)
+        up = _gelu(_awfc(cfg, params, adp, f"{p}_ff_up", h2, slots))
+    return _awfc(cfg, params, adp, f"{p}_ff_down", up, slots)
 
 
 def _logits(cfg, params, x):
@@ -2451,7 +2678,8 @@ def _logits(cfg, params, x):
     return _wfc(params, f"{name}_head", final)
 
 
-def _forward_token_batch(cfg, params, ck, cv, ksc, vsc, toks, pos, tables):
+def _forward_token_batch(cfg, params, ck, cv, ksc, vsc, toks, pos, tables,
+                         adp=None, slots=None):
     """Shared decode math: write each row's K/V at its position,
     attend through the block tables, return logits (B, V).  With
     ``cfg.kv_quant`` the caches are int8 and ``ksc``/``vsc`` carry the
@@ -2472,9 +2700,9 @@ def _forward_token_batch(cfg, params, ck, cv, ksc, vsc, toks, pos, tables):
         p = f"{name}_l{i}"
         h = _ln(x, params[f"{p}_ln1_gamma"],
                 None if cfg.rmsnorm else params[f"{p}_ln1_beta"])
-        q = _wfc(params, f"{p}_q", h)
-        k = _wfc(params, f"{p}_k", h)
-        v = _wfc(params, f"{p}_v", h)
+        q = _awfc(cfg, params, adp, f"{p}_q", h, slots)
+        k = _awfc(cfg, params, adp, f"{p}_k", h, slots)
+        v = _awfc(cfg, params, adp, f"{p}_v", h, slots)
         qh = q.reshape(B, Hq, Dh)
         kh = k.reshape(B, Hkv, Dh)
         vh = v.reshape(B, Hkv, Dh)
@@ -2495,8 +2723,9 @@ def _forward_token_batch(cfg, params, ck, cv, ksc, vsc, toks, pos, tables):
             cv = cv.at[i, blk, off].set(vh)
             attn = paged_attention(qh, ck[i], cv[i], tables, ctx,
                                    window=cfg.window)
-        x = x + _wfc(params, f"{p}_proj", attn.reshape(B, d_model))
-        x = x + _mlp(cfg, params, p, x)
+        x = x + _awfc(cfg, params, adp, f"{p}_proj",
+                      attn.reshape(B, d_model), slots)
+        x = x + _mlp(cfg, params, p, x, adp=adp, slots=slots)
     return _logits(cfg, params, x), ck, cv, ksc, vsc
 
 
@@ -2535,16 +2764,23 @@ def _jit_kwargs(cfg, donate, shardings, n_token_args, n_lead=None):
     n_caches = 4 if cfg.kv_quant else 2
     if cfg.sampling:
         n_token_args += 3
+    if cfg.adapters:
+        n_token_args += 1            # the per-row adapter-slot operand
     if n_lead is None:
         n_lead = 4 if cfg.sampling else 1
-    kw = {"donate_argnums": (tuple(range(1, 1 + n_caches))
+    first = 2 if cfg.adapters else 1  # adp stacks sit after params
+    kw = {"donate_argnums": (tuple(range(first, first + n_caches))
                              if donate else ())}
     if shardings is not None:
         rep = shardings.rep
         caches = (shardings.cache,) * 2
         if cfg.kv_quant:
             caches += (shardings.scale,) * 2
-        kw["in_shardings"] = ((shardings.params,) + caches
+        lead_in = (shardings.params,)
+        if cfg.adapters:
+            lead_in += (shardings.adapters
+                        if shardings.adapters is not None else rep,)
+        kw["in_shardings"] = (lead_in + caches
                               + (rep,) * n_token_args + (rep,))
         out = (rep,) * n_lead
         if cfg.numeric_watch:
@@ -2555,13 +2791,21 @@ def _jit_kwargs(cfg, donate, shardings, n_token_args, n_lead=None):
 
 def _build_decode(cfg, donate, shardings=None):
     def decode(params, *rest):
+        adp = slots = None
+        if cfg.adapters:
+            adp, rest = rest[0], rest[1:]
         ck, cv, ksc, vsc, tail = _split_cache_args(cfg, rest)
+        toks, pos, tables = tail[:3]
+        tail = tail[3:]
+        if cfg.adapters:
+            slots, tail = tail[0], tail[1:]
         if cfg.sampling:
-            toks, pos, tables, temp, topp, topk, rng = tail
+            temp, topp, topk, rng = tail
         else:
-            toks, pos, tables, rng = tail
+            rng, = tail
         logits, ck, cv, ksc, vsc = _forward_token_batch(
-            cfg, params, ck, cv, ksc, vsc, toks, pos, tables)
+            cfg, params, ck, cv, ksc, vsc, toks, pos, tables,
+            adp=adp, slots=slots)
         if cfg.sampling:
             tok = _sample_ops(cfg, logits, rng, temp, topp, topk)
             lead = (tok,) + _logprob_outs(logits, tok)
@@ -2591,11 +2835,18 @@ def _build_prefill(cfg, P, donate, shardings=None):
         """Whole-prompt pass at padded length P for ONE request:
         writes K/V for positions [0, plen) through the block
         table and samples the token after position plen-1."""
+        adp = slots = None
+        if cfg.adapters:
+            adp, rest = rest[0], rest[1:]
         ck, cv, ksc, vsc, tail = _split_cache_args(cfg, rest)
+        toks, plen, blk, off = tail[:4]
+        tail = tail[4:]
+        if cfg.adapters:
+            slots, tail = tail[0], tail[1:]
         if cfg.sampling:
-            toks, plen, blk, off, temp, topp, topk, rng = tail
+            temp, topp, topk, rng = tail
         else:
-            toks, plen, blk, off, rng = tail
+            rng, = tail
         pos = jnp.arange(P)
         x = params[f"{name}_tok_embed_weight"][toks]       # (P, D)
         if cfg.pos_table is not None:
@@ -2609,9 +2860,9 @@ def _build_prefill(cfg, P, donate, shardings=None):
             p = f"{name}_l{i}"
             h = _ln(x, params[f"{p}_ln1_gamma"],
                     None if cfg.rmsnorm else params[f"{p}_ln1_beta"])
-            q = _wfc(params, f"{p}_q", h)
-            k = _wfc(params, f"{p}_k", h)
-            v = _wfc(params, f"{p}_v", h)
+            q = _awfc(cfg, params, adp, f"{p}_q", h, slots)
+            k = _awfc(cfg, params, adp, f"{p}_k", h, slots)
+            v = _awfc(cfg, params, adp, f"{p}_v", h, slots)
             qh = q.reshape(P, Hq, Dh)
             kh = k.reshape(P, Hkv, Dh)
             vh = v.reshape(P, Hkv, Dh)
@@ -2643,8 +2894,9 @@ def _build_prefill(cfg, P, donate, shardings=None):
             pr = jax.nn.softmax(sc.astype(jnp.float32),
                                 axis=-1).astype(x.dtype)
             at = jnp.einsum("kgqs,skd->qkgd", pr, vh)
-            x = x + _wfc(params, f"{p}_proj", at.reshape(P, d_model))
-            x = x + _mlp(cfg, params, p, x)
+            x = x + _awfc(cfg, params, adp, f"{p}_proj",
+                          at.reshape(P, d_model), slots)
+            x = x + _mlp(cfg, params, p, x, adp=adp, slots=slots)
         logits = _logits(cfg, params, x[plen - 1][None])
         caches = _cache_outs(cfg, ck, cv, ksc, vsc)
         if cfg.sampling:
@@ -2716,12 +2968,18 @@ def _build_chunk(cfg, C, donate, shardings=None):
         n_valid are padding: they write into the null block and their
         outputs are discarded).  Samples the token after position
         start+n_valid-1 — meaningful on the final chunk only."""
+        adp = slots = None
+        if cfg.adapters:
+            adp, rest = rest[0], rest[1:]
         ck, cv, ksc, vsc, tail = _split_cache_args(cfg, rest)
+        toks, start, n_valid, table, blk, off = tail[:6]
+        tail = tail[6:]
+        if cfg.adapters:
+            slots, tail = tail[0], tail[1:]
         if cfg.sampling:
-            toks, start, n_valid, table, blk, off, temp, topp, topk, \
-                rng = tail
+            temp, topp, topk, rng = tail
         else:
-            toks, start, n_valid, table, blk, off, rng = tail
+            rng, = tail
         pos = start + jnp.arange(C)
         x = params[f"{name}_tok_embed_weight"][toks]       # (C, D)
         if cfg.pos_table is not None:
@@ -2737,9 +2995,9 @@ def _build_chunk(cfg, C, donate, shardings=None):
             p = f"{name}_l{i}"
             h = _ln(x, params[f"{p}_ln1_gamma"],
                     None if cfg.rmsnorm else params[f"{p}_ln1_beta"])
-            q = _wfc(params, f"{p}_q", h)
-            k = _wfc(params, f"{p}_k", h)
-            v = _wfc(params, f"{p}_v", h)
+            q = _awfc(cfg, params, adp, f"{p}_q", h, slots)
+            k = _awfc(cfg, params, adp, f"{p}_k", h, slots)
+            v = _awfc(cfg, params, adp, f"{p}_v", h, slots)
             qh = q.reshape(C, Hq, Dh)
             kh = k.reshape(C, Hkv, Dh)
             vh = v.reshape(C, Hkv, Dh)
@@ -2772,8 +3030,9 @@ def _build_chunk(cfg, C, donate, shardings=None):
             pr = jax.nn.softmax(sc.astype(jnp.float32),
                                 axis=-1).astype(x.dtype)
             at = jnp.einsum("kgcs,skd->ckgd", pr, vb)
-            x = x + _wfc(params, f"{p}_proj", at.reshape(C, d_model))
-            x = x + _mlp(cfg, params, p, x)
+            x = x + _awfc(cfg, params, adp, f"{p}_proj",
+                          at.reshape(C, d_model), slots)
+            x = x + _mlp(cfg, params, p, x, adp=adp, slots=slots)
         logits = _logits(cfg, params, x[n_valid - 1][None])
         caches = _cache_outs(cfg, ck, cv, ksc, vsc)
         if cfg.sampling:

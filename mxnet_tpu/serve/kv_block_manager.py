@@ -138,7 +138,24 @@ def _block_key(parent, token_ids):
     return h.digest()
 
 
-def chain_keys(token_ids, block_size, max_blocks=None):
+def salted_root(salt):
+    """Radix root for a KV-affecting request condition — e.g. a LoRA
+    ``adapter_id``, whose K/V projections differ from the base
+    model's.  Equal salt means equal chain keys (same-adapter requests
+    share prefixes exactly like before); a different salt yields a
+    fully disjoint key space, so adapter K/V can never be reused for
+    base rows or across adapters — not by the local radix walk, not by
+    a handoff import, not by the fleet KV fabric.  ``None``/empty is
+    the historical unsalted root: every pre-adapter chain key is
+    byte-identical to what it always was."""
+    if not salt:
+        return _ROOT
+    h = hashlib.sha1(_ROOT)
+    h.update(str(salt).encode())
+    return h.digest()
+
+
+def chain_keys(token_ids, block_size, max_blocks=None, salt=None):
     """Chain keys of ``token_ids``'s full blocks, in prefix order.
 
     The tokenizer-side half of cache-aware routing: the fleet router
@@ -157,7 +174,7 @@ def chain_keys(token_ids, block_size, max_blocks=None):
     if max_blocks is not None:
         n_full = min(n_full, int(max_blocks))
     out = []
-    parent = _ROOT
+    parent = salted_root(salt)
     for b in range(n_full):
         key = _block_key(parent, token_ids[b * bs:(b + 1) * bs])
         out.append(key)
@@ -756,7 +773,7 @@ class BlockManager:
         return blocks_for(n_tokens, self.block_size) <= self.total_blocks
 
     # -- prefix lookup -------------------------------------------------------
-    def _walk(self, token_ids):
+    def _walk(self, token_ids, salt=None):
         """Longest cached prefix of ``token_ids`` at block granularity
         (called under ``_lock``): returns the matched device
         ``[(key, block)]`` chain plus the ``[key]`` continuation the
@@ -765,11 +782,13 @@ class BlockManager:
         engine to recompute (a fully-cached prompt still needs its last
         position's logits, and the recompute must never scribble into
         the shared final block) — host hits shed first: they are the
-        deeper end of the chain."""
+        deeper end of the chain.  ``salt`` scopes the chain (see
+        :func:`salted_root`): an adapter request can only ever hit
+        same-adapter K/V."""
         n = len(token_ids)
         bs = self.block_size
         hits = []
-        parent = _ROOT
+        parent = salted_root(salt)
         while (len(hits) + 1) * bs <= n:
             b = len(hits)
             key = _block_key(parent, token_ids[b * bs:(b + 1) * bs])
@@ -791,7 +810,7 @@ class BlockManager:
             (host or hits).pop()       # COW: recompute the final span
         return hits, host
 
-    def prefix_probe(self, token_ids):
+    def prefix_probe(self, token_ids, salt=None):
         """(cached_blocks, cached_tokens) an ``allocate`` with these
         ``token_ids`` would reuse — admission-time capacity math, no
         state mutated.  ``cached_blocks`` counts only DEVICE hits (the
@@ -801,11 +820,11 @@ class BlockManager:
         with self._lock:
             if not self.prefix_cache or token_ids is None:
                 return 0, 0
-            hits, host = self._walk(token_ids)
+            hits, host = self._walk(token_ids, salt=salt)
             return len(hits), (len(hits) + len(host)) * self.block_size
 
     # -- prefill/decode handoff ----------------------------------------------
-    def export_blocks(self, rid, token_ids):
+    def export_blocks(self, rid, token_ids, salt=None):
         """Serialize ``rid``'s cached prefix chain for ``token_ids``
         (its prompt) as wire records — the prefill side of a
         disaggregated prefill→decode handoff.
@@ -829,7 +848,7 @@ class BlockManager:
             bs = self.block_size
             n = len(token_ids)
             out = []
-            parent = _ROOT
+            parent = salted_root(salt)
             parent_key = None
             while (len(out) + 1) * bs <= n:
                 b = len(out)
@@ -848,7 +867,7 @@ class BlockManager:
                 parent = key
             return out
 
-    def import_blocks(self, records):
+    def import_blocks(self, records, salt=None):
         """Ingest handoff records into the host tier under their
         content keys — the decode side of a prefill→decode handoff.
 
@@ -867,7 +886,7 @@ class BlockManager:
         imported = deduped = 0
         with self._lock:
             expect_parent = None
-            parent = _ROOT
+            parent = salted_root(salt)
             for key, parent_key, token_ids, arrays in records:
                 if (parent_key != expect_parent
                         or len(token_ids) != self.block_size
@@ -977,7 +996,7 @@ class BlockManager:
                 return self._lru.pop(self._key_of[blk], None) is not None
             return False
 
-    def allocate(self, rid, n_tokens, token_ids=None):
+    def allocate(self, rid, n_tokens, token_ids=None, salt=None):
         """Create ``rid``'s block table covering ``n_tokens`` slots.
 
         Without ``token_ids`` (legacy callers): fresh blocks only,
@@ -1000,7 +1019,7 @@ class BlockManager:
                 self._free.extend(self._retained.pop(rid))
             hits, host_keys = [], []
             if self.prefix_cache and token_ids is not None:
-                hits, host_keys = self._walk(token_ids)
+                hits, host_keys = self._walk(token_ids, salt=salt)
             # clear-miss precheck BEFORE any mutation or eviction (the
             # same optimistic math as can_allocate, one walk instead of
             # two): a request that cannot fit even by reclaiming every
@@ -1156,7 +1175,7 @@ class BlockManager:
             return freed
 
     # -- publishing ----------------------------------------------------------
-    def note_tokens(self, rid, token_ids):
+    def note_tokens(self, rid, token_ids, salt=None):
         """Publish ``rid``'s newly-FULL blocks under their chain keys.
 
         ``token_ids`` is the sequence whose K/V has been written so far
@@ -1176,7 +1195,7 @@ class BlockManager:
             n_full = min(len(token_ids) // self.block_size, len(table))
             while len(chain) < n_full:
                 b = len(chain)
-                parent = chain[-1] if chain else _ROOT
+                parent = chain[-1] if chain else salted_root(salt)
                 key = _block_key(
                     parent,
                     token_ids[b * self.block_size:(b + 1) * self.block_size])

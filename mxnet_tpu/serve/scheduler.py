@@ -62,7 +62,7 @@ class Request:
 
     def __init__(self, prompt, max_new_tokens, deadline_s=None, tenant=None,
                  handoff=False, temperature=0.0, top_p=1.0, top_k=None,
-                 logprobs=0):
+                 logprobs=0, adapter_id=None):
         self.rid = next(_rid_counter)
         # prefill→decode handoff ingest (disaggregated fleets): the
         # decode replica marks the re-submitted request so the admit
@@ -85,6 +85,12 @@ class Request:
         self.top_p = float(top_p)
         self.top_k = int(top_k) if top_k else None
         self.logprobs = int(logprobs)
+        # multi-tenant LoRA: adapter_id names a registered adapter on
+        # the engine's AdapterStore; adapter_slot is the pinned device
+        # slot (0 = base model, a true zero delta) — an OPERAND of the
+        # bucket programs like the sampling params, never a trace key
+        self.adapter_id = str(adapter_id) if adapter_id is not None else None
+        self.adapter_slot = 0
         # n>1 sample-group bookkeeping (stamped by Engine.submit):
         # every member shares the primary's rid as ``group`` and the
         # primary carries the full handle list on ``samples``
@@ -146,6 +152,13 @@ class Request:
             samp["group"] = self.group
             samp["sample_index"] = self.sample_index
         return {"sampling": samp}
+
+    def trace_adapter(self):
+        """Admit-event trace field for the request's adapter —
+        only-when-set (same rule as :meth:`trace_sampling`)."""
+        if self.adapter_id is None:
+            return {}
+        return {"adapter": self.adapter_id}
 
 
 class Scheduler:
@@ -467,8 +480,11 @@ class Scheduler:
                     # evictable).  A failed allocate undoes its hit
                     # refs, so treating both as does-not-fit-yet is
                     # safe — the request stays at the queue head
-                    _, cached = self.blocks.allocate(req.rid, need,
-                                                     token_ids=ids)
+                    _, cached = self.blocks.allocate(
+                        req.rid, need, token_ids=ids,
+                        # adapter-salted radix chain: an adapter row
+                        # can only ever reuse same-adapter K/V
+                        salt=req.adapter_id)
                 except NoFreeBlocks:
                     break
                 self.waiting.remove(req)
@@ -491,7 +507,9 @@ class Scheduler:
                     # byte-identical to pre-handoff releases
                     **({"handoff": True} if req.handoff else {}),
                     # per-request sampling params (only-when-on too)
-                    **req.trace_sampling())
+                    **req.trace_sampling(),
+                    # the request's LoRA adapter (only-when-set)
+                    **req.trace_adapter())
                 prefills.append(req)
                 if chunked:
                     self.prefilling.append(req)

@@ -435,10 +435,9 @@ def _build_verify(cfg, k, donate, shardings=None):
     the emitted tokens' logprob views, so the host's only sync is the
     result.
     """
-    from .engine import (_cache_outs, _filter_logits, _kv_dequant,
+    from .engine import (_awfc, _cache_outs, _filter_logits, _kv_dequant,
                          _kv_quant_vals, _ln, _logits, _logprob_outs,
-                         _mlp, _safe_log, _sample, _split_cache_args,
-                         _wfc)
+                         _mlp, _safe_log, _sample, _split_cache_args)
 
     name = cfg.name
     Hq, Hkv, Dh = cfg.num_heads, cfg.kv_heads, cfg.head_dim
@@ -454,13 +453,23 @@ def _build_verify(cfg, k, donate, shardings=None):
         the target's (B, K1) greedy tokens (row j's token decided after
         consuming rows 0..j) — or, in sampling mode, the
         rejection-sampled emit rows + accepted counts + logprobs."""
+        adp = slots = None
+        if cfg.adapters:
+            adp, rest = rest[0], rest[1:]
         ck, cv, ksc, vsc, tail = _split_cache_args(cfg, rest)
         if cfg.sampling:
-            toks0, drafted, q_at, q_vals, q_idx, pos0, tables, temp, \
-                topp, topk, rng = tail
+            toks0, drafted, q_at, q_vals, q_idx, pos0, tables = tail[:7]
+            tail = tail[7:]
             rows = jnp.concatenate([toks0[:, None], drafted], axis=1)
         else:
-            rows, pos0, tables, rng = tail
+            rows, pos0, tables = tail[:3]
+            tail = tail[3:]
+        if cfg.adapters:
+            slots, tail = tail[0], tail[1:]
+        if cfg.sampling:
+            temp, topp, topk, rng = tail
+        else:
+            rng, = tail
         B = rows.shape[0]
         pos = pos0[:, None] + jnp.arange(K1)[None, :]      # (B, K1)
         x = params[f"{name}_tok_embed_weight"][rows]       # (B, K1, D)
@@ -487,9 +496,9 @@ def _build_verify(cfg, k, donate, shardings=None):
             p = f"{name}_l{i}"
             h = _ln(x, params[f"{p}_ln1_gamma"],
                     None if cfg.rmsnorm else params[f"{p}_ln1_beta"])
-            q = _wfc(params, f"{p}_q", h)
-            kk = _wfc(params, f"{p}_k", h)
-            v = _wfc(params, f"{p}_v", h)
+            q = _awfc(cfg, params, adp, f"{p}_q", h, slots)
+            kk = _awfc(cfg, params, adp, f"{p}_k", h, slots)
+            v = _awfc(cfg, params, adp, f"{p}_v", h, slots)
             qh = q.reshape(B, K1, Hq, Dh)
             kh = kk.reshape(B, K1, Hkv, Dh)
             vh = v.reshape(B, K1, Hkv, Dh)
@@ -523,8 +532,9 @@ def _build_verify(cfg, k, donate, shardings=None):
             pr = jax.nn.softmax(sc.astype(jnp.float32),
                                 axis=-1).astype(x.dtype)
             at = jnp.einsum("bkgcs,bskd->bckgd", pr, vb)
-            x = x + _wfc(params, f"{p}_proj", at.reshape(B, K1, d_model))
-            x = x + _mlp(cfg, params, p, x)
+            x = x + _awfc(cfg, params, adp, f"{p}_proj",
+                          at.reshape(B, K1, d_model), slots)
+            x = x + _mlp(cfg, params, p, x, adp=adp, slots=slots)
         logits = _logits(cfg, params, x)                   # (B, K1, V)
         caches = _cache_outs(cfg, ck, cv, ksc, vsc)
         if cfg.sampling:

@@ -171,6 +171,10 @@ class ServeStats:
     # per-tenant admission/outcome/latency table
     # (Scheduler.tenant_stats) — empty until requests carry tenants
     tenants: dict = field(default_factory=dict)
+    # per-adapter goodput ({adapter_id: {completed, tokens}}) — empty
+    # until requests carry adapter ids (the fleet catalog's per-model
+    # traffic ground truth)
+    adapters: dict = field(default_factory=dict)
 
     def as_dict(self):
         return asdict(self)
@@ -251,6 +255,16 @@ class StatsRecorder:
         self._m_spec_rejected = telemetry.counter(
             "mxtpu_serve_spec_rejected_tokens_total",
             "drafted tokens the target model rejected")
+        # per-adapter goodput: rows appear only for requests that
+        # carried an adapter id, so adapter-less serving keeps the
+        # historical snapshot/registry shape
+        self.adapters = {}
+        self._m_adapter_completed = telemetry.counter(
+            "mxtpu_serve_adapter_completed_total",
+            "completed requests by LoRA adapter", ("adapter",))
+        self._m_adapter_tokens = telemetry.counter(
+            "mxtpu_serve_adapter_tokens_total",
+            "decode tokens emitted by LoRA adapter", ("adapter",))
 
     def on_verify(self, drafted, accepted, stochastic=False):
         """One speculative verify pass: ``drafted`` tokens proposed,
@@ -354,6 +368,15 @@ class StatsRecorder:
         self.prompt_tokens += int(req.prompt.size)
         self._m_completed.inc()
         self._m_prompt_tokens.inc(int(req.prompt.size))
+        adapter = getattr(req, "adapter_id", None)
+        if adapter is not None:
+            row = self.adapters.setdefault(
+                adapter, {"completed": 0, "tokens": 0})
+            row["completed"] += 1
+            row["tokens"] += len(req.tokens)
+            self._m_adapter_completed.labels(adapter=adapter).inc()
+            self._m_adapter_tokens.labels(adapter=adapter).inc(
+                len(req.tokens))
 
     def on_reject(self):
         """Counts the Prometheus back-pressure series only.  The
@@ -442,6 +465,7 @@ class StatsRecorder:
             decode_occupancy=occupancy,
             reject_reasons=dict(scheduler.reject_reasons),
             tenants=scheduler.tenant_stats(),
+            adapters={a: dict(row) for a, row in self.adapters.items()},
             prefill_tokens_computed=self.prefill_tokens_computed,
             prefix_hits=pfx["hits"],
             prefix_misses=pfx["misses"],

@@ -229,6 +229,10 @@ class RequestTracer:
         # e.g. an SLO-offending request — to the replica that served
         # it); None keeps the line schema byte-identical to older runs
         self.identity = None
+        # catalog model id stamped like identity (the fleet front sets
+        # it): lets ``trace_report --stitch`` show which checkpoint
+        # served each hop.  None keeps the historical schema
+        self.model = None
         self.enabled = path is not None or self._pusher is not None
         if sample is None:
             try:
@@ -340,6 +344,11 @@ class RequestTracer:
                   "events": events}
         if self.identity is not None:      # only-when-set: schema pin
             record["replica"] = self.identity
+        if self.model is not None:         # only-when-set: schema pin
+            record["model"] = self.model
+        adapter = getattr(req, "adapter_id", None)
+        if adapter is not None:            # only-when-set: schema pin
+            record["adapter"] = adapter
         if self.source != "serve":
             # mark non-engine lines (the router's) so the collector's
             # SLO layer can tell client-truth lines from replica-local

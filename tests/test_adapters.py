@@ -1,0 +1,548 @@
+"""Paged multi-tenant LoRA adapter multiplexing (mxnet_tpu/serve/
+adapters.py + the engine's slot operand).
+
+The contracts under test:
+
+* trace-key inertness — an adapters-off engine (the default) keeps the
+  HISTORICAL programs: same `_spec_key`, same AOT fingerprint (no
+  adapters keys), same warmup grid, identical tokens — an upgraded
+  adapter-less fleet keeps its artifacts byte-for-byte;
+* operands, not trace keys — ONE warmed bucketed program serves any
+  mix of base + adapter rows with ZERO fresh traces, and reassigning
+  every request's adapter never recompiles;
+* correctness — every multiplexed row emits exactly the tokens of a
+  single-tenant engine serving the merged checkpoint
+  ``W + (alpha/r) * B @ A`` (token-level, the additive formulation),
+  and a slot-0/base row is byte-identical to an adapters-off engine;
+* composition — the same guarantees hold under preemption-resume,
+  speculative decoding's verify program, weight-only int8 base
+  weights, and tp=2 sharded serving;
+* the adapter-salted radix chain — same-adapter resubmits hit the
+  prefix cache, cross-adapter resubmits MISS it (adapter K/V is
+  content-disjoint from base K/V), and the unsalted chain is the
+  historical one;
+* slot discipline — the AdapterStore's content-addressed dedup,
+  refcounted pins, LRU device eviction, host-tier budget, disk/wire
+  codecs (sha1-verified), and the transient ``adapter_slots``
+  rejection when every slot is pinned.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu.serve import adapters as adapters_mod
+from mxnet_tpu.serve import engine as engine_mod
+from mxnet_tpu.serve.adapters import AdapterStore, NoAdapterSlots
+from mxnet_tpu.serve.kv_block_manager import (BlockManager, chain_keys,
+                                              salted_root, _ROOT)
+
+VOCAB = 53
+
+
+@pytest.fixture(scope="module")
+def model():
+    S = 96
+    net = mx.models.gpt(VOCAB, S, num_layers=2, d_model=32, num_heads=4)
+    arg_shapes, _, _ = net.infer_shape(data=(1, S), softmax_label=(1, S))
+    rng = np.random.RandomState(3)
+    params = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        scale = 0.35 if name.endswith("weight") else 0.0
+        params[name] = (rng.randn(*shp) * scale
+                        + (1.0 if name.endswith("gamma") else 0.0)
+                        ).astype(np.float32)
+    return net, params
+
+
+def _engine(model, params=None, **kw):
+    net, p = model
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("max_prefills_per_step", 2)
+    return mx.serve.Engine(params if params is not None else p,
+                           symbol=net, **kw)
+
+
+def _prompts(ns=(7, 12, 5, 9), seed=7):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, (n,)).astype(np.int32) for n in ns]
+
+
+def _stems(params):
+    return adapters_mod.gpt_stems("gpt", 2, False, False, params)
+
+
+def _lora(params, rank=4, seed=11, scale=0.1):
+    """One adapter's ``{stem: (A, B)}`` deltas — strong enough to move
+    greedy tokens, small enough to stay numerically tame."""
+    rng = np.random.RandomState(seed)
+    out = {}
+    for stem, (dout, din) in _stems(params).items():
+        out[stem] = ((rng.randn(rank, din) * scale).astype(np.float32),
+                     (rng.randn(dout, rank) * scale).astype(np.float32))
+    return out
+
+
+def _merged(params, arrays, alpha):
+    """The single-tenant reference checkpoint: W + (alpha/r) * B @ A."""
+    rank = next(iter(arrays.values()))[0].shape[0]
+    mp = dict(params)
+    for stem, (a, b) in arrays.items():
+        w = mp[f"{stem}_weight"]
+        mp[f"{stem}_weight"] = (
+            w.astype(np.float32) + (alpha / rank) * (b @ a)
+        ).astype(w.dtype)
+    return mp
+
+
+def _family(params, k=3, rank=4):
+    return {f"tenant-{j}": _lora(params, rank=rank, seed=20 + j)
+            for j in range(k)}
+
+
+def _run(eng, prompts, max_new=8, adapter_ids=None):
+    reqs = [eng.submit(p, max_new_tokens=max_new,
+                       adapter_id=None if adapter_ids is None
+                       else adapter_ids[i])
+            for i, p in enumerate(prompts)]
+    eng.run()
+    assert all(r.status == "finished" for r in reqs)
+    return [list(r.tokens) for r in reqs]
+
+
+# -- adapters-off inertness ---------------------------------------------------
+def test_adapters_off_keeps_historical_fingerprint(model):
+    """The only-when-on rule: an adapters-off engine's program-cache
+    key and AOT fingerprint never grow adapter fields — an upgraded
+    adapter-less fleet keeps its compiled artifacts byte-for-byte."""
+    a = _engine(model)
+    b = _engine(model)
+    assert not a._adapters and a.adapter_store is None
+    fp = a._aot_base_fp()
+    assert "adapters" not in fp["cfg"]
+    assert "adapter_rank" not in fp["cfg"]
+    assert a._spec_key() == b._spec_key()
+    assert a._aot_base_fp() == b._aot_base_fp()
+    assert a._warmup_grid() == b._warmup_grid()
+    # the adapters engine is a DIFFERENT program family, declared so
+    c = _engine(model, adapters=4, adapter_rank=4)
+    assert c._spec_key() != a._spec_key()
+    fpc = c._aot_base_fp()
+    assert fpc["cfg"]["adapters"] == 4
+    assert fpc["cfg"]["adapter_rank"] == 4
+    assert c.statusz()["adapters"]["slots"] == 4
+    assert a.statusz()["adapters"] is None
+    for e in (a, b, c):
+        e.shutdown()
+
+
+def test_adapters_validation(model):
+    with pytest.raises(ValueError, match="adapters"):
+        _engine(model, adapters=1)          # slot 0 is reserved: >= 2
+    with pytest.raises(ValueError, match="adapters"):
+        _engine(model, adapters=-2)
+    with pytest.raises(ValueError, match="adapter_rank"):
+        _engine(model, adapters=2, adapter_rank=0)
+    eng = _engine(model)                    # off: adapter_id refused
+    with pytest.raises(ValueError, match="adapters-mode"):
+        eng.submit(_prompts()[0], adapter_id="x")
+    eng.shutdown()
+    eng = _engine(model, adapters=4, adapter_rank=4)
+    with pytest.raises(ValueError, match="unknown adapter"):
+        eng.submit(_prompts()[0], adapter_id="never-registered")
+    eng.shutdown()
+
+
+def test_adapters_env_default(model, monkeypatch):
+    monkeypatch.setenv("MXTPU_SERVE_ADAPTERS", "3")
+    monkeypatch.setenv("MXTPU_SERVE_ADAPTER_RANK", "2")
+    eng = _engine(model)
+    assert eng._adapters == 3 and eng.adapter_rank == 2
+    assert eng.statusz()["adapters"]["slots"] == 3
+    eng.shutdown()
+
+
+# -- THE tentpole: mixed batch, zero fresh traces, merged parity --------------
+def test_mixed_batch_zero_fresh_traces_and_merged_parity(model):
+    """One warmed engine serves base + 3 distinct adapters in one
+    batch with ZERO fresh traced programs, each row token-identical
+    to its tenant's merged-weights single-tenant engine, and the base
+    row byte-identical to an adapters-off engine."""
+    net, params = model
+    family = _family(params, k=3, rank=4)
+    alpha = 8.0
+    prompts = _prompts()
+    ids = [None, "tenant-0", "tenant-1", "tenant-2"]
+
+    eng = _engine(model, adapters=4, adapter_rank=4)
+    for aid, arrays in family.items():
+        eng.adapter_store.register(aid, arrays, alpha=alpha)
+    eng.warmup()
+    before = len(engine_mod._STEP_CACHE)
+    mux = _run(eng, prompts, adapter_ids=ids)
+    assert len(engine_mod._STEP_CACHE) == before, \
+        "mixed adapter batch traced fresh programs"
+    # reassign EVERY row's adapter: still nothing new to compile
+    rotated = ids[1:] + ids[:1]
+    mux2 = _run(eng, prompts, adapter_ids=rotated)
+    assert len(engine_mod._STEP_CACHE) == before, \
+        "reassigning request adapters recompiled"
+    eng.shutdown()
+
+    # single-tenant references: adapters-off engines per checkpoint
+    off = _engine(model)
+    base_ref = _run(off, prompts)
+    off.shutdown()
+    assert mux[0] == base_ref[0], \
+        "slot-0/base row diverged from the adapters-off engine"
+    for row, aid in enumerate(ids):
+        if aid is None:
+            continue
+        ref = _engine(model,
+                      params=_merged(params, family[aid], alpha))
+        want = _run(ref, [prompts[row]])[0]
+        ref.shutdown()
+        assert mux[row] == want, \
+            f"row {row} ({aid}) diverged from its merged-weights ref"
+    # the rotated pass too (same rows, new tenants — fresh K/V chains):
+    # rotated[0] is tenant-0 on prompt 0
+    ref = _engine(model, params=_merged(params, family["tenant-0"],
+                                        alpha))
+    assert mux2[0] == _run(ref, [prompts[0]])[0]
+    ref.shutdown()
+    # and the adapters really moved tokens (non-vacuous)
+    assert any(mux[i] != base_ref[i] for i in (1, 2, 3)), \
+        "adapter deltas never changed a token — test is vacuous"
+
+
+def test_adapter_preemption_resume_equivalence(model):
+    """A cache-starved adapters engine preempts mid-generation; every
+    row (base and adapter alike) still reproduces the uncontended
+    run's tokens — the slot pin survives preemption."""
+    net, params = model
+    family = _family(params, k=2, rank=4)
+    prompts = _prompts((8, 14, 10, 16), seed=13)
+    ids = [None, "tenant-0", "tenant-1", "tenant-0"]
+
+    def run(num_blocks):
+        eng = _engine(model, adapters=3, adapter_rank=4,
+                      num_blocks=num_blocks)
+        for aid, arrays in family.items():
+            eng.adapter_store.register(aid, arrays, alpha=8.0)
+        toks = _run(eng, prompts, max_new=24, adapter_ids=ids)
+        st = eng.stats()
+        eng.shutdown()
+        return toks, st
+
+    calm, calm_st = run(num_blocks=64)
+    tight, tight_st = run(num_blocks=20)
+    assert calm_st.preemptions == 0
+    assert tight_st.preemptions > 0, \
+        "workload did not create cache pressure — test is vacuous"
+    assert calm == tight
+
+
+def test_adapter_spec_decode_parity(model):
+    """Rejection-free greedy spec decoding through the verify program
+    (which also threads the slot operand) is token-identical to the
+    plain adapters engine, per adapter."""
+    net, params = model
+    draft = dict(params)
+    for k, v in params.items():
+        if k.startswith("gpt_l1_") and (k.endswith("proj_weight")
+                                        or k.endswith("ff_down_weight")):
+            draft[k] = v * 0.05
+    family = _family(params, k=2, rank=4)
+    prompts = _prompts((9, 13), seed=17)
+    ids = ["tenant-0", "tenant-1"]
+
+    def run(**kw):
+        eng = _engine(model, adapters=3, adapter_rank=4, **kw)
+        for aid, arrays in family.items():
+            eng.adapter_store.register(aid, arrays, alpha=8.0)
+        toks = _run(eng, prompts, max_new=12, adapter_ids=ids)
+        eng.shutdown()
+        return toks
+
+    plain = run()
+    spec = run(spec_k=3, draft_params=draft, draft_num_heads=4,
+               draft_window=0)
+    assert spec == plain
+
+
+def test_adapter_int8_base_compose(model):
+    """Adapters over weight-only int8 base weights: the delta rides
+    the dequantized matmul — token-identical to the int8 engine
+    serving the merged (then re-quantized) checkpoint."""
+    net, params = model
+    family = _family(params, k=1, rank=4)
+    prompts = _prompts((10,), seed=19)
+
+    eng = _engine(model, adapters=2, adapter_rank=4, quantize="int8")
+    eng.adapter_store.register("tenant-0", family["tenant-0"],
+                               alpha=8.0)
+    mux = _run(eng, prompts, adapter_ids=["tenant-0"])
+    eng.shutdown()
+    ref = _engine(model, quantize="int8",
+                  params=_merged(params, family["tenant-0"], 8.0))
+    want = _run(ref, prompts)
+    ref.shutdown()
+    assert mux == want
+
+
+def test_adapter_tp2_parity(model):
+    """tp=2 sharded adapter stacks (B on the out axis, A on the in
+    axis, partial-sums joining the layer all-reduce) emit exactly the
+    tp=1 engine's tokens."""
+    net, params = model
+    family = _family(params, k=2, rank=4)
+    prompts = _prompts((8, 12, 6), seed=23)
+    ids = [None, "tenant-0", "tenant-1"]
+
+    def run(tp):
+        eng = _engine(model, adapters=3, adapter_rank=4, tp=tp)
+        for aid, arrays in family.items():
+            eng.adapter_store.register(aid, arrays, alpha=8.0)
+        toks = _run(eng, prompts, adapter_ids=ids)
+        eng.shutdown()
+        return toks
+
+    assert run(2) == run(1)
+
+
+# -- the adapter-salted radix chain -------------------------------------------
+def test_salted_root_and_chain_keys():
+    """No salt IS the historical chain (byte-identical keys); each
+    salt is its own disjoint key space."""
+    ids = list(range(1, 13))
+    assert salted_root(None) == _ROOT
+    assert salted_root("") == _ROOT
+    assert chain_keys(ids, 4) == chain_keys(ids, 4, salt=None)
+    a = chain_keys(ids, 4, salt="tenant-a")
+    b = chain_keys(ids, 4, salt="tenant-b")
+    base = chain_keys(ids, 4)
+    assert len({a[0], b[0], base[0]}) == 3
+    assert not set(a) & set(b) and not set(a) & set(base)
+
+
+def test_block_manager_salted_reuse():
+    """Same-salt resubmits hit the cached chain; cross-salt resubmits
+    (adapter vs base, adapter vs adapter) never can."""
+    ids = np.arange(1, 13, dtype=np.int32)
+    m = BlockManager(num_blocks=32, block_size=4)
+    m.allocate("r0", 12, token_ids=ids, salt="a")
+    m.note_tokens("r0", ids, salt="a")
+    m.free("r0")                              # park published
+    # the final block always recomputes (the row needs a position to
+    # decode from), so a 12-token/3-block prompt reuses 2 blocks
+    _, hit = m.allocate("r1", 12, token_ids=ids, salt="a")
+    assert hit == 8, "same-adapter resubmit missed its own chain"
+    m.free("r1")
+    _, hit = m.allocate("r2", 12, token_ids=ids, salt="b")
+    assert hit == 0, "adapter chain leaked across salts"
+    m.free("r2")
+    _, hit = m.allocate("r3", 12, token_ids=ids)
+    assert hit == 0, "adapter chain leaked into the base space"
+
+
+def test_engine_salted_prefix_cache_token_safety(model):
+    """End-to-end: resubmitting a prompt under a DIFFERENT adapter
+    must not reuse the first tenant's K/V — tokens match each
+    tenant's cold-cache reference exactly."""
+    net, params = model
+    family = _family(params, k=2, rank=4)
+    p = _prompts((16,), seed=29)[0]
+
+    def cold(aid):
+        eng = _engine(model, adapters=3, adapter_rank=4)
+        for a, arrays in family.items():
+            eng.adapter_store.register(a, arrays, alpha=8.0)
+        toks = _run(eng, [p], adapter_ids=[aid])[0]
+        eng.shutdown()
+        return toks
+
+    eng = _engine(model, adapters=3, adapter_rank=4)
+    for a, arrays in family.items():
+        eng.adapter_store.register(a, arrays, alpha=8.0)
+    warm = {}
+    for aid in (None, "tenant-0", "tenant-1", "tenant-0", None):
+        warm[aid] = _run(eng, [p], adapter_ids=[aid])[0]
+    hits = eng.blocks.prefix_stats()["hits"]
+    eng.shutdown()
+    assert hits > 0, "same-adapter resubmit never hit — vacuous"
+    for aid in (None, "tenant-0", "tenant-1"):
+        assert warm[aid] == cold(aid), \
+            f"prefix cache corrupted tokens for adapter {aid!r}"
+    assert len({tuple(v) for v in warm.values()}) == 3
+
+
+# -- slot discipline (AdapterStore unit tests) --------------------------------
+def _store(params, rank=4, slots=3, **kw):
+    return AdapterStore(_stems(params), rank, slots, **kw)
+
+
+def test_store_register_validation(model):
+    _, params = model
+    s = _store(params)
+    la = _lora(params, rank=4)
+    with pytest.raises(ValueError, match="non-empty"):
+        s.register("", la)
+    with pytest.raises(ValueError, match="unknown projection"):
+        s.register("x", {"gpt_l9_q": la["gpt_l0_q"]})
+    with pytest.raises(ValueError, match="no projection"):
+        s.register("x", {})
+    bad = dict(la)
+    a, b = bad["gpt_l0_q"]
+    with pytest.raises(ValueError, match="want A"):
+        s.register("x", dict(bad, gpt_l0_q=(a[:, :-1], b)))
+    with pytest.raises(ValueError, match="want A"):
+        s.register("x", dict(bad, gpt_l0_q=(np.zeros((9, a.shape[1]),
+                                                     np.float32), b)))
+    mixed = dict(la, gpt_l0_q=(a[:2], b[:, :2]))
+    with pytest.raises(ValueError, match="mixed per-stem ranks"):
+        s.register("x", mixed)
+
+
+def test_store_dedup_refcount_and_eviction(model):
+    _, params = model
+    s = _store(params, slots=3)               # 2 usable slots
+    la, lb, lc = (_lora(params, rank=4, seed=s_) for s_ in (1, 2, 3))
+    d1 = s.register("a", la)
+    assert s.register("a-alias", la) == d1    # content-addressed
+    s.register("b", lb)
+    s.register("c", lc)
+    assert s.known("a") and s.ids() == ["a", "a-alias", "b", "c"]
+    sa = s.acquire("a")
+    assert s.acquire("a-alias") == sa         # one slot, refcount 2
+    sb = s.acquire("b")
+    assert s.stats()["slots_pinned"] == 2
+    with pytest.raises(NoAdapterSlots):
+        s.acquire("c")                        # both slots pinned
+    s.release(sb)                             # b cold now
+    sc = s.acquire("c")                       # evicts cold b
+    assert sc == sb and s.device_evictions == 1
+    assert "b" not in s.loaded() and "c" in s.loaded()
+    s.release(sa)
+    s.release(sa)
+    s.release(sc)
+    assert s.stats()["slots_pinned"] == 0
+    # release is idempotent / bounds-safe
+    s.release(sc)
+    s.release(0)
+    s.release(99)
+
+
+def test_store_unload_and_forget(model):
+    _, params = model
+    s = _store(params, slots=3)
+    s.register("a", _lora(params, rank=4, seed=1))
+    slot = s.acquire("a")
+    with pytest.raises(RuntimeError, match="pinned"):
+        s.unload("a")
+    s.release(slot)
+    assert s.unload("a") is True              # cold: off the device
+    assert s.unload("a") is False             # already off
+    assert s.known("a")                       # registration stays
+    assert s.forget("a") is True              # de-cataloged entirely
+    assert not s.known("a") and s.forget("a") is False
+
+
+def test_store_host_tier_budget(model):
+    _, params = model
+    la = _lora(params, rank=4, seed=1)
+    nbytes = sum(a.nbytes + b.nbytes for a, b in la.values())
+    s = _store(params, slots=3, host_bytes=int(nbytes * 2.5))
+    s.register("a", la)
+    s.register("b", _lora(params, rank=4, seed=2))
+    s.register("c", _lora(params, rank=4, seed=3))   # evicts LRU "a"
+    assert s.host_evictions == 1 and not s.known("a")
+    assert s.known("b") and s.known("c")
+    with pytest.raises(ValueError, match="exceeds the host tier"):
+        AdapterStore(_stems(params), 4, 3,
+                     host_bytes=nbytes // 2).register("big", la)
+    # device-resident entries never evict from the host tier
+    slot = s.acquire("b")
+    s.register("d", _lora(params, rank=4, seed=4))   # evicts "c" not "b"
+    assert s.known("b") and not s.known("c")
+    s.release(slot)
+
+
+def test_store_disk_and_wire_roundtrip(model, tmp_path):
+    _, params = model
+    s = _store(params)
+    la = _lora(params, rank=3)                 # rank < ceiling: padded
+    d = s.register("a", la, alpha=6.0)
+    path = str(tmp_path / "a.npz")
+    s.save_file("a", path)
+    s2 = _store(params)
+    assert s2.load_file("a2", path) == d       # digest-identical
+    payload = s.export_records("a")
+    assert payload["digest"] == d and payload["rank"] == 3
+    s3 = _store(params)
+    assert s3.import_records("a3", payload) == d
+    # a flipped byte fails its per-array sha1 and rejects the adapter
+    corrupt = dict(payload)
+    corrupt["records"] = [dict(r) for r in payload["records"]]
+    corrupt["records"][0]["data"] = \
+        corrupt["records"][0]["data"][:-4] + "AAA="
+    with pytest.raises(ValueError, match="sha1"):
+        _store(params).import_records("bad", corrupt)
+    with pytest.raises(ValueError, match="A/B half"):
+        _store(params).import_records(
+            "half", {"alpha": 6.0,
+                     "records": payload["records"][:1]})
+
+
+def test_engine_adapter_slots_transient_rejection(model):
+    """All slots pinned is capacity pressure, not an error: the
+    request rejects with the retriable ``adapter_slots`` reason and
+    succeeds once a pin drops."""
+    net, params = model
+    family = _family(params, k=2, rank=4)
+    eng = _engine(model, adapters=2, adapter_rank=4)  # ONE usable slot
+    for aid, arrays in family.items():
+        eng.adapter_store.register(aid, arrays, alpha=8.0)
+    p = _prompts((8,), seed=31)[0]
+    r1 = eng.submit(p, max_new_tokens=4, adapter_id="tenant-0")
+    r2 = eng.submit(p, max_new_tokens=4, adapter_id="tenant-1")
+    assert r2.status == "rejected"
+    assert r2.reject_reason == "adapter_slots"
+    eng.run()
+    assert r1.status == "finished"
+    r3 = eng.submit(p, max_new_tokens=4, adapter_id="tenant-1")
+    assert r3.status != "rejected"            # pin dropped at terminal
+    eng.run()
+    assert r3.status == "finished"
+    eng.shutdown()
+
+
+def test_adapter_stats_and_telemetry(model):
+    """Per-adapter completion/token counters ride the stats snapshot
+    (the collector's per-model aggregation reads them)."""
+    net, params = model
+    family = _family(params, k=2, rank=4)
+    eng = _engine(model, adapters=3, adapter_rank=4)
+    for aid, arrays in family.items():
+        eng.adapter_store.register(aid, arrays, alpha=8.0)
+    prompts = _prompts((8, 10, 12), seed=37)
+    _run(eng, prompts, max_new=4,
+         adapter_ids=["tenant-0", "tenant-1", "tenant-0"])
+    snap = eng.stats()
+    assert snap.adapters == {
+        "tenant-0": {"completed": 2, "tokens": 8},
+        "tenant-1": {"completed": 1, "tokens": 4}}
+    info = eng.adapter_info()
+    assert info["slots_used"] == 2 and info["loads"] == 2
+    assert info["ids"] == ["tenant-0", "tenant-1"]
+    eng.shutdown()

@@ -380,6 +380,43 @@ def test_perf_attrib_bench_contract():
 
 
 @pytest.mark.slow
+def test_lora_bench_contract():
+    """tools/serve_bench.py --workload lora (the LORA_BENCH.json
+    bench_watch stage) on CPU smoke shapes: one multiplexed engine
+    serves base + 3 LoRA adapters with zero fresh traces on the
+    rotated second pass and token-identical output against per-tenant
+    merged-weights engines — the invariants the serve_lora watchdog
+    gate trusts."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # no tunnel for a CPU smoke
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--backend", "cpu", "--workload", "lora",
+         "--layers", "2", "--d-model", "32", "--heads", "4",
+         "--vocab", "128", "--requests", "8", "--concurrency", "4",
+         "--max-new", "8", "--prompt-lens", "8,12,16",
+         "--block-size", "4"],
+        capture_output=True, text=True, timeout=540, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads([l for l in r.stdout.splitlines()
+                          if l.startswith("{")][-1])
+    assert payload["platform"] == "cpu"
+    assert payload["complete"] is True      # stamped BEFORE the print
+    # the acceptance bars the serve_lora stage gates on
+    assert payload["fresh_traces_second_pass"] == 0
+    assert payload["agreement_vs_merged"] >= 0.98
+    assert payload["tokens_identical"] is True
+    assert payload["lora_adapters"] == 3
+    assert payload["mux_overhead_ratio"] > 0
+    rec = payload["points"][0]
+    assert rec["completed_off"] == 8
+    assert rec["completed_mux"] == 8
+    assert rec["adapter_slots_used"] == 3
+    assert rec["adapter_loads"] >= 3
+    assert "telemetry" in payload
+
+
+@pytest.mark.slow
 def test_train_bench_contract(tmp_path):
     """tools/train_bench.py (the TRAIN_BENCH.json bench_watch stage)
     emits the training-path comparison on a CPU smoke config: both

@@ -1,0 +1,349 @@
+"""Fleet model catalog (mxnet_tpu/fleet): replicas declare what they
+carry, the router filters by it, the collector aggregates per model,
+and the supervisor's rebalancer moves adapters to follow traffic.
+
+The contracts under test:
+
+* advertisement — a replica's checkpoint id (``model=`` /
+  ``MXTPU_FLEET_MODEL``) and registered adapter ids ride ``/healthz``
+  and ``/statusz.json``;
+* clean 400s — a model/adapter mismatch on ``/generate`` is a
+  structured non-retriable 400 (``wrong_model`` / ``unknown_adapter``
+  / ``adapters_off``), NEVER a 500 that would open breakers;
+* routing — the router serves two model ids side by side, lands each
+  request on a replica advertising its model (and adapter), and
+  rejects an unknown model id with :class:`PermanentError` before any
+  hop;
+* runtime adapter movement — ``/adapter_export`` →
+  ``/load_adapter`` copies an adapter replica-to-replica over the
+  wire (sha1-verified), ``/unload_adapter`` de-catalogs it;
+* aggregation — ``FleetCollector.fleet_view()["models"]`` groups
+  replicas, traffic, and per-adapter goodput by model tag;
+* rebalance — ``CatalogRebalancer`` plans spread moves for hot
+  adapters missing from replicas of their model, applies them capped
+  with per-move failure isolation, and the
+  ``Supervisor.rebalance_catalog`` actuator wraps one pass.
+"""
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu.fleet import (CatalogRebalancer, FleetCollector,
+                             PermanentError, ReplicaServer, Router,
+                             Supervisor)
+
+VOCAB = 53
+
+
+@pytest.fixture(scope="module")
+def model():
+    S = 96
+    net = mx.models.gpt(VOCAB, S, num_layers=2, d_model=32, num_heads=4)
+    arg_shapes, _, _ = net.infer_shape(data=(1, S), softmax_label=(1, S))
+    rng = np.random.RandomState(3)
+    params = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        scale = 0.35 if name.endswith("weight") else 0.0
+        params[name] = (rng.randn(*shp) * scale
+                        + (1.0 if name.endswith("gamma") else 0.0)
+                        ).astype(np.float32)
+    return net, params
+
+
+def _engine(model, **kw):
+    net, params = model
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("max_prefills_per_step", 2)
+    return mx.serve.Engine(params, symbol=net, **kw)
+
+
+def _lora(model, rank=4, seed=11):
+    from mxnet_tpu.serve import adapters as adapters_mod
+
+    net, params = model
+    rng = np.random.RandomState(seed)
+    out = {}
+    stems = adapters_mod.gpt_stems("gpt", 2, False, False, params)
+    for stem, (dout, din) in stems.items():
+        out[stem] = ((rng.randn(rank, din) * 0.1).astype(np.float32),
+                     (rng.randn(dout, rank) * 0.1).astype(np.float32))
+    return out
+
+
+def _adapter_replica(model, rid, model_id, adapters=(), **kw):
+    eng = _engine(model, adapters=4, adapter_rank=4)
+    for j, aid in enumerate(adapters):
+        eng.adapter_store.register(aid, _lora(model, seed=40 + j),
+                                   alpha=8.0)
+    return ReplicaServer(eng, replica_id=rid, model=model_id,
+                         **kw).start()
+
+
+def _prompt(n=10, seed=7):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, VOCAB, (n,)).astype(np.int32)
+
+
+def _post(url, path, payload, timeout=30):
+    req = urllib.request.Request(
+        f"{url}{path}", data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url, path, timeout=10):
+    with urllib.request.urlopen(f"{url}{path}", timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture
+def fleet_cleanup():
+    items = []
+    yield items
+    for obj in reversed(items):
+        try:
+            obj.stop()
+        except Exception:
+            pass
+
+
+# -- advertisement + clean 400s -----------------------------------------------
+def test_replica_catalog_advertisement_and_400s(model, fleet_cleanup):
+    rep = _adapter_replica(model, "r0", "m-alpha", adapters=("t0",))
+    fleet_cleanup.append(rep)
+    hz = _get(rep.url, "/healthz")
+    assert hz["model"] == "m-alpha"
+    assert hz["adapters"] == ["t0"]
+    sz = _get(rep.url, "/statusz.json")["replica"]
+    assert sz["model"] == "m-alpha"
+    assert sz["adapters"]["ids"] == ["t0"]
+
+    base = {"prompt": _prompt().tolist(), "max_new_tokens": 4}
+    # the happy paths
+    code, out = _post(rep.url, "/generate", dict(base, model="m-alpha"))
+    assert code == 200 and len(out["tokens"]) == 4
+    code, out = _post(rep.url, "/generate",
+                      dict(base, model="m-alpha", adapter="t0"))
+    assert code == 200 and len(out["tokens"]) == 4
+    # mismatches: structured, non-retriable, never 500
+    code, out = _post(rep.url, "/generate", dict(base, model="m-beta"))
+    assert code == 400 and out["error"] == "wrong_model"
+    assert out["retriable"] is False and out["model"] == "m-alpha"
+    code, out = _post(rep.url, "/generate", dict(base, adapter="nope"))
+    assert code == 400 and out["error"] == "unknown_adapter"
+    for bad in ({"model": 7}, {"model": ""}, {"adapter": 7},
+                {"adapter": ""}):
+        code, out = _post(rep.url, "/generate", dict(base, **bad))
+        assert code == 400 and out["error"] == "bad_request"
+    # an adapters-off replica 400s adapter requests the same way
+    off = ReplicaServer(_engine(model), replica_id="off").start()
+    fleet_cleanup.append(off)
+    code, out = _post(off.url, "/generate", dict(base, adapter="t0"))
+    assert code == 400 and out["error"] == "unknown_adapter"
+    assert _get(off.url, "/healthz").get("model") is None
+
+
+# -- runtime adapter movement -------------------------------------------------
+def test_adapter_export_load_unload_endpoints(model, fleet_cleanup):
+    src = _adapter_replica(model, "src", "m", adapters=("t0",))
+    dst = _adapter_replica(model, "dst", "m")
+    fleet_cleanup.extend([src, dst])
+    code, payload = _post(src.url, "/adapter_export", {"adapter": "t0"})
+    assert code == 200 and payload["adapter"] == "t0"
+    assert payload["records"] and payload["replica"] == "src"
+    code, out = _post(dst.url, "/load_adapter", payload)
+    assert code == 200 and out["adapters"] == ["t0"]
+    assert _get(dst.url, "/healthz")["adapters"] == ["t0"]
+    # the moved copy SERVES the same tokens as the original
+    body = {"prompt": _prompt().tolist(), "max_new_tokens": 6,
+            "adapter": "t0"}
+    _, a = _post(src.url, "/generate", dict(body, request_id="s1"))
+    _, b = _post(dst.url, "/generate", dict(body, request_id="d1"))
+    assert a["tokens"] == b["tokens"]
+    # corrupt wire payload: caller's 400, never a 500
+    bad = dict(payload, records=[dict(payload["records"][0],
+                                      data="AAAA")])
+    code, out = _post(dst.url, "/load_adapter", bad)
+    assert code == 400 and out["error"] == "bad_adapter"
+    # unload: de-catalogs; unknown and adapters-off are clean 400s
+    code, out = _post(dst.url, "/unload_adapter", {"adapter": "t0"})
+    assert code == 200 and out["adapters"] == []
+    code, out = _post(dst.url, "/unload_adapter", {"adapter": "t0"})
+    assert code == 400 and out["error"] == "unknown_adapter"
+    code, out = _post(dst.url, "/adapter_export", {"adapter": "t0"})
+    assert code == 400 and out["error"] == "unknown_adapter"
+    off = ReplicaServer(_engine(model), replica_id="off2").start()
+    fleet_cleanup.append(off)
+    for path in ("/load_adapter", "/unload_adapter", "/adapter_export"):
+        code, out = _post(off.url, path, {"adapter": "t0"})
+        assert code == 400 and out["error"] == "adapters_off"
+
+
+# -- routing by catalog identity ----------------------------------------------
+def test_router_routes_two_models(model, fleet_cleanup):
+    ra = _adapter_replica(model, "ra", "m-a", adapters=("t0",))
+    rb = _adapter_replica(model, "rb", "m-b")
+    fleet_cleanup.extend([ra, rb])
+    router = Router([ra.url, rb.url], scrape_interval_s=0)
+    fleet_cleanup.append(router)
+    router.scrape()
+    p = _prompt().tolist()
+    for _ in range(3):
+        assert router.generate(p, max_new_tokens=4,
+                               model="m-a").replica == "ra"
+        assert router.generate(p, max_new_tokens=4,
+                               model="m-b").replica == "rb"
+    # adapter filtering: only ra advertises t0
+    for _ in range(3):
+        assert router.generate(p, max_new_tokens=4,
+                               adapter="t0").replica == "ra"
+    # unknown model: permanent before any hop (routing it anywhere
+    # could only produce per-replica 400s)
+    with pytest.raises(PermanentError, match="unknown model"):
+        router.generate(p, max_new_tokens=4, model="m-zzz")
+    # model-less requests still balance across the whole pool
+    seen = {router.generate(p, max_new_tokens=4).replica
+            for _ in range(8)}
+    assert seen == {"ra", "rb"}
+
+
+# -- per-model aggregation ----------------------------------------------------
+def test_collector_models_aggregation(model, fleet_cleanup):
+    ra = _adapter_replica(model, "ra", "m-a", adapters=("t0", "t1"))
+    rb = _adapter_replica(model, "rb", "m-a", adapters=("t0",))
+    rc = ReplicaServer(_engine(model), replica_id="rc",
+                       model="m-b").start()
+    fleet_cleanup.extend([ra, rb, rc])
+    body = {"prompt": _prompt().tolist(), "max_new_tokens": 4}
+    for i in range(2):
+        _post(ra.url, "/generate",
+              dict(body, adapter="t0", request_id=f"a{i}"))
+    _post(ra.url, "/generate", dict(body, adapter="t1",
+                                    request_id="a9"))
+    _post(rc.url, "/generate", dict(body, request_id="c0"))
+    col = FleetCollector(urls=[ra.url, rb.url, rc.url], interval_s=0)
+    fleet_cleanup.append(col)
+    assert col.scrape()["ok"] == 3
+    view = col.fleet_view()
+    rows = {r["replica"]: r for r in view["replicas"]}
+    assert rows["ra"]["model"] == "m-a"
+    assert rows["ra"]["adapters"] == ["t0", "t1"]
+    assert rows["rc"]["adapters"] is None       # adapters-off replica
+    models = view["models"]
+    assert set(models) == {"m-a", "m-b"}
+    ma = models["m-a"]
+    assert ma["replicas"] == 2 and ma["stale"] == 0
+    assert ma["adapters"] == {"t0": 2, "t1": 1}   # placement counts
+    assert ma["adapter_goodput"] == {"t0": 2, "t1": 1}
+    assert ma["adapter_tokens"] == {"t0": 8, "t1": 4}
+    assert ma["completed"] == 3
+    assert models["m-b"]["completed"] == 1
+    assert models["m-b"]["adapters"] == {}
+
+
+# -- rebalance ----------------------------------------------------------------
+def test_catalog_rebalancer_spread_cap_and_failures(model, fleet_cleanup):
+    ra = _adapter_replica(model, "ra", "m", adapters=("t0", "t1"))
+    rb = _adapter_replica(model, "rb", "m")
+    fleet_cleanup.extend([ra, rb])
+    body = {"prompt": _prompt().tolist(), "max_new_tokens": 4}
+    for i in range(3):
+        _post(ra.url, "/generate",
+              dict(body, adapter="t0", request_id=f"t0-{i}"))
+    _post(ra.url, "/generate", dict(body, adapter="t1",
+                                    request_id="t1-0"))
+    col = FleetCollector(urls=[ra.url, rb.url], interval_s=0)
+    fleet_cleanup.append(col)
+    col.scrape()
+    reb = CatalogRebalancer(col)
+    moves = reb.plan()
+    # hot-first ordering: t0 (3 completions) spreads before t1 (1)
+    assert [(m["action"], m["adapter"], m["dst"]) for m in moves] == \
+        [("spread", "t0", rb.url), ("spread", "t1", rb.url)]
+    assert moves[0]["src"] == ra.url
+    # cap: max_moves bounds one pass (planned > applied stays visible)
+    assert CatalogRebalancer(col, max_moves=1).apply(moves) and \
+        len(CatalogRebalancer(col, max_moves=1).apply(moves)) == 1
+    results = reb.rebalance()
+    assert all(r["ok"] for r in results)
+    assert _get(rb.url, "/healthz")["adapters"] == ["t0", "t1"]
+    # converged: the next scrape+plan has nothing left to move
+    col.scrape()
+    assert reb.plan() == []
+    # the moved copies serve (same tokens as the source's)
+    _, a = _post(ra.url, "/generate", dict(body, adapter="t0",
+                                           request_id="pa"))
+    _, b = _post(rb.url, "/generate", dict(body, adapter="t0",
+                                           request_id="pb"))
+    assert a["tokens"] == b["tokens"]
+    # failure isolation: a dead destination reports, never raises
+    col2 = FleetCollector(urls=[ra.url], interval_s=0)
+    fleet_cleanup.append(col2)
+    col2.scrape()
+    dead = [{"action": "spread", "model": "m", "adapter": "t0",
+             "src": ra.url, "dst": "http://127.0.0.1:9"}]
+    rows = CatalogRebalancer(col2, timeout_s=2.0).apply(dead)
+    assert len(rows) == 1 and rows[0]["ok"] is False
+    assert rows[0]["error"]
+
+
+def test_retire_idle_policy(model, fleet_cleanup):
+    ra = _adapter_replica(model, "ra", "m", adapters=("hot", "cold"))
+    fleet_cleanup.append(ra)
+    body = {"prompt": _prompt().tolist(), "max_new_tokens": 4}
+    _post(ra.url, "/generate", dict(body, adapter="hot",
+                                    request_id="h0"))
+    col = FleetCollector(urls=[ra.url], interval_s=0)
+    fleet_cleanup.append(col)
+    col.scrape()
+    # default policy never retires (zero traffic must not de-catalog
+    # a freshly loaded adapter); opt-in retires exactly the idle one
+    assert CatalogRebalancer(col).plan() == []
+    moves = CatalogRebalancer(col, retire_idle=True).plan()
+    assert moves == [{"action": "retire", "model": "m",
+                      "adapter": "cold", "src": ra.url, "dst": None}]
+    rows = CatalogRebalancer(col, retire_idle=True).apply(moves)
+    assert rows[0]["ok"] is True
+    assert _get(ra.url, "/healthz")["adapters"] == ["hot"]
+
+
+def test_supervisor_rebalance_catalog_actuator(model, fleet_cleanup):
+    ra = _adapter_replica(model, "ra", "m", adapters=("t0",))
+    rb = _adapter_replica(model, "rb", "m")
+    fleet_cleanup.extend([ra, rb])
+    body = {"prompt": _prompt().tolist(), "max_new_tokens": 4}
+    _post(ra.url, "/generate", dict(body, adapter="t0",
+                                    request_id="s0"))
+    col = FleetCollector(urls=[ra.url, rb.url], interval_s=0)
+    fleet_cleanup.append(col)
+    col.scrape()
+    # no attached rebalancer: a clean no-op
+    sup = Supervisor(lambda slot: None, 0, collector=col)
+    assert sup.rebalance_catalog() == []
+    sup = Supervisor(lambda slot: None, 0, collector=col,
+                     catalog=CatalogRebalancer(col))
+    results = sup.rebalance_catalog(reason="scale_up_decode")
+    assert [r["adapter"] for r in results] == ["t0"]
+    assert all(r["ok"] for r in results)
+    assert _get(rb.url, "/healthz")["adapters"] == ["t0"]
+    kinds = [a["kind"] for a in col.fleet_view()["annotations"]]
+    assert "catalog_rebalance" in kinds

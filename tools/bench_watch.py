@@ -19,6 +19,7 @@ artifact set in priority order:
      tools/serve_bench.py --workload quant  -> QUANT_SERVE_BENCH.json
      tools/serve_bench.py --workload offload -> OFFLOAD_BENCH.json
      tools/serve_bench.py --workload perf-attrib -> PERF_ATTRIB_BENCH.json
+     tools/serve_bench.py --workload lora   -> LORA_BENCH.json
   9. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
 
 Two stages need no TPU and run ahead of the probe (so chip-down rounds
@@ -778,6 +779,33 @@ def run_serve_perf_bench(timeout=2400):
         "PERF_ATTRIB_BENCH.json", timeout, validate=validate)
 
 
+def run_serve_lora_bench(timeout=2400):
+    """Multi-tenant LoRA multiplexing A/B (tools/serve_bench.py
+    --workload lora) — adapters-off vs one multiplexed engine cycling
+    base + K adapters vs per-tenant merged-weight engines: the
+    rotated second pass must trace ZERO fresh programs (slot index is
+    an operand, not a trace key), every multiplexed row must agree
+    with its tenant's merged-weights reference, and the consolidation
+    headline (K+1 tenants through one engine's HBM) gets a record."""
+
+    def validate(payload):
+        if payload.get("fresh_traces_second_pass", 1) != 0:
+            return "rotated second pass traced fresh programs"
+        if (payload.get("agreement_vs_merged") or 0) < 0.98:
+            return "mux tokens disagree with merged-weights reference"
+        if (payload.get("lora_adapters") or 0) < 3:
+            return "fewer than 3 adapters multiplexed"
+        if (payload.get("mux_overhead_ratio") or 0) < 0.5:
+            return "multiplexing cost above 2x adapters-off"
+        return None
+
+    return run_json_artifact(
+        "serve_lora",
+        [os.path.join(REPO, "tools", "serve_bench.py"),
+         "--workload", "lora"],
+        "LORA_BENCH.json", timeout, validate=validate)
+
+
 def run_train_bench(timeout=1800):
     """Fused single-dispatch train step vs per-param loop
     (tools/train_bench.py) — steps/sec and per-batch host dispatch
@@ -861,7 +889,7 @@ def main():
             "serve_tp": False, "serve_prefix": False,
             "serve_spec": False, "serve_sampling": False,
             "serve_quant": False, "serve_offload": False,
-            "serve_perf": False,
+            "serve_perf": False, "serve_lora": False,
             "train_bench": False, "startup": False, "train_tier": False,
             "sweep": False}
     fails = {k: 0 for k in done}
@@ -998,6 +1026,8 @@ def main():
              lambda: run_serve_offload_bench(timeout=min(2400, left))),
             ("serve_perf",
              lambda: run_serve_perf_bench(timeout=min(2400, left))),
+            ("serve_lora",
+             lambda: run_serve_lora_bench(timeout=min(2400, left))),
             ("train_bench", lambda: run_train_bench(timeout=min(1800, left))),
             ("startup", lambda: run_startup_bench(timeout=min(1800, left))),
             ("train_tier", lambda: run_train_tier(timeout=min(3000, left))),

@@ -75,11 +75,30 @@ def render(view):
             f"{_fmt(a.get('achieved_tflops'), 2):>7}")
     lines.append("")
 
+    # model catalog: per-checkpoint traffic across the fresh replicas
+    # carrying it, plus which adapters earned that traffic
+    models = view.get("models") or {}
+    if models:
+        lines.append(f"{'MODEL':<16} {'REPL':>4} {'STALE':>5} "
+                     f"{'TOK/S':>8} {'TOKENS':>9} {'DONE':>6}  "
+                     f"ADAPTER_GOODPUT")
+        for tag in sorted(models):
+            m = models[tag]
+            gp = m.get("adapter_goodput") or {}
+            gp_s = " ".join(f"{a}={gp[a]}" for a in sorted(gp)) or "-"
+            lines.append(
+                f"{str(tag)[:16]:<16} {m.get('replicas', 0):>4} "
+                f"{m.get('stale', 0):>5} "
+                f"{_fmt(m.get('tok_per_sec')):>8} "
+                f"{m.get('tokens_generated', 0):>9} "
+                f"{m.get('completed', 0):>6}  {gp_s}")
+        lines.append("")
+
     # AFFINITY = radix-summary keys the replica currently advertises to
     # the router (its routable cache surface); HITS = prefix hits, with
     # resurrections (reuse rescued off the eviction LRU) after "+"
     lines.append(f"{'REPLICA':<24} {'ROLE':<8} {'STATE':<9} "
-                 f"{'VERSION':<14} "
+                 f"{'VERSION':<14} {'MODEL':<12} {'ADAPTERS':<10} "
                  f"{'STALE':>5} {'FAILS':>5} {'QUEUE':>5} {'RUN':>4} "
                  f"{'TOK/S':>8} {'TTFT_P99':>9} {'TPOT_P99':>9} "
                  f"{'AFFINITY':>8} {'HITS':>9} {'PULLS':>5}")
@@ -89,10 +108,22 @@ def render(view):
             hits_s = "-"
         else:
             hits_s = f"{int(hits)}+{int(r.get('prefix_resurrections') or 0)}"
+        # ADAPTERS = the replica's registered LoRA adapter ids (the
+        # router's routable surface for adapter requests); "-" means
+        # multiplexing off, "0" an adapters-mode store with none loaded
+        adp = r.get("adapters")
+        if adp is None:
+            adp_s = "-"
+        elif len(adp) <= 1:
+            adp_s = ",".join(adp) or "0"
+        else:
+            adp_s = f"{adp[0][:5]}+{len(adp) - 1}"
         lines.append(
             f"{str(r.get('replica'))[:24]:<24} "
             f"{str(r.get('role')):<8} {str(r.get('state'))[:9]:<9} "
             f"{str(r.get('version') or '-')[:14]:<14} "
+            f"{str(r.get('model') or '-')[:12]:<12} "
+            f"{adp_s[:10]:<10} "
             f"{_fmt(r.get('stale')):>5} "
             f"{r.get('total_failures', 0):>5} "
             f"{r.get('queue_depth', 0):>5} {r.get('running', 0):>4} "

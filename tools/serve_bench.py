@@ -618,6 +618,142 @@ def run_quant(mx, args, make_engine, workload):
     return rec
 
 
+def build_lora_family(rng, params, args, k, rank, alpha):
+    """``k`` seeded LoRA adapters over every projection stem of the
+    bench checkpoint, plus each adapter's merged-weight checkpoint
+    (``w + (alpha/r) * B @ A`` — the single-tenant reference engine a
+    multiplexed row of that adapter must reproduce)."""
+    import numpy as np
+
+    from mxnet_tpu.serve import adapters as adapters_mod
+
+    stems = adapters_mod.gpt_stems("gpt", args.layers, True, True,
+                                   params)
+    family, merged = {}, {}
+    for j in range(k):
+        arrays, mp = {}, dict(params)
+        for stem, (dout, din) in stems.items():
+            a = (rng.randn(rank, din) * 0.1).astype(np.float32)
+            b = (rng.randn(dout, rank) * 0.1).astype(np.float32)
+            arrays[stem] = (a, b)
+            w = np.asarray(mp[f"{stem}_weight"])
+            mp[f"{stem}_weight"] = (
+                w.astype(np.float32)
+                + (alpha / rank) * (b @ a)).astype(w.dtype)
+        aid = f"tenant-{j}"
+        family[aid] = arrays
+        merged[aid] = mp
+    return family, merged
+
+
+def run_lora(mx, args, make_engine, workload, params):
+    """Multi-tenant LoRA multiplexing A/B on the SAME checkpoint:
+
+    * **off**: an adapters-off engine over the workload — the baseline
+      the multiplexed engine's overhead is measured against (and the
+      pay-for-use proof: adapters-off serving is untouched).
+    * **mux**: ONE adapters-mode engine serving the same workload with
+      rows cycling base + ``--lora-adapters`` adapters, run TWICE with
+      the assignment ROTATED between passes — every row switches
+      adapter, so the second pass must add ZERO fresh traced programs
+      (the slot index is an operand: one program per bucket serves any
+      mix) and cannot lean on same-adapter prefix-cache hits.
+    * **merged**: per-adapter merged-weight engines re-serving each
+      adapter's rows — the single-tenant reference the multiplexed
+      rows must agree with (token agreement, not bitwise: the merged
+      arm folds the delta into one matmul, the mux arm adds it).
+    * **serial**: the merged arms' summed wall — what serving the same
+      tenant mix costs as one engine per tenant (the consolidation
+      headline: K+1 checkpoints' traffic through one engine's HBM).
+    """
+    import numpy as np
+
+    import mxnet_tpu.serve.engine as engine_mod
+
+    conc = args.concurrency
+    k, rank, alpha = args.lora_adapters, args.lora_rank, 8.0
+    rng = np.random.RandomState(args.seed + 7)
+    family, merged = build_lora_family(rng, params, args, k, rank,
+                                       alpha)
+    ids = [None] + sorted(family)
+
+    def assign(i):
+        return ids[i % len(ids)]
+
+    def assign2(i):
+        # rotated: every row serves a DIFFERENT adapter than pass 1,
+        # so pass 2 gets no same-salt prefix-cache hits and a
+        # trace-keyed slot would be forced to retrace every bucket
+        return ids[(i + 1) % len(ids)]
+
+    kw = dict(max_queue=len(workload) + 1)
+
+    eng = make_engine(conc, **kw)
+    # two warm passes: the first traces full-prefill buckets, the
+    # second traces the shrunken prefix-cached suffix buckets — the
+    # measured pass is then steady-state
+    run_closed(mx, eng, workload, conc)
+    run_closed(mx, eng, workload, conc)
+    off_reqs, off_wall = run_closed(mx, eng, workload, conc)
+    eng.shutdown()
+
+    eng = make_engine(conc, adapters=k + 1, adapter_rank=rank, **kw)
+    for aid in sorted(family):
+        eng.adapter_store.register(aid, family[aid], alpha=alpha)
+    cfg = lambda i: ({"adapter_id": assign(i)} if assign(i) else {})
+    run_closed(mx, eng, workload, conc, cfg_fn=cfg)   # warm the grid
+    progs = len(engine_mod._STEP_CACHE)
+    cfg2 = lambda i: ({"adapter_id": assign2(i)} if assign2(i) else {})
+    mux_reqs, mux_wall = run_closed(mx, eng, workload, conc,
+                                    cfg_fn=cfg2)
+    fresh_traces = len(engine_mod._STEP_CACHE) - progs
+    adp_stats = eng.adapter_store.stats()
+    eng.shutdown()
+
+    total = agree = 0
+    serial_wall = 0.0
+    for aid in ids:
+        rows = [i for i in range(len(workload)) if assign2(i) == aid]
+        reng = make_engine(
+            conc, params_override=None if aid is None else merged[aid],
+            **kw)
+        rreqs, rwall = run_closed(mx, reng,
+                                  [workload[i] for i in rows], conc)
+        reng.shutdown()
+        serial_wall += rwall
+        for i, rr in zip(rows, rreqs):
+            for x, y in zip(rr.tokens, mux_reqs[i].tokens):
+                total += 1
+                agree += int(x == y)
+
+    mux_toks = sum(len(r.tokens) for r in mux_reqs)
+    off_toks = sum(len(r.tokens) for r in off_reqs)
+    mux_tps = round(mux_toks / mux_wall, 1) if mux_wall else None
+    off_tps = round(off_toks / off_wall, 1) if off_wall else None
+    return {
+        "mode": "lora",
+        "requests": len(workload),
+        "adapters": k,
+        "adapter_rank": rank,
+        "completed_off": sum(r.status == "finished" for r in off_reqs),
+        "completed_mux": sum(r.status == "finished" for r in mux_reqs),
+        "tokens_per_sec_off": off_tps,
+        "tokens_per_sec_mux": mux_tps,
+        "mux_overhead_ratio": (round(mux_tps / off_tps, 3)
+                               if off_tps and mux_tps else None),
+        "fresh_traces_second_pass": fresh_traces,
+        "agreement_vs_merged": (round(agree / total, 4)
+                                if total else None),
+        "tokens_identical": total > 0 and agree == total,
+        "wall_s_mux": round(mux_wall, 3),
+        "wall_s_serial_merged": round(serial_wall, 3),
+        "consolidation_speedup": (round(serial_wall / mux_wall, 2)
+                                  if mux_wall else None),
+        "adapter_slots_used": adp_stats["slots_used"],
+        "adapter_loads": adp_stats["loads"],
+    }
+
+
 def run_perf_attrib(mx, args, make_engine, workload):
     """Performance-attribution A/B over the SAME workload: sampled
     device timing on (every step) vs off.  The acceptance bar: tokens
@@ -936,7 +1072,7 @@ def main():
     p.add_argument("--workload", default="default",
                    choices=("default", "shared-prefix", "mixed-len",
                             "prefix", "spec", "quant", "offload",
-                            "sampling", "perf-attrib"),
+                            "sampling", "perf-attrib", "lora"),
                    help="default: the mixed prompt-length load. "
                         "shared-prefix: --prefixes system prompts x "
                         "--continuations suffixes, cache-on vs cache-off "
@@ -972,7 +1108,15 @@ def main():
                         "off over the same workload — overhead within "
                         "noise, tokens byte-identical, fingerprints "
                         "unchanged, cost table populated -> the "
-                        "PERF_ATTRIB_BENCH.json stage")
+                        "PERF_ATTRIB_BENCH.json stage. "
+                        "lora: multi-tenant LoRA multiplexing — one "
+                        "adapters-mode engine serving a base + "
+                        "--lora-adapters mix (zero fresh traces on "
+                        "the second pass) vs an adapters-off "
+                        "baseline and per-adapter merged-weight "
+                        "reference engines (token agreement + "
+                        "consolidation speedup) -> the "
+                        "LORA_BENCH.json stage")
     p.add_argument("--offload-prefixes", type=int, default=6,
                    help="offload: distinct system prompts (sized to "
                         "overflow the deliberately small HBM LRU)")
@@ -1000,6 +1144,12 @@ def main():
     p.add_argument("--agreement-samples", type=int, default=192,
                    help="sampling: 2-token generations per arm of the "
                         "distribution-agreement chi-square")
+    p.add_argument("--lora-adapters", type=int, default=3,
+                   help="lora: distinct adapters multiplexed alongside "
+                        "base-model rows")
+    p.add_argument("--lora-rank", type=int, default=4,
+                   help="lora: rank of the seeded adapters (and the "
+                        "store's padded rank ceiling)")
     p.add_argument("--long-prompt", type=int, default=2048,
                    help="mixed-len: the long prompt's token count")
     p.add_argument("--prefill-chunk", type=int, default=0,
@@ -1126,12 +1276,14 @@ def main():
 
     tp = args.tp if args.tp else None    # --tp 1 forces single-device
 
-    def make_engine(max_batch, **kw):
+    def make_engine(max_batch, params_override=None, **kw):
         base = dict(block_size=args.block_size, num_blocks=num_blocks,
                     max_batch=max_batch, max_queue=max_queue,
                     max_model_len=max_len, max_prefills_per_step=2, tp=tp)
         base.update(kw)   # the prefix workloads override capacity knobs
-        return mx.serve.Engine(params, symbol=net, **base)
+        return mx.serve.Engine(
+            params if params_override is None else params_override,
+            symbol=net, **base)
 
     out = {"platform": jax.default_backend(),
            "device_kind": getattr(jax.devices()[0], "device_kind", ""),
@@ -1237,6 +1389,24 @@ def main():
             out["mfu"] = rec["mfu"]
             out["tokens_per_sec_on"] = rec["tokens_per_sec_on"]
             out["tokens_per_sec_off"] = rec["tokens_per_sec_off"]
+            flush(False)
+        if args.workload == "lora":
+            wl = build_workload(rng, args)
+            rec = run_lora(mx, args, make_engine, wl, params)
+            print(json.dumps(rec))
+            pts.append(rec)
+            recs.append(rec)
+            # the bench_watch serve_lora contract fields: the mixed
+            # batch gates on zero fresh traces + agreement vs the
+            # merged-weight references (the merged arm folds the delta
+            # into one matmul — agreement, not byte identity)
+            out["fresh_traces_second_pass"] = \
+                rec["fresh_traces_second_pass"]
+            out["agreement_vs_merged"] = rec["agreement_vs_merged"]
+            out["mux_overhead_ratio"] = rec["mux_overhead_ratio"]
+            out["consolidation_speedup"] = rec["consolidation_speedup"]
+            out["tokens_per_sec_mux"] = rec["tokens_per_sec_mux"]
+            out["lora_adapters"] = rec["adapters"]
             flush(False)
         if args.workload == "quant":
             wl = build_workload(rng, args)

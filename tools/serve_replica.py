@@ -113,6 +113,18 @@ def main():
                    help="auto: replay MXTPU_WARMUP_MANIFEST when set; "
                         "full: pre-compile the whole bucket grid; "
                         "none: compile lazily on traffic")
+    p.add_argument("--model", default=None,
+                   help="catalog model id advertised on /healthz and "
+                        "/statusz.json (default MXTPU_FLEET_MODEL / "
+                        "unset): the router only sends requests "
+                        "naming a model to replicas carrying it")
+    p.add_argument("--adapters", type=int, default=None,
+                   help="LoRA adapter device slots incl. the reserved "
+                        "base slot 0 (default MXTPU_SERVE_ADAPTERS / "
+                        "0 = multiplexing off)")
+    p.add_argument("--adapter-rank", type=int, default=None,
+                   help="padded LoRA rank ceiling for the adapter "
+                        "stacks (default MXTPU_SERVE_ADAPTER_RANK / 8)")
     p.add_argument("--exit-on-drained", action="store_true",
                    help="exit 0 once a requested drain completes "
                         "(the supervisor's rolling-restart handshake)")
@@ -142,7 +154,8 @@ def main():
         num_blocks=args.num_blocks, max_batch=args.max_batch,
         max_queue=args.max_queue, max_model_len=args.max_model_len,
         max_prefills_per_step=args.max_prefills,
-        tenant_share=args.tenant_share, host_kv_bytes=host_kv)
+        tenant_share=args.tenant_share, host_kv_bytes=host_kv,
+        adapters=args.adapters, adapter_rank=args.adapter_rank)
     warmed = 0
     if args.warmup == "full":
         warmed = engine.warmup()
@@ -162,6 +175,7 @@ def main():
     replica = mx.fleet.ReplicaServer(
         engine, host=args.host, port=args.port,
         replica_id=args.replica_id, role=role, version=version,
+        model=args.model,
         on_kill=lambda: os._exit(1))       # a kill fault is a real death
     replica.start()
 
@@ -177,6 +191,7 @@ def main():
         "pid": os.getpid(), "replica_id": replica.replica_id,
         "role": replica.role,
         "version": replica.version,
+        "model": replica.model,
         "backend": jax.default_backend(),
         "ready_s": round(time.perf_counter() - t0, 3),
         "warmed": warmed,

@@ -167,6 +167,11 @@ def stitch(traces):
         by_id.setdefault(tid, []).append(
             {"replica": rec.get("replica"),
              "source": rec.get("source") or "serve",
+             # catalog attribution (only-when-set in the line schema):
+             # which checkpoint served the hop, and which LoRA adapter
+             # the request multiplexed onto it
+             "model": rec.get("model"),
+             "adapter": rec.get("adapter"),
              "status": status, "reason": reason,
              "cached_tokens": cached})
     multi = {tid: hops for tid, hops in by_id.items() if len(hops) > 1}
@@ -350,7 +355,10 @@ def main(argv=None):
                 f"{h['replica'] or '?'}"
                 f"[cached={h['cached_tokens']}"
                 f",{h['status']}"
-                + (f"/{h['reason']}" if h["reason"] else "") + "]"
+                + (f"/{h['reason']}" if h["reason"] else "")
+                + (f",model={h['model']}" if h.get("model") else "")
+                + (f",adapter={h['adapter']}"
+                   if h.get("adapter") else "") + "]"
                 for h in engine)
             print(f"  {tid}: {path}")
     if args.json:
