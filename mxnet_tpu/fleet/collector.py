@@ -436,14 +436,19 @@ class FleetCollector:
             return list(self._annotations)[-int(limit):]
 
     # -- SLO support ---------------------------------------------------------
-    def request_flight_dump(self, url, reason):
+    def request_flight_dump(self, url, reason, capture_id=None):
         """Ask one replica to dump its flight-recorder ring (``POST
         /flight_dump`` — the replica's recorder rate-limits per
-        reason).  Returns the remote path or None; never raises."""
+        reason).  ``capture_id`` names a profiler capture fired
+        alongside, so the dump links to its device trace.  Returns
+        the remote path or None; never raises."""
+        body = {"reason": reason}
+        if capture_id:
+            body["capture_id"] = capture_id
         try:
             req = urllib.request.Request(
                 f"{url.rstrip('/')}/flight_dump",
-                data=json.dumps({"reason": reason}).encode(),
+                data=json.dumps(body).encode(),
                 method="POST",
                 headers={"Content-Type": "application/json"})
             with urllib.request.urlopen(req,
@@ -451,6 +456,67 @@ class FleetCollector:
                 return json.loads(resp.read()).get("path")
         except (OSError, ValueError):
             return None
+
+    def request_profile_capture(self, url, duration_s=1.0,
+                                reason="fleet_capture"):
+        """Ask one replica to open a bounded profiler capture window
+        (``POST /profilez``).  Returns the response payload (carrying
+        the capture ``id``) on 200, None on any refusal (409 conflict,
+        429 rate limit) or wire failure; never raises — the SLO layer
+        calls this from its evaluation loop."""
+        try:
+            req = urllib.request.Request(
+                f"{url.rstrip('/')}/profilez",
+                data=json.dumps({"duration_s": float(duration_s),
+                                 "reason": str(reason)[:64]}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            # profiler cold-start can take seconds before the 200 comes
+            # back, so don't reuse the (tight) scrape timeout here
+            with urllib.request.urlopen(
+                    req, timeout=max(self.timeout_s, 15.0)) as resp:
+                return json.loads(resp.read())
+        except (OSError, ValueError):
+            return None
+
+    def capture_fleet(self, duration_s=1.0, roles=None,
+                      reason="fleet_capture"):
+        """Open wall-clock-aligned capture windows across the fleet:
+        one concurrent ``POST /profilez`` per (optionally role-
+        filtered) replica, so every accepted window starts within one
+        request round-trip of the others and each capture's
+        ``started_epoch`` places it on the shared timeline.
+
+        Returns ``{replica_name: payload-or-None}`` — None marks a
+        replica that refused (active window, rate limit) or failed;
+        accepted payloads carry the capture ``id`` to poll via ``GET
+        /profilez/<id>``.  The fleet annotation ring records the sweep
+        so /fleetz readers see which captures belong together."""
+        with self._lock:
+            targets = [(v.name, v.url) for v in self._views.values()
+                       if roles is None or v.role in roles]
+        results = {}
+        threads = []
+
+        def one(name, url):
+            results[name] = self.request_profile_capture(
+                url, duration_s=duration_s, reason=reason)
+
+        for name, url in targets:
+            t = threading.Thread(target=one, args=(name, url),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=max(self.timeout_s, 15.0) + float(duration_s))
+        self.annotate(
+            "fleet_capture", reason=str(reason)[:64],
+            duration_s=float(duration_s),
+            captures=[{"replica": n,
+                       "id": (r or {}).get("id"),
+                       "accepted": r is not None}
+                      for n, r in sorted(results.items())])
+        return results
 
     def url_for_replica(self, name):
         """Replica name -> base URL (trace lines carry names; flight

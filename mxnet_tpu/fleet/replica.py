@@ -110,8 +110,10 @@ from __future__ import annotations
 
 import base64
 import collections
+import glob
 import json
 import os
+import tempfile
 import threading
 import time
 import urllib.request
@@ -287,6 +289,18 @@ class ReplicaServer:
         self._chain_exports = 0           # guarded-by: _lock
         self._chain_export_blocks = 0     # guarded-by: _lock
         self._chain_export_bytes = 0      # guarded-by: _lock
+        # on-demand profiler capture (POST /profilez): bounded-duration
+        # jax.profiler windows written under per-capture ids.  One
+        # window at a time (the XLA profiler is process-global) with a
+        # minimum spacing between windows, so an alert storm cannot
+        # keep a replica permanently profiled
+        self._profilez_max_s = env_float("MXTPU_PROFILEZ_MAX_S", 10.0)
+        self._profilez_interval_s = env_float(
+            "MXTPU_PROFILEZ_INTERVAL_S", 30.0)
+        self._profilez_dir = os.environ.get("MXTPU_PROFILEZ_DIR") or None
+        self._capture_seq = 0                         # guarded-by: _lock
+        self._captures = collections.OrderedDict()    # guarded-by: _lock
+        self._last_capture_t = None                   # guarded-by: _lock
         self._server = None
         self._http_thread = None
         self._step_thread = None
@@ -1025,6 +1039,146 @@ class ReplicaServer:
             ingesting = self._handoff_ingesting
         return ingesting + self.engine.scheduler.waiting_handoffs()
 
+    # -- on-demand profiler capture (/profilez) ------------------------------
+    _CAPTURE_KEEP = 8      # finished-capture metadata entries retained
+
+    def _active_capture_locked(self):
+        for cap in reversed(self._captures.values()):
+            if cap["state"] == "running":
+                return cap
+        return None
+
+    def handle_profilez(self, body):
+        """``POST /profilez``: start a bounded-duration, process-global
+        ``jax.profiler`` capture window and answer immediately with its
+        capture id; the window runs out on a background thread and the
+        artifact is served back by ``GET /profilez/<id>`` (metadata)
+        and ``GET /profilez/<id>/trace`` (the gzip trace itself).
+
+        One window at a time — a second POST answers a clean 409
+        ``capture_in_progress`` (never the RuntimeError→500 that would
+        trip router breakers) — and windows are rate-limited (429,
+        ``MXTPU_PROFILEZ_INTERVAL_S``) with durations clamped to
+        ``MXTPU_PROFILEZ_MAX_S``.  Draining or stopping the replica
+        mid-window ends the capture cleanly (early stop, artifact
+        kept).  Never fault-injected: control-plane, not traffic."""
+        from .. import profiler as profiler_mod
+
+        try:
+            duration = float(body.get("duration_s", 1.0))
+        except (TypeError, ValueError):
+            return 400, {"error": "bad_request", "retriable": False}
+        if not duration > 0.0:
+            return 400, {"error": "bad_request", "retriable": False}
+        duration = min(duration, self._profilez_max_s)
+        reason = str(body.get("reason") or "on_demand")[:64]
+        now = time.monotonic()
+        with self._lock:
+            active = self._active_capture_locked()
+            if active is not None:
+                return 409, {"error": "capture_in_progress",
+                             "retriable": False, "id": active["id"],
+                             "replica": self.replica_id}
+            if self._last_capture_t is not None \
+                    and now - self._last_capture_t \
+                    < self._profilez_interval_s:
+                retry = (self._profilez_interval_s
+                         - (now - self._last_capture_t))
+                return 429, {"error": "rate_limited", "retriable": True,
+                             "retry_after_s": round(retry, 3),
+                             "replica": self.replica_id}
+            self._capture_seq += 1
+            cap_id = f"{self.replica_id}-cap{self._capture_seq}"
+            logdir = os.path.join(
+                self._profilez_dir or os.path.join(
+                    tempfile.gettempdir(),
+                    f"mxtpu_profilez_{os.getpid()}"),
+                cap_id)
+            cap = {"id": cap_id, "state": "running", "reason": reason,
+                   "duration_s": duration, "logdir": logdir,
+                   # epoch stamp: capture_fleet aligns cross-replica
+                   # windows (and timeline_report places the device
+                   # events) on the wall clock
+                   # mxtpu-lint: disable=wall-clock (cross-replica capture alignment stamp)
+                   "started_epoch": time.time(),
+                   "replica": self.replica_id, "trace_file": None,
+                   "error": None}
+            try:
+                os.makedirs(logdir, exist_ok=True)
+                profiler_mod.start(logdir)
+            except profiler_mod.ProfilerActive as e:
+                # someone else (another in-process replica, a bench
+                # harness) holds the process-global profiler — the
+                # same clean conflict as our own active window
+                return 409, {"error": "capture_in_progress",
+                             "retriable": False, "detail": str(e)[:200],
+                             "replica": self.replica_id}
+            except Exception as e:
+                _errors("profilez_start").inc()
+                return 500, {"error": "profiler_start_failed",
+                             "retriable": True, "detail": str(e)[:200]}
+            self._last_capture_t = now
+            self._captures[cap_id] = cap
+            while len(self._captures) > self._CAPTURE_KEEP:
+                oldest = next(iter(self._captures))
+                if self._captures[oldest]["state"] == "running":
+                    break
+                self._captures.pop(oldest)
+        threading.Thread(
+            target=self._finish_capture, args=(cap,), daemon=True,
+            name=f"mxtpu-profilez-{self.port}").start()
+        telemetry.counter("mxtpu_fleet_profilez_total",
+                          "profiler capture requests by outcome",
+                          ("outcome",)).labels(outcome="started").inc()
+        return 200, {"id": cap_id, "state": "running",
+                     "duration_s": duration, "logdir": logdir,
+                     "started_epoch": cap["started_epoch"],
+                     "replica": self.replica_id}
+
+    def _finish_capture(self, cap):
+        """Background tail of one capture window: wait out the bounded
+        duration (early-out when the replica stops — drain/stop during
+        a capture ends the window cleanly, keeping whatever was
+        captured), stop the profiler, locate the artifact."""
+        from .. import profiler as profiler_mod
+
+        self._stop_evt.wait(cap["duration_s"])
+        err = None
+        try:
+            profiler_mod.stop()
+        except Exception as e:
+            # a failed stop must not leave the entry "running" forever
+            err = f"{type(e).__name__}: {e}"[:200]
+        trace_file = None
+        try:
+            found = glob.glob(os.path.join(
+                cap["logdir"], "plugins", "profile", "*",
+                "*.trace.json.gz"))
+            if found:
+                trace_file = max(found, key=os.path.getmtime)
+        except OSError:
+            pass
+        if err is None and trace_file is None:
+            err = "no trace artifact written (capture aborted early?)"
+        with self._lock:
+            cap["trace_file"] = trace_file
+            cap["error"] = err
+            cap["state"] = "failed" if err else "done"
+        telemetry.counter("mxtpu_fleet_profilez_total",
+                          "profiler capture requests by outcome",
+                          ("outcome",)).labels(
+                              outcome="failed" if err else "done").inc()
+
+    def handle_profilez_get(self, cap_id):
+        """``GET /profilez/<id>``: capture metadata (state running/
+        done/failed, logdir, trace file, epoch window)."""
+        with self._lock:
+            cap = self._captures.get(cap_id)
+            if cap is None:
+                return 404, {"error": "unknown_capture",
+                             "retriable": False}
+            return 200, dict(cap)
+
     def _reject_response(self, req):
         reason = req.reject_reason or "rejected"
         retriable = reason in RETRIABLE_REASONS
@@ -1184,6 +1338,11 @@ class ReplicaServer:
                 # MFU/goodput aggregates on /fleetz
                 "perf": (eng.perf_summary()
                          if hasattr(eng, "perf_summary") else None),
+                # per-step host-overhead fractions (None on engines
+                # predating the step profiler, or a NOOP summary with
+                # MXTPU_STEP_PROFILE=0)
+                "step_profile": (eng._sprof.summary()
+                                 if hasattr(eng, "_sprof") else None),
                 "faults_fired": len(self.faults.fired)}
 
     def statusz_snapshot(self):
@@ -1240,6 +1399,35 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path.startswith("/profilez/"):
+            # /profilez/<id> (JSON metadata) or /profilez/<id>/trace
+            # (the raw gzip xprof trace for timeline_report)
+            parts = self.path.strip("/").split("/")
+            cap_id = parts[1] if len(parts) > 1 else ""
+            want_trace = len(parts) > 2 and parts[2] == "trace"
+            code, payload = self.replica.handle_profilez_get(cap_id)
+            if want_trace and code == 200:
+                tf = payload.get("trace_file")
+                if payload.get("state") != "done" or not tf:
+                    self._send_json(409, {
+                        "error": "capture_not_done",
+                        "state": payload.get("state"),
+                        "retriable": True})
+                    return
+                try:
+                    with open(tf, "rb") as f:
+                        data = f.read()
+                except OSError:
+                    self._send_json(404, {"error": "artifact_missing",
+                                          "retriable": False})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/gzip")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            self._send_json(code, payload)
         else:
             self.send_error(404)
 
@@ -1271,9 +1459,14 @@ class _Handler(BaseHTTPRequestHandler):
             from ..telemetry import flight as flight_mod
 
             reason = str(body.get("reason") or "fleet_request")[:64]
-            path = flight_mod.recorder().dump(
-                reason, extra={"requested_by": "fleet",
-                               "replica": self.replica.replica_id})
+            extra = {"requested_by": "fleet",
+                     "replica": self.replica.replica_id}
+            if body.get("capture_id"):
+                # a burn-triggered dump names the profiler capture
+                # fired alongside it, so the post-mortem artifact
+                # links straight to its device trace
+                extra["capture_id"] = str(body["capture_id"])[:128]
+            path = flight_mod.recorder().dump(reason, extra=extra)
             telemetry.counter(
                 "mxtpu_fleet_flight_dump_requests_total",
                 "fleet-triggered flight-dump requests",
@@ -1281,6 +1474,26 @@ class _Handler(BaseHTTPRequestHandler):
                     outcome="written" if path else "suppressed").inc()
             self._send_json(200, {"path": path,
                                   "replica": self.replica.replica_id})
+            return
+        if self.path == "/profilez":
+            # on-demand profiler capture: control-plane like
+            # /flight_dump — never fault-injected, and handler
+            # exceptions map to retriable 500s (the 409/429 conflict
+            # answers come back as clean JSON, not errors)
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+            except (ValueError, OSError):
+                body = {}
+            try:
+                result = self.replica.handle_profilez(body)
+            except Exception:
+                _errors("profilez").inc()
+                result = 500, {"error": "internal", "retriable": True}
+            try:
+                self._send_json(*result)
+            except OSError:
+                _errors("respond").inc()
             return
         if self.path not in ("/generate", "/handoff", "/handoff_probe",
                              "/chain_export", "/load_adapter",

@@ -67,7 +67,7 @@ import re
 import threading
 import time
 
-from ..base import env_float, env_int
+from ..base import env_flag, env_float, env_int
 
 __all__ = ["Objective", "SLOEvaluator", "parse_slo_spec",
            "group_requests", "request_failed", "ENV_SPEC",
@@ -80,6 +80,8 @@ ENV_SLOW_WINDOW = "MXTPU_SLO_SLOW_WINDOW"
 ENV_FAST_BURN = "MXTPU_SLO_FAST_BURN"
 ENV_SLOW_BURN = "MXTPU_SLO_SLOW_BURN"
 ENV_MIN_REQUESTS = "MXTPU_SLO_MIN_REQUESTS"
+ENV_BURN_CAPTURE = "MXTPU_PROFILEZ_ON_BURN"
+ENV_BURN_CAPTURE_S = "MXTPU_PROFILEZ_BURN_S"
 
 _LATENCY_KEY = re.compile(r"^(ttft|tpot|total)_p(\d+(?:_\d+)?)_ms$")
 # trace-summary field each latency metric reads
@@ -255,6 +257,12 @@ class SLOEvaluator:
                              if min_requests is not None
                              else env_int(ENV_MIN_REQUESTS, 10))
         self.dump_interval_s = float(dump_interval_s)
+        # fast-burn auto-profiling: alongside each offender's flight
+        # dump, open a short /profilez capture window on it so the
+        # page links straight to a device trace of the burn
+        # (MXTPU_PROFILEZ_ON_BURN=0 keeps dumps only)
+        self.capture_on_burn = env_flag(ENV_BURN_CAPTURE, True)
+        self.capture_s = env_float(ENV_BURN_CAPTURE_S, 0.5)
         self.clock = clock
         self._lock = threading.Lock()
         # objective key -> {"firing", "since", "fired_total", ...}
@@ -371,9 +379,30 @@ class SLOEvaluator:
             url = self.collector.url_for_replica(name)
             if url is None:
                 continue
-            path = self.collector.request_flight_dump(
-                url, f"slo_burn_{obj.key}")
-            dumped.append({"replica": name, "path": path})
+            # capture first: the flight dump then embeds the capture
+            # id (and the last step-decomposition ring entries ride
+            # the dump's statusz snapshot), so one page links alert →
+            # post-mortem → device trace.  The replica's own 409/429
+            # policy bounds profiling cost; a refused capture degrades
+            # to a plain dump (capture_id None)
+            capture_id = None
+            request_capture = getattr(
+                self.collector, "request_profile_capture", None)
+            if self.capture_on_burn and request_capture is not None:
+                cap = request_capture(
+                    url, duration_s=self.capture_s,
+                    reason=f"slo_burn_{obj.key}")
+                capture_id = (cap or {}).get("id")
+            if capture_id is None:
+                # positional call keeps pre-capture collector doubles
+                # (and subclasses with the old signature) working
+                path = self.collector.request_flight_dump(
+                    url, f"slo_burn_{obj.key}")
+            else:
+                path = self.collector.request_flight_dump(
+                    url, f"slo_burn_{obj.key}", capture_id=capture_id)
+            dumped.append({"replica": name, "path": path,
+                           "capture_id": capture_id})
         if dumped:
             self.collector.annotate("slo_flight_dump",
                                     objective=obj.key, dumps=dumped)

@@ -18,30 +18,51 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 
 import jax
 
-__all__ = ["start", "stop", "trace", "scope", "annotate",
-           "device_memory", "summarize"]
+__all__ = ["start", "stop", "trace", "scope", "annotate", "active_logdir",
+           "ProfilerActive", "device_memory", "summarize"]
 
-_active_logdir = None
+# The XLA profiler is process-global and start/stop now arrive from two
+# threads: the engine step loop (bench/manual captures) and the replica
+# HTTP pool (POST /profilez).  All transitions of the active-capture
+# state happen under _lock so a concurrent start sees a coherent
+# already-active answer instead of racing into the opaque XLA
+# double-start crash.
+_lock = threading.Lock()
+_active_logdir = None    # guarded-by: _lock
+
+
+class ProfilerActive(RuntimeError):
+    """A capture is already running.  Distinguished from plain
+    RuntimeError so HTTP surfaces (POST /profilez) can map it to a
+    clean 409 instead of a breaker-tripping 500."""
+
+
+def active_logdir():
+    """The logdir of the capture in flight, or None."""
+    with _lock:
+        return _active_logdir
 
 
 def start(logdir):
     """Begin capturing an XLA trace into ``logdir`` (TensorBoard
     `profile` plugin / xprof format).
 
-    Raises ``RuntimeError`` when a trace is already active — the
+    Raises :class:`ProfilerActive` when a trace is already active — the
     underlying jax failure for a double-start is an opaque XLA error
     that doesn't name the first capture."""
     global _active_logdir
-    if _active_logdir is not None:
-        raise RuntimeError(
-            f"a profiler trace is already active (logdir="
-            f"{_active_logdir!r}); call profiler.stop() before starting "
-            "a new capture")
-    jax.profiler.start_trace(logdir)
-    _active_logdir = logdir
+    with _lock:
+        if _active_logdir is not None:
+            raise ProfilerActive(
+                f"a profiler trace is already active (logdir="
+                f"{_active_logdir!r}); call profiler.stop() before "
+                "starting a new capture")
+        jax.profiler.start_trace(logdir)
+        _active_logdir = logdir
 
 
 def stop():
@@ -49,10 +70,11 @@ def stop():
     resets even when the underlying ``stop_trace`` raises (a failed
     capture must not wedge every later ``start``)."""
     global _active_logdir
-    try:
-        jax.profiler.stop_trace()
-    finally:
-        _active_logdir = None
+    with _lock:
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            _active_logdir = None
 
 
 @contextlib.contextmanager

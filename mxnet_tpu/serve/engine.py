@@ -67,6 +67,7 @@ from ..parallel import partition as partition_mod
 from ..parallel.mesh import NamedSharding, PartitionSpec, make_mesh
 from ..ops.attention import paged_attention
 from ..telemetry import flight as flight_mod
+from ..telemetry import profiling
 from ..telemetry import statusz as statusz_mod
 from ..telemetry.perf_attrib import PerfAttrib
 from ..telemetry.request_trace import RequestTracer
@@ -714,6 +715,12 @@ class Engine:
         # knobs in any combination leave tokens, program cache keys
         # and AOT fingerprints byte-identical
         self._perf = PerfAttrib()
+        # per-step host-overhead decomposition (telemetry/profiling):
+        # same construction ordering + inertness rule as PerfAttrib —
+        # caches its histogram handle here, never enters
+        # _spec_key/_aot_base_fp.  Default on (MXTPU_STEP_PROFILE=0
+        # swaps in the NOOP recorder)
+        self._sprof = profiling.make_step_profiler()
         # live-state gauges stamped once per step (no-op when telemetry
         # is disabled); cumulative serve counters live in StatsRecorder
         self._tel_queue = telemetry.gauge(
@@ -1027,6 +1034,12 @@ class Engine:
         # (the default) every t0() below returns None and no dispatch
         # gains a sync
         self._perf.arm(self._step_id)
+        # step decomposition: begin/lap/commit bracket the whole
+        # iteration; laps inside _run_prefill/_run_decode/_run_spec_
+        # decode split dispatch / device-wait / host bookkeeping (see
+        # telemetry/profiling.py for the phase map)
+        sprof = self._sprof
+        sprof.begin(self._step_id)
         with telemetry.span("serve.step"):
             self._release_fanout()
             prefills, decodes = self.scheduler.schedule()
@@ -1038,6 +1051,7 @@ class Engine:
             # blocks for this iteration are all held right now — the
             # honest high-water sample (post-drain reads would be ~0)
             self._stats.on_utilization(self.blocks.utilization())
+            sprof.lap("schedule")
             emitted = 0
             for req in prefills:
                 with telemetry.span("serve.prefill", rid=req.rid):
@@ -1094,6 +1108,7 @@ class Engine:
             self._tel_preempt.set(self.scheduler.preemptions)
             self._tel_evict.set(self.blocks.evictions)
             self._tel_rejected.set(self.scheduler.rejections)
+        sprof.commit(emitted, prefills=len(prefills), decodes=len(decodes))
         return emitted
 
     def has_work(self):
@@ -1229,6 +1244,11 @@ class Engine:
             # (default-on), device-time columns once sampling has run
             # (None with MXTPU_PERF_ATTRIB=0 — the inert default rule)
             "perf": self._perf.statusz(),
+            # per-step host-overhead decomposition: ring tail + phase
+            # fractions + the perf↔epoch clock anchor timeline_report
+            # stitches with ({"enabled": False} with
+            # MXTPU_STEP_PROFILE=0 — this knob is default-on)
+            "step_profile": self._sprof.statusz(),
             "max_batch": self.max_batch,
             "max_model_len": self.max_model_len,
             "programs_recorded": len(self._manifest.entries()),
@@ -1694,8 +1714,10 @@ class Engine:
         t0 = self._perf.t0()
         outs = fn(*args)
         self._perf.done(t0, pkind, bucket, outs)
+        self._sprof.lap("prefill_dispatch")
         lead = self._unpack_outs(outs, 4 if self._sampling else 1,
                                  "prefill_logits", rid=req.rid)
+        self._sprof.lap("device_wait")
         tok = lead[0]
         req.cache_len = end
         self._stats.on_prefill(span)
@@ -1711,6 +1733,7 @@ class Engine:
             # lane and owns the next iteration's prefill budget
             self._rtrace.event(req, "prefill_chunk", done=int(end),
                                target=int(n), tokens=int(span))
+            self._sprof.lap("host_sync")
             return 0
         self._rtrace.event(req, "prefill_end", tokens=int(n - start),
                            resume=resume)
@@ -1729,6 +1752,7 @@ class Engine:
         if self._sampling:
             self._note_logprobs(req, [lead[1]], [lead[2]], [lead[3]])
         self._maybe_finish(req)
+        self._sprof.lap("host_sync")
         return 1
 
     @hot_path
@@ -1753,9 +1777,11 @@ class Engine:
                   *self._batch_adapter_operands(reqs, bucket),
                   *self._batch_sampling_operands(reqs, bucket), sub)
         self._perf.done(t0, "decode", bucket, outs)
+        self._sprof.lap("decode_dispatch")
         lead = self._unpack_outs(outs, 4 if self._sampling else 1,
                                  "decode_logits", batch_size=B,
                                  rids=[r.rid for r in reqs])
+        self._sprof.lap("device_wait")
         out = lead[0]
         now = self.clock()
         for i, req in enumerate(reqs):
@@ -1769,6 +1795,7 @@ class Engine:
                                batch_size=B, tokens=len(req.tokens),
                                emitted=1)
             self._maybe_finish(req)
+        self._sprof.lap("host_sync")
         return B
 
     def _spec_ingest(self, req):
@@ -1853,6 +1880,7 @@ class Engine:
                 self._perf.done(t0, "draft", bucket, douts)
                 drafted, q_at, q_vals, q_idx, sw.cache_k, sw.cache_v = \
                     douts
+            self._sprof.lap("decode_dispatch")
             # drafted ids and their candidate-space q views stay ON
             # DEVICE: acceptance runs inside the verify program, so
             # the only host sync this iteration is the emitted rows
@@ -1867,9 +1895,11 @@ class Engine:
                           *self._batch_adapter_operands(reqs, bucket),
                           *samp, sub)
                 self._perf.done(t0, "verify", bucket, outs)
+                self._sprof.lap("decode_dispatch")
                 emit_rows, acc, lp, tv, ti = self._unpack_outs(
                     outs, 5, "verify_logits", batch_size=B,
                     rids=[r.rid for r in reqs])
+                self._sprof.lap("device_wait")
             emitted = 0
             now = self.clock()
             for i, req in enumerate(reqs):
@@ -1900,6 +1930,7 @@ class Engine:
                     sw.forget(req.rid)
                 else:
                     self.blocks.truncate(req.rid, req.cache_len)
+            self._sprof.lap("host_sync")
             return emitted
         with telemetry.span("serve.draft", batch=B, k=k):
             t0 = self._perf.t0()
@@ -1908,9 +1939,11 @@ class Engine:
                 jp, jtab, sub)
             self._perf.done(t0, "draft", bucket, douts)
             drafted, sw.cache_k, sw.cache_v = douts
+            self._sprof.lap("decode_dispatch")
             # mxtpu-lint: disable=host-sync (designed sync point: the
             # drafted ids feed the verify dispatch's host-built rows)
             drafted = np.asarray(drafted)
+            self._sprof.lap("device_wait")
         rows = np.zeros((bucket, k + 1), np.int32)
         rows[:, 0] = toks
         rows[:, 1:] = drafted
@@ -1940,6 +1973,7 @@ class Engine:
                 # mxtpu-lint: disable=host-sync (designed sync point:
                 # acceptance needs the target tokens on the host)
                 out = np.asarray(out)
+        self._sprof.lap("device_wait")
         emitted = 0
         for i, req in enumerate(reqs):
             accepted, emit = spec_mod.accept_greedy(drafted[i], out[i], k)
@@ -1970,6 +2004,7 @@ class Engine:
                 # the accepted sequence return to the free list (never
                 # a shared prefix block — truncate stops at refcount>1)
                 self.blocks.truncate(req.rid, req.cache_len)
+        self._sprof.lap("host_sync")
         return emitted
 
     def _maybe_finish(self, req):

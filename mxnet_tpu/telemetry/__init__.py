@@ -49,8 +49,8 @@ import atexit
 import functools
 import os
 
-from . import (exporters, flight, jaxmon, metrics, request_trace, statusz,
-               timeseries, tracing)
+from . import (exporters, flight, jaxmon, metrics, profiling,
+               request_trace, statusz, timeseries, tracing)
 from .exporters import (append_jsonl, serve_http, to_prometheus_text,
                         write_prometheus)
 from .flight import FlightRecorder
@@ -63,8 +63,8 @@ __all__ = ["enabled", "enable", "disable", "reset", "counter", "gauge",
            "snapshot", "dump", "out_dir", "NOOP", "NOOP_SPAN",
            "DEFAULT_BUCKETS", "to_prometheus_text", "write_prometheus",
            "append_jsonl", "serve_http", "Registry", "SpanTracer",
-           "flight", "statusz", "request_trace", "timeseries",
-           "FlightRecorder", "RequestTracer"]
+           "flight", "statusz", "profiling", "request_trace",
+           "timeseries", "FlightRecorder", "RequestTracer"]
 
 _enabled = False
 _registry = Registry()

@@ -344,6 +344,14 @@ class RequestTracer:
                   "events": events}
         if self.identity is not None:      # only-when-set: schema pin
             record["replica"] = self.identity
+            # perf_counter↔epoch anchor: event "t" fields are
+            # perf-domain and processes don't share a perf epoch, so
+            # fleet lines (identity set ⇒ multi-process timeline
+            # exists) carry the pair timeline_report solves for the
+            # offset with.  Bare-engine lines keep the historic schema
+            epoch = time.time()  # mxtpu-lint: disable=wall-clock (cross-process trace-stitch anchor)
+            record["clock"] = {"perf": time.perf_counter(),
+                               "epoch": epoch}
         if self.model is not None:         # only-when-set: schema pin
             record["model"] = self.model
         adapter = getattr(req, "adapter_id", None)

@@ -175,10 +175,17 @@ class SpanTracer:
     def write(self, path):
         """Write the Chrome-trace JSON object form (Perfetto /
         chrome://tracing / ``profiler.summarize``-style consumers)."""
+        # event "ts" fields are relative to self._t0 (a perf_counter
+        # stamp with no cross-process meaning); the anchor maps ts=0
+        # to the wall clock so tools/timeline_report.py can align this
+        # file with other replicas' traces and device captures
+        # mxtpu-lint: disable=wall-clock (cross-process trace-stitch anchor)
+        t0_epoch = time.time() - (time.perf_counter() - self._t0)
         payload = {"traceEvents": self.trace_events(),
                    "displayTimeUnit": "ms",
                    "otherData": {"producer": "mxnet_tpu.telemetry",
-                                 "dropped_events": self.dropped}}
+                                 "dropped_events": self.dropped,
+                                 "t0_epoch": t0_epoch}}
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(payload, f)

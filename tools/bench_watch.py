@@ -19,6 +19,7 @@ artifact set in priority order:
      tools/serve_bench.py --workload quant  -> QUANT_SERVE_BENCH.json
      tools/serve_bench.py --workload offload -> OFFLOAD_BENCH.json
      tools/serve_bench.py --workload perf-attrib -> PERF_ATTRIB_BENCH.json
+     tools/serve_bench.py --workload step-profile -> PROFILE_BENCH.json
      tools/serve_bench.py --workload lora   -> LORA_BENCH.json
   9. tools/bench_sweep.py                   -> BENCH_SWEEP.json (incremental)
 
@@ -779,6 +780,36 @@ def run_serve_perf_bench(timeout=2400):
         "PERF_ATTRIB_BENCH.json", timeout, validate=validate)
 
 
+def run_serve_step_profile_bench(timeout=2400):
+    """Step-time decomposition A/B (tools/serve_bench.py --workload
+    step-profile) — the per-step host-overhead recorder on (default)
+    vs off: tokens byte-identical, AOT fingerprints unchanged, tok/s
+    within noise of the recorder-off arm, and the on-arm's phase
+    fractions (schedule / dispatch / device-wait / host-sync /
+    callbacks) summing to 1 with every phase present."""
+
+    def validate(payload):
+        if not payload.get("tokens_identical"):
+            return "recorder-on tokens differ from recorder-off"
+        if not payload.get("fingerprint_identical"):
+            return "recorder changed the AOT fingerprint"
+        if (payload.get("tok_s_ratio") or 0) < 0.98:
+            return "recorder cost more than 2% tok/s"
+        if payload.get("off_enabled"):
+            return "MXTPU_STEP_PROFILE=0 arm still recorded"
+        if not payload.get("profiled_steps"):
+            return "on arm recorded zero steps"
+        if not payload.get("phases_all_present"):
+            return "a decomposition phase is missing"
+        return None
+
+    return run_json_artifact(
+        "serve_step_profile",
+        [os.path.join(REPO, "tools", "serve_bench.py"),
+         "--workload", "step-profile"],
+        "PROFILE_BENCH.json", timeout, validate=validate)
+
+
 def run_serve_lora_bench(timeout=2400):
     """Multi-tenant LoRA multiplexing A/B (tools/serve_bench.py
     --workload lora) — adapters-off vs one multiplexed engine cycling
@@ -889,7 +920,8 @@ def main():
             "serve_tp": False, "serve_prefix": False,
             "serve_spec": False, "serve_sampling": False,
             "serve_quant": False, "serve_offload": False,
-            "serve_perf": False, "serve_lora": False,
+            "serve_perf": False, "serve_step_profile": False,
+            "serve_lora": False,
             "train_bench": False, "startup": False, "train_tier": False,
             "sweep": False}
     fails = {k: 0 for k in done}
@@ -1026,6 +1058,9 @@ def main():
              lambda: run_serve_offload_bench(timeout=min(2400, left))),
             ("serve_perf",
              lambda: run_serve_perf_bench(timeout=min(2400, left))),
+            ("serve_step_profile",
+             lambda: run_serve_step_profile_bench(
+                 timeout=min(2400, left))),
             ("serve_lora",
              lambda: run_serve_lora_bench(timeout=min(2400, left))),
             ("train_bench", lambda: run_train_bench(timeout=min(1800, left))),

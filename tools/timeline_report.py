@@ -1,0 +1,448 @@
+#!/usr/bin/env python
+"""Stitch the fleet's observability artifacts into ONE Chrome/Perfetto
+timeline keyed by trace id (the profiling-plane counterpart of
+``trace_report --stitch``; docs/how_to/observability.md walks the
+trigger → capture → stitch workflow).
+
+Inputs, each repeatable:
+
+  --trace FILE.jsonl     request-trace JSONL — router hop lines
+                         (``source: "router"``) and per-replica engine
+                         lines, grouped by the router-propagated
+                         ``trace_id``
+  --host FILE.json       a host span trace (``SpanTracer.write`` /
+                         telemetry dump ``host_trace.json``)
+  --statusz FILE.json    a ``/statusz.json`` snapshot or a flight dump
+                         — any JSON carrying ``step_profile`` sections
+                         (the per-step decomposition rings)
+  --capture FILE.json    profiler-capture metadata (``GET
+                         /profilez/<id>``, saved to a file); the
+                         referenced ``trace_file`` gzip supplies the
+                         device events
+
+Clock model: every source carries (or is) a perf_counter↔epoch anchor
+— fleet trace lines a ``clock`` pair, host traces ``otherData.
+t0_epoch``, step rings a ``clock_anchor``, captures ``started_epoch``
+— so all events land on one wall-clock axis (epoch microseconds).
+Sources missing an anchor still render (at their raw timestamps) but
+count in ``unanchored``.
+
+Step-ring caveat: a ring entry stores per-phase TOTALS, not per-lap
+offsets, so phases render sequentially in canonical order inside the
+step's true [t0, t0+wall] window — exact per-step extent and phase
+sums, approximate intra-step interleaving.
+
+``--check`` audits completeness and exits non-zero when any router hop
+resolves to no engine hop on the same trace id, any stitched event is
+malformed (missing name/ph/ts, negative dur), or nothing was stitched
+at all.
+
+Pure stdlib — usable on a laptop against files scp'd from production.
+
+Usage:
+  python tools/timeline_report.py --trace A.jsonl --trace B.jsonl \\
+      --host host_trace.json --statusz statusz.json \\
+      --capture cap.json --out TIMELINE.json [--check] [--json OUT]
+      [--device-top N]   # keep only the N longest device events
+"""
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+
+STEP_PHASES = ("schedule", "prefill_dispatch", "decode_dispatch",
+               "device_wait", "host_sync", "callbacks")
+
+ROUTER_PID = 1
+_FIRST_DYN_PID = 10
+
+
+class _Pids:
+    """Stable pid registry: one Chrome process per logical source."""
+
+    def __init__(self):
+        self._by_name = {}
+        self._next = _FIRST_DYN_PID
+        self.meta = []
+
+    def get(self, name, sort_hint=None):
+        if name in self._by_name:
+            return self._by_name[name]
+        pid = self._next
+        self._next += 1
+        self._by_name[name] = pid
+        self.meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                          "args": {"name": name}})
+        if sort_hint is not None:
+            self.meta.append({"name": "process_sort_index", "ph": "M",
+                              "pid": pid,
+                              "args": {"sort_index": sort_hint}})
+        return pid
+
+
+def _load_json(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _read_jsonl(path):
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                print(f"warning: {path}:{i}: unparseable line skipped",
+                      file=sys.stderr)
+    return out
+
+
+# -- request-trace lines ------------------------------------------------------
+def stitch_traces(lines, pids, summary):
+    """One X event per request hop (full extent) plus one child X per
+    inter-event interval, all under args.trace_id — the Perfetto query
+    surface for "show me this request everywhere"."""
+    events = []
+    by_tid = {}
+    for rec in lines:
+        tid_key = rec.get("trace_id") or f"rid-{rec.get('rid')}"
+        by_tid.setdefault(tid_key, []).append(rec)
+    track = 0
+    for tid_key in sorted(by_tid):
+        track += 1
+        for rec in by_tid[tid_key]:
+            evs = rec.get("events") or []
+            if not evs:
+                continue
+            source = rec.get("source") or "serve"
+            if source == "router":
+                pid = ROUTER_PID
+                proc = "router"
+            else:
+                proc = f"replica {rec.get('replica') or 'local'}"
+                pid = pids.get(proc)
+            anchor = rec.get("clock")
+            if isinstance(anchor, dict) and "perf" in anchor \
+                    and "epoch" in anchor:
+                off = float(anchor["epoch"]) - float(anchor["perf"])
+            else:
+                off = 0.0
+                summary["unanchored"] += 1
+            t0 = evs[0].get("t", 0.0) + off
+            t1 = evs[-1].get("t", t0) + off
+            args = {"trace_id": rec.get("trace_id"),
+                    "rid": rec.get("rid"), "status": rec.get("status"),
+                    "source": source, "generated": rec.get("generated")}
+            if rec.get("replica"):
+                args["replica"] = rec["replica"]
+            events.append({
+                "name": f"req {tid_key}", "ph": "X", "cat": "request",
+                "pid": pid, "tid": track, "ts": t0 * 1e6,
+                "dur": max(0.0, (t1 - t0)) * 1e6, "args": args})
+            for a, b in zip(evs, evs[1:]):
+                events.append({
+                    "name": a.get("ev", "?"), "ph": "X",
+                    "cat": "request.phase", "pid": pid, "tid": track,
+                    "ts": (a.get("t", 0.0) + off) * 1e6,
+                    "dur": max(0.0, b.get("t", 0.0) - a.get("t", 0.0))
+                    * 1e6,
+                    "args": {"trace_id": rec.get("trace_id")}})
+            summary["hops"] += 1
+    return events
+
+
+def audit_hops(lines):
+    """Router-hop completeness: every router line's trace id must show
+    at least one engine-side hop.  Returns (router_ids, unresolved)."""
+    router_ids, engine_ids = set(), set()
+    for rec in lines:
+        tid = rec.get("trace_id")
+        if tid is None:
+            continue
+        if (rec.get("source") or "serve") == "router":
+            router_ids.add(tid)
+        else:
+            engine_ids.add(tid)
+    return router_ids, sorted(router_ids - engine_ids)
+
+
+# -- step-decomposition rings -------------------------------------------------
+def _find_step_profiles(node, path=""):
+    """Every ``step_profile`` section (with ring + anchor) in a nested
+    JSON document — statusz snapshots nest them per engine provider,
+    flight dumps nest the whole statusz snapshot."""
+    found = []
+    if isinstance(node, dict):
+        sp = node.get("step_profile")
+        if isinstance(sp, dict) and sp.get("recent") is not None:
+            found.append((path or "engine", sp))
+        for k, v in node.items():
+            if k != "step_profile":
+                found.extend(_find_step_profiles(v, f"{path}.{k}"
+                                                 if path else str(k)))
+    elif isinstance(node, list):
+        for i, v in enumerate(node):
+            found.extend(_find_step_profiles(v, f"{path}[{i}]"))
+    return found
+
+
+def stitch_step_rings(doc, label, pids, summary):
+    events = []
+    for where, sp in _find_step_profiles(doc):
+        anchor = sp.get("clock_anchor")
+        if isinstance(anchor, dict) and "perf" in anchor \
+                and "epoch" in anchor:
+            off = float(anchor["epoch"]) - float(anchor["perf"])
+        else:
+            off = 0.0
+            summary["unanchored"] += 1
+        pid = pids.get(f"steps {label}")
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": 1, "args": {"name": where}})
+        for entry in sp.get("recent") or []:
+            t0 = float(entry.get("t0", 0.0)) + off
+            cursor = t0
+            phases = entry.get("phases") or {}
+            events.append({
+                "name": f"step {entry.get('step')}", "ph": "X",
+                "cat": "step", "pid": pid, "tid": 1, "ts": t0 * 1e6,
+                "dur": max(0.0, float(entry.get("wall_s", 0.0))) * 1e6,
+                "args": {"emitted": entry.get("emitted"),
+                         "prefills": entry.get("prefills"),
+                         "decodes": entry.get("decodes")}})
+            for phase in STEP_PHASES:
+                dt = float(phases.get(phase, 0.0))
+                if dt <= 0.0:
+                    continue
+                events.append({
+                    "name": phase, "ph": "X", "cat": "step.phase",
+                    "pid": pid, "tid": 2, "ts": cursor * 1e6,
+                    "dur": dt * 1e6, "args": {}})
+                cursor += dt
+            summary["steps"] += 1
+    return events
+
+
+# -- host span traces ---------------------------------------------------------
+def stitch_host_trace(doc, label, pids, summary):
+    events = []
+    raw = doc.get("traceEvents") if isinstance(doc, dict) else doc
+    if not isinstance(raw, list):
+        return events
+    t0_epoch = None
+    if isinstance(doc, dict):
+        t0_epoch = (doc.get("otherData") or {}).get("t0_epoch")
+    if t0_epoch is None:
+        summary["unanchored"] += 1
+        off_us = 0.0
+    else:
+        off_us = float(t0_epoch) * 1e6
+    pid = pids.get(f"host {label}")
+    for ev in raw:
+        ev = dict(ev)
+        ev["pid"] = pid
+        if ev.get("ph") != "M":
+            ev["ts"] = float(ev.get("ts", 0.0)) + off_us
+            summary["host_events"] += 1
+        elif ev.get("name") == "process_name":
+            continue              # replaced by our pid registry entry
+        events.append(ev)
+    return events
+
+
+# -- device captures ----------------------------------------------------------
+def _capture_trace_file(meta, meta_path):
+    tf = meta.get("trace_file")
+    if tf and os.path.exists(tf):
+        return tf
+    logdir = meta.get("logdir")
+    if logdir:
+        found = sorted(glob.glob(os.path.join(
+            logdir, "plugins", "profile", "*", "*.trace.json.gz")))
+        if found:
+            return found[-1]
+    # artifact fetched over GET /profilez/<id>/trace and saved next to
+    # the metadata file
+    sibling = os.path.splitext(meta_path)[0] + ".trace.json.gz"
+    return sibling if os.path.exists(sibling) else None
+
+
+def stitch_capture(meta, meta_path, pids, summary, device_top):
+    events = []
+    tf = _capture_trace_file(meta, meta_path)
+    cap_id = meta.get("id") or os.path.basename(meta_path)
+    if tf is None:
+        print(f"warning: capture {cap_id}: no trace artifact found",
+              file=sys.stderr)
+        summary["captures_missing"] += 1
+        return events
+    with gzip.open(tf) as f:
+        raw = json.load(f).get("traceEvents") or []
+    xs = [e for e in raw if e.get("ph") == "X"]
+    metas = [e for e in raw if e.get("ph") == "M"
+             and e.get("name") in ("process_name", "thread_name")]
+    # device trace timestamps are xprof-internal; anchor the window's
+    # earliest event at the capture's epoch start
+    base = min((float(e.get("ts", 0.0)) for e in xs), default=0.0)
+    started = meta.get("started_epoch")
+    if started is None:
+        summary["unanchored"] += 1
+        off_us = 0.0
+    else:
+        off_us = float(started) * 1e6 - base
+    if device_top and len(xs) > device_top:
+        xs.sort(key=lambda e: -float(e.get("dur", 0.0)))
+        dropped = len(xs) - device_top
+        xs = xs[:device_top]
+        print(f"capture {cap_id}: kept the {device_top} longest device "
+              f"events, dropped {dropped}", file=sys.stderr)
+        summary["device_events_dropped"] += dropped
+    pid_map = {}
+    for ev in metas + xs:
+        old = ev.get("pid")
+        if old not in pid_map:
+            pid_map[old] = pids.get(f"device {cap_id} p{old}")
+        ev = dict(ev)
+        ev["pid"] = pid_map[old]
+        if ev.get("ph") == "X":
+            ev["ts"] = float(ev.get("ts", 0.0)) + off_us
+            summary["device_events"] += 1
+        elif ev.get("name") == "process_name":
+            continue
+        events.append(ev)
+    return events
+
+
+# -- audit --------------------------------------------------------------------
+def audit_events(events):
+    """Malformed-event findings: every stitched event needs name/ph/ts
+    (metadata events need name/ph), X events a non-negative dur."""
+    bad = []
+    for i, ev in enumerate(events):
+        if not isinstance(ev.get("name"), str) or "ph" not in ev:
+            bad.append(f"event {i}: missing name/ph")
+        elif ev["ph"] != "M" and not isinstance(ev.get("ts"),
+                                                (int, float)):
+            bad.append(f"event {i} ({ev['name']}): missing ts")
+        elif ev["ph"] == "X" and float(ev.get("dur", 0.0)) < 0.0:
+            bad.append(f"event {i} ({ev['name']}): negative dur")
+    return bad
+
+
+# -- driver -------------------------------------------------------------------
+def build(trace_paths, host_paths, statusz_paths, capture_paths,
+          device_top=2000):
+    summary = {"hops": 0, "router_hops": 0, "unresolved_hops": [],
+               "steps": 0, "host_events": 0, "device_events": 0,
+               "device_events_dropped": 0, "captures_missing": 0,
+               "unanchored": 0, "requests": 0}
+    pids = _Pids()
+    pids.meta.append({"name": "process_name", "ph": "M",
+                      "pid": ROUTER_PID, "args": {"name": "router"}})
+    events = []
+    lines = []
+    for p in trace_paths:
+        lines.extend(_read_jsonl(p))
+    summary["requests"] = len({r.get("trace_id") for r in lines
+                               if r.get("trace_id")})
+    events.extend(stitch_traces(lines, pids, summary))
+    router_ids, unresolved = audit_hops(lines)
+    summary["router_hops"] = len(router_ids)
+    summary["unresolved_hops"] = unresolved
+    for p in statusz_paths:
+        events.extend(stitch_step_rings(_load_json(p),
+                                        os.path.basename(p), pids,
+                                        summary))
+    for p in host_paths:
+        events.extend(stitch_host_trace(_load_json(p),
+                                        os.path.basename(p), pids,
+                                        summary))
+    for p in capture_paths:
+        events.extend(stitch_capture(_load_json(p), p, pids, summary,
+                                     device_top))
+    return pids.meta + events, summary
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="stitch fleet observability artifacts into one "
+                    "Chrome/Perfetto timeline")
+    ap.add_argument("--trace", action="append", default=[],
+                    help="request-trace JSONL (repeatable)")
+    ap.add_argument("--host", action="append", default=[],
+                    help="host span trace JSON (repeatable)")
+    ap.add_argument("--statusz", action="append", default=[],
+                    help="statusz snapshot / flight dump JSON with "
+                         "step_profile sections (repeatable)")
+    ap.add_argument("--capture", action="append", default=[],
+                    help="profiler capture metadata JSON (repeatable)")
+    ap.add_argument("--out", default="TIMELINE.json",
+                    help="stitched Chrome trace output path")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the stitch summary JSON here")
+    ap.add_argument("--device-top", type=int, default=2000,
+                    help="keep only the N longest device events per "
+                         "capture (0 = keep all)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero on unresolved hops, malformed "
+                         "events, or an empty stitch")
+    args = ap.parse_args(argv)
+
+    events, summary = build(args.trace, args.host, args.statusz,
+                            args.capture, device_top=args.device_top)
+    findings = audit_events(events)
+    payload = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"producer": "tools/timeline_report",
+                             "summary": summary}}
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, args.out)
+    summary["events"] = len(events)
+    summary["out"] = args.out
+
+    print(f"stitched {len(events)} events -> {args.out}")
+    print(f"  requests: {summary['requests']}  hops: {summary['hops']} "
+          f"(router {summary['router_hops']}, unresolved "
+          f"{len(summary['unresolved_hops'])})")
+    print(f"  steps: {summary['steps']}  host events: "
+          f"{summary['host_events']}  device events: "
+          f"{summary['device_events']}"
+          + (f" (+{summary['device_events_dropped']} dropped)"
+             if summary["device_events_dropped"] else ""))
+    if summary["unanchored"]:
+        print(f"  unanchored sources: {summary['unanchored']} "
+              "(placed at raw timestamps)")
+    for tid in summary["unresolved_hops"]:
+        print(f"  UNRESOLVED router hop: {tid}")
+    for finding in findings[:20]:
+        print(f"  MALFORMED: {finding}")
+
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump({"summary": summary, "malformed": findings}, f,
+                      indent=2, sort_keys=True)
+
+    if args.check:
+        if findings:
+            print(f"--check: FAIL ({len(findings)} malformed events)")
+            return 1
+        if summary["unresolved_hops"]:
+            print(f"--check: FAIL ({len(summary['unresolved_hops'])} "
+                  "unresolved router hops)")
+            return 1
+        if not events:
+            print("--check: FAIL (nothing stitched)")
+            return 1
+        print("--check: OK (well-formed, all hops resolved)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
